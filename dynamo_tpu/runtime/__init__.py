@@ -1,0 +1,48 @@
+"""Distributed runtime core (ref layer L0: lib/runtime)."""
+
+from .component import Client, Component, Endpoint, Namespace, new_instance_id
+from .config import RuntimeConfig, env
+from .discovery import (
+    Discovery,
+    FileDiscovery,
+    KvEvent,
+    Lease,
+    LeaseExpired,
+    MemDiscovery,
+    make_discovery,
+)
+from .distributed import DistributedRuntime
+from .logging import configure_logging, get_logger
+from .push_router import NoInstancesAvailable, PushRouter
+from .request_plane import (
+    ConnectionLost,
+    EndpointNotFound,
+    RemoteError,
+    RequestContext,
+)
+
+__all__ = [
+    "Client",
+    "Component",
+    "ConnectionLost",
+    "Discovery",
+    "DistributedRuntime",
+    "Endpoint",
+    "EndpointNotFound",
+    "FileDiscovery",
+    "KvEvent",
+    "Lease",
+    "LeaseExpired",
+    "MemDiscovery",
+    "Namespace",
+    "NoInstancesAvailable",
+    "PushRouter",
+    "RemoteError",
+    "RequestContext",
+    "RuntimeConfig",
+    "configure_logging",
+    "env",
+    "get_logger",
+    "make_discovery",
+    "new_instance_id",
+]

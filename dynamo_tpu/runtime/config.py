@@ -1,0 +1,164 @@
+"""Env-first configuration registry.
+
+The reference uses a canonical `DYN_*` env-var namespace registered in one
+place (ref: lib/runtime/src/config/environment_names.rs) layered with TOML via
+figment (ref: lib/runtime/src/config.rs). We keep the same design: every knob
+has a canonical `DYNT_*` env name declared here, with typed accessors and an
+optional YAML overlay, so components never read `os.environ` ad hoc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Optional
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+def is_truthy(val: str) -> bool:
+    """Lenient bool parsing (ref: lib/config/src/lib.rs:20 `is_truthy`)."""
+    return val.strip().lower() in _TRUTHY
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+
+_REGISTRY: dict[str, EnvVar] = {}
+
+
+def _register(name: str, default: Any, parse: Callable[[str], Any], doc: str) -> EnvVar:
+    var = EnvVar(name, default, parse, doc)
+    _REGISTRY[name] = var
+    return var
+
+
+def env(name: str) -> Any:
+    """Read a registered env var with its declared parser/default."""
+    var = _REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default
+    return var.parse(raw)
+
+
+def registry() -> dict[str, EnvVar]:
+    return dict(_REGISTRY)
+
+
+_str = str
+_int = int
+_float = float
+_bool = is_truthy
+
+
+# --- canonical knob registry (DYNT_* namespace) ------------------------------
+# Discovery plane
+_register("DYNT_DISCOVERY_BACKEND", "file", _str,
+          "Discovery backend: mem | file | etcd (ref: DYN_DISCOVERY_BACKEND)")
+_register("DYNT_DISCOVERY_PATH", "/tmp/dynamo_tpu_discovery", _str,
+          "Root dir for the file discovery backend")
+_register("DYNT_ETCD_ENDPOINTS", "http://127.0.0.1:2379", _str,
+          "Comma-separated etcd endpoints")
+_register("DYNT_LEASE_TTL_SECS", 10.0, _float,
+          "Discovery lease TTL; dead workers deregister after this "
+          "(ref: docs/design-docs/discovery-plane.md, 10s default)")
+
+# Request plane
+_register("DYNT_REQUEST_PLANE", "tcp", _str,
+          "Request-plane transport: tcp (default) | mem (ref: DYN_REQUEST_PLANE)")
+_register("DYNT_TCP_HOST", "0.0.0.0", _str, "Request-plane TCP bind host")
+_register("DYNT_TCP_ADVERTISE_HOST", "127.0.0.1", _str,
+          "Host advertised to peers for request-plane connections")
+_register("DYNT_TCP_PORT", 0, _int, "Request-plane TCP port (0 = ephemeral)")
+_register("DYNT_REQUEST_TIMEOUT_SECS", 600.0, _float,
+          "Per-request end-to-end timeout on the request plane")
+_register("DYNT_CONNECT_TIMEOUT_SECS", 5.0, _float,
+          "TCP connect timeout for request-plane clients")
+
+# Event plane
+_register("DYNT_EVENT_PLANE", "zmq", _str,
+          "Event-plane transport: zmq (default) | mem (ref: NATS/ZMQ event plane)")
+_register("DYNT_ZMQ_HOST", "127.0.0.1", _str, "Event-plane ZMQ bind/advertise host")
+
+# System status server
+_register("DYNT_SYSTEM_PORT", 0, _int,
+          "System status server port (/health,/live,/metrics); 0 = ephemeral")
+_register("DYNT_SYSTEM_ENABLED", True, _bool, "Enable the system status server")
+
+# Logging
+_register("DYNT_LOG_LEVEL", "INFO", _str, "Log level")
+_register("DYNT_LOGGING_JSONL", False, _bool,
+          "Emit JSONL logs (ref: DYN_LOGGING_JSONL)")
+
+# Engine
+_register("DYNT_KV_BLOCK_SIZE", 16, _int,
+          "Tokens per KV block (block-hash granularity and paged-KV page size)")
+_register("DYNT_COMPILE_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache", _str,
+          "Persistent XLA compilation cache dir")
+
+# Router
+_register("DYNT_ROUTER_OVERLAP_WEIGHT", 1.0, _float,
+          "KV router cost weight for prefix-overlap blocks "
+          "(ref: kv-router scheduling/selector.rs:155)")
+_register("DYNT_ROUTER_TEMPERATURE", 0.0, _float,
+          "KV router softmax sampling temperature (0 = argmin)")
+_register("DYNT_BUSY_THRESHOLD", 0.95, _float,
+          "KV-load busy threshold for 503 load shedding "
+          "(ref: http/service/busy_threshold.rs)")
+
+# Fault tolerance
+_register("DYNT_MIGRATION_LIMIT", 3, _int,
+          "Max in-flight request migrations across workers (ref: migration.rs)")
+_register("DYNT_CANARY_WAIT_SECS", 30.0, _float,
+          "Idle time before canary health-check probes (ref: health_check.rs:22)")
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Resolved runtime configuration (ref: DistributedConfig::from_settings,
+    lib/runtime/src/distributed.rs:540)."""
+
+    discovery_backend: str = "file"
+    discovery_path: str = "/tmp/dynamo_tpu_discovery"
+    etcd_endpoints: str = "http://127.0.0.1:2379"
+    lease_ttl_secs: float = 10.0
+    request_plane: str = "tcp"
+    tcp_host: str = "0.0.0.0"
+    tcp_advertise_host: str = "127.0.0.1"
+    tcp_port: int = 0
+    request_timeout_secs: float = 600.0
+    connect_timeout_secs: float = 5.0
+    event_plane: str = "zmq"
+    zmq_host: str = "127.0.0.1"
+    system_port: int = 0
+    system_enabled: bool = True
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "RuntimeConfig":
+        cfg = cls(
+            discovery_backend=env("DYNT_DISCOVERY_BACKEND"),
+            discovery_path=env("DYNT_DISCOVERY_PATH"),
+            etcd_endpoints=env("DYNT_ETCD_ENDPOINTS"),
+            lease_ttl_secs=env("DYNT_LEASE_TTL_SECS"),
+            request_plane=env("DYNT_REQUEST_PLANE"),
+            tcp_host=env("DYNT_TCP_HOST"),
+            tcp_advertise_host=env("DYNT_TCP_ADVERTISE_HOST"),
+            tcp_port=env("DYNT_TCP_PORT"),
+            request_timeout_secs=env("DYNT_REQUEST_TIMEOUT_SECS"),
+            connect_timeout_secs=env("DYNT_CONNECT_TIMEOUT_SECS"),
+            event_plane=env("DYNT_EVENT_PLANE"),
+            zmq_host=env("DYNT_ZMQ_HOST"),
+            system_port=env("DYNT_SYSTEM_PORT"),
+            system_enabled=env("DYNT_SYSTEM_ENABLED"),
+        )
+        for key, val in overrides.items():
+            if val is not None:
+                setattr(cfg, key, val)
+        return cfg

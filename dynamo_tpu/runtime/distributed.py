@@ -1,0 +1,153 @@
+"""DistributedRuntime — the per-process root of the distributed stack.
+
+One instance per process (ref: lib/runtime/src/distributed.rs:42): owns the
+discovery connection with its lease + keep-alive loop, the request-plane
+server/client, the event plane, and the system status server. Everything else
+(components, endpoints, clients) hangs off it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .component import Namespace, ServedEndpoint
+from .config import RuntimeConfig
+from .discovery import Discovery, Lease, LeaseExpired, make_discovery
+from .events import (
+    EventPublisher,
+    EventSubscriber,
+    MemEventPlane,
+    ZmqEventPublisher,
+    ZmqEventSubscriberManager,
+)
+from .logging import configure_logging, get_logger
+from .request_plane import MemRequestPlane, RequestClient, TcpRequestServer
+from .status import SystemStatusServer
+
+log = get_logger("distributed")
+
+
+class DistributedRuntime:
+    def __init__(self, config: Optional[RuntimeConfig] = None) -> None:
+        configure_logging()
+        self.config = config or RuntimeConfig.from_env()
+        self.discovery: Discovery = make_discovery(
+            self.config.discovery_backend,
+            path=self.config.discovery_path,
+        )
+        self.lease: Optional[Lease] = None
+        if self.config.request_plane == "mem":
+            self.request_server = MemRequestPlane.create_server()
+        else:
+            self.request_server = TcpRequestServer(
+                self.config.tcp_host,
+                self.config.tcp_port,
+                advertise_host=self.config.tcp_advertise_host,
+            )
+        self.request_client = RequestClient(
+            connect_timeout=self.config.connect_timeout_secs
+        )
+        self.status_server: Optional[SystemStatusServer] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._served: list[ServedEndpoint] = []
+        self._subscriber_managers: list = []
+        self._publishers: list[EventPublisher] = []
+        self._started = False
+        self._lease_lost = asyncio.Event()
+
+    async def start(self) -> "DistributedRuntime":
+        if self._started:
+            return self
+        self._started = True
+        await self.discovery.start()
+        self.lease = await self.discovery.create_lease(self.config.lease_ttl_secs)
+        self._keepalive_task = asyncio.create_task(self._keepalive_loop())
+        await self.request_server.start()
+        if self.config.system_enabled:
+            self.status_server = SystemStatusServer(self.config.system_port)
+            await self.status_server.start()
+        log.info("runtime up: request_plane=%s discovery=%s status_port=%s",
+                 self.request_server.address, self.config.discovery_backend,
+                 self.status_server.port if self.status_server else None)
+        return self
+
+    async def _keepalive_loop(self) -> None:
+        """Refresh the lease at TTL/3 (ref: etcd lease keep-alive,
+        transports/etcd.rs). On persistent failure the process's instances
+        will expire cluster-wide; we flag it locally too."""
+        assert self.lease is not None
+        interval = max(0.05, self.lease.ttl / 3.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.discovery.keep_alive(self.lease)
+            except LeaseExpired:
+                log.error("discovery lease expired — instances deregistered")
+                self._lease_lost.set()
+                return
+            except Exception as exc:  # noqa: BLE001 — transient backends
+                log.warning("lease keep-alive failed: %s", exc)
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    # -- event plane -------------------------------------------------------
+
+    def event_publisher(self, namespace: str) -> EventPublisher:
+        if self.config.event_plane == "mem":
+            return MemEventPlane(cluster=namespace).publisher()
+        publisher = ZmqEventPublisher(namespace, self.discovery, self.lease,
+                                      host=self.config.zmq_host)
+        self._publishers.append(publisher)
+        return publisher
+
+    async def event_subscriber(self, namespace: str, topic_prefix: str = "") -> EventSubscriber:
+        if self.config.event_plane == "mem":
+            return await MemEventPlane(cluster=namespace).subscribe(topic_prefix)
+        manager = ZmqEventSubscriberManager(namespace, self.discovery, topic_prefix)
+        self._subscriber_managers.append(manager)
+        return await manager.start()
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def track_served(self, served: ServedEndpoint) -> None:
+        self._served.append(served)
+        if self.status_server is not None:
+            self.status_server.register_health(
+                served.endpoint.subject, served.healthy
+            )
+
+    def untrack_served(self, served: ServedEndpoint) -> None:
+        if served in self._served:
+            self._served.remove(served)
+        if self.status_server is not None:
+            self.status_server.unregister_health(served.endpoint.subject)
+
+    async def shutdown(self) -> None:
+        """Graceful shutdown: deregister + drain endpoints, revoke lease,
+        close transports (ref: GracefulShutdownTracker distributed.rs:18)."""
+        for served in list(self._served):
+            await served.shutdown()
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+            try:
+                await self._keepalive_task
+            except asyncio.CancelledError:
+                pass
+        for manager in self._subscriber_managers:
+            await manager.close()
+        for publisher in self._publishers:
+            await publisher.close()
+        self._publishers.clear()
+        if self.lease is not None:
+            try:
+                await self.discovery.revoke_lease(self.lease)
+            except Exception:  # noqa: BLE001
+                pass
+        await self.request_client.close()
+        await self.request_server.close()
+        if self.status_server is not None:
+            await self.status_server.close()
+        await self.discovery.close()
+        self._started = False

@@ -1,0 +1,216 @@
+"""Event plane: topic pub/sub for KV events and metrics.
+
+The reference's event plane abstracts NATS Core and ZMQ behind
+EventTransportTx/Rx traits (ref: lib/runtime/src/transports/event_plane/
+{mod,zmq_transport,nats_transport}.rs); KV routers subscribe to worker KV-cache
+events over it (ref: lib/llm/src/kv_router/subscriber.rs). There is no broker
+requirement in the ZMQ mode: each publisher binds a PUB socket and advertises
+its address via discovery; subscribers connect to every advertised publisher.
+We implement exactly that ZMQ mode, plus an in-process bus for tests.
+
+Wire format: topic frame (utf-8) + msgpack payload frame.
+Publisher advertisement key: v1/events/{namespace}/{publisher_id} -> {address}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import uuid
+from typing import Any, AsyncIterator, Callable, Optional
+
+import msgpack
+
+from .discovery import Discovery, Lease
+from .logging import get_logger
+
+log = get_logger("events")
+
+EVENT_PREFIX = "v1/events"
+
+
+class EventPublisher:
+    async def publish(self, topic: str, payload: Any) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class EventSubscriber:
+    """Async iterator of (topic, payload)."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+
+    def _emit(self, topic: str, payload: Any) -> None:
+        if not self._closed:
+            self._queue.put_nowait((topic, payload))
+
+    async def close(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator:
+        return self
+
+    async def __anext__(self):
+        item = await self._queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+
+# ---------------------------------------------------------------------------
+# In-process bus
+# ---------------------------------------------------------------------------
+
+
+class _MemBus:
+    def __init__(self) -> None:
+        self.subscribers: list[tuple[str, EventSubscriber, asyncio.AbstractEventLoop]] = []
+
+
+_MEM_BUSES: dict[str, _MemBus] = {}
+
+
+class MemEventPlane:
+    """Process-local topic bus (topic prefix matching like ZMQ SUB)."""
+
+    def __init__(self, cluster: str = "default") -> None:
+        self._bus = _MEM_BUSES.setdefault(cluster, _MemBus())
+
+    def publisher(self) -> "MemEventPublisher":
+        return MemEventPublisher(self._bus)
+
+    async def subscribe(self, topic_prefix: str) -> EventSubscriber:
+        sub = EventSubscriber()
+        self._bus.subscribers.append(
+            (topic_prefix, sub, asyncio.get_running_loop())
+        )
+        return sub
+
+
+class MemEventPublisher(EventPublisher):
+    def __init__(self, bus: _MemBus) -> None:
+        self._bus = bus
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        # msgpack round-trip keeps parity with the ZMQ transport
+        data = msgpack.unpackb(msgpack.packb(payload, use_bin_type=True),
+                               raw=False, strict_map_key=False)
+        for prefix, sub, loop in list(self._bus.subscribers):
+            if topic.startswith(prefix):
+                loop.call_soon_threadsafe(sub._emit, topic, data)
+
+
+# ---------------------------------------------------------------------------
+# ZMQ transport (ref: transports/event_plane/zmq_transport.rs)
+# ---------------------------------------------------------------------------
+
+
+class ZmqEventPublisher(EventPublisher):
+    """Binds a PUB socket on an ephemeral port and advertises it in discovery
+    under the runtime's lease, so subscribers find it and crashes clean up."""
+
+    def __init__(self, namespace: str, discovery: Discovery, lease: Optional[Lease],
+                 host: str = "127.0.0.1") -> None:
+        import zmq
+        import zmq.asyncio
+
+        self._ctx = zmq.asyncio.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        port = self._sock.bind_to_random_port(f"tcp://{host}")
+        self.address = f"tcp://{host}:{port}"
+        self.publisher_id = uuid.uuid4().hex
+        self._namespace = namespace
+        self._discovery = discovery
+        self._lease = lease
+        self._advertised = False
+
+    async def advertise(self) -> None:
+        await self._discovery.put(
+            f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}",
+            {"address": self.address},
+            self._lease,
+        )
+        self._advertised = True
+        # PUB/SUB joins are async; give late subscribers a chance on first use.
+        await asyncio.sleep(0)
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        if not self._advertised:
+            await self.advertise()
+        await self._sock.send_multipart(
+            [topic.encode(), msgpack.packb(payload, use_bin_type=True)]
+        )
+
+    async def close(self) -> None:
+        try:
+            await self._discovery.delete(
+                f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}"
+            )
+        except Exception:  # noqa: BLE001 — discovery may already be closed
+            pass
+        self._sock.close(0)
+
+
+class ZmqEventSubscriberManager:
+    """Watches discovery for publishers in a namespace and keeps one SUB
+    socket connected to all of them (ref: kv_router/subscriber.rs watching
+    the event plane)."""
+
+    def __init__(self, namespace: str, discovery: Discovery, topic_prefix: str) -> None:
+        import zmq
+        import zmq.asyncio
+
+        self._zmq = zmq
+        self._ctx = zmq.asyncio.Context.instance()
+        self._sock = self._ctx.socket(zmq.SUB)
+        self._sock.setsockopt(zmq.SUBSCRIBE, topic_prefix.encode())
+        self._namespace = namespace
+        self._discovery = discovery
+        self._connected: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._subscriber = EventSubscriber()
+
+    async def start(self) -> EventSubscriber:
+        watch = await self._discovery.watch_prefix(
+            f"{EVENT_PREFIX}/{self._namespace}/"
+        )
+        self._watch = watch
+        self._tasks.append(asyncio.create_task(self._watch_loop(watch)))
+        self._tasks.append(asyncio.create_task(self._recv_loop()))
+        return self._subscriber
+
+    async def _watch_loop(self, watch) -> None:
+        async for event in watch:
+            if event.kind == "put" and event.value:
+                address = event.value.get("address")
+                if address and address not in self._connected:
+                    self._sock.connect(address)
+                    self._connected.add(address)
+            elif event.kind == "delete":
+                # ZMQ reconnects are harmless; disconnect is best-effort since
+                # we don't track key->address. Sockets GC on close.
+                pass
+
+    async def _recv_loop(self) -> None:
+        while True:
+            try:
+                topic, payload = await self._sock.recv_multipart()
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                return
+            self._subscriber._emit(
+                topic.decode(),
+                msgpack.unpackb(payload, raw=False, strict_map_key=False),
+            )
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self._watch.cancel()
+        self._sock.close(0)
+        await self._subscriber.close()

@@ -1,0 +1,84 @@
+"""Structured logging: READABLE or JSONL lines with trace context.
+
+Mirrors the reference's tracing-subscriber setup (ref: lib/runtime/src/logging.rs:
+READABLE vs JSONL via DYN_LOGGING_JSONL, env-filter levels). OTEL export is a
+future hook; we carry `x_request_id`/`trace_id` fields through log records so a
+collector can correlate spans across the request plane.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+from .config import env
+
+# Trace context propagated across async tasks and (via request-plane headers)
+# across processes — the W3C-trace-context analog of the reference.
+current_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dynt_request_id", default=None
+)
+
+_CONFIGURED = False
+
+
+class _JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        req_id = current_request_id.get()
+        if req_id:
+            entry["request_id"] = req_id
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+class _ReadableFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        req_id = current_request_id.get()
+        rid = f" [{req_id[:8]}]" if req_id else ""
+        base = (
+            f"{self.formatTime(record, '%H:%M:%S')} "
+            f"{record.levelname:<5} {record.name}{rid}: {record.getMessage()}"
+        )
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+def configure_logging(level: Optional[str] = None, jsonl: Optional[bool] = None) -> None:
+    """Process-wide logging init (ref: configure_dynamo_logging).
+
+    Calls with no arguments are idempotent (first one wins, from env); a call
+    with explicit arguments reconfigures — import-time get_logger() calls must
+    not pin the configuration before the application gets a say.
+    """
+    global _CONFIGURED
+    explicit = level is not None or jsonl is not None
+    if _CONFIGURED and not explicit:
+        return
+    _CONFIGURED = True
+    level = level or env("DYNT_LOG_LEVEL")
+    jsonl = env("DYNT_LOGGING_JSONL") if jsonl is None else jsonl
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_JsonlFormatter() if jsonl else _ReadableFormatter())
+    root = logging.getLogger("dynamo_tpu")
+    root.setLevel(level.upper())
+    for old in list(root.handlers):
+        root.removeHandler(old)
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    configure_logging()
+    return logging.getLogger(f"dynamo_tpu.{name}")

@@ -1,0 +1,135 @@
+"""PushRouter — instance selection + fault-aware dispatch.
+
+Mirrors the reference's PushRouter with RouterMode {RoundRobin, Random,
+PowerOfTwoChoices, KV, Direct} (ref: lib/runtime/src/pipeline/network/egress/
+push_router.rs:71,113-120). Transport failures mark an instance down and it is
+filtered from the candidate list until discovery confirms it or a cooldown
+passes (ref: push_router.rs:8-16,103-107). The KV mode plugs in an external
+selector callback (wired by dynamo_tpu.kv_router).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import time
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional
+
+from .component import Client
+from .logging import get_logger
+from .metrics import ROUTER_DECISIONS
+from .request_plane import ConnectionLost, EndpointNotFound
+
+log = get_logger("push_router")
+
+DOWN_COOLDOWN_SECS = 5.0
+
+
+class NoInstancesAvailable(RuntimeError):
+    pass
+
+
+class PushRouter:
+    def __init__(
+        self,
+        client: Client,
+        mode: str = "round_robin",
+        selector: Optional[Callable[[Any, list[int]], Awaitable[int]]] = None,
+        first_item_timeout: Optional[float] = None,
+    ) -> None:
+        assert mode in ("round_robin", "random", "direct", "kv", "p2c")
+        self.client = client
+        self.mode = mode
+        self._selector = selector
+        self._rr = itertools.count()
+        self._down: dict[int, float] = {}
+        self._inflight: dict[int, int] = {}
+        self._first_item_timeout = first_item_timeout
+        # Clear down-marks when discovery re-confirms an instance.
+        client.on_change(self._on_instance_change)
+
+    def _on_instance_change(self, kind: str, record: dict) -> None:
+        iid = record.get("instance_id")
+        if kind == "put" and iid in self._down:
+            del self._down[iid]
+        if kind == "delete":
+            self._down.pop(iid, None)
+
+    def mark_down(self, instance_id: int) -> None:
+        self._down[instance_id] = time.monotonic()
+
+    def available(self) -> list[int]:
+        now = time.monotonic()
+        out = []
+        for iid in self.client.instance_ids():
+            downed = self._down.get(iid)
+            if downed is not None and now - downed < DOWN_COOLDOWN_SECS:
+                continue
+            out.append(iid)
+        return out
+
+    async def _pick(self, body: Any, instance_id: Optional[int]) -> int:
+        if self.mode == "direct":
+            if instance_id is None:
+                raise ValueError("direct mode requires instance_id")
+            return instance_id
+        avail = self.available()
+        if instance_id is not None:
+            # Explicit target (e.g. KV-selected upstream): honor it only while
+            # it's live and not marked down — otherwise fail fast so the caller
+            # can re-select, instead of re-dialing a dead instance.
+            if instance_id not in avail:
+                raise NoInstancesAvailable(
+                    f"{self.client.endpoint.subject}: instance {instance_id:x} "
+                    "unavailable"
+                )
+            return instance_id
+        if not avail:
+            raise NoInstancesAvailable(self.client.endpoint.subject)
+        if self.mode == "round_robin":
+            return avail[next(self._rr) % len(avail)]
+        if self.mode == "random":
+            return random.choice(avail)
+        if self.mode == "p2c":
+            # Power-of-two-choices on local in-flight counts.
+            a, b = random.sample(avail, 2) if len(avail) >= 2 else (avail[0], avail[0])
+            return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        if self.mode == "kv":
+            assert self._selector is not None, "kv mode requires a selector"
+            return await self._selector(body, avail)
+        raise AssertionError(self.mode)
+
+    async def generate(
+        self,
+        body: Any,
+        instance_id: Optional[int] = None,
+        headers: Optional[dict] = None,
+    ) -> AsyncIterator[Any]:
+        """Route and stream. On transport failure *before any output*, marks
+        the instance down and retries another one; mid-stream failures
+        propagate (migration is a pipeline-level concern, llm/migration.py)."""
+        await self.client.start()
+        attempts = 0
+        while True:
+            iid = await self._pick(body, instance_id)
+            ROUTER_DECISIONS.labels(mode=self.mode).inc()
+            self._inflight[iid] = self._inflight.get(iid, 0) + 1
+            yielded = False
+            try:
+                async for item in self.client.direct(
+                    body, iid, headers, self._first_item_timeout
+                ):
+                    yielded = True
+                    yield item
+                return
+            except (ConnectionLost, EndpointNotFound, KeyError, asyncio.TimeoutError) as exc:
+                self.mark_down(iid)
+                log.warning("instance %x down (%r)", iid, exc)
+                if yielded or self.mode == "direct":
+                    raise ConnectionLost(str(exc)) from exc
+                attempts += 1
+                if attempts >= max(3, len(self.client.instances) + 1):
+                    raise
+            finally:
+                self._inflight[iid] = max(0, self._inflight.get(iid, 1) - 1)

@@ -1,0 +1,64 @@
+"""System status server: /health, /live, /metrics.
+
+Every runtime process exposes liveness, endpoint health, and Prometheus
+metrics on an HTTP port (ref: lib/runtime/src/system_status_server.rs:131-178).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from aiohttp import web
+
+from . import metrics
+from .logging import get_logger
+
+log = get_logger("status")
+
+
+class SystemStatusServer:
+    def __init__(self, port: int = 0, host: str = "0.0.0.0") -> None:
+        self._port = port
+        self._host = host
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+        # Health callbacks: name -> () -> bool (endpoints register themselves)
+        self._health_checks: dict[str, Callable[[], bool]] = {}
+
+    def register_health(self, name: str, check: Callable[[], bool]) -> None:
+        self._health_checks[name] = check
+
+    def unregister_health(self, name: str) -> None:
+        self._health_checks.pop(name, None)
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        results = {name: bool(check()) for name, check in self._health_checks.items()}
+        healthy = all(results.values()) if results else True
+        return web.json_response(
+            {"status": "healthy" if healthy else "unhealthy", "endpoints": results},
+            status=200 if healthy else 503,
+        )
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=metrics.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,143 @@
+"""Discovery plane tests: leases, watches, expiry (ref contract:
+docs/design-docs/discovery-plane.md lease-based cleanup)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.runtime.discovery import (
+    FileDiscovery,
+    KvEvent,
+    LeaseExpired,
+    MemDiscovery,
+)
+
+
+def _mem():
+    return MemDiscovery(cluster=uuid.uuid4().hex, reaper_interval=0.05)
+
+
+class TestMemDiscovery:
+    def test_put_get_prefix(self, run):
+        async def body():
+            d = _mem()
+            await d.start()
+            await d.put("v1/instances/ns/a/1", {"x": 1})
+            await d.put("v1/instances/ns/a/2", {"x": 2})
+            await d.put("v1/other/b", {"x": 3})
+            got = await d.get_prefix("v1/instances/ns/a/")
+            assert set(got) == {"v1/instances/ns/a/1", "v1/instances/ns/a/2"}
+            await d.close()
+
+        run(body())
+
+    def test_lease_expiry_deletes_keys_and_notifies(self, run):
+        async def body():
+            d = _mem()
+            await d.start()
+            lease = await d.create_lease(ttl=0.15)
+            await d.put("k/1", {"v": 1}, lease)
+            watch = await d.watch_prefix("k/")
+            events = []
+
+            async def collect():
+                async for e in watch:
+                    events.append(e)
+                    if e.kind == "delete":
+                        return
+
+            task = asyncio.create_task(collect())
+            await asyncio.wait_for(task, 2.0)
+            kinds = [e.kind for e in events]
+            assert kinds == ["put", "delete"]
+            assert not await d.get_prefix("k/")
+            await d.close()
+
+        run(body())
+
+    def test_keepalive_sustains_lease(self, run):
+        async def body():
+            d = _mem()
+            await d.start()
+            lease = await d.create_lease(ttl=0.2)
+            await d.put("k/1", {"v": 1}, lease)
+            for _ in range(5):
+                await asyncio.sleep(0.1)
+                await d.keep_alive(lease)
+            assert await d.get_prefix("k/")
+            await d.revoke_lease(lease)
+            assert not await d.get_prefix("k/")
+            with pytest.raises(LeaseExpired):
+                await d.keep_alive(lease)
+            await d.close()
+
+        run(body())
+
+    def test_watch_sees_updates_and_deletes(self, run):
+        async def body():
+            d = _mem()
+            await d.start()
+            await d.put("p/a", {"v": 1})
+            watch = await d.watch_prefix("p/", include_existing=True)
+            await d.put("p/b", {"v": 2})
+            await d.delete("p/a")
+            seen = []
+            async for e in watch:
+                seen.append((e.kind, e.key))
+                if len(seen) == 3:
+                    break
+            assert seen == [("put", "p/a"), ("put", "p/b"), ("delete", "p/a")]
+            await d.close()
+
+        run(body())
+
+
+class TestFileDiscovery:
+    def test_cross_handle_visibility(self, run, tmp_discovery):
+        async def body():
+            d1 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            d2 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            await d1.start()
+            await d2.start()
+            lease = await d1.create_lease(ttl=5.0)
+            await d1.put("v1/instances/ns/c/9", {"addr": "tcp://x"}, lease)
+            got = await d2.get_prefix("v1/instances/")
+            assert got == {"v1/instances/ns/c/9": {"addr": "tcp://x"}}
+            await d1.close()
+            await d2.close()
+
+        run(body())
+
+    def test_stale_lease_reaped_by_other_handle(self, run, tmp_discovery):
+        async def body():
+            d1 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            d2 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            await d2.start()
+            lease = await d1.create_lease(ttl=0.2)
+            await d1.put("k/x", {"v": 1}, lease)
+            # d1 "crashes": no keepalive. d2's reaper should delete the key.
+            await asyncio.sleep(0.5)
+            assert not await d2.get_prefix("k/")
+            await d2.close()
+
+        run(body())
+
+    def test_watch_events(self, run, tmp_discovery):
+        async def body():
+            d1 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            d2 = FileDiscovery(tmp_discovery, poll_interval=0.05)
+            await d1.start()
+            await d2.start()
+            watch = await d2.watch_prefix("w/")
+            lease = await d1.create_lease(ttl=5.0)
+            await d1.put("w/a", {"v": 1}, lease)
+            event = await asyncio.wait_for(watch.__anext__(), 2.0)
+            assert (event.kind, event.key, event.value) == ("put", "w/a", {"v": 1})
+            await d1.revoke_lease(lease)
+            event = await asyncio.wait_for(watch.__anext__(), 2.0)
+            assert (event.kind, event.key) == ("delete", "w/a")
+            await d1.close()
+            await d2.close()
+
+        run(body())

@@ -1,0 +1,180 @@
+"""DistributedRuntime end-to-end: serve/discover/route across runtimes
+(ref contract: section 3.2 worker registration flow; push_router fault
+marking push_router.rs:103-107)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    NoInstancesAvailable,
+    PushRouter,
+    RuntimeConfig,
+)
+
+
+def _tcp_cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 0.5
+    return cfg
+
+
+async def _echo_worker(cluster, tag):
+    rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+
+    async def handler(req, ctx):
+        yield {"tag": tag, "echo": req}
+
+    endpoint = rt.namespace("test").component("worker").endpoint("generate")
+    await endpoint.serve_endpoint(handler)
+    return rt
+
+
+class TestDistributedRuntime:
+    def test_serve_discover_call(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            worker_rt = await _echo_worker(cluster, "w0")
+            client_rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+            client = (client_rt.namespace("test").component("worker")
+                      .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+            out = [x async for x in router.generate({"msg": "hello"})]
+            assert out == [{"tag": "w0", "echo": {"msg": "hello"}}]
+            await worker_rt.shutdown()
+            await client_rt.shutdown()
+
+        run(body())
+
+    def test_round_robin_across_workers(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            w0 = await _echo_worker(cluster, "w0")
+            w1 = await _echo_worker(cluster, "w1")
+            client_rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+            client = (client_rt.namespace("test").component("worker")
+                      .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+            tags = set()
+            for _ in range(4):
+                out = [x async for x in router.generate({})]
+                tags.add(out[0]["tag"])
+            assert tags == {"w0", "w1"}
+            for rt in (w0, w1, client_rt):
+                await rt.shutdown()
+
+        run(body())
+
+    def test_worker_crash_deregisters_and_fails_over(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            w0 = await _echo_worker(cluster, "w0")
+            w1 = await _echo_worker(cluster, "w1")
+            client_rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+            client = (client_rt.namespace("test").component("worker")
+                      .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+
+            # Hard-kill w0 (no graceful dereg): cancel keepalive + close server.
+            w0._keepalive_task.cancel()
+            await w0.request_server.close()
+            # Lease TTL is 0.5s; wait for expiry.
+            await asyncio.sleep(1.2)
+            assert len(client.instance_ids()) == 1
+            for _ in range(3):
+                out = [x async for x in router.generate({})]
+                assert out[0]["tag"] == "w1"
+            await w1.shutdown()
+            await client_rt.shutdown()
+            await w0.shutdown()
+
+        run(body())
+
+    def test_transport_failure_marks_down_and_retries(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            w0 = await _echo_worker(cluster, "w0")
+            w1 = await _echo_worker(cluster, "w1")
+            client_rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+            client = (client_rt.namespace("test").component("worker")
+                      .endpoint("generate").client())
+            await client.wait_for_instances(2, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+
+            # Close w0's listener but keep its discovery record alive: the
+            # router must mark it down on connect failure and retry w1.
+            await w0.request_server.close()
+            tags = []
+            for _ in range(4):
+                out = [x async for x in router.generate({})]
+                tags.append(out[0]["tag"])
+            assert set(tags) == {"w1"}
+            await w1.shutdown()
+            await client_rt.shutdown()
+            await w0.shutdown()
+
+        run(body())
+
+    def test_no_instances_raises(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            client_rt = await DistributedRuntime(_tcp_cfg(cluster)).start()
+            client = (client_rt.namespace("test").component("worker")
+                      .endpoint("generate").client())
+            await client.start()
+            router = PushRouter(client, mode="round_robin")
+            with pytest.raises(NoInstancesAvailable):
+                async for _ in router.generate({}):
+                    pass
+            await client_rt.shutdown()
+
+        run(body())
+
+    def test_event_plane_mem(self, run, mem_runtime_config):
+        async def body():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            ns = uuid.uuid4().hex
+            sub = await rt.event_subscriber(ns, topic_prefix="kv.")
+            pub = rt.event_publisher(ns)
+            await pub.publish("kv.events", {"op": "store", "blocks": [1, 2]})
+            topic, payload = await asyncio.wait_for(sub.__anext__(), 2.0)
+            assert topic == "kv.events"
+            assert payload == {"op": "store", "blocks": [1, 2]}
+            await rt.shutdown()
+
+        run(body())
+
+    def test_event_plane_zmq(self, run):
+        async def body():
+            cfg = _tcp_cfg(uuid.uuid4().hex)
+            cfg.event_plane = "zmq"
+            rt = await DistributedRuntime(cfg).start()
+            ns = uuid.uuid4().hex
+            sub = await rt.event_subscriber(ns, topic_prefix="kv.")
+            pub = rt.event_publisher(ns)
+            await pub.advertise()
+            # PUB/SUB join is async: retry publish until received.
+            payload = None
+            for _ in range(50):
+                await pub.publish("kv.events", {"n": 1})
+                try:
+                    _topic, payload = await asyncio.wait_for(sub.__anext__(), 0.1)
+                    break
+                except asyncio.TimeoutError:
+                    continue
+            assert payload == {"n": 1}
+            await pub.close()
+            await rt.shutdown()
+
+        run(body())

@@ -1,0 +1,147 @@
+"""Request plane tests: TCP streaming RPC, multiplexing, errors, cancellation
+(ref contract: lib/runtime/src/pipeline/network/ tcp client/server +
+push_endpoint)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.request_plane import (
+    EndpointNotFound,
+    RemoteError,
+    RequestClient,
+    TcpRequestServer,
+)
+
+
+async def _start_server():
+    server = TcpRequestServer("127.0.0.1", 0, advertise_host="127.0.0.1")
+    await server.start()
+    return server
+
+
+class TestTcpRequestPlane:
+    def test_stream_roundtrip(self, run):
+        async def body():
+            server = await _start_server()
+
+            async def handler(req, ctx):
+                for i in range(req["n"]):
+                    yield {"i": i, "echo": req["msg"]}
+
+            server.registry.register("ns/c/e/1", handler)
+            client = RequestClient()
+            out = [x async for x in client.call(server.address, "ns/c/e/1",
+                                                {"n": 3, "msg": "hi"})]
+            assert out == [{"i": 0, "echo": "hi"}, {"i": 1, "echo": "hi"},
+                           {"i": 2, "echo": "hi"}]
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_concurrent_multiplexed_requests(self, run):
+        async def body():
+            server = await _start_server()
+
+            async def handler(req, ctx):
+                for i in range(5):
+                    await asyncio.sleep(0.01)
+                    yield {"req": req["id"], "i": i}
+
+            server.registry.register("s/1", handler)
+            client = RequestClient()
+
+            async def one(rid):
+                return [x async for x in client.call(server.address, "s/1",
+                                                     {"id": rid})]
+
+            results = await asyncio.gather(*[one(i) for i in range(8)])
+            for rid, res in enumerate(results):
+                assert [x["req"] for x in res] == [rid] * 5
+                assert [x["i"] for x in res] == list(range(5))
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_handler_error_propagates(self, run):
+        async def body():
+            server = await _start_server()
+
+            async def handler(req, ctx):
+                yield {"ok": True}
+                raise ValueError("boom")
+
+            server.registry.register("s/err", handler)
+            client = RequestClient()
+            stream = client.call(server.address, "s/err", {})
+            assert (await stream.__anext__()) == {"ok": True}
+            with pytest.raises(RemoteError, match="boom"):
+                await stream.__anext__()
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_unknown_endpoint(self, run):
+        async def body():
+            server = await _start_server()
+            client = RequestClient()
+            with pytest.raises(EndpointNotFound):
+                async for _ in client.call(server.address, "nope", {}):
+                    pass
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_client_cancellation_stops_handler(self, run):
+        async def body():
+            server = await _start_server()
+            cancelled = asyncio.Event()
+
+            async def handler(req, ctx):
+                try:
+                    i = 0
+                    while True:
+                        yield {"i": i}
+                        i += 1
+                        await asyncio.sleep(0.01)
+                except asyncio.CancelledError:
+                    cancelled.set()
+                    raise
+
+            server.registry.register("s/inf", handler)
+            client = RequestClient()
+            stream = client.call(server.address, "s/inf", {})
+            got = []
+            async for item in stream:
+                got.append(item)
+                if len(got) == 3:
+                    break
+            await stream.aclose()
+            await asyncio.wait_for(cancelled.wait(), 2.0)
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_binary_payload_passthrough(self, run):
+        async def body():
+            server = await _start_server()
+
+            async def handler(req, ctx):
+                yield {"data": req["data"] + b"\x00\x01", "len": len(req["data"])}
+
+            server.registry.register("s/bin", handler)
+            client = RequestClient()
+            blob = bytes(range(256)) * 100
+            out = [x async for x in client.call(server.address, "s/bin",
+                                                {"data": blob})]
+            assert out[0]["len"] == len(blob)
+            assert out[0]["data"] == blob + b"\x00\x01"
+            await client.close()
+            await server.close()
+
+        run(body())
