@@ -1,0 +1,33 @@
+"""KV-aware routing data structures (ref layer L1a: lib/kv-router)."""
+
+from .indexer import RadixTree
+from .protocols import (
+    KV_EVENT_TOPIC,
+    LOAD_TOPIC,
+    KvCacheCleared,
+    KvCacheRemoved,
+    KvCacheStored,
+    LoadMetrics,
+    OverlapScores,
+    RouterEvent,
+    WorkerWithDpRank,
+)
+from .scheduler import KvRouterConfig, KvScheduler, SelectionResult, softmax_sample
+from .sequences import ActiveSequences
+
+__all__ = [
+    "ActiveSequences",
+    "KV_EVENT_TOPIC",
+    "KvCacheCleared",
+    "KvCacheRemoved",
+    "KvCacheStored",
+    "KvRouterConfig",
+    "KvScheduler",
+    "LOAD_TOPIC",
+    "LoadMetrics",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+    "SelectionResult",
+    "WorkerWithDpRank",
+]

@@ -1,0 +1,193 @@
+"""Radix-tree KV indexer: which worker has which cached prefix.
+
+Re-design of the reference indexer (ref: lib/kv-router/src/indexer/
+radix_tree.rs — `find_matches` :156, `apply_event` :323). Because block
+hashes are *sequence* hashes (chained, see dynamo_tpu.tokens), a node's hash
+uniquely identifies its whole prefix, so the tree is keyed directly by
+sequence hash with a flat lookup table for O(1) event application.
+
+Event ordering: per-(worker, dp_rank) monotonic event ids; a gap means we
+missed events and the caller must resync from the worker's local indexer
+(ref: router-design.md "How gap detection works", worker_query.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from .protocols import OverlapScores, RouterEvent, WorkerWithDpRank
+
+
+@dataclasses.dataclass
+class _Node:
+    hash: int
+    parent: Optional["_Node"]
+    children: dict[int, "_Node"] = dataclasses.field(default_factory=dict)
+    workers: set[WorkerWithDpRank] = dataclasses.field(default_factory=set)
+
+
+class RadixTree:
+    def __init__(self) -> None:
+        self._root = _Node(hash=0, parent=None)
+        self._nodes: dict[int, _Node] = {}
+        self._worker_blocks: dict[WorkerWithDpRank, int] = {}
+        self._last_event_id: dict[WorkerWithDpRank, int] = {}
+        self.gap_count = 0
+
+    # -- queries -----------------------------------------------------------
+
+    def find_matches(
+        self, block_hashes: Sequence[int], early_exit: bool = False
+    ) -> OverlapScores:
+        """Per-worker count of leading request blocks already cached there.
+        A worker scores i+1 only if it holds blocks 0..i contiguously."""
+        scores: dict[WorkerWithDpRank, int] = {}
+        node = self._root
+        for depth, block_hash in enumerate(block_hashes):
+            node = node.children.get(block_hash)
+            if node is None:
+                break
+            for worker in node.workers:
+                if scores.get(worker, 0) == depth:
+                    scores[worker] = depth + 1
+            if early_exit and not node.workers:
+                break
+        return OverlapScores(
+            scores=scores,
+            tree_sizes={w: self._worker_blocks.get(w, 0) for w in self._worker_blocks},
+        )
+
+    def worker_block_counts(self) -> dict[WorkerWithDpRank, int]:
+        return dict(self._worker_blocks)
+
+    def total_nodes(self) -> int:
+        return len(self._nodes)
+
+    # -- event application -------------------------------------------------
+
+    def apply_event(self, event: RouterEvent) -> str:
+        """Returns 'ok' or 'gap' (event applied either way; on 'gap' the
+        caller should schedule a resync with the worker)."""
+        worker = WorkerWithDpRank(event.worker_id, event.dp_rank)
+        status = "ok"
+        last = self._last_event_id.get(worker)
+        if last is not None and event.event_id != last + 1:
+            self.gap_count += 1
+            status = "gap"
+        self._last_event_id[worker] = event.event_id
+
+        if event.cleared:
+            self.remove_worker(worker)
+            self._last_event_id[worker] = event.event_id
+            return status
+        if event.stored is not None:
+            self._apply_stored(worker, event.stored.parent_hash,
+                               event.stored.block_hashes)
+        if event.removed is not None:
+            self._apply_removed(worker, event.removed.block_hashes)
+        return status
+
+    def _apply_stored(
+        self, worker: WorkerWithDpRank, parent_hash: Optional[int],
+        block_hashes: Sequence[int],
+    ) -> None:
+        if parent_hash is None:
+            parent = self._root
+        else:
+            parent = self._nodes.get(parent_hash)
+            if parent is None:
+                # Parent unknown (we joined mid-stream): root the chain at its
+                # own first block — sequence hashes keep lookups correct.
+                parent = self._root
+        for block_hash in block_hashes:
+            node = self._nodes.get(block_hash)
+            if node is None:
+                node = _Node(hash=block_hash, parent=parent)
+                self._nodes[block_hash] = node
+                parent.children[block_hash] = node
+            if worker not in node.workers:
+                node.workers.add(worker)
+                self._worker_blocks[worker] = self._worker_blocks.get(worker, 0) + 1
+            parent = node
+
+    def _apply_removed(
+        self, worker: WorkerWithDpRank, block_hashes: Sequence[int]
+    ) -> None:
+        for block_hash in block_hashes:
+            node = self._nodes.get(block_hash)
+            if node is None:
+                continue
+            if worker in node.workers:
+                node.workers.discard(worker)
+                self._worker_blocks[worker] = max(
+                    0, self._worker_blocks.get(worker, 1) - 1
+                )
+            self._maybe_prune(node)
+
+    def _maybe_prune(self, node: _Node) -> None:
+        while node is not self._root and not node.workers and not node.children:
+            parent = node.parent
+            if parent is None:
+                break
+            parent.children.pop(node.hash, None)
+            self._nodes.pop(node.hash, None)
+            node = parent
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        """Drop every block attributed to `worker` (worker left / cleared).
+        (ref: radix_tree.rs remove_worker on instance delete)"""
+        to_prune: list[_Node] = []
+        for node in self._nodes.values():
+            if worker in node.workers:
+                node.workers.discard(worker)
+                to_prune.append(node)
+        # Prune leaf-up: sort deepest-ish by pruning repeatedly.
+        for node in to_prune:
+            self._maybe_prune(node)
+        self._worker_blocks.pop(worker, None)
+        self._last_event_id.pop(worker, None)
+
+    def remove_worker_id(self, worker_id: int) -> None:
+        for worker in [w for w in set(self._worker_blocks) | set(self._last_event_id)
+                       if w.worker_id == worker_id]:
+            self.remove_worker(worker)
+
+    # -- snapshot / resync -------------------------------------------------
+
+    def dump_worker(self, worker: WorkerWithDpRank) -> list[tuple[Optional[int], int]]:
+        """(parent_hash, block_hash) pairs for every block the worker holds —
+        the payload a worker's local indexer returns on resync."""
+        out = []
+        for node in self._nodes.values():
+            if worker in node.workers:
+                parent = node.parent
+                parent_hash = None if parent is self._root or parent is None else parent.hash
+                out.append((parent_hash, node.hash))
+        return out
+
+    def load_worker(
+        self, worker: WorkerWithDpRank, pairs: Sequence[tuple[Optional[int], int]],
+        last_event_id: Optional[int] = None,
+    ) -> None:
+        """Replace a worker's state from a resync dump."""
+        self.remove_worker(worker)
+        # Insert parents before children: iterate until fixpoint.
+        pending = list(pairs)
+        while pending:
+            progressed = False
+            rest = []
+            for parent_hash, block_hash in pending:
+                if parent_hash is None or parent_hash in self._nodes:
+                    self._apply_stored(worker, parent_hash, [block_hash])
+                    progressed = True
+                else:
+                    rest.append((parent_hash, block_hash))
+            if not progressed:
+                # Orphans (parent evicted between dump and load): root them.
+                for parent_hash, block_hash in rest:
+                    self._apply_stored(worker, None, [block_hash])
+                break
+            pending = rest
+        if last_event_id is not None:
+            self._last_event_id[worker] = last_event_id

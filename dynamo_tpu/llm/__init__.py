@@ -1,0 +1,64 @@
+"""LLM serving layer (ref layer L1: lib/llm)."""
+
+from .engine import KvRouterEngine, Migration, RouterEngine, TokenEngine
+from .http_service import HttpService
+from .manager import ModelEntry, ModelManager, ModelWatcher
+from .model_card import (
+    CHAT,
+    COMPLETIONS,
+    EMBEDDINGS,
+    INPUT_TEXT,
+    INPUT_TOKENS,
+    PREFILL,
+    ModelDeploymentCard,
+    publish_card,
+    unpublish_card,
+)
+from .preprocessor import DeltaGenerator, OpenAIPreprocessor, RequestError
+from .protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    new_request_id,
+)
+from .tokenizer import (
+    ByteTokenizer,
+    HfTokenizer,
+    IncrementalDetokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
+
+__all__ = [
+    "ByteTokenizer",
+    "CHAT",
+    "COMPLETIONS",
+    "DeltaGenerator",
+    "EMBEDDINGS",
+    "EngineOutput",
+    "HfTokenizer",
+    "HttpService",
+    "INPUT_TEXT",
+    "INPUT_TOKENS",
+    "IncrementalDetokenizer",
+    "KvRouterEngine",
+    "Migration",
+    "ModelDeploymentCard",
+    "ModelEntry",
+    "ModelManager",
+    "ModelWatcher",
+    "OpenAIPreprocessor",
+    "PREFILL",
+    "PreprocessedRequest",
+    "RequestError",
+    "RouterEngine",
+    "SamplingOptions",
+    "StopConditions",
+    "TokenEngine",
+    "Tokenizer",
+    "load_tokenizer",
+    "new_request_id",
+    "publish_card",
+    "unpublish_card",
+]

@@ -1,0 +1,131 @@
+"""Token-level engine pipeline operators.
+
+Everything in the request path implements one interface — `generate(request)
+-> async stream` — mirroring the reference's core invariant that every hop is
+an AsyncEngine (ref: lib/runtime/src/engine.rs:201-213) and pipelines compose
+by linking operators (ref: entrypoint/input/common.rs:224 build_routed_pipeline):
+
+    Preprocessor -> Migration -> [KvRouterEngine | RouterEngine] -> worker
+
+Operators here speak PreprocessedRequest/EngineOutput; HTTP-shape conversion
+lives in preprocessor.py; transport in runtime.push_router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+from ..kv_router import KvScheduler, WorkerWithDpRank
+from ..runtime.logging import get_logger
+from ..runtime.push_router import NoInstancesAvailable, PushRouter
+from ..runtime.request_plane import ConnectionLost, RemoteError
+from ..tokens import compute_block_hashes
+from .protocols import EngineOutput, PreprocessedRequest
+
+log = get_logger("llm.engine")
+
+
+class TokenEngine:
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[EngineOutput]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+class RouterEngine(TokenEngine):
+    """Dispatch to workers through a PushRouter (round_robin/random/p2c)."""
+
+    def __init__(self, router: PushRouter) -> None:
+        self.router = router
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
+        async for item in self.router.generate(request.to_wire()):
+            yield EngineOutput.from_wire(item)
+
+
+class KvRouterEngine(TokenEngine):
+    """KV-aware dispatch: block-hash the prompt, score candidates by cached
+    overlap + load, route direct, and track the request lifecycle
+    (ref: lib/llm/src/kv_router.rs KvRouter + push_router.rs KvPushRouter;
+    flow in section 3.3)."""
+
+    def __init__(self, router: PushRouter, scheduler: KvScheduler) -> None:
+        self.router = router
+        self.scheduler = scheduler
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
+        await self.router.client.start()
+        avail = self.router.available()
+        if not avail:
+            raise NoInstancesAvailable(self.router.client.endpoint.subject)
+        block_hashes = compute_block_hashes(
+            request.token_ids, self.scheduler.config.block_size
+        )
+        candidates = [WorkerWithDpRank(iid) for iid in avail]
+        result = self.scheduler.select_worker(
+            candidates, block_hashes, len(request.token_ids)
+        )
+        request_id = request.request_id
+        self.scheduler.add_request(request_id, result, len(request.token_ids))
+        first = True
+        try:
+            async for item in self.router.generate(
+                request.to_wire(), instance_id=result.worker.worker_id
+            ):
+                if first:
+                    self.scheduler.mark_prefill_completed(request_id)
+                    first = False
+                yield EngineOutput.from_wire(item)
+        finally:
+            self.scheduler.free(request_id)
+
+
+class Migration(TokenEngine):
+    """Retry a broken stream on another worker, preserving generated tokens
+    (ref: lib/llm/src/migration.rs:36 — accumulated tokens are replayed so
+    decode continues where it left off; bounded by migration_limit)."""
+
+    def __init__(self, inner: TokenEngine, migration_limit: int = 3) -> None:
+        self.inner = inner
+        self.migration_limit = migration_limit
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
+        generated: list[int] = []
+        attempts = 0
+        current = request
+        while True:
+            try:
+                async for output in self.inner.generate(current):
+                    generated.extend(output.token_ids)
+                    yield output
+                return
+            except (ConnectionLost, NoInstancesAvailable, asyncio.TimeoutError) as exc:
+                attempts += 1
+                if attempts > self.migration_limit:
+                    log.warning("migration limit reached for %s: %r",
+                                request.request_id, exc)
+                    yield EngineOutput(finish_reason="error",
+                                       error=f"migration limit exceeded: {exc}")
+                    return
+                remaining = request.sampling.max_tokens - len(generated)
+                if remaining <= 0:
+                    yield EngineOutput(finish_reason="length")
+                    return
+                log.info("migrating %s (attempt %d, %d tokens preserved)",
+                         request.request_id, attempts, len(generated))
+                sampling = type(request.sampling)(**{
+                    **request.sampling.to_wire(), "max_tokens": remaining
+                })
+                current = PreprocessedRequest(
+                    request_id=request.request_id,
+                    token_ids=list(request.token_ids) + generated,
+                    sampling=sampling,
+                    stop=request.stop,
+                    eos_token_ids=request.eos_token_ids,
+                    model=request.model,
+                    prior_output_tokens=generated,
+                    annotations=request.annotations,
+                )
+                await asyncio.sleep(0.05 * attempts)

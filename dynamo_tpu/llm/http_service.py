@@ -1,0 +1,252 @@
+"""OpenAI-compatible HTTP frontend.
+
+Routes (ref: lib/llm/src/http/service/openai.rs:1811-2191, service_v2.rs):
+  POST /v1/chat/completions   (SSE streaming + aggregated)
+  POST /v1/completions
+  GET  /v1/models
+  GET  /health, /live, /metrics
+503 load shedding above a KV-usage busy threshold (ref: busy_threshold.rs);
+client-disconnect propagates cancellation into the pipeline (ref:
+http/service/disconnect.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator, Optional
+
+from aiohttp import web
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.logging import current_request_id, get_logger
+from ..runtime.push_router import NoInstancesAvailable
+from ..runtime.request_plane import RemoteError
+from .manager import ModelEntry, ModelManager
+from .preprocessor import DeltaGenerator, RequestError
+from .protocols import EngineOutput, PreprocessedRequest
+
+log = get_logger("llm.http")
+
+
+def _error_body(status: int, message: str, err_type: str = "invalid_request_error") -> dict:
+    return {"error": {"message": message, "type": err_type, "code": status}}
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        busy_threshold: Optional[float] = None,
+    ) -> None:
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.busy_threshold = busy_threshold
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _lookup(self, model: str) -> ModelEntry:
+        entry = self.manager.get(model)
+        if entry is None:
+            raise web.HTTPNotFound(
+                text=json.dumps(_error_body(
+                    404, f"model '{model}' not found", "model_not_found")),
+                content_type="application/json",
+            )
+        return entry
+
+    def _check_busy(self, entry: ModelEntry) -> None:
+        """Shed load when all workers are past the KV busy threshold."""
+        if self.busy_threshold is None or entry.scheduler is None:
+            return
+        usages = [
+            entry.scheduler.sequences.kv_usage(w)
+            for w in [w for w in entry.scheduler.indexer.worker_block_counts()]
+        ]
+        usages = [u for u in usages if u is not None]
+        if usages and min(usages) >= self.busy_threshold:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps(_error_body(503, "service busy", "overloaded")),
+                content_type="application/json",
+            )
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": card.name, "object": "model", "created": 0,
+                 "owned_by": "dynamo_tpu"}
+                for card in self.manager.list_models()
+            ],
+        })
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        models = [c.name for c in self.manager.list_models()]
+        return web.json_response(
+            {"status": "healthy" if models else "no_models", "models": models}
+        )
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=rt_metrics.render(), content_type="text/plain",
+                            charset="utf-8")
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._completion_common(request, kind="chat")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._completion_common(request, kind="completions")
+
+    async def _completion_common(self, request: web.Request, kind: str) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"), status=400)
+        model = body.get("model", "")
+        entry = self._lookup(model)
+        self._check_busy(entry)
+        try:
+            if kind == "chat":
+                preprocessed = entry.preprocessor.preprocess_chat(body)
+            else:
+                preprocessed = entry.preprocessor.preprocess_completions(body)
+        except RequestError as exc:
+            return web.json_response(_error_body(400, str(exc)), status=400)
+
+        current_request_id.set(preprocessed.request_id)
+        delta_gen = DeltaGenerator(entry.preprocessor, preprocessed, kind=kind)
+        stream = bool(body.get("stream", False))
+        rt_metrics.INPUT_TOKENS.labels(model=model).observe(len(preprocessed.token_ids))
+        if stream:
+            return await self._stream_response(request, entry, preprocessed,
+                                               delta_gen, body)
+        return await self._aggregate_response(entry, preprocessed, delta_gen)
+
+    async def _generate(
+        self, entry: ModelEntry, preprocessed: PreprocessedRequest
+    ) -> AsyncIterator[EngineOutput]:
+        async for output in entry.engine.generate(preprocessed):
+            yield output
+
+    async def _aggregate_response(
+        self, entry: ModelEntry, preprocessed: PreprocessedRequest,
+        delta_gen: DeltaGenerator,
+    ) -> web.Response:
+        model = preprocessed.model
+        start = time.monotonic()
+        first_token_at: Optional[float] = None
+        try:
+            async for output in self._generate(entry, preprocessed):
+                if first_token_at is None and output.token_ids:
+                    first_token_at = time.monotonic()
+                    rt_metrics.TTFT_SECONDS.labels(model=model).observe(
+                        first_token_at - start)
+                delta_gen.on_output(output)
+                if output.error:
+                    return web.json_response(
+                        _error_body(502, output.error, "engine_error"), status=502)
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no workers available", "overloaded"), status=503)
+        except RemoteError as exc:
+            return web.json_response(
+                _error_body(502, str(exc), "engine_error"), status=502)
+        rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
+            delta_gen.completion_tokens)
+        return web.json_response(delta_gen.final_response())
+
+    async def _stream_response(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator, body: dict,
+    ) -> web.StreamResponse:
+        model = preprocessed.model
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": preprocessed.request_id,
+            },
+        )
+        await response.prepare(request)
+        start = time.monotonic()
+        first_token_at: Optional[float] = None
+        last_token_at: Optional[float] = None
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage", False)
+        )
+        try:
+            async for output in self._generate(entry, preprocessed):
+                now = time.monotonic()
+                if output.token_ids:
+                    if first_token_at is None:
+                        first_token_at = now
+                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(now - start)
+                    elif last_token_at is not None:
+                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
+                            (now - last_token_at) / max(1, len(output.token_ids)))
+                    last_token_at = now
+                for chunk in delta_gen.on_output(output):
+                    await response.write(
+                        f"data: {json.dumps(chunk)}\n\n".encode())
+                if delta_gen.finish_reason is not None:
+                    break
+            if include_usage:
+                usage_chunk = {"id": delta_gen.chunk_id,
+                               "object": "chat.completion.chunk" if delta_gen.kind == "chat" else "text_completion",
+                               "created": delta_gen.created, "model": model,
+                               "choices": [], "usage": delta_gen.usage()}
+                await response.write(f"data: {json.dumps(usage_chunk)}\n\n".encode())
+            await response.write(b"data: [DONE]\n\n")
+        except NoInstancesAvailable:
+            await response.write(
+                f"data: {json.dumps(_error_body(503, 'no workers available'))}\n\n".encode())
+            await response.write(b"data: [DONE]\n\n")
+        except RemoteError as exc:
+            # Emit an OpenAI-shaped error event then terminate the stream
+            # cleanly so SDK clients see a parseable failure, not a dropped
+            # chunked read.
+            await response.write(
+                f"data: {json.dumps(_error_body(502, str(exc), 'engine_error'))}\n\n".encode())
+            await response.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: stop generating (cancellation propagates to
+            # the worker through the request plane).
+            log.info("client disconnected: %s", preprocessed.request_id)
+            raise
+        finally:
+            rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
+                delta_gen.completion_tokens)
+        await response.write_eof()
+        return response
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.build_app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("OpenAI frontend listening on %s:%d", self.host, self.port)
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,206 @@
+"""ModelManager + ModelWatcher: discovery-driven serving pipelines.
+
+The frontend does not get configured with workers — it watches discovery for
+ModelDeploymentCards and (re)builds a serving pipeline per model as worker
+instances come and go (ref: lib/llm/src/discovery/watcher.rs:68 ModelWatcher,
+model_manager.rs:67 ModelManager; flow in section 3.1). When the last
+instance of a model disappears, the model is unlisted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Optional
+
+from ..kv_router import (
+    KV_EVENT_TOPIC,
+    LOAD_TOPIC,
+    KvRouterConfig,
+    KvScheduler,
+    LoadMetrics,
+    RouterEvent,
+)
+from ..runtime.discovery import MODEL_CARD_PREFIX
+from ..runtime.logging import get_logger
+from ..runtime.push_router import PushRouter
+from .engine import KvRouterEngine, Migration, RouterEngine, TokenEngine
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+
+log = get_logger("llm.manager")
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    card: ModelDeploymentCard
+    preprocessor: OpenAIPreprocessor
+    engine: TokenEngine
+    router: PushRouter
+    scheduler: Optional[KvScheduler]
+    instances: set[int] = dataclasses.field(default_factory=set)
+
+
+class ModelManager:
+    """model name -> serving pipeline registry."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, ModelEntry] = {}
+
+    def register(self, entry: ModelEntry) -> None:
+        self._models[entry.card.name] = entry
+
+    def unregister(self, name: str) -> None:
+        self._models.pop(name, None)
+
+    def get(self, name: str) -> Optional[ModelEntry]:
+        return self._models.get(name)
+
+    def list_models(self) -> list[ModelDeploymentCard]:
+        return [e.card for e in self._models.values()]
+
+    def entries(self) -> list[ModelEntry]:
+        return list(self._models.values())
+
+
+class ModelWatcher:
+    """Watches v1/mdc/ and maintains the ModelManager (ref: watcher.rs
+    handle_put/handle_delete)."""
+
+    def __init__(
+        self,
+        runtime,
+        manager: ModelManager,
+        router_mode: str = "round_robin",
+        kv_config: Optional[KvRouterConfig] = None,
+        busy_threshold: Optional[float] = None,
+    ) -> None:
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self.kv_config = kv_config
+        self.busy_threshold = busy_threshold
+        self._watch = None
+        self._tasks: list[asyncio.Task] = []
+        # namespace -> schedulers fed by that namespace's event stream; the
+        # list is shared with the running _event_loop so late-registered
+        # models start receiving events immediately.
+        self._ns_schedulers: dict[str, list[KvScheduler]] = {}
+
+    async def start(self) -> None:
+        self._watch = await self.runtime.discovery.watch_prefix(
+            MODEL_CARD_PREFIX + "/"
+        )
+        self._tasks.append(asyncio.create_task(self._watch_loop()))
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._watch is not None:
+            await self._watch.cancel()
+        for entry in self.manager.entries():
+            await entry.router.client.close()
+
+    async def _watch_loop(self) -> None:
+        async for event in self._watch:
+            try:
+                if event.kind == "put" and event.value:
+                    await self._handle_put(event.key, event.value)
+                elif event.kind == "delete":
+                    await self._handle_delete(event.key)
+            except Exception:  # noqa: BLE001 — watcher must survive bad cards
+                log.exception("model watcher failed handling %s", event.key)
+
+    @staticmethod
+    def _parse_key(key: str) -> tuple[str, int]:
+        # v1/mdc/{ns}/{component}/{endpoint}/{instance_id}
+        parts = key.split("/")
+        return "/".join(parts[2:5]), int(parts[5])
+
+    async def _handle_put(self, key: str, value: dict) -> None:
+        subject, instance_id = self._parse_key(key)
+        card = ModelDeploymentCard.from_wire(value)
+        entry = self.manager.get(card.name)
+        if entry is None:
+            entry = self._build_entry(card)
+            await entry.router.client.start()
+            self.manager.register(entry)
+            if entry.scheduler is not None:
+                await self._subscribe_events(card.namespace, entry.scheduler)
+            log.info("model registered: %s (%s, router=%s)", card.name,
+                     subject, self.router_mode)
+        entry.instances.add(instance_id)
+
+    async def _handle_delete(self, key: str) -> None:
+        subject, instance_id = self._parse_key(key)
+        for entry in self.manager.entries():
+            if entry.card.endpoint_subject == subject:
+                entry.instances.discard(instance_id)
+                if entry.scheduler is not None:
+                    entry.scheduler.remove_worker_id(instance_id)
+                if not entry.instances:
+                    log.info("model unlisted: %s (last instance gone)",
+                             entry.card.name)
+                    self.manager.unregister(entry.card.name)
+                    if entry.scheduler is not None:
+                        schedulers = self._ns_schedulers.get(
+                            entry.card.namespace, [])
+                        if entry.scheduler in schedulers:
+                            schedulers.remove(entry.scheduler)
+                    await entry.router.client.close()
+
+    def _build_entry(self, card: ModelDeploymentCard) -> ModelEntry:
+        endpoint = (
+            self.runtime.namespace(card.namespace)
+            .component(card.component)
+            .endpoint(card.endpoint)
+        )
+        client = endpoint.client()
+        scheduler: Optional[KvScheduler] = None
+        if self.router_mode == "kv":
+            config = self.kv_config or KvRouterConfig()
+            config = dataclasses.replace(config, block_size=card.kv_block_size)
+            scheduler = KvScheduler(config)
+            router = PushRouter(client, mode="round_robin")
+            engine: TokenEngine = KvRouterEngine(router, scheduler)
+        else:
+            router = PushRouter(client, mode=self.router_mode)
+            engine = RouterEngine(router)
+        engine = Migration(engine)
+        preprocessor = OpenAIPreprocessor(card)
+        return ModelEntry(
+            card=card,
+            preprocessor=preprocessor,
+            engine=engine,
+            router=router,
+            scheduler=scheduler,
+            instances=set(),
+        )
+
+    async def _subscribe_events(self, namespace: str, scheduler: KvScheduler) -> None:
+        """Feed KV events + load metrics from the event plane into every
+        KV-routed model's scheduler in this namespace (ref:
+        kv_router/subscriber.rs; section 3.3 feedback path)."""
+        schedulers = self._ns_schedulers.get(namespace)
+        if schedulers is not None:
+            schedulers.append(scheduler)
+            return
+        schedulers = [scheduler]
+        self._ns_schedulers[namespace] = schedulers
+        sub = await self.runtime.event_subscriber(namespace, topic_prefix="")
+        self._tasks.append(asyncio.create_task(self._event_loop(sub, schedulers)))
+
+    async def _event_loop(self, sub, schedulers: list[KvScheduler]) -> None:
+        async for topic, payload in sub:
+            try:
+                if topic.startswith(KV_EVENT_TOPIC):
+                    event = RouterEvent.from_wire(payload)
+                    for scheduler in schedulers:
+                        scheduler.indexer.apply_event(event)
+                elif topic.startswith(LOAD_TOPIC):
+                    metrics = LoadMetrics.from_wire(payload)
+                    for scheduler in schedulers:
+                        scheduler.sequences.update_published(metrics)
+            except Exception:  # noqa: BLE001
+                log.exception("bad event on %s", topic)

@@ -1,0 +1,74 @@
+"""ModelDeploymentCard: everything a frontend needs to serve a model.
+
+Workers publish a card into discovery when they register (ref: lib/llm/src/
+model_card.rs:183; attach flow in local_model.rs:427 writes to
+v1/mdc/{ns}/{component}/{endpoint}/{instance_id}); frontends' ModelWatcher
+builds serving pipelines from it (section 3.1). The card carries model
+identity, the tokenizer spec, context/generation limits, and the KV block
+size (which must match between router hashing and engine paging).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..runtime.discovery import MODEL_CARD_PREFIX
+
+# Model types (ref: ModelType Chat|Completions|Prefill|Embeddings...)
+CHAT = "chat"
+COMPLETIONS = "completions"
+PREFILL = "prefill"
+EMBEDDINGS = "embeddings"
+
+# Model input types (ref: ModelInput::{Tokens,Text})
+INPUT_TOKENS = "tokens"
+INPUT_TEXT = "text"
+
+
+@dataclasses.dataclass
+class ModelDeploymentCard:
+    name: str
+    model_types: list[str] = dataclasses.field(default_factory=lambda: [CHAT, COMPLETIONS])
+    model_input: str = INPUT_TOKENS
+    tokenizer: dict = dataclasses.field(default_factory=lambda: {"kind": "byte"})
+    context_length: int = 8192
+    max_output_tokens: int = 4096
+    kv_block_size: int = 16
+    chat_template: Optional[str] = None
+    # Serving component this card belongs to
+    namespace: str = "dynamo"
+    component: str = "backend"
+    endpoint: str = "generate"
+    # Router hints
+    total_kv_blocks: int = 0
+    data_parallel_size: int = 1
+    runtime_config: dict = dataclasses.field(default_factory=dict)
+
+    def card_key(self, instance_id: int) -> str:
+        return (
+            f"{MODEL_CARD_PREFIX}/{self.namespace}/{self.component}/"
+            f"{self.endpoint}/{instance_id}"
+        )
+
+    @property
+    def endpoint_subject(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.endpoint}"
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "ModelDeploymentCard":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in fields})
+
+
+async def publish_card(runtime, card: ModelDeploymentCard, instance_id: int) -> None:
+    """Attach a model card under the runtime lease (ref: LocalModel.attach)."""
+    await runtime.discovery.put(card.card_key(instance_id), card.to_wire(),
+                                runtime.lease)
+
+
+async def unpublish_card(runtime, card: ModelDeploymentCard, instance_id: int) -> None:
+    await runtime.discovery.delete(card.card_key(instance_id))

@@ -1,0 +1,282 @@
+"""OpenAI preprocessor: request lowering and response delta generation.
+
+Forward edge: apply chat template -> tokenize -> PreprocessedRequest with
+sampling + stop conditions (ref: lib/llm/src/preprocessor.rs:147,225).
+Backward edge: incremental detokenization + OpenAI SSE delta construction
+with stop-string jailing — text that might be a prefix of a stop string is
+held until disambiguated (ref: backend.rs detokenizer + http delta path,
+chat_completions/jail.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, AsyncIterator, Optional
+
+import jinja2
+
+from .model_card import ModelDeploymentCard
+from .protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    new_request_id,
+    now_unix,
+    openai_chunk_id,
+)
+from .tokenizer import IncrementalDetokenizer, Tokenizer, load_tokenizer
+
+# ChatML — the de-facto default template when a model ships none.
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|im_start|>{{ message['role'] }}\n{{ message['content'] }}<|im_end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|im_start|>assistant\n{% endif %}"
+)
+
+
+class RequestError(ValueError):
+    """Invalid user request -> HTTP 400."""
+
+
+class OpenAIPreprocessor:
+    def __init__(self, card: ModelDeploymentCard,
+                 tokenizer: Optional[Tokenizer] = None) -> None:
+        self.card = card
+        self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
+        template = card.chat_template or self.tokenizer.chat_template \
+            or DEFAULT_CHAT_TEMPLATE
+        self._template = jinja2.Environment().from_string(template)
+
+    # -- forward: OpenAI request -> PreprocessedRequest --------------------
+
+    def render_chat(self, messages: list[dict]) -> str:
+        for msg in messages:
+            if not isinstance(msg, dict) or "role" not in msg:
+                raise RequestError("each message needs a 'role'")
+            content = msg.get("content")
+            if isinstance(content, list):
+                # Multimodal content parts: concatenate text parts (image
+                # parts are resolved by the multimodal path, not here).
+                msg["content"] = "".join(
+                    part.get("text", "") for part in content
+                    if isinstance(part, dict) and part.get("type") == "text"
+                )
+        return self._template.render(messages=messages, add_generation_prompt=True)
+
+    def preprocess_chat(self, request: dict) -> PreprocessedRequest:
+        messages = request.get("messages")
+        if not messages:
+            raise RequestError("'messages' is required and must be non-empty")
+        prompt = self.render_chat(list(messages))
+        return self._build(prompt, request)
+
+    def preprocess_completions(self, request: dict) -> PreprocessedRequest:
+        prompt = request.get("prompt")
+        if prompt is None:
+            raise RequestError("'prompt' is required")
+        if isinstance(prompt, list):
+            if prompt and isinstance(prompt[0], int):
+                return self._build_from_tokens([int(t) for t in prompt], request)
+            prompt = "".join(str(p) for p in prompt)
+        return self._build(str(prompt), request)
+
+    def _build(self, prompt: str, request: dict) -> PreprocessedRequest:
+        token_ids = self.tokenizer.encode(prompt)
+        return self._build_from_tokens(token_ids, request)
+
+    def _build_from_tokens(self, token_ids: list[int], request: dict) -> PreprocessedRequest:
+        max_context = self.card.context_length
+        if len(token_ids) >= max_context:
+            raise RequestError(
+                f"prompt ({len(token_ids)} tokens) exceeds the model context "
+                f"length ({max_context})"
+            )
+        max_tokens = request.get("max_completion_tokens") or request.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = min(self.card.max_output_tokens,
+                             max_context - len(token_ids))
+        max_tokens = min(int(max_tokens), max_context - len(token_ids))
+        if max_tokens <= 0:
+            raise RequestError("max_tokens must be positive within context length")
+
+        stop = request.get("stop")
+        if stop is None:
+            stop_strings = []
+        elif isinstance(stop, str):
+            stop_strings = [stop]
+        else:
+            stop_strings = [str(s) for s in stop][:8]
+
+        sampling = SamplingOptions(
+            max_tokens=max_tokens,
+            temperature=float(request.get("temperature", 1.0) or 0.0),
+            top_p=float(request.get("top_p", 1.0) or 1.0),
+            top_k=int(request.get("top_k", 0) or 0),
+            seed=request.get("seed"),
+            frequency_penalty=float(request.get("frequency_penalty", 0.0) or 0.0),
+            presence_penalty=float(request.get("presence_penalty", 0.0) or 0.0),
+            logprobs=bool(request.get("logprobs", False)),
+            top_logprobs=int(request.get("top_logprobs", 0) or 0),
+        )
+        return PreprocessedRequest(
+            request_id=new_request_id(),
+            token_ids=token_ids,
+            sampling=sampling,
+            stop=StopConditions(
+                stop_token_ids=[],
+                stop_strings=stop_strings,
+                ignore_eos=bool(request.get("ignore_eos", False)),
+            ),
+            eos_token_ids=list(self.tokenizer.eos_token_ids),
+            model=request.get("model", self.card.name),
+        )
+
+
+class DeltaGenerator:
+    """Backward edge: EngineOutput stream -> OpenAI SSE chunk dicts, with
+    incremental detokenization and stop-string jailing."""
+
+    def __init__(
+        self,
+        preprocessor: OpenAIPreprocessor,
+        request: PreprocessedRequest,
+        kind: str = "chat",  # chat | completions
+    ) -> None:
+        self.pre = preprocessor
+        self.request = request
+        self.kind = kind
+        self.chunk_id = openai_chunk_id()
+        self.created = now_unix()
+        self.detok = IncrementalDetokenizer(preprocessor.tokenizer)
+        self.completion_tokens = 0
+        self.finish_reason: Optional[str] = None
+        self._jail = ""  # text held back: may be a prefix of a stop string
+        self._stopped = False
+        self._role_sent = False
+        self.full_text = ""
+
+    # stop-string handling ------------------------------------------------
+
+    def _filter_stop(self, text: str, final: bool) -> tuple[str, bool]:
+        """Returns (emit_text, hit_stop). Holds back possible stop prefixes."""
+        stops = self.request.stop.stop_strings
+        if not stops:
+            return text, False
+        buf = self._jail + text
+        # Full stop match?
+        earliest = None
+        for stop in stops:
+            idx = buf.find(stop)
+            if idx != -1 and (earliest is None or idx < earliest):
+                earliest = idx
+        if earliest is not None:
+            self._jail = ""
+            return buf[:earliest], True
+        if final:
+            self._jail = ""
+            return buf, False
+        # Hold back the longest tail that is a proper prefix of any stop.
+        hold = 0
+        for stop in stops:
+            for k in range(min(len(stop) - 1, len(buf)), 0, -1):
+                if buf.endswith(stop[:k]):
+                    hold = max(hold, k)
+                    break
+        self._jail = buf[len(buf) - hold :] if hold else ""
+        return buf[: len(buf) - hold] if hold else buf, False
+
+    # chunk construction --------------------------------------------------
+
+    def _chunk(self, delta: dict, finish_reason: Optional[str]) -> dict:
+        if self.kind == "chat":
+            return {
+                "id": self.chunk_id,
+                "object": "chat.completion.chunk",
+                "created": self.created,
+                "model": self.request.model,
+                "choices": [{
+                    "index": 0,
+                    "delta": delta,
+                    "finish_reason": finish_reason,
+                }],
+            }
+        return {
+            "id": self.chunk_id,
+            "object": "text_completion",
+            "created": self.created,
+            "model": self.request.model,
+            "choices": [{
+                "index": 0,
+                "text": delta.get("content", ""),
+                "finish_reason": finish_reason,
+            }],
+        }
+
+    def on_output(self, output: EngineOutput) -> list[dict]:
+        """Convert one engine item into zero or more SSE chunks."""
+        if self._stopped:
+            return []
+        chunks: list[dict] = []
+        if output.error:
+            self.finish_reason = "error"
+            self._stopped = True
+            return [self._chunk({}, "error")]
+        self.completion_tokens += len(output.token_ids)
+        final = output.finish_reason is not None
+        text = self.detok.push(output.token_ids)
+        if final:
+            text += self.detok.flush()
+        emit, hit_stop = self._filter_stop(text, final)
+        if emit:
+            self.full_text += emit
+            delta: dict = {"content": emit}
+            if self.kind == "chat" and not self._role_sent:
+                delta["role"] = "assistant"
+                self._role_sent = True
+            chunks.append(self._chunk(delta, None))
+        if hit_stop:
+            self.finish_reason = "stop"
+            self._stopped = True
+            chunks.append(self._chunk({}, "stop"))
+        elif final:
+            self.finish_reason = output.finish_reason
+            self._stopped = True
+            chunks.append(self._chunk({}, output.finish_reason))
+        return chunks
+
+    def usage(self) -> dict:
+        return {
+            "prompt_tokens": len(self.request.token_ids),
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": len(self.request.token_ids) + self.completion_tokens,
+        }
+
+    def final_response(self) -> dict:
+        """Non-streaming aggregate response."""
+        if self.kind == "chat":
+            return {
+                "id": self.chunk_id,
+                "object": "chat.completion",
+                "created": self.created,
+                "model": self.request.model,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": self.full_text},
+                    "finish_reason": self.finish_reason or "stop",
+                }],
+                "usage": self.usage(),
+            }
+        return {
+            "id": self.chunk_id,
+            "object": "text_completion",
+            "created": self.created,
+            "model": self.request.model,
+            "choices": [{
+                "index": 0,
+                "text": self.full_text,
+                "finish_reason": self.finish_reason or "stop",
+            }],
+            "usage": self.usage(),
+        }

@@ -1,0 +1,152 @@
+"""LLM serving protocols: the token-level request/response contract.
+
+The frontend preprocessor lowers OpenAI-shape requests into a
+PreprocessedRequest of token ids + sampling + stop conditions, which is what
+crosses the request plane to workers (ref: lib/llm/src/preprocessor.rs
+OpenAIPreprocessor -> PreprocessedRequest; protocols/common.rs). Workers
+stream back token deltas; the Backend operator detokenizes incrementally
+(ref: lib/llm/src/backend.rs:56).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class SamplingOptions:
+    max_tokens: int = 256
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    seed: Optional[int] = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    logprobs: bool = False
+    top_logprobs: int = 0
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "SamplingOptions":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in (data or {}).items() if k in fields})
+
+
+@dataclasses.dataclass
+class StopConditions:
+    stop_token_ids: list[int] = dataclasses.field(default_factory=list)
+    stop_strings: list[str] = dataclasses.field(default_factory=list)
+    ignore_eos: bool = False
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "StopConditions":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in (data or {}).items() if k in fields})
+
+
+@dataclasses.dataclass
+class PreprocessedRequest:
+    """What the frontend sends to a worker (ModelInput.Tokens)."""
+
+    request_id: str
+    token_ids: list[int]
+    sampling: SamplingOptions
+    stop: StopConditions
+    eos_token_ids: list[int] = dataclasses.field(default_factory=list)
+    model: str = ""
+    # Router-injected: disaggregated prefill handoff (ref: section 3.4)
+    disaggregated_params: Optional[dict] = None
+    # Echo of prior output tokens on migration (ref: migration.rs retains
+    # generated tokens when replaying to a new worker)
+    prior_output_tokens: list[int] = dataclasses.field(default_factory=list)
+    annotations: dict = dataclasses.field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        out = {
+            "request_id": self.request_id,
+            "token_ids": self.token_ids,
+            "sampling": self.sampling.to_wire(),
+            "stop": self.stop.to_wire(),
+            "eos_token_ids": self.eos_token_ids,
+            "model": self.model,
+            "annotations": self.annotations,
+        }
+        if self.disaggregated_params is not None:
+            out["disaggregated_params"] = self.disaggregated_params
+        if self.prior_output_tokens:
+            out["prior_output_tokens"] = self.prior_output_tokens
+        return out
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "PreprocessedRequest":
+        return cls(
+            request_id=data.get("request_id") or uuid.uuid4().hex,
+            token_ids=list(data.get("token_ids") or []),
+            sampling=SamplingOptions.from_wire(data.get("sampling") or {}),
+            stop=StopConditions.from_wire(data.get("stop") or {}),
+            eos_token_ids=list(data.get("eos_token_ids") or []),
+            model=data.get("model", ""),
+            disaggregated_params=data.get("disaggregated_params"),
+            prior_output_tokens=list(data.get("prior_output_tokens") or []),
+            annotations=data.get("annotations") or {},
+        )
+
+
+@dataclasses.dataclass
+class EngineOutput:
+    """One streamed item from a worker: newly generated token ids (usually
+    one for decode, many for a final chunk) plus terminal state."""
+
+    token_ids: list[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None  # stop | length | error | cancelled
+    # Cumulative count of prompt tokens actually processed (first chunk)
+    prompt_tokens: Optional[int] = None
+    logprobs: Optional[list[float]] = None
+    # Disagg: prefill worker returns KV handoff params instead of decoding
+    kv_transfer_params: Optional[dict] = None
+    error: Optional[str] = None
+
+    def to_wire(self) -> dict:
+        out: dict = {"t": self.token_ids}
+        if self.finish_reason is not None:
+            out["f"] = self.finish_reason
+        if self.prompt_tokens is not None:
+            out["p"] = self.prompt_tokens
+        if self.logprobs is not None:
+            out["lp"] = self.logprobs
+        if self.kv_transfer_params is not None:
+            out["kv"] = self.kv_transfer_params
+        if self.error is not None:
+            out["err"] = self.error
+        return out
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "EngineOutput":
+        return cls(
+            token_ids=list(data.get("t") or []),
+            finish_reason=data.get("f"),
+            prompt_tokens=data.get("p"),
+            logprobs=data.get("lp"),
+            kv_transfer_params=data.get("kv"),
+            error=data.get("err"),
+        )
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex
+
+
+def openai_chunk_id() -> str:
+    return f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+
+def now_unix() -> int:
+    return int(time.time())

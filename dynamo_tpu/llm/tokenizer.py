@@ -1,0 +1,187 @@
+"""Tokenizer abstraction: HF `tokenizers` (local files) + byte-level fallback.
+
+The reference embeds HF tokenizers behind its preprocessor (ref: lib/llm/src/
+preprocessor.rs uses the `tokenizers` crate; tokenizer config travels in the
+ModelDeploymentCard). We support:
+
+  * HfTokenizer  — loads tokenizer.json via the `tokenizers` library
+  * ByteTokenizer — 256 byte vocab + special tokens; zero-asset, used for
+    tests and the mocker (this environment has no model downloads)
+
+Incremental (streaming) detokenization uses the prefix-offset technique: keep
+decoding the tail window of tokens and only emit the stable UTF-8 suffix, so
+multi-token unicode and SentencePiece prefix spaces render correctly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+class Tokenizer:
+    eos_token_ids: list[int] = []
+    vocab_size: int = 0
+    chat_template: Optional[str] = None
+    # How many trailing tokens may still merge with future tokens when
+    # decoding incrementally (BPE merges / sentencepiece boundary spaces).
+    # Byte-level decode is prefix-stable, so 0 there.
+    stable_window: int = 4
+
+    def encode(self, text: str) -> list[int]:
+        raise NotImplementedError
+
+    def decode(self, token_ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    def spec(self) -> dict:
+        """Serializable description for the ModelDeploymentCard."""
+        raise NotImplementedError
+
+
+class ByteTokenizer(Tokenizer):
+    """Byte-level: token i (< 256) is byte i. Special tokens above 255.
+    Mirrors the role of the mocker's tokenizer-free operation."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+    IM_START = 259
+    IM_END = 260
+
+    SPECIALS = {BOS: "<s>", EOS: "</s>", PAD: "<pad>",
+                IM_START: "<|im_start|>", IM_END: "<|im_end|>"}
+
+    def __init__(self) -> None:
+        self.eos_token_ids = [self.EOS, self.IM_END]
+        self.vocab_size = 512  # headroom above 261 for model round numbers
+        self.stable_window = 0
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8", errors="replace"))
+
+    def decode(self, token_ids: Sequence[int]) -> str:
+        out: list[str] = []
+        buf = bytearray()
+        for tok in token_ids:
+            if tok < 256:
+                buf.append(tok)
+            else:
+                if buf:
+                    out.append(buf.decode("utf-8", errors="replace"))
+                    buf.clear()
+                out.append(self.SPECIALS.get(tok, f"<unk:{tok}>"))
+        if buf:
+            out.append(buf.decode("utf-8", errors="replace"))
+        return "".join(out)
+
+    def spec(self) -> dict:
+        return {"kind": "byte"}
+
+
+class HfTokenizer(Tokenizer):
+    def __init__(self, path: str) -> None:
+        from tokenizers import Tokenizer as _HfTok
+
+        tok_file = path
+        if os.path.isdir(path):
+            tok_file = os.path.join(path, "tokenizer.json")
+        self._tok = _HfTok.from_file(tok_file)
+        self._path = path
+        self.vocab_size = self._tok.get_vocab_size()
+        self.eos_token_ids = []
+        self.chat_template = None
+        # Pull eos/chat_template from sibling config files if present.
+        cfg_dir = path if os.path.isdir(path) else os.path.dirname(path)
+        self._load_config(cfg_dir)
+
+    def _load_config(self, cfg_dir: str) -> None:
+        import json
+
+        tcfg_path = os.path.join(cfg_dir, "tokenizer_config.json")
+        gcfg_path = os.path.join(cfg_dir, "generation_config.json")
+        if os.path.exists(tcfg_path):
+            try:
+                with open(tcfg_path) as f:
+                    tcfg = json.load(f)
+                self.chat_template = tcfg.get("chat_template")
+                eos = tcfg.get("eos_token")
+                if isinstance(eos, dict):
+                    eos = eos.get("content")
+                if isinstance(eos, str):
+                    tid = self._tok.token_to_id(eos)
+                    if tid is not None:
+                        self.eos_token_ids.append(tid)
+            except (OSError, ValueError):
+                pass
+        if os.path.exists(gcfg_path):
+            try:
+                with open(gcfg_path) as f:
+                    gcfg = json.load(f)
+                eos = gcfg.get("eos_token_id")
+                if isinstance(eos, int):
+                    eos = [eos]
+                if isinstance(eos, list):
+                    self.eos_token_ids.extend(
+                        e for e in eos if e not in self.eos_token_ids
+                    )
+            except (OSError, ValueError):
+                pass
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, token_ids: Sequence[int]) -> str:
+        return self._tok.decode(list(token_ids), skip_special_tokens=True)
+
+    def spec(self) -> dict:
+        return {"kind": "hf", "path": self._path}
+
+
+def load_tokenizer(spec: dict) -> Tokenizer:
+    kind = spec.get("kind", "byte")
+    if kind == "byte":
+        return ByteTokenizer()
+    if kind == "hf":
+        return HfTokenizer(spec["path"])
+    raise ValueError(f"unknown tokenizer spec: {spec!r}")
+
+
+class IncrementalDetokenizer:
+    """Streaming decode: emits only text that can no longer change as more
+    tokens arrive (ref: Backend detokenizer hot loop, lib/llm/src/backend.rs)."""
+
+    def __init__(self, tokenizer: Tokenizer, window: Optional[int] = None) -> None:
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._emitted = 0  # chars already flushed
+        self._window = tokenizer.stable_window if window is None else window
+
+    def push(self, token_ids: Sequence[int]) -> str:
+        """Add tokens, return newly-stable text (may be '')."""
+        self._ids.extend(token_ids)
+        full = self._tok.decode(self._ids)
+        # The tail may still merge with future tokens (BPE/unicode): hold back
+        # text decoded from the last `window` tokens unless it ends cleanly.
+        if self._window == 0:
+            stable_upto = len(full)
+        elif len(self._ids) > self._window:
+            stable_upto = len(self._tok.decode(self._ids[: -self._window]))
+        else:
+            stable_upto = 0
+        # Never emit a trailing replacement char (partial UTF-8 sequence).
+        candidate = full[self._emitted : stable_upto]
+        if candidate.endswith("�"):
+            candidate = candidate[:-1]
+            stable_upto = self._emitted + len(candidate)
+        if stable_upto <= self._emitted:
+            return ""
+        self._emitted = stable_upto
+        return candidate
+
+    def flush(self) -> str:
+        """Emit everything outstanding (end of stream)."""
+        full = self._tok.decode(self._ids)
+        out = full[self._emitted :]
+        self._emitted = len(full)
+        return out

@@ -1,0 +1,6 @@
+"""Chip-free engine simulator (ref layer L4: lib/mocker)."""
+
+from .engine import MockerConfig, MockerEngine
+from .worker import MockerWorker
+
+__all__ = ["MockerConfig", "MockerEngine", "MockerWorker"]

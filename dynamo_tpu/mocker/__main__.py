@@ -1,0 +1,5 @@
+import asyncio
+
+from .worker import main
+
+asyncio.run(main())

@@ -1,0 +1,387 @@
+"""Mocker: chip-free engine simulator.
+
+The linchpin of CI-scale testing (ref: lib/mocker — vLLM-style continuous
+batching sim scheduler/vllm/core.rs, paged KV with prefix cache + LRU
+kv_manager/vllm_backend.rs + cache/radix_cache.rs, `--speedup-ratio` timing,
+KV event publishing; docs/mocker/mocker.md). Simulates a TPU inference
+engine: paged KV pool with prefix caching and LRU eviction, continuous
+batching with chunked prefill, a timing model, KV-cache events, and load
+metrics — so routing / planner / disagg logic is testable with zero chips.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import AsyncIterator, Optional
+
+from ..kv_router.protocols import (
+    KV_EVENT_TOPIC,
+    LOAD_TOPIC,
+    KvCacheRemoved,
+    KvCacheStored,
+    LoadMetrics,
+    RouterEvent,
+)
+from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..runtime.logging import get_logger
+from ..tokens import compute_block_hashes
+
+log = get_logger("mocker")
+
+
+@dataclasses.dataclass
+class MockerConfig:
+    block_size: int = 16
+    num_blocks: int = 1024
+    max_batch: int = 32
+    max_prefill_tokens_per_step: int = 2048  # chunked prefill budget
+    prefill_us_per_token: float = 300.0
+    decode_base_ms: float = 8.0
+    decode_us_per_seq: float = 100.0
+    speedup_ratio: float = 1.0
+    watermark: float = 0.01  # keep this fraction of blocks free
+    vocab_size: int = 512
+    dp_rank: int = 0
+
+
+class _PagedKvCache:
+    """Prefix cache over sequence-hash-identified blocks with LRU eviction
+    of unreferenced blocks (ref: kv_manager/vllm_backend.rs + radix_cache.rs)."""
+
+    def __init__(self, num_blocks: int) -> None:
+        self.capacity = num_blocks
+        self.used = 0  # blocks held by running requests (non-cached)
+        self.cached: OrderedDict[int, None] = OrderedDict()  # hash -> LRU
+        self.refcount: dict[int, int] = {}
+
+    def free_blocks(self) -> int:
+        return self.capacity - self.used - len(self.cached)
+
+    def match_prefix(self, block_hashes: list[int]) -> int:
+        """Longest cached prefix; touches LRU and pins the blocks."""
+        matched = 0
+        for block_hash in block_hashes:
+            if block_hash in self.cached:
+                self.cached.move_to_end(block_hash)
+                matched += 1
+            else:
+                break
+        return matched
+
+    def pin(self, block_hashes: list[int]) -> None:
+        for h in block_hashes:
+            self.refcount[h] = self.refcount.get(h, 0) + 1
+
+    def unpin(self, block_hashes: list[int]) -> None:
+        for h in block_hashes:
+            n = self.refcount.get(h, 0) - 1
+            if n <= 0:
+                self.refcount.pop(h, None)
+            else:
+                self.refcount[h] = n
+
+    def allocate(self, n: int, evict_cb) -> bool:
+        """Reserve n uncached blocks, evicting LRU cached blocks if needed."""
+        while self.free_blocks() < n and self.cached:
+            evicted = []
+            for h in list(self.cached):
+                if self.refcount.get(h, 0) == 0:
+                    self.cached.pop(h)
+                    evicted.append(h)
+                    if self.free_blocks() >= n:
+                        break
+            if evicted:
+                evict_cb(evicted)
+            else:
+                break  # everything pinned
+        if self.free_blocks() < n:
+            return False
+        self.used += n
+        return True
+
+    def release(self, n: int) -> None:
+        self.used = max(0, self.used - n)
+
+    def insert_cached(self, block_hashes: list[int], from_used: int) -> list[int]:
+        """Move `from_used` request-held blocks into the reusable cache under
+        their hashes; returns the hashes newly added."""
+        new = []
+        for h in block_hashes:
+            if h not in self.cached:
+                self.cached[h] = None
+                new.append(h)
+            else:
+                self.cached.move_to_end(h)
+        self.used = max(0, self.used - from_used)
+        return new
+
+    def usage(self) -> float:
+        return (self.used + len(self.cached)) / max(1, self.capacity)
+
+
+@dataclasses.dataclass
+class _Sequence:
+    request: PreprocessedRequest
+    queue: asyncio.Queue
+    block_hashes: list[int]
+    cached_blocks: int  # prefix hit
+    new_blocks: int  # allocated for the remainder
+    prefilled_tokens: int = 0
+    generated: int = 0
+    done: bool = False
+    cancelled: bool = False
+    pinned: list[int] = dataclasses.field(default_factory=list)
+
+
+class MockerEngine:
+    """Continuous-batching simulator; `generate` is a worker handler."""
+
+    def __init__(
+        self,
+        config: Optional[MockerConfig] = None,
+        worker_id: int = 0,
+        event_publisher=None,
+    ) -> None:
+        self.config = config or MockerConfig()
+        self.worker_id = worker_id
+        self.kv = _PagedKvCache(self.config.num_blocks)
+        self._waiting: list[_Sequence] = []
+        self._running: list[_Sequence] = []
+        self._publisher = event_publisher
+        self._event_id = 0
+        self._step_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        self.steps = 0
+        self._pending_stored: list[tuple[list[int], Optional[int]]] = []
+
+    # -- events ------------------------------------------------------------
+
+    async def _publish_stored(self, hashes: list[int], parent: Optional[int]) -> None:
+        if self._publisher is None or not hashes:
+            return
+        event = RouterEvent(
+            worker_id=self.worker_id, event_id=self._event_id,
+            dp_rank=self.config.dp_rank,
+            stored=KvCacheStored(block_hashes=hashes, parent_hash=parent),
+        )
+        self._event_id += 1
+        await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
+
+    async def _publish_removed(self, hashes: list[int]) -> None:
+        if self._publisher is None or not hashes:
+            return
+        event = RouterEvent(
+            worker_id=self.worker_id, event_id=self._event_id,
+            dp_rank=self.config.dp_rank,
+            removed=KvCacheRemoved(block_hashes=hashes),
+        )
+        self._event_id += 1
+        await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
+
+    async def publish_load(self) -> None:
+        if self._publisher is None:
+            return
+        metrics = self.load_metrics()
+        await self._publisher.publish(LOAD_TOPIC, metrics.to_wire())
+
+    def load_metrics(self) -> LoadMetrics:
+        return LoadMetrics(
+            worker_id=self.worker_id,
+            dp_rank=self.config.dp_rank,
+            active_blocks=self.kv.used,
+            total_blocks=self.kv.capacity,
+            active_requests=len(self._running),
+            waiting_requests=len(self._waiting),
+            kv_usage=self.kv.usage(),
+        )
+
+    # -- public handler ----------------------------------------------------
+
+    async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        request = PreprocessedRequest.from_wire(body)
+        queue: asyncio.Queue = asyncio.Queue()
+        block_hashes = compute_block_hashes(request.token_ids,
+                                            self.config.block_size)
+        seq = _Sequence(request=request, queue=queue, block_hashes=block_hashes,
+                        cached_blocks=0, new_blocks=0)
+        self._ensure_stepper()
+        self._waiting.append(seq)
+        self._wake.set()
+        try:
+            while True:
+                item = await queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            seq.cancelled = True
+
+    def _ensure_stepper(self) -> None:
+        if self._step_task is None or self._step_task.done():
+            self._step_task = asyncio.create_task(self._step_loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._step_task is not None:
+            self._step_task.cancel()
+            try:
+                await self._step_task
+            except asyncio.CancelledError:
+                pass
+
+    # -- scheduler ---------------------------------------------------------
+
+    async def _step_loop(self) -> None:
+        """One iteration = admit + (chunked) prefill progress + one decode
+        token per running sequence, then sleep the modeled step time."""
+        while not self._closed:
+            if not self._running and not self._waiting:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            step_start = time.monotonic()
+            evicted_total: list[int] = []
+            self._admit(evicted_total.extend)
+            prefill_tokens = self._prefill_step()
+            decoded = await self._decode_step()
+            if evicted_total:
+                await self._publish_removed(evicted_total)
+            await self._flush_stored()
+            self.steps += 1
+            elapsed = time.monotonic() - step_start
+            target = self._step_time(prefill_tokens, decoded)
+            delay = max(0.0, target - elapsed)
+            if delay:
+                await asyncio.sleep(delay)
+            elif not prefill_tokens and not decoded:
+                # Nothing progressed (all waiting on blocks): back off instead
+                # of busy-spinning the loop.
+                await asyncio.sleep(0.005)
+            else:
+                await asyncio.sleep(0)
+
+    def _step_time(self, prefill_tokens: int, decoded: int) -> float:
+        cfg = self.config
+        t = 0.0
+        if prefill_tokens:
+            t += prefill_tokens * cfg.prefill_us_per_token / 1e6
+        if decoded:
+            t += (cfg.decode_base_ms / 1e3) + decoded * cfg.decode_us_per_seq / 1e6
+        return t / max(1e-6, cfg.speedup_ratio)
+
+    def _admit(self, evict_cb) -> None:
+        cfg = self.config
+        while self._waiting and len(self._running) < cfg.max_batch:
+            seq = self._waiting[0]
+            if seq.cancelled:
+                self._waiting.pop(0)
+                continue
+            cached = self.kv.match_prefix(seq.block_hashes)
+            total_blocks = (
+                len(seq.request.token_ids) + seq.request.sampling.max_tokens
+            ) // cfg.block_size + 1
+            if total_blocks > self.kv.capacity:
+                # Can never fit, even with an empty pool: reject instead of
+                # wedging the queue (ref: engines reject over-capacity
+                # requests rather than deadlock the scheduler).
+                self._waiting.pop(0)
+                seq.queue.put_nowait(EngineOutput(
+                    finish_reason="error",
+                    error=(f"request needs {total_blocks} KV blocks, pool has "
+                           f"{self.kv.capacity}"),
+                ).to_wire())
+                seq.queue.put_nowait(None)
+                continue
+            need = max(0, total_blocks - cached)
+            reserve = int(self.kv.capacity * cfg.watermark)
+            if self.kv.free_blocks() - need < reserve and self._running:
+                break  # wait for blocks to free up
+            if not self.kv.allocate(need, evict_cb):
+                break
+            seq.cached_blocks = cached
+            seq.new_blocks = need
+            seq.prefilled_tokens = cached * cfg.block_size
+            pinned = seq.block_hashes[:cached]
+            self.kv.pin(pinned)
+            seq.pinned = pinned
+            self._waiting.pop(0)
+            self._running.append(seq)
+
+    def _prefill_step(self) -> int:
+        """Advance prefills within the chunked budget; returns tokens prefilled."""
+        budget = self.config.max_prefill_tokens_per_step
+        total = 0
+        for seq in self._running:
+            if seq.done or seq.cancelled:
+                continue
+            remaining = len(seq.request.token_ids) - seq.prefilled_tokens
+            if remaining <= 0:
+                continue
+            chunk = min(remaining, budget - total)
+            if chunk <= 0:
+                break
+            seq.prefilled_tokens += chunk
+            total += chunk
+        return total
+
+    async def _decode_step(self) -> int:
+        """Generate one token for each fully-prefilled sequence."""
+        decoded = 0
+        finished: list[_Sequence] = []
+        for seq in self._running:
+            if seq.cancelled:
+                finished.append(seq)
+                continue
+            if seq.prefilled_tokens < len(seq.request.token_ids):
+                continue
+            req = seq.request
+            # Deterministic pseudo-output: cycle through printable ASCII.
+            token = 97 + ((len(req.token_ids) + seq.generated) % 26)
+            seq.generated += 1
+            decoded += 1
+            finish = None
+            if seq.generated >= req.sampling.max_tokens:
+                finish = "length"
+            output = EngineOutput(
+                token_ids=[token],
+                finish_reason=finish,
+                prompt_tokens=len(req.token_ids) if seq.generated == 1 else None,
+            )
+            seq.queue.put_nowait(output.to_wire())
+            if finish is not None:
+                seq.done = True
+                seq.queue.put_nowait(None)
+                finished.append(seq)
+        for seq in finished:
+            self._running.remove(seq)
+            self._release(seq)
+        return decoded
+
+    def _release(self, seq: _Sequence) -> None:
+        """On completion: completed full blocks become reusable cache entries;
+        the rest free (and generated-token blocks beyond the prompt free)."""
+        cfg = self.config
+        self.kv.unpin(seq.pinned)
+        full_prompt_blocks = len(seq.block_hashes)
+        new_cached = seq.block_hashes[seq.cached_blocks:full_prompt_blocks]
+        newly = self.kv.insert_cached(
+            new_cached, from_used=min(len(new_cached), seq.new_blocks)
+        )
+        leftover = seq.new_blocks - min(len(new_cached), seq.new_blocks)
+        self.kv.release(leftover)
+        if newly:
+            parent = (
+                seq.block_hashes[seq.cached_blocks - 1]
+                if seq.cached_blocks > 0 else None
+            )
+            self._pending_stored.append((newly, parent))
+
+    async def _flush_stored(self) -> None:
+        pending, self._pending_stored = self._pending_stored, []
+        for hashes, parent in pending:
+            await self._publish_stored(hashes, parent)

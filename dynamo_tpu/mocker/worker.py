@@ -1,0 +1,122 @@
+"""Mocker worker: registers a simulated engine into the runtime.
+
+Equivalent of `python -m dynamo.mocker` (ref: components/src/dynamo/mocker/
+main.py wrapping lib/mocker create_engine): create runtime -> serve
+`generate` -> publish ModelDeploymentCard -> stream KV events + load metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..runtime import DistributedRuntime, RuntimeConfig, new_instance_id
+from ..runtime.logging import get_logger
+from ..runtime.signals import wait_for_shutdown_signal
+from .engine import MockerConfig, MockerEngine
+
+log = get_logger("mocker.worker")
+
+
+class MockerWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        model_name: str = "mock-model",
+        namespace: str = "dynamo",
+        component: str = "mocker",
+        config: Optional[MockerConfig] = None,
+        load_publish_interval: float = 1.0,
+    ) -> None:
+        self.runtime = runtime
+        self.instance_id = new_instance_id()
+        self.config = config or MockerConfig()
+        self.card = ModelDeploymentCard(
+            name=model_name,
+            namespace=namespace,
+            component=component,
+            endpoint="generate",
+            kv_block_size=self.config.block_size,
+            total_kv_blocks=self.config.num_blocks,
+            tokenizer={"kind": "byte"},
+        )
+        self.engine: Optional[MockerEngine] = None
+        self._load_task: Optional[asyncio.Task] = None
+        self._load_interval = load_publish_interval
+        self._served = None
+
+    async def start(self) -> None:
+        publisher = self.runtime.event_publisher(self.card.namespace)
+        self.engine = MockerEngine(self.config, worker_id=self.instance_id,
+                                   event_publisher=publisher)
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("generate")
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.engine.generate, instance_id=self.instance_id
+        )
+        await publish_card(self.runtime, self.card, self.instance_id)
+        self._load_task = asyncio.create_task(self._load_loop())
+        log.info("mocker worker up: model=%s instance=%x blocks=%d",
+                 self.card.name, self.instance_id, self.config.num_blocks)
+
+    async def _load_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._load_interval)
+            try:
+                await self.engine.publish_load()
+            except Exception:  # noqa: BLE001
+                log.exception("load publish failed")
+
+    async def close(self) -> None:
+        if self._load_task is not None:
+            self._load_task.cancel()
+            try:
+                await self._load_task
+            except asyncio.CancelledError:
+                pass
+        if self.engine is not None:
+            await self.engine.close()
+        if self._served is not None:
+            await self._served.shutdown()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.mocker")
+    parser.add_argument("--model-name", default="mock-model")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="mocker")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-blocks", type=int, default=1024)
+    parser.add_argument("--max-batch", type=int, default=32)
+    parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    args = parser.parse_args(argv)
+
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    worker = MockerWorker(
+        runtime,
+        model_name=args.model_name,
+        namespace=args.namespace,
+        component=args.component,
+        config=MockerConfig(
+            block_size=args.block_size,
+            num_blocks=args.num_blocks,
+            max_batch=args.max_batch,
+            speedup_ratio=args.speedup_ratio,
+        ),
+    )
+    await worker.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await worker.close()
+        await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -199,6 +199,10 @@ class MemDiscovery(Discovery):
 
     async def put(self, key: str, value: dict, lease: Optional[Lease] = None) -> None:
         self._store.data[key] = value
+        # Re-putting a key rebinds (or clears) its lease, matching etcd.
+        old_lease = self._store.key_lease.pop(key, None)
+        if old_lease is not None:
+            self._store.lease_keys.get(old_lease, set()).discard(key)
         if lease is not None:
             if lease.lease_id not in self._store.lease_deadline:
                 raise LeaseExpired(lease.lease_id)
@@ -295,11 +299,41 @@ class FileDiscovery(Discovery):
         while True:
             await asyncio.sleep(self._poll)
             try:
-                self._reap_once()
-                self._poll_watches()
+                # Directory scans + per-file reads go to a worker thread: on
+                # NFS/GCS-fuse each stat is a network round-trip and must not
+                # stall the event loop serving requests in this process.
+                scans = await asyncio.to_thread(self._reap_and_scan)
+                self._dispatch_watch_diffs(scans)
             except OSError as exc:  # transient fs races are fine
                 if exc.errno not in (errno.ENOENT,):
                     log.warning("file discovery reap error: %s", exc)
+
+    def _reap_and_scan(self) -> list[tuple[Watch, dict[str, dict]]]:
+        """Thread-side: reap stale leases, then scan each live watch's prefix."""
+        self._reap_once()
+        out: list[tuple[Watch, dict[str, dict]]] = []
+        for prefix, watch in list(self._watches):
+            if not watch._cancelled:
+                out.append((watch, self._scan(prefix)))
+        return out
+
+    def _dispatch_watch_diffs(
+        self, scans: list[tuple[Watch, dict[str, dict]]]
+    ) -> None:
+        """Loop-side: diff snapshots against each watch and emit events."""
+        self._watches = [(p, w) for p, w in self._watches if not w._cancelled]
+        live = {w for _p, w in self._watches}
+        for watch, current in scans:
+            if watch not in live:
+                continue
+            snapshot = getattr(watch, "_snapshot", {})
+            for key, value in current.items():
+                if key not in snapshot or snapshot[key] != value:
+                    watch._emit(KvEvent("put", key, value))
+            for key in snapshot:
+                if key not in current:
+                    watch._emit(KvEvent("delete", key))
+            watch._snapshot = current
 
     def _reap_once(self) -> None:
         kv_dir = os.path.join(self._root, "kv")
@@ -351,21 +385,6 @@ class FileDiscovery(Discovery):
                 continue
             out[key] = entry["value"]
         return out
-
-    def _poll_watches(self) -> None:
-        for prefix, watch in list(self._watches):
-            if watch._cancelled:
-                self._watches.remove((prefix, watch))
-                continue
-            current = self._scan(prefix)
-            snapshot = getattr(watch, "_snapshot", {})
-            for key, value in current.items():
-                if key not in snapshot or snapshot[key] != value:
-                    watch._emit(KvEvent("put", key, value))
-            for key in snapshot:
-                if key not in current:
-                    watch._emit(KvEvent("delete", key))
-            watch._snapshot = current
 
     async def create_lease(self, ttl: float) -> Lease:
         lease = Lease(lease_id=uuid.uuid4().hex, ttl=ttl)
@@ -421,8 +440,11 @@ class FileDiscovery(Discovery):
             pass
 
     async def get_prefix(self, prefix: str) -> dict[str, dict]:
-        self._reap_once()
-        return self._scan(prefix)
+        def _scan_sync() -> dict[str, dict]:
+            self._reap_once()
+            return self._scan(prefix)
+
+        return await asyncio.to_thread(_scan_sync)
 
     async def watch_prefix(self, prefix: str, include_existing: bool = True) -> Watch:
         watch = Watch()

@@ -1,0 +1,32 @@
+"""Signal-driven graceful shutdown for service entrypoints.
+
+SIGTERM/SIGINT -> resolve an event so mains fall through to their cleanup
+path (deregister instances, drain in-flight requests, revoke lease) instead
+of dying mid-request and leaning on lease expiry (ref: components/src/dynamo/
+common/utils/graceful_shutdown.py signal chaining).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from .logging import get_logger
+
+log = get_logger("signals")
+
+
+async def wait_for_shutdown_signal() -> None:
+    loop = asyncio.get_running_loop()
+    event = asyncio.Event()
+
+    def _handler(signame: str) -> None:
+        log.info("received %s — shutting down gracefully", signame)
+        event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _handler, sig.name)
+        except (NotImplementedError, RuntimeError):  # non-main thread / win
+            pass
+    await event.wait()

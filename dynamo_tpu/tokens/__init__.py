@@ -1,0 +1,91 @@
+"""Token-sequence -> block-hash identity.
+
+KV-cache reuse across the whole system keys on *chained* hashes of fixed-size
+token blocks (ref: lib/tokens/src/lib.rs — `compute_hash_v2` at :43, chain
+seeding at :650): block i's hash seeds block i+1's hash, so a block hash
+uniquely identifies the full token prefix up to and including that block
+("sequence hash"). Routers, engines, and the KV block manager all speak this
+identity, which is what makes cross-worker prefix matching sound.
+
+We use xxh3_64 with the previous sequence hash as the seed, over the
+little-endian u32 token bytes of each full block. Partial trailing blocks are
+never hashed (they can't be reused).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import xxhash
+
+# Seed for the first block in a sequence (arbitrary non-zero constant; the
+# reference uses a fixed seed too — parity requires self-consistency only).
+INITIAL_SEED = 0xD3A10_C0DE
+
+
+def hash_block(tokens: Sequence[int], seed: int) -> int:
+    """Hash one full block of token ids with a chaining seed."""
+    buf = b"".join(int(t).to_bytes(4, "little", signed=False) for t in tokens)
+    return xxhash.xxh3_64_intdigest(buf, seed=seed & 0xFFFFFFFFFFFFFFFF)
+
+
+def compute_block_hashes(
+    tokens: Sequence[int],
+    block_size: int,
+    *,
+    lora_id: Optional[int] = None,
+) -> list[int]:
+    """Chained hashes for every *full* block of `tokens`.
+
+    `lora_id` perturbs the initial seed so the same prompt under different
+    adapters never shares KV identity (the reference mixes LoRA into the
+    hash for the same reason).
+    """
+    assert block_size > 0
+    seed = INITIAL_SEED if lora_id is None else INITIAL_SEED ^ (lora_id * 0x9E3779B97F4A7C15)
+    out: list[int] = []
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        seed = hash_block(tokens[start : start + block_size], seed)
+        out.append(seed)
+    return out
+
+
+def num_full_blocks(n_tokens: int, block_size: int) -> int:
+    return n_tokens // block_size
+
+
+class TokenBlockSequence:
+    """Incremental block hasher for a growing token sequence (engine side:
+    as decode appends tokens, newly completed blocks get hashes without
+    re-hashing the prefix)."""
+
+    def __init__(self, block_size: int, lora_id: Optional[int] = None) -> None:
+        self.block_size = block_size
+        self._tokens: list[int] = []
+        self._hashes: list[int] = []
+        self._seed = (
+            INITIAL_SEED
+            if lora_id is None
+            else INITIAL_SEED ^ (lora_id * 0x9E3779B97F4A7C15)
+        )
+
+    def extend(self, tokens: Iterable[int]) -> list[int]:
+        """Append tokens; returns hashes of any newly completed blocks."""
+        self._tokens.extend(int(t) for t in tokens)
+        new_hashes: list[int] = []
+        while len(self._tokens) - len(self._hashes) * self.block_size >= self.block_size:
+            start = len(self._hashes) * self.block_size
+            self._seed = hash_block(
+                self._tokens[start : start + self.block_size], self._seed
+            )
+            self._hashes.append(self._seed)
+            new_hashes.append(self._seed)
+        return new_hashes
+
+    @property
+    def tokens(self) -> list[int]:
+        return self._tokens
+
+    @property
+    def block_hashes(self) -> list[int]:
+        return list(self._hashes)

@@ -1,0 +1,200 @@
+"""Frontend E2E: OpenAI HTTP <-> discovery <-> mocker workers, in-process
+(ref contract: section 3.1 startup + request flow; router E2E pattern from
+tests/router/test_router_e2e_with_mockers.py)."""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+async def _setup(cluster, n_workers=1, router_mode="round_robin",
+                 model="mock-model"):
+    workers = []
+    for _ in range(n_workers):
+        rt = await DistributedRuntime(_cfg(cluster)).start()
+        worker = MockerWorker(
+            rt, model_name=model,
+            config=MockerConfig(speedup_ratio=500.0, num_blocks=256),
+            load_publish_interval=0.2,
+        )
+        await worker.start()
+        workers.append((rt, worker))
+    frt = await DistributedRuntime(_cfg(cluster)).start()
+    frontend = Frontend(frt, host="127.0.0.1", port=0, router_mode=router_mode)
+    await frontend.start()
+    # Wait for model registration.
+    for _ in range(100):
+        if frontend.manager.get(model) is not None:
+            break
+        await asyncio.sleep(0.05)
+    return frontend, frt, workers
+
+
+async def _teardown(frontend, frt, workers):
+    await frontend.close()
+    await frt.shutdown()
+    for rt, worker in workers:
+        await worker.close()
+        await rt.shutdown()
+
+
+class TestFrontendE2E:
+    def test_models_and_nonstreaming_chat(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/v1/models") as resp:
+                    models = await resp.json()
+                    assert models["data"][0]["id"] == "mock-model"
+                payload = {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 8,
+                }
+                async with session.post(f"{base}/v1/chat/completions",
+                                        json=payload) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["object"] == "chat.completion"
+                    assert data["usage"]["completion_tokens"] == 8
+                    assert len(data["choices"][0]["message"]["content"]) > 0
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_streaming_sse(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            payload = {
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }
+            chunks = []
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/chat/completions",
+                                        json=payload) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"].startswith("text/event-stream")
+                    async for line in resp.content:
+                        line = line.decode().strip()
+                        if line.startswith("data: "):
+                            chunks.append(line[len("data: "):])
+            assert chunks[-1] == "[DONE]"
+            parsed = [json.loads(c) for c in chunks[:-1]]
+            finishes = [p["choices"][0]["finish_reason"]
+                        for p in parsed if p.get("choices")]
+            assert "length" in finishes
+            usage = [p for p in parsed if p.get("usage")]
+            assert usage and usage[-1]["usage"]["completion_tokens"] == 6
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_completions_endpoint(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": "abc",
+                          "max_tokens": 4},
+                ) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["object"] == "text_completion"
+                    assert len(data["choices"][0]["text"]) > 0
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_unknown_model_404_and_bad_request_400(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "nope", "messages": [
+                        {"role": "user", "content": "x"}]},
+                ) as resp:
+                    assert resp.status == 404
+                async with session.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "mock-model"},
+                ) as resp:
+                    assert resp.status == 400
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_kv_router_mode_e2e(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(
+                uuid.uuid4().hex, n_workers=2, router_mode="kv")
+            model = frontend.manager.get("mock-model")
+            assert model is not None and model.scheduler is not None
+            base = f"http://127.0.0.1:{frontend.port}"
+            prompt = "shared prefix " * 40  # several blocks
+            async with aiohttp.ClientSession() as session:
+                for i in range(4):
+                    async with session.post(
+                        f"{base}/v1/completions",
+                        json={"model": "mock-model", "prompt": prompt,
+                              "max_tokens": 4},
+                    ) as resp:
+                        assert resp.status == 200
+                        await resp.json()
+                    await asyncio.sleep(0.1)
+            # KV events flowed: the router's index knows some blocks.
+            assert model.scheduler.indexer.total_nodes() > 0
+            # All requests after the first should hit the same worker
+            # (cached prefix dominates the cost model).
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_worker_death_model_unlisted(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            frontend, frt, workers = await _setup(cluster)
+            base = f"http://127.0.0.1:{frontend.port}"
+            rt, worker = workers[0]
+            await worker.close()
+            await rt.shutdown()
+            for _ in range(100):
+                if frontend.manager.get("mock-model") is None:
+                    break
+                await asyncio.sleep(0.05)
+            assert frontend.manager.get("mock-model") is None
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/v1/models") as resp:
+                    assert (await resp.json())["data"] == []
+            await frontend.close()
+            await frt.shutdown()
+
+        run(body(), timeout=90)

@@ -1,0 +1,200 @@
+"""KV router tests: radix indexer, selection cost model, active sequences
+(ref contract: lib/kv-router indexer/tests.rs + selector.rs semantics)."""
+
+import pytest
+
+from dynamo_tpu.kv_router import (
+    KvCacheRemoved,
+    KvCacheStored,
+    KvRouterConfig,
+    KvScheduler,
+    LoadMetrics,
+    RadixTree,
+    RouterEvent,
+    WorkerWithDpRank,
+    softmax_sample,
+)
+
+W0 = WorkerWithDpRank(100)
+W1 = WorkerWithDpRank(200)
+
+
+def stored(worker, event_id, hashes, parent=None, dp_rank=0):
+    return RouterEvent(
+        worker_id=worker.worker_id,
+        event_id=event_id,
+        dp_rank=dp_rank,
+        stored=KvCacheStored(block_hashes=list(hashes), parent_hash=parent),
+    )
+
+
+def removed(worker, event_id, hashes):
+    return RouterEvent(
+        worker_id=worker.worker_id,
+        event_id=event_id,
+        removed=KvCacheRemoved(block_hashes=list(hashes)),
+    )
+
+
+class TestRadixTree:
+    def test_single_worker_match(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2, 3]))
+        scores = tree.find_matches([1, 2, 3, 4])
+        assert scores.scores == {W0: 3}
+
+    def test_contiguity_required(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2, 3]))
+        # Query starting mid-sequence matches nothing from root.
+        assert tree.find_matches([2, 3]).scores == {}
+
+    def test_two_workers_partial_overlap(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2, 3]))
+        tree.apply_event(stored(W1, 0, [1, 2]))
+        scores = tree.find_matches([1, 2, 3]).scores
+        assert scores == {W0: 3, W1: 2}
+
+    def test_removal_prunes(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2, 3]))
+        tree.apply_event(removed(W0, 1, [3]))
+        assert tree.find_matches([1, 2, 3]).scores == {W0: 2}
+        assert tree.total_nodes() == 2
+
+    def test_remove_worker(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2]))
+        tree.apply_event(stored(W1, 0, [1]))
+        tree.remove_worker(W0)
+        assert tree.find_matches([1, 2]).scores == {W1: 1}
+        assert tree.total_nodes() == 1  # node 2 pruned, node 1 kept for W1
+
+    def test_cleared_event(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2]))
+        tree.apply_event(RouterEvent(worker_id=W0.worker_id, event_id=1, cleared=True))
+        assert tree.find_matches([1, 2]).scores == {}
+
+    def test_parent_hash_extension(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2]))
+        tree.apply_event(stored(W0, 1, [3, 4], parent=2))
+        assert tree.find_matches([1, 2, 3, 4]).scores == {W0: 4}
+
+    def test_gap_detection(self):
+        tree = RadixTree()
+        assert tree.apply_event(stored(W0, 0, [1])) == "ok"
+        assert tree.apply_event(stored(W0, 1, [2], parent=1)) == "ok"
+        assert tree.apply_event(stored(W0, 5, [3], parent=2)) == "gap"
+        assert tree.gap_count == 1
+
+    def test_dp_ranks_are_distinct_workers(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2], dp_rank=0))
+        tree.apply_event(stored(W0, 0, [1], dp_rank=1))
+        scores = tree.find_matches([1, 2]).scores
+        assert scores == {
+            WorkerWithDpRank(W0.worker_id, 0): 2,
+            WorkerWithDpRank(W0.worker_id, 1): 1,
+        }
+
+    def test_dump_and_load_roundtrip(self):
+        tree = RadixTree()
+        tree.apply_event(stored(W0, 0, [1, 2, 3]))
+        tree.apply_event(stored(W0, 1, [10], parent=2))
+        dump = tree.dump_worker(W0)
+        tree2 = RadixTree()
+        tree2.load_worker(W0, dump, last_event_id=1)
+        assert tree2.find_matches([1, 2, 3]).scores == {W0: 3}
+        assert tree2.find_matches([1, 2, 10]).scores == {W0: 3}
+        # event continuity preserved
+        assert tree2.apply_event(stored(W0, 2, [4], parent=3)) == "ok"
+
+    def test_wire_roundtrip(self):
+        event = stored(W0, 3, [7, 8], parent=6)
+        assert RouterEvent.from_wire(event.to_wire()) == event
+
+
+class TestSoftmaxSample:
+    def test_zero_temp_argmin(self):
+        logits = {W0: 5.0, W1: 2.0}
+        worker, logit = softmax_sample(logits, 0.0)
+        assert (worker, logit) == (W1, 2.0)
+
+    def test_zero_temp_tie_break_by_tree_size(self):
+        logits = {W0: 2.0, W1: 2.0}
+        worker, _ = softmax_sample(logits, 0.0, tie_breaker={W0: 10, W1: 3})
+        assert worker == W1
+
+    def test_positive_temp_prefers_lower(self):
+        logits = {W0: 100.0, W1: 1.0}
+        picks = [softmax_sample(logits, 0.5)[0] for _ in range(200)]
+        assert picks.count(W1) > picks.count(W0)
+
+    def test_deterministic_with_sample(self):
+        logits = {W0: 1.0, W1: 2.0}
+        worker, _ = softmax_sample(logits, 1.0, sample=0.999999)
+        assert worker in (W0, W1)
+
+
+class TestKvScheduler:
+    def _scheduler(self, **kwargs):
+        return KvScheduler(KvRouterConfig(block_size=16, **kwargs))
+
+    def test_prefers_cached_worker(self):
+        sched = self._scheduler()
+        sched.indexer.apply_event(stored(W0, 0, [1, 2, 3]))
+        result = sched.select_worker([W0, W1], [1, 2, 3], isl_tokens=48)
+        assert result.worker == W0
+        assert result.overlap_blocks == 3
+
+    def test_load_balances_without_cache(self):
+        sched = self._scheduler()
+        # Pile predicted load onto W0.
+        for i in range(5):
+            res = sched.select_worker([W0], [], isl_tokens=160)
+            sched.add_request(f"r{i}", res, 160)
+        result = sched.select_worker([W0, W1], [], isl_tokens=16)
+        assert result.worker == W1
+
+    def test_cache_beats_small_load_delta(self):
+        sched = self._scheduler(overlap_weight=1.0)
+        sched.indexer.apply_event(stored(W0, 0, [1, 2, 3, 4]))
+        res = sched.select_worker([W0], [], isl_tokens=16)
+        sched.add_request("busy", res, 16)
+        # W0 has 1 active block of load but 4 cached blocks for this request:
+        # logit(W0) = (80-64)/16 + 1 = 2 ; logit(W1) = 80/16 + 5 = 10
+        result = sched.select_worker([W0, W1], [1, 2, 3, 4], isl_tokens=80)
+        assert result.worker == W0
+
+    def test_lifecycle_frees_load(self):
+        sched = self._scheduler()
+        res = sched.select_worker([W0], [], isl_tokens=320)
+        sched.add_request("r", res, 320)
+        assert sched.sequences.decode_blocks(W0) == 20
+        sched.mark_prefill_completed("r")
+        assert sched.sequences.prefill_tokens(W0) == 0
+        sched.free("r")
+        assert sched.sequences.decode_blocks(W0) == 0
+
+    def test_published_metrics_reconcile(self):
+        sched = self._scheduler()
+        sched.sequences.update_published(
+            LoadMetrics(worker_id=W0.worker_id, active_blocks=50, total_blocks=100,
+                        kv_usage=0.5)
+        )
+        assert sched.sequences.decode_blocks(W0) == 50
+        assert sched.sequences.kv_usage(W0) == 0.5
+
+    def test_remove_worker_id(self):
+        sched = self._scheduler()
+        sched.indexer.apply_event(stored(W0, 0, [1, 2]))
+        sched.remove_worker_id(W0.worker_id)
+        assert sched.indexer.find_matches([1, 2]).scores == {}
+
+    def test_no_candidates_raises(self):
+        sched = self._scheduler()
+        with pytest.raises(ValueError):
+            sched.select_worker([], [], isl_tokens=16)

@@ -1,0 +1,168 @@
+"""Preprocessor + detokenizer + delta generation tests (ref contract:
+lib/llm/src/preprocessor.rs lowering, backend.rs incremental detok,
+chat_completions stop-string jail)."""
+
+import pytest
+
+from dynamo_tpu.llm import (
+    ByteTokenizer,
+    DeltaGenerator,
+    EngineOutput,
+    IncrementalDetokenizer,
+    ModelDeploymentCard,
+    OpenAIPreprocessor,
+    RequestError,
+)
+
+
+def _card(**kwargs):
+    return ModelDeploymentCard(name="test-model", context_length=1024, **kwargs)
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        text = "hello, wörld! 你好"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_specials(self):
+        tok = ByteTokenizer()
+        assert tok.decode([104, 105, ByteTokenizer.EOS]) == "hi</s>"
+
+
+class TestIncrementalDetokenizer:
+    def test_streams_stable_text(self):
+        tok = ByteTokenizer()
+        detok = IncrementalDetokenizer(tok, window=2)
+        text = "streaming works"
+        ids = tok.encode(text)
+        out = ""
+        for i in ids:
+            out += detok.push([i])
+        out += detok.flush()
+        assert out == text
+
+    def test_multibyte_unicode_never_split(self):
+        tok = ByteTokenizer()
+        detok = IncrementalDetokenizer(tok, window=1)
+        ids = tok.encode("日本語テスト")
+        chunks = [detok.push([i]) for i in ids]
+        chunks.append(detok.flush())
+        assert "".join(chunks) == "日本語テスト"
+        for chunk in chunks:
+            assert "�" not in chunk
+
+
+class TestPreprocessor:
+    def test_chat_template_applied(self):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_chat({
+            "model": "test-model",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 10,
+        })
+        text = pre.tokenizer.decode(req.token_ids)
+        assert "<|im_start|>user\nhi<|im_end|>" in text
+        assert text.endswith("<|im_start|>assistant\n")
+        assert req.sampling.max_tokens == 10
+
+    def test_missing_messages_rejected(self):
+        pre = OpenAIPreprocessor(_card())
+        with pytest.raises(RequestError):
+            pre.preprocess_chat({"model": "m"})
+
+    def test_context_overflow_rejected(self):
+        pre = OpenAIPreprocessor(_card())
+        with pytest.raises(RequestError):
+            pre.preprocess_completions({"prompt": "x" * 5000})
+
+    def test_max_tokens_clamped_to_context(self):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_completions({"prompt": "hello", "max_tokens": 999999})
+        assert len(req.token_ids) + req.sampling.max_tokens <= 1024
+
+    def test_token_prompt(self):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_completions({"prompt": [72, 105], "max_tokens": 4})
+        assert req.token_ids == [72, 105]
+
+    def test_stop_strings_collected(self):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_completions(
+            {"prompt": "x", "stop": ["END", "##"], "max_tokens": 5})
+        assert req.stop.stop_strings == ["END", "##"]
+
+    def test_multimodal_text_parts_joined(self):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_chat({
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "a"}, {"type": "text", "text": "b"},
+            ]}],
+            "max_tokens": 4,
+        })
+        assert "ab" in pre.tokenizer.decode(req.token_ids)
+
+
+class TestDeltaGenerator:
+    def _gen(self, stop=None):
+        pre = OpenAIPreprocessor(_card())
+        req = pre.preprocess_chat({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 32, "stop": stop,
+        })
+        return DeltaGenerator(pre, req, kind="chat"), pre
+
+    def test_streaming_chunks(self):
+        gen, pre = self._gen()
+        ids = pre.tokenizer.encode("hello world")
+        chunks = []
+        for i, tid in enumerate(ids):
+            final = i == len(ids) - 1
+            out = EngineOutput(token_ids=[tid],
+                               finish_reason="stop" if final else None)
+            chunks.extend(gen.on_output(out))
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert text == "hello world"
+        assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert gen.usage()["completion_tokens"] == len(ids)
+
+    def test_stop_string_truncates(self):
+        gen, pre = self._gen(stop=["END"])
+        ids = pre.tokenizer.encode("abcENDxyz")
+        chunks = []
+        for tid in ids:
+            chunks.extend(gen.on_output(EngineOutput(token_ids=[tid])))
+        # flush any jailed text via a final
+        chunks.extend(gen.on_output(EngineOutput(token_ids=[],
+                                                 finish_reason="length")))
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert text == "abc"
+        assert gen.finish_reason == "stop"
+
+    def test_stop_prefix_jailed_not_leaked(self):
+        gen, pre = self._gen(stop=["ENDSTOP"])
+        # Send 'EN' then nothing else: the possible stop prefix is held until
+        # the stream finishes, then released since no stop occurred.
+        ids = pre.tokenizer.encode("xEN")
+        chunks = []
+        for tid in ids:
+            chunks.extend(gen.on_output(EngineOutput(token_ids=[tid])))
+        mid_text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert mid_text == "x"
+        chunks = gen.on_output(EngineOutput(token_ids=[], finish_reason="stop"))
+        tail = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert tail == "EN"
+
+    def test_final_response_aggregates(self):
+        gen, pre = self._gen()
+        for tid in pre.tokenizer.encode("done"):
+            gen.on_output(EngineOutput(token_ids=[tid]))
+        gen.on_output(EngineOutput(token_ids=[], finish_reason="stop"))
+        resp = gen.final_response()
+        assert resp["choices"][0]["message"]["content"] == "done"
+        assert resp["object"] == "chat.completion"

@@ -1,0 +1,141 @@
+"""Mocker engine tests: continuous batching, prefix cache, KV events
+(ref contract: lib/mocker scheduler + kv_manager behavior)."""
+
+import asyncio
+
+from dynamo_tpu.kv_router.protocols import KV_EVENT_TOPIC, RouterEvent
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+
+
+def _request(tokens, max_tokens=8, rid="r1"):
+    return PreprocessedRequest(
+        request_id=rid,
+        token_ids=list(tokens),
+        sampling=SamplingOptions(max_tokens=max_tokens),
+        stop=StopConditions(),
+    ).to_wire()
+
+
+class _CapturePublisher:
+    def __init__(self):
+        self.events = []
+
+    async def publish(self, topic, payload):
+        self.events.append((topic, payload))
+
+
+def _fast_config(**kwargs):
+    defaults = dict(speedup_ratio=1000.0, block_size=16, num_blocks=64,
+                    max_batch=8)
+    defaults.update(kwargs)
+    return MockerConfig(**defaults)
+
+
+class TestMockerEngine:
+    def test_generates_exactly_max_tokens(self, run):
+        async def body():
+            engine = MockerEngine(_fast_config())
+            outs = [EngineOutput.from_wire(o)
+                    async for o in engine.generate(_request(range(40), 5))]
+            tokens = [t for o in outs for t in o.token_ids]
+            assert len(tokens) == 5
+            assert outs[-1].finish_reason == "length"
+            assert outs[0].prompt_tokens == 40
+            await engine.close()
+
+        run(body())
+
+    def test_concurrent_requests_batched(self, run):
+        async def body():
+            engine = MockerEngine(_fast_config())
+
+            async def one(rid):
+                outs = [o async for o in engine.generate(
+                    _request(range(32), 6, rid=rid))]
+                return sum(len(o["t"]) for o in outs)
+
+            counts = await asyncio.gather(*[one(f"r{i}") for i in range(6)])
+            assert counts == [6] * 6
+            # Batched: far fewer steps than 6 sequential requests would take.
+            assert engine.steps < 6 * 10
+            await engine.close()
+
+        run(body())
+
+    def test_kv_events_published_and_prefix_reused(self, run):
+        async def body():
+            pub = _CapturePublisher()
+            engine = MockerEngine(_fast_config(), worker_id=42,
+                                  event_publisher=pub)
+            prompt = list(range(48))  # 3 full blocks
+            async for _ in engine.generate(_request(prompt, 4, "a")):
+                pass
+            stored = [RouterEvent.from_wire(p) for t, p in pub.events
+                      if t == KV_EVENT_TOPIC]
+            assert stored and stored[0].stored is not None
+            assert len(stored[0].stored.block_hashes) == 3
+            assert stored[0].worker_id == 42
+
+            # Second request with same prefix: cache hit -> fewer new blocks.
+            usage_before = engine.kv.usage()
+            async for _ in engine.generate(_request(prompt, 4, "b")):
+                pass
+            # No duplicate stored events for the same blocks.
+            stored2 = [RouterEvent.from_wire(p) for t, p in pub.events
+                       if t == KV_EVENT_TOPIC]
+            all_hashes = [h for e in stored2 if e.stored
+                          for h in e.stored.block_hashes]
+            assert len(all_hashes) == len(set(all_hashes))
+            await engine.close()
+
+        run(body())
+
+    def test_eviction_emits_removed_events(self, run):
+        async def body():
+            pub = _CapturePublisher()
+            # Tiny pool: 8 blocks; requests of 3 blocks + decode room force
+            # eviction of previous cached prefixes.
+            engine = MockerEngine(_fast_config(num_blocks=8), worker_id=1,
+                                  event_publisher=pub)
+            for i in range(4):
+                prompt = list(range(i * 100, i * 100 + 48))
+                async for _ in engine.generate(_request(prompt, 4, f"r{i}")):
+                    pass
+            removed = [RouterEvent.from_wire(p) for t, p in pub.events
+                       if t == KV_EVENT_TOPIC]
+            assert any(e.removed for e in removed)
+            await engine.close()
+
+        run(body())
+
+    def test_load_metrics(self, run):
+        async def body():
+            engine = MockerEngine(_fast_config())
+            metrics = engine.load_metrics()
+            assert metrics.total_blocks == 64
+            assert metrics.active_requests == 0
+            await engine.close()
+
+        run(body())
+
+    def test_cancellation_frees_slot(self, run):
+        async def body():
+            engine = MockerEngine(_fast_config(speedup_ratio=1.0))
+            gen = engine.generate(_request(range(16), 1000, "slow"))
+            got = await gen.__anext__()
+            await gen.aclose()
+            # Next step should drop the cancelled sequence.
+            for _ in range(100):
+                if not engine._running:
+                    break
+                await asyncio.sleep(0.02)
+            assert not engine._running
+            await engine.close()
+
+        run(body())

@@ -1,0 +1,52 @@
+"""Token block hashing tests (ref contract: lib/tokens chained hashing —
+same prefix => same hashes, any divergence => different suffix hashes)."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hashes,
+    hash_block,
+    num_full_blocks,
+)
+
+
+class TestBlockHashing:
+    def test_deterministic(self):
+        tokens = list(range(64))
+        assert compute_block_hashes(tokens, 16) == compute_block_hashes(tokens, 16)
+
+    def test_partial_block_not_hashed(self):
+        assert compute_block_hashes(list(range(15)), 16) == []
+        assert len(compute_block_hashes(list(range(17)), 16)) == 1
+        assert len(compute_block_hashes(list(range(32)), 16)) == 2
+
+    def test_chaining_shared_prefix(self):
+        a = compute_block_hashes(list(range(64)), 16)
+        b = compute_block_hashes(list(range(48)) + [999] * 16, 16)
+        assert a[:3] == b[:3]
+        assert a[3] != b[3]
+
+    def test_chaining_differs_on_prefix_change(self):
+        # Same second block content, different first block => different hash
+        # for the second block (sequence identity, not content identity).
+        a = compute_block_hashes([1] * 16 + [7] * 16, 16)
+        b = compute_block_hashes([2] * 16 + [7] * 16, 16)
+        assert a[1] != b[1]
+
+    def test_lora_id_perturbs(self):
+        tokens = list(range(32))
+        assert compute_block_hashes(tokens, 16) != compute_block_hashes(
+            tokens, 16, lora_id=7
+        )
+
+    def test_incremental_matches_batch(self):
+        tokens = list(range(100))
+        seq = TokenBlockSequence(16)
+        got = []
+        for t in tokens:
+            got.extend(seq.extend([t]))
+        assert got == compute_block_hashes(tokens, 16)
+        assert seq.block_hashes == got
+        assert num_full_blocks(100, 16) == len(got)
+
+    def test_hash_block_seed_sensitivity(self):
+        assert hash_block([1, 2, 3], 1) != hash_block([1, 2, 3], 2)
