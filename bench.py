@@ -1,0 +1,136 @@
+"""Benchmark: steady-state decode throughput of the flagship model on the
+available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+`vs_baseline` is the fraction of this chip's HBM-bandwidth roofline for the
+model (decode is memory-bound: every step streams all weights + the active
+KV). The reference publishes only relative numbers (BASELINE.md), so roofline
+fraction is the honest hardware-normalized comparison: 1.0 == perfect
+bandwidth utilization, and the reference's vLLM-on-H100 recipes sit around
+0.5-0.7 of their roofline on the same measure.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+MODEL = "qwen3-0.6b"
+BATCH = 8
+PAGE_SIZE = 16
+NUM_PAGES = 1024
+MAX_PAGES_PER_SEQ = 64
+PROMPT_LEN = 256
+DECODE_STEPS = 64
+# HBM bandwidth by chip generation (GB/s) for the roofline denominator.
+HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
+            "cpu": 50.0}
+
+
+def _param_bytes(config) -> int:
+    h, v = config.hidden, config.vocab_size
+    per_layer = (
+        h * config.n_q_heads * config.head_dim
+        + 2 * h * config.n_kv_heads * config.head_dim
+        + config.n_q_heads * config.head_dim * h
+        + 3 * h * config.mlp_hidden
+        + 2 * h
+    )
+    total = v * h + h + config.n_layers * per_layer
+    if not config.tie_embeddings:
+        total += h * v
+    return total * 2  # bf16
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.engine import ModelRunner, RunnerConfig
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    device = jax.devices()[0]
+    device_kind = getattr(device, "device_kind", "cpu").lower()
+
+    config = get_config(MODEL)
+    runner = ModelRunner(
+        config,
+        RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
+                     max_batch=BATCH, max_pages_per_seq=MAX_PAGES_PER_SEQ,
+                     prefill_buckets=(256,)),
+        make_mesh(MeshConfig()),
+        seed=0,
+    )
+
+    # Prefill BATCH sequences of PROMPT_LEN so decode runs with real KV.
+    pages_per_seq = (PROMPT_LEN + DECODE_STEPS) // PAGE_SIZE + 1
+    tables = np.zeros((BATCH, MAX_PAGES_PER_SEQ), np.int32)
+    rng = np.random.default_rng(0)
+    next_page = 1
+    for b in range(BATCH):
+        tables[b, :pages_per_seq] = np.arange(next_page,
+                                              next_page + pages_per_seq)
+        next_page += pages_per_seq
+        prompt = rng.integers(0, config.vocab_size, PROMPT_LEN).astype(np.int32)
+        runner.prefill_chunk(prompt, 0, tables[b], PROMPT_LEN,
+                             (0.0, 1.0, 0, 0))
+
+    tokens = np.zeros(BATCH, np.int32)
+    positions = np.full(BATCH, PROMPT_LEN, np.int32)
+    kv_lens = np.full(BATCH, PROMPT_LEN + 1, np.int32)
+    active = np.ones(BATCH, bool)
+    temp = np.zeros(BATCH, np.float32)
+    top_p = np.ones(BATCH, np.float32)
+    top_k = np.zeros(BATCH, np.int32)
+    seeds = np.zeros(BATCH, np.uint32)
+
+    def step():
+        nonlocal tokens, positions, kv_lens
+        out = runner.decode(tokens, positions, tables, kv_lens, active,
+                            temp, top_p, top_k, seeds)
+        tokens = out
+        positions = positions + 1
+        kv_lens = kv_lens + 1
+
+    # warmup (compile + 3 steps)
+    for _ in range(3):
+        step()
+
+    start = time.perf_counter()
+    for _ in range(DECODE_STEPS):
+        step()
+    elapsed = time.perf_counter() - start
+    tok_per_sec = BATCH * DECODE_STEPS / elapsed
+
+    # Roofline: steps/sec ceiling = HBM_bw / (weights + active KV per step)
+    hbm = 50.0
+    for key, bw in HBM_GBPS.items():
+        if key in device_kind:
+            hbm = bw
+            break
+    kv_bytes_per_step = (
+        config.n_layers * 2 * (PROMPT_LEN + DECODE_STEPS // 2) * BATCH
+        * config.n_kv_heads * config.head_dim * 2
+    )
+    bytes_per_step = _param_bytes(config) + kv_bytes_per_step
+    roofline_steps = hbm * 1e9 / bytes_per_step
+    roofline_tok = roofline_steps * BATCH
+    vs_baseline = tok_per_sec / roofline_tok
+
+    print(json.dumps({
+        "metric": f"decode throughput {MODEL} bs={BATCH} ctx={PROMPT_LEN} "
+                  f"({device_kind})",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,232 @@
+"""ModelRunner: compiled prefill/decode steps over a device mesh.
+
+Owns params + the paged KV cache on device and exposes exactly two host
+entry points per step kind:
+
+  * prefill(chunk)  — one sequence, bucketed chunk length, writes KV pages,
+                      samples the first token on the final chunk
+  * decode(batch)   — one token for every active slot
+
+Everything (forward, KV scatter, sampling) is inside `jit` with the KV cache
+donated, so steady-state decode moves only [B] int32 tokens host<->device.
+Bucketed shapes keep XLA compilation finite; the persistent compilation
+cache makes warmup a one-time cost (ref design concern: "Continuous batching
+under XLA static shapes", SURVEY section 7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import ModelConfig, forward, init_params, make_kv_cache, param_axes
+from ..parallel import kv_cache_sharding, param_shardings
+from ..parallel.mesh import AXIS_DP, Mesh
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from .sampler import sample
+
+log = get_logger("engine.runner")
+
+DEFAULT_PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
+
+
+@dataclasses.dataclass
+class RunnerConfig:
+    page_size: int = 16
+    num_pages: int = 2048
+    max_batch: int = 16
+    max_pages_per_seq: int = 128  # => context cap = page_size * this
+    prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS
+
+    @property
+    def max_context(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+
+def _enable_compile_cache() -> None:
+    platform = env("DYNT_JAX_PLATFORM")
+    if platform:
+        # Env-frozen JAX_PLATFORMS (sitecustomize pre-import) can't be
+        # overridden via os.environ; the live config update can.
+        jax.config.update("jax_platforms", platform)
+    cache_dir = env("DYNT_COMPILE_CACHE_DIR")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        os.makedirs(cache_dir, exist_ok=True)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        runner_config: RunnerConfig,
+        mesh: Mesh,
+        params: Optional[dict] = None,
+        seed: int = 0,
+        attention_fn=None,
+    ) -> None:
+        _enable_compile_cache()
+        self.model_config = model_config
+        self.config = runner_config
+        self.mesh = mesh
+        self._attention_fn = attention_fn
+        axes = param_axes(model_config)
+        self._param_sharding = param_shardings(mesh, axes)
+        self._kv_sharding = kv_cache_sharding(mesh)
+        if params is None:
+            init = jax.jit(
+                partial(init_params, config=model_config),
+                out_shardings=self._param_sharding,
+            )
+            params = init(jax.random.PRNGKey(seed))
+        self.params = params
+        kv_init = jax.jit(
+            lambda: make_kv_cache(model_config, runner_config.num_pages,
+                                  runner_config.page_size),
+            out_shardings=self._kv_sharding,
+        )
+        self.kv_cache = kv_init()
+        self._rep = NamedSharding(mesh, P())  # replicated host inputs
+        self._decode_fn = self._build_decode()
+        self._prefill_fns: dict[int, callable] = {}
+        self.decode_steps = 0
+
+    # -- compiled step builders -------------------------------------------
+
+    def _build_decode(self):
+        cfg = self.model_config
+        attention_fn = self._attention_fn
+
+        def step(params, kv, tokens, positions, block_tables, kv_lens,
+                 active, temperature, top_p, top_k, seeds, step_idx):
+            kv, logits = forward(
+                params, cfg, tokens[:, None], positions[:, None], kv,
+                block_tables, kv_lens, valid=active[:, None],
+                attention_fn=attention_fn,
+            )
+            next_tokens = sample(
+                logits[:, 0, :], temperature, top_p, top_k, seeds, step_idx
+            )
+            return kv, next_tokens
+
+        return jax.jit(step, donate_argnums=(1,),
+                       out_shardings=(self._kv_sharding, self._rep))
+
+    def _build_prefill(self, bucket: int):
+        cfg = self.model_config
+        attention_fn = self._attention_fn
+
+        def step(params, kv, tokens, positions, block_table, kv_lens,
+                 valid, last_idx, temperature, top_p, top_k, seeds):
+            kv, logits = forward(
+                params, cfg, tokens, positions, kv, block_table, kv_lens,
+                valid=valid, attention_fn=attention_fn,
+            )
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1
+            )[:, 0, :]  # [1, V]
+            token = sample(last, temperature, top_p, top_k, seeds,
+                           jnp.int32(0))
+            return kv, token
+
+        return jax.jit(step, donate_argnums=(1,),
+                       out_shardings=(self._kv_sharding, self._rep))
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    @property
+    def max_prefill_chunk(self) -> int:
+        return self.config.prefill_buckets[-1]
+
+    # -- host API ----------------------------------------------------------
+
+    def prefill_chunk(
+        self,
+        tokens: np.ndarray,  # [t] chunk token ids
+        start_pos: int,  # absolute position of tokens[0]
+        block_table: np.ndarray,  # [max_pages_per_seq] int32
+        kv_len_after: int,
+        sampling: tuple[float, float, int, int],  # (temp, top_p, top_k, seed)
+    ) -> int:
+        """Run one prefill chunk; returns the sampled token id (meaningful
+        only on the final chunk)."""
+        t = len(tokens)
+        bucket = self._bucket_for(t)
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._build_prefill(bucket)
+            self._prefill_fns[bucket] = fn
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :t] = tokens
+        pos = np.zeros((1, bucket), np.int32)
+        pos[0, :t] = np.arange(start_pos, start_pos + t)
+        valid = np.zeros((1, bucket), bool)
+        valid[0, :t] = True
+        temp, top_p, top_k, seed = sampling
+        self.kv_cache, token = fn(
+            self.params, self.kv_cache, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(block_table[None, :]),
+            jnp.asarray([kv_len_after], np.int32),
+            jnp.asarray(valid), jnp.asarray([t - 1], np.int32),
+            jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
+            jnp.asarray([top_k], np.int32),
+            jnp.asarray([seed], np.uint32),
+        )
+        return int(np.asarray(token)[0])
+
+    def decode(
+        self,
+        tokens: np.ndarray,  # [B] last token per slot
+        positions: np.ndarray,  # [B]
+        block_tables: np.ndarray,  # [B, max_pages_per_seq]
+        kv_lens: np.ndarray,  # [B]
+        active: np.ndarray,  # [B] bool
+        temperature: np.ndarray,
+        top_p: np.ndarray,
+        top_k: np.ndarray,
+        seeds: np.ndarray,
+    ) -> np.ndarray:
+        """One decode step for all slots; returns sampled tokens [B]."""
+        self.decode_steps += 1
+        self.kv_cache, next_tokens = self._decode_fn(
+            self.params, self.kv_cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32), jnp.asarray(active, bool),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(seeds, jnp.uint32),
+            jnp.int32(self.decode_steps),
+        )
+        return np.asarray(next_tokens)
+
+    def warmup(self) -> None:
+        """Compile decode + smallest prefill bucket ahead of traffic."""
+        b = self.config.max_batch
+        p = self.config.max_pages_per_seq
+        self.decode(
+            np.zeros(b, np.int32), np.zeros(b, np.int32),
+            np.zeros((b, p), np.int32), np.zeros(b, np.int32),
+            np.zeros(b, bool), np.ones(b, np.float32),
+            np.ones(b, np.float32), np.zeros(b, np.int32),
+            np.zeros(b, np.uint32),
+        )
+        self.prefill_chunk(
+            np.zeros(1, np.int32), 0, np.zeros(p, np.int32), 1,
+            (0.0, 1.0, 0, 0),
+        )

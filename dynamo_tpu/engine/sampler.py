@@ -1,0 +1,78 @@
+"""Jitted batched sampling.
+
+Sampling runs inside the compiled step so only the sampled token ids [B]
+cross the device->host boundary each decode step (the per-token hot path the
+reference keeps in native Rust, SURVEY section 7 "per-token streaming
+latency"). All branching is mask-based: every slot gets temperature/top-k/
+top-p parameters; greedy is temperature==0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SamplingState:
+    """Per-slot device arrays, updated by the scheduler on admit."""
+
+    temperature: jax.Array  # [B] f32
+    top_p: jax.Array  # [B] f32
+    top_k: jax.Array  # [B] i32 (0 = disabled)
+    seeds: jax.Array  # [B] u32
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    seeds: jax.Array,
+    step: jax.Array,  # scalar i32 — folded into per-slot keys
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature scale (guard zero-temp slots; they take the greedy branch)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # top-k: mask logits below the k-th largest (k=0 -> disabled)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+    topk_mask = (scaled >= kth) | (top_k[:, None] <= 0)
+
+    # top-p: keep the smallest set of tokens with cumulative prob >= top_p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # token kept if its sorted-cumulative position (exclusive) < top_p
+    cutoff = cumprobs - probs_sorted < top_p[:, None]
+    # map back: a logit is kept if >= the smallest kept sorted logit
+    min_kept = jnp.min(
+        jnp.where(cutoff, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    topp_mask = (scaled >= min_kept) | (top_p[:, None] >= 1.0)
+
+    masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.PRNGKey(s), step)
+    )(seeds)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V]
+    output_counts: jax.Array,  # [B, V] int32 — counts of generated tokens
+    frequency_penalty: jax.Array,  # [B]
+    presence_penalty: jax.Array,  # [B]
+) -> jax.Array:
+    """OpenAI-style frequency/presence penalties."""
+    fp = frequency_penalty[:, None] * output_counts.astype(jnp.float32)
+    pp = presence_penalty[:, None] * (output_counts > 0).astype(jnp.float32)
+    return logits - fp - pp

@@ -1,0 +1,318 @@
+"""Continuous-batching scheduler driving the ModelRunner.
+
+The engine-side scheduler the reference delegates to vLLM/SGLang (and
+simulates in lib/mocker): slot-based continuous batching with chunked
+prefill, paged-KV prefix reuse, per-token streaming, cancellation, and stop
+conditions. Runs in a dedicated thread because compiled JAX steps block;
+results cross into asyncio via call_soon_threadsafe.
+
+Scheduling policy per iteration (vLLM-style, decode-priority):
+  1. admit waiting requests into free slots while pages allocate
+  2. advance at most `prefill_chunk` prefill tokens (chunked prefill keeps
+     decode ITL protected during long prompts)
+  3. one decode step over all decode-ready slots
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as thread_queue
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..runtime.logging import get_logger
+from ..tokens import compute_block_hashes
+from .model_runner import ModelRunner
+from .pages import PageAllocation, PagePool
+
+log = get_logger("engine.scheduler")
+
+
+@dataclasses.dataclass
+class _Seq:
+    request: PreprocessedRequest
+    emit: Callable[[EngineOutput], None]
+    block_hashes: list[int]
+    alloc: PageAllocation
+    block_table: np.ndarray
+    slot: int
+    prompt_len: int
+    prefill_pos: int  # next prompt position to prefill
+    generated: list[int] = dataclasses.field(default_factory=list)
+    last_token: int = 0
+    cancelled: bool = False
+    finished: bool = False
+    seed: int = 0
+
+    @property
+    def decode_ready(self) -> bool:
+        return self.prefill_pos >= self.prompt_len
+
+    @property
+    def kv_len(self) -> int:
+        return self.prompt_len + len(self.generated)
+
+
+@dataclasses.dataclass
+class SchedulerStats:
+    steps: int = 0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    last_step_wall_ms: float = 0.0
+    prefill_tokens_last_step: int = 0
+    decode_tokens_last_step: int = 0
+
+
+class InferenceScheduler:
+    def __init__(
+        self,
+        runner: ModelRunner,
+        on_stored: Optional[Callable[[list[int], Optional[int]], None]] = None,
+        on_removed: Optional[Callable[[list[int]], None]] = None,
+    ) -> None:
+        self.runner = runner
+        cfg = runner.config
+        self.page_size = cfg.page_size
+        self.pool = PagePool(cfg.num_pages, on_stored=on_stored,
+                             on_removed=on_removed)
+        self.max_batch = cfg.max_batch
+        self._slots: list[Optional[_Seq]] = [None] * cfg.max_batch
+        self._waiting: list[_Seq] = []
+        self._incoming: thread_queue.Queue = thread_queue.Queue()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = SchedulerStats()
+        # decode input buffers (reused)
+        b, p = cfg.max_batch, cfg.max_pages_per_seq
+        self._tokens = np.zeros(b, np.int32)
+        self._positions = np.zeros(b, np.int32)
+        self._tables = np.zeros((b, p), np.int32)
+        self._kv_lens = np.zeros(b, np.int32)
+        self._active = np.zeros(b, bool)
+        self._temp = np.ones(b, np.float32)
+        self._top_p = np.ones(b, np.float32)
+        self._top_k = np.zeros(b, np.int32)
+        self._seeds = np.zeros(b, np.uint32)
+
+    # -- public (thread-safe) ---------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="engine-scheduler")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def submit(
+        self,
+        request: PreprocessedRequest,
+        emit: Callable[[EngineOutput], None],
+    ) -> "_SubmitHandle":
+        handle = _SubmitHandle()
+        self._incoming.put((request, emit, handle))
+        self._wake.set()
+        return handle
+
+    def queue_depth(self) -> tuple[int, int]:
+        active = sum(1 for s in self._slots if s is not None)
+        return active, len(self._waiting)
+
+    # -- scheduler thread --------------------------------------------------
+
+    def _loop(self) -> None:
+        log.info("scheduler loop up (max_batch=%d pages=%d)",
+                 self.max_batch, self.pool.num_pages)
+        while not self._stop:
+            self._drain_incoming()
+            progressed = self._step()
+            if not progressed:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _drain_incoming(self) -> None:
+        while True:
+            try:
+                request, emit, handle = self._incoming.get_nowait()
+            except thread_queue.Empty:
+                return
+            seq = self._prepare(request, emit)
+            if seq is not None:
+                handle.seq = seq
+                if handle._cancelled:  # cancelled before the seq existed
+                    seq.cancelled = True
+                self._waiting.append(seq)
+
+    def _prepare(self, request: PreprocessedRequest, emit) -> Optional[_Seq]:
+        prompt_len = len(request.token_ids)
+        total_pages = -(-(prompt_len + request.sampling.max_tokens)
+                        // self.page_size)
+        if (prompt_len >= self.runner.config.max_context
+                or total_pages > self.runner.config.max_pages_per_seq
+                or total_pages > self.pool.num_pages - 1):
+            emit(EngineOutput(
+                finish_reason="error",
+                error=(f"request needs {total_pages} pages / "
+                       f"{prompt_len} prompt tokens; exceeds engine capacity"),
+            ))
+            return None
+        block_hashes = compute_block_hashes(request.token_ids, self.page_size)
+        seed = request.sampling.seed
+        if seed is None:
+            seed = abs(hash(request.request_id)) & 0xFFFFFFFF
+        return _Seq(
+            request=request, emit=emit, block_hashes=block_hashes,
+            alloc=PageAllocation([], [], 0),
+            block_table=np.zeros(self.runner.config.max_pages_per_seq,
+                                 np.int32),
+            slot=-1, prompt_len=prompt_len, prefill_pos=0, seed=seed,
+        )
+
+    def _admit(self) -> None:
+        while self._waiting:
+            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            if not free_slots:
+                return
+            seq = self._waiting[0]
+            if seq.cancelled:
+                self._waiting.pop(0)
+                continue
+            total_pages = -(-(seq.prompt_len + seq.request.sampling.max_tokens)
+                            // self.page_size)
+            alloc = self.pool.allocate(seq.block_hashes, total_pages)
+            if alloc is None:
+                return  # no pages; retry next iteration
+            # Never skip the whole prompt: recompute at least the last token
+            # so we have logits to sample from (cached pages stay correct —
+            # recomputed KV values are identical).
+            cached_tokens = min(alloc.cached_blocks * self.page_size,
+                                seq.prompt_len - 1)
+            seq.alloc = alloc
+            pages = alloc.pages
+            seq.block_table[: len(pages)] = pages
+            seq.prefill_pos = cached_tokens
+            seq.slot = free_slots[0]
+            self._slots[seq.slot] = seq
+            self._waiting.pop(0)
+
+    def _step(self) -> bool:
+        start = time.monotonic()
+        self._admit()
+        prefill_tokens = self._prefill_some()
+        decode_tokens = self._decode_all()
+        self._reap_finished()
+        if prefill_tokens or decode_tokens:
+            self.stats.steps += 1
+            self.stats.prefill_tokens += prefill_tokens
+            self.stats.decode_tokens += decode_tokens
+            self.stats.prefill_tokens_last_step = prefill_tokens
+            self.stats.decode_tokens_last_step = decode_tokens
+            self.stats.last_step_wall_ms = (time.monotonic() - start) * 1e3
+            return True
+        return False
+
+    def _prefill_some(self) -> int:
+        """Advance one sequence's prefill by up to one chunk."""
+        budget = self.runner.max_prefill_chunk
+        for seq in self._slots:
+            if seq is None or seq.cancelled or seq.decode_ready:
+                continue
+            chunk = min(budget, seq.prompt_len - seq.prefill_pos)
+            tokens = np.asarray(
+                seq.request.token_ids[seq.prefill_pos : seq.prefill_pos + chunk],
+                np.int32,
+            )
+            is_final = seq.prefill_pos + chunk >= seq.prompt_len
+            sampling = seq.request.sampling
+            token = self.runner.prefill_chunk(
+                tokens, seq.prefill_pos, seq.block_table,
+                kv_len_after=seq.prefill_pos + chunk,
+                sampling=(sampling.temperature, sampling.top_p,
+                          sampling.top_k, seq.seed),
+            )
+            seq.prefill_pos += chunk
+            if is_final:
+                self._append_token(seq, token,
+                                   prompt_tokens=seq.prompt_len)
+            return chunk
+        return 0
+
+    def _decode_all(self) -> int:
+        ready = [s for s in self._slots
+                 if s is not None and s.decode_ready and not s.finished
+                 and not s.cancelled and len(s.generated) > 0]
+        # Sequences whose first token just came from prefill already have
+        # generated[0]; they join decode from the next step.
+        if not ready:
+            return 0
+        self._active[:] = False
+        for seq in ready:
+            i = seq.slot
+            self._tokens[i] = seq.last_token
+            self._positions[i] = seq.kv_len - 1  # position of last_token
+            self._tables[i] = seq.block_table
+            self._kv_lens[i] = seq.kv_len
+            self._active[i] = True
+            s = seq.request.sampling
+            self._temp[i] = s.temperature
+            self._top_p[i] = s.top_p
+            self._top_k[i] = s.top_k
+            self._seeds[i] = seq.seed
+        next_tokens = self.runner.decode(
+            self._tokens, self._positions, self._tables, self._kv_lens,
+            self._active, self._temp, self._top_p, self._top_k, self._seeds,
+        )
+        count = 0
+        for seq in ready:
+            self._append_token(seq, int(next_tokens[seq.slot]))
+            count += 1
+        return count
+
+    def _append_token(self, seq: _Seq, token: int,
+                      prompt_tokens: Optional[int] = None) -> None:
+        seq.generated.append(token)
+        seq.last_token = token
+        request = seq.request
+        finish = None
+        if not request.stop.ignore_eos and token in request.eos_token_ids:
+            finish = "stop"
+        elif token in request.stop.stop_token_ids:
+            finish = "stop"
+        elif len(seq.generated) >= request.sampling.max_tokens:
+            finish = "length"
+        seq.emit(EngineOutput(
+            token_ids=[token], finish_reason=finish,
+            prompt_tokens=prompt_tokens,
+        ))
+        if finish is not None:
+            seq.finished = True
+
+    def _reap_finished(self) -> None:
+        for i, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            if seq.finished or seq.cancelled:
+                self.pool.release(seq.alloc, seq.block_hashes)
+                self._slots[i] = None
+
+
+class _SubmitHandle:
+    """Cancellation handle bridging asyncio-side aborts into the thread."""
+
+    def __init__(self) -> None:
+        self.seq: Optional[_Seq] = None
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        if self.seq is not None:
+            self.seq.cancelled = True

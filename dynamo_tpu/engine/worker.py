@@ -1,0 +1,254 @@
+"""TPU worker: the real JAX engine registered into the distributed runtime.
+
+The analog of `python -m dynamo.vllm` (ref: components/src/dynamo/vllm/
+main.py:113 + handlers.py DecodeWorkerHandler) except the engine is ours:
+create runtime -> build ModelRunner + InferenceScheduler -> serve `generate`
+-> publish ModelDeploymentCard -> publish KV events + load metrics. The
+KV-event publisher is embedded (no ZMQ bridge needed — we own the engine;
+SURVEY section 2.6 "Engine->Dynamo KV events: in-process").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import AsyncIterator, Optional
+
+from ..kv_router.protocols import (
+    KV_EVENT_TOPIC,
+    LOAD_TOPIC,
+    KvCacheRemoved,
+    KvCacheStored,
+    LoadMetrics,
+    RouterEvent,
+)
+from ..llm.model_card import CHAT, COMPLETIONS, ModelDeploymentCard, publish_card
+from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..models import get_config
+from ..parallel import MeshConfig, make_mesh
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+from ..runtime.metrics import KV_USAGE
+from .model_runner import ModelRunner, RunnerConfig
+from .scheduler import InferenceScheduler
+
+log = get_logger("engine.worker")
+
+
+class KvEventBuffer:
+    """Thread-safe KV event buffer: the scheduler thread records stored /
+    removed page hashes; an async drain task batches them onto the event
+    plane (the reference batches publishes the same way,
+    kv_router/publisher)."""
+
+    def __init__(self, worker_id: int, dp_rank: int = 0) -> None:
+        self.worker_id = worker_id
+        self.dp_rank = dp_rank
+        self._lock = threading.Lock()
+        self._pending: list[RouterEvent] = []
+        self._event_id = 0
+
+    def on_stored(self, hashes: list[int], parent: Optional[int]) -> None:
+        with self._lock:
+            self._pending.append(RouterEvent(
+                worker_id=self.worker_id, event_id=self._event_id,
+                dp_rank=self.dp_rank,
+                stored=KvCacheStored(block_hashes=list(hashes),
+                                     parent_hash=parent),
+            ))
+            self._event_id += 1
+
+    def on_removed(self, hashes: list[int]) -> None:
+        with self._lock:
+            self._pending.append(RouterEvent(
+                worker_id=self.worker_id, event_id=self._event_id,
+                dp_rank=self.dp_rank,
+                removed=KvCacheRemoved(block_hashes=list(hashes)),
+            ))
+            self._event_id += 1
+
+    def drain(self) -> list[RouterEvent]:
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+
+class TpuWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        model_name: str = "tiny-test",
+        served_name: Optional[str] = None,
+        namespace: str = "dynamo",
+        component: str = "backend",
+        runner_config: Optional[RunnerConfig] = None,
+        mesh_config: Optional[MeshConfig] = None,
+        attention_fn=None,
+        warmup: bool = True,
+    ) -> None:
+        self.runtime = runtime
+        self.instance_id = new_instance_id()
+        self.model_config = get_config(model_name)
+        self.runner_config = runner_config or RunnerConfig()
+        self.mesh = make_mesh(mesh_config or MeshConfig())
+        self._warmup = warmup
+        self.events = KvEventBuffer(self.instance_id)
+        self.runner: Optional[ModelRunner] = None
+        self.scheduler: Optional[InferenceScheduler] = None
+        self.card = ModelDeploymentCard(
+            name=served_name or self.model_config.name,
+            model_types=[CHAT, COMPLETIONS],
+            namespace=namespace,
+            component=component,
+            endpoint="generate",
+            context_length=min(self.model_config.max_context,
+                               self.runner_config.max_context),
+            kv_block_size=self.runner_config.page_size,
+            total_kv_blocks=self.runner_config.num_pages,
+            tokenizer={"kind": "byte"},
+        )
+        self._tasks: list[asyncio.Task] = []
+        self._served = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        log.info("building model runner (%s, pages=%d, batch=%d)...",
+                 self.model_config.name, self.runner_config.num_pages,
+                 self.runner_config.max_batch)
+        self.runner = await asyncio.to_thread(
+            ModelRunner, self.model_config, self.runner_config, self.mesh,
+        )
+        if self._warmup:
+            await asyncio.to_thread(self.runner.warmup)
+        self.scheduler = InferenceScheduler(
+            self.runner,
+            on_stored=self.events.on_stored,
+            on_removed=self.events.on_removed,
+        )
+        self.scheduler.start()
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("generate")
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.generate, instance_id=self.instance_id
+        )
+        # clear_kv_blocks endpoint (ref: vllm worker clear_kv_blocks)
+        clear_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("clear_kv_blocks")
+        )
+        await clear_ep.serve_endpoint(self._clear_kv, instance_id=self.instance_id)
+        await publish_card(self.runtime, self.card, self.instance_id)
+        publisher = self.runtime.event_publisher(self.card.namespace)
+        self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
+        log.info("tpu worker serving %s as %s (instance=%x)",
+                 self.model_config.name, self.card.name, self.instance_id)
+
+    async def _clear_kv(self, body, ctx) -> AsyncIterator[dict]:
+        cleared = self.scheduler.pool.clear()
+        yield {"cleared_blocks": len(cleared)}
+
+    async def _event_drain(self, publisher, interval: float = 0.05) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            for event in self.events.drain():
+                try:
+                    await publisher.publish(KV_EVENT_TOPIC, event.to_wire())
+                except Exception:  # noqa: BLE001
+                    log.exception("kv event publish failed")
+            # periodic load metrics piggyback on the same cadence (1 in 10)
+            if self.scheduler is not None and self.runner.decode_steps % 1 == 0:
+                active, waiting = self.scheduler.queue_depth()
+                metrics = LoadMetrics(
+                    worker_id=self.instance_id,
+                    active_blocks=(self.scheduler.pool.num_pages - 1
+                                   - self.scheduler.pool.free_count()),
+                    total_blocks=self.scheduler.pool.num_pages,
+                    active_requests=active,
+                    waiting_requests=waiting,
+                    kv_usage=self.scheduler.pool.usage(),
+                    step_wall_ms=self.scheduler.stats.last_step_wall_ms,
+                    prefill_tokens_in_step=self.scheduler.stats.prefill_tokens_last_step,
+                    decode_tokens_in_step=self.scheduler.stats.decode_tokens_last_step,
+                )
+                KV_USAGE.labels(worker=f"{self.instance_id:x}").set(
+                    metrics.kv_usage)
+                try:
+                    await publisher.publish(LOAD_TOPIC, metrics.to_wire())
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- request handler ---------------------------------------------------
+
+    async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        request = PreprocessedRequest.from_wire(body)
+        loop = asyncio.get_running_loop()
+        out_queue: asyncio.Queue = asyncio.Queue()
+
+        def emit(output: EngineOutput) -> None:
+            loop.call_soon_threadsafe(out_queue.put_nowait, output)
+
+        handle = self.scheduler.submit(request, emit)
+        try:
+            while True:
+                output: EngineOutput = await out_queue.get()
+                yield output.to_wire()
+                if output.finish_reason is not None:
+                    return
+        finally:
+            handle.cancel()
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.scheduler is not None:
+            self.scheduler.stop()
+        if self._served is not None:
+            await self._served.shutdown()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.worker")
+    parser.add_argument("--model", default="tiny-test",
+                        help="model preset (models/config.py PRESETS)")
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=2048)
+    parser.add_argument("--max-batch", type=int, default=16)
+    parser.add_argument("--max-pages-per-seq", type=int, default=128)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--dp", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    worker = TpuWorker(
+        runtime,
+        model_name=args.model,
+        served_name=args.served_model_name,
+        namespace=args.namespace,
+        component=args.component,
+        runner_config=RunnerConfig(
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_batch=args.max_batch,
+            max_pages_per_seq=args.max_pages_per_seq,
+        ),
+        mesh_config=MeshConfig(dp=args.dp, tp=args.tp),
+    )
+    await worker.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await worker.close()
+        await runtime.shutdown()

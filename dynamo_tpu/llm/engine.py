@@ -125,7 +125,7 @@ class Migration(TokenEngine):
                     stop=request.stop,
                     eos_token_ids=request.eos_token_ids,
                     model=request.model,
-                    prior_output_tokens=generated,
+                    prior_output_tokens=list(generated),
                     annotations=request.annotations,
                 )
                 await asyncio.sleep(0.05 * attempts)

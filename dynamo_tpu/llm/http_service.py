@@ -61,14 +61,16 @@ class HttpService:
         return entry
 
     def _check_busy(self, entry: ModelEntry) -> None:
-        """Shed load when all workers are past the KV busy threshold."""
-        if self.busy_threshold is None or entry.scheduler is None:
+        """Shed load when every live worker is past the KV busy threshold
+        (ref: busy_threshold.rs + KvWorkerMonitor). Uses published
+        LoadMetrics usage, which flows in every router mode."""
+        if self.busy_threshold is None:
             return
         usages = [
-            entry.scheduler.sequences.kv_usage(w)
-            for w in [w for w in entry.scheduler.indexer.worker_block_counts()]
+            entry.worker_usage[iid]
+            for iid in entry.router.client.instance_ids()
+            if iid in entry.worker_usage
         ]
-        usages = [u for u in usages if u is not None]
         if usages and min(usages) >= self.busy_threshold:
             raise web.HTTPServiceUnavailable(
                 text=json.dumps(_error_body(503, "service busy", "overloaded")),

@@ -39,6 +39,9 @@ class ModelEntry:
     router: PushRouter
     scheduler: Optional[KvScheduler]
     instances: set[int] = dataclasses.field(default_factory=set)
+    # worker instance_id -> last published kv usage (any router mode; feeds
+    # busy-threshold load shedding)
+    worker_usage: dict[int, float] = dataclasses.field(default_factory=dict)
 
 
 class ModelManager:
@@ -73,19 +76,17 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: str = "round_robin",
         kv_config: Optional[KvRouterConfig] = None,
-        busy_threshold: Optional[float] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.kv_config = kv_config
-        self.busy_threshold = busy_threshold
         self._watch = None
         self._tasks: list[asyncio.Task] = []
-        # namespace -> schedulers fed by that namespace's event stream; the
+        # namespace -> entries fed by that namespace's event stream; the
         # list is shared with the running _event_loop so late-registered
         # models start receiving events immediately.
-        self._ns_schedulers: dict[str, list[KvScheduler]] = {}
+        self._ns_entries: dict[str, list[ModelEntry]] = {}
 
     async def start(self) -> None:
         self._watch = await self.runtime.discovery.watch_prefix(
@@ -126,10 +127,17 @@ class ModelWatcher:
             entry = self._build_entry(card)
             await entry.router.client.start()
             self.manager.register(entry)
-            if entry.scheduler is not None:
-                await self._subscribe_events(card.namespace, entry.scheduler)
+            await self._subscribe_events(card.namespace, entry)
             log.info("model registered: %s (%s, router=%s)", card.name,
                      subject, self.router_mode)
+        elif entry.card.endpoint_subject != subject:
+            # Same model name served from a different endpoint: first one
+            # wins (instance bookkeeping must stay per-subject or deletes
+            # can never drain the entry).
+            log.warning(
+                "model %s already served at %s; ignoring instance at %s",
+                card.name, entry.card.endpoint_subject, subject)
+            return
         entry.instances.add(instance_id)
 
     async def _handle_delete(self, key: str) -> None:
@@ -143,11 +151,9 @@ class ModelWatcher:
                     log.info("model unlisted: %s (last instance gone)",
                              entry.card.name)
                     self.manager.unregister(entry.card.name)
-                    if entry.scheduler is not None:
-                        schedulers = self._ns_schedulers.get(
-                            entry.card.namespace, [])
-                        if entry.scheduler in schedulers:
-                            schedulers.remove(entry.scheduler)
+                    entries = self._ns_entries.get(entry.card.namespace, [])
+                    if entry in entries:
+                        entries.remove(entry)
                     await entry.router.client.close()
 
     def _build_entry(self, card: ModelDeploymentCard) -> ModelEntry:
@@ -178,29 +184,34 @@ class ModelWatcher:
             instances=set(),
         )
 
-    async def _subscribe_events(self, namespace: str, scheduler: KvScheduler) -> None:
+    async def _subscribe_events(self, namespace: str, entry: ModelEntry) -> None:
         """Feed KV events + load metrics from the event plane into every
-        KV-routed model's scheduler in this namespace (ref:
-        kv_router/subscriber.rs; section 3.3 feedback path)."""
-        schedulers = self._ns_schedulers.get(namespace)
-        if schedulers is not None:
-            schedulers.append(scheduler)
+        model entry in this namespace (ref: kv_router/subscriber.rs; section
+        3.3 feedback path). Load metrics flow in every router mode (they
+        drive busy-threshold shedding); KV events only matter to entries
+        with a scheduler."""
+        entries = self._ns_entries.get(namespace)
+        if entries is not None:
+            entries.append(entry)
             return
-        schedulers = [scheduler]
-        self._ns_schedulers[namespace] = schedulers
+        entries = [entry]
+        self._ns_entries[namespace] = entries
         sub = await self.runtime.event_subscriber(namespace, topic_prefix="")
-        self._tasks.append(asyncio.create_task(self._event_loop(sub, schedulers)))
+        self._tasks.append(asyncio.create_task(self._event_loop(sub, entries)))
 
-    async def _event_loop(self, sub, schedulers: list[KvScheduler]) -> None:
+    async def _event_loop(self, sub, entries: list[ModelEntry]) -> None:
         async for topic, payload in sub:
             try:
                 if topic.startswith(KV_EVENT_TOPIC):
                     event = RouterEvent.from_wire(payload)
-                    for scheduler in schedulers:
-                        scheduler.indexer.apply_event(event)
+                    for entry in entries:
+                        if entry.scheduler is not None:
+                            entry.scheduler.indexer.apply_event(event)
                 elif topic.startswith(LOAD_TOPIC):
                     metrics = LoadMetrics.from_wire(payload)
-                    for scheduler in schedulers:
-                        scheduler.sequences.update_published(metrics)
+                    for entry in entries:
+                        entry.worker_usage[metrics.worker_id] = metrics.kv_usage
+                        if entry.scheduler is not None:
+                            entry.scheduler.sequences.update_published(metrics)
             except Exception:  # noqa: BLE001
                 log.exception("bad event on %s", topic)

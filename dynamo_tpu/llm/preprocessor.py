@@ -79,7 +79,15 @@ class OpenAIPreprocessor:
         if isinstance(prompt, list):
             if prompt and isinstance(prompt[0], int):
                 return self._build_from_tokens([int(t) for t in prompt], request)
-            prompt = "".join(str(p) for p in prompt)
+            if len(prompt) == 1:
+                prompt = prompt[0]
+            else:
+                # OpenAI batch-prompt semantics (one choice per prompt) are
+                # not supported yet; rejecting beats silently concatenating.
+                raise RequestError(
+                    "batched string prompts are not supported; send one "
+                    "prompt per request"
+                )
         return self._build(str(prompt), request)
 
     def _build(self, prompt: str, request: dict) -> PreprocessedRequest:

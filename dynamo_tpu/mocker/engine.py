@@ -282,6 +282,11 @@ class MockerEngine:
                 self._waiting.pop(0)
                 continue
             cached = self.kv.match_prefix(seq.block_hashes)
+            # Pin the matched prefix BEFORE allocating: allocation may evict
+            # unreferenced cached blocks, and it must not evict the ones we
+            # just counted as reusable.
+            prefix = seq.block_hashes[:cached]
+            self.kv.pin(prefix)
             total_blocks = (
                 len(seq.request.token_ids) + seq.request.sampling.max_tokens
             ) // cfg.block_size + 1
@@ -289,6 +294,7 @@ class MockerEngine:
                 # Can never fit, even with an empty pool: reject instead of
                 # wedging the queue (ref: engines reject over-capacity
                 # requests rather than deadlock the scheduler).
+                self.kv.unpin(prefix)
                 self._waiting.pop(0)
                 seq.queue.put_nowait(EngineOutput(
                     finish_reason="error",
@@ -299,16 +305,14 @@ class MockerEngine:
                 continue
             need = max(0, total_blocks - cached)
             reserve = int(self.kv.capacity * cfg.watermark)
-            if self.kv.free_blocks() - need < reserve and self._running:
+            if (self.kv.free_blocks() - need < reserve and self._running) \
+                    or not self.kv.allocate(need, evict_cb):
+                self.kv.unpin(prefix)
                 break  # wait for blocks to free up
-            if not self.kv.allocate(need, evict_cb):
-                break
             seq.cached_blocks = cached
             seq.new_blocks = need
             seq.prefilled_tokens = cached * cfg.block_size
-            pinned = seq.block_hashes[:cached]
-            self.kv.pin(pinned)
-            seq.pinned = pinned
+            seq.pinned = prefix
             self._waiting.pop(0)
             self._running.append(seq)
 
@@ -367,7 +371,11 @@ class MockerEngine:
         the rest free (and generated-token blocks beyond the prompt free)."""
         cfg = self.config
         self.kv.unpin(seq.pinned)
-        full_prompt_blocks = len(seq.block_hashes)
+        # Only blocks actually prefilled may enter the reusable cache — a
+        # cancelled sequence must not register (and advertise) blocks whose
+        # KV was never computed.
+        prefilled_blocks = seq.prefilled_tokens // cfg.block_size
+        full_prompt_blocks = min(len(seq.block_hashes), prefilled_blocks)
         new_cached = seq.block_hashes[seq.cached_blocks:full_prompt_blocks]
         newly = self.kv.insert_cached(
             new_cached, from_used=min(len(new_cached), seq.new_blocks)

@@ -1,0 +1,27 @@
+"""Model families (flagship: Qwen3/Llama-class decoders)."""
+
+from .config import ModelConfig, PRESETS, get_config
+from .transformer import (
+    forward,
+    init_params,
+    make_kv_cache,
+    paged_attention_xla,
+    param_axes,
+    rms_norm,
+    rope,
+    write_kv_pages,
+)
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "forward",
+    "get_config",
+    "init_params",
+    "make_kv_cache",
+    "paged_attention_xla",
+    "param_axes",
+    "rms_norm",
+    "rope",
+    "write_kv_pages",
+]

@@ -1,0 +1,82 @@
+"""Model family configs.
+
+The reference orchestrates external engines and never owns model code; the
+TPU build owns the engine, so model families live here. Flagship families
+mirror BASELINE.json configs: Qwen3-class (RMSNorm + SwiGLU + GQA + QK-norm),
+Llama-3-class (same minus QK-norm), plus a tiny test model for CI on the
+8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny-test"
+    vocab_size: int = 512
+    hidden: int = 64
+    n_layers: int = 2
+    n_q_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 16
+    mlp_hidden: int = 128
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k
+    tie_embeddings: bool = True
+    max_context: int = 8192
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense)
+    n_experts: int = 0
+    n_experts_active: int = 0
+    expert_mlp_hidden: int = 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+PRESETS: dict[str, ModelConfig] = {
+    "tiny-test": ModelConfig(),
+    "tiny-moe-test": ModelConfig(
+        name="tiny-moe-test", n_experts=4, n_experts_active=2,
+        expert_mlp_hidden=128,
+    ),
+    # Qwen3-0.6B (ref workload: BASELINE.json config 1)
+    "qwen3-0.6b": ModelConfig(
+        name="qwen3-0.6b", vocab_size=151936, hidden=1024, n_layers=28,
+        n_q_heads=16, n_kv_heads=8, head_dim=128, mlp_hidden=3072,
+        rope_theta=1e6, qk_norm=True, tie_embeddings=True, max_context=32768,
+    ),
+    "qwen3-4b": ModelConfig(
+        name="qwen3-4b", vocab_size=151936, hidden=2560, n_layers=36,
+        n_q_heads=32, n_kv_heads=8, head_dim=128, mlp_hidden=9728,
+        rope_theta=1e6, qk_norm=True, tie_embeddings=True, max_context=32768,
+    ),
+    # Llama-3-8B (ref workload: BASELINE.json config 2)
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, hidden=4096, n_layers=32,
+        n_q_heads=32, n_kv_heads=8, head_dim=128, mlp_hidden=14336,
+        rope_theta=5e5, tie_embeddings=False, max_context=8192,
+    ),
+    # Llama-3-70B (ref workload: recipes/llama-3-70b, BASELINE config 3)
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, hidden=8192, n_layers=80,
+        n_q_heads=64, n_kv_heads=8, head_dim=128, mlp_hidden=28672,
+        rope_theta=5e5, tie_embeddings=False, max_context=8192,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset '{name}' "
+                       f"(have: {sorted(PRESETS)})")
+    return PRESETS[name]

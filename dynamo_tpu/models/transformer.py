@@ -1,0 +1,298 @@
+"""Functional decoder transformer with paged KV.
+
+Pure-functional JAX (params are a pytree; no Module state) so the whole
+engine step jits and shards with pjit. Design points for TPU:
+
+  * bf16 everywhere on the matmul path (MXU), fp32 for norms/softmax accum
+  * paged KV cache: one array [layers, 2, pages, page_size, kv_heads, hd]
+    donated through each step for in-place scatter updates
+  * unified attention: queries (prefill chunk or single decode token) attend
+    over the sequence's pages via its block table, so chunked prefill,
+    prefix-cache hits, and decode share one code path
+  * GQA with q-heads/kv-heads sharded over the tp mesh axis; all tensor
+    contractions keep the tp axis inside einsums so XLA inserts ICI
+    all-reduces only at block boundaries
+
+The CUDA analog this replaces lives inside vLLM/TRT-LLM (the reference
+delegates model code entirely; SURVEY section 2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical sharding axes
+# ---------------------------------------------------------------------------
+
+
+def param_axes(config: ModelConfig) -> dict:
+    """Logical sharding axes per parameter (see parallel.shardings)."""
+    layer = {
+        "attn_norm": ("embed",),
+        "wq": ("embed", "q_heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("q_heads", "head_dim", "embed"),
+        "mlp_norm": ("embed",),
+        "w_gate": ("embed", "mlp"),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+    }
+    if config.qk_norm:
+        layer["q_norm"] = ("head_dim",)
+        layer["k_norm"] = ("head_dim",)
+    if config.n_experts:
+        layer["router"] = ("embed", "experts")
+        layer["e_gate"] = ("experts", "embed", "mlp")
+        layer["e_up"] = ("experts", "embed", "mlp")
+        layer["e_down"] = ("experts", "mlp", "embed")
+    axes = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed",),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(key: jax.Array, config: ModelConfig) -> dict:
+    dtype = jnp.dtype(config.dtype)
+    h, hd = config.hidden, config.head_dim
+    qh, kh, m = config.n_q_heads, config.n_kv_heads, config.mlp_hidden
+    keys = jax.random.split(key, config.n_layers + 2)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    def layer(k):
+        ks = jax.random.split(k, 10)
+        p = {
+            "attn_norm": jnp.ones((h,), dtype),
+            "wq": dense(ks[0], (h, qh, hd), h),
+            "wk": dense(ks[1], (h, kh, hd), h),
+            "wv": dense(ks[2], (h, kh, hd), h),
+            "wo": dense(ks[3], (qh, hd, h), qh * hd),
+            "mlp_norm": jnp.ones((h,), dtype),
+            "w_gate": dense(ks[4], (h, m), h),
+            "w_up": dense(ks[5], (h, m), h),
+            "w_down": dense(ks[6], (m, h), m),
+        }
+        if config.qk_norm:
+            p["q_norm"] = jnp.ones((hd,), dtype)
+            p["k_norm"] = jnp.ones((hd,), dtype)
+        if config.n_experts:
+            e, em = config.n_experts, config.expert_mlp_hidden or m
+            p["router"] = dense(ks[7], (h, e), h)
+            p["e_gate"] = dense(ks[8], (e, h, em), h)
+            p["e_up"] = dense(ks[9], (e, h, em), h)
+            p["e_down"] = dense(ks[7], (e, em, h), em)
+        return p
+
+    params = {
+        "embed": dense(keys[0], (config.vocab_size, h), h),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": [layer(keys[i + 1]) for i in range(config.n_layers)],
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[-1], (h, config.vocab_size), h)
+    return params
+
+
+def make_kv_cache(config: ModelConfig, num_pages: int, page_size: int,
+                  dtype: Optional[str] = None) -> jax.Array:
+    """[layers, 2(k/v), pages, page_size, kv_heads, head_dim]. Page 0 is a
+    reserved scratch page (block tables point unused slots at it)."""
+    return jnp.zeros(
+        (config.n_layers, 2, num_pages, page_size, config.n_kv_heads,
+         config.head_dim),
+        dtype=jnp.dtype(dtype or config.dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig = x.dtype
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(orig) * weight
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [..., T, H, hd]; positions: [..., T]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = jnp.exp(
+        -math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _swiglu(x: jax.Array, p: dict) -> jax.Array:
+    gate = jnp.einsum("bth,hm->btm", x, p["w_gate"])
+    up = jnp.einsum("bth,hm->btm", x, p["w_up"])
+    return jnp.einsum("btm,mh->bth", jax.nn.silu(gate) * up, p["w_down"])
+
+
+def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
+    """Dense-compute MoE (every expert computed, weighted by router top-k
+    mask) — compiles to static shapes; token-dropping EP dispatch is an
+    optimization layered in ops/moe later."""
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    k = config.n_experts_active
+    topv, topi = jax.lax.top_k(logits, k)
+    weights = jax.nn.softmax(topv, axis=-1)
+    mask = jnp.zeros_like(logits).at[
+        jnp.arange(x.shape[0])[:, None, None],
+        jnp.arange(x.shape[1])[None, :, None],
+        topi,
+    ].set(weights)  # [b, t, e]
+    gate = jnp.einsum("bth,ehm->betm", x, p["e_gate"])
+    up = jnp.einsum("bth,ehm->betm", x, p["e_up"])
+    expert_out = jnp.einsum("betm,emh->beth", jax.nn.silu(gate) * up,
+                            p["e_down"])
+    return jnp.einsum("beth,bte->bth", expert_out,
+                      mask.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Paged KV write + attention (XLA reference path; Pallas kernel in ops/)
+# ---------------------------------------------------------------------------
+
+
+def write_kv_pages(
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer: int,
+    k: jax.Array,  # [B, T, kh, hd]
+    v: jax.Array,
+    block_tables: jax.Array,  # [B, max_pages] int32
+    positions: jax.Array,  # [B, T] int32 (absolute positions)
+    valid: jax.Array,  # [B, T] bool
+) -> jax.Array:
+    page_size = kv_cache.shape[3]
+    b, t = positions.shape
+    page_of = positions // page_size  # logical page index per token
+    page_idx = jnp.take_along_axis(
+        block_tables, page_of.astype(jnp.int32), axis=1
+    )  # [B, T] physical page ids
+    offset = positions % page_size
+    # Invalid (padding) tokens write to the reserved scratch page 0.
+    page_idx = jnp.where(valid, page_idx, 0)
+    flat_pages = page_idx.reshape(-1)
+    flat_off = offset.reshape(-1)
+    kv_cache = kv_cache.at[layer, 0, flat_pages, flat_off].set(
+        k.reshape(b * t, *k.shape[2:]), mode="drop"
+    )
+    kv_cache = kv_cache.at[layer, 1, flat_pages, flat_off].set(
+        v.reshape(b * t, *v.shape[2:]), mode="drop"
+    )
+    return kv_cache
+
+
+def paged_attention_xla(
+    q: jax.Array,  # [B, T, qh, hd]
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer: int,
+    block_tables: jax.Array,  # [B, max_pages]
+    positions: jax.Array,  # [B, T] absolute query positions
+    kv_lens: jax.Array,  # [B] total kv tokens visible (incl. this chunk)
+) -> jax.Array:
+    """Reference paged attention: gather the sequence's pages, run masked
+    SDPA. Correct everywhere (CPU tests, fallback); the Pallas kernel
+    (ops/paged_attention.py) replaces this on TPU for decode."""
+    b, t, qh, hd = q.shape
+    ps = kv_cache.shape[3]
+    kh = kv_cache.shape[4]
+    max_pages = block_tables.shape[1]
+    ctx = max_pages * ps
+    # Gather pages: [B, max_pages, ps, kh, hd] -> [B, ctx, kh, hd]
+    k_pages = kv_cache[layer, 0][block_tables]
+    v_pages = kv_cache[layer, 1][block_tables]
+    k = k_pages.reshape(b, ctx, kh, hd)
+    v = v_pages.reshape(b, ctx, kh, hd)
+    group = qh // kh
+    qg = q.reshape(b, t, kh, group, hd)
+    scores = jnp.einsum("btkgh,bskh->btkgs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    kv_pos = jnp.arange(ctx)[None, :]  # [1, ctx]
+    # causal: kv position must be < kv_len and <= query position
+    mask = (kv_pos[:, None, :] <= positions[..., None]) & (
+        kv_pos[:, None, :] < kv_lens[:, None, None]
+    )  # [B, T, ctx]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("btkgs,bskh->btkgh", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, qh, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: dict,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T]
+    kv_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] kv length AFTER this chunk
+    valid: Optional[jax.Array] = None,  # [B, T]
+    attention_fn=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Unified chunk forward (prefill T>1 or decode T=1).
+
+    Returns (new_kv_cache, logits [B, T, vocab]).
+    """
+    if valid is None:
+        valid = jnp.ones(tokens.shape, dtype=bool)
+    attention = attention_fn or paged_attention_xla
+    x = params["embed"][tokens]  # [B, T, H]
+    for layer_idx, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q, positions, config.rope_theta)
+        k = rope(k, positions, config.rope_theta)
+        kv_cache = write_kv_pages(kv_cache, layer_idx, k, v, block_tables,
+                                  positions, valid)
+        attn = attention(q, kv_cache, layer_idx, block_tables, positions,
+                         kv_lens)
+        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        if config.n_experts:
+            x = x + _moe(h, lp, config)
+        else:
+            x = x + _swiglu(h, lp)
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    return kv_cache, logits

@@ -1,0 +1,19 @@
+"""Device mesh + sharding layer."""
+
+from .mesh import MeshConfig, make_mesh, local_mesh
+from .shardings import (
+    kv_cache_sharding,
+    logical_to_sharding,
+    param_shardings,
+    with_sharding,
+)
+
+__all__ = [
+    "MeshConfig",
+    "kv_cache_sharding",
+    "local_mesh",
+    "logical_to_sharding",
+    "make_mesh",
+    "param_shardings",
+    "with_sharding",
+]

@@ -1,0 +1,62 @@
+"""Logical-axis -> mesh-axis sharding rules.
+
+Parameters carry logical axis names; this module maps them onto the mesh
+(scaling-book recipe: annotate shardings, let XLA insert collectives):
+
+  embed vocab rows over tp; attention q heads over tp; kv heads over tp;
+  mlp hidden over tp; everything batch-like over dp. KV cache pages stay
+  replicated over dp (each dp rank owns its own pool) and kv-head-sharded
+  over tp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_TP
+
+# logical axis -> mesh axis (None = replicate)
+LOGICAL_RULES: dict[str, Optional[str]] = {
+    "vocab": AXIS_TP,
+    "embed": None,
+    "q_heads": AXIS_TP,
+    "kv_heads": AXIS_TP,
+    "head_dim": None,
+    "mlp": AXIS_TP,
+    "experts": "ep",
+    "layers": None,
+    "batch": AXIS_DP,
+    "seq": None,
+    "pages": None,
+    "page": None,
+}
+
+
+def spec_for(logical_axes: tuple[Optional[str], ...]) -> P:
+    return P(*(LOGICAL_RULES.get(a) if a else None for a in logical_axes))
+
+
+def logical_to_sharding(mesh: Mesh, logical_axes: tuple[Optional[str], ...]) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes))
+
+
+def param_shardings(mesh: Mesh, param_axes: Any) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: logical_to_sharding(mesh, axes),
+        param_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """KV pages: [layers, 2, pages, page, kv_heads, head_dim] — kv heads
+    over tp; pages replicated within a dp rank."""
+    return NamedSharding(mesh, P(None, None, None, None, AXIS_TP, None))
+
+
+def with_sharding(mesh: Mesh, value: Any, spec: P) -> Any:
+    return jax.lax.with_sharding_constraint(value, NamedSharding(mesh, spec))

@@ -100,6 +100,9 @@ _register("DYNT_LOGGING_JSONL", False, _bool,
 # Engine
 _register("DYNT_KV_BLOCK_SIZE", 16, _int,
           "Tokens per KV block (block-hash granularity and paged-KV page size)")
+_register("DYNT_JAX_PLATFORM", "", _str,
+          "Force the jax platform for engine processes (e.g. 'cpu'); wins "
+          "over a sitecustomize-frozen JAX_PLATFORMS")
 _register("DYNT_COMPILE_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache", _str,
           "Persistent XLA compilation cache dir")
 

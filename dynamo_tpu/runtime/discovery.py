@@ -133,7 +133,14 @@ class _MemStore:
         self.watches: list[tuple[str, Watch, asyncio.AbstractEventLoop]] = []
 
     def notify(self, event: KvEvent) -> None:
-        for prefix, watch, loop in list(self.watches):
+        for entry in list(self.watches):
+            prefix, watch, loop = entry
+            if loop.is_closed() or watch._cancelled:
+                try:
+                    self.watches.remove(entry)
+                except ValueError:
+                    pass
+                continue
             if event.key.startswith(prefix):
                 loop.call_soon_threadsafe(watch._emit, event)
 

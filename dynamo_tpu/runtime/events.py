@@ -100,7 +100,15 @@ class MemEventPublisher(EventPublisher):
         # msgpack round-trip keeps parity with the ZMQ transport
         data = msgpack.unpackb(msgpack.packb(payload, use_bin_type=True),
                                raw=False, strict_map_key=False)
-        for prefix, sub, loop in list(self._bus.subscribers):
+        for entry in list(self._bus.subscribers):
+            prefix, sub, loop = entry
+            if loop.is_closed() or sub._closed:
+                # Subscriber's loop died (e.g. a previous test's): prune.
+                try:
+                    self._bus.subscribers.remove(entry)
+                except ValueError:
+                    pass
+                continue
             if topic.startswith(prefix):
                 loop.call_soon_threadsafe(sub._emit, topic, data)
 

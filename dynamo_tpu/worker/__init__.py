@@ -1,0 +1,1 @@
+"""CLI alias: python -m dynamo_tpu.worker -> the TPU engine worker."""

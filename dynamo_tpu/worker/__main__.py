@@ -1,0 +1,5 @@
+import asyncio
+
+from ..engine.worker import main
+
+asyncio.run(main())

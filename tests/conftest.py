@@ -9,14 +9,22 @@ integration tier runs with the mocker, "no GPU required").
 import asyncio
 import os
 
-# Must be set before jax imports anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on a virtual 8-device CPU mesh and must NEVER touch a real
+# accelerator: the hosting environment may route jax to an exclusive-access
+# TPU tunnel (and may have pre-imported jax from sitecustomize with
+# JAX_PLATFORMS frozen to it), so env vars alone are not enough — override
+# the live jax config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,89 @@
+"""Migration operator tests (ref contract: lib/llm/src/migration.rs — retry
+a broken stream on another worker, preserving generated tokens; bounded by
+migration_limit)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.engine import Migration, TokenEngine
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.request_plane import ConnectionLost
+
+
+def _request(max_tokens=10):
+    return PreprocessedRequest(
+        request_id="r1",
+        token_ids=[1, 2, 3],
+        sampling=SamplingOptions(max_tokens=max_tokens),
+        stop=StopConditions(),
+    )
+
+
+class FlakyEngine(TokenEngine):
+    """Emits `per_attempt` tokens then drops the connection, until the final
+    attempt which completes. Records the requests it saw."""
+
+    def __init__(self, fail_times: int, per_attempt: int = 3) -> None:
+        self.fail_times = fail_times
+        self.per_attempt = per_attempt
+        self.attempts = 0
+        self.seen_requests: list[PreprocessedRequest] = []
+
+    async def generate(self, request):
+        self.attempts += 1
+        self.seen_requests.append(request)
+        base = 100 * self.attempts
+        for i in range(self.per_attempt):
+            yield EngineOutput(token_ids=[base + i])
+        if self.attempts <= self.fail_times:
+            raise ConnectionLost("worker died")
+        yield EngineOutput(token_ids=[999], finish_reason="stop")
+
+
+class TestMigration:
+    def test_stream_resumes_with_accumulated_tokens(self, run):
+        async def body():
+            inner = FlakyEngine(fail_times=1)
+            migration = Migration(inner, migration_limit=3)
+            outs = [o async for o in migration.generate(_request())]
+            tokens = [t for o in outs for t in o.token_ids]
+            # first attempt: 100,101,102 (then died); second: 200,201,202,999
+            assert tokens == [100, 101, 102, 200, 201, 202, 999]
+            assert outs[-1].finish_reason == "stop"
+            # The replayed request must carry the prior output tokens in its
+            # prompt and as prior_output_tokens, with max_tokens reduced.
+            replay = inner.seen_requests[1]
+            assert replay.token_ids == [1, 2, 3, 100, 101, 102]
+            assert replay.prior_output_tokens == [100, 101, 102]
+            assert replay.sampling.max_tokens == 10 - 3
+
+        run(body())
+
+    def test_migration_limit_yields_error(self, run):
+        async def body():
+            inner = FlakyEngine(fail_times=10)
+            migration = Migration(inner, migration_limit=2)
+            outs = [o async for o in migration.generate(_request(max_tokens=100))]
+            assert outs[-1].finish_reason == "error"
+            assert "migration limit" in outs[-1].error
+            assert inner.attempts == 3  # initial + 2 retries
+
+        run(body())
+
+    def test_budget_exhausted_during_retries(self, run):
+        async def body():
+            inner = FlakyEngine(fail_times=10, per_attempt=5)
+            migration = Migration(inner, migration_limit=5)
+            outs = [o async for o in migration.generate(_request(max_tokens=10))]
+            tokens = [t for o in outs for t in o.token_ids]
+            # two attempts of 5 tokens each exhaust max_tokens=10 -> length
+            assert len(tokens) == 10
+            assert outs[-1].finish_reason == "length"
+
+        run(body())
