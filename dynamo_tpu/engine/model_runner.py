@@ -66,6 +66,25 @@ def _enable_compile_cache() -> None:
         pass
 
 
+def _default_attention_fn(mesh: Mesh):
+    """Pallas flash-decode kernel on single-device TPU; XLA path otherwise.
+
+    The Pallas kernel assumes the KV pool is locally addressable; with a
+    tp-sharded cache the XLA path lets pjit partition attention across the
+    mesh (kernel-level tp via shard_map is a later optimization).
+    """
+    mode = env("DYNT_ATTENTION") or "auto"
+    if mode == "xla":
+        return None
+    backend = jax.default_backend()
+    multi = mesh.devices.size > 1
+    if mode == "pallas" or (mode == "auto" and backend == "tpu" and not multi):
+        from ..ops.paged_attention import paged_attention
+
+        return partial(paged_attention, interpret=(backend != "tpu"))
+    return None
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -80,6 +99,8 @@ class ModelRunner:
         self.model_config = model_config
         self.config = runner_config
         self.mesh = mesh
+        if attention_fn is None:
+            attention_fn = _default_attention_fn(mesh)
         self._attention_fn = attention_fn
         axes = param_axes(model_config)
         self._param_sharding = param_shardings(mesh, axes)
@@ -110,6 +131,9 @@ class ModelRunner:
 
         def step(params, kv, tokens, positions, block_tables, kv_lens,
                  active, temperature, top_p, top_k, seeds, step_idx):
+            # step_idx: [B] per-slot generated-token index, so a fixed
+            # request seed reproduces its stream independent of what other
+            # requests the engine is running.
             kv, logits = forward(
                 params, cfg, tokens[:, None], positions[:, None], kv,
                 block_tables, kv_lens, valid=active[:, None],
@@ -200,9 +224,12 @@ class ModelRunner:
         top_p: np.ndarray,
         top_k: np.ndarray,
         seeds: np.ndarray,
+        steps: Optional[np.ndarray] = None,  # [B] per-slot token index
     ) -> np.ndarray:
         """One decode step for all slots; returns sampled tokens [B]."""
         self.decode_steps += 1
+        if steps is None:
+            steps = np.zeros(len(tokens), np.int32)
         self.kv_cache, next_tokens = self._decode_fn(
             self.params, self.kv_cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
@@ -211,7 +238,7 @@ class ModelRunner:
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_p, jnp.float32), jnp.asarray(top_k, jnp.int32),
             jnp.asarray(seeds, jnp.uint32),
-            jnp.int32(self.decode_steps),
+            jnp.asarray(steps, jnp.int32),
         )
         return np.asarray(next_tokens)
 

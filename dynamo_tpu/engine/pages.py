@@ -76,6 +76,7 @@ class PagePool:
                 break
             if self._refcount.get(h, 0) == 0:
                 page = self._cached.pop(h)
+                self._refcount.pop(h, None)
                 self._free.append(page)
                 evicted_hashes.append(h)
                 freed += 1
@@ -87,18 +88,20 @@ class PagePool:
         """Try to place a sequence needing `total_pages` pages whose leading
         blocks hash to `block_hashes`. Returns None if it can't fit."""
         cached_n = self.match_prefix(block_hashes)
-        need = total_pages - cached_n
-        if need < 0:
-            need = 0
-        if len(self._free) < need:
-            self._evict(need - len(self._free))
-        if len(self._free) < need:
-            return None
+        # Pin the matched prefix BEFORE eviction so _evict can't free the
+        # pages this very request is about to reuse.
         cached_pages = []
         for h in block_hashes[:cached_n]:
             self._cached.move_to_end(h)
             self._refcount[h] = self._refcount.get(h, 0) + 1
             cached_pages.append(self._cached[h])
+        need = max(0, total_pages - cached_n)
+        if len(self._free) < need:
+            self._evict(need - len(self._free))
+        if len(self._free) < need:
+            for h in block_hashes[:cached_n]:  # doesn't fit: unpin
+                self._refcount[h] = max(0, self._refcount[h] - 1)
+            return None
         new_pages = [self._free.pop() for _ in range(need)]
         return PageAllocation(cached_pages=cached_pages, new_pages=new_pages,
                               cached_blocks=cached_n)
@@ -107,14 +110,21 @@ class PagePool:
         self,
         alloc: PageAllocation,
         block_hashes: list[int],
+        computed_blocks: Optional[int] = None,
     ) -> None:
         """Sequence finished: unpin reused prefix pages; register completed
         prompt blocks (beyond the reused prefix) into the prefix cache; free
-        the rest (decode-token pages)."""
+        the rest (decode-token pages).
+
+        `computed_blocks` caps registration to blocks whose KV was actually
+        written — a cancelled sequence must not advertise blocks that were
+        never prefilled (mocker has the same clamp)."""
         for h in block_hashes[: alloc.cached_blocks]:
             if h in self._refcount:
                 self._refcount[h] = max(0, self._refcount[h] - 1)
-        new_hashes = block_hashes[alloc.cached_blocks :]
+        if computed_blocks is None:
+            computed_blocks = len(block_hashes)
+        new_hashes = block_hashes[alloc.cached_blocks : computed_blocks]
         stored: list[int] = []
         for i, h in enumerate(new_hashes):
             if i >= len(alloc.new_pages):

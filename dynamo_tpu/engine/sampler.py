@@ -31,7 +31,9 @@ def sample(
     top_p: jax.Array,
     top_k: jax.Array,
     seeds: jax.Array,
-    step: jax.Array,  # scalar i32 — folded into per-slot keys
+    step: jax.Array,  # [B] or scalar i32 — per-slot token index folded into
+                      # the key so (seed, position) -> token is reproducible
+                      # regardless of what else the engine is running
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
     b, v = logits.shape
@@ -59,9 +61,10 @@ def sample(
     topp_mask = (scaled >= min_kept) | (top_p[:, None] >= 1.0)
 
     masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), seeds.shape)
     keys = jax.vmap(
-        lambda s: jax.random.fold_in(jax.random.PRNGKey(s), step)
-    )(seeds)
+        lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)
+    )(seeds, steps)
     sampled = jax.vmap(jax.random.categorical)(keys, masked)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 

@@ -98,6 +98,7 @@ class InferenceScheduler:
         self._top_p = np.ones(b, np.float32)
         self._top_k = np.zeros(b, np.int32)
         self._seeds = np.zeros(b, np.uint32)
+        self._steps = np.zeros(b, np.int32)
 
     # -- public (thread-safe) ---------------------------------------------
 
@@ -267,9 +268,11 @@ class InferenceScheduler:
             self._top_p[i] = s.top_p
             self._top_k[i] = s.top_k
             self._seeds[i] = seq.seed
+            self._steps[i] = len(seq.generated)
         next_tokens = self.runner.decode(
             self._tokens, self._positions, self._tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
+            self._steps,
         )
         count = 0
         for seq in ready:
@@ -301,7 +304,12 @@ class InferenceScheduler:
             if seq is None:
                 continue
             if seq.finished or seq.cancelled:
-                self.pool.release(seq.alloc, seq.block_hashes)
+                # Only blocks whose KV was actually computed may enter the
+                # prefix cache (a cancel mid-prefill leaves later blocks
+                # unwritten).
+                computed = seq.prefill_pos // self.page_size
+                self.pool.release(seq.alloc, seq.block_hashes,
+                                  computed_blocks=computed)
                 self._slots[i] = None
 
 

@@ -109,6 +109,7 @@ class TpuWorker:
         )
         self._tasks: list[asyncio.Task] = []
         self._served = None
+        self._clear_served = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     async def start(self) -> None:
@@ -141,7 +142,9 @@ class TpuWorker:
             .component(self.card.component)
             .endpoint("clear_kv_blocks")
         )
-        await clear_ep.serve_endpoint(self._clear_kv, instance_id=self.instance_id)
+        self._clear_served = await clear_ep.serve_endpoint(
+            self._clear_kv, instance_id=self.instance_id
+        )
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
@@ -153,6 +156,7 @@ class TpuWorker:
         yield {"cleared_blocks": len(cleared)}
 
     async def _event_drain(self, publisher, interval: float = 0.05) -> None:
+        self._drain_ticks = 0
         while True:
             await asyncio.sleep(interval)
             for event in self.events.drain():
@@ -160,8 +164,9 @@ class TpuWorker:
                     await publisher.publish(KV_EVENT_TOPIC, event.to_wire())
                 except Exception:  # noqa: BLE001
                     log.exception("kv event publish failed")
-            # periodic load metrics piggyback on the same cadence (1 in 10)
-            if self.scheduler is not None and self.runner.decode_steps % 1 == 0:
+            # load metrics on every 10th drain tick (~0.5s cadence)
+            self._drain_ticks += 1
+            if self.scheduler is not None and self._drain_ticks % 10 == 0:
                 active, waiting = self.scheduler.queue_depth()
                 metrics = LoadMetrics(
                     worker_id=self.instance_id,
@@ -210,6 +215,8 @@ class TpuWorker:
             self.scheduler.stop()
         if self._served is not None:
             await self._served.shutdown()
+        if self._clear_served is not None:
+            await self._clear_served.shutdown()
 
 
 async def main(argv: Optional[list[str]] = None) -> None:

@@ -1,0 +1,25 @@
+"""TPU kernels and fused ops (Pallas + XLA).
+
+This package is the TPU-native equivalent of the reference's CUDA kernel
+layer (ref: lib/llm/src/kernels/block_copy.cu, lib/kvbm-kernels/cuda/
+tensor_kernels.cu) plus the paged-attention kernels the reference inherits
+from its engines (vLLM/TRT-LLM). Everything here runs in two modes:
+
+  * compiled (Mosaic) on real TPU chips
+  * interpret mode on CPU, so the full kernel logic is unit-testable
+    against the pure-XLA reference implementations with zero chips
+"""
+
+from .paged_attention import paged_attention, paged_decode_attention
+from .block_copy import gather_kv_blocks, scatter_kv_blocks, swap_kv_blocks
+from .layout import universal_to_layered, layered_to_universal
+
+__all__ = [
+    "paged_attention",
+    "paged_decode_attention",
+    "gather_kv_blocks",
+    "scatter_kv_blocks",
+    "swap_kv_blocks",
+    "universal_to_layered",
+    "layered_to_universal",
+]

@@ -1,0 +1,76 @@
+"""Paged-KV block movement ops — TPU equivalent of the reference's
+`lib/llm/src/kernels/block_copy.cu` (strided scatter/gather copy kernels)
+and the `cudaMemcpyBatchAsync` paths in `lib/kvbm-kernels`.
+
+On TPU the idiomatic form is NOT a hand-rolled kernel: XLA compiles a
+jitted gather/scatter over the page dimension into batched HBM DMAs, which
+is exactly what the CUDA kernels hand-schedule. What matters is keeping
+everything inside one jit with the cache donated (in-place) and moving only
+int32 page-id vectors from the host. Host<->device tier movement (KVBM
+G1<->G2) uses `jax.device_put`/`device_get` on gathered page bundles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def gather_kv_blocks(kv_cache: jax.Array, page_ids: jax.Array) -> jax.Array:
+    """Pull pages out of the paged pool.
+
+    kv_cache: [L, 2, P, ps, kh, hd]; page_ids: [n] int32.
+    Returns a contiguous bundle [n, L, 2, ps, kh, hd] — the "universal"
+    block layout (page-major) used for transfer/offload, matching the role
+    of the reference's universal blocks (tensor_kernels.cu:33-58).
+    """
+    # [L, 2, n, ps, kh, hd] -> [n, L, 2, ps, kh, hd]
+    return kv_cache[:, :, page_ids].transpose(2, 0, 1, 3, 4, 5)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scatter_kv_blocks(
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd] (donated)
+    page_ids: jax.Array,  # [n] int32
+    blocks: jax.Array,  # [n, L, 2, ps, kh, hd]
+) -> jax.Array:
+    """Write a bundle of universal blocks into pool pages (onboard path)."""
+    blocks_pool = blocks.transpose(1, 2, 0, 3, 4, 5)  # [L, 2, n, ...]
+    return kv_cache.at[:, :, page_ids].set(
+        blocks_pool.astype(kv_cache.dtype)
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def swap_kv_blocks(
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd] (donated)
+    src_ids: jax.Array,  # [n] int32
+    dst_ids: jax.Array,  # [n] int32
+) -> jax.Array:
+    """Intra-pool page copy (defrag / prefix-cache COW), one fused scatter.
+    Equivalent of block_copy.cu copy_blocks_kernel."""
+    moved = kv_cache[:, :, src_ids]
+    return kv_cache.at[:, :, dst_ids].set(moved)
+
+
+def gather_to_host(kv_cache: jax.Array, page_ids: np.ndarray) -> np.ndarray:
+    """Device -> host offload of pages (KVBM G1 -> G2). The gather runs on
+    device (one fused DMA program), then a single contiguous D2H copy."""
+    bundle = gather_kv_blocks(kv_cache, jnp.asarray(page_ids, jnp.int32))
+    return np.asarray(jax.device_get(bundle))
+
+
+def scatter_from_host(
+    kv_cache: jax.Array, page_ids: np.ndarray, blocks: np.ndarray
+) -> jax.Array:
+    """Host -> device onboard of pages (KVBM G2 -> G1). One contiguous H2D
+    copy then a fused scatter into the pool."""
+    device = kv_cache.devices().pop() if hasattr(kv_cache, "devices") else None
+    dev_blocks = jax.device_put(blocks, device)
+    return scatter_kv_blocks(
+        kv_cache, jnp.asarray(page_ids, jnp.int32), dev_blocks
+    )

@@ -1,0 +1,62 @@
+"""KV block layout conversions — TPU equivalent of the reference's
+`lib/kvbm-kernels/cuda/tensor_kernels.cu` (universal <-> NHD/HND <->
+operational layout conversion kernels, batched over blocks).
+
+Layouts:
+  * universal   [n, L, 2, ps, kh, hd]   — page-major transfer bundles
+                 (what `ops.block_copy.gather_kv_blocks` produces)
+  * layered     [L, 2, n, ps, kh, hd]   — pool layout slice ("operational")
+  * NHD         [n, L, 2, ps, kh*hd]    — flattened head dim, the wire
+                 layout for cross-mesh transfer where the receiver may have
+                 a different TP sharding (heads must be contiguous to
+                 re-split; ref kvbm-design.md "Metadata Exchange")
+
+These are jitted reshape/transposes: XLA lowers them to tiled HBM copies,
+the same job the CUDA kernels do by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def universal_to_layered(blocks: jax.Array) -> jax.Array:
+    """[n, L, 2, ps, kh, hd] -> [L, 2, n, ps, kh, hd]."""
+    return blocks.transpose(1, 2, 0, 3, 4, 5)
+
+
+@jax.jit
+def layered_to_universal(pool_slice: jax.Array) -> jax.Array:
+    """[L, 2, n, ps, kh, hd] -> [n, L, 2, ps, kh, hd]."""
+    return pool_slice.transpose(2, 0, 1, 3, 4, 5)
+
+
+@jax.jit
+def universal_to_nhd(blocks: jax.Array) -> jax.Array:
+    """[n, L, 2, ps, kh, hd] -> [n, L, 2, ps, kh*hd] wire layout."""
+    n, layers, two, ps, kh, hd = blocks.shape
+    return blocks.reshape(n, layers, two, ps, kh * hd)
+
+
+def nhd_to_universal(wire: jax.Array, kv_heads: int) -> jax.Array:
+    """[n, L, 2, ps, kh*hd] -> [n, L, 2, ps, kh, hd]."""
+    n, layers, two, ps, flat = wire.shape
+    return wire.reshape(n, layers, two, ps, kv_heads, flat // kv_heads)
+
+
+def reshard_heads(
+    blocks: jax.Array,  # [n, L, 2, ps, kh_local, hd]
+    src_shards: int,
+    dst_shards: int,
+    shard_index: int,
+) -> jax.Array:
+    """Bridge TP-mismatched prefill/decode pools: given the FULL head set
+    (src_shards * kh_local heads, already concatenated), return the slice
+    of heads dst shard `shard_index` owns. Ref: kvbm-design.md "Worker 1
+    TP=4, Worker 2 TP=8" metadata-exchange scenario."""
+    n, layers, two, ps, kh_total, hd = blocks.shape
+    per_dst = kh_total // dst_shards
+    start = shard_index * per_dst
+    return jax.lax.dynamic_slice_in_dim(blocks, start, per_dst, axis=4)

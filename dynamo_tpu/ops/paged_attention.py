@@ -1,0 +1,187 @@
+"""Pallas paged-attention kernels for TPU.
+
+The decode hot loop of the engine: every step, each active sequence's single
+query token attends over its paged KV cache via a block table. The reference
+stack gets this from its engines' CUDA kernels (vLLM PagedAttention); here it
+is a TPU-first Pallas kernel:
+
+  * grid = (batch, kv_heads, page_chunks); the page dimension of the KV
+    pools is blocked by the page size and indexed THROUGH the block table
+    using scalar prefetch (`PrefetchScalarGridSpec`), so the kernel only
+    ever streams the pages a sequence actually owns — HBM -> VMEM DMA per
+    grid step, overlapped by the Pallas pipeline.
+  * online-softmax (flash) accumulation in fp32 VMEM scratch across page
+    chunks; output written on the last chunk.
+  * GQA: q-heads grouped per kv-head; the group dim rides the MXU sublanes.
+
+On CPU (tests, dev boxes) the same kernel runs in interpret mode; the
+pure-XLA fallback (`models.transformer.paged_attention_xla`) remains the
+reference oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_pages] int32 (SMEM)
+    kv_lens_ref,  # [B] int32 (SMEM)
+    # inputs (blocked)
+    q_ref,  # [1, 1, group, head_dim]  (this b, this kv head)
+    k_ref,  # [1, 1, page_size, head_dim] (the page this grid step covers)
+    v_ref,  # [1, 1, page_size, head_dim]
+    # output
+    o_ref,  # [1, 1, group, head_dim]
+    # scratch
+    m_ref,  # [group, 128] fp32 running max (broadcast over lanes)
+    l_ref,  # [group, 128] fp32 running denom
+    acc_ref,  # [group, head_dim] fp32 accumulator
+):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    page_size = k_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+    start = p * page_size
+
+    @pl.when(start < kv_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        v = v_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group, ps]
+        token_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        scores = jnp.where(token_pos < kv_len, scores, -jnp.inf)
+
+        m_prev = m_ref[:, 0:1]  # [group, 1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)  # [group, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # probs relative to the new max; correction for the old accumulator
+        probs = jnp.exp(scores - m_new)  # [group, ps]
+        alpha = jnp.exp(m_prev - m_new)  # [group, 1]
+        l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, hd]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(
+    q: jax.Array,  # [B, qh, hd] one query token per sequence
+    k_pages: jax.Array,  # [P, ps, kh, hd]
+    v_pages: jax.Array,  # [P, ps, kh, hd]
+    block_tables: jax.Array,  # [B, max_pages] int32
+    kv_lens: jax.Array,  # [B] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash decode attention over paged KV. Returns [B, qh, hd]."""
+    b, qh, hd = q.shape
+    _, ps, kh, _ = k_pages.shape
+    group = qh // kh
+    max_pages = block_tables.shape[1]
+
+    # [P, ps, kh, hd] -> [kh, P, ps, hd]: the page-id dim must be a leading
+    # blocked dim so the block table can index it, and kv-head its own grid
+    # axis so each step DMAs only one head's page slice.
+    kp = k_pages.transpose(2, 0, 1, 3)
+    vp = v_pages.transpose(2, 0, 1, 3)
+    qg = q.reshape(b, kh, group, hd)
+
+    grid = (b, kh, max_pages)
+
+    def q_map(bi, hi, pi, bt, kl):
+        del pi, bt, kl
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, pi, bt, kl):
+        del kl
+        return (hi, bt[bi, pi], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd), q_map),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, hd), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        _decode_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, group, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qg, kp, vp)
+    return out.reshape(b, qh, hd)
+
+
+def paged_attention(
+    q: jax.Array,  # [B, T, qh, hd]
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer: int,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in `attention_fn` for `models.transformer.forward`.
+
+    Decode (T == 1) runs the Pallas flash-decode kernel; prefill chunks
+    (T > 1) use the XLA path (compute-bound; XLA's fused SDPA is already
+    MXU-shaped there — ref SURVEY §7 "hard parts").
+    """
+    from ..models.transformer import paged_attention_xla
+
+    if q.shape[1] != 1:
+        return paged_attention_xla(q, kv_cache, layer, block_tables,
+                                   positions, kv_lens)
+    out = paged_decode_attention(
+        q[:, 0], kv_cache[layer, 0], kv_cache[layer, 1],
+        block_tables, kv_lens, interpret=interpret,
+    )
+    return out[:, None]

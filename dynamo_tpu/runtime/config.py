@@ -105,6 +105,9 @@ _register("DYNT_JAX_PLATFORM", "", _str,
           "over a sitecustomize-frozen JAX_PLATFORMS")
 _register("DYNT_COMPILE_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache", _str,
           "Persistent XLA compilation cache dir")
+_register("DYNT_ATTENTION", "auto", _str,
+          "Attention kernel: auto | pallas | xla (auto = Pallas flash-decode "
+          "on single-device TPU, XLA reference path elsewhere)")
 
 # Router
 _register("DYNT_ROUTER_OVERLAP_WEIGHT", 1.0, _float,

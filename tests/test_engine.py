@@ -98,6 +98,52 @@ class TestPagePool:
         pool = PagePool(4)
         assert pool.allocate([], 10) is None
 
+    def test_eviction_never_frees_just_matched_prefix(self):
+        """Regression: allocate() must pin the matched prefix before
+        evicting, or eviction can free the pages the request reuses."""
+        pool = PagePool(8)  # 7 usable pages
+        a1 = pool.allocate([1, 2, 3], 3)
+        pool.release(a1, [1, 2, 3])
+        a2 = pool.allocate([4, 5, 6, 7], 4)
+        pool.release(a2, [4, 5, 6, 7])
+        assert pool.free_count() == 0
+        # Matches [1,2,3] (the LRU-oldest cached blocks) and needs 3 more
+        # pages, which forces eviction while the match is live.
+        a3 = pool.allocate([1, 2, 3], 6)
+        assert a3 is not None
+        assert a3.cached_blocks == 3
+        assert set(a3.cached_pages).isdisjoint(set(a3.new_pages))
+        # the matched hashes must still be cached (not evicted)
+        assert pool.match_prefix([1, 2, 3]) == 3
+
+    def test_failed_allocate_unpins_prefix(self):
+        pool = PagePool(6)  # 5 usable
+        a1 = pool.allocate([1, 2], 2)
+        pool.release(a1, [1, 2])
+        # needs 8 new pages: impossible -> None, and [1,2] must be unpinned
+        assert pool.allocate([1, 2], 10) is None
+        a2 = pool.allocate([9, 10], 5)  # evicting 1,2 must be possible
+        assert a2 is not None
+
+    def test_evict_clears_refcount_entries(self):
+        pool = PagePool(8)
+        a1 = pool.allocate([1, 2, 3], 3)
+        pool.release(a1, [1, 2, 3])
+        pool._evict(3)
+        assert all(h not in pool._refcount for h in (1, 2, 3))
+
+    def test_release_clamps_to_computed_blocks(self):
+        """Regression: a cancelled sequence must not register blocks whose
+        KV was never computed."""
+        stored = []
+        pool = PagePool(16, on_stored=lambda h, p: stored.append(list(h)))
+        alloc = pool.allocate([1, 2, 3, 4], 6)
+        pool.release(alloc, [1, 2, 3, 4], computed_blocks=2)
+        assert stored == [[1, 2]]
+        assert pool.match_prefix([1, 2, 3, 4]) == 2
+        # all non-registered pages returned to the free list
+        assert pool.free_count() + pool.cached_count() == 15
+
 
 @pytest.fixture(scope="module")
 def runner():
@@ -124,6 +170,30 @@ class TestModelRunner:
             for seed in range(12)
         }
         assert len(toks) > 1  # high temperature: not all identical
+
+    def test_seeded_sampling_reproducible_across_runner_state(self, runner):
+        """Regression: the sampling key must depend only on (seed, per-slot
+        step index), not on the runner-global decode counter."""
+        bt = np.zeros((1, 16), np.int32)
+        bt[0, :4] = [9, 10, 11, 12]
+        args = dict(
+            positions=np.array([7], np.int32),
+            block_tables=bt, kv_lens=np.array([8], np.int32),
+            active=np.array([True]),
+            temperature=np.array([5.0], np.float32),
+            top_p=np.array([1.0], np.float32),
+            top_k=np.array([0], np.int32),
+            seeds=np.array([42], np.uint32),
+            steps=np.array([3], np.int32),
+        )
+        t1 = runner.decode(np.array([5], np.int32), **args)
+        # interleave unrelated decode steps to advance global state
+        for _ in range(3):
+            runner.decode(np.array([1], np.int32), **{
+                **args, "seeds": np.array([7], np.uint32),
+                "steps": np.array([9], np.int32)})
+        t2 = runner.decode(np.array([5], np.int32), **args)
+        assert int(t1[0]) == int(t2[0])
 
 
 class TestScheduler:
