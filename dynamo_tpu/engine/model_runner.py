@@ -242,6 +242,34 @@ class ModelRunner:
         )
         return np.asarray(next_tokens)
 
+    def gather_pages(self, page_ids: np.ndarray) -> np.ndarray:
+        """Pull pages to host in universal layout [n, L, 2, ps, kh, hd]
+        (disagg prefill export / KVBM offload). Must run on the scheduler
+        thread — the KV cache buffer is donated through every step."""
+        from ..ops.block_copy import gather_to_host
+
+        return gather_to_host(self.kv_cache, np.asarray(page_ids, np.int32))
+
+    def scatter_pages(self, page_ids: np.ndarray, blocks: np.ndarray) -> None:
+        """Write host block bundle into pool pages (disagg decode onboard /
+        KVBM onboard). Scheduler thread only (donation)."""
+        from ..ops.block_copy import scatter_from_host
+
+        self.kv_cache = scatter_from_host(
+            self.kv_cache, np.asarray(page_ids, np.int32), blocks
+        )
+
+    def kv_layout(self) -> dict:
+        """Wire-layout descriptor of this runner's paged pool."""
+        cfg = self.model_config
+        return {
+            "n_layers": cfg.n_layers,
+            "kv_heads": cfg.n_kv_heads,
+            "head_dim": cfg.head_dim,
+            "page_size": self.config.page_size,
+            "dtype": str(jnp.dtype(cfg.dtype).name),
+        }
+
     def warmup(self) -> None:
         """Compile decode + smallest prefill bucket ahead of traffic."""
         b = self.config.max_batch

@@ -47,6 +47,15 @@ class _Seq:
     cancelled: bool = False
     finished: bool = False
     seed: int = 0
+    # Disagg: prefill-only sequences stop after the first sampled token and
+    # hand their pages to the transfer table instead of releasing them.
+    prefill_only: bool = False
+    on_prefill_done: Optional[Callable[["_Seq", int, list[int]], dict]] = None
+    keep_pages: bool = False  # reap skips pool.release (transfer owns them)
+    # Disagg decode side: KV blocks pulled from the prefill pool + the
+    # token it sampled; admission scatters instead of prefilling.
+    onboard_blocks: Optional[np.ndarray] = None
+    onboard_first_token: Optional[int] = None
 
     @property
     def decode_ready(self) -> bool:
@@ -83,6 +92,7 @@ class InferenceScheduler:
         self._slots: list[Optional[_Seq]] = [None] * cfg.max_batch
         self._waiting: list[_Seq] = []
         self._incoming: thread_queue.Queue = thread_queue.Queue()
+        self._control: thread_queue.Queue = thread_queue.Queue()
         self._wake = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -118,11 +128,38 @@ class InferenceScheduler:
         self,
         request: PreprocessedRequest,
         emit: Callable[[EngineOutput], None],
+        *,
+        prefill_only: bool = False,
+        on_prefill_done: Optional[Callable] = None,
+        onboard_blocks: Optional[np.ndarray] = None,
+        onboard_first_token: Optional[int] = None,
     ) -> "_SubmitHandle":
         handle = _SubmitHandle()
-        self._incoming.put((request, emit, handle))
+        self._incoming.put((request, emit, handle, {
+            "prefill_only": prefill_only,
+            "on_prefill_done": on_prefill_done,
+            "onboard_blocks": onboard_blocks,
+            "onboard_first_token": onboard_first_token,
+        }))
         self._wake.set()
         return handle
+
+    def run_in_step(self, fn: Callable[[], object]) -> "thread_queue.Queue":
+        """Run `fn` on the scheduler thread between steps (the KV cache
+        buffer is donated through every compiled step, so any gather/
+        scatter/release must be serialized with stepping). Returns a
+        1-item queue carrying (result, exception)."""
+        out: thread_queue.Queue = thread_queue.Queue(1)
+
+        def wrapped() -> None:
+            try:
+                out.put((fn(), None))
+            except Exception as exc:  # noqa: BLE001 — delivered to caller
+                out.put((None, exc))
+
+        self._control.put(wrapped)
+        self._wake.set()
+        return out
 
     def queue_depth(self) -> tuple[int, int]:
         active = sum(1 for s in self._slots if s is not None)
@@ -134,20 +171,33 @@ class InferenceScheduler:
         log.info("scheduler loop up (max_batch=%d pages=%d)",
                  self.max_batch, self.pool.num_pages)
         while not self._stop:
+            self._drain_control()
             self._drain_incoming()
             progressed = self._step()
             if not progressed:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
+    def _drain_control(self) -> None:
+        while True:
+            try:
+                fn = self._control.get_nowait()
+            except thread_queue.Empty:
+                return
+            fn()
+
     def _drain_incoming(self) -> None:
         while True:
             try:
-                request, emit, handle = self._incoming.get_nowait()
+                request, emit, handle, extra = self._incoming.get_nowait()
             except thread_queue.Empty:
                 return
             seq = self._prepare(request, emit)
             if seq is not None:
+                seq.prefill_only = extra.get("prefill_only", False)
+                seq.on_prefill_done = extra.get("on_prefill_done")
+                seq.onboard_blocks = extra.get("onboard_blocks")
+                seq.onboard_first_token = extra.get("onboard_first_token")
                 handle.seq = seq
                 if handle._cancelled:  # cancelled before the seq existed
                     seq.cancelled = True
@@ -204,6 +254,26 @@ class InferenceScheduler:
             seq.slot = free_slots[0]
             self._slots[seq.slot] = seq
             self._waiting.pop(0)
+            if seq.onboard_blocks is not None:
+                self._onboard(seq)
+
+    def _onboard(self, seq: _Seq) -> None:
+        """Disagg decode side: scatter pulled prefill KV into this pool and
+        enter decode directly (no prefill pass). Cached prefix pages already
+        hold identical KV (same hash chain => same tokens); only the
+        non-cached suffix is written."""
+        n_prompt_pages = -(-seq.prompt_len // self.page_size)
+        blocks = seq.onboard_blocks
+        cached_n = min(seq.alloc.cached_blocks, n_prompt_pages)
+        target_pages = seq.block_table[cached_n:n_prompt_pages]
+        part = blocks[cached_n:n_prompt_pages]
+        if len(target_pages):
+            self.runner.scatter_pages(np.asarray(target_pages, np.int32),
+                                      part)
+        seq.onboard_blocks = None  # free host memory
+        seq.prefill_pos = seq.prompt_len
+        self._append_token(seq, int(seq.onboard_first_token),
+                           prompt_tokens=seq.prompt_len)
 
     def _step(self) -> bool:
         start = time.monotonic()
@@ -242,10 +312,42 @@ class InferenceScheduler:
             )
             seq.prefill_pos += chunk
             if is_final:
-                self._append_token(seq, token,
-                                   prompt_tokens=seq.prompt_len)
+                if seq.prefill_only:
+                    self._finish_prefill_only(seq, token)
+                else:
+                    self._append_token(seq, token,
+                                       prompt_tokens=seq.prompt_len)
             return chunk
         return 0
+
+    def _finish_prefill_only(self, seq: _Seq, first_token: int) -> None:
+        """Disagg prefill side: park the prompt pages with the transfer
+        table (via on_prefill_done) and answer with kv_transfer_params
+        instead of decoding (ref §3.4: prefill returns
+        disaggregated_params; decode pulls the blocks)."""
+        n_prompt_pages = -(-seq.prompt_len // self.page_size)
+        page_ids = [int(p) for p in seq.block_table[:n_prompt_pages]]
+        params: dict = {}
+        if seq.on_prefill_done is not None:
+            params = seq.on_prefill_done(seq, first_token, page_ids)
+            seq.keep_pages = True
+        seq.finished = True
+        seq.emit(EngineOutput(
+            token_ids=[], finish_reason="stop",
+            prompt_tokens=seq.prompt_len,
+            kv_transfer_params={**params, "first_token": first_token},
+        ))
+
+    def release_transfer_pages(self, seq: _Seq) -> None:
+        """Deferred release for a prefill-only sequence once its transfer
+        completes/expires. Thread-safe (routed through the control queue)."""
+        def _do() -> None:
+            computed = seq.prefill_pos // self.page_size
+            self.pool.release(seq.alloc, seq.block_hashes,
+                              computed_blocks=computed)
+
+        self._control.put(_do)
+        self._wake.set()
 
     def _decode_all(self) -> int:
         ready = [s for s in self._slots
@@ -304,12 +406,13 @@ class InferenceScheduler:
             if seq is None:
                 continue
             if seq.finished or seq.cancelled:
-                # Only blocks whose KV was actually computed may enter the
-                # prefix cache (a cancel mid-prefill leaves later blocks
-                # unwritten).
-                computed = seq.prefill_pos // self.page_size
-                self.pool.release(seq.alloc, seq.block_hashes,
-                                  computed_blocks=computed)
+                if not seq.keep_pages:
+                    # Only blocks whose KV was actually computed may enter
+                    # the prefix cache (a cancel mid-prefill leaves later
+                    # blocks unwritten).
+                    computed = seq.prefill_pos // self.page_size
+                    self.pool.release(seq.alloc, seq.block_hashes,
+                                      computed_blocks=computed)
                 self._slots[i] = None
 
 

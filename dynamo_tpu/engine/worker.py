@@ -22,7 +22,20 @@ from ..kv_router.protocols import (
     LoadMetrics,
     RouterEvent,
 )
-from ..llm.model_card import CHAT, COMPLETIONS, ModelDeploymentCard, publish_card
+from ..llm.kv_transfer import (
+    BlockAssembler,
+    KvLayoutDescriptor,
+    PendingTransfer,
+    PendingTransferTable,
+    encode_block_chunks,
+)
+from ..llm.model_card import (
+    CHAT,
+    COMPLETIONS,
+    PREFILL,
+    ModelDeploymentCard,
+    publish_card,
+)
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..models import get_config
 from ..parallel import MeshConfig, make_mesh
@@ -85,6 +98,7 @@ class TpuWorker:
         mesh_config: Optional[MeshConfig] = None,
         attention_fn=None,
         warmup: bool = True,
+        mode: str = "aggregated",  # aggregated | prefill | decode
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -92,12 +106,16 @@ class TpuWorker:
         self.runner_config = runner_config or RunnerConfig()
         self.mesh = make_mesh(mesh_config or MeshConfig())
         self._warmup = warmup
+        self.mode = mode
+        self.transfers = PendingTransferTable()
         self.events = KvEventBuffer(self.instance_id)
         self.runner: Optional[ModelRunner] = None
         self.scheduler: Optional[InferenceScheduler] = None
+        model_types = ([PREFILL] if mode == "prefill"
+                       else [CHAT, COMPLETIONS])
         self.card = ModelDeploymentCard(
             name=served_name or self.model_config.name,
-            model_types=[CHAT, COMPLETIONS],
+            model_types=model_types,
             namespace=namespace,
             component=component,
             endpoint="generate",
@@ -110,6 +128,8 @@ class TpuWorker:
         self._tasks: list[asyncio.Task] = []
         self._served = None
         self._clear_served = None
+        self._pull_served = None
+        self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     async def start(self) -> None:
@@ -145,6 +165,15 @@ class TpuWorker:
         self._clear_served = await clear_ep.serve_endpoint(
             self._clear_kv, instance_id=self.instance_id
         )
+        if self.mode == "prefill":
+            pull_ep = (
+                self.runtime.namespace(self.card.namespace)
+                .component(self.card.component)
+                .endpoint("kv_pull")
+            )
+            self._pull_served = await pull_ep.serve_endpoint(
+                self._kv_pull, instance_id=self.instance_id
+            )
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
@@ -154,6 +183,108 @@ class TpuWorker:
     async def _clear_kv(self, body, ctx) -> AsyncIterator[dict]:
         cleared = self.scheduler.pool.clear()
         yield {"cleared_blocks": len(cleared)}
+
+    # -- disaggregation: prefill-side export -------------------------------
+
+    def _register_transfer(self, seq, first_token: int,
+                           page_ids: list[int]) -> dict:
+        """Runs on the scheduler thread when a prefill-only sequence
+        finishes its prompt pass: park the pages with the transfer table
+        and describe the pull route (ref §3.4 disaggregated_params)."""
+        import uuid as _uuid
+
+        transfer_id = _uuid.uuid4().hex
+        layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
+        self.transfers.add(PendingTransfer(
+            transfer_id=transfer_id,
+            page_ids=page_ids,
+            release=lambda: self.scheduler.release_transfer_pages(seq),
+            layout=layout,
+            prompt_len=seq.prompt_len,
+        ))
+        return {
+            "transfer_id": transfer_id,
+            "namespace": self.card.namespace,
+            "component": self.card.component,
+            "instance_id": self.instance_id,
+            "layout": layout.to_wire(),
+            "prompt_len": seq.prompt_len,
+        }
+
+    async def _kv_pull(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        """Decode workers pull parked prefill KV here: gather the pages on
+        the scheduler thread (the cache buffer is donated through steps),
+        then stream chunked binary frames."""
+        transfer_id = (body or {}).get("transfer_id", "")
+        # Claim removes the entry atomically: TTL expiry can no longer
+        # release (and let the pool reuse) these pages mid-gather.
+        transfer = self.transfers.claim(transfer_id)
+        if transfer is None:
+            yield {"error": f"unknown transfer {transfer_id}"}
+            return
+        try:
+            page_ids = transfer.page_ids
+            resultq = self.scheduler.run_in_step(
+                lambda: self.runner.gather_pages(page_ids)
+            )
+            blocks, exc = await asyncio.to_thread(resultq.get)
+            if exc is not None:
+                yield {"error": f"gather failed: {exc!r}"}
+                return
+            for frame in encode_block_chunks(blocks, transfer.layout):
+                yield frame
+        finally:
+            # Runs even when the decode side disconnects mid-stream (the
+            # generator is aclose()d): pages go back to the pool now, not
+            # after the TTL.
+            transfer.release()
+
+    # -- disaggregation: decode-side onboard -------------------------------
+
+    async def _pull_remote_kv(self, params: dict):
+        """Pull prefill KV blocks from the prefill worker. Returns the
+        assembled bundle or None (caller falls back to local prefill —
+        the aggregated-recompute fallback the reference also takes when
+        transfer fails)."""
+        from ..runtime.push_router import PushRouter
+
+        if params.get("mock") or "layout" not in params:
+            return None  # mocker handoff carries no data; recompute
+        remote_layout = KvLayoutDescriptor.from_wire(params["layout"])
+        local_layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
+        if not remote_layout.compatible(local_layout):
+            log.warning("kv layout mismatch (remote=%s local=%s); "
+                        "recomputing prefill", remote_layout, local_layout)
+            return None
+        subject = f"{params['namespace']}/{params['component']}/kv_pull"
+        router = self._pull_clients.get(subject)
+        if router is None:
+            endpoint = (
+                self.runtime.namespace(params["namespace"])
+                .component(params["component"])
+                .endpoint("kv_pull")
+            )
+            router = PushRouter(endpoint.client(), mode="round_robin")
+            await router.client.start()
+            self._pull_clients[subject] = router
+        assembler = BlockAssembler()
+        try:
+            async for frame in router.generate(
+                {"transfer_id": params["transfer_id"]},
+                instance_id=params["instance_id"],
+            ):
+                if frame.get("error"):
+                    log.warning("kv pull failed: %s", frame["error"])
+                    return None
+                assembler.add(frame)
+        except Exception:  # noqa: BLE001 — any transfer failure -> recompute
+            log.exception("kv pull transport failure; recomputing prefill")
+            return None
+        if not assembler.complete:
+            log.warning("kv pull incomplete; recomputing prefill")
+            return None
+        blocks, _ = assembler.assemble()
+        return blocks
 
     async def _event_drain(self, publisher, interval: float = 0.05) -> None:
         self._drain_ticks = 0
@@ -166,6 +297,11 @@ class TpuWorker:
                     log.exception("kv event publish failed")
             # load metrics on every 10th drain tick (~0.5s cadence)
             self._drain_ticks += 1
+            if self._drain_ticks % 40 == 0:
+                try:
+                    self.transfers.expire_stale()
+                except Exception:  # noqa: BLE001 — drain task must survive
+                    log.exception("transfer expiry failed")
             if self.scheduler is not None and self._drain_ticks % 10 == 0:
                 active, waiting = self.scheduler.queue_depth()
                 metrics = LoadMetrics(
@@ -197,7 +333,25 @@ class TpuWorker:
         def emit(output: EngineOutput) -> None:
             loop.call_soon_threadsafe(out_queue.put_nowait, output)
 
-        handle = self.scheduler.submit(request, emit)
+        submit_kwargs: dict = {}
+        prefill_only = (self.mode == "prefill"
+                        or bool(request.annotations.get("prefill_only")))
+        if prefill_only:
+            submit_kwargs = {
+                "prefill_only": True,
+                "on_prefill_done": self._register_transfer,
+            }
+        elif request.disaggregated_params:
+            blocks = await self._pull_remote_kv(request.disaggregated_params)
+            if blocks is not None:
+                submit_kwargs = {
+                    "onboard_blocks": blocks,
+                    "onboard_first_token":
+                        request.disaggregated_params["first_token"],
+                }
+            # else: fall through — plain submit recomputes the prefill
+
+        handle = self.scheduler.submit(request, emit, **submit_kwargs)
         try:
             while True:
                 output: EngineOutput = await out_queue.get()
@@ -217,6 +371,10 @@ class TpuWorker:
             await self._served.shutdown()
         if self._clear_served is not None:
             await self._clear_served.shutdown()
+        if self._pull_served is not None:
+            await self._pull_served.shutdown()
+        for router in self._pull_clients.values():
+            await router.client.close()
 
 
 async def main(argv: Optional[list[str]] = None) -> None:
@@ -237,15 +395,23 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--max-pages-per-seq", type=int, default=128)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--mode", default="aggregated",
+                        choices=["aggregated", "prefill", "decode"],
+                        help="disaggregated role (prefill workers register "
+                             "ModelType prefill under their own component)")
     args = parser.parse_args(argv)
 
+    component = args.component
+    if args.mode == "prefill" and component == "backend":
+        component = "prefill"
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = TpuWorker(
         runtime,
         model_name=args.model,
         served_name=args.served_model_name,
         namespace=args.namespace,
-        component=args.component,
+        component=component,
+        mode=args.mode,
         runner_config=RunnerConfig(
             page_size=args.page_size, num_pages=args.num_pages,
             max_batch=args.max_batch,

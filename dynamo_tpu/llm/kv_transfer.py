@@ -1,0 +1,172 @@
+"""Disaggregated KV handoff: layout descriptors + transfer protocol.
+
+The reference moves KV from prefill GPU to decode GPU with NIXL RDMA
+(ref: docs/design-docs/disagg-serving.md; dynamo.nixl_connect). On TPU there
+are no RDMA verbs; the v1 data plane is a host-relay DCN transfer —
+
+    prefill HBM --(fused gather, one D2H DMA)--> host --(request plane,
+    chunked binary frames)--> decode host --(one H2D + fused scatter)--> HBM
+
+with a serialized layout descriptor bridging the two pools exactly like the
+reference's `SerializedNixlBlockLayout` (kvbm-design.md §Remote Memory
+Integration). Intra-mesh ICI collective-permute handoff is the v2 fast path
+(parallel/transfer planning); this module owns the wire protocol + the
+prefill-side pending-transfer registry either path shares.
+
+Flow (ref §3.4): PrefillRouter sends the prompt to a prefill worker with
+max_tokens=1 + annotation `prefill_only`; the prefill engine parks the
+sequence's pages in a PendingTransferTable and answers with
+`kv_transfer_params` (transfer id + route + layout + first token). The
+decode worker pulls the blocks over its `kv_pull` endpoint before admitting
+the sequence, then decodes from position prompt_len.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+# Target bytes per kv_pull response frame (well under codec MAX_FRAME).
+TRANSFER_CHUNK_BYTES = 4 << 20
+
+
+@dataclasses.dataclass
+class KvLayoutDescriptor:
+    """Serialized block-layout metadata exchanged between pools."""
+
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int
+    dtype: str  # numpy dtype name of the wire payload
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "KvLayoutDescriptor":
+        return cls(**{f.name: data[f.name] for f in dataclasses.fields(cls)})
+
+    def page_bytes(self) -> int:
+        return (self.n_layers * 2 * self.page_size * self.kv_heads
+                * self.head_dim * np.dtype(self.dtype).itemsize)
+
+    def compatible(self, other: "KvLayoutDescriptor") -> bool:
+        return self == other
+
+
+@dataclasses.dataclass
+class PendingTransfer:
+    transfer_id: str
+    page_ids: list[int]  # physical pages in the prefill pool, page order
+    release: Callable[[], None]  # returns the pages to the prefill pool
+    layout: KvLayoutDescriptor
+    prompt_len: int
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+    pulled: bool = False
+
+
+class PendingTransferTable:
+    """Prefill-side registry of sequences awaiting pull. Entries hold their
+    pages pinned until pulled or expired (the reference leans on engine-side
+    kv_transfer timeouts the same way).
+
+    Thread-safe: `add` runs on the engine scheduler thread while pulls and
+    TTL expiry run on the event loop. A pull `claim`s its entry (removing it
+    atomically) so expiry can never release pages a gather is reading; the
+    claimer owns exactly one release."""
+
+    def __init__(self, ttl_secs: float = 120.0) -> None:
+        self.ttl_secs = ttl_secs
+        self._table: dict[str, PendingTransfer] = {}
+        self._lock = threading.Lock()
+
+    def add(self, transfer: PendingTransfer) -> None:
+        with self._lock:
+            self._table[transfer.transfer_id] = transfer
+
+    def claim(self, transfer_id: str) -> Optional[PendingTransfer]:
+        """Atomically take ownership of an entry (pull path). The caller
+        must call `.release()` exactly once when done with the pages."""
+        with self._lock:
+            return self._table.pop(transfer_id, None)
+
+    def expire_stale(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            stale = [tid for tid, t in self._table.items()
+                     if now - t.created_at > self.ttl_secs]
+            claimed = [self._table.pop(tid) for tid in stale]
+        for transfer in claimed:
+            transfer.release()
+        return len(claimed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+
+def encode_block_chunks(
+    blocks: np.ndarray,  # [n, L, 2, ps, kh, hd] universal layout
+    layout: KvLayoutDescriptor,
+) -> Iterator[dict]:
+    """Chunk a block bundle into wire frames: msgpack dicts with raw bytes.
+    Chunk size targets TRANSFER_CHUNK_BYTES so large prompts stream instead
+    of building one giant frame."""
+    n = blocks.shape[0]
+    pages_per_chunk = max(1, TRANSFER_CHUNK_BYTES // max(1, layout.page_bytes()))
+    total_chunks = -(-n // pages_per_chunk)
+    for ci in range(total_chunks):
+        lo = ci * pages_per_chunk
+        hi = min(n, lo + pages_per_chunk)
+        part = np.ascontiguousarray(blocks[lo:hi])
+        yield {
+            "chunk": ci,
+            "total_chunks": total_chunks,
+            "page_start": lo,
+            "page_count": hi - lo,
+            "layout": layout.to_wire(),
+            "data": part.tobytes(),
+        }
+
+
+class BlockAssembler:
+    """Decode-side reassembly of pulled chunks into one bundle array."""
+
+    def __init__(self) -> None:
+        self._chunks: dict[int, tuple[int, int, bytes]] = {}
+        self._layout: Optional[KvLayoutDescriptor] = None
+        self._total: Optional[int] = None
+
+    def add(self, frame: dict) -> None:
+        layout = KvLayoutDescriptor.from_wire(frame["layout"])
+        if self._layout is None:
+            self._layout = layout
+            self._total = frame["total_chunks"]
+        elif not self._layout.compatible(layout):
+            raise ValueError("layout changed mid-transfer")
+        self._chunks[frame["chunk"]] = (
+            frame["page_start"], frame["page_count"], frame["data"]
+        )
+
+    @property
+    def complete(self) -> bool:
+        return self._total is not None and len(self._chunks) == self._total
+
+    def assemble(self) -> tuple[np.ndarray, KvLayoutDescriptor]:
+        if not self.complete:
+            raise ValueError("transfer incomplete")
+        layout = self._layout
+        shape_tail = (layout.n_layers, 2, layout.page_size, layout.kv_heads,
+                      layout.head_dim)
+        n = sum(c[1] for c in self._chunks.values())
+        out = np.empty((n,) + shape_tail, np.dtype(layout.dtype))
+        for start, count, data in self._chunks.values():
+            out[start : start + count] = np.frombuffer(
+                data, np.dtype(layout.dtype)
+            ).reshape((count,) + shape_tail)
+        return out, layout

@@ -25,7 +25,8 @@ from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
 from .engine import KvRouterEngine, Migration, RouterEngine, TokenEngine
-from .model_card import ModelDeploymentCard
+from .model_card import PREFILL, ModelDeploymentCard
+from .prefill_router import PrefillPool, PrefillRouterEngine
 from .preprocessor import OpenAIPreprocessor
 
 log = get_logger("llm.manager")
@@ -83,6 +84,12 @@ class ModelWatcher:
         self.kv_config = kv_config
         self._watch = None
         self._tasks: list[asyncio.Task] = []
+        # model name -> prefill worker pool (disagg; ref prefill_router/
+        # activation.rs — the PrefillRouterEngine activates when a pool has
+        # live instances). _prefill_subjects maps endpoint subject -> name
+        # so lease-expiry deletes drain the right pool.
+        self._prefill_pools: dict[str, PrefillPool] = {}
+        self._prefill_subjects: dict[str, str] = {}
         # namespace -> entries fed by that namespace's event stream; the
         # list is shared with the running _event_loop so late-registered
         # models start receiving events immediately.
@@ -102,6 +109,8 @@ class ModelWatcher:
             await self._watch.cancel()
         for entry in self.manager.entries():
             await entry.router.client.close()
+        for pool in self._prefill_pools.values():
+            await pool.router.client.close()
 
     async def _watch_loop(self) -> None:
         async for event in self._watch:
@@ -122,6 +131,9 @@ class ModelWatcher:
     async def _handle_put(self, key: str, value: dict) -> None:
         subject, instance_id = self._parse_key(key)
         card = ModelDeploymentCard.from_wire(value)
+        if PREFILL in card.model_types:
+            await self._handle_prefill_put(card, subject, instance_id)
+            return
         entry = self.manager.get(card.name)
         if entry is None:
             entry = self._build_entry(card)
@@ -140,8 +152,48 @@ class ModelWatcher:
             return
         entry.instances.add(instance_id)
 
+    async def _handle_prefill_put(
+        self, card: ModelDeploymentCard, subject: str, instance_id: int
+    ) -> None:
+        pool = self._prefill_pools.get(card.name)
+        if pool is not None:
+            known = self._prefill_subjects.get(subject)
+            if known != card.name:
+                # Same model's prefill workers under a second endpoint
+                # subject: the pool's router can't reach them and deletes
+                # could never drain them — first subject wins (mirrors the
+                # decode-entry guard above).
+                log.warning(
+                    "prefill pool for %s already bound to another subject; "
+                    "ignoring instance at %s", card.name, subject)
+                return
+        if pool is None:
+            endpoint = (
+                self.runtime.namespace(card.namespace)
+                .component(card.component)
+                .endpoint(card.endpoint)
+            )
+            pool = PrefillPool(router=PushRouter(endpoint.client(),
+                                                 mode="round_robin"))
+            await pool.router.client.start()
+            self._prefill_pools[card.name] = pool
+            self._prefill_subjects[subject] = card.name
+            log.info("prefill pool up for %s (%s)", card.name, subject)
+        pool.instances.add(instance_id)
+
     async def _handle_delete(self, key: str) -> None:
         subject, instance_id = self._parse_key(key)
+        name = self._prefill_subjects.get(subject)
+        if name is not None:
+            pool = self._prefill_pools.get(name)
+            if pool is not None:
+                pool.instances.discard(instance_id)
+                if not pool.instances:
+                    log.info("prefill pool drained for %s", name)
+                    self._prefill_pools.pop(name, None)
+                    self._prefill_subjects.pop(subject, None)
+                    await pool.router.client.close()
+            return
         for entry in self.manager.entries():
             if entry.card.endpoint_subject == subject:
                 entry.instances.discard(instance_id)
@@ -173,6 +225,10 @@ class ModelWatcher:
         else:
             router = PushRouter(client, mode=self.router_mode)
             engine = RouterEngine(router)
+        name = card.name
+        engine = PrefillRouterEngine(
+            engine, pool_lookup=lambda: self._prefill_pools.get(name)
+        )
         engine = Migration(engine)
         preprocessor = OpenAIPreprocessor(card)
         return ModelEntry(

@@ -1,0 +1,89 @@
+"""PrefillRouter: orchestrates disaggregated prefill/decode serving.
+
+Ref: lib/llm/src/kv_router/prefill_router/mod.rs:43 + §3.4 —
+  * inactive while no prefill-pool workers exist: requests pass straight
+    through to the decode engine (aggregated fallback)
+  * active: clone the request with max_tokens=1 + `prefill_only`, send it to
+    a prefill worker, take the returned kv_transfer_params, inject them as
+    `disaggregated_params` into the decode request, and stream from decode
+    (the decode worker pulls the KV blocks before admitting — kv_transfer.py)
+
+Activation is dynamic (runtime-reconfigurable xPyD): the ModelWatcher
+maintains a PrefillPool per model as prefill cards come and go; this engine
+checks the pool on every request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import AsyncIterator, Callable, Optional
+
+from ..runtime.logging import get_logger
+from ..runtime.push_router import NoInstancesAvailable, PushRouter
+from .engine import TokenEngine
+from .protocols import EngineOutput, PreprocessedRequest, SamplingOptions
+
+log = get_logger("llm.prefill_router")
+
+
+@dataclasses.dataclass
+class PrefillPool:
+    """A model's prefill workers (one endpoint subject + live instances)."""
+
+    router: PushRouter
+    instances: set[int] = dataclasses.field(default_factory=set)
+
+    def active(self) -> bool:
+        return bool(self.instances)
+
+
+class PrefillRouterEngine(TokenEngine):
+    def __init__(
+        self,
+        inner: TokenEngine,
+        pool_lookup: Callable[[], Optional[PrefillPool]],
+    ) -> None:
+        self.inner = inner
+        self.pool_lookup = pool_lookup
+
+    async def _run_prefill(
+        self, pool: PrefillPool, request: PreprocessedRequest
+    ) -> Optional[dict]:
+        """Send the prompt to a prefill worker; returns kv_transfer_params
+        or None (caller falls back to aggregated)."""
+        prefill_request = dataclasses.replace(
+            request,
+            sampling=dataclasses.replace(request.sampling, max_tokens=1),
+            annotations={**request.annotations, "prefill_only": True},
+        )
+        try:
+            async for item in pool.router.generate(prefill_request.to_wire()):
+                out = EngineOutput.from_wire(item)
+                if out.error:
+                    log.warning("prefill worker error for %s: %s",
+                                request.request_id, out.error)
+                    return None
+                if out.kv_transfer_params is not None:
+                    return out.kv_transfer_params
+        except Exception as exc:  # noqa: BLE001 — any prefill-leg failure
+            # (incl. NoInstancesAvailable) degrades to aggregated serving
+            log.warning("prefill leg failed for %s (%r); aggregated fallback",
+                        request.request_id, exc)
+            return None
+        return None
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[EngineOutput]:
+        pool = self.pool_lookup()
+        if pool is None or not pool.active():
+            async for out in self.inner.generate(request):
+                yield out
+            return
+        params = await self._run_prefill(pool, request)
+        if params is not None:
+            request = dataclasses.replace(
+                request, disaggregated_params=params
+            )
+        async for out in self.inner.generate(request):
+            yield out

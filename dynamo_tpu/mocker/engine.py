@@ -313,6 +313,10 @@ class MockerEngine:
             seq.new_blocks = need
             seq.prefilled_tokens = cached * cfg.block_size
             seq.pinned = prefix
+            if seq.request.disaggregated_params is not None:
+                # Disagg decode side: the KV "arrived" via transfer — skip
+                # the prefill pass entirely (ref §3.4 decode leg).
+                seq.prefilled_tokens = len(seq.request.token_ids)
             self._waiting.pop(0)
             self._running.append(seq)
 
@@ -344,6 +348,23 @@ class MockerEngine:
             if seq.prefilled_tokens < len(seq.request.token_ids):
                 continue
             req = seq.request
+            if req.annotations.get("prefill_only"):
+                # Disagg prefill side: answer with kv_transfer_params
+                # instead of decoding (the mock transfer carries no data;
+                # the decode mocker just skips its prefill pass).
+                first = 97 + (len(req.token_ids) % 26)
+                seq.done = True
+                seq.queue.put_nowait(EngineOutput(
+                    token_ids=[], finish_reason="stop",
+                    prompt_tokens=len(req.token_ids),
+                    kv_transfer_params={
+                        "mock": True, "first_token": first,
+                        "prompt_len": len(req.token_ids),
+                    },
+                ).to_wire())
+                seq.queue.put_nowait(None)
+                finished.append(seq)
+                continue
             # Deterministic pseudo-output: cycle through printable ASCII.
             token = 97 + ((len(req.token_ids) + seq.generated) % 26)
             seq.generated += 1

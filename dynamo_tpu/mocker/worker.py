@@ -10,7 +10,7 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
-from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..llm.model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard, publish_card
 from ..runtime import DistributedRuntime, RuntimeConfig, new_instance_id
 from ..runtime.logging import get_logger
 from ..runtime.signals import wait_for_shutdown_signal
@@ -28,12 +28,15 @@ class MockerWorker:
         component: str = "mocker",
         config: Optional[MockerConfig] = None,
         load_publish_interval: float = 1.0,
+        mode: str = "aggregated",  # aggregated | prefill
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
         self.config = config or MockerConfig()
+        model_types = [PREFILL] if mode == "prefill" else [CHAT, COMPLETIONS]
         self.card = ModelDeploymentCard(
             name=model_name,
+            model_types=model_types,
             namespace=namespace,
             component=component,
             endpoint="generate",
@@ -95,14 +98,20 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--num-blocks", type=int, default=1024)
     parser.add_argument("--max-batch", type=int, default=32)
     parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    parser.add_argument("--mode", default="aggregated",
+                        choices=["aggregated", "prefill"])
     args = parser.parse_args(argv)
 
+    component = args.component
+    if args.mode == "prefill" and component == "mocker":
+        component = "prefill"
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = MockerWorker(
         runtime,
         model_name=args.model_name,
         namespace=args.namespace,
-        component=args.component,
+        component=component,
+        mode=args.mode,
         config=MockerConfig(
             block_size=args.block_size,
             num_blocks=args.num_blocks,
