@@ -1,0 +1,19 @@
+"""Multi-tier KV block manager (KVBM): G1 HBM → G2 host → G3 SSD → G4 blob.
+
+TPU-native equivalent of the reference's KVBM (lib/llm/src/block_manager/,
+lib/kvbm-logical/, lib/kvbm-physical/; docs/design-docs/kvbm-design.md)."""
+
+from .layout import BlockLayoutSpec, assemble, reslice
+from .manager import KvBlockManager, KvbmConfig, KvbmStats
+from .offload import OffloadManager
+from .pool import TierPool
+from .state import BlockHandle, BlockState, BlockStateError
+from .storage import DiskArena, HostArena, ObjectStore
+from .tinylfu import TinyLfu
+
+__all__ = [
+    "BlockHandle", "BlockLayoutSpec", "BlockState", "BlockStateError",
+    "DiskArena", "HostArena", "KvBlockManager", "KvbmConfig", "KvbmStats",
+    "ObjectStore", "OffloadManager", "TierPool", "TinyLfu", "assemble",
+    "reslice",
+]

@@ -1,0 +1,111 @@
+"""Portable KV block layout descriptors + TP-mismatch bridging.
+
+The reference exchanges `SerializedNixlBlockLayout` metadata so workers
+with different tensor-parallel configurations can interpret each other's KV
+blocks (ref: docs/design-docs/kvbm-design.md §Metadata Exchange — "Worker 1
+might have TP=4, while Worker 2 has TP=8"). On TPU the universal wire
+layout is the page-major bundle `[n_blocks, L, 2, page_size, kv_heads,
+head_dim]` produced by `ops.block_copy.gather_kv_blocks`; a shard of it is
+described by which contiguous kv-head range a worker holds. Bridging a TP
+mismatch is then a pure reindex over the kv-head axis, done host-side in
+numpy (the transfer already staged through host memory on the DCN relay
+path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockLayoutSpec:
+    """Geometry + shard placement of a paged-KV pool, serializable for the
+    wire (equivalent of the reference's SerializedNixlBlockLayout)."""
+
+    n_layers: int
+    total_kv_heads: int  # model-wide head count
+    head_dim: int
+    page_size: int
+    dtype: str  # numpy dtype name
+    kv_head_start: int = 0  # first head this shard holds
+    kv_head_count: Optional[int] = None  # None = all heads (unsharded)
+
+    def __post_init__(self) -> None:
+        if self.kv_head_count is None:
+            object.__setattr__(self, "kv_head_count", self.total_kv_heads)
+        if self.kv_head_start + self.kv_head_count > self.total_kv_heads:
+            raise ValueError("shard exceeds total kv heads")
+
+    @property
+    def block_shape(self) -> tuple[int, ...]:
+        return (self.n_layers, 2, self.page_size, self.kv_head_count,
+                self.head_dim)
+
+    def block_bytes(self) -> int:
+        return int(np.prod(self.block_shape)) * np.dtype(self.dtype).itemsize
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "BlockLayoutSpec":
+        return cls(**{f.name: data[f.name]
+                      for f in dataclasses.fields(cls) if f.name in data})
+
+    @classmethod
+    def from_runner_layout(cls, layout: dict) -> "BlockLayoutSpec":
+        return cls(
+            n_layers=layout["n_layers"], total_kv_heads=layout["kv_heads"],
+            head_dim=layout["head_dim"], page_size=layout["page_size"],
+            dtype=layout["dtype"],
+        )
+
+    def head_range(self) -> tuple[int, int]:
+        return self.kv_head_start, self.kv_head_start + self.kv_head_count
+
+
+def reslice(
+    bundle: np.ndarray, src: BlockLayoutSpec, dst: BlockLayoutSpec
+) -> np.ndarray:
+    """Re-slice a universal block bundle from a source shard's head range to
+    a destination shard's. The caller is responsible for assembling full
+    coverage when dst needs heads src doesn't hold (see `assemble`)."""
+    if (src.n_layers, src.page_size, src.head_dim) != (
+            dst.n_layers, dst.page_size, dst.head_dim):
+        raise ValueError(f"incompatible layouts: {src} vs {dst}")
+    d0, d1 = dst.head_range()
+    s0, s1 = src.head_range()
+    if d0 < s0 or d1 > s1:
+        raise ValueError(
+            f"dst heads [{d0},{d1}) not covered by src [{s0},{s1})")
+    out = bundle[..., d0 - s0 : d1 - s0, :]
+    if src.dtype != dst.dtype:
+        out = out.astype(dst.dtype)
+    return np.ascontiguousarray(out)
+
+
+def assemble(
+    shards: list[tuple[BlockLayoutSpec, np.ndarray]], dst: BlockLayoutSpec
+) -> np.ndarray:
+    """Build `dst`'s block bundle from several source shards (e.g. prefill
+    TP=4 -> decode TP=8: each decode shard assembles from the one or two
+    prefill shards overlapping its head range)."""
+    d0, d1 = dst.head_range()
+    first = shards[0][1]
+    out_shape = first.shape[:-2] + (dst.kv_head_count, dst.head_dim)
+    out = np.empty(out_shape, np.dtype(dst.dtype))
+    covered = np.zeros(dst.kv_head_count, bool)
+    for spec, bundle in shards:
+        s0, s1 = spec.head_range()
+        lo, hi = max(d0, s0), min(d1, s1)
+        if lo >= hi:
+            continue
+        out[..., lo - d0 : hi - d0, :] = (
+            bundle[..., lo - s0 : hi - s0, :].astype(out.dtype))
+        covered[lo - d0 : hi - d0] = True
+    if not covered.all():
+        raise ValueError("source shards do not cover dst head range")
+    return out

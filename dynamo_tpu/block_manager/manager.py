@@ -1,0 +1,228 @@
+"""KvBlockManager: the multi-tier KV cache facade (G1→G2→G3→G4).
+
+Public orchestration layer over the tier pools, equivalent of the
+reference's `KvBlockManager`/`KvBlockManagerState` (ref: docs/design-docs/
+kvbm-design.md §KvBlockManager as Orchestration Layer; lib/llm/src/
+block_manager/). Tiers on a TPU VM:
+
+  G1 device HBM     — engine's paged pool (engine.pages.PagePool owns the
+                      bookkeeping; the runner owns the array)
+  G2 host RAM       — HostArena TierPool
+  G3 local SSD      — DiskArena TierPool
+  G4 object store   — ObjectStore (opaque blobs, e.g. gcsfuse mount)
+
+Data flows (kvbm-design.md §KVBM Data Flows):
+  offload  G1→G2 on registration (TinyLFU-gated, async via OffloadManager)
+           G2→G3 on host eviction (cascade)
+           G3→G4 on disk eviction (cascade, if configured)
+  onboard  G2/G3/G4→G1 at admission, replacing prefill compute for matched
+           prompt blocks; G3/G4 hits are promoted into G2 on read.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from .layout import BlockLayoutSpec
+from .offload import OffloadManager
+from .pool import TierPool
+from .storage import DiskArena, HostArena, ObjectStore
+
+log = get_logger("kvbm.manager")
+
+
+@dataclasses.dataclass
+class KvbmConfig:
+    """Sizing knobs (counts are universal blocks, i.e. G1 pages)."""
+
+    host_blocks: int = 0  # 0 disables the G2 tier (and everything below)
+    disk_blocks: int = 0  # 0 disables G3
+    disk_path: Optional[str] = None
+    object_store_root: Optional[str] = None  # G4 (unbounded blob store)
+    offload_batch: int = 8
+    admission: bool = True  # TinyLFU gate on G2/G3 inserts
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_blocks > 0
+
+
+@dataclasses.dataclass
+class KvbmStats:
+    offloaded: int = 0  # blocks landed in G2
+    onboarded_blocks: int = 0
+    onboard_hits_host: int = 0
+    onboard_hits_disk: int = 0
+    onboard_hits_object: int = 0
+
+
+class KvBlockManager:
+    def __init__(
+        self,
+        config: KvbmConfig,
+        layout: BlockLayoutSpec,
+        *,
+        on_stored: Optional[Callable[[str, list[int]], None]] = None,
+        on_removed: Optional[Callable[[str, list[int]], None]] = None,
+    ) -> None:
+        """on_stored/on_removed: per-tier event hooks `(tier, hashes)` —
+        the analog of KVBM Register/Remove events on the event plane."""
+        self.config = config
+        self.layout = layout
+        self.stats = KvbmStats()
+        # Tier pools are touched by two threads — the scheduler thread
+        # (match/read/promote at admission) and the offload worker thread
+        # (insert + eviction cascade). One RLock serializes them; cascade
+        # callbacks re-enter it on the same thread. Arena reads are copied
+        # out under the lock before the slot can be recycled.
+        self._lock = threading.RLock()
+        ev_s = on_stored or (lambda tier, hs: None)
+        ev_r = on_removed or (lambda tier, hs: None)
+
+        self.object_store: Optional[ObjectStore] = None
+        if config.object_store_root:
+            self.object_store = ObjectStore(layout, config.object_store_root)
+
+        self.disk: Optional[TierPool] = None
+        if config.disk_blocks > 0:
+            if not config.disk_path:
+                raise ValueError("disk_blocks > 0 requires disk_path")
+            self.disk = TierPool(
+                "g3", DiskArena(layout, config.disk_blocks, config.disk_path),
+                admission=config.admission,
+                on_evict=self._on_disk_evict,
+                on_stored=lambda hs: ev_s("g3", hs),
+                on_removed=lambda hs: ev_r("g3", hs),
+            )
+
+        self.host = TierPool(
+            "g2", HostArena(layout, config.host_blocks),
+            admission=config.admission,
+            on_evict=self._on_host_evict,
+            on_stored=lambda hs: ev_s("g2", hs),
+            on_removed=lambda hs: ev_r("g2", hs),
+        )
+        self.offload: Optional[OffloadManager] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach_engine(
+        self,
+        *,
+        lookup_pages: Callable[[list[int]], list[Optional[int]]],
+        gather: Callable[[np.ndarray], np.ndarray],
+        run_in_step,
+    ) -> None:
+        """Connect the G1 side (scheduler/runner) and start the offload
+        worker. `lookup_pages` resolves block hashes to live G1 pages on
+        the scheduler thread."""
+        self.offload = OffloadManager(
+            lookup_pages=lookup_pages, gather=gather, run_in_step=run_in_step,
+            sink=self._offload_sink, batch_size=self.config.offload_batch,
+            skip=self._already_tiered,
+        )
+
+    def notify_stored(self, hashes: list[int], parent: Optional[int]) -> None:
+        """G1 on_stored hook → queue D2H offload."""
+        if self.offload is not None:
+            self.offload.notify_stored(hashes, parent)
+
+    # -- offload path ------------------------------------------------------
+
+    def _already_tiered(self, h: int) -> bool:
+        with self._lock:
+            if self.host.contains(h):
+                return True
+            if self.disk is not None and self.disk.contains(h):
+                return True
+            return False
+
+    def _offload_sink(self, h: int, block: np.ndarray,
+                      parent: Optional[int]) -> None:
+        with self._lock:
+            if self.host.insert(h, block, parent):
+                self.stats.offloaded += 1
+
+    def _on_host_evict(self, h: int, data: np.ndarray) -> None:
+        if self.disk is not None:
+            self.disk.insert(h, data)
+        elif self.object_store is not None:
+            self.object_store.put(h, data)
+
+    def _on_disk_evict(self, h: int, data: np.ndarray) -> None:
+        if self.object_store is not None:
+            self.object_store.put(h, data)
+
+    # -- onboard path (scheduler thread, admission time) -------------------
+
+    def match_prefix(self, hashes: list[int]) -> int:
+        """Longest contiguous prefix available in G2/G3/G4."""
+        with self._lock:
+            n = 0
+            for h in hashes:
+                if self.host.contains(h):
+                    n += 1
+                elif self.disk is not None and self.disk.contains(h):
+                    n += 1
+                elif (self.object_store is not None
+                      and self.object_store.contains(h)):
+                    n += 1
+                else:
+                    break
+            return n
+
+    def read_blocks(self, hashes: list[int]) -> Optional[np.ndarray]:
+        """Read a run of blocks as a bundle [n, *block_shape]; G3/G4 hits
+        are promoted into G2 (standard tiering promotion). Returns None if
+        any block is missing (caller falls back to compute)."""
+        out = np.empty((len(hashes),) + self.layout.block_shape,
+                       np.dtype(self.layout.dtype))
+        with self._lock:
+            for i, h in enumerate(hashes):
+                data = self.host.get(h)
+                if data is not None:
+                    self.stats.onboard_hits_host += 1
+                elif self.disk is not None and (
+                        data := self.disk.get(h)) is not None:
+                    self.stats.onboard_hits_disk += 1
+                    self.host.insert(h, data)
+                elif self.object_store is not None and (
+                        data := self.object_store.get(h)) is not None:
+                    self.stats.onboard_hits_object += 1
+                    self.host.insert(h, data)
+                else:
+                    return None
+                # Copy out under the lock: arena reads are views, and the
+                # offload thread may recycle the slot after we release.
+                out[i] = data
+        self.stats.onboarded_blocks += len(hashes)
+        return out
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def usage(self) -> dict:
+        with self._lock:
+            info = {
+                "g2_blocks": len(self.host),
+                "g2_usage": self.host.usage(),
+                "offloaded": self.stats.offloaded,
+                "onboarded": self.stats.onboarded_blocks,
+            }
+            if self.disk is not None:
+                info["g3_blocks"] = len(self.disk)
+                info["g3_usage"] = self.disk.usage()
+            return info
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        return self.offload.flush(timeout) if self.offload else True
+
+    def close(self) -> None:
+        if self.offload is not None:
+            self.offload.close()
+        if self.disk is not None:
+            self.disk.arena.close()

@@ -1,0 +1,146 @@
+"""Offload manager: async tier movement with per-path queues.
+
+Equivalent of the reference's `OffloadManager`/`TransferManager` (ref:
+lib/llm/src/block_manager/offload.rs:131; kvbm-design.md §Transfer
+Manager — "Asynchronous transfer orchestrator with per-path queues
+(Device→Host, Host→Disk, Host→Device, Disk→Device)").
+
+TPU shape of the problem: the paged KV lives in one donated HBM buffer that
+every compiled step consumes, so device-side gathers/scatters MUST be
+serialized with engine steps. The manager therefore runs its own worker
+thread that only *stages* work: D2H gathers are submitted to the scheduler
+thread via a `run_in_step` executor (one fused gather + one contiguous DMA
+per batch — ref block_copy.cu's batched copies), while host→disk cascades
+and disk→host reads run entirely on the offload thread, off the hot path.
+
+Onboard (G2/G3→G1) is intentionally synchronous at admission time in the
+scheduler (it replaces prefill compute, so it IS the critical path and the
+read is a host memcpy/mmap read).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("kvbm.offload")
+
+# gather executor: takes a zero-arg fn, returns a Queue of (result, exc) —
+# the signature of InferenceScheduler.run_in_step.
+GatherExecutor = Callable[[Callable[[], object]], "object"]
+
+
+class OffloadManager:
+    def __init__(
+        self,
+        *,
+        lookup_pages: Callable[[list[int]], list[Optional[int]]],
+        gather: Callable[[np.ndarray], np.ndarray],
+        run_in_step: Optional[GatherExecutor],
+        sink: Callable[[int, np.ndarray, Optional[int]], None],
+        batch_size: int = 8,
+        skip: Optional[Callable[[int], bool]] = None,
+    ) -> None:
+        """lookup_pages: hash -> current G1 page (None if evicted since);
+        gather: page-ids -> host bundle (scheduler-thread only);
+        run_in_step: serializes `gather` with engine steps (None = call
+        inline, for tests/mocker); sink: receives (hash, block, parent)."""
+        self._lookup = lookup_pages
+        self._gather = gather
+        self._run_in_step = run_in_step
+        self._sink = sink
+        self._skip = skip or (lambda h: False)
+        self._batch = batch_size
+        self._pending: list[tuple[int, Optional[int]]] = []  # (hash, parent)
+        self._cond = threading.Condition()
+        self._stop = False
+        self._inflight = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kvbm-offload")
+        self._thread.start()
+
+    # -- producer side (scheduler thread) ---------------------------------
+
+    def notify_stored(self, hashes: list[int], parent: Optional[int]) -> None:
+        """G1 registered new blocks: queue device→host offload. Called from
+        the PagePool on_stored hook."""
+        with self._cond:
+            prev = parent
+            for h in hashes:
+                if not self._skip(h):
+                    self._pending.append((h, prev))
+                prev = h
+            self._cond.notify()
+
+    # -- worker thread -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait(timeout=0.2)
+                if self._stop and not self._pending:
+                    return
+                batch = self._pending[: self._batch]
+                del self._pending[: self._batch]
+                self._inflight += 1
+            try:
+                self._offload_batch(batch)
+            except Exception:  # noqa: BLE001 — offload is best-effort
+                log.exception("offload batch failed (%d blocks)", len(batch))
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _offload_batch(self, batch: list[tuple[int, Optional[int]]]) -> None:
+        hashes = [h for h, _ in batch]
+
+        def gather_on_sched():
+            # Resolve hash->page at gather time ON the scheduler thread:
+            # eviction also only runs there, so the mapping cannot go stale
+            # between lookup and gather.
+            pages = self._lookup(hashes)
+            keep = [i for i, p in enumerate(pages) if p is not None]
+            if not keep:
+                return [], None
+            ids = np.asarray([pages[i] for i in keep], np.int32)
+            return keep, self._gather(ids)
+
+        if self._run_in_step is None:
+            keep, bundle = gather_on_sched()
+        else:
+            out = self._run_in_step(gather_on_sched)
+            result, exc = out.get(timeout=30.0)
+            if exc is not None:
+                raise exc
+            keep, bundle = result
+        if bundle is None:
+            return
+        for j, i in enumerate(keep):
+            h, parent = batch[i]
+            self._sink(h, np.asarray(bundle[j]), parent)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until the queue drains (tests / graceful shutdown)."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(0.2, remaining))
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)

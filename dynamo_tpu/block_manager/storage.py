@@ -1,0 +1,125 @@
+"""Physical storage backends for KV block tiers.
+
+Tier backends mirroring the reference's storage types (ref: docs/
+design-docs/kvbm-design.md §Storage & Pools; lib/llm/src/block_manager/
+storage/):
+
+  G2  HostArena   — preallocated host RAM arena (reference: pinned CUDA
+                    memory; on a TPU VM the PJRT D2H/H2D DMA path stages
+                    through host RAM — one contiguous slab keeps copies
+                    batched and page-aligned).
+  G3  DiskArena   — np.memmap-backed slab on local SSD (reference: NVMe via
+                    NIXL POSIX/GDS).
+  G4  ObjectStore — opaque blob store keyed by sequence hash (reference:
+                    remote storage through NIXL; here a directory tree that
+                    can point at a GCS FUSE mount, with a native GCS client
+                    gated off since this image has no egress).
+
+All arenas share the universal block geometry from `BlockLayoutSpec` so
+blocks move between tiers with plain slab copies and no re-layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .layout import BlockLayoutSpec
+
+
+class HostArena:
+    """Fixed-capacity host-RAM slab of universal blocks."""
+
+    def __init__(self, spec: BlockLayoutSpec, capacity: int) -> None:
+        self.spec = spec
+        self.capacity = capacity
+        self._slab = np.zeros((capacity,) + spec.block_shape,
+                              np.dtype(spec.dtype))
+
+    def write(self, idx: int, block: np.ndarray) -> None:
+        self._slab[idx] = block
+
+    def read(self, idx: int) -> np.ndarray:
+        return self._slab[idx]
+
+    def read_many(self, idxs: list[int]) -> np.ndarray:
+        return self._slab[np.asarray(idxs, np.int64)]
+
+    def nbytes(self) -> int:
+        return self._slab.nbytes
+
+    def close(self) -> None:
+        pass
+
+
+class DiskArena:
+    """np.memmap slab on local disk with the same geometry as HostArena."""
+
+    def __init__(self, spec: BlockLayoutSpec, capacity: int,
+                 path: str) -> None:
+        self.spec = spec
+        self.capacity = capacity
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._slab = np.memmap(path, dtype=np.dtype(spec.dtype), mode="w+",
+                               shape=(capacity,) + spec.block_shape)
+
+    def write(self, idx: int, block: np.ndarray) -> None:
+        self._slab[idx] = block
+
+    def read(self, idx: int) -> np.ndarray:
+        return np.asarray(self._slab[idx])
+
+    def read_many(self, idxs: list[int]) -> np.ndarray:
+        return np.asarray(self._slab[np.asarray(idxs, np.int64)])
+
+    def nbytes(self) -> int:
+        return self._slab.nbytes
+
+    def close(self) -> None:
+        del self._slab
+
+
+class ObjectStore:
+    """G4: unbounded blob store keyed by sequence hash. One file per block
+    under a sharded directory tree; `root` may be a GCS FUSE mountpoint.
+    Opaque to layout optimizations, exactly like the reference treats G4."""
+
+    def __init__(self, spec: BlockLayoutSpec, root: str) -> None:
+        if root.startswith("gs://"):
+            raise NotImplementedError(
+                "direct GCS access requires the google-cloud-storage client "
+                "(not in this image); mount the bucket (gcsfuse) and pass "
+                "the mountpoint instead")
+        self.spec = spec
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, h: int) -> str:
+        key = f"{h & ((1 << 64) - 1):016x}"
+        return os.path.join(self.root, key[:2], key + ".npy")
+
+    def put(self, h: int, block: np.ndarray) -> None:
+        path = self._path(h)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, block)
+        os.replace(tmp, path)  # atomic: readers never see partial blobs
+
+    def get(self, h: int) -> Optional[np.ndarray]:
+        try:
+            return np.load(self._path(h))
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def contains(self, h: int) -> bool:
+        return os.path.exists(self._path(h))
+
+    def delete(self, h: int) -> None:
+        try:
+            os.remove(self._path(h))
+        except FileNotFoundError:
+            pass

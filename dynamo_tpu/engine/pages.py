@@ -52,6 +52,12 @@ class PagePool:
     def cached_count(self) -> int:
         return len(self._cached)
 
+    def lookup(self, block_hash: int) -> Optional[int]:
+        """Current physical page holding a registered block, or None if it
+        was evicted (KVBM offload resolves hashes through this at gather
+        time, on the scheduler thread, so the mapping cannot go stale)."""
+        return self._cached.get(block_hash)
+
     def usage(self) -> float:
         usable = self.num_pages - 1
         return 1.0 - len(self._free) / max(1, usable)

@@ -74,6 +74,7 @@ class SchedulerStats:
     last_step_wall_ms: float = 0.0
     prefill_tokens_last_step: int = 0
     decode_tokens_last_step: int = 0
+    kvbm_onboarded_blocks: int = 0
 
 
 class InferenceScheduler:
@@ -82,12 +83,29 @@ class InferenceScheduler:
         runner: ModelRunner,
         on_stored: Optional[Callable[[list[int], Optional[int]], None]] = None,
         on_removed: Optional[Callable[[list[int]], None]] = None,
+        kvbm=None,  # Optional[block_manager.KvBlockManager]
     ) -> None:
         self.runner = runner
         cfg = runner.config
         self.page_size = cfg.page_size
-        self.pool = PagePool(cfg.num_pages, on_stored=on_stored,
+        self.kvbm = kvbm
+
+        def _stored(hashes: list[int], parent: Optional[int]) -> None:
+            # Fan out G1 registrations to the router event buffer AND the
+            # KVBM offload queue (ref §3.5: connector offload trigger).
+            if on_stored is not None:
+                on_stored(hashes, parent)
+            if kvbm is not None:
+                kvbm.notify_stored(hashes, parent)
+
+        self.pool = PagePool(cfg.num_pages, on_stored=_stored,
                              on_removed=on_removed)
+        if kvbm is not None:
+            kvbm.attach_engine(
+                lookup_pages=lambda hs: [self.pool.lookup(h) for h in hs],
+                gather=runner.gather_pages,
+                run_in_step=self.run_in_step,
+            )
         self.max_batch = cfg.max_batch
         self._slots: list[Optional[_Seq]] = [None] * cfg.max_batch
         self._waiting: list[_Seq] = []
@@ -177,6 +195,10 @@ class InferenceScheduler:
             if not progressed:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+        # Final drain: run_in_step callers block on their result queue, so
+        # callbacks queued during shutdown must still execute (or their
+        # waiters would hang forever).
+        self._drain_control()
 
     def _drain_control(self) -> None:
         while True:
@@ -184,7 +206,11 @@ class InferenceScheduler:
                 fn = self._control.get_nowait()
             except thread_queue.Empty:
                 return
-            fn()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a bad control callback (e.g.
+                # a deferred page release) must not kill the engine loop
+                log.exception("control callback failed")
 
     def _drain_incoming(self) -> None:
         while True:
@@ -251,11 +277,39 @@ class InferenceScheduler:
             pages = alloc.pages
             seq.block_table[: len(pages)] = pages
             seq.prefill_pos = cached_tokens
+            # Disagg-decode sequences carry their KV in onboard_blocks; the
+            # KVBM lookup would be redundant (and overwritten) for them.
+            if self.kvbm is not None and seq.onboard_blocks is None:
+                self._onboard_from_kvbm(seq)
             seq.slot = free_slots[0]
             self._slots[seq.slot] = seq
             self._waiting.pop(0)
             if seq.onboard_blocks is not None:
                 self._onboard(seq)
+
+    def _onboard_from_kvbm(self, seq: _Seq) -> None:
+        """KVBM onboard at admission (ref §3.5 onboard flows): prompt
+        blocks missed in the G1 prefix cache but present in G2/G3/G4 are
+        scattered into the freshly allocated pages instead of prefilled.
+        Keeps at least one prompt token for recompute (logits source)."""
+        cached_n = seq.alloc.cached_blocks
+        # Only blocks fully inside prompt_len - 1 can skip compute.
+        max_blocks = (seq.prompt_len - 1) // self.page_size
+        candidates = seq.block_hashes[cached_n:max_blocks]
+        if not candidates:
+            return
+        n = self.kvbm.match_prefix(candidates)
+        if n == 0:
+            return
+        bundle = self.kvbm.read_blocks(candidates[:n])
+        if bundle is None:
+            return
+        target = seq.block_table[cached_n : cached_n + n]
+        self.runner.scatter_pages(np.asarray(target, np.int32), bundle)
+        seq.prefill_pos = (cached_n + n) * self.page_size
+        self.stats.kvbm_onboarded_blocks += n
+        log.info("kvbm onboard: %d blocks (skipping %d prefill tokens) for %s",
+                 n, n * self.page_size, seq.request.request_id)
 
     def _onboard(self, seq: _Seq) -> None:
         """Disagg decode side: scatter pulled prefill KV into this pool and

@@ -99,6 +99,7 @@ class TpuWorker:
         attention_fn=None,
         warmup: bool = True,
         mode: str = "aggregated",  # aggregated | prefill | decode
+        kvbm_config=None,  # Optional[block_manager.KvbmConfig]
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -111,6 +112,8 @@ class TpuWorker:
         self.events = KvEventBuffer(self.instance_id)
         self.runner: Optional[ModelRunner] = None
         self.scheduler: Optional[InferenceScheduler] = None
+        self.kvbm_config = kvbm_config
+        self.kvbm = None
         model_types = ([PREFILL] if mode == "prefill"
                        else [CHAT, COMPLETIONS])
         self.card = ModelDeploymentCard(
@@ -142,10 +145,18 @@ class TpuWorker:
         )
         if self._warmup:
             await asyncio.to_thread(self.runner.warmup)
+        if self.kvbm_config is not None and self.kvbm_config.enabled:
+            from ..block_manager import BlockLayoutSpec, KvBlockManager
+
+            self.kvbm = KvBlockManager(
+                self.kvbm_config,
+                BlockLayoutSpec.from_runner_layout(self.runner.kv_layout()),
+            )
         self.scheduler = InferenceScheduler(
             self.runner,
             on_stored=self.events.on_stored,
             on_removed=self.events.on_removed,
+            kvbm=self.kvbm,
         )
         self.scheduler.start()
         endpoint = (
@@ -227,7 +238,13 @@ class TpuWorker:
             resultq = self.scheduler.run_in_step(
                 lambda: self.runner.gather_pages(page_ids)
             )
-            blocks, exc = await asyncio.to_thread(resultq.get)
+            try:
+                # Bounded wait: if the scheduler is shutting down the final
+                # control drain runs the gather, but never hang the handler.
+                blocks, exc = await asyncio.to_thread(resultq.get, True, 60.0)
+            except Exception as exc_:  # noqa: BLE001 — queue.Empty on timeout
+                yield {"error": f"gather timed out: {exc_!r}"}
+                return
             if exc is not None:
                 yield {"error": f"gather failed: {exc!r}"}
                 return
@@ -365,8 +382,14 @@ class TpuWorker:
         for task in self._tasks:
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.kvbm is not None:
+            # Drain pending offload gathers while the scheduler thread can
+            # still service run_in_step, then stop both.
+            await asyncio.to_thread(self.kvbm.flush, 5.0)
         if self.scheduler is not None:
             self.scheduler.stop()
+        if self.kvbm is not None:
+            self.kvbm.close()
         if self._served is not None:
             await self._served.shutdown()
         if self._clear_served is not None:
@@ -399,11 +422,28 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         choices=["aggregated", "prefill", "decode"],
                         help="disaggregated role (prefill workers register "
                              "ModelType prefill under their own component)")
+    parser.add_argument("--kvbm-host-blocks", type=int, default=0,
+                        help="G2 host-RAM KV tier size in blocks (0=off)")
+    parser.add_argument("--kvbm-disk-blocks", type=int, default=0,
+                        help="G3 local-SSD KV tier size in blocks (0=off)")
+    parser.add_argument("--kvbm-disk-path", default="/tmp/dynamo_tpu_kvbm.bin")
+    parser.add_argument("--kvbm-object-store", default=None,
+                        help="G4 blob-store root (e.g. a gcsfuse mountpoint)")
     args = parser.parse_args(argv)
 
     component = args.component
     if args.mode == "prefill" and component == "backend":
         component = "prefill"
+    kvbm_config = None
+    if args.kvbm_host_blocks > 0:
+        from ..block_manager import KvbmConfig
+
+        kvbm_config = KvbmConfig(
+            host_blocks=args.kvbm_host_blocks,
+            disk_blocks=args.kvbm_disk_blocks,
+            disk_path=args.kvbm_disk_path,
+            object_store_root=args.kvbm_object_store,
+        )
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = TpuWorker(
         runtime,
@@ -418,6 +458,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_pages_per_seq=args.max_pages_per_seq,
         ),
         mesh_config=MeshConfig(dp=args.dp, tp=args.tp),
+        kvbm_config=kvbm_config,
     )
     await worker.start()
     try:

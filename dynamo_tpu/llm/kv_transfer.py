@@ -67,7 +67,6 @@ class PendingTransfer:
     layout: KvLayoutDescriptor
     prompt_len: int
     created_at: float = dataclasses.field(default_factory=time.monotonic)
-    pulled: bool = False
 
 
 class PendingTransferTable:

@@ -68,9 +68,21 @@ def scatter_from_host(
     kv_cache: jax.Array, page_ids: np.ndarray, blocks: np.ndarray
 ) -> jax.Array:
     """Host -> device onboard of pages (KVBM G2 -> G1). One contiguous H2D
-    copy then a fused scatter into the pool."""
-    device = kv_cache.devices().pop() if hasattr(kv_cache, "devices") else None
-    dev_blocks = jax.device_put(blocks, device)
+    copy then a fused scatter into the pool.
+
+    NOTE: never call `.devices().pop()` here — NamedSharding.device_set is
+    a shared cached set (and Meshes are interned), so popping it corrupts
+    the sharding for every array on the mesh, process-wide."""
+    sharding = getattr(kv_cache, "sharding", None)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        # Replicate the bundle over the pool's mesh; the jitted scatter
+        # then writes each device's local shard without a reshard.
+        target = jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec())
+    else:
+        devs = kv_cache.devices() if hasattr(kv_cache, "devices") else set()
+        target = next(iter(devs), None)
+    dev_blocks = jax.device_put(blocks, target)
     return scatter_kv_blocks(
         kv_cache, jnp.asarray(page_ids, jnp.int32), dev_blocks
     )
