@@ -120,7 +120,9 @@ class TierPool:
         idx = self._registry.pop(victim)
         self._lru.pop(victim, None)
         block = self._blocks[idx]
-        self.on_evict(victim, self.arena.read(idx))
+        # Hand the cascade a COPY: the callback may trigger further
+        # evictions/writes that recycle arena slots the view aliases.
+        self.on_evict(victim, np.array(self.arena.read(idx)))
         block.reset()  # Registered -> Reset (RAII drop in the reference)
         self.stats.evicted += 1
         self.on_removed([victim])

@@ -70,10 +70,13 @@ class DiskArena:
         self._slab[idx] = block
 
     def read(self, idx: int) -> np.ndarray:
-        return np.asarray(self._slab[idx])
+        # COPY, not a view: a memmap view stays aliased to the slab, and an
+        # eviction cascade can recycle this very slot while the caller still
+        # holds the data (e.g. disk-hit promotion evicting back into disk).
+        return np.array(self._slab[idx])
 
     def read_many(self, idxs: list[int]) -> np.ndarray:
-        return np.asarray(self._slab[np.asarray(idxs, np.int64)])
+        return np.array(self._slab[np.asarray(idxs, np.int64)])
 
     def nbytes(self) -> int:
         return self._slab.nbytes

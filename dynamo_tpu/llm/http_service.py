@@ -130,6 +130,18 @@ class HttpService:
                                                delta_gen, body)
         return await self._aggregate_response(entry, preprocessed, delta_gen)
 
+    @staticmethod
+    def _count_request(model: str, status: str,
+                       start: Optional[float] = None) -> None:
+        """Frontend request counter + duration — the planner's num_req and
+        concurrency signals (ref: http/service/metrics.rs request counts
+        feeding the Planner)."""
+        labels = dict(namespace="http", component="frontend", endpoint=model)
+        rt_metrics.REQUESTS_TOTAL.labels(status=status, **labels).inc()
+        if start is not None:
+            rt_metrics.REQUEST_DURATION.labels(**labels).observe(
+                max(0.0, time.monotonic() - start))
+
     async def _generate(
         self, entry: ModelEntry, preprocessed: PreprocessedRequest
     ) -> AsyncIterator[EngineOutput]:
@@ -143,12 +155,20 @@ class HttpService:
         model = preprocessed.model
         start = time.monotonic()
         first_token_at: Optional[float] = None
+        last_token_at: Optional[float] = None
         try:
             async for output in self._generate(entry, preprocessed):
-                if first_token_at is None and output.token_ids:
-                    first_token_at = time.monotonic()
-                    rt_metrics.TTFT_SECONDS.labels(model=model).observe(
-                        first_token_at - start)
+                if output.token_ids:
+                    now = time.monotonic()
+                    if first_token_at is None:
+                        first_token_at = now
+                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(
+                            now - start)
+                    elif last_token_at is not None:
+                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
+                            (now - last_token_at)
+                            / max(1, len(output.token_ids)))
+                    last_token_at = now
                 delta_gen.on_output(output)
                 if output.error:
                     return web.json_response(
@@ -161,6 +181,7 @@ class HttpService:
                 _error_body(502, str(exc), "engine_error"), status=502)
         rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
             delta_gen.completion_tokens)
+        self._count_request(model, "ok", start)
         return web.json_response(delta_gen.final_response())
 
     async def _stream_response(
@@ -225,6 +246,8 @@ class HttpService:
         finally:
             rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
                 delta_gen.completion_tokens)
+            status = "ok" if delta_gen.finish_reason is not None else "error"
+            self._count_request(model, status, start)
         await response.write_eof()
         return response
 

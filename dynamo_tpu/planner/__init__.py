@@ -1,0 +1,50 @@
+"""SLA planner: autoscaling from traffic metrics + profiled performance.
+
+TPU-native equivalent of the reference planner component (components/src/
+dynamo/planner/; docs/design-docs/planner-design.md)."""
+
+from .connectors import (
+    CallbackConnector,
+    Connector,
+    KubernetesConnector,
+    TargetReplica,
+    VirtualConnector,
+)
+from .core import (
+    LoadBasedPlanner,
+    PlannerConfig,
+    SlaPlanner,
+    apply_chip_budget,
+)
+from .interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    save_decode_profile,
+    save_prefill_profile,
+)
+from .metrics_source import (
+    FrontendScraper,
+    LoadEventSource,
+    TrafficStats,
+    parse_prometheus_text,
+)
+from .predictors import (
+    ArPredictor,
+    BasePredictor,
+    ConstantPredictor,
+    KalmanPredictor,
+    SeasonalPredictor,
+    make_predictor,
+)
+from .regression import ItlEstimator, OnlineLinearRegression, TtftEstimator
+
+__all__ = [
+    "ArPredictor", "BasePredictor", "CallbackConnector", "ConstantPredictor",
+    "Connector", "DecodeInterpolator", "FrontendScraper", "ItlEstimator",
+    "KalmanPredictor", "KubernetesConnector", "LoadBasedPlanner",
+    "LoadEventSource", "OnlineLinearRegression", "PlannerConfig",
+    "PrefillInterpolator", "SeasonalPredictor", "SlaPlanner",
+    "TargetReplica", "TrafficStats", "TtftEstimator", "VirtualConnector",
+    "apply_chip_budget", "make_predictor", "parse_prometheus_text",
+    "save_decode_profile", "save_prefill_profile",
+]

@@ -1,0 +1,88 @@
+"""`python -m dynamo_tpu.planner` — SLA autoscaler service.
+
+Scrapes the frontend /metrics page every adjustment interval, predicts
+next-interval load, computes replica targets from profiled throughput, and
+publishes the decision through the configured connector (ref:
+components/src/dynamo/planner/__main__.py)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ..runtime import DistributedRuntime, RuntimeConfig
+from ..runtime.logging import get_logger
+from ..runtime.signals import wait_for_shutdown_signal
+from .connectors import KubernetesConnector, VirtualConnector
+from .core import PlannerConfig, SlaPlanner
+from .interpolation import DecodeInterpolator, PrefillInterpolator
+from .metrics_source import FrontendScraper
+
+log = get_logger("planner.main")
+
+
+async def main(argv=None) -> None:
+    parser = argparse.ArgumentParser("dynamo_tpu.planner")
+    parser.add_argument("--metrics-url",
+                        default="http://127.0.0.1:8000/metrics")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--profile-results-dir", required=True)
+    parser.add_argument("--adjustment-interval", type=float, default=180.0)
+    parser.add_argument("--ttft", type=float, default=500.0,
+                        help="TTFT SLA in ms")
+    parser.add_argument("--itl", type=float, default=50.0,
+                        help="ITL SLA in ms")
+    parser.add_argument("--load-predictor", default="constant",
+                        choices=["constant", "ar", "arima", "kalman",
+                                 "seasonal", "prophet"])
+    parser.add_argument("--min-endpoint", type=int, default=1)
+    parser.add_argument("--max-chip-budget", type=int, default=0)
+    parser.add_argument("--prefill-engine-num-chips", type=int, default=1)
+    parser.add_argument("--decode-engine-num-chips", type=int, default=1)
+    parser.add_argument("--no-correction", action="store_true")
+    parser.add_argument("--aggregated", action="store_true",
+                        help="aggregated deployment (no prefill pool)")
+    parser.add_argument("--connector", default="virtual",
+                        choices=["virtual", "kubernetes"])
+    parser.add_argument("--k8s-deployment", default=None)
+    parser.add_argument("--k8s-namespace", default="default")
+    args = parser.parse_args(argv)
+
+    config = PlannerConfig(
+        adjustment_interval=args.adjustment_interval,
+        ttft_ms=args.ttft, itl_ms=args.itl,
+        min_endpoint=args.min_endpoint,
+        max_chip_budget=args.max_chip_budget,
+        prefill_engine_num_chips=args.prefill_engine_num_chips,
+        decode_engine_num_chips=args.decode_engine_num_chips,
+        load_predictor=args.load_predictor,
+        no_correction=args.no_correction,
+    )
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    if args.connector == "kubernetes":
+        connector = KubernetesConnector(args.k8s_deployment,
+                                        args.k8s_namespace)
+    else:
+        connector = VirtualConnector(runtime)
+    disagg = not args.aggregated
+    planner = SlaPlanner(
+        config, connector,
+        prefill_interpolator=(PrefillInterpolator(args.profile_results_dir)
+                              if disagg else None),
+        decode_interpolator=DecodeInterpolator(args.profile_results_dir),
+        scraper=FrontendScraper(args.metrics_url, args.model),
+        disagg=disagg,
+    )
+    planner.start()
+    log.info("planner running (interval=%.0fs predictor=%s connector=%s)",
+             config.adjustment_interval, config.load_predictor,
+             args.connector)
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await planner.stop()
+        await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,123 @@
+"""Scaling connectors: how planner decisions become replica changes.
+
+Reference shape (ref: components/src/dynamo/planner/kubernetes_connector.py
+and virtual_connector.py; planner-design.md §Step 5): the planner computes
+TargetReplica counts and hands them to a connector — Kubernetes PATCHes the
+DynamoGraphDeployment CRD and lets the operator reconcile; Virtual records
+the decision in the KV store for an external orchestrator to act on.
+
+TPU build equivalents:
+  VirtualConnector    — records targets in the runtime's discovery KV under
+                        v1/planner/{namespace}/target_replicas; any
+                        orchestrator (or a test) watches that key.
+  KubernetesConnector — shells out to `kubectl patch` on a DGD-style
+                        resource; gated on kubectl availability (GKE/
+                        Cloud-TPU pods), never required in-process.
+  CallbackConnector   — direct function hook (in-process orchestration,
+                        used by the mocker-backed planner tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shutil
+import subprocess
+import time
+from typing import Callable, Optional
+
+from ..runtime.logging import get_logger
+
+log = get_logger("planner.connector")
+
+
+@dataclasses.dataclass
+class TargetReplica:
+    component: str  # e.g. "backend" (decode) / "prefill"
+    desired_replicas: int
+
+
+class Connector:
+    async def set_component_replicas(
+            self, targets: list[TargetReplica]) -> None:
+        raise NotImplementedError
+
+    async def observed_replicas(self, component: str) -> Optional[int]:
+        """Current replica count if the connector can observe it."""
+        return None
+
+
+class VirtualConnector(Connector):
+    """Publish desired replica counts into the discovery KV store."""
+
+    def __init__(self, runtime, namespace: str = "dynamo") -> None:
+        self.runtime = runtime
+        self.namespace = namespace
+        self.decision_id = 0
+
+    def _key(self) -> str:
+        return f"v1/planner/{self.namespace}/target_replicas"
+
+    async def set_component_replicas(
+            self, targets: list[TargetReplica]) -> None:
+        self.decision_id += 1
+        payload = {
+            "decision_id": self.decision_id,
+            "ts": time.time(),
+            "targets": {t.component: t.desired_replicas for t in targets},
+        }
+        await self.runtime.discovery.put(self._key(), payload)
+        log.info("virtual connector decision %d: %s", self.decision_id,
+                 payload["targets"])
+
+    async def read_decision(self) -> Optional[dict]:
+        found = await self.runtime.discovery.get_prefix(self._key())
+        return found.get(self._key())
+
+
+class CallbackConnector(Connector):
+    def __init__(self, apply: Callable[[str, int], None],
+                 observe: Optional[Callable[[str], int]] = None) -> None:
+        self._apply = apply
+        self._observe = observe
+
+    async def set_component_replicas(
+            self, targets: list[TargetReplica]) -> None:
+        for t in targets:
+            self._apply(t.component, t.desired_replicas)
+
+    async def observed_replicas(self, component: str) -> Optional[int]:
+        return self._observe(component) if self._observe else None
+
+
+class KubernetesConnector(Connector):
+    """Patch spec.services.<component>.replicas on a deployment resource
+    via kubectl (the operator reconciles the rest, ref
+    kubernetes_connector.py KubernetesConnector.set_component_replicas)."""
+
+    def __init__(self, deployment: str, namespace: str = "default",
+                 resource: str = "deployment") -> None:
+        if shutil.which("kubectl") is None:
+            raise RuntimeError(
+                "kubectl not found; KubernetesConnector requires a cluster "
+                "environment (use VirtualConnector elsewhere)")
+        self.deployment = deployment
+        self.namespace = namespace
+        self.resource = resource
+
+    async def set_component_replicas(
+            self, targets: list[TargetReplica]) -> None:
+        for t in targets:
+            patch = json.dumps(
+                {"spec": {"services": {t.component: {
+                    "replicas": t.desired_replicas}}}})
+            cmd = ["kubectl", "-n", self.namespace, "patch", self.resource,
+                   self.deployment, "--type", "merge", "-p", patch]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=30)
+            except (subprocess.TimeoutExpired, OSError) as exc:
+                log.error("kubectl patch failed: %r", exc)
+                continue
+            if proc.returncode != 0:
+                log.error("kubectl patch failed: %s", proc.stderr.strip())

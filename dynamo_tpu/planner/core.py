@@ -1,0 +1,330 @@
+"""SLA planner core: observe → correct → predict → scale.
+
+TPU-native port of the reference planner loop (ref: components/src/dynamo/
+planner/utils/planner_core.py; docs/design-docs/planner-design.md). Every
+`adjustment_interval` seconds:
+
+ 1. observe traffic (frontend metrics deltas: num_req, TTFT, ITL, ISL, OSL)
+ 2. update correction factors = observed latency / interpolated expectation
+    (prefill_planner.py:78-86, decode_planner.py:69-91)
+ 3. predict next-interval load with the configured predictor
+ 4. compute replica requirements from profiled per-chip throughput:
+      num_p = ceil(req_rate * isl * min(1, p_corr) / p_thpt_per_chip / chips)
+      num_d = ceil(req_rate * osl / d_thpt(itl_sla / d_corr) / chips)
+    (prefill_planner.py:87-115, decode_planner.py:93-131)
+ 5. clamp to the chip budget (planner_core.py:122-196) and hand the targets
+    to a connector.
+
+Load-based mode instead estimates next TTFT/ITL per engine from LoadMetrics
+regressions and nudges ±1 replica when ALL engines violate/clear the SLA
+(prefill_planner.py load_plan_adjustment).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+from typing import Optional
+
+from ..runtime.logging import get_logger
+from .connectors import Connector, TargetReplica
+from .interpolation import DecodeInterpolator, PrefillInterpolator
+from .metrics_source import FrontendScraper, LoadEventSource, TrafficStats
+from .predictors import make_predictor
+from .regression import ItlEstimator, TtftEstimator
+
+log = get_logger("planner.core")
+
+
+@dataclasses.dataclass
+class PlannerConfig:
+    adjustment_interval: float = 180.0  # seconds (ref default 180)
+    ttft_ms: float = 500.0  # SLA targets
+    itl_ms: float = 50.0
+    min_endpoint: int = 1
+    max_chip_budget: int = 0  # 0 = unlimited (ref max_gpu_budget)
+    prefill_engine_num_chips: int = 1
+    decode_engine_num_chips: int = 1
+    load_predictor: str = "constant"
+    no_correction: bool = False
+    # load-based mode
+    load_based: bool = False
+    max_num_batched_tokens: int = 2048
+    scale_down_sensitivity: float = 0.5  # scale down when est < sla * s
+    # component names as registered in the runtime
+    prefill_component: str = "prefill"
+    decode_component: str = "backend"
+
+
+def apply_chip_budget(num_p: int, num_d: int,
+                      cfg: PlannerConfig) -> tuple[int, int]:
+    """Joint budget clamp (ref planner_core.py:122-168): prefill is scaled
+    down first but keeps at least min_endpoint; remaining budget goes to
+    decode."""
+    if cfg.max_chip_budget <= 0:
+        return num_p, num_d
+    total = (num_p * cfg.prefill_engine_num_chips
+             + num_d * cfg.decode_engine_num_chips)
+    if total <= cfg.max_chip_budget:
+        return num_p, num_d
+    if num_p == 0:
+        # Aggregated deployment: the whole budget belongs to decode — do
+        # not reserve chips for a nonexistent prefill pool.
+        if cfg.max_chip_budget < cfg.min_endpoint * cfg.decode_engine_num_chips:
+            log.warning("chip budget %d cannot satisfy min_endpoint decode",
+                        cfg.max_chip_budget)
+            return 0, 0
+        return 0, max(cfg.min_endpoint,
+                      int(cfg.max_chip_budget // cfg.decode_engine_num_chips))
+    min_required = cfg.min_endpoint * (cfg.prefill_engine_num_chips
+                                       + cfg.decode_engine_num_chips)
+    if cfg.max_chip_budget < min_required:
+        log.warning("chip budget %d cannot satisfy min_endpoint; zeroing",
+                    cfg.max_chip_budget)
+        return 0, 0
+    scale = cfg.max_chip_budget / total
+    max_prefill = (cfg.max_chip_budget
+                   - cfg.min_endpoint * cfg.decode_engine_num_chips
+                   ) // cfg.prefill_engine_num_chips
+    num_p = max(cfg.min_endpoint,
+                min(int(max_prefill), math.floor(num_p * scale)))
+    remaining = cfg.max_chip_budget - num_p * cfg.prefill_engine_num_chips
+    num_d = max(cfg.min_endpoint,
+                int(remaining // cfg.decode_engine_num_chips))
+    return num_p, num_d
+
+
+@dataclasses.dataclass
+class PlannerState:
+    p_correction: float = 1.0
+    d_correction: float = 1.0
+    num_p_workers: int = 0
+    num_d_workers: int = 0
+    last_decision: Optional[tuple[int, int]] = None
+    intervals: int = 0
+
+
+class SlaPlanner:
+    """Throughput-mode planner for a disaggregated (or aggregated,
+    prefill disabled) deployment."""
+
+    def __init__(
+        self,
+        config: PlannerConfig,
+        connector: Connector,
+        *,
+        prefill_interpolator: Optional[PrefillInterpolator] = None,
+        decode_interpolator: Optional[DecodeInterpolator] = None,
+        scraper: Optional[FrontendScraper] = None,
+        disagg: bool = True,
+    ) -> None:
+        self.config = config
+        self.connector = connector
+        self.prefill_interp = prefill_interpolator
+        self.decode_interp = decode_interpolator
+        self.scraper = scraper
+        self.disagg = disagg
+        self.state = PlannerState()
+        self.num_req_pred = make_predictor(config.load_predictor)
+        self.isl_pred = make_predictor(config.load_predictor)
+        self.osl_pred = make_predictor(config.load_predictor)
+        self._task: Optional[asyncio.Task] = None
+
+    # -- one planning interval --------------------------------------------
+
+    def observe(self, stats: TrafficStats) -> None:
+        self.last_stats = stats
+        self.num_req_pred.add_data_point(stats.num_req)
+        self.isl_pred.add_data_point(stats.isl)
+        self.osl_pred.add_data_point(stats.osl)
+
+    def _update_correction(self, stats: TrafficStats) -> None:
+        if self.config.no_correction:
+            return
+        if self.disagg and self.prefill_interp is not None:
+            expect_ttft = self.prefill_interp.interpolate_ttft(stats.isl)
+            if expect_ttft > 0:
+                self.state.p_correction = stats.ttft_ms / expect_ttft
+        if (self.decode_interp is not None and self.state.num_d_workers > 0
+                and not math.isnan(stats.request_duration_s)):
+            concurrency = (stats.num_req / self.state.num_d_workers
+                           * stats.request_duration_s
+                           / self.config.adjustment_interval)
+            expect_itl = self.decode_interp.interpolate_itl(
+                concurrency=concurrency,
+                context_length=stats.isl + stats.osl / 2)
+            if expect_itl > 0:
+                self.state.d_correction = stats.itl_ms / expect_itl
+        log.info("correction factors: prefill=%.3f decode=%.3f",
+                 self.state.p_correction, self.state.d_correction)
+
+    def predict_load(self) -> tuple[float, float, float]:
+        return (self.num_req_pred.predict_next(),
+                self.isl_pred.predict_next(),
+                self.osl_pred.predict_next())
+
+    def compute_num_prefill(self, num_req: float, isl: float) -> int:
+        """ref prefill_planner.py:87-115."""
+        cfg = self.config
+        pred_thpt = (num_req * isl / cfg.adjustment_interval
+                     * min(1.0, self.state.p_correction))
+        per_chip = self.prefill_interp.interpolate_thpt_per_chip(isl)
+        if per_chip <= 0:
+            return cfg.min_endpoint
+        n = math.ceil(pred_thpt / per_chip / cfg.prefill_engine_num_chips)
+        return max(n, cfg.min_endpoint)
+
+    def compute_num_decode(self, num_req: float, isl: float,
+                           osl: float) -> int:
+        """ref decode_planner.py:93-131."""
+        cfg = self.config
+        corr = self.state.d_correction
+        corrected_itl = cfg.itl_ms / corr if corr > 0 else cfg.itl_ms
+        per_chip, _, _ = self.decode_interp.find_best_throughput_per_chip(
+            itl=corrected_itl, context_length=isl + osl / 2)
+        if per_chip <= 0:
+            return cfg.min_endpoint
+        pred_thpt = num_req * osl / cfg.adjustment_interval
+        n = math.ceil(pred_thpt / per_chip / cfg.decode_engine_num_chips)
+        return max(n, cfg.min_endpoint)
+
+    def plan(self, stats: TrafficStats) -> Optional[tuple[int, int]]:
+        """Full interval: observe -> correct -> predict -> compute ->
+        budget clamp. Returns (num_p, num_d) or None (no traffic)."""
+        self.state.intervals += 1
+        if not stats.is_valid() or stats.num_req <= 0:
+            log.info("no traffic in interval; skipping adjustment")
+            return None
+        # Best estimate of current worker counts for the correction factor:
+        # the connector's observation (set in run()) or our last decision.
+        if self.state.num_d_workers == 0 and self.state.last_decision:
+            self.state.num_p_workers, self.state.num_d_workers = (
+                self.state.last_decision)
+        self.observe(stats)
+        self._update_correction(stats)
+        num_req, isl, osl = self.predict_load()
+        log.info("predicted load: num_req=%.2f isl=%.1f osl=%.1f",
+                 num_req, isl, osl)
+        num_p = (self.compute_num_prefill(num_req, isl)
+                 if self.disagg and self.prefill_interp is not None else 0)
+        num_d = self.compute_num_decode(num_req, isl, osl)
+        num_p, num_d = apply_chip_budget(num_p, num_d, self.config)
+        self.state.last_decision = (num_p, num_d)
+        return num_p, num_d
+
+    async def apply(self, decision: tuple[int, int]) -> None:
+        num_p, num_d = decision
+        targets = []
+        if self.disagg:
+            targets.append(TargetReplica(self.config.prefill_component,
+                                         num_p))
+        targets.append(TargetReplica(self.config.decode_component, num_d))
+        await self.connector.set_component_replicas(targets)
+
+    # -- loop --------------------------------------------------------------
+
+    async def run(self) -> None:
+        assert self.scraper is not None, "run() requires a FrontendScraper"
+        self.scraper.scrape()  # baseline
+        while True:
+            await asyncio.sleep(self.config.adjustment_interval)
+            try:
+                obs = await self.connector.observed_replicas(
+                    self.config.decode_component)
+                if obs is not None:
+                    self.state.num_d_workers = obs
+                stats = self.scraper.scrape()
+                if stats is None:
+                    continue
+                decision = self.plan(stats)
+                if decision is not None:
+                    await self.apply(decision)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad interval (scrape
+                # hiccup, kubectl timeout) must not kill the autoscaler
+                log.exception("planner interval failed; continuing")
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+class LoadBasedPlanner:
+    """±1 scaling from per-engine SLA estimates (ref prefill_planner.py
+    load_plan_adjustment / decode_planner.py): scale up when ALL engines
+    violate the SLA estimate, down when ALL are below sla*sensitivity."""
+
+    def __init__(self, config: PlannerConfig, connector: Connector,
+                 source: LoadEventSource) -> None:
+        self.config = config
+        self.connector = connector
+        self.source = source
+        self.ttft_est = TtftEstimator()
+        self.itl_est = ItlEstimator()
+        self.state = PlannerState()
+
+    def ingest(self) -> None:
+        for snap in self.source.snapshots():
+            wall = float(snap.get("step_wall_ms", 0.0))
+            if wall <= 0:
+                continue
+            pf = int(snap.get("prefill_tokens_in_step", 0))
+            dc = int(snap.get("decode_tokens_in_step", 0))
+            if pf:
+                self.ttft_est.observe_step(pf, wall)
+            if dc:
+                self.itl_est.observe_step(dc, wall)
+
+    @staticmethod
+    def _decide(estimates: list[float], sla: float, current: int,
+                sensitivity: float, min_endpoint: int) -> int:
+        if not estimates:
+            return current
+        if all(e > sla for e in estimates):
+            return current + 1
+        if all(e < sla * sensitivity for e in estimates):
+            return max(min_endpoint, current - 1)
+        return current
+
+    def plan_decode(self, current_replicas: int) -> int:
+        self.ingest()
+        if not self.itl_est.has_sufficient_data():
+            return current_replicas
+        ests = []
+        for snap in self.source.snapshots():
+            active = int(snap.get("active_requests", 0))
+            est = self.itl_est.estimate_itl_ms(active)
+            if est is not None:
+                ests.append(est)
+        return self._decide(ests, self.config.itl_ms, current_replicas,
+                            self.config.scale_down_sensitivity,
+                            self.config.min_endpoint)
+
+    def plan_prefill(self, current_replicas: int,
+                     queued_tokens_per_engine: list[int],
+                     avg_isl: Optional[float] = None) -> int:
+        """`avg_isl` comes from traffic stats (the estimator adds it to the
+        queue drain: a new request's own prompt must also be prefilled)."""
+        self.ingest()
+        if avg_isl is not None and avg_isl > 0:
+            self.ttft_est.observe_isl(avg_isl)
+        if not self.ttft_est.has_sufficient_data():
+            return current_replicas
+        ests = []
+        for q in queued_tokens_per_engine:
+            est = self.ttft_est.estimate_next_ttft_ms(
+                q, self.config.max_num_batched_tokens)
+            if est is not None:
+                ests.append(est)
+        return self._decide(ests, self.config.ttft_ms, current_replicas,
+                            self.config.scale_down_sensitivity,
+                            self.config.min_endpoint)

@@ -1,0 +1,163 @@
+"""Performance interpolators over pre-deployment profiling data.
+
+Same data contract as the reference (ref: components/src/dynamo/planner/
+utils/perf_interpolation.py): the profiler sweeps a deployment and saves
+
+  prefill: prefill_isl[], prefill_ttft[] (ms), prefill_thpt_per_chip[]
+  decode:  x_kv_usage[], y_context_length[], z_itl[] (ms),
+           z_thpt_per_chip[], max_kv_tokens
+
+(NPZ or JSON; `*_per_gpu` keys from reference-formatted files are accepted
+as aliases). scipy isn't in this image, so the cubic interp1d/griddata are
+replaced with numpy linear interpolation (1D) and inverse-distance
+weighting onto a precomputed grid (2D) — same clamped-lookup semantics,
+including the reverse kv-load scan of `find_best_throughput_per_gpu`
+(perf_interpolation.py:227-258; interpolated ITL need not be monotonic, so
+no binary search).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def _load_raw(path_or_data, npz_name: str, json_name: str) -> dict:
+    if isinstance(path_or_data, dict):
+        return dict(path_or_data)
+    npz_fn = os.path.join(path_or_data, npz_name)
+    if os.path.exists(npz_fn):
+        with np.load(npz_fn) as f:
+            return {k: f[k] for k in f.files}
+    json_fn = os.path.join(path_or_data, json_name)
+    with open(json_fn) as f:
+        return {k: np.asarray(v) for k, v in json.load(f).items()}
+
+
+def _key(data: dict, ours: str, theirs: str):
+    if ours in data:
+        return np.asarray(data[ours], float)
+    return np.asarray(data[theirs], float)
+
+
+class PrefillInterpolator:
+    """ISL -> TTFT(ms) and ISL -> prefill throughput per chip."""
+
+    def __init__(self, profile_results_dir: Optional[str] = None,
+                 raw_data: Optional[dict] = None) -> None:
+        data = _load_raw(raw_data if raw_data is not None
+                         else profile_results_dir,
+                         "prefill_raw_data.npz", "prefill_raw_data.json")
+        self.isl = np.asarray(data["prefill_isl"], float)
+        self.ttft = np.asarray(data["prefill_ttft"], float)
+        self.thpt_per_chip = _key(data, "prefill_thpt_per_chip",
+                                  "prefill_thpt_per_gpu")
+        order = np.argsort(self.isl)
+        self.isl, self.ttft = self.isl[order], self.ttft[order]
+        self.thpt_per_chip = self.thpt_per_chip[order]
+
+    def interpolate_ttft(self, isl: float) -> float:
+        return float(np.interp(isl, self.isl, self.ttft))
+
+    def interpolate_thpt_per_chip(self, isl: float) -> float:
+        return float(np.interp(isl, self.isl, self.thpt_per_chip))
+
+
+class DecodeInterpolator:
+    """(kv_usage, context_length) -> ITL(ms) / decode throughput per chip,
+    precomputed on a resolution x resolution grid via inverse-distance
+    weighting over the profiled samples."""
+
+    def __init__(self, profile_results_dir: Optional[str] = None,
+                 resolution: int = 100,
+                 raw_data: Optional[dict] = None) -> None:
+        data = _load_raw(raw_data if raw_data is not None
+                         else profile_results_dir,
+                         "decode_raw_data.npz", "decode_raw_data.json")
+        self.x_kv_usage = np.asarray(data["x_kv_usage"], float)
+        self.y_context_length = np.asarray(data["y_context_length"], float)
+        self.z_itl = np.asarray(data["z_itl"], float)
+        self.z_thpt_per_chip = _key(data, "z_thpt_per_chip",
+                                    "z_thpt_per_gpu")
+        mk = np.asarray(data["max_kv_tokens"]).reshape(-1)
+        self.max_kv_tokens = int(mk[0])
+
+        self.resolution = resolution
+        self.xi = np.linspace(0, 1, resolution)
+        self.yi = np.linspace(0, float(self.y_context_length.max()),
+                              resolution)
+        self.itl_grid = self._idw_grid(self.z_itl)
+        self.thpt_grid = self._idw_grid(self.z_thpt_per_chip)
+
+    def _idw_grid(self, z: np.ndarray, power: float = 2.0) -> np.ndarray:
+        # Normalize axes so distance is scale-free, then inverse-distance
+        # weight every grid point over all samples (vectorized).
+        xs = self.x_kv_usage  # already in [0, 1]
+        y_max = max(1.0, float(self.y_context_length.max()))
+        ys = self.y_context_length / y_max
+        gx, gy = np.meshgrid(self.xi, self.yi / y_max)
+        d2 = ((gx[..., None] - xs) ** 2 + (gy[..., None] - ys) ** 2)
+        w = 1.0 / np.maximum(d2, 1e-12) ** (power / 2)
+        grid = (w * z).sum(-1) / w.sum(-1)
+        # Exact at sample points (IDW converges there as d->0)
+        return grid
+
+    def compute_idx(self, concurrency: float,
+                    context_length: float) -> tuple[int, int]:
+        kv_usage = concurrency * context_length / self.max_kv_tokens
+        ix = int(np.clip(round((kv_usage - self.xi[0])
+                               / (self.xi[1] - self.xi[0])),
+                         0, self.resolution - 1))
+        iy = int(np.clip(round((context_length - self.yi[0])
+                               / (self.yi[1] - self.yi[0])),
+                         0, self.resolution - 1))
+        return ix, iy
+
+    def interpolate_itl(self, concurrency: float,
+                        context_length: float) -> float:
+        ix, iy = self.compute_idx(concurrency, context_length)
+        return float(self.itl_grid[iy, ix])
+
+    def interpolate_thpt_per_chip(self, concurrency: float,
+                                  context_length: float) -> float:
+        ix, iy = self.compute_idx(concurrency, context_length)
+        return float(self.thpt_grid[iy, ix])
+
+    def find_best_throughput_per_chip(
+        self, itl: float, context_length: float
+    ) -> tuple[float, float, float]:
+        """Max-throughput operating point whose ITL meets the target:
+        scan kv-load from high to low (ITL may be non-monotonic)."""
+        iy = int(np.clip(round((context_length - self.yi[0])
+                               / (self.yi[1] - self.yi[0])),
+                         0, self.resolution - 1))
+        for ix in range(self.resolution - 1, -1, -1):
+            if self.itl_grid[iy, ix] <= itl:
+                return (float(self.thpt_grid[iy, ix]),
+                        float(self.itl_grid[iy, ix]), float(self.xi[ix]))
+        return (float(self.thpt_grid[iy, 0]), float(self.itl_grid[iy, 0]),
+                float(self.xi[0]))
+
+
+def save_prefill_profile(path: str, isl, ttft_ms, thpt_per_chip) -> str:
+    os.makedirs(path, exist_ok=True)
+    fn = os.path.join(path, "prefill_raw_data.npz")
+    np.savez(fn, prefill_isl=np.asarray(isl, float),
+             prefill_ttft=np.asarray(ttft_ms, float),
+             prefill_thpt_per_chip=np.asarray(thpt_per_chip, float))
+    return fn
+
+
+def save_decode_profile(path: str, kv_usage, context_length, itl_ms,
+                        thpt_per_chip, max_kv_tokens: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    fn = os.path.join(path, "decode_raw_data.npz")
+    np.savez(fn, x_kv_usage=np.asarray(kv_usage, float),
+             y_context_length=np.asarray(context_length, float),
+             z_itl=np.asarray(itl_ms, float),
+             z_thpt_per_chip=np.asarray(thpt_per_chip, float),
+             max_kv_tokens=np.asarray([max_kv_tokens]))
+    return fn

@@ -1,0 +1,152 @@
+"""Traffic observation sources for the planner.
+
+Throughput mode scrapes the frontend's Prometheus /metrics page and
+differentiates histogram sums/counts between scrapes to get per-interval
+averages — the same quantities the reference pulls from Prometheus server
+queries (ref: planner_core.py observe_traffic_stats: avg TTFT, ITL,
+request count/duration, ISL, OSL). We scrape the frontend directly instead
+of requiring a Prometheus server in the loop.
+
+Load-based mode subscribes to the workers' LoadMetrics events on the event
+plane (the ForwardPassMetrics analog) and feeds the online regressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import urllib.request
+from typing import Optional
+
+from ..runtime.logging import get_logger
+
+log = get_logger("planner.metrics")
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>[^}]*)\})?\s+"
+    r"(?P<value>[-+0-9.eE(nan)(inf)]+)\s*$")
+
+
+def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
+    """Parse exposition text into {(name, sorted-label-items): value}."""
+    out: dict[tuple[str, tuple], float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        labels = ()
+        if m.group("labels"):
+            pairs = []
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"',
+                                   m.group("labels")):
+                pairs.append(part)
+            labels = tuple(sorted(pairs))
+        try:
+            out[(m.group("name"), labels)] = float(m.group("value"))
+        except ValueError:
+            continue
+    return out
+
+
+@dataclasses.dataclass
+class TrafficStats:
+    """Per-interval averages handed to the planner (ref Metrics struct,
+    planner_core.py:108)."""
+
+    num_req: float = math.nan  # requests completed in interval
+    ttft_ms: float = math.nan
+    itl_ms: float = math.nan
+    isl: float = math.nan
+    osl: float = math.nan
+    request_duration_s: float = math.nan
+
+    def is_valid(self) -> bool:
+        return not any(math.isnan(v) for v in
+                       (self.num_req, self.ttft_ms, self.itl_ms,
+                        self.isl, self.osl))
+
+
+class FrontendScraper:
+    """Delta-based scraper over the frontend /metrics endpoint."""
+
+    def __init__(self, metrics_url: str, model: str) -> None:
+        self.url = metrics_url
+        self.model = model
+        self._prev: Optional[dict] = None
+
+    def _fetch(self) -> dict[tuple[str, tuple], float]:
+        with urllib.request.urlopen(self.url, timeout=10.0) as resp:
+            return parse_prometheus_text(resp.read().decode())
+
+    def _sum_matching(self, snap: dict, name: str,
+                      match: dict[str, str]) -> float:
+        total = 0.0
+        found = False
+        for (n, labels), v in snap.items():
+            if n != name:
+                continue
+            d = dict(labels)
+            if all(d.get(k) == v2 for k, v2 in match.items()):
+                total += v
+                found = True
+        return total if found else math.nan
+
+    def scrape(self) -> Optional[TrafficStats]:
+        """Returns per-interval averages since the previous scrape, or None
+        on the first call (no baseline yet)."""
+        try:
+            snap = self._fetch()
+        except Exception as exc:  # noqa: BLE001 — scrape is retried
+            log.warning("metrics scrape failed: %r", exc)
+            return None
+        prev, self._prev = self._prev, snap
+        if prev is None:
+            return None
+
+        model = {"model": self.model}
+
+        def delta(name: str, match: dict) -> float:
+            a = self._sum_matching(snap, name, match)
+            b = self._sum_matching(prev, name, match)
+            if math.isnan(a) or math.isnan(b):
+                return math.nan
+            return a - b
+
+        def avg(prefix: str, match: dict, scale: float = 1.0) -> float:
+            ds = delta(prefix + "_sum", match)
+            dc = delta(prefix + "_count", match)
+            if math.isnan(ds) or math.isnan(dc) or dc <= 0:
+                return math.nan
+            return ds / dc * scale
+
+        num_req = delta("dynt_requests_total", {"status": "ok"})
+        return TrafficStats(
+            num_req=num_req,
+            ttft_ms=avg("dynt_time_to_first_token_seconds", model, 1e3),
+            itl_ms=avg("dynt_inter_token_latency_seconds", model, 1e3),
+            isl=avg("dynt_input_sequence_tokens", model),
+            osl=avg("dynt_output_sequence_tokens", model),
+            request_duration_s=avg("dynt_request_duration_seconds", {}),
+        )
+
+
+class LoadEventSource:
+    """Collects per-worker LoadMetrics events for load-based planning."""
+
+    def __init__(self) -> None:
+        # (worker_id, dp_rank) -> latest LoadMetrics wire dict
+        self.latest: dict[tuple[int, int], dict] = {}
+
+    def on_event(self, payload: dict) -> None:
+        key = (int(payload.get("worker_id", 0)),
+               int(payload.get("dp_rank", 0)))
+        self.latest[key] = payload
+
+    def worker_count(self) -> int:
+        return len({w for w, _ in self.latest})
+
+    def snapshots(self) -> list[dict]:
+        return list(self.latest.values())

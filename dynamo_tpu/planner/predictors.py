@@ -1,0 +1,209 @@
+"""Load predictors for the SLA planner.
+
+Mirrors the reference's predictor suite (ref: components/src/dynamo/
+planner/utils/load_predictor.py): Constant, ARIMA (pmdarima), Kalman
+(filterpy), Prophet. This image has none of those libraries, so the
+equivalents are implemented directly on numpy:
+
+  constant — last value (ref ConstantPredictor, load_predictor.py:97)
+  ar       — autoregressive least-squares fit with AIC order selection and
+             the reference's log1p fallback for spiky series (analog of
+             ARIMAPredictor, load_predictor.py:111)
+  kalman   — local linear trend Kalman filter (2-state level+velocity),
+             the same model class filterpy is used for in the reference
+  seasonal — seasonal-naive + linear trend (fills Prophet's role for
+             periodic traffic without a Stan runtime)
+
+All share BasePredictor's buffer semantics: NaN→0, and the post-deploy
+idle run of leading zeros is skipped until the first nonzero observation
+(ref load_predictor.py:69-84).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+
+class BasePredictor:
+    def __init__(self, minimum_data_points: int = 5,
+                 window: int = 512) -> None:
+        self.minimum_data_points = minimum_data_points
+        self.data_buffer: list[float] = []
+        self.window = window
+        self._seen_nonzero = False
+
+    def reset_idle_skip(self) -> None:
+        self._seen_nonzero = False
+
+    def add_data_point(self, value: float) -> None:
+        if value is None or math.isnan(value):
+            value = 0.0
+        if value == 0 and not self._seen_nonzero:
+            return  # leading idle period
+        if value != 0:
+            self._seen_nonzero = True
+        self.data_buffer.append(float(value))
+        if len(self.data_buffer) > self.window:
+            del self.data_buffer[: -self.window]
+
+    def get_last_value(self) -> float:
+        return self.data_buffer[-1] if self.data_buffer else 0.0
+
+    def predict_next(self) -> float:
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    def __init__(self) -> None:
+        super().__init__(minimum_data_points=1)
+
+    def predict_next(self) -> float:
+        return self.get_last_value()
+
+
+class ArPredictor(BasePredictor):
+    """AR(p) by least squares, order chosen by AIC over p in [1, max_order].
+
+    Fit in raw space; if the best fit degenerates (near-zero coefficients,
+    the analog of pmdarima collapsing to (0,d,0)) refit in log1p space —
+    the same spiky-series fallback the reference applies
+    (load_predictor.py:200-216)."""
+
+    def __init__(self, max_order: int = 4, log1p: bool = False) -> None:
+        super().__init__(minimum_data_points=5)
+        self.max_order = max_order
+        self._log1p = log1p
+
+    @staticmethod
+    def _fit_predict(series: np.ndarray, max_order: int) -> Optional[float]:
+        n = len(series)
+        best = None  # (aic, prediction)
+        for p in range(1, min(max_order, n - 2) + 1):
+            # Design: y[t] = c + sum_i a_i * y[t-i]
+            rows = n - p
+            if rows < p + 2:
+                continue
+            x = np.ones((rows, p + 1))
+            for i in range(p):
+                x[:, i + 1] = series[p - 1 - i : n - 1 - i]
+            y = series[p:]
+            coef, residuals, _, _ = np.linalg.lstsq(x, y, rcond=None)
+            rss = float(residuals[0]) if len(residuals) else float(
+                np.sum((y - x @ coef) ** 2))
+            sigma2 = max(rss / rows, 1e-12)
+            aic = rows * math.log(sigma2) + 2 * (p + 1)
+            pred = coef[0] + float(
+                np.dot(coef[1:], series[-1 : -p - 1 : -1]))
+            if best is None or aic < best[0]:
+                best = (aic, pred, coef)
+        if best is None:
+            return None
+        _, pred, coef = best
+        if np.max(np.abs(coef[1:])) < 1e-6:
+            return None  # degenerate fit, caller retries in log space
+        return pred
+
+    def predict_next(self) -> float:
+        if len(self.data_buffer) < self.minimum_data_points:
+            return self.get_last_value()
+        raw = np.asarray(self.data_buffer, float)
+        if len(set(self.data_buffer)) == 1:
+            return self.data_buffer[0]  # constant-data guard (ref :156-158)
+        series = np.log1p(np.maximum(raw, 0.0)) if self._log1p else raw
+        pred = self._fit_predict(series, self.max_order)
+        if pred is None and not self._log1p:
+            pred = self._fit_predict(np.log1p(np.maximum(raw, 0.0)),
+                                     self.max_order)
+            if pred is not None:
+                return max(0.0, math.expm1(pred))
+        if pred is None:
+            return self.get_last_value()
+        if self._log1p:
+            return max(0.0, math.expm1(pred))
+        return max(0.0, float(pred))
+
+
+class KalmanPredictor(BasePredictor):
+    """Local linear trend Kalman filter: state [level, velocity], observe
+    level. One-step-ahead prediction = level + velocity."""
+
+    def __init__(self, process_var: float = 1.0,
+                 measurement_var: float = 10.0) -> None:
+        super().__init__(minimum_data_points=3)
+        self._q = process_var
+        self._r = measurement_var
+        self._x = np.zeros(2)  # [level, velocity]
+        self._p = np.eye(2) * 1e3
+        self._initialized = False
+        self._f = np.array([[1.0, 1.0], [0.0, 1.0]])
+        self._h = np.array([[1.0, 0.0]])
+
+    def add_data_point(self, value: float) -> None:
+        before = len(self.data_buffer)
+        super().add_data_point(value)
+        if len(self.data_buffer) == before:
+            return
+        z = self.data_buffer[-1]
+        if not self._initialized:
+            self._x[:] = (z, 0.0)
+            self._initialized = True
+            return
+        # predict
+        self._x = self._f @ self._x
+        q = np.array([[0.25, 0.5], [0.5, 1.0]]) * self._q
+        self._p = self._f @ self._p @ self._f.T + q
+        # update
+        s = float((self._h @ self._p @ self._h.T).item()) + self._r
+        k = (self._p @ self._h.T) / s
+        innov = z - float((self._h @ self._x).item())
+        self._x = self._x + (k[:, 0] * innov)
+        self._p = (np.eye(2) - k @ self._h) @ self._p
+
+    def predict_next(self) -> float:
+        if len(self.data_buffer) < self.minimum_data_points:
+            return self.get_last_value()
+        return max(0.0, float(self._x[0] + self._x[1]))
+
+
+class SeasonalPredictor(BasePredictor):
+    """Seasonal-naive with drift: next = value one period ago + average
+    per-period drift. Prophet's role for periodic traffic."""
+
+    def __init__(self, period: int = 24) -> None:
+        super().__init__(minimum_data_points=3)
+        self.period = period
+
+    def predict_next(self) -> float:
+        n = len(self.data_buffer)
+        if n < self.minimum_data_points:
+            return self.get_last_value()
+        if n <= self.period:
+            return self.get_last_value()
+        base = self.data_buffer[n - self.period]
+        cycles = (n - 1) // self.period
+        drift = (self.data_buffer[-1]
+                 - self.data_buffer[(n - 1) - cycles * self.period]) / max(
+                     1, cycles)
+        return max(0.0, base + drift)
+
+
+PREDICTORS = {
+    "constant": ConstantPredictor,
+    "ar": ArPredictor,
+    "arima": ArPredictor,  # reference flag-name compatibility
+    "kalman": KalmanPredictor,
+    "seasonal": SeasonalPredictor,
+    "prophet": SeasonalPredictor,  # reference flag-name compatibility
+}
+
+
+def make_predictor(name: str, **kwargs) -> BasePredictor:
+    try:
+        cls = PREDICTORS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown load predictor {name!r}; one of {sorted(PREDICTORS)}")
+    return cls(**kwargs)

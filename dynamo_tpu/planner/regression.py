@@ -1,0 +1,128 @@
+"""Online regression for load-based planning.
+
+The reference's load-based mode regresses engine step wall time against
+token counts from streamed ForwardPassMetrics, then simulates the queue to
+estimate next-interval TTFT/ITL (ref: components/src/dynamo/planner/utils/
+fpm_regression.py; planner-design.md §Regression Models). Our equivalent
+consumes the worker's LoadMetrics events (kv_router/protocols.py
+LoadMetrics: step_wall_ms + prefill/decode tokens per step).
+
+Model: step_wall_ms ~ a + b * tokens, fit by exponentially-weighted least
+squares so drift (compilation warmup, thermal) ages out.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class OnlineLinearRegression:
+    """EW least squares of y on x with forgetting factor `decay`."""
+
+    def __init__(self, decay: float = 0.98, min_observations: int = 8) -> None:
+        self.decay = decay
+        self.min_observations = min_observations
+        self.num_observations = 0
+        # weighted sufficient statistics
+        self._n = 0.0
+        self._sx = 0.0
+        self._sy = 0.0
+        self._sxx = 0.0
+        self._sxy = 0.0
+
+    def observe(self, x: float, y: float) -> None:
+        d = self.decay
+        self._n = self._n * d + 1.0
+        self._sx = self._sx * d + x
+        self._sy = self._sy * d + y
+        self._sxx = self._sxx * d + x * x
+        self._sxy = self._sxy * d + x * y
+        self.num_observations += 1
+
+    def has_sufficient_data(self) -> bool:
+        return self.num_observations >= self.min_observations
+
+    def coefficients(self) -> Optional[tuple[float, float]]:
+        """(intercept, slope) or None if degenerate."""
+        if not self.has_sufficient_data():
+            return None
+        denom = self._n * self._sxx - self._sx * self._sx
+        if abs(denom) < 1e-9:
+            # All observations at one x (constant batch size): the best
+            # available model is the weighted mean wall time.
+            return (self._sy / self._n, 0.0) if self._n > 0 else None
+        slope = (self._n * self._sxy - self._sx * self._sy) / denom
+        intercept = (self._sy - slope * self._sx) / self._n
+        return intercept, slope
+
+    def predict(self, x: float) -> Optional[float]:
+        coef = self.coefficients()
+        if coef is None:
+            return None
+        return coef[0] + coef[1] * x
+
+
+class TtftEstimator:
+    """Prefill-side load model: chunked-prefill queue simulation.
+
+    estimate_next_ttft = sum of regressed chunk wall times needed to drain
+    `queued_prefill_tokens + avg_isl` at `max_num_batched_tokens` per
+    iteration (ref prefill_planner.py:19-31)."""
+
+    def __init__(self, decay: float = 0.98) -> None:
+        self.reg = OnlineLinearRegression(decay)
+        self._isl_sum = 0.0
+        self._isl_n = 0
+
+    def observe_step(self, prefill_tokens: int, wall_ms: float) -> None:
+        if prefill_tokens > 0:
+            self.reg.observe(float(prefill_tokens), wall_ms)
+
+    def observe_isl(self, isl: float) -> None:
+        self._isl_sum += isl
+        self._isl_n += 1
+
+    @property
+    def avg_isl(self) -> float:
+        return self._isl_sum / self._isl_n if self._isl_n else 0.0
+
+    def has_sufficient_data(self) -> bool:
+        return self.reg.has_sufficient_data()
+
+    def estimate_next_ttft_ms(self, queued_prefill_tokens: int,
+                              max_num_batched_tokens: int) -> Optional[float]:
+        total = queued_prefill_tokens + self.avg_isl
+        if max_num_batched_tokens <= 0:
+            return None
+        chunks = max(1, math.ceil(total / max_num_batched_tokens))
+        est = 0.0
+        remaining = total
+        for _ in range(chunks):
+            step = min(remaining, max_num_batched_tokens)
+            wall = self.reg.predict(step)
+            if wall is None:
+                return None
+            est += max(0.0, wall)
+            remaining -= step
+        return est
+
+
+class ItlEstimator:
+    """Decode-side load model: ITL ~ step wall time at the current decode
+    batch size (one token per active sequence per step)."""
+
+    def __init__(self, decay: float = 0.98) -> None:
+        self.reg = OnlineLinearRegression(decay)
+
+    def observe_step(self, decode_tokens: int, wall_ms: float) -> None:
+        if decode_tokens > 0:
+            self.reg.observe(float(decode_tokens), wall_ms)
+
+    def has_sufficient_data(self) -> bool:
+        return self.reg.has_sufficient_data()
+
+    def estimate_itl_ms(self, active_requests: int) -> Optional[float]:
+        if active_requests <= 0:
+            return None
+        return self.reg.predict(float(active_requests))

@@ -1,0 +1,19 @@
+"""Pre-deployment SLA profiler: rapid (roofline model) and thorough
+(measured endpoint sweeps) modes producing planner interpolation data.
+
+TPU-native equivalent of the reference profiler (components/src/dynamo/
+profiler/)."""
+
+from .chips import CHIPS, ChipSpec, get_chip
+from .timing_model import (
+    TimingModel,
+    kv_bytes_per_token,
+    param_count,
+    rapid_decode_sweep,
+    rapid_prefill_sweep,
+)
+
+__all__ = [
+    "CHIPS", "ChipSpec", "TimingModel", "get_chip", "kv_bytes_per_token",
+    "param_count", "rapid_decode_sweep", "rapid_prefill_sweep",
+]

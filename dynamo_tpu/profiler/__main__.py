@@ -1,0 +1,76 @@
+"""`python -m dynamo_tpu.profiler` — pre-deployment SLA profiling.
+
+Two modes, like the reference profiler (ref: components/src/dynamo/
+profiler/profile_sla.py):
+
+  rapid    — analytical roofline sweep (no hardware): TimingModel over the
+             chip spec + model geometry. Seconds, not hours.
+  thorough — measured sweeps against a live OpenAI endpoint.
+
+Both write the planner's interpolation NPZ files into --output-dir."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ..models import get_config
+from ..planner.interpolation import save_decode_profile, save_prefill_profile
+from ..runtime.logging import get_logger
+from .chips import get_chip
+from .timing_model import TimingModel, rapid_decode_sweep, rapid_prefill_sweep
+
+log = get_logger("profiler.main")
+
+DEFAULT_ISLS = [128, 256, 512, 1024, 2048, 4096, 8192]
+DEFAULT_KV_USAGES = [0.1, 0.2, 0.35, 0.5, 0.65, 0.8, 0.95]
+DEFAULT_CONTEXTS = [256, 1024, 4096, 16384]
+
+
+async def main(argv=None) -> None:
+    parser = argparse.ArgumentParser("dynamo_tpu.profiler")
+    parser.add_argument("--mode", default="rapid",
+                        choices=["rapid", "thorough"])
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--chip", default="v5e")
+    parser.add_argument("--num-chips", type=int, default=1,
+                        help="chips per replica (TP)")
+    parser.add_argument("--output-dir", required=True)
+    parser.add_argument("--isls", type=int, nargs="*", default=DEFAULT_ISLS)
+    parser.add_argument("--osl", type=int, default=128)
+    parser.add_argument("--concurrencies", type=int, nargs="*",
+                        default=[1, 2, 4, 8, 16])
+    parser.add_argument("--url", default="http://127.0.0.1:8000",
+                        help="OpenAI endpoint (thorough mode)")
+    args = parser.parse_args(argv)
+
+    model = get_config(args.model)
+    tm = TimingModel(model, get_chip(args.chip), num_chips=args.num_chips)
+
+    if args.mode == "rapid":
+        prefill = rapid_prefill_sweep(tm, args.isls)
+        decode = rapid_decode_sweep(tm, DEFAULT_KV_USAGES, DEFAULT_CONTEXTS)
+    else:
+        from .sweep import thorough_decode_sweep, thorough_prefill_sweep
+
+        prefill = await thorough_prefill_sweep(
+            args.url, args.model, args.isls, args.num_chips)
+        decode = await thorough_decode_sweep(
+            args.url, args.model, isl=args.isls[len(args.isls) // 2],
+            osl=args.osl, concurrencies=args.concurrencies,
+            num_chips=args.num_chips, max_kv_tokens=tm.max_kv_tokens())
+
+    save_prefill_profile(args.output_dir, prefill["prefill_isl"],
+                         prefill["prefill_ttft"],
+                         prefill["prefill_thpt_per_chip"])
+    save_decode_profile(args.output_dir, decode["x_kv_usage"],
+                        decode["y_context_length"], decode["z_itl"],
+                        decode["z_thpt_per_chip"],
+                        int(decode["max_kv_tokens"][0]))
+    log.info("profiles written to %s (%d prefill / %d decode points)",
+             args.output_dir, len(prefill["prefill_isl"]),
+             len(decode["x_kv_usage"]))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,36 @@
+"""TPU chip specifications for the analytical performance model.
+
+Public per-chip numbers (bf16 peak compute, HBM capacity/bandwidth). These
+are the TPU analog of the reference's pre-swept H100/H200 GPU profiles
+(ref: components/src/dynamo/planner/utils/pre_swept_results/) — the rapid
+profiler computes roofline estimates from them instead of shipping swept
+NPZ archives for hardware we may not have."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float  # peak dense bf16 TFLOP/s
+    hbm_gib: float
+    hbm_gbps: float  # GB/s
+    ici_gbps: float  # per-link interconnect bandwidth
+
+
+CHIPS = {
+    "v5e": ChipSpec("v5e", 197.0, 16.0, 819.0, 186.0),
+    "v5p": ChipSpec("v5p", 459.0, 95.0, 2765.0, 448.0),
+    "v6e": ChipSpec("v6e", 918.0, 32.0, 1640.0, 448.0),
+    # CPU fallback so rapid profiling runs anywhere (tests/dev boxes)
+    "cpu": ChipSpec("cpu", 0.5, 8.0, 50.0, 10.0),
+}
+
+
+def get_chip(name: str) -> ChipSpec:
+    key = name.lower().replace(" ", "").replace("lite", "e")
+    if key in CHIPS:
+        return CHIPS[key]
+    raise ValueError(f"unknown chip {name!r}; one of {sorted(CHIPS)}")

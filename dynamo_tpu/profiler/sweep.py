@@ -1,0 +1,149 @@
+"""Thorough profiling: measured sweeps against a live OpenAI endpoint.
+
+The aiperf-equivalent harness (ref: benchmarks/README.md aiperf sweeps;
+components/src/dynamo/profiler/thorough.py): synthetic prompts at fixed
+ISL/OSL and concurrency, TTFT measured to the first SSE delta and ITL from
+inter-delta gaps, aggregated per sweep point and written in the planner's
+interpolation format."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import aiohttp
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("profiler.sweep")
+
+
+@dataclasses.dataclass
+class SweepPoint:
+    isl: int
+    osl: int
+    concurrency: int
+    ttft_ms_p50: float
+    itl_ms_p50: float
+    requests: int
+    tokens_per_sec: float
+
+
+def _synthetic_prompt(isl: int) -> str:
+    # Byte-level tokenizers: ~1 token/char; word tokenizers: close enough
+    # for a sweep point. The measured ISL is what lands in the NPZ.
+    unit = "profiling sweep payload "
+    return (unit * (isl // len(unit) + 1))[:isl]
+
+
+async def _one_request(session: aiohttp.ClientSession, url: str, model: str,
+                       isl: int, osl: int) -> Optional[tuple[float, list[float]]]:
+    body = {"model": model, "prompt": _synthetic_prompt(isl),
+            "max_tokens": osl, "stream": True, "temperature": 1.0}
+    start = time.monotonic()
+    stamps: list[float] = []
+    try:
+        async with session.post(url + "/v1/completions", json=body) as resp:
+            if resp.status != 200:
+                log.warning("sweep request failed: HTTP %d", resp.status)
+                return None
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                stamps.append(time.monotonic())
+    except Exception as exc:  # noqa: BLE001 — a failed request is dropped
+        log.warning("sweep request error: %r", exc)
+        return None
+    if not stamps:
+        return None
+    ttft = stamps[0] - start
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    return ttft, gaps
+
+
+async def run_sweep_point(url: str, model: str, isl: int, osl: int,
+                          concurrency: int, num_requests: int
+                          ) -> Optional[SweepPoint]:
+    async with aiohttp.ClientSession() as session:
+        sem = asyncio.Semaphore(concurrency)
+        results: list[tuple[float, list[float]]] = []
+        start = time.monotonic()
+
+        async def worker() -> None:
+            async with sem:
+                r = await _one_request(session, url, model, isl, osl)
+                if r is not None:
+                    results.append(r)
+
+        await asyncio.gather(*[worker() for _ in range(num_requests)])
+        wall = time.monotonic() - start
+    if not results:
+        return None
+    ttfts = np.array([r[0] for r in results]) * 1e3
+    gaps = np.concatenate([r[1] for r in results if r[1]] or [np.zeros(1)])
+    total_tokens = sum(1 + len(r[1]) for r in results)
+    return SweepPoint(
+        isl=isl, osl=osl, concurrency=concurrency,
+        ttft_ms_p50=float(np.percentile(ttfts, 50)),
+        itl_ms_p50=float(np.percentile(gaps * 1e3, 50)) if gaps.size else 0.0,
+        requests=len(results),
+        tokens_per_sec=total_tokens / max(1e-9, wall),
+    )
+
+
+async def thorough_prefill_sweep(url: str, model: str, isls: list[int],
+                                 num_chips: int, requests_per_point: int = 8
+                                 ) -> dict:
+    """Prefill profile: osl=1 isolates TTFT (ref profile_prefill.py)."""
+    isl_out, ttft_out, thpt_out = [], [], []
+    for isl in isls:
+        pt = await run_sweep_point(url, model, isl, osl=1, concurrency=1,
+                                   num_requests=requests_per_point)
+        if pt is None:
+            continue
+        isl_out.append(isl)
+        ttft_out.append(pt.ttft_ms_p50)
+        thpt_out.append(isl / (pt.ttft_ms_p50 / 1e3) / num_chips)
+        log.info("prefill point isl=%d ttft=%.1fms", isl, pt.ttft_ms_p50)
+    return {"prefill_isl": np.asarray(isl_out, float),
+            "prefill_ttft": np.asarray(ttft_out, float),
+            "prefill_thpt_per_chip": np.asarray(thpt_out, float)}
+
+
+async def thorough_decode_sweep(url: str, model: str, isl: int, osl: int,
+                                concurrencies: list[int], num_chips: int,
+                                max_kv_tokens: int,
+                                requests_per_point: int = 8) -> dict:
+    """Decode profile over concurrency (=> kv usage) at fixed context
+    (ref profile_decode.py)."""
+    xs, ys, itls, thpts = [], [], [], []
+    context = isl + osl / 2
+    for c in concurrencies:
+        pt = await run_sweep_point(url, model, isl, osl, concurrency=c,
+                                   num_requests=max(requests_per_point, c))
+        if pt is None:
+            continue
+        xs.append(min(1.0, c * context / max_kv_tokens))
+        ys.append(context)
+        itls.append(pt.itl_ms_p50)
+        thpts.append(pt.tokens_per_sec / num_chips)
+        log.info("decode point conc=%d itl=%.2fms", c, pt.itl_ms_p50)
+    return {"x_kv_usage": np.asarray(xs), "y_context_length": np.asarray(ys),
+            "z_itl": np.asarray(itls), "z_thpt_per_chip": np.asarray(thpts),
+            "max_kv_tokens": np.asarray([max_kv_tokens])}
+
+
+def dump_summary(path: str, prefill: dict, decode: dict) -> None:
+    with open(path, "w") as f:
+        json.dump({
+            "prefill_points": len(prefill.get("prefill_isl", [])),
+            "decode_points": len(decode.get("x_kv_usage", [])),
+        }, f)

@@ -224,6 +224,21 @@ class TestManagerTiering:
         mgr = self._manager(tmp_path)
         assert mgr.read_blocks([999]) is None
 
+    def test_promotion_eviction_does_not_corrupt_read(self, tmp_path):
+        """Regression: disk-hit promotion can evict back into the same
+        capacity-1 disk tier, recycling the slab slot the promoted block
+        was read from. The read must return a copy, not a view."""
+        mgr = self._manager(tmp_path, disk_blocks=1)
+        blocks = {h: _block(h) for h in (1, 2, 3)}
+        for h, d in blocks.items():
+            mgr._offload_sink(h, d, None)
+        # host={2,3}, disk={1}; promoting 1 evicts a host block into the
+        # full disk tier, which evicts 1 and reuses its slot.
+        out = mgr.read_blocks([1])
+        np.testing.assert_array_equal(out[0], blocks[1])
+        # and the host registration of 1 must also hold the right bytes
+        np.testing.assert_array_equal(mgr.host.get(1), blocks[1])
+
 
 class TestOffloadManager:
     def test_gather_insert_roundtrip(self):

@@ -1,0 +1,331 @@
+"""Planner tests: predictors, interpolators, scaling math, budget clamp,
+load-based regression, metrics parsing, virtual connector (ref test areas:
+tests/planner/ + planner unit behavior in planner_core.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ArPredictor,
+    CallbackConnector,
+    ConstantPredictor,
+    DecodeInterpolator,
+    FrontendScraper,
+    ItlEstimator,
+    KalmanPredictor,
+    LoadBasedPlanner,
+    LoadEventSource,
+    PlannerConfig,
+    PrefillInterpolator,
+    SeasonalPredictor,
+    SlaPlanner,
+    TrafficStats,
+    TtftEstimator,
+    VirtualConnector,
+    apply_chip_budget,
+    make_predictor,
+    parse_prometheus_text,
+    save_decode_profile,
+    save_prefill_profile,
+)
+
+
+class TestPredictors:
+    def test_constant(self):
+        p = ConstantPredictor()
+        for v in (0, 0, 5, 8):
+            p.add_data_point(v)
+        assert p.predict_next() == 8
+
+    def test_leading_idle_skipped(self):
+        p = ConstantPredictor()
+        p.add_data_point(0)
+        p.add_data_point(0)
+        assert p.data_buffer == []
+        p.add_data_point(3)
+        p.add_data_point(0)  # post-traffic zero IS recorded
+        assert p.data_buffer == [3.0, 0.0]
+
+    def test_ar_tracks_linear_trend(self):
+        p = ArPredictor()
+        for t in range(20):
+            p.add_data_point(10 + 2 * t)
+        pred = p.predict_next()
+        assert 45 <= pred <= 55  # next true value is 50
+
+    def test_ar_constant_guard(self):
+        p = ArPredictor()
+        for _ in range(10):
+            p.add_data_point(7.0)
+        assert p.predict_next() == 7.0
+
+    def test_kalman_tracks_trend(self):
+        p = KalmanPredictor()
+        for t in range(30):
+            p.add_data_point(100 + 5 * t)
+        pred = p.predict_next()
+        assert 230 <= pred <= 260  # next true value 250
+
+    def test_seasonal(self):
+        p = SeasonalPredictor(period=4)
+        pattern = [10, 20, 30, 40]
+        for _ in range(3):
+            for v in pattern:
+                p.add_data_point(v)
+        # next position in cycle is pattern[0]
+        assert abs(p.predict_next() - 10) < 5
+
+    def test_nan_treated_as_zero(self):
+        p = ConstantPredictor()
+        p.add_data_point(5)
+        p.add_data_point(float("nan"))
+        assert p.data_buffer[-1] == 0.0
+
+    def test_registry(self):
+        assert isinstance(make_predictor("arima"), ArPredictor)
+        with pytest.raises(ValueError):
+            make_predictor("nope")
+
+
+def _prefill_profile(tmp_path):
+    isl = np.array([128, 512, 1024, 4096])
+    ttft = np.array([20.0, 60.0, 120.0, 500.0])
+    thpt = np.array([8000.0, 7000.0, 6000.0, 4000.0])  # tokens/s/chip
+    save_prefill_profile(str(tmp_path), isl, ttft, thpt)
+    return PrefillInterpolator(str(tmp_path))
+
+
+def _decode_profile(tmp_path):
+    # grid of kv_usage x context; itl grows with kv usage
+    kv = np.tile(np.linspace(0.1, 1.0, 10), 3)
+    ctx = np.repeat([256, 1024, 4096], 10)
+    itl = 5.0 + 40.0 * kv + ctx / 1024.0
+    thpt = 2000.0 * kv / (1 + ctx / 4096.0)
+    save_decode_profile(str(tmp_path), kv, ctx, itl, thpt,
+                        max_kv_tokens=100_000)
+    return DecodeInterpolator(str(tmp_path))
+
+
+class TestInterpolators:
+    def test_prefill_interp_clamps_and_interpolates(self, tmp_path):
+        interp = _prefill_profile(tmp_path)
+        assert interp.interpolate_ttft(128) == pytest.approx(20.0)
+        mid = interp.interpolate_ttft(768)
+        assert 60.0 < mid < 120.0
+        assert interp.interpolate_ttft(99999) == pytest.approx(500.0)
+        assert interp.interpolate_thpt_per_chip(128) == pytest.approx(8000.0)
+
+    def test_decode_interp_monotone_itl_in_kv(self, tmp_path):
+        interp = _decode_profile(tmp_path)
+        low = interp.interpolate_itl(concurrency=10, context_length=1024)
+        high = interp.interpolate_itl(concurrency=90, context_length=1024)
+        assert high > low
+
+    def test_find_best_thpt_respects_itl(self, tmp_path):
+        interp = _decode_profile(tmp_path)
+        thpt, itl, kv = interp.find_best_throughput_per_chip(
+            itl=25.0, context_length=1024)
+        assert itl <= 25.0 + 1e-6
+        # tighter SLA -> lower operating kv load -> lower throughput
+        thpt2, itl2, kv2 = interp.find_best_throughput_per_chip(
+            itl=15.0, context_length=1024)
+        assert kv2 <= kv and thpt2 <= thpt + 1e-9
+
+    def test_reference_key_aliases(self, tmp_path):
+        raw = {
+            "prefill_isl": [100, 200], "prefill_ttft": [10, 20],
+            "prefill_thpt_per_gpu": [100.0, 90.0],  # reference key name
+        }
+        interp = PrefillInterpolator(raw_data={k: np.asarray(v)
+                                               for k, v in raw.items()})
+        assert interp.interpolate_thpt_per_chip(100) == pytest.approx(100.0)
+
+
+class TestScalingMath:
+    def _planner(self, tmp_path, **cfg_kw):
+        cfg = PlannerConfig(adjustment_interval=60.0, ttft_ms=200.0,
+                            itl_ms=30.0, no_correction=True, **cfg_kw)
+        applied = {}
+        conn = CallbackConnector(lambda c, n: applied.__setitem__(c, n))
+        pl = SlaPlanner(cfg, conn,
+                        prefill_interpolator=_prefill_profile(tmp_path / "p"),
+                        decode_interpolator=_decode_profile(tmp_path / "d"))
+        return pl, applied
+
+    def test_scale_up_with_load(self, tmp_path):
+        pl, _ = self._planner(tmp_path)
+        low = pl.plan(TrafficStats(num_req=30, ttft_ms=50, itl_ms=10,
+                                   isl=512, osl=128,
+                                   request_duration_s=2.0))
+        high = pl.plan(TrafficStats(num_req=3000, ttft_ms=50, itl_ms=10,
+                                    isl=512, osl=128,
+                                    request_duration_s=2.0))
+        assert low is not None and high is not None
+        assert high[0] >= low[0] and high[1] >= low[1]
+        assert high[0] > 1  # real prefill scale-out at 3000 req/min
+
+    def test_no_traffic_skips(self, tmp_path):
+        pl, _ = self._planner(tmp_path)
+        assert pl.plan(TrafficStats()) is None
+        assert pl.plan(TrafficStats(num_req=0, ttft_ms=1, itl_ms=1,
+                                    isl=10, osl=10,
+                                    request_duration_s=1)) is None
+
+    def test_correction_factor_shrinks_prefill_estimate(self, tmp_path):
+        # observed TTFT much better than profile -> correction < 1 ->
+        # fewer prefill replicas needed
+        pl, _ = self._planner(tmp_path)
+        pl.config.no_correction = False
+        pl.state.num_d_workers = 1
+        stats = TrafficStats(num_req=2000, ttft_ms=30.0, itl_ms=10,
+                             isl=512, osl=128, request_duration_s=2.0)
+        fast = pl.plan(stats)
+        assert pl.state.p_correction < 1.0
+        pl2, _ = self._planner(tmp_path)
+        base = pl2.plan(stats)  # no correction
+        assert fast[0] <= base[0]
+
+    def test_budget_clamp(self):
+        cfg = PlannerConfig(max_chip_budget=8, prefill_engine_num_chips=2,
+                            decode_engine_num_chips=2, min_endpoint=1)
+        p, d = apply_chip_budget(4, 4, cfg)  # wants 16 chips, budget 8
+        assert p * 2 + d * 2 <= 8
+        assert p >= 1 and d >= 1
+
+    def test_budget_unlimited(self):
+        cfg = PlannerConfig(max_chip_budget=0)
+        assert apply_chip_budget(7, 9, cfg) == (7, 9)
+
+    def test_budget_below_minimum(self):
+        cfg = PlannerConfig(max_chip_budget=1, prefill_engine_num_chips=2,
+                            decode_engine_num_chips=2, min_endpoint=1)
+        assert apply_chip_budget(3, 3, cfg) == (0, 0)
+
+    def test_budget_aggregated_gives_all_to_decode(self):
+        """Regression: num_p=0 (aggregated) must not reserve prefill chips
+        or zero out decode when budget < prefill+decode minimum."""
+        cfg = PlannerConfig(max_chip_budget=5, prefill_engine_num_chips=1,
+                            decode_engine_num_chips=1, min_endpoint=1)
+        assert apply_chip_budget(0, 10, cfg) == (0, 5)
+        cfg2 = PlannerConfig(max_chip_budget=1, prefill_engine_num_chips=2,
+                             decode_engine_num_chips=1, min_endpoint=1)
+        assert apply_chip_budget(0, 2, cfg2) == (0, 1)
+
+
+class TestLoadBased:
+    def test_regressions_learn_linear_model(self):
+        est = TtftEstimator()
+        for tokens in range(100, 2100, 100):
+            est.observe_step(tokens, 1.0 + 0.01 * tokens)  # 10us/token
+        est.observe_isl(1000)
+        # 3000 queued + 1000 isl at 2048/chunk -> 2 chunks
+        ttft = est.estimate_next_ttft_ms(3000, 2048)
+        expect = (1.0 + 0.01 * 2048) + (1.0 + 0.01 * (4000 - 2048))
+        assert ttft == pytest.approx(expect, rel=0.05)
+
+    def test_itl_estimator(self):
+        est = ItlEstimator()
+        for bs in range(1, 20):
+            est.observe_step(bs, 5.0 + 0.5 * bs)
+        assert est.estimate_itl_ms(10) == pytest.approx(10.0, rel=0.05)
+
+    def test_scale_up_down_decisions(self):
+        cfg = PlannerConfig(itl_ms=20.0, min_endpoint=1,
+                            scale_down_sensitivity=0.5)
+        src = LoadEventSource()
+        pl = LoadBasedPlanner(cfg, CallbackConnector(lambda c, n: None), src)
+        # feed steps: heavy load -> wall time above SLA at observed batch
+        for i in range(20):
+            src.on_event({"worker_id": 1, "dp_rank": 0,
+                          "step_wall_ms": 30.0 + i * 0.01,
+                          "decode_tokens_in_step": 8,
+                          "active_requests": 8})
+            pl.ingest()
+        assert pl.plan_decode(current_replicas=2) == 3  # all violate
+        # light load -> well under SLA * sensitivity
+        src.latest.clear()
+        pl2 = LoadBasedPlanner(cfg, CallbackConnector(lambda c, n: None), src)
+        for i in range(20):
+            src.on_event({"worker_id": 1, "dp_rank": 0,
+                          "step_wall_ms": 2.0 + i * 0.01,
+                          "decode_tokens_in_step": 4,
+                          "active_requests": 4})
+            pl2.ingest()
+        assert pl2.plan_decode(current_replicas=2) == 1
+
+
+class TestMetricsParsing:
+    def test_parse_prometheus_text(self):
+        text = """# HELP x y
+dynt_requests_total{namespace="n",status="ok"} 42
+dynt_time_to_first_token_seconds_sum{model="m"} 1.5
+dynt_time_to_first_token_seconds_count{model="m"} 10
+"""
+        snap = parse_prometheus_text(text)
+        assert snap[("dynt_requests_total",
+                     (("namespace", "n"), ("status", "ok")))] == 42
+        assert snap[("dynt_time_to_first_token_seconds_sum",
+                     (("model", "m"),))] == 1.5
+
+    def test_scraper_deltas(self, monkeypatch):
+        pages = [
+            # baseline
+            'dynt_requests_total{status="ok"} 10\n'
+            'dynt_time_to_first_token_seconds_sum{model="m"} 1.0\n'
+            'dynt_time_to_first_token_seconds_count{model="m"} 10\n'
+            'dynt_inter_token_latency_seconds_sum{model="m"} 0.5\n'
+            'dynt_inter_token_latency_seconds_count{model="m"} 50\n'
+            'dynt_input_sequence_tokens_sum{model="m"} 1000\n'
+            'dynt_input_sequence_tokens_count{model="m"} 10\n'
+            'dynt_output_sequence_tokens_sum{model="m"} 500\n'
+            'dynt_output_sequence_tokens_count{model="m"} 10\n'
+            'dynt_request_duration_seconds_sum{namespace="n"} 5\n'
+            'dynt_request_duration_seconds_count{namespace="n"} 10\n',
+            # after one interval: +5 req, ttft avg 100ms, itl avg 10ms
+            'dynt_requests_total{status="ok"} 15\n'
+            'dynt_time_to_first_token_seconds_sum{model="m"} 1.5\n'
+            'dynt_time_to_first_token_seconds_count{model="m"} 15\n'
+            'dynt_inter_token_latency_seconds_sum{model="m"} 1.0\n'
+            'dynt_inter_token_latency_seconds_count{model="m"} 100\n'
+            'dynt_input_sequence_tokens_sum{model="m"} 2000\n'
+            'dynt_input_sequence_tokens_count{model="m"} 15\n'
+            'dynt_output_sequence_tokens_sum{model="m"} 1000\n'
+            'dynt_output_sequence_tokens_count{model="m"} 15\n'
+            'dynt_request_duration_seconds_sum{namespace="n"} 10\n'
+            'dynt_request_duration_seconds_count{namespace="n"} 15\n',
+        ]
+        scraper = FrontendScraper("http://unused/metrics", "m")
+        it = iter(pages)
+        monkeypatch.setattr(scraper, "_fetch",
+                            lambda: parse_prometheus_text(next(it)))
+        assert scraper.scrape() is None  # baseline
+        stats = scraper.scrape()
+        assert stats.num_req == 5
+        assert stats.ttft_ms == pytest.approx(100.0)
+        assert stats.itl_ms == pytest.approx(10.0)
+        assert stats.isl == pytest.approx(200.0)
+        assert stats.osl == pytest.approx(100.0)
+        assert stats.is_valid()
+
+
+class TestVirtualConnector:
+    def test_decision_roundtrip(self, run, mem_runtime_config):
+        from dynamo_tpu.planner import TargetReplica
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        async def go():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            try:
+                conn = VirtualConnector(rt)
+                await conn.set_component_replicas(
+                    [TargetReplica("backend", 3),
+                     TargetReplica("prefill", 2)])
+                decision = await conn.read_decision()
+                assert decision["targets"] == {"backend": 3, "prefill": 2}
+                assert decision["decision_id"] == 1
+            finally:
+                await rt.shutdown()
+
+        run(go())

@@ -1,0 +1,94 @@
+"""Profiler tests: roofline timing model sanity + rapid sweep output feeds
+the planner interpolators end-to-end (profiler -> NPZ -> planner)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import get_config
+from dynamo_tpu.planner import (
+    DecodeInterpolator,
+    PlannerConfig,
+    PrefillInterpolator,
+    SlaPlanner,
+    TrafficStats,
+    save_decode_profile,
+    save_prefill_profile,
+)
+from dynamo_tpu.planner.connectors import CallbackConnector
+from dynamo_tpu.profiler import (
+    TimingModel,
+    get_chip,
+    param_count,
+    rapid_decode_sweep,
+    rapid_prefill_sweep,
+)
+
+
+@pytest.fixture(scope="module")
+def tm():
+    return TimingModel(get_config("qwen3-0.6b"), get_chip("v5e"))
+
+
+class TestTimingModel:
+    def test_param_count_plausible(self, tm):
+        # Qwen3-0.6B-class: a few hundred million params
+        assert 3e8 < param_count(tm.model) < 1.2e9
+
+    def test_prefill_scales_superlinearly(self, tm):
+        t1 = tm.prefill_ttft_ms(1024)
+        t2 = tm.prefill_ttft_ms(8192)
+        assert t2 > 8 * t1 * 0.9  # attention quadratic term kicks in
+
+    def test_decode_itl_grows_with_kv(self, tm):
+        small = tm.decode_itl_ms(batch=1, context=128)
+        large = tm.decode_itl_ms(batch=64, context=8192)
+        assert large > small
+
+    def test_max_kv_tokens_positive_and_bounded(self, tm):
+        mk = tm.max_kv_tokens()
+        assert mk > 0
+        # Can't exceed HBM / kv_bytes_per_token
+        from dynamo_tpu.profiler import kv_bytes_per_token
+        hbm = tm.chip.hbm_gib * (1 << 30)
+        assert mk * kv_bytes_per_token(tm.model) < hbm
+
+    def test_unknown_chip_raises(self):
+        with pytest.raises(ValueError):
+            get_chip("h100")
+
+
+class TestRapidSweepToPlanner:
+    def test_profiles_feed_planner(self, tm, tmp_path):
+        prefill = rapid_prefill_sweep(tm, [128, 512, 2048, 8192])
+        decode = rapid_decode_sweep(tm, [0.1, 0.3, 0.5, 0.7, 0.9],
+                                    [256, 1024, 4096])
+        save_prefill_profile(str(tmp_path), prefill["prefill_isl"],
+                             prefill["prefill_ttft"],
+                             prefill["prefill_thpt_per_chip"])
+        save_decode_profile(str(tmp_path), decode["x_kv_usage"],
+                            decode["y_context_length"], decode["z_itl"],
+                            decode["z_thpt_per_chip"],
+                            int(decode["max_kv_tokens"][0]))
+        cfg = PlannerConfig(adjustment_interval=60, ttft_ms=1000.0,
+                            itl_ms=50.0, no_correction=True)
+        planner = SlaPlanner(
+            cfg, CallbackConnector(lambda c, n: None),
+            prefill_interpolator=PrefillInterpolator(str(tmp_path)),
+            decode_interpolator=DecodeInterpolator(str(tmp_path)))
+        decision = planner.plan(TrafficStats(
+            num_req=600, ttft_ms=100, itl_ms=20, isl=1024, osl=128,
+            request_duration_s=3.0))
+        assert decision is not None
+        num_p, num_d = decision
+        assert num_p >= 1 and num_d >= 1
+
+    def test_rapid_cli(self, tmp_path):
+        import asyncio
+        from dynamo_tpu.profiler.__main__ import main
+
+        asyncio.run(main(["--mode", "rapid", "--model", "qwen3-0.6b",
+                          "--chip", "v5e", "--output-dir", str(tmp_path)]))
+        assert (tmp_path / "prefill_raw_data.npz").exists()
+        assert (tmp_path / "decode_raw_data.npz").exists()
+        data = np.load(tmp_path / "decode_raw_data.npz")
+        assert data["z_itl"].shape == data["x_kv_usage"].shape
