@@ -100,6 +100,8 @@ class TpuWorker:
         warmup: bool = True,
         mode: str = "aggregated",  # aggregated | prefill | decode
         kvbm_config=None,  # Optional[block_manager.KvbmConfig]
+        tool_parser: Optional[str] = None,
+        reasoning_parser: Optional[str] = None,
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -127,6 +129,8 @@ class TpuWorker:
             kv_block_size=self.runner_config.page_size,
             total_kv_blocks=self.runner_config.num_pages,
             tokenizer={"kind": "byte"},
+            tool_parser=tool_parser,
+            reasoning_parser=reasoning_parser,
         )
         self._tasks: list[asyncio.Task] = []
         self._served = None
@@ -429,6 +433,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--kvbm-disk-path", default="/tmp/dynamo_tpu_kvbm.bin")
     parser.add_argument("--kvbm-object-store", default=None,
                         help="G4 blob-store root (e.g. a gcsfuse mountpoint)")
+    parser.add_argument("--tool-call-parser", default=None,
+                        choices=["hermes", "qwen", "mistral", "llama3_json",
+                                 "pythonic"])
+    parser.add_argument("--reasoning-parser", default=None,
+                        choices=["think", "deepseek-r1", "granite"])
     args = parser.parse_args(argv)
 
     component = args.component
@@ -459,6 +468,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
         ),
         mesh_config=MeshConfig(dp=args.dp, tp=args.tp),
         kvbm_config=kvbm_config,
+        tool_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser,
     )
     await worker.start()
     try:

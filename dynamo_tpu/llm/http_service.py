@@ -122,7 +122,15 @@ class HttpService:
             return web.json_response(_error_body(400, str(exc)), status=400)
 
         current_request_id.set(preprocessed.request_id)
-        delta_gen = DeltaGenerator(entry.preprocessor, preprocessed, kind=kind)
+        # Tool parsing activates only when the request declares tools (the
+        # reference gates on request.tools the same way); reasoning parsing
+        # follows the model card.
+        card = entry.preprocessor.card
+        delta_gen = DeltaGenerator(
+            entry.preprocessor, preprocessed, kind=kind,
+            tool_parser=(card.tool_parser if body.get("tools") else None),
+            reasoning_parser=card.reasoning_parser,
+        )
         stream = bool(body.get("stream", False))
         rt_metrics.INPUT_TOKENS.labels(model=model).observe(len(preprocessed.token_ids))
         if stream:

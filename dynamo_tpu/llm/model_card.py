@@ -36,6 +36,9 @@ class ModelDeploymentCard:
     max_output_tokens: int = 4096
     kv_block_size: int = 16
     chat_template: Optional[str] = None
+    # Output parsing (ref: lib/parsers wiring via model card runtime config)
+    tool_parser: Optional[str] = None  # hermes|mistral|llama3_json|pythonic
+    reasoning_parser: Optional[str] = None  # think|deepseek-r1|granite
     # Serving component this card belongs to
     namespace: str = "dynamo"
     component: str = "backend"

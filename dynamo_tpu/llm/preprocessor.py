@@ -151,7 +151,11 @@ class DeltaGenerator:
         preprocessor: OpenAIPreprocessor,
         request: PreprocessedRequest,
         kind: str = "chat",  # chat | completions
+        tool_parser: Optional[str] = None,
+        reasoning_parser: Optional[str] = None,
     ) -> None:
+        from ..parsers import make_reasoning_parser, make_tool_parser
+
         self.pre = preprocessor
         self.request = request
         self.kind = kind
@@ -164,6 +168,13 @@ class DeltaGenerator:
         self._stopped = False
         self._role_sent = False
         self.full_text = ""
+        self.full_reasoning = ""
+        self.tool_calls: list = []
+        # Output parsers (chat only; ref: chat_completions/jail.rs wiring)
+        self._reasoning = (make_reasoning_parser(reasoning_parser)
+                           if kind == "chat" else None)
+        self._tools = (make_tool_parser(tool_parser)
+                       if kind == "chat" else None)
 
     # stop-string handling ------------------------------------------------
 
@@ -222,6 +233,45 @@ class DeltaGenerator:
             }],
         }
 
+    def _route(self, text: str, final: bool) -> list[dict]:
+        """Route emitted text through reasoning + tool parsers into OpenAI
+        delta dicts (ref: parsers crate via chat_completions/jail.rs)."""
+        deltas: list[dict] = []
+        reason_text, content_text = "", text
+        if self._reasoning is not None:
+            ev = self._reasoning.push(text)
+            if final:
+                fin = self._reasoning.finalize()
+                ev.reasoning += fin.reasoning
+                ev.content += fin.content
+            reason_text, content_text = ev.reasoning, ev.content
+        if reason_text:
+            self.full_reasoning += reason_text
+            deltas.append({"reasoning_content": reason_text})
+        if self._tools is not None:
+            tev = self._tools.push(content_text)
+            if final:
+                fin = self._tools.finalize()
+                tev.content += fin.content
+                tev.calls.extend(fin.calls)
+            if tev.content:
+                self.full_text += tev.content
+                deltas.append({"content": tev.content})
+            if tev.calls:
+                start = len(self.tool_calls)
+                payload = [c.to_openai(start + i)
+                           for i, c in enumerate(tev.calls)]
+                self.tool_calls.extend(tev.calls)
+                deltas.append({"tool_calls": payload})
+        elif content_text:
+            self.full_text += content_text
+            deltas.append({"content": content_text})
+        return deltas
+
+    def _final_reason(self, reason: str) -> str:
+        return "tool_calls" if (self.tool_calls and reason == "stop") \
+            else reason
+
     def on_output(self, output: EngineOutput) -> list[dict]:
         """Convert one engine item into zero or more SSE chunks."""
         if self._stopped:
@@ -237,21 +287,19 @@ class DeltaGenerator:
         if final:
             text += self.detok.flush()
         emit, hit_stop = self._filter_stop(text, final)
-        if emit:
-            self.full_text += emit
-            delta: dict = {"content": emit}
+        for delta in self._route(emit, final or hit_stop):
             if self.kind == "chat" and not self._role_sent:
                 delta["role"] = "assistant"
                 self._role_sent = True
             chunks.append(self._chunk(delta, None))
         if hit_stop:
-            self.finish_reason = "stop"
+            self.finish_reason = self._final_reason("stop")
             self._stopped = True
-            chunks.append(self._chunk({}, "stop"))
+            chunks.append(self._chunk({}, self.finish_reason))
         elif final:
-            self.finish_reason = output.finish_reason
+            self.finish_reason = self._final_reason(output.finish_reason)
             self._stopped = True
-            chunks.append(self._chunk({}, output.finish_reason))
+            chunks.append(self._chunk({}, self.finish_reason))
         return chunks
 
     def usage(self) -> dict:
@@ -264,6 +312,15 @@ class DeltaGenerator:
     def final_response(self) -> dict:
         """Non-streaming aggregate response."""
         if self.kind == "chat":
+            message: dict = {"role": "assistant", "content": self.full_text}
+            if self.full_reasoning:
+                message["reasoning_content"] = self.full_reasoning
+            if self.tool_calls:
+                message["tool_calls"] = [
+                    {k: v for k, v in c.to_openai(i).items() if k != "index"}
+                    for i, c in enumerate(self.tool_calls)]
+                if not self.full_text:
+                    message["content"] = None
             return {
                 "id": self.chunk_id,
                 "object": "chat.completion",
@@ -271,7 +328,7 @@ class DeltaGenerator:
                 "model": self.request.model,
                 "choices": [{
                     "index": 0,
-                    "message": {"role": "assistant", "content": self.full_text},
+                    "message": message,
                     "finish_reason": self.finish_reason or "stop",
                 }],
                 "usage": self.usage(),

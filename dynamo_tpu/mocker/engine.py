@@ -40,6 +40,9 @@ class MockerConfig:
     max_prefill_tokens_per_step: int = 2048  # chunked prefill budget
     prefill_us_per_token: float = 300.0
     decode_base_ms: float = 8.0
+    # Echo mode: generated tokens replay the prompt (protocol/parser E2E
+    # testing — lets a test drive exact output text through the frontend).
+    echo: bool = False
     decode_us_per_seq: float = 100.0
     speedup_ratio: float = 1.0
     watermark: float = 0.01  # keep this fraction of blocks free
@@ -365,8 +368,12 @@ class MockerEngine:
                 seq.queue.put_nowait(None)
                 finished.append(seq)
                 continue
-            # Deterministic pseudo-output: cycle through printable ASCII.
-            token = 97 + ((len(req.token_ids) + seq.generated) % 26)
+            # Deterministic pseudo-output: echo the prompt, or cycle
+            # through printable ASCII.
+            if self.config.echo and seq.generated < len(req.token_ids):
+                token = int(req.token_ids[seq.generated])
+            else:
+                token = 97 + ((len(req.token_ids) + seq.generated) % 26)
             seq.generated += 1
             decoded += 1
             finish = None

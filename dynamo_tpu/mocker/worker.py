@@ -29,6 +29,8 @@ class MockerWorker:
         config: Optional[MockerConfig] = None,
         load_publish_interval: float = 1.0,
         mode: str = "aggregated",  # aggregated | prefill
+        tool_parser: Optional[str] = None,
+        reasoning_parser: Optional[str] = None,
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -43,6 +45,8 @@ class MockerWorker:
             kv_block_size=self.config.block_size,
             total_kv_blocks=self.config.num_blocks,
             tokenizer={"kind": "byte"},
+            tool_parser=tool_parser,
+            reasoning_parser=reasoning_parser,
         )
         self.engine: Optional[MockerEngine] = None
         self._load_task: Optional[asyncio.Task] = None
@@ -100,6 +104,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--speedup-ratio", type=float, default=1.0)
     parser.add_argument("--mode", default="aggregated",
                         choices=["aggregated", "prefill"])
+    parser.add_argument("--echo", action="store_true",
+                        help="generated tokens replay the prompt (parser/"
+                             "protocol E2E testing)")
+    parser.add_argument("--tool-call-parser", default=None)
+    parser.add_argument("--reasoning-parser", default=None)
     args = parser.parse_args(argv)
 
     component = args.component
@@ -117,7 +126,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
             num_blocks=args.num_blocks,
             max_batch=args.max_batch,
             speedup_ratio=args.speedup_ratio,
+            echo=args.echo,
         ),
+        tool_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser,
     )
     await worker.start()
     try:

@@ -1,0 +1,285 @@
+"""Streaming tool-call parsers.
+
+Extracts structured function calls from the generated text stream, per
+format family, matching the reference's parser suite (ref: lib/parsers/
+src/tool_calling/{json,pythonic,xml}/ and parsers.rs):
+
+  hermes    — `<tool_call>{"name":..,"arguments":{..}}</tool_call>` blocks
+              (Qwen/Hermes chat templates; ref xml + json hybrid parsers)
+  mistral   — `[TOOL_CALLS] [{...}, ...]` marker + JSON array
+  llama3    — the whole message is a JSON object
+              `{"name":..,"parameters":{..}}` (llama3.1 json tool format)
+  pythonic  — `[fn(a=1), other(b="x")]` call list parsed via ast
+              (llama-4 / pythonic format, ref tool_calling/pythonic/)
+
+Streaming model: `push(text)` returns plain content that is definitely not
+part of a tool call; text from a (possible) marker onward is buffered.
+Completed calls surface as ToolCall objects — per closed block for hermes,
+at finalize for the whole-message formats (a JSON array is only valid when
+complete, so earlier emission would be guesswork; the reference jails the
+same way in chat_completions/jail.rs).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import uuid
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ToolCall:
+    name: str
+    arguments: str  # JSON-encoded arguments object
+    id: str = dataclasses.field(
+        default_factory=lambda: "call_" + uuid.uuid4().hex[:24])
+
+    def to_openai(self, index: int) -> dict:
+        return {"index": index, "id": self.id, "type": "function",
+                "function": {"name": self.name, "arguments": self.arguments}}
+
+
+@dataclasses.dataclass
+class ToolEvent:
+    content: str = ""
+    calls: list[ToolCall] = dataclasses.field(default_factory=list)
+
+
+def _call_from_obj(obj: dict) -> Optional[ToolCall]:
+    if not isinstance(obj, dict) or "name" not in obj:
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    if isinstance(args, str):
+        try:
+            json.loads(args)
+        except ValueError:
+            args = json.dumps({"raw": args})
+    else:
+        args = json.dumps(args)
+    return ToolCall(name=str(obj["name"]), arguments=args)
+
+
+class _MarkerParser:
+    """Shared machinery: pass content through until `marker` (jailing
+    potential marker prefixes at the buffer tail), then buffer the rest."""
+
+    marker: str = ""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._capturing = False
+        self._capture = ""
+
+    @staticmethod
+    def _prefix_hold(buf: str, tag: str) -> int:
+        for k in range(min(len(tag) - 1, len(buf)), 0, -1):
+            if buf.endswith(tag[:k]):
+                return k
+        return 0
+
+    def push(self, text: str) -> ToolEvent:
+        ev = ToolEvent()
+        if self._capturing:
+            self._capture += text
+            self._on_capture(ev)
+            return ev
+        self._buf += text
+        idx = self._buf.find(self.marker)
+        if idx != -1:
+            ev.content = self._buf[:idx]
+            self._capture = self._buf[idx + len(self.marker):]
+            self._buf = ""
+            self._capturing = True
+            self._on_capture(ev)
+            return ev
+        hold = self._prefix_hold(self._buf, self.marker)
+        ev.content = self._buf[: len(self._buf) - hold]
+        self._buf = self._buf[len(ev.content):]
+        return ev
+
+    def _on_capture(self, ev: ToolEvent) -> None:
+        """Hook: formats that can close mid-stream emit calls here."""
+
+    def finalize(self) -> ToolEvent:
+        ev = ToolEvent()
+        if self._capturing:
+            self._finalize_capture(ev)
+        else:
+            ev.content = self._buf
+        self._buf = ""
+        self._capture = ""
+        self._capturing = False
+        return ev
+
+    def _finalize_capture(self, ev: ToolEvent) -> None:
+        raise NotImplementedError
+
+
+class HermesToolParser(_MarkerParser):
+    """`<tool_call>...</tool_call>`; multiple blocks; content between
+    blocks passes through. Calls emitted as each block closes."""
+
+    marker = "<tool_call>"
+    close = "</tool_call>"
+
+    def _on_capture(self, ev: ToolEvent) -> None:
+        while True:
+            idx = self._capture.find(self.close)
+            if idx == -1:
+                return
+            block = self._capture[:idx]
+            rest = self._capture[idx + len(self.close):]
+            try:
+                call = _call_from_obj(json.loads(block.strip()))
+                if call is not None:
+                    ev.calls.append(call)
+            except ValueError:
+                ev.content += self.marker + block + self.close
+            # look for another block in the remainder
+            self._capturing = False
+            self._capture = ""
+            follow = self.push(rest)
+            ev.content += follow.content
+            ev.calls.extend(follow.calls)
+            return
+
+    def _finalize_capture(self, ev: ToolEvent) -> None:
+        # Unterminated block: try parsing what we have; else emit raw.
+        try:
+            call = _call_from_obj(json.loads(self._capture.strip()))
+            if call is not None:
+                ev.calls.append(call)
+                return
+        except ValueError:
+            pass
+        ev.content = self.marker + self._capture
+
+
+class MistralToolParser(_MarkerParser):
+    """`[TOOL_CALLS] [{...}, ...]` — array parsed at finalize."""
+
+    marker = "[TOOL_CALLS]"
+
+    def _finalize_capture(self, ev: ToolEvent) -> None:
+        try:
+            data = json.loads(self._capture.strip())
+        except ValueError:
+            ev.content = self.marker + self._capture
+            return
+        if isinstance(data, dict):
+            data = [data]
+        for obj in data:
+            call = _call_from_obj(obj)
+            if call is not None:
+                ev.calls.append(call)
+
+
+class Llama3JsonToolParser:
+    """The entire message is one JSON call object. Stream is jailed from
+    the first `{`; decided at finalize."""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._maybe_json: Optional[bool] = None
+
+    def push(self, text: str) -> ToolEvent:
+        if self._maybe_json is None:
+            probe = (self._buf + text).lstrip()
+            if not probe:
+                self._buf += text
+                return ToolEvent()
+            self._maybe_json = probe.startswith("{")
+        self._buf += text
+        if self._maybe_json:
+            return ToolEvent()  # jail until finalize
+        out, self._buf = self._buf, ""
+        return ToolEvent(content=out)
+
+    def finalize(self) -> ToolEvent:
+        buf, self._buf = self._buf, ""
+        if self._maybe_json:
+            try:
+                call = _call_from_obj(json.loads(buf.strip()))
+                if call is not None:
+                    return ToolEvent(calls=[call])
+            except ValueError:
+                pass
+        return ToolEvent(content=buf)
+
+
+class PythonicToolParser:
+    """`[fn(a=1), g(x="y")]` — whole message, parsed with ast at finalize
+    (ref tool_calling/pythonic/)."""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._maybe: Optional[bool] = None
+
+    def push(self, text: str) -> ToolEvent:
+        if self._maybe is None:
+            probe = (self._buf + text).lstrip()
+            if not probe:
+                self._buf += text
+                return ToolEvent()
+            self._maybe = probe.startswith("[")
+        self._buf += text
+        if self._maybe:
+            return ToolEvent()
+        out, self._buf = self._buf, ""
+        return ToolEvent(content=out)
+
+    def finalize(self) -> ToolEvent:
+        buf, self._buf = self._buf, ""
+        if not self._maybe:
+            return ToolEvent(content=buf)
+        calls = self._parse(buf.strip())
+        if calls is None:
+            return ToolEvent(content=buf)
+        return ToolEvent(calls=calls)
+
+    @staticmethod
+    def _parse(text: str) -> Optional[list[ToolCall]]:
+        try:
+            tree = ast.parse(text, mode="eval")
+        except SyntaxError:
+            return None
+        if not isinstance(tree.body, ast.List):
+            return None
+        calls: list[ToolCall] = []
+        for node in tree.body.elts:
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Name):
+                return None
+            args: dict = {}
+            try:
+                for kw in node.keywords:
+                    args[kw.arg] = ast.literal_eval(kw.value)
+                if node.args:
+                    args["__positional__"] = [ast.literal_eval(a)
+                                              for a in node.args]
+            except ValueError:
+                return None
+            calls.append(ToolCall(name=node.func.id,
+                                  arguments=json.dumps(args)))
+        return calls
+
+
+TOOL_PARSERS = {
+    "hermes": HermesToolParser,
+    "qwen": HermesToolParser,  # qwen templates use hermes format
+    "mistral": MistralToolParser,
+    "llama3_json": Llama3JsonToolParser,
+    "pythonic": PythonicToolParser,
+}
+
+
+def make_tool_parser(name: str):
+    if not name:
+        return None
+    try:
+        return TOOL_PARSERS[name.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown tool parser {name!r}; "
+                         f"one of {sorted(TOOL_PARSERS)}")

@@ -1,0 +1,196 @@
+"""Streaming parser tests: reasoning split, tool-call formats, chunk-
+boundary jailing, and DeltaGenerator integration (ref: lib/parsers tests +
+chat_completions jail behavior)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    HermesToolParser,
+    Llama3JsonToolParser,
+    MistralToolParser,
+    PythonicToolParser,
+    StreamingReasoningParser,
+    make_reasoning_parser,
+    make_tool_parser,
+)
+
+
+def _drip(parser, text, n=3):
+    """Feed text in n-char chunks; collect reasoning/content or
+    content/calls depending on parser type."""
+    out = []
+    for i in range(0, len(text), n):
+        out.append(parser.push(text[i : i + n]))
+    out.append(parser.finalize())
+    return out
+
+
+class TestReasoningParser:
+    def test_basic_split(self):
+        p = StreamingReasoningParser()
+        events = _drip(p, "<think>step one</think>the answer")
+        reasoning = "".join(e.reasoning for e in events)
+        content = "".join(e.content for e in events)
+        assert reasoning == "step one"
+        assert content == "the answer"
+
+    def test_partial_tag_never_leaks(self):
+        """Tags split across chunk boundaries must not appear in output."""
+        p = StreamingReasoningParser()
+        for n in (1, 2, 3, 5):
+            p = StreamingReasoningParser()
+            events = _drip(p, "pre<think>mid</think>post", n=n)
+            content = "".join(e.content for e in events)
+            reasoning = "".join(e.reasoning for e in events)
+            assert "<think>" not in content and "</think>" not in content
+            assert content == "prepost" and reasoning == "mid"
+
+    def test_unterminated_think_counts_as_reasoning(self):
+        p = StreamingReasoningParser()
+        events = _drip(p, "<think>ran out of budget")
+        assert "".join(e.reasoning for e in events) == "ran out of budget"
+        assert "".join(e.content for e in events) == ""
+
+    def test_starts_in_reasoning(self):
+        p = make_reasoning_parser("deepseek-r1")
+        events = _drip(p, "implicit thought</think>visible")
+        assert "".join(e.reasoning for e in events) == "implicit thought"
+        assert "".join(e.content for e in events) == "visible"
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(ValueError):
+            make_reasoning_parser("nope")
+
+
+class TestHermesParser:
+    CALL = '<tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+
+    def test_single_call_with_surrounding_text(self):
+        for n in (1, 4, 7, 100):
+            p = HermesToolParser()
+            events = _drip(p, f"Let me check. {self.CALL} done", n=n)
+            calls = [c for e in events for c in e.calls]
+            content = "".join(e.content for e in events)
+            assert len(calls) == 1
+            assert calls[0].name == "get_weather"
+            assert json.loads(calls[0].arguments) == {"city": "SF"}
+            assert "<tool_call>" not in content
+            assert "Let me check." in content and "done" in content
+
+    def test_multiple_calls(self):
+        p = HermesToolParser()
+        events = _drip(p, self.CALL + self.CALL)
+        calls = [c for e in events for c in e.calls]
+        assert [c.name for c in calls] == ["get_weather", "get_weather"]
+
+    def test_malformed_json_falls_back_to_content(self):
+        p = HermesToolParser()
+        events = _drip(p, "<tool_call>not json</tool_call>")
+        content = "".join(e.content for e in events)
+        assert "not json" in content
+        assert not [c for e in events for c in e.calls]
+
+
+class TestMistralParser:
+    def test_array_of_calls(self):
+        text = ('thinking [TOOL_CALLS] [{"name": "a", "arguments": {"x": 1}},'
+                ' {"name": "b", "arguments": {}}]')
+        p = MistralToolParser()
+        events = _drip(p, text, n=5)
+        calls = [c for e in events for c in e.calls]
+        assert [c.name for c in calls] == ["a", "b"]
+        assert "".join(e.content for e in events).strip() == "thinking"
+
+
+class TestLlama3JsonParser:
+    def test_whole_message_call(self):
+        text = '{"name": "lookup", "parameters": {"q": "tpu"}}'
+        p = Llama3JsonToolParser()
+        events = _drip(p, text, n=6)
+        calls = [c for e in events for c in e.calls]
+        assert len(calls) == 1 and calls[0].name == "lookup"
+        assert json.loads(calls[0].arguments) == {"q": "tpu"}
+
+    def test_plain_text_passes_through(self):
+        p = Llama3JsonToolParser()
+        events = _drip(p, "just a normal answer", n=4)
+        assert "".join(e.content for e in events) == "just a normal answer"
+        assert not [c for e in events for c in e.calls]
+
+
+class TestPythonicParser:
+    def test_call_list(self):
+        text = '[get_weather(city="SF"), sum_all(1, 2)]'
+        p = PythonicToolParser()
+        events = _drip(p, text, n=5)
+        calls = [c for e in events for c in e.calls]
+        assert [c.name for c in calls] == ["get_weather", "sum_all"]
+        assert json.loads(calls[0].arguments) == {"city": "SF"}
+        assert json.loads(calls[1].arguments) == {"__positional__": [1, 2]}
+
+    def test_non_call_list_is_content(self):
+        p = PythonicToolParser()
+        events = _drip(p, "[1, 2, 3] is a list")
+        assert not [c for e in events for c in e.calls]
+        assert "[1, 2, 3] is a list" == "".join(e.content for e in events)
+
+    def test_registry(self):
+        assert isinstance(make_tool_parser("qwen"), HermesToolParser)
+        with pytest.raises(ValueError):
+            make_tool_parser("bogus")
+
+
+class TestDeltaGeneratorIntegration:
+    def _gen(self, **kw):
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
+        from dynamo_tpu.llm.protocols import (
+            PreprocessedRequest, SamplingOptions, StopConditions)
+
+        card = ModelDeploymentCard(name="m", tokenizer={"kind": "byte"})
+        pre = OpenAIPreprocessor(card)
+        req = PreprocessedRequest(
+            request_id="r", token_ids=[1, 2], sampling=SamplingOptions(),
+            stop=StopConditions(), model="m")
+        return DeltaGenerator(pre, req, kind="chat", **kw), pre
+
+    def _feed_text(self, gen, pre, text):
+        """Push text as byte tokens through the engine-output path."""
+        from dynamo_tpu.llm.protocols import EngineOutput
+
+        tokens = pre.tokenizer.encode(text)
+        chunks = []
+        for i, t in enumerate(tokens):
+            final = i == len(tokens) - 1
+            chunks += gen.on_output(EngineOutput(
+                token_ids=[t], finish_reason="stop" if final else None))
+        return chunks
+
+    def test_reasoning_and_tools_in_stream(self):
+        gen, pre = self._gen(tool_parser="hermes", reasoning_parser="think")
+        text = ('<think>need weather</think>'
+                '<tool_call>{"name": "w", "arguments": {}}</tool_call>')
+        chunks = self._feed_text(gen, pre, text)
+        reasoning = "".join(
+            c["choices"][0]["delta"].get("reasoning_content", "")
+            for c in chunks)
+        tool_deltas = [c for c in chunks
+                       if c["choices"][0]["delta"].get("tool_calls")]
+        assert reasoning == "need weather"
+        assert len(tool_deltas) == 1
+        assert gen.finish_reason == "tool_calls"
+        final = gen.final_response()
+        msg = final["choices"][0]["message"]
+        assert msg["tool_calls"][0]["function"]["name"] == "w"
+        assert msg["reasoning_content"] == "need weather"
+        assert final["choices"][0]["finish_reason"] == "tool_calls"
+
+    def test_plain_stream_unchanged(self):
+        gen, pre = self._gen()
+        chunks = self._feed_text(gen, pre, "hello world")
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "hello world"
+        assert gen.finish_reason == "stop"
