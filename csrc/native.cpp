@@ -1,0 +1,439 @@
+// dynamo_tpu._native — C++ hot paths for the TPU-native serving runtime.
+//
+// The reference implements these in Rust (lib/tokens/src/lib.rs chained block
+// hashing; lib/kv-router/src/indexer/radix_tree.rs the KV-prefix radix tree).
+// Here they are native C++ behind a CPython extension, with bit-identical
+// pure-Python fallbacks in dynamo_tpu/ (used when the extension isn't built):
+//
+//   * compute_block_hashes — chained XXH64 over fixed-size token blocks; the
+//     per-request hot path of every routing decision (router side) and every
+//     completed decode block (engine side).
+//   * RadixTree — prefix index mapping sequence-hash chains -> worker sets,
+//     queried per request (find_matches) and mutated per KV event.
+//
+// Build: `python setup.py build_ext --inplace` (auto-attempted once by
+// dynamo_tpu/native.py).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "xxh64.h"
+
+namespace {
+
+using dynamo_native::xxh64;
+
+// ---------------------------------------------------------------------------
+// Hashing
+// ---------------------------------------------------------------------------
+
+// Parse a Python sequence of ints (or a buffer of u32) into u32 tokens.
+static bool tokens_from_obj(PyObject* obj, std::vector<uint32_t>* out) {
+  Py_buffer view;
+  if (PyObject_CheckBuffer(obj) &&
+      PyObject_GetBuffer(obj, &view, PyBUF_FORMAT | PyBUF_C_CONTIGUOUS) == 0) {
+    // Accept raw bytes (itemsize 1) or 32-bit element buffers. Wider
+    // elements (e.g. numpy int64 token arrays) fall through to the sequence
+    // path so native and Python hashes never diverge.
+    if ((view.itemsize == 1 || view.itemsize == 4) && view.len % 4 == 0) {
+      out->resize(view.len / 4);
+      std::memcpy(out->data(), view.buf, view.len);
+      PyBuffer_Release(&view);
+      return true;
+    }
+    PyBuffer_Release(&view);
+  }
+  PyErr_Clear();
+  PyObject* seq = PySequence_Fast(obj, "tokens must be a sequence or buffer");
+  if (!seq) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  out->resize(n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long long v = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i));
+    if (v == -1 && PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return false;
+    }
+    (*out)[i] = (uint32_t)v;
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+// compute_block_hashes(tokens, block_size, seed) -> list[int]
+// Chained: block i's hash seeds block i+1; partial trailing block unhashed.
+static PyObject* py_compute_block_hashes(PyObject*, PyObject* args) {
+  PyObject* tokens_obj;
+  Py_ssize_t block_size;
+  unsigned long long seed;
+  if (!PyArg_ParseTuple(args, "OnK", &tokens_obj, &block_size, &seed))
+    return nullptr;
+  if (block_size <= 0) {
+    PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+    return nullptr;
+  }
+  std::vector<uint32_t> tokens;
+  if (!tokens_from_obj(tokens_obj, &tokens)) return nullptr;
+
+  size_t n_blocks = tokens.size() / (size_t)block_size;
+  PyObject* out = PyList_New((Py_ssize_t)n_blocks);
+  if (!out) return nullptr;
+  uint64_t h = seed;
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(tokens.data());
+  for (size_t i = 0; i < n_blocks; i++) {
+    h = xxh64(base + i * (size_t)block_size * 4, (size_t)block_size * 4, h);
+    PyObject* v = PyLong_FromUnsignedLongLong(h);
+    if (!v) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, v);
+  }
+  return out;
+}
+
+// hash_bytes(data, seed) -> int  (raw xxh64; parity tests vs python xxhash)
+static PyObject* py_hash_bytes(PyObject*, PyObject* args) {
+  Py_buffer view;
+  unsigned long long seed;
+  if (!PyArg_ParseTuple(args, "y*K", &view, &seed)) return nullptr;
+  uint64_t h = xxh64((const uint8_t*)view.buf, (size_t)view.len, seed);
+  PyBuffer_Release(&view);
+  return PyLong_FromUnsignedLongLong(h);
+}
+
+// ---------------------------------------------------------------------------
+// Radix tree
+// ---------------------------------------------------------------------------
+
+struct Worker {
+  uint64_t id;
+  int32_t dp;
+  bool operator==(const Worker& o) const { return id == o.id && dp == o.dp; }
+};
+
+struct WorkerHash {
+  size_t operator()(const Worker& w) const {
+    uint64_t x = w.id * 0x9E3779B97F4A7C15ULL ^ (uint64_t)(uint32_t)w.dp;
+    x ^= x >> 31;
+    return (size_t)x;
+  }
+};
+
+struct Node {
+  uint64_t hash;
+  Node* parent;
+  std::unordered_map<uint64_t, Node*> children;
+  std::unordered_set<Worker, WorkerHash> workers;
+};
+
+struct Tree {
+  Node root;
+  std::unordered_map<uint64_t, Node*> nodes;
+  std::unordered_map<Worker, int64_t, WorkerHash> worker_blocks;
+
+  Tree() {
+    root.hash = 0;
+    root.parent = nullptr;
+  }
+  ~Tree() {
+    for (auto& kv : nodes) delete kv.second;
+  }
+
+  void apply_stored(Worker w, bool has_parent, uint64_t parent_hash,
+                    const std::vector<uint64_t>& hashes) {
+    Node* parent = &root;
+    if (has_parent) {
+      auto it = nodes.find(parent_hash);
+      // Unknown parent (joined mid-stream): root the chain; sequence hashes
+      // keep lookups correct regardless of attachment point.
+      if (it != nodes.end()) parent = it->second;
+    }
+    for (uint64_t h : hashes) {
+      Node* node;
+      auto it = nodes.find(h);
+      if (it == nodes.end()) {
+        node = new Node();
+        node->hash = h;
+        node->parent = parent;
+        nodes.emplace(h, node);
+        parent->children.emplace(h, node);
+      } else {
+        node = it->second;
+      }
+      if (node->workers.insert(w).second) worker_blocks[w] += 1;
+      parent = node;
+    }
+  }
+
+  void maybe_prune(Node* node) {
+    while (node != &root && node->workers.empty() && node->children.empty()) {
+      Node* parent = node->parent;
+      if (!parent) break;
+      parent->children.erase(node->hash);
+      nodes.erase(node->hash);
+      delete node;
+      node = parent;
+    }
+  }
+
+  void apply_removed(Worker w, const std::vector<uint64_t>& hashes) {
+    for (uint64_t h : hashes) {
+      auto it = nodes.find(h);
+      if (it == nodes.end()) continue;
+      Node* node = it->second;
+      if (node->workers.erase(w)) {
+        auto wb = worker_blocks.find(w);
+        if (wb != worker_blocks.end() && wb->second > 0) wb->second -= 1;
+      }
+      maybe_prune(node);
+    }
+  }
+
+  void remove_worker(Worker w) {
+    // Collect hashes, not pointers: an earlier maybe_prune chain may delete
+    // later entries, so re-resolve each through the nodes map.
+    std::vector<uint64_t> touched;
+    for (auto& kv : nodes) {
+      if (kv.second->workers.erase(w)) touched.push_back(kv.first);
+    }
+    for (uint64_t h : touched) {
+      auto it = nodes.find(h);
+      if (it != nodes.end()) maybe_prune(it->second);
+    }
+    worker_blocks.erase(w);
+  }
+};
+
+typedef struct {
+  PyObject_HEAD
+  Tree* tree;
+} RadixTreeObject;
+
+static PyObject* RadixTree_new(PyTypeObject* type, PyObject*, PyObject*) {
+  RadixTreeObject* self = (RadixTreeObject*)type->tp_alloc(type, 0);
+  if (self) self->tree = new Tree();
+  return (PyObject*)self;
+}
+
+static void RadixTree_dealloc(RadixTreeObject* self) {
+  delete self->tree;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static bool hashes_from_obj(PyObject* obj, std::vector<uint64_t>* out) {
+  PyObject* seq = PySequence_Fast(obj, "expected a sequence of hashes");
+  if (!seq) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  out->resize(n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint64_t v =
+        PyLong_AsUnsignedLongLongMask(PySequence_Fast_GET_ITEM(seq, i));
+    if (PyErr_Occurred()) { Py_DECREF(seq); return false; }
+    (*out)[i] = v;
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+// find_matches(hashes, early_exit) -> (scores, tree_sizes)
+//   scores:     {(worker_id, dp_rank): contiguous-leading-block count}
+//   tree_sizes: {(worker_id, dp_rank): total blocks indexed for the worker}
+static PyObject* RadixTree_find_matches(RadixTreeObject* self, PyObject* args) {
+  PyObject* hashes_obj;
+  int early_exit = 0;
+  if (!PyArg_ParseTuple(args, "O|p", &hashes_obj, &early_exit)) return nullptr;
+  std::vector<uint64_t> hashes;
+  if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
+
+  std::unordered_map<Worker, int64_t, WorkerHash> scores;
+  Node* node = &self->tree->root;
+  int64_t depth = 0;
+  for (uint64_t h : hashes) {
+    auto it = node->children.find(h);
+    if (it == node->children.end()) break;
+    node = it->second;
+    for (const Worker& w : node->workers) {
+      auto s = scores.find(w);
+      int64_t cur = (s == scores.end()) ? 0 : s->second;
+      if (cur == depth) scores[w] = depth + 1;
+    }
+    if (early_exit && node->workers.empty()) break;
+    depth++;
+  }
+
+  PyObject* scores_d = PyDict_New();
+  PyObject* sizes_d = PyDict_New();
+  if (!scores_d || !sizes_d) { Py_XDECREF(scores_d); Py_XDECREF(sizes_d); return nullptr; }
+  for (auto& kv : scores) {
+    PyObject* key = Py_BuildValue("(Ki)", kv.first.id, (int)kv.first.dp);
+    PyObject* val = PyLong_FromLongLong(kv.second);
+    if (!key || !val || PyDict_SetItem(scores_d, key, val) < 0) {
+      Py_XDECREF(key); Py_XDECREF(val); Py_DECREF(scores_d); Py_DECREF(sizes_d);
+      return nullptr;
+    }
+    Py_DECREF(key); Py_DECREF(val);
+  }
+  for (auto& kv : self->tree->worker_blocks) {
+    PyObject* key = Py_BuildValue("(Ki)", kv.first.id, (int)kv.first.dp);
+    PyObject* val = PyLong_FromLongLong(kv.second);
+    if (!key || !val || PyDict_SetItem(sizes_d, key, val) < 0) {
+      Py_XDECREF(key); Py_XDECREF(val); Py_DECREF(scores_d); Py_DECREF(sizes_d);
+      return nullptr;
+    }
+    Py_DECREF(key); Py_DECREF(val);
+  }
+  PyObject* out = PyTuple_Pack(2, scores_d, sizes_d);
+  Py_DECREF(scores_d);
+  Py_DECREF(sizes_d);
+  return out;
+}
+
+// apply_stored(worker_id, dp_rank, parent_hash_or_None, hashes)
+static PyObject* RadixTree_apply_stored(RadixTreeObject* self, PyObject* args) {
+  unsigned long long wid;
+  int dp;
+  PyObject* parent_obj;
+  PyObject* hashes_obj;
+  if (!PyArg_ParseTuple(args, "KiOO", &wid, &dp, &parent_obj, &hashes_obj))
+    return nullptr;
+  bool has_parent = parent_obj != Py_None;
+  uint64_t parent_hash = 0;
+  if (has_parent) {
+    parent_hash = PyLong_AsUnsignedLongLongMask(parent_obj);
+    if (PyErr_Occurred()) return nullptr;
+  }
+  std::vector<uint64_t> hashes;
+  if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
+  self->tree->apply_stored(Worker{wid, dp}, has_parent, parent_hash, hashes);
+  Py_RETURN_NONE;
+}
+
+static PyObject* RadixTree_apply_removed(RadixTreeObject* self, PyObject* args) {
+  unsigned long long wid;
+  int dp;
+  PyObject* hashes_obj;
+  if (!PyArg_ParseTuple(args, "KiO", &wid, &dp, &hashes_obj)) return nullptr;
+  std::vector<uint64_t> hashes;
+  if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
+  self->tree->apply_removed(Worker{wid, dp}, hashes);
+  Py_RETURN_NONE;
+}
+
+static PyObject* RadixTree_remove_worker(RadixTreeObject* self, PyObject* args) {
+  unsigned long long wid;
+  int dp;
+  if (!PyArg_ParseTuple(args, "Ki", &wid, &dp)) return nullptr;
+  self->tree->remove_worker(Worker{wid, dp});
+  Py_RETURN_NONE;
+}
+
+static PyObject* RadixTree_remove_worker_id(RadixTreeObject* self,
+                                            PyObject* args) {
+  unsigned long long wid;
+  if (!PyArg_ParseTuple(args, "K", &wid)) return nullptr;
+  std::vector<Worker> targets;
+  for (auto& kv : self->tree->worker_blocks)
+    if (kv.first.id == wid) targets.push_back(kv.first);
+  for (Worker w : targets) self->tree->remove_worker(w);
+  Py_RETURN_NONE;
+}
+
+// dump_worker(worker_id, dp_rank) -> list[(parent_hash_or_None, hash)]
+static PyObject* RadixTree_dump_worker(RadixTreeObject* self, PyObject* args) {
+  unsigned long long wid;
+  int dp;
+  if (!PyArg_ParseTuple(args, "Ki", &wid, &dp)) return nullptr;
+  Worker w{wid, dp};
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  for (auto& kv : self->tree->nodes) {
+    Node* node = kv.second;
+    if (node->workers.count(w)) {
+      PyObject* item;
+      Node* parent = node->parent;
+      if (!parent || parent == &self->tree->root)
+        item = Py_BuildValue("(OK)", Py_None, node->hash);
+      else
+        item = Py_BuildValue("(KK)", parent->hash, node->hash);
+      if (!item || PyList_Append(out, item) < 0) {
+        Py_XDECREF(item); Py_DECREF(out); return nullptr;
+      }
+      Py_DECREF(item);
+    }
+  }
+  return out;
+}
+
+static PyObject* RadixTree_total_nodes(RadixTreeObject* self, PyObject*) {
+  return PyLong_FromSize_t(self->tree->nodes.size());
+}
+
+static PyObject* RadixTree_worker_block_counts(RadixTreeObject* self,
+                                               PyObject*) {
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  for (auto& kv : self->tree->worker_blocks) {
+    PyObject* key = Py_BuildValue("(Ki)", kv.first.id, (int)kv.first.dp);
+    PyObject* val = PyLong_FromLongLong(kv.second);
+    if (!key || !val || PyDict_SetItem(out, key, val) < 0) {
+      Py_XDECREF(key); Py_XDECREF(val); Py_DECREF(out); return nullptr;
+    }
+    Py_DECREF(key); Py_DECREF(val);
+  }
+  return out;
+}
+
+static PyMethodDef RadixTree_methods[] = {
+    {"find_matches", (PyCFunction)RadixTree_find_matches, METH_VARARGS, nullptr},
+    {"apply_stored", (PyCFunction)RadixTree_apply_stored, METH_VARARGS, nullptr},
+    {"apply_removed", (PyCFunction)RadixTree_apply_removed, METH_VARARGS, nullptr},
+    {"remove_worker", (PyCFunction)RadixTree_remove_worker, METH_VARARGS, nullptr},
+    {"remove_worker_id", (PyCFunction)RadixTree_remove_worker_id, METH_VARARGS, nullptr},
+    {"dump_worker", (PyCFunction)RadixTree_dump_worker, METH_VARARGS, nullptr},
+    {"total_nodes", (PyCFunction)RadixTree_total_nodes, METH_NOARGS, nullptr},
+    {"worker_block_counts", (PyCFunction)RadixTree_worker_block_counts, METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject RadixTreeType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "dynamo_tpu._native.RadixTree",          /* tp_name */
+    sizeof(RadixTreeObject),                 /* tp_basicsize */
+    0,                                       /* tp_itemsize */
+    (destructor)RadixTree_dealloc,           /* tp_dealloc */
+};
+
+// ---------------------------------------------------------------------------
+// Module
+// ---------------------------------------------------------------------------
+
+static PyMethodDef module_methods[] = {
+    {"compute_block_hashes", py_compute_block_hashes, METH_VARARGS,
+     "compute_block_hashes(tokens, block_size, seed) -> list[int]"},
+    {"hash_bytes", py_hash_bytes, METH_VARARGS,
+     "hash_bytes(data, seed) -> int (xxh64)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef native_module = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "Native C++ hot paths: chained block hashing + KV radix index.", -1,
+    module_methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__native(void) {
+  RadixTreeType.tp_flags = Py_TPFLAGS_DEFAULT;
+  RadixTreeType.tp_new = RadixTree_new;
+  RadixTreeType.tp_methods = RadixTree_methods;
+  if (PyType_Ready(&RadixTreeType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&native_module);
+  if (!m) return nullptr;
+  Py_INCREF(&RadixTreeType);
+  if (PyModule_AddObject(m, "RadixTree", (PyObject*)&RadixTreeType) < 0) {
+    Py_DECREF(&RadixTreeType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

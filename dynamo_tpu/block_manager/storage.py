@@ -101,8 +101,13 @@ class ObjectStore:
         os.makedirs(root, exist_ok=True)
 
     def _path(self, h: int) -> str:
-        key = f"{h & ((1 << 64) - 1):016x}"
-        return os.path.join(self.root, key[:2], key + ".npy")
+        # Keys carry the block-hash scheme version: a hash-function change
+        # (dynamo_tpu.tokens.HASH_VERSION) must never silently mismatch
+        # blobs persisted under the old scheme.
+        from dynamo_tpu.tokens import HASH_VERSION
+
+        hexh = f"{h & ((1 << 64) - 1):016x}"
+        return os.path.join(self.root, hexh[:2], f"v{HASH_VERSION}-{hexh}.npy")
 
     def put(self, h: int, block: np.ndarray) -> None:
         path = self._path(h)

@@ -1,6 +1,6 @@
 """KV-aware routing data structures (ref layer L1a: lib/kv-router)."""
 
-from .indexer import RadixTree
+from .indexer import NativeRadixTree, RadixTree, make_radix_tree
 from .protocols import (
     KV_EVENT_TOPIC,
     LOAD_TOPIC,
@@ -27,6 +27,8 @@ __all__ = [
     "LoadMetrics",
     "OverlapScores",
     "RadixTree",
+    "NativeRadixTree",
+    "make_radix_tree",
     "RouterEvent",
     "SelectionResult",
     "WorkerWithDpRank",

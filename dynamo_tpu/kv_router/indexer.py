@@ -191,3 +191,118 @@ class RadixTree:
             pending = rest
         if last_event_id is not None:
             self._last_event_id[worker] = last_event_id
+
+
+class NativeRadixTree:
+    """Same public API as `RadixTree`, backed by the C++ tree
+    (csrc/native.cpp). Event-id bookkeeping (gap detection) stays here —
+    it's O(1) per event; the structural work is native."""
+
+    def __init__(self, native_mod) -> None:
+        self._tree = native_mod.RadixTree()
+        self._last_event_id: dict[WorkerWithDpRank, int] = {}
+        self.gap_count = 0
+
+    # -- queries -----------------------------------------------------------
+
+    def find_matches(
+        self, block_hashes: Sequence[int], early_exit: bool = False
+    ) -> OverlapScores:
+        scores, sizes = self._tree.find_matches(list(block_hashes), early_exit)
+        return OverlapScores(
+            scores={WorkerWithDpRank(w, d): c for (w, d), c in scores.items()},
+            tree_sizes={WorkerWithDpRank(w, d): c for (w, d), c in sizes.items()},
+        )
+
+    def worker_block_counts(self) -> dict[WorkerWithDpRank, int]:
+        return {
+            WorkerWithDpRank(w, d): c
+            for (w, d), c in self._tree.worker_block_counts().items()
+        }
+
+    def total_nodes(self) -> int:
+        return self._tree.total_nodes()
+
+    # -- event application -------------------------------------------------
+
+    def apply_event(self, event: RouterEvent) -> str:
+        worker = WorkerWithDpRank(event.worker_id, event.dp_rank)
+        status = "ok"
+        last = self._last_event_id.get(worker)
+        if last is not None and event.event_id != last + 1:
+            self.gap_count += 1
+            status = "gap"
+        self._last_event_id[worker] = event.event_id
+
+        if event.cleared:
+            self.remove_worker(worker)
+            self._last_event_id[worker] = event.event_id
+            return status
+        if event.stored is not None:
+            self._tree.apply_stored(
+                worker.worker_id,
+                worker.dp_rank,
+                event.stored.parent_hash,
+                list(event.stored.block_hashes),
+            )
+        if event.removed is not None:
+            self._tree.apply_removed(
+                worker.worker_id, worker.dp_rank, list(event.removed.block_hashes)
+            )
+        return status
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        self._tree.remove_worker(worker.worker_id, worker.dp_rank)
+        self._last_event_id.pop(worker, None)
+
+    def remove_worker_id(self, worker_id: int) -> None:
+        self._tree.remove_worker_id(worker_id)
+        for w in [w for w in self._last_event_id if w.worker_id == worker_id]:
+            self._last_event_id.pop(w, None)
+
+    # -- snapshot / resync -------------------------------------------------
+
+    def dump_worker(self, worker: WorkerWithDpRank) -> list[tuple[Optional[int], int]]:
+        return self._tree.dump_worker(worker.worker_id, worker.dp_rank)
+
+    def load_worker(
+        self, worker: WorkerWithDpRank, pairs: Sequence[tuple[Optional[int], int]],
+        last_event_id: Optional[int] = None,
+    ) -> None:
+        self.remove_worker(worker)
+        known: set[int] = set()
+        pending = list(pairs)
+        while pending:
+            progressed = False
+            rest = []
+            for parent_hash, block_hash in pending:
+                if parent_hash is None or parent_hash in known:
+                    self._tree.apply_stored(
+                        worker.worker_id, worker.dp_rank, parent_hash, [block_hash]
+                    )
+                    known.add(block_hash)
+                    progressed = True
+                else:
+                    rest.append((parent_hash, block_hash))
+            if not progressed:
+                # Parent neither in this batch nor resolvable: the native
+                # tree roots genuinely-unknown parents itself, and resolves
+                # parents that exist from other workers.
+                for parent_hash, block_hash in rest:
+                    self._tree.apply_stored(
+                        worker.worker_id, worker.dp_rank, parent_hash, [block_hash]
+                    )
+                break
+            pending = rest
+        if last_event_id is not None:
+            self._last_event_id[worker] = last_event_id
+
+
+def make_radix_tree():
+    """Native C++ tree when the extension is available, Python otherwise."""
+    from dynamo_tpu.native import get_native
+
+    native = get_native()
+    if native is not None:
+        return NativeRadixTree(native)
+    return RadixTree()

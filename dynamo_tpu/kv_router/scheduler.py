@@ -18,7 +18,7 @@ import math
 import random
 from typing import Optional, Sequence
 
-from .indexer import RadixTree
+from .indexer import make_radix_tree
 from .protocols import OverlapScores, WorkerWithDpRank
 from .sequences import ActiveSequences
 
@@ -77,7 +77,7 @@ def softmax_sample(
 class KvScheduler:
     def __init__(self, config: Optional[KvRouterConfig] = None) -> None:
         self.config = config or KvRouterConfig()
-        self.indexer = RadixTree()
+        self.indexer = make_radix_tree()
         self.sequences = ActiveSequences(self.config.block_size)
 
     def select_worker(

@@ -48,6 +48,24 @@ class ModelDeploymentCard:
     data_parallel_size: int = 1
     runtime_config: dict = dataclasses.field(default_factory=dict)
 
+    def __post_init__(self) -> None:
+        # Fail at card construction (worker startup / card publish), not per
+        # request inside the frontend's delta generator.
+        from dynamo_tpu.parsers import REASONING_PARSERS, TOOL_PARSERS
+        from dynamo_tpu.tokens import HASH_VERSION
+
+        if self.tool_parser and self.tool_parser.lower() not in TOOL_PARSERS:
+            raise ValueError(
+                f"unknown tool parser {self.tool_parser!r}; "
+                f"one of {sorted(TOOL_PARSERS)}")
+        if (self.reasoning_parser
+                and self.reasoning_parser.lower() not in REASONING_PARSERS):
+            raise ValueError(
+                f"unknown reasoning parser {self.reasoning_parser!r}; "
+                f"one of {sorted(REASONING_PARSERS)}")
+        # KV identities only match between processes on the same hash scheme.
+        self.runtime_config.setdefault("kv_hash_version", HASH_VERSION)
+
     def card_key(self, instance_id: int) -> str:
         return (
             f"{MODEL_CARD_PREFIX}/{self.namespace}/{self.component}/"

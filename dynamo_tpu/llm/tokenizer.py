@@ -149,39 +149,55 @@ def load_tokenizer(spec: dict) -> Tokenizer:
 
 class IncrementalDetokenizer:
     """Streaming decode: emits only text that can no longer change as more
-    tokens arrive (ref: Backend detokenizer hot loop, lib/llm/src/backend.rs)."""
+    tokens arrive (ref: Backend detokenizer hot loop, lib/llm/src/backend.rs).
+
+    Per-token cost is O(window): we decode a sliding tail window anchored at
+    `_ctx_start` and diff against the previously decoded length, instead of
+    re-decoding the whole sequence (the reference's Rust hot loop does the
+    same prefix-offset trick). The anchor slides forward periodically so the
+    decoded span stays bounded."""
+
+    # Keep this many already-stable tokens as decode context when sliding the
+    # anchor (BPE/sentencepiece boundary effects cancel within the context).
+    _CTX_KEEP = 16
+    # Slide the anchor once the decoded span exceeds this many tokens.
+    _CTX_MAX = 256
 
     def __init__(self, tokenizer: Tokenizer, window: Optional[int] = None) -> None:
         self._tok = tokenizer
         self._ids: list[int] = []
-        self._emitted = 0  # chars already flushed
         self._window = tokenizer.stable_window if window is None else window
+        self._ctx_start = 0  # decode-anchor token index
+        self._stable_tokens = 0  # tokens whose text has been emitted
+        self._prev_len = 0  # len(decode(ids[_ctx_start:_stable_tokens])) - held-back "�"
 
     def push(self, token_ids: Sequence[int]) -> str:
         """Add tokens, return newly-stable text (may be '')."""
         self._ids.extend(token_ids)
-        full = self._tok.decode(self._ids)
-        # The tail may still merge with future tokens (BPE/unicode): hold back
-        # text decoded from the last `window` tokens unless it ends cleanly.
-        if self._window == 0:
-            stable_upto = len(full)
-        elif len(self._ids) > self._window:
-            stable_upto = len(self._tok.decode(self._ids[: -self._window]))
-        else:
-            stable_upto = 0
-        # Never emit a trailing replacement char (partial UTF-8 sequence).
-        candidate = full[self._emitted : stable_upto]
-        if candidate.endswith("�"):
-            candidate = candidate[:-1]
-            stable_upto = self._emitted + len(candidate)
-        if stable_upto <= self._emitted:
+        n = len(self._ids)
+        stable = n if self._window == 0 else max(0, n - self._window)
+        if stable <= self._stable_tokens:
             return ""
-        self._emitted = stable_upto
+        text = self._tok.decode(self._ids[self._ctx_start : stable])
+        candidate = text[self._prev_len :]
+        # Never emit a trailing replacement char (partial UTF-8 sequence);
+        # it re-decodes complete once the rest of the char arrives.
+        while candidate.endswith("�"):
+            candidate = candidate[:-1]
+        self._stable_tokens = stable
+        self._prev_len += len(candidate)
+        if stable - self._ctx_start > self._CTX_MAX:
+            self._ctx_start = max(0, stable - self._CTX_KEEP)
+            anchored = self._tok.decode(self._ids[self._ctx_start : stable])
+            while anchored.endswith("�"):  # keep held-back partial chars held
+                anchored = anchored[:-1]
+            self._prev_len = len(anchored)
         return candidate
 
     def flush(self) -> str:
         """Emit everything outstanding (end of stream)."""
-        full = self._tok.decode(self._ids)
-        out = full[self._emitted :]
-        self._emitted = len(full)
+        full = self._tok.decode(self._ids[self._ctx_start :])
+        out = full[self._prev_len :]
+        self._prev_len = len(full)
+        self._stable_tokens = len(self._ids)
         return out

@@ -1,0 +1,79 @@
+"""Loader for the native C++ extension (csrc/native.cpp).
+
+Tries to import `dynamo_tpu._native`; if absent, attempts ONE in-place build
+(`python setup.py build_ext --inplace`) and retries. Every consumer has a
+bit-identical pure-Python fallback, so a missing toolchain degrades to
+slower-but-correct:
+
+    from dynamo_tpu.native import get_native
+    native = get_native()          # module or None
+
+Set DYNAMO_TPU_NATIVE=0 to force the Python paths (used by fallback-parity
+tests).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Optional
+
+_lock = threading.Lock()
+_native: Any = None
+_resolved = False
+
+
+def _repo_root() -> Optional[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(here)
+    if os.path.exists(os.path.join(root, "csrc", "native.cpp")):
+        return root
+    return None
+
+
+def _try_build(root: str) -> None:
+    marker = os.path.join(root, "build", ".native_build_attempted")
+    if os.path.exists(marker):
+        return
+    os.makedirs(os.path.dirname(marker), exist_ok=True)
+    with open(marker, "w") as f:
+        f.write("1")
+    subprocess.run(
+        [sys.executable, "setup.py", "build_ext", "--inplace"],
+        cwd=root,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        timeout=180,
+        check=False,
+    )
+
+
+def get_native() -> Any:
+    """The `_native` module, or None (disabled / unbuildable)."""
+    global _native, _resolved
+    if _resolved:
+        return _native
+    with _lock:
+        if _resolved:
+            return _native
+        if os.environ.get("DYNAMO_TPU_NATIVE", "1") == "0":
+            _resolved = True
+            return None
+        try:
+            from dynamo_tpu import _native as mod  # type: ignore
+
+            _native = mod
+        except ImportError:
+            root = _repo_root()
+            if root is not None:
+                try:
+                    _try_build(root)
+                    from dynamo_tpu import _native as mod  # type: ignore
+
+                    _native = mod
+                except Exception:  # noqa: BLE001 — no toolchain: Python paths
+                    _native = None
+        _resolved = True
+        return _native

@@ -19,6 +19,16 @@ import dataclasses
 from typing import Optional
 
 
+def prefix_hold(buf: str, tag: str) -> int:
+    """Longest proper prefix of `tag` that `buf` ends with — the amount of
+    trailing text a streaming parser must hold back because it may be the
+    start of `tag` (shared by reasoning + tool-call jailing)."""
+    for k in range(min(len(tag) - 1, len(buf)), 0, -1):
+        if buf.endswith(tag[:k]):
+            return k
+    return 0
+
+
 @dataclasses.dataclass
 class ReasoningEvent:
     reasoning: str = ""
@@ -34,14 +44,6 @@ class StreamingReasoningParser:
         self._state = "reasoning" if starts_in_reasoning else "before"
         self._buf = ""
 
-    @staticmethod
-    def _prefix_hold(buf: str, tag: str) -> int:
-        """Longest proper prefix of `tag` that `buf` ends with."""
-        for k in range(min(len(tag) - 1, len(buf)), 0, -1):
-            if buf.endswith(tag[:k]):
-                return k
-        return 0
-
     def push(self, text: str) -> ReasoningEvent:
         ev = ReasoningEvent()
         self._buf += text
@@ -53,7 +55,7 @@ class StreamingReasoningParser:
                     self._buf = self._buf[idx + len(self.open_tag):]
                     self._state = "reasoning"
                     continue
-                hold = self._prefix_hold(self._buf, self.open_tag)
+                hold = prefix_hold(self._buf, self.open_tag)
                 emit = self._buf[: len(self._buf) - hold]
                 ev.content += emit
                 self._buf = self._buf[len(emit):]
@@ -65,7 +67,7 @@ class StreamingReasoningParser:
                     self._buf = self._buf[idx + len(self.close_tag):]
                     self._state = "after"
                     continue
-                hold = self._prefix_hold(self._buf, self.close_tag)
+                hold = prefix_hold(self._buf, self.close_tag)
                 emit = self._buf[: len(self._buf) - hold]
                 ev.reasoning += emit
                 self._buf = self._buf[len(emit):]

@@ -28,6 +28,8 @@ import json
 import uuid
 from typing import Optional
 
+from .reasoning import prefix_hold
+
 
 @dataclasses.dataclass
 class ToolCall:
@@ -72,13 +74,6 @@ class _MarkerParser:
         self._capturing = False
         self._capture = ""
 
-    @staticmethod
-    def _prefix_hold(buf: str, tag: str) -> int:
-        for k in range(min(len(tag) - 1, len(buf)), 0, -1):
-            if buf.endswith(tag[:k]):
-                return k
-        return 0
-
     def push(self, text: str) -> ToolEvent:
         ev = ToolEvent()
         if self._capturing:
@@ -94,7 +89,7 @@ class _MarkerParser:
             self._capturing = True
             self._on_capture(ev)
             return ev
-        hold = self._prefix_hold(self._buf, self.marker)
+        hold = prefix_hold(self._buf, self.marker)
         ev.content = self._buf[: len(self._buf) - hold]
         self._buf = self._buf[len(ev.content):]
         return ev

@@ -113,7 +113,11 @@ class PushRouter:
         attempts = 0
         while True:
             iid = await self._pick(body, instance_id)
-            ROUTER_DECISIONS.labels(mode=self.mode).inc()
+            # An explicit instance means the decision was made upstream
+            # (KV scheduler / prefill router), not by this router's mode.
+            ROUTER_DECISIONS.labels(
+                mode="direct" if instance_id is not None else self.mode
+            ).inc()
             self._inflight[iid] = self._inflight.get(iid, 0) + 1
             yielded = False
             try:

@@ -7,9 +7,11 @@ uniquely identifies the full token prefix up to and including that block
 ("sequence hash"). Routers, engines, and the KV block manager all speak this
 identity, which is what makes cross-worker prefix matching sound.
 
-We use xxh3_64 with the previous sequence hash as the seed, over the
+We use xxh64 with the previous sequence hash as the seed, over the
 little-endian u32 token bytes of each full block. Partial trailing blocks are
-never hashed (they can't be reused).
+never hashed (they can't be reused). The hot path runs in C++
+(csrc/native.cpp `compute_block_hashes`); the Python fallback here is
+bit-identical (both implement chained XXH64).
 """
 
 from __future__ import annotations
@@ -18,15 +20,35 @@ from typing import Iterable, Optional, Sequence
 
 import xxhash
 
+from dynamo_tpu.native import get_native
+
 # Seed for the first block in a sequence (arbitrary non-zero constant; the
 # reference uses a fixed seed too — parity requires self-consistency only).
 INITIAL_SEED = 0xD3A10_C0DE
+
+# Bump when the hash function or chaining scheme changes (v1: xxh3_64,
+# v2: xxh64 shared with csrc/native.cpp). Travels in the ModelDeploymentCard
+# runtime config so mixed-version fleets never cross-match KV identities; the
+# G4 object store prefixes keys with it.
+HASH_VERSION = 2
+
+# Resolve (and if needed, build) the native extension at import time — i.e.
+# process startup — never lazily on the request path.
+_ = get_native()
+
+_MASK64 = 0xFFFFFFFFFFFFFFFF
 
 
 def hash_block(tokens: Sequence[int], seed: int) -> int:
     """Hash one full block of token ids with a chaining seed."""
     buf = b"".join(int(t).to_bytes(4, "little", signed=False) for t in tokens)
-    return xxhash.xxh3_64_intdigest(buf, seed=seed & 0xFFFFFFFFFFFFFFFF)
+    return xxhash.xxh64_intdigest(buf, seed=seed & _MASK64)
+
+
+def _initial_seed(lora_id: Optional[int]) -> int:
+    if lora_id is None:
+        return INITIAL_SEED
+    return (INITIAL_SEED ^ (lora_id * 0x9E3779B97F4A7C15)) & _MASK64
 
 
 def compute_block_hashes(
@@ -42,7 +64,10 @@ def compute_block_hashes(
     hash for the same reason).
     """
     assert block_size > 0
-    seed = INITIAL_SEED if lora_id is None else INITIAL_SEED ^ (lora_id * 0x9E3779B97F4A7C15)
+    seed = _initial_seed(lora_id)
+    native = get_native()
+    if native is not None:
+        return native.compute_block_hashes(tokens, block_size, seed)
     out: list[int] = []
     for start in range(0, len(tokens) - block_size + 1, block_size):
         seed = hash_block(tokens[start : start + block_size], seed)
@@ -63,23 +88,31 @@ class TokenBlockSequence:
         self.block_size = block_size
         self._tokens: list[int] = []
         self._hashes: list[int] = []
-        self._seed = (
-            INITIAL_SEED
-            if lora_id is None
-            else INITIAL_SEED ^ (lora_id * 0x9E3779B97F4A7C15)
-        )
+        self._seed = _initial_seed(lora_id)
 
     def extend(self, tokens: Iterable[int]) -> list[int]:
         """Append tokens; returns hashes of any newly completed blocks."""
         self._tokens.extend(int(t) for t in tokens)
-        new_hashes: list[int] = []
-        while len(self._tokens) - len(self._hashes) * self.block_size >= self.block_size:
-            start = len(self._hashes) * self.block_size
-            self._seed = hash_block(
-                self._tokens[start : start + self.block_size], self._seed
+        n_complete = len(self._tokens) // self.block_size
+        if n_complete <= len(self._hashes):
+            return []
+        start = len(self._hashes) * self.block_size
+        native = get_native()
+        if native is not None:
+            new_hashes = native.compute_block_hashes(
+                self._tokens[start : n_complete * self.block_size],
+                self.block_size,
+                self._seed,
             )
-            self._hashes.append(self._seed)
-            new_hashes.append(self._seed)
+        else:
+            new_hashes = []
+            seed = self._seed
+            for s in range(start, n_complete * self.block_size, self.block_size):
+                seed = hash_block(self._tokens[s : s + self.block_size], seed)
+                new_hashes.append(seed)
+        if new_hashes:
+            self._seed = new_hashes[-1]
+            self._hashes.extend(new_hashes)
         return new_hashes
 
     @property

@@ -1,0 +1,20 @@
+"""Build glue for the native C++ extension (csrc/).
+
+`pyproject.toml` carries all metadata; this file only declares the extension.
+Build in-place with:  python setup.py build_ext --inplace
+(dynamo_tpu/native.py auto-attempts this once per checkout).
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "dynamo_tpu._native",
+            sources=["csrc/native.cpp"],
+            include_dirs=["csrc"],
+            extra_compile_args=["-O3", "-std=c++17", "-fvisibility=hidden"],
+            language="c++",
+        )
+    ]
+)

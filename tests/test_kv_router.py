@@ -9,11 +9,26 @@ from dynamo_tpu.kv_router import (
     KvRouterConfig,
     KvScheduler,
     LoadMetrics,
+    NativeRadixTree,
     RadixTree,
     RouterEvent,
     WorkerWithDpRank,
     softmax_sample,
 )
+from dynamo_tpu.native import get_native
+
+
+def _native_tree():
+    native = get_native()
+    if native is None:
+        pytest.skip("native extension not built")
+    return NativeRadixTree(native)
+
+
+@pytest.fixture(params=["python", "native"])
+def make_tree(request):
+    """Both indexer backends must satisfy the same contract."""
+    return RadixTree if request.param == "python" else _native_tree
 
 W0 = WorkerWithDpRank(100)
 W1 = WorkerWithDpRank(200)
@@ -37,61 +52,61 @@ def removed(worker, event_id, hashes):
 
 
 class TestRadixTree:
-    def test_single_worker_match(self):
-        tree = RadixTree()
+    def test_single_worker_match(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2, 3]))
         scores = tree.find_matches([1, 2, 3, 4])
         assert scores.scores == {W0: 3}
 
-    def test_contiguity_required(self):
-        tree = RadixTree()
+    def test_contiguity_required(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2, 3]))
         # Query starting mid-sequence matches nothing from root.
         assert tree.find_matches([2, 3]).scores == {}
 
-    def test_two_workers_partial_overlap(self):
-        tree = RadixTree()
+    def test_two_workers_partial_overlap(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2, 3]))
         tree.apply_event(stored(W1, 0, [1, 2]))
         scores = tree.find_matches([1, 2, 3]).scores
         assert scores == {W0: 3, W1: 2}
 
-    def test_removal_prunes(self):
-        tree = RadixTree()
+    def test_removal_prunes(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2, 3]))
         tree.apply_event(removed(W0, 1, [3]))
         assert tree.find_matches([1, 2, 3]).scores == {W0: 2}
         assert tree.total_nodes() == 2
 
-    def test_remove_worker(self):
-        tree = RadixTree()
+    def test_remove_worker(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2]))
         tree.apply_event(stored(W1, 0, [1]))
         tree.remove_worker(W0)
         assert tree.find_matches([1, 2]).scores == {W1: 1}
         assert tree.total_nodes() == 1  # node 2 pruned, node 1 kept for W1
 
-    def test_cleared_event(self):
-        tree = RadixTree()
+    def test_cleared_event(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2]))
         tree.apply_event(RouterEvent(worker_id=W0.worker_id, event_id=1, cleared=True))
         assert tree.find_matches([1, 2]).scores == {}
 
-    def test_parent_hash_extension(self):
-        tree = RadixTree()
+    def test_parent_hash_extension(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2]))
         tree.apply_event(stored(W0, 1, [3, 4], parent=2))
         assert tree.find_matches([1, 2, 3, 4]).scores == {W0: 4}
 
-    def test_gap_detection(self):
-        tree = RadixTree()
+    def test_gap_detection(self, make_tree):
+        tree = make_tree()
         assert tree.apply_event(stored(W0, 0, [1])) == "ok"
         assert tree.apply_event(stored(W0, 1, [2], parent=1)) == "ok"
         assert tree.apply_event(stored(W0, 5, [3], parent=2)) == "gap"
         assert tree.gap_count == 1
 
-    def test_dp_ranks_are_distinct_workers(self):
-        tree = RadixTree()
+    def test_dp_ranks_are_distinct_workers(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2], dp_rank=0))
         tree.apply_event(stored(W0, 0, [1], dp_rank=1))
         scores = tree.find_matches([1, 2]).scores
@@ -100,19 +115,19 @@ class TestRadixTree:
             WorkerWithDpRank(W0.worker_id, 1): 1,
         }
 
-    def test_dump_and_load_roundtrip(self):
-        tree = RadixTree()
+    def test_dump_and_load_roundtrip(self, make_tree):
+        tree = make_tree()
         tree.apply_event(stored(W0, 0, [1, 2, 3]))
         tree.apply_event(stored(W0, 1, [10], parent=2))
         dump = tree.dump_worker(W0)
-        tree2 = RadixTree()
+        tree2 = make_tree()
         tree2.load_worker(W0, dump, last_event_id=1)
         assert tree2.find_matches([1, 2, 3]).scores == {W0: 3}
         assert tree2.find_matches([1, 2, 10]).scores == {W0: 3}
         # event continuity preserved
         assert tree2.apply_event(stored(W0, 2, [4], parent=3)) == "ok"
 
-    def test_wire_roundtrip(self):
+    def test_wire_roundtrip(self, make_tree):
         event = stored(W0, 3, [7, 8], parent=6)
         assert RouterEvent.from_wire(event.to_wire()) == event
 
