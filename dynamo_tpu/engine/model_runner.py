@@ -27,8 +27,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import ModelConfig, forward, init_params, make_kv_cache, param_axes
+from ..models.transformer import forward_ring, write_kv_stack
 from ..parallel import kv_cache_sharding, param_shardings
-from ..parallel.mesh import AXIS_DP, Mesh
+from ..parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP, Mesh
 from ..runtime.config import env
 from ..runtime.logging import get_logger
 from .sampler import sample
@@ -121,6 +122,7 @@ class ModelRunner:
         self._rep = NamedSharding(mesh, P())  # replicated host inputs
         self._decode_fn = self._build_decode()
         self._prefill_fns: dict[int, callable] = {}
+        self._ring_prefill_fns: dict[int, callable] = {}
         self.decode_steps = 0
 
     # -- compiled step builders -------------------------------------------
@@ -166,6 +168,84 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1,),
                        out_shardings=(self._kv_sharding, self._rep))
+
+    @property
+    def sp_size(self) -> int:
+        return self.mesh.shape.get(AXIS_SP, 1)
+
+    def _build_ring_prefill(self, bucket: int):
+        """Sequence-parallel prefill: the whole prompt in ONE step with the
+        sequence sharded over sp and ring attention across the ring
+        (ops/ring_attention.py). Scales max prefill length by sp without
+        ever materializing full attention on one chip."""
+        cfg = self.model_config
+        mesh = self.mesh
+        from jax import shard_map
+
+        from ..ops.ring_attention import ring_attention
+
+        s_q = P(None, AXIS_SP, AXIS_TP, None)  # [B, T, heads, hd]
+        s_p = P(None, AXIS_SP)  # [B, T]
+        ring_fn = shard_map(
+            lambda *a: ring_attention(*a, axis_name=AXIS_SP),
+            mesh=mesh,
+            in_specs=(s_q, s_q, s_q, s_p, s_p, s_p),
+            out_specs=s_q,
+        )
+
+        def step(params, kv, tokens, positions, valid, block_table,
+                 last_idx, temperature, top_p, top_k, seeds):
+            logits, ks, vs = forward_ring(params, cfg, tokens, positions,
+                                          valid, ring_fn)
+            kv = write_kv_stack(kv, ks, vs, block_table, positions, valid)
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1
+            )[:, 0, :]
+            token = sample(last, temperature, top_p, top_k, seeds,
+                           jnp.int32(0))
+            return kv, token
+
+        return jax.jit(step, donate_argnums=(1,),
+                       out_shardings=(self._kv_sharding, self._rep))
+
+    def prefill_ring(
+        self,
+        tokens: np.ndarray,  # [t] the FULL prompt (start position 0)
+        block_table: np.ndarray,  # [max_pages_per_seq] int32
+        sampling: tuple[float, float, int, int],
+    ) -> int:
+        """One-shot sequence-parallel prefill of a long prompt. Requires an
+        sp>1 mesh and kv-head count divisible by tp. Returns the first
+        sampled token; KV pages are populated for standard paged decode."""
+        t = len(tokens)
+        sp = self.sp_size
+        assert sp > 1, "prefill_ring needs an sp>1 mesh"
+        bucket = self._bucket_for(t)
+        # each sp shard needs an equal slice
+        if bucket % sp:
+            bucket += sp - bucket % sp
+        fn = self._ring_prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._build_ring_prefill(bucket)
+            self._ring_prefill_fns[bucket] = fn
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :t] = tokens
+        pos = np.zeros((1, bucket), np.int32)
+        pos[0, :t] = np.arange(t)
+        # Padding positions must not collide with real page slots: point them
+        # past the end so write_kv_stack drops them onto the scratch page.
+        pos[0, t:] = np.arange(t, bucket)
+        valid = np.zeros((1, bucket), bool)
+        valid[0, :t] = True
+        temp, top_p, top_k, seed = sampling
+        self.kv_cache, token = fn(
+            self.params, self.kv_cache, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(valid), jnp.asarray(block_table[None, :]),
+            jnp.asarray([t - 1], np.int32),
+            jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
+            jnp.asarray([top_k], np.int32), jnp.asarray([seed], np.uint32),
+        )
+        return int(np.asarray(token)[0])
 
     def _bucket_for(self, n: int) -> int:
         for b in self.config.prefill_buckets:

@@ -346,11 +346,31 @@ class InferenceScheduler:
         return False
 
     def _prefill_some(self) -> int:
-        """Advance one sequence's prefill by up to one chunk."""
+        """Advance one sequence's prefill by up to one chunk (or, for long
+        prompts on an sp>1 mesh, the WHOLE prompt in one sequence-parallel
+        ring-attention step — ops/ring_attention.py)."""
         budget = self.runner.max_prefill_chunk
         for seq in self._slots:
             if seq is None or seq.cancelled or seq.decode_ready:
                 continue
+            if (seq.prefill_pos == 0
+                    and seq.prompt_len > budget
+                    and getattr(self.runner, "sp_size", 1) > 1):
+                sampling = seq.request.sampling
+                token = self.runner.prefill_ring(
+                    np.asarray(seq.request.token_ids[: seq.prompt_len],
+                               np.int32),
+                    seq.block_table,
+                    (sampling.temperature, sampling.top_p, sampling.top_k,
+                     seq.seed),
+                )
+                seq.prefill_pos = seq.prompt_len
+                if seq.prefill_only:
+                    self._finish_prefill_only(seq, token)
+                else:
+                    self._append_token(seq, token,
+                                       prompt_tokens=seq.prompt_len)
+                return seq.prompt_len
             chunk = min(budget, seq.prompt_len - seq.prefill_pos)
             tokens = np.asarray(
                 seq.request.token_ids[seq.prefill_pos : seq.prefill_pos + chunk],

@@ -253,6 +253,77 @@ def paged_attention_xla(
 # ---------------------------------------------------------------------------
 
 
+def forward_ring(
+    params: dict,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, T] — T sharded over sp by the caller's jit
+    positions: jax.Array,  # [B, T] global positions
+    valid: jax.Array,  # [B, T]
+    ring_attention_fn,  # (q, k, v, q_pos, k_pos, k_valid) -> attn out
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel long-context prefill: attention over the chunk
+    itself via ring attention (ops/ring_attention.py) — no paged-cache read,
+    no [T, T] materialization, sequence sharded over the sp mesh axis.
+
+    Returns (logits [B, T, vocab], k_stack [L, B, T, kh, hd], v_stack) —
+    the caller scatters the K/V stacks into the paged pool (write_kv_stack)
+    so decode continues on the standard paged path. This is the long-context
+    mechanism the reference lacks natively (SURVEY §5.7: it leans on KVBM
+    tiering + chunked prefill; owning the model lets us shard the sequence).
+    """
+    x = params["embed"][tokens]
+    ks, vs = [], []
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q, positions, config.rope_theta)
+        k = rope(k, positions, config.rope_theta)
+        attn = ring_attention_fn(q, k, v, positions, positions, valid)
+        ks.append(k)
+        vs.append(v)
+        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        if config.n_experts:
+            x = x + _moe(h, lp, config)
+        else:
+            x = x + _swiglu(h, lp)
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def write_kv_stack(
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    k_stack: jax.Array,  # [L, B, T, kh, hd]
+    v_stack: jax.Array,
+    block_tables: jax.Array,  # [B, max_pages]
+    positions: jax.Array,  # [B, T]
+    valid: jax.Array,  # [B, T]
+) -> jax.Array:
+    """Scatter every layer's K/V chunk into the paged pool in one shot
+    (ring-prefill writeback)."""
+    n_layers, b, t = k_stack.shape[:3]
+    page_size = kv_cache.shape[3]
+    page_of = positions // page_size
+    page_idx = jnp.take_along_axis(block_tables, page_of.astype(jnp.int32), axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)  # padding -> scratch page 0
+    flat_pages = page_idx.reshape(-1)
+    flat_off = (positions % page_size).reshape(-1)
+    kv_cache = kv_cache.at[:, 0, flat_pages, flat_off].set(
+        k_stack.reshape(n_layers, b * t, *k_stack.shape[3:]), mode="drop"
+    )
+    kv_cache = kv_cache.at[:, 1, flat_pages, flat_off].set(
+        v_stack.reshape(n_layers, b * t, *v_stack.shape[3:]), mode="drop"
+    )
+    return kv_cache
+
+
 def forward(
     params: dict,
     config: ModelConfig,
