@@ -105,7 +105,9 @@ class ModelRunner:
         self._attention_fn = attention_fn
         axes = param_axes(model_config)
         self._param_sharding = param_shardings(mesh, axes)
-        self._kv_sharding = kv_cache_sharding(mesh)
+        self._kv_sharding = kv_cache_sharding(
+            mesh, head_sharded=not model_config.is_mla
+        )
         if params is None:
             init = jax.jit(
                 partial(init_params, config=model_config),
@@ -321,6 +323,35 @@ class ModelRunner:
             jnp.asarray(steps, jnp.int32),
         )
         return np.asarray(next_tokens)
+
+    def reshard(self, mesh: Mesh) -> None:
+        """Elastic parallelism rescale: re-place params on a NEW mesh
+        (different ep/tp/dp split, possibly different device count) and
+        rebuild the compiled steps. The paged KV pool is re-initialized —
+        callers drain or re-prefill in-flight sequences first (the
+        reference's scale_elastic_ep drains the same way,
+        ref: components/src/dynamo/vllm/handlers.py:498 scale_elastic_ep).
+        Must run on the scheduler thread (kv donation)."""
+        self.mesh = mesh
+        axes = param_axes(self.model_config)
+        self._param_sharding = param_shardings(mesh, axes)
+        self._kv_sharding = kv_cache_sharding(
+            mesh, head_sharded=not self.model_config.is_mla
+        )
+        self.params = jax.tree.map(
+            jax.device_put, self.params, self._param_sharding
+        )
+        kv_init = jax.jit(
+            lambda: make_kv_cache(self.model_config, self.config.num_pages,
+                                  self.config.page_size),
+            out_shardings=self._kv_sharding,
+        )
+        self.kv_cache = kv_init()
+        self._rep = NamedSharding(mesh, P())
+        self._decode_fn = self._build_decode()
+        self._prefill_fns = {}
+        self._ring_prefill_fns = {}
+        log.info("resharded onto mesh %s", dict(mesh.shape))
 
     def gather_pages(self, page_ids: np.ndarray) -> np.ndarray:
         """Pull pages to host in universal layout [n, L, 2, ps, kh, hd]

@@ -80,6 +80,15 @@ class KvEventBuffer:
             ))
             self._event_id += 1
 
+    def on_cleared(self) -> None:
+        """Whole-cache invalidation (clear_kv_blocks / elastic reshard)."""
+        with self._lock:
+            self._pending.append(RouterEvent(
+                worker_id=self.worker_id, event_id=self._event_id,
+                dp_rank=self.dp_rank, cleared=True,
+            ))
+            self._event_id += 1
+
     def drain(self) -> list[RouterEvent]:
         with self._lock:
             out, self._pending = self._pending, []
@@ -189,6 +198,15 @@ class TpuWorker:
             self._pull_served = await pull_ep.serve_endpoint(
                 self._kv_pull, instance_id=self.instance_id
             )
+        # Elastic parallelism rescale (ref: vllm handlers scale_elastic_ep)
+        ep_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("scale_elastic_ep")
+        )
+        self._scale_served = await ep_ep.serve_endpoint(
+            self._scale_elastic, instance_id=self.instance_id
+        )
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
@@ -197,7 +215,29 @@ class TpuWorker:
 
     async def _clear_kv(self, body, ctx) -> AsyncIterator[dict]:
         cleared = self.scheduler.pool.clear()
+        self.events.on_cleared()
         yield {"cleared_blocks": len(cleared)}
+
+    async def _scale_elastic(self, body, ctx=None) -> AsyncIterator[dict]:
+        """Re-place params on a new dp/tp/sp/ep mesh split at runtime.
+        Body: {"dp": n, "tp": n, "sp": n, "ep": n} (missing axes default 1).
+        The KV pool resets; in-flight requests re-prefill via migration."""
+        from ..parallel import MeshConfig, make_mesh
+
+        cfg = MeshConfig(
+            dp=int(body.get("dp", 1)), tp=int(body.get("tp", 1)),
+            sp=int(body.get("sp", 1)), ep=int(body.get("ep", 1)),
+        )
+        mesh = make_mesh(cfg)
+
+        def _do() -> None:
+            self.scheduler.pool.clear()
+            self.runner.reshard(mesh)
+
+        q = self.scheduler.run_in_step(_do)
+        await asyncio.get_running_loop().run_in_executor(None, q.get)
+        self.events.on_cleared()
+        yield {"ok": True, "mesh": dict(mesh.shape)}
 
     # -- disaggregation: prefill-side export -------------------------------
 

@@ -33,6 +33,15 @@ class ModelConfig:
     n_experts: int = 0
     n_experts_active: int = 0
     expert_mlp_hidden: int = 0
+    # Static per-expert buffer headroom for capacity dispatch (tokens per
+    # expert = ceil(cf * t * k / e)); overflow tokens drop that expert.
+    moe_capacity_factor: float = 1.25
+    # MLA (DeepSeek-class latent attention); 0 = standard GQA/MHA
+    mla_kv_lora_rank: int = 0
+    mla_q_lora_rank: int = 0
+    mla_rope_head_dim: int = 0
+    mla_nope_head_dim: int = 0
+    mla_v_head_dim: int = 0
 
     @property
     def q_dim(self) -> int:
@@ -41,6 +50,35 @@ class ModelConfig:
     @property
     def kv_dim(self) -> int:
         return self.n_kv_heads * self.head_dim
+
+    # -- MLA (latent attention) cache geometry ----------------------------
+
+    @property
+    def is_mla(self) -> bool:
+        return self.mla_kv_lora_rank > 0
+
+    @property
+    def mla_qk_head_dim(self) -> int:
+        return self.mla_nope_head_dim + self.mla_rope_head_dim
+
+    @property
+    def kv_cache_kv_dims(self) -> int:
+        """Size of the kv axis of the paged cache (2 = separate K and V
+        stacks; 1 for MLA's single latent stack)."""
+        return 1 if self.is_mla else 2
+
+    @property
+    def kv_cache_heads(self) -> int:
+        return 1 if self.is_mla else self.n_kv_heads
+
+    @property
+    def kv_cache_head_dim(self) -> int:
+        """Per-token per-'head' cache width: MLA caches the compressed
+        latent + shared rope key instead of per-head K/V — the memory win
+        that lets DeepSeek-class models hold long contexts."""
+        if self.is_mla:
+            return self.mla_kv_lora_rank + self.mla_rope_head_dim
+        return self.head_dim
 
 
 PRESETS: dict[str, ModelConfig] = {
@@ -71,6 +109,44 @@ PRESETS: dict[str, ModelConfig] = {
         name="llama3-70b", vocab_size=128256, hidden=8192, n_layers=80,
         n_q_heads=64, n_kv_heads=8, head_dim=128, mlp_hidden=28672,
         rope_theta=5e5, tie_embeddings=False, max_context=8192,
+    ),
+    # MoE families (expert axis shards over ep; ref orchestrates these via
+    # SGLang WideEP recipes — recipes/deepseek-r1, SURVEY §2.5)
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden=4096, n_layers=32,
+        n_q_heads=32, n_kv_heads=8, head_dim=128, mlp_hidden=14336,
+        rope_theta=1e6, tie_embeddings=False, max_context=32768,
+        n_experts=8, n_experts_active=2, expert_mlp_hidden=14336,
+    ),
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b", vocab_size=151936, hidden=2048, n_layers=48,
+        n_q_heads=32, n_kv_heads=4, head_dim=128, mlp_hidden=6144,
+        rope_theta=1e6, qk_norm=True, tie_embeddings=False,
+        max_context=32768, n_experts=128, n_experts_active=8,
+        expert_mlp_hidden=768,
+    ),
+    # GPT-OSS-120B class (ref workload: BASELINE config 4, KVBM offload)
+    "gpt-oss-120b": ModelConfig(
+        name="gpt-oss-120b", vocab_size=201088, hidden=2880, n_layers=36,
+        n_q_heads=64, n_kv_heads=8, head_dim=64, mlp_hidden=2880,
+        rope_theta=1.5e5, tie_embeddings=False, max_context=131072,
+        n_experts=128, n_experts_active=4, expert_mlp_hidden=2880,
+    ),
+    # DeepSeek-V2-Lite class: MLA latent attention + MoE (the reference's
+    # headline DeepSeek-R1 recipes use the full-size sibling)
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite", vocab_size=102400, hidden=2048, n_layers=27,
+        n_q_heads=16, n_kv_heads=16, head_dim=192, mlp_hidden=10944,
+        rope_theta=1e4, tie_embeddings=False, max_context=32768,
+        n_experts=64, n_experts_active=6, expert_mlp_hidden=1408,
+        mla_kv_lora_rank=512, mla_rope_head_dim=64, mla_nope_head_dim=128,
+        mla_v_head_dim=128,
+    ),
+    "tiny-mla-test": ModelConfig(
+        name="tiny-mla-test", vocab_size=512, hidden=64, n_layers=2,
+        n_q_heads=4, n_kv_heads=4, head_dim=24, mlp_hidden=128,
+        mla_kv_lora_rank=32, mla_rope_head_dim=8, mla_nope_head_dim=16,
+        mla_v_head_dim=16,
     ),
 }
 

@@ -40,14 +40,23 @@ def param_axes(config: ModelConfig) -> dict:
     layer = {
         "attn_norm": ("embed",),
         "wq": ("embed", "q_heads", "head_dim"),
-        "wk": ("embed", "kv_heads", "head_dim"),
-        "wv": ("embed", "kv_heads", "head_dim"),
         "wo": ("q_heads", "head_dim", "embed"),
         "mlp_norm": ("embed",),
         "w_gate": ("embed", "mlp"),
         "w_up": ("embed", "mlp"),
         "w_down": ("mlp", "embed"),
     }
+    if config.is_mla:
+        # Latent path: the compressed c_kv is shared across heads (never
+        # head-sharded); the up-projections carry the head axis for tp.
+        layer["w_dkv"] = ("embed", None)
+        layer["w_kr"] = ("embed", "head_dim")
+        layer["kv_norm"] = (None,)
+        layer["w_uk"] = (None, "q_heads", "head_dim")
+        layer["w_uv"] = (None, "q_heads", "head_dim")
+    else:
+        layer["wk"] = ("embed", "kv_heads", "head_dim")
+        layer["wv"] = ("embed", "kv_heads", "head_dim")
     if config.qk_norm:
         layer["q_norm"] = ("head_dim",)
         layer["k_norm"] = ("head_dim",)
@@ -77,18 +86,36 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
                 * (1.0 / math.sqrt(fan_in))).astype(dtype)
 
     def layer(k):
-        ks = jax.random.split(k, 10)
-        p = {
-            "attn_norm": jnp.ones((h,), dtype),
-            "wq": dense(ks[0], (h, qh, hd), h),
-            "wk": dense(ks[1], (h, kh, hd), h),
-            "wv": dense(ks[2], (h, kh, hd), h),
-            "wo": dense(ks[3], (qh, hd, h), qh * hd),
+        ks = jax.random.split(k, 12)
+        if config.is_mla:
+            dc = config.mla_kv_lora_rank
+            nhd = config.mla_nope_head_dim
+            rhd = config.mla_rope_head_dim
+            vhd = config.mla_v_head_dim
+            p = {
+                "attn_norm": jnp.ones((h,), dtype),
+                "wq": dense(ks[0], (h, qh, nhd + rhd), h),
+                "w_dkv": dense(ks[1], (h, dc), h),
+                "w_kr": dense(ks[2], (h, rhd), h),
+                "kv_norm": jnp.ones((dc,), dtype),
+                "w_uk": dense(ks[10], (dc, qh, nhd), dc),
+                "w_uv": dense(ks[11], (dc, qh, vhd), dc),
+                "wo": dense(ks[3], (qh, vhd, h), qh * vhd),
+            }
+        else:
+            p = {
+                "attn_norm": jnp.ones((h,), dtype),
+                "wq": dense(ks[0], (h, qh, hd), h),
+                "wk": dense(ks[1], (h, kh, hd), h),
+                "wv": dense(ks[2], (h, kh, hd), h),
+                "wo": dense(ks[3], (qh, hd, h), qh * hd),
+            }
+        p.update({
             "mlp_norm": jnp.ones((h,), dtype),
             "w_gate": dense(ks[4], (h, m), h),
             "w_up": dense(ks[5], (h, m), h),
             "w_down": dense(ks[6], (m, h), m),
-        }
+        })
         if config.qk_norm:
             p["q_norm"] = jnp.ones((hd,), dtype)
             p["k_norm"] = jnp.ones((hd,), dtype)
@@ -112,11 +139,14 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
 
 def make_kv_cache(config: ModelConfig, num_pages: int, page_size: int,
                   dtype: Optional[str] = None) -> jax.Array:
-    """[layers, 2(k/v), pages, page_size, kv_heads, head_dim]. Page 0 is a
-    reserved scratch page (block tables point unused slots at it)."""
+    """[layers, kv_dims, pages, page_size, cache_heads, cache_head_dim].
+    Standard attention: kv_dims=2 (K and V stacks), heads=n_kv_heads.
+    MLA: kv_dims=1, heads=1, head_dim=latent_rank+rope_dim — the compressed
+    latent cache. Page 0 is a reserved scratch page (block tables point
+    unused slots at it)."""
     return jnp.zeros(
-        (config.n_layers, 2, num_pages, page_size, config.n_kv_heads,
-         config.head_dim),
+        (config.n_layers, config.kv_cache_kv_dims, num_pages, page_size,
+         config.kv_cache_heads, config.kv_cache_head_dim),
         dtype=jnp.dtype(dtype or config.dtype),
     )
 
@@ -156,10 +186,10 @@ def _swiglu(x: jax.Array, p: dict) -> jax.Array:
     return jnp.einsum("btm,mh->bth", jax.nn.silu(gate) * up, p["w_down"])
 
 
-def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
-    """Dense-compute MoE (every expert computed, weighted by router top-k
-    mask) — compiles to static shapes; token-dropping EP dispatch is an
-    optimization layered in ops/moe later."""
+def _moe_dense(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
+    """Oracle MoE: every expert computed for every token, weighted by the
+    router's top-k mask. O(e) FLOPs per token — used only as the test
+    reference for the dispatched path below."""
     logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
                         p["router"].astype(jnp.float32))
     k = config.n_experts_active
@@ -176,6 +206,51 @@ def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
                             p["e_down"])
     return jnp.einsum("beth,bte->bth", expert_out,
                       mask.astype(x.dtype))
+
+
+def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
+    """Expert-parallel MoE with static-shape capacity dispatch.
+
+    The classic einsum dispatch/combine formulation (Mesh-TF/Switch style —
+    compiler-friendly: no dynamic shapes, no sorting): each token picks its
+    top-k experts, gets a slot in a fixed-capacity per-expert buffer via a
+    cumulative-sum position, and overflow tokens are dropped for that
+    expert. Expert-dim tensors shard over the `ep` mesh axis (experts axis
+    of e_gate/e_up/e_down — parallel/shardings.LOGICAL_RULES), so the
+    dispatch/combine einsums lower to all-to-alls over ICI. This replaces
+    the reference's delegation to SGLang WideEP/DeepEP (SURVEY §2.5) with
+    an XLA-native design.
+    """
+    b, t, h = x.shape
+    e = config.n_experts
+    k = config.n_experts_active
+    # capacity: slots per expert for this chunk (static: t is a traced shape)
+    cap = max(k, int(math.ceil(config.moe_capacity_factor * t * k / e)))
+
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    topv, topi = jax.lax.top_k(logits, k)  # [b, t, k]
+    weights = jax.nn.softmax(topv, axis=-1)  # matches _moe_dense semantics
+
+    sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [b, t, k, e]
+    # Priority order: all tokens' 1st choice first, then 2nd choices, ...
+    # (flatten as [k*t] so lower-k picks win capacity slots).
+    sel_flat = sel.transpose(0, 2, 1, 3).reshape(b, k * t, e)
+    pos = jnp.cumsum(sel_flat, axis=1) - sel_flat  # exclusive: slot index
+    keep = sel_flat * (pos < cap)
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [b, k*t, e, cap]
+    dispatch_f = keep[..., None] * slot  # [b, k*t, e, cap]
+    dispatch = (
+        dispatch_f.reshape(b, k, t, e, cap).transpose(0, 2, 1, 3, 4)
+    )  # [b, t, k, e, cap]
+    combine = jnp.einsum("btkec,btk->btec", dispatch, weights)
+    dispatch_btec = dispatch.sum(axis=2).astype(x.dtype)  # [b, t, e, cap]
+
+    xe = jnp.einsum("btec,bth->ebch", dispatch_btec, x)  # [e, b, cap, h]
+    gate = jnp.einsum("ebch,ehm->ebcm", xe, p["e_gate"])
+    up = jnp.einsum("ebch,ehm->ebcm", xe, p["e_up"])
+    out_e = jnp.einsum("ebcm,emh->ebch", jax.nn.silu(gate) * up, p["e_down"])
+    return jnp.einsum("btec,ebch->bth", combine.astype(x.dtype), out_e)
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +323,94 @@ def paged_attention_xla(
     return out.reshape(b, t, qh, hd).astype(q.dtype)
 
 
+def write_latent_pages(
+    kv_cache: jax.Array,  # [L, 1, P, ps, 1, dc+rhd]
+    layer: int,
+    latent: jax.Array,  # [B, T, dc+rhd] c_kv ++ k_rope per token
+    block_tables: jax.Array,
+    positions: jax.Array,
+    valid: jax.Array,
+) -> jax.Array:
+    """MLA cache write: one compressed latent row per token."""
+    page_size = kv_cache.shape[3]
+    b, t = positions.shape
+    page_of = positions // page_size
+    page_idx = jnp.take_along_axis(block_tables, page_of.astype(jnp.int32),
+                                   axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)
+    flat_pages = page_idx.reshape(-1)
+    flat_off = (positions % page_size).reshape(-1)
+    return kv_cache.at[layer, 0, flat_pages, flat_off, 0].set(
+        latent.reshape(b * t, -1), mode="drop"
+    )
+
+
+def _mla_attention_block(
+    x: jax.Array,  # [B, T, H] (already attn-normed)
+    lp: dict,
+    config: ModelConfig,
+    kv_cache: jax.Array,
+    layer_idx: int,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    valid: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """MLA with weight absorption (the efficient decode form): queries are
+    projected into latent space (q_nope @ W_uk) so scores and context are
+    computed directly against the compressed cache — no per-head K/V is
+    ever materialized for past tokens. Per-token cache cost is
+    latent_rank+rope_dim (e.g. 576 vs 2*kh*hd=6144 for DeepSeek-class) —
+    the long-context memory win that motivates MLA.
+
+    Returns (new_kv_cache, attn_out [B, T, qh, v_hd]).
+    """
+    b, t, _ = x.shape
+    nhd, rhd = config.mla_nope_head_dim, config.mla_rope_head_dim
+    dc = config.mla_kv_lora_rank
+    scale = 1.0 / math.sqrt(config.mla_qk_head_dim)
+
+    q = jnp.einsum("bth,hqd->btqd", x, lp["wq"])  # [B,T,qh,nhd+rhd]
+    q_nope, q_rope = q[..., :nhd], q[..., nhd:]
+    q_rope = rope(q_rope, positions, config.rope_theta)
+
+    c_kv = rms_norm(jnp.einsum("bth,hd->btd", x, lp["w_dkv"]),
+                    lp["kv_norm"], config.rms_eps)  # [B,T,dc]
+    k_rope = rope(jnp.einsum("bth,hr->btr", x, lp["w_kr"])[:, :, None, :],
+                  positions, config.rope_theta)[:, :, 0, :]  # [B,T,rhd]
+
+    latent = jnp.concatenate([c_kv, k_rope], axis=-1)
+    kv_cache = write_latent_pages(kv_cache, layer_idx, latent, block_tables,
+                                  positions, valid)
+
+    # absorb W_uk: queries into latent space
+    q_lat = jnp.einsum("btqn,dqn->btqd", q_nope, lp["w_uk"])  # [B,T,qh,dc]
+
+    # gather latent pages: [B, ctx, dc+rhd]
+    ps = kv_cache.shape[3]
+    ctx = block_tables.shape[1] * ps
+    pages = kv_cache[layer_idx, 0][block_tables][..., 0, :]
+    lat_ctx = pages.reshape(b, ctx, dc + rhd)
+    ckv_ctx, kr_ctx = lat_ctx[..., :dc], lat_ctx[..., dc:]
+
+    scores = (
+        jnp.einsum("btqd,bsd->btqs", q_lat.astype(jnp.float32),
+                   ckv_ctx.astype(jnp.float32))
+        + jnp.einsum("btqr,bsr->btqs", q_rope.astype(jnp.float32),
+                     kr_ctx.astype(jnp.float32))
+    ) * scale
+    kv_pos = jnp.arange(ctx)[None, :]
+    mask = (kv_pos[:, None, :] <= positions[..., None]) & (
+        kv_pos[:, None, :] < kv_lens[:, None, None]
+    )
+    scores = jnp.where(mask[:, :, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx_lat = jnp.einsum("btqs,bsd->btqd", probs,
+                         ckv_ctx.astype(jnp.float32))  # [B,T,qh,dc]
+    attn = jnp.einsum("btqd,dqv->btqv", ctx_lat.astype(x.dtype), lp["w_uv"])
+    return kv_cache, attn
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -271,6 +434,9 @@ def forward_ring(
     mechanism the reference lacks natively (SURVEY §5.7: it leans on KVBM
     tiering + chunked prefill; owning the model lets us shard the sequence).
     """
+    assert not config.is_mla, (
+        "ring prefill currently targets GQA models; MLA long prefill uses "
+        "the chunked path (its latent cache is already ~10x smaller)")
     x = params["embed"][tokens]
     ks, vs = [], []
     for lp in params["layers"]:
@@ -345,18 +511,24 @@ def forward(
     x = params["embed"][tokens]  # [B, T, H]
     for layer_idx, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
-        if config.qk_norm:
-            q = rms_norm(q, lp["q_norm"], config.rms_eps)
-            k = rms_norm(k, lp["k_norm"], config.rms_eps)
-        q = rope(q, positions, config.rope_theta)
-        k = rope(k, positions, config.rope_theta)
-        kv_cache = write_kv_pages(kv_cache, layer_idx, k, v, block_tables,
-                                  positions, valid)
-        attn = attention(q, kv_cache, layer_idx, block_tables, positions,
-                         kv_lens)
+        if config.is_mla:
+            kv_cache, attn = _mla_attention_block(
+                h, lp, config, kv_cache, layer_idx, block_tables,
+                positions, kv_lens, valid,
+            )
+        else:
+            q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+            k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+            v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+            if config.qk_norm:
+                q = rms_norm(q, lp["q_norm"], config.rms_eps)
+                k = rms_norm(k, lp["k_norm"], config.rms_eps)
+            q = rope(q, positions, config.rope_theta)
+            k = rope(k, positions, config.rope_theta)
+            kv_cache = write_kv_pages(kv_cache, layer_idx, k, v,
+                                      block_tables, positions, valid)
+            attn = attention(q, kv_cache, layer_idx, block_tables,
+                             positions, kv_lens)
         x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         if config.n_experts:

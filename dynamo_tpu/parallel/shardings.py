@@ -52,9 +52,12 @@ def param_shardings(mesh: Mesh, param_axes: Any) -> Any:
     )
 
 
-def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pages: [layers, 2, pages, page, kv_heads, head_dim] — kv heads
-    over tp; pages replicated within a dp rank."""
+def kv_cache_sharding(mesh: Mesh, head_sharded: bool = True) -> NamedSharding:
+    """KV pages: [layers, kv, pages, page, heads, head_dim] — kv heads over
+    tp; pages replicated within a dp rank. MLA caches (head_sharded=False)
+    hold a single head-shared latent, replicated over tp."""
+    if not head_sharded:
+        return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(None, None, None, None, AXIS_TP, None))
 
 
