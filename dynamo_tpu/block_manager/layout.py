@@ -30,6 +30,7 @@ class BlockLayoutSpec:
     head_dim: int
     page_size: int
     dtype: str  # numpy dtype name
+    kv_dims: int = 2  # 2 for separate K/V stacks, 1 for MLA latent cache
     kv_head_start: int = 0  # first head this shard holds
     kv_head_count: Optional[int] = None  # None = all heads (unsharded)
 
@@ -41,8 +42,8 @@ class BlockLayoutSpec:
 
     @property
     def block_shape(self) -> tuple[int, ...]:
-        return (self.n_layers, 2, self.page_size, self.kv_head_count,
-                self.head_dim)
+        return (self.n_layers, self.kv_dims, self.page_size,
+                self.kv_head_count, self.head_dim)
 
     def block_bytes(self) -> int:
         return int(np.prod(self.block_shape)) * np.dtype(self.dtype).itemsize
@@ -60,7 +61,7 @@ class BlockLayoutSpec:
         return cls(
             n_layers=layout["n_layers"], total_kv_heads=layout["kv_heads"],
             head_dim=layout["head_dim"], page_size=layout["page_size"],
-            dtype=layout["dtype"],
+            dtype=layout["dtype"], kv_dims=layout.get("kv_dims", 2),
         )
 
     def head_range(self) -> tuple[int, int]:

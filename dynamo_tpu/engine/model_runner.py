@@ -100,6 +100,7 @@ class ModelRunner:
         self.model_config = model_config
         self.config = runner_config
         self.mesh = mesh
+        self._attention_user_supplied = attention_fn is not None
         if attention_fn is None:
             attention_fn = _default_attention_fn(mesh)
         self._attention_fn = attention_fn
@@ -333,6 +334,10 @@ class ModelRunner:
         ref: components/src/dynamo/vllm/handlers.py:498 scale_elastic_ep).
         Must run on the scheduler thread (kv donation)."""
         self.mesh = mesh
+        if not self._attention_user_supplied:
+            # The kernel choice depends on the mesh (Pallas flash-decode is
+            # single-device only): re-derive it for the new device count.
+            self._attention_fn = _default_attention_fn(mesh)
         axes = param_axes(self.model_config)
         self._param_sharding = param_shardings(mesh, axes)
         self._kv_sharding = kv_cache_sharding(
@@ -371,12 +376,15 @@ class ModelRunner:
         )
 
     def kv_layout(self) -> dict:
-        """Wire-layout descriptor of this runner's paged pool."""
+        """Wire-layout descriptor of this runner's paged pool. Geometry comes
+        from the *cache* dims, not the attention dims — MLA caches one latent
+        stack per layer ([L, 1, ps, 1, rank+rope]), not per-head K/V."""
         cfg = self.model_config
         return {
             "n_layers": cfg.n_layers,
-            "kv_heads": cfg.n_kv_heads,
-            "head_dim": cfg.head_dim,
+            "kv_heads": cfg.kv_cache_heads,
+            "head_dim": cfg.kv_cache_head_dim,
+            "kv_dims": cfg.kv_cache_kv_dims,
             "page_size": self.config.page_size,
             "dtype": str(jnp.dtype(cfg.dtype).name),
         }

@@ -475,6 +475,27 @@ class InferenceScheduler:
         if finish is not None:
             seq.finished = True
 
+    def abort_all(self, reason: str) -> int:
+        """Finish every waiting + in-flight sequence with finish_reason
+        'migrate' so the frontend Migration operator re-prefills them on a
+        (re)available worker with generated tokens preserved. Must run on
+        the scheduler thread (e.g. inside a run_in_step callback) — used by
+        elastic reshard, where the KV pool is about to be reinitialized."""
+        n = 0
+        for seq in self._waiting:
+            if not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="migrate", error=reason))
+                seq.cancelled = True
+                n += 1
+        self._waiting.clear()
+        for seq in self._slots:
+            if seq is not None and not seq.finished and not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="migrate", error=reason))
+                seq.finished = True
+                n += 1
+        self._reap_finished()
+        return n
+
     def _reap_finished(self) -> None:
         for i, seq in enumerate(self._slots):
             if seq is None:

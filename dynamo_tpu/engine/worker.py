@@ -36,7 +36,12 @@ from ..llm.model_card import (
     ModelDeploymentCard,
     publish_card,
 )
-from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
 from ..models import get_config
 from ..parallel import MeshConfig, make_mesh
 from ..runtime import DistributedRuntime, new_instance_id
@@ -177,8 +182,16 @@ class TpuWorker:
             .component(self.card.component)
             .endpoint("generate")
         )
+        canary = PreprocessedRequest(
+            request_id="_canary",
+            token_ids=[0],
+            sampling=SamplingOptions(max_tokens=1, temperature=0.0),
+            stop=StopConditions(),
+            annotations={"canary": True},
+        ).to_wire()
         self._served = await endpoint.serve_endpoint(
-            self.generate, instance_id=self.instance_id
+            self.generate, instance_id=self.instance_id,
+            health_check_payload=canary,
         )
         # clear_kv_blocks endpoint (ref: vllm worker clear_kv_blocks)
         clear_ep = (
@@ -221,9 +234,9 @@ class TpuWorker:
     async def _scale_elastic(self, body, ctx=None) -> AsyncIterator[dict]:
         """Re-place params on a new dp/tp/sp/ep mesh split at runtime.
         Body: {"dp": n, "tp": n, "sp": n, "ep": n} (missing axes default 1).
-        The KV pool resets; in-flight requests re-prefill via migration."""
-        from ..parallel import MeshConfig, make_mesh
-
+        In-flight requests are finished with 'migrate' (the frontend
+        Migration operator replays them, tokens preserved) before the KV
+        pool resets."""
         cfg = MeshConfig(
             dp=int(body.get("dp", 1)), tp=int(body.get("tp", 1)),
             sp=int(body.get("sp", 1)), ep=int(body.get("ep", 1)),
@@ -231,6 +244,7 @@ class TpuWorker:
         mesh = make_mesh(cfg)
 
         def _do() -> None:
+            self.scheduler.abort_all("elastic reshard")
             self.scheduler.pool.clear()
             self.runner.reshard(mesh)
 
@@ -426,6 +440,12 @@ class TpuWorker:
         for task in self._tasks:
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        # Endpoints drain BEFORE the scheduler stops — in-flight generate/
+        # scale requests need a live scheduler loop to ever finish.
+        for served in (self._served, self._clear_served, self._pull_served,
+                       self._scale_served):
+            if served is not None:
+                await served.shutdown()
         if self.kvbm is not None:
             # Drain pending offload gathers while the scheduler thread can
             # still service run_in_step, then stop both.
@@ -434,12 +454,6 @@ class TpuWorker:
             self.scheduler.stop()
         if self.kvbm is not None:
             self.kvbm.close()
-        if self._served is not None:
-            await self._served.shutdown()
-        if self._clear_served is not None:
-            await self._clear_served.shutdown()
-        if self._pull_served is not None:
-            await self._pull_served.shutdown()
         for router in self._pull_clients.values():
             await router.client.close()
 
@@ -512,8 +526,15 @@ async def main(argv: Optional[list[str]] = None) -> None:
         reasoning_parser=args.reasoning_parser,
     )
     await worker.start()
+    from ..runtime import HealthCheckManager
+    from ..runtime.config import env
+
+    health = HealthCheckManager(runtime,
+                                canary_wait_time=env("DYNT_CANARY_WAIT_SECS"))
+    health.start()
     try:
         await wait_for_shutdown_signal()
     finally:
+        await health.close()
         await worker.close()
         await runtime.shutdown()

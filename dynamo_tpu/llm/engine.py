@@ -98,6 +98,13 @@ class Migration(TokenEngine):
         while True:
             try:
                 async for output in self.inner.generate(current):
+                    if output.finish_reason == "migrate":
+                        # In-band migration request from the worker (e.g.
+                        # elastic reshard evicted the sequence): retry like a
+                        # broken stream, tokens preserved. Never reaches the
+                        # client.
+                        raise ConnectionLost(
+                            output.error or "worker requested migration")
                     generated.extend(output.token_ids)
                     yield output
                 return

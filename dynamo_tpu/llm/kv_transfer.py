@@ -43,16 +43,18 @@ class KvLayoutDescriptor:
     head_dim: int
     page_size: int
     dtype: str  # numpy dtype name of the wire payload
+    kv_dims: int = 2  # 2 for separate K/V stacks, 1 for MLA latent cache
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_wire(cls, data: dict) -> "KvLayoutDescriptor":
-        return cls(**{f.name: data[f.name] for f in dataclasses.fields(cls)})
+        return cls(**{f.name: data[f.name]
+                      for f in dataclasses.fields(cls) if f.name in data})
 
     def page_bytes(self) -> int:
-        return (self.n_layers * 2 * self.page_size * self.kv_heads
+        return (self.n_layers * self.kv_dims * self.page_size * self.kv_heads
                 * self.head_dim * np.dtype(self.dtype).itemsize)
 
     def compatible(self, other: "KvLayoutDescriptor") -> bool:

@@ -19,6 +19,24 @@ from .engine import MockerConfig, MockerEngine
 log = get_logger("mocker.worker")
 
 
+def _canary_request() -> dict:
+    """Synthetic single-token request recognized by the engine as cheap
+    (ref: health_check.rs HealthCheckTarget payload)."""
+    from ..llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        request_id="_canary",
+        token_ids=[0],
+        sampling=SamplingOptions(max_tokens=1, temperature=0.0),
+        stop=StopConditions(),
+        annotations={"canary": True},
+    ).to_wire()
+
+
 class MockerWorker:
     def __init__(
         self,
@@ -63,7 +81,8 @@ class MockerWorker:
             .endpoint("generate")
         )
         self._served = await endpoint.serve_endpoint(
-            self.engine.generate, instance_id=self.instance_id
+            self.engine.generate, instance_id=self.instance_id,
+            health_check_payload=_canary_request(),
         )
         await publish_card(self.runtime, self.card, self.instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
@@ -132,9 +151,16 @@ async def main(argv: Optional[list[str]] = None) -> None:
         reasoning_parser=args.reasoning_parser,
     )
     await worker.start()
+    from ..runtime import HealthCheckManager
+    from ..runtime.config import env
+
+    health = HealthCheckManager(runtime,
+                                canary_wait_time=env("DYNT_CANARY_WAIT_SECS"))
+    health.start()
     try:
         await wait_for_shutdown_signal()
     finally:
+        await health.close()
         await worker.close()
         await runtime.shutdown()
 

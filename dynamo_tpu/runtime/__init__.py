@@ -12,6 +12,7 @@ from .discovery import (
     make_discovery,
 )
 from .distributed import DistributedRuntime
+from .health_check import HealthCheckManager
 from .logging import configure_logging, get_logger
 from .push_router import NoInstancesAvailable, PushRouter
 from .request_plane import (
@@ -30,6 +31,7 @@ __all__ = [
     "Endpoint",
     "EndpointNotFound",
     "FileDiscovery",
+    "HealthCheckManager",
     "KvEvent",
     "Lease",
     "LeaseExpired",

@@ -80,12 +80,15 @@ class Endpoint:
         instance_id: Optional[int] = None,
         metadata: Optional[dict] = None,
         graceful: bool = True,
+        health_check_payload: Optional[Any] = None,
     ) -> "ServedEndpoint":
         """Register `handler` on the request plane and advertise the instance
-        (ref: bindings rust/lib.rs:815 serve_endpoint -> PushEndpoint.start)."""
+        (ref: bindings rust/lib.rs:815 serve_endpoint -> PushEndpoint.start).
+        `health_check_payload` opts into canary probing (health_check.py)."""
         instance_id = instance_id if instance_id is not None else new_instance_id()
         served = ServedEndpoint(self, instance_id, handler, metadata or {},
-                                graceful=graceful)
+                                graceful=graceful,
+                                health_check_payload=health_check_payload)
         await served.start()
         return served
 
@@ -98,7 +101,8 @@ class ServedEndpoint:
     in-flight tracking for graceful drain, and its discovery record."""
 
     def __init__(self, endpoint: Endpoint, instance_id: int, handler: Handler,
-                 metadata: dict, graceful: bool = True) -> None:
+                 metadata: dict, graceful: bool = True,
+                 health_check_payload: Optional[Any] = None) -> None:
         self.endpoint = endpoint
         self.instance_id = instance_id
         self.metadata = metadata
@@ -106,6 +110,9 @@ class ServedEndpoint:
         self._graceful = graceful
         self._shutting_down = False
         self._inflight = 0
+        self.health_check_payload = health_check_payload
+        self.health_ok = True
+        self.last_activity = time.monotonic()
         self._drained = asyncio.Event()
         self._drained.set()
         self._metrics = EndpointMetrics(
@@ -122,9 +129,9 @@ class ServedEndpoint:
         return f"{self.endpoint.instance_prefix}{self.instance_id}"
 
     def healthy(self) -> bool:
-        """Liveness for /health: serving and not yet deregistered. Canary
-        request probing layers on top (ref: health_check.rs HealthCheckManager)."""
-        return not self._shutting_down
+        """Liveness for /health: serving, not deregistered, and passing
+        canaries (ref: health_check.rs HealthCheckManager)."""
+        return not self._shutting_down and self.health_ok
 
     async def start(self) -> None:
         runtime = self.endpoint.runtime
@@ -146,6 +153,11 @@ class ServedEndpoint:
         self._inflight += 1
         self._drained.clear()
         start = time.monotonic()
+        if "x-dynt-canary" not in ctx.headers:
+            # Canary probes must not count as traffic, or a wedged-but-alive
+            # handler would keep resetting its own idle clock and never
+            # accumulate the consecutive failures that deregister it.
+            self.last_activity = start
         status = "ok"
         try:
             async for item in self._handler(body, ctx):

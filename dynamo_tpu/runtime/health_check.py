@@ -1,0 +1,116 @@
+"""Canary health checks for served endpoints.
+
+Mirrors the reference's HealthCheckManager (ref: lib/runtime/src/
+health_check.rs:22-50): endpoints that have been idle for longer than
+`canary_wait_time` get a synthetic "canary" request sent through the full
+request plane (loopback through the endpoint's own wire subject, so the
+serving loop, codec, and handler are all exercised — not just a Python
+function call). A canary that errors or times out marks the endpoint
+unhealthy; after `max_failures` consecutive failures the instance is
+proactively deregistered from discovery so routers stop sending to it
+(the lease-expiry path would catch a dead *process*; the canary catches a
+live process with a wedged handler).
+
+Handlers opt in by passing `health_check_payload=` to `serve_endpoint` —
+a payload the handler recognizes as synthetic and answers cheaply (ref:
+health_check.rs `HealthCheckTarget::payload`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from .logging import get_logger
+
+log = get_logger("health_check")
+
+
+class HealthCheckManager:
+    def __init__(
+        self,
+        runtime,
+        canary_wait_time: float = 60.0,
+        check_interval: float = 10.0,
+        canary_timeout: float = 10.0,
+        max_failures: int = 3,
+    ) -> None:
+        self.runtime = runtime
+        self.canary_wait_time = canary_wait_time
+        self.check_interval = check_interval
+        self.canary_timeout = canary_timeout
+        self.max_failures = max_failures
+        self._failures: dict[int, int] = {}
+        self._deregistered: set[int] = set()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval)
+            await self.check_now()
+
+    async def check_now(self) -> None:
+        """One sweep over this runtime's served endpoints (exposed separately
+        from the loop so tests and drain hooks can force a sweep)."""
+        now = time.monotonic()
+        for served in list(self.runtime._served):
+            if (served.health_check_payload is None or served._shutting_down
+                    or served.instance_id in self._deregistered):
+                continue
+            if now - served.last_activity < self.canary_wait_time:
+                # Live traffic is the health signal; canaries only probe
+                # idle endpoints (ref: health_check.rs canary_wait_time).
+                self._failures.pop(served.instance_id, None)
+                continue
+            await self._probe(served)
+
+    async def _probe(self, served) -> None:
+        ok = False
+        try:
+            stream = self.runtime.request_client.call(
+                self.runtime.request_server.address,
+                served.wire_subject,
+                served.health_check_payload,
+                {"x-dynt-canary": "1"},
+            )
+
+            async def _consume() -> None:
+                async for _ in stream:
+                    break
+
+            await asyncio.wait_for(_consume(), self.canary_timeout)
+            ok = True
+        except Exception as exc:  # noqa: BLE001 — any failure is unhealthy
+            log.warning("canary failed on %s instance=%x: %r",
+                        served.endpoint.subject, served.instance_id, exc)
+        iid = served.instance_id
+        if ok:
+            self._failures.pop(iid, None)
+            served.health_ok = True
+            return
+        failures = self._failures.get(iid, 0) + 1
+        self._failures[iid] = failures
+        served.health_ok = False
+        if failures >= self.max_failures:
+            log.error(
+                "endpoint %s instance=%x failed %d canaries — deregistering",
+                served.endpoint.subject, iid, failures)
+            self._deregistered.add(iid)
+            try:
+                await self.runtime.discovery.delete(served.instance_key)
+            except Exception:  # noqa: BLE001 — best-effort deregistration
+                pass

@@ -1,0 +1,101 @@
+"""Canary health-check tests (ref contract: lib/runtime/src/health_check.rs —
+synthetic requests to idle endpoints after canary_wait_time; failures mark
+unhealthy and eventually deregister)."""
+
+import asyncio
+import uuid
+
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    HealthCheckManager,
+    PushRouter,
+    RuntimeConfig,
+)
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    return cfg
+
+
+class TestHealthCheck:
+    def test_canary_probes_idle_endpoint(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            seen = []
+
+            async def handler(req, ctx):
+                seen.append(req)
+                yield {"ok": True}
+
+            ep = rt.namespace("t").component("w").endpoint("generate")
+            served = await ep.serve_endpoint(
+                handler, health_check_payload={"canary": True})
+            manager = HealthCheckManager(rt, canary_wait_time=0.0,
+                                         canary_timeout=2.0)
+            await manager.check_now()
+            assert seen == [{"canary": True}]
+            assert served.healthy()
+            await rt.shutdown()
+
+        run(body())
+
+    def test_active_endpoint_not_probed(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            seen = []
+
+            async def handler(req, ctx):
+                seen.append(req)
+                yield {"ok": True}
+
+            ep = rt.namespace("t").component("w").endpoint("generate")
+            await ep.serve_endpoint(
+                handler, health_check_payload={"canary": True})
+            client = ep.client()
+            await client.wait_for_instances(1, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+            out = [x async for x in router.generate({"real": 1})]
+            assert out == [{"ok": True}]
+            manager = HealthCheckManager(rt, canary_wait_time=60.0)
+            await manager.check_now()
+            assert seen == [{"real": 1}]  # no canary: traffic is recent
+            await rt.shutdown()
+
+        run(body())
+
+    def test_failing_canary_marks_unhealthy_and_deregisters(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+
+            async def handler(req, ctx):
+                raise RuntimeError("wedged")
+                yield  # pragma: no cover
+
+            ep = rt.namespace("t").component("w").endpoint("generate")
+            served = await ep.serve_endpoint(
+                handler, health_check_payload={"canary": True})
+            client = ep.client()
+            await client.wait_for_instances(1, timeout=5.0)
+
+            manager = HealthCheckManager(rt, canary_wait_time=0.0,
+                                         canary_timeout=2.0, max_failures=2)
+            await manager.check_now()
+            assert not served.healthy()
+            await manager.check_now()  # second failure -> deregister
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while client.instance_ids():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            await rt.shutdown()
+
+        run(body())

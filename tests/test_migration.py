@@ -76,6 +76,37 @@ class TestMigration:
 
         run(body())
 
+    def test_in_band_migrate_signal_retries(self, run):
+        """A worker finishing a stream with finish_reason='migrate' (elastic
+        reshard eviction) must be retried like a broken stream — and the
+        migrate marker must never reach the client."""
+
+        class ReshardingEngine(TokenEngine):
+            def __init__(self):
+                self.attempts = 0
+                self.seen_requests = []
+
+            async def generate(self, request):
+                self.attempts += 1
+                self.seen_requests.append(request)
+                if self.attempts == 1:
+                    yield EngineOutput(token_ids=[7])
+                    yield EngineOutput(finish_reason="migrate",
+                                       error="elastic reshard")
+                    return
+                yield EngineOutput(token_ids=[8], finish_reason="stop")
+
+        async def body():
+            inner = ReshardingEngine()
+            migration = Migration(inner, migration_limit=3)
+            outs = [o async for o in migration.generate(_request())]
+            tokens = [t for o in outs for t in o.token_ids]
+            assert tokens == [7, 8]
+            assert all(o.finish_reason != "migrate" for o in outs)
+            assert inner.seen_requests[1].token_ids == [1, 2, 3, 7]
+
+        run(body())
+
     def test_budget_exhausted_during_retries(self, run):
         async def body():
             inner = FlakyEngine(fail_times=10, per_attempt=5)
