@@ -126,6 +126,7 @@ class ModelRunner:
         self._decode_fn = self._build_decode()
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
+        self._embed_fns: dict[int, callable] = {}
         self.decode_steps = 0
 
     # -- compiled step builders -------------------------------------------
@@ -250,6 +251,32 @@ class ModelRunner:
         )
         return int(np.asarray(token)[0])
 
+    def embed(self, tokens: np.ndarray) -> np.ndarray:
+        """Pooled, L2-normalized embedding of a token sequence [H] float32
+        (ref surface: /v1/embeddings). No KV cache involvement, so safe to
+        serialize with engine steps via run_in_step."""
+        from ..models import forward_embed
+
+        t = len(tokens)
+        if t > self.config.prefill_buckets[-1]:
+            raise ValueError(
+                f"embedding input of {t} tokens exceeds the engine's max "
+                f"sequence bucket ({self.config.prefill_buckets[-1]})")
+        bucket = self._bucket_for(t)
+        fn = self._embed_fns.get(bucket)
+        if fn is None:
+            cfg = self.model_config
+            fn = jax.jit(partial(forward_embed, config=cfg),
+                         static_argnames=(), out_shardings=self._rep)
+            self._embed_fns[bucket] = fn
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :t] = tokens
+        valid = np.zeros((1, bucket), bool)
+        valid[0, :t] = True
+        out = fn(self.params, tokens=jnp.asarray(tok),
+                 valid=jnp.asarray(valid))
+        return np.asarray(out)[0]
+
     def _bucket_for(self, n: int) -> int:
         for b in self.config.prefill_buckets:
             if n <= b:
@@ -356,6 +383,7 @@ class ModelRunner:
         self._decode_fn = self._build_decode()
         self._prefill_fns = {}
         self._ring_prefill_fns = {}
+        self._embed_fns = {}
         log.info("resharded onto mesh %s", dict(mesh.shape))
 
     def gather_pages(self, page_ids: np.ndarray) -> np.ndarray:

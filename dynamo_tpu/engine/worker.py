@@ -150,6 +150,7 @@ class TpuWorker:
         self._served = None
         self._clear_served = None
         self._pull_served = None
+        self._scale_served = None
         self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
@@ -402,6 +403,26 @@ class TpuWorker:
 
     async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
         request = PreprocessedRequest.from_wire(body)
+        if request.annotations.get("embed"):
+            # Embedding request: trunk-only pooled forward, serialized with
+            # engine steps (shared device, no KV involvement).
+            import numpy as np
+
+            q = self.scheduler.run_in_step(
+                lambda: self.runner.embed(
+                    np.asarray(request.token_ids, np.int32)))
+            vec, exc = await asyncio.get_running_loop().run_in_executor(
+                None, q.get)
+            if exc is not None:
+                yield EngineOutput(finish_reason="error",
+                                   error=str(exc)).to_wire()
+                return
+            yield EngineOutput(
+                finish_reason="stop",
+                prompt_tokens=len(request.token_ids),
+                embedding=[float(x) for x in vec],
+            ).to_wire()
+            return
         loop = asyncio.get_running_loop()
         out_queue: asyncio.Queue = asyncio.Queue()
 

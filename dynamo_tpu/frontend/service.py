@@ -32,6 +32,7 @@ class Frontend:
         kv_overlap_weight: Optional[float] = None,
         kv_temperature: Optional[float] = None,
         busy_threshold: Optional[float] = None,
+        kserve_grpc_port: Optional[int] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = ModelManager()
@@ -51,6 +52,12 @@ class Frontend:
         self.http = HttpService(
             self.manager, host=host, port=port, busy_threshold=busy_threshold
         )
+        self.kserve = None
+        if kserve_grpc_port is not None:
+            from ..llm.kserve import KServeGrpcService
+
+            self.kserve = KServeGrpcService(self.manager, host=host,
+                                            port=kserve_grpc_port)
 
     @property
     def port(self) -> int:
@@ -59,8 +66,12 @@ class Frontend:
     async def start(self) -> None:
         await self.watcher.start()
         await self.http.start()
+        if self.kserve is not None:
+            await self.kserve.start()
 
     async def close(self) -> None:
+        if self.kserve is not None:
+            await self.kserve.close()
         await self.http.close()
         await self.watcher.close()
 
@@ -76,6 +87,9 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--kv-overlap-score-weight", type=float, default=None)
     parser.add_argument("--router-temperature", type=float, default=None)
     parser.add_argument("--busy-threshold", type=float, default=None)
+    parser.add_argument("--kserve-grpc-port", type=int, default=None,
+                        help="also serve the KServe v2 gRPC frontend on "
+                             "this port (0 = ephemeral)")
     args = parser.parse_args(argv)
 
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
@@ -87,6 +101,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
         kv_overlap_weight=args.kv_overlap_score_weight,
         kv_temperature=args.router_temperature,
         busy_threshold=args.busy_threshold,
+        kserve_grpc_port=args.kserve_grpc_port,
     )
     await frontend.start()
     log.info("frontend ready on port %d (router=%s)", frontend.port,

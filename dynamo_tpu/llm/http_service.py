@@ -1,8 +1,12 @@
 """OpenAI-compatible HTTP frontend.
 
-Routes (ref: lib/llm/src/http/service/openai.rs:1811-2191, service_v2.rs):
+Routes (ref: lib/llm/src/http/service/openai.rs:1811-2191, service_v2.rs,
+anthropic.rs:63):
   POST /v1/chat/completions   (SSE streaming + aggregated)
   POST /v1/completions
+  POST /v1/embeddings
+  POST /v1/messages           (Anthropic Messages API)
+  POST /v1/responses          (OpenAI Responses API)
   GET  /v1/models
   GET  /health, /live, /metrics
 503 load shedding above a KV-usage busy threshold (ref: busy_threshold.rs);
@@ -13,8 +17,10 @@ http/service/disconnect.rs).
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import time
+import uuid
 from typing import AsyncIterator, Optional
 
 from aiohttp import web
@@ -25,7 +31,14 @@ from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
 from .manager import ModelEntry, ModelManager
 from .preprocessor import DeltaGenerator, RequestError
-from .protocols import EngineOutput, PreprocessedRequest
+from .protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    new_request_id,
+    now_unix,
+)
 
 log = get_logger("llm.http")
 
@@ -259,12 +272,435 @@ class HttpService:
         await response.write_eof()
         return response
 
+    # -- embeddings --------------------------------------------------------
+
+    def _embedding_inputs(self, raw, entry: ModelEntry) -> list[list[int]]:
+        """Normalize OpenAI `input` (str | [str] | [int] | [[int]]) into
+        token-id lists."""
+        if isinstance(raw, str):
+            return [entry.preprocessor.tokenizer.encode(raw)]
+        if isinstance(raw, list) and raw:
+            if all(isinstance(x, str) for x in raw):
+                return [entry.preprocessor.tokenizer.encode(x) for x in raw]
+            if all(isinstance(x, int) for x in raw):
+                return [[int(x) for x in raw]]
+            if all(isinstance(x, list) for x in raw):
+                return [[int(t) for t in x] for x in raw]
+        raise RequestError("'input' must be a string, list of strings, or "
+                           "token array(s)")
+
+    async def _embed_one(self, entry: ModelEntry, model: str,
+                         token_ids: list[int]) -> list[float]:
+        pre = PreprocessedRequest(
+            request_id=new_request_id(),
+            token_ids=token_ids,
+            sampling=SamplingOptions(max_tokens=1, temperature=0.0),
+            stop=StopConditions(),
+            model=model,
+            annotations={"embed": True},
+        )
+        async for out in entry.engine.generate(pre):
+            if out.error:
+                raise RemoteError(out.error)
+            if out.embedding is not None:
+                return out.embedding
+            if out.finish_reason is not None:
+                break
+        raise RemoteError("worker returned no embedding")
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"),
+                                     status=400)
+        model = body.get("model", "")
+        entry = self._lookup(model)
+        self._check_busy(entry)
+        try:
+            inputs = self._embedding_inputs(body.get("input"), entry)
+            for toks in inputs:
+                if len(toks) >= entry.card.context_length:
+                    raise RequestError(
+                        f"input of {len(toks)} tokens exceeds the model "
+                        f"context length ({entry.card.context_length})")
+        except RequestError as exc:
+            return web.json_response(_error_body(400, str(exc)), status=400)
+        encoding = body.get("encoding_format", "float")
+        if encoding not in ("float", "base64"):
+            return web.json_response(
+                _error_body(400, "encoding_format must be float or base64"),
+                status=400)
+        start = time.monotonic()
+        try:
+            vectors = await asyncio.gather(*[
+                self._embed_one(entry, model, toks) for toks in inputs
+            ])
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no workers available", "overloaded"),
+                status=503)
+        except RemoteError as exc:
+            return web.json_response(
+                _error_body(502, str(exc), "engine_error"), status=502)
+        data = []
+        for i, vec in enumerate(vectors):
+            if encoding == "base64":
+                import numpy as np
+
+                payload = base64.b64encode(
+                    np.asarray(vec, np.float32).tobytes()).decode()
+            else:
+                payload = vec
+            data.append({"object": "embedding", "index": i,
+                         "embedding": payload})
+        total = sum(len(t) for t in inputs)
+        self._count_request(model, "ok", start)
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": model,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
+
+    # -- Anthropic Messages API (ref: http/service/anthropic.rs) -----------
+
+    @staticmethod
+    def _messages_to_chat(body: dict) -> dict:
+        """Lower an Anthropic Messages request onto the chat pipeline."""
+        if not body.get("messages"):
+            raise RequestError("'messages' is required")
+        if not body.get("max_tokens"):
+            raise RequestError("'max_tokens' is required")
+        messages = []
+        system = body.get("system")
+        if system:
+            if isinstance(system, list):  # content-block form
+                system = "".join(b.get("text", "") for b in system
+                                 if isinstance(b, dict))
+            messages.append({"role": "system", "content": system})
+        for msg in body["messages"]:
+            content = msg.get("content")
+            if isinstance(content, list):
+                content = "".join(b.get("text", "") for b in content
+                                  if isinstance(b, dict)
+                                  and b.get("type") == "text")
+            messages.append({"role": msg.get("role", "user"),
+                             "content": content or ""})
+        chat = {
+            "model": body.get("model", ""),
+            "messages": messages,
+            "max_tokens": body["max_tokens"],
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+            "top_k": body.get("top_k", 0),
+            "stop": body.get("stop_sequences"),
+        }
+        return chat
+
+    @staticmethod
+    def _anthropic_stop(delta_gen: DeltaGenerator) -> tuple[str, Optional[str]]:
+        """(stop_reason, stop_sequence) in Anthropic terms."""
+        if delta_gen.stop_sequence_hit is not None:
+            return "stop_sequence", delta_gen.stop_sequence_hit
+        reason = {"length": "max_tokens"}.get(
+            delta_gen.finish_reason or "stop", "end_turn")
+        return reason, None
+
+    async def _anthropic_messages(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"),
+                                     status=400)
+        model = body.get("model", "")
+        entry = self._lookup(model)
+        self._check_busy(entry)
+        try:
+            chat_body = self._messages_to_chat(body)
+            preprocessed = entry.preprocessor.preprocess_chat(chat_body)
+        except RequestError as exc:
+            return web.json_response(_error_body(400, str(exc)), status=400)
+        current_request_id.set(preprocessed.request_id)
+        delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
+                                   kind="chat")
+        msg_id = f"msg_{uuid.uuid4().hex[:24]}"
+        if bool(body.get("stream", False)):
+            return await self._anthropic_stream(request, entry, preprocessed,
+                                                delta_gen, msg_id)
+        start = time.monotonic()
+        try:
+            async for output in self._generate(entry, preprocessed):
+                delta_gen.on_output(output)
+                if output.error:
+                    return web.json_response(
+                        _error_body(502, output.error, "engine_error"),
+                        status=502)
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no workers available", "overloaded"),
+                status=503)
+        except RemoteError as exc:
+            return web.json_response(
+                _error_body(502, str(exc), "engine_error"), status=502)
+        self._count_request(model, "ok", start)
+        stop_reason, stop_sequence = self._anthropic_stop(delta_gen)
+        return web.json_response({
+            "id": msg_id,
+            "type": "message",
+            "role": "assistant",
+            "model": model,
+            "content": [{"type": "text", "text": delta_gen.full_text}],
+            "stop_reason": stop_reason,
+            "stop_sequence": stop_sequence,
+            "usage": {
+                "input_tokens": len(preprocessed.token_ids),
+                "output_tokens": delta_gen.completion_tokens,
+            },
+        })
+
+    async def _anthropic_stream(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator,
+        msg_id: str,
+    ) -> web.StreamResponse:
+        response = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Request-Id": preprocessed.request_id},
+        )
+        await response.prepare(request)
+
+        async def emit(event: str, payload: dict) -> None:
+            await response.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode())
+
+        await emit("message_start", {
+            "type": "message_start",
+            "message": {"id": msg_id, "type": "message", "role": "assistant",
+                        "model": preprocessed.model, "content": [],
+                        "stop_reason": None, "stop_sequence": None,
+                        "usage": {"input_tokens": len(preprocessed.token_ids),
+                                  "output_tokens": 0}},
+        })
+        await emit("content_block_start", {
+            "type": "content_block_start", "index": 0,
+            "content_block": {"type": "text", "text": ""},
+        })
+        start = time.monotonic()
+        errored = False
+        try:
+            async for output in self._generate(entry, preprocessed):
+                if output.error:
+                    errored = True
+                    await emit("error", {"type": "error",
+                                         "error": {"type": "api_error",
+                                                   "message": output.error}})
+                    break
+                for chunk in delta_gen.on_output(output):
+                    text = chunk["choices"][0]["delta"].get("content")
+                    if text:
+                        await emit("content_block_delta", {
+                            "type": "content_block_delta", "index": 0,
+                            "delta": {"type": "text_delta", "text": text},
+                        })
+                if delta_gen.finish_reason is not None:
+                    break
+            if not errored:
+                stop_reason, stop_sequence = self._anthropic_stop(delta_gen)
+                await emit("content_block_stop",
+                           {"type": "content_block_stop", "index": 0})
+                await emit("message_delta", {
+                    "type": "message_delta",
+                    "delta": {"stop_reason": stop_reason,
+                              "stop_sequence": stop_sequence},
+                    "usage": {"output_tokens": delta_gen.completion_tokens},
+                })
+                await emit("message_stop", {"type": "message_stop"})
+        except (NoInstancesAvailable, RemoteError) as exc:
+            errored = True
+            await emit("error", {"type": "error",
+                                 "error": {"type": "api_error",
+                                           "message": str(exc)}})
+        finally:
+            ok = delta_gen.finish_reason is not None and not errored
+            self._count_request(preprocessed.model,
+                                "ok" if ok else "error", start)
+        await response.write_eof()
+        return response
+
+    # -- OpenAI Responses API ----------------------------------------------
+
+    @staticmethod
+    def _responses_to_chat(body: dict) -> dict:
+        """Lower a Responses API request onto the chat pipeline."""
+        raw = body.get("input")
+        if raw is None:
+            raise RequestError("'input' is required")
+        messages = []
+        instructions = body.get("instructions")
+        if instructions:
+            messages.append({"role": "system", "content": instructions})
+        if isinstance(raw, str):
+            messages.append({"role": "user", "content": raw})
+        elif isinstance(raw, list):
+            for item in raw:
+                if not isinstance(item, dict):
+                    raise RequestError("input items must be objects")
+                content = item.get("content")
+                if isinstance(content, list):
+                    content = "".join(
+                        b.get("text", "") for b in content
+                        if isinstance(b, dict)
+                        and b.get("type") in ("input_text", "output_text",
+                                              "text"))
+                messages.append({"role": item.get("role", "user"),
+                                 "content": content or ""})
+        else:
+            raise RequestError("'input' must be a string or message list")
+        return {
+            "model": body.get("model", ""),
+            "messages": messages,
+            "max_tokens": body.get("max_output_tokens"),
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+        }
+
+    def _responses_body(self, resp_id: str, model: str,
+                        delta_gen: DeltaGenerator, status: str) -> dict:
+        return {
+            "id": resp_id,
+            "object": "response",
+            "created_at": now_unix(),
+            "status": status,
+            "model": model,
+            "output": [{
+                "type": "message",
+                "id": f"msg_{uuid.uuid4().hex[:24]}",
+                "status": status,
+                "role": "assistant",
+                "content": [{"type": "output_text",
+                             "text": delta_gen.full_text,
+                             "annotations": []}],
+            }],
+            "usage": {
+                "input_tokens": len(delta_gen.request.token_ids),
+                "output_tokens": delta_gen.completion_tokens,
+                "total_tokens": (len(delta_gen.request.token_ids)
+                                 + delta_gen.completion_tokens),
+            },
+        }
+
+    async def _responses(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"),
+                                     status=400)
+        model = body.get("model", "")
+        entry = self._lookup(model)
+        self._check_busy(entry)
+        try:
+            chat_body = self._responses_to_chat(body)
+            preprocessed = entry.preprocessor.preprocess_chat(chat_body)
+        except RequestError as exc:
+            return web.json_response(_error_body(400, str(exc)), status=400)
+        current_request_id.set(preprocessed.request_id)
+        delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
+                                   kind="chat")
+        resp_id = f"resp_{uuid.uuid4().hex[:24]}"
+        if bool(body.get("stream", False)):
+            return await self._responses_stream(request, entry, preprocessed,
+                                                delta_gen, resp_id)
+        start = time.monotonic()
+        try:
+            async for output in self._generate(entry, preprocessed):
+                delta_gen.on_output(output)
+                if output.error:
+                    return web.json_response(
+                        _error_body(502, output.error, "engine_error"),
+                        status=502)
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no workers available", "overloaded"),
+                status=503)
+        except RemoteError as exc:
+            return web.json_response(
+                _error_body(502, str(exc), "engine_error"), status=502)
+        self._count_request(model, "ok", start)
+        return web.json_response(
+            self._responses_body(resp_id, model, delta_gen, "completed"))
+
+    async def _responses_stream(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator,
+        resp_id: str,
+    ) -> web.StreamResponse:
+        response = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Request-Id": preprocessed.request_id},
+        )
+        await response.prepare(request)
+
+        async def emit(event: str, payload: dict) -> None:
+            await response.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode())
+
+        await emit("response.created", {
+            "type": "response.created",
+            "response": self._responses_body(resp_id, preprocessed.model,
+                                             delta_gen, "in_progress"),
+        })
+        start = time.monotonic()
+        errored = False
+        try:
+            async for output in self._generate(entry, preprocessed):
+                if output.error:
+                    errored = True
+                    await emit("error", {"type": "error",
+                                         "message": output.error})
+                    break
+                for chunk in delta_gen.on_output(output):
+                    text = chunk["choices"][0]["delta"].get("content")
+                    if text:
+                        await emit("response.output_text.delta", {
+                            "type": "response.output_text.delta",
+                            "delta": text,
+                        })
+                if delta_gen.finish_reason is not None:
+                    break
+            if not errored:
+                await emit("response.output_text.done", {
+                    "type": "response.output_text.done",
+                    "text": delta_gen.full_text,
+                })
+                await emit("response.completed", {
+                    "type": "response.completed",
+                    "response": self._responses_body(
+                        resp_id, preprocessed.model, delta_gen, "completed"),
+                })
+        except (NoInstancesAvailable, RemoteError) as exc:
+            errored = True
+            await emit("error", {"type": "error", "message": str(exc)})
+        finally:
+            ok = delta_gen.finish_reason is not None and not errored
+            self._count_request(preprocessed.model,
+                                "ok" if ok else "error", start)
+        await response.write_eof()
+        return response
+
     # -- lifecycle ---------------------------------------------------------
 
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/v1/chat/completions", self._chat)
         app.router.add_post("/v1/completions", self._completions)
+        app.router.add_post("/v1/embeddings", self._embeddings)
+        app.router.add_post("/v1/messages", self._anthropic_messages)
+        app.router.add_post("/v1/responses", self._responses)
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._health)

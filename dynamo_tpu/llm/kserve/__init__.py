@@ -1,0 +1,9 @@
+"""KServe Predict Protocol v2 gRPC frontend (ref: lib/llm/src/grpc/service/
+kserve.rs — the reference exposes the same GRPCInferenceService next to the
+OpenAI HTTP surface). Messages are generated from inference.proto with protoc
+(`protoc --python_out=. inference.proto`); the service wiring is hand-rolled
+over grpc.aio generic handlers so no grpc codegen plugin is needed."""
+
+from .service import KServeGrpcService
+
+__all__ = ["KServeGrpcService"]

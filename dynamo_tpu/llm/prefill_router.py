@@ -76,6 +76,10 @@ class PrefillRouterEngine(TokenEngine):
         self, request: PreprocessedRequest
     ) -> AsyncIterator[EngineOutput]:
         pool = self.pool_lookup()
+        if request.annotations.get("embed"):
+            # Embeddings have no KV to hand off — a prefill leg would just
+            # compute the same trunk twice.
+            pool = None
         if pool is None or not pool.active():
             async for out in self.inner.generate(request):
                 yield out

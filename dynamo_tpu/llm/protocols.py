@@ -112,6 +112,8 @@ class EngineOutput:
     logprobs: Optional[list[float]] = None
     # Disagg: prefill worker returns KV handoff params instead of decoding
     kv_transfer_params: Optional[dict] = None
+    # Embedding requests return a pooled vector instead of tokens
+    embedding: Optional[list[float]] = None
     error: Optional[str] = None
 
     def to_wire(self) -> dict:
@@ -124,6 +126,8 @@ class EngineOutput:
             out["lp"] = self.logprobs
         if self.kv_transfer_params is not None:
             out["kv"] = self.kv_transfer_params
+        if self.embedding is not None:
+            out["emb"] = self.embedding
         if self.error is not None:
             out["err"] = self.error
         return out
@@ -136,6 +140,7 @@ class EngineOutput:
             prompt_tokens=data.get("p"),
             logprobs=data.get("lp"),
             kv_transfer_params=data.get("kv"),
+            embedding=data.get("emb"),
             error=data.get("err"),
         )
 

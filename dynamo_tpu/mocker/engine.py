@@ -206,6 +206,20 @@ class MockerEngine:
 
     async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
         request = PreprocessedRequest.from_wire(body)
+        if request.annotations.get("embed"):
+            # Deterministic pseudo-embedding: seeded by the token content so
+            # identical inputs embed identically (router/E2E testability).
+            import numpy as np
+
+            seed = abs(hash(tuple(request.token_ids))) & 0xFFFFFFFF
+            vec = np.random.default_rng(seed).standard_normal(64)
+            vec /= max(float(np.linalg.norm(vec)), 1e-9)
+            yield EngineOutput(
+                finish_reason="stop",
+                prompt_tokens=len(request.token_ids),
+                embedding=[float(x) for x in vec],
+            ).to_wire()
+            return
         queue: asyncio.Queue = asyncio.Queue()
         block_hashes = compute_block_hashes(request.token_ids,
                                             self.config.block_size)

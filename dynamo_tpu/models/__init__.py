@@ -3,6 +3,7 @@
 from .config import ModelConfig, PRESETS, get_config
 from .transformer import (
     forward,
+    forward_embed,
     init_params,
     make_kv_cache,
     paged_attention_xla,
@@ -16,6 +17,7 @@ __all__ = [
     "ModelConfig",
     "PRESETS",
     "forward",
+    "forward_embed",
     "get_config",
     "init_params",
     "make_kv_cache",

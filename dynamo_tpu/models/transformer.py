@@ -490,6 +490,54 @@ def write_kv_stack(
     return kv_cache
 
 
+def forward_embed(
+    params: dict,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, T]
+    valid: jax.Array,  # [B, T] bool
+) -> jax.Array:
+    """Trunk-only forward for embedding requests: in-chunk causal attention
+    (no KV cache touched), masked mean pooling over valid positions, L2
+    normalization. Returns [B, H] float32 (ref surface: /v1/embeddings,
+    lib/llm/src/http/service/openai.rs embeddings route — the reference
+    delegates the encoder to its engines; here we own it)."""
+    assert not config.is_mla, "embedding path supports standard-attention models"
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    mask = causal[None, :, :] & valid[:, None, :]  # [B, Tq, Tk]
+    group = config.n_q_heads // config.n_kv_heads
+    x = params["embed"][tokens]
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q, positions, config.rope_theta)
+        k = rope(k, positions, config.rope_theta)
+        qg = q.reshape(b, t, config.n_kv_heads, group, config.head_dim)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, k) \
+            * (1.0 / math.sqrt(config.head_dim))
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", weights.astype(q.dtype), v)
+        attn = attn.reshape(b, t, config.n_q_heads, config.head_dim)
+        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        if config.n_experts:
+            x = x + _moe(h, lp, config)
+        else:
+            x = x + _swiglu(h, lp)
+    x = rms_norm(x, params["final_norm"], config.rms_eps).astype(jnp.float32)
+    w = valid.astype(jnp.float32)[:, :, None]
+    pooled = (x * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def forward(
     params: dict,
     config: ModelConfig,

@@ -136,7 +136,7 @@ class ServedEndpoint:
     async def start(self) -> None:
         runtime = self.endpoint.runtime
         runtime.request_server.registry.register(self.wire_subject, self._wrapped)
-        record = {
+        self.record = {
             "instance_id": self.instance_id,
             "address": runtime.request_server.address,
             "subject": self.wire_subject,
@@ -144,7 +144,7 @@ class ServedEndpoint:
             "started_at": time.time(),
             "metadata": self.metadata,
         }
-        await runtime.discovery.put(self.instance_key, record, runtime.lease)
+        await runtime.discovery.put(self.instance_key, self.record, runtime.lease)
         runtime.track_served(self)
         log.info("serving %s instance=%x at %s", self.endpoint.subject,
                  self.instance_id, runtime.request_server.address)
@@ -172,6 +172,12 @@ class ServedEndpoint:
             self._inflight -= 1
             if self._inflight == 0:
                 self._drained.set()
+            if "x-dynt-canary" not in ctx.headers:
+                # Stamp completion too: a worker grinding through long
+                # decodes is active, not idle — without this, canaries can
+                # queue behind a saturated batch, time out, and deregister
+                # a healthy worker.
+                self.last_activity = time.monotonic()
             self._metrics.observe_request(start, status)
 
     async def shutdown(self, drain_timeout: float = 30.0) -> None:

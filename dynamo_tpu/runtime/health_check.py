@@ -68,12 +68,14 @@ class HealthCheckManager:
         from the loop so tests and drain hooks can force a sweep)."""
         now = time.monotonic()
         for served in list(self.runtime._served):
-            if (served.health_check_payload is None or served._shutting_down
-                    or served.instance_id in self._deregistered):
+            if served.health_check_payload is None or served._shutting_down:
                 continue
-            if now - served.last_activity < self.canary_wait_time:
+            if (served.instance_id not in self._deregistered
+                    and now - served.last_activity < self.canary_wait_time):
                 # Live traffic is the health signal; canaries only probe
                 # idle endpoints (ref: health_check.rs canary_wait_time).
+                # Deregistered endpoints keep being probed regardless —
+                # recovery re-registers them (below).
                 self._failures.pop(served.instance_id, None)
                 continue
             await self._probe(served)
@@ -101,6 +103,18 @@ class HealthCheckManager:
         if ok:
             self._failures.pop(iid, None)
             served.health_ok = True
+            if iid in self._deregistered:
+                # The handler recovered (e.g. drained a saturated batch):
+                # re-advertise the instance so routers can reach it again.
+                log.info("endpoint %s instance=%x recovered — re-registering",
+                         served.endpoint.subject, iid)
+                self._deregistered.discard(iid)
+                try:
+                    await self.runtime.discovery.put(
+                        served.instance_key, served.record,
+                        self.runtime.lease)
+                except Exception:  # noqa: BLE001 — retried next sweep
+                    self._deregistered.add(iid)
             return
         failures = self._failures.get(iid, 0) + 1
         self._failures[iid] = failures

@@ -1,0 +1,223 @@
+"""API-surface E2E against the mocker: /v1/embeddings, Anthropic
+/v1/messages (stream + aggregate), /v1/responses (ref contract:
+lib/llm/src/http/service/openai.rs embeddings/responses routes,
+anthropic.rs:63 messages route)."""
+
+import asyncio
+import base64
+import json
+import uuid
+
+import aiohttp
+import numpy as np
+
+from tests.test_frontend_e2e import _setup, _teardown
+
+
+def _sse_events(raw: bytes) -> list[tuple[str, dict]]:
+    events = []
+    current_event = None
+    for line in raw.decode().splitlines():
+        if line.startswith("event: "):
+            current_event = line[len("event: "):]
+        elif line.startswith("data: ") and current_event:
+            events.append((current_event, json.loads(line[len("data: "):])))
+    return events
+
+
+class TestEmbeddings:
+    def test_single_and_batch(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/embeddings", json={
+                    "model": "mock-model", "input": "hello world",
+                }) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["object"] == "list"
+                    v1 = data["data"][0]["embedding"]
+                    assert len(v1) == 64
+                    assert abs(sum(x * x for x in v1) - 1.0) < 1e-4
+                # identical input -> identical embedding; batch keeps order
+                async with session.post(f"{base}/v1/embeddings", json={
+                    "model": "mock-model",
+                    "input": ["hello world", "different"],
+                }) as resp:
+                    data = await resp.json()
+                    assert [d["index"] for d in data["data"]] == [0, 1]
+                    assert data["data"][0]["embedding"] == v1
+                    assert data["data"][1]["embedding"] != v1
+                    assert data["usage"]["prompt_tokens"] > 0
+                # base64 encoding round-trips to the same floats
+                async with session.post(f"{base}/v1/embeddings", json={
+                    "model": "mock-model", "input": "hello world",
+                    "encoding_format": "base64",
+                }) as resp:
+                    data = await resp.json()
+                    decoded = np.frombuffer(
+                        base64.b64decode(data["data"][0]["embedding"]),
+                        np.float32)
+                    assert np.allclose(decoded, np.asarray(v1, np.float32))
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_bad_input_rejected(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/embeddings", json={
+                    "model": "mock-model", "input": [],
+                }) as resp:
+                    assert resp.status == 400
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+
+class TestAnthropicMessages:
+    def test_aggregate(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": "mock-model",
+                    "max_tokens": 8,
+                    "system": "be brief",
+                    "messages": [{"role": "user", "content": "hello"}],
+                }) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["type"] == "message"
+                    assert data["role"] == "assistant"
+                    assert data["content"][0]["type"] == "text"
+                    assert len(data["content"][0]["text"]) > 0
+                    assert data["stop_reason"] == "max_tokens"
+                    assert data["usage"]["output_tokens"] == 8
+                # missing max_tokens -> 400
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                }) as resp:
+                    assert resp.status == 400
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_stop_sequence_reported(self, run):
+        """Hitting a stop_sequence must report stop_reason='stop_sequence'
+        with the matched string. The mocker emits consecutive letters whose
+        start depends on prompt length, so probe the first two letters from
+        an unstopped call and stop on them in a second call."""
+
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            msg = {"role": "user", "content": "hello"}
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": "mock-model", "max_tokens": 6,
+                    "messages": [msg],
+                }) as resp:
+                    probe = (await resp.json())["content"][0]["text"]
+                stop = probe[2:4]
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": "mock-model",
+                    "max_tokens": 20,
+                    "messages": [msg],
+                    "stop_sequences": [stop],
+                }) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["stop_reason"] == "stop_sequence"
+                    assert data["stop_sequence"] == stop
+                    assert data["content"][0]["text"] == probe[:2]
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_stream_event_sequence(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": "mock-model",
+                    "max_tokens": 6,
+                    "messages": [{"role": "user",
+                                  "content": [{"type": "text",
+                                               "text": "hi"}]}],
+                    "stream": True,
+                }) as resp:
+                    assert resp.status == 200
+                    raw = await resp.read()
+            events = _sse_events(raw)
+            names = [e for e, _ in events]
+            assert names[0] == "message_start"
+            assert names[1] == "content_block_start"
+            assert "content_block_delta" in names
+            assert names[-3:] == ["content_block_stop", "message_delta",
+                                  "message_stop"]
+            deltas = [p["delta"]["text"] for e, p in events
+                      if e == "content_block_delta"]
+            assert all(deltas)
+            mdelta = [p for e, p in events if e == "message_delta"][0]
+            assert mdelta["delta"]["stop_reason"] == "max_tokens"
+            assert mdelta["usage"]["output_tokens"] == 6
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+
+class TestResponsesApi:
+    def test_aggregate_string_input(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/responses", json={
+                    "model": "mock-model",
+                    "input": "hello",
+                    "instructions": "be brief",
+                    "max_output_tokens": 5,
+                }) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["object"] == "response"
+                    assert data["status"] == "completed"
+                    msg = data["output"][0]
+                    assert msg["role"] == "assistant"
+                    assert len(msg["content"][0]["text"]) > 0
+                    assert data["usage"]["output_tokens"] == 5
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_stream(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/responses", json={
+                    "model": "mock-model",
+                    "input": [{"role": "user", "content": "hello"}],
+                    "max_output_tokens": 4,
+                    "stream": True,
+                }) as resp:
+                    assert resp.status == 200
+                    raw = await resp.read()
+            events = _sse_events(raw)
+            names = [e for e, _ in events]
+            assert names[0] == "response.created"
+            assert "response.output_text.delta" in names
+            assert names[-1] == "response.completed"
+            final = events[-1][1]["response"]
+            assert final["status"] == "completed"
+            assert final["output"][0]["content"][0]["text"]
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)

@@ -324,3 +324,58 @@ class TestTpuWorkerE2E:
             await rt.shutdown()
 
         run(body(), timeout=120)
+
+
+class TestEmbeddings:
+    def test_runner_embed_deterministic_and_normalized(self):
+        runner = _runner()
+        v1 = runner.embed(np.arange(10, dtype=np.int32))
+        v2 = runner.embed(np.arange(10, dtype=np.int32))
+        v3 = runner.embed(np.arange(1, 11, dtype=np.int32))
+        assert v1.shape == (runner.model_config.hidden,)
+        assert np.allclose(v1, v2)
+        assert not np.allclose(v1, v3)
+        assert abs(float(np.linalg.norm(v1)) - 1.0) < 1e-4
+        # Bucketing must not change the result: the same tokens padded into
+        # a larger bucket (a runner whose only bucket is 32 forces 10 tokens
+        # into 22 extra pad positions) must embed identically.
+        wide = ModelRunner(
+            get_config("tiny-test"),
+            RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                         max_pages_per_seq=16, prefill_buckets=(32,)),
+            make_mesh(MeshConfig()), seed=0,
+        )
+        v4 = wide.embed(np.arange(10, dtype=np.int32))
+        assert np.allclose(v1, v4, atol=1e-5)
+        # Over the largest bucket -> clear error, not a broadcast crash.
+        with pytest.raises(ValueError, match="exceeds"):
+            runner.embed(np.zeros(100, np.int32))
+
+    def test_worker_embed_endpoint(self, run, mem_runtime_config):
+        async def body():
+            from dynamo_tpu.runtime import DistributedRuntime
+
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            ns = uuid.uuid4().hex
+            worker = TpuWorker(
+                rt, model_name="tiny-test", namespace=ns,
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=16, prefill_buckets=(8, 16, 32)),
+                warmup=False,
+            )
+            await worker.start()
+            client = rt.namespace(ns).component("backend").endpoint("generate").client()
+            await client.wait_for_instances(1, timeout=10)
+            req = _request(list(range(12)), max_tokens=1)
+            req.annotations = {"embed": True}
+            outs = [EngineOutput.from_wire(o) async for o in client.direct(
+                req.to_wire(), worker.instance_id)]
+            assert outs[-1].finish_reason == "stop"
+            emb = outs[-1].embedding
+            assert emb is not None
+            assert len(emb) == worker.runner.model_config.hidden
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)

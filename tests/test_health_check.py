@@ -99,3 +99,37 @@ class TestHealthCheck:
             await rt.shutdown()
 
         run(body())
+
+    def test_recovered_endpoint_reregisters(self, run):
+        """A deregistered endpoint whose canaries start passing again gets
+        its discovery record re-advertised (saturation, not death)."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            wedged = {"on": True}
+
+            async def handler(req, ctx):
+                if wedged["on"]:
+                    raise RuntimeError("saturated")
+                yield {"ok": True}
+
+            ep = rt.namespace("t").component("w").endpoint("generate")
+            served = await ep.serve_endpoint(
+                handler, health_check_payload={"canary": True})
+            client = ep.client()
+            await client.wait_for_instances(1, timeout=5.0)
+            manager = HealthCheckManager(rt, canary_wait_time=0.0,
+                                         canary_timeout=2.0, max_failures=1)
+            await manager.check_now()  # fails -> deregistered
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while client.instance_ids():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            wedged["on"] = False
+            await manager.check_now()  # passes -> re-registered
+            assert served.healthy()
+            await client.wait_for_instances(1, timeout=5.0)
+            await rt.shutdown()
+
+        run(body())

@@ -1,0 +1,115 @@
+"""KServe v2 gRPC frontend E2E against the mocker (ref contract:
+lib/llm/src/grpc/service/kserve.rs — GRPCInferenceService next to the
+OpenAI HTTP surface)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from dynamo_tpu.llm.kserve import inference_pb2 as pb
+from dynamo_tpu.llm.kserve import KServeGrpcService
+
+from tests.test_frontend_e2e import _setup, _teardown
+
+_S = "/inference.GRPCInferenceService/"
+
+
+def _infer_request(model, text, max_tokens=6, chat=False, rid="r1"):
+    req = pb.ModelInferRequest(
+        model_name=model, id=rid,
+        inputs=[pb.ModelInferRequest.InferInputTensor(
+            name="text_input", datatype="BYTES", shape=[1],
+            contents=pb.InferTensorContents(bytes_contents=[text.encode()]))],
+    )
+    req.parameters["max_tokens"].int64_param = max_tokens
+    if chat:
+        req.parameters["chat"].bool_param = True
+    return req
+
+
+async def _grpc_setup(cluster):
+    frontend, frt, workers = await _setup(cluster)
+    service = KServeGrpcService(frontend.manager, host="127.0.0.1", port=0)
+    await service.start()
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{service.port}")
+    return frontend, frt, workers, service, channel
+
+
+class TestKServeGrpc:
+    def test_liveness_metadata_infer(self, run):
+        async def body():
+            frontend, frt, workers, service, channel = await _grpc_setup(
+                uuid.uuid4().hex)
+            live = await channel.unary_unary(
+                _S + "ServerLive",
+                request_serializer=pb.ServerLiveRequest.SerializeToString,
+                response_deserializer=pb.ServerLiveResponse.FromString,
+            )(pb.ServerLiveRequest())
+            assert live.live
+            ready = await channel.unary_unary(
+                _S + "ModelReady",
+                request_serializer=pb.ModelReadyRequest.SerializeToString,
+                response_deserializer=pb.ModelReadyResponse.FromString,
+            )(pb.ModelReadyRequest(name="mock-model"))
+            assert ready.ready
+            meta = await channel.unary_unary(
+                _S + "ModelMetadata",
+                request_serializer=pb.ModelMetadataRequest.SerializeToString,
+                response_deserializer=pb.ModelMetadataResponse.FromString,
+            )(pb.ModelMetadataRequest(name="mock-model"))
+            assert meta.inputs[0].name == "text_input"
+            resp = await channel.unary_unary(
+                _S + "ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )(_infer_request("mock-model", "hello world"))
+            text = resp.outputs[0].contents.bytes_contents[0].decode()
+            assert len(text) > 0
+            await channel.close()
+            await service.close()
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
+    def test_stream_infer_and_unknown_model(self, run):
+        async def body():
+            frontend, frt, workers, service, channel = await _grpc_setup(
+                uuid.uuid4().hex)
+            stream = channel.stream_stream(
+                _S + "ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            call = stream()
+            await call.write(_infer_request("mock-model", "hi", chat=True))
+            await call.done_writing()
+            deltas, final_seen = [], False
+            async for item in call:
+                assert not item.error_message
+                out = item.infer_response.outputs[0]
+                text = out.contents.bytes_contents[0].decode()
+                params = item.infer_response.parameters
+                if ("triton_final_response" in params
+                        and params["triton_final_response"].bool_param):
+                    final_seen = True
+                elif text:
+                    deltas.append(text)
+            assert deltas and final_seen
+            # Unknown model -> NOT_FOUND
+            try:
+                await channel.unary_unary(
+                    _S + "ModelInfer",
+                    request_serializer=pb.ModelInferRequest.SerializeToString,
+                    response_deserializer=pb.ModelInferResponse.FromString,
+                )(_infer_request("nope", "hello"))
+                raise AssertionError("expected NOT_FOUND")
+            except grpc.aio.AioRpcError as exc:
+                assert exc.code() == grpc.StatusCode.NOT_FOUND
+            await channel.close()
+            await service.close()
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
