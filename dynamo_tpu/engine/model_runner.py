@@ -46,6 +46,10 @@ class RunnerConfig:
     max_batch: int = 16
     max_pages_per_seq: int = 128  # => context cap = page_size * this
     prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS
+    # Multi-LoRA slot pack (0 = LoRA disabled). All slots share one static
+    # rank so any adapter mix batches into one compiled step.
+    max_loras: int = 0
+    lora_rank: int = 8
 
     @property
     def max_context(self) -> int:
@@ -123,6 +127,16 @@ class ModelRunner:
         )
         self.kv_cache = kv_init()
         self._rep = NamedSharding(mesh, P())  # replicated host inputs
+        self.lora_pack = None
+        if runner_config.max_loras > 0:
+            from ..models.transformer import init_lora_pack
+
+            # Replicated (tiny vs the base weights); slot 0 stays zero.
+            self.lora_pack = jax.device_put(
+                init_lora_pack(model_config, runner_config.max_loras,
+                               runner_config.lora_rank),
+                NamedSharding(mesh, P()),
+            )
         self._decode_fn = self._build_decode()
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
@@ -134,9 +148,11 @@ class ModelRunner:
     def _build_decode(self):
         cfg = self.model_config
         attention_fn = self._attention_fn
+        with_lora = self.lora_pack is not None
 
         def step(params, kv, tokens, positions, block_tables, kv_lens,
-                 active, temperature, top_p, top_k, seeds, step_idx):
+                 active, temperature, top_p, top_k, seeds, step_idx,
+                 lora=None, lora_idx=None):
             # step_idx: [B] per-slot generated-token index, so a fixed
             # request seed reproduces its stream independent of what other
             # requests the engine is running.
@@ -144,6 +160,7 @@ class ModelRunner:
                 params, cfg, tokens[:, None], positions[:, None], kv,
                 block_tables, kv_lens, valid=active[:, None],
                 attention_fn=attention_fn,
+                lora=lora if with_lora else None, lora_idx=lora_idx,
             )
             next_tokens = sample(
                 logits[:, 0, :], temperature, top_p, top_k, seeds, step_idx
@@ -156,12 +173,15 @@ class ModelRunner:
     def _build_prefill(self, bucket: int):
         cfg = self.model_config
         attention_fn = self._attention_fn
+        with_lora = self.lora_pack is not None
 
         def step(params, kv, tokens, positions, block_table, kv_lens,
-                 valid, last_idx, temperature, top_p, top_k, seeds):
+                 valid, last_idx, temperature, top_p, top_k, seeds,
+                 lora=None, lora_idx=None):
             kv, logits = forward(
                 params, cfg, tokens, positions, kv, block_table, kv_lens,
                 valid=valid, attention_fn=attention_fn,
+                lora=lora if with_lora else None, lora_idx=lora_idx,
             )
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
@@ -296,6 +316,7 @@ class ModelRunner:
         block_table: np.ndarray,  # [max_pages_per_seq] int32
         kv_len_after: int,
         sampling: tuple[float, float, int, int],  # (temp, top_p, top_k, seed)
+        lora_idx: int = 0,
     ) -> int:
         """Run one prefill chunk; returns the sampled token id (meaningful
         only on the final chunk)."""
@@ -312,7 +333,7 @@ class ModelRunner:
         valid = np.zeros((1, bucket), bool)
         valid[0, :t] = True
         temp, top_p, top_k, seed = sampling
-        self.kv_cache, token = fn(
+        args = [
             self.params, self.kv_cache, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(block_table[None, :]),
             jnp.asarray([kv_len_after], np.int32),
@@ -320,7 +341,10 @@ class ModelRunner:
             jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
             jnp.asarray([top_k], np.int32),
             jnp.asarray([seed], np.uint32),
-        )
+        ]
+        if self.lora_pack is not None:
+            args += [self.lora_pack, jnp.asarray([lora_idx], jnp.int32)]
+        self.kv_cache, token = fn(*args)
         return int(np.asarray(token)[0])
 
     def decode(
@@ -335,12 +359,13 @@ class ModelRunner:
         top_k: np.ndarray,
         seeds: np.ndarray,
         steps: Optional[np.ndarray] = None,  # [B] per-slot token index
+        lora_idx: Optional[np.ndarray] = None,  # [B] adapter slot per seq
     ) -> np.ndarray:
         """One decode step for all slots; returns sampled tokens [B]."""
         self.decode_steps += 1
         if steps is None:
             steps = np.zeros(len(tokens), np.int32)
-        self.kv_cache, next_tokens = self._decode_fn(
+        args = [
             self.params, self.kv_cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(block_tables, jnp.int32),
@@ -349,8 +374,51 @@ class ModelRunner:
             jnp.asarray(top_p, jnp.float32), jnp.asarray(top_k, jnp.int32),
             jnp.asarray(seeds, jnp.uint32),
             jnp.asarray(steps, jnp.int32),
-        )
+        ]
+        if self.lora_pack is not None:
+            if lora_idx is None:
+                lora_idx = np.zeros(len(tokens), np.int32)
+            args += [self.lora_pack, jnp.asarray(lora_idx, jnp.int32)]
+        self.kv_cache, next_tokens = self._decode_fn(*args)
         return np.asarray(next_tokens)
+
+    # -- LoRA slot pack ----------------------------------------------------
+
+    def set_lora_slot(self, slot: int, adapter) -> None:
+        """Write an adapter's factors into pack slot `slot` (llm.lora
+        LoraAdapter, factors already rank-padded + alpha-scaled). Targets
+        the adapter does not provide are zeroed. Serialize with stepping
+        (run on the scheduler thread) so one step never sees a half-written
+        pack."""
+        assert self.lora_pack is not None, "runner built with max_loras=0"
+        assert 1 <= slot <= self.config.max_loras, f"bad lora slot {slot}"
+        dtype = jnp.dtype(self.model_config.dtype)
+        layers = self.lora_pack["layers"]
+        for i, layer in enumerate(layers):
+            provided = adapter.layers.get(i, {})
+            for target, entry in layer.items():
+                if target in provided:
+                    a, b = provided[target]
+                    layer[target] = {
+                        "a": entry["a"].at[slot].set(
+                            jnp.asarray(a, dtype)),
+                        "b": entry["b"].at[slot].set(
+                            jnp.asarray(b, dtype)),
+                    }
+                else:
+                    layer[target] = {
+                        "a": entry["a"].at[slot].set(0.0),
+                        "b": entry["b"].at[slot].set(0.0),
+                    }
+
+    def clear_lora_slot(self, slot: int) -> None:
+        assert self.lora_pack is not None, "runner built with max_loras=0"
+        for layer in self.lora_pack["layers"]:
+            for target, entry in layer.items():
+                layer[target] = {
+                    "a": entry["a"].at[slot].set(0.0),
+                    "b": entry["b"].at[slot].set(0.0),
+                }
 
     def reshard(self, mesh: Mesh) -> None:
         """Elastic parallelism rescale: re-place params on a NEW mesh
@@ -380,6 +448,8 @@ class ModelRunner:
         )
         self.kv_cache = kv_init()
         self._rep = NamedSharding(mesh, P())
+        if self.lora_pack is not None:
+            self.lora_pack = jax.device_put(self.lora_pack, self._rep)
         self._decode_fn = self._build_decode()
         self._prefill_fns = {}
         self._ring_prefill_fns = {}

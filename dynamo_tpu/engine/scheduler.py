@@ -25,7 +25,7 @@ import numpy as np
 
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..runtime.logging import get_logger
-from ..tokens import compute_block_hashes
+from ..tokens import compute_block_hashes, lora_id_of
 from .model_runner import ModelRunner
 from .pages import PageAllocation, PagePool
 
@@ -56,6 +56,8 @@ class _Seq:
     # token it sampled; admission scatters instead of prefilling.
     onboard_blocks: Optional[np.ndarray] = None
     onboard_first_token: Optional[int] = None
+    # Multi-LoRA: adapter slot in the runner's pack (0 = base model)
+    lora_idx: int = 0
 
     @property
     def decode_ready(self) -> bool:
@@ -127,6 +129,7 @@ class InferenceScheduler:
         self._top_k = np.zeros(b, np.int32)
         self._seeds = np.zeros(b, np.uint32)
         self._steps = np.zeros(b, np.int32)
+        self._lora_idx = np.zeros(b, np.int32)
 
     # -- public (thread-safe) ---------------------------------------------
 
@@ -151,6 +154,7 @@ class InferenceScheduler:
         on_prefill_done: Optional[Callable] = None,
         onboard_blocks: Optional[np.ndarray] = None,
         onboard_first_token: Optional[int] = None,
+        lora_idx: int = 0,
     ) -> "_SubmitHandle":
         handle = _SubmitHandle()
         self._incoming.put((request, emit, handle, {
@@ -158,6 +162,7 @@ class InferenceScheduler:
             "on_prefill_done": on_prefill_done,
             "onboard_blocks": onboard_blocks,
             "onboard_first_token": onboard_first_token,
+            "lora_idx": lora_idx,
         }))
         self._wake.set()
         return handle
@@ -182,6 +187,17 @@ class InferenceScheduler:
     def queue_depth(self) -> tuple[int, int]:
         active = sum(1 for s in self._slots if s is not None)
         return active, len(self._waiting)
+
+    def lora_in_flight(self, lora_slot: int) -> int:
+        """Sequences (admitted, waiting, or just submitted) still bound to
+        an adapter slot. Scheduler-thread only (run via run_in_step): drains
+        the incoming queue first so submissions that already resolved the
+        adapter are counted."""
+        self._drain_incoming()
+        live = [s for s in self._slots if s is not None] + self._waiting
+        return sum(1 for s in live
+                   if s.lora_idx == lora_slot
+                   and not s.finished and not s.cancelled)
 
     # -- scheduler thread --------------------------------------------------
 
@@ -224,6 +240,7 @@ class InferenceScheduler:
                 seq.on_prefill_done = extra.get("on_prefill_done")
                 seq.onboard_blocks = extra.get("onboard_blocks")
                 seq.onboard_first_token = extra.get("onboard_first_token")
+                seq.lora_idx = extra.get("lora_idx", 0)
                 handle.seq = seq
                 if handle._cancelled:  # cancelled before the seq existed
                     seq.cancelled = True
@@ -242,7 +259,9 @@ class InferenceScheduler:
                        f"{prompt_len} prompt tokens; exceeds engine capacity"),
             ))
             return None
-        block_hashes = compute_block_hashes(request.token_ids, self.page_size)
+        block_hashes = compute_block_hashes(
+            request.token_ids, self.page_size,
+            lora_id=lora_id_of(request.lora_name))
         seed = request.sampling.seed
         if seed is None:
             seed = abs(hash(request.request_id)) & 0xFFFFFFFF
@@ -355,6 +374,7 @@ class InferenceScheduler:
                 continue
             if (seq.prefill_pos == 0
                     and seq.prompt_len > budget
+                    and seq.lora_idx == 0  # ring path has no adapter delta
                     and getattr(self.runner, "sp_size", 1) > 1):
                 sampling = seq.request.sampling
                 token = self.runner.prefill_ring(
@@ -383,6 +403,7 @@ class InferenceScheduler:
                 kv_len_after=seq.prefill_pos + chunk,
                 sampling=(sampling.temperature, sampling.top_p,
                           sampling.top_k, seq.seed),
+                lora_idx=seq.lora_idx,
             )
             seq.prefill_pos += chunk
             if is_final:
@@ -445,10 +466,11 @@ class InferenceScheduler:
             self._top_k[i] = s.top_k
             self._seeds[i] = seq.seed
             self._steps[i] = len(seq.generated)
+            self._lora_idx[i] = seq.lora_idx
         next_tokens = self.runner.decode(
             self._tokens, self._positions, self._tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
-            self._steps,
+            self._steps, lora_idx=self._lora_idx,
         )
         count = 0
         for seq in ready:

@@ -116,6 +116,7 @@ class TpuWorker:
         kvbm_config=None,  # Optional[block_manager.KvbmConfig]
         tool_parser: Optional[str] = None,
         reasoning_parser: Optional[str] = None,
+        lora_adapters: Optional[dict[str, str]] = None,  # name -> npz path
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -130,6 +131,18 @@ class TpuWorker:
         self.scheduler: Optional[InferenceScheduler] = None
         self.kvbm_config = kvbm_config
         self.kvbm = None
+        self.loras = None
+        if self.runner_config.max_loras > 0:
+            from ..llm.lora import LoraManager
+
+            self.loras = LoraManager(self.model_config,
+                                     self.runner_config.max_loras,
+                                     self.runner_config.lora_rank)
+        elif lora_adapters:
+            raise ValueError(
+                "LoRA adapters were given but max_loras=0 — set "
+                "--max-loras to enable adapter slots")
+        self._initial_loras = lora_adapters or {}
         model_types = ([PREFILL] if mode == "prefill"
                        else [CHAT, COMPLETIONS])
         self.card = ModelDeploymentCard(
@@ -147,6 +160,7 @@ class TpuWorker:
             reasoning_parser=reasoning_parser,
         )
         self._tasks: list[asyncio.Task] = []
+        self._lora_served: list = []
         self._served = None
         self._clear_served = None
         self._pull_served = None
@@ -221,6 +235,24 @@ class TpuWorker:
         self._scale_served = await ep_ep.serve_endpoint(
             self._scale_elastic, instance_id=self.instance_id
         )
+        # LoRA endpoints (ref: vllm worker LoRA load/unload/list endpoints)
+        if self.loras is not None:
+            self.card.runtime_config["lora"] = {
+                "max_loras": self.runner_config.max_loras,
+                "rank": self.runner_config.lora_rank,
+            }
+            for ep_name, handler in (("lora_load", self._lora_load),
+                                     ("lora_unload", self._lora_unload),
+                                     ("lora_list", self._lora_list)):
+                ep = (
+                    self.runtime.namespace(self.card.namespace)
+                    .component(self.card.component)
+                    .endpoint(ep_name)
+                )
+                self._lora_served.append(await ep.serve_endpoint(
+                    handler, instance_id=self.instance_id))
+            for name, path in self._initial_loras.items():
+                await self._do_lora_load(name, path)
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
@@ -253,6 +285,69 @@ class TpuWorker:
         await asyncio.get_running_loop().run_in_executor(None, q.get)
         self.events.on_cleared()
         yield {"ok": True, "mesh": dict(mesh.shape)}
+
+    # -- multi-LoRA --------------------------------------------------------
+
+    async def _do_lora_load(self, name: str, path: str) -> None:
+        adapter = self.loras.load(name, path)
+        # Pack writes are serialized with stepping (one step must never see
+        # a half-written slot).
+        q = self.scheduler.run_in_step(
+            lambda: self.runner.set_lora_slot(adapter.slot, adapter))
+        _, exc = await asyncio.get_running_loop().run_in_executor(None, q.get)
+        if exc is not None:
+            self.loras.unload(name)
+            raise exc
+        await self._republish_loras()
+
+    async def _republish_loras(self) -> None:
+        """Advertise loaded adapters on the card so frontends route
+        model=<adapter> here (ref: lora.rs routing via discovery)."""
+        self.card.runtime_config["loras"] = self.loras.names()
+        await publish_card(self.runtime, self.card, self.instance_id)
+
+    async def _lora_load(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        try:
+            name = body["name"]
+            await self._do_lora_load(name, body["path"])
+        except Exception as exc:  # noqa: BLE001 — report, don't kill endpoint
+            yield {"error": str(exc)}
+            return
+        yield {"ok": True, "name": name, "slot": self.loras.slot_of(name)}
+
+    async def _lora_unload(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        """Two-phase unload: unmap the name (new requests fail fast, slot
+        stays reserved), then on the scheduler thread refuse if any
+        in-flight sequence still uses the slot — zeroing (or a later load
+        reusing it) would silently switch weights mid-generation. Busy ->
+        the unload is aborted and the caller retries after draining."""
+        try:
+            name = body["name"]
+            adapter = self.loras.begin_unload(name)
+        except Exception as exc:  # noqa: BLE001
+            yield {"error": str(exc)}
+            return
+
+        def _clear() -> None:
+            busy = self.scheduler.lora_in_flight(adapter.slot)
+            if busy:
+                raise RuntimeError(
+                    f"adapter {name!r} busy: {busy} in-flight sequence(s); "
+                    "retry after they finish")
+            self.runner.clear_lora_slot(adapter.slot)
+
+        q = self.scheduler.run_in_step(_clear)
+        _, exc = await asyncio.get_running_loop().run_in_executor(None, q.get)
+        if exc is not None:
+            self.loras.abort_unload(adapter)
+            yield {"error": str(exc)}
+            return
+        self.loras.commit_unload(adapter)
+        await self._republish_loras()
+        yield {"ok": True, "name": name}
+
+    async def _lora_list(self, body, ctx=None) -> AsyncIterator[dict]:
+        yield {"adapters": self.loras.list()}
 
     # -- disaggregation: prefill-side export -------------------------------
 
@@ -430,21 +525,30 @@ class TpuWorker:
             loop.call_soon_threadsafe(out_queue.put_nowait, output)
 
         submit_kwargs: dict = {}
+        if request.lora_name:
+            slot = (self.loras.slot_of(request.lora_name)
+                    if self.loras is not None else None)
+            if slot is None:
+                yield EngineOutput(
+                    finish_reason="error",
+                    error=f"adapter {request.lora_name!r} not loaded here",
+                ).to_wire()
+                return
+            submit_kwargs["lora_idx"] = slot
         prefill_only = (self.mode == "prefill"
                         or bool(request.annotations.get("prefill_only")))
         if prefill_only:
-            submit_kwargs = {
-                "prefill_only": True,
-                "on_prefill_done": self._register_transfer,
-            }
+            submit_kwargs.update(
+                prefill_only=True,
+                on_prefill_done=self._register_transfer,
+            )
         elif request.disaggregated_params:
             blocks = await self._pull_remote_kv(request.disaggregated_params)
             if blocks is not None:
-                submit_kwargs = {
-                    "onboard_blocks": blocks,
-                    "onboard_first_token":
-                        request.disaggregated_params["first_token"],
-                }
+                submit_kwargs.update(
+                    onboard_blocks=blocks,
+                    onboard_first_token=request.disaggregated_params["first_token"],
+                )
             # else: fall through — plain submit recomputes the prefill
 
         handle = self.scheduler.submit(request, emit, **submit_kwargs)
@@ -464,7 +568,7 @@ class TpuWorker:
         # Endpoints drain BEFORE the scheduler stops — in-flight generate/
         # scale requests need a live scheduler loop to ever finish.
         for served in (self._served, self._clear_served, self._pull_served,
-                       self._scale_served):
+                       self._scale_served, *self._lora_served):
             if served is not None:
                 await served.shutdown()
         if self.kvbm is not None:
@@ -508,6 +612,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--kvbm-disk-path", default="/tmp/dynamo_tpu_kvbm.bin")
     parser.add_argument("--kvbm-object-store", default=None,
                         help="G4 blob-store root (e.g. a gcsfuse mountpoint)")
+    parser.add_argument("--max-loras", type=int, default=0,
+                        help="adapter slots for multi-LoRA serving (0=off)")
+    parser.add_argument("--lora-rank", type=int, default=8,
+                        help="shared slot rank (adapters with lower rank "
+                             "are zero-padded)")
+    parser.add_argument("--lora", action="append", default=[],
+                        metavar="NAME=PATH",
+                        help="adapter to load at startup (repeatable)")
     parser.add_argument("--tool-call-parser", default=None,
                         choices=["hermes", "qwen", "mistral", "llama3_json",
                                  "pythonic"])
@@ -540,11 +652,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
             page_size=args.page_size, num_pages=args.num_pages,
             max_batch=args.max_batch,
             max_pages_per_seq=args.max_pages_per_seq,
+            max_loras=args.max_loras, lora_rank=args.lora_rank,
         ),
         mesh_config=MeshConfig(dp=args.dp, tp=args.tp),
         kvbm_config=kvbm_config,
         tool_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
+        lora_adapters=dict(s.split("=", 1) for s in args.lora),
     )
     await worker.start()
     from ..runtime import HealthCheckManager

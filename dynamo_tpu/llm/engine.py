@@ -20,7 +20,7 @@ from ..kv_router import KvScheduler, WorkerWithDpRank
 from ..runtime.logging import get_logger
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.request_plane import ConnectionLost, RemoteError
-from ..tokens import compute_block_hashes
+from ..tokens import compute_block_hashes, lora_id_of
 from .protocols import EngineOutput, PreprocessedRequest
 
 log = get_logger("llm.engine")
@@ -35,13 +35,28 @@ class TokenEngine:
 
 
 class RouterEngine(TokenEngine):
-    """Dispatch to workers through a PushRouter (round_robin/random/p2c)."""
+    """Dispatch to workers through a PushRouter (round_robin/random/p2c).
 
-    def __init__(self, router: PushRouter) -> None:
+    `lora_instances(name)` (optional) returns the instance ids currently
+    advertising a LoRA adapter; adapter requests only route there (ref:
+    lora.rs — adapters are a routing constraint, not just a name)."""
+
+    def __init__(self, router: PushRouter, lora_instances=None) -> None:
         self.router = router
+        self._lora_instances = lora_instances
+
+    def _allowed(self, request: PreprocessedRequest) -> Optional[set]:
+        if not request.lora_name or self._lora_instances is None:
+            return None
+        allowed = self._lora_instances(request.lora_name)
+        if not allowed:
+            raise NoInstancesAvailable(
+                f"no instance has adapter {request.lora_name!r}")
+        return allowed
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
-        async for item in self.router.generate(request.to_wire()):
+        async for item in self.router.generate(request.to_wire(),
+                                               allowed=self._allowed(request)):
             yield EngineOutput.from_wire(item)
 
 
@@ -51,17 +66,23 @@ class KvRouterEngine(TokenEngine):
     (ref: lib/llm/src/kv_router.rs KvRouter + push_router.rs KvPushRouter;
     flow in section 3.3)."""
 
-    def __init__(self, router: PushRouter, scheduler: KvScheduler) -> None:
+    def __init__(self, router: PushRouter, scheduler: KvScheduler,
+                 lora_instances=None) -> None:
         self.router = router
         self.scheduler = scheduler
+        self._lora_instances = lora_instances
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
         await self.router.client.start()
         avail = self.router.available()
+        if request.lora_name and self._lora_instances is not None:
+            has = self._lora_instances(request.lora_name)
+            avail = [i for i in avail if i in has]
         if not avail:
             raise NoInstancesAvailable(self.router.client.endpoint.subject)
         block_hashes = compute_block_hashes(
-            request.token_ids, self.scheduler.config.block_size
+            request.token_ids, self.scheduler.config.block_size,
+            lora_id=lora_id_of(request.lora_name),
         )
         candidates = [WorkerWithDpRank(iid) for iid in avail]
         result = self.scheduler.select_worker(
@@ -134,5 +155,6 @@ class Migration(TokenEngine):
                     model=request.model,
                     prior_output_tokens=list(generated),
                     annotations=request.annotations,
+                    lora_name=request.lora_name,
                 )
                 await asyncio.sleep(0.05 * attempts)

@@ -63,15 +63,18 @@ class HttpService:
 
     # -- helpers -----------------------------------------------------------
 
-    def _lookup(self, model: str) -> ModelEntry:
-        entry = self.manager.get(model)
+    def _lookup(self, model: str) -> tuple[ModelEntry, Optional[str]]:
+        """Resolve a model OR adapter name to (entry, lora_name). Resolved
+        exactly once per request — re-resolving later could silently fall
+        back to the base model if the adapter is unloaded concurrently."""
+        entry, lora = self.manager.resolve(model)
         if entry is None:
             raise web.HTTPNotFound(
                 text=json.dumps(_error_body(
                     404, f"model '{model}' not found", "model_not_found")),
                 content_type="application/json",
             )
-        return entry
+        return entry, lora
 
     def _check_busy(self, entry: ModelEntry) -> None:
         """Shed load when every live worker is past the KV busy threshold
@@ -93,14 +96,17 @@ class HttpService:
     # -- handlers ----------------------------------------------------------
 
     async def _models(self, _request: web.Request) -> web.Response:
-        return web.json_response({
-            "object": "list",
-            "data": [
-                {"id": card.name, "object": "model", "created": 0,
-                 "owned_by": "dynamo_tpu"}
-                for card in self.manager.list_models()
-            ],
-        })
+        data = [
+            {"id": card.name, "object": "model", "created": 0,
+             "owned_by": "dynamo_tpu"}
+            for card in self.manager.list_models()
+        ]
+        data += [
+            {"id": name, "object": "model", "created": 0,
+             "owned_by": "dynamo_tpu", "parent": base}
+            for name, base in self.manager.list_adapters()
+        ]
+        return web.json_response({"object": "list", "data": data})
 
     async def _health(self, _request: web.Request) -> web.Response:
         models = [c.name for c in self.manager.list_models()]
@@ -124,7 +130,7 @@ class HttpService:
         except (ValueError, UnicodeDecodeError):
             return web.json_response(_error_body(400, "invalid JSON body"), status=400)
         model = body.get("model", "")
-        entry = self._lookup(model)
+        entry, lora = self._lookup(model)
         self._check_busy(entry)
         try:
             if kind == "chat":
@@ -134,6 +140,7 @@ class HttpService:
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
 
+        preprocessed.lora_name = lora
         current_request_id.set(preprocessed.request_id)
         # Tool parsing activates only when the request declares tools (the
         # reference gates on request.tools the same way); reasoning parsing
@@ -315,7 +322,7 @@ class HttpService:
             return web.json_response(_error_body(400, "invalid JSON body"),
                                      status=400)
         model = body.get("model", "")
-        entry = self._lookup(model)
+        entry, lora = self._lookup(model)
         self._check_busy(entry)
         try:
             inputs = self._embedding_inputs(body.get("input"), entry)
@@ -414,13 +421,14 @@ class HttpService:
             return web.json_response(_error_body(400, "invalid JSON body"),
                                      status=400)
         model = body.get("model", "")
-        entry = self._lookup(model)
+        entry, lora = self._lookup(model)
         self._check_busy(entry)
         try:
             chat_body = self._messages_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
+        preprocessed.lora_name = lora
         current_request_id.set(preprocessed.request_id)
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")
@@ -599,13 +607,14 @@ class HttpService:
             return web.json_response(_error_body(400, "invalid JSON body"),
                                      status=400)
         model = body.get("model", "")
-        entry = self._lookup(model)
+        entry, lora = self._lookup(model)
         self._check_busy(entry)
         try:
             chat_body = self._responses_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
+        preprocessed.lora_name = lora
         current_request_id.set(preprocessed.request_id)
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")

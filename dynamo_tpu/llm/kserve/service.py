@@ -57,12 +57,14 @@ class KServeGrpcService:
     # -- request lowering --------------------------------------------------
 
     async def _entry(self, model_name: str, context):
-        entry = self.manager.get(model_name)
+        # resolve() also matches LoRA adapter names, keeping the gRPC and
+        # HTTP surfaces consistent.
+        entry, lora = self.manager.resolve(model_name)
         if entry is None:
             # context.abort raises; the await satisfies grpc.aio's contract.
             await context.abort(grpc.StatusCode.NOT_FOUND,
                                 f"model '{model_name}' not found")
-        return entry
+        return entry, lora
 
     async def _preprocess(self, request: pb.ModelInferRequest, context):
         text = None
@@ -77,7 +79,7 @@ class KServeGrpcService:
         if text is None:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 "missing 'text_input' BYTES tensor")
-        entry = await self._entry(request.model_name, context)
+        entry, lora = await self._entry(request.model_name, context)
         params = request.parameters
         body = {
             "model": request.model_name,
@@ -94,6 +96,7 @@ class KServeGrpcService:
                 preprocessed = entry.preprocessor.preprocess_completions(body)
         except RequestError as exc:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        preprocessed.lora_name = lora
         return entry, preprocessed
 
     # -- handlers ----------------------------------------------------------
@@ -105,8 +108,8 @@ class KServeGrpcService:
         return pb.ServerReadyResponse(ready=True)
 
     async def _model_ready(self, request, context) -> pb.ModelReadyResponse:
-        return pb.ModelReadyResponse(
-            ready=self.manager.get(request.name) is not None)
+        entry, _ = self.manager.resolve(request.name)
+        return pb.ModelReadyResponse(ready=entry is not None)
 
     async def _server_metadata(self, request, context) -> pb.ServerMetadataResponse:
         return pb.ServerMetadataResponse(
@@ -114,7 +117,7 @@ class KServeGrpcService:
             extensions=["model_repository"])
 
     async def _model_metadata(self, request, context) -> pb.ModelMetadataResponse:
-        entry = await self._entry(request.name, context)
+        entry, _ = await self._entry(request.name, context)
         return pb.ModelMetadataResponse(
             name=entry.card.name,
             versions=["1"],

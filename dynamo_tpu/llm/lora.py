@@ -1,0 +1,207 @@
+"""LoRA adapter serving: registry, file format, worker-side slot manager.
+
+The reference downloads adapters and routes requests to workers that have
+them, delegating the actual low-rank math to vLLM (ref: lib/llm/src/lora.rs
+download/routing; components/src/dynamo/vllm worker LoRA load/unload/list
+endpoints). We own the engine, so both halves live here:
+
+  * file format + registry: an adapter is a `.npz` holding per-layer
+    low-rank factors `layers.{i}.{target}.a` [din, r] / `.b` [r, dout]
+    for targets in models.transformer.LORA_TARGETS, plus `alpha`/`rank`
+    scalars. Anything on a locally readable path serves (local disk or a
+    GCS fuse mount — the TPU-VM equivalent of the reference's HF/NGC
+    adapter download dir).
+  * LoraManager: name -> slot assignment against the runner's fixed
+    adapter-slot pack (slot 0 = base model), with alpha/rank scaling baked
+    into `b` at load so the compiled step stays two plain matmuls.
+
+Serving integration: the worker exposes lora_load / lora_unload / lora_list
+endpoints and republishes its ModelDeploymentCard with
+runtime_config["loras"], which the frontend uses to route `model=<adapter>`
+requests (llm/manager.py resolve).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..models import ModelConfig
+from ..models.transformer import LORA_TARGETS
+from ..runtime.logging import get_logger
+
+log = get_logger("llm.lora")
+
+
+@dataclasses.dataclass
+class LoraAdapter:
+    name: str
+    rank: int
+    alpha: float
+    # layer index -> target -> (a [din, r], b [r, dout]) host arrays,
+    # b already scaled by alpha/rank.
+    layers: dict[int, dict[str, tuple[np.ndarray, np.ndarray]]] = (
+        dataclasses.field(default_factory=dict))
+    slot: int = -1
+
+
+def save_lora_npz(path: str, layers: dict[int, dict[str, tuple[np.ndarray, np.ndarray]]],
+                  rank: int, alpha: float) -> None:
+    """Write an adapter file. `layers[i][target] = (a, b)` with UNscaled b."""
+    arrays: dict[str, np.ndarray] = {
+        "rank": np.asarray(rank, np.int32),
+        "alpha": np.asarray(alpha, np.float32),
+    }
+    for i, targets in layers.items():
+        for t, (a, b) in targets.items():
+            if t not in LORA_TARGETS:
+                raise ValueError(f"unknown LoRA target {t!r}")
+            arrays[f"layers.{i}.{t}.a"] = np.asarray(a)
+            arrays[f"layers.{i}.{t}.b"] = np.asarray(b)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_lora_npz(name: str, path: str) -> LoraAdapter:
+    with open(path, "rb") as f:
+        data = np.load(io.BytesIO(f.read()))
+    rank = int(data["rank"])
+    alpha = float(data["alpha"])
+    scale = alpha / max(rank, 1)
+    layers: dict[int, dict[str, tuple[np.ndarray, np.ndarray]]] = {}
+    for key in data.files:
+        if not key.startswith("layers."):
+            continue
+        _, idx, target, part = key.split(".")
+        if target not in LORA_TARGETS:
+            raise ValueError(f"{path}: unknown LoRA target {target!r}")
+        entry = layers.setdefault(int(idx), {})
+        a, b = entry.get(target, (None, None))
+        if part == "a":
+            a = np.asarray(data[key])
+        elif part == "b":
+            b = np.asarray(data[key]) * scale
+        else:
+            raise ValueError(f"{path}: bad key {key!r}")
+        entry[target] = (a, b)
+    for idx, targets in layers.items():
+        for t, (a, b) in targets.items():
+            if a is None or b is None:
+                raise ValueError(f"{path}: layer {idx} target {t} missing a/b")
+            if a.shape[1] != rank or b.shape[0] != rank:
+                raise ValueError(
+                    f"{path}: layer {idx} target {t} rank mismatch "
+                    f"(a {a.shape}, b {b.shape}, rank {rank})")
+    return LoraAdapter(name=name, rank=rank, alpha=alpha, layers=layers)
+
+
+class LoraManager:
+    """Worker-side adapter slot registry over a fixed-rank slot pack.
+
+    Thread-safe: load/unload may race with list from the event drain and
+    with slot application on the scheduler thread.
+    """
+
+    def __init__(self, model_config: ModelConfig, max_loras: int,
+                 rank: int) -> None:
+        self.model_config = model_config
+        self.max_loras = max_loras
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._by_name: dict[str, LoraAdapter] = {}
+        self._free_slots = list(range(1, max_loras + 1))  # slot 0 = base
+
+    def load(self, name: str, path: str) -> LoraAdapter:
+        adapter = load_lora_npz(name, path)
+        # Reject targets this model family can't apply (MLA has no dense
+        # wk/wv; MoE layers have no dense MLP) and shape mismatches —
+        # loudly, never by silently dropping the weights.
+        from ..models.transformer import lora_target_dims
+
+        dims = lora_target_dims(self.model_config)
+        for idx, targets in adapter.layers.items():
+            if not 0 <= idx < self.model_config.n_layers:
+                raise ValueError(
+                    f"adapter {name!r} targets layer {idx}; model has "
+                    f"{self.model_config.n_layers} layers")
+            for t, (a, b) in targets.items():
+                if t not in dims:
+                    raise ValueError(
+                        f"adapter {name!r} targets {t!r}, unsupported for "
+                        f"model family {self.model_config.name!r} "
+                        f"(supported: {sorted(dims)})")
+                din, dout = dims[t]
+                if a.shape[0] != din or b.shape[1] != dout:
+                    raise ValueError(
+                        f"adapter {name!r} layer {idx} target {t}: shapes "
+                        f"a{a.shape}/b{b.shape} vs model ({din}, {dout})")
+        if adapter.rank > self.rank:
+            raise ValueError(
+                f"adapter {name!r} rank {adapter.rank} exceeds the engine's "
+                f"slot rank {self.rank} (set --lora-rank higher)")
+        if adapter.rank < self.rank:
+            # zero-pad factors up to the slot rank (delta unchanged)
+            for idx, targets in adapter.layers.items():
+                for t, (a, b) in targets.items():
+                    pad = self.rank - adapter.rank
+                    a = np.pad(a, ((0, 0), (0, pad)))
+                    b = np.pad(b, ((0, pad), (0, 0)))
+                    targets[t] = (a, b)
+        with self._lock:
+            if name in self._by_name:
+                raise ValueError(f"adapter {name!r} already loaded")
+            if not self._free_slots:
+                raise RuntimeError(
+                    f"no free adapter slots (max_loras={self.max_loras})")
+            adapter.slot = self._free_slots.pop(0)
+            self._by_name[name] = adapter
+        log.info("lora adapter %s loaded into slot %d (rank %d, alpha %g)",
+                 name, adapter.slot, adapter.rank, adapter.alpha)
+        return adapter
+
+    def unload(self, name: str) -> LoraAdapter:
+        adapter = self.begin_unload(name)
+        self.commit_unload(adapter)
+        return adapter
+
+    def begin_unload(self, name: str) -> LoraAdapter:
+        """Phase 1: unmap the name (new requests fail fast) WITHOUT freeing
+        the slot, so a concurrent load can't reuse it while in-flight
+        sequences are checked. Follow with commit_unload or abort_unload."""
+        with self._lock:
+            adapter = self._by_name.pop(name, None)
+            if adapter is None:
+                raise KeyError(f"adapter {name!r} not loaded")
+        return adapter
+
+    def commit_unload(self, adapter: LoraAdapter) -> None:
+        with self._lock:
+            self._free_slots.append(adapter.slot)
+            self._free_slots.sort()
+        log.info("lora adapter %s unloaded (slot %d freed)", adapter.name,
+                 adapter.slot)
+
+    def abort_unload(self, adapter: LoraAdapter) -> None:
+        with self._lock:
+            self._by_name[adapter.name] = adapter
+
+    def slot_of(self, name: str) -> Optional[int]:
+        with self._lock:
+            adapter = self._by_name.get(name)
+            return adapter.slot if adapter is not None else None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._by_name)
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"name": a.name, "slot": a.slot, "rank": a.rank,
+                 "alpha": a.alpha}
+                for a in sorted(self._by_name.values(), key=lambda a: a.slot)
+            ]

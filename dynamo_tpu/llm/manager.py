@@ -43,6 +43,17 @@ class ModelEntry:
     # worker instance_id -> last published kv usage (any router mode; feeds
     # busy-threshold load shedding)
     worker_usage: dict[int, float] = dataclasses.field(default_factory=dict)
+    # worker instance_id -> adapters it advertises (cards republish on LoRA
+    # load/unload); the model's adapter set is the UNION — per-instance
+    # eligibility is enforced at routing time via lora_instances.
+    instance_loras: dict[int, list[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def loras(self) -> set[str]:
+        return {name for ls in self.instance_loras.values() for name in ls}
+
+    def lora_instances(self, name: str) -> set[int]:
+        return {iid for iid, ls in self.instance_loras.items() if name in ls}
 
 
 class ModelManager:
@@ -60,8 +71,29 @@ class ModelManager:
     def get(self, name: str) -> Optional[ModelEntry]:
         return self._models.get(name)
 
+    def resolve(self, name: str) -> tuple[Optional[ModelEntry], Optional[str]]:
+        """Resolve a requested model name to (entry, lora_name). A name
+        matching a LoRA adapter advertised in some model's card routes to
+        that base model with the adapter applied (ref: lora.rs — adapters
+        are served as model names)."""
+        entry = self._models.get(name)
+        if entry is not None:
+            return entry, None
+        for entry in self._models.values():
+            if name in entry.loras():
+                return entry, name
+        return None, None
+
     def list_models(self) -> list[ModelDeploymentCard]:
         return [e.card for e in self._models.values()]
+
+    def list_adapters(self) -> list[tuple[str, str]]:
+        """(adapter_name, base_model_name) pairs across all entries."""
+        out = []
+        for entry in self._models.values():
+            for name in sorted(entry.loras()):
+                out.append((name, entry.card.name))
+        return out
 
     def entries(self) -> list[ModelEntry]:
         return list(self._models.values())
@@ -151,6 +183,11 @@ class ModelWatcher:
                 card.name, entry.card.endpoint_subject, subject)
             return
         entry.instances.add(instance_id)
+        # Per-instance adapter list (cards republish on LoRA load/unload);
+        # never overwrite the entry card wholesale — with multiple instances
+        # the last publisher would clobber the others' state.
+        entry.instance_loras[instance_id] = list(
+            card.runtime_config.get("loras", []))
 
     async def _handle_prefill_put(
         self, card: ModelDeploymentCard, subject: str, instance_id: int
@@ -197,6 +234,7 @@ class ModelWatcher:
         for entry in self.manager.entries():
             if entry.card.endpoint_subject == subject:
                 entry.instances.discard(instance_id)
+                entry.instance_loras.pop(instance_id, None)
                 if entry.scheduler is not None:
                     entry.scheduler.remove_worker_id(instance_id)
                 if not entry.instances:
@@ -216,15 +254,23 @@ class ModelWatcher:
         )
         client = endpoint.client()
         scheduler: Optional[KvScheduler] = None
+        # Shared with the ModelEntry below: routing reads live per-instance
+        # adapter state maintained by the watcher.
+        instance_loras: dict[int, list[str]] = {}
+
+        def lora_lookup(adapter: str) -> set[int]:
+            return {iid for iid, ls in instance_loras.items() if adapter in ls}
+
         if self.router_mode == "kv":
             config = self.kv_config or KvRouterConfig()
             config = dataclasses.replace(config, block_size=card.kv_block_size)
             scheduler = KvScheduler(config)
             router = PushRouter(client, mode="round_robin")
-            engine: TokenEngine = KvRouterEngine(router, scheduler)
+            engine: TokenEngine = KvRouterEngine(router, scheduler,
+                                                 lora_instances=lora_lookup)
         else:
             router = PushRouter(client, mode=self.router_mode)
-            engine = RouterEngine(router)
+            engine = RouterEngine(router, lora_instances=lora_lookup)
         name = card.name
         engine = PrefillRouterEngine(
             engine, pool_lookup=lambda: self._prefill_pools.get(name)
@@ -238,6 +284,7 @@ class ModelWatcher:
             router=router,
             scheduler=scheduler,
             instances=set(),
+            instance_loras=instance_loras,
         )
 
     async def _subscribe_events(self, namespace: str, entry: ModelEntry) -> None:

@@ -68,6 +68,9 @@ class PreprocessedRequest:
     # generated tokens when replaying to a new worker)
     prior_output_tokens: list[int] = dataclasses.field(default_factory=list)
     annotations: dict = dataclasses.field(default_factory=dict)
+    # Multi-LoRA: adapter to apply (frontend resolves model=<adapter-name>
+    # against worker cards; ref: lib/llm/src/lora.rs routing)
+    lora_name: Optional[str] = None
 
     def to_wire(self) -> dict:
         out = {
@@ -83,6 +86,8 @@ class PreprocessedRequest:
             out["disaggregated_params"] = self.disaggregated_params
         if self.prior_output_tokens:
             out["prior_output_tokens"] = self.prior_output_tokens
+        if self.lora_name:
+            out["lora_name"] = self.lora_name
         return out
 
     @classmethod
@@ -97,6 +102,7 @@ class PreprocessedRequest:
             disaggregated_params=data.get("disaggregated_params"),
             prior_output_tokens=list(data.get("prior_output_tokens") or []),
             annotations=data.get("annotations") or {},
+            lora_name=data.get("lora_name"),
         )
 
 

@@ -180,10 +180,18 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _swiglu(x: jax.Array, p: dict) -> jax.Array:
+def _swiglu(x: jax.Array, p: dict, lora_layer: Optional[dict] = None,
+            lora_idx: Optional[jax.Array] = None) -> jax.Array:
     gate = jnp.einsum("bth,hm->btm", x, p["w_gate"])
     up = jnp.einsum("bth,hm->btm", x, p["w_up"])
-    return jnp.einsum("btm,mh->bth", jax.nn.silu(gate) * up, p["w_down"])
+    if lora_layer is not None:
+        gate = gate + _lora_delta(x, lora_layer["w_gate"], lora_idx)
+        up = up + _lora_delta(x, lora_layer["w_up"], lora_idx)
+    act = jax.nn.silu(gate) * up
+    down = jnp.einsum("btm,mh->bth", act, p["w_down"])
+    if lora_layer is not None:
+        down = down + _lora_delta(act, lora_layer["w_down"], lora_idx)
+    return down
 
 
 def _moe_dense(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
@@ -251,6 +259,74 @@ def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
     up = jnp.einsum("ebch,ehm->ebcm", xe, p["e_up"])
     out_e = jnp.einsum("ebcm,emh->ebch", jax.nn.silu(gate) * up, p["e_down"])
     return jnp.einsum("btec,ebch->bth", combine.astype(x.dtype), out_e)
+
+
+# ---------------------------------------------------------------------------
+# Multi-LoRA (batched adapter slots, static shapes)
+# ---------------------------------------------------------------------------
+
+# Projections a LoRA adapter may target (dense path; expert weights and the
+# MLA latent projections are out of scope, matching common adapter training).
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def lora_target_dims(config: ModelConfig) -> dict[str, tuple[int, int]]:
+    """(din, dout) per supported adapter target for this model family.
+    Targets absent here are UNSUPPORTED for the family and are rejected at
+    load time (never silently dropped): MLA has no dense wk/wv (K/V come
+    from the shared latent path), and MoE layers have no dense MLP."""
+    h, hd = config.hidden, config.head_dim
+    qh, kh, m = config.n_q_heads, config.n_kv_heads, config.mlp_hidden
+    if config.is_mla:
+        dims = {
+            "wq": (h, qh * (config.mla_nope_head_dim
+                            + config.mla_rope_head_dim)),
+            "wo": (qh * config.mla_v_head_dim, h),
+        }
+    else:
+        dims = {
+            "wq": (h, qh * hd),
+            "wk": (h, kh * hd),
+            "wv": (h, kh * hd),
+            "wo": (qh * hd, h),
+        }
+    if not config.n_experts:
+        dims.update({"w_gate": (h, m), "w_up": (h, m), "w_down": (m, h)})
+    return dims
+
+
+def init_lora_pack(config: ModelConfig, max_loras: int, rank: int) -> dict:
+    """Zero-initialized stacked adapter slots: for every layer and target,
+    a: [S, in, r] and b: [S, r, out] with S = max_loras + 1. Slot 0 is
+    permanently zero (the base model), so requests without an adapter run
+    through the same compiled step with lora_idx=0 — one XLA program for
+    any adapter mix in the batch (the TPU answer to the reference's
+    delegation of multi-LoRA batching to vLLM/Punica, ref: lib/llm/src/
+    lora.rs + vllm handlers LoRA endpoints).
+
+    alpha/rank scaling is baked into `b` at load time so the forward pass
+    is just two small matmuls per target."""
+    dtype = jnp.dtype(config.dtype)
+    s = max_loras + 1
+    dims = lora_target_dims(config)
+    layer = {
+        t: {
+            "a": jnp.zeros((s, din, rank), dtype),
+            "b": jnp.zeros((s, rank, dout), dtype),
+        }
+        for t, (din, dout) in dims.items()
+    }
+    return {"layers": [jax.tree.map(lambda x: x, layer)
+                       for _ in range(config.n_layers)]}
+
+
+def _lora_delta(x: jax.Array, entry: dict, idx: jax.Array) -> jax.Array:
+    """x: [B, T, din]; entry: {a: [S, din, r], b: [S, r, dout]};
+    idx: [B] int32 slot per sequence. Returns [B, T, dout]."""
+    a = entry["a"][idx]  # [B, din, r]
+    b = entry["b"][idx]  # [B, r, dout]
+    low = jnp.einsum("bti,bir->btr", x, a)
+    return jnp.einsum("btr,bro->bto", low, b)
 
 
 # ---------------------------------------------------------------------------
@@ -355,6 +431,7 @@ def _mla_attention_block(
     positions: jax.Array,
     kv_lens: jax.Array,
     valid: jax.Array,
+    q_extra: Optional[jax.Array] = None,  # [B, T, qh*(nhd+rhd)] LoRA delta
 ) -> tuple[jax.Array, jax.Array]:
     """MLA with weight absorption (the efficient decode form): queries are
     projected into latent space (q_nope @ W_uk) so scores and context are
@@ -371,6 +448,8 @@ def _mla_attention_block(
     scale = 1.0 / math.sqrt(config.mla_qk_head_dim)
 
     q = jnp.einsum("bth,hqd->btqd", x, lp["wq"])  # [B,T,qh,nhd+rhd]
+    if q_extra is not None:
+        q = q + q_extra.reshape(q.shape)
     q_nope, q_rope = q[..., :nhd], q[..., nhd:]
     q_rope = rope(q_rope, positions, config.rope_theta)
 
@@ -548,6 +627,8 @@ def forward(
     kv_lens: jax.Array,  # [B] kv length AFTER this chunk
     valid: Optional[jax.Array] = None,  # [B, T]
     attention_fn=None,
+    lora: Optional[dict] = None,  # init_lora_pack() pytree
+    lora_idx: Optional[jax.Array] = None,  # [B] adapter slot per sequence
 ) -> tuple[jax.Array, jax.Array]:
     """Unified chunk forward (prefill T>1 or decode T=1).
 
@@ -556,18 +637,26 @@ def forward(
     if valid is None:
         valid = jnp.ones(tokens.shape, dtype=bool)
     attention = attention_fn or paged_attention_xla
+    b, t = tokens.shape
     x = params["embed"][tokens]  # [B, T, H]
     for layer_idx, lp in enumerate(params["layers"]):
+        ll = lora["layers"][layer_idx] if lora is not None else {}
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
         if config.is_mla:
             kv_cache, attn = _mla_attention_block(
                 h, lp, config, kv_cache, layer_idx, block_tables,
                 positions, kv_lens, valid,
+                q_extra=(_lora_delta(h, ll["wq"], lora_idx)
+                         if "wq" in ll else None),
             )
         else:
             q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
             k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
             v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+            if "wq" in ll:
+                q = q + _lora_delta(h, ll["wq"], lora_idx).reshape(q.shape)
+                k = k + _lora_delta(h, ll["wk"], lora_idx).reshape(k.shape)
+                v = v + _lora_delta(h, ll["wv"], lora_idx).reshape(v.shape)
             if config.qk_norm:
                 q = rms_norm(q, lp["q_norm"], config.rms_eps)
                 k = rms_norm(k, lp["k_norm"], config.rms_eps)
@@ -577,12 +666,16 @@ def forward(
                                       block_tables, positions, valid)
             attn = attention(q, kv_cache, layer_idx, block_tables,
                              positions, kv_lens)
-        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        if "wo" in ll:
+            attn_out = attn_out + _lora_delta(
+                attn.reshape(b, t, -1), ll["wo"], lora_idx)
+        x = x + attn_out
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         if config.n_experts:
             x = x + _moe(h, lp, config)
         else:
-            x = x + _swiglu(h, lp)
+            x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)

@@ -69,12 +69,16 @@ class PushRouter:
             out.append(iid)
         return out
 
-    async def _pick(self, body: Any, instance_id: Optional[int]) -> int:
+    async def _pick(self, body: Any, instance_id: Optional[int],
+                    allowed: Optional[set] = None) -> int:
         if self.mode == "direct":
             if instance_id is None:
                 raise ValueError("direct mode requires instance_id")
             return instance_id
         avail = self.available()
+        if allowed is not None:
+            # Capability filter (e.g. only instances holding a LoRA adapter).
+            avail = [i for i in avail if i in allowed]
         if instance_id is not None:
             # Explicit target (e.g. KV-selected upstream): honor it only while
             # it's live and not marked down — otherwise fail fast so the caller
@@ -105,6 +109,7 @@ class PushRouter:
         body: Any,
         instance_id: Optional[int] = None,
         headers: Optional[dict] = None,
+        allowed: Optional[set] = None,
     ) -> AsyncIterator[Any]:
         """Route and stream. On transport failure *before any output*, marks
         the instance down and retries another one; mid-stream failures
@@ -112,7 +117,7 @@ class PushRouter:
         await self.client.start()
         attempts = 0
         while True:
-            iid = await self._pick(body, instance_id)
+            iid = await self._pick(body, instance_id, allowed)
             # An explicit instance means the decision was made upstream
             # (KV scheduler / prefill router), not by this router's mode.
             ROUTER_DECISIONS.labels(

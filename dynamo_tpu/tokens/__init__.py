@@ -39,6 +39,16 @@ _ = get_native()
 _MASK64 = 0xFFFFFFFFFFFFFFFF
 
 
+def lora_id_of(name: Optional[str]) -> Optional[int]:
+    """Stable numeric identity for a LoRA adapter name, used to salt block
+    hashes (same prompt under different adapters produces different KV —
+    both the engine prefix cache and the router must see distinct
+    identities)."""
+    if not name:
+        return None
+    return xxhash.xxh64_intdigest(name.encode("utf-8"))
+
+
 def hash_block(tokens: Sequence[int], seed: int) -> int:
     """Hash one full block of token ids with a chaining seed."""
     buf = b"".join(int(t).to_bytes(4, "little", signed=False) for t in tokens)

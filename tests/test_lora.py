@@ -1,0 +1,455 @@
+"""Multi-LoRA serving tests: adapter math equivalence against merged dense
+weights, the slot manager, worker load/unload/list endpoints, and KV-identity
+salting (ref surface: lib/llm/src/lora.rs + vllm worker LoRA endpoints; the
+low-rank math itself is ours because we own the engine)."""
+
+import asyncio
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import ModelRunner, RunnerConfig, TpuWorker
+from dynamo_tpu.llm.lora import LoraManager, load_lora_npz, save_lora_npz
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import get_config
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.tokens import compute_block_hashes, lora_id_of
+
+RANK = 4
+ALPHA = 8.0
+
+
+def _adapter_layers(config, rng, targets=("wq", "wk", "wv", "wo",
+                                          "w_gate", "w_up", "w_down")):
+    """Random low-rank factors for every layer/target (unscaled b)."""
+    h, hd = config.hidden, config.head_dim
+    qh, kh, m = config.n_q_heads, config.n_kv_heads, config.mlp_hidden
+    dims = {
+        "wq": (h, qh * hd), "wk": (h, kh * hd), "wv": (h, kh * hd),
+        "wo": (qh * hd, h), "w_gate": (h, m), "w_up": (h, m),
+        "w_down": (m, h),
+    }
+    out = {}
+    for i in range(config.n_layers):
+        out[i] = {
+            t: (rng.standard_normal((dims[t][0], RANK)).astype(np.float32) * 0.1,
+                rng.standard_normal((RANK, dims[t][1])).astype(np.float32) * 0.1)
+            for t in targets
+        }
+    return out
+
+
+def _merged_params(params, config, layers):
+    """Base params with every adapter delta folded in (ground truth)."""
+    scale = ALPHA / RANK
+    merged = jax.tree.map(lambda x: x, params)
+    h, hd = config.hidden, config.head_dim
+    qh, kh = config.n_q_heads, config.n_kv_heads
+    for i, targets in layers.items():
+        lp = merged["layers"][i]
+        for t, (a, b) in targets.items():
+            delta = (a @ b) * scale
+            base = np.asarray(lp[t], np.float32)
+            if t == "wq":
+                delta = delta.reshape(h, qh, hd)
+            elif t in ("wk", "wv"):
+                delta = delta.reshape(h, kh, hd)
+            elif t == "wo":
+                delta = delta.reshape(qh, hd, h)
+            lp[t] = jnp.asarray(base + delta, dtype=lp[t].dtype)
+    return merged
+
+
+def _runner(max_loras=0, seed=0, params=None, dtype=None):
+    import dataclasses as dc
+
+    config = get_config("tiny-test")
+    if dtype is not None:
+        config = dc.replace(config, dtype=dtype)
+    return ModelRunner(
+        config,
+        RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                     max_pages_per_seq=16, prefill_buckets=(8, 16, 32),
+                     max_loras=max_loras, lora_rank=RANK),
+        make_mesh(MeshConfig()),
+        seed=seed,
+        params=params,
+    )
+
+
+def _greedy_tokens(runner, prompt, n=4, lora_idx=0):
+    """Prefill + n greedy decode steps on slot 0 of the runner."""
+    table = np.zeros(16, np.int32)
+    table[:8] = np.arange(1, 9)
+    tok = runner.prefill_chunk(np.asarray(prompt, np.int32), 0, table,
+                               len(prompt), (0.0, 1.0, 0, 0),
+                               lora_idx=lora_idx)
+    out = [tok]
+    b = runner.config.max_batch
+    tables = np.zeros((b, 16), np.int32)
+    tables[0] = table
+    for step in range(n - 1):
+        kv_len = len(prompt) + len(out)
+        toks = np.zeros(b, np.int32)
+        toks[0] = out[-1]
+        positions = np.zeros(b, np.int32)
+        positions[0] = kv_len - 1
+        kv_lens = np.zeros(b, np.int32)
+        kv_lens[0] = kv_len
+        active = np.zeros(b, bool)
+        active[0] = True
+        li = np.zeros(b, np.int32)
+        li[0] = lora_idx
+        nxt = runner.decode(toks, positions, tables, kv_lens, active,
+                            np.zeros(b, np.float32), np.ones(b, np.float32),
+                            np.zeros(b, np.int32), np.zeros(b, np.uint32),
+                            lora_idx=li)
+        out.append(int(nxt[0]))
+    return out
+
+
+class TestLoraMath:
+    def test_slot_zero_matches_base_model(self):
+        """A lora-enabled runner with empty slots reproduces the base
+        model's stream exactly."""
+        base = _runner(max_loras=0)
+        lora = _runner(max_loras=2)
+        prompt = list(range(1, 9))
+        assert _greedy_tokens(base, prompt) == _greedy_tokens(lora, prompt)
+
+    def test_adapter_matches_merged_weights(self, tmp_path):
+        """Applying an adapter through the slot pack equals folding the
+        delta into the dense weights (prefill + decode, greedy). Uses
+        float32 so merged-vs-factored rounding can't flip the argmax."""
+        import dataclasses as dc
+
+        config = dc.replace(get_config("tiny-test"), dtype="float32")
+        rng = np.random.default_rng(7)
+        layers = _adapter_layers(config, rng)
+        path = str(tmp_path / "ad.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=ALPHA)
+
+        runner = _runner(max_loras=2, dtype="float32")
+        manager = LoraManager(config, max_loras=2, rank=RANK)
+        adapter = manager.load("style", path)
+        runner.set_lora_slot(adapter.slot, adapter)
+
+        merged = _merged_params(runner.params, config, layers)
+        truth = _runner(params=merged, dtype="float32")
+
+        prompt = list(range(1, 9))
+        got = _greedy_tokens(runner, prompt, lora_idx=adapter.slot)
+        want = _greedy_tokens(truth, prompt)
+        assert got == want
+        # and slot 0 still serves the base model
+        base = _runner(max_loras=0, dtype="float32")
+        assert _greedy_tokens(runner, prompt, lora_idx=0) == \
+            _greedy_tokens(base, prompt)
+
+    def test_clear_slot_restores_base(self, tmp_path):
+        config = get_config("tiny-test")
+        layers = _adapter_layers(config, np.random.default_rng(3),
+                                 targets=("wq", "wo"))
+        path = str(tmp_path / "ad.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=ALPHA)
+        runner = _runner(max_loras=1)
+        manager = LoraManager(config, 1, RANK)
+        adapter = manager.load("a", path)
+        runner.set_lora_slot(adapter.slot, adapter)
+        prompt = list(range(1, 9))
+        base_out = _greedy_tokens(runner, prompt, lora_idx=0)
+        lora_out = _greedy_tokens(runner, prompt, lora_idx=1)
+        runner.clear_lora_slot(1)
+        assert _greedy_tokens(runner, prompt, lora_idx=1) == base_out
+        # sanity: the adapter actually changed something before the clear
+        # (tiny models can coincide; tolerate equality but flag via xfail
+        # semantics — we only hard-assert the restore)
+        del lora_out
+
+
+class TestLoraManager:
+    def test_npz_roundtrip_and_scaling(self, tmp_path):
+        config = get_config("tiny-test")
+        layers = _adapter_layers(config, np.random.default_rng(0),
+                                 targets=("wq",))
+        path = str(tmp_path / "x.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=ALPHA)
+        ad = load_lora_npz("x", path)
+        assert ad.rank == RANK and ad.alpha == ALPHA
+        a, b = ad.layers[0]["wq"]
+        np.testing.assert_allclose(a, layers[0]["wq"][0])
+        np.testing.assert_allclose(b, layers[0]["wq"][1] * (ALPHA / RANK),
+                                   rtol=1e-6)
+
+    def test_rank_padding(self, tmp_path):
+        config = get_config("tiny-test")
+        h, qh, hd = config.hidden, config.n_q_heads, config.head_dim
+        small = {0: {"wq": (np.ones((h, 2), np.float32),
+                            np.ones((2, qh * hd), np.float32))}}
+        path = str(tmp_path / "s.npz")
+        save_lora_npz(path, small, rank=2, alpha=2.0)
+        manager = LoraManager(config, 1, RANK)
+        ad = manager.load("s", path)
+        a, b = ad.layers[0]["wq"]
+        assert a.shape == (h, RANK) and b.shape == (RANK, qh * hd)
+        # padded region is zero => delta unchanged
+        assert np.all(a[:, 2:] == 0) and np.all(b[2:, :] == 0)
+
+    def test_slot_exhaustion_and_unload(self, tmp_path):
+        config = get_config("tiny-test")
+        layers = _adapter_layers(config, np.random.default_rng(1),
+                                 targets=("wq",))
+        path = str(tmp_path / "a.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=1.0)
+        manager = LoraManager(config, 2, RANK)
+        a1 = manager.load("one", path)
+        a2 = manager.load("two", path)
+        assert {a1.slot, a2.slot} == {1, 2}
+        with pytest.raises(RuntimeError, match="no free"):
+            manager.load("three", path)
+        manager.unload("one")
+        with pytest.raises(ValueError, match="already loaded"):
+            manager.load("two", path)
+        manager.unload("two")
+        a3 = manager.load("three", path)
+        assert a3.slot == 1  # lowest freed slot is reused first
+        assert [d["name"] for d in manager.list()] == ["three"]
+
+    def test_rank_too_large_rejected(self, tmp_path):
+        config = get_config("tiny-test")
+        h, qh, hd = config.hidden, config.n_q_heads, config.head_dim
+        big = {0: {"wq": (np.ones((h, 16), np.float32),
+                          np.ones((16, qh * hd), np.float32))}}
+        path = str(tmp_path / "b.npz")
+        save_lora_npz(path, big, rank=16, alpha=1.0)
+        manager = LoraManager(config, 1, RANK)
+        with pytest.raises(ValueError, match="exceeds"):
+            manager.load("big", path)
+
+    def test_unsupported_targets_rejected_loudly(self, tmp_path):
+        """MoE models have no dense MLP and MLA has no dense wk/wv: adapters
+        targeting them must be rejected at load, never silently dropped."""
+        moe = get_config("tiny-moe-test")
+        layers = {0: {"w_gate": (np.ones((moe.hidden, RANK), np.float32),
+                                 np.ones((RANK, moe.mlp_hidden), np.float32))}}
+        path = str(tmp_path / "moe.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=1.0)
+        with pytest.raises(ValueError, match="unsupported"):
+            LoraManager(moe, 1, RANK).load("m", path)
+
+        mla = get_config("tiny-mla-test")
+        layers = {0: {"wk": (np.ones((mla.hidden, RANK), np.float32),
+                             np.ones((RANK, 8), np.float32))}}
+        path2 = str(tmp_path / "mla.npz")
+        save_lora_npz(path2, layers, rank=RANK, alpha=1.0)
+        with pytest.raises(ValueError, match="unsupported"):
+            LoraManager(mla, 1, RANK).load("k", path2)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        config = get_config("tiny-test")
+        layers = {0: {"wq": (np.ones((7, RANK), np.float32),
+                             np.ones((RANK, 9), np.float32))}}
+        path = str(tmp_path / "bad.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=1.0)
+        with pytest.raises(ValueError, match="shapes"):
+            LoraManager(config, 1, RANK).load("bad", path)
+
+    def test_layer_out_of_range_rejected(self, tmp_path):
+        config = get_config("tiny-test")
+        h, qh, hd = config.hidden, config.n_q_heads, config.head_dim
+        layers = {99: {"wq": (np.ones((h, RANK), np.float32),
+                              np.ones((RANK, qh * hd), np.float32))}}
+        path = str(tmp_path / "deep.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=1.0)
+        with pytest.raises(ValueError, match="layer 99"):
+            LoraManager(config, 1, RANK).load("deep", path)
+
+
+class TestLoraRouting:
+    def test_manager_union_and_instance_sets(self):
+        """Adapter advertisement is the union across instances; routing
+        eligibility is per-instance (a re-publish by one instance must not
+        clobber another's adapters)."""
+        from dynamo_tpu.llm.manager import ModelEntry, ModelManager
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+        card = ModelDeploymentCard(name="m")
+        entry = ModelEntry(card=card, preprocessor=None, engine=None,
+                           router=None, scheduler=None)
+        entry.instance_loras[1] = ["styleA"]
+        entry.instance_loras[2] = []
+        assert entry.loras() == {"styleA"}
+        assert entry.lora_instances("styleA") == {1}
+        # instance 2 republishing without adapters doesn't hide styleA
+        entry.instance_loras[2] = []
+        assert entry.loras() == {"styleA"}
+
+        manager = ModelManager()
+        manager.register(entry)
+        got, lora = manager.resolve("styleA")
+        assert got is entry and lora == "styleA"
+        got, lora = manager.resolve("m")
+        assert got is entry and lora is None
+        assert manager.resolve("ghost") == (None, None)
+        assert manager.list_adapters() == [("styleA", "m")]
+
+    def test_router_engine_filters_by_adapter(self, run):
+        """RouterEngine only dispatches adapter requests to instances that
+        advertise the adapter; none -> NoInstancesAvailable (so Migration /
+        the frontend surface an error instead of a silent base-model run)."""
+        from dynamo_tpu.llm.engine import RouterEngine
+        from dynamo_tpu.runtime.push_router import (
+            NoInstancesAvailable,
+            PushRouter,
+        )
+
+        sent = {}
+
+        class FakeClient:
+            class endpoint:
+                subject = "ns/c/e"
+
+            instances = [{"instance_id": 1}, {"instance_id": 2}]
+
+            def instance_ids(self):
+                return [1, 2]
+
+            def on_change(self, cb):
+                pass
+
+            async def start(self):
+                pass
+
+            async def direct(self, body, iid, headers=None, timeout=None):
+                sent["iid"] = iid
+                yield {"t": [5], "f": "stop"}
+
+        router = PushRouter(FakeClient(), mode="round_robin")
+        engine = RouterEngine(router, lora_instances=lambda n: {2} if n == "x" else set())
+
+        async def body():
+            req = PreprocessedRequest(
+                request_id="r1", token_ids=[1, 2, 3],
+                sampling=SamplingOptions(max_tokens=1),
+                stop=StopConditions(), lora_name="x")
+            outs = [o async for o in engine.generate(req)]
+            assert outs[-1].finish_reason == "stop"
+            assert sent["iid"] == 2  # only instance 2 has the adapter
+            req2 = PreprocessedRequest(
+                request_id="r2", token_ids=[1], lora_name="ghost",
+                sampling=SamplingOptions(max_tokens=1),
+                stop=StopConditions())
+            with pytest.raises(NoInstancesAvailable):
+                async for _ in engine.generate(req2):
+                    pass
+
+        run(body(), timeout=30)
+
+
+class TestLoraKvIdentity:
+    def test_hashes_salted_by_adapter(self):
+        toks = list(range(32))
+        base = compute_block_hashes(toks, 8)
+        a = compute_block_hashes(toks, 8, lora_id=lora_id_of("styleA"))
+        b = compute_block_hashes(toks, 8, lora_id=lora_id_of("styleB"))
+        assert base != a and a != b
+        assert compute_block_hashes(toks, 8, lora_id=lora_id_of("styleA")) == a
+        assert lora_id_of(None) is None and lora_id_of("") is None
+
+
+class TestLoraWorkerE2E:
+    def test_load_generate_unload(self, run, mem_runtime_config, tmp_path):
+        config = get_config("tiny-test")
+        layers = _adapter_layers(config, np.random.default_rng(11))
+        path = str(tmp_path / "w.npz")
+        save_lora_npz(path, layers, rank=RANK, alpha=ALPHA)
+
+        async def body():
+            from dynamo_tpu.runtime import DistributedRuntime
+
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            ns = uuid.uuid4().hex
+            worker = TpuWorker(
+                rt, model_name="tiny-test", namespace=ns,
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=16, prefill_buckets=(8, 16, 32),
+                    max_loras=2, lora_rank=RANK),
+                warmup=False,
+            )
+            await worker.start()
+            comp = rt.namespace(ns).component("backend")
+            gen = comp.endpoint("generate").client()
+            await gen.wait_for_instances(1, timeout=10)
+
+            async def one(ep, body_):
+                client = comp.endpoint(ep).client()
+                await client.wait_for_instances(1, timeout=10)
+                outs = [o async for o in client.direct(body_, worker.instance_id)]
+                return outs[-1]
+
+            loaded = await one("lora_load", {"name": "style", "path": path})
+            assert loaded.get("ok"), loaded
+            listed = await one("lora_list", {})
+            assert [a["name"] for a in listed["adapters"]] == ["style"]
+            # the card now advertises the adapter
+            assert worker.card.runtime_config["loras"] == ["style"]
+
+            def req(lora_name=None):
+                return PreprocessedRequest(
+                    request_id=uuid.uuid4().hex,
+                    token_ids=list(range(1, 9)),
+                    sampling=SamplingOptions(max_tokens=4, temperature=0.0),
+                    stop=StopConditions(ignore_eos=True),
+                    lora_name=lora_name,
+                ).to_wire()
+
+            outs_base = [EngineOutput.from_wire(o)
+                         async for o in gen.direct(req(), worker.instance_id)]
+            outs_lora = [EngineOutput.from_wire(o)
+                         async for o in gen.direct(req("style"),
+                                                   worker.instance_id)]
+            assert outs_base[-1].finish_reason in ("stop", "length")
+            assert outs_lora[-1].finish_reason in ("stop", "length")
+            # unknown adapter -> routed error, not a crash
+            outs_bad = [EngineOutput.from_wire(o)
+                        async for o in gen.direct(req("nope"),
+                                                  worker.instance_id)]
+            assert outs_bad[-1].finish_reason == "error"
+            assert "not loaded" in outs_bad[-1].error
+
+            # Unload while a request is mid-stream on the adapter: refused
+            # (weights must not switch under an in-flight sequence).
+            long_req = PreprocessedRequest(
+                request_id=uuid.uuid4().hex, token_ids=list(range(1, 9)),
+                sampling=SamplingOptions(max_tokens=30, temperature=0.0),
+                stop=StopConditions(ignore_eos=True), lora_name="style",
+            ).to_wire()
+            stream = gen.direct(long_req, worker.instance_id)
+            first = await stream.__anext__()
+            assert EngineOutput.from_wire(first).token_ids
+            busy = await one("lora_unload", {"name": "style"})
+            assert "busy" in busy.get("error", ""), busy
+            # aborted unload restored the name -> adapter still usable
+            assert worker.loras.slot_of("style") == 1
+            async for _ in stream:
+                pass
+            await asyncio.sleep(0.2)  # let the scheduler reap the sequence
+
+            unloaded = await one("lora_unload", {"name": "style"})
+            assert unloaded.get("ok"), unloaded
+            assert worker.card.runtime_config["loras"] == []
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=180)
