@@ -525,16 +525,6 @@ class TpuWorker:
             loop.call_soon_threadsafe(out_queue.put_nowait, output)
 
         submit_kwargs: dict = {}
-        if request.lora_name:
-            slot = (self.loras.slot_of(request.lora_name)
-                    if self.loras is not None else None)
-            if slot is None:
-                yield EngineOutput(
-                    finish_reason="error",
-                    error=f"adapter {request.lora_name!r} not loaded here",
-                ).to_wire()
-                return
-            submit_kwargs["lora_idx"] = slot
         prefill_only = (self.mode == "prefill"
                         or bool(request.annotations.get("prefill_only")))
         if prefill_only:
@@ -551,6 +541,21 @@ class TpuWorker:
                 )
             # else: fall through — plain submit recomputes the prefill
 
+        if request.lora_name:
+            # Resolve the slot AFTER every await above: submit() runs in the
+            # same event-loop step as this resolution, so lora_in_flight's
+            # incoming-queue drain can never miss a resolved-but-unsubmitted
+            # sequence (a suspend between resolve and submit would let a
+            # concurrent unload free — and a load repurpose — the slot).
+            slot = (self.loras.slot_of(request.lora_name)
+                    if self.loras is not None else None)
+            if slot is None:
+                yield EngineOutput(
+                    finish_reason="error",
+                    error=f"adapter {request.lora_name!r} not loaded here",
+                ).to_wire()
+                return
+            submit_kwargs["lora_idx"] = slot
         handle = self.scheduler.submit(request, emit, **submit_kwargs)
         try:
             while True:

@@ -33,9 +33,15 @@ class Frontend:
         kv_temperature: Optional[float] = None,
         busy_threshold: Optional[float] = None,
         kserve_grpc_port: Optional[int] = None,
+        audit_sinks: Optional[str] = None,
+        record_path: Optional[str] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = ModelManager()
+        from ..llm.audit import Recorder, audit_bus_from_specs
+
+        self.audit = audit_bus_from_specs(audit_sinks)
+        self.recorder = Recorder(record_path) if record_path else None
         kv_config = KvRouterConfig(
             overlap_weight=(
                 env("DYNT_ROUTER_OVERLAP_WEIGHT")
@@ -50,7 +56,8 @@ class Frontend:
             runtime, self.manager, router_mode=router_mode, kv_config=kv_config
         )
         self.http = HttpService(
-            self.manager, host=host, port=port, busy_threshold=busy_threshold
+            self.manager, host=host, port=port, busy_threshold=busy_threshold,
+            audit=self.audit, recorder=self.recorder,
         )
         self.kserve = None
         if kserve_grpc_port is not None:
@@ -64,6 +71,8 @@ class Frontend:
         return self.http.port
 
     async def start(self) -> None:
+        if self.audit is not None:
+            self.audit.start()
         await self.watcher.start()
         await self.http.start()
         if self.kserve is not None:
@@ -74,6 +83,10 @@ class Frontend:
             await self.kserve.close()
         await self.http.close()
         await self.watcher.close()
+        if self.audit is not None:
+            await self.audit.close()
+        if self.recorder is not None:
+            self.recorder.close()
 
 
 async def main(argv: Optional[list[str]] = None) -> None:
@@ -90,6 +103,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--kserve-grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC frontend on "
                              "this port (0 = ephemeral)")
+    parser.add_argument("--audit-sinks", default=None,
+                        help="comma list: 'log' and/or 'jsonl:<path>' "
+                             "(default: DYNT_AUDIT_SINKS)")
+    parser.add_argument("--record", default=None, metavar="PATH",
+                        help="record every request + output stream to a "
+                             "JSONL file replayable by dynamo_tpu.replay")
     args = parser.parse_args(argv)
 
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
@@ -102,6 +121,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
         kv_temperature=args.router_temperature,
         busy_threshold=args.busy_threshold,
         kserve_grpc_port=args.kserve_grpc_port,
+        audit_sinks=args.audit_sinks,
+        record_path=args.record,
     )
     await frontend.start()
     log.info("frontend ready on port %d (router=%s)", frontend.port,

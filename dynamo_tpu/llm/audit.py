@@ -1,0 +1,210 @@
+"""Audit bus + request recorder: off-hot-path observability of requests.
+
+Two related subsystems from the reference, realized together:
+
+  * **Audit bus** (ref: lib/llm/src/audit/{bus,sink,stream}.rs, initialized
+    at entrypoint/input.rs:112-119): per-request summary records fanned out
+    to pluggable sinks. Emission is non-blocking — records go onto a bounded
+    queue drained by a background task, so a slow sink (disk, network) never
+    back-pressures the token stream; overflow drops oldest and counts drops.
+  * **Recorder** (ref: lib/llm/src/recorder.rs:26 JSONL event recorder +
+    dynamo.replay tooling): full request/output event log with timestamps,
+    replayable against a live endpoint by `python -m dynamo_tpu.replay`
+    (original inter-arrival timing, optionally scaled).
+
+Sink spec strings (DYNT_AUDIT_SINKS, comma separated):
+    jsonl:/path/to/audit.jsonl    append one JSON object per request
+    log                           INFO-level line per request
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Callable, Optional
+
+from ..runtime.logging import get_logger
+
+log = get_logger("llm.audit")
+
+
+@dataclasses.dataclass
+class AuditRecord:
+    """One served request, summarized after its last token."""
+
+    request_id: str
+    model: str
+    kind: str = ""  # chat | completions | messages | responses | embeddings
+    status: str = "ok"
+    lora: Optional[str] = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    finish_reason: Optional[str] = None
+    latency_ms: float = 0.0
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AuditSink:
+    def write(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink(AuditSink):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    def write(self, record: dict) -> None:
+        self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class LogSink(AuditSink):
+    def write(self, record: dict) -> None:
+        log.info("audit %s", json.dumps(record, separators=(",", ":")))
+
+
+class CallbackSink(AuditSink):
+    def __init__(self, fn: Callable[[dict], None]) -> None:
+        self.fn = fn
+
+    def write(self, record: dict) -> None:
+        self.fn(record)
+
+
+def sink_from_spec(spec: str) -> AuditSink:
+    spec = spec.strip()
+    if spec == "log":
+        return LogSink()
+    if spec.startswith("jsonl:"):
+        return JsonlSink(spec[len("jsonl:"):])
+    raise ValueError(f"unknown audit sink spec {spec!r} "
+                     "(expected 'log' or 'jsonl:<path>')")
+
+
+class AuditBus:
+    """Bounded-queue fan-out to sinks; emit() never blocks the hot path."""
+
+    def __init__(self, sinks: list[AuditSink], max_queue: int = 4096) -> None:
+        self.sinks = sinks
+        self._queue: asyncio.Queue = asyncio.Queue(max_queue)
+        self._task: Optional[asyncio.Task] = None
+        self.dropped = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    def emit(self, record: AuditRecord) -> None:
+        try:
+            self._queue.put_nowait(record.to_wire())
+        except asyncio.QueueFull:
+            # Shed the oldest so the newest (most useful) record survives.
+            self.dropped += 1
+            try:
+                self._queue.get_nowait()
+                self._queue.put_nowait(record.to_wire())
+            except (asyncio.QueueEmpty, asyncio.QueueFull):
+                pass
+
+    async def _pump(self) -> None:
+        while True:
+            record = await self._queue.get()
+            for sink in self.sinks:
+                try:
+                    sink.write(record)
+                except Exception:  # noqa: BLE001 — one bad sink can't stop
+                    log.exception("audit sink failed")
+
+    async def close(self) -> None:
+        # Drain what's queued, then stop.
+        while not self._queue.empty():
+            await asyncio.sleep(0.01)
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self.dropped:
+            log.warning("audit bus dropped %d records (queue overflow)",
+                        self.dropped)
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                log.exception("audit sink close failed")
+
+
+def audit_bus_from_specs(specs: Optional[str] = None) -> Optional[AuditBus]:
+    """Build a bus from a comma-separated spec string; None falls back to
+    DYNT_AUDIT_SINKS. Empty/blank -> no bus."""
+    if specs is None:
+        from ..runtime.config import env
+
+        specs = env("DYNT_AUDIT_SINKS")
+    if not specs or not specs.strip():
+        return None
+    return AuditBus([sink_from_spec(s) for s in specs.split(",") if s.strip()])
+
+
+# ---------------------------------------------------------------------------
+# Recorder: full request/output event log for replay
+# ---------------------------------------------------------------------------
+
+
+class Recorder:
+    """JSONL event stream: `request` (original HTTP body), `output` (engine
+    deltas), `end` — each stamped with a wall-clock ts. The replay tool
+    re-sends `request` events preserving inter-arrival gaps."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _write(self, event: str, request_id: str, data,
+               flush: bool = False) -> None:
+        # Per-token output events stay in the file buffer (record_output is
+        # on the streaming hot path — an fsync per delta would stall every
+        # in-flight stream on the shared event loop); request/end boundaries
+        # flush so a crash loses at most the tail of open streams.
+        self._f.write(json.dumps(
+            {"ts": time.time(), "event": event, "request_id": request_id,
+             "data": data},
+            separators=(",", ":")) + "\n")
+        if flush:
+            self._f.flush()
+
+    def record_request(self, request_id: str, kind: str, body: dict) -> None:
+        self._write("request", request_id, {"kind": kind, "body": body},
+                    flush=True)
+
+    def record_output(self, request_id: str, output_wire: dict) -> None:
+        self._write("output", request_id, output_wire)
+
+    def record_end(self, request_id: str, status: str) -> None:
+        self._write("end", request_id, {"status": status}, flush=True)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def read_recording(path: str) -> list[dict]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

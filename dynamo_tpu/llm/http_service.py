@@ -54,11 +54,15 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8000,
         busy_threshold: Optional[float] = None,
+        audit=None,  # Optional[audit.AuditBus]
+        recorder=None,  # Optional[audit.Recorder]
     ) -> None:
         self.manager = manager
         self.host = host
         self.port = port
         self.busy_threshold = busy_threshold
+        self.audit = audit
+        self.recorder = recorder
         self._runner: Optional[web.AppRunner] = None
 
     # -- helpers -----------------------------------------------------------
@@ -142,6 +146,8 @@ class HttpService:
 
         preprocessed.lora_name = lora
         current_request_id.set(preprocessed.request_id)
+        if self.recorder is not None:
+            self.recorder.record_request(preprocessed.request_id, kind, body)
         # Tool parsing activates only when the request declares tools (the
         # reference gates on request.tools the same way); reasoning parsing
         # follows the model card.
@@ -158,22 +164,45 @@ class HttpService:
                                                delta_gen, body)
         return await self._aggregate_response(entry, preprocessed, delta_gen)
 
-    @staticmethod
-    def _count_request(model: str, status: str,
-                       start: Optional[float] = None) -> None:
+    def _count_request(self, model: str, status: str,
+                       start: Optional[float] = None, *,
+                       preprocessed: Optional[PreprocessedRequest] = None,
+                       delta_gen: Optional[DeltaGenerator] = None,
+                       kind: str = "") -> None:
         """Frontend request counter + duration — the planner's num_req and
         concurrency signals (ref: http/service/metrics.rs request counts
-        feeding the Planner)."""
+        feeding the Planner). Also emits the audit record (off hot path:
+        emit is a queue put)."""
         labels = dict(namespace="http", component="frontend", endpoint=model)
         rt_metrics.REQUESTS_TOTAL.labels(status=status, **labels).inc()
         if start is not None:
             rt_metrics.REQUEST_DURATION.labels(**labels).observe(
                 max(0.0, time.monotonic() - start))
+        if self.audit is not None:
+            from .audit import AuditRecord
+
+            self.audit.emit(AuditRecord(
+                request_id=(preprocessed.request_id if preprocessed else ""),
+                model=model, kind=kind, status=status,
+                lora=(preprocessed.lora_name if preprocessed else None),
+                prompt_tokens=(len(preprocessed.token_ids)
+                               if preprocessed else 0),
+                completion_tokens=(delta_gen.completion_tokens
+                                   if delta_gen else 0),
+                finish_reason=(delta_gen.finish_reason if delta_gen else None),
+                latency_ms=((time.monotonic() - start) * 1e3 if start else 0.0),
+            ))
 
     async def _generate(
         self, entry: ModelEntry, preprocessed: PreprocessedRequest
     ) -> AsyncIterator[EngineOutput]:
+        rec = self.recorder
         async for output in entry.engine.generate(preprocessed):
+            if rec is not None:
+                rec.record_output(preprocessed.request_id, output.to_wire())
+                if output.finish_reason is not None:
+                    rec.record_end(preprocessed.request_id,
+                                   output.finish_reason)
             yield output
 
     async def _aggregate_response(
@@ -209,7 +238,8 @@ class HttpService:
                 _error_body(502, str(exc), "engine_error"), status=502)
         rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
             delta_gen.completion_tokens)
-        self._count_request(model, "ok", start)
+        self._count_request(model, "ok", start, preprocessed=preprocessed,
+                            delta_gen=delta_gen, kind=delta_gen.kind)
         return web.json_response(delta_gen.final_response())
 
     async def _stream_response(
@@ -275,7 +305,9 @@ class HttpService:
             rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
                 delta_gen.completion_tokens)
             status = "ok" if delta_gen.finish_reason is not None else "error"
-            self._count_request(model, status, start)
+            self._count_request(model, status, start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind=delta_gen.kind)
         await response.write_eof()
         return response
 
@@ -323,7 +355,13 @@ class HttpService:
                                      status=400)
         model = body.get("model", "")
         entry, lora = self._lookup(model)
+        if lora is not None:
+            return web.json_response(_error_body(
+                400, f"model '{model}' is a LoRA adapter; adapters are not "
+                     "supported for embeddings"), status=400)
         self._check_busy(entry)
+        if self.recorder is not None:
+            self.recorder.record_request(new_request_id(), "embeddings", body)
         try:
             inputs = self._embedding_inputs(body.get("input"), entry)
             for toks in inputs:
@@ -362,7 +400,7 @@ class HttpService:
             data.append({"object": "embedding", "index": i,
                          "embedding": payload})
         total = sum(len(t) for t in inputs)
-        self._count_request(model, "ok", start)
+        self._count_request(model, "ok", start, kind="embeddings")
         return web.json_response({
             "object": "list",
             "data": data,
@@ -429,6 +467,9 @@ class HttpService:
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
         preprocessed.lora_name = lora
+        if self.recorder is not None:
+            self.recorder.record_request(
+                preprocessed.request_id, "messages", body)
         current_request_id.set(preprocessed.request_id)
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")
@@ -451,7 +492,8 @@ class HttpService:
         except RemoteError as exc:
             return web.json_response(
                 _error_body(502, str(exc), "engine_error"), status=502)
-        self._count_request(model, "ok", start)
+        self._count_request(model, "ok", start, preprocessed=preprocessed,
+                            delta_gen=delta_gen, kind="messages")
         stop_reason, stop_sequence = self._anthropic_stop(delta_gen)
         return web.json_response({
             "id": msg_id,
@@ -534,7 +576,9 @@ class HttpService:
         finally:
             ok = delta_gen.finish_reason is not None and not errored
             self._count_request(preprocessed.model,
-                                "ok" if ok else "error", start)
+                                "ok" if ok else "error", start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind="messages")
         await response.write_eof()
         return response
 
@@ -615,6 +659,9 @@ class HttpService:
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
         preprocessed.lora_name = lora
+        if self.recorder is not None:
+            self.recorder.record_request(
+                preprocessed.request_id, "responses", body)
         current_request_id.set(preprocessed.request_id)
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")
@@ -637,7 +684,8 @@ class HttpService:
         except RemoteError as exc:
             return web.json_response(
                 _error_body(502, str(exc), "engine_error"), status=502)
-        self._count_request(model, "ok", start)
+        self._count_request(model, "ok", start, preprocessed=preprocessed,
+                            delta_gen=delta_gen, kind="responses")
         return web.json_response(
             self._responses_body(resp_id, model, delta_gen, "completed"))
 
@@ -697,7 +745,9 @@ class HttpService:
         finally:
             ok = delta_gen.finish_reason is not None and not errored
             self._count_request(preprocessed.model,
-                                "ok" if ok else "error", start)
+                                "ok" if ok else "error", start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind="responses")
         await response.write_eof()
         return response
 

@@ -1,0 +1,153 @@
+"""Replay recorded request streams against a live OpenAI endpoint.
+
+Counterpart of the frontend's `--record` JSONL recorder (llm/audit.py
+Recorder); mirrors the reference's `dynamo.replay` tooling (ref:
+lib/bindings/python/src/dynamo/replay/ + lib/llm/src/recorder.rs). Replays
+`request` events with their original inter-arrival gaps (scaled by
+--speed), collects per-request latency/TTFT/token counts, and prints a
+JSON summary.
+
+Usage:
+    python -m dynamo_tpu.replay --file audit.jsonl \
+        --url http://127.0.0.1:8000 [--speed 2.0] [--max-concurrency 32]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import aiohttp
+
+from ..llm.audit import read_recording
+from ..runtime.logging import get_logger
+
+log = get_logger("replay")
+
+_ENDPOINTS = {
+    "chat": "/v1/chat/completions",
+    "completions": "/v1/completions",
+    "messages": "/v1/messages",
+    "responses": "/v1/responses",
+    "embeddings": "/v1/embeddings",
+}
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    requests: int = 0
+    ok: int = 0
+    errors: int = 0
+    total_latency_ms: float = 0.0
+    total_ttft_ms: float = 0.0
+    streamed: int = 0
+    wall_s: float = 0.0
+
+    def summary(self) -> dict:
+        n = max(1, self.ok)
+        return {
+            "requests": self.requests,
+            "ok": self.ok,
+            "errors": self.errors,
+            "avg_latency_ms": round(self.total_latency_ms / n, 2),
+            "avg_ttft_ms": (round(self.total_ttft_ms / self.streamed, 2)
+                            if self.streamed else None),
+            "wall_s": round(self.wall_s, 3),
+            "rps": round(self.requests / self.wall_s, 2) if self.wall_s else 0,
+        }
+
+
+async def _send_one(session: aiohttp.ClientSession, url: str, kind: str,
+                    body: dict, result: ReplayResult) -> None:
+    endpoint = _ENDPOINTS.get(kind, _ENDPOINTS["chat"])
+    start = time.monotonic()
+    try:
+        if body.get("stream"):
+            async with session.post(url + endpoint, json=body) as resp:
+                first = None
+                async for line in resp.content:
+                    if first is None and line.strip():
+                        first = time.monotonic()
+                if resp.status == 200:
+                    result.ok += 1
+                    if first is not None:
+                        result.total_ttft_ms += (first - start) * 1e3
+                        result.streamed += 1
+                else:
+                    result.errors += 1
+        else:
+            async with session.post(url + endpoint, json=body) as resp:
+                await resp.read()
+                if resp.status == 200:
+                    result.ok += 1
+                else:
+                    result.errors += 1
+    except aiohttp.ClientError as exc:
+        log.warning("replay request failed: %r", exc)
+        result.errors += 1
+    finally:
+        result.requests += 1
+        result.total_latency_ms += (time.monotonic() - start) * 1e3
+
+
+async def replay(
+    path: str,
+    url: str,
+    speed: float = 1.0,
+    max_concurrency: int = 64,
+    model_override: Optional[str] = None,
+) -> ReplayResult:
+    """Re-send every recorded `request` event. speed > 1 compresses the
+    original timeline (2.0 = twice as fast); speed <= 0 fires as fast as
+    the concurrency limit allows."""
+    events = [e for e in read_recording(path) if e.get("event") == "request"]
+    if not events:
+        raise ValueError(f"no request events in {path}")
+    result = ReplayResult()
+    t0_rec = events[0]["ts"]
+    t0 = time.monotonic()
+    sem = asyncio.Semaphore(max_concurrency)
+    tasks = []
+
+    async def run_one(event: dict) -> None:
+        async with sem:
+            data = event["data"]
+            body = dict(data["body"])
+            if model_override:
+                body["model"] = model_override
+            await _send_one(session, url, data.get("kind", "chat"), body,
+                            result)
+
+    async with aiohttp.ClientSession() as session:
+        for event in events:
+            if speed > 0:
+                due = t0 + (event["ts"] - t0_rec) / speed
+                delay = due - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(run_one(event)))
+        await asyncio.gather(*tasks)
+    result.wall_s = time.monotonic() - t0
+    return result
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.replay")
+    parser.add_argument("--file", required=True,
+                        help="recording produced by frontend --record")
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--speed", type=float, default=1.0,
+                        help="timeline compression (<=0: max speed)")
+    parser.add_argument("--max-concurrency", type=int, default=64)
+    parser.add_argument("--model", default=None,
+                        help="override the recorded model name")
+    args = parser.parse_args(argv)
+    result = await replay(args.file, args.url, speed=args.speed,
+                          max_concurrency=args.max_concurrency,
+                          model_override=args.model)
+    print(json.dumps(result.summary()))

@@ -94,6 +94,9 @@ _register("DYNT_SYSTEM_ENABLED", True, _bool, "Enable the system status server")
 
 # Logging
 _register("DYNT_LOG_LEVEL", "INFO", _str, "Log level")
+_register("DYNT_AUDIT_SINKS", "", _str,
+          "Comma list of audit sinks for the frontend: 'log' and/or "
+          "'jsonl:<path>' (ref: lib/llm/src/audit/ sink config)")
 _register("DYNT_LOGGING_JSONL", False, _bool,
           "Emit JSONL logs (ref: DYN_LOGGING_JSONL)")
 
