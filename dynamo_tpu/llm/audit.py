@@ -128,15 +128,27 @@ class AuditBus:
                     log.exception("audit sink failed")
 
     async def close(self) -> None:
-        # Drain what's queued, then stop.
-        while not self._queue.empty():
-            await asyncio.sleep(0.01)
-        if self._task is not None:
+        if self._task is not None and not self._task.done():
+            # Let the pump drain what's queued (bounded — a wedged sink
+            # must not hang shutdown), then stop it.
+            deadline = time.monotonic() + 5.0
+            while not self._queue.empty() and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
             self._task.cancel()
             try:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        else:
+            # Pump never started (or died): flush queued records directly
+            # so close() can't spin on a consumer-less queue.
+            while not self._queue.empty():
+                record = self._queue.get_nowait()
+                for sink in self.sinks:
+                    try:
+                        sink.write(record)
+                    except Exception:  # noqa: BLE001
+                        log.exception("audit sink failed")
         if self.dropped:
             log.warning("audit bus dropped %d records (queue overflow)",
                         self.dropped)

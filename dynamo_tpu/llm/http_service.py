@@ -168,7 +168,8 @@ class HttpService:
                        start: Optional[float] = None, *,
                        preprocessed: Optional[PreprocessedRequest] = None,
                        delta_gen: Optional[DeltaGenerator] = None,
-                       kind: str = "") -> None:
+                       kind: str = "", request_id: Optional[str] = None,
+                       prompt_tokens: Optional[int] = None) -> None:
         """Frontend request counter + duration — the planner's num_req and
         concurrency signals (ref: http/service/metrics.rs request counts
         feeding the Planner). Also emits the audit record (off hot path:
@@ -182,10 +183,13 @@ class HttpService:
             from .audit import AuditRecord
 
             self.audit.emit(AuditRecord(
-                request_id=(preprocessed.request_id if preprocessed else ""),
+                request_id=(request_id if request_id is not None
+                            else preprocessed.request_id if preprocessed
+                            else ""),
                 model=model, kind=kind, status=status,
                 lora=(preprocessed.lora_name if preprocessed else None),
-                prompt_tokens=(len(preprocessed.token_ids)
+                prompt_tokens=(prompt_tokens if prompt_tokens is not None
+                               else len(preprocessed.token_ids)
                                if preprocessed else 0),
                 completion_tokens=(delta_gen.completion_tokens
                                    if delta_gen else 0),
@@ -213,34 +217,41 @@ class HttpService:
         start = time.monotonic()
         first_token_at: Optional[float] = None
         last_token_at: Optional[float] = None
+        status = "error"
         try:
-            async for output in self._generate(entry, preprocessed):
-                if output.token_ids:
-                    now = time.monotonic()
-                    if first_token_at is None:
-                        first_token_at = now
-                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(
-                            now - start)
-                    elif last_token_at is not None:
-                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
-                            (now - last_token_at)
-                            / max(1, len(output.token_ids)))
-                    last_token_at = now
-                delta_gen.on_output(output)
-                if output.error:
-                    return web.json_response(
-                        _error_body(502, output.error, "engine_error"), status=502)
-        except NoInstancesAvailable:
-            return web.json_response(
-                _error_body(503, "no workers available", "overloaded"), status=503)
-        except RemoteError as exc:
-            return web.json_response(
-                _error_body(502, str(exc), "engine_error"), status=502)
-        rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
-            delta_gen.completion_tokens)
-        self._count_request(model, "ok", start, preprocessed=preprocessed,
-                            delta_gen=delta_gen, kind=delta_gen.kind)
-        return web.json_response(delta_gen.final_response())
+            try:
+                async for output in self._generate(entry, preprocessed):
+                    if output.token_ids:
+                        now = time.monotonic()
+                        if first_token_at is None:
+                            first_token_at = now
+                            rt_metrics.TTFT_SECONDS.labels(model=model).observe(
+                                now - start)
+                        elif last_token_at is not None:
+                            rt_metrics.ITL_SECONDS.labels(model=model).observe(
+                                (now - last_token_at)
+                                / max(1, len(output.token_ids)))
+                        last_token_at = now
+                    delta_gen.on_output(output)
+                    if output.error:
+                        return web.json_response(
+                            _error_body(502, output.error, "engine_error"), status=502)
+            except NoInstancesAvailable:
+                return web.json_response(
+                    _error_body(503, "no workers available", "overloaded"), status=503)
+            except RemoteError as exc:
+                return web.json_response(
+                    _error_body(502, str(exc), "engine_error"), status=502)
+            rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
+                delta_gen.completion_tokens)
+            status = "ok"
+            return web.json_response(delta_gen.final_response())
+        finally:
+            # Counts + audit on EVERY outcome (error returns included) so
+            # the audit trail never undercounts failures.
+            self._count_request(model, status, start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind=delta_gen.kind)
 
     async def _stream_response(
         self, request: web.Request, entry: ModelEntry,
@@ -360,8 +371,12 @@ class HttpService:
                 400, f"model '{model}' is a LoRA adapter; adapters are not "
                      "supported for embeddings"), status=400)
         self._check_busy(entry)
+        # One id correlates the recorder entry with the audit record (the
+        # join-by-request_id model every other endpoint follows).
+        request_id = new_request_id()
+        current_request_id.set(request_id)
         if self.recorder is not None:
-            self.recorder.record_request(new_request_id(), "embeddings", body)
+            self.recorder.record_request(request_id, "embeddings", body)
         try:
             inputs = self._embedding_inputs(body.get("input"), entry)
             for toks in inputs:
@@ -376,37 +391,42 @@ class HttpService:
             return web.json_response(
                 _error_body(400, "encoding_format must be float or base64"),
                 status=400)
-        start = time.monotonic()
-        try:
-            vectors = await asyncio.gather(*[
-                self._embed_one(entry, model, toks) for toks in inputs
-            ])
-        except NoInstancesAvailable:
-            return web.json_response(
-                _error_body(503, "no workers available", "overloaded"),
-                status=503)
-        except RemoteError as exc:
-            return web.json_response(
-                _error_body(502, str(exc), "engine_error"), status=502)
-        data = []
-        for i, vec in enumerate(vectors):
-            if encoding == "base64":
-                import numpy as np
-
-                payload = base64.b64encode(
-                    np.asarray(vec, np.float32).tobytes()).decode()
-            else:
-                payload = vec
-            data.append({"object": "embedding", "index": i,
-                         "embedding": payload})
         total = sum(len(t) for t in inputs)
-        self._count_request(model, "ok", start, kind="embeddings")
-        return web.json_response({
-            "object": "list",
-            "data": data,
-            "model": model,
-            "usage": {"prompt_tokens": total, "total_tokens": total},
-        })
+        start = time.monotonic()
+        status = "error"
+        try:
+            try:
+                vectors = await asyncio.gather(*[
+                    self._embed_one(entry, model, toks) for toks in inputs
+                ])
+            except NoInstancesAvailable:
+                return web.json_response(
+                    _error_body(503, "no workers available", "overloaded"),
+                    status=503)
+            except RemoteError as exc:
+                return web.json_response(
+                    _error_body(502, str(exc), "engine_error"), status=502)
+            data = []
+            for i, vec in enumerate(vectors):
+                if encoding == "base64":
+                    import numpy as np
+
+                    payload = base64.b64encode(
+                        np.asarray(vec, np.float32).tobytes()).decode()
+                else:
+                    payload = vec
+                data.append({"object": "embedding", "index": i,
+                             "embedding": payload})
+            status = "ok"
+            return web.json_response({
+                "object": "list",
+                "data": data,
+                "model": model,
+                "usage": {"prompt_tokens": total, "total_tokens": total},
+            })
+        finally:
+            self._count_request(model, status, start, kind="embeddings",
+                                request_id=request_id, prompt_tokens=total)
 
     # -- Anthropic Messages API (ref: http/service/anthropic.rs) -----------
 
@@ -478,22 +498,27 @@ class HttpService:
             return await self._anthropic_stream(request, entry, preprocessed,
                                                 delta_gen, msg_id)
         start = time.monotonic()
+        status = "error"
         try:
-            async for output in self._generate(entry, preprocessed):
-                delta_gen.on_output(output)
-                if output.error:
-                    return web.json_response(
-                        _error_body(502, output.error, "engine_error"),
-                        status=502)
-        except NoInstancesAvailable:
-            return web.json_response(
-                _error_body(503, "no workers available", "overloaded"),
-                status=503)
-        except RemoteError as exc:
-            return web.json_response(
-                _error_body(502, str(exc), "engine_error"), status=502)
-        self._count_request(model, "ok", start, preprocessed=preprocessed,
-                            delta_gen=delta_gen, kind="messages")
+            try:
+                async for output in self._generate(entry, preprocessed):
+                    delta_gen.on_output(output)
+                    if output.error:
+                        return web.json_response(
+                            _error_body(502, output.error, "engine_error"),
+                            status=502)
+            except NoInstancesAvailable:
+                return web.json_response(
+                    _error_body(503, "no workers available", "overloaded"),
+                    status=503)
+            except RemoteError as exc:
+                return web.json_response(
+                    _error_body(502, str(exc), "engine_error"), status=502)
+            status = "ok"
+        finally:
+            self._count_request(model, status, start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind="messages")
         stop_reason, stop_sequence = self._anthropic_stop(delta_gen)
         return web.json_response({
             "id": msg_id,
@@ -670,22 +695,27 @@ class HttpService:
             return await self._responses_stream(request, entry, preprocessed,
                                                 delta_gen, resp_id)
         start = time.monotonic()
+        status = "error"
         try:
-            async for output in self._generate(entry, preprocessed):
-                delta_gen.on_output(output)
-                if output.error:
-                    return web.json_response(
-                        _error_body(502, output.error, "engine_error"),
-                        status=502)
-        except NoInstancesAvailable:
-            return web.json_response(
-                _error_body(503, "no workers available", "overloaded"),
-                status=503)
-        except RemoteError as exc:
-            return web.json_response(
-                _error_body(502, str(exc), "engine_error"), status=502)
-        self._count_request(model, "ok", start, preprocessed=preprocessed,
-                            delta_gen=delta_gen, kind="responses")
+            try:
+                async for output in self._generate(entry, preprocessed):
+                    delta_gen.on_output(output)
+                    if output.error:
+                        return web.json_response(
+                            _error_body(502, output.error, "engine_error"),
+                            status=502)
+            except NoInstancesAvailable:
+                return web.json_response(
+                    _error_body(503, "no workers available", "overloaded"),
+                    status=503)
+            except RemoteError as exc:
+                return web.json_response(
+                    _error_body(502, str(exc), "engine_error"), status=502)
+            status = "ok"
+        finally:
+            self._count_request(model, status, start,
+                                preprocessed=preprocessed,
+                                delta_gen=delta_gen, kind="responses")
         return web.json_response(
             self._responses_body(resp_id, model, delta_gen, "completed"))
 

@@ -1,0 +1,401 @@
+"""Trace-driven load generation + offline replay for the mocker.
+
+Counterpart of the reference's mocker load tooling (ref: lib/mocker/src/
+loadgen/trace.rs — trace records with timestamps/ISL/OSL/hash_ids;
+replay/offline/{single,agg,disagg}.rs — run a trace through simulated
+engines WITHOUT network or frontend and report TTFT/ITL/throughput;
+docs/benchmarks/mocker-trace-replay.md).
+
+Trace format: JSONL, one record per request:
+    {"ts_ms": 120.0, "isl": 3000, "osl": 150, "hash_ids": [0, 1, 2]}
+`hash_ids` (optional) name prefix blocks: records sharing a hash_id prefix
+share the exact same token blocks, exercising prefix caching and KV-aware
+routing the way the reference's mooncake-style traces do. Keys
+"timestamp"/"input_length"/"output_length" are accepted as aliases.
+
+Offline replay modes:
+    single  one mocker engine
+    agg     N engines behind a router policy (round_robin | kv)
+    disagg  prefill pool + decode pool with mock KV handoff (ref §3.4)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..kv_router import KvRouterConfig, KvScheduler, WorkerWithDpRank
+from ..kv_router.protocols import KV_EVENT_TOPIC, LOAD_TOPIC, RouterEvent
+from ..llm.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    new_request_id,
+)
+from ..runtime.logging import get_logger
+from ..tokens import compute_block_hashes
+from .engine import MockerConfig, MockerEngine
+
+log = get_logger("mocker.loadgen")
+
+
+@dataclasses.dataclass
+class TraceRecord:
+    ts_ms: float
+    isl: int
+    osl: int
+    hash_ids: Optional[list[int]] = None
+
+    def to_wire(self) -> dict:
+        out = {"ts_ms": self.ts_ms, "isl": self.isl, "osl": self.osl}
+        if self.hash_ids is not None:
+            out["hash_ids"] = self.hash_ids
+        return out
+
+
+def load_trace(path: str) -> list[TraceRecord]:
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            records.append(TraceRecord(
+                ts_ms=float(d.get("ts_ms", d.get("timestamp", 0.0))),
+                isl=int(d.get("isl", d.get("input_length", 0))),
+                osl=int(d.get("osl", d.get("output_length", 1))),
+                hash_ids=d.get("hash_ids"),
+            ))
+    records.sort(key=lambda r: r.ts_ms)
+    return records
+
+
+def save_trace(path: str, records: list[TraceRecord]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r.to_wire(), separators=(",", ":")) + "\n")
+
+
+def synthesize_trace(
+    n: int,
+    rate_rps: float = 10.0,
+    isl_mean: int = 512,
+    osl_mean: int = 64,
+    prefix_ratio: float = 0.5,
+    num_prefix_groups: int = 8,
+    block_size: int = 16,
+    seed: int = 0,
+) -> list[TraceRecord]:
+    """Poisson arrivals, lognormal-ish lengths, and shared-prefix groups:
+    `prefix_ratio` of each request's ISL is drawn from one of
+    `num_prefix_groups` shared block chains (hash_ids), the rest unique —
+    the knob the reference's prefix-ratio router benchmarks turn (ref:
+    benchmarks/router/prefix_ratio_benchmark.py)."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1000.0 / max(rate_rps, 1e-6), n)
+    ts = np.cumsum(gaps)
+    records = []
+    next_unique_id = 1_000_000  # unique block ids start far above groups
+    for i in range(n):
+        isl = max(block_size, int(rng.lognormal(np.log(isl_mean), 0.3)))
+        osl = max(1, int(rng.lognormal(np.log(osl_mean), 0.3)))
+        prefix_blocks = int((isl * prefix_ratio) // block_size)
+        total_blocks = max(1, isl // block_size)
+        group = int(rng.integers(num_prefix_groups))
+        hash_ids = [group * 10_000 + b for b in range(prefix_blocks)]
+        for _ in range(total_blocks - prefix_blocks):
+            hash_ids.append(next_unique_id)
+            next_unique_id += 1
+        records.append(TraceRecord(
+            ts_ms=float(ts[i]), isl=isl, osl=osl, hash_ids=hash_ids,
+        ))
+    return records
+
+
+def tokens_for_record(record: TraceRecord, block_size: int,
+                      vocab_size: int = 512) -> list[int]:
+    """Deterministic token ids: each hash_id expands to the same block of
+    tokens everywhere, so shared hash_id prefixes produce identical token
+    prefixes (=> identical chained block hashes => real prefix cache hits)."""
+    tokens: list[int] = []
+    if record.hash_ids:
+        for hash_id in record.hash_ids:
+            rng = np.random.default_rng(hash_id)
+            tokens.extend(
+                int(t) for t in rng.integers(0, vocab_size, block_size))
+    # pad/trim to exactly isl tokens (tail beyond full blocks is unique-ish)
+    if len(tokens) < record.isl:
+        rng = np.random.default_rng(abs(hash((record.ts_ms, record.isl))))
+        tokens.extend(int(t) for t in rng.integers(
+            0, vocab_size, record.isl - len(tokens)))
+    return tokens[: record.isl]
+
+
+# ---------------------------------------------------------------------------
+# Offline replay
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RequestStats:
+    ttft_ms: float
+    total_ms: float
+    output_tokens: int
+    error: Optional[str] = None
+
+    @property
+    def itl_ms(self) -> float:
+        if self.output_tokens <= 1:
+            return 0.0
+        return (self.total_ms - self.ttft_ms) / (self.output_tokens - 1)
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    mode: str
+    requests: int = 0
+    errors: int = 0
+    wall_s: float = 0.0
+    output_tokens: int = 0
+    stats: list[RequestStats] = dataclasses.field(default_factory=list)
+
+    def _pct(self, values: list[float], p: float) -> float:
+        return float(np.percentile(values, p)) if values else 0.0
+
+    def summary(self) -> dict:
+        ttfts = [s.ttft_ms for s in self.stats if s.error is None]
+        itls = [s.itl_ms for s in self.stats
+                if s.error is None and s.output_tokens > 1]
+        return {
+            "mode": self.mode,
+            "requests": self.requests,
+            "errors": self.errors,
+            "wall_s": round(self.wall_s, 3),
+            "output_tokens": self.output_tokens,
+            "tokens_per_s": round(self.output_tokens / self.wall_s, 1)
+                            if self.wall_s else 0.0,
+            "ttft_ms": {"p50": round(self._pct(ttfts, 50), 2),
+                        "p99": round(self._pct(ttfts, 99), 2)},
+            "itl_ms": {"p50": round(self._pct(itls, 50), 2),
+                       "p99": round(self._pct(itls, 99), 2)},
+        }
+
+
+class _CapturePublisher:
+    """Event-plane stand-in: feeds RouterEvents straight into a KvScheduler
+    (what NATS/ZMQ + the frontend subscriber do in live serving, §3.3)."""
+
+    def __init__(self, scheduler: Optional[KvScheduler]) -> None:
+        self.scheduler = scheduler
+
+    async def publish(self, topic: str, payload: dict) -> None:
+        if self.scheduler is None:
+            return
+        if topic.startswith(KV_EVENT_TOPIC):
+            self.scheduler.indexer.apply_event(RouterEvent.from_wire(payload))
+        elif topic.startswith(LOAD_TOPIC):
+            pass  # offline replay tracks load via the scheduler itself
+
+
+class OfflineReplay:
+    """Drive a trace through in-process mocker engines, no network."""
+
+    def __init__(
+        self,
+        mode: str = "single",  # single | agg | disagg
+        num_workers: int = 1,
+        num_prefill_workers: int = 1,
+        router_policy: str = "round_robin",  # round_robin | kv
+        config: Optional[MockerConfig] = None,
+        time_scale: Optional[float] = None,
+    ) -> None:
+        assert mode in ("single", "agg", "disagg")
+        assert router_policy in ("round_robin", "kv")
+        self.mode = mode
+        self.config = config or MockerConfig(speedup_ratio=100.0)
+        # Arrival timeline compresses with the engine speedup so the load
+        # shape (requests per simulated second) is preserved.
+        self.time_scale = (1.0 / self.config.speedup_ratio
+                           if time_scale is None else time_scale)
+        self.router_policy = router_policy
+        n = 1 if mode == "single" else num_workers
+        self.scheduler = (
+            KvScheduler(KvRouterConfig(block_size=self.config.block_size))
+            if router_policy == "kv" else None
+        )
+        publisher = _CapturePublisher(self.scheduler)
+        self.engines = [
+            MockerEngine(dataclasses.replace(self.config), worker_id=i,
+                         event_publisher=publisher)
+            for i in range(n)
+        ]
+        self.prefill_engines = (
+            [MockerEngine(dataclasses.replace(self.config), worker_id=100 + i)
+             for i in range(num_prefill_workers)]
+            if mode == "disagg" else []
+        )
+        self._rr = 0
+
+    def _pick_engine(self, token_ids: list[int]):
+        """Returns (engine, selection) — selection non-None only under the
+        kv policy, where the caller must run the add_request /
+        mark_prefill_completed / free lifecycle (mirrors KvRouterEngine,
+        llm/engine.py)."""
+        if self.scheduler is not None and len(self.engines) > 1:
+            hashes = compute_block_hashes(token_ids, self.config.block_size)
+            result = self.scheduler.select_worker(
+                [WorkerWithDpRank(e.worker_id) for e in self.engines],
+                hashes, len(token_ids),
+            )
+            by_id = {e.worker_id: e for e in self.engines}
+            return by_id[result.worker.worker_id], result
+        engine = self.engines[self._rr % len(self.engines)]
+        self._rr += 1
+        return engine, None
+
+    async def _run_one(self, record: TraceRecord, report: ReplayReport,
+                       index: int) -> None:
+        token_ids = tokens_for_record(record, self.config.block_size,
+                                      self.config.vocab_size)
+        request = PreprocessedRequest(
+            request_id=new_request_id(),
+            token_ids=token_ids,
+            sampling=SamplingOptions(max_tokens=record.osl),
+            stop=StopConditions(ignore_eos=True),
+        )
+        start = time.monotonic()
+        first: Optional[float] = None
+        tokens = 0
+        error: Optional[str] = None
+        try:
+            if self.mode == "disagg":
+                # Prefill leg: round-robin over the prefill pool, max_tokens=1
+                # (ref: PrefillRouter clones the request with max_tokens=1).
+                prefill = self.prefill_engines[
+                    index % len(self.prefill_engines)]
+                prefill_req = dataclasses.replace(
+                    request,
+                    sampling=SamplingOptions(max_tokens=1),
+                    annotations={"prefill_only": True},
+                )
+                params = None
+                async for item in prefill.generate(prefill_req.to_wire()):
+                    kv = item.get("kv")
+                    if kv is not None:
+                        params = kv
+                if params is not None:
+                    request.disaggregated_params = params
+            engine, selection = self._pick_engine(token_ids)
+            if selection is not None:
+                self.scheduler.add_request(request.request_id, selection,
+                                           len(token_ids))
+            try:
+                async for item in engine.generate(request.to_wire()):
+                    if item.get("err"):
+                        error = item["err"]
+                        break
+                    if item.get("t"):
+                        if first is None:
+                            first = time.monotonic()
+                            if selection is not None:
+                                self.scheduler.mark_prefill_completed(
+                                    request.request_id)
+                        tokens += len(item["t"])
+                    if item.get("f") is not None:
+                        break
+            finally:
+                if selection is not None:
+                    self.scheduler.free(request.request_id)
+        except Exception as exc:  # noqa: BLE001 — a failed request is a stat
+            error = repr(exc)
+        total_ms = (time.monotonic() - start) * 1e3
+        report.stats.append(RequestStats(
+            ttft_ms=((first - start) * 1e3 if first else total_ms),
+            total_ms=total_ms,
+            output_tokens=tokens,
+            error=error,
+        ))
+        report.output_tokens += tokens
+        if error is not None:
+            report.errors += 1
+
+    async def run(self, records: list[TraceRecord]) -> ReplayReport:
+        report = ReplayReport(mode=self.mode)
+        t0 = time.monotonic()
+        t0_rec = records[0].ts_ms if records else 0.0
+        tasks = []
+        for i, record in enumerate(records):
+            due = t0 + (record.ts_ms - t0_rec) / 1e3 * self.time_scale
+            delay = due - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            report.requests += 1
+            tasks.append(asyncio.create_task(
+                self._run_one(record, report, i)))
+        await asyncio.gather(*tasks)
+        report.wall_s = time.monotonic() - t0
+        for engine in self.engines + self.prefill_engines:
+            await engine.close()
+        return report
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.mocker.loadgen")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    syn = sub.add_parser("synthesize", help="generate a synthetic trace")
+    syn.add_argument("--out", required=True)
+    syn.add_argument("--num-requests", type=int, default=100)
+    syn.add_argument("--rate-rps", type=float, default=10.0)
+    syn.add_argument("--isl-mean", type=int, default=512)
+    syn.add_argument("--osl-mean", type=int, default=64)
+    syn.add_argument("--prefix-ratio", type=float, default=0.5)
+    syn.add_argument("--prefix-groups", type=int, default=8)
+    syn.add_argument("--seed", type=int, default=0)
+
+    rep = sub.add_parser("replay", help="offline replay through mockers")
+    rep.add_argument("--trace", required=True)
+    rep.add_argument("--mode", default="single",
+                     choices=["single", "agg", "disagg"])
+    rep.add_argument("--workers", type=int, default=2)
+    rep.add_argument("--prefill-workers", type=int, default=1)
+    rep.add_argument("--router-policy", default="round_robin",
+                     choices=["round_robin", "kv"])
+    rep.add_argument("--speedup", type=float, default=100.0)
+    rep.add_argument("--num-blocks", type=int, default=4096)
+    rep.add_argument("--block-size", type=int, default=16)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "synthesize":
+        records = synthesize_trace(
+            args.num_requests, rate_rps=args.rate_rps,
+            isl_mean=args.isl_mean, osl_mean=args.osl_mean,
+            prefix_ratio=args.prefix_ratio,
+            num_prefix_groups=args.prefix_groups, seed=args.seed,
+        )
+        save_trace(args.out, records)
+        print(json.dumps({"written": len(records), "path": args.out}))
+        return
+    records = load_trace(args.trace)
+    replayer = OfflineReplay(
+        mode=args.mode, num_workers=args.workers,
+        num_prefill_workers=args.prefill_workers,
+        router_policy=args.router_policy,
+        config=MockerConfig(speedup_ratio=args.speedup,
+                            num_blocks=args.num_blocks,
+                            block_size=args.block_size),
+    )
+    report = await replayer.run(records)
+    print(json.dumps(report.summary()))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

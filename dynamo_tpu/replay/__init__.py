@@ -85,7 +85,7 @@ async def _send_one(session: aiohttp.ClientSession, url: str, kind: str,
                     result.ok += 1
                 else:
                     result.errors += 1
-    except aiohttp.ClientError as exc:
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
         log.warning("replay request failed: %r", exc)
         result.errors += 1
     finally:
@@ -129,7 +129,13 @@ async def replay(
                 if delay > 0:
                     await asyncio.sleep(delay)
             tasks.append(asyncio.create_task(run_one(event)))
-        await asyncio.gather(*tasks)
+        # return_exceptions: one unexpected failure must not close the
+        # session under the remaining in-flight tasks and lose the run.
+        for res in await asyncio.gather(*tasks, return_exceptions=True):
+            if isinstance(res, BaseException):
+                # _send_one's finally already counted the request itself.
+                log.warning("replay task failed: %r", res)
+                result.errors += 1
     result.wall_s = time.monotonic() - t0
     return result
 
