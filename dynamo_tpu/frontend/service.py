@@ -35,6 +35,7 @@ class Frontend:
         kserve_grpc_port: Optional[int] = None,
         audit_sinks: Optional[str] = None,
         record_path: Optional[str] = None,
+        namespace_filter: Optional[str] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = ModelManager()
@@ -53,7 +54,8 @@ class Frontend:
             ),
         )
         self.watcher = ModelWatcher(
-            runtime, self.manager, router_mode=router_mode, kv_config=kv_config
+            runtime, self.manager, router_mode=router_mode,
+            kv_config=kv_config, namespace_filter=namespace_filter,
         )
         self.http = HttpService(
             self.manager, host=host, port=port, busy_threshold=busy_threshold,
@@ -109,6 +111,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--record", default=None, metavar="PATH",
                         help="record every request + output stream to a "
                              "JSONL file replayable by dynamo_tpu.replay")
+    parser.add_argument("--namespace", default=None,
+                        help="only serve models from this namespace (e.g. "
+                             "'global' to front a global router; default: "
+                             "all namespaces)")
     args = parser.parse_args(argv)
 
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
@@ -123,6 +129,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
         kserve_grpc_port=args.kserve_grpc_port,
         audit_sinks=args.audit_sinks,
         record_path=args.record,
+        namespace_filter=args.namespace,
     )
     await frontend.start()
     log.info("frontend ready on port %d (router=%s)", frontend.port,

@@ -1,0 +1,224 @@
+"""Global planner: cross-deployment scaling coordinator.
+
+The reference's `dynamo.global_planner` (ref: components/src/dynamo/
+global_planner/scale_handler.py) coordinates replica counts ACROSS
+deployments: each pool's local planner plans for its own traffic, while
+the global planner enforces a fleet-wide chip budget and rebalances
+between pools by observed pressure.
+
+Here: subscribes to every pool namespace's load metrics, computes per-pool
+pressure (mean KV usage + queue depth), apportions a global replica budget
+proportionally, and pushes decisions through a per-pool Connector
+(planner.connectors — Virtual for external orchestrators, Kubernetes to
+PATCH a deployment, Callback for tests). Also serves a `scale` endpoint
+for manual cross-pool scaling:
+    {"pool": "ns-a", "component": "backend", "replicas": 3}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import AsyncIterator, Optional
+
+from ..kv_router.protocols import LOAD_TOPIC, LoadMetrics
+from ..planner.connectors import Connector, TargetReplica
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+
+log = get_logger("global_planner")
+
+
+@dataclasses.dataclass
+class PoolState:
+    namespace: str
+    connector: Connector
+    component: str = "backend"
+    replicas: int = 1
+    min_replicas: int = 1
+    # latest LoadMetrics per worker instance
+    workers: dict[int, LoadMetrics] = dataclasses.field(default_factory=dict)
+
+    def pressure(self) -> float:
+        """0..inf — mean KV usage plus queue backlog per worker. The
+        rebalancer gives pools replicas proportional to this."""
+        if not self.workers:
+            return 0.0
+        usage = sum(m.kv_usage for m in self.workers.values())
+        waiting = sum(m.waiting_requests for m in self.workers.values())
+        n = len(self.workers)
+        return usage / n + waiting / max(1, n)
+
+
+class GlobalPlanner:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        pools: list[PoolState],
+        total_replica_budget: int,
+        adjustment_interval: float = 30.0,
+        namespace: str = "global",
+    ) -> None:
+        self.runtime = runtime
+        self.pools = {p.namespace: p for p in pools}
+        self.budget = total_replica_budget
+        self.interval = adjustment_interval
+        self.namespace = namespace
+        self.instance_id = new_instance_id()
+        self._tasks: list[asyncio.Task] = []
+        self._served = None
+        self.decisions: list[dict] = []  # rolling log for observability
+
+    # -- rebalance ----------------------------------------------------------
+
+    def plan(self) -> dict[str, int]:
+        """Apportion the replica budget by pressure, clamped to per-pool
+        minimums. Zero-pressure fleets split the budget evenly (startup)."""
+        pools = list(self.pools.values())
+        pressures = {p.namespace: p.pressure() for p in pools}
+        total = sum(pressures.values())
+        out: dict[str, int] = {}
+        if total <= 0:
+            share = max(1, self.budget // max(1, len(pools)))
+            for p in pools:
+                out[p.namespace] = max(p.min_replicas, share)
+            return out
+        # largest-remainder apportionment under the budget
+        raw = {ns: self.budget * (pr / total) for ns, pr in pressures.items()}
+        floored = {ns: max(self.pools[ns].min_replicas, int(v))
+                   for ns, v in raw.items()}
+        leftover = self.budget - sum(floored.values())
+        if leftover > 0:
+            by_frac = sorted(raw, key=lambda ns: raw[ns] - int(raw[ns]),
+                             reverse=True)
+            for ns in by_frac:
+                if leftover <= 0:
+                    break
+                floored[ns] += 1
+                leftover -= 1
+        return floored
+
+    async def _apply(self, targets: dict[str, int]) -> None:
+        for ns, n in targets.items():
+            pool = self.pools[ns]
+            if n == pool.replicas:
+                continue
+            log.info("global planner: pool %s %d -> %d replicas",
+                     ns, pool.replicas, n)
+            await pool.connector.set_component_replicas(
+                [TargetReplica(component=pool.component,
+                               desired_replicas=n)])
+            pool.replicas = n
+            self.decisions.append({"pool": ns, "component": pool.component,
+                                   "replicas": n})
+
+    async def _plan_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self._apply(self.plan())
+            except Exception:  # noqa: BLE001 — planner must survive a bad
+                # connector (e.g. K8s API hiccup)
+                log.exception("global planner adjustment failed")
+
+    # -- load ingestion -----------------------------------------------------
+
+    async def _ingest_loop(self, pool: PoolState, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                metrics = LoadMetrics.from_wire(payload)
+                pool.workers[metrics.worker_id] = metrics
+            except Exception:  # noqa: BLE001
+                log.exception("bad load metrics in %s", pool.namespace)
+
+    # -- manual scale endpoint (ref: scale_handler.py) ----------------------
+
+    async def _scale(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        ns = (body or {}).get("pool", "")
+        pool = self.pools.get(ns)
+        if pool is None:
+            yield {"error": f"unknown pool {ns!r} "
+                            f"(have: {sorted(self.pools)})"}
+            return
+        try:
+            replicas = int(body["replicas"])
+            component = body.get("component", pool.component)
+            await pool.connector.set_component_replicas(
+                [TargetReplica(component=component,
+                               desired_replicas=replicas)])
+            pool.replicas = replicas
+            self.decisions.append({"pool": ns, "component": component,
+                                   "replicas": replicas, "manual": True})
+        except Exception as exc:  # noqa: BLE001 — report to the caller
+            yield {"error": str(exc)}
+            return
+        yield {"ok": True, "pool": ns, "replicas": replicas}
+
+    async def start(self, serve_endpoint: bool = True,
+                    run_loop: bool = True) -> None:
+        for pool in self.pools.values():
+            # Subscribe BEFORE returning so metrics published right after
+            # start() are never missed.
+            sub = await self.runtime.event_subscriber(
+                pool.namespace, topic_prefix=LOAD_TOPIC)
+            self._tasks.append(
+                asyncio.create_task(self._ingest_loop(pool, sub)))
+        if run_loop:
+            self._tasks.append(asyncio.create_task(self._plan_loop()))
+        if serve_endpoint:
+            endpoint = (
+                self.runtime.namespace(self.namespace)
+                .component("global_planner")
+                .endpoint("scale")
+            )
+            self._served = await endpoint.serve_endpoint(
+                self._scale, instance_id=self.instance_id)
+        log.info("global planner up: pools=%s budget=%d",
+                 sorted(self.pools), self.budget)
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._served is not None:
+            await self._served.shutdown()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..planner.connectors import KubernetesConnector, VirtualConnector
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.global_planner")
+    parser.add_argument("--pool", action="append", required=True,
+                        dest="pools", metavar="NAMESPACE")
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--replica-budget", type=int, required=True,
+                        help="total replicas across all pools")
+    parser.add_argument("--adjustment-interval", type=float, default=30.0)
+    parser.add_argument("--connector", default="virtual",
+                        choices=["virtual", "kubernetes"])
+    parser.add_argument("--k8s-deployment-prefix", default="dynamo-",
+                        help="kubernetes connector: deployment name is "
+                             "<prefix><pool-namespace>")
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    pools = []
+    for ns in args.pools:
+        if args.connector == "kubernetes":
+            connector: Connector = KubernetesConnector(
+                deployment=f"{args.k8s_deployment_prefix}{ns}")
+        else:
+            connector = VirtualConnector(runtime, namespace=ns)
+        pools.append(PoolState(namespace=ns, connector=connector,
+                               component=args.component))
+    planner = GlobalPlanner(runtime, pools, args.replica_budget,
+                            adjustment_interval=args.adjustment_interval)
+    await planner.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await planner.close()
+        await runtime.shutdown()

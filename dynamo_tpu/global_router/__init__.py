@@ -1,0 +1,182 @@
+"""Global router: hierarchical routing across pool namespaces.
+
+The reference's `dynamo.global_router` (ref: components/src/dynamo/
+global_router/{handler,pool_selection}.py, README.md:9-17) sits above
+multiple Dynamo deployments ("pools" — each its own namespace with a
+frontend-less worker fleet), picks a pool per request, and registers
+ITSELF as both a Chat/Completions and a Prefill model so ordinary
+frontends discover and route to it like any worker.
+
+Here: one ModelWatcher per pool namespace maintains a live pipeline to
+that pool's workers (KV events and load metrics flow per-pool exactly as a
+frontend's would); pool selection picks by aggregate load or round-robin;
+the chosen pool's engine streams back through our own `generate` endpoint
+published in the global namespace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import AsyncIterator, Optional
+
+from ..kv_router import KvRouterConfig
+from ..llm.manager import ModelManager, ModelWatcher
+from ..llm.model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard, publish_card
+from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+from ..runtime.push_router import NoInstancesAvailable
+
+log = get_logger("global_router")
+
+POLICIES = ("least_loaded", "round_robin")
+
+
+class Pool:
+    """One downstream deployment: a namespace watched for model cards."""
+
+    def __init__(self, namespace: str, manager: ModelManager,
+                 watcher: ModelWatcher) -> None:
+        self.namespace = namespace
+        self.manager = manager
+        self.watcher = watcher
+
+    def entry(self, model: str):
+        entry, lora = self.manager.resolve(model)
+        return entry
+
+    def load(self, model: str) -> Optional[float]:
+        """Mean published KV usage across the pool's live instances for
+        `model`; None when the pool doesn't serve it (or nothing has
+        published yet — treated as idle by the selector)."""
+        entry = self.entry(model)
+        if entry is None or not entry.instances:
+            return None
+        usages = [entry.worker_usage[i] for i in entry.instances
+                  if i in entry.worker_usage]
+        if not usages:
+            return 0.0
+        return sum(usages) / len(usages)
+
+
+class GlobalRouter:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        pool_namespaces: list[str],
+        served_model: str,
+        global_namespace: str = "global",
+        policy: str = "least_loaded",
+        router_mode: str = "kv",
+        kv_config: Optional[KvRouterConfig] = None,
+    ) -> None:
+        assert policy in POLICIES, f"policy must be one of {POLICIES}"
+        self.runtime = runtime
+        self.served_model = served_model
+        self.policy = policy
+        self.instance_id = new_instance_id()
+        self.pools: list[Pool] = []
+        for ns in pool_namespaces:
+            manager = ModelManager()
+            watcher = ModelWatcher(runtime, manager, router_mode=router_mode,
+                                   kv_config=kv_config,
+                                   namespace_filter=ns)
+            self.pools.append(Pool(ns, manager, watcher))
+        self._rr = itertools.count()
+        # Register as BOTH chat/completions and prefill (ref README: the
+        # global router appears as a Prefill and a Chat model).
+        self.card = ModelDeploymentCard(
+            name=served_model,
+            model_types=[CHAT, COMPLETIONS, PREFILL],
+            namespace=global_namespace,
+            component="global_router",
+            endpoint="generate",
+        )
+        self._served = None
+
+    # -- pool selection (ref: pool_selection.py) ---------------------------
+
+    def select_pool(self, model: str) -> Optional[Pool]:
+        serving = [p for p in self.pools if p.entry(model) is not None]
+        if not serving:
+            return None
+        if self.policy == "round_robin" or len(serving) == 1:
+            return serving[next(self._rr) % len(serving)]
+        # least_loaded: idle pools (no published metrics yet) sort first.
+        return min(serving, key=lambda p: p.load(model) or 0.0)
+
+    # -- serving ------------------------------------------------------------
+
+    async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        request = PreprocessedRequest.from_wire(body)
+        model = request.model or self.served_model
+        pool = self.select_pool(model)
+        if pool is None:
+            yield EngineOutput(
+                finish_reason="error",
+                error=f"no pool serves model {model!r}",
+            ).to_wire()
+            return
+        entry = pool.entry(model)
+        try:
+            async for output in entry.engine.generate(request):
+                yield output.to_wire()
+        except NoInstancesAvailable:
+            yield EngineOutput(
+                finish_reason="error",
+                error=f"pool {pool.namespace} has no live instances",
+            ).to_wire()
+
+    async def start(self) -> None:
+        for pool in self.pools:
+            await pool.watcher.start()
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint(self.card.endpoint)
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.generate, instance_id=self.instance_id)
+        await publish_card(self.runtime, self.card, self.instance_id)
+        log.info("global router serving %s over pools %s (policy=%s)",
+                 self.served_model,
+                 [p.namespace for p in self.pools], self.policy)
+
+    async def close(self) -> None:
+        if self._served is not None:
+            await self._served.shutdown()
+        for pool in self.pools:
+            await pool.watcher.close()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.global_router")
+    parser.add_argument("--pool", action="append", required=True,
+                        dest="pools", metavar="NAMESPACE",
+                        help="pool namespace to route over (repeatable)")
+    parser.add_argument("--model", required=True,
+                        help="model name this router serves")
+    parser.add_argument("--namespace", default="global")
+    parser.add_argument("--policy", default="least_loaded", choices=POLICIES)
+    parser.add_argument("--router-mode", default="kv",
+                        choices=["round_robin", "random", "p2c", "kv"],
+                        help="intra-pool routing mode")
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    router = GlobalRouter(
+        runtime, args.pools, args.model,
+        global_namespace=args.namespace, policy=args.policy,
+        router_mode=args.router_mode,
+    )
+    await router.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await router.close()
+        await runtime.shutdown()

@@ -127,11 +127,11 @@ class AuditBus:
                 except Exception:  # noqa: BLE001 — one bad sink can't stop
                     log.exception("audit sink failed")
 
-    async def close(self) -> None:
+    async def close(self, drain_timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + drain_timeout
         if self._task is not None and not self._task.done():
             # Let the pump drain what's queued (bounded — a wedged sink
             # must not hang shutdown), then stop it.
-            deadline = time.monotonic() + 5.0
             while not self._queue.empty() and time.monotonic() < deadline:
                 await asyncio.sleep(0.01)
             self._task.cancel()
@@ -140,18 +140,23 @@ class AuditBus:
             except asyncio.CancelledError:
                 pass
         else:
-            # Pump never started (or died): flush queued records directly
-            # so close() can't spin on a consumer-less queue.
-            while not self._queue.empty():
+            # Pump never started (or died): flush queued records directly —
+            # under the same deadline — so close() can't spin or hang on a
+            # consumer-less queue / wedged sink.
+            while not self._queue.empty() and time.monotonic() < deadline:
                 record = self._queue.get_nowait()
                 for sink in self.sinks:
                     try:
                         sink.write(record)
                     except Exception:  # noqa: BLE001
                         log.exception("audit sink failed")
+        # Whatever the deadline left behind is LOST — say so.
+        while not self._queue.empty():
+            self._queue.get_nowait()
+            self.dropped += 1
         if self.dropped:
-            log.warning("audit bus dropped %d records (queue overflow)",
-                        self.dropped)
+            log.warning("audit bus dropped %d records (queue overflow or "
+                        "shutdown deadline)", self.dropped)
         for sink in self.sinks:
             try:
                 sink.close()

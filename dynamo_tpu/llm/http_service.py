@@ -197,6 +197,44 @@ class HttpService:
                 latency_ms=((time.monotonic() - start) * 1e3 if start else 0.0),
             ))
 
+    async def _consume(
+        self, entry: ModelEntry, preprocessed: PreprocessedRequest,
+        delta_gen: DeltaGenerator, observe_latency: bool = False,
+    ) -> Optional[web.Response]:
+        """Drive the engine stream to completion through `delta_gen`.
+        Returns an error Response, or None on success. Shared by every
+        non-streaming handler so error mapping stays in one place."""
+        model = preprocessed.model
+        start = time.monotonic()
+        first_token_at: Optional[float] = None
+        last_token_at: Optional[float] = None
+        try:
+            async for output in self._generate(entry, preprocessed):
+                if observe_latency and output.token_ids:
+                    now = time.monotonic()
+                    if first_token_at is None:
+                        first_token_at = now
+                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(
+                            now - start)
+                    elif last_token_at is not None:
+                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
+                            (now - last_token_at)
+                            / max(1, len(output.token_ids)))
+                    last_token_at = now
+                delta_gen.on_output(output)
+                if output.error:
+                    return web.json_response(
+                        _error_body(502, output.error, "engine_error"),
+                        status=502)
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no workers available", "overloaded"),
+                status=503)
+        except RemoteError as exc:
+            return web.json_response(
+                _error_body(502, str(exc), "engine_error"), status=502)
+        return None
+
     async def _generate(
         self, entry: ModelEntry, preprocessed: PreprocessedRequest
     ) -> AsyncIterator[EngineOutput]:
@@ -215,33 +253,12 @@ class HttpService:
     ) -> web.Response:
         model = preprocessed.model
         start = time.monotonic()
-        first_token_at: Optional[float] = None
-        last_token_at: Optional[float] = None
         status = "error"
         try:
-            try:
-                async for output in self._generate(entry, preprocessed):
-                    if output.token_ids:
-                        now = time.monotonic()
-                        if first_token_at is None:
-                            first_token_at = now
-                            rt_metrics.TTFT_SECONDS.labels(model=model).observe(
-                                now - start)
-                        elif last_token_at is not None:
-                            rt_metrics.ITL_SECONDS.labels(model=model).observe(
-                                (now - last_token_at)
-                                / max(1, len(output.token_ids)))
-                        last_token_at = now
-                    delta_gen.on_output(output)
-                    if output.error:
-                        return web.json_response(
-                            _error_body(502, output.error, "engine_error"), status=502)
-            except NoInstancesAvailable:
-                return web.json_response(
-                    _error_body(503, "no workers available", "overloaded"), status=503)
-            except RemoteError as exc:
-                return web.json_response(
-                    _error_body(502, str(exc), "engine_error"), status=502)
+            err = await self._consume(entry, preprocessed, delta_gen,
+                                      observe_latency=True)
+            if err is not None:
+                return err
             rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
                 delta_gen.completion_tokens)
             status = "ok"
@@ -500,20 +517,9 @@ class HttpService:
         start = time.monotonic()
         status = "error"
         try:
-            try:
-                async for output in self._generate(entry, preprocessed):
-                    delta_gen.on_output(output)
-                    if output.error:
-                        return web.json_response(
-                            _error_body(502, output.error, "engine_error"),
-                            status=502)
-            except NoInstancesAvailable:
-                return web.json_response(
-                    _error_body(503, "no workers available", "overloaded"),
-                    status=503)
-            except RemoteError as exc:
-                return web.json_response(
-                    _error_body(502, str(exc), "engine_error"), status=502)
+            err = await self._consume(entry, preprocessed, delta_gen)
+            if err is not None:
+                return err
             status = "ok"
         finally:
             self._count_request(model, status, start,
@@ -697,20 +703,9 @@ class HttpService:
         start = time.monotonic()
         status = "error"
         try:
-            try:
-                async for output in self._generate(entry, preprocessed):
-                    delta_gen.on_output(output)
-                    if output.error:
-                        return web.json_response(
-                            _error_body(502, output.error, "engine_error"),
-                            status=502)
-            except NoInstancesAvailable:
-                return web.json_response(
-                    _error_body(503, "no workers available", "overloaded"),
-                    status=503)
-            except RemoteError as exc:
-                return web.json_response(
-                    _error_body(502, str(exc), "engine_error"), status=502)
+            err = await self._consume(entry, preprocessed, delta_gen)
+            if err is not None:
+                return err
             status = "ok"
         finally:
             self._count_request(model, status, start,

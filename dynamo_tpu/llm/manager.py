@@ -25,7 +25,7 @@ from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
 from .engine import KvRouterEngine, Migration, RouterEngine, TokenEngine
-from .model_card import PREFILL, ModelDeploymentCard
+from .model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard
 from .prefill_router import PrefillPool, PrefillRouterEngine
 from .preprocessor import OpenAIPreprocessor
 
@@ -109,11 +109,15 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: str = "round_robin",
         kv_config: Optional[KvRouterConfig] = None,
+        namespace_filter: Optional[str] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.kv_config = kv_config
+        # Only track cards in this namespace (the global router runs one
+        # watcher per pool namespace; a frontend watches everything).
+        self.namespace_filter = namespace_filter
         self._watch = None
         self._tasks: list[asyncio.Task] = []
         # model name -> prefill worker pool (disagg; ref prefill_router/
@@ -162,10 +166,17 @@ class ModelWatcher:
 
     async def _handle_put(self, key: str, value: dict) -> None:
         subject, instance_id = self._parse_key(key)
+        if (self.namespace_filter is not None
+                and subject.split("/", 1)[0] != self.namespace_filter):
+            return
         card = ModelDeploymentCard.from_wire(value)
         if PREFILL in card.model_types:
             await self._handle_prefill_put(card, subject, instance_id)
-            return
+            if not ({CHAT, COMPLETIONS} & set(card.model_types)):
+                return
+            # Dual-role card (e.g. the global router registers as BOTH a
+            # prefill and a chat model, ref: global_router/README): fall
+            # through to normal model registration too.
         entry = self.manager.get(card.name)
         if entry is None:
             entry = self._build_entry(card)
@@ -220,6 +231,9 @@ class ModelWatcher:
 
     async def _handle_delete(self, key: str) -> None:
         subject, instance_id = self._parse_key(key)
+        if (self.namespace_filter is not None
+                and subject.split("/", 1)[0] != self.namespace_filter):
+            return
         name = self._prefill_subjects.get(subject)
         if name is not None:
             pool = self._prefill_pools.get(name)
@@ -230,7 +244,8 @@ class ModelWatcher:
                     self._prefill_pools.pop(name, None)
                     self._prefill_subjects.pop(subject, None)
                     await pool.router.client.close()
-            return
+            # No return: a dual-role card's subject may ALSO back a chat
+            # entry (global router) — fall through and drain that too.
         for entry in self.manager.entries():
             if entry.card.endpoint_subject == subject:
                 entry.instances.discard(instance_id)

