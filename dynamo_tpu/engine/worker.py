@@ -65,6 +65,11 @@ class KvEventBuffer:
         self._lock = threading.Lock()
         self._pending: list[RouterEvent] = []
         self._event_id = 0
+        # Queryable record of this worker's blocks — the router's resync/
+        # bootstrap source (kv_router/local_indexer.py).
+        from ..kv_router.local_indexer import LocalKvIndexer
+
+        self.local_index = LocalKvIndexer(worker_id, dp_rank)
 
     def on_stored(self, hashes: list[int], parent: Optional[int]) -> None:
         with self._lock:
@@ -74,6 +79,7 @@ class KvEventBuffer:
                 stored=KvCacheStored(block_hashes=list(hashes),
                                      parent_hash=parent),
             ))
+            self.local_index.on_stored(self._event_id, list(hashes), parent)
             self._event_id += 1
 
     def on_removed(self, hashes: list[int]) -> None:
@@ -83,6 +89,7 @@ class KvEventBuffer:
                 dp_rank=self.dp_rank,
                 removed=KvCacheRemoved(block_hashes=list(hashes)),
             ))
+            self.local_index.on_removed(self._event_id, list(hashes))
             self._event_id += 1
 
     def on_cleared(self) -> None:
@@ -92,6 +99,7 @@ class KvEventBuffer:
                 worker_id=self.worker_id, event_id=self._event_id,
                 dp_rank=self.dp_rank, cleared=True,
             ))
+            self.local_index.on_cleared(self._event_id)
             self._event_id += 1
 
     def drain(self) -> list[RouterEvent]:
@@ -159,12 +167,16 @@ class TpuWorker:
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
         )
+        # Routers bootstrap/gap-resync from our local indexer (manager.py
+        # gates resync RPCs on this flag).
+        self.card.runtime_config["kv_blocks_endpoint"] = True
         self._tasks: list[asyncio.Task] = []
         self._lora_served: list = []
         self._served = None
         self._clear_served = None
         self._pull_served = None
         self._scale_served = None
+        self._kvq_served = None
         self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
@@ -217,6 +229,16 @@ class TpuWorker:
         self._clear_served = await clear_ep.serve_endpoint(
             self._clear_kv, instance_id=self.instance_id
         )
+        # Local-indexer query endpoint: routers bootstrap / gap-resync from
+        # here (ref: kv_router/worker_query.rs).
+        kvq_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("kv_blocks")
+        )
+        self._kvq_served = await kvq_ep.serve_endpoint(
+            self._kv_blocks, instance_id=self.instance_id
+        )
         if self.mode == "prefill":
             pull_ep = (
                 self.runtime.namespace(self.card.namespace)
@@ -263,6 +285,9 @@ class TpuWorker:
         cleared = self.scheduler.pool.clear()
         self.events.on_cleared()
         yield {"cleared_blocks": len(cleared)}
+
+    async def _kv_blocks(self, body, ctx=None) -> AsyncIterator[dict]:
+        yield self.events.local_index.dump()
 
     async def _scale_elastic(self, body, ctx=None) -> AsyncIterator[dict]:
         """Re-place params on a new dp/tp/sp/ep mesh split at runtime.
@@ -573,7 +598,8 @@ class TpuWorker:
         # Endpoints drain BEFORE the scheduler stops — in-flight generate/
         # scale requests need a live scheduler loop to ever finish.
         for served in (self._served, self._clear_served, self._pull_served,
-                       self._scale_served, *self._lora_served):
+                       self._scale_served, self._kvq_served,
+                       *self._lora_served):
             if served is not None:
                 await served.shutdown()
         if self.kvbm is not None:

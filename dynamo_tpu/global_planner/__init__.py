@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 from typing import AsyncIterator, Optional
 
 from ..kv_router.protocols import LOAD_TOPIC, LoadMetrics
@@ -36,16 +37,27 @@ class PoolState:
     component: str = "backend"
     replicas: int = 1
     min_replicas: int = 1
-    # latest LoadMetrics per worker instance
-    workers: dict[int, LoadMetrics] = dataclasses.field(default_factory=dict)
+    # seconds after which a worker's last LoadMetrics stops counting (a
+    # dead/restarted worker must not skew pressure forever)
+    metrics_ttl: float = 60.0
+    # worker instance -> (latest LoadMetrics, monotonic receipt time)
+    workers: dict[int, tuple[LoadMetrics, float]] = dataclasses.field(
+        default_factory=dict)
+
+    def record(self, metrics: LoadMetrics) -> None:
+        self.workers[metrics.worker_id] = (metrics, time.monotonic())
 
     def pressure(self) -> float:
-        """0..inf — mean KV usage plus queue backlog per worker. The
+        """0..inf — mean KV usage plus queue backlog per live worker. The
         rebalancer gives pools replicas proportional to this."""
+        cutoff = time.monotonic() - self.metrics_ttl
+        stale = [iid for iid, (_, ts) in self.workers.items() if ts < cutoff]
+        for iid in stale:
+            del self.workers[iid]
         if not self.workers:
             return 0.0
-        usage = sum(m.kv_usage for m in self.workers.values())
-        waiting = sum(m.waiting_requests for m in self.workers.values())
+        usage = sum(m.kv_usage for m, _ in self.workers.values())
+        waiting = sum(m.waiting_requests for m, _ in self.workers.values())
         n = len(self.workers)
         return usage / n + waiting / max(1, n)
 
@@ -77,25 +89,41 @@ class GlobalPlanner:
         pools = list(self.pools.values())
         pressures = {p.namespace: p.pressure() for p in pools}
         total = sum(pressures.values())
-        out: dict[str, int] = {}
+        mins = {p.namespace: p.min_replicas for p in pools}
         if total <= 0:
-            share = max(1, self.budget // max(1, len(pools)))
-            for p in pools:
-                out[p.namespace] = max(p.min_replicas, share)
+            # Idle fleet: start everyone at its minimum, spread the rest
+            # round-robin — never past the budget (mins themselves may
+            # exceed it; minimums win, see below).
+            out = dict(mins)
+            extra = self.budget - sum(out.values())
+            names = sorted(out)
+            i = 0
+            while extra > 0 and names:
+                out[names[i % len(names)]] += 1
+                i += 1
+                extra -= 1
             return out
-        # largest-remainder apportionment under the budget
+        # Largest-remainder apportionment under the budget.
         raw = {ns: self.budget * (pr / total) for ns, pr in pressures.items()}
-        floored = {ns: max(self.pools[ns].min_replicas, int(v))
-                   for ns, v in raw.items()}
+        floored = {ns: max(mins[ns], int(v)) for ns, v in raw.items()}
         leftover = self.budget - sum(floored.values())
-        if leftover > 0:
-            by_frac = sorted(raw, key=lambda ns: raw[ns] - int(raw[ns]),
-                             reverse=True)
-            for ns in by_frac:
-                if leftover <= 0:
-                    break
-                floored[ns] += 1
-                leftover -= 1
+        by_frac = sorted(raw, key=lambda ns: raw[ns] - int(raw[ns]),
+                         reverse=True)
+        for ns in by_frac:
+            if leftover <= 0:
+                break
+            floored[ns] += 1
+            leftover -= 1
+        # Min-replica clamping can overshoot the budget: reclaim from the
+        # pools furthest above their minimum. If every pool is at its
+        # minimum the overshoot stands — minimums are a liveness floor, the
+        # budget a target (sum(min_replicas) > budget is operator error).
+        while sum(floored.values()) > self.budget:
+            candidates = [ns for ns in floored if floored[ns] > mins[ns]]
+            if not candidates:
+                break
+            victim = max(candidates, key=lambda ns: floored[ns] - mins[ns])
+            floored[victim] -= 1
         return floored
 
     async def _apply(self, targets: dict[str, int]) -> None:
@@ -126,8 +154,7 @@ class GlobalPlanner:
     async def _ingest_loop(self, pool: PoolState, sub) -> None:
         async for _topic, payload in sub:
             try:
-                metrics = LoadMetrics.from_wire(payload)
-                pool.workers[metrics.worker_id] = metrics
+                pool.record(LoadMetrics.from_wire(payload))
             except Exception:  # noqa: BLE001
                 log.exception("bad load metrics in %s", pool.namespace)
 

@@ -1,0 +1,186 @@
+"""Standalone KV indexer service.
+
+The reference runs the radix indexer as its own service so multiple router
+replicas share one view and new replicas bootstrap instantly (ref:
+lib/kv-router/src/standalone_indexer/{registry,listener,server}.rs; exposed
+as `dynamo.indexer`). This is the same idea over our planes:
+
+  * subscribes to the namespace's KV event stream and maintains a radix
+    tree (gap recovery by querying the owning worker's `kv_blocks`
+    endpoint, exactly like a frontend router does);
+  * serves `find_matches` — block hashes in, {worker_id: overlap} out —
+    so lightweight clients (gateways, global routers) can make KV-aware
+    decisions without holding radix state;
+  * serves `dump` — full per-worker state — so a (re)starting router can
+    bootstrap from the indexer instead of querying every worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+from ..kv_router import KV_EVENT_TOPIC, RouterEvent, WorkerWithDpRank
+from ..kv_router.indexer import make_radix_tree
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.discovery import MODEL_CARD_PREFIX
+from ..runtime.logging import get_logger
+
+log = get_logger("indexer")
+
+
+class StandaloneIndexer:
+    def __init__(self, runtime: DistributedRuntime, namespace: str = "dynamo",
+                 component: str = "indexer") -> None:
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.instance_id = new_instance_id()
+        self.tree = make_radix_tree()
+        self._tasks: list[asyncio.Task] = []
+        self._served: list = []
+        # worker_id -> (namespace, component) for resync targeting
+        self._worker_subjects: dict[int, tuple[str, str]] = {}
+        self._resyncing: set[int] = set()
+        self._watch = None
+
+    # -- event ingestion ---------------------------------------------------
+
+    async def _event_loop(self, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                event = RouterEvent.from_wire(payload)
+                status = self.tree.apply_event(event)
+                if status == "gap":
+                    self._schedule_resync(event.worker_id)
+            except Exception:  # noqa: BLE001
+                log.exception("bad kv event")
+
+    # -- card watch (to know where each worker's kv_blocks endpoint lives) --
+
+    async def _watch_loop(self) -> None:
+        async for event in self._watch:
+            try:
+                parts = event.key.split("/")
+                ns, component, _endpoint, instance_id = parts[2:6]
+                if ns != self.namespace:
+                    continue
+                iid = int(instance_id)
+                if event.kind == "put":
+                    if iid not in self._worker_subjects:
+                        self._worker_subjects[iid] = (ns, component)
+                        self._schedule_resync(iid)  # bootstrap
+                elif event.kind == "delete":
+                    self._worker_subjects.pop(iid, None)
+                    self.tree.remove_worker_id(iid)
+            except Exception:  # noqa: BLE001
+                log.exception("indexer watch failed on %s", event.key)
+
+    def _schedule_resync(self, worker_id: int) -> None:
+        if worker_id in self._resyncing:
+            return
+        subject = self._worker_subjects.get(worker_id)
+        if subject is None:
+            return
+        self._resyncing.add(worker_id)
+        self._tasks.append(
+            asyncio.create_task(self._resync(worker_id, subject)))
+
+    async def _resync(self, worker_id: int,
+                      subject: tuple[str, str]) -> None:
+        ns, component = subject
+        client = (self.runtime.namespace(ns).component(component)
+                  .endpoint("kv_blocks").client())
+        try:
+            await client.start()
+            await client.wait_for_instances(1, timeout=10)
+            async for dump in client.direct({}, worker_id):
+                worker = WorkerWithDpRank(dump["worker_id"],
+                                          dump.get("dp_rank", 0))
+                pairs = [(p, h) for p, h in dump.get("blocks", [])]
+                self.tree.load_worker(worker, pairs,
+                                      dump.get("last_event_id"))
+                log.info("indexer resynced worker %x: %d blocks",
+                         worker_id, len(pairs))
+                break
+        except Exception:  # noqa: BLE001 — best-effort; a later gap retries
+            log.exception("indexer resync failed for %x", worker_id)
+        finally:
+            self._resyncing.discard(worker_id)
+            await client.close()
+
+    # -- query endpoints ----------------------------------------------------
+
+    async def _find_matches(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        hashes = [int(h) for h in (body or {}).get("block_hashes", [])]
+        overlap = self.tree.find_matches(hashes)
+        yield {
+            "matches": [
+                {"worker_id": w.worker_id, "dp_rank": w.dp_rank,
+                 "overlap_blocks": n,
+                 "tree_size": overlap.tree_sizes.get(w, 0)}
+                for w, n in overlap.scores.items()
+            ],
+            "total_nodes": self.tree.total_nodes(),
+        }
+
+    async def _dump(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        """Full per-worker state — a router bootstrap source."""
+        workers = []
+        for worker, count in self.tree.worker_block_counts().items():
+            pairs = self.tree.dump_worker(worker)
+            workers.append({
+                "worker_id": worker.worker_id, "dp_rank": worker.dp_rank,
+                "blocks": [[p, h] for p, h in pairs],
+                "block_count": count,
+            })
+        yield {"workers": workers, "total_nodes": self.tree.total_nodes()}
+
+    async def start(self) -> None:
+        sub = await self.runtime.event_subscriber(
+            self.namespace, topic_prefix=KV_EVENT_TOPIC)
+        self._tasks.append(asyncio.create_task(self._event_loop(sub)))
+        self._watch = await self.runtime.discovery.watch_prefix(
+            MODEL_CARD_PREFIX + "/")
+        self._tasks.append(asyncio.create_task(self._watch_loop()))
+        for name, handler in (("find_matches", self._find_matches),
+                              ("dump", self._dump)):
+            endpoint = (
+                self.runtime.namespace(self.namespace)
+                .component(self.component)
+                .endpoint(name)
+            )
+            self._served.append(await endpoint.serve_endpoint(
+                handler, instance_id=self.instance_id))
+        log.info("standalone indexer up on %s/%s (instance=%x)",
+                 self.namespace, self.component, self.instance_id)
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._watch is not None:
+            await self._watch.cancel()
+        for served in self._served:
+            await served.shutdown()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.indexer")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="indexer")
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    indexer = StandaloneIndexer(runtime, namespace=args.namespace,
+                                component=args.component)
+    await indexer.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await indexer.close()
+        await runtime.shutdown()

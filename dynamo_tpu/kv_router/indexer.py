@@ -72,6 +72,11 @@ class RadixTree:
         worker = WorkerWithDpRank(event.worker_id, event.dp_rank)
         status = "ok"
         last = self._last_event_id.get(worker)
+        if last is not None and event.event_id <= last:
+            # Duplicate / already-reflected delivery (at-least-once event
+            # plane, or an event that raced a resync dump): skip — applying
+            # it could resurrect removed blocks.
+            return "stale"
         if last is not None and event.event_id != last + 1:
             self.gap_count += 1
             status = "gap"
@@ -229,6 +234,11 @@ class NativeRadixTree:
         worker = WorkerWithDpRank(event.worker_id, event.dp_rank)
         status = "ok"
         last = self._last_event_id.get(worker)
+        if last is not None and event.event_id <= last:
+            # Duplicate / already-reflected delivery (at-least-once event
+            # plane, or an event that raced a resync dump): skip — applying
+            # it could resurrect removed blocks.
+            return "stale"
         if last is not None and event.event_id != last + 1:
             self.gap_count += 1
             status = "gap"

@@ -20,6 +20,7 @@ from ..kv_router import (
     KvScheduler,
     LoadMetrics,
     RouterEvent,
+    WorkerWithDpRank,
 )
 from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
@@ -126,6 +127,11 @@ class ModelWatcher:
         # so lease-expiry deletes drain the right pool.
         self._prefill_pools: dict[str, PrefillPool] = {}
         self._prefill_subjects: dict[str, str] = {}
+        # (subject, worker_id) -> events buffered while a resync RPC is in
+        # flight for that worker; replayed (ids beyond the dump) after the
+        # snapshot loads — the classic snapshot+replay pattern, so live
+        # traffic during the RPC window can neither be lost nor re-applied.
+        self._resyncing: dict = {}
         # namespace -> entries fed by that namespace's event stream; the
         # list is shared with the running _event_loop so late-registered
         # models start receiving events immediately.
@@ -193,12 +199,22 @@ class ModelWatcher:
                 "model %s already served at %s; ignoring instance at %s",
                 card.name, entry.card.endpoint_subject, subject)
             return
+        newly_seen = instance_id not in entry.instances
         entry.instances.add(instance_id)
         # Per-instance adapter list (cards republish on LoRA load/unload);
         # never overwrite the entry card wholesale — with multiple instances
         # the last publisher would clobber the others' state.
         entry.instance_loras[instance_id] = list(
             card.runtime_config.get("loras", []))
+        if (newly_seen and entry.scheduler is not None
+                and card.runtime_config.get("kv_blocks_endpoint")):
+            # Bootstrap this worker's radix state from its local indexer
+            # (ref: router-design.md — "on worker discovery it dumps full
+            # state"; this is also what lets a RESTARTED router recover
+            # routing state without a durable event log). Gated on the card
+            # advertising the kv_blocks endpoint — proxies like the global
+            # router don't serve one.
+            self._schedule_resync(entry, instance_id, reason="discovered")
 
     async def _handle_prefill_put(
         self, card: ModelDeploymentCard, subject: str, instance_id: int
@@ -260,6 +276,63 @@ class ModelWatcher:
                     if entry in entries:
                         entries.remove(entry)
                     await entry.router.client.close()
+
+    # -- worker state resync (bootstrap + gap recovery) --------------------
+
+    def _schedule_resync(self, entry: ModelEntry, instance_id: int,
+                         reason: str) -> None:
+        key = (entry.card.endpoint_subject, instance_id)
+        if key in self._resyncing:
+            return
+        self._resyncing[key] = []  # event buffer; _event_loop fills it
+        task = asyncio.create_task(
+            self._resync_worker(entry, instance_id, reason, key))
+        self._tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._tasks.remove(t) if t in self._tasks else None)
+
+    async def _resync_worker(self, entry: ModelEntry, instance_id: int,
+                             reason: str, key) -> None:
+        card = entry.card
+        client = (
+            self.runtime.namespace(card.namespace)
+            .component(card.component)
+            .endpoint("kv_blocks")
+            .client()
+        )
+        try:
+            await client.start()
+            await client.wait_for_instances(1, timeout=10)
+            async for dump in client.direct({}, instance_id):
+                worker = WorkerWithDpRank(dump["worker_id"],
+                                          dump.get("dp_rank", 0))
+                pairs = [(p, h) for p, h in dump.get("blocks", [])]
+                dump_last = dump.get("last_event_id")
+                entry.scheduler.indexer.load_worker(worker, pairs, dump_last)
+                # Replay events that arrived during the RPC. Anything the
+                # dump already reflects (id <= dump_last) is skipped by the
+                # indexer's stale check; newer ones apply in order. No await
+                # between pop and replay, so no event can slip past both.
+                buffered = self._resyncing.pop(key, [])
+                for event in buffered:
+                    entry.scheduler.indexer.apply_event(event)
+                log.info("resynced worker %x for %s (%s): %d blocks, "
+                         "%d events replayed", instance_id, card.name,
+                         reason, len(pairs), len(buffered))
+                break
+        except Exception:  # noqa: BLE001 — resync is best-effort; events
+            # keep flowing and a later gap retries
+            log.exception("kv resync failed for %x (%s)", instance_id, reason)
+        finally:
+            # Failure path: don't drop what was buffered — apply it (the
+            # first event will re-flag a gap on the next live event if the
+            # stream is still inconsistent). Success path already popped.
+            for event in self._resyncing.pop(key, []):
+                try:
+                    entry.scheduler.indexer.apply_event(event)
+                except Exception:  # noqa: BLE001
+                    log.exception("buffered event replay failed")
+            await client.close()
 
     def _build_entry(self, card: ModelDeploymentCard) -> ModelEntry:
         endpoint = (
@@ -323,8 +396,24 @@ class ModelWatcher:
                 if topic.startswith(KV_EVENT_TOPIC):
                     event = RouterEvent.from_wire(payload)
                     for entry in entries:
-                        if entry.scheduler is not None:
-                            entry.scheduler.indexer.apply_event(event)
+                        if entry.scheduler is None:
+                            continue
+                        key = (entry.card.endpoint_subject, event.worker_id)
+                        buffer = self._resyncing.get(key)
+                        if buffer is not None:
+                            # Resync in flight: hold this worker's events
+                            # for replay after the snapshot loads.
+                            buffer.append(event)
+                            continue
+                        status = entry.scheduler.indexer.apply_event(event)
+                        if (status == "gap"
+                                and event.worker_id in entry.instances
+                                and entry.card.runtime_config.get(
+                                    "kv_blocks_endpoint")):
+                            # Missed events: replace this worker's view
+                            # from its local indexer (ref: worker_query).
+                            self._schedule_resync(entry, event.worker_id,
+                                                  reason="gap")
                 elif topic.startswith(LOAD_TOPIC):
                     metrics = LoadMetrics.from_wire(payload)
                     for entry in entries:

@@ -150,6 +150,9 @@ class MockerEngine:
     ) -> None:
         self.config = config or MockerConfig()
         self.worker_id = worker_id
+        from ..kv_router.local_indexer import LocalKvIndexer
+
+        self.local_index = LocalKvIndexer(worker_id, self.config.dp_rank)
         self.kv = _PagedKvCache(self.config.num_blocks)
         self._waiting: list[_Sequence] = []
         self._running: list[_Sequence] = []
@@ -164,26 +167,30 @@ class MockerEngine:
     # -- events ------------------------------------------------------------
 
     async def _publish_stored(self, hashes: list[int], parent: Optional[int]) -> None:
-        if self._publisher is None or not hashes:
+        if not hashes:
             return
+        self.local_index.on_stored(self._event_id, list(hashes), parent)
         event = RouterEvent(
             worker_id=self.worker_id, event_id=self._event_id,
             dp_rank=self.config.dp_rank,
             stored=KvCacheStored(block_hashes=hashes, parent_hash=parent),
         )
         self._event_id += 1
-        await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
+        if self._publisher is not None:
+            await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
 
     async def _publish_removed(self, hashes: list[int]) -> None:
-        if self._publisher is None or not hashes:
+        if not hashes:
             return
+        self.local_index.on_removed(self._event_id, list(hashes))
         event = RouterEvent(
             worker_id=self.worker_id, event_id=self._event_id,
             dp_rank=self.config.dp_rank,
             removed=KvCacheRemoved(block_hashes=hashes),
         )
         self._event_id += 1
-        await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
+        if self._publisher is not None:
+            await self._publisher.publish(KV_EVENT_TOPIC, event.to_wire())
 
     async def publish_load(self) -> None:
         if self._publisher is None:

@@ -66,10 +66,12 @@ class MockerWorker:
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
         )
+        self.card.runtime_config["kv_blocks_endpoint"] = True
         self.engine: Optional[MockerEngine] = None
         self._load_task: Optional[asyncio.Task] = None
         self._load_interval = load_publish_interval
         self._served = None
+        self._kvq_served = None
 
     async def start(self) -> None:
         publisher = self.runtime.event_publisher(self.card.namespace)
@@ -84,6 +86,17 @@ class MockerWorker:
             self.engine.generate, instance_id=self.instance_id,
             health_check_payload=_canary_request(),
         )
+
+        async def kv_blocks(body, ctx=None):
+            yield self.engine.local_index.dump()
+
+        kvq_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("kv_blocks")
+        )
+        self._kvq_served = await kvq_ep.serve_endpoint(
+            kv_blocks, instance_id=self.instance_id)
         await publish_card(self.runtime, self.card, self.instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         log.info("mocker worker up: model=%s instance=%x blocks=%d",
@@ -106,8 +119,9 @@ class MockerWorker:
                 pass
         if self.engine is not None:
             await self.engine.close()
-        if self._served is not None:
-            await self._served.shutdown()
+        for served in (self._served, self._kvq_served):
+            if served is not None:
+                await served.shutdown()
 
 
 async def main(argv: Optional[list[str]] = None) -> None:

@@ -1,0 +1,247 @@
+"""Worker local indexer, router bootstrap/gap-resync, and the standalone
+indexer service (ref surface: lib/kv-router standalone_indexer/, kv_router/
+worker_query.rs, router-design.md "How gap detection works" + JetStream-mode
+restart recovery — ours recovers from worker local indexers instead of a
+durable log)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.indexer import StandaloneIndexer
+from dynamo_tpu.kv_router import RouterEvent, WorkerWithDpRank
+from dynamo_tpu.kv_router.local_indexer import LocalKvIndexer
+from dynamo_tpu.kv_router.protocols import KvCacheStored
+from dynamo_tpu.llm.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.tokens import compute_block_hashes
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+class TestLocalKvIndexer:
+    def test_store_remove_clear_and_chain(self):
+        ix = LocalKvIndexer(worker_id=5)
+        ix.on_stored(0, [10, 11, 12], parent=None)
+        ix.on_stored(1, [20], parent=12)
+        assert ix.block_count() == 4
+        d = ix.dump()
+        assert d["worker_id"] == 5 and d["last_event_id"] == 1
+        # chained parents within one stored event
+        assert [None, 10, 11, 12] == [p for p, _ in d["blocks"]]
+        ix.on_removed(2, [11])
+        assert ix.block_count() == 3
+        ix.on_cleared(3)
+        assert ix.block_count() == 0
+        assert ix.dump()["last_event_id"] == 3
+
+
+async def _drive(port, n=3, content="hello world this is a shared prefix"):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        for _ in range(n):
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={"model": "mock-model",
+                      "messages": [{"role": "user", "content": content}],
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                await resp.json()
+
+
+class TestRouterRestartRecovery:
+    def test_new_router_bootstraps_from_worker(self, run):
+        """A frontend started AFTER traffic was served recovers the radix
+        state by querying the worker's local indexer — the restart-recovery
+        path (no durable event log needed)."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=256,
+                                    block_size=16),
+                load_publish_interval=0.2,
+            )
+            await worker.start()
+            frt1 = await DistributedRuntime(_cfg(cluster)).start()
+            f1 = Frontend(frt1, host="127.0.0.1", port=0, router_mode="kv")
+            await f1.start()
+            for _ in range(100):
+                if f1.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            await _drive(f1.port)
+            # worker's local index has the prompt blocks
+            assert worker.engine.local_index.block_count() > 0
+            entry1 = f1.manager.get("mock-model")
+            for _ in range(100):
+                if entry1.scheduler.indexer.total_nodes() > 0:
+                    break
+                await asyncio.sleep(0.05)
+            nodes_before = entry1.scheduler.indexer.total_nodes()
+            assert nodes_before > 0
+            # "restart": close frontend 1, start frontend 2 fresh
+            await f1.close()
+            await frt1.shutdown()
+            frt2 = await DistributedRuntime(_cfg(cluster)).start()
+            f2 = Frontend(frt2, host="127.0.0.1", port=0, router_mode="kv")
+            await f2.start()
+            entry2 = None
+            for _ in range(200):
+                entry2 = f2.manager.get("mock-model")
+                if (entry2 is not None and entry2.scheduler is not None
+                        and entry2.scheduler.indexer.total_nodes()
+                        >= nodes_before):
+                    break
+                await asyncio.sleep(0.05)
+            # recovered WITHOUT any new requests
+            assert entry2.scheduler.indexer.total_nodes() >= nodes_before
+            counts = entry2.scheduler.indexer.worker_block_counts()
+            assert any(w.worker_id == worker.instance_id for w in counts)
+            await f2.close()
+            await frt2.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)
+
+    def test_gap_triggers_resync(self, run):
+        """A skipped event id repairs the router's view from the worker's
+        local indexer."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=256),
+                load_publish_interval=0.2,
+            )
+            await worker.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            f = Frontend(frt, host="127.0.0.1", port=0, router_mode="kv")
+            await f.start()
+            for _ in range(100):
+                if f.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            await _drive(f.port, n=1)
+            entry = f.manager.get("mock-model")
+            for _ in range(100):
+                if entry.scheduler.indexer.total_nodes() > 0:
+                    break
+                await asyncio.sleep(0.05)
+            # Publish an event with a far-future id directly onto the event
+            # plane: the router sees a gap and resyncs from the worker.
+            pub = rt.event_publisher("dynamo")
+            bogus = RouterEvent(
+                worker_id=worker.instance_id, event_id=10_000,
+                stored=KvCacheStored(block_hashes=[999999], parent_hash=None),
+            )
+            await pub.publish("kv_events", bogus.to_wire())
+            real = worker.engine.local_index.block_count()
+            ok = False
+            for _ in range(200):
+                counts = entry.scheduler.indexer.worker_block_counts()
+                mine = sum(n for w, n in counts.items()
+                           if w.worker_id == worker.instance_id)
+                # after resync, the bogus block is gone: count == real
+                if mine == real and entry.scheduler.indexer.gap_count > 0:
+                    ok = True
+                    break
+                await asyncio.sleep(0.05)
+            assert ok, "resync never repaired the router view"
+            await f.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)
+
+
+class TestStandaloneIndexer:
+    def test_serves_matches_and_dump(self, run):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=256,
+                                    block_size=16),
+                load_publish_interval=0.2,
+            )
+            await worker.start()
+            irt = await DistributedRuntime(_cfg(cluster)).start()
+            indexer = StandaloneIndexer(irt)
+            await indexer.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            f = Frontend(frt, host="127.0.0.1", port=0)
+            await f.start()
+            for _ in range(100):
+                if f.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            content = "a shared long prefix for the indexer test " * 4
+            await _drive(f.port, n=2, content=content)
+            for _ in range(200):
+                if indexer.tree.total_nodes() > 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert indexer.tree.total_nodes() > 0
+
+            # query find_matches with the request's actual block hashes
+            entry = f.manager.get("mock-model")
+            pre = entry.preprocessor.preprocess_chat({
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": content}],
+                "max_tokens": 4,
+            })
+            hashes = compute_block_hashes(pre.token_ids, 16)
+            client_rt = await DistributedRuntime(_cfg(cluster)).start()
+            client = (client_rt.namespace("dynamo").component("indexer")
+                      .endpoint("find_matches").client())
+            await client.wait_for_instances(1, timeout=10)
+            outs = [o async for o in client.direct(
+                {"block_hashes": hashes}, indexer.instance_id)]
+            matches = outs[-1]["matches"]
+            assert any(m["worker_id"] == worker.instance_id
+                       and m["overlap_blocks"] > 0 for m in matches)
+
+            dump_client = (client_rt.namespace("dynamo").component("indexer")
+                           .endpoint("dump").client())
+            await dump_client.wait_for_instances(1, timeout=10)
+            outs = [o async for o in dump_client.direct(
+                {}, indexer.instance_id)]
+            workers = outs[-1]["workers"]
+            assert any(w["worker_id"] == worker.instance_id
+                       and w["block_count"] > 0 for w in workers)
+
+            await indexer.close()
+            await f.close()
+            for r in (client_rt, frt, irt):
+                await r.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)
