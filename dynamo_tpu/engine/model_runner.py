@@ -119,6 +119,12 @@ class ModelRunner:
                 out_shardings=self._param_sharding,
             )
             params = init(jax.random.PRNGKey(seed))
+        else:
+            # Host arrays (weight service / peer stream / checkpoint) or
+            # device arrays: place each leaf under its sharding. For arrays
+            # already placed correctly this is a no-op.
+            params = jax.tree.map(jax.device_put, params,
+                                  self._param_sharding)
         self.params = params
         kv_init = jax.jit(
             lambda: make_kv_cache(model_config, runner_config.num_pages,

@@ -125,6 +125,8 @@ class TpuWorker:
         tool_parser: Optional[str] = None,
         reasoning_parser: Optional[str] = None,
         lora_adapters: Optional[dict[str, str]] = None,  # name -> npz path
+        weight_service: Optional[str] = None,  # unix socket (GMS analog)
+        weights_from_peer: bool = False,  # ModelExpress analog
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -179,15 +181,107 @@ class TpuWorker:
         self._kvq_served = None
         self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._weight_service = weight_service
+        self._weights_from_peer = weights_from_peer
+        self._weights_served = None
+        self.weights_source = "init"  # init | service | peer
 
     async def start(self) -> None:
-        self._loop = asyncio.get_running_loop()
+        """prepare + serve in one go (normal startup). Snapshot-gated
+        startup calls prepare() and serve() separately around the dump
+        point (runtime/snapshot.py)."""
+        await self.prepare()
+        await self.serve()
+
+    def _weights_key(self) -> str:
+        """Arena key: model name + a digest of the FULL config, so any
+        architecture change (heads, mlp width, vocab, ...) misses the old
+        arena instead of loading wrong-shaped weights."""
+        import xxhash
+
+        cfg = self.model_config
+        digest = xxhash.xxh64_intdigest(repr(cfg).encode())
+        return f"{cfg.name}:{digest:016x}"
+
+    def _params_template(self):
+        import jax
+
+        from ..models import init_params as _ip
+
+        return jax.eval_shape(
+            lambda: _ip(jax.random.PRNGKey(0), self.model_config))
+
+    def _params_from_flat(self, flat, source: str):
+        """Validate + rebuild a fetched flat param dict; None on mismatch
+        (caller falls back to the next source)."""
+        from ..weights.client import unflatten_like
+
+        try:
+            params = unflatten_like(self._params_template(), flat)
+        except KeyError as exc:
+            log.warning("%s weights mismatch (%s); ignoring", source, exc)
+            return None
+        self.weights_source = source
+        return params
+
+    async def _resolve_params(self):
+        """Fast-start weight resolution: weight service (crash survival) ->
+        live peer stream (ModelExpress analog) -> init. Publishes to the
+        service whenever enabled so the NEXT restart is fast."""
+        host_params = None
+        client = None
+        if self._weight_service:
+            from ..weights import WeightClient
+
+            client = WeightClient(self._weight_service)
+            flat = await asyncio.to_thread(client.fetch, self._weights_key())
+            if flat is not None:
+                host_params = self._params_from_flat(flat, "service")
+        if (host_params is None and self._weights_from_peer
+                and self.runtime is not None):
+            from ..weights.streaming import pull_weights
+
+            flat = await pull_weights(self.runtime, self.card.namespace,
+                                      self.card.component)
+            if flat is not None:
+                host_params = self._params_from_flat(flat, "peer")
+        return host_params, client
+
+    def rederive_identity(self) -> None:
+        """Fresh instance identity after a snapshot restore: clones of a
+        dumped process must NOT share instance ids — cards would clobber
+        and KV event streams would interleave under one worker id (ref:
+        snapshot.py worker protocol 're-derives namespace/discovery
+        identity'). Call before serve(); safe because nothing has been
+        published yet at the dump point."""
+        self.instance_id = new_instance_id()
+        self.events.worker_id = self.instance_id
+        self.events.local_index.worker_id = self.instance_id
+
+    async def prepare(self) -> None:
+        """Build the engine: weights on device, steps compiled, scheduler
+        running. No runtime connections are made here (snapshot protocol:
+        the dump point must have no open sockets)."""
         log.info("building model runner (%s, pages=%d, batch=%d)...",
                  self.model_config.name, self.runner_config.num_pages,
                  self.runner_config.max_batch)
+        host_params, weight_client = await self._resolve_params()
         self.runner = await asyncio.to_thread(
             ModelRunner, self.model_config, self.runner_config, self.mesh,
+            host_params,
         )
+        log.info("weights source: %s", self.weights_source)
+        if weight_client is not None and self.weights_source != "service":
+            # Publish for the next (re)start; best-effort.
+            def _publish() -> None:
+                try:
+                    weight_client.store(self._weights_key(),
+                                        self.runner.params)
+                except Exception:  # noqa: BLE001 — crash survival is
+                    # best-effort; serving continues without it
+                    log.exception("weight publish failed")
+
+            await asyncio.to_thread(_publish)
         if self._warmup:
             await asyncio.to_thread(self.runner.warmup)
         if self.kvbm_config is not None and self.kvbm_config.enabled:
@@ -204,6 +298,11 @@ class TpuWorker:
             kvbm=self.kvbm,
         )
         self.scheduler.start()
+
+    async def serve(self) -> None:
+        """Connect endpoints + publish the card (requires self.runtime;
+        set after restore in snapshot mode)."""
+        self._loop = asyncio.get_running_loop()
         endpoint = (
             self.runtime.namespace(self.card.namespace)
             .component(self.card.component)
@@ -238,6 +337,16 @@ class TpuWorker:
         )
         self._kvq_served = await kvq_ep.serve_endpoint(
             self._kv_blocks, instance_id=self.instance_id
+        )
+        # Peer weight streaming source (ModelExpress analog): cold replicas
+        # pull parameters from here instead of re-initializing.
+        weights_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("weights")
+        )
+        self._weights_served = await weights_ep.serve_endpoint(
+            self._stream_weights, instance_id=self.instance_id
         )
         if self.mode == "prefill":
             pull_ep = (
@@ -288,6 +397,16 @@ class TpuWorker:
 
     async def _kv_blocks(self, body, ctx=None) -> AsyncIterator[dict]:
         yield self.events.local_index.dump()
+
+    async def _stream_weights(self, body, ctx=None) -> AsyncIterator[dict]:
+        """Stream this replica's parameters to a cold peer (chunked raw
+        bytes). Host transfer runs in a thread; frames stream as produced."""
+        from ..weights.client import flatten_params
+        from ..weights.streaming import encode_param_chunks
+
+        flat = await asyncio.to_thread(flatten_params, self.runner.params)
+        for frame in encode_param_chunks(flat):
+            yield frame
 
     async def _scale_elastic(self, body, ctx=None) -> AsyncIterator[dict]:
         """Re-place params on a new dp/tp/sp/ep mesh split at runtime.
@@ -599,7 +718,7 @@ class TpuWorker:
         # scale requests need a live scheduler loop to ever finish.
         for served in (self._served, self._clear_served, self._pull_served,
                        self._scale_served, self._kvq_served,
-                       *self._lora_served):
+                       self._weights_served, *self._lora_served):
             if served is not None:
                 await served.shutdown()
         if self.kvbm is not None:
@@ -643,6 +762,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--kvbm-disk-path", default="/tmp/dynamo_tpu_kvbm.bin")
     parser.add_argument("--kvbm-object-store", default=None,
                         help="G4 blob-store root (e.g. a gcsfuse mountpoint)")
+    parser.add_argument("--weight-service", default=None,
+                        help="unix socket of the weight service (GMS "
+                             "analog; default DYNT_WEIGHT_SERVICE)")
+    parser.add_argument("--weights-from-peer", action="store_true",
+                        help="pull weights from a live replica at startup "
+                             "(ModelExpress analog)")
     parser.add_argument("--max-loras", type=int, default=0,
                         help="adapter slots for multi-LoRA serving (0=off)")
     parser.add_argument("--lora-rank", type=int, default=8,
@@ -671,7 +796,16 @@ async def main(argv: Optional[list[str]] = None) -> None:
             disk_path=args.kvbm_disk_path,
             object_store_root=args.kvbm_object_store,
         )
-    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    from ..runtime.config import env as _env
+    from ..runtime.snapshot import SnapshotController
+
+    snapshot = SnapshotController()
+    # Snapshot protocol: the engine is prepared BEFORE any runtime
+    # connection (no open sockets at the dump point); normal mode connects
+    # first so the worker registers as soon as it's ready.
+    runtime = None
+    if not snapshot.enabled:
+        runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = TpuWorker(
         runtime,
         model_name=args.model,
@@ -690,8 +824,20 @@ async def main(argv: Optional[list[str]] = None) -> None:
         tool_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
         lora_adapters=dict(s.split("=", 1) for s in args.lora),
+        weight_service=(args.weight_service
+                        or _env("DYNT_WEIGHT_SERVICE") or None),
+        weights_from_peer=args.weights_from_peer,
     )
-    await worker.start()
+    if snapshot.enabled:
+        await worker.prepare()
+        snapshot.engine_ready()
+        await snapshot.wait_for_restore()
+        worker.rederive_identity()  # clones must not share an instance id
+        runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+        worker.runtime = runtime
+        await worker.serve()
+    else:
+        await worker.start()
     from ..runtime import HealthCheckManager
     from ..runtime.config import env
 

@@ -41,7 +41,9 @@ class StandaloneIndexer:
         self._served: list = []
         # worker_id -> (namespace, component) for resync targeting
         self._worker_subjects: dict[int, tuple[str, str]] = {}
-        self._resyncing: set[int] = set()
+        # worker_id -> buffered events while its resync RPC is in flight
+        # (snapshot+replay, same pattern as llm/manager.py)
+        self._resyncing: dict[int, list[RouterEvent]] = {}
         self._watch = None
 
     # -- event ingestion ---------------------------------------------------
@@ -50,6 +52,10 @@ class StandaloneIndexer:
         async for _topic, payload in sub:
             try:
                 event = RouterEvent.from_wire(payload)
+                buffer = self._resyncing.get(event.worker_id)
+                if buffer is not None:
+                    buffer.append(event)
+                    continue
                 status = self.tree.apply_event(event)
                 if status == "gap":
                     self._schedule_resync(event.worker_id)
@@ -66,7 +72,13 @@ class StandaloneIndexer:
                 if ns != self.namespace:
                     continue
                 iid = int(instance_id)
-                if event.kind == "put":
+                if event.kind == "put" and event.value:
+                    # Only workers that actually serve kv_blocks (the same
+                    # gate manager.py uses — proxies like the global router
+                    # publish cards but have no local indexer).
+                    if not (event.value.get("runtime_config") or {}).get(
+                            "kv_blocks_endpoint"):
+                        continue
                     if iid not in self._worker_subjects:
                         self._worker_subjects[iid] = (ns, component)
                         self._schedule_resync(iid)  # bootstrap
@@ -82,9 +94,11 @@ class StandaloneIndexer:
         subject = self._worker_subjects.get(worker_id)
         if subject is None:
             return
-        self._resyncing.add(worker_id)
-        self._tasks.append(
-            asyncio.create_task(self._resync(worker_id, subject)))
+        self._resyncing[worker_id] = []  # _event_loop buffers into this
+        task = asyncio.create_task(self._resync(worker_id, subject))
+        self._tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._tasks.remove(t) if t in self._tasks else None)
 
     async def _resync(self, worker_id: int,
                       subject: tuple[str, str]) -> None:
@@ -100,13 +114,26 @@ class StandaloneIndexer:
                 pairs = [(p, h) for p, h in dump.get("blocks", [])]
                 self.tree.load_worker(worker, pairs,
                                       dump.get("last_event_id"))
+                # Replay events buffered during the RPC (snapshot+replay —
+                # stale ids skipped by the indexer, no await between pop
+                # and replay). A gap inside the window retries.
+                regap = False
+                for event in self._resyncing.pop(worker_id, []):
+                    if self.tree.apply_event(event) == "gap":
+                        regap = True
                 log.info("indexer resynced worker %x: %d blocks",
                          worker_id, len(pairs))
+                if regap:
+                    self._schedule_resync(worker_id)
                 break
         except Exception:  # noqa: BLE001 — best-effort; a later gap retries
             log.exception("indexer resync failed for %x", worker_id)
         finally:
-            self._resyncing.discard(worker_id)
+            for event in self._resyncing.pop(worker_id, []):
+                try:
+                    self.tree.apply_event(event)
+                except Exception:  # noqa: BLE001
+                    log.exception("buffered event replay failed")
             await client.close()
 
     # -- query endpoints ----------------------------------------------------

@@ -314,11 +314,19 @@ class ModelWatcher:
                 # indexer's stale check; newer ones apply in order. No await
                 # between pop and replay, so no event can slip past both.
                 buffered = self._resyncing.pop(key, [])
+                regap = False
                 for event in buffered:
-                    entry.scheduler.indexer.apply_event(event)
+                    if entry.scheduler.indexer.apply_event(event) == "gap":
+                        regap = True
                 log.info("resynced worker %x for %s (%s): %d blocks, "
                          "%d events replayed", instance_id, card.name,
                          reason, len(pairs), len(buffered))
+                if regap:
+                    # An event was lost inside the resync window itself —
+                    # without this, _last_event_id has advanced and the
+                    # live path would never notice.
+                    self._schedule_resync(entry, instance_id,
+                                          reason="replay-gap")
                 break
         except Exception:  # noqa: BLE001 — resync is best-effort; events
             # keep flowing and a later gap retries

@@ -94,6 +94,14 @@ _register("DYNT_SYSTEM_ENABLED", True, _bool, "Enable the system status server")
 
 # Logging
 _register("DYNT_LOG_LEVEL", "INFO", _str, "Log level")
+_register("DYNT_WEIGHT_SERVICE", "", _str,
+          "Unix socket of the weight service (GMS analog): workers "
+          "re-attach published weights on restart instead of initializing")
+_register("DYNT_SNAPSHOT_MODE", "off", _str,
+          "Worker snapshot protocol: off | dump (prepare engine, signal "
+          "ready, block for restore before connecting — CRIU analog)")
+_register("DYNT_SNAPSHOT_DIR", "/tmp/dynamo_tpu_snapshot", _str,
+          "Directory for snapshot ready/restore marker files")
 _register("DYNT_AUDIT_SINKS", "", _str,
           "Comma list of audit sinks for the frontend: 'log' and/or "
           "'jsonl:<path>' (ref: lib/llm/src/audit/ sink config)")

@@ -1,0 +1,27 @@
+"""Weight service: out-of-process host-memory weight store + streaming.
+
+TPU-native equivalent of the reference's GPU Memory Service (ref:
+lib/gpu_memory_service — CUDA VMM allocations owned by a separate process,
+shared over a unix socket, so worker crashes don't lose weights and
+restarts re-import instead of reloading) and of ModelExpress weight
+streaming (ref: README.md:63 "7x faster model startup", client wired at
+components/src/dynamo/vllm/main.py mx-source/mx-target load formats).
+
+On TPU there is no device-memory handle passing; the fast path is
+host DRAM -> HBM DMA. So:
+
+  * `WeightServiceServer` (own process) owns POSIX shared-memory segments
+    holding each model's parameters; a crashed/restarted worker re-attaches
+    (zero-copy host views) and `jax.device_put`s with its shardings — no
+    init, no checkpoint read.
+  * `WeightClient.load_or_init` is the worker-side one-liner: attach if
+    present, else init + publish for the next restart.
+  * Peer streaming (`serve_weights` / `pull_weights`, llm-level): a cold
+    worker pulls parameters from a live replica over the request plane in
+    chunked raw-bytes frames — the ModelExpress analog for scale-out.
+"""
+
+from .client import WeightClient
+from .service import WeightServiceServer, serve_in_process
+
+__all__ = ["WeightClient", "WeightServiceServer", "serve_in_process"]

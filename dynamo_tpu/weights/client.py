@@ -1,0 +1,175 @@
+"""Weight service client: store/fetch parameter pytrees via shared memory.
+
+The worker-facing half of the GMS analog (see package docstring). Bulk
+data moves by memcpy into/out of POSIX shm; the socket carries only
+metadata. Fetched leaves are COPIES of the shm contents (`np.array`), so
+the returned pytree stays valid after close() and the service can free or
+replace arenas without corrupting a live model.
+"""
+
+from __future__ import annotations
+
+import socket
+from multiprocessing import shared_memory
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from .service import _recv_msg, _send_msg
+
+log = get_logger("weights.client")
+
+
+def _attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Attach WITHOUT the resource tracker claiming ownership: on
+    Python <= 3.12 a plain attach registers the segment with the client's
+    tracker, which unlinks it when the client process dies — destroying
+    the service's arena and defeating crash survival. The server alone
+    owns segment lifetime."""
+    try:
+        return shared_memory.SharedMemory(name=name, track=False)
+    except TypeError:  # Python < 3.13: no track kwarg
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:  # noqa: BLE001 — best-effort untracking
+            pass
+        return shm
+
+
+def flatten_params(params) -> list[tuple[str, np.ndarray]]:
+    """Stable path-addressed flattening of the model param pytree."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        out.append((key, np.asarray(leaf)))
+    return out
+
+
+def unflatten_like(template, flat: dict[str, np.ndarray]):
+    """Rebuild a pytree shaped like `template` from path-addressed leaves.
+    Validates shape and dtype per leaf against the template (which may be
+    `jax.eval_shape` output) — a stale arena from an older model config
+    must fail HERE, where callers fall back to init, not deep inside jit
+    tracing. Raises KeyError on any mismatch."""
+    import jax
+
+    paths = jax.tree_util.tree_flatten_with_path(template)[0]
+    treedef = jax.tree_util.tree_structure(template)
+    leaves = []
+    for path, tmpl_leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        if key not in flat:
+            raise KeyError(f"weight arena is missing parameter {key!r}")
+        leaf = flat[key]
+        want_shape = tuple(tmpl_leaf.shape)
+        want_dtype = np.dtype(tmpl_leaf.dtype)
+        if tuple(leaf.shape) != want_shape or np.dtype(leaf.dtype) != want_dtype:
+            raise KeyError(
+                f"weight arena parameter {key!r} is {leaf.shape}/"
+                f"{leaf.dtype}, model expects {want_shape}/{want_dtype}")
+        leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class WeightClient:
+    def __init__(self, socket_path: str, timeout: float = 30.0) -> None:
+        self.socket_path = socket_path
+        self.timeout = timeout
+
+    def _rpc(self, msg: dict) -> dict:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        try:
+            sock.connect(self.socket_path)
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+            if reply is None:
+                raise ConnectionError("weight service closed the connection")
+            return reply
+        finally:
+            sock.close()
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._rpc({"cmd": "ping"}).get("ok"))
+        except (OSError, ConnectionError):
+            return False
+
+    def list(self) -> list[dict]:
+        return self._rpc({"cmd": "list"}).get("models", [])
+
+    def delete(self, model: str) -> None:
+        self._rpc({"cmd": "delete", "model": model})
+
+    def store(self, model: str, params) -> None:
+        """Publish a param pytree into the service's shm arenas."""
+        flat = flatten_params(params)
+        reply = self._rpc({
+            "cmd": "alloc", "model": model,
+            "params": [{"path": k, "shape": list(a.shape),
+                        "dtype": str(a.dtype)} for k, a in flat],
+        })
+        if not reply.get("ok"):
+            raise RuntimeError(f"weight alloc failed: {reply.get('error')}")
+        segments = reply["segments"]
+        for key, arr in flat:
+            shm = _attach_shm(segments[key])
+            try:
+                view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+                view[...] = arr
+            finally:
+                shm.close()
+        reply = self._rpc({"cmd": "commit", "model": model})
+        if not reply.get("ok"):
+            raise RuntimeError(f"weight commit failed: {reply.get('error')}")
+        log.info("published %d params for %s to the weight service",
+                 len(flat), model)
+
+    def fetch(self, model: str) -> Optional[dict[str, np.ndarray]]:
+        """Path-addressed host arrays, or None if absent/incomplete."""
+        try:
+            reply = self._rpc({"cmd": "manifest", "model": model})
+        except (OSError, ConnectionError):
+            return None
+        if not reply.get("ok") or not reply.get("complete"):
+            return None
+        out: dict[str, np.ndarray] = {}
+        for meta in reply["params"]:
+            shm = _attach_shm(meta["shm_name"])
+            try:
+                view = np.ndarray(tuple(meta["shape"]),
+                                  dtype=np.dtype(meta["dtype"]),
+                                  buffer=shm.buf)
+                out[meta["path"]] = np.array(view)  # own the memory
+            finally:
+                shm.close()
+        return out
+
+    def load_or_init(self, model: str, template,
+                     init_fn: Callable[[], object]):
+        """The worker-side fast-restart path: attach the published weights
+        if the service has them (crash survival / warm restart), else run
+        `init_fn` (slow: init or checkpoint read) and publish the result.
+        Returns (pytree, from_service: bool)."""
+        flat = self.fetch(model)
+        if flat is not None:
+            try:
+                return unflatten_like(template, flat), True
+            except KeyError as exc:
+                log.warning("weight arena mismatch (%s); reinitializing", exc)
+        params = init_fn()
+        try:
+            self.store(model, params)
+        except (OSError, ConnectionError, RuntimeError) as exc:
+            log.warning("weight publish failed (%r); continuing without "
+                        "crash survival", exc)
+        return params, False

@@ -1,0 +1,108 @@
+"""Peer weight streaming — the ModelExpress analog.
+
+A cold worker pulls parameters from a LIVE replica over the request plane
+instead of initializing or reading a checkpoint (ref: README.md:63
+ModelExpress "7x faster model startup"; mx-source/mx-target load formats in
+components/src/dynamo/vllm/main.py). Frames are msgpack dicts with raw
+bytes, chunked like the disagg KV transfer (llm/kv_transfer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("weights.streaming")
+
+STREAM_CHUNK_BYTES = 4 * 2**20
+
+
+def encode_param_chunks(flat: list[tuple[str, np.ndarray]]) -> Iterator[dict]:
+    """Stream a flattened param list as wire frames. Each param is split
+    into <= STREAM_CHUNK_BYTES raw-byte chunks."""
+    total = len(flat)
+    for index, (key, arr) in enumerate(flat):
+        data = np.ascontiguousarray(arr).tobytes()
+        n_chunks = max(1, -(-len(data) // STREAM_CHUNK_BYTES))
+        for ci in range(n_chunks):
+            lo = ci * STREAM_CHUNK_BYTES
+            yield {
+                "path": key,
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "index": index,
+                "total_params": total,
+                "chunk": ci,
+                "total_chunks": n_chunks,
+                "data": data[lo: lo + STREAM_CHUNK_BYTES],
+            }
+
+
+class ParamAssembler:
+    """Pull-side reassembly of streamed parameter frames."""
+
+    def __init__(self) -> None:
+        self._partial: dict[str, list[Optional[bytes]]] = {}
+        self._meta: dict[str, tuple[tuple, str]] = {}
+        self.params: dict[str, np.ndarray] = {}
+        self._total: Optional[int] = None
+
+    def add(self, frame: dict) -> None:
+        key = frame["path"]
+        self._total = frame["total_params"]
+        chunks = self._partial.setdefault(
+            key, [None] * frame["total_chunks"])
+        chunks[frame["chunk"]] = frame["data"]
+        self._meta[key] = (tuple(frame["shape"]), frame["dtype"])
+        if all(c is not None for c in chunks):
+            shape, dtype = self._meta[key]
+            buf = b"".join(chunks)
+            self.params[key] = np.frombuffer(
+                buf, dtype=np.dtype(dtype)).reshape(shape).copy()
+            del self._partial[key]
+
+    @property
+    def complete(self) -> bool:
+        return (self._total is not None
+                and len(self.params) == self._total
+                and not self._partial)
+
+
+async def pull_weights(runtime, namespace: str, component: str,
+                       timeout: float = 120.0) -> Optional[dict[str, np.ndarray]]:
+    """Pull a full parameter set from any live peer serving the `weights`
+    endpoint. Returns path-addressed host arrays, or None on failure (the
+    caller falls back to init/checkpoint — same degradation the reference
+    takes when ModelExpress is unavailable)."""
+    import asyncio
+
+    from ..runtime.push_router import PushRouter
+
+    endpoint = (runtime.namespace(namespace).component(component)
+                .endpoint("weights"))
+    router = PushRouter(endpoint.client(), mode="round_robin")
+    try:
+        await router.client.start()
+        try:
+            await router.client.wait_for_instances(1, timeout=5.0)
+        except asyncio.TimeoutError:
+            return None
+        assembler = ParamAssembler()
+        async for frame in router.generate({}):
+            if frame.get("error"):
+                log.warning("peer weight pull failed: %s", frame["error"])
+                return None
+            assembler.add(frame)
+        if not assembler.complete:
+            log.warning("peer weight pull incomplete")
+            return None
+        log.info("pulled %d params from a live peer", len(assembler.params))
+        return assembler.params
+    except Exception:  # noqa: BLE001 — any failure -> fall back to init
+        log.exception("peer weight pull failed")
+        return None
+    finally:
+        await router.client.close()

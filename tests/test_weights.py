@@ -1,0 +1,303 @@
+"""Weight service (GMS analog), peer weight streaming (ModelExpress
+analog), and the snapshot startup protocol (CRIU analog) — ref surface:
+lib/gpu_memory_service, README ModelExpress, deploy/snapshot +
+components snapshot.py."""
+
+import asyncio
+import multiprocessing
+import os
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import jax
+
+from dynamo_tpu.engine import RunnerConfig, TpuWorker
+from dynamo_tpu.models import get_config, init_params
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.runtime.snapshot import SnapshotController
+from dynamo_tpu.weights import WeightClient, serve_in_process
+from dynamo_tpu.weights.client import flatten_params, unflatten_like
+from dynamo_tpu.weights.streaming import ParamAssembler, encode_param_chunks
+
+
+def _params():
+    return init_params(jax.random.PRNGKey(1), get_config("tiny-test"))
+
+
+class TestWeightService:
+    def test_store_fetch_roundtrip(self, tmp_path):
+        sock = str(tmp_path / "w.sock")
+        server = serve_in_process(sock)
+        try:
+            client = WeightClient(sock)
+            assert client.ping()
+            params = _params()
+            client.store("m:1", params)
+            models = client.list()
+            assert len(models) == 1 and models[0]["complete"]
+            flat = client.fetch("m:1")
+            rebuilt = unflatten_like(params, flat)
+            for (k1, a), (k2, b) in zip(flatten_params(params),
+                                        flatten_params(rebuilt)):
+                assert k1 == k2
+                np.testing.assert_array_equal(a, b)
+            client.delete("m:1")
+            assert client.fetch("m:1") is None
+        finally:
+            server.stop()
+
+    def test_worker_crash_survival(self, tmp_path):
+        """Weights published by one 'worker' survive its death: a second
+        client (the restarted worker) re-attaches them — the GMS value
+        proposition."""
+        sock = str(tmp_path / "w.sock")
+        server = serve_in_process(sock)
+        try:
+            params = _params()
+            # worker #1 publishes, then "crashes" (client object discarded)
+            WeightClient(sock).store("m:x", params)
+            # worker #2 (fresh restart) re-attaches instead of initializing
+            got, from_service = WeightClient(sock).load_or_init(
+                "m:x", params, init_fn=lambda: pytest.fail("should not init"))
+            assert from_service
+            np.testing.assert_array_equal(
+                np.asarray(params["embed"]), np.asarray(got["embed"]))
+        finally:
+            server.stop()
+
+    def test_load_or_init_falls_back_and_publishes(self, tmp_path):
+        sock = str(tmp_path / "w.sock")
+        server = serve_in_process(sock)
+        try:
+            client = WeightClient(sock)
+            params = _params()
+            got, from_service = client.load_or_init(
+                "m:y", params, init_fn=lambda: params)
+            assert not from_service
+            # second call now hits the service
+            _, from_service2 = client.load_or_init(
+                "m:y", params, init_fn=lambda: pytest.fail("should not init"))
+            assert from_service2
+        finally:
+            server.stop()
+
+    def test_service_down_is_graceful(self, tmp_path):
+        client = WeightClient(str(tmp_path / "nope.sock"), timeout=1.0)
+        assert not client.ping()
+        assert client.fetch("m") is None
+        params = _params()
+        got, from_service = client.load_or_init("m", params,
+                                                init_fn=lambda: params)
+        assert not from_service and got is params
+
+    def test_separate_process_server(self, tmp_path):
+        """The real deployment shape: the service is its own PROCESS; a
+        client in this process stores, another fetches."""
+        sock = str(tmp_path / "proc.sock")
+
+        def serve():
+            from dynamo_tpu.weights.service import WeightServiceServer
+
+            WeightServiceServer(sock).serve_forever()
+
+        proc = multiprocessing.Process(target=serve, daemon=True)
+        proc.start()
+        try:
+            client = WeightClient(sock)
+            for _ in range(100):
+                if client.ping():
+                    break
+                time.sleep(0.05)
+            assert client.ping()
+            arr = {"a": np.arange(100, dtype=np.float32).reshape(10, 10)}
+            client.store("k", arr)
+            got = WeightClient(sock).fetch("k")
+            np.testing.assert_array_equal(got["a"], arr["a"])
+        finally:
+            proc.terminate()
+            proc.join(timeout=5)
+
+
+class TestParamStreaming:
+    def test_chunk_roundtrip(self):
+        flat = flatten_params(_params())
+        assembler = ParamAssembler()
+        for frame in encode_param_chunks(flat):
+            assembler.add(frame)
+        assert assembler.complete
+        for key, arr in flat:
+            np.testing.assert_array_equal(assembler.params[key],
+                                          np.asarray(arr))
+
+    def test_multi_chunk_param(self):
+        import dynamo_tpu.weights.streaming as streaming
+
+        old = streaming.STREAM_CHUNK_BYTES
+        streaming.STREAM_CHUNK_BYTES = 64
+        try:
+            flat = [("big", np.arange(1000, dtype=np.float32))]
+            frames = list(encode_param_chunks(flat))
+            assert len(frames) > 1
+            assembler = ParamAssembler()
+            for frame in reversed(frames):  # out-of-order safe
+                assembler.add(frame)
+            assert assembler.complete
+            np.testing.assert_array_equal(assembler.params["big"], flat[0][1])
+        finally:
+            streaming.STREAM_CHUNK_BYTES = old
+
+    def test_worker_pulls_from_live_peer(self, run, mem_runtime_config):
+        """ModelExpress analog E2E: a cold worker pulls weights from a live
+        replica and ends up with identical parameters."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt_a = await DistributedRuntime(
+                mem_runtime_config(cluster)).start()
+            ns = uuid.uuid4().hex
+            cfg = RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                               max_pages_per_seq=16, prefill_buckets=(8, 16))
+            worker_a = TpuWorker(rt_a, model_name="tiny-test", namespace=ns,
+                                 runner_config=cfg, warmup=False)
+            await worker_a.start()
+            rt_b = await DistributedRuntime(
+                mem_runtime_config(cluster)).start()
+            worker_b = TpuWorker(rt_b, model_name="tiny-test", namespace=ns,
+                                 runner_config=cfg, warmup=False,
+                                 weights_from_peer=True)
+            await worker_b.start()
+            assert worker_b.weights_source == "peer"
+            np.testing.assert_array_equal(
+                np.asarray(worker_a.runner.params["embed"]),
+                np.asarray(worker_b.runner.params["embed"]))
+            await worker_b.close()
+            await worker_a.close()
+            await rt_b.shutdown()
+            await rt_a.shutdown()
+
+        run(body(), timeout=180)
+
+
+class TestSnapshotController:
+    def test_modes_and_markers(self, run, tmp_path):
+        with pytest.raises(ValueError):
+            SnapshotController(mode="bogus")
+        off = SnapshotController(mode="off", directory=str(tmp_path))
+        assert not off.enabled
+
+        ctl = SnapshotController(mode="dump", directory=str(tmp_path / "s"))
+        assert ctl.enabled
+        ctl.engine_ready()
+        assert os.path.exists(ctl.ready_path)
+        assert open(ctl.ready_path).read() == str(os.getpid())
+
+        async def body():
+            waiter = asyncio.create_task(ctl.wait_for_restore(poll=0.01))
+            await asyncio.sleep(0.05)
+            assert not waiter.done()  # gated until the marker appears
+            with open(ctl.restore_path, "w") as f:
+                f.write("go")
+            await asyncio.wait_for(waiter, 5)
+
+        run(body(), timeout=30)
+        # A stale restore marker must not leak into the next run: a fresh
+        # ready signal clears it (else wait_for_restore returns instantly
+        # and the dump captures open sockets).
+        assert os.path.exists(ctl.restore_path)
+        ctl.engine_ready()
+        assert not os.path.exists(ctl.restore_path)
+        ctl.clear()
+        assert not os.path.exists(ctl.ready_path)
+
+    def test_snapshot_gated_worker_startup(self, run, mem_runtime_config,
+                                           tmp_path):
+        """Full protocol: prepare with NO runtime, ready marker, restore,
+        then serve with a fresh runtime — and the worker actually serves."""
+
+        async def body():
+            ns = uuid.uuid4().hex
+            ctl = SnapshotController(mode="dump",
+                                     directory=str(tmp_path / "snap"))
+            cfg = RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                               max_pages_per_seq=16, prefill_buckets=(8, 16))
+            worker = TpuWorker(None, model_name="tiny-test", namespace=ns,
+                               runner_config=cfg, warmup=False)
+            await worker.prepare()
+            ctl.engine_ready()
+            # "snapshotter" restores immediately
+            with open(ctl.restore_path, "w") as f:
+                f.write("go")
+            await ctl.wait_for_restore(poll=0.01)
+            # Clones of a dumped process must not share identity.
+            old_id = worker.instance_id
+            worker.rederive_identity()
+            assert worker.instance_id != old_id
+            assert worker.events.worker_id == worker.instance_id
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            worker.runtime = rt
+            await worker.serve()
+
+            from dynamo_tpu.llm.protocols import (
+                EngineOutput,
+                PreprocessedRequest,
+                SamplingOptions,
+                StopConditions,
+            )
+
+            client = (rt.namespace(ns).component("backend")
+                      .endpoint("generate").client())
+            await client.wait_for_instances(1, timeout=10)
+            req = PreprocessedRequest(
+                request_id=uuid.uuid4().hex, token_ids=list(range(8)),
+                sampling=SamplingOptions(max_tokens=3, temperature=0.0),
+                stop=StopConditions(ignore_eos=True),
+            ).to_wire()
+            outs = [EngineOutput.from_wire(o)
+                    async for o in client.direct(req, worker.instance_id)]
+            assert sum(len(o.token_ids) for o in outs) == 3
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=180)
+
+
+class TestWorkerWeightService:
+    def test_worker_restart_uses_service(self, run, mem_runtime_config,
+                                         tmp_path):
+        """Worker #1 initializes + publishes; 'restarted' worker #2 attaches
+        from the service and produces identical weights."""
+        sock = str(tmp_path / "ws.sock")
+        server = serve_in_process(sock)
+
+        async def body():
+            ns = uuid.uuid4().hex
+            cfg = RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                               max_pages_per_seq=16, prefill_buckets=(8, 16))
+            rt1 = await DistributedRuntime(mem_runtime_config()).start()
+            w1 = TpuWorker(rt1, model_name="tiny-test", namespace=ns,
+                           runner_config=cfg, warmup=False,
+                           weight_service=sock)
+            await w1.start()
+            assert w1.weights_source == "init"
+            embed1 = np.asarray(w1.runner.params["embed"])
+            await w1.close()
+            await rt1.shutdown()  # worker "crashes"
+
+            rt2 = await DistributedRuntime(mem_runtime_config()).start()
+            w2 = TpuWorker(rt2, model_name="tiny-test", namespace=ns,
+                           runner_config=cfg, warmup=False,
+                           weight_service=sock)
+            await w2.start()
+            assert w2.weights_source == "service"
+            np.testing.assert_array_equal(
+                embed1, np.asarray(w2.runner.params["embed"]))
+            await w2.close()
+            await rt2.shutdown()
+
+        try:
+            run(body(), timeout=180)
+        finally:
+            server.stop()
