@@ -1,0 +1,31 @@
+"""Deployment controller — the Kubernetes-operator analog.
+
+The reference ships a Go operator reconciling `DynamoGraphDeployment`
+CRDs: a graph of services (frontend, workers, planner, ...) with replicas,
+resources, and engine args; the planner scales it by PATCHing the CRD and
+the operator converges actual state (ref: deploy/operator/
+api/v1alpha1/*_types.go + internal/controller/
+dynamographdeployment_controller.go).
+
+TPU-native equivalent, two halves:
+
+  * `GraphDeploymentSpec` + `LocalDeploymentController`: reconcile a
+    graph of dynamo_tpu service PROCESSES on this host — spawn, restart
+    with backoff on crash, scale up/down with graceful drain, and follow
+    planner decisions published by the VirtualConnector (the same
+    planner -> controller loop as PATCH -> reconcile).
+  * `render_k8s_manifests`: emit standard Deployment/Service YAML from
+    the same spec for real clusters (GKE/TPU pods), where kubectl +
+    KubernetesConnector take over the scaling edge.
+"""
+
+from .controller import LocalDeploymentController
+from .manifests import render_k8s_manifests
+from .spec import GraphDeploymentSpec, ServiceSpec
+
+__all__ = [
+    "GraphDeploymentSpec",
+    "ServiceSpec",
+    "LocalDeploymentController",
+    "render_k8s_manifests",
+]

@@ -1,0 +1,5 @@
+import asyncio
+
+from .controller import main
+
+asyncio.run(main())

@@ -1,0 +1,256 @@
+"""Local deployment controller: reconcile service processes to a spec.
+
+The reconcile loop the reference's operator runs against Kubernetes (ref:
+deploy/operator internal/controller/dynamographdeployment_controller.go),
+against local processes: observe running replicas per service, converge to
+desired (spawn missing, drain extras), restart crashed replicas with
+exponential backoff, and follow scaling decisions the planner publishes
+through its VirtualConnector (v1/planner/<ns>/target_replicas — the
+planner->operator edge; ref: planner-design.md Step 5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import signal
+import time
+from typing import Optional
+
+from ..runtime.logging import get_logger
+from .spec import GraphDeploymentSpec, ServiceSpec
+
+log = get_logger("deploy.controller")
+
+BACKOFF_BASE_SECS = 1.0
+BACKOFF_MAX_SECS = 30.0
+DRAIN_GRACE_SECS = 10.0
+
+
+@dataclasses.dataclass
+class _Replica:
+    service: str
+    index: int
+    proc: asyncio.subprocess.Process
+    started_at: float
+    log_path: Optional[str]
+
+
+class LocalDeploymentController:
+    def __init__(
+        self,
+        spec: GraphDeploymentSpec,
+        runtime=None,  # Optional DistributedRuntime: follow planner decisions
+        log_dir: Optional[str] = None,
+        reconcile_interval: float = 1.0,
+    ) -> None:
+        self.spec = spec
+        self.runtime = runtime
+        self.log_dir = log_dir
+        self.interval = reconcile_interval
+        self.desired: dict[str, int] = {
+            name: svc.replicas for name, svc in spec.services.items()
+        }
+        self._replicas: dict[str, list[_Replica]] = {
+            name: [] for name in spec.services
+        }
+        self._crashes: dict[str, int] = {}  # consecutive crash count
+        self._backoff_until: dict[str, float] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self._applied_decision_id = 0
+        self.restarts = 0
+
+    # -- scaling API (the operator's CRD-patch edge) -----------------------
+
+    def set_replicas(self, service: str, n: int) -> None:
+        if service not in self.spec.services:
+            raise KeyError(f"unknown service {service!r}")
+        if n < 0:
+            raise ValueError("negative replicas")
+        self.desired[service] = n
+        log.info("desired replicas: %s -> %d", service, n)
+
+    def observed(self, service: str) -> int:
+        return len([r for r in self._replicas.get(service, [])
+                    if r.proc.returncode is None])
+
+    def status(self) -> dict:
+        return {
+            "deployment": self.spec.name,
+            "services": {
+                name: {"desired": self.desired[name],
+                       "running": self.observed(name),
+                       "crash_streak": self._crashes.get(name, 0)}
+                for name in self.spec.services
+            },
+            "restarts": self.restarts,
+        }
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def _spawn(self, svc: ServiceSpec, index: int) -> _Replica:
+        env = dict(os.environ)
+        env.update(self.spec.env)
+        env.update(svc.env)
+        log_path = None
+        stdout = asyncio.subprocess.DEVNULL
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            log_path = os.path.join(self.log_dir,
+                                    f"{svc.name}-{index}.log")
+            stdout = open(log_path, "ab")
+        proc = await asyncio.create_subprocess_exec(
+            *svc.argv(), env=env, stdout=stdout, stderr=stdout,
+            start_new_session=True,  # isolate signals from the controller
+        )
+        if stdout is not asyncio.subprocess.DEVNULL:
+            stdout.close()  # child holds its own fd
+        log.info("spawned %s[%d] pid=%d: %s", svc.name, index, proc.pid,
+                 " ".join(svc.argv()))
+        return _Replica(service=svc.name, index=index, proc=proc,
+                        started_at=time.monotonic(), log_path=log_path)
+
+    async def _drain(self, replica: _Replica) -> None:
+        """SIGTERM -> grace -> SIGKILL (graceful shutdown first, ref:
+        graceful_shutdown.py drain semantics)."""
+        proc = replica.proc
+        if proc.returncode is not None:
+            return
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            return
+        try:
+            await asyncio.wait_for(proc.wait(), DRAIN_GRACE_SECS)
+        except asyncio.TimeoutError:
+            log.warning("%s[%d] did not drain in %.0fs; killing",
+                        replica.service, replica.index, DRAIN_GRACE_SECS)
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            await proc.wait()
+
+    async def reconcile_once(self) -> None:
+        await self._apply_planner_decision()
+        for name, svc in self.spec.services.items():
+            replicas = self._replicas[name]
+            # Reap exits (crash or normal) and count crashes for backoff.
+            live: list[_Replica] = []
+            for replica in replicas:
+                if replica.proc.returncode is None:
+                    live.append(replica)
+                    continue
+                ran_for = time.monotonic() - replica.started_at
+                if replica.index < self.desired[name]:
+                    self.restarts += 1
+                    streak = (self._crashes.get(name, 0) + 1
+                              if ran_for < 60.0 else 1)
+                    self._crashes[name] = streak
+                    delay = min(BACKOFF_MAX_SECS,
+                                BACKOFF_BASE_SECS * 2 ** (streak - 1))
+                    self._backoff_until[name] = time.monotonic() + delay
+                    log.warning(
+                        "%s[%d] exited rc=%s after %.1fs (streak %d, "
+                        "backoff %.1fs)", name, replica.index,
+                        replica.proc.returncode, ran_for, streak, delay)
+            self._replicas[name] = live
+            # Scale down: drain the highest indices first.
+            want = self.desired[name]
+            extras = [r for r in live if r.index >= want]
+            for replica in sorted(extras, key=lambda r: -r.index):
+                log.info("scaling down %s[%d]", name, replica.index)
+                await self._drain(replica)
+                self._replicas[name].remove(replica)
+            # Scale up (respecting crash backoff).
+            if time.monotonic() < self._backoff_until.get(name, 0.0):
+                continue
+            have = {r.index for r in self._replicas[name]}
+            for index in range(want):
+                if index not in have:
+                    self._replicas[name].append(await self._spawn(svc, index))
+
+    async def _apply_planner_decision(self) -> None:
+        """Follow VirtualConnector decisions from discovery (the planner
+        'PATCHes the DGD'; we reconcile it — ref: kubernetes_connector /
+        virtual_connector split)."""
+        if self.runtime is None:
+            return
+        key = f"v1/planner/{self.spec.namespace}/target_replicas"
+        try:
+            found = await self.runtime.discovery.get_prefix(key)
+        except Exception:  # noqa: BLE001 — discovery hiccup; retry next tick
+            log.exception("planner decision read failed")
+            return
+        decision = found.get(key)
+        if not decision or decision.get("decision_id", 0) <= self._applied_decision_id:
+            return
+        self._applied_decision_id = decision["decision_id"]
+        for component, n in (decision.get("targets") or {}).items():
+            if component in self.spec.services:
+                self.set_replicas(component, int(n))
+            else:
+                log.warning("planner decision for unknown service %r",
+                            component)
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:  # noqa: BLE001 — controller must keep going
+                log.exception("reconcile failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def close(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+        for replicas in self._replicas.values():
+            for replica in list(replicas):
+                await self._drain(replica)
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+    import json
+
+    from ..runtime import DistributedRuntime, RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.deploy")
+    parser.add_argument("--spec", required=True, help="deployment YAML")
+    parser.add_argument("--log-dir", default=None)
+    parser.add_argument("--emit-k8s", action="store_true",
+                        help="print Kubernetes manifests and exit")
+    parser.add_argument("--follow-planner", action="store_true",
+                        help="apply VirtualConnector scaling decisions "
+                             "from discovery")
+    args = parser.parse_args(argv)
+    spec = GraphDeploymentSpec.from_yaml(args.spec)
+    if args.emit_k8s:
+        from .manifests import render_k8s_manifests
+
+        print(render_k8s_manifests(spec))
+        return
+    runtime = None
+    if args.follow_planner:
+        runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    controller = LocalDeploymentController(spec, runtime=runtime,
+                                           log_dir=args.log_dir)
+    controller.start()
+    log.info("deployment %s up: %s", spec.name,
+             json.dumps({k: v.replicas for k, v in spec.services.items()}))
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await controller.close()
+        if runtime is not None:
+            await runtime.shutdown()

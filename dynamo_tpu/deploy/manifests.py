@@ -1,0 +1,78 @@
+"""Render Kubernetes manifests from a GraphDeploymentSpec.
+
+For real clusters the reference's operator materializes Deployments/
+Services from the DGD CRD; here the same spec renders standard manifests
+an operator-less cluster can `kubectl apply` directly, with the
+KubernetesConnector (planner/connectors.py) handling the scaling edge by
+patching `spec.replicas`.
+"""
+
+from __future__ import annotations
+
+from .spec import GraphDeploymentSpec, ServiceSpec
+
+IMAGE_PLACEHOLDER = "dynamo-tpu:latest"
+
+
+def _deployment(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
+    env = []
+    for k, v in {**spec.env, **svc.env}.items():
+        env.append({"name": k, "value": str(v)})
+    labels = {
+        "app.kubernetes.io/part-of": spec.name,
+        "app.kubernetes.io/component": svc.name,
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{spec.name}-{svc.name}",
+            "labels": labels,
+        },
+        "spec": {
+            "replicas": svc.replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [{
+                        "name": svc.name,
+                        "image": IMAGE_PLACEHOLDER,
+                        "command": svc.argv(),
+                        "env": env,
+                    }],
+                },
+            },
+        },
+    }
+
+
+def _service(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
+    """ClusterIP service for frontends (the HTTP ingress point)."""
+    port = 8000
+    if "--port" in svc.args:
+        port = int(svc.args[svc.args.index("--port") + 1])
+    labels = {
+        "app.kubernetes.io/part-of": spec.name,
+        "app.kubernetes.io/component": svc.name,
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{spec.name}-{svc.name}", "labels": labels},
+        "spec": {
+            "selector": labels,
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def render_k8s_manifests(spec: GraphDeploymentSpec) -> str:
+    import yaml
+
+    docs = []
+    for svc in spec.services.values():
+        docs.append(_deployment(spec, svc))
+        if svc.kind == "frontend":
+            docs.append(_service(spec, svc))
+    return yaml.safe_dump_all(docs, sort_keys=False)

@@ -1,0 +1,104 @@
+"""Graph deployment spec — the DynamoGraphDeployment analog.
+
+YAML shape (ref: examples/backends/sglang/deploy/disagg-multinode.yaml —
+services with replicas + engine args under one deployment):
+
+    name: my-deployment
+    namespace: dynamo
+    env:                       # shared env for every service
+      DYNT_DISCOVERY_BACKEND: file
+      DYNT_DISCOVERY_PATH: /tmp/disc
+    services:
+      frontend:
+        kind: frontend         # maps to python -m dynamo_tpu.frontend
+        replicas: 1
+        args: ["--port", "8000", "--router-mode", "kv"]
+      decode:
+        kind: worker
+        replicas: 2
+        args: ["--model", "qwen3-0.6b"]
+      prefill:
+        kind: worker
+        replicas: 1
+        args: ["--model", "qwen3-0.6b", "--mode", "prefill"]
+
+`kind` selects the module CLI; `command` overrides it entirely (escape
+hatch / tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional
+
+KIND_MODULES = {
+    "frontend": "dynamo_tpu.frontend",
+    "worker": "dynamo_tpu.worker",
+    "mocker": "dynamo_tpu.mocker",
+    "planner": "dynamo_tpu.planner",
+    "indexer": "dynamo_tpu.indexer",
+    "global_router": "dynamo_tpu.global_router",
+    "global_planner": "dynamo_tpu.global_planner",
+    "weights": "dynamo_tpu.weights",
+}
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    name: str
+    kind: str = ""
+    replicas: int = 1
+    args: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    command: Optional[list[str]] = None  # overrides kind's module CLI
+
+    def __post_init__(self) -> None:
+        if self.command is None and self.kind not in KIND_MODULES:
+            raise ValueError(
+                f"service {self.name!r}: unknown kind {self.kind!r} "
+                f"(known: {sorted(KIND_MODULES)}) and no explicit command")
+        if self.replicas < 0:
+            raise ValueError(f"service {self.name!r}: negative replicas")
+
+    def argv(self) -> list[str]:
+        if self.command is not None:
+            return list(self.command) + list(self.args)
+        return [sys.executable, "-m", KIND_MODULES[self.kind],
+                *self.args]
+
+
+@dataclasses.dataclass
+class GraphDeploymentSpec:
+    name: str
+    namespace: str = "dynamo"
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    services: dict[str, ServiceSpec] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GraphDeploymentSpec":
+        services = {}
+        for name, raw in (data.get("services") or {}).items():
+            services[name] = ServiceSpec(
+                name=name,
+                kind=raw.get("kind", ""),
+                replicas=int(raw.get("replicas", 1)),
+                args=[str(a) for a in raw.get("args", [])],
+                env={k: str(v) for k, v in (raw.get("env") or {}).items()},
+                command=raw.get("command"),
+            )
+        if not services:
+            raise ValueError("deployment spec has no services")
+        return cls(
+            name=data.get("name", "deployment"),
+            namespace=data.get("namespace", "dynamo"),
+            env={k: str(v) for k, v in (data.get("env") or {}).items()},
+            services=services,
+        )
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "GraphDeploymentSpec":
+        import yaml
+
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(yaml.safe_load(f))

@@ -184,6 +184,7 @@ class TpuWorker:
         self._weight_service = weight_service
         self._weights_from_peer = weights_from_peer
         self._weights_served = None
+        self._publish_task: Optional[asyncio.Task] = None
         self.weights_source = "init"  # init | service | peer
 
     async def start(self) -> None:
@@ -242,7 +243,8 @@ class TpuWorker:
             from ..weights.streaming import pull_weights
 
             flat = await pull_weights(self.runtime, self.card.namespace,
-                                      self.card.component)
+                                      self.card.component,
+                                      expected_key=self._weights_key())
             if flat is not None:
                 host_params = self._params_from_flat(flat, "peer")
         return host_params, client
@@ -272,7 +274,9 @@ class TpuWorker:
         )
         log.info("weights source: %s", self.weights_source)
         if weight_client is not None and self.weights_source != "service":
-            # Publish for the next (re)start; best-effort.
+            # Publish for the next (re)start — best-effort AND off the
+            # startup critical path (it only benefits a future restart;
+            # the host gather of every param must not delay first serve).
             def _publish() -> None:
                 try:
                     weight_client.store(self._weights_key(),
@@ -281,7 +285,8 @@ class TpuWorker:
                     # best-effort; serving continues without it
                     log.exception("weight publish failed")
 
-            await asyncio.to_thread(_publish)
+            self._publish_task = asyncio.create_task(
+                asyncio.to_thread(_publish))
         if self._warmup:
             await asyncio.to_thread(self.runner.warmup)
         if self.kvbm_config is not None and self.kvbm_config.enabled:
@@ -400,13 +405,21 @@ class TpuWorker:
 
     async def _stream_weights(self, body, ctx=None) -> AsyncIterator[dict]:
         """Stream this replica's parameters to a cold peer (chunked raw
-        bytes). Host transfer runs in a thread; frames stream as produced."""
+        bytes). All serialization (device->host gather + tobytes copies)
+        runs per-param in a thread so multi-GB copies never stall the
+        event loop mid-token-stream."""
         from ..weights.client import flatten_params
-        from ..weights.streaming import encode_param_chunks
+        from ..weights.streaming import encode_param_chunks, manifest_frame
 
         flat = await asyncio.to_thread(flatten_params, self.runner.params)
-        for frame in encode_param_chunks(flat):
-            yield frame
+        yield manifest_frame(self._weights_key(), len(flat))
+        for index, (key, arr) in enumerate(flat):
+            frames = await asyncio.to_thread(
+                lambda k=key, a=arr: list(encode_param_chunks([(k, a)])))
+            for frame in frames:
+                frame["total_params"] = len(flat)
+                frame["index"] = index
+                yield frame
 
     async def _scale_elastic(self, body, ctx=None) -> AsyncIterator[dict]:
         """Re-place params on a new dp/tp/sp/ep mesh split at runtime.
@@ -711,6 +724,14 @@ class TpuWorker:
             handle.cancel()
 
     async def close(self) -> None:
+        if self._publish_task is not None and not self._publish_task.done():
+            # Let an in-flight weight publish finish (bounded) — cancelling
+            # it would leave no arena for the next restart to attach.
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._publish_task), 30.0)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                pass
         for task in self._tasks:
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

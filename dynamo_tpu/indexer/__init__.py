@@ -105,6 +105,7 @@ class StandaloneIndexer:
         ns, component = subject
         client = (self.runtime.namespace(ns).component(component)
                   .endpoint("kv_blocks").client())
+        regap = False
         try:
             await client.start()
             await client.wait_for_instances(1, timeout=10)
@@ -116,15 +117,12 @@ class StandaloneIndexer:
                                       dump.get("last_event_id"))
                 # Replay events buffered during the RPC (snapshot+replay —
                 # stale ids skipped by the indexer, no await between pop
-                # and replay). A gap inside the window retries.
-                regap = False
+                # and replay).
                 for event in self._resyncing.pop(worker_id, []):
                     if self.tree.apply_event(event) == "gap":
                         regap = True
                 log.info("indexer resynced worker %x: %d blocks",
                          worker_id, len(pairs))
-                if regap:
-                    self._schedule_resync(worker_id)
                 break
         except Exception:  # noqa: BLE001 — best-effort; a later gap retries
             log.exception("indexer resync failed for %x", worker_id)
@@ -135,6 +133,10 @@ class StandaloneIndexer:
                 except Exception:  # noqa: BLE001
                     log.exception("buffered event replay failed")
             await client.close()
+        if regap:
+            # A gap inside the replay window retries — scheduled AFTER the
+            # finally so the retry's fresh buffer survives this invocation.
+            self._schedule_resync(worker_id)
 
     # -- query endpoints ----------------------------------------------------
 

@@ -300,6 +300,7 @@ class ModelWatcher:
             .endpoint("kv_blocks")
             .client()
         )
+        regap = False
         try:
             await client.start()
             await client.wait_for_instances(1, timeout=10)
@@ -314,19 +315,12 @@ class ModelWatcher:
                 # indexer's stale check; newer ones apply in order. No await
                 # between pop and replay, so no event can slip past both.
                 buffered = self._resyncing.pop(key, [])
-                regap = False
                 for event in buffered:
                     if entry.scheduler.indexer.apply_event(event) == "gap":
                         regap = True
                 log.info("resynced worker %x for %s (%s): %d blocks, "
                          "%d events replayed", instance_id, card.name,
                          reason, len(pairs), len(buffered))
-                if regap:
-                    # An event was lost inside the resync window itself —
-                    # without this, _last_event_id has advanced and the
-                    # live path would never notice.
-                    self._schedule_resync(entry, instance_id,
-                                          reason="replay-gap")
                 break
         except Exception:  # noqa: BLE001 — resync is best-effort; events
             # keep flowing and a later gap retries
@@ -341,6 +335,12 @@ class ModelWatcher:
                 except Exception:  # noqa: BLE001
                     log.exception("buffered event replay failed")
             await client.close()
+        if regap:
+            # An event was lost inside the resync window itself — without
+            # this, _last_event_id has advanced and the live path would
+            # never notice. Scheduled strictly AFTER the finally above so
+            # the retry's fresh buffer can't be popped by this invocation.
+            self._schedule_resync(entry, instance_id, reason="replay-gap")
 
     def _build_entry(self, card: ModelDeploymentCard) -> ModelEntry:
         endpoint = (

@@ -121,6 +121,7 @@ class WeightClient:
         if not reply.get("ok"):
             raise RuntimeError(f"weight alloc failed: {reply.get('error')}")
         segments = reply["segments"]
+        token = reply.get("token", "")
         for key, arr in flat:
             shm = _attach_shm(segments[key])
             try:
@@ -128,7 +129,7 @@ class WeightClient:
                 view[...] = arr
             finally:
                 shm.close()
-        reply = self._rpc({"cmd": "commit", "model": model})
+        reply = self._rpc({"cmd": "commit", "model": model, "token": token})
         if not reply.get("ok"):
             raise RuntimeError(f"weight commit failed: {reply.get('error')}")
         log.info("published %d params for %s to the weight service",
@@ -143,15 +144,22 @@ class WeightClient:
         if not reply.get("ok") or not reply.get("complete"):
             return None
         out: dict[str, np.ndarray] = {}
-        for meta in reply["params"]:
-            shm = _attach_shm(meta["shm_name"])
-            try:
-                view = np.ndarray(tuple(meta["shape"]),
-                                  dtype=np.dtype(meta["dtype"]),
-                                  buffer=shm.buf)
-                out[meta["path"]] = np.array(view)  # own the memory
-            finally:
-                shm.close()
+        try:
+            for meta in reply["params"]:
+                shm = _attach_shm(meta["shm_name"])
+                try:
+                    view = np.ndarray(tuple(meta["shape"]),
+                                      dtype=np.dtype(meta["dtype"]),
+                                      buffer=shm.buf)
+                    out[meta["path"]] = np.array(view)  # own the memory
+                finally:
+                    shm.close()
+        except (FileNotFoundError, ValueError) as exc:
+            # Arena freed/replaced between manifest and attach (concurrent
+            # store/delete): the fast path just misses — callers fall back
+            # to init, they must never crash on it.
+            log.warning("weight arena vanished mid-fetch (%r)", exc)
+            return None
         return out
 
     def load_or_init(self, model: str, template,

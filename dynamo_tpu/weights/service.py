@@ -76,6 +76,7 @@ class _Param:
 @dataclasses.dataclass
 class _Arena:
     model: str
+    token: str = ""  # alloc ownership: only the allocator may commit
     params: dict[str, _Param] = dataclasses.field(default_factory=dict)
     complete: bool = False
 
@@ -95,12 +96,14 @@ class WeightServiceServer:
     # -- commands ----------------------------------------------------------
 
     def _cmd_alloc(self, msg: dict) -> dict:
+        import uuid
+
         model = msg["model"]
         with self._lock:
             old = self._arenas.pop(model, None)
             if old is not None:
                 self._free_arena(old)
-            arena = _Arena(model=model)
+            arena = _Arena(model=model, token=uuid.uuid4().hex)
             segments = {}
             try:
                 for spec in msg["params"]:
@@ -118,13 +121,19 @@ class WeightServiceServer:
             self._arenas[model] = arena
         log.info("allocated arena for %s: %d params, %.1f MiB",
                  model, len(arena.params), arena.nbytes() / 2**20)
-        return {"ok": True, "segments": segments}
+        return {"ok": True, "segments": segments, "token": arena.token}
 
     def _cmd_commit(self, msg: dict) -> dict:
         with self._lock:
             arena = self._arenas.get(msg["model"])
             if arena is None:
                 return {"ok": False, "error": "no such arena"}
+            if msg.get("token") != arena.token:
+                # A concurrent publisher replaced this arena after the
+                # caller's alloc: committing would mark the OTHER writer's
+                # half-written segments complete.
+                return {"ok": False,
+                        "error": "arena replaced by a concurrent publisher"}
             arena.complete = True
         return {"ok": True}
 

@@ -20,6 +20,14 @@ log = get_logger("weights.streaming")
 STREAM_CHUNK_BYTES = 4 * 2**20
 
 
+def manifest_frame(weights_key: str, n_params: int) -> dict:
+    """First frame of a weight stream: identifies WHAT is being streamed
+    so a puller can reject weights from the wrong model (two architecturally
+    identical models would otherwise pass shape validation)."""
+    return {"manifest": True, "weights_key": weights_key,
+            "total_params": n_params}
+
+
 def encode_param_chunks(flat: list[tuple[str, np.ndarray]]) -> Iterator[dict]:
     """Stream a flattened param list as wire frames. Each param is split
     into <= STREAM_CHUNK_BYTES raw-byte chunks."""
@@ -72,11 +80,14 @@ class ParamAssembler:
 
 
 async def pull_weights(runtime, namespace: str, component: str,
+                       expected_key: Optional[str] = None,
                        timeout: float = 120.0) -> Optional[dict[str, np.ndarray]]:
     """Pull a full parameter set from any live peer serving the `weights`
-    endpoint. Returns path-addressed host arrays, or None on failure (the
-    caller falls back to init/checkpoint — same degradation the reference
-    takes when ModelExpress is unavailable)."""
+    endpoint. `expected_key` (the puller's weights key) must match the
+    stream's manifest — shape checks alone can't tell two same-architecture
+    models apart. Returns path-addressed host arrays, or None on failure
+    (the caller falls back to init/checkpoint — same degradation the
+    reference takes when ModelExpress is unavailable)."""
     import asyncio
 
     from ..runtime.push_router import PushRouter
@@ -95,6 +106,15 @@ async def pull_weights(runtime, namespace: str, component: str,
             if frame.get("error"):
                 log.warning("peer weight pull failed: %s", frame["error"])
                 return None
+            if frame.get("manifest"):
+                peer_key = frame.get("weights_key")
+                if expected_key is not None and peer_key != expected_key:
+                    log.warning(
+                        "peer serves %r, we need %r; not pulling (same "
+                        "component hosting a different model?)",
+                        peer_key, expected_key)
+                    return None
+                continue
             assembler.add(frame)
         if not assembler.complete:
             log.warning("peer weight pull incomplete")
