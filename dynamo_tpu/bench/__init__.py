@@ -1,0 +1,258 @@
+"""Multiturn conversation benchmark + aiperf-style concurrency sweeps.
+
+The reference benchmarks with two tools: `lib/bench`'s multiturn_bench
+binary (multiturn conversations against the OpenAI endpoint — growing
+shared prefixes are what make KV routing/prefix caching matter) and
+aiperf concurrency sweeps (`--synthetic-input-tokens-mean ISL
+--output-tokens-mean OSL --concurrency C` producing TTFT/ITL/throughput
+JSON; ref: benchmarks/README.md:26-50, recipes/llama-3-70b perf.yaml).
+
+This module is both:
+
+    python -m dynamo_tpu.bench --url http://HOST:PORT --model M \
+        --concurrency 1,4,16 --conversations 32 --turns 4 \
+        --isl-mean 512 --osl-mean 64 --out results.json
+
+Each concurrency level runs `--conversations` multiturn conversations
+with at most C in flight; every turn streams (TTFT/ITL measured per
+turn), carries the full history (prefix growth), and appends the
+assistant's reply. Results: per-level TTFT/ITL percentiles, token
+throughput, requests/s — one JSON document, Pareto-ready.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("bench")
+
+_WORDS = ("alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+          "kilo lima mike november oscar papa quebec romeo sierra tango "
+          "uniform victor whiskey xray yankee zulu").split()
+
+
+def synth_text(n_tokens: int, rng: np.random.Generator) -> str:
+    """~n_tokens of synthetic text (one word ~ one token for byte-level /
+    BPE tokenizers alike — close enough for load shaping)."""
+    return " ".join(_WORDS[int(i)] for i in rng.integers(0, len(_WORDS),
+                                                         max(1, n_tokens)))
+
+
+@dataclasses.dataclass
+class TurnStat:
+    ttft_ms: float
+    total_ms: float
+    output_tokens: int
+    error: Optional[str] = None
+
+    @property
+    def itl_ms(self) -> float:
+        if self.output_tokens <= 1:
+            return 0.0
+        return (self.total_ms - self.ttft_ms) / (self.output_tokens - 1)
+
+
+@dataclasses.dataclass
+class SweepLevel:
+    concurrency: int
+    turns: list[TurnStat] = dataclasses.field(default_factory=list)
+    wall_s: float = 0.0
+
+    def summary(self) -> dict:
+        ok = [t for t in self.turns if t.error is None]
+        ttfts = [t.ttft_ms for t in ok]
+        itls = [t.itl_ms for t in ok if t.output_tokens > 1]
+        out_tokens = sum(t.output_tokens for t in ok)
+
+        def pct(vals, p):
+            return round(float(np.percentile(vals, p)), 2) if vals else None
+
+        return {
+            "concurrency": self.concurrency,
+            "requests": len(self.turns),
+            "errors": len(self.turns) - len(ok),
+            "wall_s": round(self.wall_s, 3),
+            "requests_per_s": (round(len(self.turns) / self.wall_s, 2)
+                               if self.wall_s else 0),
+            "output_tokens_per_s": (round(out_tokens / self.wall_s, 1)
+                                    if self.wall_s else 0),
+            "ttft_ms": {"p50": pct(ttfts, 50), "p90": pct(ttfts, 90),
+                        "p99": pct(ttfts, 99)},
+            "itl_ms": {"p50": pct(itls, 50), "p90": pct(itls, 90),
+                       "p99": pct(itls, 99)},
+        }
+
+
+class MultiturnBench:
+    def __init__(
+        self,
+        url: str,
+        model: str,
+        turns: int = 4,
+        isl_mean: int = 256,
+        osl_mean: int = 64,
+        system_prompt_tokens: int = 0,
+        seed: int = 0,
+        timeout: float = 300.0,
+    ) -> None:
+        self.url = url.rstrip("/")
+        self.model = model
+        self.turns = turns
+        self.isl_mean = isl_mean
+        self.osl_mean = osl_mean
+        self.system_prompt_tokens = system_prompt_tokens
+        self.seed = seed
+        self.timeout = timeout
+
+    async def _one_turn(self, session, messages: list[dict],
+                        max_tokens: int) -> tuple[TurnStat, str]:
+        """Stream one chat turn; returns (stats, assistant_text)."""
+        import aiohttp
+
+        start = time.monotonic()
+        first: Optional[float] = None
+        tokens = 0
+        text_parts: list[str] = []
+        try:
+            async with session.post(
+                f"{self.url}/v1/chat/completions",
+                json={"model": self.model, "messages": messages,
+                      "max_tokens": max_tokens, "stream": True},
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                if resp.status != 200:
+                    body = await resp.text()
+                    return TurnStat(0, 0, 0,
+                                    error=f"http {resp.status}: "
+                                          f"{body[:200]}"), ""
+                async for raw in resp.content:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    try:
+                        chunk = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    if chunk.get("error"):
+                        return TurnStat(0, 0, tokens,
+                                        error=str(chunk["error"])), ""
+                    delta = (chunk.get("choices") or [{}])[0].get(
+                        "delta", {})
+                    content = delta.get("content")
+                    if content:
+                        if first is None:
+                            first = time.monotonic()
+                        tokens += 1  # one delta ~ one token in our stack
+                        text_parts.append(content)
+        except (asyncio.TimeoutError, OSError,
+                aiohttp.ClientError) as exc:
+            return TurnStat(0, 0, tokens, error=repr(exc)), ""
+        total_ms = (time.monotonic() - start) * 1e3
+        ttft_ms = ((first - start) * 1e3) if first else total_ms
+        return TurnStat(ttft_ms, total_ms, tokens), "".join(text_parts)
+
+    async def _one_conversation(self, session, conv_idx: int,
+                                level: SweepLevel) -> None:
+        rng = np.random.default_rng(self.seed * 100_003 + conv_idx)
+        messages: list[dict] = []
+        if self.system_prompt_tokens:
+            # Shared system prompt: the cross-conversation prefix that KV
+            # routing scores on (same seed -> same text for every conv).
+            sys_rng = np.random.default_rng(self.seed)
+            messages.append({"role": "system",
+                            "content": synth_text(self.system_prompt_tokens,
+                                                  sys_rng)})
+        for _turn in range(self.turns):
+            isl = max(4, int(rng.lognormal(np.log(self.isl_mean), 0.3)))
+            osl = max(2, int(rng.lognormal(np.log(self.osl_mean), 0.3)))
+            messages.append({"role": "user",
+                             "content": synth_text(isl, rng)})
+            stat, reply = await self._one_turn(session, messages, osl)
+            level.turns.append(stat)
+            if stat.error is not None:
+                return
+            messages.append({"role": "assistant", "content": reply})
+
+    async def run_level(self, concurrency: int,
+                        conversations: int) -> SweepLevel:
+        import aiohttp
+
+        level = SweepLevel(concurrency=concurrency)
+        sem = asyncio.Semaphore(concurrency)
+
+        async def run_conv(i: int) -> None:
+            async with sem:
+                await self._one_conversation(session, i, level)
+
+        start = time.monotonic()
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(*[run_conv(i)
+                                   for i in range(conversations)])
+        level.wall_s = time.monotonic() - start
+        return level
+
+    async def sweep(self, concurrencies: list[int],
+                    conversations: int) -> dict:
+        levels = []
+        for c in concurrencies:
+            log.info("bench level: concurrency=%d conversations=%d "
+                     "turns=%d", c, conversations, self.turns)
+            level = await self.run_level(c, conversations)
+            summary = level.summary()
+            log.info("  -> %s", json.dumps(summary))
+            levels.append(summary)
+        return {
+            "model": self.model,
+            "url": self.url,
+            "turns": self.turns,
+            "isl_mean": self.isl_mean,
+            "osl_mean": self.osl_mean,
+            "conversations_per_level": conversations,
+            "levels": levels,
+        }
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.bench")
+    parser.add_argument("--url", default="http://127.0.0.1:8000")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--concurrency", default="1,4,16",
+                        help="comma-separated sweep levels")
+    parser.add_argument("--conversations", type=int, default=32,
+                        help="conversations per level")
+    parser.add_argument("--turns", type=int, default=4)
+    parser.add_argument("--isl-mean", type=int, default=256)
+    parser.add_argument("--osl-mean", type=int, default=64)
+    parser.add_argument("--system-prompt-tokens", type=int, default=0,
+                        help="shared system prompt length (cross-"
+                             "conversation prefix for KV-routing A/B)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None, help="write JSON here too")
+    args = parser.parse_args(argv)
+    bench = MultiturnBench(
+        args.url, args.model, turns=args.turns, isl_mean=args.isl_mean,
+        osl_mean=args.osl_mean,
+        system_prompt_tokens=args.system_prompt_tokens, seed=args.seed,
+    )
+    report = await bench.sweep(
+        [int(c) for c in args.concurrency.split(",") if c.strip()],
+        args.conversations,
+    )
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    print(text)

@@ -59,7 +59,10 @@ class LocalDeploymentController:
         self._backoff_until: dict[str, float] = {}
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
-        self._applied_decision_id = 0
+        # (decision_id, ts) of the last applied planner decision — compared
+        # by VALUE, not monotonically: a restarted planner's counter resets
+        # to 0 and must not be ignored until it re-passes the old maximum.
+        self._applied_decision: Optional[tuple] = None
         self.restarts = 0
 
     # -- scaling API (the operator's CRD-patch edge) -----------------------
@@ -101,12 +104,14 @@ class LocalDeploymentController:
             log_path = os.path.join(self.log_dir,
                                     f"{svc.name}-{index}.log")
             stdout = open(log_path, "ab")
-        proc = await asyncio.create_subprocess_exec(
-            *svc.argv(), env=env, stdout=stdout, stderr=stdout,
-            start_new_session=True,  # isolate signals from the controller
-        )
-        if stdout is not asyncio.subprocess.DEVNULL:
-            stdout.close()  # child holds its own fd
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *svc.argv(), env=env, stdout=stdout, stderr=stdout,
+                start_new_session=True,  # isolate signals from controller
+            )
+        finally:
+            if stdout is not asyncio.subprocess.DEVNULL:
+                stdout.close()  # child holds its own fd (or spawn failed)
         log.info("spawned %s[%d] pid=%d: %s", svc.name, index, proc.pid,
                  " ".join(svc.argv()))
         return _Replica(service=svc.name, index=index, proc=proc,
@@ -157,13 +162,15 @@ class LocalDeploymentController:
                         "backoff %.1fs)", name, replica.index,
                         replica.proc.returncode, ran_for, streak, delay)
             self._replicas[name] = live
-            # Scale down: drain the highest indices first.
+            # Scale down: drain extras in parallel (one hung replica must
+            # not stall the reconcile loop N x grace).
             want = self.desired[name]
             extras = [r for r in live if r.index >= want]
-            for replica in sorted(extras, key=lambda r: -r.index):
-                log.info("scaling down %s[%d]", name, replica.index)
-                await self._drain(replica)
-                self._replicas[name].remove(replica)
+            if extras:
+                for replica in extras:
+                    log.info("scaling down %s[%d]", name, replica.index)
+                    self._replicas[name].remove(replica)
+                await asyncio.gather(*(self._drain(r) for r in extras))
             # Scale up (respecting crash backoff).
             if time.monotonic() < self._backoff_until.get(name, 0.0):
                 continue
@@ -185,9 +192,12 @@ class LocalDeploymentController:
             log.exception("planner decision read failed")
             return
         decision = found.get(key)
-        if not decision or decision.get("decision_id", 0) <= self._applied_decision_id:
+        if not decision:
             return
-        self._applied_decision_id = decision["decision_id"]
+        mark = (decision.get("decision_id"), decision.get("ts"))
+        if mark == self._applied_decision:
+            return
+        self._applied_decision = mark
         for component, n in (decision.get("targets") or {}).items():
             if component in self.spec.services:
                 self.set_replicas(component, int(n))
@@ -213,9 +223,11 @@ class LocalDeploymentController:
         self._stop.set()
         if self._task is not None:
             await self._task
-        for replicas in self._replicas.values():
-            for replica in list(replicas):
-                await self._drain(replica)
+        await asyncio.gather(*(
+            self._drain(replica)
+            for replicas in self._replicas.values()
+            for replica in list(replicas)
+        ))
 
 
 async def main(argv: Optional[list[str]] = None) -> None:

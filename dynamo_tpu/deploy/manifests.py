@@ -50,8 +50,12 @@ def _deployment(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
 def _service(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
     """ClusterIP service for frontends (the HTTP ingress point)."""
     port = 8000
-    if "--port" in svc.args:
-        port = int(svc.args[svc.args.index("--port") + 1])
+    args = svc.args
+    for i, arg in enumerate(args):
+        if arg == "--port" and i + 1 < len(args):
+            port = int(args[i + 1])
+        elif arg.startswith("--port="):
+            port = int(arg.split("=", 1)[1])
     labels = {
         "app.kubernetes.io/part-of": spec.name,
         "app.kubernetes.io/component": svc.name,

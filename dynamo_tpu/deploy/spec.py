@@ -77,15 +77,22 @@ class GraphDeploymentSpec:
 
     @classmethod
     def from_dict(cls, data: dict) -> "GraphDeploymentSpec":
+        import shlex
+
         services = {}
         for name, raw in (data.get("services") or {}).items():
+            command = raw.get("command")
+            if isinstance(command, str):
+                # YAML `command: /bin/echo -n` — split shell-style; a bare
+                # string iterated as a list would become per-character argv.
+                command = shlex.split(command)
             services[name] = ServiceSpec(
                 name=name,
                 kind=raw.get("kind", ""),
                 replicas=int(raw.get("replicas", 1)),
                 args=[str(a) for a in raw.get("args", [])],
                 env={k: str(v) for k, v in (raw.get("env") or {}).items()},
-                command=raw.get("command"),
+                command=command,
             )
         if not services:
             raise ValueError("deployment spec has no services")

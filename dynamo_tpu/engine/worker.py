@@ -730,7 +730,8 @@ class TpuWorker:
             try:
                 await asyncio.wait_for(
                     asyncio.shield(self._publish_task), 30.0)
-            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — only TimeoutError is
+                # reachable; _publish logs its own failures
                 pass
         for task in self._tasks:
             task.cancel()

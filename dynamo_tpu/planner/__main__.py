@@ -44,6 +44,10 @@ async def main(argv=None) -> None:
                         help="aggregated deployment (no prefill pool)")
     parser.add_argument("--connector", default="virtual",
                         choices=["virtual", "kubernetes"])
+    parser.add_argument("--namespace", default="dynamo",
+                        help="virtual connector decision namespace (must "
+                             "match the deployment controller's spec "
+                             "namespace)")
     parser.add_argument("--k8s-deployment", default=None)
     parser.add_argument("--k8s-namespace", default="default")
     args = parser.parse_args(argv)
@@ -63,7 +67,7 @@ async def main(argv=None) -> None:
         connector = KubernetesConnector(args.k8s_deployment,
                                         args.k8s_namespace)
     else:
-        connector = VirtualConnector(runtime)
+        connector = VirtualConnector(runtime, namespace=args.namespace)
     disagg = not args.aggregated
     planner = SlaPlanner(
         config, connector,

@@ -1,0 +1,134 @@
+"""Multiturn bench harness tests (ref surface: lib/bench multiturn_bench +
+aiperf concurrency sweeps)."""
+
+import asyncio
+import json
+import uuid
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.bench import MultiturnBench, SweepLevel, TurnStat, synth_text
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+class TestUnits:
+    def test_synth_text_token_shaping(self):
+        rng = np.random.default_rng(0)
+        text = synth_text(100, rng)
+        assert len(text.split()) == 100
+        # deterministic per rng state
+        assert synth_text(10, np.random.default_rng(5)) == \
+            synth_text(10, np.random.default_rng(5))
+
+    def test_turnstat_itl(self):
+        stat = TurnStat(ttft_ms=10.0, total_ms=110.0, output_tokens=11)
+        assert stat.itl_ms == 10.0
+        assert TurnStat(5.0, 5.0, 1).itl_ms == 0.0
+
+    def test_level_summary(self):
+        level = SweepLevel(concurrency=2)
+        level.turns = [TurnStat(10, 100, 10), TurnStat(20, 120, 11),
+                       TurnStat(0, 0, 0, error="boom")]
+        level.wall_s = 2.0
+        s = level.summary()
+        assert s["requests"] == 3 and s["errors"] == 1
+        assert s["output_tokens_per_s"] == round(21 / 2.0, 1)
+        assert s["ttft_ms"]["p50"] == 15.0
+        assert s["itl_ms"]["p99"] is not None
+
+
+class TestBenchE2E:
+    def test_sweep_against_mocker(self, run, tmp_path):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=1024),
+                load_publish_interval=0.2,
+            )
+            await worker.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0,
+                                router_mode="kv")
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+
+            bench = MultiturnBench(
+                f"http://127.0.0.1:{frontend.port}", "mock-model",
+                turns=2, isl_mean=32, osl_mean=6,
+                system_prompt_tokens=16,
+            )
+            report = await bench.sweep([1, 3], conversations=3)
+            assert len(report["levels"]) == 2
+            for level in report["levels"]:
+                assert level["errors"] == 0
+                # 3 conversations x 2 turns
+                assert level["requests"] == 6
+                assert level["output_tokens_per_s"] > 0
+                assert level["ttft_ms"]["p50"] > 0
+                assert level["ttft_ms"]["p99"] >= level["ttft_ms"]["p50"]
+            # history grows across turns -> level is self-consistent JSON
+            json.dumps(report)
+
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)
+
+    def test_cli_writes_artifact(self, run, tmp_path):
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=512),
+                load_publish_interval=0.2,
+            )
+            await worker.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            out = str(tmp_path / "bench.json")
+            from dynamo_tpu.bench import main
+
+            await main([
+                "--url", f"http://127.0.0.1:{frontend.port}",
+                "--model", "mock-model", "--concurrency", "2",
+                "--conversations", "2", "--turns", "2",
+                "--isl-mean", "16", "--osl-mean", "4", "--out", out,
+            ])
+            report = json.load(open(out))
+            assert report["levels"][0]["requests"] == 4
+            assert report["levels"][0]["errors"] == 0
+
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=120)

@@ -146,6 +146,13 @@ class TestControllerReconcile:
             ctl.set_replicas("decode", 1)
             await ctl.reconcile_once()
             assert ctl.desired["decode"] == 1
+            # a RESTARTED planner's counter resets to 1 — its decisions
+            # must still apply (value comparison, not monotonic)
+            connector2 = VirtualConnector(rt, namespace="dynamo")
+            await connector2.set_component_replicas(
+                [TargetReplica(component="decode", desired_replicas=2)])
+            await ctl.reconcile_once()
+            assert ctl.desired["decode"] == 2
             await ctl.close()
             await rt.shutdown()
 
