@@ -147,6 +147,7 @@ class ModelRunner:
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
         self._embed_fns: dict[int, callable] = {}
+        self._zero_embeds: dict[int, jax.Array] = {}  # per-bucket, mm only
         self.decode_steps = 0
 
     # -- compiled step builders -------------------------------------------
@@ -180,14 +181,18 @@ class ModelRunner:
         cfg = self.model_config
         attention_fn = self._attention_fn
         with_lora = self.lora_pack is not None
+        with_mm = cfg.image_token_id >= 0
 
         def step(params, kv, tokens, positions, block_table, kv_lens,
                  valid, last_idx, temperature, top_p, top_k, seeds,
-                 lora=None, lora_idx=None):
+                 lora=None, lora_idx=None, extra_embeds=None):
             kv, logits = forward(
                 params, cfg, tokens, positions, kv, block_table, kv_lens,
                 valid=valid, attention_fn=attention_fn,
                 lora=lora if with_lora else None, lora_idx=lora_idx,
+                extra_embeds=extra_embeds if with_mm else None,
+                extra_mask=((tokens == cfg.image_token_id)
+                            if with_mm else None),
             )
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
@@ -323,9 +328,11 @@ class ModelRunner:
         kv_len_after: int,
         sampling: tuple[float, float, int, int],  # (temp, top_p, top_k, seed)
         lora_idx: int = 0,
+        chunk_embeds: Optional[np.ndarray] = None,  # [t, H] splice rows
     ) -> int:
         """Run one prefill chunk; returns the sampled token id (meaningful
-        only on the final chunk)."""
+        only on the final chunk). `chunk_embeds` rows replace the token
+        embedding at image-placeholder positions within this chunk."""
         t = len(tokens)
         bucket = self._bucket_for(t)
         fn = self._prefill_fns.get(bucket)
@@ -348,9 +355,30 @@ class ModelRunner:
             jnp.asarray([top_k], np.int32),
             jnp.asarray([seed], np.uint32),
         ]
+        # Optional features pass by KEYWORD: with lora disabled, a
+        # positional embeds array would silently bind to the `lora`
+        # parameter and the splice would never happen.
+        kwargs: dict = {}
         if self.lora_pack is not None:
-            args += [self.lora_pack, jnp.asarray([lora_idx], jnp.int32)]
-        self.kv_cache, token = fn(*args)
+            kwargs["lora"] = self.lora_pack
+            kwargs["lora_idx"] = jnp.asarray([lora_idx], jnp.int32)
+        if self.model_config.image_token_id >= 0:
+            if chunk_embeds is not None:
+                embeds = np.zeros((1, bucket, self.model_config.hidden),
+                                  np.float32)
+                embeds[0, :t] = chunk_embeds
+                kwargs["extra_embeds"] = jnp.asarray(embeds)
+            else:
+                # Text-only request on a multimodal engine: reuse a cached
+                # device zero buffer (a fresh 10s-of-MB host alloc +
+                # transfer per chunk would tax every text request).
+                zeros = self._zero_embeds.get(bucket)
+                if zeros is None:
+                    zeros = jnp.zeros(
+                        (1, bucket, self.model_config.hidden), jnp.float32)
+                    self._zero_embeds[bucket] = zeros
+                kwargs["extra_embeds"] = zeros
+        self.kv_cache, token = fn(*args, **kwargs)
         return int(np.asarray(token)[0])
 
     def decode(
@@ -460,6 +488,7 @@ class ModelRunner:
         self._prefill_fns = {}
         self._ring_prefill_fns = {}
         self._embed_fns = {}
+        self._zero_embeds = {}
         log.info("resharded onto mesh %s", dict(mesh.shape))
 
     def gather_pages(self, page_ids: np.ndarray) -> np.ndarray:

@@ -25,7 +25,7 @@ import numpy as np
 
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..runtime.logging import get_logger
-from ..tokens import compute_block_hashes, lora_id_of
+from ..tokens import compute_block_hashes
 from .model_runner import ModelRunner
 from .pages import PageAllocation, PagePool
 
@@ -58,6 +58,9 @@ class _Seq:
     onboard_first_token: Optional[int] = None
     # Multi-LoRA: adapter slot in the runner's pack (0 = base model)
     lora_idx: int = 0
+    # Multimodal: encoder rows spliced at image-placeholder positions,
+    # consumed in token order across prefill chunks
+    media_embeds: Optional[np.ndarray] = None  # [total_rows, H]
 
     @property
     def decode_ready(self) -> bool:
@@ -155,6 +158,7 @@ class InferenceScheduler:
         onboard_blocks: Optional[np.ndarray] = None,
         onboard_first_token: Optional[int] = None,
         lora_idx: int = 0,
+        media_embeds: Optional[np.ndarray] = None,
     ) -> "_SubmitHandle":
         handle = _SubmitHandle()
         self._incoming.put((request, emit, handle, {
@@ -163,6 +167,7 @@ class InferenceScheduler:
             "onboard_blocks": onboard_blocks,
             "onboard_first_token": onboard_first_token,
             "lora_idx": lora_idx,
+            "media_embeds": media_embeds,
         }))
         self._wake.set()
         return handle
@@ -241,6 +246,7 @@ class InferenceScheduler:
                 seq.onboard_blocks = extra.get("onboard_blocks")
                 seq.onboard_first_token = extra.get("onboard_first_token")
                 seq.lora_idx = extra.get("lora_idx", 0)
+                seq.media_embeds = extra.get("media_embeds")
                 handle.seq = seq
                 if handle._cancelled:  # cancelled before the seq existed
                     seq.cancelled = True
@@ -261,7 +267,7 @@ class InferenceScheduler:
             return None
         block_hashes = compute_block_hashes(
             request.token_ids, self.page_size,
-            lora_id=lora_id_of(request.lora_name))
+            lora_id=request.kv_salt())
         seed = request.sampling.seed
         if seed is None:
             seed = abs(hash(request.request_id)) & 0xFFFFFFFF
@@ -375,6 +381,7 @@ class InferenceScheduler:
             if (seq.prefill_pos == 0
                     and seq.prompt_len > budget
                     and seq.lora_idx == 0  # ring path has no adapter delta
+                    and seq.media_embeds is None  # nor embed splicing
                     and getattr(self.runner, "sp_size", 1) > 1):
                 sampling = seq.request.sampling
                 token = self.runner.prefill_ring(
@@ -398,12 +405,16 @@ class InferenceScheduler:
             )
             is_final = seq.prefill_pos + chunk >= seq.prompt_len
             sampling = seq.request.sampling
+            chunk_embeds = None
+            if seq.media_embeds is not None:
+                chunk_embeds = self._chunk_media_embeds(seq, tokens)
             token = self.runner.prefill_chunk(
                 tokens, seq.prefill_pos, seq.block_table,
                 kv_len_after=seq.prefill_pos + chunk,
                 sampling=(sampling.temperature, sampling.top_p,
                           sampling.top_k, seq.seed),
                 lora_idx=seq.lora_idx,
+                chunk_embeds=chunk_embeds,
             )
             seq.prefill_pos += chunk
             if is_final:
@@ -414,6 +425,23 @@ class InferenceScheduler:
                                        prompt_tokens=seq.prompt_len)
             return chunk
         return 0
+
+    def _chunk_media_embeds(self, seq: _Seq,
+                            chunk_tokens: np.ndarray) -> np.ndarray:
+        """[chunk, H] splice rows for this prefill chunk: placeholder
+        positions get consecutive encoder rows (consumption order = token
+        order, robust to chunk boundaries and prefix-cache skips)."""
+        img_id = self.runner.model_config.image_token_id
+        prompt = np.asarray(seq.request.token_ids[: seq.prefill_pos],
+                            np.int32)
+        consumed = int(np.count_nonzero(prompt == img_id))
+        out = np.zeros((len(chunk_tokens), seq.media_embeds.shape[1]),
+                       np.float32)
+        positions = np.nonzero(chunk_tokens == img_id)[0]
+        n = len(positions)
+        avail = seq.media_embeds[consumed: consumed + n]
+        out[positions[: len(avail)]] = avail
+        return out
 
     def _finish_prefill_only(self, seq: _Seq, first_token: int) -> None:
         """Disagg prefill side: park the prompt pages with the transfer

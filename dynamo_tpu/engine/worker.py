@@ -172,6 +172,13 @@ class TpuWorker:
         # Routers bootstrap/gap-resync from our local indexer (manager.py
         # gates resync RPCs on this flag).
         self.card.runtime_config["kv_blocks_endpoint"] = True
+        if self.model_config.image_token_id >= 0:
+            # Frontends expand image parts into these placeholder tokens
+            # (preprocessor._preprocess_multimodal).
+            self.card.runtime_config["multimodal"] = {
+                "image_token_id": self.model_config.image_token_id,
+                "n_image_tokens": self.model_config.n_image_tokens,
+            }
         self._tasks: list[asyncio.Task] = []
         self._lora_served: list = []
         self._served = None
@@ -698,6 +705,26 @@ class TpuWorker:
                 )
             # else: fall through — plain submit recomputes the prefill
 
+        if request.media_embeddings is not None:
+            import numpy as np
+
+            me = request.media_embeddings
+            rows = np.frombuffer(me["data"], np.float32).reshape(
+                tuple(me["shape"]))
+            if rows.shape[-1] != self.model_config.hidden:
+                yield EngineOutput(
+                    finish_reason="error",
+                    error=(f"media embeddings dim {rows.shape[-1]} != model "
+                           f"hidden {self.model_config.hidden} (wrong "
+                           "encoder preset?)")).to_wire()
+                return
+            submit_kwargs["media_embeds"] = rows
+        elif request.annotations.get("media_urls"):
+            yield EngineOutput(
+                finish_reason="error",
+                error="multimodal request reached the worker without "
+                      "embeddings (no encoder pool?)").to_wire()
+            return
         if request.lora_name:
             # Resolve the slot AFTER every await above: submit() runs in the
             # same event-loop step as this resolution, so lora_in_flight's

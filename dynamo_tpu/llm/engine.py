@@ -20,7 +20,7 @@ from ..kv_router import KvScheduler, WorkerWithDpRank
 from ..runtime.logging import get_logger
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.request_plane import ConnectionLost, RemoteError
-from ..tokens import compute_block_hashes, lora_id_of
+from ..tokens import compute_block_hashes
 from .protocols import EngineOutput, PreprocessedRequest
 
 log = get_logger("llm.engine")
@@ -82,7 +82,7 @@ class KvRouterEngine(TokenEngine):
             raise NoInstancesAvailable(self.router.client.endpoint.subject)
         block_hashes = compute_block_hashes(
             request.token_ids, self.scheduler.config.block_size,
-            lora_id=lora_id_of(request.lora_name),
+            lora_id=request.kv_salt(),
         )
         candidates = [WorkerWithDpRank(iid) for iid in avail]
         result = self.scheduler.select_worker(
@@ -101,6 +101,42 @@ class KvRouterEngine(TokenEngine):
                 yield EngineOutput.from_wire(item)
         finally:
             self.scheduler.free(request_id)
+
+
+class MultimodalEngine(TokenEngine):
+    """Resolve a request's images through the encoder pool (the E stage of
+    E/P/D) and attach the embeddings before the request hits prefill/
+    decode routing. No encoder pool -> explicit error (a silently dropped
+    image would produce confident answers about an image the model never
+    saw)."""
+
+    def __init__(self, inner: TokenEngine, pool_lookup) -> None:
+        self.inner = inner
+        self._pool_lookup = pool_lookup
+
+    async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
+        urls = request.annotations.get("media_urls")
+        if urls and request.media_embeddings is None:
+            from ..multimodal import encode_via_pool
+
+            pool = self._pool_lookup()
+            if pool is None or not pool.instances:
+                yield EngineOutput(
+                    finish_reason="error",
+                    error="multimodal request but no encoder workers are "
+                          "registered for this model")
+                return
+            rows = await encode_via_pool(pool.router, urls)
+            if rows is None:
+                yield EngineOutput(finish_reason="error",
+                                   error="image encoding failed")
+                return
+            request.media_embeddings = {
+                "shape": list(rows.shape),
+                "data": rows.astype("float32").tobytes(),
+            }
+        async for output in self.inner.generate(request):
+            yield output
 
 
 class Migration(TokenEngine):
@@ -156,5 +192,7 @@ class Migration(TokenEngine):
                     prior_output_tokens=list(generated),
                     annotations=request.annotations,
                     lora_name=request.lora_name,
+                    media_hashes=request.media_hashes,
+                    media_embeddings=request.media_embeddings,
                 )
                 await asyncio.sleep(0.05 * attempts)

@@ -25,9 +25,17 @@ from ..kv_router import (
 from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
-from .engine import KvRouterEngine, Migration, RouterEngine, TokenEngine
+from .engine import (
+    KvRouterEngine,
+    Migration,
+    MultimodalEngine,
+    RouterEngine,
+    TokenEngine,
+)
 from .model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard
 from .prefill_router import PrefillPool, PrefillRouterEngine
+
+ENCODER = "encoder"  # multimodal encode workers (E of E/P/D)
 from .preprocessor import OpenAIPreprocessor
 
 log = get_logger("llm.manager")
@@ -127,6 +135,10 @@ class ModelWatcher:
         # so lease-expiry deletes drain the right pool.
         self._prefill_pools: dict[str, PrefillPool] = {}
         self._prefill_subjects: dict[str, str] = {}
+        # Multimodal encoder pools (same shape as prefill pools): model
+        # name -> pool of encode workers the MultimodalEngine calls.
+        self._encoder_pools: dict[str, PrefillPool] = {}
+        self._encoder_subjects: dict[str, str] = {}
         # (subject, worker_id) -> events buffered while a resync RPC is in
         # flight for that worker; replayed (ids beyond the dump) after the
         # snapshot loads — the classic snapshot+replay pattern, so live
@@ -153,6 +165,8 @@ class ModelWatcher:
             await entry.router.client.close()
         for pool in self._prefill_pools.values():
             await pool.router.client.close()
+        for pool in self._encoder_pools.values():
+            await pool.router.client.close()
 
     async def _watch_loop(self) -> None:
         async for event in self._watch:
@@ -176,6 +190,9 @@ class ModelWatcher:
                 and subject.split("/", 1)[0] != self.namespace_filter):
             return
         card = ModelDeploymentCard.from_wire(value)
+        if ENCODER in card.model_types:
+            await self._handle_encoder_put(card, subject, instance_id)
+            return
         if PREFILL in card.model_types:
             await self._handle_prefill_put(card, subject, instance_id)
             if not ({CHAT, COMPLETIONS} & set(card.model_types)):
@@ -245,10 +262,43 @@ class ModelWatcher:
             log.info("prefill pool up for %s (%s)", card.name, subject)
         pool.instances.add(instance_id)
 
+    async def _handle_encoder_put(
+        self, card: ModelDeploymentCard, subject: str, instance_id: int
+    ) -> None:
+        pool = self._encoder_pools.get(card.name)
+        if pool is not None and self._encoder_subjects.get(subject) != card.name:
+            log.warning("encoder pool for %s already bound elsewhere; "
+                        "ignoring instance at %s", card.name, subject)
+            return
+        if pool is None:
+            endpoint = (
+                self.runtime.namespace(card.namespace)
+                .component(card.component)
+                .endpoint(card.endpoint)
+            )
+            pool = PrefillPool(router=PushRouter(endpoint.client(),
+                                                 mode="round_robin"))
+            await pool.router.client.start()
+            self._encoder_pools[card.name] = pool
+            self._encoder_subjects[subject] = card.name
+            log.info("encoder pool up for %s (%s)", card.name, subject)
+        pool.instances.add(instance_id)
+
     async def _handle_delete(self, key: str) -> None:
         subject, instance_id = self._parse_key(key)
         if (self.namespace_filter is not None
                 and subject.split("/", 1)[0] != self.namespace_filter):
+            return
+        enc_name = self._encoder_subjects.get(subject)
+        if enc_name is not None:
+            pool = self._encoder_pools.get(enc_name)
+            if pool is not None:
+                pool.instances.discard(instance_id)
+                if not pool.instances:
+                    log.info("encoder pool drained for %s", enc_name)
+                    self._encoder_pools.pop(enc_name, None)
+                    self._encoder_subjects.pop(subject, None)
+                    await pool.router.client.close()
             return
         name = self._prefill_subjects.get(subject)
         if name is not None:
@@ -372,6 +422,11 @@ class ModelWatcher:
             engine, pool_lookup=lambda: self._prefill_pools.get(name)
         )
         engine = Migration(engine)
+        # Outermost: images are encoded ONCE, before any migration retry
+        # re-dispatch (embeddings travel with the replayed request).
+        engine = MultimodalEngine(
+            engine, pool_lookup=lambda: self._encoder_pools.get(name)
+        )
         preprocessor = OpenAIPreprocessor(card)
         return ModelEntry(
             card=card,

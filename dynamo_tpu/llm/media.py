@@ -1,0 +1,112 @@
+"""Media resolution: chat image content -> encoder-ready arrays.
+
+The reference resolves multimodal media in the preprocessor (ref:
+lib/llm/src/preprocessor/media.rs) before the engine sees the request.
+Supported sources (no network egress — remote URLs are rejected, matching
+an air-gapped TPU-VM deployment):
+
+    data:image/png;base64,...         PNG/JPEG/... via Pillow
+    data:application/x-raw-tensor;base64,...   raw float32 [S, S, 3]
+
+Images are resized to the encoder's square input and normalized to
+[0, 1] float32. `media_hash` gives the content identity the encoder
+cache keys on (ref: common/multimodal/async_encoder_cache.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+import xxhash
+
+
+class MediaError(ValueError):
+    pass
+
+
+def resolve_image(url: str, image_size: int) -> np.ndarray:
+    """Data URL -> [S, S, 3] float32 in [0, 1]."""
+    if not url.startswith("data:"):
+        raise MediaError(
+            "only data: URLs are supported (remote fetch is disabled); "
+            "inline the image as data:image/...;base64,...")
+    try:
+        header, payload = url.split(",", 1)
+    except ValueError as exc:
+        raise MediaError("malformed data URL") from exc
+    if ";base64" not in header:
+        raise MediaError("data URL must be base64-encoded")
+    try:
+        raw = base64.b64decode(payload, validate=True)
+    except Exception as exc:  # noqa: BLE001 — binascii.Error et al.
+        raise MediaError(f"bad base64 payload: {exc}") from exc
+    mime = header[5:].split(";", 1)[0]
+    if mime == "application/x-raw-tensor":
+        side = round((len(raw) // (4 * 3)) ** 0.5)
+        if side * side * 3 * 4 != len(raw):
+            raise MediaError(
+                f"raw tensor of {len(raw)} bytes is not a square "
+                "[S, S, 3] float32 image")
+        arr = np.frombuffer(raw, np.float32).reshape(side, side, 3)
+    else:
+        try:
+            from PIL import Image
+        except ImportError as exc:  # pragma: no cover
+            raise MediaError("Pillow unavailable for image decoding") from exc
+        try:
+            with Image.open(io.BytesIO(raw)) as img:
+                arr = np.asarray(img.convert("RGB"), np.float32) / 255.0
+        except Exception as exc:  # noqa: BLE001 — corrupt image data
+            raise MediaError(f"cannot decode image: {exc}") from exc
+    return _resize_square(arr, image_size)
+
+
+def _resize_square(arr: np.ndarray, size: int) -> np.ndarray:
+    """Nearest-neighbor resize to [size, size, 3] (host-side; encoders are
+    robust to interpolation choice and this avoids a Pillow round-trip for
+    raw tensors)."""
+    h, w = arr.shape[:2]
+    if (h, w) == (size, size):
+        return np.ascontiguousarray(arr, np.float32)
+    ys = (np.arange(size) * (h / size)).astype(np.int64).clip(0, h - 1)
+    xs = (np.arange(size) * (w / size)).astype(np.int64).clip(0, w - 1)
+    return np.ascontiguousarray(arr[np.ix_(ys, xs)], np.float32)
+
+
+def media_hash(url: str) -> int:
+    """Stable content identity for encoder-cache keying."""
+    return xxhash.xxh64_intdigest(url.encode("utf-8"))
+
+
+# Marker inserted at image positions. NUL bytes are stripped from user
+# text below, so this cannot be forged from content (and a user's literal
+# "<image>" string stays plain text).
+IMAGE_MARKER = "\x00image\x00"
+
+
+def extract_image_parts(messages: list[dict]) -> tuple[list[dict], list[str]]:
+    """Split multimodal chat messages: returns (messages with text plus
+    one IMAGE_MARKER per image part, ordered data URLs). The preprocessor
+    expands each marker into the model's image-placeholder tokens."""
+    out_messages = []
+    urls: list[str] = []
+    for msg in messages:
+        content = msg.get("content")
+        if not isinstance(content, list):
+            out_messages.append(msg)
+            continue
+        pieces = []
+        for part in content:
+            kind = part.get("type")
+            if kind == "text":
+                pieces.append(part.get("text", "").replace("\x00", ""))
+            elif kind == "image_url":
+                url = (part.get("image_url") or {}).get("url", "")
+                if not url:
+                    raise MediaError("image_url part without a url")
+                urls.append(url)
+                pieces.append(IMAGE_MARKER)
+        out_messages.append({**msg, "content": "".join(pieces)})
+    return out_messages, urls

@@ -69,8 +69,50 @@ class OpenAIPreprocessor:
         messages = request.get("messages")
         if not messages:
             raise RequestError("'messages' is required and must be non-empty")
+        if any(isinstance(m.get("content"), list)
+               and any(isinstance(p, dict) and p.get("type") == "image_url"
+                       for p in m["content"])
+               for m in messages if isinstance(m, dict)):
+            return self._preprocess_multimodal(list(messages), request)
         prompt = self.render_chat(list(messages))
         return self._build(prompt, request)
+
+    def _preprocess_multimodal(self, messages: list[dict],
+                               request: dict) -> PreprocessedRequest:
+        """Image content parts -> placeholder tokens + media identity (ref:
+        preprocessor/media.rs resolving multimodal media before the
+        engine). The card must advertise multimodal support (worker
+        runtime_config) with the placeholder id + rows-per-image."""
+        from .media import IMAGE_MARKER, extract_image_parts, media_hash
+
+        mm = self.card.runtime_config.get("multimodal")
+        if not mm:
+            raise RequestError(
+                f"model '{self.card.name}' does not accept image input")
+        image_token_id = int(mm["image_token_id"])
+        # extract_image_parts inserts the NUL-delimited marker at image
+        # positions and strips NULs from user text, so a literal "<image>"
+        # in content cannot forge a slot.
+        flat_messages, urls = extract_image_parts(messages)
+        prompt = self.render_chat(flat_messages)
+        pieces = prompt.split(IMAGE_MARKER)
+        if len(pieces) - 1 != len(urls):
+            raise RequestError("image marker/url count mismatch")
+        token_ids: list[int] = []
+        for i, piece in enumerate(pieces):
+            if piece:
+                # The placeholder id must only mark image positions: drop
+                # any occurrence the tokenizer produced from plain text, or
+                # embed splicing would consume encoder rows out of order.
+                token_ids.extend(t for t in self.tokenizer.encode(piece)
+                                 if t != image_token_id)
+            if i < len(urls):
+                token_ids.extend(
+                    [image_token_id] * int(mm["n_image_tokens"]))
+        pre = self._build_from_tokens(token_ids, request)
+        pre.annotations["media_urls"] = urls
+        pre.media_hashes = [media_hash(u) for u in urls]
+        return pre
 
     def preprocess_completions(self, request: dict) -> PreprocessedRequest:
         prompt = request.get("prompt")

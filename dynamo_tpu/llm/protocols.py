@@ -71,6 +71,30 @@ class PreprocessedRequest:
     # Multi-LoRA: adapter to apply (frontend resolves model=<adapter-name>
     # against worker cards; ref: lib/llm/src/lora.rs routing)
     lora_name: Optional[str] = None
+    # Multimodal: content identity of each image (salts KV hashes — same
+    # placeholder tokens with different images must never share KV) and
+    # the encoder's output rows spliced at placeholder positions
+    # (wire: {"shape": [n, H], "data": f32 bytes})
+    media_hashes: list[int] = dataclasses.field(default_factory=list)
+    media_embeddings: Optional[dict] = None
+
+    def kv_salt(self) -> Optional[int]:
+        """Perturbs block-hash chaining for anything beyond token ids that
+        changes KV content (adapter weights, image embeddings). Media
+        hashes are CHAINED (order-sensitive): XOR would let swapped or
+        repeated images cancel out and share KV with the wrong content."""
+        from dynamo_tpu.tokens import lora_id_of
+
+        salt = lora_id_of(self.lora_name)
+        if self.media_hashes:
+            import xxhash
+
+            buf = b"".join(
+                (int(h) & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+                for h in self.media_hashes)
+            salt = xxhash.xxh64_intdigest(
+                buf, seed=(salt or 0) & 0xFFFFFFFFFFFFFFFF)
+        return salt
 
     def to_wire(self) -> dict:
         out = {
@@ -88,6 +112,10 @@ class PreprocessedRequest:
             out["prior_output_tokens"] = self.prior_output_tokens
         if self.lora_name:
             out["lora_name"] = self.lora_name
+        if self.media_hashes:
+            out["media_hashes"] = self.media_hashes
+        if self.media_embeddings is not None:
+            out["media_embeddings"] = self.media_embeddings
         return out
 
     @classmethod
@@ -103,6 +131,8 @@ class PreprocessedRequest:
             prior_output_tokens=list(data.get("prior_output_tokens") or []),
             annotations=data.get("annotations") or {},
             lora_name=data.get("lora_name"),
+            media_hashes=list(data.get("media_hashes") or []),
+            media_embeddings=data.get("media_embeddings"),
         )
 
 

@@ -36,6 +36,11 @@ class ModelConfig:
     # Static per-expert buffer headroom for capacity dispatch (tokens per
     # expert = ceil(cf * t * k / e)); overflow tokens drop that expert.
     moe_capacity_factor: float = 1.25
+    # Multimodal: placeholder token id for spliced image embeddings
+    # (-1 = text-only) and the rows one image expands to (must match the
+    # paired vision encoder's n_image_tokens)
+    image_token_id: int = -1
+    n_image_tokens: int = 0
     # MLA (DeepSeek-class latent attention); 0 = standard GQA/MHA
     mla_kv_lora_rank: int = 0
     mla_q_lora_rank: int = 0
@@ -86,6 +91,11 @@ PRESETS: dict[str, ModelConfig] = {
     "tiny-moe-test": ModelConfig(
         name="tiny-moe-test", n_experts=4, n_experts_active=2,
         expert_mlp_hidden=128,
+    ),
+    # Multimodal CI model: token 511 is the image placeholder; 16 rows per
+    # image (= tiny-vit-test n_patches)
+    "tiny-mm-test": ModelConfig(
+        name="tiny-mm-test", image_token_id=511, n_image_tokens=16,
     ),
     # Qwen3-0.6B (ref workload: BASELINE.json config 1)
     "qwen3-0.6b": ModelConfig(

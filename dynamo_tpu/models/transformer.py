@@ -629,8 +629,15 @@ def forward(
     attention_fn=None,
     lora: Optional[dict] = None,  # init_lora_pack() pytree
     lora_idx: Optional[jax.Array] = None,  # [B] adapter slot per sequence
+    extra_embeds: Optional[jax.Array] = None,  # [B, T, H] spliced inputs
+    extra_mask: Optional[jax.Array] = None,  # [B, T] True = use extra
 ) -> tuple[jax.Array, jax.Array]:
     """Unified chunk forward (prefill T>1 or decode T=1).
+
+    `extra_embeds`/`extra_mask` splice non-text inputs (image-token
+    embeddings from the vision encoder) over the token embedding at
+    masked positions — the multimodal injection point (ref: the reference
+    delegates this to its engines' multimodal runners).
 
     Returns (new_kv_cache, logits [B, T, vocab]).
     """
@@ -639,6 +646,9 @@ def forward(
     attention = attention_fn or paged_attention_xla
     b, t = tokens.shape
     x = params["embed"][tokens]  # [B, T, H]
+    if extra_embeds is not None:
+        x = jnp.where(extra_mask[:, :, None],
+                      extra_embeds.astype(x.dtype), x)
     for layer_idx, lp in enumerate(params["layers"]):
         ll = lora["layers"][layer_idx] if lora is not None else {}
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)

@@ -1,0 +1,203 @@
+"""Multimodal encode worker + embedding transfer — the E in E/P/D.
+
+The reference disaggregates multimodal serving into Encode / Prefill /
+Decode stages: encode workers run the vision encoder and ship embeddings
+to the LLM workers (ref: sglang init_multimodal.py encode paths,
+common/multimodal/{embedding_transfer,async_encoder_cache}.py, "30%
+faster TTFT" multimodal disagg README.md:96).
+
+Here:
+  * `EncodeWorker` registers an `encode` endpoint: data-URL images in,
+    one embedding frame per image out (raw f32 bytes), with an LRU cache
+    keyed on media content hash (the async_encoder_cache analog — turn 2
+    of a conversation re-sends the same image; encoding it once matters).
+  * `encode_via_pool` is the frontend-side client: resolve the request's
+    images through the encoder pool and attach the stacked rows to the
+    PreprocessedRequest (llm/manager.py wires it when encoder cards are
+    live).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from typing import AsyncIterator, Optional
+
+import numpy as np
+
+from ..llm.media import MediaError, media_hash, resolve_image
+from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+
+log = get_logger("multimodal")
+
+ENCODER = "encoder"  # model card type for encode workers
+
+
+class EmbeddingCache:
+    """LRU over encoded images, keyed by media content hash."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = capacity
+        self._store: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: int) -> Optional[np.ndarray]:
+        value = self._store.get(key)
+        if value is not None:
+            self._store.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return value
+
+    def put(self, key: int, value: np.ndarray) -> None:
+        self._store[key] = value
+        self._store.move_to_end(key)
+        while len(self._store) > self.capacity:
+            self._store.popitem(last=False)
+
+
+class EncodeWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        model_name: str,
+        vision_preset: str = "tiny-vit-test",
+        namespace: str = "dynamo",
+        component: str = "encoder",
+        cache_capacity: int = 256,
+        seed: int = 0,
+    ) -> None:
+        from ..models.vision import get_vision_config
+
+        self.runtime = runtime
+        self.instance_id = new_instance_id()
+        self.vision_config = get_vision_config(vision_preset)
+        self._vision_preset = vision_preset
+        self._seed = seed
+        self.encoder = None  # built in start() OFF the event loop: the
+        # first jit compile takes seconds and would starve the discovery
+        # lease keep-alive
+        self.cache = EmbeddingCache(cache_capacity)
+        self.card = ModelDeploymentCard(
+            name=model_name,
+            model_types=[ENCODER],
+            namespace=namespace,
+            component=component,
+            endpoint="encode",
+            runtime_config={
+                "vision": {
+                    "preset": vision_preset,
+                    "image_size": self.vision_config.image_size,
+                    "n_image_tokens": self.vision_config.n_image_tokens,
+                    "out_dim": self.vision_config.out_dim,
+                },
+            },
+        )
+        self._served = None
+
+    async def encode(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        """{"urls": [data-url, ...]} -> one frame per image:
+        {"index", "media_hash", "shape", "data": f32 bytes} (cache-aware)."""
+        urls = (body or {}).get("urls") or []
+        if not urls:
+            yield {"error": "no urls given"}
+            return
+        for index, url in enumerate(urls):
+            key = media_hash(url)
+            rows = self.cache.get(key)
+            if rows is None:
+                try:
+                    image = resolve_image(url, self.vision_config.image_size)
+                except MediaError as exc:
+                    yield {"error": f"image {index}: {exc}"}
+                    return
+                rows = await asyncio.to_thread(
+                    lambda img=image: self.encoder.encode(img)[0])
+                self.cache.put(key, rows)
+            yield {
+                "index": index,
+                "media_hash": key,
+                "shape": list(rows.shape),
+                "data": np.ascontiguousarray(rows, np.float32).tobytes(),
+            }
+
+    async def start(self) -> None:
+        from ..models.vision import VisionEncoder
+
+        def _build() -> VisionEncoder:
+            enc = VisionEncoder(self.vision_config, seed=self._seed)
+            # compile + warm the encode path before serving
+            enc.encode(np.zeros((self.vision_config.image_size,
+                                 self.vision_config.image_size, 3),
+                                np.float32))
+            return enc
+
+        self.encoder = await asyncio.to_thread(_build)
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("encode")
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.encode, instance_id=self.instance_id)
+        await publish_card(self.runtime, self.card, self.instance_id)
+        log.info("encode worker up: model=%s vision=%s tokens/img=%d",
+                 self.card.name, self.vision_config,
+                 self.vision_config.n_image_tokens)
+
+    async def close(self) -> None:
+        if self._served is not None:
+            await self._served.shutdown()
+
+
+async def encode_via_pool(router, urls: list[str]) -> Optional[np.ndarray]:
+    """Frontend-side: send the request's images through an encoder pool
+    router; returns stacked [n_images * n_tokens, out_dim] rows or None on
+    failure (caller surfaces the error — silently dropping images would
+    produce answers about images the model never saw)."""
+    frames: dict[int, np.ndarray] = {}
+    async for frame in router.generate({"urls": urls}):
+        if frame.get("error"):
+            log.warning("encode failed: %s", frame["error"])
+            return None
+        rows = np.frombuffer(frame["data"], np.float32).reshape(
+            tuple(frame["shape"]))
+        frames[frame["index"]] = rows
+    if len(frames) != len(urls):
+        log.warning("encode incomplete: %d/%d images", len(frames),
+                    len(urls))
+        return None
+    return np.concatenate([frames[i] for i in range(len(urls))], axis=0)
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.encoder")
+    parser.add_argument("--model", required=True,
+                        help="LLM model name this encoder pairs with")
+    parser.add_argument("--vision", default="vit-l-14",
+                        help="vision preset (models/vision.py PRESETS)")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="encoder")
+    parser.add_argument("--cache-capacity", type=int, default=256)
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    worker = EncodeWorker(
+        runtime, args.model, vision_preset=args.vision,
+        namespace=args.namespace, component=args.component,
+        cache_capacity=args.cache_capacity,
+    )
+    await worker.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await worker.close()
+        await runtime.shutdown()

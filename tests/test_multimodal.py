@@ -1,0 +1,411 @@
+"""Multimodal tests: vision encoder, media resolution, embedding splice in
+the engine, E/P/D flow through encode workers, KV identity salting (ref
+surface: sglang multimodal E/P/D + preprocessor/media.rs +
+common/multimodal/async_encoder_cache.py)."""
+
+import asyncio
+import base64
+import io
+import uuid
+
+import numpy as np
+import pytest
+
+import jax
+
+from dynamo_tpu.engine import ModelRunner, RunnerConfig, TpuWorker
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.llm.media import (
+    MediaError,
+    extract_image_parts,
+    media_hash,
+    resolve_image,
+)
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import get_config
+from dynamo_tpu.models.vision import VisionEncoder, get_vision_config
+from dynamo_tpu.multimodal import EmbeddingCache, EncodeWorker
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _raw_tensor_url(side=32, seed=0) -> str:
+    rng = np.random.default_rng(seed)
+    arr = rng.random((side, side, 3), dtype=np.float32)
+    b64 = base64.b64encode(arr.tobytes()).decode()
+    return f"data:application/x-raw-tensor;base64,{b64}"
+
+
+def _png_url(side=16, color=(255, 0, 0)) -> str:
+    from PIL import Image
+
+    img = Image.new("RGB", (side, side), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return ("data:image/png;base64,"
+            + base64.b64encode(buf.getvalue()).decode())
+
+
+class TestMedia:
+    def test_raw_tensor_roundtrip(self):
+        url = _raw_tensor_url(side=32, seed=1)
+        arr = resolve_image(url, 32)
+        assert arr.shape == (32, 32, 3) and arr.dtype == np.float32
+
+    def test_png_decode_and_resize(self):
+        arr = resolve_image(_png_url(side=16), 32)
+        assert arr.shape == (32, 32, 3)
+        assert abs(float(arr[0, 0, 0]) - 1.0) < 1e-6  # red channel
+        assert float(arr[0, 0, 1]) == 0.0
+
+    def test_rejects_remote_and_garbage(self):
+        with pytest.raises(MediaError, match="data: URLs"):
+            resolve_image("https://example.com/x.png", 32)
+        with pytest.raises(MediaError, match="base64"):
+            resolve_image("data:image/png,notb64", 32)
+        with pytest.raises(MediaError, match="decode"):
+            resolve_image("data:image/png;base64,"
+                          + base64.b64encode(b"junk").decode(), 32)
+
+    def test_extract_image_parts(self):
+        from dynamo_tpu.llm.media import IMAGE_MARKER
+
+        messages = [
+            {"role": "user", "content": [
+                {"type": "text", "text": "look: "},
+                {"type": "image_url", "image_url": {"url": "data:x"}},
+                {"type": "text", "text": " thanks"},
+            ]},
+            {"role": "assistant", "content": "plain"},
+        ]
+        out, urls = extract_image_parts(messages)
+        assert out[0]["content"] == f"look: {IMAGE_MARKER} thanks"
+        assert out[1]["content"] == "plain"
+        assert urls == ["data:x"]
+
+    def test_literal_image_string_and_nuls_cannot_forge_markers(self):
+        from dynamo_tpu.llm.media import IMAGE_MARKER
+
+        messages = [{"role": "user", "content": [
+            {"type": "text", "text": "what does <image> do? \x00image\x00"},
+            {"type": "image_url", "image_url": {"url": "data:x"}},
+        ]}]
+        out, urls = extract_image_parts(messages)
+        # exactly ONE marker (the real image); user text survives minus NULs
+        assert out[0]["content"].count(IMAGE_MARKER) == 1
+        assert "<image> do?" in out[0]["content"]
+        assert len(urls) == 1
+
+    def test_media_hash_stable(self):
+        assert media_hash("abc") == media_hash("abc") != media_hash("abd")
+
+
+class TestVisionEncoder:
+    def test_shapes_and_determinism(self):
+        enc = VisionEncoder(get_vision_config("tiny-vit-test"), seed=0)
+        img = np.random.default_rng(0).random((32, 32, 3),
+                                              dtype=np.float32)
+        out1 = enc.encode(img)
+        out2 = enc.encode(img)
+        assert out1.shape == (1, 16, 64)  # n_patches x out_dim(=llm hidden)
+        np.testing.assert_array_equal(out1, out2)
+        other = enc.encode(np.zeros((32, 32, 3), np.float32))
+        assert not np.allclose(out1, other)
+
+
+class TestEmbeddingCache:
+    def test_lru(self):
+        cache = EmbeddingCache(capacity=2)
+        a, b, c = (np.ones(1), np.ones(2), np.ones(3))
+        cache.put(1, a)
+        cache.put(2, b)
+        assert cache.get(1) is a  # touches 1
+        cache.put(3, c)  # evicts 2 (LRU)
+        assert cache.get(2) is None
+        assert cache.get(3) is c
+        assert cache.hits == 2 and cache.misses == 1
+
+
+def _mm_runner():
+    return ModelRunner(
+        get_config("tiny-mm-test"),
+        RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                     max_pages_per_seq=16, prefill_buckets=(8, 16, 32)),
+        make_mesh(MeshConfig()),
+        seed=0,
+    )
+
+
+class TestEmbedSplice:
+    def test_image_embeddings_change_output(self):
+        """Same placeholder tokens with different image embeddings must
+        produce different streams (the splice actually feeds the model),
+        and identical embeddings must reproduce exactly."""
+        runner = _mm_runner()
+        img_id = runner.model_config.image_token_id
+        prompt = [1, 2, img_id, img_id, img_id, img_id, 3, 4]
+        table = np.zeros(16, np.int32)
+        table[:8] = np.arange(1, 9)
+        rng = np.random.default_rng(0)
+        e1 = rng.standard_normal((4, 64)).astype(np.float32)
+        e2 = rng.standard_normal((4, 64)).astype(np.float32)
+
+        def first_token(embeds):
+            # fresh runner each time: the KV cache is donated + mutated
+            r = _mm_runner()
+            chunk = np.zeros((len(prompt), 64), np.float32)
+            positions = [i for i, t in enumerate(prompt) if t == img_id]
+            chunk[positions] = embeds
+            return r.prefill_chunk(
+                np.asarray(prompt, np.int32), 0, table, len(prompt),
+                (0.0, 1.0, 0, 0), chunk_embeds=chunk)
+
+        t1 = first_token(e1)
+        t1b = first_token(e1)
+        t2 = first_token(e2)
+        assert t1 == t1b
+        # Regression (positional-binding bug): through the RUNNER path —
+        # no LoRA pack configured — strongly contrasting embeddings must
+        # change the greedy token; if splicing were silently dropped both
+        # would sample from identical logits.
+        big = np.full((4, 64), 20.0, np.float32)
+        neg = np.full((4, 64), -20.0, np.float32)
+        assert first_token(big) != first_token(neg)
+        # different images -> (almost surely) different greedy next token;
+        # tolerate collision but require the logits path to differ via a
+        # direct forward check
+        from dynamo_tpu.models import forward, make_kv_cache
+
+        cfg = runner.model_config
+        kv = make_kv_cache(cfg, 64, 4)
+        toks = np.asarray([prompt], np.int32)
+        pos = np.arange(8, dtype=np.int32)[None, :]
+        mask = (toks == img_id)
+
+        def logits_for(e):
+            extra = np.zeros((1, 8, 64), np.float32)
+            extra[0, mask[0]] = e
+            _, lg = forward(runner.params, cfg, toks, pos, kv,
+                            np.asarray(table[None, :]),
+                            np.asarray([8], np.int32),
+                            extra_embeds=extra, extra_mask=mask)
+            return np.asarray(lg)
+
+        assert not np.allclose(logits_for(e1), logits_for(e2))
+
+    def test_kv_salt_distinguishes_images(self):
+        r1 = PreprocessedRequest(
+            request_id="a", token_ids=[1, 2], sampling=SamplingOptions(),
+            stop=StopConditions(), media_hashes=[111])
+        r2 = PreprocessedRequest(
+            request_id="b", token_ids=[1, 2], sampling=SamplingOptions(),
+            stop=StopConditions(), media_hashes=[222])
+        r3 = PreprocessedRequest(
+            request_id="c", token_ids=[1, 2], sampling=SamplingOptions(),
+            stop=StopConditions())
+        assert r1.kv_salt() != r2.kv_salt()
+        assert r3.kv_salt() is None
+        # lora + media combine
+        r4 = PreprocessedRequest(
+            request_id="d", token_ids=[1], sampling=SamplingOptions(),
+            stop=StopConditions(), lora_name="x", media_hashes=[111])
+        assert r4.kv_salt() not in (r1.kv_salt(), None)
+
+        def salt(hashes):
+            return PreprocessedRequest(
+                request_id="x", token_ids=[1],
+                sampling=SamplingOptions(), stop=StopConditions(),
+                media_hashes=hashes).kv_salt()
+
+        # order-sensitive: swapped images must not share KV identity
+        assert salt([111, 222]) != salt([222, 111])
+        # repeated images must not cancel to the unsalted identity
+        assert salt([111, 111]) != salt([222, 222])
+        assert salt([111, 111]) is not None
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+class TestMultimodalE2E:
+    def test_epd_flow_through_frontend(self, run):
+        """Full E/P/D: chat request with an image -> frontend expands
+        placeholders -> MultimodalEngine encodes via the encoder pool ->
+        worker splices embeddings -> tokens stream back. Second request
+        with the same image hits the encoder cache."""
+
+        async def body():
+            import aiohttp
+
+            cluster = uuid.uuid4().hex
+            rt_w = await DistributedRuntime(_cfg(cluster)).start()
+            worker = TpuWorker(
+                rt_w, model_name="tiny-mm-test",
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=32, prefill_buckets=(8, 16, 32, 64)),
+                warmup=False,
+            )
+            await worker.start()
+            rt_e = await DistributedRuntime(_cfg(cluster)).start()
+            encoder = EncodeWorker(rt_e, "tiny-mm-test",
+                                   vision_preset="tiny-vit-test")
+            await encoder.start()
+            rt_f = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(rt_f, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-mm-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            entry = frontend.manager.get("tiny-mm-test")
+            assert entry.card.runtime_config["multimodal"][
+                "n_image_tokens"] == 16
+
+            url = _raw_tensor_url(side=32, seed=7)
+            payload = {
+                "model": "tiny-mm-test",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "describe "},
+                    {"type": "image_url", "image_url": {"url": url}},
+                ]}],
+                "max_tokens": 4,
+                "temperature": 0,
+            }
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/chat/completions",
+                                        json=payload) as resp:
+                    assert resp.status == 200, await resp.text()
+                    data = await resp.json()
+                    assert data["choices"][0]["finish_reason"] == "length"
+                    # prompt includes the 16 expanded placeholder tokens
+                    assert data["usage"]["prompt_tokens"] > 16
+                first_text = data["choices"][0]["message"]["content"]
+                # same request again: encoder cache hit, same greedy output
+                async with aiohttp.ClientSession() as s2, s2.post(
+                        f"{base}/v1/chat/completions", json=payload) as resp:
+                    data2 = await resp.json()
+                assert data2["choices"][0]["message"]["content"] == first_text
+                assert encoder.cache.hits >= 1
+
+                # different image -> different KV identity; request succeeds
+                payload2 = {**payload, "messages": [
+                    {"role": "user", "content": [
+                        {"type": "text", "text": "describe "},
+                        {"type": "image_url",
+                         "image_url": {"url": _raw_tensor_url(side=32,
+                                                              seed=9)}},
+                    ]}]}
+                async with aiohttp.ClientSession() as s3, s3.post(
+                        f"{base}/v1/chat/completions", json=payload2) as resp:
+                    assert resp.status == 200
+
+            await frontend.close()
+            await rt_f.shutdown()
+            await encoder.close()
+            await rt_e.shutdown()
+            await worker.close()
+            await rt_w.shutdown()
+
+        run(body(), timeout=240)
+
+    def test_no_encoder_pool_is_explicit_error(self, run):
+        async def body():
+            import aiohttp
+
+            cluster = uuid.uuid4().hex
+            rt_w = await DistributedRuntime(_cfg(cluster)).start()
+            worker = TpuWorker(
+                rt_w, model_name="tiny-mm-test",
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=32, prefill_buckets=(8, 16, 32, 64)),
+                warmup=False,
+            )
+            await worker.start()
+            rt_f = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(rt_f, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-mm-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            payload = {
+                "model": "tiny-mm-test",
+                "messages": [{"role": "user", "content": [
+                    {"type": "image_url",
+                     "image_url": {"url": _raw_tensor_url()}},
+                ]}],
+                "max_tokens": 2,
+            }
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{frontend.port}"
+                        "/v1/chat/completions", json=payload) as resp:
+                    assert resp.status == 502
+                    body_ = await resp.json()
+                    assert "encoder" in body_["error"]["message"]
+            await frontend.close()
+            await rt_f.shutdown()
+            await worker.close()
+            await rt_w.shutdown()
+
+        run(body(), timeout=180)
+
+    def test_text_only_model_rejects_images(self, run):
+        async def body():
+            import aiohttp
+
+            cluster = uuid.uuid4().hex
+            rt_w = await DistributedRuntime(_cfg(cluster)).start()
+            worker = TpuWorker(
+                rt_w, model_name="tiny-test",
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=16, prefill_buckets=(8, 16, 32)),
+                warmup=False,
+            )
+            await worker.start()
+            rt_f = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(rt_f, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            payload = {
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": [
+                    {"type": "image_url",
+                     "image_url": {"url": _raw_tensor_url()}},
+                ]}],
+                "max_tokens": 2,
+            }
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{frontend.port}"
+                        "/v1/chat/completions", json=payload) as resp:
+                    assert resp.status == 400
+                    body_ = await resp.json()
+                    assert "image input" in body_["error"]["message"]
+            await frontend.close()
+            await rt_f.shutdown()
+            await worker.close()
+            await rt_w.shutdown()
+
+        run(body(), timeout=180)
