@@ -32,7 +32,7 @@ from ..parallel import kv_cache_sharding, param_shardings
 from ..parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP, Mesh
 from ..runtime.config import env
 from ..runtime.logging import get_logger
-from .sampler import sample
+from .sampler import sample, sample_with_logprobs
 
 log = get_logger("engine.runner")
 
@@ -143,7 +143,8 @@ class ModelRunner:
                                runner_config.lora_rank),
                 NamedSharding(mesh, P()),
             )
-        self._decode_fn = self._build_decode()
+        self._decode_fn = self._build_decode(False)
+        self._decode_fn_lp = None  # built on first logprobs request
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
         self._embed_fns: dict[int, callable] = {}
@@ -152,7 +153,7 @@ class ModelRunner:
 
     # -- compiled step builders -------------------------------------------
 
-    def _build_decode(self):
+    def _build_decode(self, with_logprobs: bool = False):
         cfg = self.model_config
         attention_fn = self._attention_fn
         with_lora = self.lora_pack is not None
@@ -169,13 +170,22 @@ class ModelRunner:
                 attention_fn=attention_fn,
                 lora=lora if with_lora else None, lora_idx=lora_idx,
             )
+            if with_logprobs:
+                next_tokens, lp, top_ids, top_lps = sample_with_logprobs(
+                    logits[:, 0, :], temperature, top_p, top_k, seeds,
+                    step_idx)
+                return kv, next_tokens, lp, top_ids, top_lps
+            # Hot path: no full-vocab log_softmax/top_k and only [B] int32
+            # crosses device->host (the per-token latency discipline,
+            # SURVEY section 7).
             next_tokens = sample(
-                logits[:, 0, :], temperature, top_p, top_k, seeds, step_idx
-            )
+                logits[:, 0, :], temperature, top_p, top_k, seeds, step_idx)
             return kv, next_tokens
 
-        return jax.jit(step, donate_argnums=(1,),
-                       out_shardings=(self._kv_sharding, self._rep))
+        shard = ((self._kv_sharding, self._rep, self._rep, self._rep,
+                  self._rep) if with_logprobs
+                 else (self._kv_sharding, self._rep))
+        return jax.jit(step, donate_argnums=(1,), out_shardings=shard)
 
     def _build_prefill(self, bucket: int):
         cfg = self.model_config
@@ -197,12 +207,13 @@ class ModelRunner:
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
             )[:, 0, :]  # [1, V]
-            token = sample(last, temperature, top_p, top_k, seeds,
-                           jnp.int32(0))
-            return kv, token
+            token, lp, top_ids, top_lps = sample_with_logprobs(
+                last, temperature, top_p, top_k, seeds, jnp.int32(0))
+            return kv, token, lp, top_ids, top_lps
 
         return jax.jit(step, donate_argnums=(1,),
-                       out_shardings=(self._kv_sharding, self._rep))
+                       out_shardings=(self._kv_sharding, self._rep,
+                                      self._rep, self._rep, self._rep))
 
     @property
     def sp_size(self) -> int:
@@ -236,12 +247,13 @@ class ModelRunner:
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
             )[:, 0, :]
-            token = sample(last, temperature, top_p, top_k, seeds,
-                           jnp.int32(0))
-            return kv, token
+            token, lp, top_ids, top_lps = sample_with_logprobs(
+                last, temperature, top_p, top_k, seeds, jnp.int32(0))
+            return kv, token, lp, top_ids, top_lps
 
         return jax.jit(step, donate_argnums=(1,),
-                       out_shardings=(self._kv_sharding, self._rep))
+                       out_shardings=(self._kv_sharding, self._rep,
+                                      self._rep, self._rep, self._rep))
 
     def prefill_ring(
         self,
@@ -273,13 +285,16 @@ class ModelRunner:
         valid = np.zeros((1, bucket), bool)
         valid[0, :t] = True
         temp, top_p, top_k, seed = sampling
-        self.kv_cache, token = fn(
+        self.kv_cache, token, lp, top_ids, top_lps = fn(
             self.params, self.kv_cache, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(valid), jnp.asarray(block_table[None, :]),
             jnp.asarray([t - 1], np.int32),
             jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
             jnp.asarray([top_k], np.int32), jnp.asarray([seed], np.uint32),
         )
+        self.last_prefill_sample = (float(np.asarray(lp)[0]),
+                                    np.asarray(top_ids)[0],
+                                    np.asarray(top_lps)[0])
         return int(np.asarray(token)[0])
 
     def embed(self, tokens: np.ndarray) -> np.ndarray:
@@ -378,7 +393,10 @@ class ModelRunner:
                         (1, bucket, self.model_config.hidden), jnp.float32)
                     self._zero_embeds[bucket] = zeros
                 kwargs["extra_embeds"] = zeros
-        self.kv_cache, token = fn(*args, **kwargs)
+        self.kv_cache, token, lp, top_ids, top_lps = fn(*args, **kwargs)
+        self.last_prefill_sample = (float(np.asarray(lp)[0]),
+                                    np.asarray(top_ids)[0],
+                                    np.asarray(top_lps)[0])
         return int(np.asarray(token)[0])
 
     def decode(
@@ -394,8 +412,12 @@ class ModelRunner:
         seeds: np.ndarray,
         steps: Optional[np.ndarray] = None,  # [B] per-slot token index
         lora_idx: Optional[np.ndarray] = None,  # [B] adapter slot per seq
+        want_logprobs: bool = False,
     ) -> np.ndarray:
-        """One decode step for all slots; returns sampled tokens [B]."""
+        """One decode step for all slots; returns sampled tokens [B].
+        `want_logprobs` selects the variant that also returns logprob data
+        (read via last_decode_sample) — the plain variant skips the
+        full-vocab log_softmax/top_k and the extra host transfers."""
         self.decode_steps += 1
         if steps is None:
             steps = np.zeros(len(tokens), np.int32)
@@ -413,7 +435,16 @@ class ModelRunner:
             if lora_idx is None:
                 lora_idx = np.zeros(len(tokens), np.int32)
             args += [self.lora_pack, jnp.asarray(lora_idx, jnp.int32)]
-        self.kv_cache, next_tokens = self._decode_fn(*args)
+        if want_logprobs:
+            if self._decode_fn_lp is None:
+                self._decode_fn_lp = self._build_decode(True)
+            self.kv_cache, next_tokens, lp, top_ids, top_lps = \
+                self._decode_fn_lp(*args)
+            self.last_decode_sample = (np.asarray(lp), np.asarray(top_ids),
+                                       np.asarray(top_lps))
+        else:
+            self.kv_cache, next_tokens = self._decode_fn(*args)
+            self.last_decode_sample = (None, None, None)
         return np.asarray(next_tokens)
 
     # -- LoRA slot pack ----------------------------------------------------
@@ -484,7 +515,8 @@ class ModelRunner:
         self._rep = NamedSharding(mesh, P())
         if self.lora_pack is not None:
             self.lora_pack = jax.device_put(self.lora_pack, self._rep)
-        self._decode_fn = self._build_decode()
+        self._decode_fn = self._build_decode(False)
+        self._decode_fn_lp = None
         self._prefill_fns = {}
         self._ring_prefill_fns = {}
         self._embed_fns = {}

@@ -69,6 +69,33 @@ def sample(
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
+# Top-alternatives returned alongside every sampled token (the OpenAI API
+# caps top_logprobs well below this; a static K keeps the step compiled).
+TOP_LOGPROBS_K = 8
+
+
+def sample_with_logprobs(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    seeds: jax.Array,
+    step: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """sample() plus logprob data from the RAW model distribution (OpenAI
+    semantics: logprobs reflect the model's distribution, not the
+    temperature/top-k-shaped sampling one).
+
+    Returns (tokens [B], logprob [B], top_ids [B, K], top_logprobs [B, K]).
+    """
+    tokens = sample(logits, temperature, top_p, top_k, seeds, step)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_lp = jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]
+    k = min(TOP_LOGPROBS_K, logits.shape[-1])
+    top_lps, top_ids = jax.lax.top_k(logp, k)
+    return tokens, token_lp, top_ids.astype(jnp.int32), top_lps
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V]
     output_counts: jax.Array,  # [B, V] int32 — counts of generated tokens

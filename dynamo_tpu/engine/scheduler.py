@@ -395,8 +395,10 @@ class InferenceScheduler:
                 if seq.prefill_only:
                     self._finish_prefill_only(seq, token)
                 else:
-                    self._append_token(seq, token,
-                                       prompt_tokens=seq.prompt_len)
+                    self._append_token(
+                        seq, token, prompt_tokens=seq.prompt_len,
+                        sample_info=getattr(self.runner,
+                                            "last_prefill_sample", None))
                 return seq.prompt_len
             chunk = min(budget, seq.prompt_len - seq.prefill_pos)
             tokens = np.asarray(
@@ -421,8 +423,10 @@ class InferenceScheduler:
                 if seq.prefill_only:
                     self._finish_prefill_only(seq, token)
                 else:
-                    self._append_token(seq, token,
-                                       prompt_tokens=seq.prompt_len)
+                    self._append_token(
+                        seq, token, prompt_tokens=seq.prompt_len,
+                        sample_info=getattr(self.runner,
+                                            "last_prefill_sample", None))
             return chunk
         return 0
 
@@ -495,19 +499,27 @@ class InferenceScheduler:
             self._seeds[i] = seq.seed
             self._steps[i] = len(seq.generated)
             self._lora_idx[i] = seq.lora_idx
+        want_logprobs = any(s.request.sampling.logprobs for s in ready)
         next_tokens = self.runner.decode(
             self._tokens, self._positions, self._tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
             self._steps, lora_idx=self._lora_idx,
+            want_logprobs=want_logprobs,
         )
+        lp_b, tid_b, tlp_b = getattr(self.runner, "last_decode_sample",
+                                     (None, None, None))
         count = 0
         for seq in ready:
-            self._append_token(seq, int(next_tokens[seq.slot]))
+            i = seq.slot
+            info = ((lp_b[i], tid_b[i], tlp_b[i])
+                    if lp_b is not None else None)
+            self._append_token(seq, int(next_tokens[i]), sample_info=info)
             count += 1
         return count
 
     def _append_token(self, seq: _Seq, token: int,
-                      prompt_tokens: Optional[int] = None) -> None:
+                      prompt_tokens: Optional[int] = None,
+                      sample_info: Optional[tuple] = None) -> None:
         seq.generated.append(token)
         seq.last_token = token
         request = seq.request
@@ -518,9 +530,19 @@ class InferenceScheduler:
             finish = "stop"
         elif len(seq.generated) >= request.sampling.max_tokens:
             finish = "length"
+        logprobs = None
+        top_logprobs = None
+        if request.sampling.logprobs and sample_info is not None:
+            lp, top_ids, top_lps = sample_info
+            logprobs = [float(lp)]
+            n = min(int(request.sampling.top_logprobs or 0), len(top_ids))
+            if n > 0:
+                top_logprobs = [[[int(i), float(v)]
+                                 for i, v in zip(top_ids[:n], top_lps[:n])]]
         seq.emit(EngineOutput(
             token_ids=[token], finish_reason=finish,
             prompt_tokens=prompt_tokens,
+            logprobs=logprobs, top_logprobs=top_logprobs,
         ))
         if finish is not None:
             seq.finished = True

@@ -682,6 +682,12 @@ class TpuWorker:
                 embedding=[float(x) for x in vec],
             ).to_wire()
             return
+        traceparent = request.annotations.get("traceparent")
+        if traceparent:
+            # Join worker-side logs to the frontend span (W3C trace context
+            # carried through the request plane).
+            log.debug("request %s traceparent=%s", request.request_id,
+                      traceparent)
         loop = asyncio.get_running_loop()
         out_queue: asyncio.Queue = asyncio.Queue()
 
@@ -709,17 +715,32 @@ class TpuWorker:
             import numpy as np
 
             me = request.media_embeddings
-            rows = np.frombuffer(me["data"], np.float32).reshape(
-                tuple(me["shape"]))
-            if rows.shape[-1] != self.model_config.hidden:
+            try:
+                rows = np.frombuffer(me["data"], np.float32).reshape(
+                    tuple(me["shape"]))
+            except (KeyError, TypeError, ValueError) as exc:
                 yield EngineOutput(
                     finish_reason="error",
-                    error=(f"media embeddings dim {rows.shape[-1]} != model "
-                           f"hidden {self.model_config.hidden} (wrong "
-                           "encoder preset?)")).to_wire()
+                    error=f"malformed media embeddings: {exc}").to_wire()
+                return
+            n_placeholders = sum(
+                1 for t in request.token_ids
+                if t == self.model_config.image_token_id)
+            if (rows.ndim != 2
+                    or rows.shape[-1] != self.model_config.hidden
+                    or rows.shape[0] != n_placeholders):
+                # A row/placeholder mismatch (encoder n_image_tokens vs the
+                # card's) would silently misalign images; fail loudly.
+                yield EngineOutput(
+                    finish_reason="error",
+                    error=(f"media embeddings {rows.shape} do not match "
+                           f"{n_placeholders} placeholder tokens x hidden "
+                           f"{self.model_config.hidden} (encoder preset "
+                           "mismatch?)")).to_wire()
                 return
             submit_kwargs["media_embeds"] = rows
-        elif request.annotations.get("media_urls"):
+        elif request.annotations.get("media_urls") or \
+                request.annotations.get("media"):
             yield EngineOutput(
                 finish_reason="error",
                 error="multimodal request reached the worker without "

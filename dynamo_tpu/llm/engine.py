@@ -135,6 +135,14 @@ class MultimodalEngine(TokenEngine):
                 "shape": list(rows.shape),
                 "data": rows.astype("float32").tobytes(),
             }
+            # The multi-MB data URLs have served their purpose — shipping
+            # them to the worker alongside the embeddings would roughly
+            # double the wire payload. Keep a count for observability.
+            request.annotations = {
+                **{k: v for k, v in request.annotations.items()
+                   if k != "media_urls"},
+                "media": len(urls),
+            }
         async for output in self.inner.generate(request):
             yield output
 

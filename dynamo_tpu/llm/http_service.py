@@ -136,6 +136,7 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
+        pre_start = time.monotonic()
         try:
             if kind == "chat":
                 preprocessed = entry.preprocessor.preprocess_chat(body)
@@ -143,8 +144,17 @@ class HttpService:
                 preprocessed = entry.preprocessor.preprocess_completions(body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
-
+        rt_metrics.STAGE_DURATION.labels(stage="preprocess",
+                                         model=model).observe(
+            time.monotonic() - pre_start)
         preprocessed.lora_name = lora
+        # W3C trace-context propagation: the incoming traceparent travels
+        # with the request across the request plane so worker-side logs can
+        # be joined to the frontend span (ref: logging.rs OTLP + W3C
+        # propagation across the request plane).
+        traceparent = request.headers.get("traceparent")
+        if traceparent:
+            preprocessed.annotations["traceparent"] = traceparent
         current_request_id.set(preprocessed.request_id)
         if self.recorder is not None:
             self.recorder.record_request(preprocessed.request_id, kind, body)

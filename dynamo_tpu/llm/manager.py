@@ -32,10 +32,14 @@ from .engine import (
     RouterEngine,
     TokenEngine,
 )
-from .model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard
+from .model_card import (
+    CHAT,
+    COMPLETIONS,
+    ENCODER,
+    PREFILL,
+    ModelDeploymentCard,
+)
 from .prefill_router import PrefillPool, PrefillRouterEngine
-
-ENCODER = "encoder"  # multimodal encode workers (E of E/P/D)
 from .preprocessor import OpenAIPreprocessor
 
 log = get_logger("llm.manager")

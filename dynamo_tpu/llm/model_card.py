@@ -20,6 +20,7 @@ CHAT = "chat"
 COMPLETIONS = "completions"
 PREFILL = "prefill"
 EMBEDDINGS = "embeddings"
+ENCODER = "encoder"  # multimodal encode workers (E of E/P/D)
 
 # Model input types (ref: ModelInput::{Tokens,Text})
 INPUT_TOKENS = "tokens"

@@ -101,11 +101,9 @@ class OpenAIPreprocessor:
         token_ids: list[int] = []
         for i, piece in enumerate(pieces):
             if piece:
-                # The placeholder id must only mark image positions: drop
-                # any occurrence the tokenizer produced from plain text, or
-                # embed splicing would consume encoder rows out of order.
-                token_ids.extend(t for t in self.tokenizer.encode(piece)
-                                 if t != image_token_id)
+                # _encode_text drops placeholder ids produced from text —
+                # they must only mark image positions.
+                token_ids.extend(self._encode_text(piece))
             if i < len(urls):
                 token_ids.extend(
                     [image_token_id] * int(mm["n_image_tokens"]))
@@ -132,9 +130,23 @@ class OpenAIPreprocessor:
                 )
         return self._build(str(prompt), request)
 
+    def _image_token_id(self):
+        mm = self.card.runtime_config.get("multimodal")
+        return int(mm["image_token_id"]) if mm else None
+
+    def _encode_text(self, text: str) -> list[int]:
+        """Tokenize text, dropping the image-placeholder id if this model
+        has one: the placeholder must ONLY mark image positions — a text
+        occurrence would be spliced over with zero embeddings by the
+        engine (and corrupt the prefix cache)."""
+        ids = self.tokenizer.encode(text)
+        img_id = self._image_token_id()
+        if img_id is not None:
+            ids = [t for t in ids if t != img_id]
+        return ids
+
     def _build(self, prompt: str, request: dict) -> PreprocessedRequest:
-        token_ids = self.tokenizer.encode(prompt)
-        return self._build_from_tokens(token_ids, request)
+        return self._build_from_tokens(self._encode_text(prompt), request)
 
     def _build_from_tokens(self, token_ids: list[int], request: dict) -> PreprocessedRequest:
         max_context = self.card.context_length
@@ -170,6 +182,19 @@ class OpenAIPreprocessor:
             logprobs=bool(request.get("logprobs", False)),
             top_logprobs=int(request.get("top_logprobs", 0) or 0),
         )
+        # Completions-style `logprobs: N` (an int, not the chat bool) also
+        # requests N alternatives per token.
+        lp_req = request.get("logprobs", False)
+        if isinstance(lp_req, int) and not isinstance(lp_req, bool):
+            sampling.top_logprobs = max(sampling.top_logprobs, int(lp_req))
+        from ..engine.sampler import TOP_LOGPROBS_K
+
+        if sampling.top_logprobs > TOP_LOGPROBS_K:
+            # The engine returns a fixed top-K per step; silently truncating
+            # would hand back a distribution that looks complete but isn't.
+            raise RequestError(
+                f"top_logprobs={sampling.top_logprobs} exceeds the engine "
+                f"maximum of {TOP_LOGPROBS_K}")
         return PreprocessedRequest(
             request_id=new_request_id(),
             token_ids=token_ids,
@@ -213,6 +238,10 @@ class DeltaGenerator:
         self.full_text = ""
         self.full_reasoning = ""
         self.tool_calls: list = []
+        # OpenAI-shape logprob entries, one per generated token (populated
+        # only when the request asked for logprobs; ref: perf/logprobs.rs
+        # consumes these streams)
+        self.logprob_entries: list[dict] = []
         # Output parsers (chat only; ref: chat_completions/jail.rs wiring)
         self._reasoning = (make_reasoning_parser(reasoning_parser)
                            if kind == "chat" else None)
@@ -326,6 +355,11 @@ class DeltaGenerator:
             self._stopped = True
             return [self._chunk({}, "error")]
         self.completion_tokens += len(output.token_ids)
+        new_lp_entries: list[dict] = []
+        if output.logprobs is not None:
+            before = len(self.logprob_entries)
+            self._collect_logprobs(output)
+            new_lp_entries = self.logprob_entries[before:]
         final = output.finish_reason is not None
         text = self.detok.push(output.token_ids)
         if final:
@@ -344,7 +378,50 @@ class DeltaGenerator:
             self.finish_reason = self._final_reason(output.finish_reason)
             self._stopped = True
             chunks.append(self._chunk({}, self.finish_reason))
+        if new_lp_entries and chunks:
+            # Streamed logprobs ride the first chunk of this engine item
+            # (token-aligned; OpenAI streams them per chunk the same way).
+            if self.kind == "chat":
+                chunks[0]["choices"][0]["logprobs"] = {
+                    "content": new_lp_entries}
+            else:
+                chunks[0]["choices"][0]["logprobs"] = {
+                    "tokens": [e["token"] for e in new_lp_entries],
+                    "token_logprobs": [e["logprob"]
+                                       for e in new_lp_entries],
+                }
         return chunks
+
+    def _collect_logprobs(self, output) -> None:
+        decode = self.pre.tokenizer.decode
+        for j, tid in enumerate(output.token_ids):
+            entry = {
+                "token": decode([tid]),
+                "logprob": float(output.logprobs[j]),
+            }
+            if output.top_logprobs:
+                entry["top_logprobs"] = [
+                    {"token": decode([int(alt_id)]),
+                     "logprob": float(alt_lp)}
+                    for alt_id, alt_lp in output.top_logprobs[j]
+                ]
+            self.logprob_entries.append(entry)
+
+    def logprobs_block(self):
+        """OpenAI response logprobs object for this stream, or None."""
+        if not self.logprob_entries:
+            return None
+        if self.kind == "chat":
+            return {"content": self.logprob_entries}
+        return {
+            "tokens": [e["token"] for e in self.logprob_entries],
+            "token_logprobs": [e["logprob"] for e in self.logprob_entries],
+            "top_logprobs": [
+                {alt["token"]: alt["logprob"]
+                 for alt in e.get("top_logprobs", [])} or None
+                for e in self.logprob_entries
+            ],
+        }
 
     def usage(self) -> dict:
         return {
@@ -365,27 +442,33 @@ class DeltaGenerator:
                     for i, c in enumerate(self.tool_calls)]
                 if not self.full_text:
                     message["content"] = None
+            choice = {
+                "index": 0,
+                "message": message,
+                "finish_reason": self.finish_reason or "stop",
+            }
+            if self.logprob_entries:
+                choice["logprobs"] = self.logprobs_block()
             return {
                 "id": self.chunk_id,
                 "object": "chat.completion",
                 "created": self.created,
                 "model": self.request.model,
-                "choices": [{
-                    "index": 0,
-                    "message": message,
-                    "finish_reason": self.finish_reason or "stop",
-                }],
+                "choices": [choice],
                 "usage": self.usage(),
             }
+        choice = {
+            "index": 0,
+            "text": self.full_text,
+            "finish_reason": self.finish_reason or "stop",
+        }
+        if self.logprob_entries:
+            choice["logprobs"] = self.logprobs_block()
         return {
             "id": self.chunk_id,
             "object": "text_completion",
             "created": self.created,
             "model": self.request.model,
-            "choices": [{
-                "index": 0,
-                "text": self.full_text,
-                "finish_reason": self.finish_reason or "stop",
-            }],
+            "choices": [choice],
             "usage": self.usage(),
         }

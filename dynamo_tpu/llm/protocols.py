@@ -146,6 +146,9 @@ class EngineOutput:
     # Cumulative count of prompt tokens actually processed (first chunk)
     prompt_tokens: Optional[int] = None
     logprobs: Optional[list[float]] = None
+    # Per emitted token: [[token_id, logprob], ...] alternatives (top-K
+    # from the raw model distribution)
+    top_logprobs: Optional[list[list[list[float]]]] = None
     # Disagg: prefill worker returns KV handoff params instead of decoding
     kv_transfer_params: Optional[dict] = None
     # Embedding requests return a pooled vector instead of tokens
@@ -160,6 +163,8 @@ class EngineOutput:
             out["p"] = self.prompt_tokens
         if self.logprobs is not None:
             out["lp"] = self.logprobs
+        if self.top_logprobs is not None:
+            out["tlp"] = self.top_logprobs
         if self.kv_transfer_params is not None:
             out["kv"] = self.kv_transfer_params
         if self.embedding is not None:
@@ -175,6 +180,7 @@ class EngineOutput:
             finish_reason=data.get("f"),
             prompt_tokens=data.get("p"),
             logprobs=data.get("lp"),
+            top_logprobs=data.get("tlp"),
             kv_transfer_params=data.get("kv"),
             embedding=data.get("emb"),
             error=data.get("err"),

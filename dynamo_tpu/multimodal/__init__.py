@@ -26,14 +26,11 @@ from typing import AsyncIterator, Optional
 import numpy as np
 
 from ..llm.media import MediaError, media_hash, resolve_image
-from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..llm.model_card import ENCODER, ModelDeploymentCard, publish_card
 from ..runtime import DistributedRuntime, new_instance_id
 from ..runtime.logging import get_logger
 
 log = get_logger("multimodal")
-
-ENCODER = "encoder"  # model card type for encode workers
-
 
 class EmbeddingCache:
     """LRU over encoded images, keyed by media content hash."""

@@ -1,0 +1,1 @@
+"""Performance/quality analysis tooling (ref: lib/llm/src/perf/)."""

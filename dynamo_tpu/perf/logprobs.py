@@ -1,0 +1,142 @@
+"""Logprob stream analysis (ref: lib/llm/src/perf/logprobs.rs, 1.6k LoC of
+confidence/perplexity tooling over recorded responses).
+
+Consumes either the frontend's `--record` JSONL (engine `lp` fields on
+output events) or saved OpenAI response JSON (choices[].logprobs), and
+reports per-request and aggregate statistics:
+
+    mean logprob, perplexity, min-confidence token, low-confidence spans
+    (runs of tokens under a threshold — where the model was guessing).
+
+    python -m dynamo_tpu.perf.logprobs --file requests.jsonl \
+        [--low-threshold -3.0]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Optional
+
+
+@dataclasses.dataclass
+class RequestLogprobs:
+    request_id: str
+    logprobs: list[float]
+
+    def mean(self) -> float:
+        return sum(self.logprobs) / len(self.logprobs) if self.logprobs else 0.0
+
+    def perplexity(self) -> float:
+        return math.exp(-self.mean()) if self.logprobs else 1.0
+
+    def low_confidence_spans(self, threshold: float = -3.0) -> list[tuple[int, int]]:
+        """[start, end) token index ranges where logprob < threshold."""
+        spans = []
+        start: Optional[int] = None
+        for i, lp in enumerate(self.logprobs):
+            if lp < threshold:
+                if start is None:
+                    start = i
+            elif start is not None:
+                spans.append((start, i))
+                start = None
+        if start is not None:
+            spans.append((start, len(self.logprobs)))
+        return spans
+
+    def summary(self, threshold: float = -3.0) -> dict:
+        spans = self.low_confidence_spans(threshold)
+        return {
+            "request_id": self.request_id,
+            "tokens": len(self.logprobs),
+            "mean_logprob": round(self.mean(), 4),
+            "perplexity": round(self.perplexity(), 3),
+            "min_logprob": (round(min(self.logprobs), 4)
+                            if self.logprobs else None),
+            "low_confidence_tokens": sum(e - s for s, e in spans),
+            "low_confidence_spans": spans[:16],
+        }
+
+
+def from_recording(path: str) -> list[RequestLogprobs]:
+    """Parse a frontend --record JSONL: collect `lp` values per request."""
+    per_request: dict[str, list[float]] = {}
+    order: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            event = json.loads(line)
+            if event.get("event") != "output":
+                continue
+            lps = (event.get("data") or {}).get("lp")
+            if not lps:
+                continue
+            rid = event.get("request_id", "")
+            if rid not in per_request:
+                per_request[rid] = []
+                order.append(rid)
+            per_request[rid].extend(float(x) for x in lps)
+    return [RequestLogprobs(rid, per_request[rid]) for rid in order]
+
+
+def from_response(data: dict) -> Optional[RequestLogprobs]:
+    """Parse one saved OpenAI response body (chat or completions)."""
+    choices = data.get("choices") or []
+    if not choices:
+        return None
+    block = choices[0].get("logprobs")
+    if not block:
+        return None
+    if "content" in block:  # chat shape
+        lps = [e["logprob"] for e in block["content"]]
+    else:  # completions shape
+        lps = [x for x in block.get("token_logprobs", []) if x is not None]
+    return RequestLogprobs(data.get("id", ""), lps)
+
+
+def aggregate(requests: list[RequestLogprobs],
+              threshold: float = -3.0) -> dict:
+    all_lps = [lp for r in requests for lp in r.logprobs]
+    mean = sum(all_lps) / len(all_lps) if all_lps else 0.0
+    return {
+        "requests": len(requests),
+        "tokens": len(all_lps),
+        "mean_logprob": round(mean, 4),
+        "perplexity": round(math.exp(-mean), 3) if all_lps else 1.0,
+        "low_confidence_fraction": (
+            round(sum(1 for lp in all_lps if lp < threshold)
+                  / len(all_lps), 4) if all_lps else 0.0),
+        "per_request": [r.summary(threshold) for r in requests],
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.perf.logprobs")
+    parser.add_argument("--file", required=True,
+                        help="recording JSONL (frontend --record) or a "
+                             "saved OpenAI response JSON")
+    parser.add_argument("--low-threshold", type=float, default=-3.0)
+    args = parser.parse_args(argv)
+    with open(args.file, encoding="utf-8") as f:
+        head = f.read(1).strip()
+    if head == "{":
+        with open(args.file, encoding="utf-8") as f:
+            first = json.loads(f.readline())
+        if "event" in first:
+            requests = from_recording(args.file)
+        else:
+            one = from_response(first)
+            requests = [one] if one else []
+    else:
+        requests = from_recording(args.file)
+    print(json.dumps(aggregate(requests, args.low_threshold), indent=1))
+
+
+if __name__ == "__main__":
+    main()

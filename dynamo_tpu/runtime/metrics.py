@@ -49,6 +49,14 @@ ITL_SECONDS = Histogram(
     registry=REGISTRY,
     buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64),
 )
+# Per-pipeline-stage latency (ref: STAGE_DURATION_SECONDS histograms at
+# pipeline/network/egress/push_router.rs:21 — which stage is eating the
+# request budget)
+STAGE_DURATION = Histogram(
+    "dynt_stage_duration_seconds", "Pipeline stage duration",
+    ["stage", "model"], registry=REGISTRY,
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0),
+)
 INPUT_TOKENS = Histogram(
     "dynt_input_sequence_tokens", "Input sequence length", ["model"],
     registry=REGISTRY, buckets=(32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768),

@@ -1,0 +1,225 @@
+"""Logprobs: sampler correctness, engine plumbing, OpenAI API surface,
+analysis tooling (ref surface: OpenAI logprobs params + lib/llm/src/perf/
+logprobs.rs)."""
+
+import asyncio
+import json
+import math
+import uuid
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.sampler import TOP_LOGPROBS_K, sample_with_logprobs
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.perf.logprobs import (
+    RequestLogprobs,
+    aggregate,
+    from_recording,
+    from_response,
+)
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+class TestSamplerLogprobs:
+    def test_greedy_token_logprob_and_topk(self):
+        logits = jnp.asarray([[0.0, 1.0, 3.0, 2.0],
+                              [5.0, 0.0, 0.0, 0.0]], jnp.float32)
+        b = logits.shape[0]
+        tokens, lp, top_ids, top_lps = sample_with_logprobs(
+            logits, jnp.zeros(b), jnp.ones(b), jnp.zeros(b, jnp.int32),
+            jnp.zeros(b, jnp.uint32), jnp.int32(0))
+        tokens = np.asarray(tokens)
+        assert list(tokens) == [2, 0]  # greedy
+        # sampled logprob == log softmax at the token
+        ref = np.asarray(jnp.log(jnp.exp(logits)
+                                 / jnp.sum(jnp.exp(logits), axis=-1,
+                                           keepdims=True)))
+        np.testing.assert_allclose(np.asarray(lp),
+                                   [ref[0, 2], ref[1, 0]], rtol=1e-5)
+        # top alternatives sorted descending, K wide
+        assert np.asarray(top_ids).shape == (2, min(TOP_LOGPROBS_K, 4))
+        assert np.asarray(top_ids)[0, 0] == 2
+        tl = np.asarray(top_lps)
+        assert all(tl[0, i] >= tl[0, i + 1] for i in range(3))
+
+    def test_logprob_reflects_raw_distribution_not_temperature(self):
+        logits = jnp.asarray([[0.0, 2.0]], jnp.float32)
+        _, lp_cold, _, _ = sample_with_logprobs(
+            logits, jnp.asarray([0.0]), jnp.ones(1),
+            jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.uint32), jnp.int32(0))
+        _, lp_hot, _, _ = sample_with_logprobs(
+            logits, jnp.asarray([0.0001]), jnp.ones(1),
+            jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.uint32), jnp.int32(0))
+        # same token, same RAW logprob regardless of temperature
+        np.testing.assert_allclose(np.asarray(lp_cold), np.asarray(lp_hot),
+                                   rtol=1e-5)
+
+
+class TestAnalysis:
+    def test_request_stats_and_spans(self):
+        r = RequestLogprobs("r1", [-0.1, -4.0, -5.0, -0.2, -3.5])
+        assert r.low_confidence_spans(-3.0) == [(1, 3), (4, 5)]
+        assert abs(r.perplexity() - math.exp(-r.mean())) < 1e-9
+        s = r.summary()
+        assert s["low_confidence_tokens"] == 3
+        assert s["min_logprob"] == -5.0
+
+    def test_from_recording_and_aggregate(self, tmp_path):
+        path = tmp_path / "rec.jsonl"
+        events = [
+            {"ts": 1, "event": "request", "request_id": "a",
+             "data": {"kind": "chat", "body": {}}},
+            {"ts": 2, "event": "output", "request_id": "a",
+             "data": {"t": [5], "lp": [-0.5]}},
+            {"ts": 3, "event": "output", "request_id": "a",
+             "data": {"t": [6], "lp": [-1.5], "f": "stop"}},
+            {"ts": 4, "event": "output", "request_id": "b",
+             "data": {"t": [7], "lp": [-4.0]}},
+            {"ts": 5, "event": "output", "request_id": "c",
+             "data": {"t": [7]}},  # no logprobs requested
+        ]
+        path.write_text("\n".join(json.dumps(e) for e in events))
+        requests = from_recording(str(path))
+        assert [r.request_id for r in requests] == ["a", "b"]
+        agg = aggregate(requests)
+        assert agg["requests"] == 2 and agg["tokens"] == 3
+        assert agg["low_confidence_fraction"] == round(1 / 3, 4)
+
+    def test_from_response_shapes(self):
+        chat = {"id": "x", "choices": [{"logprobs": {"content": [
+            {"token": "a", "logprob": -0.3},
+            {"token": "b", "logprob": -0.7},
+        ]}}]}
+        r = from_response(chat)
+        assert r.logprobs == [-0.3, -0.7]
+        comp = {"id": "y", "choices": [{"logprobs": {
+            "tokens": ["a"], "token_logprobs": [-0.9],
+            "top_logprobs": [None]}}]}
+        assert from_response(comp).logprobs == [-0.9]
+        assert from_response({"id": "z", "choices": [{}]}) is None
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+class TestLogprobsE2E:
+    def test_chat_logprobs_through_real_engine(self, run):
+        """Real TpuWorker: logprobs + top_logprobs come back in the chat
+        response, self-consistent (sampled token appears in alternatives
+        for greedy sampling, logprob <= 0)."""
+        import aiohttp
+
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = TpuWorker(
+                rt, model_name="tiny-test",
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=16, prefill_buckets=(8, 16, 32)),
+                warmup=False,
+            )
+            await worker.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            payload = {
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "logprobs": True,
+                "top_logprobs": 3,
+            }
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{frontend.port}"
+                        "/v1/chat/completions", json=payload) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+            block = data["choices"][0]["logprobs"]
+            entries = block["content"]
+            assert len(entries) == 4
+            for e in entries:
+                assert e["logprob"] <= 0.0
+                assert len(e["top_logprobs"]) == 3
+                # greedy: the sampled token must be the top alternative
+                assert abs(e["top_logprobs"][0]["logprob"]
+                           - e["logprob"]) < 1e-4
+            # no logprobs -> no block
+            payload2 = {**payload}
+            del payload2["logprobs"], payload2["top_logprobs"]
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{frontend.port}"
+                        "/v1/chat/completions", json=payload2) as resp:
+                    data2 = await resp.json()
+            assert "logprobs" not in data2["choices"][0]
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=180)
+
+    def test_completions_int_logprobs_param(self, run):
+        """completions-style `logprobs: 3` (int) requests alternatives."""
+        import aiohttp
+
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = TpuWorker(
+                rt, model_name="tiny-test",
+                runner_config=RunnerConfig(
+                    page_size=4, num_pages=64, max_batch=4,
+                    max_pages_per_seq=16, prefill_buckets=(8, 16, 32)),
+                warmup=False,
+            )
+            await worker.start()
+            frt = await DistributedRuntime(_cfg(cluster)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            payload = {"model": "tiny-test", "prompt": "hello",
+                       "max_tokens": 3, "temperature": 0, "logprobs": 2}
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{frontend.port}/v1/completions",
+                        json=payload) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+            block = data["choices"][0]["logprobs"]
+            assert len(block["tokens"]) == 3
+            assert len(block["token_logprobs"]) == 3
+            assert all(len(t) == 2 for t in block["top_logprobs"])
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        run(body(), timeout=180)
