@@ -27,7 +27,7 @@ PAGE_SIZE = 16
 NUM_PAGES = 1024
 MAX_PAGES_PER_SEQ = 64
 PROMPT_LEN = 256
-DECODE_STEPS = 64
+DECODE_STEPS = 128
 # HBM bandwidth by chip generation (GB/s) for the roofline denominator.
 HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
             "cpu": 50.0}
@@ -69,7 +69,12 @@ def main() -> None:
     )
 
     # Prefill BATCH sequences of PROMPT_LEN so decode runs with real KV.
-    pages_per_seq = (PROMPT_LEN + DECODE_STEPS) // PAGE_SIZE + 1
+    # Capacity covers prompt + warmup block + timed blocks — undersizing
+    # would scatter KV through zero table entries into the shared scratch
+    # page and silently corrupt the measured state.
+    block = 64
+    total_tokens = PROMPT_LEN + DECODE_STEPS + block
+    pages_per_seq = total_tokens // PAGE_SIZE + 1
     tables = np.zeros((BATCH, MAX_PAGES_PER_SEQ), np.int32)
     rng = np.random.default_rng(0)
     next_page = 1
@@ -90,23 +95,38 @@ def main() -> None:
     top_k = np.zeros(BATCH, np.int32)
     seeds = np.zeros(BATCH, np.uint32)
 
-    def step():
-        nonlocal tokens, positions, kv_lens
-        out = runner.decode(tokens, positions, tables, kv_lens, active,
-                            temp, top_p, top_k, seeds)
-        tokens = out
-        positions = positions + 1
-        kv_lens = kv_lens + 1
+    # Steady-state serving uses fused decode blocks (DYNT_DECODE_BLOCK;
+    # lax.scan of K steps per compiled call — one host dispatch per K
+    # tokens, the per-token latency discipline of SURVEY section 7).
+    steps_np = np.zeros(BATCH, np.int32)
 
-    # warmup (compile + 3 steps)
-    for _ in range(3):
-        step()
+    # Table width bucketed to the live context (as the serving scheduler
+    # does): the attention gather reads the full table extent.
+    width = 8
+    need = pages_per_seq
+    while width < need:
+        width *= 2
+    width = min(width, MAX_PAGES_PER_SEQ)
+    btables = np.ascontiguousarray(tables[:, :width])
 
+    def step_block():
+        nonlocal tokens, positions, kv_lens, steps_np
+        toks_k = runner.decode_multi(tokens, positions, btables, kv_lens,
+                                     active, temp, top_p, top_k, seeds,
+                                     steps_np, k=block)
+        tokens = toks_k[-1]
+        positions = positions + block
+        kv_lens = kv_lens + block
+        steps_np = steps_np + block
+
+    step_block()  # warmup (compile + first block)
+
+    n_blocks = DECODE_STEPS // block
     start = time.perf_counter()
-    for _ in range(DECODE_STEPS):
-        step()
+    for _ in range(n_blocks):
+        step_block()
     elapsed = time.perf_counter() - start
-    tok_per_sec = BATCH * DECODE_STEPS / elapsed
+    tok_per_sec = BATCH * n_blocks * block / elapsed
 
     # Roofline: steps/sec ceiling = HBM_bw / (weights + active KV per step)
     hbm = 50.0

@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import ModelConfig, forward, init_params, make_kv_cache, param_axes
-from ..models.transformer import forward_ring, write_kv_stack
+from ..models.transformer import forward_decode, forward_ring, write_kv_stack
 from ..parallel import kv_cache_sharding, param_shardings
 from ..parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP, Mesh
 from ..runtime.config import env
@@ -145,6 +145,7 @@ class ModelRunner:
             )
         self._decode_fn = self._build_decode(False)
         self._decode_fn_lp = None  # built on first logprobs request
+        self._decode_multi_fns: dict[int, callable] = {}
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
         self._embed_fns: dict[int, callable] = {}
@@ -158,18 +159,36 @@ class ModelRunner:
         attention_fn = self._attention_fn
         with_lora = self.lora_pack is not None
 
+        # Deferred-write decode (2 batched scatters per step for all layers
+        # instead of 2 per layer) measured ~12x faster than the unified
+        # path with the Pallas flash-decode kernel on v5e — it is the
+        # default. A USER-SUPPLIED attention_fn still wins (tests inject
+        # reference kernels); MLA keeps the unified path (its latent cache
+        # is a single stack, so the scatter count is already minimal).
+        fast_decode = not cfg.is_mla and not self._attention_user_supplied
+
+        def one(params, kv, tokens, positions, block_tables, kv_lens,
+                active, lora, lora_idx):
+            if not fast_decode:
+                return forward(
+                    params, cfg, tokens[:, None], positions[:, None], kv,
+                    block_tables, kv_lens, valid=active[:, None],
+                    attention_fn=attention_fn,
+                    lora=lora if with_lora else None, lora_idx=lora_idx,
+                )
+            return forward_decode(
+                params, cfg, tokens, positions, kv, block_tables, kv_lens,
+                active, lora=lora if with_lora else None, lora_idx=lora_idx,
+            )
+
         def step(params, kv, tokens, positions, block_tables, kv_lens,
                  active, temperature, top_p, top_k, seeds, step_idx,
                  lora=None, lora_idx=None):
             # step_idx: [B] per-slot generated-token index, so a fixed
             # request seed reproduces its stream independent of what other
             # requests the engine is running.
-            kv, logits = forward(
-                params, cfg, tokens[:, None], positions[:, None], kv,
-                block_tables, kv_lens, valid=active[:, None],
-                attention_fn=attention_fn,
-                lora=lora if with_lora else None, lora_idx=lora_idx,
-            )
+            kv, logits = one(params, kv, tokens, positions, block_tables,
+                             kv_lens, active, lora, lora_idx)
             if with_logprobs:
                 next_tokens, lp, top_ids, top_lps = sample_with_logprobs(
                     logits[:, 0, :], temperature, top_p, top_k, seeds,
@@ -186,6 +205,92 @@ class ModelRunner:
                   self._rep) if with_logprobs
                  else (self._kv_sharding, self._rep))
         return jax.jit(step, donate_argnums=(1,), out_shardings=shard)
+
+    def _build_decode_multi(self, k: int):
+        """K decode steps inside ONE jit call via lax.scan: a single
+        host<->device round trip produces K tokens per slot. This is the
+        TPU answer to per-token dispatch latency (multi-step scheduling in
+        vLLM terms) — on a tunneled or remote-attached chip it amortizes
+        the RTT by K, and even locally it removes K-1 host syncs."""
+        cfg = self.model_config
+        attention_fn = self._attention_fn
+        with_lora = self.lora_pack is not None
+
+        def multi(params, kv, tokens, positions, block_tables, kv_lens,
+                  active, temperature, top_p, top_k, seeds, step_idx,
+                  lora=None, lora_idx=None):
+            fast_decode = (not cfg.is_mla
+                           and not self._attention_user_supplied)
+
+            def body(carry, _):
+                kv, toks, pos, lens, sidx = carry
+                if not fast_decode:
+                    kv, logits = forward(
+                        params, cfg, toks[:, None], pos[:, None], kv,
+                        block_tables, lens, valid=active[:, None],
+                        attention_fn=attention_fn,
+                        lora=lora if with_lora else None, lora_idx=lora_idx,
+                    )
+                else:
+                    kv, logits = forward_decode(
+                        params, cfg, toks, pos, kv, block_tables, lens,
+                        active, lora=lora if with_lora else None,
+                        lora_idx=lora_idx,
+                    )
+                nxt = sample(logits[:, 0, :], temperature, top_p, top_k,
+                             seeds, sidx)
+                return (kv, nxt, pos + 1, lens + 1, sidx + 1), nxt
+
+            (kv, *_), toks_k = jax.lax.scan(
+                body, (kv, tokens, positions, kv_lens, step_idx),
+                None, length=k)
+            return kv, toks_k  # [K, B]
+
+        return jax.jit(multi, donate_argnums=(1,),
+                       out_shardings=(self._kv_sharding, self._rep))
+
+    def decode_multi(
+        self,
+        tokens: np.ndarray,  # [B] last token per slot
+        positions: np.ndarray,  # [B] position of that token
+        block_tables: np.ndarray,
+        kv_lens: np.ndarray,  # [B] kv length INCLUDING the current token
+        active: np.ndarray,
+        temperature: np.ndarray,
+        top_p: np.ndarray,
+        top_k: np.ndarray,
+        seeds: np.ndarray,
+        steps: Optional[np.ndarray] = None,
+        k: int = 8,
+        lora_idx: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """K chained decode steps in one call; returns tokens [K, B].
+        Callers must guarantee every active slot has >= k tokens of page
+        budget left (the block table is written k rows forward)."""
+        self.decode_steps += k
+        fn = self._decode_multi_fns.get(k)
+        if fn is None:
+            fn = self._build_decode_multi(k)
+            self._decode_multi_fns[k] = fn
+        if steps is None:
+            steps = np.zeros(len(tokens), np.int32)
+        args = [
+            self.params, self.kv_cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32), jnp.asarray(active, bool),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(seeds, jnp.uint32),
+            jnp.asarray(steps, jnp.int32),
+        ]
+        if self.lora_pack is not None:
+            if lora_idx is None:
+                lora_idx = np.zeros(len(tokens), np.int32)
+            args += [self.lora_pack, jnp.asarray(lora_idx, jnp.int32)]
+        self.kv_cache, toks_k = fn(*args)
+        self.last_decode_sample = (None, None, None)
+        return np.asarray(toks_k)
 
     def _build_prefill(self, bucket: int):
         cfg = self.model_config
@@ -207,6 +312,10 @@ class ModelRunner:
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
             )[:, 0, :]  # [1, V]
+            # Unconditional here, unlike decode: one [1, V] log_softmax per
+            # CHUNK is noise next to the chunk forward, and the extra host
+            # transfer is a handful of floats. Decode pays this per token,
+            # hence its gated _decode_fn/_decode_fn_lp split.
             token, lp, top_ids, top_lps = sample_with_logprobs(
                 last, temperature, top_p, top_k, seeds, jnp.int32(0))
             return kv, token, lp, top_ids, top_lps
@@ -517,6 +626,7 @@ class ModelRunner:
             self.lora_pack = jax.device_put(self.lora_pack, self._rep)
         self._decode_fn = self._build_decode(False)
         self._decode_fn_lp = None
+        self._decode_multi_fns = {}
         self._prefill_fns = {}
         self._ring_prefill_fns = {}
         self._embed_fns = {}

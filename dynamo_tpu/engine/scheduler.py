@@ -94,6 +94,12 @@ class InferenceScheduler:
         cfg = runner.config
         self.page_size = cfg.page_size
         self.kvbm = kvbm
+        from ..runtime.config import env
+
+        # Multi-step decode block (DYNT_DECODE_BLOCK): >1 fuses K decode
+        # steps into one compiled call when conditions allow — tokens then
+        # stream in blocks of K.
+        self.decode_block = max(1, int(env("DYNT_DECODE_BLOCK") or 1))
 
         def _stored(hashes: list[int], parent: Optional[int]) -> None:
             # Fan out G1 registrations to the router event buffer AND the
@@ -500,8 +506,36 @@ class InferenceScheduler:
             self._steps[i] = len(seq.generated)
             self._lora_idx[i] = seq.lora_idx
         want_logprobs = any(s.request.sampling.logprobs for s in ready)
+        block = self._decode_block_for(ready, want_logprobs)
+        # Bucket the block-table width to the LIVE context: the decode
+        # attention gather reads the full table extent, so a conversation
+        # 300 tokens deep must not pay for max_pages_per_seq (e.g. 128
+        # pages = 2048 tokens) of gather bandwidth every step. jit
+        # specializes per width; power-of-two buckets keep variants finite.
+        max_kv = max(s.kv_len for s in ready) + block
+        need = -(-max_kv // self.page_size)
+        width = 8
+        while width < need:
+            width *= 2
+        width = min(width, self.runner.config.max_pages_per_seq)
+        tables = self._tables[:, :width]
+        if block > 1:
+            toks_k = self.runner.decode_multi(
+                self._tokens, self._positions, tables, self._kv_lens,
+                self._active, self._temp, self._top_p, self._top_k,
+                self._seeds, self._steps, k=block,
+                lora_idx=self._lora_idx,
+            )
+            count = 0
+            for step in range(block):
+                for seq in ready:
+                    if seq.finished or seq.cancelled:
+                        continue  # EOS/stop inside the block: discard rest
+                    self._append_token(seq, int(toks_k[step][seq.slot]))
+                    count += 1
+            return count
         next_tokens = self.runner.decode(
-            self._tokens, self._positions, self._tables, self._kv_lens,
+            self._tokens, self._positions, tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
             self._steps, lora_idx=self._lora_idx,
             want_logprobs=want_logprobs,
@@ -516,6 +550,26 @@ class InferenceScheduler:
             self._append_token(seq, int(next_tokens[i]), sample_info=info)
             count += 1
         return count
+
+    def _decode_block_for(self, ready: list, want_logprobs: bool) -> int:
+        """How many decode steps to fuse this iteration. Falls back to 1
+        (per-token) whenever fusing would hurt:
+          * prefill work pending (waiting queue or mid-prefill slots) —
+            a K-block would add K-1 steps of TTFT to them;
+          * any sequence wants logprobs (the multi path skips them);
+          * any sequence's remaining token budget < K — KV writes past the
+            allocated pages would corrupt neighbours.
+        """
+        if self.decode_block <= 1 or want_logprobs:
+            return 1
+        if self._waiting or not self._incoming.empty():
+            return 1
+        if any(s is not None and not s.decode_ready and not s.cancelled
+               for s in self._slots):
+            return 1
+        budget = min(s.request.sampling.max_tokens - len(s.generated)
+                     for s in ready)
+        return max(1, min(self.decode_block, budget))
 
     def _append_token(self, seq: _Seq, token: int,
                       prompt_tokens: Optional[int] = None,

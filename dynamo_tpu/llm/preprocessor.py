@@ -186,6 +186,9 @@ class OpenAIPreprocessor:
         # requests N alternatives per token.
         lp_req = request.get("logprobs", False)
         if isinstance(lp_req, int) and not isinstance(lp_req, bool):
+            # Completions-style integer: logprobs: 0 still returns the
+            # sampled token's logprob (with zero alternatives).
+            sampling.logprobs = True
             sampling.top_logprobs = max(sampling.top_logprobs, int(lp_req))
         from ..engine.sampler import TOP_LOGPROBS_K
 
@@ -389,6 +392,11 @@ class DeltaGenerator:
                     "tokens": [e["token"] for e in new_lp_entries],
                     "token_logprobs": [e["logprob"]
                                        for e in new_lp_entries],
+                    "top_logprobs": [
+                        {alt["token"]: alt["logprob"]
+                         for alt in e.get("top_logprobs", [])} or None
+                        for e in new_lp_entries
+                    ],
                 }
         return chunks
 

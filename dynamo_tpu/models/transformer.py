@@ -399,6 +399,110 @@ def paged_attention_xla(
     return out.reshape(b, t, qh, hd).astype(q.dtype)
 
 
+def paged_attention_decode_xla(
+    q: jax.Array,  # [B, 1, qh, hd]
+    kv_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] kv length INCLUDING the current token
+    k_cur: jax.Array,  # [B, 1, kh, hd] current token's K (not yet cached)
+    v_cur: jax.Array,
+) -> jax.Array:
+    """Decode attention over cached history PLUS the in-register current
+    token. The current K/V never round-trips through the paged pool inside
+    the step, so the (TPU-slow) cache scatter is deferred and batched once
+    per step for ALL layers (write_kv_stack) instead of 2x per layer —
+    scatters dominate small-batch decode latency otherwise."""
+    b, _, qh, hd = q.shape
+    ps = kv_cache.shape[3]
+    kh = kv_cache.shape[4]
+    max_pages = block_tables.shape[1]
+    ctx = max_pages * ps
+    k_pages = kv_cache[layer, 0][block_tables]
+    v_pages = kv_cache[layer, 1][block_tables]
+    k = k_pages.reshape(b, ctx, kh, hd)
+    v = v_pages.reshape(b, ctx, kh, hd)
+    group = qh // kh
+    qg = q.reshape(b, kh, group, hd)
+    scores = jnp.einsum("bkgh,bskh->bkgs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    # History: positions 0 .. kv_len-2 (the current token is separate).
+    kv_pos = jnp.arange(ctx)[None, :]
+    mask = kv_pos < (kv_lens[:, None] - 1)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    cur = jnp.einsum("bkgh,bkh->bkg",
+                     qg.astype(jnp.float32),
+                     k_cur[:, 0].astype(jnp.float32)) / math.sqrt(hd)
+    full = jnp.concatenate([scores, cur[..., None]], axis=-1)
+    probs = jax.nn.softmax(full, axis=-1)
+    out = (
+        jnp.einsum("bkgs,bskh->bkgh", probs[..., :-1],
+                   v.astype(jnp.float32))
+        + probs[..., -1][..., None]
+        * v_cur[:, 0].astype(jnp.float32)[:, :, None, :]
+    )
+    return out.reshape(b, 1, qh, hd).astype(q.dtype)
+
+
+def forward_decode(
+    params: dict,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B] position of the current token
+    kv_cache: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,  # [B] length INCLUDING the current token
+    active: jax.Array,  # [B] bool
+    lora: Optional[dict] = None,
+    lora_idx: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Single-token decode with DEFERRED cache writes: every layer attends
+    over (cache history + current-token K/V in registers); the paged pool
+    is updated once at the end for all layers in two batched scatters.
+    Standard-attention models only (MLA keeps the unified path — its
+    latent cache is one stack already)."""
+    assert not config.is_mla
+    b = tokens.shape[0]
+    pos2 = positions[:, None]
+    x = params["embed"][tokens][:, None, :]  # [B, 1, H]
+    ks, vs = [], []
+    for layer_idx, lp in enumerate(params["layers"]):
+        ll = lora["layers"][layer_idx] if lora is not None else {}
+        h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if "wq" in ll:
+            q = q + _lora_delta(h, ll["wq"], lora_idx).reshape(q.shape)
+            k = k + _lora_delta(h, ll["wk"], lora_idx).reshape(k.shape)
+            v = v + _lora_delta(h, ll["wv"], lora_idx).reshape(v.shape)
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q, pos2, config.rope_theta)
+        k = rope(k, pos2, config.rope_theta)
+        attn = paged_attention_decode_xla(
+            q, kv_cache, layer_idx, block_tables, kv_lens, k, v)
+        ks.append(k)
+        vs.append(v)
+        attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        if "wo" in ll:
+            attn_out = attn_out + _lora_delta(
+                attn.reshape(b, 1, -1), ll["wo"], lora_idx)
+        x = x + attn_out
+        h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        if config.n_experts:
+            x = x + _moe(h, lp, config)
+        else:
+            x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
+    kv_cache = write_kv_stack(kv_cache, jnp.stack(ks), jnp.stack(vs),
+                              block_tables, pos2, active[:, None])
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    return kv_cache, logits
+
+
 def write_latent_pages(
     kv_cache: jax.Array,  # [L, 1, P, ps, 1, dc+rhd]
     layer: int,

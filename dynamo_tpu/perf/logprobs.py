@@ -124,16 +124,18 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--low-threshold", type=float, default=-3.0)
     args = parser.parse_args(argv)
     with open(args.file, encoding="utf-8") as f:
-        head = f.read(1).strip()
-    if head == "{":
-        with open(args.file, encoding="utf-8") as f:
-            first = json.loads(f.readline())
-        if "event" in first:
-            requests = from_recording(args.file)
-        else:
-            one = from_response(first)
+        text = f.read()
+    requests = None
+    try:
+        # One JSON document (a saved OpenAI response, possibly
+        # pretty-printed across many lines).
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "event" not in doc:
+            one = from_response(doc)
             requests = [one] if one else []
-    else:
+    except json.JSONDecodeError:
+        pass
+    if requests is None:
         requests = from_recording(args.file)
     print(json.dumps(aggregate(requests, args.low_threshold), indent=1))
 

@@ -94,6 +94,10 @@ _register("DYNT_SYSTEM_ENABLED", True, _bool, "Enable the system status server")
 
 # Logging
 _register("DYNT_LOG_LEVEL", "INFO", _str, "Log level")
+_register("DYNT_DECODE_BLOCK", 1, _int,
+          "Decode steps fused into one compiled call (lax.scan) when no "
+          "prefill work is pending: amortizes host dispatch per token. "
+          "Tokens stream in blocks of this size; 1 = per-token")
 _register("DYNT_WEIGHT_SERVICE", "", _str,
           "Unix socket of the weight service (GMS analog): workers "
           "re-attach published weights on restart instead of initializing")
