@@ -102,11 +102,9 @@ def main() -> None:
 
     # Table width bucketed to the live context (as the serving scheduler
     # does): the attention gather reads the full table extent.
-    width = 8
-    need = pages_per_seq
-    while width < need:
-        width *= 2
-    width = min(width, MAX_PAGES_PER_SEQ)
+    from dynamo_tpu.engine.model_runner import bucket_table_width
+
+    width = bucket_table_width(pages_per_seq, MAX_PAGES_PER_SEQ)
     btables = np.ascontiguousarray(tables[:, :width])
 
     def step_block():

@@ -41,6 +41,8 @@ KIND_MODULES = {
     "global_router": "dynamo_tpu.global_router",
     "global_planner": "dynamo_tpu.global_planner",
     "weights": "dynamo_tpu.weights",
+    "multimodal": "dynamo_tpu.multimodal",
+    "deploy": "dynamo_tpu.deploy",
 }
 
 

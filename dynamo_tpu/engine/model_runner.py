@@ -39,6 +39,16 @@ log = get_logger("engine.runner")
 DEFAULT_PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 
 
+def bucket_table_width(pages_needed: int, max_pages: int) -> int:
+    """Power-of-two block-table width covering `pages_needed` (min 8,
+    capped at max_pages). Shared by the scheduler and bench so both run
+    the same jit specializations."""
+    width = 8
+    while width < pages_needed:
+        width *= 2
+    return min(width, max_pages)
+
+
 @dataclasses.dataclass
 class RunnerConfig:
     page_size: int = 16

@@ -26,7 +26,7 @@ import numpy as np
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..runtime.logging import get_logger
 from ..tokens import compute_block_hashes
-from .model_runner import ModelRunner
+from .model_runner import ModelRunner, bucket_table_width
 from .pages import PageAllocation, PagePool
 
 log = get_logger("engine.scheduler")
@@ -514,10 +514,8 @@ class InferenceScheduler:
         # specializes per width; power-of-two buckets keep variants finite.
         max_kv = max(s.kv_len for s in ready) + block
         need = -(-max_kv // self.page_size)
-        width = 8
-        while width < need:
-            width *= 2
-        width = min(width, self.runner.config.max_pages_per_seq)
+        width = bucket_table_width(need,
+                                   self.runner.config.max_pages_per_seq)
         tables = self._tables[:, :width]
         if block > 1:
             toks_k = self.runner.decode_multi(
@@ -569,7 +567,10 @@ class InferenceScheduler:
             return 1
         budget = min(s.request.sampling.max_tokens - len(s.generated)
                      for s in ready)
-        return max(1, min(self.decode_block, budget))
+        # All-or-nothing: intermediate k values would each compile a fresh
+        # scanned program mid-serving (jit caches per k), costing far more
+        # than the dispatches saved on a request's final few tokens.
+        return self.decode_block if budget >= self.decode_block else 1
 
     def _append_token(self, seq: _Seq, token: int,
                       prompt_tokens: Optional[int] = None,

@@ -388,16 +388,8 @@ class DeltaGenerator:
                 chunks[0]["choices"][0]["logprobs"] = {
                     "content": new_lp_entries}
             else:
-                chunks[0]["choices"][0]["logprobs"] = {
-                    "tokens": [e["token"] for e in new_lp_entries],
-                    "token_logprobs": [e["logprob"]
-                                       for e in new_lp_entries],
-                    "top_logprobs": [
-                        {alt["token"]: alt["logprob"]
-                         for alt in e.get("top_logprobs", [])} or None
-                        for e in new_lp_entries
-                    ],
-                }
+                chunks[0]["choices"][0]["logprobs"] = \
+                    self._completions_lp_block(new_lp_entries)
         return chunks
 
     def _collect_logprobs(self, output) -> None:
@@ -415,21 +407,25 @@ class DeltaGenerator:
                 ]
             self.logprob_entries.append(entry)
 
+    @staticmethod
+    def _completions_lp_block(entries: list[dict]) -> dict:
+        return {
+            "tokens": [e["token"] for e in entries],
+            "token_logprobs": [e["logprob"] for e in entries],
+            "top_logprobs": [
+                {alt["token"]: alt["logprob"]
+                 for alt in e.get("top_logprobs", [])} or None
+                for e in entries
+            ],
+        }
+
     def logprobs_block(self):
         """OpenAI response logprobs object for this stream, or None."""
         if not self.logprob_entries:
             return None
         if self.kind == "chat":
             return {"content": self.logprob_entries}
-        return {
-            "tokens": [e["token"] for e in self.logprob_entries],
-            "token_logprobs": [e["logprob"] for e in self.logprob_entries],
-            "top_logprobs": [
-                {alt["token"]: alt["logprob"]
-                 for alt in e.get("top_logprobs", [])} or None
-                for e in self.logprob_entries
-            ],
-        }
+        return self._completions_lp_block(self.logprob_entries)
 
     def usage(self) -> dict:
         return {

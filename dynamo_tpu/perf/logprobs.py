@@ -124,17 +124,26 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--low-threshold", type=float, default=-3.0)
     args = parser.parse_args(argv)
     with open(args.file, encoding="utf-8") as f:
-        text = f.read()
+        first_line = f.readline()
     requests = None
     try:
-        # One JSON document (a saved OpenAI response, possibly
-        # pretty-printed across many lines).
-        doc = json.loads(text)
+        doc = json.loads(first_line)
         if isinstance(doc, dict) and "event" not in doc:
+            # single-line saved response
             one = from_response(doc)
             requests = [one] if one else []
     except json.JSONDecodeError:
-        pass
+        # Not line-JSON: maybe a pretty-printed response document. Only
+        # NOW pay for a whole-file read — recordings (line-JSON) stay on
+        # the streaming path with a single pass.
+        try:
+            with open(args.file, encoding="utf-8") as f:
+                doc = json.loads(f.read())
+            if isinstance(doc, dict):
+                one = from_response(doc)
+                requests = [one] if one else []
+        except json.JSONDecodeError:
+            pass
     if requests is None:
         requests = from_recording(args.file)
     print(json.dumps(aggregate(requests, args.low_threshold), indent=1))
