@@ -848,9 +848,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         help="adapter to load at startup (repeatable)")
     parser.add_argument("--tool-call-parser", default=None,
                         choices=["hermes", "qwen", "mistral", "llama3_json",
-                                 "pythonic"])
+                                 "pythonic", "xml", "dsml", "harmony"])
     parser.add_argument("--reasoning-parser", default=None,
-                        choices=["think", "deepseek-r1", "granite"])
+                        choices=["think", "deepseek-r1", "granite",
+                                 "harmony", "gpt-oss"])
     args = parser.parse_args(argv)
 
     component = args.component

@@ -38,9 +38,14 @@ class ReasoningEvent:
 class StreamingReasoningParser:
     def __init__(self, open_tag: str = "<think>",
                  close_tag: str = "</think>",
-                 starts_in_reasoning: bool = False) -> None:
+                 starts_in_reasoning: bool = False,
+                 recurring: bool = False) -> None:
         self.open_tag = open_tag
         self.close_tag = close_tag
+        # recurring: after a span closes, look for the NEXT open tag
+        # instead of treating the rest as content (Harmony emits multiple
+        # analysis spans interleaved with tool calls).
+        self.recurring = recurring
         self._state = "reasoning" if starts_in_reasoning else "before"
         self._buf = ""
 
@@ -65,7 +70,7 @@ class StreamingReasoningParser:
                 if idx != -1:
                     ev.reasoning += self._buf[:idx]
                     self._buf = self._buf[idx + len(self.close_tag):]
-                    self._state = "after"
+                    self._state = "before" if self.recurring else "after"
                     continue
                 hold = prefix_hold(self._buf, self.close_tag)
                 emit = self._buf[: len(self._buf) - hold]
@@ -94,6 +99,15 @@ REASONING_PARSERS = {
     "granite": lambda: StreamingReasoningParser(
         open_tag="Here is my thought process:",
         close_tag="Here is my response:"),
+    # gpt-oss Harmony: the analysis channel is the reasoning stream (ref
+    # reasoning/gpt_oss parser). Pairs with the `harmony` tool parser,
+    # which then consumes the remaining channel structure.
+    "harmony": lambda: StreamingReasoningParser(
+        open_tag="<|channel|>analysis<|message|>",
+        close_tag="<|end|>", recurring=True),
+    "gpt-oss": lambda: StreamingReasoningParser(
+        open_tag="<|channel|>analysis<|message|>",
+        close_tag="<|end|>", recurring=True),
 }
 
 

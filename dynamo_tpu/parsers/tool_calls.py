@@ -25,6 +25,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import json
+import re
 import uuid
 from typing import Optional
 
@@ -112,44 +113,53 @@ class _MarkerParser:
         raise NotImplementedError
 
 
-class HermesToolParser(_MarkerParser):
-    """`<tool_call>...</tool_call>`; multiple blocks; content between
-    blocks passes through. Calls emitted as each block closes."""
+class _BlockParser(_MarkerParser):
+    """Marker...close blocks, emitted as each closes; content between
+    blocks passes through. Subclasses provide `_parse_block`."""
+
+    close = ""
+
+    def _parse_block(self, block: str) -> Optional[ToolCall]:
+        raise NotImplementedError
+
+    def _on_capture(self, ev: ToolEvent) -> None:
+        idx = self._capture.find(self.close)
+        if idx == -1:
+            return
+        block = self._capture[:idx]
+        rest = self._capture[idx + len(self.close):]
+        call = self._parse_block(block)
+        if call is not None:
+            ev.calls.append(call)
+        else:
+            ev.content += self.marker + block + self.close
+        # look for another block in the remainder
+        self._capturing = False
+        self._capture = ""
+        follow = self.push(rest)
+        ev.content += follow.content
+        ev.calls.extend(follow.calls)
+
+    def _finalize_capture(self, ev: ToolEvent) -> None:
+        # Unterminated block: try parsing what we have; else emit raw.
+        call = self._parse_block(self._capture)
+        if call is not None:
+            ev.calls.append(call)
+        else:
+            ev.content = self.marker + self._capture
+
+
+class HermesToolParser(_BlockParser):
+    """`<tool_call>{json}</tool_call>` blocks."""
 
     marker = "<tool_call>"
     close = "</tool_call>"
 
-    def _on_capture(self, ev: ToolEvent) -> None:
-        while True:
-            idx = self._capture.find(self.close)
-            if idx == -1:
-                return
-            block = self._capture[:idx]
-            rest = self._capture[idx + len(self.close):]
-            try:
-                call = _call_from_obj(json.loads(block.strip()))
-                if call is not None:
-                    ev.calls.append(call)
-            except ValueError:
-                ev.content += self.marker + block + self.close
-            # look for another block in the remainder
-            self._capturing = False
-            self._capture = ""
-            follow = self.push(rest)
-            ev.content += follow.content
-            ev.calls.extend(follow.calls)
-            return
-
-    def _finalize_capture(self, ev: ToolEvent) -> None:
-        # Unterminated block: try parsing what we have; else emit raw.
+    def _parse_block(self, block: str) -> Optional[ToolCall]:
         try:
-            call = _call_from_obj(json.loads(self._capture.strip()))
-            if call is not None:
-                ev.calls.append(call)
-                return
+            return _call_from_obj(json.loads(block.strip()))
         except ValueError:
-            pass
-        ev.content = self.marker + self._capture
+            return None
 
 
 class MistralToolParser(_MarkerParser):
@@ -261,12 +271,201 @@ class PythonicToolParser:
         return calls
 
 
+class XmlToolParser(_BlockParser):
+    """Qwen3-Coder-style XML calls (ref: tool_calling/xml/):
+
+        <tool_call>
+        <function=get_weather>
+        <parameter=city>
+        Paris
+        </parameter>
+        </function>
+        </tool_call>
+
+    Parameters become string arguments (JSON-decoded when they parse as
+    JSON scalars/objects, matching the reference's coercion)."""
+
+    marker = "<tool_call>"
+    close = "</tool_call>"
+
+    _FN = re.compile(r"<function=([^>\s]+)>(.*?)</function>", re.DOTALL)
+    _PARAM = re.compile(r"<parameter=([^>\s]+)>\n?(.*?)\n?</parameter>",
+                        re.DOTALL)
+
+    def _parse_block(self, block: str) -> Optional[ToolCall]:
+        m = self._FN.search(block)
+        if m is None:
+            return None
+        name, body = m.group(1), m.group(2)
+        args: dict = {}
+        for pm in self._PARAM.finditer(body):
+            value = pm.group(2)
+            try:
+                args[pm.group(1)] = json.loads(value)
+            except ValueError:
+                args[pm.group(1)] = value
+        return ToolCall(name=name, arguments=json.dumps(args))
+
+
+class DsmlToolParser(_MarkerParser):
+    """DeepSeek DSML calls (ref: tool_calling/dsml/):
+
+        <｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function<｜tool▁sep｜>NAME
+        ```json
+        {...}
+        ```<｜tool▁call▁end｜>...<｜tool▁calls▁end｜>
+    """
+
+    marker = "<｜tool▁calls▁begin｜>"
+    _CALL = re.compile(
+        r"<｜tool▁call▁begin｜>\w*<｜tool▁sep｜>([^\n<]+)\n"
+        r"```json\n(.*?)\n```\s*<｜tool▁call▁end｜>",
+        re.DOTALL)
+
+    def _finalize_capture(self, ev: ToolEvent) -> None:
+        body = self._capture.split("<｜tool▁calls▁end｜>", 1)
+        matched = False
+        pos = 0
+        for m in self._CALL.finditer(body[0]):
+            # Anything between parsed calls (including a sibling whose JSON
+            # is malformed/truncated) re-emits as content rather than
+            # vanishing — the client must be able to see the broken call.
+            leftover = body[0][pos:m.start()].strip()
+            if leftover:
+                ev.content += leftover
+            pos = m.end()
+            try:
+                args = json.loads(m.group(2))
+            except ValueError:
+                ev.content += m.group(0)
+                continue
+            ev.calls.append(ToolCall(name=m.group(1).strip(),
+                                     arguments=json.dumps(args)))
+            matched = True
+        if not matched:
+            ev.content = self.marker + self._capture
+            return
+        tail = body[0][pos:].strip()
+        if tail:
+            ev.content += tail
+        if len(body) > 1:
+            ev.content += body[1]
+
+
+class HarmonyToolParser:
+    """gpt-oss Harmony channel format (ref: tool_calling/harmony/):
+
+        <|channel|>analysis<|message|>...<|end|>
+        <|channel|>commentary to=functions.NAME <|constrain|>json
+            <|message|>{...}<|call|>
+        <|channel|>final<|message|>VISIBLE TEXT<|return|>
+
+    Streaming state machine: `final`-channel text streams through as it
+    arrives (a Harmony answer always starts with channel markers — jailing
+    until finalize would make streamed TTFT equal full generation time);
+    `commentary to=functions.*` bodies become tool calls as each closes;
+    `analysis` bodies are DROPPED here — configure the `harmony` reasoning
+    parser (which runs first) to surface them as reasoning_content."""
+
+    _MARKS = ("<|call|>", "<|end|>", "<|return|>")
+    _TO_FN = re.compile(r"to=functions\.([\w.-]+)")
+    _CHANNEL = "<|channel|>"
+    _MESSAGE = "<|message|>"
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._state = "text"  # text | header | body
+        self._header = ""
+
+    def _find_terminator(self) -> tuple[int, int]:
+        """(index, len) of the earliest body terminator in the buffer."""
+        best, blen = -1, 0
+        for mark in self._MARKS:
+            idx = self._buf.find(mark)
+            if idx != -1 and (best == -1 or idx < best):
+                best, blen = idx, len(mark)
+        return best, blen
+
+    def _emit_body(self, body: str, ev: ToolEvent) -> None:
+        fn = self._TO_FN.search(self._header)
+        if fn is not None:
+            try:
+                args = json.loads(body.strip())
+            except ValueError:
+                args = {"raw": body.strip()}
+            ev.calls.append(ToolCall(name=fn.group(1),
+                                     arguments=json.dumps(args)))
+        # analysis/other non-final channels: dropped (see class docstring)
+
+    def push(self, text: str) -> ToolEvent:
+        ev = ToolEvent()
+        self._buf += text
+        while True:
+            if self._state == "text":
+                idx = self._buf.find(self._CHANNEL)
+                if idx == -1:
+                    hold = prefix_hold(self._buf, self._CHANNEL)
+                    ev.content += self._buf[: len(self._buf) - hold]
+                    self._buf = self._buf[len(self._buf) - hold:]
+                    return ev
+                ev.content += self._buf[:idx]
+                self._buf = self._buf[idx + len(self._CHANNEL):]
+                self._state = "header"
+            elif self._state == "header":
+                idx = self._buf.find(self._MESSAGE)
+                if idx == -1:
+                    return ev
+                self._header = self._buf[:idx]
+                self._buf = self._buf[idx + len(self._MESSAGE):]
+                self._state = "body"
+            else:  # body
+                is_final = self._header.strip().startswith("final")
+                idx, tlen = self._find_terminator()
+                if idx == -1:
+                    if is_final:
+                        # stream visible text now, jailing a possible
+                        # terminator prefix at the tail
+                        hold = max(prefix_hold(self._buf, m)
+                                   for m in self._MARKS)
+                        hold = max(hold, prefix_hold(self._buf,
+                                                     self._CHANNEL))
+                        ev.content += self._buf[: len(self._buf) - hold]
+                        self._buf = self._buf[len(self._buf) - hold:]
+                    return ev
+                body = self._buf[:idx]
+                self._buf = self._buf[idx + tlen:]
+                if is_final:
+                    ev.content += body
+                else:
+                    self._emit_body(body, ev)
+                self._state = "text"
+
+    def finalize(self) -> ToolEvent:
+        ev = ToolEvent()
+        buf, self._buf = self._buf, ""
+        if self._state == "text":
+            ev.content = buf
+        elif self._state == "header":
+            ev.content = self._CHANNEL + buf  # malformed: re-emit raw
+        else:  # unterminated body (generation hit max_tokens)
+            if self._header.strip().startswith("final"):
+                ev.content = buf
+            else:
+                self._emit_body(buf, ev)
+        self._state = "text"
+        self._header = ""
+        return ev
+
+
 TOOL_PARSERS = {
     "hermes": HermesToolParser,
     "qwen": HermesToolParser,  # qwen templates use hermes format
     "mistral": MistralToolParser,
     "llama3_json": Llama3JsonToolParser,
     "pythonic": PythonicToolParser,
+    "xml": XmlToolParser,
+    "dsml": DsmlToolParser,
+    "harmony": HarmonyToolParser,
 }
 
 
