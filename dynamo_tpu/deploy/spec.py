@@ -42,6 +42,7 @@ KIND_MODULES = {
     "global_planner": "dynamo_tpu.global_planner",
     "weights": "dynamo_tpu.weights",
     "multimodal": "dynamo_tpu.multimodal",
+    "diffusion": "dynamo_tpu.diffusion",
     "deploy": "dynamo_tpu.deploy",
 }
 

@@ -1,0 +1,159 @@
+"""Diffusion serving: image/video generation workers + frontend wiring.
+
+The reference serves diffusion through SGLang runners behind
+/v1/images/generations and /v1/videos (ref: sglang init_diffusion.py,
+request_handlers/{image_diffusion,video_generation}/, openai.rs routes).
+Here the model is ours (models/diffusion.py DiT + in-jit DDIM): a
+DiffusionWorker registers an `generate_image` endpoint and a card with
+model type `image`; the frontend routes /v1/images/generations and
+/v1/videos to the pool and returns base64 PNG / animated GIF.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import io
+from typing import AsyncIterator, Optional
+
+import numpy as np
+
+from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+
+log = get_logger("diffusion")
+
+IMAGE = "image"  # model card type for diffusion workers
+
+
+def _to_png_b64(frame: np.ndarray) -> str:
+    from PIL import Image
+
+    arr = (np.clip(frame, 0.0, 1.0) * 255).astype(np.uint8)
+    img = Image.fromarray(arr)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _to_gif_b64(frames: np.ndarray, fps: int = 4) -> str:
+    from PIL import Image
+
+    imgs = [Image.fromarray((np.clip(f, 0.0, 1.0) * 255).astype(np.uint8))
+            for f in frames]
+    buf = io.BytesIO()
+    imgs[0].save(buf, format="GIF", save_all=True, append_images=imgs[1:],
+                 duration=int(1000 / fps), loop=0)
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+class DiffusionWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        model_name: str,
+        preset: str = "tiny-diffusion-test",
+        namespace: str = "dynamo",
+        component: str = "diffusion",
+        seed: int = 0,
+    ) -> None:
+        from ..models.diffusion import get_diffusion_config
+
+        self.runtime = runtime
+        self.instance_id = new_instance_id()
+        self.config = get_diffusion_config(preset)
+        self._preset = preset
+        self._seed = seed
+        self.runner = None  # built in start() off the event loop (compile)
+        self.card = ModelDeploymentCard(
+            name=model_name,
+            model_types=[IMAGE],
+            namespace=namespace,
+            component=component,
+            endpoint="generate_image",
+            runtime_config={"diffusion": {
+                "preset": preset,
+                "image_size": self.config.image_size,
+            }},
+        )
+        self._served = None
+
+    async def generate_image(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        """{"prompt", "n", "steps", "seed", "frames"} ->
+        one frame-set per image: {"index", "frames": n, "shape",
+        "data": f32 bytes [frames, S, S, 3]}."""
+        prompt = (body or {}).get("prompt") or ""
+        if not prompt:
+            yield {"error": "prompt is required"}
+            return
+        n = max(1, min(int(body.get("n", 1)), 8))
+        steps = max(1, min(int(body.get("steps", 20)), 100))
+        n_frames = max(1, min(int(body.get("frames", 1)), 16))
+        seed = int(body.get("seed", 0))
+        try:
+            out = await asyncio.to_thread(
+                self.runner.generate, prompt, n, steps, seed, n_frames)
+        except Exception as exc:  # noqa: BLE001 — report to the caller
+            log.exception("generation failed")
+            yield {"error": f"generation failed: {exc}"}
+            return
+        # out: [frames, n, S, S, 3]
+        for i in range(n):
+            frames = np.ascontiguousarray(out[:, i], np.float32)
+            yield {
+                "index": i,
+                "frames": n_frames,
+                "shape": list(frames.shape),
+                "data": frames.tobytes(),
+            }
+
+    async def start(self) -> None:
+        from ..models.diffusion import DiffusionRunner
+
+        def _build() -> DiffusionRunner:
+            runner = DiffusionRunner(self.config, seed=self._seed)
+            runner.generate("warmup", n=1, steps=2)  # compile before serving
+            return runner
+
+        self.runner = await asyncio.to_thread(_build)
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("generate_image")
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.generate_image, instance_id=self.instance_id)
+        await publish_card(self.runtime, self.card, self.instance_id)
+        log.info("diffusion worker up: model=%s preset=%s size=%d",
+                 self.card.name, self._preset, self.config.image_size)
+
+    async def close(self) -> None:
+        if self._served is not None:
+            await self._served.shutdown()
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.diffusion")
+    parser.add_argument("--model", required=True,
+                        help="served model name (e.g. sd-tiny)")
+    parser.add_argument("--preset", default="dit-b-256",
+                        help="models/diffusion.py PRESETS")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="diffusion")
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    worker = DiffusionWorker(runtime, args.model, preset=args.preset,
+                             namespace=args.namespace,
+                             component=args.component)
+    await worker.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await worker.close()
+        await runtime.shutdown()

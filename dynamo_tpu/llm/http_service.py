@@ -110,6 +110,11 @@ class HttpService:
              "owned_by": "dynamo_tpu", "parent": base}
             for name, base in self.manager.list_adapters()
         ]
+        data += [
+            {"id": name, "object": "model", "created": 0,
+             "owned_by": "dynamo_tpu"}
+            for name in sorted(self.manager.image_pools)
+        ]
         return web.json_response({"object": "list", "data": data})
 
     async def _health(self, _request: web.Request) -> web.Response:
@@ -348,6 +353,106 @@ class HttpService:
                                 delta_gen=delta_gen, kind=delta_gen.kind)
         await response.write_eof()
         return response
+
+    # -- image / video generation (diffusion pools) ------------------------
+
+    async def _diffusion_generate(self, model: str, body: dict,
+                                  n_frames: int):
+        """Call the model's diffusion pool; returns list of [frames, S, S,
+        3] float arrays (one per image) or an error Response."""
+        import numpy as np
+
+        pool = self.manager.image_pools.get(model)
+        if pool is None or not pool.instances:
+            return web.json_response(_error_body(
+                404, f"image model '{model}' not found", "model_not_found"),
+                status=404)
+        try:
+            request = {
+                "prompt": body.get("prompt", ""),
+                "n": int(body.get("n", 1)),
+                "steps": int(body.get("steps", 20)),
+                "seed": int(body.get("seed", 0)),
+                "frames": n_frames,
+            }
+        except (TypeError, ValueError):
+            return web.json_response(_error_body(
+                400, "n/steps/seed must be integers"), status=400)
+        if not request["prompt"]:
+            return web.json_response(
+                _error_body(400, "'prompt' is required"), status=400)
+        images = []
+        try:
+            async for frame in pool.router.generate(request):
+                if frame.get("error"):
+                    return web.json_response(
+                        _error_body(502, frame["error"], "engine_error"),
+                        status=502)
+                images.append(np.frombuffer(
+                    frame["data"], np.float32).reshape(
+                        tuple(frame["shape"])))
+        except NoInstancesAvailable:
+            return web.json_response(
+                _error_body(503, "no diffusion workers", "overloaded"),
+                status=503)
+        return images
+
+    async def _images(self, request: web.Request) -> web.Response:
+        """OpenAI Images API (ref: openai.rs /v1/images/generations)."""
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"),
+                                     status=400)
+        model = body.get("model", "")
+        start = time.monotonic()
+        status = "error"
+        try:
+            result = await self._diffusion_generate(model, body, n_frames=1)
+            if isinstance(result, web.Response):
+                return result
+            from ..diffusion import _to_png_b64
+
+            data = [{"b64_json": _to_png_b64(img[0])} for img in result]
+            status = "ok"
+            return web.json_response({"created": now_unix(), "data": data})
+        finally:
+            # count + audit every outcome (same invariant as the chat
+            # routes: failures must not vanish from the trail)
+            self._count_request(model, status, start, kind="images")
+
+    async def _videos(self, request: web.Request) -> web.Response:
+        """Video generation: N temporally-threaded frames returned as an
+        animated GIF (ref: openai.rs /v1/videos route; the reference
+        delegates to SGLang video diffusion)."""
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(_error_body(400, "invalid JSON body"),
+                                     status=400)
+        model = body.get("model", "")
+        try:
+            fps = max(1, min(int(body.get("fps", 4)), 30))
+            seconds = float(body.get("seconds", 1.0))
+        except (TypeError, ValueError):
+            return web.json_response(_error_body(
+                400, "fps/seconds must be numeric"), status=400)
+        n_frames = max(1, min(int(seconds * fps), 16))
+        start = time.monotonic()
+        status = "error"
+        try:
+            result = await self._diffusion_generate(model, body,
+                                                    n_frames=n_frames)
+            if isinstance(result, web.Response):
+                return result
+            from ..diffusion import _to_gif_b64
+
+            data = [{"b64_json": _to_gif_b64(img, fps=fps), "format": "gif",
+                     "frames": int(img.shape[0])} for img in result]
+            status = "ok"
+            return web.json_response({"created": now_unix(), "data": data})
+        finally:
+            self._count_request(model, status, start, kind="videos")
 
     # -- embeddings --------------------------------------------------------
 
@@ -795,6 +900,8 @@ class HttpService:
         app.router.add_post("/v1/embeddings", self._embeddings)
         app.router.add_post("/v1/messages", self._anthropic_messages)
         app.router.add_post("/v1/responses", self._responses)
+        app.router.add_post("/v1/images/generations", self._images)
+        app.router.add_post("/v1/videos", self._videos)
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._health)

@@ -74,6 +74,10 @@ class ModelManager:
 
     def __init__(self) -> None:
         self._models: dict[str, ModelEntry] = {}
+        # Diffusion pools (model type `image`): served by their own worker
+        # kind; the HTTP /v1/images/generations + /v1/videos routes call
+        # these directly (maintained by the ModelWatcher).
+        self.image_pools: dict[str, PrefillPool] = {}
 
     def register(self, entry: ModelEntry) -> None:
         self._models[entry.card.name] = entry
@@ -143,6 +147,7 @@ class ModelWatcher:
         # name -> pool of encode workers the MultimodalEngine calls.
         self._encoder_pools: dict[str, PrefillPool] = {}
         self._encoder_subjects: dict[str, str] = {}
+        self._image_subjects: dict[str, str] = {}
         # (subject, worker_id) -> events buffered while a resync RPC is in
         # flight for that worker; replayed (ids beyond the dump) after the
         # snapshot loads — the classic snapshot+replay pattern, so live
@@ -171,6 +176,8 @@ class ModelWatcher:
             await pool.router.client.close()
         for pool in self._encoder_pools.values():
             await pool.router.client.close()
+        for pool in self.manager.image_pools.values():
+            await pool.router.client.close()
 
     async def _watch_loop(self) -> None:
         async for event in self._watch:
@@ -194,6 +201,11 @@ class ModelWatcher:
                 and subject.split("/", 1)[0] != self.namespace_filter):
             return
         card = ModelDeploymentCard.from_wire(value)
+        if "image" in card.model_types:
+            await self._pool_put(card, subject, instance_id,
+                                 self.manager.image_pools,
+                                 self._image_subjects, "image")
+            return
         if ENCODER in card.model_types:
             await self._handle_encoder_put(card, subject, instance_id)
             return
@@ -237,42 +249,18 @@ class ModelWatcher:
             # router don't serve one.
             self._schedule_resync(entry, instance_id, reason="discovered")
 
-    async def _handle_prefill_put(
-        self, card: ModelDeploymentCard, subject: str, instance_id: int
-    ) -> None:
-        pool = self._prefill_pools.get(card.name)
-        if pool is not None:
-            known = self._prefill_subjects.get(subject)
-            if known != card.name:
-                # Same model's prefill workers under a second endpoint
-                # subject: the pool's router can't reach them and deletes
-                # could never drain them — first subject wins (mirrors the
-                # decode-entry guard above).
-                log.warning(
-                    "prefill pool for %s already bound to another subject; "
-                    "ignoring instance at %s", card.name, subject)
-                return
-        if pool is None:
-            endpoint = (
-                self.runtime.namespace(card.namespace)
-                .component(card.component)
-                .endpoint(card.endpoint)
-            )
-            pool = PrefillPool(router=PushRouter(endpoint.client(),
-                                                 mode="round_robin"))
-            await pool.router.client.start()
-            self._prefill_pools[card.name] = pool
-            self._prefill_subjects[subject] = card.name
-            log.info("prefill pool up for %s (%s)", card.name, subject)
-        pool.instances.add(instance_id)
-
-    async def _handle_encoder_put(
-        self, card: ModelDeploymentCard, subject: str, instance_id: int
-    ) -> None:
-        pool = self._encoder_pools.get(card.name)
-        if pool is not None and self._encoder_subjects.get(subject) != card.name:
-            log.warning("encoder pool for %s already bound elsewhere; "
-                        "ignoring instance at %s", card.name, subject)
+    async def _pool_put(self, card: ModelDeploymentCard, subject: str,
+                        instance_id: int, pools: dict, subjects: dict,
+                        label: str) -> None:
+        """Shared worker-pool lifecycle (prefill / encoder / image pools):
+        one pool per model name, bound to the FIRST endpoint subject seen —
+        a second subject's instances are ignored (the pool's router can't
+        reach them and deletes could never drain them, mirroring the
+        decode-entry guard above)."""
+        pool = pools.get(card.name)
+        if pool is not None and subjects.get(subject) != card.name:
+            log.warning("%s pool for %s already bound to another subject; "
+                        "ignoring instance at %s", label, card.name, subject)
             return
         if pool is None:
             endpoint = (
@@ -283,39 +271,47 @@ class ModelWatcher:
             pool = PrefillPool(router=PushRouter(endpoint.client(),
                                                  mode="round_robin"))
             await pool.router.client.start()
-            self._encoder_pools[card.name] = pool
-            self._encoder_subjects[subject] = card.name
-            log.info("encoder pool up for %s (%s)", card.name, subject)
+            pools[card.name] = pool
+            subjects[subject] = card.name
+            log.info("%s pool up for %s (%s)", label, card.name, subject)
         pool.instances.add(instance_id)
+
+    async def _handle_prefill_put(self, card, subject, instance_id) -> None:
+        await self._pool_put(card, subject, instance_id,
+                             self._prefill_pools, self._prefill_subjects,
+                             "prefill")
+
+    async def _handle_encoder_put(self, card, subject, instance_id) -> None:
+        await self._pool_put(card, subject, instance_id,
+                             self._encoder_pools, self._encoder_subjects,
+                             "encoder")
 
     async def _handle_delete(self, key: str) -> None:
         subject, instance_id = self._parse_key(key)
         if (self.namespace_filter is not None
                 and subject.split("/", 1)[0] != self.namespace_filter):
             return
-        enc_name = self._encoder_subjects.get(subject)
-        if enc_name is not None:
-            pool = self._encoder_pools.get(enc_name)
+        for pools, subjects, label in (
+                (self.manager.image_pools, self._image_subjects, "image"),
+                (self._encoder_pools, self._encoder_subjects, "encoder"),
+                (self._prefill_pools, self._prefill_subjects, "prefill"),
+        ):
+            name = subjects.get(subject)
+            if name is None:
+                continue
+            pool = pools.get(name)
             if pool is not None:
                 pool.instances.discard(instance_id)
                 if not pool.instances:
-                    log.info("encoder pool drained for %s", enc_name)
-                    self._encoder_pools.pop(enc_name, None)
-                    self._encoder_subjects.pop(subject, None)
+                    log.info("%s pool drained for %s", label, name)
+                    pools.pop(name, None)
+                    subjects.pop(subject, None)
                     await pool.router.client.close()
-            return
-        name = self._prefill_subjects.get(subject)
-        if name is not None:
-            pool = self._prefill_pools.get(name)
-            if pool is not None:
-                pool.instances.discard(instance_id)
-                if not pool.instances:
-                    log.info("prefill pool drained for %s", name)
-                    self._prefill_pools.pop(name, None)
-                    self._prefill_subjects.pop(subject, None)
-                    await pool.router.client.close()
-            # No return: a dual-role card's subject may ALSO back a chat
-            # entry (global router) — fall through and drain that too.
+            if label != "prefill":
+                return
+            # prefill: NO return — a dual-role card's subject may ALSO back
+            # a chat entry (global router); fall through and drain it too.
+            break
         for entry in self.manager.entries():
             if entry.card.endpoint_subject == subject:
                 entry.instances.discard(instance_id)

@@ -1,0 +1,269 @@
+"""Latent-free pixel-space DiT for image generation, TPU-first.
+
+The reference serves image/video diffusion by orchestrating SGLang's
+diffusion runners (ref: sglang init_diffusion.py image/video paths, served
+at /v1/images/generations + /v1/videos). We own the model: a small
+Diffusion Transformer (patchify -> transformer blocks with adaLN-style
+timestep conditioning -> unpatchify) predicting noise, with the FULL DDIM
+sampling loop inside one jit via `lax.scan` — one host dispatch per image
+batch, every matmul on the MXU.
+
+Text conditioning is a deterministic byte-embedding pooled vector (no
+pretrained text tower in this environment); weights are random-initialized
+— the serving path, API shape, batching, and performance characteristics
+are the deliverable, and real checkpoints drop in through the same param
+pytree (weights/client.py load paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionConfig:
+    image_size: int = 64
+    patch_size: int = 8
+    hidden: int = 256
+    n_layers: int = 6
+    n_heads: int = 4
+    mlp_hidden: int = 1024
+    cond_dim: int = 256  # text-conditioning vector width
+    rms_eps: float = 1e-6
+    dtype: str = "bfloat16"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+PRESETS: dict[str, DiffusionConfig] = {
+    "tiny-diffusion-test": DiffusionConfig(
+        image_size=16, patch_size=4, hidden=64, n_layers=2, n_heads=2,
+        mlp_hidden=128, cond_dim=64),
+    # DiT-B/8-class at 256px
+    "dit-b-256": DiffusionConfig(
+        image_size=256, patch_size=8, hidden=768, n_layers=12, n_heads=12,
+        mlp_hidden=3072, cond_dim=768),
+}
+
+
+def get_diffusion_config(name: str) -> DiffusionConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown diffusion preset {name!r} "
+                       f"(have: {sorted(PRESETS)})")
+    return PRESETS[name]
+
+
+def init_diffusion_params(key: jax.Array, config: DiffusionConfig) -> dict:
+    dtype = jnp.dtype(config.dtype)
+    h, m = config.hidden, config.mlp_hidden
+    keys = jax.random.split(key, config.n_layers + 5)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    def layer(k):
+        ks = jax.random.split(k, 5)
+        return {
+            "norm1": jnp.ones((h,), dtype),
+            "wqkv": dense(ks[0], (h, 3 * h), h),
+            "wo": dense(ks[1], (h, h), h),
+            "norm2": jnp.ones((h,), dtype),
+            "w_up": dense(ks[2], (h, m), h),
+            "w_down": dense(ks[3], (m, h), m),
+            # adaLN-style conditioning: scale+shift per block from t+cond
+            "ada": dense(ks[4], (h, 4 * h), h),
+        }
+
+    return {
+        "patch_in": dense(keys[0], (config.patch_dim, h), config.patch_dim),
+        "pos": (jax.random.normal(keys[1], (config.n_patches, h),
+                                  dtype=jnp.float32) * 0.02).astype(dtype),
+        "t_embed": dense(keys[2], (256, h), 256),
+        "cond_proj": dense(keys[3], (config.cond_dim, h), config.cond_dim),
+        "layers": [layer(keys[i + 4]) for i in range(config.n_layers)],
+        "norm_out": jnp.ones((h,), dtype),
+        "patch_out": dense(keys[-1], (h, config.patch_dim), h),
+    }
+
+
+def _rms(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def _timestep_embedding(t: jax.Array, dim: int = 256) -> jax.Array:
+    """Sinusoidal embedding of diffusion timestep in [0, 1]. [B] -> [B, dim]."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = t[:, None].astype(jnp.float32) * 1000.0 * freqs[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+def text_condition(prompt: str, cond_dim: int) -> np.ndarray:
+    """Deterministic prompt conditioning: hashed byte bigrams pooled into
+    a unit vector (stands in for a text tower; same prompt -> same
+    vector, different prompts -> different directions)."""
+    import xxhash
+
+    vec = np.zeros(cond_dim, np.float32)
+    data = prompt.encode("utf-8")
+    for i in range(len(data)):
+        h = xxhash.xxh64_intdigest(data[max(0, i - 1): i + 1], seed=i)
+        vec[h % cond_dim] += 1.0 if (h >> 32) & 1 else -1.0
+    norm = float(np.linalg.norm(vec))
+    return vec / norm if norm > 0 else vec
+
+
+def dit_forward(params: dict, config: DiffusionConfig,
+                x: jax.Array,  # [B, S, S, 3] noisy image
+                t: jax.Array,  # [B] timestep in [0, 1]
+                cond: jax.Array,  # [B, cond_dim]
+                ) -> jax.Array:
+    """Predict noise eps(x_t, t, cond). Returns [B, S, S, 3]."""
+    from .vision import patchify
+
+    b = x.shape[0]
+    nh = config.n_heads
+    hd = config.hidden // nh
+    p = config.patch_size
+    g = config.image_size // p
+    tokens = patchify(x.astype(jnp.dtype(config.dtype)), p)
+    hstate = jnp.einsum("bpd,dh->bph", tokens, params["patch_in"])
+    hstate = hstate + params["pos"][None]
+    temb = _timestep_embedding(t) @ params["t_embed"].astype(jnp.float32)
+    cvec = cond.astype(jnp.float32) @ params["cond_proj"].astype(jnp.float32)
+    c = (temb + cvec).astype(hstate.dtype)  # [B, H]
+    for lp in params["layers"]:
+        ada = jnp.einsum("bh,hk->bk", c, lp["ada"])  # [B, 4H]
+        s1, b1, s2, b2 = jnp.split(ada, 4, axis=-1)
+        hin = _rms(hstate, lp["norm1"], config.rms_eps)
+        hin = hin * (1 + s1[:, None, :]) + b1[:, None, :]
+        qkv = jnp.einsum("bph,hk->bpk", hin, lp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        t_len = q.shape[1]
+        q = q.reshape(b, t_len, nh, hd)
+        k = k.reshape(b, t_len, nh, hd)
+        v = v.reshape(b, t_len, nh, hd)
+        scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(hd)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bnqk,bknd->bqnd", probs,
+                          v.astype(jnp.float32)).astype(hstate.dtype)
+        hstate = hstate + jnp.einsum(
+            "bph,hk->bpk", attn.reshape(b, t_len, config.hidden), lp["wo"])
+        hin = _rms(hstate, lp["norm2"], config.rms_eps)
+        hin = hin * (1 + s2[:, None, :]) + b2[:, None, :]
+        up = jnp.einsum("bph,hm->bpm", hin, lp["w_up"])
+        hstate = hstate + jnp.einsum("bpm,mh->bph", jax.nn.gelu(up),
+                                     lp["w_down"])
+    hstate = _rms(hstate, params["norm_out"], config.rms_eps)
+    out = jnp.einsum("bph,hd->bpd", hstate, params["patch_out"])
+    # unpatchify [B, g*g, p*p*3] -> [B, S, S, 3]
+    out = out.reshape(b, g, g, p, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(b, config.image_size, config.image_size,
+                       3).astype(jnp.float32)
+
+
+def ddim_sample(params: dict, config: DiffusionConfig, cond: jax.Array,
+                key: jax.Array, n_steps: int = 20,
+                n_frames: int = 1) -> jax.Array:
+    """Full DDIM sampling inside this traced function: `lax.scan` over
+    denoise steps (ONE compiled program per (batch, steps) — no per-step
+    host dispatch; the TPU-first shape of the reference's diffusion
+    runners). `n_frames` > 1 threads the latent through time for a cheap
+    temporally-coherent frame sequence (the /v1/videos path).
+
+    Returns [n_frames, B, S, S, 3] in [0, 1].
+    """
+    b = cond.shape[0]
+    shape = (b, config.image_size, config.image_size, 3)
+    ts = jnp.linspace(1.0, 1.0 / n_steps, n_steps)
+
+    def alpha_bar(t):
+        return jnp.cos(t * jnp.pi / 2) ** 2
+
+    def denoise(x, t_scalar, t_next):
+        t_vec = jnp.full((b,), t_scalar)
+        eps = dit_forward(params, config, x, t_vec, cond)
+        a_t = alpha_bar(t_scalar)
+        a_n = alpha_bar(t_next)
+        x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        return jnp.sqrt(a_n) * x0 + jnp.sqrt(1 - a_n) * eps
+
+    def sample_one(x0_key_noise):
+        x = x0_key_noise
+
+        def body(x, i):
+            t_scalar = ts[i]
+            t_next = jnp.where(i + 1 < n_steps, ts[jnp.minimum(i + 1,
+                                                               n_steps - 1)],
+                               0.0)
+            return denoise(x, t_scalar, t_next), None
+
+        x, _ = jax.lax.scan(body, x, jnp.arange(n_steps))
+        return x
+
+    frames = []
+    x = jax.random.normal(key, shape)
+    for f in range(n_frames):
+        x = sample_one(x)
+        frames.append((x + 1.0) / 2.0)
+        if f + 1 < n_frames:
+            # re-noise partially for the next frame: temporal coherence via
+            # shared structure, variation via fresh noise
+            key, sub = jax.random.split(key)
+            x = (jnp.sqrt(alpha_bar(0.5)) * (x * 2 - 1)
+                 + jnp.sqrt(1 - alpha_bar(0.5))
+                 * jax.random.normal(sub, shape))
+    return jnp.clip(jnp.stack(frames), 0.0, 1.0)
+
+
+class DiffusionRunner:
+    """Host-facing image/video generator: params + jitted sampler."""
+
+    def __init__(self, config: DiffusionConfig, seed: int = 0,
+                 params: Optional[dict] = None) -> None:
+        self.config = config
+        self.params = params or init_diffusion_params(
+            jax.random.PRNGKey(seed), config)
+        self._fns: dict[tuple, callable] = {}  # LRU-capped, see generate
+
+    def generate(self, prompt: str, n: int = 1, steps: int = 20,
+                 seed: int = 0, n_frames: int = 1) -> np.ndarray:
+        """Returns [n_frames, n, S, S, 3] float32 in [0, 1]."""
+        cond = np.tile(text_condition(prompt, self.config.cond_dim),
+                       (n, 1))
+        # One batch-shaped normal draw from this key: images in a batch
+        # differ through the batch dimension of the noise; distinct seeds
+        # give fully distinct noise.
+        key = jax.random.PRNGKey(seed)
+        sig = (n, steps, n_frames)
+        fn = self._fns.get(sig)
+        if fn is None:
+            fn = jax.jit(partial(ddim_sample, config=self.config,
+                                 n_steps=steps, n_frames=n_frames))
+            self._fns[sig] = fn
+            # (n, steps, n_frames) are client-controlled: bound the
+            # compiled-program cache or a parameter sweep becomes a
+            # compile storm + unbounded executable retention.
+            while len(self._fns) > 8:
+                self._fns.pop(next(iter(self._fns)))
+        out = fn(self.params, cond=jnp.asarray(cond), key=key)
+        return np.asarray(out)

@@ -371,6 +371,12 @@ class HarmonyToolParser:
     _TO_FN = re.compile(r"to=functions\.([\w.-]+)")
     _CHANNEL = "<|channel|>"
     _MESSAGE = "<|message|>"
+    # Inter-message structure that must never leak into visible content:
+    # role headers like <|start|>assistant and stray terminators.
+    _STRUCT = re.compile(r"<\|start\|>[\w.-]*|<\|end\|>|<\|return\|>"
+                         r"|<\|call\|>")
+    _ALL_MARKS = ("<|channel|>", "<|message|>", "<|start|>", "<|end|>",
+                  "<|return|>", "<|call|>")
 
     def __init__(self) -> None:
         self._buf = ""
@@ -404,11 +410,13 @@ class HarmonyToolParser:
             if self._state == "text":
                 idx = self._buf.find(self._CHANNEL)
                 if idx == -1:
-                    hold = prefix_hold(self._buf, self._CHANNEL)
-                    ev.content += self._buf[: len(self._buf) - hold]
+                    hold = max(prefix_hold(self._buf, m)
+                               for m in self._ALL_MARKS)
+                    emit = self._buf[: len(self._buf) - hold]
+                    ev.content += self._STRUCT.sub("", emit)
                     self._buf = self._buf[len(self._buf) - hold:]
                     return ev
-                ev.content += self._buf[:idx]
+                ev.content += self._STRUCT.sub("", self._buf[:idx])
                 self._buf = self._buf[idx + len(self._CHANNEL):]
                 self._state = "header"
             elif self._state == "header":
@@ -444,7 +452,7 @@ class HarmonyToolParser:
         ev = ToolEvent()
         buf, self._buf = self._buf, ""
         if self._state == "text":
-            ev.content = buf
+            ev.content = self._STRUCT.sub("", buf)
         elif self._state == "header":
             ev.content = self._CHANNEL + buf  # malformed: re-emit raw
         else:  # unterminated body (generation hit max_tokens)
