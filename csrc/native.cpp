@@ -22,10 +22,15 @@
 #include <vector>
 
 #include "xxh64.h"
+#include "radix_core.h"
 
 namespace {
 
 using dynamo_native::xxh64;
+using dynamo_native::Node;
+using dynamo_native::Tree;
+using dynamo_native::Worker;
+using dynamo_native::WorkerHash;
 
 // ---------------------------------------------------------------------------
 // Hashing
@@ -106,105 +111,6 @@ static PyObject* py_hash_bytes(PyObject*, PyObject* args) {
 // ---------------------------------------------------------------------------
 // Radix tree
 // ---------------------------------------------------------------------------
-
-struct Worker {
-  uint64_t id;
-  int32_t dp;
-  bool operator==(const Worker& o) const { return id == o.id && dp == o.dp; }
-};
-
-struct WorkerHash {
-  size_t operator()(const Worker& w) const {
-    uint64_t x = w.id * 0x9E3779B97F4A7C15ULL ^ (uint64_t)(uint32_t)w.dp;
-    x ^= x >> 31;
-    return (size_t)x;
-  }
-};
-
-struct Node {
-  uint64_t hash;
-  Node* parent;
-  std::unordered_map<uint64_t, Node*> children;
-  std::unordered_set<Worker, WorkerHash> workers;
-};
-
-struct Tree {
-  Node root;
-  std::unordered_map<uint64_t, Node*> nodes;
-  std::unordered_map<Worker, int64_t, WorkerHash> worker_blocks;
-
-  Tree() {
-    root.hash = 0;
-    root.parent = nullptr;
-  }
-  ~Tree() {
-    for (auto& kv : nodes) delete kv.second;
-  }
-
-  void apply_stored(Worker w, bool has_parent, uint64_t parent_hash,
-                    const std::vector<uint64_t>& hashes) {
-    Node* parent = &root;
-    if (has_parent) {
-      auto it = nodes.find(parent_hash);
-      // Unknown parent (joined mid-stream): root the chain; sequence hashes
-      // keep lookups correct regardless of attachment point.
-      if (it != nodes.end()) parent = it->second;
-    }
-    for (uint64_t h : hashes) {
-      Node* node;
-      auto it = nodes.find(h);
-      if (it == nodes.end()) {
-        node = new Node();
-        node->hash = h;
-        node->parent = parent;
-        nodes.emplace(h, node);
-        parent->children.emplace(h, node);
-      } else {
-        node = it->second;
-      }
-      if (node->workers.insert(w).second) worker_blocks[w] += 1;
-      parent = node;
-    }
-  }
-
-  void maybe_prune(Node* node) {
-    while (node != &root && node->workers.empty() && node->children.empty()) {
-      Node* parent = node->parent;
-      if (!parent) break;
-      parent->children.erase(node->hash);
-      nodes.erase(node->hash);
-      delete node;
-      node = parent;
-    }
-  }
-
-  void apply_removed(Worker w, const std::vector<uint64_t>& hashes) {
-    for (uint64_t h : hashes) {
-      auto it = nodes.find(h);
-      if (it == nodes.end()) continue;
-      Node* node = it->second;
-      if (node->workers.erase(w)) {
-        auto wb = worker_blocks.find(w);
-        if (wb != worker_blocks.end() && wb->second > 0) wb->second -= 1;
-      }
-      maybe_prune(node);
-    }
-  }
-
-  void remove_worker(Worker w) {
-    // Collect hashes, not pointers: an earlier maybe_prune chain may delete
-    // later entries, so re-resolve each through the nodes map.
-    std::vector<uint64_t> touched;
-    for (auto& kv : nodes) {
-      if (kv.second->workers.erase(w)) touched.push_back(kv.first);
-    }
-    for (uint64_t h : touched) {
-      auto it = nodes.find(h);
-      if (it != nodes.end()) maybe_prune(it->second);
-    }
-    worker_blocks.erase(w);
-  }
-};
 
 typedef struct {
   PyObject_HEAD

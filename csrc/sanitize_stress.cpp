@@ -1,0 +1,122 @@
+// Sanitizer stress harness for the native radix core + hashing.
+//
+// The reference relies on Rust ownership for memory/thread safety; our C++
+// must earn it with sanitizers instead (SURVEY section 5.2). Build+run:
+//
+//   g++ -std=c++17 -O1 -g -fsanitize=address,undefined \
+//       csrc/sanitize_stress.cpp -o /tmp/stress_asan && /tmp/stress_asan
+//   g++ -std=c++17 -O1 -g -fsanitize=thread \
+//       csrc/sanitize_stress.cpp -o /tmp/stress_tsan && /tmp/stress_tsan
+//
+// (tests/test_native.py runs both when g++ is available.)
+//
+// The threaded phase serializes tree mutation with a mutex, mirroring the
+// CPython GIL under which the extension actually runs — TSan then verifies
+// that the serialized usage really is race-free (and would catch any state
+// the extension ever shared outside the GIL).
+
+#include <cassert>
+#include <cstdio>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "radix_core.h"
+#include "xxh64.h"
+
+using dynamo_native::Tree;
+using dynamo_native::Worker;
+using dynamo_native::xxh64;
+
+namespace {
+
+std::vector<uint64_t> chain(uint64_t seed, int start, int n) {
+  std::vector<uint64_t> out;
+  uint64_t h = seed;
+  for (int i = start; i < start + n; i++) {
+    uint32_t tok[4] = {(uint32_t)i, (uint32_t)(i * 7), 3u, 4u};
+    h = xxh64(reinterpret_cast<const uint8_t*>(tok), sizeof tok, h);
+    out.push_back(h);
+  }
+  return out;
+}
+
+void single_thread_stress() {
+  Tree tree;
+  std::mt19937_64 rng(42);
+  std::vector<std::vector<uint64_t>> live;
+  for (int iter = 0; iter < 20000; iter++) {
+    Worker w{rng() % 8, (int32_t)(rng() % 2)};
+    int op = (int)(rng() % 10);
+    if (op < 5) {
+      auto hashes = chain(rng() % 64, 0, 1 + (int)(rng() % 12));
+      bool has_parent = !live.empty() && (rng() & 1);
+      uint64_t parent = has_parent ? live[rng() % live.size()].back() : 0;
+      tree.apply_stored(w, has_parent, parent, hashes);
+      live.push_back(hashes);
+      if (live.size() > 256) live.erase(live.begin());
+    } else if (op < 8 && !live.empty()) {
+      tree.apply_removed(w, live[rng() % live.size()]);
+    } else if (op == 8) {
+      tree.remove_worker(w);
+    } else if (!live.empty()) {
+      // find_matches-style walk
+      const auto& hashes = live[rng() % live.size()];
+      const dynamo_native::Node* cur = &tree.root;
+      for (uint64_t h : hashes) {
+        auto it = cur->children.find(h);
+        if (it == cur->children.end()) break;
+        cur = it->second;
+        (void)cur->workers.size();
+      }
+    }
+  }
+  std::printf("single-thread stress ok (%zu nodes live)\n",
+              tree.nodes.size());
+}
+
+void gil_serialized_stress() {
+  Tree tree;
+  std::mutex gil;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back([&tree, &gil, t] {
+      std::mt19937_64 rng(1000 + t);
+      for (int iter = 0; iter < 5000; iter++) {
+        Worker w{(uint64_t)t, 0};
+        auto hashes = chain(rng() % 32, (int)(rng() % 8),
+                            1 + (int)(rng() % 8));
+        std::lock_guard<std::mutex> hold(gil);
+        switch (rng() % 4) {
+          case 0:
+          case 1:
+            tree.apply_stored(w, false, 0, hashes);
+            break;
+          case 2:
+            tree.apply_removed(w, hashes);
+            break;
+          default:
+            tree.remove_worker(w);
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  std::printf("gil-serialized thread stress ok (%zu nodes live)\n",
+              tree.nodes.size());
+}
+
+}  // namespace
+
+int main() {
+  // hashing determinism sanity under sanitizers
+  uint8_t data[128];
+  for (int i = 0; i < 128; i++) data[i] = (uint8_t)(i * 31);
+  assert(xxh64(data, sizeof data, 7) == xxh64(data, sizeof data, 7));
+  assert(xxh64(data, 0, 7) == xxh64(data, 0, 7));
+  single_thread_stress();
+  gil_serialized_stress();
+  std::printf("sanitize_stress: all ok\n");
+  return 0;
+}

@@ -18,16 +18,13 @@ from typing import AsyncIterator, Optional
 
 import numpy as np
 
-from ..llm.model_card import ModelDeploymentCard, publish_card
+from ..llm.model_card import IMAGE, ModelDeploymentCard, publish_card
 from ..runtime import DistributedRuntime, new_instance_id
 from ..runtime.logging import get_logger
 
 log = get_logger("diffusion")
 
-IMAGE = "image"  # model card type for diffusion workers
-
-
-def _to_png_b64(frame: np.ndarray) -> str:
+def to_png_b64(frame: np.ndarray) -> str:
     from PIL import Image
 
     arr = (np.clip(frame, 0.0, 1.0) * 255).astype(np.uint8)
@@ -37,7 +34,7 @@ def _to_png_b64(frame: np.ndarray) -> str:
     return base64.b64encode(buf.getvalue()).decode()
 
 
-def _to_gif_b64(frames: np.ndarray, fps: int = 4) -> str:
+def to_gif_b64(frames: np.ndarray, fps: int = 4) -> str:
     from PIL import Image
 
     imgs = [Image.fromarray((np.clip(f, 0.0, 1.0) * 255).astype(np.uint8))

@@ -411,7 +411,7 @@ class HttpService:
             result = await self._diffusion_generate(model, body, n_frames=1)
             if isinstance(result, web.Response):
                 return result
-            from ..diffusion import _to_png_b64
+            from ..diffusion import to_png_b64 as _to_png_b64
 
             data = [{"b64_json": _to_png_b64(img[0])} for img in result]
             status = "ok"
@@ -434,10 +434,10 @@ class HttpService:
         try:
             fps = max(1, min(int(body.get("fps", 4)), 30))
             seconds = float(body.get("seconds", 1.0))
-        except (TypeError, ValueError):
+            n_frames = max(1, min(int(seconds * fps), 16))
+        except (TypeError, ValueError, OverflowError):
             return web.json_response(_error_body(
-                400, "fps/seconds must be numeric"), status=400)
-        n_frames = max(1, min(int(seconds * fps), 16))
+                400, "fps/seconds must be finite numbers"), status=400)
         start = time.monotonic()
         status = "error"
         try:
@@ -445,7 +445,7 @@ class HttpService:
                                                     n_frames=n_frames)
             if isinstance(result, web.Response):
                 return result
-            from ..diffusion import _to_gif_b64
+            from ..diffusion import to_gif_b64 as _to_gif_b64
 
             data = [{"b64_json": _to_gif_b64(img, fps=fps), "format": "gif",
                      "frames": int(img.shape[0])} for img in result]

@@ -36,6 +36,7 @@ from .model_card import (
     CHAT,
     COMPLETIONS,
     ENCODER,
+    IMAGE,
     PREFILL,
     ModelDeploymentCard,
 )
@@ -201,7 +202,7 @@ class ModelWatcher:
                 and subject.split("/", 1)[0] != self.namespace_filter):
             return
         card = ModelDeploymentCard.from_wire(value)
-        if "image" in card.model_types:
+        if IMAGE in card.model_types:
             await self._pool_put(card, subject, instance_id,
                                  self.manager.image_pools,
                                  self._image_subjects, "image")

@@ -21,6 +21,7 @@ COMPLETIONS = "completions"
 PREFILL = "prefill"
 EMBEDDINGS = "embeddings"
 ENCODER = "encoder"  # multimodal encode workers (E of E/P/D)
+IMAGE = "image"  # diffusion (image/video generation) workers
 
 # Model input types (ref: ModelInput::{Tokens,Text})
 INPUT_TOKENS = "tokens"

@@ -229,7 +229,9 @@ def ddim_sample(params: dict, config: DiffusionConfig, cond: jax.Array,
             # re-noise partially for the next frame: temporal coherence via
             # shared structure, variation via fresh noise
             key, sub = jax.random.split(key)
-            x = (jnp.sqrt(alpha_bar(0.5)) * (x * 2 - 1)
+            # x is already in model space [-1, 1] (frames.append converts a
+            # COPY to [0, 1]); re-noise it directly.
+            x = (jnp.sqrt(alpha_bar(0.5)) * x
                  + jnp.sqrt(1 - alpha_bar(0.5))
                  * jax.random.normal(sub, shape))
     return jnp.clip(jnp.stack(frames), 0.0, 1.0)

@@ -412,6 +412,12 @@ class HarmonyToolParser:
                 if idx == -1:
                     hold = max(prefix_hold(self._buf, m)
                                for m in self._ALL_MARKS)
+                    # Also hold a trailing '<|start|>rolename' whose role
+                    # word may continue in the next chunk — stripping the
+                    # complete marker now would leak the word's tail later.
+                    tail = re.search(r"<\|start\|>[\w.-]*$", self._buf)
+                    if tail is not None:
+                        hold = max(hold, len(self._buf) - tail.start())
                     emit = self._buf[: len(self._buf) - hold]
                     ev.content += self._STRUCT.sub("", emit)
                     self._buf = self._buf[len(self._buf) - hold:]

@@ -3,6 +3,8 @@ radix-tree contract equivalence (csrc/native.cpp)."""
 
 import random
 
+import os
+
 import pytest
 import xxhash
 
@@ -127,3 +129,31 @@ class TestNativeRadixEquivalence:
         assert fresh.find_matches([1, 2, 3]).scores == {w: 3}
         assert fresh.find_matches([1, 2, 9]).scores == {w: 3}
         assert fresh.worker_block_counts() == {w: 4}
+
+
+class TestSanitizers:
+    """ASan/UBSan + TSan over the native radix core (ref SURVEY section
+    5.2: the reference gets safety from Rust ownership; our C++ earns it
+    with sanitizers). Skipped when g++ is unavailable."""
+
+    @pytest.mark.parametrize("flags", ["address,undefined", "thread"])
+    def test_stress_clean_under_sanitizer(self, flags, tmp_path):
+        import shutil
+        import subprocess
+        import sys
+
+        if shutil.which("g++") is None:
+            pytest.skip("g++ not available")
+        src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                           "sanitize_stress.cpp")
+        csrc = os.path.dirname(src)
+        binary = str(tmp_path / f"stress_{flags.split(',')[0]}")
+        build = subprocess.run(
+            ["g++", "-std=c++17", "-O1", "-g", f"-fsanitize={flags}",
+             f"-I{csrc}", src, "-o", binary],
+            capture_output=True, text=True, timeout=300)
+        assert build.returncode == 0, build.stderr
+        run_proc = subprocess.run([binary], capture_output=True, text=True,
+                                  timeout=300)
+        assert run_proc.returncode == 0, (run_proc.stdout + run_proc.stderr)
+        assert "all ok" in run_proc.stdout
