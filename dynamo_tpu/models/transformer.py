@@ -647,6 +647,202 @@ def forward_ring(
     return logits, jnp.stack(ks), jnp.stack(vs)
 
 
+def stack_layer_params(layers: list[dict]) -> dict:
+    """Stack a list of UNIFORM layer dicts into one pytree with a leading
+    layer axis (pipeline stages scan over it; the stack shards over pp)."""
+    import jax
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def _dense_layer_step(x: jax.Array, lp: dict, config: ModelConfig,
+                      positions: jax.Array, mask: jax.Array,
+                      axis_tp: Optional[str] = None):
+    """One dense-GQA layer with in-chunk causal attention (prefill; no
+    paged-cache read). Returns (x, (k, v)). Uniform across layers so
+    pipeline stages can lax.scan over a stacked layer pytree.
+
+    With `axis_tp` set (inside shard_map), lp's head/mlp dims are LOCAL
+    shards: attention runs on local heads and the two residual
+    projections psum over tp — the manual form of the tp sharding pjit
+    inserts on the non-PP path."""
+    b, t, _ = x.shape
+    kh_local = lp["wk"].shape[1]
+    group = config.n_q_heads // config.n_kv_heads
+    h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+    q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+    k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+    v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+    if config.qk_norm:
+        q = rms_norm(q, lp["q_norm"], config.rms_eps)
+        k = rms_norm(k, lp["k_norm"], config.rms_eps)
+    q = rope(q, positions, config.rope_theta)
+    k = rope(k, positions, config.rope_theta)
+    qg = q.reshape(b, t, kh_local, group, config.head_dim)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) \
+        * (1.0 / math.sqrt(config.head_dim))
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bkgts,bskd->btkgd", weights,
+                      v.astype(jnp.float32)).astype(q.dtype)
+    attn = attn.reshape(b, t, kh_local * group, config.head_dim)
+    attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+    if axis_tp:
+        attn_out = jax.lax.psum(attn_out, axis_tp)
+    x = x + attn_out
+    hmid = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    gate = jnp.einsum("bth,hm->btm", hmid, lp["w_gate"])
+    up = jnp.einsum("bth,hm->btm", hmid, lp["w_up"])
+    down = jnp.einsum("btm,mh->bth", jax.nn.silu(gate) * up, lp["w_down"])
+    if axis_tp:
+        down = jax.lax.psum(down, axis_tp)
+    x = x + down
+    return x, (k, v)
+
+
+def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
+    """Pipeline-parallel prefill over the `pp` mesh axis (GPipe schedule,
+    ops/pipeline.py): layers split into pp stages, activations hop stages
+    via collective permute, each stage keeps ITS layers' K/V locally —
+    exactly the shard a layer-partitioned paged pool wants. Dense-GQA
+    models (uniform layers; MoE/MLA keep tp/ep/dp).
+
+    Layer weights shard over BOTH pp (layer axis, via the stacked pytree)
+    and tp (head/mlp axes) inside one shard_map — stage hops ppermute over
+    pp while the two residual projections psum over tp, so tp collectives
+    stay on the fast inner links.
+
+    Returns fn(params, tokens [M, mb, T], positions [M, mb, T],
+               valid [M, mb, T]) -> (logits [M, mb, T, V],
+               ks [L, M, mb, T, kh, hd] pp-sharded on L, vs ...).
+    """
+    import jax as _jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.pipeline import gpipe_prefill_loop
+    from ..parallel.mesh import AXIS_PP, AXIS_TP
+
+    assert not config.is_mla and not config.n_experts, (
+        "pp prefill targets dense-GQA models")
+    pp = mesh.shape.get(AXIS_PP, 1)
+    tp = mesh.shape.get(AXIS_TP, 1)
+    assert config.n_layers % pp == 0, (
+        f"n_layers={config.n_layers} must divide by pp={pp}")
+    assert config.n_kv_heads % tp == 0, (
+        f"n_kv_heads={config.n_kv_heads} must divide by tp={tp}")
+    # Always thread the tp axis: the weight specs shard over tp even at
+    # size 1, which types every layer output tp-varying; psum/pmean over a
+    # size-1 axis compiles to a no-op.
+    axis_tp = AXIS_TP
+
+    # Per-leaf shard specs for the stacked layer pytree: pp on the leading
+    # layer axis everywhere; tp on head/mlp axes.
+    _SPECS = {
+        "attn_norm": P(AXIS_PP), "mlp_norm": P(AXIS_PP),
+        "q_norm": P(AXIS_PP), "k_norm": P(AXIS_PP),
+        "wq": P(AXIS_PP, None, AXIS_TP),
+        "wk": P(AXIS_PP, None, AXIS_TP),
+        "wv": P(AXIS_PP, None, AXIS_TP),
+        "wo": P(AXIS_PP, AXIS_TP),
+        "w_gate": P(AXIS_PP, None, AXIS_TP),
+        "w_up": P(AXIS_PP, None, AXIS_TP),
+        "w_down": P(AXIS_PP, AXIS_TP),
+    }
+    # Stacking copies the whole layer stack; params are fixed per server,
+    # so memoize by identity instead of re-stacking per request.
+    _stack_cache: dict[int, dict] = {}
+
+    def run(params, tokens, positions, valid):
+        m, mb, t = tokens.shape
+        assert m == n_micro, (
+            f"built for n_micro={n_micro} microbatches, got {m} — the "
+            "pipeline bubble fraction depends on it")
+        # Embedding outside the pipeline (replicated table).
+        x = params["embed"][tokens]  # [M, mb, T, H]
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        key = id(params["layers"])
+        stacked = _stack_cache.get(key)
+        if stacked is None:
+            _stack_cache.clear()
+            stacked = stack_layer_params(params["layers"])
+            _stack_cache[key] = stacked
+
+        def stage(stage_params, act):
+            # act: [mb, T, H+2] float32 — hidden state with positions and
+            # valid appended so per-microbatch metadata rides the pipeline
+            # (f32 between stages: bf16 cannot represent positions > 256
+            # exactly).
+            hstate = act[..., : config.hidden].astype(
+                jnp.dtype(config.dtype))
+            pos = act[..., config.hidden]
+            val = act[..., config.hidden + 1] > 0.5
+            mask = causal[None] & val[:, None, :]
+
+            def body(carry, lp):
+                out, kv = _dense_layer_step(carry, lp, config,
+                                            pos.astype(jnp.int32), mask,
+                                            axis_tp=axis_tp)
+                return out, kv
+
+            hstate, (ks, vs) = _jax.lax.scan(body, hstate, stage_params)
+            out = jnp.concatenate(
+                [hstate.astype(jnp.float32), pos[..., None],
+                 val[..., None].astype(jnp.float32)], axis=-1)
+            return out, (ks, vs)
+
+        # Pack per-microbatch positions/valid alongside the hidden state so
+        # they travel with the activation through ppermute.
+        acts = jnp.concatenate(
+            [x.astype(jnp.float32),
+             positions[..., None].astype(jnp.float32),
+             valid[..., None].astype(jnp.float32)], axis=-1)
+
+        l_local = config.n_layers // pp
+        kh_local = config.n_kv_heads // tp
+        kv_shape = (l_local, mb, t, kh_local, config.head_dim)
+        kv_dtype = jnp.dtype(config.dtype)
+
+        def shard_body(stacked_local, acts_all):
+            outs, ks, vs = gpipe_prefill_loop(
+                stage, stacked_local, acts_all,
+                kv_shapes=(kv_shape, kv_shape), kv_dtype=kv_dtype,
+                axis_name=AXIS_PP,
+                extra_varying=(AXIS_TP,))
+            # outs is tp-REPLICATED numerically but tp-varying in the type
+            # system; pmean collapses it (exact: x*tp/tp with power-of-two
+            # tp).
+            outs = _jax.lax.pmean(outs, AXIS_TP)
+            return outs, ks, vs
+
+        stacked_specs = _jax.tree_util.tree_map_with_path(
+            lambda path, _: _SPECS[str(getattr(path[-1], "key", ""))],
+            stacked)
+        outs, ks, vs = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(stacked_specs, P()),
+            out_specs=(P(), P(AXIS_PP, None, None, None, AXIS_TP),
+                       P(AXIS_PP, None, None, None, AXIS_TP)),
+        )(stacked, acts)
+        # Back to model dtype before norm+head so logits match the dense
+        # forward bit-for-bit in rounding behavior.
+        hidden = outs[..., : config.hidden].astype(jnp.dtype(config.dtype))
+        hidden = rms_norm(hidden, params["final_norm"], config.rms_eps)
+        head = (params["embed"].T if config.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("mbth,hv->mbtv", hidden, head).astype(
+            jnp.float32)
+        # ks/vs: [L_local * pp, M, mb, T, kh, hd] -> reorder to [L, ...]
+        ks = ks.reshape(config.n_layers, m, mb, t, config.n_kv_heads,
+                        config.head_dim)
+        vs = vs.reshape(config.n_layers, m, mb, t, config.n_kv_heads,
+                        config.head_dim)
+        return logits, ks, vs
+
+    return run
+
+
 def write_kv_stack(
     kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
     k_stack: jax.Array,  # [L, B, T, kh, hd]

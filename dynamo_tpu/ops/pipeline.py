@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe schedule over a mesh axis.
+
+The reference reaches multi-node scale by delegating PP to its engines
+(vLLM headless multi-node over Ray — ref: SURVEY §2.5 "PP"); owning the
+engine, we express it the TPU way: layers partitioned into `pp` stages,
+activations moved rank-to-rank with `lax.ppermute` (DCN between slices,
+ICI within), microbatches overlapping stage compute in the classic GPipe
+schedule. Everything runs SPMD inside `shard_map` — one compiled program,
+no host orchestration per microbatch.
+
+Schedule: with P stages and M microbatches, T = M + P - 1 ticks. At tick
+t, stage r runs microbatch (t - r) when 0 <= t - r < M; stage outputs
+rotate to r+1 every tick. Bubble fraction = (P-1)/T, amortized by M.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe_stage_loop(
+    stage_fn: Callable,  # (stage_params, act [mb, ...]) -> act [mb, ...]
+    stage_params,  # this rank's layer-stack slice (pytree)
+    microbatches: jax.Array,  # [M, mb, ...] inputs (used on stage 0)
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Activation-only pipeline: thin wrapper over gpipe_prefill_loop
+    (single schedule implementation) with a dummy KV aux. Call INSIDE a
+    shard_map over `axis_name`; returns [M, mb, ...] final-stage outputs
+    valid on EVERY rank."""
+
+    def with_dummy_aux(params, act):
+        out = stage_fn(params, act)
+        dummy = jnp.zeros((1, 1), jnp.float32)
+        return out, (dummy, dummy)
+
+    outs, _, _ = gpipe_prefill_loop(
+        with_dummy_aux, stage_params, microbatches,
+        kv_shapes=((1, 1), (1, 1)), kv_dtype=jnp.float32,
+        axis_name=axis_name)
+    return outs
+
+
+def gpipe_prefill_loop(
+    stage_fn: Callable,  # (stage_params, act) -> (act, (k_stack, v_stack))
+    stage_params,
+    microbatches: jax.Array,  # [M, mb, ...]
+    kv_shapes: tuple,  # shapes of (k, v) per microbatch: [L_local, mb, ...]
+    kv_dtype=jnp.bfloat16,  # MUST follow the model/cache dtype: a bf16
+    # accumulator under a float32 model would silently round the KV the
+    # paged pool stores
+    axis_name: str = "pp",
+    extra_varying: tuple = (),  # further mesh axes the stage outputs vary
+    # over (e.g. tp when stage weights are tp-sharded); carries must enter
+    # the scan with matching varying types
+):
+    """GPipe loop that ALSO collects each stage's per-layer K/V stacks
+    rank-locally — the shape a layer-sharded paged KV pool wants (each
+    stage owns its layers' cache shard; no K/V ever crosses stages).
+
+    Returns (outputs [M, mb, ...] broadcast to all ranks,
+             ks [L_local, M, mb, ...], vs [L_local, M, mb, ...] rank-local).
+    """
+    pp = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    k_shape, v_shape = kv_shapes
+    axes = (axis_name,) + tuple(extra_varying)
+
+    act0 = lax.pcast(jnp.zeros_like(microbatches[0]), axes, to="varying")
+    outs0 = lax.pcast(jnp.zeros_like(microbatches), axes, to="varying")
+    ks0 = lax.pcast(jnp.zeros((k_shape[0], n_micro) + tuple(k_shape[1:]),
+                              kv_dtype), axes, to="varying")
+    vs0 = lax.pcast(jnp.zeros((v_shape[0], n_micro) + tuple(v_shape[1:]),
+                              kv_dtype), axes, to="varying")
+
+    def tick(carry, t):
+        act, outs, ks, vs = carry
+        feed = microbatches[jnp.minimum(t, n_micro - 1)]
+        feeding = (rank == 0) & (t < n_micro)
+        act_in = jnp.where(feeding, feed, act)
+        act_out, (k, v) = stage_fn(stage_params, act_in)
+        # This rank processed microbatch t - rank this tick.
+        mi_r = t - rank
+        valid_r = (mi_r >= 0) & (mi_r < n_micro)
+        slot_r = jnp.clip(mi_r, 0, n_micro - 1)
+        ks = jnp.where(
+            valid_r,
+            lax.dynamic_update_index_in_dim(ks, k.astype(ks.dtype),
+                                            slot_r, 1),
+            ks)
+        vs = jnp.where(
+            valid_r,
+            lax.dynamic_update_index_in_dim(vs, v.astype(vs.dtype),
+                                            slot_r, 1),
+            vs)
+        mi = t - (pp - 1)
+        collect = (rank == pp - 1) & (mi >= 0)
+        slot = jnp.clip(mi, 0, n_micro - 1)
+        outs = jnp.where(
+            collect,
+            lax.dynamic_update_index_in_dim(outs, act_out, slot, 0),
+            outs)
+        act_next = lax.ppermute(act_out, axis_name, perm)
+        return (act_next, outs, ks, vs), None
+
+    (_, outs, ks, vs), _ = lax.scan(tick, (act0, outs0, ks0, vs0),
+                                    jnp.arange(ticks))
+    outs = jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis_name), ks, vs
+
+

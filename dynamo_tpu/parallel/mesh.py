@@ -11,6 +11,10 @@ over ICI and lets XLA insert collectives. Axes:
        ride ICI within a slice.
   sp — sequence/context parallel for long-context ring attention (ops/ring).
   ep - expert parallel for MoE layers (experts sharded over ep).
+  pp — pipeline parallel: layer stages across slices/pods, activations
+       moved rank-to-rank with collective permutes (ops/pipeline.py GPipe
+       schedule); the outermost axis so stage hops ride DCN while tp
+       all-reduces stay on ICI.
 
 tp is the innermost axis so its all-reduces ride the fastest ICI links.
 """
@@ -30,6 +34,7 @@ AXIS_DP = "dp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 AXIS_EP = "ep"
+AXIS_PP = "pp"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,16 +43,17 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
     def axis_names(self) -> tuple[str, ...]:
-        return (AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
+        return (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
 
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.dp, self.sp, self.ep, self.tp)
+        return (self.pp, self.dp, self.sp, self.ep, self.tp)
 
 
 def apply_platform_override() -> None:
