@@ -43,24 +43,32 @@ def sample(
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
 
-    # top-k: mask logits below the k-th largest (k=0 -> disabled)
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(top_k - 1, 0, v - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
-    topk_mask = (scaled >= kth) | (top_k[:, None] <= 0)
+    def with_trunc_masks(scaled):
+        # top-k: mask logits below the k-th largest (k=0 -> disabled)
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_idx = jnp.clip(top_k - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+        topk_mask = (scaled >= kth) | (top_k[:, None] <= 0)
 
-    # top-p: keep the smallest set of tokens with cumulative prob >= top_p
-    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
-    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
-    # token kept if its sorted-cumulative position (exclusive) < top_p
-    cutoff = cumprobs - probs_sorted < top_p[:, None]
-    # map back: a logit is kept if >= the smallest kept sorted logit
-    min_kept = jnp.min(
-        jnp.where(cutoff, sorted_desc, jnp.inf), axis=-1, keepdims=True
-    )
-    topp_mask = (scaled >= min_kept) | (top_p[:, None] >= 1.0)
+        # top-p: smallest set of tokens with cumulative prob >= top_p
+        probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+        cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+        # token kept if its sorted-cumulative position (exclusive) < top_p
+        cutoff = cumprobs - probs_sorted < top_p[:, None]
+        # map back: a logit is kept if >= the smallest kept sorted logit
+        min_kept = jnp.min(
+            jnp.where(cutoff, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        topp_mask = (scaled >= min_kept) | (top_p[:, None] >= 1.0)
+        return jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
 
-    masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    # The truncation masks need a FULL-VOCAB SORT — several times the cost
+    # of the rest of sampling. Typical traffic (greedy, or plain
+    # temperature sampling with top_k=0/top_p=1) never uses them, so gate
+    # the sort at runtime on whether any slot actually truncates.
+    any_trunc = jnp.any((top_k > 0) & (temperature > 0)) | \
+        jnp.any((top_p < 1.0) & (temperature > 0))
+    masked = jax.lax.cond(any_trunc, with_trunc_masks, lambda s: s, scaled)
     steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), seeds.shape)
     keys = jax.vmap(
         lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)

@@ -650,8 +650,6 @@ def forward_ring(
 def stack_layer_params(layers: list[dict]) -> dict:
     """Stack a list of UNIFORM layer dicts into one pytree with a leading
     layer axis (pipeline stages scan over it; the stack shards over pp)."""
-    import jax
-
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
 
@@ -717,7 +715,6 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
                valid [M, mb, T]) -> (logits [M, mb, T, V],
                ks [L, M, mb, T, kh, hd] pp-sharded on L, vs ...).
     """
-    import jax as _jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -750,9 +747,13 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
         "w_up": P(AXIS_PP, None, AXIS_TP),
         "w_down": P(AXIS_PP, AXIS_TP),
     }
-    # Stacking copies the whole layer stack; params are fixed per server,
-    # so memoize by identity instead of re-stacking per request.
-    _stack_cache: dict[int, dict] = {}
+    # Stacking copies the whole layer stack once (the stacked layout IS
+    # the natural storage for a dedicated PP deployment — callers may drop
+    # params["layers"] after the first run to reclaim the duplicate).
+    # Memoized by held identity, not id(): holding the source list keeps
+    # its id from being recycled, so a weight swap can never silently hit
+    # a stale entry.
+    _stack_cache: dict = {"src": None, "stacked": None}
 
     def run(params, tokens, positions, valid):
         m, mb, t = tokens.shape
@@ -762,12 +763,10 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
         # Embedding outside the pipeline (replicated table).
         x = params["embed"][tokens]  # [M, mb, T, H]
         causal = jnp.tril(jnp.ones((t, t), bool))
-        key = id(params["layers"])
-        stacked = _stack_cache.get(key)
-        if stacked is None:
-            _stack_cache.clear()
-            stacked = stack_layer_params(params["layers"])
-            _stack_cache[key] = stacked
+        if _stack_cache["src"] is not params["layers"]:
+            _stack_cache["src"] = params["layers"]
+            _stack_cache["stacked"] = stack_layer_params(params["layers"])
+        stacked = _stack_cache["stacked"]
 
         def stage(stage_params, act):
             # act: [mb, T, H+2] float32 — hidden state with positions and
@@ -786,7 +785,7 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
                                             axis_tp=axis_tp)
                 return out, kv
 
-            hstate, (ks, vs) = _jax.lax.scan(body, hstate, stage_params)
+            hstate, (ks, vs) = jax.lax.scan(body, hstate, stage_params)
             out = jnp.concatenate(
                 [hstate.astype(jnp.float32), pos[..., None],
                  val[..., None].astype(jnp.float32)], axis=-1)
@@ -813,10 +812,10 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
             # outs is tp-REPLICATED numerically but tp-varying in the type
             # system; pmean collapses it (exact: x*tp/tp with power-of-two
             # tp).
-            outs = _jax.lax.pmean(outs, AXIS_TP)
+            outs = jax.lax.pmean(outs, AXIS_TP)
             return outs, ks, vs
 
-        stacked_specs = _jax.tree_util.tree_map_with_path(
+        stacked_specs = jax.tree_util.tree_map_with_path(
             lambda path, _: _SPECS[str(getattr(path[-1], "key", ""))],
             stacked)
         outs, ks, vs = shard_map(

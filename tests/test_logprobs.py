@@ -223,3 +223,51 @@ class TestLogprobsE2E:
             await rt.shutdown()
 
         run(body(), timeout=180)
+
+
+class TestSamplerTruncationGate:
+    """The full-vocab sort is gated behind a runtime cond — truncation
+    must still bite when requested."""
+
+    def test_topk_one_equals_greedy(self):
+        from dynamo_tpu.engine.sampler import sample_with_logprobs
+
+        logits = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (4, 64)), jnp.float32)
+        greedy = np.argmax(np.asarray(logits), -1)
+        toks, _, _, _ = sample_with_logprobs(
+            logits, jnp.full(4, 1.0), jnp.ones(4),
+            jnp.full(4, 1, jnp.int32),  # top_k=1 -> must pick argmax
+            jnp.arange(4, dtype=jnp.uint32), jnp.int32(3))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+    def test_tiny_top_p_equals_greedy(self):
+        from dynamo_tpu.engine.sampler import sample
+
+        logits = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (4, 64)) * 5, jnp.float32)
+        greedy = np.argmax(np.asarray(logits), -1)
+        toks = sample(logits, jnp.full(4, 1.0), jnp.full(4, 1e-6),
+                      jnp.zeros(4, jnp.int32),
+                      jnp.arange(4, dtype=jnp.uint32), jnp.int32(0))
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+    def test_mixed_batch_truncated_and_plain(self):
+        """One slot truncating forces the masked branch for the batch;
+        plain slots must be unaffected (mask is a no-op for them)."""
+        from dynamo_tpu.engine.sampler import sample
+
+        logits = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (2, 64)), jnp.float32)
+        toks_mixed = sample(
+            logits, jnp.asarray([1.0, 1.0]), jnp.asarray([1.0, 1e-6]),
+            jnp.asarray([0, 0], jnp.int32),
+            jnp.asarray([7, 8], jnp.uint32), jnp.int32(5))
+        toks_plain = sample(
+            logits, jnp.asarray([1.0, 1.0]), jnp.asarray([1.0, 1.0]),
+            jnp.asarray([0, 0], jnp.int32),
+            jnp.asarray([7, 8], jnp.uint32), jnp.int32(5))
+        # slot 0 (no truncation) samples identically either way
+        assert int(toks_mixed[0]) == int(toks_plain[0])
+        # slot 1 with top_p->0 is argmax
+        assert int(toks_mixed[1]) == int(np.argmax(np.asarray(logits)[1]))

@@ -72,7 +72,6 @@ class TestPpPrefill:
                             config.n_kv_heads, config.head_dim)
 
         # dense reference per microbatch via the paged forward
-        ref_mesh = make_mesh(MeshConfig())
         for mi in range(m):
             kv = make_kv_cache(config, 64, 4)
             tables = np.zeros((mb, 16), np.int32)
