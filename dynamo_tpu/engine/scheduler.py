@@ -491,6 +491,13 @@ class InferenceScheduler:
         if not ready:
             return 0
         self._active[:] = False
+        # Neutralize params of inactive slots: sample()'s runtime gate
+        # skips the full-vocab truncation sort only when NO slot truncates,
+        # and a finished top_k/top_p request must not keep forcing the
+        # expensive branch from a stale slot.
+        self._temp[:] = 0.0
+        self._top_p[:] = 1.0
+        self._top_k[:] = 0
         for seq in ready:
             i = seq.slot
             self._tokens[i] = seq.last_token

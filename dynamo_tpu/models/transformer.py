@@ -747,12 +747,14 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
         "w_up": P(AXIS_PP, None, AXIS_TP),
         "w_down": P(AXIS_PP, AXIS_TP),
     }
-    # Stacking copies the whole layer stack once (the stacked layout IS
-    # the natural storage for a dedicated PP deployment — callers may drop
-    # params["layers"] after the first run to reclaim the duplicate).
-    # Memoized by held identity, not id(): holding the source list keeps
-    # its id from being recycled, so a weight swap can never silently hit
-    # a stale entry.
+    # Stacking copies the whole layer stack once; the memo holds a strong
+    # reference to the source list, so BOTH the per-layer copy and the
+    # stacked copy stay resident (plan HBM for 2x layer weights when using
+    # PP, or build params in stacked form at load for dedicated PP
+    # deployments). Holding the source keeps its id from being recycled —
+    # a weight SWAP (replacing the list object) safely misses the cache.
+    # In-place mutation of the list's element arrays is NOT supported:
+    # always replace params["layers"] wholesale on weight updates.
     _stack_cache: dict = {"src": None, "stacked": None}
 
     def run(params, tokens, positions, valid):
