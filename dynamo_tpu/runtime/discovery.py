@@ -466,11 +466,19 @@ class FileDiscovery(Discovery):
         return watch
 
 
-def make_discovery(backend: str, *, path: str = "", cluster: str = "") -> Discovery:
+def make_discovery(backend: str, *, path: str = "", cluster: str = "",
+                   endpoint: str = "") -> Discovery:
     if backend == "mem":
         # For mem, `path` doubles as the cluster key so tests can isolate
         # logical clusters within one process.
         return MemDiscovery(cluster=cluster or path or "default")
     if backend == "file":
         return FileDiscovery(path or "/tmp/dynamo_tpu_discovery")
-    raise ValueError(f"unknown discovery backend: {backend!r} (expected mem|file)")
+    if backend == "etcd":
+        from .etcd import EtcdDiscovery
+
+        # `path` carries the endpoint when callers only have the two-arg
+        # form (the FileDiscovery convention of overloading path).
+        return EtcdDiscovery(endpoint or path or "http://127.0.0.1:2379")
+    raise ValueError(
+        f"unknown discovery backend: {backend!r} (expected mem|file|etcd)")

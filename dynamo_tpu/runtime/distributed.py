@@ -35,6 +35,7 @@ class DistributedRuntime:
         self.discovery: Discovery = make_discovery(
             self.config.discovery_backend,
             path=self.config.discovery_path,
+            endpoint=self.config.etcd_endpoints,
         )
         self.lease: Optional[Lease] = None
         if self.config.request_plane == "mem":

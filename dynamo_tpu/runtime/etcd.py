@@ -1,0 +1,351 @@
+"""etcd v3 Discovery backend over the grpc-gateway JSON API.
+
+The reference's production discovery plane is etcd (ref:
+lib/runtime/src/transports/etcd.rs — lease grant/keepalive/revoke, prefix
+watch, 10s TTL; docs/design-docs/discovery-plane.md "Lease-Based Cleanup").
+This backend speaks the same contract against a real etcd cluster through
+the v3 JSON gateway (`/v3/kv/*`, `/v3/lease/*`, `/v3/watch`) — every etcd
+since 3.2 serves it on the client port, so no grpc/protobuf dependency is
+needed and the wire format is auditable JSON.
+
+Semantics implemented:
+  * leases: grant(TTL) -> keepalive refresh -> revoke; expiry deletes all
+    attached keys server-side, watchers see DELETE events
+  * put/delete/get_prefix: range queries with the standard prefix range_end
+    (prefix with last byte +1)
+  * watch_prefix: one streaming POST /v3/watch per watch; created from the
+    revision AFTER an initial range snapshot so include_existing replay and
+    live events are gap-free and duplicate-free. Reconnects resume from the
+    last DELIVERED event's mod_revision (not the response header, which can
+    run ahead of batched events); a compaction past the resume point forces
+    a full snapshot resync that diffs against the keys already reported.
+
+Keys and values are base64 on the wire (gateway rule); values are JSON
+documents, matching Mem/File backends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import math
+from typing import Optional
+
+from .discovery import Discovery, KvEvent, Lease, LeaseExpired, Watch
+from .logging import get_logger
+
+log = get_logger("discovery.etcd")
+
+# Unary calls must fail fast: the runtime's keep-alive loop runs at TTL/3
+# and a black-holed connection that blocks past the TTL loses the lease
+# cluster-wide without the owner ever seeing LeaseExpired.
+UNARY_TIMEOUT_SECS = 5.0
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def _prefix_range_end(prefix: str) -> str:
+    """etcd prefix scan convention: range_end = prefix with its final byte
+    incremented (carrying over 0xff). Empty prefix scans the whole space."""
+    b = bytearray(prefix.encode())
+    while b:
+        if b[-1] < 0xFF:
+            b[-1] += 1
+            return base64.b64encode(bytes(b)).decode()
+        b.pop()
+    return base64.b64encode(b"\x00").decode()  # '\0' == "all keys" sentinel
+
+
+class EtcdDiscovery(Discovery):
+    """Discovery over an etcd cluster (v3 JSON gateway).
+
+    `endpoints` follows the etcd convention: a comma-separated list of base
+    URLs; unary calls fail over across them in order.
+    """
+
+    def __init__(self, endpoints: str = "http://127.0.0.1:2379") -> None:
+        self._endpoints = [e.strip().rstrip("/")
+                           for e in endpoints.split(",") if e.strip()]
+        if not self._endpoints:
+            raise ValueError("no etcd endpoints given")
+        self._session = None
+        self._watch_tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        import aiohttp
+
+        # No session-level read timeout: the watch stream is infinite.
+        # Unary calls override per-request (UNARY_TIMEOUT_SECS).
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, connect=5.0,
+                                          sock_read=None)
+        )
+
+    async def close(self) -> None:
+        for task in self._watch_tasks:
+            task.cancel()
+        for task in self._watch_tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._watch_tasks.clear()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _post(self, path: str, body: dict) -> dict:
+        import aiohttp
+
+        assert self._session is not None, "EtcdDiscovery not started"
+        timeout = aiohttp.ClientTimeout(total=UNARY_TIMEOUT_SECS)
+        last_exc: Optional[Exception] = None
+        for endpoint in self._endpoints:
+            try:
+                async with self._session.post(endpoint + path, json=body,
+                                              timeout=timeout) as resp:
+                    text = await resp.text()
+                    if resp.status != 200:
+                        # etcd itself answers JSON errors (lease-not-found
+                        # etc.) — those are semantic, don't fail over. A
+                        # proxy/LB error page (HTML 502) is transport-ish:
+                        # try the next endpoint.
+                        try:
+                            data = json.loads(text)
+                        except ValueError:
+                            last_exc = RuntimeError(
+                                f"etcd {path} -> {resp.status}: "
+                                f"{text[:200]!r}")
+                            continue
+                        raise RuntimeError(
+                            f"etcd {path} -> {resp.status}: {data}")
+                    return json.loads(text)
+            except (aiohttp.ClientConnectionError,
+                    asyncio.TimeoutError) as exc:
+                last_exc = exc
+                continue  # fail over to the next endpoint
+        raise RuntimeError(
+            f"etcd {path}: all endpoints unreachable or unhealthy "
+            f"({self._endpoints})") from last_exc
+
+    # -- leases -------------------------------------------------------------
+
+    async def create_lease(self, ttl: float) -> Lease:
+        # etcd TTLs are whole seconds, minimum 1 (etcd.rs uses 10s).
+        secs = max(1, math.ceil(ttl))
+        data = await self._post("/v3/lease/grant", {"TTL": str(secs)})
+        if data.get("error"):
+            raise RuntimeError(f"lease grant failed: {data['error']}")
+        return Lease(lease_id=str(data["ID"]), ttl=float(data.get("TTL", secs)))
+
+    async def keep_alive(self, lease: Lease) -> None:
+        data = await self._post("/v3/lease/keepalive",
+                                {"ID": str(lease.lease_id)})
+        # Gateway wraps the stream's first message in {"result": {...}}.
+        result = data.get("result", data)
+        ttl = int(result.get("TTL", 0) or 0)
+        if ttl <= 0:
+            # etcd answers TTL=0 for an expired/unknown lease; the owner
+            # must re-register (FileDiscovery raises the same way).
+            raise LeaseExpired(lease.lease_id)
+
+    async def revoke_lease(self, lease: Lease) -> None:
+        try:
+            await self._post("/v3/lease/revoke", {"ID": str(lease.lease_id)})
+        except RuntimeError:
+            pass  # already expired/revoked — the goal state holds
+
+    # -- kv -----------------------------------------------------------------
+
+    async def put(self, key: str, value: dict,
+                  lease: Optional[Lease] = None) -> None:
+        body = {"key": _b64(key), "value": _b64(json.dumps(value))}
+        if lease is not None:
+            body["lease"] = str(lease.lease_id)
+        try:
+            await self._post("/v3/kv/put", body)
+        except RuntimeError as exc:
+            if "lease not found" in str(exc).lower():
+                raise LeaseExpired(lease.lease_id if lease else "?") from exc
+            raise
+
+    async def delete(self, key: str) -> None:
+        await self._post("/v3/kv/deleterange", {"key": _b64(key)})
+
+    async def _range(self, prefix: str) -> tuple[dict[str, dict], int]:
+        data = await self._post("/v3/kv/range", {
+            "key": _b64(prefix),
+            "range_end": _prefix_range_end(prefix),
+        })
+        out: dict[str, dict] = {}
+        for kv in data.get("kvs", []) or []:
+            try:
+                out[_unb64(kv["key"])] = json.loads(_unb64(kv["value"]))
+            except (KeyError, ValueError):
+                continue
+        revision = int(data.get("header", {}).get("revision", 0))
+        return out, revision
+
+    async def get_prefix(self, prefix: str) -> dict[str, dict]:
+        out, _ = await self._range(prefix)
+        return out
+
+    # -- watch --------------------------------------------------------------
+
+    async def watch_prefix(self, prefix: str,
+                           include_existing: bool = True) -> Watch:
+        snapshot, revision = await self._range(prefix)
+        watch = Watch()
+        if include_existing:
+            for key in sorted(snapshot):
+                watch._emit(KvEvent("put", key, snapshot[key]))
+        task = asyncio.create_task(
+            self._watch_stream(prefix, revision + 1, set(snapshot), watch))
+        self._watch_tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._watch_tasks.remove(t)
+            if t in self._watch_tasks else None)
+
+        orig_cancel = watch.cancel
+
+        async def cancel() -> None:
+            task.cancel()
+            await orig_cancel()
+
+        watch.cancel = cancel  # type: ignore[method-assign]
+        return watch
+
+    async def _resync(self, prefix: str, live_keys: set[str],
+                      watch: Watch) -> tuple[int, set[str]]:
+        """Snapshot resync after a compaction gap: diff the store against
+        the keys already reported so the watcher converges (deletes for
+        vanished keys, puts for everything current — put is idempotent for
+        routing-table consumers)."""
+        snapshot, revision = await self._range(prefix)
+        for key in sorted(live_keys - set(snapshot)):
+            watch._emit(KvEvent("delete", key))
+        for key in sorted(snapshot):
+            watch._emit(KvEvent("put", key, snapshot[key]))
+        return revision + 1, set(snapshot)
+
+    async def _watch_stream(self, prefix: str, start_revision: int,
+                            live_keys: set[str], watch: Watch) -> None:
+        """One long-lived streaming watch; reconnects with backoff from the
+        last DELIVERED revision on transport errors, and falls back to a
+        snapshot resync when etcd cancels the watch (compaction past the
+        resume point). The etcd.rs client recovers the same two ways."""
+        assert self._session is not None
+        revision = start_revision
+        backoff = 0.2
+        attempt = 0
+        while not watch._cancelled:
+            body = {"create_request": {
+                "key": _b64(prefix),
+                "range_end": _prefix_range_end(prefix),
+                "start_revision": str(revision),
+            }}
+            # Rotate endpoints across reconnects so a dead first node
+            # doesn't blind every watcher while unary calls fail over fine.
+            endpoint = self._endpoints[attempt % len(self._endpoints)]
+            attempt += 1
+            resp = None
+            healthy = False
+            need_resync = False
+
+            def handle(msg: dict) -> bool:
+                """Process one WatchResponse; returns True when the stream
+                must stop for a resync (compaction cancel)."""
+                nonlocal revision, healthy, backoff
+                result = msg.get("result", msg)
+                if result.get("created"):
+                    healthy = True
+                    backoff = 0.2
+                if result.get("canceled"):
+                    # Compaction past our resume revision: events in the
+                    # gap are unrecoverable from the stream.
+                    return True
+                for ev in result.get("events", []) or []:
+                    kv = ev.get("kv", {})
+                    key = _unb64(kv.get("key", ""))
+                    # Resume strictly from what was DELIVERED: the response
+                    # header's revision can run ahead of the batched events
+                    # and would skip the remainder on reconnect.
+                    mod = int(kv.get("mod_revision", 0) or 0)
+                    if mod:
+                        revision = max(revision, mod + 1)
+                    if ev.get("type") == "DELETE":
+                        live_keys.discard(key)
+                        watch._emit(KvEvent("delete", key))
+                    else:
+                        try:
+                            value = json.loads(_unb64(kv.get("value", "")))
+                        except ValueError:
+                            value = None
+                        live_keys.add(key)
+                        watch._emit(KvEvent("put", key, value))
+                    healthy = True
+                    backoff = 0.2
+                return False
+
+            try:
+                resp = await self._session.post(
+                    endpoint + "/v3/watch", json=body)
+                if resp.status != 200:
+                    raise RuntimeError(f"watch -> HTTP {resp.status}")
+                # Manual line framing: aiohttp's readline caps a line at
+                # ~64KB and raises, but one catch-up WatchResponse can
+                # batch many model-card-sized values into a single line.
+                buf = b""
+                while not need_resync:
+                    chunk = await resp.content.readany()
+                    if watch._cancelled:
+                        return
+                    if not chunk:
+                        break
+                    buf += chunk
+                    lines = buf.split(b"\n")
+                    buf = lines.pop()
+                    for line in lines:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            msg = json.loads(line)
+                        except ValueError:
+                            continue
+                        if handle(msg):
+                            need_resync = True
+                            break
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:  # noqa: BLE001 — reconnect loop
+                if watch._cancelled:
+                    return
+                log.warning("etcd watch stream error (%s); reconnecting "
+                            "from revision %d", exc, revision)
+            finally:
+                if resp is not None:
+                    # Hard-close: release() would try to drain the
+                    # never-ending watch stream and hang shutdown.
+                    resp.close()
+            if watch._cancelled:
+                return
+            if need_resync:
+                try:
+                    revision, live_keys = await self._resync(
+                        prefix, live_keys, watch)
+                    healthy = True
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("etcd watch resync failed: %s", exc)
+            if not healthy:
+                # A stream that ended without delivering anything (404 body,
+                # gateway error page, instant EOF) must not spin.
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)

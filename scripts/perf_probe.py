@@ -1,0 +1,201 @@
+"""Decode-step timing breakdown on the attached chip.
+
+Times isolated jitted pieces of the decode step (bench.py shapes) so the
+~X ms/step gap to the HBM roofline can be attributed:
+
+  full      decode_multi block (what bench.py measures), per step
+  noattn    forward minus attention (weights stream + sampler + scatter)
+  attn      28x paged_attention_decode_xla alone
+  gather    the raw KV page gather alone (no math)
+  lmhead    final norm + logits matmul alone
+  sampler   sample() alone
+  scatter   write_kv_stack alone
+
+Run:  python scripts/perf_probe.py [batch] [width_pages]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.engine import ModelRunner, RunnerConfig
+from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.models import get_config
+from dynamo_tpu.models.transformer import (
+    forward_decode,
+    paged_attention_decode_xla,
+    rms_norm,
+    write_kv_stack,
+)
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+MODEL = "qwen3-0.6b"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32  # pages per seq
+PAGE_SIZE = 16
+NUM_PAGES = max(1024, BATCH * WIDTH + 8)
+
+
+def timeit(fn, *args, n=20, k_steps=1):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n / k_steps
+    return dt * 1e3  # ms
+
+
+def main():
+    cfg = get_config(MODEL)
+    mesh = make_mesh(MeshConfig())
+    runner = ModelRunner(
+        cfg,
+        RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
+                     max_batch=BATCH, max_pages_per_seq=WIDTH,
+                     prefill_buckets=(256,)),
+        mesh, seed=0,
+    )
+    params, kv = runner.params, runner.kv_cache
+    rng = np.random.default_rng(0)
+    tables = np.zeros((BATCH, WIDTH), np.int32)
+    nxt = 1
+    for b in range(BATCH):
+        tables[b] = np.arange(nxt, nxt + WIDTH)
+        nxt += WIDTH
+    tables_j = jnp.asarray(tables)
+    kv_lens = jnp.full((BATCH,), WIDTH * PAGE_SIZE - 8, jnp.int32)
+    tokens = jnp.zeros((BATCH,), jnp.int32)
+    positions = kv_lens - 1
+    active = jnp.ones((BATCH,), bool)
+    temp = jnp.zeros((BATCH,), jnp.float32)
+    top_p = jnp.ones((BATCH,), jnp.float32)
+    top_k = jnp.zeros((BATCH,), jnp.int32)
+    seeds = jnp.zeros((BATCH,), jnp.uint32)
+    steps = jnp.zeros((BATCH,), jnp.int32)
+
+    results = {}
+
+    # full fused block of K steps (bench path)
+    K = 16
+    fn = runner._build_decode_multi(K)
+    full = lambda kv: fn(params, kv, tokens, positions, tables_j, kv_lens,
+                         active, temp, top_p, top_k, seeds, steps)[0]
+    # kv donated: re-feed output
+    out = full(kv)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    N = 8
+    for _ in range(N):
+        out = full(out)
+    jax.block_until_ready(out)
+    results["full"] = (time.perf_counter() - t0) / N / K * 1e3
+    kv = out
+
+    # single-step decode fn without sampling vs with
+    @jax.jit
+    def fwd_only(kv, tokens):
+        kv2, logits = forward_decode(params, cfg, tokens, positions, kv,
+                                     tables_j, kv_lens, active)
+        return logits.sum()
+
+    results["fwd_1step"] = timeit(fwd_only, kv, tokens)
+
+    # attention alone: loop over layers on a fixed q
+    q = jnp.zeros((BATCH, 1, cfg.n_q_heads, cfg.head_dim), jnp.bfloat16)
+    kc = jnp.zeros((BATCH, 1, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
+
+    @jax.jit
+    def attn_all(kv, q):
+        acc = jnp.zeros((), jnp.float32)
+        for layer in range(cfg.n_layers):
+            o = paged_attention_decode_xla(q, kv, layer, tables_j, kv_lens,
+                                           kc, kc)
+            acc += o.astype(jnp.float32).sum()
+        return acc
+
+    results["attn_28L"] = timeit(attn_all, kv, q)
+
+    # raw gather alone
+    @jax.jit
+    def gather_all(kv):
+        acc = jnp.zeros((), jnp.float32)
+        for layer in range(cfg.n_layers):
+            kp = kv[layer, 0][tables_j]
+            vp = kv[layer, 1][tables_j]
+            acc += kp.astype(jnp.float32).sum() + vp.astype(jnp.float32).sum()
+        return acc
+
+    results["gather_28L"] = timeit(gather_all, kv)
+
+    # gather the whole cache contiguously (streaming read bound)
+    @jax.jit
+    def stream_all(kv):
+        return kv.astype(jnp.float32).sum()
+
+    results["stream_pool"] = timeit(stream_all, kv)
+
+    # lm head
+    x = jnp.zeros((BATCH, 1, cfg.hidden), jnp.bfloat16)
+
+    @jax.jit
+    def lmhead(x):
+        h = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        head = params["embed"].T
+        return jnp.einsum("bth,hv->btv", h, head).astype(jnp.float32).sum()
+
+    results["lmhead"] = timeit(lmhead, x)
+
+    # sampler
+    logits = jnp.zeros((BATCH, cfg.vocab_size), jnp.float32)
+
+    @jax.jit
+    def samp(logits):
+        return sample(logits, temp, top_p, top_k, seeds, steps)
+
+    results["sampler"] = timeit(samp, logits)
+
+    # scatter (write_kv_stack)
+    ks = jnp.zeros((cfg.n_layers, BATCH, 1, cfg.n_kv_heads, cfg.head_dim),
+                   jnp.bfloat16)
+
+    @jax.jit
+    def scat(kv):
+        return write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
+                              active[:, None])[0, 0, 0, 0, 0, 0]
+
+    # donation-free sum to avoid copying pool: time with .at returning new
+    scat2 = jax.jit(
+        lambda kv: write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
+                                  active[:, None]),
+        donate_argnums=(0,))
+    out = scat2(kv)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        out = scat2(out)
+    jax.block_until_ready(out)
+    results["scatter_donated"] = (time.perf_counter() - t0) / 20 * 1e3
+
+    dev = jax.devices()[0]
+    print(f"device={dev.device_kind} batch={BATCH} width={WIDTH}pages "
+          f"ctx={WIDTH*PAGE_SIZE}")
+    wbytes = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(params))
+    print(f"param bytes: {wbytes/1e9:.3f} GB -> roofline "
+          f"{wbytes/819e9*1e3:.2f} ms/step (weights only)")
+    for k, v in results.items():
+        print(f"{k:16s} {v:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
