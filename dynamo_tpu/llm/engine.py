@@ -60,24 +60,57 @@ class RouterEngine(TokenEngine):
             yield EngineOutput.from_wire(item)
 
 
+def _priority_of(request: PreprocessedRequest) -> float:
+    """Optional per-request priority bump for the admission queue (the
+    reference's priority_jump, carried as an annotation here)."""
+    try:
+        return float((request.annotations or {}).get("priority", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
 class KvRouterEngine(TokenEngine):
     """KV-aware dispatch: block-hash the prompt, score candidates by cached
     overlap + load, route direct, and track the request lifecycle
     (ref: lib/llm/src/kv_router.rs KvRouter + push_router.rs KvPushRouter;
-    flow in section 3.3)."""
+    flow in section 3.3). When the admission queue is enabled
+    (DYNT_ROUTER_QUEUE_THRESHOLD >= 0), saturation parks requests in
+    fcfs/lcfs/wspt order instead of routing immediately
+    (ref: lib/kv-router/src/scheduling/queue.rs)."""
 
     def __init__(self, router: PushRouter, scheduler: KvScheduler,
-                 lora_instances=None) -> None:
+                 lora_instances=None, queue=None) -> None:
+        from ..kv_router.queue import SchedulerQueue
+        from ..runtime.config import env
+
         self.router = router
         self.scheduler = scheduler
         self._lora_instances = lora_instances
+        if queue is None:
+            threshold = env("DYNT_ROUTER_QUEUE_THRESHOLD")
+            budget = env("DYNT_MAX_BATCHED_TOKENS")
+            queue = SchedulerQueue(
+                scheduler,
+                threshold_frac=threshold if threshold >= 0 else None,
+                policy=env("DYNT_ROUTER_QUEUE_POLICY"),
+                max_batched_tokens=(
+                    (lambda w: budget) if budget > 0 else None),
+            )
+        self.queue = queue
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
+        from ..kv_router.queue import QueuedRequest
+
         await self.router.client.start()
         avail = self.router.available()
+        pinned = False
         if request.lora_name and self._lora_instances is not None:
             has = self._lora_instances(request.lora_name)
             avail = [i for i in avail if i in has]
+            # Adapter-constrained requests bypass the admission gate, like
+            # the reference's allowed_worker_ids escape hatch (queue.rs
+            # enqueue).
+            pinned = True
         if not avail:
             raise NoInstancesAvailable(self.router.client.endpoint.subject)
         block_hashes = compute_block_hashes(
@@ -85,11 +118,17 @@ class KvRouterEngine(TokenEngine):
             lora_id=request.kv_salt(),
         )
         candidates = [WorkerWithDpRank(iid) for iid in avail]
-        result = self.scheduler.select_worker(
-            candidates, block_hashes, len(request.token_ids)
-        )
         request_id = request.request_id
-        self.scheduler.add_request(request_id, result, len(request.token_ids))
+        # schedule() books the request into the slot tracker (add_request)
+        # as part of the decision, so a drained backlog can't dogpile.
+        result = await self.queue.schedule(QueuedRequest(
+            candidates=candidates,
+            block_hashes=block_hashes,
+            isl_tokens=len(request.token_ids),
+            priority_jump=_priority_of(request),
+            pinned=pinned,
+            request_id=request_id,
+        ))
         first = True
         try:
             async for item in self.router.generate(
@@ -97,10 +136,12 @@ class KvRouterEngine(TokenEngine):
             ):
                 if first:
                     self.scheduler.mark_prefill_completed(request_id)
+                    self.queue.update()
                     first = False
                 yield EngineOutput.from_wire(item)
         finally:
             self.scheduler.free(request_id)
+            self.queue.update()
 
 
 class MultimodalEngine(TokenEngine):

@@ -133,6 +133,18 @@ _register("DYNT_ROUTER_TEMPERATURE", 0.0, _float,
 _register("DYNT_BUSY_THRESHOLD", 0.95, _float,
           "KV-load busy threshold for 503 load shedding "
           "(ref: http/service/busy_threshold.rs)")
+_register("DYNT_ROUTER_QUEUE_POLICY", "fcfs", _str,
+          "Router admission-queue ordering: fcfs | lcfs | wspt "
+          "(ref: kv-router scheduling/policy.rs)")
+_register("DYNT_ROUTER_QUEUE_THRESHOLD", -1.0, _float,
+          "Park requests when every worker exceeds this fraction of its "
+          "token budget; negative disables queueing "
+          "(ref: kv-router scheduling/queue.rs threshold_frac)")
+_register("DYNT_MAX_BATCHED_TOKENS", 0, _int,
+          "Per-worker token budget for the router admission gate. 0 leaves "
+          "the gate effectively unlimited (DEFAULT_MAX_BATCHED_TOKENS) — "
+          "set a real budget for queueing to engage "
+          "(ref: queue.rs DEFAULT_MAX_BATCHED_TOKENS)")
 
 # Fault tolerance
 _register("DYNT_MIGRATION_LIMIT", 3, _int,

@@ -89,9 +89,12 @@ class EtcdDiscovery(Discovery):
         )
 
     async def close(self) -> None:
-        for task in self._watch_tasks:
+        # Snapshot: each task's done-callback removes it from the live list
+        # mid-iteration otherwise, skipping (and never awaiting) neighbors.
+        tasks = list(self._watch_tasks)
+        for task in tasks:
             task.cancel()
-        for task in self._watch_tasks:
+        for task in tasks:
             try:
                 await task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
@@ -264,9 +267,10 @@ class EtcdDiscovery(Discovery):
                 must stop for a resync (compaction cancel)."""
                 nonlocal revision, healthy, backoff
                 result = msg.get("result", msg)
-                if result.get("created"):
-                    healthy = True
-                    backoff = 0.2
+                # NOTE: "created" alone is NOT health — a proxy that ACKs
+                # the watch then closes would otherwise defeat the backoff
+                # and produce a full-speed reconnect storm. Only delivered
+                # events reset it.
                 if result.get("canceled"):
                     # Compaction past our resume revision: events in the
                     # gap are unrecoverable from the stream.
