@@ -651,14 +651,22 @@ class ModelRunner:
 
         return gather_to_host(self.kv_cache, np.asarray(page_ids, np.int32))
 
-    def scatter_pages(self, page_ids: np.ndarray, blocks: np.ndarray) -> None:
-        """Write host block bundle into pool pages (disagg decode onboard /
-        KVBM onboard). Scheduler thread only (donation)."""
-        from ..ops.block_copy import scatter_from_host
+    def scatter_pages(self, page_ids: np.ndarray, blocks) -> None:
+        """Write a block bundle into pool pages (disagg decode onboard /
+        KVBM onboard). Scheduler thread only (donation). `blocks` is either
+        a host numpy bundle (DCN host-relay / KVBM tiers) or a jax.Array
+        already resharded onto this runner's mesh by the ICI bridge — the
+        device path skips the H2D copy entirely."""
+        from ..ops.block_copy import scatter_from_host, scatter_kv_blocks
 
-        self.kv_cache = scatter_from_host(
-            self.kv_cache, np.asarray(page_ids, np.int32), blocks
-        )
+        if isinstance(blocks, jax.Array):
+            self.kv_cache = scatter_kv_blocks(
+                self.kv_cache, jnp.asarray(page_ids, jnp.int32), blocks
+            )
+        else:
+            self.kv_cache = scatter_from_host(
+                self.kv_cache, np.asarray(page_ids, np.int32), blocks
+            )
 
     def kv_layout(self) -> dict:
         """Wire-layout descriptor of this runner's paged pool. Geometry comes

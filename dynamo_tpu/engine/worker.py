@@ -127,12 +127,18 @@ class TpuWorker:
         lora_adapters: Optional[dict[str, str]] = None,  # name -> npz path
         weight_service: Optional[str] = None,  # unix socket (GMS analog)
         weights_from_peer: bool = False,  # ModelExpress analog
+        mesh=None,  # pre-built sub-mesh (co-meshed disagg split_mesh)
+        ici_bridge=None,  # engine.ici_transfer.IciKvBridge, shared in-proc
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
         self.model_config = get_config(model_name)
         self.runner_config = runner_config or RunnerConfig()
-        self.mesh = make_mesh(mesh_config or MeshConfig())
+        self.mesh = mesh if mesh is not None else make_mesh(
+            mesh_config or MeshConfig())
+        self.ici_bridge = ici_bridge
+        if ici_bridge is not None and mode == "prefill":
+            ici_bridge.attach_prefill(self)
         self._warmup = warmup
         self.mode = mode
         self.transfers = PendingTransferTable()
@@ -531,7 +537,7 @@ class TpuWorker:
             layout=layout,
             prompt_len=seq.prompt_len,
         ))
-        return {
+        params = {
             "transfer_id": transfer_id,
             "namespace": self.card.namespace,
             "component": self.card.component,
@@ -539,6 +545,11 @@ class TpuWorker:
             "layout": layout.to_wire(),
             "prompt_len": seq.prompt_len,
         }
+        if self.ici_bridge is not None:
+            # Decode workers in THIS process (co-meshed pools) pull over
+            # ICI through the bridge; remote ones fall back to the wire.
+            params["bridge_token"] = self.ici_bridge.token
+        return params
 
     async def _kv_pull(self, body: dict, ctx=None) -> AsyncIterator[dict]:
         """Decode workers pull parked prefill KV here: gather the pages on
@@ -585,6 +596,13 @@ class TpuWorker:
 
         if params.get("mock") or "layout" not in params:
             return None  # mocker handoff carries no data; recompute
+        if (self.ici_bridge is not None
+                and params.get("bridge_token") == self.ici_bridge.token):
+            # Same process, co-meshed pools: direct chip-to-chip pull over
+            # ICI (device bundle, no host relay). Any failure degrades to
+            # the recompute fallback like the wire path.
+            return await self.ici_bridge.pull(params["transfer_id"],
+                                              self.runner)
         remote_layout = KvLayoutDescriptor.from_wire(params["layout"])
         local_layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
         if not remote_layout.compatible(local_layout):
@@ -822,9 +840,16 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--mode", default="aggregated",
-                        choices=["aggregated", "prefill", "decode"],
+                        choices=["aggregated", "prefill", "decode", "comesh"],
                         help="disaggregated role (prefill workers register "
-                             "ModelType prefill under their own component)")
+                             "ModelType prefill under their own component); "
+                             "comesh runs a prefill pool AND a decode pool "
+                             "on disjoint sub-meshes of the local chips "
+                             "with direct ICI KV handoff")
+    parser.add_argument("--prefill-devices", type=int, default=1,
+                        help="comesh: chips for the prefill sub-mesh")
+    parser.add_argument("--decode-devices", type=int, default=1,
+                        help="comesh: chips for the decode sub-mesh")
     parser.add_argument("--kvbm-host-blocks", type=int, default=0,
                         help="G2 host-RAM KV tier size in blocks (0=off)")
     parser.add_argument("--kvbm-disk-blocks", type=int, default=0,
@@ -877,6 +902,68 @@ async def main(argv: Optional[list[str]] = None) -> None:
     runtime = None
     if not snapshot.enabled:
         runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+
+    if args.mode == "comesh":
+        # Co-meshed disagg: one process, prefill + decode pools on disjoint
+        # sub-meshes, KV handoff over ICI (engine/ici_transfer.py). The
+        # frontend orchestrates exactly as with remote disagg — the bridge
+        # token in kv_transfer_params selects the fast path.
+        from ..runtime import HealthCheckManager
+        from .ici_transfer import IciKvBridge, split_mesh
+
+        if snapshot.enabled:
+            raise SystemExit(
+                "--mode comesh does not support snapshot-gated startup "
+                "(two engines, one dump point); unset DYNT_SNAPSHOT_MODE")
+        # --tp > 1 sets in-pool tensor parallelism for BOTH pools; the
+        # default is full-tp within each pool's devices. --dp has no
+        # meaning here (the pools ARE the device split).
+        if args.dp != 1:
+            raise SystemExit("--dp is not meaningful with --mode comesh; "
+                             "size the pools with --prefill-devices/"
+                             "--decode-devices")
+        pre_mesh, dec_mesh = split_mesh(
+            args.prefill_devices, args.decode_devices,
+            prefill_tp=args.tp if args.tp > 1 else None,
+            decode_tp=args.tp if args.tp > 1 else None)
+        bridge = IciKvBridge()
+        rc = RunnerConfig(
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_batch=args.max_batch,
+            max_pages_per_seq=args.max_pages_per_seq,
+            max_loras=args.max_loras, lora_rank=args.lora_rank,
+        )
+        common = dict(
+            model_name=args.model, served_name=args.served_model_name,
+            namespace=args.namespace, runner_config=rc,
+            tool_parser=args.tool_call_parser,
+            reasoning_parser=args.reasoning_parser,
+            lora_adapters=dict(s.split("=", 1) for s in args.lora),
+            weight_service=(args.weight_service
+                            or _env("DYNT_WEIGHT_SERVICE") or None),
+            weights_from_peer=args.weights_from_peer,
+            ici_bridge=bridge,
+        )
+        prefill_worker = TpuWorker(runtime, mode="prefill",
+                                   component="prefill", mesh=pre_mesh,
+                                   **common)
+        decode_worker = TpuWorker(runtime, mode="decode",
+                                  component=args.component, mesh=dec_mesh,
+                                  kvbm_config=kvbm_config, **common)
+        await prefill_worker.start()
+        await decode_worker.start()
+        health = HealthCheckManager(
+            runtime, canary_wait_time=_env("DYNT_CANARY_WAIT_SECS"))
+        health.start()
+        try:
+            await wait_for_shutdown_signal()
+        finally:
+            await health.close()
+            await decode_worker.close()
+            await prefill_worker.close()
+            await runtime.shutdown()
+        return
+
     worker = TpuWorker(
         runtime,
         model_name=args.model,

@@ -168,16 +168,29 @@ class SchedulerQueue:
             req.overlaps = self.scheduler.indexer.find_matches(
                 list(req.block_hashes))
         threshold = self.threshold_frac
-        if threshold is None or req.pinned or not self._all_busy(
-                req.candidates, threshold):
+        # A non-empty backlog gates new arrivals too (ref: queue.rs
+        # enqueue): letting a fresh request grab freed capacity ahead of
+        # parked ones would invert fcfs/priority exactly under the load the
+        # queue exists for.
+        if threshold is None or req.pinned or (
+                not self._heap
+                and not self._all_busy(req.candidates, threshold)):
             return self._select(req)
         arrival = time.monotonic() - self._start
         key = self._key_fn(arrival, req, self.scheduler.config.block_size)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         heapq.heappush(self._heap, (-key, next(self._seq), req, future))
-        log.debug("all workers busy; parked request (pending=%d)",
-                  len(self._heap))
+        log.debug("workers busy or backlog pending; parked request "
+                  "(pending=%d)", len(self._heap))
         self._ensure_ticker()
+        # Drain immediately: if capacity exists (we parked only to keep
+        # ordering), the highest-priority entry — possibly this one —
+        # schedules now.
+        self.update()
+        # Yield once: if update() resolved earlier-parked futures AND ours,
+        # their tasks were scheduled first and must resume (dispatch) first
+        # — awaiting an already-done future does not suspend.
+        await asyncio.sleep(0)
         try:
             return await future
         except asyncio.CancelledError:
@@ -228,6 +241,12 @@ class SchedulerQueue:
                 return
             heapq.heappop(self._heap)
             try:
+                # Re-score overlaps at DRAIN time: KV events kept flowing
+                # while the request was parked, and routing on the arrival
+                # snapshot could chase evicted prefixes. (Policy keys stay
+                # frozen at park time — ordering already happened.)
+                req.overlaps = self.scheduler.indexer.find_matches(
+                    list(req.block_hashes))
                 # _select books the load (add_request) before returning, so
                 # the next iteration's busy check sees it.
                 result = self._select(req)

@@ -30,6 +30,7 @@ import asyncio
 import base64
 import json
 import math
+import time
 from typing import Optional
 
 from .discovery import Discovery, KvEvent, Lease, LeaseExpired, Watch
@@ -298,11 +299,13 @@ class EtcdDiscovery(Discovery):
                     backoff = 0.2
                 return False
 
+            stream_started = time.monotonic()
             try:
                 resp = await self._session.post(
                     endpoint + "/v3/watch", json=body)
                 if resp.status != 200:
                     raise RuntimeError(f"watch -> HTTP {resp.status}")
+                stream_started = time.monotonic()
                 # Manual line framing: aiohttp's readline caps a line at
                 # ~64KB and raises, but one catch-up WatchResponse can
                 # batch many model-card-sized values into a single line.
@@ -348,6 +351,12 @@ class EtcdDiscovery(Discovery):
                     healthy = True
                 except Exception as exc:  # noqa: BLE001
                     log.warning("etcd watch resync failed: %s", exc)
+            # A stream that SURVIVED a while counts as healthy even with
+            # zero events (quiet prefix behind an idle-timeout LB): only
+            # quick ACK-then-EOF cycles should keep escalating the backoff.
+            if time.monotonic() - stream_started > 5.0:
+                healthy = True
+                backoff = 0.2
             if not healthy:
                 # A stream that ended without delivering anything (404 body,
                 # gateway error page, instant EOF) must not spin.

@@ -1,0 +1,193 @@
+"""Component-level decode timing on the attached chip (see perf_probe.py
+for the RTT discipline). Each probe compiles + runs in sequence and prints
+immediately; a tunnel failure kills at most the current probe.
+
+Run: python scripts/perf_components.py [batch] [width_pages] [probe ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.models import get_config, init_params, make_kv_cache
+from dynamo_tpu.models.transformer import (
+    forward_decode,
+    paged_attention_decode_xla,
+    rms_norm,
+    write_kv_stack,
+)
+
+MODEL = "qwen3-0.6b"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+ONLY = set(sys.argv[3:])
+PAGE_SIZE = 16
+NUM_PAGES = max(1024, BATCH * WIDTH + 8)
+
+cfg = get_config(MODEL)
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+kv = jax.jit(lambda: make_kv_cache(cfg, NUM_PAGES, PAGE_SIZE))()
+
+tables = np.zeros((BATCH, WIDTH), np.int32)
+nxt = 1
+for b in range(BATCH):
+    tables[b] = np.arange(nxt, nxt + WIDTH)
+    nxt += WIDTH
+tables_j = jnp.asarray(tables)
+kv_lens = jnp.full((BATCH,), WIDTH * PAGE_SIZE - 8, jnp.int32)
+tokens = jnp.zeros((BATCH,), jnp.int32)
+positions = kv_lens - 1
+active = jnp.ones((BATCH,), bool)
+temp = jnp.zeros((BATCH,), jnp.float32)
+top_p = jnp.ones((BATCH,), jnp.float32)
+top_k = jnp.zeros((BATCH,), jnp.int32)
+seeds = jnp.zeros((BATCH,), jnp.uint32)
+steps = jnp.zeros((BATCH,), jnp.int32)
+
+
+def measure_rtt() -> float:
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((), jnp.float32)
+    float(tiny(x))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        float(tiny(x))
+    return (time.perf_counter() - t0) / 20 * 1e3
+
+
+RTT = measure_rtt()
+print(f"RTT {RTT:.1f} ms", flush=True)
+
+
+def timeit(name, fn, *args, n=10):
+    if ONLY and name not in ONLY:
+        return
+    try:
+        np.asarray(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            np.asarray(fn(*args))
+        dt = max((time.perf_counter() - t0) / n * 1e3 - RTT, 0.0)
+        print(f"{name:16s} {dt:8.3f} ms", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"{name:16s} FAILED {exc!r}", flush=True)
+
+
+q = jnp.zeros((BATCH, 1, cfg.n_q_heads, cfg.head_dim), jnp.bfloat16)
+kc = jnp.zeros((BATCH, 1, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
+
+
+@jax.jit
+def fwd_only(kv, tokens):
+    _, logits = forward_decode(params, cfg, tokens, positions, kv,
+                               tables_j, kv_lens, active)
+    return logits.sum()
+
+
+@jax.jit
+def attn_all(kv, q):
+    acc = jnp.zeros((), jnp.float32)
+    for layer in range(cfg.n_layers):
+        o = paged_attention_decode_xla(q, kv, layer, tables_j, kv_lens,
+                                       kc, kc)
+        acc += o.astype(jnp.float32).sum()
+    return acc
+
+
+@jax.jit
+def gather_all(kv):
+    acc = jnp.zeros((), jnp.float32)
+    for layer in range(cfg.n_layers):
+        acc += kv[layer, 0][tables_j].astype(jnp.float32).sum()
+        acc += kv[layer, 1][tables_j].astype(jnp.float32).sum()
+    return acc
+
+
+@jax.jit
+def stream_all(kv):
+    return kv.astype(jnp.float32).sum()
+
+
+x1 = jnp.zeros((BATCH, 1, cfg.hidden), jnp.bfloat16)
+
+
+@jax.jit
+def lmhead(x):
+    h = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return jnp.einsum("bth,hv->btv", h,
+                      params["embed"].T).astype(jnp.float32).sum()
+
+
+logits0 = jnp.zeros((BATCH, cfg.vocab_size), jnp.float32)
+
+
+@jax.jit
+def samp(logits):
+    return sample(logits, temp, top_p, top_k, seeds, steps).sum()
+
+
+@jax.jit
+def mlp_stack(x):
+    # all layers' matmuls minus attention: the pure weight-stream cost
+    acc = jnp.zeros((), jnp.float32)
+    h = x
+    for lp in params["layers"]:
+        a = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        qh = jnp.einsum("bth,hqd->btqd", a, lp["wq"])
+        kh2 = jnp.einsum("bth,hkd->btkd", a, lp["wk"])
+        vh = jnp.einsum("bth,hkd->btkd", a, lp["wv"])
+        o = jnp.einsum("btqd,qdh->bth", qh, lp["wo"])
+        m = rms_norm(h + o, lp["mlp_norm"], cfg.rms_eps)
+        g = jnp.einsum("bth,hm->btm", m, lp["w_gate"])
+        u = jnp.einsum("bth,hm->btm", m, lp["w_up"])
+        d = jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+        h = h + d
+        acc += kh2.astype(jnp.float32).sum() + vh.astype(jnp.float32).sum()
+    return acc + h.astype(jnp.float32).sum()
+
+
+timeit("fwd_1step", fwd_only, kv, tokens)
+timeit("attn_28L", attn_all, kv, q)
+timeit("gather_28L", gather_all, kv)
+timeit("stream_pool", stream_all, kv)
+timeit("mlp_stack", mlp_stack, x1)
+timeit("lmhead", lmhead, x1)
+timeit("sampler", samp, logits0)
+
+state = {"kv": kv}
+ks = jnp.zeros((cfg.n_layers, BATCH, 1, cfg.n_kv_heads, cfg.head_dim),
+               jnp.bfloat16)
+scat = jax.jit(
+    lambda kv: write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
+                              active[:, None]),
+    donate_argnums=(0,))
+if not ONLY or "scatter" in ONLY:
+    try:
+        def scat_call():
+            out = scat(state["kv"])
+            state["kv"] = out
+            np.asarray(out[0, 0, 0, 0, 0, 0])
+
+        scat_call()
+        t0 = time.perf_counter()
+        for _ in range(10):
+            scat_call()
+        dt = max((time.perf_counter() - t0) / 10 * 1e3 - RTT, 0.0)
+        print(f"{'scatter':16s} {dt:8.3f} ms", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"scatter FAILED {exc!r}", flush=True)
+
+wbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+print(f"params {wbytes/1e9:.3f} GB -> {wbytes/819e9*1e3:.2f} ms weight "
+      f"stream floor; pool {kv.size*2/1e9:.2f} GB", flush=True)

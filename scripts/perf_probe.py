@@ -1,15 +1,10 @@
 """Decode-step timing breakdown on the attached chip.
 
-Times isolated jitted pieces of the decode step (bench.py shapes) so the
-~X ms/step gap to the HBM roofline can be attributed:
-
-  full      decode_multi block (what bench.py measures), per step
-  noattn    forward minus attention (weights stream + sampler + scatter)
-  attn      28x paged_attention_decode_xla alone
-  gather    the raw KV page gather alone (no math)
-  lmhead    final norm + logits matmul alone
-  sampler   sample() alone
-  scatter   write_kv_stack alone
+The chip is tunnel-attached: `jax.block_until_ready` does NOT synchronize
+(returns immediately) and every host readback costs ~50-100ms RTT. So every
+measurement here (a) forces a small host readback per call and (b) subtracts
+the measured RTT; per-step decode additionally uses paired scan lengths
+(K=16 vs K=128) so the per-step slope is RTT-free.
 
 Run:  python scripts/perf_probe.py [batch] [width_pages]
 """
@@ -18,7 +13,6 @@ from __future__ import annotations
 
 import sys
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -43,19 +37,36 @@ WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32  # pages per seq
 PAGE_SIZE = 16
 NUM_PAGES = max(1024, BATCH * WIDTH + 8)
 
+RTT_MS = 0.0
 
-def timeit(fn, *args, n=20, k_steps=1):
-    out = fn(*args)
-    jax.block_until_ready(out)
+
+def measure_rtt() -> float:
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((), jnp.float32)
+    float(tiny(x))
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        float(tiny(x))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def timeit(fn, *args, n=10):
+    """fn must return a SCALAR (or tiny) array; we read it back per call to
+    force synchronization, then subtract the tunnel RTT."""
+    np.asarray(fn(*args))  # warmup/compile
     t0 = time.perf_counter()
     for _ in range(n):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n / k_steps
-    return dt * 1e3  # ms
+        np.asarray(fn(*args))
+    dt = (time.perf_counter() - t0) / n * 1e3
+    return max(dt - RTT_MS, 0.0)
 
 
 def main():
+    global RTT_MS
     cfg = get_config(MODEL)
     mesh = make_mesh(MeshConfig())
     runner = ModelRunner(
@@ -66,7 +77,6 @@ def main():
         mesh, seed=0,
     )
     params, kv = runner.params, runner.kv_cache
-    rng = np.random.default_rng(0)
     tables = np.zeros((BATCH, WIDTH), np.int32)
     nxt = 1
     for b in range(BATCH):
@@ -83,34 +93,51 @@ def main():
     seeds = jnp.zeros((BATCH,), jnp.uint32)
     steps = jnp.zeros((BATCH,), jnp.int32)
 
+    RTT_MS = measure_rtt()
+    print(f"tunnel RTT {RTT_MS:.1f} ms (subtracted from all numbers)",
+          flush=True)
+
+    # -- decode per-step via paired scan lengths (RTT-free slope) ----------
+    def block_time(k, n=6):
+        fn = runner._build_decode_multi(k)
+        state = {"kv": runner.kv_cache}
+
+        def call():
+            out_kv, toks = fn(params, state["kv"], tokens, positions,
+                              tables_j, kv_lens, active, temp, top_p,
+                              top_k, seeds, steps)
+            state["kv"] = out_kv
+            np.asarray(toks)
+
+        call()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            call()
+        runner.kv_cache = state["kv"]
+        return (time.perf_counter() - t0) / n * 1e3
+
+    t16 = block_time(16)
+    print(f"decode_multi k=16 block: {t16:.1f} ms "
+          f"({(t16 - RTT_MS) / 16:.2f} ms/step naive)", flush=True)
+    t128 = block_time(128)
+    per_step = (t128 - t16) / 112
+    print(f"decode_multi k=128 block: {t128:.1f} ms -> per-step slope "
+          f"{per_step:.3f} ms", flush=True)
+
+    kv = runner.kv_cache
     results = {}
 
-    # full fused block of K steps (bench path)
-    K = 16
-    fn = runner._build_decode_multi(K)
-    full = lambda kv: fn(params, kv, tokens, positions, tables_j, kv_lens,
-                         active, temp, top_p, top_k, seeds, steps)[0]
-    # kv donated: re-feed output
-    out = full(kv)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    N = 8
-    for _ in range(N):
-        out = full(out)
-    jax.block_until_ready(out)
-    results["full"] = (time.perf_counter() - t0) / N / K * 1e3
-    kv = out
-
-    # single-step decode fn without sampling vs with
+    # single full decode step (forward only, no sampling)
     @jax.jit
     def fwd_only(kv, tokens):
-        kv2, logits = forward_decode(params, cfg, tokens, positions, kv,
-                                     tables_j, kv_lens, active)
+        _, logits = forward_decode(params, cfg, tokens, positions, kv,
+                                   tables_j, kv_lens, active)
         return logits.sum()
 
     results["fwd_1step"] = timeit(fwd_only, kv, tokens)
+    print(f"fwd_1step {results['fwd_1step']:.3f} ms", flush=True)
 
-    # attention alone: loop over layers on a fixed q
+    # attention alone over all layers
     q = jnp.zeros((BATCH, 1, cfg.n_q_heads, cfg.head_dim), jnp.bfloat16)
     kc = jnp.zeros((BATCH, 1, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
 
@@ -124,77 +151,80 @@ def main():
         return acc
 
     results["attn_28L"] = timeit(attn_all, kv, q)
+    print(f"attn_28L {results['attn_28L']:.3f} ms", flush=True)
 
-    # raw gather alone
+    # raw KV page gather alone
     @jax.jit
     def gather_all(kv):
         acc = jnp.zeros((), jnp.float32)
         for layer in range(cfg.n_layers):
-            kp = kv[layer, 0][tables_j]
-            vp = kv[layer, 1][tables_j]
-            acc += kp.astype(jnp.float32).sum() + vp.astype(jnp.float32).sum()
+            acc += kv[layer, 0][tables_j].astype(jnp.float32).sum()
+            acc += kv[layer, 1][tables_j].astype(jnp.float32).sum()
         return acc
 
     results["gather_28L"] = timeit(gather_all, kv)
+    print(f"gather_28L {results['gather_28L']:.3f} ms", flush=True)
 
-    # gather the whole cache contiguously (streaming read bound)
+    # stream the whole pool contiguously (bandwidth reference)
     @jax.jit
     def stream_all(kv):
         return kv.astype(jnp.float32).sum()
 
     results["stream_pool"] = timeit(stream_all, kv)
+    print(f"stream_pool {results['stream_pool']:.3f} ms "
+          f"(pool {kv.size * 2 / 1e9:.2f} GB)", flush=True)
 
-    # lm head
+    # lm head matmul
     x = jnp.zeros((BATCH, 1, cfg.hidden), jnp.bfloat16)
 
     @jax.jit
     def lmhead(x):
         h = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        head = params["embed"].T
-        return jnp.einsum("bth,hv->btv", h, head).astype(jnp.float32).sum()
+        return jnp.einsum("bth,hv->btv", h,
+                          params["embed"].T).astype(jnp.float32).sum()
 
     results["lmhead"] = timeit(lmhead, x)
+    print(f"lmhead {results['lmhead']:.3f} ms", flush=True)
 
     # sampler
     logits = jnp.zeros((BATCH, cfg.vocab_size), jnp.float32)
 
     @jax.jit
     def samp(logits):
-        return sample(logits, temp, top_p, top_k, seeds, steps)
+        return sample(logits, temp, top_p, top_k, seeds, steps).sum()
 
     results["sampler"] = timeit(samp, logits)
+    print(f"sampler {results['sampler']:.3f} ms", flush=True)
 
-    # scatter (write_kv_stack)
+    # deferred KV write (2 batched scatters)
     ks = jnp.zeros((cfg.n_layers, BATCH, 1, cfg.n_kv_heads, cfg.head_dim),
                    jnp.bfloat16)
 
-    @jax.jit
-    def scat(kv):
-        return write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
-                              active[:, None])[0, 0, 0, 0, 0, 0]
-
-    # donation-free sum to avoid copying pool: time with .at returning new
-    scat2 = jax.jit(
+    state = {"kv": kv}
+    scat = jax.jit(
         lambda kv: write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
                                   active[:, None]),
         donate_argnums=(0,))
-    out = scat2(kv)
-    jax.block_until_ready(out)
+
+    def scat_call():
+        out = scat(state["kv"])
+        state["kv"] = out
+        np.asarray(out[0, 0, 0, 0, 0, 0])
+
+    scat_call()
     t0 = time.perf_counter()
-    for _ in range(20):
-        out = scat2(out)
-    jax.block_until_ready(out)
-    results["scatter_donated"] = (time.perf_counter() - t0) / 20 * 1e3
+    for _ in range(10):
+        scat_call()
+    results["scatter"] = max((time.perf_counter() - t0) / 10 * 1e3 - RTT_MS,
+                             0.0)
+    print(f"scatter {results['scatter']:.3f} ms", flush=True)
 
     dev = jax.devices()[0]
     print(f"device={dev.device_kind} batch={BATCH} width={WIDTH}pages "
           f"ctx={WIDTH*PAGE_SIZE}")
-    wbytes = sum(x.size * x.dtype.itemsize
-                 for x in jax.tree.leaves(params))
+    wbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
     print(f"param bytes: {wbytes/1e9:.3f} GB -> roofline "
           f"{wbytes/819e9*1e3:.2f} ms/step (weights only)")
-    for k, v in results.items():
-        print(f"{k:16s} {v:8.3f} ms")
 
 
 if __name__ == "__main__":

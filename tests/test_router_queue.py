@@ -267,6 +267,33 @@ class TestSchedulerQueue:
 
         run(body())
 
+    def test_new_arrival_cannot_bypass_backlog(self, run):
+        """Freed capacity must go to the PARKED request, not a fresh
+        arrival that shows up before the drain (ref queue.rs: non-empty
+        queue gates new requests too)."""
+
+        async def body():
+            q = _queue(policy="fcfs", threshold=0.5, budget=100)
+            await q.schedule(_req(isl=96, rid="warm"))
+            order = []
+
+            async def one(rid):
+                await q.schedule(_req(isl=10, rid=rid))
+                order.append(rid)
+
+            parked = asyncio.create_task(one("parked"))
+            await asyncio.sleep(0.02)
+            assert q.pending_count == 1
+            # capacity returns, but no update() runs yet
+            q.scheduler.free("warm")
+            # fresh arrival: must NOT jump the backlog. Its own schedule()
+            # triggers a drain, so BOTH route — parked first.
+            late = asyncio.create_task(one("late"))
+            await asyncio.wait_for(asyncio.gather(parked, late), 2.0)
+            assert order == ["parked", "late"]
+
+        run(body())
+
     def test_ticker_drains_without_explicit_update(self, run):
         """Capacity that returns without a local free/prefill event (e.g.
         published snapshots dropping) still drains parked requests via the
