@@ -30,6 +30,7 @@ bounce buffers.
 from __future__ import annotations
 
 import asyncio
+import functools
 import uuid
 from typing import Optional
 
@@ -130,10 +131,17 @@ class IciKvBridge:
             # Pages go back to the prefill pool as soon as the gather made
             # an independent copy (or failed) — not after decode admission.
             transfer.release()
-        head_sharded = not worker.runner.model_config.is_mla
-        target = bundle_sharding(decode_runner.mesh, head_sharded)
-        dst = jax.device_put(bundle, target)  # the ICI hop (async)
-        await asyncio.to_thread(jax.block_until_ready, dst)
+        try:
+            head_sharded = not worker.runner.model_config.is_mla
+            target = bundle_sharding(decode_runner.mesh, head_sharded)
+            dst = jax.device_put(bundle, target)  # the ICI hop (async)
+            await asyncio.to_thread(jax.block_until_ready, dst)
+        except Exception as exc:  # noqa: BLE001 — degrade like the wire path
+            # Same contract as the host-relay pull: ANY transfer failure
+            # (decode HBM full, sharding mismatch) means recompute, not a
+            # failed user request.
+            log.warning("ici reshard failed (%r); recomputing prefill", exc)
+            return None
         self.hits += 1
         log.info("ici bridge pull %s: %d pages moved prefill->decode "
                  "on-device", transfer_id[:8], len(transfer.page_ids))
@@ -143,23 +151,11 @@ class IciKvBridge:
 # -- union-mesh (single SPMD program) collective-permute form ---------------
 
 
-def ppermute_kv_handoff(
-    pooled_kv: jax.Array,  # [2, L, kv, P, ps, kh, hd] — axis 0 over "pool"
-    src_pages: jax.Array,  # [n] pages to read on pool rank 0
-    dst_pages: jax.Array,  # [n] pages to write on pool rank 1
-    mesh: Mesh,
-    pool_axis: str = "pool",
-) -> jax.Array:
-    """Move pages between the prefill half (pool rank 0) and decode half
-    (pool rank 1) of ONE union mesh with an explicit `lax.ppermute` — the
-    collective-permute KV handoff. Everything happens in a single jitted
-    SPMD program: gather on rank 0, one ICI permute, scatter on rank 1.
-
-    `pooled_kv` leads with the pool axis so each rank owns its page pool;
-    within a rank the cache keeps its usual [L, kv, P, ps, kh, hd] layout
-    (kh may additionally be tp-sharded — the permute moves each tp shard
-    to its peer with the same tp coordinate, n_tp parallel ICI hops).
-    """
+@functools.lru_cache(maxsize=16)
+def _ppermute_fn(mesh: Mesh, pool_axis: str):
+    """Compile the handoff program once per (mesh, pool_axis) — a fresh
+    closure per call would miss jit's identity-keyed cache and retrace the
+    whole SPMD program on every transfer."""
 
     def body(kv, src, dst):
         # kv arrives as the rank-local pool [1, L, kvd, P, ps, kh, hd].
@@ -182,4 +178,24 @@ def ppermute_kv_handoff(
         in_specs=(specs, P(), P()),
         out_specs=specs,
     )
-    return jax.jit(fn, donate_argnums=(0,))(pooled_kv, src_pages, dst_pages)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def ppermute_kv_handoff(
+    pooled_kv: jax.Array,  # [2, L, kv, P, ps, kh, hd] — axis 0 over "pool"
+    src_pages: jax.Array,  # [n] pages to read on pool rank 0
+    dst_pages: jax.Array,  # [n] pages to write on pool rank 1
+    mesh: Mesh,
+    pool_axis: str = "pool",
+) -> jax.Array:
+    """Move pages between the prefill half (pool rank 0) and decode half
+    (pool rank 1) of ONE union mesh with an explicit `lax.ppermute` — the
+    collective-permute KV handoff. Everything happens in a single jitted
+    SPMD program: gather on rank 0, one ICI permute, scatter on rank 1.
+
+    `pooled_kv` leads with the pool axis so each rank owns its page pool;
+    within a rank the cache keeps its usual [L, kv, P, ps, kh, hd] layout
+    (kh may additionally be tp-sharded — the permute moves each tp shard
+    to its peer with the same tp coordinate, n_tp parallel ICI hops).
+    """
+    return _ppermute_fn(mesh, pool_axis)(pooled_kv, src_pages, dst_pages)

@@ -706,88 +706,110 @@ class TpuWorker:
             # carried through the request plane).
             log.debug("request %s traceparent=%s", request.request_id,
                       traceparent)
-        loop = asyncio.get_running_loop()
-        out_queue: asyncio.Queue = asyncio.Queue()
+        # Worker span: child of the frontend's server span via the carried
+        # traceparent (ref: logging.rs propagation across the request plane).
+        from ..runtime.otel import get_tracer
 
-        def emit(output: EngineOutput) -> None:
-            loop.call_soon_threadsafe(out_queue.put_nowait, output)
-
-        submit_kwargs: dict = {}
-        prefill_only = (self.mode == "prefill"
-                        or bool(request.annotations.get("prefill_only")))
-        if prefill_only:
-            submit_kwargs.update(
-                prefill_only=True,
-                on_prefill_done=self._register_transfer,
-            )
-        elif request.disaggregated_params:
-            blocks = await self._pull_remote_kv(request.disaggregated_params)
-            if blocks is not None:
-                submit_kwargs.update(
-                    onboard_blocks=blocks,
-                    onboard_first_token=request.disaggregated_params["first_token"],
-                )
-            # else: fall through — plain submit recomputes the prefill
-
-        if request.media_embeddings is not None:
-            import numpy as np
-
-            me = request.media_embeddings
-            try:
-                rows = np.frombuffer(me["data"], np.float32).reshape(
-                    tuple(me["shape"]))
-            except (KeyError, TypeError, ValueError) as exc:
-                yield EngineOutput(
-                    finish_reason="error",
-                    error=f"malformed media embeddings: {exc}").to_wire()
-                return
-            n_placeholders = sum(
-                1 for t in request.token_ids
-                if t == self.model_config.image_token_id)
-            if (rows.ndim != 2
-                    or rows.shape[-1] != self.model_config.hidden
-                    or rows.shape[0] != n_placeholders):
-                # A row/placeholder mismatch (encoder n_image_tokens vs the
-                # card's) would silently misalign images; fail loudly.
-                yield EngineOutput(
-                    finish_reason="error",
-                    error=(f"media embeddings {rows.shape} do not match "
-                           f"{n_placeholders} placeholder tokens x hidden "
-                           f"{self.model_config.hidden} (encoder preset "
-                           "mismatch?)")).to_wire()
-                return
-            submit_kwargs["media_embeds"] = rows
-        elif request.annotations.get("media_urls") or \
-                request.annotations.get("media"):
-            yield EngineOutput(
-                finish_reason="error",
-                error="multimodal request reached the worker without "
-                      "embeddings (no encoder pool?)").to_wire()
-            return
-        if request.lora_name:
-            # Resolve the slot AFTER every await above: submit() runs in the
-            # same event-loop step as this resolution, so lora_in_flight's
-            # incoming-queue drain can never miss a resolved-but-unsubmitted
-            # sequence (a suspend between resolve and submit would let a
-            # concurrent unload free — and a load repurpose — the slot).
-            slot = (self.loras.slot_of(request.lora_name)
-                    if self.loras is not None else None)
-            if slot is None:
-                yield EngineOutput(
-                    finish_reason="error",
-                    error=f"adapter {request.lora_name!r} not loaded here",
-                ).to_wire()
-                return
-            submit_kwargs["lora_idx"] = slot
-        handle = self.scheduler.submit(request, emit, **submit_kwargs)
+        worker_span = get_tracer().start_span(
+            "worker.generate", parent=traceparent,
+            **{"request.id": request.request_id, "worker.mode": self.mode,
+               "instance.id": self.instance_id})
         try:
-            while True:
-                output: EngineOutput = await out_queue.get()
-                yield output.to_wire()
-                if output.finish_reason is not None:
+            loop = asyncio.get_running_loop()
+            out_queue: asyncio.Queue = asyncio.Queue()
+
+            def emit(output: EngineOutput) -> None:
+                loop.call_soon_threadsafe(out_queue.put_nowait, output)
+
+            submit_kwargs: dict = {}
+            prefill_only = (self.mode == "prefill"
+                            or bool(request.annotations.get("prefill_only")))
+            if prefill_only:
+                submit_kwargs.update(
+                    prefill_only=True,
+                    on_prefill_done=self._register_transfer,
+                )
+            elif request.disaggregated_params:
+                blocks = await self._pull_remote_kv(request.disaggregated_params)
+                if blocks is not None:
+                    submit_kwargs.update(
+                        onboard_blocks=blocks,
+                        onboard_first_token=request.disaggregated_params["first_token"],
+                    )
+                # else: fall through — plain submit recomputes the prefill
+
+            if request.media_embeddings is not None:
+                import numpy as np
+
+                me = request.media_embeddings
+                try:
+                    rows = np.frombuffer(me["data"], np.float32).reshape(
+                        tuple(me["shape"]))
+                except (KeyError, TypeError, ValueError) as exc:
+                    yield EngineOutput(
+                        finish_reason="error",
+                        error=f"malformed media embeddings: {exc}").to_wire()
+                    worker_span.end(ok=False)
                     return
+                n_placeholders = sum(
+                    1 for t in request.token_ids
+                    if t == self.model_config.image_token_id)
+                if (rows.ndim != 2
+                        or rows.shape[-1] != self.model_config.hidden
+                        or rows.shape[0] != n_placeholders):
+                    # A row/placeholder mismatch (encoder n_image_tokens vs the
+                    # card's) would silently misalign images; fail loudly.
+                    yield EngineOutput(
+                        finish_reason="error",
+                        error=(f"media embeddings {rows.shape} do not match "
+                               f"{n_placeholders} placeholder tokens x hidden "
+                               f"{self.model_config.hidden} (encoder preset "
+                               "mismatch?)")).to_wire()
+                    worker_span.end(ok=False)
+                    return
+                submit_kwargs["media_embeds"] = rows
+            elif request.annotations.get("media_urls") or \
+                    request.annotations.get("media"):
+                yield EngineOutput(
+                    finish_reason="error",
+                    error="multimodal request reached the worker without "
+                          "embeddings (no encoder pool?)").to_wire()
+                worker_span.end(ok=False)
+                return
+            if request.lora_name:
+                # Resolve the slot AFTER every await above: submit() runs in the
+                # same event-loop step as this resolution, so lora_in_flight's
+                # incoming-queue drain can never miss a resolved-but-unsubmitted
+                # sequence (a suspend between resolve and submit would let a
+                # concurrent unload free — and a load repurpose — the slot).
+                slot = (self.loras.slot_of(request.lora_name)
+                        if self.loras is not None else None)
+                if slot is None:
+                    yield EngineOutput(
+                        finish_reason="error",
+                        error=f"adapter {request.lora_name!r} not loaded here",
+                    ).to_wire()
+                    worker_span.end(ok=False)
+                    return
+                submit_kwargs["lora_idx"] = slot
+            handle = self.scheduler.submit(request, emit, **submit_kwargs)
+            ok = True
+            try:
+                while True:
+                    output: EngineOutput = await out_queue.get()
+                    if output.error is not None:
+                        ok = False
+                    yield output.to_wire()
+                    if output.finish_reason is not None:
+                        return
+            finally:
+                handle.cancel()
+                worker_span.end(ok=ok)
         finally:
-            handle.cancel()
+            # Idempotent backstop: any exception between span
+            # creation and the instrumented exits (kv pull,
+            # submit) must still export the span.
+            worker_span.end(ok=False)
 
     async def close(self) -> None:
         if self._publish_task is not None and not self._publish_task.done():

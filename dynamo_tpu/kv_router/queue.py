@@ -187,11 +187,13 @@ class SchedulerQueue:
         # ordering), the highest-priority entry — possibly this one —
         # schedules now.
         self.update()
-        # Yield once: if update() resolved earlier-parked futures AND ours,
-        # their tasks were scheduled first and must resume (dispatch) first
-        # — awaiting an already-done future does not suspend.
-        await asyncio.sleep(0)
         try:
+            # Yield once: if update() resolved earlier-parked futures AND
+            # ours, their tasks were scheduled first and must resume
+            # (dispatch) first — awaiting an already-done future does not
+            # suspend. Inside the try: a cancellation landing on this yield
+            # after our grant was booked must hit the unbook handler below.
+            await asyncio.sleep(0)
             return await future
         except asyncio.CancelledError:
             # Two flavors of dead entry: still parked (skipped at drain

@@ -153,31 +153,51 @@ class HttpService:
                                          model=model).observe(
             time.monotonic() - pre_start)
         preprocessed.lora_name = lora
-        # W3C trace-context propagation: the incoming traceparent travels
-        # with the request across the request plane so worker-side logs can
-        # be joined to the frontend span (ref: logging.rs OTLP + W3C
-        # propagation across the request plane).
-        traceparent = request.headers.get("traceparent")
-        if traceparent:
-            preprocessed.annotations["traceparent"] = traceparent
+        # W3C trace-context propagation + span export: the frontend opens a
+        # SERVER span (child of any incoming traceparent) and re-injects
+        # ITS OWN context into the request annotations, so worker spans
+        # parent under it across the request plane (ref: logging.rs OTLP
+        # init + Injector/Extractor propagation).
+        from ..runtime.otel import get_tracer
+
+        span = get_tracer().start_span(
+            f"http.{kind}", parent=request.headers.get("traceparent"),
+            kind=2, **{"request.id": preprocessed.request_id,
+                       "model": model,
+                       "input.tokens": len(preprocessed.token_ids)})
+        tp = span.traceparent or request.headers.get("traceparent")
+        if tp:
+            preprocessed.annotations["traceparent"] = tp
         current_request_id.set(preprocessed.request_id)
-        if self.recorder is not None:
-            self.recorder.record_request(preprocessed.request_id, kind, body)
-        # Tool parsing activates only when the request declares tools (the
-        # reference gates on request.tools the same way); reasoning parsing
-        # follows the model card.
-        card = entry.preprocessor.card
-        delta_gen = DeltaGenerator(
-            entry.preprocessor, preprocessed, kind=kind,
-            tool_parser=(card.tool_parser if body.get("tools") else None),
-            reasoning_parser=card.reasoning_parser,
-        )
-        stream = bool(body.get("stream", False))
-        rt_metrics.INPUT_TOKENS.labels(model=model).observe(len(preprocessed.token_ids))
-        if stream:
-            return await self._stream_response(request, entry, preprocessed,
-                                               delta_gen, body)
-        return await self._aggregate_response(entry, preprocessed, delta_gen)
+        try:
+            if self.recorder is not None:
+                self.recorder.record_request(preprocessed.request_id, kind,
+                                             body)
+            # Tool parsing activates only when the request declares tools
+            # (the reference gates on request.tools the same way);
+            # reasoning parsing follows the model card.
+            card = entry.preprocessor.card
+            delta_gen = DeltaGenerator(
+                entry.preprocessor, preprocessed, kind=kind,
+                tool_parser=(card.tool_parser if body.get("tools")
+                             else None),
+                reasoning_parser=card.reasoning_parser,
+            )
+            stream = bool(body.get("stream", False))
+            rt_metrics.INPUT_TOKENS.labels(model=model).observe(
+                len(preprocessed.token_ids))
+        except BaseException:
+            # Failing requests are exactly the ones operators need spans
+            # for; export before re-raising (end() is idempotent).
+            span.end(ok=False)
+            raise
+        with span:
+            if stream:
+                return await self._stream_response(request, entry,
+                                                   preprocessed, delta_gen,
+                                                   body)
+            return await self._aggregate_response(entry, preprocessed,
+                                                  delta_gen)
 
     def _count_request(self, model: str, status: str,
                        start: Optional[float] = None, *,

@@ -146,6 +146,13 @@ _register("DYNT_MAX_BATCHED_TOKENS", 0, _int,
           "set a real budget for queueing to engage "
           "(ref: queue.rs DEFAULT_MAX_BATCHED_TOKENS)")
 
+# Tracing
+_register("DYNT_OTLP_ENDPOINT", "", _str,
+          "OTLP/HTTP collector base URL (e.g. http://localhost:4318); "
+          "empty disables span export (ref: logging.rs OTLP init)")
+_register("DYNT_OTEL_SERVICE_NAME", "dynamo_tpu", _str,
+          "service.name resource attribute on exported spans")
+
 # Fault tolerance
 _register("DYNT_MIGRATION_LIMIT", 3, _int,
           "Max in-flight request migrations across workers (ref: migration.rs)")

@@ -1,8 +1,9 @@
 """Structured logging: READABLE or JSONL lines with trace context.
 
 Mirrors the reference's tracing-subscriber setup (ref: lib/runtime/src/logging.rs:
-READABLE vs JSONL via DYN_LOGGING_JSONL, env-filter levels). OTEL export is a
-future hook; we carry `x_request_id`/`trace_id` fields through log records so a
+READABLE vs JSONL via DYN_LOGGING_JSONL, env-filter levels). OTLP span export
+lives in runtime/otel.py (DYNT_OTLP_ENDPOINT gates it, matching logging.rs's
+OTLP-in-logging-init); log records carry `x_request_id`/`trace_id` fields so a
 collector can correlate spans across the request plane.
 """
 

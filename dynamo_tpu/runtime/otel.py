@@ -1,0 +1,306 @@
+"""OTLP trace export: spans across frontend -> router -> worker.
+
+The reference wires OpenTelemetry OTLP export into logging init (ref:
+lib/runtime/src/logging.rs:72-100 — OTLP endpoint default localhost:4317,
+W3C trace-context propagation via Injector/Extractor). This is the same
+contract without the SDK dependency: a process-wide tracer buffers finished
+spans and a flusher thread POSTs OTLP/HTTP **JSON** (the collector's 4318
+`/v1/traces` mapping) — auditable wire format, zero new deps.
+
+Enable with DYNT_OTLP_ENDPOINT (e.g. http://localhost:4318); disabled (all
+no-ops) when unset, so the hot path costs one attribute lookup.
+
+Propagation: W3C `traceparent` (00-<trace32>-<span16>-01). The HTTP service
+extracts/creates one per request and re-injects the CURRENT span id into the
+request annotations, so worker spans parent correctly across the request
+plane — the Injector/Extractor role in logging.rs.
+
+Span recording is thread-safe (engine schedulers run on their own threads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import secrets
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from .config import env
+from .logging import get_logger
+
+log = get_logger("otel")
+
+FLUSH_INTERVAL_SECS = 2.0
+MAX_BUFFERED_SPANS = 4096
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple[str, str]]:
+    """W3C traceparent -> (trace_id, parent_span_id), None if malformed."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str]
+    start_ns: int
+    end_ns: int = 0
+    kind: int = 1  # SPAN_KIND_INTERNAL; 2=SERVER, 3=CLIENT
+    attributes: dict = dataclasses.field(default_factory=dict)
+    ok: bool = True
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def end(self, ok: bool = True) -> None:
+        self.end_ns = time.time_ns()
+        self.ok = ok
+
+    def to_otlp(self) -> dict:
+        attrs = []
+        for k, v in self.attributes.items():
+            if isinstance(v, bool):
+                val = {"boolValue": v}
+            elif isinstance(v, int):
+                val = {"intValue": str(v)}
+            elif isinstance(v, float):
+                val = {"doubleValue": v}
+            else:
+                val = {"stringValue": str(v)}
+            attrs.append({"key": k, "value": val})
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "name": self.name,
+            "kind": self.kind,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns or time.time_ns()),
+            "attributes": attrs,
+            "status": {"code": 1 if self.ok else 2},  # OK / ERROR
+        }
+        if self.parent_span_id:
+            out["parentSpanId"] = self.parent_span_id
+        return out
+
+
+class _NoopSpan:
+    """Absorbs the tracing API when export is disabled."""
+
+    trace_id = ""
+    span_id = ""
+    traceparent = ""
+
+    def set_attribute(self, key: str, value) -> None:
+        pass
+
+    def end(self, ok: bool = True) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Buffers finished spans; a daemon thread flushes OTLP JSON batches."""
+
+    def __init__(self, endpoint: str, service_name: str = "dynamo_tpu"):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self._buf: list[Span] = []
+        self._lock = threading.Lock()
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.exported = 0
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.endpoint)
+
+    # -- span API -----------------------------------------------------------
+
+    def start_span(self, name: str, parent: Optional[str] = None,
+                   kind: int = 1, **attributes):
+        """`parent` is a traceparent header value (or a Span.traceparent).
+        Returns a Span usable as a context manager; a no-op when disabled."""
+        if not self.enabled:
+            return NOOP_SPAN
+        ctx = parse_traceparent(parent)
+        if ctx:
+            trace_id, parent_span = ctx
+        else:
+            trace_id, parent_span = new_trace_id(), None
+        span = Span(name=name, trace_id=trace_id, span_id=new_span_id(),
+                    parent_span_id=parent_span, start_ns=time.time_ns(),
+                    kind=kind, attributes=dict(attributes))
+        return _SpanHandle(span, self)
+
+    def record(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        if not span.end_ns:
+            span.end()
+        with self._lock:
+            if len(self._buf) >= MAX_BUFFERED_SPANS:
+                self._buf.pop(0)
+                self.dropped += 1
+            self._buf.append(span)
+        self._ensure_flusher()
+
+    # -- export -------------------------------------------------------------
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is not None and self._flusher.is_alive():
+            return
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         name="otel-flush", daemon=True)
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(FLUSH_INTERVAL_SECS):
+            self.flush()
+        self.flush()
+
+    def flush(self) -> int:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return 0
+        payload = {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "dynamo_tpu"},
+                    "spans": [s.to_otlp() for s in batch],
+                }],
+            }]
+        }
+        try:
+            req = urllib.request.Request(
+                self.endpoint + "/v1/traces",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                resp.read()
+            self.exported += len(batch)
+            return len(batch)
+        except Exception as exc:  # noqa: BLE001 — telemetry must not kill
+            self.dropped += len(batch)
+            log.debug("otlp export failed (%d spans dropped): %r",
+                      len(batch), exc)
+            return 0
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._flusher is not None and self._flusher.is_alive():
+            self._flusher.join(timeout=FLUSH_INTERVAL_SECS + 6.0)
+        self.flush()
+
+
+class _SpanHandle:
+    """Span + context-manager glue returned by Tracer.start_span."""
+
+    def __init__(self, span: Span, tracer: Tracer):
+        self.span = span
+        self._tracer = tracer
+        self._recorded = False
+
+    # delegate the Span surface
+    @property
+    def trace_id(self) -> str:
+        return self.span.trace_id
+
+    @property
+    def span_id(self) -> str:
+        return self.span.span_id
+
+    @property
+    def traceparent(self) -> str:
+        return self.span.traceparent
+
+    def set_attribute(self, key: str, value) -> None:
+        self.span.set_attribute(key, value)
+
+    def end(self, ok: bool = True) -> None:
+        if self._recorded:
+            return
+        self._recorded = True
+        self.span.end(ok)
+        self._tracer.record(self.span)
+
+    def __enter__(self) -> "_SpanHandle":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end(ok=exc_type is None)
+        return False
+
+
+_GLOBAL: Optional[Tracer] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """Process-wide tracer from DYNT_OTLP_ENDPOINT (disabled when empty —
+    the logging.rs pattern of wiring OTLP into init but gating on env)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = Tracer(env("DYNT_OTLP_ENDPOINT"),
+                             service_name=env("DYNT_OTEL_SERVICE_NAME"))
+        return _GLOBAL
+
+
+def reset_tracer() -> None:
+    """Testing hook: drop the cached tracer so env changes take effect."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+        _GLOBAL = None

@@ -1,0 +1,43 @@
+"""Shared timing discipline for probes on the tunnel-attached chip.
+
+`jax.block_until_ready` does NOT synchronize over the tunnel (returns
+immediately) and every host readback costs ~50-300ms RTT, so: force a small
+readback per call, measure the RTT with a trivial kernel, subtract it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RTT_MS = 0.0
+
+
+def measure_rtt(n: int = 20) -> float:
+    """Round-trip of a trivial dispatch+readback; sets the module RTT."""
+    global RTT_MS
+
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((), jnp.float32)
+    float(tiny(x))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        float(tiny(x))
+    RTT_MS = (time.perf_counter() - t0) / n * 1e3
+    return RTT_MS
+
+
+def timeit(fn, *args, n: int = 10) -> float:
+    """Mean ms/call of `fn` (must return a scalar/tiny array), RTT
+    subtracted. Compiles on the first (untimed) call."""
+    np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        np.asarray(fn(*args))
+    return max((time.perf_counter() - t0) / n * 1e3 - RTT_MS, 0.0)

@@ -53,20 +53,10 @@ seeds = jnp.zeros((BATCH,), jnp.uint32)
 steps = jnp.zeros((BATCH,), jnp.int32)
 
 
-def measure_rtt() -> float:
-    @jax.jit
-    def tiny(x):
-        return x + 1
+sys.path.insert(0, "scripts")
+import perf_common
 
-    x = jnp.zeros((), jnp.float32)
-    float(tiny(x))
-    t0 = time.perf_counter()
-    for _ in range(20):
-        float(tiny(x))
-    return (time.perf_counter() - t0) / 20 * 1e3
-
-
-RTT = measure_rtt()
+RTT = perf_common.measure_rtt()
 print(f"RTT {RTT:.1f} ms", flush=True)
 
 
@@ -74,11 +64,7 @@ def timeit(name, fn, *args, n=10):
     if ONLY and name not in ONLY:
         return
     try:
-        np.asarray(fn(*args))
-        t0 = time.perf_counter()
-        for _ in range(n):
-            np.asarray(fn(*args))
-        dt = max((time.perf_counter() - t0) / n * 1e3 - RTT, 0.0)
+        dt = perf_common.timeit(fn, *args, n=n)
         print(f"{name:16s} {dt:8.3f} ms", flush=True)
     except Exception as exc:  # noqa: BLE001
         print(f"{name:16s} FAILED {exc!r}", flush=True)
@@ -183,7 +169,7 @@ if not ONLY or "scatter" in ONLY:
         t0 = time.perf_counter()
         for _ in range(10):
             scat_call()
-        dt = max((time.perf_counter() - t0) / 10 * 1e3 - RTT, 0.0)
+        dt = max((time.perf_counter() - t0) / 10 * 1e3 - perf_common.RTT_MS, 0.0)
         print(f"{'scatter':16s} {dt:8.3f} ms", flush=True)
     except Exception as exc:  # noqa: BLE001
         print(f"scatter FAILED {exc!r}", flush=True)

@@ -1,10 +1,9 @@
-"""Decode-step timing breakdown on the attached chip.
+"""Decode per-step timing on the attached chip via paired scan lengths.
 
-The chip is tunnel-attached: `jax.block_until_ready` does NOT synchronize
-(returns immediately) and every host readback costs ~50-100ms RTT. So every
-measurement here (a) forces a small host readback per call and (b) subtracts
-the measured RTT; per-step decode additionally uses paired scan lengths
-(K=16 vs K=128) so the per-step slope is RTT-free.
+Runs decode_multi blocks of K=16 and K=128 steps and reports the slope
+((t128 - t16) / 112) — per-step device time free of the tunnel RTT (see
+perf_common.py for why block_until_ready can't be trusted here).
+Component-level attribution lives in perf_components.py.
 
 Run:  python scripts/perf_probe.py [batch] [width_pages]
 """
@@ -14,21 +13,16 @@ from __future__ import annotations
 import sys
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+
+from perf_common import measure_rtt
 
 from dynamo_tpu.engine import ModelRunner, RunnerConfig
-from dynamo_tpu.engine.sampler import sample
 from dynamo_tpu.models import get_config
-from dynamo_tpu.models.transformer import (
-    forward_decode,
-    paged_attention_decode_xla,
-    rms_norm,
-    write_kv_stack,
-)
 from dynamo_tpu.parallel import MeshConfig, make_mesh
 
 MODEL = "qwen3-0.6b"
@@ -37,46 +31,17 @@ WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32  # pages per seq
 PAGE_SIZE = 16
 NUM_PAGES = max(1024, BATCH * WIDTH + 8)
 
-RTT_MS = 0.0
-
-
-def measure_rtt() -> float:
-    @jax.jit
-    def tiny(x):
-        return x + 1
-
-    x = jnp.zeros((), jnp.float32)
-    float(tiny(x))
-    t0 = time.perf_counter()
-    n = 20
-    for _ in range(n):
-        float(tiny(x))
-    return (time.perf_counter() - t0) / n * 1e3
-
-
-def timeit(fn, *args, n=10):
-    """fn must return a SCALAR (or tiny) array; we read it back per call to
-    force synchronization, then subtract the tunnel RTT."""
-    np.asarray(fn(*args))  # warmup/compile
-    t0 = time.perf_counter()
-    for _ in range(n):
-        np.asarray(fn(*args))
-    dt = (time.perf_counter() - t0) / n * 1e3
-    return max(dt - RTT_MS, 0.0)
-
 
 def main():
-    global RTT_MS
     cfg = get_config(MODEL)
-    mesh = make_mesh(MeshConfig())
     runner = ModelRunner(
         cfg,
         RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
                      max_batch=BATCH, max_pages_per_seq=WIDTH,
                      prefill_buckets=(256,)),
-        mesh, seed=0,
+        make_mesh(MeshConfig()), seed=0,
     )
-    params, kv = runner.params, runner.kv_cache
+    params = runner.params
     tables = np.zeros((BATCH, WIDTH), np.int32)
     nxt = 1
     for b in range(BATCH):
@@ -93,11 +58,9 @@ def main():
     seeds = jnp.zeros((BATCH,), jnp.uint32)
     steps = jnp.zeros((BATCH,), jnp.int32)
 
-    RTT_MS = measure_rtt()
-    print(f"tunnel RTT {RTT_MS:.1f} ms (subtracted from all numbers)",
-          flush=True)
+    rtt = measure_rtt()
+    print(f"tunnel RTT {rtt:.1f} ms", flush=True)
 
-    # -- decode per-step via paired scan lengths (RTT-free slope) ----------
     def block_time(k, n=6):
         fn = runner._build_decode_multi(k)
         state = {"kv": runner.kv_cache}
@@ -117,114 +80,16 @@ def main():
         return (time.perf_counter() - t0) / n * 1e3
 
     t16 = block_time(16)
-    print(f"decode_multi k=16 block: {t16:.1f} ms "
-          f"({(t16 - RTT_MS) / 16:.2f} ms/step naive)", flush=True)
+    print(f"decode_multi k=16 block: {t16:.1f} ms", flush=True)
     t128 = block_time(128)
     per_step = (t128 - t16) / 112
     print(f"decode_multi k=128 block: {t128:.1f} ms -> per-step slope "
           f"{per_step:.3f} ms", flush=True)
 
-    kv = runner.kv_cache
-    results = {}
-
-    # single full decode step (forward only, no sampling)
-    @jax.jit
-    def fwd_only(kv, tokens):
-        _, logits = forward_decode(params, cfg, tokens, positions, kv,
-                                   tables_j, kv_lens, active)
-        return logits.sum()
-
-    results["fwd_1step"] = timeit(fwd_only, kv, tokens)
-    print(f"fwd_1step {results['fwd_1step']:.3f} ms", flush=True)
-
-    # attention alone over all layers
-    q = jnp.zeros((BATCH, 1, cfg.n_q_heads, cfg.head_dim), jnp.bfloat16)
-    kc = jnp.zeros((BATCH, 1, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
-
-    @jax.jit
-    def attn_all(kv, q):
-        acc = jnp.zeros((), jnp.float32)
-        for layer in range(cfg.n_layers):
-            o = paged_attention_decode_xla(q, kv, layer, tables_j, kv_lens,
-                                           kc, kc)
-            acc += o.astype(jnp.float32).sum()
-        return acc
-
-    results["attn_28L"] = timeit(attn_all, kv, q)
-    print(f"attn_28L {results['attn_28L']:.3f} ms", flush=True)
-
-    # raw KV page gather alone
-    @jax.jit
-    def gather_all(kv):
-        acc = jnp.zeros((), jnp.float32)
-        for layer in range(cfg.n_layers):
-            acc += kv[layer, 0][tables_j].astype(jnp.float32).sum()
-            acc += kv[layer, 1][tables_j].astype(jnp.float32).sum()
-        return acc
-
-    results["gather_28L"] = timeit(gather_all, kv)
-    print(f"gather_28L {results['gather_28L']:.3f} ms", flush=True)
-
-    # stream the whole pool contiguously (bandwidth reference)
-    @jax.jit
-    def stream_all(kv):
-        return kv.astype(jnp.float32).sum()
-
-    results["stream_pool"] = timeit(stream_all, kv)
-    print(f"stream_pool {results['stream_pool']:.3f} ms "
-          f"(pool {kv.size * 2 / 1e9:.2f} GB)", flush=True)
-
-    # lm head matmul
-    x = jnp.zeros((BATCH, 1, cfg.hidden), jnp.bfloat16)
-
-    @jax.jit
-    def lmhead(x):
-        h = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        return jnp.einsum("bth,hv->btv", h,
-                          params["embed"].T).astype(jnp.float32).sum()
-
-    results["lmhead"] = timeit(lmhead, x)
-    print(f"lmhead {results['lmhead']:.3f} ms", flush=True)
-
-    # sampler
-    logits = jnp.zeros((BATCH, cfg.vocab_size), jnp.float32)
-
-    @jax.jit
-    def samp(logits):
-        return sample(logits, temp, top_p, top_k, seeds, steps).sum()
-
-    results["sampler"] = timeit(samp, logits)
-    print(f"sampler {results['sampler']:.3f} ms", flush=True)
-
-    # deferred KV write (2 batched scatters)
-    ks = jnp.zeros((cfg.n_layers, BATCH, 1, cfg.n_kv_heads, cfg.head_dim),
-                   jnp.bfloat16)
-
-    state = {"kv": kv}
-    scat = jax.jit(
-        lambda kv: write_kv_stack(kv, ks, ks, tables_j, positions[:, None],
-                                  active[:, None]),
-        donate_argnums=(0,))
-
-    def scat_call():
-        out = scat(state["kv"])
-        state["kv"] = out
-        np.asarray(out[0, 0, 0, 0, 0, 0])
-
-    scat_call()
-    t0 = time.perf_counter()
-    for _ in range(10):
-        scat_call()
-    results["scatter"] = max((time.perf_counter() - t0) / 10 * 1e3 - RTT_MS,
-                             0.0)
-    print(f"scatter {results['scatter']:.3f} ms", flush=True)
-
-    dev = jax.devices()[0]
-    print(f"device={dev.device_kind} batch={BATCH} width={WIDTH}pages "
-          f"ctx={WIDTH*PAGE_SIZE}")
-    wbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
-    print(f"param bytes: {wbytes/1e9:.3f} GB -> roofline "
-          f"{wbytes/819e9*1e3:.2f} ms/step (weights only)")
+    wbytes = sum(x.size * x.dtype.itemsize
+                 for x in __import__("jax").tree.leaves(params))
+    print(f"params {wbytes/1e9:.3f} GB -> {wbytes/819e9*1e3:.2f} ms/step "
+          f"weight-stream floor", flush=True)
 
 
 if __name__ == "__main__":

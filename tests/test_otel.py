@@ -1,0 +1,204 @@
+"""OTLP span export (ref: lib/runtime/src/logging.rs:72-100 — OTLP wired
+into logging init, W3C trace-context propagation). Collector stub captures
+POST /v1/traces; the e2e tier asserts frontend->worker span parentage
+across the request plane."""
+
+import http.server
+import json
+import threading
+import uuid
+
+import pytest
+
+from dynamo_tpu.runtime.otel import (
+    Span,
+    Tracer,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    reset_tracer,
+)
+
+
+class _Collector(http.server.BaseHTTPRequestHandler):
+    store = None  # set per-instance via server attribute
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.captured.append((self.path, json.loads(body)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+def _start_collector():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Collector)
+    srv.captured = []
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _spans_of(srv):
+    spans = []
+    for path, payload in srv.captured:
+        assert path == "/v1/traces"
+        for rs in payload["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                spans.extend(ss["spans"])
+    return spans
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        parsed = parse_traceparent(format_traceparent(tid, sid))
+        assert parsed == (tid, sid)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-cd" * 8 + "-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+
+class TestTracerExport:
+    def test_flush_posts_otlp_json(self):
+        srv, endpoint = _start_collector()
+        try:
+            tracer = Tracer(endpoint, service_name="svc-under-test")
+            with tracer.start_span("root", kind=2, model="m1",
+                                   count=3) as root:
+                with tracer.start_span("child",
+                                       parent=root.traceparent) as child:
+                    child.set_attribute("ok", True)
+            assert tracer.flush() == 2
+            spans = _spans_of(srv)
+            assert {s["name"] for s in spans} == {"root", "child"}
+            by_name = {s["name"]: s for s in spans}
+            assert by_name["child"]["traceId"] == by_name["root"]["traceId"]
+            assert by_name["child"]["parentSpanId"] == \
+                by_name["root"]["spanId"]
+            assert by_name["root"]["kind"] == 2
+            attrs = {a["key"]: a["value"]
+                     for a in by_name["root"]["attributes"]}
+            assert attrs["model"] == {"stringValue": "m1"}
+            assert attrs["count"] == {"intValue": "3"}
+            res_attrs = srv.captured[0][1]["resourceSpans"][0]["resource"][
+                "attributes"]
+            assert {"key": "service.name",
+                    "value": {"stringValue": "svc-under-test"}} in res_attrs
+            tracer.close()
+        finally:
+            srv.shutdown()
+
+    def test_disabled_tracer_is_noop(self):
+        tracer = Tracer("")
+        span = tracer.start_span("x")
+        span.set_attribute("a", 1)
+        with span:
+            pass
+        assert tracer.flush() == 0
+        assert tracer.exported == 0
+
+    def test_error_status_and_drop_on_dead_collector(self):
+        tracer = Tracer("http://127.0.0.1:9")  # nothing listens
+        try:
+            with pytest.raises(RuntimeError):
+                with tracer.start_span("boom"):
+                    raise RuntimeError("x")
+            assert tracer.flush() == 0
+            assert tracer.dropped == 1
+        finally:
+            tracer.close()
+
+    def test_get_tracer_reads_env(self, monkeypatch):
+        monkeypatch.setenv("DYNT_OTLP_ENDPOINT", "http://127.0.0.1:1234")
+        monkeypatch.setenv("DYNT_OTEL_SERVICE_NAME", "frontdoor")
+        reset_tracer()
+        try:
+            t = get_tracer()
+            assert t.enabled and t.service_name == "frontdoor"
+        finally:
+            monkeypatch.delenv("DYNT_OTLP_ENDPOINT")
+            reset_tracer()
+
+
+class TestE2ESpans:
+    def test_frontend_to_worker_parentage(self, run, mem_runtime_config,
+                                          monkeypatch):
+        """One chat request through HTTP frontend -> request plane -> real
+        TpuWorker produces an http.chat SERVER span and a worker.generate
+        child span sharing its trace, continuing the CLIENT's traceparent."""
+        import asyncio
+
+        import aiohttp
+
+        srv, endpoint = _start_collector()
+        monkeypatch.setenv("DYNT_OTLP_ENDPOINT", endpoint)
+        reset_tracer()
+
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+        from dynamo_tpu.frontend import Frontend
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        client_trace = "ab" * 16
+        client_tp = format_traceparent(client_trace, "12" * 8)
+
+        async def body():
+            cfg = mem_runtime_config()
+            rt = await DistributedRuntime(cfg).start()
+            rcfg = RunnerConfig(page_size=4, num_pages=128, max_batch=2,
+                                max_pages_per_seq=32,
+                                prefill_buckets=(16, 32, 64, 128))
+            worker = TpuWorker(rt, model_name="tiny-test",
+                               runner_config=rcfg, warmup=False)
+            await worker.start()
+            frt = await DistributedRuntime(mem_runtime_config(
+                cfg.discovery_path)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0,
+                                router_mode="round_robin")
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get("tiny-test") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            url = (f"http://127.0.0.1:{frontend.port}/v1/chat/completions")
+            async with aiohttp.ClientSession() as session:
+                async with session.post(url, json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 2,
+                }, headers={"traceparent": client_tp}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    await resp.json()
+            await asyncio.to_thread(get_tracer().flush)
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        try:
+            run(body(), timeout=300)
+            spans = _spans_of(srv)
+            names = {s["name"] for s in spans}
+            assert "http.chat" in names and "worker.generate" in names
+            by_name = {s["name"]: s for s in spans}
+            http_span = by_name["http.chat"]
+            wrk_span = by_name["worker.generate"]
+            # client's trace continues through both tiers
+            assert http_span["traceId"] == client_trace
+            assert http_span["parentSpanId"] == "12" * 8
+            assert wrk_span["traceId"] == client_trace
+            assert wrk_span["parentSpanId"] == http_span["spanId"]
+        finally:
+            monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)
+            reset_tracer()
+            srv.shutdown()
