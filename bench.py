@@ -27,7 +27,7 @@ PAGE_SIZE = 16
 NUM_PAGES = 1024
 MAX_PAGES_PER_SEQ = 64
 PROMPT_LEN = 256
-DECODE_STEPS = 128
+DECODE_STEPS = 256
 # HBM bandwidth by chip generation (GB/s) for the roofline denominator.
 HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
             "cpu": 50.0}
@@ -96,28 +96,42 @@ def main() -> None:
     seeds = np.zeros(BATCH, np.uint32)
 
     # Steady-state serving uses fused decode blocks (DYNT_DECODE_BLOCK;
-    # lax.scan of K steps per compiled call — one host dispatch per K
-    # tokens, the per-token latency discipline of SURVEY section 7).
+    # lax.scan of K steps per compiled call) with PIPELINED dispatch
+    # (DYNT_DECODE_PIPELINE): block d+1 consumes block d's tokens
+    # ON-DEVICE, so the host readback of block d overlaps block d+1's
+    # compute — exactly what the serving scheduler does
+    # (engine/scheduler.py _decode_all).
     steps_np = np.zeros(BATCH, np.int32)
 
     # Table width bucketed to the live context (as the serving scheduler
-    # does): the attention gather reads the full table extent.
+    # does): the attention kernel streams the table extent's pages.
     from dynamo_tpu.engine.model_runner import bucket_table_width
 
     width = bucket_table_width(pages_per_seq, MAX_PAGES_PER_SEQ)
     btables = np.ascontiguousarray(tables[:, :width])
 
+    state = {"tokens": tokens, "pending": None}
+
     def step_block():
-        nonlocal tokens, positions, kv_lens, steps_np
-        toks_k = runner.decode_multi(tokens, positions, btables, kv_lens,
-                                     active, temp, top_p, top_k, seeds,
-                                     steps_np, k=block)
-        tokens = toks_k[-1]
-        positions = positions + block
-        kv_lens = kv_lens + block
-        steps_np = steps_np + block
+        nonlocal positions, kv_lens, steps_np
+        toks_dev = runner.decode_multi(
+            state["tokens"], positions, btables, kv_lens, active, temp,
+            top_p, top_k, seeds, steps_np, k=block, return_device=True)
+        if state["pending"] is not None:
+            np.asarray(state["pending"])  # stream block d while d+1 runs
+        state["pending"] = toks_dev
+        state["tokens"] = toks_dev[-1]  # device-side chain
+        positions += block
+        kv_lens += block
+        steps_np += block
+
+    def drain():
+        if state["pending"] is not None:
+            np.asarray(state["pending"])
+            state["pending"] = None
 
     step_block()  # warmup (compile + first block)
+    drain()
 
     # Median of three trials: the chip may be tunnel-attached/shared, and
     # a single window can catch a latency spike that says nothing about
@@ -128,6 +142,7 @@ def main() -> None:
         start = time.perf_counter()
         for _ in range(n_blocks):
             step_block()
+        drain()
         trials.append(time.perf_counter() - start)
         # rewind positions so every trial measures the same context length
         positions -= n_blocks * block

@@ -21,16 +21,25 @@
 #include <unordered_set>
 #include <vector>
 
+#include <chrono>
+
 #include "xxh64.h"
 #include "radix_core.h"
 
 namespace {
 
 using dynamo_native::xxh64;
+using dynamo_native::BlockKey;
+using dynamo_native::ConcurrentTree;
 using dynamo_native::Node;
-using dynamo_native::Tree;
 using dynamo_native::Worker;
 using dynamo_native::WorkerHash;
+
+static uint64_t steady_now_ms() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 // ---------------------------------------------------------------------------
 // Hashing
@@ -114,12 +123,27 @@ static PyObject* py_hash_bytes(PyObject*, PyObject* args) {
 
 typedef struct {
   PyObject_HEAD
-  Tree* tree;
+  ConcurrentTree* tree;
 } RadixTreeObject;
 
-static PyObject* RadixTree_new(PyTypeObject* type, PyObject*, PyObject*) {
+// RadixTree(ttl_secs=0.0, max_tree_size=0, prune_target_ratio=0.8)
+// ttl_secs > 0 enables TTL expiry (+ size pruning when max_tree_size > 0),
+// serviced by maintain() (ref: indexer/pruning.rs PruneManager).
+static PyObject* RadixTree_new(PyTypeObject* type, PyObject* args,
+                               PyObject* kwargs) {
+  double ttl_secs = 0.0;
+  unsigned long long max_tree_size = 0;
+  double target_ratio = 0.8;
+  static const char* kwlist[] = {"ttl_secs", "max_tree_size",
+                                 "prune_target_ratio", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|dKd",
+                                   const_cast<char**>(kwlist), &ttl_secs,
+                                   &max_tree_size, &target_ratio))
+    return nullptr;
   RadixTreeObject* self = (RadixTreeObject*)type->tp_alloc(type, 0);
-  if (self) self->tree = new Tree();
+  if (self)
+    self->tree = new ConcurrentTree((uint64_t)(ttl_secs * 1000.0),
+                                    (size_t)max_tree_size, target_ratio);
   return (PyObject*)self;
 }
 
@@ -154,20 +178,13 @@ static PyObject* RadixTree_find_matches(RadixTreeObject* self, PyObject* args) {
   if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
 
   std::unordered_map<Worker, int64_t, WorkerHash> scores;
-  Node* node = &self->tree->root;
-  int64_t depth = 0;
-  for (uint64_t h : hashes) {
-    auto it = node->children.find(h);
-    if (it == node->children.end()) break;
-    node = it->second;
-    for (const Worker& w : node->workers) {
-      auto s = scores.find(w);
-      int64_t cur = (s == scores.end()) ? 0 : s->second;
-      if (cur == depth) scores[w] = depth + 1;
-    }
-    if (early_exit && node->workers.empty()) break;
-    depth++;
-  }
+  std::unordered_map<Worker, int64_t, WorkerHash> sizes;
+  // Drop the GIL for the walk: find_matches is the router's per-request hot
+  // read and the shared lock lets concurrent readers overlap (the
+  // ConcurrentRadixTree role, concurrent_radix_tree.rs).
+  Py_BEGIN_ALLOW_THREADS
+  self->tree->find_matches(hashes, early_exit != 0, &scores, &sizes);
+  Py_END_ALLOW_THREADS
 
   PyObject* scores_d = PyDict_New();
   PyObject* sizes_d = PyDict_New();
@@ -181,7 +198,7 @@ static PyObject* RadixTree_find_matches(RadixTreeObject* self, PyObject* args) {
     }
     Py_DECREF(key); Py_DECREF(val);
   }
-  for (auto& kv : self->tree->worker_blocks) {
+  for (auto& kv : sizes) {
     PyObject* key = Py_BuildValue("(Ki)", kv.first.id, (int)kv.first.dp);
     PyObject* val = PyLong_FromLongLong(kv.second);
     if (!key || !val || PyDict_SetItem(sizes_d, key, val) < 0) {
@@ -212,7 +229,10 @@ static PyObject* RadixTree_apply_stored(RadixTreeObject* self, PyObject* args) {
   }
   std::vector<uint64_t> hashes;
   if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
-  self->tree->apply_stored(Worker{wid, dp}, has_parent, parent_hash, hashes);
+  Py_BEGIN_ALLOW_THREADS
+  self->tree->apply_stored(Worker{wid, dp}, has_parent, parent_hash, hashes,
+                           steady_now_ms());
+  Py_END_ALLOW_THREADS
   Py_RETURN_NONE;
 }
 
@@ -223,7 +243,9 @@ static PyObject* RadixTree_apply_removed(RadixTreeObject* self, PyObject* args) 
   if (!PyArg_ParseTuple(args, "KiO", &wid, &dp, &hashes_obj)) return nullptr;
   std::vector<uint64_t> hashes;
   if (!hashes_from_obj(hashes_obj, &hashes)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
   self->tree->apply_removed(Worker{wid, dp}, hashes);
+  Py_END_ALLOW_THREADS
   Py_RETURN_NONE;
 }
 
@@ -231,7 +253,9 @@ static PyObject* RadixTree_remove_worker(RadixTreeObject* self, PyObject* args) 
   unsigned long long wid;
   int dp;
   if (!PyArg_ParseTuple(args, "Ki", &wid, &dp)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
   self->tree->remove_worker(Worker{wid, dp});
+  Py_END_ALLOW_THREADS
   Py_RETURN_NONE;
 }
 
@@ -239,10 +263,17 @@ static PyObject* RadixTree_remove_worker_id(RadixTreeObject* self,
                                             PyObject* args) {
   unsigned long long wid;
   if (!PyArg_ParseTuple(args, "K", &wid)) return nullptr;
-  std::vector<Worker> targets;
-  for (auto& kv : self->tree->worker_blocks)
-    if (kv.first.id == wid) targets.push_back(kv.first);
-  for (Worker w : targets) self->tree->remove_worker(w);
+  Py_BEGIN_ALLOW_THREADS
+  {
+    std::vector<Worker> targets;
+    {
+      std::shared_lock<std::shared_mutex> lk(self->tree->mu);
+      for (auto& kv : self->tree->tree.worker_blocks)
+        if (kv.first.id == wid) targets.push_back(kv.first);
+    }
+    for (Worker w : targets) self->tree->remove_worker(w);
+  }
+  Py_END_ALLOW_THREADS
   Py_RETURN_NONE;
 }
 
@@ -254,12 +285,13 @@ static PyObject* RadixTree_dump_worker(RadixTreeObject* self, PyObject* args) {
   Worker w{wid, dp};
   PyObject* out = PyList_New(0);
   if (!out) return nullptr;
-  for (auto& kv : self->tree->nodes) {
+  std::shared_lock<std::shared_mutex> lk(self->tree->mu);
+  for (auto& kv : self->tree->tree.nodes) {
     Node* node = kv.second;
     if (node->workers.count(w)) {
       PyObject* item;
       Node* parent = node->parent;
-      if (!parent || parent == &self->tree->root)
+      if (!parent || parent == &self->tree->tree.root)
         item = Py_BuildValue("(OK)", Py_None, node->hash);
       else
         item = Py_BuildValue("(KK)", parent->hash, node->hash);
@@ -273,14 +305,40 @@ static PyObject* RadixTree_dump_worker(RadixTreeObject* self, PyObject* args) {
 }
 
 static PyObject* RadixTree_total_nodes(RadixTreeObject* self, PyObject*) {
-  return PyLong_FromSize_t(self->tree->nodes.size());
+  return PyLong_FromSize_t(self->tree->total_nodes());
+}
+
+// maintain(now_ms=None) -> list[(worker_id, dp_rank, hash)]
+// TTL-expire + size-prune; returns evicted (worker, block) pairs.
+static PyObject* RadixTree_maintain(RadixTreeObject* self, PyObject* args) {
+  PyObject* now_obj = Py_None;
+  if (!PyArg_ParseTuple(args, "|O", &now_obj)) return nullptr;
+  uint64_t now_ms = (now_obj == Py_None)
+                        ? steady_now_ms()
+                        : PyLong_AsUnsignedLongLongMask(now_obj);
+  if (PyErr_Occurred()) return nullptr;
+  std::vector<BlockKey> evicted;
+  Py_BEGIN_ALLOW_THREADS
+  evicted = self->tree->maintain(now_ms);
+  Py_END_ALLOW_THREADS
+  PyObject* out = PyList_New((Py_ssize_t)evicted.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < evicted.size(); i++) {
+    PyObject* item = Py_BuildValue("(KiK)", evicted[i].worker.id,
+                                   (int)evicted[i].worker.dp,
+                                   evicted[i].hash);
+    if (!item) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, item);
+  }
+  return out;
 }
 
 static PyObject* RadixTree_worker_block_counts(RadixTreeObject* self,
                                                PyObject*) {
   PyObject* out = PyDict_New();
   if (!out) return nullptr;
-  for (auto& kv : self->tree->worker_blocks) {
+  std::shared_lock<std::shared_mutex> lk(self->tree->mu);
+  for (auto& kv : self->tree->tree.worker_blocks) {
     PyObject* key = Py_BuildValue("(Ki)", kv.first.id, (int)kv.first.dp);
     PyObject* val = PyLong_FromLongLong(kv.second);
     if (!key || !val || PyDict_SetItem(out, key, val) < 0) {
@@ -298,6 +356,7 @@ static PyMethodDef RadixTree_methods[] = {
     {"remove_worker", (PyCFunction)RadixTree_remove_worker, METH_VARARGS, nullptr},
     {"remove_worker_id", (PyCFunction)RadixTree_remove_worker_id, METH_VARARGS, nullptr},
     {"dump_worker", (PyCFunction)RadixTree_dump_worker, METH_VARARGS, nullptr},
+    {"maintain", (PyCFunction)RadixTree_maintain, METH_VARARGS, nullptr},
     {"total_nodes", (PyCFunction)RadixTree_total_nodes, METH_NOARGS, nullptr},
     {"worker_block_counts", (PyCFunction)RadixTree_worker_block_counts, METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr}};

@@ -7,9 +7,14 @@
 // under ASan/UBSan/TSan.
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
+#include <mutex>
+#include <queue>
+#include <shared_mutex>
 #include <unordered_map>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
 namespace dynamo_native {
@@ -111,7 +116,214 @@ struct Tree {
     }
     worker_blocks.erase(w);
   }
+
+  // Leading-contiguous-match scores: worker -> count of request blocks 0..i
+  // it holds without a gap (the router's per-request hot read).
+  void match_prefix(const std::vector<uint64_t>& hashes, bool early_exit,
+                    std::unordered_map<Worker, int64_t, WorkerHash>* scores)
+      const {
+    const Node* node = &root;
+    int64_t depth = 0;
+    for (uint64_t h : hashes) {
+      auto it = node->children.find(h);
+      if (it == node->children.end()) break;
+      node = it->second;
+      for (const Worker& w : node->workers) {
+        auto s = scores->find(w);
+        int64_t cur = (s == scores->end()) ? 0 : s->second;
+        if (cur == depth) (*scores)[w] = depth + 1;
+      }
+      if (early_exit && node->workers.empty()) break;
+      depth++;
+    }
+  }
 };
 
+// ---------------------------------------------------------------------------
+// TTL + size pruning (ref: lib/kv-router/src/indexer/pruning.rs
+// PruneManager — lazy min-heap of expirations over an authoritative timers
+// map; stale heap entries are skipped on pop and compacted past a rebuild
+// threshold).
+// ---------------------------------------------------------------------------
+
+struct BlockKey {
+  uint64_t hash;
+  Worker worker;
+  bool operator==(const BlockKey& o) const {
+    return hash == o.hash && worker == o.worker;
+  }
+};
+
+struct BlockKeyHash {
+  size_t operator()(const BlockKey& k) const {
+    return (size_t)(k.hash * 0x9E3779B97F4A7C15ULL) ^ WorkerHash{}(k.worker);
+  }
+};
+
+struct PruneManager {
+  // expiry in caller-supplied ms ticks (tests drive a fake clock).
+  std::unordered_map<BlockKey, uint64_t, BlockKeyHash> timers;
+  struct HeapEntry {
+    uint64_t expiry;
+    BlockKey key;
+    bool operator>(const HeapEntry& o) const { return expiry > o.expiry; }
+  };
+  std::priority_queue<HeapEntry, std::vector<HeapEntry>,
+                      std::greater<HeapEntry>> expirations;
+  uint64_t ttl_ms;
+  size_t max_tree_size;     // 0 = size pruning disabled
+  double prune_target_ratio;
+  size_t rebuild_threshold; // heap > timers * threshold -> rebuild
+
+  PruneManager(uint64_t ttl_ms_, size_t max_tree_size_ = 0,
+               double target_ratio = 0.8, size_t rebuild = 4)
+      : ttl_ms(ttl_ms_), max_tree_size(max_tree_size_),
+        prune_target_ratio(target_ratio), rebuild_threshold(rebuild) {}
+
+  void rebuild_heap() {
+    std::vector<HeapEntry> entries;
+    entries.reserve(timers.size());
+    for (auto& kv : timers) entries.push_back({kv.second, kv.first});
+    expirations = std::priority_queue<HeapEntry, std::vector<HeapEntry>,
+                                      std::greater<HeapEntry>>(
+        std::greater<HeapEntry>(), std::move(entries));
+  }
+
+  void insert(const std::vector<BlockKey>& keys, uint64_t now_ms) {
+    uint64_t expiry = now_ms + ttl_ms;
+    for (const BlockKey& k : keys) {
+      timers[k] = expiry;  // refresh; old heap entry goes stale
+      expirations.push({expiry, k});
+    }
+    if (expirations.size() > timers.size() * rebuild_threshold &&
+        expirations.size() > 1024)
+      rebuild_heap();
+  }
+
+  void erase(const BlockKey& k) { timers.erase(k); }
+
+  std::vector<BlockKey> pop_expired(uint64_t now_ms) {
+    std::vector<BlockKey> out;
+    while (!expirations.empty() && expirations.top().expiry <= now_ms) {
+      HeapEntry e = expirations.top();
+      expirations.pop();
+      auto it = timers.find(e.key);
+      if (it != timers.end() && it->second == e.expiry) {
+        timers.erase(it);
+        out.push_back(e.key);
+      }
+    }
+    return out;
+  }
+
+  // Oldest-expiry blocks beyond the size budget (approximate LRU: refresh
+  // on re-store pushes hot blocks to the back of the line).
+  std::vector<BlockKey> prune(size_t current_size) {
+    std::vector<BlockKey> out;
+    if (max_tree_size == 0 || current_size <= max_tree_size) return out;
+    size_t target = (size_t)(max_tree_size * prune_target_ratio);
+    size_t want = current_size - target;
+    while (out.size() < want && !expirations.empty()) {
+      HeapEntry e = expirations.top();
+      expirations.pop();
+      auto it = timers.find(e.key);
+      if (it != timers.end() && it->second == e.expiry) {
+        timers.erase(it);
+        out.push_back(e.key);
+      }
+    }
+    return out;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Concurrent tree (ref: lib/kv-router/src/indexer/concurrent_radix_tree.rs
+// — reader-writer concurrency so per-request find_matches never queues
+// behind other readers). One tree-wide shared_mutex: match/size reads take
+// shared locks (and the CPython binding drops the GIL around them, so many
+// router threads really do read in parallel); event application takes the
+// exclusive lock.
+// ---------------------------------------------------------------------------
+
+struct ConcurrentTree {
+  Tree tree;
+  PruneManager pruner;
+  mutable std::shared_mutex mu;
+
+  explicit ConcurrentTree(uint64_t ttl_ms = 0, size_t max_tree_size = 0,
+                          double target_ratio = 0.8)
+      : pruner(ttl_ms, max_tree_size, target_ratio) {}
+
+  // TTL and size budgets are independent: size-only configs still need the
+  // timer heap (it provides the oldest-first prune order; with ttl=0 the
+  // "expiry" is the insertion tick and pop_expired never runs).
+  bool tracking_enabled() const {
+    return pruner.ttl_ms > 0 || pruner.max_tree_size > 0;
+  }
+  bool ttl_enabled() const { return pruner.ttl_ms > 0; }
+
+  void find_matches(const std::vector<uint64_t>& hashes, bool early_exit,
+                    std::unordered_map<Worker, int64_t, WorkerHash>* scores,
+                    std::unordered_map<Worker, int64_t, WorkerHash>* sizes)
+      const {
+    std::shared_lock<std::shared_mutex> lk(mu);
+    tree.match_prefix(hashes, early_exit, scores);
+    if (sizes) *sizes = tree.worker_blocks;
+  }
+
+  void apply_stored(Worker w, bool has_parent, uint64_t parent_hash,
+                    const std::vector<uint64_t>& hashes, uint64_t now_ms) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    tree.apply_stored(w, has_parent, parent_hash, hashes);
+    if (tracking_enabled()) {
+      std::vector<BlockKey> keys;
+      keys.reserve(hashes.size());
+      for (uint64_t h : hashes) keys.push_back({h, w});
+      pruner.insert(keys, now_ms);
+    }
+  }
+
+  void apply_removed(Worker w, const std::vector<uint64_t>& hashes) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    for (uint64_t h : hashes) pruner.erase({h, w});
+    tree.apply_removed(w, hashes);
+  }
+
+  void remove_worker(Worker w) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    tree.remove_worker(w);
+    if (tracking_enabled()) {
+      std::vector<BlockKey> dead;
+      for (auto& kv : pruner.timers)
+        if (kv.first.worker == w) dead.push_back(kv.first);
+      for (const BlockKey& k : dead) pruner.timers.erase(k);
+    }
+  }
+
+  // TTL expiry + size pruning in one sweep; returns what was evicted so the
+  // caller can surface metrics/events. Expiry is APPLIED before the size
+  // check — pruning against the pre-expiry count would evict live blocks a
+  // sweep that just freed enough room.
+  std::vector<BlockKey> maintain(uint64_t now_ms) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    if (!tracking_enabled()) return {};
+    std::vector<BlockKey> evicted;
+    if (ttl_enabled()) {
+      evicted = pruner.pop_expired(now_ms);
+      for (const BlockKey& k : evicted)
+        tree.apply_removed(k.worker, {k.hash});
+    }
+    std::vector<BlockKey> pruned = pruner.prune(tree.nodes.size());
+    for (const BlockKey& k : pruned)
+      tree.apply_removed(k.worker, {k.hash});
+    evicted.insert(evicted.end(), pruned.begin(), pruned.end());
+    return evicted;
+  }
+
+  size_t total_nodes() const {
+    std::shared_lock<std::shared_mutex> lk(mu);
+    return tree.nodes.size();
+  }
+};
 
 }  // namespace dynamo_native

@@ -107,6 +107,67 @@ void gil_serialized_stress() {
               tree.nodes.size());
 }
 
+void concurrent_tree_stress() {
+  // The ConcurrentTree does its OWN locking (shared_mutex; the extension
+  // drops the GIL around its calls) — hammer it from unsynchronized
+  // threads so TSan proves the internal locking, not caller discipline.
+  dynamo_native::ConcurrentTree tree(/*ttl_ms=*/50, /*max_tree_size=*/512);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 6; t++) {
+    threads.emplace_back([&tree, t] {
+      std::mt19937_64 rng(2000 + t);
+      for (int iter = 0; iter < 4000; iter++) {
+        Worker w{(uint64_t)(t % 3), (int32_t)(t & 1)};
+        auto hashes = chain(rng() % 32, (int)(rng() % 8),
+                            1 + (int)(rng() % 8));
+        switch (rng() % 8) {
+          case 0:
+          case 1:
+          case 2:
+            tree.apply_stored(w, false, 0, hashes, (uint64_t)iter);
+            break;
+          case 3:
+            tree.apply_removed(w, hashes);
+            break;
+          case 4:
+            tree.remove_worker(w);
+            break;
+          case 5:
+            (void)tree.maintain((uint64_t)iter + 25);
+            break;
+          default: {
+            std::unordered_map<Worker, int64_t,
+                               dynamo_native::WorkerHash> scores, sizes;
+            tree.find_matches(hashes, false, &scores, &sizes);
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  // final full expiry must empty every worker's index
+  (void)tree.maintain(~0ULL);
+  std::printf("concurrent tree stress ok (%zu nodes live)\n",
+              tree.total_nodes());
+}
+
+void prune_manager_checks() {
+  dynamo_native::PruneManager pm(/*ttl_ms=*/100, /*max_tree_size=*/10,
+                                 /*target_ratio=*/0.5);
+  std::vector<dynamo_native::BlockKey> keys;
+  for (uint64_t i = 0; i < 20; i++) keys.push_back({i, Worker{1, 0}});
+  pm.insert(keys, 0);
+  // refresh half at a later tick: they must survive the first expiry sweep
+  std::vector<dynamo_native::BlockKey> young(keys.begin() + 10, keys.end());
+  pm.insert(young, 60);
+  auto expired = pm.pop_expired(110);
+  assert(expired.size() == 10);  // the unrefreshed half
+  auto pruned = pm.prune(15);    // 15 > 10 -> prune to 5, oldest first
+  assert(pruned.size() == 10);   // 15 - 10*0.5
+  assert(pm.pop_expired(1000).size() == 0);  // everything accounted for
+  std::printf("prune manager checks ok\n");
+}
+
 }  // namespace
 
 int main() {
@@ -117,6 +178,8 @@ int main() {
   assert(xxh64(data, 0, 7) == xxh64(data, 0, 7));
   single_thread_stress();
   gil_serialized_stress();
+  concurrent_tree_stress();
+  prune_manager_checks();
   std::printf("sanitize_stress: all ok\n");
   return 0;
 }

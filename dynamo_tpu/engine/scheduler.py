@@ -100,6 +100,7 @@ class InferenceScheduler:
         # steps into one compiled call when conditions allow — tokens then
         # stream in blocks of K.
         self.decode_block = max(1, int(env("DYNT_DECODE_BLOCK") or 1))
+        self.decode_pipeline = max(1, int(env("DYNT_DECODE_PIPELINE") or 1))
 
         def _stored(hashes: list[int], parent: Optional[int]) -> None:
             # Fan out G1 registrations to the router event buffer AND the
@@ -513,31 +514,45 @@ class InferenceScheduler:
             self._steps[i] = len(seq.generated)
             self._lora_idx[i] = seq.lora_idx
         want_logprobs = any(s.request.sampling.logprobs for s in ready)
-        block = self._decode_block_for(ready, want_logprobs)
+        block, depth = self._decode_block_for(ready, want_logprobs)
         # Bucket the block-table width to the LIVE context: the decode
         # attention gather reads the full table extent, so a conversation
         # 300 tokens deep must not pay for max_pages_per_seq (e.g. 128
         # pages = 2048 tokens) of gather bandwidth every step. jit
         # specializes per width; power-of-two buckets keep variants finite.
-        max_kv = max(s.kv_len for s in ready) + block
+        max_kv = max(s.kv_len for s in ready) + block * depth
         need = -(-max_kv // self.page_size)
         width = bucket_table_width(need,
                                    self.runner.config.max_pages_per_seq)
         tables = self._tables[:, :width]
         if block > 1:
-            toks_k = self.runner.decode_multi(
-                self._tokens, self._positions, tables, self._kv_lens,
-                self._active, self._temp, self._top_p, self._top_k,
-                self._seeds, self._steps, k=block,
-                lora_idx=self._lora_idx,
-            )
+            # Pipelined dispatch: issue block d+1 feeding on block d's
+            # DEVICE tokens before reading block d back, so the host
+            # readback (expensive on remote-attached chips) overlaps the
+            # next block's compute. A sequence finishing inside block d
+            # wastes its block-d+1 tokens — the same speculation the
+            # in-block discard below already accepts.
+            device_blocks = []
+            toks_dev = None
+            for d in range(depth):
+                toks_dev = self.runner.decode_multi(
+                    self._tokens if d == 0 else toks_dev[-1],
+                    self._positions + d * block, tables,
+                    self._kv_lens + d * block,
+                    self._active, self._temp, self._top_p, self._top_k,
+                    self._seeds, self._steps + d * block, k=block,
+                    lora_idx=self._lora_idx, return_device=True,
+                )
+                device_blocks.append(toks_dev)
             count = 0
-            for step in range(block):
-                for seq in ready:
-                    if seq.finished or seq.cancelled:
-                        continue  # EOS/stop inside the block: discard rest
-                    self._append_token(seq, int(toks_k[step][seq.slot]))
-                    count += 1
+            for toks_dev in device_blocks:
+                toks_k = np.asarray(toks_dev)
+                for step in range(block):
+                    for seq in ready:
+                        if seq.finished or seq.cancelled:
+                            continue  # EOS/stop inside: discard the rest
+                        self._append_token(seq, int(toks_k[step][seq.slot]))
+                        count += 1
             return count
         next_tokens = self.runner.decode(
             self._tokens, self._positions, tables, self._kv_lens,
@@ -556,28 +571,36 @@ class InferenceScheduler:
             count += 1
         return count
 
-    def _decode_block_for(self, ready: list, want_logprobs: bool) -> int:
-        """How many decode steps to fuse this iteration. Falls back to 1
-        (per-token) whenever fusing would hurt:
+    def _decode_block_for(self, ready: list,
+                          want_logprobs: bool) -> tuple[int, int]:
+        """(block, pipeline depth) for this iteration. Falls back to
+        (1, 1) whenever fusing would hurt:
           * prefill work pending (waiting queue or mid-prefill slots) —
             a K-block would add K-1 steps of TTFT to them;
           * any sequence wants logprobs (the multi path skips them);
           * any sequence's remaining token budget < K — KV writes past the
             allocated pages would corrupt neighbours.
+        Depth > 1 (DYNT_DECODE_PIPELINE) additionally needs depth*K of
+        budget — the pipelined dispatches write that far ahead.
         """
         if self.decode_block <= 1 or want_logprobs:
-            return 1
+            return 1, 1
         if self._waiting or not self._incoming.empty():
-            return 1
+            return 1, 1
         if any(s is not None and not s.decode_ready and not s.cancelled
                for s in self._slots):
-            return 1
+            return 1, 1
         budget = min(s.request.sampling.max_tokens - len(s.generated)
                      for s in ready)
         # All-or-nothing: intermediate k values would each compile a fresh
         # scanned program mid-serving (jit caches per k), costing far more
         # than the dispatches saved on a request's final few tokens.
-        return self.decode_block if budget >= self.decode_block else 1
+        if budget < self.decode_block:
+            return 1, 1
+        depth = max(1, self.decode_pipeline)
+        while depth > 1 and budget < depth * self.decode_block:
+            depth -= 1
+        return self.decode_block, depth
 
     def _append_token(self, seq: _Seq, token: int,
                       prompt_tokens: Optional[int] = None,

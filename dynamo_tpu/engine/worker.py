@@ -749,7 +749,6 @@ class TpuWorker:
                     yield EngineOutput(
                         finish_reason="error",
                         error=f"malformed media embeddings: {exc}").to_wire()
-                    worker_span.end(ok=False)
                     return
                 n_placeholders = sum(
                     1 for t in request.token_ids
@@ -765,7 +764,6 @@ class TpuWorker:
                                f"{n_placeholders} placeholder tokens x hidden "
                                f"{self.model_config.hidden} (encoder preset "
                                "mismatch?)")).to_wire()
-                    worker_span.end(ok=False)
                     return
                 submit_kwargs["media_embeds"] = rows
             elif request.annotations.get("media_urls") or \
@@ -774,7 +772,6 @@ class TpuWorker:
                     finish_reason="error",
                     error="multimodal request reached the worker without "
                           "embeddings (no encoder pool?)").to_wire()
-                worker_span.end(ok=False)
                 return
             if request.lora_name:
                 # Resolve the slot AFTER every await above: submit() runs in the
@@ -789,7 +786,6 @@ class TpuWorker:
                         finish_reason="error",
                         error=f"adapter {request.lora_name!r} not loaded here",
                     ).to_wire()
-                    worker_span.end(ok=False)
                     return
                 submit_kwargs["lora_idx"] = slot
             handle = self.scheduler.submit(request, emit, **submit_kwargs)

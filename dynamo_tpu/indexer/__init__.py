@@ -165,10 +165,23 @@ class StandaloneIndexer:
             })
         yield {"workers": workers, "total_nodes": self.tree.total_nodes()}
 
+    async def _maintain_loop(self, interval: float = 1.0) -> None:
+        """TTL expiry + size pruning sweep (no-op unless DYNT_INDEXER_TTL_
+        SECS/_MAX_TREE_SIZE enable it; ref: pruning.rs PruneManager driven
+        from the indexer's progress loop)."""
+        from ..kv_router.indexer import sweep_tree
+
+        while True:
+            await asyncio.sleep(interval)
+            sweep_tree(self.tree, "standalone", log)
+
     async def start(self) -> None:
         sub = await self.runtime.event_subscriber(
             self.namespace, topic_prefix=KV_EVENT_TOPIC)
         self._tasks.append(asyncio.create_task(self._event_loop(sub)))
+        if getattr(self.tree, "maintain", None) is not None:
+            self._tasks.append(
+                asyncio.create_task(self._maintain_loop()))
         self._watch = await self.runtime.discovery.watch_prefix(
             MODEL_CARD_PREFIX + "/")
         self._tasks.append(asyncio.create_task(self._watch_loop()))

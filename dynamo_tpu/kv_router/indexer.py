@@ -28,12 +28,90 @@ class _Node:
 
 
 class RadixTree:
-    def __init__(self) -> None:
+    def __init__(self, ttl_secs: float = 0.0, max_tree_size: int = 0,
+                 prune_target_ratio: float = 0.8) -> None:
         self._root = _Node(hash=0, parent=None)
         self._nodes: dict[int, _Node] = {}
         self._worker_blocks: dict[WorkerWithDpRank, int] = {}
         self._last_event_id: dict[WorkerWithDpRank, int] = {}
         self.gap_count = 0
+        # TTL/size pruning (ref: indexer/pruning.rs PruneManager): lazy
+        # min-heap over an authoritative (hash, worker) -> expiry map.
+        self._ttl = ttl_secs
+        self._max_tree_size = max_tree_size
+        self._prune_target_ratio = prune_target_ratio
+        self._timers: dict[tuple[int, WorkerWithDpRank], float] = {}
+        self._expirations: list[tuple[float, int, int, int]] = []  # heap
+
+    # -- TTL / size pruning -------------------------------------------------
+
+    @property
+    def _tracking(self) -> bool:
+        # TTL and size budgets are independent; size-only configs still
+        # need the timer heap for oldest-first prune order.
+        return bool(self._ttl or self._max_tree_size)
+
+    def _timer_insert(self, worker: WorkerWithDpRank,
+                      hashes: Sequence[int]) -> None:
+        if not self._tracking:
+            return
+        import heapq
+        import time as _time
+
+        expiry = _time.monotonic() + self._ttl
+        for h in hashes:
+            self._timers[(h, worker)] = expiry
+            heapq.heappush(self._expirations,
+                           (expiry, h, worker.worker_id, worker.dp_rank))
+        if (len(self._expirations) > 4 * max(len(self._timers), 256)):
+            self._expirations = [
+                (exp, h, w.worker_id, w.dp_rank)
+                for (h, w), exp in self._timers.items()
+            ]
+            heapq.heapify(self._expirations)
+
+    def maintain(self, now: float = None) -> list[tuple[int, int, int]]:
+        """TTL-expire + size-prune; returns evicted (worker_id, dp, hash)
+        tuples (ref: pruning.rs pop_expired + prune)."""
+        if not self._tracking:
+            return []
+        import heapq
+        import time as _time
+
+        if now is None:
+            now = _time.monotonic()
+        evicted: list[tuple[int, int, int]] = []
+
+        def _pop_valid() -> tuple[int, WorkerWithDpRank] | None:
+            exp, h, wid, dp = heapq.heappop(self._expirations)
+            worker = WorkerWithDpRank(wid, dp)
+            if self._timers.get((h, worker)) == exp:
+                del self._timers[(h, worker)]
+                return h, worker
+            return None
+
+        # TTL expiry, APPLIED before the size check — pruning against the
+        # pre-expiry count would evict live blocks a sweep that just freed
+        # enough room.
+        if self._ttl:
+            while self._expirations and self._expirations[0][0] <= now:
+                hit = _pop_valid()
+                if hit is not None:
+                    h, worker = hit
+                    evicted.append((worker.worker_id, worker.dp_rank, h))
+                    self._apply_removed(worker, [h])
+        if self._max_tree_size and len(self._nodes) > self._max_tree_size:
+            target = int(self._max_tree_size * self._prune_target_ratio)
+            want = len(self._nodes) - target
+            pruned = 0
+            while pruned < want and self._expirations:
+                hit = _pop_valid()
+                if hit is not None:
+                    h, worker = hit
+                    evicted.append((worker.worker_id, worker.dp_rank, h))
+                    self._apply_removed(worker, [h])
+                    pruned += 1
+        return evicted
 
     # -- queries -----------------------------------------------------------
 
@@ -115,6 +193,7 @@ class RadixTree:
                 node.workers.add(worker)
                 self._worker_blocks[worker] = self._worker_blocks.get(worker, 0) + 1
             parent = node
+        self._timer_insert(worker, block_hashes)
 
     def _apply_removed(
         self, worker: WorkerWithDpRank, block_hashes: Sequence[int]
@@ -128,6 +207,7 @@ class RadixTree:
                 self._worker_blocks[worker] = max(
                     0, self._worker_blocks.get(worker, 1) - 1
                 )
+            self._timers.pop((block_hash, worker), None)
             self._maybe_prune(node)
 
     def _maybe_prune(self, node: _Node) -> None:
@@ -152,6 +232,9 @@ class RadixTree:
             self._maybe_prune(node)
         self._worker_blocks.pop(worker, None)
         self._last_event_id.pop(worker, None)
+        if self._tracking:
+            for key in [k for k in self._timers if k[1] == worker]:
+                del self._timers[key]
 
     def remove_worker_id(self, worker_id: int) -> None:
         for worker in [w for w in set(self._worker_blocks) | set(self._last_event_id)
@@ -203,10 +286,21 @@ class NativeRadixTree:
     (csrc/native.cpp). Event-id bookkeeping (gap detection) stays here —
     it's O(1) per event; the structural work is native."""
 
-    def __init__(self, native_mod) -> None:
-        self._tree = native_mod.RadixTree()
+    def __init__(self, native_mod, ttl_secs: float = 0.0,
+                 max_tree_size: int = 0,
+                 prune_target_ratio: float = 0.8) -> None:
+        self._tree = native_mod.RadixTree(
+            ttl_secs=ttl_secs, max_tree_size=max_tree_size,
+            prune_target_ratio=prune_target_ratio)
         self._last_event_id: dict[WorkerWithDpRank, int] = {}
         self.gap_count = 0
+
+    def maintain(self, now: float = None) -> list[tuple[int, int, int]]:
+        """TTL expiry + size pruning in the native core; (worker_id, dp,
+        hash) evictions (native clock when `now` is None)."""
+        out = self._tree.maintain() if now is None else \
+            self._tree.maintain(int(now * 1000))
+        return [(wid, dp, h) for wid, dp, h in out]
 
     # -- queries -----------------------------------------------------------
 
@@ -308,11 +402,36 @@ class NativeRadixTree:
             self._last_event_id[worker] = last_event_id
 
 
-def make_radix_tree():
-    """Native C++ tree when the extension is available, Python otherwise."""
-    from dynamo_tpu.native import get_native
+def sweep_tree(tree, name: str, log) -> None:
+    """One TTL/size maintenance sweep with the shared logging/swallow
+    discipline (used by the standalone indexer service and the frontend
+    manager's periodic loops)."""
+    maintain = getattr(tree, "maintain", None)
+    if maintain is None:
+        return
+    try:
+        evicted = maintain()
+        if evicted:
+            log.info("pruned %d expired/over-budget indexed blocks (%s)",
+                     len(evicted), name)
+    except Exception:  # noqa: BLE001 — the sweep loop must survive
+        log.exception("indexer maintain failed (%s)", name)
 
+
+def make_radix_tree(ttl_secs: float = None, max_tree_size: int = None):
+    """Native C++ tree when the extension is available, Python otherwise.
+    TTL/size pruning defaults come from DYNT_INDEXER_TTL_SECS /
+    DYNT_INDEXER_MAX_TREE_SIZE (0 = disabled, matching the reference's
+    opt-in PruneConfig)."""
+    from dynamo_tpu.native import get_native
+    from dynamo_tpu.runtime.config import env
+
+    if ttl_secs is None:
+        ttl_secs = env("DYNT_INDEXER_TTL_SECS")
+    if max_tree_size is None:
+        max_tree_size = env("DYNT_INDEXER_MAX_TREE_SIZE")
     native = get_native()
     if native is not None:
-        return NativeRadixTree(native)
-    return RadixTree()
+        return NativeRadixTree(native, ttl_secs=ttl_secs,
+                               max_tree_size=max_tree_size)
+    return RadixTree(ttl_secs=ttl_secs, max_tree_size=max_tree_size)

@@ -169,7 +169,10 @@ class HttpService:
         if tp:
             preprocessed.annotations["traceparent"] = tp
         current_request_id.set(preprocessed.request_id)
-        try:
+        # Everything from here runs under the span: setup failures export
+        # it with ok=False via __exit__ — failing requests are exactly the
+        # ones operators need spans for.
+        with span:
             if self.recorder is not None:
                 self.recorder.record_request(preprocessed.request_id, kind,
                                              body)
@@ -186,12 +189,6 @@ class HttpService:
             stream = bool(body.get("stream", False))
             rt_metrics.INPUT_TOKENS.labels(model=model).observe(
                 len(preprocessed.token_ids))
-        except BaseException:
-            # Failing requests are exactly the ones operators need spans
-            # for; export before re-raising (end() is idempotent).
-            span.end(ok=False)
-            raise
-        with span:
             if stream:
                 return await self._stream_response(request, entry,
                                                    preprocessed, delta_gen,

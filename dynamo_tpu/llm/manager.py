@@ -138,6 +138,7 @@ class ModelWatcher:
         self.namespace_filter = namespace_filter
         self._watch = None
         self._tasks: list[asyncio.Task] = []
+        self._maintain_task: Optional[asyncio.Task] = None
         # model name -> prefill worker pool (disagg; ref prefill_router/
         # activation.rs — the PrefillRouterEngine activates when a pool has
         # live instances). _prefill_subjects maps endpoint subject -> name
@@ -453,6 +454,24 @@ class ModelWatcher:
         self._ns_entries[namespace] = entries
         sub = await self.runtime.event_subscriber(namespace, topic_prefix="")
         self._tasks.append(asyncio.create_task(self._event_loop(sub, entries)))
+        if self._maintain_task is None:
+            self._maintain_task = asyncio.create_task(
+                self._indexer_maintain_loop())
+            self._tasks.append(self._maintain_task)
+
+    async def _indexer_maintain_loop(self, interval: float = 1.0) -> None:
+        """Radix-index TTL/size sweep for every KV-routed entry (no-op
+        unless DYNT_INDEXER_TTL_SECS/_MAX_TREE_SIZE enable pruning;
+        ref: indexer/pruning.rs driven from the indexer loop)."""
+        from ..kv_router.indexer import sweep_tree
+
+        while True:
+            await asyncio.sleep(interval)
+            for entries in self._ns_entries.values():
+                for entry in entries:
+                    if entry.scheduler is not None:
+                        sweep_tree(entry.scheduler.indexer,
+                                   entry.card.name, log)
 
     async def _event_loop(self, sub, entries: list[ModelEntry]) -> None:
         async for topic, payload in sub:

@@ -455,15 +455,19 @@ def forward_decode(
     active: jax.Array,  # [B] bool
     lora: Optional[dict] = None,
     lora_idx: Optional[jax.Array] = None,
+    decode_attention_fn=None,  # (q, kv, layer, tables, lens, k, v) -> attn
 ) -> tuple[jax.Array, jax.Array]:
     """Single-token decode with DEFERRED cache writes: every layer attends
     over (cache history + current-token K/V in registers); the paged pool
     is updated once at the end for all layers in two batched scatters.
     Standard-attention models only (MLA keeps the unified path — its
-    latent cache is one stack already)."""
+    latent cache is one stack already). `decode_attention_fn` overrides the
+    XLA history attention (the Pallas flash-decode kernel on TPU: the XLA
+    page gather lowers ~10x off the bandwidth roofline there)."""
     assert not config.is_mla
     b = tokens.shape[0]
     pos2 = positions[:, None]
+    attn_fn = decode_attention_fn or paged_attention_decode_xla
     x = params["embed"][tokens][:, None, :]  # [B, 1, H]
     ks, vs = [], []
     for layer_idx, lp in enumerate(params["layers"]):
@@ -481,7 +485,7 @@ def forward_decode(
             k = rms_norm(k, lp["k_norm"], config.rms_eps)
         q = rope(q, pos2, config.rope_theta)
         k = rope(k, pos2, config.rope_theta)
-        attn = paged_attention_decode_xla(
+        attn = attn_fn(
             q, kv_cache, layer_idx, block_tables, kv_lens, k, v)
         ks.append(k)
         vs.append(v)

@@ -159,6 +159,436 @@ def paged_decode_attention(
     return out.reshape(b, qh, hd)
 
 
+def _decode_kernel_partial(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_pages] int32 (SMEM)
+    kv_lens_ref,  # [B] int32 (SMEM) — HISTORY length (current excluded)
+    # inputs (blocked)
+    q_ref,  # [1, 1, group, head_dim]
+    k_ref,  # [1, 1, page_size, head_dim]
+    v_ref,  # [1, 1, page_size, head_dim]
+    # outputs: UNNORMALIZED flash partials, combined with the in-register
+    # current token outside the kernel (deferred-write decode)
+    o_ref,  # [1, 1, group, head_dim] fp32 accumulator sum(exp(s-m))*v
+    m_ref_out,  # [1, 1, group, 128] fp32 running max
+    l_ref_out,  # [1, 1, group, 128] fp32 denom
+    # scratch
+    m_ref,
+    l_ref,
+    acc_ref,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    page_size = k_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+    start = p * page_size
+
+    @pl.when(start < kv_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        token_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        scores = jnp.where(token_pos < kv_len, scores, -jnp.inf)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        probs = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        o_ref[0, 0] = acc_ref[...]
+        m_ref_out[0, 0] = m_ref[...]
+        l_ref_out[0, 0] = l_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_partial(
+    q: jax.Array,  # [B, qh, hd]
+    k_pages: jax.Array,  # [P, ps, kh, hd]
+    v_pages: jax.Array,  # [P, ps, kh, hd]
+    block_tables: jax.Array,  # [B, max_pages] int32
+    kv_lens_hist: jax.Array,  # [B] int32 HISTORY length (current excluded)
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partials over the paged HISTORY: returns (acc, m, l) with
+    acc = sum(exp(s - m)) * v unnormalized, so the caller can fold in the
+    current token's in-register K/V (deferred cache writes keep the
+    (TPU-slow) scatter out of the per-layer loop — forward_decode)."""
+    b, qh, hd = q.shape
+    _, ps, kh, _ = k_pages.shape
+    group = qh // kh
+    max_pages = block_tables.shape[1]
+    kp = k_pages.transpose(2, 0, 1, 3)
+    vp = v_pages.transpose(2, 0, 1, 3)
+    qg = q.reshape(b, kh, group, hd)
+    grid = (b, kh, max_pages)
+
+    def q_map(bi, hi, pi, bt, kl):
+        del pi, bt, kl
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, pi, bt, kl):
+        del kl
+        return (hi, bt[bi, pi], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd), q_map),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, group, hd), q_map),
+            pl.BlockSpec((1, 1, group, 128), q_map),
+            pl.BlockSpec((1, 1, group, 128), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, hd), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        _decode_kernel_partial,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kh, group, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(block_tables.astype(jnp.int32), kv_lens_hist.astype(jnp.int32),
+      qg, kp, vp)
+    return acc, m[..., 0], l[..., 0]
+
+
+def _pool_decode_kernel(
+    # scalar prefetch
+    lengths_ref,  # [B] int32 HISTORY lengths (current token excluded)
+    tables_ref,  # [B * max_pages] int32 flattened block tables
+    layer_ref,  # [1] int32
+    buf_idx_ref,  # [1] int32 (mutable scalar-prefetch: double-buffer slot)
+    init_ref,  # [1] int32 (1 until the first DMA was issued)
+    # inputs
+    q_ref,  # [1, kh, g, hd] (block for this b)
+    pool_ref,  # FULL [L, 2, P, ps, kh, hd] in HBM (memory_space=ANY)
+    # outputs (blocks per b)
+    acc_ref,  # [1, kh, g, hd] f32 unnormalized accumulator
+    m_out_ref,  # [1, kh, g, 128] f32
+    l_out_ref,  # [1, kh, g, 128] f32
+    # scratch
+    k_buf,  # [2, C, ps, kh, hd] double-buffered page chunks
+    v_buf,
+    k_sems,  # DMA semaphores (2,)
+    v_sems,
+    m_ref,  # [kh, g, 128] f32
+    l_ref,
+    o_ref,  # [kh, g, hd] f32
+    *,
+    pages_per_chunk: int,
+    max_pages: int,
+    batch_size: int,
+):
+    """Flash decode over the paged HISTORY reading the WHOLE pool ref.
+
+    Why this shape (vs blocking pages through BlockSpec index maps):
+      * the pool stays in HBM and the kernel DMAs only owned pages — an
+        XLA-level `kv_cache[layer]` slice materializes a copy per layer
+        per step because custom calls can't fuse slicing (measured ~4ms of
+        pure copies per decode step);
+      * one DMA moves a page for ALL kv heads (the pool's page-major
+        layout), so there is no per-head grid dim re-reading pages;
+      * chunks of `pages_per_chunk` pages amortize per-iteration overhead
+        and double-buffer against compute (the technique of the public
+        jax paged_attention_kernel, adapted to page-major pools, layer
+        indexing, and unnormalized partials for deferred cache writes).
+    """
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_chunks = pl.num_programs(1)
+    ps = k_buf.shape[2]
+    bk = pages_per_chunk * ps
+    layer = layer_ref[0]
+    length = lengths_ref[b]
+
+    def start_copy(bi, ci, slot):
+        # Chunk ci of sequence bi into buffer `slot`; one async copy per
+        # page, covering every kv head of that page.
+        base = bi * max_pages + ci * pages_per_chunk
+        copies = []
+        for j in range(pages_per_chunk):
+            page = tables_ref[base + j]
+            copies.append(pltpu.make_async_copy(
+                pool_ref.at[layer, 0, page], k_buf.at[slot, j],
+                k_sems.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                pool_ref.at[layer, 1, page], v_buf.at[slot, j],
+                v_sems.at[slot]))
+        for c in copies:
+            c.start()
+
+    def wait_copy(bi, ci, slot):
+        # Recreate the same descriptors and wait (the public kernel's
+        # pattern: wait consumes the per-slot semaphore byte count).
+        base = bi * max_pages + ci * pages_per_chunk
+        for j in range(pages_per_chunk):
+            page = tables_ref[base + j]
+            pltpu.make_async_copy(pool_ref.at[layer, 0, page],
+                                  k_buf.at[slot, j], k_sems.at[slot]).wait()
+            pltpu.make_async_copy(pool_ref.at[layer, 1, page],
+                                  v_buf.at[slot, j], v_sems.at[slot]).wait()
+
+    def next_active(bi, ci):
+        """First active (b, chunk) after (bi, ci) — sequences with zero
+        history are skipped entirely."""
+        def advance_b():
+            nb = jax.lax.fori_loop(
+                0, batch_size,
+                lambda _, cur: jnp.where(
+                    jnp.logical_and(
+                        cur < batch_size,
+                        lengths_ref[jnp.clip(cur, 0, batch_size - 1)] == 0),
+                    cur + 1, cur),
+                bi + 1)
+            return nb, jnp.int32(0)
+
+        return jax.lax.cond((ci + 1) * bk < length,
+                            lambda: (bi, ci + 1), advance_b)
+
+    active = i * bk < length
+
+    @pl.when(jnp.logical_and(active, init_ref[0] == 1))
+    def _first():
+        start_copy(b, i, buf_idx_ref[0])
+        init_ref[0] = 0
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(active)
+    def _compute():
+        slot = buf_idx_ref[0]
+        nb, ni = next_active(b, i)
+
+        @pl.when(nb < batch_size)
+        def _prefetch():
+            nslot = jnp.where(slot == 0, 1, 0)
+            start_copy(nb, ni, nslot)
+            buf_idx_ref[0] = nslot
+
+        wait_copy(b, i, slot)
+        q = q_ref[0].astype(jnp.float32)  # [kh, g, hd]
+        kh = k_buf.shape[3]
+        k = k_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
+        v = v_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        pos = i * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[1], bk), 1)  # [g, t]
+        # Static per-head loop: Mosaic's matmul wants matching batch-dim
+        # layouts, so run kh small GQA matmuls instead of one batched one.
+        for h in range(kh):
+            qh_ = q[h]  # [g, hd]
+            kh_ = k[:, h, :]  # [t, hd]
+            vh_ = v[:, h, :]
+            scores = jax.lax.dot_general(
+                qh_, kh_, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [g, t]
+            scores = jnp.where(pos < length, scores, -jnp.inf)
+            m_prev = m_ref[h, :, 0:1]  # [g, 1]
+            l_prev = l_ref[h, :, 0:1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            probs = jnp.exp(scores - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                probs, vh_, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [g, hd]
+            o_ref[h] = o_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(i == n_chunks - 1)
+    def _finish():
+        acc_ref[0] = o_ref[...]
+        m_out_ref[0] = m_ref[...]
+        l_out_ref[0] = l_ref[...]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("pages_per_chunk", "interpret"))
+def paged_decode_attention_pool(
+    q: jax.Array,  # [B, qh, hd]
+    kv_pool: jax.Array,  # [L, 2, P, ps, kh, hd] — the WHOLE cache
+    layer: jax.Array,  # scalar int32
+    block_tables: jax.Array,  # [B, max_pages] int32
+    kv_lens_hist: jax.Array,  # [B] int32 history length (current excluded)
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Chunked-DMA flash partials over the paged history; see
+    _pool_decode_kernel for why this reads the full pool. Returns
+    (acc, m, l) unnormalized for the deferred current-token combine."""
+    b, qh, hd = q.shape
+    ps, kh = kv_pool.shape[3], kv_pool.shape[4]
+    group = qh // kh
+    max_pages = block_tables.shape[1]
+    ppc = min(pages_per_chunk, max_pages)
+    while max_pages % ppc:
+        ppc -= 1
+    n_chunks = max_pages // ppc
+    qg = q.reshape(b, kh, group, hd)
+
+    def q_map(bi, ci, *refs):
+        del ci, refs
+        return (bi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, kh, group, hd), q_map),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, kh, group, hd), q_map),
+            pl.BlockSpec((1, kh, group, 128), q_map),
+            pl.BlockSpec((1, kh, group, 128), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((kh, group, 128), jnp.float32),
+            pltpu.VMEM((kh, group, 128), jnp.float32),
+            pltpu.VMEM((kh, group, hd), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_pool_decode_kernel, pages_per_chunk=ppc,
+                          max_pages=max_pages, batch_size=b),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kh, group, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(kv_lens_hist.astype(jnp.int32),
+      block_tables.reshape(-1).astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
+      jnp.zeros((1,), jnp.int32),  # double-buffer slot
+      jnp.ones((1,), jnp.int32),  # init flag
+      qg, kv_pool)
+    return acc, m[..., 0], l[..., 0]
+
+
+def paged_attention_decode_fused(
+    q: jax.Array,  # [B, 1, qh, hd]
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer: int,
+    block_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] INCLUDING the current token
+    k_cur: jax.Array,  # [B, 1, kh, hd] current token's K (not yet cached)
+    v_cur: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Deferred-write decode attention: Pallas flash partials over the
+    paged history (only owned pages are streamed — the XLA gather reads
+    the table extent through scatter-shaped HLO an order of magnitude
+    slower on TPU), combined with the in-register current token here.
+    Drop-in for `transformer.paged_attention_decode_xla`."""
+    acc, m, l = paged_decode_attention_partial(
+        q[:, 0], kv_cache[layer, 0], kv_cache[layer, 1],
+        block_tables, kv_lens - 1, interpret=interpret,
+    )  # acc [B, kh, g, hd] f32; m, l [B, kh, g]
+    return _combine_current(q, acc, m, l, k_cur, v_cur)
+
+
+def _combine_current(q, acc, m, l, k_cur, v_cur):
+    """Fold the in-register current token into unnormalized flash partials
+    (the deferred-write combine shared by both kernel variants)."""
+    b, _, qh, hd = q.shape
+    kh = k_cur.shape[2]
+    group = qh // kh
+    qg = q[:, 0].reshape(b, kh, group, hd)
+    s_cur = jnp.einsum(
+        "bkgh,bkh->bkg", qg.astype(jnp.float32),
+        k_cur[:, 0].astype(jnp.float32)) / math.sqrt(hd)
+    m_new = jnp.maximum(m, s_cur)
+    alpha = jnp.exp(m - m_new)  # 0 when history empty (m = -inf)
+    beta = jnp.exp(s_cur - m_new)
+    out = (acc * alpha[..., None]
+           + beta[..., None] * v_cur[:, 0].astype(jnp.float32)[:, :, None, :])
+    out = out / (l * alpha + beta)[..., None]
+    return out.reshape(b, 1, qh, hd).astype(q.dtype)
+
+
+def paged_attention_decode_pool(
+    q: jax.Array,  # [B, 1, qh, hd]
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,  # [B] INCLUDING the current token
+    k_cur: jax.Array,  # [B, 1, kh, hd]
+    v_cur: jax.Array,
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Deferred-write decode attention via the whole-pool chunked-DMA
+    kernel — the production TPU path: no per-layer pool slices (no copies),
+    one DMA per page covering all kv heads, double-buffered against the
+    flash compute. Drop-in for `transformer.paged_attention_decode_xla`."""
+    acc, m, l = paged_decode_attention_pool(
+        q[:, 0], kv_cache, layer, block_tables,
+        jnp.maximum(kv_lens - 1, 0),
+        pages_per_chunk=pages_per_chunk, interpret=interpret,
+    )
+    return _combine_current(q, acc, m, l, k_cur, v_cur)
+
+
 def paged_attention(
     q: jax.Array,  # [B, T, qh, hd]
     kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]

@@ -94,6 +94,10 @@ _register("DYNT_SYSTEM_ENABLED", True, _bool, "Enable the system status server")
 
 # Logging
 _register("DYNT_LOG_LEVEL", "INFO", _str, "Log level")
+_register("DYNT_DECODE_PIPELINE", 2, _int,
+          "Pipelined decode-block dispatches in flight (>1 overlaps the "
+          "host readback of block d with block d+1's compute — the tokens "
+          "chain on-device; costs depth*block of page/token budget)")
 _register("DYNT_DECODE_BLOCK", 1, _int,
           "Decode steps fused into one compiled call (lax.scan) when no "
           "prefill work is pending: amortizes host dispatch per token. "
@@ -145,6 +149,13 @@ _register("DYNT_MAX_BATCHED_TOKENS", 0, _int,
           "the gate effectively unlimited (DEFAULT_MAX_BATCHED_TOKENS) — "
           "set a real budget for queueing to engage "
           "(ref: queue.rs DEFAULT_MAX_BATCHED_TOKENS)")
+
+_register("DYNT_INDEXER_TTL_SECS", 0.0, _float,
+          "Radix-index block TTL; 0 disables expiry "
+          "(ref: indexer/pruning.rs PruneConfig ttl=120s when enabled)")
+_register("DYNT_INDEXER_MAX_TREE_SIZE", 0, _int,
+          "Radix-index node budget; above it the oldest blocks prune to "
+          "80% of budget (0 = unlimited; ref PruneConfig max_tree_size)")
 
 # Tracing
 _register("DYNT_OTLP_ENDPOINT", "", _str,

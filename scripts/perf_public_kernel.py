@@ -1,0 +1,150 @@
+"""Measure a unified decode step built on the PUBLIC jax Pallas paged
+attention kernel with a head-major pool layout [L, 2, kh, P, ps, hd]:
+per-layer current-KV writes into the pool, then chunked-DMA kernel reads.
+Slope-paired like perf_slope.py. Decides whether the product pool layout
+refactor pays.
+
+Run: python scripts/perf_public_kernel.py [batch] [width] [pages_per_block]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+
+from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel import (  # noqa: E501
+    paged_attention,
+)
+from perf_common import measure_rtt
+
+from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.models import get_config, init_params
+from dynamo_tpu.models.transformer import rms_norm, rope
+
+MODEL = "qwen3-0.6b"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+PPB = int(sys.argv[3]) if len(sys.argv) > 3 else 8  # pages per compute block
+MODE = sys.argv[4] if len(sys.argv) > 4 else "full"  # full|nowrite|noattn
+PAGE_SIZE = 16
+NUM_PAGES = max(1024, BATCH * WIDTH + 8)
+K1, K2 = 8, 40
+
+cfg = get_config(MODEL)
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+
+
+def decode_step(params, kv, tokens, positions, tables, kv_lens):
+    """Unified decode: write current K/V into the head-major pool per
+    layer, then public chunked-DMA paged attention over the full length."""
+    b = tokens.shape[0]
+    pos2 = positions[:, None]
+    x = params["embed"][tokens][:, None, :]
+    page_of = positions // PAGE_SIZE
+    page_idx = jnp.take_along_axis(tables, page_of[:, None], axis=1)[:, 0]
+    slot = positions % PAGE_SIZE
+    for layer_idx, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
+        q = rope(q, pos2, cfg.rope_theta)
+        k = rope(k, pos2, cfg.rope_theta)
+        # kv: [L, 2, kh, P, ps, hd]; write row (kh, page_idx[b], slot[b])
+        kc = k[:, 0].transpose(1, 0, 2)  # [kh, B, hd]
+        vc = v[:, 0].transpose(1, 0, 2)
+        if MODE != "nowrite":
+            kv = kv.at[layer_idx, 0, :, page_idx, slot].set(
+                kc.transpose(1, 0, 2).astype(kv.dtype))
+            kv = kv.at[layer_idx, 1, :, page_idx, slot].set(
+                vc.transpose(1, 0, 2).astype(kv.dtype))
+        if MODE == "noattn":
+            attn = q[:, 0]
+        else:
+            attn = paged_attention(
+                q[:, 0], kv[layer_idx, 0], kv[layer_idx, 1], kv_lens,
+                tables, pages_per_compute_block=PPB,
+            )  # [B, qh, hd]
+        x = x + jnp.einsum("btqd,qdh->bth", attn[:, None], lp["wo"])
+        hm = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        g = jnp.einsum("bth,hm->btm", hm, lp["w_gate"])
+        u = jnp.einsum("bth,hm->btm", hm, lp["w_up"])
+        x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bth,hv->btv", x,
+                        params["embed"].T).astype(jnp.float32)[:, 0]
+    return kv, logits
+
+
+def build(k_steps):
+    def multi(params, kv, tokens, positions, tables, kv_lens, temp, top_p,
+              top_k, seeds, steps):
+        def body(carry, _):
+            kv, toks, pos, lens, sidx = carry
+            kv, logits = decode_step(params, kv, toks, pos, tables, lens)
+            nxt = sample(logits, temp, top_p, top_k, seeds, sidx)
+            return (kv, nxt, pos + 1, lens + 1, sidx + 1), nxt
+
+        (kv, *_), toks = jax.lax.scan(
+            body, (kv, tokens, positions, kv_lens, steps), None,
+            length=k_steps)
+        return kv, toks
+
+    return jax.jit(multi, donate_argnums=(1,))
+
+
+def main():
+    tables = np.zeros((BATCH, WIDTH), np.int32)
+    nxt = 1
+    for b in range(BATCH):
+        tables[b] = np.arange(nxt, nxt + WIDTH)
+        nxt += WIDTH
+    tables_j = jnp.asarray(tables)
+    kv_lens = jnp.full((BATCH,), WIDTH * PAGE_SIZE - K2 - 4, jnp.int32)
+    tokens = jnp.zeros((BATCH,), jnp.int32)
+    positions = kv_lens - 1
+    temp = jnp.zeros((BATCH,), jnp.float32)
+    top_p = jnp.ones((BATCH,), jnp.float32)
+    top_k = jnp.zeros((BATCH,), jnp.int32)
+    seeds = jnp.zeros((BATCH,), jnp.uint32)
+    steps = jnp.zeros((BATCH,), jnp.int32)
+
+    rtt = measure_rtt()
+    print(f"RTT {rtt:.1f} ms (ppb={PPB})", flush=True)
+    slopes = {}
+    for k in (K1, K2):
+        fn = build(k)
+        kv = jax.jit(lambda: jnp.zeros(
+            (cfg.n_layers, 2, cfg.n_kv_heads, NUM_PAGES, PAGE_SIZE,
+             cfg.head_dim), jnp.bfloat16))()
+
+        def call(kv):
+            kv, toks = fn(params, kv, tokens, positions, tables_j,
+                          kv_lens, temp, top_p, top_k, seeds, steps)
+            np.asarray(toks)
+            return kv
+
+        kv = call(kv)
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            kv = call(kv)
+        slopes[k] = (time.perf_counter() - t0) / n * 1e3
+        print(f"k{k}: {slopes[k]:.1f} ms", flush=True)
+    per_step = (slopes[K2] - slopes[K1]) / (K2 - K1)
+    print(f"public-kernel {MODE}: {per_step:.3f} ms/step",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Ablation slopes: per-step decode cost of each component, measured as
+paired-scan-length slopes of ABLATED decode graphs (fusion-faithful, RTT-
+free — see perf_common.py for why single-call timing lies on this tunnel).
+
+Each variant runs K1 and K2 steps of a scan inside one jit; the slope
+(t2-t1)/(K2-K1) is that graph's true per-step device time. full - variant
+attributes the removed component's in-context cost.
+
+Variants: full | no_attn | no_gather (attend only to the current token) |
+no_head (skip lm_head matmul, sample from hidden slice) | no_write (skip
+the deferred KV scatter) | no_mlp
+
+Run: python scripts/perf_slope.py [batch] [width_pages] [variant ...]
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+
+from perf_common import measure_rtt
+
+from dynamo_tpu.engine.sampler import sample
+from dynamo_tpu.models import get_config, init_params, make_kv_cache
+from dynamo_tpu.models.transformer import rms_norm, rope, write_kv_stack
+
+MODEL = "qwen3-0.6b"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+WIDTH = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+VARIANTS = sys.argv[3:] or ["full", "no_attn", "no_gather", "no_head",
+                            "no_write", "no_mlp"]
+PAGE_SIZE = 16
+NUM_PAGES = max(1024, BATCH * WIDTH + 8)
+K1, K2 = 8, 40
+
+cfg = get_config(MODEL)
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+
+
+def decode_step(params, kv, tokens, positions, tables, kv_lens, variant):
+    """Trimmed copy of forward_decode with ablation switches (probe-only:
+    keeping ablation flags out of the product path)."""
+    x = params["embed"][tokens][:, None, :]
+    pos2 = positions[:, None]
+    ks, vs = [], []
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
+        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
+        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
+        q = rope(q, pos2, cfg.rope_theta)
+        k = rope(k, pos2, cfg.rope_theta)
+        ks.append(k)
+        vs.append(v)
+        if variant == "no_attn":
+            attn = q  # keep shapes; drop all attention math
+        elif variant == "no_gather":
+            # attention math against ONLY the current token (no KV reads)
+            qg = q.reshape(BATCH, cfg.n_kv_heads, -1, cfg.head_dim)
+            cur = jnp.einsum("bkgh,bkh->bkg", qg.astype(jnp.float32),
+                             k[:, 0].astype(jnp.float32))
+            probs = jax.nn.softmax(cur[..., None], axis=-1)
+            attn = (probs[..., 0][..., None]
+                    * v[:, 0].astype(jnp.float32)[:, :, None, :]) \
+                .reshape(BATCH, 1, cfg.n_q_heads, cfg.head_dim) \
+                .astype(q.dtype)
+        elif variant.startswith("pool"):
+            from dynamo_tpu.ops.paged_attention import (
+                paged_attention_decode_pool,
+            )
+
+            ppc = int(variant[4:]) if len(variant) > 4 else 8
+            attn = paged_attention_decode_pool(
+                q, kv, len(ks) - 1, tables, kv_lens, k, v,
+                pages_per_chunk=ppc)
+        else:
+            layer_idx = len(ks) - 1
+            from dynamo_tpu.models.transformer import (
+                paged_attention_decode_xla,
+            )
+
+            attn = paged_attention_decode_xla(
+                q, kv, layer_idx, tables, kv_lens, k, v)
+        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        if variant != "no_mlp":
+            g = jnp.einsum("bth,hm->btm", h, lp["w_gate"])
+            u = jnp.einsum("bth,hm->btm", h, lp["w_up"])
+            x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u,
+                               lp["w_down"])
+    if variant != "no_write":
+        kv = write_kv_stack(kv, jnp.stack(ks), jnp.stack(vs), tables, pos2,
+                            jnp.ones((BATCH, 1), bool))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if variant == "no_head":
+        logits = jnp.pad(x[:, 0, :].astype(jnp.float32),
+                         ((0, 0), (0, cfg.vocab_size - cfg.hidden)))
+    else:
+        logits = jnp.einsum("bth,hv->btv", x,
+                            params["embed"].T).astype(jnp.float32)[:, 0]
+    return kv, logits
+
+
+def build(variant, k_steps):
+    def multi(params, kv, tokens, positions, tables, kv_lens, temp, top_p,
+              top_k, seeds, steps):
+        def body(carry, _):
+            kv, toks, pos, lens, sidx = carry
+            kv, logits = decode_step(params, kv, toks, pos, tables, lens,
+                                     variant)
+            nxt = sample(logits, temp, top_p, top_k, seeds, sidx)
+            return (kv, nxt, pos + 1, lens + 1, sidx + 1), nxt
+
+        (kv, *_), toks = jax.lax.scan(
+            body, (kv, tokens, positions, kv_lens, steps), None,
+            length=k_steps)
+        return kv, toks
+
+    return jax.jit(multi, donate_argnums=(1,))
+
+
+def main():
+    tables = np.zeros((BATCH, WIDTH), np.int32)
+    nxt = 1
+    for b in range(BATCH):
+        tables[b] = np.arange(nxt, nxt + WIDTH)
+        nxt += WIDTH
+    tables_j = jnp.asarray(tables)
+    kv_lens = jnp.full((BATCH,), WIDTH * PAGE_SIZE - K2 - 4, jnp.int32)
+    tokens = jnp.zeros((BATCH,), jnp.int32)
+    positions = kv_lens - 1
+    temp = jnp.zeros((BATCH,), jnp.float32)
+    top_p = jnp.ones((BATCH,), jnp.float32)
+    top_k = jnp.zeros((BATCH,), jnp.int32)
+    seeds = jnp.zeros((BATCH,), jnp.uint32)
+    steps = jnp.zeros((BATCH,), jnp.int32)
+
+    rtt = measure_rtt()
+    print(f"RTT {rtt:.1f} ms", flush=True)
+
+    for variant in VARIANTS:
+        try:
+            slopes = {}
+            for k in (K1, K2):
+                fn = build(variant, k)
+                kv = jax.jit(
+                    lambda: make_kv_cache(cfg, NUM_PAGES, PAGE_SIZE))()
+
+                def call(kv):
+                    kv, toks = fn(params, kv, tokens, positions, tables_j,
+                                  kv_lens, temp, top_p, top_k, seeds, steps)
+                    np.asarray(toks)
+                    return kv
+
+                kv = call(kv)  # compile + warm
+                n = 5
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    kv = call(kv)
+                slopes[k] = (time.perf_counter() - t0) / n * 1e3
+            per_step = (slopes[K2] - slopes[K1]) / (K2 - K1)
+            print(f"{variant:10s} k{K1}={slopes[K1]:7.1f} ms "
+                  f"k{K2}={slopes[K2]:7.1f} ms -> {per_step:6.3f} ms/step",
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(f"{variant:10s} FAILED {exc!r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

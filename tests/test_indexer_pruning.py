@@ -1,0 +1,188 @@
+"""Radix-index TTL expiry + size pruning, both backends (ref:
+lib/kv-router/src/indexer/pruning.rs PruneManager; concurrent_radix_tree.rs
+for the native tree's internal locking)."""
+
+import threading
+import time
+
+import pytest
+
+from dynamo_tpu.kv_router.indexer import (
+    NativeRadixTree,
+    RadixTree,
+    make_radix_tree,
+)
+from dynamo_tpu.kv_router.protocols import WorkerWithDpRank
+from dynamo_tpu.native import get_native
+
+W0 = WorkerWithDpRank(1, 0)
+W1 = WorkerWithDpRank(2, 0)
+
+BACKENDS = ["python"]
+if get_native() is not None:
+    BACKENDS.append("native")
+
+
+def _tree(backend, **kwargs):
+    if backend == "native":
+        return NativeRadixTree(get_native(), **kwargs)
+    return RadixTree(**kwargs)
+
+
+def _store(tree, worker, hashes, parent=None):
+    if isinstance(tree, NativeRadixTree):
+        tree._tree.apply_stored(worker.worker_id, worker.dp_rank, parent,
+                                list(hashes))
+    else:
+        tree._apply_stored(worker, parent, list(hashes))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestTtlExpiry:
+    def test_blocks_expire_after_ttl(self, backend):
+        tree = _tree(backend, ttl_secs=0.05)
+        _store(tree, W0, [1, 2, 3])
+        assert tree.find_matches([1, 2, 3]).scores == {W0: 3}
+        assert tree.maintain() == []  # not yet
+        time.sleep(0.08)
+        evicted = tree.maintain()
+        assert sorted(h for _, _, h in evicted) == [1, 2, 3]
+        assert all(wid == W0.worker_id for wid, _, _ in evicted)
+        assert tree.find_matches([1, 2, 3]).scores == {}
+
+    def test_restore_refreshes_ttl(self, backend):
+        tree = _tree(backend, ttl_secs=0.15)
+        _store(tree, W0, [1, 2])
+        time.sleep(0.09)
+        _store(tree, W0, [1, 2])  # re-store: TTL refreshed
+        time.sleep(0.09)  # 0.18 > ttl from FIRST store, < from second
+        assert tree.maintain() == []
+        assert tree.find_matches([1, 2]).scores == {W0: 2}
+
+    def test_expiry_is_per_worker(self, backend):
+        tree = _tree(backend, ttl_secs=0.1)
+        _store(tree, W0, [1, 2])
+        time.sleep(0.06)
+        _store(tree, W1, [1, 2])
+        time.sleep(0.06)  # W0's copy expired; W1's is fresh
+        evicted = tree.maintain()
+        assert {(wid, h) for wid, _, h in evicted} == {(1, 1), (1, 2)}
+        assert tree.find_matches([1, 2]).scores == {W1: 2}
+
+    def test_disabled_by_default(self, backend):
+        tree = _tree(backend)
+        _store(tree, W0, [1, 2])
+        time.sleep(0.02)
+        assert tree.maintain() == []
+        assert tree.find_matches([1, 2]).scores == {W0: 2}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestSizePruning:
+    def test_prunes_oldest_down_to_target(self, backend):
+        tree = _tree(backend, ttl_secs=300.0, max_tree_size=10)
+        # 16 single-block chains, oldest first
+        for i in range(16):
+            _store(tree, W0, [100 + i])
+            time.sleep(0.002)  # strictly increasing expiries
+        assert tree.total_nodes() == 16
+        evicted = tree.maintain()
+        # prune down to 0.8 * 10 = 8 nodes, oldest first
+        assert tree.total_nodes() == 8
+        evicted_hashes = sorted(h for _, _, h in evicted)
+        assert evicted_hashes == [100 + i for i in range(8)]
+        # newest survive
+        assert tree.find_matches([115]).scores == {W0: 1}
+
+    def test_under_budget_untouched(self, backend):
+        tree = _tree(backend, ttl_secs=300.0, max_tree_size=10)
+        for i in range(5):
+            _store(tree, W0, [200 + i])
+        assert tree.maintain() == []
+        assert tree.total_nodes() == 5
+
+    def test_size_pruning_works_without_ttl(self, backend):
+        """max_tree_size alone must prune (TTL and size budgets are
+        independent knobs)."""
+        tree = _tree(backend, max_tree_size=10)
+        for i in range(16):
+            _store(tree, W0, [300 + i])
+            time.sleep(0.002)
+        evicted = tree.maintain()
+        assert tree.total_nodes() == 8
+        assert sorted(h for _, _, h in evicted) == [300 + i
+                                                    for i in range(8)]
+
+    def test_expiry_applied_before_size_check(self, backend):
+        """A sweep whose TTL expiry already brings the tree under budget
+        must not additionally prune live blocks."""
+        tree = _tree(backend, ttl_secs=0.05, max_tree_size=10)
+        for i in range(8):  # these will expire
+            _store(tree, W0, [400 + i])
+        time.sleep(0.08)
+        for i in range(7):  # fresh: under budget after expiry
+            _store(tree, W1, [500 + i])
+        evicted = tree.maintain()
+        # only the 8 expired go; the 7 fresh survive (12 > 10 pre-expiry,
+        # 7 <= 10 post-expiry)
+        assert sorted(h for _, _, h in evicted) == [400 + i
+                                                    for i in range(8)]
+        assert tree.total_nodes() == 7
+
+
+@pytest.mark.skipif(get_native() is None, reason="native core not built")
+class TestNativeConcurrency:
+    def test_parallel_match_and_mutate(self):
+        """The native tree locks internally and releases the GIL: threads
+        hammering reads+writes concurrently must neither crash nor corrupt
+        counts (the ConcurrentRadixTree contract)."""
+        tree = NativeRadixTree(get_native(), ttl_secs=60.0)
+        stop = threading.Event()
+        errors = []
+
+        def writer(wid):
+            try:
+                i = 0
+                while not stop.is_set():
+                    w = WorkerWithDpRank(wid, 0)
+                    _store(tree, w, [wid * 10_000 + (i % 50) * 3 + j
+                                     for j in range(3)])
+                    if i % 7 == 0:
+                        tree.remove_worker(w)
+                    i += 1
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    tree.find_matches([1, 2, 3])
+                    tree.total_nodes()
+                    tree.maintain()
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(3)]
+        threads += [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        # full cleanup must leave a consistent empty-ish index
+        for w in range(3):
+            tree.remove_worker(WorkerWithDpRank(w, 0))
+        assert all(c == 0 for c in tree.worker_block_counts().values())
+
+
+class TestFactoryKnobs:
+    def test_env_knobs_flow_through(self, monkeypatch):
+        monkeypatch.setenv("DYNT_INDEXER_TTL_SECS", "0.05")
+        monkeypatch.setenv("DYNT_INDEXER_MAX_TREE_SIZE", "64")
+        tree = make_radix_tree()
+        _store(tree, W0, [7])
+        time.sleep(0.08)
+        assert [(wid, h) for wid, _, h in tree.maintain()] == [(1, 7)]

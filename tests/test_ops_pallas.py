@@ -119,6 +119,221 @@ class TestPagedDecodeAttention:
                                    rtol=2e-5, atol=2e-5)
 
 
+class TestPagedAttentionDecodeFused:
+    """The deferred-write Pallas path (history partials + in-register
+    current token) vs paged_attention_decode_xla as oracle."""
+
+    def _case(self, b=4, qh=8, kh=4, hd=64, ps=8, n_pages=32, max_pages=6,
+              seed=3, dtype=jnp.float32, min_len=1):
+        rng = np.random.default_rng(seed)
+        L = 2
+        kv_cache = jnp.asarray(
+            rng.normal(size=(L, 2, n_pages, ps, kh, hd)), dtype)
+        q = jnp.asarray(rng.normal(size=(b, 1, qh, hd)), dtype)
+        k_cur = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), dtype)
+        v_cur = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), dtype)
+        ids = rng.permutation(n_pages - 1)[: b * max_pages] \
+            .reshape(b, max_pages)
+        bt = jnp.asarray(ids + 1, jnp.int32) % n_pages
+        # kv_lens INCLUDE the current token
+        kl = jnp.asarray(
+            rng.integers(min_len, ps * max_pages, size=b), jnp.int32)
+        return q, kv_cache, bt, kl, k_cur, v_cur
+
+    def test_matches_xla_deferred_path(self):
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_fused,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case()
+        for layer in (0, 1):
+            got = paged_attention_decode_fused(
+                q, kv, layer, bt, kl, kc, vc, interpret=True)
+            want = paged_attention_decode_xla(q, kv, layer, bt, kl, kc, vc)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_first_token_no_history(self):
+        """kv_len == 1: only the in-register current token attends (the
+        kernel's history pass sees zero tokens -> m=-inf branch)."""
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_fused,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case()
+        kl = jnp.ones_like(kl)
+        got = paged_attention_decode_fused(
+            q, kv, 0, bt, kl, kc, vc, interpret=True)
+        want = paged_attention_decode_xla(q, kv, 0, bt, kl, kc, vc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # degenerate case is exactly v_cur
+        np.testing.assert_allclose(np.asarray(got)[:, 0, 0],
+                                   np.asarray(vc)[:, 0, 0],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_fused,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case(dtype=jnp.bfloat16)
+        got = paged_attention_decode_fused(
+            q, kv, 0, bt, kl, kc, vc, interpret=True)
+        want = paged_attention_decode_xla(q, kv, 0, bt, kl, kc, vc)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_forward_decode_with_fused_kernel_matches_xla(self):
+        """Whole forward_decode equality: kernel path vs XLA path on a
+        real model config and populated cache."""
+        import functools
+
+        from dynamo_tpu.models import get_config, init_params, make_kv_cache
+        from dynamo_tpu.models.transformer import forward_decode
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_fused,
+        )
+
+        cfg = get_config("tiny-test")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        kv = make_kv_cache(cfg, 32, 4)
+        kv = jnp.asarray(rng.normal(size=kv.shape), kv.dtype)
+        b = 2
+        bt = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 0]], jnp.int32)
+        kv_lens = jnp.asarray([7, 11], jnp.int32)
+        tokens = jnp.asarray([3, 5], jnp.int32)
+        positions = kv_lens - 1
+        active = jnp.ones((b,), bool)
+
+        kv_x, logits_x = forward_decode(params, cfg, tokens, positions, kv,
+                                        bt, kv_lens, active)
+        kv_p, logits_p = forward_decode(
+            params, cfg, tokens, positions, kv, bt, kv_lens, active,
+            decode_attention_fn=functools.partial(
+                paged_attention_decode_fused, interpret=True))
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(logits_x),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(kv_p, np.float32), np.asarray(kv_x, np.float32),
+            rtol=1e-5, atol=1e-5)
+
+
+class TestPagedAttentionDecodePool:
+    """The production TPU decode path: whole-pool chunked-DMA kernel
+    (paged_decode_attention_pool + combine) vs paged_attention_decode_xla
+    as oracle, across layers, chunk sizes, history lengths, and dtypes."""
+
+    def _case(self, b=4, qh=8, kh=4, hd=64, ps=8, n_pages=32, max_pages=6,
+              seed=5, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        L = 2
+        kv = jnp.asarray(rng.normal(size=(L, 2, n_pages, ps, kh, hd)),
+                         dtype)
+        q = jnp.asarray(rng.normal(size=(b, 1, qh, hd)), dtype)
+        kc = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), dtype)
+        vc = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), dtype)
+        ids = rng.permutation(n_pages - 1)[: b * max_pages] \
+            .reshape(b, max_pages)
+        bt = jnp.asarray(ids + 1, jnp.int32) % n_pages
+        kl = jnp.asarray(rng.integers(1, ps * max_pages, size=b),
+                         jnp.int32)
+        return q, kv, bt, kl, kc, vc
+
+    @pytest.mark.parametrize("ppc", [1, 2, 3, 6])
+    def test_matches_xla_across_chunk_sizes(self, ppc):
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_pool,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case()
+        for layer in (0, 1):
+            got = paged_attention_decode_pool(
+                q, kv, layer, bt, kl, kc, vc, pages_per_chunk=ppc,
+                interpret=True)
+            want = paged_attention_decode_xla(q, kv, layer, bt, kl, kc, vc)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_zero_history_and_mixed_lengths(self):
+        """kv_len == 1 slots (no history: kernel never DMAs for them) mixed
+        with long ones — the next_active skip logic must not corrupt
+        neighbours."""
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_pool,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case()
+        kl = jnp.asarray([1, 47, 1, 13], jnp.int32)
+        got = paged_attention_decode_pool(q, kv, 0, bt, kl, kc, vc,
+                                          pages_per_chunk=2, interpret=True)
+        want = paged_attention_decode_xla(q, kv, 0, bt, kl, kc, vc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # zero-history rows degenerate to exactly v_cur
+        for row in (0, 2):
+            np.testing.assert_allclose(
+                np.asarray(got)[row, 0].reshape(4, 2, -1)[:, 0],
+                np.asarray(vc)[row, 0], rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        from dynamo_tpu.models.transformer import paged_attention_decode_xla
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_pool,
+        )
+
+        q, kv, bt, kl, kc, vc = self._case(dtype=jnp.bfloat16)
+        got = paged_attention_decode_pool(q, kv, 1, bt, kl, kc, vc,
+                                          pages_per_chunk=3, interpret=True)
+        want = paged_attention_decode_xla(q, kv, 1, bt, kl, kc, vc)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_forward_decode_with_pool_kernel_matches_xla(self):
+        """Whole forward_decode equality on a real model config — the
+        integration the runner wires on TPU."""
+        import functools
+
+        from dynamo_tpu.models import get_config, init_params, make_kv_cache
+        from dynamo_tpu.models.transformer import forward_decode
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_pool,
+        )
+
+        cfg = get_config("tiny-test")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        kv = make_kv_cache(cfg, 32, 4)
+        kv = jnp.asarray(rng.normal(size=kv.shape), kv.dtype)
+        bt = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 0]], jnp.int32)
+        kv_lens = jnp.asarray([7, 11], jnp.int32)
+        tokens = jnp.asarray([3, 5], jnp.int32)
+        active = jnp.ones((2,), bool)
+
+        kv_x, logits_x = forward_decode(params, cfg, tokens, kv_lens - 1,
+                                        kv, bt, kv_lens, active)
+        kv_p, logits_p = forward_decode(
+            params, cfg, tokens, kv_lens - 1, kv, bt, kv_lens, active,
+            decode_attention_fn=functools.partial(
+                paged_attention_decode_pool, pages_per_chunk=2,
+                interpret=True))
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(logits_x),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(kv_p, np.float32), np.asarray(kv_x, np.float32),
+            rtol=1e-5, atol=1e-5)
+
+
 class TestBlockCopy:
     def _cache(self, L=2, P=16, ps=4, kh=2, hd=8, seed=0):
         rng = np.random.default_rng(seed)
