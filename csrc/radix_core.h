@@ -216,23 +216,19 @@ struct PruneManager {
     return out;
   }
 
-  // Oldest-expiry blocks beyond the size budget (approximate LRU: refresh
-  // on re-store pushes hot blocks to the back of the line).
-  std::vector<BlockKey> prune(size_t current_size) {
-    std::vector<BlockKey> out;
-    if (max_tree_size == 0 || current_size <= max_tree_size) return out;
-    size_t target = (size_t)(max_tree_size * prune_target_ratio);
-    size_t want = current_size - target;
-    while (out.size() < want && !expirations.empty()) {
+  // Pop the single oldest valid (non-stale) entry; false when exhausted.
+  bool pop_oldest(BlockKey* out) {
+    while (!expirations.empty()) {
       HeapEntry e = expirations.top();
       expirations.pop();
       auto it = timers.find(e.key);
       if (it != timers.end() && it->second == e.expiry) {
         timers.erase(it);
-        out.push_back(e.key);
+        *out = e.key;
+        return true;
       }
     }
-    return out;
+    return false;
   }
 };
 
@@ -303,20 +299,33 @@ struct ConcurrentTree {
   // TTL expiry + size pruning in one sweep; returns what was evicted so the
   // caller can surface metrics/events. Expiry is APPLIED before the size
   // check — pruning against the pre-expiry count would evict live blocks a
-  // sweep that just freed enough room.
+  // sweep that just freed enough room. Size pruning evicts per-(worker,
+  // hash) entries but tracks the NODE count after each removal: a hash
+  // replicated across workers only drops its node when the last holder is
+  // evicted, so the loop runs until the tree actually reaches target (or
+  // the heap is exhausted).
   std::vector<BlockKey> maintain(uint64_t now_ms) {
-    std::unique_lock<std::shared_mutex> lk(mu);
+    // Config fields are immutable after construction: the disabled check
+    // must not grab the writer lock (it would contend the router's hot
+    // find_matches read path once a second for nothing).
     if (!tracking_enabled()) return {};
+    std::unique_lock<std::shared_mutex> lk(mu);
     std::vector<BlockKey> evicted;
     if (ttl_enabled()) {
       evicted = pruner.pop_expired(now_ms);
       for (const BlockKey& k : evicted)
         tree.apply_removed(k.worker, {k.hash});
     }
-    std::vector<BlockKey> pruned = pruner.prune(tree.nodes.size());
-    for (const BlockKey& k : pruned)
-      tree.apply_removed(k.worker, {k.hash});
-    evicted.insert(evicted.end(), pruned.begin(), pruned.end());
+    if (pruner.max_tree_size > 0 &&
+        tree.nodes.size() > pruner.max_tree_size) {
+      size_t target =
+          (size_t)(pruner.max_tree_size * pruner.prune_target_ratio);
+      BlockKey k;
+      while (tree.nodes.size() > target && pruner.pop_oldest(&k)) {
+        tree.apply_removed(k.worker, {k.hash});
+        evicted.push_back(k);
+      }
+    }
     return evicted;
   }
 

@@ -162,8 +162,14 @@ void prune_manager_checks() {
   pm.insert(young, 60);
   auto expired = pm.pop_expired(110);
   assert(expired.size() == 10);  // the unrefreshed half
-  auto pruned = pm.prune(15);    // 15 > 10 -> prune to 5, oldest first
-  assert(pruned.size() == 10);   // 15 - 10*0.5
+  // pop_oldest drains exactly the surviving (refreshed) half
+  dynamo_native::BlockKey k;
+  size_t popped = 0;
+  while (pm.pop_oldest(&k)) {
+    assert(k.hash >= 10);  // only refreshed keys survive
+    popped++;
+  }
+  assert(popped == 10);
   assert(pm.pop_expired(1000).size() == 0);  // everything accounted for
   std::printf("prune manager checks ok\n");
 }

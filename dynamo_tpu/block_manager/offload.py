@@ -103,7 +103,9 @@ class OffloadManager:
         def gather_on_sched():
             # Resolve hash->page at gather time ON the scheduler thread:
             # eviction also only runs there, so the mapping cannot go stale
-            # between lookup and gather.
+            # between lookup and gather. Only the DEVICE gather runs here
+            # (a fresh buffer, microseconds); the D2H copy happens below on
+            # THIS offload thread so decode stepping overlaps the transfer.
             pages = self._lookup(hashes)
             keep = [i for i, p in enumerate(pages) if p is not None]
             if not keep:
@@ -121,6 +123,9 @@ class OffloadManager:
             keep, bundle = result
         if bundle is None:
             return
+        # The slow half, off the step thread: one contiguous D2H of the
+        # whole bundle (np.asarray of a device array), then per-block sink.
+        bundle = np.asarray(bundle)
         for j, i in enumerate(keep):
             h, parent = batch[i]
             self._sink(h, np.asarray(bundle[j]), parent)

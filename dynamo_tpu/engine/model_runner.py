@@ -681,13 +681,26 @@ class ModelRunner:
         self._zero_embeds = {}
         log.info("resharded onto mesh %s", dict(mesh.shape))
 
+    def gather_pages_device(self, page_ids: np.ndarray):
+        """Device-side page gather into a FRESH bundle [n, L, 2, ps, kh,
+        hd]. Must run on the scheduler thread (the pool is donated through
+        every step) — but it is the CHEAP half: the returned buffer is
+        independent of the pool, so the caller does the slow D2H copy
+        (np.asarray) off-thread and decode stepping overlaps the transfer
+        (ref concern: SURVEY §7 host<->HBM bandwidth discipline; VERDICT
+        'transfer steals decode step time')."""
+        from ..ops.block_copy import gather_kv_blocks
+
+        return gather_kv_blocks(self.kv_cache,
+                                jnp.asarray(page_ids, jnp.int32))
+
     def gather_pages(self, page_ids: np.ndarray) -> np.ndarray:
         """Pull pages to host in universal layout [n, L, 2, ps, kh, hd]
         (disagg prefill export / KVBM offload). Must run on the scheduler
-        thread — the KV cache buffer is donated through every step."""
-        from ..ops.block_copy import gather_to_host
-
-        return gather_to_host(self.kv_cache, np.asarray(page_ids, np.int32))
+        thread — the KV cache buffer is donated through every step.
+        Prefer gather_pages_device + off-thread readback in transfer
+        paths."""
+        return np.asarray(jax.device_get(self.gather_pages_device(page_ids)))
 
     def scatter_pages(self, page_ids: np.ndarray, blocks) -> None:
         """Write a block bundle into pool pages (disagg decode onboard /

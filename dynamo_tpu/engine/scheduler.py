@@ -115,7 +115,7 @@ class InferenceScheduler:
         if kvbm is not None:
             kvbm.attach_engine(
                 lookup_pages=lambda hs: [self.pool.lookup(h) for h in hs],
-                gather=runner.gather_pages,
+                gather=runner.gather_pages_device,
                 run_in_step=self.run_in_step,
             )
         self.max_batch = cfg.max_batch

@@ -564,19 +564,27 @@ class TpuWorker:
             return
         try:
             page_ids = transfer.page_ids
+            # Only the device gather holds the step thread; the D2H copy
+            # of the bundle runs in a worker thread so decode keeps
+            # stepping while the transfer drains (VERDICT: transfers must
+            # not steal decode step time).
             resultq = self.scheduler.run_in_step(
-                lambda: self.runner.gather_pages(page_ids)
+                lambda: self.runner.gather_pages_device(page_ids)
             )
             try:
                 # Bounded wait: if the scheduler is shutting down the final
                 # control drain runs the gather, but never hang the handler.
-                blocks, exc = await asyncio.to_thread(resultq.get, True, 60.0)
+                device_blocks, exc = await asyncio.to_thread(
+                    resultq.get, True, 60.0)
             except Exception as exc_:  # noqa: BLE001 — queue.Empty on timeout
                 yield {"error": f"gather timed out: {exc_!r}"}
                 return
             if exc is not None:
                 yield {"error": f"gather failed: {exc!r}"}
                 return
+            import numpy as _np
+
+            blocks = await asyncio.to_thread(_np.asarray, device_blocks)
             for frame in encode_block_chunks(blocks, transfer.layout):
                 yield frame
         finally:
@@ -637,7 +645,24 @@ class TpuWorker:
             log.warning("kv pull incomplete; recomputing prefill")
             return None
         blocks, _ = assembler.assemble()
-        return blocks
+        # Stage the H2D copy HERE (async context, off the step thread) so
+        # admission's scatter only does the cheap fused write — the bulk
+        # upload overlaps decode stepping. Failure falls back to the host
+        # bundle (scatter_from_host does its own device_put in-step).
+        try:
+            import jax as _jax
+
+            from .ici_transfer import bundle_sharding
+
+            dev = _jax.device_put(
+                blocks, bundle_sharding(
+                    self.runner.mesh,
+                    head_sharded=not self.runner.model_config.is_mla))
+            await asyncio.to_thread(_jax.block_until_ready, dev)
+            return dev
+        except Exception:  # noqa: BLE001 — host bundle still works
+            log.exception("onboard H2D staging failed; using host bundle")
+            return blocks
 
     async def _event_drain(self, publisher, interval: float = 0.05) -> None:
         self._drain_ticks = 0

@@ -46,6 +46,12 @@ class RadixTree:
     # -- TTL / size pruning -------------------------------------------------
 
     @property
+    def prune_tracking(self) -> bool:
+        """True when TTL/size pruning is configured (sweep loops skip the
+        1 Hz maintain() calls entirely otherwise)."""
+        return bool(self._ttl or self._max_tree_size)
+
+    @property
     def _tracking(self) -> bool:
         # TTL and size budgets are independent; size-only configs still
         # need the timer heap for oldest-first prune order.
@@ -101,16 +107,17 @@ class RadixTree:
                     evicted.append((worker.worker_id, worker.dp_rank, h))
                     self._apply_removed(worker, [h])
         if self._max_tree_size and len(self._nodes) > self._max_tree_size:
+            # Evict per-(worker, hash) entries but track the NODE count: a
+            # hash replicated across workers only drops its node when the
+            # last holder goes, so loop until the tree actually reaches
+            # target (or the heap is exhausted).
             target = int(self._max_tree_size * self._prune_target_ratio)
-            want = len(self._nodes) - target
-            pruned = 0
-            while pruned < want and self._expirations:
+            while len(self._nodes) > target and self._expirations:
                 hit = _pop_valid()
                 if hit is not None:
                     h, worker = hit
                     evicted.append((worker.worker_id, worker.dp_rank, h))
                     self._apply_removed(worker, [h])
-                    pruned += 1
         return evicted
 
     # -- queries -----------------------------------------------------------
@@ -292,6 +299,7 @@ class NativeRadixTree:
         self._tree = native_mod.RadixTree(
             ttl_secs=ttl_secs, max_tree_size=max_tree_size,
             prune_target_ratio=prune_target_ratio)
+        self.prune_tracking = bool(ttl_secs or max_tree_size)
         self._last_event_id: dict[WorkerWithDpRank, int] = {}
         self.gap_count = 0
 
@@ -407,7 +415,7 @@ def sweep_tree(tree, name: str, log) -> None:
     discipline (used by the standalone indexer service and the frontend
     manager's periodic loops)."""
     maintain = getattr(tree, "maintain", None)
-    if maintain is None:
+    if maintain is None or not getattr(tree, "prune_tracking", True):
         return
     try:
         evicted = maintain()

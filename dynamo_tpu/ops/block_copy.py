@@ -57,13 +57,6 @@ def swap_kv_blocks(
     return kv_cache.at[:, :, dst_ids].set(moved)
 
 
-def gather_to_host(kv_cache: jax.Array, page_ids: np.ndarray) -> np.ndarray:
-    """Device -> host offload of pages (KVBM G1 -> G2). The gather runs on
-    device (one fused DMA program), then a single contiguous D2H copy."""
-    bundle = gather_kv_blocks(kv_cache, jnp.asarray(page_ids, jnp.int32))
-    return np.asarray(jax.device_get(bundle))
-
-
 def scatter_from_host(
     kv_cache: jax.Array, page_ids: np.ndarray, blocks: np.ndarray
 ) -> jax.Array:

@@ -150,10 +150,12 @@ class _Collect:
         return None
 
 
-def _run_scheduler(decode_block, max_tokens=9, eos=None, n_requests=1):
+def _run_scheduler(decode_block, max_tokens=9, eos=None, n_requests=1,
+                   pipeline=1):
     runner = _runner()
     sched = InferenceScheduler(runner)
     sched.decode_block = decode_block
+    sched.decode_pipeline = pipeline
     sched.start()
     collectors = []
     try:
@@ -202,3 +204,34 @@ def test_scheduler_block_mode_eos_mid_block():
     b4 = _run_scheduler(4, max_tokens=12, eos=eos)
     assert b1[0].tokens() == b4[0].tokens() == toks[: first_eos + 1]
     assert b1[0].finish == b4[0].finish == "stop"
+
+
+def test_scheduler_pipelined_blocks_stream_identical():
+    """Depth-2 pipelined dispatch (device-chained tokens, speculative
+    second block) must produce byte-identical streams to per-token mode."""
+    base = _run_scheduler(1, max_tokens=17, n_requests=2)
+    piped = _run_scheduler(4, max_tokens=17, n_requests=2, pipeline=2)
+    for c1, c2 in zip(base, piped):
+        assert c1.finish == c2.finish == "length"
+        assert c1.tokens() == c2.tokens()
+
+
+def test_scheduler_pipelined_eos_mid_first_block():
+    """EOS inside block d while block d+1 was already dispatched: the
+    speculated tokens must be discarded and the stream match exactly."""
+    base = _run_scheduler(1, max_tokens=16, eos=None)
+    toks = base[0].tokens()
+    eos = toks[2]
+    first_eos = toks.index(eos)
+    piped = _run_scheduler(4, max_tokens=16, eos=eos, pipeline=2)
+    assert piped[0].tokens() == toks[: first_eos + 1]
+    assert piped[0].finish == "stop"
+
+
+def test_scheduler_pipeline_depth_reduced_near_budget():
+    """max_tokens < depth*block: the scheduler must degrade to depth 1 /
+    block 1 rather than write past the token budget."""
+    base = _run_scheduler(1, max_tokens=6, n_requests=1)
+    piped = _run_scheduler(4, max_tokens=6, n_requests=1, pipeline=2)
+    assert piped[0].tokens() == base[0].tokens()
+    assert piped[0].finish == "length"

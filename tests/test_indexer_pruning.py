@@ -101,6 +101,19 @@ class TestSizePruning:
         assert tree.maintain() == []
         assert tree.total_nodes() == 5
 
+    def test_prune_converges_with_replicated_blocks(self, backend):
+        """Hashes held by MULTIPLE workers: node count only drops when the
+        last holder is evicted — the sweep must still reach the target in
+        ONE maintain() call (node-count-driven loop)."""
+        tree = _tree(backend, ttl_secs=300.0, max_tree_size=10)
+        for i in range(16):
+            _store(tree, W0, [600 + i])
+            _store(tree, W1, [600 + i])  # replicate on a second worker
+            time.sleep(0.002)
+        assert tree.total_nodes() == 16
+        tree.maintain()
+        assert tree.total_nodes() == 8  # one sweep reaches the target
+
     def test_size_pruning_works_without_ttl(self, backend):
         """max_tree_size alone must prune (TTL and size budgets are
         independent knobs)."""
