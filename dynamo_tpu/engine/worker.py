@@ -584,7 +584,14 @@ class TpuWorker:
                 return
             import numpy as _np
 
-            blocks = await asyncio.to_thread(_np.asarray, device_blocks)
+            try:
+                # Async dispatch means a failed device gather can surface
+                # only here, at materialization: keep the structured error
+                # contract of the other failure paths.
+                blocks = await asyncio.to_thread(_np.asarray, device_blocks)
+            except Exception as exc_:  # noqa: BLE001
+                yield {"error": f"gather readback failed: {exc_!r}"}
+                return
             for frame in encode_block_chunks(blocks, transfer.layout):
                 yield frame
         finally:

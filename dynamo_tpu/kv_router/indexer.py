@@ -49,7 +49,7 @@ class RadixTree:
     def prune_tracking(self) -> bool:
         """True when TTL/size pruning is configured (sweep loops skip the
         1 Hz maintain() calls entirely otherwise)."""
-        return bool(self._ttl or self._max_tree_size)
+        return self._tracking
 
     @property
     def _tracking(self) -> bool:
