@@ -238,14 +238,56 @@ async def main(argv: Optional[list[str]] = None) -> None:
     from ..runtime.signals import wait_for_shutdown_signal
 
     parser = argparse.ArgumentParser("dynamo_tpu.deploy")
-    parser.add_argument("--spec", required=True, help="deployment YAML")
+    parser.add_argument("--spec", help="deployment YAML")
     parser.add_argument("--log-dir", default=None)
     parser.add_argument("--emit-k8s", action="store_true",
                         help="print Kubernetes manifests and exit")
     parser.add_argument("--follow-planner", action="store_true",
                         help="apply VirtualConnector scaling decisions "
                              "from discovery")
+    # DGDR mode (ref: operator DynamoGraphDeploymentRequest flow): run the
+    # request controller against the discovery plane, or submit/query one.
+    parser.add_argument("--dgdr-controller", action="store_true",
+                        help="run the DGDR controller (watches v1/dgdr/)")
+    parser.add_argument("--dgdr-submit", default=None, metavar="JSON",
+                        help='submit a request, e.g. \'{"name":"d1",'
+                             '"model":"qwen3-0.6b","itl_ms":20}\'')
+    parser.add_argument("--dgdr-status", default=None, metavar="NAME",
+                        help="print a request's phase/status and exit")
     args = parser.parse_args(argv)
+
+    if args.dgdr_controller or args.dgdr_submit or args.dgdr_status:
+        from .dgdr import (
+            DeploymentRequest,
+            DgdrController,
+            get_status,
+            submit_request,
+        )
+
+        runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+        try:
+            if args.dgdr_submit:
+                req = DeploymentRequest.from_wire(json.loads(args.dgdr_submit))
+                await submit_request(runtime, req)
+                print(json.dumps({"submitted": req.name}))
+                return
+            if args.dgdr_status:
+                print(json.dumps(await get_status(runtime,
+                                                  args.dgdr_status)))
+                return
+            dgdr = DgdrController(runtime, log_dir=args.log_dir)
+            await dgdr.start()
+            log.info("dgdr controller watching %s", "v1/dgdr/")
+            try:
+                await wait_for_shutdown_signal()
+            finally:
+                await dgdr.close()
+        finally:
+            await runtime.shutdown()
+        return
+
+    if not args.spec:
+        parser.error("--spec is required (or use a --dgdr-* mode)")
     spec = GraphDeploymentSpec.from_yaml(args.spec)
     if args.emit_k8s:
         from .manifests import render_k8s_manifests

@@ -1,0 +1,331 @@
+"""DGDR flow: declarative deployment REQUESTS reconciled to running graphs.
+
+The reference's operator accepts a DynamoGraphDeploymentRequest (model +
+SLA + workload), runs a profiling job, generates a DynamoGraphDeployment,
+and reconciles it through phases Pending → Profiling → Ready → Deploying →
+Deployed/Failed (ref: deploy/operator/api/v1beta1/
+dynamographdeploymentrequest_types.go DGDRPhase*, internal/controller/
+dynamographdeploymentrequest_controller.go profiling job → final_config).
+
+TPU-native shape: the "CRD store" IS the discovery plane — requests are
+documents under `v1/dgdr/{name}`, the controller holds a prefix watch, and
+status goes to `v1/dgdr_status/{name}`. With the etcd backend this is a
+real in-cluster control loop (watch + reconcile against cluster state);
+with mem/file it drives tests and single-host deployments unchanged.
+Profiling uses the analytic TPU timing model (profiler/timing_model.py) to
+pick the cheapest tp × replicas meeting the SLA within the chip budget —
+the rapid-profile analog of the reference's sweep job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+from typing import Callable, Optional
+
+from ..models import get_config
+from ..profiler.chips import get_chip
+from ..profiler.timing_model import TimingModel
+from ..runtime.logging import get_logger
+from .controller import LocalDeploymentController
+from .spec import GraphDeploymentSpec, ServiceSpec
+
+log = get_logger("deploy.dgdr")
+
+DGDR_PREFIX = "v1/dgdr/"
+DGDR_STATUS_PREFIX = "v1/dgdr_status/"
+
+# Lifecycle phases (ref: DGDRPhase* in dynamographdeploymentrequest_types.go)
+PENDING = "Pending"
+PROFILING = "Profiling"
+READY = "Ready"
+DEPLOYING = "Deploying"
+DEPLOYED = "Deployed"
+FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class DeploymentRequest:
+    """The DGDR document: what to serve and how well, not how."""
+
+    name: str
+    model: str
+    chip: str = "v5e"
+    max_chips: int = 8
+    # SLA targets (ref: SLASpec ttft/itl)
+    ttft_ms: float = 2000.0
+    itl_ms: float = 50.0
+    # workload characteristics (ref: WorkloadSpec)
+    isl: int = 1024
+    osl: int = 256
+    concurrency: int = 8
+    # engine kind for generated workers: worker (real) | mocker (tests/sim)
+    engine: str = "worker"
+    env: dict = dataclasses.field(default_factory=dict)
+    frontend_port: int = 8000
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "DeploymentRequest":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in fields})
+
+
+@dataclasses.dataclass
+class ProfileResult:
+    tp: int
+    replicas: int
+    total_chips: int
+    est_ttft_ms: float
+    est_itl_ms: float
+    batch_per_replica: int
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def profile_request(req: DeploymentRequest) -> ProfileResult:
+    """Pick the cheapest (tp, replicas) meeting the SLA within the chip
+    budget — the rapid analog of the reference's profiling job (sweep →
+    filter against SLA → most cost-efficient config)."""
+    try:
+        model = get_config(req.model)
+    except KeyError:
+        if req.engine != "mocker":
+            raise
+        # The mocker simulates arbitrary model names; plan against the
+        # tiny preset (the SLA math only sizes the simulated fleet).
+        model = get_config("tiny-test")
+    chip = get_chip(req.chip)
+    context = req.isl + req.osl // 2
+    best: Optional[ProfileResult] = None
+    tp = 1
+    while tp <= req.max_chips:
+        tm = TimingModel(model=model, chip=chip, num_chips=tp)
+        ttft = tm.prefill_ttft_ms(req.isl)
+        if ttft <= req.ttft_ms:
+            # largest batch whose ITL stays within SLA and whose KV fits
+            max_kv = tm.max_kv_tokens()
+            batch_cap = max(0, min(
+                int(max_kv // max(context, 1)),
+                req.concurrency,
+            ))
+            batch = 0
+            for b in range(batch_cap, 0, -1):
+                if tm.decode_itl_ms(b, context) <= req.itl_ms:
+                    batch = b
+                    break
+            if batch > 0:
+                replicas = math.ceil(req.concurrency / batch)
+                total = replicas * tp
+                if total <= req.max_chips:
+                    cand = ProfileResult(
+                        tp=tp, replicas=replicas, total_chips=total,
+                        est_ttft_ms=round(ttft, 3),
+                        est_itl_ms=round(tm.decode_itl_ms(batch, context),
+                                         3),
+                        batch_per_replica=batch,
+                    )
+                    if best is None or cand.total_chips < best.total_chips:
+                        best = cand
+        tp *= 2
+    if best is None:
+        raise ValueError(
+            f"no (tp<=TP, replicas) within {req.max_chips} {req.chip} "
+            f"chips meets SLA ttft<={req.ttft_ms}ms itl<={req.itl_ms}ms "
+            f"for {req.model} at isl={req.isl} concurrency="
+            f"{req.concurrency}")
+    return best
+
+
+def generate_spec(req: DeploymentRequest,
+                  profile: ProfileResult) -> GraphDeploymentSpec:
+    """DGDR + profile -> the concrete graph (the generated DGD)."""
+    services = {
+        "frontend": ServiceSpec(
+            name="frontend", kind="frontend", replicas=1,
+            args=["--port", str(req.frontend_port),
+                  "--router-mode", "kv"],
+        ),
+    }
+    # The SLA plan is only real if the engine ENFORCES the profiled batch:
+    # a worker left at its default --max-batch would blow the ITL target
+    # (or cap below the planned concurrency share).
+    if req.engine == "mocker":
+        services["decode"] = ServiceSpec(
+            name="decode", kind="mocker", replicas=profile.replicas,
+            args=["--model-name", req.model, "--speedup-ratio", "100.0",
+                  "--max-batch", str(profile.batch_per_replica)],
+        )
+    else:
+        services["decode"] = ServiceSpec(
+            name="decode", kind="worker", replicas=profile.replicas,
+            args=["--model", req.model, "--tp", str(profile.tp),
+                  "--max-batch", str(profile.batch_per_replica)],
+        )
+    return GraphDeploymentSpec(name=req.name, env=dict(req.env),
+                               services=services)
+
+
+class DgdrController:
+    """Watches `v1/dgdr/` and reconciles each request through the DGDR
+    phase machine; deployments are realized by LocalDeploymentController
+    (process level — the k8s manifests renderer shares the same generated
+    spec). Spec UPDATES roll through: replica-only changes scale in place;
+    arg/env changes restart the deployment's changed services."""
+
+    def __init__(self, runtime,
+                 controller_factory: Optional[Callable] = None,
+                 log_dir: Optional[str] = None) -> None:
+        self.runtime = runtime
+        self._factory = controller_factory or (
+            lambda spec: LocalDeploymentController(
+                spec, runtime=runtime, log_dir=log_dir,
+                reconcile_interval=0.5))
+        self.deployments: dict[str, LocalDeploymentController] = {}
+        self.specs: dict[str, GraphDeploymentSpec] = {}
+        self.profiles: dict[str, ProfileResult] = {}
+        self._phase: dict[str, str] = {}  # in-memory mirror of status
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+        self._status_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._watch = await self.runtime.discovery.watch_prefix(
+            DGDR_PREFIX, include_existing=True)
+        self._task = asyncio.create_task(self._watch_loop())
+        self._status_task = asyncio.create_task(self._status_loop())
+
+    async def close(self) -> None:
+        for task in (self._task, self._status_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        if self._watch is not None:
+            await self._watch.cancel()
+        for ctl in self.deployments.values():
+            await ctl.close()
+        self.deployments.clear()
+
+    # -- status ------------------------------------------------------------
+
+    async def _set_phase(self, name: str, phase: str, **extra) -> None:
+        status = {"phase": phase, **extra}
+        self._phase[name] = phase
+        await self.runtime.discovery.put(DGDR_STATUS_PREFIX + name, status)
+        log.info("dgdr %s -> %s", name, phase)
+
+    async def _status_loop(self, interval: float = 1.0) -> None:
+        """Deploying -> Deployed edge: flip when every service observes
+        its desired replica count (the operator's readiness gate). The
+        phase comes from the in-memory mirror (this process wrote it — a
+        discovery read-back would add an etcd round trip per deployment
+        per second AND a stale-read race against reconcile)."""
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                for name, ctl in list(self.deployments.items()):
+                    if self._phase.get(name) != DEPLOYING:
+                        continue
+                    profile = self.profiles.get(name)
+                    if profile is None:  # teardown raced us
+                        continue
+                    status = ctl.status()
+                    ready = all(s["running"] >= s["desired"]
+                                for s in status["services"].values())
+                    if ready:
+                        await self._set_phase(
+                            name, DEPLOYED, profile=profile.to_wire(),
+                            services=status["services"])
+            except Exception:  # noqa: BLE001 — the gate must survive
+                log.exception("dgdr status sweep failed")
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def _watch_loop(self) -> None:
+        async for event in self._watch:
+            name = event.key[len(DGDR_PREFIX):]
+            try:
+                if event.kind == "delete":
+                    await self._teardown(name)
+                elif event.value is not None:
+                    await self._reconcile(
+                        name, DeploymentRequest.from_wire(event.value))
+            except Exception as exc:  # noqa: BLE001 — keep reconciling
+                log.exception("dgdr %s reconcile failed", name)
+                try:
+                    await self._set_phase(name, FAILED, error=str(exc))
+                except Exception:  # noqa: BLE001
+                    pass
+
+    async def _teardown(self, name: str) -> None:
+        ctl = self.deployments.pop(name, None)
+        self.specs.pop(name, None)
+        self.profiles.pop(name, None)
+        self._phase.pop(name, None)
+        if ctl is not None:
+            await ctl.close()
+        # Always drop the status document — a request that FAILED before
+        # deploying has no controller but must not leave a ghost status.
+        await self.runtime.discovery.delete(DGDR_STATUS_PREFIX + name)
+        log.info("dgdr %s torn down", name)
+
+    async def _reconcile(self, name: str, req: DeploymentRequest) -> None:
+        await self._set_phase(name, PENDING)
+        await self._set_phase(name, PROFILING)
+        profile = await asyncio.to_thread(profile_request, req)
+        spec = generate_spec(req, profile)
+        await self._set_phase(name, READY, profile=profile.to_wire())
+
+        existing = self.deployments.get(name)
+        old_spec = self.specs.get(name)
+        if existing is not None and old_spec is not None:
+            if self._same_shape(old_spec, spec):
+                # Rolling scale: replica counts only. State updates land
+                # BEFORE the Deploying phase write so the readiness sweep
+                # can never publish Deployed with the stale profile.
+                self.specs[name] = spec
+                self.profiles[name] = profile
+                for svc_name, svc in spec.services.items():
+                    if existing.desired.get(svc_name) != svc.replicas:
+                        existing.set_replicas(svc_name, svc.replicas)
+                await self._set_phase(name, DEPLOYING,
+                                      profile=profile.to_wire())
+                return
+            # Shape changed (args/env/services): replace the deployment.
+            await existing.close()
+            self.deployments.pop(name, None)
+
+        ctl = self._factory(spec)
+        ctl.start()
+        self.deployments[name] = ctl
+        self.specs[name] = spec
+        self.profiles[name] = profile
+        await self._set_phase(name, DEPLOYING, profile=profile.to_wire())
+
+    @staticmethod
+    def _same_shape(a: GraphDeploymentSpec, b: GraphDeploymentSpec) -> bool:
+        if set(a.services) != set(b.services) or a.env != b.env:
+            return False
+        for name in a.services:
+            sa, sb = a.services[name], b.services[name]
+            if (sa.kind, sa.args, sa.env, sa.command) != \
+                    (sb.kind, sb.args, sb.env, sb.command):
+                return False
+        return True
+
+
+async def submit_request(runtime, req: DeploymentRequest) -> None:
+    """Client edge: write (or update) a DGDR document."""
+    await runtime.discovery.put(DGDR_PREFIX + req.name, req.to_wire())
+
+
+async def get_status(runtime, name: str) -> Optional[dict]:
+    key = DGDR_STATUS_PREFIX + name
+    return (await runtime.discovery.get_prefix(key)).get(key)
