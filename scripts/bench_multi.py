@@ -1,0 +1,250 @@
+"""Multi-configuration benchmarks -> BENCH_MULTI.json (+ markdown table).
+
+Covers the BASELINE.json configs beyond the single-chip decode bench
+(which bench.py owns), in the same tiers the reference uses for its router
+and disagg numbers (mocker-backed A/B at controlled prefix ratios — ref:
+benchmarks/router/prefix_ratio_benchmark.py — and offline agg/disagg
+replay), plus a real-engine KVBM onboard TTFT curve:
+
+  router_ab   8 mocker workers, kv-aware vs round-robin routing at prefix
+              ratios {0.1, 0.5, 0.9}  (BASELINE config 2 analog)
+  disagg      aggregated vs disaggregated prefill/decode offline replay
+              (BASELINE config 3 analog)
+  kvbm_ttft   real JAX engine, TTFT of a long-prefix re-sent prompt: cold
+              vs G1 prefix-cache hit vs G2 host-tier onboard after the G1
+              pages were evicted  (BASELINE config 4 analog)
+
+Everything runs on CPU (mocker simulation + tiny real engine): the numbers
+are A/B RELATIVE — exactly how the reference publishes its router (3x
+TTFT) and disagg wins — not absolute chip throughput (bench.py measures
+that on the real chip).
+
+Run:  python scripts/bench_multi.py [--quick] [--out BENCH_MULTI.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def bench_router_ab(quick: bool) -> dict:
+    from dynamo_tpu.mocker.engine import MockerConfig
+    from dynamo_tpu.mocker.loadgen import OfflineReplay, synthesize_trace
+
+    n = 100 if quick else 400
+    out = {}
+    for prefix_ratio in (0.1, 0.5, 0.9):
+        row = {}
+        trace = synthesize_trace(
+            n, rate_rps=40.0, isl_mean=1024, osl_mean=64,
+            prefix_ratio=prefix_ratio, num_prefix_groups=8, seed=7)
+        for policy in ("round_robin", "kv"):
+            replay = OfflineReplay(
+                mode="agg", num_workers=8, router_policy=policy,
+                config=MockerConfig(speedup_ratio=100.0, num_blocks=2048))
+            report = asyncio.run(replay.run(trace))
+            assert report.errors == 0, report.summary()
+            row[policy] = report.summary()
+        kv50 = row["kv"]["ttft_ms"]["p50"] or 1e-9
+        row["kv_ttft_speedup_p50"] = round(
+            row["round_robin"]["ttft_ms"]["p50"] / kv50, 2)
+        out[f"prefix_{prefix_ratio}"] = row
+    return out
+
+
+def bench_disagg(quick: bool) -> dict:
+    from dynamo_tpu.mocker.engine import MockerConfig
+    from dynamo_tpu.mocker.loadgen import OfflineReplay, synthesize_trace
+
+    n = 100 if quick else 400
+    trace = synthesize_trace(
+        n, rate_rps=30.0, isl_mean=3072, osl_mean=128,
+        prefix_ratio=0.3, seed=11)
+    out = {}
+    for mode, kwargs in (
+        ("agg", dict(mode="agg", num_workers=4)),
+        ("disagg", dict(mode="disagg", num_workers=3,
+                        num_prefill_workers=1)),
+    ):
+        replay = OfflineReplay(
+            router_policy="kv" if mode == "agg" else "round_robin",
+            config=MockerConfig(speedup_ratio=100.0, num_blocks=4096),
+            **kwargs)
+        report = asyncio.run(replay.run(trace))
+        assert report.errors == 0, report.summary()
+        out[mode] = report.summary()
+    # Disagg's headline: decode ITL stays flat because prefill bursts run
+    # on the prefill pool (ref architecture.md disagg rationale).
+    agg_itl = out["agg"]["itl_ms"]["p99"] or 1e-9
+    out["disagg_itl_p99_improvement"] = round(
+        agg_itl / (out["disagg"]["itl_ms"]["p99"] or 1e-9), 2)
+    return out
+
+
+def bench_kvbm_ttft(quick: bool) -> dict:
+    """TTFT for a shared long prefix: cold prefill vs G1 prefix-cache hit
+    vs G2 onboard (G1 pages evicted, host tier supplies the blocks)."""
+    import numpy as np
+
+    from dynamo_tpu.block_manager import (
+        BlockLayoutSpec,
+        KvBlockManager,
+        KvbmConfig,
+    )
+    from dynamo_tpu.engine import InferenceScheduler, ModelRunner, RunnerConfig
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models import get_config
+
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    runner = ModelRunner(
+        get_config("tiny-test"),
+        RunnerConfig(page_size=4, num_pages=96, max_batch=2,
+                     max_pages_per_seq=48, prefill_buckets=(32, 64, 128)),
+        make_mesh(MeshConfig()), seed=0)
+    kvbm = KvBlockManager(
+        KvbmConfig(host_blocks=256, offload_batch=4),
+        BlockLayoutSpec.from_runner_layout(runner.kv_layout()))
+    sched = InferenceScheduler(runner, kvbm=kvbm)
+    sched.start()
+
+    def one_request(tokens, tag):
+        done = {}
+        t0 = time.perf_counter()
+
+        def emit(out):
+            if "ttft" not in done and out.token_ids:
+                done["ttft"] = (time.perf_counter() - t0) * 1e3
+            if out.finish_reason is not None:
+                done["fin"] = out.finish_reason
+
+        sched.submit(PreprocessedRequest(
+            request_id=tag, token_ids=list(tokens),
+            sampling=SamplingOptions(max_tokens=4, temperature=0.0),
+            stop=StopConditions(ignore_eos=True)), emit)
+        deadline = time.time() + 120
+        while "fin" not in done and time.time() < deadline:
+            time.sleep(0.005)
+        assert done.get("fin"), f"request {tag} never finished"
+        return done["ttft"]
+
+    try:
+        prefix = list(np.arange(2, 122) % 500)  # 120 tokens, 30 blocks
+        cold = one_request(prefix + [130, 131], "cold")
+        # same prefix again: G1 radix prefix-cache hit
+        g1_hit = one_request(prefix + [140, 141], "g1hit")
+        # flush offloads, then force G1 eviction by filling the pool with
+        # unrelated prompts; the prefix blocks survive only in G2
+        kvbm.flush(30.0)
+        filler = 0
+        for i in range(4):
+            one_request(list(np.arange(1000 + i * 200,
+                                       1000 + i * 200 + 120) % 500
+                             + 1), f"fill{i}")
+            filler += 1
+        g2_onboard = one_request(prefix + [150, 151], "g2")
+        onboarded = sched.stats.kvbm_onboarded_blocks
+    finally:
+        sched.stop()
+        kvbm.close()
+    return {
+        "cold_ttft_ms": round(cold, 2),
+        "g1_prefix_hit_ttft_ms": round(g1_hit, 2),
+        "g2_onboard_ttft_ms": round(g2_onboard, 2),
+        "g2_onboarded_blocks": int(onboarded),
+        "g1_speedup_vs_cold": round(cold / max(g1_hit, 1e-9), 2),
+        "g2_speedup_vs_cold": round(cold / max(g2_onboard, 1e-9), 2),
+    }
+
+
+def render_markdown(results: dict) -> str:
+    lines = ["# BENCH_MULTI — multi-config benchmarks",
+             "",
+             f"Generated by scripts/bench_multi.py; CPU tiers (mocker "
+             f"simulation + tiny real engine), A/B-relative numbers. "
+             f"Single-chip absolute throughput lives in bench.py/"
+             f"BENCH_r*.json.",
+             "",
+             "## Router A/B (8 workers, kv vs round-robin)",
+             "",
+             "| prefix ratio | policy | TTFT p50 (ms) | TTFT p99 | "
+             "ITL p50 | ITL p99 | kv TTFT speedup |",
+             "|---|---|---|---|---|---|---|"]
+    for key, row in results["router_ab"].items():
+        ratio = key.split("_")[1]
+        for policy in ("round_robin", "kv"):
+            s = row[policy]
+            speed = (f'{row["kv_ttft_speedup_p50"]}x'
+                     if policy == "kv" else "")
+            lines.append(
+                f"| {ratio} | {policy} | {s['ttft_ms']['p50']} | "
+                f"{s['ttft_ms']['p99']} | {s['itl_ms']['p50']} | "
+                f"{s['itl_ms']['p99']} | {speed} |")
+    lines += ["", "## Aggregated vs disaggregated (offline replay)", "",
+              "| mode | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 | "
+              "tokens/s |", "|---|---|---|---|---|---|"]
+    for mode in ("agg", "disagg"):
+        s = results["disagg"][mode]
+        lines.append(
+            f"| {mode} | {s['ttft_ms']['p50']} | {s['ttft_ms']['p99']} | "
+            f"{s['itl_ms']['p50']} | {s['itl_ms']['p99']} | "
+            f"{s['tokens_per_s']} |")
+    lines.append(
+        f"\ndisagg ITL p99 improvement: "
+        f"{results['disagg']['disagg_itl_p99_improvement']}x")
+    k = results["kvbm_ttft"]
+    lines += ["", "## KVBM offload TTFT (real engine, shared 120-token "
+              "prefix)", "",
+              "| path | TTFT (ms) | speedup vs cold |", "|---|---|---|",
+              f"| cold prefill | {k['cold_ttft_ms']} | 1.0x |",
+              f"| G1 prefix-cache hit | {k['g1_prefix_hit_ttft_ms']} | "
+              f"{k['g1_speedup_vs_cold']}x |",
+              f"| G2 host-tier onboard | {k['g2_onboard_ttft_ms']} | "
+              f"{k['g2_speedup_vs_cold']}x |",
+              f"\nG2 onboarded blocks: {k['g2_onboarded_blocks']}"]
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("bench_multi")
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--out", default="BENCH_MULTI.json")
+    parser.add_argument("--md", default="BENCH_MULTI.md")
+    args = parser.parse_args()
+
+    results = {}
+    t0 = time.time()
+    print("router A/B ...", flush=True)
+    results["router_ab"] = bench_router_ab(args.quick)
+    print("disagg vs agg ...", flush=True)
+    results["disagg"] = bench_disagg(args.quick)
+    print("kvbm ttft curve ...", flush=True)
+    results["kvbm_ttft"] = bench_kvbm_ttft(args.quick)
+    results["wall_s"] = round(time.time() - t0, 1)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    with open(args.md, "w") as f:
+        f.write(render_markdown(results))
+    print(json.dumps({"router_kv_speedup_p50@0.9":
+                      results["router_ab"]["prefix_0.9"]
+                      ["kv_ttft_speedup_p50"],
+                      "disagg_itl_p99_improvement":
+                      results["disagg"]["disagg_itl_p99_improvement"],
+                      "kvbm_g2_speedup":
+                      results["kvbm_ttft"]["g2_speedup_vs_cold"],
+                      "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()
