@@ -58,13 +58,32 @@ def main() -> None:
     device = jax.devices()[0]
     device_kind = getattr(device, "device_kind", "cpu").lower()
 
-    config = get_config(MODEL)
+    # With DYNT_BENCH_MODEL_PATH set, bench a REAL checkpoint (architecture
+    # from its config.json, weights from safetensors) instead of the
+    # random-init preset.
+    import os
+
+    model_path = os.environ.get("DYNT_BENCH_MODEL_PATH")
+    host_params = None
+    if model_path:
+        from dynamo_tpu.models.checkpoint import (
+            config_from_checkpoint,
+            load_params,
+        )
+
+        config = config_from_checkpoint(model_path)
+        host_params = load_params(model_path, config)
+        model_label = config.name
+    else:
+        config = get_config(MODEL)
+        model_label = MODEL
     runner = ModelRunner(
         config,
         RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
                      max_batch=BATCH, max_pages_per_seq=MAX_PAGES_PER_SEQ,
                      prefill_buckets=(256,)),
         make_mesh(MeshConfig()),
+        host_params,
         seed=0,
     )
 
@@ -167,8 +186,8 @@ def main() -> None:
     vs_baseline = tok_per_sec / roofline_tok
 
     print(json.dumps({
-        "metric": f"decode throughput {MODEL} bs={BATCH} ctx={PROMPT_LEN} "
-                  f"({device_kind})",
+        "metric": f"decode throughput {model_label} bs={BATCH} "
+                  f"ctx={PROMPT_LEN} ({device_kind})",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),

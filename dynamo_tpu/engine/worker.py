@@ -129,10 +129,21 @@ class TpuWorker:
         weights_from_peer: bool = False,  # ModelExpress analog
         mesh=None,  # pre-built sub-mesh (co-meshed disagg split_mesh)
         ici_bridge=None,  # engine.ici_transfer.IciKvBridge, shared in-proc
+        model_path: Optional[str] = None,  # HF checkpoint dir (safetensors)
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
-        self.model_config = get_config(model_name)
+        self.model_path = model_path
+        if model_path:
+            # Real checkpoint: architecture comes from its config.json
+            # (ref: fetch_model + ModelDeploymentCard weight plumbing,
+            # components/src/dynamo/vllm/main.py:133,
+            # lib/llm/src/model_card.rs:183).
+            from ..models.checkpoint import config_from_checkpoint
+
+            self.model_config = config_from_checkpoint(model_path)
+        else:
+            self.model_config = get_config(model_name)
         self.runner_config = runner_config or RunnerConfig()
         self.mesh = mesh if mesh is not None else make_mesh(
             mesh_config or MeshConfig())
@@ -161,6 +172,12 @@ class TpuWorker:
         self._initial_loras = lora_adapters or {}
         model_types = ([PREFILL] if mode == "prefill"
                        else [CHAT, COMPLETIONS])
+        import os as _os
+
+        tokenizer_spec = {"kind": "byte"}
+        if model_path and _os.path.exists(
+                _os.path.join(model_path, "tokenizer.json")):
+            tokenizer_spec = {"kind": "hf", "path": model_path}
         self.card = ModelDeploymentCard(
             name=served_name or self.model_config.name,
             model_types=model_types,
@@ -171,7 +188,7 @@ class TpuWorker:
                                self.runner_config.max_context),
             kv_block_size=self.runner_config.page_size,
             total_kv_blocks=self.runner_config.num_pages,
-            tokenizer={"kind": "byte"},
+            tokenizer=tokenizer_spec,
             tool_parser=tool_parser,
             reasoning_parser=reasoning_parser,
         )
@@ -198,7 +215,7 @@ class TpuWorker:
         self._weights_from_peer = weights_from_peer
         self._weights_served = None
         self._publish_task: Optional[asyncio.Task] = None
-        self.weights_source = "init"  # init | service | peer
+        self.weights_source = "init"  # init | service | peer | checkpoint
 
     async def start(self) -> None:
         """prepare + serve in one go (normal startup). Snapshot-gated
@@ -215,7 +232,14 @@ class TpuWorker:
 
         cfg = self.model_config
         digest = xxhash.xxh64_intdigest(repr(cfg).encode())
-        return f"{cfg.name}:{digest:016x}"
+        key = f"{cfg.name}:{digest:016x}"
+        if self.model_path:
+            # Updated weights on disk must miss a stale arena even when
+            # the architecture (and so the config digest) is unchanged.
+            from ..models.checkpoint import checkpoint_digest
+
+            key += f":{checkpoint_digest(self.model_path)}"
+        return key
 
     def _params_template(self):
         import jax
@@ -260,6 +284,16 @@ class TpuWorker:
                                       expected_key=self._weights_key())
             if flat is not None:
                 host_params = self._params_from_flat(flat, "peer")
+        if host_params is None and self.model_path:
+            # Disk checkpoint: the slow-but-real path. Errors are FATAL —
+            # a worker given a model path must never silently fall back
+            # to random-init weights.
+            from ..models.checkpoint import load_params
+
+            log.info("loading checkpoint from %s ...", self.model_path)
+            host_params = await asyncio.to_thread(
+                load_params, self.model_path, self.model_config)
+            self.weights_source = "checkpoint"
         return host_params, client
 
     def rederive_identity(self) -> None:
@@ -880,6 +914,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser = argparse.ArgumentParser("dynamo_tpu.worker")
     parser.add_argument("--model", default="tiny-test",
                         help="model preset (models/config.py PRESETS)")
+    parser.add_argument("--model-path", default=None,
+                        help="HF checkpoint directory (config.json + "
+                             "*.safetensors [+ tokenizer.json]); overrides "
+                             "--model — the architecture comes from the "
+                             "checkpoint's config.json")
     parser.add_argument("--served-model-name", default=None)
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="backend")
@@ -984,7 +1023,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_loras=args.max_loras, lora_rank=args.lora_rank,
         )
         common = dict(
-            model_name=args.model, served_name=args.served_model_name,
+            model_name=args.model, model_path=args.model_path,
+            served_name=args.served_model_name,
             namespace=args.namespace, runner_config=rc,
             tool_parser=args.tool_call_parser,
             reasoning_parser=args.reasoning_parser,
@@ -1017,6 +1057,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
     worker = TpuWorker(
         runtime,
         model_name=args.model,
+        model_path=args.model_path,
         served_name=args.served_model_name,
         namespace=args.namespace,
         component=component,

@@ -1,0 +1,343 @@
+"""Real-checkpoint loading: HF safetensors -> param pytree.
+
+Covers the VERDICT round-3 ask #1: roundtrip (save -> load bit-exact),
+worker-path loading with identical logits vs direct params, sharded
+checkpoints, and — the strong parity proof — logits equivalence against
+`transformers`' own forward pass on a tiny randomly-initialized HF model
+built locally (no downloads). Ref contract: fetch_model + MDC weight
+plumbing (components/src/dynamo/vllm/main.py:133,
+lib/llm/src/model_card.rs:183)."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models import get_config, init_params
+from dynamo_tpu.models.checkpoint import (
+    ShardReader,
+    checkpoint_digest,
+    config_from_checkpoint,
+    config_from_hf,
+    hf_config_dict,
+    load_params,
+    save_params,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.transformer import forward, make_kv_cache
+
+
+def _tree_equal(a, b, path=""):
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a)} != {set(b)}"
+        for k in a:
+            _tree_equal(a[k], b[k], f"{path}/{k}")
+    elif isinstance(a, list):
+        assert len(a) == len(b)
+        for i, (x, y) in enumerate(zip(a, b)):
+            _tree_equal(x, y, f"{path}/{i}")
+    else:
+        x, y = np.asarray(a), np.asarray(b)
+        assert x.dtype == y.dtype, f"{path}: {x.dtype} != {y.dtype}"
+        assert x.shape == y.shape, f"{path}: {x.shape} != {y.shape}"
+        assert np.array_equal(x, y), f"{path}: values differ"
+
+
+QWEN3_LIKE = ModelConfig(
+    name="tiny-qwen3", vocab_size=512, hidden=64, n_layers=2,
+    n_q_heads=4, n_kv_heads=2, head_dim=16, mlp_hidden=128,
+    qk_norm=True, tie_embeddings=False,
+)
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("cfg", [
+        get_config("tiny-test"),          # tied, no qk_norm (llama-ish)
+        QWEN3_LIKE,                       # untied + qk_norm
+        get_config("tiny-moe-test"),      # MoE expert stacks
+    ], ids=["tied-dense", "qwen3-like", "moe"])
+    def test_save_load_bit_exact(self, cfg, tmp_path):
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        if cfg.n_experts:
+            # Dense-MLP leaves are dead weight on MoE layers (forward never
+            # reads them); checkpoints zero-fill them on load.
+            for lp in params["layers"]:
+                for key in ("w_gate", "w_up", "w_down"):
+                    lp[key] = jnp.zeros_like(lp[key])
+        out = str(tmp_path / "ckpt")
+        save_params(params, cfg, out)
+        assert os.path.exists(os.path.join(out, "model.safetensors"))
+        assert os.path.exists(os.path.join(out, "config.json"))
+        loaded = load_params(out, cfg)
+        _tree_equal(params, loaded)
+
+    def test_config_roundtrip(self, tmp_path):
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), QWEN3_LIKE),
+                    QWEN3_LIKE, out)
+        cfg = config_from_checkpoint(out, name=QWEN3_LIKE.name)
+        # Everything the forward pass depends on must surive the trip.
+        for field in ("vocab_size", "hidden", "n_layers", "n_q_heads",
+                      "n_kv_heads", "head_dim", "mlp_hidden", "qk_norm",
+                      "tie_embeddings", "n_experts"):
+            assert getattr(cfg, field) == getattr(QWEN3_LIKE, field), field
+
+    def test_sharded_index(self, tmp_path):
+        """Multi-shard checkpoints (model.safetensors.index.json) load the
+        same as single-file ones."""
+        from safetensors.numpy import load_file, save_file
+
+        cfg = get_config("tiny-test")
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        single = str(tmp_path / "single")
+        save_params(params, cfg, single)
+        tensors = load_file(os.path.join(single, "model.safetensors"))
+        sharded = tmp_path / "sharded"
+        sharded.mkdir()
+        names = sorted(tensors)
+        half = len(names) // 2
+        shards = {"model-00001-of-00002.safetensors": names[:half],
+                  "model-00002-of-00002.safetensors": names[half:]}
+        weight_map = {}
+        for fname, keys in shards.items():
+            save_file({k: tensors[k] for k in keys}, str(sharded / fname))
+            weight_map.update({k: fname for k in keys})
+        (sharded / "model.safetensors.index.json").write_text(
+            json.dumps({"weight_map": weight_map}))
+        (sharded / "config.json").write_text(
+            (tmp_path / "single" / "config.json").read_text())
+        _tree_equal(params, load_params(str(sharded), cfg))
+
+    def test_missing_tensor_raises(self, tmp_path):
+        from safetensors.numpy import load_file, save_file
+
+        cfg = get_config("tiny-test")
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, out)
+        tensors = load_file(os.path.join(out, "model.safetensors"))
+        del tensors["model.layers.1.self_attn.q_proj.weight"]
+        save_file(tensors, os.path.join(out, "model.safetensors"))
+        with pytest.raises(KeyError):
+            load_params(out, cfg)
+
+    def test_wrong_shape_raises(self, tmp_path):
+        cfg = get_config("tiny-test")
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, out)
+        wider = dataclasses.replace(cfg, mlp_hidden=cfg.mlp_hidden * 2)
+        with pytest.raises(ValueError):
+            load_params(out, wider)
+
+    def test_tied_checkpoint_with_lm_head_fallback(self, tmp_path):
+        """An untied config over a checkpoint that omits lm_head falls back
+        to the embedding (HF tying semantics)."""
+        cfg = get_config("tiny-test")  # tied: save emits no lm_head
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        out = str(tmp_path / "ckpt")
+        save_params(params, cfg, out)
+        untied = dataclasses.replace(cfg, tie_embeddings=False)
+        loaded = load_params(out, untied)
+        np.testing.assert_array_equal(
+            np.asarray(loaded["lm_head"]),
+            np.asarray(params["embed"]).T)
+
+    def test_digest_is_content_derived(self, tmp_path):
+        """Identical bytes -> identical digest even with different mtimes
+        (cross-host peer/arena keys must agree); changed weights ->
+        different digest (stale arenas must miss)."""
+        import shutil
+
+        cfg = get_config("tiny-test")
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, out)
+        d1 = checkpoint_digest(out)
+        copy = str(tmp_path / "copy")
+        shutil.copytree(out, copy)
+        st_path = os.path.join(copy, "model.safetensors")
+        st = os.stat(st_path)
+        os.utime(st_path, ns=(st.st_atime_ns, st.st_mtime_ns + 10**9))
+        assert checkpoint_digest(copy) == d1
+        save_params(init_params(jax.random.PRNGKey(1), cfg), cfg, out)
+        assert checkpoint_digest(out) != d1
+
+
+class TestHfConfig:
+    def test_qwen3_fields(self):
+        cfg = config_from_hf({
+            "architectures": ["Qwen3ForCausalLM"],
+            "hidden_size": 1024, "intermediate_size": 3072,
+            "num_hidden_layers": 28, "num_attention_heads": 16,
+            "num_key_value_heads": 8, "head_dim": 128,
+            "vocab_size": 151936, "rope_theta": 1000000.0,
+            "rms_norm_eps": 1e-6, "tie_word_embeddings": True,
+            "max_position_embeddings": 40960,
+        }, name="qwen3-0.6b")
+        ours = get_config("qwen3-0.6b")
+        for field in ("vocab_size", "hidden", "n_layers", "n_q_heads",
+                      "n_kv_heads", "head_dim", "mlp_hidden", "qk_norm",
+                      "tie_embeddings", "rope_theta"):
+            assert getattr(cfg, field) == getattr(ours, field), field
+
+    def test_rope_scaling_rejected(self):
+        base = {
+            "architectures": ["LlamaForCausalLM"], "hidden_size": 64,
+            "num_attention_heads": 4, "num_hidden_layers": 1,
+            "vocab_size": 256, "intermediate_size": 128,
+        }
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_hf({**base, "rope_scaling": {
+                "rope_type": "llama3", "factor": 8.0}})
+        # explicit default scaling is fine
+        config_from_hf({**base, "rope_scaling": {"rope_type": "default"}})
+
+    def test_sliding_window_rejected(self):
+        with pytest.raises(ValueError, match="sliding-window"):
+            config_from_hf({
+                "architectures": ["MistralForCausalLM"], "hidden_size": 64,
+                "num_attention_heads": 4, "num_hidden_layers": 1,
+                "vocab_size": 256, "intermediate_size": 128,
+                "sliding_window": 4096,
+            })
+
+    def test_unsupported_arch_rejected(self):
+        with pytest.raises(ValueError, match="unsupported architecture"):
+            config_from_hf({"architectures": ["Qwen2ForCausalLM"],
+                            "hidden_size": 8, "num_attention_heads": 1,
+                            "num_hidden_layers": 1, "vocab_size": 8,
+                            "intermediate_size": 8})
+
+
+def _our_logits(cfg, params, token_ids):
+    """Full-prefill logits through our paged forward."""
+    t = len(token_ids)
+    page_size = 16
+    n_pages = (t + page_size - 1) // page_size
+    kv = make_kv_cache(cfg, num_pages=n_pages + 1, page_size=page_size)
+    tables = jnp.arange(1, n_pages + 1, dtype=jnp.int32)[None, :]
+    tokens = jnp.asarray([token_ids], dtype=jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    _, logits = forward(params, cfg, tokens, positions, kv, tables,
+                        jnp.asarray([t], dtype=jnp.int32))
+    return np.asarray(logits[0])
+
+
+class TestTransformersParity:
+    """Load a transformers-native checkpoint (tiny, randomly initialized
+    locally) and match its logits — proves the HF name mapping, transposes,
+    and head layouts are right against the authoritative implementation."""
+
+    @pytest.mark.parametrize("family", ["qwen3", "llama"])
+    def test_logits_match(self, family, tmp_path):
+        import torch
+        import transformers
+
+        torch.manual_seed(0)
+        if family == "qwen3":
+            hf_cfg = transformers.Qwen3Config(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16,
+                rope_theta=10000.0, rms_norm_eps=1e-6,
+                tie_word_embeddings=False, attention_bias=False,
+                max_position_embeddings=2048,
+            )
+            model = transformers.Qwen3ForCausalLM(hf_cfg)
+        else:
+            hf_cfg = transformers.LlamaConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16,
+                rope_theta=10000.0, rms_norm_eps=1e-6,
+                tie_word_embeddings=False, attention_bias=False,
+                mlp_bias=False, max_position_embeddings=2048,
+            )
+            model = transformers.LlamaForCausalLM(hf_cfg)
+        model = model.eval().to(torch.float32)
+        out = str(tmp_path / "hf")
+        model.save_pretrained(out, safe_serialization=True)
+
+        cfg = config_from_checkpoint(out, dtype="float32")
+        assert cfg.qk_norm == (family == "qwen3")
+        params = load_params(out, cfg)
+
+        rng = np.random.default_rng(0)
+        token_ids = rng.integers(0, 256, size=24).tolist()
+        with torch.no_grad():
+            ref = model(torch.tensor([token_ids])).logits[0].numpy()
+        ours = _our_logits(cfg, params, token_ids)
+        np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+class TestWorkerPath:
+    def test_worker_serves_checkpoint_weights(self, tmp_path, run):
+        """The VERDICT 'done' gate: dump a tiny random model to
+        safetensors, load it through the worker path, verify identical
+        logits vs direct init."""
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+
+        cfg = get_config("tiny-test")
+        params = init_params(jax.random.PRNGKey(7), cfg)
+        ckpt = str(tmp_path / "ckpt")
+        save_params(params, cfg, ckpt)
+
+        async def go():
+            worker = TpuWorker(
+                None, model_path=ckpt, warmup=False,
+                runner_config=RunnerConfig(page_size=4, num_pages=32,
+                                           max_batch=2,
+                                           max_pages_per_seq=8,
+                                           prefill_buckets=(8,)),
+            )
+            await worker.prepare()
+            try:
+                assert worker.weights_source == "checkpoint"
+                _tree_equal(params, worker.runner.params)
+            finally:
+                await worker.close()
+
+        run(go())
+
+        token_ids = list(range(12))
+        direct = _our_logits(cfg, params, token_ids)
+        via_ckpt = _our_logits(cfg, load_params(ckpt, cfg), token_ids)
+        np.testing.assert_array_equal(direct, via_ckpt)
+
+    def test_model_path_sets_hf_tokenizer(self, tmp_path):
+        from tokenizers import Tokenizer as HfTok
+        from tokenizers.models import WordLevel
+
+        from dynamo_tpu.engine import TpuWorker
+
+        cfg = get_config("tiny-test")
+        ckpt = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, ckpt)
+        # No tokenizer.json -> byte tokenizer fallback
+        worker = TpuWorker(None, model_path=ckpt, warmup=False)
+        assert worker.card.tokenizer == {"kind": "byte"}
+        assert worker.model_config.name == "ckpt"
+        # tokenizer.json present -> the card advertises the HF tokenizer
+        HfTok(WordLevel({"a": 0, "b": 1}, unk_token="a")).save(
+            os.path.join(ckpt, "tokenizer.json"))
+        worker = TpuWorker(None, model_path=ckpt, warmup=False)
+        assert worker.card.tokenizer == {"kind": "hf", "path": ckpt}
+
+
+class TestShardReader:
+    def test_single_file_path(self, tmp_path):
+        cfg = get_config("tiny-test")
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, out)
+        st = os.path.join(out, "model.safetensors")
+        with ShardReader(st) as reader:
+            assert "model.embed_tokens.weight" in reader.names()
+            emb = reader.get("model.embed_tokens.weight")
+            assert emb.shape == (cfg.vocab_size, cfg.hidden)
+
+    def test_empty_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ShardReader(str(tmp_path))
