@@ -130,6 +130,7 @@ class TpuWorker:
         mesh=None,  # pre-built sub-mesh (co-meshed disagg split_mesh)
         ici_bridge=None,  # engine.ici_transfer.IciKvBridge, shared in-proc
         model_path: Optional[str] = None,  # HF checkpoint dir (safetensors)
+        step_channel=None,  # parallel.multihost.StepChannel (driver rank)
     ) -> None:
         self.runtime = runtime
         self.instance_id = new_instance_id()
@@ -211,6 +212,16 @@ class TpuWorker:
         self._kvq_served = None
         self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._step_channel = step_channel
+        if step_channel is not None:
+            if ici_bridge is not None:
+                raise ValueError("co-meshed ICI disagg and --multihost are "
+                                 "mutually exclusive (cross-host pools use "
+                                 "the host-relay transfer path)")
+            if self.runner_config.max_loras > 0:
+                raise ValueError("multi-LoRA is not yet supported on "
+                                 "multi-host workers (adapter slot writes "
+                                 "are not mirrored)")
         self._weight_service = weight_service
         self._weights_from_peer = weights_from_peer
         self._weights_served = None
@@ -268,6 +279,19 @@ class TpuWorker:
         service whenever enabled so the NEXT restart is fast."""
         host_params = None
         client = None
+        if self._step_channel is not None:
+            # Multi-host: every process resolves weights from its own disk
+            # copy (checkpoint or deterministic init) — shm arenas and peer
+            # streams hold host-local arrays that cannot represent a
+            # cross-host sharded model.
+            if self.model_path:
+                from ..models.checkpoint import load_params
+
+                log.info("loading checkpoint from %s ...", self.model_path)
+                host_params = await asyncio.to_thread(
+                    load_params, self.model_path, self.model_config)
+                self.weights_source = "checkpoint"
+            return host_params, None
         if self._weight_service:
             from ..weights import WeightClient
 
@@ -319,6 +343,13 @@ class TpuWorker:
             ModelRunner, self.model_config, self.runner_config, self.mesh,
             host_params,
         )
+        if self._step_channel is not None:
+            # Driver rank of a multi-host worker: every device-program
+            # launch from here on is mirrored to the follower processes
+            # (parallel/multihost.py) so the SPMD programs stay in lockstep.
+            from ..parallel.multihost import MirroredRunner
+
+            self.runner = MirroredRunner(self.runner, self._step_channel)
         log.info("weights source: %s", self.weights_source)
         if weight_client is not None and self.weights_source != "service":
             # Publish for the next (re)start — best-effort AND off the
@@ -458,6 +489,11 @@ class TpuWorker:
         from ..weights.client import flatten_params
         from ..weights.streaming import encode_param_chunks, manifest_frame
 
+        if self._step_channel is not None:
+            yield {"error": "multi-host workers do not stream weights "
+                            "(parameters are sharded across hosts); cold "
+                            "peers load from the shared checkpoint"}
+            return
         flat = await asyncio.to_thread(flatten_params, self.runner.params)
         yield manifest_frame(self._weights_key(), len(flat))
         for index, (key, arr) in enumerate(flat):
@@ -474,6 +510,12 @@ class TpuWorker:
         In-flight requests are finished with 'migrate' (the frontend
         Migration operator replays them, tokens preserved) before the KV
         pool resets."""
+        if self._step_channel is not None:
+            yield {"ok": False,
+                   "error": "elastic reshard is not supported on a "
+                            "multi-host worker (mesh changes are not "
+                            "mirrored); redeploy with the new topology"}
+            return
         cfg = MeshConfig(
             dp=int(body.get("dp", 1)), tp=int(body.get("tp", 1)),
             sp=int(body.get("sp", 1)), ep=int(body.get("ep", 1)),
@@ -903,6 +945,10 @@ class TpuWorker:
             self.kvbm.close()
         for router in self._pull_clients.values():
             await router.client.close()
+        if self._step_channel is not None:
+            # Release the followers AFTER the scheduler stops (no more
+            # mirrored launches can be in flight).
+            self._step_channel.close()
 
 
 async def main(argv: Optional[list[str]] = None) -> None:
@@ -928,6 +974,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--max-pages-per-seq", type=int, default=128)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--multihost", default=None, metavar="R/N@HOST:PORT",
+                        help="span this worker across N host processes via "
+                             "jax.distributed (one global mesh). Rank 0 is "
+                             "the driver (serves endpoints); ranks 1..N-1 "
+                             "are engine-only followers replaying the "
+                             "driver's steps (ref: vLLM headless multi-node "
+                             "mode, components/src/dynamo/vllm/main.py:79)")
     parser.add_argument("--mode", default="aggregated",
                         choices=["aggregated", "prefill", "decode", "comesh"],
                         help="disaggregated role (prefill workers register "
@@ -984,7 +1038,54 @@ async def main(argv: Optional[list[str]] = None) -> None:
     from ..runtime.config import env as _env
     from ..runtime.snapshot import SnapshotController
 
+    multihost_cfg = None
+    step_channel = None
+    if args.multihost:
+        from ..parallel import multihost as mh
+
+        if args.mode == "comesh":
+            raise SystemExit("--multihost does not combine with --mode "
+                             "comesh (cross-host disagg pools use separate "
+                             "multihost workers + host-relay KV transfer)")
+        multihost_cfg = mh.MultihostConfig.parse(args.multihost)
+        mh.initialize(multihost_cfg)
+        rc = RunnerConfig(
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_batch=args.max_batch,
+            max_pages_per_seq=args.max_pages_per_seq,
+            max_loras=args.max_loras, lora_rank=args.lora_rank,
+        )
+        if not multihost_cfg.is_driver:
+            # Follower: engine only — no runtime, no endpoints. Build a
+            # runner IDENTICAL to the driver's and replay its steps.
+            if args.model_path:
+                from ..models.checkpoint import (
+                    config_from_checkpoint,
+                    load_params,
+                )
+
+                model_config = config_from_checkpoint(args.model_path)
+                host_params = load_params(args.model_path, model_config)
+            else:
+                model_config = get_config(args.model)
+                host_params = None
+            mesh = make_mesh(MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
+            runner = ModelRunner(model_config, rc, mesh, host_params,
+                                 seed=0)
+            await asyncio.to_thread(mh.follower_serve, runner, multihost_cfg)
+            return
+        host, port = multihost_cfg.plan_host_port
+        step_channel = mh.StepChannel(
+            host if host in ("127.0.0.1", "localhost") else "0.0.0.0",
+            port, multihost_cfg.num_processes - 1)
+        log.info("waiting for %d followers on the step channel...",
+                 multihost_cfg.num_processes - 1)
+        await asyncio.to_thread(step_channel.wait_for_followers)
+
     snapshot = SnapshotController()
+    if snapshot.enabled and multihost_cfg is not None:
+        raise SystemExit("snapshot-gated startup does not combine with "
+                         "--multihost")
     # Snapshot protocol: the engine is prepared BEFORE any runtime
     # connection (no open sockets at the dump point); normal mode connects
     # first so the worker registers as soon as it's ready.
@@ -1068,8 +1169,9 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
         ),
-        mesh_config=MeshConfig(dp=args.dp, tp=args.tp),
+        mesh_config=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp),
         kvbm_config=kvbm_config,
+        step_channel=step_channel,
         tool_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
         lora_adapters=dict(s.split("=", 1) for s in args.lora),

@@ -1,0 +1,68 @@
+"""Multi-process dryrun half: one rank of a 2-process multihost engine.
+
+Invoked by __graft_entry__.dryrun_multichip as two subprocesses (driver +
+follower) to validate that a worker really spans OS processes: global mesh
+over jax.distributed, mirrored prefill + decode, identical sampled tokens
+printed by the driver. Runs on virtual CPU devices; the same code path is
+what `--multihost` uses on real TPU pods."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = int(sys.argv[3])
+
+    import numpy as np
+
+    from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+    from dynamo_tpu.models import ModelConfig
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+    from dynamo_tpu.parallel import multihost as mh
+
+    cfg = mh.MultihostConfig(coordinator=f"127.0.0.1:{port}",
+                             num_processes=nprocs, process_id=rank)
+    mh.initialize(cfg)
+
+    model = ModelConfig(name="mh-dryrun", vocab_size=512, hidden=64,
+                        n_layers=2, n_q_heads=8, n_kv_heads=4, head_dim=8,
+                        mlp_hidden=128, qk_norm=True)
+    import jax
+
+    n = jax.device_count()
+    tp = 2 if n % 2 == 0 else 1
+    mesh = make_mesh(MeshConfig(dp=n // tp, tp=tp))
+    runner = ModelRunner(
+        model,
+        RunnerConfig(page_size=4, num_pages=32, max_batch=2,
+                     max_pages_per_seq=8, prefill_buckets=(16,)),
+        mesh, seed=0)
+
+    if not cfg.is_driver:
+        mh.follower_serve(runner, cfg)
+        return
+
+    channel = mh.StepChannel("127.0.0.1", cfg.plan_host_port[1], nprocs - 1)
+    channel.wait_for_followers(timeout=120.0)
+    mirrored = mh.MirroredRunner(runner, channel)
+    table = np.zeros(8, np.int32)
+    table[:4] = np.arange(1, 5)
+    first = mirrored.prefill_chunk(
+        np.arange(1, 11, dtype=np.int32), 0, table, 10, (0.0, 1.0, 0, 0))
+    nxt = mirrored.decode(
+        np.array([first], np.int32), np.array([10], np.int32),
+        table[None, :], np.array([11], np.int32), np.array([True]),
+        np.zeros(1, np.float32), np.ones(1, np.float32),
+        np.zeros(1, np.int32), np.zeros(1, np.uint32))
+    channel.close()
+    print(json.dumps({"mesh": {"dp": n // tp, "tp": tp},
+                      "global_devices": n,
+                      "first": int(first), "next": int(nxt[0])}))
+
+
+if __name__ == "__main__":
+    main()
