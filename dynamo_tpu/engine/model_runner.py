@@ -81,31 +81,28 @@ def _enable_compile_cache() -> None:
         pass
 
 
-def _select_pallas_kernel(mesh: Mesh, import_kernel):
-    """Shared DYNT_ATTENTION / backend / mesh gating for the Pallas kernel
-    choices. The kernels assume a locally addressable KV pool; with a
-    tp-sharded cache the XLA path lets pjit partition attention across the
-    mesh (kernel-level tp via shard_map is a later optimization). None ->
-    the caller's XLA fallback."""
+def _pallas_mode(mesh: Mesh) -> Optional[bool]:
+    """Shared DYNT_ATTENTION / backend gating: returns `interpret` (bool)
+    when a Pallas kernel should be used, None for the XLA fallback."""
     mode = env("DYNT_ATTENTION") or "auto"
     if mode == "xla":
         return None
     backend = jax.default_backend()
-    multi = mesh.devices.size > 1
-    if mode == "pallas" or (mode == "auto" and backend == "tpu" and not multi):
-        return partial(import_kernel(), interpret=(backend != "tpu"))
+    if mode == "pallas" or (mode == "auto" and backend == "tpu"):
+        return backend != "tpu"
     return None
 
 
 def _default_attention_fn(mesh: Mesh):
-    """Prefill/unified attention: Pallas flash-decode on single-device TPU;
-    XLA otherwise."""
-    def _imp():
-        from ..ops.paged_attention import paged_attention
+    """Prefill/unified attention: Pallas flash-decode on single-device;
+    XLA otherwise (prefill is compute-bound — XLA's fused SDPA is already
+    MXU-shaped, so a multi-device kernel buys nothing there)."""
+    interpret = _pallas_mode(mesh)
+    if interpret is None or mesh.devices.size > 1:
+        return None
+    from ..ops.paged_attention import paged_attention
 
-        return paged_attention
-
-    return _select_pallas_kernel(mesh, _imp)
+    return partial(paged_attention, interpret=interpret)
 
 
 def _default_decode_attention_fn(mesh: Mesh):
@@ -114,13 +111,29 @@ def _default_decode_attention_fn(mesh: Mesh):
     On TPU the XLA page gather lowers to scatter-shaped HLO an order of
     magnitude off the HBM roofline (measured: the gather alone accounted
     for ~90% of decode step time); the whole-pool chunked-DMA Pallas kernel
-    streams only the owned pages with no per-layer slice copies."""
-    def _imp():
+    streams only the owned pages with no per-layer slice copies.
+
+    Mesh coverage: single device runs the kernel directly; a tp-only mesh
+    runs it per-shard via shard_map over the kv-head axis (each shard
+    streams its local pool slice — ops/paged_attention.py
+    make_paged_attention_decode_pool_tp). Meshes with other multi-size
+    axes (dp/sp/ep/pp) keep the XLA path, whose sharding pjit manages."""
+    interpret = _pallas_mode(mesh)
+    if interpret is None:
+        return None
+    n = mesh.devices.size
+    if n == 1:
         from ..ops.paged_attention import paged_attention_decode_pool
 
-        return paged_attention_decode_pool
+        return partial(paged_attention_decode_pool, interpret=interpret)
+    if mesh.shape.get(AXIS_TP, 1) == n:
+        from ..ops.paged_attention import (
+            make_paged_attention_decode_pool_tp,
+        )
 
-    return _select_pallas_kernel(mesh, _imp)
+        return make_paged_attention_decode_pool_tp(mesh,
+                                                   interpret=interpret)
+    return None
 
 
 class ModelRunner:
