@@ -382,31 +382,44 @@ class InferenceScheduler:
         prompts on an sp>1 mesh, the WHOLE prompt in one sequence-parallel
         ring-attention step — ops/ring_attention.py)."""
         budget = self.runner.max_prefill_chunk
-        for seq in self._slots:
-            if seq is None or seq.cancelled or seq.decode_ready:
-                continue
-            if (seq.prefill_pos == 0
+
+        def _ring_eligible(seq) -> bool:
+            return (seq.prefill_pos == 0
                     and seq.prompt_len > budget
                     and seq.lora_idx == 0  # ring path has no adapter delta
                     and seq.media_embeds is None  # nor embed splicing
-                    and getattr(self.runner, "sp_size", 1) > 1):
-                sampling = seq.request.sampling
-                token = self.runner.prefill_ring(
-                    np.asarray(seq.request.token_ids[: seq.prompt_len],
-                               np.int32),
-                    seq.block_table,
-                    (sampling.temperature, sampling.top_p, sampling.top_k,
-                     seq.seed),
-                )
+                    and getattr(self.runner, "sp_size", 1) > 1)
+
+        # Long prompts on an sp>1 mesh: batch EVERY eligible sequence into
+        # ONE ring step ([B, bucket] — long-prompt pools batch instead of
+        # paying one full ring pass per sequence).
+        ring = [seq for seq in self._slots
+                if seq is not None and not seq.cancelled
+                and not seq.decode_ready and _ring_eligible(seq)]
+        if ring:
+            tokens = 0
+            result = self.runner.prefill_ring_batch(
+                [np.asarray(s.request.token_ids[: s.prompt_len], np.int32)
+                 for s in ring],
+                np.stack([s.block_table for s in ring]),
+                [(s.request.sampling.temperature, s.request.sampling.top_p,
+                  s.request.sampling.top_k, s.seed) for s in ring],
+            )
+            samples = getattr(self.runner, "last_prefill_samples",
+                              [None] * len(ring))
+            for seq, token, info in zip(ring, result, samples):
                 seq.prefill_pos = seq.prompt_len
+                tokens += seq.prompt_len
                 if seq.prefill_only:
                     self._finish_prefill_only(seq, token)
                 else:
-                    self._append_token(
-                        seq, token, prompt_tokens=seq.prompt_len,
-                        sample_info=getattr(self.runner,
-                                            "last_prefill_sample", None))
-                return seq.prompt_len
+                    self._append_token(seq, token,
+                                       prompt_tokens=seq.prompt_len,
+                                       sample_info=info)
+            return tokens
+        for seq in self._slots:
+            if seq is None or seq.cancelled or seq.decode_ready:
+                continue
             chunk = min(budget, seq.prompt_len - seq.prefill_pos)
             tokens = np.asarray(
                 seq.request.token_ids[seq.prefill_pos : seq.prefill_pos + chunk],

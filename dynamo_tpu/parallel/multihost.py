@@ -262,6 +262,7 @@ class StepChannel:
 MIRRORED_METHODS = (
     "prefill_chunk",
     "prefill_ring",
+    "prefill_ring_batch",
     "decode",
     "decode_multi",
     "embed",
