@@ -21,13 +21,15 @@ import time
 import numpy as np
 
 
-MODEL = "qwen3-0.6b"
-BATCH = 8
+import os as _os
+
+MODEL = _os.environ.get("DYNT_BENCH_MODEL", "qwen3-0.6b")
+BATCH = int(_os.environ.get("DYNT_BENCH_BS", "8"))
 PAGE_SIZE = 16
-NUM_PAGES = 1024
+NUM_PAGES = int(_os.environ.get("DYNT_BENCH_PAGES", "1024"))
 MAX_PAGES_PER_SEQ = 64
-PROMPT_LEN = 256
-DECODE_STEPS = 256
+PROMPT_LEN = int(_os.environ.get("DYNT_BENCH_CTX", "256"))
+DECODE_STEPS = int(_os.environ.get("DYNT_BENCH_STEPS", "256"))
 # HBM bandwidth by chip generation (GB/s) for the roofline denominator.
 HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
             "cpu": 50.0}
@@ -185,13 +187,50 @@ def main() -> None:
     roofline_tok = roofline_steps * BATCH
     vs_baseline = tok_per_sec / roofline_tok
 
-    print(json.dumps({
+    result = {
         "metric": f"decode throughput {model_label} bs={BATCH} "
                   f"ctx={PROMPT_LEN} ({device_kind})",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }
+
+    # Prefill/TTFT tail: p50/p99 single-request prefill latency at a few
+    # ISLs (the reference's aiperf sweeps report TTFT alongside decode —
+    # BASELINE.md measurement method). Skipped with DYNT_BENCH_TTFT=0.
+    if os.environ.get("DYNT_BENCH_TTFT", "1") != "0":
+        ttft = {}
+        bt = np.zeros(MAX_PAGES_PER_SEQ, np.int32)
+        for isl in (128, 512, 1024):
+            if isl > runner.config.max_context - 8:
+                continue
+            pages = isl // PAGE_SIZE + 1
+            bt[:] = 0
+            bt[:pages] = np.arange(1, pages + 1)
+            prompt = rng.integers(0, config.vocab_size, isl).astype(np.int32)
+            # TTFT = time to run the full prefill (chunked at the largest
+            # bucket) + sample the first token, prompt cold in the engine.
+            budget = runner.max_prefill_chunk
+            samples = []
+            for trial in range(12):
+                t0 = time.perf_counter()
+                start = 0
+                while start < isl:
+                    chunk = prompt[start:start + budget]
+                    runner.prefill_chunk(chunk, start, bt,
+                                         start + len(chunk),
+                                         (0.0, 1.0, 0, 0))
+                    start += len(chunk)
+                samples.append((time.perf_counter() - t0) * 1e3)
+            samples = sorted(samples[2:])  # drop compile-warmup trials
+            ttft[str(isl)] = {
+                "p50_ms": round(samples[len(samples) // 2], 2),
+                "p99_ms": round(samples[min(len(samples) - 1,
+                                            int(len(samples) * 0.99))], 2),
+            }
+        result["ttft"] = ttft
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -747,8 +747,17 @@ class ModelRunner:
         forces it so every host can read the full bundle locally)."""
         from ..ops.block_copy import gather_kv_blocks
 
-        bundle = gather_kv_blocks(self.kv_cache,
-                                  jnp.asarray(page_ids, jnp.int32))
+        # Pad the id list to a power-of-two width (extra ids hit the
+        # scratch page 0) so the gather jit compiles O(log n) shapes, not
+        # one per transfer size; slice back on device.
+        ids = np.asarray(page_ids, np.int32)
+        n = len(ids)
+        m = 1 << max(0, n - 1).bit_length()
+        if m != n:
+            ids = np.concatenate([ids, np.zeros(m - n, np.int32)])
+        bundle = gather_kv_blocks(self.kv_cache, jnp.asarray(ids))
+        if m != n:
+            bundle = bundle[:n]
         if replicated and not bundle.is_fully_addressable:
             bundle = jax.device_put(bundle, self._rep)
         return bundle

@@ -69,11 +69,23 @@ def sample(
     any_trunc = jnp.any((top_k > 0) & (temperature > 0)) | \
         jnp.any((top_p < 1.0) & (temperature > 0))
     masked = jax.lax.cond(any_trunc, with_trunc_masks, lambda s: s, scaled)
-    steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), seeds.shape)
-    keys = jax.vmap(
-        lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)
-    )(seeds, steps)
-    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+
+    # Gumbel sampling generates FULL-VOCAB threefry bits per slot — ~B*V
+    # random u32s per step, a measured batch-linear floor cost on TPU that
+    # all-greedy traffic (the common serving case) was paying for nothing.
+    # Gate it at runtime like the truncation sort: an all-greedy batch
+    # skips the RNG entirely, and temp-0 slots inside a mixed batch still
+    # take the greedy branch via the final where.
+    def with_categorical(masked):
+        steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), seeds.shape)
+        keys = jax.vmap(
+            lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)
+        )(seeds, steps)
+        return jax.vmap(jax.random.categorical)(keys, masked)
+
+    any_sampled = jnp.any(temperature > 0)
+    sampled = jax.lax.cond(any_sampled, with_categorical,
+                           lambda m: greedy, masked)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 

@@ -57,15 +57,34 @@ def swap_kv_blocks(
     return kv_cache.at[:, :, dst_ids].set(moved)
 
 
+def pad_bundle_pow2(page_ids: np.ndarray, blocks: np.ndarray):
+    """Pad a (page_ids, blocks) pair to a power-of-two count by REPEATING
+    the last entry — writing the same block to the same page twice is
+    idempotent, and the padding bounds jit specializations of the scatter
+    to O(log n) shapes instead of one compile per onboard size (a measured
+    multi-hundred-ms hiccup on the first onboard of each size)."""
+    n = len(page_ids)
+    m = 1 << max(0, n - 1).bit_length()
+    if m == n or n == 0:
+        return page_ids, blocks
+    reps = m - n
+    page_ids = np.concatenate([page_ids, np.repeat(page_ids[-1:], reps)])
+    blocks = np.concatenate([blocks, np.repeat(blocks[-1:], reps, axis=0)])
+    return page_ids, blocks
+
+
 def scatter_from_host(
     kv_cache: jax.Array, page_ids: np.ndarray, blocks: np.ndarray
 ) -> jax.Array:
     """Host -> device onboard of pages (KVBM G2 -> G1). One contiguous H2D
-    copy then a fused scatter into the pool.
+    copy then a fused scatter into the pool. Bundle sizes are padded to
+    power-of-two buckets (pad_bundle_pow2) so compiles stay finite.
 
     NOTE: never call `.devices().pop()` here — NamedSharding.device_set is
     a shared cached set (and Meshes are interned), so popping it corrupts
     the sharding for every array on the mesh, process-wide."""
+    page_ids, blocks = pad_bundle_pow2(np.asarray(page_ids),
+                                       np.asarray(blocks))
     sharding = getattr(kv_cache, "sharding", None)
     if isinstance(sharding, jax.sharding.NamedSharding):
         # Replicate the bundle over the pool's mesh; the jitted scatter

@@ -480,5 +480,12 @@ def make_discovery(backend: str, *, path: str = "", cluster: str = "",
         # `path` carries the endpoint when callers only have the two-arg
         # form (the FileDiscovery convention of overloading path).
         return EtcdDiscovery(endpoint or path or "http://127.0.0.1:2379")
+    if backend == "kube":
+        from .kube import KubeDiscovery
+
+        # `path` optionally carries an apiserver base URL (tests / out-of-
+        # cluster); empty -> in-cluster service-account config.
+        return KubeDiscovery(base_url=path or None)
     raise ValueError(
-        f"unknown discovery backend: {backend!r} (expected mem|file|etcd)")
+        f"unknown discovery backend: {backend!r} "
+        "(expected mem|file|etcd|kube)")

@@ -1,0 +1,541 @@
+"""Kubernetes Discovery backend over the K8s REST API.
+
+The reference's second production discovery plane (ref:
+lib/runtime/src/discovery/kube.rs, 462 LoC): each worker pod owns ONE
+`DynamoWorkerMetadata` custom resource carrying ALL its registrations
+(endpoints + model cards), ownerReference'd to the pod so K8s garbage
+collection removes it when the pod dies; every client runs a watch daemon
+merging the CRs into a metadata snapshot.
+
+This backend keeps that shape while honoring our etcd-style Discovery
+contract (runtime/discovery.py):
+
+  * one CR **per lease** (`spec.entries = {key: value}`) — the lease IS
+    the pod-owned CR, plus a `coordination.k8s.io/v1` Lease object whose
+    renewTime the owner refreshes on keep_alive. Two liveness layers:
+    K8s GC deletes the CR with the pod (ownerReference), and every
+    client's reaper deletes CRs whose coordination Lease went stale —
+    covering live-pod/hung-runtime, exactly the hole readiness gating
+    covers in the reference (discovery/metadata.rs "ready workers").
+  * put() without a lease writes to a per-handle persistent CR.
+  * watch_prefix: list (capture resourceVersion) -> snapshot replay ->
+    streaming `?watch=true&resourceVersion=N`; whole-CR events diff into
+    per-key put/delete events. Disconnects resume from the last seen
+    resourceVersion; HTTP 410 Gone (the compaction analog) forces a full
+    relist diffed against already-delivered keys — the same gap-free
+    resync discipline as runtime/etcd.py.
+
+Auth: in-cluster service-account config (KUBERNETES_SERVICE_HOST + token/
+CA files) or explicit base_url/token/namespace (tests run against a stub
+apiserver over plain HTTP — tests/test_kube_discovery.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from .discovery import Discovery, KvEvent, Lease, LeaseExpired, Watch
+from .logging import get_logger
+
+log = get_logger("discovery.kube")
+
+GROUP = "dynamo.tpu.dev"
+VERSION = "v1"
+PLURAL = "dynamoworkermetadata"
+KIND = "DynamoWorkerMetadata"
+LABEL = "app.kubernetes.io/part-of"
+LABEL_VALUE = "dynamo-tpu"
+
+UNARY_TIMEOUT_SECS = 5.0
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _now_rfc3339() -> str:
+    return (datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z")
+
+
+def _parse_rfc3339(s: str) -> float:
+    s = s.rstrip("Z")
+    # renewTime carries microseconds (MicroTime); tolerate plain seconds.
+    fmt = "%Y-%m-%dT%H:%M:%S.%f" if "." in s else "%Y-%m-%dT%H:%M:%S"
+    dt = datetime.datetime.strptime(s, fmt).replace(
+        tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+class KubeDiscovery(Discovery):
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        namespace: Optional[str] = None,
+        token: Optional[str] = None,
+        reap_interval: Optional[float] = None,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ValueError(
+                    "KubeDiscovery needs base_url or the in-cluster "
+                    "KUBERNETES_SERVICE_HOST environment")
+            base_url = f"https://{host}:{port}"
+        self._base = base_url.rstrip("/")
+        if namespace is None:
+            try:
+                with open(os.path.join(_SA_DIR, "namespace")) as f:
+                    namespace = f.read().strip()
+            except OSError:
+                namespace = "default"
+        self._ns = namespace
+        if token is None:
+            try:
+                with open(os.path.join(_SA_DIR, "token")) as f:
+                    token = f.read().strip()
+            except OSError:
+                token = ""
+        self._token = token
+        self._reap_interval = reap_interval
+        self._session = None
+        self._handle_id = uuid.uuid4().hex[:12]
+        self._static_cr_created = False
+        # key -> CR name, for delete() of keys this handle wrote
+        self._owned_keys: dict[str, str] = {}
+        self._lease_ttl: dict[str, float] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._watch_tasks: list[asyncio.Task] = []
+        # Pod identity for ownerReferences (K8s GC ties CR to pod life).
+        self._pod_name = os.environ.get("POD_NAME") or os.environ.get(
+            "HOSTNAME", "")
+        self._pod_uid = os.environ.get("POD_UID", "")
+
+    # -- HTTP plumbing ------------------------------------------------------
+
+    def _headers(self, content_type: Optional[str] = None) -> dict:
+        h = {}
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    def _cr_url(self, name: str = "") -> str:
+        url = (f"{self._base}/apis/{GROUP}/{VERSION}/namespaces/"
+               f"{self._ns}/{PLURAL}")
+        return f"{url}/{name}" if name else url
+
+    def _lease_url(self, name: str = "") -> str:
+        url = (f"{self._base}/apis/coordination.k8s.io/v1/namespaces/"
+               f"{self._ns}/leases")
+        return f"{url}/{name}" if name else url
+
+    async def start(self) -> None:
+        import aiohttp
+
+        if self._session is None:
+            ca_path = os.path.join(_SA_DIR, "ca.crt")
+            ssl_arg = None
+            if self._base.startswith("https://") and os.path.exists(ca_path):
+                import ssl as _ssl
+
+                ssl_arg = _ssl.create_default_context(cafile=ca_path)
+            connector = (aiohttp.TCPConnector(ssl=ssl_arg)
+                         if ssl_arg is not None else None)
+            self._session = aiohttp.ClientSession(
+                connector=connector,
+                timeout=aiohttp.ClientTimeout(total=None, connect=5.0,
+                                              sock_read=None))
+        interval = self._reap_interval or 2.0
+        self._tasks.append(asyncio.create_task(self._reap_loop(interval)))
+
+    async def close(self) -> None:
+        for task in self._tasks + self._watch_tasks:
+            task.cancel()
+        for task in self._tasks + self._watch_tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks.clear()
+        self._watch_tasks.clear()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _req(self, method: str, url: str, body: Optional[dict] = None,
+                   content_type: str = "application/json",
+                   ok_statuses=(200, 201)) -> dict:
+        import aiohttp
+
+        assert self._session is not None, "call start() first"
+        data = json.dumps(body).encode() if body is not None else None
+        timeout = aiohttp.ClientTimeout(total=UNARY_TIMEOUT_SECS)
+        async with self._session.request(
+                method, url, data=data,
+                headers=self._headers(content_type if body is not None
+                                      else None),
+                timeout=timeout) as resp:
+            text = await resp.text()
+            if resp.status == 404:
+                raise _NotFound(url)
+            if resp.status == 409:
+                raise _Conflict(url)
+            if resp.status not in ok_statuses:
+                raise RuntimeError(
+                    f"kube API {method} {url} -> {resp.status}: {text[:300]}")
+            return json.loads(text) if text else {}
+
+    # -- leases -------------------------------------------------------------
+
+    def _cr_name(self, lease_id: str) -> str:
+        return f"dynt-{lease_id}"
+
+    def _owner_refs(self) -> list:
+        if self._pod_name and self._pod_uid:
+            # GC: delete the CR when the owning pod goes away (ref kube.rs
+            # build_cr ownerReferences to the pod).
+            return [{"apiVersion": "v1", "kind": "Pod",
+                     "name": self._pod_name, "uid": self._pod_uid}]
+        return []
+
+    async def create_lease(self, ttl: float) -> Lease:
+        lease = Lease(lease_id=uuid.uuid4().hex[:16], ttl=ttl)
+        name = self._cr_name(lease.lease_id)
+        await self._req("POST", self._lease_url(), {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": name,
+                         "labels": {LABEL: LABEL_VALUE}},
+            "spec": {"holderIdentity": self._handle_id,
+                     "leaseDurationSeconds": max(1, int(ttl)),
+                     "renewTime": _now_rfc3339()},
+        })
+        await self._req("POST", self._cr_url(), {
+            "apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+            "metadata": {"name": name, "labels": {LABEL: LABEL_VALUE},
+                         "ownerReferences": self._owner_refs()},
+            "spec": {"entries": {}, "lease": name, "leased": True},
+        })
+        self._lease_ttl[lease.lease_id] = ttl
+        return lease
+
+    async def keep_alive(self, lease: Lease) -> None:
+        name = self._cr_name(lease.lease_id)
+        try:
+            cur = await self._req("GET", self._lease_url(name))
+        except _NotFound:
+            raise LeaseExpired(lease.lease_id) from None
+        spec = cur.get("spec", {})
+        renew = spec.get("renewTime")
+        dur = spec.get("leaseDurationSeconds", lease.ttl)
+        if renew and _parse_rfc3339(renew) + dur < time.time():
+            # Already stale: a reaper may have dropped (or be dropping)
+            # the CR — the owner must re-register, matching etcd.
+            try:
+                await self._req("DELETE", self._lease_url(name))
+            except _NotFound:
+                pass
+            raise LeaseExpired(lease.lease_id)
+        await self._req(
+            "PATCH", self._lease_url(name),
+            {"spec": {"renewTime": _now_rfc3339()}},
+            content_type="application/merge-patch+json")
+
+    async def revoke_lease(self, lease: Lease) -> None:
+        name = self._cr_name(lease.lease_id)
+        for url in (self._cr_url(name), self._lease_url(name)):
+            try:
+                await self._req("DELETE", url)
+            except _NotFound:
+                pass
+        self._owned_keys = {k: v for k, v in self._owned_keys.items()
+                            if v != name}
+
+    # -- kv -----------------------------------------------------------------
+
+    def _escape(self, key: str) -> str:
+        # '/' is fine inside a JSON object key; no escaping needed — but a
+        # merge-patch with '~'-style JSON-pointer is not used here.
+        return key
+
+    async def _ensure_static_cr(self) -> str:
+        name = f"dynt-static-{self._handle_id}"
+        if not self._static_cr_created:
+            try:
+                await self._req("POST", self._cr_url(), {
+                    "apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+                    "metadata": {"name": name,
+                                 "labels": {LABEL: LABEL_VALUE}},
+                    "spec": {"entries": {}, "leased": False},
+                })
+            except _Conflict:
+                pass
+            self._static_cr_created = True
+        return name
+
+    async def put(self, key: str, value: dict,
+                  lease: Optional[Lease] = None) -> None:
+        if lease is not None:
+            name = self._cr_name(lease.lease_id)
+        else:
+            name = await self._ensure_static_cr()
+        try:
+            await self._req(
+                "PATCH", self._cr_url(name),
+                {"spec": {"entries": {self._escape(key): value}}},
+                content_type="application/merge-patch+json")
+        except _NotFound:
+            if lease is not None:
+                raise LeaseExpired(lease.lease_id) from None
+            raise
+        self._owned_keys[key] = name
+
+    async def delete(self, key: str) -> None:
+        name = self._owned_keys.get(key)
+        names = [name] if name else None
+        if names is None:
+            crs = await self._list_crs()
+            names = [cr["metadata"]["name"] for cr in crs
+                     if key in cr.get("spec", {}).get("entries", {})]
+        for cr_name in names:
+            try:
+                await self._req(
+                    "PATCH", self._cr_url(cr_name),
+                    {"spec": {"entries": {self._escape(key): None}}},
+                    content_type="application/merge-patch+json")
+            except _NotFound:
+                pass
+        self._owned_keys.pop(key, None)
+
+    async def _list_crs(self) -> list[dict]:
+        out = await self._req(
+            "GET", self._cr_url() + f"?labelSelector={LABEL}%3D{LABEL_VALUE}")
+        return out.get("items", [])
+
+    @staticmethod
+    def _merge_entries(crs: list[dict], prefix: str) -> dict[str, dict]:
+        merged: dict[str, dict] = {}
+        for cr in crs:
+            for key, value in cr.get("spec", {}).get("entries", {}).items():
+                if key.startswith(prefix) and value is not None:
+                    merged[key] = value
+        return merged
+
+    async def get_prefix(self, prefix: str) -> dict[str, dict]:
+        return self._merge_entries(await self._list_crs(), prefix)
+
+    # -- reaper (stale coordination Leases -> delete CR) --------------------
+
+    async def _reap_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._reap_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — keep reaping
+                log.debug("kube reap error: %s", exc)
+
+    async def _reap_once(self) -> None:
+        try:
+            leases = (await self._req(
+                "GET",
+                self._lease_url() + f"?labelSelector={LABEL}%3D{LABEL_VALUE}"
+            )).get("items", [])
+        except RuntimeError:
+            return
+        now = time.time()
+        for obj in leases:
+            spec = obj.get("spec", {})
+            renew = spec.get("renewTime")
+            dur = spec.get("leaseDurationSeconds", 10)
+            if renew is None or _parse_rfc3339(renew) + dur >= now:
+                continue
+            name = obj["metadata"]["name"]
+            log.info("reaping stale kube lease %s (expired %.1fs ago)",
+                     name, now - (_parse_rfc3339(renew) + dur))
+            for url in (self._cr_url(name), self._lease_url(name)):
+                try:
+                    await self._req("DELETE", url)
+                except (_NotFound, RuntimeError):
+                    pass
+
+    # -- watch --------------------------------------------------------------
+
+    async def watch_prefix(self, prefix: str,
+                           include_existing: bool = True) -> Watch:
+        out = await self._req(
+            "GET", self._cr_url() + f"?labelSelector={LABEL}%3D{LABEL_VALUE}")
+        items = out.get("items", [])
+        rv = out.get("metadata", {}).get("resourceVersion", "0")
+        # per-CR entries snapshot (prefix-filtered), to diff future events
+        cr_state: dict[str, dict[str, dict]] = {}
+        delivered: dict[str, dict] = {}
+        for cr in items:
+            name = cr["metadata"]["name"]
+            entries = {k: v for k, v in
+                       cr.get("spec", {}).get("entries", {}).items()
+                       if k.startswith(prefix) and v is not None}
+            cr_state[name] = entries
+            delivered.update(entries)
+
+        done = asyncio.Event()
+
+        def _cancel(_w: Watch) -> None:
+            done.set()
+
+        watch = Watch(on_cancel=_cancel)
+        if include_existing:
+            for key in sorted(delivered):
+                watch._emit(KvEvent("put", key, delivered[key]))
+        task = asyncio.create_task(
+            self._watch_stream(watch, prefix, rv, cr_state, delivered, done))
+        self._watch_tasks.append(task)
+        return watch
+
+    def _diff_cr(self, watch: Watch, prefix: str,
+                 cr_state: dict, delivered: dict,
+                 name: str, entries_now: dict[str, dict]) -> None:
+        before = cr_state.get(name, {})
+        for key, value in entries_now.items():
+            if before.get(key) != value:
+                delivered[key] = value
+                watch._emit(KvEvent("put", key, value))
+        for key in before:
+            if key not in entries_now:
+                # another CR may still carry the key; emit delete only if
+                # nobody does (merged-view semantics)
+                held = any(key in st for n, st in cr_state.items()
+                           if n != name)
+                if not held:
+                    delivered.pop(key, None)
+                    watch._emit(KvEvent("delete", key))
+        if entries_now:
+            cr_state[name] = entries_now
+        else:
+            cr_state.pop(name, None)
+
+    async def _watch_stream(self, watch: Watch, prefix: str, rv: str,
+                            cr_state: dict, delivered: dict,
+                            done: asyncio.Event) -> None:
+        import aiohttp
+
+        url_base = (self._cr_url()
+                    + f"?labelSelector={LABEL}%3D{LABEL_VALUE}&watch=true")
+        backoff = 0.05
+        while not done.is_set():
+            try:
+                async with self._session.get(
+                        url_base + f"&resourceVersion={rv}",
+                        headers=self._headers(),
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      connect=5.0,
+                                                      sock_read=None),
+                ) as resp:
+                    if resp.status == 410:
+                        rv = await self._resync(watch, prefix, cr_state,
+                                                delivered)
+                        continue
+                    if resp.status != 200:
+                        raise RuntimeError(f"watch HTTP {resp.status}")
+                    backoff = 0.05
+                    buffer = b""
+                    while not done.is_set():
+                        chunk = await resp.content.read(65536)
+                        if not chunk:
+                            break
+                        buffer += chunk
+                        while b"\n" in buffer:
+                            line, buffer = buffer.split(b"\n", 1)
+                            if not line.strip():
+                                continue
+                            event = json.loads(line)
+                            rv = self._handle_event(
+                                watch, prefix, cr_state, delivered,
+                                event) or rv
+                            if event.get("type") == "ERROR":
+                                # 410 delivered in-stream (K8s convention)
+                                rv = await self._resync(
+                                    watch, prefix, cr_state, delivered)
+                                raise _ReconnectWanted()
+            except (_ReconnectWanted, aiohttp.ClientError,
+                    asyncio.TimeoutError, ConnectionError, OSError):
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:  # noqa: BLE001
+                if done.is_set():
+                    return
+                log.warning("kube watch error (%r); resyncing", exc)
+                try:
+                    rv = await self._resync(watch, prefix, cr_state,
+                                            delivered)
+                except Exception:  # noqa: BLE001
+                    pass
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    def _handle_event(self, watch: Watch, prefix: str, cr_state: dict,
+                      delivered: dict, event: dict) -> Optional[str]:
+        etype = event.get("type")
+        obj = event.get("object", {})
+        if etype == "ERROR":
+            return None
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        if etype == "BOOKMARK":
+            return rv
+        name = obj.get("metadata", {}).get("name", "")
+        if etype in ("ADDED", "MODIFIED"):
+            entries = {k: v for k, v in
+                       obj.get("spec", {}).get("entries", {}).items()
+                       if k.startswith(prefix) and v is not None}
+            self._diff_cr(watch, prefix, cr_state, delivered, name, entries)
+        elif etype == "DELETED":
+            self._diff_cr(watch, prefix, cr_state, delivered, name, {})
+        return rv
+
+    async def _resync(self, watch: Watch, prefix: str, cr_state: dict,
+                      delivered: dict) -> str:
+        """Relist and diff against what this watch already delivered —
+        the 410-Gone recovery (same discipline as the etcd compaction
+        resync: gap-free, duplicate-free)."""
+        out = await self._req(
+            "GET", self._cr_url() + f"?labelSelector={LABEL}%3D{LABEL_VALUE}")
+        items = out.get("items", [])
+        rv = out.get("metadata", {}).get("resourceVersion", "0")
+        cr_state.clear()
+        current: dict[str, dict] = {}
+        for cr in items:
+            name = cr["metadata"]["name"]
+            entries = {k: v for k, v in
+                       cr.get("spec", {}).get("entries", {}).items()
+                       if k.startswith(prefix) and v is not None}
+            cr_state[name] = entries
+            current.update(entries)
+        for key, value in current.items():
+            if delivered.get(key) != value:
+                watch._emit(KvEvent("put", key, value))
+        for key in list(delivered):
+            if key not in current:
+                watch._emit(KvEvent("delete", key))
+                delivered.pop(key, None)
+        delivered.update(current)
+        return rv
+
+
+class _NotFound(Exception):
+    pass
+
+
+class _Conflict(Exception):
+    pass
+
+
+class _ReconnectWanted(Exception):
+    pass

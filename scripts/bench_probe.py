@@ -1,0 +1,135 @@
+"""Decode-wall probe: where do the non-roofline 40% go?
+
+Times steady-state fused decode blocks under controlled variations:
+  * ctx ~0 (weights-only floor) vs ctx=256 -> attention+KV share
+  * Pallas pool kernel vs XLA gather path
+  * batch 8 vs 16 vs 32
+  * pages_per_chunk sweep for the pool kernel
+
+Prints one JSON line per config. Run on the real chip. (VERDICT r3 task 5:
+'profile where the remaining 40% goes'.)"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(label, bs, ctx, attention, ppc=None, block=64, n_blocks=4):
+    os.environ["DYNT_ATTENTION"] = attention
+    if ppc is not None:
+        os.environ["DYNT_PALLAS_PPC"] = str(ppc)
+    else:
+        os.environ.pop("DYNT_PALLAS_PPC", None)
+
+    import jax
+
+    from dynamo_tpu.engine.model_runner import (
+        ModelRunner,
+        RunnerConfig,
+        bucket_table_width,
+    )
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    config = get_config("qwen3-0.6b")
+    page_size = 16
+    max_pages = 64
+    runner = ModelRunner(
+        config,
+        RunnerConfig(page_size=page_size, num_pages=2048, max_batch=bs,
+                     max_pages_per_seq=max_pages, prefill_buckets=(256,)),
+        make_mesh(MeshConfig()),
+        seed=0,
+    )
+    total = ctx + (n_blocks + 1) * block
+    pages_per_seq = total // page_size + 1
+    tables = np.zeros((bs, max_pages), np.int32)
+    rng = np.random.default_rng(0)
+    nxt = 1
+    for b in range(bs):
+        tables[b, :pages_per_seq] = np.arange(nxt, nxt + pages_per_seq)
+        nxt += pages_per_seq
+        if ctx:
+            prompt = rng.integers(0, config.vocab_size, ctx).astype(np.int32)
+            runner.prefill_chunk(prompt, 0, tables[b], ctx, (0.0, 1.0, 0, 0))
+
+    width = bucket_table_width(pages_per_seq, max_pages)
+    btables = np.ascontiguousarray(tables[:, :width])
+    positions = np.full(bs, ctx, np.int32)
+    kv_lens = np.full(bs, ctx + 1, np.int32)
+    state = {"tokens": np.zeros(bs, np.int32), "pending": None}
+    steps_np = np.zeros(bs, np.int32)
+
+    def step_block():
+        nonlocal positions, kv_lens, steps_np
+        toks = runner.decode_multi(
+            state["tokens"], positions, btables, kv_lens,
+            np.ones(bs, bool), np.zeros(bs, np.float32),
+            np.ones(bs, np.float32), np.zeros(bs, np.int32),
+            np.zeros(bs, np.uint32), steps_np, k=block, return_device=True)
+        if state["pending"] is not None:
+            np.asarray(state["pending"])
+        state["pending"] = toks
+        state["tokens"] = toks[-1]
+        positions += block
+        kv_lens += block
+        steps_np += block
+
+    def drain():
+        if state["pending"] is not None:
+            np.asarray(state["pending"])
+            state["pending"] = None
+
+    step_block()
+    drain()
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_blocks):
+            step_block()
+        drain()
+        trials.append(time.perf_counter() - t0)
+        positions -= n_blocks * block
+        kv_lens -= n_blocks * block
+        steps_np -= n_blocks * block
+    best = sorted(trials)[1]
+    tok_s = bs * n_blocks * block / best
+    print(json.dumps({"label": label, "bs": bs, "ctx": ctx,
+                      "attention": attention, "ppc": ppc,
+                      "tok_per_sec": round(tok_s, 1),
+                      "steps_per_sec": round(tok_s / bs, 1),
+                      "us_per_step": round(1e6 * best / (n_blocks * block),
+                                           1)}), flush=True)
+
+
+CONFIGS = [
+    ("floor-bs8", 8, 0, "pallas"),
+    ("base-bs8", 8, 256, "pallas"),
+    ("xla-bs8", 8, 256, "xla"),
+    ("floor-bs16", 16, 0, "pallas"),
+    ("base-bs16", 16, 256, "pallas"),
+    ("floor-bs32", 32, 0, "pallas"),
+    ("base-bs32", 32, 256, "pallas"),
+]
+
+
+def main():
+    import gc
+
+    which = sys.argv[1] if len(sys.argv) > 1 else None
+    for cfg in CONFIGS:
+        if which and cfg[0] != which:
+            continue
+        run_config(*cfg)
+        gc.collect()  # free the previous runner's HBM before the next
+
+
+if __name__ == "__main__":
+    main()
