@@ -469,6 +469,14 @@ class TpuWorker:
                 await self._do_lora_load(name, path)
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
+        if hasattr(publisher, "set_snapshot_fn"):
+            # Durable journal plane: rotations seed the new generation
+            # with this worker's full index instead of the old history.
+            from ..kv_router.protocols import KV_SNAPSHOT_TOPIC
+
+            publisher.set_snapshot_fn(
+                lambda: [(KV_SNAPSHOT_TOPIC,
+                          self.events.local_index.dump())])
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
         log.info("tpu worker serving %s as %s (instance=%x)",
                  self.model_config.name, self.card.name, self.instance_id)

@@ -3,6 +3,7 @@
 from .indexer import NativeRadixTree, RadixTree, make_radix_tree
 from .protocols import (
     KV_EVENT_TOPIC,
+    KV_SNAPSHOT_TOPIC,
     LOAD_TOPIC,
     KvCacheCleared,
     KvCacheRemoved,
@@ -18,6 +19,7 @@ from .sequences import ActiveSequences
 __all__ = [
     "ActiveSequences",
     "KV_EVENT_TOPIC",
+    "KV_SNAPSHOT_TOPIC",
     "KvCacheCleared",
     "KvCacheRemoved",
     "KvCacheStored",

@@ -17,6 +17,11 @@ from typing import Optional
 KV_EVENT_TOPIC = "kv_events"
 # Event-plane topic prefix for worker load metrics (ForwardPassMetrics analog).
 LOAD_TOPIC = "load_metrics"
+# Whole-index snapshots: emitted when a durable journal rotates (the
+# publisher seeds the new generation with current state instead of the
+# discarded history); payload = LocalKvIndexer.dump(). Routers load it
+# via indexer.load_worker — the same application path as worker resync.
+KV_SNAPSHOT_TOPIC = "kv_snapshot"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,6 +15,7 @@ from typing import Optional
 
 from ..kv_router import (
     KV_EVENT_TOPIC,
+    KV_SNAPSHOT_TOPIC,
     LOAD_TOPIC,
     KvRouterConfig,
     KvScheduler,
@@ -497,6 +498,22 @@ class ModelWatcher:
                             # from its local indexer (ref: worker_query).
                             self._schedule_resync(entry, event.worker_id,
                                                   reason="gap")
+                elif topic.startswith(KV_SNAPSHOT_TOPIC):
+                    # Journal rotation snapshot: replace that worker's view
+                    # wholesale (same application path as worker resync).
+                    worker = WorkerWithDpRank(payload["worker_id"],
+                                              payload.get("dp_rank", 0))
+                    for entry in entries:
+                        if entry.scheduler is None:
+                            continue
+                        key = (entry.card.endpoint_subject,
+                               payload["worker_id"])
+                        if key in self._resyncing:
+                            continue  # live resync wins; it is fresher
+                        entry.scheduler.indexer.load_worker(
+                            worker,
+                            [(p, h) for p, h in payload.get("blocks", [])],
+                            payload.get("last_event_id"))
                 elif topic.startswith(LOAD_TOPIC):
                     metrics = LoadMetrics.from_wire(payload)
                     for entry in entries:

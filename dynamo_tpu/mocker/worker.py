@@ -77,6 +77,13 @@ class MockerWorker:
         publisher = self.runtime.event_publisher(self.card.namespace)
         self.engine = MockerEngine(self.config, worker_id=self.instance_id,
                                    event_publisher=publisher)
+        if hasattr(publisher, "set_snapshot_fn"):
+            # Durable journal plane: rotation snapshots (see engine worker)
+            from ..kv_router.protocols import KV_SNAPSHOT_TOPIC
+
+            publisher.set_snapshot_fn(
+                lambda: [(KV_SNAPSHOT_TOPIC,
+                          self.engine.local_index.dump())])
         endpoint = (
             self.runtime.namespace(self.card.namespace)
             .component(self.card.component)

@@ -84,8 +84,15 @@ _register("DYNT_CONNECT_TIMEOUT_SECS", 5.0, _float,
 
 # Event plane
 _register("DYNT_EVENT_PLANE", "zmq", _str,
-          "Event-plane transport: zmq (default) | mem (ref: NATS/ZMQ event plane)")
+          "Event-plane transport: zmq (default) | mem | journal (durable "
+          "replayable log — the JetStream-mode analog, ref: "
+          "kv_router/jetstream.rs)")
 _register("DYNT_ZMQ_HOST", "127.0.0.1", _str, "Event-plane ZMQ bind/advertise host")
+_register("DYNT_EVENT_JOURNAL_PATH", "/tmp/dynamo_tpu_events", _str,
+          "Journal event-plane root directory (shared storage: local disk "
+          "single-host, NFS/GCS-fuse across hosts)")
+_register("DYNT_EVENT_JOURNAL_MAX_MB", 64, _int,
+          "Per-publisher journal size that triggers a snapshot rotation")
 
 # System status server
 _register("DYNT_SYSTEM_PORT", 0, _int,
@@ -188,6 +195,8 @@ class RuntimeConfig:
     connect_timeout_secs: float = 5.0
     event_plane: str = "zmq"
     zmq_host: str = "127.0.0.1"
+    event_journal_path: str = "/tmp/dynamo_tpu_events"
+    event_journal_max_mb: int = 64
     system_port: int = 0
     system_enabled: bool = True
 
@@ -206,6 +215,8 @@ class RuntimeConfig:
             connect_timeout_secs=env("DYNT_CONNECT_TIMEOUT_SECS"),
             event_plane=env("DYNT_EVENT_PLANE"),
             zmq_host=env("DYNT_ZMQ_HOST"),
+            event_journal_path=env("DYNT_EVENT_JOURNAL_PATH"),
+            event_journal_max_mb=env("DYNT_EVENT_JOURNAL_MAX_MB"),
             system_port=env("DYNT_SYSTEM_PORT"),
             system_enabled=env("DYNT_SYSTEM_ENABLED"),
         )

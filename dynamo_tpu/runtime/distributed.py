@@ -98,6 +98,14 @@ class DistributedRuntime:
     def event_publisher(self, namespace: str) -> EventPublisher:
         if self.config.event_plane == "mem":
             return MemEventPlane(cluster=namespace).publisher()
+        if self.config.event_plane == "journal":
+            from .events import JournalEventPublisher
+
+            publisher = JournalEventPublisher(
+                self.config.event_journal_path, namespace,
+                max_bytes=self.config.event_journal_max_mb * 2**20)
+            self._publishers.append(publisher)
+            return publisher
         publisher = ZmqEventPublisher(namespace, self.discovery, self.lease,
                                       host=self.config.zmq_host)
         self._publishers.append(publisher)
@@ -106,6 +114,13 @@ class DistributedRuntime:
     async def event_subscriber(self, namespace: str, topic_prefix: str = "") -> EventSubscriber:
         if self.config.event_plane == "mem":
             return await MemEventPlane(cluster=namespace).subscribe(topic_prefix)
+        if self.config.event_plane == "journal":
+            from .events import JournalEventSubscriberManager
+
+            manager = JournalEventSubscriberManager(
+                self.config.event_journal_path, namespace, topic_prefix)
+            self._subscriber_managers.append(manager)
+            return await manager.start()
         manager = ZmqEventSubscriberManager(namespace, self.discovery, topic_prefix)
         self._subscriber_managers.append(manager)
         return await manager.start()

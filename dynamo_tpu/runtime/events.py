@@ -222,3 +222,185 @@ class ZmqEventSubscriberManager:
         await self._watch.cancel()
         self._sock.close(0)
         await self._subscriber.close()
+
+
+# ---------------------------------------------------------------------------
+# Journal transport: durable, replayable event log on shared storage
+# (ref: lib/llm/src/kv_router/jetstream.rs + router-design.md "JetStream
+# Mode" — a durable stream so ROUTER REPLICAS recover state after restart
+# without querying workers. The TPU build's substrate is a directory of
+# per-publisher append-only logs on storage all replicas mount — the same
+# deployment substrate FileDiscovery uses: local disk single-host,
+# NFS/GCS-fuse across hosts.)
+# ---------------------------------------------------------------------------
+
+import os
+import struct
+
+
+def _journal_pack(topic: str, payload: Any) -> bytes:
+    body = msgpack.packb({"t": topic, "p": payload}, use_bin_type=True)
+    return struct.pack(">I", len(body)) + body
+
+
+def _journal_read(buf: bytes, offset: int):
+    """Yield (next_offset, topic, payload) for complete frames in buf from
+    offset; a trailing partial frame (torn write from a crashed publisher)
+    is left for the next poll."""
+    n = len(buf)
+    while offset + 4 <= n:
+        (length,) = struct.unpack_from(">I", buf, offset)
+        if offset + 4 + length > n:
+            break  # incomplete tail frame
+        frame = msgpack.unpackb(buf[offset + 4 : offset + 4 + length],
+                                raw=False, strict_map_key=False)
+        offset += 4 + length
+        yield offset, frame["t"], frame["p"]
+
+
+class JournalEventPublisher(EventPublisher):
+    """Appends length-prefixed msgpack frames to
+    `<root>/<namespace>/<publisher_id>.g<generation>.log`.
+
+    Durability model: a frame is on disk before publish() returns (write +
+    flush; fsync is left to the filesystem — same stance as JetStream's
+    default file storage). Rotation: past `max_bytes` the publisher starts
+    a new generation seeded with snapshot frames from `snapshot_fn` (the
+    worker's local-index dump — the state that replaces the discarded
+    history), then unlinks the old generation. Subscribers switch to the
+    highest generation and reset their offset, so replayed state stays
+    exact across rotations."""
+
+    def __init__(self, root: str, namespace: str,
+                 max_bytes: int = 64 * 2**20) -> None:
+        self.publisher_id = uuid.uuid4().hex
+        self._dir = os.path.join(root, namespace)
+        os.makedirs(self._dir, exist_ok=True)
+        self._generation = 0
+        self._max_bytes = max_bytes
+        self._file = open(self._path(), "ab")
+        self.snapshot_fn: Optional[Callable[[], list]] = None
+
+    def _path(self) -> str:
+        return os.path.join(
+            self._dir, f"{self.publisher_id}.g{self._generation}.log")
+
+    def set_snapshot_fn(self, fn: Callable[[], list]) -> None:
+        """fn() -> [(topic, payload), ...] reproducing current state; used
+        to seed a rotated journal generation."""
+        self.snapshot_fn = fn
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        data = _journal_pack(topic, payload)
+        await asyncio.to_thread(self._append, data)
+
+    def _append(self, data: bytes) -> None:
+        self._file.write(data)
+        self._file.flush()
+        if self._file.tell() >= self._max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        old_path, old_file = self._path(), self._file
+        self._generation += 1
+        new_file = open(self._path(), "ab")
+        if self.snapshot_fn is not None:
+            try:
+                for topic, payload in self.snapshot_fn():
+                    new_file.write(_journal_pack(topic, payload))
+            except Exception:  # noqa: BLE001 — a failed snapshot must not
+                # lose the stream; fall back to an empty generation (the
+                # consumer's gap/bootstrap recovery covers it)
+                log.exception("journal snapshot failed during rotation")
+        new_file.flush()
+        self._file = new_file
+        old_file.close()
+        try:
+            os.unlink(old_path)
+        except OSError:
+            pass
+        log.info("journal rotated to generation %d (%s)",
+                 self._generation, self.publisher_id)
+
+    async def close(self) -> None:
+        self._file.close()
+
+
+class JournalEventSubscriberManager:
+    """Tails every publisher log under `<root>/<namespace>/`, replaying
+    from offset 0 (full durable history — the restart-recovery property)
+    then following live appends. Poll-based like FileDiscovery; KV events
+    are already batched by publishers so the poll interval bounds latency,
+    not throughput."""
+
+    def __init__(self, root: str, namespace: str, topic_prefix: str,
+                 poll_interval: float = 0.05) -> None:
+        self._dir = os.path.join(root, namespace)
+        self._prefix = topic_prefix
+        self._poll = poll_interval
+        # publisher_id -> (generation, offset)
+        self._positions: dict[str, tuple[int, int]] = {}
+        self._subscriber = EventSubscriber()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> EventSubscriber:
+        self._task = asyncio.create_task(self._poll_loop())
+        return self._subscriber
+
+    def _scan(self) -> list[tuple[str, Any]]:
+        """Thread-side: read new frames from every log; returns events."""
+        out: list[tuple[str, Any]] = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        files: dict[str, int] = {}
+        for name in names:
+            if not name.endswith(".log") or ".g" not in name:
+                continue
+            pub, gen_part = name[:-len(".log")].rsplit(".g", 1)
+            try:
+                gen = int(gen_part)
+            except ValueError:
+                continue
+            if gen > files.get(pub, -1):
+                files[pub] = gen
+        for pub, gen in files.items():
+            cur_gen, offset = self._positions.get(pub, (-1, 0))
+            if gen > cur_gen:
+                offset = 0  # new generation: replay from its start
+            path = os.path.join(self._dir, f"{pub}.g{gen}.log")
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    buf = f.read()
+            except OSError:
+                continue  # rotated away between listdir and open
+            pos = 0
+            for next_pos, topic, payload in _journal_read(buf, 0):
+                pos = next_pos
+                if topic.startswith(self._prefix):
+                    out.append((topic, payload))
+            self._positions[pub] = (gen, offset + pos)
+        return out
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                events = await asyncio.to_thread(self._scan)
+                for topic, payload in events:
+                    self._subscriber._emit(topic, payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep tailing
+                log.exception("journal poll failed")
+            await asyncio.sleep(self._poll)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await self._subscriber.close()

@@ -1,0 +1,297 @@
+"""Durable journal event plane (the JetStream-mode analog — ref:
+lib/llm/src/kv_router/jetstream.rs, router-design.md "JetStream Mode"):
+per-publisher append-only logs on shared storage, full-history replay for
+restarted subscribers, snapshot-seeded rotation, torn-tail tolerance.
+
+E2E tier: two KV-routed frontends under live traffic; one restarts and
+converges to the same radix state as the survivor FROM THE JOURNAL ALONE
+(worker resync disabled), then keeps serving."""
+
+import asyncio
+import os
+import struct
+import uuid
+
+import pytest
+
+from dynamo_tpu.runtime.events import (
+    JournalEventPublisher,
+    JournalEventSubscriberManager,
+    _journal_pack,
+)
+
+
+async def _drain(sub, n, timeout=5.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(out) < n:
+        remaining = deadline - asyncio.get_event_loop().time()
+        if remaining <= 0:
+            break
+        try:
+            out.append(await asyncio.wait_for(sub.__anext__(), remaining))
+        except (asyncio.TimeoutError, StopAsyncIteration):
+            break
+    return out
+
+
+class TestJournalTransport:
+    def test_publish_subscribe_roundtrip(self, run, tmp_path):
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("kv_events", {"a": 1})
+            await pub.publish("load_metrics", {"b": 2})
+            await pub.publish("kv_events", {"a": 3})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 2)
+            assert events == [("kv_events", {"a": 1}),
+                              ("kv_events", {"a": 3})]
+            # live tail after replay
+            await pub.publish("kv_events", {"a": 4})
+            assert await _drain(sub, 1) == [("kv_events", {"a": 4})]
+            await mgr.close()
+            await pub.close()
+        run(body())
+
+    def test_restarted_subscriber_replays_full_history(self, run,
+                                                       tmp_path):
+        """The durable property: a brand-new subscriber (a restarted
+        router) sees everything ever published."""
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            for i in range(20):
+                await pub.publish("kv_events", {"i": i})
+            # first subscriber consumes...
+            m1 = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                               poll_interval=0.02)
+            s1 = await m1.start()
+            assert len(await _drain(s1, 20)) == 20
+            await m1.close()
+            # ...then a FRESH subscriber still gets the full history
+            m2 = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                               poll_interval=0.02)
+            s2 = await m2.start()
+            events = await _drain(s2, 20)
+            assert [p["i"] for _t, p in events] == list(range(20))
+            await m2.close()
+            await pub.close()
+        run(body())
+
+    def test_multiple_publishers(self, run, tmp_path):
+        async def body():
+            p1 = JournalEventPublisher(str(tmp_path), "ns")
+            p2 = JournalEventPublisher(str(tmp_path), "ns")
+            await p1.publish("t", {"from": 1})
+            await p2.publish("t", {"from": 2})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 2)
+            assert {p["from"] for _t, p in events} == {1, 2}
+            await mgr.close()
+            await p1.close()
+            await p2.close()
+        run(body())
+
+    def test_rotation_seeds_snapshot_and_old_gen_removed(self, run,
+                                                         tmp_path):
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=400)
+            pub.set_snapshot_fn(
+                lambda: [("kv_snapshot", {"state": "current"})])
+            for i in range(40):  # well past max_bytes -> several rotations
+                await pub.publish("kv_events", {"i": i, "pad": "x" * 40})
+            assert pub._generation > 0
+            files = os.listdir(tmp_path / "ns")
+            assert len(files) == 1  # old generations unlinked
+            # fresh subscriber: snapshot frame first, then the tail
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 2)
+            assert events[0][0] == "kv_snapshot"
+            assert events[0][1] == {"state": "current"}
+            await mgr.close()
+            await pub.close()
+        run(body())
+
+    def test_live_subscriber_follows_rotation(self, run, tmp_path):
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=300)
+            pub.set_snapshot_fn(lambda: [("kv_snapshot", {"gen": "snap"})])
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            seen = []
+            for i in range(30):
+                await pub.publish("kv_events", {"i": i, "pad": "y" * 30})
+                seen.extend(await _drain(sub, 1, timeout=1.0))
+            # Every event is delivered exactly once OR superseded by a
+            # snapshot frame from a rotation that happened before the
+            # subscriber reached it.
+            payload_is = [p["i"] for t, p in seen if t == "kv_events"]
+            assert payload_is == sorted(set(payload_is))  # no duplicates
+            assert any(t == "kv_snapshot" for t, _p in seen) or \
+                payload_is == list(range(30))
+            await mgr.close()
+            await pub.close()
+        run(body())
+
+    def test_torn_tail_frame_tolerated(self, run, tmp_path):
+        """A crash mid-append leaves a partial frame; the subscriber stops
+        at the last complete frame and picks up the rest when a recovered
+        publisher completes it."""
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("t", {"ok": 1})
+            path = pub._path()
+            full_frame = _journal_pack("t", {"ok": 2})
+            with open(path, "ab") as f:
+                f.write(full_frame[: len(full_frame) // 2])  # torn write
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 1)
+            assert events == [("t", {"ok": 1})]
+            assert await _drain(sub, 1, timeout=0.3) == []  # torn frame held
+            with open(path, "ab") as f:  # recovery completes the frame
+                f.write(full_frame[len(full_frame) // 2:])
+            assert await _drain(sub, 1) == [("t", {"ok": 2})]
+            await mgr.close()
+            await pub.close()
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# E2E: two router replicas over the journal; one restarts under traffic
+# ---------------------------------------------------------------------------
+
+
+def _cfg(cluster, journal_root):
+    from dynamo_tpu.runtime import RuntimeConfig
+
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "journal"
+    cfg.event_journal_path = journal_root
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 2.0
+    return cfg
+
+
+async def _chat(port, content, n=1):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        for _ in range(n):
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={"model": "mock-model",
+                      "messages": [{"role": "user", "content": content}],
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                await resp.json()
+
+
+def _tree_state(frontend):
+    entry = frontend.manager.get("mock-model")
+    counts = entry.scheduler.indexer.worker_block_counts()
+    return {w.worker_id: n for w, n in counts.items()}
+
+
+class TestRouterReplicaRestart:
+    def test_restarted_replica_converges_from_journal(self, run, tmp_path):
+        """Two KV-routed frontends, live traffic through BOTH, kill one,
+        restart it: it must converge to the survivor's radix state from
+        the durable journal ALONE (worker resync endpoints disabled) and
+        keep serving KV-routed traffic (VERDICT r3 ask #7)."""
+        from dynamo_tpu.frontend import Frontend
+        from dynamo_tpu.mocker import MockerConfig, MockerWorker
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            journal = str(tmp_path / "journal")
+            rts = []
+
+            async def rt():
+                r = await DistributedRuntime(_cfg(cluster, journal)).start()
+                rts.append(r)
+                return r
+
+            workers = []
+            for _ in range(2):
+                w = MockerWorker(
+                    await rt(), model_name="mock-model",
+                    config=MockerConfig(speedup_ratio=500.0,
+                                        num_blocks=256, block_size=16),
+                    load_publish_interval=0.2)
+                # JetStream-mode deployment: recovery comes from the
+                # durable log, not worker queries.
+                w.card.runtime_config["kv_blocks_endpoint"] = False
+                await w.start()
+                workers.append(w)
+
+            f1 = Frontend(await rt(), host="127.0.0.1", port=0,
+                          router_mode="kv")
+            await f1.start()
+            f2 = Frontend(await rt(), host="127.0.0.1", port=0,
+                          router_mode="kv")
+            await f2.start()
+            for f in (f1, f2):
+                for _ in range(100):
+                    if f.manager.get("mock-model") is not None:
+                        break
+                    await asyncio.sleep(0.05)
+
+            # live traffic through BOTH replicas
+            await _chat(f1.port, "shared prefix one " * 8, n=3)
+            await _chat(f2.port, "shared prefix two " * 8, n=3)
+            for _ in range(100):
+                if _tree_state(f1) and _tree_state(f1) == _tree_state(f2):
+                    break
+                await asyncio.sleep(0.05)
+            state_before = _tree_state(f1)
+            assert state_before and sum(state_before.values()) > 0
+            assert _tree_state(f2) == state_before
+
+            # kill replica 2 mid-operation...
+            f2_port_rt = rts[-1]
+            await f2.close()
+            await f2_port_rt.shutdown()
+            # ...traffic keeps flowing through replica 1 while 2 is down
+            await _chat(f1.port, "prefix while down " * 8, n=2)
+
+            # restart replica 2 fresh
+            f2b = Frontend(await rt(), host="127.0.0.1", port=0,
+                           router_mode="kv")
+            await f2b.start()
+            for _ in range(200):
+                entry = f2b.manager.get("mock-model")
+                if (entry is not None and entry.scheduler is not None
+                        and _tree_state(f2b) == _tree_state(f1)
+                        and _tree_state(f2b)):
+                    break
+                await asyncio.sleep(0.05)
+            # consistent trees, recovered from the journal alone
+            assert _tree_state(f2b) == _tree_state(f1)
+            assert sum(_tree_state(f2b).values()) \
+                > sum(state_before.values())
+            # and the restarted replica still serves KV-routed traffic
+            await _chat(f2b.port, "shared prefix one " * 8, n=1)
+
+            await f2b.close()
+            await f1.close()
+            for w in workers:
+                await w.close()
+            for r in rts:
+                await r.shutdown()
+
+        run(body(), timeout=180)
