@@ -1,0 +1,192 @@
+"""Gateway endpoint-picker (EPP) service — KV-aware routing callable from
+a standard external gateway.
+
+The reference ships a Gateway API Inference Extension plugin
+(ref: deploy/inference-gateway/epp/) that picks the serving endpoint from
+INSIDE a standard K8s gateway and communicates the decision through the
+`x-prefill-instance-id` header consumed by the PrefillRouter's direct
+mode (ref: lib/llm/src/kv_router/prefill_router/mod.rs:117-120). The
+TPU-build analog is this HTTP service:
+
+    POST /v1/pick {"model": m, "prompt": "..." | "token_ids": [...]}
+      -> {"instance_id": "<hex>", "overlap_blocks": n,
+          "headers": {"x-worker-instance-id": "<hex>"
+                      [, "x-prefill-instance-id": "<hex>"]}}
+
+A gateway (Envoy ext-proc, nginx njs, anything that can make a subrequest)
+calls /v1/pick and forwards the returned headers with the request to any
+frontend replica; the frontends honor them by direct-routing (annotation
+contract in llm/http_service.py + llm/engine.py + llm/prefill_router.py).
+
+State: the EPP reuses the frontend's ModelWatcher/ModelManager machinery
+in KV mode, so its radix view and selection logic (overlap-logit softmax)
+are IDENTICAL to an in-frontend KV router — the decision quality doesn't
+degrade by moving it into the gateway. Selection here does NOT book the
+request into the slot tracker: the picker is advisory and the shared KV
+events keep every replica's view converging.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..kv_router import KvRouterConfig, WorkerWithDpRank
+from ..llm.manager import ModelManager, ModelWatcher
+from ..runtime import DistributedRuntime
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from ..tokens import compute_block_hashes
+
+log = get_logger("gateway.epp")
+
+
+class EppService:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        host: str = "0.0.0.0",
+        port: int = 9300,
+        kv_overlap_weight: Optional[float] = None,
+        kv_temperature: Optional[float] = None,
+        namespace_filter: Optional[str] = None,
+    ) -> None:
+        self.runtime = runtime
+        self.host = host
+        self._port = port
+        self.manager = ModelManager()
+        kv_config = KvRouterConfig(
+            overlap_weight=(env("DYNT_ROUTER_OVERLAP_WEIGHT")
+                            if kv_overlap_weight is None
+                            else kv_overlap_weight),
+            temperature=(env("DYNT_ROUTER_TEMPERATURE")
+                         if kv_temperature is None else kv_temperature),
+        )
+        self.watcher = ModelWatcher(
+            runtime, self.manager, router_mode="kv", kv_config=kv_config,
+            namespace_filter=namespace_filter,
+        )
+        self._runner = None
+        self._site = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        await self.watcher.start()
+        app = web.Application()
+        app.router.add_post("/v1/pick", self._pick)
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/v1/models", self._models)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self._port)
+        await self._site.start()
+        if self._port == 0:
+            self._port = self._site._server.sockets[0].getsockname()[1]
+        log.info("gateway EPP listening on %s:%d", self.host, self._port)
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        await self.watcher.close()
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response({
+            "ok": True,
+            "models": [c.name for c in self.manager.list_models()],
+        })
+
+    async def _models(self, request):
+        from aiohttp import web
+
+        return web.json_response({
+            "data": [{"id": c.name} for c in self.manager.list_models()]})
+
+    async def _pick(self, request):
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        entry, _lora = self.manager.resolve(body.get("model", ""))
+        if entry is None:
+            return web.json_response(
+                {"error": f"unknown model {body.get('model')!r}"},
+                status=404)
+        if entry.scheduler is None:
+            return web.json_response(
+                {"error": "model entry has no KV scheduler"}, status=503)
+        token_ids = body.get("token_ids")
+        if token_ids is None and body.get("messages") is not None:
+            # Chat shape: preprocess EXACTLY like the frontend will (chat
+            # template + tokenize), or the block hashes cannot match the
+            # blocks the serving request stores.
+            try:
+                token_ids = entry.preprocessor.preprocess_chat(
+                    body).token_ids
+            except Exception as exc:  # noqa: BLE001 — bad messages shape
+                return web.json_response({"error": str(exc)}, status=400)
+        if token_ids is None:
+            prompt = body.get("prompt")
+            if prompt is None:
+                return web.json_response(
+                    {"error": "need token_ids, messages, or prompt"},
+                    status=400)
+            token_ids = entry.preprocessor.tokenizer.encode(str(prompt))
+        try:
+            await entry.router.client.start()
+            avail = entry.router.available()
+        except Exception as exc:  # noqa: BLE001 — no workers yet
+            return web.json_response({"error": repr(exc)}, status=503)
+        if not avail:
+            return web.json_response({"error": "no instances"}, status=503)
+        token_ids = [int(t) for t in token_ids]
+        hashes = compute_block_hashes(token_ids,
+                                      entry.scheduler.config.block_size)
+        result = entry.scheduler.select_worker(
+            [WorkerWithDpRank(iid) for iid in avail], hashes,
+            isl_tokens=len(token_ids))
+        headers = {"x-worker-instance-id": f"{result.worker.worker_id:x}"}
+        # Disagg deployments: also pick a prefill-pool worker when one is
+        # registered for this model (the reference's header).
+        prefill_pool = getattr(self.watcher, "_prefill_pools", {}).get(
+            entry.card.name)
+        if prefill_pool is not None and prefill_pool.instances:
+            pre = sorted(prefill_pool.instances)[
+                (hashes[0] if hashes else 0) % len(prefill_pool.instances)]
+            headers["x-prefill-instance-id"] = f"{pre:x}"
+        return web.json_response({
+            "instance_id": f"{result.worker.worker_id:x}",
+            "overlap_blocks": result.overlap_blocks,
+            "logit": result.logit,
+            "headers": headers,
+        })
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    parser = argparse.ArgumentParser("dynamo_tpu.gateway")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9300)
+    parser.add_argument("--namespace-filter", default=None)
+    args = parser.parse_args(argv)
+    runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+    epp = EppService(runtime, host=args.host, port=args.port,
+                     namespace_filter=args.namespace_filter)
+    await epp.start()
+    try:
+        await wait_for_shutdown_signal()
+    finally:
+        await epp.close()
+        await runtime.shutdown()

@@ -55,9 +55,24 @@ class RouterEngine(TokenEngine):
         return allowed
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
-        async for item in self.router.generate(request.to_wire(),
-                                               allowed=self._allowed(request)):
+        async for item in self.router.generate(
+                request.to_wire(), instance_id=_pinned_instance(request),
+                allowed=self._allowed(request)):
             yield EngineOutput.from_wire(item)
+
+
+def _pinned_instance(request: PreprocessedRequest) -> Optional[int]:
+    """Instance id pinned by an external endpoint picker via the gateway
+    header contract (annotation set in http_service from
+    x-worker-instance-id; hex as logged/returned by the EPP)."""
+    raw = (request.annotations or {}).get("target_instance")
+    if not raw:
+        return None
+    try:
+        return int(str(raw), 16)
+    except ValueError:
+        log.warning("bad target_instance annotation %r; ignoring", raw)
+        return None
 
 
 def _priority_of(request: PreprocessedRequest) -> float:
@@ -102,6 +117,15 @@ class KvRouterEngine(TokenEngine):
         from ..kv_router.queue import QueuedRequest
 
         await self.router.client.start()
+        pinned_instance = _pinned_instance(request)
+        if pinned_instance is not None:
+            # External endpoint picker owns placement (gateway EPP header
+            # contract): direct route, no booking — the picker's view of
+            # load already includes this request.
+            async for item in self.router.generate(
+                    request.to_wire(), instance_id=pinned_instance):
+                yield EngineOutput.from_wire(item)
+            return
         avail = self.router.available()
         pinned = False
         if request.lora_name and self._lora_instances is not None:

@@ -168,6 +168,18 @@ class HttpService:
         tp = span.traceparent or request.headers.get("traceparent")
         if tp:
             preprocessed.annotations["traceparent"] = tp
+        # Gateway EPP header contract: an external endpoint picker (e.g.
+        # the gateway/ EPP service behind a standard K8s gateway) pins
+        # routing via headers — x-worker-instance-id direct-routes the
+        # decode/aggregated leg; x-prefill-instance-id the prefill leg
+        # (ref: deploy/inference-gateway/epp +
+        # lib/llm/src/kv_router/prefill_router/mod.rs:117-120).
+        target = request.headers.get("x-worker-instance-id")
+        if target:
+            preprocessed.annotations["target_instance"] = target
+        prefill_target = request.headers.get("x-prefill-instance-id")
+        if prefill_target:
+            preprocessed.annotations["prefill_instance"] = prefill_target
         current_request_id.set(preprocessed.request_id)
         # Everything from here runs under the span: setup failures export
         # it with ok=False via __exit__ — failing requests are exactly the

@@ -56,8 +56,18 @@ class PrefillRouterEngine(TokenEngine):
             sampling=dataclasses.replace(request.sampling, max_tokens=1),
             annotations={**request.annotations, "prefill_only": True},
         )
+        # Gateway EPP header contract (ref: prefill_router/mod.rs:117-120
+        # x-prefill-instance-id): an external picker pins the prefill leg.
+        target = None
+        raw = request.annotations.get("prefill_instance")
+        if raw:
+            try:
+                target = int(str(raw), 16)
+            except ValueError:
+                log.warning("bad prefill_instance annotation %r", raw)
         try:
-            async for item in pool.router.generate(prefill_request.to_wire()):
+            async for item in pool.router.generate(prefill_request.to_wire(),
+                                                   instance_id=target):
                 out = EngineOutput.from_wire(item)
                 if out.error:
                     log.warning("prefill worker error for %s: %s",

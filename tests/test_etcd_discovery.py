@@ -377,8 +377,26 @@ class TestEtcdDiscoveryStub:
         run(body())
 
 
-@pytest.mark.skipif(shutil.which("etcd") is None,
-                    reason="etcd binary not on PATH")
+def _etcd_bin():
+    """Real etcd binary: DYNT_ETCD_BIN, PATH, or the pinned CI vendor dir
+    (scripts/fetch_etcd.sh downloads into build/etcd/)."""
+    explicit = os.environ.get("DYNT_ETCD_BIN")
+    if explicit and os.path.exists(explicit):
+        return explicit
+    found = shutil.which("etcd")
+    if found:
+        return found
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for cand in ("/usr/local/bin/etcd", "/opt/etcd/etcd",
+                 os.path.join(repo, "build", "etcd", "etcd")):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+@pytest.mark.skipif(_etcd_bin() is None,
+                    reason="etcd binary not found (set DYNT_ETCD_BIN or "
+                           "run scripts/fetch_etcd.sh)")
 class TestEtcdDiscoveryReal:
     """Same contract against a real single-node etcd."""
 
@@ -391,7 +409,7 @@ class TestEtcdDiscoveryReal:
             peer_port = s.getsockname()[1]
         endpoint = f"http://127.0.0.1:{client_port}"
         proc = subprocess.Popen(
-            ["etcd", "--data-dir", str(tmp_path / "etcd"),
+            [_etcd_bin(), "--data-dir", str(tmp_path / "etcd"),
              "--listen-client-urls", endpoint,
              "--advertise-client-urls", endpoint,
              "--listen-peer-urls", f"http://127.0.0.1:{peer_port}"],

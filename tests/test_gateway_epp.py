@@ -1,0 +1,160 @@
+"""Gateway EPP analog (ref: deploy/inference-gateway/epp/ + the
+x-prefill-instance-id contract, lib/llm/src/kv_router/prefill_router/
+mod.rs:117-120): an external endpoint-picker HTTP service whose decision
+travels to the frontend as headers and pins routing."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.frontend import Frontend
+from dynamo_tpu.gateway import EppService
+from dynamo_tpu.mocker import MockerConfig, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 2.0
+    return cfg
+
+
+PROMPT = "the gateway picks the endpoint with the warm cache " * 6
+
+
+class TestEppService:
+    def test_pick_is_kv_aware_and_headers_pin_routing(self, run, tmp_path):
+        import aiohttp
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rts = []
+
+            async def rt():
+                r = await DistributedRuntime(_cfg(cluster)).start()
+                rts.append(r)
+                return r
+
+            workers = []
+            for _ in range(2):
+                w = MockerWorker(
+                    await rt(), model_name="mock-model",
+                    config=MockerConfig(speedup_ratio=500.0,
+                                        num_blocks=256, block_size=16),
+                    load_publish_interval=0.2)
+                await w.start()
+                workers.append(w)
+            # Frontend in ROUND-ROBIN mode: any KV-aware placement below
+            # must come from the EPP headers, not the frontend's router.
+            fe = Frontend(await rt(), host="127.0.0.1", port=0,
+                          router_mode="round_robin")
+            await fe.start()
+            epp = EppService(await rt(), host="127.0.0.1", port=0)
+            await epp.start()
+
+            async with aiohttp.ClientSession() as session:
+                for _ in range(100):
+                    async with session.get(
+                            f"http://127.0.0.1:{epp.port}/healthz") as r:
+                        if "mock-model" in (await r.json())["models"]:
+                            break
+                    await asyncio.sleep(0.05)
+                for _ in range(100):
+                    if fe.manager.get("mock-model") is not None:
+                        break
+                    await asyncio.sleep(0.05)
+
+                # Warm the prefix on whichever worker the first pick hits.
+                async with session.post(
+                        f"http://127.0.0.1:{epp.port}/v1/pick",
+                        json={"model": "mock-model",
+                              "messages": [{"role": "user",
+                                            "content": PROMPT}]}) as r:
+                    assert r.status == 200
+                    first = await r.json()
+                assert "x-worker-instance-id" in first["headers"]
+                async with session.post(
+                        f"http://127.0.0.1:{fe.port}/v1/chat/completions",
+                        json={"model": "mock-model",
+                              "messages": [{"role": "user",
+                                            "content": PROMPT}],
+                              "max_tokens": 4},
+                        headers=first["headers"]) as r:
+                    assert r.status == 200
+                    await r.json()
+
+                warm = next(w for w in workers
+                            if f"{w.instance_id:x}"
+                            == first["instance_id"])
+                cold = next(w for w in workers if w is not warm)
+                # events propagate into the EPP's tree
+                for _ in range(100):
+                    async with session.post(
+                            f"http://127.0.0.1:{epp.port}/v1/pick",
+                            json={"model": "mock-model",
+                                  "messages": [{"role": "user",
+                                                "content": PROMPT}]}) as r:
+                        pick = await r.json()
+                    if pick["overlap_blocks"] > 0:
+                        break
+                    await asyncio.sleep(0.05)
+                # KV-aware: the pick returns the warm worker with overlap
+                assert pick["overlap_blocks"] > 0
+                assert pick["instance_id"] == f"{warm.instance_id:x}"
+
+                # The header contract overrides: pin to the COLD worker
+                # and verify the request actually lands there.
+                before = cold.engine.local_index.block_count()
+                async with session.post(
+                        f"http://127.0.0.1:{fe.port}/v1/chat/completions",
+                        json={"model": "mock-model",
+                              "messages": [{"role": "user",
+                                            "content": PROMPT}],
+                              "max_tokens": 4},
+                        headers={"x-worker-instance-id":
+                                 f"{cold.instance_id:x}"}) as r:
+                    assert r.status == 200
+                    await r.json()
+                for _ in range(50):
+                    if cold.engine.local_index.block_count() > before:
+                        break
+                    await asyncio.sleep(0.05)
+                assert cold.engine.local_index.block_count() > before
+
+            await epp.close()
+            await fe.close()
+            for w in workers:
+                await w.close()
+            for r in rts:
+                await r.shutdown()
+
+        run(body(), timeout=120)
+
+    def test_pick_unknown_model_404(self, run):
+        import aiohttp
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            epp = EppService(rt, host="127.0.0.1", port=0)
+            await epp.start()
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{epp.port}/v1/pick",
+                        json={"model": "nope", "prompt": "x"}) as r:
+                    assert r.status == 404
+                async with session.post(
+                        f"http://127.0.0.1:{epp.port}/v1/pick",
+                        data=b"not json") as r:
+                    assert r.status == 400
+            await epp.close()
+            await rt.shutdown()
+
+        run(body(), timeout=60)
