@@ -72,7 +72,9 @@ _register("DYNT_LEASE_TTL_SECS", 10.0, _float,
 
 # Request plane
 _register("DYNT_REQUEST_PLANE", "tcp", _str,
-          "Request-plane transport: tcp (default) | mem (ref: DYN_REQUEST_PLANE)")
+          "Request-plane transport: tcp (default) | http | mem "
+          "(ref: DYN_REQUEST_PLANE tcp/http2/nats); addresses carry their "
+          "scheme, so mixed-transport clusters interoperate")
 _register("DYNT_TCP_HOST", "0.0.0.0", _str, "Request-plane TCP bind host")
 _register("DYNT_TCP_ADVERTISE_HOST", "127.0.0.1", _str,
           "Host advertised to peers for request-plane connections")

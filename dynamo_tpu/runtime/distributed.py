@@ -40,6 +40,14 @@ class DistributedRuntime:
         self.lease: Optional[Lease] = None
         if self.config.request_plane == "mem":
             self.request_server = MemRequestPlane.create_server()
+        elif self.config.request_plane == "http":
+            from .request_plane import HttpRequestServer
+
+            self.request_server = HttpRequestServer(
+                self.config.tcp_host,
+                self.config.tcp_port,
+                advertise_host=self.config.tcp_advertise_host,
+            )
         else:
             self.request_server = TcpRequestServer(
                 self.config.tcp_host,

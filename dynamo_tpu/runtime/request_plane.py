@@ -403,10 +403,14 @@ class MemRequestServer:
 
 
 class RequestClient:
-    """Facade that routes by address scheme (tcp:// or mem://)."""
+    """Facade that routes by address scheme (tcp://, http:// or mem://) —
+    a worker's advertised address selects its transport, so mixed-plane
+    clusters interoperate (ref: DYN_REQUEST_PLANE per-process choice)."""
 
     def __init__(self, connect_timeout: float = 5.0) -> None:
         self._tcp = TcpRequestClient(connect_timeout=connect_timeout)
+        self._http: Optional["HttpRequestClient"] = None
+        self._connect_timeout = connect_timeout
 
     def call(
         self, address: str, subject: str, body: Any, headers: Optional[dict] = None,
@@ -415,7 +419,211 @@ class RequestClient:
         if address.startswith("mem://"):
             return MemRequestPlane.call(address, subject, body, headers,
                                         first_item_timeout)
+        if address.startswith("http://"):
+            if self._http is None:
+                self._http = HttpRequestClient(
+                    connect_timeout=self._connect_timeout)
+            return self._http.call(address, subject, body, headers,
+                                   first_item_timeout)
         return self._tcp.call(address, subject, body, headers, first_item_timeout)
 
     async def close(self) -> None:
         await self._tcp.close()
+        if self._http is not None:
+            await self._http.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (ref: the reference's second request plane — egress/
+# http_router.rs + ingress/http_endpoint.rs, selected via DYN_REQUEST_PLANE.
+# One POST per request, response stream = chunked length-prefixed msgpack
+# frames; rides standard HTTP infrastructure (L7 LBs, mesh sidecars, HTTP
+# health checking) where raw TCP cannot.)
+# ---------------------------------------------------------------------------
+
+
+def _http_frame(obj: dict, payload: bytes = b"") -> bytes:
+    import struct
+
+    head = codec.pack_body(obj)
+    return (struct.pack(">II", len(head), len(payload)) + head + payload)
+
+
+class HttpRequestServer:
+    def __init__(self, host: str, port: int,
+                 advertise_host: Optional[str] = None) -> None:
+        self._host = host
+        self._port = port
+        self._advertise_host = advertise_host or host
+        self._registry = _Registry()
+        self._runner = None
+        self._bound_port: Optional[int] = None
+        self._next_id = itertools.count(1)
+
+    @property
+    def registry(self) -> _Registry:
+        return self._registry
+
+    @property
+    def address(self) -> str:
+        assert self._bound_port is not None, "server not started"
+        return f"http://{self._advertise_host}:{self._bound_port}"
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/rpc/{subject:.+}", self._handle)
+        # handler_cancellation: a client disconnect cancels the handler
+        # coroutine mid-await — matching the TCP plane's `cancel` frame
+        # semantics (the user handler sees CancelledError at its yield).
+        self._runner = web.AppRunner(app, shutdown_timeout=0.5,
+                                     handler_cancellation=True)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._bound_port = site._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        subject = request.match_info["subject"]
+        body_bytes = await request.read()
+        try:
+            body = codec.unpack_body(body_bytes)
+        except Exception:  # noqa: BLE001 — malformed payload
+            return web.Response(status=400, text="bad msgpack body")
+        import json as _json
+
+        try:
+            req_headers = _json.loads(request.headers.get("x-dynt-h", "{}"))
+        except ValueError:
+            req_headers = {}
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        ctx = RequestContext(next(self._next_id), req_headers, subject)
+        try:
+            handler = self._registry.get(subject)
+        except EndpointNotFound:
+            await resp.write(_http_frame({"t": "err", "c": "not_found",
+                                          "e": subject}))
+            return resp
+        gen = handler(body, ctx)
+        try:
+            async for item in gen:
+                await resp.write(_http_frame({"t": "data"},
+                                             codec.pack_body(item)))
+            await resp.write(_http_frame({"t": "end"}))
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away mid-stream: cancellation semantics match
+            # the TCP plane's `cancel` frame.
+            ctx.stop()
+            raise
+        except Exception as exc:  # noqa: BLE001 — surfaced to the client
+            log.exception("handler error on %s", subject)
+            try:
+                await resp.write(_http_frame({"t": "err",
+                                              "c": "handler_error",
+                                              "e": str(exc)}))
+            except (ConnectionResetError, ConnectionError):
+                pass
+        finally:
+            ctx.stop()
+            await gen.aclose()
+        return resp
+
+
+class HttpRequestClient:
+    def __init__(self, connect_timeout: float = 5.0) -> None:
+        self._connect_timeout = connect_timeout
+        self._session = None
+
+    def _get_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None,
+                                              connect=self._connect_timeout,
+                                              sock_read=None))
+        return self._session
+
+    async def call(
+        self,
+        address: str,
+        subject: str,
+        body: Any,
+        headers: Optional[dict] = None,
+        first_item_timeout: Optional[float] = None,
+    ) -> AsyncIterator[Any]:
+        import json as _json
+        import struct
+
+        import aiohttp
+
+        session = self._get_session()
+        url = f"{address}/rpc/{subject}"
+        try:
+            resp_cm = session.post(
+                url, data=codec.pack_body(body),
+                headers={"x-dynt-h": _json.dumps(headers or {})})
+            resp = await resp_cm.__aenter__()
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+            raise ConnectionLost(f"cannot connect {address}: {exc}") from exc
+        try:
+            if resp.status != 200:
+                raise ConnectionLost(f"{url} -> HTTP {resp.status}")
+            buf = b""
+            first = True
+
+            async def _read(n: int) -> bytes:
+                nonlocal buf
+                while len(buf) < n:
+                    chunk = await resp.content.read(65536)
+                    if not chunk:
+                        raise ConnectionLost(
+                            f"{address} stream ended mid-frame")
+                    buf += chunk
+                out, buf = buf[:n], buf[n:]
+                return out
+
+            while True:
+                read_head = _read(8)
+                if first and first_item_timeout is not None:
+                    head = await asyncio.wait_for(read_head,
+                                                  first_item_timeout)
+                else:
+                    head = await read_head
+                hlen, plen = struct.unpack(">II", head)
+                frame = codec.unpack_body(await _read(hlen))
+                payload = await _read(plen) if plen else b""
+                first = False
+                ftype = frame.get("t")
+                if ftype == "data":
+                    yield codec.unpack_body(payload)
+                elif ftype == "end":
+                    return
+                elif ftype == "err":
+                    code = frame.get("c", "handler_error")
+                    if code == "not_found":
+                        raise EndpointNotFound(frame.get("e", subject))
+                    if code == "connection_lost":
+                        raise ConnectionLost(frame.get("e", "lost"))
+                    raise RemoteError(frame.get("e", "remote error"), code)
+        except aiohttp.ClientError as exc:
+            raise ConnectionLost(f"{address}: {exc}") from exc
+        finally:
+            # Closing the response aborts the request server-side — the
+            # cancellation signal (the TCP plane's `cancel` frame analog).
+            await resp_cm.__aexit__(None, None, None)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None

@@ -1,6 +1,7 @@
-"""Request plane tests: TCP streaming RPC, multiplexing, errors, cancellation
-(ref contract: lib/runtime/src/pipeline/network/ tcp client/server +
-push_endpoint)."""
+"""Request plane tests: streaming RPC, multiplexing, errors, cancellation
+over BOTH transports — TCP (two-part frames) and HTTP (chunked frame
+stream) — behind one contract (ref: lib/runtime/src/pipeline/network/
+tcp client/server + egress/http_router.rs, DYN_REQUEST_PLANE)."""
 
 import asyncio
 
@@ -8,22 +9,25 @@ import pytest
 
 from dynamo_tpu.runtime.request_plane import (
     EndpointNotFound,
+    HttpRequestServer,
     RemoteError,
     RequestClient,
     TcpRequestServer,
 )
 
 
-async def _start_server():
-    server = TcpRequestServer("127.0.0.1", 0, advertise_host="127.0.0.1")
+async def _start_server(kind="tcp"):
+    cls = {"tcp": TcpRequestServer, "http": HttpRequestServer}[kind]
+    server = cls("127.0.0.1", 0, advertise_host="127.0.0.1")
     await server.start()
     return server
 
 
-class TestTcpRequestPlane:
-    def test_stream_roundtrip(self, run):
+@pytest.mark.parametrize("kind", ["tcp", "http"])
+class TestRequestPlane:
+    def test_stream_roundtrip(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
 
             async def handler(req, ctx):
                 for i in range(req["n"]):
@@ -40,9 +44,9 @@ class TestTcpRequestPlane:
 
         run(body())
 
-    def test_concurrent_multiplexed_requests(self, run):
+    def test_concurrent_multiplexed_requests(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
 
             async def handler(req, ctx):
                 for i in range(5):
@@ -65,9 +69,9 @@ class TestTcpRequestPlane:
 
         run(body())
 
-    def test_handler_error_propagates(self, run):
+    def test_handler_error_propagates(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
 
             async def handler(req, ctx):
                 yield {"ok": True}
@@ -84,9 +88,9 @@ class TestTcpRequestPlane:
 
         run(body())
 
-    def test_unknown_endpoint(self, run):
+    def test_unknown_endpoint(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
             client = RequestClient()
             with pytest.raises(EndpointNotFound):
                 async for _ in client.call(server.address, "nope", {}):
@@ -96,9 +100,9 @@ class TestTcpRequestPlane:
 
         run(body())
 
-    def test_client_cancellation_stops_handler(self, run):
+    def test_client_cancellation_stops_handler(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
             cancelled = asyncio.Event()
 
             async def handler(req, ctx):
@@ -127,9 +131,9 @@ class TestTcpRequestPlane:
 
         run(body())
 
-    def test_binary_payload_passthrough(self, run):
+    def test_binary_payload_passthrough(self, run, kind):
         async def body():
-            server = await _start_server()
+            server = await _start_server(kind)
 
             async def handler(req, ctx):
                 yield {"data": req["data"] + b"\x00\x01", "len": len(req["data"])}
@@ -145,3 +149,107 @@ class TestTcpRequestPlane:
             await server.close()
 
         run(body())
+
+
+class TestHttpPlaneEndToEnd:
+    def test_runtime_pair_over_http(self, run):
+        """Full DistributedRuntime pair with DYNT_REQUEST_PLANE=http:
+        serve, discover, stream — the transport choice is invisible to the
+        rest of the stack (addresses carry their scheme)."""
+        import uuid
+
+        from dynamo_tpu.runtime import (
+            DistributedRuntime,
+            PushRouter,
+            RuntimeConfig,
+        )
+
+        async def body():
+            cluster = uuid.uuid4().hex
+
+            def cfg():
+                c = RuntimeConfig.from_env()
+                c.discovery_backend = "mem"
+                c.discovery_path = cluster
+                c.request_plane = "http"
+                c.tcp_host = "127.0.0.1"
+                c.event_plane = "mem"
+                c.system_enabled = False
+                return c
+
+            server = await DistributedRuntime(cfg()).start()
+            assert server.request_server.address.startswith("http://")
+            client_rt = await DistributedRuntime(cfg()).start()
+            try:
+                endpoint = (server.namespace("httpns").component("w")
+                            .endpoint("gen"))
+
+                async def handler(body_, ctx=None):
+                    for i in range(3):
+                        yield {"i": i, "echo": body_["x"]}
+
+                await endpoint.serve_endpoint(handler, instance_id=3)
+                cep = (client_rt.namespace("httpns").component("w")
+                       .endpoint("gen").client())
+                await cep.wait_for_instances(1, timeout=10.0)
+                router = PushRouter(cep, mode="round_robin")
+                out = [o async for o in router.generate({"x": "hi"})]
+                assert out == [{"i": 0, "echo": "hi"}, {"i": 1, "echo": "hi"},
+                               {"i": 2, "echo": "hi"}]
+            finally:
+                await client_rt.shutdown()
+                await server.shutdown()
+
+        run(body(), timeout=60.0)
+
+    def test_mixed_transport_cluster(self, run):
+        """A tcp worker and an http worker behind ONE client: the address
+        scheme selects the transport per call."""
+        import uuid
+
+        from dynamo_tpu.runtime import (
+            DistributedRuntime,
+            PushRouter,
+            RuntimeConfig,
+        )
+
+        async def body():
+            cluster = uuid.uuid4().hex
+
+            def cfg(plane):
+                c = RuntimeConfig.from_env()
+                c.discovery_backend = "mem"
+                c.discovery_path = cluster
+                c.request_plane = plane
+                c.tcp_host = "127.0.0.1"
+                c.event_plane = "mem"
+                c.system_enabled = False
+                return c
+
+            rt_tcp = await DistributedRuntime(cfg("tcp")).start()
+            rt_http = await DistributedRuntime(cfg("http")).start()
+            rt_client = await DistributedRuntime(cfg("tcp")).start()
+            try:
+                for rt, iid, tag in ((rt_tcp, 1, "tcp"),
+                                     (rt_http, 2, "http")):
+                    async def handler(body_, ctx=None, tag=tag):
+                        yield {"via": tag}
+
+                    await (rt.namespace("mix").component("w")
+                           .endpoint("gen")
+                           .serve_endpoint(handler, instance_id=iid))
+                cep = (rt_client.namespace("mix").component("w")
+                       .endpoint("gen").client())
+                await cep.wait_for_instances(2, timeout=10.0)
+                router = PushRouter(cep, mode="round_robin")
+                seen = set()
+                for _ in range(4):
+                    out = [o async for o in router.generate({})]
+                    seen.add(out[0]["via"])
+                assert seen == {"tcp", "http"}
+            finally:
+                await rt_client.shutdown()
+                await rt_http.shutdown()
+                await rt_tcp.shutdown()
+
+        run(body(), timeout=60.0)
