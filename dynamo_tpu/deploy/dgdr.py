@@ -64,6 +64,12 @@ class DeploymentRequest:
     engine: str = "worker"
     env: dict = dataclasses.field(default_factory=dict)
     frontend_port: int = 8000
+    # rapid = analytic roofline plan only; measured = plan rapidly, deploy,
+    # then run a REAL sweep against the live deployment and correct the
+    # replica count if the measured ITL misses the SLA (the reference's
+    # "thorough" profiling job, components/src/dynamo/profiler/thorough.py,
+    # folded into the DGDR loop)
+    profile_mode: str = "rapid"
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
@@ -308,6 +314,89 @@ class DgdrController:
         self.specs[name] = spec
         self.profiles[name] = profile
         await self._set_phase(name, DEPLOYING, profile=profile.to_wire())
+        if req.profile_mode == "measured":
+            task = asyncio.create_task(
+                self._measured_correction(name, req, profile, ctl))
+            task.add_done_callback(lambda t: t.exception())
+
+    async def _measured_correction(self, name: str,
+                                   req: DeploymentRequest,
+                                   profile: ProfileResult,
+                                   ctl) -> None:
+        """Thorough-profiling pass: once the deployment reports Deployed,
+        sweep the LIVE frontend at the request's workload shape, publish
+        the measured TTFT/ITL into the status, and scale the decode pool
+        up when the measured ITL misses the SLA (analytic estimates are
+        optimistic exactly when a real engine's batching behaves worse
+        than the roofline — the correction-factor idea the planner applies
+        continuously, done once at deploy time here)."""
+        from ..profiler.sweep import run_sweep_point
+
+        # run_sweep_point appends /v1/... itself
+        url = f"http://127.0.0.1:{req.frontend_port}"
+        deadline = asyncio.get_event_loop().time() + 120.0
+        while asyncio.get_event_loop().time() < deadline:
+            if (self._phase.get(name) == DEPLOYED
+                    and self.deployments.get(name) is ctl):
+                break
+            await asyncio.sleep(0.25)
+        else:
+            log.warning("dgdr %s: measured profiling skipped "
+                        "(never reached Deployed)", name)
+            return
+        # Deployed = processes running; the MODEL registers a beat later
+        # (worker card -> frontend watcher). Gate the sweep on it.
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    async with session.get(url + "/v1/models") as resp:
+                        models = await resp.json()
+                        if any(m.get("id") == req.model
+                               for m in models.get("data", [])):
+                            break
+                except (aiohttp.ClientError, OSError, ValueError):
+                    pass
+                await asyncio.sleep(0.5)
+            else:
+                log.warning("dgdr %s: model %s never listed; measured "
+                            "profiling skipped", name, req.model)
+                return
+        try:
+            point = await run_sweep_point(
+                url, req.model, isl=min(req.isl, 512),
+                osl=min(req.osl, 32),
+                concurrency=min(req.concurrency, 8),
+                num_requests=min(2 * req.concurrency, 24))
+        except Exception as exc:  # noqa: BLE001 — sweep is best-effort
+            log.warning("dgdr %s: measured sweep failed (%r)", name, exc)
+            return
+        if point is None or self.deployments.get(name) is not ctl:
+            return
+        measured = {"ttft_ms_p50": round(point.ttft_ms_p50, 2),
+                    "itl_ms_p50": round(point.itl_ms_p50, 3),
+                    "tokens_per_sec": round(point.tokens_per_sec, 1),
+                    "requests": point.requests}
+        corrected = profile.replicas
+        if point.itl_ms_p50 > req.itl_ms > 0:
+            factor = point.itl_ms_p50 / req.itl_ms
+            corrected = min(
+                math.ceil(profile.replicas * factor),
+                max(1, req.max_chips // max(1, profile.tp)))
+        if corrected != profile.replicas:
+            log.info("dgdr %s: measured itl %.2fms > SLA %.2fms; scaling "
+                     "decode %d -> %d replicas", name, point.itl_ms_p50,
+                     req.itl_ms, profile.replicas, corrected)
+            profile.replicas = corrected
+            profile.total_chips = corrected * profile.tp
+            spec = self.specs.get(name)
+            if spec is not None and "decode" in spec.services:
+                spec.services["decode"].replicas = corrected
+            ctl.set_replicas("decode", corrected)
+        await self._set_phase(name, DEPLOYED, profile=profile.to_wire(),
+                              measured=measured,
+                              services=ctl.status()["services"])
 
     @staticmethod
     def _same_shape(a: GraphDeploymentSpec, b: GraphDeploymentSpec) -> bool:

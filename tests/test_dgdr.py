@@ -278,3 +278,66 @@ class TestDgdrRealProcesses:
             await rt.shutdown()
 
         run(body(), timeout=240.0)
+
+
+class TestDgdrMeasuredProfiling:
+    def test_measured_mode_sweeps_live_deployment(self, run, tmp_path,
+                                                  mem_runtime_config):
+        """profile_mode=measured (the reference's 'thorough' profiling job,
+        folded into the DGDR loop): deploy the rapid plan with the REAL
+        process controller (mocker + frontend), sweep the LIVE frontend,
+        and publish measured TTFT/ITL into the status."""
+        import uuid as _uuid
+
+        from dynamo_tpu.deploy.controller import LocalDeploymentController
+
+        disc = str(tmp_path / "disc")
+        port = 8600 + (_uuid.uuid4().int % 200)
+
+        async def body():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+
+            def factory(spec):
+                spec.env.update({
+                    "DYNT_DISCOVERY_BACKEND": "file",
+                    "DYNT_DISCOVERY_PATH": disc,
+                    "DYNT_LOG_LEVEL": "WARNING",
+                    "JAX_PLATFORMS": "cpu",
+                })
+                return LocalDeploymentController(
+                    spec, log_dir=str(tmp_path / "logs"),
+                    reconcile_interval=0.5)
+
+            dgdr = DgdrController(rt, controller_factory=factory)
+            await dgdr.start()
+            try:
+                req = DeploymentRequest(
+                    name="measured", model="mock-model", engine="mocker",
+                    concurrency=4, max_chips=8, ttft_ms=10000.0,
+                    itl_ms=1000.0, isl=64, osl=8,
+                    frontend_port=port, profile_mode="measured")
+                await submit_request(rt, req)
+
+                deadline = asyncio.get_event_loop().time() + 150
+                st = None
+                while asyncio.get_event_loop().time() < deadline:
+                    st = await get_status(rt, "measured")
+                    if st and st.get("phase") == DEPLOYED \
+                            and "measured" in st:
+                        break
+                    await asyncio.sleep(0.5)
+                assert st and st.get("phase") == DEPLOYED, st
+                assert "measured" in st, st
+                m = st["measured"]
+                assert m["requests"] >= 1
+                assert m["ttft_ms_p50"] > 0
+                assert m["tokens_per_sec"] > 0
+                # generous SLA -> the rapid replica count stood
+                assert st["profile"]["replicas"] >= 1
+            finally:
+                await rt.discovery.delete("v1/dgdr/measured")
+                await asyncio.sleep(0.5)
+                await dgdr.close()
+                await rt.shutdown()
+
+        run(body(), timeout=240)
