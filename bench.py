@@ -79,15 +79,18 @@ def main() -> None:
     else:
         config = get_config(MODEL)
         model_label = MODEL
+    kv_dtype = os.environ.get("DYNT_BENCH_KV_DTYPE", "model")
     runner = ModelRunner(
         config,
         RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
                      max_batch=BATCH, max_pages_per_seq=MAX_PAGES_PER_SEQ,
-                     prefill_buckets=(256,)),
+                     prefill_buckets=(256,), kv_dtype=kv_dtype),
         make_mesh(MeshConfig()),
         host_params,
         seed=0,
     )
+    if kv_dtype != "model":
+        model_label += f" kv={kv_dtype}"
 
     # Prefill BATCH sequences of PROMPT_LEN so decode runs with real KV.
     # Capacity covers prompt + warmup block + timed blocks — undersizing
@@ -104,8 +107,13 @@ def main() -> None:
                                               next_page + pages_per_seq)
         next_page += pages_per_seq
         prompt = rng.integers(0, config.vocab_size, PROMPT_LEN).astype(np.int32)
-        runner.prefill_chunk(prompt, 0, tables[b], PROMPT_LEN,
-                             (0.0, 1.0, 0, 0))
+        budget = runner.max_prefill_chunk
+        start_tok = 0
+        while start_tok < PROMPT_LEN:
+            chunk = prompt[start_tok:start_tok + budget]
+            runner.prefill_chunk(chunk, start_tok, tables[b],
+                                 start_tok + len(chunk), (0.0, 1.0, 0, 0))
+            start_tok += len(chunk)
 
     tokens = np.zeros(BATCH, np.int32)
     positions = np.full(BATCH, PROMPT_LEN, np.int32)

@@ -60,6 +60,14 @@ class RunnerConfig:
     # rank so any adapter mix batches into one compiled step.
     max_loras: int = 0
     lora_rank: int = 8
+    # KV cache storage: "model" (the model dtype, bf16) | "int8"
+    # (quantized pool + per-token head-shared scales). int8 gives ~1.6x
+    # KV CAPACITY (more concurrent sequences / longer contexts per chip);
+    # measured on v5e it currently costs ~25% decode step time (the q8
+    # kernel's per-page DMA overheads outweigh the traffic saving — see
+    # BASELINE.md), so it is a capacity lever, not a latency one, until
+    # the kernel is tuned. Excludes KVBM/disagg transfers in v1.
+    kv_dtype: str = "model"
 
     @property
     def max_context(self) -> int:
@@ -159,9 +167,20 @@ class ModelRunner:
             else _default_decode_attention_fn(mesh))
         axes = param_axes(model_config)
         self._param_sharding = param_shardings(mesh, axes)
-        self._kv_sharding = kv_cache_sharding(
+        self._kv_quantized = runner_config.kv_dtype == "int8"
+        if self._kv_quantized and model_config.is_mla:
+            raise ValueError("int8 KV targets standard-attention models "
+                             "(MLA's latent cache is already compact)")
+        base_kv_sharding = kv_cache_sharding(
             mesh, head_sharded=not model_config.is_mla
         )
+        if self._kv_quantized:
+            # (values, scales): the per-token scales are head-shared and
+            # lane-broadcast — replicated across tp shards.
+            self._kv_sharding = (base_kv_sharding,
+                                 NamedSharding(mesh, P()))
+        else:
+            self._kv_sharding = base_kv_sharding
         if params is None:
             init = jax.jit(
                 partial(init_params, config=model_config),
@@ -175,11 +194,21 @@ class ModelRunner:
             params = jax.tree.map(jax.device_put, params,
                                   self._param_sharding)
         self.params = params
-        kv_init = jax.jit(
-            lambda: make_kv_cache(model_config, runner_config.num_pages,
-                                  runner_config.page_size),
-            out_shardings=self._kv_sharding,
-        )
+        if self._kv_quantized:
+            from ..models.transformer import make_kv_cache_int8
+
+            kv_init = jax.jit(
+                lambda: make_kv_cache_int8(model_config,
+                                           runner_config.num_pages,
+                                           runner_config.page_size),
+                out_shardings=self._kv_sharding,
+            )
+        else:
+            kv_init = jax.jit(
+                lambda: make_kv_cache(model_config, runner_config.num_pages,
+                                      runner_config.page_size),
+                out_shardings=self._kv_sharding,
+            )
         self.kv_cache = kv_init()
         self._rep = NamedSharding(mesh, P())  # replicated host inputs
         self.lora_pack = None
@@ -707,17 +736,31 @@ class ModelRunner:
             self._decode_attention_fn = _default_decode_attention_fn(mesh)
         axes = param_axes(self.model_config)
         self._param_sharding = param_shardings(mesh, axes)
-        self._kv_sharding = kv_cache_sharding(
+        base_kv_sharding = kv_cache_sharding(
             mesh, head_sharded=not self.model_config.is_mla
         )
         self.params = jax.tree.map(
             jax.device_put, self.params, self._param_sharding
         )
-        kv_init = jax.jit(
-            lambda: make_kv_cache(self.model_config, self.config.num_pages,
-                                  self.config.page_size),
-            out_shardings=self._kv_sharding,
-        )
+        if self._kv_quantized:
+            from ..models.transformer import make_kv_cache_int8
+
+            self._kv_sharding = (base_kv_sharding,
+                                 NamedSharding(mesh, P()))
+            kv_init = jax.jit(
+                lambda: make_kv_cache_int8(self.model_config,
+                                           self.config.num_pages,
+                                           self.config.page_size),
+                out_shardings=self._kv_sharding,
+            )
+        else:
+            self._kv_sharding = base_kv_sharding
+            kv_init = jax.jit(
+                lambda: make_kv_cache(self.model_config,
+                                      self.config.num_pages,
+                                      self.config.page_size),
+                out_shardings=self._kv_sharding,
+            )
         self.kv_cache = kv_init()
         self._rep = NamedSharding(mesh, P())
         if self.lora_pack is not None:
@@ -730,6 +773,13 @@ class ModelRunner:
         self._embed_fns = {}
         self._zero_embeds = {}
         log.info("resharded onto mesh %s", dict(mesh.shape))
+
+    def _require_plain_cache(self, what: str) -> None:
+        if self._kv_quantized:
+            raise NotImplementedError(
+                f"{what} is not supported with an int8 KV cache in v1 "
+                "(transfer bundles carry a single array); deploy KVBM/"
+                "disagg pools with kv_dtype='model'")
 
     def gather_pages_device(self, page_ids: np.ndarray,
                             replicated: bool = False):
@@ -747,6 +797,7 @@ class ModelRunner:
         forces it so every host can read the full bundle locally)."""
         from ..ops.block_copy import gather_kv_blocks
 
+        self._require_plain_cache("gather_pages")
         # Pad the id list to a power-of-two width (extra ids hit the
         # scratch page 0) so the gather jit compiles O(log n) shapes, not
         # one per transfer size; slice back on device.
@@ -779,6 +830,7 @@ class ModelRunner:
         device path skips the H2D copy entirely."""
         from ..ops.block_copy import scatter_from_host, scatter_kv_blocks
 
+        self._require_plain_cache("scatter_pages")
         if isinstance(blocks, jax.Array):
             self.kv_cache = scatter_kv_blocks(
                 self.kv_cache, jnp.asarray(page_ids, jnp.int32), blocks

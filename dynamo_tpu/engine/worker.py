@@ -980,6 +980,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--num-pages", type=int, default=2048)
     parser.add_argument("--max-batch", type=int, default=16)
     parser.add_argument("--max-pages-per-seq", type=int, default=128)
+    parser.add_argument("--kv-dtype", default="model",
+                        choices=["model", "int8"],
+                        help="KV cache storage: model dtype (bf16) or "
+                             "int8 (half the decode KV traffic, double "
+                             "the KV capacity; excludes KVBM/disagg "
+                             "transfers in v1)")
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
@@ -1033,6 +1039,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
     component = args.component
     if args.mode == "prefill" and component == "backend":
         component = "prefill"
+    if args.kv_dtype == "int8" and (args.kvbm_host_blocks > 0
+                                    or args.mode != "aggregated"):
+        raise SystemExit("--kv-dtype int8 currently excludes KVBM tiers "
+                         "and disaggregated modes (transfer bundles carry "
+                         "a single array); use aggregated serving")
     kvbm_config = None
     if args.kvbm_host_blocks > 0:
         from ..block_manager import KvbmConfig
@@ -1062,6 +1073,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_batch=args.max_batch,
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
+            kv_dtype=args.kv_dtype,
         )
         if not multihost_cfg.is_driver:
             # Follower: engine only — no runtime, no endpoints. Build a
@@ -1130,6 +1142,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_batch=args.max_batch,
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
+            kv_dtype=args.kv_dtype,
         )
         common = dict(
             model_name=args.model, model_path=args.model_path,
@@ -1176,6 +1189,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_batch=args.max_batch,
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
+            kv_dtype=args.kv_dtype,
         ),
         mesh_config=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp),
         kvbm_config=kvbm_config,

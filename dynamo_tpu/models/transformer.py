@@ -156,6 +156,49 @@ def make_kv_cache(config: ModelConfig, num_pages: int, page_size: int,
 # ---------------------------------------------------------------------------
 
 
+# Lane width of the scale rows: matches the TPU vector lane count so the
+# kernel's per-page scale DMA slices are tiling-aligned and the dequant is
+# a pure elementwise multiply (no lane gathers/reshapes, which Mosaic
+# rejects).
+KV_SCALE_LANES = 128
+
+
+def make_kv_cache_int8(config: ModelConfig, num_pages: int,
+                       page_size: int) -> tuple[jax.Array, jax.Array]:
+    """Quantized paged cache: (values int8 [L, 2, P, ps, kh, hd],
+    scales bf16 [L, 2, P, ps, LANES]) with one absmax scale per TOKEN,
+    shared across heads and lane-broadcast so the Pallas kernel dequant
+    is elementwise. ~1.6x less KV HBM traffic and capacity vs bf16 — the
+    decode bandwidth lever (BASELINE.md decode-wall analysis; the
+    reference gets fp8 KV from its engines' quantized cache modes).
+    Head-sharing costs little: qk-norm families normalize per head, so
+    per-token absmax dominates. Standard-attention models only (MLA's
+    latent is already ~10x smaller)."""
+    assert not config.is_mla, "int8 KV targets standard-attention models"
+    values = jnp.zeros(
+        (config.n_layers, 2, num_pages, page_size, config.n_kv_heads,
+         config.head_dim), jnp.int8)
+    scales = jnp.zeros(
+        (config.n_layers, 2, num_pages, page_size, KV_SCALE_LANES),
+        jnp.bfloat16)
+    return values, scales
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., kh, hd] float -> (int8 [..., kh, hd], lane-broadcast scale
+    bf16 [..., LANES]) — one symmetric absmax scale per TOKEN (shared
+    across heads)."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=(-2, -1))
+    scale = (absmax / 127.0).astype(jnp.bfloat16).astype(jnp.float32)
+    q = jnp.round(x32 / jnp.maximum(scale, 1e-12)[..., None, None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    scale_rows = jnp.broadcast_to(
+        scale[..., None].astype(jnp.bfloat16),
+        scale.shape + (KV_SCALE_LANES,))
+    return q, scale_rows
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     orig = x.dtype
     x32 = x.astype(jnp.float32)
@@ -334,16 +377,25 @@ def _lora_delta(x: jax.Array, entry: dict, idx: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _kv_parts(kv_cache):
+    """(values, scales) for either cache form: plain array (scales=None)
+    or the int8 (values, scales) pair."""
+    if isinstance(kv_cache, tuple):
+        return kv_cache
+    return kv_cache, None
+
+
 def write_kv_pages(
-    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    kv_cache,  # [L, 2, P, ps, kh, hd] or int8 (values, scales) pair
     layer: int,
     k: jax.Array,  # [B, T, kh, hd]
     v: jax.Array,
     block_tables: jax.Array,  # [B, max_pages] int32
     positions: jax.Array,  # [B, T] int32 (absolute positions)
     valid: jax.Array,  # [B, T] bool
-) -> jax.Array:
-    page_size = kv_cache.shape[3]
+):
+    values, scales = _kv_parts(kv_cache)
+    page_size = values.shape[3]
     b, t = positions.shape
     page_of = positions // page_size  # logical page index per token
     page_idx = jnp.take_along_axis(
@@ -354,13 +406,25 @@ def write_kv_pages(
     page_idx = jnp.where(valid, page_idx, 0)
     flat_pages = page_idx.reshape(-1)
     flat_off = offset.reshape(-1)
-    kv_cache = kv_cache.at[layer, 0, flat_pages, flat_off].set(
+    if scales is not None:
+        kq, ks = quantize_kv(k)  # ks: [B, T, LANES] lane-broadcast
+        vq, vs = quantize_kv(v)
+        values = values.at[layer, 0, flat_pages, flat_off].set(
+            kq.reshape(b * t, *kq.shape[2:]), mode="drop")
+        values = values.at[layer, 1, flat_pages, flat_off].set(
+            vq.reshape(b * t, *vq.shape[2:]), mode="drop")
+        scales = scales.at[layer, 0, flat_pages, flat_off].set(
+            ks.reshape(b * t, ks.shape[-1]), mode="drop")
+        scales = scales.at[layer, 1, flat_pages, flat_off].set(
+            vs.reshape(b * t, vs.shape[-1]), mode="drop")
+        return values, scales
+    values = values.at[layer, 0, flat_pages, flat_off].set(
         k.reshape(b * t, *k.shape[2:]), mode="drop"
     )
-    kv_cache = kv_cache.at[layer, 1, flat_pages, flat_off].set(
+    values = values.at[layer, 1, flat_pages, flat_off].set(
         v.reshape(b * t, *v.shape[2:]), mode="drop"
     )
-    return kv_cache
+    return values
 
 
 def paged_attention_xla(
@@ -374,16 +438,26 @@ def paged_attention_xla(
     """Reference paged attention: gather the sequence's pages, run masked
     SDPA. Correct everywhere (CPU tests, fallback); the Pallas kernel
     (ops/paged_attention.py) replaces this on TPU for decode."""
+    values, scales = _kv_parts(kv_cache)
     b, t, qh, hd = q.shape
-    ps = kv_cache.shape[3]
-    kh = kv_cache.shape[4]
+    ps = values.shape[3]
+    kh = values.shape[4]
     max_pages = block_tables.shape[1]
     ctx = max_pages * ps
     # Gather pages: [B, max_pages, ps, kh, hd] -> [B, ctx, kh, hd]
-    k_pages = kv_cache[layer, 0][block_tables]
-    v_pages = kv_cache[layer, 1][block_tables]
+    k_pages = values[layer, 0][block_tables]
+    v_pages = values[layer, 1][block_tables]
     k = k_pages.reshape(b, ctx, kh, hd)
     v = v_pages.reshape(b, ctx, kh, hd)
+    if scales is not None:
+        # [B, mp, ps, LANES] -> per-token scalar (lane 0; rows are
+        # broadcast), shared across heads
+        k_s = scales[layer, 0][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        v_s = scales[layer, 1][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        k = k.astype(jnp.float32) * k_s[..., None, None]
+        v = v.astype(jnp.float32) * v_s[..., None, None]
     group = qh // kh
     qg = q.reshape(b, t, kh, group, hd)
     scores = jnp.einsum("btkgh,bskh->btkgs", qg.astype(jnp.float32),
@@ -413,15 +487,25 @@ def paged_attention_decode_xla(
     the step, so the (TPU-slow) cache scatter is deferred and batched once
     per step for ALL layers (write_kv_stack) instead of 2x per layer —
     scatters dominate small-batch decode latency otherwise."""
+    values, scales = _kv_parts(kv_cache)
     b, _, qh, hd = q.shape
-    ps = kv_cache.shape[3]
-    kh = kv_cache.shape[4]
+    ps = values.shape[3]
+    kh = values.shape[4]
     max_pages = block_tables.shape[1]
     ctx = max_pages * ps
-    k_pages = kv_cache[layer, 0][block_tables]
-    v_pages = kv_cache[layer, 1][block_tables]
+    k_pages = values[layer, 0][block_tables]
+    v_pages = values[layer, 1][block_tables]
     k = k_pages.reshape(b, ctx, kh, hd)
     v = v_pages.reshape(b, ctx, kh, hd)
+    if scales is not None:
+        # [B, mp, ps, LANES] -> per-token scalar (lane 0; rows are
+        # broadcast), shared across heads
+        k_s = scales[layer, 0][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        v_s = scales[layer, 1][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        k = k.astype(jnp.float32) * k_s[..., None, None]
+        v = v.astype(jnp.float32) * v_s[..., None, None]
     group = qh // kh
     qg = q.reshape(b, kh, group, hd)
     scores = jnp.einsum("bkgh,bskh->bkgs", qg.astype(jnp.float32),
@@ -849,29 +933,42 @@ def make_pp_prefill(config: ModelConfig, mesh, n_micro: int):
 
 
 def write_kv_stack(
-    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    kv_cache,  # [L, 2, P, ps, kh, hd] or int8 (values, scales) pair
     k_stack: jax.Array,  # [L, B, T, kh, hd]
     v_stack: jax.Array,
     block_tables: jax.Array,  # [B, max_pages]
     positions: jax.Array,  # [B, T]
     valid: jax.Array,  # [B, T]
-) -> jax.Array:
+):
     """Scatter every layer's K/V chunk into the paged pool in one shot
-    (ring-prefill writeback)."""
+    (deferred decode writeback + ring-prefill writeback)."""
+    values, scales = _kv_parts(kv_cache)
     n_layers, b, t = k_stack.shape[:3]
-    page_size = kv_cache.shape[3]
+    page_size = values.shape[3]
     page_of = positions // page_size
     page_idx = jnp.take_along_axis(block_tables, page_of.astype(jnp.int32), axis=1)
     page_idx = jnp.where(valid, page_idx, 0)  # padding -> scratch page 0
     flat_pages = page_idx.reshape(-1)
     flat_off = (positions % page_size).reshape(-1)
-    kv_cache = kv_cache.at[:, 0, flat_pages, flat_off].set(
+    if scales is not None:
+        kq, ks = quantize_kv(k_stack)  # ks: [L, B, T, LANES]
+        vq, vs = quantize_kv(v_stack)
+        values = values.at[:, 0, flat_pages, flat_off].set(
+            kq.reshape(n_layers, b * t, *kq.shape[3:]), mode="drop")
+        values = values.at[:, 1, flat_pages, flat_off].set(
+            vq.reshape(n_layers, b * t, *vq.shape[3:]), mode="drop")
+        scales = scales.at[:, 0, flat_pages, flat_off].set(
+            ks.reshape(n_layers, b * t, ks.shape[-1]), mode="drop")
+        scales = scales.at[:, 1, flat_pages, flat_off].set(
+            vs.reshape(n_layers, b * t, vs.shape[-1]), mode="drop")
+        return values, scales
+    values = values.at[:, 0, flat_pages, flat_off].set(
         k_stack.reshape(n_layers, b * t, *k_stack.shape[3:]), mode="drop"
     )
-    kv_cache = kv_cache.at[:, 1, flat_pages, flat_off].set(
+    values = values.at[:, 1, flat_pages, flat_off].set(
         v_stack.reshape(n_layers, b * t, *v_stack.shape[3:]), mode="drop"
     )
-    return kv_cache
+    return values
 
 
 def forward_embed(

@@ -450,6 +450,247 @@ def _pool_decode_kernel(
         l_out_ref[0] = l_ref[...]
 
 
+def _pool_decode_kernel_q8(
+    # scalar prefetch
+    lengths_ref,  # [B] int32 HISTORY lengths (current token excluded)
+    tables_ref,  # [B * max_pages] int32 flattened block tables
+    layer_ref,  # [1] int32
+    buf_idx_ref,  # [1] int32 (mutable scalar-prefetch: double-buffer slot)
+    init_ref,  # [1] int32 (1 until the first DMA was issued)
+    # inputs
+    q_ref,  # [1, kh, g, hd] (block for this b)
+    pool_ref,  # FULL int8 [L, 2, P, ps, kh, hd] in HBM (ANY)
+    scale_ref,  # FULL bf16 [L, 2, P, ps, LANES] in HBM (ANY): per-token
+    # head-shared scales, lane-broadcast so the page DMA slice
+    # ([ps, 128]) is tiling-aligned and the dequant is elementwise
+    # outputs (blocks per b)
+    acc_ref,  # [1, kh, g, hd] f32 unnormalized accumulator
+    m_out_ref,  # [1, kh, g, 128] f32
+    l_out_ref,  # [1, kh, g, 128] f32
+    # scratch
+    k_buf,  # [2, C, ps, kh, hd] int8 double-buffered page chunks
+    v_buf,
+    ks_buf,  # [2, C, ps, LANES] bf16 lane-broadcast per-token scales
+    vs_buf,
+    k_sems,
+    v_sems,
+    m_ref,
+    l_ref,
+    o_ref,
+    *,
+    pages_per_chunk: int,
+    max_pages: int,
+    batch_size: int,
+):
+    """int8 variant of _pool_decode_kernel: pages stream as int8 (HALF the
+    HBM traffic of bf16 — decode's dominant KV cost) plus tiny f32
+    per-token scale rows; dequantization happens in VMEM right before the
+    flash accumulation."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_chunks = pl.num_programs(1)
+    ps = k_buf.shape[2]
+    bk = pages_per_chunk * ps
+    layer = layer_ref[0]
+    length = lengths_ref[b]
+
+    def start_copy(bi, ci, slot):
+        base = bi * max_pages + ci * pages_per_chunk
+        copies = []
+        for j in range(pages_per_chunk):
+            page = tables_ref[base + j]
+            copies.append(pltpu.make_async_copy(
+                pool_ref.at[layer, 0, page], k_buf.at[slot, j],
+                k_sems.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                pool_ref.at[layer, 1, page], v_buf.at[slot, j],
+                v_sems.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                scale_ref.at[layer, 0, page], ks_buf.at[slot, j],
+                k_sems.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                scale_ref.at[layer, 1, page], vs_buf.at[slot, j],
+                v_sems.at[slot]))
+        for c in copies:
+            c.start()
+
+    def wait_copy(bi, ci, slot):
+        base = bi * max_pages + ci * pages_per_chunk
+        for j in range(pages_per_chunk):
+            page = tables_ref[base + j]
+            pltpu.make_async_copy(pool_ref.at[layer, 0, page],
+                                  k_buf.at[slot, j], k_sems.at[slot]).wait()
+            pltpu.make_async_copy(pool_ref.at[layer, 1, page],
+                                  v_buf.at[slot, j], v_sems.at[slot]).wait()
+            pltpu.make_async_copy(scale_ref.at[layer, 0, page],
+                                  ks_buf.at[slot, j],
+                                  k_sems.at[slot]).wait()
+            pltpu.make_async_copy(scale_ref.at[layer, 1, page],
+                                  vs_buf.at[slot, j],
+                                  v_sems.at[slot]).wait()
+
+    def next_active(bi, ci):
+        def advance_b():
+            nb = jax.lax.fori_loop(
+                0, batch_size,
+                lambda _, cur: jnp.where(
+                    jnp.logical_and(
+                        cur < batch_size,
+                        lengths_ref[jnp.clip(cur, 0, batch_size - 1)] == 0),
+                    cur + 1, cur),
+                bi + 1)
+            return nb, jnp.int32(0)
+
+        return jax.lax.cond((ci + 1) * bk < length,
+                            lambda: (bi, ci + 1), advance_b)
+
+    active = i * bk < length
+
+    @pl.when(jnp.logical_and(active, init_ref[0] == 1))
+    def _first():
+        start_copy(b, i, buf_idx_ref[0])
+        init_ref[0] = 0
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(active)
+    def _compute():
+        slot = buf_idx_ref[0]
+        nb, ni = next_active(b, i)
+
+        @pl.when(nb < batch_size)
+        def _prefetch():
+            nslot = jnp.where(slot == 0, 1, 0)
+            start_copy(nb, ni, nslot)
+            buf_idx_ref[0] = nslot
+
+        wait_copy(b, i, slot)
+        q = q_ref[0].astype(jnp.float32)  # [kh, g, hd]
+        kh = k_buf.shape[3]
+        k = k_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
+        v = v_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
+        # [C, ps, LANES] -> [bk, LANES]; rows are lane-broadcast scalars
+        # and hd == LANES (the q8 eligibility gate), so the dequant is a
+        # straight elementwise multiply.
+        ks = ks_buf[slot].astype(jnp.float32).reshape(bk, -1)
+        vs = vs_buf[slot].astype(jnp.float32).reshape(bk, -1)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        pos = i * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[1], bk), 1)  # [g, t]
+        hd_ = k.shape[-1]
+        for h in range(kh):
+            qh_ = q[h]  # [g, hd]
+            # identity slice on the TPU-eligible geometry (hd == LANES);
+            # narrower hd only occurs in interpret mode (the dispatcher
+            # gates real-TPU use on hd == LANES)
+            kh_ = k[:, h, :] * ks[:, :hd_]  # dequant [t, hd]
+            vh_ = v[:, h, :] * vs[:, :hd_]
+            scores = jax.lax.dot_general(
+                qh_, kh_, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [g, t]
+            scores = jnp.where(pos < length, scores, -jnp.inf)
+            m_prev = m_ref[h, :, 0:1]
+            l_prev = l_ref[h, :, 0:1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            probs = jnp.exp(scores - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                probs, vh_, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[h] = o_ref[h] * alpha + pv
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(i == n_chunks - 1)
+    def _finish():
+        acc_ref[0] = o_ref[...]
+        m_out_ref[0] = m_ref[...]
+        l_out_ref[0] = l_ref[...]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("pages_per_chunk", "interpret"))
+def paged_decode_attention_pool_q8(
+    q: jax.Array,  # [B, qh, hd]
+    kv_pool: jax.Array,  # int8 [L, 2, P, ps, kh, hd]
+    kv_scales: jax.Array,  # bf16 [L, 2, P, ps, LANES] lane-broadcast
+    layer: jax.Array,
+    block_tables: jax.Array,
+    kv_lens_hist: jax.Array,
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """int8-pool chunked-DMA flash partials (see _pool_decode_kernel_q8)."""
+    b, qh, hd = q.shape
+    ps, kh = kv_pool.shape[3], kv_pool.shape[4]
+    group = qh // kh
+    max_pages = block_tables.shape[1]
+    ppc = min(pages_per_chunk, max_pages)
+    while max_pages % ppc:
+        ppc -= 1
+    n_chunks = max_pages // ppc
+    qg = q.reshape(b, kh, group, hd)
+
+    def q_map(bi, ci, *refs):
+        del ci, refs
+        return (bi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, kh, group, hd), q_map),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, kh, group, hd), q_map),
+            pl.BlockSpec((1, kh, group, 128), q_map),
+            pl.BlockSpec((1, kh, group, 128), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]),
+                       kv_scales.dtype),
+            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]),
+                       kv_scales.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((kh, group, 128), jnp.float32),
+            pltpu.VMEM((kh, group, 128), jnp.float32),
+            pltpu.VMEM((kh, group, hd), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_pool_decode_kernel_q8, pages_per_chunk=ppc,
+                          max_pages=max_pages, batch_size=b),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kh, group, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(kv_lens_hist.astype(jnp.int32),
+      block_tables.reshape(-1).astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
+      jnp.zeros((1,), jnp.int32),
+      jnp.ones((1,), jnp.int32),
+      qg, kv_pool, kv_scales)
+    return acc, m[..., 0], l[..., 0]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("pages_per_chunk", "interpret"))
 def paged_decode_attention_pool(
@@ -567,7 +808,7 @@ def _combine_current(q, acc, m, l, k_cur, v_cur):
 
 def paged_attention_decode_pool(
     q: jax.Array,  # [B, 1, qh, hd]
-    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    kv_cache,  # [L, 2, P, ps, kh, hd] or int8 (values, scales) pair
     layer,
     block_tables: jax.Array,
     kv_lens: jax.Array,  # [B] INCLUDING the current token
@@ -580,7 +821,26 @@ def paged_attention_decode_pool(
     """Deferred-write decode attention via the whole-pool chunked-DMA
     kernel — the production TPU path: no per-layer pool slices (no copies),
     one DMA per page covering all kv heads, double-buffered against the
-    flash compute. Drop-in for `transformer.paged_attention_decode_xla`."""
+    flash compute. Drop-in for `transformer.paged_attention_decode_xla`.
+    An int8 (values, scales) cache takes the q8 kernel: half the page DMA
+    bytes, dequantization fused into the VMEM flash loop."""
+    if isinstance(kv_cache, tuple):
+        values, scales = kv_cache
+        hd_ = values.shape[5]
+        if hd_ != scales.shape[-1] and not interpret:
+            # The elementwise dequant needs head_dim == the scale lane
+            # width (128); other geometries take the XLA dequant path.
+            from ..models.transformer import paged_attention_decode_xla
+
+            return paged_attention_decode_xla(q, kv_cache, layer,
+                                              block_tables, kv_lens,
+                                              k_cur, v_cur)
+        acc, m, l = paged_decode_attention_pool_q8(
+            q[:, 0], values, scales, layer, block_tables,
+            jnp.maximum(kv_lens - 1, 0),
+            pages_per_chunk=pages_per_chunk, interpret=interpret,
+        )
+        return _combine_current(q, acc, m, l, k_cur, v_cur)
     acc, m, l = paged_decode_attention_pool(
         q[:, 0], kv_cache, layer, block_tables,
         jnp.maximum(kv_lens - 1, 0),
@@ -609,6 +869,8 @@ def make_paged_attention_decode_pool_tp(mesh, *, pages_per_chunk: int = 8,
 
     q_spec = P(None, None, AXIS_TP, None)  # [B, 1, heads, hd]
     kv_spec = P(None, None, None, None, AXIS_TP, None)
+    # per-token scales are head-shared: replicated across tp shards
+    scale_spec = P()
     rep = P()
 
     def local(q, kv_cache, layer, block_tables, kv_lens, k_cur, v_cur):
@@ -616,17 +878,26 @@ def make_paged_attention_decode_pool_tp(mesh, *, pages_per_chunk: int = 8,
             q, kv_cache, layer, block_tables, kv_lens, k_cur, v_cur,
             pages_per_chunk=pages_per_chunk, interpret=interpret)
 
-    sharded = shard_map(
-        local, mesh=mesh,
-        in_specs=(q_spec, kv_spec, rep, rep, rep, q_spec, q_spec),
-        out_specs=q_spec,
-        # pallas_call's out_shape carries no varying-mesh-axes annotation;
-        # the kernel is per-shard pure (no collectives), so the static
-        # check adds nothing here.
-        check_vma=False,
-    )
+    def build(cache_spec):
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(q_spec, cache_spec, rep, rep, rep, q_spec, q_spec),
+            out_specs=q_spec,
+            # pallas_call's out_shape carries no varying-mesh-axes
+            # annotation; the kernel is per-shard pure (no collectives),
+            # so the static check adds nothing here.
+            check_vma=False,
+        )
+
+    variants = {}  # plain | q8, built on first use
 
     def fn(q, kv_cache, layer, block_tables, kv_lens, k_cur, v_cur):
+        quantized = isinstance(kv_cache, tuple)
+        key = "q8" if quantized else "plain"
+        sharded = variants.get(key)
+        if sharded is None:
+            sharded = build((kv_spec, scale_spec) if quantized else kv_spec)
+            variants[key] = sharded
         return sharded(q, kv_cache, jnp.asarray(layer, jnp.int32),
                        block_tables, kv_lens, k_cur, v_cur)
 
@@ -651,7 +922,10 @@ def paged_attention(
     """
     from ..models.transformer import paged_attention_xla
 
-    if q.shape[1] != 1:
+    if q.shape[1] != 1 or isinstance(kv_cache, tuple):
+        # Prefill chunks are compute-bound (XLA's fused SDPA is already
+        # MXU-shaped); int8 caches dequantize on the XLA path here — the
+        # q8 Pallas kernel covers the decode hot loop.
         return paged_attention_xla(q, kv_cache, layer, block_tables,
                                    positions, kv_lens)
     out = paged_decode_attention(

@@ -1,0 +1,221 @@
+"""int8 KV cache: quantized pool + per-token-per-head scales.
+
+Halves the decode KV HBM traffic and doubles KV capacity (the reference
+gets fp8 KV from its engines' quantized cache modes; BASELINE.md decode-
+wall analysis motivates it here). Accuracy oracle: the same forward with
+a full-precision cache."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models import get_config, init_params
+from dynamo_tpu.models.transformer import (
+    forward,
+    forward_decode,
+    make_kv_cache,
+    make_kv_cache_int8,
+    paged_attention_decode_xla,
+    quantize_kv,
+)
+
+
+class TestQuantize:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        # [B, T, kh, hd]: one scale per (B, T) token, shared across heads,
+        # returned lane-broadcast [B, T, 128] in bf16
+        x = jnp.asarray(rng.normal(size=(2, 5, 4, 128)) * 3.0, jnp.float32)
+        q, s = quantize_kv(x)
+        assert q.dtype == jnp.int8 and s.shape == (2, 5, 128)
+        assert s.dtype == jnp.bfloat16
+        # lane-broadcast rows: every lane carries the same scalar
+        s_np = np.asarray(s, np.float32)
+        assert (s_np == s_np[..., :1]).all()
+        deq = np.asarray(q, np.float32) * s_np[:, :, :1][..., None]
+        err = np.abs(deq - np.asarray(x))
+        # half an int8 lsb + bf16 scale rounding slack
+        bound = s_np[:, :, :1][..., None] * 0.51 + 1e-6
+        assert (err <= bound).all()
+
+    def test_zero_rows_stay_zero(self):
+        q, s = quantize_kv(jnp.zeros((2, 5, 4, 16)))
+        assert np.asarray(q).sum() == 0
+        assert np.asarray(s, np.float32).sum() == 0
+
+
+def _fp32_cfg():
+    return dataclasses.replace(get_config("tiny-test"), dtype="float32")
+
+
+def _prefill_both(cfg, n_pages=16, page_size=4, t=12):
+    """Populate a plain fp32 cache and an int8 cache with the same chunk;
+    returns (tokens, positions, tables, caches...)."""
+    rng = np.random.default_rng(3)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, t)), jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    tables = jnp.arange(1, n_pages, dtype=jnp.int32)[None, :]
+    kv_plain = make_kv_cache(cfg, n_pages, page_size)
+    kv_q8 = make_kv_cache_int8(cfg, n_pages, page_size)
+    kv_lens = jnp.asarray([t], jnp.int32)
+    kv_plain, logits_plain = forward(params, cfg, tokens, positions,
+                                     kv_plain, tables, kv_lens)
+    kv_q8, logits_q8 = forward(params, cfg, tokens, positions,
+                               kv_q8, tables, kv_lens)
+    return params, tokens, tables, kv_plain, kv_q8, logits_plain, logits_q8
+
+
+class TestForwardWithInt8Cache:
+    def test_prefill_and_decode_match_fp32_cache(self):
+        cfg = _fp32_cfg()
+        (params, tokens, tables, kv_plain, kv_q8,
+         logits_plain, logits_q8) = _prefill_both(cfg)
+        # Prefill logits: in-chunk attention reads the just-written pages;
+        # int8 error is bounded by the quantization step.
+        np.testing.assert_allclose(np.asarray(logits_q8),
+                                   np.asarray(logits_plain),
+                                   atol=0.3, rtol=0.08)
+        t = tokens.shape[1]
+        nxt = jnp.asarray([7], jnp.int32)
+        kv_lens = jnp.asarray([t + 1], jnp.int32)
+        active = jnp.ones((1,), bool)
+        _, dec_plain = forward_decode(params, cfg, nxt,
+                                      jnp.asarray([t], jnp.int32),
+                                      kv_plain, tables, kv_lens, active)
+        _, dec_q8 = forward_decode(params, cfg, nxt,
+                                   jnp.asarray([t], jnp.int32),
+                                   kv_q8, tables, kv_lens, active)
+        np.testing.assert_allclose(np.asarray(dec_q8),
+                                   np.asarray(dec_plain),
+                                   atol=0.3, rtol=0.08)
+        # greedy choice is stable under the quantization noise here
+        assert (int(np.argmax(np.asarray(dec_q8)[0, 0]))
+                == int(np.argmax(np.asarray(dec_plain)[0, 0])))
+
+    def test_int8_cache_updates_are_tuples(self):
+        cfg = _fp32_cfg()
+        _params, _tok, _tables, _plain, kv_q8, _a, _b = _prefill_both(cfg)
+        assert isinstance(kv_q8, tuple) and len(kv_q8) == 2
+        assert kv_q8[0].dtype == jnp.int8
+        assert kv_q8[1].dtype == jnp.bfloat16
+
+
+class TestPoolKernelQ8:
+    def _case(self, b=4, qh=8, kh=4, hd=64, ps=8, n_pages=32, max_pages=6,
+              seed=5):
+        rng = np.random.default_rng(seed)
+        L = 2
+        kf = jnp.asarray(rng.normal(size=(L, 2, n_pages, ps, kh, hd)),
+                         jnp.float32)
+        qv, qs = quantize_kv(kf)
+        q = jnp.asarray(rng.normal(size=(b, 1, qh, hd)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(b, 1, kh, hd)), jnp.float32)
+        ids = rng.permutation(n_pages - 1)[: b * max_pages] \
+            .reshape(b, max_pages)
+        bt = jnp.asarray(ids + 1, jnp.int32) % n_pages
+        kl = jnp.asarray([1, 13, 47, 30], jnp.int32)
+        return q, (qv, qs), bt, kl, kc, vc
+
+    @pytest.mark.parametrize("ppc", [2, 3])
+    def test_q8_kernel_matches_xla_dequant(self, ppc):
+        from dynamo_tpu.ops.paged_attention import (
+            paged_attention_decode_pool,
+        )
+
+        q, kv_q8, bt, kl, kc, vc = self._case()
+        for layer in (0, 1):
+            got = paged_attention_decode_pool(
+                q, kv_q8, layer, bt, kl, kc, vc, pages_per_chunk=ppc,
+                interpret=True)
+            want = paged_attention_decode_xla(q, kv_q8, layer, bt, kl,
+                                              kc, vc)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_q8_kernel_tp2_matches_oracle(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dynamo_tpu.ops.paged_attention import (
+            make_paged_attention_decode_pool_tp,
+        )
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(tp=2))
+        q, (qv, qs), bt, kl, kc, vc = self._case()
+        qv = jax.device_put(qv, NamedSharding(
+            mesh, P(None, None, None, None, "tp", None)))
+        qs = jax.device_put(qs, NamedSharding(mesh, P()))  # head-shared
+        fn = make_paged_attention_decode_pool_tp(mesh, pages_per_chunk=2,
+                                                 interpret=True)
+        got = fn(q, (qv, qs), 1, bt, kl, kc, vc)
+        want = paged_attention_decode_xla(q, (qv, qs), 1, bt, kl, kc, vc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestRunnerInt8:
+    def _runner(self, kv_dtype):
+        from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        return ModelRunner(
+            get_config("tiny-test"),
+            RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                         max_pages_per_seq=16, prefill_buckets=(16, 32),
+                         kv_dtype=kv_dtype),
+            make_mesh(MeshConfig()),
+            seed=0,
+        )
+
+    def test_serving_loop_runs_and_matches_bf16_greedy(self):
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, 500, 20).astype(np.int32)
+        table = np.zeros(16, np.int32)
+        table[:8] = np.arange(1, 9)
+        outs = {}
+        for dtype in ("model", "int8"):
+            r = self._runner(dtype)
+            first = r.prefill_chunk(prompt, 0, table, len(prompt),
+                                    (0.0, 1.0, 0, 0))
+            toks = [first]
+            tok = first
+            for i in range(6):
+                pos = len(prompt) + i
+                nxt = r.decode(
+                    np.array([tok], np.int32), np.array([pos], np.int32),
+                    table[None, :], np.array([pos + 1], np.int32),
+                    np.array([True]), np.zeros(1, np.float32),
+                    np.ones(1, np.float32), np.zeros(1, np.int32),
+                    np.zeros(1, np.uint32), np.array([i], np.int32))
+                tok = int(nxt[0])
+                toks.append(tok)
+            outs[dtype] = toks
+        # bf16's own rounding noise is larger than int8-KV quantization
+        # noise at this scale; greedy streams agree on the tiny model.
+        assert outs["int8"] == outs["model"]
+
+    def test_transfer_paths_guarded(self):
+        r = self._runner("int8")
+        with pytest.raises(NotImplementedError, match="int8"):
+            r.gather_pages(np.array([1, 2], np.int32))
+        with pytest.raises(NotImplementedError, match="int8"):
+            r.scatter_pages(np.array([1], np.int32),
+                            np.zeros((1, 2, 2, 4, 2, 16), np.float32))
+
+    def test_mla_rejected(self):
+        from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        with pytest.raises(ValueError, match="int8 KV"):
+            ModelRunner(get_config("tiny-mla-test"),
+                        RunnerConfig(page_size=4, num_pages=32,
+                                     max_batch=2, max_pages_per_seq=8,
+                                     prefill_buckets=(16,),
+                                     kv_dtype="int8"),
+                        make_mesh(MeshConfig()), seed=0)
