@@ -167,10 +167,25 @@ class ModelRunner:
             else _default_decode_attention_fn(mesh))
         axes = param_axes(model_config)
         self._param_sharding = param_shardings(mesh, axes)
+        if runner_config.kv_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {runner_config.kv_dtype!r} "
+                "(expected 'model' or 'int8')")
         self._kv_quantized = runner_config.kv_dtype == "int8"
         if self._kv_quantized and model_config.is_mla:
             raise ValueError("int8 KV targets standard-attention models "
                              "(MLA's latent cache is already compact)")
+        if self._kv_quantized:
+            from ..models.transformer import KV_SCALE_LANES
+
+            if model_config.head_dim != KV_SCALE_LANES:
+                # The q8 kernel's elementwise dequant needs head_dim ==
+                # the scale lane width; anything else would silently run
+                # every decode step on the ~10x-slower XLA gather path.
+                raise ValueError(
+                    f"int8 KV requires head_dim == {KV_SCALE_LANES} "
+                    f"(model has {model_config.head_dim}); the Pallas q8 "
+                    "kernel cannot cover this geometry yet")
         base_kv_sharding = kv_cache_sharding(
             mesh, head_sharded=not model_config.is_mla
         )

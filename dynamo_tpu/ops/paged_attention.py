@@ -301,25 +301,15 @@ def _pool_decode_kernel(
     layer_ref,  # [1] int32
     buf_idx_ref,  # [1] int32 (mutable scalar-prefetch: double-buffer slot)
     init_ref,  # [1] int32 (1 until the first DMA was issued)
-    # inputs
+    # inputs + outputs + scratch, order depending on `quantized` —
+    # unpacked below (Pallas passes refs positionally)
     q_ref,  # [1, kh, g, hd] (block for this b)
     pool_ref,  # FULL [L, 2, P, ps, kh, hd] in HBM (memory_space=ANY)
-    # outputs (blocks per b)
-    acc_ref,  # [1, kh, g, hd] f32 unnormalized accumulator
-    m_out_ref,  # [1, kh, g, 128] f32
-    l_out_ref,  # [1, kh, g, 128] f32
-    # scratch
-    k_buf,  # [2, C, ps, kh, hd] double-buffered page chunks
-    v_buf,
-    k_sems,  # DMA semaphores (2,)
-    v_sems,
-    m_ref,  # [kh, g, 128] f32
-    l_ref,
-    o_ref,  # [kh, g, hd] f32
-    *,
+    *rest,
     pages_per_chunk: int,
     max_pages: int,
     batch_size: int,
+    quantized: bool = False,
 ):
     """Flash decode over the paged HISTORY reading the WHOLE pool ref.
 
@@ -334,7 +324,28 @@ def _pool_decode_kernel(
         and double-buffer against compute (the technique of the public
         jax paged_attention_kernel, adapted to page-major pools, layer
         indexing, and unnormalized partials for deferred cache writes).
+
+    `quantized` (static) adds an int8 path: pages stream as int8 (HALF
+    the HBM traffic of bf16) plus per-token head-shared bf16 scale rows
+    ([ps, LANES], lane-broadcast so the per-page DMA slice is
+    tiling-aligned), dequantized elementwise in VMEM right before the
+    flash accumulation.
     """
+    if quantized:
+        (scale_ref,  # FULL bf16 [L, 2, P, ps, LANES] in HBM (ANY)
+         acc_ref, m_out_ref, l_out_ref,
+         k_buf, v_buf,  # [2, C, ps, kh, hd] double-buffered page chunks
+         ks_buf, vs_buf,  # [2, C, ps, LANES] lane-broadcast scales
+         k_sems, v_sems, m_ref, l_ref, o_ref) = rest
+    else:
+        scale_ref = ks_buf = vs_buf = None
+        (acc_ref,  # [1, kh, g, hd] f32 unnormalized accumulator
+         m_out_ref,  # [1, kh, g, 128] f32
+         l_out_ref,  # [1, kh, g, 128] f32
+         k_buf, v_buf,  # [2, C, ps, kh, hd] double-buffered page chunks
+         k_sems, v_sems,  # DMA semaphores (2,)
+         m_ref, l_ref,  # [kh, g, 128] f32
+         o_ref) = rest  # [kh, g, hd] f32
     b = pl.program_id(0)
     i = pl.program_id(1)
     n_chunks = pl.num_programs(1)
@@ -356,6 +367,13 @@ def _pool_decode_kernel(
             copies.append(pltpu.make_async_copy(
                 pool_ref.at[layer, 1, page], v_buf.at[slot, j],
                 v_sems.at[slot]))
+            if quantized:
+                copies.append(pltpu.make_async_copy(
+                    scale_ref.at[layer, 0, page], ks_buf.at[slot, j],
+                    k_sems.at[slot]))
+                copies.append(pltpu.make_async_copy(
+                    scale_ref.at[layer, 1, page], vs_buf.at[slot, j],
+                    v_sems.at[slot]))
         for c in copies:
             c.start()
 
@@ -369,6 +387,13 @@ def _pool_decode_kernel(
                                   k_buf.at[slot, j], k_sems.at[slot]).wait()
             pltpu.make_async_copy(pool_ref.at[layer, 1, page],
                                   v_buf.at[slot, j], v_sems.at[slot]).wait()
+            if quantized:
+                pltpu.make_async_copy(scale_ref.at[layer, 0, page],
+                                      ks_buf.at[slot, j],
+                                      k_sems.at[slot]).wait()
+                pltpu.make_async_copy(scale_ref.at[layer, 1, page],
+                                      vs_buf.at[slot, j],
+                                      v_sems.at[slot]).wait()
 
     def next_active(bi, ci):
         """First active (b, chunk) after (bi, ci) — sequences with zero
@@ -416,6 +441,14 @@ def _pool_decode_kernel(
         kh = k_buf.shape[3]
         k = k_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
         v = v_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
+        hd_ = k.shape[-1]
+        if quantized:
+            # [C, ps, LANES] -> [bk, LANES]: lane-broadcast per-token
+            # scalars; sliced to hd (identity on the TPU-eligible
+            # hd == LANES geometry — the dispatcher gates on it; narrower
+            # hd only occurs in interpret mode).
+            ks = ks_buf[slot].astype(jnp.float32).reshape(bk, -1)[:, :hd_]
+            vs = vs_buf[slot].astype(jnp.float32).reshape(bk, -1)[:, :hd_]
         scale = 1.0 / math.sqrt(q.shape[-1])
         pos = i * bk + jax.lax.broadcasted_iota(
             jnp.int32, (q.shape[1], bk), 1)  # [g, t]
@@ -425,6 +458,9 @@ def _pool_decode_kernel(
             qh_ = q[h]  # [g, hd]
             kh_ = k[:, h, :]  # [t, hd]
             vh_ = v[:, h, :]
+            if quantized:
+                kh_ = kh_ * ks  # elementwise dequant
+                vh_ = vh_ * vs
             scores = jax.lax.dot_general(
                 qh_, kh_, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [g, t]
@@ -450,247 +486,6 @@ def _pool_decode_kernel(
         l_out_ref[0] = l_ref[...]
 
 
-def _pool_decode_kernel_q8(
-    # scalar prefetch
-    lengths_ref,  # [B] int32 HISTORY lengths (current token excluded)
-    tables_ref,  # [B * max_pages] int32 flattened block tables
-    layer_ref,  # [1] int32
-    buf_idx_ref,  # [1] int32 (mutable scalar-prefetch: double-buffer slot)
-    init_ref,  # [1] int32 (1 until the first DMA was issued)
-    # inputs
-    q_ref,  # [1, kh, g, hd] (block for this b)
-    pool_ref,  # FULL int8 [L, 2, P, ps, kh, hd] in HBM (ANY)
-    scale_ref,  # FULL bf16 [L, 2, P, ps, LANES] in HBM (ANY): per-token
-    # head-shared scales, lane-broadcast so the page DMA slice
-    # ([ps, 128]) is tiling-aligned and the dequant is elementwise
-    # outputs (blocks per b)
-    acc_ref,  # [1, kh, g, hd] f32 unnormalized accumulator
-    m_out_ref,  # [1, kh, g, 128] f32
-    l_out_ref,  # [1, kh, g, 128] f32
-    # scratch
-    k_buf,  # [2, C, ps, kh, hd] int8 double-buffered page chunks
-    v_buf,
-    ks_buf,  # [2, C, ps, LANES] bf16 lane-broadcast per-token scales
-    vs_buf,
-    k_sems,
-    v_sems,
-    m_ref,
-    l_ref,
-    o_ref,
-    *,
-    pages_per_chunk: int,
-    max_pages: int,
-    batch_size: int,
-):
-    """int8 variant of _pool_decode_kernel: pages stream as int8 (HALF the
-    HBM traffic of bf16 — decode's dominant KV cost) plus tiny f32
-    per-token scale rows; dequantization happens in VMEM right before the
-    flash accumulation."""
-    b = pl.program_id(0)
-    i = pl.program_id(1)
-    n_chunks = pl.num_programs(1)
-    ps = k_buf.shape[2]
-    bk = pages_per_chunk * ps
-    layer = layer_ref[0]
-    length = lengths_ref[b]
-
-    def start_copy(bi, ci, slot):
-        base = bi * max_pages + ci * pages_per_chunk
-        copies = []
-        for j in range(pages_per_chunk):
-            page = tables_ref[base + j]
-            copies.append(pltpu.make_async_copy(
-                pool_ref.at[layer, 0, page], k_buf.at[slot, j],
-                k_sems.at[slot]))
-            copies.append(pltpu.make_async_copy(
-                pool_ref.at[layer, 1, page], v_buf.at[slot, j],
-                v_sems.at[slot]))
-            copies.append(pltpu.make_async_copy(
-                scale_ref.at[layer, 0, page], ks_buf.at[slot, j],
-                k_sems.at[slot]))
-            copies.append(pltpu.make_async_copy(
-                scale_ref.at[layer, 1, page], vs_buf.at[slot, j],
-                v_sems.at[slot]))
-        for c in copies:
-            c.start()
-
-    def wait_copy(bi, ci, slot):
-        base = bi * max_pages + ci * pages_per_chunk
-        for j in range(pages_per_chunk):
-            page = tables_ref[base + j]
-            pltpu.make_async_copy(pool_ref.at[layer, 0, page],
-                                  k_buf.at[slot, j], k_sems.at[slot]).wait()
-            pltpu.make_async_copy(pool_ref.at[layer, 1, page],
-                                  v_buf.at[slot, j], v_sems.at[slot]).wait()
-            pltpu.make_async_copy(scale_ref.at[layer, 0, page],
-                                  ks_buf.at[slot, j],
-                                  k_sems.at[slot]).wait()
-            pltpu.make_async_copy(scale_ref.at[layer, 1, page],
-                                  vs_buf.at[slot, j],
-                                  v_sems.at[slot]).wait()
-
-    def next_active(bi, ci):
-        def advance_b():
-            nb = jax.lax.fori_loop(
-                0, batch_size,
-                lambda _, cur: jnp.where(
-                    jnp.logical_and(
-                        cur < batch_size,
-                        lengths_ref[jnp.clip(cur, 0, batch_size - 1)] == 0),
-                    cur + 1, cur),
-                bi + 1)
-            return nb, jnp.int32(0)
-
-        return jax.lax.cond((ci + 1) * bk < length,
-                            lambda: (bi, ci + 1), advance_b)
-
-    active = i * bk < length
-
-    @pl.when(jnp.logical_and(active, init_ref[0] == 1))
-    def _first():
-        start_copy(b, i, buf_idx_ref[0])
-        init_ref[0] = 0
-
-    @pl.when(i == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        o_ref[...] = jnp.zeros_like(o_ref)
-
-    @pl.when(active)
-    def _compute():
-        slot = buf_idx_ref[0]
-        nb, ni = next_active(b, i)
-
-        @pl.when(nb < batch_size)
-        def _prefetch():
-            nslot = jnp.where(slot == 0, 1, 0)
-            start_copy(nb, ni, nslot)
-            buf_idx_ref[0] = nslot
-
-        wait_copy(b, i, slot)
-        q = q_ref[0].astype(jnp.float32)  # [kh, g, hd]
-        kh = k_buf.shape[3]
-        k = k_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
-        v = v_buf[slot].astype(jnp.float32).reshape(bk, kh, -1)
-        # [C, ps, LANES] -> [bk, LANES]; rows are lane-broadcast scalars
-        # and hd == LANES (the q8 eligibility gate), so the dequant is a
-        # straight elementwise multiply.
-        ks = ks_buf[slot].astype(jnp.float32).reshape(bk, -1)
-        vs = vs_buf[slot].astype(jnp.float32).reshape(bk, -1)
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        pos = i * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[1], bk), 1)  # [g, t]
-        hd_ = k.shape[-1]
-        for h in range(kh):
-            qh_ = q[h]  # [g, hd]
-            # identity slice on the TPU-eligible geometry (hd == LANES);
-            # narrower hd only occurs in interpret mode (the dispatcher
-            # gates real-TPU use on hd == LANES)
-            kh_ = k[:, h, :] * ks[:, :hd_]  # dequant [t, hd]
-            vh_ = v[:, h, :] * vs[:, :hd_]
-            scores = jax.lax.dot_general(
-                qh_, kh_, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [g, t]
-            scores = jnp.where(pos < length, scores, -jnp.inf)
-            m_prev = m_ref[h, :, 0:1]
-            l_prev = l_ref[h, :, 0:1]
-            m_cur = jnp.max(scores, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            probs = jnp.exp(scores - m_new)
-            alpha = jnp.exp(m_prev - m_new)
-            l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-            pv = jax.lax.dot_general(
-                probs, vh_, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            o_ref[h] = o_ref[h] * alpha + pv
-            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
-            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
-
-    @pl.when(i == n_chunks - 1)
-    def _finish():
-        acc_ref[0] = o_ref[...]
-        m_out_ref[0] = m_ref[...]
-        l_out_ref[0] = l_ref[...]
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("pages_per_chunk", "interpret"))
-def paged_decode_attention_pool_q8(
-    q: jax.Array,  # [B, qh, hd]
-    kv_pool: jax.Array,  # int8 [L, 2, P, ps, kh, hd]
-    kv_scales: jax.Array,  # bf16 [L, 2, P, ps, LANES] lane-broadcast
-    layer: jax.Array,
-    block_tables: jax.Array,
-    kv_lens_hist: jax.Array,
-    *,
-    pages_per_chunk: int = 8,
-    interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """int8-pool chunked-DMA flash partials (see _pool_decode_kernel_q8)."""
-    b, qh, hd = q.shape
-    ps, kh = kv_pool.shape[3], kv_pool.shape[4]
-    group = qh // kh
-    max_pages = block_tables.shape[1]
-    ppc = min(pages_per_chunk, max_pages)
-    while max_pages % ppc:
-        ppc -= 1
-    n_chunks = max_pages // ppc
-    qg = q.reshape(b, kh, group, hd)
-
-    def q_map(bi, ci, *refs):
-        del ci, refs
-        return (bi, 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(b, n_chunks),
-        in_specs=[
-            pl.BlockSpec((1, kh, group, hd), q_map),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, kh, group, hd), q_map),
-            pl.BlockSpec((1, kh, group, 128), q_map),
-            pl.BlockSpec((1, kh, group, 128), q_map),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
-            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
-            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]),
-                       kv_scales.dtype),
-            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]),
-                       kv_scales.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((kh, group, 128), jnp.float32),
-            pltpu.VMEM((kh, group, 128), jnp.float32),
-            pltpu.VMEM((kh, group, hd), jnp.float32),
-        ],
-    )
-    acc, m, l = pl.pallas_call(
-        functools.partial(_pool_decode_kernel_q8, pages_per_chunk=ppc,
-                          max_pages=max_pages, batch_size=b),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((b, kh, group, hd), jnp.float32),
-            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
-            jax.ShapeDtypeStruct((b, kh, group, 128), jnp.float32),
-        ],
-        interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
-        ),
-    )(kv_lens_hist.astype(jnp.int32),
-      block_tables.reshape(-1).astype(jnp.int32),
-      jnp.asarray(layer, jnp.int32).reshape(1),
-      jnp.zeros((1,), jnp.int32),
-      jnp.ones((1,), jnp.int32),
-      qg, kv_pool, kv_scales)
-    return acc, m[..., 0], l[..., 0]
-
-
 @functools.partial(jax.jit,
                    static_argnames=("pages_per_chunk", "interpret"))
 def paged_decode_attention_pool(
@@ -699,13 +494,17 @@ def paged_decode_attention_pool(
     layer: jax.Array,  # scalar int32
     block_tables: jax.Array,  # [B, max_pages] int32
     kv_lens_hist: jax.Array,  # [B] int32 history length (current excluded)
+    kv_scales=None,  # bf16 [L, 2, P, ps, LANES] for an int8 pool
     *,
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Chunked-DMA flash partials over the paged history; see
     _pool_decode_kernel for why this reads the full pool. Returns
-    (acc, m, l) unnormalized for the deferred current-token combine."""
+    (acc, m, l) unnormalized for the deferred current-token combine.
+    With `kv_scales`, the pool is int8 and the kernel dequantizes in
+    VMEM (the q8 path)."""
+    quantized = kv_scales is not None
     b, qh, hd = q.shape
     ps, kh = kv_pool.shape[3], kv_pool.shape[4]
     group = qh // kh
@@ -720,31 +519,44 @@ def paged_decode_attention_pool(
         del ci, refs
         return (bi, 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, kh, group, hd), q_map),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+        pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
+    ]
+    operands = [qg, kv_pool]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch += [
+            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]), kv_scales.dtype),
+            pltpu.VMEM((2, ppc, ps, kv_scales.shape[-1]), kv_scales.dtype),
+        ]
+        operands.append(kv_scales)
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((kh, group, 128), jnp.float32),
+        pltpu.VMEM((kh, group, 128), jnp.float32),
+        pltpu.VMEM((kh, group, hd), jnp.float32),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(b, n_chunks),
-        in_specs=[
-            pl.BlockSpec((1, kh, group, hd), q_map),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, kh, group, hd), q_map),
             pl.BlockSpec((1, kh, group, 128), q_map),
             pl.BlockSpec((1, kh, group, 128), q_map),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
-            pltpu.VMEM((2, ppc, ps, kh, hd), kv_pool.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((kh, group, 128), jnp.float32),
-            pltpu.VMEM((kh, group, 128), jnp.float32),
-            pltpu.VMEM((kh, group, hd), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     acc, m, l = pl.pallas_call(
         functools.partial(_pool_decode_kernel, pages_per_chunk=ppc,
-                          max_pages=max_pages, batch_size=b),
+                          max_pages=max_pages, batch_size=b,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b, kh, group, hd), jnp.float32),
@@ -760,7 +572,7 @@ def paged_decode_attention_pool(
       jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.zeros((1,), jnp.int32),  # double-buffer slot
       jnp.ones((1,), jnp.int32),  # init flag
-      qg, kv_pool)
+      *operands)
     return acc, m[..., 0], l[..., 0]
 
 
@@ -835,9 +647,9 @@ def paged_attention_decode_pool(
             return paged_attention_decode_xla(q, kv_cache, layer,
                                               block_tables, kv_lens,
                                               k_cur, v_cur)
-        acc, m, l = paged_decode_attention_pool_q8(
-            q[:, 0], values, scales, layer, block_tables,
-            jnp.maximum(kv_lens - 1, 0),
+        acc, m, l = paged_decode_attention_pool(
+            q[:, 0], values, layer, block_tables,
+            jnp.maximum(kv_lens - 1, 0), kv_scales=scales,
             pages_per_chunk=pages_per_chunk, interpret=interpret,
         )
         return _combine_current(q, acc, m, l, k_cur, v_cur)

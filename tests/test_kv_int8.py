@@ -164,8 +164,11 @@ class TestRunnerInt8:
         from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
         from dynamo_tpu.parallel import MeshConfig, make_mesh
 
+        # int8 requires head_dim == the 128 scale-lane width (flagship
+        # geometry); widen the tiny model's heads accordingly.
+        cfg = dataclasses.replace(get_config("tiny-test"), head_dim=128)
         return ModelRunner(
-            get_config("tiny-test"),
+            cfg,
             RunnerConfig(page_size=4, num_pages=64, max_batch=2,
                          max_pages_per_seq=16, prefill_buckets=(16, 32),
                          kv_dtype=kv_dtype),
@@ -206,7 +209,27 @@ class TestRunnerInt8:
             r.gather_pages(np.array([1, 2], np.int32))
         with pytest.raises(NotImplementedError, match="int8"):
             r.scatter_pages(np.array([1], np.int32),
-                            np.zeros((1, 2, 2, 4, 2, 16), np.float32))
+                            np.zeros((1, 2, 2, 4, 2, 128), np.float32))
+
+    def test_bad_kv_dtype_rejected(self):
+        from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        with pytest.raises(ValueError, match="unknown kv_dtype"):
+            ModelRunner(get_config("tiny-test"),
+                        RunnerConfig(prefill_buckets=(16,),
+                                     kv_dtype="fp8"),
+                        make_mesh(MeshConfig()), seed=0)
+
+    def test_narrow_head_dim_rejected(self):
+        from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        with pytest.raises(ValueError, match="head_dim"):
+            ModelRunner(get_config("tiny-test"),  # head_dim=16
+                        RunnerConfig(prefill_buckets=(16,),
+                                     kv_dtype="int8"),
+                        make_mesh(MeshConfig()), seed=0)
 
     def test_mla_rejected(self):
         from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
