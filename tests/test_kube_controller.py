@@ -1,0 +1,211 @@
+"""KubeDeploymentController against a stub apiserver (apps/v1
+Deployments): create on start, PATCH replicas on scale, readyReplicas
+feedback, delete on close — and the full DGDR flow realized through it
+(the in-cluster operator analog; ref:
+deploy/operator/internal/controller/dynamographdeployment_controller.go)."""
+
+import asyncio
+import contextlib
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.deploy.kube_controller import KubeDeploymentController
+from dynamo_tpu.deploy.spec import GraphDeploymentSpec
+
+
+class StubAppsApi:
+    """apps/v1 deployments CRUD; marks every deployment fully ready one
+    poll after creation/scale (a cooperative kubelet)."""
+
+    def __init__(self):
+        self.deployments = {}  # name -> object
+        self.port = None
+        self._runner = None
+
+    async def start(self):
+        from aiohttp import web
+
+        base = "/apis/apps/v1/namespaces/{ns}/deployments"
+        app = web.Application()
+        app.router.add_post(base, self._create)
+        app.router.add_get(base + "/{name}", self._get)
+        app.router.add_patch(base + "/{name}", self._patch)
+        app.router.add_delete(base + "/{name}", self._delete)
+        self._runner = web.AppRunner(app, shutdown_timeout=0.25)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+    @property
+    def base_url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    async def _create(self, request):
+        from aiohttp import web
+
+        obj = await request.json()
+        name = obj["metadata"]["name"]
+        if name in self.deployments:
+            return web.Response(status=409, text="AlreadyExists")
+        obj.setdefault("status", {})
+        self.deployments[name] = obj
+        return web.json_response(obj, status=201)
+
+    async def _get(self, request):
+        from aiohttp import web
+
+        obj = self.deployments.get(request.match_info["name"])
+        if obj is None:
+            return web.Response(status=404, text="NotFound")
+        # cooperative kubelet: everything asked for becomes ready
+        obj["status"]["readyReplicas"] = obj["spec"].get("replicas", 0)
+        return web.json_response(obj)
+
+    async def _patch(self, request):
+        from aiohttp import web
+
+        obj = self.deployments.get(request.match_info["name"])
+        if obj is None:
+            return web.Response(status=404, text="NotFound")
+        patch = await request.json()
+
+        def merge(dst, src):
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merge(obj, patch)
+        return web.json_response(obj)
+
+    async def _delete(self, request):
+        from aiohttp import web
+
+        obj = self.deployments.pop(request.match_info["name"], None)
+        if obj is None:
+            return web.Response(status=404, text="NotFound")
+        return web.json_response(obj)
+
+
+@contextlib.asynccontextmanager
+async def stub_api():
+    api = StubAppsApi()
+    await api.start()
+    try:
+        yield api
+    finally:
+        await api.stop()
+
+
+def _spec():
+    return GraphDeploymentSpec.from_dict({
+        "name": "kc",
+        "namespace": "dynamo",
+        "env": {"DYNT_DISCOVERY_PATH": "/tmp/x"},
+        "services": {
+            "decode": {"kind": "mocker", "replicas": 2,
+                       "args": ["--model-name", "m"]},
+            "frontend": {"kind": "frontend", "replicas": 1,
+                         "args": ["--port", "8123"]},
+        },
+    })
+
+
+class TestKubeController:
+    def test_create_scale_status_delete(self, run):
+        async def body():
+            async with stub_api() as api:
+                ctl = KubeDeploymentController(
+                    _spec(), base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if set(api.deployments) == {"kc-decode",
+                                                    "kc-frontend"}:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert set(api.deployments) == {"kc-decode",
+                                                    "kc-frontend"}
+                    assert (api.deployments["kc-decode"]["spec"]["replicas"]
+                            == 2)
+                    # readiness feeds back into status()
+                    for _ in range(100):
+                        st = ctl.status()["services"]
+                        if (st["decode"]["running"] == 2
+                                and st["frontend"]["running"] == 1):
+                            break
+                        await asyncio.sleep(0.02)
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+
+                    ctl.set_replicas("decode", 5)
+                    for _ in range(100):
+                        if (api.deployments["kc-decode"]["spec"]["replicas"]
+                                == 5):
+                            break
+                        await asyncio.sleep(0.02)
+                    assert (api.deployments["kc-decode"]["spec"]["replicas"]
+                            == 5)
+                finally:
+                    await ctl.close()
+                assert api.deployments == {}  # torn down
+        run(body())
+
+    def test_dgdr_realized_as_k8s_deployments(self, run):
+        """The full zero-config DGDR flow with the kube controller as the
+        realization layer: submit -> Deployed, replica change PATCHes the
+        live Deployment."""
+        from dynamo_tpu.deploy.dgdr import (
+            DEPLOYED,
+            DeploymentRequest,
+            DgdrController,
+            get_status,
+            submit_request,
+        )
+        from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+        async def body():
+            async with stub_api() as api:
+                cfg = RuntimeConfig()
+                cfg.discovery_backend = "mem"
+                cfg.discovery_path = "kube-ctl-test"
+                cfg.system_enabled = False
+                rt = await DistributedRuntime(cfg).start()
+
+                def factory(spec):
+                    return KubeDeploymentController(
+                        spec, base_url=api.base_url, namespace="testns",
+                        token="t", reconcile_interval=0.05)
+
+                dgdr = DgdrController(rt, controller_factory=factory)
+                await dgdr.start()
+                try:
+                    req = DeploymentRequest(
+                        name="zk", model="qwen3-0.6b", engine="mocker",
+                        concurrency=64, max_chips=16, ttft_ms=5000.0,
+                        itl_ms=3.0)
+                    await submit_request(rt, req)
+                    deadline = time.monotonic() + 30
+                    st = None
+                    while time.monotonic() < deadline:
+                        st = await get_status(rt, "zk")
+                        if st and st.get("phase") == DEPLOYED:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert st and st.get("phase") == DEPLOYED, st
+                    assert "zk-decode" in api.deployments
+                    assert (api.deployments["zk-decode"]["spec"]["replicas"]
+                            == st["profile"]["replicas"])
+                finally:
+                    await dgdr.close()
+                    await rt.shutdown()
+
+        run(body(), timeout=90.0)
