@@ -12,8 +12,10 @@ HF parameter names onto `models/transformer.py`'s pytree lives here:
 
 Supported families mirror models/config.py PRESETS: Llama-class
 (LlamaForCausalLM, MistralForCausalLM), Qwen3-class (Qwen3ForCausalLM —
-adds per-head q/k RMSNorm), and the MoE variants (Qwen3MoeForCausalLM,
-MixtralForCausalLM). Everything is numpy-side — no jax import at module
+adds per-head q/k RMSNorm), the MoE variants (Qwen3MoeForCausalLM,
+MixtralForCausalLM), and DeepSeek-V2-class MLA (DeepseekV2ForCausalLM,
+V2-Lite shape: direct q_proj, greedy softmax routing, mixed dense/MoE
+stacks with shared experts). Everything is numpy-side — no jax import at module
 load, so the weight service / CLI tools can use it without pulling in a
 TPU client.
 
@@ -60,6 +62,7 @@ _DENSE_ARCHS = {"LlamaForCausalLM", "MistralForCausalLM",
                 "Qwen3ForCausalLM"}
 _MOE_ARCHS = {"Qwen3MoeForCausalLM", "MixtralForCausalLM"}
 _QK_NORM_ARCHS = {"Qwen3ForCausalLM", "Qwen3MoeForCausalLM"}
+_MLA_ARCHS = {"DeepseekV2ForCausalLM"}
 
 
 def config_from_hf(cfg: dict, name: Optional[str] = None,
@@ -67,11 +70,14 @@ def config_from_hf(cfg: dict, name: Optional[str] = None,
     """Build a ModelConfig from a parsed HF config.json dict."""
     archs = cfg.get("architectures") or []
     arch = archs[0] if archs else ""
-    if arch not in _DENSE_ARCHS | _MOE_ARCHS:
+    if arch not in _DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS:
         raise ValueError(
             f"unsupported architecture {arch!r} (supported: "
-            f"{sorted(_DENSE_ARCHS | _MOE_ARCHS)}); Qwen2-class models "
-            "with attention biases are not representable in this family")
+            f"{sorted(_DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS)}); "
+            "Qwen2-class models with attention biases are not "
+            "representable in this family")
+    if arch in _MLA_ARCHS:
+        return _config_from_deepseek(cfg, name=name, dtype=dtype)
     scaling = cfg.get("rope_scaling")
     if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
         raise ValueError(
@@ -109,6 +115,54 @@ def config_from_hf(cfg: dict, name: Optional[str] = None,
         expert_mlp_hidden=int(cfg.get("moe_intermediate_size")
                               or cfg.get("intermediate_size", 0))
         if moe else 0,
+    )
+
+
+def _config_from_deepseek(cfg: dict, name: Optional[str],
+                          dtype: str) -> ModelConfig:
+    """DeepSeek-V2-class (MLA + mixed dense/MoE + shared experts).
+    Ref workload: the reference's headline recipes/deepseek-r1 family."""
+    if cfg.get("q_lora_rank"):
+        raise ValueError(
+            "DeepSeek checkpoints with q_lora_rank (full V2/V3) are not "
+            "supported yet — V2-Lite-class (direct q_proj) only")
+    if cfg.get("topk_method", "greedy") not in (None, "greedy"):
+        raise ValueError(
+            f"DeepSeek topk_method={cfg.get('topk_method')!r} (grouped "
+            "routing) is not implemented — greedy only (V2-Lite)")
+    if cfg.get("scoring_func", "softmax") != "softmax":
+        raise ValueError("DeepSeek sigmoid scoring (V3) not implemented")
+    scaling = cfg.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise ValueError(f"rope_scaling={scaling!r} not implemented")
+    nhd = int(cfg["qk_nope_head_dim"])
+    rhd = int(cfg["qk_rope_head_dim"])
+    n_q = int(cfg["num_attention_heads"])
+    return ModelConfig(
+        name=name or cfg.get("model_type", "deepseek"),
+        vocab_size=int(cfg["vocab_size"]),
+        hidden=int(cfg["hidden_size"]),
+        n_layers=int(cfg["num_hidden_layers"]),
+        n_q_heads=n_q,
+        n_kv_heads=int(cfg.get("num_key_value_heads", n_q)),
+        head_dim=nhd + rhd,
+        mlp_hidden=int(cfg["intermediate_size"]),
+        rope_theta=float(cfg.get("rope_theta", 10000.0)),
+        rms_eps=float(cfg.get("rms_norm_eps", 1e-6)),
+        tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        max_context=int(cfg.get("max_position_embeddings", 8192)),
+        dtype=dtype,
+        n_experts=int(cfg.get("n_routed_experts") or 0),
+        n_experts_active=int(cfg.get("num_experts_per_tok") or 0),
+        expert_mlp_hidden=int(cfg.get("moe_intermediate_size") or 0),
+        first_k_dense=int(cfg.get("first_k_dense_replace") or 0),
+        n_shared_experts=int(cfg.get("n_shared_experts") or 0),
+        moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
+        moe_routed_scale=float(cfg.get("routed_scaling_factor", 1.0)),
+        mla_kv_lora_rank=int(cfg["kv_lora_rank"]),
+        mla_rope_head_dim=rhd,
+        mla_nope_head_dim=nhd,
+        mla_v_head_dim=int(cfg["v_head_dim"]),
     )
 
 
@@ -215,11 +269,27 @@ def _moe_names(style: str, prefix: str, e: int) -> dict:
     }
 
 
+def _rope_perm(rhd: int) -> np.ndarray:
+    """Interleaved-RoPE -> rotate-half reordering: HF DeepSeek rotates
+    complex pairs (2i, 2i+1); our rope() rotates (i, i+half). Permuting
+    the rope-dim output rows of the projections converts between the two
+    exactly (q and k permute consistently, so dot products are
+    unchanged)."""
+    return np.concatenate([np.arange(0, rhd, 2), np.arange(1, rhd, 2)])
+
+
+def _rope_perm_inv(rhd: int) -> np.ndarray:
+    perm = _rope_perm(rhd)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(rhd)
+    return inv
+
+
 def build_mapping(config: ModelConfig) -> list[_Entry]:
     """Dense-path entries (everything except stacked expert weights)."""
     if config.is_mla:
-        raise ValueError("MLA checkpoints (DeepSeek-class) are not yet "
-                         "supported by the safetensors loader")
+        raise ValueError("MLA checkpoints load through the dedicated "
+                         "DeepSeek path (_load_deepseek)")
     h, hd = config.hidden, config.head_dim
     qh, kh, m = config.n_q_heads, config.n_kv_heads, config.mlp_hidden
     entries: list[_Entry] = [
@@ -341,11 +411,182 @@ def _set_path(tree: dict, path: tuple, value: np.ndarray) -> None:
     node[path[-1]] = value
 
 
+def _load_deepseek(reader: "ShardReader", config: ModelConfig) -> dict:
+    """DeepSeek-V2-class MLA checkpoint -> param pytree (ref workload:
+    recipes/deepseek-r1 — the reference's headline family). Layout bridged
+    per transformers' modeling_deepseek_v2: q_proj -> wq (rope rows
+    permuted to rotate-half order), kv_a_proj_with_mqa -> w_dkv + w_kr,
+    kv_a_layernorm -> kv_norm, kv_b_proj -> w_uk + w_uv, o_proj -> wo,
+    mixed dense/MoE layers (first_k_dense_replace) with shared experts."""
+    dtype = np.dtype(config.dtype)
+    h = config.hidden
+    qh = config.n_q_heads
+    nhd, rhd = config.mla_nope_head_dim, config.mla_rope_head_dim
+    vhd = config.mla_v_head_dim
+    dc = config.mla_kv_lora_rank
+    perm = _rope_perm(rhd)
+    params: dict = {
+        "embed": reader.get("model.embed_tokens.weight").astype(dtype),
+        "final_norm": reader.get("model.norm.weight").astype(dtype),
+        "layers": [],
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = np.ascontiguousarray(
+            reader.get("lm_head.weight").T).astype(dtype)
+    for i in range(config.n_layers):
+        p = f"model.layers.{i}."
+        wq = np.ascontiguousarray(
+            reader.get(p + "self_attn.q_proj.weight").T
+        ).reshape(h, qh, nhd + rhd)
+        wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., perm]],
+                            axis=-1)
+        kv_a = np.ascontiguousarray(
+            reader.get(p + "self_attn.kv_a_proj_with_mqa.weight").T)
+        _expect(kv_a, (h, dc + rhd))
+        kv_b = np.ascontiguousarray(
+            reader.get(p + "self_attn.kv_b_proj.weight").T
+        ).reshape(dc, qh, nhd + vhd)
+        wo = np.ascontiguousarray(
+            reader.get(p + "self_attn.o_proj.weight").T
+        ).reshape(qh, vhd, h)
+        lp = {
+            "attn_norm": reader.get(
+                p + "input_layernorm.weight").astype(dtype),
+            "wq": wq.astype(dtype),
+            "w_dkv": np.ascontiguousarray(kv_a[:, :dc]).astype(dtype),
+            "w_kr": np.ascontiguousarray(
+                kv_a[:, dc:][:, perm]).astype(dtype),
+            "kv_norm": reader.get(
+                p + "self_attn.kv_a_layernorm.weight").astype(dtype),
+            "w_uk": np.ascontiguousarray(kv_b[..., :nhd]).astype(dtype),
+            "w_uv": np.ascontiguousarray(kv_b[..., nhd:]).astype(dtype),
+            "wo": wo.astype(dtype),
+            "mlp_norm": reader.get(
+                p + "post_attention_layernorm.weight").astype(dtype),
+        }
+        m = config.mlp_hidden
+        if config.layer_is_moe(i):
+            em = config.expert_mlp_hidden or m
+            router = reader.get(p + "mlp.gate.weight")
+            _expect(router, (config.n_experts, h))
+            lp["router"] = np.ascontiguousarray(router.T).astype(dtype)
+            gates, ups, downs = [], [], []
+            for e in range(config.n_experts):
+                ep = f"{p}mlp.experts.{e}."
+                gates.append(np.ascontiguousarray(
+                    reader.get(ep + "gate_proj.weight").T))
+                ups.append(np.ascontiguousarray(
+                    reader.get(ep + "up_proj.weight").T))
+                downs.append(np.ascontiguousarray(
+                    reader.get(ep + "down_proj.weight").T))
+            lp["e_gate"] = np.stack(gates).astype(dtype)
+            lp["e_up"] = np.stack(ups).astype(dtype)
+            lp["e_down"] = np.stack(downs).astype(dtype)
+            if config.n_shared_experts:
+                sp = p + "mlp.shared_experts."
+                lp["s_gate"] = np.ascontiguousarray(
+                    reader.get(sp + "gate_proj.weight").T).astype(dtype)
+                lp["s_up"] = np.ascontiguousarray(
+                    reader.get(sp + "up_proj.weight").T).astype(dtype)
+                lp["s_down"] = np.ascontiguousarray(
+                    reader.get(sp + "down_proj.weight").T).astype(dtype)
+            # dead dense-MLP leaves (init_params shape contract)
+            lp["w_gate"] = np.zeros((h, m), dtype)
+            lp["w_up"] = np.zeros((h, m), dtype)
+            lp["w_down"] = np.zeros((m, h), dtype)
+        else:
+            lp["w_gate"] = np.ascontiguousarray(
+                reader.get(p + "mlp.gate_proj.weight").T).astype(dtype)
+            lp["w_up"] = np.ascontiguousarray(
+                reader.get(p + "mlp.up_proj.weight").T).astype(dtype)
+            lp["w_down"] = np.ascontiguousarray(
+                reader.get(p + "mlp.down_proj.weight").T).astype(dtype)
+        params["layers"].append(lp)
+    return params
+
+
+def _save_deepseek(params: dict, config: ModelConfig, path: str) -> None:
+    """Exact inverse of _load_deepseek (roundtrip tests / export)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    h = config.hidden
+    qh = config.n_q_heads
+    nhd, rhd = config.mla_nope_head_dim, config.mla_rope_head_dim
+    vhd = config.mla_v_head_dim
+    dc = config.mla_kv_lora_rank
+    inv = _rope_perm_inv(rhd)
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]),
+        "model.norm.weight": np.asarray(params["final_norm"]),
+    }
+    if not config.tie_embeddings:
+        out["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"]).T)
+    for i, lp in enumerate(params["layers"]):
+        p = f"model.layers.{i}."
+        wq = np.asarray(lp["wq"])
+        wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., inv]],
+                            axis=-1)
+        out[p + "self_attn.q_proj.weight"] = np.ascontiguousarray(
+            wq.reshape(h, qh * (nhd + rhd)).T)
+        kv_a = np.concatenate(
+            [np.asarray(lp["w_dkv"]),
+             np.asarray(lp["w_kr"])[:, inv]], axis=1)
+        out[p + "self_attn.kv_a_proj_with_mqa.weight"] = \
+            np.ascontiguousarray(kv_a.T)
+        out[p + "self_attn.kv_a_layernorm.weight"] = np.asarray(
+            lp["kv_norm"])
+        kv_b = np.concatenate([np.asarray(lp["w_uk"]),
+                               np.asarray(lp["w_uv"])], axis=-1)
+        out[p + "self_attn.kv_b_proj.weight"] = np.ascontiguousarray(
+            kv_b.reshape(dc, qh * (nhd + vhd)).T)
+        out[p + "self_attn.o_proj.weight"] = np.ascontiguousarray(
+            np.asarray(lp["wo"]).reshape(qh * vhd, h).T)
+        out[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
+        out[p + "post_attention_layernorm.weight"] = np.asarray(
+            lp["mlp_norm"])
+        if config.layer_is_moe(i):
+            out[p + "mlp.gate.weight"] = np.ascontiguousarray(
+                np.asarray(lp["router"]).T)
+            for e in range(config.n_experts):
+                ep = f"{p}mlp.experts.{e}."
+                out[ep + "gate_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["e_gate"][e]).T)
+                out[ep + "up_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["e_up"][e]).T)
+                out[ep + "down_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["e_down"][e]).T)
+            if config.n_shared_experts:
+                sp = p + "mlp.shared_experts."
+                out[sp + "gate_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["s_gate"]).T)
+                out[sp + "up_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["s_up"]).T)
+                out[sp + "down_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(lp["s_down"]).T)
+        else:
+            out[p + "mlp.gate_proj.weight"] = np.ascontiguousarray(
+                np.asarray(lp["w_gate"]).T)
+            out[p + "mlp.up_proj.weight"] = np.ascontiguousarray(
+                np.asarray(lp["w_up"]).T)
+            out[p + "mlp.down_proj.weight"] = np.ascontiguousarray(
+                np.asarray(lp["w_down"]).T)
+    save_file(out, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(hf_config_dict(config), f, indent=2)
+
+
 def load_params(path: str, config: ModelConfig) -> dict:
     """Read an HF safetensors checkpoint into the param pytree (host numpy
     arrays, cast to config.dtype). Raises on missing/mis-shaped tensors —
     serving silently-random weights is never acceptable once a model path
     was given."""
+    if config.is_mla:
+        with ShardReader(path) as reader:
+            params = _load_deepseek(reader, config)
+        log.info("loaded DeepSeek checkpoint %s", path)
+        return params
     dtype = np.dtype(config.dtype)
     entries = build_mapping(config)
     with ShardReader(path) as reader:
@@ -437,6 +678,42 @@ def _get_path(tree, path: tuple):
 
 def hf_config_dict(config: ModelConfig) -> dict:
     """config.json contents for an exported checkpoint (HF-readable)."""
+    if config.is_mla:
+        return {
+            "architectures": ["DeepseekV2ForCausalLM"],
+            "model_type": "deepseek_v2",
+            "hidden_size": config.hidden,
+            "intermediate_size": config.mlp_hidden,
+            "max_position_embeddings": config.max_context,
+            "num_attention_heads": config.n_q_heads,
+            "num_key_value_heads": config.n_kv_heads,
+            "num_hidden_layers": config.n_layers,
+            "rms_norm_eps": config.rms_eps,
+            "rope_theta": config.rope_theta,
+            "tie_word_embeddings": config.tie_embeddings,
+            "vocab_size": config.vocab_size,
+            "torch_dtype": config.dtype,
+            "q_lora_rank": None,
+            "kv_lora_rank": config.mla_kv_lora_rank,
+            "qk_nope_head_dim": config.mla_nope_head_dim,
+            "qk_rope_head_dim": config.mla_rope_head_dim,
+            "v_head_dim": config.mla_v_head_dim,
+            "head_dim": config.mla_rope_head_dim,
+            "n_routed_experts": config.n_experts or None,
+            "num_experts_per_tok": config.n_experts_active or None,
+            "moe_intermediate_size": config.expert_mlp_hidden or None,
+            "n_shared_experts": config.n_shared_experts or None,
+            "first_k_dense_replace": config.first_k_dense,
+            "norm_topk_prob": config.moe_norm_topk,
+            "routed_scaling_factor": config.moe_routed_scale,
+            "topk_method": "greedy",
+            "scoring_func": "softmax",
+            "n_group": 1,
+            "topk_group": 1,
+            "num_experts_per_token": config.n_experts_active or None,
+            "attention_bias": False,
+            "moe_layer_freq": 1,
+        }
     moe = config.n_experts > 0
     if moe:
         arch = "Qwen3MoeForCausalLM" if config.qk_norm \
@@ -477,6 +754,9 @@ def save_params(params: dict, config: ModelConfig, path: str) -> None:
     bit-for-bit."""
     from safetensors.numpy import save_file
 
+    if config.is_mla:
+        _save_deepseek(params, config, path)
+        return
     os.makedirs(path, exist_ok=True)
     out: dict[str, np.ndarray] = {}
     for entry in build_mapping(config):

@@ -36,6 +36,14 @@ class ModelConfig:
     # Static per-expert buffer headroom for capacity dispatch (tokens per
     # expert = ceil(cf * t * k / e)); overflow tokens drop that expert.
     moe_capacity_factor: float = 1.25
+    # DeepSeek-style MoE shape: the first K layers use a dense MLP instead
+    # of experts, always-active shared experts add a dense SwiGLU of width
+    # n_shared_experts * expert_mlp_hidden, and routing weights are the
+    # raw softmax-over-all-experts scores (norm_topk=False) times a scale.
+    first_k_dense: int = 0
+    n_shared_experts: int = 0
+    moe_norm_topk: bool = True
+    moe_routed_scale: float = 1.0
     # Multimodal: placeholder token id for spliced image embeddings
     # (-1 = text-only) and the rows one image expands to (must match the
     # paired vision encoder's n_image_tokens)
@@ -47,6 +55,11 @@ class ModelConfig:
     mla_rope_head_dim: int = 0
     mla_nope_head_dim: int = 0
     mla_v_head_dim: int = 0
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        """DeepSeek-style mixed stacks: layers below first_k_dense keep a
+        dense MLP; the rest route through experts."""
+        return self.n_experts > 0 and layer_idx >= self.first_k_dense
 
     @property
     def q_dim(self) -> int:
@@ -149,6 +162,7 @@ PRESETS: dict[str, ModelConfig] = {
         n_q_heads=16, n_kv_heads=16, head_dim=192, mlp_hidden=10944,
         rope_theta=1e4, tie_embeddings=False, max_context=32768,
         n_experts=64, n_experts_active=6, expert_mlp_hidden=1408,
+        first_k_dense=1, n_shared_experts=2, moe_norm_topk=False,
         mla_kv_lora_rank=512, mla_rope_head_dim=64, mla_nope_head_dim=128,
         mla_v_head_dim=128,
     ),

@@ -60,15 +60,23 @@ def param_axes(config: ModelConfig) -> dict:
     if config.qk_norm:
         layer["q_norm"] = ("head_dim",)
         layer["k_norm"] = ("head_dim",)
-    if config.n_experts:
-        layer["router"] = ("embed", "experts")
-        layer["e_gate"] = ("experts", "embed", "mlp")
-        layer["e_up"] = ("experts", "embed", "mlp")
-        layer["e_down"] = ("experts", "mlp", "embed")
+    def layer_axes(i: int) -> dict:
+        out = dict(layer)
+        if config.layer_is_moe(i):
+            out["router"] = ("embed", "experts")
+            out["e_gate"] = ("experts", "embed", "mlp")
+            out["e_up"] = ("experts", "embed", "mlp")
+            out["e_down"] = ("experts", "mlp", "embed")
+            if config.n_shared_experts:
+                out["s_gate"] = ("embed", "mlp")
+                out["s_up"] = ("embed", "mlp")
+                out["s_down"] = ("mlp", "embed")
+        return out
+
     axes = {
         "embed": ("vocab", "embed"),
         "final_norm": ("embed",),
-        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "layers": [layer_axes(i) for i in range(config.n_layers)],
     }
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
@@ -85,8 +93,8 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
         return (jax.random.normal(k, shape, dtype=jnp.float32)
                 * (1.0 / math.sqrt(fan_in))).astype(dtype)
 
-    def layer(k):
-        ks = jax.random.split(k, 12)
+    def layer(k, layer_idx):
+        ks = jax.random.split(k, 15)
         if config.is_mla:
             dc = config.mla_kv_lora_rank
             nhd = config.mla_nope_head_dim
@@ -119,18 +127,23 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
         if config.qk_norm:
             p["q_norm"] = jnp.ones((hd,), dtype)
             p["k_norm"] = jnp.ones((hd,), dtype)
-        if config.n_experts:
+        if config.layer_is_moe(layer_idx):
             e, em = config.n_experts, config.expert_mlp_hidden or m
             p["router"] = dense(ks[7], (h, e), h)
             p["e_gate"] = dense(ks[8], (e, h, em), h)
             p["e_up"] = dense(ks[9], (e, h, em), h)
             p["e_down"] = dense(ks[7], (e, em, h), em)
+            if config.n_shared_experts:
+                sm = config.n_shared_experts * em
+                p["s_gate"] = dense(ks[12], (h, sm), h)
+                p["s_up"] = dense(ks[13], (h, sm), h)
+                p["s_down"] = dense(ks[14], (sm, h), sm)
         return p
 
     params = {
         "embed": dense(keys[0], (config.vocab_size, h), h),
         "final_norm": jnp.ones((h,), dtype),
-        "layers": [layer(keys[i + 1]) for i in range(config.n_layers)],
+        "layers": [layer(keys[i + 1], i) for i in range(config.n_layers)],
     }
     if not config.tie_embeddings:
         params["lm_head"] = dense(keys[-1], (h, config.vocab_size), h)
@@ -237,26 +250,47 @@ def _swiglu(x: jax.Array, p: dict, lora_layer: Optional[dict] = None,
     return down
 
 
+def _routing_weights(x: jax.Array, p: dict, config: ModelConfig):
+    """Top-k routing weights, DeepSeek/Mixtral-general: softmax over ALL
+    experts (fp32), take the top-k scores, optionally renormalize
+    (norm_topk — Mixtral/Qwen3MoE semantics; equals softmax over the
+    top-k logits), scaled by moe_routed_scale (DeepSeek). Returns
+    (weights [b,t,k] f32, topi [b,t,k])."""
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    scores = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(scores, config.n_experts_active)
+    if config.moe_norm_topk:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    return topv * config.moe_routed_scale, topi
+
+
+def _shared_expert(x: jax.Array, p: dict) -> jax.Array:
+    """Always-active shared-expert SwiGLU (DeepSeek n_shared_experts)."""
+    gate = jnp.einsum("bth,hm->btm", x, p["s_gate"])
+    up = jnp.einsum("bth,hm->btm", x, p["s_up"])
+    return jnp.einsum("btm,mh->bth", jax.nn.silu(gate) * up, p["s_down"])
+
+
 def _moe_dense(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
     """Oracle MoE: every expert computed for every token, weighted by the
     router's top-k mask. O(e) FLOPs per token — used only as the test
     reference for the dispatched path below."""
-    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
-                        p["router"].astype(jnp.float32))
-    k = config.n_experts_active
-    topv, topi = jax.lax.top_k(logits, k)
-    weights = jax.nn.softmax(topv, axis=-1)
-    mask = jnp.zeros_like(logits).at[
-        jnp.arange(x.shape[0])[:, None, None],
-        jnp.arange(x.shape[1])[None, :, None],
+    b, t, _ = x.shape
+    weights, topi = _routing_weights(x, p, config)
+    mask = jnp.zeros((b, t, config.n_experts), jnp.float32).at[
+        jnp.arange(b)[:, None, None],
+        jnp.arange(t)[None, :, None],
         topi,
     ].set(weights)  # [b, t, e]
     gate = jnp.einsum("bth,ehm->betm", x, p["e_gate"])
     up = jnp.einsum("bth,ehm->betm", x, p["e_up"])
     expert_out = jnp.einsum("betm,emh->beth", jax.nn.silu(gate) * up,
                             p["e_down"])
-    return jnp.einsum("beth,bte->bth", expert_out,
-                      mask.astype(x.dtype))
+    out = jnp.einsum("beth,bte->bth", expert_out, mask.astype(x.dtype))
+    if "s_gate" in p:
+        out = out + _shared_expert(x, p)
+    return out
 
 
 def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
@@ -278,10 +312,7 @@ def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
     # capacity: slots per expert for this chunk (static: t is a traced shape)
     cap = max(k, int(math.ceil(config.moe_capacity_factor * t * k / e)))
 
-    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
-                        p["router"].astype(jnp.float32))
-    topv, topi = jax.lax.top_k(logits, k)  # [b, t, k]
-    weights = jax.nn.softmax(topv, axis=-1)  # matches _moe_dense semantics
+    weights, topi = _routing_weights(x, p, config)  # [b, t, k]
 
     sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [b, t, k, e]
     # Priority order: all tokens' 1st choice first, then 2nd choices, ...
@@ -301,7 +332,10 @@ def _moe(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
     gate = jnp.einsum("ebch,ehm->ebcm", xe, p["e_gate"])
     up = jnp.einsum("ebch,ehm->ebcm", xe, p["e_up"])
     out_e = jnp.einsum("ebcm,emh->ebch", jax.nn.silu(gate) * up, p["e_down"])
-    return jnp.einsum("btec,ebch->bth", combine.astype(x.dtype), out_e)
+    out = jnp.einsum("btec,ebch->bth", combine.astype(x.dtype), out_e)
+    if "s_gate" in p:
+        out = out + _shared_expert(x, p)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -579,7 +613,7 @@ def forward_decode(
                 attn.reshape(b, 1, -1), ll["wo"], lora_idx)
         x = x + attn_out
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        if config.n_experts:
+        if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
         else:
             x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
@@ -725,7 +759,7 @@ def forward_ring(
         vs.append(v)
         x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        if config.n_experts:
+        if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
         else:
             x = x + _swiglu(h, lp)
@@ -1008,7 +1042,7 @@ def forward_embed(
         attn = attn.reshape(b, t, config.n_q_heads, config.head_dim)
         x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        if config.n_experts:
+        if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
         else:
             x = x + _swiglu(h, lp)
@@ -1084,7 +1118,7 @@ def forward(
                 attn.reshape(b, t, -1), ll["wo"], lora_idx)
         x = x + attn_out
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        if config.n_experts:
+        if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
         else:
             x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
