@@ -62,7 +62,7 @@ _DENSE_ARCHS = {"LlamaForCausalLM", "MistralForCausalLM",
                 "Qwen3ForCausalLM"}
 _MOE_ARCHS = {"Qwen3MoeForCausalLM", "MixtralForCausalLM"}
 _QK_NORM_ARCHS = {"Qwen3ForCausalLM", "Qwen3MoeForCausalLM"}
-_MLA_ARCHS = {"DeepseekV2ForCausalLM"}
+_MLA_ARCHS = {"DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"}
 
 
 def config_from_hf(cfg: dict, name: Optional[str] = None,
@@ -120,18 +120,24 @@ def config_from_hf(cfg: dict, name: Optional[str] = None,
 
 def _config_from_deepseek(cfg: dict, name: Optional[str],
                           dtype: str) -> ModelConfig:
-    """DeepSeek-V2-class (MLA + mixed dense/MoE + shared experts).
-    Ref workload: the reference's headline recipes/deepseek-r1 family."""
-    if cfg.get("q_lora_rank"):
+    """DeepSeek MLA families. V2-Lite shape: direct q_proj, softmax
+    greedy routing. V3/R1 shape: q-lora, sigmoid scoring with the
+    e_score_correction_bias, node-limited group routing. Ref workload:
+    the reference's headline recipes/deepseek-r1."""
+    arch = (cfg.get("architectures") or [""])[0]
+    is_v3 = arch == "DeepseekV3ForCausalLM"
+    if cfg.get("q_lora_rank") and not is_v3:
         raise ValueError(
-            "DeepSeek checkpoints with q_lora_rank (full V2/V3) are not "
-            "supported yet — V2-Lite-class (direct q_proj) only")
-    if cfg.get("topk_method", "greedy") not in (None, "greedy"):
+            "DeepSeek-V2 checkpoints with q_lora_rank use group-limited "
+            "routing this loader does not implement; V2-Lite (direct "
+            "q_proj) or V3/R1 only")
+    if not is_v3 and cfg.get("topk_method", "greedy") not in (None,
+                                                              "greedy"):
         raise ValueError(
-            f"DeepSeek topk_method={cfg.get('topk_method')!r} (grouped "
+            f"DeepSeek-V2 topk_method={cfg.get('topk_method')!r} (grouped "
             "routing) is not implemented — greedy only (V2-Lite)")
-    if cfg.get("scoring_func", "softmax") != "softmax":
-        raise ValueError("DeepSeek sigmoid scoring (V3) not implemented")
+    if not is_v3 and cfg.get("scoring_func", "softmax") != "softmax":
+        raise ValueError("sigmoid scoring outside V3 is not implemented")
     scaling = cfg.get("rope_scaling")
     if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
         raise ValueError(f"rope_scaling={scaling!r} not implemented")
@@ -159,7 +165,11 @@ def _config_from_deepseek(cfg: dict, name: Optional[str],
         n_shared_experts=int(cfg.get("n_shared_experts") or 0),
         moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
         moe_routed_scale=float(cfg.get("routed_scaling_factor", 1.0)),
+        moe_scoring="sigmoid" if is_v3 else "softmax",
+        moe_n_group=int(cfg.get("n_group") or 1) if is_v3 else 1,
+        moe_topk_group=int(cfg.get("topk_group") or 1) if is_v3 else 1,
         mla_kv_lora_rank=int(cfg["kv_lora_rank"]),
+        mla_q_lora_rank=int(cfg.get("q_lora_rank") or 0),
         mla_rope_head_dim=rhd,
         mla_nope_head_dim=nhd,
         mla_v_head_dim=int(cfg["v_head_dim"]),
@@ -424,7 +434,10 @@ def _load_deepseek(reader: "ShardReader", config: ModelConfig) -> dict:
     nhd, rhd = config.mla_nope_head_dim, config.mla_rope_head_dim
     vhd = config.mla_v_head_dim
     dc = config.mla_kv_lora_rank
-    perm = _rope_perm(rhd)
+    # HF's V2 modeling rotates interleaved complex pairs (permute to our
+    # rotate-half order); its V3 modeling already uses rotate_half.
+    v3 = config.moe_scoring == "sigmoid" or config.mla_q_lora_rank > 0
+    perm = (np.arange(rhd) if v3 else _rope_perm(rhd))
     params: dict = {
         "embed": reader.get("model.embed_tokens.weight").astype(dtype),
         "final_norm": reader.get("model.norm.weight").astype(dtype),
@@ -433,13 +446,21 @@ def _load_deepseek(reader: "ShardReader", config: ModelConfig) -> dict:
     if not config.tie_embeddings:
         params["lm_head"] = np.ascontiguousarray(
             reader.get("lm_head.weight").T).astype(dtype)
+    qr = config.mla_q_lora_rank
     for i in range(config.n_layers):
         p = f"model.layers.{i}."
-        wq = np.ascontiguousarray(
-            reader.get(p + "self_attn.q_proj.weight").T
-        ).reshape(h, qh, nhd + rhd)
-        wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., perm]],
-                            axis=-1)
+        if qr:
+            w_uq = np.ascontiguousarray(
+                reader.get(p + "self_attn.q_b_proj.weight").T
+            ).reshape(qr, qh, nhd + rhd)
+            w_uq = np.concatenate(
+                [w_uq[..., :nhd], w_uq[..., nhd:][..., perm]], axis=-1)
+        else:
+            wq = np.ascontiguousarray(
+                reader.get(p + "self_attn.q_proj.weight").T
+            ).reshape(h, qh, nhd + rhd)
+            wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., perm]],
+                                axis=-1)
         kv_a = np.ascontiguousarray(
             reader.get(p + "self_attn.kv_a_proj_with_mqa.weight").T)
         _expect(kv_a, (h, dc + rhd))
@@ -452,7 +473,6 @@ def _load_deepseek(reader: "ShardReader", config: ModelConfig) -> dict:
         lp = {
             "attn_norm": reader.get(
                 p + "input_layernorm.weight").astype(dtype),
-            "wq": wq.astype(dtype),
             "w_dkv": np.ascontiguousarray(kv_a[:, :dc]).astype(dtype),
             "w_kr": np.ascontiguousarray(
                 kv_a[:, dc:][:, perm]).astype(dtype),
@@ -464,12 +484,24 @@ def _load_deepseek(reader: "ShardReader", config: ModelConfig) -> dict:
             "mlp_norm": reader.get(
                 p + "post_attention_layernorm.weight").astype(dtype),
         }
+        if qr:
+            lp["w_dq"] = np.ascontiguousarray(
+                reader.get(p + "self_attn.q_a_proj.weight").T).astype(dtype)
+            lp["q_a_norm"] = reader.get(
+                p + "self_attn.q_a_layernorm.weight").astype(dtype)
+            lp["w_uq"] = w_uq.astype(dtype)
+        else:
+            lp["wq"] = wq.astype(dtype)
         m = config.mlp_hidden
         if config.layer_is_moe(i):
             em = config.expert_mlp_hidden or m
             router = reader.get(p + "mlp.gate.weight")
             _expect(router, (config.n_experts, h))
             lp["router"] = np.ascontiguousarray(router.T).astype(dtype)
+            if config.moe_scoring == "sigmoid":
+                lp["e_bias"] = reader.get(
+                    p + "mlp.gate.e_score_correction_bias"
+                ).astype(np.float32)
             gates, ups, downs = [], [], []
             for e in range(config.n_experts):
                 ep = f"{p}mlp.experts.{e}."
@@ -515,7 +547,9 @@ def _save_deepseek(params: dict, config: ModelConfig, path: str) -> None:
     nhd, rhd = config.mla_nope_head_dim, config.mla_rope_head_dim
     vhd = config.mla_v_head_dim
     dc = config.mla_kv_lora_rank
-    inv = _rope_perm_inv(rhd)
+    qr = config.mla_q_lora_rank
+    v3 = config.moe_scoring == "sigmoid" or qr > 0
+    inv = (np.arange(rhd) if v3 else _rope_perm_inv(rhd))
     out: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"]),
         "model.norm.weight": np.asarray(params["final_norm"]),
@@ -525,11 +559,22 @@ def _save_deepseek(params: dict, config: ModelConfig, path: str) -> None:
             np.asarray(params["lm_head"]).T)
     for i, lp in enumerate(params["layers"]):
         p = f"model.layers.{i}."
-        wq = np.asarray(lp["wq"])
-        wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., inv]],
-                            axis=-1)
-        out[p + "self_attn.q_proj.weight"] = np.ascontiguousarray(
-            wq.reshape(h, qh * (nhd + rhd)).T)
+        if qr:
+            w_uq = np.asarray(lp["w_uq"])
+            w_uq = np.concatenate(
+                [w_uq[..., :nhd], w_uq[..., nhd:][..., inv]], axis=-1)
+            out[p + "self_attn.q_a_proj.weight"] = np.ascontiguousarray(
+                np.asarray(lp["w_dq"]).T)
+            out[p + "self_attn.q_a_layernorm.weight"] = np.asarray(
+                lp["q_a_norm"])
+            out[p + "self_attn.q_b_proj.weight"] = np.ascontiguousarray(
+                w_uq.reshape(qr, qh * (nhd + rhd)).T)
+        else:
+            wq = np.asarray(lp["wq"])
+            wq = np.concatenate([wq[..., :nhd], wq[..., nhd:][..., inv]],
+                                axis=-1)
+            out[p + "self_attn.q_proj.weight"] = np.ascontiguousarray(
+                wq.reshape(h, qh * (nhd + rhd)).T)
         kv_a = np.concatenate(
             [np.asarray(lp["w_dkv"]),
              np.asarray(lp["w_kr"])[:, inv]], axis=1)
@@ -549,6 +594,9 @@ def _save_deepseek(params: dict, config: ModelConfig, path: str) -> None:
         if config.layer_is_moe(i):
             out[p + "mlp.gate.weight"] = np.ascontiguousarray(
                 np.asarray(lp["router"]).T)
+            if config.moe_scoring == "sigmoid":
+                out[p + "mlp.gate.e_score_correction_bias"] = np.asarray(
+                    lp["e_bias"], np.float32)
             for e in range(config.n_experts):
                 ep = f"{p}mlp.experts.{e}."
                 out[ep + "gate_proj.weight"] = np.ascontiguousarray(
@@ -679,9 +727,11 @@ def _get_path(tree, path: tuple):
 def hf_config_dict(config: ModelConfig) -> dict:
     """config.json contents for an exported checkpoint (HF-readable)."""
     if config.is_mla:
+        v3 = config.moe_scoring == "sigmoid" or config.mla_q_lora_rank > 0
         return {
-            "architectures": ["DeepseekV2ForCausalLM"],
-            "model_type": "deepseek_v2",
+            "architectures": ["DeepseekV3ForCausalLM" if v3
+                              else "DeepseekV2ForCausalLM"],
+            "model_type": "deepseek_v3" if v3 else "deepseek_v2",
             "hidden_size": config.hidden,
             "intermediate_size": config.mlp_hidden,
             "max_position_embeddings": config.max_context,
@@ -693,7 +743,7 @@ def hf_config_dict(config: ModelConfig) -> dict:
             "tie_word_embeddings": config.tie_embeddings,
             "vocab_size": config.vocab_size,
             "torch_dtype": config.dtype,
-            "q_lora_rank": None,
+            "q_lora_rank": config.mla_q_lora_rank or None,
             "kv_lora_rank": config.mla_kv_lora_rank,
             "qk_nope_head_dim": config.mla_nope_head_dim,
             "qk_rope_head_dim": config.mla_rope_head_dim,
@@ -706,10 +756,10 @@ def hf_config_dict(config: ModelConfig) -> dict:
             "first_k_dense_replace": config.first_k_dense,
             "norm_topk_prob": config.moe_norm_topk,
             "routed_scaling_factor": config.moe_routed_scale,
-            "topk_method": "greedy",
-            "scoring_func": "softmax",
-            "n_group": 1,
-            "topk_group": 1,
+            "topk_method": "noaux_tc" if v3 else "greedy",
+            "scoring_func": config.moe_scoring,
+            "n_group": config.moe_n_group,
+            "topk_group": config.moe_topk_group,
             "num_experts_per_token": config.n_experts_active or None,
             "attention_bias": False,
             "moe_layer_freq": 1,

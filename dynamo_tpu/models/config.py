@@ -44,6 +44,12 @@ class ModelConfig:
     n_shared_experts: int = 0
     moe_norm_topk: bool = True
     moe_routed_scale: float = 1.0
+    # DeepSeek-V3/R1 routing: sigmoid scores + a learned per-expert
+    # selection bias (e_score_correction_bias; selection only — weights
+    # use the unbiased scores) and node-limited group routing.
+    moe_scoring: str = "softmax"  # softmax | sigmoid
+    moe_n_group: int = 1
+    moe_topk_group: int = 1
     # Multimodal: placeholder token id for spliced image embeddings
     # (-1 = text-only) and the rows one image expands to (must match the
     # paired vision encoder's n_image_tokens)
@@ -165,6 +171,20 @@ PRESETS: dict[str, ModelConfig] = {
         first_k_dense=1, n_shared_experts=2, moe_norm_topk=False,
         mla_kv_lora_rank=512, mla_rope_head_dim=64, mla_nope_head_dim=128,
         mla_v_head_dim=128,
+    ),
+    # DeepSeek-V3/R1 (671B): the reference's headline recipes
+    # (recipes/deepseek-r1) — q-lora MLA, sigmoid+bias node-limited
+    # routing, 3 dense layers then 256-expert MoE with 1 shared expert.
+    "deepseek-v3": ModelConfig(
+        name="deepseek-v3", vocab_size=129280, hidden=7168, n_layers=61,
+        n_q_heads=128, n_kv_heads=128, head_dim=192, mlp_hidden=18432,
+        rope_theta=1e4, tie_embeddings=False, max_context=163840,
+        n_experts=256, n_experts_active=8, expert_mlp_hidden=2048,
+        first_k_dense=3, n_shared_experts=1, moe_norm_topk=True,
+        moe_routed_scale=2.5, moe_scoring="sigmoid", moe_n_group=8,
+        moe_topk_group=4,
+        mla_kv_lora_rank=512, mla_q_lora_rank=1536, mla_rope_head_dim=64,
+        mla_nope_head_dim=128, mla_v_head_dim=128,
     ),
     "tiny-mla-test": ModelConfig(
         name="tiny-mla-test", vocab_size=512, hidden=64, n_layers=2,

@@ -54,6 +54,12 @@ def param_axes(config: ModelConfig) -> dict:
         layer["kv_norm"] = (None,)
         layer["w_uk"] = (None, "q_heads", "head_dim")
         layer["w_uv"] = (None, "q_heads", "head_dim")
+        if config.mla_q_lora_rank:
+            # V3/R1-class query low-rank path replaces the direct wq
+            del layer["wq"]
+            layer["w_dq"] = ("embed", None)
+            layer["q_a_norm"] = (None,)
+            layer["w_uq"] = (None, "q_heads", "head_dim")
     else:
         layer["wk"] = ("embed", "kv_heads", "head_dim")
         layer["wv"] = ("embed", "kv_heads", "head_dim")
@@ -64,6 +70,8 @@ def param_axes(config: ModelConfig) -> dict:
         out = dict(layer)
         if config.layer_is_moe(i):
             out["router"] = ("embed", "experts")
+            if config.moe_scoring == "sigmoid":
+                out["e_bias"] = ("experts",)
             out["e_gate"] = ("experts", "embed", "mlp")
             out["e_up"] = ("experts", "embed", "mlp")
             out["e_down"] = ("experts", "mlp", "embed")
@@ -102,7 +110,6 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
             vhd = config.mla_v_head_dim
             p = {
                 "attn_norm": jnp.ones((h,), dtype),
-                "wq": dense(ks[0], (h, qh, nhd + rhd), h),
                 "w_dkv": dense(ks[1], (h, dc), h),
                 "w_kr": dense(ks[2], (h, rhd), h),
                 "kv_norm": jnp.ones((dc,), dtype),
@@ -110,6 +117,13 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
                 "w_uv": dense(ks[11], (dc, qh, vhd), dc),
                 "wo": dense(ks[3], (qh, vhd, h), qh * vhd),
             }
+            if config.mla_q_lora_rank:
+                qr = config.mla_q_lora_rank
+                p["w_dq"] = dense(ks[0], (h, qr), h)
+                p["q_a_norm"] = jnp.ones((qr,), dtype)
+                p["w_uq"] = dense(ks[12], (qr, qh, nhd + rhd), qr)
+            else:
+                p["wq"] = dense(ks[0], (h, qh, nhd + rhd), h)
         else:
             p = {
                 "attn_norm": jnp.ones((h,), dtype),
@@ -130,6 +144,8 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
         if config.layer_is_moe(layer_idx):
             e, em = config.n_experts, config.expert_mlp_hidden or m
             p["router"] = dense(ks[7], (h, e), h)
+            if config.moe_scoring == "sigmoid":
+                p["e_bias"] = jnp.zeros((e,), jnp.float32)
             p["e_gate"] = dense(ks[8], (e, h, em), h)
             p["e_up"] = dense(ks[9], (e, h, em), h)
             p["e_down"] = dense(ks[7], (e, em, h), em)
@@ -258,10 +274,32 @@ def _routing_weights(x: jax.Array, p: dict, config: ModelConfig):
     (weights [b,t,k] f32, topi [b,t,k])."""
     logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
                         p["router"].astype(jnp.float32))
-    scores = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(scores, config.n_experts_active)
+    if config.moe_scoring == "sigmoid":
+        # DeepSeek-V3/R1: sigmoid scores; SELECTION adds the learned
+        # correction bias and applies node-limited group routing (top-2
+        # sums per group pick topk_group groups); WEIGHTS are the
+        # unbiased scores at the selected experts.
+        b, t, e = logits.shape
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + p["e_bias"].astype(jnp.float32)
+        g = config.moe_n_group
+        if g > 1:
+            grouped = choice.reshape(b, t, g, e // g)
+            group_scores = jnp.sum(
+                jax.lax.top_k(grouped, 2)[0], axis=-1)  # [b, t, g]
+            _, gidx = jax.lax.top_k(group_scores, config.moe_topk_group)
+            gmask = jnp.zeros((b, t, g), jnp.float32).at[
+                jnp.arange(b)[:, None, None],
+                jnp.arange(t)[None, :, None], gidx].set(1.0)
+            choice = jnp.where(
+                jnp.repeat(gmask, e // g, axis=-1) > 0, choice, 0.0)
+        _, topi = jax.lax.top_k(choice, config.n_experts_active)
+        topv = jnp.take_along_axis(scores, topi, axis=-1)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(scores, config.n_experts_active)
     if config.moe_norm_topk:
-        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-20)
     return topv * config.moe_routed_scale, topi
 
 
@@ -356,10 +394,12 @@ def lora_target_dims(config: ModelConfig) -> dict[str, tuple[int, int]]:
     qh, kh, m = config.n_q_heads, config.n_kv_heads, config.mlp_hidden
     if config.is_mla:
         dims = {
-            "wq": (h, qh * (config.mla_nope_head_dim
-                            + config.mla_rope_head_dim)),
             "wo": (qh * config.mla_v_head_dim, h),
         }
+        if not config.mla_q_lora_rank:
+            # q-lora models (V3/R1) have no dense wq to adapt
+            dims["wq"] = (h, qh * (config.mla_nope_head_dim
+                                   + config.mla_rope_head_dim))
     else:
         dims = {
             "wq": (h, qh * hd),
@@ -673,7 +713,13 @@ def _mla_attention_block(
     dc = config.mla_kv_lora_rank
     scale = 1.0 / math.sqrt(config.mla_qk_head_dim)
 
-    q = jnp.einsum("bth,hqd->btqd", x, lp["wq"])  # [B,T,qh,nhd+rhd]
+    if "w_dq" in lp:
+        # V3/R1-class query low-rank path: rms(x @ w_dq) @ w_uq
+        q_lat = rms_norm(jnp.einsum("bth,hr->btr", x, lp["w_dq"]),
+                         lp["q_a_norm"], config.rms_eps)
+        q = jnp.einsum("btr,rqd->btqd", q_lat, lp["w_uq"])
+    else:
+        q = jnp.einsum("bth,hqd->btqd", x, lp["wq"])  # [B,T,qh,nhd+rhd]
     if q_extra is not None:
         q = q + q_extra.reshape(q.shape)
     q_nope, q_rope = q[..., :nhd], q[..., nhd:]
