@@ -243,7 +243,19 @@ class StepChannel:
             _send_frame(conn.sock, frame)
 
     def close(self) -> None:
+        import time
+
         for conn in self._conns:
+            # Drain outstanding acks first: closing the socket while a
+            # follower's final ack is in flight resets its connection and
+            # turns a clean shutdown into a follower crash.
+            deadline = time.monotonic() + 10.0
+            drained = 0
+            while drained < _ACK_WINDOW and time.monotonic() < deadline:
+                if conn.error:
+                    break
+                if conn.outstanding.acquire(timeout=0.1):
+                    drained += 1
             try:
                 _send_frame(conn.sock, {"m": _CLOSE, "a": [], "k": {}})
                 conn.sock.close()
@@ -355,6 +367,12 @@ def follower_serve(runner, cfg: MultihostConfig,
             except Exception as exc:  # noqa: BLE001 — report then die
                 _send_frame(sock, {"ok": False, "err": repr(exc)})
                 raise
-            _send_frame(sock, {"ok": True})
+            try:
+                _send_frame(sock, {"ok": True})
+            except (ConnectionError, BrokenPipeError):
+                # Driver shut down between its last plan and our ack:
+                # a clean exit, not a divergence.
+                log.info("driver closed during final ack; follower exiting")
+                return
     finally:
         sock.close()
