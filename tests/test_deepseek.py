@@ -265,3 +265,54 @@ class TestDeepseekV3:
             ref = model(torch.tensor([token_ids])).logits[0].numpy()
         ours = _logits(cfg, params, token_ids)
         np.testing.assert_allclose(ours, ref, atol=6e-3, rtol=2e-2)
+
+
+class TestWorkerPath:
+    def test_worker_serves_deepseek_checkpoint(self, tmp_path, run):
+        """A DeepSeek MLA checkpoint through the worker path: config from
+        its config.json, weights loaded, a request scheduled and decoded
+        end-to-end on the MLA engine."""
+        import uuid
+
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+        from dynamo_tpu.llm.protocols import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        params = init_params(jax.random.PRNGKey(2), TINY_DS)
+        ckpt = str(tmp_path / "ckpt")
+        save_params(params, TINY_DS, ckpt)
+
+        async def go():
+            import asyncio
+            import queue as thread_queue
+
+            worker = TpuWorker(
+                None, model_path=ckpt, warmup=False,
+                runner_config=RunnerConfig(page_size=4, num_pages=64,
+                                           max_batch=2,
+                                           max_pages_per_seq=16,
+                                           prefill_buckets=(16,)),
+            )
+            await worker.prepare()
+            try:
+                assert worker.weights_source == "checkpoint"
+                assert worker.model_config.is_mla
+                assert worker.model_config.n_shared_experts == 2
+                done: thread_queue.Queue = thread_queue.Queue()
+                worker.scheduler.submit(
+                    PreprocessedRequest(
+                        request_id=uuid.uuid4().hex,
+                        token_ids=list(range(1, 13)),
+                        sampling=SamplingOptions(max_tokens=3,
+                                                 temperature=0.0),
+                        stop=StopConditions(ignore_eos=True)),
+                    lambda o: done.put(o) if o.finish_reason else None)
+                out = await asyncio.to_thread(done.get, True, 120)
+                assert out.finish_reason == "length"
+            finally:
+                await worker.close()
+
+        run(go(), timeout=180)
