@@ -833,19 +833,52 @@ def save_params(params: dict, config: ModelConfig, path: str) -> None:
         json.dump(hf_config_dict(config), f, indent=2)
 
 
+_DIGEST_CACHE: dict = {}
+
+
 def checkpoint_digest(path: str) -> str:
     """Cheap CONTENT fingerprint of the weight files, so weight-service /
     peer-streaming keys (worker._weights_key) change when the checkpoint
     does — a stale arena must never shadow updated weights. Deliberately
     NOT mtime-based: two hosts holding identical bytes must compute the
     same key or cross-host peer streaming and arena reuse silently miss.
-    Per file we hash name + size + head and tail windows (a real weight
-    update rewrites essentially every byte, so sampling catches it) plus
-    config.json in full."""
+    Per file we hash name + size + the full safetensors header (tensor
+    names/dtypes/shapes/offsets — catches any re-layout), head and tail
+    windows, and interior 4KiB windows sampled every <=16MiB across the
+    whole file — so a same-size in-place edit touching only middle
+    tensors (merged/patched checkpoints) is caught whenever the edited
+    span is >=16MiB (any real tensor rewrite); sub-stride interior flips
+    are caught only probabilistically — full hashing would cost a full
+    checkpoint read on every worker start. config.json is hashed in
+    full.
+
+    Memoized per directory on a (name, size, mtime) stat signature: the
+    digest VALUE stays mtime-independent (cross-host keys must agree),
+    but a worker start calls this several times and the strided reads
+    are not free on network filesystems, so repeat calls within one
+    process only pay a stat() sweep unless a file changed."""
     import xxhash
+
+    root_key = os.path.realpath(
+        path if os.path.isdir(path) else os.path.dirname(path))
+    try:
+        sig = tuple(sorted(
+            (name, st.st_size, st.st_mtime_ns)
+            for name in os.listdir(root_key)
+            if (name == "config.json" or name.endswith(".safetensors"))
+            and (st := os.stat(os.path.join(root_key, name)))))
+    except OSError:
+        sig = None
+    if sig is not None:
+        cached = _DIGEST_CACHE.get(root_key)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
 
     hasher = xxhash.xxh64()
     window = 1 << 16
+    stride_window = 1 << 12
+    max_stride = 16 << 20
+    n_strides = 32
     root = path if os.path.isdir(path) else os.path.dirname(path)
     for fname in sorted(os.listdir(root)):
         fpath = os.path.join(root, fname)
@@ -856,8 +889,28 @@ def checkpoint_digest(path: str) -> str:
             size = os.path.getsize(fpath)
             hasher.update(f"{fname}:{size}".encode())
             with open(fpath, "rb") as f:
-                hasher.update(f.read(window))
+                head = f.read(window)
+                hasher.update(head)
+                if size >= 8:
+                    # safetensors: u64le header length, then JSON header.
+                    hlen = int.from_bytes(head[:8], "little")
+                    if 0 < hlen <= size - 8 and hlen + 8 > window:
+                        f.seek(8)
+                        hasher.update(f.read(min(hlen, 1 << 24)))
                 if size > 2 * window:
+                    # Evenly strided interior samples, at most 16MiB
+                    # apart so any whole-tensor rewrite lands in one.
+                    span = size - 2 * window
+                    step = min(max(span // n_strides, stride_window),
+                               max_stride)
+                    pos = window
+                    while pos < size - window:
+                        f.seek(pos)
+                        hasher.update(f.read(stride_window))
+                        pos += step
                     f.seek(size - window)
                     hasher.update(f.read(window))
-    return f"{hasher.intdigest():016x}"
+    digest = f"{hasher.intdigest():016x}"
+    if sig is not None:
+        _DIGEST_CACHE[root_key] = (sig, digest)
+    return digest

@@ -215,7 +215,17 @@ class StepChannel:
     """Rank 0's fan-out of runner calls to follower processes."""
 
     def __init__(self, host: str, port: int, n_followers: int) -> None:
+        from ..runtime.config import env
+
         self.n_followers = n_followers
+        # Bound on how long a follower may sit on a full ack window
+        # without acking anything. A follower that hangs without
+        # erroring (e.g. a stuck collective) must tear the driver down
+        # loudly, not block its scheduler thread forever. Followers ack
+        # a step only after executing it, so the default (10 min) must
+        # stay above the slowest cold XLA compile a follower can hit.
+        self.publish_timeout = float(
+            env("DYNT_MULTIHOST_PUBLISH_TIMEOUT_SECS"))
         self._conns: list[_FollowerConn] = []
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -233,13 +243,20 @@ class StepChannel:
         self._server.close()
 
     def publish(self, method: str, args: tuple, kwargs: dict) -> None:
+        timeout = self.publish_timeout
         frame = {"m": method, "a": _enc(list(args)), "k": _enc(kwargs)}
         for conn in self._conns:
             if conn.error:
                 raise RuntimeError(
                     f"multihost follower {conn.peer} failed: {conn.error} "
                     "— the SPMD program has diverged; restart the worker")
-            conn.outstanding.acquire()
+            if not conn.outstanding.acquire(timeout=timeout):
+                conn.error = conn.error or (
+                    f"no ack for {timeout:.0f}s "
+                    f"(window {_ACK_WINDOW} full, last method {method!r})")
+                raise RuntimeError(
+                    f"multihost follower {conn.peer} hung: {conn.error} "
+                    "— the SPMD program has diverged; restart the worker")
             _send_frame(conn.sock, frame)
 
     def close(self) -> None:

@@ -178,6 +178,11 @@ _register("DYNT_MIGRATION_LIMIT", 3, _int,
           "Max in-flight request migrations across workers (ref: migration.rs)")
 _register("DYNT_CANARY_WAIT_SECS", 30.0, _float,
           "Idle time before canary health-check probes (ref: health_check.rs:22)")
+_register("DYNT_MULTIHOST_PUBLISH_TIMEOUT_SECS", 600.0, _float,
+          "How long the multihost driver waits on a follower's full ack "
+          "window before declaring it hung and tearing down loudly. Must "
+          "exceed the slowest follower-side cold XLA compile (a follower "
+          "acks a step only after executing it)")
 
 
 @dataclasses.dataclass

@@ -236,6 +236,8 @@ class ZmqEventSubscriberManager:
 
 import os
 import struct
+import threading
+import time
 
 
 def _journal_pack(topic: str, payload: Any) -> bytes:
@@ -267,18 +269,33 @@ class JournalEventPublisher(EventPublisher):
     default file storage). Rotation: past `max_bytes` the publisher starts
     a new generation seeded with snapshot frames from `snapshot_fn` (the
     worker's local-index dump — the state that replaces the discarded
-    history), then unlinks the old generation. Subscribers switch to the
-    highest generation and reset their offset, so replayed state stays
-    exact across rotations."""
+    history). Rotated-away generations are kept on disk for
+    `grace_seconds` so subscribers (which poll every ~50ms) can drain
+    their tail frames in order before switching to the newest
+    generation; only generations retired longer ago than the grace
+    period are unlinked. Within that grace window replay is exact; a
+    subscriber that lags a rotation by more than grace_seconds falls
+    back to the newest generation's snapshot frames (exact for
+    snapshot-covered topics, lossy for fire-and-forget topics like
+    load metrics — same stance as JetStream's retention limits).
+
+    publish() may be called from multiple asyncio tasks concurrently
+    (each dispatches to a threadpool thread), so _append/_rotate are
+    serialized with a lock — interleaved buffered writes would tear
+    frames in the journal that restarted routers replay."""
 
     def __init__(self, root: str, namespace: str,
-                 max_bytes: int = 64 * 2**20) -> None:
+                 max_bytes: int = 64 * 2**20,
+                 grace_seconds: float = 5.0) -> None:
         self.publisher_id = uuid.uuid4().hex
         self._dir = os.path.join(root, namespace)
         os.makedirs(self._dir, exist_ok=True)
         self._generation = 0
         self._max_bytes = max_bytes
+        self._grace = grace_seconds
         self._file = open(self._path(), "ab")
+        self._lock = threading.Lock()
+        self._retired: list[tuple[str, float]] = []  # (path, retired_at)
         self.snapshot_fn: Optional[Callable[[], list]] = None
 
     def _path(self) -> str:
@@ -295,12 +312,32 @@ class JournalEventPublisher(EventPublisher):
         await asyncio.to_thread(self._append, data)
 
     def _append(self, data: bytes) -> None:
-        self._file.write(data)
-        self._file.flush()
-        if self._file.tell() >= self._max_bytes:
-            self._rotate()
+        with self._lock:
+            self._file.write(data)
+            self._file.flush()
+            if self._file.tell() >= self._max_bytes:
+                self._rotate()
+            elif self._retired:
+                # A publisher that stops rotating must still prune
+                # retired generations once their grace expires, or they
+                # accumulate on shared storage forever.
+                self._prune_retired(time.monotonic())
+
+    def _prune_retired(self, now: float) -> None:
+        # Caller holds self._lock.
+        keep: list[tuple[str, float]] = []
+        for path, at in self._retired:
+            if now - at >= self._grace:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            else:
+                keep.append((path, at))
+        self._retired = keep
 
     def _rotate(self) -> None:
+        # Caller holds self._lock.
         old_path, old_file = self._path(), self._file
         self._generation += 1
         new_file = open(self._path(), "ab")
@@ -315,15 +352,25 @@ class JournalEventPublisher(EventPublisher):
         new_file.flush()
         self._file = new_file
         old_file.close()
-        try:
-            os.unlink(old_path)
-        except OSError:
-            pass
+        # Grace window: retire old_path; unlink only generations that
+        # have been retired longer than the grace period, so subscribers
+        # can drain tails even across rapid back-to-back rotations.
+        now = time.monotonic()
+        self._retired.append((old_path, now))
+        self._prune_retired(now)
         log.info("journal rotated to generation %d (%s)",
                  self._generation, self.publisher_id)
 
     async def close(self) -> None:
-        self._file.close()
+        with self._lock:
+            self._file.close()
+            # Nothing needs a superseded generation once the final one
+            # holds the snapshot — unlink all retired files so routine
+            # restarts never accumulate garbage on shared storage. (A
+            # subscriber mid-drain can at worst lose fire-and-forget
+            # tail frames of a publisher that is shutting down anyway.)
+            self._grace = 0.0
+            self._prune_retired(time.monotonic())
 
 
 class JournalEventSubscriberManager:
@@ -347,6 +394,25 @@ class JournalEventSubscriberManager:
         self._task = asyncio.create_task(self._poll_loop())
         return self._subscriber
 
+    def _read_frames(self, pub: str, gen: int, offset: int,
+                     out: list[tuple[str, Any]]) -> Optional[int]:
+        """Read complete frames of `<pub>.g<gen>.log` from offset into
+        out (prefix-filtered); returns the new offset, or None if the
+        file is gone (rotated away and past its grace window)."""
+        path = os.path.join(self._dir, f"{pub}.g{gen}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                buf = f.read()
+        except OSError:
+            return None
+        pos = 0
+        for next_pos, topic, payload in _journal_read(buf, 0):
+            pos = next_pos
+            if topic.startswith(self._prefix):
+                out.append((topic, payload))
+        return offset + pos
+
     def _scan(self) -> list[tuple[str, Any]]:
         """Thread-side: read new frames from every log; returns events."""
         out: list[tuple[str, Any]] = []
@@ -367,21 +433,29 @@ class JournalEventSubscriberManager:
                 files[pub] = gen
         for pub, gen in files.items():
             cur_gen, offset = self._positions.get(pub, (-1, 0))
+            # Buffer this publisher's frames and emit them only if the
+            # newest-generation read succeeds — emitting drained tails
+            # while leaving _positions unadvanced (e.g. a transient
+            # ESTALE on the newest file over NFS/GCS-fuse) would
+            # re-emit the same frames on the next poll.
+            pub_out: list[tuple[str, Any]] = []
+            if gen > cur_gen and cur_gen >= 0:
+                # Drain every generation between our position and the
+                # newest, in order — the publisher keeps rotated
+                # generations on disk for a grace period exactly for
+                # this window. A generation already unlinked (we fell
+                # past the grace window) is skipped; its state is
+                # covered by the newest generation's snapshot frames.
+                for g in range(cur_gen, gen):
+                    self._read_frames(pub, g,
+                                      offset if g == cur_gen else 0,
+                                      pub_out)
             if gen > cur_gen:
                 offset = 0  # new generation: replay from its start
-            path = os.path.join(self._dir, f"{pub}.g{gen}.log")
-            try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    buf = f.read()
-            except OSError:
-                continue  # rotated away between listdir and open
-            pos = 0
-            for next_pos, topic, payload in _journal_read(buf, 0):
-                pos = next_pos
-                if topic.startswith(self._prefix):
-                    out.append((topic, payload))
-            self._positions[pub] = (gen, offset + pos)
+            new_offset = self._read_frames(pub, gen, offset, pub_out)
+            if new_offset is not None:
+                self._positions[pub] = (gen, new_offset)
+                out.extend(pub_out)
         return out
 
     async def _poll_loop(self) -> None:

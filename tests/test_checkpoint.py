@@ -165,6 +165,25 @@ class TestRoundtrip:
         save_params(init_params(jax.random.PRNGKey(1), cfg), cfg, out)
         assert checkpoint_digest(out) != d1
 
+    def test_digest_catches_interior_only_edit(self, tmp_path):
+        """A same-size in-place edit touching only middle bytes (a merged
+        or patched checkpoint) must change the digest — head/tail-window
+        sampling alone would miss it; the strided interior samples and
+        full-header hash are the defense."""
+        cfg = get_config("tiny-test")
+        out = str(tmp_path / "ckpt")
+        save_params(init_params(jax.random.PRNGKey(0), cfg), cfg, out)
+        d1 = checkpoint_digest(out)
+        st_path = os.path.join(out, "model.safetensors")
+        size = os.path.getsize(st_path)
+        assert size > 3 * (1 << 16), "fixture too small to have interior"
+        with open(st_path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(64)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        assert checkpoint_digest(out) != d1
+
 
 class TestHfConfig:
     def test_qwen3_fields(self):

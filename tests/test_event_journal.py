@@ -99,14 +99,18 @@ class TestJournalTransport:
     def test_rotation_seeds_snapshot_and_old_gen_removed(self, run,
                                                          tmp_path):
         async def body():
-            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=400)
+            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=400,
+                                        grace_seconds=0.0)
             pub.set_snapshot_fn(
                 lambda: [("kv_snapshot", {"state": "current"})])
             for i in range(40):  # well past max_bytes -> several rotations
                 await pub.publish("kv_events", {"i": i, "pad": "x" * 40})
             assert pub._generation > 0
-            files = os.listdir(tmp_path / "ns")
-            assert len(files) == 1  # old generations unlinked
+            files = sorted(os.listdir(tmp_path / "ns"))
+            # grace_seconds=0: retired generations unlink at the next
+            # rotation, so at most the current + newest-retired remain.
+            assert len(files) <= 2
+            assert f"{pub.publisher_id}.g{pub._generation}.log" in files
             # fresh subscriber: snapshot frame first, then the tail
             mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
                                                 poll_interval=0.02)
@@ -116,6 +120,67 @@ class TestJournalTransport:
             assert events[0][1] == {"state": "current"}
             await mgr.close()
             await pub.close()
+        run(body())
+
+    def test_rotation_tail_frames_not_lost(self, run, tmp_path):
+        """A subscriber whose last poll position is mid-way through a
+        generation that then rotates must still see that generation's
+        tail frames (non-snapshot topics like load metrics are not
+        reproduced by the rotation snapshot)."""
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=500)
+            pub.set_snapshot_fn(lambda: [("kv_snapshot", {"s": 1})])
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            # Publish a first frame and let the subscriber catch up so it
+            # holds a position inside generation 0.
+            await pub.publish("load_metrics", {"i": 0})
+            assert len(await _drain(sub, 1)) == 1
+            # Stall polling while the publisher writes tail frames into
+            # gen 0 and then rotates past max_bytes.
+            mgr._poll = 0.5
+            i = 1
+            while pub._generation == 0:
+                await pub.publish("load_metrics", {"i": i, "pad": "z" * 60})
+                i += 1
+            mgr._poll = 0.02
+            events = await _drain(sub, i, timeout=5.0)
+            got = [p["i"] for t, p in events if t == "load_metrics"]
+            # Every load_metrics frame from gen 0's tail was delivered.
+            assert got == list(range(1, i))
+            await mgr.close()
+            await pub.close()
+        run(body())
+
+    def test_concurrent_publishes_never_tear_frames(self, run, tmp_path):
+        """publish() from many tasks concurrently (threadpool-dispatched
+        appends) must keep every frame intact across rotations. Checked
+        directly against the on-disk files: every surviving generation
+        parses cleanly to its last byte and — because the default grace
+        window keeps all generations of this short burst on disk — every
+        published frame is present exactly once."""
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns", max_bytes=800)
+            pub.set_snapshot_fn(lambda: [])
+            await asyncio.gather(*[
+                pub.publish("t", {"i": i, "pad": "w" * 50})
+                for i in range(60)])
+            assert pub._generation > 0  # the burst really rotated
+            # Scan before close(): close unlinks retired generations.
+            from dynamo_tpu.runtime.events import _journal_read
+            got = []
+            for name in os.listdir(tmp_path / "ns"):
+                buf = (tmp_path / "ns" / name).read_bytes()
+                end = 0
+                for pos, _t, payload in _journal_read(buf, 0):
+                    end = pos
+                    got.append(payload["i"])
+                assert end == len(buf), f"torn frame in {name}"
+            assert sorted(got) == list(range(60))
+            await pub.close()
+            # close() leaves only the final generation on disk.
+            assert len(os.listdir(tmp_path / "ns")) == 1
         run(body())
 
     def test_live_subscriber_follows_rotation(self, run, tmp_path):
