@@ -63,6 +63,15 @@ class _PagedKvCache:
     def free_blocks(self) -> int:
         return self.capacity - self.used - len(self.cached)
 
+    def evictable_blocks(self) -> int:
+        """Cached blocks no running request references — reclaimable by
+        allocate() on demand, so admission math must count them as free
+        capacity (ref: vllm_backend.rs inactive pool — eviction source
+        during allocation). Counting them as occupied would stall
+        admission on exactly the cache-rich workers KV-affinity routing
+        prefers."""
+        return sum(1 for h in self.cached if self.refcount.get(h, 0) == 0)
+
     def match_prefix(self, block_hashes: list[int]) -> int:
         """Longest cached prefix; touches LRU and pins the blocks."""
         matched = 0
@@ -272,22 +281,32 @@ class MockerEngine:
             evicted_total: list[int] = []
             self._admit(evicted_total.extend)
             prefill_tokens = self._prefill_step()
-            decoded = await self._decode_step()
-            if evicted_total:
-                await self._publish_removed(evicted_total)
-            await self._flush_stored()
-            self.steps += 1
-            elapsed = time.monotonic() - step_start
-            target = self._step_time(prefill_tokens, decoded)
-            delay = max(0.0, target - elapsed)
-            if delay:
-                await asyncio.sleep(delay)
-            elif not prefill_tokens and not decoded:
-                # Nothing progressed (all waiting on blocks): back off instead
-                # of busy-spinning the loop.
-                await asyncio.sleep(0.005)
-            else:
-                await asyncio.sleep(0)
+            decoded, deliveries = await self._decode_step()
+            try:
+                if evicted_total:
+                    await self._publish_removed(evicted_total)
+                await self._flush_stored()
+                self.steps += 1
+                elapsed = time.monotonic() - step_start
+                target = self._step_time(prefill_tokens, decoded)
+                delay = max(0.0, target - elapsed)
+                if delay:
+                    await asyncio.sleep(delay)
+                elif not prefill_tokens and not decoded:
+                    # Nothing progressed (all waiting on blocks): back off
+                    # instead of busy-spinning the loop.
+                    await asyncio.sleep(0.005)
+                else:
+                    await asyncio.sleep(0)
+            finally:
+                # Deliver AFTER sleeping the modeled step time: the step's
+                # outputs become visible at step end, so TTFT/ITL include
+                # the compute they rode on. finally: sequences finalized
+                # in _decode_step are already off _running, so dropping
+                # their frames on cancellation/publish failure would hang
+                # consumers waiting on the terminal None.
+                for queue, item in deliveries:
+                    queue.put_nowait(item)
 
     def _step_time(self, prefill_tokens: int, decoded: int) -> float:
         cfg = self.config
@@ -329,7 +348,8 @@ class MockerEngine:
                 continue
             need = max(0, total_blocks - cached)
             reserve = int(self.kv.capacity * cfg.watermark)
-            if (self.kv.free_blocks() - need < reserve and self._running) \
+            reclaimable = self.kv.free_blocks() + self.kv.evictable_blocks()
+            if (reclaimable - need < reserve and self._running) \
                     or not self.kv.allocate(need, evict_cb):
                 self.kv.unpin(prefix)
                 break  # wait for blocks to free up
@@ -361,8 +381,16 @@ class MockerEngine:
             total += chunk
         return total
 
-    async def _decode_step(self) -> int:
-        """Generate one token for each fully-prefilled sequence."""
+    async def _decode_step(self) -> tuple[int, list]:
+        """Generate one token for each fully-prefilled sequence.
+
+        Outputs are COLLECTED, not delivered: a step's tokens exist only
+        once the step's modeled compute time has elapsed, so the step
+        loop sleeps the step time first and then flushes the deliveries
+        (otherwise TTFT on an uncontended worker measures ~0 instead of
+        the prefill cost — ref: the real engine returns step outputs at
+        step end)."""
+        deliveries: list[tuple[asyncio.Queue, object]] = []
         decoded = 0
         finished: list[_Sequence] = []
         for seq in self._running:
@@ -378,15 +406,15 @@ class MockerEngine:
                 # the decode mocker just skips its prefill pass).
                 first = 97 + (len(req.token_ids) % 26)
                 seq.done = True
-                seq.queue.put_nowait(EngineOutput(
+                deliveries.append((seq.queue, EngineOutput(
                     token_ids=[], finish_reason="stop",
                     prompt_tokens=len(req.token_ids),
                     kv_transfer_params={
                         "mock": True, "first_token": first,
                         "prompt_len": len(req.token_ids),
                     },
-                ).to_wire())
-                seq.queue.put_nowait(None)
+                ).to_wire()))
+                deliveries.append((seq.queue, None))
                 finished.append(seq)
                 continue
             # Deterministic pseudo-output: echo the prompt, or cycle
@@ -405,15 +433,15 @@ class MockerEngine:
                 finish_reason=finish,
                 prompt_tokens=len(req.token_ids) if seq.generated == 1 else None,
             )
-            seq.queue.put_nowait(output.to_wire())
+            deliveries.append((seq.queue, output.to_wire()))
             if finish is not None:
                 seq.done = True
-                seq.queue.put_nowait(None)
+                deliveries.append((seq.queue, None))
                 finished.append(seq)
         for seq in finished:
             self._running.remove(seq)
             self._release(seq)
-        return decoded
+        return decoded, deliveries
 
     def _release(self, seq: _Sequence) -> None:
         """On completion: completed full blocks become reusable cache entries;

@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time as _time
 from typing import Callable, Iterator, Optional
 
 import numpy as np
@@ -862,11 +863,19 @@ def checkpoint_digest(path: str) -> str:
     root_key = os.path.realpath(
         path if os.path.isdir(path) else os.path.dirname(path))
     try:
-        sig = tuple(sorted(
-            (name, st.st_size, st.st_mtime_ns)
-            for name in os.listdir(root_key)
-            if (name == "config.json" or name.endswith(".safetensors"))
-            and (st := os.stat(os.path.join(root_key, name)))))
+        stats = [
+            (name, os.stat(os.path.join(root_key, name)))
+            for name in sorted(os.listdir(root_key))
+            if name == "config.json" or name.endswith(".safetensors")]
+        sig = tuple((name, st.st_size, st.st_mtime_ns)
+                    for name, st in stats)
+        # Coarse-mtime guard: on filesystems with ~1s timestamp
+        # granularity a same-size in-place rewrite within the same tick
+        # would leave the stat signature unchanged. Only trust the cache
+        # for files that have been quiet for a couple of seconds.
+        newest = max((st.st_mtime for _n, st in stats), default=0.0)
+        if _time.time() - newest < 2.0:
+            sig = None
     except OSError:
         sig = None
     if sig is not None:
@@ -898,11 +907,16 @@ def checkpoint_digest(path: str) -> str:
                         f.seek(8)
                         hasher.update(f.read(min(hlen, 1 << 24)))
                 if size > 2 * window:
-                    # Evenly strided interior samples, at most 16MiB
-                    # apart so any whole-tensor rewrite lands in one.
+                    # Evenly strided interior samples: <=16MiB apart so
+                    # any whole-tensor rewrite lands in one, but capped
+                    # at 64 samples per file so a multi-GB shard on a
+                    # network filesystem costs at most 64 small reads
+                    # (granularity degrades to span/64 there — still
+                    # finer than any real tensor in such a shard).
                     span = size - 2 * window
-                    step = min(max(span // n_strides, stride_window),
-                               max_stride)
+                    step = max(min(max(span // n_strides, stride_window),
+                                   max_stride),
+                               span // 64)
                     pos = window
                     while pos < size - window:
                         f.seek(pos)
