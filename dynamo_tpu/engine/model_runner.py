@@ -238,6 +238,7 @@ class ModelRunner:
             )
         self._decode_fn = self._build_decode(False)
         self._decode_fn_lp = None  # built on first logprobs request
+        self._decode_fn_logits = None  # built on first processor request
         self._decode_multi_fns: dict[int, callable] = {}
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
@@ -247,7 +248,8 @@ class ModelRunner:
 
     # -- compiled step builders -------------------------------------------
 
-    def _build_decode(self, with_logprobs: bool = False):
+    def _build_decode(self, with_logprobs: bool = False,
+                      with_logits: bool = False):
         cfg = self.model_config
         attention_fn = self._attention_fn
         with_lora = self.lora_pack is not None
@@ -283,6 +285,16 @@ class ModelRunner:
             # requests the engine is running.
             kv, logits = one(params, kv, tokens, positions, block_tables,
                              kv_lens, active, lora, lora_idx)
+            if with_logits:
+                # Logits-processor escape hatch: ship the raw rows to
+                # host alongside the device-sampled tokens; the scheduler
+                # re-samples processor slots on host. Costs a [B, V] f32
+                # readback — paid only by steps whose batch contains a
+                # processor request.
+                next_tokens = sample(
+                    logits[:, 0, :], temperature, top_p, top_k, seeds,
+                    step_idx)
+                return kv, next_tokens, logits[:, 0, :].astype(jnp.float32)
             if with_logprobs:
                 next_tokens, lp, top_ids, top_lps = sample_with_logprobs(
                     logits[:, 0, :], temperature, top_p, top_k, seeds,
@@ -295,9 +307,13 @@ class ModelRunner:
                 logits[:, 0, :], temperature, top_p, top_k, seeds, step_idx)
             return kv, next_tokens
 
-        shard = ((self._kv_sharding, self._rep, self._rep, self._rep,
-                  self._rep) if with_logprobs
-                 else (self._kv_sharding, self._rep))
+        if with_logits:
+            shard = (self._kv_sharding, self._rep, self._rep)
+        elif with_logprobs:
+            shard = (self._kv_sharding, self._rep, self._rep, self._rep,
+                     self._rep)
+        else:
+            shard = (self._kv_sharding, self._rep)
         return jax.jit(step, donate_argnums=(1,), out_shardings=shard)
 
     def _build_decode_multi(self, k: int):
@@ -663,11 +679,16 @@ class ModelRunner:
         steps: Optional[np.ndarray] = None,  # [B] per-slot token index
         lora_idx: Optional[np.ndarray] = None,  # [B] adapter slot per seq
         want_logprobs: bool = False,
+        want_logits: bool = False,
     ) -> np.ndarray:
         """One decode step for all slots; returns sampled tokens [B].
         `want_logprobs` selects the variant that also returns logprob data
         (read via last_decode_sample) — the plain variant skips the
-        full-vocab log_softmax/top_k and the extra host transfers."""
+        full-vocab log_softmax/top_k and the extra host transfers.
+        `want_logits` selects the logits-processor variant that also
+        returns the raw [B, V] logits rows (read via last_decode_logits);
+        it overrides want_logprobs (the scheduler derives logprob data on
+        host from the raw rows in that mode)."""
         self.decode_steps += 1
         if steps is None:
             steps = np.zeros(len(tokens), np.int32)
@@ -685,16 +706,26 @@ class ModelRunner:
             if lora_idx is None:
                 lora_idx = np.zeros(len(tokens), np.int32)
             args += [self.lora_pack, jnp.asarray(lora_idx, jnp.int32)]
-        if want_logprobs:
+        if want_logits:
+            if self._decode_fn_logits is None:
+                self._decode_fn_logits = self._build_decode(
+                    with_logits=True)
+            self.kv_cache, next_tokens, logits = \
+                self._decode_fn_logits(*args)
+            self.last_decode_logits = np.asarray(logits)
+            self.last_decode_sample = (None, None, None)
+        elif want_logprobs:
             if self._decode_fn_lp is None:
                 self._decode_fn_lp = self._build_decode(True)
             self.kv_cache, next_tokens, lp, top_ids, top_lps = \
                 self._decode_fn_lp(*args)
             self.last_decode_sample = (np.asarray(lp), np.asarray(top_ids),
                                        np.asarray(top_lps))
+            self.last_decode_logits = None
         else:
             self.kv_cache, next_tokens = self._decode_fn(*args)
             self.last_decode_sample = (None, None, None)
+            self.last_decode_logits = None
         return np.asarray(next_tokens)
 
     # -- LoRA slot pack ----------------------------------------------------

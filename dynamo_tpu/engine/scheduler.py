@@ -61,6 +61,15 @@ class _Seq:
     # Multimodal: encoder rows spliced at image-placeholder positions,
     # consumed in token order across prefill chunks
     media_embeds: Optional[np.ndarray] = None  # [total_rows, H]
+    # Logits processors (llm/logits_processing.py): instantiated per
+    # request at _prepare; non-empty routes this sequence through the
+    # host-sampling decode path (block=1 + raw-logits readback)
+    processors: Optional[list] = None
+    # Processor sequences defer their FIRST token past prefill (prefill
+    # samples on device without logits readback): the first decode step
+    # re-attends at prompt_len-1 (idempotent KV rewrite of the last
+    # prompt token) and produces it through the host path.
+    first_deferred: bool = False
 
     @property
     def decode_ready(self) -> bool:
@@ -278,13 +287,44 @@ class InferenceScheduler:
         seed = request.sampling.seed
         if seed is None:
             seed = abs(hash(request.request_id)) & 0xFFFFFFFF
+        try:
+            processors = self._build_processors(request)
+        except (ValueError, TypeError, KeyError) as exc:
+            emit(EngineOutput(finish_reason="error",
+                              error=f"logits processors: {exc}"))
+            return None
         return _Seq(
             request=request, emit=emit, block_hashes=block_hashes,
             alloc=PageAllocation([], [], 0),
             block_table=np.zeros(self.runner.config.max_pages_per_seq,
                                  np.int32),
             slot=-1, prompt_len=prompt_len, prefill_pos=0, seed=seed,
+            processors=processors,
         )
+
+    def _build_processors(self, request: PreprocessedRequest):
+        """Instantiate the request's logits processors (explicit specs +
+        implicit ones for logit_bias and penalties). Non-empty switches
+        the sequence onto the host-sampling decode path."""
+        from ..llm.logits_processing import (
+            LogitBiasProcessor,
+            PenaltyProcessor,
+            resolve_processors,
+        )
+
+        procs: list = []
+        s = request.sampling
+        if s.logit_bias:
+            procs.append(LogitBiasProcessor(
+                {int(k): float(v) for k, v in s.logit_bias.items()}))
+        if s.frequency_penalty or s.presence_penalty:
+            procs.append(PenaltyProcessor(s.frequency_penalty,
+                                          s.presence_penalty))
+        if request.logits_processors:
+            procs.extend(resolve_processors(
+                request.logits_processors,
+                tokenizer=getattr(self, "logits_tokenizer", None)))
+        return procs or None
 
     def _admit(self) -> None:
         while self._waiting:
@@ -358,6 +398,13 @@ class InferenceScheduler:
                                       part)
         seq.onboard_blocks = None  # free host memory
         seq.prefill_pos = seq.prompt_len
+        if seq.processors:
+            # The prefill worker sampled the first token on device with
+            # no processors applied — discard it and let the first
+            # decode step regenerate its logits through the host path
+            # (same idempotent-rewrite trick as _defer_first_token).
+            self._defer_first_token(seq)
+            return
         self._append_token(seq, int(seq.onboard_first_token),
                            prompt_tokens=seq.prompt_len)
 
@@ -412,6 +459,8 @@ class InferenceScheduler:
                 tokens += seq.prompt_len
                 if seq.prefill_only:
                     self._finish_prefill_only(seq, token)
+                elif seq.processors:
+                    self._defer_first_token(seq)
                 else:
                     self._append_token(seq, token,
                                        prompt_tokens=seq.prompt_len,
@@ -442,6 +491,8 @@ class InferenceScheduler:
             if is_final:
                 if seq.prefill_only:
                     self._finish_prefill_only(seq, token)
+                elif seq.processors:
+                    self._defer_first_token(seq)
                 else:
                     self._append_token(
                         seq, token, prompt_tokens=seq.prompt_len,
@@ -449,6 +500,14 @@ class InferenceScheduler:
                                             "last_prefill_sample", None))
             return chunk
         return 0
+
+    def _defer_first_token(self, seq: _Seq) -> None:
+        """Processor sequences discard the device-sampled prefill token;
+        the first decode step (input = last prompt token at position
+        prompt_len-1, an idempotent KV rewrite) regenerates its logits
+        and the host path picks the token."""
+        seq.first_deferred = True
+        seq.last_token = int(seq.request.token_ids[-1])
 
     def _chunk_media_embeds(self, seq: _Seq,
                             chunk_tokens: np.ndarray) -> np.ndarray:
@@ -499,9 +558,12 @@ class InferenceScheduler:
     def _decode_all(self) -> int:
         ready = [s for s in self._slots
                  if s is not None and s.decode_ready and not s.finished
-                 and not s.cancelled and len(s.generated) > 0]
+                 and not s.cancelled
+                 and (len(s.generated) > 0 or s.first_deferred)]
         # Sequences whose first token just came from prefill already have
-        # generated[0]; they join decode from the next step.
+        # generated[0]; they join decode from the next step. (Processor
+        # sequences instead join with first_deferred set — their first
+        # token is produced HERE through the host path.)
         if not ready:
             return 0
         self._active[:] = False
@@ -527,7 +589,9 @@ class InferenceScheduler:
             self._steps[i] = len(seq.generated)
             self._lora_idx[i] = seq.lora_idx
         want_logprobs = any(s.request.sampling.logprobs for s in ready)
-        block, depth = self._decode_block_for(ready, want_logprobs)
+        want_logits = any(s.processors for s in ready)
+        block, depth = self._decode_block_for(
+            ready, want_logprobs or want_logits)
         # Bucket the block-table width to the LIVE context: the decode
         # attention gather reads the full table extent, so a conversation
         # 300 tokens deep must not pay for max_pages_per_seq (e.g. 128
@@ -571,18 +635,73 @@ class InferenceScheduler:
             self._tokens, self._positions, tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
             self._steps, lora_idx=self._lora_idx,
-            want_logprobs=want_logprobs,
+            want_logprobs=want_logprobs and not want_logits,
+            want_logits=want_logits,
         )
         lp_b, tid_b, tlp_b = getattr(self.runner, "last_decode_sample",
                                      (None, None, None))
+        logits_rows = (getattr(self.runner, "last_decode_logits", None)
+                       if want_logits else None)
         count = 0
         for seq in ready:
             i = seq.slot
             info = ((lp_b[i], tid_b[i], tlp_b[i])
                     if lp_b is not None else None)
-            self._append_token(seq, int(next_tokens[i]), sample_info=info)
+            token = int(next_tokens[i])
+            if logits_rows is not None:
+                try:
+                    token, info = self._host_sample_slot(
+                        seq, logits_rows[i], token)
+                except Exception as exc:  # noqa: BLE001 — a misbehaving
+                    # user processor (bad token id, all-banned vocab)
+                    # must error ITS request, not kill the scheduler
+                    # thread and hang the whole engine.
+                    log.warning("logits processor failed for %s: %r",
+                                seq.request.request_id, exc)
+                    seq.finished = True
+                    seq.emit(EngineOutput(
+                        finish_reason="error",
+                        error=f"logits processor failed: {exc}"))
+                    continue
+            first = seq.first_deferred and not seq.generated
+            seq.first_deferred = False
+            self._append_token(
+                seq, token, sample_info=info,
+                prompt_tokens=seq.prompt_len if first else None)
             count += 1
         return count
+
+    def _host_sample_slot(self, seq: _Seq, raw_row: np.ndarray,
+                          device_token: int):
+        """Host leg of the logits-processor path: apply the sequence's
+        processors to its raw logits row and re-sample; sequences without
+        processors keep the device-sampled token. Logprob data (when the
+        request asks) is computed from the RAW distribution (OpenAI
+        semantics — logprobs reflect the model, not the processors)."""
+        from ..llm.logits_processing import host_sample
+
+        s = seq.request.sampling
+        token = device_token
+        if seq.processors:
+            row = raw_row.astype(np.float32).copy()
+            input_ids = list(seq.generated)
+            for proc in seq.processors:
+                proc(input_ids, row)
+            token = host_sample(row, s.temperature, s.top_p, s.top_k,
+                                seq.seed, len(seq.generated))
+        info = None
+        if s.logprobs:
+            from .sampler import TOP_LOGPROBS_K
+
+            logp = raw_row.astype(np.float64)
+            logp -= logp.max()
+            logp -= np.log(np.exp(logp).sum())
+            k = min(TOP_LOGPROBS_K, len(logp))
+            top_ids = np.argpartition(logp, -k)[-k:]
+            top_ids = top_ids[np.argsort(logp[top_ids])[::-1]]
+            info = (float(logp[token]), top_ids.astype(np.int32),
+                    logp[top_ids].astype(np.float32))
+        return token, info
 
     def _decode_block_for(self, ready: list,
                           want_logprobs: bool) -> tuple[int, int]:

@@ -380,6 +380,17 @@ class TpuWorker:
             on_removed=self.events.on_removed,
             kvbm=self.kvbm,
         )
+        # Logits-processor factories that declare a `tokenizer` parameter
+        # get this model's tokenizer (ref: logits_processing examples —
+        # HelloWorldLogitsProcessor takes the tokenizer).
+        try:
+            from ..llm.tokenizer import load_tokenizer
+
+            self.scheduler.logits_tokenizer = load_tokenizer(
+                self.card.tokenizer)
+        except Exception:  # noqa: BLE001 — processors are optional;
+            # a tokenizer-less deployment still serves
+            self.scheduler.logits_tokenizer = None
         self.scheduler.start()
 
     async def serve(self) -> None:

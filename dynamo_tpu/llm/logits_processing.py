@@ -1,0 +1,200 @@
+"""Pluggable logits processors (ref: lib/bindings/python/src/dynamo/
+logits_processing/base.py BaseLogitsProcessor + examples/).
+
+The reference protocol is a per-request callable that mutates the
+next-token logits in place given the tokens generated so far. On TPU
+the decode hot path keeps sampling inside the compiled step so only
+token ids cross device->host; requests that attach a processor opt into
+a slower escape hatch: the engine switches those steps to a variant
+that also returns the raw logits rows, applies the processors on host
+(numpy, in place — same contract as the reference), re-samples on host,
+and feeds the chosen token back into the next step. The cost (a [V]
+f32 readback per step) is paid only by requests that ask for it, which
+is the reference's stance too (its processors are Python callbacks on
+the engine step path).
+
+`logit_bias` (OpenAI API field) is implemented as an implicit processor
+on the same path.
+
+Processors are registered per deployment (worker startup) and selected
+per request via `nvext.logits_processors: [{"name": ..., "args": {}}]`.
+Factories receive the tokenizer when they declare it, mirroring the
+reference examples (HelloWorldLogitsProcessor takes the tokenizer).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Optional, Protocol, Sequence,\
+    runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class BaseLogitsProcessor(Protocol):
+    """Per-request processor: mutate `logits` ([V] float32 numpy row for
+    the next token) in place. `input_ids` are the tokens generated so
+    far (ref: logits_processing/base.py — same signature with a torch
+    tensor; numpy here)."""
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None: ...
+
+
+_REGISTRY: dict[str, Callable[..., BaseLogitsProcessor]] = {}
+
+
+def register_processor(name: str,
+                       factory: Callable[..., BaseLogitsProcessor]) -> None:
+    """Register a processor factory under `name`. The factory is called
+    once per request with the request's `args` dict (plus `tokenizer=`
+    when its signature accepts it), so processors can keep per-request
+    state (the reference's HelloWorld example counts steps)."""
+    _REGISTRY[name] = factory
+
+
+def registered_processors() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def resolve_processors(
+    specs: Optional[list],
+    tokenizer: Any = None,
+) -> list[BaseLogitsProcessor]:
+    """Instantiate processors for one request from nvext specs
+    (names or {"name":..., "args": {...}}). Unknown names raise
+    ValueError (surfaced as a 400 by the worker): a silently dropped
+    processor would return unconstrained output the client believes is
+    constrained."""
+    out: list[BaseLogitsProcessor] = []
+    for spec in specs or []:
+        if isinstance(spec, str):
+            name, args = spec, {}
+        else:
+            name, args = spec["name"], dict(spec.get("args") or {})
+        factory = _REGISTRY.get(name)
+        if factory is None:
+            raise ValueError(
+                f"unknown logits processor {name!r}; registered: "
+                f"{registered_processors()}")
+        params = inspect.signature(factory).parameters
+        if "tokenizer" in params and tokenizer is not None:
+            args.setdefault("tokenizer", tokenizer)
+        out.append(factory(**args))
+    return out
+
+
+class LogitBiasProcessor:
+    """OpenAI `logit_bias`: additive bias per token id."""
+
+    def __init__(self, bias: dict[int, float]) -> None:
+        self._ids = np.fromiter(bias.keys(), np.int64, len(bias))
+        self._vals = np.fromiter(bias.values(), np.float32, len(bias))
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        mask = self._ids < logits.shape[-1]
+        np.add.at(logits, self._ids[mask], self._vals[mask])
+
+
+# -- built-in examples (ref: logits_processing/examples/) -------------------
+
+
+class ForcedResponseProcessor:
+    """Force an exact token sequence then EOS (ref: examples/
+    hello_world.py HelloWorldLogitsProcessor — the canonical "did my
+    processor actually run" probe)."""
+
+    def __init__(self, token_ids: list[int], eos_id: int) -> None:
+        self.token_ids = [int(t) for t in token_ids]
+        self.eos_id = int(eos_id)
+        self.state = 0
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        want = (self.token_ids[self.state]
+                if self.state < len(self.token_ids) else self.eos_id)
+        logits[:] = -np.inf
+        logits[want] = 0.0
+        self.state += 1
+
+
+class TemperatureProcessor:
+    """Logit-side temperature scaling (ref: examples/temperature.py)."""
+
+    def __init__(self, temperature: float = 1.0) -> None:
+        if temperature <= 0:
+            raise ValueError("temperature must be positive")
+        self.temperature = float(temperature)
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        logits /= self.temperature
+
+
+class PenaltyProcessor:
+    """OpenAI frequency/presence penalties over the tokens generated so
+    far (the engine routes penalty requests through the host path so the
+    penalties are actually applied — the compiled step samples from the
+    raw distribution)."""
+
+    def __init__(self, frequency_penalty: float = 0.0,
+                 presence_penalty: float = 0.0) -> None:
+        self.frequency_penalty = float(frequency_penalty)
+        self.presence_penalty = float(presence_penalty)
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        if not len(input_ids):
+            return
+        ids, counts = np.unique(np.asarray(input_ids, np.int64),
+                                return_counts=True)
+        keep = ids < logits.shape[-1]
+        ids, counts = ids[keep], counts[keep]
+        logits[ids] -= (self.frequency_penalty * counts
+                        + self.presence_penalty)
+
+
+class BanTokensProcessor:
+    """Never emit the given token ids."""
+
+    def __init__(self, token_ids: list[int]) -> None:
+        self.token_ids = [int(t) for t in token_ids]
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        logits[self.token_ids] = -np.inf
+
+
+register_processor("forced_response", ForcedResponseProcessor)
+register_processor("temperature", TemperatureProcessor)
+register_processor("ban_tokens", BanTokensProcessor)
+
+
+def host_sample(logits: np.ndarray, temperature: float, top_p: float,
+                top_k: int, seed: Optional[int], step: int) -> int:
+    """Sample from a processed logits row on host, mirroring the
+    compiled sampler's semantics (greedy at temperature 0; top-k/top-p
+    truncation; seeded draws keyed by (seed, step) so a fixed request
+    seed reproduces its stream)."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    scaled = logits.astype(np.float64) / temperature
+    top_k = min(int(top_k or 0), len(scaled))  # clamp like the device
+    if top_k > 0:                              # sampler's jnp.clip
+        kth = np.partition(scaled, -top_k)[-top_k]
+        scaled = np.where(scaled >= kth, scaled, -np.inf)
+    if top_p < 1.0:
+        order = np.argsort(scaled)[::-1]
+        probs = np.exp(scaled[order] - np.max(scaled))
+        probs /= probs.sum()
+        keep = np.cumsum(probs) - probs < top_p
+        cut = np.full_like(scaled, -np.inf)
+        cut[order[keep]] = scaled[order[keep]]
+        scaled = cut
+    probs = np.exp(scaled - np.max(scaled))
+    probs /= probs.sum()
+    rng = np.random.default_rng(
+        (0 if seed is None else int(seed)) * 1_000_003 + step)
+    return int(rng.choice(len(probs), p=probs))

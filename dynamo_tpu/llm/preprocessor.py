@@ -66,6 +66,9 @@ class OpenAIPreprocessor:
         return self._template.render(messages=messages, add_generation_prompt=True)
 
     def preprocess_chat(self, request: dict) -> PreprocessedRequest:
+        from .validate import validate_request
+
+        validate_request(request, "chat")
         messages = request.get("messages")
         if not messages:
             raise RequestError("'messages' is required and must be non-empty")
@@ -113,6 +116,9 @@ class OpenAIPreprocessor:
         return pre
 
     def preprocess_completions(self, request: dict) -> PreprocessedRequest:
+        from .validate import validate_request
+
+        validate_request(request, "completions")
         prompt = request.get("prompt")
         if prompt is None:
             raise RequestError("'prompt' is required")
@@ -171,6 +177,8 @@ class OpenAIPreprocessor:
         else:
             stop_strings = [str(s) for s in stop][:8]
 
+        from .validate import validate_logit_bias
+
         sampling = SamplingOptions(
             max_tokens=max_tokens,
             temperature=float(request.get("temperature", 1.0) or 0.0),
@@ -181,6 +189,7 @@ class OpenAIPreprocessor:
             presence_penalty=float(request.get("presence_penalty", 0.0) or 0.0),
             logprobs=bool(request.get("logprobs", False)),
             top_logprobs=int(request.get("top_logprobs", 0) or 0),
+            logit_bias=validate_logit_bias(request.get("logit_bias")),
         )
         # Completions-style `logprobs: N` (an int, not the chat bool) also
         # requests N alternatives per token.
@@ -198,7 +207,7 @@ class OpenAIPreprocessor:
             raise RequestError(
                 f"top_logprobs={sampling.top_logprobs} exceeds the engine "
                 f"maximum of {TOP_LOGPROBS_K}")
-        return PreprocessedRequest(
+        pre = PreprocessedRequest(
             request_id=new_request_id(),
             token_ids=token_ids,
             sampling=sampling,
@@ -210,6 +219,15 @@ class OpenAIPreprocessor:
             eos_token_ids=list(self.tokenizer.eos_token_ids),
             model=request.get("model", self.card.name),
         )
+        nvext = request.get("nvext")
+        if isinstance(nvext, dict):
+            if isinstance(nvext.get("annotations"), dict):
+                pre.annotations.update(nvext["annotations"])
+            if nvext.get("priority") is not None:
+                pre.annotations["priority"] = nvext["priority"]
+            if nvext.get("logits_processors"):
+                pre.logits_processors = list(nvext["logits_processors"])
+        return pre
 
 
 class DeltaGenerator:

@@ -27,6 +27,9 @@ class SamplingOptions:
     presence_penalty: float = 0.0
     logprobs: bool = False
     top_logprobs: int = 0
+    # OpenAI logit_bias: {token_id: additive bias}; applied via the
+    # host logits-processor path (llm/logits_processing.py)
+    logit_bias: Optional[dict] = None
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
@@ -77,6 +80,9 @@ class PreprocessedRequest:
     # (wire: {"shape": [n, H], "data": f32 bytes})
     media_hashes: list[int] = dataclasses.field(default_factory=list)
     media_embeddings: Optional[dict] = None
+    # Logits-processor specs (names or {"name","args"}) resolved against
+    # the worker's registry (llm/logits_processing.py)
+    logits_processors: list = dataclasses.field(default_factory=list)
 
     def kv_salt(self) -> Optional[int]:
         """Perturbs block-hash chaining for anything beyond token ids that
@@ -116,6 +122,8 @@ class PreprocessedRequest:
             out["media_hashes"] = self.media_hashes
         if self.media_embeddings is not None:
             out["media_embeddings"] = self.media_embeddings
+        if self.logits_processors:
+            out["logits_processors"] = self.logits_processors
         return out
 
     @classmethod
@@ -133,6 +141,7 @@ class PreprocessedRequest:
             lora_name=data.get("lora_name"),
             media_hashes=list(data.get("media_hashes") or []),
             media_embeddings=data.get("media_embeddings"),
+            logits_processors=list(data.get("logits_processors") or []),
         )
 
 

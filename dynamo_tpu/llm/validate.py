@@ -1,0 +1,163 @@
+"""OpenAI request validation: unsupported-field tracking + range checks.
+
+The reference captures unknown request fields in a serde catch-all and
+rejects them with 400 "Unsupported parameter" instead of silently
+dropping them (ref: lib/llm/src/protocols/openai/{completions.rs:44,422,
+validate.rs:101}, http/service/openai.rs:2413 tests) — a client sending
+`response_format` for JSON mode must learn it is not honored, not
+receive confidently wrong output. Known fields get the same range
+validation the reference applies (validate.rs temperature/top_p/
+penalties/logit_bias/n).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .preprocessor import RequestError
+
+# Fields consumed by the preprocessor/HTTP layer for each endpoint kind.
+# Anything else in the request body is an unsupported parameter.
+_COMMON_FIELDS = {
+    "model", "stream", "stream_options", "max_tokens",
+    "max_completion_tokens", "temperature", "top_p", "top_k", "seed",
+    "frequency_penalty", "presence_penalty", "logprobs", "top_logprobs",
+    "stop", "ignore_eos", "n", "user", "logit_bias", "metadata", "nvext",
+}
+CHAT_FIELDS = _COMMON_FIELDS | {
+    "messages", "tools", "tool_choice", "response_format",
+    "parallel_tool_calls",
+}
+COMPLETION_FIELDS = _COMMON_FIELDS | {"prompt", "echo", "suffix"}
+
+# nvext is our extension namespace (the reference's NvExt analog).
+NVEXT_FIELDS = {"annotations", "priority", "logits_processors"}
+
+
+def _reject_unknown(body: dict, allowed: set) -> None:
+    unknown = sorted(k for k in body if k not in allowed)
+    if unknown:
+        raise RequestError(
+            "Unsupported parameter: "
+            + ", ".join(f"'{k}'" for k in unknown))
+
+
+def _check_range(body: dict, field: str, lo: float, hi: float) -> None:
+    val = body.get(field)
+    if val is None:
+        return
+    try:
+        f = float(val)
+    except (TypeError, ValueError):
+        raise RequestError(f"'{field}' must be a number") from None
+    if not (lo <= f <= hi):
+        raise RequestError(
+            f"'{field}' must be between {lo} and {hi}, got {f}")
+
+
+def validate_logit_bias(raw: Any) -> Optional[dict[int, float]]:
+    """OpenAI logit_bias: {token_id: bias in [-100, 100]}. Returns the
+    parsed map (int keys) or None."""
+    if raw is None:
+        return None
+    if not isinstance(raw, dict):
+        raise RequestError("'logit_bias' must be an object")
+    parsed: dict[int, float] = {}
+    for key, val in raw.items():
+        try:
+            token_id = int(key)
+        except (TypeError, ValueError):
+            raise RequestError(
+                f"'logit_bias' key {key!r} is not a token id") from None
+        if token_id < 0:
+            # Negative ids would wrap via numpy indexing and bias the
+            # WRONG token — the silent-wrong-output class this module
+            # exists to prevent.
+            raise RequestError(
+                f"'logit_bias' key {token_id} is not a valid token id")
+        try:
+            bias = float(val)
+        except (TypeError, ValueError):
+            raise RequestError(
+                f"'logit_bias' value for {key!r} is not a number") from None
+        if not (-100.0 <= bias <= 100.0):
+            raise RequestError(
+                f"'logit_bias' value for token {token_id} must be in "
+                f"[-100, 100], got {bias}")
+        parsed[token_id] = bias
+    return parsed or None
+
+
+def validate_request(body: dict, kind: str) -> None:
+    """Raise RequestError (-> HTTP 400) for unsupported or out-of-range
+    fields. kind: "chat" | "completions"."""
+    if not isinstance(body, dict):
+        raise RequestError("request body must be a JSON object")
+    allowed = CHAT_FIELDS if kind == "chat" else COMPLETION_FIELDS
+    _reject_unknown(body, allowed)
+
+    _check_range(body, "temperature", 0.0, 2.0)
+    _check_range(body, "top_p", 0.0, 1.0)
+    _check_range(body, "frequency_penalty", -2.0, 2.0)
+    _check_range(body, "presence_penalty", -2.0, 2.0)
+
+    n = body.get("n")
+    if n is not None and n != 1:
+        raise RequestError("only n=1 is supported")
+
+    top_k = body.get("top_k")
+    if top_k is not None:
+        try:
+            top_k_int = int(top_k)
+        except (TypeError, ValueError):
+            raise RequestError("'top_k' must be an integer") from None
+        if top_k_int < 0:
+            raise RequestError("'top_k' must be >= 0")
+
+    stop = body.get("stop")
+    if stop is not None and not isinstance(stop, str):
+        if not (isinstance(stop, list)
+                and all(isinstance(s, str) for s in stop)):
+            raise RequestError(
+                "'stop' must be a string or an array of strings")
+
+    validate_logit_bias(body.get("logit_bias"))
+
+    rf = body.get("response_format")
+    if rf is not None:
+        # No guided decoding in the engine yet: accepting json_object /
+        # json_schema and returning free text would be silent wrong
+        # behavior (the failure mode this module exists to prevent).
+        if not (isinstance(rf, dict) and rf.get("type") == "text"):
+            got = rf.get("type") if isinstance(rf, dict) else rf
+            raise RequestError(
+                f"response_format type {got!r} is not supported "
+                "(only 'text'); structured output is not available on "
+                "this deployment")
+
+    suffix = body.get("suffix")
+    if suffix is not None and suffix != "":
+        raise RequestError("'suffix' is not supported")
+
+    if body.get("echo"):
+        raise RequestError("'echo' is not supported")
+
+    nvext = body.get("nvext")
+    if nvext is not None:
+        if not isinstance(nvext, dict):
+            raise RequestError("'nvext' must be an object")
+        unknown = sorted(k for k in nvext if k not in NVEXT_FIELDS)
+        if unknown:
+            raise RequestError(
+                "Unsupported nvext parameter: "
+                + ", ".join(f"'{k}'" for k in unknown))
+        procs = nvext.get("logits_processors")
+        if procs is not None:
+            if not isinstance(procs, list):
+                raise RequestError("'nvext.logits_processors' must be a list")
+            for spec in procs:
+                if not (isinstance(spec, str)
+                        or (isinstance(spec, dict) and "name" in spec)):
+                    raise RequestError(
+                        "each logits processor must be a name or an "
+                        "object with a 'name'")

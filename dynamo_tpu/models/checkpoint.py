@@ -874,7 +874,10 @@ def checkpoint_digest(path: str) -> str:
         # would leave the stat signature unchanged. Only trust the cache
         # for files that have been quiet for a couple of seconds.
         newest = max((st.st_mtime for _n, st in stats), default=0.0)
-        if _time.time() - newest < 2.0:
+        # abs(): a FUTURE mtime (clock skew, archive extraction) must not
+        # permanently disable the cache — it is just as "quiet" once the
+        # wall clock passes it.
+        if abs(_time.time() - newest) < 2.0:
             sig = None
     except OSError:
         sig = None

@@ -221,3 +221,96 @@ class TestResponsesApi:
             await _teardown(frontend, frt, workers)
 
         run(body(), timeout=90)
+
+
+class TestRequestStrictness:
+    """Unsupported-field tracking + range validation (ref:
+    lib/llm/src/http/service/openai.rs:2413,2820-2830 — unknown fields
+    and unhonorable response_format are 400s, never silently dropped)."""
+
+    def test_unit_validation(self):
+        from dynamo_tpu.llm.preprocessor import RequestError
+        from dynamo_tpu.llm.validate import validate_request
+
+        ok = {"model": "m", "messages": [{"role": "user", "content": "x"}],
+              "temperature": 0.5, "logit_bias": {"5": 10},
+              "response_format": {"type": "text"},
+              "nvext": {"priority": 1.0}}
+        validate_request(ok, "chat")  # no raise
+        cases = [
+            ({"model": "m", "messages": [], "add_special_tokens": False},
+             "Unsupported parameter: 'add_special_tokens'"),
+            ({"model": "m", "messages": [],
+              "response_format": {"type": "json_object"}},
+             "response_format type 'json_object'"),
+            ({"model": "m", "messages": [], "temperature": 3.0},
+             "'temperature' must be between"),
+            ({"model": "m", "messages": [], "top_p": 1.5},
+             "'top_p' must be between"),
+            ({"model": "m", "messages": [], "n": 2}, "only n=1"),
+            ({"model": "m", "messages": [],
+              "logit_bias": {"7": 500}}, "must be in [-100, 100]"),
+            ({"model": "m", "messages": [],
+              "logit_bias": {"abc": 1}}, "not a token id"),
+            ({"model": "m", "messages": [],
+              "logit_bias": {"-1": 5}}, "not a valid token id"),
+            ({"model": "m", "messages": [], "top_k": -1},
+             "'top_k' must be >= 0"),
+            ({"model": "m", "messages": [], "stop": [1, 2]},
+             "'stop' must be a string"),
+            ({"model": "m", "messages": [],
+              "nvext": {"bogus": 1}}, "Unsupported nvext parameter"),
+        ]
+        for body, fragment in cases:
+            try:
+                validate_request(body, "chat")
+            except RequestError as exc:
+                assert fragment in str(exc), (body, str(exc))
+            else:
+                raise AssertionError(f"accepted: {body}")
+        # completions-kind: chat-only fields are unsupported there
+        try:
+            validate_request({"model": "m", "prompt": "x",
+                              "messages": []}, "completions")
+        except RequestError as exc:
+            assert "'messages'" in str(exc)
+        else:
+            raise AssertionError("completions accepted 'messages'")
+
+    def test_e2e_unknown_field_rejected(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"{base}/v1/chat/completions", json={
+                            "model": "mock-model",
+                            "messages": [
+                                {"role": "user", "content": "hi"}],
+                            "response_format": {"type": "json_object"},
+                        }) as resp:
+                    assert resp.status == 400
+                    data = await resp.json()
+                    assert "response_format" in data["error"]["message"]
+                async with session.post(
+                        f"{base}/v1/chat/completions", json={
+                            "model": "mock-model",
+                            "messages": [
+                                {"role": "user", "content": "hi"}],
+                            "guided_json": {"type": "object"},
+                        }) as resp:
+                    assert resp.status == 400
+                    data = await resp.json()
+                    assert "guided_json" in data["error"]["message"]
+                # a valid request still flows after rejections
+                async with session.post(
+                        f"{base}/v1/chat/completions", json={
+                            "model": "mock-model",
+                            "messages": [
+                                {"role": "user", "content": "hi"}],
+                            "max_tokens": 3,
+                        }) as resp:
+                    assert resp.status == 200
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)

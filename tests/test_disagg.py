@@ -176,6 +176,58 @@ class TestRealEngineDisagg:
 
         run(body(), timeout=300)
 
+    def test_disagg_first_token_honors_logits_processors(
+            self, run, mem_runtime_config):
+        """The prefill worker samples the first token with no processors
+        applied; the decode side must discard it and regenerate through
+        the host path so a forced-response processor controls the WHOLE
+        stream (the onboard path's _defer_first_token branch)."""
+
+        async def body():
+            cfg = mem_runtime_config()
+            rt = await DistributedRuntime(cfg).start()
+            rcfg = RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                                max_pages_per_seq=16,
+                                prefill_buckets=(8, 16, 32))
+            prefill_w = TpuWorker(rt, model_name="tiny-test",
+                                  component="prefill", mode="prefill",
+                                  runner_config=rcfg, warmup=False)
+            decode_w = TpuWorker(rt, model_name="tiny-test",
+                                 component="backend", mode="decode",
+                                 runner_config=rcfg, warmup=False)
+            await prefill_w.start()
+            await decode_w.start()
+            decode_ep = rt.namespace("dynamo").component("backend") \
+                          .endpoint("generate")
+            decode_router = PushRouter(decode_ep.client(),
+                                       mode="round_robin")
+            await decode_router.client.start()
+            prefill_ep = rt.namespace("dynamo").component("prefill") \
+                           .endpoint("generate")
+            prefill_router = PushRouter(prefill_ep.client(),
+                                        mode="round_robin")
+            await prefill_router.client.start()
+            pool = PrefillPool(router=prefill_router,
+                               instances={prefill_w.instance_id})
+            engine = PrefillRouterEngine(
+                RouterEngine(decode_router), lambda: pool)
+
+            forced = [21, 22, 23]
+            req = _request(list(range(30, 47)), max_tokens=3)
+            req.logits_processors = [
+                {"name": "forced_response",
+                 "args": {"token_ids": forced, "eos_id": 1}}]
+            toks = await _collect(engine, req)
+            assert toks == forced
+
+            await decode_router.client.close()
+            await prefill_router.client.close()
+            await prefill_w.close()
+            await decode_w.close()
+            await rt.shutdown()
+
+        run(body(), timeout=300)
+
     def test_disagg_falls_back_when_prefill_pool_empty(self, run,
                                                        mem_runtime_config):
         async def body():
