@@ -50,7 +50,8 @@ class LocalDeploymentController:
         self.log_dir = log_dir
         self.interval = reconcile_interval
         self.desired: dict[str, int] = {
-            name: svc.replicas for name, svc in spec.services.items()
+            name: svc.clamp_replicas(svc.replicas)
+            for name, svc in spec.services.items()
         }
         self._replicas: dict[str, list[_Replica]] = {
             name: [] for name in spec.services
@@ -72,8 +73,12 @@ class LocalDeploymentController:
             raise KeyError(f"unknown service {service!r}")
         if n < 0:
             raise ValueError("negative replicas")
-        self.desired[service] = n
-        log.info("desired replicas: %s -> %d", service, n)
+        clamped = self.spec.services[service].clamp_replicas(int(n))
+        if clamped != n:
+            log.info("scaling adapter clamped %s: %d -> %d", service, n,
+                     clamped)
+        self.desired[service] = clamped
+        log.info("desired replicas: %s -> %d", service, clamped)
 
     def observed(self, service: str) -> int:
         return len([r for r in self._replicas.get(service, [])
@@ -254,7 +259,34 @@ async def main(argv: Optional[list[str]] = None) -> None:
                              '"model":"qwen3-0.6b","itl_ms":20}\'')
     parser.add_argument("--dgdr-status", default=None, metavar="NAME",
                         help="print a request's phase/status and exit")
+    # Model/checkpoint registry (DynamoModel / DynamoCheckpoint CRD
+    # analogs — deploy/registry.py records in discovery)
+    parser.add_argument("--register-model", default=None, metavar="JSON",
+                        help='register a ModelRecord, e.g. \'{"name":"q06",'
+                             '"source":"qwen3-0.6b"}\'')
+    parser.add_argument("--list-models", action="store_true")
+    parser.add_argument("--list-checkpoints", action="store_true")
     args = parser.parse_args(argv)
+
+    if args.register_model or args.list_models or args.list_checkpoints:
+        from . import registry as reg
+
+        runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
+        try:
+            if args.register_model:
+                record = reg.ModelRecord.from_wire(
+                    json.loads(args.register_model))
+                await reg.register_model(runtime, record)
+                print(json.dumps({"registered": record.name}))
+            if args.list_models:
+                models = await reg.list_models(runtime)
+                print(json.dumps([m.to_wire() for m in models]))
+            if args.list_checkpoints:
+                ckpts = await reg.list_checkpoints(runtime)
+                print(json.dumps([c.to_wire() for c in ckpts]))
+        finally:
+            await runtime.shutdown()
+        return
 
     if args.dgdr_controller or args.dgdr_submit or args.dgdr_status:
         from .dgdr import (

@@ -12,6 +12,15 @@ zero-config DGDR flow (submit → profile → Deployed) against a real
 apiserver — or the faithful stub in tests/test_kube_controller.py, the
 same technique the discovery backend uses (runtime/kube.py).
 
+Rolling updates (ref: the operator's readiness-gated rollout in
+dynamographdeployment_controller.go): Deployment names carry a revision
+hash of their pod template. A spec change (apply_spec) surges a NEW
+revision Deployment while the old one keeps serving; once the new
+revision reports ready it wins and old revisions are deleted. A new
+revision that fails to become ready within `rollout_timeout` is rolled
+back automatically — its Deployment is deleted and the service spec
+reverts to the revision that was serving.
+
 Auth mirrors runtime/kube.py: in-cluster service-account config or
 explicit base_url/token/namespace.
 """
@@ -19,18 +28,31 @@ explicit base_url/token/namespace.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
+import hashlib
 import json
 import os
+import time
 from typing import Optional
 
 from ..runtime.logging import get_logger
 from .manifests import _deployment
-from .spec import GraphDeploymentSpec
+from .spec import GraphDeploymentSpec, ServiceSpec
 
 log = get_logger("deploy.kube")
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 UNARY_TIMEOUT_SECS = 10.0
+
+
+@dataclasses.dataclass
+class _Rollout:
+    """An in-flight readiness-gated revision change for one service."""
+
+    new_rev: str
+    previous: ServiceSpec  # spec to restore on rollback
+    started_at: float
+    state: str = "progressing"  # progressing | complete | rolled_back
 
 
 class KubeDeploymentController:
@@ -44,6 +66,7 @@ class KubeDeploymentController:
         namespace: Optional[str] = None,
         token: Optional[str] = None,
         reconcile_interval: float = 2.0,
+        rollout_timeout: float = 300.0,
     ) -> None:
         self.spec = spec
         if base_url is None:
@@ -70,9 +93,13 @@ class KubeDeploymentController:
                 token = ""
         self._token = token
         self._interval = reconcile_interval
+        self._rollout_timeout = rollout_timeout
         self.desired: dict[str, int] = {
-            name: svc.replicas for name, svc in spec.services.items()}
+            name: svc.clamp_replicas(svc.replicas)
+            for name, svc in spec.services.items()}
         self._observed: dict[str, int] = {name: 0 for name in spec.services}
+        self._rollouts: dict[str, _Rollout] = {}
+        self._removed: set[str] = set()  # services dropped by apply_spec
         self._session = None
         self._task: Optional[asyncio.Task] = None
         self._dirty = asyncio.Event()
@@ -111,10 +138,85 @@ class KubeDeploymentController:
             except ValueError:  # plain-text error body
                 return resp.status, {"message": text}
 
-    def _dep_name(self, service: str) -> str:
-        return f"{self.spec.name}-{service}"
+    def _render(self, svc: ServiceSpec) -> dict:
+        obj = _deployment(self.spec, svc)
+        obj["metadata"]["namespace"] = self._ns
+        return obj
+
+    def _revision_of(self, svc: ServiceSpec) -> str:
+        """Content hash of the pod template — the rollout identity. Two
+        specs with the same command/env/image are the same revision
+        (replica count is NOT part of it; scaling is not a rollout)."""
+        template = self._render(svc)["spec"]["template"]
+        return hashlib.sha256(
+            json.dumps(template, sort_keys=True).encode()).hexdigest()[:8]
+
+    def _dep_name(self, service: str, rev: Optional[str] = None) -> str:
+        if rev is None:
+            rev = self._revision_of(self.spec.services[service])
+        return f"{self.spec.name}-{service}-{rev}"
+
+    async def _list_service_deployments(self, service: str) -> list[dict]:
+        """All revisions of one service, via the part-of/component labels
+        the manifests stamp."""
+        selector = (f"app.kubernetes.io/part-of={self.spec.name},"
+                    f"app.kubernetes.io/component={service}")
+        status, body = await self._req(
+            "GET", f"{self._url()}?labelSelector={selector}")
+        if status != 200:
+            log.warning("list %s -> HTTP %d", service, status)
+            return []
+        return list(body.get("items") or [])
 
     # -- controller interface ----------------------------------------------
+
+    def apply_spec(self, new_spec: GraphDeploymentSpec) -> None:
+        """Adopt a changed DGD spec. Services whose pod template changed
+        (including via graph-level env) start a readiness-gated rolling
+        update (surge the new revision, keep the old serving, delete old
+        on ready, roll back on timeout). Replica-count-only changes are
+        plain scaling."""
+        if new_spec.name != self.spec.name:
+            raise ValueError(
+                "apply_spec cannot rename a deployment "
+                f"({self.spec.name!r} -> {new_spec.name!r}); create a new "
+                "controller instead")
+        # Revisions of the CURRENTLY-SERVING spec, rendered before any
+        # graph-level field (env) is swapped — graph env is part of every
+        # pod template, so changing it must read as a revision change.
+        old_revs = {name: self._revision_of(svc)
+                    for name, svc in self.spec.services.items()}
+        old_specs = dict(self.spec.services)
+        self.spec.env = dict(new_spec.env)
+        for name, svc in new_spec.services.items():
+            old = old_specs.get(name)
+            self.spec.services[name] = svc
+            self.desired[name] = svc.clamp_replicas(svc.replicas)
+            if old is None:
+                self._observed.setdefault(name, 0)
+                continue
+            new_rev = self._revision_of(svc)
+            if new_rev != old_revs[name]:
+                roll = self._rollouts.get(name)
+                if roll is not None and roll.state == "progressing":
+                    # Re-rolled mid-rollout: keep the ORIGINAL serving
+                    # revision as the rollback target.
+                    previous = roll.previous
+                else:
+                    previous = old
+                self._rollouts[name] = _Rollout(
+                    new_rev=new_rev, previous=previous,
+                    started_at=time.monotonic())
+                log.info("rollout %s: %s -> %s", name, old_revs[name],
+                         new_rev)
+        for name in list(self.spec.services):
+            if name not in new_spec.services:
+                self._removed.add(name)
+                del self.spec.services[name]
+                self.desired.pop(name, None)
+                self._observed.pop(name, None)
+                self._rollouts.pop(name, None)
+        self._dirty.set()
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -126,12 +228,20 @@ class KubeDeploymentController:
                 await self._task
             except asyncio.CancelledError:
                 pass
-        for name in self.spec.services:
+        # Include services removed by apply_spec whose deletion the
+        # reconcile loop has not drained yet.
+        for name in set(self.spec.services) | self._removed:
             try:
-                status, _ = await self._req("DELETE",
-                                            self._url(self._dep_name(name)))
-                if status not in (200, 202, 404):
-                    log.warning("delete %s -> HTTP %d", name, status)
+                deps = await self._list_service_deployments(name)
+                targets = [d["metadata"]["name"] for d in deps]
+                if not targets and name in self.spec.services:
+                    targets = [self._dep_name(name)]
+                for dep_name in targets:
+                    status, _ = await self._req("DELETE",
+                                                self._url(dep_name))
+                    if status not in (200, 202, 404):
+                        log.warning("delete %s -> HTTP %d", dep_name,
+                                    status)
             except Exception as exc:  # noqa: BLE001 — best-effort teardown
                 log.warning("delete %s failed: %r", name, exc)
         if self._session is not None and not self._session.closed:
@@ -140,7 +250,11 @@ class KubeDeploymentController:
     def set_replicas(self, service: str, n: int) -> None:
         if service not in self.desired:
             raise KeyError(service)
-        self.desired[service] = n
+        clamped = self.spec.services[service].clamp_replicas(int(n))
+        if clamped != n:
+            log.info("scaling adapter clamped %s: %d -> %d", service, n,
+                     clamped)
+        self.desired[service] = clamped
         self._dirty.set()
 
     def observed(self, service: str) -> int:
@@ -154,6 +268,10 @@ class KubeDeploymentController:
                        "running": self._observed.get(name, 0),
                        "crash_streak": 0}
                 for name in self.spec.services
+            },
+            "rollouts": {
+                name: {"revision": roll.new_rev, "state": roll.state}
+                for name, roll in self._rollouts.items()
             },
             "restarts": 0,
         }
@@ -175,32 +293,105 @@ class KubeDeploymentController:
                 pass
 
     async def _reconcile_once(self) -> None:
-        for name, svc in self.spec.services.items():
-            dep_name = self._dep_name(name)
-            obj = _deployment(self.spec, svc)
-            obj["metadata"]["namespace"] = self._ns
-            obj["spec"]["replicas"] = self.desired[name]
-            status, current = await self._req("GET", self._url(dep_name))
-            if status == 404:
-                status, created = await self._req("POST", self._url(), obj)
-                if status not in (200, 201):
-                    log.warning("create %s -> HTTP %d: %s", dep_name,
-                                status, created)
-                continue
+        # Removed services: delete every revision, then forget them.
+        for name in list(self._removed):
+            for dep in await self._list_service_deployments(name):
+                await self._req("DELETE",
+                                self._url(dep["metadata"]["name"]))
+            self._removed.discard(name)
+        # list(): the synchronous apply_spec may add/remove services
+        # while this loop awaits inside _reconcile_service.
+        for name, svc in list(self.spec.services.items()):
+            await self._reconcile_service(name, svc)
+
+    async def _roll_back(self, name: str, rev: str, dep_name: str,
+                         roll: _Rollout, reason: str) -> None:
+        log.warning("rollout %s: revision %s %s — rolling back", name, rev,
+                    reason)
+        await self._req("DELETE", self._url(dep_name))
+        self.spec.services[name] = roll.previous
+        self.desired[name] = max(
+            self.desired.get(name, 0),
+            roll.previous.clamp_replicas(roll.previous.replicas))
+        roll.state = "rolled_back"
+        self._dirty.set()
+
+    async def _reconcile_service(self, name: str, svc: ServiceSpec) -> None:
+        rev = self._revision_of(svc)
+        dep_name = self._dep_name(name, rev)
+        want = self.desired[name]
+        roll = self._rollouts.get(name)
+
+        def _roll_expired() -> bool:
+            return (roll is not None and roll.state == "progressing"
+                    and time.monotonic() - roll.started_at
+                    > self._rollout_timeout)
+
+        status, current = await self._req("GET", self._url(dep_name))
+        if status == 404:
+            obj = self._render(svc)
+            obj["metadata"]["name"] = dep_name
+            obj["metadata"]["labels"]["dynamo.revision"] = rev
+            obj["spec"]["replicas"] = want
+            status, created = await self._req("POST", self._url(), obj)
+            if status not in (200, 201):
+                log.warning("create %s -> HTTP %d: %s", dep_name,
+                            status, created)
+                # A revision the apiserver refuses to create (admission
+                # webhook, invalid field) must still hit the rollback
+                # deadline, or the rollout hangs "progressing" forever.
+                if _roll_expired():
+                    await self._roll_back(name, rev, dep_name, roll,
+                                          "rejected by the apiserver")
+                return
+            current = created
+        elif status != 200:
+            log.warning("get %s -> HTTP %d", dep_name, status)
+            if _roll_expired():
+                await self._roll_back(name, rev, dep_name, roll,
+                                      "unreadable from the apiserver")
+            return
+        have = current.get("spec", {}).get("replicas")
+        if have != want:
+            status, _ = await self._req(
+                "PATCH", self._url(dep_name),
+                {"spec": {"replicas": want}},
+                content_type="application/merge-patch+json")
             if status != 200:
-                log.warning("get %s -> HTTP %d", dep_name, status)
-                continue
-            want = self.desired[name]
-            have = current.get("spec", {}).get("replicas")
-            if have != want:
-                status, _ = await self._req(
-                    "PATCH", self._url(dep_name),
-                    {"spec": {"replicas": want}},
-                    content_type="application/merge-patch+json")
-                if status != 200:
-                    log.warning("scale %s -> HTTP %d", dep_name, status)
-                else:
-                    log.info("scaled %s: %s -> %d replicas", dep_name,
-                             have, want)
-            ready = current.get("status", {}).get("readyReplicas", 0)
-            self._observed[name] = int(ready or 0)
+                log.warning("scale %s -> HTTP %d", dep_name, status)
+            else:
+                log.info("scaled %s: %s -> %d replicas", dep_name,
+                         have, want)
+        ready = int(current.get("status", {}).get("readyReplicas", 0) or 0)
+
+        # Rollout bookkeeping: old revisions keep serving until the new
+        # one is ready (surge); a timed-out rollout is rolled back.
+        old_revs = [d for d in await self._list_service_deployments(name)
+                    if d["metadata"]["name"] != dep_name]
+        old_ready = sum(
+            int(d.get("status", {}).get("readyReplicas", 0) or 0)
+            for d in old_revs)
+        if old_revs:
+            if ready >= want:
+                for dep in old_revs:
+                    await self._req("DELETE",
+                                    self._url(dep["metadata"]["name"]))
+                    log.info("rollout %s: old revision %s retired", name,
+                             dep["metadata"]["name"])
+                if roll is not None and roll.state == "progressing":
+                    roll.state = "complete"
+            elif _roll_expired():
+                # New revision never became ready: delete it and revert
+                # the service spec to the revision still serving.
+                await self._roll_back(
+                    name, rev, dep_name, roll,
+                    f"not ready after {self._rollout_timeout:.0f}s")
+                self._observed[name] = old_ready
+                return
+        elif roll is not None and roll.state == "progressing" \
+                and ready >= want:
+            roll.state = "complete"
+        # During a surge the OLD revision's ready replicas are still
+        # serving traffic; report whichever revision set is actually
+        # backing the service.
+        self._observed[name] = max(ready, old_ready)

@@ -1,0 +1,155 @@
+"""Model + checkpoint registry records in the discovery plane.
+
+The reference's operator declares models and engine checkpoints as CRDs
+(`DynamoModel` / `DynamoCheckpoint`, ref: deploy/operator/api/v1alpha1/
+dynamomodel_types.go, dynamocheckpoint_types.go): a model names WHAT to
+serve (source, served name) independent of any deployment; a checkpoint
+records a ready-to-restore engine image for fast cold starts. The TPU
+analogs are plain discovery records — same plane the worker model cards
+and DGDR requests already live in, so every component (and kubectl-less
+operator tooling) reads them the same way:
+
+    v1/model_registry/{namespace}/{name}      ModelRecord
+    v1/checkpoint_registry/{namespace}/{name} CheckpointRecord
+
+Workers resolve `--model-ref NAME` against the model registry; the
+snapshot path (runtime/snapshot.py) registers a CheckpointRecord after
+a successful save, so a planner/controller can prefer snapshot-restore
+workers (the CRIU-flow analog, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from ..runtime.logging import get_logger
+
+log = get_logger("deploy.registry")
+
+MODEL_PREFIX = "v1/model_registry"
+CHECKPOINT_PREFIX = "v1/checkpoint_registry"
+
+
+@dataclasses.dataclass
+class ModelRecord:
+    """DynamoModel analog: a served model's identity + source."""
+
+    name: str
+    source: str  # checkpoint dir / preset name the worker loads
+    served_model_name: str = ""  # name clients use; defaults to `name`
+    namespace: str = "dynamo"
+    revision: str = ""  # optional content pin (checkpoint_digest)
+    created_ts: float = 0.0
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "ModelRecord":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in fields})
+
+
+@dataclasses.dataclass
+class CheckpointRecord:
+    """DynamoCheckpoint analog: a restorable engine snapshot."""
+
+    name: str
+    model: str  # ModelRecord.name or raw model source
+    snapshot_dir: str
+    namespace: str = "dynamo"
+    weights_digest: str = ""
+    created_ts: float = 0.0
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "CheckpointRecord":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in fields})
+
+
+def _model_key(namespace: str, name: str) -> str:
+    return f"{MODEL_PREFIX}/{namespace}/{name}"
+
+
+def _ckpt_key(namespace: str, name: str) -> str:
+    return f"{CHECKPOINT_PREFIX}/{namespace}/{name}"
+
+
+async def register_model(runtime, record: ModelRecord) -> None:
+    if not record.served_model_name:
+        record.served_model_name = record.name
+    if not record.created_ts:
+        record.created_ts = time.time()
+    await runtime.discovery.put(
+        _model_key(record.namespace, record.name), record.to_wire())
+    log.info("registered model %s (source=%s)", record.name, record.source)
+
+
+async def get_model(runtime, name: str,
+                    namespace: str = "dynamo") -> Optional[ModelRecord]:
+    found = await runtime.discovery.get_prefix(_model_key(namespace, name))
+    data = found.get(_model_key(namespace, name))
+    return ModelRecord.from_wire(data) if data else None
+
+
+async def list_models(runtime,
+                      namespace: str = "dynamo") -> list[ModelRecord]:
+    found = await runtime.discovery.get_prefix(
+        f"{MODEL_PREFIX}/{namespace}/")
+    return sorted((ModelRecord.from_wire(v) for v in found.values()),
+                  key=lambda r: r.name)
+
+
+async def delete_model(runtime, name: str,
+                       namespace: str = "dynamo") -> None:
+    await runtime.discovery.delete(_model_key(namespace, name))
+
+
+async def register_checkpoint(runtime, record: CheckpointRecord) -> None:
+    if not record.created_ts:
+        record.created_ts = time.time()
+    await runtime.discovery.put(
+        _ckpt_key(record.namespace, record.name), record.to_wire())
+    log.info("registered checkpoint %s (model=%s dir=%s)", record.name,
+             record.model, record.snapshot_dir)
+
+
+async def get_checkpoint(runtime, name: str, namespace: str = "dynamo"
+                         ) -> Optional[CheckpointRecord]:
+    found = await runtime.discovery.get_prefix(_ckpt_key(namespace, name))
+    data = found.get(_ckpt_key(namespace, name))
+    return CheckpointRecord.from_wire(data) if data else None
+
+
+async def list_checkpoints(runtime, namespace: str = "dynamo",
+                           model: Optional[str] = None
+                           ) -> list[CheckpointRecord]:
+    found = await runtime.discovery.get_prefix(
+        f"{CHECKPOINT_PREFIX}/{namespace}/")
+    records = [CheckpointRecord.from_wire(v) for v in found.values()]
+    if model is not None:
+        records = [r for r in records if r.model == model]
+    return sorted(records, key=lambda r: r.created_ts)
+
+
+async def delete_checkpoint(runtime, name: str,
+                            namespace: str = "dynamo") -> None:
+    await runtime.discovery.delete(_ckpt_key(namespace, name))
+
+
+async def resolve_model_ref(runtime, ref: str,
+                            namespace: str = "dynamo") -> ModelRecord:
+    """Resolve a `--model-ref` name to its registered record; unknown
+    refs are an explicit error (serving an unintended default would be
+    silent wrong behavior)."""
+    record = await get_model(runtime, ref, namespace)
+    if record is None:
+        known = [r.name for r in await list_models(runtime, namespace)]
+        raise KeyError(
+            f"model ref {ref!r} not in the registry (known: {known})")
+    return record

@@ -55,6 +55,12 @@ class ServiceSpec:
     args: list[str] = dataclasses.field(default_factory=list)
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     command: Optional[list[str]] = None  # overrides kind's module CLI
+    # Scaling-adapter bounds (ref: DynamoGraphDeploymentScalingAdapter
+    # CRD — the HPA-drivable scale surface with per-service limits):
+    # every scale request (planner, manual, DGDR correction) is clamped
+    # to [min_replicas, max_replicas]. max 0 = unbounded.
+    min_replicas: int = 0
+    max_replicas: int = 0
 
     def __post_init__(self) -> None:
         if self.command is None and self.kind not in KIND_MODULES:
@@ -63,6 +69,18 @@ class ServiceSpec:
                 f"(known: {sorted(KIND_MODULES)}) and no explicit command")
         if self.replicas < 0:
             raise ValueError(f"service {self.name!r}: negative replicas")
+        if self.min_replicas < 0 or self.max_replicas < 0:
+            raise ValueError(f"service {self.name!r}: negative scale bound")
+        if self.max_replicas and self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"service {self.name!r}: min_replicas > max_replicas")
+
+    def clamp_replicas(self, n: int) -> int:
+        """Apply the scaling-adapter bounds to a requested replica count."""
+        n = max(n, self.min_replicas)
+        if self.max_replicas:
+            n = min(n, self.max_replicas)
+        return n
 
     def argv(self) -> list[str]:
         if self.command is not None:
@@ -96,6 +114,8 @@ class GraphDeploymentSpec:
                 args=[str(a) for a in raw.get("args", [])],
                 env={k: str(v) for k, v in (raw.get("env") or {}).items()},
                 command=command,
+                min_replicas=int(raw.get("min_replicas", 0)),
+                max_replicas=int(raw.get("max_replicas", 0)),
             )
         if not services:
             raise ValueError("deployment spec has no services")

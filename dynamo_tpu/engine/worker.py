@@ -984,6 +984,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
                              "*.safetensors [+ tokenizer.json]); overrides "
                              "--model — the architecture comes from the "
                              "checkpoint's config.json")
+    parser.add_argument("--model-ref", default=None,
+                        help="resolve the model from a registered "
+                             "ModelRecord (deploy/registry.py, the "
+                             "DynamoModel CRD analog) instead of "
+                             "--model/--model-path; the record's source + "
+                             "served name win")
     parser.add_argument("--served-model-name", default=None)
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="backend")
@@ -1124,6 +1130,32 @@ async def main(argv: Optional[list[str]] = None) -> None:
     if not snapshot.enabled:
         runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
 
+    if args.model_ref:
+        # DynamoModel-analog resolution: the registry record decides the
+        # source and served name (ref: dynamomodel_types.go).
+        if runtime is None:
+            raise SystemExit("--model-ref needs the discovery plane; it "
+                             "does not combine with snapshot-gated "
+                             "startup (resolve before dumping instead)")
+        import os
+
+        from ..deploy.registry import resolve_model_ref
+
+        record = await resolve_model_ref(runtime, args.model_ref,
+                                         args.namespace)
+        if os.path.isdir(record.source):
+            args.model_path = record.source
+        else:
+            # The record's source WINS over any --model-path on the
+            # command line (model_path would otherwise override --model
+            # downstream and silently serve the wrong checkpoint).
+            args.model = record.source
+            args.model_path = None
+        if args.served_model_name is None:
+            args.served_model_name = record.served_model_name
+        log.info("model ref %r -> source=%s served=%s", args.model_ref,
+                 record.source, record.served_model_name)
+
     if args.mode == "comesh":
         # Co-meshed disagg: one process, prefill + decode pools on disjoint
         # sub-meshes, KV handoff over ICI (engine/ici_transfer.py). The
@@ -1220,6 +1252,42 @@ async def main(argv: Optional[list[str]] = None) -> None:
         runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
         worker.runtime = runtime
         await worker.serve()
+        # A restore proves the snapshot is viable: record it as a
+        # DynamoCheckpoint analog so deploy tooling can prefer
+        # snapshot-restore cold starts (ref: dynamocheckpoint_types.go).
+        try:
+            from ..deploy.registry import (
+                CheckpointRecord,
+                register_checkpoint,
+            )
+
+            digest = ""
+            if args.model_path:
+                from ..models.checkpoint import checkpoint_digest
+
+                # Strided reads over every shard: off the event loop —
+                # the worker is already serving at this point.
+                digest = await asyncio.to_thread(checkpoint_digest,
+                                                 args.model_path)
+            # Identity: prefer the explicit ref, else the checkpoint
+            # directory basename — plain args.model defaults to
+            # "tiny-test" under --model-path and would collide every
+            # model-path snapshot worker on one registry key.
+            import os
+
+            ident = (args.model_ref
+                     or (os.path.basename(args.model_path.rstrip("/"))
+                         if args.model_path else args.model))
+            await register_checkpoint(runtime, CheckpointRecord(
+                name=f"{ident}-snapshot",
+                model=args.model_ref or args.model_path or args.model,
+                snapshot_dir=snapshot.directory,
+                namespace=args.namespace,
+                weights_digest=digest,
+            ))
+        except Exception:  # noqa: BLE001 — registry is advisory; serving
+            # must not depend on it
+            log.exception("checkpoint record registration failed")
     else:
         await worker.start()
     from ..runtime import HealthCheckManager

@@ -30,6 +30,7 @@ class StubAppsApi:
         base = "/apis/apps/v1/namespaces/{ns}/deployments"
         app = web.Application()
         app.router.add_post(base, self._create)
+        app.router.add_get(base, self._list)
         app.router.add_get(base + "/{name}", self._get)
         app.router.add_patch(base + "/{name}", self._patch)
         app.router.add_delete(base + "/{name}", self._delete)
@@ -58,15 +59,44 @@ class StubAppsApi:
         self.deployments[name] = obj
         return web.json_response(obj, status=201)
 
+    def _is_broken(self, obj):
+        """A pod template with env BROKEN=1 never becomes ready (the
+        bad-image rollout scenario)."""
+        containers = (obj.get("spec", {}).get("template", {})
+                      .get("spec", {}).get("containers", []))
+        for c in containers:
+            for e in c.get("env", []):
+                if e.get("name") == "BROKEN" and e.get("value") == "1":
+                    return True
+        return False
+
+    def _refresh_status(self, obj):
+        # cooperative kubelet: everything asked for becomes ready —
+        # unless the template is marked broken.
+        ready = 0 if self._is_broken(obj) else obj["spec"].get("replicas", 0)
+        obj.setdefault("status", {})["readyReplicas"] = ready
+
     async def _get(self, request):
         from aiohttp import web
 
         obj = self.deployments.get(request.match_info["name"])
         if obj is None:
             return web.Response(status=404, text="NotFound")
-        # cooperative kubelet: everything asked for becomes ready
-        obj["status"]["readyReplicas"] = obj["spec"].get("replicas", 0)
+        self._refresh_status(obj)
         return web.json_response(obj)
+
+    async def _list(self, request):
+        from aiohttp import web
+
+        selector = request.query.get("labelSelector", "")
+        want = dict(kv.split("=", 1) for kv in selector.split(",") if kv)
+        items = []
+        for obj in self.deployments.values():
+            labels = obj.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                self._refresh_status(obj)
+                items.append(obj)
+        return web.json_response({"items": items})
 
     async def _patch(self, request):
         from aiohttp import web
@@ -119,6 +149,14 @@ def _spec():
     })
 
 
+def _svc_deps(api, deployment, service):
+    """Deployments backing one service (names are revision-suffixed)."""
+    return {n: o for n, o in api.deployments.items()
+            if o.get("metadata", {}).get("labels", {})
+            .get("app.kubernetes.io/component") == service
+            and n.startswith(f"{deployment}-{service}-")}
+
+
 class TestKubeController:
     def test_create_scale_status_delete(self, run):
         async def body():
@@ -129,14 +167,13 @@ class TestKubeController:
                 ctl.start()
                 try:
                     for _ in range(100):
-                        if set(api.deployments) == {"kc-decode",
-                                                    "kc-frontend"}:
+                        if (_svc_deps(api, "kc", "decode")
+                                and _svc_deps(api, "kc", "frontend")):
                             break
                         await asyncio.sleep(0.02)
-                    assert set(api.deployments) == {"kc-decode",
-                                                    "kc-frontend"}
-                    assert (api.deployments["kc-decode"]["spec"]["replicas"]
-                            == 2)
+                    (dec_name, dec), = _svc_deps(api, "kc",
+                                                 "decode").items()
+                    assert dec["spec"]["replicas"] == 2
                     # readiness feeds back into status()
                     for _ in range(100):
                         st = ctl.status()["services"]
@@ -148,15 +185,125 @@ class TestKubeController:
 
                     ctl.set_replicas("decode", 5)
                     for _ in range(100):
-                        if (api.deployments["kc-decode"]["spec"]["replicas"]
+                        if (api.deployments[dec_name]["spec"]["replicas"]
                                 == 5):
                             break
                         await asyncio.sleep(0.02)
-                    assert (api.deployments["kc-decode"]["spec"]["replicas"]
+                    assert (api.deployments[dec_name]["spec"]["replicas"]
                             == 5)
                 finally:
                     await ctl.close()
                 assert api.deployments == {}  # torn down
+        run(body())
+
+    def test_rolling_update_zero_downtime(self, run):
+        """An image/template change surges a NEW revision while the old
+        keeps serving; the old revision is deleted only after the new
+        reports ready (ref: operator readiness-gated rollout)."""
+        async def body():
+            async with stub_api() as api:
+                spec = _spec()
+                ctl = KubeDeploymentController(
+                    spec, base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05,
+                    rollout_timeout=30.0)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    (old_name,), = [tuple(_svc_deps(api, "kc", "decode"))]
+                    # spec change: new args -> new pod template revision
+                    new = _spec()
+                    new.services["decode"].args = ["--model-name", "m2"]
+                    ctl.apply_spec(new)
+                    saw_both = False
+                    for _ in range(200):
+                        deps = _svc_deps(api, "kc", "decode")
+                        if len(deps) == 2:
+                            saw_both = True  # surge: old + new coexist
+                            # zero downtime: the OLD revision still has
+                            # its ready replicas while the new rolls out
+                            assert old_name in deps
+                        if len(deps) == 1 and old_name not in deps:
+                            break
+                        await asyncio.sleep(0.02)
+                    deps = _svc_deps(api, "kc", "decode")
+                    assert saw_both
+                    assert len(deps) == 1 and old_name not in deps
+                    (new_obj,) = deps.values()
+                    assert new_obj["spec"]["template"]["spec"][
+                        "containers"][0]["command"][-1] == "m2"
+                    assert (ctl.status()["rollouts"]["decode"]["state"]
+                            == "complete")
+                    # replicas carried over and serving
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+                finally:
+                    await ctl.close()
+        run(body())
+
+    def test_failed_rollout_auto_rollback(self, run):
+        """A revision that never becomes ready (bad image) is rolled
+        back: its Deployment is deleted, the old revision keeps serving,
+        and the service spec reverts."""
+        async def body():
+            async with stub_api() as api:
+                spec = _spec()
+                ctl = KubeDeploymentController(
+                    spec, base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05,
+                    rollout_timeout=0.5)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    (old_name,), = [tuple(_svc_deps(api, "kc", "decode"))]
+                    bad = _spec()
+                    bad.services["decode"].env = {"BROKEN": "1"}
+                    ctl.apply_spec(bad)
+                    # rollback: back to exactly the old revision
+                    for _ in range(300):
+                        deps = _svc_deps(api, "kc", "decode")
+                        roll = ctl.status()["rollouts"].get("decode", {})
+                        if (roll.get("state") == "rolled_back"
+                                and set(deps) == {old_name}):
+                            break
+                        await asyncio.sleep(0.02)
+                    roll = ctl.status()["rollouts"]["decode"]
+                    assert roll["state"] == "rolled_back"
+                    assert set(_svc_deps(api, "kc", "decode")) == {old_name}
+                    # old revision never stopped serving
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+                    # the reverted spec no longer carries the bad env
+                    assert "BROKEN" not in ctl.spec.services["decode"].env
+                finally:
+                    await ctl.close()
+        run(body())
+
+    def test_scaling_adapter_clamps(self, run):
+        async def body():
+            async with stub_api() as api:
+                spec = _spec()
+                spec.services["decode"].min_replicas = 2
+                spec.services["decode"].max_replicas = 4
+                ctl = KubeDeploymentController(
+                    spec, base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05)
+                ctl.start()
+                try:
+                    ctl.set_replicas("decode", 100)
+                    assert ctl.desired["decode"] == 4
+                    ctl.set_replicas("decode", 0)
+                    assert ctl.desired["decode"] == 2
+                finally:
+                    await ctl.close()
         run(body())
 
     def test_dgdr_realized_as_k8s_deployments(self, run):
@@ -201,9 +348,10 @@ class TestKubeController:
                             break
                         await asyncio.sleep(0.05)
                     assert st and st.get("phase") == DEPLOYED, st
-                    assert "zk-decode" in api.deployments
-                    assert (api.deployments["zk-decode"]["spec"]["replicas"]
-                            == st["profile"]["replicas"])
+                    deps = _svc_deps(api, "zk", "decode")
+                    assert len(deps) == 1
+                    (dec,) = deps.values()
+                    assert dec["spec"]["replicas"] == st["profile"]["replicas"]
                 finally:
                     await dgdr.close()
                     await rt.shutdown()
