@@ -56,6 +56,13 @@ def main() -> None:
     from dynamo_tpu.engine import ModelRunner, RunnerConfig
     from dynamo_tpu.models import get_config
     from dynamo_tpu.parallel import MeshConfig, make_mesh
+    from dynamo_tpu.runtime.config import env as _env
+
+    # Honor DYNT_JAX_PLATFORM BEFORE the first backend touch (CPU smoke
+    # runs; the frozen JAX_PLATFORMS env can't override the tunnel
+    # platform, the live config update can — see parallel/mesh.py).
+    if _env("DYNT_JAX_PLATFORM"):
+        jax.config.update("jax_platforms", _env("DYNT_JAX_PLATFORM"))
 
     device = jax.devices()[0]
     device_kind = getattr(device, "device_kind", "cpu").lower()
@@ -202,6 +209,68 @@ def main() -> None:
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
     }
+
+    # On-chip prefill throughput + MFU headline (VERDICT r3 item 2): time
+    # PIPELINED prefill chunks exactly like the decode bench pipelines
+    # decode blocks — return_device defers the host sync so the dispatch
+    # round trip (tunnel-dominated here) overlaps the next chunk's
+    # compute. MFU denominator: model forward FLOPs (2 * active params
+    # per token) over the chip's peak bf16 FLOPs.
+    if os.environ.get("DYNT_BENCH_PREFILL", "1") != "0":
+        PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+                       "v6e": 918.0, "cpu": 1.0}
+        chunk_len = runner.max_prefill_chunk
+        n_chunks = 8
+        # Distinct page ranges per chunk: each timed chunk is an
+        # independent prefill (no prefix reuse, full attention cost).
+        pf_tables = np.zeros((n_chunks, MAX_PAGES_PER_SEQ), np.int32)
+        pf_pages = chunk_len // PAGE_SIZE + 1
+        nxt = 1
+        for i in range(n_chunks):
+            pf_tables[i, :pf_pages] = np.arange(nxt, nxt + pf_pages)
+            nxt += pf_pages
+        pf_prompt = rng.integers(0, config.vocab_size,
+                                 chunk_len).astype(np.int32)
+
+        def prefill_pass():
+            pending = []
+            for i in range(n_chunks):
+                pending.append(runner.prefill_chunk(
+                    pf_prompt, 0, pf_tables[i], chunk_len,
+                    (0.0, 1.0, 0, 0), return_device=True))
+            for tok in pending:
+                np.asarray(tok)
+
+        prefill_pass()  # compile + settle
+        pf_trials = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            prefill_pass()
+            pf_trials.append(time.perf_counter() - t0)
+        pf_elapsed = sorted(pf_trials)[1]
+        pf_tok_per_sec = n_chunks * chunk_len / pf_elapsed
+        peak = 1.0
+        for key, tf in PEAK_TFLOPS.items():
+            if key in device_kind:
+                peak = tf
+                break
+        # Forward FLOPs/token: 2 * matmul params + attention score/value
+        # FLOPs over the mean context. The embedding GATHER does no
+        # matmul: drop one vocab*h from the param count when untied (the
+        # tied table already counts once and serves as the head matmul).
+        h = config.hidden
+        matmul_params = _param_bytes(config) // 2
+        if not config.tie_embeddings:
+            matmul_params -= config.vocab_size * h
+        attn_flops = (2 * 2 * config.n_layers * config.n_q_heads
+                      * config.head_dim * (chunk_len / 2))
+        flops_per_tok = 2 * matmul_params + attn_flops
+        mfu = pf_tok_per_sec * flops_per_tok / (peak * 1e12)
+        result["prefill"] = {
+            "tokens_per_sec_per_chip": round(pf_tok_per_sec, 1),
+            "chunk_len": chunk_len,
+            "mfu": round(mfu, 4),
+        }
 
     # Prefill/TTFT tail: p50/p99 single-request prefill latency at a few
     # ISLs (the reference's aiperf sweeps report TTFT alongside decode —

@@ -51,6 +51,9 @@ class _Rollout:
 
     new_rev: str
     previous: ServiceSpec  # spec to restore on rollback
+    previous_env: dict  # graph-level env at rollout start (also part of
+    # the pod template — a rollout caused by an env change must restore
+    # it or the rolled-back render re-produces the failed revision)
     started_at: float
     state: str = "progressing"  # progressing | complete | rolled_back
 
@@ -100,6 +103,7 @@ class KubeDeploymentController:
         self._observed: dict[str, int] = {name: 0 for name in spec.services}
         self._rollouts: dict[str, _Rollout] = {}
         self._removed: set[str] = set()  # services dropped by apply_spec
+        self._gc_tick = 0  # occasional old-revision sweep counter
         self._session = None
         self._task: Optional[asyncio.Task] = None
         self._dirty = asyncio.Event()
@@ -187,6 +191,7 @@ class KubeDeploymentController:
         old_revs = {name: self._revision_of(svc)
                     for name, svc in self.spec.services.items()}
         old_specs = dict(self.spec.services)
+        old_env = dict(self.spec.env)
         self.spec.env = dict(new_spec.env)
         for name, svc in new_spec.services.items():
             old = old_specs.get(name)
@@ -201,12 +206,12 @@ class KubeDeploymentController:
                 if roll is not None and roll.state == "progressing":
                     # Re-rolled mid-rollout: keep the ORIGINAL serving
                     # revision as the rollback target.
-                    previous = roll.previous
+                    previous, prev_env = roll.previous, roll.previous_env
                 else:
-                    previous = old
+                    previous, prev_env = old, old_env
                 self._rollouts[name] = _Rollout(
                     new_rev=new_rev, previous=previous,
-                    started_at=time.monotonic())
+                    previous_env=prev_env, started_at=time.monotonic())
                 log.info("rollout %s: %s -> %s", name, old_revs[name],
                          new_rev)
         for name in list(self.spec.services):
@@ -310,6 +315,14 @@ class KubeDeploymentController:
                     reason)
         await self._req("DELETE", self._url(dep_name))
         self.spec.services[name] = roll.previous
+        if self._revision_of(roll.previous) == rev:
+            # The failed revision came from a GRAPH-LEVEL env change
+            # (same ServiceSpec renders the same broken template):
+            # restore the whole graph env, or reconcile would recreate
+            # the failed revision forever. This also reverts the env for
+            # sibling services — a failed rollout reverts the applied
+            # change as a unit.
+            self.spec.env = dict(roll.previous_env)
         self.desired[name] = max(
             self.desired.get(name, 0),
             roll.previous.clamp_replicas(roll.previous.replicas))
@@ -319,7 +332,9 @@ class KubeDeploymentController:
     async def _reconcile_service(self, name: str, svc: ServiceSpec) -> None:
         rev = self._revision_of(svc)
         dep_name = self._dep_name(name, rev)
-        want = self.desired[name]
+        want = self.desired.get(name)
+        if want is None:
+            return  # removed by apply_spec mid-pass; next pass GCs it
         roll = self._rollouts.get(name)
 
         def _roll_expired() -> bool:
@@ -332,6 +347,13 @@ class KubeDeploymentController:
             obj = self._render(svc)
             obj["metadata"]["name"] = dep_name
             obj["metadata"]["labels"]["dynamo.revision"] = rev
+            # The revision must be part of the SELECTOR and pod labels:
+            # two Deployment revisions with identical matchLabels are
+            # overlapping selectors — ReplicaSet adoption fights and
+            # readyReplicas accounting breaks on a real apiserver.
+            obj["spec"]["selector"]["matchLabels"]["dynamo.revision"] = rev
+            obj["spec"]["template"]["metadata"]["labels"][
+                "dynamo.revision"] = rev
             obj["spec"]["replicas"] = want
             status, created = await self._req("POST", self._url(), obj)
             if status not in (200, 201):
@@ -365,7 +387,15 @@ class KubeDeploymentController:
         ready = int(current.get("status", {}).get("readyReplicas", 0) or 0)
 
         # Rollout bookkeeping: old revisions keep serving until the new
-        # one is ready (surge); a timed-out rollout is rolled back.
+        # one is ready (surge); a timed-out rollout is rolled back. The
+        # LIST is only needed while a rollout is in flight (plus a
+        # periodic garbage-collection sweep) — steady state stays at one
+        # GET per service per pass.
+        self._gc_tick += 1
+        if not (roll is not None and roll.state == "progressing"
+                or self._gc_tick % 16 == 0):
+            self._observed[name] = ready
+            return
         old_revs = [d for d in await self._list_service_deployments(name)
                     if d["metadata"]["name"] != dep_name]
         old_ready = sum(

@@ -610,10 +610,15 @@ class ModelRunner:
         sampling: tuple[float, float, int, int],  # (temp, top_p, top_k, seed)
         lora_idx: int = 0,
         chunk_embeds: Optional[np.ndarray] = None,  # [t, H] splice rows
+        return_device: bool = False,
     ) -> int:
         """Run one prefill chunk; returns the sampled token id (meaningful
         only on the final chunk). `chunk_embeds` rows replace the token
-        embedding at image-placeholder positions within this chunk."""
+        embedding at image-placeholder positions within this chunk.
+        `return_device=True` skips the host sync and returns the device
+        token array — lets callers (bench pipelining, speculative
+        schedulers) overlap successive chunks across the dispatch
+        round trip the same way decode_multi does."""
         t = len(tokens)
         bucket = self._bucket_for(t)
         fn = self._prefill_fns.get(bucket)
@@ -660,6 +665,9 @@ class ModelRunner:
                     self._zero_embeds[bucket] = zeros
                 kwargs["extra_embeds"] = zeros
         self.kv_cache, token, lp, top_ids, top_lps = fn(*args, **kwargs)
+        if return_device:
+            self.last_prefill_sample = None
+            return token
         self.last_prefill_sample = (float(np.asarray(lp)[0]),
                                     np.asarray(top_ids)[0],
                                     np.asarray(top_lps)[0])

@@ -44,10 +44,72 @@ class MockerConfig:
     # testing — lets a test drive exact output text through the frontend).
     echo: bool = False
     decode_us_per_seq: float = 100.0
+    # Paged-attention cost: per active KV block of decoding sequences per
+    # step (the context-length-dependent term the reference's mocker
+    # models — ref: lib/mocker/src/scheduler/vllm/core.rs timing).
+    decode_us_per_kv_block: float = 0.0
     speedup_ratio: float = 1.0
     watermark: float = 0.01  # keep this fraction of blocks free
     vocab_size: int = 512
     dp_rank: int = 0
+
+    @classmethod
+    def from_timing_preset(cls, name: str, **overrides) -> "MockerConfig":
+        params = dict(TIMING_PRESETS[name])
+        params.update(overrides)
+        return cls(**params)
+
+
+# Step-time coefficients FIT FROM MEASURED silicon (BASELINE.md r3/r4
+# decode probe, scripts/bench_probe.py on a real v5e chip):
+#   us/step = decode_base + decode_us_per_seq * batch
+#             + decode_us_per_kv_block * active_kv_blocks
+# Least-squares over the ctx~0 floor points (bs 8/16/32 -> 2580/3298/
+# 5241 us) gives base=1608us, per_seq=112.4us (fit error <3.3% on all
+# three); the attention term is measured directly (+620us for 128
+# blocks at bs=8 ctx=256 -> 4.84us/block). The prefill rate comes from
+# the on-chip chunked-prefill bench. These make planner/mocker CI
+# validate SLA math against real step-time physics, not placeholders.
+TIMING_PRESETS: dict[str, dict] = {
+    "tpu-v5e-qwen3-0.6b": dict(
+        decode_base_ms=1.608,
+        decode_us_per_seq=112.4,
+        decode_us_per_kv_block=4.84,
+        prefill_us_per_token=12.0,
+        block_size=16,
+    ),
+}
+
+
+def derive_decode_profile(preset: str, num_blocks: int = 2048,
+                          batches=(1, 2, 4, 8, 16, 32),
+                          contexts=(128, 256, 512, 1024, 2048)) -> dict:
+    """Sample a (kv_usage, context) -> ITL/throughput decode profile from
+    a timing preset, in the planner interpolator's raw_data schema — so
+    planner replica math can be validated (and bootstrapped) against the
+    same measured step-time physics the mocker simulates, without a
+    profiling sweep (ref: planner pre_swept_results NPZ role)."""
+    params = TIMING_PRESETS[preset]
+    bs_block = params["block_size"]
+    kv, ctx_out, itl, thpt = [], [], [], []
+    for ctx in contexts:
+        blocks_per_seq = -(-ctx // bs_block)
+        for bs in batches:
+            step_us = (params["decode_base_ms"] * 1e3
+                       + params["decode_us_per_seq"] * bs
+                       + params["decode_us_per_kv_block"]
+                       * bs * blocks_per_seq)
+            kv.append(min(1.0, bs * blocks_per_seq / num_blocks))
+            ctx_out.append(float(ctx))
+            itl.append(step_us / 1e3)  # ms per token per sequence
+            thpt.append(bs / (step_us / 1e6))  # tokens/s/chip
+    return {
+        "x_kv_usage": kv,
+        "y_context_length": ctx_out,
+        "z_itl": itl,
+        "z_thpt_per_chip": thpt,
+        "max_kv_tokens": [num_blocks * bs_block],
+    }
 
 
 class _PagedKvCache:
@@ -288,7 +350,8 @@ class MockerEngine:
                 await self._flush_stored()
                 self.steps += 1
                 elapsed = time.monotonic() - step_start
-                target = self._step_time(prefill_tokens, decoded)
+                target = self._step_time(prefill_tokens, decoded,
+                                         self._active_kv_blocks())
                 delay = max(0.0, target - elapsed)
                 if delay:
                     await asyncio.sleep(delay)
@@ -308,14 +371,28 @@ class MockerEngine:
                 for queue, item in deliveries:
                     queue.put_nowait(item)
 
-    def _step_time(self, prefill_tokens: int, decoded: int) -> float:
+    def _step_time(self, prefill_tokens: int, decoded: int,
+                   kv_blocks: int = 0) -> float:
         cfg = self.config
         t = 0.0
         if prefill_tokens:
             t += prefill_tokens * cfg.prefill_us_per_token / 1e6
         if decoded:
             t += (cfg.decode_base_ms / 1e3) + decoded * cfg.decode_us_per_seq / 1e6
+            t += kv_blocks * cfg.decode_us_per_kv_block / 1e6
         return t / max(1e-6, cfg.speedup_ratio)
+
+    def _active_kv_blocks(self) -> int:
+        """KV blocks attended by currently-DECODING sequences (the paged
+        attention streams these every step)."""
+        bs = self.config.block_size
+        total = 0
+        for seq in self._running:
+            if seq.done or seq.cancelled:
+                continue
+            if seq.prefilled_tokens >= len(seq.request.token_ids):
+                total += -(-(seq.prefilled_tokens + seq.generated) // bs)
+        return total
 
     def _admit(self, evict_cb) -> None:
         cfg = self.config

@@ -142,6 +142,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--num-blocks", type=int, default=1024)
     parser.add_argument("--max-batch", type=int, default=32)
     parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    parser.add_argument("--timing-preset", default=None,
+                        help="measured-silicon step-time coefficients "
+                             "(engine.TIMING_PRESETS, e.g. "
+                             "tpu-v5e-qwen3-0.6b); overrides the generic "
+                             "defaults so planner/SLA validation runs "
+                             "against real step-time physics")
     parser.add_argument("--mode", default="aggregated",
                         choices=["aggregated", "prefill"])
     parser.add_argument("--echo", action="store_true",
@@ -154,6 +160,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
     component = args.component
     if args.mode == "prefill" and component == "mocker":
         component = "prefill"
+    common_cfg = dict(
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_batch=args.max_batch,
+        speedup_ratio=args.speedup_ratio,
+        echo=args.echo,
+    )
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = MockerWorker(
         runtime,
@@ -161,13 +174,9 @@ async def main(argv: Optional[list[str]] = None) -> None:
         namespace=args.namespace,
         component=component,
         mode=args.mode,
-        config=MockerConfig(
-            block_size=args.block_size,
-            num_blocks=args.num_blocks,
-            max_batch=args.max_batch,
-            speedup_ratio=args.speedup_ratio,
-            echo=args.echo,
-        ),
+        config=(MockerConfig.from_timing_preset(args.timing_preset,
+                                                **common_cfg)
+                if args.timing_preset else MockerConfig(**common_cfg)),
         tool_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
     )

@@ -1,0 +1,153 @@
+"""Batch-scaling ablation: WHICH part of the ctx~0 decode floor grows
+with batch size?
+
+The r3 probe (bench_probe.py) showed the weights-only floor rising
+2,580 -> 3,298 -> 5,241 us/step from bs 8 -> 16 -> 32 while the streamed
+bytes stay constant — so something batch-linear eats the headroom. This
+probe times jitted scan-blocks of ablated programs on the real chip:
+
+  matmuls   just the per-layer matmul chain (weight streaming + MXU)
+  +vpu      plus norms/rope/activation (batch-linear VPU work)
+  +head     plus the LM head matmul + logits materialization
+  +sample   plus the sampler (full forward_decode equivalent)
+
+One JSON line per (config, bs). Scan-block timing per the tunnel rule:
+only deferred, scanned programs give valid numbers here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BLOCK = 64
+N_BLOCKS = 4
+
+
+def build(config, params, bs, what):
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampler import sample
+    from dynamo_tpu.models.transformer import rms_norm, rope
+
+    h_dim = config.hidden
+
+    def layer_matmuls(x, lp):
+        q = jnp.einsum("bh,hqd->bqd", x, lp["wq"])
+        k = jnp.einsum("bh,hkd->bkd", x, lp["wk"])
+        v = jnp.einsum("bh,hkd->bkd", x, lp["wv"])
+        attn = q[:, :, :] * 1.0  # stand-in for attention output
+        o = jnp.einsum("bqd,qdh->bh", attn, lp["wo"].reshape(
+            config.n_q_heads, config.head_dim, h_dim))
+        g = jnp.einsum("bh,hm->bm", o, lp["w_gate"])
+        u = jnp.einsum("bh,hm->bm", o, lp["w_up"])
+        d = jnp.einsum("bm,mh->bh", g * u, lp["w_down"])
+        return x + d * 1e-6, k, v
+
+    def layer_full(x, lp, positions):
+        hn = rms_norm(x[:, None, :], lp["attn_norm"], config.rms_eps)[:, 0]
+        q = jnp.einsum("bh,hqd->bqd", hn, lp["wq"])
+        k = jnp.einsum("bh,hkd->bkd", hn, lp["wk"])
+        v = jnp.einsum("bh,hkd->bkd", hn, lp["wv"])
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q[:, None], positions[:, None], config.rope_theta)[:, 0]
+        k = rope(k[:, None], positions[:, None], config.rope_theta)[:, 0]
+        attn = q * 1.0
+        o = jnp.einsum("bqd,qdh->bh", attn, lp["wo"].reshape(
+            config.n_q_heads, config.head_dim, h_dim))
+        x = x + o
+        hn = rms_norm(x[:, None, :], lp["mlp_norm"], config.rms_eps)[:, 0]
+        g = jnp.einsum("bh,hm->bm", hn, lp["w_gate"])
+        u = jnp.einsum("bh,hm->bm", hn, lp["w_up"])
+        d = jnp.einsum("bm,mh->bh", jax.nn.silu(g) * u, lp["w_down"])
+        return x + d, k, v
+
+    def body(carry, _):
+        tokens, positions = carry
+        x = params["embed"][tokens]
+        for lp in params["layers"]:
+            if what == "matmuls":
+                x, _k, _v = layer_matmuls(x, lp)
+            else:
+                x, _k, _v = layer_full(x, lp, positions)
+        if what in ("matmuls", "+vpu"):
+            nxt = jnp.argmax(x, axis=-1).astype(jnp.int32) % 1000
+            return (nxt, positions + 1), nxt
+        x = rms_norm(x[:, None, :], params["final_norm"],
+                     config.rms_eps)[:, 0]
+        head = (params["embed"].T if config.tie_embeddings
+                else params["lm_head"])
+        logits = (x @ head).astype(jnp.float32)
+        if what == "+head":
+            nxt = jnp.max(logits, axis=-1).astype(jnp.int32) % 1000
+            return (nxt, positions + 1), nxt
+        nxt = sample(logits, jnp.zeros(bs), jnp.ones(bs),
+                     jnp.zeros(bs, jnp.int32), jnp.zeros(bs, jnp.uint32),
+                     positions)
+        return (nxt, positions + 1), nxt
+
+    def block_fn(tokens, positions):
+        (t, p), toks = jax.lax.scan(body, (tokens, positions), None,
+                                    length=BLOCK)
+        return toks
+
+    return jax.jit(block_fn)
+
+
+def run(bs, what):
+    import jax
+
+    from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    config = get_config("qwen3-0.6b")
+    runner = ModelRunner(
+        config,
+        RunnerConfig(page_size=16, num_pages=64, max_batch=bs,
+                     max_pages_per_seq=4, prefill_buckets=(32,)),
+        make_mesh(MeshConfig()), seed=0)
+    fn = build(config, runner.params, bs, what)
+    tokens = np.zeros(bs, np.int32)
+    positions = np.zeros(bs, np.int32)
+    out = fn(tokens, positions)
+    np.asarray(out)  # compile + settle
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pending = []
+        for _ in range(N_BLOCKS):
+            pending.append(fn(tokens, positions))
+        for p in pending:
+            np.asarray(p)
+        trials.append(time.perf_counter() - t0)
+    best = sorted(trials)[1]
+    us = 1e6 * best / (N_BLOCKS * BLOCK)
+    print(json.dumps({"what": what, "bs": bs,
+                      "us_per_step": round(us, 1)}), flush=True)
+
+
+def main():
+    import gc
+
+    whats = (sys.argv[1].split(",") if len(sys.argv) > 1
+             else ["matmuls", "+vpu", "+head", "+sample"])
+    sizes = ([int(b) for b in sys.argv[2].split(",")]
+             if len(sys.argv) > 2 else [8, 32])
+    for what in whats:
+        for bs in sizes:
+            run(bs, what)
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Floor ablation v2: reuse the PROVEN decode_multi timing path
+(bench_probe.run_config) with surgical monkeypatches, instead of a
+bespoke program the remote compiler chokes on.
+
+  full        unmodified decode (bench_probe baseline)
+  noscatter   write_kv_stack -> identity (no paged-pool writeback)
+  nosample    sampler.sample -> zeros (no argmax/logits consumer)
+  nohead      lm head matmul + logits replaced by a [B,1] dummy read
+
+Usage: python -u scripts/bench_ablate2.py <what> <bs>
+(one config per process: monkeypatches must precede jit builds)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def apply_patch(what: str) -> None:
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import transformer
+
+    if what == "noscatter":
+        transformer.write_kv_stack = (
+            lambda kv_cache, *a, **k: kv_cache)
+    elif what == "nosample":
+        from dynamo_tpu.engine import sampler
+
+        sampler.sample = (
+            lambda logits, temperature, *a, **k:
+            jnp.zeros(logits.shape[0], jnp.int32))
+        # model_runner imported sample by name
+        from dynamo_tpu.engine import model_runner
+
+        model_runner.sample = sampler.sample
+    elif what == "nohead":
+        orig = transformer.forward_decode
+
+        def patched(params, config, tokens, *a, **k):
+            kv, logits = orig(params, config, tokens, *a, **k)
+            # keep the output contract but drop the real logits so XLA
+            # dead-code-eliminates the head matmul + [B, V] materialize
+            fake = jnp.zeros((logits.shape[0], logits.shape[1], 1024),
+                             jnp.float32) + tokens[:, None, None]
+            return kv, fake
+        transformer.forward_decode = patched
+        from dynamo_tpu.engine import model_runner
+
+        model_runner.forward_decode = patched
+    elif what == "norope":
+        transformer.rope = lambda x, positions, theta=10000.0: x
+    elif what == "noqknorm":
+        # skip q/k per-head norms only (qwen3 qk_norm): patch the config
+        # factory (bench_probe late-imports get_config from the package)
+        import dataclasses as dc
+
+        import dynamo_tpu.models as m
+        from dynamo_tpu.models import config as mcfg
+
+        orig_get = mcfg.get_config
+
+        def patched_cfg(name):
+            return dc.replace(orig_get(name), qk_norm=False)
+        mcfg.get_config = patched_cfg
+        m.get_config = patched_cfg
+    elif what == "nonorm":
+        transformer.rms_norm = lambda x, w, eps=1e-6: x
+    elif what != "full":
+        raise SystemExit(f"unknown ablation {what}")
+
+
+def main() -> None:
+    what = sys.argv[1]
+    bs = int(sys.argv[2])
+    apply_patch(what)
+    from scripts.bench_probe import run_config
+
+    run_config(f"{what}-bs{bs}", bs, 0, "pallas")
+
+
+if __name__ == "__main__":
+    main()

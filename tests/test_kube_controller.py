@@ -287,6 +287,48 @@ class TestKubeController:
                     await ctl.close()
         run(body())
 
+    def test_graph_env_rollout_rolls_back_env(self, run):
+        """A rollout caused by a GRAPH-level env change (rendered into
+        every pod template) must restore the env on rollback — otherwise
+        the rolled-back spec re-renders the same failed revision and the
+        controller re-surges it forever."""
+        async def body():
+            async with stub_api() as api:
+                spec = _spec()
+                ctl = KubeDeploymentController(
+                    spec, base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05,
+                    rollout_timeout=0.5)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    bad = _spec()
+                    bad.env = {**bad.env, "BROKEN": "1"}  # graph-level
+                    ctl.apply_spec(bad)
+                    for _ in range(300):
+                        roll = ctl.status()["rollouts"].get("decode", {})
+                        if roll.get("state") == "rolled_back":
+                            break
+                        await asyncio.sleep(0.02)
+                    assert (ctl.status()["rollouts"]["decode"]["state"]
+                            == "rolled_back")
+                    assert "BROKEN" not in ctl.spec.env
+                    # stable: the failed revision does not come back
+                    await asyncio.sleep(0.3)
+                    for deps in (_svc_deps(api, "kc", "decode"),
+                                 _svc_deps(api, "kc", "frontend")):
+                        for obj in deps.values():
+                            envs = (obj["spec"]["template"]["spec"]
+                                    ["containers"][0].get("env", []))
+                            assert not any(e["name"] == "BROKEN"
+                                           for e in envs)
+                finally:
+                    await ctl.close()
+        run(body())
+
     def test_scaling_adapter_clamps(self, run):
         async def body():
             async with stub_api() as api:

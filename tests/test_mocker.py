@@ -139,3 +139,53 @@ class TestMockerEngine:
             await engine.close()
 
         run(body())
+
+
+class TestTimingFidelity:
+    """The v5e timing preset must reproduce the REAL chip's measured
+    step times (scripts/bench_probe.py table, BASELINE.md) within 20%
+    — the bar for planner/SLA validation against the mocker (ref:
+    lib/mocker vllm core.rs timing model fidelity)."""
+
+    PROBE_TABLE = [
+        # (batch, ctx_tokens, measured us/step on v5e)
+        (8, 0, 2580.0),
+        (16, 0, 3298.0),
+        (32, 0, 5241.0),
+        (8, 256, 3203.0),
+    ]
+
+    def test_preset_matches_probe_within_20pct(self):
+        from dynamo_tpu.mocker.engine import MockerConfig
+
+        cfg = MockerConfig.from_timing_preset("tpu-v5e-qwen3-0.6b")
+        eng = MockerEngine(cfg, worker_id=0)
+        try:
+            for bs, ctx, measured in self.PROBE_TABLE:
+                blocks = bs * (-(-ctx // cfg.block_size))
+                model = eng._step_time(0, bs, blocks) * 1e6
+                err = abs(model - measured) / measured
+                assert err < 0.20, (bs, ctx, model, measured, err)
+        finally:
+            eng._closed = True
+
+    def test_derived_profile_consistent(self):
+        from dynamo_tpu.mocker.engine import derive_decode_profile
+
+        prof = derive_decode_profile("tpu-v5e-qwen3-0.6b")
+        # throughput rises with batch at fixed context...
+        t = {(k, c): v for k, c, v in zip(prof["x_kv_usage"],
+                                          prof["y_context_length"],
+                                          prof["z_thpt_per_chip"])}
+        itl = {(k, c): v for k, c, v in zip(prof["x_kv_usage"],
+                                            prof["y_context_length"],
+                                            prof["z_itl"])}
+        by_ctx = {}
+        for (k, c), v in t.items():
+            by_ctx.setdefault(c, []).append((k, v))
+        for c, rows in by_ctx.items():
+            rows.sort()
+            thpts = [v for _k, v in rows]
+            assert thpts == sorted(thpts)  # more batch -> more tok/s
+        # ...and ITL grows with context at fixed batch share
+        assert max(itl.values()) > min(itl.values())
