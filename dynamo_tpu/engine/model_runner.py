@@ -159,11 +159,14 @@ class ModelRunner:
         self.config = runner_config
         self.mesh = mesh
         self._attention_user_supplied = attention_fn is not None
-        if attention_fn is None:
+        if attention_fn is None and not model_config.is_gptoss:
             attention_fn = _default_attention_fn(mesh)
         self._attention_fn = attention_fn
+        # gpt-oss: sink + sliding-window attention lives in the unified
+        # forward (the Pallas kernels don't model sinks); its forward
+        # branch ignores attention_fn, and fast decode is gated off.
         self._decode_attention_fn = (
-            None if self._attention_user_supplied
+            None if self._attention_user_supplied or model_config.is_gptoss
             else _default_decode_attention_fn(mesh))
         axes = param_axes(model_config)
         self._param_sharding = param_shardings(mesh, axes)
@@ -260,7 +263,8 @@ class ModelRunner:
         # default. A USER-SUPPLIED attention_fn still wins (tests inject
         # reference kernels); MLA keeps the unified path (its latent cache
         # is a single stack, so the scatter count is already minimal).
-        fast_decode = not cfg.is_mla and not self._attention_user_supplied
+        fast_decode = (not cfg.is_mla and not cfg.is_gptoss
+                       and not self._attention_user_supplied)
 
         def one(params, kv, tokens, positions, block_tables, kv_lens,
                 active, lora, lora_idx):
@@ -329,7 +333,7 @@ class ModelRunner:
         def multi(params, kv, tokens, positions, block_tables, kv_lens,
                   active, temperature, top_p, top_k, seeds, step_idx,
                   lora=None, lora_idx=None):
-            fast_decode = (not cfg.is_mla
+            fast_decode = (not cfg.is_mla and not cfg.is_gptoss
                            and not self._attention_user_supplied)
 
             def body(carry, _):
@@ -893,6 +897,36 @@ class ModelRunner:
             self.kv_cache = scatter_from_host(
                 self.kv_cache, np.asarray(page_ids, np.int32), blocks
             )
+
+    # -- distributed KVBM worker half (block_manager/distributed.py) -------
+    # Mirrored across multihost ranks via the step channel: each host
+    # gathers/scatters only its addressable shards — no cross-host bytes.
+
+    kvbm_worker = None  # set by the worker CLI on every rank
+
+    def kvbm_store_shards(self, page_ids: np.ndarray,
+                          hashes: list[int]) -> None:
+        """Gather pages (pool-sharded bundle, NO replication) and store
+        this host's shards in its local arena."""
+        assert self.kvbm_worker is not None, "no KvbmShardWorker attached"
+        bundle = self.gather_pages_device(np.asarray(page_ids, np.int32),
+                                          replicated=False)
+        self.kvbm_worker.store([int(h) for h in hashes], bundle)
+
+    def kvbm_load_shards(self, hashes: list[int],
+                         page_ids: np.ndarray) -> None:
+        """Reassemble the sharded bundle from this host's arena rows and
+        scatter it into the pool (every rank provides its shards of the
+        same global array inside the same mirrored step)."""
+        assert self.kvbm_worker is not None, "no KvbmShardWorker attached"
+        per_device = self.kvbm_worker.load([int(h) for h in hashes])
+        if per_device is None:
+            # Arenas are deterministic replicas; a miss here on any rank
+            # means the leader's index diverged — fail loudly rather than
+            # scatter stale KV.
+            raise RuntimeError("shard arena miss during onboard")
+        bundle = self.kvbm_worker.make_bundle(per_device)
+        self.scatter_pages(np.asarray(page_ids, np.int32), bundle)
 
     def kv_layout(self) -> dict:
         """Wire-layout descriptor of this runner's paged pool. Geometry comes

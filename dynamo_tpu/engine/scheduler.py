@@ -373,11 +373,19 @@ class InferenceScheduler:
         n = self.kvbm.match_prefix(candidates)
         if n == 0:
             return
-        bundle = self.kvbm.read_blocks(candidates[:n])
-        if bundle is None:
-            return
         target = seq.block_table[cached_n : cached_n + n]
-        self.runner.scatter_pages(np.asarray(target, np.int32), bundle)
+        if hasattr(self.kvbm, "onboard_direct"):
+            # Distributed KVBM: the bytes never assemble on one host —
+            # every rank scatters its own shards (mirrored call).
+            if not self.kvbm.onboard_direct(
+                    candidates[:n], np.asarray(target, np.int32),
+                    self.runner):
+                return
+        else:
+            bundle = self.kvbm.read_blocks(candidates[:n])
+            if bundle is None:
+                return
+            self.runner.scatter_pages(np.asarray(target, np.int32), bundle)
         seq.prefill_pos = (cached_n + n) * self.page_size
         self.stats.kvbm_onboarded_blocks += n
         log.info("kvbm onboard: %d blocks (skipping %d prefill tokens) for %s",

@@ -368,12 +368,34 @@ class TpuWorker:
         if self._warmup:
             await asyncio.to_thread(self.runner.warmup)
         if self.kvbm_config is not None and self.kvbm_config.enabled:
-            from ..block_manager import BlockLayoutSpec, KvBlockManager
+            if self._step_channel is not None:
+                # Multihost: the paged pool is sharded across hosts —
+                # use the leader/worker split (each rank stores its own
+                # shards; ref: block_manager/distributed/{leader,worker}.rs)
+                from ..block_manager.distributed import (
+                    DistributedKvbm,
+                    KvbmShardWorker,
+                )
 
-            self.kvbm = KvBlockManager(
-                self.kvbm_config,
-                BlockLayoutSpec.from_runner_layout(self.runner.kv_layout()),
-            )
+                if (self.kvbm_config.disk_blocks
+                        or self.kvbm_config.object_store_root):
+                    log.warning(
+                        "distributed KVBM (multihost) supports the host "
+                        "tier only in v1 — ignoring disk_blocks=%s / "
+                        "object_store_root=%s",
+                        self.kvbm_config.disk_blocks,
+                        self.kvbm_config.object_store_root)
+                self.runner.kvbm_worker = KvbmShardWorker(
+                    self.kvbm_config.host_blocks)
+                self.kvbm = DistributedKvbm(self.kvbm_config, self.runner)
+            else:
+                from ..block_manager import BlockLayoutSpec, KvBlockManager
+
+                self.kvbm = KvBlockManager(
+                    self.kvbm_config,
+                    BlockLayoutSpec.from_runner_layout(
+                        self.runner.kv_layout()),
+                )
         self.scheduler = InferenceScheduler(
             self.runner,
             on_stored=self.events.on_stored,
@@ -1109,6 +1131,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
             mesh = make_mesh(MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
             runner = ModelRunner(model_config, rc, mesh, host_params,
                                  seed=0)
+            if args.kvbm_host_blocks > 0:
+                # Distributed KVBM worker half: this rank stores/loads
+                # its local KV shards when the driver mirrors
+                # kvbm_store_shards / kvbm_load_shards.
+                from ..block_manager.distributed import KvbmShardWorker
+
+                runner.kvbm_worker = KvbmShardWorker(args.kvbm_host_blocks)
             await asyncio.to_thread(mh.follower_serve, runner, multihost_cfg)
             return
         host, port = multihost_cfg.plan_host_port

@@ -42,6 +42,12 @@ def main() -> None:
                      max_pages_per_seq=8, prefill_buckets=(16,)),
         mesh, seed=0)
 
+    from dynamo_tpu.block_manager.distributed import KvbmShardWorker
+
+    # Distributed-KVBM worker half on EVERY rank: each process stores and
+    # loads its local KV shards when kvbm_store/load_shards are mirrored.
+    runner.kvbm_worker = KvbmShardWorker(capacity_blocks=16)
+
     if not cfg.is_driver:
         mh.follower_serve(runner, cfg)
         return
@@ -58,10 +64,22 @@ def main() -> None:
         table[None, :], np.array([11], np.int32), np.array([True]),
         np.zeros(1, np.float32), np.ones(1, np.float32),
         np.zeros(1, np.int32), np.zeros(1, np.uint32))
+    # Distributed KVBM roundtrip across the two processes: offload the
+    # prefilled pages (each rank keeps only ITS shards), clobber the
+    # pool, onboard back, and verify bit-exactness on the driver.
+    pages = np.asarray([1, 2, 3], np.int32)
+    oracle = np.asarray(mirrored.gather_pages(pages))
+    mirrored.kvbm_store_shards(pages, [11, 12, 13])
+    mirrored.scatter_pages(pages, np.zeros_like(oracle))
+    new_pages = np.asarray([5, 6, 7], np.int32)
+    mirrored.kvbm_load_shards([11, 12, 13], new_pages)
+    back = np.asarray(mirrored.gather_pages(new_pages))
+    kvbm_exact = bool(np.array_equal(back, oracle))
     channel.close()
     print(json.dumps({"mesh": {"dp": n // tp, "tp": tp},
                       "global_devices": n,
-                      "first": int(first), "next": int(nxt[0])}))
+                      "first": int(first), "next": int(nxt[0]),
+                      "kvbm_shard_roundtrip_exact": kvbm_exact}))
 
 
 if __name__ == "__main__":

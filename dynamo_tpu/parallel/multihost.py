@@ -300,6 +300,10 @@ MIRRORED_METHODS = (
     "gather_pages_device",
     "scatter_pages",
     "clear_lora_slot",
+    # Distributed KVBM (block_manager/distributed.py): every rank moves
+    # its own shards; the leader only plans.
+    "kvbm_store_shards",
+    "kvbm_load_shards",
 )
 
 
