@@ -64,6 +64,7 @@ _DENSE_ARCHS = {"LlamaForCausalLM", "MistralForCausalLM",
 _MOE_ARCHS = {"Qwen3MoeForCausalLM", "MixtralForCausalLM"}
 _QK_NORM_ARCHS = {"Qwen3ForCausalLM", "Qwen3MoeForCausalLM"}
 _MLA_ARCHS = {"DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"}
+_GPTOSS_ARCHS = {"GptOssForCausalLM"}
 
 
 def config_from_hf(cfg: dict, name: Optional[str] = None,
@@ -71,14 +72,17 @@ def config_from_hf(cfg: dict, name: Optional[str] = None,
     """Build a ModelConfig from a parsed HF config.json dict."""
     archs = cfg.get("architectures") or []
     arch = archs[0] if archs else ""
-    if arch not in _DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS:
+    supported = _DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS | _GPTOSS_ARCHS
+    if arch not in supported:
         raise ValueError(
             f"unsupported architecture {arch!r} (supported: "
-            f"{sorted(_DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS)}); "
+            f"{sorted(supported)}); "
             "Qwen2-class models with attention biases are not "
             "representable in this family")
     if arch in _MLA_ARCHS:
         return _config_from_deepseek(cfg, name=name, dtype=dtype)
+    if arch in _GPTOSS_ARCHS:
+        return _config_from_gptoss(cfg, name=name, dtype=dtype)
     scaling = cfg.get("rope_scaling")
     if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
         raise ValueError(
@@ -116,6 +120,59 @@ def config_from_hf(cfg: dict, name: Optional[str] = None,
         expert_mlp_hidden=int(cfg.get("moe_intermediate_size")
                               or cfg.get("intermediate_size", 0))
         if moe else 0,
+    )
+
+
+def _config_from_gptoss(cfg: dict, name: Optional[str],
+                        dtype: str) -> ModelConfig:
+    """gpt-oss family (ref workload: recipes/ gpt-oss entries): sink
+    attention, alternating sliding windows, biased projections, clipped
+    gated-swiglu MoE, YaRN rope. The generic path's sliding-window /
+    rope-scaling rejections do not apply — this forward implements
+    both."""
+    scaling = cfg.get("rope_scaling") or {}
+    rope_type = scaling.get("rope_type", scaling.get("type", "yarn"))
+    if scaling and rope_type != "yarn":
+        raise ValueError(
+            f"gpt-oss rope_type {rope_type!r} is not implemented (yarn "
+            "only)")
+    layer_types = cfg.get("layer_types") or []
+    for i, lt in enumerate(layer_types):
+        expect = ("sliding_attention" if i % 2 == 0 else "full_attention")
+        if lt != expect:
+            raise ValueError(
+                "gpt-oss layer_types deviate from the alternating "
+                f"sliding/full pattern at layer {i} ({lt!r}) — the "
+                "forward hardcodes that pattern")
+    n_q = int(cfg["num_attention_heads"])
+    hidden = int(cfg["hidden_size"])
+    return ModelConfig(
+        name=name or cfg.get("model_type", "gpt_oss"),
+        vocab_size=int(cfg["vocab_size"]),
+        hidden=hidden,
+        n_layers=int(cfg["num_hidden_layers"]),
+        n_q_heads=n_q,
+        n_kv_heads=int(cfg.get("num_key_value_heads", n_q)),
+        head_dim=int(cfg.get("head_dim") or hidden // n_q),
+        mlp_hidden=int(cfg["intermediate_size"]),
+        rope_theta=float(cfg.get("rope_theta", 150000.0)),
+        rms_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        max_context=int(cfg.get("max_position_embeddings", 131072)),
+        dtype=dtype,
+        n_experts=int(cfg.get("num_local_experts", 0)),
+        n_experts_active=int(cfg.get("num_experts_per_tok", 0)),
+        expert_mlp_hidden=int(cfg["intermediate_size"]),
+        attn_sinks=True,
+        sliding_window=int(cfg.get("sliding_window") or 0),
+        attn_bias=bool(cfg.get("attention_bias", True)),
+        swiglu_limit=float(cfg.get("swiglu_limit", 7.0)),
+        rope_yarn_factor=float(scaling.get("factor", 32.0)),
+        rope_yarn_beta_fast=float(scaling.get("beta_fast", 32.0)),
+        rope_yarn_beta_slow=float(scaling.get("beta_slow", 1.0)),
+        rope_yarn_orig_max=int(
+            scaling.get("original_max_position_embeddings")
+            or cfg.get("max_position_embeddings", 4096)),
     )
 
 
@@ -626,11 +683,114 @@ def _save_deepseek(params: dict, config: ModelConfig, path: str) -> None:
         json.dump(hf_config_dict(config), f, indent=2)
 
 
+_FP4_LUT = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], np.float32)
+
+
+def mxfp4_dequant(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """MXFP4 -> f32 (ref format: HF gpt-oss checkpoints; matching
+    transformers/integrations/mxfp4.py convert_moe_packed_tensors).
+
+    blocks: uint8 [..., G, 16] — 32 fp4 (E2M1) values per group, LOW
+    nibble first; scales: uint8 [..., G] — shared E8M0 exponent per
+    group (2^(s-127)). Returns [..., G*32] float32."""
+    blocks = np.asarray(blocks, np.uint8)
+    scales = np.asarray(scales)
+    lo = _FP4_LUT[blocks & 0x0F]
+    hi = _FP4_LUT[blocks >> 4]
+    vals = np.empty(blocks.shape[:-1] + (blocks.shape[-1] * 2,),
+                    np.float32)
+    vals[..., 0::2] = lo
+    vals[..., 1::2] = hi
+    exp = np.exp2(scales.astype(np.float32) - 127.0)
+    out = vals * exp[..., None]
+    return out.reshape(out.shape[:-2] + (-1,))
+
+
+def _gptoss_expert_tensor(reader: "ShardReader", base: str,
+                          dtype: np.dtype) -> np.ndarray:
+    """Expert weight in the FORWARD layout [e, in, out]: bf16 checkpoints
+    store it directly; MXFP4 checkpoints store `<base>_blocks`/`_scales`
+    in [e, out, in/32-groups] and dequantize + transpose (matching the
+    HF dequant's final transpose(1, 2))."""
+    names = reader.names()
+    if base in names:
+        return reader.get(base).astype(dtype)
+    deq = mxfp4_dequant(reader.get(base + "_blocks"),
+                        reader.get(base + "_scales"))
+    return np.ascontiguousarray(np.swapaxes(deq, 1, 2)).astype(dtype)
+
+
+def _load_gptoss(reader: "ShardReader", config: ModelConfig) -> dict:
+    """gpt-oss checkpoint -> param tree (handles both bf16 and MXFP4
+    expert storage)."""
+    dtype = np.dtype(config.dtype)
+    h, hd = config.hidden, config.head_dim
+    qh, kh = config.n_q_heads, config.n_kv_heads
+
+    def lin(name: str, heads: int) -> np.ndarray:
+        w = reader.get(name)  # [heads*hd, h]
+        return np.ascontiguousarray(
+            w.T.reshape(h, heads, hd)).astype(dtype)
+
+    params: dict = {
+        "embed": reader.get("model.embed_tokens.weight").astype(dtype),
+        "final_norm": reader.get("model.norm.weight").astype(dtype),
+        "layers": [],
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = np.ascontiguousarray(
+            reader.get("lm_head.weight").T).astype(dtype)
+    for i in range(config.n_layers):
+        p = f"model.layers.{i}."
+        a = p + "self_attn."
+        wo = reader.get(a + "o_proj.weight")  # [h, qh*hd]
+        layer = {
+            "attn_norm": reader.get(p + "input_layernorm.weight"
+                                    ).astype(dtype),
+            "mlp_norm": reader.get(p + "post_attention_layernorm.weight"
+                                   ).astype(dtype),
+            "wq": lin(a + "q_proj.weight", qh),
+            "wk": lin(a + "k_proj.weight", kh),
+            "wv": lin(a + "v_proj.weight", kh),
+            "wo": np.ascontiguousarray(
+                wo.T.reshape(qh, hd, h)).astype(dtype),
+            "bq": reader.get(a + "q_proj.bias").reshape(qh, hd
+                                                        ).astype(dtype),
+            "bk": reader.get(a + "k_proj.bias").reshape(kh, hd
+                                                        ).astype(dtype),
+            "bv": reader.get(a + "v_proj.bias").reshape(kh, hd
+                                                        ).astype(dtype),
+            "bo": reader.get(a + "o_proj.bias").astype(dtype),
+            "sinks": reader.get(a + "sinks").astype(dtype),
+            "router": np.ascontiguousarray(
+                reader.get(p + "mlp.router.weight").T).astype(dtype),
+            "router_bias": reader.get(p + "mlp.router.bias"
+                                      ).astype(dtype),
+            "e_gate_up": _gptoss_expert_tensor(
+                reader, p + "mlp.experts.gate_up_proj", dtype),
+            "e_gate_up_bias": reader.get(
+                p + "mlp.experts.gate_up_proj_bias").astype(dtype),
+            "e_down": _gptoss_expert_tensor(
+                reader, p + "mlp.experts.down_proj", dtype),
+            "e_down_bias": reader.get(
+                p + "mlp.experts.down_proj_bias").astype(dtype),
+        }
+        params["layers"].append(layer)
+    return params
+
+
 def load_params(path: str, config: ModelConfig) -> dict:
     """Read an HF safetensors checkpoint into the param pytree (host numpy
     arrays, cast to config.dtype). Raises on missing/mis-shaped tensors —
     serving silently-random weights is never acceptable once a model path
     was given."""
+    if config.is_gptoss:
+        with ShardReader(path) as reader:
+            params = _load_gptoss(reader, config)
+        log.info("loaded gpt-oss checkpoint %s", path)
+        return params
     if config.is_mla:
         with ShardReader(path) as reader:
             params = _load_deepseek(reader, config)

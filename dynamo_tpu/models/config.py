@@ -61,6 +61,32 @@ class ModelConfig:
     mla_rope_head_dim: int = 0
     mla_nope_head_dim: int = 0
     mla_v_head_dim: int = 0
+    # gpt-oss family (ref workload: recipes/ gpt-oss entries; parsers
+    # lib/parsers/src/tool_calling/harmony/). attn_sinks is the family
+    # marker: learned per-head sink logits join the softmax denominator;
+    # even-indexed layers use a sliding window (HF layer_types pattern);
+    # projections carry biases; experts use the clipped gated-swiglu
+    # (clamp + sigmoid(alpha*x)) with fused gate_up weights; rope is YaRN.
+    attn_sinks: bool = False
+    sliding_window: int = 0  # even layers sliding when attn_sinks
+    attn_bias: bool = False
+    swiglu_limit: float = 0.0  # 0 = plain silu*up
+    swiglu_alpha: float = 1.702
+    rope_yarn_factor: float = 0.0  # 0 = no yarn scaling
+    rope_yarn_beta_fast: float = 32.0
+    rope_yarn_beta_slow: float = 1.0
+    rope_yarn_orig_max: int = 4096
+
+    @property
+    def is_gptoss(self) -> bool:
+        return self.attn_sinks
+
+    def layer_sliding_window(self, layer_idx: int) -> int:
+        """Per-layer window (0 = full attention). gpt-oss alternates
+        sliding/full starting with sliding at layer 0 (HF layer_types)."""
+        if not self.attn_sinks or not self.sliding_window:
+            return 0
+        return self.sliding_window if layer_idx % 2 == 0 else 0
 
     def layer_is_moe(self, layer_idx: int) -> bool:
         """DeepSeek-style mixed stacks: layers below first_k_dense keep a
@@ -133,6 +159,14 @@ PRESETS: dict[str, ModelConfig] = {
         n_q_heads=32, n_kv_heads=8, head_dim=128, mlp_hidden=14336,
         rope_theta=5e5, tie_embeddings=False, max_context=8192,
     ),
+    # Mistral-7B-v0.3 (ref serves it via the vLLM adapter; the 7-8B-class
+    # config that actually FITS a 16GB single chip in bf16 — llama3-8b's
+    # 128k vocab pushes it to 16.06GB, over the v5e HBM line)
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32768, hidden=4096, n_layers=32,
+        n_q_heads=32, n_kv_heads=8, head_dim=128, mlp_hidden=14336,
+        rope_theta=1e6, tie_embeddings=False, max_context=8192,
+    ),
     # Llama-3-70B (ref workload: recipes/llama-3-70b, BASELINE config 3)
     "llama3-70b": ModelConfig(
         name="llama3-70b", vocab_size=128256, hidden=8192, n_layers=80,
@@ -160,6 +194,26 @@ PRESETS: dict[str, ModelConfig] = {
         n_q_heads=64, n_kv_heads=8, head_dim=64, mlp_hidden=2880,
         rope_theta=1.5e5, tie_embeddings=False, max_context=131072,
         n_experts=128, n_experts_active=4, expert_mlp_hidden=2880,
+        attn_sinks=True, sliding_window=128, attn_bias=True,
+        swiglu_limit=7.0, rope_yarn_factor=32.0, rope_yarn_orig_max=4096,
+    ),
+    # gpt-oss-20b: same family, 24 layers / 32 experts
+    "gpt-oss-20b": ModelConfig(
+        name="gpt-oss-20b", vocab_size=201088, hidden=2880, n_layers=24,
+        n_q_heads=64, n_kv_heads=8, head_dim=64, mlp_hidden=2880,
+        rope_theta=1.5e5, tie_embeddings=False, max_context=131072,
+        n_experts=32, n_experts_active=4, expert_mlp_hidden=2880,
+        attn_sinks=True, sliding_window=128, attn_bias=True,
+        swiglu_limit=7.0, rope_yarn_factor=32.0, rope_yarn_orig_max=4096,
+    ),
+    # tiny gpt-oss for CI (sinks, sliding, biases, clipped swiglu, yarn)
+    "tiny-gptoss-test": ModelConfig(
+        name="tiny-gptoss-test", vocab_size=512, hidden=64, n_layers=4,
+        n_q_heads=4, n_kv_heads=2, head_dim=16, mlp_hidden=64,
+        tie_embeddings=False, max_context=256,
+        n_experts=4, n_experts_active=2, expert_mlp_hidden=64,
+        attn_sinks=True, sliding_window=16, attn_bias=True,
+        swiglu_limit=7.0, rope_yarn_factor=8.0, rope_yarn_orig_max=64,
     ),
     # DeepSeek-V2-Lite class: MLA latent attention + MoE (the reference's
     # headline DeepSeek-R1 recipes use the full-size sibling)

@@ -68,6 +68,23 @@ def param_axes(config: ModelConfig) -> dict:
         layer["k_norm"] = ("head_dim",)
     def layer_axes(i: int) -> dict:
         out = dict(layer)
+        if config.is_gptoss:
+            for name in ("w_gate", "w_up", "w_down"):
+                out.pop(name, None)
+            out.update({
+                "bq": ("q_heads", "head_dim"),
+                "bk": ("kv_heads", "head_dim"),
+                "bv": ("kv_heads", "head_dim"),
+                "bo": ("embed",),
+                "sinks": ("q_heads",),
+                "router": ("embed", "experts"),
+                "router_bias": ("experts",),
+                "e_gate_up": ("experts", "embed", "mlp"),
+                "e_gate_up_bias": ("experts", "mlp"),
+                "e_down": ("experts", "mlp", "embed"),
+                "e_down_bias": ("experts", "embed"),
+            })
+            return out
         if config.layer_is_moe(i):
             out["router"] = ("embed", "experts")
             if config.moe_scoring == "sigmoid":
@@ -141,6 +158,24 @@ def init_params(key: jax.Array, config: ModelConfig) -> dict:
         if config.qk_norm:
             p["q_norm"] = jnp.ones((hd,), dtype)
             p["k_norm"] = jnp.ones((hd,), dtype)
+        if config.is_gptoss:
+            e, em = config.n_experts, config.expert_mlp_hidden or m
+            for name in ("w_gate", "w_up", "w_down"):
+                p.pop(name, None)  # experts replace the dense MLP
+            p.update({
+                "bq": dense(ks[7], (qh, hd), h) * 0.02,
+                "bk": dense(ks[8], (kh, hd), h) * 0.02,
+                "bv": dense(ks[9], (kh, hd), h) * 0.02,
+                "bo": dense(ks[10], (h,), h) * 0.02,
+                "sinks": dense(ks[11], (qh,), 1),
+                "router": dense(ks[12], (h, e), h),
+                "router_bias": jnp.zeros((e,), dtype),
+                "e_gate_up": dense(ks[13], (e, h, 2 * em), h),
+                "e_gate_up_bias": jnp.zeros((e, 2 * em), dtype),
+                "e_down": dense(ks[14], (e, em, h), em),
+                "e_down_bias": jnp.zeros((e, h), dtype),
+            })
+            return p
         if config.layer_is_moe(layer_idx):
             e, em = config.n_experts, config.expert_mlp_hidden or m
             p["router"] = dense(ks[7], (h, e), h)
@@ -250,6 +285,151 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     )
     return out.astype(x.dtype)
+
+
+def yarn_rope_tables(config: ModelConfig) -> tuple[jax.Array, float]:
+    """YaRN-scaled inverse frequencies + cos/sin attention factor,
+    matching HF `_compute_yarn_parameters` (gpt-oss: truncate=False,
+    attention_factor = 0.1*ln(factor)+1). Returns (inv_freq [hd/2],
+    attention_factor)."""
+    dim = config.head_dim
+    base = config.rope_theta
+    factor = config.rope_yarn_factor
+    orig_max = config.rope_yarn_orig_max
+
+    def correction_dim(num_rot):
+        return (dim * math.log(orig_max / (num_rot * 2 * math.pi))
+                ) / (2 * math.log(base))
+
+    low = max(correction_dim(config.rope_yarn_beta_fast), 0)
+    high = min(correction_dim(config.rope_yarn_beta_slow), dim - 1)
+    if low == high:
+        high += 0.001
+    pos_freqs = base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+    ramp = jnp.clip(
+        (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low),
+        0, 1)
+    extrap_factor = 1.0 - ramp
+    inv_freq = interp * (1 - extrap_factor) + extrap * extrap_factor
+    attention_factor = 0.1 * math.log(factor) + 1.0
+    return inv_freq, attention_factor
+
+
+def rope_gptoss(x: jax.Array, positions: jax.Array,
+                config: ModelConfig) -> jax.Array:
+    """Rotary embedding with YaRN scaling (same half-split rotate form
+    as rope(); cos/sin scaled by the YaRN attention factor)."""
+    inv_freq, att = yarn_rope_tables(config)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    cos = (jnp.cos(angles) * att)[..., None, :]
+    sin = (jnp.sin(angles) * att)[..., None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def paged_attention_sinks_xla(
+    q: jax.Array,  # [B, T, qh, hd]
+    kv_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,
+    positions: jax.Array,  # [B, T]
+    kv_lens: jax.Array,
+    sinks: jax.Array,  # [qh] learned sink logits
+    window: int,  # 0 = full attention
+) -> jax.Array:
+    """gpt-oss attention: a per-head SINK logit joins the softmax (its
+    probability is dropped — attention mass can 'park' on the sink,
+    ref HF eager_attention_forward), with an optional sliding window
+    (kv position > query position - window)."""
+    values, _scales = _kv_parts(kv_cache)
+    b, t, qh, hd = q.shape
+    ps = values.shape[3]
+    kh = values.shape[4]
+    max_pages = block_tables.shape[1]
+    ctx = max_pages * ps
+    k = values[layer, 0][block_tables].reshape(b, ctx, kh, hd)
+    v = values[layer, 1][block_tables].reshape(b, ctx, kh, hd)
+    group = qh // kh
+    qg = q.reshape(b, t, kh, group, hd)
+    scores = jnp.einsum("btkgh,bskh->btkgs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    kv_pos = jnp.arange(ctx)[None, :]
+    mask = (kv_pos[:, None, :] <= positions[..., None]) & (
+        kv_pos[:, None, :] < kv_lens[:, None, None])
+    if window:
+        mask = mask & (kv_pos[:, None, :]
+                       > positions[..., None] - window)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    sink = sinks.astype(jnp.float32).reshape(kh, group)[None, None, :, :,
+                                                        None]
+    combined = jnp.concatenate(
+        [scores, jnp.broadcast_to(sink, (b, t, kh, group, 1))], axis=-1)
+    combined = combined - jnp.max(combined, axis=-1, keepdims=True)
+    probs = jax.nn.softmax(combined, axis=-1)[..., :-1]  # drop the sink
+    out = jnp.einsum("btkgs,bskh->btkgh", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, qh, hd).astype(q.dtype)
+
+
+def _moe_gptoss(x: jax.Array, p: dict, config: ModelConfig) -> jax.Array:
+    """gpt-oss MoE: biased router, softmax over the TOP-K logits, fused
+    gate_up experts with the clipped gated swiglu
+    (ref HF GptOssExperts/GptOssTopKRouter). Dense-over-experts compute
+    (every expert for every token, masked) — matches HF's inference
+    path; capacity dispatch over the ep axis is the optimization path
+    shared with _moe once sharded."""
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                        p["router"].astype(jnp.float32)) \
+        + p["router_bias"].astype(jnp.float32)
+    topv, topi = jax.lax.top_k(logits, config.n_experts_active)
+    topw = jax.nn.softmax(topv, axis=-1)
+    b, t, _ = x.shape
+    mask = jnp.zeros((b, t, config.n_experts), jnp.float32).at[
+        jnp.arange(b)[:, None, None], jnp.arange(t)[None, :, None], topi
+    ].set(topw)
+    gate_up = jnp.einsum("bth,ehm->betm", x, p["e_gate_up"]) \
+        + p["e_gate_up_bias"][None, :, None, :].astype(x.dtype)
+    gate = gate_up[..., ::2]
+    up = gate_up[..., 1::2]
+    limit = config.swiglu_limit
+    gate = jnp.clip(gate.astype(jnp.float32), max=limit)
+    up = jnp.clip(up.astype(jnp.float32), min=-limit, max=limit)
+    glu = gate * jax.nn.sigmoid(gate * config.swiglu_alpha)
+    act = ((up + 1.0) * glu).astype(x.dtype)
+    expert_out = jnp.einsum("betm,emh->beth", act, p["e_down"]) \
+        + p["e_down_bias"][None, :, None, :].astype(x.dtype)
+    return jnp.einsum("beth,bte->bth", expert_out.astype(jnp.float32),
+                      mask).astype(x.dtype)
+
+
+def _gptoss_attention_block(
+    h: jax.Array,  # [B, T, H] (attn-normed)
+    lp: dict,
+    config: ModelConfig,
+    kv_cache: jax.Array,
+    layer_idx: int,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    valid: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """qkv with biases, YaRN rope, sink attention with the per-layer
+    sliding window; returns (kv_cache, attn [B, T, qh, hd])."""
+    q = jnp.einsum("bth,hqd->btqd", h, lp["wq"]) + lp["bq"]
+    k = jnp.einsum("bth,hkd->btkd", h, lp["wk"]) + lp["bk"]
+    v = jnp.einsum("bth,hkd->btkd", h, lp["wv"]) + lp["bv"]
+    q = rope_gptoss(q, positions, config)
+    k = rope_gptoss(k, positions, config)
+    kv_cache = write_kv_pages(kv_cache, layer_idx, k, v, block_tables,
+                              positions, valid)
+    attn = paged_attention_sinks_xla(
+        q, kv_cache, layer_idx, block_tables, positions, kv_lens,
+        lp["sinks"], config.layer_sliding_window(layer_idx))
+    return kv_cache, attn
 
 
 def _swiglu(x: jax.Array, p: dict, lora_layer: Optional[dict] = None,
@@ -1134,7 +1314,11 @@ def forward(
     for layer_idx, lp in enumerate(params["layers"]):
         ll = lora["layers"][layer_idx] if lora is not None else {}
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-        if config.is_mla:
+        if config.is_gptoss:
+            kv_cache, attn = _gptoss_attention_block(
+                h, lp, config, kv_cache, layer_idx, block_tables,
+                positions, kv_lens, valid)
+        elif config.is_mla:
             kv_cache, attn = _mla_attention_block(
                 h, lp, config, kv_cache, layer_idx, block_tables,
                 positions, kv_lens, valid,
@@ -1159,12 +1343,16 @@ def forward(
             attn = attention(q, kv_cache, layer_idx, block_tables,
                              positions, kv_lens)
         attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        if "bo" in lp:
+            attn_out = attn_out + lp["bo"]
         if "wo" in ll:
             attn_out = attn_out + _lora_delta(
                 attn.reshape(b, t, -1), ll["wo"], lora_idx)
         x = x + attn_out
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
+        if config.is_gptoss:
+            x = x + _moe_gptoss(h, lp, config)
+        elif "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
         else:
             x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
