@@ -89,9 +89,8 @@ class ModelDeploymentCard:
 
 async def publish_card(runtime, card: ModelDeploymentCard, instance_id: int) -> None:
     """Attach a model card under the runtime lease (ref: LocalModel.attach)."""
-    await runtime.discovery.put(card.card_key(instance_id), card.to_wire(),
-                                runtime.lease)
+    await runtime.put_leased(card.card_key(instance_id), card.to_wire())
 
 
 async def unpublish_card(runtime, card: ModelDeploymentCard, instance_id: int) -> None:
-    await runtime.discovery.delete(card.card_key(instance_id))
+    await runtime.delete_leased(card.card_key(instance_id))

@@ -144,7 +144,7 @@ class ServedEndpoint:
             "started_at": time.time(),
             "metadata": self.metadata,
         }
-        await runtime.discovery.put(self.instance_key, self.record, runtime.lease)
+        await runtime.put_leased(self.instance_key, self.record)
         runtime.track_served(self)
         log.info("serving %s instance=%x at %s", self.endpoint.subject,
                  self.instance_id, runtime.request_server.address)
@@ -185,7 +185,7 @@ class ServedEndpoint:
         GracefulShutdownTracker lib/runtime/src/distributed.rs:18)."""
         self._shutting_down = True
         runtime = self.endpoint.runtime
-        await runtime.discovery.delete(self.instance_key)
+        await runtime.delete_leased(self.instance_key)
         if self._graceful and self._inflight > 0:
             try:
                 await asyncio.wait_for(self._drained.wait(), drain_timeout)

@@ -83,6 +83,14 @@ _register("DYNT_REQUEST_TIMEOUT_SECS", 600.0, _float,
           "Per-request end-to-end timeout on the request plane")
 _register("DYNT_CONNECT_TIMEOUT_SECS", 5.0, _float,
           "TCP connect timeout for request-plane clients")
+_register("DYNT_STREAM_IDLE_TIMEOUT_SECS", 120.0, _float,
+          "Max gap between response frames on a streaming request before "
+          "the client declares the worker black-holed (network partition/"
+          "SIGSTOP: the connection stays open but nothing flows). Fires "
+          "asyncio.TimeoutError -> the router fault-marks the instance "
+          "and Migration replays the stream on a peer. Must exceed the "
+          "longest legitimate inter-token stall (a cold mid-stream "
+          "compile). 0 disables")
 
 # Event plane
 _register("DYNT_EVENT_PLANE", "zmq", _str,

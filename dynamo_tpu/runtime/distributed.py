@@ -64,6 +64,12 @@ class DistributedRuntime:
         self._publishers: list[EventPublisher] = []
         self._started = False
         self._lease_lost = asyncio.Event()
+        # Everything put under the runtime lease, for re-registration
+        # after a discovery outage (a restarted/recovered backend knows
+        # nothing about us — lease re-grant must replay these records or
+        # the process stays deregistered forever; ref:
+        # tests/fault_tolerance/etcd_ha recovery contract).
+        self._leased_records: dict[str, dict] = {}
 
     async def start(self) -> "DistributedRuntime":
         if self._started:
@@ -81,10 +87,24 @@ class DistributedRuntime:
                  self.status_server.port if self.status_server else None)
         return self
 
+    async def put_leased(self, key: str, value: dict) -> None:
+        """Put under the runtime lease AND record it for re-registration
+        after a discovery outage."""
+        self._leased_records[key] = value
+        await self.discovery.put(key, value, self.lease)
+
+    async def delete_leased(self, key: str) -> None:
+        self._leased_records.pop(key, None)
+        await self.discovery.delete(key)
+
     async def _keepalive_loop(self) -> None:
         """Refresh the lease at TTL/3 (ref: etcd lease keep-alive,
-        transports/etcd.rs). On persistent failure the process's instances
-        will expire cluster-wide; we flag it locally too."""
+        transports/etcd.rs). A lost lease (discovery outage past the TTL,
+        or a restarted backend that forgot us) triggers RECOVERY: grant a
+        fresh lease and replay every leased record, so the process
+        re-registers cluster-wide instead of staying dark (ref:
+        tests/fault_tolerance/etcd_ha — serving must resume after the
+        discovery plane comes back)."""
         assert self.lease is not None
         interval = max(0.05, self.lease.ttl / 3.0)
         while True:
@@ -92,11 +112,38 @@ class DistributedRuntime:
             try:
                 await self.discovery.keep_alive(self.lease)
             except LeaseExpired:
-                log.error("discovery lease expired — instances deregistered")
+                log.error("discovery lease expired — re-granting and "
+                          "re-registering %d records",
+                          len(self._leased_records))
                 self._lease_lost.set()
-                return
+                await self._recover_lease()
             except Exception as exc:  # noqa: BLE001 — transient backends
                 log.warning("lease keep-alive failed: %s", exc)
+
+    async def _recover_lease(self) -> None:
+        backoff = 0.2
+        while True:
+            try:
+                self.lease = await self.discovery.create_lease(
+                    self.config.lease_ttl_secs)
+                for key, value in list(self._leased_records.items()):
+                    if key not in self._leased_records:
+                        # delete_leased ran while we replayed (an
+                        # endpoint shut down mid-recovery): re-putting
+                        # would resurrect a dead record under the fresh
+                        # lease with nothing left to delete it.
+                        continue
+                    await self.discovery.put(key, value, self.lease)
+                self._lease_lost.clear()
+                log.info("lease re-granted (%s); %d records re-registered",
+                         self.lease.lease_id, len(self._leased_records))
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — backend still down
+                log.warning("lease recovery attempt failed: %s", exc)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
 
     def namespace(self, name: str) -> Namespace:
         return Namespace(self, name)
@@ -115,7 +162,9 @@ class DistributedRuntime:
             self._publishers.append(publisher)
             return publisher
         publisher = ZmqEventPublisher(namespace, self.discovery, self.lease,
-                                      host=self.config.zmq_host)
+                                      host=self.config.zmq_host,
+                                      put_leased=self.put_leased,
+                                      delete_leased=self.delete_leased)
         self._publishers.append(publisher)
         return publisher
 

@@ -123,7 +123,8 @@ class ZmqEventPublisher(EventPublisher):
     under the runtime's lease, so subscribers find it and crashes clean up."""
 
     def __init__(self, namespace: str, discovery: Discovery, lease: Optional[Lease],
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1", put_leased=None,
+                 delete_leased=None) -> None:
         import zmq
         import zmq.asyncio
 
@@ -135,14 +136,22 @@ class ZmqEventPublisher(EventPublisher):
         self._namespace = namespace
         self._discovery = discovery
         self._lease = lease
+        # Runtime-tracked put/delete: the advertisement survives a
+        # discovery outage (lease re-grant replays it) AND close() drops
+        # it from the replay set — a raw delete would leave the record
+        # behind for recovery to resurrect. The raw path remains for
+        # lease-less/test construction.
+        self._put_leased = put_leased
+        self._delete_leased = delete_leased
         self._advertised = False
 
     async def advertise(self) -> None:
-        await self._discovery.put(
-            f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}",
-            {"address": self.address},
-            self._lease,
-        )
+        key = f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}"
+        value = {"address": self.address}
+        if self._put_leased is not None:
+            await self._put_leased(key, value)
+        else:
+            await self._discovery.put(key, value, self._lease)
         self._advertised = True
         # PUB/SUB joins are async; give late subscribers a chance on first use.
         await asyncio.sleep(0)
@@ -155,10 +164,12 @@ class ZmqEventPublisher(EventPublisher):
         )
 
     async def close(self) -> None:
+        key = f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}"
         try:
-            await self._discovery.delete(
-                f"{EVENT_PREFIX}/{self._namespace}/{self.publisher_id}"
-            )
+            if self._delete_leased is not None:
+                await self._delete_leased(key)
+            else:
+                await self._discovery.delete(key)
         except Exception:  # noqa: BLE001 — discovery may already be closed
             pass
         self._sock.close(0)
@@ -454,6 +465,11 @@ class JournalEventSubscriberManager:
                 offset = 0  # new generation: replay from its start
             new_offset = self._read_frames(pub, gen, offset, pub_out)
             if new_offset is not None:
+                if cur_gen < 0 and pub_out:
+                    # First contact with this publisher's log: the
+                    # durable-replay property restarted routers rely on.
+                    log.info("journal replay: %d events from publisher "
+                             "%s (gen %d)", len(pub_out), pub, gen)
                 self._positions[pub] = (gen, new_offset)
                 out.extend(pub_out)
         return out

@@ -110,9 +110,8 @@ class HealthCheckManager:
                          served.endpoint.subject, iid)
                 self._deregistered.discard(iid)
                 try:
-                    await self.runtime.discovery.put(
-                        served.instance_key, served.record,
-                        self.runtime.lease)
+                    await self.runtime.put_leased(
+                        served.instance_key, served.record)
                 except Exception:  # noqa: BLE001 — retried next sweep
                     self._deregistered.add(iid)
             return
@@ -125,6 +124,6 @@ class HealthCheckManager:
                 served.endpoint.subject, iid, failures)
             self._deregistered.add(iid)
             try:
-                await self.runtime.discovery.delete(served.instance_key)
+                await self.runtime.delete_leased(served.instance_key)
             except Exception:  # noqa: BLE001 — best-effort deregistration
                 pass

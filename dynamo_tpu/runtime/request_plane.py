@@ -315,9 +315,16 @@ class TcpRequestClient:
         try:
             await conn.send({"t": "req", "i": rid, "s": subject, "h": headers or {}},
                             codec.pack_body(body))
+            from .config import env
+
+            # A black-holed worker (network partition, SIGSTOP) keeps the
+            # connection open while nothing flows; the idle timeout turns
+            # that silent hang into a TimeoutError the router fault-marks
+            # and Migration recovers from.
+            idle = env("DYNT_STREAM_IDLE_TIMEOUT_SECS") or None
             first = True
             while True:
-                timeout = first_item_timeout if first else None
+                timeout = first_item_timeout if first else idle
                 if timeout is not None:
                     header, payload = await asyncio.wait_for(queue.get(), timeout)
                 else:
@@ -341,10 +348,16 @@ class TcpRequestClient:
             conn.streams.pop(rid, None)
             # Propagate cancellation to the server only if the stream did not
             # finish cleanly (no redundant frame on the per-request hot path).
+            # Bounded: a black-holed peer (the very case the idle timeout
+            # just detected) has a full socket buffer — an unbounded
+            # drain() here would swallow the TimeoutError AND deadlock
+            # every sender queued on this connection's send lock.
             if not ended and not conn.closed:
                 try:
-                    await conn.send({"t": "cancel", "i": rid})
-                except (ConnectionLost, ConnectionResetError):
+                    await asyncio.wait_for(
+                        conn.send({"t": "cancel", "i": rid}), 2.0)
+                except (ConnectionLost, ConnectionResetError,
+                        asyncio.TimeoutError):
                     pass
 
     async def close(self) -> None:
@@ -593,16 +606,27 @@ class HttpRequestClient:
                 out, buf = buf[:n], buf[n:]
                 return out
 
-            while True:
-                read_head = _read(8)
-                if first and first_item_timeout is not None:
-                    head = await asyncio.wait_for(read_head,
-                                                  first_item_timeout)
-                else:
-                    head = await read_head
+            from .config import env
+
+            idle = env("DYNT_STREAM_IDLE_TIMEOUT_SECS") or None
+
+            async def _read_frame():
+                head = await _read(8)
                 hlen, plen = struct.unpack(">II", head)
                 frame = codec.unpack_body(await _read(hlen))
                 payload = await _read(plen) if plen else b""
+                return frame, payload
+
+            while True:
+                # Timeout covers the WHOLE frame: a peer black-holed
+                # mid-frame (head delivered, body never) must still trip
+                # the idle timeout.
+                timeout = first_item_timeout if first else idle
+                if timeout is not None:
+                    frame, payload = await asyncio.wait_for(_read_frame(),
+                                                            timeout)
+                else:
+                    frame, payload = await _read_frame()
                 first = False
                 ftype = frame.get("t")
                 if ftype == "data":

@@ -1,0 +1,68 @@
+"""Shared helpers for the chaos/fault-injection tiers (real OS
+processes): spawn with log capture, readiness polls, teardown."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(module_or_script, *args, env, log_path=None, script=False):
+    out = open(log_path, "w") if log_path else subprocess.DEVNULL
+    cmd = ([sys.executable, "-u", module_or_script, *args] if script
+           else [sys.executable, "-u", "-m", module_or_script, *args])
+    return subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                            env=env, cwd=REPO)
+
+
+async def wait_models(session, base, model, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            async with session.get(base + "/v1/models") as resp:
+                body = await resp.json()
+                if any(m["id"] == model for m in body.get("data", [])):
+                    return True
+        except Exception:  # noqa: BLE001 — not up yet
+            pass
+        await asyncio.sleep(0.5)
+    return False
+
+
+async def chat(session, base, model, content, max_tokens=8, timeout=60):
+    async with session.post(
+            base + "/v1/chat/completions",
+            json={"model": model, "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content": content}]},
+            timeout=timeout) as resp:
+        body = await resp.json()
+        assert resp.status == 200, body
+        return body["choices"][0]["message"]["content"]
+
+
+def wait_port(port, timeout=30.0):
+    import socket
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass

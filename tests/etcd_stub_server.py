@@ -1,0 +1,22 @@
+"""Standalone etcd v3 gateway stub for chaos/fault-injection tests:
+`python tests/etcd_stub_server.py PORT` serves tests.test_etcd_discovery.
+StubEtcd on a FIXED port until killed — so a test can SIGKILL it
+mid-serving and restart an EMPTY one on the same port (the etcd-HA
+outage scenario, ref: tests/fault_tolerance/etcd_ha/)."""
+
+import asyncio
+import sys
+
+
+async def main() -> None:
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from tests.test_etcd_discovery import StubEtcd
+
+    stub = StubEtcd()
+    await stub.start(port=int(sys.argv[1]))
+    print(f"stub etcd up on {stub.port}", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

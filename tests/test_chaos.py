@@ -24,28 +24,11 @@ pytestmark = pytest.mark.skipif(
     os.environ.get("DYNT_SKIP_CHAOS") == "1",
     reason="chaos tier disabled")
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _spawn(module, *args, env):
-    return subprocess.Popen(
-        [sys.executable, "-m", module, *args],
-        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
-        env=env, cwd=REPO)
-
-
-async def _wait_models(session, base, model, timeout=120.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            async with session.get(base + "/v1/models") as resp:
-                body = await resp.json()
-                if any(m["id"] == model for m in body.get("data", [])):
-                    return True
-        except Exception:  # noqa: BLE001 — not up yet
-            pass
-        await asyncio.sleep(0.5)
-    return False
+from tests.chaos_util import (  # noqa: E402
+    REPO,
+    spawn as _spawn,
+    wait_models as _wait_models,
+)
 
 
 class TestKillNineMidStream:

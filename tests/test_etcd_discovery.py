@@ -45,7 +45,7 @@ class StubEtcd:
         self._runner = None
         self._reaper = None
 
-    async def start(self):
+    async def start(self, port: int = 0):
         from aiohttp import web
 
         app = web.Application()
@@ -60,7 +60,7 @@ class StubEtcd:
         # wait the default 60s for them.
         self._runner = web.AppRunner(app, shutdown_timeout=0.25)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         self._reaper = asyncio.create_task(self._reap_loop())
