@@ -85,49 +85,161 @@ class DiskArena:
         del self._slab
 
 
-class ObjectStore:
-    """G4: unbounded blob store keyed by sequence hash. One file per block
-    under a sharded directory tree; `root` may be a GCS FUSE mountpoint.
-    Opaque to layout optimizations, exactly like the reference treats G4."""
+class TransientStorageError(Exception):
+    """Retryable object-store failure (timeout, 5xx, flaky mount)."""
 
-    def __init__(self, spec: BlockLayoutSpec, root: str) -> None:
-        if root.startswith("gs://"):
+
+class FsObjectStoreClient:
+    """Filesystem/FUSE-mount client; `root` may be a gcsfuse mountpoint.
+    Keys may contain '/' — treated as directory separators under root
+    (ObjectStore's keys preserve the original sharded on-disk layout,
+    `<2-hex-shard>/v<N>-<hash>.npy`, so pre-existing tiers keep
+    resolving). Transient I/O errors (EIO from a flaky mount, timeouts)
+    surface as TransientStorageError so the store's retry machinery
+    applies; only a clean miss is None."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        assert ".." not in key and not key.startswith("/"), key
+        return os.path.join(self.root, *key.split("/"))
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic: no partial blobs visible
+        except OSError as exc:
+            raise TransientStorageError(f"put {key}: {exc}") from exc
+
+    def get_bytes(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as exc:
+            raise TransientStorageError(f"get {key}: {exc}") from exc
+
+    def exists(self, key: str) -> bool:
+        try:
+            return os.path.exists(self._path(key))
+        except OSError as exc:
+            raise TransientStorageError(f"exists {key}: {exc}") from exc
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+        except OSError as exc:
+            raise TransientStorageError(f"delete {key}: {exc}") from exc
+
+
+class ObjectStore:
+    """G4: unbounded blob store keyed by sequence hash, over a pluggable
+    CLIENT (ref: the reference reaches remote G4 through NIXL-plugged
+    backends — kvbm-design.md §Remote Memory Integration). The store
+    owns the semantics clients shouldn't: bounded retries on transient
+    errors, corrupt/partial-read detection (a non-atomic backend can
+    surface truncated objects), and key versioning. `backend` is a root
+    path (filesystem/gcsfuse client) or any object with the
+    put_bytes/get_bytes/exists/delete surface — a native GCS/S3 SDK
+    client drops in without touching tiering logic (none ships in this
+    zero-egress image)."""
+
+    def __init__(self, spec: BlockLayoutSpec, backend,
+                 retries: int = 3, backoff: float = 0.05) -> None:
+        if isinstance(backend, str) and backend.startswith("gs://"):
             raise NotImplementedError(
                 "direct GCS access requires the google-cloud-storage client "
                 "(not in this image); mount the bucket (gcsfuse) and pass "
                 "the mountpoint instead")
         self.spec = spec
-        self.root = root
-        os.makedirs(root, exist_ok=True)
+        self.client = (FsObjectStoreClient(backend)
+                       if isinstance(backend, str) else backend)
+        self.retries = retries
+        self.backoff = backoff
+        self.retried_ops = 0
+        self.corrupt_reads = 0
 
-    def _path(self, h: int) -> str:
+    def _key(self, h: int) -> str:
         # Keys carry the block-hash scheme version: a hash-function change
         # (dynamo_tpu.tokens.HASH_VERSION) must never silently mismatch
-        # blobs persisted under the old scheme.
+        # blobs persisted under the old scheme. The shape matches the
+        # pre-abstraction on-disk layout byte-for-byte
+        # (<shard>/v<N>-<fullhash>.npy) so existing G4 tiers stay warm.
         from dynamo_tpu.tokens import HASH_VERSION
 
         hexh = f"{h & ((1 << 64) - 1):016x}"
-        return os.path.join(self.root, hexh[:2], f"v{HASH_VERSION}-{hexh}.npy")
+        return f"{hexh[:2]}/v{HASH_VERSION}-{hexh}.npy"
+
+    def _with_retries(self, op):
+        import time
+
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return op()
+            except TransientStorageError as exc:
+                last = exc
+                if attempt < self.retries:
+                    self.retried_ops += 1
+                    time.sleep(self.backoff * (2 ** attempt))
+        raise last  # type: ignore[misc]
 
     def put(self, h: int, block: np.ndarray) -> None:
-        path = self._path(h)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, block)
-        os.replace(tmp, path)  # atomic: readers never see partial blobs
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(block))
+        data = buf.getvalue()
+        self._with_retries(lambda: self.client.put_bytes(self._key(h), data))
 
     def get(self, h: int) -> Optional[np.ndarray]:
+        import io
+
         try:
-            return np.load(self._path(h))
-        except (FileNotFoundError, ValueError):
+            data = self._with_retries(
+                lambda: self.client.get_bytes(self._key(h)))
+        except TransientStorageError:
+            # Reads degrade to a MISS (prefill compute) rather than
+            # crashing the admission path — G4 is an accelerator, not a
+            # dependency.
             return None
+        if data is None:
+            return None
+        try:
+            arr = np.load(io.BytesIO(data))
+        except (ValueError, EOFError, OSError):
+            arr = None
+        if arr is None or arr.shape != self.spec.block_shape:
+            # Truncated or mis-shaped object (partial write on a
+            # non-atomic backend): treat as a MISS — the caller falls
+            # back to prefill compute — and drop the bad blob so it
+            # cannot keep poisoning reads.
+            self.corrupt_reads += 1
+            try:
+                self.client.delete(self._key(h))
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            return None
+        return arr
 
     def contains(self, h: int) -> bool:
-        return os.path.exists(self._path(h))
+        try:
+            return self._with_retries(
+                lambda: self.client.exists(self._key(h)))
+        except TransientStorageError:
+            return False
 
     def delete(self, h: int) -> None:
         try:
-            os.remove(self._path(h))
-        except FileNotFoundError:
+            self._with_retries(lambda: self.client.delete(self._key(h)))
+        except TransientStorageError:
             pass

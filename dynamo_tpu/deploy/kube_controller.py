@@ -306,6 +306,10 @@ class KubeDeploymentController:
             self._removed.discard(name)
         # list(): the synchronous apply_spec may add/remove services
         # while this loop awaits inside _reconcile_service.
+        # _gc_tick advances once per PASS — a per-service increment with
+        # a fixed iteration order would leave some services permanently
+        # off the modulus and never GC-swept.
+        self._gc_tick += 1
         for name, svc in list(self.spec.services.items()):
             await self._reconcile_service(name, svc)
 
@@ -315,14 +319,29 @@ class KubeDeploymentController:
                     reason)
         await self._req("DELETE", self._url(dep_name))
         self.spec.services[name] = roll.previous
-        if self._revision_of(roll.previous) == rev:
-            # The failed revision came from a GRAPH-LEVEL env change
-            # (same ServiceSpec renders the same broken template):
-            # restore the whole graph env, or reconcile would recreate
-            # the failed revision forever. This also reverts the env for
-            # sibling services — a failed rollout reverts the applied
-            # change as a unit.
+        restored_rev = self._revision_of(roll.previous)
+        if restored_rev == rev:
+            # The restored ServiceSpec re-renders the SAME broken
+            # template — the failure came from the graph env (alone or
+            # combined with the service change): revert the env as a
+            # unit or reconcile recreates the failed revision forever.
             self.spec.env = dict(roll.previous_env)
+        else:
+            # The restored spec under the CURRENT env is a distinct
+            # revision. If it is also not the one still serving (an env
+            # change landed mid-rollout), reaching it is a NEW rollout —
+            # track it so it is readiness-gated and itself rolls back
+            # (to the pre-rollout env) on failure, instead of surging
+            # untracked forever.
+            cur_env = dict(self.spec.env)
+            self.spec.env = dict(roll.previous_env)
+            serving_rev = self._revision_of(roll.previous)
+            self.spec.env = cur_env
+            if restored_rev != serving_rev:
+                self._rollouts[name] = _Rollout(
+                    new_rev=restored_rev, previous=roll.previous,
+                    previous_env=roll.previous_env,
+                    started_at=time.monotonic())
         self.desired[name] = max(
             self.desired.get(name, 0),
             roll.previous.clamp_replicas(roll.previous.replicas))
@@ -391,7 +410,6 @@ class KubeDeploymentController:
         # LIST is only needed while a rollout is in flight (plus a
         # periodic garbage-collection sweep) — steady state stays at one
         # GET per service per pass.
-        self._gc_tick += 1
         if not (roll is not None and roll.state == "progressing"
                 or self._gc_tick % 16 == 0):
             self._observed[name] = ready

@@ -373,3 +373,122 @@ class TestSchedulerIntegration:
             mgr.flush(5.0)
             sched.stop()
             mgr.close()
+
+
+class FakeObjectStoreClient:
+    """Injectable-fault client: transient failures, latency, and
+    truncated (partial-read) objects — the semantics the G4 abstraction
+    must absorb (retries, corrupt-read fallback) regardless of which
+    SDK backs it."""
+
+    def __init__(self, fail_next: int = 0, truncate_next: int = 0,
+                 latency_s: float = 0.0):
+        self.blobs: dict[str, bytes] = {}
+        self.fail_next = fail_next
+        self.truncate_next = truncate_next
+        self.latency_s = latency_s
+        self.calls = 0
+
+    def _maybe_fail(self):
+        import time
+
+        self.calls += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            from dynamo_tpu.block_manager.storage import (
+                TransientStorageError,
+            )
+
+            raise TransientStorageError("injected")
+
+    def put_bytes(self, key, data):
+        self._maybe_fail()
+        self.blobs[key] = data
+
+    def get_bytes(self, key):
+        self._maybe_fail()
+        data = self.blobs.get(key)
+        if data is not None and self.truncate_next > 0:
+            self.truncate_next -= 1
+            return data[: len(data) // 3]  # partial read
+        return data
+
+    def exists(self, key):
+        self._maybe_fail()
+        return key in self.blobs
+
+    def delete(self, key):
+        self._maybe_fail()
+        self.blobs.pop(key, None)
+
+
+class TestObjectStoreClient:
+    def test_retries_transient_failures(self):
+        fake = FakeObjectStoreClient(fail_next=2)
+        store = ObjectStore(SPEC, fake, retries=3, backoff=0.001)
+        block = np.full(SPEC.block_shape, 3.0, SPEC.dtype)
+        store.put(7, block)  # 2 failures then success
+        assert store.retried_ops == 2
+        np.testing.assert_array_equal(store.get(7), block)
+
+    def test_retry_exhaustion_put_raises_get_misses(self):
+        import pytest as _pytest
+
+        from dynamo_tpu.block_manager.storage import TransientStorageError
+
+        fake = FakeObjectStoreClient(fail_next=10)
+        store = ObjectStore(SPEC, fake, retries=2, backoff=0.001)
+        block = np.zeros(SPEC.block_shape, SPEC.dtype)
+        with _pytest.raises(TransientStorageError):
+            store.put(9, block)
+        fake.fail_next = 10
+        assert store.get(9) is None  # degrade to miss, never crash
+
+    def test_partial_read_detected_and_quarantined(self):
+        fake = FakeObjectStoreClient()
+        store = ObjectStore(SPEC, fake, backoff=0.001)
+        block = np.full(SPEC.block_shape, 5.0, SPEC.dtype)
+        store.put(11, block)
+        fake.truncate_next = 1
+        # Truncated object -> miss, blob deleted (not served corrupt).
+        assert store.get(11) is None
+        assert store.corrupt_reads == 1
+        assert not store.contains(11)
+
+    def test_wrong_shape_rejected(self):
+        fake = FakeObjectStoreClient()
+        store = ObjectStore(SPEC, fake, backoff=0.001)
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.zeros((1, 2, 3), np.float32))  # wrong geometry
+        fake.blobs[store._key(13)] = buf.getvalue()
+        assert store.get(13) is None
+        assert store.corrupt_reads == 1
+
+    def test_on_disk_layout_is_stable(self, tmp_path):
+        """The filesystem client must resolve blobs at the ORIGINAL
+        sharded layout (<shard>/v<N>-<fullhash>.npy) under the given
+        root — renaming the scheme would orphan every persisted tier."""
+        import os
+
+        from dynamo_tpu.tokens import HASH_VERSION
+
+        root = str(tmp_path / "g4")
+        h = 123456789
+        hexh = f"{h:016x}"
+        legacy = os.path.join(root, hexh[:2], f"v{HASH_VERSION}-{hexh}.npy")
+        os.makedirs(os.path.dirname(legacy))
+        block = np.full(SPEC.block_shape, 9.0, SPEC.dtype)
+        with open(legacy, "wb") as f:
+            np.save(f, block)
+        store = ObjectStore(SPEC, root)
+        np.testing.assert_array_equal(store.get(h), block)
+        # and writes land INSIDE the root (never at filesystem '/')
+        store.put(h + 1, block)
+        found = [os.path.join(dp, fn) for dp, _dn, fns in os.walk(root)
+                 for fn in fns]
+        assert len(found) == 2
+        assert all(p.startswith(root) for p in found)
