@@ -152,11 +152,23 @@ class KvBlockManager:
         if self.disk is not None:
             self.disk.insert(h, data)
         elif self.object_store is not None:
-            self.object_store.put(h, data)
+            self._g4_put(h, data)
 
     def _on_disk_evict(self, h: int, data: np.ndarray) -> None:
         if self.object_store is not None:
+            self._g4_put(h, data)
+
+    def _g4_put(self, h: int, data: np.ndarray) -> None:
+        """Eviction cascades can run on the SCHEDULER thread (a G4
+        onboard hit promotes into G2, whose eviction lands here); a G4
+        write failure must drop the evicted cache block, never crash the
+        engine loop."""
+        from .storage import TransientStorageError
+
+        try:
             self.object_store.put(h, data)
+        except TransientStorageError:
+            log.warning("G4 put failed; evicted block %x dropped", h)
 
     # -- onboard path (scheduler thread, admission time) -------------------
 

@@ -103,7 +103,8 @@ class FsObjectStoreClient:
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        assert ".." not in key and not key.startswith("/"), key
+        if ".." in key or key.startswith("/"):
+            raise ValueError(f"unsafe object key {key!r}")
         return os.path.join(self.root, *key.split("/"))
 
     def put_bytes(self, key: str, data: bytes) -> None:
@@ -127,8 +128,13 @@ class FsObjectStoreClient:
             raise TransientStorageError(f"get {key}: {exc}") from exc
 
     def exists(self, key: str) -> bool:
+        # os.stat, not os.path.exists: exists() swallows OSError and
+        # would silently report a flaky mount's blobs as absent.
         try:
-            return os.path.exists(self._path(key))
+            os.stat(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
         except OSError as exc:
             raise TransientStorageError(f"exists {key}: {exc}") from exc
 
@@ -205,12 +211,14 @@ class ObjectStore:
         import io
 
         try:
-            data = self._with_retries(
-                lambda: self.client.get_bytes(self._key(h)))
+            # SINGLE attempt, no sleeping retries: reads run on the
+            # scheduler thread under the manager lock at admission time —
+            # they degrade to a MISS (prefill compute) rather than
+            # stalling the engine loop. G4 is an accelerator, not a
+            # dependency; sleeping retries are reserved for the
+            # offload-thread write path.
+            data = self.client.get_bytes(self._key(h))
         except TransientStorageError:
-            # Reads degrade to a MISS (prefill compute) rather than
-            # crashing the admission path — G4 is an accelerator, not a
-            # dependency.
             return None
         if data is None:
             return None
@@ -218,11 +226,14 @@ class ObjectStore:
             arr = np.load(io.BytesIO(data))
         except (ValueError, EOFError, OSError):
             arr = None
-        if arr is None or arr.shape != self.spec.block_shape:
-            # Truncated or mis-shaped object (partial write on a
-            # non-atomic backend): treat as a MISS — the caller falls
-            # back to prefill compute — and drop the bad blob so it
-            # cannot keep poisoning reads.
+        if (arr is None or arr.shape != self.spec.block_shape
+                or arr.dtype != np.dtype(self.spec.dtype)):
+            # Truncated, mis-shaped, or wrong-dtype object (partial
+            # write on a non-atomic backend; a tier persisted under a
+            # different kv_dtype — silently value-casting quantized
+            # bytes into a bf16 arena would onboard garbage KV): treat
+            # as a MISS — the caller falls back to prefill compute —
+            # and drop the bad blob so it cannot keep poisoning reads.
             self.corrupt_reads += 1
             try:
                 self.client.delete(self._key(h))
@@ -233,8 +244,8 @@ class ObjectStore:
 
     def contains(self, h: int) -> bool:
         try:
-            return self._with_retries(
-                lambda: self.client.exists(self._key(h)))
+            # Single attempt, like get(): runs at admission time.
+            return self.client.exists(self._key(h))
         except TransientStorageError:
             return False
 

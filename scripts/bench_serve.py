@@ -1,0 +1,141 @@
+"""Served-path throughput bench: the REAL scheduler + paged pool +
+(optionally) an active KVBM host tier on the real chip — the
+steady-state serving number, not the raw-runner number bench.py owns.
+
+N concurrent requests (ISL/OSL configurable) flow through
+InferenceScheduler with continuous batching; with --kvbm-host-blocks
+the offload worker runs DURING decode (the 'KVBM offload active'
+configuration BASELINE.json's north star describes), so the number
+includes any offload interference.
+
+Usage:
+  python scripts/bench_serve.py --model mistral-7b --batch 4 \
+      --num-pages 256 --requests 12 --isl 256 --osl 64 \
+      --kvbm-host-blocks 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as thread_queue
+import sys
+import threading
+import time
+import uuid
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("bench_serve")
+    parser.add_argument("--model", default="qwen3-0.6b")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=1024)
+    parser.add_argument("--max-pages-per-seq", type=int, default=64)
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--isl", type=int, default=256)
+    parser.add_argument("--osl", type=int, default=64)
+    parser.add_argument("--kv-dtype", default="model")
+    parser.add_argument("--kvbm-host-blocks", type=int, default=0)
+    args = parser.parse_args()
+
+    from dynamo_tpu.engine import InferenceScheduler, ModelRunner, RunnerConfig
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    config = get_config(args.model)
+    runner = ModelRunner(
+        config,
+        RunnerConfig(page_size=args.page_size, num_pages=args.num_pages,
+                     max_batch=args.batch,
+                     max_pages_per_seq=args.max_pages_per_seq,
+                     prefill_buckets=(256,), kv_dtype=args.kv_dtype),
+        make_mesh(MeshConfig()), seed=0)
+    kvbm = None
+    if args.kvbm_host_blocks:
+        from dynamo_tpu.block_manager import (
+            BlockLayoutSpec,
+            KvbmConfig,
+            KvBlockManager,
+        )
+
+        kvbm = KvBlockManager(
+            KvbmConfig(host_blocks=args.kvbm_host_blocks, offload_batch=8),
+            BlockLayoutSpec.from_runner_layout(runner.kv_layout()))
+    sched = InferenceScheduler(runner, kvbm=kvbm)
+    sched.start()
+
+    rng = np.random.default_rng(0)
+    done: thread_queue.Queue = thread_queue.Queue()
+    tokens_out = [0]
+    lock = threading.Lock()
+
+    def submit(i: int) -> None:
+        prompt = rng.integers(2, config.vocab_size - 2,
+                              args.isl).astype(np.int32).tolist()
+
+        def emit(out) -> None:
+            with lock:
+                tokens_out[0] += len(out.token_ids)
+            if out.finish_reason is not None:
+                done.put((i, out.finish_reason, out.error))
+
+        sched.submit(PreprocessedRequest(
+            request_id=uuid.uuid4().hex, token_ids=prompt,
+            sampling=SamplingOptions(max_tokens=args.osl, temperature=0.0),
+            stop=StopConditions(ignore_eos=True)), emit)
+
+    try:
+        # Warmup: one full request compiles prefill + decode. A failed
+        # warmup (capacity rejection etc.) would silently bill the first
+        # measured request for compilation — assert it succeeded.
+        submit(-1)
+        _i, reason, err = done.get(timeout=1200)
+        assert err is None and reason == "length", (reason, err)
+        with lock:
+            tokens_out[0] = 0
+        t0 = time.perf_counter()
+        for i in range(args.requests):
+            submit(i)
+        finished = 0
+        while finished < args.requests:
+            idx, reason, err = done.get(timeout=1200)
+            assert err is None, err
+            finished += 1
+        elapsed = time.perf_counter() - t0
+        out_toks = tokens_out[0]
+        result = {
+            "metric": (f"served decode throughput {args.model} "
+                       f"kv={args.kv_dtype} batch<={args.batch} "
+                       f"isl={args.isl} osl={args.osl}"
+                       + (f" kvbm_g2={args.kvbm_host_blocks}"
+                          if args.kvbm_host_blocks else "")),
+            "requests": args.requests,
+            "output_tokens": out_toks,
+            "output_tokens_per_sec": round(out_toks / elapsed, 1),
+            "total_tokens_per_sec": round(
+                args.requests * (args.isl + args.osl) / elapsed, 1),
+            "wall_s": round(elapsed, 2),
+        }
+        if kvbm is not None:
+            kvbm.flush(60.0)
+            result["kvbm"] = kvbm.usage()
+        print(json.dumps(result), flush=True)
+    finally:
+        sched.stop()
+        if kvbm is not None:
+            kvbm.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -492,3 +492,17 @@ class TestObjectStoreClient:
                  for fn in fns]
         assert len(found) == 2
         assert all(p.startswith(root) for p in found)
+
+    def test_wrong_dtype_rejected(self):
+        """A blob persisted under a different kv_dtype (same shape) must
+        read as a miss — silently value-casting quantized bytes into a
+        bf16 arena would onboard garbage KV."""
+        import io
+
+        fake = FakeObjectStoreClient()
+        store = ObjectStore(SPEC, fake, backoff=0.001)
+        buf = io.BytesIO()
+        np.save(buf, np.zeros(SPEC.block_shape, np.int8))
+        fake.blobs[store._key(17)] = buf.getvalue()
+        assert store.get(17) is None
+        assert store.corrupt_reads == 1
