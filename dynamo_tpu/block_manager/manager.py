@@ -160,13 +160,14 @@ class KvBlockManager:
 
     def _g4_put(self, h: int, data: np.ndarray) -> None:
         """Eviction cascades can run on the SCHEDULER thread (a G4
-        onboard hit promotes into G2, whose eviction lands here); a G4
-        write failure must drop the evicted cache block, never crash the
-        engine loop."""
+        onboard hit promotes into G2, whose eviction lands here), often
+        under the manager lock — so the put is fail_fast (one attempt,
+        no retry sleeps that would stall the engine loop) and a failure
+        drops the evicted cache block instead of crashing."""
         from .storage import TransientStorageError
 
         try:
-            self.object_store.put(h, data)
+            self.object_store.put(h, data, fail_fast=True)
         except TransientStorageError:
             log.warning("G4 put failed; evicted block %x dropped", h)
 

@@ -199,12 +199,19 @@ class ObjectStore:
                     time.sleep(self.backoff * (2 ** attempt))
         raise last  # type: ignore[misc]
 
-    def put(self, h: int, block: np.ndarray) -> None:
+    def put(self, h: int, block: np.ndarray,
+            fail_fast: bool = False) -> None:
+        """fail_fast=True: single attempt, no sleeping retries — for
+        callers on the scheduler thread (the eviction cascade under the
+        manager lock), where a retry sleep stalls the engine loop."""
         import io
 
         buf = io.BytesIO()
         np.save(buf, np.ascontiguousarray(block))
         data = buf.getvalue()
+        if fail_fast:
+            self.client.put_bytes(self._key(h), data)
+            return
         self._with_retries(lambda: self.client.put_bytes(self._key(h), data))
 
     def get(self, h: int) -> Optional[np.ndarray]:

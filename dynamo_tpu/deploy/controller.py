@@ -81,8 +81,17 @@ class LocalDeploymentController:
         log.info("desired replicas: %s -> %d", service, clamped)
 
     def observed(self, service: str) -> int:
-        return len([r for r in self._replicas.get(service, [])
-                    if r.proc.returncode is None])
+        live = [r for r in self._replicas.get(service, [])
+                if r.proc.returncode is None]
+        n = self.spec.services[service].multihost
+        if n > 1:
+            # A gang counts only when COMPLETE (all N ranks alive) —
+            # a partial gang is not a serving replica.
+            gangs: dict[int, int] = {}
+            for r in live:
+                gangs[r.index // n] = gangs.get(r.index // n, 0) + 1
+            return sum(1 for count in gangs.values() if count == n)
+        return len(live)
 
     def status(self) -> dict:
         return {
@@ -98,10 +107,26 @@ class LocalDeploymentController:
 
     # -- reconcile ---------------------------------------------------------
 
+    def _argv_for(self, svc: ServiceSpec, index: int) -> list[str]:
+        """Process argv for replica slot `index`. multihost services
+        treat each REPLICA as a gang of N ranks (the Grove PodCliqueSet
+        analog): slot index -> (gang, rank), with a per-gang coordinator
+        port (+2 per gang: jax.distributed uses port, the step channel
+        port+1)."""
+        if svc.multihost > 1:
+            gang, rank = divmod(index, svc.multihost)
+            port = svc.multihost_port + gang * 2
+            return svc.gang_argv(rank, f"127.0.0.1:{port}")
+        return svc.argv()
+
+    def _procs_wanted(self, svc: ServiceSpec, replicas: int) -> int:
+        return replicas * max(1, svc.multihost)
+
     async def _spawn(self, svc: ServiceSpec, index: int) -> _Replica:
         env = dict(os.environ)
         env.update(self.spec.env)
         env.update(svc.env)
+        argv = self._argv_for(svc, index)
         log_path = None
         stdout = asyncio.subprocess.DEVNULL
         if self.log_dir:
@@ -111,14 +136,14 @@ class LocalDeploymentController:
             stdout = open(log_path, "ab")
         try:
             proc = await asyncio.create_subprocess_exec(
-                *svc.argv(), env=env, stdout=stdout, stderr=stdout,
+                *argv, env=env, stdout=stdout, stderr=stdout,
                 start_new_session=True,  # isolate signals from controller
             )
         finally:
             if stdout is not asyncio.subprocess.DEVNULL:
                 stdout.close()  # child holds its own fd (or spawn failed)
         log.info("spawned %s[%d] pid=%d: %s", svc.name, index, proc.pid,
-                 " ".join(svc.argv()))
+                 " ".join(argv))
         return _Replica(service=svc.name, index=index, proc=proc,
                         started_at=time.monotonic(), log_path=log_path)
 
@@ -147,6 +172,7 @@ class LocalDeploymentController:
         await self._apply_planner_decision()
         for name, svc in self.spec.services.items():
             replicas = self._replicas[name]
+            wanted_procs = self._procs_wanted(svc, self.desired[name])
             # Reap exits (crash or normal) and count crashes for backoff.
             live: list[_Replica] = []
             for replica in replicas:
@@ -154,7 +180,7 @@ class LocalDeploymentController:
                     live.append(replica)
                     continue
                 ran_for = time.monotonic() - replica.started_at
-                if replica.index < self.desired[name]:
+                if replica.index < wanted_procs:
                     self.restarts += 1
                     streak = (self._crashes.get(name, 0) + 1
                               if ran_for < 60.0 else 1)
@@ -167,9 +193,34 @@ class LocalDeploymentController:
                         "backoff %.1fs)", name, replica.index,
                         replica.proc.returncode, ran_for, streak, delay)
             self._replicas[name] = live
+            # Gang-unit restart (ref: Grove restarts PodCliqueSets
+            # wholesale): jax.distributed has no elastic rejoin, so a
+            # respawned rank cannot join a surviving gang — when any
+            # member of a gang is missing, drain the survivors so the
+            # WHOLE gang respawns together.
+            if svc.multihost > 1:
+                alive_by_gang: dict[int, list[_Replica]] = {}
+                for r in live:
+                    alive_by_gang.setdefault(
+                        r.index // svc.multihost, []).append(r)
+                broken = [g for g, members in alive_by_gang.items()
+                          if len(members) < svc.multihost
+                          and g * svc.multihost < wanted_procs]
+                if broken:
+                    victims = [r for g in broken
+                               for r in alive_by_gang[g]]
+                    log.warning("gang(s) %s of %s incomplete — draining "
+                                "%d survivors for a whole-gang restart",
+                                broken, name, len(victims))
+                    for r in victims:
+                        self._replicas[name].remove(r)
+                    await asyncio.gather(*(self._drain(r)
+                                           for r in victims))
+                    live = self._replicas[name]
             # Scale down: drain extras in parallel (one hung replica must
-            # not stall the reconcile loop N x grace).
-            want = self.desired[name]
+            # not stall the reconcile loop N x grace). Desired counts are
+            # REPLICAS; for multihost gangs each replica is N processes.
+            want = wanted_procs
             extras = [r for r in live if r.index >= want]
             if extras:
                 for replica in extras:

@@ -71,6 +71,17 @@ class KubeDeploymentController:
         reconcile_interval: float = 2.0,
         rollout_timeout: float = 300.0,
     ) -> None:
+        for svc in spec.services.values():
+            if svc.multihost > 1:
+                # Gang semantics need Parallel StatefulSets + headless
+                # Services (render_k8s_manifests emits them) — silently
+                # flattening a gang into a Deployment of independent
+                # pods would serve N broken single-host workers.
+                raise ValueError(
+                    f"service {svc.name!r} uses multihost={svc.multihost}"
+                    ": the live kube controller does not drive gangs "
+                    "yet; apply the --emit-k8s StatefulSet manifests "
+                    "for this service")
         self.spec = spec
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -71,11 +71,88 @@ def _service(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
     }
 
 
+def _gang_statefulset(spec: GraphDeploymentSpec, svc: ServiceSpec,
+                      gang: int) -> list[dict]:
+    """One multihost gang as a Parallel StatefulSet + headless Service
+    (ref: Grove PodCliqueSet gang scheduling — operator
+    internal/dynamo/grove.go). Parallel pod management co-starts all N
+    ranks; the jax.distributed coordinator barrier is the gang join; the
+    standard coscheduling pod-group annotations
+    (scheduling.x-k8s.io / sigs.k8s.io coscheduling plugin) give
+    all-or-nothing SCHEDULING on clusters running a gang scheduler.
+    Rank wiring: each pod derives its rank from its StatefulSet ordinal
+    and dials rank 0's stable headless-DNS name."""
+    env = [{"name": k, "value": str(v)}
+           for k, v in {**spec.env, **svc.env}.items()]
+    name = f"{spec.name}-{svc.name}-g{gang}"
+    labels = {
+        "app.kubernetes.io/part-of": spec.name,
+        "app.kubernetes.io/component": svc.name,
+        "dynamo.gang": str(gang),
+    }
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {"clusterIP": "None", "selector": labels,
+                 "ports": [{"port": svc.multihost_port,
+                            "name": "coordinator"}]},
+    }
+    base = " ".join(svc.argv())
+    coordinator = (f"{name}-0.{name}.$(POD_NAMESPACE)."
+                   f"svc.cluster.local:{svc.multihost_port}")
+    command = ["/bin/sh", "-c",
+               f"exec {base} --multihost "
+               f"$(expr \"$HOSTNAME\" : '.*-\\([0-9]*\\)$')"
+               f"/{svc.multihost}@{coordinator}"]
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {
+            "serviceName": name,
+            "replicas": svc.multihost,
+            "podManagementPolicy": "Parallel",  # co-start all ranks
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {
+                    "labels": labels,
+                    "annotations": {
+                        # coscheduling plugin contract: schedule the
+                        # whole gang or none of it
+                        "scheduling.x-k8s.io/pod-group": name,
+                        "pod-group.scheduling.sigs.k8s.io/name": name,
+                        "pod-group.scheduling.sigs.k8s.io/min-available":
+                            str(svc.multihost),
+                    },
+                },
+                "spec": {
+                    "containers": [{
+                        "name": svc.name,
+                        "image": IMAGE_PLACEHOLDER,
+                        "command": command,
+                        "env": env + [{
+                            "name": "POD_NAMESPACE",
+                            "valueFrom": {"fieldRef": {
+                                "fieldPath": "metadata.namespace"}},
+                        }],
+                    }],
+                },
+            },
+        },
+    }
+    return [headless, sts]
+
+
 def render_k8s_manifests(spec: GraphDeploymentSpec) -> str:
     import yaml
 
     docs = []
     for svc in spec.services.values():
+        if svc.multihost > 1:
+            for gang in range(svc.replicas):
+                docs.extend(_gang_statefulset(spec, svc, gang))
+            continue
         docs.append(_deployment(spec, svc))
         if svc.kind == "frontend":
             docs.append(_service(spec, svc))

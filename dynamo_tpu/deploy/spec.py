@@ -61,6 +61,14 @@ class ServiceSpec:
     # to [min_replicas, max_replicas]. max 0 = unbounded.
     min_replicas: int = 0
     max_replicas: int = 0
+    # Multi-host gang (ref: Grove PodCliqueSet — operator
+    # internal/dynamo/grove.go): N>1 makes each REPLICA a gang of N
+    # co-started processes spanning one engine (`--multihost r/N@...`,
+    # parallel/multihost.py). Locally the controller spawns all N
+    # together; on K8s the service renders as a Parallel StatefulSet per
+    # gang with coscheduling pod-group annotations.
+    multihost: int = 0
+    multihost_port: int = 7777
 
     def __post_init__(self) -> None:
         if self.command is None and self.kind not in KIND_MODULES:
@@ -87,6 +95,13 @@ class ServiceSpec:
             return list(self.command) + list(self.args)
         return [sys.executable, "-m", KIND_MODULES[self.kind],
                 *self.args]
+
+    def gang_argv(self, rank: int, coordinator: str) -> list[str]:
+        """argv for one rank of a multihost gang: the base command plus
+        the rank's `--multihost r/N@host:port` wiring."""
+        assert self.multihost > 1, "gang_argv needs multihost > 1"
+        return self.argv() + ["--multihost",
+                              f"{rank}/{self.multihost}@{coordinator}"]
 
 
 @dataclasses.dataclass
@@ -116,15 +131,42 @@ class GraphDeploymentSpec:
                 command=command,
                 min_replicas=int(raw.get("min_replicas", 0)),
                 max_replicas=int(raw.get("max_replicas", 0)),
+                multihost=int(raw.get("multihost", 0)),
+                multihost_port=int(raw.get("multihost_port", 7777)),
             )
         if not services:
             raise ValueError("deployment spec has no services")
-        return cls(
+        spec = cls(
             name=data.get("name", "deployment"),
             namespace=data.get("namespace", "dynamo"),
             env={k: str(v) for k, v in (data.get("env") or {}).items()},
             services=services,
         )
+        spec.validate_gang_ports()
+        return spec
+
+    def validate_gang_ports(self) -> None:
+        """Local gang coordinators bind real ports (base + gang*2 per
+        replica; jax.distributed uses the port, the step channel
+        port+1). Overlapping ranges between multihost services would
+        bind-collide and crash-loop — reject at parse time. Each
+        service reserves a span covering its scaling headroom."""
+        spans: list[tuple[int, int, str]] = []
+        for svc in self.services.values():
+            if svc.multihost <= 1:
+                continue
+            gangs = max(svc.replicas, svc.max_replicas, 16)
+            lo = svc.multihost_port
+            hi = lo + gangs * 2
+            for other_lo, other_hi, other in spans:
+                if lo < other_hi and other_lo < hi:
+                    raise ValueError(
+                        f"multihost services {other!r} and {svc.name!r} "
+                        f"have overlapping coordinator port ranges "
+                        f"([{other_lo},{other_hi}) vs [{lo},{hi})); set "
+                        "distinct multihost_port values at least "
+                        f"{gangs * 2} apart")
+            spans.append((lo, hi, svc.name))
 
     @classmethod
     def from_yaml(cls, path: str) -> "GraphDeploymentSpec":

@@ -4,6 +4,7 @@ E2E brings up a real mocker+frontend graph and follows a planner decision."""
 
 import asyncio
 import json
+import os
 import sys
 import uuid
 
@@ -221,3 +222,166 @@ class TestDeployE2E:
                 await ctl.close()
 
         run(body(), timeout=180)
+
+
+class TestMultihostGang:
+    def test_gang_renders_parallel_statefulset(self):
+        """A multihost service renders one Parallel StatefulSet +
+        headless Service per GANG with coscheduling pod-group
+        annotations (the Grove PodCliqueSet analog)."""
+        spec = _spec(
+            big=ServiceSpec(name="big", kind="worker", replicas=2,
+                            args=["--model", "tiny-test"], multihost=4,
+                            multihost_port=7901),
+        )
+        docs = list(yaml.safe_load_all(render_k8s_manifests(spec)))
+        stss = [d for d in docs if d["kind"] == "StatefulSet"]
+        heads = [d for d in docs if d["kind"] == "Service"]
+        assert {d["metadata"]["name"] for d in stss} == {"t-big-g0",
+                                                         "t-big-g1"}
+        assert {d["metadata"]["name"] for d in heads} == {"t-big-g0",
+                                                          "t-big-g1"}
+        sts = stss[0]
+        assert sts["spec"]["replicas"] == 4  # N ranks per gang
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        ann = sts["spec"]["template"]["metadata"]["annotations"]
+        assert ann["pod-group.scheduling.sigs.k8s.io/min-available"] == "4"
+        cmd = " ".join(sts["spec"]["template"]["spec"]["containers"][0]
+                       ["command"])
+        assert "--multihost" in cmd and "/4@t-big-g0-0.t-big-g0" in cmd
+        # no plain Deployment for the gang service
+        assert not any(d["kind"] == "Deployment"
+                       and "big" in d["metadata"]["name"] for d in docs)
+
+    def test_local_controller_spawns_full_gangs(self, run):
+        """Locally, one multihost replica = N co-spawned rank processes;
+        observed() counts only COMPLETE gangs."""
+        async def body():
+            spec = _spec(g=ServiceSpec(
+                name="g", command=SLEEP_CMD, replicas=1, multihost=2))
+            ctl = LocalDeploymentController(spec, reconcile_interval=0.1)
+            await ctl.reconcile_once()
+            procs = ctl._replicas["g"]
+            assert len(procs) == 2  # both ranks spawned together
+            assert ctl.observed("g") == 1  # ONE complete gang
+            # rank wiring: each process got its own --multihost r/N flag
+            # (command override: flags appended after the sleep argv)
+            await ctl.close()
+
+        run(body(), timeout=60)
+
+    def test_gang_argv_wiring(self):
+        svc = ServiceSpec(name="w", kind="worker", replicas=1,
+                          args=["--model", "m"], multihost=3,
+                          multihost_port=7800)
+        argv = svc.gang_argv(2, "127.0.0.1:7800")
+        assert argv[-2:] == ["--multihost", "2/3@127.0.0.1:7800"]
+
+
+class TestGangE2E:
+    def test_deployed_gang_serves(self, run, tmp_path):
+        """The deploy controller brings up a 2-rank multihost worker
+        GANG (driver + follower spanning one engine over
+        jax.distributed) plus a frontend from one spec, and chat flows —
+        the local realization of Grove gang scheduling."""
+        disc = str(tmp_path / "disc")
+        salt = uuid.uuid4().int
+        port = 8650 + (salt % 150)
+        mh_port = 21600 + (salt % 150) * 2
+        spec = GraphDeploymentSpec.from_dict({
+            "name": "gang",
+            "env": {
+                "DYNT_DISCOVERY_BACKEND": "file",
+                "DYNT_DISCOVERY_PATH": disc,
+                "DYNT_LOG_LEVEL": "INFO",
+                "JAX_PLATFORMS": "cpu",
+                "DYNT_JAX_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "DYNT_SYSTEM_ENABLED": "false",
+            },
+            "services": {
+                "worker": {"kind": "worker", "replicas": 1,
+                           "multihost": 2, "multihost_port": mh_port,
+                           "args": ["--model", "tiny-test",
+                                    "--page-size", "4",
+                                    "--num-pages", "64",
+                                    "--max-batch", "2",
+                                    "--max-pages-per-seq", "16",
+                                    "--tp", "2", "--dp", "2"]},
+                "frontend": {"kind": "frontend", "replicas": 1,
+                             "args": ["--port", str(port)]},
+            },
+        })
+
+        async def body():
+            import aiohttp
+
+            from tests.chaos_util import chat, wait_models
+
+            ctl = LocalDeploymentController(
+                spec, log_dir=str(tmp_path / "logs"))
+            ctl.start()
+            try:
+                assert ctl.observed("worker") in (0, 1)
+                base = f"http://127.0.0.1:{port}"
+                async with aiohttp.ClientSession() as session:
+                    ok = await wait_models(session, base, "tiny-test",
+                                           timeout=240.0)
+                    if not ok:
+                        logs = tmp_path / "logs"
+                        detail = "".join(
+                            f"== {p.name}\n" + p.read_text()[-1500:]
+                            for p in sorted(logs.glob("*.log")))
+                        pytest.fail("gang never served:\n" + detail)
+                    out = await chat(session, base, "tiny-test",
+                                     "gang hello", max_tokens=4,
+                                     timeout=120)
+                    assert out
+                    # the gang is COMPLETE (both ranks alive)
+                    assert ctl.observed("worker") == 1
+                    assert len([r for r in ctl._replicas["worker"]
+                                if r.proc.returncode is None]) == 2
+            finally:
+                await ctl.close()
+
+        run(body(), timeout=420)
+
+    def test_overlapping_gang_ports_rejected(self):
+        with pytest.raises(ValueError, match="overlapping coordinator"):
+            GraphDeploymentSpec.from_dict({
+                "name": "p", "services": {
+                    "a": {"kind": "worker", "multihost": 2,
+                          "multihost_port": 7777},
+                    "b": {"kind": "worker", "multihost": 2,
+                          "multihost_port": 7779},
+                }})
+
+    def test_broken_gang_restarts_as_unit(self, run):
+        """When one rank of a gang dies, the survivors are drained so
+        the gang respawns WHOLE (jax.distributed has no elastic
+        rejoin)."""
+        async def body():
+            spec = _spec(g=ServiceSpec(
+                name="g", command=SLEEP_CMD, replicas=1, multihost=2))
+            ctl = LocalDeploymentController(spec, reconcile_interval=0.1)
+            await ctl.reconcile_once()
+            procs = list(ctl._replicas["g"])
+            assert len(procs) == 2
+            pids = {r.index: r.proc.pid for r in procs}
+            # kill rank 1 only
+            os.kill(pids[1], 9)
+            for _ in range(50):
+                if procs[1].proc.returncode is not None:
+                    break
+                await asyncio.sleep(0.1)
+            await ctl.reconcile_once()  # reap + drain survivor
+            # rank 0's ORIGINAL process must be gone too (gang-unit)
+            assert all(r.proc.pid != pids[0]
+                       for r in ctl._replicas["g"])
+            # after backoff both ranks respawn together
+            ctl._backoff_until["g"] = 0.0
+            await ctl.reconcile_once()
+            assert ctl.observed("g") == 1
+            await ctl.close()
+
+        run(body(), timeout=60)
