@@ -6,11 +6,25 @@ prefill, paged-KV prefix reuse, per-token streaming, cancellation, and stop
 conditions. Runs in a dedicated thread because compiled JAX steps block;
 results cross into asyncio via call_soon_threadsafe.
 
-Scheduling policy per iteration (vLLM-style, decode-priority):
+Scheduling policy per iteration (vLLM-style, decode-priority), with the
+host/device overlap the TPU dispatch model rewards — device work is
+issued asynchronously and read back as late as possible:
   1. admit waiting requests into free slots while pages allocate
-  2. advance at most `prefill_chunk` prefill tokens (chunked prefill keeps
-     decode ITL protected during long prompts)
-  3. one decode step over all decode-ready slots
+  2. DISPATCH a fused decode block (lax.scan over K steps, optionally
+     depth-pipelined on device-resident tokens) for all decode-ready
+     slots — no readback yet
+  3. advance at most `prefill_chunk` prefill tokens (chunked prefill
+     keeps decode ITL protected during long prompts); the chunk executes
+     behind the decode block on the device stream, and its host-side
+     prep/dispatch overlaps the block's compute
+  4. admit again — arrivals that landed during dispatch are admitted
+     while the device is still stepping
+  5. drain the decode block (the only blocking readback of the loop)
+
+Fused blocks run even while prefill work is pending: each sequence's
+page allocation carries block*depth tokens of speculative slack, so a
+sequence stopping mid-block can never write into a neighbour's pages,
+and the surplus tokens are discarded at drain.
 """
 
 from __future__ import annotations
@@ -70,6 +84,12 @@ class _Seq:
     # re-attends at prompt_len-1 (idempotent KV rewrite of the last
     # prompt token) and produces it through the host path.
     first_deferred: bool = False
+    # Whether this sequence's allocation includes the speculative slack
+    # pages fused decode overruns into. False only when the slacked span
+    # would exceed engine capacity (tiny configs / max-length requests);
+    # such sequences fuse only while their remaining token budget covers
+    # the block, else the batch degrades to per-token.
+    slack_ok: bool = True
 
     @property
     def decode_ready(self) -> bool:
@@ -89,6 +109,11 @@ class SchedulerStats:
     prefill_tokens_last_step: int = 0
     decode_tokens_last_step: int = 0
     kvbm_onboarded_blocks: int = 0
+    # Overlap instrumentation (tested by tests/test_serving_overlap.py):
+    # fused decode blocks dispatched while prefill work was pending, and
+    # sequences admitted while a decode block was in flight on device.
+    fused_steps_with_prefill: int = 0
+    admitted_during_inflight: int = 0
 
 
 class InferenceScheduler:
@@ -268,10 +293,25 @@ class InferenceScheduler:
                     seq.cancelled = True
                 self._waiting.append(seq)
 
+    def _page_span(self, prompt_len: int, max_tokens: int,
+                   with_slack: bool = True) -> int:
+        """Pages to allocate for a sequence. With slack: fused/pipelined
+        decode writes up to block*depth - 1 tokens past a sequence's stop
+        position before the host observes the stop, so those positions
+        must land in pages this sequence owns (never a neighbour's); the
+        surplus tokens are discarded at drain. Capacity CHECKS use the
+        slack-free span (slack must never reject a request that fits) —
+        a sequence whose slacked span exceeds capacity is admitted
+        without slack and gated per-seq in _decode_block_for."""
+        slack = (self.decode_block * max(1, self.decode_pipeline)
+                 if with_slack and self.decode_block > 1 else 0)
+        return -(-(prompt_len + max_tokens + slack) // self.page_size)
+
     def _prepare(self, request: PreprocessedRequest, emit) -> Optional[_Seq]:
         prompt_len = len(request.token_ids)
-        total_pages = -(-(prompt_len + request.sampling.max_tokens)
-                        // self.page_size)
+        total_pages = self._page_span(prompt_len,
+                                      request.sampling.max_tokens,
+                                      with_slack=False)
         if (prompt_len >= self.runner.config.max_context
                 or total_pages > self.runner.config.max_pages_per_seq
                 or total_pages > self.pool.num_pages - 1):
@@ -326,20 +366,28 @@ class InferenceScheduler:
                 tokenizer=getattr(self, "logits_tokenizer", None)))
         return procs or None
 
-    def _admit(self) -> None:
+    def _admit(self) -> int:
+        admitted = 0
         while self._waiting:
             free_slots = [i for i, s in enumerate(self._slots) if s is None]
             if not free_slots:
-                return
+                return admitted
             seq = self._waiting[0]
             if seq.cancelled:
                 self._waiting.pop(0)
                 continue
-            total_pages = -(-(seq.prompt_len + seq.request.sampling.max_tokens)
-                            // self.page_size)
+            total_pages = self._page_span(seq.prompt_len,
+                                          seq.request.sampling.max_tokens)
+            seq.slack_ok = (
+                total_pages <= self.runner.config.max_pages_per_seq
+                and total_pages <= self.pool.num_pages - 1)
+            if not seq.slack_ok:
+                total_pages = self._page_span(
+                    seq.prompt_len, seq.request.sampling.max_tokens,
+                    with_slack=False)
             alloc = self.pool.allocate(seq.block_hashes, total_pages)
             if alloc is None:
-                return  # no pages; retry next iteration
+                return admitted  # no pages; retry next iteration
             # Never skip the whole prompt: recompute at least the last token
             # so we have logits to sample from (cached pages stay correct —
             # recomputed KV values are identical).
@@ -356,8 +404,10 @@ class InferenceScheduler:
             seq.slot = free_slots[0]
             self._slots[seq.slot] = seq
             self._waiting.pop(0)
+            admitted += 1
             if seq.onboard_blocks is not None:
                 self._onboard(seq)
+        return admitted
 
     def _onboard_from_kvbm(self, seq: _Seq) -> None:
         """KVBM onboard at admission (ref §3.5 onboard flows): prompt
@@ -418,11 +468,25 @@ class InferenceScheduler:
 
     def _step(self) -> bool:
         start = time.monotonic()
-        self._admit()
+        admitted = self._admit()
+        # Dispatch decode FIRST (async — no readback): the fused block(s)
+        # execute on device while the host runs prefill prep + dispatch
+        # and admits fresh arrivals below. The readback in _drain_decode
+        # is the loop's only blocking device sync.
+        pending = self._dispatch_decode()
         prefill_tokens = self._prefill_some()
-        decode_tokens = self._decode_all()
+        # Overlap window: arrivals that landed during dispatch are
+        # admitted while the device is still stepping the decode block.
+        self._drain_incoming()
+        late = self._admit()
+        admitted += late
+        # "blocks" handles are genuinely in flight here; a "count" handle
+        # means _decode_single already read back (host-sampling path).
+        if pending is not None and pending[0] == "blocks" and late:
+            self.stats.admitted_during_inflight += late
+        decode_tokens = self._drain_decode(pending)
         self._reap_finished()
-        if prefill_tokens or decode_tokens:
+        if prefill_tokens or decode_tokens or admitted:
             self.stats.steps += 1
             self.stats.prefill_tokens += prefill_tokens
             self.stats.decode_tokens += decode_tokens
@@ -487,6 +551,10 @@ class InferenceScheduler:
             chunk_embeds = None
             if seq.media_embeds is not None:
                 chunk_embeds = self._chunk_media_embeds(seq, tokens)
+            # Non-final chunks: the sampled token is discarded, so skip
+            # the host readback entirely (return_device) — otherwise the
+            # int() conversion would serialize this loop on the in-flight
+            # decode block and pay a dispatch RTT for nothing.
             token = self.runner.prefill_chunk(
                 tokens, seq.prefill_pos, seq.block_table,
                 kv_len_after=seq.prefill_pos + chunk,
@@ -494,6 +562,7 @@ class InferenceScheduler:
                           sampling.top_k, seq.seed),
                 lora_idx=seq.lora_idx,
                 chunk_embeds=chunk_embeds,
+                return_device=not is_final,
             )
             seq.prefill_pos += chunk
             if is_final:
@@ -563,7 +632,13 @@ class InferenceScheduler:
         self._control.put(_do)
         self._wake.set()
 
-    def _decode_all(self) -> int:
+    def _dispatch_decode(self):
+        """Decode phase 1: fill the batch buffers and ISSUE the fused
+        block(s) with no readback — the returned handle is drained by
+        _drain_decode after prefill/admission have overlapped the device
+        time. The host-sampling paths (logprobs / logits processors)
+        need the readback before they can produce a token, so they run
+        synchronously here and return a ("count", n) handle."""
         ready = [s for s in self._slots
                  if s is not None and s.decode_ready and not s.finished
                  and not s.cancelled
@@ -571,9 +646,9 @@ class InferenceScheduler:
         # Sequences whose first token just came from prefill already have
         # generated[0]; they join decode from the next step. (Processor
         # sequences instead join with first_deferred set — their first
-        # token is produced HERE through the host path.)
+        # token is produced through the host path.)
         if not ready:
-            return 0
+            return None
         self._active[:] = False
         # Neutralize params of inactive slots: sample()'s runtime gate
         # skips the full-vocab truncation sort only when NO slot truncates,
@@ -598,8 +673,11 @@ class InferenceScheduler:
             self._lora_idx[i] = seq.lora_idx
         want_logprobs = any(s.request.sampling.logprobs for s in ready)
         want_logits = any(s.processors for s in ready)
+        prefill_pending = any(
+            s is not None and not s.decode_ready and not s.cancelled
+            for s in self._slots)
         block, depth = self._decode_block_for(
-            ready, want_logprobs or want_logits)
+            ready, want_logprobs or want_logits, prefill_pending)
         # Bucket the block-table width to the LIVE context: the decode
         # attention gather reads the full table extent, so a conversation
         # 300 tokens deep must not pay for max_pages_per_seq (e.g. 128
@@ -611,12 +689,14 @@ class InferenceScheduler:
                                    self.runner.config.max_pages_per_seq)
         tables = self._tables[:, :width]
         if block > 1:
+            if prefill_pending:
+                self.stats.fused_steps_with_prefill += 1
             # Pipelined dispatch: issue block d+1 feeding on block d's
             # DEVICE tokens before reading block d back, so the host
             # readback (expensive on remote-attached chips) overlaps the
             # next block's compute. A sequence finishing inside block d
             # wastes its block-d+1 tokens — the same speculation the
-            # in-block discard below already accepts.
+            # in-block discard at drain already accepts.
             device_blocks = []
             toks_dev = None
             for d in range(depth):
@@ -629,16 +709,41 @@ class InferenceScheduler:
                     lora_idx=self._lora_idx, return_device=True,
                 )
                 device_blocks.append(toks_dev)
-            count = 0
-            for toks_dev in device_blocks:
-                toks_k = np.asarray(toks_dev)
-                for step in range(block):
-                    for seq in ready:
-                        if seq.finished or seq.cancelled:
-                            continue  # EOS/stop inside: discard the rest
-                        self._append_token(seq, int(toks_k[step][seq.slot]))
-                        count += 1
-            return count
+            return ("blocks", device_blocks, ready, block)
+        return ("count",
+                self._decode_single(ready, tables, want_logprobs,
+                                    want_logits))
+
+    def _drain_decode(self, pending) -> int:
+        """Decode phase 2: read the fused block(s) back and append tokens.
+        Sequences that stopped (EOS/length/cancel) inside a block have
+        their surplus speculated tokens discarded; the KV those tokens
+        wrote lives in the sequence's own slack pages (_page_span) and is
+        released with them."""
+        if pending is None:
+            return 0
+        if pending[0] == "count":
+            return pending[1]
+        _kind, device_blocks, ready, block = pending
+        # Materialize EVERY block before emitting any token: a sequence
+        # finishing in block d would otherwise deliver its finish_reason
+        # while block d+1's readback still separates it from
+        # _reap_finished's page release — consumers reacting to the
+        # finish (KVBM flush, disagg transfer) would race a release that
+        # hasn't happened yet.
+        blocks_np = [np.asarray(t) for t in device_blocks]
+        count = 0
+        for toks_k in blocks_np:
+            for step in range(block):
+                for seq in ready:
+                    if seq.finished or seq.cancelled:
+                        continue  # EOS/stop inside: discard the rest
+                    self._append_token(seq, int(toks_k[step][seq.slot]))
+                    count += 1
+        return count
+
+    def _decode_single(self, ready, tables, want_logprobs,
+                       want_logits) -> int:
         next_tokens = self.runner.decode(
             self._tokens, self._positions, tables, self._kv_lens,
             self._active, self._temp, self._top_p, self._top_k, self._seeds,
@@ -711,36 +816,42 @@ class InferenceScheduler:
                     logp[top_ids].astype(np.float32))
         return token, info
 
-    def _decode_block_for(self, ready: list,
-                          want_logprobs: bool) -> tuple[int, int]:
-        """(block, pipeline depth) for this iteration. Falls back to
-        (1, 1) whenever fusing would hurt:
-          * prefill work pending (waiting queue or mid-prefill slots) —
-            a K-block would add K-1 steps of TTFT to them;
-          * any sequence wants logprobs (the multi path skips them);
-          * any sequence's remaining token budget < K — KV writes past the
-            allocated pages would corrupt neighbours.
-        Depth > 1 (DYNT_DECODE_PIPELINE) additionally needs depth*K of
-        budget — the pipelined dispatches write that far ahead.
+    def _decode_block_for(self, ready: list, want_host: bool,
+                          prefill_pending: bool) -> tuple[int, int]:
+        """(block, pipeline depth) for this iteration. Per-token (1, 1)
+        only when fusing CANNOT work: a sequence wants logprobs or
+        host-side logits processing — those need a readback per step to
+        produce the next token.
+
+        Prefill work pending no longer forces per-token (the round-4
+        all-or-nothing bail): the chunk interleaves BETWEEN fused blocks
+        — TTFT impact is bounded by one block of decode — and the chunk's
+        own dispatch provides the readback overlap, so depth stays 1.
+        Pure-decode phases chain `decode_pipeline` blocks on
+        device-resident tokens. There is no token-budget bail either:
+        _page_span allocates block*depth of speculative slack per
+        sequence, so a sequence stopping mid-block overruns into its OWN
+        pages and the surplus tokens are discarded at drain. A single
+        fused k keeps the compiled-variant count at one (jit caches per
+        k; varying k mid-serving would compile fresh scan programs).
         """
-        if self.decode_block <= 1 or want_logprobs:
+        if self.decode_block <= 1 or want_host:
             return 1, 1
-        if self._waiting or not self._incoming.empty():
-            return 1, 1
-        if any(s is not None and not s.decode_ready and not s.cancelled
-               for s in self._slots):
-            return 1, 1
-        budget = min(s.request.sampling.max_tokens - len(s.generated)
-                     for s in ready)
-        # All-or-nothing: intermediate k values would each compile a fresh
-        # scanned program mid-serving (jit caches per k), costing far more
-        # than the dispatches saved on a request's final few tokens.
-        if budget < self.decode_block:
-            return 1, 1
-        depth = max(1, self.decode_pipeline)
-        while depth > 1 and budget < depth * self.decode_block:
+        # Mirrored (multihost) runners: depth stays 1 — chained blocks
+        # feed device-resident tokens, which cannot ride the step channel
+        # to follower ranks (parallel/multihost.py MirroredRunner).
+        depth = (1 if (prefill_pending or self._waiting
+                       or getattr(self.runner, "is_mirrored", False))
+                 else max(1, self.decode_pipeline))
+        while depth >= 1:
+            need = self.decode_block * depth
+            if all(s.slack_ok
+                   or (s.request.sampling.max_tokens - len(s.generated)
+                       >= need)
+                   for s in ready):
+                return self.decode_block, depth
             depth -= 1
-        return self.decode_block, depth
+        return 1, 1
 
     def _append_token(self, seq: _Seq, token: int,
                       prompt_tokens: Optional[int] = None,

@@ -313,10 +313,25 @@ class MirroredRunner:
     equals the local enqueue order), then dispatched locally. Non-compute
     attributes pass through."""
 
+    # Schedulers check this to disable device-resident token chaining
+    # (decode pipeline depth > 1): a jax.Array argument cannot travel
+    # the step channel, so chained blocks would force a host sync here
+    # anyway — better to choose depth 1 up front.
+    is_mirrored = True
+
     def __init__(self, runner, channel: StepChannel) -> None:
         self._runner = runner
         self._channel = channel
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _to_host(obj):
+        """Device arrays can't be encoded into a step plan — force the
+        readback (correctness net; the scheduler avoids this path on
+        mirrored runners)."""
+        if isinstance(obj, np.ndarray) or not hasattr(obj, "__array__"):
+            return obj
+        return np.asarray(obj)
 
     def __getattr__(self, name: str):
         target = getattr(self._runner, name)
@@ -329,6 +344,8 @@ class MirroredRunner:
                 # process can read them back; force it consistently on
                 # driver AND followers (the kwarg travels in the plan).
                 kwargs.setdefault("replicated", True)
+            args = tuple(self._to_host(a) for a in args)
+            kwargs = {k: self._to_host(v) for k, v in kwargs.items()}
             with self._lock:
                 self._channel.publish(name, args, kwargs)
                 return target(*args, **kwargs)

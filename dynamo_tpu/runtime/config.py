@@ -115,10 +115,11 @@ _register("DYNT_DECODE_PIPELINE", 2, _int,
           "Pipelined decode-block dispatches in flight (>1 overlaps the "
           "host readback of block d with block d+1's compute — the tokens "
           "chain on-device; costs depth*block of page/token budget)")
-_register("DYNT_DECODE_BLOCK", 1, _int,
-          "Decode steps fused into one compiled call (lax.scan) when no "
-          "prefill work is pending: amortizes host dispatch per token. "
-          "Tokens stream in blocks of this size; 1 = per-token")
+_register("DYNT_DECODE_BLOCK", 8, _int,
+          "Decode steps fused into one compiled call (lax.scan): "
+          "amortizes host dispatch per token; fused blocks also run while "
+          "prefill work is pending (prefill chunks interleave between "
+          "blocks). Tokens stream in blocks of this size; 1 = per-token")
 _register("DYNT_WEIGHT_SERVICE", "", _str,
           "Unix socket of the weight service (GMS analog): workers "
           "re-attach published weights on restart instead of initializing")

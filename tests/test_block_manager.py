@@ -361,7 +361,14 @@ class TestSchedulerIntegration:
             prompt = list(range(1, 13))  # 12 tokens = 3 blocks of 4
             out1 = self._run_one(sched, self._req(prompt))
             toks1 = [t for o in out1 for t in o.token_ids]
-            assert mgr.flush(30.0)
+            # The finish emit is a streaming event, not a release barrier:
+            # the scheduler releases pages (which queues the offload) on
+            # its own thread right after — poll rather than assume.
+            import time as _t
+            deadline = _t.time() + 30.0
+            while mgr.stats.offloaded < 2 and _t.time() < deadline:
+                mgr.flush(1.0)
+                _t.sleep(0.02)
             assert mgr.stats.offloaded >= 2  # prompt blocks landed in G2
             # Clear G1 prefix cache -> only KVBM can serve the prefix now.
             sched.run_in_step(sched.pool.clear).get(timeout=30.0)
