@@ -1,8 +1,10 @@
-"""Benchmark: steady-state decode throughput of the flagship model on the
-available accelerator.
+"""Benchmark: steady-state decode + prefill throughput of the FLAGSHIP
+model (mistral-7b, the honest single-chip 7-8B config — BASELINE.md) on
+the available accelerator, with the 0.6B toy as a secondary datapoint.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+   "prefill": {...}, "ttft": {...}, "secondary": [{...}]}
 
 `vs_baseline` is the fraction of this chip's HBM-bandwidth roofline for the
 model (decode is memory-bound: every step streams all weights + the active
@@ -10,33 +12,30 @@ KV). The reference publishes only relative numbers (BASELINE.md), so roofline
 fraction is the honest hardware-normalized comparison: 1.0 == perfect
 bandwidth utilization, and the reference's vLLM-on-H100 recipes sit around
 0.5-0.7 of their roofline on the same measure.
+
+Model selection: with DYNT_BENCH_MODEL / DYNT_BENCH_MODEL_PATH set, bench
+exactly that model (single-model mode, all DYNT_BENCH_* knobs honored).
+Otherwise on TPU the headline is mistral-7b (int8 KV — required at 7B:
+bf16 KV + 14.5 GB of weights exceed the 16 GB HBM) and qwen3-0.6b runs
+as `secondary`; on CPU only the toy runs (a 7B random-init on the CPU
+smoke path would add tens of minutes for no signal).
 """
 
 from __future__ import annotations
 
+import gc
 import json
-import sys
+import os
 import time
 
 import numpy as np
 
-
-import os as _os
-
-MODEL = _os.environ.get("DYNT_BENCH_MODEL", "qwen3-0.6b")
-BATCH = int(_os.environ.get("DYNT_BENCH_BS", "8"))
 PAGE_SIZE = 16
-NUM_PAGES = int(_os.environ.get("DYNT_BENCH_PAGES", "1024"))
-PROMPT_LEN = int(_os.environ.get("DYNT_BENCH_CTX", "256"))
-DECODE_STEPS = int(_os.environ.get("DYNT_BENCH_STEPS", "256"))
-# Prefill-headline chunk length: big chunks amortize per-chunk overhead
-# onto the MXU (the serving scheduler's chunked-prefill budget plays the
-# same role); the table width grows to fit it.
-PREFILL_CHUNK = int(_os.environ.get("DYNT_BENCH_PREFILL_CHUNK", "1024"))
-MAX_PAGES_PER_SEQ = max(64, PREFILL_CHUNK // PAGE_SIZE + 2)
 # HBM bandwidth by chip generation (GB/s) for the roofline denominator.
 HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
             "cpu": 50.0}
+PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+               "v6e": 918.0, "cpu": 1.0}
 
 
 def _param_bytes(config) -> int:
@@ -54,29 +53,16 @@ def _param_bytes(config) -> int:
     return total * 2  # bf16
 
 
-def main() -> None:
-    import jax
-
+def bench_one(model: str, *, model_path: str | None = None,
+              batch: int = 8, kv_dtype: str = "model",
+              num_pages: int = 1024, prompt_len: int = 256,
+              decode_steps: int = 256, prefill_chunk: int = 1024,
+              do_prefill: bool = True, do_ttft: bool = True,
+              device_kind: str = "cpu") -> dict:
     from dynamo_tpu.engine import ModelRunner, RunnerConfig
     from dynamo_tpu.models import get_config
     from dynamo_tpu.parallel import MeshConfig, make_mesh
-    from dynamo_tpu.runtime.config import env as _env
 
-    # Honor DYNT_JAX_PLATFORM BEFORE the first backend touch (CPU smoke
-    # runs; the frozen JAX_PLATFORMS env can't override the tunnel
-    # platform, the live config update can — see parallel/mesh.py).
-    if _env("DYNT_JAX_PLATFORM"):
-        jax.config.update("jax_platforms", _env("DYNT_JAX_PLATFORM"))
-
-    device = jax.devices()[0]
-    device_kind = getattr(device, "device_kind", "cpu").lower()
-
-    # With DYNT_BENCH_MODEL_PATH set, bench a REAL checkpoint (architecture
-    # from its config.json, weights from safetensors) instead of the
-    # random-init preset.
-    import os
-
-    model_path = os.environ.get("DYNT_BENCH_MODEL_PATH")
     host_params = None
     if model_path:
         from dynamo_tpu.models.checkpoint import (
@@ -88,15 +74,16 @@ def main() -> None:
         host_params = load_params(model_path, config)
         model_label = config.name
     else:
-        config = get_config(MODEL)
-        model_label = MODEL
-    kv_dtype = os.environ.get("DYNT_BENCH_KV_DTYPE", "model")
+        config = get_config(model)
+        model_label = model
+
+    max_pages_per_seq = max(64, prefill_chunk // PAGE_SIZE + 2)
     runner = ModelRunner(
         config,
-        RunnerConfig(page_size=PAGE_SIZE, num_pages=NUM_PAGES,
-                     max_batch=BATCH, max_pages_per_seq=MAX_PAGES_PER_SEQ,
-                     prefill_buckets=(256, PREFILL_CHUNK)
-                     if PREFILL_CHUNK > 256 else (256,),
+        RunnerConfig(page_size=PAGE_SIZE, num_pages=num_pages,
+                     max_batch=batch, max_pages_per_seq=max_pages_per_seq,
+                     prefill_buckets=(256, prefill_chunk)
+                     if prefill_chunk > 256 else (256,),
                      kv_dtype=kv_dtype),
         make_mesh(MeshConfig()),
         host_params,
@@ -110,46 +97,46 @@ def main() -> None:
     # would scatter KV through zero table entries into the shared scratch
     # page and silently corrupt the measured state.
     block = 64
-    total_tokens = PROMPT_LEN + DECODE_STEPS + block
+    total_tokens = prompt_len + decode_steps + block
     pages_per_seq = total_tokens // PAGE_SIZE + 1
-    tables = np.zeros((BATCH, MAX_PAGES_PER_SEQ), np.int32)
+    tables = np.zeros((batch, max_pages_per_seq), np.int32)
     rng = np.random.default_rng(0)
     next_page = 1
-    for b in range(BATCH):
+    for b in range(batch):
         tables[b, :pages_per_seq] = np.arange(next_page,
                                               next_page + pages_per_seq)
         next_page += pages_per_seq
-        prompt = rng.integers(0, config.vocab_size, PROMPT_LEN).astype(np.int32)
+        prompt = rng.integers(0, config.vocab_size, prompt_len).astype(np.int32)
         budget = runner.max_prefill_chunk
         start_tok = 0
-        while start_tok < PROMPT_LEN:
+        while start_tok < prompt_len:
             chunk = prompt[start_tok:start_tok + budget]
             runner.prefill_chunk(chunk, start_tok, tables[b],
                                  start_tok + len(chunk), (0.0, 1.0, 0, 0))
             start_tok += len(chunk)
 
-    tokens = np.zeros(BATCH, np.int32)
-    positions = np.full(BATCH, PROMPT_LEN, np.int32)
-    kv_lens = np.full(BATCH, PROMPT_LEN + 1, np.int32)
-    active = np.ones(BATCH, bool)
-    temp = np.zeros(BATCH, np.float32)
-    top_p = np.ones(BATCH, np.float32)
-    top_k = np.zeros(BATCH, np.int32)
-    seeds = np.zeros(BATCH, np.uint32)
+    tokens = np.zeros(batch, np.int32)
+    positions = np.full(batch, prompt_len, np.int32)
+    kv_lens = np.full(batch, prompt_len + 1, np.int32)
+    active = np.ones(batch, bool)
+    temp = np.zeros(batch, np.float32)
+    top_p = np.ones(batch, np.float32)
+    top_k = np.zeros(batch, np.int32)
+    seeds = np.zeros(batch, np.uint32)
 
     # Steady-state serving uses fused decode blocks (DYNT_DECODE_BLOCK;
     # lax.scan of K steps per compiled call) with PIPELINED dispatch
     # (DYNT_DECODE_PIPELINE): block d+1 consumes block d's tokens
     # ON-DEVICE, so the host readback of block d overlaps block d+1's
     # compute — exactly what the serving scheduler does
-    # (engine/scheduler.py _decode_all).
-    steps_np = np.zeros(BATCH, np.int32)
+    # (engine/scheduler.py _dispatch_decode/_drain_decode).
+    steps_np = np.zeros(batch, np.int32)
 
     # Table width bucketed to the live context (as the serving scheduler
     # does): the attention kernel streams the table extent's pages.
     from dynamo_tpu.engine.model_runner import bucket_table_width
 
-    width = bucket_table_width(pages_per_seq, MAX_PAGES_PER_SEQ)
+    width = bucket_table_width(pages_per_seq, max_pages_per_seq)
     btables = np.ascontiguousarray(tables[:, :width])
 
     state = {"tokens": tokens, "pending": None}
@@ -178,7 +165,7 @@ def main() -> None:
     # Median of three trials: the chip may be tunnel-attached/shared, and
     # a single window can catch a latency spike that says nothing about
     # the engine.
-    n_blocks = DECODE_STEPS // block
+    n_blocks = decode_steps // block
     trials = []
     for _ in range(3):
         start = time.perf_counter()
@@ -191,7 +178,7 @@ def main() -> None:
         kv_lens -= n_blocks * block
         steps_np -= n_blocks * block
     elapsed = sorted(trials)[len(trials) // 2]
-    tok_per_sec = BATCH * n_blocks * block / elapsed
+    tok_per_sec = batch * n_blocks * block / elapsed
 
     # Roofline: steps/sec ceiling = HBM_bw / (weights + active KV per step)
     hbm = 50.0
@@ -199,18 +186,19 @@ def main() -> None:
         if key in device_kind:
             hbm = bw
             break
+    kv_elem_bytes = 1 if kv_dtype == "int8" else 2
     kv_bytes_per_step = (
-        config.n_layers * 2 * (PROMPT_LEN + DECODE_STEPS // 2) * BATCH
-        * config.n_kv_heads * config.head_dim * 2
+        config.n_layers * 2 * (prompt_len + decode_steps // 2) * batch
+        * config.n_kv_heads * config.head_dim * kv_elem_bytes
     )
     bytes_per_step = _param_bytes(config) + kv_bytes_per_step
     roofline_steps = hbm * 1e9 / bytes_per_step
-    roofline_tok = roofline_steps * BATCH
+    roofline_tok = roofline_steps * batch
     vs_baseline = tok_per_sec / roofline_tok
 
     result = {
-        "metric": f"decode throughput {model_label} bs={BATCH} "
-                  f"ctx={PROMPT_LEN} ({device_kind})",
+        "metric": f"decode throughput {model_label} bs={batch} "
+                  f"ctx={prompt_len} ({device_kind})",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
@@ -222,18 +210,16 @@ def main() -> None:
     # round trip (tunnel-dominated here) overlaps the next chunk's
     # compute. MFU denominator: model forward FLOPs (2 * active params
     # per token) over the chip's peak bf16 FLOPs.
-    if os.environ.get("DYNT_BENCH_PREFILL", "1") != "0":
-        PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
-                       "v6e": 918.0, "cpu": 1.0}
+    if do_prefill:
         chunk_len = runner.max_prefill_chunk
         n_chunks = 8
         # All chunks write the SAME page range: they are independent
         # prefills whose KV content is irrelevant to timing, and reuse
         # keeps the bench inside small NUM_PAGES pools (a 14.5GB model
         # leaves little HBM for benchmark-only pages).
-        pf_table = np.zeros(MAX_PAGES_PER_SEQ, np.int32)
+        pf_table = np.zeros(max_pages_per_seq, np.int32)
         pf_pages = chunk_len // PAGE_SIZE + 1
-        avail = NUM_PAGES - next_page
+        avail = num_pages - next_page
         assert avail >= pf_pages, (
             f"prefill bench needs {pf_pages} free pages, pool has {avail}")
         pf_table[:pf_pages] = np.arange(next_page, next_page + pf_pages)
@@ -291,10 +277,11 @@ def main() -> None:
 
     # Prefill/TTFT tail: p50/p99 single-request prefill latency at a few
     # ISLs (the reference's aiperf sweeps report TTFT alongside decode —
-    # BASELINE.md measurement method). Skipped with DYNT_BENCH_TTFT=0.
-    if os.environ.get("DYNT_BENCH_TTFT", "1") != "0":
+    # BASELINE.md measurement method). Tunnel-RTT-dominated on a
+    # remote-attached chip (documented in BASELINE.md).
+    if do_ttft:
         ttft = {}
-        bt = np.zeros(MAX_PAGES_PER_SEQ, np.int32)
+        bt = np.zeros(max_pages_per_seq, np.int32)
         for isl in (128, 512, 1024):
             if isl > runner.config.max_context - 8:
                 continue
@@ -323,7 +310,66 @@ def main() -> None:
                                             int(len(samples) * 0.99))], 2),
             }
         result["ttft"] = ttft
+    return result
 
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.runtime.config import env as _env
+
+    # Honor DYNT_JAX_PLATFORM BEFORE the first backend touch (CPU smoke
+    # runs; the frozen JAX_PLATFORMS env can't override the tunnel
+    # platform, the live config update can — see parallel/mesh.py).
+    if _env("DYNT_JAX_PLATFORM"):
+        jax.config.update("jax_platforms", _env("DYNT_JAX_PLATFORM"))
+
+    device = jax.devices()[0]
+    device_kind = getattr(device, "device_kind", "cpu").lower()
+
+    env_model = os.environ.get("DYNT_BENCH_MODEL")
+    model_path = os.environ.get("DYNT_BENCH_MODEL_PATH")
+    if env_model or model_path:
+        # Single-model mode: bench exactly what the caller asked for.
+        result = bench_one(
+            env_model or "qwen3-0.6b", model_path=model_path,
+            batch=int(os.environ.get("DYNT_BENCH_BS", "8")),
+            kv_dtype=os.environ.get("DYNT_BENCH_KV_DTYPE", "model"),
+            num_pages=int(os.environ.get("DYNT_BENCH_PAGES", "1024")),
+            prompt_len=int(os.environ.get("DYNT_BENCH_CTX", "256")),
+            decode_steps=int(os.environ.get("DYNT_BENCH_STEPS", "256")),
+            prefill_chunk=int(os.environ.get("DYNT_BENCH_PREFILL_CHUNK",
+                                             "1024")),
+            do_prefill=os.environ.get("DYNT_BENCH_PREFILL", "1") != "0",
+            do_ttft=os.environ.get("DYNT_BENCH_TTFT", "1") != "0",
+            device_kind=device_kind,
+        )
+        print(json.dumps(result))
+        return
+
+    if "cpu" in device_kind:
+        # CPU smoke: only the toy — a 7B random-init forward on CPU is
+        # tens of minutes of compile+run for zero perf signal.
+        result = bench_one("qwen3-0.6b", device_kind=device_kind)
+        print(json.dumps(result))
+        return
+
+    # Flagship-first (VERDICT r4 item 3): the driver-captured headline is
+    # the representative 7B config, with the toy as a secondary datapoint.
+    # int8 KV is REQUIRED at 7B (weights 14.5 GB + bf16 KV exceed HBM);
+    # num_pages sized to leave the prefill bench its pages while fitting
+    # beside the weights (BASELINE.md capacity math).
+    result = bench_one("mistral-7b", kv_dtype="int8", num_pages=448,
+                       device_kind=device_kind)
+    gc.collect()
+    jax.clear_caches()
+    try:
+        toy = bench_one("qwen3-0.6b", device_kind=device_kind,
+                        do_ttft=False)
+        result["secondary"] = [toy]
+    except Exception as exc:  # noqa: BLE001 — the flagship number must
+        # survive a secondary-bench failure (e.g. HBM not fully released)
+        result["secondary_error"] = repr(exc)
     print(json.dumps(result))
 
 
