@@ -21,6 +21,17 @@ revision that fails to become ready within `rollout_timeout` is rolled
 back automatically — its Deployment is deleted and the service spec
 reverts to the revision that was serving.
 
+Multihost gangs (ref: Grove PodCliqueSet reconciliation,
+deploy/operator/internal/dynamo/grove.go + graph_test.go:1222): a
+`multihost: N` service is reconciled as `replicas` Parallel
+StatefulSets — one per GANG — each with its revision-stamped headless
+Service (the jax.distributed coordinator DNS). Gangs are all-or-nothing:
+a StatefulSet counts toward `observed` only when ALL N ranks are ready
+(complete-gang accounting, matching deploy/controller.py's local
+semantics), scaling moves whole gangs (never a partial gang), and
+rolling updates surge complete new-revision gangs before retiring old
+ones, with the same timeout rollback as Deployments.
+
 Auth mirrors runtime/kube.py: in-cluster service-account config or
 explicit base_url/token/namespace.
 """
@@ -36,7 +47,7 @@ import time
 from typing import Optional
 
 from ..runtime.logging import get_logger
-from .manifests import _deployment
+from .manifests import _deployment, _gang_statefulset
 from .spec import GraphDeploymentSpec, ServiceSpec
 
 log = get_logger("deploy.kube")
@@ -71,17 +82,12 @@ class KubeDeploymentController:
         reconcile_interval: float = 2.0,
         rollout_timeout: float = 300.0,
     ) -> None:
-        for svc in spec.services.values():
-            if svc.multihost > 1:
-                # Gang semantics need Parallel StatefulSets + headless
-                # Services (render_k8s_manifests emits them) — silently
-                # flattening a gang into a Deployment of independent
-                # pods would serve N broken single-host workers.
-                raise ValueError(
-                    f"service {svc.name!r} uses multihost={svc.multihost}"
-                    ": the live kube controller does not drive gangs "
-                    "yet; apply the --emit-k8s StatefulSet manifests "
-                    "for this service")
+        # Admission before any apiserver write (webhook analog,
+        # deploy/validate.py): a spec the reconcile loop could only fail
+        # on at runtime is rejected HERE with structured field issues.
+        from .validate import check_spec
+
+        check_spec(spec)
         self.spec = spec
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -124,6 +130,15 @@ class KubeDeploymentController:
 
     def _url(self, name: str = "") -> str:
         url = f"{self._base}/apis/apps/v1/namespaces/{self._ns}/deployments"
+        return f"{url}/{name}" if name else url
+
+    def _sts_url(self, name: str = "") -> str:
+        url = (f"{self._base}/apis/apps/v1/namespaces/{self._ns}"
+               "/statefulsets")
+        return f"{url}/{name}" if name else url
+
+    def _svc_url(self, name: str = "") -> str:
+        url = f"{self._base}/api/v1/namespaces/{self._ns}/services"
         return f"{url}/{name}" if name else url
 
     def _headers(self, content_type: Optional[str] = None) -> dict:
@@ -174,14 +189,54 @@ class KubeDeploymentController:
     async def _list_service_deployments(self, service: str) -> list[dict]:
         """All revisions of one service, via the part-of/component labels
         the manifests stamp."""
+        return await self._list_service_objs(self._url(), service)
+
+    async def _list_service_objs(self, base_url: str,
+                                 service: str) -> list[dict]:
         selector = (f"app.kubernetes.io/part-of={self.spec.name},"
                     f"app.kubernetes.io/component={service}")
         status, body = await self._req(
-            "GET", f"{self._url()}?labelSelector={selector}")
+            "GET", f"{base_url}?labelSelector={selector}")
         if status != 200:
             log.warning("list %s -> HTTP %d", service, status)
             return []
         return list(body.get("items") or [])
+
+    # -- gang (multihost) rendering -----------------------------------------
+
+    def _gang_revision_of(self, svc: ServiceSpec) -> str:
+        """Rollout identity of a gang service: hash of the IDENTITY
+        render's pod template (gang 0, no suffix — the suffix embeds the
+        revision itself into the coordinator DNS, so hashing a suffixed
+        render would be circular). Gang count / replicas are not part of
+        it; scaling by gangs is not a rollout."""
+        _, sts = _gang_statefulset(self.spec, svc, 0)
+        template = dict(sts["spec"]["template"])
+        return hashlib.sha256(
+            json.dumps(template, sort_keys=True).encode()).hexdigest()[:8]
+
+    def _render_gang(self, svc: ServiceSpec, gang: int,
+                     rev: str) -> tuple[dict, dict]:
+        """(headless Service, StatefulSet) for one gang of one revision,
+        revision-stamped in name + labels + selector so two revisions
+        surge side by side without selector overlap."""
+        headless, sts = _gang_statefulset(self.spec, svc, gang,
+                                          suffix=f"-{rev}")
+        for obj in (headless, sts):
+            obj["metadata"]["namespace"] = self._ns
+            obj["metadata"]["labels"]["dynamo.revision"] = rev
+        sts["spec"]["selector"]["matchLabels"]["dynamo.revision"] = rev
+        sts["spec"]["template"]["metadata"]["labels"][
+            "dynamo.revision"] = rev
+        headless["spec"]["selector"]["dynamo.revision"] = rev
+        return headless, sts
+
+    async def _delete_gang(self, sts_name: str) -> None:
+        """A gang is one StatefulSet + its same-named headless Service."""
+        for url in (self._sts_url(sts_name), self._svc_url(sts_name)):
+            status, _ = await self._req("DELETE", url)
+            if status not in (200, 202, 404):
+                log.warning("delete %s -> HTTP %d", url, status)
 
     # -- controller interface ----------------------------------------------
 
@@ -196,10 +251,15 @@ class KubeDeploymentController:
                 "apply_spec cannot rename a deployment "
                 f"({self.spec.name!r} -> {new_spec.name!r}); create a new "
                 "controller instead")
+        from .validate import check_spec
+
+        check_spec(new_spec)  # reject before any rollout state mutates
         # Revisions of the CURRENTLY-SERVING spec, rendered before any
         # graph-level field (env) is swapped — graph env is part of every
         # pod template, so changing it must read as a revision change.
-        old_revs = {name: self._revision_of(svc)
+        # _rev_of: gang services hash the StatefulSet template (which
+        # carries multihost/multihost_port), not the Deployment one.
+        old_revs = {name: self._rev_of(svc)
                     for name, svc in self.spec.services.items()}
         old_specs = dict(self.spec.services)
         old_env = dict(self.spec.env)
@@ -211,7 +271,7 @@ class KubeDeploymentController:
             if old is None:
                 self._observed.setdefault(name, 0)
                 continue
-            new_rev = self._revision_of(svc)
+            new_rev = self._rev_of(svc)
             if new_rev != old_revs[name]:
                 roll = self._rollouts.get(name)
                 if roll is not None and roll.state == "progressing":
@@ -248,6 +308,14 @@ class KubeDeploymentController:
         # reconcile loop has not drained yet.
         for name in set(self.spec.services) | self._removed:
             try:
+                svc = self.spec.services.get(name)
+                if svc is not None and svc.multihost > 1 \
+                        or name in self._removed:
+                    for obj in await self._list_service_objs(
+                            self._sts_url(), name):
+                        await self._delete_gang(obj["metadata"]["name"])
+                if svc is not None and svc.multihost > 1:
+                    continue
                 deps = await self._list_service_deployments(name)
                 targets = [d["metadata"]["name"] for d in deps]
                 if not targets and name in self.spec.services:
@@ -309,11 +377,15 @@ class KubeDeploymentController:
                 pass
 
     async def _reconcile_once(self) -> None:
-        # Removed services: delete every revision, then forget them.
+        # Removed services: delete every revision (Deployments AND gang
+        # StatefulSets — a removed service could be either), then forget.
         for name in list(self._removed):
             for dep in await self._list_service_deployments(name):
                 await self._req("DELETE",
                                 self._url(dep["metadata"]["name"]))
+            for obj in await self._list_service_objs(self._sts_url(),
+                                                     name):
+                await self._delete_gang(obj["metadata"]["name"])
             self._removed.discard(name)
         # list(): the synchronous apply_spec may add/remove services
         # while this loop awaits inside _reconcile_service.
@@ -324,13 +396,21 @@ class KubeDeploymentController:
         for name, svc in list(self.spec.services.items()):
             await self._reconcile_service(name, svc)
 
+    def _rev_of(self, svc: ServiceSpec) -> str:
+        return (self._gang_revision_of(svc) if svc.multihost > 1
+                else self._revision_of(svc))
+
     async def _roll_back(self, name: str, rev: str, dep_name: str,
                          roll: _Rollout, reason: str) -> None:
         log.warning("rollout %s: revision %s %s — rolling back", name, rev,
                     reason)
         await self._req("DELETE", self._url(dep_name))
+        self._restore_previous(name, rev, roll)
+
+    def _restore_previous(self, name: str, rev: str,
+                          roll: _Rollout) -> None:
         self.spec.services[name] = roll.previous
-        restored_rev = self._revision_of(roll.previous)
+        restored_rev = self._rev_of(roll.previous)
         if restored_rev == rev:
             # The restored ServiceSpec re-renders the SAME broken
             # template — the failure came from the graph env (alone or
@@ -346,7 +426,7 @@ class KubeDeploymentController:
             # untracked forever.
             cur_env = dict(self.spec.env)
             self.spec.env = dict(roll.previous_env)
-            serving_rev = self._revision_of(roll.previous)
+            serving_rev = self._rev_of(roll.previous)
             self.spec.env = cur_env
             if restored_rev != serving_rev:
                 self._rollouts[name] = _Rollout(
@@ -360,6 +440,9 @@ class KubeDeploymentController:
         self._dirty.set()
 
     async def _reconcile_service(self, name: str, svc: ServiceSpec) -> None:
+        if svc.multihost > 1:
+            await self._reconcile_gang_service(name, svc)
+            return
         rev = self._revision_of(svc)
         dep_name = self._dep_name(name, rev)
         want = self.desired.get(name)
@@ -454,3 +537,124 @@ class KubeDeploymentController:
         # serving traffic; report whichever revision set is actually
         # backing the service.
         self._observed[name] = max(ready, old_ready)
+
+    # -- gang (multihost) reconciliation ------------------------------------
+
+    async def _reconcile_gang_service(self, name: str,
+                                      svc: ServiceSpec) -> None:
+        """One multihost service = `desired` gangs, each a Parallel
+        StatefulSet of svc.multihost ranks + its headless coordinator
+        Service. Complete-gang accounting: a gang counts toward
+        `observed` only with ALL ranks ready; scaling creates/deletes
+        whole gangs (highest ordinal first); rollouts surge the new
+        revision's gangs and retire old-revision gangs only once the new
+        set is complete, rolling back on the same timeout as
+        Deployments. Ref: grove.go PodCliqueSet + graph_test.go:1222."""
+        rev = self._gang_revision_of(svc)
+        want = self.desired.get(name)
+        if want is None:
+            return
+        roll = self._rollouts.get(name)
+
+        def _roll_expired() -> bool:
+            return (roll is not None and roll.state == "progressing"
+                    and time.monotonic() - roll.started_at
+                    > self._rollout_timeout)
+
+        # ONE LIST per pass is the whole apiserver read cost (the
+        # Deployment path's 'one GET per service per pass' discipline):
+        # it yields existence, spec.replicas, and readyReplicas for every
+        # gang of every revision at once.
+        all_sts = await self._list_service_objs(self._sts_url(), name)
+        by_name = {o["metadata"]["name"]: o for o in all_sts}
+        complete = 0
+        create_refused = False
+        for gang in range(want):
+            sts_name = f"{self.spec.name}-{name}-g{gang}-{rev}"
+            current = by_name.pop(sts_name, None)
+            if current is None:
+                headless, sts = self._render_gang(svc, gang, rev)
+                s_svc, body = await self._req("POST", self._svc_url(),
+                                              headless)
+                if s_svc not in (200, 201, 409):  # 409: already exists
+                    log.warning("create headless %s -> HTTP %d: %s",
+                                sts_name, s_svc, body)
+                status, current = await self._req("POST", self._sts_url(),
+                                                  sts)
+                if status == 409:
+                    continue  # raced another creator; next pass adopts it
+                if status not in (200, 201):
+                    log.warning("create gang %s -> HTTP %d: %s", sts_name,
+                                status, current)
+                    create_refused = True
+                    continue
+            # Gang size is INVARIANT (an engine spans exactly N ranks);
+            # repair drift but never scale a gang partially.
+            have = current.get("spec", {}).get("replicas")
+            if have != svc.multihost:
+                status, _ = await self._req(
+                    "PATCH", self._sts_url(sts_name),
+                    {"spec": {"replicas": svc.multihost}},
+                    content_type="application/merge-patch+json")
+                if status != 200:
+                    log.warning("resize gang %s -> HTTP %d", sts_name,
+                                status)
+            ready = int(current.get("status", {})
+                        .get("readyReplicas", 0) or 0)
+            if ready >= svc.multihost:
+                complete += 1
+        if create_refused and _roll_expired():
+            await self._roll_back_gangs(name, svc, rev, want, roll,
+                                        "rejected by the apiserver")
+            return
+
+        # Whatever the reconcile loop above did not claim is either an
+        # excess ordinal of this revision (scale down by whole gangs) or
+        # an old-revision gang (rollout bookkeeping).
+        old_by_rev: dict[str, list[dict]] = {}
+        for obj in by_name.values():
+            labels = obj.get("metadata", {}).get("labels", {})
+            obj_rev = labels.get("dynamo.revision", "")
+            if obj_rev == rev:
+                await self._delete_gang(obj["metadata"]["name"])
+                log.info("gang %s retired (scale down to %d)",
+                         obj["metadata"]["name"], want)
+            else:
+                old_by_rev.setdefault(obj_rev, []).append(obj)
+
+        def _obj_complete(obj: dict) -> bool:
+            size = int(obj.get("spec", {}).get("replicas", 0) or 0)
+            ready = int(obj.get("status", {})
+                        .get("readyReplicas", 0) or 0)
+            return size > 0 and ready >= size
+
+        old_complete = sum(1 for objs in old_by_rev.values()
+                           for o in objs if _obj_complete(o))
+        if old_by_rev:
+            if complete >= want:
+                for objs in old_by_rev.values():
+                    for obj in objs:
+                        await self._delete_gang(obj["metadata"]["name"])
+                        log.info("rollout %s: old gang %s retired", name,
+                                 obj["metadata"]["name"])
+                if roll is not None and roll.state == "progressing":
+                    roll.state = "complete"
+            elif _roll_expired():
+                await self._roll_back_gangs(
+                    name, svc, rev, want, roll,
+                    f"not ready after {self._rollout_timeout:.0f}s")
+                self._observed[name] = old_complete
+                return
+        elif roll is not None and roll.state == "progressing" \
+                and complete >= want:
+            roll.state = "complete"
+        self._observed[name] = max(complete, old_complete)
+
+    async def _roll_back_gangs(self, name: str, svc: ServiceSpec,
+                               rev: str, want: int, roll: _Rollout,
+                               reason: str) -> None:
+        log.warning("rollout %s: gang revision %s %s — rolling back",
+                    name, rev, reason)
+        for gang in range(want):
+            await self._delete_gang(f"{self.spec.name}-{name}-g{gang}-{rev}")
+        self._restore_previous(name, rev, roll)

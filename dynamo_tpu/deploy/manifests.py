@@ -72,7 +72,7 @@ def _service(spec: GraphDeploymentSpec, svc: ServiceSpec) -> dict:
 
 
 def _gang_statefulset(spec: GraphDeploymentSpec, svc: ServiceSpec,
-                      gang: int) -> list[dict]:
+                      gang: int, suffix: str = "") -> list[dict]:
     """One multihost gang as a Parallel StatefulSet + headless Service
     (ref: Grove PodCliqueSet gang scheduling — operator
     internal/dynamo/grove.go). Parallel pod management co-starts all N
@@ -84,7 +84,10 @@ def _gang_statefulset(spec: GraphDeploymentSpec, svc: ServiceSpec,
     and dials rank 0's stable headless-DNS name."""
     env = [{"name": k, "value": str(v)}
            for k, v in {**spec.env, **svc.env}.items()]
-    name = f"{spec.name}-{svc.name}-g{gang}"
+    # `suffix` lets the live controller stamp a revision into the gang's
+    # identity (name + headless DNS) so two revisions can surge side by
+    # side; the kubectl-apply render keeps the bare name.
+    name = f"{spec.name}-{svc.name}-g{gang}{suffix}"
     labels = {
         "app.kubernetes.io/part-of": spec.name,
         "app.kubernetes.io/component": svc.name,

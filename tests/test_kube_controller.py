@@ -16,29 +16,60 @@ from dynamo_tpu.deploy.spec import GraphDeploymentSpec
 
 
 class StubAppsApi:
-    """apps/v1 deployments CRUD; marks every deployment fully ready one
-    poll after creation/scale (a cooperative kubelet)."""
+    """apps/v1 deployments + statefulsets and core/v1 services CRUD;
+    marks every workload fully ready one poll after creation/scale (a
+    cooperative kubelet). `stuck[name] = n` pins a statefulset's
+    readyReplicas below its spec (the partial-gang scenario)."""
 
     def __init__(self):
         self.deployments = {}  # name -> object
+        self.statefulsets = {}  # name -> object
+        self.services = {}  # name -> object (headless coordinator svcs)
+        self.stuck = {}  # sts name -> pinned readyReplicas
         self.port = None
         self._runner = None
 
     async def start(self):
         from aiohttp import web
 
-        base = "/apis/apps/v1/namespaces/{ns}/deployments"
         app = web.Application()
-        app.router.add_post(base, self._create)
-        app.router.add_get(base, self._list)
-        app.router.add_get(base + "/{name}", self._get)
-        app.router.add_patch(base + "/{name}", self._patch)
-        app.router.add_delete(base + "/{name}", self._delete)
+        for kind in ("deployments", "statefulsets"):
+            base = "/apis/apps/v1/namespaces/{ns}/" + kind
+            app.router.add_post(base, self._create)
+            app.router.add_get(base, self._list)
+            app.router.add_get(base + "/{name}", self._get)
+            app.router.add_patch(base + "/{name}", self._patch)
+            app.router.add_delete(base + "/{name}", self._delete)
+        svc = "/api/v1/namespaces/{ns}/services"
+        app.router.add_post(svc, self._svc_create)
+        app.router.add_delete(svc + "/{name}", self._svc_delete)
         self._runner = web.AppRunner(app, shutdown_timeout=0.25)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
+
+    def _kind_store(self, request):
+        return (self.statefulsets if "/statefulsets" in request.path
+                else self.deployments)
+
+    async def _svc_create(self, request):
+        from aiohttp import web
+
+        obj = await request.json()
+        name = obj["metadata"]["name"]
+        if name in self.services:
+            return web.Response(status=409, text="AlreadyExists")
+        self.services[name] = obj
+        return web.json_response(obj, status=201)
+
+    async def _svc_delete(self, request):
+        from aiohttp import web
+
+        obj = self.services.pop(request.match_info["name"], None)
+        if obj is None:
+            return web.Response(status=404, text="NotFound")
+        return web.json_response(obj)
 
     async def stop(self):
         if self._runner:
@@ -52,11 +83,12 @@ class StubAppsApi:
         from aiohttp import web
 
         obj = await request.json()
+        store = self._kind_store(request)
         name = obj["metadata"]["name"]
-        if name in self.deployments:
+        if name in store:
             return web.Response(status=409, text="AlreadyExists")
         obj.setdefault("status", {})
-        self.deployments[name] = obj
+        store[name] = obj
         return web.json_response(obj, status=201)
 
     def _is_broken(self, obj):
@@ -72,14 +104,20 @@ class StubAppsApi:
 
     def _refresh_status(self, obj):
         # cooperative kubelet: everything asked for becomes ready —
-        # unless the template is marked broken.
-        ready = 0 if self._is_broken(obj) else obj["spec"].get("replicas", 0)
+        # unless the template is marked broken or the sts is pinned stuck.
+        name = obj.get("metadata", {}).get("name", "")
+        if name in self.stuck:
+            ready = self.stuck[name]
+        elif self._is_broken(obj):
+            ready = 0
+        else:
+            ready = obj["spec"].get("replicas", 0)
         obj.setdefault("status", {})["readyReplicas"] = ready
 
     async def _get(self, request):
         from aiohttp import web
 
-        obj = self.deployments.get(request.match_info["name"])
+        obj = self._kind_store(request).get(request.match_info["name"])
         if obj is None:
             return web.Response(status=404, text="NotFound")
         self._refresh_status(obj)
@@ -91,7 +129,7 @@ class StubAppsApi:
         selector = request.query.get("labelSelector", "")
         want = dict(kv.split("=", 1) for kv in selector.split(",") if kv)
         items = []
-        for obj in self.deployments.values():
+        for obj in self._kind_store(request).values():
             labels = obj.get("metadata", {}).get("labels", {})
             if all(labels.get(k) == v for k, v in want.items()):
                 self._refresh_status(obj)
@@ -101,7 +139,7 @@ class StubAppsApi:
     async def _patch(self, request):
         from aiohttp import web
 
-        obj = self.deployments.get(request.match_info["name"])
+        obj = self._kind_store(request).get(request.match_info["name"])
         if obj is None:
             return web.Response(status=404, text="NotFound")
         patch = await request.json()
@@ -119,7 +157,8 @@ class StubAppsApi:
     async def _delete(self, request):
         from aiohttp import web
 
-        obj = self.deployments.pop(request.match_info["name"], None)
+        obj = self._kind_store(request).pop(request.match_info["name"],
+                                            None)
         if obj is None:
             return web.Response(status=404, text="NotFound")
         return web.json_response(obj)
@@ -399,3 +438,178 @@ class TestKubeController:
                     await rt.shutdown()
 
         run(body(), timeout=90.0)
+
+
+def _gang_spec(multihost=2, gangs=2, env=None):
+    return GraphDeploymentSpec.from_dict({
+        "name": "kg",
+        "namespace": "dynamo",
+        "env": env or {"DYNT_DISCOVERY_PATH": "/tmp/x"},
+        "services": {
+            "decode": {"kind": "mocker", "replicas": gangs,
+                       "multihost": multihost,
+                       "args": ["--model-name", "m"]},
+        },
+    })
+
+
+def _svc_sts(api, deployment, service):
+    """Gang StatefulSets backing one service."""
+    return {n: o for n, o in api.statefulsets.items()
+            if o.get("metadata", {}).get("labels", {})
+            .get("app.kubernetes.io/component") == service
+            and n.startswith(f"{deployment}-{service}-")}
+
+
+class TestKubeGangs:
+    """Live reconciliation of multihost gangs as Parallel StatefulSets +
+    headless coordinator Services (ref: Grove PodCliqueSet,
+    deploy/operator/internal/dynamo/grove.go; fixture
+    graph_test.go:1222-1397)."""
+
+    def test_gang_create_scale_delete(self, run):
+        async def body():
+            async with stub_api() as api:
+                ctl = KubeDeploymentController(
+                    _gang_spec(multihost=2, gangs=2),
+                    base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if len(_svc_sts(api, "kg", "decode")) == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    stss = _svc_sts(api, "kg", "decode")
+                    assert len(stss) == 2
+                    for name, sts in stss.items():
+                        # every gang is a full Parallel StatefulSet of
+                        # multihost ranks with its headless coordinator
+                        assert sts["spec"]["replicas"] == 2
+                        assert (sts["spec"]["podManagementPolicy"]
+                                == "Parallel")
+                        assert name in api.services
+                        assert (api.services[name]["spec"]["clusterIP"]
+                                == "None")
+                    # complete gangs feed observed/status
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+
+                    # scale UP by whole gangs
+                    ctl.set_replicas("decode", 3)
+                    for _ in range(100):
+                        if len(_svc_sts(api, "kg", "decode")) == 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert len(_svc_sts(api, "kg", "decode")) == 3
+                    assert all(s["spec"]["replicas"] == 2
+                               for s in api.statefulsets.values())
+
+                    # scale DOWN removes whole gangs (sts + headless svc)
+                    ctl.set_replicas("decode", 1)
+                    for _ in range(100):
+                        if len(_svc_sts(api, "kg", "decode")) == 1:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert len(_svc_sts(api, "kg", "decode")) == 1
+                    assert len(api.services) == 1
+                finally:
+                    await ctl.close()
+                assert api.statefulsets == {}
+                assert api.services == {}  # headless svcs torn down too
+        run(body())
+
+    def test_partial_gang_not_counted(self, run):
+        """A gang with 1/2 ranks ready must NOT count toward observed —
+        complete-gang accounting, the deploy/controller.py local
+        semantics carried to the live controller."""
+        async def body():
+            async with stub_api() as api:
+                ctl = KubeDeploymentController(
+                    _gang_spec(multihost=2, gangs=2),
+                    base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if len(_svc_sts(api, "kg", "decode")) == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    names = sorted(_svc_sts(api, "kg", "decode"))
+                    api.stuck[names[0]] = 1  # rank 1 of gang 0 never up
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 1:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert ctl.status()["services"]["decode"]["running"] == 1
+                    del api.stuck[names[0]]
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+                finally:
+                    await ctl.close()
+        run(body())
+
+    def test_gang_rolling_update_and_rollback(self, run):
+        async def body():
+            async with stub_api() as api:
+                spec = _gang_spec(multihost=2, gangs=2)
+                ctl = KubeDeploymentController(
+                    spec, base_url=api.base_url, namespace="testns",
+                    token="t", reconcile_interval=0.05,
+                    rollout_timeout=1.5)
+                ctl.start()
+                try:
+                    for _ in range(100):
+                        if ctl.status()["services"]["decode"]["running"] == 2:
+                            break
+                        await asyncio.sleep(0.02)
+                    rev1 = set(_svc_sts(api, "kg", "decode"))
+
+                    # GOOD rollout: env change -> new revision surges,
+                    # old gangs retired once the new set is complete.
+                    ctl.apply_spec(_gang_spec(
+                        multihost=2, gangs=2,
+                        env={"DYNT_DISCOVERY_PATH": "/tmp/y"}))
+                    for _ in range(200):
+                        names = set(_svc_sts(api, "kg", "decode"))
+                        if names and not (names & rev1):
+                            break
+                        await asyncio.sleep(0.02)
+                    names = set(_svc_sts(api, "kg", "decode"))
+                    assert len(names) == 2 and not (names & rev1)
+                    st = ctl.status()
+                    assert st["rollouts"]["decode"]["state"] == "complete"
+                    assert st["services"]["decode"]["running"] == 2
+                    rev2 = names
+
+                    # BAD rollout: BROKEN env -> new gangs never ready,
+                    # rollback deletes them and the old set keeps serving.
+                    ctl.apply_spec(_gang_spec(
+                        multihost=2, gangs=2,
+                        env={"DYNT_DISCOVERY_PATH": "/tmp/y",
+                             "BROKEN": "1"}))
+                    deadline = time.monotonic() + 20
+                    while time.monotonic() < deadline:
+                        st = ctl.status()
+                        if (st["rollouts"].get("decode", {}).get("state")
+                                == "rolled_back"):
+                            break
+                        await asyncio.sleep(0.05)
+                    assert (ctl.status()["rollouts"]["decode"]["state"]
+                            == "rolled_back")
+                    for _ in range(200):
+                        names = set(_svc_sts(api, "kg", "decode"))
+                        if names == rev2:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert set(_svc_sts(api, "kg", "decode")) == rev2
+                    assert ctl.status()["services"]["decode"]["running"] == 2
+                finally:
+                    await ctl.close()
+        run(body(), timeout=60.0)
