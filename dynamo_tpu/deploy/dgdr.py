@@ -29,6 +29,7 @@ from ..profiler.chips import get_chip
 from ..profiler.timing_model import TimingModel
 from ..runtime.logging import get_logger
 from .controller import LocalDeploymentController
+from .validate import SpecValidationError, check_request, check_spec
 from .spec import GraphDeploymentSpec, ServiceSpec
 
 log = get_logger("deploy.dgdr")
@@ -283,10 +284,24 @@ class DgdrController:
         log.info("dgdr %s torn down", name)
 
     async def _reconcile(self, name: str, req: DeploymentRequest) -> None:
+        # Server-side admission (defense in depth behind submit_request's
+        # client-side check — a raw discovery.put bypasses the client):
+        # a bad document FAILS here, before any chip is profiled or any
+        # process spawned. SpecValidationError's structured issues land
+        # in the Failed status for the submitter to read.
+        try:
+            check_request(req)
+        except SpecValidationError as exc:
+            await self._set_phase(name, FAILED, error=str(exc),
+                                  issues=exc.to_wire()["issues"])
+            return
         await self._set_phase(name, PENDING)
         await self._set_phase(name, PROFILING)
         profile = await asyncio.to_thread(profile_request, req)
         spec = generate_spec(req, profile)
+        check_spec(spec)  # a generated spec failing admission is a bug —
+        # let it raise into the watch loop's FAILED handler with the
+        # structured message
         await self._set_phase(name, READY, profile=profile.to_wire())
 
         existing = self.deployments.get(name)
@@ -411,7 +426,11 @@ class DgdrController:
 
 
 async def submit_request(runtime, req: DeploymentRequest) -> None:
-    """Client edge: write (or update) a DGDR document."""
+    """Client edge: write (or update) a DGDR document. Admission runs
+    HERE (webhook analog, deploy/validate.py): a bad request raises
+    SpecValidationError with structured field issues instead of ever
+    reaching the controller."""
+    check_request(req)
     await runtime.discovery.put(DGDR_PREFIX + req.name, req.to_wire())
 
 

@@ -1,0 +1,264 @@
+"""Admission defaulting/validation — the operator webhook analog.
+
+The reference guards its CRDs with ~6k LoC of defaulting + validation
+webhooks (ref: deploy/operator/internal/webhook/{defaulting,validation}/
+— dynamographdeployment_webhook.go et al.): bad specs are rejected at
+SUBMIT with structured field errors, never discovered as a crash-looping
+reconcile. The framework-level equivalent is this module:
+
+  * `validate_request(req)`  — DGDR document sanity (the DGDR webhook)
+  * `validate_spec(spec)`    — generated/authored graph sanity (the DGD
+                               webhook): k8s-name validity, replica and
+                               gang consistency, port ranges/collisions,
+                               service cross-references, env-typo
+                               detection against the DYNT_* registry
+  * `check_request/check_spec` — raise SpecValidationError (carrying the
+                               structured issue list) on any error
+
+Wired at every admission edge: `submit_request` (client), the DGDR
+controller's reconcile entry (server, defense in depth), and the kube
+controller before any apiserver write.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .dgdr import DeploymentRequest
+    from .spec import GraphDeploymentSpec
+
+# DNS-1123 label (k8s object-name charset).
+_DNS1123 = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+# Controller-appended suffix budget: "-{service}-g{gang}-{rev8}" for
+# gangs, "-{service}-{rev8}" for deployments. Gang ordinals stay small;
+# budget 6 digits of ordinal + separators + the 8-char revision.
+_NAME_SUFFIX_BUDGET = 17
+_K8S_NAME_MAX = 63
+
+ENGINE_KINDS = ("worker", "mocker")
+
+
+@dataclasses.dataclass
+class Issue:
+    """One structured finding, shaped like a webhook field error."""
+
+    path: str  # e.g. "services.decode.multihost_port"
+    message: str
+    severity: str = "error"  # error | warning
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.path}: {self.message}"
+
+
+class SpecValidationError(ValueError):
+    """Admission rejection: carries the full structured issue list so
+    callers (HTTP edges, DGDR status) can surface field-level errors."""
+
+    def __init__(self, issues: list[Issue]):
+        self.issues = issues
+        super().__init__("; ".join(str(i) for i in issues
+                                   if i.severity == "error"))
+
+    def to_wire(self) -> dict:
+        return {"issues": [i.to_wire() for i in self.issues]}
+
+
+def _arg_value(args: list[str], flag: str) -> Optional[str]:
+    for i, a in enumerate(args):
+        if a == flag and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _check_name(issues: list[Issue], path: str, value: str,
+                max_len: int = _K8S_NAME_MAX) -> None:
+    if not value:
+        issues.append(Issue(path, "must not be empty"))
+    elif not _DNS1123.match(value):
+        issues.append(Issue(
+            path, f"{value!r} is not a DNS-1123 label (lowercase "
+            "alphanumerics and '-', must start/end alphanumeric)"))
+    elif len(value) > max_len:
+        issues.append(Issue(
+            path, f"{value!r} is {len(value)} chars; max {max_len} "
+            "(kubernetes object-name budget incl. controller suffixes)"))
+
+
+def validate_request(req: "DeploymentRequest") -> list[Issue]:
+    """DGDR-document admission (ref: DGDR validation webhook)."""
+    issues: list[Issue] = []
+    _check_name(issues, "name", req.name,
+                max_len=_K8S_NAME_MAX - _NAME_SUFFIX_BUDGET - 9)
+    if not req.model:
+        issues.append(Issue("model", "must not be empty"))
+    if req.engine not in ENGINE_KINDS:
+        issues.append(Issue(
+            "engine", f"{req.engine!r} is not one of {ENGINE_KINDS}"))
+    if req.max_chips <= 0:
+        issues.append(Issue("max_chips", "must be positive"))
+    elif req.max_chips > 4096:
+        issues.append(Issue("max_chips",
+                            f"{req.max_chips} exceeds the 4096-chip "
+                            "sanity bound", "warning"))
+    for field in ("ttft_ms", "itl_ms"):
+        if getattr(req, field) <= 0:
+            issues.append(Issue(field, "SLA target must be positive"))
+    for field in ("isl", "osl", "concurrency"):
+        if getattr(req, field) <= 0:
+            issues.append(Issue(field, "must be positive"))
+    if not (0 < req.frontend_port < 65536):
+        issues.append(Issue("frontend_port",
+                            f"{req.frontend_port} outside 1-65535"))
+    if req.profile_mode not in ("rapid", "measured"):
+        issues.append(Issue(
+            "profile_mode",
+            f"{req.profile_mode!r} is not 'rapid' or 'measured'"))
+    _check_env(issues, "env", req.env)
+    return issues
+
+
+def validate_spec(spec: "GraphDeploymentSpec") -> list[Issue]:
+    """Graph-spec admission (ref: DGD validation webhook)."""
+    issues: list[Issue] = []
+    _check_name(issues, "name", spec.name,
+                max_len=_K8S_NAME_MAX - _NAME_SUFFIX_BUDGET)
+    _check_name(issues, "namespace", spec.namespace)
+    _check_env(issues, "env", spec.env)
+    if not spec.services:
+        issues.append(Issue("services", "deployment spec has no services"))
+
+    frontend_ports: dict[int, str] = {}
+    worker_models: set[str] = set()
+    prefill_models: dict[str, str] = {}  # model -> service path
+    for name, svc in spec.services.items():
+        p = f"services.{name}"
+        budget = _K8S_NAME_MAX - _NAME_SUFFIX_BUDGET - len(spec.name)
+        _check_name(issues, p, name, max_len=max(1, budget))
+        if svc.replicas > 4096:
+            issues.append(Issue(f"{p}.replicas",
+                                f"{svc.replicas} exceeds the 4096 sanity "
+                                "bound", "warning"))
+        if svc.multihost < 0:
+            issues.append(Issue(f"{p}.multihost", "must be >= 0"))
+        elif svc.multihost == 1:
+            issues.append(Issue(
+                f"{p}.multihost",
+                "multihost: 1 is a single-host service; omit the field "
+                "(gangs need N >= 2)", "warning"))
+        elif svc.multihost > 64:
+            issues.append(Issue(f"{p}.multihost",
+                                f"{svc.multihost} ranks per gang exceeds "
+                                "the 64-host sanity bound"))
+        if svc.multihost > 1:
+            if not (0 < svc.multihost_port < 65536):
+                issues.append(Issue(f"{p}.multihost_port",
+                                    f"{svc.multihost_port} outside "
+                                    "1-65535"))
+            if svc.kind == "frontend":
+                issues.append(Issue(
+                    f"{p}.multihost",
+                    "a frontend cannot be a gang: the HTTP ingress is a "
+                    "single process (gangs are for SPMD engine ranks)"))
+        _check_env(issues, f"{p}.env", svc.env)
+        port_s = _arg_value(svc.args, "--port")
+        if port_s is not None:
+            try:
+                port = int(port_s)
+            except ValueError:
+                issues.append(Issue(f"{p}.args",
+                                    f"--port {port_s!r} is not an integer"))
+            else:
+                if not (0 < port < 65536):
+                    issues.append(Issue(f"{p}.args",
+                                        f"--port {port} outside 1-65535"))
+                elif svc.kind == "frontend":
+                    other = frontend_ports.get(port)
+                    if other:
+                        issues.append(Issue(
+                            f"{p}.args",
+                            f"frontend port {port} already used by "
+                            f"service {other!r}"))
+                    frontend_ports[port] = name
+        # Cross-refs (ref: validation webhook's graph consistency rules):
+        # a prefill-pool worker is useless without a decode counterpart
+        # for the same model (xPyD disagg needs both halves).
+        model = (_arg_value(svc.args, "--model")
+                 or _arg_value(svc.args, "--model-name"))
+        mode = _arg_value(svc.args, "--mode") or "aggregated"
+        if svc.kind in ENGINE_KINDS:
+            if mode == "prefill":
+                prefill_models[model or ""] = p
+            else:
+                worker_models.add(model or "")
+    for model, path in prefill_models.items():
+        if model not in worker_models:
+            label = f"model {model!r}" if model else "its model"
+            issues.append(Issue(
+                f"{path}.args",
+                f"prefill-mode worker has no decode/aggregated "
+                f"counterpart for {label} (xPyD disagg needs both "
+                "halves)"))
+    try:
+        spec.validate_gang_ports()
+    except ValueError as exc:
+        issues.append(Issue("services", str(exc)))
+    return issues
+
+
+def _check_env(issues: list[Issue], path: str, env: dict) -> None:
+    """DYNT_*-typo detection against the live config registry — the
+    defaulting webhook's 'unknown field' guard, softened to a warning
+    (forward-compat: a newer worker image may know newer keys)."""
+    from ..runtime.config import registry
+
+    known = registry()
+    for key in env or {}:
+        if key.startswith("DYNT_") and key not in known:
+            issues.append(Issue(
+                f"{path}.{key}",
+                "unknown DYNT_* config key (typo? known keys: "
+                "dynamo_tpu.runtime.config.registry())", "warning"))
+
+
+def errors_of(issues: list[Issue]) -> list[Issue]:
+    return [i for i in issues if i.severity == "error"]
+
+
+def check_request(req: "DeploymentRequest") -> list[Issue]:
+    """Validate a DGDR; raise SpecValidationError on any error-severity
+    issue. Returns the full issue list (warnings included) otherwise."""
+    issues = validate_request(req)
+    if errors_of(issues):
+        raise SpecValidationError(issues)
+    return issues
+
+
+def check_spec(spec: "GraphDeploymentSpec") -> list[Issue]:
+    """Validate a graph spec; raise SpecValidationError on any
+    error-severity issue."""
+    issues = validate_spec(spec)
+    if errors_of(issues):
+        raise SpecValidationError(issues)
+    return issues
+
+
+def validate_spec_dict(data: dict) -> tuple[Optional["GraphDeploymentSpec"],
+                                            list[Issue]]:
+    """Parse + validate an authored spec document. Parse failures
+    (unknown kind, negative replicas — ServiceSpec's own constructor
+    guards) come back as structured issues instead of raw ValueErrors."""
+    from .spec import GraphDeploymentSpec
+
+    try:
+        spec = GraphDeploymentSpec.from_dict(data)
+    except (ValueError, TypeError, KeyError) as exc:
+        return None, [Issue("spec", str(exc))]
+    return spec, validate_spec(spec)

@@ -1,0 +1,190 @@
+"""Admission defaulting/validation — the operator webhook analog
+(ref: deploy/operator/internal/webhook/{defaulting,validation}/): bad
+specs and DGDRs fail at SUBMIT with structured field issues, never as a
+crash-looping reconcile."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from dynamo_tpu.deploy.dgdr import (
+    DGDR_PREFIX,
+    FAILED,
+    DeploymentRequest,
+    DgdrController,
+    get_status,
+    submit_request,
+)
+from dynamo_tpu.deploy.spec import GraphDeploymentSpec
+from dynamo_tpu.deploy.validate import (
+    SpecValidationError,
+    check_request,
+    check_spec,
+    validate_request,
+    validate_spec,
+    validate_spec_dict,
+)
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def _spec_dict(**over):
+    base = {
+        "name": "vx",
+        "namespace": "dynamo",
+        "services": {
+            "frontend": {"kind": "frontend", "replicas": 1,
+                         "args": ["--port", "8000"]},
+            "decode": {"kind": "worker", "replicas": 2,
+                       "args": ["--model", "qwen3-0.6b"]},
+        },
+    }
+    base.update(over)
+    return base
+
+
+def _paths(issues, severity="error"):
+    return {i.path for i in issues if i.severity == severity}
+
+
+class TestSpecValidation:
+    def test_good_spec_clean(self):
+        spec = GraphDeploymentSpec.from_dict(_spec_dict())
+        assert validate_spec(spec) == []
+        assert check_spec(spec) == []
+
+    def test_bad_names_rejected(self):
+        spec = GraphDeploymentSpec.from_dict(_spec_dict(name="Bad_Name"))
+        assert "name" in _paths(validate_spec(spec))
+        long = GraphDeploymentSpec.from_dict(_spec_dict(name="a" * 60))
+        assert "name" in _paths(validate_spec(long))
+
+    def test_frontend_gang_rejected(self):
+        d = _spec_dict()
+        d["services"]["frontend"]["multihost"] = 2
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert "services.frontend.multihost" in _paths(validate_spec(spec))
+
+    def test_frontend_port_collision(self):
+        d = _spec_dict()
+        d["services"]["frontend2"] = {"kind": "frontend", "replicas": 1,
+                                      "args": ["--port", "8000"]}
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert any(p.startswith("services.frontend") and p.endswith("args")
+                   for p in _paths(validate_spec(spec)))
+
+    def test_bad_port_rejected(self):
+        d = _spec_dict()
+        d["services"]["frontend"]["args"] = ["--port", "99999"]
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert "services.frontend.args" in _paths(validate_spec(spec))
+
+    def test_prefill_without_decode_counterpart(self):
+        d = _spec_dict()
+        del d["services"]["decode"]
+        d["services"]["prefill"] = {
+            "kind": "worker", "replicas": 1,
+            "args": ["--model", "qwen3-0.6b", "--mode", "prefill"]}
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert "services.prefill.args" in _paths(validate_spec(spec))
+        # ...and the pair is clean
+        d["services"]["decode"] = {"kind": "worker", "replicas": 1,
+                                   "args": ["--model", "qwen3-0.6b"]}
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert "services.prefill.args" not in _paths(validate_spec(spec))
+
+    def test_env_typo_is_warning(self):
+        spec = GraphDeploymentSpec.from_dict(_spec_dict(
+            env={"DYNT_DISCOVERY_BAKCEND": "mem"}))
+        issues = validate_spec(spec)
+        assert _paths(issues) == set()  # warnings don't reject
+        assert any("DYNT_DISCOVERY_BAKCEND" in i.path
+                   for i in issues if i.severity == "warning")
+
+    def test_oversize_gang_rejected(self):
+        d = _spec_dict()
+        d["services"]["decode"]["multihost"] = 128
+        spec = GraphDeploymentSpec.from_dict(d)
+        assert "services.decode.multihost" in _paths(validate_spec(spec))
+
+    def test_parse_failure_becomes_issue(self):
+        d = _spec_dict()
+        d["services"]["decode"]["kind"] = "no-such-kind"
+        spec, issues = validate_spec_dict(d)
+        assert spec is None
+        assert issues and issues[0].severity == "error"
+        assert "no-such-kind" in issues[0].message
+
+    def test_check_spec_raises_structured(self):
+        d = _spec_dict(name="Bad_Name")
+        with pytest.raises(SpecValidationError) as err:
+            check_spec(GraphDeploymentSpec.from_dict(d))
+        wire = err.value.to_wire()
+        assert wire["issues"] and wire["issues"][0]["path"] == "name"
+
+
+class TestRequestValidation:
+    def test_good_request_clean(self):
+        assert check_request(DeploymentRequest(
+            name="ok", model="qwen3-0.6b", engine="mocker")) == []
+
+    def test_bad_fields(self):
+        req = DeploymentRequest(name="UP", model="", engine="vllm",
+                                itl_ms=0.0, concurrency=0,
+                                frontend_port=0, profile_mode="psychic")
+        paths = _paths(validate_request(req))
+        assert {"name", "model", "engine", "itl_ms", "concurrency",
+                "frontend_port", "profile_mode"} <= paths
+
+    def test_submit_is_the_admission_edge(self, run):
+        """Client-side: submit_request refuses a bad DGDR outright.
+        Server-side: a document written PAST the client check (raw
+        discovery put) fails at the controller with structured issues —
+        no profiling, no deployment."""
+        async def body():
+            cfg = RuntimeConfig.from_env()
+            cfg.discovery_backend = "mem"
+            cfg.discovery_path = uuid.uuid4().hex
+            cfg.request_plane = "tcp"
+            cfg.tcp_host = "127.0.0.1"
+            cfg.event_plane = "mem"
+            cfg.system_enabled = False
+            rt = await DistributedRuntime(cfg).start()
+            dgdr = DgdrController(rt)
+            await dgdr.start()
+            try:
+                bad = DeploymentRequest(name="bad", model="",
+                                        engine="vllm")
+                with pytest.raises(SpecValidationError):
+                    await submit_request(rt, bad)
+                # bypass the client edge entirely
+                await rt.discovery.put(DGDR_PREFIX + bad.name,
+                                       bad.to_wire())
+                st = None
+                for _ in range(200):
+                    st = await get_status(rt, "bad")
+                    if st and st.get("phase") == FAILED:
+                        break
+                    await asyncio.sleep(0.05)
+                assert st and st.get("phase") == FAILED, st
+                issue_paths = {i["path"] for i in st.get("issues", [])}
+                assert {"model", "engine"} <= issue_paths
+            finally:
+                await dgdr.close()
+                await rt.shutdown()
+
+        run(body(), timeout=60.0)
+
+
+class TestKubeAdmission:
+    def test_kube_controller_rejects_at_construction(self):
+        from dynamo_tpu.deploy.kube_controller import (
+            KubeDeploymentController,
+        )
+
+        d = _spec_dict()
+        d["services"]["frontend"]["multihost"] = 2
+        with pytest.raises(SpecValidationError):
+            KubeDeploymentController(GraphDeploymentSpec.from_dict(d),
+                                     base_url="http://127.0.0.1:1",
+                                     namespace="t", token="t")
