@@ -157,6 +157,12 @@ class InferenceScheduler:
         self._waiting: list[_Seq] = []
         self._incoming: thread_queue.Queue = thread_queue.Queue()
         self._control: thread_queue.Queue = thread_queue.Queue()
+        # Final-chunk prefill tokens whose host readback is deferred one
+        # iteration: (seq, device token array). The readback then sits
+        # BEHIND the next decode block on the device queue, so prefill
+        # never blocks the serving loop (the tunnel-RTT killer the r4
+        # served bench exposed).
+        self._pending_prefill: list = []
         self._wake = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -469,6 +475,12 @@ class InferenceScheduler:
     def _step(self) -> bool:
         start = time.monotonic()
         admitted = self._admit()
+        # Deferred prefill tokens from the PREVIOUS iteration: their
+        # device work was queued before this iteration's dispatches, so
+        # by the time we materialize them below the result is (nearly)
+        # always already sitting in host-visible memory.
+        ripe = self._pending_prefill
+        self._pending_prefill = []
         # Dispatch decode FIRST (async — no readback): the fused block(s)
         # execute on device while the host runs prefill prep + dispatch
         # and admits fresh arrivals below. The readback in _drain_decode
@@ -484,9 +496,12 @@ class InferenceScheduler:
         # means _decode_single already read back (host-sampling path).
         if pending is not None and pending[0] == "blocks" and late:
             self.stats.admitted_during_inflight += late
+        finalized = 0
+        for seq, tok_dev in ripe:
+            finalized += self._finalize_prefill(seq, tok_dev)
         decode_tokens = self._drain_decode(pending)
         self._reap_finished()
-        if prefill_tokens or decode_tokens or admitted:
+        if prefill_tokens or decode_tokens or admitted or finalized:
             self.stats.steps += 1
             self.stats.prefill_tokens += prefill_tokens
             self.stats.decode_tokens += decode_tokens
@@ -551,10 +566,16 @@ class InferenceScheduler:
             chunk_embeds = None
             if seq.media_embeds is not None:
                 chunk_embeds = self._chunk_media_embeds(seq, tokens)
-            # Non-final chunks: the sampled token is discarded, so skip
-            # the host readback entirely (return_device) — otherwise the
-            # int() conversion would serialize this loop on the in-flight
-            # decode block and pay a dispatch RTT for nothing.
+            # Skip the host readback wherever the token is not needed NOW:
+            # non-final chunks discard it, and plain final chunks defer it
+            # one iteration (_pending_prefill) so the int() conversion
+            # never serializes the loop on the in-flight decode block.
+            # Sync only where the host needs more than the token id:
+            # logprobs (sample info), prefill_only (transfer params), and
+            # processor sequences (which discard it anyway but finish
+            # through _defer_first_token immediately).
+            defer = (is_final and not seq.prefill_only
+                     and not seq.processors and not sampling.logprobs)
             token = self.runner.prefill_chunk(
                 tokens, seq.prefill_pos, seq.block_table,
                 kv_len_after=seq.prefill_pos + chunk,
@@ -562,11 +583,13 @@ class InferenceScheduler:
                           sampling.top_k, seq.seed),
                 lora_idx=seq.lora_idx,
                 chunk_embeds=chunk_embeds,
-                return_device=not is_final,
+                return_device=defer or not is_final,
             )
             seq.prefill_pos += chunk
             if is_final:
-                if seq.prefill_only:
+                if defer:
+                    self._pending_prefill.append((seq, token))
+                elif seq.prefill_only:
                     self._finish_prefill_only(seq, token)
                 elif seq.processors:
                     self._defer_first_token(seq)
@@ -577,6 +600,15 @@ class InferenceScheduler:
                                             "last_prefill_sample", None))
             return chunk
         return 0
+
+    def _finalize_prefill(self, seq: _Seq, tok_dev) -> int:
+        """Materialize a deferred final-chunk token and hand the sequence
+        to decode. Returns 1 if a token was delivered (progress)."""
+        if seq.cancelled or seq.finished:
+            return 0
+        self._append_token(seq, int(np.asarray(tok_dev).reshape(-1)[0]),
+                           prompt_tokens=seq.prompt_len)
+        return 1
 
     def _defer_first_token(self, seq: _Seq) -> None:
         """Processor sequences discard the device-sampled prefill token;

@@ -104,6 +104,12 @@ def main() -> None:
         assert err is None and reason == "length", (reason, err)
         with lock:
             tokens_out[0] = 0
+        # Reset scheduler stats too: the reported sched block must cover
+        # exactly the measured requests, like the token counters beside
+        # it.
+        from dynamo_tpu.engine.scheduler import SchedulerStats
+
+        sched.stats = SchedulerStats()
         t0 = time.perf_counter()
         for i in range(args.requests):
             submit(i)
@@ -126,6 +132,14 @@ def main() -> None:
             "total_tokens_per_sec": round(
                 args.requests * (args.isl + args.osl) / elapsed, 1),
             "wall_s": round(elapsed, 2),
+            "sched": {
+                "iterations": sched.stats.steps,
+                "decode_tokens": sched.stats.decode_tokens,
+                "prefill_tokens": sched.stats.prefill_tokens,
+                "fused_with_prefill": sched.stats.fused_steps_with_prefill,
+                "admitted_during_inflight":
+                    sched.stats.admitted_during_inflight,
+            },
         }
         if kvbm is not None:
             kvbm.flush(60.0)
