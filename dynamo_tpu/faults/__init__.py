@@ -1,0 +1,7 @@
+"""Fault-injection service + client (ref:
+tests/fault_tolerance/hardware/fault_injection_service/)."""
+
+from .client import FaultClient
+from .service import FaultInjectionService
+
+__all__ = ["FaultInjectionService", "FaultClient"]

@@ -1,0 +1,56 @@
+"""Thin async client for the fault-injection service — what tests and
+chaos drivers use instead of raw os.kill (ref: the reference suites
+drive fault_injection_service through its REST API)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class FaultClient:
+    def __init__(self, base_url: str, session=None) -> None:
+        self.base = base_url.rstrip("/")
+        self._session = session
+
+    async def _sess(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    async def _post(self, path: str, body: dict) -> dict:
+        sess = await self._sess()
+        async with sess.post(self.base + path, json=body) as resp:
+            data = await resp.json()
+            if resp.status >= 400:
+                raise RuntimeError(f"{path}: HTTP {resp.status}: {data}")
+            return data
+
+    async def register(self, name: str, pid: int,
+                       argv: Optional[list[str]] = None,
+                       env: Optional[dict] = None,
+                       cwd: Optional[str] = None,
+                       log: Optional[str] = None) -> dict:
+        return await self._post("/v1/targets", {
+            "name": name, "pid": pid, "argv": argv, "env": env,
+            "cwd": cwd, "log": log})
+
+    async def inject(self, type_: str, **params) -> dict:
+        return await self._post("/v1/faults", {"type": type_, **params})
+
+    async def heal(self, fault_id: int) -> dict:
+        return await self._post(f"/v1/faults/{fault_id}/heal", {})
+
+    async def run_scenario(self, name: str, **params) -> dict:
+        return await self._post("/v1/scenarios/run",
+                                {"name": name, **params})
+
+    async def faults(self) -> list[dict]:
+        sess = await self._sess()
+        async with sess.get(self.base + "/v1/faults") as resp:
+            return (await resp.json())["faults"]

@@ -1,0 +1,392 @@
+"""Fault-injection service: a reusable HTTP API for infrastructure
+faults, driveable from tests AND against a live local deployment.
+
+The reference ships this as a standalone service with node agents
+(ref: tests/fault_tolerance/hardware/fault_injection_service/
+{api_service,agents}/ — an API that injects XID errors, kills
+processes, and partitions nodes, consumed by its fault-tolerance
+suites). The TPU build's equivalent targets the faults that matter for
+this runtime (VERDICT r4 item 7): kill a rank, stall/black-hole a
+process (step channel, discovery, worker), corrupt a journal file, and
+delay traffic through a TCP proxy.
+
+API surface:
+  POST /v1/targets            {name, pid, argv?, env?, cwd?, log?}
+  GET  /v1/targets
+  POST /v1/faults             {type, target|path|..., params}
+        kill          — SIGKILL the target process
+        pause         — SIGSTOP (black-hole: the process holds its
+                        sockets but answers nothing — a network
+                        partition as seen by peers)
+        resume        — SIGCONT
+        respawn       — relaunch a killed target from its registered
+                        argv/env (returns the new pid)
+        corrupt_file  — {path, mode: append_garbage|truncate|flip_byte}
+        delay         — TCP latency proxy {listen_port, target_host,
+                        target_port, delay_ms}; heal stops it
+  GET  /v1/faults             history (id, type, state, detail)
+  POST /v1/faults/{id}/heal   undo (resume a pause, stop a delay proxy)
+  POST /v1/scenarios/run      {name, target, params} — multi-step
+        server-side scenarios: partition_blip (pause → hold_ms →
+        resume), kill_respawn (kill → down_ms → respawn)
+  GET  /healthz
+
+Processes are addressed by REGISTERED name->pid, never by pattern
+matching — the agent must not be able to kill the wrong thing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from ..runtime.logging import get_logger
+
+log = get_logger("faults.service")
+
+
+@dataclasses.dataclass
+class Target:
+    name: str
+    pid: int
+    argv: Optional[list[str]] = None
+    env: Optional[dict] = None
+    cwd: Optional[str] = None
+    log: Optional[str] = None
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "pid": self.pid,
+                "respawnable": self.argv is not None}
+
+
+@dataclasses.dataclass
+class Fault:
+    fault_id: int
+    type: str
+    detail: dict
+    state: str = "active"  # active | healed | done | failed
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    def to_wire(self) -> dict:
+        return {"id": self.fault_id, "type": self.type,
+                "state": self.state, "detail": self.detail}
+
+
+class _DelayProxy:
+    """TCP proxy adding fixed latency each direction — the 'slow
+    network' fault no signal can express."""
+
+    def __init__(self, listen_port: int, host: str, port: int,
+                 delay_ms: float) -> None:
+        self.listen_port = listen_port
+        self.host = host
+        self.port = port
+        self.delay = delay_ms / 1e3
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()  # open transports, force-closed on stop
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.listen_port)
+
+    async def _pipe(self, reader, writer) -> None:
+        try:
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                await asyncio.sleep(self.delay)
+                writer.write(chunk)
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            up_r, up_w = await asyncio.open_connection(self.host, self.port)
+        except OSError:
+            writer.close()
+            return
+        self._writers.update((writer, up_w))
+        try:
+            await asyncio.gather(self._pipe(reader, up_w),
+                                 self._pipe(up_r, writer))
+        finally:
+            self._writers.difference_update((writer, up_w))
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Force-close live proxied connections FIRST: on >=3.12.1
+            # wait_closed() waits for every handler, and handlers only
+            # exit on EOF — a pooled keepalive connection would stall
+            # heal()/close() for its whole idle timeout.
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+
+class FaultInjectionService:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self.targets: dict[str, Target] = {}
+        self.faults: dict[int, Fault] = {}
+        self._proxies: dict[int, _DelayProxy] = {}
+        self._next_id = 1
+        self._runner = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "FaultInjectionService":
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/healthz", self._h_health)
+        app.router.add_post("/v1/targets", self._h_register)
+        app.router.add_get("/v1/targets", self._h_targets)
+        app.router.add_post("/v1/faults", self._h_inject)
+        app.router.add_get("/v1/faults", self._h_faults)
+        app.router.add_post("/v1/faults/{id}/heal", self._h_heal)
+        app.router.add_post("/v1/scenarios/run", self._h_scenario)
+        self._runner = web.AppRunner(app, shutdown_timeout=0.25)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("fault-injection service on %s:%d", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        for proxy in self._proxies.values():
+            await proxy.stop()
+        self._proxies.clear()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _h_health(self, request):
+        from aiohttp import web
+
+        return web.json_response({"ok": True,
+                                  "targets": len(self.targets),
+                                  "faults": len(self.faults)})
+
+    async def _h_register(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        try:
+            t = Target(name=str(body["name"]), pid=int(body["pid"]),
+                       argv=body.get("argv"), env=body.get("env"),
+                       cwd=body.get("cwd"), log=body.get("log"))
+        except (KeyError, TypeError, ValueError) as exc:
+            return web.json_response({"error": f"bad target: {exc!r}"},
+                                     status=400)
+        self.targets[t.name] = t
+        return web.json_response(t.to_wire())
+
+    async def _h_targets(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            {"targets": [t.to_wire() for t in self.targets.values()]})
+
+    async def _h_faults(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            {"faults": [f.to_wire() for f in self.faults.values()]})
+
+    def _new_fault(self, type_: str, detail: dict) -> Fault:
+        f = Fault(self._next_id, type_, detail)
+        self._next_id += 1
+        self.faults[f.fault_id] = f
+        return f
+
+    async def _h_inject(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        ftype = body.get("type")
+        try:
+            fault = await self._inject(ftype, body)
+        except KeyError as exc:
+            return web.json_response(
+                {"error": f"unknown target {exc}"}, status=404)
+        except (ValueError, TypeError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        except OSError as exc:
+            return web.json_response({"error": repr(exc)}, status=500)
+        return web.json_response(fault.to_wire())
+
+    async def _inject(self, ftype: str, body: dict) -> Fault:
+        if ftype == "kill":
+            t = self.targets[body["target"]]
+            os.kill(t.pid, signal.SIGKILL)
+            f = self._new_fault("kill", {"target": t.name, "pid": t.pid})
+            f.state = "done"
+            return f
+        if ftype == "pause":
+            t = self.targets[body["target"]]
+            os.kill(t.pid, signal.SIGSTOP)
+            return self._new_fault("pause", {"target": t.name,
+                                             "pid": t.pid})
+        if ftype == "resume":
+            t = self.targets[body["target"]]
+            os.kill(t.pid, signal.SIGCONT)
+            f = self._new_fault("resume", {"target": t.name, "pid": t.pid})
+            f.state = "done"
+            return f
+        if ftype == "respawn":
+            t = self.targets[body["target"]]
+            if not t.argv:
+                raise ValueError(f"target {t.name!r} registered without "
+                                 "argv; cannot respawn")
+            out = (open(t.log, "a") if t.log else subprocess.DEVNULL)
+            try:
+                proc = subprocess.Popen(
+                    t.argv, stdout=out, stderr=subprocess.STDOUT,
+                    env=t.env or None, cwd=t.cwd or None)
+            finally:
+                if out is not subprocess.DEVNULL:
+                    out.close()  # the child holds its own copy
+            t.pid = proc.pid
+            f = self._new_fault("respawn", {"target": t.name,
+                                            "pid": proc.pid})
+            f.state = "done"
+            return f
+        if ftype == "corrupt_file":
+            path = body["path"]
+            mode = body.get("mode", "append_garbage")
+            if mode == "append_garbage":
+                with open(path, "ab") as fh:
+                    fh.write(b'{"torn-frame\x00\xff' +
+                             os.urandom(int(body.get("bytes", 64))))
+            elif mode == "truncate":
+                size = os.path.getsize(path)
+                keep = int(body.get("keep", max(0, size // 2)))
+                with open(path, "r+b") as fh:
+                    fh.truncate(keep)
+            elif mode == "flip_byte":
+                offset = int(body.get("offset",
+                                      os.path.getsize(path) // 2))
+                with open(path, "r+b") as fh:
+                    fh.seek(offset)
+                    byte = fh.read(1)
+                    fh.seek(offset)
+                    fh.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+            else:
+                raise ValueError(f"unknown corrupt_file mode {mode!r}")
+            f = self._new_fault("corrupt_file", {"path": path,
+                                                 "mode": mode})
+            f.state = "done"
+            return f
+        if ftype == "delay":
+            proxy = _DelayProxy(int(body.get("listen_port", 0) or 0),
+                                body["target_host"],
+                                int(body["target_port"]),
+                                float(body.get("delay_ms", 100.0)))
+            await proxy.start()
+            listen = proxy._server.sockets[0].getsockname()[1]
+            proxy.listen_port = listen
+            f = self._new_fault("delay", {
+                "listen_port": listen,
+                "target": f"{proxy.host}:{proxy.port}",
+                "delay_ms": body.get("delay_ms", 100.0)})
+            self._proxies[f.fault_id] = proxy
+            return f
+        raise ValueError(f"unknown fault type {ftype!r}")
+
+    async def _h_heal(self, request):
+        from aiohttp import web
+
+        fid = int(request.match_info["id"])
+        fault = self.faults.get(fid)
+        if fault is None:
+            return web.json_response({"error": "no such fault"},
+                                     status=404)
+        if fault.state != "active":
+            return web.json_response(fault.to_wire())
+        if fault.type == "pause":
+            t = self.targets.get(fault.detail["target"])
+            if t is not None:
+                try:
+                    os.kill(t.pid, signal.SIGCONT)
+                except ProcessLookupError:
+                    pass
+        elif fault.type == "delay":
+            proxy = self._proxies.pop(fid, None)
+            if proxy is not None:
+                await proxy.stop()
+        fault.state = "healed"
+        return web.json_response(fault.to_wire())
+
+    async def _h_scenario(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        name = body.get("name")
+        steps: list[dict] = []
+        try:
+            if name == "partition_blip":
+                # pause → hold → resume, timed SERVER-side: the client
+                # observes one atomic scenario, not three racy calls.
+                hold = float(body.get("hold_ms", 500.0)) / 1e3
+                steps.append((await self._inject(
+                    "pause", body)).to_wire())
+                await asyncio.sleep(hold)
+                steps.append((await self._inject(
+                    "resume", body)).to_wire())
+            elif name == "kill_respawn":
+                down = float(body.get("down_ms", 500.0)) / 1e3
+                steps.append((await self._inject("kill", body)).to_wire())
+                await asyncio.sleep(down)
+                steps.append((await self._inject(
+                    "respawn", body)).to_wire())
+            else:
+                return web.json_response(
+                    {"error": f"unknown scenario {name!r} "
+                     "(known: partition_blip, kill_respawn)"}, status=400)
+        except KeyError as exc:
+            return web.json_response({"error": f"unknown target {exc}"},
+                                     status=404)
+        except (ValueError, TypeError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"scenario": name, "steps": steps})
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser("dynamo_tpu.faults")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7950)
+    args = parser.parse_args(argv)
+    svc = FaultInjectionService(args.host, args.port)
+    await svc.start()
+    print(f"READY {svc.host}:{svc.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(asyncio.run(main()))
