@@ -147,6 +147,79 @@ class FsObjectStoreClient:
             raise TransientStorageError(f"delete {key}: {exc}") from exc
 
 
+class HttpObjectStoreClient:
+    """Native S3/GCS-shaped REST client (stdlib urllib — no SDK in this
+    image): blobs live at {base_url}/{key} with PUT / GET / HEAD /
+    DELETE, the verb set both S3's REST API and GCS's XML API speak, so
+    an endpoint URL pointed at a real bucket gateway (or the in-process
+    stub in tests) works unchanged. Error mapping follows the
+    ObjectStore contract: connection errors and 5xx/429 become
+    TransientStorageError (retryable), 404 is absence, and a body
+    shorter than Content-Length is a detected partial read (also
+    transient — the caller's corrupt-read path quarantines it).
+    Ref: kvbm-design.md §Remote Memory Integration (NIXL-plugged object
+    backends)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _url(self, key: str) -> str:
+        if ".." in key or key.startswith("/"):
+            raise ValueError(f"unsafe object key {key!r}")
+        return f"{self.base_url}/{key}"
+
+    def _request(self, method: str, key: str,
+                 data: Optional[bytes] = None):
+        import http.client
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(self._url(key), data=data,
+                                     method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read()
+                want = resp.headers.get("Content-Length")
+                if (method == "GET" and want is not None
+                        and len(body) != int(want)):
+                    raise TransientStorageError(
+                        f"{method} {key}: partial read "
+                        f"({len(body)}/{want} bytes)")
+                return resp.status, body
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return 404, b""
+            if exc.code in (408, 429) or exc.code >= 500:
+                raise TransientStorageError(
+                    f"{method} {key}: HTTP {exc.code}") from exc
+            raise  # 4xx other than absence/throttle: a caller bug
+        except (urllib.error.URLError, TimeoutError, OSError) as exc:
+            raise TransientStorageError(
+                f"{method} {key}: {exc}") from exc
+        except http.client.HTTPException as exc:
+            # http.client.IncompleteRead: the connection died mid-body —
+            # the same partial-read class as the Content-Length check.
+            raise TransientStorageError(
+                f"{method} {key}: {exc!r}") from exc
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._request("PUT", key, data)
+
+    def get_bytes(self, key: str) -> Optional[bytes]:
+        status, body = self._request("GET", key)
+        return None if status == 404 else body
+
+    def exists(self, key: str) -> bool:
+        status, _ = self._request("HEAD", key)
+        return status != 404
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", key)
+
+
 class ObjectStore:
     """G4: unbounded blob store keyed by sequence hash, over a pluggable
     CLIENT (ref: the reference reaches remote G4 through NIXL-plugged
@@ -165,10 +238,16 @@ class ObjectStore:
             raise NotImplementedError(
                 "direct GCS access requires the google-cloud-storage client "
                 "(not in this image); mount the bucket (gcsfuse) and pass "
-                "the mountpoint instead")
+                "the mountpoint, or point an http(s):// URL at a bucket "
+                "REST gateway (HttpObjectStoreClient)")
         self.spec = spec
-        self.client = (FsObjectStoreClient(backend)
-                       if isinstance(backend, str) else backend)
+        if isinstance(backend, str) and backend.startswith(
+                ("http://", "https://")):
+            self.client = HttpObjectStoreClient(backend)
+        elif isinstance(backend, str):
+            self.client = FsObjectStoreClient(backend)
+        else:
+            self.client = backend
         self.retries = retries
         self.backoff = backoff
         self.retried_ops = 0

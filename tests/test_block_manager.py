@@ -513,3 +513,169 @@ class TestObjectStoreClient:
         fake.blobs[store._key(17)] = buf.getvalue()
         assert store.get(17) is None
         assert store.corrupt_reads == 1
+
+
+class _S3StubServer:
+    """In-process S3/GCS-REST-shaped HTTP server (PUT/GET/HEAD/DELETE on
+    /{key}) with injectable transient failures and truncated responses —
+    the same technique test_kube_controller.py uses for the apiserver.
+    Proves the native HttpObjectStoreClient end to end without any SDK
+    or egress."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _maybe_fail(self):
+                if stub.fail_next > 0:
+                    stub.fail_next -= 1
+                    self.send_response(503)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return True
+                return False
+
+            def do_PUT(self):
+                if self._maybe_fail():
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                stub.blobs[self.path] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if self._maybe_fail():
+                    return
+                data = stub.blobs.get(self.path)
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                send = data
+                if stub.truncate_next > 0:
+                    stub.truncate_next -= 1
+                    send = data[: max(0, len(data) - 64)]
+                self.send_response(200)
+                # Content-Length advertises the FULL object even when the
+                # body is truncated — the partial-read scenario a flaky
+                # proxy/backend produces.
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                try:
+                    self.wfile.write(send)
+                except BrokenPipeError:
+                    pass
+
+            def do_HEAD(self):
+                if self._maybe_fail():
+                    return
+                ok = self.path in stub.blobs
+                self.send_response(200 if ok else 404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_DELETE(self):
+                if self._maybe_fail():
+                    return
+                stub.blobs.pop(self.path, None)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.blobs = {}
+        self.fail_next = 0
+        self.truncate_next = 0
+        import http.server as hs
+
+        self._srv = hs.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture()
+def s3_stub():
+    srv = _S3StubServer()
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+class TestHttpObjectStore:
+    """The native G4 REST client behind the same ObjectStore surface as
+    the filesystem backend (VERDICT r4 item 8): identical retry /
+    partial-read / miss semantics, proven against a live HTTP server."""
+
+    def _store(self, url, **kw):
+        from dynamo_tpu.block_manager.storage import ObjectStore
+
+        return ObjectStore(SPEC, url, backoff=0.001, **kw)
+
+    def test_roundtrip_exists_delete(self, s3_stub):
+        store = self._store(s3_stub.url)
+        block = _block(7)
+        store.put(1234, block)
+        assert store.contains(1234)
+        got = store.get(1234)
+        np.testing.assert_array_equal(got, block)
+        store.delete(1234)
+        assert not store.contains(1234)
+        assert store.get(1234) is None
+
+    def test_transient_500s_retried(self, s3_stub):
+        store = self._store(s3_stub.url, retries=3)
+        s3_stub.fail_next = 2
+        store.put(55, _block(3))
+        assert store.retried_ops >= 2
+        np.testing.assert_array_equal(store.get(55), _block(3))
+
+    def test_partial_read_detected(self, s3_stub):
+        store = self._store(s3_stub.url)
+        store.put(77, _block(5))
+        s3_stub.truncate_next = 1
+        # short body vs Content-Length -> transient -> single-attempt
+        # read degrades to a miss (prefill recompute), engine unharmed
+        assert store.get(77) is None
+        # next read (untruncated) is whole again
+        np.testing.assert_array_equal(store.get(77), _block(5))
+
+    def test_server_down_is_transient_miss(self, s3_stub):
+        store = self._store(s3_stub.url, retries=1)
+        store.put(88, _block(2))
+        s3_stub.close()
+        assert store.get(88) is None  # read path: miss, not crash
+        from dynamo_tpu.block_manager.storage import (
+            TransientStorageError,
+        )
+
+        with pytest.raises(TransientStorageError):
+            store.put(89, _block(2))  # write path: raises after retries
+
+    def test_key_layout_matches_fs_backend(self, s3_stub, tmp_path):
+        """Same hash -> same key path on both backends: a tier can
+        migrate between gcsfuse-mount and REST endpoint without
+        recomputing anything."""
+        from dynamo_tpu.block_manager.storage import ObjectStore
+
+        fs = ObjectStore(SPEC, str(tmp_path / "g4"))
+        http = self._store(s3_stub.url)
+        h = 0xDEADBEEF12345678
+        fs.put(h, _block(9))
+        http.put(h, _block(9))
+        (only_key,) = {k.lstrip("/") for k in s3_stub.blobs}
+        path = tmp_path / "g4" / only_key
+        assert path.exists()
