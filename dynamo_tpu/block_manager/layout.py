@@ -29,19 +29,42 @@ class BlockLayoutSpec:
     total_kv_heads: int  # model-wide head count
     head_dim: int
     page_size: int
-    dtype: str  # numpy dtype name
+    dtype: str  # numpy dtype name (uint8 for packed quantized blocks)
     kv_dims: int = 2  # 2 for separate K/V stacks, 1 for MLA latent cache
     kv_head_start: int = 0  # first head this shard holds
     kv_head_count: Optional[int] = None  # None = all heads (unsharded)
+    # Quantized pools (engine kv_dtype="int8"): tier blocks travel as
+    # PACKED uint8 bytes — int8 values then lane-broadcast bf16 scale
+    # rows (models/transformer.py make_kv_cache_int8), bit-exact across
+    # offload/onboard (no dequant/requant roundtrip). scale_lanes is the
+    # per-token scale-row width (KV_SCALE_LANES).
+    kv_dtype: str = "model"
+    scale_lanes: int = 0
 
     def __post_init__(self) -> None:
         if self.kv_head_count is None:
             object.__setattr__(self, "kv_head_count", self.total_kv_heads)
         if self.kv_head_start + self.kv_head_count > self.total_kv_heads:
             raise ValueError("shard exceeds total kv heads")
+        if self.kv_dtype == "int8":
+            if self.scale_lanes <= 0:
+                raise ValueError("int8 layout needs scale_lanes > 0")
+            # Packed bytes are opaque: the arena dtype is uint8 whatever
+            # the model dtype was.
+            object.__setattr__(self, "dtype", "uint8")
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
 
     @property
     def block_shape(self) -> tuple[int, ...]:
+        if self.quantized:
+            values = (self.n_layers * self.kv_dims * self.page_size
+                      * self.kv_head_count * self.head_dim)  # int8: 1 B
+            scales = (self.n_layers * self.kv_dims * self.page_size
+                      * self.scale_lanes * 2)  # bf16: 2 B
+            return (values + scales,)
         return (self.n_layers, self.kv_dims, self.page_size,
                 self.kv_head_count, self.head_dim)
 
@@ -62,6 +85,8 @@ class BlockLayoutSpec:
             n_layers=layout["n_layers"], total_kv_heads=layout["kv_heads"],
             head_dim=layout["head_dim"], page_size=layout["page_size"],
             dtype=layout["dtype"], kv_dims=layout.get("kv_dims", 2),
+            kv_dtype=layout.get("kv_dtype", "model"),
+            scale_lanes=layout.get("scale_lanes", 0),
         )
 
     def head_range(self) -> tuple[int, int]:
@@ -74,6 +99,15 @@ def reslice(
     """Re-slice a universal block bundle from a source shard's head range to
     a destination shard's. The caller is responsible for assembling full
     coverage when dst needs heads src doesn't hold (see `assemble`)."""
+    if src.quantized or dst.quantized:
+        # Packed quantized blocks are opaque bytes: same-geometry moves
+        # are identity; cross-TP reindexing would need an unpack/repack
+        # of the head-interleaved value bytes — out of scope for int8 v2
+        # (deploy heterogeneous-TP disagg pools with kv_dtype='model').
+        if src == dst:
+            return bundle
+        raise NotImplementedError(
+            "cross-geometry reshard of packed int8 KV blocks")
     if (src.n_layers, src.page_size, src.head_dim) != (
             dst.n_layers, dst.page_size, dst.head_dim):
         raise ValueError(f"incompatible layouts: {src} vs {dst}")
@@ -94,6 +128,12 @@ def assemble(
     """Build `dst`'s block bundle from several source shards (e.g. prefill
     TP=4 -> decode TP=8: each decode shard assembles from the one or two
     prefill shards overlapping its head range)."""
+    if dst.quantized:
+        for spec, bundle in shards:
+            if spec == dst:
+                return bundle
+        raise NotImplementedError(
+            "cross-geometry assembly of packed int8 KV blocks")
     d0, d1 = dst.head_range()
     first = shards[0][1]
     out_shape = first.shape[:-2] + (dst.kv_head_count, dst.head_dim)

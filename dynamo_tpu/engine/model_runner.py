@@ -66,8 +66,15 @@ class RunnerConfig:
     # measured on v5e it currently costs ~25% decode step time (the q8
     # kernel's per-page DMA overheads outweigh the traffic saving — see
     # BASELINE.md), so it is a capacity lever, not a latency one, until
-    # the kernel is tuned. Excludes KVBM/disagg transfers in v1.
+    # the kernel is tuned. r5: composes with KVBM/disagg transfers
+    # (packed uint8 universal blocks, ops/block_copy.py).
     kv_dtype: str = "model"
+    # Weight storage: "model" (bf16) | "int8" (weight-only W8A16: the
+    # dense projection stack as int8 + per-output-channel scales through
+    # the Pallas kernel in ops/q8_linear.py — halves decode weight
+    # streaming, the 7B single-chip bandwidth lever; models/quantize.py
+    # scope notes).
+    weight_dtype: str = "model"
 
     @property
     def max_context(self) -> int:
@@ -169,6 +176,25 @@ class ModelRunner:
             None if self._attention_user_supplied or model_config.is_gptoss
             else _default_decode_attention_fn(mesh))
         axes = param_axes(model_config)
+        if runner_config.weight_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"unknown weight_dtype {runner_config.weight_dtype!r} "
+                "(expected 'model' or 'int8')")
+        self._weight_quantized = runner_config.weight_dtype == "int8"
+        self._raw_param_sharding = None
+        if self._weight_quantized:
+            from ..models.quantize import (
+                check_quantizable,
+                quantize_param_axes,
+            )
+
+            check_quantizable(model_config,
+                              tp=int(dict(mesh.shape).get("tp", 1)),
+                              n_devices=mesh.devices.size)
+            # Raw tree places un-quantized inputs (checkpoints, random
+            # init) before the device-side quantize transform.
+            self._raw_param_sharding = param_shardings(mesh, axes)
+            axes = quantize_param_axes(axes, model_config)
         self._param_sharding = param_shardings(mesh, axes)
         if runner_config.kv_dtype not in ("model", "int8"):
             raise ValueError(
@@ -199,12 +225,42 @@ class ModelRunner:
                                  NamedSharding(mesh, P()))
         else:
             self._kv_sharding = base_kv_sharding
+        def _already_quantized(p) -> bool:
+            return any(isinstance(leaf, dict) and "q8" in leaf
+                       for leaf in p["layers"][0].values())
+
         if params is None:
-            init = jax.jit(
-                partial(init_params, config=model_config),
-                out_shardings=self._param_sharding,
-            )
+            if self._weight_quantized:
+                from ..models.quantize import quantize_params_int8
+
+                init = jax.jit(
+                    lambda key: quantize_params_int8(
+                        init_params(key, config=model_config),
+                        model_config),
+                    out_shardings=self._param_sharding,
+                )
+            else:
+                init = jax.jit(
+                    partial(init_params, config=model_config),
+                    out_shardings=self._param_sharding,
+                )
             params = init(jax.random.PRNGKey(seed))
+        elif self._weight_quantized and not _already_quantized(params):
+            # Host arrays (checkpoint / random): place raw, quantize on
+            # device (one-time cost at load). Weight-service re-attach
+            # streams the ALREADY-quantized pytree and skips this.
+            from ..models.quantize import quantize_params_int8
+
+            params = jax.tree.map(jax.device_put, params,
+                                  self._raw_param_sharding)
+            # donate: a 7B's bf16 params + int8 copy would exceed HBM if
+            # both were live; donation lets XLA retire each bf16 leaf as
+            # its quantized form materializes.
+            params = jax.jit(
+                lambda p: quantize_params_int8(p, model_config),
+                out_shardings=self._param_sharding,
+                donate_argnums=0,
+            )(params)
         else:
             # Host arrays (weight service / peer stream / checkpoint) or
             # device arrays: place each leaf under its sharding. For arrays
@@ -793,6 +849,16 @@ class ModelRunner:
             self._attention_fn = _default_attention_fn(mesh)
             self._decode_attention_fn = _default_decode_attention_fn(mesh)
         axes = param_axes(self.model_config)
+        if self._weight_quantized:
+            from ..models.quantize import (
+                check_quantizable,
+                quantize_param_axes,
+            )
+
+            check_quantizable(self.model_config,
+                              tp=int(dict(mesh.shape).get("tp", 1)),
+                              n_devices=mesh.devices.size)
+            axes = quantize_param_axes(axes, self.model_config)
         self._param_sharding = param_shardings(mesh, axes)
         base_kv_sharding = kv_cache_sharding(
             mesh, head_sharded=not self.model_config.is_mla
@@ -832,13 +898,6 @@ class ModelRunner:
         self._zero_embeds = {}
         log.info("resharded onto mesh %s", dict(mesh.shape))
 
-    def _require_plain_cache(self, what: str) -> None:
-        if self._kv_quantized:
-            raise NotImplementedError(
-                f"{what} is not supported with an int8 KV cache in v1 "
-                "(transfer bundles carry a single array); deploy KVBM/"
-                "disagg pools with kv_dtype='model'")
-
     def gather_pages_device(self, page_ids: np.ndarray,
                             replicated: bool = False):
         """Device-side page gather into a FRESH bundle [n, L, 2, ps, kh,
@@ -853,9 +912,8 @@ class ModelRunner:
         device first — REQUIRED on a multi-host mesh, where the sharded
         bundle is not addressable from one process (the MirroredRunner
         forces it so every host can read the full bundle locally)."""
-        from ..ops.block_copy import gather_kv_blocks
+        from ..ops.block_copy import gather_kv_blocks, gather_kv_blocks_q8
 
-        self._require_plain_cache("gather_pages")
         # Pad the id list to a power-of-two width (extra ids hit the
         # scratch page 0) so the gather jit compiles O(log n) shapes, not
         # one per transfer size; slice back on device.
@@ -864,7 +922,15 @@ class ModelRunner:
         m = 1 << max(0, n - 1).bit_length()
         if m != n:
             ids = np.concatenate([ids, np.zeros(m - n, np.int32)])
-        bundle = gather_kv_blocks(self.kv_cache, jnp.asarray(ids))
+        if self._kv_quantized:
+            # Quantized pool: PACKED uint8 universal blocks (int8 values
+            # + bf16 scale rows, ops/block_copy.py) — bit-exact through
+            # every tier, no dequant/requant roundtrip.
+            bundle = gather_kv_blocks_q8(self.kv_cache[0],
+                                         self.kv_cache[1],
+                                         jnp.asarray(ids))
+        else:
+            bundle = gather_kv_blocks(self.kv_cache, jnp.asarray(ids))
         if m != n:
             bundle = bundle[:n]
         if replicated and not bundle.is_fully_addressable:
@@ -886,9 +952,24 @@ class ModelRunner:
         a host numpy bundle (DCN host-relay / KVBM tiers) or a jax.Array
         already resharded onto this runner's mesh by the ICI bridge — the
         device path skips the H2D copy entirely."""
-        from ..ops.block_copy import scatter_from_host, scatter_kv_blocks
+        from ..ops.block_copy import (
+            scatter_from_host,
+            scatter_from_host_q8,
+            scatter_kv_blocks,
+            scatter_kv_blocks_q8,
+        )
 
-        self._require_plain_cache("scatter_pages")
+        if self._kv_quantized:
+            values, scales = self.kv_cache
+            if isinstance(blocks, jax.Array):
+                self.kv_cache = scatter_kv_blocks_q8(
+                    values, scales, jnp.asarray(page_ids, jnp.int32),
+                    blocks)
+            else:
+                self.kv_cache = scatter_from_host_q8(
+                    values, scales, np.asarray(page_ids, np.int32),
+                    blocks)
+            return
         if isinstance(blocks, jax.Array):
             self.kv_cache = scatter_kv_blocks(
                 self.kv_cache, jnp.asarray(page_ids, jnp.int32), blocks
@@ -933,7 +1014,7 @@ class ModelRunner:
         from the *cache* dims, not the attention dims — MLA caches one latent
         stack per layer ([L, 1, ps, 1, rank+rope]), not per-head K/V."""
         cfg = self.model_config
-        return {
+        layout = {
             "n_layers": cfg.n_layers,
             "kv_heads": cfg.kv_cache_heads,
             "head_dim": cfg.kv_cache_head_dim,
@@ -941,6 +1022,15 @@ class ModelRunner:
             "page_size": self.config.page_size,
             "dtype": str(jnp.dtype(cfg.dtype).name),
         }
+        if self._kv_quantized:
+            from ..models.transformer import KV_SCALE_LANES
+
+            # Tier blocks travel PACKED (uint8 values+scales bytes,
+            # ops/block_copy.py gather_kv_blocks_q8); BlockLayoutSpec
+            # derives the flat byte geometry from these fields.
+            layout["kv_dtype"] = "int8"
+            layout["scale_lanes"] = KV_SCALE_LANES
+        return layout
 
     def warmup(self) -> None:
         """Compile decode + smallest prefill bucket ahead of traffic."""

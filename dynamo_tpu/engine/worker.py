@@ -1023,8 +1023,15 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         choices=["model", "int8"],
                         help="KV cache storage: model dtype (bf16) or "
                              "int8 (half the decode KV traffic, double "
-                             "the KV capacity; excludes KVBM/disagg "
-                             "transfers in v1)")
+                             "the KV capacity; composes with KVBM and "
+                             "same-geometry disagg via packed uint8 "
+                             "transfer blocks)")
+    parser.add_argument("--weight-dtype", default="model",
+                        choices=["model", "int8"],
+                        help="Weight storage: model dtype (bf16) or "
+                             "weight-only int8 (W8A16 Pallas matmuls — "
+                             "halves decode weight streaming; dense "
+                             "llama/mistral/qwen family, tp=1)")
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
@@ -1078,11 +1085,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
     component = args.component
     if args.mode == "prefill" and component == "backend":
         component = "prefill"
-    if args.kv_dtype == "int8" and (args.kvbm_host_blocks > 0
-                                    or args.mode != "aggregated"):
-        raise SystemExit("--kv-dtype int8 currently excludes KVBM tiers "
-                         "and disaggregated modes (transfer bundles carry "
-                         "a single array); use aggregated serving")
+    if args.kv_dtype == "int8" and args.mode != "aggregated":
+        # KVBM tiers compose with int8 KV (packed uint8 blocks, r5), but
+        # the DISAGG transfer planes (ICI bridge + DCN wire descriptors)
+        # still move model-dtype bundles; a quantized pool would fail or
+        # recompute every handoff.
+        raise SystemExit("--kv-dtype int8 supports aggregated serving "
+                         "(incl. KVBM tiers); disaggregated prefill/"
+                         "decode pools still require kv-dtype=model")
     kvbm_config = None
     if args.kvbm_host_blocks > 0:
         from ..block_manager import KvbmConfig
@@ -1113,6 +1123,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
             kv_dtype=args.kv_dtype,
+            weight_dtype=args.weight_dtype,
         )
         if not multihost_cfg.is_driver:
             # Follower: engine only — no runtime, no endpoints. Build a
@@ -1215,6 +1226,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
             kv_dtype=args.kv_dtype,
+            weight_dtype=args.weight_dtype,
         )
         common = dict(
             model_name=args.model, model_path=args.model_path,
@@ -1262,6 +1274,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
             max_pages_per_seq=args.max_pages_per_seq,
             max_loras=args.max_loras, lora_rank=args.lora_rank,
             kv_dtype=args.kv_dtype,
+            weight_dtype=args.weight_dtype,
         ),
         mesh_config=MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp),
         kvbm_config=kvbm_config,

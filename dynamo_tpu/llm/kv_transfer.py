@@ -44,6 +44,12 @@ class KvLayoutDescriptor:
     page_size: int
     dtype: str  # numpy dtype name of the wire payload
     kv_dims: int = 2  # 2 for separate K/V stacks, 1 for MLA latent cache
+    # Quantized pools stamp their scheme so a packed-uint8 pool can never
+    # silently pair with a bf16 pool (compatible() compares the whole
+    # descriptor): disagg transfers of int8 pools are rejected at the
+    # worker CLI today, but the descriptor must still tell them apart.
+    kv_dtype: str = "model"
+    scale_lanes: int = 0
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)

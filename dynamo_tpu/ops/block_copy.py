@@ -45,6 +45,50 @@ def scatter_kv_blocks(
     )
 
 
+@functools.partial(jax.jit, donate_argnums=())
+def gather_kv_blocks_q8(values: jax.Array, scales: jax.Array,
+                        page_ids: jax.Array) -> jax.Array:
+    """Quantized-pool gather into PACKED universal blocks.
+
+    values: int8 [L, 2, P, ps, kh, hd]; scales: bf16 [L, 2, P, ps, lanes]
+    (models/transformer.py make_kv_cache_int8). Returns uint8
+    [n, value_bytes + scale_bytes]: the int8 value bytes followed by the
+    bf16 scale rows bitcast to bytes — ONE opaque array per block, so
+    every tier (host arena, disk, object store, distributed shard
+    workers) moves quantized blocks bit-exactly without knowing about
+    the two-array pool. Same-endian pack/unpack (both ends are this
+    runtime)."""
+    v = values[:, :, page_ids].transpose(2, 0, 1, 3, 4, 5)
+    s = scales[:, :, page_ids].transpose(2, 0, 1, 3, 4)
+    n = v.shape[0]
+    v8 = jax.lax.bitcast_convert_type(v, jnp.uint8).reshape(n, -1)
+    s8 = jax.lax.bitcast_convert_type(s, jnp.uint8).reshape(n, -1)
+    return jnp.concatenate([v8, s8], axis=1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def scatter_kv_blocks_q8(
+    values: jax.Array,  # int8 [L, 2, P, ps, kh, hd] (donated)
+    scales: jax.Array,  # bf16 [L, 2, P, ps, lanes] (donated)
+    page_ids: jax.Array,  # [n] int32
+    packed: jax.Array,  # uint8 [n, value_bytes + scale_bytes]
+) -> tuple[jax.Array, jax.Array]:
+    """Write packed quantized blocks back into the two-array pool
+    (onboard path) — the inverse of gather_kv_blocks_q8."""
+    layers, kv_dims, _, ps, kh, hd = values.shape
+    lanes = scales.shape[-1]
+    n = packed.shape[0]
+    nv = layers * kv_dims * ps * kh * hd
+    v = jax.lax.bitcast_convert_type(
+        packed[:, :nv].reshape(n, layers, kv_dims, ps, kh, hd), jnp.int8)
+    s = jax.lax.bitcast_convert_type(
+        packed[:, nv:].reshape(n, layers, kv_dims, ps, lanes, 2),
+        jnp.bfloat16)
+    values = values.at[:, :, page_ids].set(v.transpose(1, 2, 0, 3, 4, 5))
+    scales = scales.at[:, :, page_ids].set(s.transpose(1, 2, 0, 3, 4))
+    return values, scales
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def swap_kv_blocks(
     kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd] (donated)
@@ -97,4 +141,26 @@ def scatter_from_host(
     dev_blocks = jax.device_put(blocks, target)
     return scatter_kv_blocks(
         kv_cache, jnp.asarray(page_ids, jnp.int32), dev_blocks
+    )
+
+
+def scatter_from_host_q8(
+    values: jax.Array, scales: jax.Array, page_ids: np.ndarray,
+    packed: np.ndarray
+) -> tuple[jax.Array, jax.Array]:
+    """Host -> device onboard of PACKED quantized pages (the uint8 tier
+    format of gather_kv_blocks_q8), mirroring scatter_from_host's
+    pad/replicate discipline."""
+    page_ids, packed = pad_bundle_pow2(np.asarray(page_ids),
+                                       np.asarray(packed))
+    sharding = getattr(values, "sharding", None)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        target = jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec())
+    else:
+        devs = values.devices() if hasattr(values, "devices") else set()
+        target = next(iter(devs), None)
+    dev_packed = jax.device_put(packed, target)
+    return scatter_kv_blocks_q8(
+        values, scales, jnp.asarray(page_ids, jnp.int32), dev_packed
     )

@@ -203,13 +203,97 @@ class TestRunnerInt8:
         # noise at this scale; greedy streams agree on the tiny model.
         assert outs["int8"] == outs["model"]
 
-    def test_transfer_paths_guarded(self):
+    def test_packed_gather_scatter_roundtrip(self):
+        """int8 transfers (r5, VERDICT item 6): the pool's quantized
+        blocks travel as PACKED uint8 bytes (values + scale rows) and
+        survive a gather -> scatter -> gather roundtrip bit-exactly —
+        no dequant/requant drift through the tiers."""
+        from dynamo_tpu.block_manager import BlockLayoutSpec
+
         r = self._runner("int8")
-        with pytest.raises(NotImplementedError, match="int8"):
-            r.gather_pages(np.array([1, 2], np.int32))
-        with pytest.raises(NotImplementedError, match="int8"):
-            r.scatter_pages(np.array([1], np.int32),
-                            np.zeros((1, 2, 2, 4, 2, 128), np.float32))
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 500, 12).astype(np.int32)
+        table = np.zeros(16, np.int32)
+        table[:4] = np.arange(1, 5)
+        r.prefill_chunk(prompt, 0, table, len(prompt), (0.0, 1.0, 0, 0))
+
+        pages = np.array([1, 2, 3], np.int32)
+        packed = r.gather_pages(pages)
+        assert packed.dtype == np.uint8 and packed.ndim == 2
+        spec = BlockLayoutSpec.from_runner_layout(r.kv_layout())
+        assert spec.quantized
+        assert packed.shape[1] == spec.block_shape[0]
+        assert packed.any()  # real bytes, not zeros
+
+        target = np.array([10, 11, 12], np.int32)
+        r.scatter_pages(target, packed)
+        back = r.gather_pages(target)
+        np.testing.assert_array_equal(back, packed)
+
+    def test_kvbm_offload_onboard_int8_e2e(self, tmp_path):
+        """Scheduler-level compose (bench_serve --kv-dtype int8
+        --kvbm-host-blocks N): blocks offloaded from a quantized pool
+        onboard back after the G1 prefix cache is cleared, and the
+        greedy completion is unchanged — the int8 and KVBM capacity
+        levers no longer exclude each other."""
+        import queue as thread_queue
+        import uuid
+
+        from dynamo_tpu.block_manager import (
+            BlockLayoutSpec,
+            KvbmConfig,
+            KvBlockManager,
+        )
+        from dynamo_tpu.engine import InferenceScheduler
+        from dynamo_tpu.llm.protocols import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        runner = self._runner("int8")
+        mgr = KvBlockManager(
+            KvbmConfig(host_blocks=16, disk_blocks=16,
+                       disk_path=str(tmp_path / "g3.bin"),
+                       admission=False),
+            BlockLayoutSpec.from_runner_layout(runner.kv_layout()))
+        sched = InferenceScheduler(runner, kvbm=mgr)
+        sched.start()
+
+        def run_one(prompt):
+            done = thread_queue.Queue()
+            outs = []
+
+            def emit(o):
+                outs.append(o)
+                if o.finish_reason is not None:
+                    done.put(o)
+
+            sched.submit(PreprocessedRequest(
+                request_id=uuid.uuid4().hex, token_ids=list(prompt),
+                sampling=SamplingOptions(max_tokens=2, temperature=0.0),
+                stop=StopConditions(ignore_eos=True)), emit)
+            done.get(timeout=120.0)
+            return [t for o in outs for t in o.token_ids]
+
+        try:
+            prompt = list(range(1, 13))  # 3 blocks of 4
+            toks1 = run_one(prompt)
+            import time as _t
+
+            deadline = _t.time() + 30.0
+            while mgr.stats.offloaded < 2 and _t.time() < deadline:
+                mgr.flush(1.0)
+                _t.sleep(0.02)
+            assert mgr.stats.offloaded >= 2
+            sched.run_in_step(sched.pool.clear).get(timeout=30.0)
+            toks2 = run_one(prompt)
+            assert sched.stats.kvbm_onboarded_blocks >= 2
+            assert toks1 == toks2  # onboarded quantized KV == computed
+        finally:
+            mgr.flush(5.0)
+            sched.stop()
+            mgr.close()
 
     def test_bad_kv_dtype_rejected(self):
         from dynamo_tpu.engine.model_runner import ModelRunner, RunnerConfig
