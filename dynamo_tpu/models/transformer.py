@@ -432,15 +432,27 @@ def _gptoss_attention_block(
     return kv_cache, attn
 
 
+def _mm(spec: str, x: jax.Array, w) -> jax.Array:
+    """Dense projection that transparently supports weight-only int8
+    leaves ({"q8","qs"} — models/quantize.py): quantized weights route
+    through the Pallas W8A16 kernel (ops/q8_linear.py) so the bf16
+    weight never materializes in HBM."""
+    if isinstance(w, dict):
+        from ..ops.q8_linear import q8_einsum
+
+        return q8_einsum(spec, x, w["q8"], w["qs"])
+    return jnp.einsum(spec, x, w)
+
+
 def _swiglu(x: jax.Array, p: dict, lora_layer: Optional[dict] = None,
             lora_idx: Optional[jax.Array] = None) -> jax.Array:
-    gate = jnp.einsum("bth,hm->btm", x, p["w_gate"])
-    up = jnp.einsum("bth,hm->btm", x, p["w_up"])
+    gate = _mm("bth,hm->btm", x, p["w_gate"])
+    up = _mm("bth,hm->btm", x, p["w_up"])
     if lora_layer is not None:
         gate = gate + _lora_delta(x, lora_layer["w_gate"], lora_idx)
         up = up + _lora_delta(x, lora_layer["w_up"], lora_idx)
     act = jax.nn.silu(gate) * up
-    down = jnp.einsum("btm,mh->bth", act, p["w_down"])
+    down = _mm("btm,mh->bth", act, p["w_down"])
     if lora_layer is not None:
         down = down + _lora_delta(act, lora_layer["w_down"], lora_idx)
     return down
@@ -811,9 +823,9 @@ def forward_decode(
     for layer_idx, lp in enumerate(params["layers"]):
         ll = lora["layers"][layer_idx] if lora is not None else {}
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        q = _mm("bth,hqd->btqd", h, lp["wq"])
+        k = _mm("bth,hkd->btkd", h, lp["wk"])
+        v = _mm("bth,hkd->btkd", h, lp["wv"])
         if "wq" in ll:
             q = q + _lora_delta(h, ll["wq"], lora_idx).reshape(q.shape)
             k = k + _lora_delta(h, ll["wk"], lora_idx).reshape(k.shape)
@@ -827,7 +839,7 @@ def forward_decode(
             q, kv_cache, layer_idx, block_tables, kv_lens, k, v)
         ks.append(k)
         vs.append(v)
-        attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        attn_out = _mm("btqd,qdh->bth", attn, lp["wo"])
         if "wo" in ll:
             attn_out = attn_out + _lora_delta(
                 attn.reshape(b, 1, -1), ll["wo"], lora_idx)
@@ -841,7 +853,7 @@ def forward_decode(
                               block_tables, pos2, active[:, None])
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    logits = _mm("bth,hv->btv", x, head).astype(jnp.float32)
     return kv_cache, logits
 
 
@@ -972,9 +984,9 @@ def forward_ring(
     ks, vs = [], []
     for lp in params["layers"]:
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        q = _mm("bth,hqd->btqd", h, lp["wq"])
+        k = _mm("bth,hkd->btkd", h, lp["wk"])
+        v = _mm("bth,hkd->btkd", h, lp["wv"])
         if config.qk_norm:
             q = rms_norm(q, lp["q_norm"], config.rms_eps)
             k = rms_norm(k, lp["k_norm"], config.rms_eps)
@@ -983,7 +995,7 @@ def forward_ring(
         attn = ring_attention_fn(q, k, v, positions, positions, valid)
         ks.append(k)
         vs.append(v)
-        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        x = x + _mm("btqd,qdh->bth", attn, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
@@ -991,7 +1003,7 @@ def forward_ring(
             x = x + _swiglu(h, lp)
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    logits = _mm("bth,hv->btv", x, head).astype(jnp.float32)
     return logits, jnp.stack(ks), jnp.stack(vs)
 
 
@@ -1016,9 +1028,9 @@ def _dense_layer_step(x: jax.Array, lp: dict, config: ModelConfig,
     kh_local = lp["wk"].shape[1]
     group = config.n_q_heads // config.n_kv_heads
     h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-    q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-    k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-    v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+    q = _mm("bth,hqd->btqd", h, lp["wq"])
+    k = _mm("bth,hkd->btkd", h, lp["wk"])
+    v = _mm("bth,hkd->btkd", h, lp["wv"])
     if config.qk_norm:
         q = rms_norm(q, lp["q_norm"], config.rms_eps)
         k = rms_norm(k, lp["k_norm"], config.rms_eps)
@@ -1033,7 +1045,7 @@ def _dense_layer_step(x: jax.Array, lp: dict, config: ModelConfig,
     attn = jnp.einsum("bkgts,bskd->btkgd", weights,
                       v.astype(jnp.float32)).astype(q.dtype)
     attn = attn.reshape(b, t, kh_local * group, config.head_dim)
-    attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+    attn_out = _mm("btqd,qdh->bth", attn, lp["wo"])
     if axis_tp:
         attn_out = jax.lax.psum(attn_out, axis_tp)
     x = x + attn_out
@@ -1251,9 +1263,9 @@ def forward_embed(
     x = params["embed"][tokens]
     for lp in params["layers"]:
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-        k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-        v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+        q = _mm("bth,hqd->btqd", h, lp["wq"])
+        k = _mm("bth,hkd->btkd", h, lp["wk"])
+        v = _mm("bth,hkd->btkd", h, lp["wv"])
         if config.qk_norm:
             q = rms_norm(q, lp["q_norm"], config.rms_eps)
             k = rms_norm(k, lp["k_norm"], config.rms_eps)
@@ -1266,7 +1278,7 @@ def forward_embed(
         weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         attn = jnp.einsum("bkgts,bskd->btkgd", weights.astype(q.dtype), v)
         attn = attn.reshape(b, t, config.n_q_heads, config.head_dim)
-        x = x + jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        x = x + _mm("btqd,qdh->bth", attn, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         if "router" in lp:  # per-layer: DeepSeek stacks mix dense + MoE
             x = x + _moe(h, lp, config)
@@ -1326,9 +1338,9 @@ def forward(
                          if "wq" in ll else None),
             )
         else:
-            q = jnp.einsum("bth,hqd->btqd", h, lp["wq"])
-            k = jnp.einsum("bth,hkd->btkd", h, lp["wk"])
-            v = jnp.einsum("bth,hkd->btkd", h, lp["wv"])
+            q = _mm("bth,hqd->btqd", h, lp["wq"])
+            k = _mm("bth,hkd->btkd", h, lp["wk"])
+            v = _mm("bth,hkd->btkd", h, lp["wv"])
             if "wq" in ll:
                 q = q + _lora_delta(h, ll["wq"], lora_idx).reshape(q.shape)
                 k = k + _lora_delta(h, ll["wk"], lora_idx).reshape(k.shape)
@@ -1342,7 +1354,7 @@ def forward(
                                       block_tables, positions, valid)
             attn = attention(q, kv_cache, layer_idx, block_tables,
                              positions, kv_lens)
-        attn_out = jnp.einsum("btqd,qdh->bth", attn, lp["wo"])
+        attn_out = _mm("btqd,qdh->bth", attn, lp["wo"])
         if "bo" in lp:
             attn_out = attn_out + lp["bo"]
         if "wo" in ll:
@@ -1358,5 +1370,5 @@ def forward(
             x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
+    logits = _mm("bth,hv->btv", x, head).astype(jnp.float32)
     return kv_cache, logits

@@ -120,6 +120,9 @@ _register("DYNT_DECODE_BLOCK", 8, _int,
           "amortizes host dispatch per token; fused blocks also run while "
           "prefill work is pending (prefill chunks interleave between "
           "blocks). Tokens stream in blocks of this size; 1 = per-token")
+_register("DYNT_Q8_MATMUL", "auto", _str,
+          "W8A16 matmul backend for int8 weights: auto (Pallas on TPU, "
+          "XLA reference elsewhere) | pallas | xla")
 _register("DYNT_WEIGHT_SERVICE", "", _str,
           "Unix socket of the weight service (GMS analog): workers "
           "re-attach published weights on restart instead of initializing")

@@ -41,6 +41,7 @@ def main() -> None:
     parser.add_argument("--isl", type=int, default=256)
     parser.add_argument("--osl", type=int, default=64)
     parser.add_argument("--kv-dtype", default="model")
+    parser.add_argument("--weight-dtype", default="model")
     parser.add_argument("--kvbm-host-blocks", type=int, default=0)
     args = parser.parse_args()
 
@@ -59,7 +60,8 @@ def main() -> None:
         RunnerConfig(page_size=args.page_size, num_pages=args.num_pages,
                      max_batch=args.batch,
                      max_pages_per_seq=args.max_pages_per_seq,
-                     prefill_buckets=(256,), kv_dtype=args.kv_dtype),
+                     prefill_buckets=(256,), kv_dtype=args.kv_dtype,
+                     weight_dtype=args.weight_dtype),
         make_mesh(MeshConfig()), seed=0)
     kvbm = None
     if args.kvbm_host_blocks:
@@ -122,7 +124,8 @@ def main() -> None:
         out_toks = tokens_out[0]
         result = {
             "metric": (f"served decode throughput {args.model} "
-                       f"kv={args.kv_dtype} batch<={args.batch} "
+                       f"kv={args.kv_dtype} w={args.weight_dtype} "
+                       f"batch<={args.batch} "
                        f"isl={args.isl} osl={args.osl}"
                        + (f" kvbm_g2={args.kvbm_host_blocks}"
                           if args.kvbm_host_blocks else "")),
