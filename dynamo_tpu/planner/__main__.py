@@ -26,7 +26,12 @@ async def main(argv=None) -> None:
     parser.add_argument("--metrics-url",
                         default="http://127.0.0.1:8000/metrics")
     parser.add_argument("--model", required=True)
-    parser.add_argument("--profile-results-dir", required=True)
+    parser.add_argument("--profile-results-dir", default=None,
+                        help="profiler sweep output; omitted = use the "
+                             "shipped pre-swept profile for --chip/"
+                             "--model (planner/pre_swept/)")
+    parser.add_argument("--chip", default="v5e",
+                        help="chip generation for pre-swept lookup")
     parser.add_argument("--adjustment-interval", type=float, default=180.0)
     parser.add_argument("--ttft", type=float, default=500.0,
                         help="TTFT SLA in ms")
@@ -51,6 +56,18 @@ async def main(argv=None) -> None:
     parser.add_argument("--k8s-deployment", default=None)
     parser.add_argument("--k8s-namespace", default="default")
     args = parser.parse_args(argv)
+
+    if args.profile_results_dir is None:
+        from .interpolation import pre_swept_dir
+
+        args.profile_results_dir = pre_swept_dir(args.model, args.chip)
+        if args.profile_results_dir is None:
+            raise SystemExit(
+                f"no pre-swept profile for chip={args.chip} "
+                f"model={args.model}; pass --profile-results-dir (run "
+                "python -m dynamo_tpu.profiler to generate one)")
+        log.info("using shipped pre-swept profile: %s",
+                 args.profile_results_dir)
 
     config = PlannerConfig(
         adjustment_interval=args.adjustment_interval,

@@ -142,6 +142,21 @@ class DecodeInterpolator:
                 float(self.xi[0]))
 
 
+def pre_swept_dir(model: str, chip: str = "v5e") -> Optional[str]:
+    """Shipped pre-swept profile for (chip, model), or None (ref:
+    planner/utils/pre_swept_results/ — the reference checks in per-GPU
+    NPZ data so the planner boots zero-config). Generated + calibrated
+    to real-chip anchors by scripts/gen_pre_swept.py; provenance sits
+    beside the NPZ files."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "pre_swept", chip, model)
+    if (os.path.exists(os.path.join(path, "decode_raw_data.npz"))
+            and os.path.exists(os.path.join(path,
+                                            "prefill_raw_data.npz"))):
+        return path
+    return None
+
+
 def save_prefill_profile(path: str, isl, ttft_ms, thpt_per_chip) -> str:
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, "prefill_raw_data.npz")
