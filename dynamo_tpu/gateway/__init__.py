@@ -108,21 +108,15 @@ class EppService:
         return web.json_response({
             "data": [{"id": c.name} for c in self.manager.list_models()]})
 
-    async def _pick(self, request):
-        from aiohttp import web
-
-        try:
-            body = await request.json()
-        except (ValueError, UnicodeDecodeError):
-            return web.json_response({"error": "invalid JSON"}, status=400)
+    async def pick(self, body: dict) -> tuple[int, dict]:
+        """Core endpoint-pick: (http_status, payload). Shared by the
+        /v1/pick HTTP edge and the Envoy ext-proc adapter
+        (gateway/ext_proc.py)."""
         entry, _lora = self.manager.resolve(body.get("model", ""))
         if entry is None:
-            return web.json_response(
-                {"error": f"unknown model {body.get('model')!r}"},
-                status=404)
+            return 404, {"error": f"unknown model {body.get('model')!r}"}
         if entry.scheduler is None:
-            return web.json_response(
-                {"error": "model entry has no KV scheduler"}, status=503)
+            return 503, {"error": "model entry has no KV scheduler"}
         token_ids = body.get("token_ids")
         if token_ids is None and body.get("messages") is not None:
             # Chat shape: preprocess EXACTLY like the frontend will (chat
@@ -132,21 +126,20 @@ class EppService:
                 token_ids = entry.preprocessor.preprocess_chat(
                     body).token_ids
             except Exception as exc:  # noqa: BLE001 — bad messages shape
-                return web.json_response({"error": str(exc)}, status=400)
+                return 400, {"error": str(exc)}
         if token_ids is None:
             prompt = body.get("prompt")
             if prompt is None:
-                return web.json_response(
-                    {"error": "need token_ids, messages, or prompt"},
-                    status=400)
+                return 400, {"error":
+                             "need token_ids, messages, or prompt"}
             token_ids = entry.preprocessor.tokenizer.encode(str(prompt))
         try:
             await entry.router.client.start()
             avail = entry.router.available()
         except Exception as exc:  # noqa: BLE001 — no workers yet
-            return web.json_response({"error": repr(exc)}, status=503)
+            return 503, {"error": repr(exc)}
         if not avail:
-            return web.json_response({"error": "no instances"}, status=503)
+            return 503, {"error": "no instances"}
         token_ids = [int(t) for t in token_ids]
         hashes = compute_block_hashes(token_ids,
                                       entry.scheduler.config.block_size)
@@ -162,12 +155,22 @@ class EppService:
             pre = sorted(prefill_pool.instances)[
                 (hashes[0] if hashes else 0) % len(prefill_pool.instances)]
             headers["x-prefill-instance-id"] = f"{pre:x}"
-        return web.json_response({
+        return 200, {
             "instance_id": f"{result.worker.worker_id:x}",
             "overlap_blocks": result.overlap_blocks,
             "logit": result.logit,
             "headers": headers,
-        })
+        }
+
+    async def _pick(self, request):
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        status, payload = await self.pick(body)
+        return web.json_response(payload, status=status)
 
 
 async def main(argv: Optional[list[str]] = None) -> None:
