@@ -139,15 +139,37 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser = argparse.ArgumentParser("dynamo_tpu.diffusion")
     parser.add_argument("--model", required=True,
                         help="served model name (e.g. sd-tiny)")
-    parser.add_argument("--preset", default="dit-b-256",
-                        help="models/diffusion.py PRESETS")
+    parser.add_argument("--preset", default=None,
+                        help="models/diffusion.py PRESETS (image mode, "
+                             "default dit-b-256) or models/diffusion_lm"
+                             ".py DLM_PRESETS (llm mode, default "
+                             "tiny-dlm-test)")
+    parser.add_argument("--mode", default="image",
+                        choices=["image", "llm"],
+                        help="image/video DiT worker, or the LLaDA-class "
+                             "masked-diffusion LLM worker (ref: sglang "
+                             "--diffusion-worker / dllm_algorithm)")
+    parser.add_argument("--dlm-steps", type=int, default=16,
+                        help="denoise steps per block (llm mode)")
+    parser.add_argument("--max-gen-len", type=int, default=128,
+                        help="largest response block (llm mode)")
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="diffusion")
     args = parser.parse_args(argv)
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
-    worker = DiffusionWorker(runtime, args.model, preset=args.preset,
-                             namespace=args.namespace,
-                             component=args.component)
+    if args.mode == "llm":
+        from .llm import DiffusionLmWorker
+
+        worker = DiffusionLmWorker(
+            runtime, args.model,
+            preset=args.preset or "tiny-dlm-test",
+            namespace=args.namespace, component=args.component,
+            default_steps=args.dlm_steps, max_gen_len=args.max_gen_len)
+    else:
+        worker = DiffusionWorker(runtime, args.model,
+                                 preset=args.preset or "dit-b-256",
+                                 namespace=args.namespace,
+                                 component=args.component)
     await worker.start()
     try:
         await wait_for_shutdown_signal()

@@ -1,0 +1,159 @@
+"""Diffusion-LLM worker (LLaDA-class) — the reference's
+`--diffusion-worker` sglang mode (ref: components/src/dynamo/sglang/
+main.py:113 init_llm_diffusion, dllm_algorithm) served TPU-native.
+
+Registers a standard CHAT/COMPLETIONS model card on the `generate`
+endpoint, so every frontend feature (routing, migration, parsers,
+metrics) applies unchanged; only the engine differs — whole-block
+masked denoising (models/diffusion_lm.py) instead of autoregressive
+decode. The response streams as ONE EngineOutput: diffusion commits
+the full block at once, matching the reference's non-streaming dLLM
+handler."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+import numpy as np
+
+from ..llm.model_card import CHAT, COMPLETIONS, ModelDeploymentCard, publish_card
+from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.logging import get_logger
+
+log = get_logger("diffusion.llm")
+
+
+def _bucket(n: int, cap: int) -> int:
+    b = 8
+    while b < n and b < cap:
+        b <<= 1
+    return min(b, cap)
+
+
+class DiffusionLmWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        model_name: str,
+        preset: str = "tiny-dlm-test",
+        namespace: str = "dynamo",
+        component: str = "dlm",
+        default_steps: int = 16,
+        max_gen_len: int = 128,
+        seed: int = 0,
+    ) -> None:
+        from ..models.diffusion_lm import get_dlm_config
+
+        self.runtime = runtime
+        self.instance_id = new_instance_id()
+        self.config, self.mask_id = get_dlm_config(preset)
+        self.default_steps = default_steps
+        self.max_gen_len = max_gen_len
+        self._seed = seed
+        self.params = None  # built in start() (compile off the loop)
+        self.card = ModelDeploymentCard(
+            name=model_name,
+            model_types=[CHAT, COMPLETIONS],
+            namespace=namespace,
+            component=component,
+            endpoint="generate",
+            tokenizer={"kind": "byte"},
+            runtime_config={"diffusion_lm": {
+                "preset": preset, "default_steps": default_steps,
+                "max_gen_len": max_gen_len,
+            }},
+        )
+        self._served = None
+        self._sem = asyncio.Semaphore(1)  # one denoise loop at a time
+
+    async def start(self) -> None:
+        import jax
+
+        from ..models import init_params
+
+        def build():
+            return init_params(jax.random.PRNGKey(self._seed),
+                               config=self.config)
+
+        self.params = await asyncio.to_thread(build)
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("generate")
+        )
+        self._served = await endpoint.serve_endpoint(
+            self.generate, instance_id=self.instance_id)
+        await publish_card(self.runtime, self.card, self.instance_id)
+        log.info("diffusion-LM worker up: model=%s preset=%s instance=%x",
+                 self.card.name, self.config.name, self.instance_id)
+
+    async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
+        request = PreprocessedRequest.from_wire(body)
+        s = request.sampling
+        gen_len = _bucket(max(1, s.max_tokens), self.max_gen_len)
+        try:
+            steps = int(request.annotations.get("dlm_steps")
+                        or min(self.default_steps, gen_len))
+        except (TypeError, ValueError):
+            yield EngineOutput(
+                finish_reason="error",
+                error=("dlm_steps annotation must be an integer, got "
+                       f"{request.annotations.get('dlm_steps')!r}")
+            ).to_wire()
+            return
+        # 0/negative would emit a block of raw [MASK] tokens; huge step
+        # counts are a denial-of-service lever (one forward per step).
+        steps = max(1, min(steps, 256))
+        seed = s.seed
+        if seed is None:
+            seed = abs(hash(request.request_id)) & 0xFFFFFFFF
+        prompt = np.asarray(request.token_ids, np.int32)[None, :]
+        # Keep the prompt inside the model context alongside the block.
+        max_prompt = self.config.max_context - gen_len
+        if max_prompt <= 0:
+            yield EngineOutput(
+                finish_reason="error",
+                error=(f"gen_len {gen_len} exceeds the model context "
+                       f"{self.config.max_context}")).to_wire()
+            return
+        if prompt.shape[1] > max_prompt:
+            yield EngineOutput(
+                finish_reason="error",
+                error=(f"prompt ({prompt.shape[1]} tokens) + block "
+                       f"{gen_len} exceeds context "
+                       f"{self.config.max_context}")).to_wire()
+            return
+
+        def run():
+            import jax.numpy as jnp
+
+            from ..models.diffusion_lm import diffusion_generate
+
+            out = diffusion_generate(
+                self.params, self.config, prompt, gen_len, steps,
+                jnp.int32(self.mask_id), jnp.float32(s.temperature),
+                jnp.uint32(seed))
+            return np.asarray(out)[0]
+
+        async with self._sem:
+            tokens = await asyncio.to_thread(run)
+        tokens = [int(t) for t in tokens[: s.max_tokens]]
+        finish = "length"
+        stop_ids = set(request.eos_token_ids) | \
+            set(request.stop.stop_token_ids)
+        if not request.stop.ignore_eos and stop_ids:
+            for i, t in enumerate(tokens):
+                if t in stop_ids:
+                    tokens = tokens[: i + 1]
+                    finish = "stop"
+                    break
+        yield EngineOutput(
+            token_ids=tokens, finish_reason=finish,
+            prompt_tokens=int(prompt.shape[1]),
+        ).to_wire()
+
+    async def close(self) -> None:
+        if self._served is not None:
+            await self._served.shutdown()
