@@ -1,0 +1,121 @@
+"""Prefill-side floor ablation (VERDICT r4 item 2): locate where the
+non-MXU time in a prefill chunk goes, mirroring bench_ablate2.py's
+monkeypatch-then-time method on the pipelined prefill_chunk path
+bench.py's prefill block uses (the only dispatch pattern the tunnel
+measures faithfully — scan/pipelined benches only).
+
+  full        unmodified prefill_chunk
+  noattn      attention replaced by identity over V-shaped zeros (the
+              projections + MLP remain: isolates SDPA cost)
+  nowrite     write_kv_pages -> identity (no paged-pool writeback)
+  nohead      final-token head matmul + sampler replaced by a dummy
+  nonorm      rms_norm -> identity
+  norope      rope -> identity
+
+Usage: python -u scripts/bench_ablate_prefill.py <what> [model] [chunk]
+(one config per process: monkeypatches must precede jit builds).
+Prints one JSON line: {"ablation": ..., "tokens_per_sec": ..., "mfu"?}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def apply_patch(what: str) -> None:
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import transformer
+
+    if what == "noattn":
+        def fake_attention(q, kv_cache, layer, block_tables, positions,
+                          kv_lens):
+            return jnp.zeros_like(q) + q * 1e-6  # keep deps, kill SDPA
+        transformer.paged_attention_xla = fake_attention
+        # the runner passes an attention_fn; main() below forces None +
+        # DYNT_ATTENTION=xla so this module-level patch is the one used
+    elif what == "nowrite":
+        transformer.write_kv_pages = (
+            lambda kv_cache, layer, k, v, *a, **kw: kv_cache)
+    elif what == "nohead":
+        orig = transformer.forward
+
+        def patched(params, config, tokens, *a, **k):
+            kv, logits = orig(params, config, tokens, *a, **k)
+            fake = jnp.zeros((logits.shape[0], logits.shape[1], 1024),
+                             jnp.float32) + tokens[:, :, None]
+            return kv, fake
+        transformer.forward = patched
+        from dynamo_tpu.engine import model_runner
+
+        model_runner.forward = patched
+    elif what == "nonorm":
+        transformer.rms_norm = lambda x, w, eps=1e-6: x
+    elif what == "norope":
+        transformer.rope = lambda x, positions, theta=10000.0: x
+    elif what != "full":
+        raise SystemExit(f"unknown ablation {what}")
+
+
+def main() -> None:
+    what = sys.argv[1]
+    model = sys.argv[2] if len(sys.argv) > 2 else "mistral-7b"
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    os.environ.setdefault("DYNT_ATTENTION",
+                          "xla" if what == "noattn" else "auto")
+    apply_patch(what)
+    import numpy as np
+
+    from dynamo_tpu.engine import ModelRunner, RunnerConfig
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    config = get_config(model)
+    kv_dtype = os.environ.get("DYNT_BENCH_KV_DTYPE", "int8"
+                              if "7b" in model else "model")
+    page_size = 16
+    pages = chunk // page_size + 2
+    runner = ModelRunner(
+        config,
+        RunnerConfig(page_size=page_size, num_pages=pages + 2,
+                     max_batch=1, max_pages_per_seq=pages,
+                     prefill_buckets=(256, chunk) if chunk > 256
+                     else (256,),
+                     kv_dtype=kv_dtype),
+        make_mesh(MeshConfig()), seed=0)
+    rng = np.random.default_rng(0)
+    table = np.zeros(pages, np.int32)
+    table[: chunk // page_size + 1] = np.arange(
+        1, chunk // page_size + 2)
+    prompt = rng.integers(0, config.vocab_size, chunk).astype(np.int32)
+    n_chunks = 8
+
+    def prefill_pass():
+        pending = [runner.prefill_chunk(prompt, 0, table, chunk,
+                                        (0.0, 1.0, 0, 0),
+                                        return_device=True)
+                   for _ in range(n_chunks)]
+        for tok in pending:
+            np.asarray(tok)
+
+    prefill_pass()  # compile
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        prefill_pass()
+        trials.append(time.perf_counter() - t0)
+    elapsed = sorted(trials)[1]
+    tok_s = n_chunks * chunk / elapsed
+    print(json.dumps({"ablation": what, "model": model, "chunk": chunk,
+                      "tokens_per_sec": round(tok_s, 1),
+                      "us_per_chunk": round(elapsed / n_chunks * 1e6, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
