@@ -158,3 +158,164 @@ class TestEppService:
             await rt.shutdown()
 
         run(body(), timeout=60)
+
+
+class TestExtProcAdapter:
+    """Envoy ext-proc protocol shape (VERDICT r4 missing item 6; ref:
+    deploy/inference-gateway/epp/): a bidi Process stream of
+    request_headers + buffered request_body frames comes back with the
+    header mutation the frontends' direct-routing contract consumes."""
+
+    def test_process_stream_mutates_headers(self, run):
+        import json
+
+        import grpc
+
+        from dynamo_tpu.gateway.ext_proc import (
+            METHOD,
+            ExtProcServer,
+            encode_request_body_frame,
+            encode_request_headers_frame,
+            parse_processing_request,
+        )
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rts = []
+
+            async def rt():
+                r = await DistributedRuntime(_cfg(cluster)).start()
+                rts.append(r)
+                return r
+
+            w = MockerWorker(
+                await rt(), model_name="mock-model",
+                config=MockerConfig(speedup_ratio=500.0,
+                                    num_blocks=256, block_size=16),
+                load_publish_interval=0.2)
+            await w.start()
+            epp = EppService(await rt(), host="127.0.0.1", port=0)
+            await epp.start()
+            ext = await ExtProcServer(epp).start()
+            try:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as session:
+                    for _ in range(100):
+                        async with session.get(
+                                "http://127.0.0.1:"
+                                f"{epp.port}/healthz") as r:
+                            if "mock-model" in (await r.json())["models"]:
+                                break
+                        await asyncio.sleep(0.05)
+                    # reference answer straight from /v1/pick
+                    async with session.post(
+                            f"http://127.0.0.1:{epp.port}/v1/pick",
+                            json={"model": "mock-model",
+                                  "prompt": PROMPT}) as r:
+                        assert r.status == 200
+                        ref = await r.json()
+
+                payload = json.dumps({"model": "mock-model",
+                                      "prompt": PROMPT}).encode()
+                frames = [
+                    encode_request_headers_frame(
+                        {":path": "/v1/chat/completions",
+                         ":method": "POST"}),
+                    encode_request_body_frame(payload),
+                ]
+                async with grpc.aio.insecure_channel(
+                        f"127.0.0.1:{ext.port}") as chan:
+                    call = chan.stream_stream(
+                        METHOD,
+                        request_serializer=None,
+                        response_deserializer=None)
+                    responses = []
+                    stream = call(iter(frames))
+                    async for resp in stream:
+                        responses.append(bytes(resp))
+                        if len(responses) == 2:
+                            break
+                # frame 1: headers CONTINUE; frame 2: body response with
+                # the routing header mutation
+                assert len(responses) == 2
+                from dynamo_tpu.gateway.ext_proc import _fields
+
+                def extract_set_headers(buf):
+                    # ProcessingResponse.request_body(3).response(1)
+                    #   .header_mutation(2).set_headers(1)
+                    #   .header(1).{key(1), raw_value(3)}
+                    out = {}
+                    for n, _w, p in _fields(buf):
+                        if n != 3:
+                            continue
+                        for n1, _w1, p1 in _fields(p):
+                            if n1 != 1:
+                                continue
+                            for n2, _w2, p2 in _fields(p1):
+                                if n2 != 2:
+                                    continue
+                                for n3, _w3, p3 in _fields(p2):
+                                    if n3 != 1:
+                                        continue
+                                    for n4, _w4, p4 in _fields(p3):
+                                        if n4 != 1:
+                                            continue
+                                        key = val = ""
+                                        for n5, _w5, p5 in _fields(p4):
+                                            if n5 == 1:
+                                                key = p5.decode()
+                                            elif n5 == 3:
+                                                val = p5.decode()
+                                        out[key] = val
+                    return out
+
+                muts = extract_set_headers(responses[1])
+                assert muts.get("x-worker-instance-id") == \
+                    ref["headers"]["x-worker-instance-id"]
+                # the server parsed our client frames symmetrically
+                kind, info = parse_processing_request(frames[0])
+                assert kind == "request_headers"
+                assert info["headers"][":method"] == "POST"
+            finally:
+                await ext.close()
+                await epp.close()
+                for r in rts:
+                    await r.shutdown()
+
+        run(body(), timeout=90.0)
+
+    def test_bad_body_gets_immediate_response(self, run):
+        import grpc
+
+        from dynamo_tpu.gateway.ext_proc import (
+            METHOD,
+            ExtProcServer,
+            _fields,
+            encode_request_body_frame,
+        )
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            epp = EppService(rt, host="127.0.0.1", port=0)
+            await epp.start()
+            ext = await ExtProcServer(epp).start()
+            try:
+                async with grpc.aio.insecure_channel(
+                        f"127.0.0.1:{ext.port}") as chan:
+                    call = chan.stream_stream(METHOD,
+                                              request_serializer=None,
+                                              response_deserializer=None)
+                    stream = call(iter(
+                        [encode_request_body_frame(b"not json")]))
+                    resp = bytes(await stream.read())
+                # ProcessingResponse.immediate_response(7)
+                nums = [n for n, _w, _p in _fields(resp)]
+                assert nums == [7]
+            finally:
+                await ext.close()
+                await epp.close()
+                await rt.shutdown()
+
+        run(body(), timeout=60.0)
