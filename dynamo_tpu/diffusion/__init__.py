@@ -88,9 +88,25 @@ class DiffusionWorker:
         steps = max(1, min(int(body.get("steps", 20)), 100))
         n_frames = max(1, min(int(body.get("frames", 1)), 16))
         seed = int(body.get("seed", 0))
+        negative = body.get("negative_prompt")
+        if negative is not None and not isinstance(negative, str):
+            yield {"error": "negative_prompt must be a string"}
+            return
+        try:
+            guidance = float(body.get("guidance_scale", 1.0))
+        except (TypeError, ValueError):
+            yield {"error": "guidance_scale must be a number"}
+            return
+        guidance = max(0.0, min(guidance, 20.0))
+        if negative and guidance == 1.0:
+            # scale 1.0 reduces CFG to the conditional branch exactly —
+            # a negative prompt would silently do nothing; give it the
+            # conventional default strength instead.
+            guidance = 3.0
         try:
             out = await asyncio.to_thread(
-                self.runner.generate, prompt, n, steps, seed, n_frames)
+                self.runner.generate, prompt, n, steps, seed, n_frames,
+                negative, guidance)
         except Exception as exc:  # noqa: BLE001 — report to the caller
             log.exception("generation failed")
             yield {"error": f"generation failed: {exc}"}
@@ -153,6 +169,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         help="denoise steps per block (llm mode)")
     parser.add_argument("--max-gen-len", type=int, default=128,
                         help="largest response block (llm mode)")
+    parser.add_argument("--dlm-block-len", type=int, default=32,
+                        help="tokens committed per denoise block; longer "
+                             "responses continue semi-autoregressively "
+                             "(llm mode)")
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="diffusion")
     args = parser.parse_args(argv)
@@ -164,7 +184,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
             runtime, args.model,
             preset=args.preset or "tiny-dlm-test",
             namespace=args.namespace, component=args.component,
-            default_steps=args.dlm_steps, max_gen_len=args.max_gen_len)
+            default_steps=args.dlm_steps, max_gen_len=args.max_gen_len,
+            block_len=args.dlm_block_len)
     else:
         worker = DiffusionWorker(runtime, args.model,
                                  preset=args.preset or "dit-b-256",

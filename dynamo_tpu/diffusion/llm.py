@@ -42,6 +42,7 @@ class DiffusionLmWorker:
         component: str = "dlm",
         default_steps: int = 16,
         max_gen_len: int = 128,
+        block_len: int = 32,
         seed: int = 0,
     ) -> None:
         from ..models.diffusion_lm import get_dlm_config
@@ -51,6 +52,10 @@ class DiffusionLmWorker:
         self.config, self.mask_id = get_dlm_config(preset)
         self.default_steps = default_steps
         self.max_gen_len = max_gen_len
+        # Semi-autoregressive continuation (LLaDA long-form mode): one
+        # denoise pass commits `block_len` tokens; longer responses loop
+        # blocks with the committed prefix re-conditioned each time.
+        self.block_len = block_len
         self._seed = seed
         self.params = None  # built in start() (compile off the loop)
         self.card = ModelDeploymentCard(
@@ -109,49 +114,73 @@ class DiffusionLmWorker:
         seed = s.seed
         if seed is None:
             seed = abs(hash(request.request_id)) & 0xFFFFFFFF
-        prompt = np.asarray(request.token_ids, np.int32)[None, :]
-        # Keep the prompt inside the model context alongside the block.
-        max_prompt = self.config.max_context - gen_len
-        if max_prompt <= 0:
+        prompt_ids = [int(t) for t in request.token_ids]
+        # Validate with the BUCKETED first-block size — the loop rounds
+        # blocks up to jit buckets, so the unbucketed size would admit
+        # requests the loop immediately context-caps to zero tokens.
+        first_block = _bucket(min(self.block_len, s.max_tokens),
+                              self.max_gen_len)
+        if len(prompt_ids) + first_block > self.config.max_context:
             yield EngineOutput(
                 finish_reason="error",
-                error=(f"gen_len {gen_len} exceeds the model context "
-                       f"{self.config.max_context}")).to_wire()
-            return
-        if prompt.shape[1] > max_prompt:
-            yield EngineOutput(
-                finish_reason="error",
-                error=(f"prompt ({prompt.shape[1]} tokens) + block "
-                       f"{gen_len} exceeds context "
+                error=(f"prompt ({len(prompt_ids)} tokens) + a "
+                       f"{first_block}-token generation block exceeds "
+                       f"the model context "
                        f"{self.config.max_context}")).to_wire()
             return
 
-        def run():
+        def run_block(prefix_list: list[int], block: int,
+                      block_seed: int) -> list[int]:
             import jax.numpy as jnp
 
-            from ..models.diffusion_lm import diffusion_generate
+            from ..models.diffusion_lm import diffusion_generate_block
 
-            out = diffusion_generate(
-                self.params, self.config, prompt, gen_len, steps,
+            plen = len(prefix_list)
+            tp_pad = _bucket(plen, self.config.max_context - block)
+            prefix = np.zeros((1, tp_pad), np.int32)
+            prefix[0, :plen] = prefix_list
+            valid = np.zeros((1, tp_pad), bool)
+            valid[0, :plen] = True
+            out = diffusion_generate_block(
+                self.params, self.config, prefix, valid,
+                np.asarray([plen], np.int32), block, steps,
                 jnp.int32(self.mask_id), jnp.float32(s.temperature),
-                jnp.uint32(seed))
-            return np.asarray(out)[0]
+                jnp.uint32(block_seed))
+            return [int(t) for t in np.asarray(out)[0]]
 
-        async with self._sem:
-            tokens = await asyncio.to_thread(run)
-        tokens = [int(t) for t in tokens[: s.max_tokens]]
+        # Semi-autoregressive block loop (LLaDA long-form): each block
+        # re-conditions on prompt + committed tokens; EOS inside a
+        # committed block ends the response there.
+        committed: list[int] = []
         finish = "length"
         stop_ids = set(request.eos_token_ids) | \
             set(request.stop.stop_token_ids)
-        if not request.stop.ignore_eos and stop_ids:
-            for i, t in enumerate(tokens):
-                if t in stop_ids:
-                    tokens = tokens[: i + 1]
-                    finish = "stop"
+        async with self._sem:
+            while len(committed) < s.max_tokens:
+                remaining = s.max_tokens - len(committed)
+                block = _bucket(min(self.block_len, remaining),
+                                self.max_gen_len)
+                prefix_list = prompt_ids + committed
+                if len(prefix_list) + block > self.config.max_context:
+                    break  # context-capped: return what's committed
+                toks = await asyncio.to_thread(
+                    run_block, prefix_list, block,
+                    (seed + len(committed)) & 0xFFFFFFFF)
+                toks = toks[:remaining]
+                stopped = False
+                if not request.stop.ignore_eos and stop_ids:
+                    for i, t in enumerate(toks):
+                        if t in stop_ids:
+                            toks = toks[: i + 1]
+                            finish = "stop"
+                            stopped = True
+                            break
+                committed.extend(toks)
+                if stopped:
                     break
         yield EngineOutput(
-            token_ids=tokens, finish_reason=finish,
-            prompt_tokens=int(prompt.shape[1]),
+            token_ids=committed, finish_reason=finish,
+            prompt_tokens=len(prompt_ids),
         ).to_wire()
 
     async def close(self) -> None:

@@ -403,10 +403,16 @@ class HttpService:
                 "steps": int(body.get("steps", 20)),
                 "seed": int(body.get("seed", 0)),
                 "frames": n_frames,
+                # classifier-free guidance (production diffusion
+                # sampling): scale > 1 steers away from negative_prompt
+                # (or empty conditioning)
+                "guidance_scale": float(body.get("guidance_scale", 1.0)),
+                "negative_prompt": body.get("negative_prompt"),
             }
         except (TypeError, ValueError):
             return web.json_response(_error_body(
-                400, "n/steps/seed must be integers"), status=400)
+                400, "n/steps/seed/guidance_scale must be numbers"),
+                status=400)
         if not request["prompt"]:
             return web.json_response(
                 _error_body(400, "'prompt' is required"), status=400)

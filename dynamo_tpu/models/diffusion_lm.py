@@ -38,13 +38,21 @@ from .transformer import rms_norm, rope
 
 
 def bidirectional_forward(params: dict, config: ModelConfig,
-                          tokens: jax.Array) -> jax.Array:
+                          tokens: jax.Array,
+                          positions: jax.Array = None,
+                          valid: jax.Array = None) -> jax.Array:
     """[B, T] -> logits [B, T, V]: the dense-family layer stack with
     FULL (bidirectional) attention — the mask-predictor network of a
     masked-diffusion LM. Cited sites: same projections as
-    transformer.forward's dense branch; no cache, no causal mask."""
+    transformer.forward's dense branch; no cache, no causal mask.
+
+    `positions`/`valid` support PADDED prefixes (semi-autoregressive
+    block continuation pads prompt+committed to a bucket): invalid key
+    positions are masked out of every score row, and positions carry
+    the true RoPE indices so padding gaps don't shift the block."""
     b, t = tokens.shape
-    positions = jnp.arange(t)[None, :]
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     x = params["embed"][tokens]
     for lp in params["layers"]:
         h = rms_norm(x, lp["attn_norm"], config.rms_eps)
@@ -62,6 +70,9 @@ def bidirectional_forward(params: dict, config: ModelConfig,
                             qg.astype(jnp.float32),
                             k.astype(jnp.float32))
         scores = scores / jnp.sqrt(float(config.head_dim))
+        if valid is not None:
+            scores = jnp.where(valid[:, None, None, None, :],
+                               scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)  # FULL attention
         attn = jnp.einsum("btkgs,bskh->btkgh", probs,
                           v.astype(jnp.float32))
@@ -79,7 +90,6 @@ def bidirectional_forward(params: dict, config: ModelConfig,
     return jnp.einsum("bth,hv->btv", x, head).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("config", "gen_len", "steps"))
 def diffusion_generate(
     params: dict,
     config: ModelConfig,
@@ -90,18 +100,49 @@ def diffusion_generate(
     temperature: jax.Array,  # scalar f32; 0 = greedy
     seed: jax.Array,  # scalar uint32
 ) -> jax.Array:
-    """-> [B, gen_len] denoised response tokens. One compiled program:
-    S bidirectional passes with cumulative confidence-scheduled
-    unmasking (LLaDA/MaskGIT low-confidence remasking)."""
+    """-> [B, gen_len] denoised response tokens: the unpadded
+    single-block case of diffusion_generate_block (all-valid prefix,
+    contiguous positions)."""
     b, tp = prompt.shape
+    return diffusion_generate_block(
+        params, config, jnp.asarray(prompt, jnp.int32),
+        jnp.ones((b, tp), bool), jnp.full((b,), tp, jnp.int32),
+        gen_len, steps, mask_id, temperature, seed)
+
+
+@partial(jax.jit, static_argnames=("config", "gen_len", "steps"))
+def diffusion_generate_block(
+    params: dict,
+    config: ModelConfig,
+    prefix: jax.Array,  # [B, Tp_pad] prompt + committed blocks, padded
+    prefix_valid: jax.Array,  # [B, Tp_pad] bool
+    prefix_len: jax.Array,  # [B] true prefix length (positions source)
+    gen_len: int,
+    steps: int,
+    mask_id: jax.Array,
+    temperature: jax.Array,
+    seed: jax.Array,
+) -> jax.Array:
+    """Semi-autoregressive continuation (LLaDA's long-form mode): denoise
+    ONE gen_len block conditioned on the padded prefix. The prefix pads
+    to a bucket so jit specializations stay finite as committed blocks
+    grow; padding is masked out of attention and RoPE positions skip it,
+    so the result equals an unpadded run."""
+    b, tp = prefix.shape
     gen0 = jnp.full((b, gen_len), mask_id, jnp.int32)
-    x0 = jnp.concatenate([prompt.astype(jnp.int32), gen0], axis=1)
+    x0 = jnp.concatenate([prefix.astype(jnp.int32), gen0], axis=1)
+    prefix_pos = jnp.broadcast_to(jnp.arange(tp)[None, :], (b, tp))
+    gen_pos = prefix_len[:, None] + jnp.arange(gen_len)[None, :]
+    positions = jnp.concatenate([prefix_pos, gen_pos], axis=1)
+    valid = jnp.concatenate(
+        [prefix_valid, jnp.ones((b, gen_len), bool)], axis=1)
     base_key = jax.random.PRNGKey(seed)
 
     def step(carry, s):
-        x, fixed = carry  # fixed: [B, gen_len] bool — committed tokens
-        logits = bidirectional_forward(params, config, x)
-        gen_logits = logits[:, tp:, :]  # [B, gen_len, V]
+        x, fixed = carry
+        logits = bidirectional_forward(params, config, x,
+                                       positions=positions, valid=valid)
+        gen_logits = logits[:, tp:, :]
         key = jax.random.fold_in(base_key, s)
         gumbel = jax.random.gumbel(key, gen_logits.shape,
                                    dtype=jnp.float32)
@@ -110,25 +151,20 @@ def diffusion_generate(
         pred = jnp.argmax(noisy, axis=-1).astype(jnp.int32)
         logp = jax.nn.log_softmax(gen_logits, axis=-1)
         conf = jnp.take_along_axis(logp, pred[..., None],
-                                   axis=-1)[..., 0]  # [B, gen_len]
-        # Already-committed tokens keep their values and always rank
-        # first; the cumulative unmask count follows the linear LLaDA
-        # schedule: round(gen_len * (s+1)/S) fixed after step s.
+                                   axis=-1)[..., 0]
         conf = jnp.where(fixed, jnp.inf, conf)
         n_keep = jnp.round(gen_len * (s + 1).astype(jnp.float32)
                            / steps).astype(jnp.int32)
-        order = jnp.argsort(-conf, axis=-1)  # best first
+        order = jnp.argsort(-conf, axis=-1)
         rank = jnp.argsort(order, axis=-1)
         keep = rank < n_keep
         gen_tokens = jnp.where(fixed, x[:, tp:],
                                jnp.where(keep, pred, mask_id))
-        new_fixed = fixed | keep
-        x_new = jnp.concatenate([x[:, :tp], gen_tokens], axis=1)
-        return (x_new, new_fixed), None
+        return (jnp.concatenate([x[:, :tp], gen_tokens], axis=1),
+                fixed | keep), None
 
     (x_final, _), _ = jax.lax.scan(
-        step, (x0, jnp.zeros((b, gen_len), bool)),
-        jnp.arange(steps))
+        step, (x0, jnp.zeros((b, gen_len), bool)), jnp.arange(steps))
     return x_final[:, tp:]
 
 

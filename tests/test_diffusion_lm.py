@@ -76,6 +76,33 @@ class TestDenoising:
                 jnp.float32(0.0), jnp.uint32(0)))
             assert not (out == mask_id).any(), steps
 
+    def test_padded_prefix_equals_unpadded(self, dlm):
+        """Semi-autoregressive block conditioning: a prefix padded to a
+        bucket (attention-masked, positions skipping the pad) must
+        produce the SAME block as the unpadded run — the invariant the
+        long-form worker loop relies on."""
+        from dynamo_tpu.models.diffusion_lm import (
+            diffusion_generate_block,
+        )
+
+        config, mask_id, params = dlm
+        prefix_list = [3, 4, 5, 6, 7, 8]
+        plen = len(prefix_list)
+        base = np.asarray(diffusion_generate(
+            params, config, jnp.asarray([prefix_list], jnp.int32), 8, 4,
+            jnp.int32(mask_id), jnp.float32(0.0), jnp.uint32(0)))
+        for pad_to in (plen, 16):
+            prefix = np.zeros((1, pad_to), np.int32)
+            prefix[0, :plen] = prefix_list
+            valid = np.zeros((1, pad_to), bool)
+            valid[0, :plen] = True
+            out = np.asarray(diffusion_generate_block(
+                params, config, jnp.asarray(prefix),
+                jnp.asarray(valid), jnp.asarray([plen], jnp.int32),
+                8, 4, jnp.int32(mask_id), jnp.float32(0.0),
+                jnp.uint32(0)))
+            np.testing.assert_array_equal(out, base, err_msg=str(pad_to))
+
 
 class TestServedE2E:
     def test_chat_through_frontend(self, run):
@@ -99,7 +126,10 @@ class TestServedE2E:
             cluster = uuid.uuid4().hex
             rt = await DistributedRuntime(_cfg(cluster)).start()
             worker = DiffusionLmWorker(rt, model_name="llada-tiny",
-                                       default_steps=4, max_gen_len=16)
+                                       default_steps=4, max_gen_len=16,
+                                       block_len=8)
+            # max_tokens 12 > block_len 8: the response spans TWO
+            # semi-autoregressive blocks (8 + 4)
             await worker.start()
             frt = await DistributedRuntime(_cfg(cluster)).start()
             fe = Frontend(frt, host="127.0.0.1", port=0)
