@@ -199,6 +199,11 @@ def bench_one(model: str, *, model_path: str | None = None,
         # W8A16 streams int8 projections (+ negligible scale rows);
         # embeddings/norms stay bf16 but the projections dominate.
         param_bytes //= 2
+    elif weight_dtype == "int4":
+        # W4A16: 0.5 B/weight packed + f32 scale+zero rows per group
+        # (8 B / group weights) vs 2 B bf16.
+        q4_group = int(os.environ.get("DYNT_Q4_GROUP", "256"))
+        param_bytes = int(param_bytes * (0.5 + 8.0 / q4_group) / 2.0)
     bytes_per_step = param_bytes + kv_bytes_per_step
     roofline_steps = hbm * 1e9 / bytes_per_step
     roofline_tok = roofline_steps * batch
@@ -365,15 +370,18 @@ def main() -> None:
         return
 
     # Flagship-first (VERDICT r4 item 3): the driver-captured headline is
-    # the representative 7B config in its FASTEST serving shape — W8A16
-    # weights (Pallas int8 matmuls, ops/q8_linear.py: 1.69x decode over
-    # bf16 weights, measured r5) + int8 KV (required at 7B: bf16 weights
-    # + bf16 KV exceed HBM; with int8 weights it remains the capacity
-    # lever). Secondaries: the bf16-weight 7B config and the toy.
-    result = bench_one("mistral-7b", kv_dtype="int8", weight_dtype="int8",
+    # the representative 7B config in its FASTEST serving shape — W4A16
+    # weights (packed-int4 Pallas matmuls, ops/q4_linear.py: 2.87x decode
+    # over bf16 weights / 1.70x over W8A16, measured r5) + int8 KV (the
+    # capacity lever; at 7B bf16 weights + bf16 KV exceed HBM).
+    # Secondaries: the int8- and bf16-weight 7B configs and the toy.
+    result = bench_one("mistral-7b", kv_dtype="int8", weight_dtype="int4",
                        num_pages=448, device_kind=device_kind)
     secondary = []
     for label, kwargs in (
+        ("mistral-7b int8 weights",
+         dict(kv_dtype="int8", weight_dtype="int8", num_pages=448,
+              do_ttft=False)),
         ("mistral-7b bf16 weights",
          dict(kv_dtype="int8", num_pages=448, do_ttft=False)),
         ("qwen3-0.6b", dict(do_ttft=False)),

@@ -92,6 +92,10 @@ class DiffusionWorker:
         if negative is not None and not isinstance(negative, str):
             yield {"error": "negative_prompt must be a string"}
             return
+        # "" means "no negative prompt": normalizing here keeps the
+        # runner's `negative_prompt is not None` CFG gate from running
+        # the doubled-batch path for an identical result.
+        negative = negative or None
         try:
             guidance = float(body.get("guidance_scale", 1.0))
         except (TypeError, ValueError):

@@ -176,25 +176,24 @@ class ModelRunner:
             None if self._attention_user_supplied or model_config.is_gptoss
             else _default_decode_attention_fn(mesh))
         axes = param_axes(model_config)
-        if runner_config.weight_dtype not in ("model", "int8"):
+        if runner_config.weight_dtype not in ("model", "int8", "int4"):
             raise ValueError(
                 f"unknown weight_dtype {runner_config.weight_dtype!r} "
-                "(expected 'model' or 'int8')")
-        self._weight_quantized = runner_config.weight_dtype == "int8"
+                "(expected 'model', 'int8', or 'int4')")
+        self._weight_quantized = runner_config.weight_dtype in ("int8",
+                                                                "int4")
         self._raw_param_sharding = None
         if self._weight_quantized:
-            from ..models.quantize import (
-                check_quantizable,
-                quantize_param_axes,
-            )
+            from ..models.quantize import check_quantizable
 
             check_quantizable(model_config,
                               tp=int(dict(mesh.shape).get("tp", 1)),
-                              n_devices=mesh.devices.size)
+                              n_devices=mesh.devices.size,
+                              dtype=runner_config.weight_dtype)
             # Raw tree places un-quantized inputs (checkpoints, random
             # init) before the device-side quantize transform.
             self._raw_param_sharding = param_shardings(mesh, axes)
-            axes = quantize_param_axes(axes, model_config)
+            axes = self._quantize_axes(axes, model_config)
         self._param_sharding = param_shardings(mesh, axes)
         if runner_config.kv_dtype not in ("model", "int8"):
             raise ValueError(
@@ -226,15 +225,28 @@ class ModelRunner:
         else:
             self._kv_sharding = base_kv_sharding
         def _already_quantized(p) -> bool:
-            return any(isinstance(leaf, dict) and "q8" in leaf
-                       for leaf in p["layers"][0].values())
+            """True when the incoming pytree already carries THIS
+            runner's quantized leaves; a tree quantized in the other
+            dtype (e.g. an int8 weight-service stream re-attached by an
+            int4 runner) is rejected up front — silently accepting it
+            would die later on an opaque pytree-structure mismatch."""
+            want = "q4" if runner_config.weight_dtype == "int4" else "q8"
+            other = "q8" if want == "q4" else "q4"
+            leaves = [leaf for leaf in p["layers"][0].values()
+                      if isinstance(leaf, dict)]
+            if any(other in leaf for leaf in leaves):
+                raise ValueError(
+                    f"params are already quantized as '{other}' but this "
+                    f"runner wants weight_dtype="
+                    f"{runner_config.weight_dtype!r}; re-publish the "
+                    "weights unquantized or match the weight_dtype")
+            return any(want in leaf for leaf in leaves)
 
         if params is None:
             if self._weight_quantized:
-                from ..models.quantize import quantize_params_int8
-
+                quantize = self._quantize_params_fn()
                 init = jax.jit(
-                    lambda key: quantize_params_int8(
+                    lambda key: quantize(
                         init_params(key, config=model_config),
                         model_config),
                     out_shardings=self._param_sharding,
@@ -249,15 +261,14 @@ class ModelRunner:
             # Host arrays (checkpoint / random): place raw, quantize on
             # device (one-time cost at load). Weight-service re-attach
             # streams the ALREADY-quantized pytree and skips this.
-            from ..models.quantize import quantize_params_int8
-
+            quantize = self._quantize_params_fn()
             params = jax.tree.map(jax.device_put, params,
                                   self._raw_param_sharding)
-            # donate: a 7B's bf16 params + int8 copy would exceed HBM if
-            # both were live; donation lets XLA retire each bf16 leaf as
-            # its quantized form materializes.
+            # donate: a 7B's bf16 params + quantized copy would exceed
+            # HBM if both were live; donation lets XLA retire each bf16
+            # leaf as its quantized form materializes.
             params = jax.jit(
-                lambda p: quantize_params_int8(p, model_config),
+                lambda p: quantize(p, model_config),
                 out_shardings=self._param_sharding,
                 donate_argnums=0,
             )(params)
@@ -306,6 +317,26 @@ class ModelRunner:
         self.decode_steps = 0
 
     # -- compiled step builders -------------------------------------------
+
+    def _quantize_params_fn(self):
+        """Device-side weight-quantize transform for the configured
+        weight_dtype (models/quantize.py)."""
+        if self.config.weight_dtype == "int4":
+            from ..models.quantize import quantize_params_int4
+
+            return quantize_params_int4
+        from ..models.quantize import quantize_params_int8
+
+        return quantize_params_int8
+
+    def _quantize_axes(self, axes, model_config):
+        if self.config.weight_dtype == "int4":
+            from ..models.quantize import quantize_param_axes_q4
+
+            return quantize_param_axes_q4(axes, model_config)
+        from ..models.quantize import quantize_param_axes
+
+        return quantize_param_axes(axes, model_config)
 
     def _build_decode(self, with_logprobs: bool = False,
                       with_logits: bool = False):
@@ -850,15 +881,13 @@ class ModelRunner:
             self._decode_attention_fn = _default_decode_attention_fn(mesh)
         axes = param_axes(self.model_config)
         if self._weight_quantized:
-            from ..models.quantize import (
-                check_quantizable,
-                quantize_param_axes,
-            )
+            from ..models.quantize import check_quantizable
 
             check_quantizable(self.model_config,
                               tp=int(dict(mesh.shape).get("tp", 1)),
-                              n_devices=mesh.devices.size)
-            axes = quantize_param_axes(axes, self.model_config)
+                              n_devices=mesh.devices.size,
+                              dtype=self.config.weight_dtype)
+            axes = self._quantize_axes(axes, self.model_config)
         self._param_sharding = param_shardings(mesh, axes)
         base_kv_sharding = kv_cache_sharding(
             mesh, head_sharded=not self.model_config.is_mla

@@ -1027,11 +1027,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
                              "same-geometry disagg via packed uint8 "
                              "transfer blocks)")
     parser.add_argument("--weight-dtype", default="model",
-                        choices=["model", "int8"],
-                        help="Weight storage: model dtype (bf16) or "
+                        choices=["model", "int8", "int4"],
+                        help="Weight storage: model dtype (bf16), "
                              "weight-only int8 (W8A16 Pallas matmuls — "
-                             "halves decode weight streaming; dense "
-                             "llama/mistral/qwen family, tp=1)")
+                             "halves decode weight streaming), or packed "
+                             "int4 (W4A16, per-group scale/zero — "
+                             "quarters it; dense llama/mistral/qwen "
+                             "family, tp=1)")
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)

@@ -24,16 +24,18 @@ from __future__ import annotations
 from ..ops.q8_linear import QUANT_LEAVES, quantize_weight
 
 
-def check_quantizable(config, tp: int = 1, n_devices: int = 1) -> None:
+def check_quantizable(config, tp: int = 1, n_devices: int = 1,
+                      dtype: str = "int8") -> None:
     if config.is_mla or config.is_gptoss or config.n_experts:
         raise ValueError(
-            "weight_dtype='int8' supports the dense llama/mistral/qwen "
-            f"family in v1 ({config.name} is MLA/MoE/gpt-oss)")
+            f"weight_dtype='{dtype}' supports the dense "
+            f"llama/mistral/qwen family in v1 ({config.name} is "
+            "MLA/MoE/gpt-oss)")
     if tp != 1 or n_devices != 1:
         raise ValueError(
-            "weight_dtype='int8' is single-device in v1 (the Pallas "
-            "W8A16 kernel is not shard_map-wrapped yet); it targets the "
-            "single-chip 7-8B HBM-bound configuration")
+            f"weight_dtype='{dtype}' is single-device in v1 (the Pallas "
+            "dequant kernels are not shard_map-wrapped yet); it targets "
+            "the single-chip 7-8B HBM-bound configuration")
 
 
 def quantize_params_int8(params: dict, config) -> dict:
@@ -72,4 +74,52 @@ def quantize_param_axes(axes: dict, config) -> dict:
     if "lm_head" in axes and not config.tie_embeddings:
         out["lm_head"] = {"q8": axes["lm_head"],
                           "qs": tuple(axes["lm_head"][1:])}
+    return out
+
+
+# --- W4A16 (packed int4 + per-group scale/zero, ops/q4_linear.py) ----
+
+
+def quantize_params_int4(params: dict, config) -> dict:
+    """Device-side transform: packed-int4 projection leaves
+    ({"q4","qs4","qz4"}). Same scope as int8 (dense family, tp=1)."""
+    from ..ops.q4_linear import QUANT_LEAVES as Q4_LEAVES
+    from ..ops.q4_linear import quantize_weight_q4
+
+    check_quantizable(config, dtype="int4")
+    out = dict(params)
+    out["layers"] = [
+        {name: (quantize_weight_q4(leaf, Q4_LEAVES[name])
+                if name in Q4_LEAVES else leaf)
+         for name, leaf in layer.items()}
+        for layer in params["layers"]
+    ]
+    if "lm_head" in params and not config.tie_embeddings:
+        out["lm_head"] = quantize_weight_q4(params["lm_head"],
+                                            Q4_LEAVES["lm_head"])
+    return out
+
+
+def quantize_param_axes_q4(axes: dict, config) -> dict:
+    """Logical-axes mirror of quantize_params_int4. int4 is
+    single-device in v1 (check_quantizable), so every quantized leaf is
+    replicated: q4 keeps the weight's rank (flattened to 2 for wo whose
+    pack blocks span heads), scales/zeros are rank-2 [K//128, N]."""
+    from ..ops.q4_linear import QUANT_LEAVES as Q4_LEAVES
+
+    def q(name, tup):
+        if name not in Q4_LEAVES:
+            return tup
+        rank = 2 if name == "wo" else len(tup)
+        return {"q4": (None,) * rank, "qs4": (None, None),
+                "qz4": (None, None)}
+
+    out = dict(axes)
+    out["layers"] = [
+        {name: q(name, tup) for name, tup in layer.items()}
+        for layer in axes["layers"]
+    ]
+    if "lm_head" in axes and not config.tie_embeddings:
+        out["lm_head"] = {"q4": (None, None), "qs4": (None, None),
+                          "qz4": (None, None)}
     return out

@@ -433,11 +433,16 @@ def _gptoss_attention_block(
 
 
 def _mm(spec: str, x: jax.Array, w) -> jax.Array:
-    """Dense projection that transparently supports weight-only int8
-    leaves ({"q8","qs"} — models/quantize.py): quantized weights route
-    through the Pallas W8A16 kernel (ops/q8_linear.py) so the bf16
-    weight never materializes in HBM."""
+    """Dense projection that transparently supports weight-only
+    quantized leaves (models/quantize.py): int8 {"q8","qs"} routes
+    through the Pallas W8A16 kernel (ops/q8_linear.py), packed int4
+    {"q4","qs4","qz4"} through the W4A16 kernel (ops/q4_linear.py) —
+    either way the bf16 weight never materializes in HBM."""
     if isinstance(w, dict):
+        if "q4" in w:
+            from ..ops.q4_linear import q4_einsum
+
+            return q4_einsum(spec, x, w["q4"], w["qs4"], w["qz4"])
         from ..ops.q8_linear import q8_einsum
 
         return q8_einsum(spec, x, w["q8"], w["qs"])

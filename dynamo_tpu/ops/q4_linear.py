@@ -1,0 +1,287 @@
+"""Weight-only int4 linear layers (W4A16) — the second halving of the
+decode weight stream.
+
+W8A16 (ops/q8_linear.py) halves the bytes decode streams from HBM every
+step; W4A16 halves them again: the dense projection stack is stored as
+packed 4-bit codes (two per byte) with per-group asymmetric scale/zero
+rows (group = PACK_BLOCK contracted rows; DYNT_Q4_GROUP=128 gives the
+finer GPTQ/AWQ-convention groups), so a 7B's projections drop from
+~14.5 GB (bf16) to ~3.6 GB streamed per decode step. The Pallas kernel
+dequantizes IN VMEM — packed bytes stream from HBM, nibbles unpack on
+the VPU, and the MXU consumes bf16 tiles — so the bf16 (or even int8)
+weight never exists in HBM.
+
+Math: per-group asymmetric codes dequantize as (u - z) * s with s, z
+constant over each contracted group. The kernel processes whole groups
+per k-step, computing per group
+  acc += (x_blk @ u_blk - colsum(x_blk) * z_row) * s_row
+which equals x @ dequant(u) restricted to that group: the scale has no
+contracted axis within a group so it factors out of the partial dot,
+and the integer zero-point folds into a rank-1 correction instead of
+touching the weight tile (one fewer VPU pass over every element).
+
+Packed layout: within each group of `group` contracted rows, byte row r
+holds code row r in its LOW nibble and code row r + group//2 in its
+HIGH nibble. Unpacking is therefore two contiguous half-groups — no
+lane/sublane interleave inside the kernel, just two half-contraction
+dots against x's matching column halves.
+
+The reference reaches this lever through its engines' 4-bit checkpoint
+modes (vLLM/TRT-LLM AWQ/GPTQ w4a16 paths); BASELINE.md names weight
+streaming as the decode floor at 7B.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Preferred contracted rows per quantization group (the packed layout
+# bakes the group in — see module docstring). 256 measured fastest on
+# v5e (706 tok/s decode at 7B vs 615 at group 128 — BASELINE.md r5);
+# DYNT_Q4_GROUP=128 selects the finer GPTQ/AWQ-convention groups when
+# quality matters more than the last ~15% of decode. Small-geometry
+# weights (tests' tiny models) fall back to the largest power-of-two
+# divisor of K.
+PACK_BLOCK = 256
+
+
+def _group_for(k: int) -> int:
+    from ..runtime.config import env
+
+    g = int(env("DYNT_Q4_GROUP") or PACK_BLOCK)
+    while g > 2 and k % g:
+        g //= 2
+    if k % g or g < 2:
+        raise ValueError(
+            f"int4 needs the contracted size to divide a power-of-two "
+            f"group (got K={k}); this weight cannot take the W4A16 "
+            "kernel")
+    return g
+
+# Leaf name -> number of LEADING contracted axes (same registry shape as
+# q8_linear.QUANT_LEAVES; shared by the quantizer and model plumbing).
+QUANT_LEAVES = {
+    "wq": 1, "wk": 1, "wv": 1, "wo": 2,
+    "w_gate": 1, "w_up": 1, "w_down": 1,
+    "lm_head": 1,
+}
+
+
+def _pack_codes(u: jnp.ndarray, group: int) -> jnp.ndarray:
+    """uint8 codes [K, N] in [0, 15] -> packed uint8 [K//2, N] in the
+    half-block layout (byte row r of each group holds code rows r and
+    r + group//2)."""
+    k, n = u.shape
+    half = group // 2
+    blk = u.reshape(k // group, group, n)
+    lo, hi = blk[:, :half], blk[:, half:]
+    return (lo | (hi << 4)).reshape(k // 2, n)
+
+
+def _unpack_codes(packed: jnp.ndarray, group: int) -> jnp.ndarray:
+    """Inverse of _pack_codes (reference path / tests)."""
+    k2, n = packed.shape
+    half = group // 2
+    blk = packed.reshape(k2 // half, half, n)
+    lo = blk & 0xF
+    hi = blk >> 4
+    return jnp.concatenate([lo, hi], axis=1).reshape(k2 * 2, n)
+
+
+def quantize_weight_q4(w: jax.Array, n_contract: int) -> dict:
+    """Asymmetric per-group int4 over the contracted axes.
+
+    Returns {"q4": packed uint8, "qs4": f32 [K//group, N], "qz4": f32
+    [K//group, N]}. q4 keeps the weight's output axes when a single
+    leading axis is contracted ([K//2, *out_axes]); multi-axis
+    contractions (wo) flatten to 2-D [K//2, N] because pack groups span
+    head boundaries.
+    """
+    out_axes = w.shape[n_contract:]
+    k = int(np.prod(w.shape[:n_contract]))
+    n = int(np.prod(out_axes)) if out_axes else 1
+    group = _group_for(k)
+    w2 = jnp.asarray(w, jnp.float32).reshape(k, n)
+    grp = w2.reshape(k // group, group, n)
+    lo = jnp.min(grp, axis=1)
+    hi = jnp.max(grp, axis=1)
+    scale = (hi - lo) / 15.0
+    safe = jnp.maximum(scale, 1e-12)
+    zero = jnp.clip(jnp.round(-lo / safe), 0.0, 15.0)
+    codes = jnp.clip(
+        jnp.round(grp / safe[:, None, :]) + zero[:, None, :], 0.0, 15.0
+    ).reshape(k, n).astype(jnp.uint8)
+    q4 = _pack_codes(codes, group)
+    if n_contract == 1 and out_axes:
+        q4 = q4.reshape((k // 2,) + out_axes)
+    return {"q4": q4, "qs4": scale.astype(jnp.float32),
+            "qz4": zero.astype(jnp.float32)}
+
+
+def _q4_matmul_kernel(group, gk, x_ref, wp_ref, s_ref, z_ref, o_ref,
+                      acc_ref):
+    k = pl.program_id(2)
+    half = group // 2
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Per group: packed bytes -> two int32 nibble tiles -> ONE convert
+    # each to the MXU dtype (the zero-point never touches the weight
+    # tile: dot(x, u - z) == dot(x, u) - colsum(x) * z, so the asymmetric
+    # offset folds into a [bm, 1] x [1, bn] outer product). The group
+    # scale factors out of the block's contraction and lands on the
+    # [bm, bn] partial product.
+    for g in range(gk):
+        # Mosaic has no u8->bf16 cast: widen once to i32, mask/shift,
+        # one convert per nibble tile.
+        w32 = wp_ref[g * half:(g + 1) * half].astype(jnp.int32)
+        u_lo = (w32 & 0xF).astype(x_ref.dtype)
+        u_hi = (w32 >> 4).astype(x_ref.dtype)
+        xg = x_ref[:, g * group:(g + 1) * group]
+        part = jax.lax.dot_general(
+            xg[:, :half], u_lo, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            xg[:, half:], u_hi, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        xsum = jnp.sum(xg.astype(jnp.float32), axis=1, keepdims=True)
+        z = z_ref[g].astype(jnp.float32)
+        s = s_ref[g].astype(jnp.float32)
+        acc_ref[:] += (part - xsum * z) * s
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
+              zero: jax.Array, bm: int = 256, bn: int = 1024,
+              interpret: bool = False) -> jax.Array:
+    """x [M, K] (bf16/f32) @ packed-int4 [K//2, N] with per-group
+    scale/zero [K//group, N] -> [M, N] in x.dtype. The group (and the
+    kernel's k-block) is inferred from the scale shape."""
+    m, k2 = x.shape[0], q4.shape[0]
+    k = k2 * 2
+    n = q4.shape[1]
+    assert x.shape[1] == k, (x.shape, q4.shape)
+    group = k // scale.shape[0]
+    assert scale.shape == (k // group, n) and k % group == 0, scale.shape
+    assert zero.shape == scale.shape, zero.shape
+    bm = min(bm, max(16, 1 << max(0, m - 1).bit_length()))
+    mp = -(-m // bm) * bm
+    if mp != m:
+        x = jnp.pad(x, ((0, mp - m), (0, 0)))
+    b = min(bn, n)
+    while b > 128 and n % b:
+        b //= 2
+    bn = b
+    if n >= 128 and (bn < 128 or n % bn):
+        raise ValueError(
+            f"q4_matmul needs 128-lane-divisible geometry (N={n}); "
+            "this weight cannot take the W4A16 kernel")
+    # Process several groups per k-block: bigger DMA tiles amortize the
+    # grid and let Mosaic double-buffer the packed stream.
+    gk = 1
+    while gk < 32 and k % (group * gk * 2) == 0:
+        gk *= 2
+    # Mosaic requires the sublane block dim to divide 8 or equal the
+    # array dim: give the per-group rows a unit middle axis so each
+    # (gk, 1, bn) block spans full (singleton) sublane dimensions.
+    s3 = scale.reshape(k // group, 1, n)
+    z3 = zero.reshape(k // group, 1, n)
+    out = pl.pallas_call(
+        functools.partial(_q4_matmul_kernel, group, gk),
+        grid=(mp // bm, n // bn, k // (group * gk)),
+        in_specs=[
+            pl.BlockSpec((bm, group * gk), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((group * gk // 2, bn),
+                         lambda mi, ni, ki: (ki, ni)),
+            pl.BlockSpec((gk, 1, bn), lambda mi, ni, ki: (ki, 0, ni)),
+            pl.BlockSpec((gk, 1, bn), lambda mi, ni, ki: (ki, 0, ni)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, q4, s3, z3)
+    return out[:m]
+
+
+def dequantize_q4(q4: jax.Array, scale: jax.Array,
+                  zero: jax.Array) -> jax.Array:
+    """Full-precision reconstruction [K, N] f32 (tests / ref path)."""
+    k2 = q4.shape[0]
+    n = int(np.prod(q4.shape[1:]))
+    group = (k2 * 2) // scale.shape[0]
+    u = _unpack_codes(q4.reshape(k2, n), group).astype(jnp.float32)
+    s = jnp.repeat(scale.reshape(-1, n), group, axis=0)
+    z = jnp.repeat(zero.reshape(-1, n), group, axis=0)
+    return (u - z) * s
+
+
+def q4_matmul_ref(x: jax.Array, q4: jax.Array, scale: jax.Array,
+                  zero: jax.Array) -> jax.Array:
+    """XLA reference: materializes the dequantized weight (correctness
+    path, not the perf path)."""
+    w = dequantize_q4(q4, scale, zero)
+    acc = jax.lax.dot_general(
+        x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc.astype(x.dtype)
+
+
+def _use_pallas() -> bool:
+    from ..runtime.config import env
+
+    mode = env("DYNT_Q4_MATMUL") or "auto"
+    if mode == "xla":
+        return False
+    return mode == "pallas" or jax.default_backend() == "tpu"
+
+
+def q4_einsum(spec: str, x: jax.Array, q4: jax.Array, qs4: jax.Array,
+              qz4: jax.Array) -> jax.Array:
+    """Quantized drop-in for the transformer's dense einsums (mirror of
+    q8_linear.q8_einsum over the packed-int4 leaves)."""
+    if spec in ("bth,hm->btm", "btm,mh->bth", "bth,hv->btv"):
+        b, t, k = x.shape
+        out_shape = (b, t, q4.shape[1])
+        x2 = x.reshape(b * t, k)
+        w2 = q4
+    elif spec == "bth,hqd->btqd":
+        b, t, k = x.shape
+        _, qh, hd = q4.shape
+        out_shape = (b, t, qh, hd)
+        x2 = x.reshape(b * t, k)
+        w2 = q4.reshape(k // 2, qh * hd)
+    elif spec == "bth,hkd->btkd":
+        b, t, k = x.shape
+        _, kh, hd = q4.shape
+        out_shape = (b, t, kh, hd)
+        x2 = x.reshape(b * t, k)
+        w2 = q4.reshape(k // 2, kh * hd)
+    elif spec == "btqd,qdh->bth":
+        b, t, qh, hd = x.shape
+        h = q4.shape[-1]
+        out_shape = (b, t, h)
+        x2 = x.reshape(b * t, qh * hd)
+        w2 = q4  # wo is stored flat [K//2, h] (pack blocks span heads)
+    else:
+        raise ValueError(f"q4_einsum does not support spec {spec!r}")
+    if _use_pallas():
+        out = q4_matmul(x2, w2, qs4, qz4,
+                        interpret=jax.default_backend() != "tpu")
+    else:
+        out = q4_matmul_ref(x2, w2, qs4, qz4)
+    return out.reshape(out_shape)

@@ -123,6 +123,13 @@ _register("DYNT_DECODE_BLOCK", 8, _int,
 _register("DYNT_Q8_MATMUL", "auto", _str,
           "W8A16 matmul backend for int8 weights: auto (Pallas on TPU, "
           "XLA reference elsewhere) | pallas | xla")
+_register("DYNT_Q4_MATMUL", "auto", _str,
+          "W4A16 matmul backend for packed-int4 weights: auto (Pallas "
+          "on TPU, XLA reference elsewhere) | pallas | xla")
+_register("DYNT_Q4_GROUP", "256", _str,
+          "int4 quantization group (contracted rows per scale/zero "
+          "row): 256 (fastest measured decode on v5e) | 128 (finer "
+          "GPTQ/AWQ-convention groups, slightly better quality)")
 _register("DYNT_WEIGHT_SERVICE", "", _str,
           "Unix socket of the weight service (GMS analog): workers "
           "re-attach published weights on restart instead of initializing")

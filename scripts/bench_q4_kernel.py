@@ -1,0 +1,67 @@
+"""Microbench: q4_matmul vs q8_matmul vs bf16 XLA matmul on one chip.
+
+Times a single [M, K] x [K, N] projection-shaped matmul per variant and
+prints GB/s of weight traffic achieved (the kernels are weight-stream
+bound at decode M). Used to tune the W4A16 kernel's block shapes.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.q4_linear import q4_matmul, quantize_weight_q4
+from dynamo_tpu.ops.q8_linear import q8_matmul, quantize_weight
+
+
+INNER = 32
+
+
+def timeit(fn, x, *args, n=8):
+    """One jitted lax.scan of INNER chained matmuls per trial: the chain
+    defeats overlap/dedupe, the scan amortizes dispatch overhead."""
+    k = x.shape[1]
+
+    @jax.jit
+    def trial(xc):
+        def body(c, _):
+            out = fn(c, *args)
+            return c + out[:, :k].astype(c.dtype) * 1e-6, ()
+
+        return jax.lax.scan(body, xc, (), length=INNER)[0]
+
+    xc = trial(x)
+    jax.block_until_ready(xc)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        xc = trial(xc)
+    jax.block_until_ready(xc)
+    return (time.perf_counter() - t0) / (n * INNER)
+
+
+def main():
+    m, k, n = 16, 4096, 14336
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    wb = w.astype(jnp.bfloat16)
+    q8 = quantize_weight(w, 1)
+    q4 = quantize_weight_q4(w, 1)
+    q8 = jax.device_put(q8)
+    q4 = jax.device_put(q4)
+
+    t_bf = timeit(lambda a, b: a @ b, x, wb)
+    t_q8 = timeit(q8_matmul, x, q8["q8"], q8["qs"])
+    t_q4 = timeit(q4_matmul, x, q4["q4"], q4["qs4"], q4["qz4"])
+    for name, t, byts in (
+        ("bf16", t_bf, k * n * 2),
+        ("q8", t_q8, k * n),
+        ("q4", t_q4, k * n // 2),
+    ):
+        print(f"{name}: {t * 1e6:9.1f} us  {byts / t / 1e9:7.1f} GB/s "
+              f"(weight bytes {byts / 1e6:.1f} MB)")
+
+
+if __name__ == "__main__":
+    main()
