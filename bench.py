@@ -201,8 +201,11 @@ def bench_one(model: str, *, model_path: str | None = None,
         param_bytes //= 2
     elif weight_dtype == "int4":
         # W4A16: 0.5 B/weight packed + f32 scale+zero rows per group
-        # (8 B / group weights) vs 2 B bf16.
-        q4_group = int(os.environ.get("DYNT_Q4_GROUP", "256"))
+        # (8 B / group weights) vs 2 B bf16. The group comes from the
+        # same registered config the kernel reads (runtime/config.py).
+        from dynamo_tpu.runtime.config import env as _cfg_env
+
+        q4_group = int(_cfg_env("DYNT_Q4_GROUP"))
         param_bytes = int(param_bytes * (0.5 + 8.0 / q4_group) / 2.0)
     bytes_per_step = param_bytes + kv_bytes_per_step
     roofline_steps = hbm * 1e9 / bytes_per_step

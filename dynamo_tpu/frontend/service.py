@@ -59,7 +59,7 @@ class Frontend:
         )
         self.http = HttpService(
             self.manager, host=host, port=port, busy_threshold=busy_threshold,
-            audit=self.audit, recorder=self.recorder,
+            audit=self.audit, recorder=self.recorder, runtime=runtime,
         )
         self.kserve = None
         if kserve_grpc_port is not None:

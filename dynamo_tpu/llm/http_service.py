@@ -56,13 +56,18 @@ class HttpService:
         busy_threshold: Optional[float] = None,
         audit=None,  # Optional[audit.AuditBus]
         recorder=None,  # Optional[audit.Recorder]
+        runtime=None,  # Optional[DistributedRuntime]: admin fan-out routes
     ) -> None:
         self.manager = manager
         self.host = host
         self.port = port
         self.busy_threshold = busy_threshold
+        # Per-model overrides set at runtime via POST /busy_threshold
+        # (ref: busy_threshold.rs); the constructor value is the default.
+        self.busy_thresholds: dict[str, float] = {}
         self.audit = audit
         self.recorder = recorder
+        self.runtime = runtime
         self._runner: Optional[web.AppRunner] = None
 
     # -- helpers -----------------------------------------------------------
@@ -84,14 +89,16 @@ class HttpService:
         """Shed load when every live worker is past the KV busy threshold
         (ref: busy_threshold.rs + KvWorkerMonitor). Uses published
         LoadMetrics usage, which flows in every router mode."""
-        if self.busy_threshold is None:
+        threshold = self.busy_thresholds.get(entry.card.name,
+                                             self.busy_threshold)
+        if threshold is None:
             return
         usages = [
             entry.worker_usage[iid]
             for iid in entry.router.client.instance_ids()
             if iid in entry.worker_usage
         ]
-        if usages and min(usages) >= self.busy_threshold:
+        if usages and min(usages) >= threshold:
             raise web.HTTPServiceUnavailable(
                 text=json.dumps(_error_body(503, "service busy", "overloaded")),
                 content_type="application/json",
@@ -928,6 +935,167 @@ class HttpService:
 
     # -- lifecycle ---------------------------------------------------------
 
+    # -- admin + docs routes (ref: busy_threshold.rs, clear_kv_blocks.rs,
+    # service_v2.rs /openapi.json + /docs) --------------------------------
+
+    async def _busy_threshold_list(self, _request: web.Request) -> web.Response:
+        return web.json_response({"thresholds": [
+            {"model": m, "active_decode_blocks_threshold": v}
+            for m, v in sorted(self.busy_thresholds.items())
+        ]})
+
+    async def _busy_threshold_post(self, request: web.Request) -> web.Response:
+        """Get or set a model's busy threshold: body with a threshold
+        sets it; body with only the model name reads it back (the
+        reference's get-or-set POST contract, busy_threshold.rs)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                _error_body(400, "invalid JSON body"), status=400)
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            return web.json_response(
+                _error_body(400, "'model' is required"), status=400)
+        entry, _ = self.manager.resolve(model)
+        if entry is None:
+            return web.json_response(
+                _error_body(404, f"model '{model}' not found",
+                            "model_not_found"), status=404)
+        name = entry.card.name
+        value = body.get("active_decode_blocks_threshold",
+                         body.get("busy_threshold"))
+        if value is not None:
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                return web.json_response(_error_body(
+                    400, "active_decode_blocks_threshold must be a "
+                    "number in [0, 1]"), status=400)
+            if not 0.0 <= value <= 1.0:
+                return web.json_response(_error_body(
+                    400, "active_decode_blocks_threshold must be in "
+                    "[0, 1]"), status=400)
+            self.busy_thresholds[name] = value
+        current = self.busy_thresholds.get(name, self.busy_threshold)
+        return web.json_response(
+            {"model": name, "active_decode_blocks_threshold": current})
+
+    async def _clear_kv_blocks(self, _request: web.Request) -> web.Response:
+        """Fan out to every worker group's clear_kv_blocks endpoint and
+        report per-worker outcomes (ref: clear_kv_blocks.rs)."""
+        entries = self.manager.entries()
+        if not entries:
+            return web.json_response(
+                {"message": "No active worker groups found"})
+        if self.runtime is None:
+            return web.json_response(
+                {"message": "Failed to create distributed runtime"})
+        cleared, failed = [], []
+        seen: set[tuple[str, str]] = set()
+        for entry in entries:
+            card = entry.card
+            key = (card.namespace, card.component)
+            if key in seen:  # chat+completions share a worker group
+                continue
+            seen.add(key)
+            endpoint = f"{card.namespace}/{card.component}/clear_kv_blocks"
+            client = None
+            try:
+                client = (
+                    self.runtime.namespace(card.namespace)
+                    .component(card.component)
+                    .endpoint("clear_kv_blocks")
+                    .client()
+                )
+                await client.start()
+                instance_ids = list(client.instance_ids()) or [None]
+                for iid in instance_ids:
+                    rec = {"name": card.name, "endpoint": endpoint,
+                           "instance": iid}
+                    try:
+                        if iid is None:
+                            raise RuntimeError("no live instances")
+                        async for resp in client.direct({}, iid):
+                            rec["response"] = resp
+                            break
+                        rec["status"] = "cleared"
+                        cleared.append(rec)
+                    except Exception as exc:  # noqa: BLE001 — report
+                        rec["status"] = "failed"
+                        rec["error"] = str(exc)
+                        failed.append(rec)
+            except Exception as exc:  # noqa: BLE001 — report per group
+                failed.append({"name": card.name, "endpoint": endpoint,
+                               "status": "failed", "error": str(exc)})
+            finally:
+                # per-request client: close its discovery watcher/task
+                # or every POST leaks one for the frontend's lifetime
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        log.exception("clear_kv client close failed")
+        return web.json_response(
+            {"cleared_workers": cleared, "failed_workers": failed})
+
+    # (method, path, summary) — drives both the aiohttp route table and
+    # the generated OpenAPI document (ref: RouteDoc in service_v2.rs).
+    _ROUTE_DOCS = (
+        ("post", "/v1/chat/completions",
+         "OpenAI chat completions (SSE streaming + aggregate)"),
+        ("post", "/v1/completions", "OpenAI text completions"),
+        ("post", "/v1/embeddings", "OpenAI embeddings"),
+        ("post", "/v1/messages", "Anthropic messages"),
+        ("post", "/v1/responses", "OpenAI responses"),
+        ("post", "/v1/images/generations", "Image generation (diffusion)"),
+        ("post", "/v1/videos", "Video generation (diffusion)"),
+        ("get", "/v1/models", "List served models, adapters, and pools"),
+        ("get", "/health", "Service health + served model list"),
+        ("get", "/live", "Liveness probe"),
+        ("get", "/metrics", "Prometheus metrics"),
+        ("get", "/busy_threshold", "List per-model busy thresholds"),
+        ("post", "/busy_threshold",
+         "Get or set a model's busy threshold (load shedding)"),
+        ("post", "/clear_kv_blocks",
+         "Clear every worker's KV prefix cache"),
+        ("get", "/openapi.json", "This OpenAPI document"),
+        ("get", "/docs", "Human-readable API index"),
+    )
+
+    async def _openapi(self, _request: web.Request) -> web.Response:
+        paths: dict[str, dict] = {}
+        for method, path, summary in self._ROUTE_DOCS:
+            paths.setdefault(path, {})[method] = {
+                "summary": summary,
+                "responses": {"200": {"description": "OK"}},
+            }
+        return web.json_response({
+            "openapi": "3.0.3",
+            "info": {"title": "dynamo_tpu frontend",
+                     "version": "1.0.0"},
+            "paths": paths,
+        })
+
+    async def _docs(self, _request: web.Request) -> web.Response:
+        # Self-contained (zero-CDN) index rendered from _ROUTE_DOCS; the
+        # machine-readable spec lives at /openapi.json.
+        rows = "".join(
+            f"<tr><td><code>{m.upper()}</code></td>"
+            f"<td><code>{p}</code></td><td>{s}</td></tr>"
+            for m, p, s in self._ROUTE_DOCS)
+        html = (
+            "<!doctype html><html><head><title>dynamo_tpu API</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:4px 10px;text-align:left}</style></head><body>"
+            "<h1>dynamo_tpu frontend API</h1>"
+            "<p>Machine-readable spec: <a href='/openapi.json'>"
+            "/openapi.json</a></p>"
+            f"<table><tr><th>Method</th><th>Path</th><th>Summary</th></tr>"
+            f"{rows}</table></body></html>")
+        return web.Response(text=html, content_type="text/html")
+
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/v1/chat/completions", self._chat)
@@ -941,6 +1109,11 @@ class HttpService:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._health)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/busy_threshold", self._busy_threshold_list)
+        app.router.add_post("/busy_threshold", self._busy_threshold_post)
+        app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
+        app.router.add_get("/openapi.json", self._openapi)
+        app.router.add_get("/docs", self._docs)
         return app
 
     async def start(self) -> None:

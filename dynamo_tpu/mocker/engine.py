@@ -277,6 +277,17 @@ class MockerEngine:
         metrics = self.load_metrics()
         await self._publisher.publish(LOAD_TOPIC, metrics.to_wire())
 
+    async def clear_prefix_cache(self) -> int:
+        """Drop every unpinned cached block and publish their removal
+        (the clear_kv_blocks endpoint; ref: vllm worker
+        clear_kv_blocks + mocker kv_manager reset)."""
+        dropped = [h for h in list(self.kv.cached)
+                   if self.kv.refcount.get(h, 0) == 0]
+        for h in dropped:
+            self.kv.cached.pop(h, None)
+        await self._publish_removed(dropped)
+        return len(dropped)
+
     def load_metrics(self) -> LoadMetrics:
         return LoadMetrics(
             worker_id=self.worker_id,

@@ -72,6 +72,7 @@ class MockerWorker:
         self._load_interval = load_publish_interval
         self._served = None
         self._kvq_served = None
+        self._clear_served = None
 
     async def start(self) -> None:
         publisher = self.runtime.event_publisher(self.card.namespace)
@@ -104,6 +105,17 @@ class MockerWorker:
         )
         self._kvq_served = await kvq_ep.serve_endpoint(
             kv_blocks, instance_id=self.instance_id)
+
+        async def clear_kv(body, ctx=None):
+            yield {"cleared": await self.engine.clear_prefix_cache()}
+
+        clear_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("clear_kv_blocks")
+        )
+        self._clear_served = await clear_ep.serve_endpoint(
+            clear_kv, instance_id=self.instance_id)
         await publish_card(self.runtime, self.card, self.instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         log.info("mocker worker up: model=%s instance=%x blocks=%d",
@@ -126,7 +138,8 @@ class MockerWorker:
                 pass
         if self.engine is not None:
             await self.engine.close()
-        for served in (self._served, self._kvq_served):
+        for served in (self._served, self._kvq_served,
+                       self._clear_served):
             if served is not None:
                 await served.shutdown()
 

@@ -113,14 +113,22 @@ def quantize_weight_q4(w: jax.Array, n_contract: int) -> dict:
     hi = jnp.max(grp, axis=1)
     scale = (hi - lo) / 15.0
     safe = jnp.maximum(scale, 1e-12)
-    zero = jnp.clip(jnp.round(-lo / safe), 0.0, 15.0)
+    # The zero-point is stored as an f32 row, NOT packed, so it must not
+    # be clipped to the code range: an all-positive (or all-negative)
+    # group has -lo/s outside [0, 15], and clipping it would shift every
+    # dequantized value by the clipped amount (a constant group would
+    # reconstruct to 0 instead of its value). Only the CODES clip.
+    zero = jnp.round(-lo / safe)
     codes = jnp.clip(
         jnp.round(grp / safe[:, None, :]) + zero[:, None, :], 0.0, 15.0
     ).reshape(k, n).astype(jnp.uint8)
     q4 = _pack_codes(codes, group)
     if n_contract == 1 and out_axes:
         q4 = q4.reshape((k // 2,) + out_axes)
-    return {"q4": q4, "qs4": scale.astype(jnp.float32),
+    # Store the CLAMPED scale: the zero-point was computed against it,
+    # and a constant group (raw scale 0) must dequantize as
+    # (u - z)*safe = u*eps + lo, not (u - z)*0 = 0.
+    return {"q4": q4, "qs4": safe.astype(jnp.float32),
             "qz4": zero.astype(jnp.float32)}
 
 
@@ -184,7 +192,7 @@ def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
     while b > 128 and n % b:
         b //= 2
     bn = b
-    if n >= 128 and (bn < 128 or n % bn):
+    if n >= 128 and (bn % 128 or n % bn):
         raise ValueError(
             f"q4_matmul needs 128-lane-divisible geometry (N={n}); "
             "this weight cannot take the W4A16 kernel")

@@ -178,6 +178,76 @@ class TestFrontendE2E:
 
         run(body(), timeout=90)
 
+    def test_admin_and_docs_routes(self, run):
+        """The reference's operational route set (busy_threshold.rs,
+        clear_kv_blocks.rs, /openapi.json + /docs from service_v2.rs):
+        get-or-set per-model thresholds, whole-fleet KV cache clear with
+        per-worker outcomes, and the generated API docs."""
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                # get-or-set busy thresholds
+                async with session.get(f"{base}/busy_threshold") as resp:
+                    assert (await resp.json())["thresholds"] == []
+                async with session.post(
+                    f"{base}/busy_threshold",
+                    json={"model": "mock-model",
+                          "active_decode_blocks_threshold": 0.9},
+                ) as resp:
+                    data = await resp.json()
+                    assert data["active_decode_blocks_threshold"] == 0.9
+                async with session.post(
+                    f"{base}/busy_threshold", json={"model": "mock-model"},
+                ) as resp:  # get via threshold-less POST
+                    data = await resp.json()
+                    assert data["active_decode_blocks_threshold"] == 0.9
+                async with session.get(f"{base}/busy_threshold") as resp:
+                    data = await resp.json()
+                    assert data["thresholds"] == [
+                        {"model": "mock-model",
+                         "active_decode_blocks_threshold": 0.9}]
+                async with session.post(
+                    f"{base}/busy_threshold", json={"model": "nope"},
+                ) as resp:
+                    assert resp.status == 404
+                async with session.post(
+                    f"{base}/busy_threshold",
+                    json={"model": "mock-model",
+                          "active_decode_blocks_threshold": 7},
+                ) as resp:
+                    assert resp.status == 400
+
+                # seed the prefix cache, then clear it fleet-wide
+                payload = {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "x" * 200}],
+                    "max_tokens": 4,
+                }
+                async with session.post(f"{base}/v1/chat/completions",
+                                        json=payload) as resp:
+                    assert resp.status == 200
+                async with session.post(f"{base}/clear_kv_blocks") as resp:
+                    data = await resp.json()
+                    assert data["failed_workers"] == []
+                    assert len(data["cleared_workers"]) == 1
+                    assert data["cleared_workers"][0]["status"] == "cleared"
+                    assert data["cleared_workers"][0]["response"][
+                        "cleared"] >= 1
+
+                # generated docs
+                async with session.get(f"{base}/openapi.json") as resp:
+                    spec = await resp.json()
+                    assert spec["openapi"].startswith("3.")
+                    assert "/v1/chat/completions" in spec["paths"]
+                    assert "/clear_kv_blocks" in spec["paths"]
+                async with session.get(f"{base}/docs") as resp:
+                    assert resp.status == 200
+                    assert "/openapi.json" in await resp.text()
+            await _teardown(frontend, frt, workers)
+
+        run(body(), timeout=90)
+
     def test_worker_death_model_unlisted(self, run):
         async def body():
             cluster = uuid.uuid4().hex

@@ -41,6 +41,42 @@ class TestQ4Pack:
         s = np.repeat(np.asarray(qw["qs4"]), group, axis=0)
         assert np.max(np.abs(deq - np.asarray(w)) - s * 0.5) <= 1e-5
 
+    def test_constant_and_one_sided_groups_reconstruct(self):
+        """A constant group and an all-positive group must dequantize to
+        ~their values: the f32 zero-point row is NOT clipped to the code
+        range (clipping it shifted such groups toward 0)."""
+        import jax.numpy as jnp
+
+        from dynamo_tpu.ops.q4_linear import (
+            dequantize_q4,
+            quantize_weight_q4,
+        )
+
+        const = jnp.full((256, 128), 3.0, jnp.float32)
+        qw = quantize_weight_q4(const, 1)
+        deq = np.asarray(dequantize_q4(qw["q4"], qw["qs4"], qw["qz4"]))
+        np.testing.assert_allclose(deq, 3.0, rtol=1e-5)
+
+        rng = np.random.default_rng(7)
+        pos = jnp.asarray(rng.uniform(2.0, 4.0, (256, 128)), jnp.float32)
+        qw = quantize_weight_q4(pos, 1)
+        deq = np.asarray(dequantize_q4(qw["q4"], qw["qs4"], qw["qz4"]))
+        # within half an LSB of the true values (range 2 / 15 codes)
+        assert np.max(np.abs(deq - np.asarray(pos))) <= 2.0 / 15.0
+
+        # The kernel's rank-1 zero-point fold must survive the huge
+        # zero-points these groups produce (z ~ -lo/eps for constants).
+        from dynamo_tpu.ops.q4_linear import q4_matmul, q4_matmul_ref
+
+        mixed = jnp.concatenate([const[:128], pos[:128]], axis=0)
+        qm = quantize_weight_q4(mixed, 1)
+        x = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+        ref = q4_matmul_ref(x, qm["q4"], qm["qs4"], qm["qz4"])
+        out = q4_matmul(x, qm["q4"], qm["qs4"], qm["qz4"],
+                        interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=2e-3)
+
     def test_non_divisible_k_rejected(self):
         import jax.numpy as jnp
 
