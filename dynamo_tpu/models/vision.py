@@ -33,6 +33,24 @@ class VisionConfig:
     out_dim: int = 1024  # LLM hidden size
     rms_eps: float = 1e-6
     dtype: str = "bfloat16"
+    # HF checkpoint variants (models/vision_checkpoint.py): "dyn" is our
+    # native RMS/no-bias tower; "siglip"/"clip" reproduce the HF
+    # architectures exactly (LayerNorm with bias, biased projections,
+    # tanh-GELU vs QuickGELU, CLIP's class token + pre-LN) so real
+    # SigLIP/CLIP vision towers load with logit parity.
+    variant: str = "dyn"
+    # Pixel normalization applied in encode() for HF variants (the HF
+    # image-processor step; [0,1] inputs -> (x - mean) / std).
+    image_mean: tuple = (0.5, 0.5, 0.5)
+    image_std: tuple = (0.5, 0.5, 0.5)
+    name: str = ""
+    # VLM (LLaVA-class) feature extraction: take the hidden states of
+    # this layer (HF hidden_states indexing, e.g. -2 = penultimate)
+    # instead of the final post-LN output, optionally dropping CLIP's
+    # class token (vision_feature_select_strategy "default"), then run
+    # the multi-modal projector into the LLM's hidden size.
+    feature_layer: int | None = None
+    drop_class_token: bool = False
 
     @property
     def n_patches(self) -> int:
@@ -40,7 +58,10 @@ class VisionConfig:
 
     @property
     def n_image_tokens(self) -> int:
-        return self.n_patches
+        # CLIP prepends a class token; VLM feature selection may drop it
+        extra = 1 if self.variant == "clip" and not self.drop_class_token \
+            else 0
+        return self.n_patches + extra
 
     @property
     def patch_dim(self) -> int:
@@ -149,16 +170,128 @@ def vision_forward(params: dict, config: VisionConfig,
         jnp.float32)
 
 
+def _ln(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32) \
+        + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def vision_forward_hf(params: dict, config: VisionConfig,
+                      images: jax.Array) -> jax.Array:
+    """SigLIP / CLIP vision tower forward, matching the HF reference op
+    for op (pre-LN blocks, biased projections, f32 LayerNorm/softmax;
+    CLIP adds the class token + embedding pre-LN and QuickGELU).
+    images: [B, S, S, 3] ALREADY pixel-normalized. Returns
+    [B, n_image_tokens, hidden] == HF last_hidden_state."""
+    b = images.shape[0]
+    nh = config.n_heads
+    hd = config.hidden // nh
+    act = _quick_gelu if config.variant == "clip" else \
+        (lambda v: jax.nn.gelu(v, approximate=True))
+    eps = config.rms_eps
+    x = patchify(images.astype(jnp.dtype(config.dtype)), config.patch_size)
+    x = jnp.einsum("bpd,dh->bph", x, params["patch_proj"])
+    if "patch_bias" in params:
+        x = x + params["patch_bias"]
+    if "class_embed" in params:  # CLIP
+        cls = jnp.broadcast_to(params["class_embed"][None, None, :],
+                               (b, 1, config.hidden)).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"][None, :, :]
+    if "pre_norm" in params:  # CLIP pre_layrnorm
+        x = _ln(x, params["pre_norm"]["w"], params["pre_norm"]["b"], eps)
+    # VLM feature selection: HF hidden_states[i] has n_layers+1 entries
+    # (embeddings, then one per block); feature_layer -2 means "stop
+    # after block n_layers-1" and skip the post-LN.
+    n_run = config.n_layers
+    if config.feature_layer is not None:
+        n_run = config.n_layers + 1 + config.feature_layer \
+            if config.feature_layer < 0 else config.feature_layer
+        if not 0 < n_run <= config.n_layers:
+            raise ValueError(
+                f"feature_layer {config.feature_layer} out of range for "
+                f"{config.n_layers} layers")
+    for lp in params["layers"][:n_run]:
+        hsrc = _ln(x, lp["ln1_w"], lp["ln1_b"], eps)
+        qkv = jnp.einsum("bph,hk->bpk", hsrc, lp["wqkv"]) + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        t = q.shape[1]
+        q = q.reshape(b, t, nh, hd)
+        k = k.reshape(b, t, nh, hd)
+        v = v.reshape(b, t, nh, hd)
+        scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(hd)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bnqk,bknd->bqnd", probs,
+                          v.astype(jnp.float32)).astype(x.dtype)
+        attn = attn.reshape(b, t, config.hidden)
+        x = x + jnp.einsum("bph,ho->bpo", attn, lp["wo"]) + lp["bo"]
+        hsrc = _ln(x, lp["ln2_w"], lp["ln2_b"], eps)
+        up = jnp.einsum("bph,hm->bpm", hsrc, lp["w_up"]) + lp["b_up"]
+        x = x + jnp.einsum("bpm,mh->bph", act(up), lp["w_down"]) \
+            + lp["b_down"]
+    if config.feature_layer is None and config.variant != "clip":
+        # CLIP's last_hidden_state is PRE-post_layernorm (HF applies
+        # post_layernorm only to the [CLS] pooled path, which VLM
+        # feature extraction doesn't use); SigLIP norms the whole
+        # sequence. VLM feature selection takes RAW hidden states.
+        x = _ln(x, params["final_norm"], params["final_norm_b"], eps)
+    if config.drop_class_token and "class_embed" in params:
+        x = x[:, 1:]
+    if "proj" in params:
+        # LLaVA-class multi-modal projector: linear -> exact GELU ->
+        # linear into the LLM hidden size (projector_hidden_act "gelu")
+        pj = params["proj"]
+        x = jnp.einsum("bph,hm->bpm", x, pj["w1"]) + pj["b1"]
+        x = jax.nn.gelu(x, approximate=False)
+        x = jnp.einsum("bpm,mo->bpo", x, pj["w2"]) + pj["b2"]
+    elif "out_proj" in params:
+        x = jnp.einsum("bph,ho->bpo", x, params["out_proj"])
+    return x.astype(jnp.float32)
+
+
 class VisionEncoder:
     """Host-facing encoder: owns params + a jitted forward."""
 
     def __init__(self, config: VisionConfig, seed: int = 0,
                  params: dict | None = None) -> None:
         self.config = config
+        if params is None and config.variant != "dyn":
+            raise ValueError(
+                f"variant {config.variant!r} encoders load from a "
+                "checkpoint (VisionEncoder.from_checkpoint)")
         self.params = params or init_vision_params(
             jax.random.PRNGKey(seed), config)
-        self._fn = jax.jit(
-            lambda p, imgs: vision_forward(p, config, imgs))
+        fwd = vision_forward_hf if config.variant != "dyn" else \
+            vision_forward
+        self._fn = jax.jit(lambda p, imgs: fwd(p, config, imgs))
+
+    @classmethod
+    def from_checkpoint(cls, path: str,
+                        config: "VisionConfig | None" = None,
+                        ) -> "VisionEncoder":
+        """Load a SigLIP/CLIP tower (or a LLaVA-class VLM's tower +
+        projector) from an HF safetensors checkpoint directory
+        (models/vision_checkpoint.py). Pass a pre-parsed `config` when
+        the caller already derived one from the same directory, so the
+        advertised geometry and the built encoder cannot diverge."""
+        from .vision_checkpoint import (
+            load_vision_params,
+            vision_config_from_checkpoint,
+        )
+
+        if config is None:
+            config = vision_config_from_checkpoint(path)
+        params = jax.tree.map(jnp.asarray,
+                              load_vision_params(path, config))
+        return cls(config, params=params)
 
     def encode(self, images: np.ndarray) -> np.ndarray:
         """[B, S, S, 3] float32 in [0,1] -> [B, n_image_tokens, out_dim]."""
@@ -167,4 +300,9 @@ class VisionEncoder:
         s = self.config.image_size
         assert images.shape[1:] == (s, s, 3), (
             f"expected [B, {s}, {s}, 3], got {images.shape}")
+        if self.config.variant != "dyn":
+            # the HF image-processor normalization step
+            mean = np.asarray(self.config.image_mean, np.float32)
+            std = np.asarray(self.config.image_std, np.float32)
+            images = (np.asarray(images, np.float32) - mean) / std
         return np.asarray(self._fn(self.params, jnp.asarray(images)))

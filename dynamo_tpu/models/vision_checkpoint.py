@@ -1,0 +1,206 @@
+"""HF vision-tower checkpoints -> the functional vision param pytree.
+
+Supported: SigLIP (`SiglipVisionModel` / the vision_config half of a
+`siglip` checkpoint) and CLIP (`CLIPVisionModel` / `clip`), the towers
+modern VLM stacks encode images with (ref: the sglang/trtllm adapters
+delegate multimodal encoders to their engines, which load exactly these
+towers; our encode workers own the model — SURVEY §2.2 sglang
+multimodal E/P/D).
+
+Numpy-side like models/checkpoint.py (no jax import at module load).
+Shape conventions bridged (HF Linear stores [out, in]; ours are
+einsum-ready [in, out]):
+
+    patch_embedding conv [H, 3, P, P] -> patch_proj [P*P*3, H]
+      (transposed (kh, kw, in, out) to match patchify's row-major
+       (y, x, channel) flattening)
+    q/k/v_proj [H, H] each -> fused wqkv [H, 3H] (+ bqkv [3H])
+    out_proj [H, H] -> wo [H, H]
+    mlp.fc1 [M, H] -> w_up [H, M]; mlp.fc2 [H, M] -> w_down [M, H]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from .checkpoint import ShardReader
+from .vision import VisionConfig
+
+log = get_logger("models.vision_checkpoint")
+
+# HF image-processor defaults per family ([0,1] -> (x - mean)/std).
+_SIGLIP_MEAN = (0.5, 0.5, 0.5)
+_SIGLIP_STD = (0.5, 0.5, 0.5)
+_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def vision_config_from_checkpoint(path: str) -> VisionConfig:
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.isfile(cfg_path):
+        raise FileNotFoundError(
+            f"{path} does not look like an HF checkpoint directory "
+            "(config.json + *.safetensors)")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    model_type = cfg.get("model_type", "")
+    if "vision_config" in cfg:  # parent CLIP/SigLIP/VLM config
+        vc = cfg["vision_config"]
+        model_type = vc.get("model_type", model_type)
+    else:
+        vc = cfg
+    if model_type.startswith("siglip"):
+        variant = "siglip"
+        mean, std = _SIGLIP_MEAN, _SIGLIP_STD
+    elif model_type.startswith("clip"):
+        variant = "clip"
+        mean, std = _CLIP_MEAN, _CLIP_STD
+    else:
+        raise ValueError(
+            f"unsupported vision model_type {model_type!r} (expected a "
+            "siglip* or clip* tower)")
+    # LLaVA-class VLM checkpoint: features come from an interior layer
+    # (vision_feature_layer), CLIP's class token is dropped under the
+    # "default" select strategy, and the multi-modal projector maps into
+    # the LLM hidden size — so the encoder emits rows ANY paired LLM of
+    # that hidden size can splice (HF get_image_features semantics).
+    feature_layer = None
+    drop_cls = False
+    out_dim = int(vc["hidden_size"])
+    if "text_config" in cfg:
+        feature_layer = cfg.get("vision_feature_layer", -2)
+        if isinstance(feature_layer, list):
+            raise ValueError("multi-layer vision features are not "
+                             "supported (vision_feature_layer is a list)")
+        drop_cls = cfg.get("vision_feature_select_strategy",
+                           "default") == "default"
+        out_dim = int(cfg["text_config"].get("hidden_size", out_dim))
+    # preprocessor_config.json overrides the family normalization
+    pp_path = os.path.join(path, "preprocessor_config.json")
+    if os.path.isfile(pp_path):
+        with open(pp_path) as f:
+            pp = json.load(f)
+        mean = tuple(pp.get("image_mean", mean))
+        std = tuple(pp.get("image_std", std))
+    hidden = int(vc["hidden_size"])
+    return VisionConfig(
+        image_size=int(vc["image_size"]),
+        patch_size=int(vc["patch_size"]),
+        hidden=hidden,
+        n_layers=int(vc["num_hidden_layers"]),
+        n_heads=int(vc["num_attention_heads"]),
+        mlp_hidden=int(vc["intermediate_size"]),
+        out_dim=out_dim,  # bare tower: hidden; VLM: LLM hidden size
+        rms_eps=float(vc.get("layer_norm_eps", 1e-6)),
+        dtype="float32",
+        variant=variant,
+        image_mean=mean,
+        image_std=std,
+        name=cfg.get("model_type", model_type),
+        feature_layer=feature_layer,
+        drop_class_token=drop_cls,
+    )
+
+
+def _lin(reader: ShardReader, name: str) -> np.ndarray:
+    """HF Linear [out, in] -> einsum-ready [in, out]."""
+    return np.ascontiguousarray(reader.get(name).T)
+
+
+def load_vision_params(path: str, config: VisionConfig) -> dict:
+    with ShardReader(path) as reader:
+        return _load_vision_params(reader, config)
+
+
+def _load_vision_params(reader: ShardReader, config: VisionConfig) -> dict:
+    for pfx in ("vision_model.", "vision_tower.vision_model.",
+                "model.vision_tower.vision_model.", ""):
+        try:
+            reader.get(pfx + "post_layernorm.weight")
+            break
+        except KeyError:
+            continue
+    else:
+        raise KeyError("no vision tower found in checkpoint (tried the "
+                       "bare, llava, and nested llava prefixes)")
+
+    conv = reader.get(pfx + "embeddings.patch_embedding.weight")
+    h = config.hidden
+    p = config.patch_size
+    assert conv.shape == (h, 3, p, p), conv.shape
+    # conv stride==kernel == matmul over patchify's (y, x, channel) rows
+    patch_proj = np.ascontiguousarray(
+        conv.transpose(2, 3, 1, 0).reshape(config.patch_dim, h))
+
+    params: dict = {
+        "patch_proj": patch_proj,
+        "pos_embed": reader.get(pfx + "embeddings.position_embedding.weight"),
+        "final_norm": reader.get(pfx + "post_layernorm.weight"),
+        "final_norm_b": reader.get(pfx + "post_layernorm.bias"),
+    }
+    if config.variant == "siglip":
+        params["patch_bias"] = reader.get(
+            pfx + "embeddings.patch_embedding.bias")
+    else:  # clip
+        params["class_embed"] = reader.get(pfx + "embeddings.class_embedding")
+        # (sic — the HF CLIP module really is named pre_layrnorm)
+        params["pre_norm"] = {
+            "w": reader.get(pfx + "pre_layrnorm.weight"),
+            "b": reader.get(pfx + "pre_layrnorm.bias"),
+        }
+    expected = config.n_patches + (1 if config.variant == "clip" else 0)
+    assert params["pos_embed"].shape == (expected, h), (
+        params["pos_embed"].shape, expected)
+
+    layers = []
+    for i in range(config.n_layers):
+        lp = f"{pfx}encoder.layers.{i}."
+        wq = _lin(reader, lp + "self_attn.q_proj.weight")
+        wk = _lin(reader, lp + "self_attn.k_proj.weight")
+        wv = _lin(reader, lp + "self_attn.v_proj.weight")
+        bq = reader.get(lp + "self_attn.q_proj.bias")
+        bk = reader.get(lp + "self_attn.k_proj.bias")
+        bv = reader.get(lp + "self_attn.v_proj.bias")
+        layers.append({
+            "ln1_w": reader.get(lp + "layer_norm1.weight"),
+            "ln1_b": reader.get(lp + "layer_norm1.bias"),
+            "wqkv": np.ascontiguousarray(
+                np.concatenate([wq, wk, wv], axis=1)),
+            "bqkv": np.concatenate([bq, bk, bv]),
+            "wo": _lin(reader, lp + "self_attn.out_proj.weight"),
+            "bo": reader.get(lp + "self_attn.out_proj.bias"),
+            "ln2_w": reader.get(lp + "layer_norm2.weight"),
+            "ln2_b": reader.get(lp + "layer_norm2.bias"),
+            "w_up": _lin(reader, lp + "mlp.fc1.weight"),
+            "b_up": reader.get(lp + "mlp.fc1.bias"),
+            "w_down": _lin(reader, lp + "mlp.fc2.weight"),
+            "b_down": reader.get(lp + "mlp.fc2.bias"),
+        })
+    params["layers"] = layers
+
+    # LLaVA-class multi-modal projector (linear_1 -> GELU -> linear_2)
+    for ppfx in ("multi_modal_projector.", "model.multi_modal_projector."):
+        try:
+            params["proj"] = {
+                "w1": _lin(reader, ppfx + "linear_1.weight"),
+                "b1": reader.get(ppfx + "linear_1.bias"),
+                "w2": _lin(reader, ppfx + "linear_2.weight"),
+                "b2": reader.get(ppfx + "linear_2.bias"),
+            }
+            break
+        except KeyError:
+            continue
+    if config.feature_layer is not None and "proj" not in params:
+        raise KeyError(
+            "VLM checkpoint (text_config present) has no "
+            "multi_modal_projector weights")
+
+    log.info("loaded %s vision tower: %d layers, hidden %d -> out %d, "
+             "%d image tokens%s", config.variant, config.n_layers, h,
+             config.out_dim, config.n_image_tokens,
+             " (+projector)" if "proj" in params else "")
+    return params

@@ -67,12 +67,23 @@ class EncodeWorker:
         component: str = "encoder",
         cache_capacity: int = 256,
         seed: int = 0,
+        vision_path: Optional[str] = None,
     ) -> None:
-        from ..models.vision import get_vision_config
-
         self.runtime = runtime
         self.instance_id = new_instance_id()
-        self.vision_config = get_vision_config(vision_preset)
+        self._vision_path = vision_path
+        if vision_path:
+            # real SigLIP/CLIP tower from an HF checkpoint directory
+            from ..models.vision_checkpoint import (
+                vision_config_from_checkpoint,
+            )
+
+            self.vision_config = vision_config_from_checkpoint(vision_path)
+            vision_preset = self.vision_config.name or "checkpoint"
+        else:
+            from ..models.vision import get_vision_config
+
+            self.vision_config = get_vision_config(vision_preset)
         self._vision_preset = vision_preset
         self._seed = seed
         self.encoder = None  # built in start() OFF the event loop: the
@@ -126,7 +137,13 @@ class EncodeWorker:
         from ..models.vision import VisionEncoder
 
         def _build() -> VisionEncoder:
-            enc = VisionEncoder(self.vision_config, seed=self._seed)
+            if self._vision_path:
+                # reuse the __init__-parsed config: the published card
+                # geometry and the served tower must agree
+                enc = VisionEncoder.from_checkpoint(
+                    self._vision_path, config=self.vision_config)
+            else:
+                enc = VisionEncoder(self.vision_config, seed=self._seed)
             # compile + warm the encode path before serving
             enc.encode(np.zeros((self.vision_config.image_size,
                                  self.vision_config.image_size, 3),
@@ -182,6 +199,9 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         help="LLM model name this encoder pairs with")
     parser.add_argument("--vision", default="vit-l-14",
                         help="vision preset (models/vision.py PRESETS)")
+    parser.add_argument("--vision-path", default=None,
+                        help="HF checkpoint directory of a SigLIP/CLIP "
+                             "vision tower (overrides --vision)")
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="encoder")
     parser.add_argument("--cache-capacity", type=int, default=256)
@@ -190,7 +210,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
     worker = EncodeWorker(
         runtime, args.model, vision_preset=args.vision,
         namespace=args.namespace, component=args.component,
-        cache_capacity=args.cache_capacity,
+        cache_capacity=args.cache_capacity, vision_path=args.vision_path,
     )
     await worker.start()
     try:
