@@ -51,6 +51,11 @@ class VisionConfig:
     # the multi-modal projector into the LLM's hidden size.
     feature_layer: int | None = None
     drop_class_token: bool = False
+    # Qwen2-VL-class towers: 2x2 patch-merge windows (the merger MLP
+    # collapses each window into one LLM token) and temporal patch
+    # duplication for still images.
+    spatial_merge: int = 1
+    temporal_patch: int = 1
 
     @property
     def n_patches(self) -> int:
@@ -58,6 +63,8 @@ class VisionConfig:
 
     @property
     def n_image_tokens(self) -> int:
+        if self.variant == "qwen2vl":
+            return self.n_patches // (self.spatial_merge ** 2)
         # CLIP prepends a class token; VLM feature selection may drop it
         extra = 1 if self.variant == "clip" and not self.drop_class_token \
             else 0
@@ -257,6 +264,95 @@ def vision_forward_hf(params: dict, config: VisionConfig,
     return x.astype(jnp.float32)
 
 
+def _qwen2vl_patches(images: jax.Array, config: VisionConfig) -> jax.Array:
+    """[B, S, S, 3] -> [B, T, 3*Tp*P*P] in the Qwen2-VL processor's
+    patch order: 2x2 merge windows are consecutive in the sequence, and
+    each patch vector flattens as (channel, temporal, py, px) to match
+    the Conv3d weight layout. Still images duplicate temporally."""
+    b, s, _, c = images.shape
+    p = config.patch_size
+    m = config.spatial_merge
+    tp = config.temporal_patch
+    g = s // p
+    x = images.transpose(0, 3, 1, 2)  # [B, C, S, S]
+    x = jnp.repeat(x[:, None], tp, axis=1)  # [B, Tp, C, S, S]
+    x = x.reshape(b, tp, c, g // m, m, p, g // m, m, p)
+    # -> [B, gh/m, gw/m, mh, mw, C, Tp, Ph, Pw]
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return x.reshape(b, g * g, c * tp * p * p)
+
+
+def _qwen2vl_rope(config: VisionConfig) -> np.ndarray:
+    """Per-patch 2D rotary angles [T, head_dim/2] in the same
+    merge-window-major order as _qwen2vl_patches (HF rot_pos_emb)."""
+    g = config.image_size // config.patch_size
+    m = config.spatial_merge
+    hd = config.hidden // config.n_heads
+    dim = hd // 2  # VisionRotaryEmbedding(dim=head_dim//2)
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim, 2, np.float32) / dim))
+    freqs = np.outer(np.arange(g, dtype=np.float32), inv_freq)  # [g, hd/4]
+    hpos = np.broadcast_to(np.arange(g)[:, None], (g, g))
+    hpos = hpos.reshape(g // m, m, g // m, m).transpose(0, 2, 1, 3).ravel()
+    wpos = np.broadcast_to(np.arange(g)[None, :], (g, g))
+    wpos = wpos.reshape(g // m, m, g // m, m).transpose(0, 2, 1, 3).ravel()
+    # [T, 2, hd/4] -> [T, hd/2]
+    return freqs[np.stack([hpos, wpos], axis=1)].reshape(g * g, -1)
+
+
+def vision_forward_qwen2vl(params: dict, config: VisionConfig,
+                           images: jax.Array) -> jax.Array:
+    """Qwen2-VL-class vision tower, matching the HF reference op for op:
+    Conv3d patchify (as a matmul over pre-arranged patch vectors), 2D
+    rotary embeddings over merge-window-major patch order, pre-LN blocks
+    with QuickGELU MLPs, and the PatchMerger (LN -> window concat ->
+    linear -> exact GELU -> linear into the LLM hidden size). Full
+    attention per image (each batch row is one image). Returns
+    [B, n_patches/merge^2, out_dim] == HF visual() per image."""
+    b = images.shape[0]
+    nh = config.n_heads
+    hd = config.hidden // nh
+    eps = config.rms_eps
+    x = _qwen2vl_patches(images.astype(jnp.dtype(config.dtype)), config)
+    x = jnp.einsum("bpd,dh->bph", x, params["patch_proj"])
+    angles = jnp.asarray(_qwen2vl_rope(config))  # [T, hd/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [T, hd]
+    cos = jnp.cos(emb)[None, :, None, :]  # [1, T, 1, hd]
+    sin = jnp.sin(emb)[None, :, None, :]
+
+    def rot_half(v):
+        v1, v2 = jnp.split(v, 2, axis=-1)
+        return jnp.concatenate([-v2, v1], axis=-1)
+
+    for lp in params["layers"]:
+        hsrc = _ln(x, lp["ln1_w"], lp["ln1_b"], eps)
+        qkv = jnp.einsum("bph,hk->bpk", hsrc, lp["wqkv"]) + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        t = q.shape[1]
+        q = q.reshape(b, t, nh, hd).astype(jnp.float32)
+        k = k.reshape(b, t, nh, hd).astype(jnp.float32)
+        v = v.reshape(b, t, nh, hd)
+        q = q * cos + rot_half(q) * sin
+        k = k * cos + rot_half(k) * sin
+        scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / math.sqrt(hd)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bnqk,bknd->bqnd", probs,
+                          v.astype(jnp.float32)).astype(x.dtype)
+        attn = attn.reshape(b, t, config.hidden)
+        x = x + jnp.einsum("bph,ho->bpo", attn, lp["wo"]) + lp["bo"]
+        hsrc = _ln(x, lp["ln2_w"], lp["ln2_b"], eps)
+        up = jnp.einsum("bph,hm->bpm", hsrc, lp["w_up"]) + lp["b_up"]
+        x = x + jnp.einsum("bpm,mh->bph", _quick_gelu(up), lp["w_down"]) \
+            + lp["b_down"]
+    mg = params["merger"]
+    x = _ln(x, mg["ln_w"], mg["ln_b"], 1e-6)
+    m2 = config.spatial_merge ** 2
+    x = x.reshape(b, x.shape[1] // m2, m2 * config.hidden)
+    x = jnp.einsum("bpd,dm->bpm", x, mg["w1"]) + mg["b1"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jnp.einsum("bpm,mo->bpo", x, mg["w2"]) + mg["b2"]
+    return x.astype(jnp.float32)
+
+
 class VisionEncoder:
     """Host-facing encoder: owns params + a jitted forward."""
 
@@ -269,8 +365,12 @@ class VisionEncoder:
                 "checkpoint (VisionEncoder.from_checkpoint)")
         self.params = params or init_vision_params(
             jax.random.PRNGKey(seed), config)
-        fwd = vision_forward_hf if config.variant != "dyn" else \
-            vision_forward
+        if config.variant == "qwen2vl":
+            fwd = vision_forward_qwen2vl
+        elif config.variant != "dyn":
+            fwd = vision_forward_hf
+        else:
+            fwd = vision_forward
         self._fn = jax.jit(lambda p, imgs: fwd(p, config, imgs))
 
     @classmethod

@@ -47,12 +47,49 @@ def vision_config_from_checkpoint(path: str) -> VisionConfig:
             "(config.json + *.safetensors)")
     with open(cfg_path) as f:
         cfg = json.load(f)
+
+    def _norm_override(mean, std):
+        # preprocessor_config.json overrides the family normalization
+        pp_path = os.path.join(path, "preprocessor_config.json")
+        if os.path.isfile(pp_path):
+            with open(pp_path) as f:
+                pp = json.load(f)
+            mean = tuple(pp.get("image_mean", mean))
+            std = tuple(pp.get("image_std", std))
+        return mean, std
+
     model_type = cfg.get("model_type", "")
     if "vision_config" in cfg:  # parent CLIP/SigLIP/VLM config
         vc = cfg["vision_config"]
         model_type = vc.get("model_type", model_type)
     else:
         vc = cfg
+    if model_type.startswith("qwen2_vl") or (
+            cfg.get("model_type", "").startswith("qwen2_vl")):
+        # Qwen2-VL vision config uses different field names (embed_dim
+        # is the tower width; hidden_size is the LLM/merger output)
+        mean, std = _norm_override(_CLIP_MEAN, _CLIP_STD)
+        return VisionConfig(
+            # the family is native-resolution; serving fixes a square
+            # canvas (448 = 32x32 patches at p=14, merge-divisible) —
+            # override with dataclasses.replace for other canvases
+            image_size=int(vc.get("image_size", 448)),
+            patch_size=int(vc.get("patch_size", 14)),
+            hidden=int(vc.get("embed_dim", 1280)),
+            n_layers=int(vc.get("depth", 32)),
+            n_heads=int(vc.get("num_heads", 16)),
+            mlp_hidden=int(vc.get("embed_dim", 1280)
+                           * vc.get("mlp_ratio", 4)),
+            out_dim=int(vc.get("hidden_size", 3584)),
+            rms_eps=1e-6,
+            dtype="float32",
+            variant="qwen2vl",
+            image_mean=mean,
+            image_std=std,
+            name=cfg.get("model_type", model_type),
+            spatial_merge=int(vc.get("spatial_merge_size", 2)),
+            temporal_patch=int(vc.get("temporal_patch_size", 2)),
+        )
     if model_type.startswith("siglip"):
         variant = "siglip"
         mean, std = _SIGLIP_MEAN, _SIGLIP_STD
@@ -62,7 +99,7 @@ def vision_config_from_checkpoint(path: str) -> VisionConfig:
     else:
         raise ValueError(
             f"unsupported vision model_type {model_type!r} (expected a "
-            "siglip* or clip* tower)")
+            "siglip*, clip*, or qwen2_vl* tower)")
     # LLaVA-class VLM checkpoint: features come from an interior layer
     # (vision_feature_layer), CLIP's class token is dropped under the
     # "default" select strategy, and the multi-modal projector maps into
@@ -79,13 +116,7 @@ def vision_config_from_checkpoint(path: str) -> VisionConfig:
         drop_cls = cfg.get("vision_feature_select_strategy",
                            "default") == "default"
         out_dim = int(cfg["text_config"].get("hidden_size", out_dim))
-    # preprocessor_config.json overrides the family normalization
-    pp_path = os.path.join(path, "preprocessor_config.json")
-    if os.path.isfile(pp_path):
-        with open(pp_path) as f:
-            pp = json.load(f)
-        mean = tuple(pp.get("image_mean", mean))
-        std = tuple(pp.get("image_std", std))
+    mean, std = _norm_override(mean, std)
     hidden = int(vc["hidden_size"])
     return VisionConfig(
         image_size=int(vc["image_size"]),
@@ -113,7 +144,63 @@ def _lin(reader: ShardReader, name: str) -> np.ndarray:
 
 def load_vision_params(path: str, config: VisionConfig) -> dict:
     with ShardReader(path) as reader:
+        if config.variant == "qwen2vl":
+            return _load_qwen2vl_params(reader, config)
         return _load_vision_params(reader, config)
+
+
+def _load_qwen2vl_params(reader: ShardReader,
+                         config: VisionConfig) -> dict:
+    for pfx in ("visual.", "model.visual.", ""):
+        try:
+            reader.get(pfx + "merger.ln_q.weight")
+            break
+        except KeyError:
+            continue
+    else:
+        raise KeyError("no qwen2_vl visual tower found in checkpoint")
+
+    e = config.hidden
+    p = config.patch_size
+    tp = config.temporal_patch
+    conv = reader.get(pfx + "patch_embed.proj.weight")  # [e, 3, Tp, P, P]
+    assert conv.shape == (e, 3, tp, p, p), conv.shape
+    patch_proj = np.ascontiguousarray(
+        conv.reshape(e, 3 * tp * p * p).T)
+
+    layers = []
+    for i in range(config.n_layers):
+        lp = f"{pfx}blocks.{i}."
+        layers.append({
+            "ln1_w": reader.get(lp + "norm1.weight"),
+            "ln1_b": reader.get(lp + "norm1.bias"),
+            "wqkv": _lin(reader, lp + "attn.qkv.weight"),
+            "bqkv": reader.get(lp + "attn.qkv.bias"),
+            "wo": _lin(reader, lp + "attn.proj.weight"),
+            "bo": reader.get(lp + "attn.proj.bias"),
+            "ln2_w": reader.get(lp + "norm2.weight"),
+            "ln2_b": reader.get(lp + "norm2.bias"),
+            "w_up": _lin(reader, lp + "mlp.fc1.weight"),
+            "b_up": reader.get(lp + "mlp.fc1.bias"),
+            "w_down": _lin(reader, lp + "mlp.fc2.weight"),
+            "b_down": reader.get(lp + "mlp.fc2.bias"),
+        })
+    params = {
+        "patch_proj": patch_proj,
+        "layers": layers,
+        "merger": {
+            "ln_w": reader.get(pfx + "merger.ln_q.weight"),
+            "ln_b": reader.get(pfx + "merger.ln_q.bias"),
+            "w1": _lin(reader, pfx + "merger.mlp.0.weight"),
+            "b1": reader.get(pfx + "merger.mlp.0.bias"),
+            "w2": _lin(reader, pfx + "merger.mlp.2.weight"),
+            "b2": reader.get(pfx + "merger.mlp.2.bias"),
+        },
+    }
+    log.info("loaded qwen2vl vision tower: %d layers, width %d -> out "
+             "%d, merge %dx%d", config.n_layers, e, config.out_dim,
+             config.spatial_merge, config.spatial_merge)
+    return params
 
 
 def _load_vision_params(reader: ShardReader, config: VisionConfig) -> dict:

@@ -181,6 +181,73 @@ class TestVisionParity:
         np.testing.assert_allclose(ours, ref.reshape(ours.shape),
                                    atol=2e-3, rtol=2e-3)
 
+    def test_qwen2vl_tower_matches(self, tmp_path):
+        """Qwen2-VL-class tower (Conv3d patchify, 2D rope in
+        merge-window order, QuickGELU blocks, PatchMerger): our forward
+        on a [B, S, S, 3] image matches HF visual() on the same
+        patches, and our patch arrangement matches the HF image
+        processor's."""
+        import dataclasses
+
+        import torch
+        import transformers
+
+        from dynamo_tpu.models.vision import (
+            _qwen2vl_patches,
+            vision_forward_qwen2vl,
+        )
+        from dynamo_tpu.models.vision_checkpoint import (
+            load_vision_params,
+            vision_config_from_checkpoint,
+        )
+
+        torch.manual_seed(4)
+        vc = dict(depth=2, embed_dim=32, num_heads=2, hidden_size=48,
+                  mlp_ratio=2, patch_size=8, spatial_merge_size=2,
+                  temporal_patch_size=2, in_channels=3)
+        tc = transformers.Qwen2Config(
+            vocab_size=64, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2)
+        cfg = transformers.Qwen2VLConfig(
+            vision_config=vc, text_config=tc.to_dict(),
+            image_token_id=61, video_token_id=62, vision_start_token_id=59,
+            vision_end_token_id=60)
+        model = transformers.Qwen2VLForConditionalGeneration(cfg)
+        model = model.eval().to(torch.float32)
+        path = str(tmp_path / "qwen2vl")
+        model.save_pretrained(path, safe_serialization=True)
+
+        config = vision_config_from_checkpoint(path)
+        assert config.variant == "qwen2vl"
+        assert config.out_dim == 48 and config.spatial_merge == 2
+        config = dataclasses.replace(config, image_size=32)
+        assert config.n_image_tokens == 4  # 4x4 patches / 2x2 merge
+        params = load_vision_params(path, config)
+
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(6)
+        img = rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+        patches = np.asarray(_qwen2vl_patches(jnp.asarray(img), config))
+        grid = torch.tensor([[1, 4, 4]])
+        with torch.no_grad():
+            ref = model.visual(torch.tensor(patches[0]),
+                               grid_thw=grid).numpy()
+        ours = np.asarray(vision_forward_qwen2vl(
+            params, config, jnp.asarray(img)))
+        np.testing.assert_allclose(ours[0], ref, atol=2e-3, rtol=2e-3)
+
+        # patch arrangement == the HF image processor's (no resize /
+        # rescale / normalize so the raw arrangement is isolated)
+        proc = transformers.models.qwen2_vl.Qwen2VLImageProcessor(
+            do_resize=False, do_rescale=False, do_normalize=False,
+            patch_size=8, merge_size=2, temporal_patch_size=2)
+        out = proc(images=[img[0]], return_tensors="np")
+        assert out["image_grid_thw"].tolist() == [[1, 4, 4]]
+        np.testing.assert_allclose(out["pixel_values"], patches[0],
+                                   atol=1e-6)
+
     def test_unsupported_tower_rejected(self, tmp_path):
         import json
 
