@@ -167,9 +167,19 @@ class BanTokensProcessor:
         logits[self.token_ids] = -np.inf
 
 
+def _guided_factory(tokenizer=None, **kwargs):
+    from .guided import make_guided_processor
+
+    return make_guided_processor(tokenizer=tokenizer, **kwargs)
+
+
 register_processor("forced_response", ForcedResponseProcessor)
 register_processor("temperature", TemperatureProcessor)
 register_processor("ban_tokens", BanTokensProcessor)
+# Structured outputs (llm/guided.py): regex / choice / json_schema /
+# json_object constraints as a DFA-masking processor — the engine-side
+# enforcement of the reference's guided_decoding protocol options.
+register_processor("guided", _guided_factory)
 
 
 def host_sample(logits: np.ndarray, temperature: float, top_p: float,

@@ -227,6 +227,37 @@ class OpenAIPreprocessor:
                 pre.annotations["priority"] = nvext["priority"]
             if nvext.get("logits_processors"):
                 pre.logits_processors = list(nvext["logits_processors"])
+            if nvext.get("guided_decoding"):
+                # reference protocol (common.rs GuidedDecodingOptions):
+                # exactly one of json / regex / choice is set (validated
+                # in llm/validate.py); enforced by the engine-side
+                # 'guided' processor (llm/guided.py)
+                gd = dict(nvext["guided_decoding"])
+                args = {}
+                if gd.get("regex") is not None:
+                    args["regex"] = gd["regex"]
+                elif gd.get("choice") is not None:
+                    args["choice"] = list(gd["choice"])
+                elif gd.get("json") is not None:
+                    js = gd["json"]
+                    if js is True or js == "object":
+                        args["json_object"] = True
+                    else:
+                        args["json_schema"] = js
+                pre.logits_processors.append(
+                    {"name": "guided", "args": args})
+        rf = request.get("response_format")
+        if isinstance(rf, dict) and rf.get("type") in ("json_object",
+                                                       "json_schema"):
+            # OpenAI structured outputs ride the same guided processor
+            args = {"json_object": True}
+            if rf.get("type") == "json_schema":
+                schema = (rf.get("json_schema") or {}).get("schema")
+                if schema is not None:
+                    # {} stays a schema: it permits ANY value, which is
+                    # WEAKER than json_object's top-level-object rule
+                    args = {"json_schema": schema}
+            pre.logits_processors.append({"name": "guided", "args": args})
         return pre
 
 
@@ -376,13 +407,27 @@ class DeltaGenerator:
             self._stopped = True
             return [self._chunk({}, "error")]
         self.completion_tokens += len(output.token_ids)
+        final = output.finish_reason is not None
+        ids = output.token_ids
+        trimmed_eos = (output.finish_reason == "stop" and ids
+                       and (ids[-1] in self.request.eos_token_ids
+                            or ids[-1] in self.request.stop.stop_token_ids))
+        if trimmed_eos:
+            # the terminating eos/stop TOKEN is not content (HF
+            # tokenizers render it as "" via skip_special_tokens, but
+            # e.g. the byte tokenizer names its specials)
+            ids = ids[:-1]
         new_lp_entries: list[dict] = []
         if output.logprobs is not None:
             before = len(self.logprob_entries)
             self._collect_logprobs(output)
             new_lp_entries = self.logprob_entries[before:]
-        final = output.finish_reason is not None
-        text = self.detok.push(output.token_ids)
+            if trimmed_eos and new_lp_entries:
+                # keep logprob entries 1:1 with CONTENT tokens (OpenAI
+                # emits no entry for the stop token)
+                new_lp_entries.pop()
+                self.logprob_entries.pop()
+        text = self.detok.push(ids)
         if final:
             text += self.detok.flush()
         emit, hit_stop = self._filter_stop(text, final)

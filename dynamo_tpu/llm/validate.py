@@ -31,7 +31,8 @@ CHAT_FIELDS = _COMMON_FIELDS | {
 COMPLETION_FIELDS = _COMMON_FIELDS | {"prompt", "echo", "suffix"}
 
 # nvext is our extension namespace (the reference's NvExt analog).
-NVEXT_FIELDS = {"annotations", "priority", "logits_processors"}
+NVEXT_FIELDS = {"annotations", "priority", "logits_processors",
+                "guided_decoding"}
 
 
 def _reject_unknown(body: dict, allowed: set) -> None:
@@ -125,15 +126,58 @@ def validate_request(body: dict, kind: str) -> None:
 
     rf = body.get("response_format")
     if rf is not None:
-        # No guided decoding in the engine yet: accepting json_object /
-        # json_schema and returning free text would be silent wrong
-        # behavior (the failure mode this module exists to prevent).
-        if not (isinstance(rf, dict) and rf.get("type") == "text"):
+        # json_object / json_schema are enforced by the engine-side
+        # guided-decoding processor (llm/guided.py); anything else would
+        # be silent wrong behavior.
+        if not (isinstance(rf, dict)
+                and rf.get("type") in ("text", "json_object",
+                                       "json_schema")):
             got = rf.get("type") if isinstance(rf, dict) else rf
             raise RequestError(
                 f"response_format type {got!r} is not supported "
-                "(only 'text'); structured output is not available on "
-                "this deployment")
+                "(text, json_object, or json_schema)")
+        if isinstance(rf, dict) and rf.get("type") == "json_schema":
+            js = rf.get("json_schema")
+            if not isinstance(js, dict) or not isinstance(
+                    js.get("schema"), dict):
+                raise RequestError(
+                    "response_format json_schema needs "
+                    "{'json_schema': {'schema': {...}}}")
+
+    gd = (body.get("nvext") or {}).get("guided_decoding") \
+        if isinstance(body.get("nvext"), dict) else None
+    if gd is not None:
+        if not isinstance(gd, dict):
+            raise RequestError("nvext.guided_decoding must be an object")
+        if isinstance(rf, dict) and rf.get("type") in ("json_object",
+                                                       "json_schema"):
+            raise RequestError(
+                "nvext.guided_decoding and response_format "
+                "json_object/json_schema cannot be combined (two "
+                "constraints would intersect)")
+        set_keys = [k for k in ("json", "regex", "choice", "grammar")
+                    if gd.get(k) is not None]
+        if len(set_keys) != 1:
+            raise RequestError(
+                "nvext.guided_decoding needs exactly one of json / "
+                "regex / choice")
+        if set_keys == ["json"] and not (
+                isinstance(gd["json"], dict) or gd["json"] is True
+                or gd["json"] == "object"):
+            raise RequestError(
+                "guided_decoding.json must be a JSON-schema object, "
+                "true, or 'object'")
+        if set_keys == ["grammar"]:
+            raise RequestError(
+                "guided_decoding.grammar (EBNF) is not supported; use "
+                "json, regex, or choice")
+        if set_keys == ["choice"] and not (
+                isinstance(gd["choice"], list) and gd["choice"]
+                and all(isinstance(c, str) for c in gd["choice"])):
+            raise RequestError(
+                "guided_decoding.choice must be a non-empty string list")
+        if set_keys == ["regex"] and not isinstance(gd["regex"], str):
+            raise RequestError("guided_decoding.regex must be a string")
 
     suffix = body.get("suffix")
     if suffix is not None and suffix != "":

@@ -241,8 +241,24 @@ class TestRequestStrictness:
             ({"model": "m", "messages": [], "add_special_tokens": False},
              "Unsupported parameter: 'add_special_tokens'"),
             ({"model": "m", "messages": [],
-              "response_format": {"type": "json_object"}},
-             "response_format type 'json_object'"),
+              "response_format": {"type": "xml"}},
+             "response_format type 'xml'"),
+            ({"model": "m", "messages": [],
+              "response_format": {"type": "json_schema"}},
+             "json_schema needs"),
+            ({"model": "m", "messages": [],
+              "nvext": {"guided_decoding": {"grammar": "root ::= x"}}},
+             "grammar"),
+            ({"model": "m", "messages": [],
+              "nvext": {"guided_decoding": {"regex": "a", "choice": ["b"]}}},
+             "exactly one"),
+            ({"model": "m", "messages": [],
+              "nvext": {"guided_decoding": {"json": "not-a-schema"}}},
+             "guided_decoding.json"),
+            ({"model": "m", "messages": [],
+              "response_format": {"type": "json_object"},
+              "nvext": {"guided_decoding": {"regex": "a"}}},
+             "cannot be combined"),
             ({"model": "m", "messages": [], "temperature": 3.0},
              "'temperature' must be between"),
             ({"model": "m", "messages": [], "top_p": 1.5},
@@ -287,7 +303,7 @@ class TestRequestStrictness:
                             "model": "mock-model",
                             "messages": [
                                 {"role": "user", "content": "hi"}],
-                            "response_format": {"type": "json_object"},
+                            "response_format": {"type": "xml"},
                         }) as resp:
                     assert resp.status == 400
                     data = await resp.json()
