@@ -26,6 +26,7 @@ from __future__ import annotations
 import gc
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -378,8 +379,25 @@ def main() -> None:
     # over bf16 weights / 1.70x over W8A16, measured r5) + int8 KV (the
     # capacity lever; at 7B bf16 weights + bf16 KV exceed HBM).
     # Secondaries: the int8- and bf16-weight 7B configs and the toy.
-    result = bench_one("mistral-7b", kv_dtype="int8", weight_dtype="int4",
-                       num_pages=448, device_kind=device_kind)
+    # One retry on the flagship: the dev chip is tunnel-attached and a
+    # transient relay error (HTTP 500 from the remote-compile helper,
+    # observed r5) must not cost the round its headline number.
+    try:
+        result = bench_one("mistral-7b", kv_dtype="int8",
+                           weight_dtype="int4", num_pages=448,
+                           device_kind=device_kind)
+    except Exception:  # noqa: BLE001 — retry once after a clean slate
+        import traceback
+
+        print("flagship bench failed once; retrying after reset:",
+              file=sys.stderr)
+        traceback.print_exc()
+        gc.collect()
+        jax.clear_caches()
+        time.sleep(5)
+        result = bench_one("mistral-7b", kv_dtype="int8",
+                           weight_dtype="int4", num_pages=448,
+                           device_kind=device_kind)
     secondary = []
     for label, kwargs in (
         ("mistral-7b int8 weights",

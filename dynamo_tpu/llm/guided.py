@@ -503,7 +503,7 @@ def token_bytes_for(tokenizer) -> list[Optional[bytes]]:
     and is identical for every pattern."""
     cached = _TOKEN_BYTES_CACHE.get(id(tokenizer))
     if cached is not None:
-        return cached
+        return cached[1]
     out: list[Optional[bytes]] = []
     specials = getattr(tokenizer, "SPECIALS", {})
     for i in range(tokenizer.vocab_size):
@@ -527,7 +527,7 @@ def token_bytes_for(tokenizer) -> list[Optional[bytes]]:
         out.append(text.encode("utf-8"))
     if len(_TOKEN_BYTES_CACHE) > 8:
         _TOKEN_BYTES_CACHE.clear()
-    _TOKEN_BYTES_CACHE[id(tokenizer)] = out
+    _TOKEN_BYTES_CACHE[id(tokenizer)] = (tokenizer, out)
     return out
 
 
@@ -601,11 +601,15 @@ def schema_to_regex(schema: dict, depth: int = 0) -> str:
         lo = int(schema.get("minItems", 0))
         hi = schema.get("maxItems")
         more = f"(,{_WS}{item})"
+        if hi is not None and int(hi) == 0:
+            if lo > 0:
+                raise RegexError("bad minItems/maxItems")
+            return r"\[\]"  # maxItems 0: the array must be empty
         if hi is None:
             tail = f"{more}{{{max(lo - 1, 0)},}}" if lo > 1 else f"{more}*"
         else:
             hi = int(hi)
-            if hi < 1 or (lo and hi < lo):
+            if lo and hi < lo:
                 raise RegexError("bad minItems/maxItems")
             tail = f"{more}{{{max(lo - 1, 0)},{hi - 1}}}"
         body = f"{item}{tail}"
@@ -644,6 +648,46 @@ def json_object_regex(max_depth: int = 4) -> str:
     value = json_value_regex(max_depth - 1)
     return (rf"\{{({_STRING}:{_WS}{value}"
             rf"(,{_WS}{_STRING}:{_WS}{value})*)?\}}")
+
+
+def tool_call_regex(format_name: str, tools: list,
+                    specific: Optional[str] = None) -> str:
+    """Output grammar for a FORCED tool call (OpenAI tool_choice
+    'required' / named function): the call JSON is constrained to a
+    declared function name + its parameter schema, wrapped in the
+    model's tool-parser format so the parser extracts it losslessly.
+    """
+    fmt = (format_name or "").lower()
+    args_key = "parameters" if fmt == "llama3_json" else "arguments"
+    calls = []
+    for tool in tools or []:
+        fn = tool.get("function", tool) if isinstance(tool, dict) else {}
+        name = fn.get("name")
+        if not isinstance(name, str) or not name:
+            continue
+        if specific is not None and name != specific:
+            continue
+        params = fn.get("parameters")
+        args_re = schema_to_regex(params) if params else \
+            json_object_regex()
+        calls.append(
+            rf'\{{"name":{_WS}"{_re_escape(name)}",{_WS}'
+            rf'"{args_key}":{_WS}{args_re}\}}')
+    if not calls:
+        raise RegexError(
+            f"tool_choice names no declared function "
+            f"({specific!r} not in tools)" if specific is not None
+            else "tool_choice 'required' needs non-empty tools")
+    call = "(" + "|".join(calls) + ")"
+    if fmt in ("hermes", "qwen"):
+        return rf"<tool_call>\n?{call}\n?</tool_call>"
+    if fmt == "llama3_json":
+        return call  # the whole message IS the call object
+    if fmt == "mistral":
+        return rf"\[TOOL_CALLS\] ?\[{call}\]"
+    raise RegexError(
+        f"tool_choice forcing is not supported for tool parser "
+        f"{format_name!r} (hermes/qwen, llama3_json, mistral)")
 
 
 # ---------------------------------------------------------------------------
@@ -691,18 +735,21 @@ def make_guided_processor(tokenizer=None, *, regex: Optional[str] = None,
                           choice: Optional[list] = None,
                           json_schema: Optional[dict] = None,
                           json_object: bool = False,
-                          whitespace_ok: bool = True) -> GuidedProcessor:
+                          tool_call: Optional[dict] = None,
+                          ) -> GuidedProcessor:
     """Factory registered as the 'guided' logits processor. Exactly one
-    of regex / choice / json_schema / json_object selects the grammar.
-    Compiled TokenGuides are cached per (tokenizer, pattern) — schema
-    compilation and vocab mask precomputation amortize across requests.
+    of regex / choice / json_schema / json_object / tool_call selects
+    the grammar. Compiled TokenGuides are cached per (tokenizer,
+    pattern) — schema compilation and vocab mask precomputation amortize
+    across requests.
     """
     given = [regex is not None, choice is not None,
-             json_schema is not None, bool(json_object)]
+             json_schema is not None, bool(json_object),
+             tool_call is not None]
     if sum(given) != 1:
         raise ValueError(
             "guided decoding needs exactly one of regex / choice / "
-            "json_schema / json_object")
+            "json_schema / json_object / tool_call")
     if tokenizer is None:
         raise ValueError("guided decoding needs the worker tokenizer")
     if regex is not None:
@@ -713,15 +760,23 @@ def make_guided_processor(tokenizer=None, *, regex: Optional[str] = None,
         pattern = "(" + "|".join(_re_escape(c) for c in choice) + ")"
     elif json_schema is not None:
         pattern = schema_to_regex(json_schema)
+    elif tool_call is not None:
+        pattern = tool_call_regex(tool_call.get("format", ""),
+                                  tool_call.get("tools") or [],
+                                  tool_call.get("name"))
     else:
         pattern = json_object_regex()
     key = (id(tokenizer), pattern)
-    guide = _GUIDE_CACHE.get(key)
-    if guide is None:
+    entry = _GUIDE_CACHE.get(key)
+    if entry is None:
         dfa = compile_regex(pattern)
         guide = TokenGuide(dfa, token_bytes_for(tokenizer),
                            getattr(tokenizer, "eos_token_ids", []))
         if len(_GUIDE_CACHE) > 64:
             _GUIDE_CACHE.clear()
-        _GUIDE_CACHE[key] = guide
+        # hold the tokenizer so its id cannot be recycled underneath
+        # the cache key while this entry lives
+        _GUIDE_CACHE[key] = (tokenizer, guide)
+    else:
+        guide = entry[1]
     return GuidedProcessor(guide)

@@ -258,6 +258,34 @@ class OpenAIPreprocessor:
                     # WEAKER than json_object's top-level-object rule
                     args = {"json_schema": schema}
             pre.logits_processors.append({"name": "guided", "args": args})
+        tc = request.get("tool_choice")
+        forced_name = None
+        force_tools = False
+        if tc == "required":
+            force_tools = True
+        elif isinstance(tc, dict) and tc.get("type") == "function":
+            force_tools = True
+            forced_name = (tc.get("function") or {}).get("name")
+        if force_tools:
+            # OpenAI tool_choice forcing: constrain the output to a
+            # declared function call in the model's tool-parser format
+            # (validated in llm/validate.py; the grammar is built by
+            # guided.tool_call_regex so the parser extracts it).
+            if not self.card.tool_parser:
+                raise RequestError(
+                    "tool_choice forcing needs a model served with a "
+                    "tool parser (--tool-call-parser)")
+            if self.card.tool_parser.lower() not in (
+                    "hermes", "qwen", "llama3_json", "mistral"):
+                # reject HERE (-> 400), not at engine grammar-build time
+                raise RequestError(
+                    "tool_choice forcing is not supported for tool "
+                    f"parser {self.card.tool_parser!r} (hermes/qwen, "
+                    "llama3_json, mistral)")
+            pre.logits_processors.append({"name": "guided", "args": {
+                "tool_call": {"format": self.card.tool_parser,
+                              "tools": request.get("tools") or [],
+                              "name": forced_name}}})
         return pre
 
 

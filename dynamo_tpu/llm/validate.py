@@ -144,6 +144,40 @@ def validate_request(body: dict, kind: str) -> None:
                     "response_format json_schema needs "
                     "{'json_schema': {'schema': {...}}}")
 
+    tc = body.get("tool_choice")
+    if tc is not None:
+        tools = body.get("tools")
+        names = []
+        if isinstance(tools, list):
+            names = [n for n in ((t.get("function") or {}).get("name")
+                                 for t in tools if isinstance(t, dict))
+                     if isinstance(n, str) and n]
+        if isinstance(tc, str):
+            if tc not in ("none", "auto", "required"):
+                raise RequestError(
+                    "tool_choice must be 'none', 'auto', 'required', or "
+                    "{'type': 'function', 'function': {'name': ...}}")
+            if tc == "required" and not names:
+                raise RequestError(
+                    "tool_choice 'required' needs non-empty 'tools'")
+        elif isinstance(tc, dict) and tc.get("type") == "function":
+            name = (tc.get("function") or {}).get("name")
+            if not isinstance(name, str) or not name:
+                raise RequestError(
+                    "tool_choice function needs a 'name'")
+            if name not in names:
+                raise RequestError(
+                    f"tool_choice function {name!r} is not in 'tools'")
+        else:
+            raise RequestError(
+                "tool_choice must be 'none', 'auto', 'required', or "
+                "{'type': 'function', 'function': {'name': ...}}")
+        if tc not in ("none", "auto") and isinstance(rf, dict) \
+                and rf.get("type") in ("json_object", "json_schema"):
+            raise RequestError(
+                "tool_choice forcing and response_format "
+                "json_object/json_schema cannot be combined")
+
     gd = (body.get("nvext") or {}).get("guided_decoding") \
         if isinstance(body.get("nvext"), dict) else None
     if gd is not None:
@@ -155,6 +189,10 @@ def validate_request(body: dict, kind: str) -> None:
                 "nvext.guided_decoding and response_format "
                 "json_object/json_schema cannot be combined (two "
                 "constraints would intersect)")
+        if tc is not None and tc not in ("none", "auto"):
+            raise RequestError(
+                "nvext.guided_decoding and tool_choice forcing cannot "
+                "be combined (two constraints would intersect)")
         set_keys = [k for k in ("json", "regex", "choice", "grammar")
                     if gd.get(k) is not None]
         if len(set_keys) != 1:

@@ -256,6 +256,16 @@ class TestRequestStrictness:
               "nvext": {"guided_decoding": {"json": "not-a-schema"}}},
              "guided_decoding.json"),
             ({"model": "m", "messages": [],
+              "tools": [{"type": "function", "function": {}}],
+              "tool_choice": "required"},
+             "non-empty 'tools'"),
+            ({"model": "m", "messages": [],
+              "tools": [{"type": "function",
+                         "function": {"name": "f"}}],
+              "tool_choice": {"type": "function",
+                              "function": {"name": "g"}}},
+             "not in 'tools'"),
+            ({"model": "m", "messages": [],
               "response_format": {"type": "json_object"},
               "nvext": {"guided_decoding": {"regex": "a"}}},
              "cannot be combined"),

@@ -104,6 +104,13 @@ class TestSchemaRegex:
         assert not dfa.fullmatch(b"[1, 2]")  # top level must be an object
         assert not dfa.fullmatch(b'{"a": }')
 
+    def test_max_items_zero_is_empty_array(self):
+        dfa = compile_regex(schema_to_regex(
+            {"type": "array", "items": {"type": "integer"},
+             "maxItems": 0}))
+        assert dfa.fullmatch(b"[]")
+        assert not dfa.fullmatch(b"[1]")
+
     def test_unsupported_schema_rejected(self):
         with pytest.raises(RegexError):
             schema_to_regex({"$ref": "#/x"})
@@ -172,103 +179,13 @@ class TestGuidedE2E:
     """Through the REAL engine worker: random-init tiny model, greedy,
     constraint supplied via response_format / nvext.guided_decoding."""
 
-    def _serve(self, run, body_patch, check):
-        import asyncio
-
-        import aiohttp
-
-        from dynamo_tpu.engine import RunnerConfig, TpuWorker
-        from dynamo_tpu.frontend import Frontend
-        from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
-
-        def _cfg():
-            cfg = RuntimeConfig.from_env()
-            cfg.discovery_backend = "mem"
-            cfg.discovery_path = self._cluster
-            cfg.request_plane = "tcp"
-            cfg.tcp_host = "127.0.0.1"
-            cfg.event_plane = "mem"
-            cfg.system_enabled = False
-            return cfg
-
-        async def body():
-            rt_w = await DistributedRuntime(_cfg()).start()
-            worker = TpuWorker(
-                rt_w, model_name="tiny-test", warmup=False,
-                runner_config=RunnerConfig(
-                    page_size=4, num_pages=64, max_batch=2,
-                    max_pages_per_seq=16, prefill_buckets=(16, 32)),
-            )
-            await worker.prepare()
-            await worker.serve()
-            rt_f = await DistributedRuntime(_cfg()).start()
-            frontend = Frontend(rt_f, host="127.0.0.1", port=0)
-            await frontend.start()
-            for _ in range(100):
-                if frontend.manager.get("tiny-test") is not None:
-                    break
-                await asyncio.sleep(0.05)
-            try:
-                # tiny-test's context is 64 total; /v1/completions with a
-                # one-token prompt leaves the whole budget for the
-                # constrained output (chat templates eat ~50 tokens)
-                payload = {
-                    "model": "tiny-test",
-                    "prompt": "x",
-                    "max_tokens": 48,
-                    "temperature": 0,
-                }
-                payload.update(body_patch)
-                base = f"http://127.0.0.1:{frontend.port}"
-                async with aiohttp.ClientSession() as session:
-                    async with session.post(
-                        f"{base}/v1/completions", json=payload,
-                    ) as resp:
-                        data = await resp.json()
-                        assert resp.status == 200, data
-                        assert data["choices"][0]["finish_reason"] == \
-                            "stop", data
-                        check(data["choices"][0]["text"])
-            finally:
-                await frontend.close()
-                await rt_f.shutdown()
-                await worker.close()
-                await rt_w.shutdown()
-
-        self._cluster = uuid.uuid4().hex
-        run(body(), timeout=120)
-
-    def test_choice_constrains_output(self, run):
-        self._serve(
-            run,
-            {"nvext": {"guided_decoding": {"choice": ["left", "right"]}}},
-            lambda text: (_ for _ in ()).throw(AssertionError(text))
-            if text not in ("left", "right") else None,
-        )
-
-    def test_json_schema_output_parses(self, run):
-        schema = {"type": "object",
-                  "properties": {"a": {"type": "integer"},
-                                 "b": {"enum": ["x", "y"]}}}
-
-        def check(text):
-            try:
-                data = json.loads(text)
-            except json.JSONDecodeError as exc:
-                raise AssertionError(f"bad JSON: {text!r}") from exc
-            assert isinstance(data["a"], int)
-            assert data["b"] in ("x", "y")
-
-        self._serve(
-            run,
-            {"nvext": {"guided_decoding": {"json": schema}}},
-            check,
-        )
-
-    def test_response_format_on_chat_route(self, run):
-        """OpenAI response_format json_schema through /v1/chat/completions
-        (a minimal schema: the tiny model's 64-token context leaves ~12
-        tokens after the chat template)."""
+    def _serve(self, run, body_patch, check, *, route="completions",
+               worker_kwargs=None, big_pool=False,
+               expect_finish="stop"):
+        """One scaffold for every E2E case: spawn a real TpuWorker +
+        Frontend, POST the route with `body_patch` over a base payload,
+        assert 200 + finish_reason, hand the response to `check`
+        (which gets the choice dict)."""
         import asyncio
 
         import aiohttp
@@ -289,14 +206,19 @@ class TestGuidedE2E:
             cfg.system_enabled = False
             return cfg
 
+        # big_pool: 256-token context (chat-route cases need room past
+        # the template); default: tiny-test's 64-token context with a
+        # one-token /v1/completions prompt leaving the budget to output
+        rc = (RunnerConfig(page_size=4, num_pages=256, max_batch=2,
+                           max_pages_per_seq=64, prefill_buckets=(16, 64))
+              if big_pool else
+              RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                           max_pages_per_seq=16, prefill_buckets=(16, 32)))
+
         async def body():
             rt_w = await DistributedRuntime(_cfg()).start()
-            worker = TpuWorker(
-                rt_w, model_name="tiny-test", warmup=False,
-                runner_config=RunnerConfig(
-                    page_size=4, num_pages=64, max_batch=2,
-                    max_pages_per_seq=16, prefill_buckets=(16, 32)),
-            )
+            worker = TpuWorker(rt_w, model_name="tiny-test", warmup=False,
+                               runner_config=rc, **(worker_kwargs or {}))
             await worker.prepare()
             await worker.serve()
             rt_f = await DistributedRuntime(_cfg()).start()
@@ -307,25 +229,26 @@ class TestGuidedE2E:
                     break
                 await asyncio.sleep(0.05)
             try:
-                schema = {"type": "object",
-                          "properties": {"a": {"enum": ["x"]}}}
+                if route == "completions":
+                    payload = {"model": "tiny-test", "prompt": "x",
+                               "max_tokens": 48, "temperature": 0}
+                else:
+                    payload = {"model": "tiny-test",
+                               "messages": [{"role": "user",
+                                             "content": "go"}],
+                               "max_tokens": 12, "temperature": 0}
+                payload.update(body_patch)
                 base = f"http://127.0.0.1:{frontend.port}"
                 async with aiohttp.ClientSession() as session:
                     async with session.post(
-                        f"{base}/v1/chat/completions",
-                        json={"model": "tiny-test",
-                              "messages": [{"role": "user",
-                                            "content": "go"}],
-                              "max_tokens": 12, "temperature": 0,
-                              "response_format": {
-                                  "type": "json_schema",
-                                  "json_schema": {"name": "t",
-                                                  "schema": schema}}},
+                        f"{base}/v1/{route}", json=payload,
                     ) as resp:
                         data = await resp.json()
                         assert resp.status == 200, data
-                        text = data["choices"][0]["message"]["content"]
-                        assert json.loads(text) == {"a": "x"}, text
+                        choice = data["choices"][0]
+                        assert choice["finish_reason"] == expect_finish, \
+                            data
+                        check(choice)
             finally:
                 await frontend.close()
                 await rt_f.shutdown()
@@ -334,12 +257,125 @@ class TestGuidedE2E:
 
         run(body(), timeout=120)
 
+    def test_choice_constrains_output(self, run):
+        def check(choice):
+            assert choice["text"] in ("left", "right"), choice
+
+        self._serve(
+            run,
+            {"nvext": {"guided_decoding": {"choice": ["left", "right"]}}},
+            check,
+        )
+
+    def test_json_schema_output_parses(self, run):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"},
+                                 "b": {"enum": ["x", "y"]}}}
+
+        def check(choice):
+            text = choice["text"]
+            try:
+                data = json.loads(text)
+            except json.JSONDecodeError as exc:
+                raise AssertionError(f"bad JSON: {text!r}") from exc
+            assert isinstance(data["a"], int)
+            assert data["b"] in ("x", "y")
+
+        self._serve(
+            run,
+            {"nvext": {"guided_decoding": {"json": schema}}},
+            check,
+        )
+
+    def test_response_format_on_chat_route(self, run):
+        """OpenAI response_format json_schema through /v1/chat/completions
+        (a minimal schema: the tiny model's chat template eats most of
+        the default context, so the big pool variant serves this)."""
+        schema = {"type": "object", "properties": {"a": {"enum": ["x"]}}}
+
+        def check(choice):
+            text = choice["message"]["content"]
+            assert json.loads(text) == {"a": "x"}, text
+
+        self._serve(
+            run,
+            {"response_format": {"type": "json_schema",
+                                 "json_schema": {"name": "t",
+                                                 "schema": schema}}},
+            check, route="chat/completions",
+        )
+
     def test_regex_via_nvext(self, run):
+        def check(choice):
+            assert re.fullmatch(r"[ab]{3,6}", choice["text"]), choice
+
         self._serve(
             run,
             {"nvext": {"guided_decoding": {"regex": r"[ab]{3,6}"}}},
-            lambda text: (_ for _ in ()).throw(AssertionError(text))
-            if not re.fullmatch(r"[ab]{3,6}", text) else None,
+            check,
+        )
+
+    def test_tool_call_regex_round_trips_parser(self):
+        """The forced-tool grammar is exactly what the tool parsers
+        extract: a conforming string parses into a ToolCall."""
+        from dynamo_tpu.llm.guided import tool_call_regex
+        from dynamo_tpu.parsers.tool_calls import make_tool_parser
+
+        tools = [{"type": "function", "function": {
+            "name": "get_weather",
+            "parameters": {"type": "object",
+                           "properties": {"city": {"type": "string"}}}}}]
+        pat = tool_call_regex("hermes", tools)
+        dfa = compile_regex(pat)
+        good = ('<tool_call>{"name": "get_weather", '
+                '"arguments": {"city": "oslo"}}</tool_call>')
+        assert dfa.fullmatch(good.encode())
+        assert not dfa.fullmatch(
+            b'<tool_call>{"name": "other", "arguments": {}}</tool_call>')
+        parser = make_tool_parser("hermes")
+        ev = parser.push(good)
+        fin = parser.finalize()
+        calls = ev.calls + fin.calls
+        assert calls and calls[0].name == "get_weather"
+        assert json.loads(calls[0].arguments) == {"city": "oslo"}
+
+        # llama3_json: the whole message is the call, "parameters" key
+        pat = tool_call_regex("llama3_json", tools, "get_weather")
+        assert compile_regex(pat).fullmatch(
+            b'{"name": "get_weather", "parameters": {"city": "x"}}')
+        # mistral wrapper
+        pat = tool_call_regex("mistral", tools)
+        assert compile_regex(pat).fullmatch(
+            b'[TOOL_CALLS] [{"name": "get_weather", '
+            b'"arguments": {"city": "y"}}]')
+        with pytest.raises(RegexError, match="not in tools"):
+            tool_call_regex("hermes", tools, "nope")
+        with pytest.raises(RegexError, match="not supported"):
+            tool_call_regex("pythonic", tools)
+
+    def test_tool_choice_forced_e2e(self, run):
+        """tool_choice 'required' through the real worker: the guided
+        grammar forces a hermes tool call and the DeltaGenerator's
+        parser returns it as tool_calls with finish_reason
+        'tool_calls'."""
+        tools = [{"type": "function", "function": {
+            "name": "pick",
+            "parameters": {"type": "object", "properties": {
+                "v": {"enum": ["a", "b"]}}}}}]
+
+        def check(choice):
+            calls = choice["message"].get("tool_calls")
+            assert calls, choice
+            assert calls[0]["function"]["name"] == "pick"
+            args = json.loads(calls[0]["function"]["arguments"])
+            assert args["v"] in ("a", "b")
+
+        self._serve(
+            run,
+            {"tools": tools, "tool_choice": "required", "max_tokens": 80},
+            check, route="chat/completions", big_pool=True,
+            worker_kwargs={"tool_parser": "hermes"},
+            expect_finish="tool_calls",
         )
 
     def test_grammar_rejected_400(self, run):
