@@ -354,7 +354,10 @@ class InferenceScheduler:
         the sequence onto the host-sampling decode path."""
         from ..llm.logits_processing import (
             LogitBiasProcessor,
+            MinPProcessor,
+            MinTokensProcessor,
             PenaltyProcessor,
+            RepetitionPenaltyProcessor,
             resolve_processors,
         )
 
@@ -366,6 +369,10 @@ class InferenceScheduler:
         if s.frequency_penalty or s.presence_penalty:
             procs.append(PenaltyProcessor(s.frequency_penalty,
                                           s.presence_penalty))
+        if getattr(s, "repetition_penalty", 1.0) != 1.0:
+            # HF semantics penalize prompt AND generated tokens
+            procs.append(RepetitionPenaltyProcessor(
+                s.repetition_penalty, prompt_ids=request.token_ids))
         if request.logits_processors:
             procs.extend(resolve_processors(
                 request.logits_processors,

@@ -167,6 +167,62 @@ class BanTokensProcessor:
         logits[self.token_ids] = -np.inf
 
 
+class RepetitionPenaltyProcessor:
+    """HF-semantics multiplicative repetition penalty over every token
+    generated so far: positive logits divide by the penalty, negative
+    multiply (ref protocol: protocols/common.rs repetition_penalty)."""
+
+    def __init__(self, penalty: float) -> None:
+        if penalty <= 0:
+            raise ValueError("repetition_penalty must be positive")
+        self.penalty = float(penalty)
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        if not len(input_ids) or self.penalty == 1.0:
+            return
+        ids = np.unique(np.asarray(input_ids, np.int64))
+        ids = ids[ids < logits.shape[-1]]
+        vals = logits[ids]
+        logits[ids] = np.where(vals > 0, vals / self.penalty,
+                               vals * self.penalty)
+
+
+class MinTokensProcessor:
+    """Ban EOS/stop tokens until `min_tokens` have been generated (ref
+    protocol: protocols/common.rs min_tokens)."""
+
+    def __init__(self, min_tokens: int, eos_ids: Sequence[int]) -> None:
+        self.min_tokens = int(min_tokens)
+        self.eos_ids = [int(e) for e in eos_ids]
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        if len(input_ids) < self.min_tokens:
+            for e in self.eos_ids:
+                if e < logits.shape[-1]:
+                    logits[e] = -np.inf
+
+
+class MinPProcessor:
+    """vLLM-style min_p: mask tokens whose post-temperature probability
+    is below min_p * max_prob (ref protocol: common.rs min_p)."""
+
+    def __init__(self, min_p: float, temperature: float = 1.0) -> None:
+        if not 0.0 < min_p <= 1.0:
+            raise ValueError("min_p must be in (0, 1]")
+        self.min_p = float(min_p)
+        self.temperature = max(float(temperature), 1e-6)
+
+    def __call__(self, input_ids: Sequence[int],
+                 logits: np.ndarray) -> None:
+        scaled = logits.astype(np.float64) / self.temperature
+        scaled -= scaled.max()
+        probs = np.exp(scaled)
+        probs /= probs.sum()
+        logits[probs < self.min_p * probs.max()] = -np.inf
+
+
 def _guided_factory(tokenizer=None, **kwargs):
     from .guided import make_guided_processor
 

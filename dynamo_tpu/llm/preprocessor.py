@@ -187,6 +187,9 @@ class OpenAIPreprocessor:
             seed=request.get("seed"),
             frequency_penalty=float(request.get("frequency_penalty", 0.0) or 0.0),
             presence_penalty=float(request.get("presence_penalty", 0.0) or 0.0),
+            repetition_penalty=float(
+                request.get("repetition_penalty", 1.0) or 1.0),
+            min_p=float(request.get("min_p", 0.0) or 0.0),
             logprobs=bool(request.get("logprobs", False)),
             top_logprobs=int(request.get("top_logprobs", 0) or 0),
             logit_bias=validate_logit_bias(request.get("logit_bias")),
@@ -215,6 +218,7 @@ class OpenAIPreprocessor:
                 stop_token_ids=[],
                 stop_strings=stop_strings,
                 ignore_eos=bool(request.get("ignore_eos", False)),
+                min_tokens=int(request.get("min_tokens", 0) or 0),
             ),
             eos_token_ids=list(self.tokenizer.eos_token_ids),
             model=request.get("model", self.card.name),

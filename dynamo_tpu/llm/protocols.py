@@ -30,6 +30,11 @@ class SamplingOptions:
     # OpenAI logit_bias: {token_id: additive bias}; applied via the
     # host logits-processor path (llm/logits_processing.py)
     logit_bias: Optional[dict] = None
+    # HF-semantics multiplicative repetition penalty (1.0 = off) and
+    # vLLM-style min_p nucleus floor (0.0 = off); both enforced via the
+    # host logits-processor path (ref: protocols/common.rs:305,323)
+    repetition_penalty: float = 1.0
+    min_p: float = 0.0
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
@@ -45,6 +50,9 @@ class StopConditions:
     stop_token_ids: list[int] = dataclasses.field(default_factory=list)
     stop_strings: list[str] = dataclasses.field(default_factory=list)
     ignore_eos: bool = False
+    # suppress EOS until this many tokens are generated (ref:
+    # protocols/common.rs:246 — "to ignore_eos, set min_tokens")
+    min_tokens: int = 0
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)

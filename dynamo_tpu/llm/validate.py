@@ -21,7 +21,8 @@ from .preprocessor import RequestError
 _COMMON_FIELDS = {
     "model", "stream", "stream_options", "max_tokens",
     "max_completion_tokens", "temperature", "top_p", "top_k", "seed",
-    "frequency_penalty", "presence_penalty", "logprobs", "top_logprobs",
+    "frequency_penalty", "presence_penalty", "repetition_penalty",
+    "min_p", "min_tokens", "logprobs", "top_logprobs",
     "stop", "ignore_eos", "n", "user", "logit_bias", "metadata", "nvext",
 }
 CHAT_FIELDS = _COMMON_FIELDS | {
@@ -101,6 +102,13 @@ def validate_request(body: dict, kind: str) -> None:
     _check_range(body, "top_p", 0.0, 1.0)
     _check_range(body, "frequency_penalty", -2.0, 2.0)
     _check_range(body, "presence_penalty", -2.0, 2.0)
+    _check_range(body, "repetition_penalty", 0.01, 10.0)
+    _check_range(body, "min_p", 0.0, 1.0)
+    mt = body.get("min_tokens")
+    if mt is not None:
+        if not isinstance(mt, int) or mt < 0:
+            raise RequestError("'min_tokens' must be a non-negative "
+                               "integer")
 
     n = body.get("n")
     if n is not None and n != 1:

@@ -282,6 +282,12 @@ class TestRequestStrictness:
               "logit_bias": {"-1": 5}}, "not a valid token id"),
             ({"model": "m", "messages": [], "top_k": -1},
              "'top_k' must be >= 0"),
+            ({"model": "m", "messages": [], "repetition_penalty": 0.0},
+             "'repetition_penalty' must be between"),
+            ({"model": "m", "messages": [], "min_p": 1.5},
+             "'min_p' must be between"),
+            ({"model": "m", "messages": [], "min_tokens": -1},
+             "'min_tokens' must be a non-negative"),
             ({"model": "m", "messages": [], "stop": [1, 2]},
              "'stop' must be a string"),
             ({"model": "m", "messages": [],

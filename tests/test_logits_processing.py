@@ -87,6 +87,44 @@ class TestProcessorPrimitives:
         assert row[3] == pytest.approx(-(0.5 * 1 + 1.0))
         assert row[0] == 0.0
 
+    def test_repetition_penalty_hf_semantics(self):
+        from dynamo_tpu.llm.logits_processing import (
+            RepetitionPenaltyProcessor,
+        )
+
+        row = np.array([2.0, -2.0, 1.0, 0.5], np.float32)
+        RepetitionPenaltyProcessor(2.0)([0, 1], row)
+        assert row[0] == pytest.approx(1.0)   # positive: divided
+        assert row[1] == pytest.approx(-4.0)  # negative: multiplied
+        assert row[2] == 1.0 and row[3] == 0.5  # unseen untouched
+        with pytest.raises(ValueError):
+            RepetitionPenaltyProcessor(0.0)
+
+    def test_min_tokens_bans_eos_until_budget(self):
+        from dynamo_tpu.llm.logits_processing import MinTokensProcessor
+
+        proc = MinTokensProcessor(2, [7])
+        row = np.zeros(8, np.float32)
+        proc([], row)
+        assert np.isneginf(row[7])
+        row = np.zeros(8, np.float32)
+        proc([1], row)
+        assert np.isneginf(row[7])
+        row = np.zeros(8, np.float32)
+        proc([1, 2], row)
+        assert row[7] == 0.0  # budget met: EOS legal again
+
+    def test_min_p_masks_low_probability_tail(self):
+        from dynamo_tpu.llm.logits_processing import MinPProcessor
+
+        row = np.array([5.0, 4.9, 0.0, -3.0], np.float32)
+        MinPProcessor(0.5)([], row)
+        # 0.5 * max_prob keeps the two near-max entries, masks the tail
+        assert not np.isneginf(row[0]) and not np.isneginf(row[1])
+        assert np.isneginf(row[2]) and np.isneginf(row[3])
+        with pytest.raises(ValueError):
+            MinPProcessor(0.0)
+
     def test_forced_response_walks_sequence(self):
         proc = ForcedResponseProcessor([4, 9], eos_id=1)
         for want in (4, 9, 1, 1):
