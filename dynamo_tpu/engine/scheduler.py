@@ -373,10 +373,25 @@ class InferenceScheduler:
             # HF semantics penalize prompt AND generated tokens
             procs.append(RepetitionPenaltyProcessor(
                 s.repetition_penalty, prompt_ids=request.token_ids))
+        if request.stop.min_tokens:
+            procs.append(MinTokensProcessor(
+                request.stop.min_tokens,
+                list(request.eos_token_ids)
+                + list(request.stop.stop_token_ids)))
         if request.logits_processors:
             procs.extend(resolve_processors(
                 request.logits_processors,
                 tokenizer=getattr(self, "logits_tokenizer", None)))
+        if s.min_p and s.temperature > 0:
+            # temperature 0 is argmax — min_p can never change it, and
+            # building the processor would force the per-step host
+            # readback path for nothing. LAST: the min_p floor is
+            # relative to the max probability of the distribution
+            # actually sampled from — after guided/user processors have
+            # masked it. Ordered before them it would prune against the
+            # unconstrained distribution and could mask every
+            # grammar-legal token (all -inf row).
+            procs.append(MinPProcessor(s.min_p, s.temperature))
         return procs or None
 
     def _admit(self) -> int:
@@ -540,7 +555,8 @@ class InferenceScheduler:
         if ring:
             tokens = 0
             result = self.runner.prefill_ring_batch(
-                [np.asarray(s.request.token_ids[: s.prompt_len], np.int32)
+                [np.asarray(s.request.token_ids[: s.prompt_len],  # dynalint: disable=DL201 -- host token list to int32, no device transfer
+                            np.int32)
                  for s in ring],
                 np.stack([s.block_table for s in ring]),
                 [(s.request.sampling.temperature, s.request.sampling.top_p,
@@ -564,7 +580,7 @@ class InferenceScheduler:
             if seq is None or seq.cancelled or seq.decode_ready:
                 continue
             chunk = min(budget, seq.prompt_len - seq.prefill_pos)
-            tokens = np.asarray(
+            tokens = np.asarray(  # dynalint: disable=DL201 -- host token list to int32, no device transfer
                 seq.request.token_ids[seq.prefill_pos : seq.prefill_pos + chunk],
                 np.int32,
             )
@@ -770,7 +786,7 @@ class InferenceScheduler:
         # _reap_finished's page release — consumers reacting to the
         # finish (KVBM flush, disagg transfer) would race a release that
         # hasn't happened yet.
-        blocks_np = [np.asarray(t) for t in device_blocks]
+        blocks_np = [np.asarray(t) for t in device_blocks]  # dynalint: disable=DL201 -- deliberate barrier: all blocks must land before any token emits (see comment above)
         count = 0
         for toks_k in blocks_np:
             for step in range(block):
@@ -921,6 +937,21 @@ class InferenceScheduler:
         ))
         if finish is not None:
             seq.finished = True
+        elif seq.processors:
+            self._maybe_retire_processors(seq)
+
+    def _maybe_retire_processors(self, seq: _Seq) -> None:
+        """min_tokens is the only processor that EXPIRES: once the budget
+        is met it is a no-op for the rest of the stream, so a sequence
+        whose processors are all exhausted MinTokens drops them and
+        rejoins the fused device-sampled decode path instead of paying a
+        per-step logits readback for its whole life."""
+        from ..llm.logits_processing import MinTokensProcessor
+
+        if all(isinstance(p, MinTokensProcessor)
+               and len(seq.generated) >= p.min_tokens
+               for p in seq.processors):
+            seq.processors = None
 
     def abort_all(self, reason: str) -> int:
         """Finish every waiting + in-flight sequence with finish_reason

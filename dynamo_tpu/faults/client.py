@@ -12,7 +12,7 @@ class FaultClient:
         self.base = base_url.rstrip("/")
         self._session = session
 
-    async def _sess(self):
+    def _sess(self):
         if self._session is None:
             import aiohttp
 
@@ -24,7 +24,7 @@ class FaultClient:
             await self._session.close()
 
     async def _post(self, path: str, body: dict) -> dict:
-        sess = await self._sess()
+        sess = self._sess()
         async with sess.post(self.base + path, json=body) as resp:
             data = await resp.json()
             if resp.status >= 400:
@@ -51,6 +51,6 @@ class FaultClient:
                                 {"name": name, **params})
 
     async def faults(self) -> list[dict]:
-        sess = await self._sess()
+        sess = self._sess()
         async with sess.get(self.base + "/v1/faults") as resp:
             return (await resp.json())["faults"]

@@ -168,21 +168,28 @@ class BanTokensProcessor:
 
 
 class RepetitionPenaltyProcessor:
-    """HF-semantics multiplicative repetition penalty over every token
-    generated so far: positive logits divide by the penalty, negative
-    multiply (ref protocol: protocols/common.rs repetition_penalty)."""
+    """HF-semantics multiplicative repetition penalty over the prompt AND
+    every token generated so far: positive logits divide by the penalty,
+    negative multiply (ref protocol: protocols/common.rs
+    repetition_penalty; HF penalizes prompt ∪ generated)."""
 
-    def __init__(self, penalty: float) -> None:
+    def __init__(self, penalty: float,
+                 prompt_ids: Optional[Sequence[int]] = None) -> None:
         if penalty <= 0:
             raise ValueError("repetition_penalty must be positive")
         self.penalty = float(penalty)
+        self._prompt_ids = np.unique(np.asarray(
+            list(prompt_ids) if prompt_ids is not None else [], np.int64))
 
     def __call__(self, input_ids: Sequence[int],
                  logits: np.ndarray) -> None:
-        if not len(input_ids) or self.penalty == 1.0:
+        if self.penalty == 1.0:
             return
-        ids = np.unique(np.asarray(input_ids, np.int64))
+        generated = np.asarray(list(input_ids), np.int64)
+        ids = np.union1d(self._prompt_ids, generated)
         ids = ids[ids < logits.shape[-1]]
+        if not len(ids):
+            return
         vals = logits[ids]
         logits[ids] = np.where(vals > 0, vals / self.penalty,
                                vals * self.penalty)

@@ -362,7 +362,7 @@ class MockerEngine:
             evicted_total: list[int] = []
             self._admit(evicted_total.extend)
             prefill_tokens = self._prefill_step()
-            decoded, deliveries = await self._decode_step()
+            decoded, deliveries = self._decode_step()
             try:
                 if evicted_total:
                     await self._publish_removed(evicted_total)
@@ -477,7 +477,7 @@ class MockerEngine:
             total += chunk
         return total
 
-    async def _decode_step(self) -> tuple[int, list]:
+    def _decode_step(self) -> tuple[int, list]:
         """Generate one token for each fully-prefilled sequence.
 
         Outputs are COLLECTED, not delivered: a step's tokens exist only

@@ -19,6 +19,7 @@ TPU build equivalents:
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import json
 import shutil
@@ -111,13 +112,35 @@ class KubernetesConnector(Connector):
             patch = json.dumps(
                 {"spec": {"services": {t.component: {
                     "replicas": t.desired_replicas}}}})
-            cmd = ["kubectl", "-n", self.namespace, "patch", self.resource,
-                   self.deployment, "--type", "merge", "-p", patch]
-            try:
-                proc = subprocess.run(cmd, capture_output=True, text=True,
-                                      timeout=30)
-            except (subprocess.TimeoutExpired, OSError) as exc:
-                log.error("kubectl patch failed: %r", exc)
-                continue
-            if proc.returncode != 0:
+            proc = await self._kubectl(
+                ["patch", self.resource, self.deployment,
+                 "--type", "merge", "-p", patch])
+            if proc is not None and proc.returncode != 0:
                 log.error("kubectl patch failed: %s", proc.stderr.strip())
+
+    async def observed_replicas(self, component: str) -> Optional[int]:
+        # Read STATUS (what the operator reconciled), not spec — spec
+        # would just echo our own last patch back as "observed".
+        proc = await self._kubectl(
+            ["get", self.resource, self.deployment, "-o",
+             f"jsonpath={{.status.services.{component}.readyReplicas}}"])
+        if proc is None or proc.returncode != 0 or not proc.stdout.strip():
+            return None
+        try:
+            return int(proc.stdout.strip())
+        except ValueError:
+            return None
+
+    async def _kubectl(self, args: list[str]):
+        """Run one kubectl invocation off the event loop (the planner
+        shares a loop with serving; kubectl blocks up to its timeout).
+        Returns the CompletedProcess, or None on timeout/launch failure
+        (already logged)."""
+        cmd = ["kubectl", "-n", self.namespace] + args
+        try:
+            return await asyncio.to_thread(
+                subprocess.run, cmd, capture_output=True, text=True,
+                timeout=30)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            log.error("kubectl %s failed: %r", args[0], exc)
+            return None

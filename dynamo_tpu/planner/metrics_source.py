@@ -122,14 +122,14 @@ class FrontendScraper:
                 return math.nan
             return ds / dc * scale
 
-        num_req = delta("dynt_requests_total", {"status": "ok"})
+        num_req = delta("dynamo_requests_total", {"status": "ok"})
         return TrafficStats(
             num_req=num_req,
-            ttft_ms=avg("dynt_time_to_first_token_seconds", model, 1e3),
-            itl_ms=avg("dynt_inter_token_latency_seconds", model, 1e3),
-            isl=avg("dynt_input_sequence_tokens", model),
-            osl=avg("dynt_output_sequence_tokens", model),
-            request_duration_s=avg("dynt_request_duration_seconds", {}),
+            ttft_ms=avg("dynamo_time_to_first_token_seconds", model, 1e3),
+            itl_ms=avg("dynamo_inter_token_latency_seconds", model, 1e3),
+            isl=avg("dynamo_input_sequence_tokens", model),
+            osl=avg("dynamo_output_sequence_tokens", model),
+            request_duration_s=avg("dynamo_request_duration_seconds", {}),
         )
 
 

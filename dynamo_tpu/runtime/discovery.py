@@ -177,9 +177,9 @@ class MemDiscovery(Discovery):
                 if deadline < now
             ]
             for lid in expired:
-                await self._expire(lid)
+                self._expire(lid)
 
-    async def _expire(self, lease_id: str) -> None:
+    def _expire(self, lease_id: str) -> None:
         keys = self._store.lease_keys.pop(lease_id, set())
         self._store.lease_deadline.pop(lease_id, None)
         self._store.lease_ttl.pop(lease_id, None)
@@ -202,7 +202,7 @@ class MemDiscovery(Discovery):
         self._store.lease_deadline[lease.lease_id] = time.monotonic() + lease.ttl
 
     async def revoke_lease(self, lease: Lease) -> None:
-        await self._expire(lease.lease_id)
+        self._expire(lease.lease_id)
 
     async def put(self, key: str, value: dict, lease: Optional[Lease] = None) -> None:
         self._store.data[key] = value

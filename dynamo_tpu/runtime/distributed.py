@@ -170,7 +170,7 @@ class DistributedRuntime:
 
     async def event_subscriber(self, namespace: str, topic_prefix: str = "") -> EventSubscriber:
         if self.config.event_plane == "mem":
-            return await MemEventPlane(cluster=namespace).subscribe(topic_prefix)
+            return MemEventPlane(cluster=namespace).subscribe(topic_prefix)
         if self.config.event_plane == "journal":
             from .events import JournalEventSubscriberManager
 

@@ -84,7 +84,7 @@ class MemEventPlane:
     def publisher(self) -> "MemEventPublisher":
         return MemEventPublisher(self._bus)
 
-    async def subscribe(self, topic_prefix: str) -> EventSubscriber:
+    def subscribe(self, topic_prefix: str) -> EventSubscriber:
         sub = EventSubscriber()
         self._bus.subscribers.append(
             (topic_prefix, sub, asyncio.get_running_loop())

@@ -10,7 +10,6 @@ single process registry so every subsystem lands on one scrape page.
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 from prometheus_client import (
     CollectorRegistry,
@@ -27,25 +26,25 @@ _HIER = ["namespace", "component", "endpoint"]
 
 # Canonical metric families (ref: metrics/prometheus_names.rs naming scheme)
 REQUESTS_TOTAL = Counter(
-    "dynt_requests_total", "Requests handled", _HIER + ["status"], registry=REGISTRY
+    "dynamo_requests_total", "Requests handled", _HIER + ["status"], registry=REGISTRY
 )
 REQUEST_DURATION = Histogram(
-    "dynt_request_duration_seconds", "End-to-end request duration", _HIER,
+    "dynamo_request_duration_seconds", "End-to-end request duration", _HIER,
     registry=REGISTRY,
     buckets=(0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0),
 )
 INFLIGHT = Gauge(
-    "dynt_inflight_requests", "In-flight requests", _HIER, registry=REGISTRY
+    "dynamo_inflight_requests", "In-flight requests", _HIER, registry=REGISTRY
 )
 # Frontend service metrics that feed the Planner (ref: http/service/metrics.rs
 # TTFT/ITL histograms)
 TTFT_SECONDS = Histogram(
-    "dynt_time_to_first_token_seconds", "Time to first token", ["model"],
+    "dynamo_time_to_first_token_seconds", "Time to first token", ["model"],
     registry=REGISTRY,
     buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8),
 )
 ITL_SECONDS = Histogram(
-    "dynt_inter_token_latency_seconds", "Inter-token latency", ["model"],
+    "dynamo_inter_token_latency_seconds", "Inter-token latency", ["model"],
     registry=REGISTRY,
     buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64),
 )
@@ -53,24 +52,24 @@ ITL_SECONDS = Histogram(
 # pipeline/network/egress/push_router.rs:21 — which stage is eating the
 # request budget)
 STAGE_DURATION = Histogram(
-    "dynt_stage_duration_seconds", "Pipeline stage duration",
+    "dynamo_stage_duration_seconds", "Pipeline stage duration",
     ["stage", "model"], registry=REGISTRY,
     buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0),
 )
 INPUT_TOKENS = Histogram(
-    "dynt_input_sequence_tokens", "Input sequence length", ["model"],
+    "dynamo_input_sequence_tokens", "Input sequence length", ["model"],
     registry=REGISTRY, buckets=(32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768),
 )
 OUTPUT_TOKENS = Histogram(
-    "dynt_output_sequence_tokens", "Output sequence length", ["model"],
+    "dynamo_output_sequence_tokens", "Output sequence length", ["model"],
     registry=REGISTRY, buckets=(1, 16, 64, 128, 256, 512, 1024, 2048, 4096),
 )
 KV_USAGE = Gauge(
-    "dynt_kv_usage_ratio", "Paged-KV pool usage fraction", ["worker"],
+    "dynamo_kv_usage_ratio", "Paged-KV pool usage fraction", ["worker"],
     registry=REGISTRY,
 )
 ROUTER_DECISIONS = Counter(
-    "dynt_router_decisions_total", "Routing decisions", ["mode"], registry=REGISTRY
+    "dynamo_router_decisions_total", "Routing decisions", ["mode"], registry=REGISTRY
 )
 
 

@@ -6,7 +6,6 @@ metrics on an HTTP port (ref: lib/runtime/src/system_status_server.rs:131-178).
 
 from __future__ import annotations
 
-import asyncio
 from typing import Callable, Optional
 
 from aiohttp import web

@@ -1,0 +1,37 @@
+"""DL103 negative: every exemption class in one file."""
+import abc
+import asyncio
+
+
+async def really_awaits():
+    await asyncio.sleep(0)
+
+
+async def generator_interface():  # async gens are structurally async
+    yield 1
+
+
+async def handler(request):  # HTTP/RPC handler convention
+    return {"ok": True}
+
+
+async def handler_underscore(_request):
+    return {"ok": True}
+
+
+class Iface(abc.ABC):
+    @abc.abstractmethod
+    async def work(self): ...
+
+    async def default_impl(self):
+        return None  # trivial default of an async interface
+
+
+class MemImpl:
+    async def fetch(self):  # duck-sibling: NetImpl.fetch awaits
+        return 42
+
+
+class NetImpl:
+    async def fetch(self):
+        return await asyncio.sleep(0, result=42)

@@ -1,0 +1,8 @@
+"""DL103 positive: vestigial async (no sibling of the name awaits)."""
+
+
+async def crunch_numbers():  # line 4
+    total = 0
+    for i in range(1000):
+        total += i
+    return total

@@ -1,0 +1,18 @@
+"""DL102 negative: async-safe equivalents, and sync contexts."""
+import asyncio
+import subprocess
+import time
+
+
+async def polite():
+    await asyncio.sleep(0.5)
+    await asyncio.to_thread(subprocess.run, ["true"])
+
+    def helper():  # nested sync def runs off-loop (executor/thread)
+        time.sleep(0.5)
+
+    await asyncio.to_thread(helper)
+
+
+def plain_sync():
+    time.sleep(0.5)  # not on the event loop

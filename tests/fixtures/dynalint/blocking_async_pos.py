@@ -1,0 +1,11 @@
+"""DL102 positive: blocking calls on the event loop."""
+import subprocess
+import time
+
+import requests
+
+
+async def stalls_everyone():
+    time.sleep(0.5)  # line 9
+    subprocess.run(["true"])  # line 10
+    requests.get("http://localhost")  # line 11

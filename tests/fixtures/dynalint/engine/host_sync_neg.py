@@ -1,0 +1,19 @@
+"""DL201 negative: syncs outside loops, and loop-adjacent non-syncs."""
+import numpy as np
+
+
+def batched_readback(device_tokens):
+    stacked = np.asarray(device_tokens)  # one transfer, outside any loop
+    out = []
+    for row in np.asarray(device_tokens):  # iterable evaluates once
+        out.append(int(row))
+    total = sum(t for t in stacked)  # loop without sync calls
+    return out, total
+
+
+def loop_defines_callback(device_tokens):
+    fns = []
+    for tok in device_tokens:
+        # defining a closure in a loop is not a per-iteration sync
+        fns.append(lambda t=tok: np.asarray(t))
+    return fns

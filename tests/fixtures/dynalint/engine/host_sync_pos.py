@@ -1,0 +1,18 @@
+"""DL201 positive: per-iteration host-device syncs (path contains
+'engine' so the hot-path rule applies)."""
+import numpy as np
+
+import jax
+
+
+def per_step_readback(device_tokens, chunks):
+    out = []
+    for tok in device_tokens:
+        out.append(np.asarray(tok))  # line 11: sync per iteration
+    i = 0
+    while i < len(device_tokens):
+        device_tokens[i].block_until_ready()  # line 14
+        i += 1
+    scalars = [t.item() for t in device_tokens]  # line 16: comp elt
+    hosts = [jax.device_get(c) for c in chunks]  # line 17: comp elt
+    return out, scalars, hosts

@@ -1,0 +1,28 @@
+"""DL101 negative: every spawn is retained or observed."""
+import asyncio
+
+
+class Owner:
+    def __init__(self):
+        self._tasks = []
+
+    async def retained_on_self(self):
+        self._tasks.append(asyncio.create_task(asyncio.sleep(1)))
+
+    async def awaited(self):
+        await asyncio.create_task(asyncio.sleep(1))
+
+    async def observed(self):
+        task = asyncio.create_task(asyncio.sleep(1))
+        task.add_done_callback(lambda t: t.exception())
+
+    async def returned(self):
+        task = asyncio.create_task(asyncio.sleep(1))
+        return task
+
+    async def loop_wraparound(self):
+        task = None
+        while True:
+            if task is not None:
+                await task  # previous iteration's task consumed here
+            task = asyncio.create_task(asyncio.sleep(1))

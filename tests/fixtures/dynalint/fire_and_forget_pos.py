@@ -1,0 +1,21 @@
+"""DL101 positive: the deliberately reintroduced fire-and-forget task."""
+import asyncio
+
+
+async def discard_expression():
+    asyncio.create_task(asyncio.sleep(1))  # line 6: bare discard
+
+
+async def discard_ensure_future():
+    asyncio.ensure_future(asyncio.sleep(1))  # line 10: bare discard
+
+
+async def assigned_never_read():
+    task = asyncio.create_task(asyncio.sleep(1))  # line 14: dead binding
+    del task  # a Del is not a Load; the task is still unobserved
+
+
+async def rebound_after_use():
+    task = asyncio.create_task(asyncio.sleep(1))
+    await task
+    task = asyncio.create_task(asyncio.sleep(1))  # line 21: leaked rebind

@@ -1,0 +1,25 @@
+"""DL202 negative: statics declared, arrays passed, or no jit at all."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "flag"))
+def statics_by_name(x, k: int, flag: bool):
+    return x * k if flag else x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def statics_by_num(x, k: int):
+    return x * k
+
+
+@jax.jit
+def arrays_only(x: jnp.ndarray, scale: np.ndarray):
+    return x * scale
+
+
+def plain(x, k: int):  # not jitted
+    return x * k

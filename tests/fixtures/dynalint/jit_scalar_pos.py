@@ -1,0 +1,23 @@
+"""DL202 positive: Python scalars in jit signatures, not declared
+static."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_bare(x, k: int):  # k at line 10
+    return x * k
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def decorated_partial(x, flag: bool, depth: int):  # depth at line 15
+    return x if flag else x * depth
+
+
+def call_form():
+    def step(kv, temp: float):  # temp at line 20
+        return kv * temp
+
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,9 @@
+"""DL303 negative: convention-conforming names, and non-metric
+Counters."""
+import collections
+
+from prometheus_client import Counter, Histogram
+
+REQS = Counter("dynamo_requests_total", "Requests handled")
+LAT = Histogram("dynamo_latency_seconds", "Latency")
+WORDS = collections.Counter("abracadabra")  # one arg: not a metric ctor

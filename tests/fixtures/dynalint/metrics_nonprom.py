@@ -1,0 +1,5 @@
+"""DL303 negative: no prometheus_client import — Counter here is
+someone else's Counter, whatever its arguments look like."""
+from mylib import Counter  # noqa
+
+REQS = Counter("requests_total", "not a prometheus metric")

@@ -1,0 +1,5 @@
+"""DL303 positive: unprefixed Prometheus metric names."""
+from prometheus_client import Counter, Gauge
+
+REQS = Counter("requests_total", "Requests handled")  # line 4
+DEPTH = Gauge("dynt_queue_depth", "Queue depth")  # line 5: legacy prefix

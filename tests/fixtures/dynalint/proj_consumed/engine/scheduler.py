@@ -1,0 +1,8 @@
+"""Mini consumer that DOES read min_p — the wired state."""
+
+
+def build(sampling):
+    procs = []
+    if sampling.min_p:
+        procs.append(("min_p", sampling.min_p, sampling.temperature))
+    return {"budget": sampling.max_tokens, "procs": procs}

@@ -1,0 +1,9 @@
+"""Mini accept layer: fields the API admits."""
+
+_COMMON_FIELDS = {"model", "max_tokens", "temperature", "min_p"}
+
+
+def validate_request(body: dict) -> None:
+    unknown = sorted(k for k in body if k not in _COMMON_FIELDS)
+    if unknown:
+        raise ValueError(f"Unsupported parameter: {unknown}")

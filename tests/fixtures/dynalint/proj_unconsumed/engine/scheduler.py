@@ -1,0 +1,8 @@
+"""Mini consumer: reads temperature and max_tokens, never min_p."""
+
+
+def build(sampling):
+    return {
+        "temp": sampling.temperature,
+        "budget": sampling.max_tokens,
+    }

@@ -1,0 +1,14 @@
+"""Mini carrier layer: the parsed request shape."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class SamplingOptions:
+    max_tokens: int = 256
+    temperature: float = 1.0
+    min_p: float = 0.0  # line 9: accepted, parsed, never consumed
+
+
+@dataclasses.dataclass
+class StopConditions:
+    ignore_eos: bool = False

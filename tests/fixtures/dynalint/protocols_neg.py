@@ -1,0 +1,29 @@
+"""DL301 negative: msgpack-native fields, local nested wire types, and
+non-wire dataclasses (no to_wire/from_wire) with exotic fields."""
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class Inner:
+    block_hashes: list[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class WireEvent:
+    worker_id: int
+    payload: Optional[dict] = None
+    inner: Optional[Inner] = None  # local type, flattened in to_wire
+    scores: dict[str, float] = dataclasses.field(default_factory=dict)
+    blob: bytes = b""
+    anything: Any = None
+
+    def to_wire(self) -> dict:
+        out = dataclasses.asdict(self)
+        out.pop("inner", None)
+        return out
+
+
+@dataclasses.dataclass
+class HostOnly:  # never crosses the wire: exotic fields are fine
+    span: tuple[int, int] = (0, 0)

@@ -1,0 +1,15 @@
+"""DL301 positive: wire dataclasses with fields msgpack can't
+round-trip (filename contains 'protocols' so the rule applies)."""
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TransferRequest:
+    request_id: str
+    span: tuple[int, int]  # line 10: decodes as a list
+    tags: set[str]  # line 11: fails to pack
+    payload: Optional[bytes] = None
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)

@@ -1,0 +1,20 @@
+"""Suppression semantics: a justified disable silences exactly that
+rule on that line; unknown rule names are themselves findings."""
+import asyncio
+import time
+
+
+async def justified():
+    asyncio.create_task(asyncio.sleep(1))  # dynalint: disable=DL101 -- fixture: exercising suppression
+
+
+async def wrong_rule_still_fires():
+    asyncio.create_task(asyncio.sleep(1))  # dynalint: disable=DL102
+
+
+async def by_name():
+    asyncio.create_task(asyncio.sleep(1))  # dynalint: disable=fire-and-forget-task
+
+
+async def typo():
+    time.sleep(1)  # dynalint: disable=DL999

@@ -1,0 +1,244 @@
+"""dynalint golden tests: every rule exercised by a positive and a
+negative fixture, suppression semantics, CLI output/exit codes, and the
+gate that the real tree stays clean (the CI contract)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+from tools.dynalint import all_rules, run
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynalint"
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def lint(*names):
+    findings, _ = run([str(FIXTURES / n) for n in names])
+    return findings
+
+
+def hits(findings, rule):
+    return [(f.path.rsplit("/", 1)[-1], f.line) for f in findings
+            if f.rule == rule]
+
+
+class TestRuleCatalogue:
+    def test_at_least_eight_rules(self):
+        assert len(all_rules()) >= 8
+
+    def test_ids_and_names_unique(self):
+        rules = all_rules()
+        assert len({r.id for r in rules}) == len(rules)
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.description for r in rules)
+
+
+class TestFireAndForget:
+    def test_positive(self):
+        findings = lint("fire_and_forget_pos.py")
+        assert hits(findings, "DL101") == [
+            ("fire_and_forget_pos.py", 6),
+            ("fire_and_forget_pos.py", 10),
+            ("fire_and_forget_pos.py", 14),
+            ("fire_and_forget_pos.py", 21),
+        ]
+
+    def test_negative(self):
+        assert hits(lint("fire_and_forget_neg.py"), "DL101") == []
+
+    def test_reintroduction_is_caught(self, tmp_path):
+        """Acceptance probe: a scratch fire-and-forget create_task is
+        flagged."""
+        scratch = tmp_path / "scratch.py"
+        scratch.write_text(
+            "import asyncio\n\n\n"
+            "async def oops():\n"
+            "    asyncio.create_task(asyncio.sleep(1))\n")
+        findings, _ = run([str(scratch)])
+        assert ("DL101", 5) in [(f.rule, f.line) for f in findings]
+
+    def test_hidden_ancestor_does_not_hide_the_tree(self, tmp_path):
+        """A checkout under a dot-directory must still be linted — only
+        hidden dirs BELOW the lint root are skipped."""
+        root = tmp_path / ".work" / "repo"
+        root.mkdir(parents=True)
+        (root / "mod.py").write_text(
+            "import asyncio\n\n\n"
+            "async def oops():\n"
+            "    asyncio.create_task(asyncio.sleep(1))\n")
+        (root / ".hidden").mkdir()
+        (root / ".hidden" / "skipme.py").write_text("import asyncio\n")
+        findings, files_checked = run([str(root)])
+        assert files_checked == 1
+        assert ("DL101", 5) in [(f.rule, f.line) for f in findings]
+
+
+class TestBlockingInAsync:
+    def test_positive(self):
+        findings = lint("blocking_async_pos.py")
+        assert hits(findings, "DL102") == [
+            ("blocking_async_pos.py", 9),
+            ("blocking_async_pos.py", 10),
+            ("blocking_async_pos.py", 11),
+        ]
+
+    def test_negative(self):
+        assert hits(lint("blocking_async_neg.py"), "DL102") == []
+
+
+class TestAsyncWithoutAwait:
+    def test_positive(self):
+        findings = lint("async_no_await_pos.py")
+        assert hits(findings, "DL103") == [("async_no_await_pos.py", 4)]
+
+    def test_negative_exemptions(self):
+        assert hits(lint("async_no_await_neg.py"), "DL103") == []
+
+    def test_duck_sibling_crosses_files(self):
+        """An awaitless method is exempt when ANOTHER file implements the
+        same name with a real await (interface conformity)."""
+        solo = lint("async_no_await_pos.py")
+        assert hits(solo, "DL103") != []
+        paired_src = FIXTURES / "async_no_await_neg.py"
+        both, _ = run([str(FIXTURES / "async_no_await_pos.py"),
+                       str(paired_src)])
+        assert hits(both, "DL103") != []  # no sibling named crunch_numbers
+
+
+class TestHostSyncInLoop:
+    def test_positive(self):
+        findings = lint("engine/host_sync_pos.py")
+        assert hits(findings, "DL201") == [
+            ("host_sync_pos.py", 11),
+            ("host_sync_pos.py", 14),
+            ("host_sync_pos.py", 16),
+            ("host_sync_pos.py", 17),
+        ]
+
+    def test_negative(self):
+        assert hits(lint("engine/host_sync_neg.py"), "DL201") == []
+
+    def test_scoped_to_hot_paths(self, tmp_path):
+        """The same code outside engine/kv_router paths is not flagged —
+        the rule is a hot-path rule, not a general numpy ban."""
+        cold = tmp_path / "cold.py"
+        cold.write_text(
+            (FIXTURES / "engine" / "host_sync_pos.py").read_text())
+        findings, _ = run([str(cold)])
+        assert hits(findings, "DL201") == []
+
+
+class TestJitScalarArg:
+    def test_positive(self):
+        findings = lint("jit_scalar_pos.py")
+        assert hits(findings, "DL202") == [
+            ("jit_scalar_pos.py", 10),
+            ("jit_scalar_pos.py", 15),
+            ("jit_scalar_pos.py", 20),
+        ]
+
+    def test_negative(self):
+        assert hits(lint("jit_scalar_neg.py"), "DL202") == []
+
+
+class TestUnserializableProtocolField:
+    def test_positive(self):
+        findings = lint("protocols_pos.py")
+        assert hits(findings, "DL301") == [
+            ("protocols_pos.py", 10),
+            ("protocols_pos.py", 11),
+        ]
+
+    def test_negative(self):
+        assert hits(lint("protocols_neg.py"), "DL301") == []
+
+
+class TestUnconsumedSamplingField:
+    def test_positive(self):
+        findings, _ = run([str(FIXTURES / "proj_unconsumed")])
+        assert [(f.rule, f.path.rsplit("/", 1)[-1], f.line)
+                for f in findings] == [("DL302", "protocols.py", 9)]
+
+    def test_negative(self):
+        findings, _ = run([str(FIXTURES / "proj_consumed")])
+        assert findings == []
+
+
+class TestMetricNamePrefix:
+    def test_positive(self):
+        findings = lint("metrics_pos.py")
+        assert hits(findings, "DL303") == [
+            ("metrics_pos.py", 4),
+            ("metrics_pos.py", 5),
+        ]
+        legacy = [f for f in findings if "dynt_queue_depth" in f.message]
+        assert legacy and "dynamo_queue_depth" in legacy[0].message
+
+    def test_negative(self):
+        assert hits(lint("metrics_neg.py"), "DL303") == []
+        assert hits(lint("metrics_nonprom.py"), "DL303") == []
+
+
+class TestSuppressions:
+    def test_semantics(self):
+        findings = lint("suppressions.py")
+        by_rule = {}
+        for f in findings:
+            by_rule.setdefault(f.rule, []).append(f.line)
+        # line 8: justified DL101 suppression silences it
+        assert 8 not in by_rule.get("DL101", [])
+        # line 12: suppressing the WRONG rule does not silence DL101
+        assert 12 in by_rule["DL101"]
+        # line 16: suppression by rule name works too
+        assert 16 not in by_rule.get("DL101", [])
+        # line 20: unknown rule in the suppression is itself reported,
+        # and the original finding still fires
+        assert 20 in by_rule["DL000"]
+        assert 20 in by_rule["DL102"]
+
+    def test_unknown_rule_message_names_catalogue(self):
+        findings = lint("suppressions.py")
+        bad = [f for f in findings if f.rule == "DL000"]
+        assert len(bad) == 1
+        assert "DL999" in bad[0].message and "DL101" in bad[0].message
+
+
+class TestCli:
+    def test_json_output_and_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynalint",
+             str(FIXTURES / "metrics_pos.py"), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["files_checked"] == 1
+        assert [f["rule"] for f in data["findings"]] == ["DL303", "DL303"]
+        assert {r["id"] for r in data["rules"]} >= {
+            "DL101", "DL102", "DL103", "DL201", "DL202",
+            "DL301", "DL302", "DL303"}
+
+    def test_clean_file_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynalint",
+             str(FIXTURES / "metrics_neg.py")],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynalint", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "DL101" in proc.stdout and "fire-and-forget-task" \
+            in proc.stdout
+
+
+class TestRealTreeStaysClean:
+    def test_dynamo_tpu_lints_clean(self):
+        """The CI contract: the shipped tree has zero findings (true
+        findings fixed, false positives suppressed with justification)."""
+        findings, files_checked = run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)

@@ -41,15 +41,18 @@ def runner():
 
 
 def _request(tokens, max_tokens=4, processors=None, logit_bias=None,
-             frequency_penalty=0.0, temperature=0.0, seed=0, top_k=0):
+             frequency_penalty=0.0, temperature=0.0, seed=0, top_k=0,
+             repetition_penalty=1.0, min_p=0.0, min_tokens=0, eos=None):
     return PreprocessedRequest(
         request_id=uuid.uuid4().hex,
         token_ids=list(tokens),
         sampling=SamplingOptions(
             max_tokens=max_tokens, temperature=temperature, seed=seed,
             top_k=top_k, logit_bias=logit_bias,
-            frequency_penalty=frequency_penalty),
-        stop=StopConditions(ignore_eos=True),
+            frequency_penalty=frequency_penalty,
+            repetition_penalty=repetition_penalty, min_p=min_p),
+        stop=StopConditions(ignore_eos=eos is None, min_tokens=min_tokens),
+        eos_token_ids=list(eos or []),
         logits_processors=processors or [],
     )
 
@@ -99,6 +102,23 @@ class TestProcessorPrimitives:
         assert row[2] == 1.0 and row[3] == 0.5  # unseen untouched
         with pytest.raises(ValueError):
             RepetitionPenaltyProcessor(0.0)
+
+    def test_repetition_penalty_covers_prompt_union_generated(self):
+        from dynamo_tpu.llm.logits_processing import (
+            RepetitionPenaltyProcessor,
+        )
+
+        proc = RepetitionPenaltyProcessor(2.0, prompt_ids=[0, 3])
+        row = np.array([2.0, 2.0, 2.0, -2.0], np.float32)
+        proc([1], row)  # generated so far: token 1
+        assert row[0] == pytest.approx(1.0)   # prompt token penalized
+        assert row[1] == pytest.approx(1.0)   # generated token penalized
+        assert row[2] == 2.0                  # unseen untouched
+        assert row[3] == pytest.approx(-4.0)  # prompt, negative logit
+        # Before any generation the prompt alone is penalized.
+        row = np.array([2.0, 2.0, 2.0, 2.0], np.float32)
+        proc([], row)
+        assert row[0] == pytest.approx(1.0) and row[1] == 2.0
 
     def test_min_tokens_bans_eos_until_budget(self):
         from dynamo_tpu.llm.logits_processing import MinTokensProcessor
@@ -216,6 +236,110 @@ class TestEngineIntegration:
                 # 2.0 is the OpenAI max; tiny-test logit gaps are well
                 # under it, so immediate repeats are suppressed.
                 assert all(a != b for a, b in zip(toks, toks[1:]))
+            finally:
+                sched.stop()
+
+        run(body(), timeout=180)
+
+    def test_repetition_penalty_request_serves(self, run, runner):
+        """Regression: repetition_penalty used to crash at processor-build
+        time (RepetitionPenaltyProcessor had no prompt_ids parameter), so
+        EVERY request setting the advertised API field errored."""
+        async def body():
+            sched = InferenceScheduler(runner)
+            sched.start()
+            try:
+                toks, err = await _run_one(sched, _request(
+                    range(8), max_tokens=4, repetition_penalty=1.2))
+                assert err is None
+                assert len(toks) == 4
+            finally:
+                sched.stop()
+
+        run(body(), timeout=180)
+
+    def test_min_tokens_defers_eos_e2e(self, run, runner):
+        """min_tokens must be CONSUMED, not just validated: with logit
+        bias forcing EOS as argmax every step, the stream still runs
+        min_tokens tokens before EOS is allowed through."""
+        async def body():
+            sched = InferenceScheduler(runner)
+            sched.start()
+            try:
+                eos = 5
+                short, err = await _run_one(sched, _request(
+                    range(8), max_tokens=8, eos=[eos],
+                    logit_bias={eos: 100.0}))
+                assert err is None
+                assert short == [eos]  # biased EOS stops immediately...
+                long, err = await _run_one(sched, _request(
+                    range(8), max_tokens=8, eos=[eos],
+                    logit_bias={eos: 100.0}, min_tokens=3))
+                assert err is None
+                # ...but with min_tokens=3 EOS is banned for 3 steps.
+                assert len(long) == 4 and long[-1] == eos
+                assert all(t != eos for t in long[:3])
+            finally:
+                sched.stop()
+
+        run(body(), timeout=180)
+
+    def test_min_tokens_only_request_retires_to_device_path(self, run,
+                                                            runner):
+        """A request whose ONLY processor is min_tokens drops it once the
+        budget is met (rejoining fused device decode) — the stream must
+        stay correct across the host->device handoff."""
+        async def body():
+            sched = InferenceScheduler(runner)
+            sched.start()
+            try:
+                base, err = await _run_one(sched, _request(
+                    range(8), max_tokens=1))
+                assert err is None
+                eos = base[0]  # greedy first choice = natural EOS probe
+                loop = asyncio.get_running_loop()
+                queue = asyncio.Queue()
+                handle = sched.submit(
+                    _request(range(8), max_tokens=6, eos=[eos],
+                             min_tokens=2),
+                    lambda o: loop.call_soon_threadsafe(
+                        queue.put_nowait, o))
+                toks = []
+                while True:
+                    out = await asyncio.wait_for(queue.get(), 60)
+                    toks.extend(out.token_ids)
+                    if out.finish_reason is not None:
+                        assert out.error is None
+                        break
+                # EOS banned for the first 2 steps, then the stream runs
+                # past the budget...
+                assert all(t != eos for t in toks[:2])
+                assert len(toks) >= 3
+                # ...and the exhausted MinTokens processor was actually
+                # dropped (the sequence rejoined the device path).
+                assert handle.seq is not None
+                assert handle.seq.processors is None
+            finally:
+                sched.stop()
+
+        run(body(), timeout=180)
+
+    def test_min_p_is_consumed_e2e(self, run, runner):
+        """min_p=1.0 keeps only argmax-probability tokens, so a hot
+        (temperature 5) stream must reproduce the greedy stream — fails
+        if the field is parsed but never wired into a processor."""
+        async def body():
+            sched = InferenceScheduler(runner)
+            sched.start()
+            try:
+                greedy, err = await _run_one(sched, _request(
+                    range(8), max_tokens=4, temperature=0.0))
+                assert err is None
+                hot, err = await _run_one(sched, _request(
+                    range(8), max_tokens=4, temperature=5.0, seed=123,
+                    min_p=1.0))
+                assert err is None
+                assert hot == greedy
             finally:
                 sched.stop()
 

@@ -259,42 +259,42 @@ class TestLoadBased:
 class TestMetricsParsing:
     def test_parse_prometheus_text(self):
         text = """# HELP x y
-dynt_requests_total{namespace="n",status="ok"} 42
-dynt_time_to_first_token_seconds_sum{model="m"} 1.5
-dynt_time_to_first_token_seconds_count{model="m"} 10
+dynamo_requests_total{namespace="n",status="ok"} 42
+dynamo_time_to_first_token_seconds_sum{model="m"} 1.5
+dynamo_time_to_first_token_seconds_count{model="m"} 10
 """
         snap = parse_prometheus_text(text)
-        assert snap[("dynt_requests_total",
+        assert snap[("dynamo_requests_total",
                      (("namespace", "n"), ("status", "ok")))] == 42
-        assert snap[("dynt_time_to_first_token_seconds_sum",
+        assert snap[("dynamo_time_to_first_token_seconds_sum",
                      (("model", "m"),))] == 1.5
 
     def test_scraper_deltas(self, monkeypatch):
         pages = [
             # baseline
-            'dynt_requests_total{status="ok"} 10\n'
-            'dynt_time_to_first_token_seconds_sum{model="m"} 1.0\n'
-            'dynt_time_to_first_token_seconds_count{model="m"} 10\n'
-            'dynt_inter_token_latency_seconds_sum{model="m"} 0.5\n'
-            'dynt_inter_token_latency_seconds_count{model="m"} 50\n'
-            'dynt_input_sequence_tokens_sum{model="m"} 1000\n'
-            'dynt_input_sequence_tokens_count{model="m"} 10\n'
-            'dynt_output_sequence_tokens_sum{model="m"} 500\n'
-            'dynt_output_sequence_tokens_count{model="m"} 10\n'
-            'dynt_request_duration_seconds_sum{namespace="n"} 5\n'
-            'dynt_request_duration_seconds_count{namespace="n"} 10\n',
+            'dynamo_requests_total{status="ok"} 10\n'
+            'dynamo_time_to_first_token_seconds_sum{model="m"} 1.0\n'
+            'dynamo_time_to_first_token_seconds_count{model="m"} 10\n'
+            'dynamo_inter_token_latency_seconds_sum{model="m"} 0.5\n'
+            'dynamo_inter_token_latency_seconds_count{model="m"} 50\n'
+            'dynamo_input_sequence_tokens_sum{model="m"} 1000\n'
+            'dynamo_input_sequence_tokens_count{model="m"} 10\n'
+            'dynamo_output_sequence_tokens_sum{model="m"} 500\n'
+            'dynamo_output_sequence_tokens_count{model="m"} 10\n'
+            'dynamo_request_duration_seconds_sum{namespace="n"} 5\n'
+            'dynamo_request_duration_seconds_count{namespace="n"} 10\n',
             # after one interval: +5 req, ttft avg 100ms, itl avg 10ms
-            'dynt_requests_total{status="ok"} 15\n'
-            'dynt_time_to_first_token_seconds_sum{model="m"} 1.5\n'
-            'dynt_time_to_first_token_seconds_count{model="m"} 15\n'
-            'dynt_inter_token_latency_seconds_sum{model="m"} 1.0\n'
-            'dynt_inter_token_latency_seconds_count{model="m"} 100\n'
-            'dynt_input_sequence_tokens_sum{model="m"} 2000\n'
-            'dynt_input_sequence_tokens_count{model="m"} 15\n'
-            'dynt_output_sequence_tokens_sum{model="m"} 1000\n'
-            'dynt_output_sequence_tokens_count{model="m"} 15\n'
-            'dynt_request_duration_seconds_sum{namespace="n"} 10\n'
-            'dynt_request_duration_seconds_count{namespace="n"} 15\n',
+            'dynamo_requests_total{status="ok"} 15\n'
+            'dynamo_time_to_first_token_seconds_sum{model="m"} 1.5\n'
+            'dynamo_time_to_first_token_seconds_count{model="m"} 15\n'
+            'dynamo_inter_token_latency_seconds_sum{model="m"} 1.0\n'
+            'dynamo_inter_token_latency_seconds_count{model="m"} 100\n'
+            'dynamo_input_sequence_tokens_sum{model="m"} 2000\n'
+            'dynamo_input_sequence_tokens_count{model="m"} 15\n'
+            'dynamo_output_sequence_tokens_sum{model="m"} 1000\n'
+            'dynamo_output_sequence_tokens_count{model="m"} 15\n'
+            'dynamo_request_duration_seconds_sum{namespace="n"} 10\n'
+            'dynamo_request_duration_seconds_count{namespace="n"} 15\n',
         ]
         scraper = FrontendScraper("http://unused/metrics", "m")
         it = iter(pages)

@@ -1,0 +1,222 @@
+"""dynalint framework: rule registry, suppressions, runner, output.
+
+The Rust reference gets whole hazard classes ruled out by its compiler
+(leaked tasks, blocking the runtime, unserializable protocol types).
+This is the Python reproduction's equivalent: a stdlib-``ast`` pass with
+project-specific rules over the async runtime and the JAX hot paths.
+
+Suppression syntax (on the flagged line)::
+
+    do_hazardous_thing()  # dynalint: disable=DL101 -- justification
+
+Multiple rules separate with commas; rule names are accepted in place of
+ids. A suppression naming an unknown rule is itself reported (DL000) so
+typos cannot silently disable nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # rule id, e.g. "DL101"
+    name: str  # rule slug, e.g. "fire-and-forget-task"
+    path: str  # posix path as given on the command line
+    line: int
+    col: int
+    message: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: pathlib.Path
+    rel: str  # posix relative path used for rule scoping
+    tree: ast.Module
+    lines: list[str]
+
+
+class Rule:
+    """Per-file rule. Subclasses set id/name/description and implement
+    check_file; override applies() to scope to a path subset."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, src: SourceFile, node: ast.AST, message: str) -> Finding:
+        return Finding(self.id, self.name, src.rel,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+class ProjectRule(Rule):
+    """Cross-file rule: sees every collected file at once."""
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        return ()
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    rule = cls()
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _RULES[rule.id] = rule
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def _known_tokens() -> set[str]:
+    out = {"DL000", "bad-suppression"}
+    for rule in _RULES.values():
+        out.add(rule.id)
+        out.add(rule.name)
+    return out
+
+
+_SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=([^#]*)")
+
+
+def _suppressions(lines: list[str], rel: str) -> tuple[dict, list]:
+    """Per-line suppressed rule tokens plus DL000 findings for unknown
+    rule names (a typo'd suppression must not silently disable nothing)."""
+    known = _known_tokens()
+    per_line: dict[int, set[str]] = {}
+    bad: list[Finding] = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        # Everything after ` -- ` is the human justification, not rules.
+        spec = m.group(1).split("--", 1)[0]
+        tokens = {t.strip() for t in spec.split(",") if t.strip()}
+        for tok in sorted(tokens - known):
+            bad.append(Finding(
+                "DL000", "bad-suppression", rel, i, m.start(),
+                f"suppression names unknown rule {tok!r}; known rules: "
+                + ", ".join(sorted(r.id for r in _RULES.values()))))
+        per_line[i] = tokens & known
+    return per_line, bad
+
+
+def collect_files(paths: list[str]) -> tuple[list[SourceFile], list[Finding]]:
+    files: list[SourceFile] = []
+    errors: list[Finding] = []
+    seen: set[pathlib.Path] = set()
+    for raw in paths:
+        root = pathlib.Path(raw)
+        if root.is_dir():
+            # Hidden-dir filter applies only BELOW the given root — a
+            # checkout that happens to live under a dot-directory must
+            # not silently lint zero files.
+            candidates = sorted(
+                p for p in root.rglob("*.py")
+                if not any(part.startswith(".")
+                           for part in p.relative_to(root).parts))
+        else:
+            candidates = [root]
+        for path in candidates:
+            if path in seen:
+                continue
+            seen.add(path)
+            try:
+                source = path.read_text(encoding="utf-8")
+                tree = ast.parse(source, filename=str(path))
+            except (OSError, SyntaxError, ValueError) as exc:
+                errors.append(Finding(
+                    "DL001", "unparseable-file", path.as_posix(), 1, 0,
+                    f"cannot parse: {exc}"))
+                continue
+            files.append(SourceFile(path, path.as_posix(), tree,
+                                    source.splitlines()))
+    return files, errors
+
+
+def run(paths: list[str],
+        rules: Optional[list[Rule]] = None) -> tuple[list[Finding], int]:
+    """Lint `paths`; returns (findings after suppression, files checked)."""
+    rules = all_rules() if rules is None else rules
+    files, findings = collect_files(paths)
+    suppress: dict[str, dict[int, set[str]]] = {}
+    for src in files:
+        per_line, bad = _suppressions(src.lines, src.rel)
+        suppress[src.rel] = per_line
+        findings.extend(bad)
+        for rule in rules:
+            if not isinstance(rule, ProjectRule) and rule.applies(src.rel):
+                findings.extend(rule.check_file(src))
+    for rule in rules:
+        if isinstance(rule, ProjectRule):
+            findings.extend(rule.check_project(files))
+    kept = [f for f in findings
+            if not {f.rule, f.name}
+            & suppress.get(f.path, {}).get(f.line, set())]
+    return sorted(kept, key=lambda f: (f.path, f.line, f.rule)), len(files)
+
+
+def render_text(findings: list[Finding], files_checked: int) -> str:
+    out = [f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.name}] {f.message}"
+           for f in findings]
+    out.append(f"{len(findings)} finding(s) in {files_checked} file(s) "
+               f"({len(_RULES)} rules)")
+    return "\n".join(out)
+
+
+def render_json(findings: list[Finding], files_checked: int) -> str:
+    return json.dumps({
+        "version": 1,
+        "files_checked": files_checked,
+        "rules": [{"id": r.id, "name": r.name,
+                   "description": r.description} for r in all_rules()],
+        "findings": [f.to_json() for f in findings],
+    }, indent=2)
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: 'asyncio.create_task', 'np.asarray',
+    'loop.create_task' (best effort; unresolvable pieces dropped)."""
+    parts: list[str] = []
+    cur: ast.AST = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def walk_skip_functions(body: list[ast.stmt]) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested function/class
+    scopes (their bodies execute in a different context)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

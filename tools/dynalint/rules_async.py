@@ -1,0 +1,237 @@
+"""asyncio hazard rules: leaked tasks, blocked event loops, fake-async.
+
+These are the runtime bugs Rust's ownership/Send bounds surface at
+compile time in the reference stack; in Python they fail silently under
+load (a dropped task is garbage-collected mid-flight, a blocking call
+stalls every request on the loop)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import (
+    Finding,
+    ProjectRule,
+    Rule,
+    SourceFile,
+    call_name,
+    register,
+    walk_skip_functions,
+)
+
+_SPAWN_CALLS = ("create_task", "ensure_future")
+
+
+def _is_spawn(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return name.split(".")[-1] in _SPAWN_CALLS
+
+
+def _scopes(tree: ast.Module) -> Iterable[ast.AST]:
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@register
+class FireAndForgetTask(Rule):
+    id = "DL101"
+    name = "fire-and-forget-task"
+    description = (
+        "asyncio.create_task/ensure_future whose result is discarded (or "
+        "bound to a name that is never read): the event loop holds only a "
+        "weak reference, so the task can be garbage-collected mid-flight "
+        "and its exceptions are never observed")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for scope in _scopes(src.tree):
+            body = scope.body if hasattr(scope, "body") else []
+            for node in walk_skip_functions(body):
+                if isinstance(node, ast.Expr) and _is_spawn(node.value):
+                    yield self.finding(
+                        src, node,
+                        f"result of {call_name(node.value)}() is discarded; "
+                        "retain the task (self._tasks.append / a module "
+                        "task set) and log its exception in a done "
+                        "callback")
+                elif (isinstance(node, ast.Assign)
+                      and len(node.targets) == 1
+                      and isinstance(node.targets[0], ast.Name)
+                      and _is_spawn(node.value)
+                      and not _read_after(scope, node)):
+                    yield self.finding(
+                        src, node,
+                        f"task bound to {node.targets[0].id!r} is never "
+                        "read afterwards — equivalent to a discard; retain "
+                        "it somewhere the loop can't garbage-collect and "
+                        "observe its exception")
+
+
+def _read_after(scope: ast.AST, assign: ast.Assign) -> bool:
+    """Is the bound name read AFTER this assignment? Flow-approximate:
+    a Load counts if it appears later in the source, or if assignment
+    and Load share an enclosing loop (wrap-around use on the next
+    iteration). A Load only before a rebinding does not retain the NEW
+    task bound here."""
+    target = assign.targets[0].id
+    loads = [n for n in ast.walk(scope)
+             if isinstance(n, ast.Name) and n.id == target
+             and isinstance(n.ctx, ast.Load)]
+    if any(n.lineno > assign.lineno for n in loads):
+        return True
+    if not loads:
+        return False
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            members = set()
+            for sub in ast.walk(node):
+                members.add(id(sub))
+            if id(assign) in members and any(id(n) in members
+                                             for n in loads):
+                return True
+    return False
+
+
+# Exact dotted call names that block the calling thread, with the async
+# replacement the finding suggests.
+_BLOCKING = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "urllib.request.urlopen": "aiohttp.ClientSession",
+    "socket.create_connection": "asyncio.open_connection",
+    "socket.getaddrinfo": "loop.getaddrinfo",
+}
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "DL102"
+    name = "blocking-call-in-async"
+    description = (
+        "synchronous blocking call (time.sleep, subprocess, requests, "
+        "sync sockets) inside an async def: stalls the entire event loop "
+        "— every in-flight request on this loop waits behind it")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for scope in ast.walk(src.tree):
+            if not isinstance(scope, ast.AsyncFunctionDef):
+                continue
+            for node in walk_skip_functions(scope.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in _BLOCKING:
+                    yield self.finding(
+                        src, node,
+                        f"{name}() blocks the event loop inside async def "
+                        f"{scope.name!r}; use {_BLOCKING[name]} (or "
+                        "asyncio.to_thread / run_in_executor)")
+                elif name.startswith("requests."):
+                    yield self.finding(
+                        src, node,
+                        f"{name}() is synchronous HTTP inside async def "
+                        f"{scope.name!r}; use aiohttp (or asyncio."
+                        "to_thread)")
+
+
+def _has_await(body: list) -> bool:
+    for node in walk_skip_functions(body):
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            if any(gen.is_async for gen in node.generators):
+                return True
+    return False
+
+
+def _is_async_gen(body: list) -> bool:
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in walk_skip_functions(body))
+
+
+def _is_stub(fn: ast.AsyncFunctionDef) -> bool:
+    body = fn.body
+    if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant) and isinstance(
+            body[0].value.value, str):
+        body = body[1:]  # docstring
+    if not body:
+        return True
+    return all(
+        isinstance(stmt, (ast.Pass, ast.Raise))
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        # `return None` / `return <const>` default impls of an async
+        # interface — the await lives in the real implementations.
+        or (isinstance(stmt, ast.Return)
+            and (stmt.value is None
+                 or isinstance(stmt.value, ast.Constant)))
+        for stmt in body)
+
+
+def _is_handler(fn: ast.AsyncFunctionDef) -> bool:
+    """HTTP/RPC handlers must be async regardless of body: detect the
+    conventional `request` parameter or a *Request annotation."""
+    for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if arg.arg in ("request", "_request"):
+            return True
+        if arg.annotation is not None and \
+                ast.unparse(arg.annotation).endswith("Request"):
+            return True
+    return False
+
+
+@register
+class AsyncWithoutAwait(ProjectRule):
+    id = "DL103"
+    name = "async-without-await"
+    description = (
+        "async def whose body never awaits: either it does synchronous "
+        "work while holding the event loop (should be a plain def or use "
+        "to_thread), or the async is vestigial and misleads callers into "
+        "thinking it yields. Exempt: async generators, dunder protocol "
+        "methods, handlers taking a `request` parameter, and methods "
+        "whose name is implemented WITH an await elsewhere in the tree "
+        "(duck-typed interface conformity)")
+
+    def check_project(self, files: list) -> Iterable[Finding]:
+        # Names implemented with a real await anywhere: an awaitless
+        # sibling is conforming to that duck interface, not vestigial.
+        awaiting_names: set[str] = set()
+        candidates: list = []
+        for src in files:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.AsyncFunctionDef):
+                    continue
+                if _has_await(node.body):
+                    awaiting_names.add(node.name)
+                    continue
+                decorators = {call_name(d) if isinstance(d, ast.Call)
+                              else ast.unparse(d)
+                              for d in node.decorator_list}
+                if any("abstractmethod" in d or "overload" in d
+                       for d in decorators):
+                    continue
+                if (node.name.startswith("__")
+                        or _is_stub(node)
+                        or _is_async_gen(node.body)
+                        or _is_handler(node)):
+                    continue
+                candidates.append((src, node))
+        for src, node in candidates:
+            if node.name in awaiting_names:
+                continue
+            yield self.finding(
+                src, node,
+                f"async def {node.name!r} never awaits (and no sibling "
+                "implementation of that name does): make it a plain def, "
+                "or route the blocking work through asyncio.to_thread")

@@ -1,0 +1,192 @@
+"""JAX hot-path rules: per-iteration host syncs and recompile hazards.
+
+The TPU dispatch model rewards keeping the device queue full; a hidden
+``.item()``/``np.asarray`` inside a serving-loop iteration serializes
+host and device once per step, and a Python scalar leaking into a
+``jax.jit`` signature either breaks tracing (used in control flow) or
+compiles a fresh executable per distinct value (marked static)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Finding, Rule, SourceFile, call_name, register
+
+# Calls that force a device->host readback (or a host round-trip) when
+# handed a device array.
+_SYNC_NAMES = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.device_get"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+
+
+def _sync_call(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in _SYNC_NAMES:
+        return name
+    last = name.split(".")[-1]
+    if last in _SYNC_METHODS and not node.args and not node.keywords:
+        return f".{last}()"
+    return None
+
+
+@register
+class HostSyncInLoop(Rule):
+    id = "DL201"
+    name = "host-sync-in-loop"
+    description = (
+        "host-device synchronization (.item(), np.asarray, "
+        "jax.device_get, .block_until_ready()) inside a loop on an "
+        "engine/kv_router hot path: one blocking round-trip per "
+        "iteration; hoist a single batched transfer out of the loop or "
+        "keep the values device-resident")
+
+    def applies(self, rel: str) -> bool:
+        parts = rel.split("/")
+        return "engine" in parts or "kv_router" in parts
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        yield from self._visit(src, src.tree.body, in_loop=False)
+
+    def _visit(self, src: SourceFile, nodes,
+               in_loop: bool) -> Iterable[Finding]:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # A nested callable runs when called, not where defined.
+                body = node.body if isinstance(node.body, list) \
+                    else [ast.Expr(node.body)]
+                yield from self._visit(src, body, in_loop=False)
+                continue
+            if isinstance(node, ast.Call) and in_loop:
+                name = _sync_call(node)
+                if name:
+                    yield self.finding(
+                        src, node,
+                        f"{name} inside a loop forces a host-device sync "
+                        "every iteration; batch the readback outside the "
+                        "loop (single transfer of a stacked result)")
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # The iterable expression evaluates once, not per step.
+                yield from self._visit(src, [node.iter], in_loop)
+                yield from self._visit(src, node.body + node.orelse, True)
+                continue
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                # First generator's iterable evaluates once; the element
+                # expression and later generators run per item.
+                per_iter = [node.generators[0].target]
+                per_iter += node.generators[0].ifs
+                for gen in node.generators[1:]:
+                    per_iter += [gen.target, gen.iter] + gen.ifs
+                if isinstance(node, ast.DictComp):
+                    per_iter += [node.key, node.value]
+                else:
+                    per_iter.append(node.elt)
+                yield from self._visit(src, [node.generators[0].iter],
+                                       in_loop)
+                yield from self._visit(src, per_iter, True)
+                continue
+            yield from self._visit(
+                src, ast.iter_child_nodes(node),
+                in_loop=in_loop or isinstance(node, ast.While))
+
+
+_SCALARS = {"int", "float", "bool"}
+
+
+def _static_params(call: ast.Call, params: list[str]) -> set[str]:
+    """Parameter names declared static via static_argnums/static_argnames
+    kwargs of a jax.jit(...) / partial(jax.jit, ...) call."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        vals: list = []
+        if isinstance(kw.value, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)]
+        elif isinstance(kw.value, ast.Constant):
+            vals = [kw.value.value]
+        if kw.arg == "static_argnames":
+            out.update(v for v in vals if isinstance(v, str))
+        elif kw.arg == "static_argnums":
+            out.update(params[v] for v in vals
+                       if isinstance(v, int) and v < len(params))
+    return out
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jax.jit(...) call carrying static_arg* kwargs, whether `node`
+    is `jax.jit(...)` itself or `partial(jax.jit, ...)`."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in ("jax.jit", "jit"):
+        return node
+    if name in ("partial", "functools.partial") and node.args:
+        inner = node.args[0]
+        if isinstance(inner, (ast.Attribute, ast.Name)) and \
+                ast.unparse(inner) in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+@register
+class JitScalarArg(Rule):
+    id = "DL202"
+    name = "jit-scalar-arg"
+    description = (
+        "Python scalar (int/float/bool annotated) parameter in a "
+        "jax.jit-traced signature without a static_argnums/"
+        "static_argnames declaration: used in control flow or shapes it "
+        "fails tracing, and every workaround recompiles per value — "
+        "declare it static deliberately or pass an array")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        defs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs[node.name] = node
+        checked: set[str] = set()
+        # Decorator form: @jax.jit / @partial(jax.jit, static_argnames=..)
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                call = _jit_call(dec)
+                if call is None and not (
+                        isinstance(dec, (ast.Attribute, ast.Name))
+                        and ast.unparse(dec) in ("jax.jit", "jit")):
+                    continue
+                checked.add(fn.name)
+                yield from self._check_fn(src, fn, call)
+                break
+        # Call form: jax.jit(step, ...) where `step` is a local def.
+        for node in ast.walk(src.tree):
+            call = _jit_call(node)
+            if (call is None or call is not node
+                    or call_name(node) not in ("jax.jit", "jit")
+                    or not node.args):
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in defs \
+                    and target.id not in checked:
+                checked.add(target.id)
+                yield from self._check_fn(src, defs[target.id], node)
+
+    def _check_fn(self, src: SourceFile, fn: ast.FunctionDef,
+                  jit_call: Optional[ast.Call]) -> Iterable[Finding]:
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        static = _static_params(jit_call, params) if jit_call else set()
+        for arg in (args.posonlyargs + args.args + args.kwonlyargs):
+            if arg.arg in static or arg.arg == "self":
+                continue
+            ann = arg.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                ann = ast.Name(id=ann.value)  # "int" string annotation
+            if isinstance(ann, ast.Name) and ann.id in _SCALARS:
+                yield self.finding(
+                    src, arg,
+                    f"parameter '{arg.arg}: {ann.id}' of jit-traced "
+                    f"{fn.name!r} is a Python scalar with no static "
+                    "declaration; add it to static_argnames (accepting a "
+                    "recompile per distinct value) or pass it as a jnp "
+                    "array")

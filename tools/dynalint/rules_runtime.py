@@ -1,0 +1,233 @@
+"""Runtime-invariant rules: wire-protocol and observability contracts.
+
+Rust's serde derives make an unserializable protocol type a compile
+error and the reference's prometheus_names.rs centralizes metric
+naming; these rules are the Python stand-ins, plus the project-specific
+"accepted-but-unconsumed sampling field" check distilled from a real
+production bug (min_p validated, parsed, and silently ignored)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Finding, ProjectRule, Rule, SourceFile, call_name, register
+
+# Types msgpack packs losslessly (plus containers of them). Tuples pack
+# but decode as lists; sets/ndarrays/datetimes fail outright.
+_SAFE_NAMES = {"int", "float", "str", "bool", "bytes", "None", "Any",
+               "dict", "list", "object"}
+_SAFE_GENERICS = {"list", "List", "dict", "Dict", "Optional", "Union"}
+_LOSSY = {
+    "tuple": "tuples decode as lists",
+    "Tuple": "tuples decode as lists",
+    "set": "sets do not pack",
+    "Set": "sets do not pack",
+    "frozenset": "sets do not pack",
+    "ndarray": "ndarrays do not pack (send shape + bytes instead)",
+    "datetime": "datetimes do not pack (send a unix timestamp)",
+    "complex": "complex numbers do not pack",
+}
+
+
+def _ann_problem(node: Optional[ast.AST],
+                 local_types: set[str]) -> Optional[str]:
+    """None if the annotation round-trips through msgpack, else why not."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return None
+        if isinstance(node.value, str):
+            try:
+                return _ann_problem(ast.parse(node.value, mode="eval").body,
+                                    local_types)
+            except SyntaxError:
+                return "unparseable string annotation"
+        return None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        name = node.attr if isinstance(node, ast.Attribute) else node.id
+        if name in _SAFE_NAMES or name in _SAFE_GENERICS \
+                or name in local_types:
+            return None
+        return _LOSSY.get(name, f"{name} is not a msgpack-native type")
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        name = base.attr if isinstance(base, ast.Attribute) else \
+            getattr(base, "id", "")
+        if name not in _SAFE_GENERICS:
+            return _LOSSY.get(name, f"{name}[...] is not msgpack-native")
+        inner = node.slice
+        elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+        for elt in elts:
+            problem = _ann_problem(elt, local_types)
+            if problem:
+                return problem
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return (_ann_problem(node.left, local_types)
+                or _ann_problem(node.right, local_types))
+    return f"annotation {ast.unparse(node)!r} is not msgpack-native"
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if "dataclass" in ast.unparse(target):
+            return True
+    return False
+
+
+@register
+class UnserializableProtocolField(Rule):
+    id = "DL301"
+    name = "unserializable-protocol-field"
+    description = (
+        "wire-protocol dataclass (defines to_wire/from_wire) with a field "
+        "the msgpack codec cannot round-trip — tuples come back as lists, "
+        "sets/ndarrays/datetimes fail to pack; the serde-derive class of "
+        "bug Rust rejects at compile time")
+
+    def applies(self, rel: str) -> bool:
+        return "protocols" in rel.rsplit("/", 1)[-1]
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        local_types = {n.name for n in ast.walk(src.tree)
+                       if isinstance(n, ast.ClassDef)}
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef) or not _is_dataclass(cls):
+                continue
+            methods = {m.name for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if not {"to_wire", "from_wire"} & methods:
+                continue
+            for stmt in cls.body:
+                if not (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    continue
+                problem = _ann_problem(stmt.annotation, local_types)
+                if problem:
+                    yield self.finding(
+                        src, stmt,
+                        f"field '{stmt.target.id}: "
+                        f"{ast.unparse(stmt.annotation)}' of wire type "
+                        f"{cls.name!r} won't survive a msgpack round-trip "
+                        f"({problem}); use a native type or convert "
+                        "explicitly in to_wire/from_wire")
+
+
+# The accept/parse layer: files whose mention of a sampling field means
+# "accepted", not "consumed".
+_PARSE_LAYER = ("llm/validate.py", "llm/protocols.py",
+                "llm/preprocessor.py", "llm/logits_processing.py")
+
+
+@register
+class UnconsumedSamplingField(ProjectRule):
+    id = "DL302"
+    name = "unconsumed-sampling-field"
+    description = (
+        "sampling/stop field accepted by validate.py and carried by "
+        "SamplingOptions/StopConditions but never read outside the "
+        "accept/parse layer: requests setting it pass validation and get "
+        "silently wrong output (the min_p failure mode)")
+
+    def check_project(self,
+                      files: list[SourceFile]) -> Iterable[Finding]:
+        validate = self._by_suffix(files, "llm/validate.py")
+        protocols = self._by_suffix(files, "llm/protocols.py")
+        if validate is None or protocols is None:
+            return
+        accepted = self._accepted_fields(validate)
+        fields = self._carrier_fields(protocols)
+        consumed: set[str] = set()
+        for src in files:
+            if src.rel.endswith(_PARSE_LAYER):
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Attribute):
+                    consumed.add(node.attr)
+        for name, node in sorted(fields.items()):
+            if name in accepted and name not in consumed:
+                yield self.finding(
+                    protocols, node,
+                    f"sampling field {name!r} is validated and parsed but "
+                    "never consumed by the engine — requests setting it "
+                    "silently get default behavior; wire it into "
+                    "engine/scheduler.py or stop accepting it")
+
+    @staticmethod
+    def _by_suffix(files: list[SourceFile],
+                   suffix: str) -> Optional[SourceFile]:
+        for src in files:
+            if src.rel.endswith(suffix):
+                return src
+        return None
+
+    @staticmethod
+    def _accepted_fields(src: SourceFile) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "_COMMON_FIELDS"
+                            for t in node.targets)):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        out.add(sub.value)
+        return out
+
+    @staticmethod
+    def _carrier_fields(src: SourceFile) -> dict[str, ast.AST]:
+        out: dict[str, ast.AST] = {}
+        for cls in ast.walk(src.tree):
+            if isinstance(cls, ast.ClassDef) and cls.name in (
+                    "SamplingOptions", "StopConditions"):
+                for stmt in cls.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                            stmt.target, ast.Name):
+                        out[stmt.target.id] = stmt
+        return out
+
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+METRIC_PREFIX = "dynamo_"
+
+
+@register
+class MetricNamePrefix(Rule):
+    id = "DL303"
+    name = "metric-name-prefix"
+    description = (
+        "Prometheus metric whose name does not start with the project "
+        "prefix 'dynamo_' (the reference centralizes naming in "
+        "prometheus_names.rs); unprefixed metrics collide on shared "
+        "scrape pages and break dashboard queries")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        imports_prom = any(
+            (isinstance(n, ast.Import)
+             and any(a.name.split(".")[0] == "prometheus_client"
+                     for a in n.names))
+            or (isinstance(n, ast.ImportFrom)
+                and (n.module or "").split(".")[0] == "prometheus_client")
+            for n in ast.walk(src.tree))
+        if not imports_prom:
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node).split(".")[-1] in _METRIC_CTORS
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            metric = node.args[0].value
+            if not metric.startswith(METRIC_PREFIX):
+                base = metric[5:] if metric.startswith("dynt_") else metric
+                yield self.finding(
+                    src, node,
+                    f"metric {metric!r} violates the {METRIC_PREFIX!r} "
+                    f"naming convention; rename to "
+                    f"'{METRIC_PREFIX}{base}'")
