@@ -992,11 +992,12 @@ class TpuWorker:
             self._step_channel.close()
 
 
-async def main(argv: Optional[list[str]] = None) -> None:
+def build_arg_parser():
+    """Worker CLI (separate from main so tests can probe env-derived
+    defaults like DYNT_KV_BLOCK_SIZE without starting a worker)."""
     import argparse
 
-    from ..runtime import RuntimeConfig
-    from ..runtime.signals import wait_for_shutdown_signal
+    from ..runtime.config import env
 
     parser = argparse.ArgumentParser("dynamo_tpu.worker")
     parser.add_argument("--model", default="tiny-test",
@@ -1015,7 +1016,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--served-model-name", default=None)
     parser.add_argument("--namespace", default="dynamo")
     parser.add_argument("--component", default="backend")
-    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--page-size", type=int,
+                        default=env("DYNT_KV_BLOCK_SIZE"))
     parser.add_argument("--num-pages", type=int, default=2048)
     parser.add_argument("--max-batch", type=int, default=16)
     parser.add_argument("--max-pages-per-seq", type=int, default=128)
@@ -1082,7 +1084,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--reasoning-parser", default=None,
                         choices=["think", "deepseek-r1", "granite",
                                  "harmony", "gpt-oss"])
-    args = parser.parse_args(argv)
+    return parser
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    from ..runtime import RuntimeConfig
+    from ..runtime.signals import wait_for_shutdown_signal
+
+    args = build_arg_parser().parse_args(argv)
 
     component = args.component
     if args.mode == "prefill" and component == "backend":

@@ -91,7 +91,9 @@ class Frontend:
             self.recorder.close()
 
 
-async def main(argv: Optional[list[str]] = None) -> None:
+def build_arg_parser():
+    """Frontend CLI (separate from main so tests can probe env-derived
+    defaults like DYNT_BUSY_THRESHOLD without starting a frontend)."""
     import argparse
 
     parser = argparse.ArgumentParser("dynamo_tpu.frontend")
@@ -101,7 +103,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         choices=["round_robin", "random", "p2c", "kv"])
     parser.add_argument("--kv-overlap-score-weight", type=float, default=None)
     parser.add_argument("--router-temperature", type=float, default=None)
-    parser.add_argument("--busy-threshold", type=float, default=None)
+    parser.add_argument("--busy-threshold", type=float,
+                        default=env("DYNT_BUSY_THRESHOLD"))
     parser.add_argument("--kserve-grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC frontend on "
                              "this port (0 = ephemeral)")
@@ -115,7 +118,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         help="only serve models from this namespace (e.g. "
                              "'global' to front a global router; default: "
                              "all namespaces)")
-    args = parser.parse_args(argv)
+    return parser
+
+
+async def main(argv: Optional[list[str]] = None) -> None:
+    args = build_arg_parser().parse_args(argv)
 
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     frontend = Frontend(

@@ -48,18 +48,33 @@ class PoolState:
         self.workers[metrics.worker_id] = (metrics, time.monotonic())
 
     def pressure(self) -> float:
-        """0..inf — mean KV usage plus queue backlog per live worker. The
-        rebalancer gives pools replicas proportional to this."""
+        """0..inf — capacity-weighted KV usage plus queue backlog per
+        live worker. The rebalancer gives pools replicas proportional to
+        this. Weighting by each worker's total_blocks keeps a near-full
+        large worker from being averaged away by an idle small one (an
+        unweighted mean treats a 16-block toy pool and a 2048-block
+        production pool as equals)."""
         cutoff = time.monotonic() - self.metrics_ttl
         stale = [iid for iid, (_, ts) in self.workers.items() if ts < cutoff]
         for iid in stale:
             del self.workers[iid]
         if not self.workers:
             return 0.0
-        usage = sum(m.kv_usage for m, _ in self.workers.values())
+        # A worker that doesn't report capacity (total_blocks=0 — e.g.
+        # an old publisher mid rolling upgrade) gets the mean reported
+        # capacity, not weight zero: a busy non-reporter must still
+        # contribute pressure. All-non-reporting degrades to the plain
+        # mean.
+        caps = [m.total_blocks for m, _ in self.workers.values()]
+        reported = [c for c in caps if c > 0]
+        default_cap = (sum(reported) / len(reported)) if reported else 1.0
+        weights = [c if c > 0 else default_cap for c in caps]
+        usage_mean = sum(
+            m.kv_usage * w
+            for (m, _), w in zip(self.workers.values(), weights)
+        ) / sum(weights)
         waiting = sum(m.waiting_requests for m, _ in self.workers.values())
-        n = len(self.workers)
-        return usage / n + waiting / max(1, n)
+        return usage_mean + waiting / max(1, len(self.workers))
 
 
 class GlobalPlanner:

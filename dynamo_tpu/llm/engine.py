@@ -235,6 +235,15 @@ class Migration(TokenEngine):
                         # client.
                         raise ConnectionLost(
                             output.error or "worker requested migration")
+                    if current.prior_output_tokens \
+                            and output.prompt_tokens is not None:
+                        # The replayed prompt embeds the tokens already
+                        # generated (and already billed as completion);
+                        # report the ORIGINAL prompt length, or usage
+                        # accounting double-counts across a migration.
+                        output.prompt_tokens = max(
+                            0, output.prompt_tokens
+                            - len(current.prior_output_tokens))
                     generated.extend(output.token_ids)
                     yield output
                 return

@@ -497,15 +497,56 @@ class TokenGuide:
 _TOKEN_BYTES_CACHE: dict[int, list] = {}
 
 
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """Inverse of HF byte-level BPE's bytes_to_unicode: vocab char ->
+    original byte. Byte-level vocabs spell every token with these 256
+    characters (printable ASCII and Latin-1 map to themselves; the
+    rest shift up past U+0100), so a token string whose chars ALL land
+    in this table losslessly inverts to its true bytes."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+_BYTE_DECODER = _gpt2_byte_decoder()
+
+
 def token_bytes_for(tokenizer) -> list[Optional[bytes]]:
     """Vocab id -> produced UTF-8 bytes (None for specials/unused).
-    Cached per tokenizer: a 150k-vocab scan is seconds of decode calls
-    and is identical for every pattern."""
+
+    Byte-level-BPE vocabs (gpt2/llama3-style) are inverted through the
+    raw token string and the bytes_to_unicode table — decode() yields
+    U+FFFD for tokens carrying partial UTF-8 sequences (a multi-byte
+    char split across tokens), which used to ban those tokens and make
+    non-ASCII content ungeneratable under guided decoding. Cached per
+    tokenizer: a 150k-vocab scan is seconds of decode calls and is
+    identical for every pattern."""
     cached = _TOKEN_BYTES_CACHE.get(id(tokenizer))
     if cached is not None:
         return cached[1]
     out: list[Optional[bytes]] = []
     specials = getattr(tokenizer, "SPECIALS", {})
+    token_text = getattr(tokenizer, "token_text", lambda i: None)
+    raws = [token_text(i) for i in range(tokenizer.vocab_size)]
+    # Vocab-level gate: byte-level-BPE vocabs (gpt2/llama3/qwen) spell
+    # the space/newline bytes as Ġ (U+0120) / Ċ (U+010A) — present in
+    # thousands of their tokens and in no other tokenizer family —
+    # while SentencePiece vocabs carry the ▁ (U+2581) word marker
+    # instead. Requiring Ġ/Ċ and rejecting on ▁ keeps non-byte-level
+    # vocabs (SentencePiece '<0x0A>' byte fallback, WordPiece '##ing',
+    # multilingual text tokens like 'ā' that happen to land in the
+    # shifted alphabet) on the decode() path exactly as before.
+    byte_level = any(
+        raw and ("Ġ" in raw or "Ċ" in raw) for raw in raws
+    ) and not any(raw and "▁" in raw for raw in raws)
     for i in range(tokenizer.vocab_size):
         if i in specials or i in getattr(tokenizer, "eos_token_ids", []):
             out.append(None)
@@ -515,10 +556,21 @@ def token_bytes_for(tokenizer) -> list[Optional[bytes]]:
         except Exception:  # noqa: BLE001 — unused vocab slots
             out.append(None)
             continue
+        raw = raws[i]
+        # Empty decode = a special/added-control token the detokenizer
+        # skips ('<|im_start|>' etc.) — it must stay banned even though
+        # its raw spelling is plain ASCII; inverting it would let guided
+        # patterns admitting '<' emit chat-control tokens the client
+        # never sees.
+        if byte_level and raw and text \
+                and all(c in _BYTE_DECODER for c in raw):
+            # byte-level BPE spelling: recover the true bytes, partial
+            # UTF-8 sequences included (ASCII round-trips identically)
+            out.append(bytes(_BYTE_DECODER[c] for c in raw))
+            continue
         if not text or "�" in text:
-            # partial UTF-8 pieces (byte-level BPE continuation bytes)
-            # decode to replacement chars; byte tokenizers expose raw
-            # bytes below 256 instead
+            # partial UTF-8 pieces outside a byte-level vocab; byte
+            # tokenizers expose raw bytes below 256 instead
             if hasattr(tokenizer, "SPECIALS") and i < 256:
                 out.append(bytes([i]))
             else:

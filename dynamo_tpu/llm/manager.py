@@ -23,6 +23,7 @@ from ..kv_router import (
     RouterEvent,
     WorkerWithDpRank,
 )
+from ..runtime.config import env
 from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
@@ -424,7 +425,7 @@ class ModelWatcher:
         engine = PrefillRouterEngine(
             engine, pool_lookup=lambda: self._prefill_pools.get(name)
         )
-        engine = Migration(engine)
+        engine = Migration(engine, migration_limit=env("DYNT_MIGRATION_LIMIT"))
         # Outermost: images are encoded ONCE, before any migration retry
         # re-dispatch (embeddings travel with the replayed request).
         engine = MultimodalEngine(

@@ -34,6 +34,13 @@ class Tokenizer:
     def decode(self, token_ids: Sequence[int]) -> str:
         raise NotImplementedError
 
+    def token_text(self, token_id: int) -> Optional[str]:
+        """Raw vocab string of one token (e.g. 'Ġhello', 'â' for a lone
+        UTF-8 continuation byte under byte-level BPE), or None if
+        unknown. Unlike decode(), never lossy: guided decoding inverts
+        byte-level-BPE strings back to true bytes (llm/guided.py)."""
+        return None
+
     def spec(self) -> dict:
         """Serializable description for the ModelDeploymentCard."""
         raise NotImplementedError
@@ -133,6 +140,9 @@ class HfTokenizer(Tokenizer):
 
     def decode(self, token_ids: Sequence[int]) -> str:
         return self._tok.decode(list(token_ids), skip_special_tokens=True)
+
+    def token_text(self, token_id: int) -> Optional[str]:
+        return self._tok.id_to_token(token_id)
 
     def spec(self) -> dict:
         return {"kind": "hf", "path": self._path}

@@ -23,6 +23,14 @@ log = get_logger("planner.main")
 
 async def main(argv=None) -> None:
     parser = argparse.ArgumentParser("dynamo_tpu.planner")
+    parser.add_argument("--mode", default="sla", choices=["sla", "load"],
+                        help="sla: scrape frontend metrics + profiled "
+                             "throughput interpolation; load: ±1 scaling "
+                             "from worker LoadMetrics events on the "
+                             "event plane (no profile needed)")
+    parser.add_argument("--event-namespace", default="dynamo",
+                        help="event-plane namespace workers publish "
+                             "LoadMetrics under (--mode load)")
     parser.add_argument("--metrics-url",
                         default="http://127.0.0.1:8000/metrics")
     parser.add_argument("--model", required=True)
@@ -57,7 +65,7 @@ async def main(argv=None) -> None:
     parser.add_argument("--k8s-namespace", default="default")
     args = parser.parse_args(argv)
 
-    if args.profile_results_dir is None:
+    if args.mode == "sla" and args.profile_results_dir is None:
         from .interpolation import pre_swept_dir
 
         args.profile_results_dir = pre_swept_dir(args.model, args.chip)
@@ -85,23 +93,53 @@ async def main(argv=None) -> None:
                                         args.k8s_namespace)
     else:
         connector = VirtualConnector(runtime, namespace=args.namespace)
-    disagg = not args.aggregated
-    planner = SlaPlanner(
-        config, connector,
-        prefill_interpolator=(PrefillInterpolator(args.profile_results_dir)
-                              if disagg else None),
-        decode_interpolator=DecodeInterpolator(args.profile_results_dir),
-        scraper=FrontendScraper(args.metrics_url, args.model),
-        disagg=disagg,
-    )
+    sub = None
+    pump_task = None
+    if args.mode == "load":
+        # Load-based mode: ±1 decode scaling from worker LoadMetrics
+        # events — no pre-swept profile required.
+        from ..kv_router.protocols import LOAD_TOPIC
+        from .core import LoadBasedPlanner
+        from .metrics_source import LoadEventSource
+
+        source = LoadEventSource()
+        sub = await runtime.event_subscriber(args.event_namespace,
+                                             topic_prefix=LOAD_TOPIC)
+
+        async def _pump() -> None:
+            async for _topic, payload in sub:
+                source.on_event(payload)
+
+        pump_task = asyncio.create_task(_pump())
+        planner = LoadBasedPlanner(config, connector, source)
+    else:
+        disagg = not args.aggregated
+        planner = SlaPlanner(
+            config, connector,
+            prefill_interpolator=(
+                PrefillInterpolator(args.profile_results_dir)
+                if disagg else None),
+            decode_interpolator=DecodeInterpolator(
+                args.profile_results_dir),
+            scraper=FrontendScraper(args.metrics_url, args.model),
+            disagg=disagg,
+        )
     planner.start()
-    log.info("planner running (interval=%.0fs predictor=%s connector=%s)",
-             config.adjustment_interval, config.load_predictor,
-             args.connector)
+    log.info("planner running (mode=%s interval=%.0fs predictor=%s "
+             "connector=%s)", args.mode, config.adjustment_interval,
+             config.load_predictor, args.connector)
     try:
         await wait_for_shutdown_signal()
     finally:
         await planner.stop()
+        if pump_task is not None:
+            pump_task.cancel()
+            try:
+                await pump_task
+            except asyncio.CancelledError:
+                pass
+        if sub is not None:
+            await sub.close()
         await runtime.shutdown()
 
 

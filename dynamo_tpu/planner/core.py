@@ -271,9 +271,24 @@ class LoadBasedPlanner:
         self.ttft_est = TtftEstimator()
         self.itl_est = ItlEstimator()
         self.state = PlannerState()
+        self._task: Optional[asyncio.Task] = None
+        # last snapshot object fed to the estimators, per worker (held
+        # by reference so identity comparison cannot see a recycled id)
+        self._ingested: dict[tuple[int, int], dict] = {}
 
     def ingest(self) -> None:
-        for snap in self.source.snapshots():
+        live = self.source.keyed()
+        # drop dedup state for workers the source expired, or dead
+        # workers' final snapshots pin memory forever under churn
+        for gone in [k for k in self._ingested if k not in live]:
+            del self._ingested[gone]
+        for key, snap in live.items():
+            if self._ingested.get(key) is snap:
+                # unchanged since last interval (event-plane stall):
+                # re-observing it would flood the regression with
+                # duplicates of stale data
+                continue
+            self._ingested[key] = snap
             wall = float(snap.get("step_wall_ms", 0.0))
             if wall <= 0:
                 continue
@@ -328,3 +343,42 @@ class LoadBasedPlanner:
         return self._decide(ests, self.config.ttft_ms, current_replicas,
                             self.config.scale_down_sensitivity,
                             self.config.min_endpoint)
+
+    # -- loop (the planner CLI's --mode load driver) -----------------------
+
+    async def run(self) -> None:
+        """Decode-replica autoscaling from worker LoadMetrics events (the
+        reference's load-based planner mode; prefill stays put — queue
+        depth per engine is a router-side signal this source lacks)."""
+        current = self.config.min_endpoint
+        while True:
+            await asyncio.sleep(self.config.adjustment_interval)
+            try:
+                obs = await self.connector.observed_replicas(
+                    self.config.decode_component)
+                if obs is not None and obs > 0:
+                    current = obs
+                target = self.plan_decode(current)
+                if target != current:
+                    log.info("load planner: decode %d -> %d replicas",
+                             current, target)
+                    await self.connector.set_component_replicas(
+                        [TargetReplica(self.config.decode_component,
+                                       target)])
+                    current = target
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad interval must not
+                # kill the autoscaler (same stance as SlaPlanner.run)
+                log.exception("load planner interval failed; continuing")
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import re
+import time
 import urllib.request
 from typing import Optional
 
@@ -134,19 +135,39 @@ class FrontendScraper:
 
 
 class LoadEventSource:
-    """Collects per-worker LoadMetrics events for load-based planning."""
+    """Collects per-worker LoadMetrics events for load-based planning.
 
-    def __init__(self) -> None:
-        # (worker_id, dp_rank) -> latest LoadMetrics wire dict
-        self.latest: dict[tuple[int, int], dict] = {}
+    Entries expire after `metrics_ttl` seconds without a fresh event
+    (same stance as the global planner's PoolState): a worker that dies
+    while busy must not pin its last high-load snapshot forever —
+    `_decide` scales down only when ALL estimates are low, so one stale
+    busy ghost would block scale-down indefinitely."""
+
+    def __init__(self, metrics_ttl: float = 60.0) -> None:
+        self.metrics_ttl = metrics_ttl
+        # (worker_id, dp_rank) -> (latest LoadMetrics wire dict, t_recv)
+        self.latest: dict[tuple[int, int], tuple[dict, float]] = {}
 
     def on_event(self, payload: dict) -> None:
         key = (int(payload.get("worker_id", 0)),
                int(payload.get("dp_rank", 0)))
-        self.latest[key] = payload
+        self.latest[key] = (payload, time.monotonic())
+
+    def _prune(self) -> None:
+        cutoff = time.monotonic() - self.metrics_ttl
+        for key in [k for k, (_, ts) in self.latest.items()
+                    if ts < cutoff]:
+            del self.latest[key]
 
     def worker_count(self) -> int:
+        self._prune()
         return len({w for w, _ in self.latest})
 
     def snapshots(self) -> list[dict]:
-        return list(self.latest.values())
+        self._prune()
+        return [snap for snap, _ in self.latest.values()]
+
+    def keyed(self) -> dict[tuple[int, int], dict]:
+        """Keyed live snapshots (lets consumers dedup by identity)."""
+        self._prune()
+        return {key: snap for key, (snap, _) in self.latest.items()}

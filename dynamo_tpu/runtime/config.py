@@ -162,9 +162,10 @@ _register("DYNT_ROUTER_OVERLAP_WEIGHT", 1.0, _float,
           "(ref: kv-router scheduling/selector.rs:155)")
 _register("DYNT_ROUTER_TEMPERATURE", 0.0, _float,
           "KV router softmax sampling temperature (0 = argmin)")
-_register("DYNT_BUSY_THRESHOLD", 0.95, _float,
-          "KV-load busy threshold for 503 load shedding "
-          "(ref: http/service/busy_threshold.rs)")
+_register("DYNT_BUSY_THRESHOLD", None, _float,
+          "KV-load busy threshold for 503 load shedding; unset disables "
+          "shedding (ref: http/service/busy_threshold.rs). The frontend "
+          "--busy-threshold flag overrides")
 _register("DYNT_ROUTER_QUEUE_POLICY", "fcfs", _str,
           "Router admission-queue ordering: fcfs | lcfs | wspt "
           "(ref: kv-router scheduling/policy.rs)")

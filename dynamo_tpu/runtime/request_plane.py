@@ -360,6 +360,39 @@ class TcpRequestClient:
                         asyncio.TimeoutError):
                     pass
 
+    async def ping(self, address: str, timeout: float = 5.0) -> float:
+        """Liveness probe: round-trips a ping frame through the peer's
+        frame loop (no handler dispatch), returning the RTT in seconds.
+        Distinguishes a live-but-busy worker (pong still flows) from a
+        black-holed one (TimeoutError) without consuming an endpoint."""
+        conn = await self._get_conn(address)
+        rid = next(self._next_id)
+        queue: asyncio.Queue = asyncio.Queue()
+        conn.streams[rid] = queue
+        start = time.monotonic()
+
+        async def probe() -> dict:
+            # The send is INSIDE the timeout: a black-holed peer with a
+            # full socket buffer blocks drain() under the send lock —
+            # the very condition ping exists to detect (same bound the
+            # cancel path applies to its fire-and-forget frame).
+            await conn.send({"t": "ping", "i": rid})
+            header, _ = await queue.get()
+            return header
+
+        try:
+            header = await asyncio.wait_for(probe(), timeout)
+            if header.get("t") != "pong":
+                raise ConnectionLost(
+                    f"expected pong, got {header.get('t')!r} "
+                    f"({header.get('e', '')})")
+            return time.monotonic() - start
+        except asyncio.TimeoutError:
+            raise ConnectionLost(
+                f"ping timeout after {timeout}s: {address}") from None
+        finally:
+            conn.streams.pop(rid, None)
+
     async def close(self) -> None:
         for conn in self._conns.values():
             conn.close()

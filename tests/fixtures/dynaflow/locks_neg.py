@@ -1,0 +1,44 @@
+"""Lock fixture (negative): send-lock transport writes, consistent
+ordering, slow work outside the locked region."""
+
+import asyncio
+import threading
+
+
+class SendLockOk:
+    def __init__(self, writer):
+        self.send_lock = asyncio.Lock()
+        self.writer = writer
+
+    async def send(self, frame):
+        # serializing the transport is the send lock's purpose
+        async with self.send_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+
+class SlowOutsideLock:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self.value = 0
+
+    async def update(self):
+        async with self._lock:
+            self.value += 1
+        await asyncio.sleep(1.0)  # slow, but the lock is released
+
+
+class OrderConsistent:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass

@@ -1,0 +1,36 @@
+"""Lock fixture (positive): slow awaits under locks + ABBA ordering."""
+
+import asyncio
+import threading
+
+
+class SlowUnderLock:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+
+    async def direct(self):
+        async with self._lock:
+            await asyncio.sleep(1.0)  # DF201: slow await under lock
+
+    async def via_callee(self):
+        async with self._lock:
+            await self._helper()  # DF201: callee awaits slow call
+
+    async def _helper(self):
+        await asyncio.sleep(0.5)
+
+
+class OrderAB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # order a -> b
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:  # DF202: order b -> a elsewhere
+                pass

@@ -1,0 +1,19 @@
+"""Protocol fixture (negative): producer and consumer agree exactly."""
+
+
+def producer(sock):
+    send(sock, {"t": "msg", "k": 1})
+    send(sock, {"t": "end"})
+
+
+def consumer(msg):
+    ftype = msg.get("t")
+    if ftype == "msg":
+        return msg["k"]
+    if ftype == "end":
+        return None
+    return None
+
+
+def send(sock, frame):
+    sock.write(frame)

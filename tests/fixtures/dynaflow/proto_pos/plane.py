@@ -1,0 +1,21 @@
+"""Protocol fixture (positive): one plane with every drift flavor."""
+
+
+def producer(sock):
+    # tag 'msg' carries a key no consumer reads ('dead') -> DF101
+    send(sock, {"t": "msg", "k": 1, "dead": 2})
+    # tag 'orphan' has no dispatch arm -> DF103
+    send(sock, {"t": "orphan", "k": 3})
+
+
+def consumer(msg):
+    ftype = msg.get("t")
+    if ftype == "msg":
+        return msg["k"]
+    if ftype == "ghost":  # never produced -> DF103
+        return msg["gone"]  # never written -> DF102
+    return None
+
+
+def send(sock, frame):
+    sock.write(frame)

@@ -1,0 +1,7 @@
+def main(request):
+    return step(request)
+
+
+def step(request):
+    penalty = request.sampling.min_p + request.sampling.temperature
+    return penalty + sum(request.output.token_ids)

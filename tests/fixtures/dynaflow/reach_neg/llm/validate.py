@@ -1,0 +1,1 @@
+_COMMON_FIELDS = {"temperature", "min_p"}

@@ -1,0 +1,12 @@
+def main(request):
+    # entry point consumes temperature, so only min_p dangles
+    return request.sampling.temperature + sum(consume(request).token_ids)
+
+
+def consume(request):
+    return request.output
+
+
+def dead_code(request):
+    # reads min_p, but nothing reachable ever calls this -> DF301
+    return request.sampling.min_p

@@ -1,0 +1,13 @@
+import dataclasses
+
+
+@dataclasses.dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    min_p: float = 0.0
+
+
+@dataclasses.dataclass
+class EngineOutput:
+    token_ids: list = dataclasses.field(default_factory=list)
+    ghost_field: int = 0  # no reachable reader anywhere -> DF302

@@ -1,0 +1,9 @@
+from prometheus_client import Counter
+
+from .runtime.config import env
+
+GOOD = env("DYNT_GOOD")
+RATIO = env("DYNT_RATIO")
+OPTIONAL = env("DYNT_OPTIONAL")
+
+DOCUMENTED = Counter("dynamo_documented_total", "listed in the doc")

@@ -1,0 +1,18 @@
+_REGISTRY = {}
+
+
+def _register(name, default, parse, doc):
+    _REGISTRY[name] = (default, parse, doc)
+
+
+def env(name):
+    return _REGISTRY[name][0]
+
+
+_int = int
+_float = float
+
+
+_register("DYNT_GOOD", 1, _int, "wired knob")
+_register("DYNT_RATIO", 0.5, _float, "float knob, float default")
+_register("DYNT_OPTIONAL", None, _float, "None default is always fine")

@@ -1,0 +1,5 @@
+from .runtime.config import env
+
+GOOD = env("DYNT_GOOD")
+BADTYPE = env("DYNT_BADTYPE")
+UNREGISTERED = env("DYNT_UNREGISTERED")  # -> DF401

@@ -1,0 +1,5 @@
+from prometheus_client import Counter
+
+FIRST = Counter("dynamo_dup_total", "first registration")
+SECOND = Counter("dynamo_dup_total", "same name again -> DF404")
+SECRET = Counter("dynamo_secret_total", "absent from the doc -> DF405")

@@ -1,0 +1,18 @@
+_REGISTRY = {}
+
+
+def _register(name, default, parse, doc):
+    _REGISTRY[name] = (default, parse, doc)
+
+
+def env(name):
+    return _REGISTRY[name][0]
+
+
+_str = str
+_int = int
+
+
+_register("DYNT_GOOD", 1, _int, "wired knob")
+_register("DYNT_DEAD", 1, _int, "read by nothing -> DF403")
+_register("DYNT_BADTYPE", "sixteen", _int, "str default, int parser -> DF402")

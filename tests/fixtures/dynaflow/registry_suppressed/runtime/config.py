@@ -1,0 +1,12 @@
+_REGISTRY = {}
+
+
+def _register(name, default, parse, doc):
+    _REGISTRY[name] = (default, parse, doc)
+
+
+_int = int
+
+
+_register("DYNT_FUTURE", 1, _int, "reserved")  # dynaflow: disable=DF403 -- reserved for the next release
+_register("DYNT_TYPO", 1, _int, "typo'd suppression")  # dynaflow: disable=DF999 -- bad rule name

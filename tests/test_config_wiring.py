@@ -1,0 +1,37 @@
+"""Registry-knob wiring regressions (dynaflow DF403 fixes): knobs that
+were registered in runtime/config.py but read by nothing. Each test
+pins the env var to the code path that now consumes it."""
+
+from dynamo_tpu.runtime.config import env, registry
+
+
+class TestKvBlockSizeKnob:
+    def test_worker_page_size_defaults_from_env(self, monkeypatch):
+        from dynamo_tpu.engine.worker import build_arg_parser
+
+        assert build_arg_parser().get_default("page_size") == 16
+        monkeypatch.setenv("DYNT_KV_BLOCK_SIZE", "32")
+        parser = build_arg_parser()
+        assert parser.get_default("page_size") == 32
+        # explicit flag still wins
+        assert parser.parse_args(["--page-size", "8"]).page_size == 8
+
+
+class TestBusyThresholdKnob:
+    def test_frontend_flag_defaults_from_env(self, monkeypatch):
+        from dynamo_tpu.frontend.service import build_arg_parser
+
+        # unset: shedding disabled (None), matching prior behavior
+        monkeypatch.delenv("DYNT_BUSY_THRESHOLD", raising=False)
+        assert build_arg_parser().get_default("busy_threshold") is None
+        monkeypatch.setenv("DYNT_BUSY_THRESHOLD", "0.8")
+        assert build_arg_parser().get_default("busy_threshold") == 0.8
+
+    def test_registry_default_is_none(self):
+        assert registry()["DYNT_BUSY_THRESHOLD"].default is None
+
+
+class TestMigrationLimitKnob:
+    def test_registry_parses_int(self, monkeypatch):
+        monkeypatch.setenv("DYNT_MIGRATION_LIMIT", "7")
+        assert env("DYNT_MIGRATION_LIMIT") == 7

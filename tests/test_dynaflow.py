@@ -1,0 +1,256 @@
+"""dynaflow golden tests: every pass exercised by positive and negative
+fixtures, schema-snapshot drift, suppression semantics, CLI contract,
+and the repo-wide clean-lint invariant (dynalint + dynaflow over
+dynamo_tpu/ — the same gate CI enforces, failing pytest locally)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import tools.dynalint as dynalint
+from tools.dynaflow import all_rules, run, update_schemas
+from tools.dynaflow.passes_locks import LockOrderInversion, SlowCallUnderLock
+from tools.dynaflow.passes_protocol import (
+    Plane,
+    WireKeyNeverRead,
+    WireKeyNeverWritten,
+    WireSchemaDrift,
+    WireTagUnhandled,
+)
+from tools.dynaflow.passes_reach import (
+    ProtocolFieldUnread,
+    UnreachableAcceptedField,
+)
+from tools.dynaflow.passes_registry import (
+    DeadConfigKnob,
+    DuplicateMetricName,
+    EnvDefaultTypeMismatch,
+    UndocumentedMetric,
+    UnregisteredEnvRead,
+)
+from tools.dynalint.core import collect_files
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynaflow"
+REPO = pathlib.Path(__file__).parent.parent
+
+# Fixture plane: one file, msg receivers, send() transmit, "t" tag.
+FIXTURE_PLANE = (Plane("fixture", ("plane.py",), ("send",), ("msg",),
+                       tag_key="t"),)
+
+
+def flow(path, rules):
+    findings, _ = run([str(FIXTURES / path)], rules=rules)
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestRuleCatalogue:
+    def test_thirteen_rules_registered(self):
+        assert len(all_rules()) >= 13
+
+    def test_ids_and_names_unique_and_described(self):
+        rules = all_rules()
+        assert len({r.id for r in rules}) == len(rules)
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.description for r in rules)
+
+    def test_disjoint_from_dynalint_ids(self):
+        assert not ({r.id for r in all_rules()}
+                    & {r.id for r in dynalint.all_rules()})
+
+
+class TestProtocolConformance:
+    RULES = [WireKeyNeverRead(FIXTURE_PLANE),
+             WireKeyNeverWritten(FIXTURE_PLANE),
+             WireTagUnhandled(FIXTURE_PLANE)]
+
+    def test_positive(self):
+        findings = flow("proto_pos", self.RULES)
+        by_rule = {}
+        for f in findings:
+            by_rule.setdefault(f.rule, []).append(f.message)
+        assert any("'dead'" in m for m in by_rule["DF101"])
+        assert any("'gone'" in m for m in by_rule["DF102"])
+        tags = " ".join(by_rule["DF103"])
+        assert "'orphan'" in tags and "'ghost'" in tags
+
+    def test_negative(self):
+        assert flow("proto_neg", self.RULES) == []
+
+    def test_schema_drift(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "proto_neg")])
+        # no snapshot yet -> missing-snapshot finding
+        rule = WireSchemaDrift(FIXTURE_PLANE, schema_dir=tmp_path)
+        missing, _ = run([str(FIXTURES / "proto_neg")], rules=[rule])
+        assert rules_of(missing) == ["DF104"]
+        assert "no schema snapshot" in missing[0].message
+        # blessed snapshot -> clean
+        update_schemas(files, schema_dir=tmp_path, planes=FIXTURE_PLANE)
+        clean, _ = run([str(FIXTURES / "proto_neg")], rules=[rule])
+        assert clean == []
+        # the tree drifts from the snapshot -> diffed finding
+        drifted, _ = run([str(FIXTURES / "proto_pos")], rules=[rule])
+        assert rules_of(drifted) == ["DF104"]
+        assert "drifted" in drifted[0].message
+
+    def test_schema_update_writes_json(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "proto_neg")])
+        changed = update_schemas(files, schema_dir=tmp_path,
+                                 planes=FIXTURE_PLANE)
+        assert changed == ["fixture"]
+        data = json.loads((tmp_path / "fixture.json").read_text())
+        assert data["dispatch"] == ["end", "msg"]
+        assert data["writes"]["msg"] == ["k", "t"]
+        # second run is a no-op
+        assert update_schemas(files, schema_dir=tmp_path,
+                              planes=FIXTURE_PLANE) == []
+
+
+class TestLockHazards:
+    def test_slow_call_positive(self):
+        findings = flow("locks_pos.py", [SlowCallUnderLock()])
+        lines = [f.line for f in findings if f.rule == "DF201"]
+        # direct slow await + the callee-traced one
+        assert len(lines) == 2
+        assert any("sleep" in f.message for f in findings)
+        assert any("_helper" in f.message for f in findings)
+
+    def test_lock_order_positive(self):
+        findings = flow("locks_pos.py", [LockOrderInversion()])
+        assert rules_of(findings) == ["DF202"]
+        assert "OrderAB._a" in findings[0].message
+        assert "OrderAB._b" in findings[0].message
+
+    def test_negative(self):
+        assert flow("locks_neg.py",
+                    [SlowCallUnderLock(), LockOrderInversion()]) == []
+
+
+class TestReachableConsumption:
+    RULES = [UnreachableAcceptedField(), ProtocolFieldUnread()]
+
+    def test_positive(self):
+        findings = flow("reach_pos", self.RULES)
+        assert ("DF301", "min_p") in [
+            (f.rule, f.message.split(".")[1].split(" ")[0])
+            for f in findings if f.rule == "DF301"]
+        assert any(f.rule == "DF302" and "ghost_field" in f.message
+                   for f in findings)
+        # temperature IS read from the entry point: not flagged
+        assert not any("temperature" in f.message for f in findings)
+
+    def test_negative(self):
+        assert flow("reach_neg", self.RULES) == []
+
+
+class TestRegistryConformance:
+    def test_env_positive(self):
+        findings = flow("registry_pos",
+                        [UnregisteredEnvRead(), EnvDefaultTypeMismatch(),
+                         DeadConfigKnob()])
+        msgs = {f.rule: f.message for f in findings}
+        assert "DYNT_UNREGISTERED" in msgs["DF401"]
+        assert "DYNT_BADTYPE" in msgs["DF402"]
+        assert "DYNT_DEAD" in msgs["DF403"]
+
+    def test_metrics_positive(self):
+        findings = flow(
+            "registry_pos",
+            [DuplicateMetricName(),
+             UndocumentedMetric(doc_path=FIXTURES / "metrics_doc.md")])
+        assert any(f.rule == "DF404" and "dynamo_dup_total" in f.message
+                   for f in findings)
+        assert any(f.rule == "DF405" and "dynamo_secret_total" in f.message
+                   for f in findings)
+
+    def test_negative(self):
+        findings = flow(
+            "registry_neg",
+            [UnregisteredEnvRead(), EnvDefaultTypeMismatch(),
+             DeadConfigKnob(), DuplicateMetricName(),
+             UndocumentedMetric(doc_path=FIXTURES / "metrics_doc.md")])
+        assert findings == []
+
+
+class TestSuppressions:
+    def test_justified_suppression_silences(self):
+        findings = flow("registry_suppressed", [DeadConfigKnob()])
+        # DYNT_FUTURE suppressed; DYNT_TYPO's suppression names an
+        # unknown rule: DF000 reported AND the DF403 still fires
+        assert [f.rule for f in findings] == ["DF000", "DF403"]
+        assert "DF999" in findings[0].message
+        assert "DYNT_TYPO" in findings[1].message
+
+    def test_dynalint_marker_does_not_suppress_dynaflow(self, tmp_path):
+        root = tmp_path / "runtime"
+        root.mkdir()
+        src = (FIXTURES / "registry_suppressed" / "runtime"
+               / "config.py").read_text()
+        (root / "config.py").write_text(
+            src.replace("# dynaflow: disable=DF403 -- reserved for the "
+                        "next release",
+                        "# dynalint: disable=DF403 -- wrong tool"))
+        findings, _ = run([str(tmp_path)], rules=[DeadConfigKnob()])
+        assert "DYNT_FUTURE" in " ".join(f.message for f in findings)
+
+
+class TestCli:
+    def test_json_output_and_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynaflow",
+             str(FIXTURES / "locks_pos.py"), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["files_checked"] == 1
+        assert {f["rule"] for f in data["findings"]} == {"DF201", "DF202"}
+        assert {r["id"] for r in data["rules"]} >= {
+            "DF101", "DF102", "DF103", "DF104", "DF201", "DF202",
+            "DF301", "DF302", "DF401", "DF402", "DF403", "DF404",
+            "DF405"}
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynaflow", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "DF101" in proc.stdout
+        assert "wire-key-never-read" in proc.stdout
+
+    def test_schema_update_on_current_tree_is_noop(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynaflow", "--schema-update"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "already current" in proc.stdout
+
+
+class TestRealTreeStaysClean:
+    """The repo-wide clean-lint invariant: BOTH analyzers have zero
+    unsuppressed findings on dynamo_tpu/. Regressions fail pytest
+    locally, not just the CI lint job."""
+
+    def test_dynaflow_clean(self):
+        findings, files_checked = run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynalint_clean(self):
+        findings, files_checked = dynalint.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_schemas_current(self):
+        """The checked-in snapshots match the tree (a drifted snapshot
+        would already fail test_dynaflow_clean; this pins the four
+        snapshot files exist)."""
+        from tools.dynaflow import DEFAULT_PLANES, SCHEMA_DIR
+
+        for plane in DEFAULT_PLANES:
+            assert (SCHEMA_DIR / f"{plane.name}.json").exists(), plane.name

@@ -263,3 +263,39 @@ class TestGlobalPlanner:
             await rt.shutdown()
 
         run(body(), timeout=120)
+
+
+class TestCapacityWeightedPressure:
+    def test_usage_weighted_by_total_blocks(self):
+        """A near-full 2048-block worker must not be averaged away by an
+        idle 16-block one (dynaflow DF302: total_blocks now feeds the
+        rebalancer)."""
+        pool = PoolState(namespace="a",
+                         connector=CallbackConnector(lambda c, n: None))
+        pool.record(LoadMetrics(worker_id=1, kv_usage=0.9,
+                                total_blocks=2048))
+        pool.record(LoadMetrics(worker_id=2, kv_usage=0.0,
+                                total_blocks=16))
+        # capacity-weighted mean ~= 0.893, not the naive 0.45
+        assert pool.pressure() == pytest.approx(
+            0.9 * 2048 / (2048 + 16), rel=1e-6)
+
+    def test_unreported_capacity_falls_back_to_mean(self):
+        pool = PoolState(namespace="a",
+                         connector=CallbackConnector(lambda c, n: None))
+        pool.record(LoadMetrics(worker_id=1, kv_usage=0.8))
+        pool.record(LoadMetrics(worker_id=2, kv_usage=0.2))
+        assert pool.pressure() == pytest.approx(0.5)
+
+    def test_mixed_capacity_fleet_keeps_nonreporters(self):
+        """Workers that don't report total_blocks (rolling upgrade) must
+        still contribute pressure — at the mean reported capacity, not
+        weight zero."""
+        pool = PoolState(namespace="a",
+                         connector=CallbackConnector(lambda c, n: None))
+        pool.record(LoadMetrics(worker_id=1, kv_usage=0.0,
+                                total_blocks=2048))
+        pool.record(LoadMetrics(worker_id=2, kv_usage=0.9))  # no capacity
+        # non-reporter weighted at the mean reported capacity (2048):
+        # (0*2048 + 0.9*2048) / 4096 = 0.45, not 0.0
+        assert pool.pressure() == pytest.approx(0.45)

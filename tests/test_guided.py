@@ -23,7 +23,7 @@ from dynamo_tpu.llm.guided import (
     schema_to_regex,
     token_bytes_for,
 )
-from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, Tokenizer
 
 
 class TestRegexEngine:
@@ -432,3 +432,99 @@ class TestGuidedE2E:
                 await rt_w.shutdown()
 
         run(body(), timeout=60)
+
+
+class FakeByteLevelBPE(Tokenizer):
+    """HF byte-level-BPE shape: raw vocab strings spell bytes via the
+    gpt2 bytes_to_unicode alphabet, and decode() of a token carrying a
+    partial UTF-8 sequence yields U+FFFD — the case that used to ban
+    the token outright (advisor round-5 finding)."""
+
+    def __init__(self):
+        # 'Ã' + '©' are the byte-level spellings of 0xC3 / 0xA9 —
+        # 'é' split across two tokens; id 5 is an added chat-control
+        # token whose raw spelling is plain ASCII but whose decode is
+        # empty (skip_special_tokens), like HF '<|im_start|>'
+        # 'Ġa' marks the vocab as byte-level (shifted gpt2 alphabet)
+        self.vocab = ['"', "\xc3", "\xa9", "a", "</s>", "<|im_start|>",
+                      "\u0120a"]
+        self.eos_token_ids = [4]
+        self.vocab_size = 7
+        self.stable_window = 0
+
+    def token_text(self, token_id):
+        return self.vocab[token_id] if token_id != 4 else None
+
+    def decode(self, token_ids):
+        out = []
+        for t in token_ids:
+            if t in (1, 2):
+                out.append("�")  # partial UTF-8 piece
+            elif t in (4, 5):
+                out.append("")  # specials skipped by the detokenizer
+            elif t == 6:
+                out.append(" a")
+            else:
+                out.append(self.vocab[t])
+        return "".join(out)
+
+    def encode(self, text):
+        raise NotImplementedError
+
+
+class TestByteLevelBpeRecovery:
+    def test_continuation_tokens_recover_true_bytes(self):
+        tok = FakeByteLevelBPE()
+        tb = token_bytes_for(tok)
+        # previously None (decode yields U+FFFD -> token banned forever)
+        assert tb[1] == b"\xc3"
+        assert tb[2] == b"\xa9"
+        assert tb[0] == b'"'
+        assert tb[4] is None  # EOS stays special
+        # ASCII-spelled chat-control token with empty decode: still
+        # banned — guided patterns admitting '<' must not emit it
+        assert tb[5] is None
+        assert tb[6] == b" a"  # Ġ inverts to a leading space
+
+    def test_non_byte_level_vocab_keeps_decode_semantics(self):
+        """SentencePiece byte-fallback spellings ('<0x0A>') are plain
+        ASCII but are NOT byte-level-BPE: without the shifted-alphabet
+        vocab marker the decode() path must win, not the inversion."""
+
+        class FakeSentencePiece(Tokenizer):
+            vocab = ["a", "<0x0A>"]
+            eos_token_ids = []
+            vocab_size = 2
+
+            def token_text(self, token_id):
+                return self.vocab[token_id]
+
+            def decode(self, token_ids):
+                return "".join("\n" if t == 1 else self.vocab[t]
+                               for t in token_ids)
+
+            def encode(self, text):
+                raise NotImplementedError
+
+        tb = token_bytes_for(FakeSentencePiece())
+        assert tb[0] == b"a"
+        assert tb[1] == b"\n"  # not b"<0x0A>"
+
+    def test_multibyte_utf8_guided_generation(self):
+        """Guided JSON with non-ASCII content is generatable: the DFA
+        walks the é bytes across two byte-level tokens."""
+        tok = FakeByteLevelBPE()
+        guide = TokenGuide(compile_regex('"é"'), token_bytes_for(tok),
+                           tok.eos_token_ids)
+        proc = GuidedProcessor(guide)
+        out = []
+        for _ in range(6):
+            logits = np.zeros(tok.vocab_size, np.float32)
+            proc(out, logits)
+            nxt = int(np.argmax(logits))
+            if nxt in tok.eos_token_ids:
+                break
+            out.append(nxt)
+        assert out == [0, 1, 2, 0]  # '"', 0xC3, 0xA9, '"'
+        data = b"".join(token_bytes_for(tok)[t] for t in out)
+        assert data.decode("utf-8") == '"é"'

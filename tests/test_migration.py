@@ -118,3 +118,53 @@ class TestMigration:
             assert outs[-1].finish_reason == "length"
 
         run(body())
+
+
+class TestMigrationAccounting:
+    def test_replay_prompt_tokens_not_inflated(self, run):
+        """A replayed request's prompt embeds the tokens already generated
+        (and already billed as completion); the worker reports the raw
+        length, and Migration must subtract prior_output_tokens so usage
+        accounting stays at the original prompt size."""
+
+        class AccountingFlaky(TokenEngine):
+            def __init__(self, fail_times):
+                self.fail_times = fail_times
+                self.attempts = 0
+
+            async def generate(self, request):
+                self.attempts += 1
+                yield EngineOutput(token_ids=[100 * self.attempts],
+                                   prompt_tokens=len(request.token_ids))
+                if self.attempts <= self.fail_times:
+                    raise ConnectionLost("worker died")
+                yield EngineOutput(token_ids=[999], finish_reason="stop")
+
+        async def body():
+            inner = AccountingFlaky(fail_times=1)
+            migration = Migration(inner, migration_limit=3)
+            outs = [o async for o in migration.generate(_request())]
+            reported = [o.prompt_tokens for o in outs
+                        if o.prompt_tokens is not None]
+            # attempt 1 sees the 3-token prompt; the replay sees 4 raw
+            # (3 prompt + 1 prior output) and must report 3
+            assert reported == [3, 3]
+
+        run(body())
+
+    def test_migration_limit_honors_registry_knob(self, run, monkeypatch):
+        """The ModelWatcher builds Migration(engine, migration_limit=
+        env("DYNT_MIGRATION_LIMIT")); the knob must bound the retries."""
+        monkeypatch.setenv("DYNT_MIGRATION_LIMIT", "1")
+        from dynamo_tpu.runtime.config import env
+
+        async def body():
+            inner = FlakyEngine(fail_times=10)
+            migration = Migration(
+                inner, migration_limit=env("DYNT_MIGRATION_LIMIT"))
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=100))]
+            assert outs[-1].finish_reason == "error"
+            assert inner.attempts == 2  # initial + 1 retry
+
+        run(body())

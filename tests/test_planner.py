@@ -2,6 +2,7 @@
 load-based regression, metrics parsing, virtual connector (ref test areas:
 tests/planner/ + planner unit behavior in planner_core.py)."""
 
+import asyncio
 import math
 
 import numpy as np
@@ -437,3 +438,57 @@ class TestPreSweptProfiles:
         from dynamo_tpu.planner.interpolation import pre_swept_dir
 
         assert pre_swept_dir("no-such-model", "v5e") is None
+
+
+class TestLoadBasedPlannerLoop:
+    def test_run_loop_scales_decode_from_events(self, run):
+        """The planner CLI's --mode load driver: LoadMetrics events feed
+        the estimators and the loop applies the decode target through
+        the connector (makes step_wall_ms / *_tokens_in_step /
+        active_requests reachable — dynaflow DF302)."""
+        applied = []
+        cfg = PlannerConfig(adjustment_interval=0.01, itl_ms=20.0,
+                            min_endpoint=1, scale_down_sensitivity=0.5)
+        src = LoadEventSource()
+        pl = LoadBasedPlanner(
+            cfg,
+            CallbackConnector(lambda c, n: applied.append((c, n)),
+                              observe=lambda c: 2),
+            src)
+
+        async def body():
+            pl.start()
+            # a live worker keeps publishing fresh snapshots (replayed
+            # stale ones are identity-deduped by ingest)
+            for i in range(300):
+                src.on_event({"worker_id": 1, "dp_rank": 0,
+                              "step_wall_ms": 30.0 + i * 0.01,
+                              "decode_tokens_in_step": 8,
+                              "active_requests": 8})
+                await asyncio.sleep(0.005)
+                if applied:
+                    break
+            await pl.stop()
+            assert applied and applied[-1] == (cfg.decode_component, 3)
+
+        run(body())
+
+    def test_dead_worker_snapshot_expires(self):
+        """A worker that dies while busy must not pin its last high-load
+        snapshot forever (it would block scale-down indefinitely)."""
+        src = LoadEventSource(metrics_ttl=0.0)
+        src.on_event({"worker_id": 1, "dp_rank": 0, "active_requests": 9})
+        assert src.snapshots() == []
+        assert src.worker_count() == 0
+
+    def test_stale_snapshot_not_reingested(self):
+        cfg = PlannerConfig(itl_ms=20.0)
+        src = LoadEventSource()
+        pl = LoadBasedPlanner(cfg, CallbackConnector(lambda c, n: None),
+                              src)
+        src.on_event({"worker_id": 1, "dp_rank": 0, "step_wall_ms": 30.0,
+                      "decode_tokens_in_step": 8})
+        pl.ingest()
+        count = pl.itl_est.reg.num_observations
+        pl.ingest()  # same snapshot object: must not observe again
+        assert pl.itl_est.reg.num_observations == count

@@ -253,3 +253,42 @@ class TestHttpPlaneEndToEnd:
                 await rt_tcp.shutdown()
 
         run(body(), timeout=60.0)
+
+
+class TestPing:
+    """Client-side liveness probe: ping/pong round-trips the peer's frame
+    loop without dispatching a handler (the 'ping' arm the server always
+    had; dynaflow DF103 flagged the missing producer)."""
+
+    def test_ping_round_trips(self, run):
+        async def body():
+            server = await _start_server("tcp")
+            client = RequestClient()
+            rtt = await client._tcp.ping(server.address)
+            assert rtt >= 0.0
+            # ping consumes no endpoint and leaves no stream behind
+            assert not any(c.streams for c in client._tcp._conns.values())
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_ping_works_alongside_streams(self, run):
+        async def body():
+            server = await _start_server("tcp")
+
+            async def handler(req, ctx):
+                await asyncio.sleep(0.05)
+                yield {"ok": True}
+
+            server.registry.register("s/slow", handler)
+            client = RequestClient()
+            stream = client.call(server.address, "s/slow", {})
+            task = asyncio.ensure_future(anext(stream.__aiter__()))
+            rtt = await client._tcp.ping(server.address, timeout=2.0)
+            assert rtt < 2.0  # pong flows while the handler is busy
+            assert (await task) == {"ok": True}
+            await client.close()
+            await server.close()
+
+        run(body())

@@ -1,0 +1,99 @@
+"""dynaflow — interprocedural call-graph analysis for dynamo_tpu.
+
+Usage::
+
+    python -m tools.dynaflow dynamo_tpu/ [--format json]
+    python -m tools.dynaflow --schema-update   # bless a wire change
+    python -m tools.dynaflow --list-rules
+
+Extends the tools/dynalint driver (shared collector, suppression
+semantics, JSON output, CI gate) with whole-program passes the
+per-file rules cannot express: an import graph + approximate call
+graph over the tree powers protocol-conformance (DF1xx), lock-hazard
+(DF2xx), reachable-consumption (DF3xx), and config/metric registry
+(DF4xx) checks. Suppress on the flagged line with
+``# dynaflow: disable=DF201 -- justification``.
+See docs/static-analysis.md for the catalogue.
+"""
+
+from __future__ import annotations
+
+from tools.dynalint.core import (  # noqa: F401
+    Finding,
+    ProjectRule,
+    Registry,
+    Rule,
+    collect_files,
+    main_for,
+    render_json,
+    render_text,
+)
+from tools.dynalint.core import run as _run
+
+DYNAFLOW = Registry("dynaflow", "DF000")
+
+from . import passes_locks, passes_protocol, passes_reach, passes_registry
+from .passes_protocol import (  # noqa: F401
+    DEFAULT_PLANES,
+    SCHEMA_DIR,
+    Plane,
+    extract_schemas,
+    update_schemas,
+)
+
+for _cls in (
+    passes_protocol.WireKeyNeverRead,
+    passes_protocol.WireKeyNeverWritten,
+    passes_protocol.WireTagUnhandled,
+    passes_protocol.WireSchemaDrift,
+    passes_locks.SlowCallUnderLock,
+    passes_locks.LockOrderInversion,
+    passes_reach.UnreachableAcceptedField,
+    passes_reach.ProtocolFieldUnread,
+    passes_registry.UnregisteredEnvRead,
+    passes_registry.EnvDefaultTypeMismatch,
+    passes_registry.DeadConfigKnob,
+    passes_registry.DuplicateMetricName,
+    passes_registry.UndocumentedMetric,
+):
+    DYNAFLOW.register(_cls)
+
+__all__ = ["DYNAFLOW", "run", "all_rules", "main", "extract_schemas",
+           "update_schemas", "Plane", "DEFAULT_PLANES", "SCHEMA_DIR"]
+
+
+def all_rules():
+    return DYNAFLOW.all_rules()
+
+
+def run(paths, rules=None):
+    """Analyze `paths`; returns (findings after suppression, files)."""
+    return _run(paths, rules=rules, registry=DYNAFLOW)
+
+
+def main(argv=None) -> int:
+    def extra_args(parser):
+        parser.add_argument(
+            "--schema-update", action="store_true",
+            help="regenerate tools/dynaflow/schemas/ from the tree "
+                 "(the one-command path after a deliberate wire-format "
+                 "change) and exit")
+
+    def handle_extra(args):
+        if not args.schema_update:
+            return None
+        files, errors = collect_files(args.paths or ["dynamo_tpu"])
+        for err in errors:
+            print(f"{err.path}:{err.line}: {err.message}")
+        changed = update_schemas(files)
+        if changed:
+            print("updated schema snapshot(s): " + ", ".join(changed))
+        else:
+            print("schema snapshots already current")
+        return 1 if errors else 0
+
+    return main_for(
+        DYNAFLOW, ["dynamo_tpu"],
+        "interprocedural call-graph analysis for the dynamo_tpu "
+        "codebase", argv, extra_args=extra_args,
+        handle_extra=handle_extra)

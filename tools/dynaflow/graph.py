@@ -1,0 +1,192 @@
+"""Whole-program model: import graph + approximate call graph.
+
+The Rust reference gets interprocedural guarantees from its compiler —
+a message struct cannot drift between producer and consumer, an unused
+field is a warning, a lock misuse is a Send/Sync error. This module is
+the substrate dynaflow's passes recover those checks on: every file is
+parsed once, every function/method becomes a node, and call edges are
+resolved *by name* (a call `self.foo()` or `mod.foo()` links to every
+known function named `foo`; a bare reference handed to a wrapper like
+`Thread(target=f)` or `add_done_callback(cb)` links too). Name
+resolution over-approximates — which is the right direction for the
+passes built on it: reachability can only over-claim (fewer false
+"dead field" findings), and lock tracing can only over-trace (more
+hazards surfaced, reviewed once, suppressed with a justification if
+deliberate).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+from tools.dynalint.core import SourceFile
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str          # "rel::Class.method@line" / "rel::<module>"
+    name: str              # bare name ("method", "func", "<module>")
+    rel: str               # posix path of the defining file
+    cls: Optional[str]     # enclosing class name, if a method
+    node: ast.AST
+    lineno: int
+    calls: set[str] = dataclasses.field(default_factory=set)  # callee tails
+    refs: set[str] = dataclasses.field(default_factory=set)   # referenced names
+    attr_reads: set[str] = dataclasses.field(default_factory=set)
+    key_reads: set[str] = dataclasses.field(default_factory=set)
+    is_async: bool = False
+
+
+def call_tail(node: ast.Call) -> str:
+    """Last segment of the call target ('create_task' for
+    asyncio.create_task, 'send' for conn.send)."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def const_key(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class Project:
+    """Parsed view of a file set: functions by name, a name-resolved
+    call graph, and per-function read sets."""
+
+    def __init__(self, files: list[SourceFile]) -> None:
+        self.files = files
+        self.functions: dict[str, FunctionInfo] = {}
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        for src in files:
+            module_fn = FunctionInfo(
+                qualname=f"{src.rel}::<module>", name="<module>",
+                rel=src.rel, cls=None, node=src.tree, lineno=1)
+            self._collect_body(module_fn, src.tree, src, cls=None)
+            self._add(module_fn)
+
+    # -- construction ------------------------------------------------------
+
+    def _add(self, fn: FunctionInfo) -> None:
+        self.functions[fn.qualname] = fn
+        self.by_name.setdefault(fn.name, []).append(fn)
+
+    def _collect_body(self, owner: FunctionInfo, root: ast.AST,
+                      src: SourceFile, cls: Optional[str]) -> None:
+        """Attribute `root`'s scope to `owner`, collecting defs nested at
+        ANY depth (inside if/try/with/for too — a handler defined under
+        `if args.mode == ...:` is still a real function) as their own
+        nodes; every non-def node is recorded exactly once."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                prefix = f"{cls}." if cls else ""
+                fn = FunctionInfo(
+                    qualname=f"{src.rel}::{prefix}{node.name}"
+                             f"@{node.lineno}",
+                    name=node.name, rel=src.rel, cls=cls, node=node,
+                    lineno=node.lineno,
+                    is_async=isinstance(node, ast.AsyncFunctionDef))
+                self._collect_body(fn, node, src, cls=cls)
+                self._add(fn)
+                continue  # the definition itself is not an execution edge
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fn = FunctionInfo(
+                            qualname=f"{src.rel}::{node.name}.{sub.name}"
+                                     f"@{sub.lineno}",
+                            name=sub.name, rel=src.rel, cls=node.name,
+                            node=sub, lineno=sub.lineno,
+                            is_async=isinstance(sub, ast.AsyncFunctionDef))
+                        self._collect_body(fn, sub, src, cls=node.name)
+                        self._add(fn)
+                    else:
+                        stack.append(sub)
+                continue
+            self._record(owner, node)
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _record(owner: FunctionInfo, cur: ast.AST) -> None:
+        """Record one node's calls/refs/reads (children are walked by
+        the caller)."""
+        if isinstance(cur, ast.Call):
+            tail = call_tail(cur)
+            if tail:
+                owner.calls.add(tail)
+            if tail == "get" and cur.args:  # d.get("k") is a key read
+                key = const_key(cur.args[0])
+                if key is not None:
+                    owner.key_reads.add(key)
+        elif isinstance(cur, ast.Attribute):
+            if isinstance(cur.ctx, ast.Load):
+                owner.attr_reads.add(cur.attr)
+                owner.refs.add(cur.attr)
+        elif isinstance(cur, ast.Name):
+            if isinstance(cur.ctx, ast.Load):
+                owner.refs.add(cur.id)
+        elif isinstance(cur, ast.Subscript):
+            key = const_key(cur.slice)
+            if key is not None and isinstance(cur.ctx, ast.Load):
+                owner.key_reads.add(key)
+        elif isinstance(cur, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn))
+                   for op in cur.ops):  # '"k" in d' is a key read
+                key = const_key(cur.left)
+                if key is not None:
+                    owner.key_reads.add(key)
+
+    # -- queries -----------------------------------------------------------
+
+    def callees(self, fn: FunctionInfo,
+                refs_too: bool = True) -> Iterator[FunctionInfo]:
+        """Functions this one may invoke (name-resolved; with refs_too,
+        bare references handed to wrappers like Thread(target=...) count
+        as execution edges)."""
+        seen: set[str] = set()
+        names = fn.calls | fn.refs if refs_too else fn.calls
+        for name in names:
+            for cand in self.by_name.get(name, ()):
+                if cand.name == "<module>":
+                    continue
+                if cand.qualname not in seen:
+                    seen.add(cand.qualname)
+                    yield cand
+
+    def reachable(self, entries: list[FunctionInfo]) -> set[str]:
+        """Qualnames reachable from `entries` over name-resolved edges."""
+        out: set[str] = set()
+        stack = list(entries)
+        while stack:
+            fn = stack.pop()
+            if fn.qualname in out:
+                continue
+            out.add(fn.qualname)
+            stack.extend(c for c in self.callees(fn)
+                         if c.qualname not in out)
+        return out
+
+
+# One Project shared by every pass in a run (run() hands all rules the
+# same `files` list). The entry holds the keyed list itself so a freed
+# address reused by a different list can never serve a stale graph.
+_PROJECT_CACHE: dict[int, tuple[list, Project]] = {}
+
+
+def get_project(files: list[SourceFile]) -> Project:
+    hit = _PROJECT_CACHE.get(id(files))
+    if hit is not None and hit[0] is files:
+        return hit[1]
+    if len(_PROJECT_CACHE) > 8:
+        _PROJECT_CACHE.clear()
+    project = Project(files)
+    _PROJECT_CACHE[id(files)] = (files, project)
+    return project

@@ -1,0 +1,356 @@
+"""Pass 2 — lock hazards traced through direct callees.
+
+Rust's Send/Sync rules out whole classes of lock misuse at compile
+time; asyncio gives us nothing. Two interprocedural checks:
+
+* DF201 slow-call-under-lock: inside an `async def`, an `await` of a
+  known-slow operation (transport send/connect, subprocess, sleep,
+  to_thread, queue waits) while a tracked lock is held — including
+  slow awaits inside a *direct callee* of the locked region. Holding a
+  lock across a slow await serializes every other task on that lock
+  behind a network peer or the thread pool. Exemption: locks whose
+  name contains "send" may cover transport writes (`drain`, `send*`)
+  — serializing the transport is precisely what a send lock is for.
+
+* DF202 lock-order-inversion: two lock attributes acquired in both
+  orders somewhere in the tree (nested `with` blocks, traced one call
+  deep). Inconsistent pairwise order is the classic ABBA deadlock;
+  the reference's equivalents are reviewed lock hierarchies in
+  leader.rs/worker.rs.
+
+Tracked locks: `self.X = asyncio.Lock()/threading.Lock()/RLock()/
+Condition()` attributes (identity `Class.X`), module-level locks, and
+function-local locks. `with`/`async with` acquisitions only — the
+codebase idiom everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .graph import FunctionInfo, Project, call_tail, get_project
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# Awaited-call name tails considered slow while a lock is held.
+SLOW_TAILS = {
+    "sleep", "to_thread", "run_in_executor", "gather",
+    "open_connection", "connect", "create_subprocess_exec",
+    "create_subprocess_shell",
+    "drain", "send", "send_multipart", "recv_multipart",
+    "wait", "wait_for", "get", "put", "post", "request",
+    "read", "readexactly", "readline",
+}
+
+# Transport writes a send lock legitimately covers.
+_SEND_OK = {"drain", "send", "send_multipart", "write"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    scope: str  # class name, "<module>", or the function qualname
+    attr: str   # attribute / variable name
+
+    def __str__(self) -> str:
+        return f"{self.scope}.{self.attr}"
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and call_tail(node) in _LOCK_CTORS
+
+
+def collect_locks(files: list[SourceFile]) -> set[LockId]:
+    """All tracked lock identities in the tree."""
+    locks: set[LockId] = set()
+    for src in files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and _is_lock_ctor(sub.value):
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id == "self":
+                                locks.add(LockId(node.name, tgt.attr))
+        for stmt in src.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks.add(LockId("<module>", tgt.id))
+    return locks
+
+
+def _local_locks(fn: FunctionInfo) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _acquired(item: ast.withitem, fn: FunctionInfo,
+              locks: set[LockId], local: set[str]) -> Optional[LockId]:
+    expr = item.context_expr
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name) \
+            and expr.value.id == "self" and fn.cls is not None:
+        lid = LockId(fn.cls, expr.attr)
+        if lid in locks:
+            return lid
+    if isinstance(expr, ast.Name):
+        if expr.id in local:
+            return LockId(fn.qualname, expr.id)
+        lid = LockId("<module>", expr.id)
+        if lid in locks:
+            return lid
+    return None
+
+
+def _function_acquisitions(fn: FunctionInfo,
+                           locks: set[LockId]) -> set[LockId]:
+    """Attribute/module locks this function acquires anywhere (used for
+    one-call-deep tracing; local locks excluded — they are invisible to
+    callers)."""
+    out: set[LockId] = set()
+    local = _local_locks(fn)
+    for node in ast.walk(fn.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lid = _acquired(item, fn, locks, local)
+                if lid is not None and lid.scope != fn.qualname:
+                    out.add(lid)
+    return out
+
+
+def _slow_awaits(fn: FunctionInfo) -> list[tuple[ast.AST, str]]:
+    """(await-node, slow tail) pairs anywhere in this function."""
+    out = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Await) and isinstance(node.value,
+                                                      ast.Call):
+            tail = call_tail(node.value)
+            if tail in SLOW_TAILS:
+                out.append((node, tail))
+    return out
+
+
+def _call_base(node: ast.Call) -> tuple[str, str]:
+    """('self' | 'selfattr' | 'name' | 'bare', base descriptor) for
+    callee resolution: self.m() -> same class; self.X.m() -> the class
+    assigned to self.X; f() -> same file; anything else unresolved."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return "bare", ""
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            return "self", ""
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            return "selfattr", base.attr
+    return "other", ""
+
+
+def attr_classes(files: list[SourceFile]) -> dict[str, set[str]]:
+    """`self.X = ClassName(...)` assignments project-wide: attribute
+    name -> possible classes (one-step type inference for resolving
+    self.X.m() calls)."""
+    out: dict[str, set[str]] = {}
+    class_names = {n.name for src in files
+                   for n in ast.walk(src.tree)
+                   if isinstance(n, ast.ClassDef)}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            cls = call_tail(node.value)
+            if cls not in class_names:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    out.setdefault(tgt.attr, set()).add(cls)
+    return out
+
+
+def resolve_callees(project: Project, fn: FunctionInfo, node: ast.Call,
+                    attr_map: dict[str, set[str]]) -> list[FunctionInfo]:
+    """Direct callees of a call site, resolved conservatively (unlike
+    the reachability graph, which over-approximates on purpose)."""
+    tail = call_tail(node)
+    kind, base = _call_base(node)
+    cands = [c for c in project.by_name.get(tail, ())
+             if c.qualname != fn.qualname]
+    if kind == "self":
+        return [c for c in cands if c.cls == fn.cls and c.cls is not None]
+    if kind == "selfattr":
+        classes = attr_map.get(base, set())
+        return [c for c in cands if c.cls in classes]
+    if kind == "bare":
+        return [c for c in cands if c.rel == fn.rel and c.cls is None]
+    return []
+
+
+class _LockWalker:
+    """Walks one function tracking the held-lock stack; reports
+    acquisitions, slow awaits, and calls with the stack at that point.
+    Nested function/class defs are skipped (they run later, not under
+    the lock)."""
+
+    def __init__(self, fn: FunctionInfo, locks: set[LockId]) -> None:
+        self.fn = fn
+        self.locks = locks
+        self.local = _local_locks(fn)
+        self.events: list[tuple[str, ast.AST, object, tuple]] = []
+        self._walk(fn.node, ())
+
+    def _walk(self, node: ast.AST, held: tuple) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in child.items:
+                    lid = _acquired(item, self.fn, self.locks, self.local)
+                    if lid is not None:
+                        self.events.append(("acquire", child, lid, inner))
+                        inner = inner + (lid,)
+                self._walk(child, inner)
+                continue
+            if isinstance(child, ast.Await) \
+                    and isinstance(child.value, ast.Call):
+                tail = call_tail(child.value)
+                self.events.append(("await", child, tail, held))
+            if isinstance(child, ast.Call):
+                self.events.append(("call", child, child, held))
+            self._walk(child, held)
+
+
+def _send_exempt(lid: LockId, tail: str) -> bool:
+    return "send" in lid.attr.lower() and tail in _SEND_OK
+
+
+class SlowCallUnderLock(ProjectRule):
+    id = "DF201"
+    name = "slow-call-under-lock"
+    description = (
+        "an async function awaits a known-slow call (transport "
+        "send/connect, subprocess, sleep, to_thread, queue waits) while "
+        "holding a lock — traced through direct callees — serializing "
+        "every other task on that lock behind a peer or the thread "
+        "pool; locks named *send* are exempt for transport writes "
+        "(serializing the transport is their purpose)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        locks = collect_locks(files)
+        attr_map = attr_classes(files)
+        for fn in project.functions.values():
+            if not fn.is_async:
+                continue
+            walker = _LockWalker(fn, locks)
+            for kind, node, payload, held in walker.events:
+                if not held:
+                    continue
+                if kind == "await" and payload in SLOW_TAILS:
+                    bad = [lid for lid in held
+                           if not _send_exempt(lid, str(payload))]
+                    if bad:
+                        yield Finding(
+                            self.id, self.name, fn.rel, node.lineno,
+                            node.col_offset,
+                            f"await of slow call '{payload}' while "
+                            f"holding {', '.join(map(str, bad))} — "
+                            "move the slow operation outside the "
+                            "locked region")
+                elif kind == "call":
+                    # one call deep: a callee that awaits slow ops runs
+                    # them under our lock
+                    for callee in resolve_callees(project, fn, payload,
+                                                  attr_map):
+                        for sub_node, tail in _slow_awaits(callee):
+                            bad = [lid for lid in held
+                                   if not _send_exempt(lid, tail)]
+                            if bad:
+                                yield Finding(
+                                    self.id, self.name, fn.rel,
+                                    node.lineno, node.col_offset,
+                                    f"call to '{callee.name}' (which "
+                                    f"awaits slow call '{tail}' at "
+                                    f"{callee.rel}:{sub_node.lineno}) "
+                                    f"while holding "
+                                    f"{', '.join(map(str, bad))}")
+                                break  # one finding per callee
+
+
+class LockOrderInversion(ProjectRule):
+    id = "DF202"
+    name = "lock-order-inversion"
+    description = (
+        "two locks acquired in opposite orders somewhere across "
+        "engine/, block_manager/, and runtime/ (nested with-blocks, "
+        "traced one call deep): the classic ABBA deadlock the "
+        "reference avoids with reviewed lock hierarchies")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        locks = collect_locks(files)
+        attr_map = attr_classes(files)
+        # (outer, inner) -> first observed (rel, line, description)
+        orders: dict[tuple[LockId, LockId], tuple[str, int, str]] = {}
+        callee_locks: dict[str, set[LockId]] = {}
+        for fn in project.functions.values():
+            walker = _LockWalker(fn, locks)
+            for kind, node, payload, held in walker.events:
+                if kind == "acquire":
+                    for outer in held:
+                        self._note(orders, outer, payload, fn, node,
+                                   f"{outer} then {payload}")
+                elif kind == "call" and held:
+                    for callee in resolve_callees(project, fn, payload,
+                                                  attr_map):
+                        acq = callee_locks.get(callee.qualname)
+                        if acq is None:
+                            acq = _function_acquisitions(callee, locks)
+                            callee_locks[callee.qualname] = acq
+                        for inner in acq:
+                            for outer in held:
+                                self._note(
+                                    orders, outer, inner, fn, node,
+                                    f"{outer} then {inner} (via "
+                                    f"{callee.name})")
+        seen: set[frozenset] = set()
+        for (outer, inner), (rel, line, desc) in sorted(
+                orders.items(), key=lambda kv: (kv[1][0], kv[1][1])):
+            if (inner, outer) not in orders or outer == inner:
+                continue
+            pair = frozenset((outer, inner))
+            if pair in seen:
+                continue
+            seen.add(pair)
+            o_rel, o_line, o_desc = orders[(inner, outer)]
+            yield Finding(
+                self.id, self.name, rel, line, 0,
+                f"inconsistent lock order: {desc} here, but "
+                f"{o_desc} at {o_rel}:{o_line} — an ABBA deadlock "
+                "waiting for the right interleaving; pick one order")
+
+    @staticmethod
+    def _note(orders: dict, outer: LockId, inner, fn: FunctionInfo,
+              node: ast.AST, desc: str) -> None:
+        if outer == inner:
+            return
+        key = (outer, inner)
+        if key not in orders:
+            orders[key] = (fn.rel, node.lineno, desc)

@@ -1,0 +1,481 @@
+"""Pass 1 — protocol conformance across the wire planes.
+
+The request plane, event plane, KVBM step channel, and LLM token
+protocol are dict-shaped msgpack messages hand-built at send sites and
+pattern-matched at consumers; nothing but convention keeps the two
+sides agreeing (the reference gets this from serde derives). This pass
+extracts, per plane:
+
+  * the literal key-set written at every send site (dicts passed to the
+    plane's send functions, plus dicts returned from `to_wire`),
+  * the key-set read at every consumer (`msg["k"]`, `.get("k")`,
+    `"k" in msg` on the plane's receiver variables),
+  * the type-tag values produced and the dispatch arms consuming them
+    (`ftype == "req"` / `ftype in (...)` on a variable bound from the
+    tag key).
+
+Keys written but never read are dead payload (or a consumer that
+silently ignores data); keys read but never written are a handler that
+can never fire; a produced tag with no dispatch arm is a message the
+peer drops on the floor. A checked-in schema snapshot per plane
+(`tools/dynaflow/schemas/<plane>.json`) turns any drift into a CI diff:
+evolve a wire format deliberately with
+`python -m tools.dynaflow --schema-update`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .graph import call_tail, const_key
+
+SCHEMA_DIR = pathlib.Path(__file__).parent / "schemas"
+
+
+@dataclasses.dataclass(frozen=True)
+class Plane:
+    name: str
+    # rel-path suffixes of the files making up the plane
+    suffixes: tuple[str, ...]
+    # call-name tails that transmit a wire dict
+    send_fns: tuple[str, ...]
+    # variable/attribute names that hold a received wire dict
+    receivers: tuple[str, ...]
+    # header key carrying the message type tag, if the plane has one
+    tag_key: Optional[str] = None
+    # functions whose dict literals ARE wire messages (serializers):
+    # every dict built inside them counts as a send site
+    codec_fns: tuple[str, ...] = ("to_wire",)
+
+
+DEFAULT_PLANES = (
+    Plane("request_plane",
+          ("runtime/request_plane.py", "runtime/codec.py"),
+          ("write_frame", "encode_frame", "_send", "send", "_http_frame",
+           "put_nowait"),
+          ("header", "frame"),
+          tag_key="t"),
+    Plane("event_plane",
+          ("runtime/events.py", "kv_router/protocols.py"),
+          ("packb", "put", "_put_leased", "publish"),
+          ("frame", "data", "value")),
+    Plane("kvbm_distributed",
+          ("parallel/multihost.py", "block_manager/distributed.py"),
+          ("_send_frame", "publish"),
+          ("msg", "obj"),
+          codec_fns=("to_wire", "_enc")),
+    Plane("llm_protocol",
+          ("llm/protocols.py",),
+          (),
+          ("data",)),
+)
+
+
+@dataclasses.dataclass
+class PlaneSchema:
+    """Extracted wire shape of one plane."""
+
+    writes: dict[str, set[str]] = dataclasses.field(default_factory=dict)
+    reads: set[str] = dataclasses.field(default_factory=set)
+    dispatch: set[str] = dataclasses.field(default_factory=set)
+    # first write site per key / per tag, for finding locations
+    key_sites: dict[str, tuple[SourceFile, ast.AST]] = \
+        dataclasses.field(default_factory=dict)
+    tag_sites: dict[str, tuple[SourceFile, ast.AST]] = \
+        dataclasses.field(default_factory=dict)
+    matched_files: int = 0
+
+    def written_keys(self) -> set[str]:
+        out: set[str] = set()
+        for keys in self.writes.values():
+            out |= keys
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "writes": {tag: sorted(keys)
+                       for tag, keys in sorted(self.writes.items())},
+            "reads": sorted(self.reads),
+            "dispatch": sorted(self.dispatch),
+        }
+
+
+def _receiver_rooted(node: ast.expr, receivers: tuple[str, ...]) -> bool:
+    """True if the expression chain is rooted at a receiver variable:
+    msg[...], msg.get(...), data["s"]["b"], event.value.get(...)."""
+    cur = node
+    while True:
+        if isinstance(cur, ast.Subscript):
+            cur = cur.value
+        elif isinstance(cur, ast.Call) and isinstance(cur.func,
+                                                      ast.Attribute):
+            cur = cur.func.value
+        elif isinstance(cur, ast.Attribute):
+            return cur.attr in receivers
+        elif isinstance(cur, ast.Name):
+            return cur.id in receivers
+        else:
+            return False
+
+
+def _dict_literal_keys(node: ast.Dict) -> tuple[set[str], dict[str, ast.expr]]:
+    """Constant string keys of a dict literal (and nested dict-literal
+    values, flattened) plus the value expr per top-level key."""
+    keys: set[str] = set()
+    values: dict[str, ast.expr] = {}
+    for key_node, val in zip(node.keys, node.values):
+        key = const_key(key_node) if key_node is not None else None
+        if key is None:
+            continue
+        keys.add(key)
+        values[key] = val
+        if isinstance(val, ast.Dict):
+            sub, _ = _dict_literal_keys(val)
+            keys |= sub
+    return keys, values
+
+
+def extract_plane(plane: Plane, files: list[SourceFile]) -> PlaneSchema:
+    schema = PlaneSchema()
+    for src in files:
+        if not src.rel.endswith(plane.suffixes):
+            continue
+        schema.matched_files += 1
+        _extract_writes(plane, src, schema)
+        _extract_reads(plane, src, schema)
+    return schema
+
+
+def _record_wire_dict(plane: Plane, src: SourceFile, node: ast.Dict,
+                      schema: PlaneSchema) -> None:
+    keys, values = _dict_literal_keys(node)
+    if not keys:
+        return
+    tag = "*"
+    if plane.tag_key is not None and plane.tag_key in values:
+        const = const_key(values[plane.tag_key])
+        if const is not None:
+            tag = const
+            if const not in schema.tag_sites:
+                schema.tag_sites[const] = (src, node)
+    schema.writes.setdefault(tag, set()).update(keys)
+    for key in keys:
+        schema.key_sites.setdefault(key, (src, node))
+
+
+def _extract_writes(plane: Plane, src: SourceFile,
+                    schema: PlaneSchema) -> None:
+    # dict literals bound to a local that is later passed to a send fn
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_dicts: dict[str, ast.Dict] = {}
+        sent_names: set[str] = set()
+        # Inside a serializer (to_wire, a plane codec fn) every dict
+        # literal IS a wire message, including ones built up via
+        # `out = {...}` / `out["k"] = ...` and returned by name.
+        writer = fn.name in plane.codec_fns
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.targets[0], ast.Subscript):
+                if writer:  # out["k"] = ... inside a serializer
+                    key = const_key(node.targets[0].slice)
+                    if key is not None:
+                        schema.writes.setdefault("*", set()).add(key)
+                        schema.key_sites.setdefault(key, (src, node))
+                        if isinstance(node.value, ast.Dict):
+                            sub_keys, _ = _dict_literal_keys(node.value)
+                            schema.writes["*"] |= sub_keys
+                            for k in sub_keys:
+                                schema.key_sites.setdefault(
+                                    k, (src, node))
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                             ast.Dict):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_dicts[tgt.id] = node.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.value, ast.Dict) and isinstance(node.target,
+                                                         ast.Name):
+                local_dicts[node.target.id] = node.value
+            elif isinstance(node, ast.Call) \
+                    and call_tail(node) in plane.send_fns:
+                args = list(node.args) + [k.value for k in node.keywords]
+                for arg in args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Dict):
+                            _record_wire_dict(plane, src, sub, schema)
+                        elif isinstance(sub, ast.Name):
+                            sent_names.add(sub.id)
+            elif writer and isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Dict):
+                _record_wire_dict(plane, src, node.value, schema)
+        if writer:
+            for dct in local_dicts.values():
+                _record_wire_dict(plane, src, dct, schema)
+        for name in sent_names:
+            if name in local_dicts:
+                _record_wire_dict(plane, src, local_dicts[name], schema)
+
+
+def _extract_reads(plane: Plane, src: SourceFile,
+                   schema: PlaneSchema) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tag_vars: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _receiver_rooted(node, plane.receivers):
+                key = const_key(node.slice)
+                if key is not None:
+                    schema.reads.add(key)
+            elif isinstance(node, ast.Call) and call_tail(node) == "get" \
+                    and node.args and isinstance(node.func, ast.Attribute) \
+                    and _receiver_rooted(node.func.value, plane.receivers):
+                key = const_key(node.args[0])
+                if key is not None:
+                    schema.reads.add(key)
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn))
+                    for op in node.ops):
+                key = const_key(node.left)
+                if key is not None and node.comparators and \
+                        _receiver_rooted(node.comparators[0],
+                                         plane.receivers):
+                    schema.reads.add(key)
+        if plane.tag_key is None:
+            continue
+        # tag dispatch: vars bound from the tag key, compared to consts
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = node.value
+                bound = None
+                if isinstance(val, ast.Subscript) \
+                        and _receiver_rooted(val, plane.receivers):
+                    bound = const_key(val.slice)
+                elif isinstance(val, ast.Call) \
+                        and call_tail(val) == "get" and val.args \
+                        and isinstance(val.func, ast.Attribute) \
+                        and _receiver_rooted(val.func.value,
+                                             plane.receivers):
+                    bound = const_key(val.args[0])
+                if bound == plane.tag_key:
+                    tag_vars.add(node.targets[0].id)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            left = node.left
+            is_tag = (isinstance(left, ast.Name) and left.id in tag_vars) \
+                or (isinstance(left, ast.Call) and call_tail(left) == "get"
+                    and left.args and const_key(left.args[0])
+                    == plane.tag_key
+                    and isinstance(left.func, ast.Attribute)
+                    and _receiver_rooted(left.func.value, plane.receivers))
+            if not is_tag:
+                continue
+            for comp in node.comparators:
+                if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for elt in comp.elts:
+                        val = const_key(elt)
+                        if val is not None:
+                            schema.dispatch.add(val)
+                else:
+                    val = const_key(comp)
+                    if val is not None:
+                        schema.dispatch.add(val)
+
+
+# -- findings ----------------------------------------------------------------
+
+# One extraction shared by the four rules below (run() hands every rule
+# the same `files` list object). The cache entry holds the keyed list
+# itself: an id() alone could be recycled by a LATER list at the same
+# address once the first is freed, silently serving a stale schema.
+_CACHE: dict = {}
+
+
+def plane_schemas(files: list[SourceFile], planes: tuple[Plane, ...],
+                  ) -> dict[str, PlaneSchema]:
+    key = (id(files), planes)
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] is files:
+        return hit[1]
+    if len(_CACHE) > 8:
+        _CACHE.clear()
+    schemas = {p.name: extract_plane(p, files) for p in planes}
+    _CACHE[key] = (files, schemas)
+    return schemas
+
+
+def extract_schemas(files: list[SourceFile],
+                    planes: tuple[Plane, ...] = DEFAULT_PLANES,
+                    ) -> dict[str, PlaneSchema]:
+    return plane_schemas(files, planes)
+
+
+def update_schemas(files: list[SourceFile],
+                   schema_dir: pathlib.Path = SCHEMA_DIR,
+                   planes: tuple[Plane, ...] = DEFAULT_PLANES) -> list[str]:
+    """Regenerate the checked-in snapshots; returns changed plane names."""
+    schema_dir.mkdir(parents=True, exist_ok=True)
+    changed = []
+    for name, schema in extract_schemas(files, planes).items():
+        path = schema_dir / f"{name}.json"
+        payload = json.dumps(schema.to_json(), indent=2,
+                             sort_keys=True) + "\n"
+        if not path.exists() or path.read_text() != payload:
+            path.write_text(payload)
+            changed.append(name)
+    return changed
+
+
+class _PlaneRule(ProjectRule):
+    """Base for the protocol rules: plane config + finding helper."""
+
+    def __init__(self, planes: tuple[Plane, ...] = DEFAULT_PLANES) -> None:
+        self.planes = planes
+
+    def _finding(self, src: SourceFile, node: ast.AST,
+                 message: str) -> Finding:
+        return Finding(self.id, self.name, src.rel,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+    @staticmethod
+    def _plane_file(plane: Plane, files: list[SourceFile]) -> SourceFile:
+        return next(s for s in files if s.rel.endswith(plane.suffixes))
+
+
+class WireKeyNeverRead(_PlaneRule):
+    id = "DF101"
+    name = "wire-key-never-read"
+    description = (
+        "wire-dict key written at a send site but never read by any "
+        "consumer on the same plane: dead payload, or the reader was "
+        "lost to drift (the serde-derive mismatch Rust rejects at "
+        "compile time)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for plane in self.planes:
+            schema = plane_schemas(files, self.planes)[plane.name]
+            if not schema.matched_files:
+                continue
+            for key in sorted(schema.written_keys() - schema.reads):
+                src, node = schema.key_sites[key]
+                yield self._finding(
+                    src, node,
+                    f"[{plane.name}] wire key {key!r} is written here "
+                    "but no consumer on the plane ever reads it — dead "
+                    "payload, or the reader was lost to drift")
+
+
+class WireKeyNeverWritten(_PlaneRule):
+    id = "DF102"
+    name = "wire-key-never-written"
+    description = (
+        "wire-dict key read by a consumer but never written at any send "
+        "site on the same plane: the handler can never fire (producer "
+        "renamed or dropped the key)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for plane in self.planes:
+            schema = plane_schemas(files, self.planes)[plane.name]
+            if not schema.matched_files:
+                continue
+            for key in sorted(schema.reads - schema.written_keys()):
+                src = self._plane_file(plane, files)
+                yield self._finding(
+                    src, src.tree,
+                    f"[{plane.name}] wire key {key!r} is read by a "
+                    "consumer but no send site ever writes it — the "
+                    "read can never see data (producer drift?)")
+
+
+class WireTagUnhandled(_PlaneRule):
+    id = "DF103"
+    name = "wire-tag-unhandled"
+    description = (
+        "message type tag produced with no consumer dispatch arm (the "
+        "peer drops it on the floor), or dispatched but never produced "
+        "(dead handler arm) — the match-arm exhaustiveness Rust enums "
+        "give for free")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for plane in self.planes:
+            if plane.tag_key is None:
+                continue
+            schema = plane_schemas(files, self.planes)[plane.name]
+            if not schema.matched_files:
+                continue
+            produced = set(schema.writes) - {"*"}
+            for tag in sorted(produced - schema.dispatch):
+                src, node = schema.tag_sites[tag]
+                yield self._finding(
+                    src, node,
+                    f"[{plane.name}] message tag {plane.tag_key}="
+                    f"{tag!r} is produced here but no consumer "
+                    "dispatches on it — the peer drops it on the floor")
+            for tag in sorted(schema.dispatch - produced):
+                src = self._plane_file(plane, files)
+                yield self._finding(
+                    src, src.tree,
+                    f"[{plane.name}] a consumer dispatches on tag "
+                    f"{plane.tag_key}={tag!r} but no send site ever "
+                    "produces it — dead handler arm")
+
+
+class WireSchemaDrift(_PlaneRule):
+    id = "DF104"
+    name = "wire-schema-drift"
+    description = (
+        "a plane's extracted wire shape diverged from the checked-in "
+        "snapshot under tools/dynaflow/schemas/ — protocol changes must "
+        "be deliberate: run `python -m tools.dynaflow --schema-update` "
+        "and commit the resulting diff")
+
+    def __init__(self, planes: tuple[Plane, ...] = DEFAULT_PLANES,
+                 schema_dir: Optional[pathlib.Path] = SCHEMA_DIR) -> None:
+        super().__init__(planes)
+        self.schema_dir = schema_dir
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        if self.schema_dir is None:
+            return
+        for plane in self.planes:
+            schema = plane_schemas(files, self.planes)[plane.name]
+            if not schema.matched_files:
+                continue
+            src = self._plane_file(plane, files)
+            path = self.schema_dir / f"{plane.name}.json"
+            if not path.exists():
+                yield self._finding(
+                    src, src.tree,
+                    f"[{plane.name}] no schema snapshot at {path}; run "
+                    "`python -m tools.dynaflow --schema-update` and "
+                    "commit the result")
+                continue
+            want = json.loads(path.read_text())
+            got = schema.to_json()
+            if got == want:
+                continue
+            diffs = []
+            for section in ("writes", "reads", "dispatch"):
+                if got.get(section) != want.get(section):
+                    diffs.append(
+                        f"{section}: snapshot {want.get(section)!r} "
+                        f"!= tree {got.get(section)!r}")
+            yield self._finding(
+                src, src.tree,
+                f"[{plane.name}] wire format drifted from the "
+                f"checked-in snapshot ({'; '.join(diffs)}); if "
+                "deliberate, run `python -m tools.dynaflow "
+                "--schema-update` and commit the diff")

@@ -1,0 +1,201 @@
+"""Pass 3 — reachable consumption via call-graph reachability.
+
+PR 1's DL302 caught the min_p failure mode textually: a sampling field
+accepted by validate.py but never *mentioned* outside the parse layer.
+This pass generalizes it over the call graph: a mention in dead code is
+not consumption. Entry points are the places work actually enters the
+system — request-plane handler registrations, HTTP route handlers, and
+`main` functions — and a field counts as consumed only when a function
+*reachable* from an entry point reads it.
+
+* DF301 unreachable-accepted-field: a field accepted by
+  `llm/validate.py` (_COMMON_FIELDS) and carried by SamplingOptions /
+  StopConditions whose only reads outside the accept/parse layer sit in
+  unreachable code. Requests setting it validate cleanly and silently
+  get default behavior.
+
+* DF302 protocol-field-unread: a dataclass field in `llm/protocols.py`
+  or `kv_router/protocols.py` with no reachable reader outside its
+  defining file (attribute read or wire-dict key read). A field nothing
+  ever reads is dead weight on every message — or a consumer lost to
+  drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .graph import FunctionInfo, Project, call_tail, get_project
+
+# The accept/parse layer (same set as dynalint's DL302): mentions here
+# mean "accepted", not "consumed".
+PARSE_LAYER = ("llm/validate.py", "llm/protocols.py",
+               "llm/preprocessor.py", "llm/logits_processing.py")
+
+_ROUTE_FNS = {"register", "add_post", "add_get", "add_route", "add_put",
+              "add_delete", "add_patch"}
+_ENTRY_NAMES = {"main", "amain"}
+
+
+def entry_points(project: Project) -> list[FunctionInfo]:
+    """Where work enters: request-plane handler registrations, HTTP
+    routes, `main`s, and every module top (imports execute)."""
+    entries: list[FunctionInfo] = []
+    handler_names: set[str] = set()
+    for fn in project.functions.values():
+        if fn.name in _ENTRY_NAMES or fn.name == "<module>":
+            entries.append(fn)
+        # registrations anywhere in the subtree count — over-collecting
+        # across nested scopes only widens the entry set, the safe
+        # direction for a reachability gate
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) \
+                    and call_tail(node) in _ROUTE_FNS:
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Attribute):
+                        handler_names.add(arg.attr)
+                    elif isinstance(arg, ast.Name):
+                        handler_names.add(arg.id)
+    for name in handler_names:
+        entries.extend(project.by_name.get(name, ()))
+    return entries
+
+
+def _by_suffix(files: list[SourceFile], suffix: str) -> Optional[SourceFile]:
+    for src in files:
+        if src.rel.endswith(suffix):
+            return src
+    return None
+
+
+def _accepted_fields(src: SourceFile) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "_COMMON_FIELDS"
+                        for t in node.targets)):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    out.add(sub.value)
+    return out
+
+
+def _dataclass_fields(src: SourceFile,
+                      classes: Optional[set[str]] = None,
+                      ) -> dict[str, tuple[str, ast.AST]]:
+    """field name -> (class name, node) for @dataclass classes."""
+    out: dict[str, tuple[str, ast.AST]] = {}
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if classes is not None and cls.name not in classes:
+            continue
+        decorated = any("dataclass" in ast.unparse(
+            dec.func if isinstance(dec, ast.Call) else dec)
+            for dec in cls.decorator_list)
+        if not decorated:
+            continue
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                out.setdefault(stmt.target.id, (cls.name, stmt))
+    return out
+
+
+def entry_reachable(project: Project) -> set[str]:
+    """Reachable-from-entry-points set, computed once per Project (both
+    reach rules share it within a run)."""
+    cached = getattr(project, "_entry_reachable", None)
+    if cached is None:
+        cached = project.reachable(entry_points(project))
+        project._entry_reachable = cached
+    return cached
+
+
+class _ReachRule(ProjectRule):
+    def _reachable_readers(self, project: Project,
+                           reachable: set[str], field: str,
+                           exclude_rels: tuple[str, ...]) -> bool:
+        for qual in reachable:
+            fn = project.functions[qual]
+            if fn.rel.endswith(exclude_rels):
+                continue
+            if field in fn.attr_reads or field in fn.key_reads:
+                return True
+        return False
+
+
+class UnreachableAcceptedField(_ReachRule):
+    id = "DF301"
+    name = "unreachable-accepted-field"
+    description = (
+        "sampling/stop field accepted by llm/validate.py and carried by "
+        "SamplingOptions/StopConditions with no read in any function "
+        "reachable from an entry point (request-plane handlers, HTTP "
+        "routes, mains) outside the accept/parse layer — requests "
+        "setting it silently get default behavior (the min_p failure "
+        "mode, now checked over the call graph instead of textually)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        validate = _by_suffix(files, "llm/validate.py")
+        protocols = _by_suffix(files, "llm/protocols.py")
+        if validate is None or protocols is None:
+            return
+        project = get_project(files)
+        reachable = entry_reachable(project)
+        accepted = _accepted_fields(validate)
+        fields = _dataclass_fields(
+            protocols, {"SamplingOptions", "StopConditions"})
+        for field in sorted(accepted & set(fields)):
+            if self._reachable_readers(project, reachable, field,
+                                       PARSE_LAYER):
+                continue
+            cls, node = fields[field]
+            yield Finding(
+                self.id, self.name, protocols.rel, node.lineno,
+                node.col_offset,
+                f"accepted field {cls}.{field} has no reachable reader "
+                "outside the accept/parse layer — requests setting it "
+                "pass validation and silently get default behavior; "
+                "wire it into the engine path or stop accepting it")
+
+
+class ProtocolFieldUnread(_ReachRule):
+    id = "DF302"
+    name = "protocol-field-unread"
+    description = (
+        "dataclass field in llm/protocols.py or kv_router/protocols.py "
+        "with no reachable reader outside its defining file (attribute "
+        "or wire-key read): dead weight on every message, or a consumer "
+        "lost to drift — the dead-field warning the Rust compiler "
+        "emits for free")
+
+    PROTOCOL_FILES = ("llm/protocols.py", "kv_router/protocols.py")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        reachable: Optional[set[str]] = None
+        for suffix in self.PROTOCOL_FILES:
+            src = _by_suffix(files, suffix)
+            if src is None:
+                continue
+            if reachable is None:
+                reachable = entry_reachable(project)
+            for field, (cls, node) in sorted(
+                    _dataclass_fields(src).items()):
+                if self._reachable_readers(project, reachable, field,
+                                           (suffix,)):
+                    continue
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"protocol field {cls}.{field} has no reachable "
+                    "reader outside its defining file — dead weight on "
+                    "every message; read it somewhere real or remove "
+                    "it from the protocol")

@@ -1,0 +1,298 @@
+"""Pass 4 — config-knob and metric registry conformance.
+
+The reference registers every `DYN_*` env var in one place
+(environment_names.rs) and every metric name in prometheus_names.rs;
+the compiler then flags unused consts. Our equivalents:
+
+* DF401 unregistered-env-read: an `env("DYNT_*")` read (or raw
+  os.environ access of a DYNT_ name) that does not resolve to a
+  `runtime/config.py` `_register(...)` entry — it would raise KeyError
+  at runtime through `env()`, or silently bypass the registry raw.
+* DF402 env-default-type-mismatch: a registry entry whose declared
+  default's type disagrees with its parser (`_int` with a str default
+  means the env-set and default paths return different types).
+* DF403 dead-config-knob: a registered `DYNT_*` name never read
+  anywhere outside the registry — a knob operators can set that does
+  nothing (the unused-const warning the Rust compiler emits).
+* DF404 duplicate-metric-name: the same Prometheus metric name
+  registered twice (prometheus_client raises at import time in one
+  process, but duplicates across processes silently collide on shared
+  scrape pages).
+* DF405 undocumented-metric: a registered metric name missing from
+  docs/metrics.md — the scrape page is operator API surface; dynalint
+  DL303 already enforces the dynamo_ prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .graph import call_tail, const_key
+
+CONFIG_FILE = "runtime/config.py"
+METRICS_DOC = pathlib.Path(__file__).parent.parent.parent / "docs" / "metrics.md"
+
+_PARSER_TYPES = {
+    "_str": str, "str": str,
+    "_int": int, "int": int,
+    "_float": float, "float": float,
+    "_bool": bool, "is_truthy": bool,
+}
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+
+
+def _registry_entries(src: SourceFile) -> dict[str, tuple[ast.Call, str]]:
+    """env name -> (_register call node, parser name)."""
+    out: dict[str, tuple[ast.Call, str]] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and call_tail(node) == "_register" \
+                and node.args:
+            name = const_key(node.args[0])
+            if name is None:
+                continue
+            parser = ""
+            if len(node.args) >= 3:
+                p = node.args[2]
+                parser = p.attr if isinstance(p, ast.Attribute) else \
+                    getattr(p, "id", "")
+            out[name] = (node, parser)
+    return out
+
+
+def _env_reads(files: list[SourceFile], prefix: str,
+               ) -> list[tuple[SourceFile, ast.AST, str]]:
+    """Every env("NAME") call and raw os.environ/getenv access of a
+    `prefix`-named variable."""
+    out = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = call_tail(node)
+            name: Optional[str] = None
+            if tail == "env" and node.args:
+                name = const_key(node.args[0])
+            elif tail in ("getenv", "get") and node.args:
+                # os.getenv("X") / os.environ.get("X")
+                base = node.func
+                based = ast.unparse(base.value) if isinstance(
+                    base, ast.Attribute) else ""
+                if based in ("os", "os.environ", "environ"):
+                    name = const_key(node.args[0])
+            if name is not None and name.startswith(prefix):
+                out.append((src, node, name))
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Subscript) \
+                    and ast.unparse(node.value) in ("os.environ",
+                                                    "environ"):
+                name = const_key(node.slice)
+                if name is not None and name.startswith(prefix):
+                    out.append((src, node, name))
+    return out
+
+
+class _RegistryRule(ProjectRule):
+    def __init__(self, config_suffix: str = CONFIG_FILE,
+                 prefix: str = "DYNT_") -> None:
+        self.config_suffix = config_suffix
+        self.prefix = prefix
+
+    def _config(self, files: list[SourceFile]) -> Optional[SourceFile]:
+        for src in files:
+            if src.rel.endswith(self.config_suffix):
+                return src
+        return None
+
+
+class UnregisteredEnvRead(_RegistryRule):
+    id = "DF401"
+    name = "unregistered-env-read"
+    description = (
+        "a DYNT_* env read that does not resolve to a runtime/config.py "
+        "registry entry: env() raises KeyError at runtime, and raw "
+        "os.environ access bypasses the declared parser/default (the "
+        "reference registers every DYN_* name in environment_names.rs)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        config = self._config(files)
+        if config is None:
+            return
+        registered = set(_registry_entries(config))
+        for src, node, name in _env_reads(files, self.prefix):
+            if src.rel.endswith(self.config_suffix):
+                continue
+            if name not in registered:
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"env var {name!r} is read here but not registered "
+                    f"in {self.config_suffix}; register it with a "
+                    "typed default (env() will raise KeyError "
+                    "otherwise)")
+
+
+class EnvDefaultTypeMismatch(_RegistryRule):
+    id = "DF402"
+    name = "env-default-type-mismatch"
+    description = (
+        "a registry entry whose declared default's type disagrees with "
+        "its parser: with the env var unset callers get the default's "
+        "type, with it set they get the parser's — downstream code "
+        "breaks only in the configured case")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        config = self._config(files)
+        if config is None:
+            return
+        for name, (node, parser) in sorted(
+                _registry_entries(config).items()):
+            want = _PARSER_TYPES.get(parser)
+            if want is None or len(node.args) < 2:
+                continue
+            default = node.args[1]
+            if not isinstance(default, ast.Constant):
+                continue
+            val = default.value
+            if val is None:
+                continue
+            ok = isinstance(val, want) and not (
+                want in (int, float) and isinstance(val, bool))
+            if want is float and isinstance(val, int) \
+                    and not isinstance(val, bool):
+                ok = True  # int default for a float knob parses fine
+            if not ok:
+                yield Finding(
+                    self.id, self.name, config.rel, node.lineno,
+                    node.col_offset,
+                    f"knob {name!r}: default {val!r} is "
+                    f"{type(val).__name__} but the parser yields "
+                    f"{want.__name__} — unset and set reads disagree "
+                    "on type")
+
+
+class DeadConfigKnob(_RegistryRule):
+    id = "DF403"
+    name = "dead-config-knob"
+    description = (
+        "a registered DYNT_* knob whose name never appears outside "
+        "runtime/config.py: operators can set it and nothing changes — "
+        "the unused-const dead code the Rust compiler flags")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        config = self._config(files)
+        if config is None:
+            return
+        entries = _registry_entries(config)
+        used: set[str] = set()
+        for src in files:
+            if src.rel.endswith(self.config_suffix):
+                # uses inside config.py beyond the _register call itself
+                # (RuntimeConfig.from_env reads) still count
+                for node in ast.walk(src.tree):
+                    if isinstance(node, ast.Call) \
+                            and call_tail(node) == "env" and node.args:
+                        name = const_key(node.args[0])
+                        if name:
+                            used.add(name)
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value.startswith(self.prefix):
+                    used.add(node.value)
+        for name, (node, _) in sorted(entries.items()):
+            if name not in used:
+                yield Finding(
+                    self.id, self.name, config.rel, node.lineno,
+                    node.col_offset,
+                    f"knob {name!r} is registered but never read "
+                    "anywhere — wire it to the code it documents or "
+                    "remove the registration")
+
+
+class DuplicateMetricName(ProjectRule):
+    id = "DF404"
+    name = "duplicate-metric-name"
+    description = (
+        "the same Prometheus metric name registered at two sites: "
+        "within a process prometheus_client raises at import; across "
+        "processes the series silently collide on shared scrape pages")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        seen: dict[str, tuple[str, int]] = {}
+        for src in files:
+            if not _imports_prometheus(src):
+                continue
+            for node in ast.walk(src.tree):
+                name = _metric_name(node)
+                if name is None:
+                    continue
+                if name in seen:
+                    rel, line = seen[name]
+                    yield Finding(
+                        self.id, self.name, src.rel, node.lineno,
+                        node.col_offset,
+                        f"metric {name!r} already registered at "
+                        f"{rel}:{line}")
+                else:
+                    seen[name] = (src.rel, node.lineno)
+
+
+class UndocumentedMetric(ProjectRule):
+    id = "DF405"
+    name = "undocumented-metric"
+    description = (
+        "a registered Prometheus metric name missing from "
+        "docs/metrics.md: the scrape page is operator API surface — "
+        "document the metric or remove it")
+
+    def __init__(self, doc_path: pathlib.Path = METRICS_DOC) -> None:
+        self.doc_path = doc_path
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        metrics: list[tuple[SourceFile, ast.AST, str]] = []
+        for src in files:
+            if not _imports_prometheus(src):
+                continue
+            for node in ast.walk(src.tree):
+                name = _metric_name(node)
+                if name is not None:
+                    metrics.append((src, node, name))
+        if not metrics:
+            return
+        documented: set[str] = set()
+        if self.doc_path.exists():
+            documented = set(re.findall(r"`(\w+)`",
+                                        self.doc_path.read_text()))
+        for src, node, name in metrics:
+            if name not in documented:
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"metric {name!r} is not documented in "
+                    f"{self.doc_path.name} — the scrape page is "
+                    "operator API surface; add a row for it")
+
+
+def _metric_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call) \
+            and call_tail(node).split(".")[-1] in _METRIC_CTORS \
+            and len(node.args) >= 2:
+        return const_key(node.args[0])
+    return None
+
+
+def _imports_prometheus(src: SourceFile) -> bool:
+    return any(
+        (isinstance(n, ast.Import)
+         and any(a.name.split(".")[0] == "prometheus_client"
+                 for a in n.names))
+        or (isinstance(n, ast.ImportFrom)
+            and (n.module or "").split(".")[0] == "prometheus_client")
+        for n in ast.walk(src.tree))

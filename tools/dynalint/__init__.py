@@ -10,40 +10,23 @@ recompile traps (DL2xx), and wire-protocol / observability invariants
 
 from . import rules_async, rules_jax, rules_runtime  # noqa: F401 — register
 from .core import (  # noqa: F401
+    DYNALINT,
     Finding,
     ProjectRule,
+    Registry,
     Rule,
     all_rules,
+    main_for,
     render_json,
     render_text,
     run,
 )
 
-__all__ = ["Finding", "Rule", "ProjectRule", "all_rules", "run",
-           "render_text", "render_json", "main"]
+__all__ = ["Finding", "Rule", "ProjectRule", "Registry", "DYNALINT",
+           "all_rules", "run", "render_text", "render_json", "main"]
 
 
 def main(argv=None) -> int:
-    import argparse
-
-    parser = argparse.ArgumentParser(
-        prog="python -m tools.dynalint",
-        description="AST-based hazard linter for the dynamo_tpu codebase")
-    parser.add_argument("paths", nargs="*", default=["dynamo_tpu"],
-                        help="files or directories to lint "
-                             "(default: dynamo_tpu)")
-    parser.add_argument("--format", choices=("text", "json"),
-                        default="text")
-    parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule catalogue and exit")
-    args = parser.parse_args(argv)
-
-    if args.list_rules:
-        for rule in all_rules():
-            print(f"{rule.id} [{rule.name}]\n    {rule.description}")
-        return 0
-
-    findings, files_checked = run(args.paths or ["dynamo_tpu"])
-    render = render_json if args.format == "json" else render_text
-    print(render(findings, files_checked))
-    return 1 if findings else 0
+    return main_for(
+        DYNALINT, ["dynamo_tpu"],
+        "AST-based hazard linter for the dynamo_tpu codebase", argv)

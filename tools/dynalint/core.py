@@ -72,52 +72,69 @@ class ProjectRule(Rule):
         return ()
 
 
-_RULES: dict[str, Rule] = {}
+class Registry:
+    """One linter's rule catalogue + suppression dialect. dynalint and
+    dynaflow each hold one; the driver, suppression semantics, and
+    output formats are shared through it."""
+
+    def __init__(self, tool: str, bad_id: str) -> None:
+        self.tool = tool  # suppression marker: `# <tool>: disable=...`
+        self.bad_id = bad_id  # the bad-suppression rule id (DL000/DF000)
+        self.rules: dict[str, Rule] = {}
+        self._suppress_re = re.compile(
+            rf"#\s*{re.escape(tool)}:\s*disable=([^#]*)")
+
+    def register(self, cls: type) -> type:
+        rule = cls()
+        if rule.id in self.rules:
+            raise ValueError(f"duplicate rule id {rule.id}")
+        self.rules[rule.id] = rule
+        return cls
+
+    def all_rules(self) -> list[Rule]:
+        return [self.rules[k] for k in sorted(self.rules)]
+
+    def known_tokens(self) -> set[str]:
+        out = {self.bad_id, "bad-suppression"}
+        for rule in self.rules.values():
+            out.add(rule.id)
+            out.add(rule.name)
+        return out
+
+    def suppressions(self, lines: list[str],
+                     rel: str) -> tuple[dict, list]:
+        """Per-line suppressed rule tokens plus bad-suppression findings
+        for unknown rule names (a typo'd suppression must not silently
+        disable nothing)."""
+        known = self.known_tokens()
+        per_line: dict[int, set[str]] = {}
+        bad: list[Finding] = []
+        for i, text in enumerate(lines, start=1):
+            m = self._suppress_re.search(text)
+            if not m:
+                continue
+            # Everything after ` -- ` is the justification, not rules.
+            spec = m.group(1).split("--", 1)[0]
+            tokens = {t.strip() for t in spec.split(",") if t.strip()}
+            for tok in sorted(tokens - known):
+                bad.append(Finding(
+                    self.bad_id, "bad-suppression", rel, i, m.start(),
+                    f"suppression names unknown rule {tok!r}; known "
+                    "rules: "
+                    + ", ".join(sorted(r.id for r in self.rules.values()))))
+            per_line[i] = tokens & known
+        return per_line, bad
+
+
+DYNALINT = Registry("dynalint", "DL000")
 
 
 def register(cls: type) -> type:
-    rule = cls()
-    if rule.id in _RULES:
-        raise ValueError(f"duplicate rule id {rule.id}")
-    _RULES[rule.id] = rule
-    return cls
+    return DYNALINT.register(cls)
 
 
 def all_rules() -> list[Rule]:
-    return [_RULES[k] for k in sorted(_RULES)]
-
-
-def _known_tokens() -> set[str]:
-    out = {"DL000", "bad-suppression"}
-    for rule in _RULES.values():
-        out.add(rule.id)
-        out.add(rule.name)
-    return out
-
-
-_SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*disable=([^#]*)")
-
-
-def _suppressions(lines: list[str], rel: str) -> tuple[dict, list]:
-    """Per-line suppressed rule tokens plus DL000 findings for unknown
-    rule names (a typo'd suppression must not silently disable nothing)."""
-    known = _known_tokens()
-    per_line: dict[int, set[str]] = {}
-    bad: list[Finding] = []
-    for i, text in enumerate(lines, start=1):
-        m = _SUPPRESS_RE.search(text)
-        if not m:
-            continue
-        # Everything after ` -- ` is the human justification, not rules.
-        spec = m.group(1).split("--", 1)[0]
-        tokens = {t.strip() for t in spec.split(",") if t.strip()}
-        for tok in sorted(tokens - known):
-            bad.append(Finding(
-                "DL000", "bad-suppression", rel, i, m.start(),
-                f"suppression names unknown rule {tok!r}; known rules: "
-                + ", ".join(sorted(r.id for r in _RULES.values()))))
-        per_line[i] = tokens & known
-    return per_line, bad
+    return DYNALINT.all_rules()
 
 
 def collect_files(paths: list[str]) -> tuple[list[SourceFile], list[Finding]]:
@@ -154,13 +171,14 @@ def collect_files(paths: list[str]) -> tuple[list[SourceFile], list[Finding]]:
 
 
 def run(paths: list[str],
-        rules: Optional[list[Rule]] = None) -> tuple[list[Finding], int]:
+        rules: Optional[list[Rule]] = None,
+        registry: Registry = DYNALINT) -> tuple[list[Finding], int]:
     """Lint `paths`; returns (findings after suppression, files checked)."""
-    rules = all_rules() if rules is None else rules
+    rules = registry.all_rules() if rules is None else rules
     files, findings = collect_files(paths)
     suppress: dict[str, dict[int, set[str]]] = {}
     for src in files:
-        per_line, bad = _suppressions(src.lines, src.rel)
+        per_line, bad = registry.suppressions(src.lines, src.rel)
         suppress[src.rel] = per_line
         findings.extend(bad)
         for rule in rules:
@@ -175,22 +193,62 @@ def run(paths: list[str],
     return sorted(kept, key=lambda f: (f.path, f.line, f.rule)), len(files)
 
 
-def render_text(findings: list[Finding], files_checked: int) -> str:
+def render_text(findings: list[Finding], files_checked: int,
+                registry: Registry = DYNALINT) -> str:
     out = [f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.name}] {f.message}"
            for f in findings]
     out.append(f"{len(findings)} finding(s) in {files_checked} file(s) "
-               f"({len(_RULES)} rules)")
+               f"({len(registry.rules)} rules)")
     return "\n".join(out)
 
 
-def render_json(findings: list[Finding], files_checked: int) -> str:
+def render_json(findings: list[Finding], files_checked: int,
+                registry: Registry = DYNALINT) -> str:
     return json.dumps({
         "version": 1,
         "files_checked": files_checked,
         "rules": [{"id": r.id, "name": r.name,
-                   "description": r.description} for r in all_rules()],
+                   "description": r.description}
+                  for r in registry.all_rules()],
         "findings": [f.to_json() for f in findings],
     }, indent=2)
+
+
+def main_for(registry: Registry, default_paths: list[str],
+             description: str, argv=None,
+             extra_args=None, handle_extra=None) -> int:
+    """Shared CLI driver: paths, --format, --list-rules, exit codes.
+    `extra_args(parser)` may add tool-specific flags; `handle_extra(args)`
+    may fully handle them (return an exit code, or None to proceed)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog=f"python -m tools.{registry.tool}", description=description)
+    parser.add_argument("paths", nargs="*", default=list(default_paths),
+                        help="files or directories to lint "
+                             f"(default: {' '.join(default_paths)})")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    if extra_args is not None:
+        extra_args(parser)
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in registry.all_rules():
+            print(f"{rule.id} [{rule.name}]\n    {rule.description}")
+        return 0
+    if handle_extra is not None:
+        code = handle_extra(args)
+        if code is not None:
+            return code
+
+    findings, files_checked = run(args.paths or list(default_paths),
+                                  registry=registry)
+    render = render_json if args.format == "json" else render_text
+    print(render(findings, files_checked, registry))
+    return 1 if findings else 0
 
 
 # -- shared AST helpers ------------------------------------------------------
