@@ -719,11 +719,13 @@ class TpuWorker:
 
     # -- disaggregation: decode-side onboard -------------------------------
 
-    async def _pull_remote_kv(self, params: dict):
+    async def _pull_remote_kv(self, params: dict, deadline=None):
         """Pull prefill KV blocks from the prefill worker. Returns the
         assembled bundle or None (caller falls back to local prefill —
         the aggregated-recompute fallback the reference also takes when
-        transfer fails)."""
+        transfer fails). `deadline` is the request's REMAINING end-to-end
+        budget (ctx.deadline): the pull's frame waits are bounded by it
+        instead of a fresh flat timeout."""
         from ..runtime.push_router import PushRouter
 
         if params.get("mock") or "layout" not in params:
@@ -757,6 +759,7 @@ class TpuWorker:
             async for frame in router.generate(
                 {"transfer_id": params["transfer_id"]},
                 instance_id=params["instance_id"],
+                deadline=deadline,
             ):
                 if frame.get("error"):
                     log.warning("kv pull failed: %s", frame["error"])
@@ -879,7 +882,9 @@ class TpuWorker:
                     on_prefill_done=self._register_transfer,
                 )
             elif request.disaggregated_params:
-                blocks = await self._pull_remote_kv(request.disaggregated_params)
+                blocks = await self._pull_remote_kv(
+                    request.disaggregated_params,
+                    deadline=ctx.deadline if ctx is not None else None)
                 if blocks is not None:
                     submit_kwargs.update(
                         onboard_blocks=blocks,

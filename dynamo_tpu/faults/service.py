@@ -95,7 +95,12 @@ class _DelayProxy:
         self._server = await asyncio.start_server(
             self._handle, "127.0.0.1", self.listen_port)
 
-    async def _pipe(self, reader, writer) -> None:
+    async def _pipe(self, reader, writer) -> bool:
+        """Forward one direction. Returns True on clean EOF — the forward
+        side is HALF-closed (write_eof) so the opposite direction keeps
+        flowing, exactly like a real link: a client that shut down its
+        write side still awaits the response. Returns False on error, and
+        _handle then tears down BOTH legs deterministically."""
         try:
             while True:
                 chunk = await reader.read(65536)
@@ -105,12 +110,15 @@ class _DelayProxy:
                 writer.write(chunk)
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
-            pass
-        finally:
-            try:
+            return False
+        try:
+            if writer.can_write_eof():
+                writer.write_eof()
+            else:
                 writer.close()
-            except Exception:  # noqa: BLE001
-                pass
+        except (OSError, RuntimeError):
+            return False
+        return True
 
     async def _handle(self, reader, writer) -> None:
         try:
@@ -119,10 +127,27 @@ class _DelayProxy:
             writer.close()
             return
         self._writers.update((writer, up_w))
+        legs = {asyncio.create_task(self._pipe(reader, up_w)),
+                asyncio.create_task(self._pipe(up_r, writer))}
         try:
-            await asyncio.gather(self._pipe(reader, up_w),
-                                 self._pipe(up_r, writer))
+            while legs:
+                done, legs = await asyncio.wait(
+                    legs, return_when=asyncio.FIRST_COMPLETED)
+                if any(t.result() is False for t in done) and legs:
+                    # One leg failed: propagate to the other leg too —
+                    # a broken pipe must look broken from BOTH sides, in
+                    # the same order every run (no half-dead lingering).
+                    for t in legs:
+                        t.cancel()
+                    await asyncio.wait(legs)
+                    legs = set()
         finally:
+            # Clean EOFs on both directions (or teardown): full-close now.
+            for w in (writer, up_w):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
             self._writers.difference_update((writer, up_w))
 
     async def stop(self) -> None:

@@ -18,8 +18,10 @@ from typing import AsyncIterator, Optional
 
 from ..kv_router import KvScheduler, WorkerWithDpRank
 from ..runtime.logging import get_logger
+from ..runtime.metrics import DEADLINE_EXCEEDED
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.request_plane import ConnectionLost, RemoteError
+from ..runtime.resilience import RetryPolicy
 from ..tokens import compute_block_hashes
 from .protocols import EngineOutput, PreprocessedRequest
 
@@ -57,7 +59,7 @@ class RouterEngine(TokenEngine):
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
         async for item in self.router.generate(
                 request.to_wire(), instance_id=_pinned_instance(request),
-                allowed=self._allowed(request)):
+                allowed=self._allowed(request), deadline=request.deadline):
             yield EngineOutput.from_wire(item)
 
 
@@ -123,7 +125,8 @@ class KvRouterEngine(TokenEngine):
             # contract): direct route, no booking — the picker's view of
             # load already includes this request.
             async for item in self.router.generate(
-                    request.to_wire(), instance_id=pinned_instance):
+                    request.to_wire(), instance_id=pinned_instance,
+                    deadline=request.deadline):
                 yield EngineOutput.from_wire(item)
             return
         avail = self.router.available()
@@ -156,7 +159,8 @@ class KvRouterEngine(TokenEngine):
         first = True
         try:
             async for item in self.router.generate(
-                request.to_wire(), instance_id=result.worker.worker_id
+                request.to_wire(), instance_id=result.worker.worker_id,
+                deadline=request.deadline,
             ):
                 if first:
                     self.scheduler.mark_prefill_completed(request_id)
@@ -215,15 +219,22 @@ class MultimodalEngine(TokenEngine):
 class Migration(TokenEngine):
     """Retry a broken stream on another worker, preserving generated tokens
     (ref: lib/llm/src/migration.rs:36 — accumulated tokens are replayed so
-    decode continues where it left off; bounded by migration_limit)."""
+    decode continues where it left off; bounded by migration_limit AND the
+    request's end-to-end deadline: every replay consumes the remaining
+    budget — propagated down through the router's headers — instead of a
+    fresh flat timeout, and backoff between replays is jittered by a
+    RetryPolicy)."""
 
-    def __init__(self, inner: TokenEngine, migration_limit: int = 3) -> None:
+    def __init__(self, inner: TokenEngine, migration_limit: int = 3,
+                 retry_policy: Optional[RetryPolicy] = None) -> None:
         self.inner = inner
         self.migration_limit = migration_limit
+        self.policy = retry_policy or RetryPolicy.from_env()
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
         generated: list[int] = []
         attempts = 0
+        prev_delay: Optional[float] = None
         current = request
         while True:
             try:
@@ -255,6 +266,17 @@ class Migration(TokenEngine):
                     yield EngineOutput(finish_reason="error",
                                        error=f"migration limit exceeded: {exc}")
                     return
+                if request.deadline is not None and request.deadline.expired():
+                    # No budget left to replay into: the client has
+                    # already given up — surface the overrun instead of
+                    # burning another worker slot.
+                    DEADLINE_EXCEEDED.labels(component="migration").inc()
+                    log.warning("deadline exceeded migrating %s: %r",
+                                request.request_id, exc)
+                    yield EngineOutput(
+                        finish_reason="error",
+                        error=f"deadline exceeded during migration: {exc}")
+                    return
                 remaining = request.sampling.max_tokens - len(generated)
                 if remaining <= 0:
                     yield EngineOutput(finish_reason="length")
@@ -276,5 +298,13 @@ class Migration(TokenEngine):
                     lora_name=request.lora_name,
                     media_hashes=request.media_hashes,
                     media_embeddings=request.media_embeddings,
+                    # Guided decoding / custom processors must survive the
+                    # replay or the continuation decodes unconstrained.
+                    logits_processors=request.logits_processors,
+                    deadline=request.deadline,
                 )
-                await asyncio.sleep(0.05 * attempts)
+                delay = self.policy.next_delay(prev_delay)
+                prev_delay = delay
+                if request.deadline is not None:
+                    delay = request.deadline.bound(delay)
+                await asyncio.sleep(delay)

@@ -26,9 +26,11 @@ from typing import AsyncIterator, Optional
 from aiohttp import web
 
 from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
 from ..runtime.logging import current_request_id, get_logger
 from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
+from ..runtime.resilience import Deadline, DeadlineExceeded
 from .manager import ModelEntry, ModelManager
 from .preprocessor import DeltaGenerator, RequestError
 from .protocols import (
@@ -99,10 +101,37 @@ class HttpService:
             if iid in entry.worker_usage
         ]
         if usages and min(usages) >= threshold:
+            rt_metrics.REQUESTS_SHED.labels(reason="busy").inc()
             raise web.HTTPServiceUnavailable(
                 text=json.dumps(_error_body(503, "service busy", "overloaded")),
                 content_type="application/json",
+                headers={"Retry-After": "1"},
             )
+
+    def _admit_deadline(self, request: web.Request) -> Optional[Deadline]:
+        """Derive the request's end-to-end Deadline: an upstream-propagated
+        x-dynt-deadline-ms header wins; otherwise DYNT_DEADLINE_SECS (0
+        disables). A budget already spent on arrival is shed immediately
+        with 503 + Retry-After — dispatching it would occupy a worker for
+        a client that has already timed out ('The Tail at Scale'
+        admission control)."""
+        # HTTP headers are case-insensitive; Deadline.from_wire keys are
+        # canonical lowercase.
+        deadline = Deadline.from_wire(
+            {k.lower(): v for k, v in request.headers.items()})
+        if deadline is None:
+            budget = env("DYNT_DEADLINE_SECS")
+            if budget and budget > 0:
+                deadline = Deadline(budget)
+        if deadline is not None and deadline.expired():
+            rt_metrics.REQUESTS_SHED.labels(reason="deadline").inc()
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps(_error_body(
+                    503, "request deadline already spent", "overloaded")),
+                content_type="application/json",
+                headers={"Retry-After": "1"},
+            )
+        return deadline
 
     # -- handlers ----------------------------------------------------------
 
@@ -148,6 +177,7 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
+        deadline = self._admit_deadline(request)
         pre_start = time.monotonic()
         try:
             if kind == "chat":
@@ -160,6 +190,7 @@ class HttpService:
                                          model=model).observe(
             time.monotonic() - pre_start)
         preprocessed.lora_name = lora
+        preprocessed.deadline = deadline
         # W3C trace-context propagation + span export: the frontend opens a
         # SERVER span (child of any incoming traceparent) and re-injects
         # ITS OWN context into the request annotations, so worker spans
@@ -280,7 +311,11 @@ class HttpService:
         except NoInstancesAvailable:
             return web.json_response(
                 _error_body(503, "no workers available", "overloaded"),
-                status=503)
+                status=503, headers={"Retry-After": "1"})
+        except DeadlineExceeded as exc:
+            rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            return web.json_response(
+                _error_body(504, str(exc), "deadline_exceeded"), status=504)
         except RemoteError as exc:
             return web.json_response(
                 _error_body(502, str(exc), "engine_error"), status=502)
@@ -367,6 +402,11 @@ class HttpService:
         except NoInstancesAvailable:
             await response.write(
                 f"data: {json.dumps(_error_body(503, 'no workers available'))}\n\n".encode())
+            await response.write(b"data: [DONE]\n\n")
+        except DeadlineExceeded as exc:
+            rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            await response.write(
+                f"data: {json.dumps(_error_body(504, str(exc), 'deadline_exceeded'))}\n\n".encode())
             await response.write(b"data: [DONE]\n\n")
         except RemoteError as exc:
             # Emit an OpenAI-shaped error event then terminate the stream

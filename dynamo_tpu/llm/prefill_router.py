@@ -20,6 +20,7 @@ from typing import AsyncIterator, Callable, Optional
 
 from ..runtime.logging import get_logger
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
+from ..runtime.resilience import DeadlineExceeded
 from .engine import TokenEngine
 from .protocols import EngineOutput, PreprocessedRequest, SamplingOptions
 
@@ -66,8 +67,12 @@ class PrefillRouterEngine(TokenEngine):
             except ValueError:
                 log.warning("bad prefill_instance annotation %r", raw)
         try:
+            # The prefill leg draws on the request's REMAINING budget
+            # (router re-encodes it per attempt) — a slow prefill pool
+            # can no longer eat more than the end-to-end deadline.
             async for item in pool.router.generate(prefill_request.to_wire(),
-                                                   instance_id=target):
+                                                   instance_id=target,
+                                                   deadline=request.deadline):
                 out = EngineOutput.from_wire(item)
                 if out.error:
                     log.warning("prefill worker error for %s: %s",
@@ -75,6 +80,10 @@ class PrefillRouterEngine(TokenEngine):
                     return None
                 if out.kv_transfer_params is not None:
                     return out.kv_transfer_params
+        except DeadlineExceeded:
+            # No budget left: the decode leg could not finish either —
+            # surface the overrun instead of burning a recompute.
+            raise
         except Exception as exc:  # noqa: BLE001 — any prefill-leg failure
             # (incl. NoInstancesAvailable) degrades to aggregated serving
             log.warning("prefill leg failed for %s (%r); aggregated fallback",

@@ -91,6 +91,13 @@ class PreprocessedRequest:
     # Logits-processor specs (names or {"name","args"}) resolved against
     # the worker's registry (llm/logits_processing.py)
     logits_processors: list = dataclasses.field(default_factory=list)
+    # End-to-end budget (runtime/resilience.py Deadline), stamped by the
+    # frontend at admission. NOT serialized by to_wire: it crosses the
+    # request plane as the x-dynt-deadline-ms header (re-encoded as
+    # remaining-ms per hop), and the worker side reads it from its
+    # RequestContext — this field only rides the in-process pipeline
+    # (router, migration, prefill legs).
+    deadline: Optional[Any] = None
 
     def kv_salt(self) -> Optional[int]:
         """Perturbs block-hash chaining for anything beyond token ids that

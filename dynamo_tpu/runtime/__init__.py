@@ -21,11 +21,21 @@ from .request_plane import (
     RemoteError,
     RequestContext,
 )
+from .resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryPolicy,
+)
 
 __all__ = [
+    "CircuitBreaker",
     "Client",
     "Component",
     "ConnectionLost",
+    "Deadline",
+    "DeadlineExceeded",
     "Discovery",
     "DistributedRuntime",
     "Endpoint",
@@ -41,6 +51,8 @@ __all__ = [
     "PushRouter",
     "RemoteError",
     "RequestContext",
+    "RetryBudget",
+    "RetryPolicy",
     "RuntimeConfig",
     "configure_logging",
     "env",

@@ -86,10 +86,14 @@ _register("DYNT_CONNECT_TIMEOUT_SECS", 5.0, _float,
 _register("DYNT_STREAM_IDLE_TIMEOUT_SECS", 120.0, _float,
           "Max gap between response frames on a streaming request before "
           "the client declares the worker black-holed (network partition/"
-          "SIGSTOP: the connection stays open but nothing flows). Fires "
+          "SIGSTOP: the connection stays open but nothing flows). Also "
+          "bounds the wait for the FIRST frame when no first-item "
+          "timeout is set, so a fresh request to a black-holed worker "
+          "fails over instead of hanging until lease expiry. Fires "
           "asyncio.TimeoutError -> the router fault-marks the instance "
           "and Migration replays the stream on a peer. Must exceed the "
-          "longest legitimate inter-token stall (a cold mid-stream "
+          "longest legitimate inter-token stall AND the worst-case "
+          "admission-queue + prefill latency to first token (a cold "
           "compile). 0 disables")
 
 # Event plane
@@ -193,7 +197,35 @@ _register("DYNT_OTLP_ENDPOINT", "", _str,
 _register("DYNT_OTEL_SERVICE_NAME", "dynamo_tpu", _str,
           "service.name resource attribute on exported spans")
 
-# Fault tolerance
+# Fault tolerance — resilience plane (runtime/resilience.py; knob
+# semantics and the degradation ladder in docs/fault-tolerance.md)
+_register("DYNT_DEADLINE_SECS", 600.0, _float,
+          "Default end-to-end request deadline the frontend stamps when "
+          "the caller sends no x-dynt-deadline-ms header. Propagated as "
+          "remaining-ms on every request-plane hop; migration replay, "
+          "prefill legs and KV-transfer waits all consume the remainder "
+          "instead of fresh flat timeouts. 0 disables deadlines")
+_register("DYNT_RETRY_BUDGET_RATIO", 0.2, _float,
+          "Retry-budget deposit per completed first attempt: total "
+          "retry volume is capped at ~this fraction of live traffic "
+          "(Finagle RetryBudget semantics — prevents retry storms)")
+_register("DYNT_RETRY_BUDGET_MIN", 3.0, _float,
+          "Retry-budget seed tokens so a cold client can still retry "
+          "before any traffic has deposited")
+_register("DYNT_RETRY_BACKOFF_BASE_MS", 50.0, _float,
+          "Decorrelated-jitter backoff floor between retry attempts")
+_register("DYNT_RETRY_BACKOFF_CAP_MS", 2000.0, _float,
+          "Decorrelated-jitter backoff ceiling between retry attempts")
+_register("DYNT_RETRY_MAX_ATTEMPTS", 3, _int,
+          "Router retry attempt cap per request (raised to live "
+          "instance count + 1 when more candidates exist)")
+_register("DYNT_BREAKER_FAILURES", 1, _int,
+          "Consecutive transport failures that open an instance's "
+          "circuit breaker (1 mirrors the old first-failure down-mark)")
+_register("DYNT_BREAKER_RESET_SECS", 5.0, _float,
+          "Open->half-open delay: how long an open breaker waits before "
+          "admitting its single recovery probe (replaces the old "
+          "DOWN_COOLDOWN_SECS full re-admission)")
 _register("DYNT_MIGRATION_LIMIT", 3, _int,
           "Max in-flight request migrations across workers (ref: migration.rs)")
 _register("DYNT_CANARY_WAIT_SECS", 30.0, _float,

@@ -82,6 +82,7 @@ class HealthCheckManager:
 
     async def _probe(self, served) -> None:
         ok = False
+        stream = None
         try:
             stream = self.runtime.request_client.call(
                 self.runtime.request_server.address,
@@ -99,6 +100,20 @@ class HealthCheckManager:
         except Exception as exc:  # noqa: BLE001 — any failure is unhealthy
             log.warning("canary failed on %s instance=%x: %r",
                         served.endpoint.subject, served.instance_id, exc)
+        finally:
+            # Close the canary stream DETERMINISTICALLY. Both exits leak
+            # otherwise: on timeout, wait_for abandons _consume with the
+            # generator parked mid-stream; on success, the early `break`
+            # leaves it suspended after the first item. Either way no
+            # `cancel` frame goes out until GC finalizes the generator —
+            # and the wedged request this canary just detected stays
+            # open server-side, holding its handler slot. aclose() runs
+            # the client's cleanup path, which sends the cancel frame.
+            if stream is not None:
+                try:
+                    await stream.aclose()
+                except Exception:  # noqa: BLE001 — already unhealthy
+                    pass
         iid = served.instance_id
         if ok:
             self._failures.pop(iid, None)

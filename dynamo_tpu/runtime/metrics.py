@@ -71,6 +71,38 @@ KV_USAGE = Gauge(
 ROUTER_DECISIONS = Counter(
     "dynamo_router_decisions_total", "Routing decisions", ["mode"], registry=REGISTRY
 )
+# Resilience plane (runtime/resilience.py): deadlines, retry budgets,
+# circuit breakers — the bounded-degradation signals dashboards alarm on
+# during a brownout (docs/fault-tolerance.md).
+RETRIES_TOTAL = Counter(
+    "dynamo_retries_total", "Request-plane retry attempts by outcome "
+    "(allowed = dispatched, denied = retry budget exhausted)",
+    ["endpoint", "outcome"], registry=REGISTRY,
+)
+RETRY_BUDGET_BALANCE = Gauge(
+    "dynamo_retry_budget_balance", "Retry-budget tokens currently available",
+    ["endpoint"], registry=REGISTRY,
+)
+BREAKER_STATE = Gauge(
+    "dynamo_circuit_breaker_state",
+    "Circuit breaker state per instance (0=closed 1=open 2=half_open)",
+    ["endpoint", "instance"], registry=REGISTRY,
+)
+BREAKER_TRANSITIONS = Counter(
+    "dynamo_circuit_breaker_transitions_total",
+    "Circuit breaker state transitions, by state entered",
+    ["endpoint", "state"], registry=REGISTRY,
+)
+DEADLINE_EXCEEDED = Counter(
+    "dynamo_deadline_exceeded_total",
+    "Requests whose end-to-end deadline budget expired, by component",
+    ["component"], registry=REGISTRY,
+)
+REQUESTS_SHED = Counter(
+    "dynamo_requests_shed_total",
+    "Requests shed at admission with 503, by reason",
+    ["reason"], registry=REGISTRY,
+)
 
 
 def render() -> bytes:

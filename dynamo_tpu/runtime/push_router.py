@@ -2,10 +2,16 @@
 
 Mirrors the reference's PushRouter with RouterMode {RoundRobin, Random,
 PowerOfTwoChoices, KV, Direct} (ref: lib/runtime/src/pipeline/network/egress/
-push_router.rs:71,113-120). Transport failures mark an instance down and it is
-filtered from the candidate list until discovery confirms it or a cooldown
-passes (ref: push_router.rs:8-16,103-107). The KV mode plugs in an external
-selector callback (wired by dynamo_tpu.kv_router).
+push_router.rs:71,113-120). Transport failures feed a per-instance circuit
+breaker (closed -> open -> half-open single-probe recovery, replacing the old
+fixed DOWN_COOLDOWN_SECS down-mark); discovery re-confirming an instance
+resets its breaker (ref: push_router.rs:8-16,103-107). Retries follow a
+decorrelated-jitter RetryPolicy and draw from a RetryBudget token bucket
+shared across this client, so a browned-out fleet degrades instead of
+amplifying load into a retry storm. An end-to-end Deadline, when supplied,
+is re-encoded onto every attempt's headers and bounds the whole loop. The
+KV mode plugs in an external selector callback (wired by
+dynamo_tpu.kv_router).
 """
 
 from __future__ import annotations
@@ -13,17 +19,22 @@ from __future__ import annotations
 import asyncio
 import itertools
 import random
-import time
 from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from .component import Client
 from .logging import get_logger
-from .metrics import ROUTER_DECISIONS
+from .metrics import RETRIES_TOTAL, ROUTER_DECISIONS
 from .request_plane import ConnectionLost, EndpointNotFound
+from .resilience import (
+    HALF_OPEN,
+    BreakerBoard,
+    Deadline,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryPolicy,
+)
 
 log = get_logger("push_router")
-
-DOWN_COOLDOWN_SECS = 5.0
 
 
 class NoInstancesAvailable(RuntimeError):
@@ -37,34 +48,41 @@ class PushRouter:
         mode: str = "round_robin",
         selector: Optional[Callable[[Any, list[int]], Awaitable[int]]] = None,
         first_item_timeout: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        retry_budget: Optional[RetryBudget] = None,
+        breakers: Optional[BreakerBoard] = None,
     ) -> None:
         assert mode in ("round_robin", "random", "direct", "kv", "p2c")
         self.client = client
         self.mode = mode
         self._selector = selector
         self._rr = itertools.count()
-        self._down: dict[int, float] = {}
         self._inflight: dict[int, int] = {}
         self._first_item_timeout = first_item_timeout
-        # Clear down-marks when discovery re-confirms an instance.
+        subject = client.endpoint.subject
+        self.policy = retry_policy or RetryPolicy.from_env()
+        self.budget = retry_budget or RetryBudget.from_env(subject)
+        self.breakers = breakers or BreakerBoard(subject)
+        # Reset breakers when discovery re-confirms an instance.
         client.on_change(self._on_instance_change)
 
     def _on_instance_change(self, kind: str, record: dict) -> None:
         iid = record.get("instance_id")
-        if kind == "put" and iid in self._down:
-            del self._down[iid]
+        if iid is None:
+            return
+        if kind == "put":
+            self.breakers.reset(iid)
         if kind == "delete":
-            self._down.pop(iid, None)
+            self.breakers.drop(iid)
 
     def mark_down(self, instance_id: int) -> None:
-        self._down[instance_id] = time.monotonic()
+        """Record a transport failure against an instance's breaker."""
+        self.breakers.get(instance_id).record_failure()
 
     def available(self) -> list[int]:
-        now = time.monotonic()
         out = []
         for iid in self.client.instance_ids():
-            downed = self._down.get(iid)
-            if downed is not None and now - downed < DOWN_COOLDOWN_SECS:
+            if not self.breakers.get(iid).can_attempt():
                 continue
             out.append(iid)
         return out
@@ -81,8 +99,9 @@ class PushRouter:
             avail = [i for i in avail if i in allowed]
         if instance_id is not None:
             # Explicit target (e.g. KV-selected upstream): honor it only while
-            # it's live and not marked down — otherwise fail fast so the caller
-            # can re-select, instead of re-dialing a dead instance.
+            # it's live and its breaker admits traffic — otherwise fail fast
+            # so the caller can re-select, instead of re-dialing a dead
+            # instance.
             if instance_id not in avail:
                 raise NoInstancesAvailable(
                     f"{self.client.endpoint.subject}: instance {instance_id:x} "
@@ -110,35 +129,109 @@ class PushRouter:
         instance_id: Optional[int] = None,
         headers: Optional[dict] = None,
         allowed: Optional[set] = None,
+        deadline: Optional[Deadline] = None,
     ) -> AsyncIterator[Any]:
-        """Route and stream. On transport failure *before any output*, marks
-        the instance down and retries another one; mid-stream failures
-        propagate (migration is a pipeline-level concern, llm/migration.py)."""
+        """Route and stream. On transport failure *before any output*, the
+        instance's breaker records a failure and — if the retry budget
+        admits it — another instance is tried after a jittered backoff;
+        mid-stream failures propagate (migration is a pipeline-level
+        concern, llm/migration.py). The deadline (also parsed from
+        `headers` when not passed) is re-encoded onto every attempt and
+        bounds the retry loop end-to-end."""
         await self.client.start()
+        if deadline is None:
+            deadline = Deadline.from_wire(headers)
         attempts = 0
+        prev_delay: Optional[float] = None
         while True:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    f"deadline exceeded routing {self.client.endpoint.subject}")
             iid = await self._pick(body, instance_id, allowed)
+            breaker = self.breakers.get(iid)
+            owns_probe = False
+            if self.mode != "direct":
+                if not breaker.try_acquire():
+                    # Lost the half-open probe slot in a race; treat like
+                    # an unavailable instance (explicit targets fail fast
+                    # so the upstream selector re-picks).
+                    if instance_id is not None:
+                        raise NoInstancesAvailable(
+                            f"{self.client.endpoint.subject}: instance "
+                            f"{iid:x} breaker open")
+                    continue
+                # Asyncio-single-threaded: a True acquire with the
+                # breaker now half-open means THIS attempt holds the
+                # single probe slot (closed-state acquires reserve
+                # nothing, and must not release someone else's probe).
+                owns_probe = breaker.state == HALF_OPEN
             # An explicit instance means the decision was made upstream
             # (KV scheduler / prefill router), not by this router's mode.
             ROUTER_DECISIONS.labels(
                 mode="direct" if instance_id is not None else self.mode
             ).inc()
+            hdrs = dict(headers or {})
+            if deadline is not None:
+                # Re-encoded per attempt: remaining-ms at send time, so
+                # backoff sleeps and failed attempts charge the budget.
+                hdrs.update(deadline.to_wire())
             self._inflight[iid] = self._inflight.get(iid, 0) + 1
             yielded = False
+            settled = False  # breaker got a success/failure verdict
             try:
                 async for item in self.client.direct(
-                    body, iid, headers, self._first_item_timeout
+                    body, iid, hdrs, self._first_item_timeout
                 ):
+                    if not yielded:
+                        breaker.record_success(probe=owns_probe)
+                        settled = True
+                        self.budget.deposit()
                     yielded = True
                     yield item
+                if not yielded:
+                    # Empty-but-clean stream still proves the instance up.
+                    breaker.record_success(probe=owns_probe)
+                    settled = True
+                    self.budget.deposit()
                 return
+            except DeadlineExceeded:
+                # The request was late, not the worker broken: no breaker
+                # failure, no retry (there is no budget left to retry in).
+                raise
             except (ConnectionLost, EndpointNotFound, KeyError, asyncio.TimeoutError) as exc:
-                self.mark_down(iid)
-                log.warning("instance %x down (%r)", iid, exc)
+                breaker.record_failure(probe=owns_probe)
+                settled = True
+                log.warning("instance %x faulted (%r) breaker=%s", iid, exc,
+                            breaker.state)
                 if yielded or self.mode == "direct":
                     raise ConnectionLost(str(exc)) from exc
                 attempts += 1
-                if attempts >= max(3, len(self.client.instances) + 1):
+                # Keep the old guarantee of one attempt per live instance
+                # (+1) even when the policy cap is lower.
+                if attempts >= max(self.policy.max_attempts,
+                                   len(self.client.instances) + 1):
                     raise
+                if not self.budget.try_spend():
+                    RETRIES_TOTAL.labels(
+                        endpoint=self.client.endpoint.subject,
+                        outcome="denied").inc()
+                    log.warning("retry budget exhausted for %s",
+                                self.client.endpoint.subject)
+                    raise
+                RETRIES_TOTAL.labels(
+                    endpoint=self.client.endpoint.subject,
+                    outcome="allowed").inc()
+                prev_delay = self.policy.next_delay(prev_delay)
+                delay = prev_delay
+                if deadline is not None:
+                    delay = deadline.bound(delay)
+                await asyncio.sleep(delay)
             finally:
+                if owns_probe and not settled:
+                    # Our probe ended with no health verdict (deadline
+                    # ran out, application error, caller closed the
+                    # stream): return the half-open slot instead of
+                    # leaking it — a leaked slot locks the instance out
+                    # forever.
+                    breaker.release_probe()
                 self._inflight[iid] = max(0, self._inflight.get(iid, 1) - 1)

@@ -21,10 +21,17 @@ from __future__ import annotations
 import asyncio
 import itertools
 import time
-from typing import Any, AsyncIterator, Awaitable, Callable, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 from . import codec
 from .logging import get_logger
+from .metrics import DEADLINE_EXCEEDED
+from .resilience import (
+    Deadline,
+    DeadlineExceeded,
+    DeadlineWatchdog,
+    bounded_wait,
+)
 
 log = get_logger("request_plane")
 
@@ -59,6 +66,9 @@ class RequestContext:
         self.request_id = request_id
         self.headers = headers or {}
         self.subject = subject
+        # End-to-end budget propagated by the caller (resilience.py);
+        # handlers size their own downstream waits from remaining().
+        self.deadline: Optional[Deadline] = Deadline.from_wire(self.headers)
         self._stopped = asyncio.Event()
 
     def stop(self) -> None:
@@ -66,6 +76,15 @@ class RequestContext:
 
     def is_stopped(self) -> bool:
         return self._stopped.is_set()
+
+    def remaining(self, default: Optional[float] = None) -> Optional[float]:
+        """Seconds of request budget left (floored at 0), or `default`
+        when the caller propagated no deadline. Handlers use this for
+        every downstream wait (KV pulls, nested RPCs) instead of fresh
+        flat timeouts."""
+        if self.deadline is None:
+            return default
+        return max(0.0, self.deadline.remaining())
 
     async def wait_stopped(self) -> None:
         await self._stopped.wait()
@@ -193,13 +212,42 @@ class TcpRequestServer:
                                                  "e": f"endpoint not found: {subject}",
                                                  "c": "not_found"})
             return
+        if ctx.deadline is not None and ctx.deadline.expired():
+            # The budget was spent in transit/queueing: refuse BEFORE
+            # dispatch so an already-late request never occupies a
+            # worker slot (the client gave up on it anyway).
+            DEADLINE_EXCEEDED.labels(component="server").inc()
+            await self._send(writer, send_lock,
+                             {"t": "err", "i": rid,
+                              "e": f"deadline expired before dispatch: "
+                                   f"{subject}",
+                              "c": "deadline_exceeded"})
+            return
+        # Watchdog: a dispatched handler is cancelled the moment its
+        # budget runs out — a request with a 2s deadline can never hold
+        # a worker for 600s (attribution semantics: DeadlineWatchdog).
+        watchdog = DeadlineWatchdog().arm(ctx.deadline)
+        gen = handler(body, ctx)
         try:
-            async for item in handler(body, ctx):
+            async for item in gen:
                 await self._send(writer, send_lock, {"t": "data", "i": rid},
                                  codec.pack_body(item))
             await self._send(writer, send_lock, {"t": "end", "i": rid})
         except asyncio.CancelledError:
             ctx.stop()
+            if watchdog.fired:
+                # Our own watchdog fired (not a client cancel): swallow
+                # the cancellation and report the overrun on the wire.
+                DEADLINE_EXCEEDED.labels(component="server").inc()
+                try:
+                    await self._send(writer, send_lock,
+                                     {"t": "err", "i": rid,
+                                      "e": f"deadline exceeded in "
+                                           f"{subject}",
+                                      "c": "deadline_exceeded"})
+                except (ConnectionResetError, BrokenPipeError):
+                    pass
+                return
             # Client went away or cancelled; nothing to send.
             raise
         except Exception as exc:  # noqa: BLE001 — handler errors cross the wire
@@ -209,6 +257,19 @@ class TcpRequestServer:
                                  {"t": "err", "i": rid, "e": repr(exc),
                                   "c": "handler_error"})
             except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            watchdog.disarm()
+            # Close the handler generator DETERMINISTICALLY: a cancel
+            # delivered while this task was suspended in _send (drain
+            # backpressure) leaves the generator parked at a yield, and
+            # without aclose() its finally blocks (slot/sequence
+            # release) would not run until GC — defeating the point of
+            # freeing the worker at the deadline. (Mirrors the HTTP
+            # plane's aclose.)
+            try:
+                await gen.aclose()
+            except (Exception, asyncio.CancelledError):  # noqa: BLE001
                 pass
 
     async def _send(
@@ -312,6 +373,7 @@ class TcpRequestClient:
         queue: asyncio.Queue = asyncio.Queue()
         conn.streams[rid] = queue
         ended = False
+        deadline = Deadline.from_wire(headers)
         try:
             await conn.send({"t": "req", "i": rid, "s": subject, "h": headers or {}},
                             codec.pack_body(body))
@@ -320,15 +382,18 @@ class TcpRequestClient:
             # A black-holed worker (network partition, SIGSTOP) keeps the
             # connection open while nothing flows; the idle timeout turns
             # that silent hang into a TimeoutError the router fault-marks
-            # and Migration recovers from.
+            # and Migration recovers from. The first frame is bounded the
+            # same way (a paused worker that never answers at all must
+            # not hang a fresh request until lease expiry), and every
+            # wait is additionally clamped to the propagated deadline.
             idle = env("DYNT_STREAM_IDLE_TIMEOUT_SECS") or None
             first = True
             while True:
-                timeout = first_item_timeout if first else idle
-                if timeout is not None:
-                    header, payload = await asyncio.wait_for(queue.get(), timeout)
-                else:
-                    header, payload = await queue.get()
+                timeout = (first_item_timeout
+                           if first and first_item_timeout is not None
+                           else idle)
+                header, payload = await bounded_wait(
+                    queue.get(), timeout, deadline, subject)
                 first = False
                 ftype = header.get("t")
                 if ftype == "data":
@@ -343,6 +408,8 @@ class TcpRequestClient:
                         raise ConnectionLost(header.get("e", "connection lost"))
                     if code == "not_found":
                         raise EndpointNotFound(header.get("e", subject))
+                    if code == "deadline_exceeded":
+                        raise DeadlineExceeded(header.get("e", subject))
                     raise RemoteError(header.get("e", "remote error"), code)
         finally:
             conn.streams.pop(rid, None)
@@ -428,6 +495,11 @@ class MemRequestPlane:
             raise ConnectionLost(f"no mem server at {address}")
         handler = registry.get(subject)
         ctx = RequestContext(0, headers or {}, subject)
+        if ctx.deadline is not None and ctx.deadline.expired():
+            # Same refuse-before-dispatch contract as the TCP server.
+            DEADLINE_EXCEEDED.labels(component="server").inc()
+            raise DeadlineExceeded(
+                f"deadline expired before dispatch: {subject}")
         try:
             async for item in handler(body, ctx):
                 # round-trip through msgpack to keep semantics identical to TCP
@@ -560,17 +632,38 @@ class HttpRequestServer:
             await resp.write(_http_frame({"t": "err", "c": "not_found",
                                           "e": subject}))
             return resp
+        if ctx.deadline is not None and ctx.deadline.expired():
+            # Refuse-before-dispatch: same contract as the TCP server.
+            DEADLINE_EXCEEDED.labels(component="server").inc()
+            await resp.write(_http_frame(
+                {"t": "err", "c": "deadline_exceeded",
+                 "e": f"deadline expired before dispatch: {subject}"}))
+            return resp
         gen = handler(body, ctx)
+        # Same watchdog (and same fired-flag attribution) as the TCP
+        # server: the handler is cancelled when its propagated budget
+        # runs out, never holding a worker slot past the deadline.
+        watchdog = DeadlineWatchdog().arm(ctx.deadline)
         try:
             async for item in gen:
                 await resp.write(_http_frame({"t": "data"},
                                              codec.pack_body(item)))
             await resp.write(_http_frame({"t": "end"}))
-        except (ConnectionResetError, asyncio.CancelledError):
-            # Client went away mid-stream: cancellation semantics match
-            # the TCP plane's `cancel` frame.
+        except (ConnectionResetError, asyncio.CancelledError) as exc:
             ctx.stop()
-            raise
+            if isinstance(exc, asyncio.CancelledError) and watchdog.fired:
+                # Our own watchdog, not a client disconnect.
+                DEADLINE_EXCEEDED.labels(component="server").inc()
+                try:
+                    await resp.write(_http_frame(
+                        {"t": "err", "c": "deadline_exceeded",
+                         "e": f"deadline exceeded in {subject}"}))
+                except (ConnectionResetError, ConnectionError):
+                    pass
+            else:
+                # Client went away mid-stream: cancellation semantics
+                # match the TCP plane's `cancel` frame.
+                raise
         except Exception as exc:  # noqa: BLE001 — surfaced to the client
             log.exception("handler error on %s", subject)
             try:
@@ -580,6 +673,7 @@ class HttpRequestServer:
             except (ConnectionResetError, ConnectionError):
                 pass
         finally:
+            watchdog.disarm()
             ctx.stop()
             await gen.aclose()
         return resp
@@ -642,6 +736,7 @@ class HttpRequestClient:
             from .config import env
 
             idle = env("DYNT_STREAM_IDLE_TIMEOUT_SECS") or None
+            deadline = Deadline.from_wire(headers)
 
             async def _read_frame():
                 head = await _read(8)
@@ -653,13 +748,14 @@ class HttpRequestClient:
             while True:
                 # Timeout covers the WHOLE frame: a peer black-holed
                 # mid-frame (head delivered, body never) must still trip
-                # the idle timeout.
-                timeout = first_item_timeout if first else idle
-                if timeout is not None:
-                    frame, payload = await asyncio.wait_for(_read_frame(),
-                                                            timeout)
-                else:
-                    frame, payload = await _read_frame()
+                # the idle timeout. First frames are bounded like the
+                # TCP plane's, and every wait is clamped to the
+                # propagated deadline (bounded_wait).
+                timeout = (first_item_timeout
+                           if first and first_item_timeout is not None
+                           else idle)
+                frame, payload = await bounded_wait(
+                    _read_frame(), timeout, deadline, subject)
                 first = False
                 ftype = frame.get("t")
                 if ftype == "data":
@@ -672,6 +768,8 @@ class HttpRequestClient:
                         raise EndpointNotFound(frame.get("e", subject))
                     if code == "connection_lost":
                         raise ConnectionLost(frame.get("e", "lost"))
+                    if code == "deadline_exceeded":
+                        raise DeadlineExceeded(frame.get("e", subject))
                     raise RemoteError(frame.get("e", "remote error"), code)
         except aiohttp.ClientError as exc:
             raise ConnectionLost(f"{address}: {exc}") from exc

@@ -1,0 +1,399 @@
+"""Request-plane resilience primitives: deadlines, retry budgets,
+circuit breakers.
+
+"The Tail at Scale" (Dean & Barroso, CACM 2013) and the gray-failure
+literature argue that a distributed serving plane must fail *bounded*:
+every hop consumes one end-to-end budget instead of stacking fresh flat
+timeouts, retries are capped at a fraction of live traffic so a
+browned-out backend triggers degradation instead of a retry storm, and
+repeated failures trip a breaker that probes its way back instead of
+hammering a struggling peer on a fixed cooldown.
+
+Four primitives, composed by PushRouter / the frontend / Migration:
+
+  * Deadline      — a monotonic budget created once at admission and
+                    re-encoded as *remaining milliseconds* on every hop
+                    (`x-dynt-deadline-ms` request-plane header), so no
+                    wall-clock agreement between hosts is needed.
+  * RetryPolicy   — decorrelated-jitter exponential backoff (the AWS
+                    "exponential backoff and jitter" scheme): each delay
+                    is uniform(base, prev*3) capped, which de-correlates
+                    synchronized retry waves better than full jitter.
+  * RetryBudget   — token bucket shared per client: live traffic
+                    deposits `ratio` tokens per request, each retry
+                    withdraws one, so total retry volume is bounded at
+                    ~ratio of throughput (the Finagle RetryBudget
+                    contract).
+  * CircuitBreaker— closed -> open (after N consecutive failures) ->
+                    half-open (after reset_secs, admitting a SINGLE
+                    probe) -> closed on probe success / open on probe
+                    failure.
+
+Everything here is asyncio-single-threaded state; no locks needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Optional
+
+from .config import env
+from .metrics import (
+    BREAKER_STATE,
+    BREAKER_TRANSITIONS,
+    DEADLINE_EXCEEDED,
+    RETRY_BUDGET_BALANCE,
+)
+
+DEADLINE_HEADER = "x-dynt-deadline-ms"
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's end-to-end budget is spent. NOT a transport failure:
+    routers must neither retry it nor fault-mark the instance that
+    reported it (the request was late, not the worker broken)."""
+
+
+class Deadline:
+    """Monotonic end-to-end budget. Created once at admission; every hop
+    measures what is left rather than adding its own flat timeout."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, budget_secs: float) -> None:
+        self.expires_at = time.monotonic() + budget_secs
+
+    def remaining(self) -> float:
+        """Seconds of budget left (can be <= 0)."""
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def bound(self, timeout: Optional[float]) -> float:
+        """Clamp a local timeout to the remaining budget. A hop must
+        never wait past the deadline even if its own timeout is laxer
+        (or absent). Floor at 0 so an expired deadline still produces a
+        valid (immediately-firing) wait."""
+        rem = max(0.0, self.remaining())
+        if timeout is None:
+            return rem
+        return min(timeout, rem)
+
+    def to_wire(self) -> dict:
+        """Header fragment carrying the budget across one hop. Encoded
+        RELATIVE (remaining ms at send time): immune to clock skew, and
+        re-encoding at every hop automatically charges queueing and
+        transfer time to the budget."""
+        return {"x-dynt-deadline-ms": max(0, int(self.remaining() * 1e3))}
+
+    @classmethod
+    def from_wire(cls, header: Optional[dict]) -> Optional["Deadline"]:
+        """Parse a Deadline out of request-plane headers; None when the
+        caller did not propagate one (legacy peers keep working)."""
+        if not header:
+            return None
+        raw = header.get("x-dynt-deadline-ms")
+        if raw is None:
+            return None
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return cls(ms / 1e3)
+
+
+class DeadlineWatchdog:
+    """Cancels the current task when a deadline expires, and attributes
+    the resulting CancelledError: `.fired` distinguishes our own
+    deadline cancel (swallow, report the overrun) from an external
+    cancel — a client cancel frame or connection teardown — which must
+    keep propagating (and must never turn into a late send on a
+    possibly-closed writer). Shared by both request-plane servers."""
+
+    __slots__ = ("fired", "_timer")
+
+    def __init__(self) -> None:
+        self.fired = False
+        self._timer: Optional[asyncio.TimerHandle] = None
+
+    def arm(self, deadline: Optional[Deadline]) -> "DeadlineWatchdog":
+        if deadline is not None:
+            task = asyncio.current_task()
+            assert task is not None
+
+            def _fire(task: "asyncio.Task" = task) -> None:
+                self.fired = True
+                task.cancel()
+
+            self._timer = asyncio.get_running_loop().call_later(
+                max(0.0, deadline.remaining()), _fire)
+        return self
+
+    def disarm(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+async def bounded_wait(coro: Any, timeout: Optional[float],
+                       deadline: Optional[Deadline], what: str) -> Any:
+    """Await `coro` under `timeout` clamped to the deadline's remaining
+    budget. A timeout caused by deadline exhaustion surfaces as
+    DeadlineExceeded (the request was late), never a bare TimeoutError
+    (the peer is sick) — routers treat the two very differently. Shared
+    by both request-plane clients' frame waits."""
+    if deadline is not None:
+        timeout = deadline.bound(timeout)
+    try:
+        if timeout is not None:
+            return await asyncio.wait_for(coro, timeout)
+        return await coro
+    except asyncio.TimeoutError:
+        if deadline is not None and deadline.expired():
+            DEADLINE_EXCEEDED.labels(component="client").inc()
+            raise DeadlineExceeded(
+                f"deadline exceeded waiting on {what}") from None
+        raise
+
+
+class RetryPolicy:
+    """Decorrelated-jitter exponential backoff + attempt cap."""
+
+    __slots__ = ("base_secs", "cap_secs", "max_attempts")
+
+    def __init__(self, base_secs: float = 0.05, cap_secs: float = 2.0,
+                 max_attempts: int = 3) -> None:
+        self.base_secs = base_secs
+        self.cap_secs = cap_secs
+        self.max_attempts = max_attempts
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            base_secs=env("DYNT_RETRY_BACKOFF_BASE_MS") / 1e3,
+            cap_secs=env("DYNT_RETRY_BACKOFF_CAP_MS") / 1e3,
+            max_attempts=env("DYNT_RETRY_MAX_ATTEMPTS"),
+        )
+
+    def next_delay(self, prev: Optional[float] = None) -> float:
+        """Next backoff given the previous delay (None on first retry):
+        sleep = min(cap, uniform(base, prev * 3))."""
+        prev = self.base_secs if prev is None else prev
+        return min(self.cap_secs,
+                   random.uniform(self.base_secs,
+                                  max(self.base_secs, prev * 3.0)))
+
+
+class RetryBudget:
+    """Token bucket capping retries at a fraction of live traffic.
+
+    Every completed first attempt deposits `ratio` tokens; every retry
+    withdraws one. Under total brownout deposits stop, the bucket
+    drains, and retry volume collapses to zero instead of multiplying
+    offered load (the storm this class exists to prevent). `min_tokens`
+    seeds the bucket so a cold client can still retry."""
+
+    __slots__ = ("ratio", "cap", "_balance", "_endpoint")
+
+    def __init__(self, ratio: float = 0.2, min_tokens: float = 3.0,
+                 cap: float = 20.0, endpoint: str = "") -> None:
+        self.ratio = ratio
+        self.cap = max(cap, min_tokens)
+        self._balance = min(min_tokens, self.cap)
+        self._endpoint = endpoint
+        self._observe()
+
+    @classmethod
+    def from_env(cls, endpoint: str = "") -> "RetryBudget":
+        return cls(
+            ratio=env("DYNT_RETRY_BUDGET_RATIO"),
+            min_tokens=env("DYNT_RETRY_BUDGET_MIN"),
+            endpoint=endpoint,
+        )
+
+    def _observe(self) -> None:
+        if self._endpoint:
+            RETRY_BUDGET_BALANCE.labels(endpoint=self._endpoint).set(
+                self._balance)
+
+    @property
+    def balance(self) -> float:
+        return self._balance
+
+    def deposit(self) -> None:
+        """Credit one unit of live traffic."""
+        self._balance = min(self.cap, self._balance + self.ratio)
+        self._observe()
+
+    def try_spend(self) -> bool:
+        """Withdraw one retry token; False = budget exhausted, the
+        caller must fail instead of retrying."""
+        if self._balance < 1.0:
+            return False
+        self._balance -= 1.0
+        self._observe()
+        return True
+
+
+# Breaker states, with the numeric encoding exported on the
+# dynamo_circuit_breaker_state gauge.
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+_STATE_VALUE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open breaker with single-probe recovery.
+
+    Unlike a fixed cooldown (the old DOWN_COOLDOWN_SECS), a breaker
+    that half-opens admits exactly ONE probe request: a still-sick
+    backend costs one request per reset window instead of a full
+    re-admitted wave."""
+
+    __slots__ = ("failure_threshold", "reset_secs", "state", "_failures",
+                 "_opened_at", "_probe_inflight", "_on_transition")
+
+    def __init__(self, failure_threshold: int = 1, reset_secs: float = 5.0,
+                 on_transition=None) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_secs = reset_secs
+        self.state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._on_transition = on_transition
+
+    def _transition(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        if self._on_transition is not None:
+            self._on_transition(state)
+
+    def can_attempt(self) -> bool:
+        """Non-mutating admission check (candidate filtering): closed,
+        or open-with-elapsed-reset, or half-open with no probe out."""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            return time.monotonic() - self._opened_at >= self.reset_secs
+        return not self._probe_inflight
+
+    def try_acquire(self) -> bool:
+        """Mutating dispatch gate: the half-open single-probe slot is
+        reserved HERE, immediately before the request goes out, never
+        during candidate filtering (which may not dispatch)."""
+        if self.state == CLOSED:
+            return True
+        now = time.monotonic()
+        if self.state == OPEN:
+            if now - self._opened_at < self.reset_secs:
+                return False
+            self._transition(HALF_OPEN)
+            self._probe_inflight = True
+            return True
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        return True
+
+    def release_probe(self) -> None:
+        """Return an acquired dispatch slot WITHOUT a verdict — the
+        attempt ended in a way that says nothing about the instance's
+        health (deadline ran out first, application-level error, caller
+        went away). Without this the half-open single-probe slot would
+        leak and lock the instance out of rotation forever."""
+        self._probe_inflight = False
+
+    def record_success(self, probe: bool = False) -> None:
+        """`probe=True` only from the attempt that owns the half-open
+        probe slot: a stale pre-open attempt settling late must not
+        release (or double-release) another request's probe."""
+        self._failures = 0
+        if probe:
+            self._probe_inflight = False
+        if self.state != CLOSED:
+            self._transition(CLOSED)
+
+    def record_failure(self, probe: bool = False) -> None:
+        now = time.monotonic()
+        if self.state == HALF_OPEN:
+            # Back to open for another reset window. Only the probe
+            # owner returns the slot — see record_success.
+            if probe:
+                self._probe_inflight = False
+            self._opened_at = now
+            self._transition(OPEN)
+            return
+        if self.state == OPEN:
+            # A failure while already open (direct-mode dispatch bypasses
+            # try_acquire, so no HALF_OPEN transition happened): re-arm
+            # the reset window, or the breaker stops fail-fasting the
+            # instance entirely after the first window elapses.
+            self._opened_at = now
+            return
+        self._failures += 1
+        if self._failures >= self.failure_threshold:
+            self._opened_at = now
+            self._transition(OPEN)
+
+    def reset(self) -> None:
+        """External evidence of health (discovery re-confirmed the
+        instance): drop all failure state."""
+        self._failures = 0
+        self._probe_inflight = False
+        self._transition(CLOSED)
+
+
+class BreakerBoard:
+    """Per-instance CircuitBreaker registry for one endpoint, exporting
+    breaker state + transitions on the process metrics registry."""
+
+    def __init__(self, endpoint: str, failure_threshold: Optional[int] = None,
+                 reset_secs: Optional[float] = None) -> None:
+        self.endpoint = endpoint
+        self.failure_threshold = (
+            env("DYNT_BREAKER_FAILURES") if failure_threshold is None
+            else failure_threshold)
+        self.reset_secs = (
+            env("DYNT_BREAKER_RESET_SECS") if reset_secs is None
+            else reset_secs)
+        self._breakers: dict[int, CircuitBreaker] = {}
+
+    def get(self, instance_id: int) -> CircuitBreaker:
+        breaker = self._breakers.get(instance_id)
+        if breaker is None:
+            def observe(state: str, iid: int = instance_id) -> None:
+                BREAKER_STATE.labels(
+                    endpoint=self.endpoint, instance=f"{iid:x}"
+                ).set(_STATE_VALUE[state])
+                BREAKER_TRANSITIONS.labels(
+                    endpoint=self.endpoint, state=state).inc()
+
+            breaker = CircuitBreaker(self.failure_threshold,
+                                     self.reset_secs,
+                                     on_transition=observe)
+            BREAKER_STATE.labels(
+                endpoint=self.endpoint, instance=f"{instance_id:x}"
+            ).set(_STATE_VALUE[CLOSED])
+            self._breakers[instance_id] = breaker
+        return breaker
+
+    def reset(self, instance_id: int) -> None:
+        breaker = self._breakers.get(instance_id)
+        if breaker is not None:
+            breaker.reset()
+
+    def drop(self, instance_id: int) -> None:
+        if self._breakers.pop(instance_id, None) is not None:
+            # Remove the gauge series too: a deregistered instance must
+            # not show a phantom breaker state forever, and instance
+            # churn must not leak label cardinality.
+            try:
+                BREAKER_STATE.remove(self.endpoint, f"{instance_id:x}")
+            except KeyError:
+                pass

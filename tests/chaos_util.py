@@ -1,8 +1,12 @@
 """Shared helpers for the chaos/fault-injection tiers (real OS
-processes): spawn with log capture, readiness polls, teardown."""
+processes): spawn with log capture, readiness polls, teardown, metrics
+scraping, and the JSON scenario report the brownout tier asserts from
+(and CI uploads as an artifact)."""
 
 import asyncio
+import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -66,3 +70,47 @@ def kill_all(procs):
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
             pass
+
+
+_SERIES = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[0-9.eE+-]+)\s*$")
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+async def scrape_metrics(session, base):
+    """Fetch and parse a Prometheus text scrape page into
+    {metric_name: [(labels_dict, float_value), ...]}."""
+    out = {}
+    async with session.get(base + "/metrics") as resp:
+        text = await resp.text()
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SERIES.match(line)
+        if m is None:
+            continue
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        out.setdefault(m.group("name"), []).append(
+            (labels, float(m.group("value"))))
+    return out
+
+
+def metric_sum(scrape, name, **label_filter):
+    """Sum series of `name` whose labels match every filter kv."""
+    total = 0.0
+    for labels, value in scrape.get(name, []):
+        if all(labels.get(k) == v for k, v in label_filter.items()):
+            total += value
+    return total
+
+
+def write_chaos_report(name, report, default_dir="/tmp"):
+    """Persist a scenario's JSON report where the CI artifact step (or a
+    human) can find it: $DYNT_CHAOS_REPORT if set, else default_dir.
+    Returns the path."""
+    path = os.environ.get("DYNT_CHAOS_REPORT") or os.path.join(
+        default_dir, f"{name}_report.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    return path

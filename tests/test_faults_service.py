@@ -1,0 +1,212 @@
+"""Fast tier-1 coverage for the fault-injection service's agent paths
+that only the (expensive) chaos tier exercised before: the kill_respawn
+scenario, the delay proxy's latency + heal lifecycle, and the proxy's
+half-close semantics (one leg's EOF must not kill the other; one leg's
+FAILURE must kill both). Loopback only — the targets are throwaway
+`sleep` subprocesses and in-process echo servers, not mockers."""
+
+import asyncio
+import contextlib
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from dynamo_tpu.faults import FaultClient, FaultInjectionService
+from dynamo_tpu.faults.service import _DelayProxy
+
+
+@contextlib.asynccontextmanager
+async def fault_service():
+    svc = await FaultInjectionService().start()
+    client = FaultClient(f"http://127.0.0.1:{svc.port}")
+    try:
+        yield client
+    finally:
+        await client.close()
+        await svc.close()
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+class TestKillRespawn:
+    def test_kill_respawn_scenario_relaunches_target(self, run):
+        import subprocess
+
+        argv = [sys.executable, "-c", "import time; time.sleep(60)"]
+        proc = subprocess.Popen(argv)
+        respawned = []
+        try:
+            async def body():
+                async with fault_service() as faults:
+                    await faults.register("sleeper", proc.pid, argv=argv)
+                    out = await faults.run_scenario(
+                        "kill_respawn", target="sleeper", down_ms=100)
+                    assert [s["type"] for s in out["steps"]] == \
+                        ["kill", "respawn"]
+                    new_pid = out["steps"][1]["detail"]["pid"]
+                    respawned.append(new_pid)
+                    assert new_pid != proc.pid
+                    assert _alive(new_pid)
+                    # the original target is really gone
+                    proc.wait(timeout=10)
+                    assert proc.returncode == -signal.SIGKILL
+
+            run(body(), timeout=30.0)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            for pid in respawned:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(pid, signal.SIGKILL)
+
+    def test_respawn_without_argv_is_rejected(self, run):
+        import subprocess
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            async def body():
+                async with fault_service() as faults:
+                    await faults.register("noargv", proc.pid)  # no argv
+                    with pytest.raises(RuntimeError, match="argv"):
+                        await faults.run_scenario("kill_respawn",
+                                                  target="noargv",
+                                                  down_ms=50)
+
+            run(body(), timeout=30.0)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+async def _echo_server():
+    """Loopback echo server; returns (server, port)."""
+
+    async def handle(reader, writer):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+        with contextlib.suppress(OSError, RuntimeError):
+            if writer.can_write_eof():
+                writer.write_eof()
+        writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
+class TestDelayHeal:
+    def test_delay_adds_latency_and_heal_closes_listener(self, run):
+        async def body():
+            server, port = await _echo_server()
+            try:
+                async with fault_service() as faults:
+                    fault = await faults.inject(
+                        "delay", target_host="127.0.0.1", target_port=port,
+                        delay_ms=120.0)
+                    listen = fault["detail"]["listen_port"]
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", listen)
+                    t0 = time.monotonic()
+                    writer.write(b"ping")
+                    await writer.drain()
+                    assert await reader.readexactly(4) == b"ping"
+                    rtt = time.monotonic() - t0
+                    # request + response each pay >=120ms through the proxy
+                    assert rtt >= 0.2, rtt
+                    writer.close()
+
+                    healed = await faults.heal(fault["id"])
+                    assert healed["state"] == "healed"
+                    with pytest.raises(OSError):
+                        await asyncio.open_connection("127.0.0.1", listen)
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(body(), timeout=30.0)
+
+
+class TestDelayProxyHalfClose:
+    def test_eof_half_closes_forward_leg_only(self, run):
+        """A client that shuts down its WRITE side must still receive the
+        response (the old teardown hard-closed the opposite direction)."""
+
+        async def body():
+            async def handle(reader, writer):
+                # read until EOF, then answer — only possible if the
+                # proxy half-closed the upstream leg instead of killing
+                # the connection
+                data = await reader.read()
+                writer.write(b"got:" + data)
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            proxy = _DelayProxy(0, "127.0.0.1", port, delay_ms=5.0)
+            await proxy.start()
+            listen = proxy._server.sockets[0].getsockname()[1]
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", listen)
+                writer.write(b"hello")
+                await writer.drain()
+                writer.write_eof()  # half-close client->proxy
+                out = await asyncio.wait_for(reader.read(), 5.0)
+                assert out == b"got:hello"
+            finally:
+                await proxy.stop()
+                server.close()
+                await server.wait_closed()
+
+        run(body(), timeout=30.0)
+
+    def test_one_leg_failure_tears_down_both(self, run):
+        """When the upstream dies mid-conversation the client leg must see
+        EOF/reset promptly — no half-dead lingering connection."""
+
+        async def body():
+            upstream_writer = {}
+
+            async def handle(reader, writer):
+                upstream_writer["w"] = writer
+                await reader.read(4096)
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            proxy = _DelayProxy(0, "127.0.0.1", port, delay_ms=1.0)
+            await proxy.start()
+            listen = proxy._server.sockets[0].getsockname()[1]
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", listen)
+                writer.write(b"hi")
+                await writer.drain()
+                while "w" not in upstream_writer:
+                    await asyncio.sleep(0.01)
+                # upstream aborts hard
+                upstream_writer["w"].transport.abort()
+                # the client leg must terminate too (EOF or reset), fast
+                with contextlib.suppress(ConnectionError):
+                    out = await asyncio.wait_for(reader.read(), 5.0)
+                    assert out == b""
+                writer.close()
+            finally:
+                await proxy.stop()
+                server.close()
+                await server.wait_closed()
+
+        run(body(), timeout=30.0)

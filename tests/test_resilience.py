@@ -1,0 +1,448 @@
+"""Resilience plane tests (runtime/resilience.py + its request-plane,
+router, and migration integration): deadlines propagate hop-to-hop and
+bound every wait, retries draw on a token-bucket budget, breakers trip
+and probe their way back.
+
+Contract refs: "The Tail at Scale" end-to-end deadlines; Finagle
+RetryBudget; the AWS decorrelated-jitter backoff scheme.
+"""
+
+import asyncio
+import time
+import uuid
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    PushRouter,
+    RuntimeConfig,
+)
+from dynamo_tpu.runtime.request_plane import RequestClient, TcpRequestServer
+from dynamo_tpu.runtime.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryPolicy,
+)
+
+
+class TestDeadline:
+    def test_remaining_counts_down(self):
+        d = Deadline(0.5)
+        assert 0.4 < d.remaining() <= 0.5
+        assert not d.expired()
+        d2 = Deadline(-0.1)
+        assert d2.expired()
+
+    def test_wire_roundtrip_is_relative(self):
+        d = Deadline(2.0)
+        wire = d.to_wire()
+        assert set(wire) == {"x-dynt-deadline-ms"}
+        assert 1500 < wire["x-dynt-deadline-ms"] <= 2000
+        d2 = Deadline.from_wire(wire)
+        assert d2 is not None
+        assert abs(d2.remaining() - d.remaining()) < 0.1
+
+    def test_from_wire_tolerates_absent_and_garbage(self):
+        assert Deadline.from_wire(None) is None
+        assert Deadline.from_wire({}) is None
+        assert Deadline.from_wire({"x-dynt-deadline-ms": "nope"}) is None
+        d = Deadline.from_wire({"x-dynt-deadline-ms": "250"})
+        assert d is not None and 0.2 < d.remaining() <= 0.25
+
+    def test_bound_clamps_local_timeouts(self):
+        d = Deadline(1.0)
+        assert d.bound(10.0) <= 1.0
+        assert d.bound(0.05) == 0.05
+        assert d.bound(None) <= 1.0
+        assert Deadline(-1.0).bound(10.0) == 0.0
+
+
+class TestRetryPolicy:
+    def test_decorrelated_jitter_bounds(self):
+        policy = RetryPolicy(base_secs=0.01, cap_secs=0.5, max_attempts=4)
+        prev = None
+        for _ in range(100):
+            prev = policy.next_delay(prev)
+            assert 0.01 <= prev <= 0.5
+
+
+class TestRetryBudget:
+    def test_deposits_fund_retries(self):
+        budget = RetryBudget(ratio=0.5, min_tokens=0.0, cap=10.0)
+        assert not budget.try_spend()  # cold, no seed
+        for _ in range(4):
+            budget.deposit()  # 4 * 0.5 = 2 tokens
+        assert budget.try_spend()
+        assert budget.try_spend()
+        assert not budget.try_spend()  # drained
+
+    def test_seed_and_cap(self):
+        budget = RetryBudget(ratio=1.0, min_tokens=2.0, cap=3.0)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()
+        for _ in range(100):
+            budget.deposit()
+        assert budget.balance == 3.0  # capped
+
+
+class TestCircuitBreaker:
+    def test_open_after_threshold_and_single_probe_recovery(self):
+        transitions = []
+        b = CircuitBreaker(failure_threshold=2, reset_secs=0.05,
+                           on_transition=transitions.append)
+        assert b.try_acquire()
+        b.record_failure()
+        assert b.state == CLOSED  # 1 of 2
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.can_attempt() and not b.try_acquire()
+        time.sleep(0.06)
+        assert b.can_attempt()
+        assert b.try_acquire()  # the single half-open probe
+        assert b.state == HALF_OPEN
+        assert not b.try_acquire()  # second probe refused
+        b.record_success(probe=True)
+        assert b.state == CLOSED
+        assert transitions == [OPEN, HALF_OPEN, CLOSED]
+
+    def test_failed_probe_reopens(self):
+        b = CircuitBreaker(failure_threshold=1, reset_secs=0.05)
+        b.record_failure()
+        assert b.state == OPEN
+        time.sleep(0.06)
+        assert b.try_acquire()
+        b.record_failure(probe=True)
+        assert b.state == OPEN
+        assert not b.try_acquire()  # fresh reset window
+
+    def test_release_probe_frees_the_slot(self):
+        """A probe that ends with no health verdict (deadline ran out,
+        application error, caller closed the stream) must return the
+        half-open slot — a leaked slot locks the instance out forever."""
+        b = CircuitBreaker(failure_threshold=1, reset_secs=0.01)
+        b.record_failure()
+        time.sleep(0.02)
+        assert b.try_acquire()  # the probe goes out
+        assert not b.try_acquire()
+        b.release_probe()  # verdict-less exit by the probe owner
+        assert b.state == HALF_OPEN
+        assert b.can_attempt() and b.try_acquire()  # next probe admitted
+
+    def test_reset_clears_state(self):
+        b = CircuitBreaker(failure_threshold=1, reset_secs=60.0)
+        b.record_failure()
+        assert b.state == OPEN
+        b.reset()
+        assert b.state == CLOSED and b.try_acquire()
+
+
+async def _tcp_server():
+    server = TcpRequestServer("127.0.0.1", 0, advertise_host="127.0.0.1")
+    await server.start()
+    return server
+
+
+@pytest.mark.parametrize("kind", ["tcp", "http"])
+class TestRequestPlaneDeadline:
+    """Wire-level contract: the server refuses expired budgets before
+    dispatch, cancels overrunning handlers at the deadline, and the
+    client surfaces DeadlineExceeded (never a bare timeout)."""
+
+    async def _server(self, kind):
+        if kind == "tcp":
+            return await _tcp_server()
+        from dynamo_tpu.runtime.request_plane import HttpRequestServer
+
+        server = HttpRequestServer("127.0.0.1", 0, advertise_host="127.0.0.1")
+        await server.start()
+        return server
+
+    def test_expired_deadline_refused_before_dispatch(self, run, kind):
+        async def body():
+            server = await self._server(kind)
+            dispatched = []
+
+            async def handler(req, ctx):
+                dispatched.append(req)
+                yield {"ok": True}
+
+            server.registry.register("s/dl", handler)
+            client = RequestClient()
+            with pytest.raises(DeadlineExceeded):
+                async for _ in client.call(server.address, "s/dl", {},
+                                           {"x-dynt-deadline-ms": 0}):
+                    pass
+            assert dispatched == []  # never occupied the worker
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_handler_cancelled_at_deadline(self, run, kind):
+        async def body():
+            server = await self._server(kind)
+            stopped = asyncio.Event()
+
+            async def handler(req, ctx):
+                try:
+                    yield {"first": True}
+                    await asyncio.sleep(30.0)  # would hold the slot 30s
+                    yield {"never": True}
+                except asyncio.CancelledError:
+                    stopped.set()
+                    raise
+
+            server.registry.register("s/slow", handler)
+            client = RequestClient()
+            start = time.monotonic()
+            got = []
+            with pytest.raises(DeadlineExceeded):
+                async for item in client.call(server.address, "s/slow", {},
+                                              {"x-dynt-deadline-ms": 300}):
+                    got.append(item)
+            elapsed = time.monotonic() - start
+            assert got == [{"first": True}]
+            assert elapsed < 5.0, elapsed  # not the 30s handler sleep
+            # the server-side watchdog cancelled the handler: the worker
+            # slot is free well before the handler's own sleep ends
+            await asyncio.wait_for(stopped.wait(), 2.0)
+            await client.close()
+            await server.close()
+
+        run(body())
+
+    def test_context_remaining_exposes_budget(self, run, kind):
+        async def body():
+            server = await self._server(kind)
+            seen = {}
+
+            async def handler(req, ctx):
+                seen["remaining"] = ctx.remaining()
+                seen["default"] = ctx.remaining(default=123.0)
+                yield {"ok": True}
+
+            server.registry.register("s/rem", handler)
+            client = RequestClient()
+            out = [x async for x in client.call(
+                server.address, "s/rem", {}, {"x-dynt-deadline-ms": 5000})]
+            assert out == [{"ok": True}]
+            assert 0.0 < seen["remaining"] <= 5.0
+            assert seen["default"] <= 5.0  # real deadline wins over default
+            out = [x async for x in client.call(server.address, "s/rem", {})]
+            assert out == [{"ok": True}]
+            assert seen["remaining"] is None  # no deadline propagated
+            assert seen["default"] == 123.0
+            await client.close()
+            await server.close()
+
+        run(body())
+
+
+class TestMemPlaneDeadline:
+    def test_mem_plane_refuses_expired(self, run):
+        from dynamo_tpu.runtime.request_plane import MemRequestPlane
+
+        async def body():
+            server = MemRequestPlane.create_server()
+
+            async def handler(req, ctx):
+                yield {"ok": True}
+
+            server.registry.register("s/m", handler)
+            with pytest.raises(DeadlineExceeded):
+                async for _ in MemRequestPlane.call(
+                        server.address, "s/m", {},
+                        {"x-dynt-deadline-ms": 0}):
+                    pass
+            out = [x async for x in MemRequestPlane.call(
+                server.address, "s/m", {}, {"x-dynt-deadline-ms": 5000})]
+            assert out == [{"ok": True}]
+            await server.close()
+
+        run(body())
+
+
+def _cfg(cluster):
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    return cfg
+
+
+async def _fake_instance(rt, ep, instance_id: int) -> None:
+    """Advertise an instance whose wire subject has NO registered handler:
+    dialing it fails with EndpointNotFound — a transport-class fault the
+    router retries (unlike handler exceptions, which are application
+    errors and must NOT trip breakers)."""
+    await rt.put_leased(f"{ep.instance_prefix}{instance_id}", {
+        "instance_id": instance_id,
+        "address": rt.request_server.address,
+        "subject": f"{ep.subject}/{instance_id}",
+        "endpoint": ep.subject,
+    })
+
+
+class TestRouterResilience:
+    def test_breaker_opens_and_recovers_via_probe(self, run):
+        """A faulted instance trips its breaker; after reset_secs the single
+        half-open probe re-admits it — the open->half_open->closed ladder."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+
+            async def healthy(req, ctx):
+                yield {"ok": True}
+
+            ep = rt.namespace("rz").component("w").endpoint("gen")
+            await ep.serve_endpoint(healthy, instance_id=2)
+            # instance 1 is advertised but its wire subject dangles: every
+            # dial fails like a dead peer
+            await _fake_instance(rt, ep, 1)
+            client = ep.client()
+            await client.wait_for_instances(2, timeout=5.0)
+            from dynamo_tpu.runtime.resilience import BreakerBoard
+
+            router = PushRouter(
+                client, mode="round_robin",
+                retry_policy=RetryPolicy(0.001, 0.005, 3),
+                retry_budget=RetryBudget(ratio=1.0, min_tokens=10.0),
+                breakers=BreakerBoard("rz/w/gen", failure_threshold=1,
+                                      reset_secs=0.2),
+            )
+            # Drive until instance 1's failure trips its breaker; every
+            # request still completes (retry lands on instance 2).
+            for _ in range(4):
+                out = [x async for x in router.generate({})]
+                assert out == [{"ok": True}]
+            breaker = router.breakers.get(1)
+            assert breaker.state == OPEN
+            assert router.available() == [2]
+            # Heal: register the missing handler, wait out the reset
+            # window — the next pick may probe instance 1.
+            rt.request_server.registry.register(f"{ep.subject}/1", healthy)
+            await asyncio.sleep(0.25)
+            assert 1 in router.available()
+            for _ in range(6):
+                out = [x async for x in router.generate({})]
+                assert out == [{"ok": True}]
+            assert breaker.state == CLOSED
+            await rt.shutdown()
+
+        run(body(), timeout=30.0)
+
+    def test_retry_budget_exhaustion_stops_storm(self, run):
+        """With every instance dead and the budget drained, the router
+        fails fast instead of multiplying retries."""
+        from dynamo_tpu.runtime.request_plane import EndpointNotFound
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            ep = rt.namespace("rz2").component("w").endpoint("gen")
+            for iid in (1, 2, 3):
+                await _fake_instance(rt, ep, iid)
+            client = ep.client()
+            await client.wait_for_instances(3, timeout=5.0)
+            from dynamo_tpu.runtime.resilience import BreakerBoard
+
+            budget = RetryBudget(ratio=0.1, min_tokens=1.0)
+            router = PushRouter(
+                client, mode="round_robin",
+                retry_policy=RetryPolicy(0.001, 0.002, 10),
+                retry_budget=budget,
+                breakers=BreakerBoard("rz2/w/gen", failure_threshold=99,
+                                      reset_secs=0.1),
+            )
+            with pytest.raises(EndpointNotFound):
+                async for _ in router.generate({}):
+                    pass
+            # seed was 1 token: exactly one retry was admitted, then the
+            # budget denied the rest (no storm against 3 dead workers)
+            assert budget.balance < 1.0
+            assert not budget.try_spend()
+            await rt.shutdown()
+
+        run(body(), timeout=30.0)
+
+    def test_router_deadline_bounds_dispatch(self, run):
+        """An expired deadline fails routing immediately; a live one is
+        forwarded so the server can refuse late requests."""
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            seen = []
+
+            async def handler(req, ctx):
+                seen.append(ctx.remaining())
+                yield {"ok": True}
+
+            ep = rt.namespace("rz3").component("w").endpoint("gen")
+            await ep.serve_endpoint(handler, instance_id=1)
+            client = ep.client()
+            await client.wait_for_instances(1, timeout=5.0)
+            router = PushRouter(client, mode="round_robin")
+            out = [x async for x in router.generate(
+                {}, deadline=Deadline(5.0))]
+            assert out == [{"ok": True}]
+            assert seen and 0.0 < seen[0] <= 5.0  # forwarded on the wire
+            with pytest.raises(DeadlineExceeded):
+                async for _ in router.generate({}, deadline=Deadline(-1.0)):
+                    pass
+            await rt.shutdown()
+
+        run(body(), timeout=30.0)
+
+
+class TestMigrationDeadline:
+    def test_migration_stops_when_budget_spent(self, run):
+        """Migration replay consumes the request's remaining budget: with
+        the deadline expired it reports the overrun instead of replaying
+        into a worker the client already abandoned."""
+        from dynamo_tpu.llm.engine import Migration, TokenEngine
+        from dynamo_tpu.llm.protocols import (
+            EngineOutput,
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.request_plane import ConnectionLost
+
+        class AlwaysBroken(TokenEngine):
+            def __init__(self):
+                self.attempts = 0
+
+            async def generate(self, request):
+                self.attempts += 1
+                yield EngineOutput(token_ids=[self.attempts])
+                raise ConnectionLost("gone")
+
+        async def body():
+            inner = AlwaysBroken()
+            migration = Migration(inner, migration_limit=10_000,
+                                  retry_policy=RetryPolicy(0.01, 0.02, 3))
+            request = PreprocessedRequest(
+                request_id="rz", token_ids=[1, 2],
+                sampling=SamplingOptions(max_tokens=100),
+                stop=StopConditions(),
+                deadline=Deadline(0.05),
+            )
+            outs = [o async for o in migration.generate(request)]
+            assert outs[-1].finish_reason == "error"
+            assert "deadline exceeded" in outs[-1].error
+            # far fewer than migration_limit attempts: the budget, not
+            # the attempt cap, ended the replay loop
+            assert inner.attempts < 100
+
+        run(body(), timeout=30.0)

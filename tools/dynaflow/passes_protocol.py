@@ -56,7 +56,10 @@ class Plane:
 
 DEFAULT_PLANES = (
     Plane("request_plane",
-          ("runtime/request_plane.py", "runtime/codec.py"),
+          # resilience.py is part of the plane: Deadline.to_wire/from_wire
+          # own the x-dynt-deadline-ms header fragment every hop forwards.
+          ("runtime/request_plane.py", "runtime/codec.py",
+           "runtime/resilience.py"),
           ("write_frame", "encode_frame", "_send", "send", "_http_frame",
            "put_nowait"),
           ("header", "frame"),
