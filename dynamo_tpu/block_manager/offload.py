@@ -98,7 +98,22 @@ class OffloadManager:
                     self._cond.notify_all()
 
     def _offload_batch(self, batch: list[tuple[int, Optional[int]]]) -> None:
+        from ..runtime.otel import get_tracer
+
         hashes = [h for h, _ in batch]
+        # Offload is background maintenance with no owning request: each
+        # batch gets a root span of its own so tier pressure is visible
+        # in the trace backend without inventing a fake parent.
+        tracer = get_tracer()
+        span = tracer.start_span("kvbm.offload", **{"blocks": len(batch)})
+        ok = False
+        try:
+            self._do_offload_batch(batch, hashes, span)
+            ok = True
+        finally:
+            span.end(ok=ok)
+
+    def _do_offload_batch(self, batch, hashes, span) -> None:
 
         def gather_on_sched():
             # Resolve hash->page at gather time ON the scheduler thread:
@@ -126,6 +141,7 @@ class OffloadManager:
         # The slow half, off the step thread: one contiguous D2H of the
         # whole bundle (np.asarray of a device array), then per-block sink.
         bundle = np.asarray(bundle)
+        span.set_attribute("bytes", int(bundle.nbytes))
         for j, i in enumerate(keep):
             h, parent = batch[i]
             self._sink(h, np.asarray(bundle[j]), parent)

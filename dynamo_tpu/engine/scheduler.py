@@ -38,6 +38,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
 from ..tokens import compute_block_hashes
 from .model_runner import ModelRunner, bucket_table_width
@@ -90,6 +91,16 @@ class _Seq:
     # such sequences fuse only while their remaining token budget covers
     # the block, else the batch degrades to per-token.
     slack_ok: bool = True
+    # Flight-recorder timeline key (worker.generate qualifies prefill
+    # legs); None for bare-scheduler callers — stamps then no-op.
+    record_id: Optional[str] = None
+    # The worker.generate span's context: scheduler-side spans (kvbm
+    # onload) parent here so their wall time lands inside the worker
+    # subtree, not as a sibling of the dispatch under the frontend span.
+    traceparent: Optional[str] = None
+    # prefill_start stamped (keeps the hot chunk loop from taking the
+    # recorder lock once per iteration per prefilling sequence)
+    prefill_stamped: bool = False
 
     @property
     def decode_ready(self) -> bool:
@@ -206,6 +217,8 @@ class InferenceScheduler:
         onboard_first_token: Optional[int] = None,
         lora_idx: int = 0,
         media_embeds: Optional[np.ndarray] = None,
+        record_id: Optional[str] = None,
+        traceparent: Optional[str] = None,
     ) -> "_SubmitHandle":
         handle = _SubmitHandle()
         self._incoming.put((request, emit, handle, {
@@ -215,6 +228,8 @@ class InferenceScheduler:
             "onboard_first_token": onboard_first_token,
             "lora_idx": lora_idx,
             "media_embeds": media_embeds,
+            "record_id": record_id,
+            "traceparent": traceparent,
         }))
         self._wake.set()
         return handle
@@ -294,6 +309,8 @@ class InferenceScheduler:
                 seq.onboard_first_token = extra.get("onboard_first_token")
                 seq.lora_idx = extra.get("lora_idx", 0)
                 seq.media_embeds = extra.get("media_embeds")
+                seq.record_id = extra.get("record_id")
+                seq.traceparent = extra.get("traceparent")
                 handle.seq = seq
                 if handle._cancelled:  # cancelled before the seq existed
                     seq.cancelled = True
@@ -432,6 +449,10 @@ class InferenceScheduler:
             seq.slot = free_slots[0]
             self._slots[seq.slot] = seq
             self._waiting.pop(0)
+            if seq.record_id is not None:
+                # Admission = end of queue wait (first write wins, so a
+                # page-starved retry next iteration can't move it).
+                get_recorder().stamp(seq.record_id, "scheduled")
             admitted += 1
             if seq.onboard_blocks is not None:
                 self._onboard(seq)
@@ -451,19 +472,49 @@ class InferenceScheduler:
         n = self.kvbm.match_prefix(candidates)
         if n == 0:
             return
-        target = seq.block_table[cached_n : cached_n + n]
-        if hasattr(self.kvbm, "onboard_direct"):
-            # Distributed KVBM: the bytes never assemble on one host —
-            # every rank scatters its own shards (mirrored call).
-            if not self.kvbm.onboard_direct(
-                    candidates[:n], np.asarray(target, np.int32),
-                    self.runner):
-                return
-        else:
-            bundle = self.kvbm.read_blocks(candidates[:n])
-            if bundle is None:
-                return
-            self.runner.scatter_pages(np.asarray(target, np.int32), bundle)
+        from ..runtime.otel import get_tracer
+
+        # Onload is synchronous on the request's critical path (it
+        # replaces prefill compute): parent it under the worker span so
+        # the trade shows up inside the worker leg that performed it
+        # (annotation fallback for bare-scheduler callers).
+        span = get_tracer().start_span(
+            "kvbm.onload",
+            parent=seq.traceparent
+            or (seq.request.annotations or {}).get("traceparent"),
+            **{"request.id": seq.request.request_id, "blocks": n})
+        ok = False
+        miss = False
+        try:
+            target = seq.block_table[cached_n : cached_n + n]
+            if hasattr(self.kvbm, "onboard_direct"):
+                # Distributed KVBM: the bytes never assemble on one host —
+                # every rank scatters its own shards (mirrored call).
+                if not self.kvbm.onboard_direct(
+                        candidates[:n], np.asarray(target, np.int32),
+                        self.runner):
+                    miss = True
+                    return
+            else:
+                bundle = self.kvbm.read_blocks(candidates[:n])
+                if bundle is None:
+                    miss = True
+                    return
+                self.runner.scatter_pages(np.asarray(target, np.int32),
+                                          bundle)
+            ok = True
+        finally:
+            if miss:
+                # Block evicted between match and read (or a rank
+                # declined): a designed degrade to recompute, not an
+                # error — a healthy request must export no ERROR spans.
+                span.add_event("miss")
+                span.end(ok=True)
+            else:
+                span.end(ok=ok)
+        if seq.record_id is not None:
+            get_recorder().event(seq.record_id, "kvbm_onload", blocks=n,
+                                 tokens=n * self.page_size)
         seq.prefill_pos = (cached_n + n) * self.page_size
         self.stats.kvbm_onboarded_blocks += n
         log.info("kvbm onboard: %d blocks (skipping %d prefill tokens) for %s",
@@ -554,6 +605,10 @@ class InferenceScheduler:
                 and not seq.decode_ready and _ring_eligible(seq)]
         if ring:
             tokens = 0
+            for seq in ring:
+                if seq.record_id is not None and not seq.prefill_stamped:
+                    seq.prefill_stamped = True
+                    get_recorder().stamp(seq.record_id, "prefill_start")
             result = self.runner.prefill_ring_batch(
                 [np.asarray(s.request.token_ids[: s.prompt_len],  # dynalint: disable=DL201 -- host token list to int32, no device transfer
                             np.int32)
@@ -579,6 +634,10 @@ class InferenceScheduler:
         for seq in self._slots:
             if seq is None or seq.cancelled or seq.decode_ready:
                 continue
+            if seq.record_id is not None and not seq.prefill_stamped:
+                # First chunk of real prefill compute only.
+                seq.prefill_stamped = True
+                get_recorder().stamp(seq.record_id, "prefill_start")
             chunk = min(budget, seq.prompt_len - seq.prefill_pos)
             tokens = np.asarray(  # dynalint: disable=DL201 -- host token list to int32, no device transfer
                 seq.request.token_ids[seq.prefill_pos : seq.prefill_pos + chunk],
@@ -670,6 +729,8 @@ class InferenceScheduler:
             params = seq.on_prefill_done(seq, first_token, page_ids)
             seq.keep_pages = True
         seq.finished = True
+        if seq.record_id is not None:
+            get_recorder().stamp(seq.record_id, "first_token")
         seq.emit(EngineOutput(
             token_ids=[], finish_reason="stop",
             prompt_tokens=seq.prompt_len,
@@ -912,6 +973,8 @@ class InferenceScheduler:
                       prompt_tokens: Optional[int] = None,
                       sample_info: Optional[tuple] = None) -> None:
         seq.generated.append(token)
+        if len(seq.generated) == 1 and seq.record_id is not None:
+            get_recorder().stamp(seq.record_id, "first_token")
         seq.last_token = token
         request = seq.request
         finish = None

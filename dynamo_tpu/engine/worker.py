@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import AsyncIterator, Optional
 
 from ..kv_router.protocols import (
@@ -672,11 +673,20 @@ class TpuWorker:
         """Decode workers pull parked prefill KV here: gather the pages on
         the scheduler thread (the cache buffer is donated through steps),
         then stream chunked binary frames."""
+        from ..runtime.otel import get_tracer
+
         transfer_id = (body or {}).get("transfer_id", "")
+        # Server half of the transfer trace: child of the decode side's
+        # kv_transfer.pull via the wire traceparent.
+        span = get_tracer().start_span(
+            "kv_transfer.serve",
+            parent=getattr(ctx, "traceparent", None), kind=2,
+            **{"transfer.id": transfer_id})
         # Claim removes the entry atomically: TTL expiry can no longer
         # release (and let the pool reuse) these pages mid-gather.
         transfer = self.transfers.claim(transfer_id)
         if transfer is None:
+            span.end(ok=False)
             yield {"error": f"unknown transfer {transfer_id}"}
             return
         try:
@@ -709,34 +719,65 @@ class TpuWorker:
             except Exception as exc_:  # noqa: BLE001
                 yield {"error": f"gather readback failed: {exc_!r}"}
                 return
+            span.set_attribute("pages", len(page_ids))
+            span.set_attribute("bytes",
+                               len(page_ids) * transfer.layout.page_bytes())
             for frame in encode_block_chunks(blocks, transfer.layout):
                 yield frame
+            span.end(ok=True)
         finally:
             # Runs even when the decode side disconnects mid-stream (the
-            # generator is aclose()d): pages go back to the pool now, not
-            # after the TTL.
+            # generator is aclose()d): close the span and return the
+            # pages to the pool now, not after the TTL.
+            span.end(ok=False)
             transfer.release()
 
     # -- disaggregation: decode-side onboard -------------------------------
 
-    async def _pull_remote_kv(self, params: dict, deadline=None):
+    async def _pull_remote_kv(self, params: dict, deadline=None,
+                              traceparent=None, record_id=None):
         """Pull prefill KV blocks from the prefill worker. Returns the
         assembled bundle or None (caller falls back to local prefill —
         the aggregated-recompute fallback the reference also takes when
         transfer fails). `deadline` is the request's REMAINING end-to-end
         budget (ctx.deadline): the pull's frame waits are bounded by it
-        instead of a fresh flat timeout."""
-        from ..runtime.push_router import PushRouter
+        instead of a fresh flat timeout. The pull leg is traced
+        (kv_transfer.pull, with link/bytes/pages attributes) and recorded
+        on the request's flight-recorder timeline."""
+        from ..runtime.otel import get_tracer
 
         if params.get("mock") or "layout" not in params:
             return None  # mocker handoff carries no data; recompute
-        if (self.ici_bridge is not None
-                and params.get("bridge_token") == self.ici_bridge.token):
+        link = ("ici" if self.ici_bridge is not None
+                and params.get("bridge_token") == self.ici_bridge.token
+                else "dcn")
+        span = get_tracer().start_span(
+            "kv_transfer.pull", parent=traceparent, kind=3,
+            **{"transfer.id": params.get("transfer_id", ""), "link": link})
+        try:
+            blocks = await self._pull_remote_kv_inner(
+                params, deadline, span, traceparent, record_id, link)
+            if blocks is not None:
+                span.end(ok=True)
+            return blocks
+        finally:
+            span.end(ok=False)  # fallback paths; success already ended
+
+    async def _pull_remote_kv_inner(self, params: dict, deadline, span,
+                                    traceparent, record_id, link):
+        from ..runtime.flight_recorder import get_recorder
+        from ..runtime.push_router import PushRouter
+
+        if link == "ici":
             # Same process, co-meshed pools: direct chip-to-chip pull over
             # ICI (device bundle, no host relay). Any failure degrades to
             # the recompute fallback like the wire path.
-            return await self.ici_bridge.pull(params["transfer_id"],
-                                              self.runner)
+            blocks = await self.ici_bridge.pull(params["transfer_id"],
+                                                self.runner)
+            if blocks is not None:
+                get_recorder().event(record_id, "kv_pull", link="ici",
+                                     transfer_id=params["transfer_id"])
+            return blocks
         remote_layout = KvLayoutDescriptor.from_wire(params["layout"])
         local_layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
         if not remote_layout.compatible(local_layout):
@@ -755,15 +796,19 @@ class TpuWorker:
             await router.client.start()
             self._pull_clients[subject] = router
         assembler = BlockAssembler()
+        pulled_bytes = 0
+        start = time.monotonic()
         try:
             async for frame in router.generate(
                 {"transfer_id": params["transfer_id"]},
                 instance_id=params["instance_id"],
                 deadline=deadline,
+                traceparent=span.traceparent or traceparent,
             ):
                 if frame.get("error"):
                     log.warning("kv pull failed: %s", frame["error"])
                     return None
+                pulled_bytes += len(frame.get("data") or b"")
                 assembler.add(frame)
         except Exception:  # noqa: BLE001 — any transfer failure -> recompute
             log.exception("kv pull transport failure; recomputing prefill")
@@ -772,6 +817,12 @@ class TpuWorker:
             log.warning("kv pull incomplete; recomputing prefill")
             return None
         blocks, _ = assembler.assemble()
+        span.set_attribute("bytes", pulled_bytes)
+        span.set_attribute("pages", int(blocks.shape[0]))
+        get_recorder().event(
+            record_id, "kv_pull", link="dcn", bytes=pulled_bytes,
+            pages=int(blocks.shape[0]),
+            duration_ms=round((time.monotonic() - start) * 1e3, 3))
         # Stage the H2D copy HERE (async context, off the step thread) so
         # admission's scatter only does the cheap fused write — the bulk
         # upload overlaps decode stepping. Failure falls back to the host
@@ -852,20 +903,42 @@ class TpuWorker:
                 embedding=[float(x) for x in vec],
             ).to_wire()
             return
-        traceparent = request.annotations.get("traceparent")
-        if traceparent:
-            # Join worker-side logs to the frontend span (W3C trace context
-            # carried through the request plane).
-            log.debug("request %s traceparent=%s", request.request_id,
-                      traceparent)
-        # Worker span: child of the frontend's server span via the carried
-        # traceparent (ref: logging.rs propagation across the request plane).
-        from ..runtime.otel import get_tracer
+        # W3C trace context: the wire header (first-class, ctx.traceparent)
+        # wins; the annotation side-channel keeps legacy peers working.
+        traceparent = None
+        if ctx is not None:
+            traceparent = getattr(ctx, "traceparent", None)
+        traceparent = traceparent or request.annotations.get("traceparent")
+        from ..runtime.flight_recorder import get_recorder
+        from ..runtime.logging import current_request_id
+        from ..runtime.otel import get_tracer, trace_id_of
 
-        worker_span = get_tracer().start_span(
-            "worker.generate", parent=traceparent,
+        current_request_id.set(request.request_id)
+        prefill_only = (self.mode == "prefill"
+                        or bool(request.annotations.get("prefill_only")))
+        tracer = get_tracer()
+        # Worker span: child of the router's dispatch span via the carried
+        # traceparent (ref: logging.rs propagation across the request plane).
+        worker_span = tracer.start_span(
+            "worker.generate", parent=traceparent, kind=2,
             **{"request.id": request.request_id, "worker.mode": self.mode,
-               "instance.id": self.instance_id})
+               "instance.id": f"{self.instance_id:x}",
+               "prefill.only": prefill_only})
+        recorder = get_recorder()
+        # Prefill legs reuse the decode request's id: qualify the record
+        # key so both legs keep their own timeline when the pools share a
+        # process (comesh). Canary probes never open a timeline.
+        rec_id = (f"{request.request_id}#prefill" if prefill_only
+                  else request.request_id)
+        if not request.annotations.get("canary"):
+            # Fall back to the wire traceparent's trace id when local
+            # span export is disabled (_NoopSpan.trace_id is "") so
+            # /debug/requests timelines still correlate to the client's
+            # trace — same contract as the HTTP/kserve frontends.
+            recorder.start(rec_id, model=request.model,
+                           trace_id=worker_span.trace_id
+                           or trace_id_of(traceparent))
+        status = "error"
         try:
             loop = asyncio.get_running_loop()
             out_queue: asyncio.Queue = asyncio.Queue()
@@ -874,8 +947,6 @@ class TpuWorker:
                 loop.call_soon_threadsafe(out_queue.put_nowait, output)
 
             submit_kwargs: dict = {}
-            prefill_only = (self.mode == "prefill"
-                            or bool(request.annotations.get("prefill_only")))
             if prefill_only:
                 submit_kwargs.update(
                     prefill_only=True,
@@ -884,7 +955,9 @@ class TpuWorker:
             elif request.disaggregated_params:
                 blocks = await self._pull_remote_kv(
                     request.disaggregated_params,
-                    deadline=ctx.deadline if ctx is not None else None)
+                    deadline=ctx.deadline if ctx is not None else None,
+                    traceparent=worker_span.traceparent or traceparent,
+                    record_id=rec_id)
                 if blocks is not None:
                     submit_kwargs.update(
                         onboard_blocks=blocks,
@@ -942,24 +1015,85 @@ class TpuWorker:
                     ).to_wire()
                     return
                 submit_kwargs["lora_idx"] = slot
-            handle = self.scheduler.submit(request, emit, **submit_kwargs)
-            ok = True
+            recorder.stamp(rec_id, "queued")
+            handle = self.scheduler.submit(
+                request, emit, record_id=rec_id,
+                traceparent=worker_span.traceparent or traceparent,
+                **submit_kwargs)
             try:
+                saw_error = False
                 while True:
                     output: EngineOutput = await out_queue.get()
-                    if output.error is not None:
-                        ok = False
-                    yield output.to_wire()
+                    saw_error = saw_error or output.error is not None
                     if output.finish_reason is not None:
+                        status = "error" if saw_error else "ok"
+                        yield output.to_wire()
                         return
+                    yield output.to_wire()
             finally:
                 handle.cancel()
-                worker_span.end(ok=ok)
+        except asyncio.CancelledError:
+            # Watchdog (deadline) cancel or the client went away: both
+            # must close the span as not-ok instead of leaking an
+            # open-looking success (satellite: span loss on abnormal ends).
+            status = "cancelled"
+            if ctx is not None and ctx.deadline is not None \
+                    and ctx.deadline.expired():
+                status = "deadline_exceeded"
+            raise
+        except GeneratorExit:
+            # The request-plane server aclose()s the handler generator
+            # when a cancel frame races its _send backpressure wait
+            # (request_plane.py cancel handling): an ordinary client
+            # cancel, not an error — don't WARNING-dump the timeline.
+            # Keep "ok" when the close raced the FINAL yield (the finish
+            # frame was already delivered and decided the status).
+            if status == "error":
+                status = "cancelled"
+            raise
         finally:
-            # Idempotent backstop: any exception between span
-            # creation and the instrumented exits (kv pull,
-            # submit) must still export the span.
-            worker_span.end(ok=False)
+            # One exit for every path (early error yields, exceptions,
+            # cancellation, clean finish): close the timeline, synthesize
+            # phase spans from it, then export the worker span. finish()
+            # returns None when another component (shared-process
+            # frontend) closed it first — fall back to a lookup.
+            timeline = (recorder.finish(rec_id, status)
+                        or recorder.get(rec_id))
+            self._record_phase_trace(tracer, worker_span, timeline,
+                                     prefill_only)
+            worker_span.end(ok=status == "ok")
+
+    def _record_phase_trace(self, tracer, worker_span, timeline,
+                            prefill_only: bool = False) -> None:
+        """Attach the flight-recorder phases to the worker span as span
+        events and synthesize explicit-timestamp child spans for the
+        queue-wait / prefill / decode segments — the per-phase breakdown
+        the trace needs without holding live spans across the scheduler
+        thread."""
+        if not tracer.enabled:
+            return
+        parent = worker_span.traceparent
+        if timeline is None or not parent:
+            return
+        phases = timeline.phases
+        for phase, ts in sorted(phases.items(), key=lambda kv: kv[1]):
+            worker_span.add_event(phase, ts=ts)
+
+        def _ns(key: str) -> int:
+            return int(phases[key] * 1e9)
+
+        if "queued" in phases and "scheduled" in phases:
+            tracer.record_span("scheduler.queue", parent,
+                               _ns("queued"), _ns("scheduled"))
+        if "prefill_start" in phases and "first_token" in phases:
+            tracer.record_span("worker.prefill", parent,
+                               _ns("prefill_start"), _ns("first_token"))
+        if "first_token" in phases and "finished" in phases \
+                and not prefill_only:
+            # Prefill-only legs never decode: first_token..finished there
+            # is transfer-table handoff, not a decode segment.
+            tracer.record_span("worker.decode", parent,
+                               _ns("first_token"), _ns("finished"))
 
     async def close(self) -> None:
         if self._publish_task is not None and not self._publish_task.done():

@@ -36,6 +36,8 @@ class Frontend:
         audit_sinks: Optional[str] = None,
         record_path: Optional[str] = None,
         namespace_filter: Optional[str] = None,
+        slo_ttft_ms: Optional[float] = None,
+        slo_itl_ms: Optional[float] = None,
     ) -> None:
         self.runtime = runtime
         self.manager = ModelManager()
@@ -60,6 +62,7 @@ class Frontend:
         self.http = HttpService(
             self.manager, host=host, port=port, busy_threshold=busy_threshold,
             audit=self.audit, recorder=self.recorder, runtime=runtime,
+            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
         )
         self.kserve = None
         if kserve_grpc_port is not None:
@@ -118,6 +121,14 @@ def build_arg_parser():
                         help="only serve models from this namespace (e.g. "
                              "'global' to front a global router; default: "
                              "all namespaces)")
+    parser.add_argument("--slo-ttft-ms", type=float, default=None,
+                        help="TTFT goodput target feeding "
+                             "dynamo_slo_good_total (default: "
+                             "DYNT_SLO_TTFT_MS; 0 = no requirement)")
+    parser.add_argument("--slo-itl-ms", type=float, default=None,
+                        help="worst-token ITL goodput target feeding "
+                             "dynamo_slo_good_total (default: "
+                             "DYNT_SLO_ITL_MS; 0 = no requirement)")
     return parser
 
 
@@ -137,6 +148,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
         audit_sinks=args.audit_sinks,
         record_path=args.record,
         namespace_filter=args.namespace,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_itl_ms=args.slo_itl_ms,
     )
     await frontend.start()
     log.info("frontend ready on port %d (router=%s)", frontend.port,

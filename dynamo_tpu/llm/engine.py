@@ -17,8 +17,10 @@ import asyncio
 from typing import AsyncIterator, Optional
 
 from ..kv_router import KvScheduler, WorkerWithDpRank
+from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
 from ..runtime.metrics import DEADLINE_EXCEEDED
+from ..runtime.otel import get_tracer
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.request_plane import ConnectionLost, RemoteError
 from ..runtime.resilience import RetryPolicy
@@ -59,8 +61,15 @@ class RouterEngine(TokenEngine):
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
         async for item in self.router.generate(
                 request.to_wire(), instance_id=_pinned_instance(request),
-                allowed=self._allowed(request), deadline=request.deadline):
+                allowed=self._allowed(request), deadline=request.deadline,
+                traceparent=_traceparent_of(request)):
             yield EngineOutput.from_wire(item)
+
+
+def _traceparent_of(request: PreprocessedRequest) -> Optional[str]:
+    """The trace context the frontend stamped on the request; every
+    pipeline operator parents its spans under it."""
+    return (request.annotations or {}).get("traceparent")
 
 
 def _pinned_instance(request: PreprocessedRequest) -> Optional[int]:
@@ -119,6 +128,7 @@ class KvRouterEngine(TokenEngine):
         from ..kv_router.queue import QueuedRequest
 
         await self.router.client.start()
+        traceparent = _traceparent_of(request)
         pinned_instance = _pinned_instance(request)
         if pinned_instance is not None:
             # External endpoint picker owns placement (gateway EPP header
@@ -126,7 +136,7 @@ class KvRouterEngine(TokenEngine):
             # load already includes this request.
             async for item in self.router.generate(
                     request.to_wire(), instance_id=pinned_instance,
-                    deadline=request.deadline):
+                    deadline=request.deadline, traceparent=traceparent):
                 yield EngineOutput.from_wire(item)
             return
         avail = self.router.available()
@@ -146,21 +156,37 @@ class KvRouterEngine(TokenEngine):
         )
         candidates = [WorkerWithDpRank(iid) for iid in avail]
         request_id = request.request_id
-        # schedule() books the request into the slot tracker (add_request)
-        # as part of the decision, so a drained backlog can't dogpile.
-        result = await self.queue.schedule(QueuedRequest(
-            candidates=candidates,
-            block_hashes=block_hashes,
-            isl_tokens=len(request.token_ids),
-            priority_jump=_priority_of(request),
-            pinned=pinned,
-            request_id=request_id,
-        ))
+        # Router-selection span: queue wait (saturation parking) plus the
+        # KV-match verdict — which worker won and at what cached overlap.
+        sspan = get_tracer().start_span(
+            "router.schedule", parent=traceparent,
+            **{"request.id": request_id, "candidates": len(candidates)})
+        try:
+            # schedule() books the request into the slot tracker
+            # (add_request) as part of the decision, so a drained backlog
+            # can't dogpile.
+            result = await self.queue.schedule(QueuedRequest(
+                candidates=candidates,
+                block_hashes=block_hashes,
+                isl_tokens=len(request.token_ids),
+                priority_jump=_priority_of(request),
+                pinned=pinned,
+                request_id=request_id,
+            ))
+            sspan.set_attribute("worker.instance",
+                                f"{result.worker.worker_id:x}")
+            sspan.set_attribute("kv.overlap_blocks", result.overlap_blocks)
+            sspan.set_attribute("router.logit", float(result.logit))
+            sspan.end(ok=True)
+        finally:
+            # Cancelled/errored while parked: close the span so queue
+            # waits that never scheduled still show up in the trace.
+            sspan.end(ok=False)
         first = True
         try:
             async for item in self.router.generate(
                 request.to_wire(), instance_id=result.worker.worker_id,
-                deadline=request.deadline,
+                deadline=request.deadline, traceparent=traceparent,
             ):
                 if first:
                     self.scheduler.mark_prefill_completed(request_id)
@@ -283,6 +309,18 @@ class Migration(TokenEngine):
                     return
                 log.info("migrating %s (attempt %d, %d tokens preserved)",
                          request.request_id, attempts, len(generated))
+                # Replay marker on the trace + flight record: the worker
+                # leg is being replaced, tokens preserved.
+                get_tracer().start_span(
+                    "migration.replay", parent=_traceparent_of(request),
+                    **{"request.id": request.request_id,
+                       "attempt": attempts,
+                       "tokens.preserved": len(generated),
+                       "cause": repr(exc)}).end(ok=True)
+                get_recorder().event(request.request_id, "migration",
+                                     attempt=attempts,
+                                     tokens_preserved=len(generated),
+                                     cause=str(exc))
                 sampling = type(request.sampling)(**{
                     **request.sampling.to_wire(), "max_tokens": remaining
                 })

@@ -27,10 +27,13 @@ from aiohttp import web
 
 from ..runtime import metrics as rt_metrics
 from ..runtime.config import env
+from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import current_request_id, get_logger
+from ..runtime.otel import get_tracer, trace_id_of
 from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
 from ..runtime.resilience import Deadline, DeadlineExceeded
+from ..runtime.status import debug_requests_response, metrics_response
 from .manager import ModelEntry, ModelManager
 from .preprocessor import DeltaGenerator, RequestError
 from .protocols import (
@@ -49,6 +52,80 @@ def _error_body(status: int, message: str, err_type: str = "invalid_request_erro
     return {"error": {"message": message, "type": err_type, "code": status}}
 
 
+def _trace_id_of(preprocessed: PreprocessedRequest) -> str:
+    """Trace id carried on the request (empty when tracing is off) — the
+    exemplar that links a latency observation back to its trace."""
+    return trace_id_of(preprocessed.annotations.get("traceparent"))
+
+
+class _SloObserver:
+    """Per-request latency observer shared by the streaming and aggregate
+    paths: TTFT/ITL histograms (with OpenMetrics trace_id exemplars), the
+    flight-recorder first_token stamp, and the goodput verdict the
+    planner consumes (dynamo_slo_good_total / dynamo_slo_requests_total;
+    an unset target always passes)."""
+
+    def __init__(self, preprocessed: PreprocessedRequest,
+                 ttft_target_ms: float, itl_target_ms: float) -> None:
+        self.model = preprocessed.model
+        self.request_id = preprocessed.request_id
+        trace_id = _trace_id_of(preprocessed)
+        self.exemplar = {"trace_id": trace_id} if trace_id else None
+        self.start = time.monotonic()
+        self.first_at: Optional[float] = None
+        self.last_at: Optional[float] = None
+        self.itl_max = 0.0
+        self.ttft_target_ms = ttft_target_ms
+        self.itl_target_ms = itl_target_ms
+        self._finalized = False
+
+    def on_output(self, output: EngineOutput) -> None:
+        if not output.token_ids:
+            return
+        now = time.monotonic()
+        if self.first_at is None:
+            self.first_at = now
+            rt_metrics.TTFT_SECONDS.labels(model=self.model).observe(
+                now - self.start, exemplar=self.exemplar)
+            get_recorder().stamp(self.request_id, "first_token")
+        elif self.last_at is not None:
+            gap = now - self.last_at
+            rt_metrics.ITL_SECONDS.labels(model=self.model).observe(
+                gap / max(1, len(output.token_ids)), exemplar=self.exemplar)
+            # Worst-token verdict uses the RAW gap: tokens inside one
+            # chunk arrive together, so the chunk's first token waited
+            # the whole gap — averaging would let a long stall hide
+            # inside a large chunk and pass the DYNT_SLO_ITL_MS target.
+            self.itl_max = max(self.itl_max, gap)
+        self.last_at = now
+
+    def finalize_from(self, delta_gen: DeltaGenerator) -> None:
+        """Derive the goodput verdict from the terminal generator state:
+        good means the stream reached a finish_reason and it wasn't
+        "error". Defined once so the streaming and aggregate paths can
+        never diverge on what counts as a good request."""
+        self.finalize(ok=delta_gen.finish_reason is not None
+                      and delta_gen.finish_reason != "error")
+
+    def finalize(self, ok: bool) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        rt_metrics.SLO_REQUESTS.labels(model=self.model).inc()
+        if not ok:
+            return
+        # An unset target always passes: a clean zero-token completion
+        # (first_at None) only fails when a TTFT target is configured —
+        # it never produced the first token that target is about.
+        if self.ttft_target_ms and (
+                self.first_at is None
+                or (self.first_at - self.start) * 1e3 > self.ttft_target_ms):
+            return
+        if self.itl_target_ms and self.itl_max * 1e3 > self.itl_target_ms:
+            return
+        rt_metrics.SLO_GOOD.labels(model=self.model).inc()
+
+
 class HttpService:
     def __init__(
         self,
@@ -59,11 +136,19 @@ class HttpService:
         audit=None,  # Optional[audit.AuditBus]
         recorder=None,  # Optional[audit.Recorder]
         runtime=None,  # Optional[DistributedRuntime]: admin fan-out routes
+        slo_ttft_ms: Optional[float] = None,
+        slo_itl_ms: Optional[float] = None,
     ) -> None:
         self.manager = manager
         self.host = host
         self.port = port
         self.busy_threshold = busy_threshold
+        # Goodput targets for dynamo_slo_good_total (0 = no requirement);
+        # the frontend CLI flags override the DYNT_SLO_* env defaults.
+        self.slo_ttft_ms = (env("DYNT_SLO_TTFT_MS") if slo_ttft_ms is None
+                            else slo_ttft_ms)
+        self.slo_itl_ms = (env("DYNT_SLO_ITL_MS") if slo_itl_ms is None
+                           else slo_itl_ms)
         # Per-model overrides set at runtime via POST /busy_threshold
         # (ref: busy_threshold.rs); the constructor value is the default.
         self.busy_thresholds: dict[str, float] = {}
@@ -159,9 +244,11 @@ class HttpService:
             {"status": "healthy" if models else "no_models", "models": models}
         )
 
-    async def _metrics(self, _request: web.Request) -> web.Response:
-        return web.Response(body=rt_metrics.render(), content_type="text/plain",
-                            charset="utf-8")
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return metrics_response(request)
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        return debug_requests_response(request)
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._completion_common(request, kind="chat")
@@ -170,6 +257,7 @@ class HttpService:
         return await self._completion_common(request, kind="completions")
 
     async def _completion_common(self, request: web.Request, kind: str) -> web.StreamResponse:
+        arrival = time.time()
         try:
             body = await request.json()
         except (ValueError, UnicodeDecodeError):
@@ -196,16 +284,13 @@ class HttpService:
         # ITS OWN context into the request annotations, so worker spans
         # parent under it across the request plane (ref: logging.rs OTLP
         # init + Injector/Extractor propagation).
-        from ..runtime.otel import get_tracer
-
         span = get_tracer().start_span(
-            f"http.{kind}", parent=request.headers.get("traceparent"),
+            "http.chat" if kind == "chat" else "http.completions",
+            parent=request.headers.get("traceparent"),
             kind=2, **{"request.id": preprocessed.request_id,
                        "model": model,
                        "input.tokens": len(preprocessed.token_ids)})
-        tp = span.traceparent or request.headers.get("traceparent")
-        if tp:
-            preprocessed.annotations["traceparent"] = tp
+        self._open_http_trace(request, preprocessed, span, received=arrival)
         # Gateway EPP header contract: an external endpoint picker (e.g.
         # the gateway/ EPP service behind a standard K8s gateway) pins
         # routing via headers — x-worker-instance-id direct-routes the
@@ -221,30 +306,85 @@ class HttpService:
         current_request_id.set(preprocessed.request_id)
         # Everything from here runs under the span: setup failures export
         # it with ok=False via __exit__ — failing requests are exactly the
-        # ones operators need spans for.
-        with span:
-            if self.recorder is not None:
-                self.recorder.record_request(preprocessed.request_id, kind,
-                                             body)
-            # Tool parsing activates only when the request declares tools
-            # (the reference gates on request.tools the same way);
-            # reasoning parsing follows the model card.
-            card = entry.preprocessor.card
-            delta_gen = DeltaGenerator(
-                entry.preprocessor, preprocessed, kind=kind,
-                tool_parser=(card.tool_parser if body.get("tools")
-                             else None),
-                reasoning_parser=card.reasoning_parser,
-            )
-            stream = bool(body.get("stream", False))
-            rt_metrics.INPUT_TOKENS.labels(model=model).observe(
-                len(preprocessed.token_ids))
-            if stream:
-                return await self._stream_response(request, entry,
-                                                   preprocessed, delta_gen,
-                                                   body)
-            return await self._aggregate_response(entry, preprocessed,
-                                                  delta_gen)
+        # ones operators need spans for. An exception escaping before the
+        # response paths run their own accounting must also close the
+        # flight-recorder timeline (no-op when already finished), or the
+        # entry sits phantom-inflight until stale eviction.
+        return await self._finish_guard(
+            preprocessed.request_id,
+            self._completion_traced(
+                request, entry, preprocessed, span, body, kind, model),
+            span=span)
+
+    async def _finish_guard(self, request_id: str, coro, span):
+        """Escape guard shared by every completion-shaped endpoint: an
+        exception before the stream helpers' own handlers are armed
+        (e.g. a disconnect during response.prepare) must still close the
+        flight-recorder timeline (no-op when the response path already
+        closed it) — a client going away is normal teardown, not an
+        error, so the recorder's cancelled status skips the WARNING
+        dump. The endpoint's server span is entered here so an escaping
+        exception exports it ok=False via __exit__; the response helpers
+        end it with the real outcome first (first end() wins)."""
+        try:
+            with span:
+                return await coro
+        except (ConnectionResetError, asyncio.CancelledError):
+            get_recorder().finish(request_id, "cancelled")
+            raise
+        except BaseException:
+            get_recorder().finish(request_id, "error")
+            raise
+
+    def _open_http_trace(self, request: web.Request,
+                         preprocessed: PreprocessedRequest, span,
+                         received: Optional[float] = None) -> None:
+        """Inject the server span's context into the request annotations
+        (falling back to the client's header when export is disabled) and
+        open the flight-recorder timeline. Shared by every
+        completion-shaped endpoint; the span itself is created at the
+        call site so the span-name registry sees a literal name.
+        `received` backdates the timeline to handler entry so the
+        tokenization cost (which precedes the request id) stays visible
+        against the deadline budget."""
+        tp = span.traceparent or request.headers.get("traceparent")
+        if tp:
+            preprocessed.annotations["traceparent"] = tp
+        get_recorder().start(preprocessed.request_id,
+                             model=preprocessed.model,
+                             trace_id=_trace_id_of(preprocessed),
+                             received=received)
+
+    async def _completion_traced(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, span, body: dict, kind: str,
+        model: str,
+    ) -> web.StreamResponse:
+        # Span ownership matches _messages/_responses: _finish_guard holds
+        # `with span:` (close-on-escape); the response helpers end it with
+        # the real outcome (first end() wins).
+        if self.recorder is not None:
+            self.recorder.record_request(preprocessed.request_id, kind,
+                                         body)
+        # Tool parsing activates only when the request declares tools
+        # (the reference gates on request.tools the same way);
+        # reasoning parsing follows the model card.
+        card = entry.preprocessor.card
+        delta_gen = DeltaGenerator(
+            entry.preprocessor, preprocessed, kind=kind,
+            tool_parser=(card.tool_parser if body.get("tools")
+                         else None),
+            reasoning_parser=card.reasoning_parser,
+        )
+        stream = bool(body.get("stream", False))
+        rt_metrics.INPUT_TOKENS.labels(model=model).observe(
+            len(preprocessed.token_ids))
+        if stream:
+            return await self._stream_response(request, entry,
+                                               preprocessed, delta_gen,
+                                               body, span)
+        return await self._aggregate_response(entry, preprocessed,
+                                              delta_gen, span)
 
     def _count_request(self, model: str, status: str,
                        start: Optional[float] = None, *,
@@ -261,6 +401,13 @@ class HttpService:
         if start is not None:
             rt_metrics.REQUEST_DURATION.labels(**labels).observe(
                 max(0.0, time.monotonic() - start))
+        rid = (request_id if request_id is not None
+               else preprocessed.request_id if preprocessed else None)
+        if rid:
+            # Close the flight-recorder timeline on EVERY outcome (no-op
+            # when a more specific status — deadline_exceeded — already
+            # finished it, or when this endpoint never opened one).
+            get_recorder().finish(rid, status)
         if self.audit is not None:
             from .audit import AuditRecord
 
@@ -286,39 +433,40 @@ class HttpService:
         """Drive the engine stream to completion through `delta_gen`.
         Returns an error Response, or None on success. Shared by every
         non-streaming handler so error mapping stays in one place."""
-        model = preprocessed.model
-        start = time.monotonic()
-        first_token_at: Optional[float] = None
-        last_token_at: Optional[float] = None
+        obs = (_SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+               if observe_latency else None)
+        cancelled = False
         try:
             async for output in self._generate(entry, preprocessed):
-                if observe_latency and output.token_ids:
-                    now = time.monotonic()
-                    if first_token_at is None:
-                        first_token_at = now
-                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(
-                            now - start)
-                    elif last_token_at is not None:
-                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
-                            (now - last_token_at)
-                            / max(1, len(output.token_ids)))
-                    last_token_at = now
+                if obs is not None:
+                    obs.on_output(output)
                 delta_gen.on_output(output)
                 if output.error:
                     return web.json_response(
                         _error_body(502, output.error, "engine_error"),
                         status=502)
+        except asyncio.CancelledError:
+            # Client abort: don't let it count against the goodput ratio
+            # or dump the timeline as an error.
+            cancelled = True
+            get_recorder().finish(preprocessed.request_id, "cancelled")
+            raise
         except NoInstancesAvailable:
             return web.json_response(
                 _error_body(503, "no workers available", "overloaded"),
                 status=503, headers={"Retry-After": "1"})
         except DeadlineExceeded as exc:
             rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            get_recorder().finish(preprocessed.request_id,
+                                  "deadline_exceeded")
             return web.json_response(
                 _error_body(504, str(exc), "deadline_exceeded"), status=504)
         except RemoteError as exc:
             return web.json_response(
                 _error_body(502, str(exc), "engine_error"), status=502)
+        finally:
+            if obs is not None and not cancelled:
+                obs.finalize_from(delta_gen)
         return None
 
     async def _generate(
@@ -335,7 +483,7 @@ class HttpService:
 
     async def _aggregate_response(
         self, entry: ModelEntry, preprocessed: PreprocessedRequest,
-        delta_gen: DeltaGenerator,
+        delta_gen: DeltaGenerator, span,
     ) -> web.Response:
         model = preprocessed.model
         start = time.monotonic()
@@ -351,14 +499,18 @@ class HttpService:
             return web.json_response(delta_gen.final_response())
         finally:
             # Counts + audit on EVERY outcome (error returns included) so
-            # the audit trail never undercounts failures.
+            # the audit trail never undercounts failures; the server span
+            # must export ERROR for error Responses too, not just raises
+            # (first end() wins over the enclosing `with span:`).
+            span.end(ok=status == "ok")
             self._count_request(model, status, start,
                                 preprocessed=preprocessed,
                                 delta_gen=delta_gen, kind=delta_gen.kind)
 
     async def _stream_response(
         self, request: web.Request, entry: ModelEntry,
-        preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator, body: dict,
+        preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator,
+        body: dict, span,
     ) -> web.StreamResponse:
         model = preprocessed.model
         response = web.StreamResponse(
@@ -371,22 +523,14 @@ class HttpService:
         )
         await response.prepare(request)
         start = time.monotonic()
-        first_token_at: Optional[float] = None
-        last_token_at: Optional[float] = None
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+        disconnected = False
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage", False)
         )
         try:
             async for output in self._generate(entry, preprocessed):
-                now = time.monotonic()
-                if output.token_ids:
-                    if first_token_at is None:
-                        first_token_at = now
-                        rt_metrics.TTFT_SECONDS.labels(model=model).observe(now - start)
-                    elif last_token_at is not None:
-                        rt_metrics.ITL_SECONDS.labels(model=model).observe(
-                            (now - last_token_at) / max(1, len(output.token_ids)))
-                    last_token_at = now
+                obs.on_output(output)
                 for chunk in delta_gen.on_output(output):
                     await response.write(
                         f"data: {json.dumps(chunk)}\n\n".encode())
@@ -405,6 +549,8 @@ class HttpService:
             await response.write(b"data: [DONE]\n\n")
         except DeadlineExceeded as exc:
             rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            get_recorder().finish(preprocessed.request_id,
+                                  "deadline_exceeded")
             await response.write(
                 f"data: {json.dumps(_error_body(504, str(exc), 'deadline_exceeded'))}\n\n".encode())
             await response.write(b"data: [DONE]\n\n")
@@ -417,13 +563,26 @@ class HttpService:
             await response.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # Client went away: stop generating (cancellation propagates to
-            # the worker through the request plane).
+            # the worker through the request plane). Normal teardown — the
+            # timeline closes as cancelled (no WARNING dump) and the
+            # request is excluded from the goodput ratio.
+            get_recorder().finish(preprocessed.request_id, "cancelled")
+            disconnected = True
             log.info("client disconnected: %s", preprocessed.request_id)
             raise
         finally:
             rt_metrics.OUTPUT_TOKENS.labels(model=model).observe(
                 delta_gen.completion_tokens)
-            status = "ok" if delta_gen.finish_reason is not None else "error"
+            # finish_reason "error" is an in-band engine failure (the
+            # worker streamed an error output), not a completion.
+            status = ("ok" if delta_gen.finish_reason
+                      not in (None, "error") else "error")
+            # In-band SSE error terminations (deadline, engine error) must
+            # export the server span as ERROR even though no exception
+            # escapes the `with span:` (mirrors _anthropic_stream).
+            span.end(ok=status == "ok" and not disconnected)
+            if not disconnected:
+                obs.finalize_from(delta_gen)
             self._count_request(model, status, start,
                                 preprocessed=preprocessed,
                                 delta_gen=delta_gen, kind=delta_gen.kind)
@@ -687,6 +846,7 @@ class HttpService:
         return reason, None
 
     async def _anthropic_messages(self, request: web.Request) -> web.StreamResponse:
+        arrival = time.time()
         try:
             body = await request.json()
         except (ValueError, UnicodeDecodeError):
@@ -695,30 +855,50 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
+        deadline = self._admit_deadline(request)
         try:
             chat_body = self._messages_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
         preprocessed.lora_name = lora
+        preprocessed.deadline = deadline
         if self.recorder is not None:
             self.recorder.record_request(
                 preprocessed.request_id, "messages", body)
         current_request_id.set(preprocessed.request_id)
+        span = get_tracer().start_span(
+            "http.messages", parent=request.headers.get("traceparent"),
+            kind=2, **{"request.id": preprocessed.request_id,
+                       "model": model,
+                       "input.tokens": len(preprocessed.token_ids)})
+        self._open_http_trace(request, preprocessed, span, received=arrival)
+        return await self._finish_guard(
+            preprocessed.request_id,
+            self._messages_traced(
+                request, entry, preprocessed, span, body, model),
+            span=span)
+
+    async def _messages_traced(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, span, body: dict, model: str,
+    ) -> web.StreamResponse:
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")
         msg_id = f"msg_{uuid.uuid4().hex[:24]}"
         if bool(body.get("stream", False)):
             return await self._anthropic_stream(request, entry, preprocessed,
-                                                delta_gen, msg_id)
+                                                delta_gen, msg_id, span)
         start = time.monotonic()
         status = "error"
         try:
-            err = await self._consume(entry, preprocessed, delta_gen)
+            err = await self._consume(entry, preprocessed, delta_gen,
+                                      observe_latency=True)
             if err is not None:
                 return err
             status = "ok"
         finally:
+            span.end(ok=status == "ok")
             self._count_request(model, status, start,
                                 preprocessed=preprocessed,
                                 delta_gen=delta_gen, kind="messages")
@@ -740,7 +920,7 @@ class HttpService:
     async def _anthropic_stream(
         self, request: web.Request, entry: ModelEntry,
         preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator,
-        msg_id: str,
+        msg_id: str, span,
     ) -> web.StreamResponse:
         response = web.StreamResponse(
             status=200,
@@ -767,9 +947,12 @@ class HttpService:
             "content_block": {"type": "text", "text": ""},
         })
         start = time.monotonic()
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
         errored = False
+        disconnected = False
         try:
             async for output in self._generate(entry, preprocessed):
+                obs.on_output(output)
                 if output.error:
                     errored = True
                     await emit("error", {"type": "error",
@@ -801,8 +984,27 @@ class HttpService:
             await emit("error", {"type": "error",
                                  "error": {"type": "api_error",
                                            "message": str(exc)}})
+        except DeadlineExceeded as exc:
+            # Same classification as the chat stream: counted, recorded
+            # as deadline_exceeded (not a bare error), surfaced as a
+            # parseable error event instead of a dropped chunked read.
+            errored = True
+            rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            get_recorder().finish(preprocessed.request_id,
+                                  "deadline_exceeded")
+            await emit("error", {"type": "error",
+                                 "error": {"type": "timeout_error",
+                                           "message": str(exc)}})
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: normal teardown, excluded from goodput.
+            get_recorder().finish(preprocessed.request_id, "cancelled")
+            disconnected = True
+            raise
         finally:
             ok = delta_gen.finish_reason is not None and not errored
+            span.end(ok=ok and not disconnected)
+            if not disconnected:
+                obs.finalize_from(delta_gen)
             self._count_request(preprocessed.model,
                                 "ok" if ok else "error", start,
                                 preprocessed=preprocessed,
@@ -873,6 +1075,7 @@ class HttpService:
         }
 
     async def _responses(self, request: web.Request) -> web.StreamResponse:
+        arrival = time.time()
         try:
             body = await request.json()
         except (ValueError, UnicodeDecodeError):
@@ -881,30 +1084,50 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
+        deadline = self._admit_deadline(request)
         try:
             chat_body = self._responses_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
         preprocessed.lora_name = lora
+        preprocessed.deadline = deadline
         if self.recorder is not None:
             self.recorder.record_request(
                 preprocessed.request_id, "responses", body)
         current_request_id.set(preprocessed.request_id)
+        span = get_tracer().start_span(
+            "http.responses", parent=request.headers.get("traceparent"),
+            kind=2, **{"request.id": preprocessed.request_id,
+                       "model": model,
+                       "input.tokens": len(preprocessed.token_ids)})
+        self._open_http_trace(request, preprocessed, span, received=arrival)
+        return await self._finish_guard(
+            preprocessed.request_id,
+            self._responses_traced(
+                request, entry, preprocessed, span, body, model),
+            span=span)
+
+    async def _responses_traced(
+        self, request: web.Request, entry: ModelEntry,
+        preprocessed: PreprocessedRequest, span, body: dict, model: str,
+    ) -> web.StreamResponse:
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="chat")
         resp_id = f"resp_{uuid.uuid4().hex[:24]}"
         if bool(body.get("stream", False)):
             return await self._responses_stream(request, entry, preprocessed,
-                                                delta_gen, resp_id)
+                                                delta_gen, resp_id, span)
         start = time.monotonic()
         status = "error"
         try:
-            err = await self._consume(entry, preprocessed, delta_gen)
+            err = await self._consume(entry, preprocessed, delta_gen,
+                                      observe_latency=True)
             if err is not None:
                 return err
             status = "ok"
         finally:
+            span.end(ok=status == "ok")
             self._count_request(model, status, start,
                                 preprocessed=preprocessed,
                                 delta_gen=delta_gen, kind="responses")
@@ -914,7 +1137,7 @@ class HttpService:
     async def _responses_stream(
         self, request: web.Request, entry: ModelEntry,
         preprocessed: PreprocessedRequest, delta_gen: DeltaGenerator,
-        resp_id: str,
+        resp_id: str, span,
     ) -> web.StreamResponse:
         response = web.StreamResponse(
             status=200,
@@ -934,9 +1157,12 @@ class HttpService:
                                              delta_gen, "in_progress"),
         })
         start = time.monotonic()
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
         errored = False
+        disconnected = False
         try:
             async for output in self._generate(entry, preprocessed):
+                obs.on_output(output)
                 if output.error:
                     errored = True
                     await emit("error", {"type": "error",
@@ -964,8 +1190,25 @@ class HttpService:
         except (NoInstancesAvailable, RemoteError) as exc:
             errored = True
             await emit("error", {"type": "error", "message": str(exc)})
+        except DeadlineExceeded as exc:
+            # Same classification as the chat stream (see _stream_response).
+            errored = True
+            rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
+            get_recorder().finish(preprocessed.request_id,
+                                  "deadline_exceeded")
+            await emit("error", {"type": "error",
+                                 "message": str(exc),
+                                 "code": "deadline_exceeded"})
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: normal teardown, excluded from goodput.
+            get_recorder().finish(preprocessed.request_id, "cancelled")
+            disconnected = True
+            raise
         finally:
             ok = delta_gen.finish_reason is not None and not errored
+            span.end(ok=ok and not disconnected)
+            if not disconnected:
+                obs.finalize_from(delta_gen)
             self._count_request(preprocessed.model,
                                 "ok" if ok else "error", start,
                                 preprocessed=preprocessed,
@@ -1093,7 +1336,10 @@ class HttpService:
         ("get", "/v1/models", "List served models, adapters, and pools"),
         ("get", "/health", "Service health + served model list"),
         ("get", "/live", "Liveness probe"),
-        ("get", "/metrics", "Prometheus metrics"),
+        ("get", "/metrics",
+         "Prometheus metrics (OpenMetrics + exemplars via Accept)"),
+        ("get", "/debug/requests",
+         "Flight recorder: inflight + recent request timelines"),
         ("get", "/busy_threshold", "List per-model busy thresholds"),
         ("post", "/busy_threshold",
          "Get or set a model's busy threshold (load shedding)"),
@@ -1103,9 +1349,18 @@ class HttpService:
         ("get", "/docs", "Human-readable API index"),
     )
 
+    def _route_docs(self):
+        """_ROUTE_DOCS minus routes not actually registered (the opt-in
+        /debug/requests), so /openapi.json and /docs never advertise an
+        endpoint that 404s."""
+        if env("DYNT_DEBUG_ENDPOINTS"):
+            return self._ROUTE_DOCS
+        return tuple(r for r in self._ROUTE_DOCS
+                     if r[1] != "/debug/requests")
+
     async def _openapi(self, _request: web.Request) -> web.Response:
         paths: dict[str, dict] = {}
-        for method, path, summary in self._ROUTE_DOCS:
+        for method, path, summary in self._route_docs():
             paths.setdefault(path, {})[method] = {
                 "summary": summary,
                 "responses": {"200": {"description": "OK"}},
@@ -1123,7 +1378,7 @@ class HttpService:
         rows = "".join(
             f"<tr><td><code>{m.upper()}</code></td>"
             f"<td><code>{p}</code></td><td>{s}</td></tr>"
-            for m, p, s in self._ROUTE_DOCS)
+            for m, p, s in self._route_docs())
         html = (
             "<!doctype html><html><head><title>dynamo_tpu API</title>"
             "<style>body{font-family:sans-serif;margin:2em}"
@@ -1149,6 +1404,11 @@ class HttpService:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._health)
         app.router.add_get("/metrics", self._metrics)
+        if env("DYNT_DEBUG_ENDPOINTS"):
+            # Tenant-facing port: the flight recorder exposes every
+            # client's request timelines, so it is opt-in here (the
+            # internal status server always serves it).
+            app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/busy_threshold", self._busy_threshold_list)
         app.router.add_post("/busy_threshold", self._busy_threshold_post)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)

@@ -13,16 +13,31 @@ ModelInfer aggregates; ModelStreamInfer streams one response per text delta.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator, Optional
 
 import grpc
 
-from ...runtime.logging import get_logger
+from ...runtime.flight_recorder import get_recorder
+from ...runtime.logging import current_request_id, get_logger
+from ...runtime.otel import get_tracer, trace_id_of
 from ..manager import ModelManager
 from ..preprocessor import DeltaGenerator, RequestError
 from . import inference_pb2 as pb
 
 log = get_logger("llm.kserve")
+
+
+def _grpc_traceparent(context) -> Optional[str]:
+    """W3C trace context from the gRPC invocation metadata (the header
+    contract is identical to HTTP: lowercase `traceparent` key)."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                return value
+    except Exception:  # noqa: BLE001 — metadata is best-effort
+        pass
+    return None
 
 _SERVICE = "inference.GRPCInferenceService"
 
@@ -128,24 +143,76 @@ class KServeGrpcService:
                 name="text_output", datatype="BYTES", shape=[1])],
         )
 
+    @staticmethod
+    def _start_trace(preprocessed, context, span_name_is_stream: bool,
+                     received: Optional[float] = None):
+        """SERVER span + flight-recorder timeline for one gRPC inference —
+        the same observability contract as the HTTP path (previously the
+        kserve surface only logged the traceparent)."""
+        tp = _grpc_traceparent(context)
+        span = get_tracer().start_span(
+            "grpc.stream_infer" if span_name_is_stream else "grpc.infer",
+            parent=tp, kind=2,
+            **{"request.id": preprocessed.request_id,
+               "model": preprocessed.model,
+               "input.tokens": len(preprocessed.token_ids)})
+        wire_tp = span.traceparent or tp
+        if wire_tp:
+            preprocessed.annotations["traceparent"] = wire_tp
+        current_request_id.set(preprocessed.request_id)
+        # Record the trace id of the traceparent actually forwarded on
+        # the wire — same semantics as the HTTP path, which keeps the
+        # client's trace id even when local export is disabled.
+        get_recorder().start(preprocessed.request_id,
+                             model=preprocessed.model,
+                             trace_id=trace_id_of(wire_tp),
+                             received=received)
+        return span
+
     async def _model_infer(self, request, context) -> pb.ModelInferResponse:
+        arrival = time.time()
         entry, preprocessed = await self._preprocess(request, context)
         delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                    kind="completions")
-        async for output in entry.engine.generate(preprocessed):
-            delta_gen.on_output(output)
-            if output.error:
-                await context.abort(grpc.StatusCode.INTERNAL, output.error)
-        return _text_response(request.model_name, request.id,
-                              delta_gen.full_text)
+        span = self._start_trace(preprocessed, context,
+                                 span_name_is_stream=False,
+                                 received=arrival)
+        status = "error"
+        try:
+            async for output in entry.engine.generate(preprocessed):
+                delta_gen.on_output(output)
+                if output.error:
+                    # abort raises; the span closes ok=False below.
+                    await context.abort(grpc.StatusCode.INTERNAL,
+                                        output.error)
+            status = "ok"
+            span.end(ok=True)
+            return _text_response(request.model_name, request.id,
+                                  delta_gen.full_text)
+        except asyncio.CancelledError:
+            # Client cancelled the RPC: routine teardown, not an error —
+            # same classification as the HTTP path (keeps the flight
+            # recorder from WARNING-dumping every normal cancel).
+            status = "cancelled"
+            raise
+        finally:
+            # Aborts, client cancellation, and engine exceptions all pass
+            # here: the span must never leak open (first end() wins).
+            span.end(ok=False)
+            get_recorder().finish(preprocessed.request_id, status)
 
     async def _model_stream_infer(
         self, request_iterator, context
     ) -> AsyncIterator[pb.ModelStreamInferResponse]:
         async for request in request_iterator:
+            arrival = time.time()
             entry, preprocessed = await self._preprocess(request, context)
             delta_gen = DeltaGenerator(entry.preprocessor, preprocessed,
                                        kind="completions")
+            span = self._start_trace(preprocessed, context,
+                                     span_name_is_stream=True,
+                                     received=arrival)
+            status = "error"
             try:
                 async for output in entry.engine.generate(preprocessed):
                     for chunk in delta_gen.on_output(output):
@@ -160,8 +227,24 @@ class KServeGrpcService:
                 final = _text_response(request.model_name, request.id, "")
                 final.parameters["triton_final_response"].bool_param = True
                 yield pb.ModelStreamInferResponse(infer_response=final)
+                status = "ok"
+                span.end(ok=True)
+            except asyncio.CancelledError:
+                # Client cancelled the stream: routine teardown, not an
+                # error (suppresses the recorder's WARNING auto-dump).
+                status = "cancelled"
+                raise
+            except GeneratorExit:
+                # grpc.aio aclose()d the handler generator (stream torn
+                # down without task cancellation): same routine teardown.
+                status = "cancelled"
+                raise
             except Exception as exc:  # noqa: BLE001 — deliver as stream error
                 yield pb.ModelStreamInferResponse(error_message=str(exc))
+            finally:
+                # Stream torn down mid-request (client cancel) included.
+                span.end(ok=False)
+                get_recorder().finish(preprocessed.request_id, status)
 
     # -- lifecycle ---------------------------------------------------------
 

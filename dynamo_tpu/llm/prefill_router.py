@@ -19,6 +19,7 @@ import dataclasses
 from typing import AsyncIterator, Callable, Optional
 
 from ..runtime.logging import get_logger
+from ..runtime.otel import get_tracer
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.resilience import DeadlineExceeded
 from .engine import TokenEngine
@@ -52,10 +53,22 @@ class PrefillRouterEngine(TokenEngine):
     ) -> Optional[dict]:
         """Send the prompt to a prefill worker; returns kv_transfer_params
         or None (caller falls back to aggregated)."""
+        # The prefill leg gets its own span: the prefill worker's server
+        # span parents under it, so the trace separates prefill execution
+        # from the decode leg that follows.
+        span = get_tracer().start_span(
+            "prefill.remote",
+            parent=request.annotations.get("traceparent"),
+            **{"request.id": request.request_id,
+               "input.tokens": len(request.token_ids)})
+        leg_tp = span.traceparent or request.annotations.get("traceparent")
+        annotations = {**request.annotations, "prefill_only": True}
+        if leg_tp:
+            annotations["traceparent"] = leg_tp
         prefill_request = dataclasses.replace(
             request,
             sampling=dataclasses.replace(request.sampling, max_tokens=1),
-            annotations={**request.annotations, "prefill_only": True},
+            annotations=annotations,
         )
         # Gateway EPP header contract (ref: prefill_router/mod.rs:117-120
         # x-prefill-instance-id): an external picker pins the prefill leg.
@@ -72,23 +85,31 @@ class PrefillRouterEngine(TokenEngine):
             # can no longer eat more than the end-to-end deadline.
             async for item in pool.router.generate(prefill_request.to_wire(),
                                                    instance_id=target,
-                                                   deadline=request.deadline):
+                                                   deadline=request.deadline,
+                                                   traceparent=leg_tp):
                 out = EngineOutput.from_wire(item)
                 if out.error:
                     log.warning("prefill worker error for %s: %s",
                                 request.request_id, out.error)
                     return None
                 if out.kv_transfer_params is not None:
+                    span.end(ok=True)
                     return out.kv_transfer_params
         except DeadlineExceeded:
             # No budget left: the decode leg could not finish either —
             # surface the overrun instead of burning a recompute.
+            span.add_event("deadline_exceeded")
             raise
         except Exception as exc:  # noqa: BLE001 — any prefill-leg failure
             # (incl. NoInstancesAvailable) degrades to aggregated serving
             log.warning("prefill leg failed for %s (%r); aggregated fallback",
                         request.request_id, exc)
             return None
+        finally:
+            # Fallback paths (error output, transport failure, no params)
+            # close the span ok=False; the success return above already
+            # ended it ok=True (first end wins).
+            span.end(ok=False)
         return None
 
     async def generate(

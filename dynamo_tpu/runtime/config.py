@@ -190,12 +190,29 @@ _register("DYNT_INDEXER_MAX_TREE_SIZE", 0, _int,
           "Radix-index node budget; above it the oldest blocks prune to "
           "80% of budget (0 = unlimited; ref PruneConfig max_tree_size)")
 
-# Tracing
+# Tracing + flight recorder (docs/observability.md)
 _register("DYNT_OTLP_ENDPOINT", "", _str,
           "OTLP/HTTP collector base URL (e.g. http://localhost:4318); "
           "empty disables span export (ref: logging.rs OTLP init)")
 _register("DYNT_OTEL_SERVICE_NAME", "dynamo_tpu", _str,
           "service.name resource attribute on exported spans")
+_register("DYNT_FLIGHT_RECORDER_SIZE", 256, _int,
+          "Completed request timelines the per-process flight recorder "
+          "retains (ring buffer behind /debug/requests)")
+_register("DYNT_SLOW_TRACE_MS", 0.0, _float,
+          "Force-sample slow requests: a request whose end-to-end wall "
+          "time meets this threshold has its flight-recorder timeline "
+          "dumped to the log at WARNING (0 disables)")
+_register("DYNT_DEBUG_ENDPOINTS", False, _bool,
+          "Also serve /debug/requests on the tenant-facing OpenAI "
+          "frontend port (it leaks cross-request timelines, so it is "
+          "opt-in there; the internal status server always serves it)")
+_register("DYNT_SLO_TTFT_MS", 0.0, _float,
+          "TTFT target for the dynamo_slo_good_total goodput counter; "
+          "0 means no TTFT requirement")
+_register("DYNT_SLO_ITL_MS", 0.0, _float,
+          "Worst-token ITL target for the dynamo_slo_good_total goodput "
+          "counter; 0 means no ITL requirement")
 
 # Fault tolerance — resilience plane (runtime/resilience.py; knob
 # semantics and the degradation ladder in docs/fault-tolerance.md)

@@ -1,0 +1,236 @@
+"""Per-request flight recorder: a bounded ring of request timelines.
+
+When a request burns its deadline budget, metrics say *that* it was slow
+and traces say so only if the collector kept the sample — this recorder
+answers *where the time went* from inside the process, with zero external
+dependencies. Every component stamps coarse phases on a shared timeline
+(received -> queued -> scheduled -> prefill_start -> first_token ->
+finished) keyed by request id, and appends structured events for the
+interesting detours (retries, breaker trips, migrations, KV-transfer
+legs). The result is the black-box flight recorder of the serving plane:
+
+  * `/debug/requests` (system status server and the frontend) returns the
+    inflight timelines plus the last N completed ones;
+  * any request that finishes in a non-ok state is auto-dumped to the log;
+  * `DYNT_SLOW_TRACE_MS` force-samples slow-but-successful requests the
+    same way (the tail you cannot reproduce on demand).
+
+Stamps are first-write-wins (phases are facts, not counters) and the
+whole structure is thread-safe: the engine scheduler stamps from its own
+thread while the asyncio side reads snapshots. Request ids default to the
+`current_request_id` contextvar so most call sites stamp with no plumbing.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+from .config import env
+from .logging import current_request_id, get_logger
+
+log = get_logger("flight_recorder")
+
+# Canonical phase order (docs/observability.md). A timeline holds any
+# subset: a prefill-only leg never decodes, a shed request never queues.
+PHASES = ("received", "queued", "scheduled", "prefill_start",
+          "first_token", "finished")
+
+# Inflight entries older than this are presumed leaked (a peer that
+# stamped but never finished — e.g. a prefill pool whose decode side
+# died) and retired so the inflight map stays bounded.
+STALE_INFLIGHT_SECS = 3600.0
+
+
+@dataclasses.dataclass
+class RequestTimeline:
+    """One request's observed life inside this process."""
+
+    request_id: str
+    model: str = ""
+    trace_id: str = ""
+    started: float = dataclasses.field(default_factory=time.time)
+    phases: dict = dataclasses.field(default_factory=dict)
+    events: list = dataclasses.field(default_factory=list)
+    status: Optional[str] = None  # None while inflight
+    slow: bool = False
+
+    def elapsed_ms(self) -> float:
+        end = self.phases.get("finished", time.time())
+        return max(0.0, (end - self.started) * 1e3)
+
+    def to_json(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "model": self.model,
+            "trace_id": self.trace_id,
+            "status": self.status or "inflight",
+            "slow": self.slow,
+            "elapsed_ms": round(self.elapsed_ms(), 3),
+            "phases": {k: round(v, 6) for k, v in self.phases.items()},
+            "events": list(self.events),
+        }
+
+
+class FlightRecorder:
+    """Thread-safe inflight map + completed ring (capacity from
+    DYNT_FLIGHT_RECORDER_SIZE when not given)."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 slow_ms: Optional[float] = None) -> None:
+        if capacity is None:
+            capacity = env("DYNT_FLIGHT_RECORDER_SIZE")
+        self.slow_ms = (env("DYNT_SLOW_TRACE_MS") if slow_ms is None
+                        else slow_ms)
+        self._inflight: dict[str, RequestTimeline] = {}
+        self._completed: collections.deque = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _resolve(request_id: Optional[str]) -> Optional[str]:
+        return request_id if request_id else current_request_id.get()
+
+    # -- producer side -----------------------------------------------------
+
+    def start(self, request_id: str, model: str = "",
+              trace_id: str = "", received: Optional[float] = None) -> None:
+        """Open (or enrich) a timeline. Idempotent: the first opener sets
+        `received`; later openers only fill in missing identity fields, so
+        frontend and worker can both call it in shared-process setups.
+        `received` backdates the timeline to the true wire-arrival time —
+        tokenization happens before the request gets an id, and a cold
+        tokenizer can burn a visible slice of the deadline budget that
+        would otherwise be missing from the timeline."""
+        with self._lock:
+            tl = self._inflight.get(request_id)
+            if tl is None:
+                tl = RequestTimeline(request_id, model=model,
+                                     trace_id=trace_id)
+                if received is not None:
+                    tl.started = received
+                tl.phases["received"] = tl.started
+                self._inflight[request_id] = tl
+                self._evict_stale_locked()
+                return
+            if model and not tl.model:
+                tl.model = model
+            if trace_id and not tl.trace_id:
+                tl.trace_id = trace_id
+
+    def stamp(self, request_id: Optional[str], phase: str,
+              ts: Optional[float] = None) -> None:
+        """Record a phase timestamp (first write wins). No-op for unknown
+        requests — canaries and bare-scheduler tests never pollute."""
+        rid = self._resolve(request_id)
+        if rid is None:
+            return
+        with self._lock:
+            tl = self._inflight.get(rid)
+            if tl is not None:
+                tl.phases.setdefault(phase, time.time() if ts is None
+                                     else ts)
+
+    def event(self, request_id: Optional[str], name: str, **attrs) -> None:
+        """Append a structured event (retry, migration, kv_pull, ...)."""
+        rid = self._resolve(request_id)
+        if rid is None:
+            return
+        with self._lock:
+            tl = self._inflight.get(rid)
+            if tl is not None:
+                tl.events.append({"ts": round(time.time(), 6),
+                                  "event": name, **attrs})
+
+    def finish(self, request_id: Optional[str],
+               status: str = "ok") -> Optional[RequestTimeline]:
+        """Close a timeline and move it to the completed ring. First call
+        wins; the auto-dump fires for every non-ok status and — when
+        DYNT_SLOW_TRACE_MS is set — for slow successes too."""
+        rid = self._resolve(request_id)
+        if rid is None:
+            return None
+        with self._lock:
+            tl = self._inflight.pop(rid, None)
+            if tl is None:
+                return None
+            tl.status = status
+            tl.phases.setdefault("finished", time.time())
+            tl.slow = bool(self.slow_ms) and tl.elapsed_ms() >= self.slow_ms
+            self._completed.append(tl)
+        if status not in ("ok", "cancelled"):
+            # Errors and deadline overruns auto-dump; plain client
+            # cancellations are normal stream teardown (e.g. a prefill
+            # leg whose consumer got its params) and would be noise.
+            log.warning("flight record (%s): %s", status,
+                        json.dumps(tl.to_json()))
+        elif tl.slow:
+            log.warning("flight record (slow: %.0fms >= %.0fms): %s",
+                        tl.elapsed_ms(), self.slow_ms,
+                        json.dumps(tl.to_json()))
+        return tl
+
+    def _evict_stale_locked(self) -> None:
+        now = time.time()
+        stale = [rid for rid, tl in self._inflight.items()
+                 if now - tl.started > STALE_INFLIGHT_SECS]
+        for rid in stale:
+            tl = self._inflight.pop(rid)
+            tl.status = "stale"
+            tl.phases.setdefault("finished", now)
+            self._completed.append(tl)
+
+    # -- consumer side -----------------------------------------------------
+
+    def get(self, request_id: str) -> Optional[RequestTimeline]:
+        """Inflight entry, or the most recent completed one by that id."""
+        with self._lock:
+            tl = self._inflight.get(request_id)
+            if tl is not None:
+                return tl
+            for done in reversed(self._completed):
+                if done.request_id == request_id:
+                    return done
+        return None
+
+    def snapshot(self) -> dict:
+        """JSON shape served at /debug/requests: inflight first, then
+        completed newest-first. Serialization happens OUTSIDE the lock —
+        hot-path stamp() from the engine step thread must never wait out
+        a debug scrape. Inflight timelines are still mutating, so their
+        phase/event containers are shallow-copied under the lock;
+        completed ones are immutable after finish()."""
+        with self._lock:
+            inflight = [dataclasses.replace(tl, phases=dict(tl.phases),
+                                            events=list(tl.events))
+                        for tl in self._inflight.values()]
+            completed = list(reversed(self._completed))
+        return {
+            "inflight": [tl.to_json() for tl in inflight],
+            "completed": [tl.to_json() for tl in completed],
+        }
+
+
+_GLOBAL: Optional[FlightRecorder] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """Process-wide recorder (always on — it is a fixed-size ring whose
+    hot-path cost is a dict write under an uncontended lock)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = FlightRecorder()
+        return _GLOBAL
+
+
+def reset_recorder() -> None:
+    """Testing hook: drop the cached recorder so env changes take effect."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

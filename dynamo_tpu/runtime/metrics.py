@@ -18,6 +18,12 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+from prometheus_client.openmetrics.exposition import (
+    CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+)
+from prometheus_client.openmetrics.exposition import (
+    generate_latest as _generate_openmetrics,
+)
 
 # One registry per process — mirrors the reference's DRT-rooted hierarchy.
 REGISTRY = CollectorRegistry()
@@ -103,10 +109,43 @@ REQUESTS_SHED = Counter(
     "Requests shed at admission with 503, by reason",
     ["reason"], registry=REGISTRY,
 )
+# SLO goodput layer (docs/observability.md): the planner consumes
+# good/total ratios per model instead of re-deriving them from latency
+# histograms ("goodput, not throughput" — the serving-SLO literature).
+SLO_REQUESTS = Counter(
+    "dynamo_slo_requests_total",
+    "Finished frontend requests considered for the SLO goodput ratio",
+    ["model"], registry=REGISTRY,
+)
+SLO_GOOD = Counter(
+    "dynamo_slo_good_total",
+    "Requests that finished OK within the DYNT_SLO_TTFT_MS / "
+    "DYNT_SLO_ITL_MS targets (an unset target always passes)",
+    ["model"], registry=REGISTRY,
+)
+# OTLP exporter health (runtime/otel.py): spans that reached the
+# collector vs spans lost to a full buffer or a failed export.
+OTEL_SPANS_EXPORTED = Counter(
+    "dynamo_otel_spans_exported_total",
+    "Spans successfully exported to the OTLP collector",
+    registry=REGISTRY,
+)
+OTEL_SPANS_DROPPED = Counter(
+    "dynamo_otel_spans_dropped_total",
+    "Spans dropped before export (buffer_full | export_error)",
+    ["reason"], registry=REGISTRY,
+)
 
 
 def render() -> bytes:
     return generate_latest(REGISTRY)
+
+
+def render_openmetrics() -> bytes:
+    """OpenMetrics exposition of the same registry — the only format that
+    carries exemplars, so the TTFT/ITL observations can link back to the
+    trace_id that produced them (served on Accept negotiation)."""
+    return _generate_openmetrics(REGISTRY)
 
 
 class EndpointMetrics:

@@ -20,6 +20,7 @@ Span recording is thread-safe (engine schedulers run on their own threads).
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import json
 import secrets
@@ -30,6 +31,7 @@ from typing import Optional
 
 from .config import env
 from .logging import get_logger
+from .metrics import OTEL_SPANS_DROPPED, OTEL_SPANS_EXPORTED
 
 log = get_logger("otel")
 
@@ -43,6 +45,14 @@ def new_trace_id() -> str:
 
 def new_span_id() -> str:
     return secrets.token_hex(8)
+
+
+def trace_id_of(header: Optional[str]) -> str:
+    """Trace id carried in a W3C traceparent header, "" when absent or
+    malformed — the one fallback contract shared by the frontend,
+    kserve, and worker recorder/exemplar paths."""
+    ctx = parse_traceparent(header)
+    return ctx[0] if ctx else ""
 
 
 def parse_traceparent(header: Optional[str]) -> Optional[tuple[str, str]]:
@@ -68,6 +78,32 @@ def format_traceparent(trace_id: str, span_id: str) -> str:
     return f"00-{trace_id}-{span_id}-01"
 
 
+# Request-plane wire fragment: otel.py owns the `traceparent` header the
+# same way resilience.py owns `x-dynt-deadline-ms` — every hop forwards the
+# W3C trace context as a first-class header, so spans parent across the
+# request plane without any side-channel (ref: logging.rs Injector/
+# Extractor propagation). Covered by the dynaflow request_plane schema.
+TRACEPARENT_HEADER = "traceparent"
+
+
+def traceparent_wire(traceparent: Optional[str]) -> dict:
+    """Header fragment carrying the trace context across one hop; empty
+    when there is no context to propagate (legacy peers keep working)."""
+    if not traceparent:
+        return {}
+    return {"traceparent": traceparent}
+
+
+def traceparent_from_wire(header: Optional[dict]) -> Optional[str]:
+    """Extract a valid traceparent from request-plane headers, or None."""
+    if not header:
+        return None
+    raw = header.get("traceparent")
+    if parse_traceparent(raw) is None:
+        return None
+    return raw
+
+
 @dataclasses.dataclass
 class Span:
     name: str
@@ -78,6 +114,7 @@ class Span:
     end_ns: int = 0
     kind: int = 1  # SPAN_KIND_INTERNAL; 2=SERVER, 3=CLIENT
     attributes: dict = dataclasses.field(default_factory=dict)
+    events: list = dataclasses.field(default_factory=list)
     ok: bool = True
 
     @property
@@ -87,22 +124,18 @@ class Span:
     def set_attribute(self, key: str, value) -> None:
         self.attributes[key] = value
 
+    def add_event(self, name: str, ts: Optional[float] = None,
+                  **attributes) -> None:
+        """Timestamped span event (retry, breaker transition, phase mark).
+        `ts` is a unix-seconds wall time; defaults to now."""
+        ns = time.time_ns() if ts is None else int(ts * 1e9)
+        self.events.append((name, ns, dict(attributes)))
+
     def end(self, ok: bool = True) -> None:
         self.end_ns = time.time_ns()
         self.ok = ok
 
     def to_otlp(self) -> dict:
-        attrs = []
-        for k, v in self.attributes.items():
-            if isinstance(v, bool):
-                val = {"boolValue": v}
-            elif isinstance(v, int):
-                val = {"intValue": str(v)}
-            elif isinstance(v, float):
-                val = {"doubleValue": v}
-            else:
-                val = {"stringValue": str(v)}
-            attrs.append({"key": k, "value": val})
         out = {
             "traceId": self.trace_id,
             "spanId": self.span_id,
@@ -110,12 +143,33 @@ class Span:
             "kind": self.kind,
             "startTimeUnixNano": str(self.start_ns),
             "endTimeUnixNano": str(self.end_ns or time.time_ns()),
-            "attributes": attrs,
+            "attributes": _otlp_attrs(self.attributes),
             "status": {"code": 1 if self.ok else 2},  # OK / ERROR
         }
+        if self.events:
+            out["events"] = [
+                {"name": name, "timeUnixNano": str(ns),
+                 "attributes": _otlp_attrs(attrs)}
+                for name, ns, attrs in self.events
+            ]
         if self.parent_span_id:
             out["parentSpanId"] = self.parent_span_id
         return out
+
+
+def _otlp_attrs(attributes: dict) -> list[dict]:
+    attrs = []
+    for k, v in attributes.items():
+        if isinstance(v, bool):
+            val = {"boolValue": v}
+        elif isinstance(v, int):
+            val = {"intValue": str(v)}
+        elif isinstance(v, float):
+            val = {"doubleValue": v}
+        else:
+            val = {"stringValue": str(v)}
+        attrs.append({"key": k, "value": val})
+    return attrs
 
 
 class _NoopSpan:
@@ -126,6 +180,10 @@ class _NoopSpan:
     traceparent = ""
 
     def set_attribute(self, key: str, value) -> None:
+        pass
+
+    def add_event(self, name: str, ts: Optional[float] = None,
+                  **attributes) -> None:
         pass
 
     def end(self, ok: bool = True) -> None:
@@ -176,6 +234,24 @@ class Tracer:
                     kind=kind, attributes=dict(attributes))
         return _SpanHandle(span, self)
 
+    def record_span(self, name: str, parent: Optional[str], start_ns: int,
+                    end_ns: int, kind: int = 1, ok: bool = True,
+                    **attributes) -> None:
+        """Record a completed span with EXPLICIT timestamps — how phase
+        spans (queue wait, prefill, decode) are synthesized from a
+        flight-recorder timeline after the fact, without holding a live
+        span object across the scheduler thread."""
+        if not self.enabled:
+            return
+        ctx = parse_traceparent(parent)
+        if ctx is None:
+            return
+        trace_id, parent_span = ctx
+        self.record(Span(name=name, trace_id=trace_id,
+                         span_id=new_span_id(), parent_span_id=parent_span,
+                         start_ns=start_ns, end_ns=end_ns, kind=kind,
+                         attributes=dict(attributes), ok=ok))
+
     def record(self, span: Span) -> None:
         if not self.enabled:
             return
@@ -185,6 +261,7 @@ class Tracer:
             if len(self._buf) >= MAX_BUFFERED_SPANS:
                 self._buf.pop(0)
                 self.dropped += 1
+                OTEL_SPANS_DROPPED.labels(reason="buffer_full").inc()
             self._buf.append(span)
         self._ensure_flusher()
 
@@ -229,9 +306,11 @@ class Tracer:
             with urllib.request.urlopen(req, timeout=5.0) as resp:
                 resp.read()
             self.exported += len(batch)
+            OTEL_SPANS_EXPORTED.inc(len(batch))
             return len(batch)
         except Exception as exc:  # noqa: BLE001 — telemetry must not kill
             self.dropped += len(batch)
+            OTEL_SPANS_DROPPED.labels(reason="export_error").inc(len(batch))
             log.debug("otlp export failed (%d spans dropped): %r",
                       len(batch), exc)
             return 0
@@ -267,6 +346,10 @@ class _SpanHandle:
     def set_attribute(self, key: str, value) -> None:
         self.span.set_attribute(key, value)
 
+    def add_event(self, name: str, ts: Optional[float] = None,
+                  **attributes) -> None:
+        self.span.add_event(name, ts=ts, **attributes)
+
     def end(self, ok: bool = True) -> None:
         if self._recorded:
             return
@@ -294,6 +377,12 @@ def get_tracer() -> Tracer:
         if _GLOBAL is None:
             _GLOBAL = Tracer(env("DYNT_OTLP_ENDPOINT"),
                              service_name=env("DYNT_OTEL_SERVICE_NAME"))
+            if _GLOBAL.enabled:
+                # Exit drain: the flusher is a daemon thread, so without
+                # this the up-to-FLUSH_INTERVAL of spans buffered at
+                # process exit would silently vanish — and the spans
+                # around a crash are exactly the ones operators need.
+                atexit.register(_GLOBAL.close)
         return _GLOBAL
 
 
@@ -303,4 +392,5 @@ def reset_tracer() -> None:
     with _GLOBAL_LOCK:
         if _GLOBAL is not None:
             _GLOBAL.close()
+            atexit.unregister(_GLOBAL.close)
         _GLOBAL = None

@@ -22,8 +22,10 @@ import random
 from typing import Any, AsyncIterator, Awaitable, Callable, Optional
 
 from .component import Client
+from .flight_recorder import get_recorder
 from .logging import get_logger
 from .metrics import RETRIES_TOTAL, ROUTER_DECISIONS
+from .otel import get_tracer, traceparent_wire
 from .request_plane import ConnectionLost, EndpointNotFound
 from .resilience import (
     HALF_OPEN,
@@ -130,6 +132,7 @@ class PushRouter:
         headers: Optional[dict] = None,
         allowed: Optional[set] = None,
         deadline: Optional[Deadline] = None,
+        traceparent: Optional[str] = None,
     ) -> AsyncIterator[Any]:
         """Route and stream. On transport failure *before any output*, the
         instance's breaker records a failure and — if the retry budget
@@ -137,16 +140,25 @@ class PushRouter:
         mid-stream failures propagate (migration is a pipeline-level
         concern, llm/migration.py). The deadline (also parsed from
         `headers` when not passed) is re-encoded onto every attempt and
-        bounds the retry loop end-to-end."""
+        bounds the retry loop end-to-end. `traceparent` (also parsed from
+        `headers`) parents a per-attempt CLIENT span whose context is
+        re-injected on the wire, so the server-side span parents under
+        THIS dispatch — retries and breaker verdicts land on it as span
+        events."""
         await self.client.start()
         if deadline is None:
             deadline = Deadline.from_wire(headers)
+        if traceparent is None and headers:
+            traceparent = headers.get("traceparent")
+        tracer = get_tracer()
+        recorder = get_recorder()
+        subject = self.client.endpoint.subject
         attempts = 0
         prev_delay: Optional[float] = None
         while True:
             if deadline is not None and deadline.expired():
                 raise DeadlineExceeded(
-                    f"deadline exceeded routing {self.client.endpoint.subject}")
+                    f"deadline exceeded routing {subject}")
             iid = await self._pick(body, instance_id, allowed)
             breaker = self.breakers.get(iid)
             owns_probe = False
@@ -157,7 +169,7 @@ class PushRouter:
                     # so the upstream selector re-picks).
                     if instance_id is not None:
                         raise NoInstancesAvailable(
-                            f"{self.client.endpoint.subject}: instance "
+                            f"{subject}: instance "
                             f"{iid:x} breaker open")
                     continue
                 # Asyncio-single-threaded: a True acquire with the
@@ -170,11 +182,23 @@ class PushRouter:
             ROUTER_DECISIONS.labels(
                 mode="direct" if instance_id is not None else self.mode
             ).inc()
+            # Per-attempt CLIENT span: the wire carries ITS context, so
+            # the server-side span parents under this exact dispatch and
+            # a migration/retry shows up as sibling attempts in the trace.
+            span = tracer.start_span(
+                "router.dispatch", parent=traceparent, kind=3,
+                **{"endpoint": subject,
+                   "instance.id": f"{iid:x}",
+                   "router.mode": ("direct" if instance_id is not None
+                                   else self.mode),
+                   "breaker.state": breaker.state,
+                   "attempt": attempts + 1})
             hdrs = dict(headers or {})
             if deadline is not None:
                 # Re-encoded per attempt: remaining-ms at send time, so
                 # backoff sleeps and failed attempts charge the budget.
                 hdrs.update(deadline.to_wire())
+            hdrs.update(traceparent_wire(span.traceparent or traceparent))
             self._inflight[iid] = self._inflight.get(iid, 0) + 1
             yielded = False
             settled = False  # breaker got a success/failure verdict
@@ -193,14 +217,27 @@ class PushRouter:
                     breaker.record_success(probe=owns_probe)
                     settled = True
                     self.budget.deposit()
+                span.end(ok=True)
                 return
+            except GeneratorExit:
+                # The consumer closed the stream early — the prefill leg
+                # returns as soon as kv_transfer_params arrives, by
+                # design. A consumed-enough dispatch is a success, not an
+                # error; only an early close before ANY frame stays one.
+                if yielded:
+                    span.add_event("early_close")
+                span.end(ok=yielded)
+                raise
             except DeadlineExceeded:
                 # The request was late, not the worker broken: no breaker
                 # failure, no retry (there is no budget left to retry in).
+                span.add_event("deadline_exceeded")
                 raise
             except (ConnectionLost, EndpointNotFound, KeyError, asyncio.TimeoutError) as exc:
                 breaker.record_failure(probe=owns_probe)
                 settled = True
+                span.add_event("transport_fault", error=repr(exc),
+                               breaker=breaker.state)
                 log.warning("instance %x faulted (%r) breaker=%s", iid, exc,
                             breaker.state)
                 if yielded or self.mode == "direct":
@@ -213,20 +250,31 @@ class PushRouter:
                     raise
                 if not self.budget.try_spend():
                     RETRIES_TOTAL.labels(
-                        endpoint=self.client.endpoint.subject,
+                        endpoint=subject,
                         outcome="denied").inc()
+                    span.add_event("retry_denied", reason="budget")
+                    recorder.event(None, "retry_denied", endpoint=subject)
                     log.warning("retry budget exhausted for %s",
-                                self.client.endpoint.subject)
+                                subject)
                     raise
                 RETRIES_TOTAL.labels(
-                    endpoint=self.client.endpoint.subject,
+                    endpoint=subject,
                     outcome="allowed").inc()
+                recorder.event(None, "retry", endpoint=subject,
+                               instance=f"{iid:x}", attempt=attempts)
+                # Close the attempt span BEFORE the backoff sleep: the
+                # wait belongs to the retry policy, not this dispatch.
+                span.end(ok=False)
                 prev_delay = self.policy.next_delay(prev_delay)
                 delay = prev_delay
                 if deadline is not None:
                     delay = deadline.bound(delay)
                 await asyncio.sleep(delay)
             finally:
+                # Abnormal ends (watchdog cancel, client disconnect, the
+                # fault paths above) close the attempt span here — first
+                # end() wins, so the success path's ok=True stands.
+                span.end(ok=False)
                 if owns_probe and not settled:
                     # Our probe ended with no health verdict (deadline
                     # ran out, application error, caller closed the

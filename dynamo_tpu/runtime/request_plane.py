@@ -26,6 +26,7 @@ from typing import Any, AsyncIterator, Callable, Optional
 from . import codec
 from .logging import get_logger
 from .metrics import DEADLINE_EXCEEDED
+from .otel import traceparent_from_wire
 from .resilience import (
     Deadline,
     DeadlineExceeded,
@@ -69,6 +70,10 @@ class RequestContext:
         # End-to-end budget propagated by the caller (resilience.py);
         # handlers size their own downstream waits from remaining().
         self.deadline: Optional[Deadline] = Deadline.from_wire(self.headers)
+        # W3C trace context propagated by the caller (otel.py): handlers
+        # parent their spans under it, the same first-class wire contract
+        # as the deadline header.
+        self.traceparent: Optional[str] = traceparent_from_wire(self.headers)
         self._stopped = asyncio.Event()
 
     def stop(self) -> None:

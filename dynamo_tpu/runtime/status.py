@@ -1,7 +1,10 @@
-"""System status server: /health, /live, /metrics.
+"""System status server: /health, /live, /metrics, /debug/requests.
 
-Every runtime process exposes liveness, endpoint health, and Prometheus
-metrics on an HTTP port (ref: lib/runtime/src/system_status_server.rs:131-178).
+Every runtime process exposes liveness, endpoint health, Prometheus
+metrics, and its flight-recorder timelines on an HTTP port (ref:
+lib/runtime/src/system_status_server.rs:131-178). /metrics negotiates
+OpenMetrics (exemplars) via the Accept header; /debug/requests returns
+the per-request phase timelines (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -11,9 +14,28 @@ from typing import Callable, Optional
 from aiohttp import web
 
 from . import metrics
+from .flight_recorder import get_recorder
 from .logging import get_logger
 
 log = get_logger("status")
+
+
+def metrics_response(request: web.Request) -> web.Response:
+    """Shared /metrics responder (status server + frontend): OpenMetrics
+    when the scraper asks for it (the only format carrying exemplars),
+    classic Prometheus text otherwise."""
+    if "application/openmetrics-text" in request.headers.get("Accept", ""):
+        return web.Response(
+            body=metrics.render_openmetrics(),
+            headers={"Content-Type": metrics.OPENMETRICS_CONTENT_TYPE})
+    return web.Response(body=metrics.render(), content_type="text/plain",
+                        charset="utf-8")
+
+
+def debug_requests_response(_request: web.Request) -> web.Response:
+    """Shared /debug/requests responder: the flight recorder's inflight
+    + recently-completed request timelines."""
+    return web.json_response(get_recorder().snapshot())
 
 
 class SystemStatusServer:
@@ -42,15 +64,18 @@ class SystemStatusServer:
     async def _live(self, _request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
 
-    async def _metrics(self, _request: web.Request) -> web.Response:
-        return web.Response(body=metrics.render(),
-                            content_type="text/plain", charset="utf-8")
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return metrics_response(request)
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        return debug_requests_response(request)
 
     async def start(self) -> None:
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/requests", self._debug_requests)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Trace smoke: mocker loadgen pass against an OTLP collector stub.
+
+CI entrypoint (the `trace-smoke` job): bring up a mocker worker and the
+OpenAI frontend on in-process planes, point DYNT_OTLP_ENDPOINT at a
+local collector stub, run a short burst of chat requests with
+DYNT_SLOW_TRACE_MS enabled, then assert that
+
+  * the collector received a nonzero number of spans, including the
+    frontend -> router -> (mocker) chain sharing one trace per request,
+  * the frontend's /debug/requests flight recorder is populated with
+    completed timelines (flagged slow by the forced threshold),
+
+and write both the exported trace JSON and the recorder snapshot as CI
+artifacts. Exits nonzero on any violated invariant.
+
+Usage: python scripts/trace_smoke.py [--requests N] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import http.server
+import json
+import os
+import pathlib
+import sys
+import threading
+import uuid
+
+# Runnable as `python scripts/trace_smoke.py` from the repo root.
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+REQUEST_TIMEOUT = 60.0
+
+
+def start_collector():
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            self.server.captured.append((self.path, payload))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    srv.captured = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def spans_of(srv):
+    spans = []
+    for _path, payload in srv.captured:
+        for rs in payload.get("resourceSpans", []):
+            for ss in rs.get("scopeSpans", []):
+                spans.extend(ss.get("spans", []))
+    return spans
+
+
+async def run_pass(n_requests: int):
+    import aiohttp
+
+    from dynamo_tpu.frontend import Frontend
+    from dynamo_tpu.mocker import MockerConfig, MockerWorker
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = uuid.uuid4().hex
+    cfg.request_plane = "mem"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+
+    rt = await DistributedRuntime(cfg).start()
+    worker = MockerWorker(
+        rt, model_name="mock-model",
+        config=MockerConfig(speedup_ratio=500.0, num_blocks=256),
+        load_publish_interval=0.2)
+    await worker.start()
+    frontend = Frontend(rt, host="127.0.0.1", port=0,
+                        router_mode="round_robin")
+    await frontend.start()
+    for _ in range(100):
+        if frontend.manager.get("mock-model") is not None:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise RuntimeError("mocker never registered with the frontend")
+
+    base = f"http://127.0.0.1:{frontend.port}"
+
+    async def one_request(session, i):
+        payload = {
+            "model": "mock-model",
+            "messages": [{"role": "user",
+                          "content": f"trace smoke request {i}"}],
+            "max_tokens": 8,
+        }
+        async with session.post(f"{base}/v1/chat/completions",
+                                json=payload) as resp:
+            body = await resp.json()
+            assert resp.status == 200, body
+            return body
+
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*[one_request(session, i)
+                               for i in range(n_requests)])
+        async with session.get(f"{base}/debug/requests") as resp:
+            snapshot = await resp.json()
+
+    from dynamo_tpu.runtime.otel import get_tracer
+
+    await asyncio.to_thread(get_tracer().flush)
+    await frontend.close()
+    await worker.close()
+    await rt.shutdown()
+    return snapshot
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("trace_smoke")
+    parser.add_argument("--requests", type=int, default=8)
+    parser.add_argument("--out", default=".",
+                        help="artifact directory (trace-smoke-spans.json "
+                             "+ trace-smoke-recorder.json)")
+    args = parser.parse_args()
+
+    srv, endpoint = start_collector()
+    # Must be set before the first get_tracer()/get_recorder() call.
+    os.environ["DYNT_OTLP_ENDPOINT"] = endpoint
+    os.environ.setdefault("DYNT_SLOW_TRACE_MS", "1")
+    os.environ.setdefault("DYNT_DEBUG_ENDPOINTS", "1")
+
+    snapshot = asyncio.run(
+        asyncio.wait_for(run_pass(args.requests), REQUEST_TIMEOUT))
+    spans = spans_of(srv)
+    srv.shutdown()
+
+    out = pathlib.Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "trace-smoke-spans.json").write_text(
+        json.dumps(spans, indent=2))
+    (out / "trace-smoke-recorder.json").write_text(
+        json.dumps(snapshot, indent=2))
+
+    failures = []
+    if not spans:
+        failures.append("no spans reached the collector stub")
+    names = {s["name"] for s in spans}
+    for required in ("http.chat", "router.dispatch"):
+        if required not in names:
+            failures.append(f"span {required!r} missing (got {sorted(names)})")
+    http_spans = [s for s in spans if s["name"] == "http.chat"]
+    traces = {s["traceId"] for s in http_spans}
+    if len(traces) != args.requests:
+        failures.append(f"expected {args.requests} traces, "
+                        f"saw {len(traces)}")
+    # every dispatch parents under an http span of the same trace
+    by_id = {s["spanId"]: s for s in spans}
+    for s in spans:
+        if s["name"] == "router.dispatch":
+            parent = by_id.get(s.get("parentSpanId", ""))
+            if parent is None or parent["traceId"] != s["traceId"]:
+                failures.append("router.dispatch span with broken parentage")
+                break
+    completed = snapshot.get("completed", [])
+    if len(completed) < args.requests:
+        failures.append(f"/debug/requests has {len(completed)} completed "
+                        f"timelines, expected >= {args.requests}")
+    if not any(t.get("slow") for t in completed):
+        failures.append("DYNT_SLOW_TRACE_MS=1 set but no timeline "
+                        "flagged slow")
+    if not all({"received", "first_token", "finished"}
+               <= set(t.get("phases", {})) for t in completed):
+        failures.append("completed timelines missing phase timestamps")
+
+    print(f"trace-smoke: {len(spans)} spans, {len(traces)} traces, "
+          f"{len(completed)} recorded timelines")
+    for failure in failures:
+        print(f"FAIL: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""Span-registry negative fixtures: documented, unique, dynamic-skipped."""
+
+
+def documented(tracer):
+    with tracer.start_span("fixture.documented"):
+        pass
+
+
+def conditional(tracer, kind):
+    with tracer.start_span(
+            "fixture.chat" if kind == "chat" else "fixture.completions"):
+        pass
+
+
+def phase(tracer, parent):
+    tracer.record_span("fixture.phase", parent, 1, 2)
+
+
+def dynamic(tracer, name):
+    # Dynamic names are invisible to the registry (kept literal in the
+    # real tree); must not crash or report.
+    with tracer.start_span(name):
+        pass

@@ -1,0 +1,22 @@
+"""Span-registry positive fixtures: undocumented + duplicate names."""
+
+
+def documented(tracer):
+    with tracer.start_span("fixture.documented"):
+        pass
+
+
+def undocumented(tracer):
+    # DF501: not in the catalogue doc
+    with tracer.start_span("fixture.mystery"):
+        pass
+
+
+def duplicate_site(tracer):
+    # DF502: same name as documented() above
+    with tracer.start_span("fixture.documented"):
+        pass
+
+
+def phase(tracer, parent):
+    tracer.record_span("fixture.phase", parent, 1, 2)

@@ -29,6 +29,7 @@ from tools.dynaflow.passes_registry import (
     UndocumentedMetric,
     UnregisteredEnvRead,
 )
+from tools.dynaflow.passes_spans import DuplicateSpanName, UndocumentedSpan
 from tools.dynalint.core import collect_files
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynaflow"
@@ -174,6 +175,34 @@ class TestRegistryConformance:
              DeadConfigKnob(), DuplicateMetricName(),
              UndocumentedMetric(doc_path=FIXTURES / "metrics_doc.md")])
         assert findings == []
+
+
+class TestSpanRegistry:
+    def test_positive(self):
+        findings = flow(
+            "spans_pos",
+            [UndocumentedSpan(doc_path=FIXTURES / "spans_doc.md"),
+             DuplicateSpanName()])
+        assert any(f.rule == "DF501" and "fixture.mystery" in f.message
+                   for f in findings)
+        assert any(f.rule == "DF502" and "fixture.documented" in f.message
+                   for f in findings)
+
+    def test_negative(self):
+        findings = flow(
+            "spans_neg",
+            [UndocumentedSpan(doc_path=FIXTURES / "spans_doc.md"),
+             DuplicateSpanName()])
+        assert findings == []
+
+    def test_conditional_names_both_checked(self):
+        findings = flow(
+            "spans_neg",
+            [UndocumentedSpan(doc_path=FIXTURES / "metrics_doc.md")])
+        # against the WRONG doc every literal name (incl. both IfExp
+        # branches) is undocumented
+        names = " ".join(f.message for f in findings)
+        assert "fixture.chat" in names and "fixture.completions" in names
 
 
 class TestSuppressions:

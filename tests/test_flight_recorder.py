@@ -1,0 +1,178 @@
+"""Flight recorder (runtime/flight_recorder.py): ring-buffer eviction,
+contextvar stamping from concurrent requests, /debug/requests JSON shape,
+and DYNT_SLOW_TRACE_MS slow-request auto-capture."""
+
+import asyncio
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime.flight_recorder import (
+    FlightRecorder,
+    get_recorder,
+    reset_recorder,
+)
+from dynamo_tpu.runtime.logging import current_request_id
+
+
+class TestRingBuffer:
+    def test_completed_ring_evicts_oldest(self):
+        rec = FlightRecorder(capacity=3, slow_ms=0)
+        for i in range(5):
+            rec.start(f"r{i}")
+            rec.finish(f"r{i}")
+        snap = rec.snapshot()
+        assert [t["request_id"] for t in snap["completed"]] == \
+            ["r4", "r3", "r2"]  # newest first, oldest two evicted
+        assert snap["inflight"] == []
+
+    def test_finish_is_first_wins_and_idempotent(self):
+        rec = FlightRecorder(capacity=4, slow_ms=0)
+        rec.start("a")
+        first = rec.finish("a", "deadline_exceeded")
+        assert first is not None and first.status == "deadline_exceeded"
+        # later (laxer) finish from another component is a no-op
+        assert rec.finish("a", "ok") is None
+        assert rec.get("a").status == "deadline_exceeded"
+
+    def test_stamp_unknown_request_is_noop(self):
+        rec = FlightRecorder(capacity=2, slow_ms=0)
+        rec.stamp("ghost", "queued")  # canary / bare-scheduler callers
+        rec.event("ghost", "retry")
+        assert rec.snapshot() == {"inflight": [], "completed": []}
+
+    def test_phase_stamps_are_first_write_wins(self):
+        rec = FlightRecorder(capacity=2, slow_ms=0)
+        rec.start("a")
+        rec.stamp("a", "queued", ts=10.0)
+        rec.stamp("a", "queued", ts=99.0)
+        assert rec.get("a").phases["queued"] == 10.0
+
+
+class TestContextvarStamping:
+    def test_concurrent_tasks_stamp_their_own_timelines(self, run):
+        """Two interleaved asyncio tasks stamping with NO explicit id:
+        the contextvar keeps each task's stamps on its own timeline."""
+        rec = FlightRecorder(capacity=8, slow_ms=0)
+
+        async def one_request(rid, phase_delay):
+            current_request_id.set(rid)
+            rec.start(rid)
+            await asyncio.sleep(phase_delay)
+            rec.stamp(None, "queued")  # rid resolved from the contextvar
+            rec.event(None, "retry", attempt=1)
+            await asyncio.sleep(phase_delay)
+            rec.finish(None)
+
+        async def body():
+            await asyncio.gather(one_request("req-a", 0.01),
+                                 one_request("req-b", 0.002))
+
+        run(body())
+        for rid in ("req-a", "req-b"):
+            tl = rec.get(rid)
+            assert tl.status == "ok"
+            assert set(tl.phases) == {"received", "queued", "finished"}
+            assert [e["event"] for e in tl.events] == ["retry"]
+
+    def test_no_context_no_id_is_noop(self):
+        rec = FlightRecorder(capacity=2, slow_ms=0)
+        assert current_request_id.get() is None
+        rec.stamp(None, "queued")
+        rec.finish(None)
+        assert rec.snapshot() == {"inflight": [], "completed": []}
+
+
+class TestDebugEndpointShape:
+    def test_snapshot_json_shape(self):
+        rec = FlightRecorder(capacity=4, slow_ms=0)
+        rec.start("live", model="m", trace_id="ab" * 16)
+        rec.stamp("live", "queued")
+        rec.start("done", model="m")
+        rec.event("done", "kv_pull", bytes=128, link="dcn")
+        rec.finish("done", "ok")
+        snap = rec.snapshot()
+        (live,) = snap["inflight"]
+        assert live["status"] == "inflight"
+        assert live["trace_id"] == "ab" * 16
+        assert set(live["phases"]) == {"received", "queued"}
+        assert isinstance(live["elapsed_ms"], float)
+        (done,) = snap["completed"]
+        assert done["status"] == "ok"
+        assert "finished" in done["phases"]
+        (event,) = done["events"]
+        assert event["event"] == "kv_pull"
+        assert event["bytes"] == 128 and event["link"] == "dcn"
+        assert "ts" in event
+
+    def test_status_server_serves_debug_requests(self, run):
+        """GET /debug/requests on the system status server returns the
+        process recorder's snapshot."""
+        import aiohttp
+
+        from dynamo_tpu.runtime.status import SystemStatusServer
+
+        reset_recorder()
+        get_recorder().start("via-status", model="m")
+
+        async def body():
+            server = SystemStatusServer(port=0, host="127.0.0.1")
+            await server.start()
+            try:
+                url = f"http://127.0.0.1:{server.port}/debug/requests"
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(url) as resp:
+                        assert resp.status == 200
+                        return await resp.json()
+            finally:
+                await server.close()
+
+        snap = run(body())
+        reset_recorder()
+        assert [t["request_id"] for t in snap["inflight"]] == ["via-status"]
+
+
+@pytest.fixture
+def dynamo_caplog(caplog):
+    """caplog that sees dynamo_tpu records: the project logger does not
+    propagate to root (its own handler formats trace context), so lift
+    propagation for the duration of the test."""
+    logger = logging.getLogger("dynamo_tpu")
+    old = logger.propagate
+    logger.propagate = True
+    yield caplog
+    logger.propagate = old
+
+
+class TestSlowAutoCapture:
+    def test_slow_request_dumped_and_flagged(self, dynamo_caplog):
+        rec = FlightRecorder(capacity=2, slow_ms=0.0001)
+        rec.start("tortoise")
+        with dynamo_caplog.at_level(logging.WARNING):
+            tl = rec.finish("tortoise", "ok")
+        assert tl.slow
+        assert any("slow" in r.message and "tortoise" in r.message
+                   for r in dynamo_caplog.records)
+
+    def test_fast_ok_request_not_dumped(self, dynamo_caplog):
+        rec = FlightRecorder(capacity=2, slow_ms=60_000)
+        rec.start("hare")
+        with dynamo_caplog.at_level(logging.WARNING):
+            tl = rec.finish("hare", "ok")
+        assert not tl.slow
+        assert not dynamo_caplog.records
+
+    def test_error_always_dumped(self, dynamo_caplog):
+        rec = FlightRecorder(capacity=2, slow_ms=0)
+        rec.start("boom")
+        with dynamo_caplog.at_level(logging.WARNING):
+            rec.finish("boom", "error")
+        assert any("flight record (error)" in r.message
+                   for r in dynamo_caplog.records)
+
+    def test_env_knobs_resolved_at_construction(self, monkeypatch):
+        monkeypatch.setenv("DYNT_FLIGHT_RECORDER_SIZE", "2")
+        monkeypatch.setenv("DYNT_SLOW_TRACE_MS", "123.5")
+        rec = FlightRecorder()
+        assert rec.slow_ms == pytest.approx(123.5)
+        assert rec._completed.maxlen == 2

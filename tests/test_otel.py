@@ -119,6 +119,90 @@ class TestTracerExport:
         finally:
             tracer.close()
 
+    def test_span_events_exported(self):
+        srv, endpoint = _start_collector()
+        try:
+            tracer = Tracer(endpoint)
+            with tracer.start_span("evented") as span:
+                span.add_event("retry", attempt=1)
+                span.add_event("phase_mark", ts=1234.5)
+            assert tracer.flush() == 1
+            (span_json,) = _spans_of(srv)
+            events = {e["name"]: e for e in span_json["events"]}
+            assert set(events) == {"retry", "phase_mark"}
+            assert events["phase_mark"]["timeUnixNano"] == str(
+                int(1234.5 * 1e9))
+            attrs = {a["key"]: a["value"]
+                     for a in events["retry"]["attributes"]}
+            assert attrs["attempt"] == {"intValue": "1"}
+            tracer.close()
+        finally:
+            srv.shutdown()
+
+    def test_record_span_explicit_timestamps(self):
+        srv, endpoint = _start_collector()
+        try:
+            tracer = Tracer(endpoint)
+            parent = format_traceparent("ab" * 16, "cd" * 8)
+            tracer.record_span("phase", parent, 1_000, 2_000, blocks=3)
+            # malformed parent -> silently skipped, never a bogus trace
+            tracer.record_span("phase", "garbage", 1_000, 2_000)
+            assert tracer.flush() == 1
+            (span_json,) = _spans_of(srv)
+            assert span_json["startTimeUnixNano"] == "1000"
+            assert span_json["endTimeUnixNano"] == "2000"
+            assert span_json["parentSpanId"] == "cd" * 8
+            tracer.close()
+        finally:
+            srv.shutdown()
+
+    def test_export_counters_track_outcomes(self):
+        from dynamo_tpu.runtime.metrics import (
+            OTEL_SPANS_DROPPED,
+            OTEL_SPANS_EXPORTED,
+        )
+
+        def _value(counter, **labels):
+            c = counter.labels(**labels) if labels else counter
+            return c._value.get()
+
+        srv, endpoint = _start_collector()
+        try:
+            exported0 = _value(OTEL_SPANS_EXPORTED)
+            dropped0 = _value(OTEL_SPANS_DROPPED, reason="export_error")
+            good = Tracer(endpoint)
+            with good.start_span("ok-span"):
+                pass
+            assert good.flush() == 1
+            assert _value(OTEL_SPANS_EXPORTED) == exported0 + 1
+            bad = Tracer("http://127.0.0.1:9")  # nothing listens
+            with bad.start_span("doomed"):
+                pass
+            assert bad.flush() == 0
+            assert _value(OTEL_SPANS_DROPPED,
+                          reason="export_error") == dropped0 + 1
+            good.close()
+            bad.close()
+        finally:
+            srv.shutdown()
+
+    def test_get_tracer_registers_atexit_flush(self, monkeypatch):
+        """The process-exit drain (satellite: daemon flusher loses
+        buffered spans at exit without a registered close)."""
+        import atexit as _atexit
+
+        registered = []
+        monkeypatch.setattr(_atexit, "register",
+                            lambda fn: registered.append(fn) or fn)
+        monkeypatch.setenv("DYNT_OTLP_ENDPOINT", "http://127.0.0.1:1234")
+        reset_tracer()
+        try:
+            tracer = get_tracer()
+            assert registered == [tracer.close]
+        finally:
+            monkeypatch.delenv("DYNT_OTLP_ENDPOINT")
+            reset_tracer()
+
     def test_get_tracer_reads_env(self, monkeypatch):
         monkeypatch.setenv("DYNT_OTLP_ENDPOINT", "http://127.0.0.1:1234")
         monkeypatch.setenv("DYNT_OTEL_SERVICE_NAME", "frontdoor")
@@ -129,6 +213,53 @@ class TestTracerExport:
         finally:
             monkeypatch.delenv("DYNT_OTLP_ENDPOINT")
             reset_tracer()
+
+
+class TestSloObserver:
+    def test_worst_token_itl_uses_raw_gap(self, monkeypatch):
+        """A stall hidden inside a multi-token chunk must still fail the
+        worst-token ITL target: the chunk's first token waited the whole
+        inter-output gap, so averaging over the chunk would let a 400ms
+        freeze pass a 100ms target."""
+        from dynamo_tpu.llm import http_service as hs
+        from dynamo_tpu.llm.protocols import (
+            EngineOutput,
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime import metrics as rt_metrics
+
+        clock = [0.0]
+        monkeypatch.setattr(hs.time, "monotonic", lambda: clock[0])
+        pre = PreprocessedRequest(request_id="slo-req", token_ids=[1],
+                                  sampling=SamplingOptions(),
+                                  stop=StopConditions(), model="slo-test")
+
+        def goodput():
+            return (rt_metrics.SLO_GOOD
+                    .labels(model="slo-test")._value.get())
+
+        base = goodput()
+        obs = hs._SloObserver(pre, ttft_target_ms=0, itl_target_ms=100)
+        clock[0] = 0.01
+        obs.on_output(EngineOutput(token_ids=[1]))
+        clock[0] = 0.02
+        obs.on_output(EngineOutput(token_ids=[2]))
+        clock[0] = 0.42  # 400ms stall, then an 8-token chunk
+        obs.on_output(EngineOutput(token_ids=list(range(8))))
+        obs.finalize(ok=True)
+        assert obs.itl_max == pytest.approx(0.4)
+        assert goodput() == base  # stall breached the worst-token target
+
+        # Same shape without the stall passes.
+        clock[0] = 0.0
+        obs2 = hs._SloObserver(pre, ttft_target_ms=0, itl_target_ms=100)
+        for step in (0.01, 0.02, 0.05):
+            clock[0] = step
+            obs2.on_output(EngineOutput(token_ids=[1]))
+        obs2.finalize(ok=True)
+        assert goodput() == base + 1
 
 
 class TestE2ESpans:
@@ -189,15 +320,270 @@ class TestE2ESpans:
             run(body(), timeout=300)
             spans = _spans_of(srv)
             names = {s["name"] for s in spans}
-            assert "http.chat" in names and "worker.generate" in names
+            assert {"http.chat", "router.dispatch", "worker.generate",
+                    "scheduler.queue", "worker.decode"} <= names, names
             by_name = {s["name"]: s for s in spans}
+            # client's trace continues through every tier
+            assert all(s["traceId"] == client_trace for s in spans), spans
             http_span = by_name["http.chat"]
+            dispatch = by_name["router.dispatch"]
             wrk_span = by_name["worker.generate"]
-            # client's trace continues through both tiers
-            assert http_span["traceId"] == client_trace
             assert http_span["parentSpanId"] == "12" * 8
-            assert wrk_span["traceId"] == client_trace
-            assert wrk_span["parentSpanId"] == http_span["spanId"]
+            # frontend -> router -> worker -> synthesized phase spans
+            assert dispatch["parentSpanId"] == http_span["spanId"]
+            assert wrk_span["parentSpanId"] == dispatch["spanId"]
+            assert by_name["scheduler.queue"]["parentSpanId"] == \
+                wrk_span["spanId"]
+            assert by_name["worker.decode"]["parentSpanId"] == \
+                wrk_span["spanId"]
+            # phase marks ride the worker span as timestamped events
+            event_names = {e["name"]
+                           for e in wrk_span.get("events", [])}
+            assert {"queued", "scheduled", "first_token",
+                    "finished"} <= event_names, event_names
+        finally:
+            monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)
+            reset_tracer()
+            srv.shutdown()
+
+    def test_disagg_single_trace_covers_all_legs(self, run,
+                                                 mem_runtime_config,
+                                                 monkeypatch):
+        """Acceptance: one trace whose spans cover frontend -> router ->
+        prefill worker -> KV transfer -> decode worker with correct
+        parentage; /metrics renders TTFT exemplars carrying the trace id
+        (OpenMetrics); /debug/requests has phase timestamps for the
+        completed request."""
+        import asyncio
+
+        import aiohttp
+
+        srv, endpoint = _start_collector()
+        monkeypatch.setenv("DYNT_OTLP_ENDPOINT", endpoint)
+        monkeypatch.setenv("DYNT_DEBUG_ENDPOINTS", "1")
+        reset_tracer()
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+        from dynamo_tpu.frontend import Frontend
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.flight_recorder import reset_recorder
+
+        reset_recorder()
+        client_trace = "fe" * 16
+        client_tp = format_traceparent(client_trace, "12" * 8)
+        debug_snap = {}
+        metrics_text = {}
+
+        async def body():
+            cfg = mem_runtime_config()
+            rt = await DistributedRuntime(cfg).start()
+            rcfg = RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                                max_pages_per_seq=16,
+                                prefill_buckets=(8, 16, 32))
+            prefill_w = TpuWorker(rt, model_name="tiny-test",
+                                  component="prefill", mode="prefill",
+                                  runner_config=rcfg, warmup=False)
+            decode_w = TpuWorker(rt, model_name="tiny-test",
+                                 component="backend", mode="decode",
+                                 runner_config=rcfg, warmup=False)
+            await prefill_w.start()
+            await decode_w.start()
+            frt = await DistributedRuntime(mem_runtime_config(
+                cfg.discovery_path)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0,
+                                router_mode="round_robin")
+            await frontend.start()
+            for _ in range(100):
+                pool = frontend.watcher._prefill_pools.get("tiny-test")
+                if (frontend.manager.get("tiny-test") is not None
+                        and pool is not None and pool.active()):
+                    break
+                await asyncio.sleep(0.05)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/chat/completions", json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "hi there"}],
+                    "max_tokens": 3,
+                }, headers={"traceparent": client_tp}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    await resp.json()
+                async with session.get(f"{base}/debug/requests") as resp:
+                    debug_snap.update(await resp.json())
+                async with session.get(f"{base}/metrics", headers={
+                    "Accept": "application/openmetrics-text",
+                }) as resp:
+                    metrics_text["body"] = await resp.text()
+            await asyncio.to_thread(get_tracer().flush)
+            await frontend.close()
+            await frt.shutdown()
+            await decode_w.close()
+            await prefill_w.close()
+            await rt.shutdown()
+
+        try:
+            run(body(), timeout=300)
+            spans = _spans_of(srv)
+            # single trace across every leg
+            assert spans and all(
+                s["traceId"] == client_trace for s in spans), spans
+            by_id = {s["spanId"]: s for s in spans}
+
+            def ancestors(span):
+                names = []
+                while span.get("parentSpanId") in by_id:
+                    span = by_id[span["parentSpanId"]]
+                    names.append(span["name"])
+                return names
+
+            def find(name, **attrs):
+                for s in spans:
+                    if s["name"] != name:
+                        continue
+                    got = {a["key"]: list(a["value"].values())[0]
+                           for a in s.get("attributes", [])}
+                    if all(got.get(k) == v for k, v in attrs.items()):
+                        return s
+                raise AssertionError(
+                    f"no span {name} with {attrs} in "
+                    f"{[s['name'] for s in spans]}")
+
+            prefill_leg = find("prefill.remote")
+            wrk_prefill = find("worker.generate", **{"worker.mode": "prefill"})
+            wrk_decode = find("worker.generate", **{"worker.mode": "decode"})
+            kv_pull = find("kv_transfer.pull")
+            kv_serve = find("kv_transfer.serve")
+            # frontend -> prefill leg -> prefill worker
+            assert "http.chat" in ancestors(prefill_leg)
+            assert "prefill.remote" in ancestors(wrk_prefill)
+            # decode worker under the frontend, NOT under the prefill leg
+            decode_chain = ancestors(wrk_decode)
+            assert "http.chat" in decode_chain
+            assert "prefill.remote" not in decode_chain
+            # KV transfer hangs off the decode worker; serve side joins
+            # through the pull's dispatch
+            assert "worker.generate" in ancestors(kv_pull)
+            assert "kv_transfer.pull" in ancestors(kv_serve)
+            # A healthy disagg request must export no ERROR spans: the
+            # prefill leg aclose()s its dispatch stream early by design,
+            # which used to skip the ok=True path and close the
+            # router.dispatch span as an error.
+            bad = [s["name"] for s in spans
+                   if s.get("status", {}).get("code") != 1]
+            assert not bad, f"ERROR-status spans in healthy run: {bad}"
+
+            # /debug/requests: completed timeline with phase timestamps
+            done = {t["request_id"]: t
+                    for t in debug_snap.get("completed", [])}
+            main = [t for rid, t in done.items()
+                    if not rid.endswith("#prefill")]
+            legs = [t for rid, t in done.items()
+                    if rid.endswith("#prefill")]
+            assert main and legs, debug_snap
+            assert {"received", "queued", "scheduled",
+                    "first_token", "finished"} <= set(main[0]["phases"])
+            assert main[0]["trace_id"] == client_trace
+            assert any(e["event"] == "kv_pull"
+                       for t in main for e in t["events"]), main
+
+            # /metrics (OpenMetrics): TTFT observation carries the
+            # trace_id exemplar
+            ttft_lines = [
+                line for line in metrics_text["body"].splitlines()
+                if line.startswith("dynamo_time_to_first_token_seconds"
+                                   "_bucket") and "# {" in line
+            ]
+            assert any(f'trace_id="{client_trace}"' in line
+                       for line in ttft_lines), ttft_lines
+            # goodput counted the request (no targets set -> good)
+            assert ('dynamo_slo_good_total{model="tiny-test"}'
+                    in metrics_text["body"])
+        finally:
+            monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)
+            reset_tracer()
+            srv.shutdown()
+
+    def test_streamed_responses_and_messages_spans_and_slo(
+            self, run, mem_runtime_config, monkeypatch):
+        """Streamed /v1/responses and /v1/messages must close their server
+        spans (exported with OK status on the client's trace) and count
+        toward the SLO goodput counters like every other stream kind."""
+        import asyncio
+        import uuid as _uuid
+
+        import aiohttp
+
+        srv, endpoint = _start_collector()
+        monkeypatch.setenv("DYNT_OTLP_ENDPOINT", endpoint)
+        reset_tracer()
+        from dynamo_tpu.frontend import Frontend
+        from dynamo_tpu.mocker import MockerConfig, MockerWorker
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.flight_recorder import reset_recorder
+
+        reset_recorder()
+        model = f"mock-{_uuid.uuid4().hex[:8]}"
+        resp_trace, msg_trace = "ad" * 16, "ae" * 16
+        metrics_text = {}
+
+        async def body():
+            cfg = mem_runtime_config()
+            rt = await DistributedRuntime(cfg).start()
+            worker = MockerWorker(
+                rt, model_name=model,
+                config=MockerConfig(speedup_ratio=500.0, num_blocks=64))
+            await worker.start()
+            frt = await DistributedRuntime(mem_runtime_config(
+                cfg.discovery_path)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0,
+                                router_mode="round_robin",
+                                slo_ttft_ms=60000.0)
+            await frontend.start()
+            for _ in range(100):
+                if frontend.manager.get(model) is not None:
+                    break
+                await asyncio.sleep(0.05)
+            base = f"http://127.0.0.1:{frontend.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/responses", json={
+                    "model": model, "input": "hi",
+                    "max_output_tokens": 4, "stream": True,
+                }, headers={"traceparent": format_traceparent(
+                    resp_trace, "12" * 8)}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    events = (await resp.text()).split("\n\n")
+                    assert any("response.completed" in e for e in events)
+                async with session.post(f"{base}/v1/messages", json={
+                    "model": model, "max_tokens": 4, "stream": True,
+                    "messages": [{"role": "user", "content": "hi"}],
+                }, headers={"traceparent": format_traceparent(
+                    msg_trace, "34" * 8)}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    assert "message_stop" in await resp.text()
+                async with session.get(f"{base}/metrics") as resp:
+                    metrics_text["body"] = await resp.text()
+            await asyncio.to_thread(get_tracer().flush)
+            await frontend.close()
+            await frt.shutdown()
+            await worker.close()
+            await rt.shutdown()
+
+        try:
+            run(body(), timeout=120)
+            spans = _spans_of(srv)
+            by_trace = {}
+            for s in spans:
+                by_trace.setdefault(s["traceId"], []).append(s)
+            for trace, name in ((resp_trace, "http.responses"),
+                                (msg_trace, "http.messages")):
+                server = [s for s in by_trace.get(trace, [])
+                          if s["name"] == name]
+                assert server, (name, {s["name"] for s in spans})
+                assert server[0]["status"]["code"] == 1, server
+            # both streams counted toward goodput (TTFT well under target)
+            assert (f'dynamo_slo_requests_total{{model="{model}"}} 2.0'
+                    in metrics_text["body"]), metrics_text["body"]
+            assert (f'dynamo_slo_good_total{{model="{model}"}} 2.0'
+                    in metrics_text["body"])
         finally:
             monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)
             reset_tracer()

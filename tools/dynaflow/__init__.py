@@ -32,7 +32,13 @@ from tools.dynalint.core import run as _run
 
 DYNAFLOW = Registry("dynaflow", "DF000")
 
-from . import passes_locks, passes_protocol, passes_reach, passes_registry
+from . import (
+    passes_locks,
+    passes_protocol,
+    passes_reach,
+    passes_registry,
+    passes_spans,
+)
 from .passes_protocol import (  # noqa: F401
     DEFAULT_PLANES,
     SCHEMA_DIR,
@@ -55,6 +61,8 @@ for _cls in (
     passes_registry.DeadConfigKnob,
     passes_registry.DuplicateMetricName,
     passes_registry.UndocumentedMetric,
+    passes_spans.UndocumentedSpan,
+    passes_spans.DuplicateSpanName,
 ):
     DYNAFLOW.register(_cls)
 

@@ -57,13 +57,15 @@ class Plane:
 DEFAULT_PLANES = (
     Plane("request_plane",
           # resilience.py is part of the plane: Deadline.to_wire/from_wire
-          # own the x-dynt-deadline-ms header fragment every hop forwards.
+          # own the x-dynt-deadline-ms header fragment every hop forwards;
+          # otel.py owns the traceparent header the same way.
           ("runtime/request_plane.py", "runtime/codec.py",
-           "runtime/resilience.py"),
+           "runtime/resilience.py", "runtime/otel.py"),
           ("write_frame", "encode_frame", "_send", "send", "_http_frame",
            "put_nowait"),
           ("header", "frame"),
-          tag_key="t"),
+          tag_key="t",
+          codec_fns=("to_wire", "traceparent_wire")),
     Plane("event_plane",
           ("runtime/events.py", "kv_router/protocols.py"),
           ("packb", "put", "_put_leased", "publish"),

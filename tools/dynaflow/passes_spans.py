@@ -1,0 +1,132 @@
+"""Pass 5 — span-name registry conformance.
+
+Trace span names are operator API surface exactly like metric names:
+dashboards, trace queries, and alert routing key on them, and
+docs/observability.md is their canonical catalogue (the
+prometheus_names.rs analog for the tracing plane). Two rules, the same
+shape as the DF404/DF405 metric-registry rules:
+
+* DF501 undocumented-span: a literal span name passed to
+  `start_span(...)` / `record_span(...)` that does not appear (in
+  backticks) in the docs/observability.md catalogue — new spans must be
+  documented in the same PR.
+* DF502 duplicate-span-name: the same literal span name created at two
+  distinct call sites — span names identify one instrumentation point;
+  two sites sharing one name make traces unattributable.
+
+Name extraction handles plain string constants and conditional
+expressions whose branches are both constants
+(`"http.chat" if kind == "chat" else "http.completions"`). Dynamic
+names are invisible to the registry — keep span names literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterable
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .graph import call_tail
+
+OBSERVABILITY_DOC = (pathlib.Path(__file__).parent.parent.parent
+                     / "docs" / "observability.md")
+
+# Call tails that create a span whose first positional argument is its
+# name (runtime/otel.py Tracer API).
+SPAN_FNS = ("start_span", "record_span")
+
+
+def _span_names(node: ast.AST) -> list[str]:
+    """Literal span name(s) at a span-creating call site, [] otherwise."""
+    if not (isinstance(node, ast.Call) and call_tail(node) in SPAN_FNS
+            and node.args):
+        return []
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp) \
+            and isinstance(arg.body, ast.Constant) \
+            and isinstance(arg.body.value, str) \
+            and isinstance(arg.orelse, ast.Constant) \
+            and isinstance(arg.orelse.value, str):
+        return [arg.body.value, arg.orelse.value]
+    return []
+
+
+def span_sites(files: list[SourceFile],
+               ) -> list[tuple[SourceFile, ast.AST, str]]:
+    out = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            for name in _span_names(node):
+                out.append((src, node, name))
+    return out
+
+
+class _SpanRule(ProjectRule):
+    def __init__(self, doc_path: pathlib.Path = OBSERVABILITY_DOC) -> None:
+        self.doc_path = doc_path
+
+
+def _catalogue_names(text: str) -> set[str]:
+    """Span names documented in the catalogue: the first backticked cell
+    of each table row, scoped to the "Span-name catalogue" section when
+    that heading exists (so attribute/phase words backticked in prose or
+    other tables don't count as documented spans)."""
+    section = re.search(r"^##[^\n]*catalogue[^\n]*$(.*?)(?=^## |\Z)",
+                        text, re.MULTILINE | re.DOTALL | re.IGNORECASE)
+    if section:
+        text = section.group(1)
+    return set(re.findall(r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|",
+                          text, re.MULTILINE))
+
+
+class UndocumentedSpan(_SpanRule):
+    id = "DF501"
+    name = "undocumented-span"
+    description = (
+        "a literal span name passed to start_span/record_span that is "
+        "missing from the docs/observability.md catalogue: span names "
+        "are operator API surface (trace queries and dashboards key on "
+        "them) — document the span or remove it")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        sites = span_sites(files)
+        if not sites:
+            return
+        documented: set[str] = set()
+        if self.doc_path.exists():
+            documented = _catalogue_names(self.doc_path.read_text())
+        for src, node, name in sites:
+            if name not in documented:
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"span {name!r} is not documented in "
+                    f"{self.doc_path.name} — add it to the span-name "
+                    "catalogue in the same PR")
+
+
+class DuplicateSpanName(_SpanRule):
+    id = "DF502"
+    name = "duplicate-span-name"
+    description = (
+        "the same literal span name created at two call sites: a span "
+        "name identifies ONE instrumentation point — two sites sharing "
+        "it make trace durations and error rates unattributable")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        seen: dict[str, tuple[str, int]] = {}
+        for src, node, name in span_sites(files):
+            if name in seen:
+                rel, line = seen[name]
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"span name {name!r} already created at {rel}:{line} "
+                    "— give each instrumentation point its own name")
+            else:
+                seen[name] = (src.rel, node.lineno)
