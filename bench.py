@@ -60,6 +60,7 @@ def bench_one(model: str, *, model_path: str | None = None,
               num_pages: int = 1024, prompt_len: int = 256,
               decode_steps: int = 256, prefill_chunk: int = 1024,
               do_prefill: bool = True, do_ttft: bool = True,
+              do_spec: bool = True,
               device_kind: str = "cpu") -> dict:
     from dynamo_tpu.engine import ModelRunner, RunnerConfig
     from dynamo_tpu.models import get_config
@@ -220,6 +221,83 @@ def bench_one(model: str, *, model_path: str | None = None,
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
     }
+
+    # Speculative decode point (ROADMAP item 1 / ISSUE 7): the same
+    # decode workload driven through the draftless speculation plane —
+    # n-gram proposals mined from each sequence's own token stream,
+    # verified k+1 positions per dispatch (engine/spec.py +
+    # ModelRunner.decode_spec, exactly what the serving scheduler runs
+    # with DYNT_SPEC_ENABLE=1). Greedy continuation of the SAME
+    # random-prompt state as the plain decode number above, so
+    # acceptance reflects what the model actually repeats — reported
+    # alongside tok/s rather than assumed.
+    # Gated on runner.supports_spec: MLA/gpt-oss configs have no
+    # multi-token verification forward, and a single-model bench of one
+    # must not crash away its decode/prefill numbers.
+    if do_spec and os.environ.get("DYNT_BENCH_SPEC", "1") != "0" \
+            and getattr(runner, "supports_spec", False):
+        from dynamo_tpu.engine.spec import NGramProposer
+
+        spec_k = int(os.environ.get("DYNT_BENCH_SPEC_K", "4"))
+        proposers = []
+        sp_tokens = np.array(state["tokens"], np.int32).reshape(-1)
+        sp_positions = np.full(batch, prompt_len + block, np.int32)
+        sp_kv_lens = sp_positions + 1
+        sp_steps = np.full(batch, block, np.int32)
+        for b in range(batch):
+            # History = this slot's committed stream (the bench has no
+            # prompt text worth mining; serving seeds with the prompt).
+            proposers.append(NGramProposer([int(sp_tokens[b])]))
+        drafts = np.zeros((batch, spec_k), np.int32)
+        # Committed tokens + the k-token verification overrun must stay
+        # inside the per-sequence page allocation sized above.
+        n_iter = max(1, (decode_steps - spec_k) // (spec_k + 1))
+        proposed = accepted = emitted = 0
+
+        def spec_iter():
+            nonlocal proposed, accepted, emitted
+            mined = np.zeros(batch, np.int32)
+            for b in range(batch):
+                drafts[b] = 0
+                prop = proposers[b].propose(spec_k)
+                drafts[b, : len(prop)] = prop
+                mined[b] = len(prop)
+                proposed += len(prop)
+            targets, n_acc = runner.decode_spec(
+                sp_tokens, drafts, sp_positions, btables, sp_kv_lens,
+                active, temp, top_p, top_k, seeds, sp_steps)
+            for b in range(batch):
+                n = int(n_acc[b])
+                toks = [int(t) for t in targets[b, : n + 1]]
+                proposers[b].extend(toks)
+                sp_tokens[b] = toks[-1]
+                sp_positions[b] += len(toks)
+                sp_kv_lens[b] += len(toks)
+                sp_steps[b] += len(toks)
+                emitted += len(toks)
+                # Acceptance counts MINED drafts only (the scheduler's
+                # cap): an accidental target match on a 0-padded row
+                # commits a correct token but is not an acceptance.
+                accepted += min(n, int(mined[b]))
+            return targets
+
+        spec_iter()  # warmup (compiles the spec variant)
+        proposed = accepted = emitted = 0
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            spec_iter()
+        spec_elapsed = time.perf_counter() - t0
+        result["spec"] = {
+            "tokens_per_sec_per_chip": round(emitted / spec_elapsed, 1),
+            "k": spec_k,
+            "steps": n_iter,
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": round(accepted / proposed, 4)
+                               if proposed else 0.0,
+            "speedup_vs_decode": round(
+                (emitted / spec_elapsed) / tok_per_sec, 3),
+        }
 
     # On-chip prefill throughput + MFU headline (VERDICT r3 item 2): time
     # PIPELINED prefill chunks exactly like the decode bench pipelines

@@ -120,6 +120,22 @@ def _default_attention_fn(mesh: Mesh):
     return partial(paged_attention, interpret=interpret)
 
 
+def _default_spec_attention_fn(mesh: Mesh):
+    """History-attention kernel for speculative batched verification
+    (forward_spec): the whole-pool chunked-DMA kernel with the chunk dim
+    folded into the GQA group dim, so one dispatch streams each owned
+    page once for all k+1 candidate positions. Single-device meshes run
+    the Pallas kernel; multi-device meshes keep the XLA reference path
+    (pjit manages its sharding — speculation still works, the history
+    gather is just not kernel-accelerated there yet)."""
+    interpret = _pallas_mode(mesh)
+    if interpret is None or mesh.devices.size > 1:
+        return None
+    from ..ops.paged_attention import paged_attention_spec_pool
+
+    return partial(paged_attention_spec_pool, interpret=interpret)
+
+
 def _default_decode_attention_fn(mesh: Mesh):
     """History-attention kernel for the DEFERRED-write decode path.
 
@@ -175,6 +191,10 @@ class ModelRunner:
         self._decode_attention_fn = (
             None if self._attention_user_supplied or model_config.is_gptoss
             else _default_decode_attention_fn(mesh))
+        self._spec_attention_fn = (
+            None if self._attention_user_supplied or model_config.is_gptoss
+            or model_config.is_mla
+            else _default_spec_attention_fn(mesh))
         axes = param_axes(model_config)
         if runner_config.weight_dtype not in ("model", "int8", "int4"):
             raise ValueError(
@@ -310,6 +330,7 @@ class ModelRunner:
         self._decode_fn_lp = None  # built on first logprobs request
         self._decode_fn_logits = None  # built on first processor request
         self._decode_multi_fns: dict[int, callable] = {}
+        self._decode_spec_fns: dict[tuple[int, bool], callable] = {}
         self._prefill_fns: dict[int, callable] = {}
         self._ring_prefill_fns: dict[int, callable] = {}
         self._embed_fns: dict[int, callable] = {}
@@ -502,6 +523,121 @@ class ModelRunner:
         if return_device:
             return toks_k
         return np.asarray(toks_k)
+
+    @property
+    def supports_spec(self) -> bool:
+        """Whether this runner can run speculative batched verification:
+        `forward_spec` covers standard-attention models only (MLA's
+        latent cache and gpt-oss's sink attention keep per-token paths).
+        A user-supplied attention_fn also disables it — sequential decode
+        then runs the injected kernel, and verification targets drawn
+        from different attention semantics would silently diverge from
+        the non-speculative stream."""
+        cfg = self.model_config
+        return (not cfg.is_mla and not cfg.is_gptoss
+                and not self._attention_user_supplied)
+
+    def _build_decode_spec(self, t: int, with_logits: bool = False):
+        """Speculative batched verification: ONE forward scores t chunk
+        positions per slot (token 0 = the last committed token, tokens
+        1..t-1 = the draftless proposals) against the paged KV, then
+        `sampler.spec_verify` draws the per-position target tokens with
+        the exact (seed, step) keys sequential decode would use and
+        accepts the longest matching draft prefix. The weight stream —
+        the memory-bound cost of a decode step — is paid once for up to
+        t committed tokens. `with_logits` additionally ships the raw
+        [B, t, V] rows to host for the logits-processor verification leg
+        (scheduler._drain_spec applies processors per position there)."""
+        cfg = self.model_config
+        with_lora = self.lora_pack is not None
+        from ..models.transformer import forward_spec
+
+        from .sampler import spec_verify
+
+        def step(params, kv, tokens, positions, block_tables, kv_lens,
+                 active, temperature, top_p, top_k, seeds, step_idx,
+                 lora=None, lora_idx=None):
+            kv, logits = forward_spec(
+                params, cfg, tokens, positions, kv, block_tables, kv_lens,
+                active, lora=lora if with_lora else None, lora_idx=lora_idx,
+                spec_attention_fn=self._spec_attention_fn,
+            )
+            targets, n_accept = spec_verify(
+                logits, tokens[:, 1:], temperature, top_p, top_k, seeds,
+                step_idx)
+            if with_logits:
+                return kv, targets, n_accept, logits.astype(jnp.float32)
+            return kv, targets, n_accept
+
+        shard = (self._kv_sharding, self._rep, self._rep)
+        if with_logits:
+            shard = shard + (self._rep,)
+        return jax.jit(step, donate_argnums=(1,), out_shardings=shard)
+
+    def decode_spec(
+        self,
+        tokens: np.ndarray,  # [B] last committed token per slot
+        drafts: np.ndarray,  # [B, K] proposed continuations (0-padded)
+        positions: np.ndarray,  # [B] position of the committed token
+        block_tables: np.ndarray,
+        kv_lens: np.ndarray,  # [B] committed length INCLUDING the token
+        active: np.ndarray,
+        temperature: np.ndarray,
+        top_p: np.ndarray,
+        top_k: np.ndarray,
+        seeds: np.ndarray,
+        steps: Optional[np.ndarray] = None,
+        lora_idx: Optional[np.ndarray] = None,
+        want_logits: bool = False,
+        return_device: bool = False,
+    ):
+        """One speculative verification step. Returns (targets [B, K+1],
+        n_accept [B]); callers commit targets[b, : n_accept[b] + 1] —
+        bit-identical to what K+1 sequential decode steps would emit for
+        the accepted prefix. With `want_logits`, raw logits rows land in
+        `last_spec_logits` [B, K+1, V] for host-side processor slots.
+        `return_device=True` skips the readbacks (the scheduler drains
+        them after overlapping prefill/admission work)."""
+        b, k = drafts.shape
+        t = k + 1
+        self.decode_steps += 1
+        fn = self._decode_spec_fns.get((t, want_logits))
+        if fn is None:
+            fn = self._build_decode_spec(t, want_logits)
+            self._decode_spec_fns[(t, want_logits)] = fn
+        if steps is None:
+            steps = np.zeros(b, np.int32)
+        chunk = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None],
+             np.asarray(drafts, np.int32)], axis=1)
+        pos2 = (np.asarray(positions, np.int32)[:, None]
+                + np.arange(t, dtype=np.int32)[None, :])
+        args = [
+            self.params, self.kv_cache, jnp.asarray(chunk),
+            jnp.asarray(pos2),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32), jnp.asarray(active, bool),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(seeds, jnp.uint32),
+            jnp.asarray(steps, jnp.int32),
+        ]
+        if self.lora_pack is not None:
+            if lora_idx is None:
+                lora_idx = np.zeros(b, np.int32)
+            args += [self.lora_pack, jnp.asarray(lora_idx, jnp.int32)]
+        if want_logits:
+            self.kv_cache, targets, n_accept, logits = fn(*args)
+            if return_device:
+                self.last_spec_logits = logits
+                return targets, n_accept
+            self.last_spec_logits = np.asarray(logits)
+        else:
+            self.kv_cache, targets, n_accept = fn(*args)
+            self.last_spec_logits = None
+            if return_device:
+                return targets, n_accept
+        return np.asarray(targets), np.asarray(n_accept)
 
     def _build_prefill(self, bucket: int):
         cfg = self.model_config
@@ -879,6 +1015,9 @@ class ModelRunner:
             # single-device only): re-derive it for the new device count.
             self._attention_fn = _default_attention_fn(mesh)
             self._decode_attention_fn = _default_decode_attention_fn(mesh)
+            if not (self.model_config.is_gptoss
+                    or self.model_config.is_mla):
+                self._spec_attention_fn = _default_spec_attention_fn(mesh)
         axes = param_axes(self.model_config)
         if self._weight_quantized:
             from ..models.quantize import check_quantizable
@@ -921,6 +1060,7 @@ class ModelRunner:
         self._decode_fn = self._build_decode(False)
         self._decode_fn_lp = None
         self._decode_multi_fns = {}
+        self._decode_spec_fns = {}
         self._prefill_fns = {}
         self._ring_prefill_fns = {}
         self._embed_fns = {}

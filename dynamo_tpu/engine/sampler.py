@@ -116,6 +116,46 @@ def sample_with_logprobs(
     return tokens, token_lp, top_ids.astype(jnp.int32), top_lps
 
 
+def spec_verify(
+    logits: jax.Array,  # [B, T, V] f32 — rows for T chunk positions
+    drafts: jax.Array,  # [B, T-1] i32 — proposed continuation tokens
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    seeds: jax.Array,
+    step: jax.Array,  # [B] i32 per-slot generated-token index of row 0
+) -> tuple[jax.Array, jax.Array]:
+    """Verify draftless speculative proposals against the target
+    distribution (Leviathan et al., 2023, specialized to a deterministic
+    proposer — a point-mass draft distribution).
+
+    For each position i, draw the token the NON-speculative sampler
+    would emit there — `sample()` with the identical (seed, step+i) key,
+    so the draw is bit-identical to sequential decode. Accept the draft
+    iff it equals that target; the first mismatch position emits the
+    target itself (which for a point-mass q is exactly the residual
+    distribution norm(max(0, p - q))), and a fully-accepted draft emits
+    the bonus target of row T-1. Because every accepted prefix equals
+    the sequential sample stream, the committed tokens are not merely
+    distribution-preserving — they are the SAME stream the per-token
+    path produces for a fixed seed, greedy and temperature alike.
+
+    Returns (targets [B, T], n_accept [B]); callers commit
+    targets[:, : n_accept + 1].
+    """
+    t = logits.shape[1]
+    step = jnp.broadcast_to(jnp.asarray(step, jnp.int32), seeds.shape)
+    targets = jnp.stack(
+        [sample(logits[:, i, :], temperature, top_p, top_k, seeds,
+                step + i)
+         for i in range(t)], axis=1)  # [B, T]
+    match = (targets[:, :-1] == drafts).astype(jnp.int32)
+    # Leading-match count: cumprod zeroes everything after the first
+    # mismatch.
+    n_accept = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    return targets, n_accept
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V]
     output_counts: jax.Array,  # [B, V] int32 — counts of generated tokens

@@ -40,9 +40,10 @@ import numpy as np
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
-from ..tokens import compute_block_hashes
+from ..tokens import TokenBlockSequence, compute_block_hashes
 from .model_runner import ModelRunner, bucket_table_width
 from .pages import PageAllocation, PagePool
+from .spec import BlockLookahead, NGramProposer, SlotSpec, propose_for
 
 log = get_logger("engine.scheduler")
 
@@ -101,6 +102,10 @@ class _Seq:
     # prefill_start stamped (keeps the hot chunk loop from taking the
     # recorder lock once per iteration per prefilling sequence)
     prefill_stamped: bool = False
+    # Speculative decoding state (engine/spec.py): proposer index over
+    # this sequence's history + acceptance EMA. None when speculation is
+    # off or the sequence can't speculate.
+    spec: Optional[SlotSpec] = None
 
     @property
     def decode_ready(self) -> bool:
@@ -125,6 +130,15 @@ class SchedulerStats:
     # sequences admitted while a decode block was in flight on device.
     fused_steps_with_prefill: int = 0
     admitted_during_inflight: int = 0
+    # Speculative decoding (dynamo_spec_* metrics; docs/metrics.md):
+    # proposed/accepted count MINED drafts only (static-shape padding is
+    # excluded), spec_ema is the mean acceptance EMA over the slots that
+    # proposed in the latest speculative step.
+    spec_steps: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_last_k: int = 0
+    spec_ema: float = 0.0
 
 
 class InferenceScheduler:
@@ -146,6 +160,22 @@ class InferenceScheduler:
         # stream in blocks of K.
         self.decode_block = max(1, int(env("DYNT_DECODE_BLOCK") or 1))
         self.decode_pipeline = max(1, int(env("DYNT_DECODE_PIPELINE") or 1))
+        # Speculative decoding (DYNT_SPEC_*; docs/speculative-decoding.md):
+        # draftless n-gram proposals verified in one batched forward.
+        # Gated off for runners without the multi-token verification
+        # forward (MLA/gpt-oss) and for mirrored multihost drivers (the
+        # spec step is not on the mirrored-launch protocol).
+        self.spec_enabled = (
+            bool(env("DYNT_SPEC_ENABLE"))
+            and getattr(runner, "supports_spec", False)
+            and not getattr(runner, "is_mirrored", False))
+        self.spec_k = max(1, int(env("DYNT_SPEC_MAX_K")))
+        self.spec_min_ema = float(env("DYNT_SPEC_MIN_EMA"))
+        self.spec_cutoff = max(0, int(env("DYNT_SPEC_BATCH_CUTOFF")))
+        # Cross-request continuation store keyed by the same chained
+        # block hashes the prefix cache registers (engine/spec.py).
+        self.spec_lookahead = (BlockLookahead(cfg.page_size)
+                               if self.spec_enabled else None)
 
         def _stored(hashes: list[int], parent: Optional[int]) -> None:
             # Fan out G1 registrations to the router event buffer AND the
@@ -322,12 +352,17 @@ class InferenceScheduler:
         decode writes up to block*depth - 1 tokens past a sequence's stop
         position before the host observes the stop, so those positions
         must land in pages this sequence owns (never a neighbour's); the
-        surplus tokens are discarded at drain. Capacity CHECKS use the
-        slack-free span (slack must never reject a request that fits) —
-        a sequence whose slacked span exceeds capacity is admitted
-        without slack and gated per-seq in _decode_block_for."""
+        surplus tokens are discarded at drain. Speculative verification
+        overruns the same way (up to spec_k rejected-draft KV writes past
+        the committed stop), so its chunk rides the same slack. Capacity
+        CHECKS use the slack-free span (slack must never reject a request
+        that fits) — a sequence whose slacked span exceeds capacity is
+        admitted without slack and gated per-seq in _decode_block_for /
+        _maybe_dispatch_spec."""
         slack = (self.decode_block * max(1, self.decode_pipeline)
                  if with_slack and self.decode_block > 1 else 0)
+        if with_slack and self.spec_enabled:
+            slack = max(slack, self.spec_k + 1)
         return -(-(prompt_len + max_tokens + slack) // self.page_size)
 
     def _prepare(self, request: PreprocessedRequest, emit) -> Optional[_Seq]:
@@ -356,7 +391,7 @@ class InferenceScheduler:
             emit(EngineOutput(finish_reason="error",
                               error=f"logits processors: {exc}"))
             return None
-        return _Seq(
+        seq = _Seq(
             request=request, emit=emit, block_hashes=block_hashes,
             alloc=PageAllocation([], [], 0),
             block_table=np.zeros(self.runner.config.max_pages_per_seq,
@@ -364,6 +399,17 @@ class InferenceScheduler:
             slot=-1, prompt_len=prompt_len, prefill_pos=0, seed=seed,
             processors=processors,
         )
+        if self.spec_enabled:
+            stop_ids = set(request.stop.stop_token_ids)
+            if not request.stop.ignore_eos:
+                stop_ids |= set(request.eos_token_ids)
+            hasher = TokenBlockSequence(self.page_size,
+                                        lora_id=request.kv_salt())
+            hasher.extend(request.token_ids)
+            seq.spec = SlotSpec(
+                proposer=NGramProposer(request.token_ids),
+                stop_ids=frozenset(stop_ids), hasher=hasher)
+        return seq
 
     def _build_processors(self, request: PreprocessedRequest):
         """Instantiate the request's logits processors (explicit specs +
@@ -789,6 +835,9 @@ class InferenceScheduler:
             self._lora_idx[i] = seq.lora_idx
         want_logprobs = any(s.request.sampling.logprobs for s in ready)
         want_logits = any(s.processors for s in ready)
+        spec = self._maybe_dispatch_spec(ready, want_logprobs, want_logits)
+        if spec is not None:
+            return spec
         prefill_pending = any(
             s is not None and not s.decode_ready and not s.cancelled
             for s in self._slots)
@@ -840,6 +889,8 @@ class InferenceScheduler:
             return 0
         if pending[0] == "count":
             return pending[1]
+        if pending[0] == "spec":
+            return self._drain_spec(pending)
         _kind, device_blocks, ready, block = pending
         # Materialize EVERY block before emitting any token: a sequence
         # finishing in block d would otherwise deliver its finish_reason
@@ -857,6 +908,190 @@ class InferenceScheduler:
                     self._append_token(seq, int(toks_k[step][seq.slot]))
                     count += 1
         return count
+
+    # -- speculative decoding (engine/spec.py; docs/speculative-decoding.md)
+
+    def _maybe_dispatch_spec(self, ready: list, want_logprobs: bool,
+                             want_logits: bool):
+        """Try a speculative verification step instead of the fused /
+        per-token decode. Returns a ("spec", ...) handle (drained by
+        `_drain_decode`) or None to fall through.
+
+        Policy: speculation trades FLOPs for latency — it wins when the
+        MXU has headroom (small batch) and the text is predictable
+        (acceptance EMA). Gated off batch-wide for logprobs requests
+        (per-token logprob data needs per-step readbacks), per-iteration
+        above the batch-pressure cutoff, and per-slot by the acceptance
+        EMA with periodic probing. Logits-processor slots ride along via
+        the raw-rows readback and are verified on host with their
+        processors applied per position (`_commit_spec_host`), so the
+        verification path applies them identically to the single-token
+        path."""
+        if not self.spec_enabled:
+            return None
+        # Every fall-through below means "no speculation this iteration":
+        # zero the per-step k gauge up front so dynamo_spec_k never
+        # reports a stale value through a non-speculating phase; the
+        # drain of a dispatched step writes the real mined k.
+        self.stats.spec_last_k = 0
+        if want_logprobs:
+            return None
+        if any(s.first_deferred for s in ready):
+            # First-token-deferred processor sequences re-derive their
+            # first token through _decode_single; they speculate from
+            # the next iteration.
+            return None
+        if self.spec_cutoff and len(ready) > self.spec_cutoff:
+            return None
+        need = self.spec_k + 1
+        if not all(s.slack_ok
+                   or (s.request.sampling.max_tokens - len(s.generated)
+                       >= need)
+                   for s in ready):
+            return None
+        drafts = np.zeros((self.max_batch, self.spec_k), np.int32)
+        mined = 0
+        expected = 0.0  # Σ ema·draft_len — expected accepted this step
+        for seq in ready:
+            sp = seq.spec
+            if sp is None:
+                continue
+            sp.pending = 0
+            remaining = (seq.request.sampling.max_tokens
+                         - len(seq.generated))
+            if (self.spec_min_ema > 0 and sp.ema < self.spec_min_ema
+                    and not sp.wants_probe()):
+                continue
+            prop = propose_for(sp, self.spec_lookahead, self.spec_k,
+                               remaining)
+            if prop:
+                sp.pending = len(prop)
+                drafts[seq.slot, :len(prop)] = prop
+                mined += len(prop)
+                expected += sp.ema * len(prop)
+        # A spec step is ONE dispatch emitting 1 + accepted tokens per
+        # slot; the fused block it displaces is one dispatch emitting
+        # `block` tokens per slot. Against the fused path the gain must
+        # clear the dispatch amortization it forfeits for NON-proposing
+        # slots, so require the expected accepted total to cover half a
+        # token per ready slot (vs per-token alternatives — processor
+        # batches, block=1 — any expected acceptance already wins).
+        per_token_alt = self.decode_block <= 1 or want_logits
+        threshold = 0.0 if per_token_alt else 0.5 * len(ready)
+        if mined == 0 or expected < threshold:
+            return None
+        max_kv = max(s.kv_len for s in ready) + need
+        width = bucket_table_width(-(-max_kv // self.page_size),
+                                   self.runner.config.max_pages_per_seq)
+        targets, n_acc = self.runner.decode_spec(
+            self._tokens, drafts, self._positions, self._tables[:, :width],
+            self._kv_lens, self._active, self._temp, self._top_p,
+            self._top_k, self._seeds, self._steps,
+            lora_idx=self._lora_idx, want_logits=want_logits,
+            return_device=True,
+        )
+        return ("spec", targets, n_acc, ready, drafts, want_logits)
+
+    def _drain_spec(self, pending) -> int:
+        """Materialize a speculative step and commit per-slot token
+        prefixes. Committed tokens are the per-position TARGET samples —
+        bit-identical to sequential decode — so stop conditions, stream
+        emission, and page release all flow through `_append_token`
+        unchanged; surplus rejected-draft KV sits in the sequence's own
+        slack pages and is rewritten by the next step."""
+        _kind, targets_dev, n_acc_dev, ready, drafts, with_logits = pending
+        targets = np.asarray(targets_dev)  # dynalint: disable=DL201 -- the drain point: spec commits need the verdict on host
+        n_acc = np.asarray(n_acc_dev)  # dynalint: disable=DL201 -- same drain point
+        logits = None
+        if with_logits:
+            logits = self.runner.last_spec_logits
+            if logits is not None and not isinstance(logits, np.ndarray):
+                logits = np.asarray(logits)  # dynalint: disable=DL201 -- same drain point
+        count = 0
+        emas = []
+        self.stats.spec_steps += 1
+        # Per-step k = the longest draft actually mined this step (the
+        # static spec_k shape may be mostly padding).
+        self.stats.spec_last_k = max(
+            (s.spec.pending for s in ready if s.spec is not None),
+            default=0)
+        for seq in ready:
+            i = seq.slot
+            if seq.finished or seq.cancelled:
+                continue
+            if seq.processors:
+                count += self._commit_spec_host(seq, drafts[i], logits[i])
+            else:
+                n = int(n_acc[i])
+                toks = [int(t) for t in targets[i, : n + 1]]
+                count += self._commit_spec(seq, toks)
+            if seq.spec is not None and seq.spec.pending:
+                emas.append(seq.spec.ema)
+        if emas:
+            self.stats.spec_ema = float(np.mean(emas))
+        return count
+
+    def _commit_spec(self, seq: _Seq, tokens: list) -> int:
+        """Commit verified tokens through the normal append path; update
+        the slot's acceptance accounting against its MINED draft length
+        (accidental matches on static-shape padding are committed — they
+        are correct target samples — but never counted as acceptance)."""
+        sp = seq.spec
+        emitted = 0
+        for tok in tokens:
+            if seq.finished or seq.cancelled:
+                break
+            self._append_token(seq, int(tok))
+            emitted += 1
+        if sp is not None and sp.pending:
+            accepted = min(max(emitted - 1, 0), sp.pending)
+            sp.observe(sp.pending, accepted)
+            self.stats.spec_proposed += sp.pending
+            self.stats.spec_accepted += accepted
+        return emitted
+
+    def _commit_spec_host(self, seq: _Seq, draft_row: np.ndarray,
+                          logits_rows: np.ndarray) -> int:
+        """Host verification leg for logits-processor sequences: apply
+        the slot's processors to each raw row exactly as the single-token
+        path does (same input_ids prefix, same host_sample (seed, step)
+        key), accept the draft only when it equals the processed sample.
+        One processor call per committed token — identical call counts
+        and mutation order to sequential decode, so stateful processors
+        (guided-decoding DFAs, forced responses) stay in sync."""
+        sp = seq.spec
+        input_ids = list(seq.generated)
+        k = len(draft_row)
+        emitted = 0
+        accepted = 0
+        for i in range(k + 1):
+            try:
+                token = self._host_process_sample(seq, logits_rows[i],
+                                                  input_ids)
+            except Exception as exc:  # noqa: BLE001 — same contract as
+                # the sequential host path in _decode_single
+                self._fail_processor_seq(seq, exc)
+                break
+            self._append_token(seq, token)
+            emitted += 1
+            if seq.finished or seq.cancelled:
+                break
+            if not seq.processors:
+                # Processors retired mid-chunk (min_tokens satisfied):
+                # sequential decode would continue on the DEVICE sampler,
+                # whose draws differ from host_sample — stop here so the
+                # next iteration takes the device path like sequential.
+                break
+            input_ids.append(token)
+            if i < k and int(draft_row[i]) == token:
+                accepted += 1
+                continue
+            break
+        if sp is not None and sp.pending:
+            sp.observe(sp.pending, min(accepted, sp.pending))
+            self.stats.spec_proposed += sp.pending
+            self.stats.spec_accepted += min(accepted, sp.pending)
+        return emitted
 
     def _decode_single(self, ready, tables, want_logprobs,
                        want_logits) -> int:
@@ -881,16 +1116,9 @@ class InferenceScheduler:
                 try:
                     token, info = self._host_sample_slot(
                         seq, logits_rows[i], token)
-                except Exception as exc:  # noqa: BLE001 — a misbehaving
-                    # user processor (bad token id, all-banned vocab)
-                    # must error ITS request, not kill the scheduler
-                    # thread and hang the whole engine.
-                    log.warning("logits processor failed for %s: %r",
-                                seq.request.request_id, exc)
-                    seq.finished = True
-                    seq.emit(EngineOutput(
-                        finish_reason="error",
-                        error=f"logits processor failed: {exc}"))
+                except Exception as exc:  # noqa: BLE001 — same contract
+                    # as the speculative host leg (_fail_processor_seq)
+                    self._fail_processor_seq(seq, exc)
                     continue
             first = seq.first_deferred and not seq.generated
             seq.first_deferred = False
@@ -900,6 +1128,34 @@ class InferenceScheduler:
             count += 1
         return count
 
+    def _host_process_sample(self, seq: _Seq, raw_row: np.ndarray,
+                             input_ids: list) -> int:
+        """The host sampling leg shared by the sequential processor path
+        (_host_sample_slot) and the speculative verification leg
+        (_commit_spec_host): apply the sequence's processors to a copy of
+        the raw logits row, then host_sample keyed by (seed,
+        len(input_ids)) — ONE definition so the two paths can never
+        desynchronize on processor order or sampling keys."""
+        from ..llm.logits_processing import host_sample
+
+        s = seq.request.sampling
+        row = raw_row.astype(np.float32).copy()
+        for proc in seq.processors:
+            proc(input_ids, row)
+        return host_sample(row, s.temperature, s.top_p, s.top_k,
+                           seq.seed, len(input_ids))
+
+    def _fail_processor_seq(self, seq: _Seq, exc: Exception) -> None:
+        """A misbehaving user processor (bad token id, all-banned vocab)
+        must error ITS request, not kill the scheduler thread and hang
+        the whole engine."""
+        log.warning("logits processor failed for %s: %r",
+                    seq.request.request_id, exc)
+        seq.finished = True
+        seq.emit(EngineOutput(
+            finish_reason="error",
+            error=f"logits processor failed: {exc}"))
+
     def _host_sample_slot(self, seq: _Seq, raw_row: np.ndarray,
                           device_token: int):
         """Host leg of the logits-processor path: apply the sequence's
@@ -907,17 +1163,11 @@ class InferenceScheduler:
         processors keep the device-sampled token. Logprob data (when the
         request asks) is computed from the RAW distribution (OpenAI
         semantics — logprobs reflect the model, not the processors)."""
-        from ..llm.logits_processing import host_sample
-
         s = seq.request.sampling
         token = device_token
         if seq.processors:
-            row = raw_row.astype(np.float32).copy()
-            input_ids = list(seq.generated)
-            for proc in seq.processors:
-                proc(input_ids, row)
-            token = host_sample(row, s.temperature, s.top_p, s.top_k,
-                                seq.seed, len(seq.generated))
+            token = self._host_process_sample(seq, raw_row,
+                                              list(seq.generated))
         info = None
         if s.logprobs:
             from .sampler import TOP_LOGPROBS_K
@@ -976,6 +1226,11 @@ class InferenceScheduler:
         if len(seq.generated) == 1 and seq.record_id is not None:
             get_recorder().stamp(seq.record_id, "first_token")
         seq.last_token = token
+        if seq.spec is not None:
+            # Keep the n-gram index + block-hash chain current on EVERY
+            # commit path (speculative, fused, per-token, prefill first
+            # token) — sequences alternate between them freely.
+            seq.spec.extend([token])
         request = seq.request
         finish = None
         if not request.stop.ignore_eos and token in request.eos_token_ids:
@@ -1049,6 +1304,20 @@ class InferenceScheduler:
                     computed = seq.prefill_pos // self.page_size
                     self.pool.release(seq.alloc, seq.block_hashes,
                                       computed_blocks=computed)
+                if seq.spec is not None:
+                    if seq.spec.proposed and seq.record_id is not None:
+                        # Where this request's speculated tokens were won
+                        # or wasted (docs/observability.md `spec` event).
+                        get_recorder().event(
+                            seq.record_id, "spec",
+                            proposed=seq.spec.proposed,
+                            accepted=seq.spec.accepted)
+                    if not seq.cancelled and self.spec_lookahead is not None:
+                        # Teach the cross-request lookahead this
+                        # sequence's block-hash -> continuation chain.
+                        self.spec_lookahead.record(
+                            seq.spec.hasher.block_hashes,
+                            seq.spec.proposer.tokens)
                 self._slots[i] = None
 
 

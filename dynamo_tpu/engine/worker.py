@@ -842,8 +842,34 @@ class TpuWorker:
             log.exception("onboard H2D staging failed; using host bundle")
             return blocks
 
+    def _publish_spec_metrics(self) -> None:
+        """Mirror the scheduler's speculative-decoding totals onto the
+        dynamo_spec_* families (docs/metrics.md): counters advance by the
+        delta since the last publish; gauges snapshot the EMA and the
+        current per-step k."""
+        from ..runtime.metrics import (
+            SPEC_ACCEPTANCE,
+            SPEC_ACCEPTED,
+            SPEC_K,
+            SPEC_PROPOSED,
+        )
+
+        stats = self.scheduler.stats
+        worker = f"{self.instance_id:x}"
+        prev_p, prev_a = self._spec_published
+        if stats.spec_proposed > prev_p:
+            SPEC_PROPOSED.labels(worker=worker).inc(
+                stats.spec_proposed - prev_p)
+        if stats.spec_accepted > prev_a:
+            SPEC_ACCEPTED.labels(worker=worker).inc(
+                stats.spec_accepted - prev_a)
+        self._spec_published = (stats.spec_proposed, stats.spec_accepted)
+        SPEC_ACCEPTANCE.labels(worker=worker).set(stats.spec_ema)
+        SPEC_K.labels(worker=worker).set(stats.spec_last_k)
+
     async def _event_drain(self, publisher, interval: float = 0.05) -> None:
         self._drain_ticks = 0
+        self._spec_published = (0, 0)
         while True:
             await asyncio.sleep(interval)
             for event in self.events.drain():
@@ -874,6 +900,8 @@ class TpuWorker:
                 )
                 KV_USAGE.labels(worker=f"{self.instance_id:x}").set(
                     metrics.kv_usage)
+                if self.scheduler.spec_enabled:
+                    self._publish_spec_metrics()
                 try:
                     await publisher.publish(LOAD_TOPIC, metrics.to_wire())
                 except Exception:  # noqa: BLE001

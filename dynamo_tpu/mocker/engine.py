@@ -17,6 +17,8 @@ import time
 from collections import OrderedDict
 from typing import AsyncIterator, Optional
 
+import numpy as np
+
 from ..kv_router.protocols import (
     KV_EVENT_TOPIC,
     LOAD_TOPIC,
@@ -52,6 +54,18 @@ class MockerConfig:
     watermark: float = 0.01  # keep this fraction of blocks free
     vocab_size: int = 512
     dp_rank: int = 0
+    # Speculative-worker profile (acceptance-rate-parameterized
+    # multi-token steps, mirroring the real engine's draftless
+    # speculation — docs/speculative-decoding.md): each decode step per
+    # sequence emits 1 + accepted tokens, where each of spec_k draft
+    # positions accepts independently with p=spec_acceptance until the
+    # first rejection (the verified-prefix rule). The verification
+    # forward scores k+1 positions, so the per-seq step cost scales by
+    # (1 + spec_k * spec_verify_overhead) — FLOPs-for-latency, nearly
+    # free on a memory-bound step. spec_k = 0 disables.
+    spec_k: int = 0
+    spec_acceptance: float = 0.0
+    spec_verify_overhead: float = 0.15
 
     @classmethod
     def from_timing_preset(cls, name: str, **overrides) -> "MockerConfig":
@@ -79,6 +93,21 @@ TIMING_PRESETS: dict[str, dict] = {
         # 1024 on the v5e chip -> 113 us/token.
         prefill_us_per_token=113.0,
         block_size=16,
+    ),
+    # Speculative-worker profile (ROADMAP item 1: router/planner layers
+    # must see speculation in chip-free scenario tests): the same
+    # measured v5e step physics with draftless speculation at k=4. The
+    # 0.7 acceptance default models repetitive/agentic traffic (the
+    # workloads prompt-lookup targets); override spec_acceptance per
+    # scenario for low-repetition sweeps.
+    "tpu-v5e-qwen3-0.6b-spec": dict(
+        decode_base_ms=1.608,
+        decode_us_per_seq=112.4,
+        decode_us_per_kv_block=4.84,
+        prefill_us_per_token=113.0,
+        block_size=16,
+        spec_k=4,
+        spec_acceptance=0.7,
     ),
 }
 
@@ -242,6 +271,12 @@ class MockerEngine:
         self._closed = False
         self.steps = 0
         self._pending_stored: list[tuple[list[int], Optional[int]]] = []
+        # Speculative-worker profile accounting (spec_k > 0): mirrors the
+        # real engine's dynamo_spec_* proposed/accepted counters so
+        # scenario tests can assert acceptance stats chip-free.
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._spec_rng = np.random.default_rng(0x5BEC ^ worker_id)
 
     # -- events ------------------------------------------------------------
 
@@ -362,14 +397,14 @@ class MockerEngine:
             evicted_total: list[int] = []
             self._admit(evicted_total.extend)
             prefill_tokens = self._prefill_step()
-            decoded, deliveries = self._decode_step()
+            decoded, decode_seqs, deliveries = self._decode_step()
             try:
                 if evicted_total:
                     await self._publish_removed(evicted_total)
                 await self._flush_stored()
                 self.steps += 1
                 elapsed = time.monotonic() - step_start
-                target = self._step_time(prefill_tokens, decoded,
+                target = self._step_time(prefill_tokens, decode_seqs,
                                          self._active_kv_blocks())
                 delay = max(0.0, target - elapsed)
                 if delay:
@@ -390,14 +425,21 @@ class MockerEngine:
                 for queue, item in deliveries:
                     queue.put_nowait(item)
 
-    def _step_time(self, prefill_tokens: int, decoded: int,
+    def _step_time(self, prefill_tokens: int, decode_seqs: int,
                    kv_blocks: int = 0) -> float:
         cfg = self.config
         t = 0.0
         if prefill_tokens:
             t += prefill_tokens * cfg.prefill_us_per_token / 1e6
-        if decoded:
-            t += (cfg.decode_base_ms / 1e3) + decoded * cfg.decode_us_per_seq / 1e6
+        if decode_seqs:
+            # Speculative verification scores spec_k extra positions per
+            # sequence inside the same weight stream: the per-seq compute
+            # term scales by the overhead factor, the (dominant) base +
+            # KV-streaming terms do not — which is exactly why accepted
+            # tokens come out cheaper than full steps.
+            per_seq = cfg.decode_us_per_seq * (
+                1.0 + cfg.spec_k * cfg.spec_verify_overhead)
+            t += (cfg.decode_base_ms / 1e3) + decode_seqs * per_seq / 1e6
             t += kv_blocks * cfg.decode_us_per_kv_block / 1e6
         return t / max(1e-6, cfg.speedup_ratio)
 
@@ -477,8 +519,26 @@ class MockerEngine:
             total += chunk
         return total
 
-    def _decode_step(self) -> tuple[int, list]:
-        """Generate one token for each fully-prefilled sequence.
+    def _spec_tokens_this_step(self, remaining: int) -> int:
+        """Tokens a speculative step emits for one sequence: 1 (the
+        always-emitted target) + leading draft acceptances, each draft
+        position accepting independently with p=spec_acceptance until
+        the first rejection. Bounded by the sequence's token budget."""
+        cfg = self.config
+        k = min(cfg.spec_k, max(0, remaining - 1))
+        accepted = 0
+        for _ in range(k):
+            if self._spec_rng.random() >= cfg.spec_acceptance:
+                break
+            accepted += 1
+        self.spec_proposed += k
+        self.spec_accepted += accepted
+        return 1 + accepted
+
+    def _decode_step(self) -> tuple[int, int, list]:
+        """Generate tokens for each fully-prefilled sequence — one per
+        step, or 1 + accepted under a speculative-worker profile
+        (spec_k > 0). Returns (tokens, decoding_seqs, deliveries).
 
         Outputs are COLLECTED, not delivered: a step's tokens exist only
         once the step's modeled compute time has elapsed, so the step
@@ -488,6 +548,7 @@ class MockerEngine:
         step end)."""
         deliveries: list[tuple[asyncio.Queue, object]] = []
         decoded = 0
+        decode_seqs = 0
         finished: list[_Sequence] = []
         for seq in self._running:
             if seq.cancelled:
@@ -513,21 +574,30 @@ class MockerEngine:
                 deliveries.append((seq.queue, None))
                 finished.append(seq)
                 continue
-            # Deterministic pseudo-output: echo the prompt, or cycle
-            # through printable ASCII.
-            if self.config.echo and seq.generated < len(req.token_ids):
-                token = int(req.token_ids[seq.generated])
-            else:
-                token = 97 + ((len(req.token_ids) + seq.generated) % 26)
-            seq.generated += 1
-            decoded += 1
+            decode_seqs += 1
+            n_tokens = 1
+            if self.config.spec_k > 0:
+                n_tokens = self._spec_tokens_this_step(
+                    req.sampling.max_tokens - seq.generated)
+            tokens: list[int] = []
+            for _ in range(n_tokens):
+                # Deterministic pseudo-output: echo the prompt, or cycle
+                # through printable ASCII.
+                if self.config.echo and seq.generated < len(req.token_ids):
+                    tokens.append(int(req.token_ids[seq.generated]))
+                else:
+                    tokens.append(
+                        97 + ((len(req.token_ids) + seq.generated) % 26))
+                seq.generated += 1
+            decoded += len(tokens)
             finish = None
             if seq.generated >= req.sampling.max_tokens:
                 finish = "length"
             output = EngineOutput(
-                token_ids=[token],
+                token_ids=tokens,
                 finish_reason=finish,
-                prompt_tokens=len(req.token_ids) if seq.generated == 1 else None,
+                prompt_tokens=(len(req.token_ids)
+                               if seq.generated == len(tokens) else None),
             )
             deliveries.append((seq.queue, output.to_wire()))
             if finish is not None:
@@ -537,7 +607,7 @@ class MockerEngine:
         for seq in finished:
             self._running.remove(seq)
             self._release(seq)
-        return decoded, deliveries
+        return decoded, decode_seqs, deliveries
 
     def _release(self, seq: _Sequence) -> None:
         """On completion: completed full blocks become reusable cache entries;

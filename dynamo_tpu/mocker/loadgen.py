@@ -166,6 +166,8 @@ class ReplayReport:
     errors: int = 0
     wall_s: float = 0.0
     output_tokens: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     stats: list[RequestStats] = dataclasses.field(default_factory=list)
 
     def _pct(self, values: list[float], p: float) -> float:
@@ -175,7 +177,7 @@ class ReplayReport:
         ttfts = [s.ttft_ms for s in self.stats if s.error is None]
         itls = [s.itl_ms for s in self.stats
                 if s.error is None and s.output_tokens > 1]
-        return {
+        out = {
             "mode": self.mode,
             "requests": self.requests,
             "errors": self.errors,
@@ -188,6 +190,16 @@ class ReplayReport:
             "itl_ms": {"p50": round(self._pct(itls, 50), 2),
                        "p99": round(self._pct(itls, 99), 2)},
         }
+        if self.spec_proposed:
+            # Speculative-worker profile stats (docs/metrics.md
+            # dynamo_spec_* analog for offline replay).
+            out["spec"] = {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4),
+            }
+        return out
 
 
 class _CapturePublisher:
@@ -347,6 +359,8 @@ class OfflineReplay:
             # Cancellation mid-replay must not leak engine stepper tasks.
             report.wall_s = time.monotonic() - t0
             for engine in self.engines + self.prefill_engines:
+                report.spec_proposed += engine.spec_proposed
+                report.spec_accepted += engine.spec_accepted
                 await engine.close()
         return report
 
@@ -378,6 +392,19 @@ async def main(argv: Optional[list[str]] = None) -> None:
     rep.add_argument("--speedup", type=float, default=100.0)
     rep.add_argument("--num-blocks", type=int, default=4096)
     rep.add_argument("--block-size", type=int, default=16)
+    rep.add_argument("--timing-preset", default=None,
+                     help="seed MockerConfig from a TIMING_PRESETS entry "
+                          "(e.g. tpu-v5e-qwen3-0.6b-spec); CLI flags "
+                          "override preset fields")
+    rep.add_argument("--spec-k", type=int, default=0,
+                     help="speculative-worker profile: draft tokens per "
+                          "decode step (0 = off; defaults acceptance to "
+                          "0.7 unless --spec-acceptance or a preset "
+                          "sets it)")
+    rep.add_argument("--spec-acceptance", type=float, default=None,
+                     help="per-draft-position acceptance probability for "
+                          "the speculative-worker profile (overrides the "
+                          "preset's value)")
 
     args = parser.parse_args(argv)
     if args.cmd == "synthesize":
@@ -391,13 +418,30 @@ async def main(argv: Optional[list[str]] = None) -> None:
         print(json.dumps({"written": len(records), "path": args.out}))
         return
     records = load_trace(args.trace)
+    overrides = dict(speedup_ratio=args.speedup,
+                     num_blocks=args.num_blocks,
+                     block_size=args.block_size)
+    if args.spec_k:
+        overrides["spec_k"] = args.spec_k
+    if args.spec_acceptance is not None:
+        # Independent of --spec-k so a preset's k can be kept while
+        # sweeping acceptance (the low-repetition sweep).
+        overrides["spec_acceptance"] = args.spec_acceptance
+    if args.timing_preset:
+        config = MockerConfig.from_timing_preset(args.timing_preset,
+                                                 **overrides)
+    else:
+        config = MockerConfig(**overrides)
+    if config.spec_k and config.spec_acceptance <= 0:
+        # --spec-k with no acceptance from flag or preset would propose
+        # every step and never accept (pure overhead); default to the
+        # spec preset's operating point as the help text promises.
+        config = dataclasses.replace(config, spec_acceptance=0.7)
     replayer = OfflineReplay(
         mode=args.mode, num_workers=args.workers,
         num_prefill_workers=args.prefill_workers,
         router_policy=args.router_policy,
-        config=MockerConfig(speedup_ratio=args.speedup,
-                            num_blocks=args.num_blocks,
-                            block_size=args.block_size),
+        config=config,
     )
     report = await replayer.run(records)
     print(json.dumps(report.summary()))

@@ -862,6 +862,124 @@ def forward_decode(
     return kv_cache, logits
 
 
+def paged_attention_spec_xla(
+    q: jax.Array,  # [B, T, qh, hd] — T chunk queries per sequence
+    kv_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] committed length INCLUDING chunk token 0
+    k_cur: jax.Array,  # [B, T, kh, hd] chunk K (not yet cached)
+    v_cur: jax.Array,
+) -> jax.Array:
+    """Speculative-verification attention, XLA reference path: every
+    chunk query attends the cached history (positions < kv_len - 1)
+    plus the in-register chunk tokens causally (token j <= query i).
+    The T == 1 case degenerates to `paged_attention_decode_xla` — same
+    concat-then-softmax shape, so masked positions contribute exact
+    zeros and the two paths agree bitwise on the shared prefix."""
+    values, scales = _kv_parts(kv_cache)
+    b, t, qh, hd = q.shape
+    ps = values.shape[3]
+    kh = values.shape[4]
+    max_pages = block_tables.shape[1]
+    ctx = max_pages * ps
+    k = values[layer, 0][block_tables].reshape(b, ctx, kh, hd)
+    v = values[layer, 1][block_tables].reshape(b, ctx, kh, hd)
+    if scales is not None:
+        k_s = scales[layer, 0][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        v_s = scales[layer, 1][block_tables].reshape(
+            b, ctx, -1)[..., 0].astype(jnp.float32)
+        k = k.astype(jnp.float32) * k_s[..., None, None]
+        v = v.astype(jnp.float32) * v_s[..., None, None]
+    group = qh // kh
+    qg = q.reshape(b, t, kh, group, hd)
+    hist = jnp.einsum("btkgh,bskh->btkgs", qg.astype(jnp.float32),
+                      k.astype(jnp.float32)) / math.sqrt(hd)
+    # History: positions 0 .. kv_len-2; the chunk (token 0 at kv_len-1)
+    # is in registers.
+    kv_pos = jnp.arange(ctx)[None, :]
+    hist_mask = kv_pos < (kv_lens[:, None] - 1)
+    hist = jnp.where(hist_mask[:, None, None, None, :], hist, -1e30)
+    cur = jnp.einsum("btkgh,bskh->btkgs", qg.astype(jnp.float32),
+                     k_cur.astype(jnp.float32)) / math.sqrt(hd)
+    causal = (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None])  # [Tq, Tk]
+    cur = jnp.where(causal[None, :, None, None, :], cur, -1e30)
+    full = jnp.concatenate([hist, cur], axis=-1)
+    probs = jax.nn.softmax(full, axis=-1)
+    out = (
+        jnp.einsum("btkgs,bskh->btkgh", probs[..., :ctx],
+                   v.astype(jnp.float32))
+        + jnp.einsum("btkgs,bskh->btkgh", probs[..., ctx:],
+                     v_cur.astype(jnp.float32))
+    )
+    return out.reshape(b, t, qh, hd).astype(q.dtype)
+
+
+def forward_spec(
+    params: dict,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, T] chunk token 0 = last committed token
+    positions: jax.Array,  # [B, T] absolute positions
+    kv_cache: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,  # [B] committed length INCLUDING chunk token 0
+    active: jax.Array,  # [B] bool
+    lora: Optional[dict] = None,
+    lora_idx: Optional[jax.Array] = None,
+    spec_attention_fn=None,  # (q, kv, layer, tables, lens, k, v) -> attn
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative batched verification: `forward_decode` generalized to
+    T tokens per slot — one weight-streaming pass scores all T candidate
+    positions (decode is memory-bound, so the extra FLOPs are nearly
+    free). Deferred cache writes exactly like decode: chunk K/V stay in
+    registers through the layer loop and land in two batched scatters at
+    the end; rejected positions leave stale KV past the committed length
+    that the next step's chunk rewrites before it can ever be attended.
+    Standard-attention models only (MLA/gpt-oss keep per-token paths)."""
+    assert not config.is_mla
+    b, t = tokens.shape
+    attn_fn = spec_attention_fn or paged_attention_spec_xla
+    x = params["embed"][tokens]  # [B, T, H]
+    ks, vs = [], []
+    for layer_idx, lp in enumerate(params["layers"]):
+        ll = lora["layers"][layer_idx] if lora is not None else {}
+        h = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = _mm("bth,hqd->btqd", h, lp["wq"])
+        k = _mm("bth,hkd->btkd", h, lp["wk"])
+        v = _mm("bth,hkd->btkd", h, lp["wv"])
+        if "wq" in ll:
+            q = q + _lora_delta(h, ll["wq"], lora_idx).reshape(q.shape)
+            k = k + _lora_delta(h, ll["wk"], lora_idx).reshape(k.shape)
+            v = v + _lora_delta(h, ll["wv"], lora_idx).reshape(v.shape)
+        if config.qk_norm:
+            q = rms_norm(q, lp["q_norm"], config.rms_eps)
+            k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = rope(q, positions, config.rope_theta)
+        k = rope(k, positions, config.rope_theta)
+        attn = attn_fn(
+            q, kv_cache, layer_idx, block_tables, kv_lens, k, v)
+        ks.append(k)
+        vs.append(v)
+        attn_out = _mm("btqd,qdh->bth", attn, lp["wo"])
+        if "wo" in ll:
+            attn_out = attn_out + _lora_delta(
+                attn.reshape(b, t, -1), ll["wo"], lora_idx)
+        x = x + attn_out
+        h = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        if "router" in lp:
+            x = x + _moe(h, lp, config)
+        else:
+            x = x + _swiglu(h, lp, ll if "w_gate" in ll else None, lora_idx)
+    valid = jnp.broadcast_to(active[:, None], positions.shape)
+    kv_cache = write_kv_stack(kv_cache, jnp.stack(ks), jnp.stack(vs),
+                              block_tables, positions, valid)
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = _mm("bth,hv->btv", x, head).astype(jnp.float32)
+    return kv_cache, logits
+
+
 def write_latent_pages(
     kv_cache: jax.Array,  # [L, 1, P, ps, 1, dc+rhd]
     layer: int,

@@ -716,6 +716,128 @@ def make_paged_attention_decode_pool_tp(mesh, *, pages_per_chunk: int = 8,
     return fn
 
 
+def _fold_chunk(q: jax.Array, kh: int) -> jax.Array:
+    """[B, T, qh, hd] -> [B, kh*(T*group), hd]: fold the chunk dim into
+    the GQA group dim so the flash-decode kernels score T candidate
+    positions per sequence in ONE dispatch. Sound because every chunk
+    query shares the same history mask (positions < kv_len - 1) — the
+    kernels never look at per-query positions; the causal in-chunk part
+    is combined outside (`_combine_chunk`)."""
+    b, t, qh, hd = q.shape
+    group = qh // kh
+    return q.reshape(b, t, kh, group, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, kh * t * group, hd)
+
+
+def _unfold_chunk(acc, m, l, t: int):
+    """Undo `_fold_chunk` on kernel outputs: acc [B, kh, T*g, hd] ->
+    [B, T, kh, g, hd]; m/l [B, kh, T*g] -> [B, T, kh, g]."""
+    b, kh, tg, hd = acc.shape
+    g = tg // t
+    acc = acc.reshape(b, kh, t, g, hd).transpose(0, 2, 1, 3, 4)
+    m = m.reshape(b, kh, t, g).transpose(0, 2, 1, 3)
+    l = l.reshape(b, kh, t, g).transpose(0, 2, 1, 3)
+    return acc, m, l
+
+
+def _combine_chunk(q, acc, m, l, k_cur, v_cur):
+    """Fold the in-register chunk tokens into unnormalized flash
+    partials with CAUSAL in-chunk masking (query i sees chunk tokens
+    j <= i) — the T-token generalization of `_combine_current`.
+
+    q [B, T, qh, hd]; acc [B, T, kh, g, hd] f32; m/l [B, T, kh, g];
+    k_cur/v_cur [B, T, kh, hd]. Returns [B, T, qh, hd] in q's dtype."""
+    b, t, qh, hd = q.shape
+    kh = k_cur.shape[2]
+    g = qh // kh
+    qg = q.reshape(b, t, kh, g, hd).astype(jnp.float32)
+    s = jnp.einsum("btkgh,bskh->btkgs", qg,
+                   k_cur.astype(jnp.float32)) / math.sqrt(hd)
+    causal = (jnp.arange(t)[None, :]
+              <= jnp.arange(t)[:, None])  # [Tq, Tk]: key j <= query i
+    s = jnp.where(causal[None, :, None, None, :], s, -jnp.inf)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)  # finite: the diagonal is never masked
+    alpha = jnp.exp(m - m_new)  # 0 when history empty (m = -inf)
+    probs = jnp.exp(s - m_new[..., None])  # masked entries -> exact 0
+    out = (acc * alpha[..., None]
+           + jnp.einsum("btkgs,bskh->btkgh", probs,
+                        v_cur.astype(jnp.float32)))
+    denom = l * alpha + jnp.sum(probs, axis=-1)
+    return (out / denom[..., None]).reshape(b, t, qh, hd).astype(q.dtype)
+
+
+def paged_attention_spec(
+    q: jax.Array,  # [B, T, qh, hd] chunk queries (token 0 = committed)
+    kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]
+    layer: int,
+    block_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] committed length INCLUDING chunk token 0
+    k_cur: jax.Array,  # [B, T, kh, hd] chunk K (not yet cached)
+    v_cur: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Speculative batched-verification attention via the per-layer-slice
+    flash kernel: T chunk queries folded into the GQA group dim stream
+    the paged history once, then the causal in-chunk combine runs in
+    XLA. Drop-in for `transformer.paged_attention_spec_xla` (the CPU
+    interpret-mode oracle test pins the equivalence)."""
+    t = q.shape[1]
+    kh = k_cur.shape[2]
+    acc, m, l = paged_decode_attention_partial(
+        _fold_chunk(q, kh), kv_cache[layer, 0], kv_cache[layer, 1],
+        block_tables, kv_lens - 1, interpret=interpret,
+    )
+    acc, m, l = _unfold_chunk(acc, m, l, t)
+    return _combine_chunk(q, acc, m, l, k_cur, v_cur)
+
+
+def paged_attention_spec_pool(
+    q: jax.Array,  # [B, T, qh, hd]
+    kv_cache,  # [L, 2, P, ps, kh, hd] or int8 (values, scales) pair
+    layer,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,  # [B] committed length INCLUDING chunk token 0
+    k_cur: jax.Array,  # [B, T, kh, hd]
+    v_cur: jax.Array,
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Speculative verification via the whole-pool chunked-DMA kernel —
+    the production TPU path: one dispatch streams each owned page ONCE
+    for all T candidate positions (the entire point of speculation on a
+    memory-bound decode: k extra scores ride along for free). int8
+    (values, scales) pools take the q8 variant with in-VMEM dequant,
+    same as single-token decode. Drop-in for
+    `transformer.paged_attention_spec_xla` in `forward_spec`."""
+    t = q.shape[1]
+    kh = k_cur.shape[2]
+    qf = _fold_chunk(q, kh)
+    if isinstance(kv_cache, tuple):
+        values, scales = kv_cache
+        if values.shape[5] != scales.shape[-1] and not interpret:
+            from ..models.transformer import paged_attention_spec_xla
+
+            return paged_attention_spec_xla(q, kv_cache, layer,
+                                            block_tables, kv_lens,
+                                            k_cur, v_cur)
+        acc, m, l = paged_decode_attention_pool(
+            qf, values, layer, block_tables,
+            jnp.maximum(kv_lens - 1, 0), kv_scales=scales,
+            pages_per_chunk=pages_per_chunk, interpret=interpret,
+        )
+    else:
+        acc, m, l = paged_decode_attention_pool(
+            qf, kv_cache, layer, block_tables,
+            jnp.maximum(kv_lens - 1, 0),
+            pages_per_chunk=pages_per_chunk, interpret=interpret,
+        )
+    acc, m, l = _unfold_chunk(acc, m, l, t)
+    return _combine_chunk(q, acc, m, l, k_cur, v_cur)
+
+
 def paged_attention(
     q: jax.Array,  # [B, T, qh, hd]
     kv_cache: jax.Array,  # [L, 2, P, ps, kh, hd]

@@ -160,6 +160,29 @@ _register("DYNT_ATTENTION", "auto", _str,
           "Attention kernel: auto | pallas | xla (auto = Pallas flash-decode "
           "on single-device TPU, XLA reference path elsewhere)")
 
+# Speculative decoding (engine/spec.py + scheduler;
+# docs/speculative-decoding.md)
+_register("DYNT_SPEC_ENABLE", False, _bool,
+          "Draftless speculative decoding (prompt-lookup n-gram proposals "
+          "+ batched verification): up to DYNT_SPEC_MAX_K proposed tokens "
+          "per slot are scored in ONE forward pass and the sampler-exact "
+          "prefix commits. Output streams are bit-identical to "
+          "non-speculative decode; off keeps the decode path untouched")
+_register("DYNT_SPEC_MAX_K", 4, _int,
+          "Max draft tokens proposed per slot per speculative step (the "
+          "verification chunk is k+1 positions; jit compiles one variant "
+          "per k, so this is fixed per serving process)")
+_register("DYNT_SPEC_MIN_EMA", 0.1, _float,
+          "Per-slot acceptance-rate EMA floor: a slot whose EMA falls "
+          "below this stops proposing (it still probes occasionally — "
+          "acceptance is a property of the text, which changes). 0 never "
+          "disables a slot")
+_register("DYNT_SPEC_BATCH_CUTOFF", 0, _int,
+          "Auto-disable speculation when more than this many slots are "
+          "decode-ready: speculation trades FLOPs for latency, and at "
+          "high batch the MXU is busy so the verification FLOPs stop "
+          "being free. 0 disables the cutoff (speculate at any batch)")
+
 # Router
 _register("DYNT_ROUTER_OVERLAP_WEIGHT", 1.0, _float,
           "KV router cost weight for prefix-overlap blocks "

@@ -123,6 +123,30 @@ SLO_GOOD = Counter(
     "DYNT_SLO_ITL_MS targets (an unset target always passes)",
     ["model"], registry=REGISTRY,
 )
+# Speculative decoding plane (engine/spec.py + scheduler): where
+# speculated tokens are won or wasted. acceptance = accepted/proposed;
+# every accepted token is a decode step the engine never ran.
+SPEC_PROPOSED = Counter(
+    "dynamo_spec_proposed_tokens_total",
+    "Draft tokens proposed by the speculative decoder",
+    ["worker"], registry=REGISTRY,
+)
+SPEC_ACCEPTED = Counter(
+    "dynamo_spec_accepted_tokens_total",
+    "Proposed draft tokens that matched the target sample and committed",
+    ["worker"], registry=REGISTRY,
+)
+SPEC_ACCEPTANCE = Gauge(
+    "dynamo_spec_acceptance_rate",
+    "Acceptance-rate EMA across a worker's speculating slots",
+    ["worker"], registry=REGISTRY,
+)
+SPEC_K = Gauge(
+    "dynamo_spec_k",
+    "Draft tokens per slot in the most recent speculative step "
+    "(0 = speculation idle or auto-disabled)",
+    ["worker"], registry=REGISTRY,
+)
 # OTLP exporter health (runtime/otel.py): spans that reached the
 # collector vs spans lost to a full buffer or a failed export.
 OTEL_SPANS_EXPORTED = Counter(
