@@ -60,7 +60,7 @@ def bench_one(model: str, *, model_path: str | None = None,
               num_pages: int = 1024, prompt_len: int = 256,
               decode_steps: int = 256, prefill_chunk: int = 1024,
               do_prefill: bool = True, do_ttft: bool = True,
-              do_spec: bool = True,
+              do_spec: bool = True, do_kvbm: bool = True,
               device_kind: str = "cpu") -> dict:
     from dynamo_tpu.engine import ModelRunner, RunnerConfig
     from dynamo_tpu.models import get_config
@@ -102,7 +102,11 @@ def bench_one(model: str, *, model_path: str | None = None,
     # would scatter KV through zero table entries into the shared scratch
     # page and silently corrupt the measured state.
     block = 64
-    total_tokens = prompt_len + decode_steps + block
+    # Capacity covers the warmup block + timed blocks, and (do_kvbm) the
+    # G2-offload A/B window of another settle + n_blocks fused blocks —
+    # undersizing would scatter KV through zero table entries into the
+    # shared scratch page and corrupt the measured state (comment below).
+    total_tokens = prompt_len + decode_steps + (2 if do_kvbm else 1) * block
     pages_per_seq = total_tokens // PAGE_SIZE + 1
     tables = np.zeros((batch, max_pages_per_seq), np.int32)
     rng = np.random.default_rng(0)
@@ -299,6 +303,90 @@ def bench_one(model: str, *, model_path: str | None = None,
                 (emitted / spec_elapsed) / tok_per_sec, 3),
         }
 
+    # G2-active vs G2-idle serving (ROADMAP item 2 / ISSUE 8): the same
+    # fused-block decode loop while the REAL OffloadManager drains a
+    # continuous store burst — gathers ride the bench loop's dispatch
+    # gap exactly as the serving scheduler's run_in_gap window, with the
+    # DYNT_OFFLOAD_* budget active. `active_vs_idle` is the acceptance
+    # number (>= 0.8 target; the unbudgeted round-5 collapse was 42/170
+    # = 0.25).
+    if do_kvbm and os.environ.get("DYNT_BENCH_KVBM", "1") != "0":
+        import queue as thread_queue
+        import threading
+
+        from dynamo_tpu.block_manager.offload import OffloadManager
+
+        gap_q: thread_queue.Queue = thread_queue.Queue()
+
+        def run_in_gap(fn):
+            out: thread_queue.Queue = thread_queue.Queue(1)
+
+            def wrapped():
+                try:
+                    out.put((fn(), None))
+                except Exception as exc:  # noqa: BLE001
+                    out.put((None, exc))
+
+            gap_q.put(wrapped)
+            return out
+
+        def step_block_with_gap():
+            step_block()
+            while True:  # drain gathers into the dispatch gap
+                try:
+                    fn = gap_q.get_nowait()
+                except thread_queue.Empty:
+                    break
+                fn()
+
+        n_bench_pages = max(1, next_page - 1)
+        sunk = {"blocks": 0, "bytes": 0}
+
+        def sink(h, block_arr, parent):
+            sunk["blocks"] += 1
+            sunk["bytes"] += block_arr.nbytes
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 + (h % n_bench_pages) for h in hs],
+            gather=runner.gather_pages_device,
+            run_in_step=run_in_gap,
+            sink=sink,
+        )
+        feeding = threading.Event()
+        feeding.set()
+
+        def feeder():
+            seq = 0
+            while feeding.is_set():
+                mgr.notify_stored(list(range(seq, seq + 32)), parent=None)
+                seq += 32
+                time.sleep(0.02)
+
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+        try:
+            step_block_with_gap()  # settle
+            t0 = time.perf_counter()
+            for _ in range(n_blocks):
+                step_block_with_gap()
+            drain()
+            active_elapsed = time.perf_counter() - t0
+        finally:
+            feeding.clear()
+            feed_thread.join(timeout=5)
+            mgr.close()
+        positions -= (n_blocks + 1) * block
+        kv_lens -= (n_blocks + 1) * block
+        steps_np -= (n_blocks + 1) * block
+        active_tok = batch * n_blocks * block / active_elapsed
+        result["kvbm_offload"] = {
+            "idle_tokens_per_sec": round(tok_per_sec, 1),
+            "active_tokens_per_sec": round(active_tok, 1),
+            "active_vs_idle": round(active_tok / tok_per_sec, 3),
+            "offloaded_blocks": sunk["blocks"],
+            "offloaded_mb": round(sunk["bytes"] / 2**20, 1),
+        }
+
     # On-chip prefill throughput + MFU headline (VERDICT r3 item 2): time
     # PIPELINED prefill chunks exactly like the decode bench pipelines
     # decode blocks — return_device defers the host sync so the dispatch
@@ -408,6 +496,50 @@ def bench_one(model: str, *, model_path: str | None = None,
     return result
 
 
+def bench_disagg_point(requests: int = 16) -> dict:
+    """Pipelined vs serial disaggregated prefill on the mocker xPyD
+    profile (measured v5e step physics + modeled per-block KV handoff,
+    TIMING_PRESETS) — the chip-free overlap point BENCH_r06 records next
+    to the silicon numbers. TTFT falls because chunk i's handoff
+    overlaps chunk i+1's compute; ITL is untouched by construction
+    (docs/disaggregation.md)."""
+    import asyncio
+
+    from dynamo_tpu.mocker.engine import MockerConfig
+    from dynamo_tpu.mocker.loadgen import OfflineReplay, synthesize_trace
+
+    # Long prompts + moderate speedup keep the modeled handoff delta an
+    # order of magnitude above asyncio timer jitter (sub-ms sleeps at
+    # high speedup ratios drown the signal), and the arrival rate sits
+    # below the 2-engine prefill service rate so queueing noise doesn't
+    # swamp the p50.
+    records = synthesize_trace(requests, rate_rps=5.0, isl_mean=4096,
+                               osl_mean=32, seed=11)
+    cfg = MockerConfig.from_timing_preset(
+        "tpu-v5e-qwen3-0.6b", speedup_ratio=10.0,
+        max_prefill_tokens_per_step=512)
+
+    async def both() -> tuple[dict, dict]:
+        pipe = await OfflineReplay(mode="disagg", num_workers=2,
+                                   num_prefill_workers=2, config=cfg,
+                                   disagg_pipeline=True).run(records)
+        serial = await OfflineReplay(mode="disagg", num_workers=2,
+                                     num_prefill_workers=2, config=cfg,
+                                     disagg_pipeline=False).run(records)
+        return pipe.summary(), serial.summary()
+
+    pipe, serial = asyncio.run(both())
+    return {
+        "profile": "tpu-v5e-qwen3-0.6b xPyD (2P/2D, mocker)",
+        "pipelined_ttft_ms": pipe["ttft_ms"],
+        "serial_ttft_ms": serial["ttft_ms"],
+        "pipelined_itl_ms": pipe["itl_ms"],
+        "serial_itl_ms": serial["itl_ms"],
+        "ttft_p50_speedup": round(
+            serial["ttft_ms"]["p50"] / max(pipe["ttft_ms"]["p50"], 1e-9), 3),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -448,6 +580,8 @@ def main() -> None:
         # CPU smoke: only the toy — a 7B random-init forward on CPU is
         # tens of minutes of compile+run for zero perf signal.
         result = bench_one("qwen3-0.6b", device_kind=device_kind)
+        if os.environ.get("DYNT_BENCH_DISAGG", "1") != "0":
+            result["disagg"] = bench_disagg_point()
         print(json.dumps(result))
         return
 
@@ -495,6 +629,12 @@ def main() -> None:
             # must survive a secondary-bench failure
             secondary.append({"metric": label, "error": repr(exc)})
     result["secondary"] = secondary
+    if os.environ.get("DYNT_BENCH_DISAGG", "1") != "0":
+        try:
+            result["disagg"] = bench_disagg_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["disagg"] = {"error": repr(exc)}
     print(json.dumps(result))
 
 

@@ -195,7 +195,11 @@ class DistributedKvbm:
 
     # -- scheduler-facing surface (KvBlockManager contract) ----------------
 
-    def attach_engine(self, *, lookup_pages, gather, run_in_step) -> None:
+    def attach_engine(self, *, lookup_pages, gather, run_in_step,
+                      step_pressure=None) -> None:
+        # step_pressure is accepted for contract parity with the
+        # single-host KvBlockManager; the mirrored store path has no
+        # device-gather budget yet (the store is the mirrored call).
         self._lookup = lookup_pages
         self._run_in_step = run_in_step
         self._thread = threading.Thread(target=self._loop, daemon=True,

@@ -117,14 +117,17 @@ class KvBlockManager:
         lookup_pages: Callable[[list[int]], list[Optional[int]]],
         gather: Callable[[np.ndarray], np.ndarray],
         run_in_step,
+        step_pressure=None,
     ) -> None:
         """Connect the G1 side (scheduler/runner) and start the offload
         worker. `lookup_pages` resolves block hashes to live G1 pages on
-        the scheduler thread."""
+        the scheduler thread; `step_pressure` (optional) reports the
+        engine's recent step wall time so the offload bandwidth budget
+        backs off under serving load (docs/kvbm.md overlap discipline)."""
         self.offload = OffloadManager(
             lookup_pages=lookup_pages, gather=gather, run_in_step=run_in_step,
             sink=self._offload_sink, batch_size=self.config.offload_batch,
-            skip=self._already_tiered,
+            skip=self._already_tiered, step_pressure=step_pressure,
         )
 
     def notify_stored(self, hashes: list[int], parent: Optional[int]) -> None:
@@ -226,6 +229,9 @@ class KvBlockManager:
                 "offloaded": self.stats.offloaded,
                 "onboarded": self.stats.onboarded_blocks,
             }
+            if self.offload is not None:
+                info["offload_queue"] = self.offload.queue_depth()
+                info["offload_dropped"] = self.offload.dropped
             if self.disk is not None:
                 info["g3_blocks"] = len(self.disk)
                 info["g3_usage"] = self.disk.usage()

@@ -9,9 +9,25 @@ TPU shape of the problem: the paged KV lives in one donated HBM buffer that
 every compiled step consumes, so device-side gathers/scatters MUST be
 serialized with engine steps. The manager therefore runs its own worker
 thread that only *stages* work: D2H gathers are submitted to the scheduler
-thread via a `run_in_step` executor (one fused gather + one contiguous DMA
-per batch — ref block_copy.cu's batched copies), while host→disk cascades
-and disk→host reads run entirely on the offload thread, off the hot path.
+thread via a `run_in_step` executor (the scheduler routes them into the
+dispatch/drain gap of its loop — device busy on the decode block, host
+free), while host→disk cascades and disk→host reads run entirely on the
+offload thread, off the hot path.
+
+Overlap discipline (docs/kvbm.md):
+
+  * gathers are split into small sub-batches (`DYNT_OFFLOAD_SUBBATCH`
+    pages) so no single gather holds the gap for long;
+  * sub-batches are double-buffered — while bundle k sinks to G2 (the
+    slow D2H + tier write, on this thread), sub-batch k+1's gather is
+    already submitted to the scheduler thread;
+  * a bandwidth budget (`DYNT_OFFLOAD_BW_FRAC`) defers the next gather
+    after each one, bounding the fraction of wall time the offload path
+    may hold the step thread — G2-active serving stays within budget of
+    G2-idle instead of collapsing under a store burst;
+  * the pending queue is bounded (`DYNT_OFFLOAD_QUEUE_CAP`, drop-oldest
+    + dynamo_kvbm_offload_dropped_total) — offload is best-effort cache
+    population, never backpressure.
 
 Onboard (G2/G3→G1) is intentionally synchronous at admission time in the
 scheduler (it replaces prefill compute, so it IS the critical path and the
@@ -21,16 +37,23 @@ read is a host memcpy/mmap read).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from ..runtime.config import env
 from ..runtime.logging import get_logger
+from ..runtime.metrics import (
+    KVBM_OFFLOAD_DEFERRED,
+    KVBM_OFFLOAD_DROPPED,
+    KVBM_OFFLOAD_QUEUE_DEPTH,
+)
 
 log = get_logger("kvbm.offload")
 
 # gather executor: takes a zero-arg fn, returns a Queue of (result, exc) —
-# the signature of InferenceScheduler.run_in_step.
+# the signature of InferenceScheduler.run_in_step / run_in_gap.
 GatherExecutor = Callable[[Callable[[], object]], "object"]
 
 
@@ -44,21 +67,42 @@ class OffloadManager:
         sink: Callable[[int, np.ndarray, Optional[int]], None],
         batch_size: int = 8,
         skip: Optional[Callable[[int], bool]] = None,
+        bw_frac: Optional[float] = None,
+        subbatch: Optional[int] = None,
+        queue_cap: Optional[int] = None,
+        gather_timeout: float = 30.0,
+        step_pressure: Optional[Callable[[], float]] = None,
     ) -> None:
         """lookup_pages: hash -> current G1 page (None if evicted since);
-        gather: page-ids -> host bundle (scheduler-thread only);
+        gather: page-ids -> device bundle (scheduler-thread only);
         run_in_step: serializes `gather` with engine steps (None = call
-        inline, for tests/mocker); sink: receives (hash, block, parent)."""
+        inline, for tests/mocker); sink: receives (hash, block, parent).
+        bw_frac/subbatch/queue_cap default from the DYNT_OFFLOAD_* knobs;
+        step_pressure (optional) returns the engine's recent step wall
+        time in ms — under load the budget also spaces gathers at least
+        one step apart."""
         self._lookup = lookup_pages
         self._gather = gather
         self._run_in_step = run_in_step
         self._sink = sink
         self._skip = skip or (lambda h: False)
         self._batch = batch_size
+        self._bw_frac = float(env("DYNT_OFFLOAD_BW_FRAC")
+                              if bw_frac is None else bw_frac)
+        self._subbatch = max(1, int(env("DYNT_OFFLOAD_SUBBATCH")
+                                    if subbatch is None else subbatch))
+        self._queue_cap = max(1, int(env("DYNT_OFFLOAD_QUEUE_CAP")
+                                     if queue_cap is None else queue_cap))
+        self._gather_timeout = gather_timeout
+        self._step_pressure = step_pressure
         self._pending: list[tuple[int, Optional[int]]] = []  # (hash, parent)
         self._cond = threading.Condition()
         self._stop = False
         self._inflight = 0
+        # Budget state: no gather before this monotonic instant.
+        self._next_gather_at = 0.0
+        self.dropped = 0  # blocks dropped at the queue cap (mirror of the
+        # dynamo_kvbm_offload_dropped_total counter, for tests/usage())
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="kvbm-offload")
         self._thread.start()
@@ -67,14 +111,34 @@ class OffloadManager:
 
     def notify_stored(self, hashes: list[int], parent: Optional[int]) -> None:
         """G1 registered new blocks: queue device→host offload. Called from
-        the PagePool on_stored hook."""
+        the PagePool on_stored hook. The queue is bounded: a store burst
+        past DYNT_OFFLOAD_QUEUE_CAP drops the OLDEST queued blocks (they
+        are the least likely to still be in G1 by gather time)."""
+        items = []
+        prev = parent
+        for h in hashes:
+            if not self._skip(h):
+                items.append((h, prev))
+            prev = h
+        self._append_bounded(items)
+
+    def _append_bounded(self, items: list) -> None:
+        """Append to the pending queue under the cap: overflow drops the
+        OLDEST entries (counted), depth gauge updated, worker notified.
+        Shared by notify_stored and the timeout re-queue path."""
         with self._cond:
-            prev = parent
-            for h in hashes:
-                if not self._skip(h):
-                    self._pending.append((h, prev))
-                prev = h
+            self._pending.extend(items)
+            overflow = len(self._pending) - self._queue_cap
+            if overflow > 0:
+                del self._pending[:overflow]
+                self.dropped += overflow
+                KVBM_OFFLOAD_DROPPED.inc(overflow)
+            KVBM_OFFLOAD_QUEUE_DEPTH.set(len(self._pending))
             self._cond.notify()
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._pending)
 
     # -- worker thread -----------------------------------------------------
 
@@ -87,6 +151,7 @@ class OffloadManager:
                     return
                 batch = self._pending[: self._batch]
                 del self._pending[: self._batch]
+                KVBM_OFFLOAD_QUEUE_DEPTH.set(len(self._pending))
                 self._inflight += 1
             try:
                 self._offload_batch(batch)
@@ -100,57 +165,162 @@ class OffloadManager:
     def _offload_batch(self, batch: list[tuple[int, Optional[int]]]) -> None:
         from ..runtime.otel import get_tracer
 
-        hashes = [h for h, _ in batch]
         # Offload is background maintenance with no owning request: each
         # batch gets a root span of its own so tier pressure is visible
         # in the trace backend without inventing a fake parent.
         tracer = get_tracer()
         span = tracer.start_span("kvbm.offload", **{"blocks": len(batch)})
         ok = False
+        total_bytes = 0
         try:
-            self._do_offload_batch(batch, hashes, span)
+            total_bytes = self._do_offload_batch(batch)
             ok = True
         finally:
+            span.set_attribute("bytes", total_bytes)
             span.end(ok=ok)
 
-    def _do_offload_batch(self, batch, hashes, span) -> None:
+    def _do_offload_batch(self, batch) -> int:
+        """Sub-batched, double-buffered offload: sub-batch k+1's gather is
+        submitted to the step thread BEFORE bundle k's D2H + tier sink run
+        here, so the transfer of one bundle overlaps the gather of the
+        next. Returns total bytes sunk."""
+        subs = [batch[i : i + self._subbatch]
+                for i in range(0, len(batch), self._subbatch)]
+        pending: Optional[tuple[list, object, list]] = None
+        total_bytes = 0
+        for sub in subs:
+            if self._stop:
+                break
+            self._throttle()
+            handle = self._submit_gather(sub)
+            if pending is not None:
+                total_bytes += self._sink_bundle(*pending)
+            pending = self._await_gather(handle, sub)
+        if pending is not None:
+            total_bytes += self._sink_bundle(*pending)
+        return total_bytes
+
+    def _submit_gather(self, sub: list):
+        """Dispatch the device gather for one sub-batch. With an executor,
+        returns (result queue, abandon event); inline mode returns the
+        result directly. The abandon event is set when the waiter gives
+        up (timeout/close): a closure still sitting in the scheduler's
+        gap queue then returns immediately instead of running an
+        orphaned gather whose step-thread time nobody charges to the
+        budget — and whose blocks the re-queued retry gathers again."""
+        hashes = [h for h, _ in sub]
+        abandoned = threading.Event()
 
         def gather_on_sched():
+            if abandoned.is_set():
+                return [], None, 0.0
             # Resolve hash->page at gather time ON the scheduler thread:
             # eviction also only runs there, so the mapping cannot go stale
             # between lookup and gather. Only the DEVICE gather runs here
-            # (a fresh buffer, microseconds); the D2H copy happens below on
-            # THIS offload thread so decode stepping overlaps the transfer.
+            # (a fresh buffer, microseconds on real silicon); the D2H copy
+            # happens on the OFFLOAD thread so decode stepping overlaps
+            # the transfer. The closure times itself so the bandwidth
+            # budget charges exactly the step-thread time it consumed.
+            t0 = time.perf_counter()
             pages = self._lookup(hashes)
             keep = [i for i, p in enumerate(pages) if p is not None]
             if not keep:
-                return [], None
+                return [], None, time.perf_counter() - t0
             ids = np.asarray([pages[i] for i in keep], np.int32)
-            return keep, self._gather(ids)
+            bundle = self._gather(ids)
+            return keep, bundle, time.perf_counter() - t0
 
         if self._run_in_step is None:
-            keep, bundle = gather_on_sched()
-        else:
-            out = self._run_in_step(gather_on_sched)
-            result, exc = out.get(timeout=30.0)
-            if exc is not None:
-                raise exc
-            keep, bundle = result
+            return gather_on_sched()
+        return self._run_in_step(gather_on_sched), abandoned
+
+    def _await_gather(self, handle, sub: list):
+        """Wait for a submitted gather, honoring close() and re-queueing
+        the sub-batch on timeout (a wedged scheduler must not wedge the
+        offload thread — satellite fix for the old hard 30s `.get`)."""
+        if self._run_in_step is None:
+            keep, bundle, g = handle
+            self._charge_budget(g)
+            return (keep, bundle, sub) if bundle is not None else None
+        resultq, abandoned = handle
+        deadline = time.monotonic() + self._gather_timeout
+        while True:
+            try:
+                result, exc = resultq.get(timeout=0.5)
+                break
+            except Exception:  # noqa: BLE001 — queue.Empty: keep waiting
+                if self._stop:
+                    # Closing: the scheduler's final control drain may
+                    # still run the (now no-op) closure, but nobody
+                    # needs the result.
+                    abandoned.set()
+                    return None
+                if time.monotonic() >= deadline:
+                    log.warning(
+                        "offload gather timed out after %.0fs; re-queueing "
+                        "%d blocks", self._gather_timeout, len(sub))
+                    abandoned.set()
+                    self._requeue(sub)
+                    return None
+        if exc is not None:
+            raise exc
+        keep, bundle, g = result
+        self._charge_budget(g)
         if bundle is None:
-            return
+            return None
+        return keep, bundle, sub
+
+    def _requeue(self, sub: list) -> None:
+        self._append_bounded(sub)
+
+    def _sink_bundle(self, keep: list, bundle, sub: list) -> int:
         # The slow half, off the step thread: one contiguous D2H of the
         # whole bundle (np.asarray of a device array), then per-block sink.
         bundle = np.asarray(bundle)
-        span.set_attribute("bytes", int(bundle.nbytes))
         for j, i in enumerate(keep):
-            h, parent = batch[i]
+            h, parent = sub[i]
             self._sink(h, np.asarray(bundle[j]), parent)
+        return int(bundle.nbytes)
+
+    # -- bandwidth budget --------------------------------------------------
+
+    def _charge_budget(self, gather_secs: float) -> None:
+        """A gather that held the step thread for g seconds earns an idle
+        gap of g*(1/frac - 1): over time the offload path holds at most
+        `frac` of wall time. Under step-time pressure (a reported recent
+        step wall time), gathers are additionally spaced at least one
+        engine step apart — one sub-batch per dispatch/drain gap."""
+        if self._bw_frac <= 0:
+            return
+        gap = gather_secs * (1.0 / self._bw_frac - 1.0)
+        if self._step_pressure is not None:
+            try:
+                gap = max(gap, float(self._step_pressure()) / 1e3)
+            except Exception:  # noqa: BLE001 — pressure is advisory
+                pass
+        self._next_gather_at = time.monotonic() + gap
+
+    def _throttle(self) -> None:
+        """Interruptible wait for the budget window (close() aborts it).
+        Deferred time is measured as ELAPSED monotonic time — the wait
+        condition is shared with notify_stored, so a store burst wakes
+        the wait spuriously and counting requested timeouts would
+        overcount by orders of magnitude."""
+        start = time.monotonic()
+        with self._cond:
+            while not self._stop:
+                remaining = self._next_gather_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(0.2, remaining))
+        waited = time.monotonic() - start
+        if waited > 1e-3:
+            KVBM_OFFLOAD_DEFERRED.inc(waited)
 
     # -- lifecycle ---------------------------------------------------------
 
     def flush(self, timeout: float = 30.0) -> bool:
         """Block until the queue drains (tests / graceful shutdown)."""
-        import time
         deadline = time.monotonic() + timeout
         with self._cond:
             while self._pending or self._inflight:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import time
 import uuid
 from typing import Optional
 
@@ -102,50 +103,105 @@ class IciKvBridge:
     def attach_prefill(self, worker) -> None:
         self._prefill = worker
 
-    async def pull(self, transfer_id: str, decode_runner) -> Optional[jax.Array]:
-        """Claim a parked transfer and return the bundle as a device array
-        on the decode mesh (None -> caller recomputes prefill, the same
-        fallback the host-relay path takes)."""
+    async def pull(self, transfer_id: str, decode_runner
+                   ) -> tuple[Optional[jax.Array], Optional[int]]:
+        """Claim a parked transfer and return (bundle, first_token) as a
+        device array on the decode mesh ((None, None) -> caller recomputes
+        prefill, the same fallback the host-relay path takes). Streaming
+        transfers (chunked disagg handoff) are pulled chunk-by-chunk: the
+        gather + ICI reshard of chunk i runs while the prefill pool is
+        still computing chunk i+1, and the terminal chunk carries the
+        first sampled token."""
         self.pulls += 1
         worker = self._prefill
         if worker is None:
             log.warning("ici pull with no prefill side attached")
-            return None
+            return None, None
         transfer = worker.transfers.claim(transfer_id)
         if transfer is None:
             log.warning("ici pull: unknown transfer %s", transfer_id)
-            return None
-        try:
-            page_ids = jnp.asarray(transfer.page_ids, jnp.int32)
-            resultq = worker.scheduler.run_in_step(
+            return None, None
+        first_token = getattr(transfer, "first_token", None)
+        gap_exec = getattr(worker.scheduler, "run_in_gap",
+                           worker.scheduler.run_in_step)
+        head_sharded = not worker.runner.model_config.is_mla
+        target = bundle_sharding(decode_runner.mesh, head_sharded)
+        parts: list[jax.Array] = []
+
+        async def gather_reshard(ids: list[int]) -> bool:
+            """Gather `ids` on the prefill scheduler (gap window), then
+            launch the ICI reshard; False -> recompute fallback."""
+            page_ids = jnp.asarray(ids, jnp.int32)
+            resultq = gap_exec(
                 lambda: gather_kv_blocks(worker.runner.kv_cache, page_ids))
             try:
                 bundle, exc = await asyncio.to_thread(resultq.get, True, 60.0)
             except Exception as exc_:  # noqa: BLE001 — queue.Empty on timeout
                 log.warning("ici gather timed out: %r", exc_)
-                return None
+                return False
             if exc is not None:
                 log.warning("ici gather failed: %r", exc)
-                return None
+                return False
+            try:
+                parts.append(jax.device_put(bundle, target))  # ICI hop
+            except Exception as exc_:  # noqa: BLE001 — degrade to recompute
+                log.warning("ici reshard failed (%r); recomputing prefill",
+                            exc_)
+                return False
+            return True
+
+        try:
+            if transfer.streaming:
+                sent = 0
+                # Stall window, re-armed on every chunk of progress: a
+                # long prompt may legitimately prefill for many minutes
+                # (other sequences share the chunk budget); only a
+                # 120s lull with NO new pages aborts to recompute.
+                deadline = time.monotonic() + 120.0
+                while True:
+                    ids, done, failed = await asyncio.to_thread(
+                        transfer.wait_ready, sent, 1.0)
+                    if failed:
+                        log.warning("ici pull: transfer %s aborted",
+                                    transfer_id[:8])
+                        return None, None
+                    new = ids[sent:]
+                    if not new and not done:
+                        if time.monotonic() > deadline:
+                            log.warning("ici pull timed out awaiting "
+                                        "prefill chunks")
+                            return None, None
+                        continue
+                    if new:
+                        if not await gather_reshard(new):
+                            return None, None
+                        sent += len(new)
+                        deadline = time.monotonic() + 120.0
+                    if done and sent >= len(ids):
+                        first_token = transfer.first_token
+                        break
+            else:
+                if not await gather_reshard(list(transfer.page_ids)):
+                    return None, None
         finally:
-            # Pages go back to the prefill pool as soon as the gather made
-            # an independent copy (or failed) — not after decode admission.
+            # Pages go back to the prefill pool as soon as the gathers
+            # made independent copies (or failed) — not after decode
+            # admission.
             transfer.release()
         try:
-            head_sharded = not worker.runner.model_config.is_mla
-            target = bundle_sharding(decode_runner.mesh, head_sharded)
-            dst = jax.device_put(bundle, target)  # the ICI hop (async)
+            dst = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
             await asyncio.to_thread(jax.block_until_ready, dst)
         except Exception as exc:  # noqa: BLE001 — degrade like the wire path
             # Same contract as the host-relay pull: ANY transfer failure
             # (decode HBM full, sharding mismatch) means recompute, not a
             # failed user request.
-            log.warning("ici reshard failed (%r); recomputing prefill", exc)
-            return None
+            log.warning("ici concat failed (%r); recomputing prefill", exc)
+            return None, None
         self.hits += 1
         log.info("ici bridge pull %s: %d pages moved prefill->decode "
-                 "on-device", transfer_id[:8], len(transfer.page_ids))
-        return dst
+                 "on-device (%d chunk(s))", transfer_id[:8],
+                 int(dst.shape[0]), len(parts))
+        return dst, first_token
 
 
 # -- union-mesh (single SPMD program) collective-permute form ---------------

@@ -900,6 +900,88 @@ class ModelRunner:
                                     np.asarray(top_lps)[0])
         return int(np.asarray(token)[0])
 
+    def prefill_chunk_batch(
+        self,
+        rows: list,  # (tokens, start_pos, block_table, kv_len_after,
+        #              sampling, lora_idx) per sequence
+        want_samples: bool = False,
+    ):
+        """Run SEVERAL sequences' prefill chunks in one compiled dispatch
+        — the cross-sequence shape fix for low-MFU small-model prefill
+        (one [B, bucket] forward instead of B [1, bucket] forwards; the
+        prefill step function is batch-general, jit specializes per
+        (B, bucket)). Per-row results are bit-identical to equivalent
+        prefill_chunk calls: the sampler keys on each row's (seed, step),
+        never the row index.
+
+        Returns the device token array [B_padded] (row i = rows[i]); with
+        want_samples=True, `last_prefill_samples` holds per-row
+        (logprob, top_ids, top_logprobs) — a host sync, so ask only when
+        a row actually needs logprobs. Rows padded to the power-of-two
+        batch write into the page-0 scratch sink with an all-False valid
+        mask, the same padding contract single-row prefill uses for its
+        token tail."""
+        n = len(rows)
+        b = 1 << max(0, n - 1).bit_length()  # pow2 B: bounded jit variants
+        bucket = self._bucket_for(max(len(r[0]) for r in rows))
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._build_prefill(bucket)
+            self._prefill_fns[bucket] = fn
+        max_pages = self.config.max_pages_per_seq
+        tok = np.zeros((b, bucket), np.int32)
+        pos = np.zeros((b, bucket), np.int32)
+        valid = np.zeros((b, bucket), bool)
+        tables = np.zeros((b, max_pages), np.int32)  # pad rows -> scratch
+        kv_lens = np.zeros(b, np.int32)
+        last_idx = np.zeros(b, np.int32)
+        temp = np.zeros(b, np.float32)
+        top_p = np.ones(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        seeds = np.zeros(b, np.uint32)
+        lora_rows = np.zeros(b, np.int32)
+        for i, (tokens, start, table, kv_after, sampling, lidx) in \
+                enumerate(rows):
+            t = len(tokens)
+            tok[i, :t] = tokens
+            pos[i, :t] = np.arange(start, start + t)
+            valid[i, :t] = True
+            tables[i] = table
+            kv_lens[i] = kv_after
+            last_idx[i] = t - 1
+            temp[i], top_p[i], top_k[i], seeds[i] = sampling
+            lora_rows[i] = lidx
+        args = [
+            self.params, self.kv_cache, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(tables), jnp.asarray(kv_lens), jnp.asarray(valid),
+            jnp.asarray(last_idx), jnp.asarray(temp), jnp.asarray(top_p),
+            jnp.asarray(top_k), jnp.asarray(seeds),
+        ]
+        kwargs: dict = {}
+        if self.lora_pack is not None:
+            kwargs["lora"] = self.lora_pack
+            kwargs["lora_idx"] = jnp.asarray(lora_rows)
+        if self.model_config.image_token_id >= 0:
+            # Batched path carries no embed splicing (the scheduler routes
+            # media sequences through single-row prefill); reuse a cached
+            # device zero buffer per (B, bucket).
+            zeros = self._zero_embeds.get((b, bucket))
+            if zeros is None:
+                zeros = jnp.zeros(
+                    (b, bucket, self.model_config.hidden), jnp.float32)
+                self._zero_embeds[(b, bucket)] = zeros
+            kwargs["extra_embeds"] = zeros
+        self.kv_cache, token, lp, top_ids, top_lps = fn(*args, **kwargs)
+        if want_samples:
+            lp_h = np.asarray(lp)
+            ids_h = np.asarray(top_ids)
+            lps_h = np.asarray(top_lps)
+            self.last_prefill_samples = [
+                (float(lp_h[i]), ids_h[i], lps_h[i]) for i in range(n)]
+        else:
+            self.last_prefill_samples = [None] * n
+        return token
+
     def decode(
         self,
         tokens: np.ndarray,  # [B] last token per slot

@@ -28,6 +28,7 @@ from ..llm.kv_transfer import (
     KvLayoutDescriptor,
     PendingTransfer,
     PendingTransferTable,
+    StreamingTransfer,
     encode_block_chunks,
 )
 from ..llm.model_card import (
@@ -155,6 +156,14 @@ class TpuWorker:
         self._warmup = warmup
         self.mode = mode
         self.transfers = PendingTransferTable()
+        # Disagg chunked handoff (docs/disaggregation.md): live streaming
+        # transfers keyed by request id, appended per prefill chunk on
+        # the scheduler thread. 0 depth disables (serial handoff).
+        from ..runtime.config import env as _cfg_env
+
+        self.disagg_pipeline = max(0, int(_cfg_env("DYNT_DISAGG_PIPELINE")
+                                          or 0))
+        self._stream_transfers: dict[str, StreamingTransfer] = {}
         self.events = KvEventBuffer(self.instance_id)
         self.runner: Optional[ModelRunner] = None
         self.scheduler: Optional[InferenceScheduler] = None
@@ -639,15 +648,43 @@ class TpuWorker:
 
     # -- disaggregation: prefill-side export -------------------------------
 
+    def _transfer_params(self, transfer_id: str, layout: KvLayoutDescriptor,
+                         prompt_len: int, streaming: bool = False) -> dict:
+        params = {
+            "transfer_id": transfer_id,
+            "namespace": self.card.namespace,
+            "component": self.card.component,
+            "instance_id": self.instance_id,
+            "layout": layout.to_wire(),
+            "prompt_len": prompt_len,
+        }
+        if streaming:
+            # No first_token yet: the pull stream's terminal frame
+            # carries it once the prompt pass finishes.
+            params["streaming"] = True
+        if self.ici_bridge is not None:
+            # Decode workers in THIS process (co-meshed pools) pull over
+            # ICI through the bridge; remote ones fall back to the wire.
+            params["bridge_token"] = self.ici_bridge.token
+        return params
+
     def _register_transfer(self, seq, first_token: int,
                            page_ids: list[int]) -> dict:
         """Runs on the scheduler thread when a prefill-only sequence
         finishes its prompt pass: park the pages with the transfer table
-        and describe the pull route (ref §3.4 disaggregated_params)."""
+        and describe the pull route (ref §3.4 disaggregated_params). A
+        sequence whose chunks were streamed (on_prefill_chunk) finishes
+        its EXISTING StreamingTransfer instead of opening a new one."""
         import uuid as _uuid
 
-        transfer_id = _uuid.uuid4().hex
         layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
+        stream = self._stream_transfers.pop(seq.request.request_id, None)
+        if stream is not None:
+            stream.finish(first_token, page_ids)
+            return {**self._transfer_params(stream.transfer_id, layout,
+                                            seq.prompt_len, streaming=True),
+                    "first_token": first_token}
+        transfer_id = _uuid.uuid4().hex
         self.transfers.add(PendingTransfer(
             transfer_id=transfer_id,
             page_ids=page_ids,
@@ -655,19 +692,47 @@ class TpuWorker:
             layout=layout,
             prompt_len=seq.prompt_len,
         ))
-        params = {
-            "transfer_id": transfer_id,
-            "namespace": self.card.namespace,
-            "component": self.card.component,
-            "instance_id": self.instance_id,
-            "layout": layout.to_wire(),
-            "prompt_len": seq.prompt_len,
-        }
-        if self.ici_bridge is not None:
-            # Decode workers in THIS process (co-meshed pools) pull over
-            # ICI through the bridge; remote ones fall back to the wire.
-            params["bridge_token"] = self.ici_bridge.token
-        return params
+        return self._transfer_params(transfer_id, layout, seq.prompt_len)
+
+    def _stream_transfer_chunk(self, seq, new_page_ids):
+        """Scheduler-thread hook for each NON-final prefill chunk of a
+        prefill-only sequence (InferenceScheduler._stream_prefill_chunk):
+        park the newly completed pages with a StreamingTransfer so the
+        decode worker pulls chunk i while chunk i+1 computes. First call
+        registers the transfer and returns the params the scheduler
+        emits mid-stream; `new_page_ids=None` is the abort signal
+        (cancel/error before the prompt finished)."""
+        import uuid as _uuid
+
+        from ..runtime.metrics import DISAGG_STREAMED_PAGES
+
+        rid = seq.request.request_id
+        if new_page_ids is None:
+            stream = self._stream_transfers.pop(rid, None)
+            if stream is not None:
+                stream.fail()
+            return None
+        stream = self._stream_transfers.get(rid)
+        if stream is not None:
+            stream.append_pages(new_page_ids)
+            DISAGG_STREAMED_PAGES.labels(
+                worker=f"{self.instance_id:x}").inc(len(new_page_ids))
+            return None
+        layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
+        stream = StreamingTransfer(
+            transfer_id=_uuid.uuid4().hex,
+            page_ids=[int(p) for p in new_page_ids],
+            release=lambda: self.scheduler.release_transfer_pages(seq),
+            layout=layout,
+            prompt_len=seq.prompt_len,
+            table=self.transfers,
+        )
+        self._stream_transfers[rid] = stream
+        self.transfers.add(stream)
+        DISAGG_STREAMED_PAGES.labels(
+            worker=f"{self.instance_id:x}").inc(len(new_page_ids))
+        return self._transfer_params(stream.transfer_id, layout,
+                                     seq.prompt_len, streaming=True)
 
     async def _kv_pull(self, body: dict, ctx=None) -> AsyncIterator[dict]:
         """Decode workers pull parked prefill KV here: gather the pages on
@@ -688,6 +753,24 @@ class TpuWorker:
         if transfer is None:
             span.end(ok=False)
             yield {"error": f"unknown transfer {transfer_id}"}
+            return
+        if transfer.streaming:
+            # Chunked handoff: stream pages as the (still running) prompt
+            # pass parks them — the pipeline that overlaps the wire
+            # transfer with prefill compute (docs/disaggregation.md).
+            ok = False
+            try:
+                async for frame in self._stream_kv_pull(transfer, span,
+                                                        ctx):
+                    if frame.get("done"):
+                        ok = True
+                    yield frame
+            finally:
+                # Covers clean ends, error frames, and a decode-side
+                # disconnect (GeneratorExit) alike; claimer owns the one
+                # release.
+                span.end(ok=ok)
+                transfer.release()
             return
         try:
             page_ids = transfer.page_ids
@@ -732,22 +815,103 @@ class TpuWorker:
             span.end(ok=False)
             transfer.release()
 
+    async def _stream_kv_pull(self, transfer: StreamingTransfer, span,
+                              ctx) -> AsyncIterator[dict]:
+        """Serve a streaming transfer: gather + send each chunk's pages
+        as the scheduler parks them, then a terminal frame carrying the
+        first sampled token. Gathers ride the prefill scheduler's
+        dispatch/drain gap (run_in_gap) so they queue behind in-flight
+        work instead of delaying the next prefill chunk."""
+        import numpy as _np
+
+        layout = transfer.layout
+        total = transfer.total_pages
+        deadline = getattr(ctx, "deadline", None) if ctx is not None else None
+        budget = None
+        if deadline is not None:
+            budget = deadline.remaining()
+            if budget <= 0:
+                # Already expired (remaining() can be <= 0): fail fast
+                # to the recompute fallback instead of gathering pages
+                # for a request nobody can finish in time.
+                yield {"error": "request deadline expired before "
+                                "streaming kv pull"}
+                return
+        # Deadline-carrying requests get exactly their remaining budget
+        # (the end-to-end contract). Deadlineless pulls get a 120s STALL
+        # window re-armed on every chunk of progress — a long prompt may
+        # legitimately prefill for many minutes; only a lull with no new
+        # pages aborts to recompute.
+        overall = time.monotonic() + max(1.0,
+                                         budget if budget is not None
+                                         else 120.0)
+        gap_exec = getattr(self.scheduler, "run_in_gap",
+                           self.scheduler.run_in_step)
+        sent = 0
+        while True:
+            ids, done, failed = await asyncio.to_thread(
+                transfer.wait_ready, sent, 1.0)
+            if failed:
+                yield {"error": f"transfer {transfer.transfer_id} aborted "
+                                "(prefill cancelled)"}
+                return
+            new = ids[sent:]
+            if not new and not done:
+                if time.monotonic() > overall:
+                    yield {"error": "streaming transfer timed out "
+                                    "awaiting prefill chunks"}
+                    return
+                continue
+            if new and budget is None:
+                overall = time.monotonic() + 120.0  # progress re-arms
+            if new:
+                resultq = gap_exec(
+                    lambda ids=new: self.runner.gather_pages_device(ids))
+                try:
+                    device_blocks, exc = await asyncio.to_thread(
+                        resultq.get, True, 60.0)
+                except Exception as exc_:  # noqa: BLE001 — queue.Empty
+                    yield {"error": f"gather timed out: {exc_!r}"}
+                    return
+                if exc is not None:
+                    yield {"error": f"gather failed: {exc!r}"}
+                    return
+                try:
+                    blocks = await asyncio.to_thread(_np.asarray,
+                                                     device_blocks)
+                except Exception as exc_:  # noqa: BLE001
+                    yield {"error": f"gather readback failed: {exc_!r}"}
+                    return
+                for frame in encode_block_chunks(blocks, layout, base=sent,
+                                                 total_pages=total):
+                    yield frame
+                sent += len(new)
+            if done and sent >= len(ids):
+                span.set_attribute("pages", sent)
+                span.set_attribute("bytes", sent * layout.page_bytes())
+                yield {"done": True, "first_token": transfer.first_token,
+                       "total_pages": total}
+                return
+
     # -- disaggregation: decode-side onboard -------------------------------
 
     async def _pull_remote_kv(self, params: dict, deadline=None,
                               traceparent=None, record_id=None):
-        """Pull prefill KV blocks from the prefill worker. Returns the
-        assembled bundle or None (caller falls back to local prefill —
-        the aggregated-recompute fallback the reference also takes when
-        transfer fails). `deadline` is the request's REMAINING end-to-end
-        budget (ctx.deadline): the pull's frame waits are bounded by it
-        instead of a fresh flat timeout. The pull leg is traced
+        """Pull prefill KV blocks from the prefill worker. Returns
+        (bundle, first_token), or (None, None) for the recompute fallback
+        (the aggregated fallback the reference also takes when transfer
+        fails). Streaming handoffs (docs/disaggregation.md) carry the
+        first token in the pull stream's terminal frame — the params dict
+        has none when the prefill pass was still running at dispatch.
+        `deadline` is the request's REMAINING end-to-end budget
+        (ctx.deadline): the pull's frame waits are bounded by it instead
+        of a fresh flat timeout. The pull leg is traced
         (kv_transfer.pull, with link/bytes/pages attributes) and recorded
         on the request's flight-recorder timeline."""
         from ..runtime.otel import get_tracer
 
         if params.get("mock") or "layout" not in params:
-            return None  # mocker handoff carries no data; recompute
+            return None, None  # mocker handoff carries no data; recompute
         link = ("ici" if self.ici_bridge is not None
                 and params.get("bridge_token") == self.ici_bridge.token
                 else "dcn")
@@ -755,11 +919,13 @@ class TpuWorker:
             "kv_transfer.pull", parent=traceparent, kind=3,
             **{"transfer.id": params.get("transfer_id", ""), "link": link})
         try:
-            blocks = await self._pull_remote_kv_inner(
+            blocks, first = await self._pull_remote_kv_inner(
                 params, deadline, span, traceparent, record_id, link)
+            if first is None:
+                first = params.get("first_token")
             if blocks is not None:
                 span.end(ok=True)
-            return blocks
+            return blocks, first
         finally:
             span.end(ok=False)  # fallback paths; success already ended
 
@@ -772,18 +938,18 @@ class TpuWorker:
             # Same process, co-meshed pools: direct chip-to-chip pull over
             # ICI (device bundle, no host relay). Any failure degrades to
             # the recompute fallback like the wire path.
-            blocks = await self.ici_bridge.pull(params["transfer_id"],
-                                                self.runner)
+            blocks, first = await self.ici_bridge.pull(
+                params["transfer_id"], self.runner)
             if blocks is not None:
                 get_recorder().event(record_id, "kv_pull", link="ici",
                                      transfer_id=params["transfer_id"])
-            return blocks
+            return blocks, first
         remote_layout = KvLayoutDescriptor.from_wire(params["layout"])
         local_layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
         if not remote_layout.compatible(local_layout):
             log.warning("kv layout mismatch (remote=%s local=%s); "
                         "recomputing prefill", remote_layout, local_layout)
-            return None
+            return None, None
         subject = f"{params['namespace']}/{params['component']}/kv_pull"
         router = self._pull_clients.get(subject)
         if router is None:
@@ -797,6 +963,7 @@ class TpuWorker:
             self._pull_clients[subject] = router
         assembler = BlockAssembler()
         pulled_bytes = 0
+        first_token = None
         start = time.monotonic()
         try:
             async for frame in router.generate(
@@ -807,15 +974,20 @@ class TpuWorker:
             ):
                 if frame.get("error"):
                     log.warning("kv pull failed: %s", frame["error"])
-                    return None
+                    return None, None
+                if frame.get("done"):
+                    # Streaming handoff terminal frame: the first sampled
+                    # token, produced after the last chunk we overlapped.
+                    first_token = frame.get("first_token")
+                    continue
                 pulled_bytes += len(frame.get("data") or b"")
                 assembler.add(frame)
         except Exception:  # noqa: BLE001 — any transfer failure -> recompute
             log.exception("kv pull transport failure; recomputing prefill")
-            return None
+            return None, None
         if not assembler.complete:
             log.warning("kv pull incomplete; recomputing prefill")
-            return None
+            return None, None
         blocks, _ = assembler.assemble()
         span.set_attribute("bytes", pulled_bytes)
         span.set_attribute("pages", int(blocks.shape[0]))
@@ -837,10 +1009,10 @@ class TpuWorker:
                     self.runner.mesh,
                     head_sharded=not self.runner.model_config.is_mla))
             await asyncio.to_thread(_jax.block_until_ready, dev)
-            return dev
+            return dev, first_token
         except Exception:  # noqa: BLE001 — host bundle still works
             log.exception("onboard H2D staging failed; using host bundle")
-            return blocks
+            return blocks, first_token
 
     def _publish_spec_metrics(self) -> None:
         """Mirror the scheduler's speculative-decoding totals onto the
@@ -980,16 +1152,21 @@ class TpuWorker:
                     prefill_only=True,
                     on_prefill_done=self._register_transfer,
                 )
+                if self.disagg_pipeline > 0:
+                    # Chunked handoff: stream transfer params + pages per
+                    # chunk so the decode side pulls while we compute.
+                    submit_kwargs.update(
+                        on_prefill_chunk=self._stream_transfer_chunk)
             elif request.disaggregated_params:
-                blocks = await self._pull_remote_kv(
+                blocks, first_token = await self._pull_remote_kv(
                     request.disaggregated_params,
                     deadline=ctx.deadline if ctx is not None else None,
                     traceparent=worker_span.traceparent or traceparent,
                     record_id=rec_id)
-                if blocks is not None:
+                if blocks is not None and first_token is not None:
                     submit_kwargs.update(
                         onboard_blocks=blocks,
-                        onboard_first_token=request.disaggregated_params["first_token"],
+                        onboard_first_token=first_token,
                     )
                 # else: fall through — plain submit recomputes the prefill
 

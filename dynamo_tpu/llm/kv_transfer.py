@@ -76,6 +76,85 @@ class PendingTransfer:
     prompt_len: int
     created_at: float = dataclasses.field(default_factory=time.monotonic)
 
+    @property
+    def streaming(self) -> bool:
+        return False
+
+
+class StreamingTransfer(PendingTransfer):
+    """A transfer registered while its prompt is STILL PREFILLING
+    (disagg chunked handoff, docs/disaggregation.md): the prefill
+    scheduler appends page ids per completed chunk and finishes with the
+    first sampled token; the pull side waits on the chunk condition and
+    streams pages as they become ready — chunk i moves while chunk i+1
+    computes.
+
+    Thread model: append/finish/fail run on the prefill scheduler thread,
+    wait_ready on a puller thread (asyncio.to_thread). One condition
+    serializes them. `fail` claims the table entry itself so release runs
+    exactly once whether or not a puller ever arrived."""
+
+    def __init__(self, *args, table: "PendingTransferTable", **kwargs):
+        super().__init__(*args, **kwargs)
+        self._table = table
+        self._cond = threading.Condition()
+        self.done = False
+        self.failed = False
+        self.first_token: Optional[int] = None
+
+    @property
+    def streaming(self) -> bool:
+        return True
+
+    @property
+    def total_pages(self) -> int:
+        return -(-self.prompt_len // self.layout.page_size)
+
+    def append_pages(self, page_ids: list[int]) -> None:
+        with self._cond:
+            self.page_ids.extend(int(p) for p in page_ids)
+            self._cond.notify_all()
+
+    def finish(self, first_token: int, all_page_ids: list[int]) -> None:
+        """Prompt pass complete: pin the final page list (including the
+        partial last page) and publish the first sampled token. The TTL
+        clock restarts HERE — it started at the first chunk, and a
+        prompt that legitimately prefilled longer than ttl_secs must not
+        become expirable the instant it completes (racing a decode pull
+        that is still being retried)."""
+        with self._cond:
+            self.page_ids = [int(p) for p in all_page_ids]
+            self.first_token = int(first_token)
+            self.done = True
+            self.created_at = time.monotonic()
+            self._cond.notify_all()
+
+    def fail(self) -> None:
+        """Prefill died mid-stream (cancel/error): wake waiters with the
+        failure and release the pages iff no puller claimed the entry."""
+        with self._cond:
+            self.failed = True
+            self._cond.notify_all()
+        if self._table.claim(self.transfer_id) is not None:
+            # We won the claim: no puller will ever release — we must.
+            self.release()
+
+    def wait_ready(self, have: int, timeout: float
+                   ) -> tuple[list[int], bool, bool]:
+        """Block until more than `have` pages are parked, the transfer is
+        done, or it failed. Returns (page_ids snapshot, done, failed);
+        a timeout returns the unchanged snapshot (caller re-checks its
+        deadline and loops)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (len(self.page_ids) <= have and not self.done
+                   and not self.failed):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(0.2, remaining))
+            return list(self.page_ids), self.done, self.failed
+
 
 class PendingTransferTable:
     """Prefill-side registry of sequences awaiting pull. Entries hold their
@@ -105,8 +184,13 @@ class PendingTransferTable:
     def expire_stale(self) -> int:
         now = time.monotonic()
         with self._lock:
+            # A streaming transfer whose prompt pass is still running is
+            # never stale: its pages belong to a live sequence (releasing
+            # them mid-prefill would hand them to another request). Abort
+            # is the scheduler's job (the on_prefill_chunk(None) hook).
             stale = [tid for tid, t in self._table.items()
-                     if now - t.created_at > self.ttl_secs]
+                     if now - t.created_at > self.ttl_secs
+                     and not (t.streaming and not getattr(t, "done", True))]
             claimed = [self._table.pop(tid) for tid in stale]
         for transfer in claimed:
             transfer.release()
@@ -120,10 +204,18 @@ class PendingTransferTable:
 def encode_block_chunks(
     blocks: np.ndarray,  # [n, L, 2, ps, kh, hd] universal layout
     layout: KvLayoutDescriptor,
+    base: int = 0,
+    total_pages: Optional[int] = None,
 ) -> Iterator[dict]:
     """Chunk a block bundle into wire frames: msgpack dicts with raw bytes.
     Chunk size targets TRANSFER_CHUNK_BYTES so large prompts stream instead
-    of building one giant frame."""
+    of building one giant frame.
+
+    Streaming handoffs (docs/disaggregation.md) encode SLICES of the full
+    transfer as chunks become ready: `base` is the absolute page offset of
+    this bundle and `total_pages` the final page count — the assembler
+    then tracks completeness by pages instead of chunk count (the chunk
+    count is unknowable while prefill is still running)."""
     n = blocks.shape[0]
     pages_per_chunk = max(1, TRANSFER_CHUNK_BYTES // max(1, layout.page_bytes()))
     total_chunks = -(-n // pages_per_chunk)
@@ -131,37 +223,52 @@ def encode_block_chunks(
         lo = ci * pages_per_chunk
         hi = min(n, lo + pages_per_chunk)
         part = np.ascontiguousarray(blocks[lo:hi])
-        yield {
+        frame = {
             "chunk": ci,
             "total_chunks": total_chunks,
-            "page_start": lo,
+            "page_start": base + lo,
             "page_count": hi - lo,
             "layout": layout.to_wire(),
             "data": part.tobytes(),
         }
+        if total_pages is not None:
+            frame["total_pages"] = total_pages
+        yield frame
 
 
 class BlockAssembler:
-    """Decode-side reassembly of pulled chunks into one bundle array."""
+    """Decode-side reassembly of pulled chunks into one bundle array.
+    Completeness: `total_pages` frames (streaming handoff) complete when
+    every page arrived; classic frames complete at `total_chunks` frames."""
 
     def __init__(self) -> None:
-        self._chunks: dict[int, tuple[int, int, bytes]] = {}
+        self._chunks: dict[int, tuple[int, int, bytes]] = {}  # by page_start
         self._layout: Optional[KvLayoutDescriptor] = None
         self._total: Optional[int] = None
+        self._total_pages: Optional[int] = None
 
     def add(self, frame: dict) -> None:
         layout = KvLayoutDescriptor.from_wire(frame["layout"])
         if self._layout is None:
             self._layout = layout
-            self._total = frame["total_chunks"]
         elif not self._layout.compatible(layout):
             raise ValueError("layout changed mid-transfer")
-        self._chunks[frame["chunk"]] = (
+        if frame.get("total_pages") is not None:
+            self._total_pages = int(frame["total_pages"])
+        else:
+            self._total = frame["total_chunks"]
+        self._chunks[frame["page_start"]] = (
             frame["page_start"], frame["page_count"], frame["data"]
         )
 
     @property
+    def pages(self) -> int:
+        return sum(c[1] for c in self._chunks.values())
+
+    @property
     def complete(self) -> bool:
+        if self._total_pages is not None:
+            return self.pages >= self._total_pages
         return self._total is not None and len(self._chunks) == self._total
 
     def assemble(self) -> tuple[np.ndarray, KvLayoutDescriptor]:
@@ -170,7 +277,7 @@ class BlockAssembler:
         layout = self._layout
         shape_tail = (layout.n_layers, 2, layout.page_size, layout.kv_heads,
                       layout.head_dim)
-        n = sum(c[1] for c in self._chunks.values())
+        n = self.pages
         out = np.empty((n,) + shape_tail, np.dtype(layout.dtype))
         for start, count, data in self._chunks.values():
             out[start : start + count] = np.frombuffer(

@@ -47,6 +47,42 @@ class PrefillRouterEngine(TokenEngine):
     ) -> None:
         self.inner = inner
         self.pool_lookup = pool_lookup
+        # Background drains of still-running streaming prefill legs
+        # (docs/disaggregation.md): the decode leg dispatches as soon as
+        # the FIRST chunk's transfer params arrive, but the prefill
+        # stream must keep being consumed (closing it would cancel the
+        # prefill worker's request mid-prompt).
+        self._drains: set = set()
+
+    def _drain_prefill_leg(self, agen, span, request_id: str) -> None:
+        """Consume the rest of a streaming prefill leg in the background.
+        The decode side is already pulling; an error here needs no
+        handling — the pull stream fails and the decode worker recomputes
+        (the same fallback every transfer failure takes)."""
+        import asyncio
+
+        async def _drain() -> None:
+            ok = False
+            try:
+                async for item in agen:
+                    out = EngineOutput.from_wire(item)
+                    if out.error:
+                        log.warning("streaming prefill leg error for %s: %s",
+                                    request_id, out.error)
+                        return
+                    if out.finish_reason is not None:
+                        ok = True
+                        return
+            except Exception as exc:  # noqa: BLE001 — decode side
+                # recomputes via the failed pull; nothing to surface here
+                log.warning("streaming prefill leg failed for %s (%r)",
+                            request_id, exc)
+            finally:
+                span.end(ok=ok)
+
+        task = asyncio.create_task(_drain())
+        self._drains.add(task)
+        task.add_done_callback(self._drains.discard)
 
     async def _run_prefill(
         self, pool: PrefillPool, request: PreprocessedRequest
@@ -79,22 +115,38 @@ class PrefillRouterEngine(TokenEngine):
                 target = int(str(raw), 16)
             except ValueError:
                 log.warning("bad prefill_instance annotation %r", raw)
+        streaming = False
+        # The prefill leg draws on the request's REMAINING budget
+        # (router re-encodes it per attempt) — a slow prefill pool
+        # can no longer eat more than the end-to-end deadline.
+        agen = pool.router.generate(prefill_request.to_wire(),
+                                    instance_id=target,
+                                    deadline=request.deadline,
+                                    traceparent=leg_tp)
         try:
-            # The prefill leg draws on the request's REMAINING budget
-            # (router re-encodes it per attempt) — a slow prefill pool
-            # can no longer eat more than the end-to-end deadline.
-            async for item in pool.router.generate(prefill_request.to_wire(),
-                                                   instance_id=target,
-                                                   deadline=request.deadline,
-                                                   traceparent=leg_tp):
+            async for item in agen:
                 out = EngineOutput.from_wire(item)
                 if out.error:
                     log.warning("prefill worker error for %s: %s",
                                 request.request_id, out.error)
                     return None
                 if out.kv_transfer_params is not None:
+                    params = out.kv_transfer_params
+                    if params.get("streaming") \
+                            and "first_token" not in params:
+                        # Chunked handoff (docs/disaggregation.md): the
+                        # prefill worker streamed transfer params after
+                        # its FIRST chunk. Dispatch the decode leg NOW —
+                        # it starts pulling parked chunks while later
+                        # chunks compute — and keep consuming the prefill
+                        # stream in the background (closing it would
+                        # cancel the prefill request mid-prompt).
+                        streaming = True
+                        self._drain_prefill_leg(agen, span,
+                                                request.request_id)
+                        return params
                     span.end(ok=True)
-                    return out.kv_transfer_params
+                    return params
         except DeadlineExceeded:
             # No budget left: the decode leg could not finish either —
             # surface the overrun instead of burning a recompute.
@@ -108,8 +160,10 @@ class PrefillRouterEngine(TokenEngine):
         finally:
             # Fallback paths (error output, transport failure, no params)
             # close the span ok=False; the success return above already
-            # ended it ok=True (first end wins).
-            span.end(ok=False)
+            # ended it ok=True (first end wins). A streaming leg keeps
+            # its span open — the background drain closes it.
+            if not streaming:
+                span.end(ok=False)
         return None
 
     async def generate(

@@ -66,6 +66,12 @@ class MockerConfig:
     spec_k: int = 0
     spec_acceptance: float = 0.0
     spec_verify_overhead: float = 0.15
+    # Disagg KV handoff cost (host-relay DCN / ICI): time to move one KV
+    # block prefill->decode. Consumed by the offline replay's transfer
+    # timeline (loadgen._transfer_delay_s): serial handoffs pay it in
+    # full after the prompt pass, the chunked pipeline only for the
+    # unoverlapped tail. 0 = free transfers (the pre-overlap model).
+    kv_transfer_us_per_block: float = 0.0
 
     @classmethod
     def from_timing_preset(cls, name: str, **overrides) -> "MockerConfig":
@@ -93,6 +99,10 @@ TIMING_PRESETS: dict[str, dict] = {
         # 1024 on the v5e chip -> 113 us/token.
         prefill_us_per_token=113.0,
         block_size=16,
+        # Host-relay DCN handoff: a qwen3-0.6b universal block (28 layers
+        # x 2 x 16 tok x 8 kv heads x 128 hd x bf16 ~= 1.75 MiB) over a
+        # ~4.5 GB/s host relay -> ~400 us/block.
+        kv_transfer_us_per_block=400.0,
     ),
     # Speculative-worker profile (ROADMAP item 1: router/planner layers
     # must see speculation in chip-free scenario tests): the same
@@ -245,6 +255,7 @@ class _Sequence:
     done: bool = False
     cancelled: bool = False
     pinned: list[int] = dataclasses.field(default_factory=list)
+    prefill_chunks: int = 0  # steps that advanced this prompt (chunking)
 
 
 class MockerEngine:
@@ -516,6 +527,7 @@ class MockerEngine:
             if chunk <= 0:
                 break
             seq.prefilled_tokens += chunk
+            seq.prefill_chunks += 1
             total += chunk
         return total
 
@@ -569,6 +581,11 @@ class MockerEngine:
                     kv_transfer_params={
                         "mock": True, "first_token": first,
                         "prompt_len": len(req.token_ids),
+                        # Transfer-timeline inputs for the offline
+                        # replay's handoff model (loadgen).
+                        "prompt_blocks": -(-len(req.token_ids)
+                                           // self.config.block_size),
+                        "chunks": seq.prefill_chunks,
                     },
                 ).to_wire()))
                 deliveries.append((seq.queue, None))

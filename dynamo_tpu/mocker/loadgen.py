@@ -229,6 +229,7 @@ class OfflineReplay:
         router_policy: str = "round_robin",  # round_robin | kv
         config: Optional[MockerConfig] = None,
         time_scale: Optional[float] = None,
+        disagg_pipeline: bool = True,
     ) -> None:
         assert mode in ("single", "agg", "disagg")
         assert router_policy in ("round_robin", "kv")
@@ -255,7 +256,37 @@ class OfflineReplay:
              for i in range(num_prefill_workers)]
             if mode == "disagg" else []
         )
+        self.disagg_pipeline = disagg_pipeline
         self._rr = 0
+
+    def _transfer_delay_s(self, params: dict, isl: int) -> float:
+        """Model the prefill->decode KV handoff on the replay timeline
+        (kv_transfer_us_per_block > 0). A SERIAL handoff moves every
+        block after the prompt pass finishes, so the decode leg waits the
+        full transfer. The chunked PIPELINE (docs/disaggregation.md)
+        overlaps chunk i's transfer with chunk i+1's compute, exposing
+        only the tail:
+
+            residual = max(t_chunk, total_t - (n-1) * c_chunk)
+
+        (t_chunk = per-chunk transfer, c_chunk = per-chunk compute) —
+        a compute-bound pipeline exposes one chunk's transfer, a
+        transfer-bound one its backlog. Scaled by the speedup ratio like
+        every other modeled cost."""
+        cfg = self.config
+        if cfg.kv_transfer_us_per_block <= 0:
+            return 0.0
+        blocks = int(params.get("prompt_blocks")
+                     or -(-isl // cfg.block_size))
+        total = blocks * cfg.kv_transfer_us_per_block / 1e6
+        if not self.disagg_pipeline:
+            delay = total
+        else:
+            n = max(1, int(params.get("chunks") or 1))
+            t_chunk = total / n
+            c_chunk = (isl / n) * cfg.prefill_us_per_token / 1e6
+            delay = min(total, max(t_chunk, total - (n - 1) * c_chunk))
+        return delay / max(1e-6, cfg.speedup_ratio)
 
     def _pick_engine(self, token_ids: list[int]):
         """Returns (engine, selection) — selection non-None only under the
@@ -305,6 +336,9 @@ class OfflineReplay:
                     if kv is not None:
                         params = kv
                 if params is not None:
+                    delay = self._transfer_delay_s(params, record.isl)
+                    if delay > 0:
+                        await asyncio.sleep(delay)
                     request.disaggregated_params = params
             engine, selection = self._pick_engine(token_ids)
             if selection is not None:
@@ -405,6 +439,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
                      help="per-draft-position acceptance probability for "
                           "the speculative-worker profile (overrides the "
                           "preset's value)")
+    rep.add_argument("--kv-transfer-us-per-block", type=float, default=None,
+                     help="disagg KV handoff cost per block (overrides "
+                          "the preset; 0 = free transfers)")
+    rep.add_argument("--serial-disagg", action="store_true",
+                     help="disable the chunked handoff pipeline in disagg "
+                          "mode: the decode leg waits for the FULL KV "
+                          "transfer after the prompt pass (the "
+                          "pre-overlap behavior, for A/B comparison)")
 
     args = parser.parse_args(argv)
     if args.cmd == "synthesize":
@@ -427,6 +469,8 @@ async def main(argv: Optional[list[str]] = None) -> None:
         # Independent of --spec-k so a preset's k can be kept while
         # sweeping acceptance (the low-repetition sweep).
         overrides["spec_acceptance"] = args.spec_acceptance
+    if args.kv_transfer_us_per_block is not None:
+        overrides["kv_transfer_us_per_block"] = args.kv_transfer_us_per_block
     if args.timing_preset:
         config = MockerConfig.from_timing_preset(args.timing_preset,
                                                  **overrides)
@@ -442,6 +486,7 @@ async def main(argv: Optional[list[str]] = None) -> None:
         num_prefill_workers=args.prefill_workers,
         router_policy=args.router_policy,
         config=config,
+        disagg_pipeline=not args.serial_disagg,
     )
     report = await replayer.run(records)
     print(json.dumps(report.summary()))

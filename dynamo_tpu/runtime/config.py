@@ -183,6 +183,44 @@ _register("DYNT_SPEC_BATCH_CUTOFF", 0, _int,
           "high batch the MXU is busy so the verification FLOPs stop "
           "being free. 0 disables the cutoff (speculate at any batch)")
 
+# KVBM offload overlap plane (block_manager/offload.py; docs/kvbm.md)
+_register("DYNT_OFFLOAD_BW_FRAC", 0.25, _float,
+          "Bandwidth budget for KVBM D2H offload: the fraction of wall "
+          "time the offload path may hold the scheduler thread with "
+          "device gathers. After a gather that took g seconds in-step, "
+          "the next gather is deferred g*(1/frac - 1) seconds, so "
+          "G2-active serving stays within budget of G2-idle. 0 disables "
+          "throttling (gathers run back-to-back, the pre-overlap "
+          "behavior)")
+_register("DYNT_OFFLOAD_SUBBATCH", 2, _int,
+          "Pages per in-step offload gather sub-batch: each offload "
+          "batch is split into sub-batches this size so a single gather "
+          "never holds the dispatch/drain gap for long; one sub-batch "
+          "bundle sinks to G2 while the next gathers (double buffering)")
+_register("DYNT_OFFLOAD_QUEUE_CAP", 4096, _int,
+          "Bound on the KVBM offload queue (blocks awaiting D2H). A "
+          "store burst past the cap drops the OLDEST queued blocks "
+          "(counted by dynamo_kvbm_offload_dropped_total) — offload is "
+          "best-effort cache population, never backpressure")
+
+# Disaggregated prefill pipeline (engine/scheduler.py + worker.py +
+# llm/prefill_router.py; docs/disaggregation.md)
+_register("DYNT_DISAGG_PIPELINE", 1, _int,
+          "Chunked streaming handoff for disaggregated prefill: any "
+          "non-zero value makes the prefill worker stream "
+          "kv_transfer_params after its FIRST chunk and park pages per "
+          "chunk, so the decode worker pulls chunk i while chunk i+1 "
+          "computes (the pull side drains chunks as fast as they land; "
+          "values above 1 are reserved for a future in-flight-chunk "
+          "bound). 0 disables streaming — the prefill leg returns "
+          "transfer params only after the whole prompt, the serial "
+          "pre-overlap behavior")
+_register("DYNT_DISAGG_CHUNK", 0, _int,
+          "Prefill tokens per streamed chunk for prefill-only sequences "
+          "(the disagg handoff granularity). 0 uses the engine's max "
+          "prefill chunk; smaller chunks start the KV handoff earlier "
+          "and overlap it finer, at more dispatches per prompt")
+
 # Router
 _register("DYNT_ROUTER_OVERLAP_WEIGHT", 1.0, _float,
           "KV router cost weight for prefix-overlap blocks "

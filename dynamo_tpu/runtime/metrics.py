@@ -147,6 +147,34 @@ SPEC_K = Gauge(
     "(0 = speculation idle or auto-disabled)",
     ["worker"], registry=REGISTRY,
 )
+# KVBM offload overlap plane (block_manager/offload.py): queue pressure
+# and bandwidth-budget behavior of the D2H offload path (docs/kvbm.md).
+KVBM_OFFLOAD_DROPPED = Counter(
+    "dynamo_kvbm_offload_dropped_total",
+    "Blocks dropped from the KVBM offload queue (store burst past "
+    "DYNT_OFFLOAD_QUEUE_CAP; oldest first — offload is best-effort)",
+    registry=REGISTRY,
+)
+KVBM_OFFLOAD_QUEUE_DEPTH = Gauge(
+    "dynamo_kvbm_offload_queue_depth",
+    "Blocks currently queued for KVBM D2H offload",
+    registry=REGISTRY,
+)
+KVBM_OFFLOAD_DEFERRED = Counter(
+    "dynamo_kvbm_offload_deferred_seconds_total",
+    "Seconds the offload worker spent deferring device gathers to honor "
+    "the DYNT_OFFLOAD_BW_FRAC bandwidth budget",
+    registry=REGISTRY,
+)
+# Disaggregated prefill pipeline (engine/worker.py): KV pages streamed
+# to the decode pool while the prefill pass was still computing — the
+# overlap the chunked handoff buys (docs/disaggregation.md).
+DISAGG_STREAMED_PAGES = Counter(
+    "dynamo_disagg_streamed_pages_total",
+    "KV pages parked for transfer before their prompt finished "
+    "prefilling (chunked disagg handoff; serial handoffs count 0 here)",
+    ["worker"], registry=REGISTRY,
+)
 # OTLP exporter health (runtime/otel.py): spans that reached the
 # collector vs spans lost to a full buffer or a failed export.
 OTEL_SPANS_EXPORTED = Counter(

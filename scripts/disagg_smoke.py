@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Overlap-plane smoke: pipelined disagg + bandwidth-budgeted offload.
+
+CI entrypoint (the `disagg-smoke` job), CPU/mocker-measurable proof of
+the two overlap claims (ISSUE 8 acceptance criteria):
+
+  1. **Pipelined disagg beats serial on TTFT at equal ITL.** Replay one
+     trace through the mocker xPyD profile (prefill pool + decode pool,
+     measured v5e step physics + a modeled per-block KV handoff cost)
+     twice — chunked pipeline on vs off — and assert the pipelined
+     replay's TTFT p50 is strictly lower while ITL p50 stays equal
+     (the handoff model only ever delays the first token).
+
+  2. **Offload-active decode stays within 20% of offload-idle.** Drive a
+     synthetic decode step loop (fixed per-step cost on the step thread,
+     gap-window drain between steps — the scheduler's shape) under a
+     continuous KVBM offload burst through the real OffloadManager, and
+     assert the budgeted manager (DYNT_OFFLOAD_BW_FRAC semantics) keeps
+     step throughput >= 80% of the offload-idle rate. The same scenario
+     with the budget disabled documents the collapse being prevented.
+
+Writes the scenario report JSON as a CI artifact; exits nonzero on any
+violated invariant.
+
+Usage: python scripts/disagg_smoke.py [--requests N] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import pathlib
+import queue as thread_queue
+import sys
+import threading
+import time
+
+# Runnable as `python scripts/disagg_smoke.py` from the repo root.
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+async def disagg_scenario(requests: int) -> dict:
+    from dynamo_tpu.mocker.engine import MockerConfig
+    from dynamo_tpu.mocker.loadgen import OfflineReplay, synthesize_trace
+
+    # Long prompts + moderate speedup keep the modeled handoff delta an
+    # order of magnitude above asyncio timer jitter, and the arrival
+    # rate sits below the prefill pool's service rate so queueing noise
+    # doesn't swamp the p50 (same operating point as bench.py's
+    # bench_disagg_point).
+    records = synthesize_trace(requests, rate_rps=5.0, isl_mean=4096,
+                               osl_mean=32, seed=11)
+    budget = sum(r.osl for r in records)
+    cfg = MockerConfig.from_timing_preset(
+        "tpu-v5e-qwen3-0.6b", speedup_ratio=10.0,
+        max_prefill_tokens_per_step=512,  # long prompts -> real chunking
+        # Cross-host DCN relay (~1 GB/s) rather than the preset's
+        # same-host 4.5 GB/s: the conservative inter-slice operating
+        # point, and it keeps the asserted TTFT gap an order of
+        # magnitude above replay scheduling noise.
+        kv_transfer_us_per_block=2000.0)
+
+    async def run(pipeline: bool) -> dict:
+        replay = OfflineReplay(mode="disagg", num_workers=2,
+                               num_prefill_workers=2,
+                               config=cfg, disagg_pipeline=pipeline)
+        return (await replay.run(records)).summary()
+
+    pipelined = await run(True)
+    serial = await run(False)
+    return {"pipelined": pipelined, "serial": serial,
+            "trace_output_tokens": budget,
+            "kv_transfer_us_per_block": cfg.kv_transfer_us_per_block}
+
+
+def offload_scenario(*, bw_frac: float, blocks: int = 48,
+                     step_ms: float = 4.0, gather_ms: float = 2.0,
+                     duration_s: float = 2.0) -> dict:
+    """Synthetic serving loop: the 'scheduler' thread runs fixed-cost
+    decode steps and drains submitted gather closures between them (the
+    run_in_gap shape); the OffloadManager feeds it a continuous store
+    burst. Steps/sec with the burst active vs idle measures exactly the
+    step-time the offload path steals."""
+    from dynamo_tpu.block_manager.offload import OffloadManager
+
+    gap_q: thread_queue.Queue = thread_queue.Queue()
+    stop = threading.Event()
+    steps = {"n": 0}
+
+    def step_loop() -> None:
+        while not stop.is_set():
+            time.sleep(step_ms / 1e3)  # the decode step (device busy)
+            steps["n"] += 1
+            while True:  # gap drain
+                try:
+                    fn = gap_q.get_nowait()
+                except thread_queue.Empty:
+                    break
+                fn()
+
+    def run_in_gap(fn):
+        out: thread_queue.Queue = thread_queue.Queue(1)
+
+        def wrapped():
+            try:
+                out.put((fn(), None))
+            except Exception as exc:  # noqa: BLE001
+                out.put((None, exc))
+
+        gap_q.put(wrapped)
+        return out
+
+    def gather(ids):
+        time.sleep(gather_ms / 1e3)  # modeled device-gather cost in-step
+        return [0] * len(ids)
+
+    # Idle rate first.
+    thread = threading.Thread(target=step_loop, daemon=True)
+    thread.start()
+    t0 = time.monotonic()
+    time.sleep(duration_s / 2)
+    idle_rate = steps["n"] / (time.monotonic() - t0)
+
+    mgr = OffloadManager(
+        lookup_pages=lambda hs: [1 + (h % 7) for h in hs],
+        gather=gather, run_in_step=run_in_gap,
+        sink=lambda h, b, p: None,
+        batch_size=4, subbatch=2, bw_frac=bw_frac, queue_cap=4096,
+    )
+    base = steps["n"]
+    t1 = time.monotonic()
+    seq = 0
+    while time.monotonic() - t1 < duration_s:
+        mgr.notify_stored(list(range(seq, seq + blocks)), parent=None)
+        seq += blocks
+        time.sleep(0.05)
+    active_rate = (steps["n"] - base) / (time.monotonic() - t1)
+    mgr.close()
+    stop.set()
+    thread.join(timeout=5)
+    return {"bw_frac": bw_frac,
+            "idle_steps_per_s": round(idle_rate, 1),
+            "active_steps_per_s": round(active_rate, 1),
+            "active_vs_idle": round(active_rate / max(idle_rate, 1e-9), 3)}
+
+
+async def run(out_dir: pathlib.Path, requests: int) -> int:
+    disagg = await disagg_scenario(requests)
+    offload = offload_scenario(bw_frac=0.2)
+    offload_unbudgeted = offload_scenario(bw_frac=0.0)
+
+    report = {"disagg": disagg, "offload": offload,
+              "offload_unbudgeted": offload_unbudgeted}
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "disagg-smoke.json").write_text(json.dumps(report, indent=2))
+
+    failures = []
+    pipe, serial = disagg["pipelined"], disagg["serial"]
+    if pipe["errors"] or serial["errors"]:
+        failures.append(f"replay errors: pipelined={pipe['errors']} "
+                        f"serial={serial['errors']}")
+    if pipe["output_tokens"] != disagg["trace_output_tokens"]:
+        failures.append(
+            f"pipelined replay emitted {pipe['output_tokens']} tokens, "
+            f"trace budget is {disagg['trace_output_tokens']}")
+    if not pipe["ttft_ms"]["p50"] < serial["ttft_ms"]["p50"]:
+        failures.append(
+            f"pipelined disagg TTFT p50 {pipe['ttft_ms']['p50']}ms is not "
+            f"strictly better than serial {serial['ttft_ms']['p50']}ms")
+    # "Equal ITL": the handoff model only delays first tokens, so decode
+    # cadence must match within a generous scheduling-noise band — 15%
+    # relative with a 0.25ms absolute floor (at 50x replay speedup the
+    # modeled ITL is sub-ms and asyncio timer jitter dominates below it).
+    s_itl = serial["itl_ms"]["p50"]
+    if abs(pipe["itl_ms"]["p50"] - s_itl) > max(0.15 * s_itl, 0.25):
+        failures.append(
+            f"ITL p50 diverged: pipelined {pipe['itl_ms']['p50']}ms vs "
+            f"serial {s_itl}ms (not an equal-ITL comparison)")
+    if offload["active_vs_idle"] < 0.8:
+        failures.append(
+            f"budgeted offload-active throughput is "
+            f"{offload['active_vs_idle']:.0%} of idle (< 80% target)")
+
+    print(json.dumps(report, indent=2))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"disagg-smoke OK: TTFT p50 {pipe['ttft_ms']['p50']}ms pipelined "
+          f"vs {serial['ttft_ms']['p50']}ms serial at ITL p50 "
+          f"{pipe['itl_ms']['p50']}/{s_itl}ms; offload-active decode at "
+          f"{offload['active_vs_idle']:.0%} of idle (unbudgeted: "
+          f"{offload_unbudgeted['active_vs_idle']:.0%})")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("disagg_smoke")
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--out", default="disagg-smoke")
+    args = parser.parse_args()
+    return asyncio.run(run(pathlib.Path(args.out), args.requests))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

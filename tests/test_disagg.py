@@ -254,6 +254,214 @@ class TestRealEngineDisagg:
         run(body(), timeout=300)
 
 
+class TestStreamingDisagg:
+    """Chunked-prefill parity tier (ISSUE 8): with prompts spanning
+    several prefill chunks, the streaming handoff (kv_transfer_params
+    after the FIRST chunk, pages parked per chunk, first token in the
+    pull stream's terminal frame) must produce token streams
+    bit-identical to the aggregated path — and actually stream."""
+
+    @staticmethod
+    async def _pair(rt, prefill_buckets=(8,)):
+        rcfg = RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                            max_pages_per_seq=16,
+                            prefill_buckets=prefill_buckets)
+        prefill_w = TpuWorker(rt, model_name="tiny-test",
+                              component="prefill", mode="prefill",
+                              runner_config=rcfg, warmup=False)
+        decode_w = TpuWorker(rt, model_name="tiny-test",
+                             component="backend", mode="decode",
+                             runner_config=rcfg, warmup=False)
+        await prefill_w.start()
+        await decode_w.start()
+        decode_router = PushRouter(
+            rt.namespace("dynamo").component("backend")
+              .endpoint("generate").client(), mode="round_robin")
+        await decode_router.client.start()
+        prefill_router = PushRouter(
+            rt.namespace("dynamo").component("prefill")
+              .endpoint("generate").client(), mode="round_robin")
+        await prefill_router.client.start()
+        pool = PrefillPool(router=prefill_router,
+                           instances={prefill_w.instance_id})
+        inner = RouterEngine(decode_router)
+        engine = PrefillRouterEngine(inner, lambda: pool)
+        closers = (decode_router, prefill_router, prefill_w, decode_w)
+        return prefill_w, inner, engine, closers
+
+    @staticmethod
+    async def _teardown(rt, closers):
+        decode_router, prefill_router, prefill_w, decode_w = closers
+        await decode_router.client.close()
+        await prefill_router.client.close()
+        await prefill_w.close()
+        await decode_w.close()
+        await rt.shutdown()
+
+    def test_chunked_stream_matches_aggregated(self, run,
+                                               mem_runtime_config):
+        """30-token prompt at max chunk 8 = 4 chunks: the handoff
+        streams (pages parked mid-prefill, params emitted early) and the
+        greedy AND sampled streams equal the aggregated ones exactly."""
+
+        async def body():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            prefill_w, inner, engine, closers = await self._pair(rt)
+            prompt = list(range(30, 60))  # 30 tokens: partial last page
+            for temperature in (0.0, 0.8):
+                agg = await _collect(
+                    inner, _request(prompt, temperature=temperature))
+                dis = await _collect(
+                    engine, _request(prompt, temperature=temperature))
+                assert agg == dis, (temperature, agg, dis)
+            # the handoff genuinely streamed: pages parked before the
+    	    # prompt finished prefilling
+            assert prefill_w.scheduler.stats.disagg_streamed_pages > 0
+            # prefill pool pages were released after the pulls
+            for _ in range(50):
+                if len(prefill_w.transfers) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(prefill_w.transfers) == 0
+            await self._teardown(rt, closers)
+
+        run(body(), timeout=300)
+
+    def test_serial_handoff_when_pipeline_disabled(self, run,
+                                                   mem_runtime_config,
+                                                   monkeypatch):
+        """DYNT_DISAGG_PIPELINE=0 restores the serial handoff: identical
+        output, no streamed pages."""
+        monkeypatch.setenv("DYNT_DISAGG_PIPELINE", "0")
+
+        async def body():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            prefill_w, inner, engine, closers = await self._pair(rt)
+            prompt = list(range(30, 60))
+            agg = await _collect(inner, _request(prompt))
+            dis = await _collect(engine, _request(prompt))
+            assert agg == dis
+            assert prefill_w.scheduler.stats.disagg_streamed_pages == 0
+            await self._teardown(rt, closers)
+
+        run(body(), timeout=300)
+
+    def test_mid_stream_release_defers_until_sequence_stops(self, run):
+        """A puller dying mid-stream calls transfer.release() while the
+        prompt pass is STILL RUNNING. The pages must not return to the
+        pool until the sequence stops stepping (a new request allocating
+        them would be corrupted by the remaining chunks' KV writes):
+        release cancels the sequence and reap frees the pages exactly
+        once — the pool never over-frees."""
+
+        async def body():
+            rcfg = RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                                max_pages_per_seq=16, prefill_buckets=(8,))
+            worker = TpuWorker(None, model_name="tiny-test",
+                               component="prefill", mode="prefill",
+                               runner_config=rcfg, warmup=False)
+            await worker.prepare()
+            sched = worker.scheduler
+
+            def usable():
+                return sched.pool.free_count() + sched.pool.cached_count()
+
+            before = usable()
+            outputs = []
+
+            def emit(out):
+                outputs.append(out)
+                if out.kv_transfer_params is not None \
+                        and out.finish_reason is None:
+                    # The "puller": claim on arrival, die immediately.
+                    t = worker.transfers.claim(
+                        out.kv_transfer_params["transfer_id"])
+                    assert t is not None
+                    t.release()  # mid-prefill — must NOT free pages yet
+
+            req = _request(list(range(30, 62)), max_tokens=1)
+            sched.submit(req, emit, prefill_only=True,
+                         on_prefill_done=worker._register_transfer,
+                         on_prefill_chunk=worker._stream_transfer_chunk)
+            # The cleanup conditions below are vacuously true before the
+            # request is admitted — wait for its terminal frame FIRST.
+            for _ in range(400):
+                if any(o.finish_reason is not None for o in outputs):
+                    break
+                await asyncio.sleep(0.05)
+            for _ in range(200):
+                if (usable() >= before and len(worker.transfers) == 0
+                        and not worker._stream_transfers
+                        and all(s is None for s in sched._slots)):
+                    break
+                await asyncio.sleep(0.05)
+            assert not worker._stream_transfers
+            assert len(worker.transfers) == 0
+            # released exactly once: the pool is whole, never over-freed
+            assert usable() == before, (usable(), before)
+            # the prefill leg's stream got a terminal frame (a silent
+            # drop would hang the router's background drain)
+            assert any(o.finish_reason == "cancelled" for o in outputs), \
+                [(o.finish_reason, o.error) for o in outputs]
+            await worker.close()
+
+        run(body(), timeout=300)
+
+    def test_stream_abort_on_cancel_releases_pages(self, run):
+        """A prefill-only sequence cancelled mid-stream must fail its
+        StreamingTransfer (waking any puller) and release the parked
+        pages exactly once — the reap-time abort hook. The cancel fires
+        from inside the first streamed params emit, so it lands
+        deterministically between chunks."""
+
+        async def body():
+            rcfg = RunnerConfig(page_size=4, num_pages=64, max_batch=2,
+                                max_pages_per_seq=16, prefill_buckets=(8,))
+            worker = TpuWorker(None, model_name="tiny-test",
+                               component="prefill", mode="prefill",
+                               runner_config=rcfg, warmup=False)
+            await worker.prepare()
+            sched = worker.scheduler
+            def usable():
+                # released pages may land in the prefix cache (computed
+                # KV is cacheable) — usable capacity = free + evictable
+                return sched.pool.free_count() + sched.pool.cached_count()
+
+            free_before = usable()
+            outputs = []
+            handle_box = {}
+
+            def emit(out):
+                outputs.append(out)
+                if out.kv_transfer_params is not None \
+                        and out.finish_reason is None:
+                    # First streamed chunk params: cancel mid-stream, on
+                    # the scheduler thread (deterministic).
+                    handle_box["h"].cancel()
+
+            req = _request(list(range(30, 62)), max_tokens=1)
+            handle_box["h"] = sched.submit(
+                req, emit, prefill_only=True,
+                on_prefill_done=worker._register_transfer,
+                on_prefill_chunk=worker._stream_transfer_chunk)
+            for _ in range(200):
+                if (usable() >= free_before
+                        and len(worker.transfers) == 0
+                        and not worker._stream_transfers
+                        and sched.stats.disagg_streamed_pages > 0):
+                    break
+                await asyncio.sleep(0.05)
+            assert sched.stats.disagg_streamed_pages > 0
+            assert len(worker.transfers) == 0
+            assert not worker._stream_transfers
+            assert usable() >= free_before
+            # no finish frame was emitted for the cancelled sequence
+            assert not any(o.finish_reason == "stop" for o in outputs)
+            await worker.close()
+
+        run(body(), timeout=300)
+
+
 class TestMockerDisaggE2E:
     def test_frontend_routes_through_prefill_pool(self, run):
         """Frontend + decode mockers + a prefill mocker: requests flow

@@ -139,6 +139,47 @@ def test_overlap_admission_and_prefill_with_inflight_blocks():
     assert col_b.tokens() == ref_b
 
 
+def test_cross_sequence_prefill_batching_streams_identical():
+    """Two prompts submitted together prefill their chunks in ONE
+    batched dispatch (prefill_chunk_batch, the small-model MFU shape
+    fix) — and the streams stay bit-identical to sequential submission
+    (the sampler is row-independent)."""
+    # Reference: each request alone on a fresh engine (order-free).
+    refs = []
+    for prompt, n in ((PROMPT_A, 8), (PROMPT_B, 8)):
+        runner = _runner()
+        sched = InferenceScheduler(runner)
+        sched.decode_block = 1
+        sched.start()
+        col = _Collect()
+        try:
+            sched.submit(_request(prompt, n), col)
+            _wait([col])
+        finally:
+            sched.stop()
+        assert col.finish == "length"
+        refs.append(col.tokens())
+
+    runner = _runner()
+    sched = InferenceScheduler(runner)
+    sched.decode_block = 1
+    col_a, col_b = _Collect(), _Collect()
+    try:
+        # Submit BEFORE starting the loop: both admit in the first
+        # iteration, so their chunks deterministically share one
+        # batched dispatch.
+        sched.submit(_request(PROMPT_A, 8), col_a)
+        sched.submit(_request(PROMPT_B, 8), col_b)
+        sched.start()
+        _wait([col_a, col_b])
+    finally:
+        sched.stop()
+    assert col_a.finish == col_b.finish == "length"
+    assert sched.stats.prefill_batched_steps >= 1, sched.stats
+    assert col_a.tokens() == refs[0]
+    assert col_b.tokens() == refs[1]
+
+
 def test_fused_block_with_prefill_pending_streams_identical():
     """Two requests staggered so one decodes while the other prefills:
     block mode must fuse (not bail to per-token) and still match the

@@ -114,6 +114,203 @@ class TestOffloadOverlap:
         assert in_step_time["total"] < 0.1, in_step_time["total"]
 
 
+class TestOffloadBudget:
+    """Bandwidth-budget + double-buffer + bounded-queue behavior of the
+    reworked OffloadManager (docs/kvbm.md overlap discipline)."""
+
+    @staticmethod
+    def _executor(record=None):
+        """Inline 'scheduler thread' executor that runs closures
+        immediately (timestamps optional)."""
+        def run_in_step(fn):
+            out: thread_queue.Queue = thread_queue.Queue(1)
+            try:
+                if record is not None:
+                    record.append(("gather_exec", time.perf_counter()))
+                out.put((fn(), None))
+            except Exception as exc:  # noqa: BLE001
+                out.put((None, exc))
+            return out
+
+        return run_in_step
+
+    def test_bw_budget_bounds_in_step_fraction(self):
+        """With gathers costing g on the step thread and frac=0.25, the
+        manager must spend >= 3x the total gather time idling between
+        gathers — the steal fraction stays near the budget."""
+        gather_ms = 5.0
+        in_step = {"total": 0.0}
+
+        def gather(ids):
+            time.sleep(gather_ms / 1e3)
+            in_step["total"] += gather_ms / 1e3
+            return np.zeros((len(ids), 1), np.float32)
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather, run_in_step=self._executor(),
+            sink=lambda h, b, p: None,
+            batch_size=4, subbatch=2, bw_frac=0.25,
+        )
+        t0 = time.perf_counter()
+        try:
+            mgr.notify_stored(list(range(16)), parent=None)
+            assert mgr.flush(timeout=30.0)
+        finally:
+            mgr.close()
+        wall = time.perf_counter() - t0
+        # 8 sub-batch gathers x 5ms = 40ms of step-thread time; at
+        # frac=0.25 the wall must be >= ~4x that (generous lower bound
+        # for scheduling noise).
+        assert in_step["total"] >= 0.035
+        assert wall >= 3.0 * in_step["total"], (wall, in_step["total"])
+
+    def test_unbudgeted_runs_back_to_back(self):
+        def gather(ids):
+            time.sleep(0.002)
+            return np.zeros((len(ids), 1), np.float32)
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather, run_in_step=self._executor(),
+            sink=lambda h, b, p: None,
+            batch_size=4, subbatch=2, bw_frac=0.0,
+        )
+        t0 = time.perf_counter()
+        try:
+            mgr.notify_stored(list(range(16)), parent=None)
+            assert mgr.flush(timeout=30.0)
+        finally:
+            mgr.close()
+        # 8 gathers x 2ms with no budget: well under the budgeted wall.
+        assert time.perf_counter() - t0 < 0.5
+
+    def test_double_buffer_gathers_overlap_slow_sink(self):
+        """The next sub-batch's gather must execute on the step thread
+        BEFORE the previous bundle's (slow) sink finishes — one bundle in
+        flight while the previous sinks."""
+        events: list = []
+
+        def gather(ids):
+            return np.zeros((len(ids), 1), np.float32)
+
+        def slow_sink(h, b, p):
+            time.sleep(0.03)
+            events.append(("sink_done", time.perf_counter()))
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather, run_in_step=self._executor(events),
+            sink=slow_sink,
+            batch_size=8, subbatch=2, bw_frac=0.0,
+        )
+        try:
+            mgr.notify_stored(list(range(8)), parent=None)
+            assert mgr.flush(timeout=30.0)
+        finally:
+            mgr.close()
+        gathers = [t for kind, t in events if kind == "gather_exec"]
+        sinks = [t for kind, t in events if kind == "sink_done"]
+        assert len(gathers) == 4 and len(sinks) == 8
+        # gather #2 (index 1) ran before the first sub-batch's sinks done
+        assert gathers[1] < sinks[1], (gathers, sinks)
+
+    def test_queue_cap_drops_oldest(self):
+        started = threading.Event()
+        release = threading.Event()
+
+        def gather(ids):
+            started.set()
+            release.wait(timeout=10)
+            return np.zeros((len(ids), 1), np.float32)
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather, run_in_step=self._executor(),
+            sink=lambda h, b, p: None,
+            batch_size=2, subbatch=2, bw_frac=0.0, queue_cap=8,
+        )
+        try:
+            mgr.notify_stored([0, 1], parent=None)  # wedged in gather
+            started.wait(timeout=10)
+            mgr.notify_stored(list(range(100, 120)), parent=None)  # burst
+            assert mgr.queue_depth() == 8  # capped
+            assert mgr.dropped == 12  # oldest 12 of the 20 dropped
+            release.set()
+            assert mgr.flush(timeout=30.0)
+        finally:
+            release.set()
+            mgr.close()
+
+    def test_close_interrupts_wedged_gather_wait(self):
+        """A run_in_step executor that never answers (wedged scheduler)
+        must not wedge close(): the wait loop honors _stop."""
+        def never_runs(fn):
+            return thread_queue.Queue(1)  # nobody ever drains it
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=lambda ids: np.zeros((len(ids), 1), np.float32),
+            run_in_step=never_runs,
+            sink=lambda h, b, p: None,
+            batch_size=2, subbatch=2,
+        )
+        mgr.notify_stored([0, 1], parent=None)
+        time.sleep(0.2)  # let the worker enter the wait
+        t0 = time.perf_counter()
+        mgr.close()
+        assert time.perf_counter() - t0 < 3.0
+
+    def test_gather_timeout_requeues_instead_of_raising(self):
+        """A timed-out gather re-queues its blocks (logged) instead of
+        raising away the batch — the wedge is observable and recoverable.
+        The orphaned closure left in the (wedged) scheduler queue must
+        no-op when the scheduler finally drains it: its gather was
+        abandoned, the retry owns the blocks now."""
+        answered = {"n": 0}
+        orphans: list = []
+        gathers = {"n": 0}
+
+        def gather(ids):
+            gathers["n"] += 1
+            return np.zeros((len(ids), 1), np.float32)
+
+        def flaky_exec(fn):
+            out: thread_queue.Queue = thread_queue.Queue(1)
+            answered["n"] += 1
+            if answered["n"] == 1:  # first sub-batch wedges; keep the fn
+                orphans.append(fn)
+                return out
+            try:
+                out.put((fn(), None))
+            except Exception as exc:  # noqa: BLE001
+                out.put((None, exc))
+            return out
+
+        mgr = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather,
+            run_in_step=flaky_exec,
+            sink=lambda h, b, p: None,
+            batch_size=2, subbatch=2, bw_frac=0.0,
+            gather_timeout=0.3,
+        )
+        try:
+            mgr.notify_stored([0, 1], parent=None)
+            # The timed-out sub-batch went back on the queue and the
+            # retry (second executor call) completes it.
+            assert mgr.flush(timeout=30.0)
+            assert answered["n"] >= 2
+            done = gathers["n"]
+            # The scheduler recovers and drains the orphaned closure:
+            # it must not run a duplicate device gather.
+            for fn in orphans:
+                fn()
+            assert gathers["n"] == done, "orphaned gather was not no-oped"
+        finally:
+            mgr.close()
+
+
 class TestDecodeDuringOffload:
     def test_stream_continues_during_active_offload(self, run,
                                                     mem_runtime_config):
