@@ -540,6 +540,50 @@ def bench_disagg_point(requests: int = 16) -> dict:
     }
 
 
+def bench_goodput_point() -> dict:
+    """Goodput-vs-load curve with the overload-control loop off vs on
+    (ROADMAP item 4 / ISSUE 9) — the chip-free robustness point
+    BENCH_MULTI records next to the silicon numbers. An open-loop
+    Poisson ramp walks offered load past the mocker cluster's capacity
+    knee twice; per offered-rate bucket the curve reports SLO-good
+    requests/s and the shed fraction. The headline is dominance past the
+    knee: the deadline-aware admission loop sheds early instead of
+    FCFS-ing doomed work into late 504s, so goodput flattens instead of
+    collapsing (dynamo_tpu/mocker/overload.py, the same scenario the
+    chaos-overload CI job gates on)."""
+    import asyncio
+
+    from dynamo_tpu.mocker.overload import OverloadParams, run_scenario
+
+    params = OverloadParams(ramp_secs=16.0, ramp_end_rps=28.0)
+    report = asyncio.run(run_scenario(params, pd_sweep=False))
+
+    def curve(key: str) -> list[dict]:
+        return [{"offered_rps": b["offered_rps"],
+                 "goodput_rps": b["goodput_rps"],
+                 "shed_frac": b["shed_frac"]}
+                for b in report[key]["buckets"]]
+
+    knee = report.get("knee_bucket", 0)
+    on = report["ramp_on"]["buckets"]
+    off = report["ramp_off"]["buckets"]
+    past = range(knee + 1, min(len(on), len(off)))
+    return {
+        "profile": (f"{params.n_decode}-worker mocker, open-loop ramp "
+                    f"{params.ramp_start_rps}->{params.ramp_end_rps} rps"),
+        "slo_ttft_ms": params.slo_ttft_ms,
+        "deadline_secs": params.deadline_secs,
+        "knee_bucket": knee,
+        "loop_on": curve("ramp_on"),
+        "loop_off": curve("ramp_off"),
+        "past_knee_goodput_on": round(
+            sum(on[i]["goodput_rps"] for i in past), 2),
+        "past_knee_goodput_off": round(
+            sum(off[i]["goodput_rps"] for i in past), 2),
+        "assertions_passed": report["passed"],
+    }
+
+
 def main() -> None:
     import jax
 
@@ -582,6 +626,8 @@ def main() -> None:
         result = bench_one("qwen3-0.6b", device_kind=device_kind)
         if os.environ.get("DYNT_BENCH_DISAGG", "1") != "0":
             result["disagg"] = bench_disagg_point()
+        if os.environ.get("DYNT_BENCH_GOODPUT", "1") != "0":
+            result["goodput_vs_load"] = bench_goodput_point()
         print(json.dumps(result))
         return
 
@@ -635,6 +681,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — chip-free point must
             # never cost the round its silicon numbers
             result["disagg"] = {"error": repr(exc)}
+    if os.environ.get("DYNT_BENCH_GOODPUT", "1") != "0":
+        try:
+            result["goodput_vs_load"] = bench_goodput_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["goodput_vs_load"] = {"error": repr(exc)}
     print(json.dumps(result))
 
 

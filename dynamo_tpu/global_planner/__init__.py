@@ -26,6 +26,11 @@ from ..kv_router.protocols import LOAD_TOPIC, LoadMetrics
 from ..planner.connectors import Connector, TargetReplica
 from ..runtime import DistributedRuntime, new_instance_id
 from ..runtime.logging import get_logger
+from ..runtime.metrics import (
+    PLANNER_DECISIONS,
+    PLANNER_LAST_DECISION_TS,
+    PLANNER_TARGET_REPLICAS,
+)
 
 log = get_logger("global_planner")
 
@@ -85,16 +90,23 @@ class GlobalPlanner:
         total_replica_budget: int,
         adjustment_interval: float = 30.0,
         namespace: str = "global",
+        hysteresis_intervals: int = 2,
     ) -> None:
         self.runtime = runtime
         self.pools = {p.namespace: p for p in pools}
         self.budget = total_replica_budget
         self.interval = adjustment_interval
         self.namespace = namespace
+        # A pool only SHRINKS after this many consecutive intervals
+        # wanted it (growth applies immediately: slow to shrink, fast to
+        # grow) — pressure transients from a breaker trip or a retry
+        # burst must not thrash replicas across pools.
+        self.hysteresis_intervals = max(1, hysteresis_intervals)
         self.instance_id = new_instance_id()
         self._tasks: list[asyncio.Task] = []
         self._served = None
         self.decisions: list[dict] = []  # rolling log for observability
+        self._down_streaks: dict[str, int] = {}
 
     # -- rebalance ----------------------------------------------------------
 
@@ -142,15 +154,56 @@ class GlobalPlanner:
         return floored
 
     async def _apply(self, targets: dict[str, int]) -> None:
+        # Pass 1 — scale-down hysteresis: a held shrink keeps its pool
+        # at current size for now.
+        applied: dict[str, int] = {}
+        held: set[str] = set()
         for ns, n in targets.items():
             pool = self.pools[ns]
+            if n < pool.replicas:
+                streak = self._down_streaks.get(ns, 0) + 1
+                self._down_streaks[ns] = streak
+                if streak < self.hysteresis_intervals:
+                    held.add(ns)
+                    applied[ns] = pool.replicas
+                    continue
+            else:
+                self._down_streaks[ns] = 0
+            applied[ns] = n
+        # Pass 2 — budget repair: a held shrink next to an immediate
+        # grow would push the fleet past the replica budget (the grown
+        # pool was counting on the shrunk pool's replicas). Claw growth
+        # back toward current size until the budget holds; the growth
+        # completes once the held shrink's streak does.
+        while sum(applied.values()) > self.budget:
+            grown = [ns for ns in applied
+                     if applied[ns] > self.pools[ns].replicas]
+            if not grown:
+                break  # overshoot predates this interval (min floors)
+            victim = max(grown,
+                         key=lambda ns: applied[ns]
+                         - self.pools[ns].replicas)
+            applied[victim] -= 1
+        for ns, n in applied.items():
+            pool = self.pools[ns]
+            if ns in held and n == pool.replicas:
+                PLANNER_DECISIONS.labels(
+                    pool=ns, reason="hysteresis_hold").inc()
+                continue
+            PLANNER_TARGET_REPLICAS.labels(pool=ns).set(n)
             if n == pool.replicas:
+                PLANNER_DECISIONS.labels(pool=ns, reason="hold").inc()
                 continue
             log.info("global planner: pool %s %d -> %d replicas",
                      ns, pool.replicas, n)
             await pool.connector.set_component_replicas(
                 [TargetReplica(component=pool.component,
                                desired_replicas=n)])
+            PLANNER_DECISIONS.labels(
+                pool=ns,
+                reason="scale_up" if n > pool.replicas
+                else "scale_down").inc()
+            PLANNER_LAST_DECISION_TS.set(time.time())
             pool.replicas = n
             self.decisions.append({"pool": ns, "component": pool.component,
                                    "replicas": n})
@@ -240,6 +293,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--replica-budget", type=int, required=True,
                         help="total replicas across all pools")
     parser.add_argument("--adjustment-interval", type=float, default=30.0)
+    parser.add_argument("--hysteresis-intervals", type=int, default=2,
+                        help="consecutive intervals a pool scale-down "
+                             "must persist before it applies (growth is "
+                             "immediate); 1 disables hysteresis")
     parser.add_argument("--connector", default="virtual",
                         choices=["virtual", "kubernetes"])
     parser.add_argument("--k8s-deployment-prefix", default="dynamo-",

@@ -33,7 +33,9 @@ import itertools
 import time
 from typing import Callable, Optional, Sequence
 
+from ..runtime.admission import QueueWaitEstimator, check_admission
 from ..runtime.logging import get_logger
+from ..runtime.resilience import Deadline
 from .protocols import OverlapScores, WorkerWithDpRank
 from .scheduler import KvScheduler, SelectionResult
 
@@ -61,6 +63,11 @@ class QueuedRequest:
     pinned: bool = False  # caller fixed the worker set: bypass the gate
     overlaps: Optional[OverlapScores] = None
     request_id: Optional[str] = None
+    # End-to-end deadline budget (runtime/resilience.py): when set, a
+    # request about to PARK is first checked against the queue's drain
+    # estimate — a budget that cannot survive the backlog is refused
+    # (AdmissionRefused -> 503 + Retry-After) instead of parked to 504.
+    deadline: Optional[Deadline] = None
 
 
 def fcfs_key(arrival_offset: float, req: QueuedRequest,
@@ -122,6 +129,11 @@ class SchedulerQueue:
         self._seq = itertools.count()
         self._start = time.monotonic()
         self._ticker: Optional[asyncio.Task] = None
+        # Deadline-aware admission over the parking heap: drains are the
+        # entries update() dequeues; the depth a new arrival waits behind
+        # is the heap itself (passed as `extra` at check time, so this
+        # edge needs no worker feed).
+        self.wait_estimator = QueueWaitEstimator(pool="router_queue")
         # Worker load includes snapshots PUBLISHED by workers (other router
         # replicas' traffic) — capacity can return without any local
         # prefill-complete/free event. A periodic drain tick while anything
@@ -176,6 +188,12 @@ class SchedulerQueue:
                 not self._heap
                 and not self._all_busy(req.candidates, threshold)):
             return self._select(req)
+        # About to park: refuse a budget that cannot survive the backlog
+        # ahead of it at the measured drain rate — shed-early instead of
+        # a guaranteed late 504. (An empty heap parks with zero
+        # estimated wait: ordering-only parking must never shed.)
+        check_admission(self.wait_estimator, req.deadline,
+                        extra=len(self._heap))
         arrival = time.monotonic() - self._start
         key = self._key_fn(arrival, req, self.scheduler.config.block_size)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -242,6 +260,9 @@ class SchedulerQueue:
             if self._all_busy(req.candidates, threshold):
                 return
             heapq.heappop(self._heap)
+            # One parked entry drained into service: the rate signal the
+            # admission check divides the backlog by.
+            self.wait_estimator.observe_drained(1)
             try:
                 # Re-score overlaps at DRAIN time: KV events kept flowing
                 # while the request was parked, and routing on the arrival

@@ -172,6 +172,7 @@ class KvRouterEngine(TokenEngine):
                 priority_jump=_priority_of(request),
                 pinned=pinned,
                 request_id=request_id,
+                deadline=request.deadline,
             ))
             sspan.set_attribute("worker.instance",
                                 f"{result.worker.worker_id:x}")

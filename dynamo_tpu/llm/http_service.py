@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import math
 import time
 import uuid
 from typing import AsyncIterator, Optional
@@ -26,6 +27,7 @@ from typing import AsyncIterator, Optional
 from aiohttp import web
 
 from ..runtime import metrics as rt_metrics
+from ..runtime.admission import AdmissionRefused, check_admission
 from ..runtime.config import env
 from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import current_request_id, get_logger
@@ -66,7 +68,8 @@ class _SloObserver:
     an unset target always passes)."""
 
     def __init__(self, preprocessed: PreprocessedRequest,
-                 ttft_target_ms: float, itl_target_ms: float) -> None:
+                 ttft_target_ms: float, itl_target_ms: float,
+                 wait_estimator=None) -> None:
         self.model = preprocessed.model
         self.request_id = preprocessed.request_id
         trace_id = _trace_id_of(preprocessed)
@@ -77,6 +80,11 @@ class _SloObserver:
         self.itl_max = 0.0
         self.ttft_target_ms = ttft_target_ms
         self.itl_target_ms = itl_target_ms
+        # Admission-loop drain signal (runtime/admission.py): a first
+        # token means one request entered service — drained from the
+        # pool's queue — which is the rate the queue-wait estimate
+        # divides the published backlog by.
+        self.wait_estimator = wait_estimator
         self._finalized = False
 
     def on_output(self, output: EngineOutput) -> None:
@@ -88,6 +96,8 @@ class _SloObserver:
             rt_metrics.TTFT_SECONDS.labels(model=self.model).observe(
                 now - self.start, exemplar=self.exemplar)
             get_recorder().stamp(self.request_id, "first_token")
+            if self.wait_estimator is not None:
+                self.wait_estimator.observe_drained(1)
         elif self.last_at is not None:
             gap = now - self.last_at
             rt_metrics.ITL_SECONDS.labels(model=self.model).observe(
@@ -172,6 +182,18 @@ class HttpService:
             )
         return entry, lora
 
+    def _retry_after(self, entry: Optional[ModelEntry]) -> str:
+        """Retry-After seconds for 503 shed responses: the estimated
+        drain time of the model pool's queue (runtime/admission.py),
+        floored/capped by the DYNT_RETRY_AFTER_MIN/MAX_SECS knobs — an
+        honest hint instead of the old fixed constant. Integer per
+        RFC 9110 (ceil so the client never retries a hair early)."""
+        if entry is None:
+            return str(max(1, int(env("DYNT_RETRY_AFTER_MIN_SECS"))))
+        est = entry.wait_estimator
+        secs = est.retry_after_s(est.estimate_wait_ms(extra=1))
+        return str(max(1, math.ceil(secs)))
+
     def _check_busy(self, entry: ModelEntry) -> None:
         """Shed load when every live worker is past the KV busy threshold
         (ref: busy_threshold.rs + KvWorkerMonitor). Uses published
@@ -190,10 +212,12 @@ class HttpService:
             raise web.HTTPServiceUnavailable(
                 text=json.dumps(_error_body(503, "service busy", "overloaded")),
                 content_type="application/json",
-                headers={"Retry-After": "1"},
+                headers={"Retry-After": self._retry_after(entry)},
             )
 
-    def _admit_deadline(self, request: web.Request) -> Optional[Deadline]:
+    def _admit_deadline(self, request: web.Request,
+                        entry: Optional[ModelEntry] = None,
+                        ) -> Optional[Deadline]:
         """Derive the request's end-to-end Deadline: an upstream-propagated
         x-dynt-deadline-ms header wins; otherwise DYNT_DEADLINE_SECS (0
         disables). A budget already spent on arrival is shed immediately
@@ -214,9 +238,28 @@ class HttpService:
                 text=json.dumps(_error_body(
                     503, "request deadline already spent", "overloaded")),
                 content_type="application/json",
-                headers={"Retry-After": "1"},
+                headers={"Retry-After": self._retry_after(entry)},
             )
         return deadline
+
+    def _check_queue_admission(self, entry: ModelEntry,
+                               deadline: Optional[Deadline]) -> None:
+        """Deadline-aware admission (the shed-early rung of the
+        degradation ladder, docs/fault-tolerance.md): refuse a request
+        whose budget cannot survive the estimated queue wait of the
+        model's pool — BEFORE preprocessing or dispatch burns any work
+        on a reply the client will never wait for. The wait is the
+        backlog AHEAD of this arrival (extra=0): an empty pool admits
+        regardless of how slow the measured drain is."""
+        try:
+            check_admission(entry.wait_estimator, deadline)
+        except AdmissionRefused as exc:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps(_error_body(503, str(exc), "overloaded")),
+                content_type="application/json",
+                headers={"Retry-After": str(max(1, math.ceil(
+                    exc.retry_after_s)))},
+            )
 
     # -- handlers ----------------------------------------------------------
 
@@ -265,7 +308,8 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
-        deadline = self._admit_deadline(request)
+        deadline = self._admit_deadline(request, entry)
+        self._check_queue_admission(entry, deadline)
         pre_start = time.monotonic()
         try:
             if kind == "chat":
@@ -433,7 +477,8 @@ class HttpService:
         """Drive the engine stream to completion through `delta_gen`.
         Returns an error Response, or None on success. Shared by every
         non-streaming handler so error mapping stays in one place."""
-        obs = (_SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+        obs = (_SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms,
+                            wait_estimator=entry.wait_estimator)
                if observe_latency else None)
         cancelled = False
         try:
@@ -455,6 +500,16 @@ class HttpService:
             return web.json_response(
                 _error_body(503, "no workers available", "overloaded"),
                 status=503, headers={"Retry-After": "1"})
+        except AdmissionRefused as exc:
+            # Deadline-aware refusal from a downstream admission edge
+            # (router queue / prefill router): same 503 + honest
+            # Retry-After contract as the frontend's own check — the
+            # shed was already counted where it was decided.
+            get_recorder().finish(preprocessed.request_id, "shed")
+            return web.json_response(
+                _error_body(503, str(exc), "overloaded"), status=503,
+                headers={"Retry-After": str(max(1, math.ceil(
+                    exc.retry_after_s)))})
         except DeadlineExceeded as exc:
             rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
             get_recorder().finish(preprocessed.request_id,
@@ -523,7 +578,8 @@ class HttpService:
         )
         await response.prepare(request)
         start = time.monotonic()
-        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms,
+                           wait_estimator=entry.wait_estimator)
         disconnected = False
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage", False)
@@ -546,6 +602,13 @@ class HttpService:
         except NoInstancesAvailable:
             await response.write(
                 f"data: {json.dumps(_error_body(503, 'no workers available'))}\n\n".encode())
+            await response.write(b"data: [DONE]\n\n")
+        except AdmissionRefused as exc:
+            # Mid-pipeline refusal after the stream headers went out:
+            # surface in-band like every other post-prepare failure.
+            get_recorder().finish(preprocessed.request_id, "shed")
+            await response.write(
+                f"data: {json.dumps(_error_body(503, str(exc), 'overloaded'))}\n\n".encode())
             await response.write(b"data: [DONE]\n\n")
         except DeadlineExceeded as exc:
             rt_metrics.DEADLINE_EXCEEDED.labels(component="frontend").inc()
@@ -855,7 +918,8 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
-        deadline = self._admit_deadline(request)
+        deadline = self._admit_deadline(request, entry)
+        self._check_queue_admission(entry, deadline)
         try:
             chat_body = self._messages_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
@@ -947,7 +1011,8 @@ class HttpService:
             "content_block": {"type": "text", "text": ""},
         })
         start = time.monotonic()
-        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms,
+                           wait_estimator=entry.wait_estimator)
         errored = False
         disconnected = False
         try:
@@ -979,8 +1044,12 @@ class HttpService:
                     "usage": {"output_tokens": delta_gen.completion_tokens},
                 })
                 await emit("message_stop", {"type": "message_stop"})
-        except (NoInstancesAvailable, RemoteError) as exc:
+        except (NoInstancesAvailable, AdmissionRefused, RemoteError) as exc:
             errored = True
+            if isinstance(exc, AdmissionRefused):
+                # Deliberate early shed, not a failure: keep its
+                # timeline out of the error auto-dump storm.
+                get_recorder().finish(preprocessed.request_id, "shed")
             await emit("error", {"type": "error",
                                  "error": {"type": "api_error",
                                            "message": str(exc)}})
@@ -1084,7 +1153,8 @@ class HttpService:
         model = body.get("model", "")
         entry, lora = self._lookup(model)
         self._check_busy(entry)
-        deadline = self._admit_deadline(request)
+        deadline = self._admit_deadline(request, entry)
+        self._check_queue_admission(entry, deadline)
         try:
             chat_body = self._responses_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
@@ -1157,7 +1227,8 @@ class HttpService:
                                              delta_gen, "in_progress"),
         })
         start = time.monotonic()
-        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms)
+        obs = _SloObserver(preprocessed, self.slo_ttft_ms, self.slo_itl_ms,
+                           wait_estimator=entry.wait_estimator)
         errored = False
         disconnected = False
         try:
@@ -1187,8 +1258,12 @@ class HttpService:
                     "response": self._responses_body(
                         resp_id, preprocessed.model, delta_gen, "completed"),
                 })
-        except (NoInstancesAvailable, RemoteError) as exc:
+        except (NoInstancesAvailable, AdmissionRefused, RemoteError) as exc:
             errored = True
+            if isinstance(exc, AdmissionRefused):
+                # Deliberate early shed, not a failure: keep its
+                # timeline out of the error auto-dump storm.
+                get_recorder().finish(preprocessed.request_id, "shed")
             await emit("error", {"type": "error", "message": str(exc)})
         except DeadlineExceeded as exc:
             # Same classification as the chat stream (see _stream_response).

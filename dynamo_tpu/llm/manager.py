@@ -23,6 +23,7 @@ from ..kv_router import (
     RouterEvent,
     WorkerWithDpRank,
 )
+from ..runtime.admission import QueueWaitEstimator
 from ..runtime.config import env
 from ..runtime.discovery import MODEL_CARD_PREFIX
 from ..runtime.logging import get_logger
@@ -64,6 +65,15 @@ class ModelEntry:
     # eligibility is enforced at routing time via lora_instances.
     instance_loras: dict[int, list[str]] = dataclasses.field(
         default_factory=dict)
+    # Deadline-aware admission (runtime/admission.py): queue-wait estimate
+    # for this model's serving pool — depth from worker-published
+    # waiting_requests (LoadMetrics), drain rate from the frontend's own
+    # first-token stream.
+    wait_estimator: QueueWaitEstimator = dataclasses.field(
+        default_factory=QueueWaitEstimator)
+
+    def __post_init__(self) -> None:
+        self.wait_estimator.pool = f"decode:{self.card.name}"
 
     def loras(self) -> set[str]:
         return {name for ls in self.instance_loras.values() for name in ls}
@@ -521,5 +531,15 @@ class ModelWatcher:
                         entry.worker_usage[metrics.worker_id] = metrics.kv_usage
                         if entry.scheduler is not None:
                             entry.scheduler.sequences.update_published(metrics)
+                        if metrics.worker_id in entry.instances:
+                            # Deadline-aware admission depth signal: the
+                            # scheduler's own step-loop queue stats
+                            # (waiting_requests) per live decode worker.
+                            entry.wait_estimator.update_worker(
+                                metrics.worker_id, metrics.waiting_requests)
+                    for pool in self._prefill_pools.values():
+                        if metrics.worker_id in pool.instances:
+                            pool.wait_estimator.update_worker(
+                                metrics.worker_id, metrics.waiting_requests)
             except Exception:  # noqa: BLE001
                 log.exception("bad event on %s", topic)

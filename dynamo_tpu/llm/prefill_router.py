@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import AsyncIterator, Callable, Optional
 
+from ..runtime.admission import QueueWaitEstimator, check_admission
 from ..runtime.logging import get_logger
 from ..runtime.otel import get_tracer
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
@@ -28,12 +29,23 @@ from .protocols import EngineOutput, PreprocessedRequest, SamplingOptions
 log = get_logger("llm.prefill_router")
 
 
+def _prefill_estimator() -> QueueWaitEstimator:
+    return QueueWaitEstimator(pool="prefill")
+
+
 @dataclasses.dataclass
 class PrefillPool:
     """A model's prefill workers (one endpoint subject + live instances)."""
 
     router: PushRouter
     instances: set[int] = dataclasses.field(default_factory=set)
+    # Deadline-aware admission: queue-wait estimate for the prefill pool —
+    # depth from the pool workers' waiting_requests (LoadMetrics, fed by
+    # the ModelWatcher), drain rate from completed prefill legs observed
+    # right here. Isolated from the decode pool's estimator so a drowning
+    # prefill tier cannot poison decode admission (and vice versa).
+    wait_estimator: QueueWaitEstimator = dataclasses.field(
+        default_factory=_prefill_estimator)
 
     def active(self) -> bool:
         return bool(self.instances)
@@ -131,6 +143,10 @@ class PrefillRouterEngine(TokenEngine):
                                 request.request_id, out.error)
                     return None
                 if out.kv_transfer_params is not None:
+                    # A completed leg = one unit drained from the prefill
+                    # queue — the drain-rate signal the pool's admission
+                    # estimator divides the backlog by.
+                    pool.wait_estimator.observe_drained(1)
                     params = out.kv_transfer_params
                     if params.get("streaming") \
                             and "first_token" not in params:
@@ -178,6 +194,12 @@ class PrefillRouterEngine(TokenEngine):
             async for out in self.inner.generate(request):
                 yield out
             return
+        # Deadline-aware admission for the prefill tier: refuse (503 via
+        # AdmissionRefused at the frontend) BEFORE dispatching the leg —
+        # a budget that cannot survive the prefill queue would burn a
+        # full prompt pass for a client that has already timed out. The
+        # wait is the backlog AHEAD of this leg; an idle pool admits.
+        check_admission(pool.wait_estimator, request.deadline)
         params = await self._run_prefill(pool, request)
         if params is not None:
             request = dataclasses.replace(

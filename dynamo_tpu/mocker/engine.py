@@ -281,6 +281,10 @@ class MockerEngine:
         self._wake = asyncio.Event()
         self._closed = False
         self.steps = 0
+        # Cumulative prompt tokens this engine actually prefilled —
+        # ground truth for the chaos-overload assertion that requests
+        # refused at admission never burned prefill work.
+        self.prefill_tokens_total = 0
         self._pending_stored: list[tuple[list[int], Optional[int]]] = []
         # Speculative-worker profile accounting (spec_k > 0): mirrors the
         # real engine's dynamo_spec_* proposed/accepted counters so
@@ -529,6 +533,7 @@ class MockerEngine:
             seq.prefilled_tokens += chunk
             seq.prefill_chunks += 1
             total += chunk
+        self.prefill_tokens_total += total
         return total
 
     def _spec_tokens_this_step(self, remaining: int) -> int:

@@ -121,6 +121,110 @@ def synthesize_trace(
     return records
 
 
+def ramp_arrival_times(start_rps: float, end_rps: float, seconds: float,
+                       seed: int = 0) -> list[float]:
+    """Open-loop arrival timestamps (ms) for a linear Poisson rate ramp
+    start_rps -> end_rps over `seconds` — the chaos-overload schedule
+    that walks offered load past the capacity knee. Inhomogeneous
+    Poisson by inversion: each next gap is drawn at the instantaneous
+    rate, so arrivals stay memoryless while the rate climbs. Open loop
+    means the schedule never waits for completions — exactly the load
+    shape that collapses a closed-loop-tested system."""
+    rng = np.random.default_rng(seed)
+    out: list[float] = []
+    t = 0.0
+    while t < seconds:
+        rate = start_rps + (end_rps - start_rps) * (t / seconds)
+        if rate <= 1e-9:
+            # Dead zone at the ramp start: skip forward to where the
+            # rate becomes meaningful instead of dividing by ~0.
+            t += 0.1
+            continue
+        t += rng.exponential(1.0 / rate)
+        if t < seconds:
+            out.append(t * 1e3)
+    return out
+
+
+def synthesize_ramp_trace(
+    start_rps: float,
+    end_rps: float,
+    seconds: float,
+    isl_mean: int = 512,
+    osl_mean: int = 64,
+    prefix_ratio: float = 0.5,
+    num_prefix_groups: int = 8,
+    block_size: int = 16,
+    seed: int = 0,
+) -> list[TraceRecord]:
+    """synthesize_trace with the Poisson arrivals replaced by a
+    ramp_arrival_times schedule (--ramp-rps): lengths and shared-prefix
+    structure are drawn exactly like the steady-rate generator."""
+    ts = ramp_arrival_times(start_rps, end_rps, seconds, seed=seed)
+    records = synthesize_trace(
+        len(ts), rate_rps=1.0, isl_mean=isl_mean, osl_mean=osl_mean,
+        prefix_ratio=prefix_ratio, num_prefix_groups=num_prefix_groups,
+        block_size=block_size, seed=seed,
+    )
+    for record, t in zip(records, ts):
+        record.ts_ms = float(t)
+    return records
+
+
+def parse_ramp_spec(spec: str) -> tuple[float, float, float]:
+    """Parse the --ramp-rps 'start:end:seconds' CLI spec."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(
+            f"--ramp-rps expects start:end:seconds, got {spec!r}")
+    start, end, seconds = (float(p) for p in parts)
+    if seconds <= 0 or start < 0 or end < 0:
+        raise ValueError(f"bad --ramp-rps values in {spec!r}")
+    return start, end, seconds
+
+
+def summarize_buckets(samples: list[dict], bucket_secs: float,
+                      total_secs: Optional[float] = None) -> list[dict]:
+    """Per-bucket goodput/shed summary for an open-loop run.
+
+    Each sample is one offered request:
+        {"t_s": arrival (s, relative), "ok": finished 200/OK,
+         "good": ok AND met the SLO, "shed": refused at admission,
+         "tokens": output tokens}
+    Returns one dict per `bucket_secs` window with the offered rate and
+    what became of it — the goodput-vs-load curve the chaos scenario
+    asserts on and BENCH_MULTI records (a bucket's `goodput_rps` flat
+    while `offered_rps` climbs IS graceful degradation)."""
+    if not samples:
+        return []
+    if total_secs is None:
+        total_secs = max(s["t_s"] for s in samples) + 1e-9
+    n_buckets = max(1, int(np.ceil(total_secs / bucket_secs)))
+    buckets: list[list[dict]] = [[] for _ in range(n_buckets)]
+    for s in samples:
+        idx = min(n_buckets - 1, int(s["t_s"] / bucket_secs))
+        buckets[idx].append(s)
+    out = []
+    for i, group in enumerate(buckets):
+        offered = len(group)
+        ok = sum(1 for s in group if s.get("ok"))
+        good = sum(1 for s in group if s.get("good"))
+        shed = sum(1 for s in group if s.get("shed"))
+        tokens = sum(int(s.get("tokens", 0)) for s in group if s.get("good"))
+        out.append({
+            "t_start_s": round(i * bucket_secs, 3),
+            "offered": offered,
+            "offered_rps": round(offered / bucket_secs, 3),
+            "ok": ok,
+            "good": good,
+            "shed": shed,
+            "goodput_rps": round(good / bucket_secs, 3),
+            "shed_frac": round(shed / offered, 4) if offered else 0.0,
+            "good_tokens_per_s": round(tokens / bucket_secs, 1),
+        })
+    return out
+
+
 def tokens_for_record(record: TraceRecord, block_size: int,
                       vocab_size: int = 512) -> list[int]:
     """Deterministic token ids: each hash_id expands to the same block of
@@ -151,6 +255,9 @@ class RequestStats:
     total_ms: float
     output_tokens: int
     error: Optional[str] = None
+    # Arrival offset on the (unscaled) trace timeline — keys the
+    # per-bucket goodput/shed summary for ramp traces.
+    arrival_s: float = 0.0
 
     @property
     def itl_ms(self) -> float:
@@ -168,6 +275,9 @@ class ReplayReport:
     output_tokens: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # Replay clock compression (OfflineReplay.time_scale): bucket stats
+    # scale measured latencies back onto the trace timeline with it.
+    time_scale: float = 1.0
     stats: list[RequestStats] = dataclasses.field(default_factory=list)
 
     def _pct(self, values: list[float], p: float) -> float:
@@ -200,6 +310,23 @@ class ReplayReport:
                     self.spec_accepted / self.spec_proposed, 4),
             }
         return out
+
+    def bucket_summary(self, bucket_secs: float,
+                       slo_ttft_ms: float = 0.0) -> list[dict]:
+        """Per-arrival-bucket goodput/shed stats on the TRACE timeline
+        (ramp replays: each bucket is one offered-rate step). `good`
+        means finished OK within slo_ttft_ms on the scaled-back replay
+        clock (0 = any OK finish is good)."""
+        scale = max(self.time_scale, 1e-9)
+        samples = [{
+            "t_s": s.arrival_s,
+            "ok": s.error is None,
+            "good": s.error is None and (
+                not slo_ttft_ms or s.ttft_ms / scale <= slo_ttft_ms),
+            "shed": False,  # offline replay has no admission edge
+            "tokens": s.output_tokens,
+        } for s in self.stats]
+        return summarize_buckets(samples, bucket_secs)
 
 
 class _CapturePublisher:
@@ -306,7 +433,7 @@ class OfflineReplay:
         return engine, None
 
     async def _run_one(self, record: TraceRecord, report: ReplayReport,
-                       index: int) -> None:
+                       index: int, arrival_s: float = 0.0) -> None:
         token_ids = tokens_for_record(record, self.config.block_size,
                                       self.config.vocab_size)
         request = PreprocessedRequest(
@@ -369,13 +496,14 @@ class OfflineReplay:
             total_ms=total_ms,
             output_tokens=tokens,
             error=error,
+            arrival_s=arrival_s,
         ))
         report.output_tokens += tokens
         if error is not None:
             report.errors += 1
 
     async def run(self, records: list[TraceRecord]) -> ReplayReport:
-        report = ReplayReport(mode=self.mode)
+        report = ReplayReport(mode=self.mode, time_scale=self.time_scale)
         t0 = time.monotonic()
         t0_rec = records[0].ts_ms if records else 0.0
         tasks = []
@@ -386,8 +514,9 @@ class OfflineReplay:
                 if delay > 0:
                     await asyncio.sleep(delay)
                 report.requests += 1
-                tasks.append(asyncio.create_task(
-                    self._run_one(record, report, i)))
+                tasks.append(asyncio.create_task(self._run_one(
+                    record, report, i,
+                    arrival_s=(record.ts_ms - t0_rec) / 1e3)))
             await asyncio.gather(*tasks)
         finally:
             # Cancellation mid-replay must not leak engine stepper tasks.
@@ -409,6 +538,12 @@ async def main(argv: Optional[list[str]] = None) -> None:
     syn.add_argument("--out", required=True)
     syn.add_argument("--num-requests", type=int, default=100)
     syn.add_argument("--rate-rps", type=float, default=10.0)
+    syn.add_argument("--ramp-rps", default=None, metavar="START:END:SECS",
+                     help="open-loop linear Poisson rate ramp (e.g. "
+                          "5:80:60 walks 5->80 rps over 60s) — replaces "
+                          "--rate-rps/--num-requests; the chaos-overload "
+                          "schedule that drives offered load past the "
+                          "capacity knee")
     syn.add_argument("--isl-mean", type=int, default=512)
     syn.add_argument("--osl-mean", type=int, default=64)
     syn.add_argument("--prefix-ratio", type=float, default=0.5)
@@ -442,6 +577,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
     rep.add_argument("--kv-transfer-us-per-block", type=float, default=None,
                      help="disagg KV handoff cost per block (overrides "
                           "the preset; 0 = free transfers)")
+    rep.add_argument("--bucket-secs", type=float, default=0.0,
+                     help="also emit per-arrival-bucket goodput stats on "
+                          "the trace timeline (ramp traces: one bucket "
+                          "per offered-rate step; 0 = off)")
+    rep.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                     help="TTFT target (trace clock) for the bucket "
+                          "stats' `good` verdict (0 = any OK finish)")
     rep.add_argument("--serial-disagg", action="store_true",
                      help="disable the chunked handoff pipeline in disagg "
                           "mode: the decode leg waits for the FULL KV "
@@ -450,12 +592,21 @@ async def main(argv: Optional[list[str]] = None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "synthesize":
-        records = synthesize_trace(
-            args.num_requests, rate_rps=args.rate_rps,
-            isl_mean=args.isl_mean, osl_mean=args.osl_mean,
-            prefix_ratio=args.prefix_ratio,
-            num_prefix_groups=args.prefix_groups, seed=args.seed,
-        )
+        if args.ramp_rps:
+            start, end, seconds = parse_ramp_spec(args.ramp_rps)
+            records = synthesize_ramp_trace(
+                start, end, seconds,
+                isl_mean=args.isl_mean, osl_mean=args.osl_mean,
+                prefix_ratio=args.prefix_ratio,
+                num_prefix_groups=args.prefix_groups, seed=args.seed,
+            )
+        else:
+            records = synthesize_trace(
+                args.num_requests, rate_rps=args.rate_rps,
+                isl_mean=args.isl_mean, osl_mean=args.osl_mean,
+                prefix_ratio=args.prefix_ratio,
+                num_prefix_groups=args.prefix_groups, seed=args.seed,
+            )
         save_trace(args.out, records)
         print(json.dumps({"written": len(records), "path": args.out}))
         return
@@ -489,7 +640,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
         disagg_pipeline=not args.serial_disagg,
     )
     report = await replayer.run(records)
-    print(json.dumps(report.summary()))
+    summary = report.summary()
+    if args.bucket_secs > 0:
+        summary["buckets"] = report.bucket_summary(
+            args.bucket_secs, slo_ttft_ms=args.slo_ttft_ms)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -12,9 +12,11 @@ from .connectors import (
 )
 from .core import (
     LoadBasedPlanner,
+    PdSplitPlanner,
     PlannerConfig,
     SlaPlanner,
     apply_chip_budget,
+    publish_planner_decision,
 )
 from .interpolation import (
     DecodeInterpolator,
@@ -25,6 +27,8 @@ from .interpolation import (
 from .metrics_source import (
     FrontendScraper,
     LoadEventSource,
+    PhaseBreakdown,
+    PhaseBreakdownSource,
     TrafficStats,
     parse_prometheus_text,
 )
@@ -42,9 +46,11 @@ __all__ = [
     "ArPredictor", "BasePredictor", "CallbackConnector", "ConstantPredictor",
     "Connector", "DecodeInterpolator", "FrontendScraper", "ItlEstimator",
     "KalmanPredictor", "KubernetesConnector", "LoadBasedPlanner",
-    "LoadEventSource", "OnlineLinearRegression", "PlannerConfig",
+    "LoadEventSource", "OnlineLinearRegression", "PdSplitPlanner",
+    "PhaseBreakdown", "PhaseBreakdownSource", "PlannerConfig",
     "PrefillInterpolator", "SeasonalPredictor", "SlaPlanner",
     "TargetReplica", "TrafficStats", "TtftEstimator", "VirtualConnector",
     "apply_chip_budget", "make_predictor", "parse_prometheus_text",
-    "save_decode_profile", "save_prefill_profile",
+    "publish_planner_decision", "save_decode_profile",
+    "save_prefill_profile",
 ]

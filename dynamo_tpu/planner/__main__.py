@@ -16,7 +16,7 @@ from ..runtime.signals import wait_for_shutdown_signal
 from .connectors import KubernetesConnector, VirtualConnector
 from .core import PlannerConfig, SlaPlanner
 from .interpolation import DecodeInterpolator, PrefillInterpolator
-from .metrics_source import FrontendScraper
+from .metrics_source import FrontendScraper, PhaseBreakdownSource
 
 log = get_logger("planner.main")
 
@@ -33,6 +33,23 @@ async def main(argv=None) -> None:
                              "LoadMetrics under (--mode load)")
     parser.add_argument("--metrics-url",
                         default="http://127.0.0.1:8000/metrics")
+    parser.add_argument("--debug-url", default=None,
+                        help="frontend /debug/requests URL for the "
+                             "flight-recorder phase breakdown (queue vs "
+                             "prefill vs decode burn — names the "
+                             "bottleneck pool on goodput collapse). "
+                             "Default: derived from --metrics-url; "
+                             "'off' disables (the frontend needs "
+                             "DYNT_DEBUG_ENDPOINTS=1)")
+    parser.add_argument("--goodput-target", type=float, default=0.9,
+                        help="SLO-good ratio below which an interval "
+                             "counts as violating and the planner grows "
+                             "the bottleneck pool (0 disables the "
+                             "goodput loop)")
+    parser.add_argument("--hysteresis-intervals", type=int, default=2,
+                        help="consecutive intervals a scale-down must "
+                             "persist before it applies (growth is "
+                             "immediate); 1 disables hysteresis")
     parser.add_argument("--model", required=True)
     parser.add_argument("--profile-results-dir", default=None,
                         help="profiler sweep output; omitted = use the "
@@ -86,6 +103,8 @@ async def main(argv=None) -> None:
         decode_engine_num_chips=args.decode_engine_num_chips,
         load_predictor=args.load_predictor,
         no_correction=args.no_correction,
+        goodput_target=args.goodput_target,
+        hysteresis_intervals=max(1, args.hysteresis_intervals),
     )
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     if args.connector == "kubernetes":
@@ -111,9 +130,20 @@ async def main(argv=None) -> None:
                 source.on_event(payload)
 
         pump_task = asyncio.create_task(_pump())
-        planner = LoadBasedPlanner(config, connector, source)
+        # The scraper feeds the goodput gate (a violated SLO-good ratio
+        # forces growth / vetoes shrinking); load-based planning itself
+        # still runs off LoadMetrics events alone.
+        planner = LoadBasedPlanner(
+            config, connector, source,
+            scraper=FrontendScraper(args.metrics_url, args.model))
     else:
         disagg = not args.aggregated
+        debug_url = args.debug_url
+        if debug_url is None:
+            debug_url = args.metrics_url.rsplit("/metrics", 1)[0] \
+                + "/debug/requests"
+        breakdown = (PhaseBreakdownSource(debug_url)
+                     if debug_url != "off" else None)
         planner = SlaPlanner(
             config, connector,
             prefill_interpolator=(
@@ -122,6 +152,7 @@ async def main(argv=None) -> None:
             decode_interpolator=DecodeInterpolator(
                 args.profile_results_dir),
             scraper=FrontendScraper(args.metrics_url, args.model),
+            breakdown_source=breakdown,
             disagg=disagg,
         )
     planner.start()

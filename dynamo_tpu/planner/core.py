@@ -25,12 +25,26 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import math
+import time
 from typing import Optional
 
 from ..runtime.logging import get_logger
+from ..runtime.metrics import (
+    PLANNER_CORRECTION,
+    PLANNER_DECISIONS,
+    PLANNER_GOODPUT_RATIO,
+    PLANNER_LAST_DECISION_TS,
+    PLANNER_TARGET_REPLICAS,
+)
 from .connectors import Connector, TargetReplica
 from .interpolation import DecodeInterpolator, PrefillInterpolator
-from .metrics_source import FrontendScraper, LoadEventSource, TrafficStats
+from .metrics_source import (
+    FrontendScraper,
+    LoadEventSource,
+    PhaseBreakdown,
+    PhaseBreakdownSource,
+    TrafficStats,
+)
 from .predictors import make_predictor
 from .regression import ItlEstimator, TtftEstimator
 
@@ -55,6 +69,31 @@ class PlannerConfig:
     # component names as registered in the runtime
     prefill_component: str = "prefill"
     decode_component: str = "backend"
+    # -- goodput-driven control loop (ROADMAP item 4) ----------------------
+    # SLO-good fraction below which an interval counts as violating: the
+    # planner then grows the bottleneck pool (phase breakdown decides
+    # which) beyond what the raw-load math asked for.
+    goodput_target: float = 0.9
+    # Consecutive intervals a scale-DOWN must persist before it applies
+    # (scale-UP is immediate: slow to shrink, fast to grow) — breaker/
+    # retry transients and one noisy scrape must not thrash replicas.
+    hysteresis_intervals: int = 2
+    # Under a binding chip budget, shift chips between the P and D pools
+    # toward the measured bottleneck when goodput is violated.
+    pd_rebalance: bool = True
+
+
+def publish_planner_decision(targets: dict[str, int], reason: str,
+                             goodput: Optional[float] = None) -> None:
+    """Publish a planner decision to the dynamo_planner_* families (the
+    operator/chaos-visible decision record, docs/metrics.md) — shared by
+    the SLA planner, the load-based planner and the global planner."""
+    for pool, n in targets.items():
+        PLANNER_TARGET_REPLICAS.labels(pool=pool).set(n)
+        PLANNER_DECISIONS.labels(pool=pool, reason=reason).inc()
+    if goodput is not None:
+        PLANNER_GOODPUT_RATIO.set(goodput)
+    PLANNER_LAST_DECISION_TS.set(time.time())
 
 
 def apply_chip_budget(num_p: int, num_d: int,
@@ -103,6 +142,11 @@ class PlannerState:
     num_d_workers: int = 0
     last_decision: Optional[tuple[int, int]] = None
     intervals: int = 0
+    # Consecutive intervals each pool's plan wanted to shrink (hysteresis:
+    # a scale-down only applies once the streak reaches the configured
+    # interval count; any non-shrinking interval resets it).
+    down_streak_p: int = 0
+    down_streak_d: int = 0
 
 
 class SlaPlanner:
@@ -117,6 +161,7 @@ class SlaPlanner:
         prefill_interpolator: Optional[PrefillInterpolator] = None,
         decode_interpolator: Optional[DecodeInterpolator] = None,
         scraper: Optional[FrontendScraper] = None,
+        breakdown_source: Optional[PhaseBreakdownSource] = None,
         disagg: bool = True,
     ) -> None:
         self.config = config
@@ -124,6 +169,10 @@ class SlaPlanner:
         self.prefill_interp = prefill_interpolator
         self.decode_interp = decode_interpolator
         self.scraper = scraper
+        # Flight-recorder phase burn (queue vs prefill vs decode): names
+        # the bottleneck pool when goodput collapses. Optional — without
+        # it, goodput violations scale the decode pool.
+        self.breakdown_source = breakdown_source
         self.disagg = disagg
         self.state = PlannerState()
         self.num_req_pred = make_predictor(config.load_predictor)
@@ -189,9 +238,47 @@ class SlaPlanner:
         n = math.ceil(pred_thpt / per_chip / cfg.decode_engine_num_chips)
         return max(n, cfg.min_endpoint)
 
-    def plan(self, stats: TrafficStats) -> Optional[tuple[int, int]]:
+    def _rebalance_pd(self, num_p: int, num_d: int,
+                      breakdown: Optional[PhaseBreakdown],
+                      ) -> tuple[int, int, bool]:
+        """Under a BINDING chip budget, adding replicas is impossible —
+        the only goodput lever left is the P/D ratio. Shift one replica
+        of chips toward the measured bottleneck phase (replica-granular,
+        so only when both engines are the same chip size). Returns
+        (num_p, num_d, moved)."""
+        cfg = self.config
+        if (not cfg.pd_rebalance or breakdown is None
+                or breakdown.samples <= 0 or num_p <= 0
+                or cfg.prefill_engine_num_chips
+                != cfg.decode_engine_num_chips):
+            return num_p, num_d, False
+        if breakdown.bottleneck() == "prefill" \
+                and num_d > cfg.min_endpoint:
+            return num_p + 1, num_d - 1, True
+        if breakdown.bottleneck() == "decode" \
+                and num_p > cfg.min_endpoint:
+            return num_p - 1, num_d + 1, True
+        return num_p, num_d, False
+
+    def _apply_hysteresis(self, cur: int, target: int,
+                          streak: int) -> tuple[int, int]:
+        """Scale-down hysteresis for one pool: a shrink only applies
+        after `hysteresis_intervals` consecutive intervals wanted it
+        (growth always applies immediately). Returns (applied_target,
+        new_streak)."""
+        if target >= cur:
+            return target, 0
+        streak += 1
+        if streak >= self.config.hysteresis_intervals:
+            return target, streak
+        return cur, streak
+
+    def plan(self, stats: TrafficStats,
+             breakdown: Optional[PhaseBreakdown] = None,
+             ) -> Optional[tuple[int, int]]:
         """Full interval: observe -> correct -> predict -> compute ->
-        budget clamp. Returns (num_p, num_d) or None (no traffic)."""
+        goodput correction -> budget clamp -> hysteresis. Returns
+        (num_p, num_d) or None (no traffic)."""
         self.state.intervals += 1
         if not stats.is_valid() or stats.num_req <= 0:
             log.info("no traffic in interval; skipping adjustment")
@@ -209,8 +296,67 @@ class SlaPlanner:
         num_p = (self.compute_num_prefill(num_req, isl)
                  if self.disagg and self.prefill_interp is not None else 0)
         num_d = self.compute_num_decode(num_req, isl, osl)
+        # -- goodput correction (the loop that makes this a CONTROL
+        # plane): the raw-load math above scales on latency-corrected
+        # throughput, which is blind to admission-queue burn — a pool
+        # can satisfy its interpolated ITL while every request blows its
+        # TTFT budget waiting to be scheduled. When the SLO-good ratio
+        # drops below target, grow the pool the flight-recorder phase
+        # breakdown names as the bottleneck beyond what raw load asked.
+        if breakdown is None and self.breakdown_source is not None:
+            breakdown = self.breakdown_source.fetch()
+        goodput = stats.goodput_ratio()
+        violated = (goodput is not None
+                    and goodput < self.config.goodput_target)
+        cur_p, cur_d = (self.state.last_decision
+                        or (self.state.num_p_workers or num_p,
+                            self.state.num_d_workers or num_d))
+        if violated:
+            bottleneck = (breakdown.bottleneck()
+                          if breakdown is not None and breakdown.samples
+                          else "decode")
+            if bottleneck == "prefill" and self.disagg \
+                    and self.prefill_interp is not None:
+                num_p = max(num_p, cur_p + 1)
+            else:
+                num_d = max(num_d, cur_d + 1)
+        pre_clamp = (num_p, num_d)
         num_p, num_d = apply_chip_budget(num_p, num_d, self.config)
+        moved = False
+        if violated and (num_p, num_d) != pre_clamp:
+            # The budget clamped the goodput scale-up away: the P/D
+            # ratio is the only lever left.
+            num_p, num_d, moved = self._rebalance_pd(num_p, num_d,
+                                                     breakdown)
+        wanted = (num_p, num_d)
+        num_p, self.state.down_streak_p = self._apply_hysteresis(
+            cur_p, num_p, self.state.down_streak_p)
+        num_d, self.state.down_streak_d = self._apply_hysteresis(
+            cur_d, num_d, self.state.down_streak_d)
+        # Hysteresis can re-inflate a held shrink next to an immediate
+        # grow (e.g. a rebalance whose shrink half is held): re-clamp so
+        # the applied decision NEVER exceeds the chip budget.
+        num_p, num_d = apply_chip_budget(num_p, num_d, self.config)
+        if (num_p, num_d) == (cur_p, cur_d):
+            reason = "hysteresis_hold" if wanted != (cur_p, cur_d) \
+                else "hold"
+        elif moved:
+            reason = "rebalance"
+        else:
+            reason = ("scale_up" if num_p + num_d > cur_p + cur_d
+                      else "scale_down")
         self.state.last_decision = (num_p, num_d)
+        targets = {"decode": num_d}
+        if self.disagg:
+            targets["prefill"] = num_p
+        publish_planner_decision(targets, reason, goodput)
+        PLANNER_CORRECTION.labels(phase="prefill").set(
+            self.state.p_correction)
+        PLANNER_CORRECTION.labels(phase="decode").set(
+            self.state.d_correction)
+        log.info("plan: prefill=%d decode=%d reason=%s goodput=%s",
+                 num_p, num_d, reason,
+                 f"{goodput:.3f}" if goodput is not None else "n/a")
         return num_p, num_d
 
     async def apply(self, decision: tuple[int, int]) -> None:
@@ -258,23 +404,106 @@ class SlaPlanner:
                 pass
 
 
+class PdSplitPlanner:
+    """Chooses the P/D pool split that maximizes measured SLO-good
+    tokens per chip.
+
+    The SLA planner's interpolators predict each pool in isolation; past
+    the capacity knee the coupling (prefill backlog starving decode, KV
+    handoff overlap) makes the measured goodput-per-chip of each SPLIT
+    the only trustworthy signal. This planner consumes those
+    measurements — one per (num_p, num_d) operating point, from the
+    chaos ramp or a live A/B interval — EMA-smoothed, and converges on
+    the argmax with switch hysteresis: the incumbent split is only
+    abandoned when a challenger beats it by `switch_margin`, so
+    measurement noise and breaker/retry transients cannot thrash the
+    pools."""
+
+    def __init__(self, switch_margin: float = 0.05,
+                 ema_alpha: float = 0.5) -> None:
+        self.switch_margin = switch_margin
+        self.ema_alpha = ema_alpha
+        self.scores: dict[tuple[int, int], float] = {}
+        self.current: Optional[tuple[int, int]] = None
+        self.decisions: list[dict] = []
+
+    def observe(self, num_p: int, num_d: int,
+                good_tokens_per_chip: float) -> None:
+        key = (num_p, num_d)
+        prev = self.scores.get(key)
+        self.scores[key] = (good_tokens_per_chip if prev is None else
+                            self.ema_alpha * good_tokens_per_chip
+                            + (1 - self.ema_alpha) * prev)
+        if self.current is None:
+            self.current = key
+
+    def best(self) -> Optional[tuple[int, int]]:
+        """The split the planner commits to: argmax of smoothed
+        goodput/chip, unless the incumbent is within switch_margin of
+        it (hysteresis: prefer stability over a marginal win)."""
+        if not self.scores:
+            return None
+        top = max(self.scores, key=lambda k: self.scores[k])
+        if self.current is not None and self.current in self.scores:
+            incumbent = self.scores[self.current]
+            if self.scores[top] <= incumbent * (1 + self.switch_margin):
+                top = self.current
+        if top != self.current:
+            self.decisions.append({
+                "from": list(self.current) if self.current else None,
+                "to": list(top),
+                "scores": {f"{k[0]}P/{k[1]}D": round(v, 3)
+                           for k, v in self.scores.items()}})
+            self.current = top
+            publish_planner_decision(
+                {"prefill": top[0], "decode": top[1]}, "rebalance")
+        return top
+
+
 class LoadBasedPlanner:
     """±1 scaling from per-engine SLA estimates (ref prefill_planner.py
     load_plan_adjustment / decode_planner.py): scale up when ALL engines
-    violate the SLA estimate, down when ALL are below sla*sensitivity."""
+    violate the SLA estimate, down when ALL are below sla*sensitivity.
+    When a goodput signal is available (observe_goodput / a scraper on
+    the run loop), a violated SLO-good ratio forces growth and vetoes
+    shrinking — per-engine estimates are blind to admission-queue burn."""
 
     def __init__(self, config: PlannerConfig, connector: Connector,
-                 source: LoadEventSource) -> None:
+                 source: LoadEventSource,
+                 scraper: Optional[FrontendScraper] = None) -> None:
         self.config = config
         self.connector = connector
         self.source = source
+        self.scraper = scraper
         self.ttft_est = TtftEstimator()
         self.itl_est = ItlEstimator()
         self.state = PlannerState()
         self._task: Optional[asyncio.Task] = None
+        self._goodput_ratio: Optional[float] = None
         # last snapshot object fed to the estimators, per worker (held
         # by reference so identity comparison cannot see a recycled id)
         self._ingested: dict[tuple[int, int], dict] = {}
+
+    def observe_goodput(self, good: float, total: float) -> None:
+        """Feed an interval's SLO counters (dynamo_slo_good_total /
+        dynamo_slo_requests_total deltas). No traffic leaves the
+        previous verdict in place; a NaN good count (a scraper without
+        the absent-series coercion) must not poison the gate — NaN
+        compares False everywhere, which would silently disable it."""
+        if total > 0 and not math.isnan(good):
+            self._goodput_ratio = good / total
+            PLANNER_GOODPUT_RATIO.set(self._goodput_ratio)
+
+    def _goodput_adjust(self, proposed: int, current: int) -> int:
+        """Gate a per-engine-estimate decision through the goodput
+        verdict: a violated interval never shrinks and grows at least
+        +1 even when every engine's estimate looks healthy (the queue
+        burn the estimates cannot see is exactly what goodput sees)."""
+        if self._goodput_ratio is None:
+            return proposed
+        if self._goodput_ratio < self.config.goodput_target:
+            return max(proposed, current + 1)
+        return proposed
 
     def ingest(self) -> None:
         live = self.source.keyed()
@@ -313,16 +542,17 @@ class LoadBasedPlanner:
     def plan_decode(self, current_replicas: int) -> int:
         self.ingest()
         if not self.itl_est.has_sufficient_data():
-            return current_replicas
+            return self._goodput_adjust(current_replicas, current_replicas)
         ests = []
         for snap in self.source.snapshots():
             active = int(snap.get("active_requests", 0))
             est = self.itl_est.estimate_itl_ms(active)
             if est is not None:
                 ests.append(est)
-        return self._decide(ests, self.config.itl_ms, current_replicas,
-                            self.config.scale_down_sensitivity,
-                            self.config.min_endpoint)
+        proposed = self._decide(ests, self.config.itl_ms, current_replicas,
+                                self.config.scale_down_sensitivity,
+                                self.config.min_endpoint)
+        return self._goodput_adjust(proposed, current_replicas)
 
     def plan_prefill(self, current_replicas: int,
                      queued_tokens_per_engine: list[int],
@@ -358,6 +588,11 @@ class LoadBasedPlanner:
                     self.config.decode_component)
                 if obs is not None and obs > 0:
                     current = obs
+                if self.scraper is not None:
+                    stats = self.scraper.scrape()
+                    if stats is not None and not math.isnan(stats.slo_total):
+                        self.observe_goodput(stats.slo_good,
+                                             stats.slo_total)
                 target = self.plan_decode(current)
                 if target != current:
                     log.info("load planner: decode %d -> %d replicas",
@@ -365,7 +600,14 @@ class LoadBasedPlanner:
                     await self.connector.set_component_replicas(
                         [TargetReplica(self.config.decode_component,
                                        target)])
+                    publish_planner_decision(
+                        {"decode": target},
+                        "scale_up" if target > current else "scale_down",
+                        self._goodput_ratio)
                     current = target
+                else:
+                    publish_planner_decision({"decode": current}, "hold",
+                                             self._goodput_ratio)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — one bad interval must not

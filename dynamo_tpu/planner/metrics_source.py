@@ -14,6 +14,7 @@ plane (the ForwardPassMetrics analog) and feeds the online regressions.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import re
 import time
@@ -55,7 +56,10 @@ def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
 @dataclasses.dataclass
 class TrafficStats:
     """Per-interval averages handed to the planner (ref Metrics struct,
-    planner_core.py:108)."""
+    planner_core.py:108). The SLO block (slo_good / slo_total / shed)
+    feeds goodput-driven planning: the planner optimizes SLO-good tokens
+    per chip, not raw load — past the capacity knee those diverge
+    (docs/fault-tolerance.md control loop)."""
 
     num_req: float = math.nan  # requests completed in interval
     ttft_ms: float = math.nan
@@ -63,11 +67,35 @@ class TrafficStats:
     isl: float = math.nan
     osl: float = math.nan
     request_duration_s: float = math.nan
+    # SLO layer deltas over the interval (dynamo_slo_* + shed counters);
+    # nan when the frontend predates the goodput layer.
+    slo_good: float = math.nan
+    slo_total: float = math.nan
+    shed: float = math.nan
 
     def is_valid(self) -> bool:
         return not any(math.isnan(v) for v in
                        (self.num_req, self.ttft_ms, self.itl_ms,
                         self.isl, self.osl))
+
+    def goodput_ratio(self) -> Optional[float]:
+        """SLO-good fraction of finished requests this interval; None
+        when the goodput counters are absent or saw no traffic."""
+        if math.isnan(self.slo_good) or math.isnan(self.slo_total) \
+                or self.slo_total <= 0:
+            return None
+        return self.slo_good / self.slo_total
+
+    def shed_fraction(self) -> Optional[float]:
+        """Fraction of offered work shed at admission this interval
+        (shed requests never reach the finished counters, so the
+        denominator is finished + shed)."""
+        if math.isnan(self.shed) or math.isnan(self.slo_total):
+            return None
+        offered = self.slo_total + self.shed
+        if offered <= 0:
+            return None
+        return self.shed / offered
 
 
 class FrontendScraper:
@@ -124,6 +152,20 @@ class FrontendScraper:
             return ds / dc * scale
 
         num_req = delta("dynamo_requests_total", {"status": "ok"})
+        # Goodput layer (PR5 counters): SLO-good vs finished, plus
+        # early-shed volume across every reason (deadline / busy /
+        # queue) — together the planner's objective signal. A counter
+        # child that was never incremented has NO series: with traffic
+        # flowing but zero good requests (an overloaded restart — the
+        # exact regime the loop exists for), the absent good series
+        # means 0, not unknown. Same for shed with no sheds yet.
+        slo_total = delta("dynamo_slo_requests_total", model)
+        slo_good = delta("dynamo_slo_good_total", model)
+        if not math.isnan(slo_total) and math.isnan(slo_good):
+            slo_good = 0.0
+        shed = delta("dynamo_requests_shed_total", {})
+        if not math.isnan(slo_total) and math.isnan(shed):
+            shed = 0.0
         return TrafficStats(
             num_req=num_req,
             ttft_ms=avg("dynamo_time_to_first_token_seconds", model, 1e3),
@@ -131,7 +173,98 @@ class FrontendScraper:
             isl=avg("dynamo_input_sequence_tokens", model),
             osl=avg("dynamo_output_sequence_tokens", model),
             request_duration_s=avg("dynamo_request_duration_seconds", {}),
+            slo_good=slo_good,
+            slo_total=slo_total,
+            shed=shed,
         )
+
+
+@dataclasses.dataclass
+class PhaseBreakdown:
+    """Where finished requests burned their wall time, averaged over an
+    interval (ms per request): admission/scheduler queue vs prefill vs
+    decode. Derived from flight-recorder timelines (/debug/requests,
+    docs/observability.md) — the signal that tells the planner WHICH
+    pool is the bottleneck when goodput collapses (queue+prefill burn
+    dominant -> the prefill/admission side is drowning; decode burn
+    dominant -> the decode pool is)."""
+
+    queue_ms: float = 0.0
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+    samples: int = 0
+
+    def bottleneck(self) -> str:
+        """'prefill' when pre-first-token burn (queue + prefill)
+        dominates, else 'decode'."""
+        return ("prefill" if self.queue_ms + self.prefill_ms
+                >= self.decode_ms else "decode")
+
+
+class PhaseBreakdownSource:
+    """Interval-averaged phase burn from the frontend's flight recorder.
+
+    Fetches `/debug/requests` (the frontend needs DYNT_DEBUG_ENDPOINTS=1,
+    or point this at the system status server) and averages the phase
+    deltas of completed timelines not seen in a previous fetch. Absent
+    phases degrade gracefully: a request with no prefill_start charges
+    its whole pre-first-token wait to the queue bucket."""
+
+    def __init__(self, debug_url: str) -> None:
+        self.url = debug_url
+        self._seen: set[str] = set()
+
+    @staticmethod
+    def _burn(phases: dict) -> Optional[tuple[float, float, float]]:
+        received = phases.get("received")
+        first = phases.get("first_token")
+        finished = phases.get("finished")
+        if received is None or finished is None:
+            return None
+        prefill_start = phases.get("prefill_start")
+        if first is None:
+            # Never produced a token (shed late, errored, deadline):
+            # everything burned before service counts as queue burn.
+            return ((finished - received) * 1e3, 0.0, 0.0)
+        if prefill_start is None:
+            prefill_start = first
+        return (max(0.0, prefill_start - received) * 1e3,
+                max(0.0, first - prefill_start) * 1e3,
+                max(0.0, finished - first) * 1e3)
+
+    def fetch(self) -> Optional[PhaseBreakdown]:
+        try:
+            with urllib.request.urlopen(self.url, timeout=10.0) as resp:
+                snap = json.loads(resp.read().decode())
+        except Exception as exc:  # noqa: BLE001 — retried next interval
+            log.warning("phase breakdown fetch failed: %r", exc)
+            return None
+        return self.ingest(snap)
+
+    def ingest(self, snap: dict) -> PhaseBreakdown:
+        """Fold a /debug/requests snapshot into an interval breakdown
+        (separated from fetch() so in-process scenarios can feed the
+        recorder snapshot directly)."""
+        out = PhaseBreakdown()
+        fresh: list[tuple[float, float, float]] = []
+        seen_now: set[str] = set()
+        for tl in snap.get("completed", []):
+            rid = tl.get("request_id", "")
+            seen_now.add(rid)
+            if rid in self._seen:
+                continue
+            burn = self._burn(tl.get("phases", {}))
+            if burn is not None:
+                fresh.append(burn)
+        # Forget ids that rotated out of the ring so the seen set stays
+        # bounded by the recorder capacity.
+        self._seen = seen_now
+        if fresh:
+            out.queue_ms = sum(b[0] for b in fresh) / len(fresh)
+            out.prefill_ms = sum(b[1] for b in fresh) / len(fresh)
+            out.decode_ms = sum(b[2] for b in fresh) / len(fresh)
+            out.samples = len(fresh)
+        return out
 
 
 class LoadEventSource:

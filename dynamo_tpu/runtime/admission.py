@@ -1,0 +1,254 @@
+"""Deadline-aware admission control: queue-wait estimation + early shed.
+
+The resilience plane (docs/fault-tolerance.md) refuses budgets that are
+*already* spent — but a request whose budget cannot survive the current
+queue is still accepted FCFS and 504s minutes later, after burning
+prefill work on an answer nobody is waiting for. This module closes that
+gap: every admission edge (frontend, router admission queue, prefill
+router) consults a per-pool queue-wait estimate and refuses work whose
+`x-dynt-deadline-ms` budget cannot survive the estimated wait, with
+503 + an honest `Retry-After` derived from the estimated drain time.
+Shedding moves from "late 504 after wasted work" to "immediate 503
+before any work" — the admission-control half of 'The Tail at Scale'.
+
+The estimate is deliberately simple and self-correcting:
+
+    wait ≈ queue_depth / drain_rate
+
+* `depth` is the work currently ahead of a new arrival: the local heap
+  for the router admission queue; the sum of worker-published
+  `waiting_requests` (LoadMetrics on the event plane — the scheduler's
+  own step-loop queue stats) for the frontend and prefill-pool edges.
+* `drain_rate` is an exponentially-weighted rate of observed drain
+  events — requests entering service — measured where each edge can see
+  them (first tokens at the frontend, dequeues at the router queue,
+  completed legs at the prefill router). The EWMA decays during silence,
+  so a stalled pool (depth > 0, nothing draining) estimates an unbounded
+  wait and sheds everything with a capped Retry-After instead of
+  queueing doomed work behind the stall.
+
+Edges are independent (`per-pool isolation`): the decode pool backing a
+model, the prefill pool, and the router's own parking heap each hold
+their own estimator, so a drowning prefill tier cannot poison decode
+admission and vice versa.
+
+Conservatism rules (an admission controller that sheds on noise is worse
+than none): no deadline -> always admit (there is no budget to protect);
+empty queue -> always admit (nothing to wait behind); no drain ever
+observed (cold start) -> admit (no evidence of a stall yet).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Optional
+
+from .config import env
+
+# Refuse only when the estimated wait exceeds the remaining budget by the
+# DYNT_ADMISSION_MARGIN factor *after* leaving this fraction of budget
+# for actual service — a request admitted with exactly queue-wait budget
+# still 504s mid-prefill.
+_INF_WAIT_MS = float("inf")
+
+
+class AdmissionRefused(RuntimeError):
+    """Raised at an admission edge when a request's deadline budget
+    cannot survive the estimated queue wait. Maps to 503 +
+    `Retry-After` at the frontend — NOT a transport failure: routers
+    must neither retry it (the condition is pool-wide, not
+    per-instance) nor breaker-penalize anyone."""
+
+    def __init__(self, message: str, *, retry_after_s: float,
+                 est_wait_ms: float, pool: str) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.est_wait_ms = est_wait_ms
+        self.pool = pool
+
+
+@dataclasses.dataclass
+class AdmissionDecision:
+    admit: bool
+    est_wait_ms: float
+    retry_after_s: float
+    reason: str = ""
+
+
+class DrainRateEwma:
+    """EWMA of drain events per second over irregular sample times.
+
+    Each `observe(n)` folds `n` units drained since the previous
+    observation into the rate with exponential age-weighting
+    (half-life `halflife_s`). Reads fold in the silent gap since the
+    last drain — a pool that stops draining decays toward rate 0
+    instead of reporting its last healthy rate forever (the
+    stalled-drain edge case)."""
+
+    def __init__(self, halflife_s: float = 5.0) -> None:
+        self.halflife_s = max(1e-3, halflife_s)
+        self._rate: Optional[float] = None  # units/sec; None = cold
+        self._last: Optional[float] = None  # monotonic time of last obs
+
+    def _decay(self, dt: float) -> float:
+        return 0.5 ** (dt / self.halflife_s)
+
+    def observe(self, n: float = 1.0, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if self._last is None:
+            # First observation anchors the clock; a rate needs an
+            # interval. Seed optimistically at n per half-life (the next
+            # interval corrects it) — seeding at 0 would make the very
+            # first queue estimate infinite.
+            self._last = now
+            if n > 0:
+                self._rate = n / self.halflife_s
+            return
+        dt = max(1e-6, now - self._last)
+        inst = n / dt
+        w = self._decay(dt)
+        self._rate = inst if self._rate is None else (
+            w * self._rate + (1.0 - w) * inst)
+        self._last = now
+
+    def rate(self, now: Optional[float] = None) -> Optional[float]:
+        """Units/sec, decayed by the silence since the last observation;
+        None while cold (no drain ever observed). Silence past one
+        half-life is folded in as zero drains observed over the gap —
+        so a stalled pool decays toward 0 instead of reporting its last
+        healthy rate forever, while the grace window keeps ordinary
+        inter-event gaps from discounting a live rate."""
+        if self._rate is None or self._last is None:
+            return None
+        now = time.monotonic() if now is None else now
+        gap = max(0.0, now - self._last)
+        if gap <= self.halflife_s:
+            return self._rate
+        return self._rate * self._decay(gap - self.halflife_s)
+
+
+class QueueWaitEstimator:
+    """Per-pool queue-wait estimate = depth / drain-rate EWMA.
+
+    Depth comes either from `set_depth` (edges that own their queue, e.g.
+    the router admission heap) or from `update_worker` (edges that read
+    worker-published LoadMetrics `waiting_requests`; entries expire after
+    `worker_ttl_s` so a dead worker's backlog stops counting)."""
+
+    def __init__(self, pool: str = "default",
+                 halflife_s: Optional[float] = None,
+                 worker_ttl_s: float = 30.0) -> None:
+        if halflife_s is None:
+            halflife_s = env("DYNT_ADMISSION_HALFLIFE_SECS")
+        self.pool = pool
+        self.drain = DrainRateEwma(halflife_s)
+        self.worker_ttl_s = worker_ttl_s
+        self._depth = 0
+        self._workers: dict[int, tuple[int, float]] = {}  # id -> (waiting, t)
+
+    # -- inputs ------------------------------------------------------------
+
+    def observe_drained(self, n: float = 1.0,
+                        now: Optional[float] = None) -> None:
+        self.drain.observe(n, now=now)
+
+    def set_depth(self, depth: int) -> None:
+        self._depth = max(0, int(depth))
+        self._workers.clear()
+
+    def update_worker(self, worker_id: int, waiting: int,
+                      now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._workers[worker_id] = (max(0, int(waiting)), now)
+
+    # -- estimates ---------------------------------------------------------
+
+    def depth(self, now: Optional[float] = None) -> int:
+        if not self._workers:
+            return self._depth
+        now = time.monotonic() if now is None else now
+        cutoff = now - self.worker_ttl_s
+        for wid in [w for w, (_, ts) in self._workers.items() if ts < cutoff]:
+            del self._workers[wid]
+        return sum(n for n, _ in self._workers.values())
+
+    def estimate_wait_ms(self, extra: int = 0,
+                         now: Optional[float] = None) -> float:
+        """Estimated queue wait for an arrival behind `depth() + extra`
+        units. 0 for an empty queue; inf for a stalled drain (depth > 0
+        and the rate has decayed to ~nothing); 0 while cold (no drain
+        evidence yet — admit until there is a measured reason not to)."""
+        now = time.monotonic() if now is None else now
+        ahead = self.depth(now=now) + max(0, extra)
+        if ahead <= 0:
+            return 0.0
+        rate = self.drain.rate(now=now)
+        if rate is None:
+            return 0.0  # cold start: no evidence of a stall
+        if rate <= 1e-9:
+            return _INF_WAIT_MS
+        return ahead / rate * 1e3
+
+    def retry_after_s(self, est_wait_ms: float) -> float:
+        """Honest Retry-After: the estimated time for the backlog to
+        drain, clamped to the registered floor/cap knobs."""
+        floor = env("DYNT_RETRY_AFTER_MIN_SECS")
+        cap = env("DYNT_RETRY_AFTER_MAX_SECS")
+        if math.isinf(est_wait_ms):
+            return cap
+        return min(cap, max(floor, est_wait_ms / 1e3))
+
+    def check(self, deadline, extra: int = 0,
+              now: Optional[float] = None) -> AdmissionDecision:
+        """Admission verdict for a request with `deadline` budget (a
+        runtime.resilience.Deadline or None). Refuses when the estimated
+        wait, scaled by DYNT_ADMISSION_MARGIN (headroom for the service
+        time after the queue), exceeds the remaining budget."""
+        est = self.estimate_wait_ms(extra=extra, now=now)
+        retry_after = self.retry_after_s(est)
+        if deadline is None or est <= 0.0:
+            return AdmissionDecision(True, est, retry_after)
+        remaining_ms = deadline.remaining() * 1e3
+        margin = env("DYNT_ADMISSION_MARGIN")
+        if est * margin > remaining_ms:
+            return AdmissionDecision(
+                False, est, retry_after,
+                reason=(f"estimated queue wait {est:.0f}ms (pool "
+                        f"{self.pool!r}) exceeds remaining deadline "
+                        f"budget {remaining_ms:.0f}ms"))
+        return AdmissionDecision(True, est, retry_after)
+
+    def refuse(self, decision: AdmissionDecision) -> AdmissionRefused:
+        return AdmissionRefused(decision.reason or "admission refused",
+                                retry_after_s=decision.retry_after_s,
+                                est_wait_ms=decision.est_wait_ms,
+                                pool=self.pool)
+
+
+def admission_enabled() -> bool:
+    return bool(env("DYNT_ADMISSION_ENABLE"))
+
+
+def check_admission(estimator: QueueWaitEstimator, deadline,
+                    extra: int = 0) -> AdmissionDecision:
+    """Edge entry point shared by the frontend, the router admission
+    queue and the prefill router: evaluate, publish the pool's
+    queue-wait gauge, and raise AdmissionRefused (counted under
+    dynamo_requests_shed_total{reason="queue"}) on refusal. A disabled
+    loop (DYNT_ADMISSION_ENABLE=0) admits unconditionally and publishes
+    nothing — the pure-FCFS baseline the chaos A/B measures against."""
+    from .metrics import ADMISSION_WAIT_MS, REQUESTS_SHED
+
+    if not admission_enabled():
+        return AdmissionDecision(True, 0.0, 0.0)
+    decision = estimator.check(deadline, extra=extra)
+    gauge = decision.est_wait_ms
+    if math.isinf(gauge):
+        gauge = decision.retry_after_s * 1e3
+    ADMISSION_WAIT_MS.labels(pool=estimator.pool).set(gauge)
+    if not decision.admit:
+        REQUESTS_SHED.labels(reason="queue").inc()
+        raise estimator.refuse(decision)
+    return decision

@@ -275,6 +275,34 @@ _register("DYNT_SLO_ITL_MS", 0.0, _float,
           "Worst-token ITL target for the dynamo_slo_good_total goodput "
           "counter; 0 means no ITL requirement")
 
+# Deadline-aware admission — overload-control loop (runtime/admission.py;
+# degradation ladder + chaos-overload how-to in docs/fault-tolerance.md)
+_register("DYNT_ADMISSION_ENABLE", True, _bool,
+          "Deadline-aware admission at the frontend, router admission "
+          "queue and prefill router: refuse work whose x-dynt-deadline-ms "
+          "budget cannot survive the estimated queue wait (503 + honest "
+          "Retry-After) instead of FCFS-ing it into a late 504. Only "
+          "acts on requests that carry a deadline AND pools with "
+          "measured drain evidence — cold pools and empty queues always "
+          "admit. Off restores pure FCFS admission")
+_register("DYNT_ADMISSION_HALFLIFE_SECS", 5.0, _float,
+          "Half-life of the per-pool drain-rate EWMA behind the queue-"
+          "wait estimate; shorter reacts faster to stalls, longer "
+          "smooths bursty drains")
+_register("DYNT_ADMISSION_MARGIN", 1.2, _float,
+          "Safety factor on the estimated queue wait when checked "
+          "against the remaining deadline budget: refuse when "
+          "est_wait * margin > remaining. >1 leaves headroom for the "
+          "service time after the queue (a request admitted with "
+          "exactly queue-wait budget still 504s mid-prefill)")
+_register("DYNT_RETRY_AFTER_MIN_SECS", 1.0, _float,
+          "Floor on the Retry-After seconds attached to 503 shed "
+          "responses (derived from the estimated queue drain time)")
+_register("DYNT_RETRY_AFTER_MAX_SECS", 30.0, _float,
+          "Cap on the Retry-After seconds attached to 503 shed "
+          "responses; also what a stalled pool (unbounded estimated "
+          "wait) advertises")
+
 # Fault tolerance — resilience plane (runtime/resilience.py; knob
 # semantics and the degradation ladder in docs/fault-tolerance.md)
 _register("DYNT_DEADLINE_SECS", 600.0, _float,

@@ -162,10 +162,13 @@ class FlightRecorder:
             tl.phases.setdefault("finished", time.time())
             tl.slow = bool(self.slow_ms) and tl.elapsed_ms() >= self.slow_ms
             self._completed.append(tl)
-        if status not in ("ok", "cancelled"):
+        if status not in ("ok", "cancelled", "shed"):
             # Errors and deadline overruns auto-dump; plain client
             # cancellations are normal stream teardown (e.g. a prefill
             # leg whose consumer got its params) and would be noise.
+            # Admission sheds ("shed") are DELIBERATE bounded
+            # degradation — dumping each one would storm the log at
+            # exactly the moment the system is overloaded.
             log.warning("flight record (%s): %s", status,
                         json.dumps(tl.to_json()))
         elif tl.slow:

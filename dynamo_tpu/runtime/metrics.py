@@ -109,6 +109,49 @@ REQUESTS_SHED = Counter(
     "Requests shed at admission with 503, by reason",
     ["reason"], registry=REGISTRY,
 )
+# Deadline-aware admission (runtime/admission.py): the queue-wait
+# estimate each admission edge checks deadlines against. A rising gauge
+# with flat shed counts means budgets still cover the backlog; shed
+# counts rising with a flat gauge means budgets got shorter.
+ADMISSION_WAIT_MS = Gauge(
+    "dynamo_admission_queue_wait_ms",
+    "Estimated queue wait (ms) at an admission edge's last decision, "
+    "per pool (inf collapses to the Retry-After cap)",
+    ["pool"], registry=REGISTRY,
+)
+# Planner observability (planner/core.py + global_planner): every
+# adjustment interval publishes its targets and the reason for the last
+# decision, so chaos assertions and operators read planner behavior from
+# /metrics instead of log-scraping (docs/metrics.md).
+PLANNER_TARGET_REPLICAS = Gauge(
+    "dynamo_planner_target_replicas",
+    "Replica target the planner last decided, per pool "
+    "(prefill / decode, or the pool namespace under the global planner)",
+    ["pool"], registry=REGISTRY,
+)
+PLANNER_CORRECTION = Gauge(
+    "dynamo_planner_correction_factor",
+    "SLA planner correction factor (observed latency / interpolated "
+    "expectation), per phase (prefill / decode)",
+    ["phase"], registry=REGISTRY,
+)
+PLANNER_GOODPUT_RATIO = Gauge(
+    "dynamo_planner_goodput_ratio",
+    "SLO-good / total request ratio the planner observed in its last "
+    "adjustment interval (from the frontend dynamo_slo_* counters)",
+    registry=REGISTRY,
+)
+PLANNER_DECISIONS = Counter(
+    "dynamo_planner_decisions_total",
+    "Planner decisions by pool and reason (scale_up / scale_down / "
+    "hold / rebalance / hysteresis_hold)",
+    ["pool", "reason"], registry=REGISTRY,
+)
+PLANNER_LAST_DECISION_TS = Gauge(
+    "dynamo_planner_last_decision_unixtime",
+    "Wall-clock time of the planner's most recent applied decision",
+    registry=REGISTRY,
+)
 # SLO goodput layer (docs/observability.md): the planner consumes
 # good/total ratios per model instead of re-deriving them from latency
 # histograms ("goodput, not throughput" — the serving-SLO literature).

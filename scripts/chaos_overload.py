@@ -1,0 +1,66 @@
+"""Chaos-overload CI driver: ramp an open-loop mocker load past the
+capacity knee with the admission loop off/on, sweep P/D splits, assert
+graceful degradation, and write the goodput-vs-load JSON report the CI
+job uploads as an artifact (docs/fault-tolerance.md chaos how-to).
+
+Headless, CPU-only, chip-free: everything runs in-process through
+dynamo_tpu.mocker.overload. Exits nonzero when any scenario assertion
+fails, so the chaos-overload job gates on the degradation contract.
+
+    python scripts/chaos_overload.py --out chaos-overload
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("chaos_overload")
+    parser.add_argument("--out", default="chaos-overload",
+                        help="report output directory")
+    parser.add_argument("--quick", action="store_true",
+                        help="shorter ramp/sweep (local smoke)")
+    parser.add_argument("--no-pd-sweep", action="store_true",
+                        help="skip the P/D split sweep phase")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+
+    from dynamo_tpu.mocker.overload import OverloadParams, run_scenario
+
+    params = OverloadParams()
+    if args.quick:
+        params = OverloadParams(ramp_secs=16.0, ramp_end_rps=28.0,
+                                bucket_secs=4.0, sweep_secs=6.0)
+    report = asyncio.run(run_scenario(params,
+                                      pd_sweep=not args.no_pd_sweep))
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "chaos_overload_report.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+
+    print(f"report: {path}")
+    for chk in report["assertions"]:
+        mark = "PASS" if chk["ok"] else "FAIL"
+        print(f"  [{mark}] {chk['name']}")
+        if not chk["ok"]:
+            print(f"         {json.dumps(chk['detail'])[:400]}")
+    curve = [(b["offered_rps"], b["goodput_rps"], b["shed_frac"])
+             for b in report["ramp_on"]["buckets"]]
+    print("goodput-vs-load (loop on): "
+          + " ".join(f"{o:.1f}->{g:.1f}({s:.0%})" for o, g, s in curve))
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""Deadline-aware admission tests (runtime/admission.py + the three
+admission edges): drain-rate EWMA convergence, empty-queue / stalled-
+drain / cold-start edge cases, per-pool isolation, and refusal semantics
+at the frontend, the router admission queue, and the prefill router
+(docs/fault-tolerance.md shed-early rung)."""
+
+import asyncio
+import math
+import uuid
+
+import pytest
+
+from dynamo_tpu.kv_router import KvRouterConfig, KvScheduler, WorkerWithDpRank
+from dynamo_tpu.kv_router.queue import QueuedRequest, SchedulerQueue
+from dynamo_tpu.runtime.admission import (
+    AdmissionRefused,
+    DrainRateEwma,
+    QueueWaitEstimator,
+    check_admission,
+)
+from dynamo_tpu.runtime.resilience import Deadline
+
+
+def _deadline(secs: float) -> Deadline:
+    return Deadline(secs)
+
+
+class TestDrainRateEwma:
+    def test_cold_rate_is_none(self):
+        assert DrainRateEwma().rate(now=10.0) is None
+
+    def test_converges_to_steady_rate(self):
+        ewma = DrainRateEwma(halflife_s=2.0)
+        # 4 drains/sec for 30 seconds of virtual time.
+        t = 0.0
+        while t < 30.0:
+            ewma.observe(1, now=t)
+            t += 0.25
+        rate = ewma.rate(now=t)
+        assert rate == pytest.approx(4.0, rel=0.15)
+
+    def test_batch_observations_equal_singles(self):
+        a, b = DrainRateEwma(halflife_s=2.0), DrainRateEwma(halflife_s=2.0)
+        for i in range(1, 41):
+            a.observe(2, now=i * 0.5)
+        for i in range(1, 41):
+            b.observe(2.0, now=i * 0.5)
+        assert a.rate(now=20.0) == pytest.approx(b.rate(now=20.0))
+
+    def test_stall_decays_rate(self):
+        ewma = DrainRateEwma(halflife_s=2.0)
+        for i in range(20):
+            ewma.observe(1, now=float(i))
+        healthy = ewma.rate(now=19.0)
+        assert healthy > 0.5
+        # Within the half-life grace window the rate holds...
+        assert ewma.rate(now=20.5) == pytest.approx(healthy)
+        # ...then decays toward zero: 10 half-lives of silence.
+        stalled = ewma.rate(now=19.0 + 2.0 + 20.0)
+        assert stalled < healthy / 500
+
+
+class TestQueueWaitEstimator:
+    def _warmed(self, pool="p", halflife=2.0, rate=5.0,
+                until=20.0) -> QueueWaitEstimator:
+        est = QueueWaitEstimator(pool=pool, halflife_s=halflife)
+        t = 0.0
+        while t < until:
+            est.observe_drained(1, now=t)
+            t += 1.0 / rate
+        return est
+
+    def test_empty_queue_estimates_zero_and_admits(self):
+        est = self._warmed()
+        assert est.estimate_wait_ms(now=20.0) == 0.0
+        # Even a nearly-spent budget is admitted against an empty queue.
+        decision = est.check(_deadline(0.001), now=20.0)
+        assert decision.admit
+
+    def test_cold_start_admits_despite_depth(self):
+        est = QueueWaitEstimator(pool="cold")
+        est.update_worker(1, 50, now=0.0)
+        # No drain ever observed: no evidence of a stall -> admit.
+        assert est.estimate_wait_ms(now=1.0) == 0.0
+        assert est.check(_deadline(0.5), now=1.0).admit
+
+    def test_wait_tracks_depth_over_rate(self):
+        est = self._warmed(rate=5.0)
+        est.update_worker(1, 10, now=20.0)
+        est.update_worker(2, 10, now=20.0)
+        # 20 queued at ~5/s -> ~4s estimated wait.
+        assert est.estimate_wait_ms(now=20.0) == pytest.approx(4000,
+                                                               rel=0.3)
+        assert not est.check(_deadline(1.0), now=20.0).admit
+        assert est.check(_deadline(30.0), now=20.0).admit
+
+    def test_stalled_drain_refuses_with_capped_retry_after(self):
+        est = self._warmed(rate=5.0)
+        est.update_worker(1, 10, now=120.0)  # backlog, drain long dead
+        wait = est.estimate_wait_ms(now=120.0)
+        assert math.isinf(wait)
+        decision = est.check(_deadline(60.0), now=120.0)
+        assert not decision.admit
+        # Stalled pool advertises the DYNT_RETRY_AFTER_MAX_SECS cap.
+        assert decision.retry_after_s == 30.0
+
+    def test_retry_after_floor_and_cap(self):
+        est = self._warmed(rate=5.0)
+        assert est.retry_after_s(10.0) == 1.0  # floor
+        assert est.retry_after_s(10_000.0) == 10.0
+        assert est.retry_after_s(10_000_000.0) == 30.0  # cap
+
+    def test_per_pool_isolation(self):
+        drowning = self._warmed(pool="prefill", rate=1.0)
+        drowning.update_worker(1, 100, now=20.0)
+        healthy = self._warmed(pool="decode", rate=10.0)
+        healthy.update_worker(1, 1, now=20.0)
+        assert not drowning.check(_deadline(5.0), now=20.0).admit
+        assert healthy.check(_deadline(5.0), now=20.0).admit
+
+    def test_dead_worker_depth_expires(self):
+        est = self._warmed(rate=5.0, until=100.0)
+        est.update_worker(1, 40, now=100.0)
+        assert est.depth(now=100.0) == 40
+        # TTL (30s) passes with no fresh report: the dead worker's
+        # backlog stops counting.
+        assert est.depth(now=140.0) == 0
+
+    def test_no_deadline_always_admits(self):
+        est = self._warmed(rate=1.0)
+        est.update_worker(1, 1000, now=20.0)
+        assert est.check(None, now=20.0).admit
+
+
+class TestCheckAdmission:
+    def _stalled(self) -> QueueWaitEstimator:
+        """A stalled pool anchored to the REAL clock (check_admission
+        reads time.monotonic()): drain learned long ago, fresh backlog."""
+        import time
+
+        base = time.monotonic()
+        est = QueueWaitEstimator(pool=f"t-{uuid.uuid4().hex[:6]}",
+                                 halflife_s=1.0)
+        for i in range(10):
+            est.observe_drained(1, now=base - 500.0 + i)
+        est.update_worker(1, 50, now=base)
+        return est
+
+    def test_refusal_raises_and_counts(self):
+        from dynamo_tpu.runtime.metrics import REQUESTS_SHED
+
+        est = self._stalled()
+        before = REQUESTS_SHED.labels(reason="queue")._value.get()
+        with pytest.raises(AdmissionRefused) as exc_info:
+            check_admission(est, _deadline(5.0))
+        assert exc_info.value.retry_after_s > 0
+        assert exc_info.value.pool == est.pool
+        after = REQUESTS_SHED.labels(reason="queue")._value.get()
+        assert after == before + 1
+
+    def test_disabled_admits_unconditionally(self, monkeypatch):
+        monkeypatch.setenv("DYNT_ADMISSION_ENABLE", "0")
+        decision = check_admission(self._stalled(), _deadline(5.0))
+        assert decision.admit
+
+    def test_healthy_pool_admits(self):
+        est = QueueWaitEstimator(pool="healthy", halflife_s=2.0)
+        now = 0.0
+        while now < 20.0:
+            est.observe_drained(1, now=now)
+            now += 0.1
+        est.update_worker(1, 1, now=20.0)
+        assert check_admission(est, _deadline(10.0)).admit
+
+
+BS = 16
+W0 = WorkerWithDpRank(1)
+
+
+class TestRouterQueueEdge:
+    """Deadline-aware refusal at the router admission queue: a request
+    about to PARK is checked against the heap's drain estimate."""
+
+    def _queue(self) -> SchedulerQueue:
+        sched = KvScheduler(KvRouterConfig(block_size=BS))
+        return SchedulerQueue(sched, threshold_frac=0.5,
+                              max_batched_tokens=lambda w: 100)
+
+    def test_park_with_surviving_budget_still_parks(self, run):
+        async def body():
+            q = self._queue()
+            await q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=96,
+                request_id="warm"))
+            task = asyncio.create_task(q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=8,
+                request_id="r1", deadline=Deadline(60.0))))
+            await asyncio.sleep(0.05)
+            assert q.pending_count == 1
+            q.scheduler.free("warm")
+            q.update()
+            result = await asyncio.wait_for(task, 2.0)
+            assert result.worker == W0
+
+        run(body())
+
+    def test_park_with_doomed_budget_refused(self, run):
+        async def body():
+            q = self._queue()
+            # Teach the estimator a slow-but-known drain, then stall it.
+            for i in range(10):
+                q.wait_estimator.observe_drained(1, now=float(i))
+            q.wait_estimator.drain._last = -1000.0  # long-dead drain
+            await q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=96,
+                request_id="warm"))
+            # Busy worker + non-empty backlog ahead: the next arrival
+            # would park behind a stalled drain -> refused, not parked.
+            parked = asyncio.create_task(q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=8,
+                request_id="r1")))  # no deadline: parks fine
+            await asyncio.sleep(0.05)
+            assert q.pending_count == 1
+            with pytest.raises(AdmissionRefused):
+                await q.schedule(QueuedRequest(
+                    candidates=[W0], block_hashes=[], isl_tokens=8,
+                    request_id="r2", deadline=Deadline(2.0)))
+            # The refused request never booked load or parked.
+            assert q.pending_count == 1
+            parked.cancel()
+            try:
+                await parked
+            except asyncio.CancelledError:
+                pass
+
+        run(body())
+
+    def test_drains_feed_rate(self, run):
+        async def body():
+            q = self._queue()
+            await q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=96,
+                request_id="warm"))
+            task = asyncio.create_task(q.schedule(QueuedRequest(
+                candidates=[W0], block_hashes=[], isl_tokens=8,
+                request_id="r1")))
+            await asyncio.sleep(0.05)
+            assert q.wait_estimator.drain.rate() is None  # cold
+            q.scheduler.free("warm")
+            q.update()
+            await asyncio.wait_for(task, 2.0)
+            assert q.wait_estimator.drain.rate() is not None
+
+        run(body())
+
+
+class TestPrefillRouterEdge:
+    def _pool(self):
+        from dynamo_tpu.llm.prefill_router import PrefillPool
+
+        pool = PrefillPool(router=None)  # router untouched on refusal
+        pool.instances = {7}
+        return pool
+
+    def _request(self, deadline_secs=2.0):
+        from dynamo_tpu.llm.protocols import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            request_id="pf-req", token_ids=[1, 2, 3, 4],
+            sampling=SamplingOptions(max_tokens=4), stop=StopConditions())
+        req.deadline = Deadline(deadline_secs)
+        return req
+
+    def test_doomed_budget_refused_before_prefill_leg(self, run):
+        from dynamo_tpu.llm.prefill_router import PrefillRouterEngine
+
+        import time
+
+        base = time.monotonic()
+        pool = self._pool()
+        for i in range(10):
+            pool.wait_estimator.observe_drained(1, now=base - 500.0 + i)
+        pool.wait_estimator.update_worker(7, 30, now=base)
+
+        class Inner:
+            async def generate(self, request):
+                raise AssertionError("refusal must precede any dispatch")
+                yield  # pragma: no cover
+
+        engine = PrefillRouterEngine(Inner(), pool_lookup=lambda: pool)
+
+        async def body():
+            with pytest.raises(AdmissionRefused):
+                async for _ in engine.generate(self._request()):
+                    pass
+
+        run(body())
+
+    def test_inactive_pool_skips_admission(self, run):
+        from dynamo_tpu.llm.prefill_router import (
+            PrefillPool,
+            PrefillRouterEngine,
+        )
+        from dynamo_tpu.llm.protocols import EngineOutput
+
+        pool = PrefillPool(router=None)  # no instances -> aggregated
+
+        class Inner:
+            async def generate(self, request):
+                yield EngineOutput(token_ids=[1], finish_reason="stop")
+
+        engine = PrefillRouterEngine(Inner(), pool_lookup=lambda: pool)
+
+        async def body():
+            outs = [o async for o in engine.generate(self._request(0.001))]
+            assert outs[-1].finish_reason == "stop"
+
+        run(body())
+
+
+class TestFrontendEdge:
+    """End-to-end over the real frontend + a mocker worker: a request
+    whose x-dynt-deadline-ms budget cannot survive the (forced) queue
+    estimate is shed 503 with an estimator-derived Retry-After."""
+
+    def _cfg(self, cluster):
+        from dynamo_tpu.runtime import RuntimeConfig
+
+        cfg = RuntimeConfig.from_env()
+        cfg.discovery_backend = "mem"
+        cfg.discovery_path = cluster
+        cfg.request_plane = "tcp"
+        cfg.tcp_host = "127.0.0.1"
+        cfg.event_plane = "mem"
+        cfg.system_enabled = False
+        return cfg
+
+    def test_frontend_sheds_doomed_budget_with_retry_after(self, run):
+        import aiohttp
+
+        from dynamo_tpu.frontend import Frontend
+        from dynamo_tpu.mocker import MockerConfig, MockerWorker
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            wrt = await DistributedRuntime(self._cfg(cluster)).start()
+            worker = MockerWorker(
+                wrt, model_name="adm-model",
+                config=MockerConfig(speedup_ratio=200.0, num_blocks=256),
+                load_publish_interval=0.2)
+            await worker.start()
+            frt = await DistributedRuntime(self._cfg(cluster)).start()
+            frontend = Frontend(frt, host="127.0.0.1", port=0)
+            await frontend.start()
+            try:
+                for _ in range(100):
+                    if frontend.manager.get("adm-model") is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                entry = frontend.manager.get("adm-model")
+                assert entry is not None
+                base = f"http://127.0.0.1:{frontend.port}"
+                payload = {"model": "adm-model", "max_tokens": 2,
+                           "messages": [{"role": "user",
+                                         "content": "hello"}]}
+                async with aiohttp.ClientSession() as session:
+                    # Healthy path first (also warms the pipeline).
+                    async with session.post(
+                            base + "/v1/chat/completions", json=payload,
+                            headers={"x-dynt-deadline-ms": "30000"}) as r:
+                        assert r.status == 200, await r.text()
+                    # Force a measured-slow, deep queue into the entry's
+                    # estimator: ~1 drain per 2s, 30 queued -> ~60s wait.
+                    est = entry.wait_estimator
+                    for i in range(10):
+                        est.observe_drained(1, now=float(i) * 2.0)
+                    import time as _time
+
+                    est.drain._last = _time.monotonic()
+                    est.update_worker(next(iter(entry.instances)), 30)
+                    async with session.post(
+                            base + "/v1/chat/completions", json=payload,
+                            headers={"x-dynt-deadline-ms": "2000"}) as r:
+                        assert r.status == 503, await r.text()
+                        retry_after = int(r.headers["Retry-After"])
+                        # Estimated drain (~60s) capped at
+                        # DYNT_RETRY_AFTER_MAX_SECS=30.
+                        assert retry_after == 30
+                        body_json = await r.json()
+                        assert "queue wait" in \
+                            body_json["error"]["message"]
+                    # A patient client (or none of the above) still gets
+                    # served: shedding is per-budget, not a breaker.
+                    async with session.post(
+                            base + "/v1/chat/completions", json=payload,
+                            headers={"x-dynt-deadline-ms": "300000"}) as r:
+                        assert r.status == 200, await r.text()
+            finally:
+                await frontend.close()
+                await frt.shutdown()
+                await worker.close()
+                await wrt.shutdown()
+
+        run(body(), timeout=90)
+
+
+class TestSloObserverDrain:
+    def test_first_token_observes_drain(self):
+        from dynamo_tpu.llm.http_service import _SloObserver
+        from dynamo_tpu.llm.protocols import (
+            EngineOutput,
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        est = QueueWaitEstimator(pool="obs")
+        req = PreprocessedRequest(
+            request_id="obs-req", token_ids=[1],
+            sampling=SamplingOptions(max_tokens=2), stop=StopConditions())
+        obs = _SloObserver(req, 0.0, 0.0, wait_estimator=est)
+        assert est.drain._last is None
+        obs.on_output(EngineOutput(token_ids=[5]))
+        first = est.drain._last
+        assert first is not None
+        # Later chunks are NOT drains — only entering service is.
+        obs.on_output(EngineOutput(token_ids=[6]))
+        assert est.drain._last == first

@@ -138,6 +138,37 @@ class TestKillNineMidStream:
                     pass
 
 
+class TestOverloadRamp:
+    """Chaos-overload scenario (ROADMAP item 4): an open-loop Poisson
+    ramp walks offered load ~2x past the capacity knee of a mocker
+    cluster behind the real frontend, with the deadline-aware admission
+    loop off then on, plus a P/D split sweep feeding the PdSplitPlanner.
+    All graceful-degradation assertions are evaluated FROM the JSON
+    scenario report (the same artifact the chaos-overload CI job
+    uploads): past the knee the loop's goodput dominates the baseline,
+    shed fraction absorbs the excess, refused requests never burned
+    prefill, and the planner converges to the best measured split."""
+
+    def test_overload_ramp_degrades_gracefully(self, run, tmp_path):
+        from dynamo_tpu.mocker.overload import (
+            OverloadParams,
+            run_scenario,
+        )
+
+        params = OverloadParams(ramp_secs=16.0, ramp_end_rps=28.0,
+                                sweep_secs=6.0)
+
+        async def body():
+            report = await run_scenario(params, pd_sweep=True)
+            path = _write_chaos_report("chaos_overload", report,
+                                       default_dir=str(tmp_path))
+            print(f"overload scenario report: {path}")
+            failed = [c for c in report["assertions"] if not c["ok"]]
+            assert report["passed"], failed
+
+        run(body(), timeout=240.0)
+
+
 class TestBrownout:
     """Brownout (gray failure) scenario: a worker is SIGSTOP'd — alive to
     discovery (long lease), dead to traffic. The resilience plane, not

@@ -249,7 +249,10 @@ class TestGlobalPlanner:
 
             # automatic rebalance applies through connectors: pool-a has
             # pressure, pool-b none -> a gets the lion's share and the
-            # totals respect the budget
+            # totals respect the budget. Two intervals: scale-down
+            # hysteresis (default 2) lets pool-b shrink only after the
+            # wish persists — one pressure transient must not thrash it.
+            await planner._apply(planner.plan())
             await planner._apply(planner.plan())
             rebalance = applied[1:]
             assert rebalance, "rebalance never hit the connectors"
@@ -263,6 +266,37 @@ class TestGlobalPlanner:
             await rt.shutdown()
 
         run(body(), timeout=120)
+
+
+class TestHysteresisBudgetRepair:
+    def test_held_shrink_claws_back_growth(self, run):
+        """Regression: a held scale-down next to an immediate scale-up
+        must not command more replicas than the fleet budget — growth is
+        clawed back until the held shrink's streak completes."""
+        planner = GlobalPlanner(
+            runtime=None,
+            pools=[PoolState(namespace=ns,
+                             connector=CallbackConnector(lambda c, n: None))
+                   for ns in ("a", "b")],
+            total_replica_budget=8, adjustment_interval=3600.0,
+            hysteresis_intervals=2)
+        planner.pools["a"].replicas = 4
+        planner.pools["b"].replicas = 4
+
+        async def body():
+            # Plan wants a=6, b=2 (within budget), but b's shrink is
+            # held for one interval: a's growth must be clawed back so
+            # the commanded total never exceeds 8.
+            await planner._apply({"a": 6, "b": 2})
+            total = sum(p.replicas for p in planner.pools.values())
+            assert total <= 8, total
+            # Second interval: the shrink streak completes and the full
+            # rebalance lands.
+            await planner._apply({"a": 6, "b": 2})
+            assert planner.pools["a"].replicas == 6
+            assert planner.pools["b"].replicas == 2
+
+        run(body(), timeout=60)
 
 
 class TestCapacityWeightedPressure:

@@ -18,6 +18,9 @@ from dynamo_tpu.planner import (
     KalmanPredictor,
     LoadBasedPlanner,
     LoadEventSource,
+    PdSplitPlanner,
+    PhaseBreakdown,
+    PhaseBreakdownSource,
     PlannerConfig,
     PrefillInterpolator,
     SeasonalPredictor,
@@ -213,6 +216,212 @@ class TestScalingMath:
         cfg2 = PlannerConfig(max_chip_budget=1, prefill_engine_num_chips=2,
                              decode_engine_num_chips=1, min_endpoint=1)
         assert apply_chip_budget(0, 2, cfg2) == (0, 1)
+
+
+class TestGoodputLoop:
+    """Goodput-fed planning (ROADMAP item 4): SLO-good ratio + the
+    flight-recorder phase breakdown steer the plan beyond raw-load math,
+    with scale-down hysteresis so transients don't thrash replicas."""
+
+    def _planner(self, tmp_path, **cfg_kw):
+        cfg = PlannerConfig(adjustment_interval=60.0, ttft_ms=200.0,
+                            itl_ms=30.0, no_correction=True,
+                            goodput_target=0.9, **cfg_kw)
+        conn = CallbackConnector(lambda c, n: None)
+        return SlaPlanner(
+            cfg, conn,
+            prefill_interpolator=_prefill_profile(tmp_path / "p"),
+            decode_interpolator=_decode_profile(tmp_path / "d"))
+
+    def _stats(self, good, total, **kw):
+        base = dict(num_req=30, ttft_ms=50, itl_ms=10, isl=512, osl=128,
+                    request_duration_s=2.0, slo_good=good, slo_total=total)
+        base.update(kw)
+        return TrafficStats(**base)
+
+    def test_goodput_violation_scales_bottleneck_pool(self, tmp_path):
+        pl = self._planner(tmp_path)
+        healthy = pl.plan(self._stats(98, 100))
+        pl2 = self._planner(tmp_path)
+        # Same raw load, collapsed goodput, decode burn dominant.
+        burn = PhaseBreakdown(queue_ms=10, prefill_ms=10, decode_ms=500,
+                              samples=8)
+        violated = pl2.plan(self._stats(30, 100), breakdown=burn)
+        assert violated[1] > healthy[1]
+
+    def test_prefill_burn_scales_prefill_pool(self, tmp_path):
+        pl = self._planner(tmp_path)
+        healthy = pl.plan(self._stats(98, 100))
+        pl2 = self._planner(tmp_path)
+        burn = PhaseBreakdown(queue_ms=400, prefill_ms=300, decode_ms=50,
+                              samples=8)
+        violated = pl2.plan(self._stats(30, 100), breakdown=burn)
+        assert violated[0] > healthy[0]
+
+    def test_goodput_ratio_and_shed_fraction(self):
+        stats = self._stats(60, 100, shed=25.0)
+        assert stats.goodput_ratio() == pytest.approx(0.6)
+        assert stats.shed_fraction() == pytest.approx(0.2)
+        assert TrafficStats(num_req=1).goodput_ratio() is None
+        assert TrafficStats(num_req=1).shed_fraction() is None
+
+    def test_scale_down_needs_hysteresis_streak(self, tmp_path):
+        pl = self._planner(tmp_path, hysteresis_intervals=2)
+        big = self._stats(98, 100, num_req=3000)
+        small = self._stats(98, 100, num_req=30)
+        first = pl.plan(big)
+        assert first is not None and sum(first) > 2
+        # One quiet interval: the shrink is WANTED but suppressed.
+        held = pl.plan(small)
+        assert held == first
+        # A second consecutive quiet interval applies it.
+        applied = pl.plan(small)
+        assert sum(applied) < sum(first)
+
+    def test_scale_up_applies_immediately(self, tmp_path):
+        pl = self._planner(tmp_path, hysteresis_intervals=3)
+        small = pl.plan(self._stats(98, 100, num_req=30))
+        up = pl.plan(self._stats(98, 100, num_req=3000))
+        assert sum(up) > sum(small)
+
+    def test_hysteresis_never_exceeds_chip_budget(self, tmp_path):
+        """Regression: a held shrink next to an immediate grow (the
+        rebalance case) must not push the applied decision past the
+        chip budget — the post-hysteresis re-clamp."""
+        pl = self._planner(tmp_path, max_chip_budget=4,
+                           hysteresis_intervals=2)
+        pl.state.last_decision = (2, 2)
+        burn = PhaseBreakdown(queue_ms=400, prefill_ms=300, decode_ms=50,
+                              samples=8)
+        for _ in range(4):
+            out = pl.plan(self._stats(30, 100, num_req=5000),
+                          breakdown=burn)
+            assert out is not None
+            assert out[0] + out[1] <= 4, out
+
+    def test_binding_budget_rebalances_pd_ratio(self, tmp_path):
+        pl = self._planner(tmp_path, max_chip_budget=4,
+                           hysteresis_intervals=1)
+        burn = PhaseBreakdown(queue_ms=400, prefill_ms=300, decode_ms=50,
+                              samples=8)
+        # Heavy load + bad goodput: the budget clamps the scale-up away,
+        # so chips shift toward the prefill bottleneck instead.
+        out = pl.plan(self._stats(30, 100, num_req=5000), breakdown=burn)
+        assert out is not None
+        p, d = out
+        assert p + d <= 4
+        assert p >= 2  # the ratio moved toward prefill
+
+
+class TestScraperGoodputSeries:
+    def test_absent_good_series_reads_zero_not_nan(self, monkeypatch):
+        """Regression: with traffic flowing but ZERO SLO-good requests
+        (overloaded restart), the good counter series does not exist —
+        that must read as goodput 0, not 'unknown', or the control loop
+        is inert in exactly the regime it exists for."""
+        scraper = FrontendScraper("http://unused/metrics", "m")
+        base = ('dynamo_requests_total{status="ok"} %d\n'
+                'dynamo_time_to_first_token_seconds_sum{model="m"} %f\n'
+                'dynamo_time_to_first_token_seconds_count{model="m"} %d\n'
+                'dynamo_inter_token_latency_seconds_sum{model="m"} %f\n'
+                'dynamo_inter_token_latency_seconds_count{model="m"} %d\n'
+                'dynamo_input_sequence_tokens_sum{model="m"} %d\n'
+                'dynamo_input_sequence_tokens_count{model="m"} %d\n'
+                'dynamo_output_sequence_tokens_sum{model="m"} %d\n'
+                'dynamo_output_sequence_tokens_count{model="m"} %d\n'
+                'dynamo_slo_requests_total{model="m"} %d\n')
+        pages = [base % (0, 0.0, 0, 0.0, 0, 0, 0, 0, 0, 0),
+                 base % (10, 20.0, 10, 0.5, 10, 5120, 10, 640, 10, 10)]
+        monkeypatch.setattr(scraper, "_fetch",
+                            lambda: parse_prometheus_text(pages.pop(0)))
+        assert scraper.scrape() is None  # baseline
+        stats = scraper.scrape()
+        assert stats.slo_total == 10
+        assert stats.slo_good == 0.0
+        assert stats.shed == 0.0
+        assert stats.goodput_ratio() == 0.0
+
+    def test_nan_goodput_does_not_poison_load_based_gate(self):
+        cfg = PlannerConfig(goodput_target=0.9)
+        conn = CallbackConnector(lambda c, n: None)
+        pl = LoadBasedPlanner(cfg, conn, LoadEventSource())
+        pl.observe_goodput(float("nan"), 10)
+        assert pl._goodput_ratio is None
+        assert pl.plan_decode(2) == 2
+
+
+class TestPhaseBreakdown:
+    def test_burn_classification(self):
+        src = PhaseBreakdownSource("http://unused/debug/requests")
+        snap = {"completed": [
+            {"request_id": "a", "phases": {
+                "received": 100.0, "prefill_start": 100.4,
+                "first_token": 100.5, "finished": 100.9}},
+            {"request_id": "b", "phases": {
+                "received": 200.0, "first_token": 200.2,
+                "finished": 200.4}},
+        ]}
+        out = src.ingest(snap)
+        assert out.samples == 2
+        assert out.queue_ms == pytest.approx((400 + 200) / 2, rel=0.01)
+        assert out.prefill_ms == pytest.approx(50, rel=0.01)
+        assert out.decode_ms == pytest.approx((400 + 200) / 2, rel=0.01)
+
+    def test_ingest_dedups_across_intervals(self):
+        src = PhaseBreakdownSource("http://unused/debug/requests")
+        snap = {"completed": [{"request_id": "a", "phases": {
+            "received": 1.0, "first_token": 1.5, "finished": 2.0}}]}
+        assert src.ingest(snap).samples == 1
+        assert src.ingest(snap).samples == 0  # already seen
+
+    def test_bottleneck_verdict(self):
+        assert PhaseBreakdown(queue_ms=300, prefill_ms=100,
+                              decode_ms=200).bottleneck() == "prefill"
+        assert PhaseBreakdown(queue_ms=10, prefill_ms=10,
+                              decode_ms=200).bottleneck() == "decode"
+
+
+class TestPdSplitPlanner:
+    def test_converges_to_argmax(self):
+        pl = PdSplitPlanner(switch_margin=0.05)
+        pl.observe(1, 3, 10.0)
+        pl.observe(2, 2, 16.0)
+        pl.observe(3, 1, 13.0)
+        assert pl.best() == (2, 2)
+        assert pl.decisions  # the switch was recorded
+
+    def test_hysteresis_keeps_incumbent_within_margin(self):
+        pl = PdSplitPlanner(switch_margin=0.10)
+        pl.observe(2, 2, 10.0)  # incumbent
+        pl.observe(1, 3, 10.5)  # 5% better: inside the switch margin
+        assert pl.best() == (2, 2)
+        pl.observe(1, 3, 14.0)  # EMA pulls it decisively ahead
+        assert pl.best() == (1, 3)
+
+    def test_ema_smooths_noise(self):
+        pl = PdSplitPlanner(switch_margin=0.05, ema_alpha=0.5)
+        pl.observe(2, 2, 10.0)
+        pl.observe(1, 3, 2.0)   # one terrible sample
+        pl.observe(1, 3, 30.0)  # one great sample -> EMA 16
+        assert pl.scores[(1, 3)] == pytest.approx(16.0)
+
+
+class TestLoadBasedGoodput:
+    def test_violated_goodput_forces_growth_and_vetoes_shrink(self):
+        cfg = PlannerConfig(goodput_target=0.9)
+        conn = CallbackConnector(lambda c, n: None)
+        pl = LoadBasedPlanner(cfg, conn, LoadEventSource())
+        # No estimator data at all: goodput alone drives the verdict.
+        pl.observe_goodput(50, 100)
+        assert pl.plan_decode(2) == 3
+        pl.observe_goodput(99, 100)
+        assert pl.plan_decode(2) == 2
+
+    def test_no_goodput_signal_leaves_decision_alone(self):
+        cfg = PlannerConfig()
+        conn = CallbackConnector(lambda c, n: None)
+        pl = LoadBasedPlanner(cfg, conn, LoadEventSource())
+        assert pl.plan_decode(2) == 2
 
 
 class TestLoadBased:
