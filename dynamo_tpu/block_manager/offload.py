@@ -183,22 +183,55 @@ class OffloadManager:
         """Sub-batched, double-buffered offload: sub-batch k+1's gather is
         submitted to the step thread BEFORE bundle k's D2H + tier sink run
         here, so the transfer of one bundle overlaps the gather of the
-        next. Returns total bytes sunk."""
+        next. Returns total bytes sunk.
+
+        Exactly-once ledger: every block leaves this function either
+        sunk, re-queued (gather timeout), legitimately skipped (evicted
+        from G1 before gather / shutdown), or COUNTED as dropped. A
+        mid-batch exception (sink tier full, gather blowup) previously
+        vanished the batch's remaining blocks with no trace — the
+        dropped counter is the contract that offload loss is always
+        visible (DJ5xx sweep)."""
         subs = [batch[i : i + self._subbatch]
                 for i in range(0, len(batch), self._subbatch)]
         pending: Optional[tuple[list, object, list]] = None
         total_bytes = 0
-        for sub in subs:
-            if self._stop:
-                break
-            self._throttle()
-            handle = self._submit_gather(sub)
+        acct = [0]  # blocks sunk, re-queued, or skipped so far —
+        # _sink_bundle advances it PER BLOCK so a sink failing midway
+        # through a bundle never counts its already-sunk blocks as lost
+        inflight = None  # submitted-but-not-awaited gather handle
+        try:
+            for sub in subs:
+                if self._stop:
+                    acct[0] += len(sub)  # shutdown: deliberate drop
+                    continue
+                self._throttle()
+                inflight = self._submit_gather(sub)
+                if pending is not None:
+                    total_bytes += self._sink_bundle(*pending, acct=acct)
+                    pending = None
+                handle, inflight = inflight, None
+                pending = self._await_gather(handle, sub)
+                if pending is None:
+                    acct[0] += len(sub)  # re-queued or evicted
             if pending is not None:
-                total_bytes += self._sink_bundle(*pending)
-            pending = self._await_gather(handle, sub)
-        if pending is not None:
-            total_bytes += self._sink_bundle(*pending)
-        return total_bytes
+                total_bytes += self._sink_bundle(*pending, acct=acct)
+                pending = None
+            return total_bytes
+        except Exception:
+            if inflight is not None and self._run_in_step is not None:
+                # A gather was submitted but never awaited (the sink
+                # between submit and await raised): abandon the queued
+                # closure so it no-ops instead of running an orphaned,
+                # budget-uncharged gather on the step thread.
+                inflight[1].set()
+            lost = len(batch) - acct[0]
+            if lost > 0:
+                self.dropped += lost
+                KVBM_OFFLOAD_DROPPED.inc(lost)
+                log.warning("offload batch failed mid-way; %d block(s) "
+                            "dropped (counted)", lost)
+            raise
 
     def _submit_gather(self, sub: list):
         """Dispatch the device gather for one sub-batch. With an executor,
@@ -273,13 +306,25 @@ class OffloadManager:
     def _requeue(self, sub: list) -> None:
         self._append_bounded(sub)
 
-    def _sink_bundle(self, keep: list, bundle, sub: list) -> int:
+    def _sink_bundle(self, keep: list, bundle, sub: list,
+                     acct: Optional[list] = None) -> int:
         # The slow half, off the step thread: one contiguous D2H of the
-        # whole bundle (np.asarray of a device array), then per-block sink.
+        # whole bundle (np.asarray of a device array), then per-block
+        # sink. `acct` (the batch ledger) advances per block AS IT
+        # SINKS — plus the evicted-before-gather blocks up front — so a
+        # tier failing midway counts only the genuinely unsunk blocks
+        # as dropped.
+        if acct is not None:
+            # Credit the evicted-before-gather blocks BEFORE the D2H:
+            # they are "nothing to sink" whether or not the transfer
+            # below blows up, and must never count as dropped.
+            acct[0] += len(sub) - len(keep)
         bundle = np.asarray(bundle)
         for j, i in enumerate(keep):
             h, parent = sub[i]
             self._sink(h, np.asarray(bundle[j]), parent)
+            if acct is not None:
+                acct[0] += 1
         return int(bundle.nbytes)
 
     # -- bandwidth budget --------------------------------------------------

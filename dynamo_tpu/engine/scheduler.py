@@ -897,7 +897,7 @@ class InferenceScheduler:
                 self._pending_prefill.append((seq, toks_dev[row]))
                 continue
             if host_toks is None:
-                host_toks = np.asarray(toks_dev)  # dynalint: disable=DL201 -- sync rows need their token now (prefill_only/logprobs), same contract as the single-dispatch path
+                host_toks = np.asarray(toks_dev)  # dynalint: disable=DL201 -- sync rows need their token now (prefill_only/logprobs), same contract as the single-dispatch path # dynajit: disable=DJ201 -- same designed drain
             if seq.prefill_only:
                 self._finish_prefill_only(seq, int(host_toks[row]))
             elif seq.processors:
@@ -937,7 +937,7 @@ class InferenceScheduler:
         to decode. Returns 1 if a token was delivered (progress)."""
         if seq.cancelled or seq.finished:
             return 0
-        self._append_token(seq, int(np.asarray(tok_dev).reshape(-1)[0]),
+        self._append_token(seq, int(np.asarray(tok_dev).reshape(-1)[0]),  # dynajit: disable=DJ201 -- deferred one iteration by design: the device work queued ahead of this readback last step
                            prompt_tokens=seq.prompt_len)
         return 1
 
@@ -1123,7 +1123,7 @@ class InferenceScheduler:
         # _reap_finished's page release — consumers reacting to the
         # finish (KVBM flush, disagg transfer) would race a release that
         # hasn't happened yet.
-        blocks_np = [np.asarray(t) for t in device_blocks]  # dynalint: disable=DL201 -- deliberate barrier: all blocks must land before any token emits (see comment above)
+        blocks_np = [np.asarray(t) for t in device_blocks]  # dynalint: disable=DL201 -- deliberate barrier: all blocks must land before any token emits (see comment above) # dynajit: disable=DJ201 -- the loop's ONE blocking drain
         count = 0
         for toks_k in blocks_np:
             for step in range(block):
@@ -1225,13 +1225,13 @@ class InferenceScheduler:
         unchanged; surplus rejected-draft KV sits in the sequence's own
         slack pages and is rewritten by the next step."""
         _kind, targets_dev, n_acc_dev, ready, drafts, with_logits = pending
-        targets = np.asarray(targets_dev)  # dynalint: disable=DL201 -- the drain point: spec commits need the verdict on host
-        n_acc = np.asarray(n_acc_dev)  # dynalint: disable=DL201 -- same drain point
+        targets = np.asarray(targets_dev)  # dynalint: disable=DL201 -- the drain point: spec commits need the verdict on host # dynajit: disable=DJ201 -- same spec drain
+        n_acc = np.asarray(n_acc_dev)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
         logits = None
         if with_logits:
             logits = self.runner.last_spec_logits
             if logits is not None and not isinstance(logits, np.ndarray):
-                logits = np.asarray(logits)  # dynalint: disable=DL201 -- same drain point
+                logits = np.asarray(logits)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
         count = 0
         emas = []
         self.stats.spec_steps += 1

@@ -267,6 +267,23 @@ class DiffusionRunner:
             jax.random.PRNGKey(seed), config)
         self._fns: dict[tuple, callable] = {}  # LRU-capped, see generate
 
+    def _build_sample_fn(self, steps: int, n_frames: int, use_cfg: bool):
+        """Jitted sampler for one (steps, n_frames, cfg) configuration —
+        constructed only on a cache miss in `generate` (the dynajit
+        builder idiom: per-call jit construction never hits the compile
+        cache). guidance_scale stays a TRACED float32 so sweeping it
+        never recompiles; batch size n specializes through the cond
+        shape like every other runner."""
+        if use_cfg:
+            return jax.jit(lambda p, cond, key, uncond, scale:
+                           ddim_sample(p, self.config, cond, key,
+                                       n_steps=steps,
+                                       n_frames=n_frames,
+                                       uncond=uncond,
+                                       guidance_scale=scale))
+        return jax.jit(partial(ddim_sample, config=self.config,
+                               n_steps=steps, n_frames=n_frames))
+
     def generate(self, prompt: str, n: int = 1, steps: int = 20,
                  seed: int = 0, n_frames: int = 1,
                  negative_prompt: Optional[str] = None,
@@ -287,24 +304,19 @@ class DiffusionRunner:
         # give fully distinct noise.
         key = jax.random.PRNGKey(seed)
         sig = (n, steps, n_frames, use_cfg)
-        fn = self._fns.get(sig)
+        fn = self._fns.pop(sig, None)
         if fn is None:
-            if use_cfg:
-                fn = jax.jit(lambda p, cond, key, uncond, scale:
-                             ddim_sample(p, self.config, cond, key,
-                                         n_steps=steps,
-                                         n_frames=n_frames,
-                                         uncond=uncond,
-                                         guidance_scale=scale))
-            else:
-                fn = jax.jit(partial(ddim_sample, config=self.config,
-                                     n_steps=steps, n_frames=n_frames))
-            self._fns[sig] = fn
-            # (n, steps, n_frames) are client-controlled: bound the
-            # compiled-program cache or a parameter sweep becomes a
-            # compile storm + unbounded executable retention.
-            while len(self._fns) > 8:
-                self._fns.pop(next(iter(self._fns)))
+            fn = self._build_sample_fn(steps, n_frames, use_cfg)
+        # Reinsert on every use: dict order then IS recency order, so
+        # the eviction below drops the least-recently-USED signature —
+        # FIFO here evicted the one a 2-sig parameter sweep was about
+        # to reuse, recompiling on every alternation at the cap.
+        self._fns[sig] = fn
+        # (n, steps, n_frames) are client-controlled: bound the
+        # compiled-program cache or a parameter sweep becomes a
+        # compile storm + unbounded executable retention.
+        while len(self._fns) > 8:
+            self._fns.pop(next(iter(self._fns)))
         if use_cfg:
             out = fn(self.params, jnp.asarray(cond), key,
                      jnp.asarray(uncond),

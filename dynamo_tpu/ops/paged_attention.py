@@ -487,7 +487,11 @@ def _pool_decode_kernel(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("pages_per_chunk", "interpret"))
+                   static_argnames=("pages_per_chunk", "interpret"),
+                   # Read-only on the WHOLE paged pool by design: the
+                   # decode step that calls this still owns (and
+                   # donates) the cache through its own jit boundary.
+                   donate_argnums=())
 def paged_decode_attention_pool(
     q: jax.Array,  # [B, qh, hd]
     kv_pool: jax.Array,  # [L, 2, P, ps, kh, hd] — the WHOLE cache

@@ -190,11 +190,18 @@ class FlightRecorder:
     # -- consumer side -----------------------------------------------------
 
     def get(self, request_id: str) -> Optional[RequestTimeline]:
-        """Inflight entry, or the most recent completed one by that id."""
+        """Inflight entry, or the most recent completed one by that id.
+        Inflight timelines are returned as a shallow COPY taken under
+        the lock — the scheduler thread keeps stamping the original,
+        and a reader iterating live phase/event containers (the worker
+        synthesizing phase spans, a /debug scrape) would race those
+        mutations. Completed entries are immutable after finish() and
+        returned as-is."""
         with self._lock:
             tl = self._inflight.get(request_id)
             if tl is not None:
-                return tl
+                return dataclasses.replace(tl, phases=dict(tl.phases),
+                                           events=list(tl.events))
             for done in reversed(self._completed):
                 if done.request_id == request_id:
                     return done

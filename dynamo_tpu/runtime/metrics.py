@@ -218,6 +218,19 @@ DISAGG_STREAMED_PAGES = Counter(
     "prefilling (chunked disagg handoff; serial handoffs count 0 here)",
     ["worker"], registry=REGISTRY,
 )
+# Device-plane compile counter (engine/model_runner.py jax.monitoring
+# listener): every XLA backend compile, labelled by the runner entry
+# point that triggered it. Steady-state serving must hold this flat —
+# a counter that keeps rising under stable traffic is an unbounded
+# retrace (the dynajit DJ1xx hazard class, observed at runtime); the
+# retrace-canary tier-1 test pins the bound against the jit-signature
+# registry (tools/dynajit/signatures/).
+JIT_COMPILES = Counter(
+    "dynamo_jit_compiles_total",
+    "XLA backend compiles, by the ModelRunner entry point in scope "
+    "when the compile fired (unscoped = outside any runner entry)",
+    ["fn"], registry=REGISTRY,
+)
 # OTLP exporter health (runtime/otel.py): spans that reached the
 # collector vs spans lost to a full buffer or a failed export.
 OTEL_SPANS_EXPORTED = Counter(

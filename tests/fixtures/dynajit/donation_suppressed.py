@@ -1,0 +1,10 @@
+"""DJ3xx suppressed: a justified undeclared-donation site."""
+
+import jax
+
+
+def legacy_kernel(kv_cache, idx):
+    return kv_cache[idx]
+
+
+WRAPPED = jax.jit(legacy_kernel)  # dynajit: disable=DJ303 -- vendored reference kernel kept verbatim for diffing

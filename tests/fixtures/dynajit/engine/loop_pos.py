@@ -1,0 +1,20 @@
+"""DJ201 positive: a host sync three calls deep under the dispatch
+loop (the regression class the interprocedural pass exists for)."""
+
+import numpy as np
+
+
+def _dispatch_decode(batch):
+    tokens = _issue(batch)
+    return tokens
+
+
+def _issue(batch):
+    return _collect(batch)
+
+
+def _collect(batch):
+    count = batch.total.item()  # sync on the dispatch path
+    stats = np.asarray(batch.device_stats)  # bare readback, no dtype
+    host = np.asarray(batch.host_list, np.int32)  # dtype-carrying: exempt
+    return count, stats, host

@@ -1,0 +1,55 @@
+"""DJ4xx negatives: guarded grids and honest q8 variants pass clean."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def _divisor(dim, pref):
+    b = min(pref, dim)
+    while b > 1 and dim % b:
+        b //= 2
+    return b
+
+
+def guarded_kernel(x, block):
+    n = x.shape[0]
+    bs = _divisor(n, block)
+    return pl.pallas_call(
+        _kernel,
+        grid=(n // bs,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def padded_kernel(x, block):
+    n = x.shape[0]
+    npad = -(-n // block) * block
+    x = jnp.pad(x, ((0, npad - n),))
+    return pl.pallas_call(
+        _kernel,
+        grid=(npad // block,),
+        out_shape=jax.ShapeDtypeStruct((npad,), x.dtype),
+    )(x)[:n]
+
+
+def asserted_kernel(x, block):
+    n = x.shape[0]
+    assert n % block == 0
+    return pl.pallas_call(
+        _kernel,
+        grid=(n // block,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def dequant_rows(x, scale):
+    return x.astype(jnp.float32) * scale
+
+
+def dequant_rows_q8(x, scale):
+    return x.view(jnp.int8).astype(jnp.float32) * scale

@@ -1,0 +1,35 @@
+"""DJ4xx positives: truncating grid division, q8 variant drift, and a
+kernel with no oracle test."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def orphan_kernel(x, block):
+    n = x.shape[0]
+    return pl.pallas_call(  # DJ403: no test references this name
+        _kernel,
+        grid=(n // block,),  # DJ401: unguarded division truncates
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def scale_rows(x):
+    return x * 2.0
+
+
+def scale_rows_q8(x):
+    return x * 2.0  # DJ402: "quantized" variant never touches int8
+
+
+def pack_rows(x):
+    return jnp.asarray(x, jnp.int8)  # DJ402: base fn doing q8 work
+
+
+def pack_rows_q8(x):
+    return jnp.asarray(x, jnp.int8)

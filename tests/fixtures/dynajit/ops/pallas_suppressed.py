@@ -1,0 +1,17 @@
+"""DJ4xx suppressed: a justified unguarded grid."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def fixed_geometry(x, block):
+    n = x.shape[0]
+    return pl.pallas_call(
+        _kernel,
+        grid=(n // block,),  # dynajit: disable=DJ401 -- geometry fixed by the caller contract (n is always 8*block)
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

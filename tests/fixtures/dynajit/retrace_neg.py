@@ -1,0 +1,55 @@
+"""DJ1xx negatives: the blessed construction idioms pass clean."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def decorated(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def decorated_static(x, n: int):
+    return x * n
+
+
+MODULE_FN = jax.jit(lambda x: x - 1)
+
+
+class Runner:
+    def __init__(self):
+        self._fn = jax.jit(lambda x: x)  # attr store in __init__
+        self._fns = {}
+        self._caps = {}
+
+    def _build_step(self, bucket):
+        return jax.jit(lambda x: x + bucket)  # returned from a builder
+
+    def _bucket_for(self, n):
+        return 1 << max(0, n - 1).bit_length()
+
+    def step(self, x, n: int):
+        bucket = self._bucket_for(n)  # pow2-bucketed key
+        fn = self._fns.get(bucket)
+        if fn is None:
+            fn = self._build_step(bucket)
+            self._fns[bucket] = fn
+        return fn(x)
+
+    def capped(self, x, k: int):
+        fn = self._caps.get(k)
+        if fn is None:
+            fn = jax.jit(lambda v: v + k)
+            self._caps[k] = fn  # bounded: eviction below
+            while len(self._caps) > 4:
+                self._caps.pop(next(iter(self._caps)))
+        return fn(x)
+
+    def flagged(self, x, want: bool):
+        fn = self._fns.get(want)
+        if fn is None:
+            fn = self._build_step(1)
+            self._fns[want] = fn  # bool-annotated key: domain of 2
+        return fn(x)

@@ -1,0 +1,32 @@
+"""DJ1xx positives: jit in a loop, per-call jit, unbounded cache key."""
+
+import jax
+
+
+def jit_in_loop(batches):
+    outs = []
+    for batch in batches:
+        fn = jax.jit(lambda x: x + 1)  # DJ101: fresh callable per iter
+        outs.append(fn(batch))
+    return outs
+
+
+def per_call_immediate(x):
+    return jax.jit(lambda v: v * 2)(x)  # DJ102: compiled every call
+
+
+def per_call_local(x):
+    fn = jax.jit(lambda v: v * 3)  # DJ102: local never stored
+    return fn(x)
+
+
+class Runner:
+    def __init__(self):
+        self._fns = {}
+
+    def step(self, x, k: int):
+        fn = self._fns.get(k)
+        if fn is None:
+            fn = jax.jit(lambda v: v + k)
+            self._fns[k] = fn  # DJ103: raw param key, no eviction
+        return fn(x)

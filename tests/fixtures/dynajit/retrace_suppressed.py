@@ -1,0 +1,8 @@
+"""DJ1xx suppressed: justified per-call construction."""
+
+import jax
+
+
+def one_shot_tool(x):
+    fn = jax.jit(lambda v: v * 3)  # dynajit: disable=DJ102 -- offline CLI tool, runs once per invocation
+    return fn(x)

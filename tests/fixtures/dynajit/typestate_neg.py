@@ -1,0 +1,40 @@
+"""DJ5xx negatives: finally-owned releases, ownership hand-off, and
+idempotent span double-end all pass clean."""
+
+
+class Puller:
+    def serve(self, table, transfer_id, wire):
+        transfer = table.claim(transfer_id)
+        try:
+            wire.send_header(transfer.layout)
+            wire.send_pages(transfer.page_ids)
+        finally:
+            transfer.release()  # exactly once, exception-safe
+        return True
+
+    def adopt(self, table, transfer_id):
+        transfer = table.claim(transfer_id)
+        self.owned = transfer  # ownership escapes: not this fn's leak
+        return transfer
+
+    def traced(self, tracer, table, transfer_id, wire):
+        span = tracer.start_span("kv_transfer.serve")
+        transfer = table.claim(transfer_id)
+        try:
+            wire.send_pages(transfer.page_ids)
+            span.end(ok=True)  # idempotent: first end wins
+        finally:
+            span.end(ok=False)
+            transfer.release()
+
+
+class Router:
+    def dispatch(self, breaker, client, body):
+        if not breaker.try_acquire():
+            return None
+        try:
+            out = client.send(body)
+            breaker.record_success()
+            return out
+        finally:
+            breaker.release_probe()  # verdict settled on every path

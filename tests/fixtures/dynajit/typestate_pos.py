@@ -1,0 +1,34 @@
+"""DJ5xx positives: leaked claim, unsafe release, double release,
+probe-verdict leak."""
+
+
+class Puller:
+    def serve_unsafe(self, table, transfer_id, wire):
+        transfer = table.claim(transfer_id)
+        wire.send_header(transfer.layout)  # can raise: release leaks
+        wire.send_pages(transfer.page_ids)
+        transfer.release()  # DJ501: not under a finally
+        return True
+
+    def serve_leak(self, table, transfer_id):
+        transfer = table.claim(transfer_id)
+        if transfer is None:
+            return None
+        return transfer.page_ids.copy()  # DJ501: never released
+
+    def serve_twice(self, table, transfer_id):
+        transfer = table.claim(transfer_id)
+        try:
+            return transfer.page_ids
+        finally:
+            transfer.release()
+            transfer.release()  # DJ502: second release in one block
+
+
+class Router:
+    def dispatch(self, breaker, client, body):
+        if not breaker.try_acquire():  # DJ503: no finally settles it
+            return None
+        out = client.send(body)
+        breaker.record_success()
+        return out

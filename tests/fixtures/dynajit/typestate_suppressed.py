@@ -1,0 +1,9 @@
+"""DJ5xx suppressed: a justified non-finally release."""
+
+
+class Puller:
+    def serve(self, table, transfer_id, wire):
+        transfer = table.claim(transfer_id)  # dynajit: disable=DJ501 -- wire.send_* cannot raise here (in-memory test double)
+        wire.send_pages(transfer.page_ids)
+        transfer.release()
+        return True

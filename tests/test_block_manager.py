@@ -260,6 +260,112 @@ class TestOffloadManager:
         np.testing.assert_array_equal(sunk[10][0], pool[0])
         assert sunk[20][1] == 10  # parent chain: 20's parent is 10
 
+    def test_mid_batch_failure_counts_dropped_exactly_once(self):
+        """DJ5xx exactly-once ledger: a sink blowing up mid-batch must
+        leave every block either sunk or COUNTED dropped — never
+        silently vanished — and the worker thread must survive to serve
+        the next batch."""
+        sunk = []
+        fail = {"on": True}
+
+        def sink(h, d, p):
+            if fail["on"] and h >= 3:
+                raise RuntimeError("tier full")
+            sunk.append(h)
+
+        om = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=lambda ids: np.zeros((len(ids), 1), np.float32),
+            run_in_step=None,
+            sink=sink,
+            batch_size=4, subbatch=2, bw_frac=0.0, queue_cap=64,
+        )
+        om.notify_stored([1, 2, 3, 4], parent=None)
+        assert om.flush(5.0)
+        # blocks 1,2 sunk; 3 failed the sink and 4 never sank -> both
+        # counted dropped
+        assert sorted(sunk) == [1, 2]
+        assert om.dropped == 2
+        # the manager survives: the next batch sinks normally
+        fail["on"] = False
+        om.notify_stored([5, 6], parent=None)
+        assert om.flush(5.0)
+        om.close()
+        assert sorted(sunk) == [1, 2, 5, 6]
+        assert om.dropped == 2  # no further loss counted
+
+    def test_sink_failure_abandons_submitted_gather(self):
+        """A sink raising BETWEEN submit and await must set the queued
+        gather's abandon event: the closure still sitting in the
+        scheduler's gap queue then no-ops instead of running an
+        orphaned, budget-uncharged device gather."""
+        import queue as thread_queue
+        import time
+
+        queued = []
+
+        def run_in_step(fn):
+            out = thread_queue.Queue(1)
+            queued.append((fn, out))  # captured, NOT executed
+            return out
+
+        gathers = []
+
+        def gather(ids):
+            gathers.append(len(ids))
+            return np.zeros((len(ids), 1), np.float32)
+
+        def sink(h, d, p):
+            raise RuntimeError("tier full")
+
+        om = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=gather, run_in_step=run_in_step, sink=sink,
+            batch_size=4, subbatch=2, bw_frac=0.0, queue_cap=64,
+        )
+        om.notify_stored([1, 2, 3, 4], parent=None)
+        # sub 1's gather: run its closure so the worker can await it;
+        # the sink of that bundle then raises before sub 2 is awaited.
+        deadline = time.monotonic() + 5.0
+        while not queued and time.monotonic() < deadline:
+            time.sleep(0.01)
+        fn, out = queued[0]
+        out.put((fn(), None))
+        assert om.flush(5.0)
+        om.close()
+        assert gathers == [2]  # sub 1 gathered once
+        # sub 2's closure was queued then abandoned: running it now (as
+        # the scheduler's final drain would) must NOT gather.
+        assert len(queued) == 2
+        fn2, _ = queued[1]
+        assert fn2() == ([], None, 0.0)
+        assert gathers == [2]
+        assert om.dropped == 4  # whole batch counted, nothing sunk
+
+    def test_partial_bundle_sink_failure_counts_only_unsunk(self):
+        """The ledger advances PER BLOCK inside a bundle: a tier that
+        dies on the bundle's second block drops exactly one — the sunk
+        first block must not be double-counted as lost."""
+        sunk = []
+
+        def sink(h, d, p):
+            if h == 2:
+                raise RuntimeError("tier full")
+            sunk.append(h)
+
+        om = OffloadManager(
+            lookup_pages=lambda hs: [1 for _ in hs],
+            gather=lambda ids: np.zeros((len(ids), 1), np.float32),
+            run_in_step=None,
+            sink=sink,
+            batch_size=2, subbatch=2, bw_frac=0.0, queue_cap=64,
+        )
+        om.notify_stored([1, 2], parent=None)
+        assert om.flush(5.0)
+        om.close()
+        assert sunk == [1]
+        assert om.dropped == 1
+
     def test_skip_filter(self):
         sunk = []
         om = OffloadManager(

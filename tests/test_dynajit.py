@@ -1,0 +1,340 @@
+"""dynajit golden tests: every pass exercised by positive, negative,
+and suppressed fixtures, the jit-signature registry drift gate, the CLI
+contract, and the repo-wide clean-lint invariant now covering all THREE
+analyzers (dynalint + dynaflow + dynajit over dynamo_tpu/ — the same
+gate CI enforces, failing pytest locally)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import tools.dynaflow as dynaflow
+import tools.dynalint as dynalint
+from tools.dynajit import (
+    all_rules,
+    diff_registry,
+    extract_jit_sites,
+    run,
+    surface_json,
+    update_registry,
+)
+from tools.dynajit.jit_surface import REGISTRY_PATH
+from tools.dynajit.passes_donation import (
+    DonatedAttrNotRebound,
+    KvParamDonationUndeclared,
+    UseAfterDonate,
+)
+from tools.dynajit.passes_hostsync import HostSyncReachable
+from tools.dynajit.passes_pallas import (
+    KernelOracleMissing,
+    Q8VariantDtypeDisagreement,
+    UncheckedGridDivision,
+)
+from tools.dynajit.passes_retrace import (
+    JitInLoop,
+    JitSignatureDrift,
+    PerCallJit,
+    UnboundedJitCacheKey,
+)
+from tools.dynajit.passes_typestate import (
+    DoubleRelease,
+    ProbeVerdictLeak,
+    ReleaseNotExceptionSafe,
+)
+from tools.dynalint.core import collect_files
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynajit"
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def jit(path, rules):
+    findings, _ = run([str(FIXTURES / path)], rules=rules)
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestRuleCatalogue:
+    def test_fourteen_rules_registered(self):
+        assert len(all_rules()) >= 14
+
+    def test_ids_and_names_unique_and_described(self):
+        rules = all_rules()
+        assert len({r.id for r in rules}) == len(rules)
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.description for r in rules)
+
+    def test_disjoint_from_sibling_analyzers(self):
+        ids = {r.id for r in all_rules()}
+        assert not ids & {r.id for r in dynalint.all_rules()}
+        assert not ids & {r.id for r in dynaflow.all_rules()}
+
+
+class TestJitSurface:
+    def test_dispositions(self):
+        files, _ = collect_files([str(FIXTURES / "retrace_neg.py")])
+        sites = {(s.scope, s.disposition)
+                 for s in extract_jit_sites(files)}
+        assert ("<module>", "decorator") in {
+            (s[0], s[1]) for s in sites} or any(
+            d == "decorator" for _, d in sites)
+        assert ("<module>", "module") in sites  # MODULE_FN
+        assert ("Runner.__init__", "attr:_fn") in sites
+        assert ("Runner._build_step", "returned") in sites
+        assert any(d.startswith("cached:") for _, d in sites)
+
+    def test_static_and_donate_extraction(self):
+        files, _ = collect_files([str(FIXTURES / "donation_neg.py")])
+        sites = extract_jit_sites(files)
+        gather = next(s for s in sites if s.target == "gather")
+        assert gather.donate_declared and gather.donate_argnums == ()
+        scatter = next(s for s in sites if s.target == "scatter")
+        assert scatter.donate_argnums == (0,)
+        assert gather.target_params == ("kv_cache", "idx")
+
+
+class TestRetraceRules:
+    RULES = [JitInLoop(), PerCallJit(), UnboundedJitCacheKey()]
+
+    def test_positive(self):
+        findings = jit("retrace_pos.py", self.RULES)
+        assert "DJ101" in rules_of(findings)
+        assert sum(1 for f in findings if f.rule == "DJ102") == 2
+        assert any(f.rule == "DJ103" and "'_fns'" in f.message
+                   for f in findings)
+
+    def test_negative(self):
+        assert jit("retrace_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert jit("retrace_suppressed.py", self.RULES) == []
+
+
+class TestSignatureRegistry:
+    def test_drift_gate(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "retrace_neg.py")])
+        reg = tmp_path / "jit_surface.json"
+        rule = JitSignatureDrift(registry_path=reg)
+        # no snapshot yet -> missing-registry finding
+        missing, _ = run([str(FIXTURES / "retrace_neg.py")], rules=[rule])
+        assert rules_of(missing) == ["DJ104"]
+        assert "no jit-signature registry" in missing[0].message
+        # blessed -> clean
+        assert update_registry(files, reg)
+        clean, _ = run([str(FIXTURES / "retrace_neg.py")], rules=[rule])
+        assert clean == []
+        # the tree drifts (different fixture) -> diffed finding
+        drifted, _ = run([str(FIXTURES / "retrace_pos.py")], rules=[rule])
+        assert rules_of(drifted) == ["DJ104"]
+        assert "added:" in drifted[0].message \
+            or "removed:" in drifted[0].message
+
+    def test_diff_names_changed_sites(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "retrace_neg.py")])
+        reg = tmp_path / "jit_surface.json"
+        update_registry(files, reg)
+        other, _ = collect_files([str(FIXTURES / "retrace_pos.py")])
+        drift = diff_registry(other, reg)
+        assert drift is not None
+        assert any("jit_in_loop" in line or "per_call" in line
+                   for line in drift)
+
+    def test_update_is_idempotent(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "retrace_neg.py")])
+        reg = tmp_path / "jit_surface.json"
+        assert update_registry(files, reg) is True
+        assert update_registry(files, reg) is False
+        payload = json.loads(reg.read_text())
+        assert payload["version"] == 1 and payload["sites"]
+
+
+class TestHostSyncReachability:
+    def test_positive_three_calls_deep(self):
+        findings = jit("engine", [HostSyncReachable()])
+        msgs = [f.message for f in findings if f.rule == "DJ201"]
+        assert any(".item()" in m for m in msgs)
+        assert any("np.asarray" in m for m in msgs)
+        # the dtype-carrying conversion is exempt by convention
+        assert len([f for f in findings
+                    if f.path.endswith("loop_pos.py")]) == 2
+
+    def test_suppressed(self):
+        findings = jit("engine/loop_suppressed.py",
+                       [HostSyncReachable()])
+        assert findings == []
+
+
+class TestDonationRules:
+    RULES = [UseAfterDonate(), DonatedAttrNotRebound(),
+             KvParamDonationUndeclared()]
+
+    def test_positive(self):
+        findings = jit("donation_pos.py", self.RULES)
+        assert rules_of(findings) == ["DJ301", "DJ302", "DJ303"]
+        dj301 = next(f for f in findings if f.rule == "DJ301")
+        assert "'buf'" in dj301.message
+
+    def test_negative(self):
+        assert jit("donation_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert jit("donation_suppressed.py", self.RULES) == []
+
+
+class TestPallasRules:
+    def test_positive(self, tmp_path):
+        findings = jit("ops/pallas_pos.py",
+                       [UncheckedGridDivision(),
+                        Q8VariantDtypeDisagreement(),
+                        KernelOracleMissing(tests_dir=tmp_path)])
+        # empty tests dir -> the fixture kernel has no oracle
+        ids = rules_of(findings)
+        assert ids == ["DJ401", "DJ402", "DJ403"]
+        assert any("scale_rows_q8" in f.message for f in findings)
+        assert any("pack_rows" in f.message for f in findings)
+
+    def test_oracle_satisfied_by_test_reference(self, tmp_path):
+        (tmp_path / "test_k.py").write_text("from x import orphan_kernel")
+        findings = jit("ops/pallas_pos.py",
+                       [KernelOracleMissing(tests_dir=tmp_path)])
+        assert findings == []
+
+    def test_oracle_prefix_reference_does_not_satisfy(self, tmp_path):
+        """A sibling kernel whose name EXTENDS this one must not
+        satisfy the oracle requirement via substring matching (the
+        paged_decode_attention / _partial / _pool family hole)."""
+        (tmp_path / "test_k.py").write_text(
+            "from x import orphan_kernel_extended")
+        findings = jit("ops/pallas_pos.py",
+                       [KernelOracleMissing(tests_dir=tmp_path)])
+        assert [f.rule for f in findings] == ["DJ403"]
+        assert "orphan_kernel" in findings[0].message
+
+    def test_negative(self):
+        assert jit("ops/pallas_neg.py",
+                   [UncheckedGridDivision(),
+                    Q8VariantDtypeDisagreement()]) == []
+
+    def test_suppressed(self):
+        assert jit("ops/pallas_suppressed.py",
+                   [UncheckedGridDivision()]) == []
+
+
+class TestTypestateRules:
+    RULES = [ReleaseNotExceptionSafe(), DoubleRelease(),
+             ProbeVerdictLeak()]
+
+    def test_positive(self):
+        findings = jit("typestate_pos.py", self.RULES)
+        assert rules_of(findings) == ["DJ501", "DJ502", "DJ503"]
+        dj501 = [f for f in findings if f.rule == "DJ501"]
+        assert any("outside any finally" in f.message for f in dj501)
+        assert any("never released" in f.message for f in dj501)
+
+    def test_negative(self):
+        """Finally-owned release, ownership hand-off, and the designed
+        idempotent span double-end all pass clean."""
+        assert jit("typestate_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert jit("typestate_suppressed.py",
+                   [ReleaseNotExceptionSafe()]) == []
+
+
+class TestSuppressionDialect:
+    def test_wrong_tool_marker_does_not_suppress(self, tmp_path):
+        src = (FIXTURES / "retrace_suppressed.py").read_text()
+        bad = tmp_path / "wrong.py"
+        bad.write_text(src.replace("# dynajit: disable=DJ102",
+                                   "# dynalint: disable=DJ102"))
+        findings, _ = run([str(bad)], rules=[PerCallJit()])
+        assert rules_of(findings) == ["DJ102"]
+
+    def test_unknown_rule_reported(self, tmp_path):
+        bad = tmp_path / "typo.py"
+        bad.write_text(
+            "import jax\n\n\n"
+            "def f(x):\n"
+            "    fn = jax.jit(lambda v: v)"
+            "  # dynajit: disable=DJ999 -- typo\n"
+            "    return fn(x)\n")
+        findings, _ = run([str(bad)], rules=[PerCallJit()])
+        assert [f.rule for f in findings] == ["DJ000", "DJ102"]
+
+
+class TestCli:
+    def test_json_output_and_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynajit",
+             str(FIXTURES / "retrace_pos.py"), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["files_checked"] == 1
+        assert {f["rule"] for f in data["findings"]} >= {"DJ101",
+                                                         "DJ102",
+                                                         "DJ103"}
+        assert {r["id"] for r in data["rules"]} >= {
+            "DJ101", "DJ102", "DJ103", "DJ104", "DJ201", "DJ301",
+            "DJ302", "DJ303", "DJ401", "DJ402", "DJ403", "DJ501",
+            "DJ502", "DJ503"}
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynajit", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "DJ104" in proc.stdout
+        assert "jit-signature-drift" in proc.stdout
+
+    def test_registry_update_on_current_tree_is_noop(self):
+        # Prove currency with a PURE READ first: on a drifted tree this
+        # fails HERE, before the CLI below would silently rewrite the
+        # checked-in registry mid-pytest (and let the later
+        # TestRealTreeStaysClean pass against the fresh rewrite).
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files, REGISTRY_PATH) is None, (
+            "jit surface drifted; not exercising --registry-update "
+            "against the real registry")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynajit", "--registry-update"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "already current" in proc.stdout
+
+
+class TestRealTreeStaysClean:
+    """The repo-wide clean-lint invariant, now over all THREE
+    analyzers: zero unsuppressed findings on dynamo_tpu/. Regressions
+    fail pytest locally, not just the CI lint job."""
+
+    def test_dynajit_clean(self):
+        findings, files_checked = run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynaflow_clean(self):
+        findings, files_checked = dynaflow.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynalint_clean(self):
+        findings, files_checked = dynalint.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_registry_current(self):
+        """The checked-in jit-signature registry matches the tree (a
+        drifted registry already fails test_dynajit_clean; this pins
+        the snapshot file exists and parses)."""
+        assert REGISTRY_PATH.exists()
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files, REGISTRY_PATH) is None
+        payload = surface_json(files)
+        assert len(payload["sites"]) >= 30  # the tree's real surface

@@ -15,6 +15,35 @@ from dynamo_tpu.runtime.flight_recorder import (
 from dynamo_tpu.runtime.logging import current_request_id
 
 
+class TestGetIsolation:
+    """get() on an INFLIGHT timeline returns a copy taken under the
+    lock (DJ5xx sweep): the scheduler thread keeps stamping the
+    original, and a reader iterating live phase/event containers (the
+    worker synthesizing phase spans, a /debug scrape) raced those
+    mutations before."""
+
+    def test_inflight_get_is_isolated_from_later_stamps(self):
+        rec = FlightRecorder(capacity=4, slow_ms=0)
+        rec.start("r1", model="m")
+        rec.stamp("r1", "queued")
+        tl = rec.get("r1")
+        assert "queued" in tl.phases and tl.events == []
+        rec.stamp("r1", "scheduled")
+        rec.event("r1", "retry", attempt=1)
+        assert "scheduled" not in tl.phases
+        assert tl.events == []
+        # the recorder's own entry kept every mutation
+        live = rec.get("r1")
+        assert "scheduled" in live.phases and len(live.events) == 1
+
+    def test_completed_get_returns_the_final_record(self):
+        rec = FlightRecorder(capacity=4, slow_ms=0)
+        rec.start("r2")
+        rec.finish("r2", "ok")
+        done = rec.get("r2")
+        assert done.status == "ok" and "finished" in done.phases
+
+
 class TestRingBuffer:
     def test_completed_ring_evicts_oldest(self):
         rec = FlightRecorder(capacity=3, slow_ms=0)

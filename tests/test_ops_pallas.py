@@ -119,6 +119,69 @@ class TestPagedDecodeAttention:
                                    rtol=2e-5, atol=2e-5)
 
 
+class TestPagedDecodeAttentionPartial:
+    """The unnormalized flash-partials kernel (acc, m, l over the paged
+    HISTORY) vs the dense oracle: acc / l must equal masked softmax
+    attention, and the partials must be foldable (the contract the
+    deferred-write combine in forward_decode relies on)."""
+
+    @staticmethod
+    def _guard():
+        from dynamo_tpu.ops.paged_attention import pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            pytest.skip("this jax predates pltpu.CompilerParams "
+                        "(kernel tests run where the env is current)")
+
+    def test_normalized_partials_match_oracle(self):
+        self._guard()
+        from dynamo_tpu.ops.paged_attention import (
+            paged_decode_attention_partial,
+        )
+
+        q, kp, vp, bt, kl = _make_case()
+        acc, m, l = paged_decode_attention_partial(q, kp, vp, bt, kl,
+                                                   interpret=True)
+        b, qh, hd = q.shape
+        kh = kp.shape[2]
+        out = (np.asarray(acc) / np.asarray(l)[..., None]).reshape(
+            b, qh, hd)
+        want = _oracle(q, kp, vp, bt, kl)
+        np.testing.assert_allclose(out, want, rtol=2e-5, atol=2e-5)
+
+    def test_partials_fold_across_a_page_split(self):
+        """m is the row max and l the exp-sum at that max: the standard
+        flash rescale over the partials of the first two pages and the
+        last two pages must reproduce attention over the full history —
+        the exact combine forward_decode's deferred-write path runs."""
+        self._guard()
+        from dynamo_tpu.ops.paged_attention import (
+            paged_decode_attention_partial,
+        )
+
+        ps = 8
+        q, kp, vp, bt, kl = _make_case(max_pages=4, ps=ps)
+        lo_len = np.minimum(np.asarray(kl), 2 * ps)
+        hi_len = np.clip(np.asarray(kl) - 2 * ps, 0, 2 * ps)
+        a1, m1, l1 = paged_decode_attention_partial(
+            q, kp, vp, bt[:, :2], jnp.asarray(lo_len, jnp.int32),
+            interpret=True)
+        a2, m2, l2 = paged_decode_attention_partial(
+            q, kp, vp, bt[:, 2:], jnp.asarray(hi_len, jnp.int32),
+            interpret=True)
+        a1, m1, l1 = (np.asarray(x, np.float64) for x in (a1, m1, l1))
+        a2, m2, l2 = (np.asarray(x, np.float64) for x in (a2, m2, l2))
+        m12 = np.maximum(m1, m2)
+        c1 = np.exp(m1 - m12)
+        c2 = np.exp(m2 - m12)
+        acc = a1 * c1[..., None] + a2 * c2[..., None]
+        tot = l1 * c1 + l2 * c2
+        want = _oracle(q, kp, vp, bt, kl)
+        b, qh, hd = q.shape
+        got = (acc / tot[..., None]).reshape(b, qh, hd)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 class TestPagedAttentionDecodeFused:
     """The deferred-write Pallas path (history partials + in-register
     current token) vs paged_attention_decode_xla as oracle."""

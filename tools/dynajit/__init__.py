@@ -1,0 +1,113 @@
+"""dynajit — device-plane static analysis for dynamo_tpu.
+
+Usage::
+
+    python -m tools.dynajit dynamo_tpu/ [--format json]
+    python -m tools.dynajit --registry-update  # bless a jit-surface change
+    python -m tools.dynajit --list-rules
+
+The third analyzer on the shared dynalint/dynaflow driver (collector,
+per-line suppressions, JSON output, CI gate): abstract interpretation
+over the JAX hot path using dynaflow's call graph. Where dynalint
+checks lines and dynaflow checks protocols, dynajit checks what the
+DEVICE sees — the jit cache-key space (DJ1xx, with a checked-in
+jit-signature registry under tools/dynajit/signatures/), host-sync
+reachability from the dispatch loop (DJ2xx), buffer-donation
+discipline (DJ3xx), Pallas kernel contracts (DJ4xx), and exactly-once
+resource typestate (DJ5xx). Suppress on the flagged line with
+``# dynajit: disable=DJ201 -- justification``.
+See docs/static-analysis.md for the catalogue.
+"""
+
+from __future__ import annotations
+
+from tools.dynalint.core import (  # noqa: F401
+    Finding,
+    ProjectRule,
+    Registry,
+    Rule,
+    collect_files,
+    main_for,
+    render_json,
+    render_text,
+)
+from tools.dynalint.core import run as _run
+
+DYNAJIT = Registry("dynajit", "DJ000")
+
+from . import (  # noqa: E402
+    passes_donation,
+    passes_hostsync,
+    passes_pallas,
+    passes_retrace,
+    passes_typestate,
+)
+from .jit_surface import (  # noqa: E402,F401
+    REGISTRY_PATH,
+    SIGNATURE_DIR,
+    JitSite,
+    diff_registry,
+    extract_jit_sites,
+    jit_sites,
+    surface_json,
+    update_registry,
+)
+
+for _cls in (
+    passes_retrace.JitInLoop,
+    passes_retrace.PerCallJit,
+    passes_retrace.UnboundedJitCacheKey,
+    passes_retrace.JitSignatureDrift,
+    passes_hostsync.HostSyncReachable,
+    passes_donation.UseAfterDonate,
+    passes_donation.DonatedAttrNotRebound,
+    passes_donation.KvParamDonationUndeclared,
+    passes_pallas.UncheckedGridDivision,
+    passes_pallas.Q8VariantDtypeDisagreement,
+    passes_pallas.KernelOracleMissing,
+    passes_typestate.ReleaseNotExceptionSafe,
+    passes_typestate.DoubleRelease,
+    passes_typestate.ProbeVerdictLeak,
+):
+    DYNAJIT.register(_cls)
+
+__all__ = ["DYNAJIT", "run", "all_rules", "main", "extract_jit_sites",
+           "jit_sites", "surface_json", "update_registry",
+           "diff_registry", "JitSite", "REGISTRY_PATH", "SIGNATURE_DIR"]
+
+
+def all_rules():
+    return DYNAJIT.all_rules()
+
+
+def run(paths, rules=None):
+    """Analyze `paths`; returns (findings after suppression, files)."""
+    return _run(paths, rules=rules, registry=DYNAJIT)
+
+
+def main(argv=None) -> int:
+    def extra_args(parser):
+        parser.add_argument(
+            "--registry-update", action="store_true",
+            help="regenerate tools/dynajit/signatures/jit_surface.json "
+                 "from the tree (the one-command path after a "
+                 "deliberate compile-signature change) and exit")
+
+    def handle_extra(args):
+        if not args.registry_update:
+            return None
+        files, errors = collect_files(args.paths or ["dynamo_tpu"])
+        for err in errors:
+            print(f"{err.path}:{err.line}: {err.message}")
+        if update_registry(files):
+            print(f"updated jit-signature registry: {REGISTRY_PATH}")
+        else:
+            print("jit-signature registry already current")
+        return 1 if errors else 0
+
+    return main_for(
+        DYNAJIT, ["dynamo_tpu"],
+        "device-plane static analysis (jit surface, host syncs, "
+        "donation, Pallas contracts, resource typestate) for the "
+        "dynamo_tpu codebase", argv, extra_args=extra_args,
+        handle_extra=handle_extra)

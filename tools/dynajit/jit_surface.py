@@ -1,0 +1,430 @@
+"""Jit-surface extraction: every `jax.jit` construction site in the tree.
+
+The compile boundary is the TPU serving plane's real API surface: each
+`jax.jit` site defines a cache-key space (static argnames/nums, traced
+shapes, the Python identity of the jitted callable), and every change to
+one — a new static arg, a dropped donation, a callable constructed per
+call instead of per process — changes what the device compiles and when.
+None of that is visible in a runtime test until silicon stalls.
+
+This module recovers the whole surface statically: decorator sites
+(`@jax.jit`, `@partial(jax.jit, ...)`), call sites (`jax.jit(fn, ...)`),
+their static/donate declarations, and the *disposition* of each
+constructed callable — module-level, cached in a dict, stored on an
+attribute, returned from a builder, bound to a local, or invoked
+immediately. Dispositions are what the DJ1xx retrace rules reason about
+(a per-call construction never hits jit's identity-keyed cache), and the
+full surface snapshots into a checked-in registry
+(`tools/dynajit/signatures/jit_surface.json`) so any signature change
+fails CI with a diff — the drift-gate contract dynaflow's wire schemas
+established, applied to the compile plane.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+from typing import Iterable, Optional
+
+from tools.dynalint.core import SourceFile, call_name
+
+SIGNATURE_DIR = pathlib.Path(__file__).parent / "signatures"
+REGISTRY_PATH = SIGNATURE_DIR / "jit_surface.json"
+
+
+@dataclasses.dataclass
+class JitSite:
+    rel: str
+    line: int
+    scope: str            # "<module>", "func", or "Class.method"
+    form: str             # "decorator" | "call"
+    target: str           # jitted callable's name ("<lambda>" when anon)
+    static_argnames: tuple[str, ...] = ()
+    static_argnums: tuple[int, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    donate_declared: bool = False  # donate_argnums kw present (even `()`)
+    # How the constructed callable is held: "decorator" | "module" |
+    # "returned" | "attr:<name>" | "cached:<container>" | "immediate" |
+    # "local" (never stored — a fresh callable per execution of scope).
+    disposition: str = "local"
+    cache_key: str = ""   # unparsed key expr for cached dispositions
+    in_loop: bool = False
+    target_params: tuple[str, ...] = ()  # resolvable jitted-fn params
+    node: Optional[ast.AST] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def signature(self) -> dict:
+        """Registry entry: everything stable across pure line moves.
+        The file path is anchored at the package root so the snapshot
+        agrees whether the tree was collected via a relative or an
+        absolute path (CI runs from the repo root; pytest hands the
+        collector absolute paths)."""
+        idx = self.rel.find("dynamo_tpu/")
+        return {
+            "file": self.rel[idx:] if idx >= 0 else self.rel,
+            "scope": self.scope,
+            "form": self.form,
+            "target": self.target,
+            "static_argnames": sorted(self.static_argnames),
+            "static_argnums": list(self.static_argnums),
+            "donate_argnums": list(self.donate_argnums),
+            "donate_declared": self.donate_declared,
+            "disposition": self.disposition,
+            "cache_key": self.cache_key,
+            "params": list(self.target_params),
+        }
+
+
+def _jit_callee(node: ast.AST) -> Optional[ast.Call]:
+    """The call carrying jit kwargs: `jax.jit(...)` itself, or
+    `partial(jax.jit, ...)` / `functools.partial(jax.jit, ...)`."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in ("jax.jit", "jit"):
+        return node
+    if name in ("partial", "functools.partial") and node.args:
+        inner = node.args[0]
+        if isinstance(inner, (ast.Attribute, ast.Name)) and \
+                ast.unparse(inner) in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+def _const_ints(node: ast.expr) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _const_strs(node: ast.expr) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _jit_kwargs(call: ast.Call) -> dict:
+    out = {"static_argnames": (), "static_argnums": (),
+           "donate_argnums": (), "donate_declared": False}
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out["static_argnames"] = _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            out["static_argnums"] = _const_ints(kw.value)
+        elif kw.arg == "donate_argnums":
+            out["donate_argnums"] = _const_ints(kw.value)
+            out["donate_declared"] = True
+    return out
+
+
+def _params_of(args: ast.arguments) -> tuple[str, ...]:
+    return tuple(a.arg for a in args.posonlyargs + args.args)
+
+
+def _target_info(call: ast.Call, local_defs: dict) -> tuple[str, tuple]:
+    """(target name, params) of the callable handed to jax.jit(...)."""
+    if call_name(call) in ("partial", "functools.partial"):
+        return "<partial-jit>", ()  # configured jit awaiting its target
+    if not call.args:
+        return "<unknown>", ()
+    tgt = call.args[0]
+    if isinstance(tgt, ast.Lambda):
+        return "<lambda>", _params_of(tgt.args)
+    if isinstance(tgt, ast.Name):
+        fn = local_defs.get(tgt.id)
+        return tgt.id, _params_of(fn.args) if fn is not None else ()
+    if isinstance(tgt, ast.Call) and call_name(tgt) in (
+            "partial", "functools.partial") and tgt.args:
+        inner = tgt.args[0]
+        name = (ast.unparse(inner)
+                if isinstance(inner, (ast.Name, ast.Attribute)) else "?")
+        fn = local_defs.get(name)
+        # partial binds keywords in this codebase; positional params of
+        # the underlying def still apply when it is locally resolvable.
+        return f"partial:{name}", _params_of(fn.args) if fn else ()
+    if isinstance(tgt, (ast.Attribute, ast.Name)):
+        return ast.unparse(tgt), ()
+    return "<expr>", ()
+
+
+def _is_jit_decorator(dec: ast.expr) -> Optional[ast.Call]:
+    """Returns the kwargs-carrying call for decorator forms; bare
+    `@jax.jit` returns a synthetic empty marker (None kwargs source)."""
+    call = _jit_callee(dec)
+    if call is not None:
+        return call
+    return None
+
+
+class _Extractor:
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.sites: list[JitSite] = []
+        # module + nested defs by bare name, for target param resolution
+        self.defs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+
+    def run(self) -> list[JitSite]:
+        self._visit_body(self.src.tree.body, scope="<module>", cls=None,
+                         in_loop=False)
+        return self.sites
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit_body(self, body: list, scope: str, cls: Optional[str],
+                    in_loop: bool) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt, scope, cls, in_loop)
+
+    def _visit_stmt(self, stmt: ast.stmt, scope: str, cls: Optional[str],
+                    in_loop: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in stmt.decorator_list:
+                self._record_decorator(stmt, dec, scope, cls, in_loop)
+            inner = (stmt.name if cls is None else f"{cls}.{stmt.name}")
+            self._visit_function(stmt, inner)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self._visit_body(stmt.body, scope, stmt.name, in_loop)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self._scan_exprs(stmt, scope, in_loop, stmt_ctx=None,
+                             header_only=True)
+            self._visit_body(stmt.body + stmt.orelse, scope, cls, True)
+            return
+        if isinstance(stmt, (ast.If, ast.With, ast.AsyncWith)):
+            self._scan_exprs(stmt, scope, in_loop, stmt_ctx=None,
+                             header_only=True)
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.stmt):
+                    self._visit_stmt(sub, scope, cls, in_loop)
+            return
+        if isinstance(stmt, ast.Try):
+            for sub in (stmt.body + stmt.orelse + stmt.finalbody):
+                self._visit_stmt(sub, scope, cls, in_loop)
+            for handler in stmt.handlers:
+                self._visit_body(handler.body, scope, cls, in_loop)
+            return
+        self._scan_exprs(stmt, scope, in_loop, stmt_ctx=stmt)
+
+    def _visit_function(self, fn, scope: str) -> None:
+        """Call-form sites inside one function, with local disposition
+        refinement (a local later stored in a cache/attr is not a
+        per-call construction)."""
+        before = len(self.sites)
+        self._visit_body(fn.body, scope, cls=None, in_loop=False)
+        new = [s for s in self.sites[before:]
+               if s.scope == scope and s.form == "call"]
+        if not new:
+            return
+        locals_to_sites: dict[str, list[JitSite]] = {}
+        for site in new:
+            if site.disposition.startswith("local:"):
+                locals_to_sites.setdefault(
+                    site.disposition.split(":", 1)[1], []).append(site)
+        if locals_to_sites:
+            self._refine_locals(fn, locals_to_sites)
+        for site in new:  # anything still raw-local collapses to "local"
+            if site.disposition.startswith("local:"):
+                site.disposition = "local"
+
+    def _refine_locals(self, fn, locals_to_sites: dict) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and isinstance(node.value,
+                                                           ast.Name):
+                for site in locals_to_sites.get(node.value.id, ()):
+                    site.disposition = "returned"
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                             ast.Name):
+                sites = locals_to_sites.get(node.value.id, ())
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        for site in sites:
+                            site.disposition = f"attr:{tgt.attr}"
+                    elif isinstance(tgt, ast.Subscript):
+                        cont = _container_name(tgt.value)
+                        for site in sites:
+                            site.disposition = f"cached:{cont}"
+                            site.cache_key = ast.unparse(tgt.slice)
+
+    # -- site recording ----------------------------------------------------
+
+    def _record_decorator(self, fn, dec: ast.expr, scope: str,
+                          cls: Optional[str], in_loop: bool) -> None:
+        call = _is_jit_decorator(dec)
+        bare = (isinstance(dec, (ast.Attribute, ast.Name))
+                and ast.unparse(dec) in ("jax.jit", "jit"))
+        if call is None and not bare:
+            return
+        kwargs = _jit_kwargs(call) if call is not None else {
+            "static_argnames": (), "static_argnums": (),
+            "donate_argnums": (), "donate_declared": False}
+        self.sites.append(JitSite(
+            rel=self.src.rel, line=fn.lineno, scope=scope,
+            form="decorator", target=fn.name,
+            disposition="decorator", in_loop=in_loop,
+            target_params=_params_of(fn.args), node=fn, **kwargs))
+
+    def _scan_exprs(self, stmt: ast.stmt, scope: str, in_loop: bool,
+                    stmt_ctx: Optional[ast.stmt],
+                    header_only: bool = False) -> None:
+        """Find jit Call nodes inside a statement (or just its header
+        expressions for compound statements)."""
+        if header_only:
+            roots = [n for n in ast.iter_child_nodes(stmt)
+                     if isinstance(n, ast.expr)]
+        else:
+            roots = [stmt]
+        for root in roots:
+            for node in ast.walk(root):
+                call = _jit_callee(node)
+                if call is None or call is not node:
+                    continue
+                self._record_call(call, stmt if stmt_ctx is None else
+                                  stmt_ctx, scope, in_loop, root)
+
+    def _record_call(self, call: ast.Call, stmt: ast.stmt, scope: str,
+                     in_loop: bool, root: ast.AST) -> None:
+        target, params = _target_info(call, self.defs)
+        disposition = "local"
+        cache_key = ""
+        if scope == "<module>":
+            disposition = "module"
+        elif isinstance(stmt, ast.Return) and stmt.value is call:
+            disposition = "returned"
+        elif isinstance(stmt, ast.Assign) and stmt.value is call:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Attribute):
+                disposition = f"attr:{tgt.attr}"
+            elif isinstance(tgt, ast.Subscript):
+                disposition = f"cached:{_container_name(tgt.value)}"
+                cache_key = ast.unparse(tgt.slice)
+            elif isinstance(tgt, ast.Name):
+                disposition = f"local:{tgt.id}"  # refined by caller
+        else:
+            # jax.jit(...)(...) — constructed and invoked in one
+            # expression: a fresh callable (and an empty jit cache)
+            # every time the statement runs.
+            for outer in ast.walk(root):
+                if isinstance(outer, ast.Call) and outer.func is call:
+                    disposition = "immediate"
+                    break
+        kwargs = _jit_kwargs(call)
+        self.sites.append(JitSite(
+            rel=self.src.rel, line=call.lineno, scope=scope, form="call",
+            target=target, disposition=disposition, cache_key=cache_key,
+            in_loop=in_loop, target_params=params, node=call, **kwargs))
+
+
+def _container_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ast.unparse(node)
+
+
+def extract_jit_sites(files: list[SourceFile]) -> list[JitSite]:
+    sites: list[JitSite] = []
+    for src in files:
+        sites.extend(_Extractor(src).run())
+    return sites
+
+
+# One extraction shared by every DJ1xx rule in a run (run() hands all
+# rules the same `files` list; the entry keys the list itself so a freed
+# id() can never serve a stale surface — the dynaflow cache contract).
+_CACHE: dict[int, tuple[list, list]] = {}
+
+
+def jit_sites(files: list[SourceFile]) -> list[JitSite]:
+    hit = _CACHE.get(id(files))
+    if hit is not None and hit[0] is files:
+        return hit[1]
+    if len(_CACHE) > 8:
+        _CACHE.clear()
+    sites = extract_jit_sites(files)
+    _CACHE[id(files)] = (files, sites)
+    return sites
+
+
+# -- registry snapshot -------------------------------------------------------
+
+
+def surface_json(files: list[SourceFile]) -> dict:
+    entries = sorted((s.signature() for s in jit_sites(files)),
+                     key=lambda e: json.dumps(e, sort_keys=True))
+    return {"version": 1, "sites": entries}
+
+
+def update_registry(files: list[SourceFile],
+                    registry_path: pathlib.Path = REGISTRY_PATH) -> bool:
+    """Regenerate the checked-in jit-signature registry; True if it
+    changed."""
+    registry_path.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(surface_json(files), indent=2,
+                         sort_keys=True) + "\n"
+    if registry_path.exists() and registry_path.read_text() == payload:
+        return False
+    registry_path.write_text(payload)
+    return True
+
+
+def diff_registry(files: list[SourceFile],
+                  registry_path: pathlib.Path = REGISTRY_PATH,
+                  ) -> Optional[list[str]]:
+    """None when the tree matches the snapshot; otherwise a list of
+    human-readable drift lines (added/removed signature entries)."""
+    if not registry_path.exists():
+        return ["no jit-signature registry at "
+                f"{registry_path}; run `python -m tools.dynajit "
+                "--registry-update` and commit the result"]
+    want = json.loads(registry_path.read_text())
+    got = surface_json(files)
+    if got == want:
+        return None
+
+    def keyed(payload: dict) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for entry in payload.get("sites", []):
+            key = json.dumps(entry, sort_keys=True)
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    want_k, got_k = keyed(want), keyed(got)
+    lines = []
+    for key in sorted(set(got_k) - set(want_k)):
+        entry = json.loads(key)
+        lines.append(f"added: {entry['file']}::{entry['scope']} "
+                     f"jit({entry['target']}) [{entry['disposition']}]")
+    for key in sorted(set(want_k) - set(got_k)):
+        entry = json.loads(key)
+        lines.append(f"removed: {entry['file']}::{entry['scope']} "
+                     f"jit({entry['target']}) [{entry['disposition']}]")
+    for key in sorted(set(want_k) & set(got_k)):
+        if want_k[key] != got_k[key]:
+            entry = json.loads(key)
+            lines.append(
+                f"count changed ({want_k[key]} -> {got_k[key]}): "
+                f"{entry['file']}::{entry['scope']} "
+                f"jit({entry['target']})")
+    return lines or ["signature ordering drifted (regenerate)"]
+
+
+def iter_sites_in(files: list[SourceFile],
+                  rel_suffixes: tuple[str, ...]) -> Iterable[JitSite]:
+    for site in jit_sites(files):
+        if site.rel.endswith(rel_suffixes):
+            yield site

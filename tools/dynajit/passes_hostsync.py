@@ -1,0 +1,159 @@
+"""DJ2xx — host-device sync reachability from the dispatch loop.
+
+dynalint's DL201 flags syncs *inside loops*, per file. That misses the
+class of regression that actually moved TTFT in round 5: a straight-line
+`.item()` / bare `np.asarray` added three calls deep under
+`_dispatch_decode` serializes host and device once per engine iteration
+and no runtime test notices (CPU tests have no dispatch pipeline to
+stall). This pass walks dynaflow's name-resolved call graph from the
+serving plane's hot entry points — the scheduler's dispatch/drain/
+prefill phases, every ModelRunner decode*/prefill* step, and the
+run_in_gap maintenance window (KVBM offload gathers) — and flags every
+host-sync operation reachable from them.
+
+Device-readback detection leans on a repo convention the rule also
+enforces: host-side array conversions ALWAYS pass an explicit dtype
+(`np.asarray(tokens, np.int32)`), while device readbacks are bare
+one-argument calls (`np.asarray(toks_dev)`). A flagged line is either a
+real regression (fix it) or a designed drain point (suppress it with a
+justification — the suppression inventory doubles as the canonical list
+of every host sync on the dispatch path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dynaflow.graph import FunctionInfo, get_project
+from tools.dynalint.core import (
+    Finding,
+    ProjectRule,
+    SourceFile,
+    call_name,
+    walk_skip_functions,
+)
+
+# The serving plane's hot entry points (function bare names).
+HOT_ENTRIES = (
+    # scheduler loop phases (engine/scheduler.py)
+    "_step", "_dispatch_decode", "_drain_decode", "_drain_spec",
+    "_prefill_some", "_drain_gap",
+    # compiled-step host API (engine/model_runner.py)
+    "decode", "decode_multi", "decode_spec",
+    "prefill_chunk", "prefill_chunk_batch", "prefill_ring_batch",
+    # the maintenance-window device ops (gap callbacks gather through
+    # these; the closures themselves are lambdas the graph cannot name)
+    "gather_pages_device", "scatter_pages",
+)
+
+# Files whose functions participate in the reachability walk. The name-
+# resolved graph over-approximates; bounding the walk to the dispatch
+# plane keeps every finding a genuine hot-path sync.
+SCOPE_MARKERS = ("/engine/", "block_manager/offload.py")
+
+_SYNC_NAMES = {"jax.device_get"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_BARE_READBACK = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(marker in rel for marker in SCOPE_MARKERS)
+
+
+def _sync_call(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in _SYNC_NAMES:
+        return name
+    last = name.split(".")[-1]
+    if last in _SYNC_METHODS and not node.args and not node.keywords:
+        return f".{last}()"
+    if name in _BARE_READBACK and len(node.args) == 1 \
+            and not node.keywords:
+        # Bare one-arg form = device readback by repo convention; host
+        # conversions pass an explicit dtype and are exempt.
+        return name
+    return None
+
+
+class HostSyncReachable(ProjectRule):
+    id = "DJ201"
+    name = "host-sync-reachable-from-dispatch"
+    description = (
+        "a host-device synchronization (.item(), .block_until_ready(), "
+        "jax.device_get, or a bare one-argument np.asarray/np.array — "
+        "the repo's device-readback form; dtype-carrying conversions "
+        "are host-side and exempt) is reachable from a serving-plane "
+        "hot entry (scheduler dispatch/drain, ModelRunner "
+        "decode*/prefill*, the run_in_gap window) over the call graph: "
+        "it serializes host and device once per engine iteration — "
+        "remove it, defer it behind the next dispatch, or suppress "
+        "with a justification naming why this drain point is designed")
+
+    def __init__(self, entries: tuple[str, ...] = HOT_ENTRIES) -> None:
+        self.entries = entries
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        entry_fns = [fn for name in self.entries
+                     for fn in project.by_name.get(name, ())
+                     if _in_scope(fn.rel)]
+        if not entry_fns:
+            return
+        reachable = self._reachable_in_scope(project, entry_fns)
+        src_by_rel = {src.rel: src for src in files}
+        seen: set[tuple[str, int, int]] = set()
+        for qualname in sorted(reachable):
+            fn = project.functions[qualname]
+            if fn.name == "<module>":
+                continue
+            src = src_by_rel.get(fn.rel)
+            if src is None:
+                continue
+            for finding in self._check_fn(src, fn):
+                key = (finding.path, finding.line, finding.col)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding
+
+    @staticmethod
+    def _reachable_in_scope(project, entries: list[FunctionInfo]
+                            ) -> set[str]:
+        # calls-only edges (refs_too=False): bare-name references are
+        # how dynaflow catches callback hand-offs, but here they link a
+        # loop variable named `start` to `Scheduler.start` and drag the
+        # whole offload thread into the "dispatch path". The gap-window
+        # device ops the callbacks reach (gather_pages_device /
+        # scatter_pages) are entries in their own right, so the
+        # precision costs no coverage.
+        out: set[str] = set()
+        stack = list(entries)
+        while stack:
+            fn = stack.pop()
+            if fn.qualname in out:
+                continue
+            out.add(fn.qualname)
+            stack.extend(c for c in project.callees(fn, refs_too=False)
+                         if c.qualname not in out and _in_scope(c.rel))
+        return out
+
+    def _check_fn(self, src: SourceFile,
+                  fn: FunctionInfo) -> Iterable[Finding]:
+        body = getattr(fn.node, "body", None)
+        if not isinstance(body, list):
+            return
+        # Nested defs/lambdas are their own graph nodes (or escape the
+        # dispatch plane entirely); only this function's own statements
+        # execute on its call path.
+        for node in walk_skip_functions(body):
+            if not isinstance(node, ast.Call):
+                continue
+            sync = _sync_call(node)
+            if sync is None:
+                continue
+            yield Finding(
+                self.id, self.name, src.rel, node.lineno, node.col_offset,
+                f"{sync} in {fn.name!r} is reachable from a dispatch-"
+                "loop hot entry: a blocking host-device round trip per "
+                "engine iteration — defer the readback behind the next "
+                "dispatch or justify the drain point")

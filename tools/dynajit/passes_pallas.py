@@ -1,0 +1,273 @@
+"""DJ4xx — Pallas kernel contracts.
+
+A Pallas kernel's correctness contract lives outside the code that
+expresses it: the grid must tile the array exactly (a truncating `//`
+silently drops trailing rows), the int8/q8 variant must actually touch
+quantized dtypes (a copy-pasted body that forgot the dequant produces
+plausible garbage), and every kernel needs an interpret-mode XLA-oracle
+test — the only way kernel math is checkable off silicon. None of these
+break a CPU test suite when violated; all of them break the flagship.
+
+  * DJ401 unchecked-grid-division — a `grid=` element `A // B` where
+    neither operand is derived through a divisibility-aware computation
+    (a `%` guard, a `_divisor`-style helper, pow2 `bit_length`
+    bucketing, round-up padding) in the enclosing function.
+  * DJ402 q8-variant-dtype-disagreement — a `<fn>_q8` variant whose
+    body never references an int8/uint8 dtype (or a base fn that does):
+    the quantized and unquantized paths have drifted into each other.
+  * DJ403 kernel-oracle-missing — a public ops/ function containing a
+    `pl.pallas_call` with no reference anywhere under tests/: the
+    kernel has no interpret-mode oracle pinning it to the XLA
+    reference (the contract every existing kernel test follows).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, Rule, SourceFile
+
+# Anchored at the repo root (the dynaflow METRICS_DOC convention) so
+# the rule finds the tests tree regardless of the caller's CWD.
+DEFAULT_TESTS_DIR = pathlib.Path(__file__).parent.parent.parent / "tests"
+
+
+def _is_ops(rel: str) -> bool:
+    return "/ops/" in rel or rel.startswith("ops/")
+
+
+def _has_pallas_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                    ast.Attribute) \
+                and sub.func.attr == "pallas_call":
+            return True
+    return False
+
+
+class UncheckedGridDivision(Rule):
+    id = "DJ401"
+    name = "unchecked-grid-division"
+    description = (
+        "a pallas_call grid element divides with // where neither "
+        "operand is derived through a divisibility-aware computation "
+        "(% guard/assert, a *divisor* helper, pow2 bit_length "
+        "bucketing, round-up padding): a non-dividing shape silently "
+        "truncates the trailing tile instead of failing")
+
+    def applies(self, rel: str) -> bool:
+        return _is_ops(rel)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guarded = _guarded_names(fn)
+            for call in ast.walk(fn):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "pallas_call"):
+                    continue
+                for kw in call.keywords:
+                    if kw.arg not in ("grid", "grid_spec"):
+                        continue
+                    yield from self._check_grid(src, kw.value, guarded)
+
+    def _check_grid(self, src: SourceFile, grid: ast.expr,
+                    guarded: set[str]) -> Iterable[Finding]:
+        for node in ast.walk(grid):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.FloorDiv)):
+                continue
+            names = {sub.id for operand in (node.left, node.right)
+                     for sub in ast.walk(operand)
+                     if isinstance(sub, ast.Name)}
+            if names and not (names & guarded):
+                yield self.finding(
+                    src, node,
+                    f"grid element `{ast.unparse(node)}` divides "
+                    "unguarded values: a non-dividing shape silently "
+                    "drops the trailing tile — guard with an assert, a "
+                    "divisor helper, or round-up padding")
+
+
+def _guarded_names(fn) -> set[str]:
+    """Names the function derives through divisibility-aware
+    computation, closed over simple name copies."""
+    guarded: set[str] = set()
+    copies: list[tuple[str, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assert) or (
+                isinstance(node, ast.If)
+                and any(isinstance(s, ast.Raise) for s in node.body)):
+            test = node.test
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.BinOp) and isinstance(sub.op,
+                                                             ast.Mod):
+                    guarded.update(n.id for n in ast.walk(sub)
+                                   if isinstance(n, ast.Name))
+        elif isinstance(node, ast.Assign):
+            derived = any(
+                (isinstance(sub, ast.BinOp)
+                 and isinstance(sub.op, (ast.Mod, ast.FloorDiv)))
+                or (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "bit_length")
+                or (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and "divisor" in sub.func.id)
+                for sub in ast.walk(node.value))
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if derived:
+                    guarded.add(tgt.id)
+                elif isinstance(node.value, ast.Name):
+                    copies.append((tgt.id, node.value.id))
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            if isinstance(node.op, (ast.FloorDiv, ast.Mod)):
+                guarded.add(node.target.id)
+        elif isinstance(node, ast.While):
+            has_mod = any(isinstance(sub, ast.BinOp)
+                          and isinstance(sub.op, ast.Mod)
+                          for sub in ast.walk(node.test))
+            if has_mod:
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                        tgt = (sub.targets[0]
+                               if isinstance(sub, ast.Assign)
+                               else sub.target)
+                        if isinstance(tgt, ast.Name):
+                            guarded.add(tgt.id)
+    # close over x = y copies (one fixpoint pass per edge is enough for
+    # the chains this codebase writes)
+    changed = True
+    while changed:
+        changed = False
+        for dst, srcname in copies:
+            if srcname in guarded and dst not in guarded:
+                guarded.add(dst)
+                changed = True
+    return guarded
+
+
+_INT8_MARKERS = ("int8", "uint8")
+
+
+def _mentions_int8(fn) -> bool:
+    """The function handles quantized data itself (int8/uint8 dtype
+    references) or routes to a *_q8 callee that does (the
+    scatter_from_host_q8 -> scatter_kv_blocks_q8 delegation idiom)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in _INT8_MARKERS:
+            return True
+        if isinstance(node, ast.Name) and node.id in _INT8_MARKERS:
+            return True
+        if isinstance(node, ast.Constant) and node.value in _INT8_MARKERS:
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if tail != fn.name and "q8" in tail:
+                return True
+    return False
+
+
+class Q8VariantDtypeDisagreement(Rule):
+    id = "DJ402"
+    name = "q8-variant-dtype-disagreement"
+    description = (
+        "a `<fn>_q8` quantized variant never references an int8/uint8 "
+        "dtype (or its base fn does): the quantized and unquantized "
+        "paths have drifted into each other — the q8 body must handle "
+        "packed int8 values and their scale rows explicitly")
+
+    def applies(self, rel: str) -> bool:
+        return _is_ops(rel)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        fns = {node.name: node for node in ast.walk(src.tree)
+               if isinstance(node, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))}
+        for name, fn in fns.items():
+            if not name.endswith("_q8"):
+                continue
+            if not _mentions_int8(fn):
+                yield self.finding(
+                    src, fn,
+                    f"{name!r} is a q8 variant but its body never "
+                    "references an int8/uint8 dtype — the quantized "
+                    "path has lost its dequant/pack handling")
+                continue
+            base = fns.get(name[: -len("_q8")])
+            if base is not None and _mentions_int8(base) \
+                    and not base.name.startswith("_"):
+                yield self.finding(
+                    src, base,
+                    f"{base.name!r} (the unquantized base of {name!r}) "
+                    "references int8/uint8 — the two variants have "
+                    "drifted into each other")
+
+
+class KernelOracleMissing(ProjectRule):
+    id = "DJ403"
+    name = "kernel-oracle-missing"
+    description = (
+        "a public ops/ function containing a pl.pallas_call has no "
+        "reference anywhere under tests/: every Pallas kernel needs an "
+        "interpret-mode XLA-oracle test (the only way kernel math is "
+        "checkable off silicon) — add one to tests/test_ops_pallas.py "
+        "or the kernel's feature test file")
+
+    def __init__(self, tests_dir: Optional[pathlib.Path] = None) -> None:
+        self.tests_dir = (DEFAULT_TESTS_DIR if tests_dir is None
+                          else tests_dir)
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        kernels: list[tuple[SourceFile, ast.AST, str]] = []
+        for src in files:
+            if not _is_ops(src.rel):
+                continue
+            for node in src.tree.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name.startswith("_"):
+                    continue
+                if _has_pallas_call(node):
+                    kernels.append((src, node, node.name))
+        if not kernels:
+            return
+        corpus = self._tests_corpus()
+        if corpus is None:
+            return  # no tests tree next to the linted files (fixtures)
+        for src, node, name in kernels:
+            # Word-boundary match: `paged_decode_attention` appearing
+            # inside `paged_decode_attention_partial(` must not satisfy
+            # the BASE kernel's oracle requirement (prefix kernels are
+            # exactly the family this rule guards).
+            if not re.search(rf"\b{re.escape(name)}\b", corpus):
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"Pallas kernel {name!r} has no reference anywhere "
+                    f"under {self.tests_dir}/ — add an interpret-mode "
+                    "XLA-oracle test pinning it")
+
+    def _tests_corpus(self) -> Optional[str]:
+        if not self.tests_dir.is_dir():
+            return None
+        parts = []
+        for path in sorted(self.tests_dir.rglob("*.py")):
+            if "fixtures" in path.parts:
+                continue  # lint-fixture kernels must not self-satisfy
+            try:
+                parts.append(path.read_text(encoding="utf-8"))
+            except OSError:
+                continue
+        return "\n".join(parts)

@@ -1,0 +1,285 @@
+"""DJ1xx — retrace hazards over the jit surface.
+
+A `jax.jit` callable caches compiled executables keyed on (its own
+Python identity, static argument values, traced shapes/dtypes). Three
+construction mistakes defeat that cache silently:
+
+  * a jit constructed inside a loop compiles fresh EVERY iteration
+    (each lambda/partial is a new identity with an empty cache);
+  * a jit constructed per call — immediately invoked, or bound to a
+    local that is never stored — compiles fresh every call of the
+    enclosing function;
+  * a dict cache of jitted callables keyed on a raw per-request value
+    retains one compiled program per distinct value forever: a client
+    parameter sweep becomes a compile storm plus unbounded executable
+    retention.
+
+The blessed idioms this codebase already uses are recognized and pass
+clean: module-level/decorator jits, builder methods that `return
+jax.jit(...)` into a cache, `self.<cache>[key] = fn` stores, and cache
+keys derived through the pow2 bucketing helpers (`_bucket_for`,
+`bucket_table_width`, `.bit_length()`); caches with an eviction path
+(`.pop`/`popitem`/`del`) are bounded by construction. Everything else
+is a finding — fix it or suppress it with a justification on the line.
+
+DJ104 turns the whole surface into a drift gate: the extracted
+signatures must match the checked-in registry
+(`tools/dynajit/signatures/jit_surface.json`); bless deliberate changes
+with `python -m tools.dynajit --registry-update`.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .jit_surface import (
+    REGISTRY_PATH,
+    JitSite,
+    _container_name,
+    _jit_callee,
+    diff_registry,
+    jit_sites,
+)
+
+# Key-derivation helpers that bound a cache-key domain to pow2 buckets.
+BUCKETING_CALLS = ("_bucket_for", "bucket_table_width", "bit_length")
+
+
+class _SurfaceRule(ProjectRule):
+    def _finding(self, site: JitSite, message: str) -> Finding:
+        node = site.node
+        return Finding(self.id, self.name, site.rel,
+                       getattr(node, "lineno", site.line),
+                       getattr(node, "col_offset", 0), message)
+
+
+class JitInLoop(_SurfaceRule):
+    id = "DJ101"
+    name = "jit-in-loop"
+    description = (
+        "jax.jit constructed inside a for/while body: every iteration "
+        "creates a fresh callable with an empty compile cache, so the "
+        "device recompiles per iteration — hoist the construction out "
+        "of the loop (or into a cached builder)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for site in jit_sites(files):
+            if site.in_loop:
+                yield self._finding(
+                    site,
+                    f"jit({site.target}) is constructed inside a loop "
+                    "body; each iteration compiles from scratch — hoist "
+                    "it out of the loop")
+
+
+class PerCallJit(_SurfaceRule):
+    id = "DJ102"
+    name = "per-call-jit-construction"
+    description = (
+        "jax.jit constructed per call of its enclosing function "
+        "(invoked immediately, or bound to a local that is never "
+        "stored): the callable's compile cache dies with the call, so "
+        "every invocation recompiles — store it (module level, "
+        "attribute, bounded cache, or a `return jax.jit(...)` builder). "
+        "__init__ is exempt (one-time construction by definition)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for site in jit_sites(files):
+            if site.form != "call" or site.scope == "<module>":
+                continue
+            if site.in_loop:
+                continue  # DJ101 owns loop-constructed sites
+            if site.disposition not in ("immediate", "local"):
+                continue
+            method = site.scope.rsplit(".", 1)[-1]
+            if method == "__init__":
+                continue
+            how = ("invoked in the same expression"
+                   if site.disposition == "immediate"
+                   else "bound to a local that is never stored")
+            yield self._finding(
+                site,
+                f"jit({site.target}) in {site.scope!r} is {how}: a "
+                "fresh callable (and an empty compile cache) per call "
+                "— hoist it, or store it in a bounded cache")
+
+
+class UnboundedJitCacheKey(_SurfaceRule):
+    id = "DJ103"
+    name = "unbounded-jit-cache-key"
+    description = (
+        "a dict cache of compiled callables is keyed on a raw function "
+        "parameter with no eviction on the container: one executable "
+        "retained per distinct value, forever — bucket the key "
+        "(pow2 helpers), bound the cache (.pop/popitem eviction), or "
+        "justify why the key domain is finite. bool-annotated key "
+        "components are exempt (domain of 2)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for src in files:
+            yield from self._check_file(src)
+
+    def _check_file(self, src: SourceFile) -> Iterable[Finding]:
+        builders = _builder_names(src)
+        evicted = _evicted_containers(src)
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(src, fn, builders, evicted)
+
+    def _check_fn(self, src: SourceFile, fn, builders: set[str],
+                  evicted: set[str]) -> Iterable[Finding]:
+        params = {a.arg: a for a in (fn.args.posonlyargs + fn.args.args
+                                     + fn.args.kwonlyargs)}
+        bucketed = _bucketed_names(fn)
+        # locals holding compiled callables: jit results or builder calls
+        jit_locals: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _is_compiled_value(node.value, builders):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jit_locals.add(tgt.id)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.targets[0], ast.Subscript)):
+                continue
+            store = node.targets[0]
+            is_compiled = (_is_compiled_value(node.value, builders)
+                           or (isinstance(node.value, ast.Name)
+                               and node.value.id in jit_locals))
+            if not is_compiled:
+                continue
+            container = _container_name(store.value)
+            if container in evicted:
+                continue
+            raw = self._raw_param_keys(store.slice, params, bucketed)
+            if raw:
+                yield Finding(
+                    self.id, self.name, src.rel, node.lineno,
+                    node.col_offset,
+                    f"compiled-callable cache {container!r} is keyed on "
+                    f"raw parameter(s) {', '.join(sorted(raw))} with no "
+                    "eviction on the container: unbounded executable "
+                    "retention — bucket the key or bound the cache")
+
+    @staticmethod
+    def _raw_param_keys(key: ast.expr, params: dict,
+                        bucketed: set[str]) -> set[str]:
+        raw: set[str] = set()
+        for node in ast.walk(key):
+            if not isinstance(node, ast.Name) or node.id not in params:
+                continue
+            if node.id in bucketed:
+                continue
+            ann = params[node.id].annotation
+            if isinstance(ann, ast.Name) and ann.id == "bool":
+                continue
+            if isinstance(ann, ast.Constant) and ann.value == "bool":
+                continue
+            raw.add(node.id)
+        return raw
+
+
+def _builder_names(src: SourceFile) -> set[str]:
+    """Functions in this file that return a jax.jit-compiled callable
+    (the `_build_*` idiom) — calls to them produce compiled values."""
+    out: set[str] = set()
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if any(isinstance(sub, ast.Call)
+                       and _jit_callee(sub) is not None
+                       for sub in ast.walk(node.value)):
+                    out.add(fn.name)
+    return out
+
+
+def _evicted_containers(src: SourceFile) -> set[str]:
+    """Container attribute/variable names with an eviction path
+    somewhere in the file (`X.pop(...)`, `X.popitem(...)`, `del X[...]`)
+    — a bounded cache by construction."""
+    out: set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in ("pop", "popitem"):
+                out.add(_container_name(node.func.value))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    out.add(_container_name(tgt.value))
+    return out
+
+
+def _bucketed_names(fn) -> set[str]:
+    """Local names assigned (anywhere in the function) through a pow2
+    bucketing helper — their value domain is finite by construction."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        uses_bucketing = any(
+            isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                     ast.Attribute)
+            and sub.func.attr in BUCKETING_CALLS
+            or (isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                         ast.Name)
+                and sub.func.id in BUCKETING_CALLS)
+            for sub in ast.walk(node.value))
+        if uses_bucketing:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _is_compiled_value(value: ast.expr, builders: set[str]) -> bool:
+    if isinstance(value, ast.Call):
+        if _jit_callee(value) is not None:
+            return True
+        fn = value.func
+        tail = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        return tail in builders
+    return False
+
+
+class JitSignatureDrift(ProjectRule):
+    id = "DJ104"
+    name = "jit-signature-drift"
+    description = (
+        "the tree's extracted jit surface (sites, static/donate "
+        "declarations, cache dispositions) diverged from the checked-in "
+        "registry under tools/dynajit/signatures/ — compile-triggering "
+        "signature changes must be deliberate: run `python -m "
+        "tools.dynajit --registry-update` and commit the diff")
+
+    def __init__(self,
+                 registry_path: Optional[pathlib.Path] = REGISTRY_PATH,
+                 ) -> None:
+        self.registry_path = registry_path
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        if self.registry_path is None or not files:
+            return
+        if not any(jit_sites(files)):
+            return  # no jit surface in this file set; nothing to gate
+        drift = diff_registry(files, self.registry_path)
+        if drift is None:
+            return
+        src = files[0]
+        yield Finding(
+            self.id, self.name, src.rel, 1, 0,
+            "jit surface drifted from the checked-in signature "
+            "registry: " + "; ".join(drift[:8])
+            + ("; ..." if len(drift) > 8 else "")
+            + " — if deliberate, run `python -m tools.dynajit "
+            "--registry-update` and commit the diff")

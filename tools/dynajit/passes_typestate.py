@@ -1,0 +1,357 @@
+"""DJ5xx — exactly-once resource typestate.
+
+Every production incident the last four PRs fixed by hand had the same
+shape: a resource acquired on one path and released on most-but-not-all
+of the others. KV pages parked with a transfer and released twice; a
+trace span opened before an early return and never ended; a breaker's
+half-open probe slot leaked by an attempt that died without a verdict;
+a claimed transfer whose release lived outside the `finally`. This pass
+encodes the contract those reviews enforced: from every acquire, every
+path must reach EXACTLY one release — which in Python means the release
+lives in a `finally` (or the resource is a context manager), and no
+path releases twice.
+
+The checker is per-function with an escape hatch for ownership
+transfer: an acquired value that is returned, yielded, stored on an
+attribute/container, or passed onward carries its release obligation
+with it and is not this function's problem. Resources whose release is
+idempotent by design (trace spans — `_SpanHandle.end` is first-wins)
+are exempt from the double-release rule but not the leak rule.
+
+  * DJ501 release-not-exception-safe — acquire + release in one
+    function, statements that can raise in between, and no release
+    under a `finally`/`with`.
+  * DJ502 double-release — two unconditional releases of the same
+    resource in one straight-line block (non-idempotent resources).
+  * DJ503 probe-verdict-leak — a breaker `try_acquire` with no
+    release-family call (`release_probe`/`record_success`/
+    `record_failure`) under a `finally`: an attempt that dies without a
+    verdict leaks the half-open slot and locks the instance out.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, Rule, SourceFile
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    name: str
+    acquire_tails: tuple[str, ...]
+    release_tails: tuple[str, ...]
+    idempotent_release: bool = False
+
+
+RESOURCES = (
+    # Trace spans: _SpanHandle.end is first-wins, so double-end is the
+    # DESIGNED pattern (success-end in the body, failure-end in the
+    # finally); leaking one silently drops the span from export.
+    ResourceSpec("span", ("start_span",), ("end",),
+                 idempotent_release=True),
+    # Pending/streaming KV transfers: claim() removes the table entry
+    # atomically and the claimer owns exactly one release() — a leak
+    # pins the prefill pool's pages forever, a double release hands
+    # live pages to another request.
+    ResourceSpec("transfer", ("claim",), ("release",)),
+)
+
+PROBE_RELEASES = ("release_probe", "record_success", "record_failure")
+
+
+def _call_tail(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    """Conservative: any call/await/yield between acquire and release
+    can raise (or suspend and be cancelled)."""
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Call, ast.Await, ast.Yield,
+                             ast.YieldFrom, ast.Raise)):
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class _Acquire:
+    spec: ResourceSpec
+    var: Optional[str]  # bound name, None when consumed inline
+    node: ast.AST
+
+
+class _FunctionScan:
+    """One function's acquire/release/escape facts for one spec."""
+
+    def __init__(self, fn, spec: ResourceSpec) -> None:
+        self.fn = fn
+        self.spec = spec
+        self.acquires: list[_Acquire] = []
+        self.releases: list[tuple[str, ast.AST]] = []  # (var, node)
+        self.finally_released: set[str] = set()
+        self.with_managed: set[str] = set()
+        self.escaped: set[str] = set()
+        self._scan()
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if _call_tail(node.value) in self.spec.acquire_tails:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        self.acquires.append(
+                            _Acquire(self.spec, tgt.id, node))
+                    else:
+                        self.escaped.add("<unbound>")
+            elif isinstance(node, ast.Call) \
+                    and _call_tail(node) in self.spec.release_tails \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                self.releases.append((node.func.value.id, node))
+        acquired = {a.var for a in self.acquires if a.var}
+        if not acquired:
+            return
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) \
+                                and _call_tail(sub) in \
+                                self.spec.release_tails \
+                                and isinstance(sub.func, ast.Attribute) \
+                                and isinstance(sub.func.value, ast.Name):
+                            self.finally_released.add(sub.func.value.id)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name) and ctx.id in acquired:
+                        self.with_managed.add(ctx.id)
+        self._scan_escapes(acquired)
+
+    def _scan_escapes(self, acquired: set[str]) -> None:
+        """Ownership transfer = the resource ITSELF leaves the function
+        (returned/yielded/stored/passed as a bare name). A derived value
+        (`return transfer.page_ids.copy()`) transfers nothing — the
+        release obligation stays here."""
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                self._escape_names(node.value, acquired)
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                self._escape_names(node.value, acquired)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        self._escape_names(node.value, acquired)
+            elif isinstance(node, ast.Call):
+                tail = _call_tail(node)
+                if tail in self.spec.release_tails \
+                        or tail in self.spec.acquire_tails:
+                    continue
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    self._escape_names(arg, acquired)
+
+    def _escape_names(self, expr: ast.expr, acquired: set[str]) -> None:
+        nodes: list[ast.expr] = [expr]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            nodes = list(expr.elts)
+        for sub in nodes:
+            if isinstance(sub, ast.Name) and sub.id in acquired:
+                self.escaped.add(sub.id)
+
+
+class _TypestateRule(Rule):
+    def _functions(self, src: SourceFile):
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+class ReleaseNotExceptionSafe(_TypestateRule):
+    id = "DJ501"
+    name = "release-not-exception-safe"
+    description = (
+        "a resource (claimed transfer, trace span) is acquired and "
+        "released in the same function, statements between them can "
+        "raise, and no release sits under a finally (or `with`): the "
+        "exception path leaks it — pages pinned forever, a span "
+        "silently dropped. Move the release into a finally, or hand "
+        "ownership off explicitly")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in self._functions(src):
+            for spec in RESOURCES:
+                scan = _FunctionScan(fn, spec)
+                yield from self._check(src, fn, spec, scan)
+
+    def _check(self, src: SourceFile, fn, spec: ResourceSpec,
+               scan: _FunctionScan) -> Iterable[Finding]:
+        released_vars = {var for var, _ in scan.releases}
+        for acq in scan.acquires:
+            if acq.var is None or acq.var in scan.escaped:
+                continue
+            if acq.var in scan.with_managed:
+                continue
+            if acq.var not in released_vars:
+                # guard-only uses (e.g. `if x.claim(...) is not None`)
+                # never bind, so reaching here means a bound resource
+                # with no release at all and no escape
+                yield self.finding(
+                    src, acq.node,
+                    f"{spec.name} {acq.var!r} is acquired here but "
+                    "never released in this function and never escapes "
+                    "— the resource leaks on every path")
+                continue
+            if acq.var in scan.finally_released:
+                continue
+            between = _stmts_between(fn, acq.node, acq.var, spec)
+            if any(_can_raise(s) for s in between):
+                yield self.finding(
+                    src, acq.node,
+                    f"{spec.name} {acq.var!r} is released outside any "
+                    "finally while statements in between can raise: "
+                    "the exception path leaks it — move the release "
+                    "into a finally")
+
+
+def _stmts_between(fn, acquire_stmt: ast.AST, var: str,
+                   spec: ResourceSpec) -> list[ast.stmt]:
+    """Statements after the acquire and before the first release of
+    `var` (linear document order — branches over-approximate)."""
+    stmts = [s for s in ast.walk(fn) if isinstance(s, ast.stmt)
+             and hasattr(s, "lineno")]
+    stmts.sort(key=lambda s: (s.lineno, s.col_offset))
+    out: list[ast.stmt] = []
+    started = False
+    for stmt in stmts:
+        if stmt is acquire_stmt:
+            started = True
+            continue
+        if not started:
+            continue
+        has_release = any(
+            isinstance(sub, ast.Call)
+            and _call_tail(sub) in spec.release_tails
+            and isinstance(sub.func, ast.Attribute)
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == var
+            for sub in ast.walk(stmt))
+        if has_release:
+            break
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.append(stmt)
+    return out
+
+
+class DoubleRelease(_TypestateRule):
+    id = "DJ502"
+    name = "double-release"
+    description = (
+        "the same non-idempotent resource is released twice in one "
+        "straight-line block: the second release frees pages another "
+        "request may already own. Resources with first-wins release "
+        "semantics (trace spans) are exempt")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in self._functions(src):
+            for spec in RESOURCES:
+                if spec.idempotent_release:
+                    continue
+                yield from self._check(src, fn, spec)
+
+    def _check(self, src: SourceFile, fn,
+               spec: ResourceSpec) -> Iterable[Finding]:
+        for block in _blocks(fn):
+            seen: dict[str, ast.AST] = {}
+            for stmt in block:
+                if isinstance(stmt, (ast.If, ast.Try, ast.For, ast.While,
+                                     ast.With, ast.AsyncWith)):
+                    continue  # releases under conditions judged per-block
+                for sub in ast.walk(stmt):
+                    if not (isinstance(sub, ast.Call)
+                            and _call_tail(sub) in spec.release_tails
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)):
+                        continue
+                    var = sub.func.value.id
+                    if var in seen:
+                        yield self.finding(
+                            src, sub,
+                            f"{spec.name} {var!r} is released twice in "
+                            "the same block (first release on line "
+                            f"{getattr(seen[var], 'lineno', '?')}): the "
+                            "second release frees a resource someone "
+                            "else may already own")
+                    else:
+                        seen[var] = sub
+
+    @staticmethod
+    def _release_sites(fn, spec):  # pragma: no cover - debugging aid
+        return [sub for sub in ast.walk(fn)
+                if isinstance(sub, ast.Call)
+                and _call_tail(sub) in spec.release_tails]
+
+
+def _blocks(fn) -> Iterable[list[ast.stmt]]:
+    """Every straight-line statement list in the function (bodies of
+    the function, ifs, loops, trys, withs — each yielded separately)."""
+    stack: list[list[ast.stmt]] = [fn.body]
+    while stack:
+        body = stack.pop()
+        yield body
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub:
+                    stack.append(sub)
+            for handler in getattr(stmt, "handlers", []) or []:
+                stack.append(handler.body)
+
+
+class ProbeVerdictLeak(_TypestateRule):
+    id = "DJ503"
+    name = "probe-verdict-leak"
+    description = (
+        "a circuit-breaker try_acquire() with no release-family call "
+        "(release_probe / record_success / record_failure) under a "
+        "finally in the same function: an attempt that dies without a "
+        "verdict (cancellation, deadline, client disconnect) leaks the "
+        "half-open single-probe slot and locks the instance out "
+        "forever")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in self._functions(src):
+            acquires = [node for node in ast.walk(fn)
+                        if isinstance(node, ast.Call)
+                        and _call_tail(node) == "try_acquire"]
+            if not acquires:
+                continue
+            safe = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                for stmt in node.finalbody:
+                    if any(isinstance(sub, ast.Call)
+                           and _call_tail(sub) in PROBE_RELEASES
+                           for sub in ast.walk(stmt)):
+                        safe = True
+            if safe:
+                continue
+            yield self.finding(
+                src, acquires[0],
+                "try_acquire() here has no probe-release family call "
+                "(release_probe/record_success/record_failure) under a "
+                "finally: a dying attempt leaks the half-open probe "
+                "slot — settle the verdict in a finally")
