@@ -540,6 +540,98 @@ def bench_disagg_point(requests: int = 16) -> dict:
     }
 
 
+def bench_session_point() -> dict:
+    """Session-cache A/B for BENCH_MULTI (ROADMAP item 2 / ISSUE 11):
+    two-turn conversations with ~zero natural cross-session overlap
+    against a KV-routed 2-worker mocker pair — cold turn-0 vs cached
+    turn-1 TTFT with explicit pinning + session affinity ON, and the
+    same traffic with the markers OFF (implicit-overlap baseline).
+    Target on silicon: cached-turn TTFT <= the kvbm G1 hit number
+    (BENCH_MULTI.kvbm_ttft: 2.7ms hit vs 6.2ms cold); here the mocker's
+    measured v5e step physics stand in for the chips
+    (docs/prompt-caching.md)."""
+    import asyncio
+    import uuid
+
+    from dynamo_tpu.bench import MultiturnBench
+    from dynamo_tpu.frontend import Frontend
+    from dynamo_tpu.mocker import MockerConfig, MockerWorker
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    def _cfg(cluster: str) -> RuntimeConfig:
+        cfg = RuntimeConfig.from_env()
+        cfg.discovery_backend = "mem"
+        cfg.discovery_path = cluster
+        cfg.request_plane = "tcp"
+        cfg.tcp_host = "127.0.0.1"
+        cfg.event_plane = "mem"
+        cfg.system_enabled = False
+        cfg.lease_ttl_secs = 1.0
+        return cfg
+
+    async def one_side(session_cache: bool) -> dict:
+        cluster = uuid.uuid4().hex
+        workers = []
+        for _ in range(2):
+            rt = await DistributedRuntime(_cfg(cluster)).start()
+            worker = MockerWorker(
+                rt, model_name="mock-model",
+                config=MockerConfig.from_timing_preset(
+                    "tpu-v5e-qwen3-0.6b", speedup_ratio=20.0,
+                    num_blocks=4096),
+                load_publish_interval=0.2)
+            await worker.start()
+            workers.append((rt, worker))
+        frt = await DistributedRuntime(_cfg(cluster)).start()
+        frontend = Frontend(frt, host="127.0.0.1", port=0,
+                            router_mode="kv")
+        await frontend.start()
+        try:
+            for _ in range(100):
+                if frontend.manager.get("mock-model") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            # ~13 mock-tokenizer tokens per synthetic word: 128 words
+            # is ~1.7k prompt tokens — two turns stay inside the mock
+            # card's 8k context with a prefill big enough to dominate
+            # TTFT.
+            bench = MultiturnBench(
+                f"http://127.0.0.1:{frontend.port}", "mock-model",
+                turns=2, isl_mean=128, osl_mean=8,
+                followup_isl_mean=8, session_cache=session_cache)
+            level = await bench.run_level(concurrency=4,
+                                          conversations=16)
+            return level.summary()
+        finally:
+            await frontend.close()
+            await frt.shutdown()
+            for rt, worker in workers:
+                await worker.close()
+                await rt.shutdown()
+
+    async def both() -> tuple[dict, dict]:
+        return await one_side(True), await one_side(False)
+
+    on, off = asyncio.run(both())
+
+    def turn_ttft(summary: dict, turn: int):
+        return summary.get("ttft_ms_by_turn", {}).get(str(turn))
+
+    cold = turn_ttft(on, 0)
+    cached = turn_ttft(on, 1)
+    return {
+        "profile": "2-worker v5e mocker, kv router, 2-turn sessions, "
+                   "~zero cross-session overlap",
+        "pinned_cold_ttft_ms": cold,
+        "pinned_cached_ttft_ms": cached,
+        "cached_speedup": (round(cold / cached, 2)
+                           if cold and cached else None),
+        "unpinned_cold_ttft_ms": turn_ttft(off, 0),
+        "unpinned_cached_ttft_ms": turn_ttft(off, 1),
+        "errors": on.get("errors", 0) + off.get("errors", 0),
+    }
+
+
 def bench_goodput_point() -> dict:
     """Goodput-vs-load curve with the overload-control loop off vs on
     (ROADMAP item 4 / ISSUE 9) — the chip-free robustness point
@@ -628,6 +720,8 @@ def main() -> None:
             result["disagg"] = bench_disagg_point()
         if os.environ.get("DYNT_BENCH_GOODPUT", "1") != "0":
             result["goodput_vs_load"] = bench_goodput_point()
+        if os.environ.get("DYNT_BENCH_SESSION", "1") != "0":
+            result["session_cache"] = bench_session_point()
         print(json.dumps(result))
         return
 
@@ -687,6 +781,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — chip-free point must
             # never cost the round its silicon numbers
             result["goodput_vs_load"] = {"error": repr(exc)}
+    if os.environ.get("DYNT_BENCH_SESSION", "1") != "0":
+        try:
+            result["session_cache"] = bench_session_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["session_cache"] = {"error": repr(exc)}
     print(json.dumps(result))
 
 

@@ -52,6 +52,9 @@ class TurnStat:
     total_ms: float
     output_tokens: int
     error: Optional[str] = None
+    # Turn index within its conversation (0 = cold first turn; later
+    # turns carry the growing prefix — what prompt caching accelerates).
+    turn: int = 0
 
     @property
     def itl_ms(self) -> float:
@@ -75,6 +78,9 @@ class SweepLevel:
         def pct(vals, p):
             return round(float(np.percentile(vals, p)), 2) if vals else None
 
+        by_turn: dict[int, list[float]] = {}
+        for t in ok:
+            by_turn.setdefault(t.turn, []).append(t.ttft_ms)
         return {
             "concurrency": self.concurrency,
             "requests": len(self.turns),
@@ -88,6 +94,10 @@ class SweepLevel:
                         "p99": pct(ttfts, 99)},
             "itl_ms": {"p50": pct(itls, 50), "p90": pct(itls, 90),
                        "p99": pct(itls, 99)},
+            # Cold turn 0 vs cached later turns: the session-cache
+            # headline (docs/prompt-caching.md).
+            "ttft_ms_by_turn": {str(turn): pct(vals, 50)
+                                for turn, vals in sorted(by_turn.items())},
         }
 
 
@@ -102,6 +112,8 @@ class MultiturnBench:
         system_prompt_tokens: int = 0,
         seed: int = 0,
         timeout: float = 300.0,
+        session_cache: bool = False,
+        followup_isl_mean: Optional[int] = None,
     ) -> None:
         self.url = url.rstrip("/")
         self.model = model
@@ -111,9 +123,21 @@ class MultiturnBench:
         self.system_prompt_tokens = system_prompt_tokens
         self.seed = seed
         self.timeout = timeout
+        # Session-cache mode (docs/prompt-caching.md): every turn sends
+        # an x-dynt-session-id and marks its last message with
+        # cache_control {"type": "ephemeral"} — the explicit
+        # prompt-caching + residency-routing path, vs the purely
+        # implicit prefix-overlap baseline when off.
+        self.session_cache = session_cache
+        # Agent-shaped traffic: a big first turn (isl_mean) then short
+        # follow-ups — the regime where a cached-turn TTFT win is the
+        # prefix cache working, not a shorter prompt.
+        self.followup_isl_mean = followup_isl_mean
 
     async def _one_turn(self, session, messages: list[dict],
-                        max_tokens: int) -> tuple[TurnStat, str]:
+                        max_tokens: int,
+                        headers: Optional[dict] = None,
+                        ) -> tuple[TurnStat, str]:
         """Stream one chat turn; returns (stats, assistant_text)."""
         import aiohttp
 
@@ -126,6 +150,7 @@ class MultiturnBench:
                 f"{self.url}/v1/chat/completions",
                 json={"model": self.model, "messages": messages,
                       "max_tokens": max_tokens, "stream": True},
+                headers=headers or {},
                 timeout=aiohttp.ClientTimeout(total=self.timeout),
             ) as resp:
                 if resp.status != 200:
@@ -173,12 +198,23 @@ class MultiturnBench:
             messages.append({"role": "system",
                             "content": synth_text(self.system_prompt_tokens,
                                                   sys_rng)})
-        for _turn in range(self.turns):
-            isl = max(4, int(rng.lognormal(np.log(self.isl_mean), 0.3)))
+        headers = ({"x-dynt-session-id": f"bench-{self.seed}-{conv_idx}"}
+                   if self.session_cache else None)
+        for turn in range(self.turns):
+            isl_mean = (self.followup_isl_mean
+                        if turn > 0 and self.followup_isl_mean
+                        else self.isl_mean)
+            isl = max(4, int(rng.lognormal(np.log(isl_mean), 0.3)))
             osl = max(2, int(rng.lognormal(np.log(self.osl_mean), 0.3)))
-            messages.append({"role": "user",
-                             "content": synth_text(isl, rng)})
-            stat, reply = await self._one_turn(session, messages, osl)
+            user_msg: dict = {"role": "user", "content": synth_text(isl, rng)}
+            if self.session_cache:
+                # Mark the whole prompt-so-far as a reusable prefix: the
+                # frontend pins its blocks and the next turn rides them.
+                user_msg["cache_control"] = {"type": "ephemeral"}
+            messages.append(user_msg)
+            stat, reply = await self._one_turn(session, messages, osl,
+                                               headers=headers)
+            stat.turn = turn
             level.turns.append(stat)
             if stat.error is not None:
                 return
@@ -240,12 +276,17 @@ async def main(argv: Optional[list[str]] = None) -> None:
                         help="shared system prompt length (cross-"
                              "conversation prefix for KV-routing A/B)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--session-cache", action="store_true",
+                        help="send per-conversation x-dynt-session-id "
+                             "headers + cache_control markers (explicit "
+                             "prompt caching; docs/prompt-caching.md)")
     parser.add_argument("--out", default=None, help="write JSON here too")
     args = parser.parse_args(argv)
     bench = MultiturnBench(
         args.url, args.model, turns=args.turns, isl_mean=args.isl_mean,
         osl_mean=args.osl_mean,
         system_prompt_tokens=args.system_prompt_tokens, seed=args.seed,
+        session_cache=args.session_cache,
     )
     report = await bench.sweep(
         [int(c) for c in args.concurrency.split(",") if c.strip()],

@@ -108,6 +108,15 @@ class KvBlockManager:
             on_removed=lambda hs: ev_r("g2", hs),
         )
         self.offload: Optional[OffloadManager] = None
+        # Session-tier pin leases (docs/prompt-caching.md): hash ->
+        # lease expiry (monotonic). A leased block is held against tier
+        # eviction (TierPool pin refcount) wherever it currently lives;
+        # _pins_applied records which pool holds the refcount so expiry
+        # releases exactly once. Leases ALWAYS die at TTL.
+        self._pin_leases: dict[int, float] = {}
+        self._pins_applied: dict[int, str] = {}
+        self._prefetch_q: Optional[object] = None
+        self._prefetch_thread = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -150,6 +159,11 @@ class KvBlockManager:
         with self._lock:
             if self.host.insert(h, block, parent):
                 self.stats.offloaded += 1
+            if h in self._pin_leases:
+                # A pin-ahead lease (pinned while the block still lived
+                # only in G1) attaches the moment the block lands in a
+                # tier we can protect.
+                self._apply_pin(h)
 
     def _on_host_evict(self, h: int, data: np.ndarray) -> None:
         if self.disk is not None:
@@ -219,6 +233,134 @@ class KvBlockManager:
         self.stats.onboarded_blocks += len(hashes)
         return out
 
+    # -- session pin leases (docs/prompt-caching.md) ----------------------
+
+    def _apply_pin(self, h: int) -> None:
+        """Attach the tier-level eviction hold for a leased hash (at
+        most one hold per hash; caller holds the lock)."""
+        if h in self._pins_applied:
+            return
+        if self.host.contains(h):
+            self.host.pin(h)
+            self._pins_applied[h] = "g2"
+        elif self.disk is not None and self.disk.contains(h):
+            self.disk.pin(h)
+            self._pins_applied[h] = "g3"
+
+    def _release_pin(self, h: int) -> None:
+        tier = self._pins_applied.pop(h, None)
+        if tier == "g2":
+            self.host.unpin(h)
+        elif tier == "g3" and self.disk is not None:
+            self.disk.unpin(h)
+
+    def pin_blocks(self, hashes: list[int], ttl: float,
+                   now: Optional[float] = None) -> int:
+        """Lease `hashes` against tier eviction until now+ttl (clamped
+        to DYNT_PIN_TTL_SECS). Re-pinning refreshes the expiry. Blocks
+        not yet tiered get a pin-ahead lease that attaches when the
+        offload path lands them. Returns the number of leases taken."""
+        import time as _time
+
+        from ..runtime.config import env as _env
+
+        now = _time.monotonic() if now is None else now
+        ttl = min(float(ttl), _env("DYNT_PIN_TTL_SECS")) if ttl \
+            else _env("DYNT_PIN_TTL_SECS")
+        with self._lock:
+            self.sweep_pins(now)
+            for h in hashes:
+                expiry = now + ttl
+                prev = self._pin_leases.get(h)
+                self._pin_leases[h] = max(prev or 0.0, expiry)
+                self._apply_pin(h)
+            return len(hashes)
+
+    def sweep_pins(self, now: Optional[float] = None) -> int:
+        """Release every lease past its TTL (a pin can never outlive
+        it). Called from the pin path and the worker's load loop."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            dead = [h for h, exp in self._pin_leases.items() if exp <= now]
+            for h in dead:
+                self._pin_leases.pop(h, None)
+                self._release_pin(h)
+            return len(dead)
+
+    def pinned_blocks(self) -> int:
+        with self._lock:
+            return len(self._pin_leases)
+
+    def prefetch(self, hashes: list[int]) -> None:
+        """Promote G3/G4 residents of `hashes` into G2 off the request
+        path, so a cached turn's admission-time onload (scheduler
+        `_onboard_from_kvbm` -> G1 scatter inside the step/gap
+        discipline) hits host RAM instead of disk or the network.
+        Host-side work only — runs on a dedicated daemon thread."""
+        if self.disk is None and self.object_store is None:
+            return
+        import queue as _queue
+        import threading
+
+        if self._prefetch_q is None:
+            self._prefetch_q = _queue.Queue(maxsize=256)
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_loop, name="kvbm-prefetch",
+                daemon=True)
+            self._prefetch_thread.start()
+        try:
+            self._prefetch_q.put_nowait(list(hashes))
+        except _queue.Full:
+            pass  # best-effort: admission falls back to G3/G4 reads
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            hashes = self._prefetch_q.get()
+            if hashes is None:
+                return
+            # Anchored prefixes are contiguous chains with co-resident
+            # blocks: once one block misses G4, the rest of the chain
+            # is almost surely absent too (most commonly the whole
+            # prefix still lives only in G1). Stop probing the network
+            # after the first miss — bounds futile G4 GETs to one per
+            # prefetch instead of one per block.
+            probe_g4 = True
+            for h in hashes:
+                try:
+                    if self._promote_one(h, probe_g4=probe_g4) == "miss":
+                        probe_g4 = False
+                except Exception:  # noqa: BLE001 — prefetch is
+                    # best-effort; a failed promotion degrades to the
+                    # admission-time read path
+                    log.exception("prefetch promote failed for %x", h)
+
+    def _promote_one(self, h: int, probe_g4: bool = True) -> str:
+        """Promote one block into G2 if it lives below; returns
+        "resident" (already in G2), "promoted", or "miss". The G3 read
+        happens under the lock (TierPool/arena structures are not
+        thread-safe and the memmap read is page-cache fast); only the
+        G4 network fetch runs outside it."""
+        with self._lock:
+            if self.host.contains(h):
+                return "resident"
+            data = self.disk.get(h) if self.disk is not None else None
+        if data is None:
+            if not probe_g4 or self.object_store is None:
+                return "miss"
+            # G4 fetch outside the lock: a network read must not stall
+            # the scheduler thread's admission-time lookups.
+            data = self.object_store.get(h)
+        if data is None:
+            return "miss"
+        with self._lock:
+            if self.host.insert(h, data) and h in self._pin_leases:
+                # The hold follows the block up-tier.
+                self._release_pin(h)
+                self._apply_pin(h)
+        return "promoted"
+
     # -- introspection / lifecycle ----------------------------------------
 
     def usage(self) -> dict:
@@ -243,5 +385,9 @@ class KvBlockManager:
     def close(self) -> None:
         if self.offload is not None:
             self.offload.close()
+        if self._prefetch_q is not None:
+            self._prefetch_q.put(None)  # type: ignore[union-attr]
+            self._prefetch_thread.join(timeout=5.0)
+            self._prefetch_q = None
         if self.disk is not None:
             self.disk.arena.close()

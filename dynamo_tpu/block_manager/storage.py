@@ -147,6 +147,112 @@ class FsObjectStoreClient:
             raise TransientStorageError(f"delete {key}: {exc}") from exc
 
 
+# --- G4 request signing (SigV4-style; docs/prompt-caching.md §G4 auth) ----
+#
+# Pinned prefixes only earn a real G4 leg when the object store is an
+# authenticated cloud endpoint. The scheme mirrors AWS SigV4's shape —
+# canonical string over (method, path, date, payload hash), a
+# date-scoped derived key, hex HMAC-SHA256 — without the full
+# header-canonicalization surface this client never uses. The verify
+# half lives here too so the signature-enforcing stub server in tests
+# and any real gateway shim share one implementation.
+
+SIG_ALGORITHM = "DYNT1-HMAC-SHA256"
+DATE_HEADER = "x-dynt-date"
+CONTENT_SHA_HEADER = "x-dynt-content-sha256"
+
+
+def _canonical_string(method: str, path: str, date: str,
+                      payload_hash: str) -> str:
+    return "\n".join((SIG_ALGORITHM, method.upper(), path, date,
+                      payload_hash))
+
+
+def _signing_key(secret: str, datestamp: str) -> bytes:
+    import hashlib
+    import hmac
+
+    # Date-scoped derived key (SigV4 kDate step): a leaked signature
+    # never reveals the long-term secret, and old signatures expire
+    # with their date scope.
+    return hmac.new(("DYNT1" + secret).encode(), datestamp.encode(),
+                    hashlib.sha256).digest()
+
+
+def sign_request(method: str, path: str, body: Optional[bytes],
+                 key_id: str, secret: str,
+                 date: Optional[str] = None) -> dict[str, str]:
+    """Signed headers for one request. `path` is the URL path
+    ("/" + object key)."""
+    import hashlib
+    import hmac
+    import time as _time
+
+    if date is None:
+        date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+    payload_hash = hashlib.sha256(body or b"").hexdigest()
+    sig = hmac.new(
+        _signing_key(secret, date[:8]),
+        _canonical_string(method, path, date, payload_hash).encode(),
+        hashlib.sha256).hexdigest()
+    return {
+        DATE_HEADER: date,
+        CONTENT_SHA_HEADER: payload_hash,
+        "Authorization": (f"{SIG_ALGORITHM} Credential={key_id}/{date[:8]}, "
+                          f"Signature={sig}"),
+    }
+
+
+def verify_signature(method: str, path: str, body: Optional[bytes],
+                     headers, secrets: dict[str, str],
+                     max_age_secs: Optional[float] = None,
+                     now: Optional[float] = None) -> Optional[str]:
+    """Server-side check (the tests' enforcing stub + any gateway shim):
+    returns None when the request verifies, else a short reason —
+    unsigned / unknown-key / expired / bad-signature / body-mismatch.
+    Constant-time signature comparison."""
+    import calendar
+    import hashlib
+    import hmac
+    import time as _time
+
+    if max_age_secs is None:
+        from ..runtime.config import env
+
+        max_age_secs = env("DYNT_G4_SIG_TTL_SECS")
+    auth = headers.get("Authorization") or headers.get("authorization")
+    date = headers.get(DATE_HEADER) or headers.get(DATE_HEADER.title())
+    if not auth or not auth.startswith(SIG_ALGORITHM) or not date:
+        return "unsigned"
+    try:
+        parts = dict(
+            kv.strip().split("=", 1)
+            for kv in auth[len(SIG_ALGORITHM):].strip().split(","))
+        key_id = parts["Credential"].split("/", 1)[0]
+        got_sig = parts["Signature"]
+        ts = calendar.timegm(_time.strptime(date, "%Y%m%dT%H%M%SZ"))
+    except (KeyError, ValueError, IndexError):
+        return "bad-signature"
+    secret = secrets.get(key_id)
+    if secret is None:
+        return "unknown-key"
+    now = _time.time() if now is None else now
+    if abs(now - ts) > max_age_secs:
+        return "expired"
+    payload_hash = hashlib.sha256(body or b"").hexdigest()
+    claimed = headers.get(CONTENT_SHA_HEADER) \
+        or headers.get(CONTENT_SHA_HEADER.title())
+    if claimed is not None and claimed != payload_hash:
+        return "body-mismatch"
+    want = hmac.new(
+        _signing_key(secret, date[:8]),
+        _canonical_string(method, path, date, payload_hash).encode(),
+        hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, got_sig):
+        return "bad-signature"
+    return None
+
+
 class HttpObjectStoreClient:
     """Native S3/GCS-shaped REST client (stdlib urllib — no SDK in this
     image): blobs live at {base_url}/{key} with PUT / GET / HEAD /
@@ -156,18 +262,52 @@ class HttpObjectStoreClient:
     ObjectStore contract: connection errors and 5xx/429 become
     TransientStorageError (retryable), 404 is absence, and a body
     shorter than Content-Length is a detected partial read (also
-    transient — the caller's corrupt-read path quarantines it).
+    transient — the caller's corrupt-read path quarantines it). Auth
+    (the real-G4 leg): `auth` is None (DYNT_G4_* env decides),
+    {"mode": "hmac", "key_id":..., "secret":...} for SigV4-style
+    request signing, or {"mode": "bearer", "token":...}. 401/403 stay
+    non-transient — a rejected credential must fail loudly, not retry.
     Ref: kvbm-design.md §Remote Memory Integration (NIXL-plugged object
     backends)."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 auth: Optional[dict] = None) -> None:
+        from ..runtime.config import env
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        if auth is None:
+            mode = env("DYNT_G4_AUTH")
+            if mode == "hmac":
+                auth = {"mode": "hmac",
+                        "key_id": env("DYNT_G4_HMAC_KEY_ID"),
+                        "secret": env("DYNT_G4_HMAC_SECRET")}
+            elif mode == "bearer":
+                auth = {"mode": "bearer",
+                        "token": env("DYNT_G4_BEARER_TOKEN")}
+        self.auth = auth
 
     def _url(self, key: str) -> str:
         if ".." in key or key.startswith("/"):
             raise ValueError(f"unsafe object key {key!r}")
         return f"{self.base_url}/{key}"
+
+    def _auth_headers(self, method: str, key: str,
+                      data: Optional[bytes]) -> dict[str, str]:
+        if not self.auth:
+            return {}
+        if self.auth.get("mode") == "bearer":
+            return {"Authorization": f"Bearer {self.auth.get('token', '')}"}
+        if self.auth.get("mode") == "hmac":
+            from urllib.parse import urlsplit
+
+            # Sign the full URL path (base path + key) — what the
+            # server sees and verifies.
+            base_path = urlsplit(self.base_url).path
+            return sign_request(method, f"{base_path}/{key}", data,
+                                self.auth.get("key_id", ""),
+                                self.auth.get("secret", ""))
+        return {}
 
     def _request(self, method: str, key: str,
                  data: Optional[bytes] = None):
@@ -179,6 +319,8 @@ class HttpObjectStoreClient:
                                      method=method)
         if data is not None:
             req.add_header("Content-Type", "application/octet-stream")
+        for name, value in self._auth_headers(method, key, data).items():
+            req.add_header(name, value)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 body = resp.read()

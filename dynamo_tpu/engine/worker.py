@@ -1056,6 +1056,14 @@ class TpuWorker:
                     self.transfers.expire_stale()
                 except Exception:  # noqa: BLE001 — drain task must survive
                     log.exception("transfer expiry failed")
+                if self.kvbm is not None \
+                        and hasattr(self.kvbm, "sweep_pins"):
+                    try:
+                        # Session pin leases die at TTL even when no new
+                        # pin traffic triggers the lazy sweep.
+                        self.kvbm.sweep_pins()
+                    except Exception:  # noqa: BLE001 — drain survives
+                        log.exception("pin sweep failed")
             if self.scheduler is not None and self._drain_ticks % 10 == 0:
                 active, waiting = self.scheduler.queue_depth()
                 metrics = LoadMetrics(
@@ -1145,6 +1153,35 @@ class TpuWorker:
 
             def emit(output: EngineOutput) -> None:
                 loop.call_soon_threadsafe(out_queue.put_nowait, output)
+
+            if request.cache_anchors and self.kvbm is not None \
+                    and hasattr(self.kvbm, "pin_blocks"):
+                # Session tier: lease the anchored prefix blocks against
+                # tier eviction (they always die at TTL) and stage any
+                # G3/G4 residents up into G2 so the admission-time
+                # onload hits host RAM (docs/prompt-caching.md).
+                try:
+                    from ..runtime.config import env as _env
+                    from ..tokens import compute_block_hashes
+
+                    page = self.scheduler.page_size
+                    n = (max(request.cache_anchors) // page) * page
+                    pin_hashes = compute_block_hashes(
+                        request.token_ids[:n], page,
+                        lora_id=request.kv_salt()) if n else []
+                    if pin_hashes:
+                        # Client-requested lease TTL when carried on the
+                        # wire (pin_blocks clamps to the system ceiling).
+                        ttl = (request.cache_ttl
+                               or _env("DYNT_PIN_TTL_SECS"))
+                        self.kvbm.pin_blocks(pin_hashes, ttl)
+                        self.kvbm.prefetch(pin_hashes)
+                        recorder.event(rec_id, "session_pin",
+                                       blocks=len(pin_hashes))
+                except Exception:  # noqa: BLE001 — pinning is a cache
+                    # hint; a failure degrades to normal eviction order
+                    log.exception("session pin failed for %s",
+                                  request.request_id)
 
             submit_kwargs: dict = {}
             if prefill_only:

@@ -54,6 +54,7 @@ class Frontend:
                 env("DYNT_ROUTER_TEMPERATURE")
                 if kv_temperature is None else kv_temperature
             ),
+            session_affinity_weight=env("DYNT_SESSION_AFFINITY_WEIGHT"),
         )
         self.watcher = ModelWatcher(
             runtime, self.manager, router_mode=router_mode,

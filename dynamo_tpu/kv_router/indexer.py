@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
+from ..block_manager.tinylfu import TinyLfu
 from .protocols import OverlapScores, RouterEvent, WorkerWithDpRank
 
 
@@ -29,7 +30,8 @@ class _Node:
 
 class RadixTree:
     def __init__(self, ttl_secs: float = 0.0, max_tree_size: int = 0,
-                 prune_target_ratio: float = 0.8) -> None:
+                 prune_target_ratio: float = 0.8,
+                 admission: bool = False) -> None:
         self._root = _Node(hash=0, parent=None)
         self._nodes: dict[int, _Node] = {}
         self._worker_blocks: dict[WorkerWithDpRank, int] = {}
@@ -42,6 +44,15 @@ class RadixTree:
         self._prune_target_ratio = prune_target_ratio
         self._timers: dict[tuple[int, WorkerWithDpRank], float] = {}
         self._expirations: list[tuple[float, int, int, int]] = []  # heap
+        # TinyLFU admission at the node cap (block_manager/tinylfu.py
+        # lifted into the router, DYNT_INDEXER_ADMISSION): queries count
+        # as accesses, and a NEW chain at a full tree is inserted only
+        # if its frequency estimate beats the oldest entry's — a flood
+        # of one-shot session prefixes cannot flush hot shared prefixes
+        # out of the index. Requires max_tree_size.
+        self._lfu = (TinyLfu(max_tree_size)
+                     if admission and max_tree_size else None)
+        self.admission_rejected = 0
 
     # -- TTL / size pruning -------------------------------------------------
 
@@ -130,6 +141,12 @@ class RadixTree:
         scores: dict[WorkerWithDpRank, int] = {}
         node = self._root
         for depth, block_hash in enumerate(block_hashes):
+            if self._lfu is not None:
+                # Query traffic is the admission filter's frequency
+                # evidence: every looked-up block counts as an access,
+                # hit or miss (a missed-but-requested prefix earns its
+                # slot next time a worker stores it).
+                self._lfu.touch(block_hash)
             node = node.children.get(block_hash)
             if node is None:
                 break
@@ -178,6 +195,47 @@ class RadixTree:
             self._apply_removed(worker, event.removed.block_hashes)
         return status
 
+    def _peek_oldest(self) -> Optional[int]:
+        """Hash of the oldest live (hash, worker) timer entry — the
+        admission victim candidate. Pops stale heap entries in passing;
+        the valid head stays."""
+        import heapq
+
+        while self._expirations:
+            exp, h, wid, dp = self._expirations[0]
+            if self._timers.get((h, WorkerWithDpRank(wid, dp))) == exp:
+                return h
+            heapq.heappop(self._expirations)
+        return None
+
+    def _admit(self, block_hash: int) -> bool:
+        """Frequency-gated insertion at the node cap. EVERY evicted
+        victim must individually lose to the candidate — freeing a slot
+        can require evicting several oldest (hash, worker) entries
+        (interior nodes only prune once their leaf cascades), and
+        checking only the first would let one cold insertion wipe a
+        whole hot chain. Returns False when the candidate loses or no
+        slot could be freed (caller stops the chain — deeper blocks are
+        colder than the rejected one)."""
+        if self._lfu is None or len(self._nodes) < self._max_tree_size:
+            return True
+        self._lfu.touch(block_hash)
+        import heapq
+
+        while len(self._nodes) >= self._max_tree_size:
+            victim = self._peek_oldest()
+            if victim is None:
+                return False  # nothing evictable: refuse, hold the cap
+            if not self._lfu.admit(block_hash, victim):
+                self.admission_rejected += 1
+                return False
+            exp, h, wid, dp = heapq.heappop(self._expirations)
+            w = WorkerWithDpRank(wid, dp)
+            if self._timers.get((h, w)) == exp:
+                del self._timers[(h, w)]
+                self._apply_removed(w, [h])
+        return True
+
     def _apply_stored(
         self, worker: WorkerWithDpRank, parent_hash: Optional[int],
         block_hashes: Sequence[int],
@@ -190,9 +248,22 @@ class RadixTree:
                 # Parent unknown (we joined mid-stream): root the chain at its
                 # own first block — sequence hashes keep lookups correct.
                 parent = self._root
+        stored: list[int] = []
         for block_hash in block_hashes:
             node = self._nodes.get(block_hash)
             if node is None:
+                if not self._admit(block_hash):
+                    # Chain truncated at the first rejected block: a
+                    # child inserted under a missing parent could never
+                    # be matched (find_matches walks contiguously).
+                    break
+                if parent is not self._root \
+                        and self._nodes.get(parent.hash) is not parent:
+                    # _admit's eviction cascade pruned our own parent:
+                    # inserting under the dead node would orphan the
+                    # chain (in _nodes, unreachable from the root,
+                    # unmatchable forever). Truncate instead.
+                    break
                 node = _Node(hash=block_hash, parent=parent)
                 self._nodes[block_hash] = node
                 parent.children[block_hash] = node
@@ -200,7 +271,8 @@ class RadixTree:
                 node.workers.add(worker)
                 self._worker_blocks[worker] = self._worker_blocks.get(worker, 0) + 1
             parent = node
-        self._timer_insert(worker, block_hashes)
+            stored.append(block_hash)
+        self._timer_insert(worker, stored)
 
     def _apply_removed(
         self, worker: WorkerWithDpRank, block_hashes: Sequence[int]
@@ -426,11 +498,14 @@ def sweep_tree(tree, name: str, log) -> None:
         log.exception("indexer maintain failed (%s)", name)
 
 
-def make_radix_tree(ttl_secs: float = None, max_tree_size: int = None):
+def make_radix_tree(ttl_secs: float = None, max_tree_size: int = None,
+                    admission: bool = None):
     """Native C++ tree when the extension is available, Python otherwise.
     TTL/size pruning defaults come from DYNT_INDEXER_TTL_SECS /
     DYNT_INDEXER_MAX_TREE_SIZE (0 = disabled, matching the reference's
-    opt-in PruneConfig)."""
+    opt-in PruneConfig). DYNT_INDEXER_ADMISSION adds TinyLFU
+    frequency-gated insertion at the node cap — that mode forces the
+    Python tree (the native core carries no admission sketch yet)."""
     from dynamo_tpu.native import get_native
     from dynamo_tpu.runtime.config import env
 
@@ -438,6 +513,11 @@ def make_radix_tree(ttl_secs: float = None, max_tree_size: int = None):
         ttl_secs = env("DYNT_INDEXER_TTL_SECS")
     if max_tree_size is None:
         max_tree_size = env("DYNT_INDEXER_MAX_TREE_SIZE")
+    if admission is None:
+        admission = env("DYNT_INDEXER_ADMISSION")
+    if admission and max_tree_size:
+        return RadixTree(ttl_secs=ttl_secs, max_tree_size=max_tree_size,
+                         admission=True)
     native = get_native()
     if native is not None:
         return NativeRadixTree(native, ttl_secs=ttl_secs,

@@ -68,6 +68,9 @@ class QueuedRequest:
     # estimate — a budget that cannot survive the backlog is refused
     # (AdmissionRefused -> 503 + Retry-After) instead of parked to 504.
     deadline: Optional[Deadline] = None
+    # Session-affinity residency (dynamo_tpu/session): the worker id a
+    # live session last landed on; the selector biases toward it.
+    affinity_worker: Optional[int] = None
 
 
 def fcfs_key(arrival_offset: float, req: QueuedRequest,
@@ -227,7 +230,7 @@ class SchedulerQueue:
     def _select(self, req: QueuedRequest) -> SelectionResult:
         result = self.scheduler.select_worker(
             req.candidates, list(req.block_hashes), req.isl_tokens,
-            overlaps=req.overlaps,
+            overlaps=req.overlaps, affinity_worker=req.affinity_worker,
         )
         if req.request_id is not None:
             self.scheduler.add_request(req.request_id, result,

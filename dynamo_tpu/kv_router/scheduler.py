@@ -28,6 +28,11 @@ class KvRouterConfig:
     overlap_weight: float = 1.0
     temperature: float = 0.0
     block_size: int = 16
+    # Session-affinity logit bonus (block units): a live session's
+    # resident worker wins the selection unless it is this many blocks
+    # more loaded than the best alternative (DYNT_SESSION_AFFINITY_WEIGHT;
+    # 0 disables steering — docs/prompt-caching.md).
+    session_affinity_weight: float = 4.0
 
 
 @dataclasses.dataclass
@@ -88,6 +93,7 @@ class KvScheduler:
         overlaps: Optional[OverlapScores] = None,
         overlap_weight: Optional[float] = None,
         temperature: Optional[float] = None,
+        affinity_worker: Optional[int] = None,
     ) -> SelectionResult:
         if not candidates:
             raise ValueError("no candidate workers")
@@ -112,6 +118,15 @@ class KvScheduler:
             if decode_block is None:
                 decode_block = math.floor(potential_prefill_block)
             logits[worker] = weight * potential_prefill_block + float(decode_block)
+            if affinity_worker is not None \
+                    and worker.worker_id == affinity_worker:
+                # Cache-residency steering (session tier): the session's
+                # resident worker holds the pinned prefix in its KVBM
+                # tiers even when the radix index no longer scores G1
+                # overlap (evicted to G2/G3) — bias toward it by the
+                # configured block bonus, bounded so a hot worker still
+                # loses to a sufficiently idle one.
+                logits[worker] -= self.config.session_affinity_weight
 
         worker, logit = softmax_sample(
             logits, temp, tie_breaker=overlaps.tree_sizes

@@ -19,7 +19,7 @@ from typing import AsyncIterator, Optional
 from ..kv_router import KvScheduler, WorkerWithDpRank
 from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
-from ..runtime.metrics import DEADLINE_EXCEEDED
+from ..runtime.metrics import DEADLINE_EXCEEDED, SESSION_AFFINITY
 from ..runtime.otel import get_tracer
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
 from ..runtime.request_plane import ConnectionLost, RemoteError
@@ -105,13 +105,16 @@ class KvRouterEngine(TokenEngine):
     (ref: lib/kv-router/src/scheduling/queue.rs)."""
 
     def __init__(self, router: PushRouter, scheduler: KvScheduler,
-                 lora_instances=None, queue=None) -> None:
+                 lora_instances=None, queue=None, session=None) -> None:
         from ..kv_router.queue import SchedulerQueue
         from ..runtime.config import env
 
         self.router = router
         self.scheduler = scheduler
         self._lora_instances = lora_instances
+        # Session tier (dynamo_tpu/session.SessionTier): residency
+        # lookups before selection, routed-worker observations after.
+        self.session = session
         if queue is None:
             threshold = env("DYNT_ROUTER_QUEUE_THRESHOLD")
             budget = env("DYNT_MAX_BATCHED_TOKENS")
@@ -161,6 +164,13 @@ class KvRouterEngine(TokenEngine):
         sspan = get_tracer().start_span(
             "router.schedule", parent=traceparent,
             **{"request.id": request_id, "candidates": len(candidates)})
+        # Cache-residency routing (session tier): a live session's
+        # resident worker gets the affinity bonus in the selector; the
+        # routed decision is observed back so the NEXT turn knows where
+        # this one's KV landed.
+        affinity = (self.session.residency(request.session_id)
+                    if self.session is not None and request.session_id
+                    else None)
         try:
             # schedule() books the request into the slot tracker
             # (add_request) as part of the decision, so a drained backlog
@@ -173,11 +183,20 @@ class KvRouterEngine(TokenEngine):
                 pinned=pinned,
                 request_id=request_id,
                 deadline=request.deadline,
+                affinity_worker=affinity,
             ))
             sspan.set_attribute("worker.instance",
                                 f"{result.worker.worker_id:x}")
             sspan.set_attribute("kv.overlap_blocks", result.overlap_blocks)
             sspan.set_attribute("router.logit", float(result.logit))
+            if self.session is not None and request.session_id:
+                outcome = ("none" if affinity is None else
+                           "hit" if result.worker.worker_id == affinity
+                           else "miss")
+                SESSION_AFFINITY.labels(outcome=outcome).inc()
+                sspan.set_attribute("session.affinity", outcome)
+                self.session.observe_routed(request.session_id,
+                                            result.worker.worker_id)
             sspan.end(ok=True)
         finally:
             # Cancelled/errored while parked: close the span so queue
@@ -341,6 +360,11 @@ class Migration(TokenEngine):
                     # replay or the continuation decodes unconstrained.
                     logits_processors=request.logits_processors,
                     deadline=request.deadline,
+                    # Session pins + affinity survive the replay: the new
+                    # worker re-pins the anchored prefix into ITS tiers.
+                    cache_anchors=request.cache_anchors,
+                    cache_ttl=request.cache_ttl,
+                    session_id=request.session_id,
                 )
                 delay = self.policy.next_delay(prev_delay)
                 prev_delay = delay

@@ -36,6 +36,12 @@ from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
 from ..runtime.resilience import Deadline, DeadlineExceeded
 from ..runtime.status import debug_requests_response, metrics_response
+from ..session.wire import (
+    extract_cache_control,
+    resolve_anchor_tokens,
+    session_id_of,
+    strip_cache_control,
+)
 from .manager import ModelEntry, ModelManager
 from .preprocessor import DeltaGenerator, RequestError
 from .protocols import (
@@ -261,6 +267,57 @@ class HttpService:
                     exc.retry_after_s)))},
             )
 
+    def _session_prepare(self, request: web.Request,
+                         body: dict) -> tuple[dict, Optional[str], list]:
+        """Session-tier wire surface, shared by chat and messages:
+        extract cache_control anchors + the session id, and strip the
+        markers so the preprocessor sees a byte-identical unmarked
+        request (the unpinned-fallback contract). Returns
+        (clean_body, session_id, raw_anchors)."""
+        if not env("DYNT_SESSION_ENABLE"):
+            return body, None, []
+        anchors = extract_cache_control(body)
+        sid = session_id_of(body, request.headers)
+        if anchors or sid or "cache_control" in body \
+                or "session_id" in body:
+            body = strip_cache_control(body)
+        return body, sid, anchors
+
+    def _session_register(self, entry: ModelEntry, preprocessed,
+                          chat_messages, sid: Optional[str],
+                          anchors_raw: list) -> None:
+        """Resolve anchors to token prefixes, pin them into the ledger,
+        and stamp the request — after preprocessing, before dispatch.
+        Failures degrade to an unpinned request, never a 5xx: the
+        session tier is an accelerator, not a dependency."""
+        if entry.session is None or not (anchors_raw or sid):
+            return
+        try:
+            preprocessed.session_id = sid
+            anchors = []
+            if anchors_raw and not preprocessed.media_hashes:
+                # Multimodal prompts skip anchors: image-placeholder
+                # splicing breaks the rendered-prefix <-> token-prefix
+                # correspondence the resolution relies on.
+                anchors = resolve_anchor_tokens(
+                    entry.preprocessor, chat_messages, anchors_raw,
+                    preprocessed.token_ids)
+            preprocessed.cache_anchors = [n for n, _ in anchors]
+            if anchors and anchors[-1][1]:
+                # Carry the longest anchor's requested TTL to the worker
+                # so its KVBM pin honors the client's lease, not the
+                # system ceiling.
+                preprocessed.cache_ttl = float(anchors[-1][1])
+            pinned = entry.session.register_request(preprocessed, anchors)
+            if anchors or sid:
+                get_recorder().event(
+                    preprocessed.request_id, "session",
+                    pinned_blocks=len(pinned), anchors=len(anchors),
+                    session=bool(sid))
+        except Exception:  # noqa: BLE001 — degrade to unpinned
+            log.exception("session registration failed for %s",
+                          preprocessed.request_id)
+
     # -- handlers ----------------------------------------------------------
 
     async def _models(self, _request: web.Request) -> web.Response:
@@ -310,6 +367,9 @@ class HttpService:
         self._check_busy(entry)
         deadline = self._admit_deadline(request, entry)
         self._check_queue_admission(entry, deadline)
+        sid, anchors_raw = None, []
+        if kind == "chat":
+            body, sid, anchors_raw = self._session_prepare(request, body)
         pre_start = time.monotonic()
         try:
             if kind == "chat":
@@ -335,6 +395,13 @@ class HttpService:
                        "model": model,
                        "input.tokens": len(preprocessed.token_ids)})
         self._open_http_trace(request, preprocessed, span, received=arrival)
+        if kind == "chat":
+            # After the timeline opens so the `session` event lands in
+            # the flight record; markers resolve against the flattened
+            # message list preprocess_chat produced in place.
+            self._session_register(entry, preprocessed,
+                                   body.get("messages") or [], sid,
+                                   anchors_raw)
         # Gateway EPP header contract: an external endpoint picker (e.g.
         # the gateway/ EPP service behind a standard K8s gateway) pins
         # routing via headers — x-worker-instance-id direct-routes the
@@ -920,8 +987,9 @@ class HttpService:
         self._check_busy(entry)
         deadline = self._admit_deadline(request, entry)
         self._check_queue_admission(entry, deadline)
+        clean_body, sid, anchors_raw = self._session_prepare(request, body)
         try:
-            chat_body = self._messages_to_chat(body)
+            chat_body = self._messages_to_chat(clean_body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
@@ -937,6 +1005,15 @@ class HttpService:
                        "model": model,
                        "input.tokens": len(preprocessed.token_ids)})
         self._open_http_trace(request, preprocessed, span, received=arrival)
+        # Anthropic anchor indices are against body["messages"]; the
+        # lowered chat list may prepend a system message — remap (-1 =
+        # marked system block -> chat index 0).
+        chat_msgs = chat_body.get("messages") or []
+        offset = 1 if (chat_msgs and chat_msgs[0].get("role") == "system") \
+            else 0
+        self._session_register(
+            entry, preprocessed, chat_msgs, sid,
+            [(i if i < 0 else i + offset, ttl) for i, ttl in anchors_raw])
         return await self._finish_guard(
             preprocessed.request_id,
             self._messages_traced(

@@ -26,6 +26,7 @@ from ..kv_router import (
 from ..runtime.admission import QueueWaitEstimator
 from ..runtime.config import env
 from ..runtime.discovery import MODEL_CARD_PREFIX
+from ..session import SESSION_PIN_TOPIC
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
 from .engine import (
@@ -71,6 +72,9 @@ class ModelEntry:
     # first-token stream.
     wait_estimator: QueueWaitEstimator = dataclasses.field(
         default_factory=QueueWaitEstimator)
+    # Session/prompt-cache tier (dynamo_tpu/session): pin leases +
+    # session affinity for this model. None when DYNT_SESSION_ENABLE=0.
+    session: Optional[object] = None
 
     def __post_init__(self) -> None:
         self.wait_estimator.pool = f"decode:{self.card.name}"
@@ -171,6 +175,9 @@ class ModelWatcher:
         # list is shared with the running _event_loop so late-registered
         # models start receiving events immediately.
         self._ns_entries: dict[str, list[ModelEntry]] = {}
+        # namespace -> event publisher for session pin reconciliation
+        # (created lazily by the maintain loop's first drain).
+        self._session_publishers: dict = {}
 
     async def start(self) -> None:
         self._watch = await self.runtime.discovery.watch_prefix(
@@ -192,6 +199,11 @@ class ModelWatcher:
             await pool.router.client.close()
         for pool in self.manager.image_pools.values():
             await pool.router.client.close()
+        for publisher in self._session_publishers.values():
+            try:
+                await publisher.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                log.exception("session publisher close failed")
 
     async def _watch_loop(self) -> None:
         async for event in self._watch:
@@ -332,6 +344,14 @@ class ModelWatcher:
                 entry.instance_loras.pop(instance_id, None)
                 if entry.scheduler is not None:
                     entry.scheduler.remove_worker_id(instance_id)
+                # Session residency is invalidated LAZILY: a departed
+                # worker is simply absent from the router's candidate
+                # set, so its affinity bonus no-ops; the next routed
+                # turn overwrites the entry. An eager
+                # store.remove_worker_id scan would walk up to
+                # DYNT_SESSION_MAX entries on the event loop per
+                # departure. Pins survive either way — the KV may still
+                # be tiered and another worker can onboard it.
                 if not entry.instances:
                     log.info("model unlisted: %s (last instance gone)",
                              entry.card.name)
@@ -421,13 +441,19 @@ class ModelWatcher:
         def lora_lookup(adapter: str) -> set[int]:
             return {iid for iid, ls in instance_loras.items() if adapter in ls}
 
+        session = None
+        if env("DYNT_SESSION_ENABLE"):
+            from ..session import SessionTier
+
+            session = SessionTier(card.name, card.kv_block_size)
         if self.router_mode == "kv":
             config = self.kv_config or KvRouterConfig()
             config = dataclasses.replace(config, block_size=card.kv_block_size)
             scheduler = KvScheduler(config)
             router = PushRouter(client, mode="round_robin")
             engine: TokenEngine = KvRouterEngine(router, scheduler,
-                                                 lora_instances=lora_lookup)
+                                                 lora_instances=lora_lookup,
+                                                 session=session)
         else:
             router = PushRouter(client, mode=self.router_mode)
             engine = RouterEngine(router, lora_instances=lora_lookup)
@@ -450,6 +476,7 @@ class ModelWatcher:
             scheduler=scheduler,
             instances=set(),
             instance_loras=instance_loras,
+            session=session,
         )
 
     async def _subscribe_events(self, namespace: str, entry: ModelEntry) -> None:
@@ -474,16 +501,48 @@ class ModelWatcher:
     async def _indexer_maintain_loop(self, interval: float = 1.0) -> None:
         """Radix-index TTL/size sweep for every KV-routed entry (no-op
         unless DYNT_INDEXER_TTL_SECS/_MAX_TREE_SIZE enable pruning;
-        ref: indexer/pruning.rs driven from the indexer loop)."""
+        ref: indexer/pruning.rs driven from the indexer loop), plus the
+        session tier's lease/store sweep and pin-event publication —
+        the reconciliation feed peer router replicas converge on."""
         from ..kv_router.indexer import sweep_tree
 
         while True:
             await asyncio.sleep(interval)
-            for entries in self._ns_entries.values():
+            for namespace, entries in self._ns_entries.items():
                 for entry in entries:
                     if entry.scheduler is not None:
                         sweep_tree(entry.scheduler.indexer,
                                    entry.card.name, log)
+                    if entry.session is not None:
+                        try:
+                            entry.session.sweep()
+                            await self._publish_session_events(namespace,
+                                                               entry)
+                        except Exception:  # noqa: BLE001 — the sweep
+                            # loop must survive a publisher hiccup
+                            log.exception("session sweep/publish failed "
+                                          "(%s)", entry.card.name)
+
+    async def _publish_session_events(self, namespace: str, entry) -> None:
+        events = entry.session.drain_events()
+        if not events:
+            return
+        publisher = self._session_publishers.get(namespace)
+        if publisher is None:
+            publisher = self.runtime.event_publisher(namespace)
+            if hasattr(publisher, "advertise"):
+                await publisher.advertise()
+            self._session_publishers[namespace] = publisher
+        for i, payload in enumerate(events):
+            try:
+                await publisher.publish(SESSION_PIN_TOPIC, payload)
+            except Exception:
+                # A publisher hiccup must not lose the drained tail —
+                # requeue it (front, original order) for the next tick
+                # or peer replicas silently diverge until lease TTL.
+                for p in reversed(events[i:]):
+                    entry.session.outbox.appendleft(p)
+                raise
 
     async def _event_loop(self, sub, entries: list[ModelEntry]) -> None:
         async for topic, payload in sub:
@@ -525,6 +584,13 @@ class ModelWatcher:
                             worker,
                             [(p, h) for p, h in payload.get("blocks", [])],
                             payload.get("last_event_id"))
+                elif topic.startswith(SESSION_PIN_TOPIC):
+                    # Peer router replica's pin/route/touch: apply so
+                    # both replicas converge on the same pin set +
+                    # residency map (self-echoes filtered by origin id).
+                    for entry in entries:
+                        if entry.session is not None:
+                            entry.session.apply_event(payload)
                 elif topic.startswith(LOAD_TOPIC):
                     metrics = LoadMetrics.from_wire(payload)
                     for entry in entries:

@@ -91,6 +91,18 @@ class PreprocessedRequest:
     # Logits-processor specs (names or {"name","args"}) resolved against
     # the worker's registry (llm/logits_processing.py)
     logits_processors: list = dataclasses.field(default_factory=list)
+    # Session tier (dynamo_tpu/session): client-declared cacheable
+    # prefix boundaries as TOKEN counts into token_ids (ascending; each
+    # floors to full blocks before hashing), and the session-affinity
+    # id. The worker pins the anchored blocks into its KVBM tiers;
+    # routers key residency on session_id. Both empty = the request is
+    # wire-identical to the pre-session-tier protocol. cache_ttl is the
+    # client-requested lease TTL (seconds) of the longest anchor — the
+    # worker's KVBM pin honors it instead of defaulting to the system
+    # ceiling (still clamped to DYNT_PIN_TTL_SECS).
+    cache_anchors: list[int] = dataclasses.field(default_factory=list)
+    cache_ttl: Optional[float] = None
+    session_id: Optional[str] = None
     # End-to-end budget (runtime/resilience.py Deadline), stamped by the
     # frontend at admission. NOT serialized by to_wire: it crosses the
     # request plane as the x-dynt-deadline-ms header (re-encoded as
@@ -139,6 +151,12 @@ class PreprocessedRequest:
             out["media_embeddings"] = self.media_embeddings
         if self.logits_processors:
             out["logits_processors"] = self.logits_processors
+        if self.cache_anchors:
+            out["cache_anchors"] = self.cache_anchors
+        if self.cache_ttl:
+            out["cache_ttl"] = self.cache_ttl
+        if self.session_id:
+            out["session_id"] = self.session_id
         return out
 
     @classmethod
@@ -157,6 +175,9 @@ class PreprocessedRequest:
             media_hashes=list(data.get("media_hashes") or []),
             media_embeddings=data.get("media_embeddings"),
             logits_processors=list(data.get("logits_processors") or []),
+            cache_anchors=list(data.get("cache_anchors") or []),
+            cache_ttl=data.get("cache_ttl"),
+            session_id=data.get("session_id"),
         )
 
 

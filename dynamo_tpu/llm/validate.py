@@ -28,6 +28,12 @@ _COMMON_FIELDS = {
 CHAT_FIELDS = _COMMON_FIELDS | {
     "messages", "tools", "tool_choice", "response_format",
     "parallel_tool_calls",
+    # Session tier (docs/prompt-caching.md): session affinity id and a
+    # whole-prompt cache marker. Accepted regardless of
+    # DYNT_SESSION_ENABLE — the operator switch must not turn existing
+    # clients' requests into 400s; per-message cache_control markers
+    # live inside message/content dicts and are not top-level fields.
+    "session_id", "cache_control",
 }
 COMPLETION_FIELDS = _COMMON_FIELDS | {"prompt", "echo", "suffix"}
 

@@ -1,0 +1,261 @@
+"""Session-flood scenario: bounded memory + replica convergence at scale.
+
+Proves the two planet-scale claims of the session tier
+(docs/prompt-caching.md) without chips or sockets, CI-fast:
+
+  * **Bounded RSS under >=100k concurrent sessions.** A pair of
+    in-process router replicas (SessionStore + PinLedger + TinyLFU-
+    admission RadixTree each) absorbs a flood of distinct sessions and
+    synthetic KV-store events. Every structure must hold its cap — the
+    store at DYNT_SESSION_MAX, the ledger at DYNT_PIN_MAX_BLOCKS, the
+    radix index at its node budget — and process RSS growth must stay
+    under an explicit byte bound.
+  * **Pin-set convergence.** Replicas exchange their pin/route/touch
+    outboxes (the journal-event reconciliation feed, here a direct
+    in-process pipe so the assertion isolates the reconciliation
+    LOGIC, not transport); after the drain both must hold the SAME pin
+    set and agree on sampled session residency.
+  * **TinyLFU earns its slot.** A small set of hot shared prefixes is
+    touched throughout; the one-shot flood must not flush them out of
+    the capped radix index (the admission filter's whole job).
+
+Run via scripts/session_flood.py (CI job `session-flood`) or the
+smaller tier-1 test in tests/test_session_flood.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import resource
+import time
+from typing import Optional
+
+from ..kv_router.indexer import RadixTree
+from ..kv_router.protocols import KvCacheStored, RouterEvent
+from ..session.store import PinLedger, SessionStore, SessionTier
+
+
+@dataclasses.dataclass
+class FloodParams:
+    n_sessions: int = 100_000
+    turns_per_session: int = 2
+    blocks_per_turn: int = 3
+    n_workers: int = 2
+    # Caps deliberately far below the offered load: the flood is ~2x
+    # the session cap and many times the node cap, so the assertions
+    # exercise eviction/admission, not head-room.
+    max_sessions: int = 50_000
+    session_shards: int = 16
+    max_pin_blocks: int = 60_000
+    max_tree_nodes: int = 30_000
+    n_hot_prefixes: int = 64
+    hot_touch_every: int = 50
+    # Lease TTL + the injected per-session clock advance shape the live
+    # pin window: 120s / 0.02s-per-session ~= 6k sessions * 6 blocks =
+    # ~36k live pins — bounded by TTL turnover well under the cap, with
+    # 100k+ sessions' worth of pins offered over the run.
+    pin_ttl_secs: float = 120.0
+    clock_step_secs: float = 0.02
+    # RSS growth bound for the whole scenario (bytes). Generous vs the
+    # ~tens of MB the capped structures actually need, tight vs the
+    # GBs an unbounded map would take at 100k+ sessions.
+    rss_bound_bytes: int = 800 * 2**20
+    reconcile_every: int = 1000
+    seed: int = 7
+
+
+def _rss_bytes() -> int:
+    # ru_maxrss: KiB on Linux, bytes on macOS.
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return peak * 1024 if os.uname().sysname == "Linux" else peak
+
+
+class _Replica:
+    """One router replica's session-relevant state."""
+
+    def __init__(self, name: str, params: FloodParams) -> None:
+        self.name = name
+        self.tier = SessionTier(
+            "flood", block_size=16,
+            store=SessionStore(max_sessions=params.max_sessions,
+                               shards=params.session_shards,
+                               ttl_secs=600.0),
+            ledger=PinLedger(max_blocks=params.max_pin_blocks),
+            origin=name,
+            # Shared injected clock basis: expiry boundaries bit-exact
+            # across the pair, so convergence asserts equality.
+            mono_offset=0.0)
+        self.tree = RadixTree(max_tree_size=params.max_tree_nodes,
+                              admission=True, ttl_secs=0.0)
+        self._event_ids: dict[int, int] = {}
+
+    def store_chain(self, worker_id: int, hashes: list[int],
+                    parent: Optional[int]) -> None:
+        eid = self._event_ids.get(worker_id, 0) + 1
+        self._event_ids[worker_id] = eid
+        self.tree.apply_event(RouterEvent(
+            worker_id=worker_id, event_id=eid,
+            stored=KvCacheStored(block_hashes=hashes, parent_hash=parent)))
+
+
+def _session_hashes(idx: int, turn: int, blocks: int) -> list[int]:
+    # Deterministic per-session chains; turn t extends turn t-1 (the
+    # multi-turn grow-the-prefix shape).
+    base = (idx * 0x9E3779B97F4A7C15) & ((1 << 64) - 1)
+    return [(base + 1 + b) & ((1 << 64) - 1)
+            for b in range((turn + 1) * blocks)]
+
+
+def _reconcile(a: _Replica, b: _Replica, now: float) -> int:
+    """Cross-apply outboxes (the journal feed, in-process) and run both
+    lease expiries at the shared clock — replicas that saw the same
+    grants with the same absolute expiries hold the same live set."""
+    moved = 0
+    for src, dst in ((a, b), (b, a)):
+        for payload in src.tier.drain_events():
+            dst.tier.apply_event(payload, now=now)
+            moved += 1
+    a.tier.ledger.expire(now)
+    b.tier.ledger.expire(now)
+    return moved
+
+
+def run_flood(params: Optional[FloodParams] = None) -> dict:
+    params = params or FloodParams()
+    rss_before = _rss_bytes()
+    t0 = time.monotonic()
+    a = _Replica("replica-a", params)
+    b = _Replica("replica-b", params)
+    now = 1000.0  # injected clock: deterministic TTL behavior
+
+    # Hot shared prefixes, touched throughout the flood.
+    hot = [(0xABCD0000 + i) & ((1 << 64) - 1)
+           for i in range(params.n_hot_prefixes)]
+    for i, h in enumerate(hot):
+        a.store_chain(worker_id=i % params.n_workers, hashes=[h],
+                      parent=None)
+
+    for idx in range(params.n_sessions):
+        sid = f"s{idx:08d}"
+        worker = idx % params.n_workers
+        replica = a if idx % 2 == 0 else b
+        for turn in range(params.turns_per_session):
+            hashes = _session_hashes(idx, turn, params.blocks_per_turn)
+            lease_id = f"{sid}:{hashes[-1]:016x}"
+            granted = replica.tier.ledger.pin(
+                hashes, params.pin_ttl_secs, lease_id=lease_id,
+                session_id=sid, now=now)
+            if granted is not None:
+                # Emit only grants (register_request semantics): a
+                # locally refused pin must not ask the peer to diverge.
+                replica.tier._emit({
+                    "op": "pin", "lease": granted, "h": hashes,
+                    "exp": now + replica.tier._mono_offset
+                    + params.pin_ttl_secs, "sid": sid})
+            replica.tier.store.touch(sid, worker_id=worker,
+                                     prefix_hashes=hashes, now=now)
+            replica.tier._emit({"op": "route", "sid": sid, "w": worker,
+                                "t": now})
+            replica.store_chain(worker, hashes, parent=None)
+        if idx % params.hot_touch_every == 0:
+            # Keep the hot prefixes hot: queries are the admission
+            # filter's frequency evidence (per-hash — they are sibling
+            # roots, not one chain).
+            for h in hot:
+                a.tree.find_matches([h])
+        if idx % params.reconcile_every == 0:
+            _reconcile(a, b, now)
+        now += params.clock_step_secs
+    _reconcile(a, b, now)
+    # One more pass: route/touch events emitted after the last exchange.
+    _reconcile(a, b, now)
+    # Residency convergence sampled over the most recent window — the
+    # sessions guaranteed live in BOTH stores (older ones may have been
+    # legitimately cap- or TTL-evicted on either side).
+    sample_n = min(512, params.reconcile_every, params.n_sessions)
+    affinity_samples = [f"s{i:08d}" for i in
+                        range(params.n_sessions - sample_n,
+                              params.n_sessions)]
+    wall_s = time.monotonic() - t0
+    rss_after = _rss_bytes()
+
+    pins_a, pins_b = a.tier.ledger.pinned_set(), b.tier.ledger.pinned_set()
+    # Residency convergence: an entry may be legitimately absent on one
+    # replica (cap/TinyLFU eviction is local), but when BOTH hold a
+    # session they must agree on its resident worker — a conflict would
+    # send the cached turn to the wrong machine on one replica.
+    present_both = agree = 0
+    for sid in affinity_samples:
+        ea = a.tier.store.get(sid, now=now)
+        eb = b.tier.store.get(sid, now=now)
+        if ea is not None and eb is not None:
+            present_both += 1
+            if ea.worker_id == eb.worker_id:
+                agree += 1
+    sample_agree = agree
+    hot_survived = sum(
+        1 for h in hot
+        if a.tree.find_matches([h]).scores)
+    report = {
+        "params": dataclasses.asdict(params),
+        "wall_s": round(wall_s, 2),
+        "rss_before_bytes": rss_before,
+        "rss_after_bytes": rss_after,
+        "rss_growth_bytes": rss_after - rss_before,
+        "sessions_a": len(a.tier.store),
+        "sessions_b": len(b.tier.store),
+        "session_evicted_a": dict(a.tier.store.evicted),
+        "pinned_blocks_a": len(pins_a),
+        "pinned_blocks_b": len(pins_b),
+        "pin_set_divergence": len(pins_a ^ pins_b),
+        "tree_nodes_a": a.tree.total_nodes(),
+        "tree_nodes_b": b.tree.total_nodes(),
+        "tree_admission_rejected_a": a.tree.admission_rejected,
+        "affinity_samples": len(affinity_samples),
+        "affinity_present_both": present_both,
+        "affinity_agree": sample_agree,
+        "hot_prefixes": len(hot),
+        "hot_survived": hot_survived,
+    }
+    report["assertions"] = {
+        "rss_bounded": report["rss_growth_bytes"] < params.rss_bound_bytes,
+        "sessions_capped": (
+            len(a.tier.store) <= params.max_sessions
+            and len(b.tier.store) <= params.max_sessions),
+        "pins_capped": (
+            len(pins_a) <= params.max_pin_blocks
+            and len(pins_b) <= params.max_pin_blocks),
+        "tree_capped": (
+            a.tree.total_nodes() <= params.max_tree_nodes
+            and b.tree.total_nodes() <= params.max_tree_nodes),
+        "pin_sets_converged": pins_a == pins_b,
+        "affinity_converged": (present_both > 0
+                               and sample_agree == present_both),
+        "hot_prefixes_survived": hot_survived >= len(hot) // 2,
+    }
+    report["passed"] = all(report["assertions"].values())
+    return report
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser("session_flood")
+    parser.add_argument("--sessions", type=int, default=100_000)
+    parser.add_argument("--out", default="session-flood")
+    args = parser.parse_args(argv)
+    report = run_flood(FloodParams(n_sessions=args.sessions))
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "session-flood-report.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "params"}, indent=2))
+    print(f"report: {path}")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -251,6 +251,73 @@ _register("DYNT_INDEXER_MAX_TREE_SIZE", 0, _int,
           "Radix-index node budget; above it the oldest blocks prune to "
           "80% of budget (0 = unlimited; ref PruneConfig max_tree_size)")
 
+# Session tier — explicit prompt caching + cache-residency routing
+# (dynamo_tpu/session/; docs/prompt-caching.md)
+_register("DYNT_SESSION_ENABLE", True, _bool,
+          "Session/prompt-cache tier: honor cache_control markers and "
+          "session ids on /v1/chat/completions + /v1/messages (pin "
+          "leases into KVBM, session-affinity routing). Off makes the "
+          "new wire fields inert — requests behave exactly as before")
+_register("DYNT_SESSION_TTL_SECS", 900.0, _float,
+          "Idle TTL for a session-affinity entry in the SessionStore; "
+          "an entry not touched for this long expires (its pin leases "
+          "die with it). Bounds memory together with DYNT_SESSION_MAX")
+_register("DYNT_SESSION_MAX", 1_000_000, _int,
+          "Bound on live session entries per router process, across all "
+          "shards. At the cap, admission is frequency-gated (TinyLFU "
+          "doorkeeper) and the coldest session in the shard is evicted "
+          "— millions of distinct one-shot sessions cannot grow the "
+          "store without bound")
+_register("DYNT_SESSION_SHARDS", 16, _int,
+          "SessionStore shard count (cap is split evenly; sharding "
+          "bounds per-eviction scan cost, not thread contention — the "
+          "store lives on the event loop)")
+_register("DYNT_SESSION_AFFINITY_WEIGHT", 4.0, _float,
+          "KV-router logit bonus (in block units) for the worker a live "
+          "session last landed on: cached-turn requests prefer the "
+          "resident worker unless it is this many blocks more loaded "
+          "than the best alternative. 0 disables affinity steering "
+          "(pins and radix overlap still apply)")
+_register("DYNT_SESSION_EVENTS", True, _bool,
+          "Publish session pin/unpin events on the event plane "
+          "(topic 'session_pins') so sharded router replicas converge "
+          "on the same pin set (journal-event reconciliation)")
+_register("DYNT_PIN_TTL_SECS", 300.0, _float,
+          "Default lease TTL for a cache_control pinned prefix (a "
+          "request-supplied ttl is clamped to at most this). A pinned "
+          "prefix cannot be evicted from KVBM G2/G3 mid-lease but "
+          "ALWAYS dies at TTL — re-pin (idempotent) to keep it warm")
+_register("DYNT_PIN_MAX_BLOCKS", 65536, _int,
+          "Bound on concurrently pinned blocks per PinLedger. Pins past "
+          "the cap are refused (counted dynamo_pin_ops_total{op=refuse})"
+          " — pinning is a cache hint, never a reservation guarantee")
+_register("DYNT_INDEXER_ADMISSION", False, _bool,
+          "TinyLFU admission/eviction for the router radix prefix index "
+          "(block_manager tinylfu lifted into kv_router): insertions at "
+          "the DYNT_INDEXER_MAX_TREE_SIZE node cap are frequency-gated "
+          "(doorkeeper absorbs one-hit-wonders, a cold chain cannot "
+          "flush a hot shared prefix). Forces the Python tree (the "
+          "native core has no admission filter yet)")
+
+# G4 object-store auth (block_manager/storage.py HttpObjectStoreClient;
+# docs/prompt-caching.md §G4 auth modes)
+_register("DYNT_G4_AUTH", "none", _str,
+          "Auth mode for the HTTP(S) G4 object-store client: none | "
+          "hmac (SigV4-style canonical-string request signing) | "
+          "bearer (static token)")
+_register("DYNT_G4_HMAC_KEY_ID", "", _str,
+          "Access-key id sent in the Authorization Credential for "
+          "hmac-signed G4 requests")
+_register("DYNT_G4_HMAC_SECRET", "", _str,
+          "HMAC-SHA256 signing secret for G4 request signing (prefer "
+          "injecting via env from a secret manager; never logged)")
+_register("DYNT_G4_BEARER_TOKEN", "", _str,
+          "Static bearer token for G4 requests when DYNT_G4_AUTH=bearer")
+_register("DYNT_G4_SIG_TTL_SECS", 300.0, _float,
+          "Maximum age of a signed G4 request's x-dynt-date before the "
+          "server rejects it (replay window; both the client clock-skew "
+          "allowance and the stub server's enforcement bound)")
+
 # Tracing + flight recorder (docs/observability.md)
 _register("DYNT_OTLP_ENDPOINT", "", _str,
           "OTLP/HTTP collector base URL (e.g. http://localhost:4318); "

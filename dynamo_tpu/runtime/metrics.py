@@ -231,6 +231,49 @@ JIT_COMPILES = Counter(
     "when the compile fired (unscoped = outside any runner entry)",
     ["fn"], registry=REGISTRY,
 )
+# Session tier (dynamo_tpu/session/): prompt-cache pins and
+# session-affinity routing at planet scale — the gauges prove the store
+# stays bounded under millions of sessions, the counters show whether
+# cached turns actually land on their resident worker
+# (docs/prompt-caching.md).
+SESSION_ACTIVE = Gauge(
+    "dynamo_session_active",
+    "Live session-affinity entries in the SessionStore (all shards), "
+    "per served model",
+    ["model"], registry=REGISTRY,
+)
+SESSION_EVICTED = Counter(
+    "dynamo_session_evicted_total",
+    "Session entries dropped, by cause: ttl (idle expiry), cap (shard "
+    "at budget — LRU victim), rejected (TinyLFU doorkeeper refused "
+    "admission at the cap)",
+    ["cause"], registry=REGISTRY,
+)
+SESSION_AFFINITY = Counter(
+    "dynamo_session_affinity_total",
+    "Session-affinity routing outcomes: hit (routed to the resident "
+    "worker), miss (resident worker lost the selection or left), "
+    "none (first turn — no residency yet)",
+    ["outcome"], registry=REGISTRY,
+)
+PIN_LEASES = Gauge(
+    "dynamo_pin_leases_active",
+    "Live prompt-cache pin leases in the PinLedger, per served model",
+    ["model"], registry=REGISTRY,
+)
+PIN_BLOCKS = Gauge(
+    "dynamo_pin_blocks_active",
+    "Distinct blocks currently protected by at least one pin lease, "
+    "per served model",
+    ["model"], registry=REGISTRY,
+)
+PIN_OPS = Counter(
+    "dynamo_pin_ops_total",
+    "Pin-ledger operations: pin (new lease), refresh (idempotent "
+    "re-pin extended an existing lease), unpin, expire (lease died at "
+    "TTL), refuse (DYNT_PIN_MAX_BLOCKS cap)",
+    ["op"], registry=REGISTRY,
+)
 # OTLP exporter health (runtime/otel.py): spans that reached the
 # collector vs spans lost to a full buffer or a failed export.
 OTEL_SPANS_EXPORTED = Counter(

@@ -1,0 +1,35 @@
+"""Session tier: explicit prompt caching + bounded-memory session state.
+
+The distributed layer's best-case number (4.6x TTFT at 0.9 prefix
+overlap, BENCH_MULTI.router_ab) only materializes when requests *have*
+overlap. This package makes overlap instead of hoping for it:
+
+  * `cache_control`-style markers on /v1/chat/completions and
+    /v1/messages resolve marked prefixes to the same chained block
+    hashes the prefix cache and KV router already key on, and issue
+    pin/unpin + TTL leases (PinLedger) so the marked KV survives in
+    KVBM G2/G3 between turns;
+  * a session id (body field or x-dynt-session-id header) records
+    which worker holds a conversation's KV, and the kv_router scorer
+    consults that residency before cost — a cached turn lands where
+    its prefix lives;
+  * the SessionStore survives millions of distinct sessions with
+    bounded memory: sharded, TinyLFU-admission-gated at the cap, idle
+    TTL, and journal-event reconciliation so two router replicas
+    converge on the same pin set.
+
+Semantics in docs/prompt-caching.md.
+"""
+
+from .store import (  # noqa: F401
+    SESSION_PIN_TOPIC,
+    PinLedger,
+    SessionEntry,
+    SessionStore,
+    SessionTier,
+)
+from .wire import (  # noqa: F401
+    SESSION_HEADER,
+    extract_cache_control,
+    strip_cache_control,
+)

@@ -1,0 +1,481 @@
+"""SessionStore + PinLedger: bounded session state and pin leases.
+
+Memory discipline (the planet-scale contract): every structure here is
+bounded and every entry has a TTL. The SessionStore is sharded (cap
+split evenly), admission at a full shard is frequency-gated through the
+same TinyLFU sketch KVBM tiers use (block_manager/tinylfu.py — one-hit
+wonder sessions cannot flush hot multi-turn agents), and idle entries
+expire. The PinLedger refcounts pinned blocks across leases so a prefix
+shared by two sessions stays protected until BOTH leases drop — but a
+lease always dies at TTL: pinning is a cache hint with an expiry, never
+a permanent reservation.
+
+Replica convergence: every pin/unpin/touch mutation is published on the
+event plane (SESSION_PIN_TOPIC) with absolute expiry timestamps and an
+origin id; a peer replica applies the event idempotently, so two
+routers fed the same journal converge on the same pin set regardless of
+delivery order interleaving with their own traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import xxhash
+
+from ..block_manager.tinylfu import TinyLfu
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+
+log = get_logger("session.store")
+
+# Event-plane topic for pin-set reconciliation between router replicas.
+SESSION_PIN_TOPIC = "session_pins"
+
+
+@dataclasses.dataclass
+class _Lease:
+    lease_id: str
+    hashes: tuple[int, ...]
+    expires_at: float
+    session_id: Optional[str] = None
+
+
+class PinLedger:
+    """Refcounted pin leases over block hashes.
+
+    A block is *protected* while any live lease covers it. Leases are
+    idempotent by `lease_id` — re-pinning the same id refreshes the TTL
+    instead of stacking refcounts, so a chatty client cannot leak
+    protection. `max_blocks` bounds total distinct protected blocks;
+    pins past the cap are refused (op=refuse), never queued.
+    """
+
+    def __init__(self, max_blocks: Optional[int] = None,
+                 on_release: Optional[Callable[[list[int]], None]] = None,
+                 model: str = "default") -> None:
+        self.max_blocks = (env("DYNT_PIN_MAX_BLOCKS")
+                           if max_blocks is None else max_blocks)
+        self._leases: dict[str, _Lease] = {}
+        self._refs: dict[int, int] = {}
+        # Gauges are per-model labeled: one ledger per served model, so
+        # an unlabeled absolute set() would flip-flop between models.
+        self._gauge_leases = rt_metrics.PIN_LEASES.labels(model=model)
+        self._gauge_blocks = rt_metrics.PIN_BLOCKS.labels(model=model)
+        # Blocks released by the last expire/unpin — the KVBM side
+        # unprotects them (on_release hook).
+        self.on_release = on_release or (lambda hs: None)
+
+    # -- introspection ------------------------------------------------------
+
+    def pinned(self, h: int) -> bool:
+        return h in self._refs
+
+    def pinned_set(self) -> set[int]:
+        return set(self._refs)
+
+    def lease_count(self) -> int:
+        return len(self._leases)
+
+    def block_count(self) -> int:
+        return len(self._refs)
+
+    def lease(self, lease_id: str) -> Optional[_Lease]:
+        return self._leases.get(lease_id)
+
+    def _gauges(self) -> None:
+        self._gauge_leases.set(len(self._leases))
+        self._gauge_blocks.set(len(self._refs))
+
+    # -- mutation -----------------------------------------------------------
+
+    def pin(self, hashes, ttl: float, *, lease_id: Optional[str] = None,
+            session_id: Optional[str] = None,
+            now: Optional[float] = None) -> Optional[str]:
+        """Create (or refresh) a lease over `hashes` expiring at
+        now+ttl. Returns the lease id, or None when refused at the
+        block cap. TTL is clamped to DYNT_PIN_TTL_SECS — a lease can
+        never outlive the system ceiling."""
+        now = time.monotonic() if now is None else now
+        ttl = min(float(ttl), env("DYNT_PIN_TTL_SECS")) \
+            if ttl else env("DYNT_PIN_TTL_SECS")
+        hashes = tuple(int(h) for h in hashes)
+        if not hashes:
+            return None
+        if lease_id is None:
+            lease_id = uuid.uuid4().hex
+        existing = self._leases.get(lease_id)
+        if existing is not None and existing.hashes == hashes:
+            # Idempotent re-pin: same identity, fresher TTL. No
+            # refcount churn — the lease already holds its blocks.
+            existing.expires_at = now + ttl
+            rt_metrics.PIN_OPS.labels(op="refresh").inc()
+            return lease_id
+        new_blocks = sum(1 for h in set(hashes) if h not in self._refs)
+        if existing is None and self.max_blocks \
+                and len(self._refs) + new_blocks > self.max_blocks:
+            rt_metrics.PIN_OPS.labels(op="refuse").inc()
+            return None
+        if existing is not None:
+            # Same lease id, different chain (conversation grew): swap
+            # atomically — release old refs after taking new ones so a
+            # shared prefix never transits unprotected.
+            for h in set(hashes):
+                self._refs[h] = self._refs.get(h, 0) + 1
+            self._drop_refs(existing.hashes)
+        else:
+            for h in set(hashes):
+                self._refs[h] = self._refs.get(h, 0) + 1
+        self._leases[lease_id] = _Lease(lease_id, hashes, now + ttl,
+                                        session_id)
+        rt_metrics.PIN_OPS.labels(op="pin").inc()
+        self._gauges()
+        return lease_id
+
+    def _drop_refs(self, hashes) -> list[int]:
+        released = []
+        for h in set(hashes):
+            n = self._refs.get(h, 1) - 1
+            if n <= 0:
+                self._refs.pop(h, None)
+                released.append(h)
+            else:
+                self._refs[h] = n
+        return released
+
+    def unpin(self, lease_id: str) -> bool:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        released = self._drop_refs(lease.hashes)
+        rt_metrics.PIN_OPS.labels(op="unpin").inc()
+        self._gauges()
+        if released:
+            self.on_release(released)
+        return True
+
+    def expire(self, now: Optional[float] = None) -> list[int]:
+        """Kill every lease past its TTL; returns blocks that lost
+        their last protection (the caller unprotects them in KVBM)."""
+        now = time.monotonic() if now is None else now
+        dead = [lid for lid, lease in self._leases.items()
+                if lease.expires_at <= now]
+        released: list[int] = []
+        for lid in dead:
+            lease = self._leases.pop(lid)
+            released.extend(self._drop_refs(lease.hashes))
+            rt_metrics.PIN_OPS.labels(op="expire").inc()
+        if dead:
+            self._gauges()
+        if released:
+            self.on_release(released)
+        return released
+
+
+@dataclasses.dataclass
+class SessionEntry:
+    session_id: str
+    worker_id: Optional[int] = None
+    prefix_hashes: tuple[int, ...] = ()
+    last_seen: float = 0.0
+    lease_ids: tuple[str, ...] = ()
+
+
+class SessionStore:
+    """Sharded, TinyLFU-gated, TTL-bounded session map."""
+
+    def __init__(self, max_sessions: Optional[int] = None,
+                 shards: Optional[int] = None,
+                 ttl_secs: Optional[float] = None,
+                 model: str = "default") -> None:
+        self.max_sessions = (env("DYNT_SESSION_MAX")
+                             if max_sessions is None else max_sessions)
+        n = env("DYNT_SESSION_SHARDS") if shards is None else shards
+        self.n_shards = max(1, int(n))
+        self.ttl_secs = (env("DYNT_SESSION_TTL_SECS")
+                         if ttl_secs is None else ttl_secs)
+        self.cap_per_shard = max(1, self.max_sessions // self.n_shards)
+        self._shards: list[OrderedDict[str, SessionEntry]] = [
+            OrderedDict() for _ in range(self.n_shards)]
+        # One admission sketch per shard, sized for the shard cap: the
+        # doorkeeper absorbs one-shot session floods before they can
+        # evict live multi-turn sessions.
+        self._lfu = [TinyLfu(self.cap_per_shard)
+                     for _ in range(self.n_shards)]
+        self.evicted = {"ttl": 0, "cap": 0, "rejected": 0}
+        self._gauge = rt_metrics.SESSION_ACTIVE.labels(model=model)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    def _shard_of(self, session_id: str) -> int:
+        return xxhash.xxh64_intdigest(session_id.encode()) % self.n_shards
+
+    @staticmethod
+    def _key_hash(session_id: str) -> int:
+        return xxhash.xxh64_intdigest(session_id.encode())
+
+    def get(self, session_id: str,
+            now: Optional[float] = None) -> Optional[SessionEntry]:
+        now = time.monotonic() if now is None else now
+        shard = self._shards[self._shard_of(session_id)]
+        entry = shard.get(session_id)
+        if entry is None:
+            return None
+        if self.ttl_secs and now - entry.last_seen > self.ttl_secs:
+            shard.pop(session_id, None)
+            self.evicted["ttl"] += 1
+            rt_metrics.SESSION_EVICTED.labels(cause="ttl").inc()
+            self._gauge.set(len(self))
+            return None
+        return entry
+
+    def touch(self, session_id: str, *, worker_id: Optional[int] = None,
+              prefix_hashes=None, lease_ids=None,
+              now: Optional[float] = None) -> Optional[SessionEntry]:
+        """Upsert a session. Returns the live entry, or None when the
+        shard is at cap and TinyLFU refused admission (a cold new
+        session does not displace a hot one)."""
+        now = time.monotonic() if now is None else now
+        idx = self._shard_of(session_id)
+        shard, lfu = self._shards[idx], self._lfu[idx]
+        key = self._key_hash(session_id)
+        lfu.touch(key)
+        entry = shard.get(session_id)
+        if entry is None:
+            if len(shard) >= self.cap_per_shard:
+                victim_sid = self._expire_one(shard, now)
+                if victim_sid is None:
+                    # LRU victim is hotter than the candidate: refuse.
+                    victim = next(iter(shard))
+                    if not lfu.admit(key, self._key_hash(victim)):
+                        self.evicted["rejected"] += 1
+                        rt_metrics.SESSION_EVICTED.labels(
+                            cause="rejected").inc()
+                        return None
+                    shard.pop(victim, None)
+                    self.evicted["cap"] += 1
+                    rt_metrics.SESSION_EVICTED.labels(cause="cap").inc()
+            entry = SessionEntry(session_id=session_id)
+            shard[session_id] = entry
+        entry.last_seen = now
+        if worker_id is not None:
+            entry.worker_id = worker_id
+        if prefix_hashes is not None:
+            entry.prefix_hashes = tuple(int(h) for h in prefix_hashes)
+        if lease_ids is not None:
+            entry.lease_ids = tuple(lease_ids)
+        shard.move_to_end(session_id)
+        self._gauge.set(len(self))
+        return entry
+
+    def _expire_one(self, shard: OrderedDict, now: float) -> Optional[str]:
+        """Drop the LRU entry if it is TTL-dead (cheap lazy expiry that
+        keeps full shards honest); returns its id or None."""
+        if not shard or not self.ttl_secs:
+            return None
+        sid, entry = next(iter(shard.items()))
+        if now - entry.last_seen > self.ttl_secs:
+            shard.pop(sid, None)
+            self.evicted["ttl"] += 1
+            rt_metrics.SESSION_EVICTED.labels(cause="ttl").inc()
+            return sid
+        return None
+
+    def sweep(self, now: Optional[float] = None, limit: int = 1024) -> int:
+        """Expire up to `limit` idle entries across shards (called from
+        the frontend's 1 Hz maintenance loop)."""
+        if not self.ttl_secs:
+            return 0
+        now = time.monotonic() if now is None else now
+        dropped = 0
+        for shard in self._shards:
+            while dropped < limit and self._expire_one(shard, now):
+                dropped += 1
+        if dropped:
+            self._gauge.set(len(self))
+        return dropped
+
+    def remove_worker_id(self, worker_id: int) -> int:
+        """A worker left: its residency claims are stale. Entries keep
+        their pins (the KV may still be tiered elsewhere) but lose
+        affinity."""
+        n = 0
+        for shard in self._shards:
+            for entry in shard.values():
+                if entry.worker_id == worker_id:
+                    entry.worker_id = None
+                    n += 1
+        return n
+
+
+class SessionTier:
+    """Per-model facade gluing the wire surface to the store, the pin
+    ledger, the router scorer, and the event plane."""
+
+    def __init__(self, model: str, block_size: int,
+                 publish: Optional[Callable[[dict], None]] = None,
+                 store: Optional[SessionStore] = None,
+                 ledger: Optional[PinLedger] = None,
+                 origin: Optional[str] = None,
+                 mono_offset: Optional[float] = None) -> None:
+        self.model = model
+        self.block_size = block_size
+        # Explicit None checks: a fresh SessionStore is EMPTY and
+        # therefore falsy (__len__ == 0) — `store or ...` would silently
+        # replace an injected store with a default-capped one.
+        self.store = SessionStore(model=model) if store is None else store
+        self.ledger = PinLedger(model=model) if ledger is None else ledger
+        # Event emission: a sync `publish` callback, or (default) a
+        # bounded outbox the owner drains from its maintenance loop and
+        # publishes asynchronously — no fire-and-forget tasks on the
+        # request path. Origin id filters self-echoes on the shared
+        # topic.
+        self.origin = origin or uuid.uuid4().hex[:12]
+        from collections import deque
+
+        self.outbox: "deque[dict]" = deque(maxlen=4096)
+        self._publish = publish or self.outbox.append
+        # monotonic -> wall offset so event expiries are absolute and
+        # replicas with different monotonic epochs still converge
+        # (injectable: scenarios driving several tiers on one injected
+        # clock share an offset, so expiry boundaries are bit-exact;
+        # across real processes, sub-ms offset skew just means a lease
+        # dies a sweep earlier on one replica than the other).
+        self._mono_offset = (time.time() - time.monotonic()
+                             if mono_offset is None else mono_offset)
+
+    # -- request path --------------------------------------------------------
+
+    def register_request(self, preprocessed, anchors,
+                         now: Optional[float] = None) -> list[int]:
+        """Pin each anchored token prefix (floored to full blocks) and
+        record the session. Returns the pinned hashes of the LONGEST
+        anchor (what routing/prefetch care about). `anchors` is
+        [(n_tokens, ttl_or_None), ...] ascending."""
+        from ..tokens import compute_block_hashes
+
+        now = time.monotonic() if now is None else now
+        session_id = preprocessed.session_id
+        longest: list[int] = []
+        lease_ids: list[str] = []
+        salt = preprocessed.kv_salt()
+        for n_tokens, ttl in anchors:
+            n_blocks = n_tokens // self.block_size
+            if n_blocks <= 0:
+                continue
+            hashes = compute_block_hashes(
+                preprocessed.token_ids[: n_blocks * self.block_size],
+                self.block_size, lora_id=salt)
+            if not hashes:
+                continue
+            ttl = ttl or env("DYNT_PIN_TTL_SECS")
+            # Deterministic lease id: same session + same chain tail =
+            # same lease, so a re-sent marker refreshes instead of
+            # stacking (idempotent re-pin).
+            lease_id = f"{session_id or 'anon'}:{hashes[-1] & ((1 << 64) - 1):016x}"
+            granted = self.ledger.pin(hashes, ttl, lease_id=lease_id,
+                                      session_id=session_id, now=now)
+            if granted is None:
+                continue
+            lease_ids.append(granted)
+            longest = hashes
+            self._emit({"op": "pin", "lease": granted,
+                        "h": [h & ((1 << 64) - 1) for h in hashes],
+                        "exp": now + self._mono_offset
+                        + min(float(ttl), env("DYNT_PIN_TTL_SECS")),
+                        "sid": session_id})
+        if session_id:
+            self.store.touch(session_id, prefix_hashes=longest or None,
+                             lease_ids=lease_ids or None, now=now)
+            self._emit({"op": "touch", "sid": session_id,
+                        "t": now + self._mono_offset})
+        return longest
+
+    def residency(self, session_id: Optional[str],
+                  now: Optional[float] = None) -> Optional[int]:
+        """The worker id a live session last landed on, if any."""
+        if not session_id:
+            return None
+        entry = self.store.get(session_id, now=now)
+        return entry.worker_id if entry is not None else None
+
+    def observe_routed(self, session_id: Optional[str], worker_id: int,
+                       now: Optional[float] = None) -> None:
+        if not session_id:
+            return
+        self.store.touch(session_id, worker_id=worker_id, now=now)
+        self._emit({"op": "route", "sid": session_id, "w": worker_id,
+                    "t": (time.monotonic() if now is None else now)
+                    + self._mono_offset})
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        self.ledger.expire(now)
+        self.store.sweep(now)
+
+    def drain_events(self) -> list[dict]:
+        """Outbox contents for async publication (the owner's
+        maintenance loop); drops nothing — the deque bound only sheds
+        under a publisher stall, oldest first."""
+        out = []
+        while self.outbox:
+            out.append(self.outbox.popleft())
+        return out
+
+    # -- replica reconciliation ----------------------------------------------
+
+    def _emit(self, payload: dict) -> None:
+        if not env("DYNT_SESSION_EVENTS"):
+            return
+        payload["o"] = self.origin
+        payload["m"] = self.model
+        try:
+            self._publish(payload)
+        except Exception:  # noqa: BLE001 — reconciliation is
+            # best-effort; local state is already correct
+            log.exception("session event publish failed")
+
+    def apply_event(self, payload: dict,
+                    now: Optional[float] = None) -> bool:
+        """Apply a peer replica's pin/route/touch event. Idempotent:
+        pin events carry absolute (wall-clock) expiry, so replaying or
+        reordering them converges on the same pin set."""
+        if not isinstance(payload, dict):
+            return False
+        if payload.get("o") == self.origin:
+            return False  # self-echo on the shared topic
+        if payload.get("m") not in (None, self.model):
+            return False
+        now = time.monotonic() if now is None else now
+        op = payload.get("op")
+        if op == "pin":
+            ttl = float(payload.get("exp", 0.0)) \
+                - (now + self._mono_offset)
+            if ttl <= 0:
+                return False
+            self.ledger.pin([int(h) for h in payload.get("h", [])],
+                            ttl, lease_id=payload.get("lease"),
+                            session_id=payload.get("sid"), now=now)
+            sid = payload.get("sid")
+            if sid:
+                self.store.touch(sid, now=now)
+            return True
+        if op == "unpin":
+            return self.ledger.unpin(payload.get("lease", ""))
+        if op == "route":
+            sid = payload.get("sid")
+            if sid and payload.get("w") is not None:
+                self.store.touch(sid, worker_id=int(payload["w"]), now=now)
+                return True
+            return False
+        if op == "touch":
+            sid = payload.get("sid")
+            if sid:
+                self.store.touch(sid, now=now)
+                return True
+        return False

@@ -1,0 +1,194 @@
+"""cache_control wire-surface parsing and anchor resolution.
+
+The marker shape follows the Anthropic Messages convention —
+``{"cache_control": {"type": "ephemeral"}}`` on a message, a content
+block, or a system block — and the same shape is accepted on
+/v1/chat/completions messages (and their content parts) so OpenAI-SDK
+clients get prompt caching without a bespoke extension namespace. An
+optional ``ttl`` ("300", "5m", "1h", or a number of seconds) rides the
+marker; it is clamped to DYNT_PIN_TTL_SECS at pin time.
+
+A marker on message/block i means "the prompt prefix up to and
+including i is a stable, reusable prefix — pin it". Markers are
+normalized (deduped, sorted, capped at MAX_ANCHORS keeping the longest)
+and resolved to *token* prefix lengths by re-rendering the truncated
+message list and taking the longest common token prefix with the full
+prompt — robust to templates and tokenizer merges at the boundary, and
+floored to full blocks before hashing (partial blocks are never
+reusable, dynamo_tpu.tokens).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+# Session affinity header (also accepted as a `session_id` body field).
+# Lowercase: HTTP headers are case-insensitive and aiohttp normalizes.
+SESSION_HEADER = "x-dynt-session-id"
+
+# Anthropic caps cache_control breakpoints at 4 per request; same here —
+# extra markers keep the LONGEST prefixes (deeper anchors subsume
+# shallower ones for routing, shallower ones only add lease granularity).
+MAX_ANCHORS = 4
+
+_TTL_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([smh]?)\s*$")
+_TTL_UNIT = {"": 1.0, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_ttl(raw) -> Optional[float]:
+    """Marker ttl -> seconds, or None when absent/unparseable (the pin
+    falls back to the DYNT_PIN_TTL_SECS default)."""
+    if raw is None:
+        return None
+    if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+        return float(raw) if raw > 0 else None
+    if isinstance(raw, str):
+        m = _TTL_RE.match(raw)
+        if m:
+            secs = float(m.group(1)) * _TTL_UNIT[m.group(2)]
+            return secs if secs > 0 else None
+    return None
+
+
+def _marker_of(obj) -> Optional[dict]:
+    """The cache_control marker on a message/block dict, if valid."""
+    if not isinstance(obj, dict):
+        return None
+    cc = obj.get("cache_control")
+    if isinstance(cc, dict) and cc.get("type") == "ephemeral":
+        return cc
+    return None
+
+
+def _scan_message(msg) -> Optional[dict]:
+    """Marker on the message itself or on any of its content parts (the
+    Anthropic block form; the deepest marked part marks the message)."""
+    marker = _marker_of(msg)
+    content = msg.get("content") if isinstance(msg, dict) else None
+    if isinstance(content, list):
+        for part in content:
+            m = _marker_of(part)
+            if m is not None:
+                marker = m
+    return marker
+
+
+def extract_cache_control(body: dict) -> list[tuple[int, Optional[float]]]:
+    """Normalized anchors from a chat/messages request body:
+    ``[(message_index, ttl_secs_or_None), ...]`` sorted ascending,
+    deduped, at most MAX_ANCHORS (longest kept). For /v1/messages a
+    marked ``system`` (block list form) anchors at index -1 — "the
+    prefix before the first message", which the caller resolves against
+    the system-bearing rendered prompt."""
+    anchors: dict[int, Optional[float]] = {}
+    system = body.get("system")
+    if isinstance(system, list):
+        for block in system:
+            m = _marker_of(block)
+            if m is not None:
+                anchors[-1] = parse_ttl(m.get("ttl"))
+    messages = body.get("messages")
+    if isinstance(messages, list):
+        for i, msg in enumerate(messages):
+            m = _scan_message(msg)
+            if m is not None:
+                anchors[i] = parse_ttl(m.get("ttl"))
+        # Top-level marker: "the whole prompt is a stable prefix" —
+        # anchors at the last message.
+        m = _marker_of(body)
+        if m is not None and messages:
+            anchors[len(messages) - 1] = parse_ttl(m.get("ttl"))
+    out = sorted(anchors.items())
+    return out[-MAX_ANCHORS:]
+
+
+def strip_cache_control(body: dict) -> dict:
+    """Copy of `body` with every cache_control marker and the session_id
+    field removed — what the preprocessor sees, so a marked request
+    tokenizes/validates byte-identically to an unmarked one (the
+    unpinned-fallback contract)."""
+    out = {k: v for k, v in body.items()
+           if k not in ("cache_control", "session_id")}
+
+    def _strip_block(block):
+        if isinstance(block, dict) and "cache_control" in block:
+            return {k: v for k, v in block.items() if k != "cache_control"}
+        return block
+
+    for key in ("messages", "system"):
+        val = out.get(key)
+        if not isinstance(val, list):
+            continue
+        cleaned = []
+        for item in val:
+            item = _strip_block(item)
+            if isinstance(item, dict) and isinstance(item.get("content"),
+                                                     list):
+                item = {**item,
+                        "content": [_strip_block(p) for p in item["content"]]}
+            cleaned.append(item)
+        out[key] = cleaned
+    return out
+
+
+def session_id_of(body: dict, headers=None) -> Optional[str]:
+    """Session identity: x-dynt-session-id header wins over the
+    `session_id` body field. Bounded length — the id keys a sharded
+    store sized for millions of entries."""
+    sid = None
+    if headers is not None:
+        sid = headers.get(SESSION_HEADER)
+    if not sid:
+        sid = body.get("session_id")
+    if not isinstance(sid, str) or not sid:
+        return None
+    return sid[:256]
+
+
+def common_prefix_len(a: list[int], b: list[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+def resolve_anchor_tokens(
+    preprocessor,
+    chat_messages: list[dict],
+    anchors: list[tuple[int, Optional[float]]],
+    full_token_ids: list[int],
+) -> list[tuple[int, Optional[float]]]:
+    """Anchor message indices -> token prefix lengths against the FULL
+    tokenized prompt. Each marked prefix is re-rendered without the
+    generation prompt and tokenized; the longest common token prefix
+    with the full prompt is the anchor (tokenizer merges at the
+    boundary only shorten it — safe, never wrong). Returns
+    ``[(n_tokens, ttl), ...]`` ascending, zero-length anchors dropped."""
+    out: list[tuple[int, Optional[float]]] = []
+    for idx, ttl in anchors:
+        upto = chat_messages[: idx + 1] if idx >= 0 else []
+        if idx == -1:
+            # System anchor: the system message is messages[0] after
+            # _messages_to_chat lowering (when present).
+            upto = [m for m in chat_messages[:1]
+                    if m.get("role") == "system"]
+        if not upto:
+            continue
+        try:
+            prefix = preprocessor._template.render(
+                messages=upto, add_generation_prompt=False)
+            prefix_ids = preprocessor._encode_text(prefix)
+        except Exception:  # noqa: BLE001 — a template that cannot
+            # render a truncated list degrades to "no anchor", never 500s
+            continue
+        n = common_prefix_len(prefix_ids, full_token_ids)
+        if n > 0:
+            out.append((n, ttl))
+    # Dedupe equal token lengths (distinct markers can collapse after
+    # tokenization); keep ascending order.
+    seen: dict[int, Optional[float]] = {}
+    for n, ttl in out:
+        seen[n] = ttl
+    return sorted(seen.items())

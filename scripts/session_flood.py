@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Session-flood gate: >=100k concurrent synthetic sessions against an
+in-process router-replica pair, asserting bounded RSS (TinyLFU holds
+the radix index and session store under their caps) and pin-set
+convergence across replicas (dynamo_tpu/mocker/session_flood.py;
+docs/prompt-caching.md). Exit code gates the session-flood CI job; the
+JSON report uploads as an artifact.
+
+    python scripts/session_flood.py --sessions 100000 --out session-flood
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+    from dynamo_tpu.mocker.session_flood import main
+
+    sys.exit(main())

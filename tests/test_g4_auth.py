@@ -1,0 +1,262 @@
+"""G4 object-store auth: SigV4-style HMAC request signing + bearer mode
+against a signature-ENFORCING stub server that rejects unsigned,
+expired, unknown-key, and tampered requests (VERDICT missing #2 — the
+leg that lets pinned prefixes live in real cloud storage;
+docs/prompt-caching.md §G4 auth modes)."""
+
+import io
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.block_manager.layout import BlockLayoutSpec
+from dynamo_tpu.block_manager.storage import (
+    HttpObjectStoreClient,
+    ObjectStore,
+    sign_request,
+    verify_signature,
+)
+
+
+class _EnforcingHandler(BaseHTTPRequestHandler):
+    """Blob store that refuses anything not properly authenticated.
+
+    Modes (server attribute `auth_mode`): "hmac" verifies the signed
+    canonical string (known keys in `secrets`, replay window
+    `max_age_secs`); "bearer" matches a static token."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _reject(self, code: int, reason: str) -> None:
+        body = reason.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authenticate(self, body) -> bool:
+        srv = self.server
+        if srv.auth_mode == "bearer":
+            if self.headers.get("Authorization") != f"Bearer {srv.token}":
+                self._reject(403, "bad token")
+                return False
+            return True
+        reason = verify_signature(self.command, self.path, body,
+                                  self.headers, srv.secrets,
+                                  max_age_secs=srv.max_age_secs,
+                                  now=srv.now)
+        if reason is not None:
+            srv.rejections.append(reason)
+            self._reject(401 if reason == "unsigned" else 403, reason)
+            return False
+        return True
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def do_PUT(self):
+        body = self._read_body()
+        if not self._authenticate(body):
+            return
+        self.server.blobs[self.path] = body
+        self._reject(200, "ok")
+
+    def do_GET(self):
+        if not self._authenticate(b""):
+            return
+        blob = self.server.blobs.get(self.path)
+        if blob is None:
+            self._reject(404, "absent")
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_HEAD(self):
+        if not self._authenticate(b""):
+            return
+        self.send_response(200 if self.path in self.server.blobs else 404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._authenticate(b""):
+            return
+        self.server.blobs.pop(self.path, None)
+        self._reject(200, "ok")
+
+
+@pytest.fixture
+def stub():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _EnforcingHandler)
+    server.blobs = {}
+    server.rejections = []
+    server.auth_mode = "hmac"
+    server.secrets = {"test-key": "s3cr3t"}
+    server.token = "tok-123"
+    server.max_age_secs = 300.0
+    server.now = None  # real clock unless a test overrides
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+        server.server_close()
+
+
+def _url(server) -> str:
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _hmac_client(server) -> HttpObjectStoreClient:
+    return HttpObjectStoreClient(
+        _url(server),
+        auth={"mode": "hmac", "key_id": "test-key", "secret": "s3cr3t"})
+
+
+class TestVerifyUnit:
+    def test_roundtrip(self):
+        headers = sign_request("PUT", "/k/x", b"payload", "id", "sec")
+        assert verify_signature("PUT", "/k/x", b"payload", headers,
+                                {"id": "sec"}) is None
+
+    def test_tampered_body(self):
+        headers = sign_request("PUT", "/k/x", b"payload", "id", "sec")
+        assert verify_signature("PUT", "/k/x", b"EVIL", headers,
+                                {"id": "sec"}) in ("body-mismatch",
+                                                   "bad-signature")
+
+    def test_wrong_path_or_method(self):
+        headers = sign_request("PUT", "/k/x", b"p", "id", "sec")
+        assert verify_signature("PUT", "/k/OTHER", b"p", headers,
+                                {"id": "sec"}) == "bad-signature"
+        assert verify_signature("DELETE", "/k/x", b"p", headers,
+                                {"id": "sec"}) == "bad-signature"
+
+    def test_expired_and_unknown_key(self):
+        headers = sign_request("GET", "/k", None, "id", "sec",
+                               date="20200101T000000Z")
+        assert verify_signature("GET", "/k", None, headers,
+                                {"id": "sec"}) == "expired"
+        fresh = sign_request("GET", "/k", None, "ghost", "sec")
+        assert verify_signature("GET", "/k", None, fresh,
+                                {"id": "sec"}) == "unknown-key"
+
+    def test_unsigned(self):
+        assert verify_signature("GET", "/k", None, {}, {}) == "unsigned"
+
+
+class TestHmacAgainstStub:
+    def test_signed_roundtrip(self, stub):
+        client = _hmac_client(stub)
+        client.put_bytes("aa/blob.npy", b"\x01\x02\x03")
+        assert client.get_bytes("aa/blob.npy") == b"\x01\x02\x03"
+        assert client.exists("aa/blob.npy")
+        client.delete("aa/blob.npy")
+        assert not client.exists("aa/blob.npy")
+        assert stub.rejections == []
+
+    def test_unsigned_client_rejected(self, stub):
+        import urllib.error
+
+        plain = HttpObjectStoreClient(_url(stub), auth=None)
+        plain.auth = None  # force no auth regardless of env
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            plain.put_bytes("aa/x", b"data")
+        assert exc_info.value.code == 401
+        assert "unsigned" in stub.rejections
+
+    def test_wrong_secret_rejected(self, stub):
+        import urllib.error
+
+        bad = HttpObjectStoreClient(
+            _url(stub),
+            auth={"mode": "hmac", "key_id": "test-key", "secret": "WRONG"})
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            bad.get_bytes("aa/x")
+        assert exc_info.value.code == 403
+        assert "bad-signature" in stub.rejections
+
+    def test_expired_signature_rejected(self, stub):
+        import urllib.error
+
+        # Server clock pinned far ahead: every fresh signature is stale.
+        stub.now = 4102444800.0  # 2100-01-01
+        client = _hmac_client(stub)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            client.put_bytes("aa/x", b"d")
+        assert exc_info.value.code == 403
+        assert "expired" in stub.rejections
+
+    def test_objectstore_tier_through_signed_client(self, stub):
+        """The full G4 path: ObjectStore (retries, corrupt-read
+        quarantine, versioned keys) over the signed client against the
+        enforcing server."""
+        layout = BlockLayoutSpec(n_layers=1, total_kv_heads=1, head_dim=8,
+                                 page_size=4, dtype="float32")
+        store = ObjectStore(layout, _hmac_client(stub))
+        block = np.arange(np.prod(layout.block_shape),
+                          dtype=np.float32).reshape(layout.block_shape)
+        store.put(0xDEAD, block)
+        assert store.contains(0xDEAD)
+        out = store.get(0xDEAD)
+        np.testing.assert_array_equal(out, block)
+        store.delete(0xDEAD)
+        assert not store.contains(0xDEAD)
+        assert stub.rejections == []
+
+    def test_corrupt_blob_still_quarantined(self, stub):
+        """Auth and the corrupt-read path compose: a truncated signed
+        blob reads as a miss and is deleted server-side."""
+        layout = BlockLayoutSpec(n_layers=1, total_kv_heads=1, head_dim=8,
+                                 page_size=4, dtype="float32")
+        client = _hmac_client(stub)
+        store = ObjectStore(layout, client)
+        buf = io.BytesIO()
+        np.save(buf, np.zeros(3, np.float32))  # wrong shape blob
+        key = store._key(0xBEEF)
+        client.put_bytes(key, buf.getvalue())
+        assert store.get(0xBEEF) is None
+        assert store.corrupt_reads >= 1
+        assert not client.exists(key)
+
+
+class TestBearerAgainstStub:
+    def test_bearer_roundtrip_and_rejection(self, stub):
+        import urllib.error
+
+        stub.auth_mode = "bearer"
+        good = HttpObjectStoreClient(
+            _url(stub), auth={"mode": "bearer", "token": "tok-123"})
+        good.put_bytes("bb/x", b"hi")
+        assert good.get_bytes("bb/x") == b"hi"
+        bad = HttpObjectStoreClient(
+            _url(stub), auth={"mode": "bearer", "token": "nope"})
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            bad.get_bytes("bb/x")
+        assert exc_info.value.code == 403
+
+
+class TestEnvWiring:
+    def test_env_selects_hmac(self, monkeypatch, stub):
+        monkeypatch.setenv("DYNT_G4_AUTH", "hmac")
+        monkeypatch.setenv("DYNT_G4_HMAC_KEY_ID", "test-key")
+        monkeypatch.setenv("DYNT_G4_HMAC_SECRET", "s3cr3t")
+        client = HttpObjectStoreClient(_url(stub))
+        client.put_bytes("cc/x", b"env")
+        assert client.get_bytes("cc/x") == b"env"
+        assert stub.rejections == []
+
+    def test_env_default_unauthenticated(self, monkeypatch):
+        monkeypatch.delenv("DYNT_G4_AUTH", raising=False)
+        client = HttpObjectStoreClient("http://example.invalid")
+        assert client.auth is None

@@ -1,0 +1,805 @@
+"""Session tier: cache_control wire surface, PinLedger/SessionStore
+bounds, TinyLFU-in-indexer admission, KVBM pin leases, and the
+end-to-end cached-turn path (docs/prompt-caching.md)."""
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.kv_router.indexer import RadixTree
+from dynamo_tpu.kv_router.protocols import KvCacheStored, RouterEvent
+from dynamo_tpu.llm import ModelDeploymentCard, OpenAIPreprocessor
+from dynamo_tpu.session.store import PinLedger, SessionStore, SessionTier
+from dynamo_tpu.session.wire import (
+    MAX_ANCHORS,
+    extract_cache_control,
+    parse_ttl,
+    resolve_anchor_tokens,
+    session_id_of,
+    strip_cache_control,
+)
+
+
+def _card(**kwargs):
+    return ModelDeploymentCard(name="test-model", context_length=4096,
+                               **kwargs)
+
+
+# -- wire parsing -----------------------------------------------------------
+
+
+class TestWireParsing:
+    def test_parse_ttl_forms(self):
+        assert parse_ttl(120) == 120.0
+        assert parse_ttl("45") == 45.0
+        assert parse_ttl("5m") == 300.0
+        assert parse_ttl("2h") == 7200.0
+        assert parse_ttl("1.5m") == 90.0
+        assert parse_ttl(None) is None
+        assert parse_ttl("soon") is None
+        assert parse_ttl(0) is None
+        assert parse_ttl(True) is None
+
+    def test_message_level_marker(self):
+        body = {"messages": [
+            {"role": "system", "content": "sys",
+             "cache_control": {"type": "ephemeral"}},
+            {"role": "user", "content": "hi"},
+        ]}
+        assert extract_cache_control(body) == [(0, None)]
+
+    def test_content_part_marker(self):
+        body = {"messages": [
+            {"role": "user", "content": [
+                {"type": "text", "text": "big context"},
+                {"type": "text", "text": "tail",
+                 "cache_control": {"type": "ephemeral", "ttl": "2m"}},
+            ]},
+            {"role": "user", "content": "follow-up"},
+        ]}
+        assert extract_cache_control(body) == [(0, 120.0)]
+
+    def test_top_level_marker_anchors_last_message(self):
+        body = {"cache_control": {"type": "ephemeral"},
+                "messages": [{"role": "user", "content": "a"},
+                             {"role": "user", "content": "b"}]}
+        assert extract_cache_control(body) == [(1, None)]
+
+    def test_anthropic_system_block_marker(self):
+        body = {"system": [{"type": "text", "text": "instructions",
+                            "cache_control": {"type": "ephemeral"}}],
+                "messages": [{"role": "user", "content": "hi"}]}
+        assert extract_cache_control(body) == [(-1, None)]
+
+    def test_anchor_cap_keeps_longest(self):
+        body = {"messages": [
+            {"role": "user", "content": str(i),
+             "cache_control": {"type": "ephemeral"}}
+            for i in range(MAX_ANCHORS + 3)
+        ]}
+        anchors = extract_cache_control(body)
+        assert len(anchors) == MAX_ANCHORS
+        # Longest prefixes survive the cap.
+        assert [i for i, _ in anchors] == list(
+            range(3, MAX_ANCHORS + 3))
+
+    def test_non_ephemeral_marker_ignored(self):
+        body = {"messages": [{"role": "user", "content": "x",
+                              "cache_control": {"type": "permanent"}}]}
+        assert extract_cache_control(body) == []
+
+    def test_strip_removes_every_marker(self):
+        body = {
+            "model": "m", "session_id": "s1",
+            "cache_control": {"type": "ephemeral"},
+            "system": [{"type": "text", "text": "sys",
+                        "cache_control": {"type": "ephemeral"}}],
+            "messages": [
+                {"role": "user", "cache_control": {"type": "ephemeral"},
+                 "content": [{"type": "text", "text": "a",
+                              "cache_control": {"type": "ephemeral"}}]},
+            ],
+        }
+        clean = strip_cache_control(body)
+        assert "cache_control" not in clean and "session_id" not in clean
+        assert "cache_control" not in clean["system"][0]
+        assert "cache_control" not in clean["messages"][0]
+        assert "cache_control" not in clean["messages"][0]["content"][0]
+        # Original untouched (strip copies).
+        assert "cache_control" in body["messages"][0]
+
+    def test_strip_of_unmarked_body_is_identity(self):
+        body = {"model": "m",
+                "messages": [{"role": "user", "content": "hi"}]}
+        assert strip_cache_control(body) == body
+
+    def test_session_id_header_wins(self):
+        body = {"session_id": "from-body"}
+        assert session_id_of(body, {"x-dynt-session-id": "from-header"}) \
+            == "from-header"
+        assert session_id_of(body, {}) == "from-body"
+        assert session_id_of({}, {}) is None
+        assert len(session_id_of({"session_id": "x" * 999}, {})) == 256
+
+
+class TestAnchorResolution:
+    def test_anchor_is_prefix_of_full_prompt(self):
+        pre = OpenAIPreprocessor(_card())
+        messages = [{"role": "system", "content": "you are helpful " * 8},
+                    {"role": "user", "content": "question one"},
+                    {"role": "user", "content": "question two"}]
+        full = pre.preprocess_chat({"model": "m", "messages": messages,
+                                    "max_tokens": 8})
+        anchors = resolve_anchor_tokens(pre, messages, [(0, None), (1, 60.0)],
+                                        full.token_ids)
+        assert len(anchors) == 2
+        (n0, t0), (n1, t1) = anchors
+        assert 0 < n0 < n1 < len(full.token_ids)
+        assert t1 == 60.0
+
+    def test_marked_request_tokenizes_identically(self):
+        """The unpinned-fallback contract: markers change pinning, never
+        the token stream the model sees."""
+        pre = OpenAIPreprocessor(_card())
+        plain = {"model": "m", "max_tokens": 8,
+                 "messages": [{"role": "user", "content": "hello there"},
+                              {"role": "user", "content": "again"}]}
+        marked = {"model": "m", "max_tokens": 8, "session_id": "s",
+                  "messages": [{"role": "user", "content": "hello there",
+                                "cache_control": {"type": "ephemeral"}},
+                               {"role": "user", "content": "again"}]}
+        clean = strip_cache_control(marked)
+        assert pre.preprocess_chat(clean).token_ids == \
+            pre.preprocess_chat(plain).token_ids
+
+
+# -- pin ledger -------------------------------------------------------------
+
+
+class TestPinLedger:
+    def test_pin_and_ttl_expiry(self):
+        led = PinLedger(max_blocks=100)
+        lid = led.pin([1, 2, 3], ttl=10.0, now=0.0)
+        assert lid is not None
+        assert led.pinned(2)
+        assert led.expire(now=5.0) == []
+        released = led.expire(now=10.0)
+        assert sorted(released) == [1, 2, 3]
+        assert not led.pinned(2) and led.lease_count() == 0
+
+    def test_idempotent_repin_refreshes(self):
+        led = PinLedger(max_blocks=100)
+        led.pin([1, 2], ttl=10.0, lease_id="L", now=0.0)
+        led.pin([1, 2], ttl=10.0, lease_id="L", now=8.0)
+        assert led.lease_count() == 1 and led.block_count() == 2
+        assert led.expire(now=12.0) == []  # refreshed past the old expiry
+        assert sorted(led.expire(now=18.0)) == [1, 2]
+
+    def test_shared_prefix_refcounted(self):
+        led = PinLedger(max_blocks=100)
+        led.pin([1, 2], ttl=100.0, lease_id="A", now=0.0)
+        led.pin([1, 2, 3], ttl=100.0, lease_id="B", now=0.0)
+        assert led.unpin("A") is True
+        # 1,2 still covered by B.
+        assert led.pinned(1) and led.pinned(2)
+        assert led.unpin("B") is True
+        assert led.block_count() == 0
+
+    def test_lease_growth_same_id_swaps_atomically(self):
+        led = PinLedger(max_blocks=100)
+        led.pin([1, 2], ttl=100.0, lease_id="L", now=0.0)
+        led.pin([1, 2, 3, 4], ttl=100.0, lease_id="L", now=1.0)
+        assert led.lease_count() == 1
+        assert led.pinned(4) and led.pinned(1)
+        led.unpin("L")
+        assert led.block_count() == 0
+
+    def test_cap_refusal(self):
+        led = PinLedger(max_blocks=3)
+        assert led.pin([1, 2, 3], ttl=10.0, now=0.0) is not None
+        assert led.pin([4], ttl=10.0, now=0.0) is None  # refused
+        # Same blocks never count twice.
+        assert led.pin([1, 2], ttl=10.0, now=0.0) is not None
+
+    def test_ttl_clamped_to_system_ceiling(self, monkeypatch):
+        monkeypatch.setenv("DYNT_PIN_TTL_SECS", "50")
+        led = PinLedger(max_blocks=10)
+        led.pin([1], ttl=10_000.0, lease_id="L", now=0.0)
+        assert led.expire(now=49.0) == []
+        assert led.expire(now=50.0) == [1]
+
+    def test_release_hook_fires_once(self):
+        released = []
+        led = PinLedger(max_blocks=10, on_release=released.extend)
+        led.pin([1, 2], ttl=10.0, lease_id="A", now=0.0)
+        led.pin([2, 3], ttl=10.0, lease_id="B", now=0.0)
+        led.unpin("A")
+        assert released == [1]  # 2 still held by B
+        led.expire(now=10.0)
+        assert sorted(released) == [1, 2, 3]
+
+
+# -- session store ----------------------------------------------------------
+
+
+class TestSessionStore:
+    def test_affinity_roundtrip_and_ttl(self):
+        st = SessionStore(max_sessions=100, shards=4, ttl_secs=60.0)
+        st.touch("s1", worker_id=7, now=0.0)
+        assert st.get("s1", now=30.0).worker_id == 7
+        assert st.get("s1", now=100.0) is None  # idle expiry
+
+    def test_cap_with_tinylfu_admission(self):
+        st = SessionStore(max_sessions=4, shards=1, ttl_secs=0.0)
+        for i in range(4):
+            st.touch(f"hot{i}", now=0.0)
+        # Heat the residents.
+        for _ in range(3):
+            for i in range(4):
+                st.touch(f"hot{i}", now=1.0)
+        # A cold one-shot session cannot displace a hot one...
+        assert st.touch("cold", now=2.0) is None
+        assert st.evicted["rejected"] == 1
+        # ...but a repeat visitor earns admission (doorkeeper, then
+        # frequency parity with the LRU victim).
+        entry = None
+        for _ in range(8):
+            entry = st.touch("persistent", now=3.0)
+            if entry is not None:
+                break
+        assert entry is not None
+        assert len(st) == 4
+
+    def test_remove_worker_clears_residency_only(self):
+        st = SessionStore(max_sessions=10, shards=2, ttl_secs=0.0)
+        st.touch("s1", worker_id=5, prefix_hashes=[1, 2], now=0.0)
+        assert st.remove_worker_id(5) == 1
+        entry = st.get("s1", now=0.0)
+        assert entry.worker_id is None
+        assert entry.prefix_hashes == (1, 2)
+
+    def test_bounded_across_shards(self):
+        st = SessionStore(max_sessions=64, shards=8, ttl_secs=0.0)
+        for i in range(1000):
+            st.touch(f"s{i}", now=float(i))
+        assert len(st) <= 64
+
+
+# -- session tier (pin + reconcile) ----------------------------------------
+
+
+def _tier(**kwargs) -> SessionTier:
+    defaults = dict(
+        store=SessionStore(max_sessions=1000, shards=2, ttl_secs=600.0),
+        ledger=PinLedger(max_blocks=1000), mono_offset=0.0)
+    defaults.update(kwargs)
+    return SessionTier("test-model", block_size=16, **defaults)
+
+
+class _Req:
+    """Minimal PreprocessedRequest stand-in for register_request."""
+
+    def __init__(self, token_ids, session_id=None):
+        self.token_ids = token_ids
+        self.session_id = session_id
+        self.cache_anchors = []
+
+    def kv_salt(self):
+        return None
+
+
+class TestSessionTier:
+    def test_register_floors_to_full_blocks(self):
+        tier = _tier()
+        req = _Req(list(range(100)), session_id="s1")
+        pinned = tier.register_request(req, [(40, None), (90, None)],
+                                       now=0.0)
+        # 90 tokens -> 5 full blocks of 16.
+        assert len(pinned) == 5
+        assert tier.ledger.lease_count() == 2  # 40-token + 90-token anchors
+        assert tier.store.get("s1", now=0.0).prefix_hashes == tuple(pinned)
+
+    def test_sub_block_anchor_pins_nothing(self):
+        tier = _tier()
+        assert tier.register_request(_Req(list(range(100))), [(15, None)],
+                                     now=0.0) == []
+        assert tier.ledger.lease_count() == 0
+
+    def test_idempotent_repin_same_turn(self):
+        tier = _tier()
+        req = _Req(list(range(64)), session_id="s1")
+        tier.register_request(req, [(64, None)], now=0.0)
+        tier.register_request(req, [(64, None)], now=1.0)
+        assert tier.ledger.lease_count() == 1
+
+    def test_replicas_converge_through_events(self):
+        a, b = _tier(origin="a"), _tier(origin="b")
+        req = _Req(list(range(64)), session_id="s1")
+        a.register_request(req, [(64, "100")], now=0.0)
+        a.observe_routed("s1", worker_id=3, now=0.0)
+        for payload in a.drain_events():
+            assert b.apply_event(payload, now=0.5)
+        assert b.ledger.pinned_set() == a.ledger.pinned_set()
+        assert b.residency("s1", now=1.0) == 3
+        # Self-echoes are filtered.
+        b2 = _tier(origin="a")
+        req2 = _Req(list(range(32)), session_id="s2")
+        b2.register_request(req2, [(32, None)], now=0.0)
+        for payload in b2.drain_events():
+            assert b2.apply_event(payload) is False
+
+    def test_expired_pin_event_not_applied(self):
+        a, b = _tier(origin="a"), _tier(origin="b")
+        a.register_request(_Req(list(range(32)), session_id="s"),
+                           [(32, "10")], now=0.0)
+        events = a.drain_events()
+        pin_events = [e for e in events if e["op"] == "pin"]
+        assert pin_events
+        assert b.apply_event(pin_events[0], now=100.0) is False
+        assert b.ledger.lease_count() == 0
+
+    def test_lease_always_dies_at_ttl(self):
+        tier = _tier()
+        req = _Req(list(range(64)), session_id="s1")
+        tier.register_request(req, [(64, "30")], now=0.0)
+        assert tier.ledger.lease_count() == 1
+        tier.sweep(now=31.0)
+        assert tier.ledger.lease_count() == 0
+        assert tier.ledger.block_count() == 0
+
+
+# -- TinyLFU in the radix indexer ------------------------------------------
+
+
+def _stored(worker_id, event_id, hashes, parent=None):
+    return RouterEvent(worker_id=worker_id, event_id=event_id,
+                       stored=KvCacheStored(block_hashes=hashes,
+                                            parent_hash=parent))
+
+
+class TestIndexerAdmission:
+    def test_node_cap_held_exactly(self):
+        tree = RadixTree(max_tree_size=32, admission=True)
+        eid = 0
+        for i in range(100):
+            eid += 1
+            tree.apply_event(_stored(1, eid, [1000 + i]))
+        assert tree.total_nodes() <= 32
+
+    def test_hot_prefix_survives_cold_flood(self):
+        tree = RadixTree(max_tree_size=16, admission=True)
+        hot = list(range(1, 9))
+        eid = 0
+        for h in hot:
+            eid += 1
+            tree.apply_event(_stored(1, eid, [h]))
+        for _ in range(50):  # frequency evidence
+            for h in hot:
+                tree.find_matches([h])
+        for i in range(200):  # one-shot flood
+            eid += 1
+            tree.apply_event(_stored(1, eid, [5000 + i]))
+        assert tree.admission_rejected > 0
+        for h in hot:
+            assert tree.find_matches([h]).scores, f"hot {h} evicted"
+
+    def test_equal_evidence_rotates_oldest_first(self):
+        tree = RadixTree(max_tree_size=4, admission=True)
+        eid = 0
+        for i in range(4):
+            eid += 1
+            tree.apply_event(_stored(1, eid, [10 + i]))
+        # All cold (doorkeeper only): a fresh candidate with equal
+        # evidence displaces the OLDEST entry (>= admits).
+        eid += 1
+        tree.apply_event(_stored(1, eid, [99]))
+        assert tree.total_nodes() <= 4
+        assert tree.find_matches([99]).scores
+        assert not tree.find_matches([10]).scores  # oldest went
+
+    def test_rejected_chain_truncates_not_corrupts(self):
+        tree = RadixTree(max_tree_size=4, admission=True)
+        eid = 0
+        hot = [1, 2]
+        for h in hot:
+            eid += 1
+            tree.apply_event(_stored(1, eid, [h]))
+        for _ in range(40):
+            for h in hot:
+                tree.find_matches([h])
+        eid += 1
+        tree.apply_event(_stored(1, eid, [50, 51, 52, 53, 54]))
+        # Whatever was admitted, matching is contiguous-from-root.
+        scores = tree.find_matches([50, 51, 52, 53, 54])
+        depth = max(scores.scores.values(), default=0)
+        assert 0 <= depth <= 5
+        assert tree.total_nodes() <= 4
+
+    def test_hot_chain_not_wiped_and_no_orphans(self):
+        """Review regression: at the cap, extending a hot chain with a
+        cold block must neither wipe the chain (every evicted victim
+        gets its own frequency check) nor insert the new node under a
+        pruned parent (orphans are unmatchable forever)."""
+        tree = RadixTree(max_tree_size=4, admission=True)
+        tree.apply_event(_stored(1, 1, [1, 2, 3, 4]))
+        for _ in range(50):
+            tree.find_matches([1, 2, 3, 4])
+        tree.apply_event(_stored(1, 2, [5], parent=4))
+        # Cold candidate: the hot chain survives intact.
+        assert max(tree.find_matches([1, 2, 3, 4]).scores.values()) == 4
+        # Nothing unreachable squats in the node map (orphan guard).
+        reachable = set()
+        stack = [tree._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                reachable.add(child.hash)
+                stack.append(child)
+        assert set(tree._nodes) == reachable
+        assert tree.total_nodes() <= 4
+
+    def test_cold_chain_eviction_never_orphans(self):
+        """All-cold variant: the admission cascade may prune the very
+        parent the chain extends — the insert must truncate, leaving
+        only root-reachable nodes."""
+        tree = RadixTree(max_tree_size=4, admission=True)
+        tree.apply_event(_stored(1, 1, [1, 2, 3, 4]))
+        tree.apply_event(_stored(1, 2, [5], parent=4))
+        reachable = set()
+        stack = [tree._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                reachable.add(child.hash)
+                stack.append(child)
+        assert set(tree._nodes) == reachable
+        assert tree.total_nodes() <= 4
+
+    def test_admission_off_keeps_legacy_prune_path(self):
+        tree = RadixTree(max_tree_size=8)  # no admission
+        eid = 0
+        for i in range(20):
+            eid += 1
+            tree.apply_event(_stored(1, eid, [100 + i]))
+        evicted = tree.maintain()
+        assert tree.total_nodes() <= 8
+        assert evicted  # maintain pruned oldest down to target
+
+    def test_frequency_decays_with_sample_window(self):
+        # After enough traffic the sketch halves: old heat fades, new
+        # entries win again (no permanent incumbency).
+        tree = RadixTree(max_tree_size=8, admission=True)
+        eid = 0
+        for i in range(8):
+            eid += 1
+            tree.apply_event(_stored(1, eid, [i + 1]))
+        for _ in range(30):
+            for i in range(8):
+                tree.find_matches([i + 1])
+        # Massive new-key traffic forces sample resets (touches on
+        # lookups + admission attempts).
+        for i in range(6000):
+            eid += 1
+            tree.apply_event(_stored(1, eid, [10_000 + i]))
+            tree.find_matches([10_000 + i])
+        # Eventually newcomers displace the faded incumbents.
+        assert any(tree.find_matches([10_000 + i]).scores
+                   for i in range(5900, 6000))
+
+
+# -- KVBM pin leases --------------------------------------------------------
+
+
+class TestKvbmPins:
+    def _manager(self, tmp_path, host_blocks=4, disk_blocks=0):
+        from dynamo_tpu.block_manager import (
+            BlockLayoutSpec,
+            KvBlockManager,
+            KvbmConfig,
+        )
+
+        layout = BlockLayoutSpec(n_layers=1, total_kv_heads=1, head_dim=8,
+                                 page_size=4, dtype="float32")
+        cfg = KvbmConfig(host_blocks=host_blocks, disk_blocks=disk_blocks,
+                         disk_path=str(tmp_path / "g3.bin"),
+                         admission=False)
+        return KvBlockManager(cfg, layout), layout
+
+    def _block(self, layout, fill):
+        return np.full(layout.block_shape, fill, np.float32)
+
+    def test_pinned_block_survives_eviction_pressure(self, tmp_path):
+        mgr, layout = self._manager(tmp_path)
+        for h in range(1, 5):
+            mgr._offload_sink(h, self._block(layout, h), None)
+        mgr.pin_blocks([1], ttl=100.0, now=0.0)
+        for h in range(5, 12):  # pressure: would evict LRU (hash 1)
+            mgr._offload_sink(h, self._block(layout, h), None)
+        assert mgr.host.contains(1)  # pinned: held against eviction
+        assert not mgr.host.contains(2)  # unpinned LRU went
+
+    def test_lease_dies_at_ttl(self, tmp_path):
+        mgr, layout = self._manager(tmp_path)
+        mgr._offload_sink(1, self._block(layout, 1), None)
+        mgr.pin_blocks([1], ttl=50.0, now=0.0)
+        assert mgr.pinned_blocks() == 1
+        mgr.sweep_pins(now=51.0)
+        assert mgr.pinned_blocks() == 0
+        for h in range(2, 12):
+            mgr._offload_sink(h, self._block(layout, h), None)
+        assert not mgr.host.contains(1)  # evictable again
+
+    def test_pin_ahead_attaches_on_offload(self, tmp_path):
+        mgr, layout = self._manager(tmp_path)
+        mgr.pin_blocks([7], ttl=100.0, now=0.0)  # not tiered yet
+        mgr._offload_sink(7, self._block(layout, 7), None)
+        for h in range(20, 30):
+            mgr._offload_sink(h, self._block(layout, h), None)
+        assert mgr.host.contains(7)
+
+    def test_repin_refreshes_expiry(self, tmp_path):
+        mgr, layout = self._manager(tmp_path)
+        mgr._offload_sink(1, self._block(layout, 1), None)
+        mgr.pin_blocks([1], ttl=50.0, now=0.0)
+        mgr.pin_blocks([1], ttl=50.0, now=40.0)
+        mgr.sweep_pins(now=60.0)  # original expiry passed; refreshed holds
+        assert mgr.pinned_blocks() == 1
+        mgr.sweep_pins(now=91.0)
+        assert mgr.pinned_blocks() == 0
+
+    def test_prefetch_promotes_disk_to_host(self, tmp_path):
+        mgr, layout = self._manager(tmp_path, host_blocks=8, disk_blocks=8)
+        try:
+            mgr.disk.insert(42, self._block(layout, 42))
+            assert not mgr.host.contains(42)
+            mgr.prefetch([42])
+            for _ in range(100):
+                if mgr.host.contains(42):
+                    break
+                import time
+
+                time.sleep(0.02)
+            assert mgr.host.contains(42)
+        finally:
+            mgr.close()
+
+
+# -- end-to-end over HTTP ---------------------------------------------------
+
+
+def _cfg(cluster):
+    from dynamo_tpu.runtime import RuntimeConfig
+
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 1.0
+    return cfg
+
+
+async def _setup(cluster, n_workers=1, router_mode="kv",
+                 model="mock-model"):
+    from dynamo_tpu.frontend import Frontend
+    from dynamo_tpu.mocker import MockerConfig, MockerWorker
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    workers = []
+    for _ in range(n_workers):
+        rt = await DistributedRuntime(_cfg(cluster)).start()
+        worker = MockerWorker(
+            rt, model_name=model,
+            config=MockerConfig(speedup_ratio=500.0, num_blocks=512),
+            load_publish_interval=0.1,
+        )
+        await worker.start()
+        workers.append((rt, worker))
+    frt = await DistributedRuntime(_cfg(cluster)).start()
+    frontend = Frontend(frt, host="127.0.0.1", port=0,
+                        router_mode=router_mode)
+    await frontend.start()
+    for _ in range(100):
+        if frontend.manager.get(model) is not None:
+            break
+        await asyncio.sleep(0.05)
+    return frontend, frt, workers
+
+
+async def _teardown(frontend, frt, workers):
+    await frontend.close()
+    await frt.shutdown()
+    for rt, worker in workers:
+        await worker.close()
+        await rt.shutdown()
+
+
+async def _chat(port, body, headers=None):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json=body, headers=headers or {}) as resp:
+            return resp.status, await resp.json()
+
+
+class TestHttpSessionE2E:
+    def test_marked_chat_pins_and_unmarked_does_not(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            try:
+                entry = frontend.manager.get("mock-model")
+                long_text = "context " * 120  # > 1 block of tokens
+                status, _ = await _chat(frontend.port, {
+                    "model": "mock-model", "max_tokens": 4,
+                    "messages": [
+                        {"role": "user", "content": long_text,
+                         "cache_control": {"type": "ephemeral"}}],
+                })
+                assert status == 200
+                assert entry.session.ledger.lease_count() == 1
+                assert entry.session.ledger.block_count() > 0
+                before = entry.session.ledger.lease_count()
+                status, _ = await _chat(frontend.port, {
+                    "model": "mock-model", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": long_text}],
+                })
+                assert status == 200
+                # Unmarked request pinned nothing new.
+                assert entry.session.ledger.lease_count() == before
+            finally:
+                await _teardown(frontend, frt, workers)
+
+        run(body())
+
+    def test_idempotent_repin_over_http(self, run):
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            try:
+                entry = frontend.manager.get("mock-model")
+                req = {
+                    "model": "mock-model", "max_tokens": 4,
+                    "session_id": "sess-1",
+                    "messages": [
+                        {"role": "user", "content": "repeat " * 120,
+                         "cache_control": {"type": "ephemeral"}}],
+                }
+                for _ in range(3):
+                    status, _ = await _chat(frontend.port, req)
+                    assert status == 200
+                assert entry.session.ledger.lease_count() == 1
+            finally:
+                await _teardown(frontend, frt, workers)
+
+        run(body())
+
+    def test_messages_endpoint_system_marker(self, run):
+        async def body():
+            import aiohttp
+
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            try:
+                entry = frontend.manager.get("mock-model")
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                            f"http://127.0.0.1:{frontend.port}/v1/messages",
+                            json={
+                                "model": "mock-model", "max_tokens": 4,
+                                "system": [
+                                    {"type": "text",
+                                     "text": "instructions " * 120,
+                                     "cache_control": {
+                                         "type": "ephemeral"}}],
+                                "messages": [{"role": "user",
+                                              "content": "hi"}],
+                            },
+                            headers={"x-dynt-session-id": "anth-1"},
+                    ) as resp:
+                        assert resp.status == 200
+                assert entry.session.ledger.lease_count() == 1
+                assert entry.session.store.get("anth-1") is not None
+            finally:
+                await _teardown(frontend, frt, workers)
+
+        run(body())
+
+    def test_session_disabled_falls_back(self, run, monkeypatch):
+        monkeypatch.setenv("DYNT_SESSION_ENABLE", "0")
+
+        async def body():
+            frontend, frt, workers = await _setup(uuid.uuid4().hex)
+            try:
+                entry = frontend.manager.get("mock-model")
+                assert entry.session is None
+                status, _ = await _chat(frontend.port, {
+                    "model": "mock-model", "max_tokens": 4,
+                    "session_id": "s",
+                    "messages": [
+                        {"role": "user", "content": "hello",
+                         "cache_control": {"type": "ephemeral"}}],
+                })
+                # Markers are inert, not 400s.
+                assert status == 200
+            finally:
+                await _teardown(frontend, frt, workers)
+
+        run(body())
+
+    def test_cached_turn_routes_to_resident_worker(self, run):
+        """Acceptance: turn 2 of a pinned session lands on the worker
+        holding turn 1's KV, its TTFT path hits the prefix cache
+        (mocker prefill ledger), and the flight recorder carries the
+        session event + dynamo_session_* counters move."""
+
+        async def body():
+            from dynamo_tpu.runtime import metrics as rt_metrics
+            from dynamo_tpu.runtime.flight_recorder import get_recorder
+
+            frontend, frt, workers = await _setup(uuid.uuid4().hex,
+                                                  n_workers=2)
+            try:
+                entry = frontend.manager.get("mock-model")
+                hits0 = rt_metrics.SESSION_AFFINITY.labels(
+                    outcome="hit")._value.get()
+                long_text = "conversation context " * 80
+                headers = {"x-dynt-session-id": "agent-42"}
+                status, reply = await _chat(frontend.port, {
+                    "model": "mock-model", "max_tokens": 4,
+                    "messages": [
+                        {"role": "user", "content": long_text,
+                         "cache_control": {"type": "ephemeral"}}],
+                }, headers)
+                assert status == 200
+                resident = entry.session.store.get("agent-42").worker_id
+                assert resident is not None
+                by_id = {w.instance_id: w for _, w in workers}
+                prefill_before = by_id[resident].engine.prefill_tokens_total
+                # Wait for the worker's KV events to land in the radix
+                # index (the cached-turn TTFT path needs the overlap).
+                await asyncio.sleep(0.3)
+                turn2 = {
+                    "model": "mock-model", "max_tokens": 4,
+                    "messages": [
+                        {"role": "user", "content": long_text},
+                        {"role": "assistant",
+                         "content": reply["choices"][0]["message"]
+                         ["content"]},
+                        {"role": "user", "content": "short follow-up",
+                         "cache_control": {"type": "ephemeral"}}],
+                }
+                status, _ = await _chat(frontend.port, turn2, headers)
+                assert status == 200
+                # Residency held: turn 2 landed on the same worker.
+                assert entry.session.store.get(
+                    "agent-42").worker_id == resident
+                hits1 = rt_metrics.SESSION_AFFINITY.labels(
+                    outcome="hit")._value.get()
+                assert hits1 == hits0 + 1
+                # Prefix-cache hit: the resident worker prefilled far
+                # fewer tokens than turn 2's full prompt (most of it
+                # was turn 1's cached blocks).
+                turn2_tokens = len(entry.preprocessor.preprocess_chat(
+                    {k: v for k, v in turn2.items()
+                     if k != "session_id"}).token_ids)
+                prefill_delta = (by_id[resident].engine.prefill_tokens_total
+                                 - prefill_before)
+                assert 0 < prefill_delta < turn2_tokens * 0.7
+                # Flight recorder: both turns carry the session event.
+                snap = get_recorder().snapshot()
+                session_events = [
+                    ev for t in (snap.get("completed", [])
+                                 + snap.get("inflight", []))
+                    for ev in t.get("events", [])
+                    if ev.get("event") == "session"]
+                assert session_events
+                # Pins recorded for both anchors of the conversation.
+                assert entry.session.ledger.block_count() > 0
+            finally:
+                await _teardown(frontend, frt, workers)
+
+        run(body())

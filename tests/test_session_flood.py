@@ -1,0 +1,26 @@
+"""Session-flood scenario (CI runs 100k via scripts/session_flood.py;
+this tier-1 pass runs a 20k-session slice of the same assertions:
+bounded structures, pin-set convergence, hot-prefix survival —
+dynamo_tpu/mocker/session_flood.py)."""
+
+from dynamo_tpu.mocker.session_flood import FloodParams, run_flood
+
+
+class TestSessionFlood:
+    def test_flood_slice_holds_every_bound(self):
+        report = run_flood(FloodParams(
+            n_sessions=20_000, max_sessions=8_000, max_pin_blocks=60_000,
+            max_tree_nodes=10_000))
+        assert report["assertions"] == {
+            k: True for k in report["assertions"]}, report
+        # The caps actually engaged: this was a flood, not head-room.
+        assert report["sessions_a"] == 8_000
+        assert report["tree_admission_rejected_a"] > 0
+        assert report["pin_set_divergence"] == 0
+
+    def test_report_shape_for_artifact(self):
+        report = run_flood(FloodParams(
+            n_sessions=2_000, max_sessions=1_000, max_tree_nodes=2_000))
+        for key in ("rss_growth_bytes", "pinned_blocks_a", "tree_nodes_a",
+                    "assertions", "passed"):
+            assert key in report
