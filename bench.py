@@ -225,6 +225,24 @@ def bench_one(model: str, *, model_path: str | None = None,
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
     }
+    if weight_dtype == "int4":
+        # Record WHICH pack layout served the number (the v1/v2 kernels
+        # are A/B-able — docs/quantization.md): the version rides each
+        # leaf's dtype, so read it off the live params.
+        from dynamo_tpu.ops.q4_linear import pack_version
+        from dynamo_tpu.runtime.config import env as _cfg_env
+
+        versions = sorted({
+            pack_version(leaf["q4"])
+            for layer in runner.params["layers"]
+            for leaf in layer.values() if isinstance(leaf, dict)
+        })
+        result["q4_layout"] = {
+            "variant": ("mixed" if len(versions) > 1
+                        else f"v{versions[0]}"),
+            "group": int(_cfg_env("DYNT_Q4_GROUP")),
+            "policy": _cfg_env("DYNT_Q4_VARIANT"),
+        }
 
     # Speculative decode point (ROADMAP item 1 / ISSUE 7): the same
     # decode workload driven through the draftless speculation plane —
@@ -241,8 +259,16 @@ def bench_one(model: str, *, model_path: str | None = None,
     if do_spec and os.environ.get("DYNT_BENCH_SPEC", "1") != "0" \
             and getattr(runner, "supports_spec", False):
         from dynamo_tpu.engine.spec import NGramProposer
+        from dynamo_tpu.runtime.config import env as _spec_env
 
-        spec_k = int(os.environ.get("DYNT_BENCH_SPEC_K", "4"))
+        # BENCH_r06 capture prep: the serving path speculates at
+        # DYNT_SPEC_MAX_K when DYNT_SPEC_ENABLE is on (main() flips it
+        # for the flagship run), so the bench's k defaults to the SAME
+        # registered knob the scheduler reads — one `python bench.py`
+        # on silicon records the number the fleet would serve, with the
+        # knob state alongside the acceptance it produced.
+        spec_k = int(os.environ.get("DYNT_BENCH_SPEC_K")
+                     or _spec_env("DYNT_SPEC_MAX_K"))
         proposers = []
         sp_tokens = np.array(state["tokens"], np.int32).reshape(-1)
         sp_positions = np.full(batch, prompt_len + block, np.int32)
@@ -293,6 +319,8 @@ def bench_one(model: str, *, model_path: str | None = None,
         spec_elapsed = time.perf_counter() - t0
         result["spec"] = {
             "tokens_per_sec_per_chip": round(emitted / spec_elapsed, 1),
+            "spec_enable": bool(_spec_env("DYNT_SPEC_ENABLE")),
+            "max_k": int(_spec_env("DYNT_SPEC_MAX_K")),
             "k": spec_k,
             "steps": n_iter,
             "proposed": proposed,
@@ -734,6 +762,11 @@ def main() -> None:
     # One retry on the flagship: the dev chip is tunnel-attached and a
     # transient relay error (HTTP 500 from the remote-compile helper,
     # observed r5) must not cost the round its headline number.
+    # BENCH_r06 capture prep (ROADMAP item 1): speculation ON for the
+    # flagship serving block (the spec block records acceptance_rate and
+    # the DYNT_SPEC_MAX_K it ran) so spec, kvbm_offload, disagg, and
+    # q4_ablation are all captured by ONE `python bench.py` on silicon.
+    os.environ.setdefault("DYNT_SPEC_ENABLE", "1")
     try:
         result = bench_one("mistral-7b", kv_dtype="int8",
                            weight_dtype="int4", num_pages=448,
@@ -769,6 +802,21 @@ def main() -> None:
             # must survive a secondary-bench failure
             secondary.append({"metric": label, "error": repr(exc)})
     result["secondary"] = secondary
+    if os.environ.get("DYNT_BENCH_Q4_ABLATE", "1") != "0":
+        # Kernel-level decomposition of the flagship number: pack-layout
+        # variant x block-size sweep over the mistral-7b projection
+        # geometries, with per-point effective bandwidth (the same
+        # harness CI runs in interpret mode — scripts/q4_ablate.py).
+        try:
+            gc.collect()
+            jax.clear_caches()
+            from dynamo_tpu.perf.q4_ablation import run_ablation
+
+            result["q4_ablation"] = run_ablation(
+                mode="tpu", gks=(0, 2, 4))
+        except Exception as exc:  # noqa: BLE001 — an ablation failure
+            # must never cost the round its silicon numbers
+            result["q4_ablation"] = {"error": repr(exc)}
     if os.environ.get("DYNT_BENCH_DISAGG", "1") != "0":
         try:
             result["disagg"] = bench_disagg_point()

@@ -93,24 +93,69 @@ class BlockLayoutSpec:
         return self.kv_head_start, self.kv_head_start + self.kv_head_count
 
 
+def _check_bridgeable(src: BlockLayoutSpec, dst: BlockLayoutSpec) -> None:
+    if src.quantized != dst.quantized:
+        raise ValueError(
+            "cannot bridge a packed-int8 KV layout with an unquantized "
+            f"one ({src.kv_dtype!r} vs {dst.kv_dtype!r}): the per-token "
+            "scale state has no unquantized counterpart")
+    if (src.n_layers, src.page_size, src.head_dim, src.kv_dims) != (
+            dst.n_layers, dst.page_size, dst.head_dim, dst.kv_dims):
+        raise ValueError(f"incompatible layouts: {src} vs {dst}")
+    if src.quantized and src.scale_lanes != dst.scale_lanes:
+        raise ValueError(
+            f"incompatible scale-row widths: {src.scale_lanes} vs "
+            f"{dst.scale_lanes}")
+
+
+def _split_packed(
+    bundle: np.ndarray, spec: BlockLayoutSpec
+) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack a quantized bundle [n, value_bytes + scale_bytes] (the
+    gather_kv_blocks_q8 wire format) into the head-shaped int8 value
+    view [n, L, kv_dims, ps, kh, hd] and the opaque per-token scale
+    bytes [n, scale_bytes]. Pure reshape/views — no copies."""
+    if bundle.ndim != 2 or bundle.shape[1] != spec.block_shape[0]:
+        raise ValueError(
+            f"packed bundle shape {bundle.shape} does not match layout "
+            f"{spec.block_shape} (n_blocks x bytes expected)")
+    nv = (spec.n_layers * spec.kv_dims * spec.page_size
+          * spec.kv_head_count * spec.head_dim)
+    values = bundle[:, :nv].reshape(
+        bundle.shape[0], spec.n_layers, spec.kv_dims, spec.page_size,
+        spec.kv_head_count, spec.head_dim)
+    return values, bundle[:, nv:]
+
+
+def _join_packed(values: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.concatenate(
+        [values.reshape(values.shape[0], -1), scales], axis=1))
+
+
 def reslice(
     bundle: np.ndarray, src: BlockLayoutSpec, dst: BlockLayoutSpec
 ) -> np.ndarray:
     """Re-slice a universal block bundle from a source shard's head range to
     a destination shard's. The caller is responsible for assembling full
-    coverage when dst needs heads src doesn't hold (see `assemble`)."""
-    if src.quantized or dst.quantized:
-        # Packed quantized blocks are opaque bytes: same-geometry moves
-        # are identity; cross-TP reindexing would need an unpack/repack
-        # of the head-interleaved value bytes — out of scope for int8 v2
-        # (deploy heterogeneous-TP disagg pools with kv_dtype='model').
+    coverage when dst needs heads src doesn't hold (see `assemble`).
+
+    Quantized pools bridge too: the packed bytes unpack into the int8
+    value view, the kv-head axis reindexes exactly like the unquantized
+    path, and the bytes repack — bit-exact, no dequant/requant
+    roundtrip. The per-token scale rows are head-shared (one absmax per
+    token, lane-broadcast — models/transformer.py quantize_kv), so they
+    pass through verbatim whatever the head range."""
+    _check_bridgeable(src, dst)
+    if src.quantized:
         if src == dst:
             return bundle
-        raise NotImplementedError(
-            "cross-geometry reshard of packed int8 KV blocks")
-    if (src.n_layers, src.page_size, src.head_dim) != (
-            dst.n_layers, dst.page_size, dst.head_dim):
-        raise ValueError(f"incompatible layouts: {src} vs {dst}")
+        d0, d1 = dst.head_range()
+        s0, s1 = src.head_range()
+        if d0 < s0 or d1 > s1:
+            raise ValueError(
+                f"dst heads [{d0},{d1}) not covered by src [{s0},{s1})")
+        values, scales = _split_packed(bundle, src)
+        return _join_packed(values[..., d0 - s0 : d1 - s0, :], scales)
     d0, d1 = dst.head_range()
     s0, s1 = src.head_range()
     if d0 < s0 or d1 > s1:
@@ -127,13 +172,49 @@ def assemble(
 ) -> np.ndarray:
     """Build `dst`'s block bundle from several source shards (e.g. prefill
     TP=4 -> decode TP=8: each decode shard assembles from the one or two
-    prefill shards overlapping its head range)."""
+    prefill shards overlapping its head range).
+
+    Quantized shards assemble head-wise over the unpacked int8 value
+    views and repack. The per-token scale rows are head-shared and
+    replicated across TP shards (engine/model_runner.py places them
+    unsharded), so any covering shard supplies them — but every
+    covering shard must agree bit-exactly, or the bundle was quantized
+    inconsistently and silently mixing scales would corrupt the KV."""
     if dst.quantized:
         for spec, bundle in shards:
             if spec == dst:
                 return bundle
-        raise NotImplementedError(
-            "cross-geometry assembly of packed int8 KV blocks")
+        d0, d1 = dst.head_range()
+        n = shards[0][1].shape[0]
+        out = np.empty(
+            (n, dst.n_layers, dst.kv_dims, dst.page_size,
+             dst.kv_head_count, dst.head_dim), np.uint8)
+        covered = np.zeros(dst.kv_head_count, bool)
+        scales = None
+        for spec, bundle in shards:
+            _check_bridgeable(spec, dst)
+            if bundle.shape[0] != n:
+                raise ValueError(
+                    f"shard block counts disagree: {bundle.shape[0]} "
+                    f"vs {n}")
+            s0, s1 = spec.head_range()
+            lo, hi = max(d0, s0), min(d1, s1)
+            if lo >= hi:
+                continue
+            values, shard_scales = _split_packed(bundle, spec)
+            out[..., lo - d0 : hi - d0, :] = (
+                values[..., lo - s0 : hi - s0, :])
+            covered[lo - d0 : hi - d0] = True
+            if scales is None:
+                scales = shard_scales
+            elif not np.array_equal(scales, shard_scales):
+                raise ValueError(
+                    "covering shards carry disagreeing per-token scale "
+                    "rows; refusing to assemble a corrupt quantized "
+                    "bundle")
+        if not covered.all():
+            raise ValueError("source shards do not cover dst head range")
+        return _join_packed(out, scales)
     d0, d1 = dst.head_range()
     first = shards[0][1]
     out_shape = first.shape[:-2] + (dst.kv_head_count, dst.head_dim)

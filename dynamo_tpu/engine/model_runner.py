@@ -346,6 +346,16 @@ class ModelRunner:
                 donate_argnums=0,
             )(params)
         else:
+            if runner_config.weight_dtype == "int4":
+                # Transparent pack-layout migration: a v1-packed int4
+                # tree (old checkpoint / weight-service stream) repacks
+                # host-side to the DYNT_Q4_VARIANT target before
+                # placement; current-layout leaves pass through
+                # untouched (repack_params_q4 returns the same objects,
+                # so device arrays are never round-tripped for a no-op).
+                from ..models.quantize import repack_params_q4
+
+                params = repack_params_q4(params)
             # Host arrays (weight service / peer stream / checkpoint) or
             # device arrays: place each leaf under its sharding. For arrays
             # already placed correctly this is a no-op.

@@ -12,23 +12,44 @@ the VPU, and the MXU consumes bf16 tiles — so the bf16 (or even int8)
 weight never exists in HBM.
 
 Math: per-group asymmetric codes dequantize as (u - z) * s with s, z
-constant over each contracted group. The kernel processes whole groups
-per k-step, computing per group
-  acc += (x_blk @ u_blk - colsum(x_blk) * z_row) * s_row
-which equals x @ dequant(u) restricted to that group: the scale has no
-contracted axis within a group so it factors out of the partial dot,
-and the integer zero-point folds into a rank-1 correction instead of
-touching the weight tile (one fewer VPU pass over every element).
+constant over each contracted group. Within a group the scale has no
+contracted axis, so it factors out of the partial dot, and the integer
+zero-point folds into a rank-1 correction instead of touching the
+weight tile:
+  x @ dequant(u) == sum_g (x_g @ u_g - colsum(x_g) * z_g) * s_g
 
-Packed layout: within each group of `group` contracted rows, byte row r
-holds code row r in its LOW nibble and code row r + group//2 in its
-HIGH nibble. Unpacking is therefore two contiguous half-groups — no
-lane/sublane interleave inside the kernel, just two half-contraction
-dots against x's matching column halves.
+Two pack layouts coexist, selected by DYNT_Q4_VARIANT at quantize time
+and dispatched by the packed dtype (uint8 = v1, int8 = v2 — the version
+travels with the leaf, jit-static, no extra pytree field):
+
+v1 (half-block, uint8): within each group, byte row r holds code row r
+  in its LOW nibble and code row r + group//2 in its HIGH nibble.
+  Unpacking a group yields two half-group tiles, so the kernel pays two
+  half-contraction dots per group and a full [bm, bn] VPU pass per
+  group for the scale/zero epilogue, all through an int32 widen.
+
+v2 (VPU-swizzled global half-split, int8): byte row r of the WHOLE
+  packed array holds code row r (low nibble) and code row r + K/2
+  (high nibble), codes biased to signed (c = u - 8) so nibble
+  sign-extension is two int8 shifts — the q8_linear dequant idiom (one
+  narrow-int unpack, ONE convert per tile) instead of the v1 int32
+  mask/shift/convert pipeline. Each nibble tile of a k-block then IS a
+  contiguous run of whole groups in contracted order, so the k-step
+  collapses to one full-width dot per nibble tile (the unpack fuses
+  into the k-block contraction), the per-group scale rides the weight
+  tile, and the zero-point correction becomes one small
+  [bm, groups] x [groups, bn] MXU dot per tile instead of per-group
+  [bm, bn] VPU passes. Scale/zero rows are byte-identical to v1 (the
+  kernel subtracts the +8 bias inside the rank-1 term), which keeps
+  v1<->v2 repacking a pure transform of the code bytes — bit-exact
+  roundtrips by construction. v2 needs K % (2*group) == 0; smaller
+  weights (tests' tiny models) fall back to v1.
 
 The reference reaches this lever through its engines' 4-bit checkpoint
 modes (vLLM/TRT-LLM AWQ/GPTQ w4a16 paths); BASELINE.md names weight
-streaming as the decode floor at 7B.
+streaming as the decode floor at 7B. The variant x block-size ablation
+harness lives in dynamo_tpu/perf/q4_ablation.py (scripts/q4_ablate.py,
+bench.py's q4_ablation block).
 """
 
 from __future__ import annotations
@@ -50,6 +71,18 @@ from jax.experimental.pallas import tpu as pltpu
 # divisor of K.
 PACK_BLOCK = 256
 
+# Pack-layout versions (see module docstring). The version is encoded in
+# the packed dtype — uint8 = v1, int8 = v2 — so it is jit-static, rides
+# every pytree/wire hop for free, and q4_einsum carries it through all
+# five projection specs (including the flat wo) untouched.
+PACK_V1 = 1
+PACK_V2 = 2
+
+
+def pack_version(q4) -> int:
+    """Layout version of a packed-int4 leaf (dtype-encoded)."""
+    return PACK_V2 if q4.dtype == jnp.int8 else PACK_V1
+
 
 def _group_for(k: int) -> int:
     from ..runtime.config import env
@@ -64,6 +97,37 @@ def _group_for(k: int) -> int:
             "kernel")
     return g
 
+
+def resolve_pack_version(k: int, group: int | None = None,
+                         strict: bool = True) -> int:
+    """Pack layout for a weight with contracted size `k` under the
+    DYNT_Q4_VARIANT policy: auto = v2 wherever the global half-split is
+    well-formed (K divides 2*group), v1 otherwise; v1/v2 force the
+    layout. Forcing v2 on an incompatible K raises when `strict` (the
+    quantizer must not mis-pack) and falls back to v1 otherwise (the
+    load-time repack keeps such leaves as they are). An unknown mode
+    ALWAYS raises — a typo'd knob must not silently pick a layout."""
+    from ..runtime.config import env
+
+    g = group or _group_for(k)
+    mode = env("DYNT_Q4_VARIANT") or "auto"
+    if mode not in ("auto", "v1", "v2"):
+        raise ValueError(
+            f"unknown DYNT_Q4_VARIANT {mode!r} (expected auto|v1|v2)")
+    v2_ok = k % (2 * g) == 0
+    if mode == "v1":
+        return PACK_V1
+    if mode == "v2":
+        if not v2_ok:
+            if strict:
+                raise ValueError(
+                    f"DYNT_Q4_VARIANT=v2 needs K % (2*group) == 0 "
+                    f"(K={k}, group={g}); this weight only supports the "
+                    "v1 half-block layout")
+            return PACK_V1
+        return PACK_V2
+    return PACK_V2 if v2_ok else PACK_V1
+
 # Leaf name -> number of LEADING contracted axes (same registry shape as
 # q8_linear.QUANT_LEAVES; shared by the quantizer and model plumbing).
 QUANT_LEAVES = {
@@ -74,9 +138,9 @@ QUANT_LEAVES = {
 
 
 def _pack_codes(u: jnp.ndarray, group: int) -> jnp.ndarray:
-    """uint8 codes [K, N] in [0, 15] -> packed uint8 [K//2, N] in the
-    half-block layout (byte row r of each group holds code rows r and
-    r + group//2)."""
+    """v1: uint8 codes [K, N] in [0, 15] -> packed uint8 [K//2, N] in
+    the half-block layout (byte row r of each group holds code rows r
+    and r + group//2)."""
     k, n = u.shape
     half = group // 2
     blk = u.reshape(k // group, group, n)
@@ -94,19 +158,48 @@ def _unpack_codes(packed: jnp.ndarray, group: int) -> jnp.ndarray:
     return jnp.concatenate([lo, hi], axis=1).reshape(k2 * 2, n)
 
 
-def quantize_weight_q4(w: jax.Array, n_contract: int) -> dict:
+def _pack_codes_v2(u: jnp.ndarray) -> jnp.ndarray:
+    """v2: uint8 codes [K, N] in [0, 15] -> packed int8 [K//2, N] in the
+    global half-split layout: byte row r holds code row r (low nibble)
+    and code row r + K//2 (high nibble), both biased to signed
+    two's-complement nibbles (c = u - 8, and (u - 8) & 0xF ==
+    (u + 8) & 0xF mod 16)."""
+    k, n = u.shape
+    half = k // 2
+    lo = (u[:half].astype(jnp.int32) + 8) & 0xF
+    hi = (u[half:].astype(jnp.int32) + 8) & 0xF
+    return jax.lax.bitcast_convert_type(
+        (lo | (hi << 4)).astype(jnp.uint8), jnp.int8)
+
+
+def _unpack_codes_v2(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of _pack_codes_v2 -> UNSIGNED codes [K, N] in [0, 15]
+    (reference path / tests; u = nibble ^ 8 undoes the sign bias)."""
+    b = jax.lax.bitcast_convert_type(packed, jnp.uint8)
+    lo = (b & 0xF) ^ 8
+    hi = (b >> 4) ^ 8
+    return jnp.concatenate([lo, hi], axis=0)
+
+
+def quantize_weight_q4(w: jax.Array, n_contract: int,
+                       version: int | None = None) -> dict:
     """Asymmetric per-group int4 over the contracted axes.
 
-    Returns {"q4": packed uint8, "qs4": f32 [K//group, N], "qz4": f32
-    [K//group, N]}. q4 keeps the weight's output axes when a single
-    leading axis is contracted ([K//2, *out_axes]); multi-axis
+    Returns {"q4": packed uint8 (v1) / int8 (v2), "qs4": f32
+    [K//group, N], "qz4": f32 [K//group, N]}. The scale/zero rows are
+    identical across layouts (only the code bytes differ), so v1<->v2
+    repacking never touches them. q4 keeps the weight's output axes when
+    a single leading axis is contracted ([K//2, *out_axes]); multi-axis
     contractions (wo) flatten to 2-D [K//2, N] because pack groups span
-    head boundaries.
+    head boundaries. `version` None follows DYNT_Q4_VARIANT
+    (resolve_pack_version).
     """
     out_axes = w.shape[n_contract:]
     k = int(np.prod(w.shape[:n_contract]))
     n = int(np.prod(out_axes)) if out_axes else 1
     group = _group_for(k)
+    if version is None:
+        version = resolve_pack_version(k, group)
     w2 = jnp.asarray(w, jnp.float32).reshape(k, n)
     grp = w2.reshape(k // group, group, n)
     lo = jnp.min(grp, axis=1)
@@ -122,7 +215,14 @@ def quantize_weight_q4(w: jax.Array, n_contract: int) -> dict:
     codes = jnp.clip(
         jnp.round(grp / safe[:, None, :]) + zero[:, None, :], 0.0, 15.0
     ).reshape(k, n).astype(jnp.uint8)
-    q4 = _pack_codes(codes, group)
+    if version == PACK_V2:
+        if k % (2 * group):
+            raise ValueError(
+                f"pack layout v2 needs K % (2*group) == 0 (K={k}, "
+                f"group={group})")
+        q4 = _pack_codes_v2(codes)
+    else:
+        q4 = _pack_codes(codes, group)
     if n_contract == 1 and out_axes:
         q4 = q4.reshape((k // 2,) + out_axes)
     # Store the CLAMPED scale: the zero-point was computed against it,
@@ -130,6 +230,82 @@ def quantize_weight_q4(w: jax.Array, n_contract: int) -> dict:
     # (u - z)*safe = u*eps + lo, not (u - z)*0 = 0.
     return {"q4": q4, "qs4": safe.astype(jnp.float32),
             "qz4": zero.astype(jnp.float32)}
+
+
+# -- host-side repack (checkpoint migration; pure numpy, no device) -----
+
+
+def _np_unpack_v1(q2: np.ndarray, group: int) -> np.ndarray:
+    k2, n = q2.shape
+    half = group // 2
+    blk = q2.reshape(k2 // half, half, n)
+    return np.concatenate([blk & 0xF, blk >> 4], axis=1).reshape(
+        k2 * 2, n).astype(np.uint8)
+
+
+def _np_pack_v1(u: np.ndarray, group: int) -> np.ndarray:
+    k, n = u.shape
+    half = group // 2
+    blk = u.reshape(k // group, group, n)
+    return (blk[:, :half] | (blk[:, half:] << 4)).reshape(
+        k // 2, n).astype(np.uint8)
+
+
+def _np_unpack_v2(packed: np.ndarray) -> np.ndarray:
+    b = packed.view(np.uint8)
+    return np.concatenate([(b & 0xF) ^ 8, (b >> 4) ^ 8],
+                          axis=0).astype(np.uint8)
+
+
+def _np_pack_v2(u: np.ndarray) -> np.ndarray:
+    k, n = u.shape
+    half = k // 2
+    lo = (u[:half].astype(np.int32) + 8) & 0xF
+    hi = (u[half:].astype(np.int32) + 8) & 0xF
+    return (lo | (hi << 4)).astype(np.uint8).view(np.int8)
+
+
+def repack_q4_leaf(leaf: dict, version: int | None = None) -> dict:
+    """Host-side layout migration of one quantized leaf. `version` None
+    follows DYNT_Q4_VARIANT (auto keeps v1 where v2's half-split is not
+    well-formed). Scale/zero rows pass through untouched and the code
+    transform is a bijection on nibbles, so v1 -> v2 -> v1 roundtrips
+    bit-exactly. Returns the SAME dict when no repack is needed (device
+    leaves are never pulled to host for a no-op)."""
+    q4 = leaf["q4"]
+    cur = pack_version(q4)
+    k2 = q4.shape[0]
+    k = k2 * 2
+    qs4 = leaf["qs4"]
+    group = k // qs4.shape[0]
+    if version is None:
+        # non-strict: a forced variant this K can't take keeps the leaf
+        # as-is; an unknown DYNT_Q4_VARIANT still raises.
+        version = resolve_pack_version(k, group, strict=False)
+    if version == cur:
+        return leaf
+    n = int(np.prod(q4.shape[1:]))
+    q2 = np.asarray(q4).reshape(k2, n)
+    if version == PACK_V2:
+        if k % (2 * group):
+            raise ValueError(
+                f"cannot repack to v2: K % (2*group) != 0 (K={k}, "
+                f"group={group})")
+        out = _np_pack_v2(_np_unpack_v1(q2, group))
+    else:
+        out = _np_pack_v1(_np_unpack_v2(q2), group)
+    return {"q4": out.reshape(q4.shape), "qs4": qs4, "qz4": leaf["qz4"]}
+
+
+def _compiler_params():
+    """Mosaic compiler params across jax versions (CompilerParams landed
+    after TPUCompilerParams; interpret mode ignores them either way)."""
+    semantics = ("parallel", "parallel", "arbitrary")
+    if hasattr(pltpu, "CompilerParams"):
+        return pltpu.CompilerParams(dimension_semantics=semantics)
+    if hasattr(pltpu, "TPUCompilerParams"):
+        return pltpu.TPUCompilerParams(dimension_semantics=semantics)
+    return None
 
 
 def _q4_matmul_kernel(group, gk, x_ref, wp_ref, s_ref, z_ref, o_ref,
@@ -170,20 +346,99 @@ def _q4_matmul_kernel(group, gk, x_ref, wp_ref, s_ref, z_ref, o_ref,
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def _q4_matmul_kernel_v2(group, gh, x_lo_ref, x_hi_ref, wp_ref,
+                         s_lo_ref, s_hi_ref, z_lo_ref, z_hi_ref, o_ref,
+                         acc_ref):
+    """v2: the packed tile's nibbles ARE contracted order (low nibbles =
+    `gh` whole groups of the low K-half, high nibbles = the matching
+    groups of the high K-half), so each k-step is two full-width dots.
+    Unpack rides the q8 idiom — two int8 shifts (sign-extending the
+    biased nibbles), ONE convert per tile — and the per-group scale
+    rides the weight tile while the zero-point (incl. the -8 bias
+    absorbed by the signed codes) folds into one small
+    [bm, gh] x [gh, bn] dot per tile."""
+    k = pl.program_id(2)
+    kb2 = group * gh
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    w8 = wp_ref[:]  # [kb2, bn] int8: two signed nibbles per byte
+    lo = jnp.right_shift(jnp.left_shift(w8, 4), 4)  # sign-extended low
+    hi = jnp.right_shift(w8, 4)                     # arithmetic shift
+    bn = o_ref.shape[1]
+    for x_ref, s_ref, z_ref, codes in (
+            (x_lo_ref, s_lo_ref, z_lo_ref, lo),
+            (x_hi_ref, s_hi_ref, z_hi_ref, hi)):
+        x = x_ref[:]
+        s = s_ref[:].astype(jnp.float32)  # [gh, 1, bn]
+        z = z_ref[:].astype(jnp.float32)
+        # One convert per nibble tile; the scale broadcasts over each
+        # group's sublanes and lands on the weight tile, so the dot
+        # spans all `gh` groups at once.
+        sw = jnp.broadcast_to(s, (gh, group, bn)).reshape(kb2, bn)
+        u = codes.astype(x.dtype) * sw.astype(x.dtype)
+        part = jax.lax.dot_general(
+            x, u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # Rank-1 zero-point for all gh groups as ONE small MXU dot:
+        # per-group colsums via a 0/1 block-diagonal mask, then
+        # [bm, gh] x [gh, bn] against the (z - 8) * s rows (the signed
+        # codes are u - 8, so the stored v1-convention zero row shifts
+        # by the same bias here instead of at pack time — repacks stay
+        # bit-exact).
+        rows = jax.lax.broadcasted_iota(jnp.int32, (kb2, gh), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (kb2, gh), 1)
+        gmask = (rows // group == cols).astype(x.dtype)
+        xsum = jax.lax.dot_general(
+            x, gmask, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        zs = ((z - 8.0) * s).reshape(gh, bn)
+        acc_ref[:] += part - jax.lax.dot_general(
+            xsum, zs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bm", "bn", "gk", "interpret"))
 def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
               zero: jax.Array, bm: int = 256, bn: int = 1024,
-              interpret: bool = False) -> jax.Array:
+              gk: int = 0, interpret: bool = False) -> jax.Array:
     """x [M, K] (bf16/f32) @ packed-int4 [K//2, N] with per-group
     scale/zero [K//group, N] -> [M, N] in x.dtype. The group (and the
-    kernel's k-block) is inferred from the scale shape."""
+    kernel's k-block) is inferred from the scale shape; the kernel
+    variant is dispatched from the packed dtype (uint8 = v1 half-block,
+    int8 = v2 swizzled — see module docstring). `gk` overrides the
+    groups contracted per k-step (0 = auto; the ablation harness sweeps
+    it)."""
     m, k2 = x.shape[0], q4.shape[0]
     k = k2 * 2
     n = q4.shape[1]
-    assert x.shape[1] == k, (x.shape, q4.shape)
+    # Explicit raises (not asserts): geometry validation must survive
+    # python -O, exactly like the lane-divisibility error below.
+    if x.shape[1] != k:
+        raise ValueError(
+            f"q4_matmul: x columns must equal 2 * packed rows "
+            f"(x {x.shape}, q4 {q4.shape})")
+    if k % scale.shape[0]:
+        raise ValueError(
+            f"q4_matmul: scale rows must divide K (K={k}, "
+            f"scale {scale.shape})")
     group = k // scale.shape[0]
-    assert scale.shape == (k // group, n) and k % group == 0, scale.shape
-    assert zero.shape == scale.shape, zero.shape
+    if scale.shape != (k // group, n):
+        raise ValueError(
+            f"q4_matmul: scale must be [K//group, N] "
+            f"(got {scale.shape}, expected {(k // group, n)})")
+    if zero.shape != scale.shape:
+        raise ValueError(
+            f"q4_matmul: zero must match scale shape "
+            f"(zero {zero.shape}, scale {scale.shape})")
+    version = pack_version(q4)
     bm = min(bm, max(16, 1 << max(0, m - 1).bit_length()))
     mp = -(-m // bm) * bm
     if mp != m:
@@ -197,15 +452,52 @@ def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
             f"q4_matmul needs 128-lane-divisible geometry (N={n}); "
             "this weight cannot take the W4A16 kernel")
     # Process several groups per k-block: bigger DMA tiles amortize the
-    # grid and let Mosaic double-buffer the packed stream.
-    gk = 1
-    while gk < 32 and k % (group * gk * 2) == 0:
-        gk *= 2
+    # grid and let Mosaic double-buffer the packed stream. A k-step
+    # contracts group*gk codes for either variant (v2 splits them as
+    # gk/2 whole groups per nibble tile, so it needs gk even).
+    if gk:
+        if k % (group * gk):
+            raise ValueError(
+                f"q4_matmul: gk={gk} does not divide the contraction "
+                f"(K={k}, group={group})")
+        if version == PACK_V2 and gk % 2:
+            raise ValueError(
+                f"q4_matmul: the v2 layout needs an even gk (got {gk})")
+    else:
+        gk = 1
+        while gk < 32 and k % (group * gk * 2) == 0:
+            gk *= 2
     # Mosaic requires the sublane block dim to divide 8 or equal the
     # array dim: give the per-group rows a unit middle axis so each
-    # (gk, 1, bn) block spans full (singleton) sublane dimensions.
+    # scale/zero block spans full (singleton) sublane dimensions.
     s3 = scale.reshape(k // group, 1, n)
     z3 = zero.reshape(k // group, 1, n)
+    if version == PACK_V2:
+        gh = gk // 2
+        kb2 = group * gh  # packed byte rows (= codes per nibble tile)
+        nk = (k // 2) // kb2
+        out = pl.pallas_call(
+            functools.partial(_q4_matmul_kernel_v2, group, gh),
+            grid=(mp // bm, n // bn, nk),
+            in_specs=[
+                pl.BlockSpec((bm, kb2), lambda mi, ni, ki: (mi, ki)),
+                pl.BlockSpec((bm, kb2),
+                             lambda mi, ni, ki, nk=nk: (mi, ki + nk)),
+                pl.BlockSpec((kb2, bn), lambda mi, ni, ki: (ki, ni)),
+                pl.BlockSpec((gh, 1, bn), lambda mi, ni, ki: (ki, 0, ni)),
+                pl.BlockSpec((gh, 1, bn),
+                             lambda mi, ni, ki, nk=nk: (ki + nk, 0, ni)),
+                pl.BlockSpec((gh, 1, bn), lambda mi, ni, ki: (ki, 0, ni)),
+                pl.BlockSpec((gh, 1, bn),
+                             lambda mi, ni, ki, nk=nk: (ki + nk, 0, ni)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
+            out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+            compiler_params=_compiler_params(),
+            interpret=interpret,
+        )(x, x, q4, s3, s3, z3, z3)
+        return out[:m]
     out = pl.pallas_call(
         functools.partial(_q4_matmul_kernel, group, gk),
         grid=(mp // bm, n // bn, k // (group * gk)),
@@ -219,8 +511,7 @@ def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
         out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
         out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(x, q4, s3, z3)
     return out[:m]
@@ -228,11 +519,16 @@ def q4_matmul(x: jax.Array, q4: jax.Array, scale: jax.Array,
 
 def dequantize_q4(q4: jax.Array, scale: jax.Array,
                   zero: jax.Array) -> jax.Array:
-    """Full-precision reconstruction [K, N] f32 (tests / ref path)."""
+    """Full-precision reconstruction [K, N] f32 (tests / ref path);
+    dispatches the unpack on the layout version like the kernel."""
     k2 = q4.shape[0]
     n = int(np.prod(q4.shape[1:]))
     group = (k2 * 2) // scale.shape[0]
-    u = _unpack_codes(q4.reshape(k2, n), group).astype(jnp.float32)
+    q2 = q4.reshape(k2, n)
+    if pack_version(q4) == PACK_V2:
+        u = _unpack_codes_v2(q2).astype(jnp.float32)
+    else:
+        u = _unpack_codes(q2, group).astype(jnp.float32)
     s = jnp.repeat(scale.reshape(-1, n), group, axis=0)
     z = jnp.repeat(zero.reshape(-1, n), group, axis=0)
     return (u - z) * s
@@ -241,7 +537,7 @@ def dequantize_q4(q4: jax.Array, scale: jax.Array,
 def q4_matmul_ref(x: jax.Array, q4: jax.Array, scale: jax.Array,
                   zero: jax.Array) -> jax.Array:
     """XLA reference: materializes the dequantized weight (correctness
-    path, not the perf path)."""
+    path, not the perf path). Layout-agnostic via dequantize_q4."""
     w = dequantize_q4(q4, scale, zero)
     acc = jax.lax.dot_general(
         x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
@@ -261,7 +557,10 @@ def _use_pallas() -> bool:
 def q4_einsum(spec: str, x: jax.Array, q4: jax.Array, qs4: jax.Array,
               qz4: jax.Array) -> jax.Array:
     """Quantized drop-in for the transformer's dense einsums (mirror of
-    q8_linear.q8_einsum over the packed-int4 leaves)."""
+    q8_linear.q8_einsum over the packed-int4 leaves). The pack-layout
+    version rides the q4 dtype through every reshape, so all five
+    projection specs (including the flat wo) dispatch the right kernel
+    variant without extra plumbing."""
     if spec in ("bth,hm->btm", "btm,mh->bth", "bth,hv->btv"):
         b, t, k = x.shape
         out_shape = (b, t, q4.shape[1])

@@ -1,0 +1,217 @@
+"""W4A16 kernel ablation harness (ROADMAP item 1: recover the int4
+roofline to >= 0.5 of HBM bandwidth).
+
+Sweeps pack-layout variant x (bm, bn, gk) x the flagship projection
+geometries and emits one machine-readable JSON report. The same harness
+runs in two modes:
+
+  interpret (CI, any backend): tiny geometry grid, parity-only — every
+    kernel variant is checked against q4_matmul_ref within the kernel
+    test tolerances, so a layout/kernel regression fails the q4-parity
+    CI job before it ever reaches silicon.
+
+  tpu (BENCH_r06's `q4_ablation` block, bench.py): the mistral-7b
+    projection shapes at the decode batch, timed on the chip with an
+    effective-bandwidth readout (bytes actually streamed per call /
+    measured time vs the chip's HBM roofline) — the per-kernel
+    decomposition of the flagship `vs_baseline` number.
+
+One command either way: `python scripts/q4_ablate.py [--interpret]`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+# Report schema version + the silicon acceptance bar this harness exists
+# to prove (BENCH_r06: flagship decode vs_baseline >= 0.5 — the
+# reference's w4a16 engine paths sit at 0.5-0.7 of their roofline).
+SCHEMA_VERSION = 1
+SILICON_TARGET = {
+    "flagship_vs_baseline_min": 0.5,
+    "note": "mistral-7b kv=int8 w=int4 decode, fraction of the HBM "
+            "roofline (bench.py vs_baseline); round-5 shipped 0.443 on "
+            "the v1 layout",
+}
+
+# Deduped flagship (mistral-7b) projection contractions [K, N]: the qkv
+# head projections share K with the attention out/gate/up stack, so the
+# distinct shapes are few. M defaults to the decode batch bench.py runs.
+FLAGSHIP_GEOMS = (
+    ("wq/wo", 4096, 4096),
+    ("wkv", 4096, 1024),
+    ("w_gate/w_up", 4096, 14336),
+    ("w_down", 14336, 4096),
+    ("lm_head", 4096, 32768),
+)
+
+# Interpret-mode grid: small enough for CI, still covering the v2
+# half-split boundaries (K == 2*group minimal case), a multi-k-step
+# shape, and a lane-minimal N.
+TINY_GEOMS = (
+    ("k512", 512, 512),
+    ("k1024", 1024, 256),
+    ("n128", 512, 128),
+)
+
+
+def _parity(out: np.ndarray, ref: np.ndarray) -> dict:
+    err = np.abs(out.astype(np.float64) - ref.astype(np.float64))
+    denom = max(float(np.sqrt(np.mean(ref.astype(np.float64) ** 2))),
+                1e-12)
+    return {
+        "max_abs_err": float(np.max(err)) if err.size else 0.0,
+        "rel_rms_err": float(np.sqrt(np.mean(err ** 2)) / denom),
+    }
+
+
+def _effective_tiles(m: int, n: int, bm: int, bn: int) -> tuple[int, int]:
+    """Mirror q4_matmul's internal block clamping, so the report labels
+    the tiles the kernel actually RAN (and duplicate requested configs
+    collapsing to the same effective tile run once)."""
+    bm = min(bm, max(16, 1 << max(0, m - 1).bit_length()))
+    b = min(bn, n)
+    while b > 128 and n % b:
+        b //= 2
+    return bm, b
+
+
+def run_ablation(
+    mode: str = "auto",
+    m: int = 8,
+    variants: Sequence[str] = ("v1", "v2"),
+    bms: Sequence[int] = (256,),
+    bns: Sequence[int] = (512, 1024),
+    gks: Sequence[int] = (0,),
+    geoms: Optional[Sequence[tuple[str, int, int]]] = None,
+    trials: int = 3,
+    steps: int = 16,
+    seed: int = 0,
+    atol: float = 2e-3,
+    rel_tol: float = 2e-2,
+) -> dict:
+    """Run the sweep; returns the report dict (see module docstring).
+
+    mode: "interpret" forces the Pallas interpreter (parity-only),
+    "tpu" requires the TPU backend and times each point, "auto" picks
+    by jax.default_backend(). gk=0 lets the kernel choose its k-block.
+    Parity gates on max_abs_err <= atol for f32 activations (interpret)
+    and on rel_rms_err <= rel_tol for bf16 (tpu): a flagship-geometry
+    bf16 output ULP exceeds any absolute tolerance, so the silicon gate
+    must be relative.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.q4_linear import (
+        PACK_V1,
+        PACK_V2,
+        q4_matmul,
+        q4_matmul_ref,
+        quantize_weight_q4,
+    )
+    from ..runtime.config import env
+
+    backend = jax.default_backend()
+    if mode == "auto":
+        mode = "tpu" if backend == "tpu" else "interpret"
+    interpret = mode != "tpu"
+    if geoms is None:
+        geoms = TINY_GEOMS if interpret else FLAGSHIP_GEOMS
+    group_pref = int(env("DYNT_Q4_GROUP") or 256)
+    rng = np.random.default_rng(seed)
+    results: list[dict] = []
+    for label, k, n in geoms:
+        x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32
+                        if interpret else jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+        ref = None
+        for variant in variants:
+            version = PACK_V2 if variant == "v2" else PACK_V1
+            try:
+                qw = quantize_weight_q4(w, 1, version=version)
+            except ValueError as exc:
+                results.append({"geom": label, "k": k, "n": n, "m": m,
+                                "variant": variant,
+                                "skipped": str(exc)})
+                continue
+            if ref is None:
+                # The two layouts dequantize bit-identically, so one
+                # reference per geometry serves every variant.
+                ref = np.asarray(q4_matmul_ref(
+                    x, qw["q4"], qw["qs4"], qw["qz4"]), np.float64)
+            seen: set = set()
+            for bm in bms:
+                for bn in bns:
+                    for gk in gks:
+                        bm_eff, bn_eff = _effective_tiles(m, n, bm, bn)
+                        if (bm_eff, bn_eff, gk) in seen:
+                            continue  # clamps to an already-run tile
+                        seen.add((bm_eff, bn_eff, gk))
+                        point = {
+                            "geom": label, "k": k, "n": n, "m": m,
+                            "variant": variant, "bm": bm_eff,
+                            "bn": bn_eff, "gk": gk,
+                        }
+                        try:
+                            out = q4_matmul(
+                                x, qw["q4"], qw["qs4"], qw["qz4"],
+                                bm=bm, bn=bn, gk=gk,
+                                interpret=interpret)
+                            out.block_until_ready()
+                        except ValueError as exc:
+                            point["skipped"] = str(exc)
+                            results.append(point)
+                            continue
+                        point.update(_parity(np.asarray(out), ref))
+                        point["parity_ok"] = bool(
+                            point["max_abs_err"] <= atol
+                            if x.dtype == jnp.float32
+                            else point["rel_rms_err"] <= rel_tol)
+                        if not interpret:
+                            timed = []
+                            for _ in range(trials):
+                                t0 = time.perf_counter()
+                                for _ in range(steps):
+                                    out = q4_matmul(
+                                        x, qw["q4"], qw["qs4"],
+                                        qw["qz4"], bm=bm, bn=bn, gk=gk)
+                                out.block_until_ready()
+                                timed.append(
+                                    (time.perf_counter() - t0) / steps)
+                            dt = sorted(timed)[len(timed) // 2]
+                            # Bytes the kernel must stream per call:
+                            # packed codes + f32 scale/zero rows + x.
+                            streamed = (
+                                qw["q4"].size
+                                + qw["qs4"].size * 8
+                                + x.size * x.dtype.itemsize)
+                            point["time_us"] = round(dt * 1e6, 2)
+                            point["gbps"] = round(
+                                streamed / dt / 1e9, 2)
+                        results.append(point)
+    ran = [r for r in results if "skipped" not in r]
+    best = {}
+    if not interpret:
+        for label, _, _ in geoms:
+            pts = [r for r in ran if r["geom"] == label
+                   and "time_us" in r and r["parity_ok"]]
+            if pts:
+                top = min(pts, key=lambda r: r["time_us"])
+                best[label] = {key: top[key] for key in
+                               ("variant", "bm", "bn", "gk", "time_us",
+                                "gbps")}
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "mode": mode,
+        "backend": backend,
+        "group": group_pref,
+        "silicon_target": SILICON_TARGET,
+        "points": len(results),
+        "parity_failures": [r for r in ran if not r["parity_ok"]],
+        "best": best,
+        "results": results,
+    }

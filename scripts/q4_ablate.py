@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""W4A16 kernel ablation CLI — variant x (bm, bn, gk) x geometry sweep
+(dynamo_tpu/perf/q4_ablation.py) with a machine-readable JSON report.
+
+The same command runs in two places:
+
+  CI (`q4-parity` job): `python scripts/q4_ablate.py --interpret` —
+    tiny geometry grid through the Pallas interpreter, every pack
+    layout checked against q4_matmul_ref; exits nonzero on any parity
+    failure, report uploaded as an artifact.
+
+  Silicon (BENCH_r06): `python bench.py` attaches the flagship-geometry
+    sweep as its `q4_ablation` block; running this script directly on a
+    TPU host gives the same numbers standalone:
+    `python scripts/q4_ablate.py --out q4-ablate`.
+
+The report embeds the silicon acceptance bar (flagship decode
+vs_baseline >= 0.5) so a captured BENCH_r06 is self-describing.
+
+Usage: python scripts/q4_ablate.py [--interpret] [--m N]
+         [--variants v1,v2] [--bm 256] [--bn 512,1024] [--gk 0,2,4]
+         [--out DIR | --json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+# Runnable as `python scripts/q4_ablate.py` from the repo root.
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+def _ints(raw: str) -> list[int]:
+    return [int(v) for v in raw.split(",") if v != ""]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("q4_ablate")
+    parser.add_argument("--interpret", action="store_true",
+                        help="force the Pallas interpreter + tiny grid "
+                             "(the CI parity mode)")
+    parser.add_argument("--m", type=int, default=8,
+                        help="activation rows (decode batch)")
+    parser.add_argument("--variants", default="v1,v2")
+    parser.add_argument("--bm", default="256", type=_ints)
+    parser.add_argument("--bn", default="512,1024", type=_ints)
+    parser.add_argument("--gk", default="0,2,4", type=_ints,
+                        help="groups per k-step (0 = kernel auto)")
+    parser.add_argument("--trials", type=int, default=3)
+    parser.add_argument("--steps", type=int, default=16)
+    parser.add_argument("--out", default=None,
+                        help="artifact dir (writes q4-ablate-report.json)")
+    parser.add_argument("--json", default=None,
+                        help="explicit report path (wins over --out)")
+    args = parser.parse_args()
+
+    from dynamo_tpu.perf.q4_ablation import run_ablation
+
+    report = run_ablation(
+        mode="interpret" if args.interpret else "auto",
+        m=args.m,
+        variants=tuple(v for v in args.variants.split(",") if v),
+        bms=tuple(args.bm), bns=tuple(args.bn), gks=tuple(args.gk),
+        trials=args.trials, steps=args.steps,
+    )
+
+    path = None
+    if args.json:
+        path = pathlib.Path(args.json)
+    elif args.out:
+        path = pathlib.Path(args.out) / "q4-ablate-report.json"
+    if path is not None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(report, indent=2))
+        print(f"report: {path}")
+
+    ran = [r for r in report["results"] if "skipped" not in r]
+    print(f"mode={report['mode']} backend={report['backend']} "
+          f"points={report['points']} ran={len(ran)} "
+          f"parity_failures={len(report['parity_failures'])}")
+    for geom, top in report.get("best", {}).items():
+        print(f"  best[{geom}]: {top}")
+    if report["parity_failures"]:
+        for bad in report["parity_failures"]:
+            print(f"PARITY FAIL: {bad}", file=sys.stderr)
+        return 1
+    if not ran:
+        print("no points ran", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

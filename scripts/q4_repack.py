@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Offline W4A16 pack-layout migration (docs/quantization.md).
+
+Operates on an `.npz` dump of a quantized param pytree with flattened
+path keys (`layers/0/wq/q4`, `layers/0/wq/qs4`, ... — any prefix works;
+every `<prefix>/q4` must have `<prefix>/qs4` + `<prefix>/qz4`
+siblings). Every packed leaf is migrated to the target layout with
+scale/zero rows untouched; the code transform is a nibble bijection so
+`--to v2` then `--to v1` restores the input bit-for-bit.
+
+The serving path does NOT need this: ModelRunner transparently repacks
+a mismatched tree at load (engine/model_runner.py). This tool is for
+migrating stored weight-service snapshots once, so fleets skip the
+per-boot host repack.
+
+Usage:
+  python scripts/q4_repack.py in.npz out.npz [--to auto|v1|v2]
+  python scripts/q4_repack.py in.npz --report   # per-leaf versions
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+import numpy as np
+
+# Runnable as `python scripts/q4_repack.py` from the repo root.
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+def repack_npz(src: dict, to: str) -> tuple[dict, list[tuple[str, int, int]]]:
+    """Returns (new arrays dict, [(prefix, from_version, to_version)])."""
+    from dynamo_tpu.ops.q4_linear import (
+        PACK_V1,
+        PACK_V2,
+        pack_version,
+        repack_q4_leaf,
+    )
+
+    version = {"auto": None, "v1": PACK_V1, "v2": PACK_V2}[to]
+    out = dict(src)
+    moved: list[tuple[str, int, int]] = []
+    for key in sorted(src):
+        if key != "q4" and not (key.endswith("/q4")
+                                or key.endswith(".q4")):
+            continue
+        prefix = key[: -len("q4")]
+        try:
+            leaf = {"q4": src[key], "qs4": src[prefix + "qs4"],
+                    "qz4": src[prefix + "qz4"]}
+        except KeyError as exc:
+            raise SystemExit(
+                f"{key}: missing scale/zero sibling {exc}") from exc
+        new = repack_q4_leaf(leaf, version)
+        cur = pack_version(np.asarray(leaf["q4"]))
+        now = pack_version(np.asarray(new["q4"]))
+        if new is not leaf:
+            out[key] = np.asarray(new["q4"])
+        moved.append((prefix.rstrip("/.") or key, cur, now))
+    return out, moved
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("q4_repack")
+    parser.add_argument("src")
+    parser.add_argument("dst", nargs="?")
+    parser.add_argument("--to", default="auto",
+                        choices=("auto", "v1", "v2"),
+                        help="target layout (auto = DYNT_Q4_VARIANT "
+                             "policy: v2 wherever well-formed)")
+    parser.add_argument("--report", action="store_true",
+                        help="print per-leaf layout versions, write "
+                             "nothing")
+    args = parser.parse_args()
+
+    with np.load(args.src) as f:
+        src = {k: f[k] for k in f.files}
+    out, moved = repack_npz(src, args.to)
+    if not moved:
+        print(f"{args.src}: no packed-int4 leaves found", file=sys.stderr)
+        return 1
+    for prefix, cur, now in moved:
+        tag = f"v{cur}" if cur == now else f"v{cur} -> v{now}"
+        print(f"  {prefix}: {tag}")
+    if args.report:
+        return 0
+    if not args.dst:
+        print("dst required unless --report", file=sys.stderr)
+        return 2
+    np.savez(args.dst, **out)
+    changed = sum(1 for _, c, n in moved if c != n)
+    print(f"wrote {args.dst}: {changed}/{len(moved)} leaves repacked")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
