@@ -149,14 +149,23 @@ def bench_one(model: str, *, model_path: str | None = None,
     btables = np.ascontiguousarray(tables[:, :width])
 
     state = {"tokens": tokens, "pending": None}
+    # Step decomposition accumulators (perf/steptrace.py definitions):
+    # dispatch = host time inside submit calls (tunnel RTT lives here),
+    # drain = blocked readback waits. Recorded per timed trial so the
+    # BENCH_r06 decode number ships with its host/device attribution.
+    trace_acc = {"dispatch_s": 0.0, "drain_s": 0.0}
 
     def step_block():
         nonlocal positions, kv_lens, steps_np
+        t0 = time.perf_counter()
         toks_dev = runner.decode_multi(
             state["tokens"], positions, btables, kv_lens, active, temp,
             top_p, top_k, seeds, steps_np, k=block, return_device=True)
+        trace_acc["dispatch_s"] += time.perf_counter() - t0
         if state["pending"] is not None:
+            t1 = time.perf_counter()
             np.asarray(state["pending"])  # stream block d while d+1 runs
+            trace_acc["drain_s"] += time.perf_counter() - t1
         state["pending"] = toks_dev
         state["tokens"] = toks_dev[-1]  # device-side chain
         positions += block
@@ -165,7 +174,9 @@ def bench_one(model: str, *, model_path: str | None = None,
 
     def drain():
         if state["pending"] is not None:
+            t1 = time.perf_counter()
             np.asarray(state["pending"])
+            trace_acc["drain_s"] += time.perf_counter() - t1
             state["pending"] = None
 
     step_block()  # warmup (compile + first block)
@@ -176,18 +187,37 @@ def bench_one(model: str, *, model_path: str | None = None,
     # the engine.
     n_blocks = decode_steps // block
     trials = []
+    trial_traces = []
     for _ in range(3):
+        trace_acc["dispatch_s"] = trace_acc["drain_s"] = 0.0
         start = time.perf_counter()
         for _ in range(n_blocks):
             step_block()
         drain()
         trials.append(time.perf_counter() - start)
+        trial_traces.append(dict(trace_acc))
         # rewind positions so every trial measures the same context length
         positions -= n_blocks * block
         kv_lens -= n_blocks * block
         steps_np -= n_blocks * block
-    elapsed = sorted(trials)[len(trials) // 2]
+    median_i = sorted(range(3), key=lambda i: trials[i])[1]
+    elapsed = trials[median_i]
     tok_per_sec = batch * n_blocks * block / elapsed
+    # Decomposition of the median trial: host dispatch share is the
+    # tunnel-RTT signal (a remote-attached chip shows it dominating),
+    # device window = wall minus the host submit time.
+    med_trace = trial_traces[median_i]
+    steptrace_cols = {
+        "dispatch_ms_per_block": round(
+            med_trace["dispatch_s"] / n_blocks * 1e3, 4),
+        "drain_wait_ms_per_block": round(
+            med_trace["drain_s"] / n_blocks * 1e3, 4),
+        "device_ms_per_block": round(
+            max(0.0, elapsed - med_trace["dispatch_s"]) / n_blocks * 1e3,
+            4),
+        "host_dispatch_frac": round(
+            med_trace["dispatch_s"] / elapsed, 4),
+    }
 
     # Roofline: steps/sec ceiling = HBM_bw / (weights + active KV per step)
     hbm = 50.0
@@ -224,6 +254,7 @@ def bench_one(model: str, *, model_path: str | None = None,
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
+        "steptrace": steptrace_cols,
     }
     if weight_dtype == "int4":
         # Record WHICH pack layout served the number (the v1/v2 kernels
@@ -507,18 +538,34 @@ def bench_one(model: str, *, model_path: str | None = None,
             for trial in range(12):
                 t0 = time.perf_counter()
                 start = 0
+                tok = None
+                dispatch_s = 0.0
                 while start < isl:
                     chunk = prompt[start:start + budget]
-                    runner.prefill_chunk(chunk, start, bt,
-                                         start + len(chunk),
-                                         (0.0, 1.0, 0, 0))
+                    # Deferred readback per chunk, as the serving
+                    # scheduler dispatches (dispatch-submit cost is the
+                    # host/tunnel share; the final drain closes the
+                    # device-stream window).
+                    d0 = time.perf_counter()
+                    tok = runner.prefill_chunk(chunk, start, bt,
+                                               start + len(chunk),
+                                               (0.0, 1.0, 0, 0),
+                                               return_device=True)
+                    dispatch_s += time.perf_counter() - d0
                     start += len(chunk)
-                samples.append((time.perf_counter() - t0) * 1e3)
+                np.asarray(tok)
+                total_ms = (time.perf_counter() - t0) * 1e3
+                samples.append((total_ms, dispatch_s * 1e3))
             samples = sorted(samples[2:])  # drop compile-warmup trials
+            p50 = samples[len(samples) // 2]
+            p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
             ttft[str(isl)] = {
-                "p50_ms": round(samples[len(samples) // 2], 2),
-                "p99_ms": round(samples[min(len(samples) - 1,
-                                            int(len(samples) * 0.99))], 2),
+                "p50_ms": round(p50[0], 2),
+                "p99_ms": round(p99[0], 2),
+                # Decomposition of the p50 sample (BENCH_r06: the
+                # attributable TTFT that retires the tunnel hypothesis)
+                "p50_host_dispatch_ms": round(p50[1], 2),
+                "p50_device_ms": round(max(0.0, p50[0] - p50[1]), 2),
             }
         result["ttft"] = ttft
     return result

@@ -38,6 +38,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..llm.protocols import EngineOutput, PreprocessedRequest
+from ..perf.steptrace import StepTrace
 from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
 from ..tokens import TokenBlockSequence, compute_block_hashes
@@ -117,6 +118,13 @@ class _Seq:
     # this sequence's history + acceptance EMA. None when speculation is
     # off or the sequence can't speculate.
     spec: Optional[SlotSpec] = None
+    # Device-time attribution (perf/steptrace.py): monotonic timestamp
+    # of this sequence's FIRST prefill dispatch submit, and the
+    # accumulated device windows per phase. Flushed onto the flight
+    # recorder at first_token (prefill) and reap (decode).
+    prefill_submit_ts: Optional[float] = None
+    device_prefill_ms: float = 0.0
+    device_decode_ms: float = 0.0
 
     @property
     def decode_ready(self) -> bool:
@@ -157,6 +165,13 @@ class SchedulerStats:
     spec_accepted: int = 0
     spec_last_k: int = 0
     spec_ema: float = 0.0
+    # Step decomposition of the latest committed step
+    # (perf/steptrace.py): device window vs host residual, mirrored
+    # into LoadMetrics. device + host == wall by construction; the
+    # full sample (dispatch/drain/prep) and cumulative totals live on
+    # scheduler.steptrace.
+    device_ms_last_step: float = 0.0
+    host_ms_last_step: float = 0.0
 
 
 class InferenceScheduler:
@@ -239,6 +254,11 @@ class InferenceScheduler:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.stats = SchedulerStats()
+        # Device-time attribution (perf/steptrace.py): per-step
+        # decomposition stamps around every dispatch/drain below, plus
+        # the jax.profiler StepTraceAnnotation scopes an on-demand
+        # /debug/profile capture attributes device ops to.
+        self.steptrace = StepTrace()
         # decode input buffers (reused)
         b, p = cfg.max_batch, cfg.max_pages_per_seq
         self._tokens = np.zeros(b, np.int32)
@@ -345,6 +365,16 @@ class InferenceScheduler:
     def queue_depth(self) -> tuple[int, int]:
         active = sum(1 for s in self._slots if s is not None)
         return active, len(self._waiting)
+
+    def active_kv_tokens(self) -> int:
+        """KV tokens attended by live decode slots — the working-set
+        input of the live roofline gauges. Read cross-thread without
+        the scheduler lock: a slightly stale sum only skews a gauge."""
+        total = 0
+        for seq in list(self._slots):
+            if seq is not None and not seq.finished and not seq.cancelled:
+                total += seq.kv_len
+        return total
 
     def lora_in_flight(self, lora_slot: int) -> int:
         """Sequences (admitted, waiting, or just submitted) still bound to
@@ -672,6 +702,7 @@ class InferenceScheduler:
 
     def _step(self) -> bool:
         start = time.monotonic()
+        self.steptrace.begin()
         admitted = self._admit()
         # Deferred prefill tokens from the PREVIOUS iteration: their
         # device work was queued before this iteration's dispatches, so
@@ -711,6 +742,9 @@ class InferenceScheduler:
             self.stats.prefill_tokens_last_step = prefill_tokens
             self.stats.decode_tokens_last_step = decode_tokens
             self.stats.last_step_wall_ms = (time.monotonic() - start) * 1e3
+            sample = self.steptrace.commit(self.stats.last_step_wall_ms)
+            self.stats.device_ms_last_step = sample.device_ms
+            self.stats.host_ms_last_step = sample.host_ms
             return True
         return False
 
@@ -739,14 +773,23 @@ class InferenceScheduler:
                 if seq.record_id is not None and not seq.prefill_stamped:
                     seq.prefill_stamped = True
                     get_recorder().stamp(seq.record_id, "prefill_start")
-            result = self.runner.prefill_ring_batch(
-                [np.asarray(s.request.token_ids[: s.prompt_len],  # dynalint: disable=DL201 -- host token list to int32, no device transfer
-                            np.int32)
-                 for s in ring],
-                np.stack([s.block_table for s in ring]),
-                [(s.request.sampling.temperature, s.request.sampling.top_p,
-                  s.request.sampling.top_k, s.seed) for s in ring],
-            )
+            for seq in ring:
+                if seq.prefill_submit_ts is None:
+                    seq.prefill_submit_ts = time.monotonic()
+            # The ring step materializes its samples in-call: one
+            # blocking device window covering the whole batched pass.
+            with self.steptrace.sync("prefill", self.stats.steps) as rsc:
+                result = self.runner.prefill_ring_batch(
+                    [np.asarray(s.request.token_ids[: s.prompt_len],  # dynalint: disable=DL201 -- host token list to int32, no device transfer
+                                np.int32)
+                     for s in ring],
+                    np.stack([s.block_table for s in ring]),
+                    [(s.request.sampling.temperature,
+                      s.request.sampling.top_p,
+                      s.request.sampling.top_k, s.seed) for s in ring],
+                )
+            for seq in ring:
+                seq.device_prefill_ms += rsc.device_ms
             samples = getattr(self.runner, "last_prefill_samples",
                               [None] * len(ring))
             for seq, token, info in zip(ring, result, samples):
@@ -829,15 +872,31 @@ class InferenceScheduler:
         # through _defer_first_token immediately).
         defer = (is_final and not seq.prefill_only
                  and not seq.processors and not sampling.logprobs)
-        token = self.runner.prefill_chunk(
-            tokens, seq.prefill_pos, seq.block_table,
-            kv_len_after=seq.prefill_pos + chunk,
-            sampling=(sampling.temperature, sampling.top_p,
-                      sampling.top_k, seq.seed),
-            lora_idx=seq.lora_idx,
-            chunk_embeds=chunk_embeds,
-            return_device=defer or not is_final,
-        )
+        deferred_readback = defer or not is_final
+        # Async chunks stamp dispatch-submit only (their device window
+        # closes at the deferred drain); sync chunks (prefill_only /
+        # processors / logprobs need the token NOW) are one blocking
+        # call — the whole duration is device window.
+        scope = (self.steptrace.dispatch("prefill", self.stats.steps)
+                 if deferred_readback
+                 else self.steptrace.sync("prefill", self.stats.steps))
+        if seq.prefill_submit_ts is None:
+            seq.prefill_submit_ts = time.monotonic()
+        with scope:
+            token = self.runner.prefill_chunk(
+                tokens, seq.prefill_pos, seq.block_table,
+                kv_len_after=seq.prefill_pos + chunk,
+                sampling=(sampling.temperature, sampling.top_p,
+                          sampling.top_k, seq.seed),
+                lora_idx=seq.lora_idx,
+                chunk_embeds=chunk_embeds,
+                return_device=deferred_readback,
+            )
+        if not deferred_readback:
+            # Device-stream completion window of the whole prompt pass:
+            # first chunk dispatched -> final token materialized.
+            seq.device_prefill_ms = max(
+                0.0, (time.monotonic() - seq.prefill_submit_ts) * 1e3)
         seq.prefill_pos += chunk
         if is_final:
             if defer:
@@ -878,8 +937,13 @@ class InferenceScheduler:
         want_samples = any(
             final and seq.request.sampling.logprobs
             for final, (seq, _) in zip(finals, work))
-        toks_dev = self.runner.prefill_chunk_batch(
-            rows, want_samples=want_samples)
+        now = time.monotonic()
+        for seq, _chunk in work:
+            if seq.prefill_submit_ts is None:
+                seq.prefill_submit_ts = now
+        with self.steptrace.dispatch("prefill", self.stats.steps):
+            toks_dev = self.runner.prefill_chunk_batch(
+                rows, want_samples=want_samples)
         samples = (self.runner.last_prefill_samples
                    if want_samples else [None] * len(work))
         self.stats.prefill_batched_steps += 1
@@ -897,7 +961,10 @@ class InferenceScheduler:
                 self._pending_prefill.append((seq, toks_dev[row]))
                 continue
             if host_toks is None:
-                host_toks = np.asarray(toks_dev)  # dynalint: disable=DL201 -- sync rows need their token now (prefill_only/logprobs), same contract as the single-dispatch path # dynajit: disable=DJ201 -- same designed drain
+                with self.steptrace.drain("prefill"):
+                    host_toks = np.asarray(toks_dev)  # dynalint: disable=DL201 -- sync rows need their token now (prefill_only/logprobs), same contract as the single-dispatch path # dynajit: disable=DJ201 -- same designed drain
+            seq.device_prefill_ms = max(
+                0.0, (time.monotonic() - seq.prefill_submit_ts) * 1e3)
             if seq.prefill_only:
                 self._finish_prefill_only(seq, int(host_toks[row]))
             elif seq.processors:
@@ -937,8 +1004,17 @@ class InferenceScheduler:
         to decode. Returns 1 if a token was delivered (progress)."""
         if seq.cancelled or seq.finished:
             return 0
-        self._append_token(seq, int(np.asarray(tok_dev).reshape(-1)[0]),  # dynajit: disable=DJ201 -- deferred one iteration by design: the device work queued ahead of this readback last step
-                           prompt_tokens=seq.prompt_len)
+        # anchored=False: the chunk behind this token was SUBMITTED last
+        # step — this step's prefill submit stamp (if any) belongs to a
+        # different sequence's chunk, so only the blocked wait counts.
+        with self.steptrace.drain("prefill", anchored=False):
+            token = int(np.asarray(tok_dev).reshape(-1)[0])  # dynajit: disable=DJ201 -- deferred one iteration by design: the device work queued ahead of this readback last step
+        if seq.prefill_submit_ts is not None:
+            # First chunk dispatched -> first token materialized: the
+            # device-stream completion window of the prompt pass.
+            seq.device_prefill_ms = max(
+                0.0, (time.monotonic() - seq.prefill_submit_ts) * 1e3)
+        self._append_token(seq, token, prompt_tokens=seq.prompt_len)
         return 1
 
     def _defer_first_token(self, seq: _Seq) -> None:
@@ -981,6 +1057,9 @@ class InferenceScheduler:
         seq.finished = True
         if seq.record_id is not None:
             get_recorder().stamp(seq.record_id, "first_token")
+            if seq.device_prefill_ms:
+                get_recorder().device(seq.record_id, "prefill",
+                                      seq.device_prefill_ms)
         seq.emit(EngineOutput(
             token_ids=[], finish_reason="stop",
             prompt_tokens=seq.prompt_len,
@@ -1089,16 +1168,21 @@ class InferenceScheduler:
             # in-block discard at drain already accepts.
             device_blocks = []
             toks_dev = None
-            for d in range(depth):
-                toks_dev = self.runner.decode_multi(
-                    self._tokens if d == 0 else toks_dev[-1],
-                    self._positions + d * block, tables,
-                    self._kv_lens + d * block,
-                    self._active, self._temp, self._top_p, self._top_k,
-                    self._seeds, self._steps + d * block, k=block,
-                    lora_idx=self._lora_idx, return_device=True,
-                )
-                device_blocks.append(toks_dev)
+            # Dispatch-submit stamp + profiler step annotation: the
+            # submit wall here is host dispatch cost; the device window
+            # runs from this scope's end to the drain in _drain_decode.
+            with self.steptrace.dispatch("decode", self.stats.steps):
+                for d in range(depth):
+                    toks_dev = self.runner.decode_multi(
+                        self._tokens if d == 0 else toks_dev[-1],
+                        self._positions + d * block, tables,
+                        self._kv_lens + d * block,
+                        self._active, self._temp, self._top_p,
+                        self._top_k,
+                        self._seeds, self._steps + d * block, k=block,
+                        lora_idx=self._lora_idx, return_device=True,
+                    )
+                    device_blocks.append(toks_dev)
             return ("blocks", device_blocks, ready, block)
         return ("count",
                 self._decode_single(ready, tables, want_logprobs,
@@ -1123,7 +1207,12 @@ class InferenceScheduler:
         # _reap_finished's page release — consumers reacting to the
         # finish (KVBM flush, disagg transfer) would race a release that
         # hasn't happened yet.
-        blocks_np = [np.asarray(t) for t in device_blocks]  # dynalint: disable=DL201 -- deliberate barrier: all blocks must land before any token emits (see comment above) # dynajit: disable=DJ201 -- the loop's ONE blocking drain
+        with self.steptrace.drain("decode") as drain:
+            blocks_np = [np.asarray(t) for t in device_blocks]  # dynalint: disable=DL201 -- deliberate barrier: all blocks must land before any token emits (see comment above) # dynajit: disable=DJ201 -- the loop's ONE blocking drain
+        # Wall attribution: every live slot waited this device window
+        # out (the block served them all in one dispatch).
+        for seq in ready:
+            seq.device_decode_ms += drain.device_ms
         count = 0
         for toks_k in blocks_np:
             for step in range(block):
@@ -1208,13 +1297,15 @@ class InferenceScheduler:
         max_kv = max(s.kv_len for s in ready) + need
         width = bucket_table_width(-(-max_kv // self.page_size),
                                    self.runner.config.max_pages_per_seq)
-        targets, n_acc = self.runner.decode_spec(
-            self._tokens, drafts, self._positions, self._tables[:, :width],
-            self._kv_lens, self._active, self._temp, self._top_p,
-            self._top_k, self._seeds, self._steps,
-            lora_idx=self._lora_idx, want_logits=want_logits,
-            return_device=True,
-        )
+        with self.steptrace.dispatch("spec", self.stats.steps):
+            targets, n_acc = self.runner.decode_spec(
+                self._tokens, drafts, self._positions,
+                self._tables[:, :width],
+                self._kv_lens, self._active, self._temp, self._top_p,
+                self._top_k, self._seeds, self._steps,
+                lora_idx=self._lora_idx, want_logits=want_logits,
+                return_device=True,
+            )
         return ("spec", targets, n_acc, ready, drafts, want_logits)
 
     def _drain_spec(self, pending) -> int:
@@ -1225,13 +1316,17 @@ class InferenceScheduler:
         unchanged; surplus rejected-draft KV sits in the sequence's own
         slack pages and is rewritten by the next step."""
         _kind, targets_dev, n_acc_dev, ready, drafts, with_logits = pending
-        targets = np.asarray(targets_dev)  # dynalint: disable=DL201 -- the drain point: spec commits need the verdict on host # dynajit: disable=DJ201 -- same spec drain
-        n_acc = np.asarray(n_acc_dev)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
-        logits = None
-        if with_logits:
-            logits = self.runner.last_spec_logits
-            if logits is not None and not isinstance(logits, np.ndarray):
-                logits = np.asarray(logits)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
+        with self.steptrace.drain("spec") as drain:
+            targets = np.asarray(targets_dev)  # dynalint: disable=DL201 -- the drain point: spec commits need the verdict on host # dynajit: disable=DJ201 -- same spec drain
+            n_acc = np.asarray(n_acc_dev)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
+            logits = None
+            if with_logits:
+                logits = self.runner.last_spec_logits
+                if logits is not None and not isinstance(logits,
+                                                         np.ndarray):
+                    logits = np.asarray(logits)  # dynalint: disable=DL201 -- same drain point # dynajit: disable=DJ201 -- same spec drain
+        for seq in ready:
+            seq.device_decode_ms += drain.device_ms
         count = 0
         emas = []
         self.stats.spec_steps += 1
@@ -1320,13 +1415,20 @@ class InferenceScheduler:
 
     def _decode_single(self, ready, tables, want_logprobs,
                        want_logits) -> int:
-        next_tokens = self.runner.decode(
-            self._tokens, self._positions, tables, self._kv_lens,
-            self._active, self._temp, self._top_p, self._top_k, self._seeds,
-            self._steps, lora_idx=self._lora_idx,
-            want_logprobs=want_logprobs and not want_logits,
-            want_logits=want_logits,
-        )
+        # Host-sampling path: dispatch, execute, and readback happen
+        # inside the one runner call — the whole duration is the
+        # device window (the host was blocked on the chip throughout).
+        with self.steptrace.sync("decode", self.stats.steps) as sc:
+            next_tokens = self.runner.decode(
+                self._tokens, self._positions, tables, self._kv_lens,
+                self._active, self._temp, self._top_p, self._top_k,
+                self._seeds,
+                self._steps, lora_idx=self._lora_idx,
+                want_logprobs=want_logprobs and not want_logits,
+                want_logits=want_logits,
+            )
+        for seq in ready:
+            seq.device_decode_ms += sc.device_ms
         lp_b, tid_b, tlp_b = getattr(self.runner, "last_decode_sample",
                                      (None, None, None))
         logits_rows = (getattr(self.runner, "last_decode_logits", None)
@@ -1450,6 +1552,12 @@ class InferenceScheduler:
         seq.generated.append(token)
         if len(seq.generated) == 1 and seq.record_id is not None:
             get_recorder().stamp(seq.record_id, "first_token")
+            if seq.device_prefill_ms:
+                # Device share of the TTFT the timeline just closed:
+                # feeds /debug/requests, the planner's phase breakdown,
+                # and dynamo_ttft_device_ms (worker-side).
+                get_recorder().device(seq.record_id, "prefill",
+                                      seq.device_prefill_ms)
         seq.last_token = token
         if seq.spec is not None:
             # Keep the n-gram index + block-hash chain current on EVERY
@@ -1473,6 +1581,15 @@ class InferenceScheduler:
             if n > 0:
                 top_logprobs = [[[int(i), float(v)]
                                  for i, v in zip(top_ids[:n], top_lps[:n])]]
+        if finish is not None and seq.device_decode_ms \
+                and seq.record_id is not None:
+            # Flush decode device burn BEFORE the finish frame goes
+            # out: the worker closes the timeline as soon as it
+            # consumes that frame, and a reap-time flush would race
+            # it. Zeroed so reap cannot double-count.
+            get_recorder().device(seq.record_id, "decode",
+                                  seq.device_decode_ms)
+            seq.device_decode_ms = 0.0
         seq.emit(EngineOutput(
             token_ids=[token], finish_reason=finish,
             prompt_tokens=prompt_tokens,
@@ -1522,6 +1639,11 @@ class InferenceScheduler:
             if seq is None:
                 continue
             if seq.finished or seq.cancelled:
+                if seq.device_decode_ms and seq.record_id is not None:
+                    # Decode-phase device burn, flushed once at reap
+                    # (per-step recorder traffic would tax the loop).
+                    get_recorder().device(seq.record_id, "decode",
+                                          seq.device_decode_ms)
                 if (seq.stream_started and not seq.stream_done
                         and seq.on_prefill_chunk is not None):
                     # A prefill-only sequence died mid-stream (cancel or

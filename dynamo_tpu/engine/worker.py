@@ -46,6 +46,7 @@ from ..llm.protocols import (
 )
 from ..models import get_config
 from ..parallel import MeshConfig, make_mesh
+from ..perf.steptrace import LiveRoofline
 from ..runtime import DistributedRuntime, new_instance_id
 from ..runtime.logging import get_logger
 from ..runtime.metrics import KV_USAGE
@@ -237,6 +238,11 @@ class TpuWorker:
         self._weights_served = None
         self._publish_task: Optional[asyncio.Task] = None
         self.weights_source = "init"  # init | service | peer | checkpoint
+        # Live roofline gauges (perf/steptrace.py LiveRoofline) + the
+        # interval baseline (prefill/decode tokens, decode steps,
+        # device-ms total) behind dynamo_mfu/dynamo_roofline_fraction.
+        self._roofline: Optional[LiveRoofline] = None
+        self._roof_prev: Optional[tuple] = None
 
     async def start(self) -> None:
         """prepare + serve in one go (normal startup). Snapshot-gated
@@ -1039,6 +1045,59 @@ class TpuWorker:
         SPEC_ACCEPTANCE.labels(worker=worker).set(stats.spec_ema)
         SPEC_K.labels(worker=worker).set(stats.spec_last_k)
 
+    def _publish_steptrace_metrics(self) -> None:
+        """Publish the device-time attribution plane (perf/steptrace.py):
+        per-step device/host histograms from the samples buffered since
+        the last drain, the host-bound verdict, and the live MFU /
+        roofline-fraction gauges computed from this interval's work via
+        the analytical TimingModel."""
+        from ..runtime.metrics import (
+            HOST_BOUND,
+            MFU_GAUGE,
+            ROOFLINE_FRACTION,
+            STEP_DEVICE_MS,
+            STEP_HOST_MS,
+        )
+
+        trace = self.scheduler.steptrace
+        worker = f"{self.instance_id:x}"
+        for sample in trace.drain_samples():
+            for phase, ms in sample.device_by_phase.items():
+                STEP_DEVICE_MS.labels(phase=phase).observe(ms)
+            STEP_HOST_MS.labels(phase=sample.kind).observe(sample.host_ms)
+        HOST_BOUND.labels(worker=worker).set(1.0 if trace.host_bound
+                                             else 0.0)
+        if self._roofline is None:
+            wb = {"int8": 1.0, "int4": 0.53125}.get(
+                self.runner_config.weight_dtype, 2.0)
+            self._roofline = LiveRoofline(
+                self.model_config,
+                num_chips=int(self.mesh.devices.size),
+                weight_bytes_per_param=wb,
+                kv_dtype_bytes=1 if self.runner_config.kv_dtype == "int8"
+                else 2,
+            )
+        stats = self.scheduler.stats
+        cur = (stats.prefill_tokens, stats.decode_tokens,
+               getattr(self.runner, "decode_steps", 0),
+               trace.device_ms_total)
+        prev = self._roof_prev
+        self._roof_prev = cur
+        if prev is None:
+            return
+        device_s = (cur[3] - prev[3]) / 1e3
+        if device_s <= 0:
+            return
+        mfu, fraction = self._roofline.observe(
+            prefill_tokens=cur[0] - prev[0],
+            decode_tokens=cur[1] - prev[1],
+            decode_steps=cur[2] - prev[2],
+            active_kv_tokens=self.scheduler.active_kv_tokens(),
+            device_s=device_s,
+        )
+        MFU_GAUGE.labels(worker=worker).set(mfu)
+        ROOFLINE_FRACTION.labels(worker=worker).set(fraction)
+
     async def _event_drain(self, publisher, interval: float = 0.05) -> None:
         self._drain_ticks = 0
         self._spec_published = (0, 0)
@@ -1077,11 +1136,18 @@ class TpuWorker:
                     step_wall_ms=self.scheduler.stats.last_step_wall_ms,
                     prefill_tokens_in_step=self.scheduler.stats.prefill_tokens_last_step,
                     decode_tokens_in_step=self.scheduler.stats.decode_tokens_last_step,
+                    device_ms_in_step=self.scheduler.stats.device_ms_last_step,
+                    host_ms_in_step=self.scheduler.stats.host_ms_last_step,
                 )
                 KV_USAGE.labels(worker=f"{self.instance_id:x}").set(
                     metrics.kv_usage)
                 if self.scheduler.spec_enabled:
                     self._publish_spec_metrics()
+                try:
+                    self._publish_steptrace_metrics()
+                except Exception:  # noqa: BLE001 — gauges must not
+                    # kill the drain task
+                    log.exception("steptrace metrics publish failed")
                 try:
                     await publisher.publish(LOAD_TOPIC, metrics.to_wire())
                 except Exception:  # noqa: BLE001
@@ -1301,6 +1367,19 @@ class TpuWorker:
             # frontend) closed it first — fall back to a lookup.
             timeline = (recorder.finish(rec_id, status)
                         or recorder.get(rec_id))
+            if (timeline is not None
+                    and not request.annotations.get("canary")):
+                # Device-time TTFT (docs/observability.md): the prefill
+                # device-stream window behind this request's first
+                # token, exemplar-linked to its trace.
+                dev_ms = (timeline.device or {}).get("prefill_device_ms")
+                if dev_ms:
+                    from ..runtime.metrics import TTFT_DEVICE_MS
+
+                    TTFT_DEVICE_MS.labels(model=request.model).observe(
+                        dev_ms,
+                        exemplar={"trace_id": timeline.trace_id}
+                        if timeline.trace_id else None)
             self._record_phase_trace(tracer, worker_span, timeline,
                                      prefill_only)
             worker_span.end(ok=status == "ok")
@@ -1327,15 +1406,36 @@ class TpuWorker:
         if "queued" in phases and "scheduled" in phases:
             tracer.record_span("scheduler.queue", parent,
                                _ns("queued"), _ns("scheduled"))
+        segments = []
         if "prefill_start" in phases and "first_token" in phases:
-            tracer.record_span("worker.prefill", parent,
-                               _ns("prefill_start"), _ns("first_token"))
+            segments.append(("worker.prefill", "prefill",
+                             _ns("prefill_start"), _ns("first_token")))
         if "first_token" in phases and "finished" in phases \
                 and not prefill_only:
             # Prefill-only legs never decode: first_token..finished there
             # is transfer-table handoff, not a decode segment.
-            tracer.record_span("worker.decode", parent,
-                               _ns("first_token"), _ns("finished"))
+            segments.append(("worker.decode", "decode",
+                             _ns("first_token"), _ns("finished")))
+        device = timeline.device or {}
+        for span_name, phase, start_ns, end_ns in segments:
+            seg_parent = tracer.record_span(span_name, parent,
+                                            start_ns, end_ns)
+            # Device slice of the phase (perf/steptrace.py attribution):
+            # the device-stream window abuts the segment end (the drain
+            # materialized the tokens that closed it), so the child span
+            # is laid back from there; the host share is the remainder.
+            dev_ms = device.get(f"{phase}_device_ms", 0.0)
+            if not seg_parent or dev_ms <= 0:
+                continue
+            dev_ns = int(dev_ms * 1e6)
+            seg_ns = max(0, end_ns - start_ns)
+            dev_ns = min(dev_ns, seg_ns)
+            tracer.record_span(
+                "worker.device_execute", seg_parent,
+                end_ns - dev_ns, end_ns,
+                **{"phase": phase, "device_ms": round(dev_ms, 3),
+                   "host_ms": round(max(0.0, seg_ns / 1e6 - dev_ms),
+                                    3)})
 
     async def close(self) -> None:
         if self._publish_task is not None and not self._publish_task.done():

@@ -119,6 +119,11 @@ class LoadMetrics:
     step_wall_ms: float = 0.0
     prefill_tokens_in_step: int = 0
     decode_tokens_in_step: int = 0
+    # step decomposition (perf/steptrace.py): device window vs host
+    # residual of the last step, so planners can tell a host-bound pool
+    # (more chips won't move it) from a device-bound one before scaling
+    device_ms_in_step: float = 0.0
+    host_ms_in_step: float = 0.0
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)

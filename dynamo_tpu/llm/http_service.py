@@ -35,7 +35,11 @@ from ..runtime.otel import get_tracer, trace_id_of
 from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
 from ..runtime.resilience import Deadline, DeadlineExceeded
-from ..runtime.status import debug_requests_response, metrics_response
+from ..runtime.status import (
+    debug_requests_response,
+    metrics_response,
+    profile_response,
+)
 from ..session.wire import (
     extract_cache_control,
     resolve_anchor_tokens,
@@ -349,6 +353,9 @@ class HttpService:
 
     async def _debug_requests(self, request: web.Request) -> web.Response:
         return debug_requests_response(request)
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        return await profile_response(request)
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._completion_common(request, kind="chat")
@@ -1492,6 +1499,9 @@ class HttpService:
          "Prometheus metrics (OpenMetrics + exemplars via Accept)"),
         ("get", "/debug/requests",
          "Flight recorder: inflight + recent request timelines"),
+        ("get", "/debug/profile",
+         "On-demand jax.profiler capture (?duration_ms=); returns the "
+         "trace artifact path"),
         ("get", "/busy_threshold", "List per-model busy thresholds"),
         ("post", "/busy_threshold",
          "Get or set a model's busy threshold (load shedding)"),
@@ -1503,12 +1513,12 @@ class HttpService:
 
     def _route_docs(self):
         """_ROUTE_DOCS minus routes not actually registered (the opt-in
-        /debug/requests), so /openapi.json and /docs never advertise an
-        endpoint that 404s."""
+        /debug/* endpoints), so /openapi.json and /docs never advertise
+        an endpoint that 404s."""
         if env("DYNT_DEBUG_ENDPOINTS"):
             return self._ROUTE_DOCS
         return tuple(r for r in self._ROUTE_DOCS
-                     if r[1] != "/debug/requests")
+                     if not r[1].startswith("/debug/"))
 
     async def _openapi(self, _request: web.Request) -> web.Response:
         paths: dict[str, dict] = {}
@@ -1558,9 +1568,11 @@ class HttpService:
         app.router.add_get("/metrics", self._metrics)
         if env("DYNT_DEBUG_ENDPOINTS"):
             # Tenant-facing port: the flight recorder exposes every
-            # client's request timelines, so it is opt-in here (the
-            # internal status server always serves it).
+            # client's request timelines and a profile capture burns
+            # serving-process time, so both are opt-in here (the
+            # internal status server always serves them).
             app.router.add_get("/debug/requests", self._debug_requests)
+            app.router.add_get("/debug/profile", self._debug_profile)
         app.router.add_get("/busy_threshold", self._busy_threshold_list)
         app.router.add_post("/busy_threshold", self._busy_threshold_post)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)

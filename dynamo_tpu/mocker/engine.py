@@ -256,6 +256,17 @@ class _Sequence:
     cancelled: bool = False
     pinned: list[int] = dataclasses.field(default_factory=list)
     prefill_chunks: int = 0  # steps that advanced this prompt (chunking)
+    # Simulated device-time attribution (mirrors the real scheduler's
+    # perf/steptrace.py plane): device = modeled step compute, host =
+    # measured loop bookkeeping, bucketed as "prefill" until the first
+    # token is DELIVERED (so the TTFT decomposition sums to the
+    # timeline's TTFT), "decode" after. Flushed onto the flight
+    # recorder at those two boundaries.
+    device_prefill_ms: float = 0.0
+    host_prefill_ms: float = 0.0
+    device_decode_ms: float = 0.0
+    host_decode_ms: float = 0.0
+    prefill_flushed: bool = False
 
 
 class MockerEngine:
@@ -292,6 +303,13 @@ class MockerEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self._spec_rng = np.random.default_rng(0x5BEC ^ worker_id)
+        # Simulated step decomposition (the perf/steptrace.py analog):
+        # device = modeled compute, host = measured loop bookkeeping.
+        self.last_step_device_ms = 0.0
+        self.last_step_host_ms = 0.0
+        self.last_step_wall_ms = 0.0
+        self.device_ms_total = 0.0
+        self.host_ms_total = 0.0
 
     # -- events ------------------------------------------------------------
 
@@ -347,6 +365,9 @@ class MockerEngine:
             active_requests=len(self._running),
             waiting_requests=len(self._waiting),
             kv_usage=self.kv.usage(),
+            step_wall_ms=self.last_step_wall_ms,
+            device_ms_in_step=self.last_step_device_ms,
+            host_ms_in_step=self.last_step_host_ms,
         )
 
     # -- public handler ----------------------------------------------------
@@ -411,8 +432,9 @@ class MockerEngine:
             step_start = time.monotonic()
             evicted_total: list[int] = []
             self._admit(evicted_total.extend)
-            prefill_tokens = self._prefill_step()
-            decoded, decode_seqs, deliveries = self._decode_step()
+            prefill_tokens, prefilled = self._prefill_step()
+            decoded, decode_seqs, progressed, deliveries = \
+                self._decode_step()
             try:
                 if evicted_total:
                     await self._publish_removed(evicted_total)
@@ -422,6 +444,37 @@ class MockerEngine:
                 target = self._step_time(prefill_tokens, decode_seqs,
                                          self._active_kv_blocks())
                 delay = max(0.0, target - elapsed)
+                # Simulated step decomposition (the mocker analog of
+                # perf/steptrace.py): device = the modeled compute time,
+                # host = the loop's measured bookkeeping residual;
+                # device + host == the step wall the sleeps realize.
+                wall_ms = (elapsed + delay) * 1e3
+                device_ms = min(target * 1e3, wall_ms)
+                host_ms = max(0.0, wall_ms - device_ms)
+                self.last_step_device_ms = device_ms
+                self.last_step_host_ms = host_ms
+                self.last_step_wall_ms = wall_ms
+                self.device_ms_total += device_ms
+                self.host_ms_total += host_ms
+                seen_ids: set[int] = set()
+                for seq in prefilled + progressed + self._running:
+                    # Wall attribution to EVERY admitted live sequence
+                    # (each one waited this step's wall out, whether it
+                    # progressed or sat behind the shared prefill
+                    # budget — contention is part of its burn, exactly
+                    # like the real scheduler's shared block windows),
+                    # deduped, and bucketed as prefill until its first
+                    # token DELIVERS so the TTFT decomposition sums to
+                    # the timeline's TTFT.
+                    if id(seq) in seen_ids or seq.cancelled:
+                        continue
+                    seen_ids.add(id(seq))
+                    if not seq.prefill_flushed:
+                        seq.device_prefill_ms += device_ms
+                        seq.host_prefill_ms += host_ms
+                    else:
+                        seq.device_decode_ms += device_ms
+                        seq.host_decode_ms += host_ms
                 if delay:
                     await asyncio.sleep(delay)
                 elif not prefill_tokens and not decoded:
@@ -437,8 +490,8 @@ class MockerEngine:
                 # in _decode_step are already off _running, so dropping
                 # their frames on cancellation/publish failure would hang
                 # consumers waiting on the terminal None.
-                for queue, item in deliveries:
-                    queue.put_nowait(item)
+                for seq, item in deliveries:
+                    self._deliver(seq, item)
 
     def _step_time(self, prefill_tokens: int, decode_seqs: int,
                    kv_blocks: int = 0) -> float:
@@ -510,6 +563,11 @@ class MockerEngine:
             seq.new_blocks = need
             seq.prefilled_tokens = cached * cfg.block_size
             seq.pinned = prefix
+            # Admission = end of queue wait (no-op without an open
+            # timeline; first write wins like the real scheduler).
+            from ..runtime.flight_recorder import get_recorder
+
+            get_recorder().stamp(seq.request.request_id, "scheduled")
             if seq.request.disaggregated_params is not None:
                 # Disagg decode side: the KV "arrived" via transfer — skip
                 # the prefill pass entirely (ref §3.4 decode leg).
@@ -517,10 +575,14 @@ class MockerEngine:
             self._waiting.pop(0)
             self._running.append(seq)
 
-    def _prefill_step(self) -> int:
-        """Advance prefills within the chunked budget; returns tokens prefilled."""
+    def _prefill_step(self) -> tuple[int, list["_Sequence"]]:
+        """Advance prefills within the chunked budget; returns (tokens
+        prefilled, the sequences that advanced)."""
+        from ..runtime.flight_recorder import get_recorder
+
         budget = self.config.max_prefill_tokens_per_step
         total = 0
+        advanced: list[_Sequence] = []
         for seq in self._running:
             if seq.done or seq.cancelled:
                 continue
@@ -532,9 +594,15 @@ class MockerEngine:
                 break
             seq.prefilled_tokens += chunk
             seq.prefill_chunks += 1
+            if seq.prefill_chunks == 1:
+                # First chunk of real prefill compute (no-op for
+                # requests with no open timeline — bare-mocker tests).
+                get_recorder().stamp(seq.request.request_id,
+                                     "prefill_start")
             total += chunk
+            advanced.append(seq)
         self.prefill_tokens_total += total
-        return total
+        return total, advanced
 
     def _spec_tokens_this_step(self, remaining: int) -> int:
         """Tokens a speculative step emits for one sequence: 1 (the
@@ -552,10 +620,11 @@ class MockerEngine:
         self.spec_accepted += accepted
         return 1 + accepted
 
-    def _decode_step(self) -> tuple[int, int, list]:
+    def _decode_step(self) -> tuple[int, int, list, list]:
         """Generate tokens for each fully-prefilled sequence — one per
         step, or 1 + accepted under a speculative-worker profile
-        (spec_k > 0). Returns (tokens, decoding_seqs, deliveries).
+        (spec_k > 0). Returns (tokens, decoding_seqs, progressed
+        sequences, deliveries).
 
         Outputs are COLLECTED, not delivered: a step's tokens exist only
         once the step's modeled compute time has elapsed, so the step
@@ -563,9 +632,10 @@ class MockerEngine:
         (otherwise TTFT on an uncontended worker measures ~0 instead of
         the prefill cost — ref: the real engine returns step outputs at
         step end)."""
-        deliveries: list[tuple[asyncio.Queue, object]] = []
+        deliveries: list[tuple[_Sequence, object]] = []
         decoded = 0
         decode_seqs = 0
+        progressed: list[_Sequence] = []
         finished: list[_Sequence] = []
         for seq in self._running:
             if seq.cancelled:
@@ -580,7 +650,8 @@ class MockerEngine:
                 # the decode mocker just skips its prefill pass).
                 first = 97 + (len(req.token_ids) % 26)
                 seq.done = True
-                deliveries.append((seq.queue, EngineOutput(
+                progressed.append(seq)
+                deliveries.append((seq, EngineOutput(
                     token_ids=[], finish_reason="stop",
                     prompt_tokens=len(req.token_ids),
                     kv_transfer_params={
@@ -593,10 +664,11 @@ class MockerEngine:
                         "chunks": seq.prefill_chunks,
                     },
                 ).to_wire()))
-                deliveries.append((seq.queue, None))
+                deliveries.append((seq, None))
                 finished.append(seq)
                 continue
             decode_seqs += 1
+            progressed.append(seq)
             n_tokens = 1
             if self.config.spec_k > 0:
                 n_tokens = self._spec_tokens_this_step(
@@ -621,15 +693,56 @@ class MockerEngine:
                 prompt_tokens=(len(req.token_ids)
                                if seq.generated == len(tokens) else None),
             )
-            deliveries.append((seq.queue, output.to_wire()))
+            deliveries.append((seq, output.to_wire()))
             if finish is not None:
                 seq.done = True
-                deliveries.append((seq.queue, None))
+                deliveries.append((seq, None))
                 finished.append(seq)
         for seq in finished:
             self._running.remove(seq)
             self._release(seq)
-        return decoded, decode_seqs, deliveries
+        return decoded, decode_seqs, progressed, deliveries
+
+    def _deliver(self, seq: _Sequence, item) -> None:
+        """Flush the simulated device/host attribution onto the flight
+        recorder at the two bucket boundaries — first token delivered
+        (prefill burn becomes the request's device-time TTFT) and
+        stream end (decode burn) — then hand the frame to the consumer.
+        Flushes run BEFORE the frame so the consumer closing the
+        timeline can never race them."""
+        from ..runtime.flight_recorder import get_recorder
+
+        rid = seq.request.request_id
+        if item is None:
+            if seq.device_decode_ms or seq.host_decode_ms:
+                get_recorder().device(rid, "decode",
+                                      seq.device_decode_ms,
+                                      seq.host_decode_ms)
+                seq.device_decode_ms = seq.host_decode_ms = 0.0
+            seq.queue.put_nowait(None)
+            return
+        if not seq.prefill_flushed and isinstance(item, dict) \
+                and (item.get("t") or item.get("kv")):
+            seq.prefill_flushed = True
+            get_recorder().device(rid, "prefill", seq.device_prefill_ms,
+                                  seq.host_prefill_ms)
+            if seq.device_prefill_ms \
+                    and not seq.request.annotations.get("canary"):
+                try:
+                    from ..runtime.metrics import TTFT_DEVICE_MS
+                    from ..runtime.otel import trace_id_of
+
+                    trace_id = trace_id_of(
+                        seq.request.annotations.get("traceparent"))
+                    TTFT_DEVICE_MS.labels(
+                        model=seq.request.model).observe(
+                        seq.device_prefill_ms,
+                        exemplar={"trace_id": trace_id}
+                        if trace_id else None)
+                except Exception:  # noqa: BLE001 — metrics must not
+                    # break a chip-free simulation environment
+                    pass
+        seq.queue.put_nowait(item)
 
     def _release(self, seq: _Sequence) -> None:
         """On completion: completed full blocks become reusable cache entries;

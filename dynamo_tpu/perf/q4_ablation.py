@@ -21,10 +21,11 @@ One command either way: `python scripts/q4_ablate.py [--interpret]`.
 
 from __future__ import annotations
 
-import time
 from typing import Optional, Sequence
 
 import numpy as np
+
+from .steptrace import measure_device
 
 # Report schema version + the silicon acceptance bar this harness exists
 # to prove (BENCH_r06: flagship decode vs_baseline >= 0.5 — the
@@ -172,17 +173,17 @@ def run_ablation(
                             if x.dtype == jnp.float32
                             else point["rel_rms_err"] <= rel_tol)
                         if not interpret:
-                            timed = []
-                            for _ in range(trials):
-                                t0 = time.perf_counter()
-                                for _ in range(steps):
-                                    out = q4_matmul(
-                                        x, qw["q4"], qw["qs4"],
-                                        qw["qz4"], bm=bm, bn=bn, gk=gk)
-                                out.block_until_ready()
-                                timed.append(
-                                    (time.perf_counter() - t0) / steps)
-                            dt = sorted(timed)[len(timed) // 2]
+                            # ONE measurement definition with the live
+                            # serving plane and bench decomposition
+                            # columns (perf/steptrace.py): kernel
+                            # ablation numbers and production step
+                            # timings mean the same thing.
+                            dt = measure_device(
+                                lambda bm=bm, bn=bn, gk=gk: q4_matmul(
+                                    x, qw["q4"], qw["qs4"], qw["qz4"],
+                                    bm=bm, bn=bn, gk=gk),
+                                steps=steps, trials=trials,
+                            )["median_s"]
                             # Bytes the kernel must stream per call:
                             # packed codes + f32 scale/zero rows + x.
                             streamed = (

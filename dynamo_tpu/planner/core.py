@@ -528,6 +528,32 @@ class LoadBasedPlanner:
             if dc:
                 self.itl_est.observe_step(dc, wall)
 
+    def pool_time_split(self) -> tuple[float, float]:
+        """Mean (host_ms, device_ms) of the pool's last steps from the
+        live LoadMetrics snapshots (perf/steptrace.py decomposition on
+        the wire). (0, 0) when the workers predate the field."""
+        host = device = 0.0
+        n = 0
+        for snap in self.source.snapshots():
+            h = float(snap.get("host_ms_in_step", 0.0))
+            d = float(snap.get("device_ms_in_step", 0.0))
+            if h or d:
+                host += h
+                device += d
+                n += 1
+        if n == 0:
+            return 0.0, 0.0
+        return host / n, device / n
+
+    def pool_host_bound(self) -> bool:
+        """True when the pool's steps burn more host than device time —
+        an ITL violation here is dispatch/scheduling cost, and adding
+        replicas helps by shrinking per-replica batch, not by adding
+        chips; the planner tags such decisions so operators chase the
+        host path instead of capacity."""
+        host, device = self.pool_time_split()
+        return host > device > 0.0 or (host > 0.0 and device == 0.0)
+
     @staticmethod
     def _decide(estimates: list[float], sla: float, current: int,
                 sensitivity: float, min_endpoint: int) -> int:
@@ -600,10 +626,15 @@ class LoadBasedPlanner:
                     await self.connector.set_component_replicas(
                         [TargetReplica(self.config.decode_component,
                                        target)])
+                    # Host-bound pools get a distinct decision reason:
+                    # the grow still helps (smaller per-replica batch),
+                    # but the operator should be chasing the host path,
+                    # not buying chips (docs/observability.md).
+                    reason = ("scale_down" if target < current
+                              else "scale_up_host_bound"
+                              if self.pool_host_bound() else "scale_up")
                     publish_planner_decision(
-                        {"decode": target},
-                        "scale_up" if target > current else "scale_down",
-                        self._goodput_ratio)
+                        {"decode": target}, reason, self._goodput_ratio)
                     current = target
                 else:
                     publish_planner_decision({"decode": current}, "hold",

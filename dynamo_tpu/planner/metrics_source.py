@@ -192,6 +192,12 @@ class PhaseBreakdown:
     queue_ms: float = 0.0
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
+    # Device-time split of the service segments (perf/steptrace.py via
+    # the timelines' "device" block): the prefill/decode wall above
+    # decomposed into device-stream burn vs host residual. Zero when
+    # the serving side predates the attribution plane.
+    prefill_device_ms: float = 0.0
+    decode_device_ms: float = 0.0
     samples: int = 0
 
     def bottleneck(self) -> str:
@@ -199,6 +205,25 @@ class PhaseBreakdown:
         dominates, else 'decode'."""
         return ("prefill" if self.queue_ms + self.prefill_ms
                 >= self.decode_ms else "decode")
+
+    def device_ms(self) -> float:
+        return self.prefill_device_ms + self.decode_device_ms
+
+    def host_ms(self) -> float:
+        """Host share of the service burn (wall minus attributed device
+        time; queue burn is neither — it is its own bucket)."""
+        return max(0.0, self.prefill_ms + self.decode_ms
+                   - self.device_ms())
+
+    def device_fraction(self) -> Optional[float]:
+        """Device share of service burn, None without service samples —
+        the signal that distinguishes 'the chips are saturated' (high)
+        from 'the host/dispatch path is the wall' (low) before a
+        planner spends replicas on it."""
+        service = self.prefill_ms + self.decode_ms
+        if service <= 0:
+            return None
+        return min(1.0, self.device_ms() / service)
 
 
 class PhaseBreakdownSource:
@@ -215,22 +240,31 @@ class PhaseBreakdownSource:
         self._seen: set[str] = set()
 
     @staticmethod
-    def _burn(phases: dict) -> Optional[tuple[float, float, float]]:
+    def _burn(phases: dict,
+              device: Optional[dict] = None,
+              ) -> Optional[tuple[float, float, float, float, float]]:
         received = phases.get("received")
         first = phases.get("first_token")
         finished = phases.get("finished")
         if received is None or finished is None:
             return None
+        dev = device or {}
         prefill_start = phases.get("prefill_start")
         if first is None:
             # Never produced a token (shed late, errored, deadline):
             # everything burned before service counts as queue burn.
-            return ((finished - received) * 1e3, 0.0, 0.0)
+            return ((finished - received) * 1e3, 0.0, 0.0, 0.0, 0.0)
         if prefill_start is None:
             prefill_start = first
+        prefill_wall = max(0.0, first - prefill_start) * 1e3
+        decode_wall = max(0.0, finished - first) * 1e3
         return (max(0.0, prefill_start - received) * 1e3,
-                max(0.0, first - prefill_start) * 1e3,
-                max(0.0, finished - first) * 1e3)
+                prefill_wall,
+                decode_wall,
+                min(prefill_wall,
+                    float(dev.get("prefill_device_ms", 0.0))),
+                min(decode_wall,
+                    float(dev.get("decode_device_ms", 0.0))))
 
     def fetch(self) -> Optional[PhaseBreakdown]:
         try:
@@ -246,14 +280,14 @@ class PhaseBreakdownSource:
         (separated from fetch() so in-process scenarios can feed the
         recorder snapshot directly)."""
         out = PhaseBreakdown()
-        fresh: list[tuple[float, float, float]] = []
+        fresh: list[tuple[float, float, float, float, float]] = []
         seen_now: set[str] = set()
         for tl in snap.get("completed", []):
             rid = tl.get("request_id", "")
             seen_now.add(rid)
             if rid in self._seen:
                 continue
-            burn = self._burn(tl.get("phases", {}))
+            burn = self._burn(tl.get("phases", {}), tl.get("device"))
             if burn is not None:
                 fresh.append(burn)
         # Forget ids that rotated out of the ring so the seen set stays
@@ -263,6 +297,8 @@ class PhaseBreakdownSource:
             out.queue_ms = sum(b[0] for b in fresh) / len(fresh)
             out.prefill_ms = sum(b[1] for b in fresh) / len(fresh)
             out.decode_ms = sum(b[2] for b in fresh) / len(fresh)
+            out.prefill_device_ms = sum(b[3] for b in fresh) / len(fresh)
+            out.decode_device_ms = sum(b[4] for b in fresh) / len(fresh)
             out.samples = len(fresh)
         return out
 

@@ -342,6 +342,19 @@ _register("DYNT_DEBUG_ENDPOINTS", False, _bool,
           "Also serve /debug/requests on the tenant-facing OpenAI "
           "frontend port (it leaks cross-request timelines, so it is "
           "opt-in there; the internal status server always serves it)")
+# Device-time attribution plane (perf/steptrace.py "dynaprof";
+# docs/observability.md §Device-time attribution)
+_register("DYNT_PROF_DIR", "/tmp/dynamo_tpu_profiles", _str,
+          "Directory /debug/profile captures write jax.profiler traces "
+          "into (one timestamped subdirectory per capture; open with "
+          "TensorBoard/XProf)")
+_register("DYNT_PROF_DEFAULT_MS", 1000, _int,
+          "Capture duration for /debug/profile when the request sends "
+          "no duration_ms query parameter")
+_register("DYNT_PROF_MAX_MS", 30000, _int,
+          "Ceiling on a single /debug/profile capture duration — "
+          "profiling holds buffers in the serving process, so an "
+          "operator typo must not pin it for minutes")
 _register("DYNT_SLO_TTFT_MS", 0.0, _float,
           "TTFT target for the dynamo_slo_good_total goodput counter; "
           "0 means no TTFT requirement")

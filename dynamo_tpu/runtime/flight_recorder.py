@@ -56,6 +56,11 @@ class RequestTimeline:
     started: float = dataclasses.field(default_factory=time.time)
     phases: dict = dataclasses.field(default_factory=dict)
     events: list = dataclasses.field(default_factory=list)
+    # Device-time attribution (perf/steptrace.py): accumulated
+    # "<phase>_device_ms" / "<phase>_host_ms" per engine phase, so the
+    # host wall-clock phases above can be split into host vs device
+    # burn (/debug/requests -> planner PhaseBreakdownSource).
+    device: dict = dataclasses.field(default_factory=dict)
     status: Optional[str] = None  # None while inflight
     slow: bool = False
 
@@ -72,6 +77,7 @@ class RequestTimeline:
             "slow": self.slow,
             "elapsed_ms": round(self.elapsed_ms(), 3),
             "phases": {k: round(v, 6) for k, v in self.phases.items()},
+            "device": {k: round(v, 3) for k, v in self.device.items()},
             "events": list(self.events),
         }
 
@@ -134,6 +140,25 @@ class FlightRecorder:
             if tl is not None:
                 tl.phases.setdefault(phase, time.time() if ts is None
                                      else ts)
+
+    def device(self, request_id: Optional[str], phase: str,
+               device_ms: float = 0.0, host_ms: float = 0.0) -> None:
+        """Accumulate device/host burn for an engine phase ("prefill" /
+        "decode") onto the timeline (perf/steptrace.py attribution).
+        No-op for unknown requests, like stamp()."""
+        rid = self._resolve(request_id)
+        if rid is None:
+            return
+        with self._lock:
+            tl = self._inflight.get(rid)
+            if tl is None:
+                return
+            if device_ms:
+                key = f"{phase}_device_ms"
+                tl.device[key] = tl.device.get(key, 0.0) + device_ms
+            if host_ms:
+                key = f"{phase}_host_ms"
+                tl.device[key] = tl.device.get(key, 0.0) + host_ms
 
     def event(self, request_id: Optional[str], name: str, **attrs) -> None:
         """Append a structured event (retry, migration, kv_pull, ...)."""
@@ -201,6 +226,7 @@ class FlightRecorder:
             tl = self._inflight.get(request_id)
             if tl is not None:
                 return dataclasses.replace(tl, phases=dict(tl.phases),
+                                           device=dict(tl.device),
                                            events=list(tl.events))
             for done in reversed(self._completed):
                 if done.request_id == request_id:
@@ -216,6 +242,7 @@ class FlightRecorder:
         completed ones are immutable after finish()."""
         with self._lock:
             inflight = [dataclasses.replace(tl, phases=dict(tl.phases),
+                                            device=dict(tl.device),
                                             events=list(tl.events))
                         for tl in self._inflight.values()]
             completed = list(reversed(self._completed))

@@ -236,21 +236,26 @@ class Tracer:
 
     def record_span(self, name: str, parent: Optional[str], start_ns: int,
                     end_ns: int, kind: int = 1, ok: bool = True,
-                    **attributes) -> None:
+                    **attributes) -> Optional[str]:
         """Record a completed span with EXPLICIT timestamps — how phase
         spans (queue wait, prefill, decode) are synthesized from a
         flight-recorder timeline after the fact, without holding a live
-        span object across the scheduler thread."""
+        span object across the scheduler thread. Returns the recorded
+        span's traceparent so callers can nest further synthesized
+        children (worker.device_execute under the phase spans), or None
+        when export is disabled / the parent is malformed."""
         if not self.enabled:
-            return
+            return None
         ctx = parse_traceparent(parent)
         if ctx is None:
-            return
+            return None
         trace_id, parent_span = ctx
-        self.record(Span(name=name, trace_id=trace_id,
-                         span_id=new_span_id(), parent_span_id=parent_span,
-                         start_ns=start_ns, end_ns=end_ns, kind=kind,
-                         attributes=dict(attributes), ok=ok))
+        span = Span(name=name, trace_id=trace_id,
+                    span_id=new_span_id(), parent_span_id=parent_span,
+                    start_ns=start_ns, end_ns=end_ns, kind=kind,
+                    attributes=dict(attributes), ok=ok)
+        self.record(span)
+        return span.traceparent
 
     def record(self, span: Span) -> None:
         if not self.enabled:

@@ -1,23 +1,106 @@
-"""System status server: /health, /live, /metrics, /debug/requests.
+"""System status server: /health, /live, /metrics, /debug/requests,
+/debug/profile.
 
 Every runtime process exposes liveness, endpoint health, Prometheus
 metrics, and its flight-recorder timelines on an HTTP port (ref:
 lib/runtime/src/system_status_server.rs:131-178). /metrics negotiates
 OpenMetrics (exemplars) via the Accept header; /debug/requests returns
-the per-request phase timelines (docs/observability.md).
+the per-request phase timelines; /debug/profile runs an on-demand
+jax.profiler capture in THIS process and returns the trace artifact
+path (docs/observability.md).
 """
 
 from __future__ import annotations
 
+import asyncio
+import threading
+import time
 from typing import Callable, Optional
 
 from aiohttp import web
 
 from . import metrics
+from .config import env
 from .flight_recorder import get_recorder
 from .logging import get_logger
 
 log = get_logger("status")
+
+# One capture at a time per process: jax.profiler.start_trace is a
+# process-global session, and a second starter would raise (or worse,
+# interleave two operators' captures).
+_PROFILE_LOCK = threading.Lock()
+
+
+async def profile_response(request: web.Request) -> web.Response:
+    """Shared /debug/profile responder (status server + opt-in
+    frontend): run `jax.profiler.start_trace` / `stop_trace` for
+    ?duration_ms= (default DYNT_PROF_DEFAULT_MS, clamped to
+    DYNT_PROF_MAX_MS) and answer with the capture directory. The
+    engine's dispatch scopes carry StepTraceAnnotation marks
+    (perf/steptrace.py), so the capture attributes device ops to
+    decode/prefill/spec phases. 409 while another capture runs; 503
+    when the local jax has no profiler."""
+    try:
+        duration = float(request.query.get(
+            "duration_ms", env("DYNT_PROF_DEFAULT_MS")))
+    except ValueError:
+        return web.json_response(
+            {"error": "duration_ms must be a number"}, status=400)
+    duration = max(1.0, min(duration, float(env("DYNT_PROF_MAX_MS"))))
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return web.json_response(
+            {"error": "a profile capture is already running"}, status=409)
+    try:
+        try:
+            from jax import profiler
+        except Exception as exc:  # noqa: BLE001 — jax-free process
+            return web.json_response(
+                {"error": f"jax.profiler unavailable: {exc!r}"},
+                status=503)
+        import os
+        import uuid
+
+        # Unique per capture (sub-second repeats must not share a dir —
+        # the returned manifest has to identify THIS capture's files).
+        trace_dir = os.path.join(
+            env("DYNT_PROF_DIR"),
+            time.strftime("%Y%m%d-%H%M%S") + f"-{uuid.uuid4().hex[:6]}")
+        os.makedirs(trace_dir, exist_ok=True)
+        # start/stop serialize trace buffers to disk — seconds for a
+        # long capture — and must never freeze the serving event loop
+        # (token streams, /health, the metrics drain all live on it).
+        try:
+            await asyncio.to_thread(profiler.start_trace, trace_dir)
+        except Exception as exc:  # noqa: BLE001 — backend refused
+            return web.json_response(
+                {"error": f"start_trace failed: {exc!r}"}, status=503)
+        try:
+            await asyncio.sleep(duration / 1e3)
+        finally:
+            try:
+                await asyncio.to_thread(profiler.stop_trace)
+            except Exception as exc:  # noqa: BLE001 — a failed stop
+                # still ends the session server-side; report it
+                return web.json_response(
+                    {"error": f"stop_trace failed: {exc!r}",
+                     "trace_dir": trace_dir}, status=500)
+
+        def _walk() -> list[str]:
+            out = []
+            for root, _dirs, names in os.walk(trace_dir):
+                out.extend(os.path.join(os.path.relpath(root, trace_dir),
+                                        name) for name in names)
+            return out
+
+        files = await asyncio.to_thread(_walk)
+        return web.json_response({
+            "trace_dir": trace_dir,
+            "duration_ms": duration,
+            "files": sorted(files),
+        })
+    finally:
+        _PROFILE_LOCK.release()
 
 
 def metrics_response(request: web.Request) -> web.Response:
@@ -70,12 +153,16 @@ class SystemStatusServer:
     async def _debug_requests(self, request: web.Request) -> web.Response:
         return debug_requests_response(request)
 
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        return await profile_response(request)
+
     async def start(self) -> None:
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
