@@ -751,6 +751,59 @@ def bench_goodput_point() -> dict:
     }
 
 
+def bench_two_class_point() -> dict:
+    """Two-class goodput A/B for BENCH_MULTI (ROADMAP item 5 /
+    ISSUE 14): an interactive tenant at a fixed below-knee rate plus a
+    batch tenant ramping ~2x past the knee, served twice — untagged
+    FCFS vs the full QoS plane (priority classes, fair-share quotas,
+    class-strict queues, preempt-to-park). The headline: the
+    interactive goodput curve holds flat past the knee at <= 10% total
+    goodput cost, with batch absorbing the shed and the preemptions
+    (dynamo_tpu/mocker/overload.py, the same scenario the
+    chaos-two-tenant CI job gates on; docs/multi-tenancy.md)."""
+    import asyncio
+
+    from dynamo_tpu.mocker.overload import (
+        TwoTenantParams,
+        run_two_tenant_scenario,
+    )
+
+    params = TwoTenantParams(ramp_secs=16.0, batch_end_rps=20.0)
+    report = asyncio.run(run_two_tenant_scenario(params))
+
+    def tenant_curve(key: str, tenant: str) -> list[dict]:
+        return [{"offered_rps": b["offered_rps"],
+                 "goodput_rps": b["goodput_rps"],
+                 "shed_frac": b["shed_frac"]}
+                for b in report[key]["tenant_buckets"].get(tenant, [])]
+
+    qos, base = report["qos_on"], report["qos_off"]
+    return {
+        "profile": (f"{params.n_decode}-worker mocker; interactive "
+                    f"{params.interactive_rps} rps fixed, batch "
+                    f"{params.batch_start_rps}->{params.batch_end_rps} "
+                    "rps ramp"),
+        "slo_ttft_ms": params.slo_ttft_ms,
+        "knee_bucket": report.get("knee_bucket", 0),
+        "interactive_qos": tenant_curve("qos_on", "interactive"),
+        "interactive_fcfs": tenant_curve("qos_off", "interactive"),
+        "batch_qos": tenant_curve("qos_on", "batch"),
+        "batch_fcfs": tenant_curve("qos_off", "batch"),
+        "good_total_qos": qos["good_total"],
+        "good_total_fcfs": base["good_total"],
+        "total_cost_frac": (round(1 - qos["good_total"]
+                                  / base["good_total"], 4)
+                            if base["good_total"] else None),
+        "preempt": {k: qos["metrics"][f"preempt_{k}"]
+                    for k in ("park", "migrate", "resume")},
+        "tenant_shed": {
+            "batch": qos["metrics"]["tenant_shed_batch"],
+            "interactive": qos["metrics"]["tenant_shed_interactive"],
+        },
+        "assertions_passed": report["passed"],
+    }
+
+
 def main() -> None:
     import jax
 
@@ -795,6 +848,8 @@ def main() -> None:
             result["disagg"] = bench_disagg_point()
         if os.environ.get("DYNT_BENCH_GOODPUT", "1") != "0":
             result["goodput_vs_load"] = bench_goodput_point()
+        if os.environ.get("DYNT_BENCH_TWO_CLASS", "1") != "0":
+            result["two_class_goodput"] = bench_two_class_point()
         if os.environ.get("DYNT_BENCH_SESSION", "1") != "0":
             result["session_cache"] = bench_session_point()
         print(json.dumps(result))
@@ -876,6 +931,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — chip-free point must
             # never cost the round its silicon numbers
             result["goodput_vs_load"] = {"error": repr(exc)}
+    if os.environ.get("DYNT_BENCH_TWO_CLASS", "1") != "0":
+        try:
+            result["two_class_goodput"] = bench_two_class_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["two_class_goodput"] = {"error": repr(exc)}
     if os.environ.get("DYNT_BENCH_SESSION", "1") != "0":
         try:
             result["session_cache"] = bench_session_point()

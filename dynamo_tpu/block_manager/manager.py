@@ -117,6 +117,14 @@ class KvBlockManager:
         self._pins_applied: dict[int, str] = {}
         self._prefetch_q: Optional[object] = None
         self._prefetch_thread = None
+        # Preempt-to-KVBM park store (docs/multi-tenancy.md): request_id
+        # -> host KV bundle of a preempted sequence's computed pages.
+        # NOT hash-keyed cache — parked state must survive until claimed
+        # (a resume that finds its bundle evicted would silently corrupt
+        # the stream), so it lives outside the tier pools' eviction.
+        # Exactly-once discipline: park puts, claim takes (returns None
+        # on a second claim), drop cleans up cancel/expiry paths.
+        self._parked_seqs: dict[str, np.ndarray] = {}
 
     # -- wiring ------------------------------------------------------------
 
@@ -232,6 +240,34 @@ class KvBlockManager:
                 out[i] = data
         self.stats.onboarded_blocks += len(hashes)
         return out
+
+    # -- preempt park store (docs/multi-tenancy.md) ------------------------
+
+    def park_sequence(self, request_id: str, bundle: np.ndarray) -> bool:
+        """Store a preempted sequence's gathered KV pages until resume.
+        Idempotent on the same request id (a re-park refreshes the
+        bundle). Returns True when parked."""
+        with self._lock:
+            self._parked_seqs[request_id] = np.asarray(bundle)
+        return True
+
+    def claim_parked(self, request_id: str) -> Optional[np.ndarray]:
+        """Take a parked bundle EXACTLY ONCE: the first claim returns
+        it and removes it, a second claim (double-resume bug) returns
+        None so the caller degrades to migrate instead of scattering a
+        stale buffer."""
+        with self._lock:
+            return self._parked_seqs.pop(request_id, None)
+
+    def drop_parked(self, request_id: str) -> bool:
+        """Discard a parked bundle (cancelled client / expired
+        deadline). Idempotent; returns whether a bundle was present."""
+        with self._lock:
+            return self._parked_seqs.pop(request_id, None) is not None
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked_seqs)
 
     # -- session pin leases (docs/prompt-caching.md) ----------------------
 

@@ -125,6 +125,19 @@ class _Seq:
     prefill_submit_ts: Optional[float] = None
     device_prefill_ms: float = 0.0
     device_decode_ms: float = 0.0
+    # Multi-tenant QoS (docs/multi-tenancy.md): the request's priority
+    # class orders admission (class-strict, stable within class) and
+    # marks batch slots as preemption donors; parked_pages records how
+    # many leading block-table pages the park bundle covers so resume
+    # scatters exactly what preemption gathered.
+    priority_class: str = "standard"
+    parked_pages: int = 0
+
+    @property
+    def rank(self) -> int:
+        from ..llm.protocols import class_rank
+
+        return class_rank(self.priority_class)
 
     @property
     def decode_ready(self) -> bool:
@@ -172,6 +185,12 @@ class SchedulerStats:
     # scheduler.steptrace.
     device_ms_last_step: float = 0.0
     host_ms_last_step: float = 0.0
+    # Multi-tenant QoS preemption plane (docs/multi-tenancy.md):
+    # batch decode slots parked to KVBM / cooperatively migrated under
+    # interactive pressure, and parked sequences resumed.
+    preempt_parked: int = 0
+    preempt_migrated: int = 0
+    preempt_resumed: int = 0
 
 
 class InferenceScheduler:
@@ -212,6 +231,12 @@ class InferenceScheduler:
         # Disagg chunked handoff: streamed-chunk token budget for
         # prefill-only sequences (0 = the engine's prefill chunk).
         self.disagg_chunk = max(0, int(env("DYNT_DISAGG_CHUNK") or 0))
+        # Multi-tenant QoS preemption (docs/multi-tenancy.md): under
+        # interactive pressure, batch decode slots park-to-KVBM (or
+        # cooperatively migrate when no park store is attached).
+        self.preempt_enabled = bool(env("DYNT_PREEMPT_ENABLE"))
+        self.preempt_max_parked = max(0, int(env("DYNT_PREEMPT_MAX_PARKED")))
+        self._parked: list[_Seq] = []
 
         def _stored(hashes: list[int], parent: Optional[int]) -> None:
             # Fan out G1 registrations to the router event buffer AND the
@@ -363,8 +388,10 @@ class InferenceScheduler:
         return 0.0
 
     def queue_depth(self) -> tuple[int, int]:
+        # Parked (preempted) sequences count as waiting: they hold live
+        # client streams the admission estimators must see as backlog.
         active = sum(1 for s in self._slots if s is not None)
-        return active, len(self._waiting)
+        return active, len(self._waiting) + len(self._parked)
 
     def active_kv_tokens(self) -> int:
         """KV tokens attended by live decode slots — the working-set
@@ -434,10 +461,18 @@ class InferenceScheduler:
                 log.exception("gap callback failed")
 
     def _drain_incoming(self) -> None:
+        added = False
         while True:
             try:
                 request, emit, handle, extra = self._incoming.get_nowait()
             except thread_queue.Empty:
+                if added:
+                    # Class-strict admission order
+                    # (docs/multi-tenancy.md): ONE stable sort per drain
+                    # batch keeps FIFO within a class while a fresh
+                    # interactive arrival overtakes every waiting batch
+                    # request.
+                    self._waiting.sort(key=lambda s: -s.rank)
                 return
             seq = self._prepare(request, emit)
             if seq is not None:
@@ -454,6 +489,7 @@ class InferenceScheduler:
                 if handle._cancelled:  # cancelled before the seq existed
                     seq.cancelled = True
                 self._waiting.append(seq)
+                added = True
 
     def _page_span(self, prompt_len: int, max_tokens: int,
                    with_slack: bool = True) -> int:
@@ -507,6 +543,7 @@ class InferenceScheduler:
                                  np.int32),
             slot=-1, prompt_len=prompt_len, prefill_pos=0, seed=seed,
             processors=processors,
+            priority_class=request.priority or "standard",
         )
         if self.spec_enabled:
             stop_ids = set(request.stop.stop_token_ids)
@@ -566,16 +603,33 @@ class InferenceScheduler:
             procs.append(MinPProcessor(s.min_p, s.temperature))
         return procs or None
 
-    def _admit(self) -> int:
+    def _admit(self, allow_preempt: bool = False) -> int:
         admitted = 0
         while self._waiting:
-            free_slots = [i for i, s in enumerate(self._slots) if s is None]
-            if not free_slots:
-                return admitted
             seq = self._waiting[0]
             if seq.cancelled:
                 self._waiting.pop(0)
                 continue
+            # A parked sequence of the head's class or better resumes
+            # BEFORE the head admits (it was admitted first — letting a
+            # waiting batch request grab the slot ahead of a parked
+            # standard sequence would be the parked-entry inversion all
+            # over again, on the engine).
+            if allow_preempt and self._resume_parked(limit=1,
+                                                     min_rank=seq.rank):
+                admitted += 1
+                continue
+            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            if not free_slots:
+                # Interactive pressure, no slot: preempt a lower-class
+                # decode slot (park-to-KVBM or cooperative migrate) and
+                # retry. allow_preempt only on the step's FIRST admit
+                # pass — the late pass runs with a decode block in
+                # flight whose drain would append tokens to a victim
+                # that no longer owns its pages.
+                if allow_preempt and self._try_preempt_for(seq):
+                    continue
+                break
             total_pages = self._page_span(seq.prompt_len,
                                           seq.request.sampling.max_tokens)
             seq.slack_ok = (
@@ -587,7 +641,11 @@ class InferenceScheduler:
                     with_slack=False)
             alloc = self.pool.allocate(seq.block_hashes, total_pages)
             if alloc is None:
-                return admitted  # no pages; retry next iteration
+                # Page starvation is the other preemption trigger: a
+                # parked batch slot returns its pages to the pool.
+                if allow_preempt and self._try_preempt_for(seq):
+                    continue
+                break  # no pages; retry next iteration
             # Never skip the whole prompt: recompute at least the last token
             # so we have logits to sample from (cached pages stay correct —
             # recomputed KV values are identical).
@@ -611,7 +669,220 @@ class InferenceScheduler:
             admitted += 1
             if seq.onboard_blocks is not None:
                 self._onboard(seq)
+        if allow_preempt:
+            # Pressure check ran: parked sequences resume when slots and
+            # pages are back and nothing higher-class is still waiting.
+            admitted += self._resume_parked()
         return admitted
+
+    # -- preempt-to-KVBM (docs/multi-tenancy.md) ---------------------------
+
+    def _park_capacity_ok(self) -> bool:
+        return (self.kvbm is not None
+                and hasattr(self.kvbm, "park_sequence")
+                and len(self._parked) < self.preempt_max_parked)
+
+    def _preempt_victim(self, head_rank: int) -> Optional[_Seq]:
+        """The cheapest lower-class decode slot to evict: lowest class
+        first, then fewest generated tokens (least KV to move / least
+        work to replay), then slot index for determinism. Only plain
+        decode-ready slots qualify — prefill-only / transfer-owning /
+        first-token-deferred sequences hold state a park cannot carry."""
+        best = None
+        for seq in self._slots:
+            if seq is None or seq.finished or seq.cancelled:
+                continue
+            if seq.prefill_only or seq.keep_pages or seq.first_deferred:
+                continue
+            if not seq.decode_ready or not seq.generated:
+                continue
+            if seq.rank >= head_rank:
+                continue
+            key = (seq.rank, len(seq.generated), seq.slot)
+            if best is None or key < best[0]:
+                best = (key, seq)
+        return best[1] if best is not None else None
+
+    def _try_preempt_for(self, head: _Seq) -> bool:
+        """Free a slot (and its pages) for `head` by preempting a
+        lower-class victim. Returns True only when the park path freed
+        capacity NOW (caller retries admission); a migrate fallback
+        returns False — its slot and pages come back at reap, END of
+        this step, so retrying inside this pass would only cascade into
+        migrating every lower-class slot for one waiting head."""
+        if not self.preempt_enabled:
+            return False
+        victim = self._preempt_victim(head.rank)
+        if victim is None:
+            return False
+        return self._preempt_seq(victim)
+
+    def _preempt_seq(self, victim: _Seq) -> bool:
+        """Preempt one decode slot: gather its computed pages into the
+        KVBM park store and park the sequence (resume continues the
+        committed stream bit-identically — seed, step count, processor
+        and spec state all stay live on the _Seq), or fall back to the
+        cooperative in-band migrate the frontend Migration operator
+        replays on a peer worker. Returns whether the PARK path freed
+        the slot and pages immediately (migrate frees them at reap)."""
+        from ..runtime.metrics import PREEMPT_TOTAL
+        from ..runtime.otel import get_tracer
+
+        rid = victim.request.request_id
+        # KV present on device: positions 0..kv_len-2 (the last
+        # generated token's KV is written by its NEXT decode step).
+        computed = max(0, victim.kv_len - 1)
+        n_pages = -(-computed // self.page_size) if computed else 0
+        span = get_tracer().start_span(
+            "scheduler.preempt",
+            parent=victim.traceparent
+            or (victim.request.annotations or {}).get("traceparent"),
+            **{"request.id": rid, "class": victim.priority_class,
+               "pages": n_pages,
+               "tokens.preserved": len(victim.generated)})
+        parked = False
+        try:
+            if self._park_capacity_ok() and n_pages > 0:
+                ids = np.asarray(victim.block_table[:n_pages], np.int32)
+                # One blocking D2H per preemption: preemption is rare
+                # and the pages must be on host BEFORE they return to
+                # the pool (a release-then-gather would race the next
+                # allocation).
+                bundle = np.asarray(self.runner.gather_pages_device(ids))  # dynalint: disable=DL201 -- park bundle must land on host before the pages free # dynajit: disable=DJ201 -- designed preemption drain: pages are released right after
+                parked = bool(self.kvbm.park_sequence(rid, bundle))
+            span.set_attribute("kind", "park" if parked else "migrate")
+            if parked:
+                self.pool.release(
+                    victim.alloc, victim.block_hashes,
+                    computed_blocks=victim.prefill_pos // self.page_size)
+                self._slots[victim.slot] = None
+                victim.slot = -1
+                victim.alloc = PageAllocation([], [], 0)
+                victim.parked_pages = n_pages
+                self._parked.append(victim)
+                self.stats.preempt_parked += 1
+                PREEMPT_TOTAL.labels(kind="park").inc()
+                get_recorder().event(victim.record_id, "preempt",
+                                     kind="park", pages=n_pages,
+                                     tokens_preserved=len(victim.generated))
+                log.info("preempted %s to KVBM (%d pages, %d tokens kept)",
+                         rid, n_pages, len(victim.generated))
+            else:
+                # Cooperative migrate: the Migration operator replays
+                # prompt+generated on a peer (or here, later) under the
+                # DYNT_PREEMPT_MIGRATION_LIMIT bound. Reap releases the
+                # pages.
+                victim.finished = True
+                self.stats.preempt_migrated += 1
+                PREEMPT_TOTAL.labels(kind="migrate").inc()
+                get_recorder().event(victim.record_id, "preempt",
+                                     kind="migrate",
+                                     tokens_preserved=len(victim.generated))
+                victim.emit(EngineOutput(
+                    finish_reason="migrate",
+                    error="preempted under interactive pressure"))
+                log.info("preempted %s via cooperative migrate", rid)
+        finally:
+            span.end(ok=True)
+        return parked
+
+    def _resume_parked(self, limit: Optional[int] = None,
+                       min_rank: int = -1) -> int:
+        """Resume parked sequences when pressure clears: a free slot,
+        pages available, and no higher-class request still waiting
+        (`min_rank` additionally restricts candidates — the admit loop
+        uses it to resume only entries that outrank the waiting head).
+        Deadline budgets kept burning across the park — an expired
+        sequence is finished honestly instead of resumed into a reply
+        nobody is waiting for."""
+        from ..runtime.metrics import PREEMPT_TOTAL
+
+        if not self._parked:
+            return 0
+        waiting_rank = max(
+            (s.rank for s in self._waiting if not s.cancelled), default=-1)
+        resumed = 0
+        # Higher class resumes first; park order (FIFO) within a class.
+        for seq in sorted(self._parked, key=lambda s: -s.rank):
+            if limit is not None and resumed >= limit:
+                break
+            rid = seq.request.request_id
+            if seq.cancelled:
+                self._parked.remove(seq)
+                self._drop_parked(rid)
+                continue
+            deadline = seq.request.deadline
+            if deadline is not None and deadline.expired():
+                self._parked.remove(seq)
+                self._drop_parked(rid)
+                seq.finished = True
+                get_recorder().event(seq.record_id, "preempt",
+                                     kind="expired")
+                seq.emit(EngineOutput(
+                    finish_reason="error",
+                    error="deadline exceeded while preempted"))
+                continue
+            if seq.rank < waiting_rank or seq.rank < min_rank:
+                continue  # pressure persists: stay parked
+            free_slots = [i for i, s in enumerate(self._slots)
+                          if s is None]
+            if not free_slots:
+                break
+            total_pages = self._page_span(seq.prompt_len,
+                                          seq.request.sampling.max_tokens)
+            seq.slack_ok = (
+                total_pages <= self.runner.config.max_pages_per_seq
+                and total_pages <= self.pool.num_pages - 1)
+            if not seq.slack_ok:
+                total_pages = self._page_span(
+                    seq.prompt_len, seq.request.sampling.max_tokens,
+                    with_slack=False)
+            alloc = self.pool.allocate(seq.block_hashes, total_pages)
+            if alloc is None:
+                break
+            bundle = self.kvbm.claim_parked(rid)
+            if bundle is None:
+                # Park store lost the bundle (should not happen — the
+                # store is eviction-free — but a resume MUST NOT scatter
+                # garbage): degrade to cooperative migrate.
+                self.pool.release(alloc, seq.block_hashes,
+                                  computed_blocks=0)
+                self._parked.remove(seq)
+                seq.finished = True
+                self.stats.preempt_migrated += 1
+                PREEMPT_TOTAL.labels(kind="migrate").inc()
+                seq.emit(EngineOutput(
+                    finish_reason="migrate",
+                    error="park bundle lost; replay elsewhere"))
+                continue
+            self._parked.remove(seq)
+            seq.alloc = alloc
+            pages = alloc.pages
+            seq.block_table[: len(pages)] = pages
+            # Cached prompt-prefix pages already hold identical KV
+            # (same hash chain => same bytes); scatter only the
+            # non-cached span of the park bundle, like _onboard.
+            cached_n = min(alloc.cached_blocks, seq.parked_pages)
+            target = seq.block_table[cached_n: seq.parked_pages]
+            if len(target):
+                self.runner.scatter_pages(
+                    np.asarray(target, np.int32),  # dynalint: disable=DL201 -- host block-table slice to int32, no device transfer
+                    bundle[cached_n:])
+            seq.slot = free_slots[0]
+            self._slots[seq.slot] = seq
+            seq.parked_pages = 0
+            self.stats.preempt_resumed += 1
+            PREEMPT_TOTAL.labels(kind="resume").inc()
+            get_recorder().event(seq.record_id, "preempt", kind="resume",
+                                 tokens_preserved=len(seq.generated))
+            log.info("resumed parked %s (%d tokens preserved)",
+                     rid, len(seq.generated))
+            resumed += 1
+        return resumed
+
+    def _drop_parked(self, rid: str) -> None:
+        if self.kvbm is not None and hasattr(self.kvbm, "drop_parked"):
+            self.kvbm.drop_parked(rid)
 
     def _onboard_from_kvbm(self, seq: _Seq) -> None:
         """KVBM onboard at admission (ref §3.5 onboard flows): prompt
@@ -703,7 +974,10 @@ class InferenceScheduler:
     def _step(self) -> bool:
         start = time.monotonic()
         self.steptrace.begin()
-        admitted = self._admit()
+        # Preemption/resume only on this first admit pass: no decode
+        # block is in flight yet, so a victim's pages can be gathered
+        # and released without racing a pending drain.
+        admitted = self._admit(allow_preempt=True)
         # Deferred prefill tokens from the PREVIOUS iteration: their
         # device work was queued before this iteration's dispatches, so
         # by the time we materialize them below the result is (nearly)
@@ -1626,6 +1900,15 @@ class InferenceScheduler:
                 seq.cancelled = True
                 n += 1
         self._waiting.clear()
+        for seq in self._parked:
+            # Parked sequences migrate too: their park bundles reference
+            # a KV pool that is about to be reinitialized.
+            self._drop_parked(seq.request.request_id)
+            if not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="migrate", error=reason))
+                seq.cancelled = True
+                n += 1
+        self._parked.clear()
         for seq in self._slots:
             if seq is not None and not seq.finished and not seq.cancelled:
                 seq.emit(EngineOutput(finish_reason="migrate", error=reason))

@@ -15,7 +15,11 @@ scheduling/policy.rs):
     realized overlap is well-approximated by the best available). Optimizes
     MEAN TTFT: short or well-cached requests jump long cold ones.
 
-Higher key schedules first. The busy check parks a request only when EVERY
+Higher key schedules first, WITHIN a priority class: the parked heap is
+class-strict (interactive > standard > batch, docs/multi-tenancy.md) —
+a newly arrived higher-class request overtakes every parked lower-class
+entry at drain time, so batch backlog can never head-of-line-block
+interactive traffic. The busy check parks a request only when EVERY
 eligible worker sits above `threshold_frac` of its token budget
 (ref: queue.rs all_workers_busy); requests pinned to specific workers by
 the caller bypass the check, matching the reference's allowed_worker_ids
@@ -33,7 +37,13 @@ import itertools
 import time
 from typing import Callable, Optional, Sequence
 
-from ..runtime.admission import QueueWaitEstimator, check_admission
+from ..llm.protocols import class_rank
+from ..runtime.admission import (
+    QueueWaitEstimator,
+    check_admission,
+    check_tenant_admission,
+    get_tenant_ledger,
+)
 from ..runtime.logging import get_logger
 from ..runtime.resilience import Deadline
 from .protocols import OverlapScores, WorkerWithDpRank
@@ -71,6 +81,13 @@ class QueuedRequest:
     # Session-affinity residency (dynamo_tpu/session): the worker id a
     # live session last landed on; the selector biases toward it.
     affinity_worker: Optional[int] = None
+    # Multi-tenant QoS (docs/multi-tenancy.md): class is STRICT in the
+    # parking heap — every interactive entry drains before any standard
+    # entry, which drains before any batch entry; the policy key only
+    # orders WITHIN a class. tenant keys the fair-share quota check when
+    # the request is about to park.
+    priority_class: str = "standard"
+    tenant: str = ""
 
 
 def fcfs_key(arrival_offset: float, req: QueuedRequest,
@@ -124,10 +141,16 @@ class SchedulerQueue:
         self.policy_name = policy
         self._key_fn = POLICIES[policy]
         self._max_batched = max_batched_tokens or (lambda w: None)
-        # heapq is a min-heap; store -key. The monotone tiebreak keeps
-        # equal-key entries FIFO and makes entries totally ordered so the
-        # heap never compares QueuedRequest objects.
-        self._heap: list[tuple[float, int, QueuedRequest,
+        # heapq is a min-heap; store (-class_rank, -key). Class rank
+        # leads the tuple so drain order is class-STRICT: a newly
+        # arrived interactive entry lands ahead of every parked batch
+        # entry and update() pops it first — the parked-entry priority
+        # inversion fix (an arrival-offset-bearing key would otherwise
+        # let a long-parked batch entry outrank a fresh interactive
+        # one). The monotone tiebreak keeps equal-key entries FIFO and
+        # makes entries totally ordered so the heap never compares
+        # QueuedRequest objects.
+        self._heap: list[tuple[int, float, int, QueuedRequest,
                                asyncio.Future]] = []
         self._seq = itertools.count()
         self._start = time.monotonic()
@@ -191,16 +214,27 @@ class SchedulerQueue:
                 not self._heap
                 and not self._all_busy(req.candidates, threshold)):
             return self._select(req)
-        # About to park: refuse a budget that cannot survive the backlog
-        # ahead of it at the measured drain rate — shed-early instead of
-        # a guaranteed late 504. (An empty heap parks with zero
-        # estimated wait: ordering-only parking must never shed.)
+        # About to park: a tenant over its fair share is refused first
+        # (shed reason="quota" — parking IS contention), then refuse a
+        # budget that cannot survive the backlog ahead of it at the
+        # measured drain rate — shed-early instead of a guaranteed late
+        # 504. (An empty heap parks with zero estimated wait:
+        # ordering-only parking must never shed.) tokens=0: the entry
+        # edge already deposited this request's cost — re-adding it
+        # here would double-count the request against its own share.
+        # The backlog ahead of THIS entry is only the entries of its
+        # class or better — lower-class entries cannot delay it.
+        check_tenant_admission(get_tenant_ledger(), req.tenant, 0,
+                               contended=True)
+        rank = class_rank(req.priority_class)
+        ahead = sum(1 for neg_rank, *_ in self._heap if -neg_rank >= rank)
         check_admission(self.wait_estimator, req.deadline,
-                        extra=len(self._heap))
+                        extra=ahead, tenant=req.tenant)
         arrival = time.monotonic() - self._start
         key = self._key_fn(arrival, req, self.scheduler.config.block_size)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
-        heapq.heappush(self._heap, (-key, next(self._seq), req, future))
+        heapq.heappush(self._heap, (-rank, -key, next(self._seq), req,
+                                    future))
         log.debug("workers busy or backlog pending; parked request "
                   "(pending=%d)", len(self._heap))
         self._ensure_ticker()
@@ -256,7 +290,7 @@ class SchedulerQueue:
         if threshold is None:
             return
         while self._heap:
-            neg_key, seq, req, future = self._heap[0]
+            _neg_rank, _neg_key, seq, req, future = self._heap[0]
             if future.done():  # caller gave up (cancelled/timeout)
                 heapq.heappop(self._heap)
                 continue

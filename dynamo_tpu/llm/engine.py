@@ -184,6 +184,8 @@ class KvRouterEngine(TokenEngine):
                 request_id=request_id,
                 deadline=request.deadline,
                 affinity_worker=affinity,
+                priority_class=request.priority,
+                tenant=request.tenant,
             ))
             sspan.set_attribute("worker.instance",
                                 f"{result.worker.worker_id:x}")
@@ -262,6 +264,15 @@ class MultimodalEngine(TokenEngine):
             yield output
 
 
+class CooperativeMigration(ConnectionLost):
+    """In-band `finish_reason="migrate"` from a worker: a PLANNED
+    hand-off (elastic reshard, QoS preemption without a local park
+    slot), not a failure. Bounded separately from failure migrations
+    (DYNT_PREEMPT_MIGRATION_LIMIT vs migration_limit) and replayed
+    without backoff jitter — the worker asked us to move, nothing is
+    broken, and sleeping would only stretch the client's stall."""
+
+
 class Migration(TokenEngine):
     """Retry a broken stream on another worker, preserving generated tokens
     (ref: lib/llm/src/migration.rs:36 — accumulated tokens are replayed so
@@ -269,17 +280,28 @@ class Migration(TokenEngine):
     request's end-to-end deadline: every replay consumes the remaining
     budget — propagated down through the router's headers — instead of a
     fresh flat timeout, and backoff between replays is jittered by a
-    RetryPolicy)."""
+    RetryPolicy). Worker-initiated cooperative migrations (in-band
+    `finish_reason="migrate"`) carry their own bound (`cooperative_limit`,
+    DYNT_PREEMPT_MIGRATION_LIMIT) and skip the backoff — a planner/QoS
+    decision to move a sequence must not consume the failure budget that
+    protects against crash loops."""
 
     def __init__(self, inner: TokenEngine, migration_limit: int = 3,
-                 retry_policy: Optional[RetryPolicy] = None) -> None:
+                 retry_policy: Optional[RetryPolicy] = None,
+                 cooperative_limit: Optional[int] = None) -> None:
+        from ..runtime.config import env
+
         self.inner = inner
         self.migration_limit = migration_limit
+        self.cooperative_limit = (env("DYNT_PREEMPT_MIGRATION_LIMIT")
+                                  if cooperative_limit is None
+                                  else cooperative_limit)
         self.policy = retry_policy or RetryPolicy.from_env()
 
     async def generate(self, request: PreprocessedRequest) -> AsyncIterator[EngineOutput]:
         generated: list[int] = []
         attempts = 0
+        coop_attempts = 0
         prev_delay: Optional[float] = None
         current = request
         while True:
@@ -287,10 +309,11 @@ class Migration(TokenEngine):
                 async for output in self.inner.generate(current):
                     if output.finish_reason == "migrate":
                         # In-band migration request from the worker (e.g.
-                        # elastic reshard evicted the sequence): retry like a
-                        # broken stream, tokens preserved. Never reaches the
-                        # client.
-                        raise ConnectionLost(
+                        # elastic reshard or QoS preemption evicted the
+                        # sequence): retry like a broken stream, tokens
+                        # preserved, but on the COOPERATIVE bound. Never
+                        # reaches the client.
+                        raise CooperativeMigration(
                             output.error or "worker requested migration")
                     if current.prior_output_tokens \
                             and output.prompt_tokens is not None:
@@ -305,9 +328,16 @@ class Migration(TokenEngine):
                     yield output
                 return
             except (ConnectionLost, NoInstancesAvailable, asyncio.TimeoutError) as exc:
-                attempts += 1
-                if attempts > self.migration_limit:
-                    log.warning("migration limit reached for %s: %r",
+                cooperative = isinstance(exc, CooperativeMigration)
+                if cooperative:
+                    coop_attempts += 1
+                else:
+                    attempts += 1
+                if (coop_attempts > self.cooperative_limit
+                        if cooperative else
+                        attempts > self.migration_limit):
+                    log.warning("%smigration limit reached for %s: %r",
+                                "cooperative " if cooperative else "",
                                 request.request_id, exc)
                     yield EngineOutput(finish_reason="error",
                                        error=f"migration limit exceeded: {exc}")
@@ -327,18 +357,24 @@ class Migration(TokenEngine):
                 if remaining <= 0:
                     yield EngineOutput(finish_reason="length")
                     return
-                log.info("migrating %s (attempt %d, %d tokens preserved)",
-                         request.request_id, attempts, len(generated))
+                log.info("migrating %s (%sattempt %d, %d tokens preserved)",
+                         request.request_id,
+                         "cooperative " if cooperative else "",
+                         coop_attempts if cooperative else attempts,
+                         len(generated))
                 # Replay marker on the trace + flight record: the worker
                 # leg is being replaced, tokens preserved.
                 get_tracer().start_span(
                     "migration.replay", parent=_traceparent_of(request),
                     **{"request.id": request.request_id,
-                       "attempt": attempts,
+                       "attempt": coop_attempts if cooperative else attempts,
+                       "cooperative": cooperative,
                        "tokens.preserved": len(generated),
                        "cause": repr(exc)}).end(ok=True)
                 get_recorder().event(request.request_id, "migration",
-                                     attempt=attempts,
+                                     attempt=(coop_attempts if cooperative
+                                              else attempts),
+                                     cooperative=cooperative,
                                      tokens_preserved=len(generated),
                                      cause=str(exc))
                 sampling = type(request.sampling)(**{
@@ -366,6 +402,13 @@ class Migration(TokenEngine):
                     cache_ttl=request.cache_ttl,
                     session_id=request.session_id,
                 )
+                if cooperative:
+                    # Planned hand-off: replay immediately (yield once so
+                    # the loop stays fair). Backoff exists to spread
+                    # retry storms off a FAILING instance; a cooperative
+                    # move has no failing instance to protect.
+                    await asyncio.sleep(0)
+                    continue
                 delay = self.policy.next_delay(prev_delay)
                 prev_delay = delay
                 if request.deadline is not None:

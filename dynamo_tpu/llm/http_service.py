@@ -82,6 +82,10 @@ class _SloObserver:
                  wait_estimator=None) -> None:
         self.model = preprocessed.model
         self.request_id = preprocessed.request_id
+        # Per-class / per-tenant goodput attribution (the multi-tenant
+        # QoS headline, docs/multi-tenancy.md).
+        self.priority = preprocessed.priority or "standard"
+        self.tenant = preprocessed.tenant or "untagged"
         trace_id = _trace_id_of(preprocessed)
         self.exemplar = {"trace_id": trace_id} if trace_id else None
         self.start = time.monotonic()
@@ -131,7 +135,9 @@ class _SloObserver:
         if self._finalized:
             return
         self._finalized = True
-        rt_metrics.SLO_REQUESTS.labels(model=self.model).inc()
+        rt_metrics.SLO_REQUESTS.labels(model=self.model,
+                                       priority=self.priority,
+                                       tenant=self.tenant).inc()
         if not ok:
             return
         # An unset target always passes: a clean zero-token completion
@@ -143,7 +149,9 @@ class _SloObserver:
             return
         if self.itl_target_ms and self.itl_max * 1e3 > self.itl_target_ms:
             return
-        rt_metrics.SLO_GOOD.labels(model=self.model).inc()
+        rt_metrics.SLO_GOOD.labels(model=self.model,
+                                   priority=self.priority,
+                                   tenant=self.tenant).inc()
 
 
 class HttpService:
@@ -252,8 +260,20 @@ class HttpService:
             )
         return deadline
 
+    @staticmethod
+    def _refused_503(exc: AdmissionRefused) -> web.HTTPServiceUnavailable:
+        """The ONE AdmissionRefused -> 503 translation (body shape +
+        integer Retry-After) every pre-dispatch admission edge raises."""
+        return web.HTTPServiceUnavailable(
+            text=json.dumps(_error_body(503, str(exc), "overloaded")),
+            content_type="application/json",
+            headers={"Retry-After": str(max(1, math.ceil(
+                exc.retry_after_s)))},
+        )
+
     def _check_queue_admission(self, entry: ModelEntry,
-                               deadline: Optional[Deadline]) -> None:
+                               deadline: Optional[Deadline],
+                               tenant: str = "") -> None:
         """Deadline-aware admission (the shed-early rung of the
         degradation ladder, docs/fault-tolerance.md): refuse a request
         whose budget cannot survive the estimated queue wait of the
@@ -262,14 +282,57 @@ class HttpService:
         backlog AHEAD of this arrival (extra=0): an empty pool admits
         regardless of how slow the measured drain is."""
         try:
-            check_admission(entry.wait_estimator, deadline)
+            check_admission(entry.wait_estimator, deadline, tenant=tenant)
         except AdmissionRefused as exc:
-            raise web.HTTPServiceUnavailable(
-                text=json.dumps(_error_body(503, str(exc), "overloaded")),
-                content_type="application/json",
-                headers={"Retry-After": str(max(1, math.ceil(
-                    exc.retry_after_s)))},
-            )
+            raise self._refused_503(exc)
+
+    @staticmethod
+    def _tenant_of(request: web.Request, body: dict) -> str:
+        """Tenant identity for shed attribution BEFORE preprocessing —
+        same precedence as _fold_qos_headers (body wins over the
+        header) and the same bound the preprocessor applies, so queue
+        sheds and quota/goodput series always name the same tenant."""
+        raw = body.get("tenant") or request.headers.get(
+            "x-dynt-tenant-id") or ""
+        return str(raw).strip()[:64]
+
+    @staticmethod
+    def _fold_qos_headers(request: web.Request, body: dict) -> dict:
+        """Multi-tenant QoS wire surface (docs/multi-tenancy.md): the
+        x-dynt-priority / x-dynt-tenant-id headers fold into the body
+        fields the preprocessor normalizes. Body fields win on conflict
+        (the more specific declaration). Shared by every completion-
+        shaped endpoint."""
+        pr = request.headers.get("x-dynt-priority")
+        if pr and not body.get("priority"):
+            body["priority"] = pr
+        ten = request.headers.get("x-dynt-tenant-id")
+        if ten and not body.get("tenant"):
+            body["tenant"] = ten
+        return body
+
+    def _check_tenant_quota(self, entry: ModelEntry,
+                            preprocessed: PreprocessedRequest) -> None:
+        """Weighted fair-share admission (runtime/admission.py
+        TenantLedger): refuse an over-share tenant under contention
+        with 503 + Retry-After BEFORE dispatch. The entry edge — it
+        deposits admitted token costs into the shared ledger the
+        downstream (router queue / prefill) edges read. Contention =
+        the pool's queue-wait estimate is non-zero (work is waiting)."""
+        from ..runtime.admission import (
+            check_tenant_admission,
+            get_tenant_ledger,
+        )
+
+        tokens = (len(preprocessed.token_ids)
+                  + preprocessed.sampling.max_tokens)
+        contended = entry.wait_estimator.estimate_wait_ms() > 0
+        try:
+            check_tenant_admission(get_tenant_ledger(),
+                                   preprocessed.tenant, tokens,
+                                   contended=contended, observe=True)
+        except AdmissionRefused as exc:
+            raise self._refused_503(exc)
 
     def _session_prepare(self, request: web.Request,
                          body: dict) -> tuple[dict, Optional[str], list]:
@@ -373,10 +436,12 @@ class HttpService:
         entry, lora = self._lookup(model)
         self._check_busy(entry)
         deadline = self._admit_deadline(request, entry)
-        self._check_queue_admission(entry, deadline)
+        self._check_queue_admission(entry, deadline,
+                                    tenant=self._tenant_of(request, body))
         sid, anchors_raw = None, []
         if kind == "chat":
             body, sid, anchors_raw = self._session_prepare(request, body)
+        body = self._fold_qos_headers(request, body)
         pre_start = time.monotonic()
         try:
             if kind == "chat":
@@ -388,6 +453,9 @@ class HttpService:
         rt_metrics.STAGE_DURATION.labels(stage="preprocess",
                                          model=model).observe(
             time.monotonic() - pre_start)
+        # Fair-share quota edge: after preprocessing (the token cost is
+        # known), before any dispatch work.
+        self._check_tenant_quota(entry, preprocessed)
         preprocessed.lora_name = lora
         preprocessed.deadline = deadline
         # W3C trace-context propagation + span export: the frontend opens a
@@ -971,6 +1039,12 @@ class HttpService:
             "top_k": body.get("top_k", 0),
             "stop": body.get("stop_sequences"),
         }
+        # QoS fields ride every completion-shaped endpoint
+        # (docs/multi-tenancy.md); the preprocessor validates the class.
+        if body.get("priority"):
+            chat["priority"] = body["priority"]
+        if body.get("tenant"):
+            chat["tenant"] = body["tenant"]
         return chat
 
     @staticmethod
@@ -993,13 +1067,16 @@ class HttpService:
         entry, lora = self._lookup(model)
         self._check_busy(entry)
         deadline = self._admit_deadline(request, entry)
-        self._check_queue_admission(entry, deadline)
+        self._check_queue_admission(entry, deadline,
+                                    tenant=self._tenant_of(request, body))
         clean_body, sid, anchors_raw = self._session_prepare(request, body)
+        clean_body = self._fold_qos_headers(request, clean_body)
         try:
             chat_body = self._messages_to_chat(clean_body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
+        self._check_tenant_quota(entry, preprocessed)
         preprocessed.lora_name = lora
         preprocessed.deadline = deadline
         if self.recorder is not None:
@@ -1194,13 +1271,19 @@ class HttpService:
                                  "content": content or ""})
         else:
             raise RequestError("'input' must be a string or message list")
-        return {
+        chat = {
             "model": body.get("model", ""),
             "messages": messages,
             "max_tokens": body.get("max_output_tokens"),
             "temperature": body.get("temperature", 1.0),
             "top_p": body.get("top_p", 1.0),
         }
+        # QoS fields ride every completion-shaped endpoint.
+        if body.get("priority"):
+            chat["priority"] = body["priority"]
+        if body.get("tenant"):
+            chat["tenant"] = body["tenant"]
+        return chat
 
     def _responses_body(self, resp_id: str, model: str,
                         delta_gen: DeltaGenerator, status: str) -> dict:
@@ -1238,12 +1321,15 @@ class HttpService:
         entry, lora = self._lookup(model)
         self._check_busy(entry)
         deadline = self._admit_deadline(request, entry)
-        self._check_queue_admission(entry, deadline)
+        self._check_queue_admission(entry, deadline,
+                                    tenant=self._tenant_of(request, body))
+        body = self._fold_qos_headers(request, body)
         try:
             chat_body = self._responses_to_chat(body)
             preprocessed = entry.preprocessor.preprocess_chat(chat_body)
         except RequestError as exc:
             return web.json_response(_error_body(400, str(exc)), status=400)
+        self._check_tenant_quota(entry, preprocessed)
         preprocessed.lora_name = lora
         preprocessed.deadline = deadline
         if self.recorder is not None:

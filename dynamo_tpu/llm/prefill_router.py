@@ -18,7 +18,12 @@ from __future__ import annotations
 import dataclasses
 from typing import AsyncIterator, Callable, Optional
 
-from ..runtime.admission import QueueWaitEstimator, check_admission
+from ..runtime.admission import (
+    QueueWaitEstimator,
+    check_admission,
+    check_tenant_admission,
+    get_tenant_ledger,
+)
 from ..runtime.logging import get_logger
 from ..runtime.otel import get_tracer
 from ..runtime.push_router import NoInstancesAvailable, PushRouter
@@ -199,7 +204,15 @@ class PrefillRouterEngine(TokenEngine):
         # a budget that cannot survive the prefill queue would burn a
         # full prompt pass for a client that has already timed out. The
         # wait is the backlog AHEAD of this leg; an idle pool admits.
-        check_admission(pool.wait_estimator, request.deadline)
+        # An over-share tenant is quota-refused first when the prefill
+        # pool is backlogged (contention is prefill-pool-local here).
+        # tokens=0: the entry edge already deposited this request's
+        # cost — re-adding it would double-count it against its share.
+        check_tenant_admission(
+            get_tenant_ledger(), request.tenant, 0,
+            contended=pool.wait_estimator.depth() > 0)
+        check_admission(pool.wait_estimator, request.deadline,
+                        tenant=request.tenant)
         params = await self._run_prefill(pool, request)
         if params is not None:
             request = dataclasses.replace(

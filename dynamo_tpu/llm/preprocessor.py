@@ -210,6 +210,17 @@ class OpenAIPreprocessor:
             raise RequestError(
                 f"top_logprobs={sampling.top_logprobs} exceeds the engine "
                 f"maximum of {TOP_LOGPROBS_K}")
+        from .protocols import normalize_priority
+
+        try:
+            # Multi-tenant QoS wire surface (docs/multi-tenancy.md): the
+            # body `priority` field (the x-dynt-priority header is folded
+            # into the body by the HTTP layer before preprocessing) and
+            # the tenant identity, normalized once here so every queue
+            # downstream sees a validated class.
+            priority = normalize_priority(request.get("priority"))
+        except ValueError as exc:
+            raise RequestError(str(exc))
         pre = PreprocessedRequest(
             request_id=new_request_id(),
             token_ids=token_ids,
@@ -222,6 +233,12 @@ class OpenAIPreprocessor:
             ),
             eos_token_ids=list(self.tokenizer.eos_token_ids),
             model=request.get("model", self.card.name),
+            priority=priority,
+            # Tenant ids become Prometheus label values: bound the
+            # per-request blast radius (strip + truncate). Cardinality
+            # itself is the operator's contract — tenant ids should be
+            # a bounded, authenticated set (docs/multi-tenancy.md).
+            tenant=str(request.get("tenant") or "").strip()[:64],
         )
         nvext = request.get("nvext")
         if isinstance(nvext, dict):

@@ -63,6 +63,35 @@ class StopConditions:
         return cls(**{k: v for k, v in (data or {}).items() if k in fields})
 
 
+# Multi-tenant QoS (docs/multi-tenancy.md): the priority classes a
+# request may declare on the wire (`priority` body field or
+# x-dynt-priority header), strongest first. Class is STRICT at every
+# queue — interactive never parks behind batch — and batch is the
+# preemption donor under interactive pressure.
+PRIORITY_CLASSES = ("interactive", "standard", "batch")
+_CLASS_RANK = {"interactive": 2, "standard": 1, "batch": 0}
+
+
+def class_rank(priority: str) -> int:
+    """Numeric rank of a priority class (higher schedules first).
+    Unknown strings rank as `standard` — rank is an ordering helper,
+    validation happens at the preprocessor edge."""
+    return _CLASS_RANK.get(priority, _CLASS_RANK["standard"])
+
+
+def normalize_priority(raw) -> str:
+    """Validate + normalize a wire priority value. None/"" defaults to
+    `standard`; anything else must name a known class."""
+    if raw is None or raw == "":
+        return "standard"
+    val = str(raw).strip().lower()
+    if val not in PRIORITY_CLASSES:
+        raise ValueError(
+            f"unknown priority {raw!r} (expected one of "
+            f"{'|'.join(PRIORITY_CLASSES)})")
+    return val
+
+
 @dataclasses.dataclass
 class PreprocessedRequest:
     """What the frontend sends to a worker (ModelInput.Tokens)."""
@@ -103,6 +132,16 @@ class PreprocessedRequest:
     cache_anchors: list[int] = dataclasses.field(default_factory=list)
     cache_ttl: Optional[float] = None
     session_id: Optional[str] = None
+    # Multi-tenant QoS (docs/multi-tenancy.md): the normalized priority
+    # class (interactive | standard | batch; preprocessor-validated) and
+    # the tenant identity (x-dynt-tenant-id / `tenant` body field; ""
+    # = untagged). Both default-valued = wire-identical to the pre-QoS
+    # protocol. Priority is class-STRICT at every queue and on the chip
+    # (batch decode slots are the preemption donors); tenant keys the
+    # fair-share TenantLedger at the admission edges and labels the
+    # shed/goodput metrics.
+    priority: str = "standard"
+    tenant: str = ""
     # End-to-end budget (runtime/resilience.py Deadline), stamped by the
     # frontend at admission. NOT serialized by to_wire: it crosses the
     # request plane as the x-dynt-deadline-ms header (re-encoded as
@@ -157,6 +196,10 @@ class PreprocessedRequest:
             out["cache_ttl"] = self.cache_ttl
         if self.session_id:
             out["session_id"] = self.session_id
+        if self.priority != "standard":
+            out["priority"] = self.priority
+        if self.tenant:
+            out["tenant"] = self.tenant
         return out
 
     @classmethod
@@ -178,6 +221,8 @@ class PreprocessedRequest:
             cache_anchors=list(data.get("cache_anchors") or []),
             cache_ttl=data.get("cache_ttl"),
             session_id=data.get("session_id"),
+            priority=data.get("priority") or "standard",
+            tenant=data.get("tenant") or "",
         )
 
 

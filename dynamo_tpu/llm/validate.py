@@ -24,6 +24,12 @@ _COMMON_FIELDS = {
     "frequency_penalty", "presence_penalty", "repetition_penalty",
     "min_p", "min_tokens", "logprobs", "top_logprobs",
     "stop", "ignore_eos", "n", "user", "logit_bias", "metadata", "nvext",
+    # Multi-tenant QoS (docs/multi-tenancy.md): priority class
+    # (interactive | standard | batch; value validated in the
+    # preprocessor) and tenant identity. Top-level on every
+    # completion-shaped endpoint; the x-dynt-priority /
+    # x-dynt-tenant-id headers fold into these fields.
+    "priority", "tenant",
 }
 CHAT_FIELDS = _COMMON_FIELDS | {
     "messages", "tools", "tool_choice", "response_format",

@@ -268,6 +268,12 @@ class _Sequence:
     host_decode_ms: float = 0.0
     prefill_flushed: bool = False
 
+    @property
+    def rank(self) -> int:
+        from ..llm.protocols import class_rank
+
+        return class_rank(self.request.priority)
+
 
 class MockerEngine:
     """Continuous-batching simulator; `generate` is a worker handler."""
@@ -286,6 +292,18 @@ class MockerEngine:
         self.kv = _PagedKvCache(self.config.num_blocks)
         self._waiting: list[_Sequence] = []
         self._running: list[_Sequence] = []
+        # Multi-tenant QoS (docs/multi-tenancy.md): preempted batch
+        # sequences parked off their slots/blocks (the chip-free analog
+        # of the real scheduler's preempt-to-KVBM), resumed when
+        # interactive pressure clears. Mirrors the real engine's
+        # dynamo_preempt_total counters so chaos scenarios assert the
+        # plane without silicon.
+        from ..runtime.config import env
+
+        self._parked: list[_Sequence] = []
+        self.preempt_enabled = bool(env("DYNT_PREEMPT_ENABLE"))
+        self.preempt_parked = 0
+        self.preempt_resumed = 0
         self._publisher = event_publisher
         self._event_id = 0
         self._step_task: Optional[asyncio.Task] = None
@@ -363,7 +381,9 @@ class MockerEngine:
             active_blocks=self.kv.used,
             total_blocks=self.kv.capacity,
             active_requests=len(self._running),
-            waiting_requests=len(self._waiting),
+            # Parked (preempted) sequences are backlog the admission
+            # estimators must see, exactly like the real scheduler.
+            waiting_requests=len(self._waiting) + len(self._parked),
             kv_usage=self.kv.usage(),
             step_wall_ms=self.last_step_wall_ms,
             device_ms_in_step=self.last_step_device_ms,
@@ -425,7 +445,7 @@ class MockerEngine:
         """One iteration = admit + (chunked) prefill progress + one decode
         token per running sequence, then sleep the modeled step time."""
         while not self._closed:
-            if not self._running and not self._waiting:
+            if not self._running and not self._waiting and not self._parked:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
@@ -525,11 +545,25 @@ class MockerEngine:
 
     def _admit(self, evict_cb) -> None:
         cfg = self.config
-        while self._waiting and len(self._running) < cfg.max_batch:
+        # Class-strict admission (docs/multi-tenancy.md): stable sort
+        # keeps FIFO within a class, a fresh interactive arrival
+        # overtakes every waiting batch request.
+        self._waiting.sort(key=lambda s: -s.rank)
+        while self._waiting:
             seq = self._waiting[0]
             if seq.cancelled:
                 self._waiting.pop(0)
                 continue
+            # A parked sequence of the head's class or better resumes
+            # before the head admits (it was admitted first).
+            if self._resume_parked(evict_cb, limit=1, min_rank=seq.rank):
+                continue
+            if len(self._running) >= cfg.max_batch:
+                # Slot pressure: preempt a lower-class decode slot (the
+                # chip-free park-to-KVBM analog) and retry.
+                if self._try_preempt_for(seq):
+                    continue
+                break
             cached = self.kv.match_prefix(seq.block_hashes)
             # Pin the matched prefix BEFORE allocating: allocation may evict
             # unreferenced cached blocks, and it must not evict the ones we
@@ -558,6 +592,10 @@ class MockerEngine:
             if (reclaimable - need < reserve and self._running) \
                     or not self.kv.allocate(need, evict_cb):
                 self.kv.unpin(prefix)
+                # Block pressure is the other preemption trigger: a
+                # parked batch slot returns its blocks.
+                if self._try_preempt_for(seq):
+                    continue
                 break  # wait for blocks to free up
             seq.cached_blocks = cached
             seq.new_blocks = need
@@ -574,6 +612,122 @@ class MockerEngine:
                 seq.prefilled_tokens = len(seq.request.token_ids)
             self._waiting.pop(0)
             self._running.append(seq)
+        self._resume_parked(evict_cb)
+
+    # -- preemption (docs/multi-tenancy.md; the real engine's
+    # preempt-to-KVBM plane, simulated chip-free) -------------------------
+
+    def _try_preempt_for(self, head: "_Sequence") -> bool:
+        """Park the cheapest lower-class decode slot so `head` can
+        admit. Returns True when a victim was parked."""
+        if not self.preempt_enabled:
+            return False
+        victim = None
+        vkey = None
+        for seq in self._running:
+            if seq.done or seq.cancelled:
+                continue
+            if seq.prefilled_tokens < len(seq.request.token_ids):
+                continue
+            if seq.request.annotations.get("prefill_only"):
+                continue
+            if seq.generated < 1 or seq.rank >= head.rank:
+                continue
+            key = (seq.rank, seq.generated)
+            if vkey is None or key < vkey:
+                victim, vkey = seq, key
+        if victim is None:
+            return False
+        self._running.remove(victim)
+        self._park_seq(victim)
+        self._parked.append(victim)
+        self.preempt_parked += 1
+        try:
+            from ..runtime.metrics import PREEMPT_TOTAL
+
+            PREEMPT_TOTAL.labels(kind="park").inc()
+        except Exception:  # noqa: BLE001 — metrics must not break sims
+            pass
+        from ..runtime.flight_recorder import get_recorder
+
+        get_recorder().event(victim.request.request_id, "preempt",
+                             kind="park",
+                             tokens_preserved=victim.generated)
+        return True
+
+    def _park_seq(self, seq: "_Sequence") -> None:
+        """Return the victim's blocks to the pool, keeping the sequence
+        (prefill position, generated count) live for resume — the mock
+        analog of gathering pages into the KVBM park store. Prefilled
+        full prompt blocks enter the reusable cache (the offloaded KV
+        stays matchable, so resume onload is ~free exactly like a KVBM
+        hit)."""
+        cfg = self.config
+        self.kv.unpin(seq.pinned)
+        prefilled_blocks = seq.prefilled_tokens // cfg.block_size
+        full_prompt_blocks = min(len(seq.block_hashes), prefilled_blocks)
+        new_cached = seq.block_hashes[seq.cached_blocks:full_prompt_blocks]
+        newly = self.kv.insert_cached(
+            new_cached, from_used=min(len(new_cached), seq.new_blocks))
+        leftover = seq.new_blocks - min(len(new_cached), seq.new_blocks)
+        self.kv.release(leftover)
+        if newly:
+            parent = (seq.block_hashes[seq.cached_blocks - 1]
+                      if seq.cached_blocks > 0 else None)
+            self._pending_stored.append((newly, parent))
+        seq.pinned = []
+        seq.new_blocks = 0
+
+    def _resume_parked(self, evict_cb, limit=None, min_rank=-1) -> int:
+        """Re-admit parked sequences when slots and blocks are back and
+        nothing higher-class is still waiting (higher class first, park
+        order within a class). Returns how many resumed."""
+        if not self._parked:
+            return 0
+        cfg = self.config
+        waiting_rank = max(
+            (s.rank for s in self._waiting if not s.cancelled), default=-1)
+        resumed = 0
+        for seq in sorted(self._parked, key=lambda s: -s.rank):
+            if limit is not None and resumed >= limit:
+                break
+            if seq.cancelled:
+                self._parked.remove(seq)
+                continue
+            if seq.rank < waiting_rank or seq.rank < min_rank:
+                continue  # pressure persists: stay parked
+            if len(self._running) >= cfg.max_batch:
+                break
+            cached = self.kv.match_prefix(seq.block_hashes)
+            prefix = seq.block_hashes[:cached]
+            self.kv.pin(prefix)
+            total_blocks = (
+                len(seq.request.token_ids)
+                + seq.request.sampling.max_tokens
+            ) // cfg.block_size + 1
+            need = max(0, total_blocks - cached)
+            if not self.kv.allocate(need, evict_cb):
+                self.kv.unpin(prefix)
+                break
+            seq.cached_blocks = cached
+            seq.new_blocks = need
+            seq.pinned = prefix
+            self._parked.remove(seq)
+            self._running.append(seq)
+            resumed += 1
+            self.preempt_resumed += 1
+            try:
+                from ..runtime.metrics import PREEMPT_TOTAL
+
+                PREEMPT_TOTAL.labels(kind="resume").inc()
+            except Exception:  # noqa: BLE001 — metrics must not break
+                pass
+            from ..runtime.flight_recorder import get_recorder
+
+            get_recorder().event(seq.request.request_id, "preempt",
+                                 kind="resume",
+                                 tokens_preserved=seq.generated)
+        return resumed
 
     def _prefill_step(self) -> tuple[int, list["_Sequence"]]:
         """Advance prefills within the chunked budget; returns (tokens

@@ -50,11 +50,19 @@ class TraceRecord:
     isl: int
     osl: int
     hash_ids: Optional[list[int]] = None
+    # Multi-tenant QoS (docs/multi-tenancy.md): optional tenant identity
+    # + priority class per record; replay threads them onto the request.
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
 
     def to_wire(self) -> dict:
         out = {"ts_ms": self.ts_ms, "isl": self.isl, "osl": self.osl}
         if self.hash_ids is not None:
             out["hash_ids"] = self.hash_ids
+        if self.tenant:
+            out["tenant"] = self.tenant
+        if self.priority:
+            out["priority"] = self.priority
         return out
 
 
@@ -71,6 +79,8 @@ def load_trace(path: str) -> list[TraceRecord]:
                 isl=int(d.get("isl", d.get("input_length", 0))),
                 osl=int(d.get("osl", d.get("output_length", 1))),
                 hash_ids=d.get("hash_ids"),
+                tenant=d.get("tenant"),
+                priority=d.get("priority"),
             ))
     records.sort(key=lambda r: r.ts_ms)
     return records
@@ -183,6 +193,119 @@ def parse_ramp_spec(spec: str) -> tuple[float, float, float]:
     return start, end, seconds
 
 
+@dataclasses.dataclass
+class TenantSpec:
+    """One tenant's traffic shape in a multi-tenant run
+    (docs/multi-tenancy.md): a named tenant sending `priority`-class
+    requests at a linearly ramping Poisson rate."""
+
+    name: str
+    priority: str = "standard"
+    start_rps: float = 1.0
+    end_rps: float = 1.0
+
+
+def parse_tenants_spec(spec: str) -> list[TenantSpec]:
+    """Parse the --tenants CLI spec: a comma list of
+    'name:priority:start_rps:end_rps' (end_rps optional — omitted means
+    a flat rate). Example:
+
+        --tenants alice:interactive:3:3,bob:batch:2:24
+    """
+    from ..llm.protocols import normalize_priority
+
+    out: list[TenantSpec] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (3, 4):
+            raise ValueError(
+                "--tenants expects name:priority:start_rps[:end_rps], "
+                f"got {part!r}")
+        start = float(bits[2])
+        end = float(bits[3]) if len(bits) == 4 else start
+        out.append(TenantSpec(name=bits[0],
+                              priority=normalize_priority(bits[1]),
+                              start_rps=start, end_rps=end))
+    if not out:
+        raise ValueError("--tenants needs at least one tenant spec")
+    return out
+
+
+def tenant_arrival_schedule(tenants: list[TenantSpec], seconds: float,
+                            seed: int = 0) -> list[tuple[float, TenantSpec]]:
+    """Merged open-loop arrival schedule: (arrival_ms, tenant) sorted by
+    time, each tenant an independent inhomogeneous Poisson ramp."""
+    merged: list[tuple[float, TenantSpec]] = []
+    for i, tenant in enumerate(tenants):
+        for t_ms in ramp_arrival_times(tenant.start_rps, tenant.end_rps,
+                                       seconds, seed=seed + i * 7919):
+            merged.append((t_ms, tenant))
+    merged.sort(key=lambda pair: pair[0])
+    return merged
+
+
+def synthesize_tenant_trace(
+    tenants: list[TenantSpec],
+    seconds: float,
+    isl_mean: int = 512,
+    osl_mean: int = 64,
+    prefix_ratio: float = 0.5,
+    num_prefix_groups: int = 8,
+    block_size: int = 16,
+    seed: int = 0,
+) -> list[TraceRecord]:
+    """Multi-tenant trace: each tenant an independent Poisson ramp
+    (--tenants spec), merged onto one timeline with tenant + priority
+    tagged per record. Prefix groups are tenant-disjoint (group ids
+    offset per tenant) — tenants must not accidentally share KV."""
+    out: list[TraceRecord] = []
+    for i, tenant in enumerate(tenants):
+        ts = ramp_arrival_times(tenant.start_rps, tenant.end_rps, seconds,
+                                seed=seed + i * 7919)
+        records = synthesize_trace(
+            len(ts), rate_rps=1.0, isl_mean=isl_mean, osl_mean=osl_mean,
+            prefix_ratio=prefix_ratio,
+            num_prefix_groups=num_prefix_groups, block_size=block_size,
+            seed=seed + i * 104729,
+        )
+        for record, t_ms in zip(records, ts):
+            record.ts_ms = float(t_ms)
+            record.tenant = tenant.name
+            record.priority = tenant.priority
+            if record.hash_ids:
+                # Disjoint id space per tenant (unique ids in
+                # synthesize_trace live above group*10_000 already;
+                # shift everything by a per-tenant stride).
+                stride = (i + 1) * 100_000_000
+                record.hash_ids = [h + stride for h in record.hash_ids]
+        out.extend(records)
+    out.sort(key=lambda r: r.ts_ms)
+    return out
+
+
+def summarize_tenant_buckets(samples: list[dict], bucket_secs: float,
+                             total_secs: Optional[float] = None,
+                             ) -> dict[str, list[dict]]:
+    """Per-tenant bucket summaries: samples carry a `tenant` key (""
+    / missing groups under "untagged"). The per-tenant goodput curves
+    are what the two-tenant chaos ramp asserts on — interactive flat,
+    batch absorbing the shed. Bucket lists are index-aligned across
+    tenants: the shared timeline ends at the GLOBAL last arrival (or
+    `total_secs`), never at each tenant's own — comparing
+    buckets[i] across tenants must compare the same time window."""
+    groups: dict[str, list[dict]] = {}
+    for s in samples:
+        groups.setdefault(s.get("tenant") or "untagged", []).append(s)
+    if total_secs is None and samples:
+        total_secs = max(s["t_s"] for s in samples) + 1e-9
+    return {tenant: summarize_buckets(group, bucket_secs,
+                                      total_secs=total_secs)
+            for tenant, group in sorted(groups.items())}
+
+
 def summarize_buckets(samples: list[dict], bucket_secs: float,
                       total_secs: Optional[float] = None) -> list[dict]:
     """Per-bucket goodput/shed summary for an open-loop run.
@@ -258,6 +381,8 @@ class RequestStats:
     # Arrival offset on the (unscaled) trace timeline — keys the
     # per-bucket goodput/shed summary for ramp traces.
     arrival_s: float = 0.0
+    # Tenant identity ("" = untagged) for per-tenant bucket summaries.
+    tenant: str = ""
 
     @property
     def itl_ms(self) -> float:
@@ -327,6 +452,23 @@ class ReplayReport:
             "tokens": s.output_tokens,
         } for s in self.stats]
         return summarize_buckets(samples, bucket_secs)
+
+    def tenant_bucket_summary(self, bucket_secs: float,
+                              slo_ttft_ms: float = 0.0) -> dict:
+        """Per-tenant goodput curves for multi-tenant traces
+        (docs/multi-tenancy.md) — the replay-side analog of the chaos
+        ramp's per-tenant buckets."""
+        scale = max(self.time_scale, 1e-9)
+        samples = [{
+            "t_s": s.arrival_s,
+            "ok": s.error is None,
+            "good": s.error is None and (
+                not slo_ttft_ms or s.ttft_ms / scale <= slo_ttft_ms),
+            "shed": False,
+            "tokens": s.output_tokens,
+            "tenant": s.tenant,
+        } for s in self.stats]
+        return summarize_tenant_buckets(samples, bucket_secs)
 
 
 class _CapturePublisher:
@@ -441,6 +583,8 @@ class OfflineReplay:
             token_ids=token_ids,
             sampling=SamplingOptions(max_tokens=record.osl),
             stop=StopConditions(ignore_eos=True),
+            priority=record.priority or "standard",
+            tenant=record.tenant or "",
         )
         start = time.monotonic()
         first: Optional[float] = None
@@ -497,6 +641,7 @@ class OfflineReplay:
             output_tokens=tokens,
             error=error,
             arrival_s=arrival_s,
+            tenant=record.tenant or "",
         ))
         report.output_tokens += tokens
         if error is not None:
@@ -544,6 +689,16 @@ async def main(argv: Optional[list[str]] = None) -> None:
                           "--rate-rps/--num-requests; the chaos-overload "
                           "schedule that drives offered load past the "
                           "capacity knee")
+    syn.add_argument("--tenants", default=None,
+                     metavar="NAME:PRIO:START[:END],...",
+                     help="multi-tenant trace: comma list of "
+                          "name:priority:start_rps[:end_rps] per-tenant "
+                          "ramps over --duration-secs (e.g. "
+                          "'alice:interactive:3,bob:batch:2:24'); tags "
+                          "every record with tenant + priority and "
+                          "replaces --rate-rps/--ramp-rps")
+    syn.add_argument("--duration-secs", type=float, default=30.0,
+                     help="trace length for --tenants ramps")
     syn.add_argument("--isl-mean", type=int, default=512)
     syn.add_argument("--osl-mean", type=int, default=64)
     syn.add_argument("--prefix-ratio", type=float, default=0.5)
@@ -592,7 +747,14 @@ async def main(argv: Optional[list[str]] = None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "synthesize":
-        if args.ramp_rps:
+        if args.tenants:
+            records = synthesize_tenant_trace(
+                parse_tenants_spec(args.tenants), args.duration_secs,
+                isl_mean=args.isl_mean, osl_mean=args.osl_mean,
+                prefix_ratio=args.prefix_ratio,
+                num_prefix_groups=args.prefix_groups, seed=args.seed,
+            )
+        elif args.ramp_rps:
             start, end, seconds = parse_ramp_spec(args.ramp_rps)
             records = synthesize_ramp_trace(
                 start, end, seconds,
@@ -644,6 +806,9 @@ async def main(argv: Optional[list[str]] = None) -> None:
     if args.bucket_secs > 0:
         summary["buckets"] = report.bucket_summary(
             args.bucket_secs, slo_ttft_ms=args.slo_ttft_ms)
+        if any(r.tenant for r in records):
+            summary["tenant_buckets"] = report.tenant_bucket_summary(
+                args.bucket_secs, slo_ttft_ms=args.slo_ttft_ms)
     print(json.dumps(summary))
 
 

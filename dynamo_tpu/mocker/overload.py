@@ -185,27 +185,37 @@ class _Stack:
 
 
 async def _fire_one(session, base: str, t_s: float,
-                    params: OverloadParams, samples: list[dict]) -> None:
+                    params, samples: list[dict],
+                    priority: Optional[str] = None,
+                    tenant: Optional[str] = None,
+                    label: Optional[str] = None) -> None:
     """One open-loop request: streamed chat, client-side TTFT verdict.
     Outcomes: shed (503 at admission, or an in-band 503 error event from
     a downstream admission edge), ok (finished), good (ok AND first
-    token within the SLO)."""
+    token within the SLO). `priority`/`tenant` ride the wire when set
+    (the QoS pass of the two-tenant ramp); `label` tags the sample for
+    per-tenant bucketing regardless of whether the wire was tagged."""
     import aiohttp
 
     out = {"t_s": t_s, "ok": False, "good": False, "shed": False,
-           "tokens": 0, "ttft_ms": None, "status": 0}
+           "tokens": 0, "ttft_ms": None, "status": 0,
+           "tenant": label or tenant or ""}
     # Unique prompt bytes per request: shared content would hit the
     # mocker's prefix cache and make every prefill after the first free,
     # flattening the capacity knee the scenario exists to cross.
     content = uuid.uuid4().hex + "x" * max(0, params.isl - 32)
+    body = {"model": MODEL, "stream": True,
+            "max_tokens": params.max_tokens,
+            "messages": [{"role": "user", "content": content}]}
+    if priority:
+        body["priority"] = priority
+    if tenant:
+        body["tenant"] = tenant
     sent = time.monotonic()
     try:
         async with session.post(
                 base + "/v1/chat/completions",
-                json={"model": MODEL, "stream": True,
-                      "max_tokens": params.max_tokens,
-                      "messages": [{"role": "user",
-                                    "content": content}]},
+                json=body,
                 timeout=aiohttp.ClientTimeout(
                     total=params.deadline_secs + 20),
         ) as resp:
@@ -492,6 +502,258 @@ def evaluate(report: dict) -> list[dict]:
               and sweep["planner_gauges"]["decode"] > 0,
               sweep["planner_gauges"])
     return checks
+
+
+# ---------------------------------------------------------------------------
+# Two-tenant QoS chaos ramp (docs/multi-tenancy.md): interactive tenant
+# at a fixed below-knee rate, batch tenant ramping ~2x past the knee.
+# A/B: untagged FCFS baseline vs the full QoS plane (priority classes,
+# fair-share quotas, preemption). The headline: the interactive goodput
+# curve holds flat past the knee while batch absorbs the shed and the
+# preemptions, at <= 10% total-throughput cost.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TwoTenantParams:
+    """Two-tenant ramp shape. The mocker cluster and knee math are the
+    OverloadParams defaults (knee ~5 rps on 2 workers): interactive
+    holds 3 rps (below knee), batch ramps 2 -> 24 rps (~2x past)."""
+
+    interactive_rps: float = 3.0
+    batch_start_rps: float = 2.0
+    batch_end_rps: float = 24.0
+    ramp_secs: float = 24.0
+    bucket_secs: float = 4.0
+    n_decode: int = 2
+    slo_ttft_ms: float = 1800.0
+    deadline_secs: float = 2.0
+    admission_margin: float = 1.3
+    isl: int = 192
+    max_tokens: int = 4
+    seed: int = 0
+    # Fair-share quota shape: capacity in ADMITTED tokens/s (prompt +
+    # max_tokens; ~205 tokens/request at the defaults -> ~3000 sits
+    # above the measured ~11 rps cluster ceiling) and 3:1 interactive:batch
+    # weights. The quota is a flood guardrail ABOVE the knee — set it
+    # at/above real capacity so deadline-aware admission does the fine
+    # shedding and the quota only arbitrates genuine floods (a quota
+    # far below capacity would idle chips batch could use).
+    tenant_rate_limit_tps: float = 3000.0
+    interactive_weight: float = 3.0
+    batch_weight: float = 1.0
+
+
+def _two_tenant_arrivals(params: TwoTenantParams) -> list[tuple]:
+    """Merged (arrival_ms, tenant_name, priority) schedule."""
+    from .loadgen import TenantSpec, tenant_arrival_schedule
+
+    tenants = [
+        TenantSpec("interactive", "interactive",
+                   params.interactive_rps, params.interactive_rps),
+        TenantSpec("batch", "batch",
+                   params.batch_start_rps, params.batch_end_rps),
+    ]
+    return [(t_ms, spec.name, spec.priority)
+            for t_ms, spec in tenant_arrival_schedule(
+                tenants, params.ramp_secs, seed=params.seed)]
+
+
+async def _drive_tagged(base: str, arrivals: list[tuple],
+                        params: TwoTenantParams,
+                        tagged: bool) -> list[dict]:
+    """Fire the merged two-tenant schedule open-loop. `tagged=False`
+    sends the identical traffic UNTAGGED (the FCFS baseline) — samples
+    still carry the tenant label so both passes bucket per tenant."""
+    import aiohttp
+
+    samples: list[dict] = []
+    tasks = []
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        t0 = time.monotonic()
+        for a_ms, tenant, priority in arrivals:
+            delay = t0 + a_ms / 1e3 - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(_fire_one(
+                session, base, a_ms / 1e3, params, samples,
+                priority=priority if tagged else None,
+                tenant=tenant if tagged else None,
+                label=tenant)))
+        await asyncio.gather(*tasks)
+    return samples
+
+
+async def run_two_tenant_pass(params: TwoTenantParams,
+                              qos_on: bool) -> dict:
+    """One ramp against a fresh stack: qos_on = priority/tenant tags on
+    the wire + quotas + preemption; off = the identical traffic
+    untagged (pure FCFS baseline). Admission-loop knobs are IDENTICAL
+    in both passes — the A/B isolates the QoS plane."""
+    from ..runtime.admission import reset_tenant_ledger
+    from .loadgen import summarize_tenant_buckets
+
+    os.environ["DYNT_ADMISSION_ENABLE"] = "1"
+    os.environ["DYNT_DEADLINE_SECS"] = str(params.deadline_secs)
+    os.environ["DYNT_ADMISSION_HALFLIFE_SECS"] = "2.0"
+    os.environ["DYNT_ADMISSION_MARGIN"] = str(params.admission_margin)
+    os.environ["DYNT_PREEMPT_ENABLE"] = "1" if qos_on else "0"
+    os.environ["DYNT_TENANT_RATE_LIMIT"] = (
+        str(params.tenant_rate_limit_tps) if qos_on else "0")
+    os.environ["DYNT_TENANT_WINDOW_SECS"] = "6.0"
+    os.environ["DYNT_TENANT_WEIGHTS"] = (
+        f"interactive={params.interactive_weight},"
+        f"batch={params.batch_weight}")
+    reset_tenant_ledger()
+    base_params = OverloadParams(
+        n_decode=params.n_decode, slo_ttft_ms=params.slo_ttft_ms,
+        deadline_secs=params.deadline_secs, isl=params.isl,
+        max_tokens=params.max_tokens)
+    stack = await _Stack(base_params, params.n_decode).start()
+    try:
+        before = await _scrape(stack.base)
+        arrivals = _two_tenant_arrivals(params)
+        samples = await _drive_tagged(stack.base, arrivals, params,
+                                      tagged=qos_on)
+        scrape = await _scrape(stack.base)
+
+        def delta(name: str, **labels) -> float:
+            return (_metric_sum(scrape, name, **labels)
+                    - _metric_sum(before, name, **labels))
+
+        by_tenant = {
+            t: {
+                "offered": len(group),
+                "ok": sum(1 for s in group if s["ok"]),
+                "good": sum(1 for s in group if s["good"]),
+                "shed": sum(1 for s in group if s["shed"]),
+            }
+            for t, group in (
+                ("interactive", [s for s in samples
+                                 if s["tenant"] == "interactive"]),
+                ("batch", [s for s in samples if s["tenant"] == "batch"]),
+            )
+        }
+        return {
+            "qos_on": qos_on,
+            "offered": len(samples),
+            "buckets": summarize_buckets(samples, params.bucket_secs,
+                                         total_secs=params.ramp_secs),
+            "tenant_buckets": summarize_tenant_buckets(
+                samples, params.bucket_secs,
+                total_secs=params.ramp_secs),
+            "tenants": by_tenant,
+            "good_total": sum(1 for s in samples if s["good"]),
+            "shed_total": sum(1 for s in samples if s["shed"]),
+            "metrics": {
+                "preempt_park": delta("dynamo_preempt_total",
+                                      kind="park"),
+                "preempt_migrate": delta("dynamo_preempt_total",
+                                         kind="migrate"),
+                "preempt_resume": delta("dynamo_preempt_total",
+                                        kind="resume"),
+                "tenant_shed_batch": delta("dynamo_tenant_shed_total",
+                                           tenant="batch"),
+                "tenant_shed_interactive": delta(
+                    "dynamo_tenant_shed_total", tenant="interactive"),
+                "shed_quota": delta("dynamo_requests_shed_total",
+                                    reason="quota"),
+            },
+        }
+    finally:
+        await stack.close()
+
+
+def evaluate_two_tenant(report: dict) -> list[dict]:
+    """The multi-tenant QoS assertions, evaluated FROM the report (the
+    JSON the chaos-two-tenant CI job uploads)."""
+    checks: list[dict] = []
+
+    def check(name: str, ok: bool, detail) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    base = report["qos_off"]
+    qos = report["qos_on"]
+    knee = _knee_index(base["buckets"])
+    report["knee_bucket"] = knee
+    n_buckets = min(len(base["buckets"]), len(qos["buckets"]))
+    past = list(range(knee + 1, n_buckets))
+
+    def tenant_past(rep, tenant, key):
+        buckets = rep["tenant_buckets"].get(tenant, [])
+        return sum(b[key] for i, b in enumerate(buckets) if i in past)
+
+    # 1. Interactive goodput holds flat past the knee with QoS on:
+    # nearly every offered interactive request stays good, and at least
+    # as many as the untagged baseline manages.
+    qos_i_good = tenant_past(qos, "interactive", "good")
+    qos_i_off = tenant_past(qos, "interactive", "offered")
+    base_i_good = tenant_past(base, "interactive", "good")
+    check("interactive_goodput_holds_past_knee",
+          bool(past) and qos_i_off > 0
+          and qos_i_good >= 0.85 * qos_i_off
+          and qos_i_good >= base_i_good,
+          {"knee": knee, "qos_good": qos_i_good, "offered": qos_i_off,
+           "baseline_good": base_i_good})
+    # 2. Total throughput cost of the QoS plane <= 10%.
+    check("total_goodput_cost_within_10pct",
+          qos["good_total"] >= 0.9 * base["good_total"],
+          {"qos": qos["good_total"], "baseline": base["good_total"]})
+    # 3. Preemptions actually happened and are observable.
+    preempts = (qos["metrics"]["preempt_park"]
+                + qos["metrics"]["preempt_migrate"])
+    check("preemptions_observed", preempts > 0, qos["metrics"])
+    check("baseline_never_preempts",
+          (base["metrics"]["preempt_park"]
+           + base["metrics"]["preempt_migrate"]) == 0, base["metrics"])
+    # 4. Batch absorbs the shed; interactive is (nearly) never shed.
+    i_shed = qos["tenants"]["interactive"]["shed"]
+    i_offered = qos["tenants"]["interactive"]["offered"]
+    check("batch_absorbs_shed",
+          qos["tenants"]["batch"]["shed"] > 0
+          and i_shed <= max(1, 0.02 * i_offered),
+          {"batch_shed": qos["tenants"]["batch"]["shed"],
+           "interactive_shed": i_shed,
+           "interactive_offered": i_offered})
+    # 5. Shed attribution lands on the flooding tenant.
+    check("tenant_shed_attributed_to_batch",
+          qos["metrics"]["tenant_shed_batch"] > 0
+          and qos["metrics"]["tenant_shed_interactive"]
+          <= max(1.0, 0.02 * i_offered),
+          qos["metrics"])
+    return checks
+
+
+async def run_two_tenant_scenario(
+        params: Optional[TwoTenantParams] = None) -> dict:
+    """Full two-tenant chaos ramp: untagged FCFS baseline, then the QoS
+    plane, with `assertions` evaluated; `passed` is the conjunction."""
+    params = params or TwoTenantParams()
+    report: dict = {
+        "scenario": "chaos_two_tenant",
+        "params": dataclasses.asdict(params),
+    }
+    knobs = ("DYNT_ADMISSION_ENABLE", "DYNT_DEADLINE_SECS",
+             "DYNT_ADMISSION_HALFLIFE_SECS", "DYNT_ADMISSION_MARGIN",
+             "DYNT_PREEMPT_ENABLE", "DYNT_TENANT_RATE_LIMIT",
+             "DYNT_TENANT_WINDOW_SECS", "DYNT_TENANT_WEIGHTS")
+    prev = {key: os.environ.get(key) for key in knobs}
+    try:
+        report["qos_off"] = await run_two_tenant_pass(params, qos_on=False)
+        report["qos_on"] = await run_two_tenant_pass(params, qos_on=True)
+    finally:
+        from ..runtime.admission import reset_tenant_ledger
+
+        for key in knobs:
+            if prev[key] is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev[key]
+        reset_tenant_ledger()
+    report["assertions"] = evaluate_two_tenant(report)
+    report["passed"] = all(c["ok"] for c in report["assertions"])
+    return report
 
 
 async def run_scenario(params: Optional[OverloadParams] = None,

@@ -56,17 +56,32 @@ _INF_WAIT_MS = float("inf")
 
 class AdmissionRefused(RuntimeError):
     """Raised at an admission edge when a request's deadline budget
-    cannot survive the estimated queue wait. Maps to 503 +
-    `Retry-After` at the frontend — NOT a transport failure: routers
-    must neither retry it (the condition is pool-wide, not
-    per-instance) nor breaker-penalize anyone."""
+    cannot survive the estimated queue wait (`reason="queue"`) or a
+    tenant is over its weighted fair share under contention
+    (`reason="quota"`). Maps to 503 + `Retry-After` at the frontend —
+    NOT a transport failure: routers must neither retry it (the
+    condition is pool-wide, not per-instance) nor breaker-penalize
+    anyone."""
 
     def __init__(self, message: str, *, retry_after_s: float,
-                 est_wait_ms: float, pool: str) -> None:
+                 est_wait_ms: float, pool: str,
+                 reason: str = "queue") -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
         self.est_wait_ms = est_wait_ms
         self.pool = pool
+        self.reason = reason
+
+
+def clamp_retry_after_s(est_wait_ms: float) -> float:
+    """Retry-After seconds from an estimated wait, clamped to the
+    DYNT_RETRY_AFTER_MIN/MAX_SECS knobs (inf → the cap). The ONE
+    clamping rule every admission edge shares."""
+    floor = env("DYNT_RETRY_AFTER_MIN_SECS")
+    cap = env("DYNT_RETRY_AFTER_MAX_SECS")
+    if math.isinf(est_wait_ms):
+        return cap
+    return min(cap, max(floor, est_wait_ms / 1e3))
 
 
 @dataclasses.dataclass
@@ -194,11 +209,7 @@ class QueueWaitEstimator:
     def retry_after_s(self, est_wait_ms: float) -> float:
         """Honest Retry-After: the estimated time for the backlog to
         drain, clamped to the registered floor/cap knobs."""
-        floor = env("DYNT_RETRY_AFTER_MIN_SECS")
-        cap = env("DYNT_RETRY_AFTER_MAX_SECS")
-        if math.isinf(est_wait_ms):
-            return cap
-        return min(cap, max(floor, est_wait_ms / 1e3))
+        return clamp_retry_after_s(est_wait_ms)
 
     def check(self, deadline, extra: int = 0,
               now: Optional[float] = None) -> AdmissionDecision:
@@ -227,19 +238,225 @@ class QueueWaitEstimator:
                                 pool=self.pool)
 
 
+def parse_tenant_weights(spec: str) -> dict[str, float]:
+    """Parse the DYNT_TENANT_WEIGHTS spec: "tenantA=4,tenantB=1".
+    Malformed entries are skipped (a config typo must not take the
+    serving plane down)."""
+    out: dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, raw = part.partition("=")
+        try:
+            weight = float(raw)
+        except ValueError:
+            continue
+        if name.strip() and weight > 0:
+            out[name.strip()] = weight
+    return out
+
+
+class TenantLedger:
+    """Sliding-window per-tenant token-rate accounting with weighted
+    fair-share refusal (docs/multi-tenancy.md).
+
+    Every admitted request deposits its token cost (prompt +
+    max_tokens) into its tenant's window; `check` refuses a tenant
+    that is over its weighted fair share of the configured capacity
+    while the system is CONTENDED — so one tenant's flood 503s *that
+    tenant* first (shed reason="quota") instead of degrading everyone
+    FCFS. Uncontended traffic under the capacity line is never quota-
+    refused: quotas are a contention arbiter, not a hard rate limit.
+
+    fair share of tenant t = capacity * w_t / Σ w_active, where the
+    active set is the tenants with traffic inside the window. Untagged
+    requests (tenant="") and a zero capacity knob disable the check
+    entirely — the pre-QoS behavior."""
+
+    def __init__(self, capacity_tps: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 weights: Optional[dict[str, float]] = None,
+                 default_weight: Optional[float] = None) -> None:
+        self.capacity = float(env("DYNT_TENANT_RATE_LIMIT")
+                              if capacity_tps is None else capacity_tps)
+        self.window_s = max(1e-3, float(env("DYNT_TENANT_WINDOW_SECS")
+                                        if window_s is None else window_s))
+        self.weights = (parse_tenant_weights(env("DYNT_TENANT_WEIGHTS"))
+                        if weights is None else dict(weights))
+        self.default_weight = float(
+            env("DYNT_TENANT_DEFAULT_WEIGHT")
+            if default_weight is None else default_weight)
+        # tenant -> deque[(monotonic_t, tokens)]; _sums mirrors the
+        # deque totals so rate() is O(expired) not O(window).
+        from collections import deque
+
+        self._events: dict[str, object] = {}
+        self._sums: dict[str, float] = {}
+        self._deque = deque  # constructor kept off the hot path imports
+
+    def weight_of(self, tenant: str) -> float:
+        return self.weights.get(tenant, self.default_weight)
+
+    def _prune(self, tenant: str, now: float) -> None:
+        q = self._events.get(tenant)
+        if q is None:
+            return
+        cutoff = now - self.window_s
+        total = self._sums.get(tenant, 0.0)
+        while q and q[0][0] < cutoff:
+            total -= q.popleft()[1]
+        if q:
+            self._sums[tenant] = max(0.0, total)
+        else:
+            self._events.pop(tenant, None)
+            self._sums.pop(tenant, None)
+
+    def observe(self, tenant: str, tokens: float,
+                now: Optional[float] = None) -> None:
+        """Deposit an ADMITTED request's token cost into the window.
+        Called once per request at the entry edge (the frontend);
+        downstream edges only read."""
+        if not tenant or tokens <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        q = self._events.get(tenant)
+        if q is None:
+            q = self._events[tenant] = self._deque()
+        q.append((now, float(tokens)))
+        self._sums[tenant] = self._sums.get(tenant, 0.0) + float(tokens)
+        self._prune(tenant, now)
+
+    def _rates(self, now: float) -> dict[str, float]:
+        """One prune sweep -> every active tenant's tokens/s. The ONE
+        ledger scan an admission decision performs."""
+        for tenant in list(self._events):
+            self._prune(tenant, now)
+        return {t: s / self.window_s for t, s in self._sums.items()}
+
+    def rate(self, tenant: str, now: Optional[float] = None) -> float:
+        """Tokens/s the tenant admitted over the sliding window."""
+        now = time.monotonic() if now is None else now
+        self._prune(tenant, now)
+        return self._sums.get(tenant, 0.0) / self.window_s
+
+    def total_rate(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return sum(self._rates(now).values())
+
+    def _share_of(self, tenant: str, rates: dict[str, float]) -> float:
+        """Fair share against an already-pruned rate map: the larger
+        of the weighted share (tenants active in the window, candidate
+        included) and the capacity the OTHER tenants are not using —
+        work-conserving, so a lone flooding tenant may use idle
+        capacity but is squeezed back to its weighted share the moment
+        the others' demand returns (the sliding window forgets its
+        burst within DYNT_TENANT_WINDOW_SECS seconds)."""
+        active = set(rates) | {tenant}
+        total_w = sum(self.weight_of(t) for t in active)
+        weighted = (self.capacity if total_w <= 0
+                    else self.capacity * self.weight_of(tenant) / total_w)
+        others = sum(r for t, r in rates.items() if t != tenant)
+        return max(weighted, self.capacity - others)
+
+    def share(self, tenant: str, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return self._share_of(tenant, self._rates(now))
+
+    def check(self, tenant: str, tokens: float, contended: bool = False,
+              now: Optional[float] = None) -> AdmissionDecision:
+        """Quota verdict for a request costing `tokens` (0 at
+        downstream read-only edges — the entry edge already deposited
+        the request's cost, re-adding it would double-count it against
+        its own share). Admits unless the system is contended
+        (caller-observed queueing, or total demand past capacity) AND
+        the tenant is over its fair share."""
+        if self.capacity <= 0 or not tenant:
+            return AdmissionDecision(True, 0.0, 0.0)
+        now = time.monotonic() if now is None else now
+        rates = self._rates(now)
+        incoming = float(tokens) / self.window_s
+        total = sum(rates.values())
+        if not contended and total + incoming <= self.capacity:
+            return AdmissionDecision(True, 0.0, 0.0)
+        share = self._share_of(tenant, rates)
+        rate = rates.get(tenant, 0.0)
+        if rate + incoming <= share:
+            return AdmissionDecision(True, 0.0, 0.0)
+        # Honest Retry-After: the window fraction that must age out
+        # before this tenant is back under its share.
+        excess_frac = 1.0 - share / max(rate + incoming, 1e-9)
+        retry = clamp_retry_after_s(excess_frac * self.window_s * 1e3)
+        return AdmissionDecision(
+            False, 0.0, retry,
+            reason=(f"tenant {tenant!r} over fair share "
+                    f"({rate:.0f}+{incoming:.0f} tok/s > "
+                    f"{share:.0f} tok/s of {self.capacity:.0f} capacity)"))
+
+    def reset(self) -> None:
+        self._events.clear()
+        self._sums.clear()
+
+
+_tenant_ledger: Optional[TenantLedger] = None
+
+
+def get_tenant_ledger() -> TenantLedger:
+    """Process-wide ledger shared by the frontend, router queue and
+    prefill-router edges (they run in one process): a flood observed at
+    the entry edge informs every downstream check."""
+    global _tenant_ledger
+    if _tenant_ledger is None:
+        _tenant_ledger = TenantLedger()
+    return _tenant_ledger
+
+
+def reset_tenant_ledger() -> None:
+    """Drop the singleton (tests / knob changes)."""
+    global _tenant_ledger
+    _tenant_ledger = None
+
+
+def check_tenant_admission(ledger: TenantLedger, tenant: str,
+                           tokens: float, contended: bool = False,
+                           observe: bool = False) -> AdmissionDecision:
+    """Quota edge shared by the three admission edges: evaluate, count
+    the shed (reason="quota", attributed to the tenant), and raise
+    AdmissionRefused on refusal. `observe=True` (the entry edge only)
+    deposits admitted tokens into the window — downstream edges must
+    not double-count."""
+    from .metrics import REQUESTS_SHED, TENANT_SHED
+
+    decision = ledger.check(tenant, tokens, contended=contended)
+    if not decision.admit:
+        REQUESTS_SHED.labels(reason="quota").inc()
+        TENANT_SHED.labels(tenant=tenant or "untagged",
+                           reason="quota").inc()
+        raise AdmissionRefused(
+            decision.reason or "tenant quota exceeded",
+            retry_after_s=decision.retry_after_s,
+            est_wait_ms=decision.est_wait_ms, pool="tenant",
+            reason="quota")
+    if observe:
+        ledger.observe(tenant, tokens)
+    return decision
+
+
 def admission_enabled() -> bool:
     return bool(env("DYNT_ADMISSION_ENABLE"))
 
 
 def check_admission(estimator: QueueWaitEstimator, deadline,
-                    extra: int = 0) -> AdmissionDecision:
+                    extra: int = 0,
+                    tenant: str = "") -> AdmissionDecision:
     """Edge entry point shared by the frontend, the router admission
     queue and the prefill router: evaluate, publish the pool's
     queue-wait gauge, and raise AdmissionRefused (counted under
-    dynamo_requests_shed_total{reason="queue"}) on refusal. A disabled
-    loop (DYNT_ADMISSION_ENABLE=0) admits unconditionally and publishes
+    dynamo_requests_shed_total{reason="queue"}, attributed to the
+    tenant when the request is tagged) on refusal. A disabled loop
+    (DYNT_ADMISSION_ENABLE=0) admits unconditionally and publishes
     nothing — the pure-FCFS baseline the chaos A/B measures against."""
-    from .metrics import ADMISSION_WAIT_MS, REQUESTS_SHED
+    from .metrics import ADMISSION_WAIT_MS, REQUESTS_SHED, TENANT_SHED
 
     if not admission_enabled():
         return AdmissionDecision(True, 0.0, 0.0)
@@ -250,5 +467,7 @@ def check_admission(estimator: QueueWaitEstimator, deadline,
     ADMISSION_WAIT_MS.labels(pool=estimator.pool).set(gauge)
     if not decision.admit:
         REQUESTS_SHED.labels(reason="queue").inc()
+        if tenant:
+            TENANT_SHED.labels(tenant=tenant, reason="queue").inc()
         raise estimator.refuse(decision)
     return decision

@@ -390,6 +390,43 @@ _register("DYNT_RETRY_AFTER_MAX_SECS", 30.0, _float,
           "responses; also what a stalled pool (unbounded estimated "
           "wait) advertises")
 
+# Multi-tenant QoS — priority classes, fair-share quotas, preemption
+# (docs/multi-tenancy.md; runtime/admission.py TenantLedger +
+# engine/scheduler.py preempt-to-KVBM)
+_register("DYNT_TENANT_RATE_LIMIT", 0.0, _float,
+          "Serving capacity (tokens/s: prompt + max_tokens of admitted "
+          "requests) the weighted fair-share quota divides among "
+          "tenants. Under contention a tenant over its share is shed "
+          "503 reason=quota BEFORE untagged/under-share traffic "
+          "degrades. 0 disables quota admission entirely")
+_register("DYNT_TENANT_WINDOW_SECS", 10.0, _float,
+          "Sliding window of the per-tenant token-rate ledger; shorter "
+          "reacts faster to floods, longer tolerates bursts")
+_register("DYNT_TENANT_WEIGHTS", "", _str,
+          "Per-tenant fair-share weights as 'tenantA=4,tenantB=1'; a "
+          "tenant's share is capacity * w / sum(w of active tenants). "
+          "Unlisted tenants get DYNT_TENANT_DEFAULT_WEIGHT")
+_register("DYNT_TENANT_DEFAULT_WEIGHT", 1.0, _float,
+          "Fair-share weight of tenants not named in "
+          "DYNT_TENANT_WEIGHTS")
+_register("DYNT_PREEMPT_ENABLE", True, _bool,
+          "Preempt batch-class decode slots under interactive pressure: "
+          "park-to-KVBM (offload the sequence's pages, resume by onload "
+          "when pressure clears — committed streams stay bit-identical) "
+          "with cooperative preempt-and-migrate as the fallback when no "
+          "park store is attached. Off = class-blind slot allocation "
+          "(the pre-QoS behavior; priority still orders queues)")
+_register("DYNT_PREEMPT_MAX_PARKED", 16, _int,
+          "Bound on concurrently parked (preempted) sequences per "
+          "engine. Past it, further preemptions take the cooperative "
+          "migrate fallback instead of growing host memory unboundedly")
+_register("DYNT_PREEMPT_MIGRATION_LIMIT", 3, _int,
+          "Bound on COOPERATIVE migrations per request (worker-emitted "
+          "finish_reason=migrate: QoS preemption, elastic reshard) — "
+          "separate from DYNT_MIGRATION_LIMIT so planned hand-offs "
+          "never consume the failure budget that protects against "
+          "crash loops; cooperative replays also skip backoff jitter")
+
 # Fault tolerance — resilience plane (runtime/resilience.py; knob
 # semantics and the degradation ladder in docs/fault-tolerance.md)
 _register("DYNT_DEADLINE_SECS", 600.0, _float,

@@ -157,14 +157,38 @@ PLANNER_LAST_DECISION_TS = Gauge(
 # histograms ("goodput, not throughput" — the serving-SLO literature).
 SLO_REQUESTS = Counter(
     "dynamo_slo_requests_total",
-    "Finished frontend requests considered for the SLO goodput ratio",
-    ["model"], registry=REGISTRY,
+    "Finished frontend requests considered for the SLO goodput ratio, "
+    "by model, priority class and tenant (untagged requests count "
+    "priority=standard tenant=untagged)",
+    ["model", "priority", "tenant"], registry=REGISTRY,
 )
 SLO_GOOD = Counter(
     "dynamo_slo_good_total",
     "Requests that finished OK within the DYNT_SLO_TTFT_MS / "
-    "DYNT_SLO_ITL_MS targets (an unset target always passes)",
-    ["model"], registry=REGISTRY,
+    "DYNT_SLO_ITL_MS targets (an unset target always passes), by "
+    "model, priority class and tenant — per-class goodput is the "
+    "multi-tenant QoS headline (docs/multi-tenancy.md)",
+    ["model", "priority", "tenant"], registry=REGISTRY,
+)
+# Multi-tenant QoS plane (docs/multi-tenancy.md): who absorbed the
+# shed, and how often batch decode slots were preempted for
+# interactive pressure.
+TENANT_SHED = Counter(
+    "dynamo_tenant_shed_total",
+    "Requests shed at an admission edge attributed to a tenant, by "
+    "reason: quota (over weighted fair share under contention) or "
+    "queue (deadline-aware admission). Untagged requests count under "
+    "tenant=untagged only when quota-shed",
+    ["tenant", "reason"], registry=REGISTRY,
+)
+PREEMPT_TOTAL = Counter(
+    "dynamo_preempt_total",
+    "Scheduler preemption events, by kind: park (batch decode slot "
+    "offloaded to the KVBM park store under interactive pressure), "
+    "migrate (cooperative preempt-and-migrate fallback — the worker "
+    "emitted finish_reason=migrate), resume (parked sequence restored "
+    "and decoding again)",
+    ["kind"], registry=REGISTRY,
 )
 # Speculative decoding plane (engine/spec.py + scheduler): where
 # speculated tokens are won or wasted. acceptance = accepted/proposed;

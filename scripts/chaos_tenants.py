@@ -1,0 +1,64 @@
+"""Chaos-two-tenant CI driver: an interactive tenant at a fixed
+below-knee rate and a batch tenant ramping ~2x past the capacity knee,
+A/B'd against the identical traffic untagged (pure FCFS), through the
+full in-process QoS plane — priority classes on the wire, weighted
+fair-share quotas, class-strict queues, and preempt-to-park scheduling
+(docs/multi-tenancy.md).
+
+Headless, CPU-only, chip-free. Writes the JSON report the
+chaos-two-tenant job uploads as an artifact and exits nonzero when any
+scenario assertion fails — the CI gate on the QoS contract:
+
+    python scripts/chaos_tenants.py --out chaos-two-tenant
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("chaos_tenants")
+    parser.add_argument("--out", default="chaos-two-tenant",
+                        help="report output directory")
+    parser.add_argument("--quick", action="store_true",
+                        help="shorter ramp (local smoke)")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+
+    from dynamo_tpu.mocker.overload import (
+        TwoTenantParams,
+        run_two_tenant_scenario,
+    )
+
+    params = TwoTenantParams()
+    if args.quick:
+        params = TwoTenantParams(ramp_secs=16.0, batch_end_rps=20.0)
+    report = asyncio.run(run_two_tenant_scenario(params))
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "chaos_two_tenant_report.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(f"report: {path}")
+    for check in report["assertions"]:
+        status = "PASS" if check["ok"] else "FAIL"
+        print(f"  [{status}] {check['name']}")
+    if not report["passed"]:
+        print("two-tenant QoS assertions FAILED", file=sys.stderr)
+        return 1
+    print("two-tenant QoS assertions passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -431,3 +431,132 @@ class TestSloObserverDrain:
         # Later chunks are NOT drains — only entering service is.
         obs.on_output(EngineOutput(token_ids=[6]))
         assert est.drain._last == first
+
+
+class TestTenantLedger:
+    """Weighted fair-share quota admission (docs/multi-tenancy.md):
+    sliding-window token-rate accounting; under contention an
+    over-share tenant is refused FIRST (shed reason="quota")."""
+
+    def _ledger(self, capacity=1000.0, window=10.0, weights=None):
+        from dynamo_tpu.runtime.admission import TenantLedger
+
+        return TenantLedger(capacity_tps=capacity, window_s=window,
+                            weights=weights or {}, default_weight=1.0)
+
+    def test_disabled_capacity_always_admits(self):
+        ledger = self._ledger(capacity=0.0)
+        for _ in range(100):
+            assert ledger.check("flood", 10_000, contended=True).admit
+
+    def test_untagged_tenant_never_quota_checked(self):
+        ledger = self._ledger(capacity=10.0)
+        assert ledger.check("", 10_000, contended=True).admit
+
+    def test_window_rate_accounting(self):
+        ledger = self._ledger(capacity=1000.0, window=10.0)
+        now = 100.0
+        ledger.observe("a", 500, now=now)
+        ledger.observe("a", 500, now=now + 1)
+        assert ledger.rate("a", now=now + 1) == 100.0  # 1000 tok / 10 s
+        # Events age out of the window.
+        assert ledger.rate("a", now=now + 10.5) == 50.0
+        assert ledger.rate("a", now=now + 20.0) == 0.0
+
+    def test_uncontended_under_capacity_admits(self):
+        ledger = self._ledger(capacity=1000.0, window=10.0)
+        now = 0.0
+        ledger.observe("a", 4000, now=now)  # 400 tok/s
+        assert ledger.check("a", 1000, contended=False, now=now).admit
+
+    def test_over_share_refused_under_contention(self):
+        ledger = self._ledger(capacity=1000.0, window=10.0)
+        now = 0.0
+        # Two active tenants, equal weights: 500 tok/s weighted share
+        # each; the victim's real 400 tok/s demand leaves the flood only
+        # 600 tok/s of work-conserving headroom.
+        ledger.observe("flood", 8000, now=now)   # 800 tok/s
+        ledger.observe("victim", 4000, now=now)  # 400 tok/s
+        flood = ledger.check("flood", 500, contended=True, now=now)
+        victim = ledger.check("victim", 500, contended=True, now=now)
+        assert not flood.admit
+        assert "fair share" in flood.reason
+        assert flood.retry_after_s >= 1.0
+        assert victim.admit
+
+    def test_weights_shift_the_share(self):
+        ledger = self._ledger(capacity=1000.0, window=10.0,
+                              weights={"gold": 3.0, "bronze": 1.0})
+        now = 0.0
+        ledger.observe("gold", 7000, now=now)    # 700 tok/s < 750 share
+        ledger.observe("bronze", 3000, now=now)  # 300 tok/s > 250 share
+        assert ledger.check("gold", 100, contended=True, now=now).admit
+        assert not ledger.check("bronze", 100, contended=True,
+                                now=now).admit
+
+    def test_work_conserving_idle_capacity_usable(self):
+        """A lone flooding tenant may use capacity the others are not
+        using — the quota arbitrates contention, it does not idle
+        chips."""
+        ledger = self._ledger(capacity=1000.0, window=10.0)
+        now = 0.0
+        ledger.observe("flood", 8000, now=now)  # 800 tok/s, alone
+        assert ledger.check("flood", 1000, contended=True, now=now).admit
+        # A second tenant's demand squeezes the share back down.
+        ledger.observe("other", 6000, now=now)  # 600 tok/s
+        assert not ledger.check("flood", 1000, contended=True,
+                                now=now).admit
+
+    def test_check_tenant_admission_counts_and_raises(self):
+        import time as _time
+
+        from dynamo_tpu.runtime.admission import (
+            AdmissionRefused,
+            check_tenant_admission,
+        )
+        from dynamo_tpu.runtime.metrics import REQUESTS_SHED, TENANT_SHED
+
+        ledger = self._ledger(capacity=100.0, window=10.0)
+        now = _time.monotonic()
+        ledger.observe("flood", 2000, now=now)
+        ledger.observe("peer", 500, now=now)
+        before = TENANT_SHED.labels(tenant="flood",
+                                    reason="quota")._value.get()
+        before_q = REQUESTS_SHED.labels(reason="quota")._value.get()
+        with pytest.raises(AdmissionRefused) as exc_info:
+            check_tenant_admission(ledger, "flood", 100, contended=True)
+        assert exc_info.value.reason == "quota"
+        assert TENANT_SHED.labels(tenant="flood",
+                                  reason="quota")._value.get() \
+            == before + 1
+        assert REQUESTS_SHED.labels(reason="quota")._value.get() \
+            == before_q + 1
+
+    def test_observe_only_on_entry_edge(self):
+        from dynamo_tpu.runtime.admission import check_tenant_admission
+
+        ledger = self._ledger(capacity=10_000.0, window=10.0)
+        check_tenant_admission(ledger, "a", 100, observe=False)
+        assert ledger.rate("a") == 0.0
+        check_tenant_admission(ledger, "a", 100, observe=True)
+        assert ledger.rate("a") > 0.0
+
+    def test_parse_weights_spec(self):
+        from dynamo_tpu.runtime.admission import parse_tenant_weights
+
+        assert parse_tenant_weights("a=4,b=1.5") == {"a": 4.0, "b": 1.5}
+        # Malformed entries are skipped, not fatal.
+        assert parse_tenant_weights("a=4,junk,c=-1,=2,d=x") == {"a": 4.0}
+        assert parse_tenant_weights("") == {}
+
+    def test_singleton_reset(self):
+        from dynamo_tpu.runtime.admission import (
+            get_tenant_ledger,
+            reset_tenant_ledger,
+        )
+
+        first = get_tenant_ledger()
+        assert get_tenant_ledger() is first
+        reset_tenant_ledger()
+        assert get_tenant_ledger() is not first
+        reset_tenant_ledger()

@@ -138,6 +138,7 @@ class TestKillNineMidStream:
                     pass
 
 
+@pytest.mark.slow
 class TestOverloadRamp:
     """Chaos-overload scenario (ROADMAP item 4): an open-loop Poisson
     ramp walks offered load ~2x past the capacity knee of a mocker
@@ -163,6 +164,36 @@ class TestOverloadRamp:
             path = _write_chaos_report("chaos_overload", report,
                                        default_dir=str(tmp_path))
             print(f"overload scenario report: {path}")
+            failed = [c for c in report["assertions"] if not c["ok"]]
+            assert report["passed"], failed
+
+        run(body(), timeout=240.0)
+
+
+@pytest.mark.slow
+class TestTwoTenantRamp:
+    """Two-tenant QoS chaos ramp (docs/multi-tenancy.md): interactive
+    tenant at a fixed below-knee rate, batch tenant ramping ~2x past
+    the knee, A/B against the identical traffic untagged. Asserted
+    from the JSON report (the chaos-two-tenant CI artifact): the
+    interactive goodput curve holds flat past the knee, batch absorbs
+    the shed and the preemptions (dynamo_preempt_total > 0), shed
+    attribution lands on the flooding tenant, and the whole QoS plane
+    costs <= 10% total goodput vs untagged FCFS."""
+
+    def test_two_tenant_ramp_protects_interactive(self, run, tmp_path):
+        from dynamo_tpu.mocker.overload import (
+            TwoTenantParams,
+            run_two_tenant_scenario,
+        )
+
+        params = TwoTenantParams(ramp_secs=16.0, batch_end_rps=20.0)
+
+        async def body():
+            report = await run_two_tenant_scenario(params)
+            path = _write_chaos_report("chaos_two_tenant", report,
+                                       default_dir=str(tmp_path))
+            print(f"two-tenant scenario report: {path}")
             failed = [c for c in report["assertions"] if not c["ok"]]
             assert report["passed"], failed
 

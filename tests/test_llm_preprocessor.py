@@ -166,3 +166,64 @@ class TestDeltaGenerator:
         resp = gen.final_response()
         assert resp["choices"][0]["message"]["content"] == "done"
         assert resp["object"] == "chat.completion"
+
+
+class TestPriorityWireSurface:
+    """Multi-tenant QoS wire surface (docs/multi-tenancy.md): the
+    `priority` / `tenant` body fields normalize onto
+    PreprocessedRequest; invalid classes 400 at the edge."""
+
+    def _pre(self):
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+
+        return OpenAIPreprocessor(ModelDeploymentCard(name="t"))
+
+    def test_priority_defaults_to_standard(self):
+        pre = self._pre().preprocess_chat(
+            {"messages": [{"role": "user", "content": "hi"}]})
+        assert pre.priority == "standard"
+        assert pre.tenant == ""
+
+    def test_priority_and_tenant_normalized(self):
+        pre = self._pre().preprocess_chat({
+            "messages": [{"role": "user", "content": "hi"}],
+            "priority": "  Interactive ", "tenant": "acme"})
+        assert pre.priority == "interactive"
+        assert pre.tenant == "acme"
+
+    def test_completions_accept_priority(self):
+        pre = self._pre().preprocess_completions(
+            {"prompt": "hello", "priority": "batch"})
+        assert pre.priority == "batch"
+
+    def test_unknown_priority_is_400(self):
+        from dynamo_tpu.llm.preprocessor import RequestError
+
+        with pytest.raises(RequestError, match="priority"):
+            self._pre().preprocess_chat({
+                "messages": [{"role": "user", "content": "hi"}],
+                "priority": "urgent"})
+
+    def test_wire_roundtrip_default_omits_fields(self):
+        from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+        pre = self._pre().preprocess_chat(
+            {"messages": [{"role": "user", "content": "hi"}]})
+        wire = pre.to_wire()
+        assert "priority" not in wire and "tenant" not in wire
+        tagged = self._pre().preprocess_chat({
+            "messages": [{"role": "user", "content": "hi"}],
+            "priority": "batch", "tenant": "acme"})
+        back = PreprocessedRequest.from_wire(tagged.to_wire())
+        assert back.priority == "batch" and back.tenant == "acme"
+
+    def test_class_rank_helpers(self):
+        from dynamo_tpu.llm.protocols import class_rank, normalize_priority
+
+        assert class_rank("interactive") > class_rank("standard") \
+            > class_rank("batch")
+        assert class_rank("weird") == class_rank("standard")
+        assert normalize_priority(None) == "standard"
+        with pytest.raises(ValueError):
+            normalize_priority("urgent")

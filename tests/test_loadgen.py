@@ -151,3 +151,91 @@ class TestLoadgenCli:
         assert json.loads(lines[0])["written"] == 10
         summary = json.loads(lines[-1])
         assert summary["requests"] == 10 and summary["errors"] == 0
+
+
+class TestTenantTraces:
+    """Multi-tenant load shapes (docs/multi-tenancy.md): --tenants spec
+    parsing, tagged trace synthesis, and per-tenant bucket summaries."""
+
+    def test_parse_tenants_spec(self):
+        from dynamo_tpu.mocker.loadgen import parse_tenants_spec
+
+        specs = parse_tenants_spec("alice:interactive:3,bob:batch:2:24")
+        assert [(s.name, s.priority, s.start_rps, s.end_rps)
+                for s in specs] == [("alice", "interactive", 3.0, 3.0),
+                                    ("bob", "batch", 2.0, 24.0)]
+        with pytest.raises(ValueError):
+            parse_tenants_spec("alice:urgent:3")  # unknown class
+        with pytest.raises(ValueError):
+            parse_tenants_spec("")
+
+    def test_synthesize_tenant_trace_tags_and_merges(self, tmp_path):
+        from dynamo_tpu.mocker.loadgen import (
+            load_trace,
+            parse_tenants_spec,
+            save_trace,
+            synthesize_tenant_trace,
+        )
+
+        records = synthesize_tenant_trace(
+            parse_tenants_spec("a:interactive:5,b:batch:5"), 4.0, seed=1)
+        assert records, "empty trace"
+        tenants = {r.tenant for r in records}
+        assert tenants == {"a", "b"}
+        # Merged timeline is sorted.
+        ts = [r.ts_ms for r in records]
+        assert ts == sorted(ts)
+        # Priorities follow the spec.
+        assert all(r.priority == "interactive" for r in records
+                   if r.tenant == "a")
+        # Prefix ids are tenant-disjoint (tenants never share KV).
+        ids_a = {h for r in records if r.tenant == "a"
+                 for h in (r.hash_ids or [])}
+        ids_b = {h for r in records if r.tenant == "b"
+                 for h in (r.hash_ids or [])}
+        assert not (ids_a & ids_b)
+        # Wire roundtrip preserves the tags.
+        path = str(tmp_path / "trace.jsonl")
+        save_trace(path, records)
+        back = load_trace(path)
+        assert [(r.tenant, r.priority) for r in back] \
+            == [(r.tenant, r.priority) for r in records]
+
+    def test_summarize_tenant_buckets(self):
+        from dynamo_tpu.mocker.loadgen import summarize_tenant_buckets
+
+        samples = [
+            {"t_s": 0.5, "ok": True, "good": True, "shed": False,
+             "tokens": 4, "tenant": "a"},
+            {"t_s": 1.5, "ok": False, "good": False, "shed": True,
+             "tokens": 0, "tenant": "b"},
+            {"t_s": 1.6, "ok": True, "good": False, "shed": False,
+             "tokens": 2},  # untagged
+        ]
+        out = summarize_tenant_buckets(samples, 1.0, total_secs=2.0)
+        assert set(out) == {"a", "b", "untagged"}
+        assert out["a"][0]["good"] == 1
+        assert out["b"][1]["shed"] == 1
+        assert out["untagged"][1]["ok"] == 1
+
+    def test_replay_threads_priority_onto_requests(self, run):
+        from dynamo_tpu.mocker.loadgen import (
+            OfflineReplay,
+            parse_tenants_spec,
+            synthesize_tenant_trace,
+        )
+
+        records = synthesize_tenant_trace(
+            parse_tenants_spec("i:interactive:8,b:batch:8"), 2.0,
+            isl_mean=64, osl_mean=4, seed=3)
+
+        async def body():
+            replayer = OfflineReplay(mode="single")
+            report = await replayer.run(records)
+            assert report.errors == 0
+            tenants = {s.tenant for s in report.stats}
+            assert tenants == {"i", "b"}
+            buckets = report.tenant_bucket_summary(1.0)
+            assert set(buckets) == {"b", "i"}
+
+        run(body(), timeout=60)

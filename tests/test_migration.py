@@ -168,3 +168,120 @@ class TestMigrationAccounting:
             assert inner.attempts == 2  # initial + 1 retry
 
         run(body())
+
+
+class TestCooperativeMigration:
+    """Cooperative (worker-initiated, in-band finish_reason='migrate')
+    migrations carry their own bound — DYNT_PREEMPT_MIGRATION_LIMIT —
+    and never consume the failure budget (docs/multi-tenancy.md
+    preemption ladder), nor pay backoff jitter."""
+
+    class PreemptingEngine(TokenEngine):
+        """Emits `migrates` cooperative migrate frames (one per
+        attempt), then completes; optionally also drops the connection
+        `fails` times after that."""
+
+        def __init__(self, migrates: int, fails: int = 0):
+            self.migrates = migrates
+            self.fails = fails
+            self.attempts = 0
+
+        async def generate(self, request):
+            self.attempts += 1
+            yield EngineOutput(token_ids=[self.attempts])
+            if self.attempts <= self.migrates:
+                yield EngineOutput(finish_reason="migrate",
+                                   error="preempted under interactive "
+                                         "pressure")
+                return
+            if self.attempts <= self.migrates + self.fails:
+                raise ConnectionLost("worker died")
+            yield EngineOutput(token_ids=[999], finish_reason="stop")
+
+    def test_cooperative_bound_is_separate_from_failure_bound(self, run):
+        async def body():
+            # 3 cooperative migrations exceed migration_limit=1 but fit
+            # cooperative_limit=5: the stream must complete.
+            inner = self.PreemptingEngine(migrates=3)
+            migration = Migration(inner, migration_limit=1,
+                                  cooperative_limit=5)
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=50))]
+            assert outs[-1].finish_reason == "stop"
+            assert inner.attempts == 4
+            # ...and the failure budget is still fully available after
+            # the cooperative replays: one failure + one clean retry.
+            inner2 = self.PreemptingEngine(migrates=2, fails=1)
+            migration2 = Migration(inner2, migration_limit=1,
+                                   cooperative_limit=5)
+            outs2 = [o async for o in
+                     migration2.generate(_request(max_tokens=50))]
+            assert outs2[-1].finish_reason == "stop"
+            assert inner2.attempts == 4  # 2 coop + 1 failure + final
+
+        run(body())
+
+    def test_cooperative_limit_bounds_replays(self, run):
+        async def body():
+            inner = self.PreemptingEngine(migrates=10)
+            migration = Migration(inner, migration_limit=3,
+                                  cooperative_limit=2)
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=50))]
+            assert outs[-1].finish_reason == "error"
+            assert "migration limit" in outs[-1].error
+            assert inner.attempts == 3  # initial + 2 cooperative
+
+        run(body())
+
+    def test_cooperative_replay_skips_backoff(self, run):
+        async def body():
+            inner = self.PreemptingEngine(migrates=2)
+            migration = Migration(inner, migration_limit=3,
+                                  cooperative_limit=5)
+            calls = []
+
+            class _CountingPolicy:
+                def next_delay(self, prev):
+                    calls.append(prev)
+                    return 99.0
+
+            migration.policy = _CountingPolicy()
+            import time
+
+            t0 = time.monotonic()
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=50))]
+            assert outs[-1].finish_reason == "stop"
+            # The jitter policy was never consulted and nothing slept.
+            assert calls == []
+            assert time.monotonic() - t0 < 1.0
+
+        run(body())
+
+    def test_cooperative_limit_honors_registry_knob(self, run,
+                                                    monkeypatch):
+        monkeypatch.setenv("DYNT_PREEMPT_MIGRATION_LIMIT", "1")
+
+        async def body():
+            inner = self.PreemptingEngine(migrates=10)
+            migration = Migration(inner, migration_limit=3)
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=50))]
+            assert outs[-1].finish_reason == "error"
+            assert inner.attempts == 2  # initial + 1 cooperative
+
+        run(body())
+
+    def test_tokens_preserved_across_cooperative_replay(self, run):
+        async def body():
+            inner = self.PreemptingEngine(migrates=1)
+            migration = Migration(inner, migration_limit=0,
+                                  cooperative_limit=3)
+            outs = [o async for o in
+                    migration.generate(_request(max_tokens=50))]
+            tokens = [t for o in outs for t in o.token_ids]
+            assert tokens == [1, 2, 999]
+            assert all(o.finish_reason != "migrate" for o in outs)
+
+        run(body())

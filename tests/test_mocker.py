@@ -189,3 +189,105 @@ class TestTimingFidelity:
             assert thpts == sorted(thpts)  # more batch -> more tok/s
         # ...and ITL grows with context at fixed batch share
         assert max(itl.values()) > min(itl.values())
+
+
+class TestMockerPreemption:
+    """Chip-free QoS plane (docs/multi-tenancy.md): interactive
+    arrivals preempt batch decode slots; parked sequences resume and
+    still deliver their full token budget."""
+
+    def _request(self, tokens, max_tokens, rid, priority="standard"):
+        return PreprocessedRequest(
+            request_id=rid,
+            token_ids=list(tokens),
+            sampling=SamplingOptions(max_tokens=max_tokens),
+            stop=StopConditions(),
+            priority=priority,
+        ).to_wire()
+
+    def test_interactive_preempts_batch_slot(self, run):
+        async def body():
+            # One slot: the interactive arrival MUST preempt to run.
+            engine = MockerEngine(_fast_config(max_batch=1,
+                                               speedup_ratio=50.0))
+
+            async def one(req):
+                outs = [EngineOutput.from_wire(o)
+                        async for o in engine.generate(req)]
+                return [t for o in outs for t in o.token_ids], outs[-1]
+
+            batch_task = asyncio.create_task(one(self._request(
+                range(32), 24, "batch-1", priority="batch")))
+            # Let the batch request start decoding.
+            for _ in range(200):
+                await asyncio.sleep(0.005)
+                if engine._running and engine._running[0].generated >= 1:
+                    break
+            inter_tokens, inter_last = await one(self._request(
+                range(64, 96), 4, "inter-1", priority="interactive"))
+            batch_tokens, batch_last = await batch_task
+            await engine.close()
+            assert engine.preempt_parked >= 1
+            assert engine.preempt_resumed == engine.preempt_parked
+            assert len(inter_tokens) == 4
+            # The preempted batch stream still delivers every token.
+            assert len(batch_tokens) == 24
+            assert batch_last.finish_reason == "length"
+            assert not engine._parked
+
+        run(body())
+
+    def test_waiting_order_is_class_strict(self, run, monkeypatch):
+        # No preemption: this test pins pure ADMISSION order, so the
+        # standard-class warm request must keep its slot.
+        monkeypatch.setenv("DYNT_PREEMPT_ENABLE", "0")
+
+        async def body():
+            # Real-time step pacing (speedup 1): the warm request holds
+            # the single slot long enough for both later arrivals to
+            # queue behind it.
+            engine = MockerEngine(_fast_config(max_batch=1,
+                                               speedup_ratio=1.0))
+            order = []
+
+            async def one(req, tag):
+                outs = [o async for o in engine.generate(req)]
+                order.append(tag)
+                return outs
+
+            warm = asyncio.create_task(one(self._request(
+                range(32), 30, "warm"), "warm"))
+            await asyncio.sleep(0.05)
+            # Batch arrives first, interactive second — interactive
+            # must still admit (and finish) first.
+            t_batch = asyncio.create_task(one(self._request(
+                range(32, 64), 2, "b", priority="batch"), "b"))
+            await asyncio.sleep(0.02)
+            t_inter = asyncio.create_task(one(self._request(
+                range(96, 128), 2, "i", priority="interactive"), "i"))
+            await asyncio.gather(warm, t_batch, t_inter)
+            await engine.close()
+            assert order.index("i") < order.index("b")
+
+        run(body())
+
+    def test_preempt_disabled_keeps_fcfs(self, run, monkeypatch):
+        monkeypatch.setenv("DYNT_PREEMPT_ENABLE", "0")
+
+        async def body():
+            engine = MockerEngine(_fast_config(max_batch=1,
+                                               speedup_ratio=50.0))
+
+            async def one(req):
+                return [o async for o in engine.generate(req)]
+
+            batch_task = asyncio.create_task(one(self._request(
+                range(32), 16, "batch-2", priority="batch")))
+            await asyncio.sleep(0.05)
+            await one(self._request(range(64, 96), 2, "inter-2",
+                                    priority="interactive"))
+            await batch_task
+            await engine.close()
+            assert engine.preempt_parked == 0
+
+        run(body())

@@ -238,7 +238,8 @@ class TestSloObserver:
 
         def goodput():
             return (rt_metrics.SLO_GOOD
-                    .labels(model="slo-test")._value.get())
+                    .labels(model="slo-test", priority="standard",
+                            tenant="untagged")._value.get())
 
         base = goodput()
         obs = hs._SloObserver(pre, ttft_target_ms=0, itl_target_ms=100)
@@ -495,7 +496,8 @@ class TestE2ESpans:
             assert any(f'trace_id="{client_trace}"' in line
                        for line in ttft_lines), ttft_lines
             # goodput counted the request (no targets set -> good)
-            assert ('dynamo_slo_good_total{model="tiny-test"}'
+            assert ('dynamo_slo_good_total{model="tiny-test",'
+                    'priority="standard",tenant="untagged"}'
                     in metrics_text["body"])
         finally:
             monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)
@@ -580,9 +582,11 @@ class TestE2ESpans:
                 assert server, (name, {s["name"] for s in spans})
                 assert server[0]["status"]["code"] == 1, server
             # both streams counted toward goodput (TTFT well under target)
-            assert (f'dynamo_slo_requests_total{{model="{model}"}} 2.0'
+            assert (f'dynamo_slo_requests_total{{model="{model}",'
+                    'priority="standard",tenant="untagged"} 2.0'
                     in metrics_text["body"]), metrics_text["body"]
-            assert (f'dynamo_slo_good_total{{model="{model}"}} 2.0'
+            assert (f'dynamo_slo_good_total{{model="{model}",'
+                    'priority="standard",tenant="untagged"} 2.0'
                     in metrics_text["body"])
         finally:
             monkeypatch.delenv("DYNT_OTLP_ENDPOINT", raising=False)

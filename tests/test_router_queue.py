@@ -395,3 +395,145 @@ class TestQueueE2E:
                 await _teardown(frontend, frt, workers)
 
         run(body(), timeout=90.0)
+
+
+class TestClassStrictOrdering:
+    """Multi-tenant QoS (docs/multi-tenancy.md): the parked heap is
+    class-strict — drain order re-consults class so a newly arrived
+    higher-class request overtakes parked lower-class entries (the
+    parked-entry priority-inversion fix), and lower-class backlog never
+    head-of-line-blocks interactive traffic."""
+
+    def _req(self, isl=8, rid=None, priority_class="standard",
+             workers=(W0,)):
+        return QueuedRequest(candidates=list(workers), block_hashes=[],
+                             isl_tokens=isl, request_id=rid,
+                             priority_class=priority_class)
+
+    def test_new_interactive_overtakes_parked_batch(self, run):
+        async def body():
+            q = _queue(policy="fcfs", threshold=0.5, budget=100)
+            await q.schedule(self._req(isl=96, rid="warm"))
+            order = []
+
+            async def one(rid, cls):
+                await q.schedule(self._req(rid=rid, priority_class=cls))
+                order.append(rid)
+
+            tasks = [asyncio.create_task(one("b1", "batch"))]
+            await asyncio.sleep(0.02)
+            tasks.append(asyncio.create_task(one("b2", "batch")))
+            await asyncio.sleep(0.02)
+            # Interactive arrives LAST, long after the batch entries
+            # parked — fcfs arrival offsets would bury it, class rank
+            # must not.
+            tasks.append(asyncio.create_task(one("i1", "interactive")))
+            await asyncio.sleep(0.02)
+            assert q.pending_count == 3
+            q.scheduler.free("warm")
+            q.update()
+            await asyncio.gather(*tasks)
+            assert order == ["i1", "b1", "b2"]
+
+        run(body())
+
+    def test_zero_cross_tenant_hol_blocking_in_drain(self, run):
+        """Mixed-class park/drain sequences: across repeated drains, no
+        batch entry EVER drains while an interactive entry is parked."""
+
+        async def body():
+            q = _queue(policy="fcfs", threshold=0.5, budget=100)
+            await q.schedule(self._req(isl=96, rid="warm"))
+            drained = []
+
+            async def one(rid, cls):
+                await q.schedule(self._req(rid=rid, priority_class=cls))
+                drained.append((rid, cls))
+                # Completed instantly: release the booking so the whole
+                # backlog can drain through the budget.
+                q.scheduler.free(rid)
+                q.update()
+
+            tasks = []
+            for i, cls in enumerate(["batch", "standard", "batch",
+                                     "interactive", "standard",
+                                     "interactive", "batch"]):
+                tasks.append(asyncio.create_task(one(f"r{i}", cls)))
+                await asyncio.sleep(0.01)
+            assert q.pending_count == 7
+            q.scheduler.free("warm")
+            q.update()
+            await asyncio.gather(*tasks)
+            ranks = {"interactive": 2, "standard": 1, "batch": 0}
+            order = [ranks[cls] for _rid, cls in drained]
+            # Class-monotone drain: ranks never increase.
+            assert order == sorted(order, reverse=True), drained
+            # FIFO within a class.
+            inter = [rid for rid, cls in drained if cls == "interactive"]
+            assert inter == ["r3", "r5"]
+            batch = [rid for rid, cls in drained if cls == "batch"]
+            assert batch == ["r0", "r2", "r6"]
+
+        run(body())
+
+    def test_priority_jump_orders_within_class_only(self, run):
+        async def body():
+            q = _queue(policy="fcfs", threshold=0.5, budget=100)
+            await q.schedule(self._req(isl=96, rid="warm"))
+            order = []
+
+            async def one(rid, cls, jump=0.0):
+                req = self._req(rid=rid, priority_class=cls)
+                req.priority_jump = jump
+                await q.schedule(req)
+                order.append(rid)
+
+            tasks = [
+                asyncio.create_task(one("b-jumped", "batch", jump=100.0)),
+            ]
+            await asyncio.sleep(0.02)
+            tasks.append(asyncio.create_task(one("s-plain", "standard")))
+            await asyncio.sleep(0.02)
+            q.scheduler.free("warm")
+            q.update()
+            await asyncio.gather(*tasks)
+            # A huge intra-class jump cannot cross a class boundary.
+            assert order == ["s-plain", "b-jumped"]
+
+        run(body())
+
+    def test_quota_refusal_when_parking(self, run, monkeypatch):
+        from dynamo_tpu.runtime.admission import (
+            AdmissionRefused,
+            get_tenant_ledger,
+            reset_tenant_ledger,
+        )
+
+        monkeypatch.setenv("DYNT_TENANT_RATE_LIMIT", "100")
+        monkeypatch.setenv("DYNT_TENANT_WINDOW_SECS", "10")
+        reset_tenant_ledger()
+
+        async def body():
+            q = _queue(threshold=0.5, budget=100)
+            await q.schedule(self._req(isl=96, rid="warm"))
+            # Flood tenant already far over its share; a peer makes the
+            # share binding (two active tenants).
+            get_tenant_ledger().observe("flood", 5000)
+            get_tenant_ledger().observe("peer", 4000)
+            req = self._req(rid="f1")
+            req.tenant = "flood"
+            with pytest.raises(AdmissionRefused) as exc_info:
+                await q.schedule(req)
+            assert exc_info.value.reason == "quota"
+            # Untagged requests park normally under the same pressure.
+            task = asyncio.create_task(q.schedule(self._req(rid="u1")))
+            await asyncio.sleep(0.02)
+            assert q.pending_count == 1
+            q.scheduler.free("warm")
+            q.update()
+            await asyncio.wait_for(task, 2.0)
+
+        try:
+            run(body())
+        finally:
+            reset_tenant_ledger()
