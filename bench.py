@@ -707,6 +707,46 @@ def bench_session_point() -> dict:
     }
 
 
+def bench_drain_point() -> dict:
+    """Graceful-drain point for BENCH_r06 (ISSUE 15 / docs/
+    fault-tolerance.md departure ladder): evict one worker of a mocker
+    fleet mid-decode and record what the departure cost — wall time of
+    the drain (announce -> handoff -> deregistration-ready), sequences
+    per ladder rung, and the re-prefilled-token count on the KV-handoff
+    path (the zero-drop headline: 0 on the handoff rung vs a full
+    prompt re-prefill per stream on the replay fallback). Runs the same
+    in-process scenario the chaos-drain CI job gates on
+    (dynamo_tpu/mocker/drain_chaos.py)."""
+    import asyncio
+
+    from dynamo_tpu.mocker.drain_chaos import DrainChaosParams, run_scenario
+
+    params = DrainChaosParams(n_workers=2, n_streams=8, max_tokens=40,
+                              decode_base_ms=20.0)
+    report = asyncio.run(run_scenario(params, fallback_pass=True))
+
+    def rungs(key: str) -> dict:
+        rep = report[key]["drain_report"] or {}
+        return {"handoff": len(rep.get("handoff") or []),
+                "replay": len(rep.get("replay") or []),
+                "errored": rep.get("errored", 0),
+                "duration_ms": rep.get("duration_ms"),
+                "reprefill_tokens": report[key]["reprefill_tokens"]}
+
+    return {
+        "profile": (f"{params.n_workers}-worker mocker fleet, "
+                    f"{params.n_streams} live streams, evict 1 "
+                    "mid-decode"),
+        "deadline_secs": params.deadline_secs,
+        "passed": report["passed"],
+        "handoff_path": rungs("drain_handoff"),
+        "replay_fallback": rungs("drain_replay"),
+        "bit_identical": all(
+            c["ok"] for c in report["assertions"]
+            if c["name"] == "bit_identical_to_undrained_run"),
+    }
+
+
 def bench_goodput_point() -> dict:
     """Goodput-vs-load curve with the overload-control loop off vs on
     (ROADMAP item 4 / ISSUE 9) — the chip-free robustness point
@@ -852,6 +892,8 @@ def main() -> None:
             result["two_class_goodput"] = bench_two_class_point()
         if os.environ.get("DYNT_BENCH_SESSION", "1") != "0":
             result["session_cache"] = bench_session_point()
+        if os.environ.get("DYNT_BENCH_DRAIN", "1") != "0":
+            result["drain"] = bench_drain_point()
         print(json.dumps(result))
         return
 
@@ -943,6 +985,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — chip-free point must
             # never cost the round its silicon numbers
             result["session_cache"] = {"error": repr(exc)}
+    if os.environ.get("DYNT_BENCH_DRAIN", "1") != "0":
+        try:
+            result["drain"] = bench_drain_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["drain"] = {"error": repr(exc)}
     print(json.dumps(result))
 
 

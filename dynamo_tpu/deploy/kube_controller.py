@@ -515,13 +515,19 @@ class KubeDeploymentController:
             for d in old_revs)
         if old_revs:
             if ready >= want:
+                # Complete BEFORE the retire deletes: each DELETE awaits
+                # the apiserver, so a status() reader polling between
+                # them could see the new revision alone while the
+                # rollout still says "progressing". The new set is fully
+                # ready here — retirement is cleanup, and a failed
+                # delete is swept by the periodic GC pass.
+                if roll is not None and roll.state == "progressing":
+                    roll.state = "complete"
                 for dep in old_revs:
                     await self._req("DELETE",
                                     self._url(dep["metadata"]["name"]))
                     log.info("rollout %s: old revision %s retired", name,
                              dep["metadata"]["name"])
-                if roll is not None and roll.state == "progressing":
-                    roll.state = "complete"
             elif _roll_expired():
                 # New revision never became ready: delete it and revert
                 # the service spec to the revision still serving.
@@ -632,13 +638,19 @@ class KubeDeploymentController:
                            for o in objs if _obj_complete(o))
         if old_by_rev:
             if complete >= want:
+                # Complete BEFORE the retire deletes (see the deployment
+                # path above): every new gang is ready here, and a
+                # status() reader polling between the awaited deletes
+                # must not see "progressing" with only the new revision
+                # left. Leftovers from a failed delete are swept by the
+                # periodic GC pass.
+                if roll is not None and roll.state == "progressing":
+                    roll.state = "complete"
                 for objs in old_by_rev.values():
                     for obj in objs:
                         await self._delete_gang(obj["metadata"]["name"])
                         log.info("rollout %s: old gang %s retired", name,
                                  obj["metadata"]["name"])
-                if roll is not None and roll.state == "progressing":
-                    roll.state = "complete"
             elif _roll_expired():
                 await self._roll_back_gangs(
                     name, svc, rev, want, roll,

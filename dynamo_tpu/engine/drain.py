@@ -1,0 +1,192 @@
+"""Graceful drain plane: zero-drop worker departures.
+
+The reference treats worker departure as fault tolerance's centerpiece —
+graceful shutdown drains in-flight requests before deregistering, and the
+operator's rolling updates depend on it (ref: components/src/dynamo/common/
+utils/graceful_shutdown.py; docs/fault-tolerance.md departure ladder). On
+TPUs the scenario is sharper: spot/preemptible VMs get a ~30s eviction
+notice, so planner scale-downs and rolling restarts must vacate a worker
+without killing its live streams.
+
+The DrainCoordinator runs the departure ladder on SIGTERM, the worker's
+`drain` control verb (request plane), the status server's POST /drain, or
+a faults-service `evict` notice:
+
+  1. announce — flip the worker to draining in discovery (card
+     runtime_config) and LoadMetrics so routers stop selecting it and
+     decay its radix state; the scheduler bounces anything that raced
+     the flip with an in-band migrate.
+  2. KV handoff — every eligible live decode sequence parks its computed
+     pages with the transfer table and emits a migrate frame carrying
+     kv_transfer_params + resume state (seed, step count, generated
+     tokens); the frontend Migration operator re-dispatches to a peer
+     that PULLS the KV over the existing StreamingTransfer/kv_pull plane
+     and resumes bit-identically — zero re-prefilled tokens.
+  3. cooperative replay — sequences a handoff cannot carry (mid-prefill,
+     host-sampler/logits-processor state) emit a plain migrate; the peer
+     replays prompt+generated (PR-14's CooperativeMigration bound).
+  4. honest error — at the DYNT_DRAIN_DEADLINE_SECS budget, whatever
+     remains (unclaimed transfers, stuck prefill-only legs) finishes
+     with an in-band error instead of dying with the process.
+
+The worker deregisters (endpoints close, lease revokes) only when empty
+or expired — `drain()` returns and the main's teardown proceeds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from ..runtime.metrics import DRAIN_DURATION_MS, DRAIN_SEQUENCES, DRAIN_STATE
+
+log = get_logger("engine.drain")
+
+SERVING, DRAINING, DRAINED = "serving", "draining", "drained"
+_STATE_CODE = {SERVING: 0, DRAINING: 1, DRAINED: 2}
+
+
+def set_drain_state(instance_id: int, state: str) -> None:
+    """Export dynamo_drain_state for a worker. Workers call this with
+    SERVING at START — the coordinator is constructed lazily on the
+    first drain(), so without the startup stamp the documented
+    0=serving sample never exists and a dashboard can't tell "serving"
+    from "not scraped" (docs/metrics.md) — and the ladders (this
+    module's and the mocker's chip-free one) call it on every
+    transition."""
+    try:
+        DRAIN_STATE.labels(worker=f"{instance_id:x}").set(
+            _STATE_CODE[state])
+    except Exception:  # noqa: BLE001 — gauges must not block a drain
+        pass
+
+
+class DrainCoordinator:
+    """One per worker; owns the departure ladder. Idempotent: the first
+    drain() runs the ladder, concurrent/repeated calls (double SIGTERM,
+    a POST /drain racing the signal) await and return the same report.
+
+    `worker` duck-type surface: .scheduler (InferenceScheduler),
+    .transfers (PendingTransferTable), .instance_id,
+    .register_drain_handoff(seq, page_ids, computed) -> params|None,
+    .announce_draining() async (discovery + LoadMetrics flip)."""
+
+    def __init__(self, worker, deadline_secs: Optional[float] = None,
+                 handoff: Optional[bool] = None) -> None:
+        self.worker = worker
+        self.deadline_secs = (env("DYNT_DRAIN_DEADLINE_SECS")
+                              if deadline_secs is None else deadline_secs)
+        self.handoff_enabled = (bool(env("DYNT_DRAIN_HANDOFF"))
+                                if handoff is None else handoff)
+        self.state = SERVING
+        self._task: Optional[asyncio.Task] = None
+        self._set_state(SERVING)
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        set_drain_state(self.worker.instance_id, state)
+
+    async def drain(self, reason: str = "signal") -> dict:
+        """Run (or join) the departure ladder; returns the drain report.
+        Safe to call from any task — double-SIGTERM, a control verb
+        racing the signal, and repeated POSTs all converge on ONE
+        ladder run."""
+        if not env("DYNT_DRAIN_ENABLE"):
+            return {"skipped": True, "reason": "DYNT_DRAIN_ENABLE=0"}
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(reason))
+        return await asyncio.shield(self._task)
+
+    async def _run(self, reason: str) -> dict:
+        worker = self.worker
+        scheduler = worker.scheduler
+        start = time.monotonic()
+        deadline = start + max(0.5, self.deadline_secs)
+        self._set_state(DRAINING)
+        log.info("drain starting (%s): deadline %.1fs handoff=%s",
+                 reason, self.deadline_secs, self.handoff_enabled)
+        # 1. Announce: discovery card + LoadMetrics flip routers off this
+        # worker; the scheduler bounces raced arrivals from here on.
+        try:
+            await worker.announce_draining()
+        except Exception:  # noqa: BLE001 — an announce failure must not
+            # stop the vacate; routers still converge via lease expiry
+            log.exception("drain announce failed; continuing")
+        # One event tick for routers to apply the flip BEFORE migrate
+        # frames ask them to re-dispatch — else the handoff replay races
+        # straight back at this worker and burns its cooperative bound
+        # on a bounce. Bounded by the remaining deadline budget.
+        settle = min(float(env("DYNT_DRAIN_ANNOUNCE_SETTLE_SECS")),
+                     max(0.0, deadline - time.monotonic() - 1.0))
+        if settle > 0:
+            await asyncio.sleep(settle)
+        # 2+3. Vacate live sequences on the scheduler thread (between
+        # steps — pages can change ownership safely there).
+        register = (worker.register_drain_handoff
+                    if self.handoff_enabled else None)
+        q = scheduler.run_in_step(
+            lambda: scheduler.drain_sweep(register_handoff=register))
+        try:
+            report, exc = await asyncio.to_thread(
+                q.get, True, max(1.0, deadline - time.monotonic()))
+        except Exception as exc_:  # noqa: BLE001 — queue.Empty: the
+            # scheduler thread is wedged; fall through to the deadline
+            # rung with an empty report rather than hanging the drain
+            report, exc = None, exc_
+        if exc is not None:
+            log.exception("drain sweep failed", exc_info=exc)
+            report = {"handoff": [], "replay": [], "pending": [],
+                      "sweep_error": repr(exc)}
+        # Wait for peers to pull the parked handoffs and for pending
+        # prefill-only transfers to finish, bounded by the deadline.
+        errored = 0
+        while time.monotonic() < deadline:
+            active, waiting = scheduler.queue_depth()
+            if active == 0 and waiting == 0 and len(worker.transfers) == 0:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            # 4. Deadline rung: expire unclaimed transfers (pages
+            # release; a peer's late pull sees "unknown transfer" and
+            # takes its replay fallback), then finish anything still
+            # live with an honest error.
+            expired = worker.transfers.expire_all()
+            q = scheduler.run_in_step(
+                lambda: scheduler.drain_expire(
+                    "worker drain deadline exceeded"))
+            try:
+                errored, exc = await asyncio.to_thread(q.get, True, 10.0)
+            except Exception as exc_:  # noqa: BLE001 — queue.Empty
+                errored, exc = 0, exc_
+            if exc is not None:
+                log.exception("drain expire failed", exc_info=exc)
+                errored = 0
+            if expired or errored:
+                log.warning("drain deadline: expired %d transfer(s), "
+                            "errored %d live sequence(s)", expired,
+                            errored)
+        duration_ms = (time.monotonic() - start) * 1e3
+        report = {
+            **report,
+            "reason": reason,
+            "bounced": scheduler.stats.drain_bounced,
+            "errored": errored,
+            "completed": errored == 0 and not report.get("sweep_error"),
+            "duration_ms": round(duration_ms, 3),
+        }
+        for outcome, count in (("handoff", len(report["handoff"])),
+                               ("replay", len(report["replay"])),
+                               ("error", errored)):
+            if count:
+                DRAIN_SEQUENCES.labels(outcome=outcome).inc(count)
+        DRAIN_DURATION_MS.labels(
+            worker=f"{worker.instance_id:x}").set(duration_ms)
+        self._set_state(DRAINED)
+        log.info("drain complete in %.0fms: %d handoff, %d replay, "
+                 "%d errored, %d bounced", duration_ms,
+                 len(report["handoff"]), len(report["replay"]), errored,
+                 report["bounced"])
+        return report

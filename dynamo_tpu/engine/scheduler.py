@@ -132,6 +132,11 @@ class _Seq:
     # scatters exactly what preemption gathered.
     priority_class: str = "standard"
     parked_pages: int = 0
+    # Graceful-drain handoff destination (docs/fault-tolerance.md): the
+    # resume state a draining peer shipped alongside onboard_blocks —
+    # seed, step count and generated tokens — so decode continues the
+    # committed stream bit-identically instead of re-prefilling.
+    resume_state: Optional[dict] = None
 
     @property
     def rank(self) -> int:
@@ -191,6 +196,15 @@ class SchedulerStats:
     preempt_parked: int = 0
     preempt_migrated: int = 0
     preempt_resumed: int = 0
+    # Graceful drain plane (engine/drain.py; docs/fault-tolerance.md
+    # departure ladder): sequences vacated per rung on the SOURCE
+    # (handoff / replay / error), handoff sequences resumed on the
+    # DESTINATION, and new arrivals bounced while draining.
+    drain_handoff: int = 0
+    drain_replayed: int = 0
+    drain_errored: int = 0
+    drain_resumed: int = 0
+    drain_bounced: int = 0
 
 
 class InferenceScheduler:
@@ -237,6 +251,12 @@ class InferenceScheduler:
         self.preempt_enabled = bool(env("DYNT_PREEMPT_ENABLE"))
         self.preempt_max_parked = max(0, int(env("DYNT_PREEMPT_MAX_PARKED")))
         self._parked: list[_Seq] = []
+        # Graceful drain (engine/drain.py): while draining, new arrivals
+        # bounce with an in-band migrate (the router has been told to
+        # stop selecting this worker; anything that raced the flip
+        # replays on a peer instead of being admitted into a pool that
+        # is vacating).
+        self.draining = False
 
         def _stored(hashes: list[int], parent: Optional[int]) -> None:
             # Fan out G1 registrations to the router event buffer AND the
@@ -322,6 +342,7 @@ class InferenceScheduler:
         on_prefill_chunk: Optional[Callable] = None,
         onboard_blocks: Optional[np.ndarray] = None,
         onboard_first_token: Optional[int] = None,
+        resume_state: Optional[dict] = None,
         lora_idx: int = 0,
         media_embeds: Optional[np.ndarray] = None,
         record_id: Optional[str] = None,
@@ -334,6 +355,7 @@ class InferenceScheduler:
             "on_prefill_chunk": on_prefill_chunk,
             "onboard_blocks": onboard_blocks,
             "onboard_first_token": onboard_first_token,
+            "resume_state": resume_state,
             "lora_idx": lora_idx,
             "media_embeds": media_embeds,
             "record_id": record_id,
@@ -474,6 +496,16 @@ class InferenceScheduler:
                     # request.
                     self._waiting.sort(key=lambda s: -s.rank)
                 return
+            if self.draining:
+                # Vacating: anything that raced the router's draining
+                # flip bounces with an in-band migrate — the Migration
+                # operator replays it on a peer, tokens preserved
+                # (docs/fault-tolerance.md departure ladder).
+                self.stats.drain_bounced += 1
+                emit(EngineOutput(finish_reason="migrate",
+                                  error="worker draining; replay on a "
+                                        "peer"))
+                continue
             seq = self._prepare(request, emit)
             if seq is not None:
                 seq.prefill_only = extra.get("prefill_only", False)
@@ -481,6 +513,7 @@ class InferenceScheduler:
                 seq.on_prefill_chunk = extra.get("on_prefill_chunk")
                 seq.onboard_blocks = extra.get("onboard_blocks")
                 seq.onboard_first_token = extra.get("onboard_first_token")
+                seq.resume_state = extra.get("resume_state")
                 seq.lora_idx = extra.get("lora_idx", 0)
                 seq.media_embeds = extra.get("media_embeds")
                 seq.record_id = extra.get("record_id")
@@ -668,7 +701,10 @@ class InferenceScheduler:
                 get_recorder().stamp(seq.record_id, "scheduled")
             admitted += 1
             if seq.onboard_blocks is not None:
-                self._onboard(seq)
+                if seq.resume_state is not None:
+                    self._onboard_resume(seq)
+                else:
+                    self._onboard(seq)
         if allow_preempt:
             # Pressure check ran: parked sequences resume when slots and
             # pages are back and nothing higher-class is still waiting.
@@ -970,6 +1006,50 @@ class InferenceScheduler:
             return
         self._append_token(seq, int(seq.onboard_first_token),
                            prompt_tokens=seq.prompt_len)
+
+    def _onboard_resume(self, seq: _Seq) -> None:
+        """Drain-handoff destination (docs/fault-tolerance.md): the
+        pulled bundle covers every COMPUTED position — prompt AND
+        generated tokens up to kv_len-2 (the last generated token's KV
+        is written by its next decode step, exactly as on the source).
+        Scatter it, restore seed / step count / generated history, and
+        continue decoding: the (seed, step) sampler fold-in keys pick up
+        where the source stopped, so greedy, temperature, and
+        spec-active streams all continue byte-for-byte. Nothing is
+        emitted here — the already-delivered tokens stay delivered; the
+        source reported prompt_tokens on ITS first frame, so re-emitting
+        usage here would double-count."""
+        state = seq.resume_state or {}
+        blocks = seq.onboard_blocks
+        gen = [int(t) for t in (state.get("generated") or [])]
+        n_pages = int(blocks.shape[0])
+        # Cached prompt-prefix pages already hold identical KV (same
+        # chained hashes => same bytes); scatter only the rest, like
+        # _onboard. The cache can only ever cover prompt blocks, so
+        # cached_n never reaches into the generated span.
+        cached_n = min(seq.alloc.cached_blocks, n_pages)
+        target = seq.block_table[cached_n:n_pages]
+        if len(target):
+            self.runner.scatter_pages(np.asarray(target, np.int32),  # dynalint: disable=DL201 -- host block-table slice to int32, no device transfer
+                                      blocks[cached_n:])
+        seq.onboard_blocks = None  # free host memory
+        seq.prefill_pos = seq.prompt_len
+        seq.generated = gen
+        seq.last_token = (gen[-1] if gen
+                          else int(seq.request.token_ids[-1]))
+        if state.get("seed") is not None:
+            seq.seed = int(state["seed"]) & 0xFFFFFFFF
+        if seq.spec is not None and gen:
+            # The proposer index and block-hash chain must reflect the
+            # full committed history before the next proposal.
+            seq.spec.extend(gen)
+        self.stats.drain_resumed += 1
+        if seq.record_id is not None:
+            get_recorder().event(seq.record_id, "drain_resume",
+                                 pages=n_pages,
+                                 tokens_preserved=len(gen))
+        log.info("resumed drained %s (%d tokens preserved, %d pages "
+                 "pulled)", seq.request.request_id, len(gen), n_pages)
 
     def _step(self) -> bool:
         start = time.monotonic()
@@ -1914,6 +1994,125 @@ class InferenceScheduler:
                 seq.emit(EngineOutput(finish_reason="migrate", error=reason))
                 seq.finished = True
                 n += 1
+        self._reap_finished()
+        return n
+
+    # -- graceful drain (engine/drain.py; docs/fault-tolerance.md) ---------
+
+    def drain_sweep(self, register_handoff=None) -> dict:
+        """Vacate live sequences for a graceful departure. Scheduler
+        thread only (run via run_in_step) — no decode block is in
+        flight between steps, so pages can change ownership safely.
+
+        Ladder rung 1 — KV handoff: an eligible decode sequence parks
+        its computed pages with the worker's transfer table
+        (`register_handoff(seq, page_ids, computed_tokens) -> params`)
+        and emits a migrate frame carrying kv_transfer_params + resume
+        state; the Migration operator re-dispatches it to a peer that
+        PULLS the KV and resumes bit-identically instead of
+        re-prefilling. Eligible = decode-ready with committed tokens and
+        no host-sampler state (logits processors hold live Python state
+        a handoff cannot carry — those take rung 2).
+
+        Rung 2 — cooperative replay: everything else live (mid-prefill,
+        processor slots, waiting, parked) emits a plain migrate; the
+        peer replays prompt+generated (a re-prefill, tokens preserved).
+
+        Prefill-only sequences that already handed pages to a transfer
+        keep running — their decode peer is mid-pull; the drain
+        deadline bounds them. Returns {"handoff": [...], "replay":
+        [...], "pending": [...]} request-id lists."""
+        self.draining = True
+        report: dict = {"handoff": [], "replay": [], "pending": []}
+
+        def _replay(seq: _Seq) -> None:
+            self.stats.drain_replayed += 1
+            report["replay"].append(seq.request.request_id)
+            get_recorder().event(seq.record_id, "drain",
+                                 rung="replay",
+                                 tokens_preserved=len(seq.generated))
+            seq.emit(EngineOutput(finish_reason="migrate",
+                                  error="worker draining"))
+
+        for seq in self._waiting:
+            if not seq.cancelled:
+                _replay(seq)
+                seq.cancelled = True
+        self._waiting.clear()
+        for seq in self._parked:
+            # Parked bundles reference a pool that is departing: replay.
+            self._drop_parked(seq.request.request_id)
+            if not seq.cancelled:
+                _replay(seq)
+                seq.cancelled = True
+        self._parked.clear()
+        for seq in self._slots:
+            if seq is None or seq.finished or seq.cancelled:
+                continue
+            rid = seq.request.request_id
+            if seq.prefill_only or seq.keep_pages:
+                report["pending"].append(rid)
+                continue
+            params = None
+            if (register_handoff is not None and seq.decode_ready
+                    and seq.generated and not seq.processors
+                    and not seq.first_deferred):
+                # KV present on device: positions 0..kv_len-2 (the same
+                # computed-page math as preempt-to-KVBM).
+                computed = seq.kv_len - 1
+                n_pages = -(-computed // self.page_size)
+                page_ids = [int(p) for p in seq.block_table[:n_pages]]
+                try:
+                    params = register_handoff(seq, page_ids, computed)
+                except Exception:  # noqa: BLE001 — a failed handoff
+                    # registration degrades to the replay rung
+                    log.exception("handoff registration failed for %s",
+                                  rid)
+                    params = None
+            seq.finished = True
+            if params is not None:
+                # The transfer owns the pages now; reap must not release
+                # them (the claim/expiry path releases exactly once).
+                seq.keep_pages = True
+                self.stats.drain_handoff += 1
+                report["handoff"].append(rid)
+                get_recorder().event(seq.record_id, "drain",
+                                     rung="handoff",
+                                     tokens_preserved=len(seq.generated))
+                seq.emit(EngineOutput(
+                    finish_reason="migrate",
+                    error="worker draining (kv handoff)",
+                    kv_transfer_params=params))
+            else:
+                _replay(seq)
+        self._reap_finished()
+        return report
+
+    def drain_expire(self, reason: str) -> int:
+        """Deadline rung: finish every still-live sequence with an
+        honest in-band error (scheduler thread). The ladder's last rung
+        — better a truthful failure the client can retry than a stream
+        that dies with the process."""
+        n = 0
+        for seq in self._waiting:
+            if not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="error", error=reason))
+                seq.cancelled = True
+                n += 1
+        self._waiting.clear()
+        for seq in self._parked:
+            self._drop_parked(seq.request.request_id)
+            if not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="error", error=reason))
+                seq.cancelled = True
+                n += 1
+        self._parked.clear()
+        for seq in self._slots:
+            if seq is not None and not seq.finished and not seq.cancelled:
+                seq.emit(EngineOutput(finish_reason="error", error=reason))
+                seq.finished = True
+                n += 1
+        self.stats.drain_errored += n
         self._reap_finished()
         return n
 

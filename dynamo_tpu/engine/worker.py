@@ -221,6 +221,14 @@ class TpuWorker:
         self._pull_served = None
         self._scale_served = None
         self._kvq_served = None
+        self._drain_served = None
+        # Graceful drain plane (engine/drain.py; docs/fault-tolerance.md
+        # departure ladder): set by the coordinator; LoadMetrics carries
+        # it so routers stop selecting this worker and planners count it
+        # as departing capacity.
+        self.draining = False
+        self._drain_coordinator = None
+        self._publisher = None
         self._pull_clients: dict = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._step_channel = step_channel
@@ -480,15 +488,36 @@ class TpuWorker:
         self._weights_served = await weights_ep.serve_endpoint(
             self._stream_weights, instance_id=self.instance_id
         )
-        if self.mode == "prefill":
-            pull_ep = (
-                self.runtime.namespace(self.card.namespace)
-                .component(self.card.component)
-                .endpoint("kv_pull")
-            )
-            self._pull_served = await pull_ep.serve_endpoint(
-                self._kv_pull, instance_id=self.instance_id
-            )
+        # kv_pull is served in EVERY mode, not just prefill: graceful
+        # drains park live decode sequences' pages with the transfer
+        # table, and the handoff destination pulls them from here
+        # (engine/drain.py; docs/fault-tolerance.md departure ladder).
+        pull_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("kv_pull")
+        )
+        self._pull_served = await pull_ep.serve_endpoint(
+            self._kv_pull, instance_id=self.instance_id
+        )
+        # Drain control verb (request plane); the status server's
+        # POST /drain routes to the same coordinator.
+        drain_ep = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint("drain")
+        )
+        self._drain_served = await drain_ep.serve_endpoint(
+            self._drain_endpoint, instance_id=self.instance_id
+        )
+        if getattr(self.runtime, "status_server", None) is not None:
+            self.runtime.status_server.register_drain(self.drain)
+        # Startup stamp: dynamo_drain_state=0 (serving). The coordinator
+        # only exists once a drain starts, so this is the only place the
+        # documented serving sample can come from.
+        from .drain import SERVING, set_drain_state
+
+        set_drain_state(self.instance_id, SERVING)
         # Elastic parallelism rescale (ref: vllm handlers scale_elastic_ep)
         ep_ep = (
             self.runtime.namespace(self.card.namespace)
@@ -518,6 +547,7 @@ class TpuWorker:
                 await self._do_lora_load(name, path)
         await publish_card(self.runtime, self.card, self.instance_id)
         publisher = self.runtime.event_publisher(self.card.namespace)
+        self._publisher = publisher
         if hasattr(publisher, "set_snapshot_fn"):
             # Durable journal plane: rotations seed the new generation
             # with this worker's full index instead of the old history.
@@ -1020,6 +1050,117 @@ class TpuWorker:
             log.exception("onboard H2D staging failed; using host bundle")
             return blocks, first_token
 
+    # -- graceful drain (engine/drain.py; docs/fault-tolerance.md) ---------
+
+    def _load_metrics(self) -> LoadMetrics:
+        active, waiting = self.scheduler.queue_depth()
+        return LoadMetrics(
+            worker_id=self.instance_id,
+            active_blocks=(self.scheduler.pool.num_pages - 1
+                           - self.scheduler.pool.free_count()),
+            total_blocks=self.scheduler.pool.num_pages,
+            active_requests=active,
+            waiting_requests=waiting,
+            kv_usage=self.scheduler.pool.usage(),
+            step_wall_ms=self.scheduler.stats.last_step_wall_ms,
+            prefill_tokens_in_step=self.scheduler.stats.prefill_tokens_last_step,
+            decode_tokens_in_step=self.scheduler.stats.decode_tokens_last_step,
+            device_ms_in_step=self.scheduler.stats.device_ms_last_step,
+            host_ms_in_step=self.scheduler.stats.host_ms_last_step,
+            draining=self.draining,
+        )
+
+    async def announce_draining(self) -> None:
+        """Flip this worker to draining everywhere routers look: the
+        discovery card (runtime_config) and an IMMEDIATE LoadMetrics
+        publish — waiting for the next ~0.5s load tick would leave a
+        window where routers keep selecting a vacating worker."""
+        self.draining = True
+        self.card.runtime_config["draining"] = True
+        try:
+            await publish_card(self.runtime, self.card, self.instance_id)
+        except Exception:  # noqa: BLE001 — LoadMetrics still flips
+            # routers; lease expiry is the backstop
+            log.exception("draining card republish failed")
+        if self._publisher is not None and self.scheduler is not None:
+            try:
+                await self._publisher.publish(
+                    LOAD_TOPIC, self._load_metrics().to_wire())
+            except Exception:  # noqa: BLE001
+                log.exception("draining load publish failed")
+
+    def register_drain_handoff(self, seq, page_ids: list[int],
+                               computed_tokens: int) -> dict:
+        """Scheduler-thread callback from InferenceScheduler.drain_sweep:
+        park a live decode sequence's computed pages with the transfer
+        table (served by our kv_pull endpoint while we drain) and
+        describe the pull route plus the resume state the destination
+        needs to continue the stream bit-identically."""
+        import uuid as _uuid
+
+        layout = KvLayoutDescriptor.from_wire(self.runner.kv_layout())
+        transfer_id = _uuid.uuid4().hex
+        self.transfers.add(PendingTransfer(
+            transfer_id=transfer_id,
+            page_ids=[int(p) for p in page_ids],
+            release=lambda: self.scheduler.release_transfer_pages(seq),
+            layout=layout,
+            prompt_len=computed_tokens,
+        ))
+        params = self._transfer_params(transfer_id, layout,
+                                       computed_tokens)
+        # Never offer the ICI bridge for drain handoffs: the bridge
+        # serves the comesh prefill pool's transfers, not ours, and the
+        # whole process is departing anyway — the wire path is the one
+        # that works from any peer.
+        params.pop("bridge_token", None)
+        params["handoff"] = {
+            "seed": int(seq.seed),
+            "generated": [int(t) for t in seq.generated],
+            "prompt_len": int(seq.prompt_len),
+        }
+        return params
+
+    async def drain(self, reason: str = "signal",
+                    deadline_secs: Optional[float] = None) -> dict:
+        """Run (or join) the departure ladder (engine/drain.py).
+        Idempotent: double SIGTERM / a control verb racing the signal
+        converge on one ladder run and one report. `deadline_secs`
+        overrides DYNT_DRAIN_DEADLINE_SECS for THIS worker's ladder —
+        a comesh main splits one eviction notice across its two
+        workers' drains instead of granting the budget twice (only
+        effective on the call that starts the ladder; joins keep the
+        original budget)."""
+        from .drain import DrainCoordinator
+
+        if self.scheduler is None:
+            return {"skipped": True, "reason": "no scheduler"}
+        if self._drain_coordinator is None:
+            self._drain_coordinator = DrainCoordinator(
+                self, deadline_secs=deadline_secs)
+        return await self._drain_coordinator.drain(reason)
+
+    async def _drain_endpoint(self, body: dict, ctx=None
+                              ) -> AsyncIterator[dict]:
+        """Request-plane drain control verb: run the ladder, stream the
+        report. body.shutdown=true also resolves the process's shutdown
+        event so main() proceeds to deregister after the drain."""
+        report = await self.drain(reason=(body or {}).get("reason",
+                                                          "control"))
+        try:
+            yield report
+        finally:
+            # In a finally: a caller that closes the stream as soon as
+            # the report frame lands (or a transport teardown racing the
+            # long drain) raises GeneratorExit at the yield — the drain
+            # already ran and the worker is terminally out of routing,
+            # so dropping the requested shutdown here would strand a
+            # vacated process waiting on an event nobody will set.
+            if (body or {}).get("shutdown"):
+                from ..runtime.signals import request_shutdown
+
+                request_shutdown("drain control verb")
+
     def _publish_spec_metrics(self) -> None:
         """Mirror the scheduler's speculative-decoding totals onto the
         dynamo_spec_* families (docs/metrics.md): counters advance by the
@@ -1124,21 +1265,7 @@ class TpuWorker:
                     except Exception:  # noqa: BLE001 — drain survives
                         log.exception("pin sweep failed")
             if self.scheduler is not None and self._drain_ticks % 10 == 0:
-                active, waiting = self.scheduler.queue_depth()
-                metrics = LoadMetrics(
-                    worker_id=self.instance_id,
-                    active_blocks=(self.scheduler.pool.num_pages - 1
-                                   - self.scheduler.pool.free_count()),
-                    total_blocks=self.scheduler.pool.num_pages,
-                    active_requests=active,
-                    waiting_requests=waiting,
-                    kv_usage=self.scheduler.pool.usage(),
-                    step_wall_ms=self.scheduler.stats.last_step_wall_ms,
-                    prefill_tokens_in_step=self.scheduler.stats.prefill_tokens_last_step,
-                    decode_tokens_in_step=self.scheduler.stats.decode_tokens_last_step,
-                    device_ms_in_step=self.scheduler.stats.device_ms_last_step,
-                    host_ms_in_step=self.scheduler.stats.host_ms_last_step,
-                )
+                metrics = self._load_metrics()
                 KV_USAGE.labels(worker=f"{self.instance_id:x}").set(
                     metrics.kv_usage)
                 if self.scheduler.spec_enabled:
@@ -1261,12 +1388,38 @@ class TpuWorker:
                     submit_kwargs.update(
                         on_prefill_chunk=self._stream_transfer_chunk)
             elif request.disaggregated_params:
+                handoff = (request.disaggregated_params or {}).get(
+                    "handoff")
                 blocks, first_token = await self._pull_remote_kv(
                     request.disaggregated_params,
                     deadline=ctx.deadline if ctx is not None else None,
                     traceparent=worker_span.traceparent or traceparent,
                     record_id=rec_id)
-                if blocks is not None and first_token is not None:
+                if handoff is not None:
+                    # Drain handoff destination (engine/drain.py): the
+                    # bundle covers prompt AND generated pages; resume
+                    # state continues the stream bit-identically. A
+                    # failed pull CANNOT fall through to plain submit —
+                    # that would re-emit the whole stream from scratch
+                    # on top of tokens the client already has. Bounce
+                    # with a plain migrate instead: the Migration
+                    # operator replays prompt+generated (the ladder's
+                    # replay rung).
+                    if blocks is not None:
+                        submit_kwargs.update(
+                            onboard_blocks=blocks,
+                            resume_state=handoff,
+                        )
+                    else:
+                        log.warning("drain handoff pull failed for %s; "
+                                    "bouncing to replay",
+                                    request.request_id)
+                        yield EngineOutput(
+                            finish_reason="migrate",
+                            error="drain handoff pull failed; replay",
+                        ).to_wire()
+                        return
+                elif blocks is not None and first_token is not None:
                     submit_kwargs.update(
                         onboard_blocks=blocks,
                         onboard_first_token=first_token,
@@ -1454,7 +1607,8 @@ class TpuWorker:
         # scale requests need a live scheduler loop to ever finish.
         for served in (self._served, self._clear_served, self._pull_served,
                        self._scale_served, self._kvq_served,
-                       self._weights_served, *self._lora_served):
+                       self._weights_served, self._drain_served,
+                       *self._lora_served):
             if served is not None:
                 await served.shutdown()
         if self.kvbm is not None:
@@ -1570,6 +1724,7 @@ def build_arg_parser():
 
 async def main(argv: Optional[list[str]] = None) -> None:
     from ..runtime import RuntimeConfig
+    from ..runtime.config import env
     from ..runtime.signals import wait_for_shutdown_signal
 
     args = build_arg_parser().parse_args(argv)
@@ -1740,12 +1895,44 @@ async def main(argv: Optional[list[str]] = None) -> None:
                                   kvbm_config=kvbm_config, **common)
         await prefill_worker.start()
         await decode_worker.start()
+        # POST /drain and SIGTERM both vacate BOTH workers through this
+        # one ladder, in order: decode first (live client streams hand
+        # off / replay), then prefill (its transfers are being pulled
+        # by decode peers) — and ONE DYNT_DRAIN_DEADLINE_SECS budget
+        # spans the pair: granting each worker the full deadline would
+        # take 2x worst-case and overrun the ~30s eviction notice the
+        # knob is sized to fit inside. Per-worker auto-registrations on
+        # the shared status server are last-wins; this composed drainer
+        # replaces them.
+        async def _drain_both(reason: str = "control") -> dict:
+            budget = float(env("DYNT_DRAIN_DEADLINE_SECS"))
+            t0 = time.monotonic()
+            report: dict = {}
+            for label, w in (("decode", decode_worker),
+                             ("prefill", prefill_worker)):
+                try:
+                    report[label] = await w.drain(
+                        reason, deadline_secs=max(
+                            1.0, budget - (time.monotonic() - t0)))
+                except Exception:  # noqa: BLE001 — one worker's failed
+                    # drain must not skip the other's (or teardown)
+                    log.exception("graceful drain failed (%s)", label)
+                    report[label] = {"error": "drain failed; see log"}
+            return report
+
+        if getattr(runtime, "status_server", None) is not None:
+            runtime.status_server.register_drain(_drain_both)
         health = HealthCheckManager(
             runtime, canary_wait_time=_env("DYNT_CANARY_WAIT_SECS"))
         health.start()
         try:
             await wait_for_shutdown_signal()
         finally:
+            # Departure ladder BEFORE teardown (docs/fault-tolerance.md):
+            # the same composed drainer POST /drain uses — decode then
+            # prefill under one shared deadline; it swallows per-worker
+            # failures so teardown always proceeds.
+            await _drain_both("shutdown-signal")
             await health.close()
             await decode_worker.close()
             await prefill_worker.close()
@@ -1833,6 +2020,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
     try:
         await wait_for_shutdown_signal()
     finally:
+        # Departure ladder BEFORE teardown: in-flight streams hand off
+        # their KV state to peers (or replay) instead of dying with the
+        # endpoints (docs/fault-tolerance.md).
+        try:
+            await worker.drain("shutdown-signal")
+        except Exception:  # noqa: BLE001 — teardown proceeds regardless
+            log.exception("graceful drain failed")
         await health.close()
         await worker.close()
         await runtime.shutdown()

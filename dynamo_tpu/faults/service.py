@@ -15,6 +15,8 @@ API surface:
   GET  /v1/targets
   POST /v1/faults             {type, target|path|..., params}
         kill          — SIGKILL the target process
+        sigterm       — SIGTERM (graceful-shutdown request; the target
+                        runs its departure ladder, engine/drain.py)
         pause         — SIGSTOP (black-hole: the process holds its
                         sockets but answers nothing — a network
                         partition as seen by peers)
@@ -28,7 +30,9 @@ API surface:
   POST /v1/faults/{id}/heal   undo (resume a pause, stop a delay proxy)
   POST /v1/scenarios/run      {name, target, params} — multi-step
         server-side scenarios: partition_blip (pause → hold_ms →
-        resume), kill_respawn (kill → down_ms → respawn)
+        resume), kill_respawn (kill → down_ms → respawn), evict
+        (sigterm → deadline_ms hold → SIGKILL unless the target
+        exited — GCE spot preemption as the drain plane sees it)
   GET  /healthz
 
 Processes are addressed by REGISTERED name->pid, never by pattern
@@ -49,6 +53,24 @@ from typing import Optional
 from ..runtime.logging import get_logger
 
 log = get_logger("faults.service")
+
+
+def _pid_running(pid: int) -> bool:
+    """Liveness that sees through zombies: a target spawned by the SAME
+    process (chaos tests register their own children) stays a zombie
+    until reaped, and `os.kill(pid, 0)` succeeds on zombies — which
+    would make the `evict` scenario SIGKILL a process that already
+    drained and exited inside its notice."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            # field 3 (after the parenthesized comm) is the state char
+            return fh.read().rsplit(b")", 1)[-1].split()[0] != b"Z"
+    except OSError:
+        return True  # no /proc: the signal check is the best we have
 
 
 @dataclasses.dataclass
@@ -268,6 +290,16 @@ class FaultInjectionService:
             f = self._new_fault("kill", {"target": t.name, "pid": t.pid})
             f.state = "done"
             return f
+        if ftype == "sigterm":
+            # The graceful half of an eviction notice: the target's
+            # signal handler runs its departure ladder (engine/drain.py)
+            # while the `evict` scenario's SIGKILL clock ticks.
+            t = self.targets[body["target"]]
+            os.kill(t.pid, signal.SIGTERM)
+            f = self._new_fault("sigterm", {"target": t.name,
+                                            "pid": t.pid})
+            f.state = "done"
+            return f
         if ftype == "pause":
             t = self.targets[body["target"]]
             os.kill(t.pid, signal.SIGSTOP)
@@ -385,10 +417,44 @@ class FaultInjectionService:
                 await asyncio.sleep(down)
                 steps.append((await self._inject(
                     "respawn", body)).to_wire())
+            elif name == "evict":
+                # GCE spot/preemptible preemption model: the eviction
+                # notice is a SIGTERM, and the VM disappears deadline_ms
+                # later REGARDLESS of what the process is doing — the
+                # SIGKILL lands only if the graceful drain didn't finish
+                # and exit first. Timed server-side like partition_blip
+                # so drain tests drive the same notice production sees.
+                deadline = float(body.get("deadline_ms", 30000.0)) / 1e3
+                t = self.targets[body["target"]]
+                steps.append((await self._inject(
+                    "sigterm", body)).to_wire())
+                waited = 0.0
+                while waited < deadline:
+                    tick = min(0.05, deadline - waited)
+                    await asyncio.sleep(tick)
+                    waited += tick
+                    if not _pid_running(t.pid):
+                        break  # drained and exited inside the notice
+                else:
+                    try:
+                        steps.append((await self._inject(
+                            "kill", body)).to_wire())
+                    except ProcessLookupError:
+                        # Exited in the window between the last liveness
+                        # poll and the SIGKILL: that IS a graceful exit,
+                        # not a scenario failure.
+                        pass
+                f = self._new_fault("evict", {
+                    "target": t.name, "pid": t.pid,
+                    "deadline_ms": deadline * 1e3,
+                    "graceful": len(steps) == 1,
+                })
+                f.state = "done"
+                steps.append(f.to_wire())
             else:
                 return web.json_response(
-                    {"error": f"unknown scenario {name!r} "
-                     "(known: partition_blip, kill_respawn)"}, status=400)
+                    {"error": f"unknown scenario {name!r} (known: "
+                     "partition_blip, kill_respawn, evict)"}, status=400)
         except KeyError as exc:
             return web.json_response({"error": f"unknown target {exc}"},
                                      status=404)

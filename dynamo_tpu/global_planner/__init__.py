@@ -50,6 +50,13 @@ class PoolState:
         default_factory=dict)
 
     def record(self, metrics: LoadMetrics) -> None:
+        if metrics.draining:
+            # Graceful departure (engine/drain.py): a draining worker is
+            # departing capacity — its queue is migrating to peers, so
+            # counting it as pressure would read a planned scale-down
+            # (or spot eviction) as demand for MORE replicas.
+            self.workers.pop(metrics.worker_id, None)
+            return
         self.workers[metrics.worker_id] = (metrics, time.monotonic())
 
     def pressure(self) -> float:

@@ -49,8 +49,18 @@ class StandaloneIndexer:
     # -- event ingestion ---------------------------------------------------
 
     async def _event_loop(self, sub) -> None:
-        async for _topic, payload in sub:
+        from ..runtime.events import JOURNAL_RESYNC_TOPIC
+
+        async for topic, payload in sub:
             try:
+                if topic.startswith(JOURNAL_RESYNC_TOPIC):
+                    # The durable journal skipped corrupt frames: lost
+                    # events carry no per-worker gap to flag them, so
+                    # re-dump every known worker instead of silently
+                    # diverging (skip-dedup lives in _schedule_resync).
+                    for worker_id in list(self._worker_subjects):
+                        self._schedule_resync(worker_id)
+                    continue
                 event = RouterEvent.from_wire(payload)
                 buffer = self._resyncing.get(event.worker_id)
                 if buffer is not None:

@@ -124,6 +124,11 @@ class LoadMetrics:
     # (more chips won't move it) from a device-bound one before scaling
     device_ms_in_step: float = 0.0
     host_ms_in_step: float = 0.0
+    # Graceful drain plane (docs/fault-tolerance.md departure ladder):
+    # a draining worker is vacating — routers stop selecting it and
+    # decay its radix state, planners count it as departing capacity
+    # (its backlog is migrating out, not a scale-up signal).
+    draining: bool = False
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)

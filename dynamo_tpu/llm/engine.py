@@ -14,6 +14,7 @@ lives in preprocessor.py; transport in runtime.push_router.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 from typing import AsyncIterator, Optional
 
 from ..kv_router import KvScheduler, WorkerWithDpRank
@@ -84,6 +85,23 @@ def _pinned_instance(request: PreprocessedRequest) -> Optional[int]:
     except ValueError:
         log.warning("bad target_instance annotation %r; ignoring", raw)
         return None
+
+
+def _unpin(request: PreprocessedRequest) -> PreprocessedRequest:
+    """Drop a gateway pin (`target_instance` annotation) from a
+    migration re-dispatch. The pinned worker just failed or announced
+    departure, and every routed mode vetoes unavailable explicit
+    targets (PushRouter._pick) — keeping the pin would burn the whole
+    migration budget re-dialing a worker that will never come back and
+    surface a spurious client error. The EPP's placement decision is
+    invalidated by the departure; the replay leg re-selects."""
+    ann = request.annotations
+    if not ann or "target_instance" not in ann:
+        return request
+    return dataclasses.replace(
+        request,
+        annotations={k: v for k, v in ann.items()
+                     if k != "target_instance"})
 
 
 def _priority_of(request: PreprocessedRequest) -> float:
@@ -267,10 +285,25 @@ class MultimodalEngine(TokenEngine):
 class CooperativeMigration(ConnectionLost):
     """In-band `finish_reason="migrate"` from a worker: a PLANNED
     hand-off (elastic reshard, QoS preemption without a local park
-    slot), not a failure. Bounded separately from failure migrations
-    (DYNT_PREEMPT_MIGRATION_LIMIT vs migration_limit) and replayed
-    without backoff jitter — the worker asked us to move, nothing is
-    broken, and sleeping would only stretch the client's stall."""
+    slot, graceful drain), not a failure. Bounded separately from
+    failure migrations (DYNT_PREEMPT_MIGRATION_LIMIT vs
+    migration_limit) and replayed without backoff jitter — the worker
+    asked us to move, nothing is broken, and sleeping would only
+    stretch the client's stall.
+
+    A graceful-drain handoff frame (engine/drain.py) additionally
+    carries `kv_transfer_params` with the pull route + resume state:
+    the replay dispatches with those as `disaggregated_params`, so the
+    destination PULLS the source's computed KV and resumes the stream
+    bit-identically instead of re-prefilling prompt+generated. Clean
+    handoff hops do NOT consume the cooperative bound — a rolling
+    restart of N workers legitimately hops a long stream N times, and
+    a failed hop degrades to a plain migrate which does consume it."""
+
+    def __init__(self, reason: str,
+                 kv_transfer_params: Optional[dict] = None) -> None:
+        super().__init__(reason)
+        self.kv_transfer_params = kv_transfer_params
 
 
 class Migration(TokenEngine):
@@ -302,6 +335,7 @@ class Migration(TokenEngine):
         generated: list[int] = []
         attempts = 0
         coop_attempts = 0
+        handoff_hops = 0
         prev_delay: Optional[float] = None
         current = request
         while True:
@@ -309,12 +343,14 @@ class Migration(TokenEngine):
                 async for output in self.inner.generate(current):
                     if output.finish_reason == "migrate":
                         # In-band migration request from the worker (e.g.
-                        # elastic reshard or QoS preemption evicted the
-                        # sequence): retry like a broken stream, tokens
+                        # elastic reshard, QoS preemption, graceful
+                        # drain): retry like a broken stream, tokens
                         # preserved, but on the COOPERATIVE bound. Never
-                        # reaches the client.
+                        # reaches the client. A drain handoff frame also
+                        # carries the KV pull route + resume state.
                         raise CooperativeMigration(
-                            output.error or "worker requested migration")
+                            output.error or "worker requested migration",
+                            kv_transfer_params=output.kv_transfer_params)
                     if current.prior_output_tokens \
                             and output.prompt_tokens is not None:
                         # The replayed prompt embeds the tokens already
@@ -329,11 +365,34 @@ class Migration(TokenEngine):
                 return
             except (ConnectionLost, NoInstancesAvailable, asyncio.TimeoutError) as exc:
                 cooperative = isinstance(exc, CooperativeMigration)
-                if cooperative:
+                handoff = (exc.kv_transfer_params
+                           if cooperative
+                           and exc.kv_transfer_params is not None
+                           and exc.kv_transfer_params.get("handoff")
+                           is not None else None)
+                if handoff is not None:
+                    # A clean drain handoff does NOT consume the
+                    # cooperative replay budget: each hop is driven by
+                    # an actual worker departure (a rolling restart of
+                    # N workers legitimately hops a long stream N
+                    # times), and a failed hop comes back as a PLAIN
+                    # migrate, which DOES consume it — so ping-pong is
+                    # already bounded. The hard cap below only guards a
+                    # pathological livelock.
+                    handoff_hops += 1
+                    if handoff_hops > 64:
+                        log.warning("handoff hop cap reached for %s: %r",
+                                    request.request_id, exc)
+                        yield EngineOutput(
+                            finish_reason="error",
+                            error=f"migration limit exceeded: {exc}")
+                        return
+                elif cooperative:
                     coop_attempts += 1
                 else:
                     attempts += 1
-                if (coop_attempts > self.cooperative_limit
+                if handoff is None and (
+                        coop_attempts > self.cooperative_limit
                         if cooperative else
                         attempts > self.migration_limit):
                     log.warning("%smigration limit reached for %s: %r",
@@ -353,6 +412,36 @@ class Migration(TokenEngine):
                         finish_reason="error",
                         error=f"deadline exceeded during migration: {exc}")
                     return
+                if handoff is not None:
+                    # Graceful-drain KV handoff (engine/drain.py;
+                    # docs/fault-tolerance.md departure ladder rung 1):
+                    # re-dispatch the SAME request (same prompt, same
+                    # sampling — the resume state rides in the params)
+                    # with the pull route as disaggregated_params. The
+                    # destination pulls the source's computed pages and
+                    # continues with the original sampler keys — zero
+                    # re-prefilled tokens, bit-identical stream. A
+                    # failed pull comes back as a PLAIN migrate, which
+                    # lands on the replay rung below next iteration.
+                    get_tracer().start_span(
+                        "migration.handoff",
+                        parent=_traceparent_of(request),
+                        **{"request.id": request.request_id,
+                           "attempt": handoff_hops,
+                           "tokens.preserved": len(generated)}
+                    ).end(ok=True)
+                    get_recorder().event(
+                        request.request_id, "migration",
+                        attempt=handoff_hops, cooperative=True,
+                        handoff=True, tokens_preserved=len(generated))
+                    log.info("drain handoff for %s (hop %d, %d "
+                             "tokens preserved, no re-prefill)",
+                             request.request_id, handoff_hops,
+                             len(generated))
+                    current = _unpin(dataclasses.replace(
+                        current, disaggregated_params=exc.kv_transfer_params))
+                    await asyncio.sleep(0)  # planned move: no backoff
+                    continue
                 remaining = request.sampling.max_tokens - len(generated)
                 if remaining <= 0:
                     yield EngineOutput(finish_reason="length")
@@ -380,28 +469,20 @@ class Migration(TokenEngine):
                 sampling = type(request.sampling)(**{
                     **request.sampling.to_wire(), "max_tokens": remaining
                 })
-                current = PreprocessedRequest(
-                    request_id=request.request_id,
+                # dataclasses.replace keeps EVERY other field — guided
+                # processors, session pins, deadline, priority/tenant
+                # (a replayed batch request must not sneak back in as
+                # "standard") — while the replayed prompt embeds the
+                # tokens already generated. A stale drain-handoff pull
+                # route must NOT survive onto the replay leg: this rung
+                # re-prefills instead.
+                current = _unpin(dataclasses.replace(
+                    request,
                     token_ids=list(request.token_ids) + generated,
                     sampling=sampling,
-                    stop=request.stop,
-                    eos_token_ids=request.eos_token_ids,
-                    model=request.model,
                     prior_output_tokens=list(generated),
-                    annotations=request.annotations,
-                    lora_name=request.lora_name,
-                    media_hashes=request.media_hashes,
-                    media_embeddings=request.media_embeddings,
-                    # Guided decoding / custom processors must survive the
-                    # replay or the continuation decodes unconstrained.
-                    logits_processors=request.logits_processors,
-                    deadline=request.deadline,
-                    # Session pins + affinity survive the replay: the new
-                    # worker re-pins the anchored prefix into ITS tiers.
-                    cache_anchors=request.cache_anchors,
-                    cache_ttl=request.cache_ttl,
-                    session_id=request.session_id,
-                )
+                    disaggregated_params=None,
+                ))
                 if cooperative:
                     # Planned hand-off: replay immediately (yield once so
                     # the loop stays fair). Backoff exists to spread

@@ -196,6 +196,19 @@ class PendingTransferTable:
             transfer.release()
         return len(claimed)
 
+    def expire_all(self) -> int:
+        """Force-expire every unclaimed entry (graceful-drain deadline:
+        parked handoff pages a peer never pulled must release before
+        the worker deregisters). Claims atomically like expire_stale,
+        so a pull racing this can never double-release. Live streaming
+        transfers degrade through their release hook's cancel path."""
+        with self._lock:
+            claimed = list(self._table.values())
+            self._table.clear()
+        for transfer in claimed:
+            transfer.release()
+        return len(claimed)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._table)

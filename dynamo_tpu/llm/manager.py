@@ -26,6 +26,7 @@ from ..kv_router import (
 from ..runtime.admission import QueueWaitEstimator
 from ..runtime.config import env
 from ..runtime.discovery import MODEL_CARD_PREFIX
+from ..runtime.events import JOURNAL_RESYNC_TOPIC
 from ..session import SESSION_PIN_TOPIC
 from ..runtime.logging import get_logger
 from ..runtime.push_router import PushRouter
@@ -75,6 +76,12 @@ class ModelEntry:
     # Session/prompt-cache tier (dynamo_tpu/session): pin leases +
     # session affinity for this model. None when DYNT_SESSION_ENABLE=0.
     session: Optional[object] = None
+    # Graceful drain plane (docs/fault-tolerance.md): instances that
+    # flipped to draining (LoadMetrics.draining or the card flag). Their
+    # radix state is decayed once and further KV events from them are
+    # skipped — a vacating worker's prefixes must not keep attracting
+    # overlap routing while it hands its sequences off.
+    draining: set = dataclasses.field(default_factory=set)
 
     def __post_init__(self) -> None:
         self.wait_estimator.pool = f"decode:{self.card.name}"
@@ -265,6 +272,13 @@ class ModelWatcher:
         # the last publisher would clobber the others' state.
         entry.instance_loras[instance_id] = list(
             card.runtime_config.get("loras", []))
+        if card.runtime_config.get("draining"):
+            # The worker announced its departure on the discovery plane
+            # (engine/drain.py announce): stop selecting it, decay its
+            # radix state, and skip the bootstrap resync — dumping a
+            # vacating worker's index would re-attract traffic to it.
+            self._mark_draining(entry, instance_id)
+            return
         if (newly_seen and entry.scheduler is not None
                 and card.runtime_config.get("kv_blocks_endpoint")):
             # Bootstrap this worker's radix state from its local indexer
@@ -301,6 +315,15 @@ class ModelWatcher:
             subjects[subject] = card.name
             log.info("%s pool up for %s (%s)", label, card.name, subject)
         pool.instances.add(instance_id)
+        if card.runtime_config.get("draining"):
+            # Departure announce on the discovery plane (engine/drain.py):
+            # a vacating pool worker must stop attracting new legs.
+            if pool.router.set_draining(instance_id, True):
+                estimator = getattr(pool, "wait_estimator", None)
+                if estimator is not None:
+                    estimator.update_worker(instance_id, 0)
+                log.info("%s pool worker %x draining for %s", label,
+                         instance_id, card.name)
 
     async def _handle_prefill_put(self, card, subject, instance_id) -> None:
         await self._pool_put(card, subject, instance_id,
@@ -342,6 +365,10 @@ class ModelWatcher:
             if entry.card.endpoint_subject == subject:
                 entry.instances.discard(instance_id)
                 entry.instance_loras.pop(instance_id, None)
+                # Deregistration completes a drain: clear the mark so a
+                # RESTARTED worker at the same id starts clean (the
+                # router's own _draining set clears on the same delete).
+                entry.draining.discard(instance_id)
                 if entry.scheduler is not None:
                     entry.scheduler.remove_worker_id(instance_id)
                 # Session residency is invalidated LAZILY: a departed
@@ -361,10 +388,35 @@ class ModelWatcher:
                         entries.remove(entry)
                     await entry.router.client.close()
 
+    def _mark_draining(self, entry: ModelEntry, instance_id: int) -> None:
+        """One-shot draining transition for a decode instance: exclude
+        it from routing (PushRouter.available), decay its radix state so
+        overlap scoring stops preferring it, zero its admission-depth
+        contribution, and skip its future KV events. Runs from both the
+        LoadMetrics path and the card-flag path; set_draining dedups."""
+        if not entry.router.set_draining(instance_id, True):
+            return
+        entry.draining.add(instance_id)
+        if entry.scheduler is not None:
+            entry.scheduler.remove_worker_id(instance_id)
+        # Its backlog is migrating out, not queue depth new arrivals
+        # wait behind.
+        entry.wait_estimator.update_worker(instance_id, 0)
+        log.info("worker %x draining: removed from selection for %s",
+                 instance_id, entry.card.name)
+
     # -- worker state resync (bootstrap + gap recovery) --------------------
 
     def _schedule_resync(self, entry: ModelEntry, instance_id: int,
                          reason: str) -> None:
+        if instance_id in entry.draining:
+            # Vacating worker (docs/fault-tolerance.md departure ladder):
+            # _mark_draining decayed its radix state on purpose —
+            # re-dumping its index would re-attract overlap routing to a
+            # worker that is handing its sequences off, and its
+            # endpoints are shutting down anyway. Central guard: covers
+            # the gap, journal-corrupt, and bootstrap paths.
+            return
         key = (entry.card.endpoint_subject, instance_id)
         if key in self._resyncing:
             return
@@ -552,6 +604,12 @@ class ModelWatcher:
                     for entry in entries:
                         if entry.scheduler is None:
                             continue
+                        if event.worker_id in entry.draining:
+                            # The worker is vacating: applying its late
+                            # KV events would re-create the radix state
+                            # _mark_draining just decayed (and a gap
+                            # verdict would resync it right back in).
+                            continue
                         key = (entry.card.endpoint_subject, event.worker_id)
                         buffer = self._resyncing.get(key)
                         if buffer is not None:
@@ -576,6 +634,8 @@ class ModelWatcher:
                     for entry in entries:
                         if entry.scheduler is None:
                             continue
+                        if payload["worker_id"] in entry.draining:
+                            continue  # vacating: stay decayed
                         key = (entry.card.endpoint_subject,
                                payload["worker_id"])
                         if key in self._resyncing:
@@ -584,6 +644,22 @@ class ModelWatcher:
                             worker,
                             [(p, h) for p, h in payload.get("blocks", [])],
                             payload.get("last_event_id"))
+                elif topic.startswith(JOURNAL_RESYNC_TOPIC):
+                    # The durable journal skipped corrupt frames: KV
+                    # events were lost with no per-worker gap to flag
+                    # them, so re-dump EVERY routed worker's state from
+                    # its local indexer (the dump_worker/load_worker
+                    # round-trip) instead of silently diverging from
+                    # peer replicas. _schedule_resync dedups in-flight
+                    # keys, so a burst of skips costs one RPC per worker.
+                    for entry in entries:
+                        if entry.scheduler is None or not \
+                                entry.card.runtime_config.get(
+                                    "kv_blocks_endpoint"):
+                            continue
+                        for iid in list(entry.instances):
+                            self._schedule_resync(entry, iid,
+                                                  reason="journal-corrupt")
                 elif topic.startswith(SESSION_PIN_TOPIC):
                     # Peer router replica's pin/route/touch: apply so
                     # both replicas converge on the same pin set +
@@ -595,6 +671,17 @@ class ModelWatcher:
                     metrics = LoadMetrics.from_wire(payload)
                     for entry in entries:
                         entry.worker_usage[metrics.worker_id] = metrics.kv_usage
+                        if metrics.draining \
+                                and metrics.worker_id in entry.instances:
+                            # Departure announce via the load plane
+                            # (engine/drain.py): faster than waiting for
+                            # the card republish to land. Skip the usual
+                            # bookkeeping below — update_published would
+                            # re-add the worker remove_worker_id just
+                            # dropped, and its backlog is migrating out,
+                            # not queue depth new arrivals wait behind.
+                            self._mark_draining(entry, metrics.worker_id)
+                            continue
                         if entry.scheduler is not None:
                             entry.scheduler.sequences.update_published(metrics)
                         if metrics.worker_id in entry.instances:
@@ -605,6 +692,17 @@ class ModelWatcher:
                                 metrics.worker_id, metrics.waiting_requests)
                     for pool in self._prefill_pools.values():
                         if metrics.worker_id in pool.instances:
+                            if metrics.draining:
+                                # Draining prefill worker: stop selecting
+                                # it for new legs; in-flight transfers
+                                # its decode peers are pulling finish on
+                                # their own (the drain deadline bounds
+                                # them).
+                                if pool.router.set_draining(
+                                        metrics.worker_id, True):
+                                    pool.wait_estimator.update_worker(
+                                        metrics.worker_id, 0)
+                                continue
                             pool.wait_estimator.update_worker(
                                 metrics.worker_id, metrics.waiting_requests)
             except Exception:  # noqa: BLE001

@@ -191,7 +191,14 @@ class PrefillRouterEngine(TokenEngine):
         self, request: PreprocessedRequest
     ) -> AsyncIterator[EngineOutput]:
         pool = self.pool_lookup()
-        if request.annotations.get("embed"):
+        if (request.disaggregated_params or {}).get("handoff") is not None:
+            # Graceful-drain KV handoff replay (engine/drain.py): the
+            # request already carries its pull route + resume state —
+            # the destination pulls the SOURCE's computed pages and
+            # continues the stream. A prefill leg here would recompute
+            # KV the handoff exists to preserve (and clobber the params).
+            pool = None
+        elif request.annotations.get("embed"):
             # Embeddings have no KV to hand off — a prefill leg would just
             # compute the same trunk twice.
             pool = None

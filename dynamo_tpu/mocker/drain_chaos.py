@@ -1,0 +1,339 @@
+"""Chaos-drain scenario: prove zero-drop worker departures chip-free.
+
+A mocker fleet behind the real frontend serves N live decode streams;
+one worker is evicted mid-decode (the in-process analog of the faults
+service's `evict` scenario — SIGTERM, drain, SIGKILL-at-deadline). The
+departure ladder (engine/drain.py; docs/fault-tolerance.md) must make
+the eviction invisible to clients:
+
+  * zero client-visible errors — every stream finishes with a normal
+    finish_reason, despite its worker departing mid-generation;
+  * every stream is BIT-IDENTICAL to an undrained baseline run — the
+    handoff carries the committed history, the destination continues
+    with the same token function (the mocker analog of the real
+    engine's (seed, step) sampler keys);
+  * re-prefill tokens on the KV-handoff path are ZERO — the fleet's
+    prefill ledger does not move after the eviction (replay is
+    permitted only in the forced-fallback pass, DYNT_DRAIN_HANDOFF=0);
+  * the drain completes inside DYNT_DRAIN_DEADLINE_SECS and the
+    drained worker disappears from router selection.
+
+One process, mem discovery/event planes, TCP request plane — the same
+harness pattern as mocker/overload.py. Used by scripts/chaos_drain.py
+(the chaos-drain CI job), tests/test_chaos.py, and bench.py's drain
+block.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from ..runtime import DistributedRuntime, RuntimeConfig
+from ..runtime.logging import get_logger
+from .engine import MockerConfig
+from .worker import MockerWorker
+
+log = get_logger("mocker.drain_chaos")
+
+MODEL = "drain-model"
+
+
+@dataclasses.dataclass
+class DrainChaosParams:
+    """Scenario shape. Defaults run in ~15s wall: 12 streams across 3
+    workers, ~25ms decode steps so every stream is live for >1s, evict
+    once every stream has committed a handful of tokens."""
+
+    n_workers: int = 3
+    n_streams: int = 12
+    isl: int = 96
+    max_tokens: int = 48
+    # evict once EVERY stream has this many client-delivered tokens
+    # (=> fully prefilled and mid-decode: the handoff-eligible shape)
+    tokens_before_evict: int = 6
+    deadline_secs: float = 10.0
+    settle_secs: float = 0.3
+    decode_base_ms: float = 25.0
+
+    def mocker_config(self) -> MockerConfig:
+        return MockerConfig(
+            block_size=16, num_blocks=512, max_batch=16,
+            decode_base_ms=self.decode_base_ms,
+            prefill_us_per_token=150.0,
+        )
+
+
+def _runtime_cfg(cluster: str) -> RuntimeConfig:
+    cfg = RuntimeConfig.from_env()
+    cfg.discovery_backend = "mem"
+    cfg.discovery_path = cluster
+    cfg.request_plane = "tcp"
+    cfg.tcp_host = "127.0.0.1"
+    cfg.event_plane = "mem"
+    cfg.system_enabled = False
+    cfg.lease_ttl_secs = 2.0
+    return cfg
+
+
+class _DrainStack:
+    """N aggregated mocker workers behind a real KV-routed Frontend —
+    the full engine stack (Migration included) the departure ladder's
+    handoff frames travel through."""
+
+    def __init__(self, params: DrainChaosParams) -> None:
+        self.params = params
+        self.workers: list[tuple[DistributedRuntime, MockerWorker]] = []
+        self.frontend = None
+        self._frt: Optional[DistributedRuntime] = None
+
+    async def start(self) -> "_DrainStack":
+        from ..frontend import Frontend
+
+        cluster = uuid.uuid4().hex
+        for _ in range(self.params.n_workers):
+            rt = await DistributedRuntime(_runtime_cfg(cluster)).start()
+            worker = MockerWorker(rt, model_name=MODEL,
+                                  config=self.params.mocker_config(),
+                                  load_publish_interval=0.1)
+            await worker.start()
+            self.workers.append((rt, worker))
+        self._frt = await DistributedRuntime(_runtime_cfg(cluster)).start()
+        self.frontend = Frontend(self._frt, host="127.0.0.1", port=0,
+                                 router_mode="kv")
+        await self.frontend.start()
+        for _ in range(200):
+            entry = self.frontend.manager.get(MODEL)
+            if entry is not None \
+                    and len(entry.instances) >= self.params.n_workers:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("drain stack never registered its model")
+        return self
+
+    @property
+    def base(self) -> str:
+        return f"http://127.0.0.1:{self.frontend.port}"
+
+    def prefill_tokens_total(self) -> int:
+        return sum(w.engine.prefill_tokens_total for _, w in self.workers)
+
+    async def close(self) -> None:
+        if self.frontend is not None:
+            await self.frontend.close()
+        if self._frt is not None:
+            await self._frt.shutdown()
+        for rt, worker in self.workers:
+            await worker.close()
+            await rt.shutdown()
+
+
+def _prompt(i: int, isl: int) -> str:
+    # Deterministic per stream index and IDENTICAL across passes (each
+    # pass runs a fresh cluster, so there is no cross-pass cache), but
+    # unique across streams so routing spreads them.
+    return f"drain-stream-{i:03d}-" + "x" * max(0, isl - 20)
+
+
+async def _stream_chat(session, base: str, i: int,
+                       params: DrainChaosParams, out: dict) -> None:
+    """One streamed chat request; accumulates delivered text so the
+    bit-identity assertion can compare byte-for-byte across passes."""
+    rec = {"i": i, "text": "", "tokens": 0, "finish": None,
+           "status": 0, "error": None}
+    out[i] = rec
+    try:
+        async with session.post(
+                base + "/v1/chat/completions",
+                json={"model": MODEL, "stream": True,
+                      "max_tokens": params.max_tokens,
+                      "messages": [{"role": "user",
+                                    "content": _prompt(i, params.isl)}]},
+        ) as resp:
+            rec["status"] = resp.status
+            if resp.status != 200:
+                rec["error"] = f"http {resp.status}"
+                return
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                if chunk.get("error"):
+                    rec["error"] = json.dumps(chunk["error"])[:200]
+                    return
+                choices = chunk.get("choices") or []
+                if not choices:
+                    continue
+                delta = choices[0].get("delta", {}).get("content")
+                if delta:
+                    rec["text"] += delta
+                    rec["tokens"] += 1
+                if choices[0].get("finish_reason") is not None:
+                    rec["finish"] = choices[0]["finish_reason"]
+    except Exception as exc:  # noqa: BLE001 — a failed stream is a stat
+        rec["error"] = repr(exc)
+
+
+async def run_drain_pass(params: DrainChaosParams, evict: bool,
+                         handoff: bool = True) -> dict:
+    """One pass: start N streams, optionally evict worker 0 once every
+    stream is mid-decode, collect everything. Returns per-stream
+    outcomes + the drain report + the prefill-ledger delta."""
+    import aiohttp
+
+    os.environ["DYNT_DRAIN_ENABLE"] = "1"
+    os.environ["DYNT_DRAIN_HANDOFF"] = "1" if handoff else "0"
+    os.environ["DYNT_DRAIN_DEADLINE_SECS"] = str(params.deadline_secs)
+    os.environ["DYNT_DRAIN_ANNOUNCE_SETTLE_SECS"] = str(params.settle_secs)
+    stack = await _DrainStack(params).start()
+    results: dict = {}
+    drain_report = None
+    prefill_at_evict = None
+    prefill_after = None
+    victim_available_after = None
+    try:
+        async with aiohttp.ClientSession() as session:
+            tasks = [asyncio.create_task(
+                _stream_chat(session, stack.base, i, params, results))
+                for i in range(params.n_streams)]
+            if evict:
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    live = [r for r in results.values()
+                            if r["tokens"] >= params.tokens_before_evict
+                            or r["finish"] is not None or r["error"]]
+                    if len(live) == params.n_streams:
+                        break
+                    await asyncio.sleep(0.02)
+                else:
+                    raise RuntimeError(
+                        "streams never reached mid-decode: "
+                        f"{[r['tokens'] for r in results.values()]}")
+                victim = stack.workers[0][1]
+                victim_streams = len(victim.engine._running)
+                prefill_at_evict = stack.prefill_tokens_total()
+                drain_report = await victim.drain("chaos-evict")
+                drain_report["victim_streams"] = victim_streams
+            await asyncio.gather(*tasks)
+            prefill_after = stack.prefill_tokens_total()
+            if evict:
+                entry = stack.frontend.manager.get(MODEL)
+                victim_available_after = (
+                    stack.workers[0][1].instance_id
+                    in entry.router.available())
+    finally:
+        await stack.close()
+    streams = [results[i] for i in sorted(results)]
+    return {
+        "evicted": evict,
+        "handoff_enabled": handoff,
+        "streams": streams,
+        "errors": [r for r in streams
+                   if r["error"] or r["finish"] not in ("length", "stop")],
+        "drain_report": drain_report,
+        "prefill_at_evict": prefill_at_evict,
+        "prefill_after": prefill_after,
+        "reprefill_tokens": (None if prefill_at_evict is None
+                             else prefill_after - prefill_at_evict),
+        "victim_available_after": victim_available_after,
+    }
+
+
+def evaluate(report: dict) -> list[dict]:
+    """The departure-ladder contract, asserted from the report alone
+    (the CI job gates on these)."""
+    checks: list[dict] = []
+
+    def check(name: str, ok: bool, detail) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    base = report["baseline"]["streams"]
+    drained = report["drain_handoff"]
+    fallback = report.get("drain_replay")
+
+    check("baseline_clean", not report["baseline"]["errors"],
+          {"errors": report["baseline"]["errors"][:3]})
+    check("zero_client_errors", not drained["errors"],
+          {"errors": drained["errors"][:3]})
+    mismatches = [
+        {"i": b["i"], "baseline": b["text"][:60], "drained": d["text"][:60]}
+        for b, d in zip(base, drained["streams"])
+        if b["text"] != d["text"]]
+    check("bit_identical_to_undrained_run", not mismatches,
+          {"mismatches": mismatches[:3]})
+    rep = drained["drain_report"] or {}
+    check("handoff_path_used",
+          len(rep.get("handoff") or []) >= 1
+          and rep.get("victim_streams", 0) >= 1,
+          {"handoff": len(rep.get("handoff") or []),
+           "victim_streams": rep.get("victim_streams")})
+    check("no_replay_on_handoff_path",
+          not rep.get("replay") and not rep.get("errored"),
+          {"replay": rep.get("replay"), "errored": rep.get("errored")})
+    check("zero_reprefill_tokens_on_handoff_path",
+          drained["reprefill_tokens"] == 0,
+          {"reprefill_tokens": drained["reprefill_tokens"]})
+    check("drain_inside_deadline",
+          rep.get("completed") is True
+          and rep.get("duration_ms", 1e18)
+          <= report["params"]["deadline_secs"] * 1e3,
+          {"duration_ms": rep.get("duration_ms"),
+           "completed": rep.get("completed")})
+    check("drained_worker_invisible_to_router",
+          drained["victim_available_after"] is False,
+          {"victim_available_after": drained["victim_available_after"]})
+    if fallback is not None:
+        frep = fallback["drain_report"] or {}
+        check("forced_fallback_replays_without_client_errors",
+              not fallback["errors"] and not frep.get("handoff")
+              and len(frep.get("replay") or []) >= 1,
+              {"errors": fallback["errors"][:3],
+               "handoff": frep.get("handoff"),
+               "replay": len(frep.get("replay") or [])})
+        fb_mismatch = [b["i"] for b, d in zip(base, fallback["streams"])
+                       if b["text"] != d["text"]]
+        check("forced_fallback_bit_identical", not fb_mismatch,
+              {"mismatches": fb_mismatch[:3]})
+    return checks
+
+
+async def run_scenario(params: Optional[DrainChaosParams] = None,
+                       fallback_pass: bool = True) -> dict:
+    """Full scenario: undrained baseline, handoff-path eviction, and
+    (optionally) the forced replay-fallback eviction. `passed` is the
+    conjunction of the assertions."""
+    params = params or DrainChaosParams()
+    report: dict = {
+        "scenario": "chaos_drain",
+        "params": dataclasses.asdict(params),
+    }
+    knobs = ("DYNT_DRAIN_ENABLE", "DYNT_DRAIN_HANDOFF",
+             "DYNT_DRAIN_DEADLINE_SECS",
+             "DYNT_DRAIN_ANNOUNCE_SETTLE_SECS")
+    prev = {key: os.environ.get(key) for key in knobs}
+    try:
+        report["baseline"] = await run_drain_pass(params, evict=False)
+        report["drain_handoff"] = await run_drain_pass(params, evict=True,
+                                                       handoff=True)
+        if fallback_pass:
+            report["drain_replay"] = await run_drain_pass(
+                params, evict=True, handoff=False)
+    finally:
+        for key in knobs:
+            if prev[key] is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev[key]
+    report["assertions"] = evaluate(report)
+    report["passed"] = all(c["ok"] for c in report["assertions"])
+    return report

@@ -252,6 +252,12 @@ class _Sequence:
     new_blocks: int  # allocated for the remainder
     prefilled_tokens: int = 0
     generated: int = 0
+    # Tokens actually DELIVERED to the consumer: deliveries lag
+    # `generated` by up to one modeled step (the step loop flushes
+    # frames after sleeping the step time), and a drain handoff must
+    # carry exactly the delivered history — resume state covering an
+    # undelivered token would skip it from the client's stream.
+    delivered: int = 0
     done: bool = False
     cancelled: bool = False
     pinned: list[int] = dataclasses.field(default_factory=list)
@@ -304,6 +310,17 @@ class MockerEngine:
         self.preempt_enabled = bool(env("DYNT_PREEMPT_ENABLE"))
         self.preempt_parked = 0
         self.preempt_resumed = 0
+        # Graceful drain plane (engine/drain.py simulated chip-free;
+        # docs/fault-tolerance.md departure ladder): while draining,
+        # raced arrivals bounce with an in-band migrate; counters mirror
+        # the real scheduler's SchedulerStats.drain_* so the chaos proof
+        # asserts the ladder without silicon.
+        self.draining = False
+        self.drain_handoff = 0
+        self.drain_replayed = 0
+        self.drain_errored = 0
+        self.drain_resumed = 0
+        self.drain_bounced = 0
         self._publisher = event_publisher
         self._event_id = 0
         self._step_task: Optional[asyncio.Task] = None
@@ -388,6 +405,7 @@ class MockerEngine:
             step_wall_ms=self.last_step_wall_ms,
             device_ms_in_step=self.last_step_device_ms,
             host_ms_in_step=self.last_step_host_ms,
+            draining=self.draining,
         )
 
     # -- public handler ----------------------------------------------------
@@ -407,6 +425,15 @@ class MockerEngine:
                 prompt_tokens=len(request.token_ids),
                 embedding=[float(x) for x in vec],
             ).to_wire()
+            return
+        if self.draining:
+            # Vacating (engine/drain.py): anything that raced the
+            # router's draining flip bounces with an in-band migrate —
+            # the Migration operator replays it on a peer.
+            self.drain_bounced += 1
+            yield EngineOutput(
+                finish_reason="migrate",
+                error="worker draining; replay on a peer").to_wire()
             return
         queue: asyncio.Queue = asyncio.Queue()
         block_hashes = compute_block_hashes(request.token_ids,
@@ -610,6 +637,25 @@ class MockerEngine:
                 # Disagg decode side: the KV "arrived" via transfer — skip
                 # the prefill pass entirely (ref §3.4 decode leg).
                 seq.prefilled_tokens = len(seq.request.token_ids)
+                handoff = seq.request.disaggregated_params.get("handoff")
+                if handoff is not None:
+                    # Drain-handoff destination (engine/drain.py): the
+                    # committed history rides the params; decode
+                    # continues at the next index — the token function
+                    # is deterministic in (prompt, index), so the
+                    # continuation is bit-identical to an undrained
+                    # run, with ZERO tokens through the prefill ledger
+                    # (the chaos proof's re-prefill assertion).
+                    seq.generated = len(handoff.get("generated") or [])
+                    # The inherited history counts as DELIVERED too: a
+                    # second drain of this worker (rolling restart) must
+                    # ship the full committed history, or the next peer
+                    # would re-emit the inherited tokens to the client.
+                    seq.delivered = seq.generated
+                    self.drain_resumed += 1
+                    get_recorder().event(seq.request.request_id,
+                                         "drain_resume",
+                                         tokens_preserved=seq.generated)
             self._waiting.pop(0)
             self._running.append(seq)
         self._resume_parked(evict_cb)
@@ -774,6 +820,133 @@ class MockerEngine:
         self.spec_accepted += accepted
         return 1 + accepted
 
+    def _token_at(self, req: PreprocessedRequest, index: int) -> int:
+        """Deterministic pseudo-output — echo the prompt, or cycle
+        through printable ASCII. A pure function of (prompt, index):
+        what makes drain-handoff continuations bit-identical to an
+        undrained run by construction, and lets the drain sweep
+        reconstruct the committed history for the handoff frame."""
+        if self.config.echo and index < len(req.token_ids):
+            return int(req.token_ids[index])
+        return 97 + ((len(req.token_ids) + index) % 26)
+
+    # -- graceful drain (engine/drain.py, simulated chip-free;
+    # docs/fault-tolerance.md departure ladder) ---------------------------
+
+    def drain_sweep(self, handoff: bool = True) -> dict:
+        """Vacate live sequences for a graceful departure, mirroring
+        InferenceScheduler.drain_sweep. Rung 1 — eligible decode
+        sequences (fully prefilled, committed tokens, not prefill-only)
+        emit a migrate frame whose kv_transfer_params carry the mock
+        pull route + resume state; the destination mocker skips its
+        prefill pass and continues the deterministic token function at
+        the next index. Rung 2 — everything else (waiting, parked,
+        mid-prefill) emits a plain migrate for a peer replay. Returns
+        the same {"handoff": [...], "replay": [...], "pending": [...]}
+        report shape as the real scheduler."""
+        self.draining = True
+        report: dict = {"handoff": [], "replay": [], "pending": []}
+        from ..runtime.flight_recorder import get_recorder
+
+        def _replay(seq: _Sequence) -> None:
+            self.drain_replayed += 1
+            report["replay"].append(seq.request.request_id)
+            get_recorder().event(seq.request.request_id, "drain",
+                                 rung="replay",
+                                 tokens_preserved=seq.generated)
+            self._deliver(seq, EngineOutput(
+                finish_reason="migrate",
+                error="worker draining").to_wire())
+            self._deliver(seq, None)
+
+        for seq in list(self._waiting):
+            if not seq.cancelled:
+                _replay(seq)
+            seq.cancelled = True
+        self._waiting.clear()
+        for seq in list(self._parked):
+            if not seq.cancelled:
+                _replay(seq)
+            seq.cancelled = True
+        self._parked.clear()
+        for seq in list(self._running):
+            if seq.done or seq.cancelled:
+                continue
+            req = seq.request
+            if req.annotations.get("prefill_only"):
+                # Its decode peer is mid-"pull" of the mock transfer;
+                # the step loop finishes it on its own.
+                report["pending"].append(req.request_id)
+                continue
+            if (handoff and seq.delivered > 0
+                    and seq.prefilled_tokens >= len(req.token_ids)):
+                # Resume state covers the DELIVERED history only:
+                # tokens committed this step but still waiting on the
+                # modeled step sleep never reached the client, so the
+                # destination must regenerate them (bit-identically).
+                self.drain_handoff += 1
+                report["handoff"].append(req.request_id)
+                get_recorder().event(req.request_id, "drain",
+                                     rung="handoff",
+                                     tokens_preserved=seq.delivered)
+                self._deliver(seq, EngineOutput(
+                    finish_reason="migrate",
+                    error="worker draining (kv handoff)",
+                    kv_transfer_params={
+                        "mock": True,
+                        "handoff": {
+                            "seed": 0,
+                            "generated": [self._token_at(req, g)
+                                          for g in range(seq.delivered)],
+                            "prompt_len": len(req.token_ids),
+                        },
+                    }).to_wire())
+                self._deliver(seq, None)
+            else:
+                _replay(seq)
+            seq.done = True
+            self._running.remove(seq)
+            self._release(seq)
+        try:
+            from ..runtime.metrics import DRAIN_SEQUENCES
+
+            for outcome, count in (("handoff", len(report["handoff"])),
+                                   ("replay", len(report["replay"]))):
+                if count:
+                    DRAIN_SEQUENCES.labels(outcome=outcome).inc(count)
+        except Exception:  # noqa: BLE001 — metrics must not break sims
+            pass
+        return report
+
+    def drain_expire(self, reason: str) -> int:
+        """Deadline rung: finish anything still live with an honest
+        in-band error (mirrors InferenceScheduler.drain_expire)."""
+        n = 0
+        for seq in list(self._waiting) + list(self._parked) \
+                + list(self._running):
+            if seq.done or seq.cancelled:
+                continue
+            self._deliver(seq, EngineOutput(
+                finish_reason="error", error=reason).to_wire())
+            self._deliver(seq, None)
+            seq.done = True
+            seq.cancelled = True
+            n += 1
+            if seq in self._running:
+                self._running.remove(seq)
+                self._release(seq)
+        self._waiting.clear()
+        self._parked.clear()
+        self.drain_errored += n
+        try:
+            from ..runtime.metrics import DRAIN_SEQUENCES
+
+            if n:
+                DRAIN_SEQUENCES.labels(outcome="error").inc(n)
+        except Exception:  # noqa: BLE001
+            pass
+        return n
+
     def _decode_step(self) -> tuple[int, int, list, list]:
         """Generate tokens for each fully-prefilled sequence — one per
         step, or 1 + accepted under a speculative-worker profile
@@ -829,13 +1002,7 @@ class MockerEngine:
                     req.sampling.max_tokens - seq.generated)
             tokens: list[int] = []
             for _ in range(n_tokens):
-                # Deterministic pseudo-output: echo the prompt, or cycle
-                # through printable ASCII.
-                if self.config.echo and seq.generated < len(req.token_ids):
-                    tokens.append(int(req.token_ids[seq.generated]))
-                else:
-                    tokens.append(
-                        97 + ((len(req.token_ids) + seq.generated) % 26))
+                tokens.append(self._token_at(req, seq.generated))
                 seq.generated += 1
             decoded += len(tokens)
             finish = None
@@ -875,6 +1042,8 @@ class MockerEngine:
                 seq.device_decode_ms = seq.host_decode_ms = 0.0
             seq.queue.put_nowait(None)
             return
+        if isinstance(item, dict) and item.get("t"):
+            seq.delivered += len(item["t"])
         if not seq.prefill_flushed and isinstance(item, dict) \
                 and (item.get("t") or item.get("kv")):
             seq.prefill_flushed = True

@@ -8,10 +8,12 @@ main.py wrapping lib/mocker create_engine): create runtime -> serve
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional
 
 from ..llm.model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard, publish_card
 from ..runtime import DistributedRuntime, RuntimeConfig, new_instance_id
+from ..runtime.config import env
 from ..runtime.logging import get_logger
 from ..runtime.signals import wait_for_shutdown_signal
 from .engine import MockerConfig, MockerEngine
@@ -73,11 +75,23 @@ class MockerWorker:
         self._served = None
         self._kvq_served = None
         self._clear_served = None
+        # Graceful drain plane (engine/drain.py simulated chip-free):
+        # one ladder run per process; repeats join it.
+        self._drain_task: Optional[asyncio.Task] = None
+        self._publisher = None
 
     async def start(self) -> None:
         publisher = self.runtime.event_publisher(self.card.namespace)
+        self._publisher = publisher
         self.engine = MockerEngine(self.config, worker_id=self.instance_id,
                                    event_publisher=publisher)
+        if getattr(self.runtime, "status_server", None) is not None:
+            self.runtime.status_server.register_drain(self.drain)
+        # Startup stamp: dynamo_drain_state=0 (serving) — same contract
+        # as TpuWorker (docs/metrics.md; engine/drain.py).
+        from ..engine.drain import SERVING, set_drain_state
+
+        set_drain_state(self.instance_id, SERVING)
         if hasattr(publisher, "set_snapshot_fn"):
             # Durable journal plane: rotation snapshots (see engine worker)
             from ..kv_router.protocols import KV_SNAPSHOT_TOPIC
@@ -128,6 +142,66 @@ class MockerWorker:
                 await self.engine.publish_load()
             except Exception:  # noqa: BLE001
                 log.exception("load publish failed")
+
+    # -- graceful drain (the chip-free departure ladder; mirrors
+    # TpuWorker.drain / engine/drain.py) ----------------------------------
+
+    async def drain(self, reason: str = "signal") -> dict:
+        """Run (or join) the departure ladder: announce draining on
+        discovery + the load plane, hand off / replay live streams,
+        then (deadline rung) error whatever remains. Idempotent —
+        double SIGTERM and a racing POST /drain share one run."""
+        if not env("DYNT_DRAIN_ENABLE"):
+            return {"skipped": True, "reason": "DYNT_DRAIN_ENABLE=0"}
+        if self._drain_task is None:
+            self._drain_task = asyncio.create_task(self._run_drain(reason))
+        return await asyncio.shield(self._drain_task)
+
+    async def _run_drain(self, reason: str) -> dict:
+        from ..engine.drain import DRAINED, DRAINING, set_drain_state
+
+        start = time.monotonic()
+        deadline = start + max(0.1, float(env("DYNT_DRAIN_DEADLINE_SECS")))
+        set_drain_state(self.instance_id, DRAINING)
+        self.card.runtime_config["draining"] = True
+        try:
+            await publish_card(self.runtime, self.card, self.instance_id)
+        except Exception:  # noqa: BLE001 — the load flip still lands
+            log.exception("draining card republish failed")
+        self.engine.draining = True
+        try:
+            # Immediate LoadMetrics flip (draining=True) — waiting for
+            # the next load tick would leave routers selecting us.
+            await self.engine.publish_load()
+        except Exception:  # noqa: BLE001
+            log.exception("draining load publish failed")
+        # One event tick for routers to apply the flip before migrate
+        # frames re-dispatch (same settle as engine/drain.py).
+        settle = min(float(env("DYNT_DRAIN_ANNOUNCE_SETTLE_SECS")),
+                     max(0.0, deadline - time.monotonic() - 0.05))
+        if settle > 0:
+            await asyncio.sleep(settle)
+        report = self.engine.drain_sweep(
+            handoff=bool(env("DYNT_DRAIN_HANDOFF")))
+        errored = 0
+        while time.monotonic() < deadline:
+            if not (self.engine._running or self.engine._waiting
+                    or self.engine._parked):
+                break
+            await asyncio.sleep(0.02)
+        else:
+            errored = self.engine.drain_expire(
+                "worker drain deadline exceeded")
+        duration_ms = (time.monotonic() - start) * 1e3
+        report = {**report, "reason": reason, "errored": errored,
+                  "bounced": self.engine.drain_bounced,
+                  "completed": errored == 0,
+                  "duration_ms": round(duration_ms, 3)}
+        log.info("mocker drain complete in %.0fms: %d handoff, %d "
+                 "replay, %d errored", duration_ms,
+                 len(report["handoff"]), len(report["replay"]), errored)
+        set_drain_state(self.instance_id, DRAINED)
+        return report
 
     async def close(self) -> None:
         if self._load_task is not None:
@@ -203,6 +277,13 @@ async def main(argv: Optional[list[str]] = None) -> None:
     try:
         await wait_for_shutdown_signal()
     finally:
+        # Departure ladder BEFORE teardown (docs/fault-tolerance.md):
+        # live streams hand off / replay instead of dying with the
+        # endpoints — what the faults service's `evict` notice drives.
+        try:
+            await worker.drain("shutdown-signal")
+        except Exception:  # noqa: BLE001 — teardown proceeds regardless
+            log.exception("graceful drain failed")
         await health.close()
         await worker.close()
         await runtime.shutdown()

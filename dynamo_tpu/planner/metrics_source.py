@@ -320,6 +320,13 @@ class LoadEventSource:
     def on_event(self, payload: dict) -> None:
         key = (int(payload.get("worker_id", 0)),
                int(payload.get("dp_rank", 0)))
+        if payload.get("draining"):
+            # Graceful departure (engine/drain.py): the worker is
+            # vacating — its backlog is migrating to peers, not load
+            # that should drive a scale-up, and it must not count as
+            # serving capacity either. Drop it from the estimate set.
+            self.latest.pop(key, None)
+            return
         self.latest[key] = (payload, time.monotonic())
 
     def _prune(self) -> None:

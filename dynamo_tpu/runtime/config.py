@@ -427,6 +427,44 @@ _register("DYNT_PREEMPT_MIGRATION_LIMIT", 3, _int,
           "never consume the failure budget that protects against "
           "crash loops; cooperative replays also skip backoff jitter")
 
+# Graceful drain plane — zero-drop worker departures
+# (engine/drain.py; departure ladder in docs/fault-tolerance.md)
+_register("DYNT_DRAIN_ENABLE", True, _bool,
+          "Graceful drain on SIGTERM / POST /drain / faults 'evict': flip "
+          "the worker to draining (routers stop selecting it), hand live "
+          "decode sequences to peers via KV handoff, and deregister only "
+          "when empty or the deadline expires. Off restores the old "
+          "behavior — SIGTERM tears down and in-flight streams fall onto "
+          "failure migration (a full re-prefill per stream)")
+_register("DYNT_DRAIN_DEADLINE_SECS", 20.0, _float,
+          "Budget for a graceful drain, end-to-end (sized to fit inside "
+          "a ~30s spot/preemptible eviction notice). The degradation "
+          "ladder runs inside it: KV-state handoff -> cooperative "
+          "replay-migrate -> honest in-band error at expiry; parked "
+          "handoff transfers not pulled by the deadline are expired and "
+          "their pages released")
+_register("DYNT_DRAIN_ANNOUNCE_SETTLE_SECS", 0.25, _float,
+          "Pause between announcing `draining` (discovery card + "
+          "LoadMetrics) and sweeping live sequences, giving routers one "
+          "event tick to stop selecting this worker — a handoff migrate "
+          "frame that lands before the flip would re-dispatch straight "
+          "back at the vacating worker, bounce, and burn its replay on "
+          "the cooperative rung. Comes out of the drain deadline budget")
+_register("DYNT_DRAIN_HANDOFF", True, _bool,
+          "Live KV-state handoff during drain: eligible decode sequences "
+          "park their computed pages with the transfer table and emit a "
+          "migrate frame carrying kv_transfer_params + resume state, so "
+          "the destination pulls the KV and continues bit-identically "
+          "instead of re-prefilling. Off forces every drained sequence "
+          "onto the cooperative replay-migrate rung (ablation/debug)")
+_register("DYNT_DRAIN_HTTP", True, _bool,
+          "Serve POST /drain on the status server. The verb is "
+          "unauthenticated and its effect is terminal (a drained worker "
+          "never rejoins routing until restarted) — on deployments where "
+          "the status port is reachable beyond the operators, disable it "
+          "and drain via SIGTERM / the request-plane control verb / the "
+          "faults service instead")
+
 # Fault tolerance — resilience plane (runtime/resilience.py; knob
 # semantics and the degradation ladder in docs/fault-tolerance.md)
 _register("DYNT_DEADLINE_SECS", 600.0, _float,

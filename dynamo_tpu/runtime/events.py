@@ -249,26 +249,168 @@ import os
 import struct
 import threading
 import time
+import zlib
+
+# Frame header: big-endian (length, crc32-of-body). Lengths above this
+# bound are corruption, not a frame still being appended — no legitimate
+# journal frame approaches it (the codec chunks payloads well below).
+_JOURNAL_MAX_FRAME = 64 << 20
+# File preamble marking the CRC-framed format. Files WITHOUT it are
+# pre-CRC journals ([len][body] frames): a reader must parse them with
+# the legacy framing — interpreting their first body bytes as a CRC
+# would "corrupt-skip" an entire healthy history on the first read
+# after an upgrade (and fire a false storage-corruption alarm).
+_JOURNAL_MAGIC = b"DYNJRNL1"
+# Synthetic subscriber event emitted when corrupt frames were skipped:
+# consumers holding derived state (radix routers, standalone indexers)
+# schedule a worker resync (dump_worker/load_worker round-trip) instead
+# of silently diverging on the lost events. Always delivered, bypassing
+# the subscriber's topic-prefix filter.
+JOURNAL_RESYNC_TOPIC = "_journal/resync"
 
 
 def _journal_pack(topic: str, payload: Any) -> bytes:
     body = msgpack.packb({"t": topic, "p": payload}, use_bin_type=True)
-    return struct.pack(">I", len(body)) + body
+    return struct.pack(">II", len(body), zlib.crc32(body)) + body
 
 
-def _journal_read(buf: bytes, offset: int):
-    """Yield (next_offset, topic, payload) for complete frames in buf from
-    offset; a trailing partial frame (torn write from a crashed publisher)
-    is left for the next poll."""
+_PARTIAL = "partial"
+
+
+def _try_frame(buf: bytes, pos: int):
+    """Parse one frame at pos: (next_pos, topic, payload) on success,
+    _PARTIAL when the buffer ends inside a plausible frame (torn tail —
+    wait for the next poll), None when the bytes are corrupt (bad
+    length, CRC mismatch, or undecodable body)."""
+    n = len(buf)
+    if pos + 8 > n:
+        return _PARTIAL
+    length, crc = struct.unpack_from(">II", buf, pos)
+    if length > _JOURNAL_MAX_FRAME:
+        return None
+    if pos + 8 + length > n:
+        return _PARTIAL
+    body = buf[pos + 8 : pos + 8 + length]
+    if zlib.crc32(body) != crc:
+        return None
+    try:
+        frame = msgpack.unpackb(body, raw=False, strict_map_key=False)
+        return pos + 8 + length, frame["t"], frame["p"]
+    except Exception:  # noqa: BLE001 — CRC-passing but undecodable
+        # bytes are still corruption (e.g. a zero-filled sparse hole:
+        # length 0 / crc 0 checks out, the empty body does not unpack)
+        return None
+
+
+def _scan_next_valid(buf: bytes, start: int) -> Optional[int]:
+    """First position >= start where a COMPLETE frame parses (CRC +
+    msgpack). A 32-bit CRC over the candidate's full body makes a false
+    re-sync point vanishingly unlikely."""
+    n = len(buf)
+    for pos in range(start, max(start, n - 8) + 1):
+        if _try_frame(buf, pos) not in (None, _PARTIAL):
+            return pos
+    return None
+
+
+def _scan_next_partial(buf: bytes, start: int) -> Optional[int]:
+    """First position >= start that could be the START of a frame whose
+    remainder has not been written yet (plausible header, body past
+    EOF). Used when corruption leaves no COMPLETE frame: the consumed
+    garbage must stop IN FRONT of such a candidate — eating a
+    half-written valid frame's prefix would make its remaining bytes
+    parse as garbage on the next poll and cascade the loss."""
+    for pos in range(start, len(buf)):
+        if _try_frame(buf, pos) is _PARTIAL:
+            return pos
+    return None
+
+
+def _journal_read(buf: bytes, offset: int, on_bad=None,
+                  scan_partial: bool = True):
+    """Yield (next_offset, topic, payload) for complete frames in buf
+    from offset. A trailing partial frame (torn write from a crashed
+    publisher) is left for the next poll. A CORRUPT frame (CRC mismatch,
+    implausible length, zero-fill from a truncate-then-append hole) does
+    not wedge replay: the reader re-syncs to the next CRC-valid frame —
+    or, when nothing valid remains, consumes to EOF so fresh appends
+    land on a clean boundary (the generation-boundary fallback). Each
+    skip calls `on_bad(1)` so subscribers can count it and signal a
+    worker resync for the derived state the lost frames fed.
+
+    `scan_partial=False` skips the byte-by-byte resync scan for a
+    PLAUSIBLE partial tail (a corrupted length field is indistinguishable
+    from a frame still being appended): callers pass False while the file
+    is still growing — re-scanning a multi-MB half-written snapshot frame
+    on every poll is O(tail²) for nothing — and True once it stagnates,
+    which is when "still appending" stops being the likely explanation.
+    Mid-buffer corruption (CRC/length/decode failures) always scans."""
+    n = len(buf)
+    while True:
+        parsed = _try_frame(buf, offset)
+        if parsed is _PARTIAL:
+            # Usually a torn tail that completes on a later poll. But a
+            # corrupted length field masquerades as an ever-growing
+            # partial frame: if a valid frame exists FURTHER ALONG, the
+            # "partial" here is garbage — skip to it.
+            if not scan_partial:
+                return
+            nxt = _scan_next_valid(buf, offset + 1)
+            if nxt is None:
+                return
+            if on_bad is not None:
+                on_bad(1)
+            offset = nxt
+            continue
+        if parsed is None:
+            if on_bad is not None:
+                on_bad(1)
+            nxt = _scan_next_valid(buf, offset + 1)
+            if nxt is None:
+                # Nothing COMPLETE left — but the tail may hold a valid
+                # frame still being APPENDED behind the corruption.
+                # Consume only up to the first plausible frame-start
+                # (eating a half-written frame's prefix would corrupt
+                # it in turn and cascade); with no candidate at all,
+                # consume to EOF so the next poll starts at a clean
+                # append boundary instead of re-counting these bytes.
+                part = _scan_next_partial(buf, offset + 1)
+                yield (n if part is None else part), None, None
+                return
+            offset = nxt
+            continue
+        offset, topic, payload = parsed
+        yield offset, topic, payload
+
+
+def _journal_read_legacy(buf: bytes, offset: int, on_bad=None):
+    """Pre-CRC framing ([len u32][msgpack body], no checksum): the
+    parser for journal files that lack the _JOURNAL_MAGIC preamble —
+    history written before the CRC format, replayed once across an
+    upgrade. A torn tail is left for the next poll; an undecodable body
+    (no CRC to resync on) counts one bad frame and consumes to EOF so
+    the file cannot wedge replay of everything behind it."""
     n = len(buf)
     while offset + 4 <= n:
         (length,) = struct.unpack_from(">I", buf, offset)
+        if length > _JOURNAL_MAX_FRAME:
+            if on_bad is not None:
+                on_bad(1)
+            yield n, None, None
+            return
         if offset + 4 + length > n:
-            break  # incomplete tail frame
-        frame = msgpack.unpackb(buf[offset + 4 : offset + 4 + length],
-                                raw=False, strict_map_key=False)
+            return  # incomplete tail frame
+        try:
+            frame = msgpack.unpackb(buf[offset + 4 : offset + 4 + length],
+                                    raw=False, strict_map_key=False)
+            topic, payload = frame["t"], frame["p"]
+        except Exception:  # noqa: BLE001 — corrupt legacy frame
+            if on_bad is not None:
+                on_bad(1)
+            yield n, None, None
+            return
         offset += 4 + length
-        yield offset, frame["t"], frame["p"]
+        yield offset, topic, payload
 
 
 class JournalEventPublisher(EventPublisher):
@@ -305,6 +447,11 @@ class JournalEventPublisher(EventPublisher):
         self._max_bytes = max_bytes
         self._grace = grace_seconds
         self._file = open(self._path(), "ab")
+        if self._file.tell() == 0:
+            # Format preamble: marks this file as CRC-framed so readers
+            # never misparse it with the legacy ([len][body]) framing.
+            self._file.write(_JOURNAL_MAGIC)
+            self._file.flush()
         self._lock = threading.Lock()
         self._retired: list[tuple[str, float]] = []  # (path, retired_at)
         self.snapshot_fn: Optional[Callable[[], list]] = None
@@ -352,6 +499,8 @@ class JournalEventPublisher(EventPublisher):
         old_path, old_file = self._path(), self._file
         self._generation += 1
         new_file = open(self._path(), "ab")
+        if new_file.tell() == 0:
+            new_file.write(_JOURNAL_MAGIC)
         if self.snapshot_fn is not None:
             try:
                 for topic, payload in self.snapshot_fn():
@@ -394,35 +543,134 @@ class JournalEventSubscriberManager:
     def __init__(self, root: str, namespace: str, topic_prefix: str,
                  poll_interval: float = 0.05) -> None:
         self._dir = os.path.join(root, namespace)
+        self._namespace = namespace
         self._prefix = topic_prefix
         self._poll = poll_interval
         # publisher_id -> (generation, offset)
         self._positions: dict[str, tuple[int, int]] = {}
         self._subscriber = EventSubscriber()
         self._task: Optional[asyncio.Task] = None
+        # Corrupt frames skipped via CRC resync, total (mirrors the
+        # dynamo_journal_bad_frames_total counter for direct assertion).
+        self.bad_frames = 0
+        # Partial-tail scan pacing, path -> (eof_seen, eof_scanned): a
+        # plausible torn tail is only CRC-scanned for a false "partial"
+        # (corrupt length field) once the file STOPS growing — scanning
+        # a half-written multi-MB frame on every poll is O(tail²) per
+        # poll for nothing — and at most once per stagnant size.
+        self._tail_scan: dict[str, tuple[int, int]] = {}
+        # path -> "crc" | "legacy", decided once at offset 0 by the
+        # _JOURNAL_MAGIC preamble: pre-upgrade history replays through
+        # the legacy ([len][body]) parser instead of being discarded as
+        # wall-to-wall CRC corruption.
+        self._formats: dict[str, str] = {}
 
     async def start(self) -> EventSubscriber:
         self._task = asyncio.create_task(self._poll_loop())
         return self._subscriber
 
     def _read_frames(self, pub: str, gen: int, offset: int,
-                     out: list[tuple[str, Any]]) -> Optional[int]:
+                     out: list[tuple[str, Any]],
+                     bad_acc: list[tuple[str, int, int]]) -> Optional[int]:
         """Read complete frames of `<pub>.g<gen>.log` from offset into
         out (prefix-filtered); returns the new offset, or None if the
-        file is gone (rotated away and past its grace window)."""
+        file is gone (rotated away and past its grace window). Corrupt
+        frames are skipped (CRC resync) and followed by ONE synthetic
+        JOURNAL_RESYNC_TOPIC event — delivered regardless of the topic
+        prefix — so consumers re-dump the workers whose state the lost
+        frames fed instead of silently diverging. Their count lands in
+        `bad_acc`, NOT on the counters: the caller commits it together
+        with the position advance (see _commit_bad_frames)."""
         path = os.path.join(self._dir, f"{pub}.g{gen}.log")
+        fmt = self._formats.get(path)
         try:
             with open(path, "rb") as f:
+                head = b""
+                if fmt is None:
+                    # Decide the format from the offset-0 preamble EVERY
+                    # time it's unknown — a transient read error drops
+                    # the cached verdict while our offset stays
+                    # mid-file, and inferring "legacy" from a nonzero
+                    # offset would permanently misparse a CRC-framed
+                    # file (every later frame discarded as corruption).
+                    head = f.read(len(_JOURNAL_MAGIC))
                 f.seek(offset)
                 buf = f.read()
         except OSError:
+            self._tail_scan.pop(path, None)
+            self._formats.pop(path, None)
             return None
-        pos = 0
-        for next_pos, topic, payload in _journal_read(buf, 0):
+        bad = [0]
+
+        def _on_bad(k: int) -> None:
+            bad[0] += k
+
+        if fmt is None:
+            if head == _JOURNAL_MAGIC:
+                fmt = "crc"
+            elif head == _JOURNAL_MAGIC[: len(head)]:
+                # Strict prefix (file still shorter than the preamble):
+                # too short to decide; wait for the rest.
+                return offset
+            else:
+                fmt = "legacy"  # pre-magic first bytes: old format
+            self._formats[path] = fmt
+        # The preamble is consumed on any offset-0 read of a CRC file,
+        # cached verdict or not — a scan that buffered frames but could
+        # not commit its position leaves offset at 0 with fmt decided.
+        skip = (len(_JOURNAL_MAGIC)
+                if fmt == "crc" and offset == 0 else 0)
+        end = offset + len(buf)
+        st = self._tail_scan.get(path)
+        grew = st is None or end > st[0]
+        scan_partial = not grew and (st is None or st[1] < end)
+        pos = skip
+        frames = (_journal_read(buf, skip, _on_bad,
+                                scan_partial=scan_partial)
+                  if fmt == "crc"
+                  else _journal_read_legacy(buf, skip, _on_bad))
+        for next_pos, topic, payload in frames:
             pos = next_pos
+            if topic is None:
+                continue  # consume-to-EOF sentinel (garbage tail)
             if topic.startswith(self._prefix):
                 out.append((topic, payload))
+        if offset + pos < end:
+            # A tail remains unconsumed (partial or not-yet-scanned
+            # garbage): remember this EOF so the scan fires exactly once
+            # after the file stagnates at it.
+            self._tail_scan[path] = (
+                end, end if scan_partial else (st[1] if st else 0))
+        else:
+            self._tail_scan.pop(path, None)
+        if bad[0]:
+            bad_acc.append((pub, gen, bad[0]))
+            out.append((JOURNAL_RESYNC_TOPIC,
+                        {"publisher": pub, "generation": gen,
+                         "skipped": bad[0]}))
         return offset + pos
+
+    def _commit_bad_frames(
+            self, acc: list[tuple[str, int, int]]) -> None:
+        """Deferred corruption accounting, applied only when the scan
+        commits a publisher's position advance. Counting inside
+        _read_frames would re-bump dynamo_journal_bad_frames_total (and
+        re-log) on EVERY poll while a transient newest-generation read
+        failure keeps positions unadvanced and the same corrupt frames
+        keep being re-read."""
+        for pub, gen, k in acc:
+            self.bad_frames += k
+            log.warning(
+                "journal corruption: skipped %d bad frame(s) in %s.g%d "
+                "(resync signalled)", k, pub, gen)
+            try:
+                from .metrics import JOURNAL_BAD_FRAMES
+
+                JOURNAL_BAD_FRAMES.labels(
+                    namespace=self._namespace).inc(k)
+            except Exception:  # noqa: BLE001 — metrics must not break
+                # the tail loop
+                pass
 
     def _scan(self) -> list[tuple[str, Any]]:
         """Thread-side: read new frames from every log; returns events."""
@@ -450,6 +698,7 @@ class JournalEventSubscriberManager:
             # ESTALE on the newest file over NFS/GCS-fuse) would
             # re-emit the same frames on the next poll.
             pub_out: list[tuple[str, Any]] = []
+            pub_bad: list[tuple[str, int, int]] = []
             if gen > cur_gen and cur_gen >= 0:
                 # Drain every generation between our position and the
                 # newest, in order — the publisher keeps rotated
@@ -460,10 +709,11 @@ class JournalEventSubscriberManager:
                 for g in range(cur_gen, gen):
                     self._read_frames(pub, g,
                                       offset if g == cur_gen else 0,
-                                      pub_out)
+                                      pub_out, pub_bad)
             if gen > cur_gen:
                 offset = 0  # new generation: replay from its start
-            new_offset = self._read_frames(pub, gen, offset, pub_out)
+            new_offset = self._read_frames(pub, gen, offset, pub_out,
+                                           pub_bad)
             if new_offset is not None:
                 if cur_gen < 0 and pub_out:
                     # First contact with this publisher's log: the
@@ -472,6 +722,7 @@ class JournalEventSubscriberManager:
                              "%s (gen %d)", len(pub_out), pub, gen)
                 self._positions[pub] = (gen, new_offset)
                 out.extend(pub_out)
+                self._commit_bad_frames(pub_bad)
         return out
 
     async def _poll_loop(self) -> None:

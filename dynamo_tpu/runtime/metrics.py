@@ -190,6 +190,36 @@ PREEMPT_TOTAL = Counter(
     "and decoding again)",
     ["kind"], registry=REGISTRY,
 )
+# Graceful drain plane (engine/drain.py; docs/fault-tolerance.md
+# departure ladder): how a departing worker vacated its live streams.
+DRAIN_STATE = Gauge(
+    "dynamo_drain_state",
+    "Worker drain state (0=serving 1=draining 2=drained)",
+    ["worker"], registry=REGISTRY,
+)
+DRAIN_SEQUENCES = Counter(
+    "dynamo_drain_sequences_total",
+    "Live sequences vacated during graceful drains, by the ladder rung "
+    "that moved them: handoff (KV-state handoff, peer resumes "
+    "bit-identically), replay (cooperative replay-migrate, peer "
+    "re-prefills), error (deadline expired — honest in-band error)",
+    ["outcome"], registry=REGISTRY,
+)
+DRAIN_DURATION_MS = Gauge(
+    "dynamo_drain_duration_ms",
+    "Wall time of this worker's last graceful drain, start to "
+    "deregistration-ready", ["worker"], registry=REGISTRY,
+)
+# Durable journal integrity (runtime/events.py): corrupt/torn frames
+# the subscriber skipped via CRC resync instead of wedging replay.
+JOURNAL_BAD_FRAMES = Counter(
+    "dynamo_journal_bad_frames_total",
+    "Corrupt journal frames (CRC mismatch / implausible length) skipped "
+    "by the skip-to-next-valid-frame resync, per namespace. Each skip "
+    "also emits a journal-resync event so routers re-dump affected "
+    "workers instead of silently diverging",
+    ["namespace"], registry=REGISTRY,
+)
 # Speculative decoding plane (engine/spec.py + scheduler): where
 # speculated tokens are won or wasted. acceptance = accepted/proposed;
 # every accepted token is a decode step the engine never ran.

@@ -65,6 +65,19 @@ class PushRouter:
         self.policy = retry_policy or RetryPolicy.from_env()
         self.budget = retry_budget or RetryBudget.from_env(subject)
         self.breakers = breakers or BreakerBoard(subject)
+        # Graceful drain plane (docs/fault-tolerance.md): instances a
+        # watcher marked as vacating. Excluded from available() so no
+        # mode (round_robin/random/p2c/kv) selects them for NEW work —
+        # including explicit targets, which fail fast with
+        # NoInstancesAvailable so the caller re-selects (Migration
+        # drops a stale gateway pin on its replay leg, see _unpin).
+        # Only mode="direct" bypasses the filter; the handoff KV pull
+        # rides ad-hoc per-subject routers no watcher marks, so pulling
+        # FROM the vacating worker keeps working. A card re-put does
+        # NOT clear the mark — a draining worker republishes its card
+        # with the flag set, and drains are terminal; the delete at
+        # deregistration drops it.
+        self._draining: set[int] = set()
         # Reset breakers when discovery re-confirms an instance.
         client.on_change(self._on_instance_change)
 
@@ -76,14 +89,31 @@ class PushRouter:
             self.breakers.reset(iid)
         if kind == "delete":
             self.breakers.drop(iid)
+            self._draining.discard(iid)
 
     def mark_down(self, instance_id: int) -> None:
         """Record a transport failure against an instance's breaker."""
         self.breakers.get(instance_id).record_failure()
 
+    def set_draining(self, instance_id: int, draining: bool = True) -> bool:
+        """Mark/unmark an instance as vacating. Returns True on a state
+        TRANSITION (callers decay derived state — radix entries, wait
+        estimators — exactly once, not per LoadMetrics tick)."""
+        if draining:
+            if instance_id in self._draining:
+                return False
+            self._draining.add(instance_id)
+            return True
+        if instance_id not in self._draining:
+            return False
+        self._draining.discard(instance_id)
+        return True
+
     def available(self) -> list[int]:
         out = []
         for iid in self.client.instance_ids():
+            if iid in self._draining:
+                continue
             if not self.breakers.get(iid).can_attempt():
                 continue
             out.append(iid)

@@ -4,21 +4,59 @@ SIGTERM/SIGINT -> resolve an event so mains fall through to their cleanup
 path (deregister instances, drain in-flight requests, revoke lease) instead
 of dying mid-request and leaning on lease expiry (ref: components/src/dynamo/
 common/utils/graceful_shutdown.py signal chaining).
+
+Worker mains compose this with the drain plane (engine/drain.py): the
+signal wait returns, the worker drains (KV-state handoff -> cooperative
+replay -> honest error, docs/fault-tolerance.md departure ladder), THEN
+endpoints close and the instance deregisters. `request_shutdown()` lets
+non-signal initiators (the worker's `drain` control verb, the status
+server's POST /drain) resolve the same event once their drain completes,
+so every departure path funnels through one teardown sequence.
 """
 
 from __future__ import annotations
 
 import asyncio
 import signal
+from typing import Optional
 
 from .logging import get_logger
 
 log = get_logger("signals")
 
+# One event per event loop: signal handlers and request_shutdown() both
+# resolve it; wait_for_shutdown_signal() awaits it. Keyed by loop so
+# tests running several loops in one process never share a stale event.
+_EVENTS: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Event]] = {}
+
+
+def _shutdown_event(loop: Optional[asyncio.AbstractEventLoop] = None
+                    ) -> asyncio.Event:
+    loop = loop or asyncio.get_running_loop()
+    key = id(loop)
+    entry = _EVENTS.get(key)
+    if entry is None:
+        entry = (loop, asyncio.Event())
+        _EVENTS[key] = entry
+        # Prune events of CLOSED loops so long test sessions don't
+        # accumulate one entry per loop ever created (a concurrently
+        # live loop in another thread keeps its event).
+        for k, (lp, _ev) in list(_EVENTS.items()):
+            if k != key and lp.is_closed():
+                del _EVENTS[k]
+    return entry[1]
+
+
+def request_shutdown(reason: str = "requested") -> None:
+    """Resolve the running loop's shutdown event (the non-signal
+    initiator path: drain control verbs, test harnesses)."""
+    log.info("shutdown requested (%s)", reason)
+    _shutdown_event().set()
+
 
 async def wait_for_shutdown_signal() -> None:
     loop = asyncio.get_running_loop()
-    event = asyncio.Event()
+    event = _shutdown_event(loop)
 
     def _handler(signame: str) -> None:
         log.info("received %s — shutting down gracefully", signame)

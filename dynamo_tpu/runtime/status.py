@@ -129,6 +129,12 @@ class SystemStatusServer:
         self.port: Optional[int] = None
         # Health callbacks: name -> () -> bool (endpoints register themselves)
         self._health_checks: dict[str, Callable[[], bool]] = {}
+        # Graceful-drain control verb (engine/drain.py): the hosting
+        # worker registers an async () -> dict that runs the departure
+        # ladder and returns the drain report. POST /drain without a
+        # registered drainer is a 404 (frontends/routers have nothing
+        # to drain through this verb).
+        self._drain_fn = None
 
     def register_health(self, name: str, check: Callable[[], bool]) -> None:
         self._health_checks[name] = check
@@ -156,11 +162,33 @@ class SystemStatusServer:
     async def _debug_profile(self, request: web.Request) -> web.Response:
         return await profile_response(request)
 
+    def register_drain(self, fn) -> None:
+        """fn: async () -> dict — runs the component's graceful drain
+        (idempotent; a second POST while draining awaits the first) and
+        returns its report. Single slot, LAST registration wins: a main
+        hosting several drainable components (the comesh prefill+decode
+        pair) must register ONE composed drainer that runs its ladder in
+        the right order — per-worker auto-registrations would otherwise
+        silently shadow each other."""
+        self._drain_fn = fn
+
+    async def _drain(self, _request: web.Request) -> web.Response:
+        if self._drain_fn is None:
+            return web.json_response(
+                {"error": "no drainable component registered"}, status=404)
+        report = await self._drain_fn()
+        return web.json_response(report)
+
     async def start(self) -> None:
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        # Mutating + terminal (a drained worker never rejoins routing),
+        # so unlike the read-only surface it gets an off switch for
+        # deployments where this port is reachable beyond the operators.
+        if env("DYNT_DRAIN_HTTP"):
+            app.router.add_post("/drain", self._drain)
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app, access_log=None)

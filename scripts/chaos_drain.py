@@ -1,0 +1,69 @@
+"""Chaos-drain CI driver: evict a mocker worker mid-decode and assert
+the departure ladder made it invisible — zero client-visible errors,
+streams bit-identical to an undrained run, zero re-prefill tokens on
+the KV-handoff path, drain inside the deadline, drained worker gone
+from router selection (docs/fault-tolerance.md departure ladder).
+
+Headless, CPU-only, chip-free: everything runs in-process through
+dynamo_tpu.mocker.drain_chaos. Exits nonzero when any assertion fails,
+so the chaos-drain job gates on the zero-drop contract.
+
+    python scripts/chaos_drain.py --out chaos-drain
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("chaos_drain")
+    parser.add_argument("--out", default="chaos-drain",
+                        help="report output directory")
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller fleet/streams (local smoke)")
+    parser.add_argument("--no-fallback-pass", action="store_true",
+                        help="skip the forced replay-fallback eviction")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+
+    from dynamo_tpu.mocker.drain_chaos import DrainChaosParams, run_scenario
+
+    params = DrainChaosParams()
+    if args.quick:
+        params = DrainChaosParams(n_workers=2, n_streams=6,
+                                  max_tokens=32, decode_base_ms=20.0)
+    report = asyncio.run(run_scenario(
+        params, fallback_pass=not args.no_fallback_pass))
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "chaos_drain_report.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+
+    print(f"report: {path}")
+    for chk in report["assertions"]:
+        mark = "PASS" if chk["ok"] else "FAIL"
+        print(f"  [{mark}] {chk['name']}")
+        if not chk["ok"]:
+            print(f"         {json.dumps(chk['detail'])[:400]}")
+    rep = report["drain_handoff"]["drain_report"] or {}
+    print(f"drain: {len(rep.get('handoff') or [])} handoff, "
+          f"{len(rep.get('replay') or [])} replay, "
+          f"{rep.get('errored', '?')} errored in "
+          f"{rep.get('duration_ms', 0):.0f}ms; "
+          f"re-prefill={report['drain_handoff']['reprefill_tokens']} tokens")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -416,3 +416,33 @@ class TestBrownout:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     pass
+
+
+@pytest.mark.slow
+class TestDrainChaos:
+    """Graceful-drain chaos scenario (docs/fault-tolerance.md departure
+    ladder): evict one mocker worker mid-decode out of a fleet serving
+    live streams. Asserted from the JSON report (the chaos-drain CI
+    artifact): zero client-visible errors, every stream bit-identical
+    to an undrained baseline, zero re-prefill tokens on the KV-handoff
+    path (replay only in the forced DYNT_DRAIN_HANDOFF=0 fallback),
+    drain inside the deadline, drained worker invisible to routing."""
+
+    def test_evicted_worker_departs_with_zero_drops(self, run, tmp_path):
+        from dynamo_tpu.mocker.drain_chaos import (
+            DrainChaosParams,
+            run_scenario,
+        )
+
+        params = DrainChaosParams(n_workers=2, n_streams=6,
+                                  max_tokens=32, decode_base_ms=20.0)
+
+        async def body():
+            report = await run_scenario(params, fallback_pass=True)
+            path = _write_chaos_report("chaos_drain", report,
+                                      default_dir=str(tmp_path))
+            print(f"drain scenario report: {path}")
+            failed = [c for c in report["assertions"] if not c["ok"]]
+            assert report["passed"], failed
+
+        run(body(), timeout=240.0)

@@ -1,0 +1,840 @@
+"""Graceful drain plane (engine/drain.py; docs/fault-tolerance.md
+departure ladder). The contract pinned here:
+
+  * KV handoff is bit-identical: a sequence drained mid-decode hands its
+    computed pages + resume state to a peer scheduler that continues the
+    committed stream byte-for-byte (greedy AND temperature, incl. a
+    spec-decode-active slot) with ZERO re-prefilled tokens;
+  * the ladder is ordered and honest — handoff for eligible decode
+    sequences, cooperative replay for what a handoff cannot carry
+    (waiting, host-sampler state), an in-band error at the deadline;
+  * the coordinator is idempotent (double SIGTERM = one ladder run) and
+    deregisters only when empty or expired;
+  * a draining worker disappears from router selection;
+  * the Migration operator re-dispatches a handoff frame with the pull
+    route as disaggregated_params (no replay-into-prompt), and a failed
+    destination pull degrades to the replay rung.
+"""
+
+import asyncio
+import queue as thread_queue
+import uuid
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import InferenceScheduler, ModelRunner, RunnerConfig
+from dynamo_tpu.llm.protocols import (
+    EngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import get_config
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+
+@pytest.fixture(autouse=True)
+def _small_decode_block(monkeypatch):
+    # Fused decode blocks commit DYNT_DECODE_BLOCK tokens per step; the
+    # default of 8 can run a short stream to completion before the
+    # drain sweep's between-steps callback lands. Two keeps every test
+    # deterministically mid-stream at sweep time.
+    monkeypatch.setenv("DYNT_DECODE_BLOCK", "2")
+
+
+def _runner(max_batch=2, num_pages=96, page_size=4, max_pages=36):
+    return ModelRunner(
+        get_config("tiny-test"),
+        RunnerConfig(page_size=page_size, num_pages=num_pages,
+                     max_batch=max_batch, max_pages_per_seq=max_pages,
+                     prefill_buckets=(8, 16, 32)),
+        make_mesh(MeshConfig()),
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def src_runner():
+    return _runner()
+
+
+@pytest.fixture(scope="module")
+def dst_runner():
+    # Same config + seed => identical weights: the "peer worker" the
+    # handoff lands on.
+    return _runner()
+
+
+def _request(tokens, max_tokens, temperature=0.0, seed=7, rid=None):
+    return PreprocessedRequest(
+        request_id=rid or uuid.uuid4().hex,
+        token_ids=list(tokens),
+        sampling=SamplingOptions(max_tokens=max_tokens,
+                                 temperature=temperature, seed=seed),
+        stop=StopConditions(ignore_eos=True),
+    )
+
+
+class _Stream:
+    """Collects one request's outputs off the scheduler thread."""
+
+    def __init__(self, loop):
+        self.queue = asyncio.Queue()
+        self._loop = loop
+        self.outputs: list = []
+
+    def emit(self, out: EngineOutput) -> None:
+        self._loop.call_soon_threadsafe(self.queue.put_nowait, out)
+
+    async def drain(self, timeout=60.0):
+        while True:
+            out = await asyncio.wait_for(self.queue.get(), timeout)
+            self.outputs.append(out)
+            if out.finish_reason is not None:
+                return self
+
+    async def take_tokens(self, n, timeout=60.0):
+        """Consume frames until >= n tokens committed (mid-decode)."""
+        while len(self.tokens) < n:
+            out = await asyncio.wait_for(self.queue.get(), timeout)
+            self.outputs.append(out)
+            assert out.finish_reason is None, \
+                f"finished early: {out.finish_reason} {out.error}"
+        return self
+
+    @property
+    def tokens(self):
+        return [t for o in self.outputs for t in o.token_ids]
+
+    @property
+    def finish(self):
+        return self.outputs[-1].finish_reason if self.outputs else None
+
+
+def _gathering_registry(runner, store):
+    """register_handoff callback: gather computed pages to host (what
+    the worker's transfer table serves to the peer's pull) and describe
+    the resume state."""
+
+    def register(seq, page_ids, computed):
+        bundle = np.asarray(runner.gather_pages_device(
+            np.asarray(page_ids, np.int32)))
+        store[seq.request.request_id] = bundle
+        return {
+            "transfer_id": seq.request.request_id,
+            "handoff": {"seed": int(seq.seed),
+                        "generated": [int(t) for t in seq.generated],
+                        "prompt_len": int(seq.prompt_len)},
+        }
+
+    return register
+
+
+async def _run_uninterrupted(runner, request) -> list:
+    sched = InferenceScheduler(runner)
+    sched.start()
+    try:
+        stream = _Stream(asyncio.get_running_loop())
+        sched.submit(request, stream.emit)
+        await stream.drain()
+        assert stream.finish == "length"
+        return stream.tokens
+    finally:
+        sched.stop()
+
+
+async def _drain_and_resume(src_runner, dst_runner, mk_request,
+                            tokens_before=3):
+    """Decode on the source until mid-stream, run the drain sweep, then
+    resume the handoff on a fresh destination scheduler. Returns
+    (src_sched, dst_sched, source tokens, destination stream)."""
+    loop = asyncio.get_running_loop()
+    src = InferenceScheduler(src_runner)
+    src.start()
+    store: dict = {}
+    try:
+        stream = _Stream(loop)
+        request = mk_request()
+        src.submit(request, stream.emit)
+        await stream.take_tokens(tokens_before)
+        q = src.run_in_step(lambda: src.drain_sweep(
+            register_handoff=_gathering_registry(src_runner, store)))
+        report, exc = await asyncio.to_thread(q.get, True, 60)
+        assert exc is None
+        await stream.drain()  # the terminal migrate frame
+        assert stream.finish == "migrate"
+        mig = stream.outputs[-1]
+        assert report["handoff"] == [request.request_id]
+        assert mig.kv_transfer_params is not None
+        handoff = mig.kv_transfer_params["handoff"]
+        # Every committed token was delivered before the handoff frame.
+        assert handoff["generated"] == stream.tokens
+    finally:
+        src.stop()
+    dst = InferenceScheduler(dst_runner)
+    dst.start()
+    try:
+        d_stream = _Stream(loop)
+        dst.submit(mk_request(rid=request.request_id), d_stream.emit,
+                   onboard_blocks=store[request.request_id],
+                   resume_state=handoff)
+        await d_stream.drain()
+    finally:
+        dst.stop()
+    return src, dst, stream.tokens, d_stream
+
+
+class TestKvHandoffBitIdentity:
+    def test_greedy_stream_survives_handoff(self, run, src_runner,
+                                            dst_runner):
+        async def body():
+            mk = lambda rid=None: _request(range(10), max_tokens=48,  # noqa: E731
+                                           rid=rid)
+            baseline = await _run_uninterrupted(dst_runner, mk())
+            src, dst, src_tokens, d_stream = await _drain_and_resume(
+                src_runner, dst_runner, mk)
+            assert src.stats.drain_handoff == 1
+            assert dst.stats.drain_resumed == 1
+            assert d_stream.finish == "length"
+            assert src_tokens + d_stream.tokens == baseline
+            # Zero re-prefilled tokens on the handoff path: the
+            # destination never ran a prefill pass for this request.
+            assert dst.stats.prefill_tokens == 0
+
+        run(body(), timeout=180)
+
+    def test_temperature_stream_survives_handoff(self, run, src_runner,
+                                                 dst_runner):
+        async def body():
+            mk = lambda rid=None: _request(range(16), max_tokens=48,  # noqa: E731
+                                           temperature=0.9, seed=123,
+                                           rid=rid)
+            baseline = await _run_uninterrupted(dst_runner, mk())
+            src, _dst, src_tokens, d_stream = await _drain_and_resume(
+                src_runner, dst_runner, mk)
+            assert src.stats.drain_handoff == 1
+            # Sampled continuation matching across the hop proves the
+            # (seed, step) fold-in keys continued, not restarted.
+            assert src_tokens + d_stream.tokens == baseline
+
+        run(body(), timeout=180)
+
+    def test_spec_active_stream_survives_handoff(self, run, monkeypatch):
+        monkeypatch.setenv("DYNT_SPEC_ENABLE", "1")
+        monkeypatch.setenv("DYNT_SPEC_MIN_EMA", "0")
+
+        async def body():
+            src_r = _runner()
+            if not getattr(src_r, "supports_spec", False):
+                pytest.skip("runner has no spec verification forward")
+            dst_r = _runner()
+            prompt = [5, 6, 7] * 6
+            mk = lambda rid=None: _request(prompt, max_tokens=48,  # noqa: E731
+                                           rid=rid)
+            baseline = await _run_uninterrupted(dst_r, mk())
+            src, _dst, src_tokens, d_stream = await _drain_and_resume(
+                src_r, dst_r, mk, tokens_before=4)
+            assert src.stats.drain_handoff == 1
+            assert src_tokens + d_stream.tokens == baseline
+
+        run(body(), timeout=300)
+
+
+class TestDrainLadder:
+    def test_waiting_and_processor_sequences_take_replay_rung(
+            self, run, src_runner):
+        """A handoff cannot carry live host-sampler state or a sequence
+        still waiting for admission: both emit the plain migrate the
+        Migration operator replays."""
+
+        async def body():
+            loop = asyncio.get_running_loop()
+            sched = InferenceScheduler(src_runner)
+            sched.start()
+            try:
+                proc = _Stream(loop)
+                req = _request(range(10), max_tokens=24)
+                # Live logits-processor state => handoff-ineligible.
+                req.sampling.repetition_penalty = 1.3
+                sched.submit(req, proc.emit)
+                await proc.take_tokens(2)
+                waiting = _Stream(loop)
+                # max_batch=2 on the module runner: fill the second slot
+                # and park one in the waiting list.
+                filler = _Stream(loop)
+                sched.submit(_request(range(20, 30), max_tokens=24),
+                             filler.emit)
+                sched.submit(_request(range(30, 40), max_tokens=8),
+                             waiting.emit)
+                q = sched.run_in_step(lambda: sched.drain_sweep(
+                    register_handoff=_gathering_registry(src_runner, {})))
+                report, exc = await asyncio.to_thread(q.get, True, 60)
+                assert exc is None
+                await proc.drain()
+                await waiting.drain()
+                await filler.drain()
+            finally:
+                sched.stop()
+            assert proc.finish == "migrate"
+            assert waiting.finish == "migrate"
+            assert req.request_id in report["replay"]
+            assert sched.stats.drain_replayed >= 2
+
+        run(body(), timeout=180)
+
+    def test_drain_expire_errors_remaining(self, run, src_runner):
+        async def body():
+            loop = asyncio.get_running_loop()
+            sched = InferenceScheduler(src_runner)
+            sched.start()
+            try:
+                s1 = _Stream(loop)
+                sched.submit(_request(range(10), max_tokens=64), s1.emit)
+                await s1.take_tokens(1)
+                q = sched.run_in_step(lambda: sched.drain_expire(
+                    "worker drain deadline exceeded"))
+                n, exc = await asyncio.to_thread(q.get, True, 60)
+                assert exc is None and n == 1
+                await s1.drain()
+            finally:
+                sched.stop()
+            assert s1.finish == "error"
+            assert "deadline" in (s1.outputs[-1].error or "")
+            assert sched.stats.drain_errored == 1
+
+        run(body(), timeout=180)
+
+    def test_draining_scheduler_bounces_new_arrivals(self, run,
+                                                     src_runner):
+        async def body():
+            loop = asyncio.get_running_loop()
+            sched = InferenceScheduler(src_runner)
+            sched.start()
+            try:
+                q = sched.run_in_step(lambda: sched.drain_sweep())
+                await asyncio.to_thread(q.get, True, 60)
+                raced = _Stream(loop)
+                sched.submit(_request(range(10), max_tokens=8),
+                             raced.emit)
+                await raced.drain()
+            finally:
+                sched.stop()
+            assert raced.finish == "migrate"
+            assert sched.stats.drain_bounced == 1
+
+        run(body(), timeout=180)
+
+    def test_handoff_pages_release_exactly_once(self, run):
+        """After the peer claims (or the deadline expires) the transfer,
+        release_transfer_pages returns the pool to its pre-request
+        state — the handoff owns the pages exactly once."""
+
+        async def body():
+            loop = asyncio.get_running_loop()
+            local = _runner(max_batch=1, num_pages=64)
+            sched = InferenceScheduler(local)
+            free0 = sched.pool.free_count() + sched.pool.cached_count()
+            sched.start()
+            seqs = {}
+
+            def register(seq, page_ids, computed):
+                seqs[seq.request.request_id] = seq
+                return {"transfer_id": seq.request.request_id,
+                        "handoff": {"seed": 0, "generated": [],
+                                    "prompt_len": seq.prompt_len}}
+
+            try:
+                s1 = _Stream(loop)
+                sched.submit(_request(range(10), max_tokens=48), s1.emit)
+                await s1.take_tokens(2)
+                q = sched.run_in_step(
+                    lambda: sched.drain_sweep(register_handoff=register))
+                report, exc = await asyncio.to_thread(q.get, True, 60)
+                assert exc is None and len(report["handoff"]) == 1
+                await s1.drain()
+                # The transfer's release hook (claim or expiry) frees
+                # the parked pages exactly once.
+                for seq in seqs.values():
+                    sched.release_transfer_pages(seq)
+                # Let the control queue drain (stop() joins the thread).
+            finally:
+                sched.stop()
+            assert (sched.pool.free_count() + sched.pool.cached_count()
+                    == free0)
+
+        run(body(), timeout=180)
+
+
+class _FakeScheduler:
+    """Duck-type surface DrainCoordinator drives, with a call ledger."""
+
+    class _Stats:
+        drain_bounced = 0
+
+    def __init__(self, live=1, transfers=None):
+        self.stats = self._Stats()
+        self.live = live
+        self.calls: list = []
+        self.transfers = transfers
+        self.draining = False
+
+    def run_in_step(self, fn):
+        q: thread_queue.Queue = thread_queue.Queue()
+        try:
+            q.put((fn(), None))
+        except Exception as exc:  # noqa: BLE001 — mirrors the real queue
+            q.put((None, exc))
+        return q
+
+    def drain_sweep(self, register_handoff=None):
+        self.draining = True
+        self.calls.append("sweep")
+        return {"handoff": ["h1"] if register_handoff else [],
+                "replay": ["r1"], "pending": []}
+
+    def drain_expire(self, reason):
+        self.calls.append("expire")
+        n, self.live = self.live, 0
+        return n
+
+    def queue_depth(self):
+        return (self.live, 0)
+
+
+class _FakeTransfers:
+    def __init__(self, sched, n=1):
+        self._sched = sched
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def expire_all(self):
+        self._sched.calls.append("expire_all")
+        n, self.n = self.n, 0
+        return n
+
+
+class _FakeWorker:
+    instance_id = 0xD12A1
+
+    def __init__(self, live=1, transfers_n=1):
+        self.scheduler = _FakeScheduler(live=live)
+        self.transfers = _FakeTransfers(self.scheduler, n=transfers_n)
+        self.announces = 0
+
+    async def announce_draining(self) -> None:
+        self.announces += 1
+        self.scheduler.calls.append("announce")
+
+    def register_drain_handoff(self, seq, page_ids, computed):
+        return {"transfer_id": "t"}
+
+
+class TestDrainCoordinator:
+    def test_ladder_ordering_and_deadline_rung(self, run):
+        """announce -> sweep -> (still busy at the deadline) ->
+        expire_all -> drain_expire, inside the budget."""
+        from dynamo_tpu.engine.drain import DrainCoordinator
+
+        async def body():
+            worker = _FakeWorker(live=2, transfers_n=3)
+            coord = DrainCoordinator(worker, deadline_secs=0.0)
+            report = await coord.drain("test")
+            assert worker.scheduler.calls == [
+                "announce", "sweep", "expire_all", "expire"]
+            assert report["handoff"] == ["h1"]
+            assert report["replay"] == ["r1"]
+            assert report["errored"] == 2
+            assert report["completed"] is False
+            assert coord.state == "drained"
+
+        run(body(), timeout=30)
+
+    def test_empty_worker_completes_without_expiry(self, run):
+        from dynamo_tpu.engine.drain import DrainCoordinator
+
+        async def body():
+            worker = _FakeWorker(live=0, transfers_n=0)
+            coord = DrainCoordinator(worker, deadline_secs=5.0)
+            report = await coord.drain("test")
+            assert worker.scheduler.calls == ["announce", "sweep"]
+            assert report["errored"] == 0
+            assert report["completed"] is True
+            assert report["duration_ms"] < 5000
+
+        run(body(), timeout=30)
+
+    def test_double_drain_is_idempotent(self, run):
+        """Double SIGTERM / a POST racing the signal: ONE ladder run,
+        both callers get the same report."""
+        from dynamo_tpu.engine.drain import DrainCoordinator
+
+        async def body():
+            worker = _FakeWorker(live=0, transfers_n=0)
+            coord = DrainCoordinator(worker, deadline_secs=5.0)
+            r1, r2 = await asyncio.gather(coord.drain("sigterm-1"),
+                                          coord.drain("sigterm-2"))
+            assert r1 is r2
+            assert worker.announces == 1
+            assert worker.scheduler.calls.count("sweep") == 1
+
+        run(body(), timeout=30)
+
+    def test_disable_knob_skips(self, run, monkeypatch):
+        monkeypatch.setenv("DYNT_DRAIN_ENABLE", "0")
+        from dynamo_tpu.engine.drain import DrainCoordinator
+
+        async def body():
+            worker = _FakeWorker()
+            coord = DrainCoordinator(worker, deadline_secs=5.0)
+            report = await coord.drain("test")
+            assert report.get("skipped") is True
+            assert worker.scheduler.calls == []
+
+        run(body(), timeout=30)
+
+    def test_handoff_knob_disables_rung_one(self, run, monkeypatch):
+        monkeypatch.setenv("DYNT_DRAIN_HANDOFF", "0")
+        from dynamo_tpu.engine.drain import DrainCoordinator
+
+        async def body():
+            worker = _FakeWorker(live=0, transfers_n=0)
+            coord = DrainCoordinator(worker)
+            report = await coord.drain("test")
+            # drain_sweep saw register_handoff=None: everything replays.
+            assert report["handoff"] == []
+
+        run(body(), timeout=30)
+
+
+class _ScriptedEngine:
+    """TokenEngine stand-in: each attempt pops the next script — a
+    callable(request) -> list[EngineOutput]."""
+
+    def __init__(self, scripts):
+        self.scripts = list(scripts)
+        self.requests: list = []
+
+    async def generate(self, request):
+        self.requests.append(request)
+        for out in self.scripts.pop(0)(request):
+            yield out
+
+
+class TestMigrationHandoff:
+    def _migration(self, inner):
+        from dynamo_tpu.llm.engine import Migration
+
+        return Migration(inner, migration_limit=3, cooperative_limit=3)
+
+    def test_handoff_redispatches_with_pull_route(self, run):
+        """A migrate frame carrying kv_transfer_params re-dispatches the
+        SAME request with disaggregated_params — no replay-into-prompt,
+        no re-prefill."""
+        params = {"transfer_id": "t1",
+                  "handoff": {"seed": 1, "generated": [11, 12],
+                              "prompt_len": 3}}
+
+        def attempt1(req):
+            return [EngineOutput(token_ids=[11], prompt_tokens=3),
+                    EngineOutput(token_ids=[12]),
+                    EngineOutput(finish_reason="migrate",
+                                 error="worker draining (kv handoff)",
+                                 kv_transfer_params=params)]
+
+        def attempt2(req):
+            return [EngineOutput(token_ids=[13]),
+                    EngineOutput(token_ids=[14], finish_reason="length")]
+
+        async def body():
+            inner = _ScriptedEngine([attempt1, attempt2])
+            engine = self._migration(inner)
+            request = _request([1, 2, 3], max_tokens=4)
+            outs = [o async for o in engine.generate(request)]
+            tokens = [t for o in outs for t in o.token_ids]
+            assert tokens == [11, 12, 13, 14]
+            assert outs[-1].finish_reason == "length"
+            second = inner.requests[1]
+            assert second.disaggregated_params == params
+            # Same prompt — the resume state rides the params, the
+            # replay rung's token-extension did NOT run.
+            assert list(second.token_ids) == [1, 2, 3]
+            assert second.sampling.max_tokens == 4
+
+        run(body(), timeout=30)
+
+    def test_failed_pull_degrades_to_replay_rung(self, run):
+        """Destination bounces the handoff (pull failed) with a PLAIN
+        migrate: the next attempt replays prompt+generated with no
+        disaggregated_params."""
+        params = {"transfer_id": "t1",
+                  "handoff": {"seed": 1, "generated": [11],
+                              "prompt_len": 3}}
+
+        def attempt1(req):
+            return [EngineOutput(token_ids=[11]),
+                    EngineOutput(finish_reason="migrate",
+                                 error="worker draining (kv handoff)",
+                                 kv_transfer_params=params)]
+
+        def attempt2(req):
+            return [EngineOutput(finish_reason="migrate",
+                                 error="drain handoff pull failed; "
+                                       "replay")]
+
+        def attempt3(req):
+            return [EngineOutput(token_ids=[12], prompt_tokens=4),
+                    EngineOutput(token_ids=[13],
+                                 finish_reason="length")]
+
+        async def body():
+            inner = _ScriptedEngine([attempt1, attempt2, attempt3])
+            engine = self._migration(inner)
+            request = _request([1, 2, 3], max_tokens=3)
+            outs = [o async for o in engine.generate(request)]
+            tokens = [t for o in outs for t in o.token_ids]
+            assert tokens == [11, 12, 13]
+            third = inner.requests[2]
+            assert third.disaggregated_params is None
+            # Replay rung: the already-generated token is embedded in
+            # the prompt and billed exactly once.
+            assert list(third.token_ids) == [1, 2, 3, 11]
+            assert third.prior_output_tokens == [11]
+            prompt_frames = [o.prompt_tokens for o in outs
+                             if o.prompt_tokens is not None]
+            assert prompt_frames == [3]  # 4 - len(prior)
+
+        run(body(), timeout=30)
+
+    def test_replay_preserves_priority_and_tenant(self, run):
+        """The replay construction must not strip QoS identity — a
+        replayed batch request sneaking back in as "standard" would
+        jump the class-strict queues."""
+        from dynamo_tpu.runtime.request_plane import ConnectionLost
+
+        def attempt1(req):
+            raise ConnectionLost("boom")
+            yield  # pragma: no cover
+
+        def attempt2(req):
+            return [EngineOutput(token_ids=[9], finish_reason="length")]
+
+        async def body():
+            inner = _ScriptedEngine([attempt1, attempt2])
+            engine = self._migration(inner)
+            request = _request([1, 2], max_tokens=1)
+            request.priority = "batch"
+            request.tenant = "acme"
+            outs = [o async for o in engine.generate(request)]
+            assert [t for o in outs for t in o.token_ids] == [9]
+            second = inner.requests[1]
+            assert second.priority == "batch"
+            assert second.tenant == "acme"
+
+        run(body(), timeout=30)
+
+    def test_handoff_hops_do_not_consume_cooperative_budget(self, run):
+        """A rolling restart hops a long stream once per departing
+        worker — clean KV handoffs must NOT burn the cooperative
+        replay bound (limit 3 here), or hop 4 of a healthy fleet's
+        restart kills the stream with a spurious error."""
+        params = {"transfer_id": "t1",
+                  "handoff": {"seed": 1, "generated": [11],
+                              "prompt_len": 3}}
+
+        def hop(token):
+            def _attempt(req):
+                return [EngineOutput(token_ids=[token]),
+                        EngineOutput(finish_reason="migrate",
+                                     error="worker draining (kv handoff)",
+                                     kv_transfer_params=params)]
+            return _attempt
+
+        def final(req):
+            return [EngineOutput(token_ids=[19],
+                                 finish_reason="length")]
+
+        async def body():
+            # 6 handoff hops > cooperative_limit=3, then completion.
+            inner = _ScriptedEngine(
+                [hop(11 + i) for i in range(6)] + [final])
+            engine = self._migration(inner)
+            request = _request([1, 2, 3], max_tokens=16)
+            outs = [o async for o in engine.generate(request)]
+            assert [o.finish_reason for o in outs if o.finish_reason] \
+                == ["length"]
+            assert not any(o.finish_reason == "error" for o in outs)
+            tokens = [t for o in outs for t in o.token_ids]
+            assert tokens == [11, 12, 13, 14, 15, 16, 19]
+
+        run(body(), timeout=30)
+
+    def test_handoff_and_replay_drop_gateway_pin(self, run):
+        """A gateway pin (EPP target_instance annotation) targets the
+        departing worker; every routed mode vetoes unavailable explicit
+        targets, so a surviving pin would burn the whole migration
+        budget re-dialing the vacated worker. Both re-dispatch legs
+        must strip it (and nothing else)."""
+        from dynamo_tpu.runtime.request_plane import ConnectionLost
+
+        params = {"transfer_id": "t1",
+                  "handoff": {"seed": 1, "generated": [11],
+                              "prompt_len": 3}}
+
+        def attempt1(req):
+            return [EngineOutput(token_ids=[11]),
+                    EngineOutput(finish_reason="migrate",
+                                 error="worker draining (kv handoff)",
+                                 kv_transfer_params=params)]
+
+        def attempt2(req):
+            raise ConnectionLost("boom")
+            yield  # pragma: no cover
+
+        def attempt3(req):
+            return [EngineOutput(token_ids=[12],
+                                 finish_reason="length")]
+
+        async def body():
+            inner = _ScriptedEngine([attempt1, attempt2, attempt3])
+            engine = self._migration(inner)
+            request = _request([1, 2, 3], max_tokens=2)
+            request.annotations = {"target_instance": "2a",
+                                   "traceparent": "00-ab-cd-01"}
+            outs = [o async for o in engine.generate(request)]
+            assert [t for o in outs for t in o.token_ids] == [11, 12]
+            # Handoff leg: pin gone, trace context kept.
+            second = inner.requests[1]
+            assert "target_instance" not in (second.annotations or {})
+            assert second.annotations["traceparent"] == "00-ab-cd-01"
+            # Replay leg (failed pull -> ConnectionLost): same contract.
+            third = inner.requests[2]
+            assert "target_instance" not in (third.annotations or {})
+            assert third.annotations["traceparent"] == "00-ab-cd-01"
+
+        run(body(), timeout=30)
+
+
+class TestDrainStateGauge:
+    def test_serving_stamped_at_start_and_transitions(self):
+        """Workers stamp dynamo_drain_state=0 at START (the coordinator
+        is built lazily on the first drain, so the stamp is the only
+        source of the documented serving sample — absence must mean
+        'not scraped', never 'healthy'); the ladder then walks it
+        0 -> 1 -> 2."""
+        from dynamo_tpu.engine import drain
+        from dynamo_tpu.runtime import metrics
+
+        def gauge_line():
+            out = metrics.render()
+            text = out.decode() if isinstance(out, bytes) else out
+            return [l for l in text.splitlines()
+                    if l.startswith('dynamo_drain_state{worker="77b"}')]
+
+        drain.set_drain_state(0x77B, drain.SERVING)
+        assert gauge_line() == ['dynamo_drain_state{worker="77b"} 0.0']
+        drain.set_drain_state(0x77B, drain.DRAINING)
+        assert gauge_line() == ['dynamo_drain_state{worker="77b"} 1.0']
+        drain.set_drain_state(0x77B, drain.DRAINED)
+        assert gauge_line() == ['dynamo_drain_state{worker="77b"} 2.0']
+
+
+class TestDrainControlVerb:
+    def test_shutdown_survives_early_stream_close(self, run):
+        """body.shutdown=true must resolve the process shutdown event
+        even when the caller closes the stream as soon as the report
+        frame lands (GeneratorExit at the yield) — the drain already
+        ran and the worker is terminally out of routing, so losing the
+        signal strands a vacated process."""
+        from dynamo_tpu.engine.worker import TpuWorker
+        from dynamo_tpu.runtime import signals
+
+        class _Stub:
+            async def drain(self, reason="control"):
+                return {"completed": True}
+
+        async def body():
+            ev = signals._shutdown_event()
+            ev.clear()
+            gen = TpuWorker._drain_endpoint(_Stub(), {"shutdown": True})
+            report = await gen.__anext__()
+            assert report["completed"] is True
+            await gen.aclose()  # caller hangs up after the report
+            assert ev.is_set()
+            ev.clear()
+
+        run(body(), timeout=30)
+
+    def test_drain_http_knob_removes_verb(self, run, monkeypatch):
+        """DYNT_DRAIN_HTTP=0: the status server keeps its read-only
+        surface but never mounts the mutating POST /drain — the verb is
+        unauthenticated and terminal, so deployments exposing the
+        status port beyond their operators can turn it off."""
+        monkeypatch.setenv("DYNT_DRAIN_HTTP", "0")
+        import aiohttp
+
+        from dynamo_tpu.runtime.status import SystemStatusServer
+
+        async def body():
+            srv = SystemStatusServer(port=0, host="127.0.0.1")
+
+            async def _drainer():
+                raise AssertionError("must be unreachable")
+
+            srv.register_drain(_drainer)
+            await srv.start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/drain") as r:
+                        assert r.status in (404, 405)
+                    async with s.get(f"{base}/live") as r:
+                        assert r.status == 200
+            finally:
+                await srv.close()
+
+        run(body(), timeout=30)
+
+
+class TestRouterInvisibility:
+    def test_draining_worker_excluded_from_selection(self, run,
+                                                     mem_runtime_config):
+        """set_draining removes an instance from every selection mode;
+        deregistration (delete) clears the mark."""
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.push_router import PushRouter
+
+        async def body():
+            rt = await DistributedRuntime(mem_runtime_config()).start()
+            try:
+                ep = rt.namespace("drz").component("w").endpoint("gen")
+
+                async def handler(req, ctx=None):
+                    yield {"ok": True}
+
+                served1 = await ep.serve_endpoint(handler, instance_id=1)
+                served2 = await ep.serve_endpoint(handler, instance_id=2)
+                client = ep.client()
+                await client.wait_for_instances(2, timeout=5.0)
+                router = PushRouter(client, mode="round_robin")
+                assert sorted(router.available()) == [1, 2]
+                assert router.set_draining(1, True) is True
+                # Transition reported exactly once (per-tick dedup).
+                assert router.set_draining(1, True) is False
+                assert router.available() == [2]
+                # Every dispatch now lands on the survivor.
+                for _ in range(4):
+                    outs = [o async for o in router.generate({"x": 1})]
+                    assert outs == [{"ok": True}]
+                # Deregistration clears the mark (a RESTARTED worker at
+                # the same id starts clean).
+                await served1.shutdown()
+                router._on_instance_change("delete", {"instance_id": 1})
+                assert 1 not in router._draining
+                await served2.shutdown()
+            finally:
+                await rt.shutdown()
+
+        run(body(), timeout=60)

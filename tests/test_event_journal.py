@@ -230,6 +230,280 @@ class TestJournalTransport:
         run(body())
 
 
+class TestJournalIntegrity:
+    """Per-frame CRC32 + skip-to-next-valid-frame resync: corrupt frames
+    (the faults service's corrupt_file shapes — flipped bytes, garbage
+    appends, zero-fill holes) must not wedge replay; each skip is
+    counted (dynamo_journal_bad_frames_total) and followed by ONE
+    synthetic journal-resync event so derived state re-dumps instead of
+    silently diverging."""
+
+    def test_read_frames_unit_tier(self):
+        """Pure-function tier over _journal_read: valid/corrupt/valid,
+        torn tail held, garbage tail consumed to EOF exactly once."""
+        from dynamo_tpu.runtime.events import _journal_read
+
+        f1 = _journal_pack("t", {"i": 1})
+        f2 = _journal_pack("t", {"i": 2})
+        f3 = _journal_pack("t", {"i": 3})
+
+        def read(buf):
+            bad = [0]
+            out = list(_journal_read(buf, 0, lambda k: bad.__setitem__(
+                0, bad[0] + k)))
+            return out, bad[0]
+
+        # clean
+        out, bad = read(f1 + f2)
+        assert [(t, p) for _o, t, p in out] == [("t", {"i": 1}),
+                                                ("t", {"i": 2})]
+        assert bad == 0
+        # corrupt middle frame: flip a body byte of f2
+        broken = bytearray(f1 + f2 + f3)
+        broken[len(f1) + 12] ^= 0xFF
+        out, bad = read(bytes(broken))
+        assert [p["i"] for _o, _t, p in out if p] == [1, 3]
+        assert bad == 1
+        # torn tail: held for the next poll, not counted
+        out, bad = read(f1 + f2[: len(f2) // 2])
+        assert [p["i"] for _o, _t, p in out if p] == [1]
+        assert bad == 0
+        # garbage tail: consumed via the sentinel, counted once. The
+        # consumed span stops IN FRONT of the first byte run that could
+        # still be a frame prefix (the last <8 header bytes always
+        # qualify) — never all the way to EOF past a potential frame.
+        garbage = b"\x07garbage-no-frame-here\xff\xfe"
+        out, bad = read(f1 + garbage)
+        assert out[-1][1] is None  # sentinel
+        assert len(f1) < out[-1][0] <= len(f1 + garbage)
+        assert bad == 1
+        # corrupt frame followed by a TORN VALID frame: the consumed
+        # garbage must stop before the torn frame's start — eating its
+        # prefix would make the remainder parse as garbage on the next
+        # poll and cascade the loss across every later frame.
+        broken2 = bytearray(f1 + f2 + f3[: len(f3) - 5])
+        broken2[len(f1) + 12] ^= 0xFF  # corrupt f2's body
+        out, bad = read(bytes(broken2))
+        assert [p["i"] for _o, _t, p in out if p] == [1]
+        assert bad == 1
+        consumed = out[-1][0]
+        assert consumed <= len(f1 + f2)  # f3's prefix survives
+        # next poll from `consumed` with the append finished: f3 parses
+        full = bytes(broken2) + f3[len(f3) - 5:]
+        out2, bad2 = read(full[consumed:])
+        assert [p["i"] for _o, _t, p in out2 if p] == [3]
+
+    def test_flipped_byte_skips_frame_and_signals_resync(self, run,
+                                                         tmp_path):
+        from dynamo_tpu.runtime.events import JOURNAL_RESYNC_TOPIC
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            for i in range(5):
+                await pub.publish("kv_events", {"i": i})
+            path = pub._path()
+            buf = bytearray(open(path, "rb").read())
+            # Flip one byte inside the SECOND frame's body (frames
+            # start after the 8-byte format-magic preamble).
+            from dynamo_tpu.runtime.events import _JOURNAL_MAGIC
+
+            first = len(_JOURNAL_MAGIC)
+            (length0,) = struct.unpack_from(">I", buf, first)
+            second = first + 8 + length0
+            buf[second + 12] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(buf)
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 5)
+            kv = [p["i"] for t, p in events if t == "kv_events"]
+            resync = [p for t, p in events
+                      if t == JOURNAL_RESYNC_TOPIC]
+            assert kv == [0, 2, 3, 4]  # frame 1 skipped, replay not wedged
+            assert len(resync) == 1 and resync[0]["skipped"] == 1
+            assert mgr.bad_frames == 1
+            # Live tail still flows after the skip.
+            await pub.publish("kv_events", {"i": 9})
+            more = await _drain(sub, 1)
+            assert [p["i"] for _t, p in more] == [9]
+            # The skip was counted once, not once per poll.
+            assert mgr.bad_frames == 1
+            await mgr.close()
+            await pub.close()
+
+        run(body())
+
+    def test_garbage_tail_then_fresh_appends_resume(self, run, tmp_path):
+        """The generation-boundary fallback: when nothing valid remains
+        after the corruption, the reader consumes to EOF so the
+        publisher's NEXT appends land on a clean boundary and flow."""
+        from dynamo_tpu.runtime.events import JOURNAL_RESYNC_TOPIC
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("kv_events", {"i": 0})
+            await pub.publish("kv_events", {"i": 1})
+            with open(pub._path(), "ab") as f:
+                f.write(b'{"torn-frame\x00\xff' + b"\xa5" * 48)
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns", "",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 3)
+            assert [p["i"] for t, p in events
+                    if t == "kv_events"] == [0, 1]
+            assert any(t == JOURNAL_RESYNC_TOPIC for t, _p in events)
+            for i in (2, 3):
+                await pub.publish("kv_events", {"i": i})
+            more = await _drain(sub, 2)
+            assert [p["i"] for _t, p in more] == [2, 3]
+            await mgr.close()
+            await pub.close()
+
+        run(body())
+
+    def test_zero_fill_hole_skipped(self, run, tmp_path):
+        """A zero-filled sparse hole (truncate-then-append crash shape)
+        parses as (length=0, crc=0) with a CRC-passing empty body — it
+        must still count as corruption, not as frames."""
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("kv_events", {"i": 0})
+            with open(pub._path(), "ab") as f:
+                f.write(b"\x00" * 64)
+            await pub.publish("kv_events", {"i": 1})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 2)
+            assert [p["i"] for t, p in events
+                    if t == "kv_events"] == [0, 1]
+            assert mgr.bad_frames >= 1
+            await mgr.close()
+            await pub.close()
+
+        run(body())
+
+    def test_corrupt_length_field_does_not_wedge(self, run, tmp_path):
+        """A flipped length byte turns a frame into an ever-growing
+        'partial'; with valid frames beyond it the reader must skip to
+        them instead of waiting for a tail that never completes."""
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("kv_events", {"i": 0})
+            # Header claims 4000 bytes; only junk follows.
+            with open(pub._path(), "ab") as f:
+                f.write(struct.pack(">II", 4000, 0xDEAD) + b"\x42" * 37)
+            await pub.publish("kv_events", {"i": 1})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 2)
+            assert [p["i"] for t, p in events
+                    if t == "kv_events"] == [0, 1]
+            assert mgr.bad_frames >= 1
+            await mgr.close()
+            await pub.close()
+
+        run(body())
+
+    def test_transient_format_cache_loss_keeps_crc(self, run, tmp_path):
+        """A transient read error (ESTALE over NFS) pops the cached
+        format verdict while the reader's position stays mid-file. The
+        next successful poll must re-derive "crc" from the offset-0
+        preamble — inferring "legacy" from the nonzero offset would
+        permanently misparse every later frame as corruption."""
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            for i in range(3):
+                await pub.publish("kv_events", {"i": i})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 3)
+            assert [p["i"] for t, p in events
+                    if t == "kv_events"] == [0, 1, 2]
+            # Simulate the OSError cleanup path: verdict dropped,
+            # position (gen, offset>0) untouched.
+            mgr._formats.clear()
+            await pub.publish("kv_events", {"i": 3})
+            more = await _drain(sub, 1)
+            assert [p["i"] for _t, p in more] == [3]
+            assert mgr.bad_frames == 0  # no false legacy-parse alarm
+            await mgr.close()
+            await pub.close()
+
+        run(body())
+
+    def test_bad_frame_accounting_commits_with_position(self, run,
+                                                        tmp_path):
+        """Corruption accounting is deferred to the scan's position
+        commit: a poll whose newest-generation read transiently fails
+        re-reads the same corrupt frames next tick, and counting inside
+        _read_frames would double-bump dynamo_journal_bad_frames_total
+        for one on-disk corruption."""
+
+        async def body():
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            for i in range(3):
+                await pub.publish("kv_events", {"i": i})
+            path = pub._path()
+            buf = bytearray(open(path, "rb").read())
+            from dynamo_tpu.runtime.events import _JOURNAL_MAGIC
+
+            first = len(_JOURNAL_MAGIC)
+            (length0,) = struct.unpack_from(">I", buf, first)
+            buf[first + 8 + length0 + 12] ^= 0xFF  # second frame's body
+            with open(path, "wb") as f:
+                f.write(buf)
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events")
+            name = os.path.basename(path)[: -len(".log")]
+            pid, gen_s = name.rsplit(".g", 1)
+            out1: list = []
+            bad1: list = []
+            mgr._read_frames(pid, int(gen_s), 0, out1, bad1)
+            out2: list = []
+            bad2: list = []
+            mgr._read_frames(pid, int(gen_s), 0, out2, bad2)
+            assert bad1 and bad2  # both reads saw the corrupt frame
+            assert mgr.bad_frames == 0  # neither committed anything
+            mgr._commit_bad_frames(bad2)
+            assert mgr.bad_frames == 1  # counted once, at commit
+            await pub.close()
+
+        run(body())
+
+    def test_resync_event_triggers_indexer_redump(self, run):
+        """The standalone indexer reacts to a journal-resync event by
+        re-dumping EVERY known worker — lost frames carry no per-worker
+        gap to flag them."""
+        from dynamo_tpu.indexer import StandaloneIndexer
+        from dynamo_tpu.runtime.events import JOURNAL_RESYNC_TOPIC
+
+        async def body():
+            idx = StandaloneIndexer(runtime=None)
+            idx._worker_subjects = {7: ("ns", "c"), 9: ("ns", "c")}
+            called = []
+            idx._schedule_resync = called.append
+
+            async def sub():
+                yield (JOURNAL_RESYNC_TOPIC,
+                       {"publisher": "p", "generation": 0, "skipped": 2})
+
+            await idx._event_loop(sub())
+            assert sorted(called) == [7, 9]
+
+        run(body())
+
+
 # ---------------------------------------------------------------------------
 # E2E: two router replicas over the journal; one restarts under traffic
 # ---------------------------------------------------------------------------
@@ -360,3 +634,41 @@ class TestRouterReplicaRestart:
                 await r.shutdown()
 
         run(body(), timeout=180)
+
+
+class TestJournalFormatUpgrade:
+    def test_legacy_pre_crc_journal_replays_not_corrupt_skipped(
+            self, run, tmp_path):
+        """A journal written by the pre-CRC format ([len][body] frames,
+        no magic preamble) must replay through the legacy parser on
+        upgrade — NOT be discarded as wall-to-wall CRC corruption with
+        a false storage-corruption alarm (bad_frames must stay 0)."""
+        import msgpack
+
+        def legacy_pack(topic, payload):
+            body = msgpack.packb({"t": topic, "p": payload},
+                                 use_bin_type=True)
+            return struct.pack(">I", len(body)) + body
+
+        async def body():
+            ns = os.path.join(str(tmp_path), "ns")
+            os.makedirs(ns)
+            # Pre-upgrade history from a dead publisher: no magic.
+            with open(os.path.join(ns, "oldpub.g0.log"), "wb") as f:
+                for i in range(4):
+                    f.write(legacy_pack("kv_events", {"i": i}))
+            # Post-upgrade publisher in the same dir: CRC format.
+            pub = JournalEventPublisher(str(tmp_path), "ns")
+            await pub.publish("kv_events", {"i": 100})
+            mgr = JournalEventSubscriberManager(str(tmp_path), "ns",
+                                                "kv_events",
+                                                poll_interval=0.02)
+            sub = await mgr.start()
+            events = await _drain(sub, 5)
+            got = sorted(p["i"] for t, p in events if t == "kv_events")
+            assert got == [0, 1, 2, 3, 100]
+            assert mgr.bad_frames == 0  # upgrade is not corruption
+            await mgr.close()
+            await pub.close()
+
+        run(body())

@@ -107,6 +107,66 @@ async def _echo_server():
     return server, server.sockets[0].getsockname()[1]
 
 
+class TestEvictScenario:
+    """The GCE spot-preemption shape (docs/fault-tolerance.md departure
+    ladder): SIGTERM notice -> deadline hold -> SIGKILL only if the
+    target did not drain and exit inside the notice."""
+
+    def test_graceful_exit_inside_notice_skips_sigkill(self, run):
+        import subprocess
+
+        # A well-behaved drainer: exits promptly on SIGTERM.
+        proc = subprocess.Popen([
+            sys.executable, "-c",
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+            "time.sleep(60)"])
+        try:
+            time.sleep(0.2)  # let the handler install
+
+            async def body():
+                async with fault_service() as faults:
+                    await faults.register("drainer", proc.pid)
+                    out = await faults.run_scenario(
+                        "evict", target="drainer", deadline_ms=5000)
+                    kinds = [s["type"] for s in out["steps"]]
+                    assert kinds == ["sigterm", "evict"]  # no kill step
+                    assert out["steps"][-1]["detail"]["graceful"] is True
+                    proc.wait(timeout=10)
+                    assert not _alive(proc.pid)
+
+            run(body(), timeout=30)
+        finally:
+            if _alive(proc.pid):
+                proc.kill()
+
+    def test_sigterm_ignorer_gets_sigkill_at_deadline(self, run):
+        import subprocess
+
+        proc = subprocess.Popen([
+            sys.executable, "-c",
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "time.sleep(60)"])
+        try:
+            time.sleep(0.2)
+
+            async def body():
+                async with fault_service() as faults:
+                    await faults.register("stubborn", proc.pid)
+                    out = await faults.run_scenario(
+                        "evict", target="stubborn", deadline_ms=300)
+                    kinds = [s["type"] for s in out["steps"]]
+                    assert kinds == ["sigterm", "kill", "evict"]
+                    assert out["steps"][-1]["detail"]["graceful"] is False
+                    proc.wait(timeout=10)
+
+            run(body(), timeout=30)
+        finally:
+            if _alive(proc.pid):
+                proc.kill()
+
+
 class TestDelayHeal:
     def test_delay_adds_latency_and_heal_closes_listener(self, run):
         async def body():

@@ -291,3 +291,82 @@ class TestMockerPreemption:
             assert engine.preempt_parked == 0
 
         run(body())
+
+
+class TestMockerDoubleDrain:
+    def test_rolling_restart_handoff_chain_stays_bit_identical(self, run):
+        """Rolling restart: a stream handed off A->B must survive a
+        SECOND drain B->C with its FULL committed history — B inherits
+        the handed-off tokens as delivered, so B's own handoff frame
+        ships inherited + locally-delivered tokens, and C's
+        continuation matches an undrained run byte-for-byte."""
+
+        async def body():
+            prompt = list(range(40))
+            # Undrained oracle: one engine, straight through.
+            oracle_engine = MockerEngine(_fast_config(speedup_ratio=50.0))
+            oracle = [t for o in [EngineOutput.from_wire(w) async for w in
+                                  oracle_engine.generate(
+                                      _request(prompt, 24, "oracle"))]
+                      for t in o.token_ids]
+            await oracle_engine.close()
+            assert len(oracle) == 24
+
+            async def drain_mid_stream(engine, req, min_delivered):
+                outs = []
+
+                async def consume():
+                    async for w in engine.generate(req):
+                        outs.append(EngineOutput.from_wire(w))
+
+                task = asyncio.create_task(consume())
+                for _ in range(400):
+                    await asyncio.sleep(0.005)
+                    seqs = list(engine._running)
+                    if seqs and seqs[0].delivered >= min_delivered:
+                        break
+                engine.drain_sweep()
+                await task
+                assert outs[-1].finish_reason == "migrate"
+                params = outs[-1].kv_transfer_params
+                assert params and params.get("handoff") is not None
+                tokens = [t for o in outs for t in o.token_ids]
+                return tokens, params
+
+            # Hop 1: engine A drains mid-decode.
+            a = MockerEngine(_fast_config(speedup_ratio=2.0))
+            got_a, params_a = await drain_mid_stream(
+                a, _request(prompt, 24, "roll"), min_delivered=4)
+            await a.close()
+            assert got_a == params_a["handoff"]["generated"]
+
+            # Hop 2: engine B resumes from A's frame, then drains too.
+            # The Migration handoff re-dispatches the SAME request (the
+            # total budget; the destination counts generated from the
+            # inherited history), only swapping in the pull params.
+            req_b = PreprocessedRequest(
+                request_id="roll", token_ids=list(prompt),
+                sampling=SamplingOptions(max_tokens=24),
+                stop=StopConditions(),
+                disaggregated_params=params_a).to_wire()
+            b = MockerEngine(_fast_config(speedup_ratio=2.0))
+            got_b, params_b = await drain_mid_stream(
+                b, req_b, min_delivered=len(got_a) + 4)
+            await b.close()
+            # B's handoff frame must carry inherited + local history.
+            assert params_b["handoff"]["generated"] == got_a + got_b
+
+            # Hop 3: engine C finishes the stream.
+            req_c = PreprocessedRequest(
+                request_id="roll", token_ids=list(prompt),
+                sampling=SamplingOptions(max_tokens=24),
+                stop=StopConditions(),
+                disaggregated_params=params_b).to_wire()
+            c = MockerEngine(_fast_config(speedup_ratio=50.0))
+            got_c = [t for o in [EngineOutput.from_wire(w) async for w in
+                                 c.generate(req_c)]
+                     for t in o.token_ids]
+            await c.close()
+            assert got_a + got_b + got_c == oracle
+
+        run(body())
