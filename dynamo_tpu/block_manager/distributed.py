@@ -246,8 +246,13 @@ class DistributedKvbm:
             log.exception("distributed onboard failed (%d blocks)",
                           len(hashes))
             return False
-        self.stats.onboarded_blocks += len(hashes)
-        self.stats.onboard_hits_host += len(hashes)
+        # Under _lock like usage()'s reads and the leader thread's
+        # offloaded increment: onboard_direct runs on the scheduler
+        # thread, and dataclass `+=` is a read-modify-write
+        # (tests/test_interleave.py::test_distributed_stats_lost_update).
+        with self._lock:
+            self.stats.onboarded_blocks += len(hashes)
+            self.stats.onboard_hits_host += len(hashes)
         return True
 
     # -- leader offload loop ----------------------------------------------
@@ -306,7 +311,8 @@ class DistributedKvbm:
             if exc is not None:
                 raise exc
             kept = result
-        self.stats.offloaded += len(kept)
+        with self._lock:
+            self.stats.offloaded += len(kept)
 
     # -- introspection / lifecycle ----------------------------------------
 

@@ -238,7 +238,10 @@ class KvBlockManager:
                 # Copy out under the lock: arena reads are views, and the
                 # offload thread may recycle the slot after we release.
                 out[i] = data
-        self.stats.onboarded_blocks += len(hashes)
+            # Still under _lock: the offload thread bumps stats.offloaded
+            # through _offload_sink concurrently and `+=` on the shared
+            # stats object is a read-modify-write.
+            self.stats.onboarded_blocks += len(hashes)
         return out
 
     # -- preempt park store (docs/multi-tenancy.md) ------------------------
@@ -409,7 +412,7 @@ class KvBlockManager:
             }
             if self.offload is not None:
                 info["offload_queue"] = self.offload.queue_depth()
-                info["offload_dropped"] = self.offload.dropped
+                info["offload_dropped"] = self.offload.dropped_count()
             if self.disk is not None:
                 info["g3_blocks"] = len(self.disk)
                 info["g3_usage"] = self.disk.usage()

@@ -140,6 +140,13 @@ class OffloadManager:
         with self._cond:
             return len(self._pending)
 
+    def dropped_count(self) -> int:
+        """Locked read of the dropped counter for cross-thread callers
+        (manager.usage() runs on the scheduler/loop side while the
+        worker thread increments)."""
+        with self._cond:
+            return self.dropped
+
     # -- worker thread -----------------------------------------------------
 
     def _loop(self) -> None:
@@ -227,7 +234,14 @@ class OffloadManager:
                 inflight[1].set()
             lost = len(batch) - acct[0]
             if lost > 0:
-                self.dropped += lost
+                # Under _cond like every other `dropped` touch: the
+                # scheduler thread reads the counter through
+                # dropped_count() while this worker-thread increment
+                # lands, and `+=` is a read-modify-write (lost-update
+                # race reproduced by tests/test_interleave.py::
+                # test_offload_dropped_counter_lost_update).
+                with self._cond:
+                    self.dropped += lost
                 KVBM_OFFLOAD_DROPPED.inc(lost)
                 log.warning("offload batch failed mid-way; %d block(s) "
                             "dropped (counted)", lost)

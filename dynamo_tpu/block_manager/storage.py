@@ -22,6 +22,7 @@ blocks move between tiers with plain slab copies and no re-layout.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -394,6 +395,9 @@ class ObjectStore:
         self.backoff = backoff
         self.retried_ops = 0
         self.corrupt_reads = 0
+        # G4 is hit from the scheduler (onboard), prefetch, and offload
+        # threads at once; the health counters are read-modify-write.
+        self._stats_lock = threading.Lock()
 
     def _key(self, h: int) -> str:
         # Keys carry the block-hash scheme version: a hash-function change
@@ -416,7 +420,8 @@ class ObjectStore:
             except TransientStorageError as exc:
                 last = exc
                 if attempt < self.retries:
-                    self.retried_ops += 1
+                    with self._stats_lock:
+                        self.retried_ops += 1
                     time.sleep(self.backoff * (2 ** attempt))
         raise last  # type: ignore[misc]
 
@@ -462,7 +467,8 @@ class ObjectStore:
             # bytes into a bf16 arena would onboard garbage KV): treat
             # as a MISS — the caller falls back to prefill compute —
             # and drop the bad blob so it cannot keep poisoning reads.
-            self.corrupt_reads += 1
+            with self._stats_lock:
+                self.corrupt_reads += 1
             try:
                 self.client.delete(self._key(h))
             except Exception:  # noqa: BLE001 — best-effort cleanup

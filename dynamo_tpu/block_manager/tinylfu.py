@@ -13,6 +13,8 @@ flushing hot shared prefixes out of host/disk tiers.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 _SEED_MIX = (0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9, 0x94D049BB133111EB,
@@ -31,6 +33,13 @@ class TinyLfu:
         self._doorkeeper: set[int] = set()
         self._sample_size = max(16, capacity * sample_factor)
         self._touches = 0
+        # One instance serves several execution domains (the kv_router
+        # indexer is touched from the event-apply loop and lookups; tier
+        # pools touch from scheduler and prefetch threads), and a touch
+        # is a multi-step read-modify-write over sketch + doorkeeper +
+        # sample counter. The sketch lock is uncontended in the common
+        # case and keeps a concurrent _reset_sample from tearing it.
+        self._sketch_lock = threading.Lock()
 
     def _rows(self, h: int) -> list[int]:
         h &= (1 << 64) - 1
@@ -42,28 +51,31 @@ class TinyLfu:
 
     def touch(self, h: int) -> None:
         """Record one access."""
-        self._touches += 1
-        if h not in self._doorkeeper:
-            self._doorkeeper.add(h)
-        else:
-            for row, idx in enumerate(self._rows(h)):
-                if self._counters[row, idx] < 15:
-                    self._counters[row, idx] += 1
-        if self._touches >= self._sample_size:
-            self._reset_sample()
+        with self._sketch_lock:
+            self._touches += 1
+            if h not in self._doorkeeper:
+                self._doorkeeper.add(h)
+            else:
+                for row, idx in enumerate(self._rows(h)):
+                    if self._counters[row, idx] < 15:
+                        self._counters[row, idx] += 1
+            if self._touches >= self._sample_size:
+                self._reset_sample()
 
     def _reset_sample(self) -> None:
         # Halve counters + clear doorkeeper: ages out stale popularity.
+        # Caller holds self._sketch_lock.
         self._counters >>= 1
         self._doorkeeper.clear()
         self._touches = 0
 
     def estimate(self, h: int) -> int:
-        est = min(int(self._counters[row, idx])
-                  for row, idx in enumerate(self._rows(h)))
-        if h in self._doorkeeper:
-            est += 1
-        return est
+        with self._sketch_lock:
+            est = min(int(self._counters[row, idx])
+                      for row, idx in enumerate(self._rows(h)))
+            if h in self._doorkeeper:
+                est += 1
+            return est
 
     def admit(self, candidate: int, victim: int) -> bool:
         """Should `candidate` displace `victim`? (>= so fresh blocks with

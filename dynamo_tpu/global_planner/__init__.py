@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import threading
 import time
 from typing import AsyncIterator, Optional
 
@@ -48,16 +49,22 @@ class PoolState:
     # worker instance -> (latest LoadMetrics, monotonic receipt time)
     workers: dict[int, tuple[LoadMetrics, float]] = dataclasses.field(
         default_factory=dict)
+    # record() fires from metric-subscription callbacks while pressure()
+    # iterates-and-prunes on the planner tick; concurrent mutation during
+    # iteration raises RuntimeError, so both take the lock.
+    _lock: "threading.Lock" = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
 
     def record(self, metrics: LoadMetrics) -> None:
-        if metrics.draining:
-            # Graceful departure (engine/drain.py): a draining worker is
-            # departing capacity — its queue is migrating to peers, so
-            # counting it as pressure would read a planned scale-down
-            # (or spot eviction) as demand for MORE replicas.
-            self.workers.pop(metrics.worker_id, None)
-            return
-        self.workers[metrics.worker_id] = (metrics, time.monotonic())
+        with self._lock:
+            if metrics.draining:
+                # Graceful departure (engine/drain.py): a draining worker is
+                # departing capacity — its queue is migrating to peers, so
+                # counting it as pressure would read a planned scale-down
+                # (or spot eviction) as demand for MORE replicas.
+                self.workers.pop(metrics.worker_id, None)
+                return
+            self.workers[metrics.worker_id] = (metrics, time.monotonic())
 
     def pressure(self) -> float:
         """0..inf — capacity-weighted KV usage plus queue backlog per
@@ -67,26 +74,28 @@ class PoolState:
         unweighted mean treats a 16-block toy pool and a 2048-block
         production pool as equals)."""
         cutoff = time.monotonic() - self.metrics_ttl
-        stale = [iid for iid, (_, ts) in self.workers.items() if ts < cutoff]
-        for iid in stale:
-            del self.workers[iid]
-        if not self.workers:
+        with self._lock:
+            stale = [iid for iid, (_, ts) in self.workers.items()
+                     if ts < cutoff]
+            for iid in stale:
+                del self.workers[iid]
+            live = list(self.workers.values())
+        if not live:
             return 0.0
         # A worker that doesn't report capacity (total_blocks=0 — e.g.
         # an old publisher mid rolling upgrade) gets the mean reported
         # capacity, not weight zero: a busy non-reporter must still
         # contribute pressure. All-non-reporting degrades to the plain
         # mean.
-        caps = [m.total_blocks for m, _ in self.workers.values()]
+        caps = [m.total_blocks for m, _ in live]
         reported = [c for c in caps if c > 0]
         default_cap = (sum(reported) / len(reported)) if reported else 1.0
         weights = [c if c > 0 else default_cap for c in caps]
         usage_mean = sum(
-            m.kv_usage * w
-            for (m, _), w in zip(self.workers.values(), weights)
+            m.kv_usage * w for (m, _), w in zip(live, weights)
         ) / sum(weights)
-        waiting = sum(m.waiting_requests for m, _ in self.workers.values())
-        return usage_mean + waiting / max(1, len(self.workers))
+        waiting = sum(m.waiting_requests for m, _ in live)
+        return usage_mean + waiting / max(1, len(live))
 
 
 class GlobalPlanner:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import threading
 import json
 import time
 from typing import Callable, Optional
@@ -101,6 +102,10 @@ class AuditBus:
         self._queue: asyncio.Queue = asyncio.Queue(max_queue)
         self._task: Optional[asyncio.Task] = None
         self.dropped = 0
+        # emit() runs wherever the caller lives (loop callbacks AND the
+        # scheduler's completion hooks); the overflow counter is a
+        # read-modify-write shared with close()'s final accounting.
+        self._drop_lock = threading.Lock()
 
     def start(self) -> None:
         if self._task is None:
@@ -111,7 +116,8 @@ class AuditBus:
             self._queue.put_nowait(record.to_wire())
         except asyncio.QueueFull:
             # Shed the oldest so the newest (most useful) record survives.
-            self.dropped += 1
+            with self._drop_lock:
+                self.dropped += 1
             try:
                 self._queue.get_nowait()
                 self._queue.put_nowait(record.to_wire())
@@ -153,10 +159,13 @@ class AuditBus:
         # Whatever the deadline left behind is LOST — say so.
         while not self._queue.empty():
             self._queue.get_nowait()
-            self.dropped += 1
-        if self.dropped:
+            with self._drop_lock:
+                self.dropped += 1
+        with self._drop_lock:
+            dropped = self.dropped
+        if dropped:
             log.warning("audit bus dropped %d records (queue overflow or "
-                        "shutdown deadline)", self.dropped)
+                        "shutdown deadline)", dropped)
         for sink in self.sinks:
             try:
                 sink.close()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import threading
 from typing import Optional
 
 from ..kv_router import (
@@ -102,42 +103,55 @@ class ModelManager:
         # kind; the HTTP /v1/images/generations + /v1/videos routes call
         # these directly (maintained by the ModelWatcher).
         self.image_pools: dict[str, PrefillPool] = {}
+        # register/unregister run from the discovery watcher while resolve/
+        # list_models serve concurrent HTTP handlers and scheduler hooks;
+        # iteration during mutation raises RuntimeError on dicts, so every
+        # touch takes the lock (registry ops are tiny — never contended).
+        self._lock = threading.Lock()
 
     def register(self, entry: ModelEntry) -> None:
-        self._models[entry.card.name] = entry
+        with self._lock:
+            self._models[entry.card.name] = entry
 
     def unregister(self, name: str) -> None:
-        self._models.pop(name, None)
+        with self._lock:
+            self._models.pop(name, None)
 
     def get(self, name: str) -> Optional[ModelEntry]:
-        return self._models.get(name)
+        with self._lock:
+            return self._models.get(name)
 
     def resolve(self, name: str) -> tuple[Optional[ModelEntry], Optional[str]]:
         """Resolve a requested model name to (entry, lora_name). A name
         matching a LoRA adapter advertised in some model's card routes to
         that base model with the adapter applied (ref: lora.rs — adapters
         are served as model names)."""
-        entry = self._models.get(name)
-        if entry is not None:
-            return entry, None
-        for entry in self._models.values():
-            if name in entry.loras():
-                return entry, name
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is not None:
+                return entry, None
+            for entry in self._models.values():
+                if name in entry.loras():
+                    return entry, name
         return None, None
 
     def list_models(self) -> list[ModelDeploymentCard]:
-        return [e.card for e in self._models.values()]
+        with self._lock:
+            return [e.card for e in self._models.values()]
 
     def list_adapters(self) -> list[tuple[str, str]]:
         """(adapter_name, base_model_name) pairs across all entries."""
         out = []
-        for entry in self._models.values():
+        with self._lock:
+            entries = list(self._models.values())
+        for entry in entries:
             for name in sorted(entry.loras()):
                 out.append((name, entry.card.name))
         return out
 
     def entries(self) -> list[ModelEntry]:
-        return list(self._models.values())
+        with self._lock:
+            return list(self._models.values())
 
 
 class ModelWatcher:

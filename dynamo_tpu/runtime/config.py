@@ -503,6 +503,13 @@ _register("DYNT_MULTIHOST_PUBLISH_TIMEOUT_SECS", 600.0, _float,
           "window before declaring it hung and tearing down loudly. Must "
           "exceed the slowest follower-side cold XLA compile (a follower "
           "acks a step only after executing it)")
+_register("DYNT_INTERLEAVE_SEED", 0, _int,
+          "Default schedule seed for the deterministic interleaving "
+          "harness (runtime/interleave.py): tests that drive "
+          "cross-domain races through adversarial thread schedules "
+          "derive their switch order from this seed, so a CI failure "
+          "replays bit-identically with the same value. Explicit "
+          "Interleaver(seed=...) arguments win over the knob")
 
 
 @dataclasses.dataclass

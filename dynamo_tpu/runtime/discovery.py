@@ -309,17 +309,25 @@ class FileDiscovery(Discovery):
                 # Directory scans + per-file reads go to a worker thread: on
                 # NFS/GCS-fuse each stat is a network round-trip and must not
                 # stall the event loop serving requests in this process.
-                scans = await asyncio.to_thread(self._reap_and_scan)
+                # Snapshot the watch list ON THE LOOP before hopping to
+                # the worker thread: _dispatch_watch_diffs rebinds
+                # self._watches loop-side, and the thread iterating the
+                # live attribute raced that rebind.
+                scans = await asyncio.to_thread(self._reap_and_scan,
+                                                list(self._watches))
                 self._dispatch_watch_diffs(scans)
             except OSError as exc:  # transient fs races are fine
                 if exc.errno not in (errno.ENOENT,):
                     log.warning("file discovery reap error: %s", exc)
 
-    def _reap_and_scan(self) -> list[tuple[Watch, dict[str, dict]]]:
-        """Thread-side: reap stale leases, then scan each live watch's prefix."""
+    def _reap_and_scan(
+        self, watches: list[tuple[str, "Watch"]]
+    ) -> list[tuple[Watch, dict[str, dict]]]:
+        """Thread-side: reap stale leases, then scan each live watch's
+        prefix. `watches` is a loop-side snapshot of self._watches."""
         self._reap_once()
         out: list[tuple[Watch, dict[str, dict]]] = []
-        for prefix, watch in list(self._watches):
+        for prefix, watch in watches:
             if not watch._cancelled:
                 out.append((watch, self._scan(prefix)))
         return out

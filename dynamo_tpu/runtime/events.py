@@ -463,7 +463,10 @@ class JournalEventPublisher(EventPublisher):
     def set_snapshot_fn(self, fn: Callable[[], list]) -> None:
         """fn() -> [(topic, payload), ...] reproducing current state; used
         to seed a rotated journal generation."""
-        self.snapshot_fn = fn
+        # Under _lock: _rotate reads snapshot_fn on the to_thread
+        # executor while the loop installs it here.
+        with self._lock:
+            self.snapshot_fn = fn
 
     async def publish(self, topic: str, payload: Any) -> None:
         data = _journal_pack(topic, payload)

@@ -1,0 +1,281 @@
+"""Deterministic interleaving harness for cross-domain race tests.
+
+The dynarace analyzer (tools/dynarace) proves where two execution
+domains touch the same mutable state; this module makes those findings
+*testable*. An :class:`Interleaver` runs each domain's critical section
+as an actor thread but serializes them: exactly one actor runs at a
+time, and every domain switch happens at a :func:`checkpoint` — either
+called explicitly from test shims or injected with
+:func:`probe_attribute`, which turns every read and write of one
+attribute into a switch point. Which actor runs next is drawn from a
+seeded RNG, so a schedule that loses an update or tears a read replays
+bit-identically from its seed (DYNT_INTERLEAVE_SEED), and
+:func:`explore` sweeps a seed range to hunt for the losing order.
+
+Native locks stay honest: an actor that blocks on a ``threading.Lock``
+held by a parked actor can never reach its next checkpoint, so the
+scheduler watches for stalls — a chosen actor that fails to park
+within ``stall_timeout`` is marked stalled and another actor is
+driven, which releases the lock and lets the stalled actor finish its
+step. A correctly locked implementation therefore *passes* the same
+adversarial schedule that breaks the unlocked one, which is exactly
+the regression contract: the interleaving tests in
+tests/test_interleave.py fail on the pre-fix code and pin the fix.
+
+Used by the ``interleave`` pytest marker tier; see
+docs/static-analysis.md for how suppressions cite these tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+__all__ = [
+    "DeadlockError",
+    "Interleaver",
+    "checkpoint",
+    "explore",
+    "probe_attribute",
+]
+
+# States an actor moves through. NEW -> (RUNNING <-> PARKED | STALLED)
+# -> DONE; STALLED means "driven but never parked" (blocked on a native
+# lock another actor holds) and resolves back to PARKED or DONE once
+# the lock is released.
+_NEW, _RUNNING, _PARKED, _STALLED, _DONE = range(5)
+
+
+class DeadlockError(RuntimeError):
+    """No actor can make progress: every live actor is stalled."""
+
+
+class _Actor:
+    def __init__(self, sched: "Interleaver", name: str,
+                 target: Callable[[], None]) -> None:
+        self.sched = sched
+        self.name = name
+        self.target = target
+        self.state = _NEW
+        self.go = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"interleave-{name}", daemon=True)
+
+    def _run(self) -> None:
+        try:
+            self.target()
+        except BaseException as exc:  # noqa: BLE001 — replayed to caller
+            self.error = exc
+        finally:
+            self.sched._finish(self)
+
+
+class Interleaver:
+    """Seeded, serialized scheduler for a fixed set of actor threads.
+
+    ::
+
+        itl = Interleaver(seed=7)
+        itl.add("offload", lambda: engine._do_offload_batch(batch))
+        itl.add("producer", lambda: engine.notify_stored(hashes, None))
+        itl.run()
+
+    Actors switch only at checkpoints; with the same seed and actor
+    set the switch order is reproducible.
+    """
+
+    _current: Optional["Interleaver"] = None
+    _current_lock = threading.Lock()
+
+    def __init__(self, seed: Optional[int] = None,
+                 stall_timeout: float = 0.2,
+                 run_timeout: float = 30.0) -> None:
+        if seed is None:
+            from .config import env
+
+            seed = int(env("DYNT_INTERLEAVE_SEED"))
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.stall_timeout = stall_timeout
+        self.run_timeout = run_timeout
+        self._actors: list[_Actor] = []
+        self._by_thread: dict[threading.Thread, _Actor] = {}
+        # Set whenever any actor parks or finishes; the scheduler's
+        # only wait primitive.
+        self._progress = threading.Condition()
+        self.history: list[str] = []  # switch order, for failure dumps
+
+    def add(self, name: str, target: Callable[[], None]) -> None:
+        if any(a.name == name for a in self._actors):
+            raise ValueError(f"duplicate actor name: {name}")
+        self._actors.append(_Actor(self, name, target))
+
+    # -- actor side --------------------------------------------------------
+
+    def _checkpoint(self, actor: _Actor) -> None:
+        with self._progress:
+            actor.state = _PARKED
+            self._progress.notify_all()
+        actor.go.wait()
+        actor.go.clear()
+
+    def _finish(self, actor: _Actor) -> None:
+        with self._progress:
+            actor.state = _DONE
+            self._progress.notify_all()
+
+    # -- scheduler side ----------------------------------------------------
+
+    def run(self) -> None:
+        """Drive all actors to completion; re-raise the first actor
+        error (with the schedule seed in the message's context via
+        ``self.history``)."""
+        if not self._actors:
+            return
+        with Interleaver._current_lock:
+            if Interleaver._current is not None:
+                raise RuntimeError("nested Interleaver.run() — one "
+                                   "schedule at a time per process")
+            Interleaver._current = self
+        try:
+            self._drive()
+        finally:
+            with Interleaver._current_lock:
+                Interleaver._current = None
+            for a in self._actors:
+                # Unblock anything still parked so daemon threads die.
+                a.go.set()
+            for a in self._actors:
+                if a.thread.is_alive():
+                    a.thread.join(timeout=self.stall_timeout)
+        for a in self._actors:
+            if a.error is not None:
+                raise a.error
+
+    def _drive(self) -> None:
+        deadline = threading.Event()
+        timer = threading.Timer(self.run_timeout, deadline.set)
+        timer.daemon = True
+        timer.start()
+        try:
+            while True:
+                with self._progress:
+                    if all(a.state == _DONE for a in self._actors):
+                        return
+                    runnable = [a for a in self._actors
+                                if a.state in (_NEW, _PARKED)]
+                if deadline.is_set():
+                    raise DeadlockError(
+                        f"schedule seed={self.seed} exceeded "
+                        f"{self.run_timeout}s; states="
+                        f"{self._states()}; history={self.history}")
+                if not runnable:
+                    # Everything live is STALLED or RUNNING: progress
+                    # can only come from a stalled actor unblocking.
+                    if not self._await_progress():
+                        if all(a.state in (_STALLED, _DONE)
+                               for a in self._actors):
+                            raise DeadlockError(
+                                f"all live actors stalled (native "
+                                f"deadlock?) seed={self.seed}; "
+                                f"history={self.history}")
+                    continue
+                actor = self._rng.choice(
+                    sorted(runnable, key=lambda a: a.name))
+                self.history.append(actor.name)
+                if actor.state == _NEW:
+                    actor.state = _RUNNING
+                    # Register before start: the actor may hit its
+                    # first checkpoint before start() returns.
+                    self._by_thread[actor.thread] = actor
+                    actor.thread.start()
+                else:
+                    actor.state = _RUNNING
+                    actor.go.set()
+                if not self._await_parked(actor):
+                    # Never parked: blocked on a native lock some
+                    # parked actor holds. Mark stalled and drive
+                    # someone else; it re-parks on its own once the
+                    # holder releases.
+                    with self._progress:
+                        if actor.state == _RUNNING:
+                            actor.state = _STALLED
+        finally:
+            timer.cancel()
+
+    def _await_parked(self, actor: _Actor) -> bool:
+        with self._progress:
+            return self._progress.wait_for(
+                lambda: actor.state in (_PARKED, _DONE),
+                timeout=self.stall_timeout)
+
+    def _await_progress(self) -> bool:
+        with self._progress:
+            return self._progress.wait_for(
+                lambda: any(a.state in (_PARKED, _DONE, _NEW)
+                            for a in self._actors),
+                timeout=self.stall_timeout)
+
+    def _states(self) -> dict[str, str]:
+        names = {_NEW: "new", _RUNNING: "running", _PARKED: "parked",
+                 _STALLED: "stalled", _DONE: "done"}
+        return {a.name: names[a.state] for a in self._actors}
+
+
+def checkpoint(label: str = "") -> None:
+    """Domain-switch point. Inside an active :class:`Interleaver`
+    actor this parks the caller and yields to the scheduler; anywhere
+    else (production code paths, non-actor threads) it is a no-op, so
+    shims may call it unconditionally."""
+    sched = Interleaver._current
+    if sched is None:
+        return
+    actor = sched._by_thread.get(threading.current_thread())
+    if actor is None or actor.state == _DONE:
+        return
+    sched._checkpoint(actor)
+
+
+def probe_attribute(obj: Any, name: str) -> None:
+    """Turn every read and write of ``obj.name`` into a checkpoint.
+
+    Swaps ``obj``'s class for a one-off subclass carrying a property,
+    so a read-modify-write like ``self.dropped += lost`` decomposes
+    into read -> (possible domain switch) -> write: the torn schedule
+    the analyzer warns about becomes a deterministic test. Instance-
+    local — other instances of the class are untouched.
+    """
+    cls = obj.__class__
+    storage = f"__interleave_probe_{name}"
+    object.__setattr__(obj, storage, object.__getattribute__(obj, name))
+
+    def fget(self: Any) -> Any:
+        checkpoint(f"read {name}")
+        return object.__getattribute__(self, storage)
+
+    def fset(self: Any, value: Any) -> None:
+        checkpoint(f"write {name}")
+        object.__setattr__(self, storage, value)
+
+    probed = type(f"{cls.__name__}Probed", (cls,),
+                  {name: property(fget, fset)})
+    object.__setattr__(obj, "__class__", probed)
+    # The original attribute now shadows the property from the
+    # instance dict on classic classes; drop it so the property wins.
+    obj.__dict__.pop(name, None)
+
+
+def explore(scenario: Callable[[int], None],
+            seeds: Iterable[int] = range(16)) -> None:
+    """Run ``scenario(seed)`` across a seed sweep; the first failure
+    re-raises with the losing seed chained in, so the exact schedule
+    replays with ``Interleaver(seed=<that seed>)``."""
+    for seed in seeds:
+        try:
+            scenario(seed)
+        except Exception as exc:
+            raise AssertionError(
+                f"interleaving scenario failed at seed={seed}: "
+                f"{exc}") from exc

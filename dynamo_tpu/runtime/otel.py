@@ -273,11 +273,17 @@ class Tracer:
     # -- export -------------------------------------------------------------
 
     def _ensure_flusher(self) -> None:
-        if self._flusher is not None and self._flusher.is_alive():
-            return
-        self._flusher = threading.Thread(target=self._flush_loop,
-                                         name="otel-flush", daemon=True)
-        self._flusher.start()
+        # check-then-spawn under _lock: two recording threads racing
+        # through the un-locked check each spawned a flusher (the loser
+        # leaked, both drained the same buffer); reproduced by
+        # tests/test_interleave.py::test_tracer_double_flusher_spawn.
+        with self._lock:
+            if self._flusher is not None and self._flusher.is_alive():
+                return
+            self._flusher = threading.Thread(target=self._flush_loop,
+                                             name="otel-flush",
+                                             daemon=True)
+            self._flusher.start()
 
     def _flush_loop(self) -> None:
         while not self._stop.wait(FLUSH_INTERVAL_SECS):
@@ -310,11 +316,16 @@ class Tracer:
             )
             with urllib.request.urlopen(req, timeout=5.0) as resp:
                 resp.read()
-            self.exported += len(batch)
+            with self._lock:
+                self.exported += len(batch)
             OTEL_SPANS_EXPORTED.inc(len(batch))
             return len(batch)
         except Exception as exc:  # noqa: BLE001 — telemetry must not kill
-            self.dropped += len(batch)
+            # record() increments dropped under _lock on the producer
+            # side; the flush thread's export-failure increment races
+            # it without the same lock (lost update).
+            with self._lock:
+                self.dropped += len(batch)
             OTEL_SPANS_DROPPED.labels(reason="export_error").inc(len(batch))
             log.debug("otlp export failed (%d spans dropped): %r",
                       len(batch), exc)
@@ -322,8 +333,10 @@ class Tracer:
 
     def close(self) -> None:
         self._stop.set()
-        if self._flusher is not None and self._flusher.is_alive():
-            self._flusher.join(timeout=FLUSH_INTERVAL_SECS + 6.0)
+        with self._lock:
+            flusher = self._flusher
+        if flusher is not None and flusher.is_alive():
+            flusher.join(timeout=FLUSH_INTERVAL_SECS + 6.0)
         self.flush()
 
 

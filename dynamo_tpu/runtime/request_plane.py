@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 import time
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -96,25 +97,37 @@ class RequestContext:
 
 
 class _Registry:
-    """Endpoint handler table shared by TCP and mem planes."""
+    """Endpoint handler table shared by TCP and mem planes.
+
+    Registration happens wherever a component lives (loop handlers,
+    worker bring-up on the executor, engine threads registering control
+    endpoints) while the serving loop resolves subjects concurrently —
+    the table takes a real lock rather than leaning on per-op dict
+    atomicity, so iteration (subjects()) can never see a mid-rehash
+    view."""
 
     def __init__(self) -> None:
         self._handlers: dict[str, Handler] = {}
+        self._lock = threading.Lock()
 
     def register(self, subject: str, handler: Handler) -> None:
-        self._handlers[subject] = handler
+        with self._lock:
+            self._handlers[subject] = handler
 
     def unregister(self, subject: str) -> None:
-        self._handlers.pop(subject, None)
+        with self._lock:
+            self._handlers.pop(subject, None)
 
     def get(self, subject: str) -> Handler:
         try:
-            return self._handlers[subject]
+            with self._lock:
+                return self._handlers[subject]
         except KeyError:
             raise EndpointNotFound(subject) from None
 
     def subjects(self) -> list[str]:
-        return list(self._handlers)
+        with self._lock:
+            return list(self._handlers)
 
 
 # ---------------------------------------------------------------------------

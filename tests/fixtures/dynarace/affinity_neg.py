@@ -1,0 +1,37 @@
+"""DR201 negatives: the call_soon_threadsafe hop, or loop-side touches."""
+
+import asyncio
+import threading
+
+
+class HoppedNotifier:
+    """The event-plane idiom: foreign threads hop in through
+    loop.call_soon_threadsafe; the mutation itself runs on the loop."""
+
+    def __init__(self, loop):
+        self.loop = loop
+        self._ready = asyncio.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="notify-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        self.loop.call_soon_threadsafe(self._ready.set)
+
+    async def wait_ready(self):
+        await self._ready.wait()
+
+
+class LoopLocal:
+    """Loop-domain code may touch asyncio primitives freely."""
+
+    def __init__(self):
+        self._ready = asyncio.Event()
+
+    async def fire(self):
+        self._ready.set()
+        task = asyncio.ensure_future(self._pump())
+        await task
+
+    async def _pump(self):
+        await self._ready.wait()

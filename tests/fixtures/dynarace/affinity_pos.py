@@ -1,0 +1,38 @@
+"""DR201 positives: asyncio primitives touched from foreign domains."""
+
+import asyncio
+import threading
+
+
+class Notifier:
+    """Worker thread resolves an asyncio.Event directly — waiters are
+    woken via call_soon, which is loop-affine, so they may never wake."""
+
+    def __init__(self):
+        self._ready = asyncio.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="notify-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        self._ready.set()
+
+    async def wait_ready(self):
+        await self._ready.wait()
+
+
+class Spawner:
+    """Thread body creating loop tasks without the threadsafe hop."""
+
+    def __init__(self, loop):
+        self.loop = loop
+        self._thread = threading.Thread(target=self._worker,
+                                        name="spawn-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        asyncio.ensure_future(self._pump())
+        self.loop.call_soon(print, "done")
+
+    async def _pump(self):
+        await asyncio.sleep(0)

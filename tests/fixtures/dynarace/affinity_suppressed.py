@@ -1,0 +1,15 @@
+"""DR201 suppressed with justification."""
+
+import asyncio
+import threading
+
+
+class PinnedNotifier:
+    def __init__(self):
+        self._ready = asyncio.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="notify-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        self._ready.set()  # dynarace: disable=DR201 -- loop is single-threaded in this tool and parked on run_until_complete; no waiter can race the set

@@ -1,0 +1,37 @@
+"""DR301 negatives: locked region stays synchronous; await happens
+outside, or the lock is an asyncio.Lock taken with async with."""
+
+import asyncio
+import threading
+
+
+class ShrunkFlusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batch = []
+
+    def add(self, item):
+        with self._lock:
+            self.batch.append(item)
+
+    async def flush(self):
+        with self._lock:
+            batch, self.batch = self.batch, []
+        await self._send(batch)
+
+    async def _send(self, batch):
+        pass
+
+
+class AsyncFlusher:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+        self.batch = []
+
+    async def flush(self):
+        async with self._alock:
+            batch, self.batch = self.batch, []
+            await self._send(batch)
+
+    async def _send(self, batch):
+        pass

@@ -1,0 +1,21 @@
+"""DR301 positive: await while holding a threading lock."""
+
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batch = []
+
+    def add(self, item):
+        with self._lock:
+            self.batch.append(item)
+
+    async def flush(self):
+        with self._lock:
+            batch, self.batch = self.batch, []
+            await self._send(batch)
+
+    async def _send(self, batch):
+        pass

@@ -1,0 +1,80 @@
+"""DR101 negatives: every cross-domain touch is mediated."""
+
+import asyncio
+import dataclasses
+import queue
+import threading
+
+
+class LockedPump:
+    """Same shape as the positive fixture, but every access to the
+    shared counter holds the same threading.Lock."""
+
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="pump-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    async def poll(self):
+        with self._lock:
+            self.count = 0
+        await asyncio.sleep(1)
+        with self._lock:
+            return self.count
+
+
+@dataclasses.dataclass
+class MeterState:
+    """Dataclass-held lock (field(default_factory=threading.Lock)) —
+    the collector must see it just like an __init__ assignment."""
+
+    total: int = 0
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+
+    def bump(self, n):
+        with self._lock:
+            self.total += n
+
+    async def snapshot(self):
+        with self._lock:
+            return self.total
+
+
+def _meter_worker(state):
+    state.bump(1)
+
+
+def spawn_meter():
+    state = MeterState()
+    t = threading.Thread(target=_meter_worker, args=(state,),
+                         name="meter-worker", daemon=True)
+    t.start()
+    return state
+
+
+class QueuePump:
+    """Channel-typed attribute: the queue IS the mediation."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="queue-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            self._q.put(1)
+
+    async def drain(self):
+        out = []
+        while not self._q.empty():
+            out.append(self._q.get_nowait())
+        return out

@@ -1,0 +1,40 @@
+"""DR101 positives: cross-domain mutable state with no mediation."""
+
+import asyncio
+import threading
+
+
+class Pump:
+    """Worker thread and event loop both mutate `count` — no lock,
+    no channel, no sentinel: a lost-update race."""
+
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker,
+                                        name="pump-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            self.count += 1
+
+    async def poll(self):
+        self.count = 0
+        await asyncio.sleep(1)
+        return self.count
+
+
+class Loader:
+    """Executor body (asyncio.to_thread) writes what the loop reads."""
+
+    def __init__(self):
+        self.blob = None
+
+    def _build(self):
+        self.blob = object()
+        self.blob = [self.blob]
+
+    async def refresh(self):
+        await asyncio.to_thread(self._build)
+        while self.blob is None:
+            await asyncio.sleep(0)

@@ -1,0 +1,21 @@
+"""DR101 suppressed: the race exists, but the suppression carries a
+justification citing the interleaving test that earns it."""
+
+import asyncio
+import threading
+
+
+class AuditedPump:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker,
+                                        name="pump-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            self.count += 1  # dynarace: disable=DR101 -- single-writer by design; adversarial schedule pinned by tests/test_interleave.py::test_locked_counter_survives_every_schedule
+
+    async def poll(self):
+        await asyncio.sleep(1)
+        return self.count

@@ -1,0 +1,22 @@
+"""DR401 negative: the runtime/signals.py contract — a handler only
+resolves an idempotent event and logs; once-semantics live in the
+converging callee."""
+
+import asyncio
+import logging
+import signal
+
+log = logging.getLogger("fixture")
+
+
+async def wait_for_shutdown():
+    loop = asyncio.get_running_loop()
+    event = asyncio.Event()
+
+    def _handler(signame):
+        log.info("received %s", signame)
+        event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, _handler, sig.name)
+    await event.wait()

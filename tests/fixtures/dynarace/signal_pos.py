@@ -1,0 +1,40 @@
+"""DR401 positives: signal handlers that compound on repeated delivery."""
+
+import asyncio
+import queue
+import signal
+import threading
+
+DELIVERIES = []
+_SIGNAL_Q = queue.Queue()
+
+
+def _on_term(signum, frame):
+    DELIVERIES.append(signum)
+    worker = threading.Thread(target=_drain, daemon=True)
+    worker.start()
+
+
+def _drain():
+    pass
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, lambda s, f: _SIGNAL_Q.put(s))
+
+
+class App:
+    def __init__(self, loop):
+        self.loop = loop
+        self.shutdowns = 0
+
+    def _on_signal(self):
+        self.shutdowns += 1
+        self.loop.create_task(self._teardown())
+
+    async def _teardown(self):
+        await asyncio.sleep(0)
+
+    def install(self):
+        self.loop.add_signal_handler(signal.SIGTERM, self._on_signal)

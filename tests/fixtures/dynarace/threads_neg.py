@@ -1,0 +1,36 @@
+"""DR501 negatives: every thread is joined or deliberately daemon."""
+
+import threading
+
+
+class JoinedWorker:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        self._worker.join(timeout=5.0)
+
+
+class DaemonWorker:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        pass
+
+
+def scoped_join():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+
+
+def late_daemon_flag():
+    t = threading.Thread(target=print)
+    t.daemon = True
+    t.start()

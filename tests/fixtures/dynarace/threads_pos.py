@@ -1,0 +1,21 @@
+"""DR501 positives: threads with no shutdown story."""
+
+import threading
+
+
+class LeakyWorker:
+    """Stored but never joined, and not daemon: close() abandons it."""
+
+    def __init__(self):
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def fire_and_forget():
+    threading.Thread(target=print).start()

@@ -1,0 +1,12 @@
+"""DR501 suppressed with justification."""
+
+import threading
+
+
+class PinnedWorker:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._loop)  # dynarace: disable=DR501 -- interpreter-lifetime metrics pump; process exit IS its shutdown story (ops runbook §monitoring)
+        self._worker.start()
+
+    def _loop(self):
+        pass

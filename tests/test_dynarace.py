@@ -1,0 +1,356 @@
+"""dynarace golden tests: every rule family exercised by positive,
+negative, and suppressed fixtures, the execution-domain inference that
+feeds them, the channel-registry drift gate, the CLI contract, and the
+repo-wide clean-lint invariant now covering all FOUR analyzers
+(dynalint + dynaflow + dynajit + dynarace over dynamo_tpu/ — the same
+gate CI enforces, failing pytest locally)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import tools.dynaflow as dynaflow
+import tools.dynajit as dynajit
+import tools.dynalint as dynalint
+from tools.dynarace import (
+    REGISTRY_PATH,
+    all_rules,
+    channel_surface,
+    diff_registry,
+    get_model,
+    run,
+    update_registry,
+)
+from tools.dynarace.passes_affinity import ForeignThreadAsyncioTouch
+from tools.dynarace.passes_locks import SyncLockAwaitedUnder
+from tools.dynarace.passes_shared import (
+    ChannelRegistryDrift,
+    CrossDomainUnmediatedState,
+)
+from tools.dynarace.passes_signals import NonIdempotentSignalHandler
+from tools.dynarace.passes_threads import UnjoinedThread
+from tools.dynalint.core import collect_files
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynarace"
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def race(path, rules):
+    findings, _ = run([str(FIXTURES / path)], rules=rules)
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestRuleCatalogue:
+    def test_six_rules_registered(self):
+        assert len(all_rules()) >= 6
+
+    def test_ids_and_names_unique_and_described(self):
+        rules = all_rules()
+        assert len({r.id for r in rules}) == len(rules)
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.description for r in rules)
+
+    def test_disjoint_from_sibling_analyzers(self):
+        ids = {r.id for r in all_rules()}
+        assert not ids & {r.id for r in dynalint.all_rules()}
+        assert not ids & {r.id for r in dynaflow.all_rules()}
+        assert not ids & {r.id for r in dynajit.all_rules()}
+
+
+class TestDomainInference:
+    """Seed propagation over dynaflow's call graph classifies every
+    function into execution domains; the access map is only as good as
+    this classification."""
+
+    def test_thread_target_loop_and_executor_domains(self):
+        files, _ = collect_files([str(FIXTURES / "shared_pos.py")])
+        model = get_model(files)
+        by_tail = {q.split("::", 1)[1].rsplit("@", 1)[0]: doms
+                   for q, doms in model.domains.items() if doms}
+        assert by_tail["Pump._worker"] == {"thread:pump-worker"}
+        assert by_tail["Pump.poll"] == {"loop"}
+        assert by_tail["Loader._build"] == {"executor"}
+        assert by_tail["Loader.refresh"] == {"loop"}
+
+    def test_signal_domain_via_registration(self):
+        files, _ = collect_files([str(FIXTURES / "signal_pos.py")])
+        model = get_model(files)
+        signal_fns = {q.split("::", 1)[1].rsplit("@", 1)[0]
+                      for q, doms in model.domains.items()
+                      if "signal" in doms}
+        assert "_on_term" in signal_fns
+        assert "App._on_signal" in signal_fns
+        # create_task hops the work back onto the loop: the spawned
+        # coroutine runs in the loop domain, not the signal frame
+        model2 = {q.split("::", 1)[1].rsplit("@", 1)[0]: doms
+                  for q, doms in model.domains.items() if doms}
+        assert model2["App._teardown"] == {"loop"}
+
+
+class TestSharedStateRules:
+    RULES = [CrossDomainUnmediatedState()]
+
+    def test_positive(self):
+        findings = race("shared_pos.py", self.RULES)
+        assert rules_of(findings) == ["DR101"]
+        assert len(findings) == 2  # one finding per (scope, attr)
+        assert any("Pump.count" in f.message
+                   and "thread:pump-worker" in f.message
+                   for f in findings)
+        assert any("Loader.blob" in f.message and "executor" in f.message
+                   for f in findings)
+
+    def test_negative(self):
+        """Lock-at-every-access, a dataclass lock field, and a
+        queue-channel attribute all mediate."""
+        assert race("shared_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert race("shared_suppressed.py", self.RULES) == []
+
+
+class TestAffinityRules:
+    RULES = [ForeignThreadAsyncioTouch()]
+
+    def test_positive(self):
+        findings = race("affinity_pos.py", self.RULES)
+        assert rules_of(findings) == ["DR201"]
+        assert len(findings) == 3
+        assert any("call_soon_threadsafe" in f.message for f in findings)
+
+    def test_negative(self):
+        assert race("affinity_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert race("affinity_suppressed.py", self.RULES) == []
+
+
+class TestBoundaryLockRules:
+    RULES = [SyncLockAwaitedUnder()]
+
+    def test_positive(self):
+        findings = race("boundary_pos.py", self.RULES)
+        assert rules_of(findings) == ["DR301"]
+        assert len(findings) == 1
+        assert "await" in findings[0].message
+
+    def test_negative(self):
+        """Shrunk locked region and an asyncio.Lock both pass."""
+        assert race("boundary_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert race("boundary_suppressed.py", self.RULES) == []
+
+
+class TestSignalHandlerRules:
+    RULES = [NonIdempotentSignalHandler()]
+
+    def test_positive(self):
+        findings = race("signal_pos.py", self.RULES)
+        assert rules_of(findings) == ["DR401"]
+        msgs = [f.message for f in findings]
+        assert any("lambda" in m and "'put'" in m for m in msgs)
+        assert any("'_on_term'" in m and "'append'" in m for m in msgs)
+        assert any("'_on_term'" in m and "'start'" in m for m in msgs)
+        assert any("'_on_signal'" in m and "augmented" in m for m in msgs)
+        assert any("'_on_signal'" in m and "'create_task'" in m
+                   for m in msgs)
+
+    def test_each_hazard_reported_once(self):
+        """Registrations inside module-level functions must not be
+        double-visited via the <module> pseudo-function's walk."""
+        findings = race("signal_pos.py", self.RULES)
+        sites = [(f.path, f.line, f.message) for f in findings]
+        assert len(sites) == len(set(sites)) == 5
+
+    def test_negative_runtime_signals_contract(self):
+        assert race("signal_neg.py", self.RULES) == []
+
+    def test_suppressed_citing_interleave_test(self):
+        assert race("signal_suppressed.py", self.RULES) == []
+        text = (FIXTURES / "signal_suppressed.py").read_text()
+        assert "tests/test_interleave.py::test_double_drain_converges" \
+            in text
+
+
+class TestThreadLifecycleRules:
+    RULES = [UnjoinedThread()]
+
+    def test_positive(self):
+        findings = race("threads_pos.py", self.RULES)
+        assert rules_of(findings) == ["DR501"]
+        msgs = [f.message for f in findings]
+        assert any("never joined" in m for m in msgs)
+        assert any("never stored" in m for m in msgs)
+
+    def test_negative(self):
+        """join in close(), daemon kwarg, scoped join, and a late
+        `t.daemon = True` flag all count as a shutdown story."""
+        assert race("threads_neg.py", self.RULES) == []
+
+    def test_suppressed(self):
+        assert race("threads_suppressed.py", self.RULES) == []
+
+
+class TestChannelRegistry:
+    def test_drift_gate(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "shared_neg.py")])
+        reg = tmp_path / "channel_registry.json"
+        rule = ChannelRegistryDrift(registry_path=reg)
+        # no snapshot yet -> missing-registry finding
+        missing, _ = run([str(FIXTURES / "shared_neg.py")], rules=[rule])
+        assert rules_of(missing) == ["DR102"]
+        assert "no channel registry" in missing[0].message
+        # blessed -> clean
+        assert update_registry(files, reg)
+        clean, _ = run([str(FIXTURES / "shared_neg.py")], rules=[rule])
+        assert clean == []
+        # the mediated surface changes (different fixture) -> drift
+        drifted, _ = run([str(FIXTURES / "affinity_neg.py")],
+                         rules=[rule])
+        assert rules_of(drifted) == ["DR102"]
+        assert "--registry-update" in drifted[0].message
+
+    def test_surface_records_locks_and_queues(self):
+        files, _ = collect_files([str(FIXTURES / "shared_neg.py")])
+        surface = channel_surface(files)
+        assert surface["version"] == 1
+        kinds = {c["kind"] for c in surface["channels"]}
+        assert "lock" in kinds or "thread-lock" in kinds
+        assert "thread-queue" in kinds
+        # the dataclass lock field mediates MeterState.total: the
+        # lock-protected attr lands in the surface the drift gate covers
+        assert any(c["attr"] == "total" and "MeterState" in c["scope"]
+                   and c["kind"] == "lock"
+                   for c in surface["channels"])
+
+    def test_update_is_idempotent(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "shared_neg.py")])
+        reg = tmp_path / "channel_registry.json"
+        assert update_registry(files, reg) is True
+        assert update_registry(files, reg) is False
+        payload = json.loads(reg.read_text())
+        assert payload["version"] == 1 and payload["channels"]
+
+    def test_diff_names_changed_channels(self, tmp_path):
+        files, _ = collect_files([str(FIXTURES / "shared_neg.py")])
+        reg = tmp_path / "channel_registry.json"
+        update_registry(files, reg)
+        other, _ = collect_files([str(FIXTURES / "affinity_neg.py")])
+        drift = diff_registry(other, reg)
+        assert drift is not None
+        assert any("removed:" in line for line in drift)
+
+
+class TestSuppressionDialect:
+    def test_wrong_tool_marker_does_not_suppress(self, tmp_path):
+        src = (FIXTURES / "shared_suppressed.py").read_text()
+        bad = tmp_path / "wrong.py"
+        bad.write_text(src.replace("# dynarace: disable=DR101",
+                                   "# dynalint: disable=DR101"))
+        findings, _ = run([str(bad)],
+                          rules=[CrossDomainUnmediatedState()])
+        assert rules_of(findings) == ["DR101"]
+
+    def test_unknown_rule_reported(self, tmp_path):
+        bad = tmp_path / "typo.py"
+        bad.write_text(
+            "import threading\n\n\n"
+            "def fire():\n"
+            "    threading.Thread(target=print).start()"
+            "  # dynarace: disable=DR999 -- typo\n")
+        findings, _ = run([str(bad)], rules=[UnjoinedThread()])
+        assert [f.rule for f in findings] == ["DR000", "DR501"]
+
+
+class TestCli:
+    def test_json_output_and_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynarace",
+             str(FIXTURES / "shared_pos.py"), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["files_checked"] == 1
+        assert {f["rule"] for f in data["findings"]} == {"DR101"}
+        assert {r["id"] for r in data["rules"]} >= {
+            "DR101", "DR102", "DR201", "DR301", "DR401", "DR501"}
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynarace", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "DR102" in proc.stdout
+        assert "channel-registry-drift" in proc.stdout
+
+    def test_domains_dump(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynarace",
+             str(FIXTURES / "shared_pos.py"), "--domains"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "Pump._worker" in proc.stdout
+        assert "thread:pump-worker" in proc.stdout
+
+    def test_registry_update_on_current_tree_is_noop(self):
+        # Prove currency with a PURE READ first: on a drifted tree this
+        # fails HERE, before the CLI below would silently rewrite the
+        # checked-in registry mid-pytest (and let the later
+        # TestRealTreeStaysClean pass against the fresh rewrite).
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files, REGISTRY_PATH) is None, (
+            "channel surface drifted; not exercising --registry-update "
+            "against the real registry")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynarace", "--registry-update"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "already current" in proc.stdout
+
+
+class TestRealTreeStaysClean:
+    """The repo-wide clean-lint invariant, now over all FOUR
+    analyzers: zero unsuppressed findings on dynamo_tpu/. Regressions
+    fail pytest locally, not just the CI lint job."""
+
+    def test_dynarace_clean(self):
+        findings, files_checked = run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynajit_clean(self):
+        findings, files_checked = dynajit.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynaflow_clean(self):
+        findings, files_checked = dynaflow.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynalint_clean(self):
+        findings, files_checked = dynalint.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_channel_registry_current(self):
+        """The checked-in channel registry matches the tree (a drifted
+        registry already fails test_dynarace_clean; this pins that the
+        snapshot file exists, parses, and covers the real surface)."""
+        assert REGISTRY_PATH.exists()
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files, REGISTRY_PATH) is None
+        surface = channel_surface(files)
+        assert len(surface["channels"]) >= 100  # the tree's real surface
+        # every blessing flows into the surface the drift gate covers
+        assert any(c["kind"] == "blessed" for c in surface["channels"])

@@ -63,6 +63,21 @@ def _is_lock_ctor(node: ast.expr) -> bool:
     return isinstance(node, ast.Call) and call_tail(node) in _LOCK_CTORS
 
 
+def _is_lock_factory_field(node: ast.expr) -> bool:
+    """dataclass idiom: `_lock: Lock = field(default_factory=threading.Lock)`.
+    The factory is a *reference* to the ctor, not a call, so _is_lock_ctor
+    never sees it."""
+    if not (isinstance(node, ast.Call) and call_tail(node) == "field"):
+        return False
+    for kw in node.keywords:
+        if kw.arg == "default_factory":
+            v = kw.value
+            name = v.attr if isinstance(v, ast.Attribute) else (
+                v.id if isinstance(v, ast.Name) else None)
+            return name in _LOCK_CTORS
+    return False
+
+
 def collect_locks(files: list[SourceFile]) -> set[LockId]:
     """All tracked lock identities in the tree."""
     locks: set[LockId] = set()
@@ -77,6 +92,14 @@ def collect_locks(files: list[SourceFile]) -> set[LockId]:
                                     and isinstance(tgt.value, ast.Name) \
                                     and tgt.value.id == "self":
                                 locks.add(LockId(node.name, tgt.attr))
+                # Dataclass lock fields live in the class body as annotated
+                # assignments, accessed at runtime as self.<name>.
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and stmt.value is not None \
+                            and isinstance(stmt.target, ast.Name) \
+                            and _is_lock_factory_field(stmt.value):
+                        locks.add(LockId(node.name, stmt.target.id))
         for stmt in src.tree.body:
             if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
                 for tgt in stmt.targets:

@@ -1,0 +1,125 @@
+"""dynarace — concurrency-domain race analysis for dynamo_tpu.
+
+Usage::
+
+    python -m tools.dynarace dynamo_tpu/ [--format json]
+    python -m tools.dynarace --registry-update  # bless a channel change
+    python -m tools.dynarace --list-rules
+
+The fourth analyzer on the shared dynalint/dynaflow/dynajit driver
+(collector, per-line suppressions, JSON output, CI gate): every
+function is classified into execution domains (event-loop coroutine,
+scheduler step thread, dedicated Thread targets, executor bodies,
+signal handlers) by propagating seeds over dynaflow's call graph, and
+shared mutable state crossing a domain boundary must be mediated by a
+blessed channel — a lock held at every access, a queue, a
+call_soon_threadsafe hop, a sentinel flag — recorded in the checked-in
+channel registry (tools/dynarace/channels/, DR102 drift gate). Rule
+families: cross-domain shared state (DR1xx), loop affinity (DR2xx),
+boundary locks (DR3xx), signal handlers (DR4xx), thread lifecycle
+(DR5xx). Suppress on the flagged line with
+``# dynarace: disable=DR101 -- justification`` citing the blessed
+channel or the interleaving test (tests/test_interleave.py) that
+earns it. See docs/static-analysis.md for the catalogue and
+dynamo_tpu/runtime/interleave.py for the deterministic-interleaving
+harness that drives the findings through adversarial schedules.
+"""
+
+from __future__ import annotations
+
+from tools.dynalint.core import (  # noqa: F401
+    Finding,
+    ProjectRule,
+    Registry,
+    Rule,
+    collect_files,
+    main_for,
+    render_json,
+    render_text,
+)
+from tools.dynalint.core import run as _run
+
+DYNARACE = Registry("dynarace", "DR000")
+
+from . import (  # noqa: E402
+    passes_affinity,
+    passes_locks,
+    passes_shared,
+    passes_signals,
+    passes_threads,
+)
+from .channels import (  # noqa: E402,F401
+    CHANNEL_DIR,
+    REGISTRY_PATH,
+    channel_surface,
+    diff_registry,
+    update_registry,
+)
+from .domains import DomainModel, get_model  # noqa: E402,F401
+
+for _cls in (
+    passes_shared.CrossDomainUnmediatedState,
+    passes_shared.ChannelRegistryDrift,
+    passes_affinity.ForeignThreadAsyncioTouch,
+    passes_locks.SyncLockAwaitedUnder,
+    passes_signals.NonIdempotentSignalHandler,
+    passes_threads.UnjoinedThread,
+):
+    DYNARACE.register(_cls)
+
+__all__ = ["DYNARACE", "run", "all_rules", "main", "DomainModel",
+           "get_model", "channel_surface", "update_registry",
+           "diff_registry", "CHANNEL_DIR", "REGISTRY_PATH"]
+
+
+def all_rules():
+    return DYNARACE.all_rules()
+
+
+def run(paths, rules=None):
+    """Analyze `paths`; returns (findings after suppression, files)."""
+    return _run(paths, rules=rules, registry=DYNARACE)
+
+
+def main(argv=None) -> int:
+    def extra_args(parser):
+        parser.add_argument(
+            "--registry-update", action="store_true",
+            help="regenerate tools/dynarace/channels/"
+                 "channel_registry.json from the tree (the one-command "
+                 "path after a deliberate concurrency-contract change) "
+                 "and exit")
+        parser.add_argument(
+            "--domains", action="store_true",
+            help="print the inferred execution-domain classification "
+                 "and exit (debugging aid)")
+
+    def handle_extra(args):
+        if args.domains:
+            files, errors = collect_files(args.paths or ["dynamo_tpu"])
+            for err in errors:
+                print(f"{err.path}:{err.line}: {err.message}")
+            model = get_model(files)
+            for qual in sorted(model.domains):
+                doms = model.domains[qual]
+                if doms:
+                    print(f"{qual}: {', '.join(sorted(doms))}")
+            return 1 if errors else 0
+        if not args.registry_update:
+            return None
+        files, errors = collect_files(args.paths or ["dynamo_tpu"])
+        for err in errors:
+            print(f"{err.path}:{err.line}: {err.message}")
+        if update_registry(files):
+            print(f"updated channel registry: {REGISTRY_PATH}")
+        else:
+            print("channel registry already current")
+        return 1 if errors else 0
+
+    return main_for(
+        DYNARACE, ["dynamo_tpu"],
+        "concurrency-domain race analysis (execution-domain inference, "
+        "cross-domain shared state vs blessed channels, loop affinity, "
+        "boundary locks, signal handlers, thread lifecycle) for the "
+        "dynamo_tpu codebase", argv, extra_args=extra_args,
+        handle_extra=handle_extra)

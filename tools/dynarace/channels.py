@@ -1,0 +1,118 @@
+"""Blessed-channel registry: the tree's mediated cross-domain surface.
+
+Every shared attribute the domain model proves mediated — a lock held
+at all access sites, a channel-typed attribute, a sentinel flag — is a
+*channel*: a deliberate cross-domain contract the race analysis leans
+on. Like dynaflow's wire schemas and dynajit's jit surface, that
+contract must change deliberately: the surface snapshots into
+``tools/dynarace/channels/channel_registry.json`` and DR102 fails with
+a diff whenever the extracted surface drifts. Bless a reviewed change
+with ``python -m tools.dynarace --registry-update`` and commit the
+regenerated file.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Optional
+
+from tools.dynalint.core import SourceFile
+
+from .domains import BLESSED_PATH, CHANNEL_DIR, get_model  # noqa: F401
+
+REGISTRY_PATH = CHANNEL_DIR / "channel_registry.json"
+
+
+def _anchor(rel: str) -> str:
+    """Anchor paths at the package root so the snapshot agrees whether
+    the tree was collected relatively or absolutely (the jit-surface
+    contract)."""
+    idx = rel.find("dynamo_tpu/")
+    return rel[idx:] if idx >= 0 else rel
+
+
+def channel_surface(files: list[SourceFile]) -> dict:
+    """The mediated surface: channel-typed attributes plus every
+    multi-domain shared attribute with its mediation verdict."""
+    model = get_model(files)
+    entries = []
+    for cls, attrs in model.channels.items():
+        for attr, info in attrs.items():
+            entries.append({
+                "scope": f"{_anchor(info.rel)}::{cls}",
+                "attr": attr,
+                "kind": (f"{info.flavor}-{info.kind}" if info.flavor
+                         else info.kind),
+                "mediates": [],
+            })
+    for scope, attr, accs in model.shared_attrs():
+        med = model.mediation(scope, attr, accs)
+        if med is None:
+            continue  # unmediated: DR101's business, not the registry's
+        kind, detail = med
+        doms: set[str] = set()
+        for a in accs:
+            doms |= model.domains_of(a.fn)
+        entries.append({
+            "scope": f"{_anchor(accs[0].fn.rel)}::{scope}",
+            "attr": attr,
+            "kind": kind,
+            "detail": detail,
+            "domains": sorted(doms),
+            "mediates": [attr],
+        })
+    entries.sort(key=lambda e: json.dumps(e, sort_keys=True))
+    return {"version": 1, "channels": entries}
+
+
+def update_registry(files: list[SourceFile],
+                    registry_path: pathlib.Path = REGISTRY_PATH) -> bool:
+    """Regenerate the checked-in channel registry; True if it changed."""
+    registry_path.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(channel_surface(files), indent=2,
+                         sort_keys=True) + "\n"
+    if registry_path.exists() and registry_path.read_text() == payload:
+        return False
+    registry_path.write_text(payload)
+    return True
+
+
+def diff_registry(files: list[SourceFile],
+                  registry_path: pathlib.Path = REGISTRY_PATH,
+                  ) -> Optional[list[str]]:
+    """None when the tree matches the snapshot; otherwise human-readable
+    drift lines."""
+    if not registry_path.exists():
+        return ["no channel registry at "
+                f"{registry_path}; run `python -m tools.dynarace "
+                "--registry-update` and commit the result"]
+    want = json.loads(registry_path.read_text())
+    got = channel_surface(files)
+    if got == want:
+        return None
+
+    def keyed(payload: dict) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for entry in payload.get("channels", []):
+            key = json.dumps(entry, sort_keys=True)
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    want_k, got_k = keyed(want), keyed(got)
+    lines = []
+    for key in sorted(set(got_k) - set(want_k)):
+        entry = json.loads(key)
+        lines.append(f"added: {entry['scope']}.{entry['attr']} "
+                     f"[{entry['kind']}]")
+    for key in sorted(set(want_k) - set(got_k)):
+        entry = json.loads(key)
+        lines.append(f"removed: {entry['scope']}.{entry['attr']} "
+                     f"[{entry['kind']}]")
+    for key in sorted(set(want_k) & set(got_k)):
+        if want_k[key] != got_k[key]:
+            entry = json.loads(key)
+            lines.append(f"count changed ({want_k[key]} -> "
+                         f"{got_k[key]}): {entry['scope']}."
+                         f"{entry['attr']}")
+    return lines or ["channel ordering drifted (regenerate)"]

@@ -1,0 +1,95 @@
+"""DR2xx — event-loop affinity.
+
+asyncio primitives are NOT thread-safe: an `asyncio.Queue.put_nowait`,
+`asyncio.Event.set`, or `loop.create_task` from a foreign thread can
+corrupt the loop's internal state or silently never wake a waiter
+(waiters are woken via `call_soon`, which is loop-affine). The one
+blessed doorway is `loop.call_soon_threadsafe` — the hop the event
+plane uses (MemEventPlane → subscriber `_emit`). DR201 flags
+loop-affine mutations reachable in a thread/executor/signal domain
+without that hop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile, call_name
+from tools.dynaflow.graph import call_tail
+
+from .domains import LOOP, get_model
+
+# Mutating tails on loop-affine objects.
+_ASYNC_MUTATORS = {"put_nowait", "set", "clear", "set_result",
+                   "set_exception", "cancel"}
+_TASK_SPAWNERS = {"create_task", "ensure_future", "call_soon",
+                  "call_later", "call_at"}
+
+
+def _foreign(domains: set[str]) -> set[str]:
+    """Domains that are not the event loop (signal handlers run ON the
+    loop's thread via add_signal_handler, but the rule still treats a
+    handler reached from signal registration as loop-side only when
+    the loop seeded it — `signal.signal` handlers interrupt arbitrary
+    frames)."""
+    return {d for d in domains if d != LOOP}
+
+
+class ForeignThreadAsyncioTouch(ProjectRule):
+    id = "DR201"
+    name = "foreign-thread-asyncio-touch"
+    description = (
+        "an asyncio-affine primitive (asyncio.Queue/Event/Future "
+        "mutation, create_task/ensure_future/call_soon) is reached in "
+        "a thread, executor, or signal domain without the "
+        "call_soon_threadsafe hop — asyncio primitives are not "
+        "thread-safe and waiters may never wake; route the mutation "
+        "through loop.call_soon_threadsafe (the event-plane idiom)")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        model = get_model(files)
+        for fn in model.project.functions.values():
+            doms = model.domains_of(fn)
+            foreign = _foreign(doms)
+            if not foreign:
+                continue
+            asyncio_attrs = {
+                attr for attr, info in
+                model.channels.get(fn.cls or "", {}).items()
+                if info.flavor == "asyncio"}
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = call_tail(node)
+                name = call_name(node)
+                if tail in _TASK_SPAWNERS and (
+                        name.startswith("asyncio.")
+                        or name.startswith("loop.")
+                        or name.startswith("self.loop.")
+                        or name.startswith("self._loop.")):
+                    if tail == "call_soon" and "threadsafe" in name:
+                        continue
+                    yield Finding(
+                        self.id, self.name, fn.rel, node.lineno,
+                        node.col_offset,
+                        f"'{name}' runs in domain(s) "
+                        f"{{{', '.join(sorted(foreign))}}} — loop "
+                        "machinery touched off-loop; use "
+                        "loop.call_soon_threadsafe to hop in")
+                    continue
+                if tail in _ASYNC_MUTATORS \
+                        and isinstance(node.func, ast.Attribute):
+                    base = node.func.value
+                    if isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name) \
+                            and base.value.id == "self" \
+                            and base.attr in asyncio_attrs:
+                        yield Finding(
+                            self.id, self.name, fn.rel, node.lineno,
+                            node.col_offset,
+                            f"self.{base.attr}.{tail}() mutates an "
+                            "asyncio primitive in domain(s) "
+                            f"{{{', '.join(sorted(foreign))}}} — not "
+                            "thread-safe; hop in via "
+                            "loop.call_soon_threadsafe")

@@ -1,0 +1,104 @@
+"""DR3xx — locks shared across the thread/async boundary.
+
+A `threading.Lock` held while a coroutine awaits is the classic
+boundary deadlock: the coroutine parks on the await WITHOUT releasing
+the lock, the event loop moves on, and the scheduler/offload thread
+that would let the awaited thing complete blocks on the same lock —
+with the GIL released, nothing makes progress. dynaflow's DF201 flags
+*slow* awaits under any lock for latency; DR301 is the correctness
+side: ANY await under a *sync* (threading) lock that threads also
+take. The fix is to shrink the locked region to synchronous work, or
+use an asyncio.Lock on the loop side and a queue across the boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.dynalint.core import Finding, Rule, SourceFile, call_name
+from tools.dynaflow.graph import call_tail
+
+_SYNC_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _sync_lock_attrs(tree: ast.Module) -> dict[str, set[str]]:
+    """class -> attrs assigned a *threading* lock (module-qualified
+    `threading.Lock()` etc., the codebase idiom — a bare `Lock()` from
+    `asyncio import Lock` must not count)."""
+    out: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            if call_tail(sub.value) not in _SYNC_LOCK_CTORS:
+                continue
+            if not call_name(sub.value).startswith("threading."):
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    out.setdefault(node.name, set()).add(tgt.attr)
+    return out
+
+
+def _contains_await(node: ast.AST) -> ast.AST | None:
+    """First Await inside `node`, not descending into nested defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(child, ast.Await):
+            return child
+        stack.extend(ast.iter_child_nodes(child))
+    return None
+
+
+class SyncLockAwaitedUnder(Rule):
+    id = "DR301"
+    name = "sync-lock-awaited-under"
+    description = (
+        "a coroutine awaits while holding a threading.Lock/RLock/"
+        "Condition (a sync `with` on a thread-shared lock enclosing an "
+        "`await`): the coroutine parks without releasing, and any "
+        "thread taking the same lock deadlocks against the loop — "
+        "shrink the locked region to synchronous work or use an "
+        "asyncio.Lock plus a queue across the boundary")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        locks_by_class = _sync_lock_attrs(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            lock_attrs = locks_by_class.get(node.name, set())
+            if not lock_attrs:
+                continue
+            for fn in ast.walk(node):
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                for w in ast.walk(fn):
+                    if not isinstance(w, ast.With):
+                        continue  # async with = asyncio lock, fine
+                    held = [
+                        item.context_expr.attr for item in w.items
+                        if isinstance(item.context_expr, ast.Attribute)
+                        and isinstance(item.context_expr.value, ast.Name)
+                        and item.context_expr.value.id == "self"
+                        and item.context_expr.attr in lock_attrs]
+                    if not held:
+                        continue
+                    awaited = _contains_await(w)
+                    if awaited is not None:
+                        yield self.finding(
+                            src, awaited,
+                            f"await inside `with self.{held[0]}` "
+                            f"(threading lock of {node.name}) — the "
+                            "coroutine parks holding it and any "
+                            "thread on the same lock deadlocks "
+                            "against the event loop")

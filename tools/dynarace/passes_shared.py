@@ -1,0 +1,103 @@
+"""DR1xx — cross-domain shared state.
+
+DR101 flags a `self.attr` (or tracked module global) written in one
+execution domain and touched in another with no blessed channel
+mediating it: no lock held at every access, not a channel-typed
+attribute, not a sentinel flag. Exactly the shape of every concurrency
+bug this codebase has shipped (the FlightRecorder.get() torn read, the
+offload dropped-counter lost update). A deliberate unmediated design
+is suppressed on the flagged line citing the blessed channel or the
+interleaving test (tests/test_interleave.py) that earns it.
+
+DR102 is the drift gate over the mediated surface (the channel
+registry): a new lock-mediated attribute, a new queue, a changed
+domain set — any of it must be blessed with ``--registry-update``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from .channels import REGISTRY_PATH, diff_registry
+from .domains import get_model
+
+
+class CrossDomainUnmediatedState(ProjectRule):
+    id = "DR101"
+    name = "cross-domain-unmediated-state"
+    description = (
+        "mutable state (self.attr or module global) is written in one "
+        "execution domain and touched from another with no blessed "
+        "channel mediating it (no common lock at every access site, "
+        "not a queue/Event/deque channel attribute, not a "
+        "constant-sentinel flag) — a data race: fix it, or suppress "
+        "citing the mediating design and the interleaving test "
+        "(tests/test_interleave.py) that exercises it")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        model = get_model(files)
+        for scope, attr, accs in model.shared_attrs():
+            if model.mediation(scope, attr, accs) is not None:
+                continue
+            doms: set[str] = set()
+            for a in accs:
+                doms |= model.domains_of(a.fn)
+            # Anchor on the first bare (lock-free) write so the fix or
+            # suppression lands on the code that needs the argument;
+            # fall back to the first write.
+            writes = sorted((a for a in accs if a.kind == "write"),
+                            key=lambda a: (a.fn.rel, a.line))
+            bare = [a for a in writes if not model.held_at(a)]
+            site = (bare or writes)[0]
+            others = sorted({f"{a.fn.rel}:{a.line}" for a in accs
+                             if a is not site})
+            listed = ", ".join(others[:4]) + (", ..."
+                                              if len(others) > 4 else "")
+            label = f"{scope}.{attr}" if scope != "<module>" else attr
+            yield Finding(
+                self.id, self.name, site.fn.rel, site.line,
+                getattr(site.node, "col_offset", 0),
+                f"{label} is accessed from domains "
+                f"{{{', '.join(sorted(doms))}}} with no blessed channel "
+                f"mediating it (also touched at {listed}) — hold one "
+                "lock at every access, route through a queue/"
+                "call_soon_threadsafe hop, or hand out immutable "
+                "snapshots")
+
+
+class ChannelRegistryDrift(ProjectRule):
+    id = "DR102"
+    name = "channel-registry-drift"
+    description = (
+        "the tree's mediated cross-domain surface (locks, queues, "
+        "sentinel flags and the domains they bridge) diverged from the "
+        "checked-in registry under tools/dynarace/channels/ — "
+        "concurrency-contract changes must be deliberate: run "
+        "`python -m tools.dynarace --registry-update` and commit the "
+        "diff")
+
+    def __init__(self,
+                 registry_path: Optional[pathlib.Path] = REGISTRY_PATH,
+                 ) -> None:
+        self.registry_path = registry_path
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        if self.registry_path is None or not files:
+            return
+        if not any("dynamo_tpu/" in src.rel for src in files) \
+                and self.registry_path == REGISTRY_PATH:
+            return  # fixture trees gate against their own snapshots only
+        drift = diff_registry(files, self.registry_path)
+        if drift is None:
+            return
+        src = files[0]
+        yield Finding(
+            self.id, self.name, src.rel, 1, 0,
+            "mediated-channel surface drifted from the checked-in "
+            "registry: " + "; ".join(drift[:8])
+            + ("; ..." if len(drift) > 8 else "")
+            + " — if deliberate, run `python -m tools.dynarace "
+            "--registry-update` and commit the diff")

@@ -1,0 +1,120 @@
+"""DR4xx — signal-handler discipline.
+
+A POSIX signal can be delivered more than once (double SIGTERM during
+a slow drain is the shipped example — PR 15's hand-made fix), and a
+`signal.signal` handler interrupts an arbitrary frame. A handler body
+must therefore be idempotent and tiny: resolve an Event, log, return.
+DR401 flags handler bodies that compound on repeated delivery —
+counter increments, queue/list mutation, task or thread spawns —
+traced through the registration site (`loop.add_signal_handler`,
+`signal.signal`), including lambda handlers.
+
+The drain plane's contract is the model: the handler resolves ONE
+shutdown event (runtime/signals.py), and idempotence lives in
+DrainCoordinator.drain() where every duplicate delivery joins the one
+ladder run (pinned by tests/test_interleave.py::
+test_double_drain_converges).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+from tools.dynaflow.graph import call_tail
+
+from .domains import get_model
+
+# Calls that compound when a handler runs twice.
+_COMPOUNDING_TAILS = {
+    "append", "appendleft", "extend", "insert", "put", "put_nowait",
+    "pop", "popleft", "remove",
+    "create_task", "ensure_future", "start", "submit", "run",
+}
+# Idempotent by design: Event resolution, logging, introspection.
+_ALLOWED_TAILS = {
+    "set", "clear", "is_set", "info", "debug", "warning", "error",
+    "exception", "get_logger", "getLogger", "request_shutdown",
+}
+
+
+def _handler_hazards(body: ast.AST) -> Iterable[tuple[ast.AST, str]]:
+    stack = list(ast.iter_child_nodes(body))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.AugAssign):
+            yield node, "augmented assignment compounds per delivery"
+        elif isinstance(node, ast.Call):
+            tail = call_tail(node)
+            if tail in _COMPOUNDING_TAILS and tail not in _ALLOWED_TAILS:
+                yield node, f"'{tail}' call compounds per delivery"
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class NonIdempotentSignalHandler(ProjectRule):
+    id = "DR401"
+    name = "non-idempotent-signal-handler"
+    description = (
+        "a signal handler body (registered via loop.add_signal_handler "
+        "or signal.signal, lambdas included) mutates compounding state "
+        "— counters, queues/lists, task or thread spawns: a repeated "
+        "SIGTERM/SIGINT delivery re-runs it; a handler must only "
+        "resolve an idempotent event (the runtime/signals.py contract) "
+        "and let the converging call (e.g. DrainCoordinator.drain) own "
+        "once-semantics")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        model = get_model(files)
+        for src in files:
+            for fn in [f for f in model.project.functions.values()
+                       if f.rel == src.rel]:
+                # Shallow walk: nested defs/classes are FunctionInfos of
+                # their own, so descending here would visit their calls
+                # twice (once from the parent, once from themselves).
+                stack = list(ast.iter_child_nodes(fn.node))
+                while stack:
+                    node = stack.pop()
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                        continue
+                    stack.extend(ast.iter_child_nodes(node))
+                    if not isinstance(node, ast.Call):
+                        continue
+                    handler = self._handler_arg(node)
+                    if handler is None:
+                        continue
+                    yield from self._check_handler(model, src, fn, node,
+                                                   handler)
+
+    @staticmethod
+    def _handler_arg(node: ast.Call) -> Optional[ast.expr]:
+        tail = call_tail(node)
+        if tail == "add_signal_handler" and len(node.args) >= 2:
+            return node.args[1]
+        if tail == "signal" and len(node.args) >= 2:
+            return node.args[1]
+        return None
+
+    def _check_handler(self, model, src: SourceFile, fn, reg: ast.Call,
+                       handler: ast.expr) -> Iterable[Finding]:
+        if isinstance(handler, ast.Lambda):
+            for _node, why in _handler_hazards(handler):
+                yield Finding(
+                    self.id, self.name, src.rel, reg.lineno,
+                    reg.col_offset,
+                    f"lambda signal handler is not idempotent: {why} "
+                    "— resolve an Event and converge in the callee")
+            return
+        for target in model._resolve_callback(fn, handler):
+            for node, why in _handler_hazards(target.node):
+                yield Finding(
+                    self.id, self.name, target.rel,
+                    getattr(node, "lineno", target.lineno),
+                    getattr(node, "col_offset", 0),
+                    f"signal handler '{target.name}' (registered at "
+                    f"{src.rel}:{reg.lineno}) is not idempotent: {why}")

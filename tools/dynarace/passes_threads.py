@@ -1,0 +1,127 @@
+"""DR5xx — thread lifecycle.
+
+Every `threading.Thread` in the tree must have a shutdown story:
+either it is joined (the owner's close()/stop() path waits for it) or
+it is explicitly daemon=True (the declared "may be abandoned at exit"
+marker — per-client streamer threads in the weight service). A
+non-daemon thread nobody joins keeps the process alive after main
+returns; a stored thread without a join is a shutdown leak that
+close() silently abandons — both are exactly the departures the drain
+plane exists to make graceful.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, Rule, SourceFile
+from tools.dynaflow.graph import call_tail
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _thread_calls(tree: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_tail(node) == "Thread":
+            yield node
+
+
+def _joined_names(scope: ast.AST) -> set[str]:
+    """Names (self.X attrs and locals) with a .join(...) call in scope."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and call_tail(node) == "join" \
+                and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                out.add(f"self.{base.attr}")
+            elif isinstance(base, ast.Name):
+                out.add(base.id)
+    return out
+
+
+def _daemon_set_names(scope: ast.AST) -> set[str]:
+    """`t.daemon = True` / `self.X.daemon = True` assignments."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and node.value.value is True:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
+                    base = tgt.value
+                    if isinstance(base, ast.Name):
+                        out.add(base.id)
+                    elif isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name) \
+                            and base.value.id == "self":
+                        out.add(f"self.{base.attr}")
+    return out
+
+
+class UnjoinedThread(Rule):
+    id = "DR501"
+    name = "unjoined-thread"
+    description = (
+        "a threading.Thread is started with no shutdown story: not "
+        "joined anywhere in its owning scope and not daemon=True — a "
+        "non-daemon unjoined thread pins the process at exit, and a "
+        "stored-but-never-joined worker is a leak close() silently "
+        "abandons; join it in the owner's close()/stop() or declare "
+        "daemon=True deliberately")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        # Class-scoped threads: join may live in any method.
+        claimed: set[int] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                joined = _joined_names(node)
+                daemons = _daemon_set_names(node)
+                for call in _thread_calls(node):
+                    claimed.add(id(call))
+                    yield from self._check(src, call, node, joined,
+                                           daemons)
+        # Module/function-scoped threads outside any class.
+        joined = _joined_names(src.tree)
+        daemons = _daemon_set_names(src.tree)
+        for call in _thread_calls(src.tree):
+            if id(call) not in claimed:
+                yield from self._check(src, call, src.tree, joined,
+                                       daemons)
+
+    def _check(self, src: SourceFile, call: ast.Call, scope: ast.AST,
+               joined: set[str], daemons: set[str]) -> Iterable[Finding]:
+        if _is_daemon(call):
+            return
+        stored = self._binding(call, scope)
+        if stored is not None and (stored in joined or stored in daemons):
+            return
+        where = (f"stored as {stored} but never joined"
+                 if stored is not None else "never stored")
+        yield self.finding(
+            src, call,
+            f"thread is {where} and not daemon=True — no shutdown "
+            "story; join it in close()/stop() or mark it daemon "
+            "deliberately")
+
+    @staticmethod
+    def _binding(call: ast.Call, scope: ast.AST) -> Optional[str]:
+        """Name the thread object is bound to ('self.X' or a local)."""
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and node.value is call:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    return f"self.{tgt.attr}"
+                if isinstance(tgt, ast.Name):
+                    return tgt.id
+        return None
