@@ -747,6 +747,69 @@ def bench_drain_point() -> dict:
     }
 
 
+def bench_cold_start_point() -> dict:
+    """Cold-start ladder A/B for BENCH_r07 (ISSUE 17 / docs/
+    elasticity.md fast-start plane). Two layers:
+
+    * a closed-form matrix from the v5e-calibrated cold-start preset
+      (mocker/engine.py coldstart_phases): arrival total with peer
+      striping vs single-source G4 fetch, crossed with warm vs cold
+      compile cache — the headline speedups the fast-start plane buys;
+    * a measured point: the quick chaos-spot scenario (evict+replace
+      under a live ramp, dynamo_tpu/mocker/spot_chaos.py) recording the
+      replacement's wall-clock first-token and capacity-recovery times
+      against its pinned budget — the same contract the chaos-spot CI
+      job gates on."""
+    import asyncio
+
+    from dynamo_tpu.mocker.engine import MockerConfig, TIMING_PRESETS
+    from dynamo_tpu.mocker.engine import coldstart_phases
+    from dynamo_tpu.mocker.spot_chaos import SpotChaosParams, run_scenario
+
+    preset = TIMING_PRESETS["tpu-v5e-coldstart"]
+
+    def cell(striped: bool, warm: bool) -> dict:
+        cfg = MockerConfig(**{**preset, "fetch_striped": striped,
+                              "compile_cache_warm": warm})
+        phases = coldstart_phases(cfg)
+        return {"phases_s": {k: round(v, 3) for k, v in phases.items()},
+                "total_s": round(sum(phases.values()), 3)}
+
+    matrix = {
+        "striped_warm": cell(True, True),
+        "striped_cold": cell(True, False),
+        "single_warm": cell(False, True),
+        "single_cold": cell(False, False),
+    }
+    params = SpotChaosParams(n_workers=2, n_streams=10,
+                             evict_cycles=1, streams_before_evict=3)
+    report = asyncio.run(run_scenario(params))
+    cycles = report["spot"]["cycles"]
+    return {
+        "profile": (f"v5e preset: {preset['weight_bytes'] / 1e9:.1f}GB "
+                    f"weights, {preset['fetch_donors']} donors x "
+                    f"{preset['fetch_gbps_per_donor']:.0f}Gbps striped "
+                    f"vs {preset['fetch_gbps_single']:.0f}Gbps single"),
+        "modeled": matrix,
+        "striped_fetch_speedup": round(
+            matrix["single_warm"]["phases_s"]["fetch"]
+            / matrix["striped_warm"]["phases_s"]["fetch"], 2),
+        "warm_cache_speedup": round(
+            matrix["striped_cold"]["total_s"]
+            / matrix["striped_warm"]["total_s"], 2),
+        "measured_spot": {
+            "passed": report["passed"],
+            "budget_secs": params.coldstart_budget_secs,
+            "first_token_secs": [
+                c["coldstart"] and round(c["coldstart"]["total_secs"], 3)
+                for c in cycles],
+            "capacity_recovered_secs": [
+                c["recovered_secs"] and round(c["recovered_secs"], 3)
+                for c in cycles],
+        },
+    }
+
+
 def bench_goodput_point() -> dict:
     """Goodput-vs-load curve with the overload-control loop off vs on
     (ROADMAP item 4 / ISSUE 9) — the chip-free robustness point
@@ -894,6 +957,8 @@ def main() -> None:
             result["session_cache"] = bench_session_point()
         if os.environ.get("DYNT_BENCH_DRAIN", "1") != "0":
             result["drain"] = bench_drain_point()
+        if os.environ.get("DYNT_BENCH_COLD_START", "1") != "0":
+            result["cold_start"] = bench_cold_start_point()
         print(json.dumps(result))
         return
 
@@ -991,6 +1056,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — chip-free point must
             # never cost the round its silicon numbers
             result["drain"] = {"error": repr(exc)}
+    if os.environ.get("DYNT_BENCH_COLD_START", "1") != "0":
+        try:
+            result["cold_start"] = bench_cold_start_point()
+        except Exception as exc:  # noqa: BLE001 — chip-free point must
+            # never cost the round its silicon numbers
+            result["cold_start"] = {"error": repr(exc)}
     print(json.dumps(result))
 
 

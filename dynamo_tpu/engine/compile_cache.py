@@ -1,0 +1,171 @@
+"""Shared persistent compile cache — the compile rung of fast start.
+
+JAX's persistent compilation cache (jax_compilation_cache_dir, enabled
+per-process in model_runner._enable_compile_cache) already makes the
+second arrival ON THE SAME HOST compile nothing. Spot arrivals land on
+FRESH hosts, so this module shares the cache directory through the G4
+object store (DYNT_COMPILE_CACHE_STORE, same fs/http client split as
+the weight tree): `sync_down` pulls every published executable into the
+local cache dir before anything traces, `sync_up` publishes whatever
+this arrival did compile. Combined with ModelRunner.prewarm — which
+touches exactly the jit-surface registry's predicted key space — a
+warm-cache arrival replays every steady-state executable from disk and
+compiles zero keys (docs/elasticity.md).
+
+Store layout under DYNT_COMPILE_CACHE_PREFIX (default "compile-cache"):
+
+    index.json          {"entries": [relative cache filename, ...]}
+    files/<name>        the cache entry bytes (name /-escaped)
+
+The index is read-merge-written (union of what it held and what we
+uploaded), so two concurrent arrivals publishing disjoint entries
+converge; a lost race costs a future cache miss, never correctness —
+JAX keys entries by content hash, so a re-download can't go stale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+
+log = get_logger("engine.compile_cache")
+
+_SKIP_SUFFIXES = (".tmp", ".lock")
+
+
+def cache_dir() -> str:
+    return env("DYNT_COMPILE_CACHE_DIR")
+
+
+def _store():
+    root = env("DYNT_COMPILE_CACHE_STORE")
+    if not root:
+        return None
+    from ..weights.objstore import make_store_client
+
+    return make_store_client(root)
+
+
+def _file_key(prefix: str, name: str) -> str:
+    # Cache entries are flat content-hash filenames today; escape "/"
+    # defensively so a nested layout can't alias store keys.
+    return f"{prefix}/files/{name.replace('/', '%2F')}"
+
+
+def _local_entries(root: str) -> list[str]:
+    out: list[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            if fname.endswith(_SKIP_SUFFIXES):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            out.append(rel)
+    return sorted(out)
+
+
+def _read_index(store, prefix: str) -> list[str]:
+    try:
+        raw = store.get_bytes(f"{prefix}/index.json")
+    except Exception:  # noqa: BLE001 — transient store error == empty
+        log.exception("compile-cache index fetch failed")
+        return []
+    if raw is None:
+        return []
+    try:
+        entries = json.loads(raw).get("entries", [])
+    except ValueError:
+        log.warning("corrupt compile-cache index; treating as empty")
+        return []
+    return [e for e in entries if isinstance(e, str)]
+
+
+def sync_down(store=None) -> int:
+    """Pull store entries absent locally into the cache dir. Returns the
+    number downloaded; 0 (never raises) on any store trouble — a cold
+    cache just means this arrival compiles, it must not fail it."""
+    if store is None:
+        store = _store()
+    if store is None:
+        return 0
+    root = cache_dir()
+    prefix = env("DYNT_COMPILE_CACHE_PREFIX")
+    os.makedirs(root, exist_ok=True)
+    have = set(_local_entries(root))
+    pulled = 0
+    for name in _read_index(store, prefix):
+        if name in have or os.path.isabs(name) or ".." in name.split("/"):
+            continue
+        try:
+            data = store.get_bytes(_file_key(prefix, name))
+        except Exception:  # noqa: BLE001 — skip, best-effort
+            log.exception("compile-cache entry fetch failed (%s)", name)
+            continue
+        if data is None:
+            continue
+        dest = os.path.join(root, name)
+        os.makedirs(os.path.dirname(dest) or root, exist_ok=True)
+        # Atomic place: JAX may race a read while we warm the dir.
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest) or root,
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dest)
+        except OSError:
+            log.exception("compile-cache entry write failed (%s)", name)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            continue
+        pulled += 1
+    if pulled:
+        log.info("compile cache warmed: %d entr%s pulled from the store",
+                 pulled, "y" if pulled == 1 else "ies")
+    return pulled
+
+
+def sync_up(store=None) -> int:
+    """Publish local entries the store's index doesn't list, then merge
+    the index. Returns the number uploaded; best-effort like sync_down."""
+    if store is None:
+        store = _store()
+    if store is None:
+        return 0
+    root = cache_dir()
+    prefix = env("DYNT_COMPILE_CACHE_PREFIX")
+    if not os.path.isdir(root):
+        return 0
+    local = _local_entries(root)
+    indexed = set(_read_index(store, prefix))
+    pushed = 0
+    for name in local:
+        if name in indexed:
+            continue
+        try:
+            with open(os.path.join(root, name), "rb") as f:
+                data = f.read()
+            store.put_bytes(_file_key(prefix, name), data)
+        except Exception:  # noqa: BLE001 — skip, best-effort
+            log.exception("compile-cache entry upload failed (%s)", name)
+            continue
+        indexed.add(name)
+        pushed += 1
+    if pushed:
+        try:
+            store.put_bytes(
+                f"{prefix}/index.json",
+                json.dumps({"entries": sorted(indexed)}).encode())
+        except Exception:  # noqa: BLE001
+            log.exception("compile-cache index publish failed")
+            return pushed
+        log.info("compile cache published: %d new entr%s", pushed,
+                 "y" if pushed == 1 else "ies")
+    return pushed
+
+
+__all__ = ["cache_dir", "sync_down", "sync_up"]

@@ -21,7 +21,7 @@ import dataclasses
 import os
 import threading
 from functools import partial
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -1373,3 +1373,38 @@ class ModelRunner:
             np.zeros(1, np.int32), 0, np.zeros(p, np.int32), 1,
             (0.0, 1.0, 0, 0),
         )
+
+    def prewarm(self, spec_widths: Optional[Sequence[int]] = None) -> None:
+        """Compile the FULL predicted steady-state jit-key space before
+        serving — exactly what the dynajit jit-surface registry (and the
+        retrace canary) enumerate: decode (attr:_decode_fn, one key),
+        EVERY prefill bucket (cached:_prefill_fns keyed by bucket), and
+        the speculative verify combos the scheduler will drive
+        (cached:_decode_spec_fns keyed (k+1, want_logits=False); the
+        logits-processor variant stays lazy — it only exists when a
+        request installs processor slots). A warm persistent compile
+        cache (engine/compile_cache.py) turns every one of these into a
+        disk replay, so a warm arrival compiles NOTHING — in either case
+        steady state never traces (docs/elasticity.md).
+
+        `spec_widths` defaults to the DYNT_SPEC_* configuration the
+        scheduler will read: [DYNT_SPEC_MAX_K] when DYNT_SPEC_ENABLE."""
+        self.warmup()
+        b = self.config.max_batch
+        p = self.config.max_pages_per_seq
+        for bucket in self.config.prefill_buckets:
+            self.prefill_chunk(
+                np.zeros(bucket, np.int32), 0, np.zeros(p, np.int32),
+                min(bucket, self.config.max_context), (0.0, 1.0, 0, 0),
+            )
+        if spec_widths is None:
+            spec_widths = ([max(1, int(env("DYNT_SPEC_MAX_K")))]
+                           if env("DYNT_SPEC_ENABLE") else [])
+        for k in spec_widths:
+            self.decode_spec(
+                np.zeros(b, np.int32), np.zeros((b, k), np.int32),
+                np.zeros(b, np.int32), np.zeros((b, p), np.int32),
+                np.ones(b, np.int32), np.zeros(b, bool),
+                np.ones(b, np.float32), np.ones(b, np.float32),
+                np.zeros(b, np.int32), np.zeros(b, np.uint32),
+            )

@@ -245,7 +245,21 @@ class TpuWorker:
         self._weights_from_peer = weights_from_peer
         self._weights_served = None
         self._publish_task: Optional[asyncio.Task] = None
-        self.weights_source = "init"  # init | service | peer | checkpoint
+        # Arrival ladder resolution (docs/elasticity.md): init | service |
+        # peer_striped | peer | object_store | checkpoint
+        self.weights_source = "init"
+        # Donor-side chunk tree for striped serving: (weights_key,
+        # WeightManifest, per-param raw bytes), built lazily on the first
+        # manifest/chunk request and invalidated on elastic reshard. The
+        # lock serializes concurrent pullers so the paced device gather
+        # runs once, not once per puller.
+        self._donor_cache: Optional[tuple] = None
+        self._donor_task: Optional[asyncio.Task] = None
+        self._donor_task_key: Optional[str] = None
+        self._donor_lock = asyncio.Lock()
+        # Cold-start ladder (engine/coldstart.py): created in prepare(),
+        # closed by the first non-canary token generate() serves.
+        self.coldstart = None
         # Live roofline gauges (perf/steptrace.py LiveRoofline) + the
         # interval baseline (prefill/decode tokens, decode steps,
         # device-ms total) behind dynamo_mfu/dynamo_roofline_fraction.
@@ -298,9 +312,13 @@ class TpuWorker:
         return params
 
     async def _resolve_params(self):
-        """Fast-start weight resolution: weight service (crash survival) ->
-        live peer stream (ModelExpress analog) -> init. Publishes to the
-        service whenever enabled so the NEXT restart is fast."""
+        """Fast-start weight resolution — the arrival ladder
+        (docs/elasticity.md): weight service (crash survival) -> striped
+        peer pull (parallel across donors) -> single-peer stream -> G4
+        object store -> checkpoint -> init. Publishes to the service and
+        store whenever enabled so the NEXT arrival is fast."""
+        from ..runtime.config import env as _cfg_env
+
         host_params = None
         client = None
         if self._step_channel is not None:
@@ -325,13 +343,39 @@ class TpuWorker:
                 host_params = self._params_from_flat(flat, "service")
         if (host_params is None and self._weights_from_peer
                 and self.runtime is not None):
-            from ..weights.streaming import pull_weights
+            if _cfg_env("DYNT_WEIGHT_STRIPE"):
+                from ..weights.striped import pull_weights_striped
 
-            flat = await pull_weights(self.runtime, self.card.namespace,
-                                      self.card.component,
-                                      expected_key=self._weights_key())
+                flat = await pull_weights_striped(
+                    self.runtime, self.card.namespace, self.card.component,
+                    expected_key=self._weights_key(),
+                    max_donors=int(_cfg_env("DYNT_WEIGHT_STRIPE_DONORS")))
+                if flat is not None:
+                    host_params = self._params_from_flat(
+                        flat, "peer_striped")
+            if host_params is None:
+                from ..weights.streaming import pull_weights
+
+                flat = await pull_weights(self.runtime, self.card.namespace,
+                                          self.card.component,
+                                          expected_key=self._weights_key())
+                if flat is not None:
+                    host_params = self._params_from_flat(flat, "peer")
+        if host_params is None and _cfg_env("DYNT_WEIGHT_STORE"):
+            # No live peer serves this model (scale-up from zero / whole-
+            # fleet eviction): the object store is the last fast rung
+            # before the slow checkpoint read.
+            from ..weights.objstore import (
+                fetch_weights_from_store,
+                make_store_client,
+            )
+
+            flat = await asyncio.to_thread(
+                fetch_weights_from_store,
+                make_store_client(_cfg_env("DYNT_WEIGHT_STORE")),
+                self._weights_key())
             if flat is not None:
-                host_params = self._params_from_flat(flat, "peer")
+                host_params = self._params_from_flat(flat, "object_store")
         if host_params is None and self.model_path:
             # Disk checkpoint: the slow-but-real path. Errors are FATAL —
             # a worker given a model path must never silently fall back
@@ -359,14 +403,30 @@ class TpuWorker:
         """Build the engine: weights on device, steps compiled, scheduler
         running. No runtime connections are made here (snapshot protocol:
         the dump point must have no open sockets)."""
+        from ..runtime.config import env as _cfg_env
+        from .coldstart import ColdStartLadder
+
+        self.coldstart = ColdStartLadder(f"{self.instance_id:x}")
         log.info("building model runner (%s, pages=%d, batch=%d)...",
                  self.model_config.name, self.runner_config.num_pages,
                  self.runner_config.max_batch)
-        host_params, weight_client = await self._resolve_params()
-        self.runner = await asyncio.to_thread(
-            ModelRunner, self.model_config, self.runner_config, self.mesh,
-            host_params,
-        )
+        with self.coldstart.phase("fetch"):
+            host_params, weight_client = await self._resolve_params()
+        self.coldstart.source = self.weights_source
+        if _cfg_env("DYNT_COMPILE_CACHE_STORE"):
+            # Warm the persistent compile cache BEFORE anything traces:
+            # with the shared store's entries on disk the warmup/prewarm
+            # pass below compiles nothing (engine/compile_cache.py).
+            from .compile_cache import sync_down
+
+            t0 = time.monotonic()
+            await asyncio.to_thread(sync_down)
+            self.coldstart.mark("compile", time.monotonic() - t0)
+        with self.coldstart.phase("load"):
+            self.runner = await asyncio.to_thread(
+                ModelRunner, self.model_config, self.runner_config,
+                self.mesh, host_params,
+            )
         if self._step_channel is not None:
             # Driver rank of a multi-host worker: every device-program
             # launch from here on is mirrored to the follower processes
@@ -375,22 +435,65 @@ class TpuWorker:
 
             self.runner = MirroredRunner(self.runner, self._step_channel)
         log.info("weights source: %s", self.weights_source)
-        if weight_client is not None and self.weights_source != "service":
-            # Publish for the next (re)start — best-effort AND off the
+        _store_root = (_cfg_env("DYNT_WEIGHT_STORE")
+                       if self._step_channel is None else "")
+        _publish_service = (weight_client is not None
+                            and self.weights_source != "service")
+        # Snapshot on the loop: the _publish thread below must not read
+        # loop-domain worker state (weights_source is loop-only).
+        _publish_store = bool(_store_root
+                              and self.weights_source != "object_store")
+        if _publish_service or _publish_store:
+            # Publish for the next arrival — best-effort AND off the
             # startup critical path (it only benefits a future restart;
             # the host gather of every param must not delay first serve).
             def _publish() -> None:
                 try:
-                    weight_client.store(self._weights_key(),
-                                        self.runner.params)
+                    if _publish_service:
+                        weight_client.store(self._weights_key(),
+                                            self.runner.params)
                 except Exception:  # noqa: BLE001 — crash survival is
                     # best-effort; serving continues without it
                     log.exception("weight publish failed")
+                if not _publish_store:
+                    return
+                try:
+                    from ..weights.client import flatten_params
+                    from ..weights.objstore import (
+                        make_store_client,
+                        publish_weights_to_store,
+                        weights_prefix,
+                    )
+
+                    store = make_store_client(_store_root)
+                    key = self._weights_key()
+                    if not store.exists(
+                            f"{weights_prefix(key)}/manifest.json"):
+                        publish_weights_to_store(
+                            store, key, flatten_params(self.runner.params))
+                except Exception:  # noqa: BLE001 — store convergence is
+                    # best-effort; peers still serve the striped pull
+                    log.exception("object-store weight publish failed")
 
             self._publish_task = asyncio.create_task(
                 asyncio.to_thread(_publish))
         if self._warmup:
-            await asyncio.to_thread(self.runner.warmup)
+            with self.coldstart.phase("compile"):
+                if _cfg_env("DYNT_PREWARM"):
+                    # Pre-warm the FULL predicted jit-key space (decode +
+                    # every prefill bucket + spec combos) so steady state
+                    # compiles zero keys — with a warm persistent cache
+                    # this is a disk replay, not a compile.
+                    await asyncio.to_thread(self.runner.prewarm)
+                else:
+                    await asyncio.to_thread(self.runner.warmup)
+            if _cfg_env("DYNT_COMPILE_CACHE_STORE"):
+                # Seed the shared cache with whatever this arrival DID
+                # compile — best-effort, off the critical path.
+                from .compile_cache import sync_up
+
+                self._tasks.append(asyncio.create_task(
+                    asyncio.to_thread(sync_up)))
         if self.kvbm_config is not None and self.kvbm_config.enabled:
             if self._step_channel is not None:
                 # Multihost: the paged pool is sharded across hosts —
@@ -442,6 +545,7 @@ class TpuWorker:
     async def serve(self) -> None:
         """Connect endpoints + publish the card (requires self.runtime;
         set after restore in snapshot mode)."""
+        _t_register = time.monotonic()
         self._loop = asyncio.get_running_loop()
         endpoint = (
             self.runtime.namespace(self.card.namespace)
@@ -557,6 +661,8 @@ class TpuWorker:
                 lambda: [(KV_SNAPSHOT_TOPIC,
                           self.events.local_index.dump())])
         self._tasks.append(asyncio.create_task(self._event_drain(publisher)))
+        if self.coldstart is not None:
+            self.coldstart.mark("register", time.monotonic() - _t_register)
         log.info("tpu worker serving %s as %s (instance=%x)",
                  self.model_config.name, self.card.name, self.instance_id)
 
@@ -568,11 +674,84 @@ class TpuWorker:
     async def _kv_blocks(self, body, ctx=None) -> AsyncIterator[dict]:
         yield self.events.local_index.dump()
 
+    async def _donor_tree(self):
+        """Donor-side chunk tree for striped serving: gather every param
+        to host ONCE (paced — see _build_donor_tree), chunk it, cache the
+        result for every concurrent/subsequent puller until a reshard
+        invalidates it. Single-flight: the lock only guards the cache
+        check and build-task claim — the slow gather itself runs
+        unlocked, and concurrent pullers await the same task."""
+        key = self._weights_key()
+        async with self._donor_lock:
+            cache = self._donor_cache
+            if cache is not None and cache[0] == key:
+                return cache[1], cache[2]
+            task = self._donor_task
+            if (task is None or self._donor_task_key != key
+                    or (task.done() and task.exception() is not None)):
+                task = asyncio.create_task(
+                    self._build_donor_tree(key))  # dynaflow: disable=DF201 -- create_task only SCHEDULES the build; the slow gather runs after the lock is released, awaited below outside the lock
+                self._donor_task = task
+                self._donor_task_key = key
+        # Shielded: one puller disconnecting must not cancel the build
+        # the other pullers are waiting on.
+        return await asyncio.shield(task)
+
+    async def _build_donor_tree(self, key: str):
+        """The slow half of _donor_tree. The device->host gathers ride
+        the scheduler's dispatch/drain gap and are duty-cycle paced by
+        DYNT_WEIGHT_STREAM_BW_FRAC (the PR-8 KVBM offload formula: a
+        gather costing g seconds defers the next by g*(1/frac-1)), so
+        seeding a newcomer does not regress this donor's decode ITL."""
+        import jax
+        import numpy as np
+
+        from ..runtime.config import env as _cfg_env
+        from ..runtime.metrics import WEIGHT_STREAM_DEFERRED
+        from ..weights.striped import BandwidthBudget, WeightManifest
+
+        budget = BandwidthBudget(_cfg_env("DYNT_WEIGHT_STREAM_BW_FRAC"))
+        leaves = jax.tree_util.tree_flatten_with_path(
+            self.runner.params)[0]
+        flat: list[tuple[str, np.ndarray]] = []
+        for path, leaf in leaves:
+            pkey = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            t0 = time.monotonic()
+            if self.scheduler is not None:
+                q = self.scheduler.run_in_gap(
+                    lambda a=leaf: np.asarray(a))
+                arr, exc = await asyncio.to_thread(q.get, True, 60.0)
+                if exc is not None:
+                    raise exc
+            else:
+                arr = await asyncio.to_thread(np.asarray, leaf)
+            flat.append((pkey, arr))
+            defer = budget.defer_after(time.monotonic() - t0)
+            if defer > 0:
+                WEIGHT_STREAM_DEFERRED.inc(defer)
+                await asyncio.sleep(defer)
+
+        def _chunk():
+            manifest = WeightManifest.build(flat, key)
+            bufs = [np.ascontiguousarray(a).tobytes() for _, a in flat]
+            return manifest, bufs
+
+        manifest, bufs = await asyncio.to_thread(_chunk)
+        self._donor_cache = (key, manifest, bufs)
+        return manifest, bufs
+
     async def _stream_weights(self, body, ctx=None) -> AsyncIterator[dict]:
-        """Stream this replica's parameters to a cold peer (chunked raw
-        bytes). All serialization (device->host gather + tobytes copies)
-        runs per-param in a thread so multi-GB copies never stall the
-        event loop mid-token-stream."""
+        """Serve this replica's parameters to a cold peer. The body
+        multiplexes three shapes (weights/striped.py wire protocol):
+
+          {}                           legacy full stream (back-compat)
+          {"weights_manifest": true}   striped: one manifest frame
+          {"weights_chunks": [cid..]}  striped: digest-stamped chunk frames
+
+        All serialization (device->host gather + tobytes copies) runs
+        off the event loop so multi-GB copies never stall it
+        mid-token-stream."""
         from ..weights.client import flatten_params
         from ..weights.streaming import encode_param_chunks, manifest_frame
 
@@ -580,6 +759,24 @@ class TpuWorker:
             yield {"error": "multi-host workers do not stream weights "
                             "(parameters are sharded across hosts); cold "
                             "peers load from the shared checkpoint"}
+            return
+        body = body or {}
+        if body.get("weights_manifest") or "weights_chunks" in body:
+            from ..weights.striped import encode_chunk_frames
+
+            try:
+                manifest, bufs = await self._donor_tree()
+            except Exception as exc:  # noqa: BLE001 — report to the
+                # puller (it falls down the arrival ladder), keep serving
+                log.exception("donor chunk tree build failed")
+                yield {"error": f"donor tree build failed: {exc!r}"}
+                return
+            if body.get("weights_manifest"):
+                yield manifest.to_wire()
+                return
+            for frame in encode_chunk_frames(
+                    manifest, bufs, [int(c) for c in body["weights_chunks"]]):
+                yield frame
             return
         flat = await asyncio.to_thread(flatten_params, self.runner.params)
         yield manifest_frame(self._weights_key(), len(flat))
@@ -617,6 +814,10 @@ class TpuWorker:
         q = self.scheduler.run_in_step(_do)
         await asyncio.get_running_loop().run_in_executor(None, q.get)
         self.events.on_cleared()
+        # Resharded params live on a new mesh split: the cached donor
+        # chunk tree (stale host gathers) must be rebuilt on next pull.
+        self._donor_cache = None
+        self._donor_task = None
         yield {"ok": True, "mesh": dict(mesh.shape)}
 
     # -- multi-LoRA --------------------------------------------------------
@@ -1486,6 +1687,13 @@ class TpuWorker:
                 while True:
                     output: EngineOutput = await out_queue.get()
                     saw_error = saw_error or output.error is not None
+                    if (self.coldstart is not None
+                            and self.coldstart.total is None
+                            and output.error is None
+                            and not request.annotations.get("canary")):
+                        # First served token closes the cold-start ladder
+                        # (idempotent; canary probes don't count).
+                        self.coldstart.first_token()
                     if output.finish_reason is not None:
                         status = "error" if saw_error else "ok"
                         yield output.to_wire()

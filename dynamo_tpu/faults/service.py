@@ -451,6 +451,17 @@ class FaultInjectionService:
                 })
                 f.state = "done"
                 steps.append(f.to_wire())
+                respawn_after = body.get("respawn_after_ms")
+                if respawn_after is not None:
+                    # Spot fleets REPLACE evicted capacity: after the
+                    # modeled reprovision delay, relaunch the target from
+                    # its registered argv (same model/pool args) — the
+                    # replacement walks the cold-start arrival ladder and
+                    # the chaos-spot gate times it (docs/elasticity.md).
+                    await asyncio.sleep(
+                        max(0.0, float(respawn_after)) / 1e3)
+                    steps.append((await self._inject(
+                        "respawn", body)).to_wire())
             else:
                 return web.json_response(
                     {"error": f"unknown scenario {name!r} (known: "

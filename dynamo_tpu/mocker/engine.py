@@ -72,12 +72,54 @@ class MockerConfig:
     # full after the prompt pass, the chunked pipeline only for the
     # unoverlapped tail. 0 = free transfers (the pre-overlap model).
     kv_transfer_us_per_block: float = 0.0
+    # -- cold-start model (fast-start plane, docs/elasticity.md) ----------
+    # With coldstart=True, MockerWorker.start() walks the real arrival
+    # ladder (fetch -> load -> compile -> register) with the modeled
+    # latencies below before registering endpoints, stamping the same
+    # dynamo_coldstart_* metric families TpuWorker does — so cold-start
+    # A/Bs (striped vs single-source fetch, warm vs cold compile cache)
+    # and the chaos-spot evict+replace scenario run chip-free. Sleeps
+    # divide by speedup_ratio like every other mocker latency.
+    coldstart: bool = False
+    weight_bytes: float = 1.4e9          # weight tree size to fetch
+    fetch_striped: bool = True           # peer-striped vs single-source
+    fetch_donors: int = 4
+    fetch_gbps_per_donor: float = 12.0   # effective per-donor stripe rate
+    fetch_gbps_single: float = 6.0       # one-source (G4 / single peer)
+    load_ms: float = 4000.0              # host->HBM device_put + pools
+    compile_cache_warm: bool = False     # warm persistent compile cache?
+    compile_cold_ms: float = 70000.0     # full prewarm key space, cold
+    compile_warm_ms: float = 3000.0      # same keys replayed from cache
+    register_ms: float = 300.0           # endpoints + card + first canary
 
     @classmethod
     def from_timing_preset(cls, name: str, **overrides) -> "MockerConfig":
         params = dict(TIMING_PRESETS[name])
         params.update(overrides)
         return cls(**params)
+
+
+def coldstart_phases(cfg: MockerConfig) -> dict[str, float]:
+    """Modeled arrival-ladder phase seconds for a mocker cold start —
+    the SAME closed-form both the worker walk and the bench.py
+    `cold_start` A/B block evaluate, so assertions about the model
+    (striped strictly faster than single-source, warm cache strictly
+    faster than cold) are deterministic and chip-free. Fetch bandwidth
+    adds across donors (each stripe is an independent TCP stream off an
+    independent host NIC); compile collapses to the warm replay time
+    when the persistent cache is warm."""
+    if cfg.fetch_striped:
+        rate_gbps = cfg.fetch_gbps_per_donor * max(1, cfg.fetch_donors)
+    else:
+        rate_gbps = cfg.fetch_gbps_single
+    compile_ms = (cfg.compile_warm_ms if cfg.compile_cache_warm
+                  else cfg.compile_cold_ms)
+    return {
+        "fetch": cfg.weight_bytes * 8 / (rate_gbps * 1e9),
+        "load": cfg.load_ms / 1e3,
+        "compile": compile_ms / 1e3,
+        "register": cfg.register_ms / 1e3,
+    }
 
 
 # Step-time coefficients FIT FROM MEASURED silicon (BASELINE.md r3/r4
@@ -118,6 +160,31 @@ TIMING_PRESETS: dict[str, dict] = {
         block_size=16,
         spec_k=4,
         spec_acceptance=0.7,
+    ),
+    # Cold-start profile for the fast-start plane (docs/elasticity.md):
+    # the v5e bring-up's qwen3-0.6b serving stack, modeled — ~1.4 GB
+    # bf16 weight tree; stripes ride independent donor NICs at an
+    # effective ~12 Gbps each vs ~6 Gbps for one G4/object-store stream;
+    # XLA compile of the full prewarm key space (decode + 5 prefill
+    # buckets + spec verify) is tens of seconds cold and a seconds-scale
+    # disk replay with a warm persistent cache; device_put + pool init
+    # is a few seconds. Serving step physics are the measured r3/r4
+    # coefficients above.
+    "tpu-v5e-coldstart": dict(
+        decode_base_ms=1.608,
+        decode_us_per_seq=112.4,
+        decode_us_per_kv_block=4.84,
+        prefill_us_per_token=113.0,
+        block_size=16,
+        coldstart=True,
+        weight_bytes=1.4e9,
+        fetch_donors=4,
+        fetch_gbps_per_donor=12.0,
+        fetch_gbps_single=6.0,
+        load_ms=4000.0,
+        compile_cold_ms=70000.0,
+        compile_warm_ms=3000.0,
+        register_ms=300.0,
     ),
 }
 

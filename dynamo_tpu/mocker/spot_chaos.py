@@ -1,0 +1,437 @@
+"""Chaos-spot scenario: continuous evict+replace under a rising ramp.
+
+The fast-start plane's composition gate (docs/elasticity.md): a mocker
+fleet behind the real KV-routed frontend serves an OPEN-LOOP ramp of
+streamed chats while workers are continuously evicted (the in-process
+analog of the faults service's `evict` scenario with
+`respawn_after_ms`: SIGTERM -> graceful drain -> gone) and replaced by
+cold arrivals that walk the modeled cold-start ladder
+(fetch -> load -> compile -> register -> first_token). The plane must
+make spot churn invisible:
+
+  * zero client-visible errors — every stream finishes normally even
+    when its worker departs mid-generation (departure ladder handoff);
+  * every stream is BIT-IDENTICAL to an uneviced baseline run;
+  * SLO goodput holds — the fraction of streams finishing inside the
+    baseline-derived latency budget stays above target despite the
+    churn;
+  * each replacement serves its first token inside the pinned
+    cold-start budget (the seconds-scale arrival headline);
+  * capacity tracks the planner's wish — after every evict+replace
+    cycle the fleet recovers to the published target replica count
+    within the recovery budget.
+
+One process, mem discovery/event planes, TCP request plane — the same
+harness pattern as drain_chaos.py. Used by scripts/chaos_spot.py (the
+chaos-spot CI job), tests/test_chaos.py, and bench.py's cold_start
+block.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from ..runtime import DistributedRuntime
+from ..runtime.logging import get_logger
+from .drain_chaos import _runtime_cfg
+from .engine import MockerConfig
+from .worker import MockerWorker
+
+log = get_logger("mocker.spot_chaos")
+
+MODEL = "spot-model"
+
+
+@dataclasses.dataclass
+class SpotChaosParams:
+    """Scenario shape. Defaults run in ~30s wall: a ramp of 24 streams
+    over ~8s across 3 workers, two evict+replace cycles riding it.
+    Cold-start phase latencies are scenario-scaled (hundreds of ms, not
+    the tens of seconds the v5e preset models) so CI stays fast; the
+    budget scales with them."""
+
+    n_workers: int = 3
+    n_streams: int = 24
+    isl: int = 64
+    max_tokens: int = 48
+    decode_base_ms: float = 30.0
+    # Open-loop ramp: stream i launches at an arrival rate interpolated
+    # start->end over the launch sequence (requests/sec, rising).
+    ramp_start_rps: float = 3.0
+    ramp_end_rps: float = 14.0
+    # Continuous churn: evict+replace cycles, first once this many
+    # streams have launched, then back-to-back. Long-enough decodes at
+    # that launch rate guarantee the victims carry live streams, so the
+    # cycles exercise mid-generation handoff, not idle departures.
+    evict_cycles: int = 2
+    streams_before_evict: int = 4
+    # Replacement cold-start model (scenario-scaled; same closed form as
+    # the v5e preset via MockerConfig/coldstart_phases).
+    weight_bytes: float = 48e6
+    fetch_gbps_per_donor: float = 2.0
+    fetch_donors: int = 4
+    load_ms: float = 120.0
+    compile_warm_ms: float = 150.0
+    register_ms: float = 30.0
+    # Gates.
+    coldstart_budget_secs: float = 2.0   # ladder total per replacement
+    recovery_budget_secs: float = 10.0   # back to the planner's wish
+    slo_margin: float = 2.5              # x baseline worst-case duration
+    goodput_target: float = 0.9
+    drain_deadline_secs: float = 10.0
+    settle_secs: float = 0.3
+
+    def mocker_config(self, coldstart: bool = False) -> MockerConfig:
+        return MockerConfig(
+            block_size=16, num_blocks=512, max_batch=16,
+            decode_base_ms=self.decode_base_ms,
+            prefill_us_per_token=150.0,
+            coldstart=coldstart,
+            fetch_striped=True,
+            weight_bytes=self.weight_bytes,
+            fetch_gbps_per_donor=self.fetch_gbps_per_donor,
+            fetch_donors=self.fetch_donors,
+            load_ms=self.load_ms,
+            compile_cache_warm=True,
+            compile_warm_ms=self.compile_warm_ms,
+            register_ms=self.register_ms,
+        )
+
+
+def _prompt(i: int, isl: int) -> str:
+    return f"spot-stream-{i:03d}-" + "y" * max(0, isl - 20)
+
+
+class _SpotStack:
+    """N aggregated mocker workers behind a real KV-routed frontend,
+    with evict+replace support: a victim drains (departure ladder) and
+    shuts down; a replacement walks the cold-start arrival ladder on
+    the same cluster."""
+
+    def __init__(self, params: SpotChaosParams) -> None:
+        self.params = params
+        self.cluster = uuid.uuid4().hex
+        self.workers: list[tuple[DistributedRuntime, MockerWorker]] = []
+        self.frontend = None
+        self._frt: Optional[DistributedRuntime] = None
+
+    async def _spawn(self, coldstart: bool) -> MockerWorker:
+        rt = await DistributedRuntime(
+            _runtime_cfg(self.cluster)).start()
+        worker = MockerWorker(rt, model_name=MODEL,
+                              config=self.params.mocker_config(coldstart),
+                              load_publish_interval=0.1)
+        await worker.start()
+        self.workers.append((rt, worker))
+        return worker
+
+    async def start(self) -> "_SpotStack":
+        from ..frontend import Frontend
+
+        for _ in range(self.params.n_workers):
+            await self._spawn(coldstart=False)
+        self._frt = await DistributedRuntime(
+            _runtime_cfg(self.cluster)).start()
+        self.frontend = Frontend(self._frt, host="127.0.0.1", port=0,
+                                 router_mode="kv")
+        await self.frontend.start()
+        for _ in range(200):
+            entry = self.frontend.manager.get(MODEL)
+            if entry is not None \
+                    and len(entry.instances) >= self.params.n_workers:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("spot stack never registered its model")
+        return self
+
+    @property
+    def base(self) -> str:
+        return f"http://127.0.0.1:{self.frontend.port}"
+
+    def capacity(self) -> int:
+        entry = self.frontend.manager.get(MODEL)
+        return 0 if entry is None else len(entry.router.available())
+
+    async def evict_and_replace(self, victim_index: int = 0) -> dict:
+        """One spot cycle: graceful-evict one worker (drain -> gone, the
+        faults `evict` notice path), then spawn its replacement with the
+        cold-start walk (the `respawn_after_ms` path) and probe it for
+        its first token. Returns the cycle record."""
+        rt, victim = self.workers.pop(victim_index)
+        t0 = time.monotonic()
+        drain_report = await victim.drain("spot-evict")
+        await victim.close()
+        await rt.shutdown()
+        replacement = await self._spawn(coldstart=True)
+        # Capacity recovery clock: the planner's wish is n_workers; the
+        # fleet is whole again when the router can select that many.
+        recovered_secs = None
+        deadline = time.monotonic() + self.params.recovery_budget_secs * 4
+        while time.monotonic() < deadline:
+            if self.capacity() >= self.params.n_workers:
+                recovered_secs = time.monotonic() - t0
+                break
+            await asyncio.sleep(0.02)
+        # First token through the real request plane, targeted at the
+        # replacement (closes its cold-start ladder).
+        await self._probe(replacement)
+        return {
+            "drain_report": drain_report,
+            "victim_instance": f"{victim.instance_id:x}",
+            "replacement_instance": f"{replacement.instance_id:x}",
+            "recovered_secs": recovered_secs,
+            "coldstart": (replacement.coldstart.report()
+                          if replacement.coldstart is not None else None),
+        }
+
+    async def _probe(self, worker: MockerWorker) -> None:
+        from ..llm.protocols import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from ..runtime.push_router import PushRouter
+
+        rt = self.workers[-1][0]
+        endpoint = (rt.namespace(worker.card.namespace)
+                    .component(worker.card.component).endpoint("generate"))
+        router = PushRouter(endpoint.client(), mode="direct")
+        try:
+            await router.client.start()
+            await router.client.wait_for_instances(1, timeout=5.0)
+            body = PreprocessedRequest(
+                request_id=f"spot-probe-{worker.instance_id:x}",
+                token_ids=[1, 2, 3],
+                sampling=SamplingOptions(max_tokens=1, temperature=0.0),
+                stop=StopConditions(),
+            ).to_wire()
+            async for _frame in router.generate(
+                    body, instance_id=worker.instance_id):
+                pass
+        finally:
+            await router.client.close()
+
+    async def close(self) -> None:
+        if self.frontend is not None:
+            await self.frontend.close()
+        if self._frt is not None:
+            await self._frt.shutdown()
+        for rt, worker in self.workers:
+            await worker.close()
+            await rt.shutdown()
+
+
+async def _stream_chat(session, base: str, i: int,
+                       params: SpotChaosParams, out: dict) -> None:
+    rec = {"i": i, "text": "", "tokens": 0, "finish": None,
+           "status": 0, "error": None, "duration_s": None}
+    out[i] = rec
+    t0 = time.monotonic()
+    try:
+        async with session.post(
+                base + "/v1/chat/completions",
+                json={"model": MODEL, "stream": True,
+                      "max_tokens": params.max_tokens,
+                      "messages": [{"role": "user",
+                                    "content": _prompt(i, params.isl)}]},
+        ) as resp:
+            rec["status"] = resp.status
+            if resp.status != 200:
+                rec["error"] = f"http {resp.status}"
+                return
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                if chunk.get("error"):
+                    rec["error"] = json.dumps(chunk["error"])[:200]
+                    return
+                choices = chunk.get("choices") or []
+                if not choices:
+                    continue
+                delta = choices[0].get("delta", {}).get("content")
+                if delta:
+                    rec["text"] += delta
+                    rec["tokens"] += 1
+                if choices[0].get("finish_reason") is not None:
+                    rec["finish"] = choices[0]["finish_reason"]
+    except Exception as exc:  # noqa: BLE001 — a failed stream is a stat
+        rec["error"] = repr(exc)
+    finally:
+        rec["duration_s"] = round(time.monotonic() - t0, 4)
+
+
+def _launch_delays(params: SpotChaosParams) -> list[float]:
+    """Open-loop arrival schedule: inter-arrival gaps interpolated from
+    the start rate to the end rate — a deterministic rising ramp."""
+    gaps = []
+    n = max(1, params.n_streams - 1)
+    for i in range(params.n_streams):
+        frac = i / n
+        rate = (params.ramp_start_rps
+                + (params.ramp_end_rps - params.ramp_start_rps) * frac)
+        gaps.append(1.0 / max(rate, 1e-6))
+    return gaps
+
+
+async def run_spot_pass(params: SpotChaosParams, churn: bool) -> dict:
+    """One pass: the open-loop ramp, with (churn=True) or without
+    continuous evict+replace cycles riding it."""
+    import aiohttp
+
+    from ..planner.core import publish_planner_decision
+
+    stack = await _SpotStack(params).start()
+    publish_planner_decision({"decode": params.n_workers}, "spot-wish")
+    results: dict = {}
+    cycles: list[dict] = []
+    capacity_after = None
+    try:
+        async with aiohttp.ClientSession() as session:
+            tasks: list[asyncio.Task] = []
+            gaps = _launch_delays(params)
+            churn_task: Optional[asyncio.Task] = None
+
+            async def run_churn() -> None:
+                victim = 0
+                for _cycle in range(params.evict_cycles):
+                    cycles.append(await stack.evict_and_replace(victim))
+                    # Replacements append at the end; keep evicting the
+                    # longest-serving worker (spot has no loyalty).
+                    victim = 0
+
+            for i in range(params.n_streams):
+                tasks.append(asyncio.create_task(
+                    _stream_chat(session, stack.base, i, params, results)))
+                if (churn and churn_task is None
+                        and i + 1 >= params.streams_before_evict):
+                    churn_task = asyncio.create_task(run_churn())
+                await asyncio.sleep(gaps[i])
+            if churn and churn_task is None:
+                churn_task = asyncio.create_task(run_churn())
+            await asyncio.gather(*tasks)
+            if churn_task is not None:
+                await churn_task
+            capacity_after = stack.capacity()
+    finally:
+        await stack.close()
+    streams = [results[i] for i in sorted(results)]
+    return {
+        "churn": churn,
+        "streams": streams,
+        "errors": [r for r in streams
+                   if r["error"] or r["finish"] not in ("length", "stop")],
+        "cycles": cycles,
+        "capacity_after": capacity_after,
+        "wish": params.n_workers,
+    }
+
+
+def evaluate(report: dict) -> list[dict]:
+    """The chaos-spot contract, asserted from the report alone (the CI
+    job gates on these)."""
+    checks: list[dict] = []
+
+    def check(name: str, ok: bool, detail) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    params = report["params"]
+    base = report["baseline"]
+    spot = report["spot"]
+
+    check("baseline_clean", not base["errors"],
+          {"errors": base["errors"][:3]})
+    check("zero_client_errors", not spot["errors"],
+          {"errors": spot["errors"][:3]})
+    mismatches = [
+        {"i": b["i"], "baseline": b["text"][:60], "spot": s["text"][:60]}
+        for b, s in zip(base["streams"], spot["streams"])
+        if b["text"] != s["text"]]
+    check("bit_identical_to_uneviced_run", not mismatches,
+          {"mismatches": mismatches[:3]})
+    # SLO goodput: the latency budget is derived from the baseline run
+    # (worst stream x margin); churn must keep the SLO-good fraction
+    # above target.
+    base_durs = [s["duration_s"] for s in base["streams"]
+                 if s["duration_s"] is not None]
+    slo_secs = max(base_durs) * params["slo_margin"] if base_durs else 0.0
+    good = [s for s in spot["streams"]
+            if s["duration_s"] is not None and s["duration_s"] <= slo_secs
+            and not s["error"]]
+    goodput = len(good) / max(1, len(spot["streams"]))
+    check("slo_goodput_held", goodput >= params["goodput_target"],
+          {"goodput": round(goodput, 4), "slo_secs": round(slo_secs, 3),
+           "target": params["goodput_target"]})
+    cycles = spot["cycles"]
+    check("evict_cycles_ran", len(cycles) == params["evict_cycles"],
+          {"cycles": len(cycles)})
+    slow = [c for c in cycles
+            if not c["coldstart"] or c["coldstart"]["total_secs"] is None
+            or c["coldstart"]["total_secs"]
+            > params["coldstart_budget_secs"]]
+    check("replacement_first_token_inside_budget", not slow,
+          {"budget_secs": params["coldstart_budget_secs"],
+           "totals": [c["coldstart"] and c["coldstart"]["total_secs"]
+                      for c in cycles]})
+    unrecovered = [c for c in cycles
+                   if c["recovered_secs"] is None
+                   or c["recovered_secs"] > params["recovery_budget_secs"]]
+    check("capacity_tracks_planner_wish",
+          not unrecovered
+          and spot["capacity_after"] >= spot["wish"],
+          {"wish": spot["wish"], "capacity_after": spot["capacity_after"],
+           "recovered_secs": [c["recovered_secs"] for c in cycles]})
+    drains = [c["drain_report"] or {} for c in cycles]
+    check("evictions_drained_gracefully",
+          all(d.get("completed") for d in drains),
+          {"completed": [d.get("completed") for d in drains]})
+    # Honesty gate: the churn must have interrupted at least one live
+    # stream (handoff or replay), else the scenario degraded to idle
+    # departures and proves nothing about mid-generation eviction.
+    migrated = sum(len(d.get("handoff") or []) + len(d.get("replay") or [])
+                   for d in drains)
+    check("evictions_interrupted_live_streams", migrated >= 1,
+          {"migrated_streams": migrated})
+    return checks
+
+
+async def run_scenario(params: Optional[SpotChaosParams] = None) -> dict:
+    """Full scenario: uneviced baseline ramp, then the same ramp under
+    continuous evict+replace. `passed` is the conjunction of the
+    assertions."""
+    params = params or SpotChaosParams()
+    report: dict = {
+        "scenario": "chaos_spot",
+        "params": dataclasses.asdict(params),
+    }
+    knobs = {
+        "DYNT_DRAIN_ENABLE": "1",
+        "DYNT_DRAIN_HANDOFF": "1",
+        "DYNT_DRAIN_DEADLINE_SECS": str(params.drain_deadline_secs),
+        "DYNT_DRAIN_ANNOUNCE_SETTLE_SECS": str(params.settle_secs),
+    }
+    prev = {key: os.environ.get(key) for key in knobs}
+    try:
+        os.environ.update(knobs)
+        report["baseline"] = await run_spot_pass(params, churn=False)
+        report["spot"] = await run_spot_pass(params, churn=True)
+    finally:
+        for key, old in prev.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+    report["assertions"] = evaluate(report)
+    report["passed"] = all(c["ok"] for c in report["assertions"])
+    return report

@@ -79,8 +79,29 @@ class MockerWorker:
         # one ladder run per process; repeats join it.
         self._drain_task: Optional[asyncio.Task] = None
         self._publisher = None
+        # Cold-start ladder (engine/coldstart.py): walked with modeled
+        # latencies when config.coldstart, closed by the first
+        # non-canary token — the chip-free twin of TpuWorker's ladder.
+        self.coldstart = None
+
+    async def _walk_coldstart(self) -> None:
+        from ..engine.coldstart import ColdStartLadder
+        from .engine import coldstart_phases
+
+        self.coldstart = ColdStartLadder(
+            f"{self.instance_id:x}",
+            source=("peer_striped" if self.config.fetch_striped
+                    else "object_store"))
+        phases = coldstart_phases(self.config)
+        scale = max(self.config.speedup_ratio, 1e-9)
+        for name in ("fetch", "load", "compile", "register"):
+            secs = phases[name] / scale
+            await asyncio.sleep(secs)
+            self.coldstart.mark(name, secs)
 
     async def start(self) -> None:
+        if self.config.coldstart:
+            await self._walk_coldstart()
         publisher = self.runtime.event_publisher(self.card.namespace)
         self._publisher = publisher
         self.engine = MockerEngine(self.config, worker_id=self.instance_id,
@@ -104,8 +125,21 @@ class MockerWorker:
             .component(self.card.component)
             .endpoint("generate")
         )
+        engine_generate = self.engine.generate
+
+        async def generate(body, ctx=None):
+            async for frame in engine_generate(body, ctx):
+                if (self.coldstart is not None
+                        and self.coldstart.total is None
+                        and not (body.get("annotations") or {}).get(
+                            "canary")):
+                    # First served token closes the cold-start ladder
+                    # (same contract as TpuWorker.generate).
+                    self.coldstart.first_token()
+                yield frame
+
         self._served = await endpoint.serve_endpoint(
-            self.engine.generate, instance_id=self.instance_id,
+            generate, instance_id=self.instance_id,
             health_check_payload=_canary_request(),
         )
 
@@ -240,6 +274,11 @@ async def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--echo", action="store_true",
                         help="generated tokens replay the prompt (parser/"
                              "protocol E2E testing)")
+    parser.add_argument("--coldstart", action="store_true",
+                        help="walk the modeled arrival ladder (fetch/load/"
+                             "compile/register sleeps + dynamo_coldstart_* "
+                             "stamps) before serving — chip-free fast-start "
+                             "scenarios (docs/elasticity.md)")
     parser.add_argument("--tool-call-parser", default=None)
     parser.add_argument("--reasoning-parser", default=None)
     args = parser.parse_args(argv)
@@ -254,6 +293,10 @@ async def main(argv: Optional[list[str]] = None) -> None:
         speedup_ratio=args.speedup_ratio,
         echo=args.echo,
     )
+    if args.coldstart:
+        # Only override when asked: a bare flag default of False must
+        # not mask a preset that enables the cold-start walk.
+        common_cfg["coldstart"] = True
     runtime = await DistributedRuntime(RuntimeConfig.from_env()).start()
     worker = MockerWorker(
         runtime,

@@ -30,6 +30,7 @@ from typing import Optional
 
 from ..runtime.logging import get_logger
 from ..runtime.metrics import (
+    COLDSTART_LEAD_SECONDS,
     PLANNER_CORRECTION,
     PLANNER_DECISIONS,
     PLANNER_GOODPUT_RATIO,
@@ -81,6 +82,16 @@ class PlannerConfig:
     # Under a binding chip budget, shift chips between the P and D pools
     # toward the measured bottleneck when goodput is violated.
     pd_rebalance: bool = True
+    # -- cold-start lead time (docs/elasticity.md) -------------------------
+    # A scale-up decision only lands capacity after the arrival ladder
+    # completes (fetch -> load -> compile -> register -> first_token), so
+    # the planner projects a GROWING load that far ahead: demand is
+    # evaluated at now + lead instead of now. 0.0 = use the measured
+    # ladder total from this process (engine.coldstart EWMA); a positive
+    # value pins the lead (deployments where the planner runs apart from
+    # any worker); disable with coldstart_lead=False.
+    coldstart_lead: bool = True
+    coldstart_lead_secs: float = 0.0
 
 
 def publish_planner_decision(targets: dict[str, int], reason: str,
@@ -178,6 +189,9 @@ class SlaPlanner:
         self.num_req_pred = make_predictor(config.load_predictor)
         self.isl_pred = make_predictor(config.load_predictor)
         self.osl_pred = make_predictor(config.load_predictor)
+        # Previous interval's observed num_req — the ramp slope the
+        # cold-start lead projection extrapolates (see _project_ahead).
+        self._prev_num_req: Optional[float] = None
         self._task: Optional[asyncio.Task] = None
 
     # -- one planning interval --------------------------------------------
@@ -212,6 +226,37 @@ class SlaPlanner:
         return (self.num_req_pred.predict_next(),
                 self.isl_pred.predict_next(),
                 self.osl_pred.predict_next())
+
+    def _lead_secs(self) -> float:
+        """Cold-start lead time for this interval: the pinned config
+        value, else the measured arrival-ladder EWMA from this process
+        (workers that completed a cold start here), else 0."""
+        cfg = self.config
+        if not cfg.coldstart_lead:
+            return 0.0
+        if cfg.coldstart_lead_secs > 0:
+            return cfg.coldstart_lead_secs
+        from ..engine.coldstart import observed_cold_start_secs
+
+        return observed_cold_start_secs() or 0.0
+
+    def _project_ahead(self, num_req: float, observed: float) -> float:
+        """Evaluate demand at now + cold-start lead: new capacity only
+        serves after the arrival ladder completes, so a ramp's slope is
+        extrapolated that far forward (never below the raw prediction —
+        a falling ramp must not double-dip with scale-down hysteresis).
+        Publishes the lead used to dynamo_coldstart_lead_seconds."""
+        prev = self._prev_num_req
+        self._prev_num_req = observed
+        lead = self._lead_secs()
+        COLDSTART_LEAD_SECONDS.set(lead)
+        if lead <= 0 or prev is None or observed <= prev:
+            return num_req
+        growth_per_sec = (observed - prev) / self.config.adjustment_interval
+        projected = num_req + growth_per_sec * lead
+        log.info("cold-start lead %.1fs: projecting num_req %.2f -> %.2f "
+                 "(+%.3f/s ramp)", lead, num_req, projected, growth_per_sec)
+        return projected
 
     def compute_num_prefill(self, num_req: float, isl: float) -> int:
         """ref prefill_planner.py:87-115."""
@@ -291,6 +336,7 @@ class SlaPlanner:
         self.observe(stats)
         self._update_correction(stats)
         num_req, isl, osl = self.predict_load()
+        num_req = self._project_ahead(num_req, stats.num_req)
         log.info("predicted load: num_req=%.2f isl=%.1f osl=%.1f",
                  num_req, isl, osl)
         num_p = (self.compute_num_prefill(num_req, isl)

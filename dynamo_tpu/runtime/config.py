@@ -144,6 +144,39 @@ _register("DYNT_Q4_VARIANT", "auto", _str,
 _register("DYNT_WEIGHT_SERVICE", "", _str,
           "Unix socket of the weight service (GMS analog): workers "
           "re-attach published weights on restart instead of initializing")
+# Fast-start arrival plane (weights/striped.py, weights/objstore.py,
+# engine/coldstart.py; docs/elasticity.md)
+_register("DYNT_WEIGHT_STRIPE", True, _bool,
+          "Striped peer weight pull: a joining worker (weights_from_peer) "
+          "stripes the content-addressed chunk manifest across every "
+          "live donor in parallel, with digest verification and "
+          "resume-after-donor-death. Off falls back to the single-peer "
+          "stream")
+_register("DYNT_WEIGHT_STRIPE_DONORS", 4, _int,
+          "Max donors a striped weight pull fans out across (more donors "
+          "= more aggregate fetch bandwidth, but each pays its "
+          "DYNT_WEIGHT_STREAM_BW_FRAC duty cycle)")
+_register("DYNT_WEIGHT_STREAM_BW_FRAC", 0.5, _float,
+          "Donor-side bandwidth budget for weight streaming: the "
+          "fraction of wall time a serving donor may spend on param "
+          "gathers for a cold peer. Same pacing formula as "
+          "DYNT_OFFLOAD_BW_FRAC (defer g*(1/frac - 1) after a gather "
+          "costing g), gathers ride the scheduler's dispatch/drain gap, "
+          "so the donor's decode ITL does not regress. 1.0 disables "
+          "pacing")
+_register("DYNT_WEIGHT_STORE", "", _str,
+          "Object-store root for the weight-tree fallback (filesystem/"
+          "FUSE path or http(s) S3/GCS-shaped endpoint with DYNT_G4_* "
+          "auth): a joining worker with no live peer fetches the "
+          "content-addressed chunk tree from here; resolved workers "
+          "publish to it best-effort off the startup critical path. "
+          "Empty disables the leg")
+_register("DYNT_COLDSTART_BUDGET_SECS", 60.0, _float,
+          "Pinned cold-start-to-first-token budget for a joining worker "
+          "(the arrival-side twin of DYNT_DRAIN_DEADLINE_SECS): the "
+          "chaos-spot gate asserts measured arrivals stay inside it, "
+          "and dynamo_coldstart_total_seconds above it is the "
+          "page-worthy signal")
 _register("DYNT_SNAPSHOT_MODE", "off", _str,
           "Worker snapshot protocol: off | dump (prepare engine, signal "
           "ready, block for restore before connecting — CRIU analog)")
@@ -163,6 +196,22 @@ _register("DYNT_JAX_PLATFORM", "", _str,
           "over a sitecustomize-frozen JAX_PLATFORMS")
 _register("DYNT_COMPILE_CACHE_DIR", "/tmp/dynamo_tpu_jax_cache", _str,
           "Persistent XLA compilation cache dir")
+_register("DYNT_COMPILE_CACHE_STORE", "", _str,
+          "Object-store root (filesystem path or http(s) endpoint) the "
+          "persistent compile cache syncs with: a joining worker pulls "
+          "cache entries down before building its engine and pushes new "
+          "entries up after warmup, so a warm-cache arrival compiles "
+          "nothing before serving (docs/elasticity.md). Empty disables "
+          "the sync")
+_register("DYNT_COMPILE_CACHE_PREFIX", "compile-cache", _str,
+          "Key prefix compile-cache entries live under in the "
+          "DYNT_COMPILE_CACHE_STORE object store")
+_register("DYNT_PREWARM", True, _bool,
+          "Warmup scope for serving workers: on, warmup compiles the "
+          "FULL jit-surface-registry-predicted key space (decode + "
+          "every prefill bucket + each speculative k) so steady state "
+          "compiles nothing; off keeps the minimal decode + smallest-"
+          "bucket warmup")
 _register("DYNT_ATTENTION", "auto", _str,
           "Attention kernel: auto | pallas | xla (auto = Pallas flash-decode "
           "on single-device TPU, XLA reference path elsewhere)")

@@ -380,6 +380,53 @@ HOST_BOUND = Gauge(
     "chips will not move this pool's latency), else 0",
     ["worker"], registry=REGISTRY,
 )
+# Fast-start arrival plane (docs/elasticity.md): the cold-start ladder
+# a joining worker walks (fetch -> load -> compile -> register ->
+# first_token), and the striped peer weight stream that makes the fetch
+# rung seconds-scale. The planner reads the measured total as scale-up
+# lead time — a decision made now yields capacity lead-time later.
+COLDSTART_PHASE_SECONDS = Gauge(
+    "dynamo_coldstart_phase_seconds",
+    "Seconds this worker's most recent cold start spent in each arrival-"
+    "ladder phase (fetch / load / compile / register / first_token)",
+    ["worker", "phase"], registry=REGISTRY,
+)
+COLDSTART_TOTAL_SECONDS = Gauge(
+    "dynamo_coldstart_total_seconds",
+    "Wall seconds of this worker's most recent cold start, process "
+    "start to first served token — should sit inside "
+    "DYNT_COLDSTART_BUDGET_SECS",
+    ["worker"], registry=REGISTRY,
+)
+COLDSTART_ARRIVALS = Counter(
+    "dynamo_coldstart_arrivals_total",
+    "Completed cold starts, by the weight source the arrival ladder "
+    "resolved (peer_striped / peer / service / object_store / "
+    "checkpoint / init / mock)",
+    ["source"], registry=REGISTRY,
+)
+COLDSTART_LEAD_SECONDS = Gauge(
+    "dynamo_coldstart_lead_seconds",
+    "Cold-start lead time the planner used in its most recent scale-up "
+    "decision (the measured arrival-ladder total it projects demand "
+    "ahead by)",
+    registry=REGISTRY,
+)
+WEIGHT_STREAM_CHUNKS = Counter(
+    "dynamo_weight_stream_chunks_total",
+    "Striped weight-stream chunks, by outcome: served (donor side), "
+    "verified (puller digest ok), digest_mismatch (corrupt chunk "
+    "rejected — re-fetched from another donor, never served), "
+    "restriped (re-assigned after a donor died mid-stream)",
+    ["outcome"], registry=REGISTRY,
+)
+WEIGHT_STREAM_DEFERRED = Counter(
+    "dynamo_weight_stream_deferred_seconds_total",
+    "Seconds weight-stream donors spent deferring param gathers to "
+    "honor the DYNT_WEIGHT_STREAM_BW_FRAC bandwidth budget (the PR-8 "
+    "offload pacing, applied to the arrival plane)",
+    registry=REGISTRY,
+)
 # OTLP exporter health (runtime/otel.py): spans that reached the
 # collector vs spans lost to a full buffer or a failed export.
 OTEL_SPANS_EXPORTED = Counter(

@@ -19,9 +19,24 @@ host DRAM -> HBM DMA. So:
   * Peer streaming (`serve_weights` / `pull_weights`, llm-level): a cold
     worker pulls parameters from a live replica over the request plane in
     chunked raw-bytes frames — the ModelExpress analog for scale-out.
+  * Striped streaming (striped.py): the same pull content-addressed and
+    fanned out across N live replicas in parallel, with per-chunk digests,
+    resume-after-donor-death, and donor-side bandwidth budgeting — the
+    fast-start arrival plane (docs/elasticity.md).
+  * Object-store fallback (objstore.py): the chunk tree published to /
+    fetched from the G4 store when no live peer serves the model.
 """
 
 from .client import WeightClient
 from .service import WeightServiceServer, serve_in_process
+from .striped import (
+    BandwidthBudget,
+    StripedAssembler,
+    WeightManifest,
+    pull_striped,
+    pull_weights_striped,
+)
 
-__all__ = ["WeightClient", "WeightServiceServer", "serve_in_process"]
+__all__ = ["WeightClient", "WeightServiceServer", "serve_in_process",
+           "WeightManifest", "StripedAssembler", "BandwidthBudget",
+           "pull_striped", "pull_weights_striped"]

@@ -1,0 +1,118 @@
+"""Object-store weight tree — the no-peer fallback of the arrival ladder.
+
+When a joining worker finds no live replica serving its weights key
+(first worker of a scale-up from zero, or a whole-fleet spot eviction),
+it fetches the tree from the G4 object store instead: the same
+content-addressed chunk layout as the striped peer pull, stored under a
+weights-key-derived prefix, digest-verified on the way back in. Workers
+that resolved weights any other way publish here best-effort and off
+the startup critical path, so the store converges to holding every
+served model (docs/elasticity.md).
+
+Layout under `weights/<xxhash64(weights_key)>/`:
+
+    manifest.json          WeightManifest.to_wire() (sans raw bytes)
+    chunks/<cid>-<digest>  raw chunk bytes
+
+The client is either backend the KVBM G4 tier already speaks
+(block_manager/storage.py): a filesystem/FUSE root, or an S3/GCS-shaped
+HTTP endpoint with the DYNT_G4_* auth family.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from .striped import StripedAssembler, WeightManifest, chunk_digest
+
+log = get_logger("weights.objstore")
+
+
+def make_store_client(root: str):
+    """Filesystem root or http(s) URL -> object-store client (the same
+    split block_manager's G4 tier uses)."""
+    from ..block_manager.storage import (
+        FsObjectStoreClient,
+        HttpObjectStoreClient,
+    )
+
+    if root.startswith(("http://", "https://")):
+        return HttpObjectStoreClient(root)
+    return FsObjectStoreClient(root)
+
+
+def weights_prefix(weights_key: str) -> str:
+    import xxhash
+
+    return f"weights/{xxhash.xxh64_hexdigest(weights_key.encode())}"
+
+
+def _chunk_key(prefix: str, cid: int, digest: str) -> str:
+    return f"{prefix}/chunks/{cid}-{digest}"
+
+
+def publish_weights_to_store(client, weights_key: str,
+                             flat: Sequence[tuple[str, np.ndarray]]) -> int:
+    """Upload the chunked tree. Manifest goes LAST so a reader never
+    sees a manifest whose chunks are still uploading. Returns the chunk
+    count (raises on store errors — callers treat publish as
+    best-effort and log)."""
+    manifest = WeightManifest.build(flat, weights_key)
+    prefix = weights_prefix(weights_key)
+    bufs = [np.ascontiguousarray(arr).tobytes() for _, arr in flat]
+    for ref in manifest.chunks:
+        data = bufs[ref.param][ref.offset: ref.offset + ref.size]
+        client.put_bytes(_chunk_key(prefix, ref.cid, ref.digest), data)
+    client.put_bytes(f"{prefix}/manifest.json",
+                     json.dumps(manifest.to_wire()).encode())
+    log.info("published %d chunks / %.1f MiB to object store under %s",
+             len(manifest.chunks), manifest.total_bytes / 2**20, prefix)
+    return len(manifest.chunks)
+
+
+def fetch_weights_from_store(
+        client, weights_key: str) -> Optional[dict[str, np.ndarray]]:
+    """Digest-verified fetch. None when the store has no (complete,
+    uncorrupted) tree for this key — the caller falls back to
+    checkpoint/init, never serves bad bytes."""
+    prefix = weights_prefix(weights_key)
+    try:
+        raw = client.get_bytes(f"{prefix}/manifest.json")
+    except Exception:  # noqa: BLE001 — transient store error == miss
+        log.exception("object-store manifest fetch failed")
+        return None
+    if raw is None:
+        return None
+    try:
+        frame = json.loads(raw)
+    except ValueError:
+        log.warning("corrupt object-store manifest under %s", prefix)
+        return None
+    if frame.get("weights_key") != weights_key:
+        log.warning("object store holds %r under our prefix, need %r",
+                    frame.get("weights_key"), weights_key)
+        return None
+    manifest = WeightManifest.from_wire(frame)
+    assembler = StripedAssembler(manifest)
+    for ref in manifest.chunks:
+        try:
+            data = client.get_bytes(_chunk_key(prefix, ref.cid, ref.digest))
+        except Exception:  # noqa: BLE001 — transient store error == miss
+            log.exception("object-store chunk fetch failed (cid=%d)",
+                          ref.cid)
+            return None
+        if data is None or not assembler.add(ref.cid, data):
+            log.warning("object-store chunk %d missing or corrupt "
+                        "(digest %s); not serving", ref.cid, ref.digest)
+            return None
+    log.info("fetched %d chunks / %.1f MiB from object store",
+             len(manifest.chunks), manifest.total_bytes / 2**20)
+    return assembler.params()
+
+
+__all__ = ["make_store_client", "weights_prefix", "chunk_digest",
+           "publish_weights_to_store", "fetch_weights_from_store"]

@@ -1,0 +1,345 @@
+"""Striped peer weight streaming — the fast-start arrival plane.
+
+A joining worker pulls the model's weight tree as CONTENT-ADDRESSED
+chunks striped in parallel across every live replica serving the same
+weights key (docs/elasticity.md arrival ladder). The single-peer pull
+in streaming.py remains as the degraded path; this module adds what a
+spot fleet actually needs:
+
+  * a deterministic chunk manifest with per-chunk xxhash64 digests, so
+    a corrupted chunk is detected at the puller and NEVER assembled —
+    it is re-fetched from a DIFFERENT donor;
+  * resume-after-donor-death: a donor that dies mid-stream only costs
+    its unserved chunks, which are re-striped over the survivors;
+  * donor-side bandwidth budgeting exactly like the PR-8 KVBM offload
+    path — device gathers ride the scheduler's dispatch/drain gap and
+    a DYNT_WEIGHT_STREAM_BW_FRAC duty-cycle fraction paces them, so a
+    donor's decode ITL does not regress while it seeds a newcomer;
+  * fallback to the G4 object store (weights/objstore.py) when no live
+    peer serves the model.
+
+Wire protocol (the `weights` endpoint, multiplexed with the legacy
+full-stream pull — an empty body keeps the old behavior):
+
+    {"weights_manifest": true}   -> one manifest frame (to_wire below)
+    {"weights_chunks": [cid...]} -> {"cid", "digest", "data"} frames
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from ..runtime.metrics import WEIGHT_STREAM_CHUNKS
+
+log = get_logger("weights.striped")
+
+STRIPE_CHUNK_BYTES = 4 * 2**20
+
+
+def chunk_digest(data: bytes) -> str:
+    import xxhash
+
+    return xxhash.xxh64_hexdigest(data)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkRef:
+    """One content-addressed slice of one parameter's raw bytes."""
+
+    cid: int      # global chunk id == position in manifest order
+    param: int    # index into the manifest's param list
+    offset: int   # byte offset within that param's buffer
+    size: int
+    digest: str   # xxhash64 hex of the chunk bytes
+
+    def to_wire(self) -> list:
+        return [self.param, self.offset, self.size, self.digest]
+
+
+class WeightManifest:
+    """Deterministic chunking of a flattened param list. Two replicas
+    holding the same weights build byte-identical manifests, which is
+    what lets a puller stripe one logical transfer across N donors and
+    re-stripe the remainder when one dies."""
+
+    def __init__(self, weights_key: str, params: list[dict],
+                 chunks: list[ChunkRef],
+                 chunk_bytes: int = STRIPE_CHUNK_BYTES) -> None:
+        self.weights_key = weights_key
+        self.params = params          # [{path, dtype, shape, nbytes}]
+        self.chunks = chunks
+        self.chunk_bytes = chunk_bytes
+
+    @classmethod
+    def build(cls, flat: Sequence[tuple[str, np.ndarray]],
+              weights_key: str,
+              chunk_bytes: int = STRIPE_CHUNK_BYTES) -> "WeightManifest":
+        params: list[dict] = []
+        chunks: list[ChunkRef] = []
+        for pi, (path, arr) in enumerate(flat):
+            data = np.ascontiguousarray(arr).tobytes()
+            params.append({"path": path, "dtype": str(arr.dtype),
+                           "shape": list(np.shape(arr)),
+                           "nbytes": len(data)})
+            n = max(1, -(-len(data) // chunk_bytes))
+            for ci in range(n):
+                lo = ci * chunk_bytes
+                piece = data[lo: lo + chunk_bytes]
+                chunks.append(ChunkRef(
+                    cid=len(chunks), param=pi, offset=lo,
+                    size=len(piece), digest=chunk_digest(piece)))
+        return cls(weights_key, params, chunks, chunk_bytes)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(p["nbytes"] for p in self.params)
+
+    def to_wire(self) -> dict:
+        return {"manifest": True, "weights_key": self.weights_key,
+                "chunk_bytes": self.chunk_bytes, "params": self.params,
+                "chunks": [c.to_wire() for c in self.chunks]}
+
+    @classmethod
+    def from_wire(cls, frame: dict) -> "WeightManifest":
+        chunks = [ChunkRef(cid=i, param=c[0], offset=c[1], size=c[2],
+                           digest=c[3])
+                  for i, c in enumerate(frame["chunks"])]
+        return cls(frame["weights_key"], frame["params"], chunks,
+                   frame.get("chunk_bytes", STRIPE_CHUNK_BYTES))
+
+
+class StripedAssembler:
+    """Digest-verifying reassembly. A chunk whose bytes do not hash to
+    the manifest digest is REJECTED here — the integrity gate that
+    guarantees corrupted data is never served — and the puller re-fetches
+    it from another donor."""
+
+    def __init__(self, manifest: WeightManifest) -> None:
+        self.manifest = manifest
+        self._bufs: list[bytearray] = [
+            bytearray(p["nbytes"]) for p in manifest.params]
+        self._have: set[int] = set()
+
+    def add(self, cid: int, data: bytes) -> bool:
+        """Verify + place one chunk. False = digest/size mismatch (the
+        chunk was NOT placed); True = placed (idempotent on repeats)."""
+        if not 0 <= cid < len(self.manifest.chunks):
+            return False
+        ref = self.manifest.chunks[cid]
+        if len(data) != ref.size or chunk_digest(data) != ref.digest:
+            WEIGHT_STREAM_CHUNKS.labels(outcome="digest_mismatch").inc()
+            return False
+        if cid not in self._have:
+            buf = self._bufs[ref.param]
+            buf[ref.offset: ref.offset + ref.size] = data
+            self._have.add(cid)
+        WEIGHT_STREAM_CHUNKS.labels(outcome="verified").inc()
+        return True
+
+    @property
+    def missing(self) -> list[int]:
+        return [c.cid for c in self.manifest.chunks
+                if c.cid not in self._have]
+
+    @property
+    def complete(self) -> bool:
+        return len(self._have) == len(self.manifest.chunks)
+
+    def params(self) -> dict[str, np.ndarray]:
+        assert self.complete, "assembling an incomplete weight tree"
+        out: dict[str, np.ndarray] = {}
+        for meta, buf in zip(self.manifest.params, self._bufs):
+            out[meta["path"]] = np.frombuffer(
+                bytes(buf), dtype=np.dtype(meta["dtype"])
+            ).reshape(meta["shape"]).copy()
+        return out
+
+
+class BandwidthBudget:
+    """Donor-side duty-cycle pacing — the PR-8 offload formula: after a
+    serving gather that cost g seconds, defer the next by g*(1/frac - 1)
+    so weight streaming occupies at most `frac` of the donor's gather
+    bandwidth and the in-flight decode batch keeps its ITL."""
+
+    def __init__(self, frac: float) -> None:
+        self.frac = min(max(float(frac), 0.01), 1.0)
+        self.deferred_total = 0.0
+
+    def defer_after(self, cost_secs: float) -> float:
+        if self.frac >= 1.0:
+            return 0.0
+        defer = max(0.0, cost_secs) * (1.0 / self.frac - 1.0)
+        self.deferred_total += defer
+        return defer
+
+
+# -- striped pull core ------------------------------------------------------
+#
+# The control loop is transport-agnostic: `fetch_chunks(donor, cids)`
+# yields (cid, data) pairs and raises (or ends early) when the donor
+# dies. Tests drive it with fakes; pull_weights_striped below binds it
+# to the request plane.
+
+async def pull_striped(
+    manifest: WeightManifest,
+    donors: Sequence[object],
+    fetch_chunks,  # async fn (donor, cids) -> AsyncIterator[(cid, bytes)]
+    deadline: Optional[float] = None,
+) -> Optional[dict[str, np.ndarray]]:
+    """Stripe the manifest over `donors`, re-striping failures until the
+    tree is complete or no donors survive. Returns the assembled
+    path-addressed host arrays, or None (caller falls back)."""
+    assembler = StripedAssembler(manifest)
+    alive: list = list(donors)
+    # cid -> donors that already failed it (death or corruption); a
+    # re-fetch prefers any donor NOT in this set, so a corrupting donor
+    # cannot re-serve the same bad chunk forever.
+    tainted: dict[int, set] = {}
+    round_no = 0
+    while alive and not assembler.complete:
+        if deadline is not None and time.monotonic() > deadline:
+            log.warning("striped pull timed out with %d/%d chunks",
+                        len(manifest.chunks) - len(assembler.missing),
+                        len(manifest.chunks))
+            return None
+        round_no += 1
+        assignment: dict = {d: [] for d in alive}
+        order = list(alive)
+        for i, cid in enumerate(assembler.missing):
+            bad = tainted.get(cid, ())
+            pool = [d for d in order if d not in bad] or order
+            assignment[pool[i % len(pool)]].append(cid)
+
+        async def _one(donor, cids: list[int]):
+            """Returns (donor, unserved_cids, died)."""
+            remaining = set(cids)
+            try:
+                async for cid, data in fetch_chunks(donor, cids):
+                    if assembler.add(cid, data):
+                        remaining.discard(cid)
+                    else:
+                        tainted.setdefault(cid, set()).add(donor)
+            except Exception as exc:  # noqa: BLE001 — donor death is an
+                # expected event on a spot fleet, not an error
+                log.warning("donor %s died mid-stripe (%r); re-striping "
+                            "%d chunks", donor, exc, len(remaining))
+                for cid in remaining:
+                    tainted.setdefault(cid, set()).add(donor)
+                return donor, sorted(remaining), True
+            return donor, sorted(remaining), False
+
+        results = await asyncio.gather(
+            *(_one(d, cids) for d, cids in assignment.items() if cids))
+        restriped = 0
+        for donor, unserved, died in results:
+            if died:
+                alive.remove(donor)
+                restriped += len(unserved)
+        if restriped and alive and not assembler.complete:
+            WEIGHT_STREAM_CHUNKS.labels(outcome="restriped").inc(restriped)
+        if alive and not assembler.complete:
+            # Every remaining chunk tainted on every live donor (death
+            # OR corruption): no assignment can make progress — bail
+            # instead of spinning.
+            if all(set(alive) <= tainted.get(cid, set())
+                   for cid in assembler.missing):
+                log.warning("all donors serve corrupt data for %d chunks",
+                            len(assembler.missing))
+                return None
+    if not assembler.complete:
+        log.warning("striped pull exhausted donors with %d chunks missing",
+                    len(assembler.missing))
+        return None
+    log.info("striped pull complete: %d chunks / %.1f MiB from %d donors "
+             "in %d round(s)", len(manifest.chunks),
+             manifest.total_bytes / 2**20, len(donors), round_no)
+    return assembler.params()
+
+
+async def pull_weights_striped(
+    runtime, namespace: str, component: str,
+    expected_key: Optional[str] = None,
+    max_donors: int = 4,
+    timeout: float = 300.0,
+) -> Optional[dict[str, np.ndarray]]:
+    """Request-plane binding of the striped pull: discover live donors on
+    the `weights` endpoint, fetch the manifest from one, stripe the chunk
+    space across up to `max_donors` of them. None on any failure — the
+    caller walks down the arrival ladder (single-peer, object store,
+    checkpoint, init)."""
+    from ..runtime.push_router import PushRouter
+
+    endpoint = (runtime.namespace(namespace).component(component)
+                .endpoint("weights"))
+    router = PushRouter(endpoint.client(), mode="round_robin")
+    try:
+        await router.client.start()
+        try:
+            await router.client.wait_for_instances(1, timeout=5.0)
+        except asyncio.TimeoutError:
+            return None
+        donors = router.available()[: max(1, max_donors)]
+        if not donors:
+            return None
+        manifest: Optional[WeightManifest] = None
+        for iid in donors:
+            try:
+                async for frame in router.generate(
+                        {"weights_manifest": True}, instance_id=iid):
+                    if frame.get("error"):
+                        log.warning("manifest fetch from %x failed: %s",
+                                    iid, frame["error"])
+                        break
+                    if frame.get("manifest"):
+                        if (expected_key is not None
+                                and frame.get("weights_key")
+                                != expected_key):
+                            log.warning(
+                                "peer serves %r, we need %r; not pulling",
+                                frame.get("weights_key"), expected_key)
+                            return None
+                        manifest = WeightManifest.from_wire(frame)
+                        break
+            except Exception:  # noqa: BLE001 — try the next donor
+                log.exception("manifest fetch from %x failed", iid)
+            if manifest is not None:
+                break
+        if manifest is None:
+            return None
+
+        async def fetch_chunks(donor, cids):
+            async for frame in router.generate(
+                    {"weights_chunks": cids}, instance_id=donor):
+                if frame.get("error"):
+                    raise RuntimeError(frame["error"])
+                yield frame["cid"], frame["data"]
+
+        return await pull_striped(
+            manifest, donors, fetch_chunks,
+            deadline=time.monotonic() + timeout)
+    except Exception:  # noqa: BLE001 — any failure -> ladder fallback
+        log.exception("striped weight pull failed")
+        return None
+    finally:
+        await router.client.close()
+
+
+def encode_chunk_frames(manifest: WeightManifest,
+                        param_bytes: Sequence[bytes],
+                        cids: Iterable[int]):
+    """Donor-side frames for a chunk-subset request. `param_bytes` is
+    the donor's cached per-param raw buffers in manifest order."""
+    for cid in cids:
+        if not 0 <= cid < len(manifest.chunks):
+            yield {"error": f"unknown chunk id {cid}"}
+            return
+        ref = manifest.chunks[cid]
+        data = param_bytes[ref.param][ref.offset: ref.offset + ref.size]
+        WEIGHT_STREAM_CHUNKS.labels(outcome="served").inc()
+        yield {"cid": cid, "digest": ref.digest, "data": data}

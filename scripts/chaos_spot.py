@@ -1,0 +1,71 @@
+"""Chaos-spot CI driver: continuously evict+replace mocker workers
+under a rising open-loop ramp and assert the fast-start plane made the
+churn invisible — zero client-visible errors, streams bit-identical to
+an uneviced run, SLO goodput held, every replacement's first token
+inside the pinned cold-start budget, and capacity tracking the
+planner's wish after every cycle (docs/elasticity.md arrival ladder).
+
+Headless, CPU-only, chip-free: everything runs in-process through
+dynamo_tpu.mocker.spot_chaos. Exits nonzero when any assertion fails,
+so the chaos-spot job gates on the seconds-scale arrival contract.
+
+    python scripts/chaos_spot.py --out chaos-spot
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("chaos_spot")
+    parser.add_argument("--out", default="chaos-spot",
+                        help="report output directory")
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller ramp / one cycle (local smoke)")
+    parser.add_argument("--cycles", type=int, default=None,
+                        help="override evict+replace cycle count")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+
+    from dynamo_tpu.mocker.spot_chaos import SpotChaosParams, run_scenario
+
+    params = SpotChaosParams()
+    if args.quick:
+        params = SpotChaosParams(n_workers=2, n_streams=10,
+                                 evict_cycles=1, streams_before_evict=3)
+    if args.cycles is not None:
+        params.evict_cycles = args.cycles
+    report = asyncio.run(run_scenario(params))
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "chaos_spot_report.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+
+    print(f"report: {path}")
+    for chk in report["assertions"]:
+        mark = "PASS" if chk["ok"] else "FAIL"
+        print(f"  [{mark}] {chk['name']}")
+        if not chk["ok"]:
+            print(f"         {json.dumps(chk['detail'])[:400]}")
+    for n, cyc in enumerate(report["spot"]["cycles"]):
+        cold = cyc["coldstart"] or {}
+        print(f"cycle {n}: first token in "
+              f"{(cold.get('total_secs') or 0):.2f}s "
+              f"(budget {params.coldstart_budget_secs:.2f}s), capacity "
+              f"recovered in {(cyc['recovered_secs'] or -1):.2f}s")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

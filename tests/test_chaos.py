@@ -446,3 +446,37 @@ class TestDrainChaos:
             assert report["passed"], failed
 
         run(body(), timeout=240.0)
+
+
+@pytest.mark.slow
+class TestSpotChurnRamp:
+    """Chaos-spot scenario (docs/elasticity.md fast-start plane): a
+    rising open-loop ramp is served while workers are continuously
+    evicted and replaced by cold arrivals walking the
+    fetch->load->compile->register->first_token ladder. Asserted from
+    the JSON report (the chaos-spot CI artifact): zero client-visible
+    errors, every stream bit-identical to an uneviced baseline, SLO
+    goodput held through the churn, at least one live stream migrated,
+    every replacement's first token inside the pinned cold-start
+    budget, and capacity recovering to the planner's published wish
+    after every cycle."""
+
+    def test_continuous_evict_replace_holds_slo_and_budget(self, run,
+                                                           tmp_path):
+        from dynamo_tpu.mocker.spot_chaos import (
+            SpotChaosParams,
+            run_scenario,
+        )
+
+        params = SpotChaosParams(n_workers=2, n_streams=12,
+                                 evict_cycles=1, streams_before_evict=3)
+
+        async def body():
+            report = await run_scenario(params)
+            path = _write_chaos_report("chaos_spot", report,
+                                       default_dir=str(tmp_path))
+            print(f"spot scenario report: {path}")
+            failed = [c for c in report["assertions"] if not c["ok"]]
+            assert report["passed"], failed
+
+        run(body(), timeout=240.0)

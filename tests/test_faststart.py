@@ -1,0 +1,313 @@
+"""Fast-start plane (docs/elasticity.md, ISSUE 17): striped peer weight
+streaming — chunk-manifest integrity, resume-after-donor-death, donor
+bandwidth budgeting —, the G4 object-store fallback, the persistent
+compile-cache sync, and the 2-worker E2E striped arrival."""
+
+import asyncio
+import json
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+import jax
+
+from dynamo_tpu.weights.striped import (
+    BandwidthBudget,
+    StripedAssembler,
+    WeightManifest,
+    chunk_digest,
+    encode_chunk_frames,
+    pull_striped,
+)
+
+
+def _flat(seed: int = 0, n: int = 3, size: int = 700):
+    rng = np.random.default_rng(seed)
+    return [(f"layer{i}/w", rng.standard_normal(size).astype(np.float32))
+            for i in range(n)]
+
+
+def _bufs(flat):
+    return [np.ascontiguousarray(a).tobytes() for _, a in flat]
+
+
+class TestManifest:
+    def test_deterministic_across_replicas(self):
+        m1 = WeightManifest.build(_flat(), "k:1", chunk_bytes=256)
+        m2 = WeightManifest.build(_flat(), "k:1", chunk_bytes=256)
+        assert m1.to_wire() == m2.to_wire()
+        assert len(m1.chunks) > len(m1.params)  # multi-chunk params
+
+    def test_wire_roundtrip(self):
+        m = WeightManifest.build(_flat(), "k:rt", chunk_bytes=256)
+        back = WeightManifest.from_wire(json.loads(json.dumps(
+            {**m.to_wire(), "chunks": [c.to_wire() for c in m.chunks]})))
+        assert back.weights_key == "k:rt"
+        assert [c.to_wire() for c in back.chunks] == \
+            [c.to_wire() for c in m.chunks]
+        assert back.total_bytes == m.total_bytes
+
+    def test_assembler_roundtrip_and_idempotence(self):
+        flat = _flat()
+        m = WeightManifest.build(flat, "k:a", chunk_bytes=256)
+        asm = StripedAssembler(m)
+        bufs = _bufs(flat)
+        for frame in encode_chunk_frames(m, bufs, range(len(m.chunks))):
+            assert asm.add(frame["cid"], frame["data"])
+            assert asm.add(frame["cid"], frame["data"])  # repeat is fine
+        assert asm.complete and not asm.missing
+        out = asm.params()
+        for path, arr in flat:
+            np.testing.assert_array_equal(out[path], arr)
+
+    def test_corrupt_chunk_rejected_never_assembled(self):
+        flat = _flat()
+        m = WeightManifest.build(flat, "k:c", chunk_bytes=256)
+        asm = StripedAssembler(m)
+        good = dict(
+            (f["cid"], f["data"])
+            for f in encode_chunk_frames(m, _bufs(flat),
+                                         range(len(m.chunks))))
+        evil = b"\x00" * m.chunks[0].size
+        assert chunk_digest(evil) != m.chunks[0].digest
+        assert asm.add(0, evil) is False
+        assert 0 in asm.missing  # the bad bytes were NOT placed
+        assert asm.add(0, good[0][:-1]) is False  # size mismatch
+        for cid, data in good.items():
+            asm.add(cid, data)
+        assert asm.complete
+        np.testing.assert_array_equal(asm.params()["layer0/w"], flat[0][1])
+
+    def test_unknown_cid_yields_error_frame(self):
+        flat = _flat(n=1)
+        m = WeightManifest.build(flat, "k:e", chunk_bytes=256)
+        frames = list(encode_chunk_frames(m, _bufs(flat), [0, 999]))
+        assert frames[0]["cid"] == 0
+        assert "unknown chunk id" in frames[-1]["error"]
+
+
+class TestBandwidthBudget:
+    def test_pr8_duty_cycle_formula(self):
+        b = BandwidthBudget(0.25)
+        assert b.defer_after(0.1) == pytest.approx(0.3)  # g*(1/f - 1)
+        assert b.deferred_total == pytest.approx(0.3)
+
+    def test_full_fraction_never_defers(self):
+        assert BandwidthBudget(1.0).defer_after(5.0) == 0.0
+
+    def test_frac_clamped(self):
+        assert BandwidthBudget(0.0).frac == 0.01
+        assert BandwidthBudget(7.0).frac == 1.0
+        assert BandwidthBudget(0.5).defer_after(-1.0) == 0.0
+
+
+def _fake_donors(manifest, bufs, *, corrupt=None, dies_after=None):
+    """fetch_chunks fake: donor 'names' are strings. `corrupt` maps
+    donor -> set of cids it serves bad bytes for; `dies_after` maps
+    donor -> number of chunks it serves before raising."""
+    corrupt = corrupt or {}
+    dies_after = dies_after or {}
+
+    async def fetch_chunks(donor, cids):
+        served = 0
+        for frame in encode_chunk_frames(manifest, bufs, cids):
+            if donor in dies_after and served >= dies_after[donor]:
+                raise ConnectionError(f"{donor} evicted")
+            served += 1
+            data = frame["data"]
+            if frame["cid"] in corrupt.get(donor, ()):
+                data = b"\xff" * len(data)
+            yield frame["cid"], data
+
+    return fetch_chunks
+
+
+class TestStripedPull:
+    def _manifest(self):
+        flat = _flat(n=4, size=900)
+        return flat, WeightManifest.build(flat, "k:p", chunk_bytes=256)
+
+    def test_stripes_across_all_donors(self, run):
+        flat, m = self._manifest()
+        out = run(pull_striped(
+            m, ["d0", "d1", "d2"], _fake_donors(m, _bufs(flat))))
+        for path, arr in flat:
+            np.testing.assert_array_equal(out[path], arr)
+
+    def test_corrupting_donor_refetched_from_another_peer(self, run):
+        flat, m = self._manifest()
+        fetch = _fake_donors(m, _bufs(flat), corrupt={"bad": {0, 1, 2}})
+        out = run(pull_striped(m, ["bad", "good"], fetch))
+        assert out is not None
+        for path, arr in flat:
+            np.testing.assert_array_equal(out[path], arr)
+
+    def test_all_donors_corrupt_bails_not_spins(self, run):
+        flat, m = self._manifest()
+        all_cids = set(range(len(m.chunks)))
+        fetch = _fake_donors(m, _bufs(flat),
+                             corrupt={"b1": all_cids, "b2": all_cids})
+        assert run(pull_striped(m, ["b1", "b2"], fetch),
+                   timeout=30.0) is None
+
+    def test_donor_death_restripes_over_survivors(self, run):
+        flat, m = self._manifest()
+        fetch = _fake_donors(m, _bufs(flat), dies_after={"dying": 1})
+        out = run(pull_striped(m, ["dying", "live"], fetch))
+        assert out is not None
+        for path, arr in flat:
+            np.testing.assert_array_equal(out[path], arr)
+
+    def test_every_donor_dead_returns_none(self, run):
+        flat, m = self._manifest()
+        fetch = _fake_donors(m, _bufs(flat),
+                             dies_after={"d0": 0, "d1": 1})
+        assert run(pull_striped(m, ["d0", "d1"], fetch)) is None
+
+
+class TestObjectStoreFallback:
+    def test_publish_fetch_roundtrip(self, tmp_path):
+        from dynamo_tpu.weights.objstore import (
+            fetch_weights_from_store,
+            make_store_client,
+            publish_weights_to_store,
+        )
+
+        flat = _flat()
+        store = make_store_client(str(tmp_path))
+        n = publish_weights_to_store(store, "m:os", flat)
+        assert n >= len(flat)
+        out = fetch_weights_from_store(store, "m:os")
+        for path, arr in flat:
+            np.testing.assert_array_equal(out[path], arr)
+
+    def test_missing_key_and_corrupt_chunk_return_none(self, tmp_path):
+        from dynamo_tpu.weights.objstore import (
+            fetch_weights_from_store,
+            make_store_client,
+            publish_weights_to_store,
+            weights_prefix,
+        )
+
+        store = make_store_client(str(tmp_path))
+        assert fetch_weights_from_store(store, "m:none") is None
+        flat = _flat(n=1)
+        publish_weights_to_store(store, "m:corr", flat)
+        prefix = weights_prefix("m:corr")
+        chunks_dir = tmp_path / prefix / "chunks"
+        victim = sorted(chunks_dir.iterdir())[0]
+        victim.write_bytes(b"\x00" * victim.stat().st_size)
+        assert fetch_weights_from_store(store, "m:corr") is None
+
+    def test_wrong_key_under_prefix_not_served(self, tmp_path):
+        from dynamo_tpu.weights.objstore import (
+            fetch_weights_from_store,
+            make_store_client,
+            weights_prefix,
+        )
+
+        store = make_store_client(str(tmp_path))
+        m = WeightManifest.build(_flat(n=1), "m:other", chunk_bytes=256)
+        prefix = weights_prefix("m:mine")
+        store.put_bytes(f"{prefix}/manifest.json",
+                        json.dumps(m.to_wire()).encode())
+        assert fetch_weights_from_store(store, "m:mine") is None
+
+
+class TestCompileCacheSync:
+    def test_up_down_roundtrip(self, tmp_path, monkeypatch):
+        from dynamo_tpu.engine import compile_cache
+
+        store_root = tmp_path / "store"
+        local_a = tmp_path / "node-a"
+        local_b = tmp_path / "node-b"
+        local_a.mkdir()
+        local_b.mkdir()
+        (local_a / "xla_key1.bin").write_bytes(b"compiled-1")
+        (local_a / "sub").mkdir()
+        (local_a / "sub" / "xla_key2.bin").write_bytes(b"compiled-2")
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_STORE", str(store_root))
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_DIR", str(local_a))
+        assert compile_cache.sync_up() == 2
+        assert compile_cache.sync_up() == 0  # idempotent
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_DIR", str(local_b))
+        assert compile_cache.sync_down() == 2
+        assert (local_b / "xla_key1.bin").read_bytes() == b"compiled-1"
+        assert (local_b / "sub" / "xla_key2.bin").read_bytes() == \
+            b"compiled-2"
+        assert compile_cache.sync_down() == 0  # nothing new
+
+    def test_sync_is_noop_without_store_knob(self, tmp_path, monkeypatch):
+        from dynamo_tpu.engine import compile_cache
+
+        monkeypatch.delenv("DYNT_COMPILE_CACHE_STORE", raising=False)
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_DIR", str(tmp_path))
+        assert compile_cache.sync_down() == 0
+        assert compile_cache.sync_up() == 0
+
+    def test_traversal_names_in_index_are_skipped(self, tmp_path,
+                                                  monkeypatch):
+        from dynamo_tpu.engine import compile_cache
+        from dynamo_tpu.weights.objstore import make_store_client
+
+        store_root = tmp_path / "store"
+        local = tmp_path / "local"
+        local.mkdir()
+        store = make_store_client(str(store_root))
+        store.put_bytes("compile-cache/index.json", json.dumps(
+            {"entries": ["../../etc/passwd", "/abs/path", "ok.bin"]}
+        ).encode())
+        store.put_bytes("compile-cache/files/ok.bin", b"fine")
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_STORE", str(store_root))
+        monkeypatch.setenv("DYNT_COMPILE_CACHE_DIR", str(local))
+        assert compile_cache.sync_down() == 1
+        assert (local / "ok.bin").read_bytes() == b"fine"
+        assert not (tmp_path / "etc").exists()
+
+
+class TestStripedArrivalE2E:
+    def test_worker_pulls_striped_from_live_peer(self, run,
+                                                 mem_runtime_config,
+                                                 monkeypatch):
+        """Arrival-ladder E2E: a cold worker stripe-pulls the weight
+        tree from a live replica over the request plane, lands with
+        weights_source == "peer_striped", identical parameters, and a
+        completed cold-start ladder."""
+        from dynamo_tpu.engine import RunnerConfig, TpuWorker
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        monkeypatch.setenv("DYNT_WEIGHT_STRIPE", "1")
+
+        async def body():
+            cluster = uuid.uuid4().hex
+            ns = uuid.uuid4().hex
+            cfg = RunnerConfig(page_size=4, num_pages=64, max_batch=4,
+                               max_pages_per_seq=16,
+                               prefill_buckets=(8, 16))
+            rt_a = await DistributedRuntime(
+                mem_runtime_config(cluster)).start()
+            worker_a = TpuWorker(rt_a, model_name="tiny-test",
+                                 namespace=ns, runner_config=cfg,
+                                 warmup=False)
+            await worker_a.start()
+            rt_b = await DistributedRuntime(
+                mem_runtime_config(cluster)).start()
+            worker_b = TpuWorker(rt_b, model_name="tiny-test",
+                                 namespace=ns, runner_config=cfg,
+                                 warmup=False, weights_from_peer=True)
+            await worker_b.start()
+            assert worker_b.weights_source == "peer_striped"
+            np.testing.assert_array_equal(
+                np.asarray(worker_a.runner.params["embed"]),
+                np.asarray(worker_b.runner.params["embed"]))
+            assert worker_b.coldstart is not None
+            rep = worker_b.coldstart.report()
+            assert (rep["phases"]["fetch"] or 0.0) > 0.0
+            await worker_b.close()
+            await worker_a.close()
+            await rt_b.shutdown()
+            await rt_a.shutdown()
+
+        run(body(), timeout=180)

@@ -140,6 +140,44 @@ class TestEvictScenario:
             if _alive(proc.pid):
                 proc.kill()
 
+    def test_respawn_after_replaces_evicted_capacity(self, run):
+        """Spot fleets REPLACE evicted workers: with respawn_after_ms
+        the scenario relaunches the target from its registered argv
+        after the modeled reprovision delay — the process-level path
+        the chaos-spot gate times (docs/elasticity.md)."""
+        import subprocess
+
+        argv = [sys.executable, "-c",
+                "import signal, sys, time\n"
+                "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+                "time.sleep(60)"]
+        proc = subprocess.Popen(argv)
+        respawned = []
+        try:
+            time.sleep(0.2)
+
+            async def body():
+                async with fault_service() as faults:
+                    await faults.register("spot", proc.pid, argv=argv)
+                    t0 = time.monotonic()
+                    out = await faults.run_scenario(
+                        "evict", target="spot", deadline_ms=5000,
+                        respawn_after_ms=150)
+                    kinds = [s["type"] for s in out["steps"]]
+                    assert kinds == ["sigterm", "evict", "respawn"]
+                    assert time.monotonic() - t0 >= 0.15
+                    new_pid = out["steps"][-1]["detail"]["pid"]
+                    respawned.append(new_pid)
+                    assert new_pid != proc.pid and _alive(new_pid)
+
+            run(body(), timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            for pid in respawned:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(pid, signal.SIGKILL)
+
     def test_sigterm_ignorer_gets_sigkill_at_deadline(self, run):
         import subprocess
 

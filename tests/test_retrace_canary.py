@@ -141,3 +141,59 @@ class TestRetraceCanary:
             "steady-state decode recompiled: a per-request value leaked "
             "into a jit cache key (DJ1xx hazard) — "
             f"{_delta(steady, _snapshot())}")
+
+    def test_prewarm_compiles_exactly_the_predicted_key_space(self):
+        """The fast-start pre-warm pass (docs/elasticity.md): prewarm()
+        compiles the registry-predicted steady-state surface — decode
+        (one key), EVERY prefill bucket, the configured spec-verify
+        combo — and NOTHING after it compiles again: a warm-cache
+        arrival that replays these from the persistent compile cache
+        serves its whole steady state without a single trace."""
+        pre = _snapshot()
+        runner = _runner()
+        if sum(_snapshot().values()) == sum(pre.values()):
+            pytest.skip("jax.monitoring compile events not observed")
+        base = _snapshot()
+        runner.prewarm(spec_widths=[2])
+        warm = _delta(base, _snapshot())
+        assert warm.get("decode") == 1, warm
+        assert warm.get("prefill") == len(runner.config.prefill_buckets), \
+            warm
+        assert warm.get("decode_spec") == 1, warm
+
+        # prewarm is idempotent — the warm-arrival shape
+        again = _snapshot()
+        runner.prewarm(spec_widths=[2])
+        assert _delta(again, _snapshot()) == {}, _delta(again, _snapshot())
+
+        # steady state after prewarm compiles NOTHING: every bucket,
+        # varying occupancy/lengths/seeds, and the spec-verify path
+        b, p = 4, 16
+        steady = _snapshot()
+        for n in (5, 12, 20):  # lands in buckets 8, 16, 32
+            runner.prefill_chunk(
+                np.full(n, 2, np.int32), 0,
+                np.arange(1, p + 1, dtype=np.int32)
+                % runner.config.num_pages,
+                n, (0.0, 1.0, 0, 0))
+        for step in range(4):
+            kv = np.asarray([4 + step, 5, 6, 4 + step], np.int32)
+            runner.decode(
+                np.zeros(b, np.int32), kv - 1,
+                np.tile(np.arange(1, p + 1, dtype=np.int32)
+                        % runner.config.num_pages, (b, 1)),
+                kv, np.asarray([1, step % 2, 1, 1], bool),
+                np.ones(b, np.float32), np.ones(b, np.float32),
+                np.zeros(b, np.int32), np.full(b, step, np.uint32))
+        runner.decode_spec(
+            np.zeros(b, np.int32), np.ones((b, 2), np.int32),
+            np.full(b, 7, np.int32),
+            np.tile(np.arange(1, p + 1, dtype=np.int32)
+                    % runner.config.num_pages, (b, 1)),
+            np.full(b, 8, np.int32), np.ones(b, bool),
+            np.ones(b, np.float32), np.ones(b, np.float32),
+            np.zeros(b, np.int32), np.zeros(b, np.uint32))
+        assert _delta(steady, _snapshot()) == {}, (
+            "post-prewarm steady state recompiled — the pre-warm pass "
+            "missed part of the predicted key space: "
+            f"{_delta(steady, _snapshot())}")

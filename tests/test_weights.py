@@ -149,9 +149,13 @@ class TestParamStreaming:
         finally:
             streaming.STREAM_CHUNK_BYTES = old
 
-    def test_worker_pulls_from_live_peer(self, run, mem_runtime_config):
+    def test_worker_pulls_from_live_peer(self, run, mem_runtime_config,
+                                         monkeypatch):
         """ModelExpress analog E2E: a cold worker pulls weights from a live
-        replica and ends up with identical parameters."""
+        replica and ends up with identical parameters. Striping is forced
+        off so this keeps covering the single-peer stream rung (the striped
+        rung has its own E2E in test_faststart.py)."""
+        monkeypatch.setenv("DYNT_WEIGHT_STRIPE", "0")
 
         async def body():
             cluster = uuid.uuid4().hex
