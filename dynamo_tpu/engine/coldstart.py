@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Optional
 
+from ..runtime import conformance
 from ..runtime.flight_recorder import get_recorder
 from ..runtime.logging import get_logger
 from ..runtime.metrics import (
@@ -92,6 +93,12 @@ class ColdStartLadder:
 
     def mark(self, name: str, seconds: float) -> None:
         assert name in PHASES, name
+        if self.total is not None:
+            # Ladder closed (first_token published the total + planner
+            # EWMA): a late mark — a lazy per-shape recompile after the
+            # first served token — must not mutate the settled record.
+            return
+        conformance.observe("coldstart", f"{self.worker}:{id(self)}", name)
         self.phases[name] = self.phases.get(name, 0.0) + seconds
         COLDSTART_PHASE_SECONDS.labels(
             worker=self.worker, phase=name).set(self.phases[name])

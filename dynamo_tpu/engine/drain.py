@@ -39,6 +39,7 @@ import asyncio
 import time
 from typing import Optional
 
+from ..runtime import conformance
 from ..runtime.config import env
 from ..runtime.logging import get_logger
 from ..runtime.metrics import DRAIN_DURATION_MS, DRAIN_SEQUENCES, DRAIN_STATE
@@ -62,6 +63,9 @@ def set_drain_state(instance_id: int, state: str) -> None:
             _STATE_CODE[state])
     except Exception:  # noqa: BLE001 — gauges must not block a drain
         pass
+    # Every ladder transition (real worker's and mocker's alike) flows
+    # through here: replay it against the drain_ladder protocol spec.
+    conformance.observe("drain_ladder", instance_id, state)
 
 
 class DrainCoordinator:

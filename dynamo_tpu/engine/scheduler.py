@@ -49,6 +49,16 @@ from .spec import BlockLookahead, NGramProposer, SlotSpec, propose_for
 log = get_logger("engine.scheduler")
 
 
+def _observe_preempt(instance: str, event: str) -> None:
+    """Feed the `preemption` lifecycle to the conformance monitor. The
+    instance key is scheduler-scoped (id(self) prefix) so a migrated
+    request replayed on a peer starts a fresh lifecycle there instead of
+    tripping park-after-migrated on the old one."""
+    from ..runtime.conformance import observe
+
+    observe("preemption", instance, event)
+
+
 @dataclasses.dataclass
 class _Seq:
     request: PreprocessedRequest
@@ -798,6 +808,7 @@ class InferenceScheduler:
                 self._parked.append(victim)
                 self.stats.preempt_parked += 1
                 PREEMPT_TOTAL.labels(kind="park").inc()
+                _observe_preempt(f"{id(self)}:{rid}", "park")
                 get_recorder().event(victim.record_id, "preempt",
                                      kind="park", pages=n_pages,
                                      tokens_preserved=len(victim.generated))
@@ -811,6 +822,7 @@ class InferenceScheduler:
                 victim.finished = True
                 self.stats.preempt_migrated += 1
                 PREEMPT_TOTAL.labels(kind="migrate").inc()
+                _observe_preempt(f"{id(self)}:{rid}", "migrate")
                 get_recorder().event(victim.record_id, "preempt",
                                      kind="migrate",
                                      tokens_preserved=len(victim.generated))
@@ -846,6 +858,7 @@ class InferenceScheduler:
             if seq.cancelled:
                 self._parked.remove(seq)
                 self._drop_parked(rid)
+                _observe_preempt(f"{id(self)}:{rid}", "drop")
                 continue
             deadline = seq.request.deadline
             if deadline is not None and deadline.expired():
@@ -854,6 +867,7 @@ class InferenceScheduler:
                 seq.finished = True
                 get_recorder().event(seq.record_id, "preempt",
                                      kind="expired")
+                _observe_preempt(f"{id(self)}:{rid}", "expire")
                 seq.emit(EngineOutput(
                     finish_reason="error",
                     error="deadline exceeded while preempted"))
@@ -887,6 +901,7 @@ class InferenceScheduler:
                 seq.finished = True
                 self.stats.preempt_migrated += 1
                 PREEMPT_TOTAL.labels(kind="migrate").inc()
+                _observe_preempt(f"{id(self)}:{rid}", "migrate")
                 seq.emit(EngineOutput(
                     finish_reason="migrate",
                     error="park bundle lost; replay elsewhere"))
@@ -909,6 +924,7 @@ class InferenceScheduler:
             seq.parked_pages = 0
             self.stats.preempt_resumed += 1
             PREEMPT_TOTAL.labels(kind="resume").inc()
+            _observe_preempt(f"{id(self)}:{rid}", "resume")
             get_recorder().event(seq.record_id, "preempt", kind="resume",
                                  tokens_preserved=len(seq.generated))
             log.info("resumed parked %s (%d tokens preserved)",

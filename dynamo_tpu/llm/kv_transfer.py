@@ -30,6 +30,8 @@ from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from ..runtime import conformance
+
 # Target bytes per kv_pull response frame (well under codec MAX_FRAME).
 TRANSFER_CHUNK_BYTES = 4 << 20
 
@@ -112,6 +114,14 @@ class StreamingTransfer(PendingTransfer):
 
     def append_pages(self, page_ids: list[int]) -> None:
         with self._cond:
+            if self.done or self.failed:
+                # Terminal: finish pinned the final page list (appending
+                # would corrupt it) or fail released the pages (appending
+                # would advertise freed — possibly reused — pages to the
+                # puller). Late chunk completions just drop.
+                return
+            conformance.observe("kv_stream_transfer", self.transfer_id,
+                                "append")
             self.page_ids.extend(int(p) for p in page_ids)
             self._cond.notify_all()
 
@@ -123,6 +133,15 @@ class StreamingTransfer(PendingTransfer):
         become expirable the instant it completes (racing a decode pull
         that is still being retried)."""
         with self._cond:
+            if self.failed or self.done:
+                # fail() already released the pages (a cancel racing the
+                # final chunk): resurrecting done=True here would restart
+                # the TTL and hand the puller page ids the pool may have
+                # reissued. A repeated finish must not restart the TTL
+                # either. First terminal event wins.
+                return
+            conformance.observe("kv_stream_transfer", self.transfer_id,
+                                "finish")
             self.page_ids = [int(p) for p in all_page_ids]
             self.first_token = int(first_token)
             self.done = True
@@ -133,6 +152,15 @@ class StreamingTransfer(PendingTransfer):
         """Prefill died mid-stream (cancel/error): wake waiters with the
         failure and release the pages iff no puller claimed the entry."""
         with self._cond:
+            if self.done or self.failed:
+                # done: the prompt pass COMPLETED before the cancel
+                # landed — the parked pages are a valid, pullable
+                # transfer and the TTL (restarted by finish) owns their
+                # release; aborting now would yank a healthy handoff out
+                # from under a decode pull. failed: already released.
+                return
+            conformance.observe("kv_stream_transfer", self.transfer_id,
+                                "fail")
             self.failed = True
             self._cond.notify_all()
         if self._table.claim(self.transfer_id) is not None:

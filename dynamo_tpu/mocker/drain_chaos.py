@@ -35,6 +35,7 @@ import uuid
 from typing import Optional
 
 from ..runtime import DistributedRuntime, RuntimeConfig
+from ..runtime import conformance
 from ..runtime.logging import get_logger
 from .engine import MockerConfig
 from .worker import MockerWorker
@@ -319,21 +320,27 @@ async def run_scenario(params: Optional[DrainChaosParams] = None,
     }
     knobs = ("DYNT_DRAIN_ENABLE", "DYNT_DRAIN_HANDOFF",
              "DYNT_DRAIN_DEADLINE_SECS",
-             "DYNT_DRAIN_ANNOUNCE_SETTLE_SECS")
+             "DYNT_DRAIN_ANNOUNCE_SETTLE_SECS", "DYNT_CONFORMANCE")
     prev = {key: os.environ.get(key) for key in knobs}
     try:
+        os.environ["DYNT_CONFORMANCE"] = "1"
+        conformance.reset_monitor()
         report["baseline"] = await run_drain_pass(params, evict=False)
         report["drain_handoff"] = await run_drain_pass(params, evict=True,
                                                        handoff=True)
         if fallback_pass:
             report["drain_replay"] = await run_drain_pass(
                 params, evict=True, handoff=False)
+        report["conformance"] = conformance.get_monitor().snapshot()
     finally:
         for key in knobs:
             if prev[key] is None:
                 os.environ.pop(key, None)
             else:
                 os.environ[key] = prev[key]
+        conformance.reset_monitor()
     report["assertions"] = evaluate(report)
+    report["assertions"].append(
+        conformance.chaos_assertion(report["conformance"]))
     report["passed"] = all(c["ok"] for c in report["assertions"])
     return report

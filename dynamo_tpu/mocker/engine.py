@@ -761,8 +761,11 @@ class MockerEngine:
             PREEMPT_TOTAL.labels(kind="park").inc()
         except Exception:  # noqa: BLE001 — metrics must not break sims
             pass
+        from ..runtime.conformance import observe
         from ..runtime.flight_recorder import get_recorder
 
+        observe("preemption",
+                f"{id(self)}:{victim.request.request_id}", "park")
         get_recorder().event(victim.request.request_id, "preempt",
                              kind="park",
                              tokens_preserved=victim.generated)
@@ -806,6 +809,10 @@ class MockerEngine:
                 break
             if seq.cancelled:
                 self._parked.remove(seq)
+                from ..runtime.conformance import observe
+
+                observe("preemption",
+                        f"{id(self)}:{seq.request.request_id}", "drop")
                 continue
             if seq.rank < waiting_rank or seq.rank < min_rank:
                 continue  # pressure persists: stay parked
@@ -835,8 +842,11 @@ class MockerEngine:
                 PREEMPT_TOTAL.labels(kind="resume").inc()
             except Exception:  # noqa: BLE001 — metrics must not break
                 pass
+            from ..runtime.conformance import observe
             from ..runtime.flight_recorder import get_recorder
 
+            observe("preemption",
+                    f"{id(self)}:{seq.request.request_id}", "resume")
             get_recorder().event(seq.request.request_id, "preempt",
                                  kind="resume",
                                  tokens_preserved=seq.generated)

@@ -44,6 +44,7 @@ from typing import Optional
 from ..planner.core import PdSplitPlanner
 from ..planner.metrics_source import parse_prometheus_text
 from ..runtime import DistributedRuntime, RuntimeConfig
+from ..runtime import conformance
 from ..runtime.logging import get_logger
 from .engine import MockerConfig
 from .loadgen import ramp_arrival_times, summarize_buckets
@@ -737,11 +738,15 @@ async def run_two_tenant_scenario(
     knobs = ("DYNT_ADMISSION_ENABLE", "DYNT_DEADLINE_SECS",
              "DYNT_ADMISSION_HALFLIFE_SECS", "DYNT_ADMISSION_MARGIN",
              "DYNT_PREEMPT_ENABLE", "DYNT_TENANT_RATE_LIMIT",
-             "DYNT_TENANT_WINDOW_SECS", "DYNT_TENANT_WEIGHTS")
+             "DYNT_TENANT_WINDOW_SECS", "DYNT_TENANT_WEIGHTS",
+             "DYNT_CONFORMANCE")
     prev = {key: os.environ.get(key) for key in knobs}
     try:
+        os.environ["DYNT_CONFORMANCE"] = "1"
+        conformance.reset_monitor()
         report["qos_off"] = await run_two_tenant_pass(params, qos_on=False)
         report["qos_on"] = await run_two_tenant_pass(params, qos_on=True)
+        report["conformance"] = conformance.get_monitor().snapshot()
     finally:
         from ..runtime.admission import reset_tenant_ledger
 
@@ -751,7 +756,10 @@ async def run_two_tenant_scenario(
             else:
                 os.environ[key] = prev[key]
         reset_tenant_ledger()
+        conformance.reset_monitor()
     report["assertions"] = evaluate_two_tenant(report)
+    report["assertions"].append(
+        conformance.chaos_assertion(report["conformance"]))
     report["passed"] = all(c["ok"] for c in report["assertions"])
     return report
 
@@ -767,19 +775,26 @@ async def run_scenario(params: Optional[OverloadParams] = None,
         "params": dataclasses.asdict(params),
     }
     knobs = ("DYNT_ADMISSION_ENABLE", "DYNT_DEADLINE_SECS",
-             "DYNT_ADMISSION_HALFLIFE_SECS", "DYNT_ADMISSION_MARGIN")
+             "DYNT_ADMISSION_HALFLIFE_SECS", "DYNT_ADMISSION_MARGIN",
+             "DYNT_CONFORMANCE")
     prev = {key: os.environ.get(key) for key in knobs}
     try:
+        os.environ["DYNT_CONFORMANCE"] = "1"
+        conformance.reset_monitor()
         report["ramp_off"] = await run_ramp_pass(params, loop_on=False)
         report["ramp_on"] = await run_ramp_pass(params, loop_on=True)
         if pd_sweep:
             report["pd_sweep"] = await run_pd_sweep(params)
+        report["conformance"] = conformance.get_monitor().snapshot()
     finally:
         for key in knobs:
             if prev[key] is None:
                 os.environ.pop(key, None)
             else:
                 os.environ[key] = prev[key]
+        conformance.reset_monitor()
     report["assertions"] = evaluate(report)
+    report["assertions"].append(
+        conformance.chaos_assertion(report["conformance"]))
     report["passed"] = all(c["ok"] for c in report["assertions"])
     return report

@@ -38,6 +38,7 @@ import uuid
 from typing import Optional
 
 from ..runtime import DistributedRuntime
+from ..runtime import conformance
 from ..runtime.logging import get_logger
 from .drain_chaos import _runtime_cfg
 from .engine import MockerConfig
@@ -420,18 +421,24 @@ async def run_scenario(params: Optional[SpotChaosParams] = None) -> dict:
         "DYNT_DRAIN_HANDOFF": "1",
         "DYNT_DRAIN_DEADLINE_SECS": str(params.drain_deadline_secs),
         "DYNT_DRAIN_ANNOUNCE_SETTLE_SECS": str(params.settle_secs),
+        "DYNT_CONFORMANCE": "1",
     }
     prev = {key: os.environ.get(key) for key in knobs}
     try:
         os.environ.update(knobs)
+        conformance.reset_monitor()
         report["baseline"] = await run_spot_pass(params, churn=False)
         report["spot"] = await run_spot_pass(params, churn=True)
+        report["conformance"] = conformance.get_monitor().snapshot()
     finally:
         for key, old in prev.items():
             if old is None:
                 os.environ.pop(key, None)
             else:
                 os.environ[key] = old
+        conformance.reset_monitor()
     report["assertions"] = evaluate(report)
+    report["assertions"].append(
+        conformance.chaos_assertion(report["conformance"]))
     report["passed"] = all(c["ok"] for c in report["assertions"])
     return report

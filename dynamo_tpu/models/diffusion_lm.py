@@ -143,6 +143,12 @@ def diffusion_generate_block(
         logits = bidirectional_forward(params, config, x,
                                        positions=positions, valid=valid)
         gen_logits = logits[:, tp:, :]
+        # [MASK] is a sentinel, never a committable token: an argmax
+        # that lands on it would freeze the mask into the output when
+        # the position is kept, so the id is barred from prediction.
+        vocab_ids = jnp.arange(gen_logits.shape[-1])
+        gen_logits = jnp.where(vocab_ids[None, None, :] == mask_id,
+                               -jnp.inf, gen_logits)
         key = jax.random.fold_in(base_key, s)
         gumbel = jax.random.gumbel(key, gen_logits.shape,
                                    dtype=jnp.float32)

@@ -380,6 +380,13 @@ _register("DYNT_OTLP_ENDPOINT", "", _str,
           "empty disables span export (ref: logging.rs OTLP init)")
 _register("DYNT_OTEL_SERVICE_NAME", "dynamo_tpu", _str,
           "service.name resource attribute on exported spans")
+_register("DYNT_CONFORMANCE", False, _bool,
+          "Runtime protocol-conformance monitor (runtime/conformance.py): "
+          "replay flight-recorder stamps, drain/breaker/coldstart/"
+          "transfer/preemption lifecycle events against the dynastate "
+          "protocol specs and count violations into "
+          "dynamo_protocol_violations_total. Chaos scenarios enable it "
+          "and assert zero violations")
 _register("DYNT_FLIGHT_RECORDER_SIZE", 256, _int,
           "Completed request timelines the per-process flight recorder "
           "retains (ring buffer behind /debug/requests)")

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from . import conformance
 from .config import env
 from .logging import current_request_id, get_logger
 
@@ -137,9 +138,16 @@ class FlightRecorder:
             return
         with self._lock:
             tl = self._inflight.get(rid)
-            if tl is not None:
-                tl.phases.setdefault(phase, time.time() if ts is None
-                                     else ts)
+            if tl is not None and phase not in tl.phases:
+                tl.phases[phase] = time.time() if ts is None else ts
+                if phase != "received":
+                    # Accepted first-write stamps replay against the
+                    # canonical phase machine (tools/dynastate/
+                    # protocols/flight_recorder.json); "received" is
+                    # the initial state, not an event. Observed under
+                    # the recorder lock so the monitor sees stamps in
+                    # acceptance order.
+                    conformance.observe("flight_recorder", rid, phase)
 
     def device(self, request_id: Optional[str], phase: str,
                device_ms: float = 0.0, host_ms: float = 0.0) -> None:
@@ -187,6 +195,7 @@ class FlightRecorder:
             tl.phases.setdefault("finished", time.time())
             tl.slow = bool(self.slow_ms) and tl.elapsed_ms() >= self.slow_ms
             self._completed.append(tl)
+            conformance.observe("flight_recorder", rid, "finished")
         if status not in ("ok", "cancelled", "shed"):
             # Errors and deadline overruns auto-dump; plain client
             # cancellations are normal stream teardown (e.g. a prefill

@@ -190,6 +190,19 @@ PREEMPT_TOTAL = Counter(
     "and decoding again)",
     ["kind"], registry=REGISTRY,
 )
+# Runtime protocol conformance (runtime/conformance.py): lifecycle
+# events the ProtocolMonitor observed that the dynastate spec machines
+# (tools/dynastate/protocols/) forbid. Rules keep the static ids:
+# DS101 = no transition for the event in the current state, DS201 =
+# event after a terminal state. Chaos scenarios assert this stays 0.
+PROTOCOL_VIOLATIONS = Counter(
+    "dynamo_protocol_violations_total",
+    "Observed lifecycle events forbidden by the dynastate protocol "
+    "specs, by protocol and rule (DS101 unhandled-event-in-state, "
+    "DS201 post-terminal-event). Nonzero means a live code path "
+    "diverged from the machine-checked protocol contract",
+    ["protocol", "rule"], registry=REGISTRY,
+)
 # Graceful drain plane (engine/drain.py; docs/fault-tolerance.md
 # departure ladder): how a departing worker vacated its live streams.
 DRAIN_STATE = Gauge(

@@ -271,6 +271,11 @@ class CircuitBreaker:
         if state == self.state:
             return
         self.state = state
+        from .conformance import observe
+
+        # The breaker machine (tools/dynastate/protocols/breaker.json)
+        # pins which trips exist; the new state is the event.
+        observe("breaker", id(self), state)
         if self._on_transition is not None:
             self._on_transition(state)
 

@@ -1,0 +1,24 @@
+"""DS201 api negative: every machine-driving method reads its spec'd
+terminal flags before mutating (first terminal event wins)."""
+
+
+class Session:
+    def __init__(self):
+        self.closed = False
+        self.failed = False
+        self.items = []
+
+    def update(self, item):
+        if self.closed or self.failed:
+            return
+        self.items.append(item)
+
+    def close(self):
+        if self.closed or self.failed:
+            return
+        self.closed = True
+
+    def fail(self):
+        if self.closed:
+            return
+        self.failed = True

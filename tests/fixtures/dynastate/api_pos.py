@@ -1,0 +1,22 @@
+"""DS201 api positives against specs_api/session.json: update reads
+neither terminal flag and fail skips its spec'd closed guard — a
+call racing or following close()/fail() mutates a settled
+lifecycle. close() itself is properly guarded."""
+
+
+class Session:
+    def __init__(self):
+        self.closed = False
+        self.failed = False
+        self.items = []
+
+    def update(self, item):
+        self.items.append(item)
+
+    def close(self):
+        if self.closed or self.failed:
+            return
+        self.closed = True
+
+    def fail(self):
+        self.failed = True

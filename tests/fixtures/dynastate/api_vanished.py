@@ -1,0 +1,20 @@
+"""DS501 api positive: the spec binds the terminal close event to
+Session.close, but the method no longer exists in the tree — the
+machine's terminal event lost its only emitter."""
+
+
+class Session:
+    def __init__(self):
+        self.closed = False
+        self.failed = False
+        self.items = []
+
+    def update(self, item):
+        if self.closed or self.failed:
+            return
+        self.items.append(item)
+
+    def fail(self):
+        if self.closed:
+            return
+        self.failed = True

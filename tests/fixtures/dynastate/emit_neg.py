@@ -1,0 +1,17 @@
+"""Wire-ordering negatives: the terminal done frame is the last
+statement of its block (nothing can follow it), and the looped
+terminal error emission breaks immediately — exactly-once holds."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    sock.send({"done": True})
+
+
+def send_error(sock, exc):
+    for _attempt in range(3):
+        if not sock.ready():
+            continue
+        sock.send({"error": str(exc)})
+        break

@@ -1,0 +1,17 @@
+"""Wire-ordering positives against specs_wire/stream.json: a chunk
+frame emitted lexically after the terminal done frame in the same
+block (DS201 — the stream already ended), and the terminal error
+frame emitted inside a loop without an immediate exit (DS501 — one
+instance's stream could terminate twice)."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    sock.send({"done": True})
+    sock.send({"chunk": -1, "data": b""})
+
+
+def send_error(sock, excs):
+    for exc in excs:
+        sock.send({"error": str(exc)})

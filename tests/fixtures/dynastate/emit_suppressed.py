@@ -1,0 +1,14 @@
+"""Suppressed variants of the DS201/DS501 wire positives, each
+citing the invariant that makes the flagged site safe."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    sock.send({"done": True})
+    sock.send({"chunk": -1, "data": b""})  # dynastate: disable=DS201 -- specs_wire/stream.json: trailing flush sentinel the peer discards after done (fixture contract)
+
+
+def send_error(sock, excs):
+    for exc in excs:
+        sock.send({"error": str(exc)})  # dynastate: disable=DS501 -- specs_wire/stream.json: callers pass a single-element tuple, one error per stream by construction

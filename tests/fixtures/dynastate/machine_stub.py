@@ -1,0 +1,7 @@
+"""Anchor file for the spec-level rule fixtures (DS100/DS301/DS401):
+those rules judge the active spec dir, not this code — the run just
+needs at least one collected file."""
+
+
+def noop():
+    return None

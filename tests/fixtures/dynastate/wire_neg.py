@@ -1,0 +1,27 @@
+"""DS101 negative: every spec'd producer and consumer exists, every
+frame has an emission site, and the consumer dispatches on each
+frame's marker key."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    if sock.needs_reset():
+        sock.send({"reset": True})
+        return
+    sock.send({"done": True})
+
+
+def send_error(sock, exc):
+    sock.send({"error": str(exc)})
+
+
+def recv_loop(sock, out):
+    while True:
+        frame = sock.recv()
+        if frame.get("error") is not None:
+            raise RuntimeError(frame["error"])
+        if frame.get("done"):
+            return out
+        if frame.get("chunk") is not None:
+            out.append(frame["data"])

@@ -1,0 +1,18 @@
+"""DS101 positives against specs_wire/stream.json: the spec'd
+send_error producer no longer exists, the reset frame is never
+emitted (dead spec arm), and recv_loop never reads the terminal
+done marker — the consumer silently drops the frame that should
+settle its machine (the cancelled-frame-hang bug class)."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    sock.send({"done": True})
+
+
+def recv_loop(sock, out):
+    while True:
+        frame = sock.recv()
+        if frame.get("chunk") is not None:
+            out.append(frame["data"])

@@ -1,0 +1,23 @@
+"""The suppressed variant of the consumer-side DS101: recv_loop
+really never reads the done key, but the drop is reviewed — the
+suppression cites the invariant that makes it safe."""
+
+
+def send_stream(sock, parts):
+    for i, part in enumerate(parts):
+        sock.send({"chunk": i, "data": part})
+    sock.send({"reset": True})
+    sock.send({"done": True})
+
+
+def send_error(sock, exc):
+    sock.send({"error": str(exc)})
+
+
+def recv_loop(sock, out):  # dynastate: disable=DS101 -- specs_wire/stream.json done frame: the transport's close callback settles the machine, tests/fixtures cover the drop
+    while True:
+        frame = sock.recv()
+        if frame.get("error") is not None:
+            raise RuntimeError(frame["error"])
+        if frame.get("chunk") is not None:
+            out.append(frame["data"])

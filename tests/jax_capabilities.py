@@ -1,0 +1,64 @@
+"""Capability probes for environment-dependent tier-1 tests.
+
+Some tier-1 tests exercise code written against a newer JAX surface
+than every environment carries — `jax.shard_map` (the top-level export)
+and `jax.experimental.pallas.tpu.CompilerParams` (renamed from
+`TPUCompilerParams`), plus tests that spawn whole worker processes or
+need wall-clock headroom a loaded single-vCPU runner cannot give. On
+such environments those tests fail for reasons that have nothing to do
+with the code under test, and a red tier-1 run stops meaning anything.
+
+These probes pin each dependence explicitly: the test skips — visibly,
+with the capability named in the reason — instead of failing, and on
+an environment that HAS the capability the test still runs and still
+gates. Probe the capability, never the version string: a backport or a
+rename makes version comparisons lie.
+"""
+
+import os
+
+import pytest
+
+
+def _has_shard_map() -> bool:
+    import jax
+
+    return hasattr(jax, "shard_map")
+
+
+def _has_pallas_compiler_params() -> bool:
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # noqa: BLE001 — no pallas at all is also "no"
+        return False
+    return hasattr(pltpu, "CompilerParams")
+
+
+requires_shard_map = pytest.mark.skipif(
+    not _has_shard_map(),
+    reason="this jax build has no top-level jax.shard_map export")
+
+requires_pallas_compiler_params = pytest.mark.skipif(
+    not _has_pallas_compiler_params(),
+    reason="this jax build has no pallas.tpu.CompilerParams "
+           "(pre-rename TPUCompilerParams)")
+
+def _usable_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux: no affinity API
+        return os.cpu_count() or 1
+
+
+# Multi-process gang tests (deploy gangs, multihost meshes, cross-host
+# KVBM) fork 2-3 worker processes that each compile XLA programs and
+# then rendezvous over gloo collectives with a fixed connect timeout.
+# On a single-core host the ranks compile SERIALLY, the rendezvous
+# window expires, and the run dies with "Gloo context initialization
+# failed: Connect timeout" or the parent test's own deadline — neither
+# of which says anything about the code under test.
+requires_multicore = pytest.mark.skipif(
+    _usable_cpus() < 2,
+    reason="multi-process gang tests need >=2 usable CPUs: concurrent "
+           "rank compilation outlives gloo connect timeouts on a "
+           "single-core host")

@@ -1,0 +1,235 @@
+"""Runtime protocol-conformance tests: the ProtocolMonitor replays
+live lifecycle events against the SAME specs dynastate lints
+(tools/dynastate/protocols/), so these pin both halves — the monitor's
+accept/violate semantics on real machines, and the two PR-18 guard
+fixes (StreamingTransfer, ColdStartLadder) staying terminal-safe under
+an enabled monitor. Reverting either guard makes the hook fire on a
+settled lifecycle and the zero-violation assertions here fail."""
+
+import pytest
+
+from dynamo_tpu.engine import coldstart
+from dynamo_tpu.engine.coldstart import ColdStartLadder
+from dynamo_tpu.llm.kv_transfer import (
+    KvLayoutDescriptor,
+    PendingTransferTable,
+    StreamingTransfer,
+)
+from dynamo_tpu.runtime import conformance
+from dynamo_tpu.runtime.conformance import (
+    MAX_DETAILS,
+    RULE_POST_TERMINAL,
+    RULE_UNHANDLED,
+    chaos_assertion,
+    get_monitor,
+    observe,
+    reset_monitor,
+)
+from dynamo_tpu.runtime.flight_recorder import FlightRecorder
+from dynamo_tpu.runtime.resilience import CircuitBreaker
+
+
+@pytest.fixture
+def monitor_on(monkeypatch):
+    monkeypatch.setenv("DYNT_CONFORMANCE", "1")
+    reset_monitor()
+    yield get_monitor()
+    reset_monitor()
+
+
+def _layout():
+    return KvLayoutDescriptor(n_layers=2, kv_heads=2, head_dim=4,
+                              page_size=16, dtype="float32")
+
+
+def _transfer(transfer_id="t1"):
+    released = []
+    table = PendingTransferTable()
+    t = StreamingTransfer(transfer_id, [], lambda: released.append(1),
+                          _layout(), 128, table=table)
+    table.add(t)
+    return t, released
+
+
+class TestMonitorCore:
+    def test_loads_all_spec_machines(self, monitor_on):
+        snap = monitor_on.snapshot()
+        assert set(snap["protocols_loaded"]) >= {
+            "kv_stream_transfer", "drain_ladder", "migration_replay",
+            "preemption", "coldstart", "striped_weight_pull", "journal",
+            "flight_recorder", "breaker"}
+        assert snap["enabled"] is True
+
+    def test_valid_sequence_is_clean(self, monitor_on):
+        observe("kv_stream_transfer", "t-ok", "append")
+        observe("kv_stream_transfer", "t-ok", "append")
+        observe("kv_stream_transfer", "t-ok", "finish")
+        snap = monitor_on.snapshot()
+        assert snap["total_violations"] == 0
+        assert snap["instances_tracked"] == 1
+
+    def test_unhandled_event_is_ds101(self, monitor_on):
+        observe("kv_stream_transfer", "t-bad", "bogus_event")
+        snap = monitor_on.snapshot()
+        assert snap["total_violations"] == 1
+        assert snap["by_protocol"] == {
+            "kv_stream_transfer": {RULE_UNHANDLED: 1}}
+        (v,) = snap["violations"]
+        assert v == {"protocol": "kv_stream_transfer",
+                     "instance": "t-bad", "state": "streaming",
+                     "event": "bogus_event", "rule": RULE_UNHANDLED}
+
+    def test_event_after_terminal_is_ds201(self, monitor_on):
+        observe("kv_stream_transfer", "t-late", "finish")
+        observe("kv_stream_transfer", "t-late", "append")
+        snap = monitor_on.snapshot()
+        assert snap["by_protocol"] == {
+            "kv_stream_transfer": {RULE_POST_TERMINAL: 1}}
+        assert snap["violations"][0]["state"] == "finished"
+
+    def test_instances_are_independent(self, monitor_on):
+        observe("kv_stream_transfer", "a", "finish")
+        observe("kv_stream_transfer", "b", "append")
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_unknown_protocol_ignored(self, monitor_on):
+        observe("no_such_protocol", "x", "whatever")
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_disabled_monitor_is_inert(self, monkeypatch):
+        monkeypatch.delenv("DYNT_CONFORMANCE", raising=False)
+        reset_monitor()
+        try:
+            observe("kv_stream_transfer", "t", "bogus_event")
+            snap = get_monitor().snapshot()
+            assert snap["enabled"] is False
+            assert snap["total_violations"] == 0
+            assert snap["instances_tracked"] == 0
+        finally:
+            reset_monitor()
+
+    def test_details_capped_but_totals_exact(self, monitor_on):
+        for i in range(MAX_DETAILS + 50):
+            observe("kv_stream_transfer", f"cap-{i}", "bogus_event")
+        snap = monitor_on.snapshot()
+        assert snap["total_violations"] == MAX_DETAILS + 50
+        assert len(snap["violations"]) == MAX_DETAILS
+
+    def test_chaos_assertion_row(self, monitor_on):
+        ok = chaos_assertion(monitor_on.snapshot())
+        assert ok == {"name": "protocol_conformance", "ok": True,
+                      "detail": {"total_violations": 0,
+                                 "by_protocol": {}, "violations": []}}
+        for i in range(7):
+            observe("kv_stream_transfer", f"x-{i}", "bogus_event")
+        bad = chaos_assertion(monitor_on.snapshot())
+        assert bad["ok"] is False
+        assert bad["detail"]["total_violations"] == 7
+        # report rows stay bounded even on a violation storm
+        assert len(bad["detail"]["violations"]) == 5
+
+
+class TestBreakerLifecycle:
+    def test_full_trip_cycle_conforms(self, monitor_on):
+        b = CircuitBreaker(failure_threshold=1, reset_secs=0.0)
+        b.record_failure()                    # closed -> open
+        assert b.try_acquire()                # open -> half_open (probe)
+        b.record_failure(probe=True)          # half_open -> open
+        assert b.try_acquire()                # open -> half_open again
+        b.record_success(probe=True)          # half_open -> closed
+        b.record_failure()                    # closed -> open
+        b.reset()                             # open -> closed
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+
+class TestFlightRecorderLifecycle:
+    def test_full_ladder_conforms(self, monitor_on):
+        rec = FlightRecorder(capacity=8)
+        rec.start("r1", model="m")
+        for phase in ("queued", "scheduled", "prefill_start",
+                      "first_token"):
+            rec.stamp("r1", phase)
+        rec.finish("r1")
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_forward_skip_is_legal(self, monitor_on):
+        """A shed request never queues; a prefill-only leg jumps straight
+        to finished — the spec allows any forward-skipping subset."""
+        rec = FlightRecorder(capacity=8)
+        rec.start("r2")
+        rec.stamp("r2", "first_token")
+        rec.finish("r2", status="ok")
+        rec.start("r3")
+        rec.finish("r3", status="shed")
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_backwards_stamp_is_flagged(self, monitor_on):
+        """first-write-wins accepts a never-seen phase even out of order;
+        the monitor is what catches the ladder running backwards."""
+        rec = FlightRecorder(capacity=8)
+        rec.start("r4")
+        rec.stamp("r4", "first_token")
+        rec.stamp("r4", "queued")
+        snap = monitor_on.snapshot()
+        assert snap["by_protocol"] == {
+            "flight_recorder": {RULE_UNHANDLED: 1}}
+        assert snap["violations"][0]["state"] == "first_token"
+        assert snap["violations"][0]["event"] == "queued"
+
+
+class TestStreamingTransferGuards:
+    """Gap A (PR-18): finish/append_pages after a terminal event must
+    drop instead of mutating the settled transfer. On the pre-fix code
+    these calls mutate AND the hooks observe forbidden transitions."""
+
+    def test_finish_after_fail_drops(self, monitor_on):
+        t, released = _transfer()
+        t.fail()
+        assert t.failed and released == [1]
+        t.finish(5, [1, 2])
+        assert t.done is False
+        assert t.first_token is None
+        assert t.page_ids == []
+        # fail claimed the entry; nothing releases twice
+        assert released == [1]
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_append_after_finish_drops(self, monitor_on):
+        t, _ = _transfer("t2")
+        t.append_pages([1])
+        t.finish(7, [1, 2])
+        t.append_pages([9])
+        assert t.page_ids == [1, 2]
+        assert t.first_token == 7
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+    def test_fail_after_finish_keeps_transfer_pullable(self, monitor_on):
+        t, released = _transfer("t3")
+        t.finish(7, [1, 2])
+        t.fail()
+        assert t.done is True and t.failed is False
+        assert released == []
+        assert monitor_on.snapshot()["total_violations"] == 0
+
+
+class TestColdStartLadderGuard:
+    """Gap B (PR-18): a late mark after first_token closed the ladder
+    (lazy per-shape recompile) must not mutate the published record."""
+
+    def test_late_mark_after_close_drops(self, monitor_on):
+        ladder = ColdStartLadder("w0", source="peer")
+        ladder.mark("fetch", 0.5)
+        total = ladder.first_token()
+        assert total is not None and ladder.total == total
+        ladder.mark("compile", 1.0)
+        assert "compile" not in ladder.phases
+        assert ladder.total == total
+        assert monitor_on.snapshot()["total_violations"] == 0
+        coldstart.reset_observations()
+
+    def test_first_token_idempotent(self, monitor_on):
+        ladder = ColdStartLadder("w1")
+        first = ladder.first_token()
+        assert ladder.first_token() == first
+        assert monitor_on.snapshot()["total_violations"] == 0
+        coldstart.reset_observations()

@@ -19,6 +19,7 @@ from dynamo_tpu.deploy import (
 from dynamo_tpu.deploy.spec import ServiceSpec
 from dynamo_tpu.planner.connectors import TargetReplica, VirtualConnector
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from jax_capabilities import requires_multicore
 
 SLEEP_CMD = [sys.executable, "-c",
              "import time\ntime.sleep(600)"]
@@ -279,6 +280,7 @@ class TestMultihostGang:
 
 
 class TestGangE2E:
+    @requires_multicore
     def test_deployed_gang_serves(self, run, tmp_path):
         """The deploy controller brings up a 2-rank multihost worker
         GANG (driver + follower spanning one engine over

@@ -1,0 +1,497 @@
+"""dynastate golden tests: every rule family exercised by positive,
+negative, and suppressed fixtures against fixture spec dirs, the
+protocol-registry drift gate, the CLI contract, static regressions
+re-deriving the PR's StreamingTransfer/ColdStartLadder guard fixes
+from replicas of the pre-fix code, and the repo-wide clean-lint
+invariant now covering all FIVE analyzers (dynalint + dynaflow +
+dynajit + dynarace + dynastate over dynamo_tpu/ — the same gate CI
+enforces, failing pytest locally)."""
+
+import contextlib
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import tools.dynaflow as dynaflow
+import tools.dynajit as dynajit
+import tools.dynalint as dynalint
+import tools.dynarace as dynarace
+from tools.dynastate import (
+    SPEC_DIR,
+    all_rules,
+    diff_registry,
+    load_specs,
+    protocol_surface,
+    registry_path,
+    run,
+    set_spec_dir,
+    update_registry,
+)
+from tools.dynastate.passes_state import (
+    CancellationUnhandled,
+    NoFailurePathToTerminal,
+    PostTerminalEmission,
+    SpecValidity,
+    TerminalFrameNotOnce,
+    UnhandledTag,
+)
+from tools.dynastate.registry import ProtocolRegistryDrift
+from tools.dynalint.core import collect_files
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "dynastate"
+REPO = pathlib.Path(__file__).parent.parent
+
+# The nine lifecycles the tree ships specs for (docs/static-analysis.md).
+REAL_PROTOCOLS = {
+    "kv_stream_transfer", "drain_ladder", "migration_replay",
+    "preemption", "coldstart", "striped_weight_pull", "journal",
+    "flight_recorder", "breaker",
+}
+
+
+@contextlib.contextmanager
+def spec_dir(path):
+    """Point the analyzer at a fixture spec dir, restoring the real one."""
+    set_spec_dir(path)
+    try:
+        yield
+    finally:
+        set_spec_dir(None)
+
+
+def state(path, rules, specs):
+    with spec_dir(specs):
+        findings, _ = run([str(FIXTURES / path)], rules=rules)
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestRuleCatalogue:
+    def test_seven_rules_registered(self):
+        assert len(all_rules()) >= 7
+
+    def test_ids_and_names_unique_and_described(self):
+        rules = all_rules()
+        assert len({r.id for r in rules}) == len(rules)
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.description for r in rules)
+
+    def test_disjoint_from_sibling_analyzers(self):
+        ids = {r.id for r in all_rules()}
+        assert not ids & {r.id for r in dynalint.all_rules()}
+        assert not ids & {r.id for r in dynaflow.all_rules()}
+        assert not ids & {r.id for r in dynajit.all_rules()}
+        assert not ids & {r.id for r in dynarace.all_rules()}
+
+
+class TestSpecLoading:
+    def test_real_specs_load_clean(self):
+        specs = load_specs(SPEC_DIR)
+        assert {s.name for s in specs} >= REAL_PROTOCOLS
+        assert all(not s.errors for s in specs), [
+            (s.name, s.errors) for s in specs if s.errors]
+
+    def test_broken_specs_carry_errors(self):
+        specs = {s.name: s for s in load_specs(FIXTURES / "specs_bad")}
+        assert any("initial state" in e for e in specs["broken"].errors)
+        assert any("undeclared event" in e for e in specs["broken"].errors)
+        assert any("outgoing" in e for e in specs["broken"].errors)
+        assert any("cannot parse" in e for e in specs["garbage"].errors)
+
+
+class TestSpecValidity:
+    RULES = [SpecValidity()]
+
+    def test_positive(self):
+        findings = state("machine_stub.py", self.RULES,
+                         FIXTURES / "specs_bad")
+        assert rules_of(findings) == ["DS100"]
+        assert len(findings) >= 5
+        # Findings anchor at the spec file, not the analyzed tree.
+        assert all(f.path.endswith(".json") for f in findings)
+
+    def test_negative(self):
+        assert state("machine_stub.py", self.RULES,
+                     FIXTURES / "specs_wire") == []
+
+
+class TestWireDispatchRules:
+    RULES = [UnhandledTag()]
+    SPECS = FIXTURES / "specs_wire"
+
+    def test_positive(self):
+        findings = state("wire_pos.py", self.RULES, self.SPECS)
+        assert rules_of(findings) == ["DS101"]
+        assert len(findings) == 3
+        msgs = [f.message for f in findings]
+        assert any("'send_error'" in m and "matches no function" in m
+                   for m in msgs)
+        assert any("'reset'" in m and "dead spec arm" in m for m in msgs)
+        assert any("recv_loop" in m and "silently dropped" in m
+                   for m in msgs)
+
+    def test_consumer_finding_anchors_at_the_consumer(self):
+        findings = state("wire_pos.py", self.RULES, self.SPECS)
+        drop = [f for f in findings if "silently dropped" in f.message]
+        assert len(drop) == 1
+        assert drop[0].path.endswith("wire_pos.py")
+
+    def test_negative(self):
+        assert state("wire_neg.py", self.RULES, self.SPECS) == []
+
+    def test_suppressed_citing_the_spec(self):
+        assert state("wire_suppressed.py", self.RULES, self.SPECS) == []
+        text = (FIXTURES / "wire_suppressed.py").read_text()
+        assert "specs_wire/stream.json" in text
+
+
+class TestPostTerminalEmission:
+    RULES = [PostTerminalEmission()]
+
+    def test_api_positive(self):
+        findings = state("api_pos.py", self.RULES, FIXTURES / "specs_api")
+        assert rules_of(findings) == ["DS201"]
+        msgs = {f.message for f in findings}
+        assert len(findings) == 2
+        assert any("Session.update" in m and "closed, failed" in m
+                   for m in msgs)
+        assert any("Session.fail" in m and "closed" in m for m in msgs)
+
+    def test_api_negative(self):
+        assert state("api_neg.py", self.RULES,
+                     FIXTURES / "specs_api") == []
+
+    def test_api_suppressed(self):
+        assert state("api_suppressed.py", self.RULES,
+                     FIXTURES / "specs_api") == []
+
+    def test_wire_positive_frame_after_terminal(self):
+        findings = state("emit_pos.py", self.RULES,
+                         FIXTURES / "specs_wire")
+        assert rules_of(findings) == ["DS201"]
+        assert len(findings) == 1
+        assert "'chunk'" in findings[0].message
+        assert "'done'" in findings[0].message
+
+    def test_wire_negative(self):
+        assert state("emit_neg.py", self.RULES,
+                     FIXTURES / "specs_wire") == []
+
+
+class TestMachineObligations:
+    def test_no_failure_path_positive(self):
+        findings = state("machine_stub.py", [NoFailurePathToTerminal()],
+                         FIXTURES / "specs_machine_pos")
+        assert rules_of(findings) == ["DS301"]
+        assert len(findings) == 1
+        assert "'pulling'" in findings[0].message
+
+    def test_cancellation_unhandled_positive(self):
+        findings = state("machine_stub.py", [CancellationUnhandled()],
+                         FIXTURES / "specs_machine_pos")
+        assert rules_of(findings) == ["DS401"]
+        assert len(findings) == 1
+        assert "'cancel'" in findings[0].message
+        assert "'pulling'" in findings[0].message
+
+    def test_negative_idle_and_ignores_exempt(self):
+        """waiting is idle, working handles everything, settling rides
+        the reviewed `ignores` list while keeping its failure arm."""
+        rules = [NoFailurePathToTerminal(), CancellationUnhandled()]
+        assert state("machine_stub.py", rules,
+                     FIXTURES / "specs_machine_neg") == []
+
+
+class TestTerminalExactlyOnce:
+    RULES = [TerminalFrameNotOnce()]
+
+    def test_loop_positive(self):
+        findings = state("emit_pos.py", self.RULES,
+                         FIXTURES / "specs_wire")
+        assert rules_of(findings) == ["DS501"]
+        assert len(findings) == 1
+        assert "'error'" in findings[0].message
+        assert "loop" in findings[0].message
+
+    def test_loop_negative_break_after(self):
+        assert state("emit_neg.py", self.RULES,
+                     FIXTURES / "specs_wire") == []
+
+    def test_loop_suppressed(self):
+        assert state("emit_suppressed.py", self.RULES,
+                     FIXTURES / "specs_wire") == []
+
+    def test_vanished_terminal_method(self):
+        findings = state("api_vanished.py", self.RULES,
+                         FIXTURES / "specs_api")
+        assert rules_of(findings) == ["DS501"]
+        assert len(findings) == 1
+        assert "'close'" in findings[0].message
+        assert "no longer exists" in findings[0].message
+
+
+class TestProtocolRegistry:
+    def _fixture_spec_dir(self, tmp_path):
+        sdir = tmp_path / "specs"
+        sdir.mkdir()
+        shutil.copy(FIXTURES / "specs_wire" / "stream.json",
+                    sdir / "stream.json")
+        return sdir
+
+    def test_drift_gate(self, tmp_path):
+        sdir = self._fixture_spec_dir(tmp_path)
+        with spec_dir(sdir):
+            rule = ProtocolRegistryDrift()
+            # no snapshot yet -> missing-registry finding
+            missing, _ = run([str(FIXTURES / "wire_neg.py")], rules=[rule])
+            assert rules_of(missing) == ["DS102"]
+            assert "no protocol registry" in missing[0].message
+            # blessed -> clean; the registry lands beside the specs
+            files, _ = collect_files([str(FIXTURES / "wire_neg.py")])
+            assert update_registry(files)
+            assert registry_path() == sdir / "protocol_registry.json"
+            clean, _ = run([str(FIXTURES / "wire_neg.py")], rules=[rule])
+            assert clean == []
+            # the emission surface changes (different fixture) -> drift
+            drifted, _ = run([str(FIXTURES / "wire_pos.py")], rules=[rule])
+            assert rules_of(drifted) == ["DS102"]
+            assert "--registry-update" in drifted[0].message
+
+    def test_update_is_idempotent(self, tmp_path):
+        sdir = self._fixture_spec_dir(tmp_path)
+        with spec_dir(sdir):
+            files, _ = collect_files([str(FIXTURES / "wire_neg.py")])
+            assert update_registry(files) is True
+            assert update_registry(files) is False
+            payload = json.loads(registry_path().read_text())
+        assert payload["version"] == 1 and payload["protocols"]
+
+    def test_diff_names_changed_sections(self, tmp_path):
+        sdir = self._fixture_spec_dir(tmp_path)
+        with spec_dir(sdir):
+            files, _ = collect_files([str(FIXTURES / "wire_neg.py")])
+            update_registry(files)
+            other, _ = collect_files([str(FIXTURES / "wire_pos.py")])
+            drift = diff_registry(other)
+            assert drift is not None
+            assert any(line.startswith("changed: stream.")
+                       for line in drift)
+
+    def test_surface_records_machine_emits_and_handles(self):
+        with spec_dir(FIXTURES / "specs_wire"):
+            files, _ = collect_files([str(FIXTURES / "wire_neg.py")])
+            surface = protocol_surface(load_specs(), files)
+        assert surface["version"] == 1
+        (entry,) = surface["protocols"]
+        assert entry["protocol"] == "stream"
+        assert entry["machine"]["states"]["closed"]["terminal"]
+        emitted = {(e["frame"]) for e in entry["emits"]}
+        assert emitted == {"chunk", "done", "error", "reset"}
+        # no line numbers: moving code must not churn the snapshot
+        assert all("line" not in e for e in entry["emits"])
+        assert all(h["dispatches"] for h in entry["handles"]
+                   if h["frame"] in ("chunk", "done", "error"))
+
+
+class TestSuppressionDialect:
+    def test_wrong_tool_marker_does_not_suppress(self, tmp_path):
+        src = (FIXTURES / "api_suppressed.py").read_text()
+        bad = tmp_path / "wrong.py"
+        bad.write_text(src.replace("# dynastate: disable=DS201",
+                                   "# dynarace: disable=DS201"))
+        with spec_dir(FIXTURES / "specs_api"):
+            findings, _ = run([str(bad)], rules=[PostTerminalEmission()])
+        assert rules_of(findings) == ["DS201"]
+        assert len(findings) == 2
+
+    def test_unknown_rule_reported(self, tmp_path):
+        src = (FIXTURES / "api_pos.py").read_text()
+        bad = tmp_path / "typo.py"
+        bad.write_text(src.replace(
+            "def fail(self):",
+            "def fail(self):  # dynastate: disable=DS999 -- typo"))
+        with spec_dir(FIXTURES / "specs_api"):
+            findings, _ = run([str(bad)], rules=[PostTerminalEmission()])
+        assert rules_of(findings) == ["DS000", "DS201"]
+
+
+class TestCli:
+    def test_json_output_and_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynastate",
+             "--spec-dir", str(FIXTURES / "specs_wire"),
+             str(FIXTURES / "wire_pos.py"), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["files_checked"] == 1
+        # three DS101 dispatch gaps + DS102 (fixture dir has no registry)
+        assert {f["rule"] for f in data["findings"]} == {"DS101", "DS102"}
+        assert {r["id"] for r in data["rules"]} >= {
+            "DS100", "DS101", "DS102", "DS201", "DS301", "DS401", "DS501"}
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynastate", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "DS102" in proc.stdout
+        assert "protocol-registry-drift" in proc.stdout
+
+    def test_protocols_dump_reports_invalid_specs(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynastate",
+             "--spec-dir", str(FIXTURES / "specs_bad"), "--protocols"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "broken [INVALID]" in proc.stdout
+        assert "garbage [INVALID]" in proc.stdout
+
+    def test_registry_update_on_current_tree_is_noop(self):
+        # Prove currency with a PURE READ first: on a drifted tree this
+        # fails HERE, before the CLI below would silently rewrite the
+        # checked-in registry mid-pytest.
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files) is None, (
+            "protocol surface drifted; not exercising --registry-update "
+            "against the real registry")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.dynastate", "--registry-update"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "already current" in proc.stdout
+
+
+class TestPreFixRegressions:
+    """The two real gaps this PR closed, re-derived from replicas of
+    the PRE-FIX code under the real checked-in specs: DS201 flags both
+    shapes, so reverting either guard fails these tests (and the
+    real-tree clean gate below)."""
+
+    PRE_FIX_KV = textwrap.dedent('''\
+        import threading
+
+
+        class StreamingTransfer:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.page_ids = []
+                self.done = False
+                self.failed = False
+                self.first_token = None
+
+            def append_pages(self, page_ids):
+                with self._cond:
+                    self.page_ids.extend(int(p) for p in page_ids)
+                    self._cond.notify_all()
+
+            def finish(self, first_token, all_page_ids):
+                with self._cond:
+                    self.page_ids = [int(p) for p in all_page_ids]
+                    self.first_token = int(first_token)
+                    self.done = True
+                    self._cond.notify_all()
+
+            def fail(self):
+                with self._cond:
+                    self.failed = True
+                    self._cond.notify_all()
+        ''')
+
+    PRE_FIX_COLDSTART = textwrap.dedent('''\
+        class ColdStartLadder:
+            def __init__(self, worker):
+                self.worker = worker
+                self.phases = {}
+                self.total = None
+
+            def mark(self, name, seconds):
+                self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+            def first_token(self):
+                if self.total is not None:
+                    return self.total
+                self.mark("first_token", 0.0)
+                self.total = 1.0
+                return self.total
+        ''')
+
+    def test_unguarded_streaming_transfer_flagged(self, tmp_path):
+        pre = tmp_path / "llm" / "kv_transfer.py"
+        pre.parent.mkdir()
+        pre.write_text(self.PRE_FIX_KV)
+        findings, _ = run([str(pre)], rules=[PostTerminalEmission()])
+        assert rules_of(findings) == ["DS201"]
+        flagged = {f.message.split(" emits")[0].rsplit("::", 1)[-1]
+                   for f in findings}
+        assert flagged == {"StreamingTransfer.append_pages",
+                           "StreamingTransfer.finish",
+                           "StreamingTransfer.fail"}
+
+    def test_unguarded_coldstart_mark_flagged(self, tmp_path):
+        pre = tmp_path / "engine" / "coldstart.py"
+        pre.parent.mkdir()
+        pre.write_text(self.PRE_FIX_COLDSTART)
+        findings, _ = run([str(pre)], rules=[PostTerminalEmission()])
+        assert rules_of(findings) == ["DS201"]
+        assert len(findings) == 1
+        assert "ColdStartLadder.mark" in findings[0].message
+        assert "total" in findings[0].message
+
+
+class TestRealTreeStaysClean:
+    """The repo-wide clean-lint invariant, now over all FIVE analyzers:
+    zero unsuppressed findings on dynamo_tpu/. Regressions fail pytest
+    locally, not just the CI lint job."""
+
+    def test_dynastate_clean(self):
+        findings, files_checked = run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynarace_clean(self):
+        findings, files_checked = dynarace.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynajit_clean(self):
+        findings, files_checked = dynajit.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynaflow_clean(self):
+        findings, files_checked = dynaflow.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_dynalint_clean(self):
+        findings, files_checked = dynalint.run([str(REPO / "dynamo_tpu")])
+        assert files_checked > 100
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+
+    def test_protocol_registry_current(self):
+        """The checked-in protocol registry matches the tree (a drifted
+        registry already fails test_dynastate_clean; this pins that the
+        snapshot exists, parses, and covers every spec'd protocol)."""
+        assert registry_path().exists()
+        files, _ = collect_files([str(REPO / "dynamo_tpu")])
+        assert diff_registry(files) is None
+        payload = json.loads(registry_path().read_text())
+        assert {e["protocol"]
+                for e in payload["protocols"]} >= REAL_PROTOCOLS
+        # the monitored lifecycles carry real extraction surface too
+        by_name = {e["protocol"]: e for e in payload["protocols"]}
+        assert by_name["kv_stream_transfer"]["emits"]
+        assert by_name["kv_stream_transfer"]["api"]
+        assert by_name["coldstart"]["api"]

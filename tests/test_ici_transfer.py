@@ -31,6 +31,7 @@ from dynamo_tpu.llm.protocols import (
 from dynamo_tpu.ops.block_copy import gather_kv_blocks
 from dynamo_tpu.runtime import DistributedRuntime
 from dynamo_tpu.runtime.push_router import PushRouter
+from jax_capabilities import requires_shard_map
 
 
 def _request(tokens, max_tokens=6, temperature=0.0):
@@ -225,6 +226,9 @@ class TestBridgeE2E:
         run(body(), timeout=300)
 
 
+# engine/ici_transfer.py's collective-permute form calls jax.shard_map
+# directly (ici_transfer.py:232).
+@requires_shard_map
 class TestPpermuteHandoff:
     def test_pages_move_rank0_to_rank1(self):
         """Union-mesh collective-permute form: rank 0's src pages land in

@@ -31,6 +31,7 @@ from dynamo_tpu.block_manager.distributed import (
 from dynamo_tpu.engine import ModelRunner, RunnerConfig
 from dynamo_tpu.models import get_config
 from dynamo_tpu.parallel import MeshConfig, make_mesh
+from jax_capabilities import requires_multicore
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -184,6 +185,7 @@ def _spawn(module, *args, env, log_path):
 
 
 @pytestmark_e2e
+@requires_multicore
 class TestMultihostKvbmE2E:
     def test_offload_onboard_across_hosts(self, run, tmp_path):
         """2-process x 2-device engine with a distributed host tier:

@@ -22,6 +22,7 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.parallel.multihost import MultihostConfig, _dec, _enc
+from jax_capabilities import requires_multicore
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -107,6 +108,7 @@ REQ = {
 }
 
 
+@requires_multicore
 class TestTwoProcessWorker:
     def test_spans_processes_and_matches_single_process(self, run,
                                                         tmp_path):

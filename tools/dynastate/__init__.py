@@ -1,0 +1,133 @@
+"""dynastate — protocol state-machine analysis for dynamo_tpu.
+
+Usage::
+
+    python -m tools.dynastate dynamo_tpu/ [--format json]
+    python -m tools.dynastate --registry-update  # bless a protocol change
+    python -m tools.dynastate --list-rules
+    python -m tools.dynastate --spec-dir tests/fixtures/... fixture.py
+
+The fifth analyzer on the shared dynalint/dynaflow/dynajit/dynarace
+driver (collector, per-line suppressions, JSON output, CI gate): the
+repo's multi-hop frame protocols and lifecycles — streaming KV
+transfer, drain departure ladder, migration/replay, preemption,
+coldstart ladder, striped weight pull, journal frames, flight-recorder
+phase order, breaker — are hand-authored as machine-readable state
+machines (tools/dynastate/protocols/*.json), and every emission and
+dispatch site is extracted over dynaflow's call graph and checked
+against them. Rule families: spec validity (DS100), unhandled tags
+(DS101), registry drift (DS102), post-terminal emission (DS2xx),
+failure reachability (DS3xx), cancellation coverage (DS4xx),
+terminal exactly-once (DS5xx). The SAME spec files drive the runtime
+ProtocolMonitor (dynamo_tpu/runtime/conformance.py, DYNT_CONFORMANCE)
+that the chaos scenarios assert zero violations against. Suppress on
+the flagged line with ``# dynastate: disable=DS201 -- justification``
+citing the spec file and the invariant that makes the site safe. See
+docs/static-analysis.md for the catalogue and the spec authoring
+workflow.
+"""
+
+from __future__ import annotations
+
+from tools.dynalint.core import (  # noqa: F401
+    Finding,
+    ProjectRule,
+    Registry,
+    Rule,
+    collect_files,
+    main_for,
+    render_json,
+    render_text,
+)
+from tools.dynalint.core import run as _run
+
+DYNASTATE = Registry("dynastate", "DS000")
+
+from . import passes_state, registry  # noqa: E402
+from .extraction import protocol_surface  # noqa: E402,F401
+from .registry import (  # noqa: E402,F401
+    diff_registry,
+    registry_path,
+    update_registry,
+)
+from .specs import (  # noqa: E402,F401
+    SPEC_DIR,
+    ProtocolSpec,
+    active_spec_dir,
+    load_specs,
+    set_spec_dir,
+)
+
+for _cls in (
+    passes_state.SpecValidity,
+    passes_state.UnhandledTag,
+    registry.ProtocolRegistryDrift,
+    passes_state.PostTerminalEmission,
+    passes_state.NoFailurePathToTerminal,
+    passes_state.CancellationUnhandled,
+    passes_state.TerminalFrameNotOnce,
+):
+    DYNASTATE.register(_cls)
+
+__all__ = ["DYNASTATE", "run", "all_rules", "main", "ProtocolSpec",
+           "load_specs", "set_spec_dir", "active_spec_dir", "SPEC_DIR",
+           "protocol_surface", "update_registry", "diff_registry",
+           "registry_path"]
+
+
+def all_rules():
+    return DYNASTATE.all_rules()
+
+
+def run(paths, rules=None):
+    """Analyze `paths`; returns (findings after suppression, files)."""
+    return _run(paths, rules=rules, registry=DYNASTATE)
+
+
+def main(argv=None) -> int:
+    def extra_args(parser):
+        parser.add_argument(
+            "--spec-dir", default=None,
+            help="load protocol specs from this directory instead of "
+                 "tools/dynastate/protocols/ (fixture trees ship their "
+                 "own spec dirs; the registry snapshot is looked up "
+                 "beside the specs)")
+        parser.add_argument(
+            "--registry-update", action="store_true",
+            help="regenerate the protocol registry snapshot beside the "
+                 "active spec dir from the tree (the one-command path "
+                 "after a deliberate protocol change) and exit")
+        parser.add_argument(
+            "--protocols", action="store_true",
+            help="print the loaded protocol machines and exit "
+                 "(debugging aid)")
+
+    def handle_extra(args):
+        set_spec_dir(args.spec_dir)
+        if args.protocols:
+            for spec in load_specs():
+                status = "INVALID" if spec.errors else "ok"
+                terminals = ",".join(sorted(spec.terminal_states)) or "-"
+                print(f"{spec.name} [{status}] states="
+                      f"{len(spec.states)} events={len(spec.events)} "
+                      f"terminal={terminals}")
+                for err in spec.errors:
+                    print(f"  error: {err}")
+            return 0
+        if not args.registry_update:
+            return None
+        files, errors = collect_files(args.paths or ["dynamo_tpu"])
+        for err in errors:
+            print(f"{err.path}:{err.line}: {err.message}")
+        if update_registry(files):
+            print(f"updated protocol registry: {registry_path()}")
+        else:
+            print("protocol registry already current")
+        return 1 if errors else 0
+
+    return main_for(
+        DYNASTATE, ["dynamo_tpu"],
+        "protocol state-machine analysis (hand-authored lifecycle specs, "
+        "emission/dispatch extraction, terminal-state and cancellation "
+        "obligations, registry drift gate) for the dynamo_tpu codebase",
+        argv, extra_args=extra_args, handle_extra=handle_extra)

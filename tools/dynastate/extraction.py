@@ -1,0 +1,353 @@
+"""Emission/dispatch-site extraction for dynastate.
+
+Binds protocol-spec events to concrete code sites over dynaflow's
+parsed project view (tools/dynaflow/graph.py):
+
+* **wire frames** — a spec's ``wire`` section names producer and
+  consumer functions plus, per frame kind, *emit matchers* (a dict
+  literal carrying the frame's marker keys, or a constructor call with
+  pinned keywords) and *read matchers* (a key or attribute the
+  dispatching consumer must load). Emit sites keep their statement
+  context (enclosing block, loop depth, whether the next statement
+  exits) so the exactly-once rules can reason about ordering.
+
+* **api methods** — a spec's ``api`` section names a class whose
+  methods drive the machine, with the attributes that flag terminal
+  states (``terminal_attrs``). A method must *read* every terminal
+  flag it is guarded by (default: all of them) before emitting — the
+  static form of "no transitions out of a terminal state".
+
+Spec extraction grammar::
+
+    "wire": {
+      "producers": [{"module": "<rel-suffix>", "fn": "name|Class.name"}],
+      "consumers": [{"module": ..., "fn": ...}],
+      "frames": {
+        "<frame>": {
+          "event": "<machine event>",        # optional binding
+          "terminal": true,                  # stream ends at this frame
+          "emit": [{"keys": ["error"]} |
+                   {"call": "EngineOutput",
+                    "kw_equals": {"finish_reason": "migrate"}}],
+          "read": [{"key": "error"} | {"attr": "finish_reason"} |
+                   {"ref": "JOURNAL_RESYNC_TOPIC"}],
+          "producers": ["name", ...],        # optional subset (by fn)
+          "consumers": ["name", ...]         # optional subset (by fn)
+        }
+      }
+    }
+    "api": [{
+      "module": ..., "class": "StreamingTransfer",
+      "terminal_attrs": ["done", "failed"],
+      "methods": {"finish": {"event": "finish",
+                              "guards": ["failed"]}}   # optional override
+    }]
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from tools.dynaflow.graph import (
+    FunctionInfo,
+    Project,
+    call_tail,
+    const_key,
+    get_project,
+)
+
+from .specs import ProtocolSpec
+
+
+def _anchor(rel: str) -> str:
+    """Anchor paths at the package root so the registry agrees whether
+    the tree was collected relatively or absolutely (the channel-
+    registry contract)."""
+    idx = rel.find("dynamo_tpu/")
+    return rel[idx:] if idx >= 0 else rel
+
+
+def fn_label(fn: FunctionInfo) -> str:
+    name = f"{fn.cls}.{fn.name}" if fn.cls else fn.name
+    return f"{_anchor(fn.rel)}::{name}"
+
+
+def resolve_fns(project: Project, module: str, fn: str
+                ) -> list[FunctionInfo]:
+    """Functions matching a spec target: `module` is a path suffix,
+    `fn` a bare name or Class.name."""
+    cls, _, name = fn.rpartition(".")
+    out = []
+    for cand in project.by_name.get(name or fn, ()):
+        if not cand.rel.endswith(module):
+            continue
+        if cls and cand.cls != cls:
+            continue
+        if not cls and fn != cand.name:
+            continue
+        out.append(cand)
+    return out
+
+
+# -- emit-site scanning ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EmitSite:
+    frame: str
+    fn: FunctionInfo
+    node: ast.AST      # the matched expression
+    stmt: ast.stmt     # enclosing statement in its block
+    block: list        # the statement list containing stmt
+    index: int         # stmt's index in block
+    in_loop: bool
+
+    @property
+    def exits_after(self) -> bool:
+        """The frame cannot be emitted again on this path: the site is
+        a return value, or the next statement in its block exits."""
+        if isinstance(self.stmt, (ast.Return, ast.Raise)):
+            return True
+        if self.index + 1 < len(self.block):
+            return isinstance(self.block[self.index + 1],
+                              (ast.Return, ast.Raise, ast.Break))
+        return False
+
+
+def _sub_blocks(stmt: ast.stmt) -> Iterable[tuple[list, bool]]:
+    """(statement-list, enters_loop) pairs nested directly under `stmt`
+    — nested function/class scopes excluded (their bodies are their own
+    FunctionInfos)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            yield block, loop and field == "body"
+    for handler in getattr(stmt, "handlers", ()) or ():
+        yield handler.body, False
+    for case in getattr(stmt, "cases", ()) or ():
+        yield case.body, False
+
+
+def _stmt_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Every expression node attached to `stmt` itself (not to nested
+    statement blocks or nested scopes)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            continue
+        values = value if isinstance(value, list) else [value]
+        stack = [v for v in values if isinstance(v, ast.expr)]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # a deferred scope, not this statement's
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _match_emit(node: ast.AST, matcher: dict) -> bool:
+    keys = matcher.get("keys")
+    if keys is not None:
+        if not isinstance(node, ast.Dict):
+            return False
+        have = {const_key(k) for k in node.keys if k is not None}
+        return all(k in have for k in keys)
+    call = matcher.get("call")
+    if call is not None:
+        if not isinstance(node, ast.Call) or call_tail(node) != call:
+            return False
+        wanted = matcher.get("kw_equals") or {}
+        if wanted:
+            got = {kw.arg: kw.value.value for kw in node.keywords
+                   if kw.arg is not None
+                   and isinstance(kw.value, ast.Constant)}
+            return all(got.get(k) == v for k, v in wanted.items())
+        return True
+    return False
+
+
+def emit_sites(fn: FunctionInfo,
+               frame_matchers: dict[str, list[dict]]) -> list[EmitSite]:
+    """All frame-emission sites inside `fn` (nested defs excluded),
+    with block/loop context."""
+    sites: list[EmitSite] = []
+    body = getattr(fn.node, "body", None) or []
+
+    def scan(block: list, in_loop: bool) -> None:
+        for i, stmt in enumerate(block):
+            for node in _stmt_exprs(stmt):
+                for frame, matchers in frame_matchers.items():
+                    if any(_match_emit(node, m) for m in matchers):
+                        sites.append(EmitSite(frame, fn, node, stmt,
+                                              block, i, in_loop))
+            for sub, enters_loop in _sub_blocks(stmt):
+                scan(sub, in_loop or enters_loop)
+
+    scan(body, False)
+    return sites
+
+
+def _match_read(fn: FunctionInfo, matcher: dict) -> bool:
+    key = matcher.get("key")
+    if key is not None:
+        return key in fn.key_reads
+    attr = matcher.get("attr")
+    if attr is not None:
+        return attr in fn.attr_reads
+    ref = matcher.get("ref")
+    if ref is not None:
+        # Dispatch by named constant (e.g. topic.startswith(RESYNC_TOPIC))
+        return ref in fn.refs
+    return False
+
+
+# -- per-spec models ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WireModel:
+    spec: ProtocolSpec
+    producers: dict[str, list[FunctionInfo]]  # fn token -> matches
+    consumers: dict[str, list[FunctionInfo]]
+    sites: dict[str, list[EmitSite]]          # frame -> emit sites
+
+    def frame_producers(self, frame: str) -> dict[str, list[FunctionInfo]]:
+        subset = (self.spec.wire["frames"].get(frame) or {}).get("producers")
+        if subset is None:
+            return self.producers
+        return {k: v for k, v in self.producers.items() if k in subset}
+
+    def frame_consumers(self, frame: str) -> dict[str, list[FunctionInfo]]:
+        subset = (self.spec.wire["frames"].get(frame) or {}).get("consumers")
+        if subset is None:
+            return self.consumers
+        return {k: v for k, v in self.consumers.items() if k in subset}
+
+
+def wire_model(spec: ProtocolSpec, project: Project) -> Optional[WireModel]:
+    wire = spec.wire
+    if not wire:
+        return None
+    producers: dict[str, list[FunctionInfo]] = {}
+    for entry in wire.get("producers", []) or []:
+        producers[entry["fn"]] = resolve_fns(project, entry.get("module", ""),
+                                             entry["fn"])
+    consumers: dict[str, list[FunctionInfo]] = {}
+    for entry in wire.get("consumers", []) or []:
+        consumers[entry["fn"]] = resolve_fns(project, entry.get("module", ""),
+                                             entry["fn"])
+    frames = wire.get("frames", {}) or {}
+    sites: dict[str, list[EmitSite]] = {f: [] for f in frames}
+    for token, fns in producers.items():
+        for fn in fns:
+            matchers = {
+                f: (body or {}).get("emit", []) or []
+                for f, body in frames.items()
+                if (body or {}).get("producers") is None
+                or token in (body or {}).get("producers")
+            }
+            for site in emit_sites(fn, matchers):
+                sites[site.frame].append(site)
+    return WireModel(spec, producers, consumers, sites)
+
+
+@dataclasses.dataclass
+class ApiMethod:
+    entry: dict
+    method: str
+    event: Optional[str]
+    guards: list[str]
+    fns: list[FunctionInfo]
+
+    @property
+    def terminal(self) -> bool:
+        return bool((self.entry.get("methods") or {})
+                    .get(self.method, {}).get("terminal"))
+
+    def missing_guards(self, fn: FunctionInfo) -> list[str]:
+        return [g for g in self.guards if g not in fn.attr_reads]
+
+
+def api_model(spec: ProtocolSpec, project: Project) -> list[ApiMethod]:
+    out: list[ApiMethod] = []
+    for entry in spec.api:
+        module = entry.get("module", "")
+        cls = entry.get("class", "")
+        terminal_attrs = entry.get("terminal_attrs", []) or []
+        for method, body in (entry.get("methods") or {}).items():
+            body = body or {}
+            fns = resolve_fns(project, module,
+                              f"{cls}.{method}" if cls else method)
+            out.append(ApiMethod(
+                entry, method, body.get("event"),
+                list(body.get("guards", terminal_attrs)), fns))
+    return out
+
+
+# -- registry surface (DS102) ------------------------------------------------
+
+
+def protocol_surface(specs: list[ProtocolSpec], files: list) -> dict:
+    """The extracted protocol surface: each spec's machine plus every
+    emission site (aggregated per function, no line numbers — moving
+    code must not churn the snapshot), consumer dispatch verdicts, and
+    api guard verdicts. Snapshot target of the DS102 drift gate."""
+    project = get_project(files)
+    entries = []
+    for spec in sorted(specs, key=lambda s: s.name):
+        machine = {
+            "initial": spec.initial,
+            "states": {
+                s: {"terminal": spec.is_terminal(s),
+                    "idle": spec.is_idle(s),
+                    "on": dict(sorted(spec.transitions(s).items()))}
+                for s in sorted(spec.states)
+            },
+            "events": {
+                e: {k: v for k, v in sorted((spec.events[e] or {}).items())}
+                for e in sorted(spec.events)
+            },
+        }
+        emits: dict[tuple[str, str], int] = {}
+        handles = []
+        model = wire_model(spec, project)
+        if model is not None:
+            for frame, sites in sorted(model.sites.items()):
+                for site in sites:
+                    key = (fn_label(site.fn), frame)
+                    emits[key] = emits.get(key, 0) + 1
+            for frame, body in sorted((spec.wire.get("frames") or {}
+                                       ).items()):
+                reads = (body or {}).get("read", []) or []
+                for token, fns in sorted(
+                        model.frame_consumers(frame).items()):
+                    for fn in fns:
+                        handles.append({
+                            "consumer": fn_label(fn), "frame": frame,
+                            "dispatches": any(_match_read(fn, m)
+                                              for m in reads)})
+        api = []
+        for am in api_model(spec, project):
+            for fn in am.fns:
+                api.append({
+                    "scope": fn_label(fn), "event": am.event,
+                    "guards": sorted(am.guards),
+                    "guarded": not am.missing_guards(fn)})
+        entries.append({
+            "protocol": spec.name,
+            "machine": machine,
+            "emits": [{"site": site, "frame": frame, "count": count}
+                      for (site, frame), count in sorted(emits.items())],
+            "handles": sorted(handles,
+                              key=lambda h: (h["consumer"], h["frame"])),
+            "api": sorted(api, key=lambda a: (a["scope"],
+                                              a["event"] or ""))})
+    return {"version": 1, "protocols": entries}

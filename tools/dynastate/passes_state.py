@@ -1,0 +1,306 @@
+"""dynastate rule families DS1xx–DS5xx.
+
+All rules are ProjectRules driven by the hand-authored protocol specs
+(tools/dynastate/protocols/*.json — see specs.py for the shape and
+docs/static-analysis.md for the authoring workflow):
+
+* DS100 invalid-protocol-spec — the spec file itself is malformed
+  (undeclared states/events in transitions, missing initial, terminal
+  states with outgoing edges).
+* DS101 unhandled-tag-in-state — a frame the spec says the protocol
+  emits has no emission site left in the code (dead spec arm), or a
+  dispatching consumer never reads the frame's marker — the
+  "cancelled-frame hang" bug class: the producer emits a tag the
+  consumer silently drops, and the machine wedges in a non-terminal
+  state.
+* DS201 post-terminal-emission — an api method that drives the machine
+  does not read the terminal-state flags before emitting (so a call
+  after fail()/finish() mutates a settled lifecycle), or a producer
+  emits another frame lexically after a terminal frame in the same
+  block.
+* DS301 no-failure-path-to-terminal — a non-terminal, non-idle state
+  has no failure/cancellation transition whose path reaches a terminal
+  state: an error there strands the instance forever.
+* DS401 cancellation-unhandled-in-state — a cancellation event is not
+  accepted in some non-terminal state (and the state is not explicitly
+  listed in the event's `ignores`).
+* DS501 terminal-frame-not-exactly-once — a terminal frame is emitted
+  inside a loop without an immediate exit (the stream could terminate
+  twice), or an api terminal event has no emitting method.
+
+Suppress on the flagged line with
+``# dynastate: disable=DS201 -- justification`` citing the spec file
+and the invariant that makes the site safe.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+from tools.dynaflow.graph import get_project
+
+from . import extraction, specs
+from .extraction import EmitSite, fn_label
+
+
+def _spec_finding(rule, spec, message: str) -> Finding:
+    return Finding(rule.id, rule.name, spec.path, 1, 0, message)
+
+
+def _fn_finding(rule, fn, message: str) -> Finding:
+    return Finding(rule.id, rule.name, fn.rel, fn.lineno, 0, message)
+
+
+def _site_finding(rule, site: EmitSite, message: str) -> Finding:
+    return Finding(rule.id, rule.name, site.fn.rel,
+                   getattr(site.node, "lineno", site.fn.lineno),
+                   getattr(site.node, "col_offset", 0), message)
+
+
+class SpecValidity(ProjectRule):
+    id = "DS100"
+    name = "invalid-protocol-spec"
+    description = (
+        "A protocol spec under tools/dynastate/protocols/ is malformed: "
+        "unparseable JSON, transitions naming undeclared states or "
+        "events, a missing initial state, or a terminal state with "
+        "outgoing edges. The spec files drive both the static rules and "
+        "the runtime ProtocolMonitor, so a broken spec silently disables "
+        "conformance checking.")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for spec in specs.load_specs():
+            for err in spec.errors:
+                yield _spec_finding(self, spec,
+                                    f"protocol {spec.name!r}: {err}")
+
+
+class UnhandledTag(ProjectRule):
+    id = "DS101"
+    name = "unhandled-tag-in-state"
+    description = (
+        "A spec'd wire frame is emitted by no producer left in the tree "
+        "(the spec models an emission the code no longer performs), or "
+        "a dispatching consumer never reads the frame's marker key — "
+        "the consumer silently drops a tag the producer emits and the "
+        "protocol wedges in a non-terminal state (the cancelled-frame-"
+        "hang bug class).")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        for spec in specs.load_specs():
+            if spec.errors:
+                continue
+            model = extraction.wire_model(spec, project)
+            if model is None:
+                continue
+            for token, fns in model.producers.items():
+                if not fns:
+                    yield _spec_finding(
+                        self, spec,
+                        f"protocol {spec.name!r}: producer {token!r} "
+                        "matches no function in the tree")
+            for token, fns in model.consumers.items():
+                if not fns:
+                    yield _spec_finding(
+                        self, spec,
+                        f"protocol {spec.name!r}: consumer {token!r} "
+                        "matches no function in the tree")
+            for frame, body in (spec.wire.get("frames") or {}).items():
+                body = body or {}
+                sites = model.sites.get(frame, [])
+                if not sites and any(model.frame_producers(frame)
+                                     .values()):
+                    yield _spec_finding(
+                        self, spec,
+                        f"protocol {spec.name!r}: frame {frame!r} has no "
+                        "emission site in its producers — dead spec arm "
+                        "or the emission moved; update the spec or the "
+                        "code")
+                    continue
+                if not sites:
+                    continue
+                reads = body.get("read", []) or []
+                if not reads:
+                    continue
+                for token, fns in model.frame_consumers(frame).items():
+                    for fn in fns:
+                        if not any(extraction._match_read(fn, m)
+                                   for m in reads):
+                            want = ", ".join(
+                                str(m.get("key") or m.get("attr"))
+                                for m in reads)
+                            yield _fn_finding(
+                                self, fn,
+                                f"consumer {fn_label(fn)} never reads "
+                                f"{want!r}: the {frame!r} frame of "
+                                f"protocol {spec.name!r} is emitted "
+                                "but silently dropped here")
+
+
+class PostTerminalEmission(ProjectRule):
+    id = "DS201"
+    name = "post-terminal-emission"
+    description = (
+        "An api method that drives a protocol machine does not read the "
+        "terminal-state flags before emitting, so a call racing or "
+        "following fail()/finish() mutates a settled lifecycle "
+        "(resurrecting released resources, republishing closed totals); "
+        "or a producer emits another frame lexically after a terminal "
+        "frame in the same block. Guard the method on every "
+        "terminal_attr the spec declares (or the spec's per-method "
+        "`guards` subset).")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        for spec in specs.load_specs():
+            if spec.errors:
+                continue
+            for am in extraction.api_model(spec, project):
+                if not am.guards:
+                    continue
+                for fn in am.fns:
+                    missing = am.missing_guards(fn)
+                    if missing:
+                        yield _fn_finding(
+                            self, fn,
+                            f"{fn_label(fn)} emits "
+                            f"{am.event or 'machine events'} for protocol "
+                            f"{spec.name!r} without checking terminal "
+                            f"flag(s) {', '.join(missing)} — a call "
+                            "after the machine settled would emit past "
+                            "a terminal state")
+            model = extraction.wire_model(spec, project)
+            if model is None:
+                continue
+            terminal_frames = {
+                f for f, body in (spec.wire.get("frames") or {}).items()
+                if (body or {}).get("terminal")}
+            all_sites = [s for sites in model.sites.values()
+                         for s in sites]
+            for site in all_sites:
+                if site.frame not in terminal_frames or site.exits_after:
+                    continue
+                for other in all_sites:
+                    if (other.block is site.block
+                            and other.index > site.index
+                            and not self._exits_between(site, other)):
+                        yield _site_finding(
+                            self, other,
+                            f"frame {other.frame!r} emitted after the "
+                            f"terminal {site.frame!r} frame in the same "
+                            f"block (protocol {spec.name!r}): the "
+                            "stream already ended")
+
+    @staticmethod
+    def _exits_between(first: EmitSite, second: EmitSite) -> bool:
+        return any(isinstance(stmt, (ast.Return, ast.Raise, ast.Break))
+                   for stmt in first.block[first.index + 1:second.index])
+
+
+class NoFailurePathToTerminal(ProjectRule):
+    id = "DS301"
+    name = "no-failure-path-to-terminal"
+    description = (
+        "A non-terminal, non-idle spec state has no failure or "
+        "cancellation transition whose path reaches a terminal state: "
+        "an error or cancel arriving there strands the instance (and "
+        "whatever it holds — pages, slots, probe tokens) forever. Add "
+        "the failure arm to the machine and the code, or mark the state "
+        "`idle` when nothing is in flight.")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for spec in specs.load_specs():
+            if spec.errors or not spec.terminal_states:
+                continue
+            failure = spec.failure_events
+            if not failure:
+                continue  # machine declares no failure class (cyclic)
+            reach = spec.reaches_terminal()
+            for state in spec.states:
+                if spec.is_terminal(state) or spec.is_idle(state):
+                    continue
+                ok = any(event in failure and dst in reach
+                         for event, dst in spec.transitions(state).items())
+                if not ok:
+                    yield _spec_finding(
+                        self, spec,
+                        f"protocol {spec.name!r}: state {state!r} cannot "
+                        "reach a terminal state on any failure/"
+                        "cancellation event")
+
+
+class CancellationUnhandled(ProjectRule):
+    id = "DS401"
+    name = "cancellation-unhandled-in-state"
+    description = (
+        "A cancellation event is not accepted in some non-terminal, "
+        "non-idle state of the machine: a cancel arriving in that state "
+        "has no transition, which is exactly where cancelled work leaks "
+        "(the stranded-shutdown bug class). Accept the event in the "
+        "state or list the state in the event's `ignores` with a "
+        "reviewed reason.")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        for spec in specs.load_specs():
+            if spec.errors:
+                continue
+            for event in sorted(spec.cancellation_events):
+                ignores = set((spec.events.get(event) or {})
+                              .get("ignores", []) or [])
+                for state in spec.states:
+                    if (spec.is_terminal(state) or spec.is_idle(state)
+                            or state in ignores):
+                        continue
+                    if event not in spec.transitions(state):
+                        yield _spec_finding(
+                            self, spec,
+                            f"protocol {spec.name!r}: cancellation event "
+                            f"{event!r} is unhandled in state {state!r}")
+
+
+class TerminalFrameNotOnce(ProjectRule):
+    id = "DS501"
+    name = "terminal-frame-not-exactly-once"
+    description = (
+        "A terminal frame is emitted inside a loop without an immediate "
+        "exit (return/raise/break as the next statement), so one "
+        "instance's stream can terminate more than once; or a terminal "
+        "machine event has no emitting api method left in the tree. "
+        "Terminal frames settle the peer's state machine — exactly-once "
+        "is the contract every consumer leans on.")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        project = get_project(files)
+        for spec in specs.load_specs():
+            if spec.errors:
+                continue
+            model = extraction.wire_model(spec, project)
+            if model is not None:
+                terminal_frames = {
+                    f for f, body in (spec.wire.get("frames") or {}).items()
+                    if (body or {}).get("terminal")}
+                for frame in sorted(terminal_frames):
+                    for site in model.sites.get(frame, []):
+                        if site.in_loop and not site.exits_after:
+                            yield _site_finding(
+                                self, site,
+                                f"terminal frame {frame!r} of protocol "
+                                f"{spec.name!r} emitted inside a loop "
+                                "without an immediate exit — the stream "
+                                "could terminate twice")
+            # api side: every terminal event bound to a method must
+            # still have a matching method in the tree.
+            bound = {}
+            for am in extraction.api_model(spec, project):
+                if am.event is not None:
+                    bound.setdefault(am.event, []).extend(am.fns)
+            for event in sorted(spec.terminal_events & set(bound)):
+                if not bound[event]:
+                    yield _spec_finding(
+                        self, spec,
+                        f"protocol {spec.name!r}: terminal event "
+                        f"{event!r} is bound to an api method that no "
+                        "longer exists in the tree")

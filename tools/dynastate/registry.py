@@ -1,0 +1,108 @@
+"""Protocol-registry snapshot: the tree's spec'd lifecycle surface.
+
+Every machine dynastate checks — states, events, emission sites,
+consumer dispatch verdicts, api guard verdicts — snapshots into
+``tools/dynastate/protocols/protocol_registry.json``. Like dynaflow's
+wire schemas, dynajit's jit surface, and dynarace's channel registry,
+the protocol surface must change *deliberately*: DS102 fails with a
+diff whenever the extracted surface drifts from the snapshot. Bless a
+reviewed change with ``python -m tools.dynastate --registry-update``
+and commit the regenerated file.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Iterable, Optional
+
+from tools.dynalint.core import Finding, ProjectRule, SourceFile
+
+from . import specs as specs_mod
+from .extraction import protocol_surface
+
+REGISTRY_NAME = specs_mod.REGISTRY_NAME
+
+
+def registry_path() -> pathlib.Path:
+    """The snapshot lives beside the specs it summarizes, so fixture
+    spec dirs carry their own registries."""
+    return specs_mod.active_spec_dir() / REGISTRY_NAME
+
+
+def _surface(files: list[SourceFile]) -> dict:
+    return protocol_surface(specs_mod.load_specs(), files)
+
+
+def update_registry(files: list[SourceFile],
+                    path: Optional[pathlib.Path] = None) -> bool:
+    """Regenerate the checked-in protocol registry; True if changed."""
+    path = registry_path() if path is None else path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(_surface(files), indent=2, sort_keys=True) + "\n"
+    if path.exists() and path.read_text() == payload:
+        return False
+    path.write_text(payload)
+    return True
+
+
+def diff_registry(files: list[SourceFile],
+                  path: Optional[pathlib.Path] = None,
+                  ) -> Optional[list[str]]:
+    """None when the tree matches the snapshot; otherwise human-readable
+    drift lines."""
+    path = registry_path() if path is None else path
+    if not path.exists():
+        return [f"no protocol registry at {path}; run `python -m "
+                "tools.dynastate --registry-update` and commit the result"]
+    try:
+        want = json.loads(path.read_text())
+    except ValueError as exc:
+        return [f"protocol registry at {path} is unreadable ({exc}); "
+                "run `python -m tools.dynastate --registry-update`"]
+    got = _surface(files)
+    if got == want:
+        return None
+
+    def by_protocol(payload: dict) -> dict[str, str]:
+        return {e.get("protocol", "?"): json.dumps(e, sort_keys=True)
+                for e in payload.get("protocols", [])}
+
+    want_p, got_p = by_protocol(want), by_protocol(got)
+    lines = []
+    for name in sorted(set(got_p) - set(want_p)):
+        lines.append(f"added protocol: {name}")
+    for name in sorted(set(want_p) - set(got_p)):
+        lines.append(f"removed protocol: {name}")
+    for name in sorted(set(want_p) & set(got_p)):
+        if want_p[name] == got_p[name]:
+            continue
+        w, g = json.loads(want_p[name]), json.loads(got_p[name])
+        for section in ("machine", "emits", "handles", "api"):
+            if w.get(section) != g.get(section):
+                lines.append(f"changed: {name}.{section}")
+    return lines or ["protocol registry drifted (regenerate)"]
+
+
+class ProtocolRegistryDrift(ProjectRule):
+    id = "DS102"
+    name = "protocol-registry-drift"
+    description = (
+        "The extracted protocol surface — state machines, emission "
+        "sites, consumer dispatch verdicts, api guard verdicts — no "
+        "longer matches the checked-in snapshot "
+        "(tools/dynastate/protocols/protocol_registry.json). Protocol "
+        "changes must be deliberate: review the diff, then bless it "
+        "with `python -m tools.dynastate --registry-update` and commit "
+        "the regenerated registry.")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        lines = diff_registry(files)
+        if lines is None:
+            return
+        path = registry_path().as_posix()
+        for line in lines:
+            yield Finding(self.id, self.name, path, 1, 0,
+                          f"protocol surface drifted from snapshot: "
+                          f"{line} (bless with `python -m tools.dynastate "
+                          "--registry-update`)")

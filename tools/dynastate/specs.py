@@ -1,0 +1,213 @@
+"""Protocol spec loading for dynastate.
+
+A *spec* is one hand-authored JSON state machine under
+``tools/dynastate/protocols/*.json`` describing a frame/lifecycle
+protocol the tree implements: named states with event-keyed
+transitions, terminal states, failure/cancellation event classes, and
+an *extraction* section binding machine events to concrete emission and
+dispatch sites in the code (see docs/static-analysis.md §dynastate for
+the authoring workflow). The same files drive the static rules (DS1xx-
+DS5xx) and the runtime ProtocolMonitor (dynamo_tpu/runtime/
+conformance.py), so the machine checked in CI is the machine enforced
+in chaos runs.
+
+Spec shape::
+
+    {
+      "version": 1,
+      "protocol": "kv_stream_transfer",
+      "doc": "...",
+      "initial": "streaming",
+      "states": {
+        "streaming": {"on": {"append": "streaming", "fail": "failed"}},
+        "failed":    {"terminal": true}
+      },
+      "events": {
+        "append": {},
+        "fail": {"terminal": true, "failure": true, "cancellation": true,
+                  "ignores": ["some_state"]}
+      },
+      "wire": {...},   # frame extraction (see extraction.py)
+      "api":  [...]    # object-API extraction (see extraction.py)
+    }
+
+States may set ``"idle": true``: a quiescent state with nothing in
+flight, exempt from the DS301/DS401 must-reach-terminal obligations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Optional
+
+SPEC_DIR = pathlib.Path(__file__).resolve().parent / "protocols"
+REGISTRY_NAME = "protocol_registry.json"
+
+# Overridable for fixture trees (tests ship their own tiny spec dirs);
+# the CLI's --spec-dir flag lands here too.
+_active_dir: pathlib.Path = SPEC_DIR
+
+
+def set_spec_dir(path: Optional[str | pathlib.Path]) -> None:
+    global _active_dir
+    _active_dir = SPEC_DIR if path is None else pathlib.Path(path)
+
+
+def active_spec_dir() -> pathlib.Path:
+    return _active_dir
+
+
+@dataclasses.dataclass
+class ProtocolSpec:
+    name: str
+    path: str  # posix path of the spec file (finding anchor)
+    raw: dict
+    errors: list[str]  # structural problems (DS100's business)
+
+    # -- machine queries ---------------------------------------------------
+
+    @property
+    def states(self) -> dict:
+        return self.raw.get("states", {}) or {}
+
+    @property
+    def events(self) -> dict:
+        return self.raw.get("events", {}) or {}
+
+    @property
+    def initial(self) -> Optional[str]:
+        return self.raw.get("initial")
+
+    def transitions(self, state: str) -> dict:
+        return (self.states.get(state) or {}).get("on", {}) or {}
+
+    def is_terminal(self, state: str) -> bool:
+        return bool((self.states.get(state) or {}).get("terminal"))
+
+    def is_idle(self, state: str) -> bool:
+        return bool((self.states.get(state) or {}).get("idle"))
+
+    @property
+    def terminal_states(self) -> set[str]:
+        return {s for s in self.states if self.is_terminal(s)}
+
+    def event_flag(self, event: str, flag: str) -> bool:
+        return bool((self.events.get(event) or {}).get(flag))
+
+    @property
+    def failure_events(self) -> set[str]:
+        return {e for e in self.events
+                if self.event_flag(e, "failure")
+                or self.event_flag(e, "cancellation")}
+
+    @property
+    def cancellation_events(self) -> set[str]:
+        return {e for e in self.events
+                if self.event_flag(e, "cancellation")}
+
+    @property
+    def terminal_events(self) -> set[str]:
+        return {e for e in self.events if self.event_flag(e, "terminal")}
+
+    def reaches_terminal(self) -> set[str]:
+        """States from which SOME transition path ends in a terminal
+        state (terminal states included)."""
+        reach = set(self.terminal_states)
+        changed = True
+        while changed:
+            changed = False
+            for state in self.states:
+                if state in reach:
+                    continue
+                if any(dst in reach
+                       for dst in self.transitions(state).values()):
+                    reach.add(state)
+                    changed = True
+        return reach
+
+    # -- extraction sections -----------------------------------------------
+
+    @property
+    def wire(self) -> Optional[dict]:
+        return self.raw.get("wire")
+
+    @property
+    def api(self) -> list[dict]:
+        return self.raw.get("api", []) or []
+
+
+def _validate(spec: ProtocolSpec) -> None:
+    raw, errs = spec.raw, spec.errors
+    if not isinstance(raw.get("protocol"), str) or not raw.get("protocol"):
+        errs.append("missing 'protocol' name")
+    states = raw.get("states")
+    if not isinstance(states, dict) or not states:
+        errs.append("missing or empty 'states'")
+        return
+    initial = raw.get("initial")
+    if initial not in states:
+        errs.append(f"initial state {initial!r} is not a declared state")
+    events = raw.get("events") or {}
+    for state, body in states.items():
+        if not isinstance(body, dict):
+            errs.append(f"state {state!r} body must be an object")
+            continue
+        for event, dst in (body.get("on") or {}).items():
+            if event not in events:
+                errs.append(f"state {state!r} transitions on undeclared "
+                            f"event {event!r}")
+            if dst not in states:
+                errs.append(f"state {state!r} transitions to undeclared "
+                            f"state {dst!r} on {event!r}")
+        if body.get("terminal") and (body.get("on") or {}):
+            errs.append(f"terminal state {state!r} declares outgoing "
+                        "transitions")
+    for event, body in events.items():
+        for ignored in (body or {}).get("ignores", []) or []:
+            if ignored not in states:
+                errs.append(f"event {event!r} ignores undeclared state "
+                            f"{ignored!r}")
+    wire = raw.get("wire")
+    if wire is not None:
+        for frame, body in (wire.get("frames") or {}).items():
+            ev = (body or {}).get("event")
+            if ev is not None and ev not in events:
+                errs.append(f"frame {frame!r} maps to undeclared event "
+                            f"{ev!r}")
+    for entry in raw.get("api", []) or []:
+        for method, body in (entry.get("methods") or {}).items():
+            ev = (body or {}).get("event")
+            if ev is not None and ev not in events:
+                errs.append(f"api method {method!r} maps to undeclared "
+                            f"event {ev!r}")
+
+
+def load_specs(spec_dir: Optional[pathlib.Path] = None
+               ) -> list[ProtocolSpec]:
+    """Parse every spec in the active dir (registry snapshot excluded).
+    Unreadable files come back as specs whose `errors` carry the parse
+    failure so DS100 can report instead of the run crashing."""
+    root = spec_dir if spec_dir is not None else _active_dir
+    specs: list[ProtocolSpec] = []
+    if not root.is_dir():
+        return specs
+    for path in sorted(root.glob("*.json")):
+        if path.name == REGISTRY_NAME:
+            continue
+        rel = path.as_posix()
+        try:
+            raw = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as exc:
+            specs.append(ProtocolSpec(path.stem, rel, {},
+                                      [f"cannot parse: {exc}"]))
+            continue
+        if not isinstance(raw, dict):
+            specs.append(ProtocolSpec(path.stem, rel, {},
+                                      ["top level must be an object"]))
+            continue
+        spec = ProtocolSpec(raw.get("protocol") or path.stem, rel, raw, [])
+        _validate(spec)
+        specs.append(spec)
+    return specs
