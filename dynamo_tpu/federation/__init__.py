@@ -1,0 +1,33 @@
+"""Federation plane: one logical service over N cells.
+
+Every robustness plane in this repo — drain ladder, journal CRC +
+resync, session pins, QoS budgets, admission estimators — exists as a
+per-cell singleton. This package composes them across cells into one
+logical service that survives losing a whole cell (docs/federation.md):
+
+* `cells`       — Cell + CellDirectory: membership, load, heartbeats,
+                  the serving/evacuating/evacuated/lost lifecycle.
+* `router`      — FederationRouter: residency-first routing learned
+                  from `session_pins` journal events, pressure-gated
+                  spill with an honest cold-start cost model.
+* `reconciler`  — FederationReconciler: cross-cell event streams on
+                  CRC journal framing, measured lag, resync rung.
+* `evacuation`  — FederationControl: the `evacuate` verb (handoff /
+                  replay / honest deadline errors) and unplanned
+                  cell-loss handling (breaker board failed, residency
+                  cleared, QoS budgets redistributed).
+"""
+
+from .cells import (  # noqa: F401
+    EVACUATED,
+    EVACUATING,
+    LOST,
+    SERVING,
+    STATE_VALUES,
+    Cell,
+    CellDirectory,
+)
+from .evacuation import PROTOCOL as EVACUATION_PROTOCOL  # noqa: F401
+from .evacuation import FederationControl  # noqa: F401
+from .reconciler import FederationReconciler  # noqa: F401
+from .router import FederationRouter, RouteDecision, coldstart_lead_s  # noqa: F401

@@ -1,0 +1,214 @@
+"""Cell directory: membership + health for the federation plane.
+
+A *cell* is one self-contained deployment — a pool namespace with its
+own frontends, routers, workers, and the per-cell singletons every
+robustness plane ships (drain ladder, journal, session tier, QoS
+budgets). The directory is the federation's view of those cells: load
+reports, heartbeats, and a four-state lifecycle
+(serving → evacuating → evacuated, or → lost on heartbeat expiry).
+
+Pressure mirrors the global planner's PoolState semantics exactly —
+capacity-weighted KV usage plus queue backlog per live worker, with the
+mean-reported-capacity default for workers that publish total_blocks=0
+— so the federation router and the planner agree on which cell is hot.
+Each cell also owns a QueueWaitEstimator fed by the same load reports:
+the router's spill cost model compares *seconds of estimated queue
+wait*, not bare pressure scores, so staying home and spilling are
+priced in the same unit.
+
+Every method takes an injectable `now` (monotonic seconds): the chaos
+scenario drives three cells plus the directory off one synthetic clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.admission import QueueWaitEstimator
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+
+log = get_logger("federation.cells")
+
+SERVING = "serving"
+EVACUATING = "evacuating"
+EVACUATED = "evacuated"
+LOST = "lost"
+
+# Gauge encoding for dynamo_federation_cell_state{cell}.
+STATE_VALUES = {SERVING: 0, EVACUATING: 1, EVACUATED: 2, LOST: 3}
+
+
+class Cell:
+    """One deployment's standing in the federation."""
+
+    def __init__(self, name: str, namespace: Optional[str] = None,
+                 mesh_handoff: bool = True,
+                 qos_budget: float = 0.0,
+                 metrics_ttl: float = 60.0,
+                 now: Optional[float] = None) -> None:
+        self.name = name
+        # Pool namespace the cell serves under (global_router/planner
+        # key); defaults to the cell name — one cell, one namespace.
+        self.namespace = namespace or name
+        # Whether a neighbor's mesh can receive this cell's KV blocks
+        # directly (ICI/DMA reachable). Gates the evacuation rung:
+        # handoff where meshes allow, cooperative replay otherwise.
+        self.mesh_handoff = mesh_handoff
+        # Share of the fleet QoS budget (token capacity) this cell
+        # carries; redistributed to survivors on loss/evacuation.
+        self.qos_budget = qos_budget
+        self.metrics_ttl = metrics_ttl
+        self.state = SERVING
+        # worker id -> (kv_usage, waiting, total_blocks, receipt time)
+        self.workers: dict[int, tuple[float, int, int, float]] = {}
+        self.last_heartbeat = time.monotonic() if now is None else now
+        # Queue-wait estimate in SECONDS — the unit the spill cost
+        # model prices cold starts against.
+        self.wait = QueueWaitEstimator(pool=f"cell/{name}")
+        self._set_gauge()
+
+    def _set_gauge(self) -> None:
+        rt_metrics.FEDERATION_CELL_STATE.labels(cell=self.name).set(
+            STATE_VALUES[self.state])
+
+    # -- health --------------------------------------------------------------
+
+    def heartbeat(self, now: Optional[float] = None) -> None:
+        self.last_heartbeat = time.monotonic() if now is None else now
+
+    def alive(self, now: Optional[float] = None,
+              timeout_s: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        if timeout_s is None:
+            timeout_s = float(env("DYNT_FED_HEARTBEAT_TIMEOUT_SECS"))
+        return now - self.last_heartbeat <= timeout_s
+
+    def serving(self) -> bool:
+        return self.state == SERVING
+
+    # -- load ----------------------------------------------------------------
+
+    def record(self, worker_id: int, kv_usage: float, waiting: int,
+               total_blocks: int = 0,
+               now: Optional[float] = None) -> None:
+        """Fold one worker's load report in (LoadMetrics fields). Also
+        counts as a heartbeat — a cell publishing load is alive."""
+        now = time.monotonic() if now is None else now
+        self.workers[worker_id] = (
+            float(kv_usage), max(0, int(waiting)),
+            max(0, int(total_blocks)), now)
+        self.wait.update_worker(worker_id, waiting, now=now)
+        self.last_heartbeat = now
+
+    def observe_drained(self, n: float = 1.0,
+                        now: Optional[float] = None) -> None:
+        """A request entered service in this cell (feeds the drain-rate
+        EWMA behind the wait estimate)."""
+        self.wait.observe_drained(n, now=now)
+
+    def _live(self, now: float) -> list[tuple[float, int, int]]:
+        cutoff = now - self.metrics_ttl
+        stale = [w for w, (_, _, _, ts) in self.workers.items()
+                 if ts < cutoff]
+        for w in stale:
+            del self.workers[w]
+            self.wait.forget_worker(w)
+        return [(u, q, c) for u, q, c, _ in self.workers.values()]
+
+    def capacity(self, now: Optional[float] = None) -> int:
+        """Total KV blocks across live workers. 0 = the cell has no
+        capacity to route to (no workers, or none reporting) — the
+        router never selects it."""
+        now = time.monotonic() if now is None else now
+        return sum(c for _, _, c in self._live(now))
+
+    def pressure(self, now: Optional[float] = None) -> float:
+        """0..inf, PoolState.pressure semantics: capacity-weighted KV
+        usage plus waiting per live worker; total_blocks=0 reporters
+        get the mean reported capacity (a busy non-reporter still
+        contributes); no live workers = 0."""
+        now = time.monotonic() if now is None else now
+        live = self._live(now)
+        if not live:
+            return 0.0
+        caps = [c for _, _, c in live]
+        reported = [c for c in caps if c > 0]
+        default_cap = (sum(reported) / len(reported)) if reported else 1.0
+        weights = [c if c > 0 else default_cap for c in caps]
+        usage_mean = sum(u * w for (u, _, _), w in zip(live, weights)) \
+            / sum(weights)
+        waiting = sum(q for _, q, _ in live)
+        return usage_mean + waiting / max(1, len(live))
+
+    def est_wait_s(self, now: Optional[float] = None) -> float:
+        """Estimated queue wait in seconds for a new arrival (inf when
+        the cell's drain has stalled)."""
+        return self.wait.estimate_wait_ms(now=now) / 1e3
+
+
+class CellDirectory:
+    """The federation's cell membership: add/get/sweep, loss callbacks.
+
+    `sweep(now)` is the health plane: any serving/evacuating cell whose
+    heartbeat aged past DYNT_FED_HEARTBEAT_TIMEOUT_SECS transitions to
+    LOST and every registered loss callback fires — that is where the
+    breaker board fails, residency clears, and QoS budgets redistribute
+    (federation/evacuation.py wires those)."""
+
+    def __init__(self, heartbeat_timeout_s: Optional[float] = None) -> None:
+        self._timeout_s = heartbeat_timeout_s
+        self.cells: dict[str, Cell] = {}
+        self._on_loss: list[Callable[[Cell, float], None]] = []
+
+    def timeout_s(self) -> float:
+        if self._timeout_s is not None:
+            return self._timeout_s
+        return float(env("DYNT_FED_HEARTBEAT_TIMEOUT_SECS"))
+
+    def add(self, cell: Cell) -> Cell:
+        self.cells[cell.name] = cell
+        cell._set_gauge()
+        return cell
+
+    def get(self, name: str) -> Optional[Cell]:
+        return self.cells.get(name)
+
+    def serving_cells(self) -> list[Cell]:
+        return [c for c in self.cells.values() if c.serving()]
+
+    def set_state(self, name: str, state: str) -> None:
+        cell = self.cells[name]
+        if cell.state == state:
+            return
+        log.info("cell %s: %s -> %s", name, cell.state, state)
+        cell.state = state
+        cell._set_gauge()
+
+    def on_cell_lost(self, cb: Callable[[Cell, float], None]) -> None:
+        self._on_loss.append(cb)
+
+    def sweep(self, now: Optional[float] = None) -> list[Cell]:
+        """Detect unplanned cell loss; returns the newly lost cells
+        (callbacks already fired, in registration order)."""
+        now = time.monotonic() if now is None else now
+        timeout = self.timeout_s()
+        lost: list[Cell] = []
+        for cell in self.cells.values():
+            if cell.state in (EVACUATED, LOST):
+                continue
+            if now - cell.last_heartbeat > timeout:
+                self.set_state(cell.name, LOST)
+                lost.append(cell)
+        for cell in lost:
+            for cb in self._on_loss:
+                try:
+                    cb(cell, now)
+                except Exception:  # noqa: BLE001 — one handler's bug
+                    # must not stop loss handling (breaker fail,
+                    # residency clear, budget redistribution)
+                    log.exception("cell-loss callback failed for %s",
+                                  cell.name)
+        return lost

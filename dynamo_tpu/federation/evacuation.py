@@ -1,0 +1,155 @@
+"""Cell evacuation + unplanned cell-loss handling.
+
+The PR-15 departure ladder (engine/drain.py) lifted to fleet
+granularity. Planned departure is the `evacuate` verb:
+
+    announce -> per-session handoff|replay -> (deadline) -> evacuated
+
+The cell stops taking new sessions the moment it announces (the
+directory flips it to EVACUATING, the router's `_routable` filter drops
+it), then every resident session is re-homed onto a serving neighbor —
+a *handoff* where both meshes can exchange KV directly
+(`Cell.mesh_handoff`), a cooperative *replay* (re-prefill from the
+session journal) otherwise. A session that cannot be placed by the
+deadline gets an honest error, never a silent drop. The ladder is a
+dynastate protocol (tools/dynastate/protocols/federation_evacuation
+.json) and every rung is observed by the runtime ProtocolMonitor, so
+the chaos scenario's zero-violations assertion covers it.
+
+Unplanned loss is the other entry to the same machine: the directory's
+heartbeat sweep flips the cell to LOST and this module's callback
+fails the cell's breaker board (instances fail-fast instead of timing
+out), clears residency (sessions re-home on their next turn; their
+pins expire at TTL on the surviving replicas — there is nothing to
+hand off, the KV died with the mesh), drops the cell's reconciliation
+streams, redistributes its QoS budget over the survivors by serving
+capacity, and removes the pool from the global planner so the next
+plan() re-apportions the replica budget by surviving pressure.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.conformance import observe
+from ..runtime.logging import get_logger
+from .cells import EVACUATED, EVACUATING, Cell, CellDirectory
+from .reconciler import FederationReconciler
+from .router import FederationRouter
+
+log = get_logger("federation.evacuation")
+
+PROTOCOL = "federation_evacuation"
+
+
+class FederationControl:
+    """The federation's control verbs over one CellDirectory.
+
+    `boards` maps cell name -> that cell's BreakerBoard (the per-cell
+    routing plane's breaker registry); `planner` is a GlobalPlanner (or
+    anything with `remove_pool(namespace)`); both optional — a chaos
+    harness can wire only what it measures."""
+
+    def __init__(self, directory: CellDirectory,
+                 router: FederationRouter,
+                 reconciler: Optional[FederationReconciler] = None,
+                 planner=None, boards: Optional[dict] = None) -> None:
+        self.directory = directory
+        self.router = router
+        self.reconciler = reconciler
+        self.planner = planner
+        self.boards = boards or {}
+        directory.on_cell_lost(self.on_cell_lost)
+
+    # -- planned departure ---------------------------------------------------
+
+    def evacuate(self, name: str, now: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> dict:
+        """Drain cell `name` onto its neighbors. Returns a report dict
+        with per-rung counts; raises KeyError for an unknown cell."""
+        now = time.monotonic() if now is None else now
+        cell = self.directory.cells[name]
+        if deadline_s is None:
+            deadline_s = float(env("DYNT_FED_EVAC_DEADLINE_SECS"))
+        observe(PROTOCOL, name, "announce")
+        self.directory.set_state(name, EVACUATING)
+        sessions = self.router.sessions_on(name)
+        report = {"cell": name, "sessions": len(sessions),
+                  "handoff": 0, "replay": 0, "error": 0,
+                  "deadline_s": deadline_s}
+        targets = [c for c in self.directory.serving_cells()
+                   if c.capacity(now) > 0]
+        for sid in sessions:
+            target = self._pick_target(targets, now)
+            if target is None:
+                # Nowhere to put it and the clock is running: honest
+                # error at the deadline, never a silent drop.
+                observe(PROTOCOL, name, "deadline")
+                rt_metrics.FEDERATION_EVAC_SESSIONS.labels(
+                    outcome="error").inc()
+                report["error"] += 1
+                continue
+            rung = ("handoff" if cell.mesh_handoff and target.mesh_handoff
+                    else "replay")
+            observe(PROTOCOL, name, rung)
+            rt_metrics.FEDERATION_EVAC_SESSIONS.labels(
+                outcome=rung).inc()
+            self.router.observe_routed(sid, target.name, now=now)
+            report[rung] += 1
+        self._redistribute_budget(cell, now)
+        if self.planner is not None:
+            self.planner.remove_pool(cell.namespace)
+        if self.reconciler is not None:
+            self.reconciler.drop_cell(name)
+        observe(PROTOCOL, name, "evacuated")
+        self.directory.set_state(name, EVACUATED)
+        log.info("cell %s evacuated: %d handoff, %d replay, %d error",
+                 name, report["handoff"], report["replay"],
+                 report["error"])
+        return report
+
+    def _pick_target(self, targets: list[Cell],
+                     now: float) -> Optional[Cell]:
+        """Least-pressured serving neighbor. Evacuation places onto a
+        pressured neighbor rather than erroring — a queued session
+        beats a killed one — so only an empty target list fails."""
+        if not targets:
+            return None
+        return min(targets, key=lambda c: c.pressure(now))
+
+    # -- unplanned loss ------------------------------------------------------
+
+    def on_cell_lost(self, cell: Cell, now: float) -> None:
+        """Directory sweep callback: the cell's heartbeat expired."""
+        observe(PROTOCOL, cell.name, "lost")
+        board = self.boards.get(cell.name)
+        opened = board.fail_all() if board is not None else 0
+        cleared = self.router.clear_cell(cell.name)
+        if self.reconciler is not None:
+            self.reconciler.drop_cell(cell.name)
+        self._redistribute_budget(cell, now)
+        if self.planner is not None:
+            self.planner.remove_pool(cell.namespace)
+        log.warning("cell %s LOST: %d breakers opened, %d residencies "
+                    "cleared (pins expire at TTL)",
+                    cell.name, opened, cleared)
+
+    def _redistribute_budget(self, dead: Cell, now: float) -> None:
+        """Hand the departing cell's QoS budget to the survivors,
+        proportional to serving capacity (equal split when nobody
+        reports capacity)."""
+        if dead.qos_budget <= 0:
+            return
+        survivors = [c for c in self.directory.serving_cells()
+                     if c is not dead]
+        if not survivors:
+            return
+        caps = [max(0, c.capacity(now)) for c in survivors]
+        total = sum(caps)
+        for c, cap in zip(survivors, caps):
+            share = (cap / total) if total > 0 else 1.0 / len(survivors)
+            c.qos_budget += dead.qos_budget * share
+        dead.qos_budget = 0.0

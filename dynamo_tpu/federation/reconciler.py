@@ -1,0 +1,227 @@
+"""Cross-cell journal reconciliation with a bounded-lag contract.
+
+Each cell's SessionTier already emits pin/route/touch events with
+absolute expiries and a per-cell origin id (session/store.py). Inside a
+cell those ride the event plane; BETWEEN cells this reconciler streams
+them over per-direction append-only logs using the PR-15 CRC journal
+framing (runtime/events.py `_journal_pack`/`_journal_read`): every
+frame is length+CRC32 guarded, a corrupt frame is skipped with a
+re-sync to the next valid boundary, and a torn tail waits for the next
+pump instead of wedging the stream.
+
+The lag contract: every frame is stamped with the emitting cell's wall
+clock; on delivery the receiver measures `now - ts` and publishes it as
+`dynamo_federation_lag_seconds{from,to}`. When the measured lag exceeds
+DYNT_FED_MAX_LAG_SECS — a stalled link, a partitioned cell, corruption
+that ate a chunk of the stream — the stream takes the *resync rung*:
+the source's authoritative state (live leases + session affinities,
+`SessionTier.snapshot_events`) is applied wholesale, the backlog is
+skipped, and `dynamo_federation_resyncs_total{from,to}` counts the
+event. Duplicate deliveries on either path land in the receiving
+tier's bounded per-origin dedupe window, so at-least-once is safe.
+
+The router learns residency from the same stream: every drained event
+passes through `FederationRouter.learn` before fan-out, which is how
+"global_router learns session residency from the journal's
+session_pins events" is literally implemented.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.events import _journal_pack, _journal_read
+from ..runtime.logging import get_logger
+from ..session.store import SESSION_PIN_TOPIC, SessionTier
+from .router import FederationRouter
+
+log = get_logger("federation.reconciler")
+
+# Compact a stream's consumed prefix past this many bytes: the logs are
+# in-memory, and a week-long federation must not retain every frame it
+# ever delivered (the RSS-bounded contract the chaos scenario asserts).
+_COMPACT_BYTES = 1 << 20
+
+
+class _Stream:
+    """One direction src -> dst: an append-only CRC-framed log plus the
+    receiver's read offset."""
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.offset = 0
+        self.corrupt = 0
+        # Wall timestamp of the OLDEST undelivered frame; lets a paused
+        # (partitioned) stream's lag keep growing honestly even though
+        # nothing is being delivered. Cleared when the backlog drains.
+        self.oldest_pending_ts: Optional[float] = None
+
+    def append(self, payload: dict) -> None:
+        self.buf += _journal_pack(SESSION_PIN_TOPIC, payload)
+        ts = payload.get("ts")
+        if ts is not None and self.oldest_pending_ts is None:
+            self.oldest_pending_ts = float(ts)
+
+    def backlog(self) -> int:
+        return len(self.buf) - self.offset
+
+    def compact(self) -> None:
+        if self.offset > _COMPACT_BYTES:
+            del self.buf[: self.offset]
+            self.offset = 0
+
+
+class FederationReconciler:
+    """Pairwise event streaming between every registered cell's tier.
+
+    `pump(now)` drives one reconciliation round: drain each tier's
+    outbox once, stamp each event with the emitter's wall clock, feed
+    it to the router's residency map, fan it out to every peer stream,
+    then deliver every unpaused stream and enforce the lag contract.
+    `pause(src, dst)` / `unpause` model a partitioned link (chaos
+    scenarios use it to force the resync rung deterministically)."""
+
+    def __init__(self, router: Optional[FederationRouter] = None,
+                 max_lag_s: Optional[float] = None) -> None:
+        self.router = router
+        self._max_lag_s = max_lag_s
+        self.tiers: dict[str, SessionTier] = {}
+        self.streams: dict[tuple[str, str], _Stream] = {}
+        self.paused: set[tuple[str, str]] = set()
+        self.lag: dict[tuple[str, str], float] = {}
+        # Worst lag ever observed on any stream (pre-resync): chaos
+        # scenarios assert the contract was MEASURED, not just reset.
+        self.lag_peak = 0.0
+        self.resyncs = 0
+        self.corrupt_frames = 0
+
+    def max_lag_s(self) -> float:
+        if self._max_lag_s is not None:
+            return self._max_lag_s
+        return float(env("DYNT_FED_MAX_LAG_SECS"))
+
+    # -- membership ----------------------------------------------------------
+
+    def add_cell(self, name: str, tier: SessionTier) -> None:
+        for peer in self.tiers:
+            self.streams[(name, peer)] = _Stream()
+            self.streams[(peer, name)] = _Stream()
+        self.tiers[name] = tier
+        if self.router is not None:
+            self.router.register_origin(tier.origin, name)
+
+    def drop_cell(self, name: str) -> None:
+        """Cell left (lost or evacuated): its streams go with it. The
+        tier object stays with its owner — only reconciliation stops."""
+        self.tiers.pop(name, None)
+        for key in [k for k in self.streams if name in k]:
+            del self.streams[key]
+            self.paused.discard(key)
+            self.lag.pop(key, None)
+
+    def pause(self, src: str, dst: str) -> None:
+        self.paused.add((src, dst))
+
+    def unpause(self, src: str, dst: str) -> None:
+        self.paused.discard((src, dst))
+
+    # -- the pump ------------------------------------------------------------
+
+    def pump(self, now: Optional[float] = None,
+             wall: Optional[float] = None) -> dict:
+        """One reconciliation round. `now` is the shared monotonic
+        clock the tiers run on; `wall` the corresponding wall clock for
+        lag stamps (defaults to now + the first tier's offset so
+        injected-clock scenarios stay consistent)."""
+        now = time.monotonic() if now is None else now
+        if wall is None:
+            offsets = [t._mono_offset for t in self.tiers.values()]
+            wall = now + (offsets[0] if offsets else
+                          time.time() - time.monotonic())
+        delivered = 0
+        for src, tier in self.tiers.items():
+            for payload in tier.drain_events():
+                payload.setdefault("ts", wall)
+                if self.router is not None:
+                    self.router.learn(payload, now=now)
+                for dst in self.tiers:
+                    if dst != src:
+                        self.streams[(src, dst)].append(payload)
+        for (src, dst), stream in self.streams.items():
+            delivered += self._deliver(src, dst, stream, now, wall)
+        return {"delivered": delivered, "resyncs": self.resyncs,
+                "corrupt": self.corrupt_frames,
+                "max_lag_s": max(self.lag.values(), default=0.0)}
+
+    def _on_bad(self, stream: _Stream):
+        def count(n: int) -> None:
+            stream.corrupt += n
+            self.corrupt_frames += n
+        return count
+
+    def _set_lag(self, src: str, dst: str, lag: float) -> None:
+        self.lag[(src, dst)] = lag
+        self.lag_peak = max(self.lag_peak, lag)
+        rt_metrics.FEDERATION_LAG_SECONDS.labels(src, dst).set(lag)
+
+    def _deliver(self, src: str, dst: str, stream: _Stream,
+                 now: float, wall: float) -> int:
+        tier = self.tiers.get(dst)
+        if tier is None:
+            return 0
+        if (src, dst) in self.paused:
+            # Partitioned link: nothing moves, but the contract is
+            # still measured — the backlog head keeps aging. The
+            # resync rung fires on delivery once the link heals (or
+            # here, if the caller polls a dead link long enough that
+            # an operator should be paged).
+            if stream.backlog() > 0 \
+                    and stream.oldest_pending_ts is not None:
+                self._set_lag(src, dst,
+                              max(0.0, wall - stream.oldest_pending_ts))
+            return 0
+        applied = 0
+        worst_lag = 0.0
+        for next_off, topic, payload in _journal_read(
+                stream.buf, stream.offset, on_bad=self._on_bad(stream)):
+            stream.offset = next_off
+            if topic is None:
+                continue  # corrupt gap consumed, resynced to a boundary
+            ts = payload.get("ts")
+            if ts is not None:
+                worst_lag = max(worst_lag, wall - float(ts))
+            tier.apply_event(payload, now=now)
+            applied += 1
+        if stream.backlog() == 0:
+            stream.oldest_pending_ts = None
+        self._set_lag(src, dst, worst_lag if applied else 0.0)
+        if worst_lag > self.max_lag_s():
+            self._resync(src, dst, stream, now)
+        stream.compact()
+        return applied
+
+    def _resync(self, src: str, dst: str, stream: _Stream,
+                now: float) -> None:
+        """The bounded-lag escape hatch: a stream that blew the lag
+        contract may have lost frames to corruption or a partition, so
+        incremental replay alone is no longer trusted — apply the
+        source's authoritative snapshot (idempotent; already-applied
+        events hit the dedupe window) and start the stream clean."""
+        src_tier = self.tiers.get(src)
+        dst_tier = self.tiers.get(dst)
+        self.resyncs += 1
+        rt_metrics.FEDERATION_RESYNCS.labels(src, dst).inc()
+        log.warning("federation stream %s->%s lag %.1fs > %.1fs: "
+                    "resyncing from snapshot", src, dst,
+                    self.lag.get((src, dst), 0.0), self.max_lag_s())
+        if src_tier is None or dst_tier is None:
+            return
+        for payload in src_tier.snapshot_events(now=now):
+            dst_tier.apply_event(payload, now=now)
+        stream.offset = len(stream.buf)
+        stream.oldest_pending_ts = None
+        stream.compact()
+        self._set_lag(src, dst, 0.0)

@@ -1,0 +1,326 @@
+"""Residency-first global routing across federation cells.
+
+A returning session's KV prefix lives in ONE cell's cache tiers; send
+the session anywhere else and its next turn pays a full re-prefill —
+and, if the neighbor must scale up to absorb it, a worker cold start on
+top. The router therefore routes a returning session to its *resident*
+cell unconditionally while that cell is under the spill pressure
+threshold, and past it, spills only when the move is actually cheaper:
+
+    stay-home cost   = home cell's estimated queue wait (seconds)
+    spill cost       = neighbor's estimated queue wait
+                     + coldstart_lead × min(1, neighbor_pressure/threshold)
+
+where `coldstart_lead` is the PR-17 coldstart ladder's observed EWMA
+(engine/coldstart.py) — the measured seconds a new worker takes to
+first token — falling back to DYNT_FED_COLDSTART_DEFAULT_SECS while no
+cold start has been observed. The pressure scaling is the honest part:
+the fuller the neighbor, the likelier the spilled load forces a
+scale-up and actually pays that lead; an idle neighbor costs only the
+re-prefill, which the queue-wait term already dominates.
+
+Residency is learned from the journal's `session_pins` events: every
+pin/route/touch carries a per-cell origin id, the reconciler feeds each
+event through `learn()`, and the mapping session → cell lands in a
+bounded SessionStore (sharded, TinyLFU-gated, TTL'd — a router replica
+can restart and relearn residency from the stream). Cell names are
+interned to small ints so the store's worker_id slot carries them.
+
+Refusal contract: when EVERY serving cell is past the spill threshold,
+new sessions are refused with an honest Retry-After (the minimum
+estimated drain across cells) instead of being queued onto a saturated
+fleet — returning sessions still go home (their context is there;
+queueing at the resident cell is strictly cheaper than a refused turn
+or a cold re-prefill elsewhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+import zlib
+from typing import Optional
+
+from ..engine import coldstart
+from ..runtime import metrics as rt_metrics
+from ..runtime.admission import AdmissionRefused, clamp_retry_after_s
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from ..session.store import SessionStore
+from .cells import Cell, CellDirectory
+
+log = get_logger("federation.router")
+
+POLICIES = ("residency", "pressure")
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """Outcome of one federation routing decision.
+
+    outcome: resident | new | spill | rehomed | refused.
+    `retry_after_s` is non-zero on refusals AND on spills — a spill
+    stamps Retry-After as a hint that the home cell was pressured and
+    the client's next turn may find it drained."""
+
+    cell: Optional[str]
+    outcome: str
+    reason: str = ""
+    resident: Optional[str] = None
+    retry_after_s: float = 0.0
+    est_wait_s: float = 0.0
+
+
+def coldstart_lead_s() -> float:
+    """Measured cold-start lead (EWMA of completed ladder arrivals), or
+    the configured default while nothing has been observed."""
+    lead = coldstart.observed_cold_start_secs()
+    if lead is None:
+        return float(env("DYNT_FED_COLDSTART_DEFAULT_SECS"))
+    return float(lead)
+
+
+class FederationRouter:
+    """Cell selection over a CellDirectory, residency-first."""
+
+    def __init__(self, directory: CellDirectory,
+                 max_sessions: Optional[int] = None,
+                 policy: str = "residency",
+                 spill_pressure: Optional[float] = None) -> None:
+        assert policy in POLICIES, f"policy must be one of {POLICIES}"
+        self.directory = directory
+        self.policy = policy
+        self._spill_pressure = spill_pressure
+        # session id -> cell (interned in the worker_id slot); bounded +
+        # TTL'd like any session map — residency is a cache hint.
+        self.store = SessionStore(max_sessions=max_sessions,
+                                  model="federation")
+        self._cell_ids: dict[str, int] = {}
+        self._cell_names: dict[int, str] = {}
+        # journal origin id -> cell name (reconciler registers these;
+        # `session_pins` events only carry origins).
+        self._origins: dict[str, str] = {}
+
+    # -- residency plumbing --------------------------------------------------
+
+    def cell_id(self, name: str) -> int:
+        cid = self._cell_ids.get(name)
+        if cid is None:
+            cid = self._cell_ids[name] = len(self._cell_ids) + 1
+            self._cell_names[cid] = name
+        return cid
+
+    def register_origin(self, origin: str, cell_name: str) -> None:
+        self._origins[origin] = cell_name
+
+    def learn(self, payload: dict, now: Optional[float] = None) -> bool:
+        """Fold one `session_pins` event into the residency map: the
+        event's origin id names the cell where the session's KV lives.
+        Returns True when residency was recorded."""
+        if not isinstance(payload, dict):
+            return False
+        cell = self._origins.get(payload.get("o") or "")
+        sid = payload.get("sid")
+        if cell is None or not sid:
+            return False
+        if payload.get("op") not in ("pin", "route", "touch"):
+            return False
+        self.store.touch(sid, worker_id=self.cell_id(cell), now=now)
+        return True
+
+    def resident_cell(self, session_id: Optional[str],
+                      now: Optional[float] = None) -> Optional[str]:
+        if not session_id:
+            return None
+        entry = self.store.get(session_id, now=now)
+        if entry is None or entry.worker_id is None:
+            return None
+        return self._cell_names.get(entry.worker_id)
+
+    def observe_routed(self, session_id: Optional[str], cell: str,
+                       now: Optional[float] = None) -> None:
+        if not session_id:
+            return
+        self.store.touch(session_id, worker_id=self.cell_id(cell), now=now)
+
+    def clear_cell(self, name: str) -> int:
+        """Cell loss/evacuation: every session resident there loses its
+        affinity (entries stay — pins expire at their own TTL — but the
+        next turn re-homes). Returns the number cleared."""
+        cid = self._cell_ids.get(name)
+        if cid is None:
+            return 0
+        return self.store.remove_worker_id(cid)
+
+    def sessions_on(self, name: str) -> list[str]:
+        """Session ids currently resident on `name` (the evacuation
+        verb walks these)."""
+        cid = self._cell_ids.get(name)
+        if cid is None:
+            return []
+        out: list[str] = []
+        for shard in self.store._shards:
+            out.extend(sid for sid, e in shard.items()
+                       if e.worker_id == cid)
+        return out
+
+    # -- cost model ----------------------------------------------------------
+
+    def spill_threshold(self) -> float:
+        if self._spill_pressure is not None:
+            return self._spill_pressure
+        return float(env("DYNT_FED_SPILL_PRESSURE"))
+
+    def _spill_cost_s(self, neighbor: Cell, now: float) -> float:
+        """Seconds a session pays to land on `neighbor` instead of its
+        resident cell: the neighbor's queue wait plus the cold-start
+        lead scaled by how likely the extra load forces a scale-up."""
+        thresh = max(self.spill_threshold(), 1e-9)
+        scale = min(1.0, max(0.0, neighbor.pressure(now) / thresh))
+        return neighbor.est_wait_s(now) + coldstart_lead_s() * scale
+
+    def _shed_new(self, session_id: Optional[str], cell: Cell,
+                  now: float) -> bool:
+        """Graded backpressure for NEW sessions: load reports are
+        control-plane stale (a heartbeat old), so a hard open/shut gate
+        at the spill threshold oscillates — the instant pressure dips
+        below it, everything floods in, overshoots, and the queue
+        penalty blows the SLO for a whole report interval. Instead the
+        refusal probability ramps linearly from 0 at
+        `threshold × DYNT_FED_SHED_SOFT_FRAC` to 1 at the threshold, so
+        admission converges to an equilibrium just under the hard gate
+        with the queue still empty. The draw is a hash of the session
+        id — deterministic (replays and A/B traffic stay bit-identical)
+        and consistent (a shed session stays shed at that pressure
+        instead of flapping across retries)."""
+        thresh = self.spill_threshold()
+        soft = thresh * float(env("DYNT_FED_SHED_SOFT_FRAC"))
+        if thresh <= soft:
+            return False
+        prob = (cell.pressure(now) - soft) / (thresh - soft)
+        if prob <= 0.0:
+            return False
+        if not session_id:
+            return prob >= 1.0
+        draw = (zlib.crc32(session_id.encode()) & 0xFFFFFF) / 0x1000000
+        return draw < prob
+
+    def _routable(self, now: float) -> list[Cell]:
+        """Serving cells with non-zero capacity (a zero-capacity cell —
+        no live workers reporting blocks — is never a routing target)."""
+        return [c for c in self.directory.serving_cells()
+                if c.capacity(now) > 0]
+
+    # -- routing -------------------------------------------------------------
+
+    def route(self, session_id: Optional[str],
+              home: Optional[str] = None,
+              now: Optional[float] = None) -> RouteDecision:
+        """Pick a cell for one request. `home` is the edge the request
+        arrived at (the client's geographic preference); residency wins
+        over it for returning sessions."""
+        now = time.monotonic() if now is None else now
+        cells = self._routable(now)
+        if not cells:
+            return RouteDecision(
+                None, "refused", reason="no_serving_cells",
+                retry_after_s=clamp_retry_after_s(math.inf))
+        thresh = self.spill_threshold()
+        by_name = {c.name: c for c in cells}
+
+        resident = (self.resident_cell(session_id, now=now)
+                    if self.policy == "residency" else None)
+        if resident is not None:
+            cell = by_name.get(resident)
+            if cell is None:
+                # Resident cell evacuating/lost/empty: re-home. The
+                # spill reason is the cell's actual state when we still
+                # know it, "lost" once it's gone from the directory.
+                gone = self.directory.get(resident)
+                reason = gone.state if gone is not None else "lost"
+                rt_metrics.FEDERATION_RESIDENCY.labels(
+                    outcome="miss").inc()
+                target = min(cells, key=lambda c: c.pressure(now))
+                rt_metrics.FEDERATION_SPILL.labels(
+                    resident, target.name, reason).inc()
+                self.observe_routed(session_id, target.name, now=now)
+                return RouteDecision(target.name, "rehomed",
+                                     reason=reason, resident=resident)
+            if cell.pressure(now) < thresh:
+                rt_metrics.FEDERATION_RESIDENCY.labels(
+                    outcome="hit").inc()
+                self.observe_routed(session_id, resident, now=now)
+                return RouteDecision(resident, "resident",
+                                     resident=resident)
+            # Home is pressured: spill only when a neighbor is actually
+            # cheaper than queueing at home.
+            rt_metrics.FEDERATION_RESIDENCY.labels(outcome="miss").inc()
+            home_wait = cell.est_wait_s(now)
+            best, best_cost = None, math.inf
+            for n in cells:
+                if n is cell:
+                    continue
+                cost = self._spill_cost_s(n, now)
+                if cost < best_cost:
+                    best, best_cost = n, cost
+            if best is not None and best_cost < home_wait:
+                retry = clamp_retry_after_s(home_wait * 1e3)
+                rt_metrics.FEDERATION_SPILL.labels(
+                    resident, best.name, "pressure").inc()
+                self.observe_routed(session_id, best.name, now=now)
+                return RouteDecision(best.name, "spill",
+                                     reason="pressure",
+                                     resident=resident,
+                                     retry_after_s=retry,
+                                     est_wait_s=best_cost)
+            # Queueing at home beats every neighbor (cold-start cost
+            # dominates, or everyone is pressured): stay resident.
+            rt_metrics.FEDERATION_RESIDENCY.labels(outcome="hit").inc()
+            self.observe_routed(session_id, resident, now=now)
+            return RouteDecision(resident, "resident",
+                                 reason="pressured_home",
+                                 resident=resident,
+                                 est_wait_s=home_wait)
+
+        # No residency: prefer the arrival edge while it has headroom,
+        # else the least-pressured cell with headroom; all cells past
+        # the threshold = the federation is saturated -> refuse.
+        if session_id and self.policy == "residency":
+            rt_metrics.FEDERATION_RESIDENCY.labels(outcome="none").inc()
+        under = [c for c in cells if c.pressure(now) < thresh]
+        if not under:
+            est = min(c.est_wait_s(now) for c in cells)
+            return RouteDecision(
+                None, "refused", reason="all_cells_pressured",
+                retry_after_s=clamp_retry_after_s(
+                    est * 1e3 if est > 0 else math.inf),
+                est_wait_s=est)
+        hint = by_name.get(home) if home else None
+        if hint is not None and hint in under:
+            target, spilled = hint, False
+        else:
+            target = min(under, key=lambda c: c.pressure(now))
+            spilled = hint is not None
+        if self._shed_new(session_id, target, now):
+            est = target.est_wait_s(now)
+            return RouteDecision(
+                None, "refused", reason="backpressure",
+                retry_after_s=clamp_retry_after_s(
+                    est * 1e3 if est > 0 else 1e3),
+                est_wait_s=est)
+        if spilled:
+            # The preferred edge was pressured: this is a spill too.
+            rt_metrics.FEDERATION_SPILL.labels(
+                hint.name, target.name, "pressure").inc()
+        self.observe_routed(session_id, target.name, now=now)
+        return RouteDecision(target.name, "new")
+
+    def refusal(self, decision: RouteDecision) -> AdmissionRefused:
+        """Map a refused decision onto the admission-control exception
+        the frontends already translate to 503 + Retry-After."""
+        return AdmissionRefused(
+            f"federation refused: {decision.reason}",
+            retry_after_s=decision.retry_after_s,
+            est_wait_ms=decision.est_wait_s * 1e3,
+            pool="federation", reason="federation")

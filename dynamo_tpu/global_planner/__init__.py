@@ -124,6 +124,20 @@ class GlobalPlanner:
         self.decisions: list[dict] = []  # rolling log for observability
         self._down_streaks: dict[str, int] = {}
 
+    def remove_pool(self, namespace: str) -> Optional[PoolState]:
+        """A cell died or evacuated (federation/evacuation.py): drop
+        its pool from planning so the next plan() re-apportions the
+        SAME replica budget over the survivors by pressure — the dead
+        cell's share moves to where the displaced traffic lands instead
+        of staying parked on a namespace nobody serves."""
+        pool = self.pools.pop(namespace, None)
+        self._down_streaks.pop(namespace, None)
+        if pool is not None:
+            log.info("global planner: pool %s removed (budget %d now "
+                     "re-apportions over %s)", namespace, self.budget,
+                     sorted(self.pools))
+        return pool
+
     # -- rebalance ----------------------------------------------------------
 
     def plan(self) -> dict[str, int]:

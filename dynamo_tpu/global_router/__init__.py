@@ -25,6 +25,7 @@ from ..llm.manager import ModelManager, ModelWatcher
 from ..llm.model_card import CHAT, COMPLETIONS, PREFILL, ModelDeploymentCard, publish_card
 from ..llm.protocols import EngineOutput, PreprocessedRequest
 from ..runtime import DistributedRuntime, new_instance_id
+from ..runtime.admission import AdmissionRefused
 from ..runtime.logging import get_logger
 from ..runtime.push_router import NoInstancesAvailable
 
@@ -70,11 +71,17 @@ class GlobalRouter:
         policy: str = "least_loaded",
         router_mode: str = "kv",
         kv_config: Optional[KvRouterConfig] = None,
+        federation=None,
     ) -> None:
         assert policy in POLICIES, f"policy must be one of {POLICIES}"
         self.runtime = runtime
         self.served_model = served_model
         self.policy = policy
+        # Optional federation.FederationRouter: when set, pool selection
+        # is residency-first (cells are pool namespaces) and a refused
+        # decision sheds with Retry-After instead of piling onto a
+        # saturated fleet. None = the pre-federation policies.
+        self.federation = federation
         self.instance_id = new_instance_id()
         self.pools: list[Pool] = []
         for ns in pool_namespaces:
@@ -97,21 +104,51 @@ class GlobalRouter:
 
     # -- pool selection (ref: pool_selection.py) ---------------------------
 
-    def select_pool(self, model: str) -> Optional[Pool]:
+    def select_pool(self, model: str,
+                    session_id: Optional[str] = None) -> Optional[Pool]:
         serving = [p for p in self.pools if p.entry(model) is not None]
         if not serving:
             return None
+        if self.federation is not None:
+            pool = self._select_federated(serving, session_id)
+            if pool is not None:
+                return pool
+            # The federation's pick doesn't serve this model (mixed
+            # fleets): fall through to the plain policies.
         if self.policy == "round_robin" or len(serving) == 1:
             return serving[next(self._rr) % len(serving)]
         # least_loaded: idle pools (no published metrics yet) sort first.
         return min(serving, key=lambda p: p.load(model) or 0.0)
+
+    def _select_federated(self, serving: list[Pool],
+                          session_id: Optional[str]) -> Optional[Pool]:
+        """Residency-first selection: the federation router picks a
+        cell, cells ARE pool namespaces. Raises AdmissionRefused when
+        every cell is past the spill threshold (the frontend already
+        maps that to 503 + Retry-After)."""
+        decision = self.federation.route(session_id)
+        if decision.outcome == "refused":
+            raise self.federation.refusal(decision)
+        for pool in serving:
+            if pool.namespace == decision.cell:
+                return pool
+        return None
 
     # -- serving ------------------------------------------------------------
 
     async def generate(self, body: dict, ctx=None) -> AsyncIterator[dict]:
         request = PreprocessedRequest.from_wire(body)
         model = request.model or self.served_model
-        pool = self.select_pool(model)
+        try:
+            pool = self.select_pool(model, session_id=request.session_id)
+        except AdmissionRefused as refused:
+            # Saturated federation: honest shed, never a silent queue.
+            yield EngineOutput(
+                finish_reason="error",
+                error=(f"{refused} (retry after "
+                       f"{refused.retry_after_s:.0f}s)"),
+            ).to_wire()
+            return
         if pool is None:
             yield EngineOutput(
                 finish_reason="error",

@@ -1,0 +1,622 @@
+"""Federation chaos: one logical service over 3 cells, minus one.
+
+The chip-free proof behind docs/federation.md: three cells, an
+open-loop Poisson ramp to ~1M sessions, one cell killed mid-ramp and
+one evacuated gracefully — all on an injected clock, no sockets, no
+accelerators, CI-fast enough to gate every merge.
+
+Two arms run the SAME precomputed arrival schedule (bit-identical
+traffic, seeded session stickiness):
+
+  * **residency** — the full federation plane under chaos: a paused
+    reconciliation stream (forces the bounded-lag resync rung), cell-1
+    killed cold at 45% of the run (heartbeat expiry -> LOST -> breaker
+    board failed, residency cleared, QoS budgets redistributed, pool
+    dropped from the planner), cell-2 evacuated gracefully at 70%
+    (announce -> per-session handoff -> evacuated).
+  * **pressure** — the baseline router policy (no residency map) over
+    the pre-chaos window only, for the cached-turn TTFT comparison.
+
+Serving is modeled per cell: a slot pool with cached/cold service
+times, TTFT = base + queue penalty, completions on a heap. Requests
+admitted to a dead cell during the detection window are honest client
+errors; the assertions pin them INSIDE that window, require zero
+errors on the evacuation path, bound RSS, require residency-hit-rate
+recovery within DYNT_FED_HIT_RECOVERY_SECS-style budget, require SLO
+goodput to hold after failover, and require zero ProtocolMonitor
+violations (tools/dynastate/protocols/federation_evacuation.json).
+
+Run via scripts/chaos_federation.py (CI job `chaos-federation`) or the
+smaller tier-1 slice in tests/test_federation.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+import math
+import os
+import resource
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..federation import (
+    Cell,
+    CellDirectory,
+    FederationControl,
+    FederationReconciler,
+    FederationRouter,
+)
+from ..global_planner import GlobalPlanner, PoolState
+from ..kv_router.protocols import LoadMetrics
+from ..runtime import conformance
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from ..runtime.resilience import OPEN, BreakerBoard
+from ..session.store import PinLedger, SessionStore, SessionTier
+from .loadgen import CellSessionAssigner, ramp_arrival_times
+
+log = get_logger("mocker.federation_chaos")
+
+
+@dataclasses.dataclass
+class FederationChaosParams:
+    n_cells: int = 3
+    seconds: float = 600.0
+    # Per-cell open-loop ramp. 3 x (400->2400) rps over 600s = ~2.5M
+    # arrivals; with return_frac below that is ~1.1M distinct sessions.
+    start_rps: float = 400.0
+    end_rps: float = 2400.0
+    roam_frac: float = 0.12
+    return_frac: float = 0.55
+    session_window: int = 64
+    min_sessions: int = 1_000_000
+    # Serving model: slots per cell, cached vs cold service + TTFT.
+    workers_per_cell: int = 4
+    slots_per_worker: int = 2500
+    blocks_per_worker: int = 2048
+    service_cached_s: float = 1.2
+    service_cold_s: float = 1.8
+    ttft_cached_ms: float = 60.0
+    ttft_cold_ms: float = 350.0
+    queue_ms_per_waiting: float = 2.0
+    slo_ttft_ms: float = 500.0
+    tick_secs: float = 1.0
+    # Load-publish cadence, decoupled from the control tick: cells
+    # report at sub-second intervals (the repo's own runtime tests use
+    # load_publish_interval=0.2s) so the router's admission gate sees
+    # at most a quarter second of un-reported flood — with reports a
+    # full control tick stale, thousands of arrivals land between
+    # publishes and the gate bang-bangs around the threshold.
+    report_secs: float = 0.25
+    bucket_secs: float = 10.0
+    warmup_secs: float = 60.0
+    # Chaos timeline, as fractions of `seconds`.
+    pause_from_frac: float = 0.20
+    pause_to_frac: float = 0.25
+    kill_frac: float = 0.45
+    evac_frac: float = 0.70
+    # Federation knobs (passed explicitly, not via env).
+    heartbeat_timeout_s: float = 5.0
+    max_lag_s: float = 2.0
+    spill_pressure: float = 0.85
+    evac_deadline_s: float = 30.0
+    qos_budget_per_cell: float = 1000.0
+    replica_budget: int = 12
+    # Event-plane cadence: every Nth admitted turn emits a route event,
+    # every pin_every-th pins a prefix — keeps the outbox under its
+    # deque bound at peak single-cell load while still pushing millions
+    # of frames through the CRC streams.
+    route_event_every: int = 4
+    pin_every: int = 64
+    pin_ttl_secs: float = 120.0
+    # Caps under the offered load: the run must hold them, not fit them.
+    router_max_sessions: int = 400_000
+    tier_max_sessions: int = 200_000
+    tier_max_pin_blocks: int = 100_000
+    last_served_cap: int = 300_000
+    # Assertion budgets. hit_recovery_secs None = the registered
+    # DYNT_FED_HIT_RECOVERY_SECS budget (the pinned fleet contract);
+    # tiny test slices pass a scaled-down budget explicitly.
+    hit_recovery_secs: Optional[float] = None
+    hit_recovery_ratio: float = 0.8
+    goodput_floor: float = 0.90
+    rss_bound_mib: int = 1536
+    seed: int = 20260807
+
+    def t_kill(self) -> float:
+        return self.kill_frac * self.seconds
+
+    def t_evac(self) -> float:
+        return self.evac_frac * self.seconds
+
+
+def _rss_bytes() -> int:
+    # ru_maxrss: KiB on Linux, bytes on macOS.
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return peak * 1024 if os.uname().sysname == "Linux" else peak
+
+
+def build_schedule(
+    params: FederationChaosParams,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Merged arrival schedule as flat arrays (seconds, home cell idx,
+    edge cell idx) — the numpy form keeps ~2.5M arrivals at tens of MB
+    so the RSS assertion measures the federation, not the harness."""
+    p = params
+    times, homes, edges = [], [], []
+    for i in range(p.n_cells):
+        t = np.asarray(ramp_arrival_times(
+            p.start_rps, p.end_rps, p.seconds,
+            seed=p.seed + i * 7919), dtype=np.float64) / 1e3
+        rng = np.random.default_rng(p.seed + i * 15_485_863)
+        edge = np.full(len(t), i, dtype=np.int8)
+        roam = rng.random(len(t)) < p.roam_frac
+        n_roam = int(roam.sum())
+        if n_roam and p.n_cells > 1:
+            others = rng.integers(1, p.n_cells, n_roam)
+            edge[roam] = (i + others) % p.n_cells
+        times.append(t)
+        homes.append(np.full(len(t), i, dtype=np.int8))
+        edges.append(edge)
+    t_all = np.concatenate(times)
+    order = np.argsort(t_all, kind="stable")
+    return (t_all[order], np.concatenate(homes)[order],
+            np.concatenate(edges)[order])
+
+
+class _SimCell:
+    """Modeled serving capacity for one cell: a slot pool, completions
+    on a heap, TTFT = cached/cold base + a per-waiting queue penalty.
+    Feeds the Cell's load reports and the planner's PoolState from the
+    same numbers, so routing and planning see one truth."""
+
+    def __init__(self, cell: Cell, params: FederationChaosParams) -> None:
+        self.cell = cell
+        self.p = params
+        self.slots = params.workers_per_cell * params.slots_per_worker
+        self.active = 0
+        self.finish: list[float] = []
+        self.alive = True
+
+    def admit(self, now: float, cached: bool) -> float:
+        p = self.p
+        waiting = max(0, self.active - self.slots)
+        ttft_ms = ((p.ttft_cached_ms if cached else p.ttft_cold_ms)
+                   + waiting * p.queue_ms_per_waiting)
+        service = p.service_cached_s if cached else p.service_cold_s
+        heapq.heappush(self.finish, now + ttft_ms / 1e3 + service)
+        self.active += 1
+        return ttft_ms
+
+    def tick(self, now: float) -> int:
+        done = 0
+        while self.finish and self.finish[0] <= now:
+            heapq.heappop(self.finish)
+            done += 1
+        if done:
+            self.active = max(0, self.active - done)
+            if self.alive:
+                self.cell.observe_drained(done, now=now)
+        return done
+
+    def report(self, now: float, pool: PoolState) -> None:
+        p = self.p
+        usage = min(1.0, self.active / self.slots)
+        waiting = max(0, self.active - self.slots)
+        per, extra = divmod(waiting, p.workers_per_cell)
+        for w in range(p.workers_per_cell):
+            q = per + (1 if w < extra else 0)
+            self.cell.record(w, usage, q, p.blocks_per_worker, now=now)
+            pool.record(LoadMetrics(worker_id=w, kv_usage=usage,
+                                    waiting_requests=q,
+                                    total_blocks=p.blocks_per_worker))
+
+    def kill(self) -> int:
+        """Unplanned death: in-flight streams die with the mesh."""
+        self.alive = False
+        inflight = len(self.finish)
+        self.finish.clear()
+        self.active = 0
+        return inflight
+
+
+def _run_arm(params: FederationChaosParams, policy: str,
+             schedule: tuple[np.ndarray, np.ndarray, np.ndarray],
+             chaos: bool, end_s: float) -> dict:
+    p = params
+    conformance.reset_monitor()
+    times, homes, edges = schedule
+    names = [f"cell-{i}" for i in range(p.n_cells)]
+    name_idx = {n: i for i, n in enumerate(names)}
+
+    directory = CellDirectory(heartbeat_timeout_s=p.heartbeat_timeout_s)
+    cells: list[Cell] = []
+    sims: list[_SimCell] = []
+    pools: list[PoolState] = []
+    tiers: dict[str, SessionTier] = {}
+    boards: dict[str, BreakerBoard] = {}
+    for n in names:
+        cell = directory.add(Cell(n, mesh_handoff=True,
+                                  qos_budget=p.qos_budget_per_cell,
+                                  now=0.0))
+        cells.append(cell)
+        sims.append(_SimCell(cell, p))
+        pools.append(PoolState(namespace=n, connector=None))
+        tiers[n] = SessionTier(
+            model="federation-chaos", block_size=16,
+            store=SessionStore(max_sessions=p.tier_max_sessions,
+                               ttl_secs=p.seconds * 2,
+                               model=f"fedtier-{n}"),
+            ledger=PinLedger(max_blocks=p.tier_max_pin_blocks,
+                             model=f"fedtier-{n}"),
+            origin=f"origin-{n}", mono_offset=0.0)
+        board = BreakerBoard(endpoint=f"federation/{n}",
+                             failure_threshold=3, reset_secs=5.0)
+        for w in range(p.workers_per_cell):
+            board.get(w)
+        boards[n] = board
+
+    router = FederationRouter(directory,
+                              max_sessions=p.router_max_sessions,
+                              policy=policy,
+                              spill_pressure=p.spill_pressure)
+    recon = FederationReconciler(router, max_lag_s=p.max_lag_s)
+    for n in names:
+        recon.add_cell(n, tiers[n])
+    planner = GlobalPlanner(None, pools, p.replica_budget)
+    control = FederationControl(directory, router, reconciler=recon,
+                                planner=planner, boards=boards)
+    assigner = CellSessionAssigner(return_frac=p.return_frac,
+                                   window=p.session_window,
+                                   seed=p.seed + 1)
+    last_served: OrderedDict[str, int] = OrderedDict()
+
+    t_kill, t_evac = p.t_kill(), p.t_evac()
+    t_pause_on = p.pause_from_frac * p.seconds
+    t_pause_off = p.pause_to_frac * p.seconds
+    nb = max(1, int(math.ceil(end_s / p.bucket_secs)))
+    buckets = [{"t_s": i * p.bucket_secs, "offered": 0, "admitted": 0,
+                "good": 0, "shed": 0, "errors": 0, "returns": 0,
+                "ret_shed": 0, "hits": 0} for i in range(nb)]
+    error_times: list[float] = []
+    state = {"killed": False, "evacuated": False, "pause_on": False,
+             "pause_off": False, "t_detect": None, "evac_report": None,
+             "killed_inflight": 0}
+    ret_ttft_sum, ret_ttft_n = 0.0, 0
+    win_end = min(t_kill, end_s)
+    admitted_total = arrivals = 0
+
+    def tick(now: float) -> None:
+        if chaos:
+            if not state["pause_on"] and now >= t_pause_on:
+                recon.pause(names[0], names[2])
+                state["pause_on"] = True
+            if not state["pause_off"] and now >= t_pause_off:
+                recon.unpause(names[0], names[2])
+                state["pause_off"] = True
+            if not state["killed"] and now >= t_kill:
+                state["killed_inflight"] = sims[1].kill()
+                state["killed"] = True
+                log.warning("t=%.0fs: %s killed (%d in flight)",
+                            now, names[1], state["killed_inflight"])
+            if not state["evacuated"] and now >= t_evac:
+                state["evac_report"] = control.evacuate(
+                    names[2], now=now, deadline_s=p.evac_deadline_s)
+                # Handoff moved the KV with the session: a cached turn
+                # now lands cached on the new resident cell.
+                for sid, ci in last_served.items():
+                    if ci == 2:
+                        tgt = router.resident_cell(sid, now=now)
+                        if tgt in name_idx:
+                            last_served[sid] = name_idx[tgt]
+                state["evacuated"] = True
+        publish(now)
+        for cell in directory.sweep(now):
+            if cell.name == names[1] and state["t_detect"] is None:
+                state["t_detect"] = now
+        recon.pump(now=now, wall=now)
+        for tier in tiers.values():
+            tier.sweep(now)
+        router.store.sweep(now)
+
+    def publish(now: float) -> None:
+        """Drain completions and publish fresh load reports — the
+        fast data-plane cadence (report_secs), vs the 1s control
+        tick that also runs sweeps/reconciliation/chaos actions."""
+        for sim in sims:
+            sim.tick(now)
+        for i, sim in enumerate(sims):
+            if sim.alive and cells[i].serving():
+                sim.report(now, pools[i])
+
+    next_tick = 0.0
+    next_report = 0.0
+    report_step = min(p.report_secs, p.tick_secs)
+    for k in range(len(times)):
+        t = float(times[k])
+        if t >= end_s:
+            break
+        while min(next_tick, next_report) <= t:
+            if next_tick <= next_report:
+                tick(next_tick)
+                if next_report == next_tick:
+                    next_report += report_step
+                next_tick += p.tick_secs
+            else:
+                publish(next_report)
+                next_report += report_step
+        arrivals += 1
+        sid, is_ret = assigner.assign(names[int(homes[k])])
+        b = buckets[min(int(t // p.bucket_secs), nb - 1)]
+        b["offered"] += 1
+        if is_ret:
+            b["returns"] += 1
+        decision = router.route(sid, home=names[int(edges[k])], now=t)
+        if decision.outcome == "refused":
+            b["shed"] += 1
+            if is_ret:
+                # A refused turn never reaches a cell: the residency
+                # hit-rate is a routing-quality metric over SERVED
+                # turns, so these leave its denominator.
+                b["ret_shed"] += 1
+            continue
+        ci = name_idx[decision.cell]
+        sim = sims[ci]
+        if not sim.alive:
+            # Routed into a dead cell before the heartbeat sweep caught
+            # it: an honest client error, pinned to the loss window.
+            b["errors"] += 1
+            error_times.append(t)
+            continue
+        cached = is_ret and last_served.get(sid) == ci
+        if is_ret and decision.outcome == "resident":
+            b["hits"] += 1
+        ttft_ms = sim.admit(t, cached)
+        b["admitted"] += 1
+        admitted_total += 1
+        if ttft_ms <= p.slo_ttft_ms:
+            b["good"] += 1
+        if is_ret and p.warmup_secs <= t < win_end:
+            ret_ttft_sum += ttft_ms
+            ret_ttft_n += 1
+        last_served[sid] = ci
+        last_served.move_to_end(sid)
+        if len(last_served) > p.last_served_cap:
+            last_served.popitem(last=False)
+        tier = tiers.get(decision.cell)
+        if tier is not None:
+            if admitted_total % p.route_event_every == 0:
+                tier.observe_routed(sid, ci, now=t)
+            if admitted_total % p.pin_every == 0:
+                base = (k + 1) << 4
+                hashes = [base, base + 1, base + 2, base + 3]
+                lease = tier.ledger.pin(hashes, p.pin_ttl_secs,
+                                        lease_id=f"{sid}:{k:x}",
+                                        session_id=sid, now=t)
+                if lease is not None:
+                    tier._emit({"op": "pin", "lease": lease,
+                                "h": hashes,
+                                "exp": t + p.pin_ttl_secs, "sid": sid})
+    while next_tick <= end_s:
+        tick(next_tick)
+        next_tick += p.tick_secs
+
+    t_detect = state["t_detect"]
+    loss_end = (t_detect if t_detect is not None else end_s) \
+        + 2 * p.tick_secs
+    outside = [t for t in error_times
+               if not (t_kill - 1e-9 <= t <= loss_end)]
+    return {
+        "policy": policy, "chaos": chaos, "end_s": end_s,
+        "arrivals": arrivals, "sessions": assigner.sessions,
+        "admitted": admitted_total,
+        "shed": sum(b["shed"] for b in buckets),
+        "errors": len(error_times),
+        "errors_outside_loss_window": len(outside),
+        "errors_after_evac": sum(1 for t in error_times if t >= t_evac),
+        "killed_inflight": state["killed_inflight"],
+        "t_detect_s": t_detect,
+        "evacuation": state["evac_report"],
+        "resyncs": recon.resyncs,
+        "corrupt_frames": recon.corrupt_frames,
+        "lag_peak_s": recon.lag_peak,
+        "window_ret_ttft_ms": (ret_ttft_sum / ret_ttft_n
+                               if ret_ttft_n else None),
+        "window_ret_turns": ret_ttft_n,
+        "router_sessions": len(router.store),
+        "tier_sessions": {n: len(tiers[n].store) for n in names},
+        "dedupe_entries": {n: tiers[n].dedupe_entries() for n in names},
+        "qos_budgets": {n: directory.cells[n].qos_budget for n in names},
+        "final_plan": planner.plan(),
+        "breakers_open": {
+            n: sum(1 for br in boards[n]._breakers.values()
+                   if br.state == OPEN) for n in names},
+        "buckets": buckets,
+        "conformance": conformance.get_monitor().snapshot(),
+    }
+
+
+def _hit_recovery(p: FederationChaosParams, arm: dict):
+    """Seconds from loss detection until a full bucket's residency hit
+    rate is back within `hit_recovery_ratio` of the pre-kill mean, or
+    None with a reason."""
+    t_detect = arm["t_detect_s"]
+    if t_detect is None:
+        return None, {"reason": "loss never detected"}
+    pre = [b for b in arm["buckets"]
+           if p.warmup_secs <= b["t_s"]
+           and b["t_s"] + p.bucket_secs <= p.t_kill()]
+    pre_ret = sum(b["returns"] - b["ret_shed"] for b in pre)
+    if pre_ret == 0:
+        return None, {"reason": "no pre-kill returning turns"}
+    pre_rate = sum(b["hits"] for b in pre) / pre_ret
+    target = p.hit_recovery_ratio * pre_rate
+    for b in arm["buckets"]:
+        served_ret = b["returns"] - b["ret_shed"]
+        if b["t_s"] < t_detect or served_ret == 0:
+            continue
+        rate = b["hits"] / served_ret
+        if rate >= target:
+            rec = b["t_s"] + p.bucket_secs - t_detect
+            return rec, {"pre_rate": round(pre_rate, 4),
+                         "recovered_rate": round(rate, 4),
+                         "recovery_secs": rec}
+    return None, {"pre_rate": round(pre_rate, 4),
+                  "reason": "never recovered"}
+
+
+def run_federation(params: Optional[FederationChaosParams] = None) -> dict:
+    """Both arms + the assertion ledger. `passed` is the conjunction."""
+    p = params or FederationChaosParams()
+    report: dict = {"scenario": "federation_chaos",
+                    "params": dataclasses.asdict(p)}
+    prev = os.environ.get("DYNT_CONFORMANCE")
+    try:
+        os.environ["DYNT_CONFORMANCE"] = "1"
+        schedule = build_schedule(p)
+        report["offered_arrivals"] = int(len(schedule[0]))
+        res = _run_arm(p, "residency", schedule, chaos=True,
+                       end_s=p.seconds)
+        base = _run_arm(p, "pressure", schedule, chaos=False,
+                        end_s=p.t_kill())
+    finally:
+        if prev is None:
+            os.environ.pop("DYNT_CONFORMANCE", None)
+        else:
+            os.environ["DYNT_CONFORMANCE"] = prev
+        conformance.reset_monitor()
+    report["arms"] = {"residency": res, "pressure_baseline": base}
+    report["rss_peak_bytes"] = _rss_bytes()
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail=None) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    check("sessions_at_scale", res["sessions"] >= p.min_sessions,
+          {"sessions": res["sessions"], "floor": p.min_sessions})
+    evac = res["evacuation"] or {}
+    check("evacuation_zero_errors",
+          bool(evac) and evac.get("error") == 0
+          and res["errors_after_evac"] == 0,
+          {"evacuation": evac,
+           "errors_after_evac": res["errors_after_evac"]})
+    check("no_errors_outside_loss_window",
+          res["errors_outside_loss_window"] == 0,
+          {"errors": res["errors"],
+           "outside": res["errors_outside_loss_window"],
+           "killed_inflight": res["killed_inflight"]})
+    check("loss_detected_within_timeout",
+          res["t_detect_s"] is not None
+          and res["t_detect_s"] - p.t_kill()
+          <= p.heartbeat_timeout_s + 2 * p.tick_secs,
+          {"t_kill_s": p.t_kill(), "t_detect_s": res["t_detect_s"]})
+    check("rss_bounded",
+          report["rss_peak_bytes"] <= p.rss_bound_mib * (1 << 20),
+          {"rss_mib": round(report["rss_peak_bytes"] / (1 << 20), 1),
+           "bound_mib": p.rss_bound_mib})
+    rec, rec_detail = _hit_recovery(p, res)
+    rec_budget = (p.hit_recovery_secs if p.hit_recovery_secs is not None
+                  else float(env("DYNT_FED_HIT_RECOVERY_SECS")))
+    check("residency_hit_recovery",
+          rec is not None and rec <= rec_budget,
+          dict(rec_detail, budget_secs=rec_budget))
+    post = [b for b in res["buckets"]
+            if res["t_detect_s"] is not None
+            and b["t_s"] >= res["t_detect_s"] + p.bucket_secs]
+    post_adm = sum(b["admitted"] for b in post)
+    post_good = sum(b["good"] for b in post)
+    check("slo_goodput_held",
+          post_adm > 0 and post_good / post_adm >= p.goodput_floor,
+          {"admitted": post_adm,
+           "good_frac": round(post_good / post_adm, 4)
+           if post_adm else None,
+           "floor": p.goodput_floor})
+    check("residency_beats_pressure",
+          res["window_ret_turns"] > 0 and base["window_ret_turns"] > 0
+          and res["window_ret_ttft_ms"]
+          <= base["window_ret_ttft_ms"] + 1e-9,
+          {"residency_ttft_ms": res["window_ret_ttft_ms"],
+           "pressure_ttft_ms": base["window_ret_ttft_ms"],
+           "turns": res["window_ret_turns"]})
+    check("resync_exercised",
+          res["resyncs"] >= 1 and res["corrupt_frames"] == 0,
+          {"resyncs": res["resyncs"],
+           "corrupt_frames": res["corrupt_frames"]})
+    pause_span = (p.pause_to_frac - p.pause_from_frac) * p.seconds
+    check("lag_contract_measured",
+          res["lag_peak_s"] >= max(p.max_lag_s,
+                                   pause_span - 2 * p.tick_secs),
+          {"lag_peak_s": round(res["lag_peak_s"], 2),
+           "pause_span_s": pause_span})
+    plan = res["final_plan"]
+    check("planner_rebalanced",
+          set(plan) == {"cell-0"}
+          and sum(plan.values()) == p.replica_budget
+          and set(base["final_plan"])
+          == {f"cell-{i}" for i in range(p.n_cells)}
+          and sum(base["final_plan"].values()) == p.replica_budget,
+          {"final_plan": plan, "baseline_plan": base["final_plan"]})
+    total_qos = p.qos_budget_per_cell * p.n_cells
+    check("qos_redistributed",
+          abs(res["qos_budgets"]["cell-0"] - total_qos) < 1e-6
+          and all(abs(res["qos_budgets"][n]) < 1e-6
+                  for n in ("cell-1", "cell-2")),
+          {"qos_budgets": res["qos_budgets"]})
+    check("breakers_failed_on_loss",
+          res["breakers_open"]["cell-1"] == p.workers_per_cell,
+          {"breakers_open": res["breakers_open"]})
+    dedupe_cap = 2 * int(env("DYNT_FED_DEDUPE_MAX"))
+    check("state_bounded",
+          res["router_sessions"] <= p.router_max_sessions
+          and all(v <= p.tier_max_sessions
+                  for v in res["tier_sessions"].values())
+          and all(v <= dedupe_cap
+                  for v in res["dedupe_entries"].values()),
+          {"router_sessions": res["router_sessions"],
+           "tier_sessions": res["tier_sessions"],
+           "dedupe_entries": res["dedupe_entries"]})
+    check("saturation_shed_honest", res["shed"] > 0,
+          {"shed": res["shed"], "admitted": res["admitted"]})
+    checks.append(conformance.chaos_assertion(res["conformance"]))
+    base_conf = conformance.chaos_assertion(base["conformance"])
+    base_conf["name"] = "protocol_conformance_baseline"
+    checks.append(base_conf)
+    report["assertions"] = checks
+    report["passed"] = all(c["ok"] for c in checks)
+    return report
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser("federation_chaos")
+    parser.add_argument("--seconds", type=float, default=600.0)
+    parser.add_argument("--start-rps", type=float, default=400.0)
+    parser.add_argument("--end-rps", type=float, default=2400.0)
+    parser.add_argument("--min-sessions", type=int, default=1_000_000)
+    parser.add_argument("--seed", type=int, default=20260807)
+    parser.add_argument("--out", default="chaos-federation")
+    args = parser.parse_args(argv)
+    params = FederationChaosParams(
+        seconds=args.seconds, start_rps=args.start_rps,
+        end_rps=args.end_rps, min_sessions=args.min_sessions,
+        seed=args.seed)
+    report = run_federation(params)
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "federation-chaos-report.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=float)
+    for c in report["assertions"]:
+        mark = "ok  " if c["ok"] else "FAIL"
+        print(f"[{mark}] {c['name']}: {c.get('detail')}")
+    print(f"passed={report['passed']} report={path}")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

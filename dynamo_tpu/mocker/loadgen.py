@@ -54,6 +54,12 @@ class TraceRecord:
     # + priority class per record; replay threads them onto the request.
     tenant: Optional[str] = None
     priority: Optional[str] = None
+    # Federation traffic (docs/federation.md): the cell edge the request
+    # arrives at, and the sticky session it belongs to (sessions are
+    # pinned to a home cell; `cell` differs from the session's home for
+    # the roaming fraction).
+    cell: Optional[str] = None
+    session: Optional[str] = None
 
     def to_wire(self) -> dict:
         out = {"ts_ms": self.ts_ms, "isl": self.isl, "osl": self.osl}
@@ -63,6 +69,10 @@ class TraceRecord:
             out["tenant"] = self.tenant
         if self.priority:
             out["priority"] = self.priority
+        if self.cell:
+            out["cell"] = self.cell
+        if self.session:
+            out["session"] = self.session
         return out
 
 
@@ -81,6 +91,8 @@ def load_trace(path: str) -> list[TraceRecord]:
                 hash_ids=d.get("hash_ids"),
                 tenant=d.get("tenant"),
                 priority=d.get("priority"),
+                cell=d.get("cell"),
+                session=d.get("session"),
             ))
     records.sort(key=lambda r: r.ts_ms)
     return records
@@ -284,6 +296,139 @@ def synthesize_tenant_trace(
         out.extend(records)
     out.sort(key=lambda r: r.ts_ms)
     return out
+
+
+@dataclasses.dataclass
+class CellTrafficSpec:
+    """One federation cell's traffic shape (docs/federation.md): a
+    named cell whose local edge receives a linearly ramping Poisson
+    arrival rate. Sessions created here are pinned to this cell as
+    their *home*; a configurable roaming fraction arrives at a
+    different cell's edge (the traveler hitting a foreign region, the
+    case residency-first routing exists for)."""
+
+    name: str
+    start_rps: float = 1.0
+    end_rps: float = 1.0
+
+
+def parse_cells_spec(spec: str) -> list[CellTrafficSpec]:
+    """Parse the --cells CLI spec: a comma list of
+    'name:start_rps[:end_rps]' (end_rps omitted = flat rate). Example:
+
+        --cells cell-a:5:40,cell-b:5:40,cell-c:2
+    """
+    out: list[CellTrafficSpec] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (2, 3):
+            raise ValueError(
+                f"--cells expects name:start_rps[:end_rps], got {part!r}")
+        start = float(bits[1])
+        end = float(bits[2]) if len(bits) == 3 else start
+        if not bits[0] or start < 0 or end < 0:
+            raise ValueError(f"bad --cells values in {part!r}")
+        out.append(CellTrafficSpec(name=bits[0], start_rps=start,
+                                   end_rps=end))
+    if not out:
+        raise ValueError("--cells needs at least one cell spec")
+    return out
+
+
+def cell_arrival_schedule(
+    cells: list[CellTrafficSpec], seconds: float,
+    roam_frac: float = 0.0, seed: int = 0,
+) -> list[tuple[float, CellTrafficSpec, str]]:
+    """Merged open-loop schedule: (arrival_ms, home_cell_spec,
+    edge_cell_name) sorted by time — each cell an independent
+    inhomogeneous Poisson ramp; `roam_frac` of each cell's arrivals
+    land on a DIFFERENT cell's edge (uniform over the others). Shared
+    by `synthesize_cell_trace` and the federation chaos scenario."""
+    merged: list[tuple[float, CellTrafficSpec, str]] = []
+    names = [c.name for c in cells]
+    for i, cell in enumerate(cells):
+        rng = np.random.default_rng(seed + i * 15_485_863)
+        others = [n for n in names if n != cell.name]
+        for t_ms in ramp_arrival_times(cell.start_rps, cell.end_rps,
+                                       seconds, seed=seed + i * 7919):
+            edge = cell.name
+            if others and roam_frac > 0 and rng.random() < roam_frac:
+                edge = others[int(rng.integers(len(others)))]
+            merged.append((t_ms, cell, edge))
+    merged.sort(key=lambda item: item[0])
+    return merged
+
+
+class CellSessionAssigner:
+    """Session-sticky id assignment over a cell arrival schedule: each
+    arrival either continues one of its home cell's recently active
+    sessions (probability `return_frac`, uniform over the last
+    `window`) or opens a new session pinned to that home. Deterministic
+    under `seed` — the chaos scenario's residency-vs-pressure A/B must
+    offer bit-identical traffic to both arms."""
+
+    def __init__(self, return_frac: float = 0.5, window: int = 64,
+                 seed: int = 0) -> None:
+        self.return_frac = return_frac
+        self.window = max(1, window)
+        self._rng = np.random.default_rng(seed)
+        self._recent: dict[str, list[str]] = {}
+        self._counts: dict[str, int] = {}
+        self.sessions = 0
+
+    def assign(self, home: str) -> tuple[str, bool]:
+        """Returns (session_id, is_returning_turn)."""
+        recent = self._recent.setdefault(home, [])
+        if recent and self._rng.random() < self.return_frac:
+            sid = recent[int(self._rng.integers(len(recent)))]
+            return sid, True
+        idx = self._counts.get(home, 0)
+        self._counts[home] = idx + 1
+        self.sessions += 1
+        sid = f"{home}:s{idx}"
+        recent.append(sid)
+        if len(recent) > self.window:
+            recent.pop(0)
+        return sid, False
+
+
+def synthesize_cell_trace(
+    cells: list[CellTrafficSpec],
+    seconds: float,
+    roam_frac: float = 0.0,
+    return_frac: float = 0.5,
+    isl_mean: int = 512,
+    osl_mean: int = 64,
+    prefix_ratio: float = 0.5,
+    num_prefix_groups: int = 8,
+    block_size: int = 16,
+    seed: int = 0,
+) -> list[TraceRecord]:
+    """Multi-cell session-sticky trace (--cells spec): each cell an
+    independent Poisson ramp merged onto one timeline, every record
+    tagged with its arrival edge (`cell`) and sticky `session` (home
+    derivable from the session id prefix). Prefix groups are
+    cell-disjoint, same stride scheme as the tenant generator."""
+    schedule = cell_arrival_schedule(cells, seconds,
+                                     roam_frac=roam_frac, seed=seed)
+    records = synthesize_trace(
+        len(schedule), rate_rps=1.0, isl_mean=isl_mean, osl_mean=osl_mean,
+        prefix_ratio=prefix_ratio, num_prefix_groups=num_prefix_groups,
+        block_size=block_size, seed=seed,
+    )
+    assigner = CellSessionAssigner(return_frac=return_frac, seed=seed)
+    index = {c.name: i for i, c in enumerate(cells)}
+    for record, (t_ms, home, edge) in zip(records, schedule):
+        record.ts_ms = float(t_ms)
+        record.cell = edge
+        record.session, _ = assigner.assign(home.name)
+        if record.hash_ids:
+            stride = (index[home.name] + 1) * 100_000_000
+            record.hash_ids = [h + stride for h in record.hash_ids]
+    return records
 
 
 def summarize_tenant_buckets(samples: list[dict], bucket_secs: float,
@@ -697,8 +842,23 @@ async def main(argv: Optional[list[str]] = None) -> None:
                           "'alice:interactive:3,bob:batch:2:24'); tags "
                           "every record with tenant + priority and "
                           "replaces --rate-rps/--ramp-rps")
+    syn.add_argument("--cells", default=None,
+                     metavar="NAME:START[:END],...",
+                     help="multi-cell session-sticky trace "
+                          "(docs/federation.md): comma list of "
+                          "name:start_rps[:end_rps] per-cell ramps over "
+                          "--duration-secs (e.g. "
+                          "'cell-a:5:40,cell-b:5:40,cell-c:2'); tags "
+                          "every record with its arrival cell + sticky "
+                          "session and replaces --rate-rps/--ramp-rps")
+    syn.add_argument("--roam-frac", type=float, default=0.0,
+                     help="--cells: fraction of each cell's arrivals "
+                          "landing on a DIFFERENT cell's edge")
+    syn.add_argument("--return-frac", type=float, default=0.5,
+                     help="--cells: probability an arrival continues a "
+                          "recent session instead of opening a new one")
     syn.add_argument("--duration-secs", type=float, default=30.0,
-                     help="trace length for --tenants ramps")
+                     help="trace length for --tenants/--cells ramps")
     syn.add_argument("--isl-mean", type=int, default=512)
     syn.add_argument("--osl-mean", type=int, default=64)
     syn.add_argument("--prefix-ratio", type=float, default=0.5)
@@ -747,7 +907,15 @@ async def main(argv: Optional[list[str]] = None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "synthesize":
-        if args.tenants:
+        if args.cells:
+            records = synthesize_cell_trace(
+                parse_cells_spec(args.cells), args.duration_secs,
+                roam_frac=args.roam_frac, return_frac=args.return_frac,
+                isl_mean=args.isl_mean, osl_mean=args.osl_mean,
+                prefix_ratio=args.prefix_ratio,
+                num_prefix_groups=args.prefix_groups, seed=args.seed,
+            )
+        elif args.tenants:
             records = synthesize_tenant_trace(
                 parse_tenants_spec(args.tenants), args.duration_secs,
                 isl_mean=args.isl_mean, osl_mean=args.osl_mean,

@@ -142,22 +142,28 @@ def run_flood(params: Optional[FloodParams] = None) -> dict:
         worker = idx % params.n_workers
         replica = a if idx % 2 == 0 else b
         for turn in range(params.turns_per_session):
+            # Distinct emissions need distinct stamps: real emitters
+            # read time.monotonic() per call, and the apply-path dedupe
+            # window keys route events on (sid, worker, t) — two turns
+            # collapsed onto one injected instant would look like an
+            # at-least-once redelivery and be dropped on the peer.
+            t_turn = now + turn * 1e-3
             hashes = _session_hashes(idx, turn, params.blocks_per_turn)
             lease_id = f"{sid}:{hashes[-1]:016x}"
             granted = replica.tier.ledger.pin(
                 hashes, params.pin_ttl_secs, lease_id=lease_id,
-                session_id=sid, now=now)
+                session_id=sid, now=t_turn)
             if granted is not None:
                 # Emit only grants (register_request semantics): a
                 # locally refused pin must not ask the peer to diverge.
                 replica.tier._emit({
                     "op": "pin", "lease": granted, "h": hashes,
-                    "exp": now + replica.tier._mono_offset
+                    "exp": t_turn + replica.tier._mono_offset
                     + params.pin_ttl_secs, "sid": sid})
             replica.tier.store.touch(sid, worker_id=worker,
-                                     prefix_hashes=hashes, now=now)
+                                     prefix_hashes=hashes, now=t_turn)
             replica.tier._emit({"op": "route", "sid": sid, "w": worker,
-                                "t": now})
+                                "t": t_turn})
             replica.store_chain(worker, hashes, parent=None)
         if idx % params.hot_touch_every == 0:
             # Keep the hot prefixes hot: queries are the admission

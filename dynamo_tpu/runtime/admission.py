@@ -150,7 +150,14 @@ class QueueWaitEstimator:
     Depth comes either from `set_depth` (edges that own their queue, e.g.
     the router admission heap) or from `update_worker` (edges that read
     worker-published LoadMetrics `waiting_requests`; entries expire after
-    `worker_ttl_s` so a dead worker's backlog stops counting)."""
+    `worker_ttl_s` so a dead worker's backlog stops counting).
+
+    Every depth input ages out: worker entries after `worker_ttl_s`, and
+    the `set_depth` value after the same TTL. A pool that vanishes from
+    discovery (cell loss, namespace teardown) therefore decays to an
+    empty queue and the edge falls back to admit, instead of estimating
+    an unbounded wait forever against a ghost — its last depth frozen
+    while its drain EWMA decays to zero."""
 
     def __init__(self, pool: str = "default",
                  halflife_s: Optional[float] = None,
@@ -161,6 +168,7 @@ class QueueWaitEstimator:
         self.drain = DrainRateEwma(halflife_s)
         self.worker_ttl_s = worker_ttl_s
         self._depth = 0
+        self._depth_t: Optional[float] = None  # when set_depth last fired
         self._workers: dict[int, tuple[int, float]] = {}  # id -> (waiting, t)
 
     # -- inputs ------------------------------------------------------------
@@ -169,8 +177,9 @@ class QueueWaitEstimator:
                         now: Optional[float] = None) -> None:
         self.drain.observe(n, now=now)
 
-    def set_depth(self, depth: int) -> None:
+    def set_depth(self, depth: int, now: Optional[float] = None) -> None:
         self._depth = max(0, int(depth))
+        self._depth_t = time.monotonic() if now is None else now
         self._workers.clear()
 
     def update_worker(self, worker_id: int, waiting: int,
@@ -178,12 +187,24 @@ class QueueWaitEstimator:
         now = time.monotonic() if now is None else now
         self._workers[worker_id] = (max(0, int(waiting)), now)
 
+    def forget_worker(self, worker_id: int) -> None:
+        """Positive evidence the worker left (discovery delete): drop
+        its backlog immediately instead of waiting out the TTL."""
+        self._workers.pop(worker_id, None)
+
     # -- estimates ---------------------------------------------------------
 
     def depth(self, now: Optional[float] = None) -> int:
-        if not self._workers:
-            return self._depth
         now = time.monotonic() if now is None else now
+        if not self._workers:
+            if self._depth and self._depth_t is not None \
+                    and now - self._depth_t > self.worker_ttl_s:
+                # The owning edge stopped reporting (pool vanished from
+                # discovery): its stale backlog must not shed arrivals
+                # forever against a queue nobody serves.
+                self._depth = 0
+                self._depth_t = None
+            return self._depth
         cutoff = now - self.worker_ttl_s
         for wid in [w for w, (_, ts) in self._workers.items() if ts < cutoff]:
             del self._workers[wid]

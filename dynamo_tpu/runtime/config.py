@@ -521,6 +521,65 @@ _register("DYNT_DRAIN_HTTP", True, _bool,
           "and drain via SIGTERM / the request-plane control verb / the "
           "faults service instead")
 
+# Federation plane — one logical service over N cells
+# (dynamo_tpu/federation/; cell model, residency routing, the
+# reconciliation lag contract and the evacuation ladder in
+# docs/federation.md)
+_register("DYNT_FED_SPILL_PRESSURE", 0.85, _float,
+          "Cell pressure (capacity-weighted KV usage + queue backlog, "
+          "global_planner.PoolState semantics) past which the "
+          "federation router stops defending residency and considers "
+          "spilling a returning session to a neighbor cell. Below it, "
+          "residency always wins — a cached multi-turn session is "
+          "cheaper at its resident cell than anywhere else")
+_register("DYNT_FED_SHED_SOFT_FRAC", 0.8, _float,
+          "Graded-backpressure knee, as a fraction of "
+          "DYNT_FED_SPILL_PRESSURE: new sessions are refused with a "
+          "probability ramping linearly from 0 at soft (= threshold x "
+          "this) to 1 at the hard threshold. Cell load reports are a "
+          "heartbeat stale, so a hard open/shut admission gate "
+          "oscillates — floods in the stale window, overshoots the "
+          "queue, slams shut; the ramp lets admission settle just "
+          "under the gate with the queue still empty. Set to >= 1.0 "
+          "to disable the ramp and keep only the hard refusal")
+_register("DYNT_FED_COLDSTART_DEFAULT_SECS", 30.0, _float,
+          "Cold-start cost the spill model charges a neighbor cell "
+          "that would have to scale up for the spilled session, used "
+          "until the coldstart lead EWMA (engine/coldstart.py, "
+          "dynamo_coldstart_lead_seconds) has a measured value — the "
+          "honest 'moving you is not free' term that keeps marginal "
+          "pressure from bouncing sessions between cells")
+_register("DYNT_FED_MAX_LAG_SECS", 5.0, _float,
+          "Cross-cell reconciliation lag contract: when a from->to "
+          "session-event stream's measured lag (emit wall-clock to "
+          "apply wall-clock) exceeds this, the reconciler abandons "
+          "event-by-event replay and resyncs the destination from a "
+          "full source snapshot (dynamo_federation_resyncs_total)")
+_register("DYNT_FED_HEARTBEAT_TIMEOUT_SECS", 10.0, _float,
+          "Cell heartbeat expiry: a cell silent this long is declared "
+          "LOST by the federation directory — its breaker board is "
+          "failed, residency pointing at it is cleared (pins expire "
+          "at their own TTL), and its QoS budget is redistributed. "
+          "Must exceed the cells' load-publish interval by a "
+          "comfortable factor or a slow scrape reads as a dead region")
+_register("DYNT_FED_EVAC_DEADLINE_SECS", 30.0, _float,
+          "Budget for a graceful cell evacuation, end-to-end: the "
+          "fleet-granularity drain ladder (KV handoff where meshes "
+          "allow -> cooperative replay -> honest errors) must finish "
+          "inside it; sessions still resident at expiry get in-band "
+          "errors, never silence")
+_register("DYNT_FED_DEDUPE_MAX", 4096, _int,
+          "Per-origin cap on the session event consumer's dedupe "
+          "window (entries also expire with each event's own absolute "
+          "expiry): bounds reconciliation memory under origin churn — "
+          "a federation of transient cells must not grow a dedupe set "
+          "per origin id forever")
+_register("DYNT_FED_HIT_RECOVERY_SECS", 60.0, _float,
+          "Pinned budget for residency-hit-rate recovery after a cell "
+          "loss: the federation chaos gate asserts the returning-"
+          "session hit rate is back above its pre-loss floor within "
+          "this many (scenario-clock) seconds of the loss")
+
 # Fault tolerance — resilience plane (runtime/resilience.py; knob
 # semantics and the degradation ladder in docs/fault-tolerance.md)
 _register("DYNT_DEADLINE_SECS", 600.0, _float,

@@ -341,6 +341,64 @@ PIN_OPS = Counter(
     "TTL), refuse (DYNT_PIN_MAX_BLOCKS cap)",
     ["op"], registry=REGISTRY,
 )
+SESSION_EVENT_DUPLICATES = Counter(
+    "dynamo_session_event_duplicates_total",
+    "Peer session pin/route/touch events dropped by the bounded "
+    "per-origin dedupe window (at-least-once reconciliation delivery "
+    "replaying a frame already applied) — duplicates are expected "
+    "under redelivery, never an error",
+    registry=REGISTRY,
+)
+# Federation plane (dynamo_tpu/federation/, docs/federation.md): one
+# logical service over N cells — residency-first global routing with
+# pressure spill, cross-cell journal reconciliation with a measured lag
+# contract, and the evacuation/cell-loss ladder.
+FEDERATION_SPILL = Counter(
+    "dynamo_federation_spill_total",
+    "Sessions routed away from their resident (or home-preferred) cell: "
+    "pressure (home past DYNT_FED_SPILL_PRESSURE and a neighbor wins "
+    "the cost model), evacuating (home draining onto neighbors), "
+    "lost (home failed — rerouted after residency was cleared)",
+    ["from", "to", "reason"], registry=REGISTRY,
+)
+FEDERATION_LAG_SECONDS = Gauge(
+    "dynamo_federation_lag_seconds",
+    "Measured cross-cell reconciliation lag: age (emit wall-clock to "
+    "apply wall-clock) of the most recently applied session-event "
+    "frame on the from->to stream. Sustained values past "
+    "DYNT_FED_MAX_LAG_SECS trip the resync rung",
+    ["from", "to"], registry=REGISTRY,
+)
+FEDERATION_RESIDENCY = Counter(
+    "dynamo_federation_residency_total",
+    "Residency-first global routing outcomes: hit (returning session "
+    "landed on its resident cell), miss (resident cell refused — "
+    "pressured, evacuating, or lost), none (first turn — no residency "
+    "learned yet)",
+    ["outcome"], registry=REGISTRY,
+)
+FEDERATION_CELL_STATE = Gauge(
+    "dynamo_federation_cell_state",
+    "Cell lifecycle state in the federation directory: 0=serving, "
+    "1=evacuating, 2=evacuated, 3=lost (heartbeat expired)",
+    ["cell"], registry=REGISTRY,
+)
+FEDERATION_RESYNCS = Counter(
+    "dynamo_federation_resyncs_total",
+    "Cross-cell reconciliation resyncs: the from->to stream's measured "
+    "lag exceeded DYNT_FED_MAX_LAG_SECS, so the destination replaced "
+    "its view from a full source snapshot instead of replaying the "
+    "backlog event-by-event",
+    ["from", "to"], registry=REGISTRY,
+)
+FEDERATION_EVAC_SESSIONS = Counter(
+    "dynamo_federation_evacuated_sessions_total",
+    "Sessions moved off a cell by the evacuation ladder, by rung: "
+    "handoff (KV handoff to a mesh-reachable neighbor — resident "
+    "state moves, no re-prefill), replay (cooperative replay on the "
+    "new home), error (deadline expired — honest in-band error)",
+    ["outcome"], registry=REGISTRY,
+)
 # Device-time attribution plane (perf/steptrace.py, "dynaprof"): every
 # scheduler step decomposed into host vs device burn, the per-request
 # device-time TTFT, and the live roofline comparison against the

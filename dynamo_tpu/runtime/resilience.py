@@ -346,6 +346,16 @@ class CircuitBreaker:
             self._opened_at = now
             self._transition(OPEN)
 
+    def force_open(self) -> None:
+        """External death verdict (heartbeat expiry, cell loss): open
+        immediately regardless of the failure threshold — counting
+        per-request failures against an instance known to be gone just
+        burns requests proving it."""
+        self._failures = 0
+        self._probe_inflight = False
+        self._opened_at = time.monotonic()
+        self._transition(OPEN)
+
     def reset(self) -> None:
         """External evidence of health (discovery re-confirmed the
         instance): drop all failure state."""
@@ -392,6 +402,15 @@ class BreakerBoard:
         breaker = self._breakers.get(instance_id)
         if breaker is not None:
             breaker.reset()
+
+    def fail_all(self) -> int:
+        """Board-wide death verdict (the federation lost the cell these
+        instances live in): force every breaker open so in-flight
+        routing fail-fasts instead of timing out against a dead mesh.
+        Returns the number of breakers opened."""
+        for breaker in self._breakers.values():
+            breaker.force_open()
+        return len(self._breakers)
 
     def drop(self, instance_id: int) -> None:
         if self._breakers.pop(instance_id, None) is not None:

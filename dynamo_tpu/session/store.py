@@ -341,6 +341,15 @@ class SessionTier:
 
         self.outbox: "deque[dict]" = deque(maxlen=4096)
         self._publish = publish or self.outbox.append
+        # Per-origin dedupe window for at-least-once event delivery
+        # (journal replay, federation reconciliation resends): applied
+        # event keys with the EVENT's absolute expiry, bounded two ways
+        # — entries die with their event's own wall-clock expiry, and
+        # each origin's window is capped at DYNT_FED_DEDUPE_MAX (oldest
+        # evicted). Without the bound a federation of churning origin
+        # ids grows a window per origin forever.
+        self._applied: dict[str, OrderedDict] = {}
+        self.duplicates_dropped = 0
         # monotonic -> wall offset so event expiries are absolute and
         # replicas with different monotonic epochs still converge
         # (injectable: scenarios driving several tiers on one injected
@@ -415,8 +424,10 @@ class SessionTier:
                     + self._mono_offset})
 
     def sweep(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
         self.ledger.expire(now)
         self.store.sweep(now)
+        self._sweep_applied(now + self._mono_offset)
 
     def drain_events(self) -> list[dict]:
         """Outbox contents for async publication (the owner's
@@ -429,6 +440,38 @@ class SessionTier:
 
     # -- replica reconciliation ----------------------------------------------
 
+    def snapshot_events(self, now: Optional[float] = None) -> list[dict]:
+        """Authoritative state re-expressed as replayable events (live
+        leases as pins, session affinities as routes) — the federation
+        resync rung: a peer whose stream lag blew the contract applies
+        this snapshot instead of chewing through the backlog. Same wire
+        shapes as `_emit`, idempotent to apply; events the peer already
+        holds fall into its dedupe window."""
+        now = time.monotonic() if now is None else now
+        wall = now + self._mono_offset
+        mask = (1 << 64) - 1
+        out: list[dict] = []
+        for lid, lease in list(self.ledger._leases.items()):
+            if lease.expires_at <= now:
+                continue
+            out.append({"op": "pin", "lease": lid,
+                        "h": [h & mask for h in lease.hashes],
+                        "exp": lease.expires_at + self._mono_offset,
+                        "sid": lease.session_id,
+                        "o": self.origin, "m": self.model})
+        for shard in self.store._shards:
+            for sid, entry in shard.items():
+                if self.store.ttl_secs \
+                        and now - entry.last_seen > self.store.ttl_secs:
+                    continue
+                op = ({"op": "route", "sid": sid, "w": entry.worker_id}
+                      if entry.worker_id is not None
+                      else {"op": "touch", "sid": sid})
+                op.update({"t": entry.last_seen + self._mono_offset,
+                           "o": self.origin, "m": self.model})
+                out.append(op)
+        return out
+
     def _emit(self, payload: dict) -> None:
         if not env("DYNT_SESSION_EVENTS"):
             return
@@ -440,11 +483,76 @@ class SessionTier:
             # best-effort; local state is already correct
             log.exception("session event publish failed")
 
+    def _event_key(self, payload: dict, wall: float):
+        """(dedupe key, absolute window expiry) for a peer event, or
+        None when the event carries no identity worth remembering. The
+        window expiry is the EVENT's own absolute expiry — a pin's
+        lease expiry, a route/touch's timestamp plus the pin TTL
+        ceiling — so the dedupe memory can never outlive the state the
+        event could still corrupt on redelivery."""
+        op = payload.get("op")
+        if op == "pin":
+            exp = float(payload.get("exp", 0.0))
+            return ("pin", payload.get("lease"), exp), exp
+        if op == "unpin":
+            return None  # unpin of a gone lease is already a no-op
+        t = float(payload.get("t", wall))
+        ttl = float(env("DYNT_PIN_TTL_SECS"))
+        if op == "route":
+            return ("route", payload.get("sid"), payload.get("w"), t), t + ttl
+        if op == "touch":
+            return ("touch", payload.get("sid"), t), t + ttl
+        return None
+
+    def _seen_before(self, origin: str, payload: dict,
+                     wall: float) -> bool:
+        """Bounded at-least-once dedupe: True when this exact event was
+        already applied from `origin` and its window entry is live."""
+        keyed = self._event_key(payload, wall)
+        if keyed is None:
+            return False
+        key, exp = keyed
+        if exp <= wall:
+            return False  # already past expiry; the op guards itself
+        window = self._applied.get(origin)
+        if window is None:
+            window = self._applied[origin] = OrderedDict()
+        prev = window.get(key)
+        if prev is not None and prev > wall:
+            self.duplicates_dropped += 1
+            rt_metrics.SESSION_EVENT_DUPLICATES.inc()
+            return True
+        window[key] = exp
+        window.move_to_end(key)
+        cap = max(1, int(env("DYNT_FED_DEDUPE_MAX")))
+        while len(window) > cap:
+            window.popitem(last=False)
+        return False
+
+    def _sweep_applied(self, wall: float) -> None:
+        """Expire dedupe entries whose events' absolute expiries have
+        passed; drop origins whose windows emptied (origin churn must
+        not leak empty maps)."""
+        for origin in list(self._applied):
+            window = self._applied[origin]
+            dead = [k for k, exp in window.items() if exp <= wall]
+            for k in dead:
+                del window[k]
+            if not window:
+                del self._applied[origin]
+
+    def dedupe_entries(self) -> int:
+        """Total live dedupe-window entries across origins (tests /
+        scenario memory assertions)."""
+        return sum(len(w) for w in self._applied.values())
+
     def apply_event(self, payload: dict,
                     now: Optional[float] = None) -> bool:
         """Apply a peer replica's pin/route/touch event. Idempotent:
         pin events carry absolute (wall-clock) expiry, so replaying or
-        reordering them converges on the same pin set."""
+        reordering them converges on the same pin set; exact redelivery
+        (at-least-once journal streams) is dropped by a bounded
+        per-origin dedupe window."""
         if not isinstance(payload, dict):
             return False
         if payload.get("o") == self.origin:
@@ -452,6 +560,10 @@ class SessionTier:
         if payload.get("m") not in (None, self.model):
             return False
         now = time.monotonic() if now is None else now
+        origin = payload.get("o")
+        if origin and self._seen_before(origin, payload,
+                                        now + self._mono_offset):
+            return False
         op = payload.get("op")
         if op == "pin":
             ttl = float(payload.get("exp", 0.0)) \

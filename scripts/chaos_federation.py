@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Federation chaos gate: 3 cells, an open-loop ramp to ~1M sessions,
+one cell killed mid-ramp and one evacuated gracefully, asserting zero
+client errors on the evacuation path, errors pinned to the loss
+window, bounded RSS, residency-hit-rate recovery inside its budget,
+SLO goodput held after failover, residency routing beating the
+pressure-only baseline on cached-turn TTFT, and zero ProtocolMonitor
+violations (dynamo_tpu/mocker/federation_chaos.py;
+docs/federation.md). Exit code gates the chaos-federation CI job; the
+JSON report uploads as an artifact.
+
+    python scripts/chaos_federation.py --out chaos-federation
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+    from dynamo_tpu.mocker.federation_chaos import main
+
+    sys.exit(main())

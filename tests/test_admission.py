@@ -131,6 +131,36 @@ class TestQueueWaitEstimator:
         est.update_worker(1, 1000, now=20.0)
         assert est.check(None, now=20.0).admit
 
+    def test_vanished_pool_set_depth_expires(self):
+        # An edge that owns its queue stops reporting (pool vanished
+        # from discovery): its frozen backlog must stop estimating an
+        # unbounded wait against a ghost.
+        est = self._warmed(rate=5.0, until=100.0)
+        est.set_depth(50, now=100.0)
+        assert est.depth(now=110.0) == 50
+        assert est.estimate_wait_ms(now=110.0) > 0
+        # worker_ttl_s (30s) with no fresh set_depth: decays to empty.
+        assert est.depth(now=131.0) == 0
+        assert est.estimate_wait_ms(now=131.0) == 0.0
+        # ...and the estimator is reusable when the pool comes back.
+        est.set_depth(3, now=200.0)
+        assert est.depth(now=201.0) == 3
+
+    def test_fresh_set_depth_keeps_counting(self):
+        est = self._warmed(rate=5.0, until=100.0)
+        est.set_depth(50, now=100.0)
+        est.set_depth(40, now=125.0)  # still reporting
+        assert est.depth(now=140.0) == 40
+
+    def test_forget_worker_drops_backlog_immediately(self):
+        est = self._warmed(rate=5.0, until=100.0)
+        est.update_worker(1, 30, now=100.0)
+        est.update_worker(2, 10, now=100.0)
+        assert est.depth(now=101.0) == 40
+        # Positive discovery delete: no TTL wait.
+        est.forget_worker(1)
+        assert est.depth(now=101.0) == 10
+
 
 class TestCheckAdmission:
     def _stalled(self) -> QueueWaitEstimator:

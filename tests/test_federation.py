@@ -1,0 +1,413 @@
+"""Federation plane: cell directory, residency-first routing, journal
+reconciliation with bounded lag, evacuation/cell-loss handling, and a
+small slice of the chaos scenario (docs/federation.md)."""
+
+import math
+
+import pytest
+
+from dynamo_tpu.federation import (
+    EVACUATED,
+    EVACUATING,
+    LOST,
+    SERVING,
+    Cell,
+    CellDirectory,
+    FederationControl,
+    FederationReconciler,
+    FederationRouter,
+)
+from dynamo_tpu.global_planner import GlobalPlanner, PoolState
+from dynamo_tpu.kv_router.protocols import LoadMetrics
+from dynamo_tpu.runtime.admission import AdmissionRefused
+from dynamo_tpu.runtime.resilience import OPEN, BreakerBoard
+from dynamo_tpu.session.store import PinLedger, SessionStore, SessionTier
+
+
+def _cell(directory, name, usage=0.1, waiting=0, blocks=1024, now=0.0,
+          **kwargs):
+    cell = directory.add(Cell(name, now=now, **kwargs))
+    cell.record(0, usage, waiting, blocks, now=now)
+    return cell
+
+
+def _tier(name):
+    return SessionTier(
+        model="fed-test", block_size=16,
+        store=SessionStore(max_sessions=1024, ttl_secs=3600,
+                           model=f"fedtest-{name}"),
+        ledger=PinLedger(max_blocks=4096, model=f"fedtest-{name}"),
+        origin=f"origin-{name}", mono_offset=0.0)
+
+
+# -- cells ------------------------------------------------------------------
+
+
+class TestCellDirectory:
+    def test_pressure_matches_poolstate_semantics(self):
+        d = CellDirectory(heartbeat_timeout_s=10.0)
+        c = _cell(d, "a", usage=0.5, waiting=0, blocks=100)
+        c.record(1, 0.9, 2, 300, now=0.0)
+        # capacity-weighted usage: (0.5*100 + 0.9*300)/400 = 0.8,
+        # plus waiting/live = 2/2 = 1.0
+        assert c.pressure(0.0) == pytest.approx(1.8)
+
+    def test_zero_blocks_worker_gets_mean_capacity_weight(self):
+        d = CellDirectory(heartbeat_timeout_s=10.0)
+        c = _cell(d, "a", usage=0.2, waiting=0, blocks=400)
+        # A busy worker that publishes total_blocks=0 must still
+        # contribute at the mean reported capacity, not vanish.
+        c.record(1, 1.0, 0, 0, now=0.0)
+        assert c.pressure(0.0) == pytest.approx(0.6)
+        # ...and one cell reporting ONLY zero-capacity workers still
+        # produces a finite pressure (unit default weight).
+        c2 = _cell(d, "b", usage=0.8, waiting=0, blocks=0)
+        assert c2.pressure(0.0) == pytest.approx(0.8)
+
+    def test_stale_workers_age_out_of_capacity(self):
+        d = CellDirectory(heartbeat_timeout_s=10.0)
+        c = _cell(d, "a", blocks=512, now=0.0)
+        assert c.capacity(1.0) == 512
+        assert c.capacity(c.metrics_ttl + 1.0) == 0
+
+    def test_sweep_flips_lost_and_fires_callback_once(self):
+        d = CellDirectory(heartbeat_timeout_s=5.0)
+        c = _cell(d, "a", now=0.0)
+        seen = []
+        d.on_cell_lost(lambda cell, now: seen.append((cell.name, now)))
+        assert d.sweep(4.0) == []
+        assert d.sweep(6.0) == [c]
+        assert c.state == LOST
+        assert d.sweep(7.0) == []  # terminal: fires exactly once
+        assert seen == [("a", 6.0)]
+
+
+# -- router -----------------------------------------------------------------
+
+
+class TestFederationRouter:
+    def _world(self, **cells):
+        d = CellDirectory(heartbeat_timeout_s=60.0)
+        for name, (usage, waiting) in cells.items():
+            _cell(d, name, usage=usage, waiting=waiting)
+        return d, FederationRouter(d, max_sessions=1024,
+                                   spill_pressure=0.85)
+
+    def test_resident_routing_learned_from_events(self):
+        d, r = self._world(a=(0.1, 0), b=(0.1, 0))
+        r.register_origin("origin-a", "a")
+        assert r.learn({"op": "touch", "sid": "s1", "o": "origin-a"},
+                       now=0.0)
+        dec = r.route("s1", home="b", now=1.0)
+        assert (dec.outcome, dec.cell) == ("resident", "a")
+
+    def test_new_session_prefers_home_edge(self):
+        d, r = self._world(a=(0.1, 0), b=(0.05, 0))
+        dec = r.route("fresh", home="a", now=0.0)
+        assert (dec.outcome, dec.cell) == ("new", "a")
+        # ...and now it is resident there.
+        assert r.route("fresh", home="b", now=1.0).cell == "a"
+
+    def test_zero_capacity_cell_never_routed(self):
+        d = CellDirectory(heartbeat_timeout_s=60.0)
+        _cell(d, "a", usage=0.3, waiting=0)
+        empty = d.add(Cell("b", now=0.0))  # no workers reporting
+        r = FederationRouter(d, max_sessions=64, spill_pressure=0.85)
+        for i in range(8):
+            assert r.route(f"s{i}", home="b", now=0.0).cell == "a"
+        assert empty.capacity(0.0) == 0
+
+    def test_single_cell_degenerate_federation(self):
+        d, r = self._world(a=(0.2, 0))
+        dec = r.route("s1", home="a", now=0.0)
+        assert (dec.outcome, dec.cell) == ("new", "a")
+        assert r.route("s1", now=1.0).outcome == "resident"
+        # Pressured single cell: resident stays (queueing at home beats
+        # nothing), new sessions are refused.
+        d.cells["a"].record(0, 0.99, 5, 1024, now=2.0)
+        assert r.route("s1", now=2.0).outcome == "resident"
+        assert r.route("other", now=2.0).outcome == "refused"
+
+    def test_all_cells_pressured_refuses_with_retry_after(self):
+        d, r = self._world(a=(0.95, 3), b=(0.97, 4))
+        dec = r.route("fresh", home="a", now=0.0)
+        assert dec.outcome == "refused"
+        assert dec.reason == "all_cells_pressured"
+        assert dec.retry_after_s > 0
+        exc = r.refusal(dec)
+        assert isinstance(exc, AdmissionRefused)
+        assert exc.retry_after_s == dec.retry_after_s
+
+    def test_graded_backpressure_ramps_before_hard_gate(self):
+        # Between soft (0.85*0.8=0.68) and hard (0.85) the refusal
+        # probability ramps: some new sessions shed, some admit, and
+        # the per-session draw is deterministic.
+        d, r = self._world(a=(0.80, 0))
+        decisions = {f"s{i}": r.route(f"s{i}", home="a", now=0.0)
+                     for i in range(64)}
+        outcomes = {d.outcome for d in decisions.values()}
+        assert outcomes == {"new", "refused"}
+        # A shed session stays shed at this pressure: deterministic
+        # draw, no flapping across retries.
+        shed_sid = next(s for s, d in decisions.items()
+                        if d.outcome == "refused")
+        for _ in range(3):
+            assert r.route(shed_sid, now=0.0).outcome == "refused"
+        # Below the soft knee nothing is shed...
+        d2, r2 = self._world(a=(0.5, 0))
+        assert all(r2.route(f"s{i}", now=0.0).outcome == "new"
+                   for i in range(64))
+        # ...and returning residents are never graded-shed.
+        r.observe_routed("res1", "a", now=0.0)
+        assert r.route("res1", now=1.0).outcome == "resident"
+
+    def test_graded_backpressure_disabled_by_knob(self, monkeypatch):
+        monkeypatch.setenv("DYNT_FED_SHED_SOFT_FRAC", "1.0")
+        d, r = self._world(a=(0.84, 0))
+        assert all(r.route(f"s{i}", now=0.0).outcome == "new"
+                   for i in range(64))
+
+    def test_no_serving_cells_refused(self):
+        d = CellDirectory(heartbeat_timeout_s=60.0)
+        r = FederationRouter(d, max_sessions=64)
+        assert r.route("s", now=0.0).reason == "no_serving_cells"
+
+    def test_pressured_home_spills_only_when_cheaper(self, monkeypatch):
+        monkeypatch.setenv("DYNT_FED_COLDSTART_DEFAULT_SECS", "30")
+        d, r = self._world(a=(0.95, 50), b=(0.1, 0))
+        r.observe_routed("s1", "a", now=0.0)
+        cell_a = d.cells["a"]
+        # Home drain stalled behind a deep queue: est wait is huge, the
+        # idle neighbor costs ~coldstart-scaled pennies -> spill.
+        for t in range(5):
+            cell_a.observe_drained(0.1, now=float(t))
+        dec = r.route("s1", now=10.0)
+        assert (dec.outcome, dec.cell) == ("spill", "b")
+        assert dec.retry_after_s > 0
+        assert dec.resident == "a"
+
+    def test_pressured_home_keeps_session_when_spill_costlier(
+            self, monkeypatch):
+        # Cold-start lead dwarfs the home queue: stay resident.
+        monkeypatch.setenv("DYNT_FED_COLDSTART_DEFAULT_SECS", "1e6")
+        d, r = self._world(a=(0.95, 1), b=(0.94, 0))
+        r.observe_routed("s1", "a", now=0.0)
+        d.cells["a"].observe_drained(50, now=0.5)
+        dec = r.route("s1", now=1.0)
+        assert (dec.outcome, dec.reason) == ("resident", "pressured_home")
+
+    def test_rehomed_when_resident_cell_gone(self):
+        d, r = self._world(a=(0.1, 0), b=(0.1, 0))
+        r.observe_routed("s1", "a", now=0.0)
+        d.set_state("a", EVACUATING)
+        dec = r.route("s1", now=1.0)
+        assert (dec.outcome, dec.cell) == ("rehomed", "b")
+        assert dec.reason == EVACUATING
+        # The re-home sticks.
+        assert r.route("s1", now=2.0).outcome == "resident"
+
+    def test_clear_cell_drops_residency_not_sessions(self):
+        d, r = self._world(a=(0.1, 0), b=(0.1, 0))
+        for i in range(4):
+            r.observe_routed(f"s{i}", "a", now=0.0)
+        assert sorted(r.sessions_on("a")) == ["s0", "s1", "s2", "s3"]
+        assert r.clear_cell("a") == 4
+        assert r.sessions_on("a") == []
+        assert len(r.store) == 4  # entries stay; affinity is gone
+
+
+# -- reconciler -------------------------------------------------------------
+
+
+class TestFederationReconciler:
+    def _pair(self, max_lag_s=5.0):
+        d = CellDirectory(heartbeat_timeout_s=60.0)
+        _cell(d, "a")
+        _cell(d, "b")
+        r = FederationRouter(d, max_sessions=1024)
+        recon = FederationReconciler(r, max_lag_s=max_lag_s)
+        ta, tb = _tier("a"), _tier("b")
+        recon.add_cell("a", ta)
+        recon.add_cell("b", tb)
+        return r, recon, ta, tb
+
+    def test_events_flow_and_router_learns(self):
+        r, recon, ta, tb = self._pair()
+        ta.ledger.pin([1, 2], 60.0, lease_id="L1", session_id="s1",
+                      now=0.0)
+        ta._emit({"op": "pin", "lease": "L1", "h": [1, 2], "exp": 60.0,
+                  "sid": "s1"})
+        out = recon.pump(now=1.0, wall=1.0)
+        assert out["delivered"] == 1
+        assert tb.ledger.pinned(1) and tb.ledger.pinned(2)
+        # Residency learned from the stream's origin id.
+        assert r.resident_cell("s1", now=1.0) == "a"
+
+    def test_duplicate_delivery_hits_dedupe_window(self):
+        r, recon, ta, tb = self._pair()
+        ev = {"op": "pin", "lease": "L1", "h": [7], "exp": 120.0,
+              "sid": "s1"}
+        ta._emit(dict(ev))
+        recon.pump(now=1.0, wall=1.0)
+        before = tb.duplicates_dropped
+        # At-least-once redelivery: the same frame resent.
+        ta._emit(dict(ev))
+        recon.pump(now=2.0, wall=2.0)
+        assert tb.duplicates_dropped == before + 1
+
+    def test_paused_stream_lag_grows_then_resync(self):
+        r, recon, ta, tb = self._pair(max_lag_s=2.0)
+        recon.pause("a", "b")
+        ta._emit({"op": "touch", "sid": "s1", "t": 0.0})
+        recon.pump(now=0.0, wall=0.0)
+        for t in (1.0, 2.0, 3.0, 4.0):
+            recon.pump(now=t, wall=t)
+        # The partitioned link's lag is measured from the OLDEST
+        # undelivered frame, growing while nothing moves.
+        assert recon.lag[("a", "b")] == pytest.approx(4.0)
+        recon.unpause("a", "b")
+        recon.pump(now=5.0, wall=5.0)
+        assert recon.resyncs == 1
+        assert recon.lag_peak >= 4.0
+        assert recon.lag[("a", "b")] == 0.0
+        # Resync applied the source snapshot: the touch arrived.
+        assert tb.store.get("s1", now=5.0) is not None
+
+    def test_resync_applies_authoritative_snapshot(self):
+        r, recon, ta, tb = self._pair(max_lag_s=1.0)
+        ta.ledger.pin([11], 600.0, lease_id="L9", session_id="s9",
+                      now=0.0)
+        ta.store.touch("s9", worker_id=3, now=0.0)
+        recon.pause("a", "b")
+        ta._emit({"op": "touch", "sid": "s9", "t": 0.0})
+        recon.pump(now=0.0, wall=0.0)
+        recon.unpause("a", "b")
+        recon.pump(now=50.0, wall=50.0)
+        assert recon.resyncs >= 1
+        assert tb.ledger.pinned(11)
+        assert tb.store.get("s9", now=50.0).worker_id == 3
+
+    def test_drop_cell_removes_streams(self):
+        r, recon, ta, tb = self._pair()
+        assert ("a", "b") in recon.streams
+        recon.drop_cell("a")
+        assert not any("a" in k for k in recon.streams)
+        # Survivor keeps pumping without error.
+        tb._emit({"op": "touch", "sid": "x", "t": 0.0})
+        recon.pump(now=1.0, wall=1.0)
+
+
+# -- evacuation + loss ------------------------------------------------------
+
+
+class TestFederationControl:
+    def _world(self, mesh=(True, True, True)):
+        d = CellDirectory(heartbeat_timeout_s=5.0)
+        for i, m in enumerate(mesh):
+            _cell(d, f"c{i}", usage=0.1, mesh_handoff=m,
+                  qos_budget=100.0)
+        r = FederationRouter(d, max_sessions=1024)
+        pools = [PoolState(namespace=f"c{i}", connector=None)
+                 for i in range(len(mesh))]
+        for p in pools:
+            p.record(LoadMetrics(worker_id=0, kv_usage=0.5,
+                                 total_blocks=64))
+        planner = GlobalPlanner(None, pools, 6)
+        boards = {}
+        for i in range(len(mesh)):
+            b = BreakerBoard(endpoint=f"fedtest/c{i}",
+                             failure_threshold=3)
+            b.get(0)
+            b.get(1)
+            boards[f"c{i}"] = b
+        recon = FederationReconciler(r, max_lag_s=5.0)
+        for i in range(len(mesh)):
+            recon.add_cell(f"c{i}", _tier(f"c{i}"))
+        control = FederationControl(d, r, reconciler=recon,
+                                    planner=planner, boards=boards)
+        return d, r, planner, boards, recon, control
+
+    def test_evacuate_handoff_rung(self):
+        d, r, planner, boards, recon, control = self._world()
+        for i in range(6):
+            r.observe_routed(f"s{i}", "c1", now=0.0)
+        rep = control.evacuate("c1", now=1.0, deadline_s=30.0)
+        assert rep["sessions"] == 6
+        assert rep["handoff"] == 6 and rep["error"] == 0
+        assert d.cells["c1"].state == EVACUATED
+        assert r.sessions_on("c1") == []
+        assert "c1" not in planner.pools
+        assert not any("c1" in k for k in recon.streams)
+        # Every session re-homed onto a serving neighbor.
+        for i in range(6):
+            assert r.resident_cell(f"s{i}", now=2.0) in ("c0", "c2")
+
+    def test_evacuate_replay_rung_without_mesh(self):
+        d, r, planner, boards, recon, control = self._world(
+            mesh=(True, False, True))
+        r.observe_routed("s0", "c1", now=0.0)
+        rep = control.evacuate("c1", now=1.0)
+        assert rep["replay"] == 1 and rep["handoff"] == 0
+
+    def test_evacuate_with_no_targets_errors_honestly(self):
+        d = CellDirectory(heartbeat_timeout_s=5.0)
+        _cell(d, "only", qos_budget=100.0)
+        r = FederationRouter(d, max_sessions=64)
+        r.observe_routed("s0", "only", now=0.0)
+        control = FederationControl(d, r)
+        rep = control.evacuate("only", now=1.0, deadline_s=1.0)
+        assert rep["error"] == 1
+        assert d.cells["only"].state == EVACUATED
+
+    def test_cell_loss_fails_breakers_and_rehomes(self):
+        d, r, planner, boards, recon, control = self._world()
+        for i in range(4):
+            r.observe_routed(f"s{i}", "c2", now=0.0)
+        # c2 stops heartbeating; the sweep delivers the verdict.
+        d.cells["c0"].heartbeat(now=20.0)
+        d.cells["c1"].heartbeat(now=20.0)
+        lost = d.sweep(20.0)
+        assert [c.name for c in lost] == ["c2"]
+        assert all(b.state == OPEN
+                   for b in boards["c2"]._breakers.values())
+        assert r.sessions_on("c2") == []
+        assert "c2" not in planner.pools
+        assert sum(planner.plan().values()) == 6
+        # Survivors split the dead cell's QoS budget.
+        assert d.cells["c2"].qos_budget == 0.0
+        assert (d.cells["c0"].qos_budget
+                + d.cells["c1"].qos_budget) == pytest.approx(300.0)
+
+    def test_breaker_board_fail_all(self):
+        b = BreakerBoard(endpoint="fedtest/board", failure_threshold=9)
+        b.get(1)
+        b.get(2)
+        assert b.fail_all() == 2
+        assert all(br.state == OPEN for br in b._breakers.values())
+
+
+# -- chaos slice ------------------------------------------------------------
+
+
+class TestFederationChaosSlice:
+    def test_small_scenario_passes_all_assertions(self):
+        from dynamo_tpu.mocker.federation_chaos import (
+            FederationChaosParams,
+            run_federation,
+        )
+
+        params = FederationChaosParams(
+            seconds=60.0, start_rps=30.0, end_rps=80.0,
+            warmup_secs=5.0, workers_per_cell=2, slots_per_worker=142,
+            min_sessions=500, router_max_sessions=20_000,
+            tier_max_sessions=10_000, tier_max_pin_blocks=5_000,
+            last_served_cap=20_000, qos_budget_per_cell=100.0,
+            replica_budget=6, hit_recovery_secs=20.0,
+            rss_bound_mib=4096)
+        report = run_federation(params)
+        failed = [c for c in report["assertions"] if not c["ok"]]
+        assert report["passed"], failed
+        res = report["arms"]["residency"]
+        assert res["evacuation"]["handoff"] > 0
+        assert res["resyncs"] >= 1
+        assert res["errors_outside_loss_window"] == 0

@@ -203,6 +203,30 @@ class TestGlobalPlanner:
                                 total_replica_budget=6)
         assert planner.plan() == {"a": 3, "b": 3}
 
+    def test_remove_pool_reapportions_same_budget(self):
+        """Cell loss/evacuation (federation/evacuation.py): the dead
+        pool leaves planning and the NEXT plan spreads the unchanged
+        budget over the survivors."""
+        def mk(ns, usage):
+            pool = PoolState(namespace=ns,
+                             connector=CallbackConnector(lambda c, n: None))
+            pool.record(LoadMetrics(worker_id=1, kv_usage=usage,
+                                    total_blocks=64))
+            return pool
+
+        planner = GlobalPlanner(runtime=None, pools=[
+            mk("a", 0.5), mk("b", 0.5), mk("c", 0.5),
+        ], total_replica_budget=9)
+        assert planner.plan() == {"a": 3, "b": 3, "c": 3}
+        gone = planner.remove_pool("b")
+        assert gone is not None and gone.namespace == "b"
+        targets = planner.plan()
+        assert set(targets) == {"a", "c"}
+        assert sum(targets.values()) == 9
+        # Idempotent: removing an unknown pool is a no-op.
+        assert planner.remove_pool("b") is None
+        assert planner.remove_pool("ghost") is None
+
     def test_scale_endpoint_and_load_ingest(self, run):
         async def body():
             cluster = uuid.uuid4().hex
@@ -321,6 +345,18 @@ class TestCapacityWeightedPressure:
         pool.record(LoadMetrics(worker_id=2, kv_usage=0.2))
         assert pool.pressure() == pytest.approx(0.5)
 
+    def test_explicit_zero_blocks_contributes_at_mean_capacity(self):
+        """A federation cell whose worker publishes total_blocks=0 must
+        still register pressure — weighted at the mean reported
+        capacity, exactly like an unreporting worker."""
+        pool = PoolState(namespace="a",
+                         connector=CallbackConnector(lambda c, n: None))
+        pool.record(LoadMetrics(worker_id=1, kv_usage=0.2,
+                                total_blocks=400))
+        pool.record(LoadMetrics(worker_id=2, kv_usage=1.0,
+                                total_blocks=0))
+        assert pool.pressure() == pytest.approx(0.6)
+
     def test_mixed_capacity_fleet_keeps_nonreporters(self):
         """Workers that don't report total_blocks (rolling upgrade) must
         still contribute pressure — at the mean reported capacity, not
@@ -333,3 +369,69 @@ class TestCapacityWeightedPressure:
         # non-reporter weighted at the mean reported capacity (2048):
         # (0*2048 + 0.9*2048) / 4096 = 0.45, not 0.0
         assert pool.pressure() == pytest.approx(0.45)
+
+
+class TestFederatedPoolSelection:
+    """GlobalRouter + FederationRouter: cells ARE pool namespaces."""
+
+    class _FakePool:
+        def __init__(self, namespace, serves=True):
+            self.namespace = namespace
+            self._serves = serves
+
+        def entry(self, model):
+            return object() if self._serves else None
+
+    def _router(self, cells, federation):
+        # Ctor only touches the runtime per pool namespace; with none
+        # listed it is constructible standalone.
+        router = GlobalRouter(None, [], "mock-model",
+                              federation=federation)
+        router.pools = [self._FakePool(c) for c in cells]
+        return router
+
+    def _federation(self, pressures):
+        import time
+
+        from dynamo_tpu.federation import Cell, CellDirectory, FederationRouter
+
+        # select_pool routes at time.monotonic(): the cells' load
+        # reports must be fresh on that clock.
+        now = time.monotonic()
+        directory = CellDirectory(heartbeat_timeout_s=3600.0)
+        for name, usage in pressures.items():
+            cell = directory.add(Cell(name, now=now))
+            cell.record(0, usage, 0, 1024, now=now)
+        return FederationRouter(directory, max_sessions=256,
+                                spill_pressure=0.85)
+
+    def test_residency_first_pool_selection(self):
+        fed = self._federation({"east": 0.1, "west": 0.1})
+        router = self._router(["east", "west"], fed)
+        fed.observe_routed("sess-1", "west")
+        pool = router.select_pool("mock-model", session_id="sess-1")
+        assert pool.namespace == "west"
+        # A fresh session lands somewhere serving; residency sticks.
+        p2 = router.select_pool("mock-model", session_id="sess-2")
+        assert router.select_pool(
+            "mock-model", session_id="sess-2").namespace == p2.namespace
+
+    def test_saturated_federation_raises_admission_refused(self):
+        from dynamo_tpu.runtime.admission import AdmissionRefused
+
+        fed = self._federation({"east": 0.95, "west": 0.99})
+        router = self._router(["east", "west"], fed)
+        with pytest.raises(AdmissionRefused) as exc:
+            router.select_pool("mock-model", session_id="sess-new")
+        assert exc.value.retry_after_s > 0
+        assert exc.value.pool == "federation"
+
+    def test_federation_pick_not_serving_falls_through(self):
+        # Mixed fleet: the federation picks a cell whose pool doesn't
+        # serve this model -> plain policy over the serving pools.
+        fed = self._federation({"east": 0.1})
+        router = GlobalRouter(None, [], "mock-model", federation=fed)
+        router.pools = [self._FakePool("east", serves=False),
+                        self._FakePool("other")]
+        pool = router.select_pool("mock-model", session_id="s")
+        assert pool.namespace == "other"

@@ -239,3 +239,84 @@ class TestTenantTraces:
             assert set(buckets) == {"b", "i"}
 
         run(body(), timeout=60)
+
+
+class TestCellTraces:
+    """--cells multi-cell traffic: per-cell Poisson ramps merged onto
+    one timeline, session-sticky ids pinned to a home cell, a roaming
+    fraction arriving at a foreign edge (docs/federation.md)."""
+
+    def _cells(self):
+        from dynamo_tpu.mocker.loadgen import CellTrafficSpec
+
+        return [CellTrafficSpec("east", 20.0, 20.0),
+                CellTrafficSpec("west", 20.0, 20.0)]
+
+    def test_parse_cells_spec(self):
+        from dynamo_tpu.mocker.loadgen import parse_cells_spec
+
+        cells = parse_cells_spec("cell-a:5:40,cell-b:5:40,cell-c:2")
+        assert [c.name for c in cells] == ["cell-a", "cell-b", "cell-c"]
+        assert (cells[0].start_rps, cells[0].end_rps) == (5.0, 40.0)
+        # end omitted = flat rate
+        assert (cells[2].start_rps, cells[2].end_rps) == (2.0, 2.0)
+        for bad in ("", "a", "a:1:2:3", ":5", "a:-1", "a:1:-2"):
+            with pytest.raises(ValueError):
+                parse_cells_spec(bad)
+
+    def test_schedule_roaming_fraction_and_determinism(self):
+        from dynamo_tpu.mocker.loadgen import cell_arrival_schedule
+
+        cells = self._cells()
+        sched = cell_arrival_schedule(cells, 30.0, roam_frac=0.25,
+                                      seed=7)
+        assert sched == cell_arrival_schedule(cells, 30.0,
+                                              roam_frac=0.25, seed=7)
+        assert [t for t, _, _ in sched] == sorted(
+            t for t, _, _ in sched)
+        roamed = sum(1 for _, home, edge in sched
+                     if edge != home.name)
+        assert 0.15 < roamed / len(sched) < 0.35
+        # No roaming knob -> every arrival lands at its home edge.
+        assert all(edge == home.name for _, home, edge in
+                   cell_arrival_schedule(cells, 10.0, seed=7))
+
+    def test_session_assigner_sticky_and_deterministic(self):
+        from dynamo_tpu.mocker.loadgen import CellSessionAssigner
+
+        def run(seed):
+            a = CellSessionAssigner(return_frac=0.5, window=8,
+                                    seed=seed)
+            return [a.assign("east" if i % 3 else "west")
+                    for i in range(500)], a.sessions
+
+        first, n1 = run(11)
+        again, n2 = run(11)
+        assert first == again and n1 == n2
+        returning = [sid for sid, ret in first if ret]
+        fresh = [sid for sid, ret in first if not ret]
+        assert returning and fresh
+        # A returning turn continues a session its home already opened.
+        assert set(returning) <= set(fresh)
+        # Sessions are pinned to the home that opened them.
+        assert all(sid.startswith(("east:", "west:"))
+                   for sid, _ in first)
+        assert n1 == len(fresh)
+
+    def test_cell_trace_round_trip(self, tmp_path):
+        from dynamo_tpu.mocker.loadgen import synthesize_cell_trace
+
+        records = synthesize_cell_trace(self._cells(), 10.0,
+                                        roam_frac=0.2, return_frac=0.5,
+                                        isl_mean=64, osl_mean=4, seed=3)
+        assert records
+        assert all(r.cell in ("east", "west") and r.session
+                   for r in records)
+        # Prefix groups are cell-disjoint (home-strided hash ids).
+        homes = {r.session.split(":", 1)[0] for r in records}
+        assert homes == {"east", "west"}
+        path = str(tmp_path / "cells.jsonl")
+        save_trace(path, records)
+        loaded = load_trace(path)
+        assert [(r.ts_ms, r.cell, r.session) for r in loaded] \
+            == [(r.ts_ms, r.cell, r.session) for r in records]

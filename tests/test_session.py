@@ -803,3 +803,70 @@ class TestHttpSessionE2E:
                 await _teardown(frontend, frt, workers)
 
         run(body())
+
+
+class TestEventDedupeMemoryBound:
+    """At-least-once delivery dedupe must stay bounded on a LONG-LIVED
+    replica pair: entries die with each event's own absolute expiry and
+    each origin's window is capped at DYNT_FED_DEDUPE_MAX — a
+    federation streaming events for weeks must not grow the window
+    monotonically (docs/federation.md)."""
+
+    def test_long_lived_pair_window_stays_bounded(self, monkeypatch):
+        monkeypatch.setenv("DYNT_PIN_TTL_SECS", "5")
+        a, b = _tier(origin="a"), _tier(origin="b")
+        peak = 0
+        for r in range(200):
+            t = float(r)
+            a.observe_routed(f"s{r}", worker_id=1, now=t)
+            for payload in a.drain_events():
+                assert b.apply_event(payload, now=t)
+            b.sweep(t)
+            peak = max(peak, b.dedupe_entries())
+        # 200 events applied; only ~one TTL's worth may be remembered.
+        assert peak <= 8
+        assert b.dedupe_entries() <= 8
+        # The origin's emptied window itself is dropped once idle.
+        b.sweep(1000.0)
+        assert b.dedupe_entries() == 0
+        assert b._applied == {}
+
+    def test_redelivery_dropped_and_counted(self):
+        a, b = _tier(origin="a"), _tier(origin="b")
+        a.observe_routed("dup", worker_id=2, now=100.0)
+        events = a.drain_events()
+        assert events
+        for payload in events:
+            assert b.apply_event(dict(payload), now=100.0)
+        before = b.duplicates_dropped
+        for payload in events:
+            assert b.apply_event(dict(payload), now=101.0) is False
+        assert b.duplicates_dropped == before + len(events)
+
+    def test_origin_window_capped(self, monkeypatch):
+        monkeypatch.setenv("DYNT_FED_DEDUPE_MAX", "8")
+        a, b = _tier(origin="a"), _tier(origin="b")
+        for i in range(30):
+            a.observe_routed(f"c{i}", worker_id=1, now=50.0)
+        for payload in a.drain_events():
+            b.apply_event(payload, now=50.0)
+        assert b.dedupe_entries() <= 8
+
+    def test_snapshot_apply_is_idempotent(self):
+        a, b = _tier(origin="a"), _tier(origin="b")
+        a.register_request(_Req(list(range(64)), session_id="s1"),
+                           [(64, "100")], now=0.0)
+        a.observe_routed("s1", worker_id=7, now=0.0)
+        a.drain_events()
+        snap = a.snapshot_events(now=1.0)
+        assert snap
+        for payload in snap:
+            b.apply_event(payload, now=1.0)
+        pinned = b.ledger.pinned_set()
+        assert pinned == a.ledger.pinned_set()
+        assert b.residency("s1", now=2.0) == 7
+        # The resync rung may re-apply the same snapshot: no growth,
+        # duplicates land in the window.
+        for payload in snap:
+            assert b.apply_event(payload, now=2.0) is False
+        assert b.ledger.pinned_set() == pinned
