@@ -29,6 +29,7 @@ from ..runtime import metrics as rt_metrics
 from ..runtime.admission import QueueWaitEstimator
 from ..runtime.config import env
 from ..runtime.logging import get_logger
+from ..runtime.metric_labels import bounded_label
 
 log = get_logger("federation.cells")
 
@@ -71,7 +72,8 @@ class Cell:
         self._set_gauge()
 
     def _set_gauge(self) -> None:
-        rt_metrics.FEDERATION_CELL_STATE.labels(cell=self.name).set(
+        rt_metrics.FEDERATION_CELL_STATE.labels(
+            cell=bounded_label("cell", self.name)).set(
             STATE_VALUES[self.state])
 
     # -- health --------------------------------------------------------------

@@ -35,6 +35,7 @@ from ..runtime import metrics as rt_metrics
 from ..runtime.config import env
 from ..runtime.events import _journal_pack, _journal_read
 from ..runtime.logging import get_logger
+from ..runtime.metric_labels import bounded_label
 from ..session.store import SESSION_PIN_TOPIC, SessionTier
 from .router import FederationRouter
 
@@ -165,7 +166,8 @@ class FederationReconciler:
     def _set_lag(self, src: str, dst: str, lag: float) -> None:
         self.lag[(src, dst)] = lag
         self.lag_peak = max(self.lag_peak, lag)
-        rt_metrics.FEDERATION_LAG_SECONDS.labels(src, dst).set(lag)
+        rt_metrics.FEDERATION_LAG_SECONDS.labels(
+            bounded_label("cell", src), bounded_label("cell", dst)).set(lag)
 
     def _deliver(self, src: str, dst: str, stream: _Stream,
                  now: float, wall: float) -> int:
@@ -213,7 +215,8 @@ class FederationReconciler:
         src_tier = self.tiers.get(src)
         dst_tier = self.tiers.get(dst)
         self.resyncs += 1
-        rt_metrics.FEDERATION_RESYNCS.labels(src, dst).inc()
+        rt_metrics.FEDERATION_RESYNCS.labels(
+            bounded_label("cell", src), bounded_label("cell", dst)).inc()
         log.warning("federation stream %s->%s lag %.1fs > %.1fs: "
                     "resyncing from snapshot", src, dst,
                     self.lag.get((src, dst), 0.0), self.max_lag_s())

@@ -47,6 +47,7 @@ from ..runtime import metrics as rt_metrics
 from ..runtime.admission import AdmissionRefused, clamp_retry_after_s
 from ..runtime.config import env
 from ..runtime.logging import get_logger
+from ..runtime.metric_labels import bounded_label
 from ..session.store import SessionStore
 from .cells import Cell, CellDirectory
 
@@ -243,7 +244,8 @@ class FederationRouter:
                     outcome="miss").inc()
                 target = min(cells, key=lambda c: c.pressure(now))
                 rt_metrics.FEDERATION_SPILL.labels(
-                    resident, target.name, reason).inc()
+                    bounded_label("cell", resident),
+                    bounded_label("cell", target.name), reason).inc()
                 self.observe_routed(session_id, target.name, now=now)
                 return RouteDecision(target.name, "rehomed",
                                      reason=reason, resident=resident)
@@ -267,7 +269,8 @@ class FederationRouter:
             if best is not None and best_cost < home_wait:
                 retry = clamp_retry_after_s(home_wait * 1e3)
                 rt_metrics.FEDERATION_SPILL.labels(
-                    resident, best.name, "pressure").inc()
+                    bounded_label("cell", resident),
+                    bounded_label("cell", best.name), "pressure").inc()
                 self.observe_routed(session_id, best.name, now=now)
                 return RouteDecision(best.name, "spill",
                                      reason="pressure",
@@ -312,7 +315,8 @@ class FederationRouter:
         if spilled:
             # The preferred edge was pressured: this is a spill too.
             rt_metrics.FEDERATION_SPILL.labels(
-                hint.name, target.name, "pressure").inc()
+                bounded_label("cell", hint.name),
+                bounded_label("cell", target.name), "pressure").inc()
         self.observe_routed(session_id, target.name, now=now)
         return RouteDecision(target.name, "new")
 

@@ -30,7 +30,9 @@ from ..runtime import metrics as rt_metrics
 from ..runtime.admission import AdmissionRefused, check_admission
 from ..runtime.config import env
 from ..runtime.flight_recorder import get_recorder
-from ..runtime.logging import current_request_id, get_logger
+from ..runtime.metric_labels import bounded_label
+from ..runtime.logging import (current_request_id, current_trace_id,
+                               get_logger)
 from ..runtime.otel import get_tracer, trace_id_of
 from ..runtime.push_router import NoInstancesAvailable
 from ..runtime.request_plane import RemoteError
@@ -135,9 +137,9 @@ class _SloObserver:
         if self._finalized:
             return
         self._finalized = True
-        rt_metrics.SLO_REQUESTS.labels(model=self.model,
-                                       priority=self.priority,
-                                       tenant=self.tenant).inc()
+        rt_metrics.SLO_REQUESTS.labels(
+            model=self.model, priority=self.priority,
+            tenant=bounded_label("tenant", self.tenant)).inc()
         if not ok:
             return
         # An unset target always passes: a clean zero-token completion
@@ -149,9 +151,9 @@ class _SloObserver:
             return
         if self.itl_target_ms and self.itl_max * 1e3 > self.itl_target_ms:
             return
-        rt_metrics.SLO_GOOD.labels(model=self.model,
-                                   priority=self.priority,
-                                   tenant=self.tenant).inc()
+        rt_metrics.SLO_GOOD.labels(
+            model=self.model, priority=self.priority,
+            tenant=bounded_label("tenant", self.tenant)).inc()
 
 
 class HttpService:
@@ -536,9 +538,11 @@ class HttpService:
         tp = span.traceparent or request.headers.get("traceparent")
         if tp:
             preprocessed.annotations["traceparent"] = tp
+        current_trace_id.set(_trace_id_of(preprocessed) or None)
         get_recorder().start(preprocessed.request_id,
                              model=preprocessed.model,
                              trace_id=_trace_id_of(preprocessed),
+                             tenant=preprocessed.tenant,
                              received=received)
 
     async def _completion_traced(

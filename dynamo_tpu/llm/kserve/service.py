@@ -19,7 +19,8 @@ from typing import AsyncIterator, Optional
 import grpc
 
 from ...runtime.flight_recorder import get_recorder
-from ...runtime.logging import current_request_id, get_logger
+from ...runtime.logging import (current_request_id, current_trace_id,
+                                get_logger)
 from ...runtime.otel import get_tracer, trace_id_of
 from ..manager import ModelManager
 from ..preprocessor import DeltaGenerator, RequestError
@@ -160,12 +161,14 @@ class KServeGrpcService:
         if wire_tp:
             preprocessed.annotations["traceparent"] = wire_tp
         current_request_id.set(preprocessed.request_id)
+        current_trace_id.set(trace_id_of(wire_tp) or None)
         # Record the trace id of the traceparent actually forwarded on
         # the wire — same semantics as the HTTP path, which keeps the
         # client's trace id even when local export is disabled.
         get_recorder().start(preprocessed.request_id,
                              model=preprocessed.model,
                              trace_id=trace_id_of(wire_tp),
+                             tenant=preprocessed.tenant,
                              received=received)
         return span
 

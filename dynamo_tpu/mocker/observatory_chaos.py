@@ -1,0 +1,414 @@
+"""Observatory chaos: does the fleet watcher SEE the incident?
+
+The chip-free proof behind docs/observability.md §Fleet observatory: a
+simulated 2-pool mocker fleet produces genuine Prometheus exposition
+text from modeled counters under an injected clock, and the REAL
+observatory stack — collector (deadlines + scrape breakers), histogram
+merge, burn-rate alert engine, capture bundler — watches it through
+the exact code path production scrapes take. Nothing in the plane
+under test is mocked; only the workers behind the /metrics pages are.
+
+Two arms share the fleet model and the clock:
+
+  * **degraded** — healthy warmup, then a step-time degradation
+    injected into the decode pool mid-ramp (TTFT inflates past the SLO
+    target, goodput collapses), plus one prefill worker killed cold
+    (scrape fetches raise) and later revived. The assertions pin:
+    the fast burn-rate alert fires within the detection budget AND
+    names the decode pool; a complete capture bundle (manifest,
+    rollup, alerts, timelines, steptrace) lands in the spool; the dead
+    worker's scrape breaker opens (bounded probing, no collector
+    hang) and re-closes after revival; the alert resolves within the
+    resolve budget after the heal; and the ProtocolMonitor saw zero
+    violations (the alert lifecycle is the ``observatory_alert``
+    dynastate protocol).
+  * **clean** — the identical fleet and duration with no injection:
+    zero alert transitions, zero bundles. The false-positive gate.
+
+Run via scripts/chaos_observatory.py (CI job `obs-watch`) or the
+tier-1 slice in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observatory.alerts import AlertEngine, default_rules
+from ..observatory.capture import CaptureBundler
+from ..observatory.collector import FleetCollector, ScrapeTarget
+from ..observatory.rollup import build_rollup, publish_rollup
+from ..runtime import conformance
+from ..runtime.logging import get_logger, set_log_cell
+
+log = get_logger("mocker.observatory_chaos")
+
+# Bucket boundaries for the simulated TTFT/ITL histograms (seconds) —
+# shape-compatible with runtime/metrics.py's exposition.
+_TTFT_LES = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, math.inf)
+_ITL_LES = (0.005, 0.01, 0.025, 0.05, 0.1, math.inf)
+
+
+def _le(le: float) -> str:
+    return "+Inf" if math.isinf(le) else f"{le:g}"
+
+
+@dataclasses.dataclass
+class ObservatoryChaosParams:
+    # Long enough for the SLOW burn rule's 6h window (720 scaled
+    # seconds) to flush the degraded interval and resolve — the
+    # end-state assertion requires EVERY alert resolved, not just the
+    # fast one.
+    seconds: float = 1200.0
+    dt: float = 1.0
+    # Window compression: 1h fast-long window -> 120 simulated seconds,
+    # 5m fast-short window -> 10s. The burn math is unchanged.
+    window_scale: float = 1.0 / 30.0
+    # Fleet shape: pools -> workers. Healthy prefill runs slightly
+    # slower than decode so the worst-pool attribution is only correct
+    # if the DEGRADED pool overtakes it — a tie cannot fake the assert.
+    workers_per_pool: int = 3
+    rate_rps: float = 40.0  # per worker
+    slo_ttft_s: float = 0.5
+    ttft_base_s: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"prefill": 0.18, "decode": 0.12})
+    ttft_jitter: float = 0.25  # lognormal sigma
+    degrade_factor: float = 12.0  # decode step-time inflation
+    # Chaos timeline, fractions of `seconds` (injection at 180s, heal
+    # at 360s with the default duration — the back half of the run is
+    # the slow window draining).
+    inject_frac: float = 0.15
+    heal_frac: float = 0.30
+    kill_frac: float = 0.20
+    revive_frac: float = 0.275
+    # Pinned budgets (simulated seconds).
+    detect_budget_s: float = 45.0
+    resolve_budget_s: float = 200.0
+    # Collector/bundler knobs under test.
+    scrape_timeout_ms: float = 200.0
+    breaker_reset_secs: float = 0.01  # breakers use the wall clock
+    capture_cooldown_s: float = 120.0
+    seed: int = 20260807
+
+
+class SimWorker:
+    """One modeled worker process: cumulative counters rendered as an
+    honest Prometheus exposition page. Degradation inflates the drawn
+    TTFT — goodput and the histogram react, nothing is written to the
+    metrics directly."""
+
+    def __init__(self, name: str, pool: str, params: ObservatoryChaosParams,
+                 seed: int) -> None:
+        self.name = name
+        self.pool = pool
+        self.p = params
+        self.rng = np.random.default_rng(seed)
+        self.degraded = False
+        self.dead = False
+        self.slo_total = 0
+        self.slo_good = 0
+        self.ttft_buckets = {le: 0 for le in _TTFT_LES}
+        self.itl_buckets = {le: 0 for le in _ITL_LES}
+        self._carry = 0.0
+        self.slow_timelines: List[dict] = []
+
+    def tick(self, now: float, dt: float) -> None:
+        if self.dead:
+            return
+        self._carry += self.p.rate_rps * dt
+        n = int(self._carry)
+        self._carry -= n
+        base = self.p.ttft_base_s[self.pool]
+        if self.degraded:
+            base *= self.p.degrade_factor
+        ttfts = base * self.rng.lognormal(
+            0.0, self.p.ttft_jitter, size=n)
+        itls = 0.012 * self.rng.lognormal(0.0, 0.2, size=n)
+        for ttft, itl in zip(ttfts, itls):
+            self.slo_total += 1
+            if ttft <= self.p.slo_ttft_s:
+                self.slo_good += 1
+            elif len(self.slow_timelines) < 32:
+                self.slow_timelines.append({
+                    "request_id": f"{self.name}-r{self.slo_total}",
+                    "status": "ok", "slow": True,
+                    "elapsed_ms": round(ttft * 1e3, 1),
+                    "phases": {"received": now,
+                               "first_token": now + ttft},
+                })
+            for le in _TTFT_LES:
+                if ttft <= le:
+                    self.ttft_buckets[le] += 1
+            for le in _ITL_LES:
+                if itl <= le:
+                    self.itl_buckets[le] += 1
+
+    def render(self) -> str:
+        """The worker's /metrics page, as the scraper would see it."""
+        if self.dead:
+            raise ConnectionError(f"{self.name} is down")
+        lines = [
+            "# TYPE dynamo_slo_requests_total counter",
+            f'dynamo_slo_requests_total{{model="sim",priority="interactive",'
+            f'tenant="chaos"}} {self.slo_total}',
+            f'dynamo_slo_good_total{{model="sim",priority="interactive",'
+            f'tenant="chaos"}} {self.slo_good}',
+            f'dynamo_mfu{{worker="{self.name}"}} '
+            f'{0.15 if self.degraded else 0.42}',
+            f'dynamo_host_bound{{worker="{self.name}"}} 0',
+        ]
+        for family, buckets, count in (
+                ("dynamo_time_to_first_token_seconds", self.ttft_buckets,
+                 self.slo_total),
+                ("dynamo_inter_token_latency_seconds", self.itl_buckets,
+                 self.slo_total)):
+            for le, n in buckets.items():
+                lines.append(
+                    f'{family}_bucket{{model="sim",le="{_le(le)}"}} {n}')
+            lines.append(f'{family}_count{{model="sim"}} {count}')
+        return "\n".join(lines) + "\n"
+
+    def debug_json(self, path: str) -> dict:
+        if self.dead:
+            raise ConnectionError(f"{self.name} is down")
+        if path.startswith("/debug/requests"):
+            return {"inflight": [],
+                    "completed": list(self.slow_timelines)}
+        if path.startswith("/debug/profile"):
+            return {"trace_dir": f"/tmp/sim-{self.name}",
+                    "duration_ms": 100.0, "files": ["trace.json"]}
+        raise ValueError(f"unexpected fetch path {path}")
+
+
+def _run_arm(params: ObservatoryChaosParams, degraded_arm: bool,
+             spool_dir: str) -> dict:
+    p = params
+    workers = {}
+    targets = []
+    for pool in ("prefill", "decode"):
+        for i in range(p.workers_per_pool):
+            name = f"{pool}-{i}"
+            workers[name] = SimWorker(
+                name, pool, p, seed=p.seed + hash((pool, i)) % 10000)
+            targets.append(ScrapeTarget(name=name, pool=pool,
+                                        cell="cell-0"))
+
+    def fetch(target: ScrapeTarget, _deadline) -> str:
+        return workers[target.name].render()
+
+    def fetch_json(target: ScrapeTarget, path: str) -> dict:
+        return workers[target.name].debug_json(path)
+
+    collector = FleetCollector(fetch=fetch,
+                               timeout_ms=p.scrape_timeout_ms,
+                               breaker_reset_secs=p.breaker_reset_secs)
+    for target in targets:
+        collector.add_target(target)
+    engine = AlertEngine(default_rules(), window_scale=p.window_scale)
+    bundler = CaptureBundler(spool_dir=spool_dir, fetch_json=fetch_json,
+                             cooldown_s=p.capture_cooldown_s)
+
+    inject_at = p.seconds * p.inject_frac
+    heal_at = p.seconds * p.heal_frac
+    kill_at = p.seconds * p.kill_frac
+    revive_at = p.seconds * p.revive_frac
+    victim = "prefill-0"
+
+    transitions: List[dict] = []
+    bundles: List[str] = []
+    skipped_while_dead = 0
+    victim_reclosed = False
+    now = 0.0
+    while now < p.seconds:
+        if degraded_arm:
+            degrade = inject_at <= now < heal_at
+            for worker in workers.values():
+                if worker.pool == "decode":
+                    worker.degraded = degrade
+            was_dead = workers[victim].dead
+            workers[victim].dead = kill_at <= now < revive_at
+            if was_dead and not workers[victim].dead:
+                # Breakers run on the wall clock; give the tiny reset
+                # window a beat so the next poll half-opens and probes.
+                time.sleep(p.breaker_reset_secs * 3)
+        for worker in workers.values():
+            worker.tick(now, p.dt)
+        before_skip = _counter_value("dynamo_fleet_scrapes_total",
+                                     outcome="skipped")
+        collector.poll(now)
+        if workers[victim].dead:
+            skipped_while_dead += int(
+                _counter_value("dynamo_fleet_scrapes_total",
+                               outcome="skipped") - before_skip)
+        if (degraded_arm and now >= revive_at
+                and collector._breakers[victim].state == "closed"):
+            victim_reclosed = True
+        snapshots = list(collector.snapshots.values())
+        roll = build_rollup(
+            snapshots, now, targets_ok=collector.last_ok,
+            targets_broken=collector.last_broken)
+        publish_rollup(roll)
+        for transition in engine.evaluate(roll):
+            transitions.append(transition)
+            if (transition["transition"] == "firing"
+                    and transition.get("capture")):
+                path = bundler.maybe_capture(
+                    transition, roll, engine.to_json(),
+                    collector.targets(), now)
+                if path is not None:
+                    bundles.append(str(path))
+        now += p.dt
+
+    return {
+        "transitions": transitions,
+        "bundles": bundles,
+        "active_at_end": engine.active(),
+        "skipped_while_dead": skipped_while_dead,
+        "victim_breaker_reclosed": victim_reclosed,
+        "inject_at": inject_at,
+        "heal_at": heal_at,
+        "conformance": conformance.get_monitor().snapshot(),
+    }
+
+
+def _counter_value(name: str, **labels) -> float:
+    from ..runtime import metrics as rt_metrics
+
+    for metric in rt_metrics.REGISTRY.collect():
+        if metric.name != name.removesuffix("_total"):
+            continue
+        for sample in metric.samples:
+            if sample.name == name and all(
+                    sample.labels.get(k) == v
+                    for k, v in labels.items()):
+                return sample.value
+    return 0.0
+
+
+def _bundle_complete(path: str) -> Optional[str]:
+    """None when the bundle holds every artifact, else what's wrong."""
+    expected = ("manifest.json", "rollup.json", "alerts.json",
+                "timelines.json", "steptrace.json")
+    for name in expected:
+        full = os.path.join(path, name)
+        if not os.path.isfile(full):
+            return f"missing {name}"
+        try:
+            with open(full) as fh:
+                json.load(fh)
+        except ValueError as exc:
+            return f"unparseable {name}: {exc}"
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    if manifest.get("steptrace_outcome") not in ("captured",
+                                                 "lock_contended"):
+        return f"steptrace outcome {manifest.get('steptrace_outcome')!r}"
+    return None
+
+
+def evaluate(report: dict, params: ObservatoryChaosParams) -> List[dict]:
+    p = params
+    deg = report["arms"]["degraded"]
+    clean = report["arms"]["clean"]
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    fires = [t for t in deg["transitions"]
+             if t["rule"] == "slo_burn_fast"
+             and t["transition"] == "firing"]
+    check("burn_rate_fired", len(fires) >= 1,
+          {"firings": len(fires)})
+    first_fire = fires[0] if fires else {}
+    latency = (first_fire.get("at", math.inf) - deg["inject_at"])
+    check("detection_within_budget", latency <= p.detect_budget_s,
+          {"latency_s": latency, "budget_s": p.detect_budget_s})
+    check("alert_names_degraded_pool",
+          first_fire.get("pool") == "decode",
+          {"pool": first_fire.get("pool")})
+
+    resolves = [t for t in deg["transitions"]
+                if t["rule"] == "slo_burn_fast"
+                and t["transition"] == "resolved"]
+    check("alert_resolved_after_heal", len(resolves) >= 1,
+          {"resolves": len(resolves)})
+    resolve_latency = (resolves[0]["at"] - deg["heal_at"]
+                       if resolves else math.inf)
+    check("resolve_within_budget",
+          resolve_latency <= p.resolve_budget_s,
+          {"latency_s": resolve_latency, "budget_s": p.resolve_budget_s})
+    check("no_alert_active_at_end", not deg["active_at_end"],
+          {"active": deg["active_at_end"]})
+
+    check("bundle_written", len(deg["bundles"]) >= 1,
+          {"bundles": deg["bundles"]})
+    problems = [_bundle_complete(b) for b in deg["bundles"]]
+    check("bundle_complete", bool(deg["bundles"]) and
+          all(pr is None for pr in problems), {"problems": problems})
+
+    check("dead_target_breaker_bounded",
+          deg["skipped_while_dead"] >= 1,
+          {"skipped_scrapes": deg["skipped_while_dead"]})
+    check("victim_breaker_reclosed", deg["victim_breaker_reclosed"],
+          {})
+
+    check("clean_arm_zero_transitions",
+          len(clean["transitions"]) == 0,
+          {"transitions": clean["transitions"][:5]})
+    check("clean_arm_zero_bundles", len(clean["bundles"]) == 0,
+          {"bundles": clean["bundles"]})
+
+    conf = conformance.chaos_assertion(deg["conformance"])
+    checks.append(conf)
+    clean_conf = conformance.chaos_assertion(clean["conformance"])
+    clean_conf["name"] = "protocol_conformance_clean"
+    checks.append(clean_conf)
+    return checks
+
+
+def run_observatory(params: Optional[ObservatoryChaosParams] = None,
+                    spool_root: str = "/tmp/obs-chaos-spool") -> dict:
+    p = params or ObservatoryChaosParams()
+    set_log_cell("cell-0")
+    report: dict = {"params": dataclasses.asdict(p), "arms": {}}
+    for arm, degraded in (("degraded", True), ("clean", False)):
+        os.environ["DYNT_CONFORMANCE"] = "1"
+        conformance.reset_monitor()
+        spool = os.path.join(spool_root, arm)
+        report["arms"][arm] = _run_arm(p, degraded, spool)
+    report["assertions"] = evaluate(report, p)
+    report["passed"] = all(c["ok"] for c in report["assertions"])
+    return report
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser("observatory_chaos")
+    parser.add_argument("--seconds", type=float, default=1200.0)
+    parser.add_argument("--seed", type=int, default=20260807)
+    parser.add_argument("--out", default="chaos-observatory")
+    args = parser.parse_args(argv)
+    params = ObservatoryChaosParams(seconds=args.seconds, seed=args.seed)
+    os.makedirs(args.out, exist_ok=True)
+    report = run_observatory(
+        params, spool_root=os.path.join(args.out, "spool"))
+    path = os.path.join(args.out, "observatory-chaos-report.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=float)
+    for c in report["assertions"]:
+        mark = "ok  " if c["ok"] else "FAIL"
+        print(f"[{mark}] {c['name']}: {c.get('detail')}")
+    print(f"passed={report['passed']} report={path}")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,51 @@
+"""Fleet observatory (docs/observability.md): cross-cell metric
+aggregation, SLO burn-rate alerting, and anomaly-triggered capture
+bundles — the layer that watches the whole fleet and acts on what it
+sees.
+
+    collector.py  pull-based /metrics scraper (discovery cards +
+                  CellDirectory membership, breaker/deadline-guarded)
+    rollup.py     per-process families folded into dynamo_fleet_*
+    alerts.py     multi-window burn-rate + threshold rules, alert
+                  lifecycle as a dynastate protocol
+    capture.py    bounded on-disk capture-bundle spool
+    service.py    the composed Observatory + /fleet, /debug/alerts
+"""
+
+from .alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    Breach,
+    BurnRateRule,
+    ThresholdRule,
+    default_rules,
+)
+from .capture import CaptureBundler, CaptureSpool  # noqa: F401
+from .collector import (  # noqa: F401
+    FleetCollector,
+    ScrapeTarget,
+    Snapshot,
+    targets_from_cards,
+)
+from .rollup import (  # noqa: F401
+    FleetRollup,
+    PoolRollup,
+    build_rollup,
+    merged_buckets,
+    publish_rollup,
+    quantile_from_buckets,
+)
+from .service import (  # noqa: F401
+    Observatory,
+    get_observatory,
+    set_observatory,
+)
+
+__all__ = [
+    "AlertEngine", "AlertRule", "Breach", "BurnRateRule",
+    "ThresholdRule", "default_rules", "CaptureBundler", "CaptureSpool",
+    "FleetCollector", "ScrapeTarget", "Snapshot", "targets_from_cards",
+    "FleetRollup", "PoolRollup", "build_rollup", "merged_buckets",
+    "publish_rollup", "quantile_from_buckets", "Observatory",
+    "get_observatory", "set_observatory",
+]

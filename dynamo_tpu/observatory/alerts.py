@@ -1,0 +1,364 @@
+"""SLO burn-rate + threshold alerting over the fleet rollup.
+
+Burn rate is the SRE-workbook quantity: with an SLO target of 99%
+goodput the error budget is 1%, and ``burn = windowed_error_rate /
+budget`` — burn 1.0 spends exactly the budget over the SLO period,
+burn 14.4 spends a 30-day budget in 2 days. A rule fires only when
+BOTH its long and short windows burn past the threshold: the long
+window gives significance, the short window confirms the problem is
+still happening (so a recovered blip cannot page an hour later).
+Production windows are the workbook's fast (1h + 5m @ 14.4) and slow
+(6h + 30m @ 6) pairs; `window_scale` compresses them for tests and
+chaos runs — the math is identical, only the clock is scaled.
+
+Alerts are first-class objects with a firing/resolved lifecycle,
+machine-checked as the dynastate protocol
+``observatory_alert`` (tools/dynastate/protocols/observatory_alert.json):
+every episode is a fresh instance ``rule#epoch`` observed through
+pending -> firing -> resolved, so a double-fire or post-resolve
+mutation is a protocol violation, not a silent bug. Transitions land
+on ``dynamo_alert_active{rule,severity}`` / ``dynamo_alerts_total``
+and a bounded log served on ``/debug/alerts``.
+
+Resolution has hysteresis: a firing rule resolves only after its
+clear condition (burn below threshold * resolve_ratio, or the
+threshold predicate gone) holds continuously for `clear_hold_s` —
+a flapping signal stays one incident, not twenty.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.conformance import observe
+from ..runtime.logging import get_logger
+from .rollup import FleetRollup
+
+log = get_logger("observatory.alerts")
+
+PROTOCOL = "observatory_alert"
+
+
+@dataclasses.dataclass
+class Breach:
+    """One evaluated breach: what fired, how bad, where."""
+
+    detail: str
+    pool: str = ""
+    value: float = 0.0
+
+
+class AlertRule:
+    """Base rule: evaluate() returns a Breach while breached, None
+    otherwise; cleared() is the (stricter) hysteresis condition that
+    must hold for clear_hold_s before a firing alert resolves.
+    `capture=True` marks perf rules whose firing assembles a capture
+    bundle (observatory/capture.py)."""
+
+    def __init__(self, name: str, severity: str = "ticket",
+                 capture: bool = False,
+                 clear_hold_s: float = 0.0) -> None:
+        self.name = name
+        self.severity = severity
+        self.capture = capture
+        self.clear_hold_s = clear_hold_s
+
+    def evaluate(self, engine: "AlertEngine", rollup: FleetRollup,
+                 prev: Optional[FleetRollup]) -> Optional[Breach]:
+        raise NotImplementedError
+
+    def cleared(self, engine: "AlertEngine", rollup: FleetRollup,
+                prev: Optional[FleetRollup]) -> bool:
+        return self.evaluate(engine, rollup, prev) is None
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window burn-rate rule over the dynamo_slo_* counters."""
+
+    def __init__(self, name: str, severity: str = "page",
+                 slo_target: float = 0.99, threshold: float = 14.4,
+                 long_s: float = 3600.0, short_s: float = 300.0,
+                 resolve_ratio: float = 0.5,
+                 clear_hold_s: Optional[float] = None) -> None:
+        super().__init__(name, severity, capture=True,
+                         clear_hold_s=(short_s if clear_hold_s is None
+                                       else clear_hold_s))
+        self.slo_target = slo_target
+        self.threshold = threshold
+        self.long_s = long_s
+        self.short_s = short_s
+        self.resolve_ratio = resolve_ratio
+
+    def burns(self, engine: "AlertEngine",
+              rollup: FleetRollup) -> Tuple[float, float]:
+        return (engine.burn_rate(self.long_s, rollup.at,
+                                 self.slo_target),
+                engine.burn_rate(self.short_s, rollup.at,
+                                 self.slo_target))
+
+    def evaluate(self, engine, rollup, prev):
+        long_burn, short_burn = self.burns(engine, rollup)
+        if long_burn > self.threshold and short_burn > self.threshold:
+            return Breach(
+                detail=(f"burn {long_burn:.1f}x/{short_burn:.1f}x over "
+                        f"{self.long_s:.0f}s/{self.short_s:.0f}s windows "
+                        f"(threshold {self.threshold}x of the "
+                        f"{1 - self.slo_target:.2%} budget)"),
+                pool=rollup.worst_pool(), value=max(long_burn,
+                                                    short_burn))
+        return None
+
+    def cleared(self, engine, rollup, prev):
+        floor = self.threshold * self.resolve_ratio
+        long_burn, short_burn = self.burns(engine, rollup)
+        return long_burn < floor and short_burn < floor
+
+
+class ThresholdRule(AlertRule):
+    """Predicate rule over the rollup (and the previous rollup, for
+    counter-delta rules like journal corruption)."""
+
+    def __init__(self, name: str,
+                 check: Callable[[FleetRollup, Optional[FleetRollup]],
+                                 Optional[Breach]],
+                 severity: str = "ticket", capture: bool = False,
+                 clear_hold_s: float = 0.0) -> None:
+        super().__init__(name, severity, capture=capture,
+                         clear_hold_s=clear_hold_s)
+        self._check = check
+
+    def evaluate(self, engine, rollup, prev):
+        return self._check(rollup, prev)
+
+
+@dataclasses.dataclass
+class _RuleState:
+    epoch: int = 0
+    firing: bool = False
+    fired_at: float = 0.0
+    clear_since: Optional[float] = None
+    breach: Optional[Breach] = None
+
+
+class AlertEngine:
+    """Evaluate the rule set against each rollup tick.
+
+    Time comes from rollup.at (the collector's injectable clock) —
+    the engine itself never reads a wall clock, so burn-window math is
+    fully deterministic under test.
+
+    `evaluate` runs on the observatory's scrape worker thread while
+    `active`/`to_json` serve /debug/alerts from the event loop, so
+    rule-state and the transition log are touched under `_lock`
+    (reentrant: to_json reads the active set too).
+    """
+
+    def __init__(self, rules: List[AlertRule],
+                 window_scale: float = 1.0,
+                 log_cap: Optional[int] = None) -> None:
+        self.rules = list(rules)
+        self.window_scale = window_scale
+        self._lock = threading.RLock()
+        self._samples: Deque[Tuple[float, float, float]] = (
+            collections.deque())
+        self._states: Dict[str, _RuleState] = {}
+        cap = int(env("DYNT_OBSERVATORY_ALERT_LOG")
+                  if log_cap is None else log_cap)
+        self.log: Deque[dict] = collections.deque(maxlen=max(1, cap))
+        self._prev: Optional[FleetRollup] = None
+        self._max_window = max(
+            [r.long_s for r in self.rules
+             if isinstance(r, BurnRateRule)] or [3600.0])
+
+    # -- burn-window sample store -------------------------------------------
+
+    def _ingest(self, rollup: FleetRollup) -> None:
+        self._samples.append((rollup.at, rollup.slo_good,
+                              rollup.slo_total))
+        horizon = rollup.at - self._max_window * self.window_scale
+        # Keep ONE sample at-or-before the horizon so a full-length
+        # window always has a base to difference against.
+        while (len(self._samples) >= 2
+               and self._samples[1][0] <= horizon):
+            self._samples.popleft()
+
+    def burn_rate(self, window_s: float, now: float,
+                  slo_target: float) -> float:
+        """Windowed burn: error rate over the last `window_s` (scaled)
+        seconds of SLO counters, divided by the error budget. 0.0 when
+        the window saw no finished requests."""
+        if not self._samples:
+            return 0.0
+        start = now - window_s * self.window_scale
+        base = self._samples[0]
+        for sample in self._samples:
+            if sample[0] <= start:
+                base = sample
+            else:
+                break
+        last = self._samples[-1]
+        dtotal = last[2] - base[2]
+        if dtotal <= 0:
+            return 0.0
+        err = 1.0 - (last[1] - base[1]) / dtotal
+        budget = max(1e-9, 1.0 - slo_target)
+        return max(0.0, err) / budget
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _state(self, rule: AlertRule) -> _RuleState:
+        st = self._states.get(rule.name)
+        if st is None:
+            st = self._states[rule.name] = _RuleState()
+        return st
+
+    def evaluate(self, rollup: FleetRollup) -> List[dict]:
+        """One tick: returns the transitions that happened (each also
+        appended to the bounded log)."""
+        with self._lock:
+            self._ingest(rollup)
+            now = rollup.at
+            transitions: List[dict] = []
+            for rule in self.rules:
+                st = self._state(rule)
+                breach = rule.evaluate(self, rollup, self._prev)
+                if breach is not None:
+                    st.clear_since = None
+                    st.breach = breach
+                    if not st.firing:
+                        st.firing = True
+                        st.epoch += 1
+                        st.fired_at = now
+                        transitions.append(self._transition(
+                            rule, st, "firing", now))
+                elif st.firing:
+                    if not rule.cleared(self, rollup, self._prev):
+                        st.clear_since = None
+                    else:
+                        if st.clear_since is None:
+                            st.clear_since = now
+                        hold = rule.clear_hold_s * self.window_scale
+                        if now - st.clear_since >= hold:
+                            st.firing = False
+                            transitions.append(self._transition(
+                                rule, st, "resolved", now))
+            self._prev = rollup
+            return transitions
+
+    def _transition(self, rule: AlertRule, st: _RuleState,
+                    transition: str, now: float) -> dict:
+        observe(PROTOCOL, f"{rule.name}#{st.epoch}", transition)
+        rt_metrics.ALERT_ACTIVE.labels(
+            rule=rule.name, severity=rule.severity).set(
+                1 if transition == "firing" else 0)
+        rt_metrics.ALERTS_TOTAL.labels(
+            rule=rule.name, transition=transition).inc()
+        breach = st.breach or Breach(detail="")
+        entry = {"at": now, "rule": rule.name,
+                 "severity": rule.severity, "transition": transition,
+                 "epoch": st.epoch, "detail": breach.detail,
+                 "pool": breach.pool, "value": breach.value,
+                 "capture": rule.capture}
+        self.log.appendleft(entry)
+        log.warning("alert %s %s (severity=%s pool=%s): %s",
+                    rule.name, transition, rule.severity, breach.pool,
+                    breach.detail)
+        return entry
+
+    def active(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for rule in self.rules:
+                st = self._states.get(rule.name)
+                if st is None or not st.firing:
+                    continue
+                breach = st.breach or Breach(detail="")
+                out.append({"rule": rule.name,
+                            "severity": rule.severity,
+                            "epoch": st.epoch, "since": st.fired_at,
+                            "detail": breach.detail,
+                            "pool": breach.pool,
+                            "value": breach.value})
+            return out
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"active": self.active(), "log": list(self.log)}
+
+
+def _host_bound_check(rollup: FleetRollup,
+                      _prev: Optional[FleetRollup]) -> Optional[Breach]:
+    bound = [(p.host_bound, p.pool) for p in rollup.pools.values()
+             if p.host_bound > 0]
+    if not bound:
+        return None
+    count = sum(n for n, _ in bound)
+    worst = max(bound)[1]
+    return Breach(detail=f"{count} host-bound worker(s) — scaling "
+                  "chips will not move this pool's latency",
+                  pool=worst, value=float(count))
+
+
+def _breaker_storm_check(rollup, _prev):
+    if rollup.breakers_open >= 3:
+        return Breach(detail=f"{rollup.breakers_open} circuit breakers "
+                      "open across the fleet",
+                      value=float(rollup.breakers_open))
+    return None
+
+
+def _journal_check(rollup, prev):
+    base = prev.journal_bad_frames if prev is not None else 0.0
+    delta = rollup.journal_bad_frames - base
+    if delta > 0:
+        return Breach(detail=f"{delta:.0f} corrupt journal frame(s) "
+                      "skipped by CRC resync since last tick",
+                      value=delta)
+    return None
+
+
+def _federation_lag_check(rollup, _prev):
+    limit = float(env("DYNT_FED_MAX_LAG_SECS"))
+    if rollup.federation_max_lag_s > limit:
+        return Breach(detail=f"cross-cell reconciliation lag "
+                      f"{rollup.federation_max_lag_s:.1f}s past the "
+                      f"{limit:.1f}s contract",
+                      value=rollup.federation_max_lag_s)
+    return None
+
+
+def _protocol_check(rollup, prev):
+    base = prev.protocol_violations if prev is not None else 0.0
+    delta = rollup.protocol_violations - base
+    if delta > 0:
+        return Breach(detail=f"{delta:.0f} protocol violation(s) "
+                      "observed by the runtime ProtocolMonitor",
+                      value=delta)
+    return None
+
+
+def default_rules() -> List[AlertRule]:
+    """The shipped rule catalogue (docs/observability.md)."""
+    return [
+        BurnRateRule("slo_burn_fast", severity="page",
+                     threshold=14.4, long_s=3600.0, short_s=300.0),
+        BurnRateRule("slo_burn_slow", severity="ticket",
+                     threshold=6.0, long_s=6 * 3600.0, short_s=1800.0),
+        ThresholdRule("host_bound_workers", _host_bound_check,
+                      severity="ticket", capture=True,
+                      clear_hold_s=30.0),
+        ThresholdRule("breaker_storm", _breaker_storm_check,
+                      severity="page"),
+        ThresholdRule("journal_corruption", _journal_check,
+                      severity="page"),
+        ThresholdRule("federation_lag", _federation_lag_check,
+                      severity="ticket"),
+        ThresholdRule("protocol_violations", _protocol_check,
+                      severity="page"),
+    ]

@@ -1,0 +1,228 @@
+"""Anomaly-triggered capture bundles: the postmortem artifact exists
+from the incident, not from a repro attempt.
+
+When a perf rule fires (capture=True on the rule), the bundler
+assembles one bundle directory under DYNT_OBSERVATORY_DIR:
+
+    NNNNNN-<rule>/
+      manifest.json    what fired, which pool was implicated, outcomes
+      rollup.json      the fleet rollup at fire time
+      alerts.json      active alerts + the transition log
+      timelines.json   /debug/requests from the implicated pool's
+                       targets (error/slow-filtered, bounded)
+      steptrace.json   a /debug/profile capture from one implicated
+                       target — taken under the SAME process-global
+                       capture lock as manual /debug/profile
+                       (runtime/status.py), so a human mid-capture
+                       wins and the bundle records the contention
+                       instead of corrupting the trace
+
+The spool is a bounded incident ring, not an archive: oldest bundles
+are pruned past DYNT_OBSERVATORY_MAX_BUNDLES / DYNT_OBSERVATORY_MAX_MB,
+and each rule captures at most once per
+DYNT_OBSERVATORY_CAPTURE_COOLDOWN_SECS, so a flapping alert cannot
+churn the disk or hog the capture lock. The bundle path is logged at
+WARNING — incidents are greppable end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import urllib.request
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from .collector import ScrapeTarget
+from .rollup import FleetRollup
+
+log = get_logger("observatory.capture")
+
+_TIMELINE_TARGET_CAP = 4
+_TIMELINE_LIMIT = 64
+
+
+def http_fetch_json(target: ScrapeTarget, path: str,
+                    timeout_s: float = 5.0) -> dict:
+    """Default bundle fetch: GET <url><path>, parsed as JSON."""
+    with urllib.request.urlopen(f"{target.url}{path}",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+class CaptureSpool:
+    """Bounded on-disk bundle ring under one root directory."""
+
+    def __init__(self, root: Path, max_bundles: Optional[int] = None,
+                 max_mb: Optional[int] = None) -> None:
+        self.root = Path(root)
+        self.max_bundles = (int(env("DYNT_OBSERVATORY_MAX_BUNDLES"))
+                            if max_bundles is None else max_bundles)
+        self.max_mb = (int(env("DYNT_OBSERVATORY_MAX_MB"))
+                       if max_mb is None else max_mb)
+
+    def bundles(self) -> List[Path]:
+        if not self.root.is_dir():
+            return []
+        return sorted(p for p in self.root.iterdir() if p.is_dir())
+
+    def next_dir(self, rule: str) -> Path:
+        existing = self.bundles()
+        seq = 0
+        for path in existing:
+            head = path.name.split("-", 1)[0]
+            if head.isdigit():
+                seq = max(seq, int(head) + 1)
+        return self.root / f"{seq:06d}-{rule}"
+
+    def _size(self, path: Path) -> int:
+        total = 0
+        for sub in path.rglob("*"):
+            if sub.is_file():
+                total += sub.stat().st_size
+        return total
+
+    def prune(self) -> None:
+        """Drop oldest bundles past the count/size bounds (the newest
+        bundle always survives, even alone over the size cap — an
+        incident artifact beats an empty spool)."""
+        bundles = self.bundles()
+        cap_bytes = self.max_mb * 1024 * 1024
+        sizes = {p: self._size(p) for p in bundles}
+        while bundles and (len(bundles) > self.max_bundles
+                           or sum(sizes[p] for p in bundles) > cap_bytes):
+            if len(bundles) == 1:
+                break
+            victim = bundles.pop(0)
+            shutil.rmtree(victim, ignore_errors=True)
+            sizes.pop(victim, None)
+        rt_metrics.OBSERVATORY_SPOOL_BYTES.set(
+            sum(sizes[p] for p in bundles))
+
+
+class CaptureBundler:
+    """Assemble capture bundles for firing perf alerts."""
+
+    def __init__(self, spool_dir: Optional[str] = None,
+                 fetch_json: Optional[Callable] = None,
+                 cooldown_s: Optional[float] = None,
+                 max_bundles: Optional[int] = None,
+                 max_mb: Optional[int] = None) -> None:
+        self._dir = (env("DYNT_OBSERVATORY_DIR")
+                     if spool_dir is None else spool_dir)
+        self._fetch_json = fetch_json or http_fetch_json
+        self._cooldown = (
+            float(env("DYNT_OBSERVATORY_CAPTURE_COOLDOWN_SECS"))
+            if cooldown_s is None else cooldown_s)
+        self._last_capture: Dict[str, float] = {}
+        self.spool = (CaptureSpool(Path(self._dir), max_bundles, max_mb)
+                      if self._dir else None)
+
+    def maybe_capture(self, transition: dict, rollup: FleetRollup,
+                      alerts_json: dict,
+                      targets: List[ScrapeTarget],
+                      now: float) -> Optional[Path]:
+        """Called with each firing transition; returns the bundle path
+        when one was written. Never raises — the alert already fired,
+        the artifact is best-effort."""
+        rule = transition["rule"]
+        if not self._dir or self.spool is None:
+            rt_metrics.OBSERVATORY_BUNDLES.labels(
+                outcome="disabled").inc()
+            return None
+        last = self._last_capture.get(rule)
+        if last is not None and now - last < self._cooldown:
+            rt_metrics.OBSERVATORY_BUNDLES.labels(
+                outcome="rate_limited").inc()
+            log.info("capture for %s suppressed: inside the %.0fs "
+                     "cooldown", rule, self._cooldown)
+            return None
+        self._last_capture[rule] = now
+        try:
+            path = self._assemble(transition, rollup, alerts_json,
+                                  targets, now)
+        except Exception:  # noqa: BLE001 — artifact is best-effort
+            rt_metrics.OBSERVATORY_BUNDLES.labels(outcome="error").inc()
+            log.exception("capture bundle for %s failed", rule)
+            return None
+        rt_metrics.OBSERVATORY_BUNDLES.labels(outcome="written").inc()
+        log.warning("capture bundle written: %s (rule=%s pool=%s)",
+                    path, rule, transition.get("pool", ""))
+        return path
+
+    def _implicated(self, pool: str,
+                    targets: List[ScrapeTarget]) -> List[ScrapeTarget]:
+        chosen = [t for t in targets if pool and t.pool == pool]
+        if not chosen:
+            chosen = [t for t in targets if t.pool]
+        return chosen[:_TIMELINE_TARGET_CAP]
+
+    def _assemble(self, transition: dict, rollup: FleetRollup,
+                  alerts_json: dict, targets: List[ScrapeTarget],
+                  now: float) -> Path:
+        rule = transition["rule"]
+        pool = transition.get("pool", "")
+        bundle = self.spool.next_dir(rule)
+        os.makedirs(bundle, exist_ok=True)
+        implicated = self._implicated(pool, targets)
+
+        timelines: Dict[str, dict] = {}
+        for target in implicated:
+            try:
+                timelines[target.name] = self._fetch_json(
+                    target,
+                    f"/debug/requests?slow=1&limit={_TIMELINE_LIMIT}")
+            except Exception as exc:  # noqa: BLE001
+                timelines[target.name] = {"error": str(exc)}
+
+        steptrace: dict = {"outcome": "no_target"}
+        if implicated:
+            steptrace = self._steptrace(implicated[0])
+
+        files = {
+            "rollup.json": rollup.to_json(),
+            "alerts.json": alerts_json,
+            "timelines.json": timelines,
+            "steptrace.json": steptrace,
+        }
+        manifest = {
+            "rule": rule,
+            "severity": transition.get("severity", ""),
+            "pool": pool,
+            "epoch": transition.get("epoch", 0),
+            "detail": transition.get("detail", ""),
+            "at": now,
+            "steptrace_outcome": steptrace.get("outcome", "captured"),
+            "targets": [t.name for t in implicated],
+            "files": sorted(files) + ["manifest.json"],
+        }
+        for name, payload in files.items():
+            with open(bundle / name, "w") as fh:
+                json.dump(payload, fh, indent=1, default=str)
+        with open(bundle / "manifest.json", "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        self.spool.prune()
+        return bundle
+
+    def _steptrace(self, target: ScrapeTarget) -> dict:
+        """Steptrace capture from the implicated target, under the
+        process-global /debug/profile lock: a concurrent manual capture
+        (or another bundler) holds it, we record the contention."""
+        from ..runtime.status import _PROFILE_LOCK
+
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            return {"outcome": "lock_contended"}
+        try:
+            trace = self._fetch_json(target, "/debug/profile")
+            if isinstance(trace, dict):
+                trace.setdefault("outcome", "captured")
+                return trace
+            return {"outcome": "captured", "trace": trace}
+        except Exception as exc:  # noqa: BLE001
+            return {"outcome": "error", "error": str(exc)}
+        finally:
+            _PROFILE_LOCK.release()

@@ -1,0 +1,235 @@
+"""Fleet metric collector: pull every discovered /metrics endpoint.
+
+One process watches the fleet: the collector keeps a target set (built
+from discovery instance cards and CellDirectory membership, or handed
+in explicitly by the chaos harness), scrapes each target's Prometheus
+exposition on a cadence, and hands the parsed per-process snapshots to
+rollup.py to fold into the ``dynamo_fleet_*`` families.
+
+Scrapes ride the resilience plane: each fetch is bounded by a Deadline
+(DYNT_OBSERVATORY_SCRAPE_TIMEOUT_MS) and gated by a per-target
+CircuitBreaker — a dead worker costs one probe per reset window, not a
+hang per tick. Breaker state exports on the usual
+``dynamo_circuit_breaker_state{endpoint="observatory.scrape"}`` series
+so a broken target is visible on the same pane as everything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ..planner.metrics_source import parse_prometheus_text
+from ..runtime import metrics as rt_metrics
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from ..runtime.resilience import CLOSED, CircuitBreaker, Deadline
+
+log = get_logger("observatory.collector")
+
+_ENDPOINT = "observatory.scrape"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScrapeTarget:
+    """One /metrics endpoint the collector watches.
+
+    `name` is the unique target id (instance id, worker name, cell
+    frontend); `pool` groups workers for per-pool rollups and alert
+    attribution; `cell` ties the target to federation membership.
+    `url` is the status-server base ("http://host:port") — empty when
+    the collector's injected fetch resolves targets itself (tests,
+    mocker fleets).
+    """
+
+    name: str
+    url: str = ""
+    pool: str = ""
+    cell: str = ""
+    role: str = "worker"
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One parsed scrape: {(family, sorted-label-items): value}."""
+
+    target: ScrapeTarget
+    at: float
+    families: Dict[tuple, float]
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        """Single-series lookup by exact label set (sorted items key)."""
+        key = (name, tuple(sorted(labels.items())))
+        return self.families.get(key)
+
+    def sum(self, name: str, **labels: str) -> float:
+        """Sum every series of `name` whose labels include `labels`."""
+        want = set(labels.items())
+        total = 0.0
+        for (fam, items), val in self.families.items():
+            if fam == name and want.issubset(items):
+                total += val
+        return total
+
+    def series(self, name: str) -> List[tuple]:
+        """[(labels-dict, value)] for every series of `name`."""
+        out = []
+        for (fam, items), val in self.families.items():
+            if fam == name:
+                out.append((dict(items), val))
+        return out
+
+
+def http_fetch(target: ScrapeTarget, deadline: Deadline) -> str:
+    """Default fetch: GET <url>/metrics inside the remaining budget."""
+    timeout = max(0.05, deadline.bound(None))
+    with urllib.request.urlopen(f"{target.url}/metrics",
+                                timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+class FleetCollector:
+    """Scrape the target set; keep the latest Snapshot per target.
+
+    `fetch(target, deadline) -> exposition text` is injectable so the
+    chaos harness and tests drive simulated fleets through the same
+    breaker/deadline path production scrapes take.
+    """
+
+    def __init__(self, fetch: Optional[Callable] = None,
+                 timeout_ms: Optional[float] = None,
+                 breaker_reset_secs: Optional[float] = None) -> None:
+        self._fetch = fetch or http_fetch
+        self._timeout_ms = (env("DYNT_OBSERVATORY_SCRAPE_TIMEOUT_MS")
+                            if timeout_ms is None else timeout_ms)
+        self._breaker_reset = breaker_reset_secs
+        self._targets: Dict[str, ScrapeTarget] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self.snapshots: Dict[str, Snapshot] = {}
+        # Last poll's breaker-aware health split. The snapshot dict
+        # keeps stale entries for rollup continuity, so counting it
+        # would hide a dead target forever; these carry the same
+        # numbers the FLEET_TARGETS gauges get.
+        self.last_ok = 0
+        self.last_broken = 0
+
+    # -- target management -------------------------------------------------
+
+    def add_target(self, target: ScrapeTarget) -> None:
+        self._targets[target.name] = target
+
+    def remove_target(self, name: str) -> None:
+        self._targets.pop(name, None)
+        self.snapshots.pop(name, None)
+        if self._breakers.pop(name, None) is not None:
+            try:
+                rt_metrics.BREAKER_STATE.remove(_ENDPOINT, name)
+            except KeyError:
+                pass
+
+    def set_targets(self, targets: List[ScrapeTarget]) -> None:
+        """Reconcile to exactly `targets` (discovery-driven refresh)."""
+        want = {t.name: t for t in targets}
+        for name in [n for n in self._targets if n not in want]:
+            self.remove_target(name)
+        for target in want.values():
+            self.add_target(target)
+
+    def targets(self) -> List[ScrapeTarget]:
+        return list(self._targets.values())
+
+    def _breaker(self, name: str) -> CircuitBreaker:
+        breaker = self._breakers.get(name)
+        if breaker is None:
+            def observe(state: str, iid: str = name) -> None:
+                rt_metrics.BREAKER_STATE.labels(
+                    endpoint=_ENDPOINT, instance=iid).set(
+                        {"closed": 0, "open": 1, "half_open": 2}[state])
+
+            reset = (env("DYNT_BREAKER_RESET_SECS")
+                     if self._breaker_reset is None
+                     else self._breaker_reset)
+            breaker = CircuitBreaker(failure_threshold=2,
+                                     reset_secs=reset,
+                                     on_transition=observe)
+            self._breakers[name] = breaker
+        return breaker
+
+    # -- scraping -----------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> Dict[str, Snapshot]:
+        """Scrape every target once; returns the fresh snapshots only
+        (stale ones stay available on self.snapshots for rollup)."""
+        at = time.monotonic() if now is None else now
+        fresh: Dict[str, Snapshot] = {}
+        broken = 0
+        for target in list(self._targets.values()):
+            breaker = self._breaker(target.name)
+            if not breaker.try_acquire():
+                rt_metrics.FLEET_SCRAPES.labels(outcome="skipped").inc()
+                broken += 1
+                continue
+            probe = breaker.state != CLOSED
+            deadline = Deadline(self._timeout_ms / 1e3)
+            text = None
+            try:
+                text = self._fetch(target, deadline)
+                if deadline.expired():
+                    text = None
+                    raise TimeoutError("scrape exceeded deadline")
+            except Exception as exc:  # noqa: BLE001 — any fetch failure
+                rt_metrics.FLEET_SCRAPES.labels(outcome="error").inc()
+                log.debug("scrape of %s failed: %s", target.name, exc)
+            finally:
+                # The verdict settles even if the scrape dies without
+                # one (thread teardown, KeyboardInterrupt): a leaked
+                # half-open probe slot would lock the target out of
+                # scraping forever.
+                if text is not None:
+                    breaker.record_success(probe=probe)
+                else:
+                    breaker.record_failure(probe=probe)
+            if text is None:
+                if breaker.state != CLOSED:
+                    broken += 1
+                continue
+            snap = Snapshot(target=target, at=at,
+                            families=parse_prometheus_text(text))
+            self.snapshots[target.name] = snap
+            fresh[target.name] = snap
+            rt_metrics.FLEET_SCRAPES.labels(outcome="ok").inc()
+        ok = len(self._targets) - broken
+        self.last_ok = ok
+        self.last_broken = broken
+        rt_metrics.FLEET_TARGETS.labels(health="ok").set(ok)
+        rt_metrics.FLEET_TARGETS.labels(health="broken").set(broken)
+        return fresh
+
+
+def targets_from_cards(records: List[dict]) -> List[ScrapeTarget]:
+    """Build scrape targets from discovery instance cards: every card
+    that advertises a `system_url` (runtime/component.py publishes the
+    hosting process's status server) becomes a target, named by
+    instance id, pooled by its component."""
+    out: List[ScrapeTarget] = []
+    seen: set = set()
+    for rec in records:
+        url = (rec.get("system_url")
+               or rec.get("metadata", {}).get("system_url") or "")
+        if not url or url in seen:
+            continue
+        seen.add(url)
+        name = str(rec.get("instance_id", url))
+        subject = rec.get("subject", "")
+        # Live cards carry slash subjects (dynamo/mocker/generate/<id>,
+        # runtime/component.py); the dotted form predates them. Either
+        # way the component segment names the pool.
+        pool = rec.get("metadata", {}).get("pool") or next(
+            (subject.split(sep)[1] for sep in ("/", ".")
+             if sep in subject), "")
+        out.append(ScrapeTarget(name=name, url=url, pool=pool,
+                                cell=rec.get("metadata", {}).get(
+                                    "cell", "")))
+    return out

@@ -1,0 +1,242 @@
+"""Fold per-process scrape snapshots into one fleet-level view.
+
+Quantiles merge the way Prometheus itself would: the per-process
+histograms share fixed bucket boundaries (runtime/metrics.py), so the
+fleet distribution is the bucket-wise SUM of every process's
+cumulative buckets, and a quantile is linear interpolation inside the
+bucket where the rank falls — identical math to PromQL's
+``histogram_quantile(q, sum by (le) (...))``. The unit tier checks
+this against a single-process oracle: observing the union of all
+samples into one histogram must yield the same quantile as merging the
+per-process histograms.
+
+Everything here is pure: snapshots in, FleetRollup out.
+publish_rollup() mirrors the headline numbers onto the
+``dynamo_fleet_*`` gauges so the single pane is itself scrapeable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..runtime import metrics as rt_metrics
+from .collector import Snapshot
+
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+def merged_buckets(snapshots: Iterable[Snapshot], name: str,
+                   pool: Optional[str] = None,
+                   ) -> List[Tuple[float, float]]:
+    """Bucket-wise sum of `name`'s cumulative buckets across snapshots
+    (optionally restricted to targets of one pool), sorted by upper
+    bound with +Inf last: [(le, cumulative_count)]."""
+    sums: Dict[float, float] = {}
+    bucket = name + "_bucket"
+    for snap in snapshots:
+        if pool is not None and snap.target.pool != pool:
+            continue
+        for (fam, items), val in snap.families.items():
+            if fam != bucket:
+                continue
+            le = dict(items).get("le")
+            if le is None:
+                continue
+            upper = math.inf if le in ("+Inf", "inf") else float(le)
+            sums[upper] = sums.get(upper, 0.0) + val
+    return sorted(sums.items(), key=lambda kv: kv[0])
+
+
+def quantile_from_buckets(buckets: List[Tuple[float, float]],
+                          q: float) -> float:
+    """histogram_quantile over cumulative buckets; nan when empty.
+
+    Ranks landing in the +Inf bucket clamp to the highest finite bound
+    (same convention as PromQL — the histogram cannot resolve beyond
+    its last boundary).
+    """
+    if not buckets:
+        return math.nan
+    total = buckets[-1][1]
+    if total <= 0:
+        return math.nan
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if math.isinf(le):
+                return prev_le
+            if count == prev_count:
+                return le
+            return prev_le + (le - prev_le) * (
+                (rank - prev_count) / (count - prev_count))
+        prev_le, prev_count = le, count
+    last_finite = [le for le, _ in buckets if not math.isinf(le)]
+    return last_finite[-1] if last_finite else math.nan
+
+
+def _sum(snapshots: Iterable[Snapshot], name: str, **labels) -> float:
+    return sum(s.sum(name, **labels) for s in snapshots)
+
+
+def _mean(values: List[float]) -> float:
+    return sum(values) / len(values) if values else math.nan
+
+
+@dataclasses.dataclass
+class PoolRollup:
+    """Per-pool slice: the attribution unit a firing perf alert names."""
+
+    pool: str
+    workers: int = 0
+    mfu: float = math.nan
+    roofline: float = math.nan
+    host_bound: int = 0
+    ttft_p95_s: float = math.nan
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FleetRollup:
+    """The single pane: everything the planner/pager layer reads."""
+
+    at: float = 0.0
+    targets_ok: int = 0
+    targets_broken: int = 0
+    # SLO goodput (cumulative counters; the alert engine windows them)
+    slo_good: float = 0.0
+    slo_total: float = 0.0
+    goodput_ratio: float = math.nan
+    shed_total: float = 0.0
+    # Latency quantile merges
+    ttft_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    itl_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # Per-pool utilization + attribution
+    pools: Dict[str, PoolRollup] = dataclasses.field(default_factory=dict)
+    # Elasticity / federation / storage pressure
+    coldstart_lead_s: float = math.nan
+    federation_max_lag_s: float = 0.0
+    federation_spill_total: float = 0.0
+    kvbm_offload_queue_depth: float = 0.0
+    kv_usage_max: float = math.nan
+    # Health planes
+    breakers_open: int = 0
+    journal_bad_frames: float = 0.0
+    protocol_violations: float = 0.0
+
+    def pool(self, name: str) -> PoolRollup:
+        return self.pools.get(name, PoolRollup(pool=name))
+
+    def worst_pool(self) -> str:
+        """The pool a perf alert implicates: highest TTFT p95 (nan
+        sorts last), ties broken by name for determinism."""
+        ranked = sorted(
+            self.pools.values(),
+            key=lambda p: (-(p.ttft_p95_s
+                             if not math.isnan(p.ttft_p95_s) else -1.0),
+                           p.pool))
+        return ranked[0].pool if ranked else ""
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["pools"] = {k: v.to_json() for k, v in self.pools.items()}
+        out["worst_pool"] = self.worst_pool()
+        return out
+
+
+def build_rollup(snapshots: List[Snapshot], at: float,
+                 targets_ok: int = -1,
+                 targets_broken: int = 0) -> FleetRollup:
+    roll = FleetRollup(at=at,
+                       targets_ok=(len(snapshots) if targets_ok < 0
+                                   else targets_ok),
+                       targets_broken=targets_broken)
+    roll.slo_good = _sum(snapshots, "dynamo_slo_good_total")
+    roll.slo_total = _sum(snapshots, "dynamo_slo_requests_total")
+    if roll.slo_total > 0:
+        roll.goodput_ratio = roll.slo_good / roll.slo_total
+    roll.shed_total = _sum(snapshots, "dynamo_requests_shed_total")
+
+    for label, q in QUANTILES:
+        roll.ttft_s[label] = quantile_from_buckets(
+            merged_buckets(snapshots,
+                           "dynamo_time_to_first_token_seconds"), q)
+        roll.itl_s[label] = quantile_from_buckets(
+            merged_buckets(snapshots,
+                           "dynamo_inter_token_latency_seconds"), q)
+
+    by_pool: Dict[str, List[Snapshot]] = {}
+    for snap in snapshots:
+        if snap.target.pool:
+            by_pool.setdefault(snap.target.pool, []).append(snap)
+    for pool, snaps in sorted(by_pool.items()):
+        mfu = [v for _, v in _series_values(snaps, "dynamo_mfu")]
+        roof = [v for _, v in _series_values(
+            snaps, "dynamo_roofline_fraction")]
+        host_bound = sum(
+            1 for _, v in _series_values(snaps, "dynamo_host_bound")
+            if v >= 1.0)
+        roll.pools[pool] = PoolRollup(
+            pool=pool, workers=len(snaps), mfu=_mean(mfu),
+            roofline=_mean(roof), host_bound=host_bound,
+            ttft_p95_s=quantile_from_buckets(
+                merged_buckets(snaps,
+                               "dynamo_time_to_first_token_seconds"),
+                0.95))
+
+    leads = [v for _, v in _series_values(
+        snapshots, "dynamo_coldstart_lead_seconds")]
+    if leads:
+        roll.coldstart_lead_s = max(leads)
+    lags = [v for _, v in _series_values(
+        snapshots, "dynamo_federation_lag_seconds")]
+    if lags:
+        roll.federation_max_lag_s = max(lags)
+    roll.federation_spill_total = _sum(
+        snapshots, "dynamo_federation_spill_total")
+    roll.kvbm_offload_queue_depth = _sum(
+        snapshots, "dynamo_kvbm_offload_queue_depth")
+    usage = [v for _, v in _series_values(snapshots,
+                                          "dynamo_kv_usage_ratio")]
+    if usage:
+        roll.kv_usage_max = max(usage)
+    roll.breakers_open = sum(
+        1 for _, v in _series_values(snapshots,
+                                     "dynamo_circuit_breaker_state")
+        if v == 1.0)
+    roll.journal_bad_frames = _sum(snapshots,
+                                   "dynamo_journal_bad_frames_total")
+    roll.protocol_violations = _sum(
+        snapshots, "dynamo_protocol_violations_total")
+    return roll
+
+
+def _series_values(snapshots: Iterable[Snapshot],
+                   name: str) -> List[Tuple[dict, float]]:
+    out: List[Tuple[dict, float]] = []
+    for snap in snapshots:
+        out.extend(snap.series(name))
+    return out
+
+
+def publish_rollup(roll: FleetRollup) -> None:
+    """Mirror the headline rollup numbers onto dynamo_fleet_* gauges."""
+    if not math.isnan(roll.goodput_ratio):
+        rt_metrics.FLEET_GOODPUT_RATIO.set(roll.goodput_ratio)
+    for label, _ in QUANTILES:
+        ttft = roll.ttft_s.get(label, math.nan)
+        if not math.isnan(ttft):
+            rt_metrics.FLEET_TTFT_SECONDS.labels(quantile=label).set(ttft)
+        itl = roll.itl_s.get(label, math.nan)
+        if not math.isnan(itl):
+            rt_metrics.FLEET_ITL_SECONDS.labels(quantile=label).set(itl)
+    for pool in roll.pools.values():
+        if not math.isnan(pool.mfu):
+            rt_metrics.FLEET_POOL_MFU.labels(pool=pool.pool).set(pool.mfu)
+        if not math.isnan(pool.ttft_p95_s):
+            rt_metrics.FLEET_POOL_TTFT_P95.labels(
+                pool=pool.pool).set(pool.ttft_p95_s)

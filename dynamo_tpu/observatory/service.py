@@ -1,0 +1,124 @@
+"""Observatory: the composed fleet watcher.
+
+One object owns the loop: scrape the targets (collector), fold the
+snapshots (rollup), evaluate the rules (alerts), and hand firing perf
+alerts to the capture bundler. `tick(now)` is the whole cycle —
+synchronous and clock-injectable, so the chaos harness and the unit
+tier drive the exact code the async `run()` loop drives in production.
+
+HTTP surface (mounted on the system status server,
+runtime/status.py):
+
+    /fleet         the rollup JSON — the single pane
+    /debug/alerts  active alerts + the bounded transition log
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..runtime.config import env
+from ..runtime.logging import get_logger
+from .alerts import AlertEngine, AlertRule, default_rules
+from .capture import CaptureBundler
+from .collector import FleetCollector, ScrapeTarget
+from .rollup import FleetRollup, build_rollup, publish_rollup
+
+log = get_logger("observatory")
+
+
+class Observatory:
+    def __init__(self, targets: Optional[List[ScrapeTarget]] = None,
+                 rules: Optional[List[AlertRule]] = None,
+                 fetch: Optional[Callable] = None,
+                 fetch_json: Optional[Callable] = None,
+                 window_scale: float = 1.0,
+                 scrape_timeout_ms: Optional[float] = None,
+                 breaker_reset_secs: Optional[float] = None,
+                 spool_dir: Optional[str] = None,
+                 capture_cooldown_s: Optional[float] = None,
+                 alert_log_cap: Optional[int] = None) -> None:
+        self.collector = FleetCollector(
+            fetch=fetch, timeout_ms=scrape_timeout_ms,
+            breaker_reset_secs=breaker_reset_secs)
+        for target in targets or []:
+            self.collector.add_target(target)
+        self.engine = AlertEngine(
+            rules if rules is not None else default_rules(),
+            window_scale=window_scale, log_cap=alert_log_cap)
+        self.bundler = CaptureBundler(
+            spool_dir=spool_dir, fetch_json=fetch_json,
+            cooldown_s=capture_cooldown_s)
+        # tick() runs on a scrape worker thread (run() dispatches it
+        # via to_thread) while status_json() serves /fleet from the
+        # event loop: the published rollup/bundle list cross domains
+        # under this lock.
+        self._lock = threading.Lock()
+        self.rollup: Optional[FleetRollup] = None
+        self.bundles: List[str] = []
+
+    # -- the cycle ----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> FleetRollup:
+        """One full observe-decide-capture cycle."""
+        at = time.monotonic() if now is None else now
+        self.collector.poll(at)
+        snapshots = list(self.collector.snapshots.values())
+        roll = build_rollup(snapshots, at,
+                            targets_ok=self.collector.last_ok,
+                            targets_broken=self.collector.last_broken)
+        publish_rollup(roll)
+        with self._lock:
+            self.rollup = roll
+        for transition in self.engine.evaluate(roll):
+            if (transition["transition"] == "firing"
+                    and transition.get("capture")):
+                path = self.bundler.maybe_capture(
+                    transition, roll, self.engine.to_json(),
+                    self.collector.targets(), at)
+                if path is not None:
+                    with self._lock:
+                        self.bundles.append(str(path))
+        return roll
+
+    async def run(self, interval_s: Optional[float] = None) -> None:
+        """Live loop: tick on the scrape cadence until cancelled."""
+        interval = (float(env("DYNT_OBSERVATORY_SCRAPE_INTERVAL_SECS"))
+                    if interval_s is None else interval_s)
+        while True:
+            try:
+                await asyncio.to_thread(self.tick)
+            except Exception:  # noqa: BLE001 — the watcher must outlive
+                log.exception("observatory tick failed")
+            await asyncio.sleep(interval)
+
+    # -- JSON surface -------------------------------------------------------
+
+    def status_json(self) -> dict:
+        with self._lock:
+            rollup = self.rollup
+            bundles = list(self.bundles)
+        roll = rollup.to_json() if rollup is not None else {}
+        roll["alerts_active"] = self.engine.active()
+        roll["bundles"] = bundles
+        return roll
+
+    def alerts_json(self) -> dict:
+        return self.engine.to_json()
+
+
+_observatory: Optional[Observatory] = None
+
+
+def get_observatory() -> Optional[Observatory]:
+    return _observatory
+
+
+def set_observatory(obs: Optional[Observatory]) -> None:
+    """Install the process's observatory so the status server can
+    serve /fleet and /debug/alerts (runtime/status.py)."""
+    global _observatory
+    _observatory = obs

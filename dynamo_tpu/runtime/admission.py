@@ -446,12 +446,14 @@ def check_tenant_admission(ledger: TenantLedger, tenant: str,
     AdmissionRefused on refusal. `observe=True` (the entry edge only)
     deposits admitted tokens into the window — downstream edges must
     not double-count."""
+    from .metric_labels import bounded_label
     from .metrics import REQUESTS_SHED, TENANT_SHED
 
     decision = ledger.check(tenant, tokens, contended=contended)
     if not decision.admit:
         REQUESTS_SHED.labels(reason="quota").inc()
-        TENANT_SHED.labels(tenant=tenant or "untagged",
+        TENANT_SHED.labels(tenant=bounded_label("tenant",
+                                                tenant or "untagged"),
                            reason="quota").inc()
         raise AdmissionRefused(
             decision.reason or "tenant quota exceeded",
@@ -477,6 +479,7 @@ def check_admission(estimator: QueueWaitEstimator, deadline,
     tenant when the request is tagged) on refusal. A disabled loop
     (DYNT_ADMISSION_ENABLE=0) admits unconditionally and publishes
     nothing — the pure-FCFS baseline the chaos A/B measures against."""
+    from .metric_labels import bounded_label
     from .metrics import ADMISSION_WAIT_MS, REQUESTS_SHED, TENANT_SHED
 
     if not admission_enabled():
@@ -489,6 +492,7 @@ def check_admission(estimator: QueueWaitEstimator, deadline,
     if not decision.admit:
         REQUESTS_SHED.labels(reason="queue").inc()
         if tenant:
-            TENANT_SHED.labels(tenant=tenant, reason="queue").inc()
+            TENANT_SHED.labels(tenant=bounded_label("tenant", tenant),
+                               reason="queue").inc()
         raise estimator.refuse(decision)
     return decision

@@ -142,6 +142,10 @@ class ServedEndpoint:
             "subject": self.wire_subject,
             "endpoint": self.endpoint.subject,
             "started_at": time.time(),
+            # Where this process's status server answers /metrics —
+            # the observatory's collector builds its scrape set from
+            # these cards (observatory/collector.py targets_from_cards).
+            "system_url": runtime.system_url(),
             "metadata": self.metadata,
         }
         await runtime.put_leased(self.instance_key, self.record)

@@ -626,6 +626,46 @@ _register("DYNT_INTERLEAVE_SEED", 0, _int,
           "replays bit-identically with the same value. Explicit "
           "Interleaver(seed=...) arguments win over the knob")
 
+# --- fleet observatory (dynamo_tpu/observatory/; docs/observability.md) ---
+_register("DYNT_OBSERVATORY_DIR", "", _str,
+          "On-disk spool for anomaly-triggered capture bundles "
+          "(observatory/capture.py). Empty disables bundle writing — "
+          "alerts still fire, only the postmortem artifact is skipped")
+_register("DYNT_OBSERVATORY_MAX_BUNDLES", 8, _int,
+          "Capture-bundle spool count bound: writing bundle N+1 deletes "
+          "the oldest bundle first (the spool is an incident ring, not "
+          "an archive)")
+_register("DYNT_OBSERVATORY_MAX_MB", 64, _int,
+          "Capture-bundle spool size bound in MiB across all bundles; "
+          "oldest bundles are pruned until the new bundle fits")
+_register("DYNT_OBSERVATORY_SCRAPE_INTERVAL_SECS", 5.0, _float,
+          "Fleet collector scrape cadence: how often every discovered "
+          "worker/frontend/cell /metrics endpoint is pulled and folded "
+          "into the dynamo_fleet_* rollup")
+_register("DYNT_OBSERVATORY_SCRAPE_TIMEOUT_MS", 2000.0, _float,
+          "Per-target scrape deadline (runtime/resilience.py Deadline); "
+          "a target that cannot answer inside it counts as a scrape "
+          "failure against its circuit breaker")
+_register("DYNT_OBSERVATORY_CAPTURE_COOLDOWN_SECS", 300.0, _float,
+          "Per-rule capture-bundle rate limit: a rule that keeps firing "
+          "assembles at most one bundle per cooldown window, so a "
+          "flapping alert cannot churn the spool or hog the process-"
+          "global /debug/profile capture lock")
+_register("DYNT_OBSERVATORY_ALERT_LOG", 256, _int,
+          "Bounded alert-transition log served on /debug/alerts "
+          "(newest first; older transitions fall off the ring)")
+_register("DYNT_METRIC_MAX_LABELS", 64, _int,
+          "Per-namespace cap for the bounded metric-label registry "
+          "(runtime/metric_labels.py): the first K distinct values of a "
+          "request-derived label (tenant, cell, ...) keep their own "
+          "series, everything later folds into the 'other' overflow "
+          "bucket so label cardinality cannot grow with user count")
+_register("DYNT_LOG_JSON", False, _bool,
+          "Emit one-line JSON log records (same formatter as "
+          "DYNT_LOGGING_JSONL; either knob enables it) with "
+          "request_id/trace_id/cell correlation fields when a request "
+          "context is active")
+
 
 @dataclasses.dataclass
 class RuntimeConfig:

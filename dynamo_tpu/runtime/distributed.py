@@ -87,6 +87,18 @@ class DistributedRuntime:
                  self.status_server.port if self.status_server else None)
         return self
 
+    def system_url(self) -> str:
+        """Scrape address of this process's status server, advertised on
+        discovery cards so the fleet observatory can find every /metrics
+        endpoint without extra configuration. Empty when the status
+        server is disabled (DYNT_SYSTEM_ENABLED off) or not yet bound."""
+        if self.status_server is None or self.status_server.port is None:
+            return ""
+        host = self.config.tcp_advertise_host or self.config.tcp_host
+        if not host or host == "0.0.0.0":
+            host = "127.0.0.1"
+        return f"http://{host}:{self.status_server.port}"
+
     async def put_leased(self, key: str, value: dict) -> None:
         """Put under the runtime lease AND record it for re-registration
         after a discovery outage."""

@@ -54,6 +54,7 @@ class RequestTimeline:
     request_id: str
     model: str = ""
     trace_id: str = ""
+    tenant: str = ""
     started: float = dataclasses.field(default_factory=time.time)
     phases: dict = dataclasses.field(default_factory=dict)
     events: list = dataclasses.field(default_factory=list)
@@ -74,6 +75,7 @@ class RequestTimeline:
             "request_id": self.request_id,
             "model": self.model,
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
             "status": self.status or "inflight",
             "slow": self.slow,
             "elapsed_ms": round(self.elapsed_ms(), 3),
@@ -105,7 +107,8 @@ class FlightRecorder:
     # -- producer side -----------------------------------------------------
 
     def start(self, request_id: str, model: str = "",
-              trace_id: str = "", received: Optional[float] = None) -> None:
+              trace_id: str = "", tenant: str = "",
+              received: Optional[float] = None) -> None:
         """Open (or enrich) a timeline. Idempotent: the first opener sets
         `received`; later openers only fill in missing identity fields, so
         frontend and worker can both call it in shared-process setups.
@@ -117,7 +120,7 @@ class FlightRecorder:
             tl = self._inflight.get(request_id)
             if tl is None:
                 tl = RequestTimeline(request_id, model=model,
-                                     trace_id=trace_id)
+                                     trace_id=trace_id, tenant=tenant)
                 if received is not None:
                     tl.started = received
                 tl.phases["received"] = tl.started
@@ -128,6 +131,8 @@ class FlightRecorder:
                 tl.model = model
             if trace_id and not tl.trace_id:
                 tl.trace_id = trace_id
+            if tenant and not tl.tenant:
+                tl.tenant = tenant
 
     def stamp(self, request_id: Optional[str], phase: str,
               ts: Optional[float] = None) -> None:

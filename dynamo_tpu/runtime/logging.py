@@ -3,8 +3,14 @@
 Mirrors the reference's tracing-subscriber setup (ref: lib/runtime/src/logging.rs:
 READABLE vs JSONL via DYN_LOGGING_JSONL, env-filter levels). OTLP span export
 lives in runtime/otel.py (DYNT_OTLP_ENDPOINT gates it, matching logging.rs's
-OTLP-in-logging-init); log records carry `x_request_id`/`trace_id` fields so a
-collector can correlate spans across the request plane.
+OTLP-in-logging-init); log records carry `request_id`/`trace_id`/`cell`
+correlation fields whenever a request context is active, so one grep joins a
+frontend log line, its flight-recorder dump, its exported span, and the
+capture bundle the observatory wrote for it (docs/observability.md).
+
+DYNT_LOG_JSON is the documented knob for one-line JSON records;
+DYNT_LOGGING_JSONL (the reference-shaped spelling) enables the same
+formatter — either one wins.
 """
 
 from __future__ import annotations
@@ -23,8 +29,29 @@ from .config import env
 current_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "dynt_request_id", default=None
 )
+# The W3C trace id of the active request (set alongside current_request_id by
+# the frontends once the traceparent is resolved) — log lines carry it so they
+# join the span stream without a request-id -> trace-id lookup table.
+current_trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dynt_trace_id", default=None
+)
+
+# Which federation cell this PROCESS serves in — process-wide, not
+# per-request (a process never changes cells mid-life). Set once by the
+# cell's composition root via set_log_cell().
+_log_cell: str = ""
 
 _CONFIGURED = False
+
+
+def set_log_cell(cell: str) -> None:
+    """Stamp every subsequent log record with this cell name."""
+    global _log_cell
+    _log_cell = cell or ""
+
+
+def log_cell() -> str:
+    return _log_cell
 
 
 class _JsonlFormatter(logging.Formatter):
@@ -38,6 +65,11 @@ class _JsonlFormatter(logging.Formatter):
         req_id = current_request_id.get()
         if req_id:
             entry["request_id"] = req_id
+        trace_id = current_trace_id.get()
+        if trace_id:
+            entry["trace_id"] = trace_id
+        if _log_cell:
+            entry["cell"] = _log_cell
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)
@@ -47,9 +79,11 @@ class _ReadableFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         req_id = current_request_id.get()
         rid = f" [{req_id[:8]}]" if req_id else ""
+        cell = f" ({_log_cell})" if _log_cell else ""
         base = (
             f"{self.formatTime(record, '%H:%M:%S')} "
-            f"{record.levelname:<5} {record.name}{rid}: {record.getMessage()}"
+            f"{record.levelname:<5} {record.name}{cell}{rid}: "
+            f"{record.getMessage()}"
         )
         if record.exc_info:
             base += "\n" + self.formatException(record.exc_info)
@@ -69,7 +103,8 @@ def configure_logging(level: Optional[str] = None, jsonl: Optional[bool] = None)
         return
     _CONFIGURED = True
     level = level or env("DYNT_LOG_LEVEL")
-    jsonl = env("DYNT_LOGGING_JSONL") if jsonl is None else jsonl
+    if jsonl is None:
+        jsonl = bool(env("DYNT_LOGGING_JSONL")) or bool(env("DYNT_LOG_JSON"))
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(_JsonlFormatter() if jsonl else _ReadableFormatter())
     root = logging.getLogger("dynamo_tpu")

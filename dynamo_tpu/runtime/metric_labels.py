@@ -1,0 +1,105 @@
+"""Bounded metric-label registry (docs/observability.md).
+
+Request-derived label values — tenant ids, session origins, cell names
+learned from traffic — are unbounded at millions-of-users scale, and
+every distinct value is a new Prometheus series held for the life of
+the process. This module is the one funnel such values must pass
+through before reaching ``Family.labels(...)``: per namespace, the
+first K distinct values (DYNT_METRIC_MAX_LABELS) keep their own
+series and everything later folds into a single ``other`` overflow
+bucket, counted on ``dynamo_metric_label_overflow_total{namespace}``.
+
+First-K-wins rather than frequency-ranked top-K is deliberate:
+Prometheus series cannot be relabelled after the fact, so demoting an
+already-admitted value would strand its series anyway — admission is
+sticky, only the cap is enforced. Operators who care about a specific
+tenant's series arriving late raise the cap, they don't reorder it.
+
+The dynaflow rule DF406 flags ``.labels(...)`` call sites that feed a
+risky label name (tenant, session, origin, ...) a non-constant value
+not mediated by :func:`bounded_label`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set
+
+from .config import env
+
+# The overflow bucket every past-cap value folds into. A literal so
+# dashboards can alert on its share of traffic (a large `other` slice
+# means the cap is too low for this fleet).
+OVERFLOW = "other"
+
+
+class LabelRegistry:
+    """Per-namespace bounded admission of label values.
+
+    Thread-safe: admission races at the cap resolve to one winner, the
+    loser folds into OVERFLOW — never more than `cap` distinct values
+    per namespace.
+    """
+
+    def __init__(self, cap: Optional[int] = None) -> None:
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._admitted: Dict[str, Set[str]] = {}
+        self._overflowed: Dict[str, int] = {}
+
+    def cap(self) -> int:
+        if self._cap is not None:
+            return self._cap
+        return max(1, int(env("DYNT_METRIC_MAX_LABELS")))
+
+    def admit(self, namespace: str, value: str) -> str:
+        """Map `value` to the label actually safe to emit: the value
+        itself while the namespace has headroom (or the value was
+        admitted earlier), OVERFLOW once the cap is reached."""
+        if not value:
+            return value
+        with self._lock:
+            seen = self._admitted.setdefault(namespace, set())
+            if value in seen:
+                return value
+            if len(seen) < self.cap():
+                seen.add(value)
+                return value
+            self._overflowed[namespace] = (
+                self._overflowed.get(namespace, 0) + 1)
+        # Counter inc outside the lock: the registry is on the request
+        # path, prometheus does its own locking.
+        from .metrics import METRIC_LABEL_OVERFLOW
+        METRIC_LABEL_OVERFLOW.labels(namespace=namespace).inc()
+        return OVERFLOW
+
+    def admitted(self, namespace: str) -> Set[str]:
+        with self._lock:
+            return set(self._admitted.get(namespace, ()))
+
+    def overflowed(self, namespace: str) -> int:
+        with self._lock:
+            return self._overflowed.get(namespace, 0)
+
+
+_registry: Optional[LabelRegistry] = None
+
+
+def get_label_registry() -> LabelRegistry:
+    global _registry
+    if _registry is None:
+        _registry = LabelRegistry()
+    return _registry
+
+
+def reset_label_registry() -> None:
+    """Drop the singleton (tests / cap changes)."""
+    global _registry
+    _registry = None
+
+
+def bounded_label(namespace: str, value: str) -> str:
+    """The call-site funnel DF406 recognizes: bound `value` through the
+    process-wide registry under `namespace` (conventionally the label
+    name: "tenant", "cell", ...)."""
+    return get_label_registry().admit(namespace, value)

@@ -510,6 +510,89 @@ OTEL_SPANS_DROPPED = Counter(
     "Spans dropped before export (buffer_full | export_error)",
     ["reason"], registry=REGISTRY,
 )
+# Fleet observatory (dynamo_tpu/observatory/; docs/observability.md
+# fleet section): per-process families scraped from every discovered
+# /metrics endpoint and folded into one fleet-level view, plus the
+# alerting and capture planes that act on it.
+FLEET_GOODPUT_RATIO = Gauge(
+    "dynamo_fleet_goodput_ratio",
+    "Fleet-wide SLO goodput: sum(dynamo_slo_good_total) / "
+    "sum(dynamo_slo_requests_total) across every scraped process "
+    "(cumulative; the burn-rate rules use windowed rates instead)",
+    registry=REGISTRY,
+)
+FLEET_TTFT_SECONDS = Gauge(
+    "dynamo_fleet_ttft_seconds",
+    "Fleet TTFT quantiles merged from every process's "
+    "dynamo_time_to_first_token_seconds buckets (bucket-wise sum, then "
+    "interpolated), by quantile (p50/p95/p99)",
+    ["quantile"], registry=REGISTRY,
+)
+FLEET_ITL_SECONDS = Gauge(
+    "dynamo_fleet_itl_seconds",
+    "Fleet inter-token-latency quantiles merged from every process's "
+    "dynamo_inter_token_latency_seconds buckets, by quantile",
+    ["quantile"], registry=REGISTRY,
+)
+FLEET_POOL_MFU = Gauge(
+    "dynamo_fleet_pool_mfu",
+    "Mean dynamo_mfu across the scraped workers of a pool — the "
+    "per-pool utilization pane the planner and humans share",
+    ["pool"], registry=REGISTRY,
+)
+FLEET_POOL_TTFT_P95 = Gauge(
+    "dynamo_fleet_pool_ttft_p95_seconds",
+    "Per-pool TTFT p95 merged from that pool's workers' buckets — the "
+    "attribution signal a firing perf alert names its pool from",
+    ["pool"], registry=REGISTRY,
+)
+FLEET_TARGETS = Gauge(
+    "dynamo_fleet_targets",
+    "Scrape targets the fleet collector currently tracks, by health "
+    "(ok / broken — broken means the target's scrape breaker is open)",
+    ["health"], registry=REGISTRY,
+)
+FLEET_SCRAPES = Counter(
+    "dynamo_fleet_scrapes_total",
+    "Collector scrape attempts, by outcome: ok, error (fetch raised "
+    "or timed out), skipped (circuit breaker open — target gets the "
+    "cooldown, not a hammering)",
+    ["outcome"], registry=REGISTRY,
+)
+ALERT_ACTIVE = Gauge(
+    "dynamo_alert_active",
+    "1 while the alert rule is firing, 0 otherwise — the pane planners "
+    "and pagers watch, by rule and severity",
+    ["rule", "severity"], registry=REGISTRY,
+)
+ALERTS_TOTAL = Counter(
+    "dynamo_alerts_total",
+    "Alert lifecycle transitions, by rule and transition "
+    "(firing / resolved)",
+    ["rule", "transition"], registry=REGISTRY,
+)
+OBSERVATORY_BUNDLES = Counter(
+    "dynamo_observatory_bundles_total",
+    "Anomaly-triggered capture bundles, by outcome: written, "
+    "rate_limited (rule inside its capture cooldown), disabled "
+    "(DYNT_OBSERVATORY_DIR unset), error (assembly failed — alert "
+    "still fires, the artifact is best-effort)",
+    ["outcome"], registry=REGISTRY,
+)
+OBSERVATORY_SPOOL_BYTES = Gauge(
+    "dynamo_observatory_spool_bytes",
+    "Bytes currently held by the capture-bundle spool under "
+    "DYNT_OBSERVATORY_DIR (bounded by DYNT_OBSERVATORY_MAX_MB)",
+    registry=REGISTRY,
+)
+METRIC_LABEL_OVERFLOW = Counter(
+    "dynamo_metric_label_overflow_total",
+    "Label values folded into the 'other' overflow bucket by the "
+    "bounded label registry (runtime/metric_labels.py), by namespace. "
+    "A namespace growing here means DYNT_METRIC_MAX_LABELS is below "
+    "this fleet's real cardinality",
+    ["namespace"], registry=REGISTRY,
+)
 
 
 def render() -> bytes:

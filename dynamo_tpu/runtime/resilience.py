@@ -29,13 +29,17 @@ Four primitives, composed by PushRouter / the frontend / Migration:
                     probe) -> closed on probe success / open on probe
                     failure.
 
-Everything here is asyncio-single-threaded state; no locks needed.
+Most state here is asyncio-single-threaded; the CircuitBreaker is the
+exception — the observatory's fleet collector drives per-target
+breakers from a scrape worker thread while routers drive theirs on the
+loop, so the breaker serializes its own transitions with a lock.
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+import threading
 import time
 from typing import Any, Optional
 
@@ -252,10 +256,16 @@ class CircuitBreaker:
     Unlike a fixed cooldown (the old DOWN_COOLDOWN_SECS), a breaker
     that half-opens admits exactly ONE probe request: a still-sick
     backend costs one request per reset window instead of a full
-    re-admitted wave."""
+    re-admitted wave.
+
+    Thread-safe: routers mutate breakers on the event loop while the
+    observatory's collector drives its own from a scrape worker thread,
+    so every verdict/transition holds `_lock` (uncontended in the
+    loop-only case)."""
 
     __slots__ = ("failure_threshold", "reset_secs", "state", "_failures",
-                 "_opened_at", "_probe_inflight", "_on_transition")
+                 "_opened_at", "_probe_inflight", "_on_transition",
+                 "_lock")
 
     def __init__(self, failure_threshold: int = 1, reset_secs: float = 5.0,
                  on_transition=None) -> None:
@@ -266,6 +276,7 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_inflight = False
         self._on_transition = on_transition
+        self._lock = threading.Lock()
 
     def _transition(self, state: str) -> None:
         if state == self.state:
@@ -282,29 +293,32 @@ class CircuitBreaker:
     def can_attempt(self) -> bool:
         """Non-mutating admission check (candidate filtering): closed,
         or open-with-elapsed-reset, or half-open with no probe out."""
-        if self.state == CLOSED:
-            return True
-        if self.state == OPEN:
-            return time.monotonic() - self._opened_at >= self.reset_secs
-        return not self._probe_inflight
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                return (time.monotonic() - self._opened_at
+                        >= self.reset_secs)
+            return not self._probe_inflight
 
     def try_acquire(self) -> bool:
         """Mutating dispatch gate: the half-open single-probe slot is
         reserved HERE, immediately before the request goes out, never
         during candidate filtering (which may not dispatch)."""
-        if self.state == CLOSED:
-            return True
-        now = time.monotonic()
-        if self.state == OPEN:
-            if now - self._opened_at < self.reset_secs:
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            now = time.monotonic()
+            if self.state == OPEN:
+                if now - self._opened_at < self.reset_secs:
+                    return False
+                self._transition(HALF_OPEN)
+                self._probe_inflight = True
+                return True
+            if self._probe_inflight:
                 return False
-            self._transition(HALF_OPEN)
             self._probe_inflight = True
             return True
-        if self._probe_inflight:
-            return False
-        self._probe_inflight = True
-        return True
 
     def release_probe(self) -> None:
         """Return an acquired dispatch slot WITHOUT a verdict — the
@@ -312,56 +326,62 @@ class CircuitBreaker:
         health (deadline ran out first, application-level error, caller
         went away). Without this the half-open single-probe slot would
         leak and lock the instance out of rotation forever."""
-        self._probe_inflight = False
+        with self._lock:
+            self._probe_inflight = False
 
     def record_success(self, probe: bool = False) -> None:
         """`probe=True` only from the attempt that owns the half-open
         probe slot: a stale pre-open attempt settling late must not
         release (or double-release) another request's probe."""
-        self._failures = 0
-        if probe:
-            self._probe_inflight = False
-        if self.state != CLOSED:
-            self._transition(CLOSED)
-
-    def record_failure(self, probe: bool = False) -> None:
-        now = time.monotonic()
-        if self.state == HALF_OPEN:
-            # Back to open for another reset window. Only the probe
-            # owner returns the slot — see record_success.
+        with self._lock:
+            self._failures = 0
             if probe:
                 self._probe_inflight = False
-            self._opened_at = now
-            self._transition(OPEN)
-            return
-        if self.state == OPEN:
-            # A failure while already open (direct-mode dispatch bypasses
-            # try_acquire, so no HALF_OPEN transition happened): re-arm
-            # the reset window, or the breaker stops fail-fasting the
-            # instance entirely after the first window elapses.
-            self._opened_at = now
-            return
-        self._failures += 1
-        if self._failures >= self.failure_threshold:
-            self._opened_at = now
-            self._transition(OPEN)
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self, probe: bool = False) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if self.state == HALF_OPEN:
+                # Back to open for another reset window. Only the probe
+                # owner returns the slot — see record_success.
+                if probe:
+                    self._probe_inflight = False
+                self._opened_at = now
+                self._transition(OPEN)
+                return
+            if self.state == OPEN:
+                # A failure while already open (direct-mode dispatch
+                # bypasses try_acquire, so no HALF_OPEN transition
+                # happened): re-arm the reset window, or the breaker
+                # stops fail-fasting the instance entirely after the
+                # first window elapses.
+                self._opened_at = now
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._opened_at = now
+                self._transition(OPEN)
 
     def force_open(self) -> None:
         """External death verdict (heartbeat expiry, cell loss): open
         immediately regardless of the failure threshold — counting
         per-request failures against an instance known to be gone just
         burns requests proving it."""
-        self._failures = 0
-        self._probe_inflight = False
-        self._opened_at = time.monotonic()
-        self._transition(OPEN)
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            self._opened_at = time.monotonic()
+            self._transition(OPEN)
 
     def reset(self) -> None:
         """External evidence of health (discovery re-confirmed the
         instance): drop all failure state."""
-        self._failures = 0
-        self._probe_inflight = False
-        self._transition(CLOSED)
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            self._transition(CLOSED)
 
 
 class BreakerBoard:

@@ -1,13 +1,16 @@
 """System status server: /health, /live, /metrics, /debug/requests,
-/debug/profile.
+/debug/profile, /fleet, /debug/alerts.
 
 Every runtime process exposes liveness, endpoint health, Prometheus
 metrics, and its flight-recorder timelines on an HTTP port (ref:
 lib/runtime/src/system_status_server.rs:131-178). /metrics negotiates
 OpenMetrics (exemplars) via the Accept header; /debug/requests returns
-the per-request phase timelines; /debug/profile runs an on-demand
-jax.profiler capture in THIS process and returns the trace artifact
-path (docs/observability.md).
+the per-request phase timelines (filterable:
+?status=&tenant=&model=&slow=&limit=&offset=); /debug/profile runs an
+on-demand jax.profiler capture in THIS process and returns the trace
+artifact path; /fleet and /debug/alerts serve the observatory's
+rollup pane and alert log when one is installed
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -115,10 +118,77 @@ def metrics_response(request: web.Request) -> web.Response:
                         charset="utf-8")
 
 
-def debug_requests_response(_request: web.Request) -> web.Response:
+def _timeline_matches(timeline: dict, status: str, tenant: str,
+                      model: str, slow: str) -> bool:
+    if status and timeline.get("status") != status:
+        return False
+    if tenant and timeline.get("tenant") != tenant:
+        return False
+    if model and timeline.get("model") != model:
+        return False
+    if slow and not timeline.get("slow"):
+        return False
+    return True
+
+
+def debug_requests_response(request: web.Request) -> web.Response:
     """Shared /debug/requests responder: the flight recorder's inflight
-    + recently-completed request timelines."""
-    return web.json_response(get_recorder().snapshot())
+    + recently-completed request timelines.
+
+    At flood scale the unfiltered dump is unusable, so the responder
+    filters and paginates: ``?status=error&tenant=acme&model=m&slow=1``
+    narrow by timeline fields, ``?limit=&offset=`` page through each
+    list in the recorder's order (completed newest first), applied
+    after filtering. The response carries the pre-pagination totals so
+    callers know what they are missing.
+    """
+    query = request.query
+    status = query.get("status", "")
+    tenant = query.get("tenant", "")
+    model = query.get("model", "")
+    slow = query.get("slow", "")
+    try:
+        limit = int(query.get("limit", 0))
+        offset = int(query.get("offset", 0))
+    except ValueError:
+        return web.json_response(
+            {"error": "limit/offset must be integers"}, status=400)
+    snapshot = get_recorder().snapshot()
+    out: dict = {}
+    for section in ("inflight", "completed"):
+        rows = [t for t in snapshot.get(section, [])
+                if _timeline_matches(t, status, tenant, model, slow)]
+        out[f"total_{section}"] = len(rows)
+        if offset:
+            rows = rows[offset:]
+        if limit > 0:
+            rows = rows[:limit]
+        out[section] = rows
+    return web.json_response(out)
+
+
+def fleet_response(_request: web.Request) -> web.Response:
+    """Shared /fleet responder: the observatory's rollup pane (404
+    until an Observatory is installed in this process)."""
+    from ..observatory.service import get_observatory
+
+    obs = get_observatory()
+    if obs is None:
+        return web.json_response(
+            {"error": "no observatory in this process"}, status=404)
+    return web.json_response(obs.status_json())
+
+
+def debug_alerts_response(_request: web.Request) -> web.Response:
+    """Shared /debug/alerts responder: active alerts + the bounded
+    transition log."""
+    from ..observatory.service import get_observatory
+
+    obs = get_observatory()
+    if obs is None:
+        return web.json_response(
+            {"error": "no observatory in this process"}, status=404)
+    return web.json_response(obs.alerts_json())
 
 
 class SystemStatusServer:
@@ -162,6 +232,12 @@ class SystemStatusServer:
     async def _debug_profile(self, request: web.Request) -> web.Response:
         return await profile_response(request)
 
+    async def _fleet(self, request: web.Request) -> web.Response:
+        return fleet_response(request)
+
+    async def _debug_alerts(self, request: web.Request) -> web.Response:
+        return debug_alerts_response(request)
+
     def register_drain(self, fn) -> None:
         """fn: async () -> dict — runs the component's graceful drain
         (idempotent; a second POST while draining awaits the first) and
@@ -191,6 +267,8 @@ class SystemStatusServer:
             app.router.add_post("/drain", self._drain)
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/profile", self._debug_profile)
+        app.router.add_get("/fleet", self._fleet)
+        app.router.add_get("/debug/alerts", self._debug_alerts)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)

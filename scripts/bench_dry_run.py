@@ -10,9 +10,12 @@ with sizes shrunk to seconds-scale, and fails if any required block is
 missing or errored.
 
 Run: python scripts/bench_dry_run.py          (CI: bench-dry-run job)
-Prints one JSON line mirroring bench.py's report shape.
+Prints one JSON line mirroring bench.py's report shape; `--json PATH`
+also writes it to a file — the input tools/dynawatch gates against its
+blessed baselines (CI: obs-watch job).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -29,6 +32,11 @@ REQUIRED_BLOCKS = ("spec", "kvbm_offload", "disagg", "q4_ablation",
 
 
 def main() -> int:
+    parser = argparse.ArgumentParser("bench_dry_run")
+    parser.add_argument("--json", default="",
+                        help="also write the report to this path")
+    args = parser.parse_args()
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -57,6 +65,9 @@ def main() -> int:
     result["cold_start"] = bench.bench_cold_start_point()
 
     print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh)
 
     failures = []
     for key in REQUIRED_BLOCKS:

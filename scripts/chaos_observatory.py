@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Observatory chaos gate: a simulated 2-pool fleet watched by the real
+observatory stack (collector breakers, histogram-merge rollups,
+burn-rate alerting, capture bundles) under an injected clock, asserting
+the fast burn-rate alert fires within the detection budget and names
+the degraded pool, a complete capture bundle lands in the spool, the
+dead target's scrape breaker bounds the damage and re-closes after
+revival, the alert resolves after the heal, the clean arm produces
+zero transitions/bundles, and zero ProtocolMonitor violations
+(dynamo_tpu/mocker/observatory_chaos.py; docs/observability.md). Exit
+code gates the obs-watch CI job; the JSON report + bundle spool upload
+as artifacts.
+
+    python scripts/chaos_observatory.py --out chaos-observatory
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("DYNT_LOG_LEVEL", "WARNING")
+    from dynamo_tpu.mocker.observatory_chaos import main
+
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""DF406 negative fixture: every per-origin label literal or funneled
+through bounded_label()/LabelRegistry.admit()."""
+
+from prometheus_client import Counter
+
+from dynamo_tpu.runtime.metric_labels import bounded_label, get_label_registry
+
+SHED = Counter("dynamo_fixture_shed_total", "per-tenant sheds",
+               ["tenant", "reason"])
+SPILL = Counter("dynamo_fixture_spill_total", "cross-cell spills",
+                ["from", "to", "reason"])
+OUTCOMES = Counter("dynamo_fixture_outcomes_total", "bounded by design",
+                   ["outcome"])
+
+
+def record(tenant, src, dst, outcome):
+    SHED.labels(tenant=bounded_label("tenant", tenant),
+                reason="quota").inc()
+    SHED.labels(tenant="untagged", reason="queue").inc()
+    SPILL.labels(bounded_label("cell", src),
+                 bounded_label("cell", dst), "pressure").inc()
+    SPILL.labels(**{"from": bounded_label("cell", src),
+                    "to": "home", "reason": "evac"}).inc()
+    # admit() is the registry-level funnel — equally bounded
+    SHED.labels(tenant=get_label_registry().admit("tenant", tenant),
+                reason="quota").inc()
+    # non-risky label names stay free-form
+    OUTCOMES.labels(outcome=outcome).inc()

@@ -1,0 +1,17 @@
+"""DF406 positive fixture: per-origin labels fed raw dynamic values."""
+
+from prometheus_client import Counter
+
+SHED = Counter("dynamo_fixture_shed_total", "per-tenant sheds",
+               ["tenant", "reason"])
+SPILL = Counter("dynamo_fixture_spill_total", "cross-cell spills",
+                ["from", "to", "reason"])
+
+
+def record(tenant, src, dst):
+    # keyword form: raw tenant -> DF406
+    SHED.labels(tenant=tenant, reason="quota").inc()
+    # **dict form (reserved-word labels): raw from/to -> DF406 x2
+    SPILL.labels(**{"from": src, "to": dst, "reason": "evac"}).inc()
+    # positional form: raw from/to -> DF406 x2 (reason is a literal)
+    SPILL.labels(src, dst, "pressure").inc()

@@ -1,0 +1,9 @@
+"""DF406 suppression fixture: a justified disable on the flagged line."""
+
+from prometheus_client import Counter
+
+CELLS = Counter("dynamo_fixture_cell_total", "per-cell events", ["cell"])
+
+
+def record(cell):
+    CELLS.labels(cell=cell).inc()  # dynaflow: disable=DF406 -- cell set is fixed at deploy time

@@ -480,3 +480,32 @@ class TestSpotChurnRamp:
             assert report["passed"], failed
 
         run(body(), timeout=240.0)
+
+
+@pytest.mark.slow
+class TestObservatoryChaos:
+    """Fleet-observatory chaos (docs/observability.md): a mocker fleet
+    of two pools behind the REAL collector/alert-engine/bundler stack,
+    decode's step time degraded 12x mid-run and one worker SIGKILL'd
+    (its scrapes fail, its breaker opens). Asserted from the JSON
+    report (the obs-watch CI artifact): the burn-rate page fires inside
+    the pinned detection budget and names the degraded pool, the
+    capture bundle is complete, the alert resolves after the heal with
+    hysteresis, the clean arm stays silent, and the observatory_alert
+    protocol monitor sees zero violations in both arms."""
+
+    def test_degradation_pages_and_resolves(self, tmp_path, monkeypatch):
+        from dynamo_tpu.mocker.observatory_chaos import (
+            ObservatoryChaosParams,
+            run_observatory,
+        )
+
+        monkeypatch.setenv("DYNT_CONFORMANCE", "1")
+        params = ObservatoryChaosParams()
+        report = run_observatory(
+            params, spool_root=str(tmp_path / "spool"))
+        path = _write_chaos_report("chaos_observatory", report,
+                                   default_dir=str(tmp_path))
+        print(f"observatory scenario report: {path}")
+        failed = [c for c in report["assertions"] if not c["ok"]]
+        assert report["passed"], failed

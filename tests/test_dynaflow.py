@@ -26,6 +26,7 @@ from tools.dynaflow.passes_registry import (
     DeadConfigKnob,
     DuplicateMetricName,
     EnvDefaultTypeMismatch,
+    UnboundedMetricLabel,
     UndocumentedMetric,
     UnregisteredEnvRead,
 )
@@ -175,6 +176,23 @@ class TestRegistryConformance:
              DeadConfigKnob(), DuplicateMetricName(),
              UndocumentedMetric(doc_path=FIXTURES / "metrics_doc.md")])
         assert findings == []
+
+
+class TestBoundedLabels:
+    def test_positive_all_three_call_shapes(self):
+        findings = flow("labels_pos.py", [UnboundedMetricLabel()])
+        assert all(f.rule == "DF406" for f in findings)
+        # keyword tenant + **dict from/to + positional from/to
+        assert len(findings) == 5
+        msgs = " ".join(f.message for f in findings)
+        assert "'tenant'" in msgs and "'from'" in msgs and "'to'" in msgs
+        assert "bounded_label" in findings[0].message
+
+    def test_negative_bounded_and_literal_sites(self):
+        assert flow("labels_neg.py", [UnboundedMetricLabel()]) == []
+
+    def test_suppression_on_flagged_line(self):
+        assert flow("labels_suppressed.py", [UnboundedMetricLabel()]) == []
 
 
 class TestSpanRegistry:

@@ -1,0 +1,597 @@
+"""Fleet observatory unit tier (dynamo_tpu/observatory/): histogram
+quantile merges against a single-process oracle, burn-rate math on the
+injectable rollup clock (firing thresholds, hysteresis, window_scale
+compression), the threshold rule catalogue, collector breaker behavior,
+discovery-card target building, the bounded label registry,
+/debug/requests filtering + pagination, and log-record correlation."""
+
+import json
+import logging
+import math
+import random
+import threading
+import time
+
+import pytest
+
+from dynamo_tpu.observatory.alerts import (
+    AlertEngine,
+    BurnRateRule,
+    default_rules,
+)
+from dynamo_tpu.observatory.collector import (
+    FleetCollector,
+    ScrapeTarget,
+    Snapshot,
+    targets_from_cards,
+)
+from dynamo_tpu.observatory.rollup import (
+    FleetRollup,
+    PoolRollup,
+    build_rollup,
+    merged_buckets,
+    quantile_from_buckets,
+)
+from dynamo_tpu.runtime import metrics as rt_metrics
+from dynamo_tpu.runtime.metric_labels import (
+    OVERFLOW,
+    LabelRegistry,
+    bounded_label,
+    reset_label_registry,
+)
+
+TTFT = "dynamo_time_to_first_token_seconds"
+_LES = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, math.inf)
+
+
+def _counter(name, **labels):
+    for metric in rt_metrics.REGISTRY.collect():
+        if metric.name != name.removesuffix("_total"):
+            continue
+        for sample in metric.samples:
+            if sample.name == name and all(
+                    sample.labels.get(k) == v for k, v in labels.items()):
+                return sample.value
+    return 0.0
+
+
+def hist_buckets(samples):
+    """Observe `samples` into one cumulative histogram over _LES."""
+    return [(le, float(sum(1 for s in samples if s <= le)))
+            for le in _LES]
+
+
+def ttft_families(samples):
+    fams = {}
+    for le, count in hist_buckets(samples):
+        text = "+Inf" if math.isinf(le) else f"{le:g}"
+        fams[(TTFT + "_bucket", (("le", text),))] = count
+    return fams
+
+
+def snap(name, pool, families, at=0.0):
+    return Snapshot(target=ScrapeTarget(name=name, pool=pool), at=at,
+                    families=families)
+
+
+class TestQuantileMerge:
+    def test_merge_matches_single_process_oracle(self):
+        """Merging per-process histograms must equal observing the
+        union of all samples into ONE histogram — the property that
+        makes the fleet quantile honest."""
+        rng = random.Random(7)
+        shards = [[rng.lognormvariate(-1.5, 0.8) for _ in range(200)]
+                  for _ in range(4)]
+        snaps = [snap(f"w{i}", "decode", ttft_families(s))
+                 for i, s in enumerate(shards)]
+        union = [x for shard in shards for x in shard]
+        for q in (0.5, 0.9, 0.95, 0.99):
+            merged = quantile_from_buckets(
+                merged_buckets(snaps, TTFT), q)
+            oracle = quantile_from_buckets(hist_buckets(union), q)
+            assert merged == pytest.approx(oracle), q
+
+    def test_pool_filter_restricts_the_merge(self):
+        snaps = [snap("d0", "decode", ttft_families([0.04] * 10)),
+                 snap("p0", "prefill", ttft_families([4.9] * 10))]
+        decode_p95 = quantile_from_buckets(
+            merged_buckets(snaps, TTFT, pool="decode"), 0.95)
+        prefill_p95 = quantile_from_buckets(
+            merged_buckets(snaps, TTFT, pool="prefill"), 0.95)
+        assert decode_p95 <= 0.05 < prefill_p95
+
+    def test_inf_rank_clamps_to_last_finite_bound(self):
+        buckets = hist_buckets([10.0, 11.0, 12.0])  # all past 5.0
+        assert quantile_from_buckets(buckets, 0.5) == 5.0
+
+    def test_empty_and_zero_histograms_are_nan(self):
+        assert math.isnan(quantile_from_buckets([], 0.5))
+        assert math.isnan(quantile_from_buckets(
+            [(le, 0.0) for le in _LES], 0.5))
+
+
+def roll_at(at, good, total):
+    roll = FleetRollup(at=at)
+    roll.slo_good = good
+    roll.slo_total = total
+    return roll
+
+
+class TestBurnRate:
+    """One rule, hand-checkable numbers: slo_target 0.9 (10% budget),
+    threshold 4.5x, 50.5s/10s windows, 4.5s clear hold — fractional
+    constants chosen so no comparison (threshold, clear floor, hold,
+    window base selection) lands exactly on a tick boundary; a tie
+    there would make the transition tick an artifact of FP rounding,
+    not of the math. Traffic is 10 requests per tick, all-good or
+    all-bad."""
+
+    def _rule(self):
+        return BurnRateRule("slo_burn", severity="page", slo_target=0.9,
+                            threshold=4.5, long_s=50.5, short_s=10.0,
+                            clear_hold_s=4.5)
+
+    def _drive(self, scale, warm, bad, tail):
+        """healthy(warm) -> 100% errors(bad) -> healthy(tail); returns
+        [(tick, transition)] with ticks de-scaled for comparison."""
+        engine = AlertEngine([self._rule()], window_scale=scale,
+                             log_cap=32)
+        good = total = 0.0
+        out = []
+        for tick in range(warm + bad + tail):
+            failed = warm <= tick < warm + bad
+            good += 0.0 if failed else 10.0
+            total += 10.0
+            for tr in engine.evaluate(roll_at(tick * scale, good, total)):
+                out.append((tick, tr["transition"], tr["epoch"]))
+        return engine, out
+
+    def test_windowed_burn_math(self):
+        engine = AlertEngine([self._rule()], log_cap=8)
+        engine.evaluate(roll_at(0.0, 100.0, 100.0))
+        engine.evaluate(roll_at(10.0, 100.0, 200.0))
+        # last 10s: 100 requests, all errors -> err 1.0 / budget 0.1
+        assert engine.burn_rate(10.0, 10.0, 0.9) == pytest.approx(10.0)
+        # empty window (no finished requests) burns nothing
+        assert engine.burn_rate(10.0, 200.0, 0.9) == 0.0
+
+    def test_lifecycle_fires_resolves_with_hysteresis(self):
+        engine, out = self._drive(1.0, warm=20, bad=25, tail=75)
+        assert [t for _, t, _ in out] == ["firing", "resolved"]
+        fired, resolved = out[0][0], out[1][0]
+        # The short window saturates early (burn 10x by tick 30) but
+        # the page waits for the long window's significance: 16 bad
+        # ticks of the 35 in the window -> burn 4.57x > 4.5x.
+        assert fired == 35
+        # Errors stop at tick 44; resolution waits for the long burn to
+        # drop under threshold*resolve_ratio (2.25x, first true at tick
+        # 84) AND hold there for clear_hold_s — not the first clean
+        # tick.
+        assert resolved == 89
+        assert engine.active() == []
+
+    def test_short_spike_without_long_significance_stays_quiet(self):
+        """A 15-tick blip saturates the short window (burn 10x) but
+        never gives the long window >45% errors: no page, ever."""
+        engine, out = self._drive(1.0, warm=20, bad=15, tail=40)
+        assert out == []
+        assert engine.active() == []
+
+    def test_window_scale_compresses_without_changing_the_math(self):
+        _, reference = self._drive(1.0, warm=20, bad=25, tail=75)
+        _, compressed = self._drive(1.0 / 30.0, warm=20, bad=25, tail=75)
+        assert compressed == reference
+
+    def test_refire_opens_a_new_epoch(self):
+        engine = AlertEngine([self._rule()], log_cap=32)
+        good = total = 0.0
+        epochs = []
+        for tick in range(240):
+            # two outages with a long quiet gap between them
+            failed = 20 <= tick < 45 or 140 <= tick < 165
+            good += 0.0 if failed else 10.0
+            total += 10.0
+            for tr in engine.evaluate(roll_at(float(tick), good, total)):
+                epochs.append((tr["transition"], tr["epoch"]))
+        assert epochs == [("firing", 1), ("resolved", 1),
+                          ("firing", 2), ("resolved", 2)]
+
+
+class TestThresholdRules:
+    def _engine(self):
+        return AlertEngine(default_rules(), log_cap=16)
+
+    def _fired(self, engine, roll):
+        return {t["rule"]: t for t in engine.evaluate(roll)
+                if t["transition"] == "firing"}
+
+    def test_host_bound_names_the_worst_pool(self):
+        engine = self._engine()
+        roll = FleetRollup(at=1.0)
+        roll.pools["prefill"] = PoolRollup(pool="prefill", host_bound=2)
+        roll.pools["decode"] = PoolRollup(pool="decode", host_bound=1)
+        fired = self._fired(engine, roll)
+        assert fired["host_bound_workers"]["pool"] == "prefill"
+        assert "3 host-bound" in fired["host_bound_workers"]["detail"]
+
+    def test_breaker_storm_threshold_is_three(self):
+        engine = self._engine()
+        roll = FleetRollup(at=1.0)
+        roll.breakers_open = 2
+        assert "breaker_storm" not in self._fired(engine, roll)
+        roll = FleetRollup(at=2.0)
+        roll.breakers_open = 3
+        assert "breaker_storm" in self._fired(engine, roll)
+
+    def test_journal_corruption_is_delta_based(self):
+        engine = self._engine()
+        steady = FleetRollup(at=1.0)
+        steady.journal_bad_frames = 7.0
+        # first sight of a nonzero cumulative counter fires (prev=None
+        # bases at zero) ...
+        assert "journal_corruption" in self._fired(engine, steady)
+        # ... and a FLAT counter afterwards resolves: corruption is an
+        # event, not a standing condition.
+        flat = FleetRollup(at=2.0)
+        flat.journal_bad_frames = 7.0
+        transitions = engine.evaluate(flat)
+        assert [(t["rule"], t["transition"]) for t in transitions] == [
+            ("journal_corruption", "resolved")]
+
+    def test_protocol_violations_fire_on_new_counts(self):
+        engine = self._engine()
+        first = FleetRollup(at=1.0)
+        assert engine.evaluate(first) == []
+        bad = FleetRollup(at=2.0)
+        bad.protocol_violations = 1.0
+        assert "protocol_violations" in self._fired(engine, bad)
+
+    def test_federation_lag_past_contract(self):
+        engine = self._engine()
+        roll = FleetRollup(at=1.0)
+        roll.federation_max_lag_s = 1e9
+        fired = self._fired(engine, roll)
+        assert "federation_lag" in fired
+        assert "contract" in fired["federation_lag"]["detail"]
+
+
+EXPO = ("dynamo_slo_good_total 5.0\n"
+        "dynamo_slo_requests_total 10.0\n")
+
+
+class TestFleetCollector:
+    def _collector(self, fetch, **kw):
+        kw.setdefault("timeout_ms", 1000.0)
+        kw.setdefault("breaker_reset_secs", 60.0)
+        return FleetCollector(fetch=fetch, **kw)
+
+    def test_breaker_opens_after_failures_and_skips(self):
+        calls = []
+        dead = set()
+
+        def fetch(target, deadline):
+            calls.append(target.name)
+            if target.name in dead:
+                raise ConnectionError("down")
+            return EXPO
+
+        col = self._collector(fetch)
+        col.add_target(ScrapeTarget(name="a", pool="p"))
+        col.add_target(ScrapeTarget(name="b", pool="p"))
+        before_skip = _counter("dynamo_fleet_scrapes_total",
+                               outcome="skipped")
+        fresh = col.poll(1.0)
+        assert set(fresh) == {"a", "b"}
+        assert col.snapshots["a"].value("dynamo_slo_good_total") == 5.0
+
+        dead.add("b")
+        col.poll(2.0)
+        col.poll(3.0)  # second failure -> breaker opens
+        assert col._breakers["b"].state == "open"
+        fresh = col.poll(4.0)  # open breaker: skipped, not fetched
+        assert set(fresh) == {"a"}
+        assert calls.count("b") == 3  # 1 ok + 2 failures, then gated
+        assert _counter("dynamo_fleet_scrapes_total",
+                        outcome="skipped") - before_skip == 1.0
+        # the stale snapshot stays available for the rollup
+        assert "b" in col.snapshots
+        assert _counter("dynamo_fleet_targets", health="ok") == 1.0
+        assert _counter("dynamo_fleet_targets", health="broken") == 1.0
+
+    def test_deadline_expiry_counts_as_error(self):
+        def slow_fetch(target, deadline):
+            time.sleep(0.01)
+            return EXPO
+
+        col = self._collector(slow_fetch, timeout_ms=1.0)
+        col.add_target(ScrapeTarget(name="slow"))
+        before = _counter("dynamo_fleet_scrapes_total", outcome="error")
+        assert col.poll(1.0) == {}
+        assert _counter("dynamo_fleet_scrapes_total",
+                        outcome="error") - before == 1.0
+        assert "slow" not in col.snapshots
+
+    def test_dead_target_shows_broken_despite_stale_snapshot(self):
+        # Regression: the rollup used to recount self.snapshots, whose
+        # stale entries (kept for fold continuity) hid a dead target
+        # forever — targets_broken stayed 0 after a worker died.
+        from dynamo_tpu.observatory.service import Observatory
+
+        dead = set()
+
+        def fetch(target, deadline):
+            if target.name in dead:
+                raise ConnectionError("down")
+            return EXPO
+
+        obs = Observatory(
+            targets=[ScrapeTarget(name="a", pool="p"),
+                     ScrapeTarget(name="b", pool="p")],
+            fetch=fetch, scrape_timeout_ms=1000.0,
+            breaker_reset_secs=60.0)
+        roll = obs.tick(1.0)
+        assert (roll.targets_ok, roll.targets_broken) == (2, 0)
+
+        dead.add("b")
+        obs.tick(2.0)
+        roll = obs.tick(3.0)  # second failure -> breaker opens
+        assert obs.collector._breakers["b"].state == "open"
+        assert (roll.targets_ok, roll.targets_broken) == (1, 1)
+        assert (obs.collector.last_ok, obs.collector.last_broken) == (1, 1)
+        # the stale snapshot still feeds the fold, only the health
+        # split reflects the death
+        assert "b" in obs.collector.snapshots
+
+    def test_set_targets_reconciles_and_clears_state(self):
+        col = self._collector(lambda t, d: EXPO)
+        col.add_target(ScrapeTarget(name="a"))
+        col.add_target(ScrapeTarget(name="b"))
+        col.poll(1.0)
+        col.set_targets([ScrapeTarget(name="a"), ScrapeTarget(name="c")])
+        assert sorted(t.name for t in col.targets()) == ["a", "c"]
+        assert "b" not in col.snapshots
+        assert "b" not in col._breakers
+
+
+class TestTargetsFromCards:
+    def test_cards_build_deduped_pooled_targets(self):
+        cards = [
+            {"instance_id": 7, "subject": "ns.prefill.generate",
+             "system_url": "http://h:1"},
+            {"instance_id": 8, "subject": "ns.decode.generate",
+             "metadata": {"system_url": "http://h:2", "cell": "c1"}},
+            # same process (same status server) -> one target
+            {"instance_id": 9, "subject": "ns.decode.generate",
+             "system_url": "http://h:1"},
+            # no status server advertised -> not scrapeable
+            {"instance_id": 10, "subject": "ns.x.y"},
+        ]
+        targets = targets_from_cards(cards)
+        assert [(t.name, t.url, t.pool, t.cell) for t in targets] == [
+            ("7", "http://h:1", "prefill", ""),
+            ("8", "http://h:2", "decode", "c1"),
+        ]
+
+    def test_metadata_pool_overrides_subject(self):
+        (target,) = targets_from_cards(
+            [{"instance_id": 1, "subject": "ns.decode.generate",
+              "system_url": "http://h:9",
+              "metadata": {"pool": "decode-spot"}}])
+        assert target.pool == "decode-spot"
+
+    def test_live_slash_subjects_pool_by_component(self):
+        # the shape runtime/component.py actually publishes
+        (target,) = targets_from_cards(
+            [{"instance_id": 4870798920945837939,
+              "subject": "dynamo/mocker/generate/4870798920945837939",
+              "system_url": "http://127.0.0.1:35965"}])
+        assert target.pool == "mocker"
+        assert target.name == "4870798920945837939"
+
+
+class TestRollupFields:
+    def test_build_rollup_folds_the_planes(self):
+        fam_a = dict(ttft_families([0.04] * 20))
+        fam_a.update({
+            ("dynamo_slo_good_total", ()): 90.0,
+            ("dynamo_slo_requests_total", ()): 100.0,
+            ("dynamo_mfu", ()): 0.5,
+            ("dynamo_host_bound", ()): 1.0,
+            ("dynamo_circuit_breaker_state",
+             (("endpoint", "e"), ("instance", "0"))): 1.0,
+            ("dynamo_journal_bad_frames_total", ()): 2.0,
+            ("dynamo_kv_usage_ratio", ()): 0.7,
+            ("dynamo_federation_lag_seconds", ()): 1.5,
+        })
+        fam_b = dict(ttft_families([2.0] * 20))
+        fam_b.update({
+            ("dynamo_slo_good_total", ()): 40.0,
+            ("dynamo_slo_requests_total", ()): 100.0,
+            ("dynamo_mfu", ()): 0.3,
+            ("dynamo_kv_usage_ratio", ()): 0.9,
+        })
+        roll = build_rollup([snap("d0", "decode", fam_a),
+                             snap("p0", "prefill", fam_b)], at=5.0)
+        assert roll.at == 5.0 and roll.targets_ok == 2
+        assert roll.goodput_ratio == pytest.approx(0.65)
+        assert roll.pools["decode"].mfu == pytest.approx(0.5)
+        assert roll.pools["decode"].host_bound == 1
+        assert roll.breakers_open == 1
+        assert roll.journal_bad_frames == 2.0
+        assert roll.kv_usage_max == pytest.approx(0.9)
+        assert roll.federation_max_lag_s == pytest.approx(1.5)
+        # prefill's merged TTFT p95 dominates -> it is the worst pool
+        assert roll.pools["prefill"].ttft_p95_s > \
+            roll.pools["decode"].ttft_p95_s
+        assert roll.worst_pool() == "prefill"
+        json.dumps(roll.to_json())  # the /fleet pane must serialize
+
+    def test_worst_pool_nan_sorts_last(self):
+        roll = FleetRollup(at=1.0)
+        roll.pools["idle"] = PoolRollup(pool="idle")  # ttft nan
+        roll.pools["busy"] = PoolRollup(pool="busy", ttft_p95_s=0.2)
+        assert roll.worst_pool() == "busy"
+
+
+class TestLabelRegistry:
+    def test_first_k_wins_admission_is_sticky(self):
+        reg = LabelRegistry(cap=2)
+        assert reg.admit("tenant", "a") == "a"
+        assert reg.admit("tenant", "b") == "b"
+        assert reg.admit("tenant", "c") == OVERFLOW
+        assert reg.admit("tenant", "a") == "a"  # admitted stays admitted
+        assert reg.admit("tenant", "c") == OVERFLOW
+        assert reg.overflowed("tenant") == 2
+        assert reg.admitted("tenant") == {"a", "b"}
+        # namespaces bound independently
+        assert reg.admit("cell", "c") == "c"
+
+    def test_empty_value_passes_through(self):
+        reg = LabelRegistry(cap=1)
+        assert reg.admit("tenant", "") == ""
+        assert reg.admitted("tenant") == set()
+
+    def test_bounded_label_env_cap_and_overflow_counter(self, monkeypatch):
+        monkeypatch.setenv("DYNT_METRIC_MAX_LABELS", "1")
+        reset_label_registry()
+        try:
+            before = _counter("dynamo_metric_label_overflow_total",
+                              namespace="tenant")
+            assert bounded_label("tenant", "t0") == "t0"
+            assert bounded_label("tenant", "t1") == OVERFLOW
+            assert _counter("dynamo_metric_label_overflow_total",
+                            namespace="tenant") - before == 1.0
+        finally:
+            reset_label_registry()
+
+    def test_concurrent_admission_never_exceeds_cap(self):
+        reg = LabelRegistry(cap=8)
+
+        def worker(start):
+            for i in range(100):
+                reg.admit("ns", f"v{(start + i) % 40}")
+
+        threads = [threading.Thread(target=worker, args=(j * 7,))
+                   for j in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(reg.admitted("ns")) == 8
+
+
+class TestDebugRequestsFiltering:
+    def test_filters_pagination_and_totals(self, run):
+        import aiohttp
+
+        from dynamo_tpu.runtime.flight_recorder import (
+            get_recorder,
+            reset_recorder,
+        )
+        from dynamo_tpu.runtime.status import SystemStatusServer
+
+        reset_recorder()
+        rec = get_recorder()
+        for i in range(4):
+            rec.start(f"ok-{i}", model="m1")
+            rec.finish(f"ok-{i}", "ok")
+        for i in range(3):
+            rec.start(f"err-{i}", model="m2")
+            rec.finish(f"err-{i}", "error")
+        rec.start("live-0", model="m1")
+
+        async def body():
+            server = SystemStatusServer(port=0, host="127.0.0.1")
+            await server.start()
+            base = f"http://127.0.0.1:{server.port}/debug/requests"
+            out = {}
+            try:
+                async with aiohttp.ClientSession() as session:
+                    for name, qs in (("err", "?status=error"),
+                                     ("page",
+                                      "?status=error&limit=2&offset=1"),
+                                     ("model", "?model=m1"),
+                                     ("bad", "?limit=x")):
+                        async with session.get(base + qs) as resp:
+                            out[name] = (resp.status, await resp.json())
+            finally:
+                await server.close()
+            return out
+
+        out = run(body())
+        reset_recorder()
+        status, err = out["err"]
+        assert status == 200
+        assert err["total_completed"] == 3 and err["total_inflight"] == 0
+        assert [t["request_id"] for t in err["completed"]] == [
+            "err-2", "err-1", "err-0"]  # newest first
+        _, page = out["page"]
+        assert page["total_completed"] == 3  # pre-pagination total
+        assert [t["request_id"] for t in page["completed"]] == [
+            "err-1", "err-0"]
+        _, by_model = out["model"]
+        assert by_model["total_inflight"] == 1
+        assert by_model["total_completed"] == 4
+        status, bad = out["bad"]
+        assert status == 400 and "integers" in bad["error"]
+
+
+class TestLogCorrelation:
+    def _record(self):
+        return logging.LogRecord("dynamo_tpu.observatory", logging.WARNING,
+                                 __file__, 1, "capture bundle written: %s",
+                                 ("/tmp/b/000000-slo_burn_fast",), None)
+
+    def test_jsonl_formatter_carries_correlation_fields(self):
+        from dynamo_tpu.runtime.logging import (
+            _JsonlFormatter,
+            current_request_id,
+            current_trace_id,
+            set_log_cell,
+        )
+
+        tok_r = current_request_id.set("req-1")
+        tok_t = current_trace_id.set("ab" * 16)
+        set_log_cell("cell-9")
+        try:
+            entry = json.loads(_JsonlFormatter().format(self._record()))
+        finally:
+            current_request_id.reset(tok_r)
+            current_trace_id.reset(tok_t)
+            set_log_cell("")
+        assert entry["request_id"] == "req-1"
+        assert entry["trace_id"] == "ab" * 16
+        assert entry["cell"] == "cell-9"
+        assert "000000-slo_burn_fast" in entry["message"]
+
+    def test_readable_formatter_shows_cell_and_request(self):
+        from dynamo_tpu.runtime.logging import (
+            _ReadableFormatter,
+            current_request_id,
+            set_log_cell,
+        )
+
+        tok = current_request_id.set("req-12345678-extra")
+        set_log_cell("cell-9")
+        try:
+            line = _ReadableFormatter().format(self._record())
+        finally:
+            current_request_id.reset(tok)
+            set_log_cell("")
+        assert "(cell-9)" in line and "[req-1234" in line
+
+    def test_log_json_knob_selects_jsonl(self, monkeypatch):
+        import dynamo_tpu.runtime.logging as dlog
+        from dynamo_tpu.runtime.config import env
+
+        monkeypatch.setenv("DYNT_LOG_JSON", "1")
+        dlog.configure_logging(level="WARNING")
+        root = logging.getLogger("dynamo_tpu")
+        try:
+            assert isinstance(root.handlers[0].formatter,
+                              dlog._JsonlFormatter)
+        finally:
+            monkeypatch.delenv("DYNT_LOG_JSON")
+            dlog.configure_logging(level=str(env("DYNT_LOG_LEVEL")))
+        assert isinstance(root.handlers[0].formatter,
+                          dlog._ReadableFormatter)

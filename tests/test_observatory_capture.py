@@ -1,0 +1,197 @@
+"""Capture bundles (observatory/capture.py): bundle layout and
+manifest, per-rule rate limiting, the /debug/profile capture-lock
+contention path, spool count/size bounds, and the disabled/error
+outcomes — all with an injected fetch, no HTTP."""
+
+import json
+from pathlib import Path
+
+from dynamo_tpu.observatory.capture import CaptureBundler, CaptureSpool
+from dynamo_tpu.observatory.collector import ScrapeTarget
+from dynamo_tpu.observatory.rollup import FleetRollup
+from dynamo_tpu.runtime import metrics as rt_metrics
+
+BUNDLE_FILES = ("manifest.json", "rollup.json", "alerts.json",
+                "timelines.json", "steptrace.json")
+
+
+def _counter(name, **labels):
+    for metric in rt_metrics.REGISTRY.collect():
+        if metric.name != name.removesuffix("_total"):
+            continue
+        for sample in metric.samples:
+            if sample.name == name and all(
+                    sample.labels.get(k) == v for k, v in labels.items()):
+                return sample.value
+    return 0.0
+
+
+def _transition(rule="slo_burn_fast", pool="decode"):
+    return {"rule": rule, "severity": "page", "transition": "firing",
+            "epoch": 1, "detail": "burn 20x", "pool": pool,
+            "capture": True}
+
+
+def _fetch_json(target, path, timeout_s=5.0):
+    if path.startswith("/debug/requests"):
+        return {"inflight": [], "total_inflight": 0, "total_completed": 1,
+                "completed": [{"request_id": f"{target.name}-r0"}]}
+    return {"trace_dir": "/tmp/trace", "files": ["steptrace.pb"]}
+
+
+TARGETS = [ScrapeTarget(name="d0", pool="decode"),
+           ScrapeTarget(name="d1", pool="decode"),
+           ScrapeTarget(name="p0", pool="prefill")]
+
+
+def _bundler(tmp_path, fetch=_fetch_json, **kw):
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("max_bundles", 8)
+    kw.setdefault("max_mb", 8)
+    return CaptureBundler(spool_dir=str(tmp_path), fetch_json=fetch, **kw)
+
+
+class TestBundleAssembly:
+    def test_layout_manifest_and_pool_attribution(self, tmp_path):
+        bundler = _bundler(tmp_path)
+        path = bundler.maybe_capture(
+            _transition(), FleetRollup(at=1.0),
+            {"active": [], "log": []}, TARGETS, now=10.0)
+        assert path is not None and path.name == "000000-slo_burn_fast"
+        payloads = {}
+        for name in BUNDLE_FILES:
+            assert (path / name).is_file(), name
+            payloads[name] = json.loads((path / name).read_text())
+        manifest = payloads["manifest.json"]
+        assert manifest["rule"] == "slo_burn_fast"
+        assert manifest["pool"] == "decode"
+        # timelines come from the IMPLICATED pool's targets only
+        assert manifest["targets"] == ["d0", "d1"]
+        assert sorted(manifest["files"]) == sorted(BUNDLE_FILES)
+        assert set(payloads["timelines.json"]) == {"d0", "d1"}
+        assert payloads["steptrace.json"]["outcome"] == "captured"
+        assert manifest["steptrace_outcome"] == "captured"
+        assert payloads["alerts.json"] == {"active": [], "log": []}
+
+    def test_rate_limit_is_per_rule_with_cooldown(self, tmp_path):
+        bundler = _bundler(tmp_path, cooldown_s=100.0)
+        roll = FleetRollup(at=1.0)
+        alerts = {"active": [], "log": []}
+        before = _counter("dynamo_observatory_bundles_total",
+                          outcome="rate_limited")
+        assert bundler.maybe_capture(_transition(), roll, alerts,
+                                     TARGETS, now=10.0) is not None
+        # same rule inside the cooldown: suppressed
+        assert bundler.maybe_capture(_transition(), roll, alerts,
+                                     TARGETS, now=20.0) is None
+        assert _counter("dynamo_observatory_bundles_total",
+                        outcome="rate_limited") - before == 1.0
+        # a DIFFERENT rule is not throttled by the first one
+        other = bundler.maybe_capture(_transition(rule="host_bound_workers",
+                                                  pool="prefill"),
+                                      roll, alerts, TARGETS, now=21.0)
+        assert other is not None and other.name.endswith(
+            "host_bound_workers")
+        # past the cooldown the original rule captures again, seq bumped
+        again = bundler.maybe_capture(_transition(), roll, alerts,
+                                      TARGETS, now=200.0)
+        assert again is not None and again.name == "000002-slo_burn_fast"
+
+    def test_disabled_without_spool_dir(self, tmp_path):
+        bundler = CaptureBundler(spool_dir="", fetch_json=_fetch_json,
+                                 cooldown_s=0.0)
+        before = _counter("dynamo_observatory_bundles_total",
+                          outcome="disabled")
+        assert bundler.maybe_capture(_transition(), FleetRollup(at=1.0),
+                                     {}, TARGETS, now=1.0) is None
+        assert _counter("dynamo_observatory_bundles_total",
+                        outcome="disabled") - before == 1.0
+
+    def test_profile_lock_contention_is_recorded_not_fatal(self, tmp_path):
+        """A human mid-/debug/profile holds the process capture lock:
+        the bundle still lands, with the contention on record instead
+        of a corrupted trace."""
+        from dynamo_tpu.runtime.status import _PROFILE_LOCK
+
+        bundler = _bundler(tmp_path)
+        assert _PROFILE_LOCK.acquire(blocking=False)
+        try:
+            path = bundler.maybe_capture(
+                _transition(), FleetRollup(at=1.0),
+                {"active": [], "log": []}, TARGETS, now=10.0)
+        finally:
+            _PROFILE_LOCK.release()
+        assert path is not None
+        steptrace = json.loads((path / "steptrace.json").read_text())
+        assert steptrace == {"outcome": "lock_contended"}
+        manifest = json.loads((path / "manifest.json").read_text())
+        assert manifest["steptrace_outcome"] == "lock_contended"
+        # the lock is free again for the next capture
+        assert _PROFILE_LOCK.acquire(blocking=False)
+        _PROFILE_LOCK.release()
+
+    def test_timeline_fetch_error_keeps_the_bundle(self, tmp_path):
+        def flaky(target, path, timeout_s=5.0):
+            if path.startswith("/debug/requests"):
+                raise ConnectionError("target died mid-incident")
+            return _fetch_json(target, path, timeout_s)
+
+        bundler = _bundler(tmp_path, fetch=flaky)
+        before = _counter("dynamo_observatory_bundles_total",
+                          outcome="written")
+        path = bundler.maybe_capture(
+            _transition(), FleetRollup(at=1.0),
+            {"active": [], "log": []}, TARGETS, now=10.0)
+        assert path is not None
+        timelines = json.loads((path / "timelines.json").read_text())
+        assert "died mid-incident" in timelines["d0"]["error"]
+        assert _counter("dynamo_observatory_bundles_total",
+                        outcome="written") - before == 1.0
+
+    def test_no_pool_match_falls_back_to_any_pooled_target(self, tmp_path):
+        bundler = _bundler(tmp_path)
+        path = bundler.maybe_capture(
+            _transition(pool="gone"), FleetRollup(at=1.0),
+            {"active": [], "log": []}, TARGETS, now=10.0)
+        manifest = json.loads((path / "manifest.json").read_text())
+        assert manifest["targets"]  # still captured something
+
+
+def _mk_bundle(root: Path, seq: int, rule: str, payload_bytes: int = 64):
+    path = root / f"{seq:06d}-{rule}"
+    path.mkdir(parents=True)
+    (path / "manifest.json").write_text("x" * payload_bytes)
+    return path
+
+
+class TestCaptureSpool:
+    def test_count_bound_drops_oldest(self, tmp_path):
+        spool = CaptureSpool(tmp_path, max_bundles=2, max_mb=100)
+        for seq in range(4):
+            _mk_bundle(tmp_path, seq, "r")
+        spool.prune()
+        assert [p.name for p in spool.bundles()] == [
+            "000002-r", "000003-r"]
+
+    def test_size_bound_keeps_the_newest_even_over_cap(self, tmp_path):
+        spool = CaptureSpool(tmp_path, max_bundles=10, max_mb=0)
+        for seq in range(3):
+            _mk_bundle(tmp_path, seq, "r")
+        spool.prune()
+        # an incident artifact beats an empty spool
+        assert [p.name for p in spool.bundles()] == ["000002-r"]
+
+    def test_next_dir_is_monotonic_across_pruning(self, tmp_path):
+        spool = CaptureSpool(tmp_path, max_bundles=1, max_mb=100)
+        for seq in range(3):
+            _mk_bundle(tmp_path, seq, "r")
+        spool.prune()
+        # pruning old bundles must never recycle their sequence numbers
+        assert spool.next_dir("r").name == "000003-r"
+
+    def test_empty_root_is_fine(self, tmp_path):
+        spool = CaptureSpool(tmp_path / "missing", max_bundles=2,
+                             max_mb=1)
+        assert spool.bundles() == []
+        spool.prune()
+        assert spool.next_dir("r").name == "000000-r"

@@ -61,6 +61,7 @@ for _cls in (
     passes_registry.DeadConfigKnob,
     passes_registry.DuplicateMetricName,
     passes_registry.UndocumentedMetric,
+    passes_registry.UnboundedMetricLabel,
     passes_spans.UndocumentedSpan,
     passes_spans.DuplicateSpanName,
 ):

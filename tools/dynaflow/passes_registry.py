@@ -296,3 +296,112 @@ def _imports_prometheus(src: SourceFile) -> bool:
         or (isinstance(n, ast.ImportFrom)
             and (n.module or "").split(".")[0] == "prometheus_client")
         for n in ast.walk(src.tree))
+
+
+# Label names whose values come from request / tenant / federation
+# identity. Fed raw, any of these turns metric cardinality into a
+# function of WHO shows up (every tenant id, session id, or peer cell a
+# request ever names mints an immortal Prometheus series); the
+# `runtime/metric_labels.bounded_label()` funnel caps each namespace at
+# DYNT_METRIC_MAX_LABELS with an `other` overflow bucket.
+_RISKY_LABELS = frozenset({
+    "tenant", "session", "session_id", "origin",
+    "user", "user_id", "from", "to", "cell",
+})
+
+# Call tails accepted as cardinality bounds at a .labels() site.
+_BOUNDING_TAILS = frozenset({"bounded_label", "admit"})
+
+
+class UnboundedMetricLabel(ProjectRule):
+    id = "DF406"
+    name = "unbounded-metric-label"
+    description = (
+        "a per-origin label (tenant/session/cell/...) fed a dynamic "
+        "value straight into .labels(): every distinct origin mints an "
+        "immortal Prometheus series — route the value through "
+        "runtime/metric_labels.bounded_label()")
+
+    def check_project(self, files: list[SourceFile]) -> Iterable[Finding]:
+        # metric VARIABLE name -> declared labelnames, project-wide
+        # (metrics are module-level consts; cross-module references
+        # keep the const name: rt_metrics.TENANT_SHED).
+        families: dict[str, list[str]] = {}
+        for src in files:
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _metric_name(node.value) is not None):
+                    continue
+                labelnames = _labelnames(node.value)
+                if labelnames is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        families[target.id] = labelnames
+        for src in files:
+            for node in ast.walk(src.tree):
+                yield from self._check_site(src, node, families)
+
+    def _check_site(self, src: SourceFile, node: ast.AST,
+                    families: dict[str, list[str]]) -> Iterable[Finding]:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            return
+        base = node.func.value
+        base_name = base.attr if isinstance(base, ast.Attribute) \
+            else getattr(base, "id", None)
+        labelnames = families.get(base_name or "")
+        if labelnames is None:
+            return
+        pairs: list[tuple[str, ast.expr]] = [
+            (labelnames[i], arg) for i, arg in enumerate(node.args)
+            if i < len(labelnames)]
+        for kw in node.keywords:
+            if kw.arg is not None:
+                pairs.append((kw.arg, kw.value))
+            elif isinstance(kw.value, ast.Dict):
+                # .labels(**{"from": x, ...}) — the reserved-word shape
+                pairs.extend(
+                    (key.value, v) for key, v in
+                    zip(kw.value.keys, kw.value.values)
+                    if isinstance(key, ast.Constant)
+                    and isinstance(key.value, str))
+        for label, value in pairs:
+            if label in _RISKY_LABELS and not _bounded_value(value):
+                yield Finding(
+                    self.id, self.name, src.rel, value.lineno,
+                    value.col_offset,
+                    f"label {label!r} on {base_name} fed a dynamic "
+                    f"value — wrap it in bounded_label({label!r}, ...) "
+                    "so origin churn cannot mint unbounded series")
+
+
+def _labelnames(node: ast.Call) -> Optional[list[str]]:
+    """Declared labelnames of a metric ctor call (third positional
+    sequence or labelnames= kwarg); None when label-less."""
+    candidates: list[ast.expr] = []
+    if len(node.args) >= 3:
+        candidates.append(node.args[2])
+    candidates.extend(kw.value for kw in node.keywords
+                      if kw.arg == "labelnames")
+    for cand in candidates:
+        if isinstance(cand, (ast.List, ast.Tuple)):
+            names = [e.value for e in cand.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)]
+            if len(names) == len(cand.elts):
+                return names
+    return None
+
+
+def _bounded_value(value: ast.expr) -> bool:
+    """True when the fed expression cannot mint unbounded series: a
+    string literal (finite by construction) or a value routed through
+    the bounded_label()/LabelRegistry.admit() funnel."""
+    if isinstance(value, ast.Constant):
+        return True
+    if isinstance(value, ast.Call) and call_tail(value) in _BOUNDING_TAILS:
+        return True
+    return False
